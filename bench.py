@@ -1,0 +1,55 @@
+"""Benchmark entry point.
+
+Prints ONE JSON line: {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}.
+Runs on whatever accelerator jax finds (real TPU chip under the driver).
+
+Current benchmark: single-chip training throughput of the mnist_mlp config
+(BASELINE.md measurement config 1).  Will move to the serving decode benchmark
+(config 3+) as the serving stack lands.
+"""
+
+import json
+import time
+
+import numpy as np
+
+
+def bench_mnist_mlp():
+    import jax
+
+    from flexflow_tpu import FFConfig, LossType, Model, SGDOptimizer
+    from flexflow_tpu.fftype import ActiMode
+
+    batch_size = 512
+    config = FFConfig(batch_size=batch_size, epochs=1)
+    model = Model(config)
+    x = model.create_tensor((batch_size, 784))
+    t = model.dense(x, 512, activation=ActiMode.RELU)
+    t = model.dense(t, 512, activation=ActiMode.RELU)
+    t = model.dense(t, 10)
+    t = model.softmax(t)
+    model.compile(optimizer=SGDOptimizer(lr=0.01, momentum=0.9),
+                  loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY)
+
+    rng = np.random.default_rng(0)
+    xs = rng.standard_normal((batch_size * 40, 784)).astype(np.float32)
+    ys = rng.integers(0, 10, batch_size * 40).astype(np.int32)
+
+    # warmup epoch compiles; timed epoch measures steady state
+    model.fit(xs, ys, epochs=1, verbose=False, shuffle=False)
+    t0 = time.time()
+    model.fit(xs, ys, epochs=1, verbose=False, shuffle=False)
+    dt = time.time() - t0
+    samples_per_s = xs.shape[0] / dt
+    return {
+        "metric": "mnist_mlp_training_throughput",
+        "value": round(samples_per_s, 1),
+        "unit": "samples/s",
+        # reference publishes no absolute numbers (BASELINE.md); 0 = no
+        # baseline ratio available yet
+        "vs_baseline": 0,
+    }
+
+
+if __name__ == "__main__":
+    print(json.dumps(bench_mnist_mlp()))

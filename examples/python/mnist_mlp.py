@@ -1,0 +1,61 @@
+"""MNIST MLP training example.
+
+Parity example for the reference's examples/python/native/mnist_mlp.py
+(784 -> 512 relu -> 512 relu -> 10 softmax, SGD, sparse CE).  Uses the real
+MNIST if available under ~/.keras (as the reference's keras dataset loader
+does), otherwise a synthetic stand-in so the example always runs.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+
+from flexflow_tpu import (FFConfig, LossType, MetricsType, Model,
+                          SGDOptimizer)
+from flexflow_tpu.fftype import ActiMode
+
+
+def load_mnist():
+    try:
+        import gzip
+        import os
+        import struct
+
+        d = os.path.expanduser("~/.mnist")
+        with gzip.open(os.path.join(d, "train-images-idx3-ubyte.gz")) as f:
+            _, n, h, w = struct.unpack(">IIII", f.read(16))
+            x = np.frombuffer(f.read(), np.uint8).reshape(n, h * w)
+        with gzip.open(os.path.join(d, "train-labels-idx1-ubyte.gz")) as f:
+            _ = f.read(8)
+            y = np.frombuffer(f.read(), np.uint8)
+        return (x.astype(np.float32) / 255.0), y.astype(np.int32)
+    except Exception:
+        rng = np.random.default_rng(0)
+        centers = rng.standard_normal((10, 784)).astype(np.float32)
+        y = rng.integers(0, 10, 8192).astype(np.int32)
+        x = centers[y] + 0.5 * rng.standard_normal((8192, 784)).astype(np.float32)
+        return x, y
+
+
+def top_level_task(epochs=2, batch_size=64):
+    config = FFConfig(batch_size=batch_size, epochs=epochs)
+    model = Model(config)
+    x = model.create_tensor((batch_size, 784))
+    t = model.dense(x, 512, activation=ActiMode.RELU)
+    t = model.dense(t, 512, activation=ActiMode.RELU)
+    t = model.dense(t, 10)
+    t = model.softmax(t)
+    model.compile(optimizer=SGDOptimizer(lr=0.01, momentum=0.9),
+                  loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+                  metrics=[MetricsType.ACCURACY,
+                           MetricsType.SPARSE_CATEGORICAL_CROSSENTROPY])
+    xs, ys = load_mnist()
+    model.fit(xs, ys, epochs=epochs)
+    return model.eval(xs, ys)
+
+
+if __name__ == "__main__":
+    top_level_task()

@@ -1,0 +1,24 @@
+"""flexflow_tpu: a TPU-native distributed DL framework.
+
+Brand-new implementation of the capabilities of the reference FlexFlow
+(Legion/CUDA auto-parallelizing training + SpecInfer LLM serving), designed
+TPU-first: JAX/XLA/Pallas for compute, GSPMD sharding over `jax.sharding.Mesh`
+for parallelism, ICI collectives instead of NCCL.  See SURVEY.md at the repo
+root for the structural map of the reference this build follows.
+"""
+
+from .config import (AXIS_DATA, AXIS_EXPERT, AXIS_MODEL, AXIS_PIPE, AXIS_SEQ,
+                     FFConfig)
+from .core.initializers import (ConstantInitializer, GlorotUniform,
+                                NormInitializer, UniformInitializer,
+                                ZeroInitializer)
+from .core.model import FFModel, Model
+from .core.tensor import ParallelDim, ParallelTensorShape, Tensor, TensorSpec
+from .fftype import (ActiMode, AggrMode, DataType, InferenceMode, LossType,
+                     MetricsType, OpType, ParameterSyncType, PoolType)
+from .training.dataloader import DataLoaderGroup, SingleDataLoader
+from .training.losses import compute_loss
+from .training.metrics import PerfMetrics
+from .training.optimizer import AdamOptimizer, Optimizer, SGDOptimizer
+
+__version__ = "0.1.0"

@@ -1,0 +1,121 @@
+"""Runtime configuration.
+
+TPU-native equivalent of the reference's ``FFConfig`` (reference:
+include/flexflow/config.h:102, defaults src/runtime/model.cc:3974-4008, arg
+parsing model.cc:4085+).  Where the reference configures Legion processors and
+framebuffer sizes, we configure a `jax.sharding.Mesh` over the available
+devices plus the parallelism degrees (dp/tp/pp + the new sequence-parallel
+axis the reference lacks, SURVEY.md §5).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+
+
+# Mesh axis names used across the framework.  Collectives ride ICI along
+# these axes; the GSPMD partitioner inserts them from NamedSharding
+# annotations (replaces the reference's NCCL-comm-per-MachineView scheme,
+# model.cc:3637-3673).
+AXIS_DATA = "dp"
+AXIS_MODEL = "tp"
+AXIS_PIPE = "pp"
+AXIS_SEQ = "sp"
+AXIS_EXPERT = "ep"
+
+
+@dataclasses.dataclass
+class FFConfig:
+    """Global runtime config (reference FFConfig, config.h:102).
+
+    The reference's per-GPU memory knobs (``-ll:fsize``, ``-ll:zsize``) have
+    no TPU analogue — XLA owns HBM — so they are accepted but unused.
+    """
+
+    batch_size: int = 64
+    epochs: int = 1
+    iterations: int = -1  # -1: derive from dataset size
+    # parallelism degrees (reference: -tensor-parallelism-degree etc.)
+    data_parallelism_degree: int = 1
+    tensor_parallelism_degree: int = 1
+    pipeline_parallelism_degree: int = 1
+    sequence_parallelism_degree: int = 1  # NEW vs reference (SURVEY.md §5)
+    expert_parallelism_degree: int = 1
+    # training knobs
+    only_data_parallel: bool = True  # reference DefaultConfig model.cc:3995
+    search_budget: int = -1
+    search_alpha: float = 1.2
+    enable_fusion: bool = True  # XLA fuses by default; kept for parity
+    profiling: bool = False
+    inference_debugging: bool = False
+    seed: int = 0
+    # numerics
+    computation_dtype: str = "float32"
+    # memory knobs (accepted for CLI parity; unused on TPU)
+    memory_per_device_mb: int = 0
+    zero_copy_memory_mb: int = 0
+    offload: bool = False
+    offload_reserve_space_size: int = 0
+    quantization: Optional[str] = None  # "int8" | "int4" | None
+    # device selection
+    num_devices: int = 0  # 0: all visible
+    devices: Optional[Sequence[jax.Device]] = None
+
+    def __post_init__(self):
+        if self.devices is None:
+            devs = jax.devices()
+            if self.num_devices:
+                devs = devs[: self.num_devices]
+            self.devices = tuple(devs)
+        self.num_devices = len(self.devices)
+
+    # ---------------------------------------------------------------- mesh
+    def total_parallel_degree(self) -> int:
+        return (
+            self.data_parallelism_degree
+            * self.tensor_parallelism_degree
+            * self.pipeline_parallelism_degree
+            * self.sequence_parallelism_degree
+            * self.expert_parallelism_degree
+        )
+
+    def validate(self):
+        """dp*tp*pp(*sp*ep) must cover the devices (reference:
+        inference_manager.cc:31-56)."""
+        if self.total_parallel_degree() > self.num_devices:
+            raise ValueError(
+                f"dp({self.data_parallelism_degree}) x "
+                f"tp({self.tensor_parallelism_degree}) x "
+                f"pp({self.pipeline_parallelism_degree}) x "
+                f"sp({self.sequence_parallelism_degree}) x "
+                f"ep({self.expert_parallelism_degree}) = "
+                f"{self.total_parallel_degree()} > num_devices "
+                f"({self.num_devices})"
+            )
+
+    def make_mesh(self, axes: Optional[Sequence[str]] = None) -> jax.sharding.Mesh:
+        """Build the device mesh.
+
+        Replaces the reference's MachineView device assignment
+        (machine_view.h:18-39) + FFMapper placement (mapper.cc:376-560):
+        device placement on TPU is mesh construction, and op placement is
+        sharding annotation.
+        """
+        self.validate()
+        degrees = {
+            AXIS_DATA: self.data_parallelism_degree,
+            AXIS_SEQ: self.sequence_parallelism_degree,
+            AXIS_PIPE: self.pipeline_parallelism_degree,
+            AXIS_EXPERT: self.expert_parallelism_degree,
+            AXIS_MODEL: self.tensor_parallelism_degree,
+        }
+        if axes is None:
+            axes = [a for a, d in degrees.items() if d > 1] or [AXIS_DATA]
+        shape = [degrees.get(a, 1) for a in axes]
+        n = int(np.prod(shape))
+        devs = np.array(self.devices[:n]).reshape(shape)
+        return jax.sharding.Mesh(devs, tuple(axes))

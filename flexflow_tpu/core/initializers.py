@@ -1,0 +1,81 @@
+"""Parameter initializers.
+
+TPU-native equivalent of the reference's initializer tasks
+(src/runtime/initializer.cc, initializer_kernel.cu — Glorot/Zero/Constant/
+Uniform/Normal launched as curand device tasks).  Here each initializer is a
+pure function of a jax PRNG key, executed inside the jitted init function, so
+XLA places the RNG on-chip — no host round trip.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class Initializer:
+    def __call__(self, key, shape, dtype, fans=None):
+        raise NotImplementedError
+
+
+class GlorotUniform(Initializer):
+    """reference: initializer.cc GlorotUniform (fan-based uniform).
+
+    ``fans=(fan_in, fan_out)`` may be supplied by the op's ParamSpec when the
+    storage layout doesn't follow a standard convention; otherwise inferred:
+    2-D = (in, out) [our Linear layout], 4-D = OIHW conv.
+    """
+
+    def __init__(self, seed: int = 0):
+        self.seed = seed
+
+    def __call__(self, key, shape, dtype, fans=None):
+        if fans is not None:
+            fan_in, fan_out = fans
+        elif len(shape) == 4:  # OIHW conv kernel
+            o, i, kh, kw = shape
+            fan_in, fan_out = i * kh * kw, o * kh * kw
+        elif len(shape) >= 2:
+            fan_in, fan_out = int(np.prod(shape[:-1])), shape[-1]
+        else:
+            fan_in = fan_out = shape[0] if shape else 1
+        limit = jnp.sqrt(6.0 / (fan_in + fan_out))
+        return jax.random.uniform(key, shape, dtype, -limit, limit)
+
+
+class ZeroInitializer(Initializer):
+    def __call__(self, key, shape, dtype, fans=None):
+        return jnp.zeros(shape, dtype)
+
+
+class ConstantInitializer(Initializer):
+    def __init__(self, value: float):
+        self.value = value
+
+    def __call__(self, key, shape, dtype, fans=None):
+        return jnp.full(shape, self.value, dtype)
+
+
+class UniformInitializer(Initializer):
+    def __init__(self, seed: int = 0, min_val: float = 0.0, max_val: float = 1.0):
+        self.seed = seed
+        self.min_val = min_val
+        self.max_val = max_val
+
+    def __call__(self, key, shape, dtype, fans=None):
+        return jax.random.uniform(key, shape, dtype, self.min_val, self.max_val)
+
+
+class NormInitializer(Initializer):
+    def __init__(self, seed: int = 0, mean: float = 0.0, stddev: float = 1.0):
+        self.seed = seed
+        self.mean = mean
+        self.stddev = stddev
+
+    def __call__(self, key, shape, dtype, fans=None):
+        return self.mean + self.stddev * jax.random.normal(key, shape, dtype)
+
+
+DEFAULT_WEIGHT_INIT = GlorotUniform()
+DEFAULT_BIAS_INIT = ZeroInitializer()

@@ -1,0 +1,40 @@
+"""Layer graph records.
+
+TPU-native equivalent of the reference's sequential Layer list
+(src/runtime/layer.cc): the user-facing graph is an ordered list of Layer
+records; lowering to the executable form happens at Model.compile (the
+reference's create_operators_from_layers, model.cc:3229).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..fftype import OpType
+from .tensor import Tensor, TensorSpec
+
+
+@dataclasses.dataclass
+class Layer:
+    """One node in the layer graph.
+
+    ``attrs`` plays the role of the reference Layer's property dict
+    (layer.cc add_int_property — e.g. carrying tensor_parallelism_degree into
+    lowering).
+    """
+
+    op_type: OpType
+    name: str
+    attrs: Dict[str, Any]
+    inputs: List[Tensor]
+    outputs: List[Tensor] = dataclasses.field(default_factory=list)
+    # populated at build time from OpDef.params()
+    param_specs: List[Any] = dataclasses.field(default_factory=list)
+    # serving metadata: which transformer block this layer belongs to
+    # (reference: LayerID.transformer_layer_id, fftype.h:9-19 — drives
+    # pipeline-stage assignment, graph.cc:2016)
+    transformer_layer_id: int = -1
+
+    def __repr__(self):
+        return f"Layer<{self.name}: {self.op_type.value}>"

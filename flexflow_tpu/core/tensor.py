@@ -1,0 +1,158 @@
+"""Symbolic tensors and parallel tensor shapes.
+
+TPU-native re-design of the reference's tensor layer:
+- ``Tensor`` here plays the role of the user-facing ``TensorBase``
+  (reference: src/runtime/layer.cc, include/flexflow/tensor.h) — a symbolic
+  handle produced by graph construction, before any execution.
+- ``ParallelDim``/``ParallelTensorShape`` mirror the reference's parallel
+  tensor metadata (include/flexflow/parallel_tensor.h:36-111) but instead of
+  Legion logical regions they carry a mesh-axis assignment per dim that lowers
+  to a `jax.sharding.NamedSharding`.
+
+Unlike the reference (which materialises ParallelTensors as Legion regions),
+actual storage is plain jax.Arrays laid out by GSPMD; this module is pure
+metadata.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence, Tuple
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec
+
+from ..fftype import DataType
+
+
+@dataclasses.dataclass(frozen=True)
+class ParallelDim:
+    """One dim of a parallel tensor (reference: parallel_tensor.h:36-71).
+
+    ``degree`` = #shards along this dim; ``mesh_axis`` = the mesh axis the
+    shards map onto (the reference stores ``parallel_idx`` into a MachineView
+    instead).  ``is_replica_dim`` marks pure replication dims.
+    """
+
+    size: int
+    degree: int = 1
+    mesh_axis: Optional[str] = None
+    is_replica_dim: bool = False
+
+    def __post_init__(self):
+        if self.degree > 1 and self.mesh_axis is None:
+            raise ValueError("sharded dim needs a mesh_axis")
+
+
+@dataclasses.dataclass(frozen=True)
+class ParallelTensorShape:
+    """Shape + per-dim parallel metadata (reference: parallel_tensor.h:90+)."""
+
+    dims: Tuple[ParallelDim, ...]
+    dtype: DataType
+
+    @property
+    def shape(self) -> Tuple[int, ...]:
+        return tuple(d.size for d in self.dims if not d.is_replica_dim)
+
+    def piece_shape(self) -> Tuple[int, ...]:
+        """Per-shard shape (reference: get_piece_size,
+        parallel_tensor.h:103-110)."""
+        return tuple(
+            d.size // d.degree for d in self.dims if not d.is_replica_dim
+        )
+
+    def num_replica_dims(self) -> int:
+        return sum(1 for d in self.dims if d.is_replica_dim)
+
+    def total_degree(self) -> int:
+        out = 1
+        for d in self.dims:
+            out *= d.degree
+        return out
+
+    def partition_spec(self) -> PartitionSpec:
+        """Lower to a PartitionSpec over the non-replica dims.
+
+        This is the boundary where the reference's parallel-op machinery
+        (Repartition/Combine/Replicate, src/parallel_ops/) collapses into a
+        single GSPMD annotation.
+        """
+        return PartitionSpec(
+            *[d.mesh_axis if d.degree > 1 else None
+              for d in self.dims if not d.is_replica_dim]
+        )
+
+    def named_sharding(self, mesh: jax.sharding.Mesh) -> NamedSharding:
+        return NamedSharding(mesh, self.partition_spec())
+
+
+@dataclasses.dataclass(frozen=True)
+class TensorSpec:
+    """Plain shape+dtype record for a symbolic tensor."""
+
+    shape: Tuple[int, ...]
+    dtype: DataType
+
+    @property
+    def ndim(self) -> int:
+        return len(self.shape)
+
+    def to_shape_dtype_struct(self) -> jax.ShapeDtypeStruct:
+        return jax.ShapeDtypeStruct(self.shape, self.dtype.to_jnp())
+
+
+class Tensor:
+    """Symbolic tensor handle returned by the layer-building API.
+
+    Mirrors the role of the reference's user-facing ``Tensor``
+    (flexflow_cffi.py Tensor / include/flexflow/tensor.h): identifies which
+    layer output it is, carries shape/dtype, and supports operator sugar that
+    routes back into the owning model's layer API.
+    """
+
+    __slots__ = ("spec", "owner_layer", "owner_idx", "model", "name", "initializer")
+
+    def __init__(self, spec: TensorSpec, owner_layer, owner_idx: int, model,
+                 name: str = "", initializer=None):
+        self.spec = spec
+        self.owner_layer = owner_layer  # Layer or None for graph inputs
+        self.owner_idx = owner_idx
+        self.model = model
+        self.name = name
+        self.initializer = initializer
+
+    # -- reference Tensor API parity (dims are reported outermost-first like
+    # numpy; the reference reports innermost-first C layout) ---------------
+    @property
+    def dims(self) -> Tuple[int, ...]:
+        return self.spec.shape
+
+    @property
+    def ndim(self) -> int:
+        return self.spec.ndim
+
+    @property
+    def dtype(self) -> DataType:
+        return self.spec.dtype
+
+    def __repr__(self):
+        who = self.owner_layer.name if self.owner_layer else "input"
+        return f"Tensor({self.spec.shape}, {self.spec.dtype.value}, from={who})"
+
+    # -- operator sugar (parity with flexflow_cffi Tensor arithmetic) ------
+    def __add__(self, other):
+        return self.model.add(self, other)
+
+    def __sub__(self, other):
+        return self.model.subtract(self, other)
+
+    def __mul__(self, other):
+        return self.model.multiply(self, other)
+
+    def __truediv__(self, other):
+        return self.model.divide(self, other)
+
+
+def specs_of(tensors: Sequence[Tensor]) -> Tuple[TensorSpec, ...]:
+    return tuple(t.spec for t in tensors)

@@ -1,0 +1,208 @@
+"""Core enums and type utilities.
+
+TPU-native re-design of the reference's constant/type layer
+(reference: include/flexflow/ffconst.h, src/runtime/fftype.cc).  We keep the
+same *semantic* vocabulary (activation modes, aggregation modes, loss/metrics
+types, inference modes) but map data types onto JAX dtypes instead of the
+reference's cuDNN descriptors.
+"""
+
+from __future__ import annotations
+
+import enum
+
+import jax.numpy as jnp
+import numpy as np
+
+
+class DataType(enum.Enum):
+    """Tensor element types (reference: ffconst.h DT_* values)."""
+
+    BOOL = "bool"
+    INT32 = "int32"
+    INT64 = "int64"
+    HALF = "float16"
+    BFLOAT16 = "bfloat16"
+    FLOAT = "float32"
+    DOUBLE = "float64"
+    INT4 = "int4"
+    INT8 = "int8"
+    NONE = "none"
+
+    def to_jnp(self):
+        if self is DataType.NONE:
+            raise ValueError("DT_NONE has no jnp dtype")
+        if self is DataType.INT4:
+            return jnp.int4
+        return jnp.dtype(self.value)
+
+    @property
+    def size_bytes(self) -> float:
+        if self is DataType.INT4:
+            return 0.5
+        return np.dtype(self.value).itemsize
+
+    @staticmethod
+    def from_jnp(dtype) -> "DataType":
+        name = jnp.dtype(dtype).name
+        for dt in DataType:
+            if dt.value == name:
+                return dt
+        raise ValueError(f"unsupported dtype {dtype}")
+
+
+class ActiMode(enum.Enum):
+    """Fused-activation modes (reference: ffconst.h AC_MODE_*)."""
+
+    NONE = "none"
+    RELU = "relu"
+    SIGMOID = "sigmoid"
+    TANH = "tanh"
+    GELU = "gelu"
+
+
+class AggrMode(enum.Enum):
+    """Embedding aggregation (reference: ffconst.h AGGR_MODE_*)."""
+
+    NONE = "none"
+    SUM = "sum"
+    AVG = "avg"
+
+
+class PoolType(enum.Enum):
+    MAX = "max"
+    AVG = "avg"
+
+
+class LossType(enum.Enum):
+    """Loss functions (reference: ffconst.h:41-47)."""
+
+    CATEGORICAL_CROSSENTROPY = "categorical_crossentropy"
+    SPARSE_CATEGORICAL_CROSSENTROPY = "sparse_categorical_crossentropy"
+    MEAN_SQUARED_ERROR_AVG_REDUCE = "mean_squared_error_avg_reduce"
+    MEAN_SQUARED_ERROR_SUM_REDUCE = "mean_squared_error_sum_reduce"
+    IDENTITY = "identity"
+
+
+class MetricsType(enum.Enum):
+    """Metrics (reference: ffconst.h:60-68)."""
+
+    ACCURACY = "accuracy"
+    CATEGORICAL_CROSSENTROPY = "categorical_crossentropy"
+    SPARSE_CATEGORICAL_CROSSENTROPY = "sparse_categorical_crossentropy"
+    MEAN_SQUARED_ERROR = "mean_squared_error"
+    ROOT_MEAN_SQUARED_ERROR = "root_mean_squared_error"
+    MEAN_ABSOLUTE_ERROR = "mean_absolute_error"
+
+
+class InferenceMode(enum.Enum):
+    """Serving mode per model (reference: ffconst.h INC_DECODING_MODE etc.)."""
+
+    INC_DECODING = "inc_decoding"
+    BEAM_SEARCH = "beam_search"
+    TREE_VERIFY = "tree_verify"
+
+
+class ParameterSyncType(enum.Enum):
+    """Gradient sync strategy (reference: ffconst.h ParameterSyncType)."""
+
+    NONE = "none"
+    PS = "ps"
+    NCCL = "allreduce"  # the reference's NCCL path == our ICI allreduce path
+
+
+class OpType(enum.Enum):
+    """Operator vocabulary (reference: ffconst.h OperatorType OP_*).
+
+    One entry per operator the reference supports; serving ops included.
+    """
+
+    INPUT = "input"
+    WEIGHT = "weight"
+    NOOP = "noop"
+    LINEAR = "linear"
+    CONV2D = "conv2d"
+    POOL2D = "pool2d"
+    BATCHNORM = "batchnorm"
+    BATCH_MATMUL = "batch_matmul"
+    EMBEDDING = "embedding"
+    DROPOUT = "dropout"
+    FLAT = "flat"
+    SOFTMAX = "softmax"
+    CONCAT = "concat"
+    SPLIT = "split"
+    RESHAPE = "reshape"
+    TRANSPOSE = "transpose"
+    REVERSE = "reverse"
+    GATHER = "gather"
+    CAST = "cast"
+    REDUCE_SUM = "reduce_sum"
+    MEAN = "mean"
+    EW_ADD = "ew_add"
+    EW_SUB = "ew_sub"
+    EW_MUL = "ew_mul"
+    EW_DIV = "ew_div"
+    EW_MAX = "ew_max"
+    EW_MIN = "ew_min"
+    EW_POW = "ew_pow"
+    SCALAR_ADD = "scalar_add"
+    SCALAR_SUB = "scalar_sub"
+    SCALAR_MUL = "scalar_mul"
+    SCALAR_TRUE_DIV = "scalar_true_div"
+    RELU = "relu"
+    SIGMOID = "sigmoid"
+    TANH = "tanh"
+    ELU = "elu"
+    GELU = "gelu"
+    IDENTITY = "identity"
+    RSQRT = "rsqrt"
+    POW = "pow"
+    EXP = "exp"
+    SIN = "sin"
+    COS = "cos"
+    MULTIHEAD_ATTENTION = "multihead_attention"
+    INC_MULTIHEAD_SELF_ATTENTION = "inc_multihead_self_attention"
+    SPEC_INC_MULTIHEAD_SELF_ATTENTION = "spec_inc_multihead_self_attention"
+    TREE_INC_MULTIHEAD_SELF_ATTENTION = "tree_inc_multihead_self_attention"
+    LAYERNORM = "layernorm"
+    RESIDUAL_LAYERNORM = "residual_layernorm"
+    ADD_BIAS_RESIDUAL_LAYERNORM = "add_bias_residual_layernorm"
+    RMS_NORM = "rms_norm"
+    RESIDUAL_RMS_NORM = "residual_rms_norm"
+    SIGMOID_SILU_MULTI = "sigmoid_silu_multi"
+    ARG_MAX = "arg_max"
+    ARG_TOPK = "arg_topk"
+    BEAM_TOPK = "beam_topk"
+    SAMPLING = "sampling"
+    TOPK = "topk"
+    GROUP_BY = "group_by"
+    AGGREGATE = "aggregate"
+    AGG_SPEC = "agg_spec"
+    EXPERTS = "experts"
+    CACHE = "cache"
+    FUSED = "fused"
+    # parallel ops (first-class parallelism IR, reference src/parallel_ops/)
+    REPARTITION = "repartition"
+    COMBINE = "combine"
+    REPLICATE = "replicate"
+    REDUCTION = "reduction"
+    ALLREDUCE = "allreduce"
+    FUSED_PARALLEL = "fused_parallel"
+
+
+# Activation helpers -------------------------------------------------------
+
+def apply_activation(x, act: ActiMode):
+    import jax.nn as jnn
+
+    if act is ActiMode.NONE:
+        return x
+    if act is ActiMode.RELU:
+        return jnn.relu(x)
+    if act is ActiMode.SIGMOID:
+        return jnn.sigmoid(x)
+    if act is ActiMode.TANH:
+        return jnp.tanh(x)
+    if act is ActiMode.GELU:
+        return jnn.gelu(x)
+    raise ValueError(f"unknown activation {act}")

@@ -1,0 +1,123 @@
+"""Attention operators (training path).
+
+TPU-native equivalent of the reference's classic multi-head attention for
+training (src/ops/attention.cc — cuDNN cudnnMultiHeadAttnForward).  The
+serving attention family (IncMultiHeadSelfAttention / Spec / Tree variants,
+src/ops/inc_multihead_self_attention.cu etc.) lives in
+``flexflow_tpu.ops.serving_attention`` because it is driven by BatchConfig.
+
+The computation is the standard q@k^T softmax v expressed as einsums so XLA
+tiles it onto the MXU; flash-style Pallas kernels slot in underneath for long
+sequences (see flexflow_tpu/kernels/).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.initializers import DEFAULT_WEIGHT_INIT
+from ..core.tensor import TensorSpec
+from ..fftype import OpType
+from .registry import OpDef, ParamSpec, register
+
+
+def mha_attention(q, k, v, *, causal=False, mask=None, scale=None,
+                  dropout_rate=0.0, dropout_rng=None):
+    """Core attention: q,k,v [B, H, S, D] -> [B, H, Sq, D].
+
+    ``dropout_rate`` applies to the attention probabilities (matching the
+    reference's cuDNN attnDropout, src/ops/attention.cc)."""
+    d = q.shape[-1]
+    scale = scale if scale is not None else 1.0 / np.sqrt(d)
+    logits = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                        preferred_element_type=jnp.float32) * scale
+    if causal:
+        sq, sk = logits.shape[-2], logits.shape[-1]
+        cmask = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
+        logits = jnp.where(cmask, logits, -jnp.inf)
+    if mask is not None:
+        logits = jnp.where(mask, logits, -jnp.inf)
+    probs = jax.nn.softmax(logits, axis=-1)
+    if dropout_rate > 0.0 and dropout_rng is not None:
+        keep = jax.random.bernoulli(dropout_rng, 1.0 - dropout_rate,
+                                    probs.shape)
+        probs = jnp.where(keep, probs / (1.0 - dropout_rate), 0.0)
+    out = jnp.einsum("bhqk,bhkd->bhqd", probs.astype(v.dtype), v,
+                     preferred_element_type=jnp.float32)
+    return out.astype(v.dtype)
+
+
+@register
+class MultiHeadAttention(OpDef):
+    """Training multi-head attention over (query, key, value) inputs
+    (reference: src/ops/attention.cc; API model.h multihead_attention)."""
+
+    type = OpType.MULTIHEAD_ATTENTION
+
+    def infer(self, attrs, in_specs):
+        q, k, v = in_specs
+        return [TensorSpec(q.shape[:-1] + (attrs["embed_dim"],), q.dtype)]
+
+    def params(self, attrs, in_specs):
+        q, k, v = in_specs
+        e = attrs["embed_dim"]
+        h = attrs["num_heads"]
+        kdim = attrs.get("kdim") or e
+        vdim = attrs.get("vdim") or e
+        dt = q.dtype
+        init = attrs.get("kernel_initializer") or DEFAULT_WEIGHT_INIT
+        return [
+            ParamSpec("wq", (q.shape[-1], h, kdim // h), dt, init,
+                      fans=(q.shape[-1], kdim)),
+            ParamSpec("wk", (k.shape[-1], h, kdim // h), dt, init,
+                      fans=(k.shape[-1], kdim)),
+            ParamSpec("wv", (v.shape[-1], h, vdim // h), dt, init,
+                      fans=(v.shape[-1], vdim)),
+            ParamSpec("wo", (h, vdim // h, e), dt, init, fans=(vdim, e)),
+        ]
+
+    def forward(self, params, inputs, attrs, ctx):
+        xq, xk, xv = inputs  # [B, S, E]
+        q = jnp.einsum("bse,ehd->bhsd", xq, params["wq"].astype(xq.dtype))
+        k = jnp.einsum("bse,ehd->bhsd", xk, params["wk"].astype(xk.dtype))
+        v = jnp.einsum("bse,ehd->bhsd", xv, params["wv"].astype(xv.dtype))
+        rate = attrs.get("dropout", 0.0)
+        drop_rng = None
+        if ctx.training and rate > 0.0:
+            assert ctx.rng is not None, "attention dropout needs ctx.rng"
+            drop_rng = jax.random.fold_in(ctx.rng, attrs["seed_offset"])
+        out = mha_attention(q, k, v, causal=attrs.get("causal", False),
+                            dropout_rate=rate if ctx.training else 0.0,
+                            dropout_rng=drop_rng)
+        y = jnp.einsum("bhsd,hde->bse", out, params["wo"].astype(out.dtype))
+        return [y]
+
+    def flops(self, attrs, in_specs):
+        q = in_specs[0]
+        b, s, e = q.shape
+        h = attrs["num_heads"]
+        return 2 * b * s * e * e * 4 + 4 * b * h * s * s * (e // h)
+
+
+def apply_rotary_embedding(x, positions, theta: float = 10000.0):
+    """HF-convention RoPE applied to [..., S, D] given integer positions
+    [..., S] (reference: apply_rotary_embedding_hf,
+    inc_multihead_self_attention.cu:449 — applied in-kernel during qk
+    projection; here it is a fused elementwise stage XLA folds into the
+    surrounding einsums).
+
+    Uses the HF pairing (first half / second half split), matching
+    transformers' LLaMA implementation so HF checkpoints decode identically.
+    """
+    d = x.shape[-1]
+    half = d // 2
+    freqs = theta ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
+    angles = positions[..., None].astype(jnp.float32) * freqs  # [..., S, half]
+    cos = jnp.cos(angles)
+    sin = jnp.sin(angles)
+    x1, x2 = x[..., :half], x[..., half:]
+    rx1 = x1 * cos - x2 * sin
+    rx2 = x2 * cos + x1 * sin
+    return jnp.concatenate([rx1, rx2], axis=-1).astype(x.dtype)

@@ -1,0 +1,168 @@
+"""Convolution / pooling / batch-norm operators.
+
+TPU-native equivalents of the reference's cuDNN-backed vision ops
+(src/ops/conv_2d.cc, pool_2d.cc, batch_norm.cc).  Logical layout is NCHW for
+API parity with the reference examples (AlexNet/ResNet, examples/cpp); XLA's
+layout assignment re-tiles for the MXU internally, so no manual NHWC
+conversion is needed.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.initializers import DEFAULT_BIAS_INIT, DEFAULT_WEIGHT_INIT, ZeroInitializer, ConstantInitializer
+from ..core.tensor import TensorSpec
+from ..fftype import ActiMode, DataType, OpType, PoolType, apply_activation
+from .registry import OpContext, OpDef, ParamSpec, register
+
+
+def _conv_out(size, kernel, stride, pad):
+    return (size + 2 * pad - kernel) // stride + 1
+
+
+@register
+class Conv2D(OpDef):
+    """reference: src/ops/conv_2d.cc (cuDNN convolution + fused bias/act)."""
+
+    type = OpType.CONV2D
+
+    def infer(self, attrs, in_specs):
+        (x,) = in_specs  # [N, C, H, W]
+        n, c, h, w = x.shape
+        oh = _conv_out(h, attrs["kernel_h"], attrs["stride_h"], attrs["padding_h"])
+        ow = _conv_out(w, attrs["kernel_w"], attrs["stride_w"], attrs["padding_w"])
+        return [TensorSpec((n, attrs["out_channels"], oh, ow), x.dtype)]
+
+    def params(self, attrs, in_specs):
+        (x,) = in_specs
+        c = x.shape[1]
+        groups = attrs.get("groups", 1)
+        ps = [ParamSpec(
+            "kernel",
+            (attrs["out_channels"], c // groups, attrs["kernel_h"], attrs["kernel_w"]),
+            x.dtype, attrs.get("kernel_initializer") or DEFAULT_WEIGHT_INIT)]
+        if attrs.get("use_bias", True):
+            ps.append(ParamSpec("bias", (attrs["out_channels"],), x.dtype,
+                                attrs.get("bias_initializer") or DEFAULT_BIAS_INIT))
+        return ps
+
+    def forward(self, params, inputs, attrs, ctx):
+        (x,) = inputs
+        y = jax.lax.conv_general_dilated(
+            x, params["kernel"].astype(x.dtype),
+            window_strides=(attrs["stride_h"], attrs["stride_w"]),
+            padding=[(attrs["padding_h"], attrs["padding_h"]),
+                     (attrs["padding_w"], attrs["padding_w"])],
+            dimension_numbers=("NCHW", "OIHW", "NCHW"),
+            feature_group_count=attrs.get("groups", 1),
+            preferred_element_type=jnp.float32,
+        ).astype(x.dtype)
+        if attrs.get("use_bias", True):
+            y = y + params["bias"].astype(y.dtype)[None, :, None, None]
+        return [apply_activation(y, attrs.get("activation", ActiMode.NONE))]
+
+    def flops(self, attrs, in_specs):
+        out = self.infer(attrs, in_specs)[0]
+        c_in = in_specs[0].shape[1]
+        return (2 * int(np.prod(out.shape)) * c_in
+                * attrs["kernel_h"] * attrs["kernel_w"]
+                // attrs.get("groups", 1))
+
+
+@register
+class Pool2D(OpDef):
+    """reference: src/ops/pool_2d.cc (cuDNN pooling)."""
+
+    type = OpType.POOL2D
+
+    def infer(self, attrs, in_specs):
+        (x,) = in_specs
+        n, c, h, w = x.shape
+        oh = _conv_out(h, attrs["kernel_h"], attrs["stride_h"], attrs["padding_h"])
+        ow = _conv_out(w, attrs["kernel_w"], attrs["stride_w"], attrs["padding_w"])
+        return [TensorSpec((n, c, oh, ow), x.dtype)]
+
+    def forward(self, params, inputs, attrs, ctx):
+        (x,) = inputs
+        pool_type = attrs.get("pool_type", PoolType.MAX)
+        window = (1, 1, attrs["kernel_h"], attrs["kernel_w"])
+        strides = (1, 1, attrs["stride_h"], attrs["stride_w"])
+        padding = [(0, 0), (0, 0),
+                   (attrs["padding_h"], attrs["padding_h"]),
+                   (attrs["padding_w"], attrs["padding_w"])]
+        if pool_type is PoolType.MAX:
+            y = jax.lax.reduce_window(x, -jnp.inf, jax.lax.max, window, strides, padding)
+        else:
+            summed = jax.lax.reduce_window(x, 0.0, jax.lax.add, window, strides, padding)
+            counts = jax.lax.reduce_window(jnp.ones_like(x), 0.0, jax.lax.add,
+                                           window, strides, padding)
+            y = summed / counts
+        return [apply_activation(y.astype(x.dtype),
+                                 attrs.get("activation", ActiMode.NONE))]
+
+
+@register
+class BatchNorm(OpDef):
+    """reference: src/ops/batch_norm.cc (cuDNN BN, stored running stats).
+
+    Running stats live as non-trainable state params updated functionally in
+    training mode (the reference mutates them in the fwd task).
+    """
+
+    type = OpType.BATCHNORM
+
+    def infer(self, attrs, in_specs):
+        return [in_specs[0]]
+
+    def params(self, attrs, in_specs):
+        (x,) = in_specs
+        c = x.shape[1]
+        return [
+            ParamSpec("scale", (c,), x.dtype, ConstantInitializer(1.0)),
+            ParamSpec("bias", (c,), x.dtype, ZeroInitializer()),
+            ParamSpec("running_mean", (c,), x.dtype, ZeroInitializer()),
+            ParamSpec("running_var", (c,), x.dtype, ConstantInitializer(1.0)),
+        ]
+
+    # running stats are state, not gradient targets
+    NON_TRAINABLE = ("running_mean", "running_var")
+
+    def forward(self, params, inputs, attrs, ctx):
+        (x,) = inputs
+        eps = attrs.get("eps", 1e-5)
+        xf = x.astype(jnp.float32)  # stats in f32 (bf16-safe)
+        if ctx.training:
+            axes = (0, 2, 3)
+            mean = jnp.mean(xf, axis=axes)
+            var = jnp.var(xf, axis=axes)
+        else:
+            mean = params["running_mean"].astype(jnp.float32)
+            var = params["running_var"].astype(jnp.float32)
+        inv = jax.lax.rsqrt(var + eps)
+        bshape = (1, -1, 1, 1)
+        y = (xf - mean.reshape(bshape)) * inv.reshape(bshape)
+        if attrs.get("relu", True):
+            y = jax.nn.relu(y * params["scale"].reshape(bshape)
+                            + params["bias"].reshape(bshape))
+        else:
+            y = y * params["scale"].reshape(bshape) + params["bias"].reshape(bshape)
+        return [y.astype(x.dtype)]
+
+    def new_state(self, params, inputs, attrs, momentum=0.9):
+        """Functional running-stat update; applied by the trainer."""
+        (x,) = inputs
+        axes = (0, 2, 3)
+        xf = x.astype(jnp.float32)
+        mean = jnp.mean(xf, axis=axes)
+        var = jnp.var(xf, axis=axes)
+        rm = params["running_mean"]
+        rv = params["running_var"]
+        return {
+            "running_mean": (momentum * rm.astype(jnp.float32)
+                             + (1 - momentum) * mean).astype(rm.dtype),
+            "running_var": (momentum * rv.astype(jnp.float32)
+                            + (1 - momentum) * var).astype(rv.dtype),
+        }

@@ -1,0 +1,408 @@
+"""Dense/compute and data-movement operators.
+
+TPU-native equivalents of the reference's core op set (src/ops/*.cc + CUDA
+kernels in src/ops/kernels/).  Each op is a pure jnp computation: the cuBLAS
+GEMM in linear_kernels.cu:130 becomes one jnp.einsum the MXU executes; the
+hand-written broadcast logic of element_binary.cu is jnp broadcasting; all
+backward kernels are jax.grad.
+
+Convention: activations are [batch, ..., channels] (row-major outermost
+batch), matching the reference's logical shapes (it stores innermost-first).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.initializers import DEFAULT_BIAS_INIT, DEFAULT_WEIGHT_INIT
+from ..core.tensor import TensorSpec
+from ..fftype import ActiMode, AggrMode, DataType, OpType, apply_activation
+from .registry import OpContext, OpDef, ParamSpec, register, simple_op
+
+
+# --------------------------------------------------------------------- Linear
+@register
+class Linear(OpDef):
+    """Dense layer (reference: src/ops/linear.cc + kernels/linear_kernels.cu).
+
+    weight is stored [in_dim, out_dim] so the forward is a single
+    x @ w einsum that XLA maps onto the MXU; fused activation mirrors the
+    reference's cublasLt epilogue fusion.
+    """
+
+    type = OpType.LINEAR
+
+    def infer(self, attrs, in_specs):
+        (x,) = in_specs
+        out_dim = attrs["out_dim"]
+        dtype = attrs.get("dtype") or x.dtype
+        return [TensorSpec(x.shape[:-1] + (out_dim,), dtype)]
+
+    def params(self, attrs, in_specs):
+        (x,) = in_specs
+        dtype = attrs.get("param_dtype") or attrs.get("dtype") or x.dtype
+        ps = [ParamSpec("kernel", (x.shape[-1], attrs["out_dim"]), dtype,
+                        attrs.get("kernel_initializer") or DEFAULT_WEIGHT_INIT)]
+        if attrs.get("use_bias", True):
+            ps.append(ParamSpec("bias", (attrs["out_dim"],), dtype,
+                                attrs.get("bias_initializer") or DEFAULT_BIAS_INIT))
+        return ps
+
+    def forward(self, params, inputs, attrs, ctx):
+        (x,) = inputs
+        w = params["kernel"]
+        y = jnp.einsum("...i,io->...o", x, w.astype(x.dtype),
+                       preferred_element_type=jnp.float32).astype(x.dtype)
+        if attrs.get("use_bias", True):
+            y = y + params["bias"].astype(y.dtype)
+        return [apply_activation(y, attrs.get("activation", ActiMode.NONE))]
+
+    def flops(self, attrs, in_specs):
+        (x,) = in_specs
+        return 2 * int(np.prod(x.shape)) * attrs["out_dim"]
+
+
+# ----------------------------------------------------------------- Embedding
+@register
+class Embedding(OpDef):
+    """Token embedding (reference: src/ops/embedding.cc).
+
+    Supports the reference's SUM/AVG aggregation over a bag-of-ids axis
+    (embedding.cc aggr modes) in addition to plain lookup.
+    """
+
+    type = OpType.EMBEDDING
+
+    def infer(self, attrs, in_specs):
+        (ids,) = in_specs
+        out_dim = attrs["out_dim"]
+        dtype = attrs.get("dtype", DataType.FLOAT)
+        aggr = attrs.get("aggr", AggrMode.NONE)
+        if aggr is AggrMode.NONE:
+            shape = ids.shape + (out_dim,)
+        else:
+            shape = ids.shape[:-1] + (out_dim,)
+        return [TensorSpec(shape, dtype)]
+
+    def params(self, attrs, in_specs):
+        dtype = attrs.get("dtype", DataType.FLOAT)
+        return [ParamSpec("embedding", (attrs["num_entries"], attrs["out_dim"]),
+                          dtype, attrs.get("kernel_initializer") or DEFAULT_WEIGHT_INIT)]
+
+    def forward(self, params, inputs, attrs, ctx):
+        (ids,) = inputs
+        table = params["embedding"]
+        out = jnp.take(table, ids, axis=0)
+        aggr = attrs.get("aggr", AggrMode.NONE)
+        if aggr is AggrMode.SUM:
+            out = out.sum(axis=-2)
+        elif aggr is AggrMode.AVG:
+            out = out.mean(axis=-2)
+        return [out]
+
+
+# -------------------------------------------------------------- BatchMatmul
+@register
+class BatchMatmul(OpDef):
+    """reference: src/ops/batch_matmul.cc (cublas strided batched gemm)."""
+
+    type = OpType.BATCH_MATMUL
+
+    def infer(self, attrs, in_specs):
+        a, b = in_specs
+        assert a.shape[:-2] == b.shape[:-2], (a.shape, b.shape)
+        assert a.shape[-1] == b.shape[-2]
+        return [TensorSpec(a.shape[:-1] + (b.shape[-1],), a.dtype)]
+
+    def forward(self, params, inputs, attrs, ctx):
+        a, b = inputs
+        return [jnp.matmul(a, b, preferred_element_type=jnp.float32).astype(a.dtype)]
+
+    def flops(self, attrs, in_specs):
+        a, b = in_specs
+        return 2 * int(np.prod(a.shape)) * b.shape[-1]
+
+
+# ------------------------------------------------------------- element-wise
+_BINARY_FNS = {
+    OpType.EW_ADD: jnp.add,
+    OpType.EW_SUB: jnp.subtract,
+    OpType.EW_MUL: jnp.multiply,
+    OpType.EW_DIV: jnp.divide,
+    OpType.EW_MAX: jnp.maximum,
+    OpType.EW_MIN: jnp.minimum,
+    OpType.EW_POW: jnp.power,
+}
+
+
+def _broadcast_infer(attrs, in_specs):
+    a, b = in_specs
+    shape = np.broadcast_shapes(a.shape, b.shape)
+    return [TensorSpec(tuple(shape), a.dtype)]
+
+
+class ElementBinary(OpDef):
+    """reference: src/ops/element_binary.cc (broadcast-aware binary kernels)."""
+
+    def __init__(self, op_type):
+        self.type = op_type
+
+    def infer(self, attrs, in_specs):
+        return _broadcast_infer(attrs, in_specs)
+
+    def forward(self, params, inputs, attrs, ctx):
+        a, b = inputs
+        out = _BINARY_FNS[self.type](a, b)
+        return [apply_activation(out, attrs.get("activation", ActiMode.NONE))]
+
+
+for _t in _BINARY_FNS:
+    register(ElementBinary(_t))
+
+
+_UNARY_FNS = {
+    OpType.RELU: jax.nn.relu,
+    OpType.SIGMOID: jax.nn.sigmoid,
+    OpType.TANH: jnp.tanh,
+    OpType.ELU: jax.nn.elu,
+    OpType.GELU: jax.nn.gelu,
+    OpType.IDENTITY: lambda x: x,
+    OpType.RSQRT: jax.lax.rsqrt,
+    OpType.EXP: jnp.exp,
+    OpType.SIN: jnp.sin,
+    OpType.COS: jnp.cos,
+}
+
+_SCALAR_FNS = {
+    OpType.SCALAR_ADD: lambda x, s: x + s,
+    OpType.SCALAR_SUB: lambda x, s: x - s,
+    OpType.SCALAR_MUL: lambda x, s: x * s,
+    OpType.SCALAR_TRUE_DIV: lambda x, s: x / s,
+    OpType.POW: lambda x, s: jnp.power(x, s),
+}
+
+
+class ElementUnary(OpDef):
+    """reference: src/ops/element_unary.cc (incl. scalar variants, gelu,
+    rsqrt, pow)."""
+
+    def __init__(self, op_type):
+        self.type = op_type
+
+    def infer(self, attrs, in_specs):
+        return [in_specs[0]]
+
+    def forward(self, params, inputs, attrs, ctx):
+        (x,) = inputs
+        if self.type in _SCALAR_FNS:
+            out = _SCALAR_FNS[self.type](x, attrs["scalar"])
+        else:
+            out = _UNARY_FNS[self.type](x)
+        if attrs.get("inplace"):  # parity no-op: XLA decides buffer reuse
+            pass
+        return [out]
+
+
+for _t in list(_UNARY_FNS) + list(_SCALAR_FNS):
+    register(ElementUnary(_t))
+
+
+# ------------------------------------------------------------------ Softmax
+@register
+class Softmax(OpDef):
+    """reference: src/ops/softmax.cc (cuDNN softmax)."""
+
+    type = OpType.SOFTMAX
+
+    def infer(self, attrs, in_specs):
+        return [in_specs[0]]
+
+    def forward(self, params, inputs, attrs, ctx):
+        (x,) = inputs
+        return [jax.nn.softmax(x, axis=attrs.get("axis", -1))]
+
+
+# ------------------------------------------------------------ data movement
+@register
+class Reshape(OpDef):
+    type = OpType.RESHAPE
+
+    def infer(self, attrs, in_specs):
+        (x,) = in_specs
+        shape = tuple(attrs["shape"])
+        if -1 in shape:
+            known = int(np.prod([s for s in shape if s != -1]))
+            shape = tuple(int(np.prod(x.shape)) // known if s == -1 else s
+                          for s in shape)
+        assert np.prod(shape) == np.prod(x.shape), (shape, x.shape)
+        return [TensorSpec(shape, x.dtype)]
+
+    def forward(self, params, inputs, attrs, ctx):
+        out_shape = self.infer(attrs, [TensorSpec(inputs[0].shape,
+                                                  DataType.from_jnp(inputs[0].dtype))])[0].shape
+        return [jnp.reshape(inputs[0], out_shape)]
+
+
+@register
+class Transpose(OpDef):
+    type = OpType.TRANSPOSE
+
+    def infer(self, attrs, in_specs):
+        (x,) = in_specs
+        perm = attrs["perm"]
+        return [TensorSpec(tuple(x.shape[p] for p in perm), x.dtype)]
+
+    def forward(self, params, inputs, attrs, ctx):
+        return [jnp.transpose(inputs[0], attrs["perm"])]
+
+
+@register
+class Concat(OpDef):
+    type = OpType.CONCAT
+
+    def infer(self, attrs, in_specs):
+        axis = attrs["axis"]
+        base = list(in_specs[0].shape)
+        base[axis] = sum(s.shape[axis] for s in in_specs)
+        return [TensorSpec(tuple(base), in_specs[0].dtype)]
+
+    def forward(self, params, inputs, attrs, ctx):
+        return [jnp.concatenate(inputs, axis=attrs["axis"])]
+
+
+@register
+class Split(OpDef):
+    type = OpType.SPLIT
+
+    def infer(self, attrs, in_specs):
+        (x,) = in_specs
+        axis = attrs["axis"]
+        sizes = attrs["sizes"]
+        assert sum(sizes) == x.shape[axis]
+        out = []
+        for s in sizes:
+            shape = list(x.shape)
+            shape[axis] = s
+            out.append(TensorSpec(tuple(shape), x.dtype))
+        return out
+
+    def forward(self, params, inputs, attrs, ctx):
+        splits = np.cumsum(attrs["sizes"])[:-1]
+        return list(jnp.split(inputs[0], splits, axis=attrs["axis"]))
+
+
+@register
+class Flat(OpDef):
+    """reference: src/ops/flat.cc — flatten all non-batch dims."""
+
+    type = OpType.FLAT
+
+    def infer(self, attrs, in_specs):
+        (x,) = in_specs
+        return [TensorSpec((x.shape[0], int(np.prod(x.shape[1:]))), x.dtype)]
+
+    def forward(self, params, inputs, attrs, ctx):
+        (x,) = inputs
+        return [jnp.reshape(x, (x.shape[0], -1))]
+
+
+@register
+class Reverse(OpDef):
+    type = OpType.REVERSE
+
+    def infer(self, attrs, in_specs):
+        return [in_specs[0]]
+
+    def forward(self, params, inputs, attrs, ctx):
+        return [jnp.flip(inputs[0], axis=attrs["axis"])]
+
+
+@register
+class Gather(OpDef):
+    """reference: src/ops/gather.cc — torch.gather semantics along a dim."""
+
+    type = OpType.GATHER
+
+    def infer(self, attrs, in_specs):
+        x, idx = in_specs
+        return [TensorSpec(idx.shape, x.dtype)]
+
+    def forward(self, params, inputs, attrs, ctx):
+        x, idx = inputs
+        return [jnp.take_along_axis(x, idx, axis=attrs["axis"])]
+
+
+@register
+class Cast(OpDef):
+    type = OpType.CAST
+
+    def infer(self, attrs, in_specs):
+        return [TensorSpec(in_specs[0].shape, attrs["dtype"])]
+
+    def forward(self, params, inputs, attrs, ctx):
+        return [inputs[0].astype(attrs["dtype"].to_jnp())]
+
+
+# --------------------------------------------------------------- reductions
+@register
+class ReduceSum(OpDef):
+    type = OpType.REDUCE_SUM
+
+    def infer(self, attrs, in_specs):
+        (x,) = in_specs
+        axes = tuple(a % len(x.shape) for a in attrs["axes"])
+        keepdims = attrs.get("keepdims", False)
+        shape = tuple(
+            (1 if i in axes else s) for i, s in enumerate(x.shape)
+            if keepdims or i not in axes
+        )
+        return [TensorSpec(shape, x.dtype)]
+
+    def forward(self, params, inputs, attrs, ctx):
+        return [jnp.sum(inputs[0], axis=tuple(attrs["axes"]),
+                        keepdims=attrs.get("keepdims", False))]
+
+
+@register
+class Mean(OpDef):
+    type = OpType.MEAN
+
+    def infer(self, attrs, in_specs):
+        return ReduceSum().infer(attrs, in_specs)
+
+    def forward(self, params, inputs, attrs, ctx):
+        return [jnp.mean(inputs[0], axis=tuple(attrs["axes"]),
+                         keepdims=attrs.get("keepdims", False))]
+
+
+# ------------------------------------------------------------------ Dropout
+@register
+class Dropout(OpDef):
+    """reference: src/ops/dropout.cc (cuDNN RNG); here jax.random inside jit."""
+
+    type = OpType.DROPOUT
+
+    def infer(self, attrs, in_specs):
+        return [in_specs[0]]
+
+    def forward(self, params, inputs, attrs, ctx):
+        (x,) = inputs
+        rate = attrs.get("rate", 0.5)
+        if not ctx.training or rate == 0.0:
+            return [x]
+        assert ctx.rng is not None, "dropout needs an rng in training mode"
+        key = jax.random.fold_in(ctx.rng, attrs["seed_offset"])
+        if attrs.get("seed"):
+            key = jax.random.fold_in(key, attrs["seed"])
+        keep = jax.random.bernoulli(key, 1.0 - rate, x.shape)
+        return [jnp.where(keep, x / (1.0 - rate), 0.0).astype(x.dtype)]
+
+
+# -------------------------------------------------------------------- NoOp
+def _identity_infer(attrs, in_specs):
+    return [in_specs[0]]
+
+
+simple_op(OpType.NOOP, _identity_infer, lambda inputs, attrs, ctx: [inputs[0]])

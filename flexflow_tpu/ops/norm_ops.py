@@ -1,0 +1,167 @@
+"""Normalization and fused-residual operators.
+
+TPU-native equivalents of the reference's transformer norm family
+(src/ops/layer_norm.cc, residual_layer_norm.cc, add_bias_residual_layer_norm.cc,
+rms_norm.cc, residual_rms_norm.cc, sigmoid_silu_multi.cc — each a hand-fused
+CUDA kernel).  Here each is a short jnp expression; XLA fuses the
+residual-add + normalize + scale chain into one HBM pass, which is exactly
+what the reference's hand fusion buys.
+
+Stats are computed in float32 regardless of activation dtype (bfloat16-safe).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.initializers import ConstantInitializer, ZeroInitializer
+from ..core.tensor import TensorSpec
+from ..fftype import OpType
+from .registry import OpDef, ParamSpec, register
+
+
+def _ln(x, gamma, beta, eps):
+    xf = x.astype(jnp.float32)
+    mean = xf.mean(axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(xf - mean), axis=-1, keepdims=True)
+    y = (xf - mean) * jax.lax.rsqrt(var + eps)
+    if gamma is not None:
+        y = y * gamma.astype(jnp.float32)
+    if beta is not None:
+        y = y + beta.astype(jnp.float32)
+    return y.astype(x.dtype)
+
+
+def _rms(x, gamma, eps):
+    xf = x.astype(jnp.float32)
+    scale = jax.lax.rsqrt(jnp.mean(jnp.square(xf), axis=-1, keepdims=True) + eps)
+    return (xf * scale * gamma.astype(jnp.float32)).astype(x.dtype)
+
+
+def _norm_params(attrs, in_specs, elementwise_affine=True, rms=False):
+    dim = in_specs[0].shape[-1]
+    dtype = in_specs[0].dtype
+    ps = []
+    if elementwise_affine or rms:
+        ps.append(ParamSpec("weight", (dim,), dtype, ConstantInitializer(1.0)))
+    if elementwise_affine and not rms:
+        ps.append(ParamSpec("bias", (dim,), dtype, ZeroInitializer()))
+    return ps
+
+
+@register
+class LayerNorm(OpDef):
+    """reference: src/ops/layer_norm.cc."""
+
+    type = OpType.LAYERNORM
+
+    def infer(self, attrs, in_specs):
+        return [in_specs[0]]
+
+    def params(self, attrs, in_specs):
+        return _norm_params(attrs, in_specs,
+                            attrs.get("elementwise_affine", True))
+
+    def forward(self, params, inputs, attrs, ctx):
+        (x,) = inputs
+        gamma = params.get("weight")
+        beta = params.get("bias")
+        return [_ln(x, gamma, beta, attrs.get("eps", 1e-5))]
+
+
+@register
+class ResidualLayerNorm(OpDef):
+    """reference: src/ops/residual_layer_norm.cc — y = LN(x + r1 [+ r2]);
+    also returns the pre-norm sum (needed by the next residual hop)."""
+
+    type = OpType.RESIDUAL_LAYERNORM
+
+    def infer(self, attrs, in_specs):
+        return [in_specs[0], in_specs[0]]  # (normed, residual_sum)
+
+    def params(self, attrs, in_specs):
+        return _norm_params(attrs, [in_specs[0]],
+                            attrs.get("elementwise_affine", True))
+
+    def forward(self, params, inputs, attrs, ctx):
+        total = inputs[0]
+        for r in inputs[1:]:
+            total = total + r
+        return [_ln(total, params.get("weight"), params.get("bias"),
+                    attrs.get("eps", 1e-5)), total]
+
+
+@register
+class AddBiasResidualLayerNorm(OpDef):
+    """reference: src/ops/add_bias_residual_layer_norm.cc — fold the
+    preceding projection's bias into the residual-add, then LN."""
+
+    type = OpType.ADD_BIAS_RESIDUAL_LAYERNORM
+
+    def infer(self, attrs, in_specs):
+        return [in_specs[0], in_specs[0]]
+
+    def params(self, attrs, in_specs):
+        dim = in_specs[0].shape[-1]
+        dtype = in_specs[0].dtype
+        return ([ParamSpec("attn_bias", (dim,), dtype, ZeroInitializer())]
+                + _norm_params(attrs, [in_specs[0]],
+                               attrs.get("elementwise_affine", True)))
+
+    def forward(self, params, inputs, attrs, ctx):
+        x, residual = inputs
+        total = x + params["attn_bias"].astype(x.dtype) + residual
+        return [_ln(total, params.get("weight"), params.get("bias"),
+                    attrs.get("eps", 1e-5)), total]
+
+
+@register
+class RMSNorm(OpDef):
+    """reference: src/ops/rms_norm.cc (LLaMA-style)."""
+
+    type = OpType.RMS_NORM
+
+    def infer(self, attrs, in_specs):
+        return [in_specs[0]]
+
+    def params(self, attrs, in_specs):
+        return _norm_params(attrs, in_specs, rms=True)
+
+    def forward(self, params, inputs, attrs, ctx):
+        (x,) = inputs
+        return [_rms(x, params["weight"], attrs.get("eps", 1e-6))]
+
+
+@register
+class ResidualRMSNorm(OpDef):
+    """reference: src/ops/residual_rms_norm.cc — y = RMS(x + r); returns
+    (normed, sum)."""
+
+    type = OpType.RESIDUAL_RMS_NORM
+
+    def infer(self, attrs, in_specs):
+        return [in_specs[0], in_specs[0]]
+
+    def params(self, attrs, in_specs):
+        return _norm_params(attrs, [in_specs[0]], rms=True)
+
+    def forward(self, params, inputs, attrs, ctx):
+        x, residual = inputs
+        total = x + residual
+        return [_rms(total, params["weight"], attrs.get("eps", 1e-6)), total]
+
+
+@register
+class SigmoidSiluMulti(OpDef):
+    """Fused SwiGLU gate: silu(x1) * x2
+    (reference: src/ops/sigmoid_silu_multi.cc)."""
+
+    type = OpType.SIGMOID_SILU_MULTI
+
+    def infer(self, attrs, in_specs):
+        return [in_specs[0]]
+
+    def forward(self, params, inputs, attrs, ctx):
+        x1, x2 = inputs
+        return [jax.nn.silu(x1) * x2]

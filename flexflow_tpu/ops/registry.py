@@ -1,0 +1,112 @@
+"""Operator registry.
+
+TPU-native re-design of the reference's operator layer (include/flexflow/
+operator.h:75 `class Op` with virtual init/forward/backward/inference).  On
+TPU there is no per-op task launch: every op is a *pure function* that XLA
+traces and fuses, so an operator definition reduces to three pieces:
+
+- ``infer``:   shape/dtype inference at graph-build time (the reference does
+               this inside each op's constructor, e.g. linear.cc shape calc);
+- ``params``:  declarative parameter specs (the reference creates weight
+               ParallelTensors per op);
+- ``forward``: the pure computation. ``backward`` is jax.grad — the
+               reference's hand-written backward kernels collapse away.
+
+Ops with serving behaviour additionally implement ``inference`` taking a
+BatchConfig (mirroring Op::inference, operator.h).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+from ..core.tensor import TensorSpec
+from ..fftype import DataType, OpType
+
+
+@dataclasses.dataclass
+class ParamSpec:
+    """Declarative weight spec (plays the role of the reference's per-op
+    weight ParallelTensor creation)."""
+
+    name: str
+    shape: Tuple[int, ...]
+    dtype: DataType
+    initializer: Any = None  # Initializer or None -> op default
+    fans: Any = None  # optional (fan_in, fan_out) for fan-based initializers
+
+
+@dataclasses.dataclass
+class OpContext:
+    """Per-call execution context threaded through op forward functions.
+
+    Replaces the reference's OpMeta/FFHandler plumbing (op_meta.h,
+    config.h:68-85): no cuDNN handles needed, but training mode, PRNG for
+    dropout, and the serving BatchConfig ride here.
+    """
+
+    training: bool = False
+    rng: Any = None
+    batch_config: Any = None  # serving: BatchConfig family
+    kv_cache: Any = None      # serving: per-layer KV cache pytree (read)
+    kv_cache_out: Dict = None  # serving: updated caches collected here
+    mesh: Any = None
+    extra_outputs: Dict = None  # side outputs (e.g. beam parent ids)
+    state_updates: Dict = None  # non-trainable state written by ops (BN stats)
+
+
+class OpDef:
+    """Base operator definition."""
+
+    type: OpType = None
+
+    def infer(self, attrs: dict, in_specs: Sequence[TensorSpec]) -> List[TensorSpec]:
+        raise NotImplementedError
+
+    def params(self, attrs: dict, in_specs: Sequence[TensorSpec]) -> List[ParamSpec]:
+        return []
+
+    def forward(self, params: dict, inputs: Sequence, attrs: dict, ctx: OpContext):
+        raise NotImplementedError
+
+    # serving path; default: same as forward
+    def inference(self, params, inputs, attrs, ctx: OpContext):
+        return self.forward(params, inputs, attrs, ctx)
+
+    def flops(self, attrs: dict, in_specs: Sequence[TensorSpec]) -> int:
+        """Analytic FLOP estimate used by the auto-parallelization cost model
+        (stands in for Simulator::measure_operator_cost before real timing,
+        simulator.cc:519)."""
+        return 0
+
+
+_REGISTRY: Dict[OpType, OpDef] = {}
+
+
+def register(op) -> OpDef:
+    """Register an OpDef instance (or class — instantiated on the spot, so
+    ``@register`` works as a class decorator)."""
+    inst = op() if isinstance(op, type) else op
+    assert inst.type is not None
+    _REGISTRY[inst.type] = inst
+    return op
+
+
+def get_op(op_type: OpType) -> OpDef:
+    return _REGISTRY[op_type]
+
+
+def simple_op(op_type: OpType, infer_fn: Callable, fwd_fn: Callable):
+    """Helper for parameterless ops."""
+
+    class _Simple(OpDef):
+        type = op_type
+
+        def infer(self, attrs, in_specs):
+            return infer_fn(attrs, in_specs)
+
+        def forward(self, params, inputs, attrs, ctx):
+            return fwd_fn(inputs, attrs, ctx)
+
+    return register(_Simple())

@@ -1,0 +1,142 @@
+"""Sampling-head operators.
+
+TPU-native equivalents of the reference's serving heads: ArgMax
+(src/ops/argmax.cc — greedy + beam variants), ArgTopK (src/ops/arg_topk.cc),
+BeamTopK (src/ops/beam_topk.cc), Sampling (src/ops/sampling.cc — top-p via
+cub radix sort + prefix sum), TopK (src/ops/topk.cc).
+
+On TPU, sort/top_k are single XLA ops; top-p sampling is a sort + cumulative
+sum + masked categorical draw, fully inside jit (the reference needs a
+multi-kernel cub pipeline for the same thing).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import TensorSpec
+from ..fftype import DataType, OpType
+from .registry import OpDef, register
+
+
+@register
+class ArgMax(OpDef):
+    """Greedy token selection (reference: src/ops/argmax.cc).  The beam
+    variant also returns the parent slot id and log-prob of the winner."""
+
+    type = OpType.ARG_MAX
+
+    def infer(self, attrs, in_specs):
+        (x,) = in_specs
+        out = [TensorSpec(x.shape[:-1], DataType.INT32)]
+        if attrs.get("beam_search", False):
+            out.append(TensorSpec(x.shape[:-1], DataType.FLOAT))  # log-probs
+        return out
+
+    def forward(self, params, inputs, attrs, ctx):
+        (x,) = inputs
+        idx = jnp.argmax(x, axis=-1).astype(jnp.int32)
+        if attrs.get("beam_search", False):
+            logp = jax.nn.log_softmax(x.astype(jnp.float32), axis=-1)
+            return [idx, jnp.take_along_axis(logp, idx[..., None], axis=-1)[..., 0]]
+        return [idx]
+
+
+@register
+class ArgTopK(OpDef):
+    """reference: src/ops/arg_topk.cc — indices (and optionally probs) of the
+    top-k logits; used to propose speculative branches."""
+
+    type = OpType.ARG_TOPK
+
+    def infer(self, attrs, in_specs):
+        (x,) = in_specs
+        k = attrs["k"]
+        out = [TensorSpec(x.shape[:-1] + (k,), DataType.INT32)]
+        if attrs.get("speculative_decoding", False):
+            out.append(TensorSpec(x.shape[:-1] + (k,), DataType.FLOAT))
+        return out
+
+    def forward(self, params, inputs, attrs, ctx):
+        (x,) = inputs
+        vals, idx = jax.lax.top_k(x, attrs["k"])
+        idx = idx.astype(jnp.int32)
+        if attrs.get("speculative_decoding", False):
+            logp = jax.nn.log_softmax(vals.astype(jnp.float32), axis=-1)
+            return [idx, logp]
+        return [idx]
+
+
+@register
+class TopK(OpDef):
+    """reference: src/ops/topk.cc — returns (values, indices)."""
+
+    type = OpType.TOPK
+
+    def infer(self, attrs, in_specs):
+        (x,) = in_specs
+        k = attrs["k"]
+        return [TensorSpec(x.shape[:-1] + (k,), x.dtype),
+                TensorSpec(x.shape[:-1] + (k,), DataType.INT32)]
+
+    def forward(self, params, inputs, attrs, ctx):
+        vals, idx = jax.lax.top_k(inputs[0], attrs["k"])
+        return [vals, idx.astype(jnp.int32)]
+
+
+@register
+class BeamTopK(OpDef):
+    """reference: src/ops/beam_topk.cc — per-request top-k over the joint
+    (beam slot x vocab) distribution, emitting token ids, parent beam slots
+    and cumulative log-probs for BeamSearchBatchConfig."""
+
+    type = OpType.BEAM_TOPK
+
+    def infer(self, attrs, in_specs):
+        (x,) = in_specs
+        k = attrs["max_beam_width"]
+        return [TensorSpec(x.shape[:-1] + (k,), DataType.INT32),   # token ids
+                TensorSpec(x.shape[:-1] + (k,), DataType.INT32),   # parent ids
+                TensorSpec(x.shape[:-1] + (k,), DataType.FLOAT)]   # log-probs
+
+    def forward(self, params, inputs, attrs, ctx):
+        (x,) = inputs  # [..., vocab] logits
+        k = attrs["max_beam_width"]
+        logp = jax.nn.log_softmax(x.astype(jnp.float32), axis=-1)
+        vals, idx = jax.lax.top_k(logp, k)
+        parents = jnp.zeros(idx.shape, jnp.int32)  # parent = own slot; RM remaps
+        return [idx.astype(jnp.int32), parents, vals]
+
+
+@register
+class Sampling(OpDef):
+    """Top-p (nucleus) sampling (reference: src/ops/sampling.cc).
+
+    Sort-descending + cumsum + renormalised categorical, all in one jitted
+    graph.  Matches the reference semantics: keep the smallest prefix with
+    cumulative prob >= top_p (always keeping the first token).
+    """
+
+    type = OpType.SAMPLING
+
+    def infer(self, attrs, in_specs):
+        (x,) = in_specs
+        return [TensorSpec(x.shape[:-1], DataType.INT32)]
+
+    def forward(self, params, inputs, attrs, ctx):
+        (x,) = inputs
+        top_p = attrs.get("top_p", 1.0)
+        probs = jax.nn.softmax(x.astype(jnp.float32), axis=-1)
+        sort_idx = jnp.argsort(-probs, axis=-1)
+        sorted_p = jnp.take_along_axis(probs, sort_idx, axis=-1)
+        csum = jnp.cumsum(sorted_p, axis=-1)
+        # keep tokens whose *preceding* mass < top_p (first token always kept)
+        keep = (csum - sorted_p) < top_p
+        masked = jnp.where(keep, sorted_p, 0.0)
+        masked = masked / masked.sum(axis=-1, keepdims=True)
+        assert ctx.rng is not None, "Sampling op needs ctx.rng"
+        key = jax.random.fold_in(ctx.rng, attrs.get("seed_offset", 0))
+        draw = jax.random.categorical(key, jnp.log(masked + 1e-20), axis=-1)
+        out = jnp.take_along_axis(sort_idx, draw[..., None], axis=-1)[..., 0]
+        return [out.astype(jnp.int32)]

@@ -1,0 +1,90 @@
+"""Data loading.
+
+TPU-native equivalent of the reference's SingleDataLoader
+(src/dataloader/dataloader.cc: whole numpy dataset staged once into zero-copy
+CPU memory, then per-iteration index-launched GPU copies into batch shards).
+
+On TPU the analogue is: keep the full dataset in host RAM, and per iteration
+`jax.device_put` the batch with the batch-axis NamedSharding so each chip
+receives only its shard (GSPMD-sliced host->HBM transfer, overlapping with
+compute via async dispatch).  Shuffled epochs use a host-side permutation,
+mirroring the reference's index-array variant (dataloader.cc:146).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec
+
+
+class SingleDataLoader:
+    """Full-dataset host staging + per-batch sharded device transfer."""
+
+    def __init__(self, data: np.ndarray, batch_size: int,
+                 mesh: Optional[jax.sharding.Mesh] = None,
+                 batch_axis: Optional[str] = "dp", shuffle: bool = False,
+                 seed: int = 0):
+        self.data = np.asarray(data)
+        self.batch_size = batch_size
+        self.num_samples = self.data.shape[0]
+        self.num_batches = self.num_samples // batch_size
+        self.mesh = mesh
+        self.sharding = None
+        if mesh is not None and batch_axis in mesh.axis_names:
+            spec = PartitionSpec(batch_axis, *([None] * (self.data.ndim - 1)))
+            self.sharding = NamedSharding(mesh, spec)
+        self.shuffle = shuffle
+        self._rng = np.random.default_rng(seed)
+        self._perm = np.arange(self.num_samples)
+        self._idx = 0
+
+    def reset(self):
+        self._idx = 0
+        if self.shuffle:
+            self._rng.shuffle(self._perm)
+
+    def next_batch(self) -> jax.Array:
+        """reference: SingleDataLoader::next_batch (dataloader.cc:208)."""
+        if self._idx + self.batch_size > self.num_samples:
+            self.reset()
+        sel = self._perm[self._idx: self._idx + self.batch_size]
+        self._idx += self.batch_size
+        host = self.data[sel]
+        if self.sharding is not None:
+            return jax.device_put(host, self.sharding)
+        return jax.device_put(host)
+
+
+class DataLoaderGroup:
+    """Convenience bundle of aligned loaders (inputs + labels) sharing one
+    shuffle order, as the reference's create_data_loader wires per-tensor
+    loaders off one dataset (flexflow_cffi.py:3671)."""
+
+    def __init__(self, arrays: Sequence[np.ndarray], batch_size: int,
+                 mesh=None, batch_axis="dp", shuffle=False, seed=0):
+        n = arrays[0].shape[0]
+        for a in arrays:
+            assert a.shape[0] == n, "all arrays must share the sample dim"
+        self.loaders = [
+            SingleDataLoader(a, batch_size, mesh, batch_axis, shuffle=False, seed=seed)
+            for a in arrays
+        ]
+        self.batch_size = batch_size
+        self.num_batches = n // batch_size
+        self.shuffle = shuffle
+        self._rng = np.random.default_rng(seed)
+
+    def reset(self):
+        perm = None
+        if self.shuffle:
+            perm = self._rng.permutation(self.loaders[0].num_samples)
+        for ld in self.loaders:
+            ld._idx = 0
+            if perm is not None:
+                ld._perm = perm
+
+    def next_batch(self) -> Tuple[jax.Array, ...]:
+        return tuple(ld.next_batch() for ld in self.loaders)

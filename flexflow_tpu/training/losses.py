@@ -1,0 +1,66 @@
+"""Loss functions.
+
+TPU-native equivalent of the reference's loss layer (src/loss_functions/ —
+the LOSS_BWD_TASK computes dLoss/dLogits by hand on device).  Here each loss
+is a scalar-valued pure function and the backward pass is jax.grad, so only
+the forward definitions exist.
+
+Like the reference (model.cc:3377-3378), when the final op is a Softmax we
+compute cross-entropy from its *input* logits via log_softmax for numerical
+stability instead of log(probs).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..fftype import LossType
+
+
+def sparse_categorical_crossentropy(logits, labels, from_logits=True):
+    """labels: int [B]; logits: [B, C] (reference: sparse CE with int32
+    labels, loss_functions.cu)."""
+    if from_logits:
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    else:
+        logp = jnp.log(logits.astype(jnp.float32) + 1e-20)
+    picked = jnp.take_along_axis(logp, labels[..., None].astype(jnp.int32),
+                                 axis=-1)[..., 0]
+    return -picked.mean()
+
+
+def categorical_crossentropy(logits, labels, from_logits=True):
+    """labels: one-hot/probabilities, same shape as logits."""
+    if from_logits:
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    else:
+        logp = jnp.log(logits.astype(jnp.float32) + 1e-20)
+    return -(labels * logp).sum(axis=-1).mean()
+
+
+def mean_squared_error(preds, labels, reduce="avg"):
+    err = jnp.square(preds.astype(jnp.float32) - labels.astype(jnp.float32))
+    per_sample = err.reshape(err.shape[0], -1).sum(axis=-1)
+    if reduce == "avg":
+        return per_sample.mean()
+    return per_sample.sum()
+
+
+def identity_loss(preds, labels=None):
+    """reference: ffconst.h LOSS_IDENTITY — the model output *is* the loss."""
+    return preds.astype(jnp.float32).mean()
+
+
+def compute_loss(loss_type: LossType, outputs, labels, from_logits=True):
+    if loss_type is LossType.SPARSE_CATEGORICAL_CROSSENTROPY:
+        return sparse_categorical_crossentropy(outputs, labels, from_logits)
+    if loss_type is LossType.CATEGORICAL_CROSSENTROPY:
+        return categorical_crossentropy(outputs, labels, from_logits)
+    if loss_type is LossType.MEAN_SQUARED_ERROR_AVG_REDUCE:
+        return mean_squared_error(outputs, labels, "avg")
+    if loss_type is LossType.MEAN_SQUARED_ERROR_SUM_REDUCE:
+        return mean_squared_error(outputs, labels, "sum")
+    if loss_type is LossType.IDENTITY:
+        return identity_loss(outputs, labels)
+    raise ValueError(f"unknown loss {loss_type}")

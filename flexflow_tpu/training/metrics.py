@@ -1,0 +1,84 @@
+"""Training metrics.
+
+TPU-native equivalent of the reference's metrics layer
+(src/metrics_functions/ — METRICS_COMP_TASK per shard + CPU-side PerfMetrics
+future reduction, mapper.cc:282-285).  Under GSPMD the per-shard compute and
+cross-device reduction collapse into one jitted reduction; ``PerfMetrics``
+keeps the reference's accumulator semantics for reporting.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Sequence
+
+import jax.numpy as jnp
+
+from ..fftype import MetricsType
+
+
+@dataclasses.dataclass
+class PerfMetrics:
+    """Running accumulator (reference: include/flexflow/metrics_functions.h
+    PerfMetrics)."""
+
+    train_all: int = 0
+    train_correct: int = 0
+    cce_loss: float = 0.0
+    sparse_cce_loss: float = 0.0
+    mse_loss: float = 0.0
+    rmse_loss: float = 0.0
+    mae_loss: float = 0.0
+
+    def update(self, other: Dict[str, float], count: int):
+        self.train_all += count
+        self.train_correct += int(other.get("correct", 0))
+        self.sparse_cce_loss += float(other.get("sparse_categorical_crossentropy", 0.0)) * count
+        self.cce_loss += float(other.get("categorical_crossentropy", 0.0)) * count
+        self.mse_loss += float(other.get("mean_squared_error", 0.0)) * count
+        self.rmse_loss += float(other.get("root_mean_squared_error", 0.0)) * count
+        self.mae_loss += float(other.get("mean_absolute_error", 0.0)) * count
+
+    @property
+    def accuracy(self) -> float:
+        return 100.0 * self.train_correct / max(self.train_all, 1)
+
+    def report(self) -> str:
+        return (f"accuracy: {self.accuracy:.2f}% ({self.train_correct} / "
+                f"{self.train_all})")
+
+
+def compute_metrics(metrics: Sequence[MetricsType], outputs, labels,
+                    logits=None, from_logits: bool = True):
+    """Per-batch metric values, computed on device inside the train step.
+
+    ``logits``/``from_logits`` let CE metrics use the numerically-right
+    source (pre-softmax logits when the model ends in Softmax)."""
+    out: Dict[str, jnp.ndarray] = {}
+    ce_input = logits if logits is not None else outputs
+    for m in metrics:
+        if m is MetricsType.ACCURACY:
+            if labels.ndim == outputs.ndim:  # one-hot labels
+                lbl = jnp.argmax(labels, axis=-1)
+            else:
+                lbl = labels.astype(jnp.int32)
+            pred = jnp.argmax(outputs, axis=-1).astype(jnp.int32)
+            out["correct"] = (pred == lbl).sum()
+        elif m is MetricsType.SPARSE_CATEGORICAL_CROSSENTROPY:
+            from .losses import sparse_categorical_crossentropy
+            out["sparse_categorical_crossentropy"] = (
+                sparse_categorical_crossentropy(ce_input, labels, from_logits))
+        elif m is MetricsType.CATEGORICAL_CROSSENTROPY:
+            from .losses import categorical_crossentropy
+            out["categorical_crossentropy"] = categorical_crossentropy(
+                ce_input, labels, from_logits)
+        elif m is MetricsType.MEAN_SQUARED_ERROR:
+            out["mean_squared_error"] = jnp.mean(
+                jnp.square(outputs.astype(jnp.float32) - labels.astype(jnp.float32)))
+        elif m is MetricsType.ROOT_MEAN_SQUARED_ERROR:
+            out["root_mean_squared_error"] = jnp.sqrt(jnp.mean(
+                jnp.square(outputs.astype(jnp.float32) - labels.astype(jnp.float32))))
+        elif m is MetricsType.MEAN_ABSOLUTE_ERROR:
+            out["mean_absolute_error"] = jnp.mean(
+                jnp.abs(outputs.astype(jnp.float32) - labels.astype(jnp.float32)))
+    return out

@@ -1,0 +1,187 @@
+"""Per-op numeric tests vs numpy/jax references.
+
+Mirrors the role of the reference's per-op GPU tests (tests/ops/*.cc) and the
+PyTorch alignment suite (tests/align/) — here the oracle is plain numpy/jax.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from flexflow_tpu.fftype import ActiMode, DataType, OpType
+from flexflow_tpu.ops.registry import OpContext, get_op
+from flexflow_tpu.core.tensor import TensorSpec
+
+
+def run_op(op_type, attrs, inputs, params=None, ctx=None):
+    op = get_op(op_type)
+    specs = [TensorSpec(tuple(x.shape), DataType.from_jnp(x.dtype))
+             for x in inputs]
+    out_specs = op.infer(attrs, specs)
+    outs = op.forward(params or {}, [jnp.asarray(x) for x in inputs], attrs,
+                      ctx or OpContext())
+    assert len(outs) == len(out_specs)
+    for o, s in zip(outs, out_specs):
+        assert tuple(o.shape) == s.shape, (op_type, o.shape, s.shape)
+        assert DataType.from_jnp(o.dtype) == s.dtype, (op_type, o.dtype, s.dtype)
+    return outs
+
+
+def test_linear_matches_numpy():
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((4, 8), dtype=np.float32)
+    w = rng.standard_normal((8, 16), dtype=np.float32)
+    b = rng.standard_normal(16, dtype=np.float32)
+    (y,) = run_op(OpType.LINEAR, dict(out_dim=16, activation=ActiMode.RELU),
+                  [x], {"kernel": jnp.asarray(w), "bias": jnp.asarray(b)})
+    np.testing.assert_allclose(np.asarray(y), np.maximum(x @ w + b, 0),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_embedding_aggr_modes():
+    table = jnp.arange(20, dtype=jnp.float32).reshape(10, 2)
+    ids = np.array([[1, 3], [2, 2]], dtype=np.int32)
+    from flexflow_tpu.fftype import AggrMode
+    (out,) = run_op(OpType.EMBEDDING,
+                    dict(num_entries=10, out_dim=2, aggr=AggrMode.NONE),
+                    [ids], {"embedding": table})
+    assert out.shape == (2, 2, 2)
+    (summed,) = run_op(OpType.EMBEDDING,
+                       dict(num_entries=10, out_dim=2, aggr=AggrMode.SUM),
+                       [ids], {"embedding": table})
+    np.testing.assert_allclose(np.asarray(summed)[0],
+                               np.asarray(table)[1] + np.asarray(table)[3])
+
+
+def test_elementwise_broadcast():
+    a = np.ones((2, 3), np.float32)
+    b = np.full((3,), 2.0, np.float32)
+    (y,) = run_op(OpType.EW_ADD, {}, [a, b])
+    np.testing.assert_allclose(np.asarray(y), a + b)
+    (y,) = run_op(OpType.EW_POW, {}, [a + 1, b])
+    np.testing.assert_allclose(np.asarray(y), 4.0 * a)
+
+
+def test_softmax_and_reshape_transpose():
+    x = np.random.default_rng(1).standard_normal((3, 5)).astype(np.float32)
+    (y,) = run_op(OpType.SOFTMAX, dict(axis=-1), [x])
+    np.testing.assert_allclose(np.asarray(y).sum(-1), np.ones(3), rtol=1e-5)
+    (r,) = run_op(OpType.RESHAPE, dict(shape=(5, 3)), [x])
+    assert r.shape == (5, 3)
+    (t,) = run_op(OpType.TRANSPOSE, dict(perm=(1, 0)), [x])
+    np.testing.assert_allclose(np.asarray(t), x.T)
+
+
+def test_concat_split_roundtrip():
+    xs = [np.full((2, i + 1), i, np.float32) for i in range(3)]
+    (c,) = run_op(OpType.CONCAT, dict(axis=1), xs)
+    assert c.shape == (2, 6)
+    parts = run_op(OpType.SPLIT, dict(axis=1, sizes=(1, 2, 3)), [np.asarray(c)])
+    for p, x in zip(parts, xs):
+        np.testing.assert_allclose(np.asarray(p), x)
+
+
+def test_conv2d_matches_lax():
+    rng = np.random.default_rng(2)
+    x = rng.standard_normal((2, 3, 8, 8), dtype=np.float32)
+    k = rng.standard_normal((4, 3, 3, 3), dtype=np.float32)
+    (y,) = run_op(OpType.CONV2D, dict(
+        out_channels=4, kernel_h=3, kernel_w=3, stride_h=1, stride_w=1,
+        padding_h=1, padding_w=1, use_bias=False), [x], {"kernel": jnp.asarray(k)})
+    assert y.shape == (2, 4, 8, 8)
+    expected = jax.lax.conv_general_dilated(
+        jnp.asarray(x), jnp.asarray(k), (1, 1), [(1, 1), (1, 1)],
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))
+    np.testing.assert_allclose(np.asarray(y), np.asarray(expected),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_pool2d_max_and_avg():
+    x = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+    from flexflow_tpu.fftype import PoolType
+    (y,) = run_op(OpType.POOL2D, dict(kernel_h=2, kernel_w=2, stride_h=2,
+                                      stride_w=2, padding_h=0, padding_w=0,
+                                      pool_type=PoolType.MAX), [x])
+    np.testing.assert_allclose(np.asarray(y)[0, 0], [[5, 7], [13, 15]])
+    (y,) = run_op(OpType.POOL2D, dict(kernel_h=2, kernel_w=2, stride_h=2,
+                                      stride_w=2, padding_h=0, padding_w=0,
+                                      pool_type=PoolType.AVG), [x])
+    np.testing.assert_allclose(np.asarray(y)[0, 0], [[2.5, 4.5], [10.5, 12.5]])
+
+
+def test_norms_match_reference_formulas():
+    x = np.random.default_rng(3).standard_normal((2, 6)).astype(np.float32)
+    gamma = np.ones(6, np.float32)
+    beta = np.zeros(6, np.float32)
+    (y,) = run_op(OpType.LAYERNORM, dict(), [x],
+                  {"weight": jnp.asarray(gamma), "bias": jnp.asarray(beta)})
+    ref = (x - x.mean(-1, keepdims=True)) / np.sqrt(x.var(-1, keepdims=True) + 1e-5)
+    np.testing.assert_allclose(np.asarray(y), ref, rtol=1e-4, atol=1e-4)
+
+    (y,) = run_op(OpType.RMS_NORM, dict(eps=1e-6), [x],
+                  {"weight": jnp.asarray(gamma)})
+    ref = x / np.sqrt((x ** 2).mean(-1, keepdims=True) + 1e-6)
+    np.testing.assert_allclose(np.asarray(y), ref, rtol=1e-4, atol=1e-4)
+
+    y, total = run_op(OpType.RESIDUAL_RMS_NORM, dict(eps=1e-6), [x, x],
+                      {"weight": jnp.asarray(gamma)})
+    np.testing.assert_allclose(np.asarray(total), 2 * x, rtol=1e-5)
+
+
+def test_sigmoid_silu_multi():
+    x1 = np.random.default_rng(4).standard_normal((3, 4)).astype(np.float32)
+    x2 = np.random.default_rng(5).standard_normal((3, 4)).astype(np.float32)
+    (y,) = run_op(OpType.SIGMOID_SILU_MULTI, {}, [x1, x2])
+    ref = x1 / (1 + np.exp(-x1)) * x2
+    np.testing.assert_allclose(np.asarray(y), ref, rtol=1e-5, atol=1e-5)
+
+
+def test_sampling_heads():
+    x = np.array([[1.0, 3.0, 2.0, 0.0]], np.float32)
+    (idx,) = run_op(OpType.ARG_MAX, dict(), [x])
+    assert int(idx[0]) == 1
+    (topk_idx,) = run_op(OpType.ARG_TOPK, dict(k=2), [x])
+    assert list(np.asarray(topk_idx)[0]) == [1, 2]
+    vals, idx2 = run_op(OpType.TOPK, dict(k=2), [x])
+    np.testing.assert_allclose(np.asarray(vals)[0], [3.0, 2.0])
+    # top-p = 1.0 keeps full distribution; with top_p tiny it is greedy
+    ctx = OpContext(rng=jax.random.PRNGKey(0))
+    (s,) = run_op(OpType.SAMPLING, dict(top_p=1e-6), [x], ctx=ctx)
+    assert int(s[0]) == 1
+
+
+def test_beam_topk_logprobs():
+    x = np.array([[0.0, 1.0, 2.0]], np.float32)
+    ids, parents, logp = run_op(OpType.BEAM_TOPK, dict(max_beam_width=2), [x])
+    assert list(np.asarray(ids)[0]) == [2, 1]
+    full = np.exp(x[0] - x[0].max())
+    full = np.log(full / full.sum())
+    np.testing.assert_allclose(np.asarray(logp)[0], sorted(full)[::-1][:2],
+                               rtol=1e-5)
+
+
+def test_mha_causal_attention():
+    from flexflow_tpu.ops.attention_ops import mha_attention
+    rng = np.random.default_rng(6)
+    q = jnp.asarray(rng.standard_normal((1, 2, 4, 8)), jnp.float32)
+    k, v = q + 0.1, q - 0.1
+    out = mha_attention(q, k, v, causal=True)
+    # first position attends only to itself
+    expected_first = v[:, :, 0]
+    np.testing.assert_allclose(np.asarray(out[:, :, 0]),
+                               np.asarray(expected_first), rtol=1e-5)
+
+
+def test_rotary_embedding_norm_preserving():
+    from flexflow_tpu.ops.attention_ops import apply_rotary_embedding
+    x = jnp.asarray(np.random.default_rng(7).standard_normal((1, 5, 8)),
+                    jnp.float32)
+    pos = jnp.arange(5)[None]
+    y = apply_rotary_embedding(x, pos)
+    np.testing.assert_allclose(np.linalg.norm(np.asarray(y), axis=-1),
+                               np.linalg.norm(np.asarray(x), axis=-1),
+                               rtol=1e-4)
+    # position 0 is identity
+    np.testing.assert_allclose(np.asarray(y[:, 0]), np.asarray(x[:, 0]),
+                               rtol=1e-5)

@@ -1,0 +1,112 @@
+"""End-to-end training tests: the mnist_mlp slice.
+
+Mirrors the reference's training integration tests (tests/training_tests.sh)
+which assert convergence thresholds on small examples
+(examples/python/native/mnist_mlp.py).  Here the dataset is synthetic and the
+threshold is a loss decrease + accuracy floor on a separable problem.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from flexflow_tpu import (AdamOptimizer, FFConfig, LossType, MetricsType,
+                          Model, SGDOptimizer)
+from flexflow_tpu.fftype import ActiMode, DataType
+
+
+def make_blobs(n=512, dim=64, classes=10, seed=0):
+    rng = np.random.default_rng(seed)
+    centers = rng.standard_normal((classes, dim)).astype(np.float32) * 3
+    labels = rng.integers(0, classes, n).astype(np.int32)
+    x = centers[labels] + rng.standard_normal((n, dim)).astype(np.float32)
+    return x, labels
+
+
+def build_mlp(config, in_dim=64, classes=10):
+    model = Model(config)
+    x = model.create_tensor((config.batch_size, in_dim))
+    t = model.dense(x, 128, activation=ActiMode.RELU)
+    t = model.dense(t, 128, activation=ActiMode.RELU)
+    t = model.dense(t, classes)
+    t = model.softmax(t)
+    return model
+
+
+def test_mnist_mlp_slice_converges():
+    config = FFConfig(batch_size=64, epochs=5)
+    model = build_mlp(config)
+    model.compile(optimizer=SGDOptimizer(lr=0.05, momentum=0.9),
+                  loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+                  metrics=[MetricsType.ACCURACY,
+                           MetricsType.SPARSE_CATEGORICAL_CROSSENTROPY])
+    x, y = make_blobs()
+    perf = model.fit(x, y, epochs=5, verbose=False)
+    final = model.eval(x, y, verbose=False)
+    assert final.accuracy > 90.0, final.report()
+
+
+def test_adam_converges():
+    config = FFConfig(batch_size=64, epochs=3)
+    model = build_mlp(config)
+    model.compile(optimizer=AdamOptimizer(alpha=0.01),
+                  loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY)
+    x, y = make_blobs(seed=1)
+    model.fit(x, y, epochs=3, verbose=False)
+    final = model.eval(x, y, verbose=False)
+    assert final.accuracy > 90.0, final.report()
+
+
+def test_mse_regression():
+    config = FFConfig(batch_size=32, epochs=20)
+    model = Model(config)
+    x_t = model.create_tensor((32, 4))
+    t = model.dense(x_t, 1, use_bias=True)
+    model.compile(optimizer=SGDOptimizer(lr=0.1),
+                  loss_type=LossType.MEAN_SQUARED_ERROR_AVG_REDUCE,
+                  metrics=[MetricsType.MEAN_SQUARED_ERROR])
+    rng = np.random.default_rng(2)
+    x = rng.standard_normal((256, 4)).astype(np.float32)
+    w = np.array([[1.0], [-2.0], [0.5], [3.0]], np.float32)
+    y = x @ w
+    model.fit(x, y, epochs=20, verbose=False)
+    pred = model.apply(model.params, jnp.asarray(x[:8]))
+    np.testing.assert_allclose(np.asarray(pred), y[:8], atol=0.2)
+
+
+def test_batchnorm_running_stats_update():
+    config = FFConfig(batch_size=16, epochs=1)
+    model = Model(config)
+    x_t = model.create_tensor((16, 3, 8, 8))
+    t = model.conv2d(x_t, 4, 3, 3, 1, 1, 1, 1)
+    t = model.batch_norm(t)
+    t = model.flat(t)
+    t = model.dense(t, 2)
+    t = model.softmax(t)
+    model.compile(optimizer=SGDOptimizer(lr=0.01),
+                  loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY)
+    rng = np.random.default_rng(3)
+    x = (rng.standard_normal((64, 3, 8, 8)) * 2 + 1).astype(np.float32)
+    y = rng.integers(0, 2, 64).astype(np.int32)
+    bn_name = [l.name for l in model.layers if l.op_type.value == "batchnorm"][0]
+    before = model.get_parameter(bn_name, "running_mean").copy()
+    model.fit(x, y, epochs=1, verbose=False)
+    after = model.get_parameter(bn_name, "running_mean")
+    assert not np.allclose(before, after), "running stats should move"
+
+
+def test_operator_sugar_and_weight_access():
+    config = FFConfig(batch_size=8)
+    model = Model(config)
+    a = model.create_tensor((8, 4))
+    t = model.dense(a, 4, name="d0")
+    out = model.softmax(t + a)
+    model.compile(optimizer=SGDOptimizer(lr=0.1),
+                  loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY)
+    w = model.get_parameter("d0", "kernel")
+    assert w.shape == (4, 4)
+    model.set_parameter("d0", "kernel", np.eye(4, dtype=np.float32))
+    x = np.zeros((8, 4), np.float32)
+    x[:, 1] = 5.0
+    pred = model.apply(model.params, jnp.asarray(x))
+    assert int(np.asarray(pred).argmax(-1)[0]) == 1
