@@ -1,0 +1,112 @@
+"""ResNet training example (data-parallel AllReduce path).
+
+Parity example for the reference's examples/cpp/ResNet (resnet.cc — the
+BASELINE.md measurement config 2: ResNet-50 training, data-parallel).  Built
+entirely from the layer API (conv2d/batch_norm/pool2d/dense); gradient
+all-reduce over the `dp` mesh axis is inserted by GSPMD (replacing the
+reference's NCCL optimizer path, optimizer.h:59-76).
+
+Run: python examples/python/resnet.py [--depth 50] [--dp N]
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+
+from flexflow_tpu import (FFConfig, LossType, MetricsType, Model,
+                          SGDOptimizer)
+from flexflow_tpu.fftype import ActiMode, PoolType
+
+
+def bottleneck_block(model, t, out_channels, stride, project):
+    """reference: BottleneckBlock (examples/cpp/ResNet/resnet.cc)."""
+    shortcut = t
+    t = model.conv2d(t, out_channels, 1, 1, 1, 1, 0, 0)
+    t = model.batch_norm(t, relu=True)
+    t = model.conv2d(t, out_channels, 3, 3, stride, stride, 1, 1)
+    t = model.batch_norm(t, relu=True)
+    t = model.conv2d(t, 4 * out_channels, 1, 1, 1, 1, 0, 0)
+    t = model.batch_norm(t, relu=False)
+    if project:
+        shortcut = model.conv2d(shortcut, 4 * out_channels, 1, 1, stride,
+                                stride, 0, 0)
+        shortcut = model.batch_norm(shortcut, relu=False)
+    t = model.add(t, shortcut)
+    return model.relu(t)
+
+
+def basic_block(model, t, out_channels, stride, project):
+    shortcut = t
+    t = model.conv2d(t, out_channels, 3, 3, stride, stride, 1, 1)
+    t = model.batch_norm(t, relu=True)
+    t = model.conv2d(t, out_channels, 3, 3, 1, 1, 1, 1)
+    t = model.batch_norm(t, relu=False)
+    if project:
+        shortcut = model.conv2d(shortcut, out_channels, 1, 1, stride, stride,
+                                0, 0)
+        shortcut = model.batch_norm(shortcut, relu=False)
+    t = model.add(t, shortcut)
+    return model.relu(t)
+
+
+RESNET_SPECS = {
+    18: (basic_block, [2, 2, 2, 2], 1),
+    34: (basic_block, [3, 4, 6, 3], 1),
+    50: (bottleneck_block, [3, 4, 6, 3], 4),
+    101: (bottleneck_block, [3, 4, 23, 3], 4),
+    152: (bottleneck_block, [3, 8, 36, 3], 4),
+}
+
+
+def build_resnet(config, depth=50, num_classes=1000, image_size=224):
+    block_fn, counts, expansion = RESNET_SPECS[depth]
+    model = Model(config)
+    x = model.create_tensor((config.batch_size, 3, image_size, image_size))
+    t = model.conv2d(x, 64, 7, 7, 2, 2, 3, 3)
+    t = model.batch_norm(t, relu=True)
+    t = model.pool2d(t, 3, 3, 2, 2, 1, 1, PoolType.MAX)
+    channels = [64, 128, 256, 512]
+    for stage, (c, n) in enumerate(zip(channels, counts)):
+        for i in range(n):
+            stride = 2 if (stage > 0 and i == 0) else 1
+            project = (i == 0)
+            t = block_fn(model, t, c, stride, project)
+    # global average pool
+    t = model.mean(t, dims=(2, 3))
+    t = model.dense(t, num_classes)
+    t = model.softmax(t)
+    return model
+
+
+def top_level_task(depth=50, dp=1, batch_size=32, iters=8, image_size=64,
+                   num_classes=16):
+    import jax
+
+    devices = jax.devices()[:dp]
+    config = FFConfig(batch_size=batch_size, data_parallelism_degree=dp,
+                      devices=devices)
+    model = build_resnet(config, depth, num_classes, image_size)
+    model.compile(optimizer=SGDOptimizer(lr=0.01, momentum=0.9),
+                  loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+                  metrics=[MetricsType.ACCURACY])
+    rng = np.random.default_rng(0)
+    n = batch_size * iters
+    xs = rng.standard_normal((n, 3, image_size, image_size)).astype(np.float32)
+    ys = rng.integers(0, num_classes, n).astype(np.int32)
+    model.fit(xs, ys, epochs=1)
+    return model
+
+
+if __name__ == "__main__":
+    p = argparse.ArgumentParser()
+    p.add_argument("--depth", type=int, default=50)
+    p.add_argument("--dp", type=int, default=1)
+    p.add_argument("--batch-size", type=int, default=32)
+    p.add_argument("--image-size", type=int, default=64)
+    args = p.parse_args()
+    top_level_task(args.depth, args.dp, args.batch_size,
+                   image_size=args.image_size)

@@ -31,7 +31,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec
 
-from ..config import AXIS_DATA, FFConfig
+from ..config import AXIS_DATA, AXIS_MODEL, FFConfig
 from ..fftype import (ActiMode, AggrMode, DataType, LossType, MetricsType,
                       OpType, PoolType)
 from ..ops import registry as _registry
@@ -49,6 +49,7 @@ from ..ops import conv_ops as _cv  # noqa: F401
 from ..ops import norm_ops as _no  # noqa: F401
 from ..ops import attention_ops as _at  # noqa: F401
 from ..ops import sampling_ops as _sa  # noqa: F401
+from ..parallel import parallel_ops as _po  # noqa: F401
 
 
 def _tensor_key(t: Tensor):
@@ -373,6 +374,31 @@ class Model:
         return self._add_layer(OpType.SAMPLING, [x], dict(
             top_p=top_p, seed_offset=self._dropout_count), name)[0]
 
+    # parallel IR ops (reference: src/parallel_ops/; inserted manually or
+    # by the search — same role as the reference's PCG parallel operators)
+    def repartition(self, x: Tensor, dim: int, degree: int,
+                    axis: str = AXIS_MODEL, name=None) -> Tensor:
+        return self._add_layer(OpType.REPARTITION, [x],
+                               dict(dim=dim, degree=degree, axis=axis), name)[0]
+
+    def combine(self, x: Tensor, dim: int, degree: int, name=None) -> Tensor:
+        return self._add_layer(OpType.COMBINE, [x],
+                               dict(dim=dim, degree=degree), name)[0]
+
+    def replicate(self, x: Tensor, degree: int = 1, name=None) -> Tensor:
+        return self._add_layer(OpType.REPLICATE, [x], dict(degree=degree),
+                               name)[0]
+
+    def reduction(self, x: Tensor, dim: int, degree: int,
+                  axis: str = AXIS_MODEL, name=None) -> Tensor:
+        """Sum `degree` stacked partial copies along `dim` (shrinks the dim
+        by `degree`; reference reduction_kernels.cu:28-54)."""
+        return self._add_layer(OpType.REDUCTION, [x],
+                               dict(dim=dim, degree=degree, axis=axis), name)[0]
+
+    def allreduce(self, x: Tensor, axis: str = AXIS_MODEL, name=None) -> Tensor:
+        return self._add_layer(OpType.ALLREDUCE, [x], dict(axis=axis), name)[0]
+
     # ------------------------------------------------------------- compile
     def _non_trainable_keys(self):
         keys = set()
@@ -481,7 +507,8 @@ class Model:
         def train_step(trainable, state, opt_state, rng, batch):
             def loss_fn(tr):
                 p = self._merge_params(tr, state)
-                ctx = OpContext(training=True, rng=rng, state_updates={})
+                ctx = OpContext(training=True, rng=rng, state_updates={},
+                                mesh=self.mesh)
                 vals = self.run_layers(p, dict(zip(input_names, batch[:-1])), ctx)
                 loss = compute_loss(loss_type, vals[logits_key], batch[-1],
                                     from_logits)
@@ -500,7 +527,7 @@ class Model:
 
         def eval_step(trainable, state, batch):
             p = self._merge_params(trainable, state)
-            ctx = OpContext(training=False)
+            ctx = OpContext(training=False, mesh=self.mesh)
             vals = self.run_layers(p, dict(zip(input_names, batch[:-1])), ctx)
             loss = compute_loss(loss_type, vals[logits_key], batch[-1],
                                 from_logits)
@@ -516,7 +543,7 @@ class Model:
     def apply(self, params, *inputs, training: bool = False, rng=None):
         """Pure functional forward over the whole graph; returns the final
         layer's outputs."""
-        ctx = OpContext(training=training, rng=rng)
+        ctx = OpContext(training=training, rng=rng, mesh=self.mesh)
         names = [t.name for t in self.input_tensors]
         vals = self.run_layers(params, dict(zip(names, inputs)), ctx)
         final = self.layers[-1]

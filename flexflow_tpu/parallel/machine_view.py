@@ -1,0 +1,89 @@
+"""Machine views: device-assignment records.
+
+TPU-native equivalent of the reference's ``MachineView``
+(include/flexflow/machine_view.h:18-39: {device_type, ndims,
+start_device_id, dim[], stride[]} mapping a task index-space point to a
+device id) and its legacy twin ``ParallelConfig`` (machine_view.h:66-100).
+
+On TPU the executable form of a MachineView is a `jax.sharding.Mesh` slice +
+axis naming: ``to_mesh`` realises the view over concrete devices.  The view
+remains a first-class value (hashable, comparable) because the
+auto-parallelization search manipulates views symbolically before any device
+is touched — same role as in the reference, where views key NCCL comms and
+simulator cache entries.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+from typing import Sequence, Tuple
+
+import numpy as np
+
+
+class DeviceType(enum.Enum):
+    TPU = "tpu"     # reference: DeviceType::GPU
+    CPU = "cpu"
+
+
+@dataclasses.dataclass(frozen=True)
+class MachineView:
+    """N-dimensional strided view over a linear device space
+    (reference: machine_view.h:18-39)."""
+
+    device_type: DeviceType = DeviceType.TPU
+    start_device_id: int = 0
+    dims: Tuple[int, ...] = (1,)
+    strides: Tuple[int, ...] = (1,)
+
+    def __post_init__(self):
+        assert len(self.dims) == len(self.strides)
+
+    @property
+    def ndims(self) -> int:
+        return len(self.dims)
+
+    def num_parts(self) -> int:
+        return int(np.prod(self.dims))
+
+    def get_device_id(self, point: Sequence[int]) -> int:
+        """reference: MachineView::get_device_id — linearise an index-space
+        point through the strides."""
+        assert len(point) == self.ndims
+        dev = self.start_device_id
+        for p, d, s in zip(point, self.dims, self.strides):
+            assert 0 <= p < d
+            dev += p * s
+        return dev
+
+    def device_ids(self) -> Tuple[int, ...]:
+        """All device ids covered, in row-major point order."""
+        out = []
+        for flat in range(self.num_parts()):
+            point = []
+            rem = flat
+            for d in reversed(self.dims):
+                point.append(rem % d)
+                rem //= d
+            out.append(self.get_device_id(tuple(reversed(point))))
+        return tuple(out)
+
+    def to_mesh(self, devices: Sequence, axis_names: Sequence[str]):
+        """Realise as a Mesh over concrete jax devices (the executable form;
+        replaces FFMapper's slice_task placement, mapper.cc:376)."""
+        import jax
+
+        ids = self.device_ids()
+        devs = np.array([devices[i] for i in ids]).reshape(self.dims)
+        assert len(axis_names) == self.ndims
+        return jax.sharding.Mesh(devs, tuple(axis_names))
+
+    def hash(self) -> int:
+        return hash(self)
+
+
+def make_1d_view(num_devices: int, start: int = 0, stride: int = 1) -> MachineView:
+    """The common data-parallel view (reference: graph.cc:1969-1992 builds
+    exactly this for only_data_parallel training)."""
+    return MachineView(DeviceType.TPU, start, (num_devices,), (stride,))

@@ -1,0 +1,202 @@
+"""First-class parallelism IR ops.
+
+TPU-native equivalents of the reference's parallel operators
+(src/parallel_ops/: Repartition, Combine, Replicate, Reduction, AllReduce,
+FusedParallelOp — §2.3 of SURVEY.md).  In the reference these are explicit
+data-movement tasks with their own CUDA kernels; on TPU they are *sharding
+annotations*: inside jit each lowers to `jax.lax.with_sharding_constraint`
+and the GSPMD partitioner inserts the matching ICI collective
+(all-gather/all-reduce/reduce-scatter/all-to-all), replacing the NCCL calls
+in allreduce_kernels.cu:27-76 etc.
+
+They stay first-class graph ops (not just annotations scattered in model
+code) so the auto-parallelization search can insert/remove/rewrite them —
+the same reason the reference keeps them in the PCG.
+
+Semantics table (reference file -> TPU lowering):
+- Repartition (partition.cc):  shard dim d over axis a      -> wsc(P(..., a, ...))
+- Combine     (combine.cc):    unshard dim d (gather)       -> wsc(P(..., None, ...))
+- Replicate   (replicate.cc):  broadcast to a replica axis  -> wsc replicated; grad = psum (automatic via transpose of broadcast)
+- Reduction   (reduction.cc):  sum partials over axis, then scatter -> psum/reduce-scatter inside shard_map paths
+- AllReduce   (allreduce.cc):  sum partials, result replicated -> psum
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec
+
+from ..core.tensor import TensorSpec
+from ..fftype import OpType
+from ..ops.registry import OpContext, OpDef, register
+
+
+def _wsc(x, mesh, spec: PartitionSpec):
+    """with_sharding_constraint when a mesh is present; identity otherwise
+    (single-device eager paths and tests)."""
+    if mesh is None:
+        return x
+    return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
+
+
+def _spec_for_dim(ndim: int, dim: int, axis: Optional[str]) -> PartitionSpec:
+    entries = [None] * ndim
+    if axis is not None:
+        entries[dim] = axis
+    return PartitionSpec(*entries)
+
+
+def _check_degree(mesh, axis: str, degree: int, what: str):
+    """The IR's declared degree must match the mesh axis it lowers onto
+    (keeps graph metadata truthful for the search/cost model)."""
+    if mesh is not None and axis in mesh.axis_names:
+        actual = mesh.shape[axis]
+        if degree != actual:
+            raise ValueError(
+                f"{what}: declared degree {degree} != mesh axis "
+                f"'{axis}' size {actual}")
+
+
+@register
+class Repartition(OpDef):
+    """Split tensor dim across devices (reference: src/parallel_ops/
+    partition.cc; kernel = identity copy per shard,
+    partition_kernels.cu:27-47)."""
+
+    type = OpType.REPARTITION
+
+    def infer(self, attrs, in_specs):
+        return [in_specs[0]]
+
+    def forward(self, params, inputs, attrs, ctx: OpContext):
+        (x,) = inputs
+        _check_degree(ctx.mesh, attrs["axis"], attrs["degree"], "Repartition")
+        return [_wsc(x, ctx.mesh, _spec_for_dim(x.ndim, attrs["dim"],
+                                                attrs["axis"]))]
+
+
+@register
+class Combine(OpDef):
+    """Gather shards of a dim (reference: src/parallel_ops/combine.cc;
+    inverse of Repartition)."""
+
+    type = OpType.COMBINE
+
+    def infer(self, attrs, in_specs):
+        return [in_specs[0]]
+
+    def forward(self, params, inputs, attrs, ctx: OpContext):
+        (x,) = inputs
+        mesh = ctx.mesh
+        return [_wsc(x, mesh, _spec_for_dim(x.ndim, attrs["dim"], None))]
+
+
+@register
+class Replicate(OpDef):
+    """Broadcast to a replica dim; backward sums replica gradients
+    (reference: src/parallel_ops/replicate.cc,
+    replicate_backward_kernel replicate_kernels.cu:39).  Under GSPMD the
+    backward psum comes from the transpose of the broadcast automatically."""
+
+    type = OpType.REPLICATE
+
+    def infer(self, attrs, in_specs):
+        return [in_specs[0]]
+
+    def forward(self, params, inputs, attrs, ctx: OpContext):
+        (x,) = inputs
+        mesh = ctx.mesh
+        return [_wsc(x, mesh, PartitionSpec(*([None] * x.ndim)))]
+
+
+@register
+class AllReduce(OpDef):
+    """Sum partial results; output replicated (reference:
+    src/parallel_ops/allreduce.cc — ncclAllReduce on fwd and inference
+    paths; the TP-sum after a row-parallel matmul).
+
+    Inside jit/GSPMD the partial-sum state is expressed by the producer
+    having contracted over a sharded dim; XLA inserts the all-reduce on its
+    own.  When called under shard_map (explicit-collective paths) we issue a
+    real psum over the named axis."""
+
+    type = OpType.ALLREDUCE
+
+    def infer(self, attrs, in_specs):
+        return [in_specs[0]]
+
+    def forward(self, params, inputs, attrs, ctx: OpContext):
+        (x,) = inputs
+        axis = attrs["axis"]
+        if _inside_shard_map(axis):
+            return [jax.lax.psum(x, axis)]
+        mesh = ctx.mesh
+        return [_wsc(x, mesh, PartitionSpec(*([None] * x.ndim)))]
+
+
+@register
+class Reduction(OpDef):
+    """Reduce-scatter: sum ``degree`` stacked partial copies along ``dim``,
+    shrinking that dim by ``degree`` (reference: src/parallel_ops/
+    reduction.cc — reduction_kernels.cu:28-54 sums num_replicas strided
+    chunks, output size = input/num_replicas).
+
+    Both lowerings agree on the logical output shape dims[dim]//degree:
+    - under shard_map: psum_scatter(tiled) over the named axis;
+    - under jit/GSPMD (or no mesh): strided chunk-sum via reshape, with the
+      result sharded over the axis."""
+
+    type = OpType.REDUCTION
+
+    def infer(self, attrs, in_specs):
+        (x,) = in_specs
+        dim, degree = attrs["dim"], attrs["degree"]
+        assert x.shape[dim] % degree == 0, (x.shape, dim, degree)
+        shape = list(x.shape)
+        shape[dim] //= degree
+        return [TensorSpec(tuple(shape), x.dtype)]
+
+    def forward(self, params, inputs, attrs, ctx: OpContext):
+        (x,) = inputs
+        axis, dim, degree = attrs["axis"], attrs["dim"], attrs["degree"]
+        if _inside_shard_map(axis):
+            return [jax.lax.psum_scatter(x, axis, scatter_dimension=dim,
+                                         tiled=True)]
+        _check_degree(ctx.mesh, axis, degree, "Reduction")
+        # strided chunk sum: reshape dim -> (degree, dim//degree), sum copies
+        shape = x.shape
+        split = shape[:dim] + (degree, shape[dim] // degree) + shape[dim + 1:]
+        y = jnp.sum(jnp.reshape(x, split), axis=dim)
+        return [_wsc(y, ctx.mesh, _spec_for_dim(y.ndim, dim, axis))]
+
+
+@register
+class FusedParallelOp(OpDef):
+    """Chain of parallel-op transitions applied as one step (reference:
+    src/parallel_ops/fused_parallel_op.cc).  Under GSPMD only the final
+    sharding matters, so this is a single constraint with the last spec."""
+
+    type = OpType.FUSED_PARALLEL
+
+    def infer(self, attrs, in_specs):
+        return [in_specs[0]]
+
+    def forward(self, params, inputs, attrs, ctx: OpContext):
+        (x,) = inputs
+        mesh = ctx.mesh
+        return [_wsc(x, mesh, attrs["spec"])]
+
+
+def _inside_shard_map(axis_name: str) -> bool:
+    """True when `axis_name` is a bound collective axis (i.e. we're tracing
+    inside shard_map/pmap), so explicit psum is legal."""
+    try:
+        jax.lax.axis_index(axis_name)
+        return True
+    except NameError:
+        return False
+    except Exception:
+        return False

@@ -34,6 +34,11 @@ class SingleDataLoader:
         self.mesh = mesh
         self.sharding = None
         if mesh is not None and batch_axis in mesh.axis_names:
+            degree = mesh.shape[batch_axis]
+            if batch_size % degree != 0:
+                raise ValueError(
+                    f"batch_size {batch_size} not divisible by "
+                    f"{batch_axis}-degree {degree}")
             spec = PartitionSpec(batch_axis, *([None] * (self.data.ndim - 1)))
             self.sharding = NamedSharding(mesh, spec)
         self.shuffle = shuffle
