@@ -49,6 +49,7 @@ from ..ops import conv_ops as _cv  # noqa: F401
 from ..ops import norm_ops as _no  # noqa: F401
 from ..ops import attention_ops as _at  # noqa: F401
 from ..ops import sampling_ops as _sa  # noqa: F401
+from ..ops import serving_attention as _sv  # noqa: F401
 from ..parallel import parallel_ops as _po  # noqa: F401
 
 
@@ -104,6 +105,7 @@ class Model:
         lname = self._unique_name(op_type.value, name)
         layer = Layer(op_type, lname, attrs, list(inputs),
                       transformer_layer_id=self.current_transformer_layer_id)
+        attrs.setdefault("layer_name", lname)  # cache keying for serving ops
         in_specs = [t.spec for t in inputs]
         out_specs = op.infer(attrs, in_specs)
         layer.param_specs = op.params(attrs, in_specs)
@@ -345,6 +347,84 @@ class Model:
                                    dropout=dropout, causal=causal,
                                    seed_offset=self._dropout_count,
                                    kernel_initializer=kernel_initializer), name)[0]
+
+    # serving attention family (reference: model.h inc_multihead_self_attention
+    # etc.; src/ops/inc_multihead_self_attention.cc:210 builder).  The
+    # *multiquery* variants expose separate q/kv head counts (GQA/MQA).
+    def _serving_attention(self, op_type, input, embed_dim, num_q_heads,
+                           num_kv_heads, kdim, vdim, dropout, qkv_bias,
+                           final_bias, apply_rotary_embedding, scaling_query,
+                           scaling_factor, qk_prod_scaling, position_bias,
+                           rope_theta, name):
+        head_dim = (kdim or embed_dim // num_q_heads)
+        return self._add_layer(op_type, [input], dict(
+            embed_dim=embed_dim, num_q_heads=num_q_heads,
+            num_kv_heads=num_kv_heads, head_dim=head_dim, dropout=dropout,
+            qkv_bias=qkv_bias, final_bias=final_bias,
+            rotary=apply_rotary_embedding, scaling_query=scaling_query,
+            scaling_factor=scaling_factor, qk_prod_scaling=qk_prod_scaling,
+            position_bias=position_bias, rope_theta=rope_theta), name)[0]
+
+    def inc_multihead_self_attention(self, input: Tensor, embed_dim: int,
+                                     num_heads: int, kdim: int = 0,
+                                     vdim: int = 0, dropout: float = 0.0,
+                                     qkv_bias: bool = False,
+                                     final_bias: bool = False,
+                                     apply_rotary_embedding: bool = False,
+                                     scaling_query: bool = True,
+                                     scaling_factor: Optional[float] = None,
+                                     qk_prod_scaling: bool = True,
+                                     position_bias: bool = False,
+                                     rope_theta: float = 10000.0,
+                                     name=None) -> Tensor:
+        return self._serving_attention(
+            OpType.INC_MULTIHEAD_SELF_ATTENTION, input, embed_dim, num_heads,
+            num_heads, kdim, vdim, dropout, qkv_bias, final_bias,
+            apply_rotary_embedding, scaling_query, scaling_factor,
+            qk_prod_scaling, position_bias, rope_theta, name)
+
+    def inc_multiquery_self_attention(self, input: Tensor, embed_dim: int,
+                                      num_q_heads: int, num_kv_heads: int,
+                                      kdim: int = 0, vdim: int = 0,
+                                      dropout: float = 0.0,
+                                      qkv_bias: bool = False,
+                                      final_bias: bool = False,
+                                      apply_rotary_embedding: bool = False,
+                                      scaling_query: bool = True,
+                                      scaling_factor: Optional[float] = None,
+                                      qk_prod_scaling: bool = True,
+                                      position_bias: bool = False,
+                                      rope_theta: float = 10000.0,
+                                      name=None) -> Tensor:
+        return self._serving_attention(
+            OpType.INC_MULTIHEAD_SELF_ATTENTION, input, embed_dim,
+            num_q_heads, num_kv_heads, kdim, vdim, dropout, qkv_bias,
+            final_bias, apply_rotary_embedding, scaling_query, scaling_factor,
+            qk_prod_scaling, position_bias, rope_theta, name)
+
+    def spec_inc_multihead_self_attention(self, input, embed_dim, num_heads,
+                                          num_kv_heads=None, **kw):
+        return self._serving_attention(
+            OpType.SPEC_INC_MULTIHEAD_SELF_ATTENTION, input, embed_dim,
+            num_heads, num_kv_heads or num_heads, kw.get("kdim", 0),
+            kw.get("vdim", 0), kw.get("dropout", 0.0),
+            kw.get("qkv_bias", False), kw.get("final_bias", False),
+            kw.get("apply_rotary_embedding", False),
+            kw.get("scaling_query", True), kw.get("scaling_factor"),
+            kw.get("qk_prod_scaling", True), kw.get("position_bias", False),
+            kw.get("rope_theta", 10000.0), kw.get("name"))
+
+    def tree_inc_multihead_self_attention(self, input, embed_dim, num_heads,
+                                          num_kv_heads=None, **kw):
+        return self._serving_attention(
+            OpType.TREE_INC_MULTIHEAD_SELF_ATTENTION, input, embed_dim,
+            num_heads, num_kv_heads or num_heads, kw.get("kdim", 0),
+            kw.get("vdim", 0), kw.get("dropout", 0.0),
+            kw.get("qkv_bias", False), kw.get("final_bias", False),
+            kw.get("apply_rotary_embedding", False),
+            kw.get("scaling_query", True), kw.get("scaling_factor"),
+            kw.get("qk_prod_scaling", True), kw.get("position_bias", False),
+            kw.get("rope_theta", 10000.0), kw.get("name"))
 
     # sampling heads
     def arg_max(self, x: Tensor, beam_search: bool = False, name=None):

@@ -1,0 +1,3 @@
+"""Serving model zoo (reference: inference/models/ + python/flexflow/serve/models/)."""
+
+from . import llama  # noqa: F401

@@ -1,0 +1,200 @@
+"""LLaMA-family graph builder for serving.
+
+TPU-native re-design of the reference's LLaMA model builder
+(inference/models/llama.cc:23-259 create_llama_model) and its Python twin
+(python/flexflow/serve/models/llama.py).  Same layer recipe:
+
+  embed -> N x [ (residual_)rms_norm -> {inc|spec|tree}_mqa(+RoPE)
+                 -> residual_rms_norm -> w1/w3 -> sigmoid_silu_multi -> w2 ]
+  -> final residual norm -> lm_head -> sampling head per mode
+
+plus the HF-checkpoint weight conversion the reference does offline in
+python/flexflow/serve/models/llama.py (convert_hf_model) + C++ FileDataLoader
+(inference/file_loader.cc:209 TP head sharding — here sharding is a
+NamedSharding on the converted arrays, so no layout surgery is needed).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from ..core.model import Model
+from ..fftype import DataType, InferenceMode
+from ..serving.request_manager import GenerationConfig
+
+
+@dataclasses.dataclass
+class LLAMAConfig:
+    """Mirrors inference/models/llama.h llama_config (read from HF
+    config.json)."""
+
+    vocab_size: int = 32000
+    hidden_size: int = 4096
+    intermediate_size: int = 11008
+    num_hidden_layers: int = 32
+    num_attention_heads: int = 32
+    num_key_value_heads: int = 32
+    rms_norm_eps: float = 1e-6
+    rope_theta: float = 10000.0
+    max_position_embeddings: int = 2048
+    bos_token_id: int = 1
+    eos_token_id: int = 2
+
+    @classmethod
+    def from_hf(cls, hf) -> "LLAMAConfig":
+        get = (hf.get if isinstance(hf, dict)
+               else lambda k, d=None: getattr(hf, k, d))
+        return cls(
+            vocab_size=get("vocab_size", 32000),
+            hidden_size=get("hidden_size", 4096),
+            intermediate_size=get("intermediate_size", 11008),
+            num_hidden_layers=get("num_hidden_layers", 32),
+            num_attention_heads=get("num_attention_heads", 32),
+            num_key_value_heads=get("num_key_value_heads", None)
+            or get("num_attention_heads", 32),
+            rms_norm_eps=get("rms_norm_eps", 1e-6),
+            rope_theta=get("rope_theta", 10000.0),
+            max_position_embeddings=get("max_position_embeddings", 2048),
+            bos_token_id=get("bos_token_id", 1),
+            eos_token_id=get("eos_token_id", 2),
+        )
+
+
+def create_llama_model(model: Model, config: LLAMAConfig,
+                       mode: InferenceMode = InferenceMode.INC_DECODING,
+                       generation_config: Optional[GenerationConfig] = None,
+                       max_requests: int = 8, chunk: int = 1,
+                       dtype: DataType = DataType.FLOAT) -> Model:
+    """Build the serving graph (reference: inference/models/llama.cc:23)."""
+    c = config
+    gen = generation_config or GenerationConfig()
+    head_dim = c.hidden_size // c.num_attention_heads
+
+    tokens = model.create_tensor((max_requests, chunk), DataType.INT32,
+                                 name="tokens")
+    t = model.embedding(tokens, c.vocab_size, c.hidden_size, dtype=dtype,
+                        name="embed_tokens")
+
+    for i in range(c.num_hidden_layers):
+        model.current_transformer_layer_id = i
+        pfx = f"layers_{i}"
+        if i == 0:
+            attn_in = model.rms_norm(t, eps=c.rms_norm_eps,
+                                     name=f"{pfx}_input_layernorm")
+            residual = t
+        else:
+            # fused add+norm (reference llama.cc residual_rms_norm)
+            attn_in, residual = model.residual_rms_norm(
+                t, residual, eps=c.rms_norm_eps,
+                name=f"{pfx}_input_layernorm")
+
+        attn_kw = dict(
+            embed_dim=c.hidden_size, num_q_heads=c.num_attention_heads,
+            num_kv_heads=c.num_key_value_heads, kdim=head_dim, vdim=head_dim,
+            qkv_bias=False, final_bias=False, apply_rotary_embedding=True,
+            rope_theta=c.rope_theta, name=f"{pfx}_attention")
+        if mode is InferenceMode.BEAM_SEARCH:
+            mha = model.spec_inc_multihead_self_attention(
+                attn_in, attn_kw.pop("embed_dim"),
+                attn_kw.pop("num_q_heads"), attn_kw.pop("num_kv_heads"),
+                **attn_kw)
+        elif mode is InferenceMode.TREE_VERIFY:
+            mha = model.tree_inc_multihead_self_attention(
+                attn_in, attn_kw.pop("embed_dim"),
+                attn_kw.pop("num_q_heads"), attn_kw.pop("num_kv_heads"),
+                **attn_kw)
+        else:
+            mha = model.inc_multiquery_self_attention(
+                attn_in, attn_kw.pop("embed_dim"),
+                attn_kw.pop("num_q_heads"), attn_kw.pop("num_kv_heads"),
+                kdim=attn_kw.pop("kdim"), vdim=attn_kw.pop("vdim"), **attn_kw)
+
+        ffn_in, residual = model.residual_rms_norm(
+            mha, residual, eps=c.rms_norm_eps,
+            name=f"{pfx}_post_attention_layernorm")
+        w1 = model.dense(ffn_in, c.intermediate_size, use_bias=False,
+                         name=f"{pfx}_mlp_gate_proj")
+        w3 = model.dense(ffn_in, c.intermediate_size, use_bias=False,
+                         name=f"{pfx}_mlp_up_proj")
+        ssm = model.sigmoid_silu_multi(w1, w3, name=f"{pfx}_mlp_act")
+        t = model.dense(ssm, c.hidden_size, use_bias=False,
+                        name=f"{pfx}_mlp_down_proj")
+        # TP annotations (reference AllReduce-insertion rules model.cc:3292)
+        model.layers[-1].attrs["shard"] = "row"
+        model.layers[-3].attrs["shard"] = "col"  # up_proj
+        model.layers[-4].attrs["shard"] = "col"  # gate_proj
+
+    model.current_transformer_layer_id = -1
+    final_norm, _ = model.residual_rms_norm(t, residual, eps=c.rms_norm_eps,
+                                            name="norm")
+    lm_head = model.dense(final_norm, c.vocab_size, use_bias=False,
+                          name="lm_head")
+    model.layers[-1].attrs["shard"] = "col"
+
+    # sampling head per mode (reference llama.cc:232-259)
+    if mode is InferenceMode.BEAM_SEARCH:
+        from ..serving.batch_config import BeamSearchBatchConfig
+        softmax = model.softmax(lm_head, name="softmax")
+        model.beam_top_k(softmax, BeamSearchBatchConfig.MAX_BEAM_WIDTH,
+                         name="beam_topk")
+    elif gen.do_sample:
+        scaled = model.scalar_true_divide(lm_head, max(gen.temperature, 1e-6),
+                                          name="temp_scale")
+        model.sampling(scaled, top_p=gen.topp, name="sampling")
+    else:
+        model.arg_max(lm_head, name="argmax")
+    return model
+
+
+# ---------------------------------------------------------------- weights
+def convert_hf_state_dict(state_dict: Dict[str, Any],
+                          config: LLAMAConfig) -> Dict[str, Dict[str, np.ndarray]]:
+    """HF LlamaForCausalLM state dict -> framework params.
+
+    reference analogue: serve/models/llama.py convert_hf_model +
+    file_loader.cc:209 load_attention_weights_v2 (qkv head splitting).
+    torch Linear stores [out, in]; our Linear kernel is [in, out] and
+    attention weights are [E, H, D] / wo [H, D, E].
+    """
+    c = config
+    H, KV = c.num_attention_heads, c.num_key_value_heads
+    D = c.hidden_size // H
+    E = c.hidden_size
+
+    def np_of(v):
+        return np.asarray(v.detach().cpu().numpy() if hasattr(v, "detach")
+                          else v, np.float32)
+
+    p: Dict[str, Dict[str, np.ndarray]] = {}
+    p["embed_tokens"] = {"embedding": np_of(state_dict["model.embed_tokens.weight"])}
+    for i in range(c.num_hidden_layers):
+        hf = f"model.layers.{i}."
+        pfx = f"layers_{i}"
+        p[f"{pfx}_input_layernorm"] = {
+            "weight": np_of(state_dict[hf + "input_layernorm.weight"])}
+        p[f"{pfx}_post_attention_layernorm"] = {
+            "weight": np_of(state_dict[hf + "post_attention_layernorm.weight"])}
+        wq = np_of(state_dict[hf + "self_attn.q_proj.weight"])  # [H*D, E]
+        wk = np_of(state_dict[hf + "self_attn.k_proj.weight"])  # [KV*D, E]
+        wv = np_of(state_dict[hf + "self_attn.v_proj.weight"])
+        wo = np_of(state_dict[hf + "self_attn.o_proj.weight"])  # [E, H*D]
+        p[f"{pfx}_attention"] = {
+            "wq": wq.reshape(H, D, E).transpose(2, 0, 1),
+            "wk": wk.reshape(KV, D, E).transpose(2, 0, 1),
+            "wv": wv.reshape(KV, D, E).transpose(2, 0, 1),
+            "wo": wo.reshape(E, H, D).transpose(1, 2, 0),
+        }
+        p[f"{pfx}_mlp_gate_proj"] = {
+            "kernel": np_of(state_dict[hf + "mlp.gate_proj.weight"]).T}
+        p[f"{pfx}_mlp_up_proj"] = {
+            "kernel": np_of(state_dict[hf + "mlp.up_proj.weight"]).T}
+        p[f"{pfx}_mlp_down_proj"] = {
+            "kernel": np_of(state_dict[hf + "mlp.down_proj.weight"]).T}
+    p["norm"] = {"weight": np_of(state_dict["model.norm.weight"])}
+    lm = state_dict.get("lm_head.weight",
+                        state_dict["model.embed_tokens.weight"])  # tied
+    p["lm_head"] = {"kernel": np_of(lm).T}
+    return p

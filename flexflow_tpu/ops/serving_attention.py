@@ -1,0 +1,274 @@
+"""Serving attention operators (KV-cached, BatchConfig-driven).
+
+TPU-native re-design of the reference's serving attention family:
+
+- IncMultiHeadSelfAttention   (src/ops/inc_multihead_self_attention.cu:
+  qkv GEMM :328-397, in-kernel RoPE :449, KV append :603/:857, prompt-phase
+  batched attention :902, single-token generation kernel :46)
+- SpecIncMultiHeadSelfAttention (src/ops/spec_inc_multihead_self_attention.cu:
+  beam-aware KV cache per sub-request)
+- TreeIncMultiHeadSelfAttention (src/ops/tree_inc_multihead_self_attention.cu:
+  commit_tokens_kernel :276-330, tree-mask attention :43)
+
+Design notes (why this is NOT a kernel port):
+
+* The reference needs three distinct hand-written CUDA kernels because its
+  batches are token-flattened and its cache is indexed per token.  Here the
+  batch is row-oriented ``[R, C]`` (see serving/batch_config.py), so all
+  three modes share ONE attention path: scatter the chunk's K/V into each
+  row's cache slice with a vmapped dynamic_update_slice, then batched
+  einsums q@K^T -> mask -> softmax -> @V that XLA tiles onto the MXU.
+  The modes differ only in (a) RoPE position source, (b) the attention
+  mask, (c) the tree commit step — all data, not code paths.
+
+* GQA/MQA (num_q_heads != num_kv_heads, reference
+  inc_multihead_self_attention.cc:694-697) is a reshape of the query heads
+  to [KV, G] — no KV duplication in memory.
+
+* TP sharding: q/k/v/o weights and the cache's head dim are sharded over
+  the ``tp`` mesh axis by the InferenceManager; the contraction with wo
+  produces a partial sum that GSPMD all-reduces (the reference inserts an
+  explicit AllReduce op after attention, model.cc:3292).
+
+The cache lives in ``ctx.kv_cache[layer_name] = {"k","v"}: [R, S, KV, D]``;
+updated caches are written to ``ctx.kv_cache_out`` (functional update — the
+step fn donates the cache buffers so XLA updates them in place).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.initializers import DEFAULT_WEIGHT_INIT
+from ..core.tensor import TensorSpec
+from ..fftype import DataType, OpType
+from .attention_ops import apply_rotary_embedding
+from .registry import OpDef, ParamSpec, register
+
+NEG_INF = -1e30  # large-negative fill; -inf breaks softmax rows that are all masked
+
+
+def _scatter_chunk(cache, chunk, start):
+    """cache [R,S,KV,D] <- chunk [R,C,KV,D] at per-row offset start [R]."""
+
+    def upd(cache_row, chunk_row, s):
+        return jax.lax.dynamic_update_slice(
+            cache_row, chunk_row.astype(cache_row.dtype), (s, 0, 0))
+
+    return jax.vmap(upd)(cache, chunk, start)
+
+
+def _attend(q, cache_k, cache_v, mask, scale):
+    """q [R,C,H,D] vs cache [R,S,KV,D] with mask [R,C,S] -> [R,C,H,D].
+
+    H = KV * G; queries grouped so each KV head serves G query heads.
+    """
+    R, C, H, D = q.shape
+    KV = cache_k.shape[2]
+    G = H // KV
+    qg = q.reshape(R, C, KV, G, D)
+    logits = jnp.einsum("rckgd,rskd->rckgs", qg, cache_k,
+                        preferred_element_type=jnp.float32) * scale
+    logits = jnp.where(mask[:, :, None, None, :], logits, NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("rckgs,rskd->rckgd", probs.astype(cache_v.dtype), cache_v,
+                     preferred_element_type=jnp.float32)
+    return out.reshape(R, C, H, D).astype(q.dtype)
+
+
+class _ServingAttentionBase(OpDef):
+    """Shared qkv/o projection + cache plumbing for the three modes."""
+
+    def infer(self, attrs, in_specs):
+        (x,) = in_specs
+        return [TensorSpec(x.shape[:-1] + (attrs["embed_dim"],), x.dtype)]
+
+    def params(self, attrs, in_specs):
+        (x,) = in_specs
+        e = attrs["embed_dim"]
+        h = attrs["num_q_heads"]
+        kv = attrs["num_kv_heads"]
+        d = attrs.get("head_dim") or e // h
+        dt = x.dtype
+        init = attrs.get("kernel_initializer") or DEFAULT_WEIGHT_INIT
+        ps = [
+            ParamSpec("wq", (x.shape[-1], h, d), dt, init, fans=(x.shape[-1], h * d)),
+            ParamSpec("wk", (x.shape[-1], kv, d), dt, init, fans=(x.shape[-1], kv * d)),
+            ParamSpec("wv", (x.shape[-1], kv, d), dt, init, fans=(x.shape[-1], kv * d)),
+            ParamSpec("wo", (h, d, e), dt, init, fans=(h * d, e)),
+        ]
+        if attrs.get("qkv_bias", False):
+            ps += [ParamSpec("bq", (h, d), dt),
+                   ParamSpec("bk", (kv, d), dt),
+                   ParamSpec("bv", (kv, d), dt)]
+        if attrs.get("final_bias", False):
+            ps.append(ParamSpec("bo", (e,), dt))
+        return ps
+
+    def forward(self, params, inputs, attrs, ctx):
+        raise NotImplementedError(
+            f"{type(self).__name__} is a serving op: it needs a BatchConfig "
+            "and KV cache (use multihead_attention for training)")
+
+    # ------------------------------------------------------------ helpers
+    def _project_qkv(self, params, x, attrs):
+        q = jnp.einsum("rce,ehd->rchd", x, params["wq"].astype(x.dtype))
+        k = jnp.einsum("rce,ehd->rchd", x, params["wk"].astype(x.dtype))
+        v = jnp.einsum("rce,ehd->rchd", x, params["wv"].astype(x.dtype))
+        if attrs.get("qkv_bias", False):
+            q = q + params["bq"].astype(q.dtype)
+            k = k + params["bk"].astype(k.dtype)
+            v = v + params["bv"].astype(v.dtype)
+        return q, k, v
+
+    def _output(self, params, out, attrs):
+        y = jnp.einsum("rchd,hde->rce", out, params["wo"].astype(out.dtype))
+        if attrs.get("final_bias", False):
+            y = y + params["bo"].astype(y.dtype)
+        return y
+
+    def _scale(self, attrs):
+        d = attrs.get("head_dim") or attrs["embed_dim"] // attrs["num_q_heads"]
+        if not attrs.get("scaling_query", True):
+            return 1.0
+        sf = attrs.get("scaling_factor")
+        return sf if sf is not None else 1.0 / np.sqrt(d)
+
+    def _cache(self, ctx, layer_name):
+        cache = ctx.kv_cache[layer_name]
+        return cache["k"], cache["v"]
+
+    def _store(self, ctx, layer_name, ck, cv):
+        ctx.kv_cache_out[layer_name] = {"k": ck, "v": cv}
+
+
+@register
+class IncMultiHeadSelfAttention(_ServingAttentionBase):
+    """Incremental decoding attention (reference:
+    src/ops/inc_multihead_self_attention.{cc,cu}).
+
+    One op handles prompt phase and generation phase: the chunk is the
+    prompt slice during prefill (C=chunk bucket) and a single token during
+    decode (C=1 bucket).  Token c of row r sits at absolute position
+    first_depth[r]+c and attends cache positions s <= that.
+    """
+
+    type = OpType.INC_MULTIHEAD_SELF_ATTENTION
+
+    def inference(self, params, inputs, attrs, ctx):
+        (x,) = inputs  # [R, C, E]
+        bc = ctx.batch_config
+        layer = attrs["layer_name"]
+        R, C, _ = x.shape
+        q, k, v = self._project_qkv(params, x, attrs)
+        positions = bc["first_depth"][:, None] + jnp.arange(C)[None, :]
+        if attrs.get("rotary", True):
+            theta = attrs.get("rope_theta", 10000.0)
+            q = apply_rotary_embedding(q.swapaxes(1, 2), positions[:, None, :],
+                                       theta).swapaxes(1, 2)
+            k = apply_rotary_embedding(k.swapaxes(1, 2), positions[:, None, :],
+                                       theta).swapaxes(1, 2)
+        ck, cv = self._cache(ctx, layer)
+        ck = _scatter_chunk(ck, k, bc["first_depth"])
+        cv = _scatter_chunk(cv, v, bc["first_depth"])
+        self._store(ctx, layer, ck, cv)
+        S = ck.shape[1]
+        span = jnp.arange(S)[None, None, :]  # [1,1,S]
+        mask = (span <= positions[:, :, None]) & bc["active"][:, None, None]
+        out = _attend(q, ck, cv, mask, self._scale(attrs))
+        return [self._output(params, out, attrs)]
+
+    def flops(self, attrs, in_specs):
+        (x,) = in_specs
+        e = attrs["embed_dim"]
+        toks = int(np.prod(x.shape[:-1]))
+        return 2 * toks * x.shape[-1] * e * 4
+
+
+@register
+class SpecIncMultiHeadSelfAttention(IncMultiHeadSelfAttention):
+    """Beam-search (SSM-side) attention (reference:
+    src/ops/spec_inc_multihead_self_attention.cu).
+
+    Identical compute to the incremental op — the beam dimension is folded
+    into the request rows (BeamSearchBatchConfig.row), and beam-parent cache
+    shuffles happen once per step in the InferenceManager (gather of cache
+    rows by parent id) instead of the reference's per-kernel sub-request
+    indexing.
+    """
+
+    type = OpType.SPEC_INC_MULTIHEAD_SELF_ATTENTION
+
+
+@register
+class TreeIncMultiHeadSelfAttention(_ServingAttentionBase):
+    """Tree-verify attention (reference:
+    src/ops/tree_inc_multihead_self_attention.cu).
+
+    Two extra data inputs vs incremental mode:
+    - commit lists: before computing, move previously-speculated KV entries
+      to their committed positions (commit_tokens_kernel :276-330).  Here
+      that is a vmapped gather+scatter inside the same jit.
+    - tree mask: token c attends committed prefix (s < first_depth) plus its
+      in-batch ancestors (tree_mask[r, c, c']), the tree tokens living at
+      cache slots first_depth + c'.
+    RoPE uses the per-token tree depth (siblings share positions).
+    """
+
+    type = OpType.TREE_INC_MULTIHEAD_SELF_ATTENTION
+
+    @staticmethod
+    def _commit(cache, count, src, dst):
+        """Move verified speculative KV to committed slots.
+
+        cache [R,S,KV,D]; per row, for i < count: cache[dst[i]] = cache[src[i]].
+        Non-committed entries scatter to a dummy slot (S-1 overwritten later
+        by real tokens, but we drop instead via mode='drop' with dst=-1).
+        """
+
+        def row(cache_row, n, s_idx, d_idx):
+            vals = cache_row[s_idx]  # [C, KV, D] gather
+            d_safe = jnp.where(jnp.arange(s_idx.shape[0]) < n, d_idx, -1)
+            return cache_row.at[d_safe].set(vals, mode="drop")
+
+        return jax.vmap(row)(cache, count, src, dst)
+
+    def inference(self, params, inputs, attrs, ctx):
+        (x,) = inputs  # [R, C, E] — C = flattened tree slots
+        bc = ctx.batch_config
+        layer = attrs["layer_name"]
+        R, C, _ = x.shape
+        ck, cv = self._cache(ctx, layer)
+        # 1) commit verified tokens from the previous verify step
+        ck = self._commit(ck, bc["commit_count"], bc["commit_src"], bc["commit_dst"])
+        cv = self._commit(cv, bc["commit_count"], bc["commit_src"], bc["commit_dst"])
+        # 2) project + RoPE at tree depths
+        q, k, v = self._project_qkv(params, x, attrs)
+        depths = bc["token_depth"]  # [R, C]
+        if attrs.get("rotary", True):
+            theta = attrs.get("rope_theta", 10000.0)
+            q = apply_rotary_embedding(q.swapaxes(1, 2), depths[:, None, :],
+                                       theta).swapaxes(1, 2)
+            k = apply_rotary_embedding(k.swapaxes(1, 2), depths[:, None, :],
+                                       theta).swapaxes(1, 2)
+        # 3) stash tree K/V flat at [first_depth, first_depth+C)
+        ck = _scatter_chunk(ck, k, bc["first_depth"])
+        cv = _scatter_chunk(cv, v, bc["first_depth"])
+        self._store(ctx, layer, ck, cv)
+        # 4) mask: committed prefix + in-batch ancestors
+        S = ck.shape[1]
+        span = jnp.arange(S)[None, None, :]
+        committed = span < bc["first_depth"][:, None, None]  # [R,1->C,S]
+        # scatter tree_mask [R,C,C] into the S axis at first_depth offset
+        def place(tm_row, start):  # tm_row [C, C] -> [C, S]
+            full = jnp.zeros((C, S), bool)
+            return jax.lax.dynamic_update_slice(full, tm_row, (0, start))
+
+        intree = jax.vmap(place)(bc["tree_mask"], bc["first_depth"])
+        mask = (committed | intree) & bc["active"][:, None, None]
+        out = _attend(q, ck, cv, mask, self._scale(attrs))
+        return [self._output(params, out, attrs)]

@@ -1,0 +1,16 @@
+"""Serving stack: continuous batching + speculative decoding on TPU.
+
+TPU-native re-design of the reference's inference subsystem
+(src/runtime/request_manager.cc, inference_manager.cc, batch_config.cc —
+SURVEY.md §2.1 layers 6-7).
+"""
+
+from .batch_config import (BatchConfig, BeamInferenceResult,
+                           BeamSearchBatchConfig, InferenceResult,
+                           TreeVerifyBatchConfig)
+from .inference_manager import InferenceManager
+from .request_manager import (GenerationConfig, GenerationResult, ProfileInfo,
+                              Request, RequestManager, get_request_manager,
+                              reset_request_manager)
+from .tokenizer import (ByteTokenizer, GPT2BPETokenizer, HFTokenizersBackend,
+                        TransformersBackend, load_tokenizer)

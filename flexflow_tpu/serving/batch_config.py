@@ -1,0 +1,194 @@
+"""Batch configuration structs for the serving stack.
+
+TPU-native re-design of the reference's BatchConfig family
+(include/flexflow/batch_config.h:39-163, src/runtime/batch_config.cc,
+beam_search_batch_config.cc, tree_verify_batch_config.cc).
+
+Layout redesign (the load-bearing TPU decision): the reference flattens
+tokens into ``tokensInfo[MAX_NUM_TOKENS]`` with per-token request indices —
+natural for CUDA kernels that index arbitrarily.  On TPU arbitrary per-token
+gathers of the KV cache are HBM-bandwidth poison, so the device-side batch is
+**row-oriented**: ``[max_requests, chunk]`` where every request owns one row
+and a contiguous span of ``chunk`` token slots starting at its current depth.
+Attention then becomes a regular batched einsum of the row's queries against
+the row's KV-cache slice — no gather, MXU-friendly, and jit sees only two
+static shapes (chunk=1 decode bucket, chunk=C prefill bucket).
+
+The host-side struct below still exposes the reference's vocabulary
+(num_tokens, per-request first_token_depth / num_tokens_in_batch,
+request_completed) so RequestManager logic maps 1:1.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..fftype import InferenceMode
+
+
+class BatchConfig:
+    """One serving step's worth of work (reference batch_config.h:39).
+
+    Class-level maxima mirror the reference's compile-time constants
+    (batch_config.h:56-57); instances are host-side and cheap — the device
+    only ever sees the packed arrays from :meth:`pack`.
+    """
+
+    MAX_NUM_REQUESTS = 16
+    MAX_NUM_TOKENS = 1024
+
+    def __init__(self, max_requests: Optional[int] = None,
+                 chunk: int = 1):
+        self.max_requests = max_requests or self.MAX_NUM_REQUESTS
+        # chunk = tokens-per-row this step (shape bucket). 1 for pure decode.
+        self.chunk = chunk
+        R = self.max_requests
+        # per-request rows (reference PerRequestInfo, batch_config.h:66-72)
+        self.request_guid = np.full(R, -1, np.int64)
+        self.first_token_depth = np.zeros(R, np.int32)  # tokens already cached
+        self.num_tokens_in_batch = np.zeros(R, np.int32)
+        self.max_sequence_length = np.zeros(R, np.int32)
+        self.request_available = np.zeros(R, bool)  # slot occupied & running
+        # row-oriented token ids [R, chunk] (reference PerTokenInfo flattened)
+        self.token_ids = np.zeros((R, chunk), np.int32)
+
+    # ------------------------------------------------------------ queries
+    def get_mode(self) -> InferenceMode:
+        return InferenceMode.INC_DECODING
+
+    def num_active_requests(self) -> int:
+        return int(self.request_available.sum())
+
+    def num_active_tokens(self) -> int:
+        return int(self.num_tokens_in_batch.sum())
+
+    # ------------------------------------------------------------- device
+    def pack(self) -> Dict[str, np.ndarray]:
+        """Arrays shipped to the jitted step fn.  Everything static-shaped;
+        per-row positions are derived on device as first_token_depth +
+        arange(chunk)."""
+        return {
+            "token_ids": self.token_ids,
+            "first_depth": self.first_token_depth,
+            "row_tokens": self.num_tokens_in_batch,
+            "active": self.request_available,
+        }
+
+    def __repr__(self):
+        return (f"<{type(self).__name__} reqs={self.num_active_requests()} "
+                f"tokens={self.num_active_tokens()} chunk={self.chunk}>")
+
+
+class TreeVerifyBatchConfig(BatchConfig):
+    """Verify a speculated token tree against the big model (reference
+    batch_config.h:85-102, tree_verify_batch_config.cc).
+
+    Per-row, the chunk holds the flattened token tree (DFS order).  Device
+    extras vs BatchConfig:
+
+    - ``tree_mask[R, chunk, chunk]``: ancestor mask — token c may attend
+      in-batch token c' iff c' is on c's root-path (includes itself).  The
+      reference encodes this via ``causalMask`` bitmasks built in
+      prepare_next_batch_verify; we build the dense boolean mask host-side
+      (chunk is small) and let the attention kernel consume it directly.
+    - ``token_depth[R, chunk]``: absolute depth per tree token (NOT
+      first_depth + arange, since siblings share a depth).
+    - commit lists: verified tokens from the *previous* step whose KV must be
+      moved from their speculative cache slots to their committed positions
+      (reference committed_tokens / commit_tokens_kernel,
+      tree_inc_multihead_self_attention.cu:276-330).
+    """
+
+    def __init__(self, max_requests: Optional[int] = None, chunk: int = 64):
+        super().__init__(max_requests, chunk)
+        R = self.max_requests
+        self.token_depth = np.zeros((R, chunk), np.int32)
+        self.tree_mask = np.zeros((R, chunk, chunk), bool)
+        # commit: per row, up to chunk tokens to persist
+        self.num_tokens_to_commit = np.zeros(R, np.int32)
+        self.commit_src_index = np.zeros((R, chunk), np.int32)  # prev cache slot
+        self.commit_dst_depth = np.zeros((R, chunk), np.int32)  # final position
+
+    def get_mode(self) -> InferenceMode:
+        return InferenceMode.TREE_VERIFY
+
+    def pack(self) -> Dict[str, np.ndarray]:
+        d = super().pack()
+        d.update(
+            token_depth=self.token_depth,
+            tree_mask=self.tree_mask,
+            commit_count=self.num_tokens_to_commit,
+            commit_src=self.commit_src_index,
+            commit_dst=self.commit_dst_depth,
+        )
+        return d
+
+
+class BeamSearchBatchConfig(BatchConfig):
+    """SSM beam-expansion step (reference batch_config.h:109-155).
+
+    The SSM keeps ``beam_width`` live hypotheses per request.  Device layout:
+    rows are (request, beam) pairs — request r's beam b lives in row
+    r * beam_width + b, so the plain row-oriented attention kernel works
+    unchanged; each beam owns its own KV-cache row (the reference instead
+    sub-indexes one request's cache by sub_request_id,
+    spec_inc_multihead_self_attention.cu).
+
+    Beam bookkeeping (parent ids, cumulative log-probs) mirrors
+    BeamSearchPerRequestInfo (batch_config.h:122-139) and is carried
+    host-side between steps by the RequestManager.
+    """
+
+    MAX_BEAM_WIDTH = 3
+    MAX_BEAM_DEPTH = 8
+
+    def __init__(self, max_requests: Optional[int] = None, chunk: int = 1,
+                 beam_width: int = 1, model_id: int = 0):
+        # NOTE: max_requests here means *logical* requests; rows = R * W.
+        logical = max_requests or self.MAX_NUM_REQUESTS
+        self.beam_width = beam_width
+        self.model_id = model_id
+        super().__init__(logical * beam_width, chunk)
+        self.logical_requests = logical
+        R = self.max_requests
+        # per-row beam metadata
+        self.beam_log_prob = np.zeros(R, np.float32)
+        self.parent_id = np.zeros(R, np.int32)
+        self.current_depth = np.zeros(R, np.int32)  # beam tree depth
+
+    def get_mode(self) -> InferenceMode:
+        return InferenceMode.BEAM_SEARCH
+
+    def row(self, request_index: int, beam_index: int) -> int:
+        return request_index * self.beam_width + beam_index
+
+    def pack(self) -> Dict[str, np.ndarray]:
+        d = super().pack()
+        d["beam_log_prob"] = self.beam_log_prob
+        return d
+
+
+@dataclasses.dataclass
+class InferenceResult:
+    """Sampled next-token ids per (row, position) (reference
+    batch_config.h:104-107 InferenceResult.token_ids).  ``probs``/``logits``
+    carried for verification paths."""
+
+    token_ids: np.ndarray  # [R, chunk] int32
+    probs: Optional[np.ndarray] = None  # [R, chunk] float32 prob of sampled id
+    topk_ids: Optional[np.ndarray] = None  # [R, chunk, k]
+    topk_probs: Optional[np.ndarray] = None
+
+
+@dataclasses.dataclass
+class BeamInferenceResult:
+    """Beam expansion result (reference batch_config.h:157-163): top
+    ``beam_width`` candidate ids + probs per row."""
+
+    token_ids: np.ndarray  # [R, chunk, beam_width]
+    probs: np.ndarray  # [R, chunk, beam_width]
+    parent_id: np.ndarray  # [R, chunk, beam_width]

@@ -1,0 +1,218 @@
+"""InferenceManager: compile a model for serving and drive per-step inference.
+
+TPU-native re-design of the reference's InferenceManager
+(src/runtime/inference_manager.cc):
+
+- ``compile_model_and_allocate_buffer`` (reference :81-224) there replicates
+  per-op output tensors per in-flight batch and assigns pipeline-stage
+  MachineViews.  Here it (a) builds the serving mesh, (b) shards the weights
+  with NamedShardings derived from per-layer TP annotations (replacing the
+  reference's auto-inserted Replicate/AllReduce/Combine parallel ops,
+  model.cc:3243-3296 — GSPMD inserts the actual collectives), (c) allocates
+  the per-layer KV caches, and (d) jit-compiles one step function per
+  (mode, chunk) shape bucket — the bucket table replaces Legion tracing.
+
+- ``inference(model, batch_config)`` (reference :290-348 walks ops calling
+  op->inference) here packs the BatchConfig to device arrays and calls the
+  bucketed step fn; cache buffers are donated so XLA updates them in place.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from ..config import AXIS_DATA, AXIS_MODEL, AXIS_PIPE, FFConfig
+from ..fftype import InferenceMode, OpType
+from ..ops.registry import OpContext, get_op
+from .batch_config import (BatchConfig, BeamSearchBatchConfig,
+                           InferenceResult, TreeVerifyBatchConfig)
+
+SERVING_ATTENTION_OPS = (
+    OpType.INC_MULTIHEAD_SELF_ATTENTION,
+    OpType.SPEC_INC_MULTIHEAD_SELF_ATTENTION,
+    OpType.TREE_INC_MULTIHEAD_SELF_ATTENTION,
+)
+
+
+def _param_pspecs(model) -> Dict[str, Dict[str, PartitionSpec]]:
+    """Per-parameter PartitionSpecs from layer TP annotations.
+
+    The reference decides TP sharding with hard-coded insertion rules
+    (model.cc:3243-3296: Replicate after embedding, AllReduce after
+    attention and FFN second linear, Combine before the head).  We make the
+    equivalent knowledge explicit: serving attention shards its head dims;
+    Linear layers carry a ``shard`` attr ("col" | "row" | "replicate") set
+    by the model builders; everything else is replicated.
+    """
+    specs: Dict[str, Dict[str, PartitionSpec]] = {}
+    for layer in model.layers:
+        if not layer.param_specs:
+            continue
+        lspec = {}
+        if layer.op_type in SERVING_ATTENTION_OPS:
+            for ps in layer.param_specs:
+                if ps.name in ("wq", "wk", "wv"):
+                    lspec[ps.name] = PartitionSpec(None, AXIS_MODEL, None)
+                elif ps.name == "wo":
+                    lspec[ps.name] = PartitionSpec(AXIS_MODEL, None, None)
+                elif ps.name in ("bq", "bk", "bv"):
+                    lspec[ps.name] = PartitionSpec(AXIS_MODEL, None)
+                else:  # bo
+                    lspec[ps.name] = PartitionSpec(None)
+        elif layer.op_type is OpType.LINEAR:
+            shard = layer.attrs.get("shard", "replicate")
+            for ps in layer.param_specs:
+                if ps.name == "kernel":
+                    lspec[ps.name] = {
+                        "col": PartitionSpec(None, AXIS_MODEL),
+                        "row": PartitionSpec(AXIS_MODEL, None),
+                        "replicate": PartitionSpec(None, None),
+                    }[shard]
+                else:  # bias — sharded only under col parallelism
+                    lspec[ps.name] = (PartitionSpec(AXIS_MODEL)
+                                      if shard == "col" else PartitionSpec(None))
+        else:
+            for ps in layer.param_specs:
+                lspec[ps.name] = PartitionSpec(*([None] * len(ps.shape)))
+        specs[layer.name] = lspec
+    return specs
+
+
+class InferenceManager:
+    """Compiles models for serving and runs per-step inference
+    (reference: include/flexflow/request_manager.h:31 InferenceManager)."""
+
+    def __init__(self, config: Optional[FFConfig] = None):
+        self.config = config or FFConfig()
+        self.mesh: Optional[Mesh] = None
+        self.models: Dict[int, Dict[str, Any]] = {}  # model_id -> record
+
+    # ------------------------------------------------------------ compile
+    def compile_model_and_allocate_buffer(
+            self, model, mode: InferenceMode = InferenceMode.INC_DECODING,
+            max_requests: int = 16, max_seq_length: int = 1024,
+            prefill_chunk: int = 256, beam_width: int = 1,
+            cache_dtype=None, model_id: Optional[int] = None) -> int:
+        """Returns a model_id handle.  reference: inference_manager.cc:81."""
+        cfg = model.config
+        tp = cfg.tensor_parallelism_degree
+        if self.mesh is None and tp > 1:
+            self.mesh = cfg.make_mesh([AXIS_MODEL])
+        mesh = self.mesh if tp > 1 else None
+        model.mesh = mesh
+
+        rows = max_requests * beam_width
+        # nominal graph-build sanity: model builders created tokens [R, C]
+        cache_dtype = cache_dtype or jnp.dtype(cfg.computation_dtype)
+
+        # parameters: init if absent, then shard
+        if model.params is None:
+            rng = jax.random.PRNGKey(cfg.seed)
+            model.params = model.init_params(rng)
+        pspecs = _param_pspecs(model)
+        if mesh is not None:
+            model.params = {
+                ln: {pn: jax.device_put(v, NamedSharding(mesh, pspecs[ln][pn]))
+                     for pn, v in lp.items()}
+                for ln, lp in model.params.items()}
+
+        # KV caches per serving-attention layer (reference: allocated in
+        # attention init, inc_multihead_self_attention.cu:1226+)
+        caches = {}
+        cache_sharding = (NamedSharding(mesh, PartitionSpec(None, None, AXIS_MODEL, None))
+                          if mesh is not None else None)
+        for layer in model.layers:
+            if layer.op_type in SERVING_ATTENTION_OPS:
+                a = layer.attrs
+                kv = a["num_kv_heads"]
+                d = a.get("head_dim") or a["embed_dim"] // a["num_q_heads"]
+                shape = (rows, max_seq_length, kv, d)
+                k = jnp.zeros(shape, cache_dtype)
+                v = jnp.zeros(shape, cache_dtype)
+                if cache_sharding is not None:
+                    k = jax.device_put(k, cache_sharding)
+                    v = jax.device_put(v, cache_sharding)
+                caches[layer.name] = {"k": k, "v": v}
+
+        mid = model_id if model_id is not None else len(self.models)
+        record = dict(model=model, mode=mode, mesh=mesh, caches=caches,
+                      max_requests=max_requests, rows=rows,
+                      max_seq_length=max_seq_length, beam_width=beam_width,
+                      prefill_chunk=prefill_chunk, steps={}, pspecs=pspecs)
+        self.models[mid] = record
+        return mid
+
+    # --------------------------------------------------------------- step
+    def _build_step(self, record, chunk: int, reorder: bool):
+        model = record["model"]
+        input_names = [t.name for t in model.input_tensors]
+
+        def step(params, caches, batch, rng):
+            if reorder:  # beam-parent cache shuffle (spec decoding)
+                parents = batch["parent_rows"]
+                caches = jax.tree.map(lambda c: c[parents], caches)
+            ctx = OpContext(training=False, rng=rng, batch_config=batch,
+                            kv_cache=caches, kv_cache_out={},
+                            mesh=record["mesh"], extra_outputs={})
+            feeds = {}
+            C = batch["token_ids"].shape[1]
+            for name in input_names:
+                if name == "tokens":
+                    feeds[name] = batch["token_ids"]
+                elif name == "positions":
+                    feeds[name] = (batch["first_depth"][:, None]
+                                   + jnp.arange(C)[None, :])
+                else:
+                    raise ValueError(f"unknown serving input {name!r}")
+            vals = model.run_layers(params, feeds, ctx, inference=True)
+            final = model.layers[-1]
+            outs = [vals[(final.name, i)] for i in range(len(final.outputs))]
+            new_caches = {**caches, **ctx.kv_cache_out}
+            return outs, new_caches
+
+        return jax.jit(step, donate_argnums=(1,))
+
+    def _get_step(self, record, chunk: int, reorder: bool):
+        key = (chunk, reorder)
+        if key not in record["steps"]:
+            record["steps"][key] = self._build_step(record, chunk, reorder)
+        return record["steps"][key]
+
+    def pick_chunk(self, record, needed: int) -> int:
+        """Smallest shape bucket covering `needed` tokens per row."""
+        if needed <= 1:
+            return 1
+        c = record["prefill_chunk"]
+        return min(c, max(8, 1 << (needed - 1).bit_length()))
+
+    def inference(self, model_id: int, bc: BatchConfig,
+                  rng=None, parent_rows: Optional[np.ndarray] = None
+                  ) -> List[Any]:
+        """Run one serving step (reference: inference_manager.cc:290).
+
+        Returns the final layer's outputs as device arrays (sampling heads →
+        token ids / probs); cache updates are kept internally.
+        """
+        record = self.models[model_id]
+        batch = {k: jnp.asarray(v) for k, v in bc.pack().items()}
+        reorder = parent_rows is not None
+        if reorder:
+            batch["parent_rows"] = jnp.asarray(parent_rows)
+        if rng is None:
+            rng = jax.random.PRNGKey(0)
+        step = self._get_step(record, bc.chunk, reorder)
+        outs, record["caches"] = step(record["model"].params,
+                                      record["caches"], batch, rng)
+        return outs
+
+    def reset_request_rows(self, model_id: int, rows: List[int]):
+        """Zero cache bookkeeping for retired rows.  Cache contents need no
+        clearing — the attention mask never reads past a row's depth."""
+        # intentionally a no-op at the cache level; kept for API parity with
+        # the reference's free-slot reuse (request_manager.cc:339-470)
+        return None

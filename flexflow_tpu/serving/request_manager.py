@@ -1,0 +1,287 @@
+"""RequestManager: request queue + continuous batching control loop.
+
+TPU-native re-design of the reference's RequestManager
+(src/runtime/request_manager.cc, include/flexflow/request_manager.h:88):
+
+- ``register_new_request`` (reference :178-234): tokenize prompt, queue.
+- ``prepare_next_batch`` (reference :339-470): append last step's sampled
+  tokens, retire EOS/max-length requests, admit pending requests into free
+  row slots, emit the next BatchConfig.  The reference emits token-flattened
+  metadata; we emit the row-oriented batch (serving/batch_config.py) and
+  additionally choose the *shape bucket*: chunk=1 when every active row is
+  decoding, chunk=C while any row is still prefilling (chunked prefill — the
+  reference caps prompt tokens per step the same way via
+  get_max_tokens_per_batch, request_manager.cc:456-462).
+- ``generate_incr_decoding`` (reference :1927-1981): the steady-state loop.
+  The reference keeps ≤4 batches in flight on Legion futures; here JAX async
+  dispatch overlaps host batch-prep with device compute — the host only
+  blocks on the small sampled-token array of the *previous* step.
+
+Speculative decoding (generate_spec_infer, beam expansion + tree verify)
+lives in spec_infer.py and reuses this queue/slot machinery.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Dict, List, Optional, Sequence
+
+import jax
+import numpy as np
+
+from ..fftype import InferenceMode
+from .batch_config import BatchConfig, InferenceResult
+from .inference_manager import InferenceManager
+
+
+@dataclasses.dataclass
+class GenerationConfig:
+    """Sampling settings (reference: include/flexflow/inference.h
+    GenerationConfig)."""
+
+    do_sample: bool = False
+    temperature: float = 0.9
+    topp: float = 0.8
+    topk: int = 1
+
+
+@dataclasses.dataclass
+class GenerationResult:
+    """reference: GenerationResult (include/flexflow/inference.h)."""
+
+    guid: int
+    input_text: str
+    input_tokens: List[int]
+    output_text: str
+    output_tokens: List[int]
+
+
+@dataclasses.dataclass
+class ProfileInfo:
+    """Per-request latency profile (reference request_manager.h:244-250,
+    dumped at request_manager.cc:404-441)."""
+
+    llm_decoding_steps: int = 0
+    ssm_decoding_steps: int = 0
+    speculated_tokens: int = 0
+    accepted_tokens: int = 0
+    start_time: float = 0.0
+    finish_time: float = 0.0
+
+
+class Request:
+    """One in-flight generation request (reference request_manager.h:52)."""
+
+    PENDING, RUNNING, COMPLETED = range(3)
+
+    def __init__(self, guid: int, prompt: str, tokens: List[int],
+                 max_new_tokens: int, max_sequence_length: int):
+        self.guid = guid
+        self.prompt = prompt
+        self.tokens = list(tokens)          # prompt + generated so far
+        self.prompt_len = len(tokens)
+        self.max_new_tokens = max_new_tokens
+        self.max_sequence_length = max_sequence_length
+        self.status = Request.PENDING
+        self.row: Optional[int] = None      # batch slot while RUNNING
+        self.cached_len = 0                 # tokens whose KV is committed
+        self.profile = ProfileInfo(start_time=time.time())
+
+
+class RequestManager:
+    """Singleton-style manager (reference request_manager.cc:2075 —
+    instantiable here; `get_request_manager()` returns a process-wide one)."""
+
+    def __init__(self, max_requests_per_batch: int = 8,
+                 max_tokens_per_batch: int = 256,
+                 max_sequence_length: int = 1024,
+                 max_spec_tree_token_num: int = 64):
+        self.max_requests_per_batch = max_requests_per_batch
+        self.max_tokens_per_batch = max_tokens_per_batch
+        self.max_sequence_length = max_sequence_length
+        self.max_spec_tree_token_num = max_spec_tree_token_num
+        self.tokenizer = None
+        self.eos_token_id: Optional[int] = None
+        self.bos_token_id: Optional[int] = None
+        self.add_bos_token = True
+        self.pending: List[Request] = []
+        self.running: Dict[int, Request] = {}   # row -> Request
+        self.completed: Dict[int, Request] = {}
+        self.next_guid = 1000000
+        self.next_available_guid = self.next_guid
+        self.ssm_model_ids: List[int] = []
+        self._rng = np.random.default_rng(0)
+
+    # -------------------------------------------------------------- setup
+    def register_tokenizer(self, tokenizer, eos_token_id=None,
+                           bos_token_id=None, add_bos_token=True):
+        """reference: register_tokenizer (request_manager.cc — model type +
+        bos/eos wiring)."""
+        self.tokenizer = tokenizer
+        self.eos_token_id = (eos_token_id if eos_token_id is not None
+                             else getattr(tokenizer, "eos_token_id", None))
+        self.bos_token_id = (bos_token_id if bos_token_id is not None
+                             else getattr(tokenizer, "bos_token_id", None))
+        self.add_bos_token = add_bos_token
+
+    def register_ssm_model(self, model_id: int):
+        """reference: register_ssm_model (request_manager.cc)."""
+        self.ssm_model_ids.append(model_id)
+
+    # ------------------------------------------------------------ requests
+    def register_new_request(self, prompt, max_new_tokens: int = 128,
+                             max_sequence_length: Optional[int] = None
+                             ) -> Request:
+        """Tokenize + queue (reference: request_manager.cc:178-234)."""
+        if isinstance(prompt, str):
+            assert self.tokenizer is not None, "no tokenizer registered"
+            tokens = list(self.tokenizer.encode(prompt))
+            if (self.add_bos_token and self.bos_token_id is not None
+                    and (not tokens or tokens[0] != self.bos_token_id)):
+                tokens = [self.bos_token_id] + tokens
+            text = prompt
+        else:
+            tokens = list(prompt)
+            text = ""
+        max_len = max_sequence_length or self.max_sequence_length
+        if len(tokens) >= max_len:
+            tokens = tokens[: max_len - 1]
+        req = Request(self.next_available_guid, text, tokens,
+                      max_new_tokens, max_len)
+        self.next_available_guid += 1
+        self.pending.append(req)
+        return req
+
+    # ------------------------------------------------------- batch update
+    def _free_rows(self) -> List[int]:
+        return [r for r in range(self.max_requests_per_batch)
+                if r not in self.running]
+
+    def _finished(self, req: Request, new_token: int) -> bool:
+        produced = len(req.tokens) - req.prompt_len
+        if self.eos_token_id is not None and new_token == self.eos_token_id:
+            return True
+        return (produced >= req.max_new_tokens
+                or len(req.tokens) >= min(req.max_sequence_length,
+                                          self.max_sequence_length))
+
+    def _retire(self, req: Request):
+        req.status = Request.COMPLETED
+        req.profile.finish_time = time.time()
+        del self.running[req.row]
+        self.completed[req.guid] = req
+        req.row = None
+
+    def prepare_next_batch(self, prev_bc: Optional[BatchConfig],
+                           prev_result: Optional[InferenceResult]
+                           ) -> Optional[BatchConfig]:
+        """Core continuous-batching update (reference semantics of
+        request_manager.cc:339-470).  Returns None when nothing to run."""
+        # 1) fold in last step's results: append sampled tokens where the
+        #    row finished its scheduled span; retire done requests
+        if prev_bc is not None and prev_result is not None:
+            for row in list(self.running):
+                req = self.running[row]
+                n = int(prev_bc.num_tokens_in_batch[row])
+                if n == 0:
+                    continue
+                req.cached_len += n
+                req.profile.llm_decoding_steps += 1
+                if req.cached_len >= len(req.tokens):
+                    # the sample at the span's last column is the next token
+                    tok = int(prev_result.token_ids[row, n - 1])
+                    req.tokens.append(tok)
+                    if self._finished(req, tok):
+                        self._retire(req)
+
+        # 2) admit pending requests into free rows
+        for row in self._free_rows():
+            if not self.pending:
+                break
+            req = self.pending.pop(0)
+            req.status = Request.RUNNING
+            req.row = row
+            req.cached_len = 0
+            self.running[row] = req
+
+        if not self.running:
+            return None
+
+        # 3) choose the shape bucket: decode-only -> chunk 1; else prefill
+        needs_prefill = any(len(r.tokens) - r.cached_len > 1
+                            for r in self.running.values())
+        chunk = 1
+        if needs_prefill:
+            budget = max(2, self.max_tokens_per_batch
+                         // max(1, len(self.running)))
+            chunk = min(budget, self.max_tokens_per_batch)
+
+        bc = BatchConfig(self.max_requests_per_batch, chunk)
+        for row, req in self.running.items():
+            remaining = len(req.tokens) - req.cached_len
+            n = min(remaining, chunk)
+            span = req.tokens[req.cached_len: req.cached_len + n]
+            bc.request_guid[row] = req.guid
+            bc.first_token_depth[row] = req.cached_len
+            bc.num_tokens_in_batch[row] = n
+            bc.max_sequence_length[row] = req.max_sequence_length
+            bc.request_available[row] = True
+            bc.token_ids[row, :n] = span
+        return bc
+
+    # ----------------------------------------------------------- generate
+    def generate_incr_decoding(self, im: InferenceManager, model_id: int,
+                               requests: Sequence[Request],
+                               seed: int = 0) -> List[GenerationResult]:
+        """Incremental-decoding driver loop (reference:
+        request_manager.cc:1927-1981)."""
+        rng = jax.random.PRNGKey(seed)
+        bc, result = None, None
+        step = 0
+        while True:
+            bc = self.prepare_next_batch(bc, result)
+            if bc is None:
+                break
+            rng, step_rng = jax.random.split(rng)
+            outs = im.inference(model_id, bc, rng=step_rng)
+            # final layer is a sampling head emitting [R, C] token ids
+            result = InferenceResult(token_ids=np.asarray(outs[0]))
+            step += 1
+        return [self._result_of(r) for r in requests]
+
+    def generate(self, im: InferenceManager, model_id: int,
+                 prompts: Sequence[str], max_new_tokens: int = 128,
+                 seed: int = 0) -> List[GenerationResult]:
+        """reference: FFModel::generate (request_manager.cc:1914)."""
+        reqs = [self.register_new_request(p, max_new_tokens) for p in prompts]
+        if self.ssm_model_ids:
+            from .spec_infer import generate_spec_infer
+            return generate_spec_infer(self, im, model_id, reqs, seed=seed)
+        return self.generate_incr_decoding(im, model_id, reqs, seed=seed)
+
+    def _result_of(self, req: Request) -> GenerationResult:
+        out_tokens = req.tokens[req.prompt_len:]
+        # strip trailing EOS from text output
+        text_tokens = [t for t in out_tokens if t != self.eos_token_id]
+        text = (self.tokenizer.decode(text_tokens)
+                if self.tokenizer is not None else "")
+        return GenerationResult(req.guid, req.prompt,
+                                req.tokens[: req.prompt_len], text, out_tokens)
+
+
+_GLOBAL_RM: Optional[RequestManager] = None
+
+
+def get_request_manager(**kwargs) -> RequestManager:
+    """Process-wide manager (reference: RequestManager::get_request_manager,
+    request_manager.cc:2075)."""
+    global _GLOBAL_RM
+    if _GLOBAL_RM is None:
+        _GLOBAL_RM = RequestManager(**kwargs)
+    return _GLOBAL_RM
+
+
+def reset_request_manager():
+    global _GLOBAL_RM
+    _GLOBAL_RM = None

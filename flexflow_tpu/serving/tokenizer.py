@@ -1,0 +1,170 @@
+"""Tokenizer layer for the serving stack.
+
+TPU-native equivalent of the reference's tokenizer stack: the standalone
+GPT-2-style BPE (src/runtime/gpt_tokenizer.cc:36-83, used for OPT) plus the
+tokenizers-cpp dependency for LLaMA/SentencePiece (request_manager.h:22-29).
+
+We provide a uniform interface — ``encode(str) -> List[int]``,
+``decode(List[int]) -> str``, ``bos/eos_token_id`` — over three backends:
+
+1. HF ``tokenizers`` Rust library (tokenizer.json files) — covers every
+   model family the reference serves;
+2. HF ``transformers`` tokenizer objects (duck-typed passthrough);
+3. a pure-Python GPT-2 byte-level BPE (the reference's gpt_tokenizer.cc
+   re-implemented from the algorithm, for vocab.json+merges.txt caches);
+4. ``ByteTokenizer``: deterministic 256-way byte vocab for tests.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import List, Optional, Sequence
+
+
+class TokenizerBase:
+    bos_token_id: Optional[int] = None
+    eos_token_id: Optional[int] = None
+
+    def encode(self, text: str) -> List[int]:
+        raise NotImplementedError
+
+    def decode(self, ids: Sequence[int]) -> str:
+        raise NotImplementedError
+
+
+class HFTokenizersBackend(TokenizerBase):
+    """Wraps a tokenizers.Tokenizer (tokenizer.json)."""
+
+    def __init__(self, path: str, bos_token_id=None, eos_token_id=None):
+        from tokenizers import Tokenizer
+
+        self.tok = Tokenizer.from_file(path)
+        self.bos_token_id = bos_token_id
+        self.eos_token_id = eos_token_id
+
+    def encode(self, text: str) -> List[int]:
+        return self.tok.encode(text, add_special_tokens=False).ids
+
+    def decode(self, ids: Sequence[int]) -> str:
+        return self.tok.decode(list(ids), skip_special_tokens=True)
+
+
+class TransformersBackend(TokenizerBase):
+    """Wraps a transformers PreTrainedTokenizer(Fast)."""
+
+    def __init__(self, tok):
+        self.tok = tok
+        self.bos_token_id = getattr(tok, "bos_token_id", None)
+        self.eos_token_id = getattr(tok, "eos_token_id", None)
+
+    def encode(self, text: str) -> List[int]:
+        return self.tok.encode(text, add_special_tokens=False)
+
+    def decode(self, ids: Sequence[int]) -> str:
+        return self.tok.decode(list(ids), skip_special_tokens=True)
+
+
+def _bytes_to_unicode():
+    """GPT-2 byte<->unicode table (reference gpt_tokenizer.cc
+    bytes_to_unicode)."""
+    bs = (list(range(ord("!"), ord("~") + 1))
+          + list(range(ord("\xa1"), ord("\xac") + 1))
+          + list(range(ord("\xae"), ord("\xff") + 1)))
+    cs = bs[:]
+    n = 0
+    for b in range(256):
+        if b not in bs:
+            bs.append(b)
+            cs.append(256 + n)
+            n += 1
+    return dict(zip(bs, [chr(c) for c in cs]))
+
+
+class GPT2BPETokenizer(TokenizerBase):
+    """Byte-level BPE from vocab.json + merges.txt (reference:
+    src/runtime/gpt_tokenizer.cc — same algorithm, clean implementation)."""
+
+    def __init__(self, vocab_file: str, merges_file: str,
+                 bos_token_id=None, eos_token_id=None):
+        import regex
+
+        with open(vocab_file) as f:
+            self.encoder = json.load(f)
+        self.decoder = {v: k for k, v in self.encoder.items()}
+        with open(merges_file, encoding="utf-8") as f:
+            merges = [tuple(line.split()) for line in f.read().split("\n")
+                      if line and not line.startswith("#version")]
+        self.bpe_ranks = dict(zip(merges, range(len(merges))))
+        self.byte_encoder = _bytes_to_unicode()
+        self.byte_decoder = {v: k for k, v in self.byte_encoder.items()}
+        self.pat = regex.compile(
+            r"'s|'t|'re|'ve|'m|'ll|'d| ?\p{L}+| ?\p{N}+"
+            r"| ?[^\s\p{L}\p{N}]+|\s+(?!\S)|\s+")
+        self.cache = {}
+        self.bos_token_id = bos_token_id
+        self.eos_token_id = eos_token_id
+
+    def _bpe(self, token: str) -> List[str]:
+        if token in self.cache:
+            return self.cache[token]
+        word = list(token)
+        while len(word) > 1:
+            pairs = {(word[i], word[i + 1]) for i in range(len(word) - 1)}
+            best = min(pairs, key=lambda p: self.bpe_ranks.get(p, 1 << 30))
+            if best not in self.bpe_ranks:
+                break
+            first, second = best
+            out, i = [], 0
+            while i < len(word):
+                if (i < len(word) - 1 and word[i] == first
+                        and word[i + 1] == second):
+                    out.append(first + second)
+                    i += 2
+                else:
+                    out.append(word[i])
+                    i += 1
+            word = out
+        self.cache[token] = word
+        return word
+
+    def encode(self, text: str) -> List[int]:
+        ids = []
+        for tok in self.pat.findall(text):
+            mapped = "".join(self.byte_encoder[b] for b in tok.encode("utf-8"))
+            ids.extend(self.encoder[t] for t in self._bpe(mapped))
+        return ids
+
+    def decode(self, ids: Sequence[int]) -> str:
+        text = "".join(self.decoder[i] for i in ids if i in self.decoder)
+        data = bytes(self.byte_decoder[c] for c in text if c in self.byte_decoder)
+        return data.decode("utf-8", errors="replace")
+
+
+class ByteTokenizer(TokenizerBase):
+    """256-way byte vocab + reserved specials; deterministic, for tests."""
+
+    def __init__(self, bos_token_id=256, eos_token_id=257):
+        self.bos_token_id = bos_token_id
+        self.eos_token_id = eos_token_id
+        self.vocab_size = 258
+
+    def encode(self, text: str) -> List[int]:
+        return list(text.encode("utf-8"))
+
+    def decode(self, ids: Sequence[int]) -> str:
+        return bytes(i for i in ids if i < 256).decode("utf-8", "replace")
+
+
+def load_tokenizer(model_path: str, bos_token_id=None,
+                   eos_token_id=None) -> TokenizerBase:
+    """Pick a backend from files in a model directory (reference:
+    request_manager register_tokenizer dispatch on model type)."""
+    tj = os.path.join(model_path, "tokenizer.json")
+    if os.path.exists(tj):
+        return HFTokenizersBackend(tj, bos_token_id, eos_token_id)
+    vj = os.path.join(model_path, "vocab.json")
+    mt = os.path.join(model_path, "merges.txt")
+    if os.path.exists(vj) and os.path.exists(mt):
+        return GPT2BPETokenizer(vj, mt, bos_token_id, eos_token_id)
+    raise FileNotFoundError(f"no tokenizer files under {model_path}")
