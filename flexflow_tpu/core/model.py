@@ -357,6 +357,12 @@ class Model:
                            scaling_factor, qk_prod_scaling, position_bias,
                            rope_theta, name):
         head_dim = (kdim or embed_dim // num_q_heads)
+        if vdim not in (0, head_dim):
+            raise NotImplementedError(
+                f"serving attention requires vdim == kdim == head_dim "
+                f"({head_dim}); got vdim={vdim} (the reference has the same "
+                f"constraint in practice: kProjSize == vProjSize across "
+                f"inference/models/*)")
         return self._add_layer(op_type, [input], dict(
             embed_dim=embed_dim, num_q_heads=num_q_heads,
             num_kv_heads=num_kv_heads, head_dim=head_dim, dropout=dropout,

@@ -62,10 +62,12 @@ def _scatter_chunk(cache, chunk, start):
     return jax.vmap(upd)(cache, chunk, start)
 
 
-def _attend(q, cache_k, cache_v, mask, scale):
+def _attend(q, cache_k, cache_v, mask, scale, alibi=None):
     """q [R,C,H,D] vs cache [R,S,KV,D] with mask [R,C,S] -> [R,C,H,D].
 
     H = KV * G; queries grouped so each KV head serves G query heads.
+    ``alibi``: optional (slopes[H], positions[R,C]) pair adding the MPT
+    position bias slope_h * (s - q_pos) to the logits.
     """
     R, C, H, D = q.shape
     KV = cache_k.shape[2]
@@ -73,6 +75,13 @@ def _attend(q, cache_k, cache_v, mask, scale):
     qg = q.reshape(R, C, KV, G, D)
     logits = jnp.einsum("rckgd,rskd->rckgs", qg, cache_k,
                         preferred_element_type=jnp.float32) * scale
+    if alibi is not None:
+        slopes, positions = alibi
+        S = cache_k.shape[1]
+        rel = (jnp.arange(S)[None, None, :]
+               - positions[:, :, None]).astype(jnp.float32)  # [R,C,S]
+        bias = slopes.reshape(1, 1, KV, G, 1) * rel[:, :, None, None, :]
+        logits = logits + bias
     logits = jnp.where(mask[:, :, None, None, :], logits, NEG_INF)
     probs = jax.nn.softmax(logits, axis=-1)
     out = jnp.einsum("rckgs,rskd->rckgd", probs.astype(cache_v.dtype), cache_v,
@@ -132,11 +141,28 @@ class _ServingAttentionBase(OpDef):
         return y
 
     def _scale(self, attrs):
+        """Logit scale (reference inc_multihead_self_attention.cu:718):
+        qk_prod_scaling gates the 1/sqrt(d) factor; scaling_query/
+        scaling_factor independently pre-scale Q (composed here since both
+        are scalar multiplies on the logits)."""
         d = attrs.get("head_dim") or attrs["embed_dim"] // attrs["num_q_heads"]
-        if not attrs.get("scaling_query", True):
-            return 1.0
-        sf = attrs.get("scaling_factor")
-        return sf if sf is not None else 1.0 / np.sqrt(d)
+        scale = 1.0
+        if attrs.get("qk_prod_scaling", True):
+            scale /= np.sqrt(d)
+        if attrs.get("scaling_query", False):
+            sf = attrs.get("scaling_factor")
+            scale *= sf if sf is not None else 1.0
+        return scale
+
+    @staticmethod
+    def _alibi_slopes(num_heads: int):
+        """ALiBi per-head slopes, MPT convention with alibi_bias_max=8
+        (reference apply_position_bias_qkprd,
+        inc_multihead_self_attention.cu:304-325: slope_h = 2^-((h+1)*8/H);
+        the reference's (k+1-T) offset differs from our (k - q) only by a
+        per-row constant, which softmax ignores)."""
+        h = np.arange(1, num_heads + 1, dtype=np.float32)
+        return 2.0 ** (-h * 8.0 / num_heads)
 
     def _cache(self, ctx, layer_name):
         cache = ctx.kv_cache[layer_name]
@@ -179,7 +205,11 @@ class IncMultiHeadSelfAttention(_ServingAttentionBase):
         S = ck.shape[1]
         span = jnp.arange(S)[None, None, :]  # [1,1,S]
         mask = (span <= positions[:, :, None]) & bc["active"][:, None, None]
-        out = _attend(q, ck, cv, mask, self._scale(attrs))
+        alibi = None
+        if attrs.get("position_bias", False):
+            alibi = (jnp.asarray(self._alibi_slopes(attrs["num_q_heads"])),
+                     positions)
+        out = _attend(q, ck, cv, mask, self._scale(attrs), alibi)
         return [self._output(params, out, attrs)]
 
     def flops(self, attrs, in_specs):
@@ -232,7 +262,10 @@ class TreeIncMultiHeadSelfAttention(_ServingAttentionBase):
 
         def row(cache_row, n, s_idx, d_idx):
             vals = cache_row[s_idx]  # [C, KV, D] gather
-            d_safe = jnp.where(jnp.arange(s_idx.shape[0]) < n, d_idx, -1)
+            # discard sentinel must be out-of-bounds *positive* (negative
+            # indices wrap in JAX even under mode='drop')
+            S = cache_row.shape[0]
+            d_safe = jnp.where(jnp.arange(s_idx.shape[0]) < n, d_idx, S)
             return cache_row.at[d_safe].set(vals, mode="drop")
 
         return jax.vmap(row)(cache, count, src, dst)
@@ -270,5 +303,9 @@ class TreeIncMultiHeadSelfAttention(_ServingAttentionBase):
 
         intree = jax.vmap(place)(bc["tree_mask"], bc["first_depth"])
         mask = (committed | intree) & bc["active"][:, None, None]
-        out = _attend(q, ck, cv, mask, self._scale(attrs))
+        alibi = None
+        if attrs.get("position_bias", False):
+            alibi = (jnp.asarray(self._alibi_slopes(attrs["num_q_heads"])),
+                     depths)
+        out = _attend(q, ck, cv, mask, self._scale(attrs), alibi)
         return [self._output(params, out, attrs)]

@@ -96,7 +96,7 @@ class InferenceManager:
     def compile_model_and_allocate_buffer(
             self, model, mode: InferenceMode = InferenceMode.INC_DECODING,
             max_requests: int = 16, max_seq_length: int = 1024,
-            prefill_chunk: int = 256, beam_width: int = 1,
+            prefill_chunk: int = 1024, beam_width: int = 1,
             cache_dtype=None, model_id: Optional[int] = None) -> int:
         """Returns a model_id handle.  reference: inference_manager.cc:81."""
         cfg = model.config
@@ -126,12 +126,18 @@ class InferenceManager:
         caches = {}
         cache_sharding = (NamedSharding(mesh, PartitionSpec(None, None, AXIS_MODEL, None))
                           if mesh is not None else None)
+        # slack tail: a mixed decode/prefill batch scatters a full chunk at
+        # each row's depth; rows near max_seq_length would otherwise have
+        # the scatter clamped back over committed entries
+        # (dynamic_update_slice clamps at the edge).  Slack positions are
+        # never attended — the mask stops at each row's current depth.
+        alloc_len = max_seq_length + prefill_chunk + 1
         for layer in model.layers:
             if layer.op_type in SERVING_ATTENTION_OPS:
                 a = layer.attrs
                 kv = a["num_kv_heads"]
                 d = a.get("head_dim") or a["embed_dim"] // a["num_q_heads"]
-                shape = (rows, max_seq_length, kv, d)
+                shape = (rows, alloc_len, kv, d)
                 k = jnp.zeros(shape, cache_dtype)
                 v = jnp.zeros(shape, cache_dtype)
                 if cache_sharding is not None:

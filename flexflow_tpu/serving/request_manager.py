@@ -208,14 +208,19 @@ class RequestManager:
         if not self.running:
             return None
 
-        # 3) choose the shape bucket: decode-only -> chunk 1; else prefill
-        needs_prefill = any(len(r.tokens) - r.cached_len > 1
-                            for r in self.running.values())
+        # 3) choose the shape bucket: decode-only -> chunk 1; else the
+        #    smallest pow2 covering the largest remaining span.  Pow2
+        #    bucketing bounds jit recompiles to log2(max_tokens) step
+        #    functions (the role Legion tracing plays in the reference); on
+        #    TPU the device cost of a step is rows x chunk regardless of how
+        #    many rows are active, so the bucket must NOT depend on the
+        #    active-request count.
+        max_span = max(len(r.tokens) - r.cached_len
+                       for r in self.running.values())
         chunk = 1
-        if needs_prefill:
-            budget = max(2, self.max_tokens_per_batch
-                         // max(1, len(self.running)))
-            chunk = min(budget, self.max_tokens_per_batch)
+        if max_span > 1:
+            chunk = min(1 << (max_span - 1).bit_length(),
+                        self.max_tokens_per_batch)
 
         bc = BatchConfig(self.max_requests_per_batch, chunk)
         for row, req in self.running.items():
