@@ -101,12 +101,13 @@ class BeamTopK(OpDef):
                 TensorSpec(x.shape[:-1] + (k,), DataType.FLOAT)]   # log-probs
 
     def forward(self, params, inputs, attrs, ctx):
-        (x,) = inputs  # [..., vocab] logits
+        (x,) = inputs  # [..., vocab] PROBABILITIES (builders place a softmax
+        # before this head, matching reference llama.cc)
         k = attrs["max_beam_width"]
-        logp = jax.nn.log_softmax(x.astype(jnp.float32), axis=-1)
-        vals, idx = jax.lax.top_k(logp, k)
+        vals, idx = jax.lax.top_k(x.astype(jnp.float32), k)
+        logp = jnp.log(vals + 1e-20)
         parents = jnp.zeros(idx.shape, jnp.int32)  # parent = own slot; RM remaps
-        return [idx.astype(jnp.int32), parents, vals]
+        return [idx.astype(jnp.int32), parents, logp]
 
 
 @register

@@ -52,22 +52,31 @@ from .registry import OpDef, ParamSpec, register
 NEG_INF = -1e30  # large-negative fill; -inf breaks softmax rows that are all masked
 
 
-def _scatter_chunk(cache, chunk, start):
-    """cache [R,S,KV,D] <- chunk [R,C,KV,D] at per-row offset start [R]."""
+def _scatter_chunk(cache, chunk, start, active):
+    """cache [R,S,KV,D] <- chunk [R,C,KV,D] at per-row offset start [R].
+
+    Inactive rows redirect to the end of the cache (dynamic_update_slice
+    clamps into the never-attended slack tail) — otherwise a batch that
+    populates only some rows would corrupt other requests' committed KV at
+    offset 0 (every step scatters all R rows unconditionally)."""
+    S = cache.shape[1]
+    safe_start = jnp.where(active, start, S)
 
     def upd(cache_row, chunk_row, s):
         return jax.lax.dynamic_update_slice(
             cache_row, chunk_row.astype(cache_row.dtype), (s, 0, 0))
 
-    return jax.vmap(upd)(cache, chunk, start)
+    return jax.vmap(upd)(cache, chunk, safe_start)
 
 
 def _attend(q, cache_k, cache_v, mask, scale, alibi=None):
     """q [R,C,H,D] vs cache [R,S,KV,D] with mask [R,C,S] -> [R,C,H,D].
 
     H = KV * G; queries grouped so each KV head serves G query heads.
-    ``alibi``: optional (slopes[H], positions[R,C]) pair adding the MPT
-    position bias slope_h * (s - q_pos) to the logits.
+    ``alibi``: optional (slopes[H], q_positions[R,C], key_positions[R,S])
+    triple adding the MPT position bias slope_h * (k_pos - q_pos).  Key
+    positions are explicit because in tree-verify mode a key's cache slot is
+    NOT its token depth (siblings share a depth but occupy distinct slots).
     """
     R, C, H, D = q.shape
     KV = cache_k.shape[2]
@@ -76,9 +85,8 @@ def _attend(q, cache_k, cache_v, mask, scale, alibi=None):
     logits = jnp.einsum("rckgd,rskd->rckgs", qg, cache_k,
                         preferred_element_type=jnp.float32) * scale
     if alibi is not None:
-        slopes, positions = alibi
-        S = cache_k.shape[1]
-        rel = (jnp.arange(S)[None, None, :]
+        slopes, positions, key_pos = alibi
+        rel = (key_pos[:, None, :]
                - positions[:, :, None]).astype(jnp.float32)  # [R,C,S]
         bias = slopes.reshape(1, 1, KV, G, 1) * rel[:, :, None, None, :]
         logits = logits + bias
@@ -199,16 +207,17 @@ class IncMultiHeadSelfAttention(_ServingAttentionBase):
             k = apply_rotary_embedding(k.swapaxes(1, 2), positions[:, None, :],
                                        theta).swapaxes(1, 2)
         ck, cv = self._cache(ctx, layer)
-        ck = _scatter_chunk(ck, k, bc["first_depth"])
-        cv = _scatter_chunk(cv, v, bc["first_depth"])
+        ck = _scatter_chunk(ck, k, bc["first_depth"], bc["active"])
+        cv = _scatter_chunk(cv, v, bc["first_depth"], bc["active"])
         self._store(ctx, layer, ck, cv)
         S = ck.shape[1]
         span = jnp.arange(S)[None, None, :]  # [1,1,S]
         mask = (span <= positions[:, :, None]) & bc["active"][:, None, None]
         alibi = None
         if attrs.get("position_bias", False):
+            key_pos = jnp.broadcast_to(jnp.arange(S)[None, :], (R, S))
             alibi = (jnp.asarray(self._alibi_slopes(attrs["num_q_heads"])),
-                     positions)
+                     positions, key_pos)
         out = _attend(q, ck, cv, mask, self._scale(attrs), alibi)
         return [self._output(params, out, attrs)]
 
@@ -289,8 +298,8 @@ class TreeIncMultiHeadSelfAttention(_ServingAttentionBase):
             k = apply_rotary_embedding(k.swapaxes(1, 2), depths[:, None, :],
                                        theta).swapaxes(1, 2)
         # 3) stash tree K/V flat at [first_depth, first_depth+C)
-        ck = _scatter_chunk(ck, k, bc["first_depth"])
-        cv = _scatter_chunk(cv, v, bc["first_depth"])
+        ck = _scatter_chunk(ck, k, bc["first_depth"], bc["active"])
+        cv = _scatter_chunk(cv, v, bc["first_depth"], bc["active"])
         self._store(ctx, layer, ck, cv)
         # 4) mask: committed prefix + in-batch ancestors
         S = ck.shape[1]
@@ -305,7 +314,15 @@ class TreeIncMultiHeadSelfAttention(_ServingAttentionBase):
         mask = (committed | intree) & bc["active"][:, None, None]
         alibi = None
         if attrs.get("position_bias", False):
+            # key position = slot index for the committed prefix, token
+            # depth for in-tree slots (scattered over the slot range)
+            base_pos = jnp.broadcast_to(jnp.arange(S)[None, :], (R, S))
+
+            def place_pos(pos_row, d_row, start):
+                return jax.lax.dynamic_update_slice(pos_row, d_row, (start,))
+
+            key_pos = jax.vmap(place_pos)(base_pos, depths, bc["first_depth"])
             alibi = (jnp.asarray(self._alibi_slopes(attrs["num_q_heads"])),
-                     depths)
+                     depths, key_pos)
         out = _attend(q, ck, cv, mask, self._scale(attrs), alibi)
         return [self._output(params, out, attrs)]

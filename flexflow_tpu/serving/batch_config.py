@@ -30,6 +30,17 @@ import numpy as np
 from ..fftype import InferenceMode
 
 
+def pick_chunk(needed: int, cap: int) -> int:
+    """Smallest pow2 shape bucket covering ``needed`` tokens per row, capped
+    at ``cap``.  Pow2 bucketing bounds jit recompiles to log2(cap) step
+    functions — the role Legion tracing plays in the reference.  The single
+    source of truth for bucket policy (used by RequestManager and
+    spec_infer)."""
+    if needed <= 1:
+        return 1
+    return min(1 << (needed - 1).bit_length(), cap)
+
+
 class BatchConfig:
     """One serving step's worth of work (reference batch_config.h:39).
 

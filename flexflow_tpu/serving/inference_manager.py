@@ -96,7 +96,7 @@ class InferenceManager:
     def compile_model_and_allocate_buffer(
             self, model, mode: InferenceMode = InferenceMode.INC_DECODING,
             max_requests: int = 16, max_seq_length: int = 1024,
-            prefill_chunk: int = 1024, beam_width: int = 1,
+            prefill_chunk: int = 256, beam_width: int = 1,
             cache_dtype=None, model_id: Optional[int] = None) -> int:
         """Returns a model_id handle.  reference: inference_manager.cc:81."""
         cfg = model.config
@@ -189,13 +189,6 @@ class InferenceManager:
             record["steps"][key] = self._build_step(record, chunk, reorder)
         return record["steps"][key]
 
-    def pick_chunk(self, record, needed: int) -> int:
-        """Smallest shape bucket covering `needed` tokens per row."""
-        if needed <= 1:
-            return 1
-        c = record["prefill_chunk"]
-        return min(c, max(8, 1 << (needed - 1).bit_length()))
-
     def inference(self, model_id: int, bc: BatchConfig,
                   rng=None, parent_rows: Optional[np.ndarray] = None
                   ) -> List[Any]:
@@ -205,6 +198,13 @@ class InferenceManager:
         token ids / probs); cache updates are kept internally.
         """
         record = self.models[model_id]
+        if bc.chunk > record["prefill_chunk"]:
+            raise ValueError(
+                f"batch chunk {bc.chunk} exceeds the cache slack "
+                f"(prefill_chunk={record['prefill_chunk']}) this model was "
+                f"compiled with — scatter would clamp over committed KV. "
+                f"Compile with prefill_chunk >= the RequestManager's "
+                f"max_tokens_per_batch.")
         batch = {k: jnp.asarray(v) for k, v in bc.pack().items()}
         reorder = parent_rows is not None
         if reorder:

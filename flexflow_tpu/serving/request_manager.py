@@ -31,7 +31,7 @@ import jax
 import numpy as np
 
 from ..fftype import InferenceMode
-from .batch_config import BatchConfig, InferenceResult
+from .batch_config import BatchConfig, InferenceResult, pick_chunk
 from .inference_manager import InferenceManager
 
 
@@ -217,10 +217,7 @@ class RequestManager:
         #    active-request count.
         max_span = max(len(r.tokens) - r.cached_len
                        for r in self.running.values())
-        chunk = 1
-        if max_span > 1:
-            chunk = min(1 << (max_span - 1).bit_length(),
-                        self.max_tokens_per_batch)
+        chunk = pick_chunk(max_span, self.max_tokens_per_batch)
 
         bc = BatchConfig(self.max_requests_per_batch, chunk)
         for row, req in self.running.items():

@@ -1,0 +1,365 @@
+"""Speculative inference: SSM beam expansion + LLM tree verification.
+
+TPU-native re-design of the reference's SpecInfer loop
+(src/runtime/request_manager.cc:1984-2070 generate_spec_infer and its
+helpers: prepare_next_batch_init :554, prepare_next_batch_beam :939,
+store_beam_metadata :1459, traverse_beam_tree :1796, merge_dfs_trees :1260,
+prepare_next_batch_verify :1211, traverse_verify_tree :1694).
+
+Division of labour (vs the reference's Legion CPU tasks + CUDA kernels):
+
+- device (jitted step fns, via InferenceManager): SSM forward with
+  beam-folded rows + beam-parent cache gather; LLM tree-attention with
+  commit-then-scatter KV handling (ops/serving_attention.py).
+- host (this file, numpy): beam bookkeeping, tree merge/dedup, the verify
+  walk, commit-list construction.  These are O(requests x tree) scalar
+  loops — exactly what the reference also runs on CPU.
+
+Cache/bookkeeping invariants per running request (committed = req.tokens):
+
+- ``llm_cached``: LLM cache holds correct KV for positions [0, llm_cached);
+  always len(tokens) - 1 after prefill — the newest token is the tree root
+  of the next verify step, so its KV lands during that step.
+- ``ssm_cached``: same for every live beam row of the SSM.
+- ``commit_src/dst``: accepted speculative KVs from the previous verify
+  step, moved at the start of the next one (reference
+  commit_tokens_kernel semantics, tree_inc_multihead_self_attention.cu:276).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+
+from .batch_config import (BatchConfig, BeamSearchBatchConfig,
+                           TreeVerifyBatchConfig, pick_chunk)
+from .request_manager import GenerationResult, Request
+
+
+@dataclasses.dataclass
+class TreeNode:
+    """One node of a request's speculation tree (reference BeamTree,
+    request_manager.h:52-86)."""
+
+    token: int
+    parent: int  # index into the node list; 0 is the root
+    depth: int   # 0 = root (last committed token)
+    log_prob: float = 0.0
+
+
+class SpecState:
+    """Per-request speculative-decoding state."""
+
+    def __init__(self):
+        self.llm_cached = 0
+        self.ssm_cached = 0
+        self.commit_src: List[int] = []
+        self.commit_dst: List[int] = []
+        self.tree: List[TreeNode] = []
+        self.beam_nodes: List[int] = []  # live beam -> tree node index
+        self.beam_logp: List[float] = []
+
+
+def _build_tree_batch(rm, im_record, states: Dict[int, SpecState],
+                      running: Dict[int, Request], chunk: int
+                      ) -> Tuple[TreeVerifyBatchConfig, Dict[int, List[int]]]:
+    """TreeVerifyBatchConfig from per-request trees (reference
+    prepare_next_batch_verify, request_manager.cc:1211-1260).
+
+    Returns the batch plus, per row, the tree-slot list in batch order
+    (identity here — nodes are already stored in parent-before-child
+    order, a DFS/BFS-merged layout like merge_dfs_trees produces).
+    """
+    bc = TreeVerifyBatchConfig(rm.max_requests_per_batch, chunk)
+    slot_map: Dict[int, List[int]] = {}
+    for row, req in running.items():
+        st = states[req.guid]
+        nodes = st.tree
+        n = len(nodes)
+        assert 0 < n <= chunk, (n, chunk)
+        bc.request_guid[row] = req.guid
+        bc.request_available[row] = True
+        bc.first_token_depth[row] = st.llm_cached
+        bc.num_tokens_in_batch[row] = n
+        bc.max_sequence_length[row] = req.max_sequence_length
+        for c, node in enumerate(nodes):
+            bc.token_ids[row, c] = node.token
+            bc.token_depth[row, c] = st.llm_cached + node.depth
+            # ancestor mask: self + transitive parents
+            bc.tree_mask[row, c, c] = True
+            p = c
+            while nodes[p].depth > 0:
+                p = nodes[p].parent
+                bc.tree_mask[row, c, p] = True
+        # commits from the previous verify step
+        k = len(st.commit_src)
+        bc.num_tokens_to_commit[row] = k
+        bc.commit_src_index[row, :k] = st.commit_src
+        bc.commit_dst_depth[row, :k] = st.commit_dst
+        st.commit_src, st.commit_dst = [], []
+        slot_map[row] = list(range(n))
+    return bc, slot_map
+
+
+def _verify_walk(nodes: List[TreeNode], outputs: np.ndarray, start: int = 0
+                 ) -> Tuple[List[int], List[int], int]:
+    """Greedy tree verification (reference traverse_verify_tree,
+    request_manager.cc:1694).
+
+    ``outputs[c]`` is the LLM's greedy token at tree slot c.  Walk from the
+    root accepting the child whose token equals the LLM's prediction at its
+    parent; the bonus token is the LLM's prediction at the last accepted
+    node (so even zero accepted speculations commit one token).
+    Returns (accepted_slots, accepted_tokens, bonus_token).
+    """
+    children: Dict[int, List[int]] = {}
+    for i, node in enumerate(nodes):
+        if node.depth > 0:
+            children.setdefault(node.parent, []).append(i)
+    path, tokens = [], []
+    cur = start
+    while True:
+        want = int(outputs[cur])
+        nxt = next((c for c in children.get(cur, ())
+                    if nodes[c].token == want), None)
+        if nxt is None:
+            return path, tokens, want
+        path.append(nxt)
+        tokens.append(nodes[nxt].token)
+        cur = nxt
+
+
+def _ssm_prefill(rm, im, ssm_id, states, running, beam_width, seed_rng):
+    """Bring every beam row's SSM cache up to the committed prefix; returns
+    last-position beam candidates per row (reference
+    prepare_next_batch_init, request_manager.cc:554)."""
+    record = im.models[ssm_id]
+    results = {}
+    while True:
+        spans = {}
+        for row, req in running.items():
+            st = states[req.guid]
+            if st.ssm_cached < len(req.tokens):
+                spans[row] = req.tokens[st.ssm_cached:]
+        if not spans:
+            break
+        max_span = max(len(s) for s in spans.values())
+        chunk = pick_chunk(max_span, rm.max_tokens_per_batch)
+        bc = BeamSearchBatchConfig(rm.max_requests_per_batch, chunk,
+                                   beam_width=beam_width)
+        for row, req in running.items():
+            st = states[req.guid]
+            span = spans.get(row)
+            if span is None:
+                continue
+            n = min(len(span), chunk)
+            for b in range(beam_width):
+                rr = bc.row(row, b)
+                bc.request_guid[rr] = req.guid
+                bc.request_available[rr] = True
+                bc.first_token_depth[rr] = st.ssm_cached
+                bc.num_tokens_in_batch[rr] = n
+                bc.max_sequence_length[rr] = req.max_sequence_length
+                bc.token_ids[rr, :n] = span[:n]
+        outs = im.inference(ssm_id, bc, rng=seed_rng)
+        ids, parents, logps = (np.asarray(outs[0]), np.asarray(outs[1]),
+                               np.asarray(outs[2]))
+        for row, req in running.items():
+            st = states[req.guid]
+            span = spans.get(row)
+            if span is None:
+                continue
+            n = min(len(span), chunk)
+            st.ssm_cached += n
+            if st.ssm_cached >= len(req.tokens):
+                rr = bc.row(row, 0)
+                results[row] = (ids[rr, n - 1], logps[rr, n - 1])
+    return results
+
+
+def generate_spec_infer(rm, im, llm_id: int, requests: Sequence[Request],
+                        seed: int = 0,
+                        beam_width: Optional[int] = None,
+                        beam_depth: Optional[int] = None
+                        ) -> List[GenerationResult]:
+    """The SpecInfer macro-loop (reference request_manager.cc:1984-2070).
+
+    ``rm.ssm_model_ids[0]`` is the small speculator (the reference supports
+    several SSMs; we speculate with the first — the reference's own default
+    config does the same in practice).
+    """
+    assert rm.ssm_model_ids, "spec_infer needs a registered SSM"
+    ssm_id = rm.ssm_model_ids[0]
+    ssm_record = im.models[ssm_id]
+    W = beam_width or ssm_record["beam_width"]
+    D = beam_depth or BeamSearchBatchConfig.MAX_BEAM_DEPTH
+    tree_chunk = rm.max_spec_tree_token_num
+    rng = jax.random.PRNGKey(seed)
+    states: Dict[int, SpecState] = {}
+
+    while True:
+        # ---- admission / retirement bookkeeping via the shared machinery
+        for row in rm._free_rows():
+            if not rm.pending:
+                break
+            req = rm.pending.pop(0)
+            req.status = Request.RUNNING
+            req.row = row
+            rm.running[row] = req
+            states[req.guid] = SpecState()
+        if not rm.running:
+            break
+        running = dict(rm.running)
+
+        # ---- LLM prompt prefill: long prompts as linear chains first so
+        #      the remaining uncached span fits inside one tree chunk
+        for row, req in running.items():
+            st = states[req.guid]
+            while len(req.tokens) - 1 - st.llm_cached >= tree_chunk:
+                chain = TreeVerifyBatchConfig(rm.max_requests_per_batch,
+                                              tree_chunk)
+                span = req.tokens[st.llm_cached: st.llm_cached + tree_chunk]
+                chain.request_guid[row] = req.guid
+                chain.request_available[row] = True
+                chain.first_token_depth[row] = st.llm_cached
+                chain.num_tokens_in_batch[row] = len(span)
+                chain.max_sequence_length[row] = req.max_sequence_length
+                chain.token_ids[row, :len(span)] = span
+                chain.token_depth[row, :len(span)] = (
+                    st.llm_cached + np.arange(len(span)))
+                chain.tree_mask[row, :len(span), :len(span)] = np.tril(
+                    np.ones((len(span), len(span)), bool))
+                rng, r3 = jax.random.split(rng)
+                im.inference(llm_id, chain, rng=r3)
+                st.llm_cached += len(span)
+
+        # ---- SSM phase: prefill + beam expansion to depth D
+        rng, r1 = jax.random.split(rng)
+        seeds = _ssm_prefill(rm, im, ssm_id, states, running, W, r1)
+        root_of: Dict[int, int] = {}
+        for row, req in running.items():
+            st = states[req.guid]
+            # committed chain: uncached positions [llm_cached, L) form the
+            # base of the tree (the reference carries these as committed
+            # tokens inside the verify batch, request_manager.cc:1211)
+            L = len(req.tokens)
+            st.tree = [TreeNode(req.tokens[pos], max(0, i - 1), i)
+                       for i, pos in enumerate(range(st.llm_cached, L))]
+            root = len(st.tree) - 1
+            root_of[row] = root
+            ids, logps = seeds[row]
+            st.beam_nodes, st.beam_logp = [], []
+            capacity = tree_chunk - len(st.tree)
+            for b in range(min(W, len(ids), max(0, capacity))):
+                st.tree.append(TreeNode(int(ids[b]), root,
+                                        st.tree[root].depth + 1,
+                                        float(logps[b])))
+                st.beam_nodes.append(len(st.tree) - 1)
+                st.beam_logp.append(float(logps[b]))
+            req.profile.ssm_decoding_steps += 1
+
+        for depth in range(1, D):
+            if all(len(states[r.guid].tree) + W > tree_chunk
+                   for r in running.values()):
+                break
+            bc = BeamSearchBatchConfig(rm.max_requests_per_batch, 1,
+                                       beam_width=W)
+            parent_rows = np.arange(bc.max_requests, dtype=np.int32)
+            any_active = False
+            for row, req in running.items():
+                st = states[req.guid]
+                if len(st.tree) + W > tree_chunk:
+                    continue
+                any_active = True
+                for b, node_idx in enumerate(st.beam_nodes):
+                    rr = bc.row(row, b)
+                    node = st.tree[node_idx]
+                    bc.request_guid[rr] = req.guid
+                    bc.request_available[rr] = True
+                    bc.first_token_depth[rr] = st.ssm_cached + depth - 1
+                    bc.num_tokens_in_batch[rr] = 1
+                    bc.max_sequence_length[rr] = req.max_sequence_length
+                    bc.token_ids[rr, 0] = node.token
+            if not any_active:
+                break
+            rng, r2 = jax.random.split(rng)
+            outs = im.inference(ssm_id, bc, rng=r2,
+                                parent_rows=parent_rows)
+            ids, _, logps = (np.asarray(outs[0]), np.asarray(outs[1]),
+                             np.asarray(outs[2]))
+            # host-side beam re-ranking (reference store_beam_metadata)
+            reorder = np.arange(bc.max_requests, dtype=np.int32)
+            for row, req in running.items():
+                st = states[req.guid]
+                if not bc.request_available[bc.row(row, 0)]:
+                    continue
+                cands = []  # (cum_logp, beam, token, token_logp)
+                for b, node_idx in enumerate(st.beam_nodes):
+                    rr = bc.row(row, b)
+                    for w in range(W):
+                        cands.append((st.beam_logp[b] + float(logps[rr, 0, w]),
+                                      b, int(ids[rr, 0, w])))
+                cands.sort(key=lambda c: -c[0])
+                new_nodes, new_logp, parents = [], [], []
+                for cum, b, tok in cands[:W]:
+                    parent_node = st.beam_nodes[b]
+                    # dedup shared prefixes (reference merge_dfs_trees)
+                    existing = next(
+                        (i for i, nd in enumerate(st.tree)
+                         if nd.parent == parent_node and nd.token == tok
+                         and nd.depth == st.tree[parent_node].depth + 1),
+                        None)
+                    if existing is None:
+                        st.tree.append(TreeNode(
+                            tok, parent_node,
+                            st.tree[parent_node].depth + 1, cum))
+                        existing = len(st.tree) - 1
+                    new_nodes.append(existing)
+                    new_logp.append(cum)
+                    parents.append(b)
+                # cache rows follow their parent beams
+                for b_new, b_old in enumerate(parents):
+                    reorder[bc.row(row, b_new)] = bc.row(row, b_old)
+                st.beam_nodes, st.beam_logp = new_nodes, new_logp
+                req.profile.ssm_decoding_steps += 1
+            # apply the reorder on the *next* step (gather before scatter);
+            # stash it — next iteration's parent_rows
+            parent_rows = reorder
+
+        # ---- tree verify step
+        bc, _ = _build_tree_batch(rm, im.models[llm_id], states, running,
+                                  tree_chunk)
+        rng, r4 = jax.random.split(rng)
+        outs = im.inference(llm_id, bc, rng=r4)
+        greedy = np.asarray(outs[0])  # [rows, chunk] argmax ids
+
+        # ---- acceptance + bookkeeping
+        for row, req in running.items():
+            st = states[req.guid]
+            nodes = st.tree
+            root = root_of[row]
+            path, acc_tokens, bonus = _verify_walk(nodes, greedy[row],
+                                                   start=root)
+            new_tokens = acc_tokens + [bonus]
+            req.profile.speculated_tokens += len(nodes) - 1 - root
+            req.profile.accepted_tokens += len(acc_tokens)
+            req.profile.llm_decoding_steps += 1
+            # chain nodes' KV landed at their final slots already; accepted
+            # speculative nodes move from tree slot to committed position
+            base = st.llm_cached  # batch slot c -> cache slot base + c
+            st.commit_src = [base + slot for slot in path]
+            st.commit_dst = [base + root + 1 + i for i in range(len(path))]
+            st.llm_cached = base + root + 1 + len(path)
+            finished = False
+            for tok in new_tokens:
+                req.tokens.append(tok)
+                if rm._finished(req, tok):
+                    finished = True
+                    break
+            if finished:
+                rm._retire(req)
+                states.pop(req.guid, None)
+    return [rm._result_of(r) for r in requests]

@@ -152,13 +152,16 @@ def test_sampling_heads():
 
 
 def test_beam_topk_logprobs():
-    x = np.array([[0.0, 1.0, 2.0]], np.float32)
-    ids, parents, logp = run_op(OpType.BEAM_TOPK, dict(max_beam_width=2), [x])
+    # BeamTopK consumes PROBABILITIES (builders put a softmax before it,
+    # matching reference llama.cc) and returns their logs
+    logits = np.array([[0.0, 1.0, 2.0]], np.float32)
+    probs = np.exp(logits - logits.max())
+    probs = probs / probs.sum()
+    ids, parents, logp = run_op(OpType.BEAM_TOPK, dict(max_beam_width=2),
+                                [probs])
     assert list(np.asarray(ids)[0]) == [2, 1]
-    full = np.exp(x[0] - x[0].max())
-    full = np.log(full / full.sum())
-    np.testing.assert_allclose(np.asarray(logp)[0], sorted(full)[::-1][:2],
-                               rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(logp)[0],
+                               np.log(sorted(probs[0])[::-1][:2]), rtol=1e-5)
 
 
 def test_mha_causal_attention():

@@ -1,0 +1,141 @@
+"""Speculative decoding tests.
+
+Mirrors the reference CI's hardest gate
+(tests/inference/python_inference_tests.sh:30-55): spec_infer's output
+tokens must EXACTLY equal incremental decoding's, for any SSM — speculation
+may only accelerate, never change, the distribution.
+"""
+
+import numpy as np
+import pytest
+
+from flexflow_tpu import FFConfig, Model
+from flexflow_tpu.fftype import InferenceMode
+from flexflow_tpu.models.llama import (LLAMAConfig, convert_hf_state_dict,
+                                       create_llama_model)
+from flexflow_tpu.serving import InferenceManager, RequestManager
+from flexflow_tpu.serving.spec_infer import generate_spec_infer
+
+TINY = dict(vocab_size=128, hidden_size=64, intermediate_size=128,
+            num_hidden_layers=2, num_attention_heads=4,
+            num_key_value_heads=2, max_position_embeddings=512)
+
+SMALLER = dict(vocab_size=128, hidden_size=32, intermediate_size=64,
+               num_hidden_layers=1, num_attention_heads=2,
+               num_key_value_heads=2, max_position_embeddings=512)
+
+
+def _hf_llama(params, seed):
+    import torch
+    from transformers import LlamaConfig, LlamaForCausalLM
+
+    torch.manual_seed(seed)
+    return LlamaForCausalLM(LlamaConfig(**params,
+                                        tie_word_embeddings=False)).eval()
+
+
+def _build(hf, mode, max_requests=4, beam_width=1):
+    cfg = LLAMAConfig.from_hf(hf.config)
+    model = Model(FFConfig(), name=f"m_{mode.value}_{id(hf) % 1000}")
+    create_llama_model(model, cfg, mode=mode, max_requests=max_requests)
+    model.params = convert_hf_state_dict(hf.state_dict(), cfg)
+    return model
+
+
+def _spec_generate(llm_hf, ssm_hf, prompts, n_new, beam_width=2,
+                   max_requests=4, tree_chunk=24):
+    llm = _build(llm_hf, InferenceMode.TREE_VERIFY, max_requests)
+    ssm = _build(ssm_hf, InferenceMode.BEAM_SEARCH, max_requests)
+    im = InferenceManager(llm.config)
+    llm_id = im.compile_model_and_allocate_buffer(
+        llm, mode=InferenceMode.TREE_VERIFY, max_requests=max_requests,
+        max_seq_length=256, cache_dtype=np.float32)
+    ssm_id = im.compile_model_and_allocate_buffer(
+        ssm, mode=InferenceMode.BEAM_SEARCH, max_requests=max_requests,
+        max_seq_length=256, beam_width=beam_width, cache_dtype=np.float32)
+    rm = RequestManager(max_requests_per_batch=max_requests,
+                        max_tokens_per_batch=64, max_sequence_length=256,
+                        max_spec_tree_token_num=tree_chunk)
+    rm.register_ssm_model(ssm_id)
+    reqs = [rm.register_new_request(list(p), max_new_tokens=n_new)
+            for p in prompts]
+    generate_spec_infer(rm, im, llm_id, reqs, beam_width=beam_width,
+                        beam_depth=4)
+    return [r.tokens[r.prompt_len:] for r in reqs], reqs
+
+
+def _incr_generate(llm_hf, prompts, n_new, max_requests=4):
+    model = _build(llm_hf, InferenceMode.INC_DECODING, max_requests)
+    im = InferenceManager(model.config)
+    mid = im.compile_model_and_allocate_buffer(
+        model, max_requests=max_requests, max_seq_length=256,
+        cache_dtype=np.float32)
+    rm = RequestManager(max_requests_per_batch=max_requests,
+                        max_tokens_per_batch=64, max_sequence_length=256)
+    reqs = [rm.register_new_request(list(p), max_new_tokens=n_new)
+            for p in prompts]
+    rm.generate_incr_decoding(im, mid, reqs)
+    return [r.tokens[r.prompt_len:] for r in reqs]
+
+
+class TestSpecInfer:
+    def test_matches_incremental_weak_ssm(self):
+        """A *different* (weak) SSM must still give exactly the greedy
+        output of the LLM (the reference's token-match CI gate)."""
+        llm_hf = _hf_llama(TINY, seed=0)
+        ssm_hf = _hf_llama(SMALLER, seed=7)
+        prompts = [[1, 5, 9, 42, 7], [2, 8, 99, 100]]
+        want = _incr_generate(llm_hf, prompts, 20)
+        got, reqs = _spec_generate(llm_hf, ssm_hf, prompts, 20)
+        for w, g in zip(want, got):
+            assert g == w, f"spec != incr:\n spec={g}\n incr={w}"
+
+    def test_matches_incremental_perfect_ssm(self):
+        """LLM speculating for itself: every speculation accepted, output
+        identical, and acceptance counters prove multi-token commits."""
+        llm_hf = _hf_llama(TINY, seed=1)
+        prompts = [[3, 1, 4, 1, 5]]
+        want = _incr_generate(llm_hf, prompts, 16)
+        got, reqs = _spec_generate(llm_hf, llm_hf, prompts, 16, beam_width=1)
+        assert got[0] == want[0]
+        prof = reqs[0].profile
+        assert prof.accepted_tokens > 0
+        # perfect speculation: fewer LLM steps than tokens generated
+        assert prof.llm_decoding_steps < len(got[0])
+
+    def test_long_prompt_chain_prefill(self):
+        """Prompt longer than the tree chunk exercises the linear-chain
+        prefill path inside the verify graph."""
+        llm_hf = _hf_llama(TINY, seed=2)
+        ssm_hf = _hf_llama(SMALLER, seed=3)
+        prompt = [int(t) for t in
+                  np.random.default_rng(0).integers(1, 127, 60)]
+        want = _incr_generate(llm_hf, [prompt], 10)
+        got, _ = _spec_generate(llm_hf, ssm_hf, [prompt], 10, tree_chunk=24)
+        assert got[0] == want[0]
+
+    def test_late_long_prompt_does_not_corrupt_neighbors(self):
+        """Regression: a request admitted mid-flight whose long prompt runs
+        single-row chain-prefill steps must not clobber other rows' KV
+        caches (inactive rows' scatters must land in the slack region)."""
+        llm_hf = _hf_llama(TINY, seed=6)
+        ssm_hf = _hf_llama(SMALLER, seed=8)
+        rng = np.random.default_rng(1)
+        long_prompt = [int(t) for t in rng.integers(1, 127, 60)]
+        prompts = [[1, 2, 3], [4, 5, 6, 7], long_prompt]
+        want = _incr_generate(llm_hf, prompts, 10)
+        # 2 slots for 3 requests: the long prompt is admitted after a
+        # retirement, while another request is still mid-generation
+        got, _ = _spec_generate(llm_hf, ssm_hf, prompts, 10,
+                                max_requests=2, tree_chunk=24)
+        for p, w, g in zip(prompts, want, got):
+            assert g == w, f"prompt len {len(p)}:\n spec={g}\n incr={w}"
+
+    def test_spec_profile_counters(self):
+        llm_hf = _hf_llama(TINY, seed=4)
+        ssm_hf = _hf_llama(SMALLER, seed=5)
+        got, reqs = _spec_generate(llm_hf, ssm_hf, [[1, 2, 3]], 12)
+        prof = reqs[0].profile
+        assert prof.speculated_tokens >= prof.accepted_tokens >= 0
+        assert prof.ssm_decoding_steps > 0
+        assert len(got[0]) == 12
