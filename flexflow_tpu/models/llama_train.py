@@ -1,0 +1,224 @@
+"""LLaMA training path with dp x pp x sp x tp sharding — the flagship
+multi-chip training configuration.
+
+The reference trains transformers through the generic FFModel path with
+Unity-searched or data-parallel MachineViews (SURVEY.md §2.3); pipeline
+parallelism exists only for inference and sequence parallelism not at all
+(SURVEY.md §5).  This module is the TPU-native superset: one jitted train
+step over a (dp, pp, sp, tp) `jax.sharding.Mesh` where
+
+- dp  shards the (micro)batch dim — gradient psum inserted by GSPMD
+  (replacing the reference's NCCL optimizer path, optimizer.h:59-76);
+- pp  runs the stacked decoder blocks through the GPipe shard_map schedule
+  (flexflow_tpu/parallel/pipeline.py — replacing per-stage MachineViews,
+  graph.cc:2016);
+- tp  shards attention heads and FFN hidden dim, Megatron-style, via
+  NamedShardings on the weights (replacing the Replicate/AllReduce insertion
+  rules, model.cc:3243-3296);
+- sp  shards the sequence dim of activations between blocks (new vs the
+  reference) — norms/residuals run sequence-sharded; attention gathers
+  heads-first (ring attention supersedes this on the long-context path,
+  flexflow_tpu/ops/ring_attention.py).
+
+Weights use the same [E, H, D] / [H, D, E] layouts as the serving builder
+(models/llama.py convert_hf_state_dict), so HF checkpoints load into either.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from ..config import (AXIS_DATA, AXIS_MODEL, AXIS_PIPE, AXIS_SEQ, FFConfig)
+from ..ops.attention_ops import apply_rotary_embedding
+from ..ops.norm_ops import _rms as _rms_norm
+from ..parallel.pipeline import (microbatch, spmd_pipeline,
+                                 stack_stage_params, stage_fn_from_blocks,
+                                 unmicrobatch)
+from ..training.optimizer import AdamOptimizer, Optimizer
+from .llama import LLAMAConfig
+
+P = PartitionSpec
+
+
+@dataclasses.dataclass
+class LLaMATrainer:
+    """Sharded next-token-prediction training on a LLaMA architecture.
+
+    Not a Model-graph path: this is the hand-sharded flagship configuration
+    (the analogue of the reference's examples/cpp/Transformer manual
+    strategy), kept separate from the generic layer-graph `Model` the way
+    the reference keeps examples' manual parallel strategies separate from
+    the Unity search.
+    """
+
+    config: LLAMAConfig
+    ffconfig: FFConfig
+    num_microbatches: int = 1
+    optimizer: Optional[Optimizer] = None
+    param_dtype: Any = jnp.float32
+
+    def __post_init__(self):
+        c, f = self.config, self.ffconfig
+        self.dp = f.data_parallelism_degree
+        self.pp = f.pipeline_parallelism_degree
+        self.sp = f.sequence_parallelism_degree
+        self.tp = f.tensor_parallelism_degree
+        assert c.num_hidden_layers % self.pp == 0, (
+            f"layers {c.num_hidden_layers} % pp {self.pp} != 0")
+        assert c.num_attention_heads % self.tp == 0
+        assert c.num_key_value_heads % self.tp == 0
+        if self.num_microbatches < 1:
+            raise ValueError(f"num_microbatches must be >= 1, got "
+                             f"{self.num_microbatches}")
+        if f.batch_size % (self.num_microbatches * self.dp):
+            raise ValueError(
+                f"batch_size {f.batch_size} must divide into "
+                f"num_microbatches {self.num_microbatches} x dp {self.dp}")
+        self.mesh = f.make_mesh([AXIS_DATA, AXIS_PIPE, AXIS_SEQ, AXIS_MODEL])
+        self.optimizer = self.optimizer or AdamOptimizer(alpha=1e-3)
+        self._train_step = None
+        self.head_dim = c.hidden_size // c.num_attention_heads
+
+    # ------------------------------------------------------------- params
+    def param_specs(self) -> Dict[str, Any]:
+        tp, pp = AXIS_MODEL, AXIS_PIPE
+        block = {
+            "attn_norm": P(pp, None, None),
+            "wq": P(pp, None, None, tp, None),
+            "wk": P(pp, None, None, tp, None),
+            "wv": P(pp, None, None, tp, None),
+            "wo": P(pp, None, tp, None, None),
+            "ffn_norm": P(pp, None, None),
+            "w1": P(pp, None, None, tp),
+            "w3": P(pp, None, None, tp),
+            "w2": P(pp, None, tp, None),
+        }
+        return {
+            "embed": P(None, tp),
+            "blocks": block,
+            "norm": P(None),
+            "lm_head": P(None, tp),
+        }
+
+    def init_params(self, rng) -> Dict[str, Any]:
+        c = self.config
+        E, F, V = c.hidden_size, c.intermediate_size, c.vocab_size
+        H, KV, D = c.num_attention_heads, c.num_key_value_heads, self.head_dim
+        L = c.num_hidden_layers
+        dt = self.param_dtype
+
+        keys = jax.random.split(rng, 8)
+        scale = lambda fan_in: 1.0 / np.sqrt(fan_in)
+
+        def init(k, shape, fan_in):
+            return (jax.random.normal(k, shape, jnp.float32)
+                    * scale(fan_in)).astype(dt)
+
+        layer_params = []
+        lkeys = jax.random.split(keys[0], L)
+        for i in range(L):
+            ks = jax.random.split(lkeys[i], 6)
+            layer_params.append({
+                "attn_norm": jnp.ones((E,), dt),
+                "wq": init(ks[0], (E, H, D), E),
+                "wk": init(ks[1], (E, KV, D), E),
+                "wv": init(ks[2], (E, KV, D), E),
+                "wo": init(ks[3], (H, D, E), H * D),
+                "ffn_norm": jnp.ones((E,), dt),
+                "w1": init(ks[4], (E, F), E),
+                "w3": init(ks[5], (E, F), E),
+                "w2": init(jax.random.fold_in(ks[5], 1), (F, E), F),
+            })
+        params = {
+            "embed": init(keys[1], (V, E), E),
+            "blocks": stack_stage_params(layer_params, self.pp),
+            "norm": jnp.ones((E,), dt),
+            "lm_head": init(keys[2], (E, V), E),
+        }
+        specs = self.param_specs()
+        return jax.tree.map(
+            lambda v, s: jax.device_put(v, NamedSharding(self.mesh, s)),
+            params, specs,
+            is_leaf=lambda v: isinstance(v, jnp.ndarray))
+
+    # -------------------------------------------------------------- block
+    def _wsc(self, x, spec):
+        return jax.lax.with_sharding_constraint(
+            x, NamedSharding(self.mesh, spec))
+
+    def _block_fn(self, bp, h):
+        """One decoder block; h [mb, T, E] (sp-sharded on T between
+        blocks)."""
+        c = self.config
+        D = self.head_dim
+        groups = c.num_attention_heads // c.num_key_value_heads
+        T = h.shape[1]
+        pos = jnp.arange(T)
+
+        x = _rms_norm(h, bp["attn_norm"], c.rms_norm_eps)
+        q = jnp.einsum("bte,ehd->bthd", x, bp["wq"])
+        k = jnp.einsum("bte,ehd->bthd", x, bp["wk"])
+        v = jnp.einsum("bte,ehd->bthd", x, bp["wv"])
+        # positions [t, 1] broadcast over the heads dim of [b, t, h, d]
+        q = apply_rotary_embedding(q, pos[:, None], c.rope_theta)
+        k = apply_rotary_embedding(k, pos[:, None], c.rope_theta)
+        if groups > 1:
+            k = jnp.repeat(k, groups, axis=2)
+            v = jnp.repeat(v, groups, axis=2)
+        # heads-sharded attention (sp gathers T here; the ring-attention op
+        # keeps T sharded instead on the long-context path)
+        scores = jnp.einsum("bthd,bshd->bhts", q, k) / np.sqrt(D)
+        mask = jnp.tril(jnp.ones((T, T), bool))
+        scores = jnp.where(mask, scores, jnp.finfo(scores.dtype).min)
+        probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(
+            h.dtype)
+        ctxv = jnp.einsum("bhts,bshd->bthd", probs, v)
+        attn_out = jnp.einsum("bthd,hde->bte", ctxv, bp["wo"])
+        h = self._wsc(h + attn_out, P(AXIS_DATA, AXIS_SEQ, None))
+
+        x = _rms_norm(h, bp["ffn_norm"], c.rms_norm_eps)
+        gate = jax.nn.silu(jnp.einsum("bte,ef->btf", x, bp["w1"]))
+        up = jnp.einsum("bte,ef->btf", x, bp["w3"])
+        y = jnp.einsum("btf,fe->bte", gate * up, bp["w2"])
+        return self._wsc(h + y, P(AXIS_DATA, AXIS_SEQ, None))
+
+    # --------------------------------------------------------------- step
+    def loss_fn(self, params, tokens):
+        """Next-token CE over [B, T] int32 tokens."""
+        c = self.config
+        M = self.num_microbatches
+        h = jnp.take(params["embed"], tokens, axis=0)
+        h = self._wsc(h, P(AXIS_DATA, AXIS_SEQ, None))
+        pipe = spmd_pipeline(stage_fn_from_blocks(self._block_fn),
+                             num_stages=self.pp, num_microbatches=M,
+                             mesh=self.mesh)
+        h = unmicrobatch(pipe(params["blocks"], microbatch(h, M)))
+        h = _rms_norm(h, params["norm"], c.rms_norm_eps)
+        logits = jnp.einsum("bte,ev->btv", h, params["lm_head"])
+        lp = jax.nn.log_softmax(logits[:, :-1].astype(jnp.float32), axis=-1)
+        tgt = tokens[:, 1:]
+        nll = -jnp.take_along_axis(lp, tgt[..., None], axis=-1)[..., 0]
+        return jnp.mean(nll)
+
+    def train_step(self):
+        if self._train_step is not None:
+            return self._train_step
+
+        def step(params, opt_state, tokens):
+            loss, grads = jax.value_and_grad(self.loss_fn)(params, tokens)
+            new_params, new_opt = self.optimizer.update(params, grads,
+                                                        opt_state)
+            return new_params, new_opt, loss
+
+        self._train_step = jax.jit(step, donate_argnums=(0, 1))
+        return self._train_step
+
+    def fit_batch(self, params, opt_state, tokens):
+        step = self.train_step()
+        return step(params, opt_state, jnp.asarray(tokens, jnp.int32))

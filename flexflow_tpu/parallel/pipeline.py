@@ -1,0 +1,169 @@
+"""Pipeline parallelism over the `pp` mesh axis.
+
+TPU-native equivalent of the reference's pipeline parallelism.  The
+reference expresses pipelining through per-stage MachineViews (stage =
+transformer_layer_id / layers_per_stage, src/runtime/graph.cc:2016,
+src/runtime/inference_manager.cc:131) and gets stage overlap for free from
+Legion's future-driven task scheduling across ≤4 in-flight batches
+(src/runtime/request_manager.cc:1947).  In a single-controller JAX program
+there is no task runtime to overlap stages, so pipelining is expressed the
+TPU way: a GPipe-style fill/drain schedule written as a `lax.scan` of
+microbatch ticks inside `jax.shard_map`, with `lax.ppermute` rotating
+activations stage→stage over ICI.
+
+Composition with the other parallel dims: `shard_map(axis_names={"pp"})`
+makes only the pipeline axis manual — dp/tp/sp stay in GSPMD "auto" mode,
+so tensor-parallel shardings inside the stage body and data/sequence
+sharding of the microbatched inputs keep working unchanged inside the
+pipeline (this replaces the reference's composition of pipeline
+MachineViews with NCCL TP comms).
+
+Reverse-mode AD through the scan+ppermute reverses the schedule
+automatically (the transpose of ppermute is ppermute with inverted pairs),
+yielding the backward pipeline without extra code — the role Legion's
+dependence analysis plays for the reference's backward pass.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec
+
+from ..config import AXIS_PIPE
+
+P = PartitionSpec
+
+
+def microbatch(x: jax.Array, num_microbatches: int) -> jax.Array:
+    """[B, ...] -> [M, B // M, ...]."""
+    b = x.shape[0]
+    assert b % num_microbatches == 0, (b, num_microbatches)
+    return x.reshape((num_microbatches, b // num_microbatches) + x.shape[1:])
+
+
+def unmicrobatch(x: jax.Array) -> jax.Array:
+    """[M, mb, ...] -> [M * mb, ...]."""
+    return x.reshape((x.shape[0] * x.shape[1],) + x.shape[2:])
+
+
+def stage_fn_from_blocks(block_fn: Callable[[Any, Any], Any]):
+    """Lift a single-block fn into a stage fn that scans the blocks assigned
+    to this stage.
+
+    ``block_fn(block_params, h) -> h`` is applied over the leading
+    (layers-per-stage) dim of ``stage_params``.  This is the analogue of the
+    reference grouping `layers_per_stage` transformer layers into one
+    pipeline stage (inference_manager.cc:131).
+    """
+
+    def stage_fn(stage_params, h):
+        def body(carry, block_params):
+            return block_fn(block_params, carry), None
+
+        out, _ = jax.lax.scan(body, h, stage_params)
+        return out
+
+    return stage_fn
+
+
+def spmd_pipeline(
+    stage_fn: Callable[[Any, jax.Array], jax.Array],
+    *,
+    num_stages: int,
+    num_microbatches: int,
+    axis: str = AXIS_PIPE,
+    mesh: Optional[Mesh] = None,
+) -> Callable[[Any, jax.Array], jax.Array]:
+    """Build ``run(stacked_params, xs) -> ys``: a GPipe fill/drain pipeline.
+
+    - ``stacked_params``: pytree whose leaves have a leading dim of size
+      ``num_stages``, sharded ``PartitionSpec(axis, ...)`` — each device on
+      the `axis` ring holds exactly its stage's slice (the TPU form of the
+      reference's per-stage weight placement via MachineView
+      start_device_id, graph.cc:2016-2024).
+    - ``xs``: microbatched inputs ``[M, mb, ...]`` (replicated over `axis`;
+      may be sharded over auto axes like dp/sp).
+    - returns ``ys``: ``[M, mb, ...]``, the last stage's outputs, replicated
+      over `axis`.
+
+    stage_fn must preserve the activation shape (stage outputs feed the next
+    stage's inputs over the ppermute ring).
+    """
+    S, M = num_stages, num_microbatches
+    fwd_ring = [(i, i + 1) for i in range(S - 1)]
+
+    def run_sharded(stacked_params, xs):
+        # each pp rank sees leading stage dim of 1 -> squeeze to this
+        # stage's params
+        params = jax.tree.map(lambda p: jax.lax.squeeze(p, (0,)),
+                              stacked_params)
+        stage = jax.lax.axis_index(axis)
+        mb_aval = jax.eval_shape(lambda a: a[0], xs)
+        state = jnp.zeros(mb_aval.shape, mb_aval.dtype)
+        # output dtype/shape must match input (ring constraint) — probe it
+        out_aval = jax.eval_shape(stage_fn, params, state)
+        assert out_aval.shape == mb_aval.shape, (
+            f"pipeline stage must preserve activation shape: "
+            f"{mb_aval.shape} -> {out_aval.shape}")
+        outs = jnp.zeros((M,) + out_aval.shape, out_aval.dtype)
+
+        def tick(carry, t):
+            state, outs = carry
+            # stage 0 injects microbatch t during the fill phase
+            x_t = jax.lax.dynamic_index_in_dim(
+                xs, jnp.clip(t, 0, M - 1), 0, keepdims=False)
+            inp = jnp.where(stage == 0, x_t.astype(state.dtype), state)
+            out = stage_fn(params, inp)
+            # last stage banks microbatch t-(S-1) during the drain phase
+            oi = t - (S - 1)
+            oi_c = jnp.clip(oi, 0, M - 1)
+            cur = jax.lax.dynamic_index_in_dim(outs, oi_c, 0, keepdims=False)
+            sel = jnp.where((stage == S - 1) & (oi >= 0), out, cur)
+            outs = jax.lax.dynamic_update_index_in_dim(outs, sel, oi_c, 0)
+            # rotate activations one stage forward over ICI
+            nxt = jax.lax.ppermute(out, axis, fwd_ring)
+            return (nxt, outs), None
+
+        (_, outs), _ = jax.lax.scan(tick, (state, outs), jnp.arange(M + S - 1))
+        # broadcast banked outputs from the last stage to the whole pp ring
+        # (masked psum = one-to-all); its transpose routes cotangents only
+        # to the last stage, which is exactly the backward schedule's entry.
+        outs = jax.lax.psum(
+            jnp.where(stage == S - 1, outs, jnp.zeros_like(outs)), axis)
+        return outs
+
+    if S == 1:
+        # degenerate pipeline: plain scan over microbatches, no collectives
+        def run_single(stacked_params, xs):
+            params = jax.tree.map(lambda p: jax.lax.squeeze(p, (0,)),
+                                  stacked_params)
+            def body(_, x):
+                return None, stage_fn(params, x)
+            _, ys = jax.lax.scan(body, None, xs)
+            return ys
+        return run_single
+
+    def run(stacked_params, xs):
+        in_specs = (jax.tree.map(lambda _: P(axis), stacked_params), P())
+        fn = jax.shard_map(
+            run_sharded, mesh=mesh, in_specs=in_specs, out_specs=P(),
+            axis_names=frozenset({axis}), check_vma=False)
+        return fn(stacked_params, xs)
+
+    return run
+
+
+def stack_stage_params(layer_params: Sequence[Any], num_stages: int) -> Any:
+    """Stack per-layer param pytrees [L x tree] -> tree with leading
+    [S, L // S] dims (stage-major), ready for `spmd_pipeline` +
+    `stage_fn_from_blocks`."""
+    L = len(layer_params)
+    assert L % num_stages == 0, (L, num_stages)
+    stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *layer_params)
+    return jax.tree.map(
+        lambda x: x.reshape((num_stages, L // num_stages) + x.shape[1:]),
+        stacked)
