@@ -37,6 +37,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec
 from ..config import (AXIS_DATA, AXIS_MODEL, AXIS_PIPE, AXIS_SEQ, FFConfig)
 from ..ops.attention_ops import apply_rotary_embedding
 from ..ops.norm_ops import _rms as _rms_norm
+from ..ops.ring_attention import manual_axis_active, ring_attention
 from ..parallel.pipeline import (microbatch, spmd_pipeline,
                                  stack_stage_params, stage_fn_from_blocks,
                                  unmicrobatch)
@@ -62,6 +63,12 @@ class LLaMATrainer:
     num_microbatches: int = 1
     optimizer: Optional[Optimizer] = None
     param_dtype: Any = jnp.float32
+    # sequence-parallel attention strategy: "ring" keeps the sequence dim
+    # sharded through attention (KV blocks rotate over ICI,
+    # ops/ring_attention.py); "gather" all-gathers the sequence
+    # (Megatron-style) and shards heads instead.  Ring is the long-context
+    # path; gather can win at short T where the ring bubble dominates.
+    attention_mode: str = "ring"
 
     def __post_init__(self):
         c, f = self.config, self.ffconfig
@@ -73,6 +80,9 @@ class LLaMATrainer:
             f"layers {c.num_hidden_layers} % pp {self.pp} != 0")
         assert c.num_attention_heads % self.tp == 0
         assert c.num_key_value_heads % self.tp == 0
+        if self.attention_mode not in ("ring", "gather"):
+            raise ValueError(f"attention_mode must be 'ring' or 'gather', "
+                             f"got {self.attention_mode!r}")
         if self.num_microbatches < 1:
             raise ValueError(f"num_microbatches must be >= 1, got "
                              f"{self.num_microbatches}")
@@ -149,6 +159,13 @@ class LLaMATrainer:
 
     # -------------------------------------------------------------- block
     def _wsc(self, x, spec):
+        # inside a shard_map, entries naming manually-bound axes must be
+        # dropped (those dims are already local); constraints on the
+        # remaining auto axes still apply
+        m = jax.sharding.get_abstract_mesh()
+        manual = set(getattr(m, "manual_axes", ())) if not m.empty else set()
+        if manual:
+            spec = P(*[None if e in manual else e for e in spec])
         return jax.lax.with_sharding_constraint(
             x, NamedSharding(self.mesh, spec))
 
@@ -158,8 +175,11 @@ class LLaMATrainer:
         c = self.config
         D = self.head_dim
         groups = c.num_attention_heads // c.num_key_value_heads
-        T = h.shape[1]
-        pos = jnp.arange(T)
+        T = h.shape[1]  # LOCAL seq block when sp is manually bound (ring)
+        if manual_axis_active(AXIS_SEQ):
+            pos = jax.lax.axis_index(AXIS_SEQ) * T + jnp.arange(T)
+        else:
+            pos = jnp.arange(T)
 
         x = _rms_norm(h, bp["attn_norm"], c.rms_norm_eps)
         q = jnp.einsum("bte,ehd->bthd", x, bp["wq"])
@@ -168,17 +188,22 @@ class LLaMATrainer:
         # positions [t, 1] broadcast over the heads dim of [b, t, h, d]
         q = apply_rotary_embedding(q, pos[:, None], c.rope_theta)
         k = apply_rotary_embedding(k, pos[:, None], c.rope_theta)
-        if groups > 1:
-            k = jnp.repeat(k, groups, axis=2)
-            v = jnp.repeat(v, groups, axis=2)
-        # heads-sharded attention (sp gathers T here; the ring-attention op
-        # keeps T sharded instead on the long-context path)
-        scores = jnp.einsum("bthd,bshd->bhts", q, k) / np.sqrt(D)
-        mask = jnp.tril(jnp.ones((T, T), bool))
-        scores = jnp.where(mask, scores, jnp.finfo(scores.dtype).min)
-        probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(
-            h.dtype)
-        ctxv = jnp.einsum("bhts,bshd->bthd", probs, v)
+        if self.attention_mode == "ring" and self.sp > 1:
+            # sequence stays sharded; KV blocks ride the sp ring (GQA
+            # grouping handled inside — kv heads are NOT repeated, so ring
+            # traffic is per-kv-head)
+            ctxv = ring_attention(q, k, v, mesh=self.mesh, causal=True)
+        else:
+            if groups > 1:
+                k = jnp.repeat(k, groups, axis=2)
+                v = jnp.repeat(v, groups, axis=2)
+            # heads-sharded attention (sp gathers T here)
+            scores = jnp.einsum("bthd,bshd->bhts", q, k) / np.sqrt(D)
+            mask = jnp.tril(jnp.ones((T, T), bool))
+            scores = jnp.where(mask, scores, jnp.finfo(scores.dtype).min)
+            probs = jax.nn.softmax(scores.astype(jnp.float32),
+                                   axis=-1).astype(h.dtype)
+            ctxv = jnp.einsum("bhts,bshd->bthd", probs, v)
         attn_out = jnp.einsum("bthd,hde->bte", ctxv, bp["wo"])
         h = self._wsc(h + attn_out, P(AXIS_DATA, AXIS_SEQ, None))
 
@@ -195,9 +220,15 @@ class LLaMATrainer:
         M = self.num_microbatches
         h = jnp.take(params["embed"], tokens, axis=0)
         h = self._wsc(h, P(AXIS_DATA, AXIS_SEQ, None))
+        # the sp ring inside the blocks needs sp bound by the SAME shard_map
+        # as pp (shardy forbids nested re-binding)
+        ring = self.attention_mode == "ring" and self.sp > 1
         pipe = spmd_pipeline(stage_fn_from_blocks(self._block_fn),
                              num_stages=self.pp, num_microbatches=M,
-                             mesh=self.mesh)
+                             mesh=self.mesh,
+                             extra_manual_axes=(AXIS_SEQ,) if ring else (),
+                             xs_spec=(P(None, None, AXIS_SEQ, None)
+                                      if ring else P()))
         h = unmicrobatch(pipe(params["blocks"], microbatch(h, M)))
         h = _rms_norm(h, params["norm"], c.rms_norm_eps)
         logits = jnp.einsum("bte,ev->btv", h, params["lm_head"])

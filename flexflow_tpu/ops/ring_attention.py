@@ -1,0 +1,142 @@
+"""Ring attention: sequence/context parallelism over ICI.
+
+The reference has NO sequence parallelism — the sequence dim is never a
+sharded dim anywhere in its PCG (SURVEY.md §5: no ring attention, no
+Ulysses; KV caches are dense per-shard).  Long-context support is therefore
+designed fresh here, TPU-first, as a first-class parallel dim alongside
+dp/tp/pp/ep:
+
+- q, k, v are sharded on the sequence dim over the `sp` mesh axis: each
+  device holds a T/S block.
+- Attention runs blockwise with the online-softmax (flash) recurrence:
+  each device computes its q-block against the kv-block it currently
+  holds, then the kv-block rotates one step around the `sp` ring via
+  `lax.ppermute`.  After S steps every q-block has seen every kv-block
+  while HBM only ever holds one kv-block per device, and the ppermute
+  overlaps with the block matmuls (XLA schedules the collective-permute
+  concurrently with compute on TPU).
+- Causal masking uses *global* positions (shard_index * block + offset),
+  so fully-future blocks contribute zero mass.
+
+Reverse-mode AD through the scan+ppermute yields the backward ring
+automatically (ppermute's transpose is the inverted ring).
+
+The math follows the blockwise-parallel-transformer / ring-attention
+formulation (PAPERS.md); the implementation is original and jit/GSPMD
+native: `sp` is the only manual axis, so dp sharding of the batch dim and
+tp sharding of the heads dim compose with it unchanged inside the same
+shard_map.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec
+
+from ..config import AXIS_SEQ
+
+P = PartitionSpec
+
+_NEG_BIG = -0.7 * float(np.finfo(np.float32).max)  # finite "-inf" (nan-safe)
+
+
+def _block_scores(q, k, scale):
+    """[b,t,h,d] x [b,s,kv,d] -> [b,h,t,s] with GQA grouping."""
+    b, t, h, d = q.shape
+    kv = k.shape[2]
+    g = h // kv
+    qg = q.reshape(b, t, kv, g, d)
+    s = jnp.einsum("btkgd,bskd->bkgts", qg.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    return s.reshape(b, kv * g, t, s.shape[-1])
+
+
+def _block_context(p, v):
+    """[b,h,t,s] x [b,s,kv,d] -> [b,t,h,d] with GQA grouping."""
+    b, h, t, s = p.shape
+    kv = v.shape[2]
+    g = h // kv
+    pg = p.reshape(b, kv, g, t, s)
+    o = jnp.einsum("bkgts,bskd->btkgd", pg, v.astype(jnp.float32))
+    return o.reshape(b, t, h, v.shape[3])
+
+
+def _ring_attention_sharded(q, k, v, *, axis: str, causal: bool,
+                            scale: float):
+    """Body run per-`sp`-shard inside shard_map; q [b, tl, h, d],
+    k/v [b, tl, kv, d] (tl = local sequence block)."""
+    num_shards = jax.lax.psum(1, axis)
+    my = jax.lax.axis_index(axis)
+    b, tl, h, d = q.shape
+    q_pos = my * tl + jnp.arange(tl)
+
+    o0 = jnp.zeros((b, tl, h, d), jnp.float32)
+    m0 = jnp.full((b, h, tl), _NEG_BIG, jnp.float32)
+    l0 = jnp.zeros((b, h, tl), jnp.float32)
+    ring = [(i, (i + 1) % num_shards) for i in range(num_shards)]
+
+    def step(carry, i):
+        o, m, l, k, v = carry
+        src = (my - i) % num_shards  # owner of the kv block we hold now
+        kv_pos = src * tl + jnp.arange(tl)
+        s = _block_scores(q, k, scale)  # [b,h,tl,tl] f32
+        if causal:
+            mask = kv_pos[None, :] <= q_pos[:, None]  # [tq, tk]
+            s = jnp.where(mask[None, None], s, _NEG_BIG)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        corr = jnp.exp(m - m_new)  # [b,h,tl]
+        p = jnp.exp(s - m_new[..., None])
+        l = l * corr + jnp.sum(p, axis=-1)
+        o = o * corr.transpose(0, 2, 1)[..., None] + _block_context(p, v)
+        k = jax.lax.ppermute(k, axis, ring)
+        v = jax.lax.ppermute(v, axis, ring)
+        return (o, m_new, l, k, v), None
+
+    (o, m, l, k, v), _ = jax.lax.scan(step, (o0, m0, l0, k, v),
+                                      jnp.arange(num_shards))
+    out = o / jnp.maximum(l, 1e-30).transpose(0, 2, 1)[..., None]
+    return out.astype(q.dtype)
+
+
+def manual_axis_active(axis: str) -> bool:
+    """True when tracing inside a shard_map that already binds `axis` as
+    manual (e.g. the pp pipeline binding sp for the ring)."""
+    m = jax.sharding.get_abstract_mesh()
+    return (not m.empty) and axis in getattr(m, "manual_axes", ())
+
+
+def ring_attention(q, k, v, *, mesh: Optional[Mesh] = None,
+                   axis: str = AXIS_SEQ, causal: bool = True,
+                   scale: Optional[float] = None):
+    """Sequence-parallel attention.
+
+    q: [b, T, h, d], k/v: [b, T, kv, d] with T sharded over `axis`
+    (kv may be < h for GQA/MQA; h % kv == 0).  Returns [b, T, h, d] with
+    the same sequence sharding.  When the mesh axis has size 1 (or no mesh)
+    this reduces to one local flash block — same code path, no collectives.
+
+    Composable two ways: called from auto-mode code it opens its own
+    shard_map over `axis`; called where `axis` is already manual (inside the
+    pp pipeline, which binds sp for it) it runs the ring body directly —
+    shardy forbids re-binding a parent's manual axis.
+    """
+    assert q.shape[2] % k.shape[2] == 0, (q.shape, k.shape)
+    scale = scale if scale is not None else 1.0 / np.sqrt(q.shape[-1])
+    body = functools.partial(_ring_attention_sharded, axis=axis,
+                             causal=causal, scale=scale)
+    if manual_axis_active(axis):
+        return body(q, k, v)
+    # inside jit with a context mesh, shard_map must use the context's
+    # AbstractMesh (mesh=None), not the concrete mesh
+    ctx_mesh = jax.sharding.get_abstract_mesh()
+    if not ctx_mesh.empty and axis in ctx_mesh.axis_names:
+        mesh = None
+    spec = P(None, axis, None, None)
+    return jax.shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
+                         out_specs=spec, axis_names=frozenset({axis}),
+                         check_vma=False)(q, k, v)

@@ -77,6 +77,8 @@ def spmd_pipeline(
     num_microbatches: int,
     axis: str = AXIS_PIPE,
     mesh: Optional[Mesh] = None,
+    extra_manual_axes: Sequence[str] = (),
+    xs_spec: Optional[PartitionSpec] = None,
 ) -> Callable[[Any, jax.Array], jax.Array]:
     """Build ``run(stacked_params, xs) -> ys``: a GPipe fill/drain pipeline.
 
@@ -92,6 +94,13 @@ def spmd_pipeline(
 
     stage_fn must preserve the activation shape (stage outputs feed the next
     stage's inputs over the ppermute ring).
+
+    ``extra_manual_axes`` binds additional mesh axes as manual inside the
+    pipeline body (shardy forbids a nested shard_map from re-binding a
+    parent's axis, so collectives the stage body issues — e.g. the sp ring
+    of ring_attention — must be bound HERE).  ``xs_spec`` then describes how
+    xs/ys are sharded over those axes (e.g. P(None, None, "sp", None) for
+    sequence-sharded [M, mb, T, E] activations).
     """
     S, M = num_stages, num_microbatches
     fwd_ring = [(i, i + 1) for i in range(S - 1)]
@@ -136,8 +145,12 @@ def spmd_pipeline(
             jnp.where(stage == S - 1, outs, jnp.zeros_like(outs)), axis)
         return outs
 
+    xs_spec = xs_spec if xs_spec is not None else P()
+
     if S == 1:
-        # degenerate pipeline: plain scan over microbatches, no collectives
+        # degenerate pipeline: plain scan over microbatches, no pp
+        # collectives (stage-body collectives like the sp ring open their
+        # own shard_map from auto mode)
         def run_single(stacked_params, xs):
             params = jax.tree.map(lambda p: jax.lax.squeeze(p, (0,)),
                                   stacked_params)
@@ -148,10 +161,10 @@ def spmd_pipeline(
         return run_single
 
     def run(stacked_params, xs):
-        in_specs = (jax.tree.map(lambda _: P(axis), stacked_params), P())
+        in_specs = (jax.tree.map(lambda _: P(axis), stacked_params), xs_spec)
         fn = jax.shard_map(
-            run_sharded, mesh=mesh, in_specs=in_specs, out_specs=P(),
-            axis_names=frozenset({axis}), check_vma=False)
+            run_sharded, mesh=mesh, in_specs=in_specs, out_specs=xs_spec,
+            axis_names=frozenset({axis, *extra_manual_axes}), check_vma=False)
         return fn(stacked_params, xs)
 
     return run

@@ -1,0 +1,111 @@
+"""Ring attention (sequence parallelism) tests on the virtual 8-device mesh.
+
+The correctness oracle is plain dense attention — values AND gradients must
+match across any sp sharding, causal and full, MHA and GQA.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from flexflow_tpu.config import AXIS_MODEL, AXIS_SEQ, FFConfig
+from flexflow_tpu.models.llama import LLAMAConfig
+from flexflow_tpu.models.llama_train import LLaMATrainer
+from flexflow_tpu.ops.ring_attention import ring_attention
+from flexflow_tpu.training.optimizer import SGDOptimizer
+
+
+def _dense_reference(q, k, v, causal):
+    h, kv = q.shape[2], k.shape[2]
+    if h != kv:
+        k = jnp.repeat(k, h // kv, axis=2)
+        v = jnp.repeat(v, h // kv, axis=2)
+    scores = jnp.einsum("bthd,bshd->bhts", q, k) / np.sqrt(q.shape[-1])
+    if causal:
+        T = q.shape[1]
+        mask = jnp.tril(jnp.ones((T, T), bool))
+        scores = jnp.where(mask, scores, -jnp.inf)
+    probs = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum("bhts,bshd->bthd", probs, v)
+
+
+def _qkv(b=2, t=32, h=4, kv=2, d=8, seed=0):
+    ks = jax.random.split(jax.random.PRNGKey(seed), 3)
+    return (jax.random.normal(ks[0], (b, t, h, d)),
+            jax.random.normal(ks[1], (b, t, kv, d)),
+            jax.random.normal(ks[2], (b, t, kv, d)))
+
+
+def _mesh(sp):
+    return Mesh(np.array(jax.devices()[:sp]), (AXIS_SEQ,))
+
+
+@pytest.mark.parametrize("sp", [1, 2, 4, 8])
+@pytest.mark.parametrize("causal", [True, False])
+def test_ring_matches_dense(sp, causal):
+    q, k, v = _qkv()
+    want = _dense_reference(q, k, v, causal)
+    mesh = _mesh(sp)
+    got = jax.jit(lambda q, k, v: ring_attention(
+        q, k, v, mesh=mesh, causal=causal))(q, k, v)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-6)
+
+
+def test_ring_mha_no_gqa():
+    q, k, v = _qkv(h=4, kv=4, seed=1)
+    want = _dense_reference(q, k, v, True)
+    got = ring_attention(q, k, v, mesh=_mesh(4), causal=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-6)
+
+
+def test_ring_grads_match_dense():
+    q, k, v = _qkv(seed=2)
+    mesh = _mesh(4)
+
+    def loss_ring(q, k, v):
+        return jnp.sum(ring_attention(q, k, v, mesh=mesh, causal=True) ** 2)
+
+    def loss_dense(q, k, v):
+        return jnp.sum(_dense_reference(q, k, v, True) ** 2)
+
+    g_ring = jax.jit(jax.grad(loss_ring, argnums=(0, 1, 2)))(q, k, v)
+    g_dense = jax.jit(jax.grad(loss_dense, argnums=(0, 1, 2)))(q, k, v)
+    for gr, gd in zip(g_ring, g_dense):
+        np.testing.assert_allclose(np.asarray(gr), np.asarray(gd),
+                                   rtol=5e-5, atol=5e-6)
+
+
+def test_ring_sequence_sharded_io():
+    """Inputs sharded over sp stay sharded — no all-gather of the sequence
+    dim in the compiled module."""
+    mesh = _mesh(4)
+    q, k, v = _qkv(t=64, seed=3)
+    shard = NamedSharding(mesh, P(None, AXIS_SEQ, None, None))
+    q, k, v = (jax.device_put(x, shard) for x in (q, k, v))
+    out = jax.jit(lambda q, k, v: ring_attention(
+        q, k, v, mesh=mesh, causal=True))(q, k, v)
+    assert out.sharding.spec == P(None, AXIS_SEQ, None, None)
+
+
+def test_trainer_ring_matches_gather_attention():
+    """Full train-graph check: ring vs megatron-gather attention give the
+    same loss on the same params."""
+    cfg = LLAMAConfig(vocab_size=64, hidden_size=32, intermediate_size=64,
+                      num_hidden_layers=2, num_attention_heads=4,
+                      num_key_value_heads=2)
+    tokens = jnp.asarray(
+        np.random.default_rng(0).integers(0, 64, (4, 32)), jnp.int32)
+
+    losses = {}
+    for mode in ("ring", "gather"):
+        ff = FFConfig(batch_size=4, sequence_parallelism_degree=4,
+                      tensor_parallelism_degree=2)
+        tr = LLaMATrainer(cfg, ff, optimizer=SGDOptimizer(lr=0.1),
+                          attention_mode=mode)
+        params = tr.init_params(jax.random.PRNGKey(0))
+        losses[mode] = float(jax.jit(tr.loss_fn)(params, tokens))
+    np.testing.assert_allclose(losses["ring"], losses["gather"], rtol=1e-5)
