@@ -127,10 +127,14 @@ class Model:
     def embedding(self, input: Tensor, num_entries: int, out_dim: int,
                   aggr: AggrMode = AggrMode.NONE,
                   dtype: DataType = DataType.FLOAT, kernel_initializer=None,
+                  input_offset: int = 0,
                   name: Optional[str] = None) -> Tensor:
+        """``input_offset`` is added to the ids before lookup (reference:
+        FFModel::set_position_offset — OPT looks positions up at +2)."""
         return self._add_layer(OpType.EMBEDDING, [input], dict(
             num_entries=num_entries, out_dim=out_dim, aggr=aggr, dtype=dtype,
-            kernel_initializer=kernel_initializer), name)[0]
+            kernel_initializer=kernel_initializer,
+            input_offset=input_offset), name)[0]
 
     def conv2d(self, input: Tensor, out_channels: int, kernel_h: int,
                kernel_w: int, stride_h: int, stride_w: int, padding_h: int,
@@ -292,28 +296,31 @@ class Model:
                 f"{what} currently normalizes the last axis only; got {axes}")
 
     def layer_norm(self, x: Tensor, axes=None, elementwise_affine=True,
-                   eps=1e-5, name=None) -> Tensor:
+                   eps=1e-5, use_bias=True, name=None) -> Tensor:
         self._check_last_axis_norm(x, axes, "layer_norm")
         return self._add_layer(OpType.LAYERNORM, [x], dict(
-            elementwise_affine=elementwise_affine, eps=eps), name)[0]
+            elementwise_affine=elementwise_affine, eps=eps,
+            use_bias=use_bias), name)[0]
 
     def residual_layer_norm(self, x: Tensor, residual1: Tensor,
                             residual2: Optional[Tensor] = None,
                             use_two_residuals: bool = False,
                             axes=None, elementwise_affine=True, eps=1e-5,
-                            name=None) -> Tuple[Tensor, Tensor]:
+                            use_bias=True, name=None) -> Tuple[Tensor, Tensor]:
         ins = [x, residual1] + ([residual2] if use_two_residuals else [])
         outs = self._add_layer(OpType.RESIDUAL_LAYERNORM, ins, dict(
-            elementwise_affine=elementwise_affine, eps=eps), name)
+            elementwise_affine=elementwise_affine, eps=eps,
+            use_bias=use_bias), name)
         return outs[0], outs[1]
 
     def add_bias_residual_layer_norm(self, x: Tensor, residual: Tensor,
                                      axes=None, elementwise_affine=True,
-                                     eps=1e-5, name=None) -> Tuple[Tensor, Tensor]:
+                                     eps=1e-5, use_bias=True,
+                                     name=None) -> Tuple[Tensor, Tensor]:
         outs = self._add_layer(OpType.ADD_BIAS_RESIDUAL_LAYERNORM,
                                [x, residual], dict(
                                    elementwise_affine=elementwise_affine,
-                                   eps=eps), name)
+                                   eps=eps, use_bias=use_bias), name)
         return outs[0], outs[1]
 
     def rms_norm(self, x: Tensor, eps: float = 1e-6, dim: Optional[int] = None,

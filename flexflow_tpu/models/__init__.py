@@ -1,3 +1,7 @@
 """Serving model zoo (reference: inference/models/ + python/flexflow/serve/models/)."""
 
+from . import falcon  # noqa: F401
 from . import llama  # noqa: F401
+from . import mpt  # noqa: F401
+from . import opt  # noqa: F401
+from . import starcoder  # noqa: F401

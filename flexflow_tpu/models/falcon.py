@@ -1,0 +1,229 @@
+"""Falcon-family graph builder for serving.
+
+TPU-native re-design of the reference's Falcon builder
+(inference/models/falcon.cc:40-240 create_falcon_model; Python twin
+python/flexflow/serve/models/falcon.py).  Layer recipe (parallel-attention
+decoder):
+
+  word_embeddings
+  -> N x [ input_layernorm (folding in the PREVIOUS block's mha+mlp
+           residuals, falcon.cc:78-92) -> { mqa(+RoPE) || dense_h_to_4h
+           -> gelu -> dense_4h_to_h } ]   (attention and MLP both read the
+           norm output — Falcon's parallel_attn block)
+  -> final residual_layer_norm(token, mha, mlp) -> lm_head -> sampling
+
+Covers HF `FalconForCausalLM` with parallel_attn=True (7B-style MQA and
+grouped-KV variants via n_head_kv).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from ..core.model import Model
+from ..fftype import DataType, InferenceMode
+from ..serving.request_manager import GenerationConfig
+from .llama import _finish_serving_graph, _np_of
+
+
+@dataclasses.dataclass
+class FalconConfig:
+    """Mirrors inference/models/falcon.h falcon_config."""
+
+    vocab_size: int = 65024
+    hidden_size: int = 4544
+    n_head: int = 71
+    n_head_kv: int = 1
+    n_layer: int = 32
+    layer_norm_epsilon: float = 1e-5
+    rope_theta: float = 10000.0
+    # Falcon-40B/180B style: separate ln_attn/ln_mlp per block (HF
+    # new_decoder_architecture).  The reference builder only covers the
+    # single-input_layernorm 7B form; we support both.
+    new_decoder_architecture: bool = False
+    # fused-qkv layout discriminator (old architecture): True = flat
+    # [q-heads | k | v] MQA packing, False = per-head-interleaved MHA
+    multi_query: bool = True
+    bos_token_id: int = 11
+    eos_token_id: int = 11
+
+    @classmethod
+    def from_hf(cls, hf) -> "FalconConfig":
+        get = (hf.get if isinstance(hf, dict)
+               else lambda k, d=None: getattr(hf, k, d))
+        if get("alibi", False):
+            raise NotImplementedError(
+                "ALiBi Falcon variants (falcon-rw) are not supported — the "
+                "reference builder likewise hardcodes RoPE "
+                "(falcon.cc apply_rotary_embedding=true)")
+        if not get("parallel_attn", True):
+            raise NotImplementedError(
+                "sequential-attention Falcon variants (parallel_attn=False) "
+                "are not supported — the reference builds the parallel "
+                "block only (falcon.cc:78-205)")
+        n_head = get("num_attention_heads", None) or get("n_head", 71)
+        # HF encodes MQA as multi_query=True (new_decoder_architecture
+        # uses num_kv_heads); the reference reads n_head_kv the same way
+        if get("new_decoder_architecture", False):
+            n_head_kv = get("num_kv_heads", None) or get("n_head_kv", n_head)
+        elif get("multi_query", True):
+            n_head_kv = 1
+        else:
+            n_head_kv = n_head
+        return cls(
+            multi_query=get("multi_query", True),
+            vocab_size=get("vocab_size", 65024),
+            hidden_size=get("hidden_size", 4544),
+            n_head=n_head,
+            n_head_kv=n_head_kv,
+            n_layer=get("num_hidden_layers", None) or get("n_layer", 32),
+            layer_norm_epsilon=get("layer_norm_epsilon", 1e-5),
+            rope_theta=get("rope_theta", 10000.0),
+            new_decoder_architecture=get("new_decoder_architecture", False),
+            bos_token_id=get("bos_token_id", 11),
+            eos_token_id=get("eos_token_id", 11),
+        )
+
+
+def create_falcon_model(model: Model, config: FalconConfig,
+                        mode: InferenceMode = InferenceMode.INC_DECODING,
+                        generation_config: Optional[GenerationConfig] = None,
+                        max_requests: int = 8, chunk: int = 1,
+                        dtype: DataType = DataType.FLOAT) -> Model:
+    """Build the serving graph (reference: inference/models/falcon.cc:40)."""
+    c = config
+    head_dim = c.hidden_size // c.n_head
+
+    tokens = model.create_tensor((max_requests, chunk), DataType.INT32,
+                                 name="tokens")
+    token = model.embedding(tokens, c.vocab_size, c.hidden_size, dtype=dtype,
+                            name="word_embeddings")
+
+    mha = mlp_output = None
+    for i in range(c.n_layer):
+        model.current_transformer_layer_id = i
+        pfx = f"layers_{i}"
+        if i == 0:
+            pass  # token is already the residual stream
+        elif c.new_decoder_architecture:
+            token = model.add(model.add(token, mha, name=f"{pfx}_res_attn"),
+                              mlp_output, name=f"{pfx}_res_mlp")
+        if c.new_decoder_architecture:
+            att_norm = model.layer_norm(token, eps=c.layer_norm_epsilon,
+                                        name=f"{pfx}_ln_attn")
+            mlp_norm = model.layer_norm(token, eps=c.layer_norm_epsilon,
+                                        name=f"{pfx}_ln_mlp")
+        elif i == 0:
+            att_norm = model.layer_norm(token, eps=c.layer_norm_epsilon,
+                                        name=f"{pfx}_input_layernorm")
+            mlp_norm = att_norm
+        else:
+            # (normed, residual_sum): norm feeds attention+MLP, the sum is
+            # the running residual stream (falcon.cc:78-92)
+            att_norm, token = model.residual_layer_norm(
+                token, mha, mlp_output, use_two_residuals=True,
+                eps=c.layer_norm_epsilon, name=f"{pfx}_input_layernorm")
+            mlp_norm = att_norm
+
+        attn_kw = dict(kdim=head_dim, vdim=head_dim, qkv_bias=False,
+                       final_bias=False, apply_rotary_embedding=True,
+                       rope_theta=c.rope_theta, name=f"{pfx}_attention")
+        if mode is InferenceMode.BEAM_SEARCH:
+            mha = model.spec_inc_multihead_self_attention(
+                att_norm, c.hidden_size, c.n_head, c.n_head_kv, **attn_kw)
+        elif mode is InferenceMode.TREE_VERIFY:
+            mha = model.tree_inc_multihead_self_attention(
+                att_norm, c.hidden_size, c.n_head, c.n_head_kv, **attn_kw)
+        else:
+            mha = model.inc_multiquery_self_attention(
+                att_norm, c.hidden_size, c.n_head, c.n_head_kv, **attn_kw)
+
+        h4 = model.dense(mlp_norm, 4 * c.hidden_size, use_bias=False,
+                         name=f"{pfx}_mlp_dense_h_to_4h")
+        model.layers[-1].attrs["shard"] = "col"
+        act = model.gelu(h4, name=f"{pfx}_mlp_gelu")
+        mlp_output = model.dense(act, c.hidden_size, use_bias=False,
+                                 name=f"{pfx}_mlp_dense_4h_to_h")
+        model.layers[-1].attrs["shard"] = "row"
+
+    model.current_transformer_layer_id = -1
+    if c.n_layer == 0:
+        final_norm = model.layer_norm(token, eps=c.layer_norm_epsilon,
+                                      name="ln_f")
+    else:
+        final_norm, _ = model.residual_layer_norm(
+            token, mha, mlp_output, use_two_residuals=True,
+            eps=c.layer_norm_epsilon, name="ln_f")
+    _finish_serving_graph(model, final_norm, c.vocab_size, mode,
+                          generation_config)
+    return model
+
+
+def convert_hf_state_dict(state_dict: Dict[str, Any],
+                          config: FalconConfig) -> Dict[str, Dict[str, np.ndarray]]:
+    """HF FalconForCausalLM state dict -> framework params.
+
+    Falcon packs qkv as fused query_key_value [(H + 2*KV) * D, E]; the
+    reference unpacks per-head in FileDataLoader (file_loader.cc:81
+    multi-query variant) — here we slice the same layout in numpy.
+    """
+    c = config
+    H, KV = c.n_head, c.n_head_kv
+    D = c.hidden_size // H
+    E = c.hidden_size
+    sd = state_dict
+    pre = "transformer."
+
+    p: Dict[str, Dict[str, np.ndarray]] = {}
+    p["word_embeddings"] = {
+        "embedding": _np_of(sd[pre + "word_embeddings.weight"])}
+    for i in range(c.n_layer):
+        hf = f"{pre}h.{i}."
+        pfx = f"layers_{i}"
+        if c.new_decoder_architecture:
+            p[f"{pfx}_ln_attn"] = {
+                "weight": _np_of(sd[hf + "ln_attn.weight"]),
+                "bias": _np_of(sd[hf + "ln_attn.bias"])}
+            p[f"{pfx}_ln_mlp"] = {
+                "weight": _np_of(sd[hf + "ln_mlp.weight"]),
+                "bias": _np_of(sd[hf + "ln_mlp.bias"])}
+        else:
+            p[f"{pfx}_input_layernorm"] = {
+                "weight": _np_of(sd[hf + "input_layernorm.weight"]),
+                "bias": _np_of(sd[hf + "input_layernorm.bias"])}
+        qkv = _np_of(sd[hf + "self_attention.query_key_value.weight"])
+        # layout determined by CONFIG, never by shape (KV == H checkpoints
+        # exist in both packings and would silently mis-slice)
+        if c.new_decoder_architecture:
+            # grouped layout [KV groups x (H/KV q heads + k + v), D, E]
+            g = H // KV
+            qkv = qkv.reshape(KV, g + 2, D, E)
+            wq = qkv[:, :g].reshape(H, D, E)
+            wk = qkv[:, g].reshape(KV, D, E)
+            wv = qkv[:, g + 1].reshape(KV, D, E)
+        elif c.multi_query:
+            # flat [q heads | one k | one v]
+            wq = qkv[: H * D].reshape(H, D, E)
+            wk = qkv[H * D: (H + KV) * D].reshape(KV, D, E)
+            wv = qkv[(H + KV) * D:].reshape(KV, D, E)
+        else:
+            # old MHA: per-head interleaved [H, (q,k,v), D, E]
+            qkv = qkv.reshape(H, 3, D, E)
+            wq, wk, wv = qkv[:, 0], qkv[:, 1], qkv[:, 2]
+        wo = _np_of(sd[hf + "self_attention.dense.weight"])  # [E, H*D]
+        p[f"{pfx}_attention"] = {
+            "wq": wq.transpose(2, 0, 1), "wk": wk.transpose(2, 0, 1),
+            "wv": wv.transpose(2, 0, 1),
+            "wo": wo.reshape(E, H, D).transpose(1, 2, 0)}
+        p[f"{pfx}_mlp_dense_h_to_4h"] = {
+            "kernel": _np_of(sd[hf + "mlp.dense_h_to_4h.weight"]).T}
+        p[f"{pfx}_mlp_dense_4h_to_h"] = {
+            "kernel": _np_of(sd[hf + "mlp.dense_4h_to_h.weight"]).T}
+    p["ln_f"] = {"weight": _np_of(sd[pre + "ln_f.weight"]),
+                 "bias": _np_of(sd[pre + "ln_f.bias"])}
+    lm = sd.get("lm_head.weight", sd[pre + "word_embeddings.weight"])  # tied
+    p["lm_head"] = {"kernel": _np_of(lm).T}
+    return p

@@ -130,11 +130,20 @@ def create_llama_model(model: Model, config: LLAMAConfig,
     model.current_transformer_layer_id = -1
     final_norm, _ = model.residual_rms_norm(t, residual, eps=c.rms_norm_eps,
                                             name="norm")
-    lm_head = model.dense(final_norm, c.vocab_size, use_bias=False,
+    _finish_serving_graph(model, final_norm, c.vocab_size, mode, gen)
+    return model
+
+
+def _finish_serving_graph(model: Model, final_hidden, vocab_size: int,
+                          mode: InferenceMode,
+                          generation_config: Optional[GenerationConfig]):
+    """Shared serving-graph tail: lm_head + per-mode sampling head
+    (reference: the common epilogue of every inference/models/*.cc builder,
+    e.g. llama.cc:232-259)."""
+    gen = generation_config or GenerationConfig()
+    lm_head = model.dense(final_hidden, vocab_size, use_bias=False,
                           name="lm_head")
     model.layers[-1].attrs["shard"] = "col"
-
-    # sampling head per mode (reference llama.cc:232-259)
     if mode is InferenceMode.BEAM_SEARCH:
         from ..serving.batch_config import BeamSearchBatchConfig
         softmax = model.softmax(lm_head, name="softmax")
@@ -150,6 +159,13 @@ def create_llama_model(model: Model, config: LLAMAConfig,
 
 
 # ---------------------------------------------------------------- weights
+def _np_of(v) -> np.ndarray:
+    """torch tensor / array-like -> float32 numpy (shared by all model
+    converters)."""
+    return np.asarray(v.detach().cpu().numpy() if hasattr(v, "detach")
+                      else v, np.float32)
+
+
 def convert_hf_state_dict(state_dict: Dict[str, Any],
                           config: LLAMAConfig) -> Dict[str, Dict[str, np.ndarray]]:
     """HF LlamaForCausalLM state dict -> framework params.
@@ -164,23 +180,19 @@ def convert_hf_state_dict(state_dict: Dict[str, Any],
     D = c.hidden_size // H
     E = c.hidden_size
 
-    def np_of(v):
-        return np.asarray(v.detach().cpu().numpy() if hasattr(v, "detach")
-                          else v, np.float32)
-
     p: Dict[str, Dict[str, np.ndarray]] = {}
-    p["embed_tokens"] = {"embedding": np_of(state_dict["model.embed_tokens.weight"])}
+    p["embed_tokens"] = {"embedding": _np_of(state_dict["model.embed_tokens.weight"])}
     for i in range(c.num_hidden_layers):
         hf = f"model.layers.{i}."
         pfx = f"layers_{i}"
         p[f"{pfx}_input_layernorm"] = {
-            "weight": np_of(state_dict[hf + "input_layernorm.weight"])}
+            "weight": _np_of(state_dict[hf + "input_layernorm.weight"])}
         p[f"{pfx}_post_attention_layernorm"] = {
-            "weight": np_of(state_dict[hf + "post_attention_layernorm.weight"])}
-        wq = np_of(state_dict[hf + "self_attn.q_proj.weight"])  # [H*D, E]
-        wk = np_of(state_dict[hf + "self_attn.k_proj.weight"])  # [KV*D, E]
-        wv = np_of(state_dict[hf + "self_attn.v_proj.weight"])
-        wo = np_of(state_dict[hf + "self_attn.o_proj.weight"])  # [E, H*D]
+            "weight": _np_of(state_dict[hf + "post_attention_layernorm.weight"])}
+        wq = _np_of(state_dict[hf + "self_attn.q_proj.weight"])  # [H*D, E]
+        wk = _np_of(state_dict[hf + "self_attn.k_proj.weight"])  # [KV*D, E]
+        wv = _np_of(state_dict[hf + "self_attn.v_proj.weight"])
+        wo = _np_of(state_dict[hf + "self_attn.o_proj.weight"])  # [E, H*D]
         p[f"{pfx}_attention"] = {
             "wq": wq.reshape(H, D, E).transpose(2, 0, 1),
             "wk": wk.reshape(KV, D, E).transpose(2, 0, 1),
@@ -188,13 +200,13 @@ def convert_hf_state_dict(state_dict: Dict[str, Any],
             "wo": wo.reshape(E, H, D).transpose(1, 2, 0),
         }
         p[f"{pfx}_mlp_gate_proj"] = {
-            "kernel": np_of(state_dict[hf + "mlp.gate_proj.weight"]).T}
+            "kernel": _np_of(state_dict[hf + "mlp.gate_proj.weight"]).T}
         p[f"{pfx}_mlp_up_proj"] = {
-            "kernel": np_of(state_dict[hf + "mlp.up_proj.weight"]).T}
+            "kernel": _np_of(state_dict[hf + "mlp.up_proj.weight"]).T}
         p[f"{pfx}_mlp_down_proj"] = {
-            "kernel": np_of(state_dict[hf + "mlp.down_proj.weight"]).T}
-    p["norm"] = {"weight": np_of(state_dict["model.norm.weight"])}
+            "kernel": _np_of(state_dict[hf + "mlp.down_proj.weight"]).T}
+    p["norm"] = {"weight": _np_of(state_dict["model.norm.weight"])}
     lm = state_dict.get("lm_head.weight",
                         state_dict["model.embed_tokens.weight"])  # tied
-    p["lm_head"] = {"kernel": np_of(lm).T}
+    p["lm_head"] = {"kernel": _np_of(lm).T}
     return p

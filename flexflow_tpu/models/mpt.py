@@ -1,0 +1,153 @@
+"""MPT-family graph builder for serving.
+
+TPU-native re-design of the reference's MPT builder
+(inference/models/mpt.cc:40-250 create_mpt_model; Python twin
+python/flexflow/serve/models/mpt.py).  Layer recipe:
+
+  wte -> N x [ norm_1 (bias-free LN) -> mha(ALiBi position bias, q scaled
+          d^-0.5, no qk-prod scaling, no biases) -> norm_2 -> up_proj ->
+          gelu -> down_proj ]
+  -> norm_f -> lm_head (tied to wte) -> sampling head
+
+MPT has no positional embeddings — attention carries ALiBi bias
+(position_bias=True; slopes per inc_multihead_self_attention.cu:304-325).
+Covers HF `MptForCausalLM` with no_bias=True.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from ..core.model import Model
+from ..fftype import DataType, InferenceMode
+from ..serving.request_manager import GenerationConfig
+from .llama import _finish_serving_graph, _np_of
+
+
+@dataclasses.dataclass
+class MPTConfig:
+    """Mirrors inference/models/mpt.h mpt_config."""
+
+    vocab_size: int = 50368
+    hidden_size: int = 4096
+    n_heads: int = 32
+    n_layers: int = 32
+    bos_token_id: int = 0
+    eos_token_id: int = 0
+
+    @classmethod
+    def from_hf(cls, hf) -> "MPTConfig":
+        get = (hf.get if isinstance(hf, dict)
+               else lambda k, d=None: getattr(hf, k, d))
+        return cls(
+            vocab_size=get("vocab_size", 50368),
+            hidden_size=get("d_model", None) or get("hidden_size", 4096),
+            n_heads=get("n_heads", 32),
+            n_layers=get("n_layers", 32),
+            bos_token_id=get("bos_token_id", None) or 0,
+            eos_token_id=get("eos_token_id", None) or 0,
+        )
+
+
+def create_mpt_model(model: Model, config: MPTConfig,
+                     mode: InferenceMode = InferenceMode.INC_DECODING,
+                     generation_config: Optional[GenerationConfig] = None,
+                     max_requests: int = 8, chunk: int = 1,
+                     dtype: DataType = DataType.FLOAT) -> Model:
+    """Build the serving graph (reference: inference/models/mpt.cc:40)."""
+    c = config
+    head_dim = c.hidden_size // c.n_heads
+
+    tokens = model.create_tensor((max_requests, chunk), DataType.INT32,
+                                 name="tokens")
+    hidden_states = model.embedding(tokens, c.vocab_size, c.hidden_size,
+                                    dtype=dtype, name="transformer_wte")
+
+    intermediate_output = None
+    for i in range(c.n_layers):
+        model.current_transformer_layer_id = i
+        pfx = f"layers_{i}"
+        if i == 0:
+            layernorm_output = model.layer_norm(
+                hidden_states, eps=1e-5, use_bias=False,
+                name=f"{pfx}_norm_1")
+        else:
+            layernorm_output, hidden_states = model.residual_layer_norm(
+                intermediate_output, hidden_states, eps=1e-5, use_bias=False,
+                name=f"{pfx}_norm_1")
+
+        attn_kw = dict(kdim=head_dim, vdim=head_dim, qkv_bias=False,
+                       final_bias=False, apply_rotary_embedding=False,
+                       scaling_query=True, scaling_factor=head_dim ** -0.5,
+                       qk_prod_scaling=False, position_bias=True,
+                       name=f"{pfx}_attention")
+        if mode is InferenceMode.BEAM_SEARCH:
+            attn_outputs = model.spec_inc_multihead_self_attention(
+                layernorm_output, c.hidden_size, c.n_heads, c.n_heads,
+                **attn_kw)
+        elif mode is InferenceMode.TREE_VERIFY:
+            attn_outputs = model.tree_inc_multihead_self_attention(
+                layernorm_output, c.hidden_size, c.n_heads, c.n_heads,
+                **attn_kw)
+        else:
+            attn_outputs = model.inc_multihead_self_attention(
+                layernorm_output, c.hidden_size, c.n_heads, **attn_kw)
+
+        layernorm_output, hidden_states = model.residual_layer_norm(
+            attn_outputs, hidden_states, eps=1e-5, use_bias=False,
+            name=f"{pfx}_norm_2")
+
+        up = model.dense(layernorm_output, 4 * c.hidden_size, use_bias=False,
+                         name=f"{pfx}_ffn_up_proj")
+        model.layers[-1].attrs["shard"] = "col"
+        act = model.gelu(up, name=f"{pfx}_ffn_gelu")
+        intermediate_output = model.dense(act, c.hidden_size, use_bias=False,
+                                          name=f"{pfx}_ffn_down_proj")
+        model.layers[-1].attrs["shard"] = "row"
+
+    model.current_transformer_layer_id = -1
+    final_norm, _ = model.residual_layer_norm(
+        intermediate_output, hidden_states, eps=1e-5, use_bias=False,
+        name="transformer_norm_f")
+    _finish_serving_graph(model, final_norm, c.vocab_size, mode,
+                          generation_config)
+    return model
+
+
+def convert_hf_state_dict(state_dict: Dict[str, Any],
+                          config: MPTConfig) -> Dict[str, Dict[str, np.ndarray]]:
+    """HF MptForCausalLM state dict -> framework params.  MPT packs qkv as
+    fused Wqkv [3*E, E]."""
+    c = config
+    H = c.n_heads
+    D = c.hidden_size // H
+    E = c.hidden_size
+    sd = state_dict
+    pre = "transformer."
+
+    p: Dict[str, Dict[str, np.ndarray]] = {}
+    p["transformer_wte"] = {"embedding": _np_of(sd[pre + "wte.weight"])}
+    for i in range(c.n_layers):
+        hf = f"{pre}blocks.{i}."
+        pfx = f"layers_{i}"
+        p[f"{pfx}_norm_1"] = {"weight": _np_of(sd[hf + "norm_1.weight"])}
+        qkv = _np_of(sd[hf + "attn.Wqkv.weight"])  # [3E, E]
+        wq, wk, wv = qkv[:E], qkv[E:2 * E], qkv[2 * E:]
+        wo = _np_of(sd[hf + "attn.out_proj.weight"])  # [E, E]
+        p[f"{pfx}_attention"] = {
+            "wq": wq.reshape(H, D, E).transpose(2, 0, 1),
+            "wk": wk.reshape(H, D, E).transpose(2, 0, 1),
+            "wv": wv.reshape(H, D, E).transpose(2, 0, 1),
+            "wo": wo.reshape(E, H, D).transpose(1, 2, 0)}
+        p[f"{pfx}_norm_2"] = {"weight": _np_of(sd[hf + "norm_2.weight"])}
+        p[f"{pfx}_ffn_up_proj"] = {
+            "kernel": _np_of(sd[hf + "ffn.up_proj.weight"]).T}
+        p[f"{pfx}_ffn_down_proj"] = {
+            "kernel": _np_of(sd[hf + "ffn.down_proj.weight"]).T}
+    p["transformer_norm_f"] = {"weight": _np_of(sd[pre + "norm_f.weight"])}
+    # MPT always ties lm_head to wte
+    p["lm_head"] = {"kernel": _np_of(sd[pre + "wte.weight"]).T}
+    return p

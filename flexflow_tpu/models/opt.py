@@ -1,0 +1,173 @@
+"""OPT-family graph builder for serving.
+
+TPU-native re-design of the reference's OPT model builder
+(inference/models/opt.cc:23-280 create_opt_model; Python twin
+python/flexflow/serve/models/opt.py).  Layer recipe:
+
+  embed_tokens + embed_positions(+2 offset)
+  -> N x [ residual_layer_norm -> inc_mha(qkv_bias, q-scaled d^-0.5,
+           no qk-prod scaling) -> add_bias_residual_layer_norm
+           -> fc1 -> relu -> fc2 ]
+  -> final residual_layer_norm -> lm_head (tied) -> sampling head
+
+The out-projection bias lives in the add_bias_residual_layer_norm layer,
+exactly like the reference (opt.cc add_bias_residual_layer_norm call).
+Covers HF `OPTForCausalLM` with do_layer_norm_before=True (125M..66B).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from ..core.model import Model
+from ..fftype import DataType, InferenceMode
+from ..serving.request_manager import GenerationConfig
+from .llama import _finish_serving_graph, _np_of
+
+
+@dataclasses.dataclass
+class OPTConfig:
+    """Mirrors inference/models/opt.h opt_config (HF config.json fields)."""
+
+    vocab_size: int = 50272
+    hidden_size: int = 768
+    ffn_dim: int = 3072
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    max_position_embeddings: int = 2048
+    layer_norm_elementwise_affine: bool = True
+    word_embed_proj_dim: int = 768
+    bos_token_id: int = 2
+    eos_token_id: int = 2
+
+    @classmethod
+    def from_hf(cls, hf) -> "OPTConfig":
+        get = (hf.get if isinstance(hf, dict)
+               else lambda k, d=None: getattr(hf, k, d))
+        return cls(
+            vocab_size=get("vocab_size", 50272),
+            hidden_size=get("hidden_size", 768),
+            ffn_dim=get("ffn_dim", 3072),
+            num_hidden_layers=get("num_hidden_layers", 12),
+            num_attention_heads=get("num_attention_heads", 12),
+            max_position_embeddings=get("max_position_embeddings", 2048),
+            layer_norm_elementwise_affine=get(
+                "layer_norm_elementwise_affine", True),
+            word_embed_proj_dim=get("word_embed_proj_dim",
+                                    get("hidden_size", 768)),
+            bos_token_id=get("bos_token_id", 2),
+            eos_token_id=get("eos_token_id", 2),
+        )
+
+
+def create_opt_model(model: Model, config: OPTConfig,
+                     mode: InferenceMode = InferenceMode.INC_DECODING,
+                     generation_config: Optional[GenerationConfig] = None,
+                     max_requests: int = 8, chunk: int = 1,
+                     dtype: DataType = DataType.FLOAT) -> Model:
+    """Build the serving graph (reference: inference/models/opt.cc:23)."""
+    c = config
+    assert c.word_embed_proj_dim == c.hidden_size, (
+        "word_embed_proj_dim != hidden_size (OPT-350M's project_in/out) is "
+        "not supported — the reference has the same restriction "
+        "(opt.cc adds token and positional embeddings directly)")
+    head_dim = c.hidden_size // c.num_attention_heads
+    affine = c.layer_norm_elementwise_affine
+
+    tokens = model.create_tensor((max_requests, chunk), DataType.INT32,
+                                 name="tokens")
+    positions = model.create_tensor((max_requests, chunk), DataType.INT32,
+                                    name="positions")
+    token = model.embedding(tokens, c.vocab_size, c.hidden_size, dtype=dtype,
+                            name="embed_tokens")
+    # reference: ff.set_position_offset(2) — HF OPT looks positions up at +2
+    pos_emb = model.embedding(positions, c.max_position_embeddings + 2,
+                              c.hidden_size, dtype=dtype, input_offset=2,
+                              name="embed_positions")
+
+    added, fc2 = token, pos_emb
+    for i in range(c.num_hidden_layers):
+        model.current_transformer_layer_id = i
+        pfx = f"layers_{i}"
+        hidden, residual = model.residual_layer_norm(
+            added, fc2, elementwise_affine=affine, eps=1e-5,
+            name=f"{pfx}_attention_layer_norm")
+
+        mha = model.inc_multihead_self_attention(
+            hidden, c.hidden_size, c.num_attention_heads,
+            qkv_bias=True, final_bias=False, apply_rotary_embedding=False,
+            scaling_query=True, scaling_factor=head_dim ** -0.5,
+            qk_prod_scaling=False, name=f"{pfx}_attention")
+
+        # (normed, sum): norm feeds the FFN, the bias+residual sum is the
+        # running stream (reference opt.cc: added=outputs[0]=sum there)
+        ffn_in, added = model.add_bias_residual_layer_norm(
+            mha, residual, elementwise_affine=affine, eps=1e-5,
+            name=f"{pfx}_add_bias_residual_layer_norm")
+        fc1 = model.dense(ffn_in, c.ffn_dim, name=f"{pfx}_fc1")
+        act = model.relu(fc1, name=f"{pfx}_relu")
+        fc2 = model.dense(act, c.hidden_size, name=f"{pfx}_fc2")
+        model.layers[-1].attrs["shard"] = "row"
+        model.layers[-3].attrs["shard"] = "col"
+
+    model.current_transformer_layer_id = -1
+    final_norm, _ = model.residual_layer_norm(
+        added, fc2, elementwise_affine=affine, eps=1e-5,
+        name="final_layer_norm")
+    _finish_serving_graph(model, final_norm, c.vocab_size, mode,
+                          generation_config)
+    return model
+
+
+def convert_hf_state_dict(state_dict: Dict[str, Any],
+                          config: OPTConfig) -> Dict[str, Dict[str, np.ndarray]]:
+    """HF OPTForCausalLM state dict -> framework params (reference analogue:
+    serve/models/opt.py convert_hf_model)."""
+    c = config
+    H = c.num_attention_heads
+    D = c.hidden_size // H
+    E = c.hidden_size
+    sd = state_dict
+    pre = "model.decoder."
+
+    p: Dict[str, Dict[str, np.ndarray]] = {}
+    p["embed_tokens"] = {"embedding": _np_of(sd[pre + "embed_tokens.weight"])}
+    p["embed_positions"] = {
+        "embedding": _np_of(sd[pre + "embed_positions.weight"])}
+    for i in range(c.num_hidden_layers):
+        hf = f"{pre}layers.{i}."
+        pfx = f"layers_{i}"
+        p[f"{pfx}_attention_layer_norm"] = {
+            "weight": _np_of(sd[hf + "self_attn_layer_norm.weight"]),
+            "bias": _np_of(sd[hf + "self_attn_layer_norm.bias"])}
+        wq = _np_of(sd[hf + "self_attn.q_proj.weight"])  # [H*D, E]
+        wk = _np_of(sd[hf + "self_attn.k_proj.weight"])
+        wv = _np_of(sd[hf + "self_attn.v_proj.weight"])
+        wo = _np_of(sd[hf + "self_attn.out_proj.weight"])  # [E, H*D]
+        p[f"{pfx}_attention"] = {
+            "wq": wq.reshape(H, D, E).transpose(2, 0, 1),
+            "wk": wk.reshape(H, D, E).transpose(2, 0, 1),
+            "wv": wv.reshape(H, D, E).transpose(2, 0, 1),
+            "wo": wo.reshape(E, H, D).transpose(1, 2, 0),
+            "bq": _np_of(sd[hf + "self_attn.q_proj.bias"]).reshape(H, D),
+            "bk": _np_of(sd[hf + "self_attn.k_proj.bias"]).reshape(H, D),
+            "bv": _np_of(sd[hf + "self_attn.v_proj.bias"]).reshape(H, D),
+        }
+        # out_proj bias folds into the fused add+norm (opt.cc semantics)
+        p[f"{pfx}_add_bias_residual_layer_norm"] = {
+            "attn_bias": _np_of(sd[hf + "self_attn.out_proj.bias"]),
+            "weight": _np_of(sd[hf + "final_layer_norm.weight"]),
+            "bias": _np_of(sd[hf + "final_layer_norm.bias"])}
+        p[f"{pfx}_fc1"] = {"kernel": _np_of(sd[hf + "fc1.weight"]).T,
+                           "bias": _np_of(sd[hf + "fc1.bias"])}
+        p[f"{pfx}_fc2"] = {"kernel": _np_of(sd[hf + "fc2.weight"]).T,
+                           "bias": _np_of(sd[hf + "fc2.bias"])}
+    p["final_layer_norm"] = {
+        "weight": _np_of(sd[pre + "final_layer_norm.weight"]),
+        "bias": _np_of(sd[pre + "final_layer_norm.bias"])}
+    lm = sd.get("lm_head.weight", sd[pre + "embed_tokens.weight"])  # tied
+    p["lm_head"] = {"kernel": _np_of(lm).T}
+    return p

@@ -1,0 +1,168 @@
+"""StarCoder (GPT-BigCode) graph builder for serving.
+
+TPU-native re-design of the reference's StarCoder builder
+(inference/models/starcoder.cc:40-220 create_starcoder_model; Python twin
+python/flexflow/serve/models/starcoder.py).  Layer recipe:
+
+  wte + wpe -> N x [ ln_1 -> mqa(1 kv head, qkv bias) -> ln_2 ->
+                     c_fc -> gelu -> c_proj ]
+  -> ln_f -> lm_head (tied) -> sampling head
+
+Divergence from the reference: the attention out-projection bias
+(c_proj.bias) is kept (final_bias=True) — the reference drops it
+(starcoder.cc passes final_bias=false), which misaligns with HF by a
+constant per layer; we match HF `GPTBigCodeForCausalLM` exactly.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from ..core.model import Model
+from ..fftype import DataType, InferenceMode
+from ..serving.request_manager import GenerationConfig
+from .llama import _finish_serving_graph, _np_of
+
+
+@dataclasses.dataclass
+class STARCODERConfig:
+    """Mirrors inference/models/starcoder.h startcoder_config."""
+
+    vocab_size: int = 49152
+    hidden_size: int = 6144
+    num_attention_heads: int = 48
+    num_hidden_layers: int = 40
+    intermediate_size: int = 24576
+    max_position_embeddings: int = 8192
+    layer_norm_epsilon: float = 1e-5
+    dropout_p: float = 0.0
+    bos_token_id: int = 0
+    eos_token_id: int = 0
+
+    @classmethod
+    def from_hf(cls, hf) -> "STARCODERConfig":
+        get = (hf.get if isinstance(hf, dict)
+               else lambda k, d=None: getattr(hf, k, d))
+        hidden = get("n_embd", None) or get("hidden_size", 6144)
+        return cls(
+            vocab_size=get("vocab_size", 49152),
+            hidden_size=hidden,
+            num_attention_heads=get("n_head", None)
+            or get("num_attention_heads", 48),
+            num_hidden_layers=get("n_layer", None)
+            or get("num_hidden_layers", 40),
+            intermediate_size=get("n_inner", None) or 4 * hidden,
+            max_position_embeddings=get("n_positions", None)
+            or get("max_position_embeddings", 8192),
+            layer_norm_epsilon=get("layer_norm_epsilon", 1e-5),
+            dropout_p=get("attn_pdrop", 0.0),
+            bos_token_id=get("bos_token_id", 0),
+            eos_token_id=get("eos_token_id", 0),
+        )
+
+
+def create_starcoder_model(
+        model: Model, config: STARCODERConfig,
+        mode: InferenceMode = InferenceMode.INC_DECODING,
+        generation_config: Optional[GenerationConfig] = None,
+        max_requests: int = 8, chunk: int = 1,
+        dtype: DataType = DataType.FLOAT) -> Model:
+    """Build the serving graph (reference: inference/models/starcoder.cc:40).
+
+    The reference only wires INC_DECODING for StarCoder (starcoder.cc mode
+    switch has a single case); we do the same.
+    """
+    c = config
+    if mode is not InferenceMode.INC_DECODING:
+        raise NotImplementedError(
+            "StarCoder supports incremental decoding only (the reference's "
+            "mode switch is identical, starcoder.cc:100-130)")
+
+    tokens = model.create_tensor((max_requests, chunk), DataType.INT32,
+                                 name="tokens")
+    positions = model.create_tensor((max_requests, chunk), DataType.INT32,
+                                    name="positions")
+    token = model.embedding(tokens, c.vocab_size, c.hidden_size, dtype=dtype,
+                            name="transformer_wte")
+    pos_emb = model.embedding(positions, c.max_position_embeddings,
+                              c.hidden_size, dtype=dtype,
+                              name="transformer_wpe")
+
+    hidden_states, c_proj = token, pos_emb
+    for i in range(c.num_hidden_layers):
+        model.current_transformer_layer_id = i
+        pfx = f"layers_{i}"
+        ln_1, hidden_states = model.residual_layer_norm(
+            hidden_states, c_proj, eps=c.layer_norm_epsilon,
+            name=f"{pfx}_ln_1")
+
+        mha = model.inc_multiquery_self_attention(
+            ln_1, c.hidden_size, c.num_attention_heads, 1,
+            dropout=c.dropout_p, qkv_bias=True, final_bias=True,
+            apply_rotary_embedding=False, name=f"{pfx}_attention")
+
+        ln_2, hidden_states = model.residual_layer_norm(
+            hidden_states, mha, eps=c.layer_norm_epsilon,
+            name=f"{pfx}_ln_2")
+
+        c_fc = model.dense(ln_2, c.intermediate_size, name=f"{pfx}_mlp_c_fc")
+        model.layers[-1].attrs["shard"] = "col"
+        act = model.gelu(c_fc, name=f"{pfx}_mlp_gelu")
+        c_proj = model.dense(act, c.hidden_size, name=f"{pfx}_mlp_c_proj")
+        model.layers[-1].attrs["shard"] = "row"
+
+    model.current_transformer_layer_id = -1
+    final_norm, _ = model.residual_layer_norm(
+        hidden_states, c_proj, eps=c.layer_norm_epsilon, name="ln_f")
+    _finish_serving_graph(model, final_norm, c.vocab_size, mode,
+                          generation_config)
+    return model
+
+
+def convert_hf_state_dict(state_dict: Dict[str, Any],
+                          config: STARCODERConfig
+                          ) -> Dict[str, Dict[str, np.ndarray]]:
+    """HF GPTBigCodeForCausalLM state dict -> framework params.  c_attn is
+    fused [E + 2*D, E] (q heads then one shared k and v head)."""
+    c = config
+    H = c.num_attention_heads
+    D = c.hidden_size // H
+    E = c.hidden_size
+    sd = state_dict
+    pre = "transformer."
+
+    p: Dict[str, Dict[str, np.ndarray]] = {}
+    p["transformer_wte"] = {"embedding": _np_of(sd[pre + "wte.weight"])}
+    p["transformer_wpe"] = {"embedding": _np_of(sd[pre + "wpe.weight"])}
+    for i in range(c.num_hidden_layers):
+        hf = f"{pre}h.{i}."
+        pfx = f"layers_{i}"
+        p[f"{pfx}_ln_1"] = {"weight": _np_of(sd[hf + "ln_1.weight"]),
+                            "bias": _np_of(sd[hf + "ln_1.bias"])}
+        w = _np_of(sd[hf + "attn.c_attn.weight"])  # [E + 2D, E]
+        b = _np_of(sd[hf + "attn.c_attn.bias"])
+        wo = _np_of(sd[hf + "attn.c_proj.weight"])  # [E, E]
+        p[f"{pfx}_attention"] = {
+            "wq": w[:E].reshape(H, D, E).transpose(2, 0, 1),
+            "wk": w[E:E + D].reshape(1, D, E).transpose(2, 0, 1),
+            "wv": w[E + D:].reshape(1, D, E).transpose(2, 0, 1),
+            "wo": wo.reshape(E, H, D).transpose(1, 2, 0),
+            "bq": b[:E].reshape(H, D),
+            "bk": b[E:E + D].reshape(1, D),
+            "bv": b[E + D:].reshape(1, D),
+            "bo": _np_of(sd[hf + "attn.c_proj.bias"])}
+        p[f"{pfx}_ln_2"] = {"weight": _np_of(sd[hf + "ln_2.weight"]),
+                            "bias": _np_of(sd[hf + "ln_2.bias"])}
+        p[f"{pfx}_mlp_c_fc"] = {"kernel": _np_of(sd[hf + "mlp.c_fc.weight"]).T,
+                                "bias": _np_of(sd[hf + "mlp.c_fc.bias"])}
+        p[f"{pfx}_mlp_c_proj"] = {
+            "kernel": _np_of(sd[hf + "mlp.c_proj.weight"]).T,
+            "bias": _np_of(sd[hf + "mlp.c_proj.bias"])}
+    p["ln_f"] = {"weight": _np_of(sd[pre + "ln_f.weight"]),
+                 "bias": _np_of(sd[pre + "ln_f.bias"])}
+    lm = sd.get("lm_head.weight", sd[pre + "wte.weight"])  # tied
+    p["lm_head"] = {"kernel": _np_of(lm).T}
+    return p

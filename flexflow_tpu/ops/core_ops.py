@@ -94,6 +94,9 @@ class Embedding(OpDef):
     def forward(self, params, inputs, attrs, ctx):
         (ids,) = inputs
         table = params["embedding"]
+        offset = attrs.get("input_offset", 0)
+        if offset:
+            ids = ids + offset
         out = jnp.take(table, ids, axis=0)
         aggr = attrs.get("aggr", AggrMode.NONE)
         if aggr is AggrMode.SUM:

@@ -45,7 +45,10 @@ def _norm_params(attrs, in_specs, elementwise_affine=True, rms=False):
     ps = []
     if elementwise_affine or rms:
         ps.append(ParamSpec("weight", (dim,), dtype, ConstantInitializer(1.0)))
-    if elementwise_affine and not rms:
+    # the reference's layer_norm takes use_bias separately from
+    # elementwise_affine (model.h layer_norm(..., elementwise_affine, eps,
+    # use_bias, ...)); MPT norms are affine-without-bias
+    if elementwise_affine and not rms and attrs.get("use_bias", True):
         ps.append(ParamSpec("bias", (dim,), dtype, ZeroInitializer()))
     return ps
 

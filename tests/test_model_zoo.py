@@ -1,0 +1,113 @@
+"""Model-zoo HF alignment tests: OPT, Falcon, MPT, StarCoder.
+
+Mirrors the reference's inference CI gates
+(tests/inference/python_inference_tests.sh: HF ground truth via
+huggingface_inference.py) — greedy decode from our serving stack must
+token-match `transformers` exactly for each architecture family.
+"""
+
+import numpy as np
+import pytest
+
+from flexflow_tpu import FFConfig, Model
+from flexflow_tpu.fftype import InferenceMode
+from flexflow_tpu.serving import InferenceManager, RequestManager
+
+transformers = pytest.importorskip("transformers")
+import torch  # noqa: E402
+
+
+def _hf_greedy(hf, prompt_ids, n_new):
+    ids = torch.tensor([list(prompt_ids)])
+    with torch.no_grad():
+        out = hf.generate(ids, max_new_tokens=n_new, do_sample=False,
+                          eos_token_id=None, pad_token_id=0)
+    return out[0, len(prompt_ids):].tolist()
+
+
+def _ff_greedy(model, prompts, n_new, max_requests=4):
+    im = InferenceManager(model.config)
+    mid = im.compile_model_and_allocate_buffer(
+        model, max_requests=max_requests, max_seq_length=128,
+        cache_dtype=np.float32)
+    rm = RequestManager(max_requests_per_batch=max_requests,
+                        max_tokens_per_batch=32, max_sequence_length=128)
+    reqs = [rm.register_new_request(list(p), max_new_tokens=n_new)
+            for p in prompts]
+    rm.generate_incr_decoding(im, mid, reqs)
+    return [r.tokens[r.prompt_len:] for r in reqs]
+
+
+def _check_family(hf_model, build, convert, config, prompts, n_new=12):
+    model = Model(FFConfig(), name=f"zoo_{type(hf_model).__name__}")
+    build(model, config, mode=InferenceMode.INC_DECODING, max_requests=4)
+    model.params = convert(hf_model.state_dict(), config)
+    got = _ff_greedy(model, prompts, n_new)
+    for prompt, g in zip(prompts, got):
+        want = _hf_greedy(hf_model, prompt, n_new)
+        assert g == want, f"{type(hf_model).__name__} {prompt}:\n ff={g}\n hf={want}"
+
+
+class TestOPT:
+    def test_greedy_token_match(self):
+        from flexflow_tpu.models.opt import (OPTConfig, convert_hf_state_dict,
+                                             create_opt_model)
+        torch.manual_seed(0)
+        hf_cfg = transformers.OPTConfig(
+            vocab_size=128, hidden_size=32, ffn_dim=64, num_hidden_layers=2,
+            num_attention_heads=4, max_position_embeddings=64,
+            do_layer_norm_before=True, word_embed_proj_dim=32)
+        hf = transformers.OPTForCausalLM(hf_cfg).eval()
+        cfg = OPTConfig.from_hf(hf.config)
+        _check_family(hf, create_opt_model, convert_hf_state_dict, cfg,
+                      [[2, 5, 9, 42], [2, 17, 3, 99, 23, 54], [2, 7]])
+
+
+class TestFalcon:
+    @pytest.mark.parametrize("kv_mode", ["mqa", "gqa"])
+    def test_greedy_token_match(self, kv_mode):
+        from flexflow_tpu.models.falcon import (FalconConfig,
+                                                convert_hf_state_dict,
+                                                create_falcon_model)
+        torch.manual_seed(1)
+        kwargs = dict(vocab_size=128, hidden_size=32, num_hidden_layers=2,
+                      num_attention_heads=4, parallel_attn=True, bias=False,
+                      alibi=False)
+        if kv_mode == "mqa":
+            kwargs.update(multi_query=True, new_decoder_architecture=False)
+        else:
+            kwargs.update(new_decoder_architecture=True, num_kv_heads=2)
+        hf = transformers.FalconForCausalLM(
+            transformers.FalconConfig(**kwargs)).eval()
+        cfg = FalconConfig.from_hf(hf.config)
+        _check_family(hf, create_falcon_model, convert_hf_state_dict, cfg,
+                      [[11, 5, 9, 42], [11, 17, 3, 99, 23]])
+
+
+class TestMPT:
+    def test_greedy_token_match(self):
+        from flexflow_tpu.models.mpt import (MPTConfig, convert_hf_state_dict,
+                                             create_mpt_model)
+        torch.manual_seed(2)
+        hf_cfg = transformers.MptConfig(
+            vocab_size=128, d_model=32, n_heads=4, n_layers=2,
+            max_seq_len=128, no_bias=True)
+        hf = transformers.MptForCausalLM(hf_cfg).eval()
+        cfg = MPTConfig.from_hf(hf.config)
+        _check_family(hf, create_mpt_model, convert_hf_state_dict, cfg,
+                      [[1, 5, 9, 42], [1, 17, 3, 99, 23, 54]])
+
+
+class TestStarCoder:
+    def test_greedy_token_match(self):
+        from flexflow_tpu.models.starcoder import (STARCODERConfig,
+                                                   convert_hf_state_dict,
+                                                   create_starcoder_model)
+        torch.manual_seed(3)
+        hf_cfg = transformers.GPTBigCodeConfig(
+            vocab_size=128, n_embd=32, n_layer=2, n_head=4, n_positions=64,
+            n_inner=64, multi_query=True)
+        hf = transformers.GPTBigCodeForCausalLM(hf_cfg).eval()
+        cfg = STARCODERConfig.from_hf(hf.config)
+        _check_family(hf, create_starcoder_model, convert_hf_state_dict, cfg,
+                      [[1, 5, 9, 42], [1, 17, 3, 99, 23, 54], [1, 7]])
