@@ -50,6 +50,7 @@ from ..ops import norm_ops as _no  # noqa: F401
 from ..ops import attention_ops as _at  # noqa: F401
 from ..ops import sampling_ops as _sa  # noqa: F401
 from ..ops import serving_attention as _sv  # noqa: F401
+from ..ops import moe_ops as _mo  # noqa: F401
 from ..parallel import parallel_ops as _po  # noqa: F401
 
 
@@ -467,6 +468,62 @@ class Model:
         return self._add_layer(OpType.SAMPLING, [x], dict(
             top_p=top_p, seed_offset=self._dropout_count), name)[0]
 
+    # mixture-of-experts family (reference: src/ops/{group_by,aggregate,
+    # aggregate_spec,experts,cache,moe}.cc)
+    def group_by(self, input: Tensor, assign: Tensor, n: int,
+                 alpha: float = 2.0, name=None) -> List[Tensor]:
+        """Route tokens into n per-expert buffers (group_by.cc:44)."""
+        return self._add_layer(OpType.GROUP_BY, [input, assign],
+                               dict(n=n, alpha=alpha), name)
+
+    def aggregate(self, inputs: Sequence[Tensor], n: int,
+                  lambda_bal: float = 0.0, name=None) -> Tensor:
+        """inputs = [gate_preds, gate_assign, true_gate_assign,
+        full_gate_preds, exp_pred_1..n] (aggregate.cc:40)."""
+        assert len(inputs) == n + 4, (len(inputs), n)
+        return self._add_layer(OpType.AGGREGATE, list(inputs),
+                               dict(n=n, lambda_bal=lambda_bal), name)[0]
+
+    def aggregate_spec(self, inputs: Sequence[Tensor], n: int,
+                       lambda_bal: float = 0.0, name=None) -> Tensor:
+        assert len(inputs) == n + 4, (len(inputs), n)
+        return self._add_layer(OpType.AGG_SPEC, list(inputs),
+                               dict(n=n, lambda_bal=lambda_bal), name)[0]
+
+    def experts(self, inputs: Sequence[Tensor], num_experts: int,
+                experts_start_idx: int, experts_output_dim_size: int,
+                alpha: float = 2.0, experts_num_layers: int = 1,
+                experts_internal_dim_size: int = 0, name=None) -> Tensor:
+        """Fused expert-FFN op: inputs = [input, indices, topk_gate_preds]
+        (experts.cc:49)."""
+        x, idx, gate = inputs
+        return self._add_layer(OpType.EXPERTS, [x, idx, gate], dict(
+            num_experts=num_experts, experts_start_idx=experts_start_idx,
+            experts_output_dim_size=experts_output_dim_size, alpha=alpha,
+            experts_num_layers=experts_num_layers,
+            experts_internal_dim_size=experts_internal_dim_size), name)[0]
+
+    def cache(self, input: Tensor, num_batches: int = 1, name=None) -> Tensor:
+        return self._add_layer(OpType.CACHE, [input],
+                               dict(num_batches=num_batches), name)[0]
+
+    def moe(self, input: Tensor, num_exp: int, num_select: int,
+            expert_hidden_size: int, alpha: float = 2.0,
+            lambda_bal: float = 0.04) -> Tensor:
+        """MoE composite wrapping top_k/group_by/dense-experts/aggregate
+        (reference src/ops/moe.cc:19-43 composition)."""
+        gate_preds = self.dense(input, num_exp, activation=ActiMode.RELU)
+        topk_vals, topk_assign = self.top_k(gate_preds, num_select,
+                                            sorted=False)
+        exp_tensors = self.group_by(input, topk_assign, num_exp, alpha)
+        agg_inputs = [self.softmax(topk_vals), topk_assign, topk_assign,
+                      gate_preds]
+        for et in exp_tensors:
+            pred = self.dense(et, expert_hidden_size,
+                              activation=ActiMode.RELU)
+            agg_inputs.append(self.softmax(pred))
+        return self.aggregate(agg_inputs, num_exp, lambda_bal)
+
     # parallel IR ops (reference: src/parallel_ops/; inserted manually or
     # by the search — same role as the reference's PCG parallel operators)
     def repartition(self, x: Tensor, dim: int, degree: int,
@@ -601,10 +658,15 @@ class Model:
             def loss_fn(tr):
                 p = self._merge_params(tr, state)
                 ctx = OpContext(training=True, rng=rng, state_updates={},
-                                mesh=self.mesh)
+                                mesh=self.mesh, aux_losses={})
                 vals = self.run_layers(p, dict(zip(input_names, batch[:-1])), ctx)
                 loss = compute_loss(loss_type, vals[logits_key], batch[-1],
                                     from_logits)
+                # auxiliary losses published by ops (MoE load balance —
+                # replaces the reference's hand-written balance gradient in
+                # aggregate.cc backward)
+                for aux in ctx.aux_losses.values():
+                    loss = loss + aux
                 return loss, (vals, ctx.state_updates)
 
             (loss, (vals, updates)), grads = jax.value_and_grad(

@@ -26,7 +26,7 @@ import numpy as np
 from ..core.model import Model
 from ..fftype import DataType, InferenceMode
 from ..serving.request_manager import GenerationConfig
-from .llama import _finish_serving_graph, _np_of
+from .llama import _finish_serving_graph, _np_of, hf_get
 
 
 @dataclasses.dataclass
@@ -52,8 +52,7 @@ class FalconConfig:
 
     @classmethod
     def from_hf(cls, hf) -> "FalconConfig":
-        get = (hf.get if isinstance(hf, dict)
-               else lambda k, d=None: getattr(hf, k, d))
+        get = hf_get(hf)
         if get("alibi", False):
             raise NotImplementedError(
                 "ALiBi Falcon variants (falcon-rw) are not supported — the "

@@ -45,8 +45,7 @@ class LLAMAConfig:
 
     @classmethod
     def from_hf(cls, hf) -> "LLAMAConfig":
-        get = (hf.get if isinstance(hf, dict)
-               else lambda k, d=None: getattr(hf, k, d))
+        get = hf_get(hf)
         return cls(
             vocab_size=get("vocab_size", 32000),
             hidden_size=get("hidden_size", 4096),
@@ -61,6 +60,14 @@ class LLAMAConfig:
             bos_token_id=get("bos_token_id", 1),
             eos_token_id=get("eos_token_id", 2),
         )
+
+
+def hf_get(hf):
+    """Accessor over an HF config given as either a dict (parsed
+    config.json) or a transformers PretrainedConfig object — shared by every
+    model family's ``from_hf``."""
+    return (hf.get if isinstance(hf, dict)
+            else lambda k, d=None: getattr(hf, k, d))
 
 
 def create_llama_model(model: Model, config: LLAMAConfig,

@@ -24,7 +24,7 @@ import numpy as np
 from ..core.model import Model
 from ..fftype import DataType, InferenceMode
 from ..serving.request_manager import GenerationConfig
-from .llama import _finish_serving_graph, _np_of
+from .llama import _finish_serving_graph, _np_of, hf_get
 
 
 @dataclasses.dataclass
@@ -40,8 +40,19 @@ class MPTConfig:
 
     @classmethod
     def from_hf(cls, hf) -> "MPTConfig":
-        get = (hf.get if isinstance(hf, dict)
-               else lambda k, d=None: getattr(hf, k, d))
+        get = hf_get(hf)
+        # the builder/converter hardcode the bias-free default MPT layout
+        # (reference inference/models/mpt.cc likewise only handles it);
+        # reject variants that would silently convert to wrong logits
+        if get("no_bias", True) is False:
+            raise NotImplementedError(
+                "MPT variants with biases (no_bias=False) are not supported")
+        attn_cfg = get("attn_config", None) or {}
+        aget = hf_get(attn_cfg)
+        if aget("alibi", True) is False or aget("clip_qkv", None) or \
+                aget("qk_ln", False):
+            raise NotImplementedError(
+                f"unsupported MPT attn_config variant: {attn_cfg}")
         return cls(
             vocab_size=get("vocab_size", 50368),
             hidden_size=get("d_model", None) or get("hidden_size", 4096),

@@ -25,7 +25,7 @@ import numpy as np
 from ..core.model import Model
 from ..fftype import DataType, InferenceMode
 from ..serving.request_manager import GenerationConfig
-from .llama import _finish_serving_graph, _np_of
+from .llama import _finish_serving_graph, _np_of, hf_get
 
 
 @dataclasses.dataclass
@@ -45,8 +45,7 @@ class OPTConfig:
 
     @classmethod
     def from_hf(cls, hf) -> "OPTConfig":
-        get = (hf.get if isinstance(hf, dict)
-               else lambda k, d=None: getattr(hf, k, d))
+        get = hf_get(hf)
         return cls(
             vocab_size=get("vocab_size", 50272),
             hidden_size=get("hidden_size", 768),

@@ -24,7 +24,7 @@ import numpy as np
 from ..core.model import Model
 from ..fftype import DataType, InferenceMode
 from ..serving.request_manager import GenerationConfig
-from .llama import _finish_serving_graph, _np_of
+from .llama import _finish_serving_graph, _np_of, hf_get
 
 
 @dataclasses.dataclass
@@ -44,8 +44,13 @@ class STARCODERConfig:
 
     @classmethod
     def from_hf(cls, hf) -> "STARCODERConfig":
-        get = (hf.get if isinstance(hf, dict)
-               else lambda k, d=None: getattr(hf, k, d))
+        get = hf_get(hf)
+        # builder/converter assume the GPTBigCode MQA layout (1 KV head,
+        # c_attn packed [E + 2*D, E]); reject the multi-head variant early
+        # rather than failing with an opaque reshape error mid-convert
+        if get("multi_query", True) is False:
+            raise NotImplementedError(
+                "GPTBigCode multi_query=False checkpoints are not supported")
         hidden = get("n_embd", None) or get("hidden_size", 6144)
         return cls(
             vocab_size=get("vocab_size", 49152),

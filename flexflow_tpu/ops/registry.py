@@ -54,6 +54,8 @@ class OpContext:
     mesh: Any = None
     extra_outputs: Dict = None  # side outputs (e.g. beam parent ids)
     state_updates: Dict = None  # non-trainable state written by ops (BN stats)
+    aux_losses: Dict = None     # auxiliary losses (MoE load balance) summed
+                                # into the training loss by Model.compile
 
 
 class OpDef:
