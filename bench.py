@@ -3,20 +3,76 @@
 Prints ONE JSON line: {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}.
 Runs on whatever accelerator jax finds (real TPU chip under the driver).
 
-Current benchmark: single-chip training throughput of the mnist_mlp config
-(BASELINE.md measurement config 1).  Will move to the serving decode benchmark
-(config 3+) as the serving stack lands.
+Headline benchmark (BASELINE.md measurement configs 3/4 direction): serving
+decode throughput of a ~1.4B-parameter LLaMA architecture under the full
+stack — RequestManager continuous batching + InferenceManager bucketed step
+functions + KV-cache attention — on a single chip, bf16, batch of 8
+concurrent requests.  Weights are random (zero-egress container: no HF
+checkpoints available), which does not change the compute profile of
+decode.  The reference publishes no absolute numbers (SURVEY.md §6), so
+vs_baseline stays 0 until the driver records cross-round history.
+
+`bench_mnist_mlp` (measurement config 1) is kept as a secondary entry,
+runnable via `python bench.py mnist`.
 """
 
 import json
+import sys
 import time
 
 import numpy as np
 
 
-def bench_mnist_mlp():
-    import jax
+def bench_llama_decode():
+    from flexflow_tpu import FFConfig, Model
+    from flexflow_tpu.models.llama import LLAMAConfig, create_llama_model
+    from flexflow_tpu.serving import InferenceManager, RequestManager
 
+    cfg = LLAMAConfig(
+        vocab_size=32000, hidden_size=2048, intermediate_size=5504,
+        num_hidden_layers=24, num_attention_heads=16, num_key_value_heads=4,
+        max_position_embeddings=1024)
+    max_requests = 8
+    prompt_len = 16
+    new_tokens = 64
+
+    ff = FFConfig(computation_dtype="bfloat16")
+    model = Model(ff, name="llama_bench")
+    create_llama_model(model, cfg, max_requests=max_requests)
+    im = InferenceManager(ff)
+    mid = im.compile_model_and_allocate_buffer(
+        model, max_requests=max_requests, max_seq_length=256,
+        prefill_chunk=64)
+
+    rng = np.random.default_rng(0)
+
+    def run():
+        rm = RequestManager(max_requests_per_batch=max_requests,
+                            max_tokens_per_batch=32,
+                            max_sequence_length=256,
+                            decode_block=64)
+        prompts = [rng.integers(4, 31000, prompt_len).tolist()
+                   for _ in range(max_requests)]
+        reqs = [rm.register_new_request(p, max_new_tokens=new_tokens)
+                for p in prompts]
+        results = rm.generate_incr_decoding(im, mid, reqs)
+        return sum(len(r.output_tokens) for r in results)
+
+    run()  # warmup: compiles the prefill + decode shape buckets
+    t0 = time.time()
+    total = run()
+    dt = time.time() - t0
+    return {
+        "metric": "llama1p4b_decode_throughput_1chip",
+        "value": round(total / dt, 1),
+        "unit": "tokens/s",
+        # reference publishes no absolute numbers (BASELINE.md §6); 0 = no
+        # baseline ratio available
+        "vs_baseline": 0,
+    }
+
+
+def bench_mnist_mlp():
     from flexflow_tpu import FFConfig, LossType, Model, SGDOptimizer
     from flexflow_tpu.fftype import ActiMode
 
@@ -45,11 +101,11 @@ def bench_mnist_mlp():
         "metric": "mnist_mlp_training_throughput",
         "value": round(samples_per_s, 1),
         "unit": "samples/s",
-        # reference publishes no absolute numbers (BASELINE.md); 0 = no
-        # baseline ratio available yet
         "vs_baseline": 0,
     }
 
 
 if __name__ == "__main__":
-    print(json.dumps(bench_mnist_mlp()))
+    which = sys.argv[1] if len(sys.argv) > 1 else "llama"
+    fn = bench_mnist_mlp if which == "mnist" else bench_llama_decode
+    print(json.dumps(fn()))

@@ -154,7 +154,9 @@ class InferenceManager:
         return mid
 
     # --------------------------------------------------------------- step
-    def _build_step(self, record, chunk: int, reorder: bool):
+    def _raw_step(self, record, reorder: bool):
+        """The un-jitted one-step function shared by the single-step path
+        and the device-resident decode block (lax.scan body)."""
         model = record["model"]
         input_names = [t.name for t in model.input_tensors]
 
@@ -181,7 +183,42 @@ class InferenceManager:
             new_caches = {**caches, **ctx.kv_cache_out}
             return outs, new_caches
 
-        return jax.jit(step, donate_argnums=(1,))
+        return step
+
+    def _build_step(self, record, chunk: int, reorder: bool):
+        return jax.jit(self._raw_step(record, reorder), donate_argnums=(1,))
+
+    def _build_decode_block(self, record, k: int):
+        """K decode steps fused into one device program via lax.scan.
+
+        Autoregressive decode needs each sampled token only *on device* for
+        the next step; syncing it to the host every step pays a full
+        host↔device round trip per token (fatal when the chip is reached
+        over a network tunnel, and still the dominant non-compute cost on
+        PCIe).  The reference amortizes the same loop with Legion tracing +
+        ≤4 in-flight future batches (request_manager.cc:1946-1977); the
+        TPU-native equivalent is a device-resident token feedback loop that
+        syncs once per K tokens.
+        """
+        step = self._raw_step(record, reorder=False)
+
+        def block(params, caches, batch, rngs):
+            active = batch["active"].astype(jnp.int32)
+
+            def body(carry, rng_i):
+                caches, token, depth = carry
+                b = dict(batch)
+                b["token_ids"] = token[:, None]
+                b["first_depth"] = depth
+                outs, caches = step(params, caches, b, rng_i)
+                new_tok = outs[0][:, 0].astype(jnp.int32)
+                return (caches, new_tok, depth + active), new_tok
+
+            init = (caches, batch["token_ids"][:, 0], batch["first_depth"])
+            (caches, _, _), toks = jax.lax.scan(body, init, rngs)
+            return toks, caches  # toks: [k, R] sampled ids
+
+        return jax.jit(block, donate_argnums=(1,))
 
     def _get_step(self, record, chunk: int, reorder: bool):
         key = (chunk, reorder)
@@ -215,6 +252,31 @@ class InferenceManager:
         outs, record["caches"] = step(record["model"].params,
                                       record["caches"], batch, rng)
         return outs
+
+    def decode_block(self, model_id: int, bc: BatchConfig, k: int,
+                     rng=None) -> Any:
+        """Run ``k`` fused decode steps (chunk must be 1); returns the
+        sampled token ids as a [k, R] device array — ONE host sync for k
+        tokens.  The KV scatter stays in bounds because rows are retired by
+        the host before exceeding max_seq_length and the cache carries
+        ``prefill_chunk`` slack positions past it."""
+        record = self.models[model_id]
+        assert bc.chunk == 1, "decode_block requires a pure-decode batch"
+        slack = record["prefill_chunk"]
+        if k > slack:
+            # clamp to the largest pow2 within the compiled cache slack —
+            # rows at max_seq_length must not scatter out of bounds
+            k = 1 << (slack.bit_length() - 1)
+        batch = {name: jnp.asarray(v) for name, v in bc.pack().items()}
+        if rng is None:
+            rng = jax.random.PRNGKey(0)
+        key = ("block", k)
+        if key not in record["steps"]:
+            record["steps"][key] = self._build_decode_block(record, k)
+        toks, record["caches"] = record["steps"][key](
+            record["model"].params, record["caches"], batch,
+            jax.random.split(rng, k))
+        return toks
 
     def reset_request_rows(self, model_id: int, rows: List[int]):
         """Zero cache bookkeeping for retired rows.  Cache contents need no

@@ -96,11 +96,14 @@ class RequestManager:
     def __init__(self, max_requests_per_batch: int = 8,
                  max_tokens_per_batch: int = 256,
                  max_sequence_length: int = 1024,
-                 max_spec_tree_token_num: int = 64):
+                 max_spec_tree_token_num: int = 64,
+                 decode_block: int = 16):
         self.max_requests_per_batch = max_requests_per_batch
         self.max_tokens_per_batch = max_tokens_per_batch
         self.max_sequence_length = max_sequence_length
         self.max_spec_tree_token_num = max_spec_tree_token_num
+        # K decode steps fused device-side per host sync (1 disables)
+        self.decode_block = decode_block
         self.tokenizer = None
         self.eos_token_id: Optional[int] = None
         self.bos_token_id: Optional[int] = None
@@ -233,23 +236,61 @@ class RequestManager:
         return bc
 
     # ----------------------------------------------------------- generate
+    def _fold_decode_block(self, bc: BatchConfig, toks: np.ndarray):
+        """Fold a [k, R] device-decoded token block into the request state:
+        per running row, iteration i consumed one cached token and sampled
+        ``toks[i, row]`` — append until EOS/max-len retirement (tokens the
+        device decoded past a row's retirement point are discarded)."""
+        k = toks.shape[0]
+        for row in list(self.running):
+            req = self.running[row]
+            if not bc.request_available[row]:
+                continue
+            for i in range(k):
+                req.cached_len += 1
+                req.profile.llm_decoding_steps += 1
+                tok = int(toks[i, row])
+                req.tokens.append(tok)
+                if self._finished(req, tok):
+                    self._retire(req)
+                    break
+
     def generate_incr_decoding(self, im: InferenceManager, model_id: int,
                                requests: Sequence[Request],
-                               seed: int = 0) -> List[GenerationResult]:
+                               seed: int = 0,
+                               decode_block: Optional[int] = None
+                               ) -> List[GenerationResult]:
         """Incremental-decoding driver loop (reference:
-        request_manager.cc:1927-1981)."""
+        request_manager.cc:1927-1981).
+
+        Pure-decode batches run as device-resident K-step blocks
+        (InferenceManager.decode_block) so the host syncs once per K tokens
+        instead of once per token; K buckets to pow2 like chunks do.
+        """
+        if decode_block is None:
+            decode_block = self.decode_block
         rng = jax.random.PRNGKey(seed)
         bc, result = None, None
-        step = 0
         while True:
             bc = self.prepare_next_batch(bc, result)
             if bc is None:
                 break
             rng, step_rng = jax.random.split(rng)
+            if bc.chunk == 1 and decode_block > 1:
+                # largest remaining span bounds useful block length
+                remaining = max(
+                    min(r.max_new_tokens - (len(r.tokens) - r.prompt_len),
+                        min(r.max_sequence_length, self.max_sequence_length)
+                        - len(r.tokens))
+                    for r in self.running.values())
+                k = pick_chunk(max(1, remaining), decode_block)
+                toks = np.asarray(im.decode_block(model_id, bc, k, step_rng))
+                self._fold_decode_block(bc, toks)
+                bc, result = None, None
+                continue
             outs = im.inference(model_id, bc, rng=step_rng)
             # final layer is a sampling head emitting [R, C] token ids
             result = InferenceResult(token_ids=np.asarray(outs[0]))
-            step += 1
         return [self._result_of(r) for r in requests]
 
     def generate(self, im: InferenceManager, model_id: int,
