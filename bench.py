@@ -9,7 +9,7 @@ stack — RequestManager continuous batching + InferenceManager bucketed step
 functions + KV-cache attention — on a single chip, bf16, batch of 8
 concurrent requests.  Weights are random (zero-egress container: no HF
 checkpoints available), which does not change the compute profile of
-decode.  The reference publishes no absolute numbers (SURVEY.md §6), so
+decode.  The reference publishes no absolute numbers (BASELINE.md §6), so
 vs_baseline stays 0 until the driver records cross-round history.
 
 `bench_mnist_mlp` (measurement config 1) is kept as a secondary entry,

@@ -1,0 +1,80 @@
+"""``flexflow_tpu.serve`` — the user-facing serving API.
+
+Mirrors the reference's ``python/flexflow/serve/__init__.py:32-209`` ``init``
+(which translated kwargs into Legion argv) — here ``init`` builds the global
+:class:`~flexflow_tpu.config.FFConfig` directly; there is no separate runtime
+process to boot, since JAX is single-controller.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Optional
+
+from ..config import FFConfig
+
+_global_config: Optional[FFConfig] = None
+
+
+def init(configs_dict: Optional[Dict[str, Any]] = None, **kwargs) -> FFConfig:
+    """Initialize the serving runtime (reference serve/__init__.py:32).
+
+    Accepts the reference's knob names (``num_gpus`` → ``num_devices``,
+    ``memory_per_gpu``/``zero_copy_memory_per_node`` accepted-but-unused on
+    TPU, ``*_parallelism_degree``, ``offload``, ``use_4bit_quantization``,
+    ``use_8bit_quantization``, ``profiling``, ``inference_debugging``,
+    ``fusion``) as a dict or kwargs.
+    """
+    global _global_config
+    cfg = dict(configs_dict or {})
+    cfg.update(kwargs)
+
+    def pop(*names, default=None):
+        for n in names:
+            if n in cfg:
+                return cfg.pop(n)
+        return default
+
+    quant = None
+    if pop("use_4bit_quantization", default=False):
+        quant = "int4"
+    if pop("use_8bit_quantization", default=False):
+        quant = "int8"
+    ff = FFConfig(
+        num_devices=pop("num_gpus", "num_devices", default=0) or 0,
+        memory_per_device_mb=pop("memory_per_gpu", default=0) or 0,
+        zero_copy_memory_mb=pop("zero_copy_memory_per_node", default=0) or 0,
+        data_parallelism_degree=pop("data_parallelism_degree", default=1),
+        tensor_parallelism_degree=pop("tensor_parallelism_degree", default=1),
+        pipeline_parallelism_degree=pop("pipeline_parallelism_degree",
+                                        default=1),
+        sequence_parallelism_degree=pop("sequence_parallelism_degree",
+                                        default=1),
+        offload=pop("offload", default=False),
+        offload_reserve_space_size=pop("offload_reserve_space_size",
+                                       default=0) or 0,
+        quantization=quant,
+        profiling=pop("profiling", default=False),
+        inference_debugging=pop("inference_debugging", default=False),
+        enable_fusion=pop("fusion", default=True),
+        seed=pop("seed", default=0),
+    )
+    # reference ignores unknown keys after warning; match that
+    for k in ("num_cpus", "legion_utility_processors", "benchmarking"):
+        cfg.pop(k, None)
+    if cfg:
+        import warnings
+
+        warnings.warn(f"ignoring unknown init() keys: {sorted(cfg)}")
+    _global_config = ff
+    return ff
+
+
+def _resolved_config() -> FFConfig:
+    global _global_config
+    if _global_config is None:
+        _global_config = FFConfig()
+    return _global_config
+
+
+from .serve import LLM, SSM, GenerationConfig, SupportedModels  # noqa: E402

@@ -1,0 +1,355 @@
+"""HF-integrated serving API: ``LLM`` / ``SSM`` classes.
+
+TPU-native re-design of the reference's ``python/flexflow/serve/serve.py``
+(LLM/SSM classes at serve.py:71, HF config/weights/tokenizer download with
+revision-hash cache at serve.py:132-283, ``compile`` at serve.py:303+).
+
+Differences by design:
+- weights convert straight into the framework's nested param tree and are
+  cached as one ``.npz`` archive (a zip of per-tensor ``.npy`` files — the
+  same per-tensor-binary-file layout the reference's FileDataLoader reads,
+  inference/file_loader.cc:792, just in a standard container).  TP head
+  sharding (file_loader.cc:209-330) is NOT baked into the cache: GSPMD
+  shards the canonical layout at load time via NamedSharding, so one cache
+  serves every parallelism config.
+- no separate C++ FileDataLoader binary format: ``jax.device_put`` with a
+  sharding is the loader.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, List, Optional, Sequence, Union
+
+import numpy as np
+
+from ..config import FFConfig
+from ..core.model import Model
+from ..fftype import DataType, InferenceMode
+from ..serving import (GenerationConfig, GenerationResult, InferenceManager,
+                       RequestManager)
+from ..serving.spec_infer import generate_spec_infer
+from ..serving.tokenizer import load_tokenizer
+
+__all__ = ["LLM", "SSM", "GenerationConfig", "SupportedModels"]
+
+
+class _FamilySpec:
+    """Builder/converter triple for one architecture family."""
+
+    def __init__(self, module_name: str, config_cls: str, builder: str):
+        self.module_name = module_name
+        self.config_cls = config_cls
+        self.builder = builder
+
+    def load(self):
+        import importlib
+
+        mod = importlib.import_module(
+            f"flexflow_tpu.models.{self.module_name}")
+        return (getattr(mod, self.config_cls), getattr(mod, self.builder),
+                getattr(mod, "convert_hf_state_dict"))
+
+
+class SupportedModels:
+    """Architecture registry (reference serve.py:40-68 __SUPPORTED_MODELS__)."""
+
+    BY_ARCH: Dict[str, _FamilySpec] = {
+        "LlamaForCausalLM": _FamilySpec("llama", "LLAMAConfig",
+                                        "create_llama_model"),
+        "OPTForCausalLM": _FamilySpec("opt", "OPTConfig", "create_opt_model"),
+        "FalconForCausalLM": _FamilySpec("falcon", "FalconConfig",
+                                         "create_falcon_model"),
+        "RWForCausalLM": _FamilySpec("falcon", "FalconConfig",
+                                     "create_falcon_model"),
+        "MptForCausalLM": _FamilySpec("mpt", "MPTConfig", "create_mpt_model"),
+        "GPTBigCodeForCausalLM": _FamilySpec("starcoder", "STARCODERConfig",
+                                             "create_starcoder_model"),
+    }
+    BY_MODEL_TYPE: Dict[str, _FamilySpec] = {
+        "llama": BY_ARCH["LlamaForCausalLM"],
+        "opt": BY_ARCH["OPTForCausalLM"],
+        "falcon": BY_ARCH["FalconForCausalLM"],
+        "mpt": BY_ARCH["MptForCausalLM"],
+        "gpt_bigcode": BY_ARCH["GPTBigCodeForCausalLM"],
+    }
+
+    @classmethod
+    def spec_for(cls, hf_config: Dict[str, Any]) -> _FamilySpec:
+        for arch in hf_config.get("architectures") or []:
+            if arch in cls.BY_ARCH:
+                return cls.BY_ARCH[arch]
+        mt = hf_config.get("model_type")
+        if mt in cls.BY_MODEL_TYPE:
+            return cls.BY_MODEL_TYPE[mt]
+        raise ValueError(
+            f"unsupported architecture {hf_config.get('architectures')} "
+            f"(model_type={mt}); supported: {sorted(cls.BY_ARCH)}")
+
+
+def _default_cache_path() -> str:
+    return os.path.expanduser("~/.cache/flexflow_tpu")
+
+
+def _flatten(tree: Dict[str, Any], prefix: str = "") -> Dict[str, np.ndarray]:
+    out = {}
+    for k, v in tree.items():
+        key = f"{prefix}{k}"
+        if isinstance(v, dict):
+            out.update(_flatten(v, key + "|"))
+        else:
+            out[key] = np.asarray(v)
+    return out
+
+
+def _unflatten(flat: Dict[str, np.ndarray]) -> Dict[str, Any]:
+    tree: Dict[str, Any] = {}
+    for k, v in flat.items():
+        parts = k.split("|")
+        node = tree
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        node[parts[-1]] = v
+    return tree
+
+
+def _local_revision(model_dir: str) -> str:
+    """Staleness fingerprint for a local HF checkpoint dir (plays the role
+    of the hub commit hash in the reference's rev_sha.txt scheme,
+    serve.py:143-165)."""
+    entries = []
+    for fn in sorted(os.listdir(model_dir)):
+        p = os.path.join(model_dir, fn)
+        if os.path.isfile(p):
+            st = os.stat(p)
+            entries.append(f"{fn}:{st.st_size}:{int(st.st_mtime)}")
+    import hashlib
+
+    return hashlib.sha256("\n".join(entries).encode()).hexdigest()
+
+
+class LLM:
+    """A large language model served by the framework (reference
+    serve/serve.py:71 class LLM)."""
+
+    def __init__(self, model_name: str,
+                 data_type: DataType = DataType.HALF,
+                 cache_path: str = "",
+                 refresh_cache: bool = False,
+                 output_file: str = ""):
+        self.model_name = model_name
+        self.data_type = data_type
+        assert data_type in (DataType.HALF, DataType.FLOAT), \
+            "weights must load as HALF (bf16) or FLOAT (f32)"
+        self.cache_path = cache_path or _default_cache_path()
+        self.refresh_cache = refresh_cache
+        self.output_file = output_file
+        self.hf_config = self._fetch_hf_config()
+        self.spec = SupportedModels.spec_for(self.hf_config)
+        # filled by compile()
+        self.model: Optional[Model] = None
+        self.model_id: Optional[int] = None
+        self.im: Optional[InferenceManager] = None
+        self.rm: Optional[RequestManager] = None
+        self.generation_config = GenerationConfig()
+        self.ssms: List["SSM"] = []
+
+    # ------------------------------------------------------------- HF cache
+    def _is_local(self) -> bool:
+        return os.path.isdir(self.model_name)
+
+    def _fetch_hf_config(self) -> Dict[str, Any]:
+        """reference: download_hf_config_if_needed (serve.py:132-160)."""
+        cfg_dir = os.path.join(self.cache_path, "configs",
+                               self.model_name.lower().replace("/", "--"))
+        cfg_json = os.path.join(cfg_dir, "config.json")
+        if self._is_local():
+            with open(os.path.join(self.model_name, "config.json")) as f:
+                cfg = json.load(f)
+        elif os.path.exists(cfg_json) and not self.refresh_cache:
+            with open(cfg_json) as f:
+                return json.load(f)
+        else:
+            from transformers import AutoConfig
+
+            cfg = AutoConfig.from_pretrained(self.model_name).to_dict()
+        os.makedirs(cfg_dir, exist_ok=True)
+        with open(cfg_json, "w") as f:
+            json.dump(cfg, f, indent=2)
+        return cfg
+
+    def _precision_dir(self) -> str:
+        # reference cache layout: weights/<model>/{full,half}-precision
+        # (serve.py:166-199)
+        tag = ("half-precision" if self.data_type == DataType.HALF
+               else "full-precision")
+        return os.path.join(self.cache_path, "weights",
+                            self.model_name.lower().replace("/", "--"), tag)
+
+    def download_hf_weights_if_needed(self, ff_config) -> Dict[str, Any]:
+        """Convert + cache HF weights; returns the framework param tree.
+
+        reference: download_hf_weights_if_needed (serve.py:166-246) +
+        convert_hf_model per family (serve/models/llama.py), consumed by
+        FileDataLoader (file_loader.cc:792).
+        """
+        wdir = self._precision_dir()
+        npz = os.path.join(wdir, "weights.npz")
+        rev_file = os.path.join(wdir, "rev_sha.txt")
+        want_rev = (_local_revision(self.model_name) if self._is_local()
+                    else self.hf_config.get("_commit_hash", "unknown"))
+        if (os.path.exists(npz) and not self.refresh_cache
+                and os.path.exists(rev_file)
+                and open(rev_file).read().strip() == str(want_rev)):
+            with np.load(npz) as z:
+                return _unflatten({k: z[k] for k in z.files})
+        config_cls, _, convert = self.spec.load()
+        cfg = config_cls.from_hf(self.hf_config)
+        state_dict = self._load_hf_state_dict()
+        params = convert(state_dict, cfg)
+        np_dtype = (np.float32 if self.data_type == DataType.FLOAT
+                    else None)  # bf16 cast happens on device_put
+        flat = _flatten(params)
+        if np_dtype is not None:
+            flat = {k: v.astype(np_dtype) if np.issubdtype(v.dtype, np.floating)
+                    else v for k, v in flat.items()}
+        os.makedirs(wdir, exist_ok=True)
+        np.savez(npz, **flat)
+        with open(rev_file, "w") as f:
+            f.write(str(want_rev))
+        return _unflatten(flat)
+
+    def _load_hf_state_dict(self):
+        import torch
+        from transformers import AutoModelForCausalLM
+
+        hf = AutoModelForCausalLM.from_pretrained(
+            self.model_name, torch_dtype=torch.float32)
+        return hf.state_dict()
+
+    def download_hf_tokenizer_if_needed(self) -> str:
+        """reference: download_hf_tokenizer_if_needed (serve.py:248-283).
+        Returns a directory containing tokenizer files."""
+        if self._is_local():
+            return self.model_name
+        tdir = os.path.join(self.cache_path, "tokenizers",
+                            self.model_name.lower().replace("/", "--"))
+        if not os.path.isdir(tdir) or self.refresh_cache:
+            from transformers import AutoTokenizer
+
+            tok = AutoTokenizer.from_pretrained(self.model_name)
+            os.makedirs(tdir, exist_ok=True)
+            tok.save_pretrained(tdir)
+        return tdir
+
+    # -------------------------------------------------------------- compile
+    def compile(self,
+                generation_config: Optional[GenerationConfig] = None,
+                max_requests_per_batch: int = 1,
+                max_seq_length: int = 256,
+                max_tokens_per_batch: int = 64,
+                ssms: Sequence["SSM"] = (),
+                ff_config: Optional[FFConfig] = None,
+                cache_dtype=None):
+        """Build + compile the serving graph (reference serve.py:303+).
+
+        With ``ssms`` the LLM compiles in TREE_VERIFY mode and each SSM in
+        BEAM_SEARCH mode on the same InferenceManager (reference
+        spec_infer.cc:325-376 semantics).
+        """
+        from . import _resolved_config
+
+        self.generation_config = generation_config or GenerationConfig()
+        cfg = ff_config or _resolved_config()
+        self.ssms = list(ssms)
+        mode = (InferenceMode.TREE_VERIFY if self.ssms
+                else InferenceMode.INC_DECODING)
+        config_cls, builder, _ = self.spec.load()
+        arch_cfg = config_cls.from_hf(self.hf_config)
+        self.model = Model(cfg, name=self.model_name.replace("/", "--"))
+        builder(self.model, arch_cfg, mode=mode,
+                max_requests=max_requests_per_batch,
+                generation_config=self.generation_config,
+                dtype=self.data_type)
+        self.model.params = self.download_hf_weights_if_needed(cfg)
+        self.im = InferenceManager(cfg)
+        self.model_id = self.im.compile_model_and_allocate_buffer(
+            self.model, mode=mode, max_requests=max_requests_per_batch,
+            max_seq_length=max_seq_length, cache_dtype=cache_dtype)
+        self.rm = RequestManager(
+            max_requests_per_batch=max_requests_per_batch,
+            max_tokens_per_batch=max_tokens_per_batch,
+            max_sequence_length=max_seq_length)
+        tok_dir = self.download_hf_tokenizer_if_needed()
+        bos = self.hf_config.get("bos_token_id")
+        eos = self.hf_config.get("eos_token_id")
+        if isinstance(eos, list):
+            eos = eos[0] if eos else None
+        try:
+            tokenizer = load_tokenizer(tok_dir, bos_token_id=bos,
+                                       eos_token_id=eos)
+        except FileNotFoundError:
+            tokenizer = None  # token-id prompts still work
+
+        self.rm.register_tokenizer(
+            tokenizer, eos_token_id=eos, bos_token_id=bos,
+            add_bos_token=self.hf_config.get("model_type") in
+            ("llama", "opt", "mpt"))
+        for ssm in self.ssms:
+            ssm._compile_as_ssm(self, max_requests_per_batch, max_seq_length,
+                                cache_dtype=cache_dtype)
+        return self
+
+    # ------------------------------------------------------------- generate
+    def generate(self, prompts: Union[str, Sequence[Any]],
+                 max_new_tokens: int = 128,
+                 seed: int = 0) -> List[GenerationResult]:
+        """Synchronous generation (reference serve.py generate / C++
+        FFModel::generate request_manager.cc:1914).  Accepts a prompt
+        string, a token-id list, or a list of either."""
+        assert self.rm is not None, "call compile() first"
+        if isinstance(prompts, str) or (
+                prompts and isinstance(prompts[0], int)):
+            prompts = [prompts]
+        reqs = [self.rm.register_new_request(p, max_new_tokens)
+                for p in prompts]
+        if self.ssms:
+            results = generate_spec_infer(self.rm, self.im, self.model_id,
+                                          reqs, seed=seed)
+        else:
+            results = self.rm.generate_incr_decoding(
+                self.im, self.model_id, reqs, seed=seed)
+        if self.output_file:
+            with open(self.output_file, "a") as f:
+                for r in results:
+                    f.write(json.dumps({
+                        "guid": r.guid, "input": r.input_text,
+                        "output": r.output_text,
+                        "output_tokens": [int(t) for t in r.output_tokens],
+                    }) + "\n")
+        return results
+
+
+class SSM(LLM):
+    """A small speculative model (reference serve.py class SSM): always
+    runs single-device data/tensor/pipeline degrees (spec_infer.cc:341-344
+    forces SSM dp=tp=pp=1)."""
+
+    def _compile_as_ssm(self, llm: LLM, max_requests: int,
+                        max_seq_length: int, cache_dtype=None):
+        cfg = FFConfig()  # degree-1 everywhere by default
+        config_cls, builder, _ = self.spec.load()
+        arch_cfg = config_cls.from_hf(self.hf_config)
+        self.model = Model(cfg, name="ssm_" + self.model_name.replace("/",
+                                                                      "--"))
+        builder(self.model, arch_cfg, mode=InferenceMode.BEAM_SEARCH,
+                max_requests=max_requests)
+        self.model.params = self.download_hf_weights_if_needed(cfg)
+        self.im = llm.im
+        self.model_id = llm.im.compile_model_and_allocate_buffer(
+            self.model, mode=InferenceMode.BEAM_SEARCH,
+            max_requests=max_requests, max_seq_length=max_seq_length,
+            beam_width=2, cache_dtype=cache_dtype)
+        llm.rm.register_ssm_model(self.model_id)
+        self.rm = llm.rm

@@ -88,6 +88,14 @@ class Request:
         self.cached_len = 0                 # tokens whose KV is committed
         self.profile = ProfileInfo(start_time=time.time())
 
+    def remaining_budget(self, manager_max_seq_len: int) -> int:
+        """Tokens this request may still produce before length retirement
+        (single source for _finished and the decode-block length bound)."""
+        produced = len(self.tokens) - self.prompt_len
+        return min(self.max_new_tokens - produced,
+                   min(self.max_sequence_length, manager_max_seq_len)
+                   - len(self.tokens))
+
 
 class RequestManager:
     """Singleton-style manager (reference request_manager.cc:2075 —
@@ -162,12 +170,9 @@ class RequestManager:
                 if r not in self.running]
 
     def _finished(self, req: Request, new_token: int) -> bool:
-        produced = len(req.tokens) - req.prompt_len
         if self.eos_token_id is not None and new_token == self.eos_token_id:
             return True
-        return (produced >= req.max_new_tokens
-                or len(req.tokens) >= min(req.max_sequence_length,
-                                          self.max_sequence_length))
+        return req.remaining_budget(self.max_sequence_length) <= 0
 
     def _retire(self, req: Request):
         req.status = Request.COMPLETED
@@ -279,9 +284,7 @@ class RequestManager:
             if bc.chunk == 1 and decode_block > 1:
                 # largest remaining span bounds useful block length
                 remaining = max(
-                    min(r.max_new_tokens - (len(r.tokens) - r.prompt_len),
-                        min(r.max_sequence_length, self.max_sequence_length)
-                        - len(r.tokens))
+                    r.remaining_budget(self.max_sequence_length)
                     for r in self.running.values())
                 k = pick_chunk(max(1, remaining), decode_block)
                 toks = np.asarray(im.decode_block(model_id, bc, k, step_rng))

@@ -1,0 +1,84 @@
+"""Incremental-decoding serving entry point.
+
+TPU twin of the reference's ``inference/incr_decoding/incr_decoding.cc``
+(flag parsing at incr_decoding.cc:42-120) and its Python twin
+``inference/python/incr_decoding.py`` — JSON ``-config-file`` plus the same
+flag names.
+"""
+
+import argparse
+import json
+import sys
+
+import flexflow_tpu.serve as ff
+from flexflow_tpu.fftype import DataType
+
+
+def parse_args(argv):
+    p = argparse.ArgumentParser()
+    p.add_argument("-config-file", "--config-file", default="")
+    p.add_argument("-llm-model", "--llm-model", default="")
+    p.add_argument("-prompt", "--prompt", default="",
+                   help="JSON file containing a list of prompt strings")
+    p.add_argument("-output-file", "--output-file", default="")
+    p.add_argument("--max-requests-per-batch", type=int, default=4)
+    p.add_argument("--max-tokens-per-batch", type=int, default=128)
+    p.add_argument("--max-sequence-length", type=int, default=1024)
+    p.add_argument("--max-new-tokens", type=int, default=128)
+    p.add_argument("-tensor-parallelism-degree", "--tensor-parallelism-degree",
+                   type=int, default=1)
+    p.add_argument("-pipeline-parallelism-degree",
+                   "--pipeline-parallelism-degree", type=int, default=1)
+    p.add_argument("--use-full-precision", action="store_true")
+    p.add_argument("--do-sample", action="store_true")
+    p.add_argument("--temperature", type=float, default=0.9)
+    p.add_argument("--topp", type=float, default=0.8)
+    p.add_argument("--refresh-cache", action="store_true")
+    return p.parse_args(argv)
+
+
+def main(argv=None):
+    args = parse_args(argv)
+    configs = {}
+    if args.config_file:
+        with open(args.config_file) as f:
+            configs = json.load(f)
+    ff.init(
+        configs,
+        tensor_parallelism_degree=configs.get(
+            "tensor_parallelism_degree", args.tensor_parallelism_degree),
+        pipeline_parallelism_degree=configs.get(
+            "pipeline_parallelism_degree", args.pipeline_parallelism_degree),
+    )
+    llm_model = configs.get("llm_model", args.llm_model)
+    assert llm_model, "-llm-model is required"
+    data_type = (DataType.FLOAT if configs.get("full_precision",
+                                               args.use_full_precision)
+                 else DataType.HALF)
+    llm = ff.LLM(llm_model, data_type=data_type,
+                 refresh_cache=configs.get("refresh_cache",
+                                           args.refresh_cache),
+                 output_file=configs.get("output_file", args.output_file))
+    gen_cfg = ff.GenerationConfig(do_sample=args.do_sample,
+                                  temperature=args.temperature,
+                                  topp=args.topp)
+    llm.compile(gen_cfg,
+                max_requests_per_batch=configs.get(
+                    "max_requests_per_batch", args.max_requests_per_batch),
+                max_seq_length=configs.get("max_sequence_length",
+                                           args.max_sequence_length),
+                max_tokens_per_batch=configs.get("max_tokens_per_batch",
+                                                 args.max_tokens_per_batch))
+    prompt_file = configs.get("prompt", args.prompt)
+    if prompt_file:
+        with open(prompt_file) as f:
+            prompts = json.load(f)
+    else:
+        prompts = ["Three tips for staying healthy are: "]
+    results = llm.generate(prompts, max_new_tokens=args.max_new_tokens)
+    for r in results:
+        print(f"[{r.guid}] {r.input_text!r} -> {r.output_text!r}")
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])
