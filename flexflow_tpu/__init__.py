@@ -16,6 +16,7 @@ from .core.model import FFModel, Model
 from .core.tensor import ParallelDim, ParallelTensorShape, Tensor, TensorSpec
 from .fftype import (ActiMode, AggrMode, DataType, InferenceMode, LossType,
                      MetricsType, OpType, ParameterSyncType, PoolType)
+from .training.checkpoint import CheckpointManager
 from .training.dataloader import DataLoaderGroup, SingleDataLoader
 from .training.losses import compute_loss
 from .training.metrics import PerfMetrics

@@ -640,6 +640,11 @@ class Model:
         if optimizer is not None:
             trainable, _ = self._split_params(self.params)
             self.opt_state = optimizer.init(trainable)
+            if self.mesh is not None:
+                # commit opt state to the mesh like params, so checkpoint
+                # restore (which preserves committed shardings) stays
+                # device-consistent with the train step
+                self.opt_state = jax.device_put(self.opt_state, replicated)
 
         final = self.layers[-1]
         out_key = (final.name, 0)

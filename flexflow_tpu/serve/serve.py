@@ -187,7 +187,7 @@ class LLM:
         return os.path.join(self.cache_path, "weights",
                             self.model_name.lower().replace("/", "--"), tag)
 
-    def download_hf_weights_if_needed(self, ff_config) -> Dict[str, Any]:
+    def download_hf_weights_if_needed(self) -> Dict[str, Any]:
         """Convert + cache HF weights; returns the framework param tree.
 
         reference: download_hf_weights_if_needed (serve.py:166-246) +
@@ -208,12 +208,15 @@ class LLM:
         cfg = config_cls.from_hf(self.hf_config)
         state_dict = self._load_hf_state_dict()
         params = convert(state_dict, cfg)
-        np_dtype = (np.float32 if self.data_type == DataType.FLOAT
-                    else None)  # bf16 cast happens on device_put
+        if self.data_type == DataType.HALF:
+            import ml_dtypes
+
+            np_dtype = ml_dtypes.bfloat16  # halves cache disk + load I/O
+        else:
+            np_dtype = np.float32
         flat = _flatten(params)
-        if np_dtype is not None:
-            flat = {k: v.astype(np_dtype) if np.issubdtype(v.dtype, np.floating)
-                    else v for k, v in flat.items()}
+        flat = {k: v.astype(np_dtype) if np.issubdtype(v.dtype, np.floating)
+                else v for k, v in flat.items()}
         os.makedirs(wdir, exist_ok=True)
         np.savez(npz, **flat)
         with open(rev_file, "w") as f:
@@ -272,7 +275,7 @@ class LLM:
                 max_requests=max_requests_per_batch,
                 generation_config=self.generation_config,
                 dtype=self.data_type)
-        self.model.params = self.download_hf_weights_if_needed(cfg)
+        self.model.params = self.download_hf_weights_if_needed()
         self.im = InferenceManager(cfg)
         self.model_id = self.im.compile_model_and_allocate_buffer(
             self.model, mode=mode, max_requests=max_requests_per_batch,
@@ -344,8 +347,8 @@ class SSM(LLM):
         self.model = Model(cfg, name="ssm_" + self.model_name.replace("/",
                                                                       "--"))
         builder(self.model, arch_cfg, mode=InferenceMode.BEAM_SEARCH,
-                max_requests=max_requests)
-        self.model.params = self.download_hf_weights_if_needed(cfg)
+                max_requests=max_requests, dtype=self.data_type)
+        self.model.params = self.download_hf_weights_if_needed()
         self.im = llm.im
         self.model_id = llm.im.compile_model_and_allocate_buffer(
             self.model, mode=InferenceMode.BEAM_SEARCH,

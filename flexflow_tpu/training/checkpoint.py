@@ -1,0 +1,96 @@
+"""Checkpoint / resume.
+
+The reference has no real checkpointing — only per-parameter
+``get_tensor``/``set_tensor`` (python/flexflow/core/flexflow_cffi.py) and
+the HF-derived inference weight cache (inference/file_loader.cc:792,
+serve/serve.py:166-199).  SURVEY.md §5 flags checkpoint/resume as a
+first-class gap for the rebuild; this module fills it TPU-natively with
+orbax: sharding-aware async-capable saves of the full training state
+(params + optimizer state + RNG + step), restored onto whatever mesh the
+restoring process has — so a checkpoint written on N chips restores on M.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Optional
+
+import jax
+import numpy as np
+
+try:
+    import orbax.checkpoint as ocp
+
+    _HAS_ORBAX = True
+except Exception:  # pragma: no cover - orbax is baked into the image
+    _HAS_ORBAX = False
+
+
+def _rng_to_np(rng):
+    return None if rng is None else np.asarray(rng)
+
+
+class CheckpointManager:
+    """Manages a directory of numbered training checkpoints.
+
+    Plays the role the reference delegates to ad-hoc get/set_tensor user
+    code, but distributed-correct: every array is saved with its sharding
+    metadata and restored with the target model's shardings.
+    """
+
+    def __init__(self, directory: str, max_to_keep: int = 3):
+        self.directory = os.path.abspath(directory)
+        os.makedirs(self.directory, exist_ok=True)
+        assert _HAS_ORBAX, "orbax-checkpoint is required for CheckpointManager"
+        self._mgr = ocp.CheckpointManager(
+            self.directory,
+            options=ocp.CheckpointManagerOptions(max_to_keep=max_to_keep,
+                                                 create=True))
+
+    # ----------------------------------------------------------------- save
+    def save(self, step: int, model, wait: bool = True) -> None:
+        """Save params + opt_state + rng at ``step``."""
+        state: Dict[str, Any] = {"params": model.params}
+        if model.opt_state is not None:
+            state["opt_state"] = model.opt_state
+        if model._rng is not None:
+            state["rng"] = _rng_to_np(model._rng)
+        self._mgr.save(step, args=ocp.args.StandardSave(state))
+        if wait:
+            self._mgr.wait_until_finished()
+
+    # -------------------------------------------------------------- restore
+    def restore(self, model, step: Optional[int] = None) -> int:
+        """Restore into ``model`` (must be compiled so shardings exist).
+
+        Returns the restored step.  Arrays land with the same shardings the
+        model's current params carry (cross-mesh restore works: orbax
+        reshards from the stored layout).
+        """
+        if step is None:
+            step = self.latest_step()
+            assert step is not None, f"no checkpoints under {self.directory}"
+        target: Dict[str, Any] = {"params": model.params}
+        if model.opt_state is not None:
+            target["opt_state"] = model.opt_state
+        if model._rng is not None:
+            target["rng"] = _rng_to_np(model._rng)
+        abstract = jax.tree.map(ocp.utils.to_shape_dtype_struct, target)
+        restored = self._mgr.restore(step,
+                                     args=ocp.args.StandardRestore(abstract))
+        model.params = restored["params"]
+        if "opt_state" in restored:
+            model.opt_state = restored["opt_state"]
+        if "rng" in restored:
+            model._rng = jax.numpy.asarray(restored["rng"])
+        return step
+
+    # ------------------------------------------------------------- queries
+    def latest_step(self) -> Optional[int]:
+        return self._mgr.latest_step()
+
+    def all_steps(self):
+        return sorted(self._mgr.all_steps())
+
+    def close(self):
+        self._mgr.close()

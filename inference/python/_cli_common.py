@@ -1,0 +1,34 @@
+"""Shared config-file handling for the serving CLI entry points.
+
+Splits a JSON ``-config-file`` (the reference's format,
+tests/inference/python_test_configs/generate_configs.py) into runtime keys
+for ``ff.init`` and serve-level keys consumed by the CLI itself.
+"""
+
+import json
+
+# keys forwarded to ff.init() (reference serve/__init__.py:32 kwargs)
+RUNTIME_KEYS = (
+    "num_gpus", "num_devices", "memory_per_gpu", "zero_copy_memory_per_node",
+    "num_cpus", "legion_utility_processors", "data_parallelism_degree",
+    "tensor_parallelism_degree", "pipeline_parallelism_degree",
+    "sequence_parallelism_degree", "offload", "offload_reserve_space_size",
+    "use_4bit_quantization", "use_8bit_quantization", "profiling",
+    "inference_debugging", "fusion", "seed",
+)
+
+
+def load_config_file(path: str) -> dict:
+    if not path:
+        return {}
+    with open(path) as f:
+        configs = json.load(f)
+    if not isinstance(configs, dict):
+        raise SystemExit(
+            f"-config-file {path} must contain a JSON object, "
+            f"got {type(configs).__name__}")
+    return configs
+
+
+def runtime_configs(configs: dict) -> dict:
+    return {k: configs[k] for k in RUNTIME_KEYS if k in configs}

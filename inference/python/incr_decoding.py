@@ -13,6 +13,11 @@ import sys
 import flexflow_tpu.serve as ff
 from flexflow_tpu.fftype import DataType
 
+try:
+    from _cli_common import load_config_file, runtime_configs
+except ImportError:  # invoked as a module rather than a script
+    from ._cli_common import load_config_file, runtime_configs
+
 
 def parse_args(argv):
     p = argparse.ArgumentParser()
@@ -39,12 +44,9 @@ def parse_args(argv):
 
 def main(argv=None):
     args = parse_args(argv)
-    configs = {}
-    if args.config_file:
-        with open(args.config_file) as f:
-            configs = json.load(f)
+    configs = load_config_file(args.config_file)
     ff.init(
-        configs,
+        runtime_configs(configs),
         tensor_parallelism_degree=configs.get(
             "tensor_parallelism_degree", args.tensor_parallelism_degree),
         pipeline_parallelism_degree=configs.get(
@@ -56,6 +58,7 @@ def main(argv=None):
                                                args.use_full_precision)
                  else DataType.HALF)
     llm = ff.LLM(llm_model, data_type=data_type,
+                 cache_path=configs.get("cache_path", ""),
                  refresh_cache=configs.get("refresh_cache",
                                            args.refresh_cache),
                  output_file=configs.get("output_file", args.output_file))

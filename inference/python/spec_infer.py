@@ -12,6 +12,11 @@ import sys
 import flexflow_tpu.serve as ff
 from flexflow_tpu.fftype import DataType
 
+try:
+    from _cli_common import load_config_file, runtime_configs
+except ImportError:  # invoked as a module rather than a script
+    from ._cli_common import load_config_file, runtime_configs
+
 
 def parse_args(argv):
     p = argparse.ArgumentParser()
@@ -35,12 +40,9 @@ def parse_args(argv):
 
 def main(argv=None):
     args = parse_args(argv)
-    configs = {}
-    if args.config_file:
-        with open(args.config_file) as f:
-            configs = json.load(f)
+    configs = load_config_file(args.config_file)
     ff.init(
-        configs if isinstance(configs, dict) else {},
+        runtime_configs(configs),
         tensor_parallelism_degree=configs.get(
             "tensor_parallelism_degree", args.tensor_parallelism_degree),
         pipeline_parallelism_degree=configs.get(
@@ -55,12 +57,14 @@ def main(argv=None):
     data_type = (DataType.FLOAT if configs.get("full_precision",
                                                args.use_full_precision)
                  else DataType.HALF)
-    llm = ff.LLM(llm_model, data_type=data_type,
+    cache_path = configs.get("cache_path", "")
+    llm = ff.LLM(llm_model, data_type=data_type, cache_path=cache_path,
                  refresh_cache=configs.get("refresh_cache",
                                            args.refresh_cache),
                  output_file=configs.get("output_file", args.output_file))
     # SSMs always compile dp=tp=pp=1 (reference spec_infer.cc:341-344)
-    ssms = [ff.SSM(m, data_type=data_type) for m in ssm_models]
+    ssms = [ff.SSM(m, data_type=data_type, cache_path=cache_path)
+            for m in ssm_models]
     llm.compile(ff.GenerationConfig(),
                 max_requests_per_batch=configs.get(
                     "max_requests_per_batch", args.max_requests_per_batch),

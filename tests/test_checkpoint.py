@@ -1,0 +1,88 @@
+"""Checkpoint/resume tests: bitwise-resumable training, cross-mesh restore.
+
+The reference lacks checkpointing entirely (SURVEY.md §5); these tests
+define the rebuild's contract: save at step k, restore into a fresh
+process/model, and training continues exactly as if uninterrupted.
+"""
+
+import numpy as np
+import pytest
+
+from flexflow_tpu import FFConfig, Model
+from flexflow_tpu.fftype import ActiMode, LossType, MetricsType
+from flexflow_tpu.training.checkpoint import CheckpointManager
+from flexflow_tpu.training.optimizer import AdamOptimizer
+
+
+def _make_model(dp=1):
+    cfg = FFConfig(batch_size=16, data_parallelism_degree=dp, seed=7)
+    m = Model(cfg, name=f"ckpt_model_dp{dp}")
+    x = m.create_tensor((16, 8), name="x")
+    t = m.dense(x, 32, activation=ActiMode.RELU)
+    t = m.dense(t, 4)
+    m.softmax(t)
+    m.compile(AdamOptimizer(alpha=1e-2),
+              loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+              metrics=[MetricsType.ACCURACY])
+    return m
+
+
+def _data(n=64):
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(n, 8)).astype(np.float32)
+    y = (x.sum(axis=1) > 0).astype(np.int32) % 4
+    return x, y
+
+
+def test_save_restore_resume_exact(tmp_path):
+    x, y = _data()
+    a = _make_model()
+    a.fit([x], y, epochs=1)
+    mgr = CheckpointManager(str(tmp_path / "ckpts"))
+    mgr.save(1, a)
+    assert mgr.all_steps() == [1]
+    # continue training the original
+    a.fit([x], y, epochs=1)
+
+    # restore into a fresh model and continue identically
+    b = _make_model()
+    mgr2 = CheckpointManager(str(tmp_path / "ckpts"))
+    assert mgr2.restore(b) == 1
+    b.fit([x], y, epochs=1)
+
+    for lname in a.params:
+        for pname in a.params[lname]:
+            np.testing.assert_allclose(
+                np.asarray(a.params[lname][pname]),
+                np.asarray(b.params[lname][pname]), rtol=1e-6, atol=1e-6,
+                err_msg=f"{lname}/{pname} diverged after resume")
+
+
+def test_cross_mesh_restore(tmp_path):
+    """Checkpoint written from a dp=1 model restores onto a dp=4 mesh."""
+    x, y = _data()
+    a = _make_model(dp=1)
+    a.fit([x], y, epochs=1)
+    mgr = CheckpointManager(str(tmp_path / "x"))
+    mgr.save(3, a)
+
+    b = _make_model(dp=4)
+    assert mgr.restore(b) == 3
+    for lname in a.params:
+        for pname in a.params[lname]:
+            np.testing.assert_allclose(
+                np.asarray(a.params[lname][pname]),
+                np.asarray(b.params[lname][pname]), rtol=1e-6, atol=1e-6)
+    # restored model trains fine on the wider mesh
+    b.fit([x], y, epochs=1)
+
+
+def test_max_to_keep(tmp_path):
+    x, y = _data(32)
+    m = _make_model()
+    m.fit([x], y, epochs=1)
+    mgr = CheckpointManager(str(tmp_path / "k"), max_to_keep=2)
+    for s in (1, 2, 3):
+        mgr.save(s, m)
+    assert mgr.all_steps() == [2, 3]
+    assert mgr.latest_step() == 3

@@ -60,13 +60,12 @@ def test_llm_generate_matches_hf(tiny_llama_dir, cache_path):
 def test_weight_cache_revision(tiny_llama_dir, cache_path):
     model_dir, _ = tiny_llama_dir
     llm = ff.LLM(model_dir, data_type=DataType.FLOAT, cache_path=cache_path)
-    cfg = None
-    p1 = llm.download_hf_weights_if_needed(cfg)
+    p1 = llm.download_hf_weights_if_needed()
     wdir = llm._precision_dir()
     assert os.path.exists(os.path.join(wdir, "weights.npz"))
     rev1 = open(os.path.join(wdir, "rev_sha.txt")).read()
     # second load hits the cache (same revision)
-    p2 = llm.download_hf_weights_if_needed(cfg)
+    p2 = llm.download_hf_weights_if_needed()
     k0 = next(iter(p1))
     np.testing.assert_array_equal(
         next(iter(next(iter(p1.values())).values())),
@@ -76,7 +75,7 @@ def test_weight_cache_revision(tiny_llama_dir, cache_path):
     cfgf = os.path.join(model_dir, "config.json")
     os.utime(cfgf, (os.path.getatime(cfgf), os.path.getmtime(cfgf) + 5))
     llm2 = ff.LLM(model_dir, data_type=DataType.FLOAT, cache_path=cache_path)
-    llm2.download_hf_weights_if_needed(cfg)
+    llm2.download_hf_weights_if_needed()
     assert open(os.path.join(wdir, "rev_sha.txt")).read() != rev1
 
 
