@@ -1,0 +1,87 @@
+"""Auto-parallelization search (the Unity analogue).
+
+Top entry mirrors the reference's ``Graph::graph_optimize_task``
+(graph.cc:2108): a memory-constrained lambda binary search
+(try_one_lambda, graph.cc:2117-2192) around the substitution search, with
+the only_data_parallel manual fast path (graph.cc:1969-2025) and the MCMC
+fallback (model.cc:3791).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+from .cost_model import (CostMetrics, EnhancedMachineModel, MachineModel,
+                         MeasuredCostModel, SimpleMachineModel,
+                         estimate_op_cost, op_flops_bytes, resharding_cost)
+from .pcg import (PCG, Edge, ShardAssignment, assign_pipeline_stages,
+                  data_parallel_strategy, export_strategy_dot,
+                  strategy_from_json, strategy_to_json)
+from .substitution import (base_optimize, generic_sequence_optimize,
+                           mcmc_optimize, node_choices)
+
+__all__ = [
+    "CostMetrics", "MachineModel", "SimpleMachineModel",
+    "EnhancedMachineModel", "MeasuredCostModel", "estimate_op_cost",
+    "op_flops_bytes", "resharding_cost", "PCG", "Edge", "ShardAssignment",
+    "assign_pipeline_stages", "data_parallel_strategy",
+    "export_strategy_dot", "strategy_to_json", "strategy_from_json",
+    "base_optimize", "generic_sequence_optimize", "mcmc_optimize",
+    "node_choices", "graph_optimize",
+]
+
+
+def graph_optimize(model, machine: Optional[MachineModel] = None,
+                   num_devices: Optional[int] = None,
+                   budget: int = 2000, alpha: float = 1.05,
+                   memory_limit: Optional[int] = None,
+                   only_data_parallel: bool = False,
+                   use_mcmc: bool = False, seed: int = 0
+                   ) -> Tuple[Dict[str, ShardAssignment], CostMetrics]:
+    """Find a per-layer sharding strategy (reference graph_optimize_task,
+    graph.cc:2108).
+
+    Returns ``(strategy, cost)``.  If ``memory_limit`` (bytes per device)
+    is set and the unconstrained optimum exceeds it, re-searches with
+    decreasing run-time weight lambda until the strategy fits — a binary
+    search exactly like try_one_lambda (graph.cc:2117-2192).
+    """
+    pcg = PCG(model)
+    # a supplied MachineModel's scale wins over the local device count —
+    # searching for a machine you don't have is the normal use
+    num_devices = (num_devices
+                   or (machine.num_devices if machine is not None else 0)
+                   or model.config.num_devices or 1)
+    machine = machine or SimpleMachineModel(num_devices)
+    if only_data_parallel:
+        # manual fast path (graph.cc:1969-1992; DefaultConfig model.cc:3995)
+        strategy = data_parallel_strategy(pcg, num_devices)
+        return strategy, pcg.strategy_cost(strategy, machine)
+
+    search = mcmc_optimize if use_mcmc else generic_sequence_optimize
+    kwargs = (dict(iterations=budget, seed=seed) if use_mcmc
+              else dict(budget=budget, alpha=alpha))
+
+    strategy, _ = search(pcg, machine, num_devices, **kwargs)
+    cost = pcg.strategy_cost(strategy, machine)
+    if memory_limit is None or cost.memory <= memory_limit:
+        return strategy, cost
+
+    # lambda binary search: weight memory ever harder until it fits
+    lo, hi = 0.0, 1.0    # mem_factor: 1 = pure runtime, 0 = pure memory
+    best_fit: Optional[Tuple[Dict[str, ShardAssignment], CostMetrics]] = None
+    c = cost
+    for _ in range(8):
+        lam = (lo + hi) / 2
+        s, _ = search(pcg, machine, num_devices, mem_factor=lam, **kwargs)
+        c = pcg.strategy_cost(s, machine)
+        if c.memory <= memory_limit:
+            best_fit = (s, c)
+            lo = lam          # fits: try weighting runtime more again
+        else:
+            hi = lam          # too big: weight memory harder
+    if best_fit is None:
+        raise MemoryError(
+            f"no strategy fits memory_limit={memory_limit} "
+            f"(best found needs {c.memory} bytes/device)")
+    return best_fit

@@ -1,0 +1,277 @@
+"""Cost model for the auto-parallelization search.
+
+TPU-native re-design of the reference's simulator stack:
+- ``CostMetrics`` mirrors simulator.h:55-89;
+- :class:`SimpleMachineModel` / :class:`EnhancedMachineModel` mirror
+  src/runtime/machine_model.cc (NVLink/NIC bandwidths become ICI/DCN);
+- :func:`estimate_op_cost` plays ``Simulator::measure_operator_cost``
+  (simulator.cc:519) in analytic mode: a roofline over MXU flops and HBM
+  bytes instead of running CUDA kernels — XLA fusion makes isolated kernel
+  timing misleading on TPU (SURVEY.md §7 hard part 4), so the analytic
+  roofline is the default and :class:`MeasuredCostModel` refines it with
+  real on-chip timings of jitted blocks, cached by (op-params, sharding)
+  exactly like simulator.cc:523-537.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import time
+from typing import Callable, Dict, Optional, Tuple
+
+import numpy as np
+
+from ..fftype import OpType
+
+
+@dataclasses.dataclass
+class CostMetrics:
+    """Per-(op, parallelization) cost record (reference simulator.h:55-89)."""
+
+    forward_time: float = 0.0     # seconds
+    backward_time: float = 0.0
+    sync_time: float = 0.0        # collective time (gradient or activation)
+    memory: int = 0               # bytes resident per device (weights+acts)
+
+    @property
+    def total_time(self) -> float:
+        return self.forward_time + self.backward_time + self.sync_time
+
+    def __add__(self, other: "CostMetrics") -> "CostMetrics":
+        return CostMetrics(self.forward_time + other.forward_time,
+                           self.backward_time + other.backward_time,
+                           self.sync_time + other.sync_time,
+                           self.memory + other.memory)
+
+
+class MachineModel:
+    """Hardware description (reference: simulator.h:213-380).
+
+    Bandwidths in bytes/s, latency in seconds, flops in FLOP/s.
+    """
+
+    def __init__(self, num_devices: int, peak_flops: float,
+                 hbm_bandwidth: float, ici_bandwidth: float,
+                 ici_latency: float, dcn_bandwidth: float,
+                 devices_per_host: int = 0, hbm_per_device: int = 0):
+        self.num_devices = num_devices
+        self.peak_flops = peak_flops
+        self.hbm_bandwidth = hbm_bandwidth
+        self.ici_bandwidth = ici_bandwidth
+        self.ici_latency = ici_latency
+        self.dcn_bandwidth = dcn_bandwidth
+        self.devices_per_host = devices_per_host or num_devices
+        self.hbm_per_device = hbm_per_device
+
+    # -------------------------------------------------------- collectives
+    def _link_bw(self, group: int) -> float:
+        # groups within one ICI domain ride ICI; larger ride DCN
+        return (self.ici_bandwidth if group <= self.devices_per_host
+                else self.dcn_bandwidth)
+
+    def allreduce_time(self, bytes_: int, group: int) -> float:
+        """Ring allreduce: 2(n-1)/n * bytes over the slowest link
+        (reference estimate via machine_model.cc bandwidths)."""
+        if group <= 1 or bytes_ == 0:
+            return 0.0
+        bw = self._link_bw(group)
+        return 2.0 * (group - 1) / group * bytes_ / bw \
+            + 2.0 * (group - 1) * self.ici_latency
+
+    def allgather_time(self, bytes_out: int, group: int) -> float:
+        if group <= 1 or bytes_out == 0:
+            return 0.0
+        bw = self._link_bw(group)
+        return (group - 1) / group * bytes_out / bw \
+            + (group - 1) * self.ici_latency
+
+    def reducescatter_time(self, bytes_in: int, group: int) -> float:
+        return self.allgather_time(bytes_in, group)
+
+    def p2p_time(self, bytes_: int) -> float:
+        if bytes_ == 0:
+            return 0.0
+        return bytes_ / self.ici_bandwidth + self.ici_latency
+
+
+class SimpleMachineModel(MachineModel):
+    """One-knob model (reference SimpleMachineModel: intra-node + NIC bw).
+
+    Defaults describe one TPU v5e chip: 197 TFLOP/s bf16 MXU, 819 GB/s HBM,
+    ~45 GB/s/link ICI (3D torus per-direction), 16 GB HBM.
+    """
+
+    def __init__(self, num_devices: int, peak_flops: float = 197e12,
+                 hbm_bandwidth: float = 819e9, ici_bandwidth: float = 45e9,
+                 ici_latency: float = 1e-6, dcn_bandwidth: float = 25e9,
+                 devices_per_host: int = 0,
+                 hbm_per_device: int = 16 * 1024**3):
+        super().__init__(num_devices, peak_flops, hbm_bandwidth,
+                         ici_bandwidth, ici_latency, dcn_bandwidth,
+                         devices_per_host, hbm_per_device)
+
+
+class EnhancedMachineModel(MachineModel):
+    """File-configured model (reference EnhancedMachineModel parsed from
+    machine_config_example:1-40).  Config lines: ``key = value`` with keys
+    num_devices, devices_per_host, peak_tflops, hbm_gbps, ici_gbps,
+    ici_latency_us, dcn_gbps, hbm_gb; '#' comments."""
+
+    @classmethod
+    def from_file(cls, path: str) -> "EnhancedMachineModel":
+        kv: Dict[str, float] = {}
+        with open(path) as f:
+            for line in f:
+                line = line.split("#", 1)[0].strip()
+                if not line:
+                    continue
+                k, _, v = line.partition("=")
+                kv[k.strip()] = float(v.strip())
+        return cls(
+            num_devices=int(kv.get("num_devices", 1)),
+            peak_flops=kv.get("peak_tflops", 197.0) * 1e12,
+            hbm_bandwidth=kv.get("hbm_gbps", 819.0) * 1e9,
+            ici_bandwidth=kv.get("ici_gbps", 45.0) * 1e9,
+            ici_latency=kv.get("ici_latency_us", 1.0) * 1e-6,
+            dcn_bandwidth=kv.get("dcn_gbps", 25.0) * 1e9,
+            devices_per_host=int(kv.get("devices_per_host", 0)),
+            hbm_per_device=int(kv.get("hbm_gb", 16) * 1024**3),
+        )
+
+
+# --------------------------------------------------------------- op math
+def _prod(xs) -> int:
+    out = 1
+    for x in xs:
+        out *= int(x)
+    return out
+
+
+def op_flops_bytes(layer, out_shapes) -> Tuple[int, int, int]:
+    """(forward flops, activation bytes moved, weight bytes) for one layer
+    at full (unsharded) size.  4 bytes/elt f32 accounting (the relative
+    costs the search compares are dtype-independent)."""
+    a = layer.attrs
+    ins = [t.spec.shape for t in layer.inputs]
+    outs = [tuple(s) for s in out_shapes]
+    elt = 4
+    in_bytes = sum(_prod(s) for s in ins) * elt
+    out_bytes = sum(_prod(s) for s in outs) * elt
+    t = layer.op_type
+    weight_bytes = sum(_prod(p.shape) for p in layer.param_specs) * elt
+    if t == OpType.LINEAR:
+        batch = _prod(ins[0][:-1])
+        flops = 2 * batch * ins[0][-1] * outs[0][-1]
+    elif t == OpType.CONV2D:
+        # NHWC out * (kh*kw*cin) MACs
+        kh, kw = a.get("kernel_h", 1), a.get("kernel_w", 1)
+        cin = ins[0][-1]
+        flops = 2 * _prod(outs[0]) * kh * kw * cin
+    elif t == OpType.BATCH_MATMUL:
+        b = _prod(ins[0][:-2])
+        flops = 2 * b * ins[0][-2] * ins[0][-1] * outs[0][-1]
+    elif t in (OpType.MULTIHEAD_ATTENTION,
+               OpType.INC_MULTIHEAD_SELF_ATTENTION,
+               OpType.SPEC_INC_MULTIHEAD_SELF_ATTENTION,
+               OpType.TREE_INC_MULTIHEAD_SELF_ATTENTION):
+        embed = a.get("embed_dim", ins[0][-1])
+        tokens = _prod(ins[0][:-1])
+        # qkv+o projections + 2 seq^2 matmuls (seq bounded by input len)
+        flops = 8 * tokens * embed * embed + 4 * tokens * tokens * embed
+    elif t == OpType.EMBEDDING:
+        flops = 0  # gather, bandwidth-bound
+    elif t == OpType.EXPERTS:
+        k = a.get("num_selected", a.get("k", 1))
+        experts_dim = a.get("experts_internal_dim_size", outs[0][-1])
+        tokens = _prod(ins[0][:-1])
+        flops = 2 * tokens * k * ins[0][-1] * experts_dim
+    else:
+        # elementwise / norm / movement: ~O(bytes)
+        flops = 2 * _prod(outs[0]) if outs else 0
+    return flops, in_bytes + out_bytes, weight_bytes
+
+
+def estimate_op_cost(layer, out_shapes, machine: MachineModel,
+                     dp: int = 1, tp: int = 1,
+                     batch_dim_size: Optional[int] = None) -> CostMetrics:
+    """Roofline cost of one layer under (dp, tp) sharding.
+
+    - dp shards the batch dim: per-device flops/bytes divide by dp; gradient
+      sync adds an allreduce of the weights over dp (the reference's NCCL
+      optimizer path, optimizer.h:59-76).
+    - tp shards weights/heads: flops and weight memory divide by tp; one
+      activation allreduce of the output over tp (the reference's inserted
+      AllReduce, model.cc:3292).
+    """
+    flops, act_bytes, w_bytes = op_flops_bytes(layer, out_shapes)
+    shard = dp * tp
+    compute = max(flops / shard / machine.peak_flops,
+                  act_bytes / shard / machine.hbm_bandwidth)
+    fwd = compute
+    bwd = 2 * compute if w_bytes else compute  # dX and dW matmuls
+    sync = 0.0
+    if tp > 1 and w_bytes:
+        out_act = sum(_prod(s) for s in out_shapes) * 4 // dp
+        sync += machine.allreduce_time(out_act, tp)          # fwd activations
+        sync += machine.allreduce_time(out_act, tp)          # bwd d(input)
+    if dp > 1 and w_bytes:
+        sync += machine.allreduce_time(w_bytes // tp, dp)    # grad allreduce
+    mem = w_bytes // tp + act_bytes // shard
+    return CostMetrics(fwd, bwd, sync, mem)
+
+
+def resharding_cost(tensor_bytes: int, src: Tuple[int, int],
+                    dst: Tuple[int, int], machine: MachineModel) -> float:
+    """Cost of moving a tensor between (dp, tp) layouts (reference:
+    Simulator::estimate_xfer_cost, simulator.cc:604 + repartition cost
+    :562-600).  Identical layouts are free; otherwise approximate as an
+    allgather out of the finer layout plus a repartition into the new one.
+    """
+    if src == dst:
+        return 0.0
+    src_parts, dst_parts = src[0] * src[1], dst[0] * dst[1]
+    t = 0.0
+    if src_parts > 1:
+        t += machine.allgather_time(tensor_bytes, src_parts)
+    if dst_parts > 1:
+        t += machine.p2p_time(tensor_bytes // dst_parts)
+    return t
+
+
+class MeasuredCostModel:
+    """Refines the roofline with real on-chip timings.
+
+    Times a jitted forward block per (op-params, shard degrees) — the
+    TPU analogue of ``Op::inner_measure_operator_cost`` (operator.h:152-155)
+    — with the same memoization as simulator.cc:523-537.
+    """
+
+    def __init__(self, machine: MachineModel, repeats: int = 3):
+        self.machine = machine
+        self.repeats = repeats
+        self.cache: Dict[Tuple, float] = {}
+
+    def _key(self, layer, out_shapes, dp, tp):
+        return (layer.op_type.value,
+                tuple(tuple(t.spec.shape) for t in layer.inputs),
+                tuple(tuple(s) for s in out_shapes), dp, tp)
+
+    def measure(self, layer, out_shapes, dp: int = 1, tp: int = 1,
+                run: Optional[Callable[[], None]] = None) -> CostMetrics:
+        est = estimate_op_cost(layer, out_shapes, self.machine, dp, tp)
+        key = self._key(layer, out_shapes, dp, tp)
+        if key in self.cache:
+            fwd = self.cache[key]
+        elif run is None:
+            fwd = est.forward_time
+        else:
+            run()  # compile + warm
+            t0 = time.perf_counter()
+            for _ in range(self.repeats):
+                run()
+            fwd = (time.perf_counter() - t0) / self.repeats
+            self.cache[key] = fwd
+        scale = fwd / est.forward_time if est.forward_time > 0 else 1.0
+        return CostMetrics(fwd, est.backward_time * scale, est.sync_time,
+                           est.memory)

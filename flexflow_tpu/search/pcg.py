@@ -1,0 +1,187 @@
+"""Parallel Computation Graph over the layer list.
+
+TPU-native re-design of the reference PCG (src/runtime/graph.cc): nodes are
+layers, edges are tensor flows (Edge{srcOp,dstOp,srcIdx,dstIdx}, graph.h:31).
+Where the reference assigns each node a MachineView, we assign a
+:class:`ShardAssignment` — per-node (dp, tp, pp_stage) degrees over the
+global mesh — which lowers to `NamedSharding` annotations instead of Legion
+partitions.  Strategy export mirrors the reference's dot/json strategy dump
+(graph.cc:460-480, config.h:160-163).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Dict, List, Optional, Tuple
+
+from ..fftype import OpType
+from .cost_model import (CostMetrics, MachineModel, estimate_op_cost,
+                         resharding_cost)
+
+# ops whose weights can be sharded tensor-parallel (the reference's
+# partitionable ops: Linear/Conv/Attention/Experts, substitution.cc:70-127)
+TP_CAPABLE = {
+    OpType.LINEAR, OpType.CONV2D, OpType.MULTIHEAD_ATTENTION,
+    OpType.INC_MULTIHEAD_SELF_ATTENTION, OpType.EXPERTS,
+    OpType.EMBEDDING,
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class ShardAssignment:
+    """Per-node parallelization choice (reference MachineView,
+    machine_view.h:18-39: here degrees over named mesh axes instead of
+    device-id strides)."""
+
+    dp: int = 1
+    tp: int = 1
+    pp_stage: int = 0
+
+    def degree(self) -> int:
+        return self.dp * self.tp
+
+
+@dataclasses.dataclass
+class Edge:
+    """reference: PCG::Edge (graph.h:31)."""
+
+    src: str           # producer layer name
+    dst: str           # consumer layer name
+    src_idx: int
+    dst_idx: int
+    tensor_bytes: int
+
+
+class PCG:
+    """Graph view of a Model's layers (reference PCG::Graph)."""
+
+    def __init__(self, model):
+        self.model = model
+        self.nodes: List = list(model.layers)
+        self.by_name = {l.name: l for l in self.nodes}
+        self.edges: List[Edge] = []
+        self.in_edges: Dict[str, List[Edge]] = {l.name: [] for l in self.nodes}
+        self.out_edges: Dict[str, List[Edge]] = {l.name: []
+                                                 for l in self.nodes}
+        for layer in self.nodes:
+            for dst_idx, t in enumerate(layer.inputs):
+                if t.owner_layer is None:
+                    continue
+                nbytes = 1
+                for s in t.spec.shape:
+                    nbytes *= int(s)
+                e = Edge(t.owner_layer.name, layer.name, t.owner_idx,
+                         dst_idx, nbytes * 4)
+                self.edges.append(e)
+                self.in_edges[layer.name].append(e)
+                self.out_edges[t.owner_layer.name].append(e)
+
+    # ------------------------------------------------------------- topology
+    def topo_order(self) -> List[str]:
+        return [l.name for l in self.nodes]  # build order is topological
+
+    def bottleneck_nodes(self) -> List[str]:
+        """Sequence-split candidates (reference find_split_node,
+        substitution.cc:2640): nodes through which every path flows —
+        computed as prefix-cut points where no edge jumps across."""
+        order = self.topo_order()
+        idx = {n: i for i, n in enumerate(order)}
+        max_reach = [0] * len(order)
+        for e in self.edges:
+            max_reach[idx[e.src]] = max(max_reach[idx[e.src]], idx[e.dst])
+        out: List[str] = []
+        frontier = 0
+        for i, n in enumerate(order):
+            frontier = max(frontier, max_reach[i])
+            if frontier <= i + 1 and i + 1 < len(order):
+                out.append(n)
+        return out
+
+    # ----------------------------------------------------------------- cost
+    def strategy_cost(self, strategy: Dict[str, ShardAssignment],
+                      machine: MachineModel) -> CostMetrics:
+        """Graph cost under a strategy: per-node roofline + edge resharding
+        (reference SearchHelper DP composition, graph.cc:1206-1281)."""
+        total = CostMetrics()
+        per_dev_mem = 0
+        for layer in self.nodes:
+            a = strategy.get(layer.name, ShardAssignment())
+            c = estimate_op_cost(
+                layer, [o.spec.shape for o in layer.outputs], machine,
+                dp=a.dp, tp=a.tp)
+            total = total + CostMetrics(c.forward_time, c.backward_time,
+                                        c.sync_time, 0)
+            per_dev_mem += c.memory
+        xfer = 0.0
+        for e in self.edges:
+            sa = strategy.get(e.src, ShardAssignment())
+            da = strategy.get(e.dst, ShardAssignment())
+            xfer += resharding_cost(e.tensor_bytes, (sa.dp, sa.tp),
+                                    (da.dp, da.tp), machine)
+            if sa.pp_stage != da.pp_stage:  # stage boundary: p2p activation
+                xfer += machine.p2p_time(e.tensor_bytes // sa.degree())
+        total.sync_time += xfer
+        total.memory = per_dev_mem
+        return total
+
+
+# ------------------------------------------------------------- strategies
+def data_parallel_strategy(pcg: PCG, num_devices: int
+                           ) -> Dict[str, ShardAssignment]:
+    """The only_data_parallel fast path (reference graph.cc:1969-1992)."""
+    return {l.name: ShardAssignment(dp=num_devices) for l in pcg.nodes}
+
+
+def assign_pipeline_stages(pcg: PCG, num_stages: int,
+                           machine: MachineModel,
+                           strategy: Optional[Dict[str, ShardAssignment]]
+                           = None) -> Dict[str, ShardAssignment]:
+    """Balance transformer layers across stages by cost, not just count
+    (refines the reference's layers_per_stage split,
+    inference_manager.cc:131, graph.cc:2016-2024)."""
+    strategy = dict(strategy or
+                    {l.name: ShardAssignment() for l in pcg.nodes})
+    costs = []
+    for l in pcg.nodes:
+        a = strategy[l.name]
+        c = estimate_op_cost(l, [o.spec.shape for o in l.outputs], machine,
+                             dp=a.dp, tp=a.tp)
+        costs.append(c.total_time)
+    total = sum(costs)
+    target = total / num_stages
+    stage, acc = 0, 0.0
+    for l, c in zip(pcg.nodes, costs):
+        if acc > target * (stage + 1) and stage < num_stages - 1:
+            stage += 1
+        acc += c
+        a = strategy[l.name]
+        strategy[l.name] = ShardAssignment(a.dp, a.tp, stage)
+    return strategy
+
+
+# ------------------------------------------------------- (de)serialization
+def strategy_to_json(strategy: Dict[str, ShardAssignment]) -> str:
+    return json.dumps({k: {"dp": v.dp, "tp": v.tp, "pp_stage": v.pp_stage}
+                       for k, v in strategy.items()}, indent=2)
+
+
+def strategy_from_json(s: str) -> Dict[str, ShardAssignment]:
+    return {k: ShardAssignment(v["dp"], v["tp"], v["pp_stage"])
+            for k, v in json.loads(s).items()}
+
+
+def export_strategy_dot(pcg: PCG, strategy: Dict[str, ShardAssignment]
+                        ) -> str:
+    """Dot export (reference export_strategy_computation_graph_file,
+    graph.cc:460-480)."""
+    lines = ["digraph strategy {"]
+    for l in pcg.nodes:
+        a = strategy.get(l.name, ShardAssignment())
+        lines.append(
+            f'  "{l.name}" [label="{l.name}\\n{l.op_type.value}\\n'
+            f'dp={a.dp} tp={a.tp} pp={a.pp_stage}"];')
+    for e in pcg.edges:
+        lines.append(f'  "{e.src}" -> "{e.dst}";')
+    lines.append("}")
+    return "\n".join(lines)

@@ -1,0 +1,200 @@
+"""Substitution-based strategy search (the Unity analogue).
+
+TPU-native re-design of src/runtime/substitution.cc: the reference rewrites
+the PCG with TASO-style GraphXfers (wrapping ops in Partition/Combine or
+Replicate/Combine pairs per degree, substitution.cc:1368-1382) and drives a
+best-first backtracking search with budget + alpha pruning
+(base_optimize, substitution.cc:2245-2327) inside a DP over sequence splits
+(generic_sequence_optimize, substitution.cc:2588).
+
+Here a "xfer" changes one node's :class:`ShardAssignment` — because under
+GSPMD the Partition/Combine/Replicate ops are *implied* by the sharding
+annotations (the mechanical insertion the reference does explicitly is done
+by the XLA partitioner), the search space collapses to per-node degree
+choices while remaining exactly as expressive for dp x tp hybrid
+strategies.  The explicit parallel-op IR (parallel/parallel_ops.py) is the
+lowering target when a strategy is applied manually via shard_map.
+"""
+
+from __future__ import annotations
+
+import heapq
+import itertools
+from typing import Dict, List, Optional, Tuple
+
+from .cost_model import MachineModel
+from .pcg import PCG, ShardAssignment, TP_CAPABLE, data_parallel_strategy
+
+
+def _factor_pairs(n: int) -> List[Tuple[int, int]]:
+    """All (dp, tp) with dp*tp == n."""
+    out = []
+    for dp in range(1, n + 1):
+        if n % dp == 0:
+            out.append((dp, n // dp))
+    return out
+
+
+def node_choices(layer, num_devices: int) -> List[ShardAssignment]:
+    """Legal assignments for one node (reference create_xfers,
+    substitution.cc:1675: partition/replicate wrappers per degree)."""
+    choices = [ShardAssignment(dp=d)
+               for d in _divisors(num_devices)]
+    if layer.op_type in TP_CAPABLE and layer.param_specs:
+        for total in _divisors(num_devices):
+            for dp, tp in _factor_pairs(total):
+                if tp > 1:
+                    choices.append(ShardAssignment(dp=dp, tp=tp))
+    return choices
+
+
+def _divisors(n: int) -> List[int]:
+    return [d for d in range(1, n + 1) if n % d == 0]
+
+
+def _lambda_cost(metrics, mem_factor: float) -> float:
+    """Run-time/memory tradeoff objective (reference MemoryOptimConfig's
+    run_time_cost_factor, memory_optimization.h:25-60): factor 1.0 = pure
+    run time, 0.0 = pure memory."""
+    return (mem_factor * metrics.total_time
+            + (1.0 - mem_factor) * metrics.memory * 1e-12)
+
+
+def base_optimize(pcg: PCG, machine: MachineModel, num_devices: int,
+                  budget: int = 2000, alpha: float = 1.05,
+                  mem_factor: float = 1.0,
+                  start: Optional[Dict[str, ShardAssignment]] = None
+                  ) -> Tuple[Dict[str, ShardAssignment], float]:
+    """Best-first search over single-node assignment rewrites
+    (reference base_optimize, substitution.cc:2245-2327; memory-aware
+    variant :2337 via ``mem_factor``).
+
+    Starts from pure data parallelism (the reference starts from the user
+    graph, which its manual path also maps to DP) and explores changing one
+    node's assignment at a time; candidates costing more than
+    ``alpha * best`` are pruned, at most ``budget`` states are expanded.
+    """
+    names = [l.name for l in pcg.nodes]
+    choices = {l.name: node_choices(l, num_devices) for l in pcg.nodes}
+    start = start or data_parallel_strategy(pcg, num_devices)
+
+    def key(strategy):
+        return tuple(strategy[n] for n in names)
+
+    def cost(strategy):
+        return _lambda_cost(pcg.strategy_cost(strategy, machine), mem_factor)
+
+    best, best_cost = dict(start), cost(start)
+    seen = {key(start)}
+    counter = itertools.count()          # FIFO tiebreak for equal costs
+    frontier = [(best_cost, next(counter), dict(start))]
+    expanded = 0
+    while frontier and expanded < budget:
+        c, _, strat = heapq.heappop(frontier)
+        if c > alpha * best_cost:        # alpha pruning
+            continue
+        expanded += 1
+        for n in names:
+            cur = strat[n]
+            for ch in choices[n]:
+                if ch == cur:
+                    continue
+                cand = dict(strat)
+                cand[n] = ch
+                k = key(cand)
+                if k in seen:
+                    continue
+                seen.add(k)
+                cc = cost(cand)
+                if cc < best_cost:
+                    best, best_cost = cand, cc
+                if cc <= alpha * best_cost:
+                    heapq.heappush(frontier, (cc, next(counter), cand))
+    return best, best_cost
+
+
+def generic_sequence_optimize(pcg: PCG, machine: MachineModel,
+                              num_devices: int, budget: int = 2000,
+                              alpha: float = 1.05, mem_factor: float = 1.0
+                              ) -> Tuple[Dict[str, ShardAssignment], float]:
+    """DP over sequence splits at bottleneck nodes (reference
+    generic_sequence_optimize, substitution.cc:2588): optimize each
+    segment independently with base_optimize, then stitch — sound because
+    resharding cost at a single-tensor cut point is already charged by the
+    edge term."""
+    cuts = pcg.bottleneck_nodes()
+    if not cuts or len(pcg.nodes) <= 8:
+        return base_optimize(pcg, machine, num_devices, budget, alpha,
+                             mem_factor)
+    # split node list into segments at cut points
+    order = pcg.topo_order()
+    cut_set = set(cuts)
+    segments: List[List[str]] = [[]]
+    for n in order:
+        segments[-1].append(n)
+        if n in cut_set:
+            segments.append([])
+    if not segments[-1]:
+        segments.pop()
+    per_seg_budget = max(50, budget // max(1, len(segments)))
+    strategy: Dict[str, ShardAssignment] = {}
+    for seg in segments:
+        sub = _SubPCG(pcg, seg)
+        s, _ = base_optimize(sub, machine, num_devices, per_seg_budget,
+                             alpha, mem_factor)
+        strategy.update(s)
+    full = pcg.strategy_cost(strategy, machine)
+    return strategy, _lambda_cost(full, mem_factor)
+
+
+class _SubPCG(PCG):
+    """Segment view sharing the parent's nodes (reference
+    Graph::split_at_node, graph.cc:972)."""
+
+    def __init__(self, parent: PCG, names: List[str]):
+        keep = set(names)
+        self.model = parent.model
+        self.nodes = [parent.by_name[n] for n in names]
+        self.by_name = {n: parent.by_name[n] for n in names}
+        self.edges = [e for e in parent.edges
+                      if e.src in keep and e.dst in keep]
+        self.in_edges = {n: [e for e in parent.in_edges[n]
+                             if e.src in keep] for n in names}
+        self.out_edges = {n: [e for e in parent.out_edges[n]
+                              if e.dst in keep] for n in names}
+
+
+def mcmc_optimize(pcg: PCG, machine: MachineModel, num_devices: int,
+                  iterations: int = 2000, temperature: float = 1e-4,
+                  seed: int = 0, mem_factor: float = 1.0
+                  ) -> Tuple[Dict[str, ShardAssignment], float]:
+    """MCMC fallback search (reference FFModel::mcmc_optimize,
+    model.cc:3791): propose a random single-node assignment flip, accept
+    with Metropolis probability."""
+    import math
+    import random
+
+    rng = random.Random(seed)
+    names = [l.name for l in pcg.nodes]
+    choices = {l.name: node_choices(l, num_devices) for l in pcg.nodes}
+
+    def cost(strategy):
+        return _lambda_cost(pcg.strategy_cost(strategy, machine), mem_factor)
+
+    cur = data_parallel_strategy(pcg, num_devices)
+    cur_cost = cost(cur)
+    best, best_cost = dict(cur), cur_cost
+    for _ in range(iterations):
+        n = rng.choice(names)
+        ch = rng.choice(choices[n])
+        if ch == cur[n]:
+            continue
+        cand = dict(cur)
+        cand[n] = ch
+        cc = cost(cand)
+        if cc < cur_cost or rng.random() < math.exp(
+                (cur_cost - cc) / max(temperature, 1e-30)):
+            cur, cur_cost = cand, cc
+            if cc < best_cost:
+                best, best_cost = dict(cand), cc
+    return best, best_cost

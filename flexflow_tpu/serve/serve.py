@@ -114,6 +114,25 @@ def _unflatten(flat: Dict[str, np.ndarray]) -> Dict[str, Any]:
     return tree
 
 
+_BF16_TAG = "__bf16__"
+
+
+def _bf16():
+    import ml_dtypes
+
+    return np.dtype(ml_dtypes.bfloat16)
+
+
+def _decode_cached(z) -> Dict[str, np.ndarray]:
+    out = {}
+    for k in z.files:
+        if k.startswith(_BF16_TAG):
+            out[k[len(_BF16_TAG):]] = z[k].view(_bf16())
+        else:
+            out[k] = z[k]
+    return out
+
+
 def _local_revision(model_dir: str) -> str:
     """Staleness fingerprint for a local HF checkpoint dir (plays the role
     of the hub commit hash in the reference's rev_sha.txt scheme,
@@ -203,7 +222,7 @@ class LLM:
                 and os.path.exists(rev_file)
                 and open(rev_file).read().strip() == str(want_rev)):
             with np.load(npz) as z:
-                return _unflatten({k: z[k] for k in z.files})
+                return _unflatten(_decode_cached(z))
         config_cls, _, convert = self.spec.load()
         cfg = config_cls.from_hf(self.hf_config)
         state_dict = self._load_hf_state_dict()
@@ -218,7 +237,12 @@ class LLM:
         flat = {k: v.astype(np_dtype) if np.issubdtype(v.dtype, np.floating)
                 else v for k, v in flat.items()}
         os.makedirs(wdir, exist_ok=True)
-        np.savez(npz, **flat)
+        # np.savez can't represent bfloat16 (serializes as raw |V2 and the
+        # dtype is lost on load) — store a uint16 view tagged in the key
+        stored = {(_BF16_TAG + k if v.dtype == _bf16() else k):
+                  (v.view(np.uint16) if v.dtype == _bf16() else v)
+                  for k, v in flat.items()}
+        np.savez(npz, **stored)
         with open(rev_file, "w") as f:
             f.write(str(want_rev))
         return _unflatten(flat)

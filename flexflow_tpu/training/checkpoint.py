@@ -45,7 +45,10 @@ class CheckpointManager:
         self._mgr = ocp.CheckpointManager(
             self.directory,
             options=ocp.CheckpointManagerOptions(max_to_keep=max_to_keep,
-                                                 create=True))
+                                                 create=True),
+            # lets a fresh manager read item_metadata for checkpoints it
+            # didn't write (otherwise metadata comes back None)
+            item_handlers=ocp.StandardCheckpointHandler())
 
     # ----------------------------------------------------------------- save
     def save(self, step: int, model, wait: bool = True) -> None:
@@ -75,13 +78,27 @@ class CheckpointManager:
             target["opt_state"] = model.opt_state
         if model._rng is not None:
             target["rng"] = _rng_to_np(model._rng)
-        abstract = jax.tree.map(ocp.utils.to_shape_dtype_struct, target)
+        # the restore target must match the on-disk tree structure, not the
+        # restoring model's: a training checkpoint (with opt_state) must
+        # still restore into an eval-only model — take sections the model
+        # wants from `target` (to carry shardings) and fill disk-only
+        # sections from stored metadata
+        disk = self._mgr.item_metadata(step)
+        abstract: Dict[str, Any] = {}
+        for key in disk.keys():
+            if key in target:
+                abstract[key] = jax.tree.map(ocp.utils.to_shape_dtype_struct,
+                                             target[key])
+            else:
+                abstract[key] = jax.tree.map(
+                    lambda m: jax.ShapeDtypeStruct(m.shape, m.dtype),
+                    disk[key])
         restored = self._mgr.restore(step,
                                      args=ocp.args.StandardRestore(abstract))
         model.params = restored["params"]
-        if "opt_state" in restored:
+        if "opt_state" in restored and model.opt_state is not None:
             model.opt_state = restored["opt_state"]
-        if "rng" in restored:
+        if "rng" in restored and model._rng is not None:
             model._rng = jax.numpy.asarray(restored["rng"])
         return step
 

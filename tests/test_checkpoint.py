@@ -77,6 +77,33 @@ def test_cross_mesh_restore(tmp_path):
     b.fit([x], y, epochs=1)
 
 
+def test_restore_training_ckpt_into_eval_model(tmp_path):
+    """A training checkpoint (params+opt_state+rng) restores into a model
+    compiled without an optimizer (regression: orbax tree mismatch)."""
+    x, y = _data()
+    a = _make_model()
+    a.fit([x], y, epochs=1)
+    mgr = CheckpointManager(str(tmp_path / "t"))
+    mgr.save(1, a)
+
+    cfg = FFConfig(batch_size=16, seed=7)
+    b = Model(cfg, name="eval_only")
+    xin = b.create_tensor((16, 8), name="x")
+    t = b.dense(xin, 32, activation=ActiMode.RELU)
+    t = b.dense(t, 4)
+    b.softmax(t)
+    b.compile(None, loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+              metrics=[MetricsType.ACCURACY])
+    assert b.opt_state is None
+    assert CheckpointManager(str(tmp_path / "t")).restore(b) == 1
+    for lname in a.params:
+        for pname in a.params[lname]:
+            np.testing.assert_allclose(np.asarray(a.params[lname][pname]),
+                                       np.asarray(b.params[lname][pname]),
+                                       rtol=1e-6, atol=1e-6)
+    assert b.opt_state is None  # eval model stays optimizer-free
+
+
 def test_max_to_keep(tmp_path):
     x, y = _data(32)
     m = _make_model()

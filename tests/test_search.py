@@ -1,0 +1,178 @@
+"""Auto-parallelization search tests.
+
+The reference unit-tests only its search/graph data structures
+(tests/unit/: machine_view, dominators, substitution loader) — SURVEY.md §4
+point 1.  These tests cover the TPU rebuild's equivalents: cost model
+sanity, PCG structure, and end-to-end strategy search with deterministic
+expectations (DP-wins vs TP-wins regimes, memory-constrained search).
+"""
+
+import numpy as np
+import pytest
+
+from flexflow_tpu import FFConfig, Model
+from flexflow_tpu.fftype import ActiMode, OpType
+from flexflow_tpu.search import (PCG, EnhancedMachineModel, ShardAssignment,
+                                 SimpleMachineModel, assign_pipeline_stages,
+                                 base_optimize, data_parallel_strategy,
+                                 estimate_op_cost, export_strategy_dot,
+                                 graph_optimize, mcmc_optimize,
+                                 op_flops_bytes, resharding_cost,
+                                 strategy_from_json, strategy_to_json)
+
+
+def _mlp(batch, in_dim, hidden, out_dim, n_hidden=2):
+    m = Model(FFConfig(batch_size=batch), name=f"mlp_{batch}_{hidden}")
+    x = m.create_tensor((batch, in_dim), name="x")
+    t = x
+    for _ in range(n_hidden):
+        t = m.dense(t, hidden, activation=ActiMode.RELU)
+    t = m.dense(t, out_dim)
+    m.softmax(t)
+    return m
+
+
+class TestCostModel:
+    def test_linear_flops(self):
+        m = _mlp(32, 64, 128, 10, n_hidden=1)
+        lin = next(l for l in m.layers if l.op_type == OpType.LINEAR)
+        flops, _, wbytes = op_flops_bytes(
+            lin, [o.spec.shape for o in lin.outputs])
+        assert flops == 2 * 32 * 64 * 128
+        assert wbytes == (64 * 128 + 128) * 4  # kernel + bias
+
+    def test_dp_divides_compute_adds_grad_sync(self):
+        m = _mlp(1024, 512, 512, 10, n_hidden=1)
+        lin = next(l for l in m.layers if l.op_type == OpType.LINEAR)
+        mm = SimpleMachineModel(8)
+        c1 = estimate_op_cost(lin, [o.spec.shape for o in lin.outputs], mm)
+        c8 = estimate_op_cost(lin, [o.spec.shape for o in lin.outputs], mm,
+                              dp=8)
+        assert c8.forward_time < c1.forward_time
+        assert c8.sync_time > 0 and c1.sync_time == 0
+
+    def test_allreduce_monotonic(self):
+        mm = SimpleMachineModel(8)
+        assert mm.allreduce_time(1 << 20, 4) < mm.allreduce_time(1 << 24, 4)
+        assert mm.allreduce_time(0, 8) == 0.0
+        assert mm.allreduce_time(1 << 20, 1) == 0.0
+
+    def test_resharding_identity_free(self):
+        mm = SimpleMachineModel(8)
+        assert resharding_cost(1 << 20, (4, 1), (4, 1), mm) == 0.0
+        assert resharding_cost(1 << 20, (4, 1), (1, 4), mm) > 0.0
+
+    def test_enhanced_machine_model_from_file(self, tmp_path):
+        p = tmp_path / "machine.cfg"
+        p.write_text("""
+# v5e-16 slice
+num_devices = 16
+devices_per_host = 4
+peak_tflops = 197
+hbm_gbps = 819
+ici_gbps = 45
+ici_latency_us = 1
+dcn_gbps = 25
+hbm_gb = 16
+""")
+        mm = EnhancedMachineModel.from_file(str(p))
+        assert mm.num_devices == 16 and mm.devices_per_host == 4
+        assert mm.peak_flops == 197e12
+
+
+class TestPCG:
+    def test_edges_follow_tensors(self):
+        m = _mlp(32, 64, 128, 10)
+        pcg = PCG(m)
+        assert len(pcg.nodes) == len(m.layers)
+        # chain model: every non-input layer has >=1 in edge
+        for l in m.layers[1:]:
+            assert pcg.in_edges[l.name]
+
+    def test_bottlenecks_in_chain(self):
+        m = _mlp(32, 64, 128, 10, n_hidden=3)
+        pcg = PCG(m)
+        # a pure chain: every interior node is a bottleneck
+        assert len(pcg.bottleneck_nodes()) >= len(m.layers) - 2
+
+    def test_strategy_json_roundtrip_and_dot(self):
+        m = _mlp(32, 64, 128, 10)
+        pcg = PCG(m)
+        s = data_parallel_strategy(pcg, 8)
+        s2 = strategy_from_json(strategy_to_json(s))
+        assert s == s2
+        dot = export_strategy_dot(pcg, s)
+        assert "digraph" in dot and "dp=8" in dot
+
+
+class TestSearch:
+    def test_dp_wins_small_params_big_batch(self):
+        """Big batch, small weights -> gradient allreduce is cheap,
+        pure DP should be (near-)optimal.  (The model must be heavy enough
+        that splitting it beats one chip at all: collective latency makes
+        single-device optimal for toy sizes — the cost model is right to
+        say so.)"""
+        m = _mlp(65536, 512, 512, 10, n_hidden=1)
+        strategy, cost = graph_optimize(m, num_devices=8, budget=300)
+        lin = [l.name for l in m.layers if l.op_type == OpType.LINEAR]
+        assert all(strategy[n].dp == 8 and strategy[n].tp == 1
+                   for n in lin), strategy
+
+    def test_tp_wins_giant_params_tiny_batch(self):
+        """Tiny batch, giant weights -> DP grad sync dominates; search
+        must discover tensor parallelism (the Unity result)."""
+        m = _mlp(64, 32768, 32768, 32768, n_hidden=1)
+        pcg = PCG(m)
+        mm = SimpleMachineModel(8)
+        dp_cost = pcg.strategy_cost(data_parallel_strategy(pcg, 8), mm)
+        strategy, cost = graph_optimize(m, machine=mm, num_devices=8,
+                                        budget=300)
+        assert cost.total_time < dp_cost.total_time
+        assert any(strategy[l.name].tp > 1 for l in m.layers
+                   if l.op_type == OpType.LINEAR), strategy
+
+    def test_memory_limit_forces_sharding(self):
+        """Weights too big to replicate: memory-constrained search must
+        return a strategy whose per-device footprint fits."""
+        m = _mlp(8, 4096, 4096, 4096, n_hidden=2)
+        pcg = PCG(m)
+        mm = SimpleMachineModel(8)
+        dp_mem = pcg.strategy_cost(data_parallel_strategy(pcg, 8), mm).memory
+        limit = int(dp_mem * 0.6)
+        strategy, cost = graph_optimize(m, machine=mm, num_devices=8,
+                                        budget=200, memory_limit=limit)
+        assert cost.memory <= limit
+
+    def test_machine_model_scale_wins_over_local_devices(self):
+        """graph_optimize(model, machine=...) must search the machine's
+        device count, not the local process's (regression)."""
+        m = _mlp(65536, 512, 512, 10, n_hidden=1)
+        mm = SimpleMachineModel(8)
+        strategy, _ = graph_optimize(m, machine=mm, budget=100)
+        assert any(a.degree() > 1 for a in strategy.values()), strategy
+
+    def test_only_data_parallel_fast_path(self):
+        m = _mlp(64, 32, 32, 10)
+        strategy, _ = graph_optimize(m, num_devices=4,
+                                     only_data_parallel=True)
+        assert all(a == ShardAssignment(dp=4) for a in strategy.values())
+
+    def test_mcmc_not_worse_than_dp(self):
+        m = _mlp(8, 2048, 2048, 2048, n_hidden=1)
+        pcg = PCG(m)
+        mm = SimpleMachineModel(8)
+        dp_cost = pcg.strategy_cost(data_parallel_strategy(pcg, 8), mm)
+        _, c = mcmc_optimize(pcg, mm, 8, iterations=500, seed=1)
+        from flexflow_tpu.search.substitution import _lambda_cost
+        assert c <= _lambda_cost(dp_cost, 1.0) + 1e-12
+
+    def test_pipeline_stage_balance(self):
+        m = _mlp(32, 256, 256, 256, n_hidden=6)
+        pcg = PCG(m)
+        mm = SimpleMachineModel(8)
+        s = assign_pipeline_stages(pcg, 2, mm)
+        stages = {a.pp_stage for a in s.values()}
+        assert stages == {0, 1}
+        # stages are contiguous in topo order
+        seen = [s[n].pp_stage for n in pcg.topo_order()]
+        assert seen == sorted(seen)

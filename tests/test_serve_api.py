@@ -79,6 +79,24 @@ def test_weight_cache_revision(tiny_llama_dir, cache_path):
     assert open(os.path.join(wdir, "rev_sha.txt")).read() != rev1
 
 
+def test_half_precision_cache_roundtrip(tiny_llama_dir, cache_path):
+    """bf16 cache must survive np.savez (regression: |V2 dtype loss)."""
+    import ml_dtypes
+
+    model_dir, _ = tiny_llama_dir
+    llm = ff.LLM(model_dir, data_type=DataType.HALF, cache_path=cache_path)
+    p1 = llm.download_hf_weights_if_needed()   # writes cache
+    llm2 = ff.LLM(model_dir, data_type=DataType.HALF, cache_path=cache_path)
+    p2 = llm2.download_hf_weights_if_needed()  # cache hit
+    a1 = p1["embed_tokens"]["embedding"]
+    a2 = p2["embed_tokens"]["embedding"]
+    assert a1.dtype == np.dtype(ml_dtypes.bfloat16)
+    assert a2.dtype == np.dtype(ml_dtypes.bfloat16)
+    np.testing.assert_array_equal(a1.view(np.uint16), a2.view(np.uint16))
+    import jax.numpy as jnp
+    assert jnp.asarray(a2).dtype == jnp.bfloat16  # JAX accepts it
+
+
 def test_spec_infer_entry_matches_incr(tiny_llama_dir, cache_path, tmp_path):
     """spec_infer CLI must produce the same tokens as incr_decoding
     (reference CI gate python_inference_tests.sh:30-55)."""
