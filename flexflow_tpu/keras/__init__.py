@@ -1,0 +1,20 @@
+"""Keras frontend (reference: python/flexflow/keras/ — a drop-in
+``tensorflow.keras`` replacement, ~4,400 LoC: models, layers, optimizers,
+losses, metrics, callbacks)."""
+
+from . import callbacks, layers
+from .layers import (Activation, Add, AveragePooling2D, BatchNormalization,
+                     Concatenate, Conv2D, Dense, Dropout, Embedding, Flatten,
+                     Input, KerasLayer, KTensor, LayerNormalization,
+                     MaxPooling2D, Multiply, Subtract)
+from .models import Model, Sequential
+from ..training.optimizer import AdamOptimizer as Adam
+from ..training.optimizer import SGDOptimizer as SGD
+
+__all__ = [
+    "Model", "Sequential", "Input", "KerasLayer", "KTensor", "Dense",
+    "Activation", "Flatten", "Dropout", "Embedding", "Conv2D",
+    "MaxPooling2D", "AveragePooling2D", "BatchNormalization",
+    "LayerNormalization", "Add", "Subtract", "Multiply", "Concatenate",
+    "SGD", "Adam", "callbacks", "layers",
+]

@@ -1,0 +1,92 @@
+"""Keras-style callbacks (reference python/flexflow/keras/callbacks.py:
+Callback base, LearningRateScheduler, VerifyMetrics, EpochVerifyMetrics
+— plus ModelCheckpoint/EarlyStopping which the reference delegates to
+user code via get/set_tensor)."""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional
+
+
+class Callback:
+    def set_model(self, model):
+        self.model = model
+
+    def on_train_begin(self):
+        pass
+
+    def on_train_end(self):
+        pass
+
+    def on_epoch_begin(self, epoch: int):
+        pass
+
+    def on_epoch_end(self, epoch: int, logs: Dict[str, Any]):
+        pass
+
+
+class LearningRateScheduler(Callback):
+    """reference: keras/callbacks.py LearningRateScheduler."""
+
+    def __init__(self, schedule: Callable[[int, float], float]):
+        self.schedule = schedule
+
+    def on_epoch_begin(self, epoch: int):
+        opt = self.model.core.optimizer
+        attr = "lr" if hasattr(opt, "lr") else "alpha"
+        setattr(opt, attr, self.schedule(epoch, getattr(opt, attr)))
+
+
+class VerifyMetrics(Callback):
+    """Assert final accuracy meets a threshold (reference keras/callbacks.py
+    VerifyMetrics, used by the training integration tests to gate CI,
+    tests/training_tests.sh semantics)."""
+
+    def __init__(self, accuracy: float):
+        self.accuracy = accuracy
+        self.last: Optional[float] = None
+
+    def on_epoch_end(self, epoch: int, logs):
+        self.last = logs.get("accuracy")
+
+    def on_train_end(self):
+        assert self.last is not None and self.last >= self.accuracy, (
+            f"accuracy {self.last} below threshold {self.accuracy}")
+
+
+class EarlyStopping(Callback):
+    def __init__(self, monitor: str = "loss", patience: int = 3,
+                 min_delta: float = 0.0):
+        self.monitor = monitor
+        self.patience = patience
+        self.min_delta = min_delta
+        self.best: Optional[float] = None
+        self.wait = 0
+        self.stop_training = False
+
+    def on_epoch_end(self, epoch: int, logs):
+        cur = logs.get(self.monitor)
+        if cur is None:
+            return
+        better = (self.best is None
+                  or cur < self.best - self.min_delta)
+        if self.monitor == "accuracy":
+            better = self.best is None or cur > self.best + self.min_delta
+        if better:
+            self.best, self.wait = cur, 0
+        else:
+            self.wait += 1
+            if self.wait >= self.patience:
+                self.stop_training = True
+
+
+class ModelCheckpoint(Callback):
+    """Saves full training state per epoch via the checkpoint subsystem."""
+
+    def __init__(self, directory: str, max_to_keep: int = 3):
+        from ..training.checkpoint import CheckpointManager
+
+        self.mgr = CheckpointManager(directory, max_to_keep=max_to_keep)
+
+    def on_epoch_end(self, epoch: int, logs):
+        self.mgr.save(epoch, self.model.core)

@@ -1,0 +1,263 @@
+"""Keras-style layer classes.
+
+TPU-native re-design of the reference's Keras frontend layer set
+(python/flexflow/keras/layers/: core.py Dense/Flatten/Dropout/Activation/
+Embedding, convolutional.py Conv2D, pool.py MaxPooling2D/AveragePooling2D,
+merge.py Add/Subtract/Multiply/Concatenate, normalization.py
+BatchNormalization).  Layers are symbolic: calling one on a KTensor records
+a node; ``build_on`` replays it onto the core :class:`~flexflow_tpu.Model`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, List, Optional, Sequence, Tuple
+
+from ..fftype import ActiMode, DataType, PoolType
+
+_ACTIVATIONS = {
+    None: ActiMode.NONE, "linear": ActiMode.NONE, "relu": ActiMode.RELU,
+    "sigmoid": ActiMode.SIGMOID, "tanh": ActiMode.TANH, "gelu": ActiMode.GELU,
+    "softmax": "softmax",
+}
+
+
+@dataclasses.dataclass
+class KTensor:
+    """Symbolic tensor in the Keras graph (reference keras/models/tensor.py)."""
+
+    layer: Optional["KerasLayer"]
+    idx: int
+    shape: Tuple[Optional[int], ...]   # batch dim is None
+    dtype: DataType = DataType.FLOAT
+    name: str = ""
+
+
+class KerasLayer:
+    _count = 0
+
+    def __init__(self, name: Optional[str] = None):
+        type(self).__name__  # noqa: B018
+        KerasLayer._count += 1
+        self.name = name or f"{type(self).__name__.lower()}_{KerasLayer._count}"
+        self.inbound: List[KTensor] = []
+        self.output: Optional[KTensor] = None
+
+    def __call__(self, inputs):
+        if isinstance(inputs, KTensor):
+            inputs = [inputs]
+        self.inbound = list(inputs)
+        self.output = KTensor(self, 0, self.compute_output_shape(
+            [t.shape for t in inputs]), inputs[0].dtype, name=self.name)
+        return self.output
+
+    # subclass API ----------------------------------------------------------
+    def compute_output_shape(self, in_shapes):
+        return in_shapes[0]
+
+    def build_on(self, model, inputs):
+        raise NotImplementedError
+
+
+def Input(shape: Sequence[int], dtype: DataType = DataType.FLOAT,
+          name: Optional[str] = None) -> KTensor:
+    """Functional-API input (reference keras/models/input_layer.py)."""
+    KerasLayer._count += 1
+    return KTensor(None, 0, (None,) + tuple(shape), dtype,
+                   name=name or f"input_{KerasLayer._count}")
+
+
+def _maybe_activation(model, t, activation):
+    act = _ACTIVATIONS.get(activation, ActiMode.NONE) \
+        if not isinstance(activation, ActiMode) else activation
+    if act == "softmax":
+        return model.softmax(t)
+    return t if act in (ActiMode.NONE,) else {
+        ActiMode.RELU: model.relu, ActiMode.SIGMOID: model.sigmoid,
+        ActiMode.TANH: model.tanh, ActiMode.GELU: model.gelu}[act](t)
+
+
+class Dense(KerasLayer):
+    def __init__(self, units: int, activation=None, use_bias: bool = True,
+                 name: Optional[str] = None, **_):
+        super().__init__(name)
+        self.units, self.activation, self.use_bias = units, activation, use_bias
+
+    def compute_output_shape(self, in_shapes):
+        return in_shapes[0][:-1] + (self.units,)
+
+    def build_on(self, model, inputs):
+        t = model.dense(inputs[0], self.units, use_bias=self.use_bias,
+                        name=model._unique_name("linear", None))
+        return _maybe_activation(model, t, self.activation)
+
+
+class Activation(KerasLayer):
+    def __init__(self, activation, name: Optional[str] = None):
+        super().__init__(name)
+        self.activation = activation
+
+    def build_on(self, model, inputs):
+        return _maybe_activation(model, inputs[0], self.activation)
+
+
+class Flatten(KerasLayer):
+    def compute_output_shape(self, in_shapes):
+        n = 1
+        for s in in_shapes[0][1:]:
+            n *= s
+        return (in_shapes[0][0], n)
+
+    def build_on(self, model, inputs):
+        return model.flat(inputs[0])
+
+
+class Dropout(KerasLayer):
+    def __init__(self, rate: float, name: Optional[str] = None, **_):
+        super().__init__(name)
+        self.rate = rate
+
+    def build_on(self, model, inputs):
+        return model.dropout(inputs[0], rate=self.rate)
+
+
+class Embedding(KerasLayer):
+    def __init__(self, input_dim: int, output_dim: int,
+                 name: Optional[str] = None, **_):
+        super().__init__(name)
+        self.input_dim, self.output_dim = input_dim, output_dim
+
+    def compute_output_shape(self, in_shapes):
+        return in_shapes[0] + (self.output_dim,)
+
+    def build_on(self, model, inputs):
+        return model.embedding(inputs[0], self.input_dim, self.output_dim)
+
+
+class Conv2D(KerasLayer):
+    """NCHW like the reference's keras frontend (channels_first)."""
+
+    def __init__(self, filters: int, kernel_size, strides=(1, 1),
+                 padding="valid", activation=None, use_bias: bool = True,
+                 groups: int = 1, name: Optional[str] = None, **_):
+        super().__init__(name)
+        self.filters = filters
+        self.kernel = (kernel_size, kernel_size) if isinstance(
+            kernel_size, int) else tuple(kernel_size)
+        self.strides = (strides, strides) if isinstance(strides, int) \
+            else tuple(strides)
+        self.padding = padding
+        self.activation = activation
+        self.use_bias = use_bias
+        self.groups = groups
+
+    def _pads(self):
+        if self.padding == "same":
+            return self.kernel[0] // 2, self.kernel[1] // 2
+        return 0, 0
+
+    def compute_output_shape(self, in_shapes):
+        b, c, h, w = in_shapes[0]
+        ph, pw = self._pads()
+        oh = (h + 2 * ph - self.kernel[0]) // self.strides[0] + 1
+        ow = (w + 2 * pw - self.kernel[1]) // self.strides[1] + 1
+        return (b, self.filters, oh, ow)
+
+    def build_on(self, model, inputs):
+        ph, pw = self._pads()
+        t = model.conv2d(inputs[0], self.filters, *self.kernel,
+                         *self.strides, ph, pw, groups=self.groups,
+                         use_bias=self.use_bias)
+        return _maybe_activation(model, t, self.activation)
+
+
+class _Pool2D(KerasLayer):
+    pool_type = PoolType.MAX
+
+    def __init__(self, pool_size=(2, 2), strides=None, padding="valid",
+                 name: Optional[str] = None, **_):
+        super().__init__(name)
+        self.pool = (pool_size, pool_size) if isinstance(pool_size, int) \
+            else tuple(pool_size)
+        strides = strides or self.pool
+        self.strides = (strides, strides) if isinstance(strides, int) \
+            else tuple(strides)
+        self.padding = padding
+
+    def _pads(self):
+        if self.padding == "same":
+            return self.pool[0] // 2, self.pool[1] // 2
+        return 0, 0
+
+    def compute_output_shape(self, in_shapes):
+        b, c, h, w = in_shapes[0]
+        ph, pw = self._pads()
+        oh = (h + 2 * ph - self.pool[0]) // self.strides[0] + 1
+        ow = (w + 2 * pw - self.pool[1]) // self.strides[1] + 1
+        return (b, c, oh, ow)
+
+    def build_on(self, model, inputs):
+        ph, pw = self._pads()
+        return model.pool2d(inputs[0], *self.pool, *self.strides, ph, pw,
+                            pool_type=self.pool_type)
+
+
+class MaxPooling2D(_Pool2D):
+    pool_type = PoolType.MAX
+
+
+class AveragePooling2D(_Pool2D):
+    pool_type = PoolType.AVG
+
+
+class BatchNormalization(KerasLayer):
+    def __init__(self, name: Optional[str] = None, **_):
+        super().__init__(name)
+
+    def build_on(self, model, inputs):
+        return model.batch_norm(inputs[0], relu=False)
+
+
+class LayerNormalization(KerasLayer):
+    def __init__(self, epsilon: float = 1e-3, name: Optional[str] = None,
+                 **_):
+        super().__init__(name)
+        self.epsilon = epsilon
+
+    def build_on(self, model, inputs):
+        return model.layer_norm(inputs[0], eps=self.epsilon)
+
+
+class _Merge(KerasLayer):
+    def compute_output_shape(self, in_shapes):
+        return in_shapes[0]
+
+
+class Add(_Merge):
+    def build_on(self, model, inputs):
+        return model.add(inputs[0], inputs[1])
+
+
+class Subtract(_Merge):
+    def build_on(self, model, inputs):
+        return model.subtract(inputs[0], inputs[1])
+
+
+class Multiply(_Merge):
+    def build_on(self, model, inputs):
+        return model.multiply(inputs[0], inputs[1])
+
+
+class Concatenate(KerasLayer):
+    def __init__(self, axis: int = -1, name: Optional[str] = None):
+        super().__init__(name)
+        self.axis = axis
+
+    def compute_output_shape(self, in_shapes):
+        ax = self.axis if self.axis >= 0 else len(in_shapes[0]) + self.axis
+        out = list(in_shapes[0])
+        out[ax] = sum(s[ax] for s in in_shapes)
+        return tuple(out)
+
+    def build_on(self, model, inputs):
+        return model.concat(inputs, axis=self.axis)

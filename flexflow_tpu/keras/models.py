@@ -1,0 +1,194 @@
+"""Keras-style Sequential and functional Model.
+
+TPU-native re-design of the reference's drop-in Keras frontend
+(python/flexflow/keras/models/sequential.py + model.py): same user surface
+(``compile(optimizer=..., loss=..., metrics=[...])``, ``fit``, ``evaluate``,
+``predict``, ``summary``), lowered onto the core
+:class:`flexflow_tpu.Model` instead of the cffi FFModel.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Union
+
+import numpy as np
+
+from ..config import FFConfig
+from ..core.model import Model as CoreModel
+from ..fftype import DataType, LossType, MetricsType
+from ..training.optimizer import AdamOptimizer, Optimizer, SGDOptimizer
+from .layers import Input, KerasLayer, KTensor
+
+_LOSSES = {
+    "categorical_crossentropy": LossType.CATEGORICAL_CROSSENTROPY,
+    "sparse_categorical_crossentropy":
+        LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+    "mean_squared_error": LossType.MEAN_SQUARED_ERROR_AVG_REDUCE,
+    "mse": LossType.MEAN_SQUARED_ERROR_AVG_REDUCE,
+}
+_METRICS = {
+    "accuracy": MetricsType.ACCURACY,
+    "categorical_crossentropy": MetricsType.CATEGORICAL_CROSSENTROPY,
+    "sparse_categorical_crossentropy":
+        MetricsType.SPARSE_CATEGORICAL_CROSSENTROPY,
+    "mean_squared_error": MetricsType.MEAN_SQUARED_ERROR,
+    "mean_absolute_error": MetricsType.MEAN_ABSOLUTE_ERROR,
+}
+
+
+def _to_optimizer(opt) -> Optimizer:
+    if isinstance(opt, Optimizer):
+        return opt
+    if isinstance(opt, str):
+        return {"sgd": SGDOptimizer(), "adam": AdamOptimizer()}[opt.lower()]
+    raise TypeError(f"unsupported optimizer {opt!r}")
+
+
+class Model:
+    """Functional-API model (reference keras/models/model.py)."""
+
+    def __init__(self, inputs: Union[KTensor, Sequence[KTensor]] = None,
+                 outputs: Union[KTensor, Sequence[KTensor]] = None,
+                 name: str = "keras_model", batch_size: int = 32,
+                 config: Optional[FFConfig] = None):
+        self.inputs = ([inputs] if isinstance(inputs, KTensor)
+                       else list(inputs or []))
+        self.outputs = ([outputs] if isinstance(outputs, KTensor)
+                        else list(outputs or []))
+        self.name = name
+        self.batch_size = batch_size
+        self.config = config
+        self.core: Optional[CoreModel] = None
+        self._layer_order: Optional[List[KerasLayer]] = None
+
+    # ------------------------------------------------------------ topology
+    def _toposort(self) -> List[KerasLayer]:
+        order: List[KerasLayer] = []
+        seen = set()
+
+        def visit(t: KTensor):
+            l = t.layer
+            if l is None or id(l) in seen:
+                return
+            seen.add(id(l))
+            for src in l.inbound:
+                visit(src)
+            order.append(l)
+
+        for out in self.outputs:
+            visit(out)
+        return order
+
+    # ------------------------------------------------------------- compile
+    def compile(self, optimizer="sgd", loss="sparse_categorical_crossentropy",
+                metrics: Sequence[str] = ("accuracy",),
+                batch_size: Optional[int] = None, seed: int = 0):
+        batch_size = batch_size or self.batch_size
+        cfg = self.config or FFConfig(batch_size=batch_size)
+        cfg.batch_size = batch_size
+        core = CoreModel(cfg, name=self.name)
+        sym_to_core: Dict[int, Any] = {}
+        for i, t in enumerate(self.inputs):
+            shape = (batch_size,) + tuple(t.shape[1:])
+            sym_to_core[id(t)] = core.create_tensor(shape, t.dtype,
+                                                    name=t.name)
+        self._layer_order = self._toposort()
+        for layer in self._layer_order:
+            ins = [sym_to_core[id(t)] for t in layer.inbound]
+            out = layer.build_on(core, ins)
+            sym_to_core[id(layer.output)] = out
+        loss_t = _LOSSES[loss] if isinstance(loss, str) else loss
+        metric_ts = [_METRICS[m] if isinstance(m, str) else m
+                     for m in metrics]
+        core.compile(_to_optimizer(optimizer), loss_type=loss_t,
+                     metrics=metric_ts, seed=seed)
+        self.core = core
+        return self
+
+    # ----------------------------------------------------------- training
+    def fit(self, x, y, epochs: int = 1, batch_size: Optional[int] = None,
+            callbacks: Sequence[Any] = (), verbose: bool = True):
+        assert self.core is not None, "call compile() first"
+        if not isinstance(x, (list, tuple)):
+            x = [x]
+        for cb in callbacks:
+            cb.set_model(self)
+            cb.on_train_begin()
+        perf = None
+        for epoch in range(epochs):
+            for cb in callbacks:
+                cb.on_epoch_begin(epoch)
+            perf = self.core.fit(x, y, epochs=1, batch_size=batch_size,
+                                 verbose=verbose)
+            logs = {"accuracy": perf.accuracy,
+                    "loss": perf.sparse_cce_loss / max(perf.train_all, 1)}
+            for cb in callbacks:
+                cb.on_epoch_end(epoch, logs)
+            if any(getattr(cb, "stop_training", False) for cb in callbacks):
+                break
+        for cb in callbacks:
+            cb.on_train_end()
+        return perf
+
+    def evaluate(self, x, y, batch_size: Optional[int] = None):
+        assert self.core is not None, "call compile() first"
+        if not isinstance(x, (list, tuple)):
+            x = [x]
+        return self.core.eval(x, y, batch_size=batch_size)
+
+    def predict(self, x, batch_size: Optional[int] = None) -> np.ndarray:
+        assert self.core is not None, "call compile() first"
+        if not isinstance(x, (list, tuple)):
+            x = [x]
+        bs = batch_size or self.core.config.batch_size
+        outs = []
+        n = x[0].shape[0]
+        for i in range(0, n - n % bs, bs):
+            batch = [np.asarray(xi[i:i + bs]) for xi in x]
+            outs.append(np.asarray(self.core.apply(self.core.params, *batch)))
+        return np.concatenate(outs, axis=0) if outs else np.empty((0,))
+
+    def summary(self) -> str:
+        lines = [f'Model: "{self.name}"']
+        for t in self.inputs:
+            lines.append(f"  Input {t.name}: {t.shape}")
+        for l in (self._layer_order or self._toposort()):
+            lines.append(f"  {type(l).__name__} {l.name}: "
+                         f"{l.output.shape if l.output else '?'}")
+        s = "\n".join(lines)
+        print(s)
+        return s
+
+
+class Sequential(Model):
+    """reference: keras/models/sequential.py."""
+
+    def __init__(self, layers: Sequence[KerasLayer] = (),
+                 name: str = "sequential", batch_size: int = 32,
+                 config: Optional[FFConfig] = None):
+        super().__init__(name=name, batch_size=batch_size, config=config)
+        self._pending: List[KerasLayer] = list(layers)
+        self.input_shape: Optional[tuple] = None
+
+    def add(self, layer: KerasLayer):
+        self._pending.append(layer)
+
+    def compile(self, optimizer="sgd",
+                loss="sparse_categorical_crossentropy",
+                metrics: Sequence[str] = ("accuracy",),
+                input_shape: Optional[Sequence[int]] = None,
+                input_dtype: DataType = DataType.FLOAT,
+                batch_size: Optional[int] = None, seed: int = 0):
+        shape = input_shape or self.input_shape
+        if shape is None:
+            first = self._pending[0]
+            shape = getattr(first, "input_shape", None)
+        assert shape is not None, \
+            "Sequential needs input_shape (pass to compile())"
+        t = Input(tuple(shape), dtype=input_dtype)
+        self.inputs = [t]
+        for layer in self._pending:
+            t = layer(t)
+        self.outputs = [t]
+        return super().compile(optimizer, loss, metrics,
+                               batch_size=batch_size, seed=seed)

@@ -1,0 +1,11 @@
+"""ONNX frontend (reference: python/flexflow/onnx/model.py, 375 LoC).
+
+The ``onnx`` package is not part of this image, so the importer is gated:
+constructing :class:`ONNXModel` raises a clear ImportError without it.
+The replay logic itself is implemented and mirrors the reference's
+node-type dispatch (onnx/model.py handle_* methods).
+"""
+
+from .model import ONNXModel, UnsupportedOnnxOp
+
+__all__ = ["ONNXModel", "UnsupportedOnnxOp"]

@@ -1,0 +1,139 @@
+"""ONNX → Model importer (reference python/flexflow/onnx/model.py).
+
+Dispatches on ONNX node op_type the way the reference's ``ONNXModel``
+dispatches via ``handle_<op>`` methods, replaying onto the core Model layer
+API.  Gated on the ``onnx`` package (not in this image — the environment
+policy is to gate, not install).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence
+
+from ..core.model import Model
+from ..core.tensor import Tensor
+from ..fftype import ActiMode, PoolType
+
+
+class UnsupportedOnnxOp(NotImplementedError):
+    pass
+
+
+def _attrs(node) -> Dict[str, Any]:
+    import onnx
+
+    out = {}
+    for a in node.attribute:
+        out[a.name] = onnx.helper.get_attribute_value(a)
+    return out
+
+
+class ONNXModel:
+    """reference: class ONNXModel (onnx/model.py) with ``apply``."""
+
+    def __init__(self, path_or_proto):
+        try:
+            import onnx  # noqa: F401
+        except ImportError as e:
+            raise ImportError(
+                "the `onnx` package is required for the ONNX frontend; it "
+                "is not bundled in this environment — install it or export "
+                "the model via the torch.fx frontend instead") from e
+        import onnx
+
+        self.proto = (onnx.load(path_or_proto)
+                      if isinstance(path_or_proto, str) else path_or_proto)
+
+    def apply(self, ffmodel: Model, inputs: Sequence[Tensor]) -> List[Tensor]:
+        g = self.proto.graph
+        env: Dict[str, Any] = {}
+        init_names = {i.name for i in g.initializer}
+        graph_inputs = [i for i in g.input if i.name not in init_names]
+        assert len(graph_inputs) == len(inputs), \
+            f"model wants {len(graph_inputs)} inputs, got {len(inputs)}"
+        for gi, t in zip(graph_inputs, inputs):
+            env[gi.name] = t
+        for node in g.node:
+            handler = getattr(self, f"_handle_{node.op_type.lower()}", None)
+            if handler is None:
+                raise UnsupportedOnnxOp(node.op_type)
+            env[node.output[0]] = handler(ffmodel, node, env)
+        return [env[o.name] for o in g.output]
+
+    # ------------------------------------------------------------ handlers
+    def _handle_gemm(self, ff, node, env):
+        a = _attrs(node)
+        x = env[node.input[0]]
+        # weight initializer gives out_dim
+        w = next(i for i in self.proto.graph.initializer
+                 if i.name == node.input[1])
+        out_dim = w.dims[0] if not a.get("transB", 0) == 0 else w.dims[1]
+        return ff.dense(x, int(out_dim), use_bias=len(node.input) > 2)
+
+    def _handle_matmul(self, ff, node, env):
+        return ff.batch_matmul(env[node.input[0]], env[node.input[1]])
+
+    def _handle_relu(self, ff, node, env):
+        return ff.relu(env[node.input[0]])
+
+    def _handle_sigmoid(self, ff, node, env):
+        return ff.sigmoid(env[node.input[0]])
+
+    def _handle_tanh(self, ff, node, env):
+        return ff.tanh(env[node.input[0]])
+
+    def _handle_softmax(self, ff, node, env):
+        return ff.softmax(env[node.input[0]],
+                          axis=_attrs(node).get("axis", -1))
+
+    def _handle_flatten(self, ff, node, env):
+        return ff.flat(env[node.input[0]])
+
+    def _handle_add(self, ff, node, env):
+        return ff.add(env[node.input[0]], env[node.input[1]])
+
+    def _handle_sub(self, ff, node, env):
+        return ff.subtract(env[node.input[0]], env[node.input[1]])
+
+    def _handle_mul(self, ff, node, env):
+        return ff.multiply(env[node.input[0]], env[node.input[1]])
+
+    def _handle_concat(self, ff, node, env):
+        return ff.concat([env[i] for i in node.input],
+                         axis=_attrs(node).get("axis", 0))
+
+    def _handle_conv(self, ff, node, env):
+        a = _attrs(node)
+        w = next(i for i in self.proto.graph.initializer
+                 if i.name == node.input[1])
+        kh, kw = a.get("kernel_shape", [w.dims[2], w.dims[3]])
+        sh, sw = a.get("strides", [1, 1])
+        pads = a.get("pads", [0, 0, 0, 0])
+        return ff.conv2d(env[node.input[0]], int(w.dims[0]), kh, kw, sh, sw,
+                         pads[0], pads[1], groups=a.get("group", 1),
+                         use_bias=len(node.input) > 2)
+
+    def _handle_maxpool(self, ff, node, env):
+        return self._pool(ff, node, env, PoolType.MAX)
+
+    def _handle_averagepool(self, ff, node, env):
+        return self._pool(ff, node, env, PoolType.AVG)
+
+    def _pool(self, ff, node, env, pt):
+        a = _attrs(node)
+        kh, kw = a["kernel_shape"]
+        sh, sw = a.get("strides", [kh, kw])
+        pads = a.get("pads", [0, 0, 0, 0])
+        return ff.pool2d(env[node.input[0]], kh, kw, sh, sw,
+                         pads[0], pads[1], pool_type=pt)
+
+    def _handle_dropout(self, ff, node, env):
+        a = _attrs(node)
+        return ff.dropout(env[node.input[0]], rate=a.get("ratio", 0.5))
+
+    def _handle_identity(self, ff, node, env):
+        return env[node.input[0]]
+
+    def _handle_reshape(self, ff, node, env):
+        raise UnsupportedOnnxOp(
+            "Reshape with runtime shape tensor; export static shapes")

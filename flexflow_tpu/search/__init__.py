@@ -56,7 +56,13 @@ def graph_optimize(model, machine: Optional[MachineModel] = None,
     if only_data_parallel:
         # manual fast path (graph.cc:1969-1992; DefaultConfig model.cc:3995)
         strategy = data_parallel_strategy(pcg, num_devices)
-        return strategy, pcg.strategy_cost(strategy, machine)
+        cost = pcg.strategy_cost(strategy, machine)
+        if memory_limit is not None and cost.memory > memory_limit:
+            raise MemoryError(
+                f"pure data parallelism needs {cost.memory} bytes/device, "
+                f"over memory_limit={memory_limit}; rerun without "
+                f"only_data_parallel to search sharded strategies")
+        return strategy, cost
 
     search = mcmc_optimize if use_mcmc else generic_sequence_optimize
     kwargs = (dict(iterations=budget, seed=seed) if use_mcmc

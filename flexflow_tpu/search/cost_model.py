@@ -177,8 +177,11 @@ def op_flops_bytes(layer, out_shapes) -> Tuple[int, int, int]:
                OpType.TREE_INC_MULTIHEAD_SELF_ATTENTION):
         embed = a.get("embed_dim", ins[0][-1])
         tokens = _prod(ins[0][:-1])
+        # per-sequence quadratic term: seq is the second-to-last dim (not
+        # tokens=batch*seq — that would overcount by a factor of batch)
+        seq = ins[0][-2] if len(ins[0]) >= 2 else 1
         # qkv+o projections + 2 seq^2 matmuls (seq bounded by input len)
-        flops = 8 * tokens * embed * embed + 4 * tokens * tokens * embed
+        flops = 8 * tokens * embed * embed + 4 * tokens * seq * embed
     elif t == OpType.EMBEDDING:
         flops = 0  # gather, bandwidth-bound
     elif t == OpType.EXPERTS:

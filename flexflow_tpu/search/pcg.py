@@ -16,7 +16,7 @@ import json
 from typing import Dict, List, Optional, Tuple
 
 from ..fftype import OpType
-from .cost_model import (CostMetrics, MachineModel, estimate_op_cost,
+from .cost_model import (CostMetrics, MachineModel, _prod, estimate_op_cost,
                          resharding_cost)
 
 # ops whose weights can be sharded tensor-parallel (the reference's
@@ -68,11 +68,8 @@ class PCG:
             for dst_idx, t in enumerate(layer.inputs):
                 if t.owner_layer is None:
                     continue
-                nbytes = 1
-                for s in t.spec.shape:
-                    nbytes *= int(s)
                 e = Edge(t.owner_layer.name, layer.name, t.owner_idx,
-                         dst_idx, nbytes * 4)
+                         dst_idx, _prod(t.spec.shape) * 4)
                 self.edges.append(e)
                 self.in_edges[layer.name].append(e)
                 self.out_edges[t.owner_layer.name].append(e)
@@ -83,19 +80,21 @@ class PCG:
 
     def bottleneck_nodes(self) -> List[str]:
         """Sequence-split candidates (reference find_split_node,
-        substitution.cc:2640): nodes through which every path flows —
-        computed as prefix-cut points where no edge jumps across."""
+        substitution.cc:2640): node i is a cut point iff every edge from an
+        earlier node lands at or before i — then the only tensors crossing
+        the cut are i's own outputs (a residual edge skipping over i
+        disqualifies it)."""
         order = self.topo_order()
         idx = {n: i for i, n in enumerate(order)}
         max_reach = [0] * len(order)
         for e in self.edges:
             max_reach[idx[e.src]] = max(max_reach[idx[e.src]], idx[e.dst])
         out: List[str] = []
-        frontier = 0
+        frontier = 0   # max reach over nodes j < i
         for i, n in enumerate(order):
-            frontier = max(frontier, max_reach[i])
-            if frontier <= i + 1 and i + 1 < len(order):
+            if i > 0 and frontier <= i and i + 1 < len(order):
                 out.append(n)
+            frontier = max(frontier, max_reach[i])
         return out
 
     # ----------------------------------------------------------------- cost

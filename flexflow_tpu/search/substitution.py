@@ -139,29 +139,42 @@ def generic_sequence_optimize(pcg: PCG, machine: MachineModel,
     per_seg_budget = max(50, budget // max(1, len(segments)))
     strategy: Dict[str, ShardAssignment] = {}
     for seg in segments:
-        sub = _SubPCG(pcg, seg)
+        # earlier segments are frozen: the boundary edge into this segment
+        # charges resharding against their fixed assignments, so the DP
+        # split stays sound (cross-cut cost is seen during the search, not
+        # only at the final stitch)
+        sub = _SubPCG(pcg, seg, frozen=strategy)
         s, _ = base_optimize(sub, machine, num_devices, per_seg_budget,
                              alpha, mem_factor)
-        strategy.update(s)
+        strategy.update({n: s[n] for n in seg})
     full = pcg.strategy_cost(strategy, machine)
     return strategy, _lambda_cost(full, mem_factor)
 
 
 class _SubPCG(PCG):
     """Segment view sharing the parent's nodes (reference
-    Graph::split_at_node, graph.cc:972)."""
+    Graph::split_at_node, graph.cc:972).  ``frozen`` carries assignments
+    already fixed for earlier segments; edges from frozen nodes into this
+    segment are kept so their resharding cost participates."""
 
-    def __init__(self, parent: PCG, names: List[str]):
+    def __init__(self, parent: PCG, names: List[str],
+                 frozen: Optional[Dict[str, ShardAssignment]] = None):
         keep = set(names)
+        self.frozen = dict(frozen or {})
         self.model = parent.model
         self.nodes = [parent.by_name[n] for n in names]
         self.by_name = {n: parent.by_name[n] for n in names}
         self.edges = [e for e in parent.edges
-                      if e.src in keep and e.dst in keep]
+                      if e.dst in keep
+                      and (e.src in keep or e.src in self.frozen)]
         self.in_edges = {n: [e for e in parent.in_edges[n]
-                             if e.src in keep] for n in names}
+                             if e.src in keep or e.src in self.frozen]
+                         for n in names}
         self.out_edges = {n: [e for e in parent.out_edges[n]
                               if e.dst in keep] for n in names}
+
+    def strategy_cost(self, strategy, machine):
+        return super().strategy_cost({**self.frozen, **strategy}, machine)
 
 
 def mcmc_optimize(pcg: PCG, machine: MachineModel, num_devices: int,

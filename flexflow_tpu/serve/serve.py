@@ -123,11 +123,15 @@ def _bf16():
     return np.dtype(ml_dtypes.bfloat16)
 
 
-def _decode_cached(z) -> Dict[str, np.ndarray]:
+def _decode_cached(z) -> Optional[Dict[str, np.ndarray]]:
+    """Returns None for caches written by older builds that stored bf16 as
+    raw void '|V2' without the tag — callers treat that as a cache miss."""
     out = {}
     for k in z.files:
         if k.startswith(_BF16_TAG):
             out[k[len(_BF16_TAG):]] = z[k].view(_bf16())
+        elif z[k].dtype.kind == "V":
+            return None
         else:
             out[k] = z[k]
     return out
@@ -222,7 +226,9 @@ class LLM:
                 and os.path.exists(rev_file)
                 and open(rev_file).read().strip() == str(want_rev)):
             with np.load(npz) as z:
-                return _unflatten(_decode_cached(z))
+                decoded = _decode_cached(z)
+            if decoded is not None:
+                return _unflatten(decoded)
         config_cls, _, convert = self.spec.load()
         cfg = config_cls.from_hf(self.hf_config)
         state_dict = self._load_hf_state_dict()

@@ -1,0 +1,11 @@
+"""PyTorch frontend: torch.fx-traced modules replayed onto the Model API.
+
+TPU-native re-design of the reference's ``python/flexflow/torch/model.py``
+(2,607 LoC): ``PyTorchModel.apply`` (reference :2408) replays a traced op
+list onto an FFModel; tracing uses torch.fx ``symbolic_trace``
+(reference :2424-2444).
+"""
+
+from .model import PyTorchModel, UnsupportedTorchOp
+
+__all__ = ["PyTorchModel", "UnsupportedTorchOp"]

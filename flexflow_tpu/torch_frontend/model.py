@@ -1,0 +1,249 @@
+"""torch.fx → Model importer.
+
+reference: python/flexflow/torch/model.py — its flow is
+``torch.fx.symbolic_trace`` (:2424-2444) → serialized op list → replay onto
+FFModel (:2408 ``PyTorchModel.apply``).  Here the fx graph replays directly
+(no intermediate file format needed inside one process; ``to_op_list`` /
+``from_op_list`` provide the serialized exchange for parity), and
+``port_parameters`` copies the torch module's weights into the framework
+param tree (transposing torch's [out,in] linear layout to our [in,out]).
+"""
+
+from __future__ import annotations
+
+import json
+import operator
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..core.model import Model
+from ..core.tensor import Tensor
+from ..fftype import ActiMode, DataType, PoolType
+
+
+class UnsupportedTorchOp(NotImplementedError):
+    pass
+
+
+def _pair(v):
+    return (v, v) if isinstance(v, int) else tuple(v)
+
+
+def _np_params(m) -> Dict[str, np.ndarray]:
+    import torch
+
+    with torch.no_grad():
+        return {k: v.detach().cpu().numpy().copy()
+                for k, v in m.named_parameters()}
+
+
+class PyTorchModel:
+    """Wraps a ``torch.nn.Module`` for replay onto a :class:`Model`
+    (reference PyTorchModel, torch/model.py:2408)."""
+
+    def __init__(self, module, trace: Optional[Any] = None):
+        import torch.fx
+
+        self.module = module
+        self.graph_module = trace or torch.fx.symbolic_trace(module)
+        # fx node name -> framework layer name (for weight porting)
+        self.node_to_layer: Dict[str, str] = {}
+
+    # ---------------------------------------------------------------- apply
+    def apply(self, ffmodel: Model, inputs: Sequence[Tensor]) -> List[Tensor]:
+        """Replay the traced graph onto ``ffmodel`` (reference
+        torch/model.py:2408)."""
+        import torch
+
+        env: Dict[str, Any] = {}
+        input_iter = iter(inputs)
+        out: List[Tensor] = []
+        mods = dict(self.graph_module.named_modules())
+
+        for node in self.graph_module.graph.nodes:
+            if node.op == "placeholder":
+                env[node.name] = next(input_iter)
+            elif node.op == "get_attr":
+                raise UnsupportedTorchOp(
+                    f"get_attr {node.target} (constants not supported)")
+            elif node.op == "call_module":
+                m = mods[node.target]
+                x = env[node.args[0].name]
+                y = self._call_module(ffmodel, node, m, x)
+                env[node.name] = y
+                if isinstance(y, Tensor) and y.owner_layer is not None:
+                    self.node_to_layer[node.name] = y.owner_layer.name
+            elif node.op in ("call_function", "call_method"):
+                env[node.name] = self._call_function(ffmodel, node, env)
+            elif node.op == "output":
+                args = node.args[0]
+                if isinstance(args, (tuple, list)):
+                    out = [env[a.name] for a in args]
+                else:
+                    out = [env[args.name]]
+        return out
+
+    # ------------------------------------------------------------- modules
+    def _call_module(self, ff: Model, node, m, x):
+        import torch.nn as nn
+
+        if isinstance(m, nn.Linear):
+            return ff.dense(x, m.out_features, use_bias=m.bias is not None)
+        if isinstance(m, nn.Conv2d):
+            kh, kw = _pair(m.kernel_size)
+            sh, sw = _pair(m.stride)
+            ph, pw = _pair(m.padding)
+            return ff.conv2d(x, m.out_channels, kh, kw, sh, sw, ph, pw,
+                             groups=m.groups, use_bias=m.bias is not None)
+        if isinstance(m, (nn.MaxPool2d, nn.AvgPool2d)):
+            kh, kw = _pair(m.kernel_size)
+            sh, sw = _pair(m.stride or m.kernel_size)
+            ph, pw = _pair(m.padding)
+            pt = (PoolType.MAX if isinstance(m, nn.MaxPool2d)
+                  else PoolType.AVG)
+            return ff.pool2d(x, kh, kw, sh, sw, ph, pw, pool_type=pt)
+        if isinstance(m, nn.Embedding):
+            return ff.embedding(x, m.num_embeddings, m.embedding_dim)
+        if isinstance(m, nn.LayerNorm):
+            return ff.layer_norm(x, eps=m.eps,
+                                 elementwise_affine=m.elementwise_affine,
+                                 use_bias=m.bias is not None)
+        if isinstance(m, nn.Dropout):
+            return ff.dropout(x, rate=m.p)
+        if isinstance(m, nn.Flatten):
+            return ff.flat(x)
+        if isinstance(m, nn.ReLU):
+            return ff.relu(x)
+        if isinstance(m, nn.GELU):
+            return ff.gelu(x)
+        if isinstance(m, nn.Sigmoid):
+            return ff.sigmoid(x)
+        if isinstance(m, nn.Tanh):
+            return ff.tanh(x)
+        if isinstance(m, nn.Softmax):
+            return ff.softmax(x, axis=m.dim if m.dim is not None else -1)
+        if isinstance(m, nn.Identity):
+            return x
+        raise UnsupportedTorchOp(f"module {type(m).__name__}")
+
+    # ----------------------------------------------------------- functions
+    def _call_function(self, ff: Model, node, env):
+        import torch
+        import torch.nn.functional as F
+
+        def val(a):
+            return env[a.name] if hasattr(a, "name") else a
+
+        args = [val(a) for a in node.args]
+        tgt = node.target
+        name = tgt if isinstance(tgt, str) else getattr(tgt, "__name__", "")
+
+        binary = {operator.add: (ff.add, ff.scalar_add),
+                  "add": (ff.add, ff.scalar_add),
+                  operator.sub: (ff.subtract, ff.scalar_sub),
+                  "sub": (ff.subtract, ff.scalar_sub),
+                  operator.mul: (ff.multiply, ff.scalar_multiply),
+                  "mul": (ff.multiply, ff.scalar_multiply),
+                  operator.truediv: (ff.divide, ff.scalar_true_divide),
+                  "div": (ff.divide, ff.scalar_true_divide)}
+        if tgt in binary or (isinstance(tgt, str) and tgt in binary):
+            key = tgt if tgt in binary else name
+            tensor_fn, scalar_fn = binary[key]
+            a, b = args[0], args[1]
+            if isinstance(b, Tensor) and isinstance(a, Tensor):
+                return tensor_fn(a, b)
+            if isinstance(a, Tensor):
+                return scalar_fn(a, float(b))
+            return scalar_fn(b, float(a))
+
+        if tgt in (torch.relu, F.relu) or name == "relu":
+            return ff.relu(args[0])
+        if tgt is F.gelu or name == "gelu":
+            return ff.gelu(args[0])
+        if tgt in (torch.sigmoid, F.sigmoid) or name == "sigmoid":
+            return ff.sigmoid(args[0])
+        if tgt in (torch.tanh, F.tanh) or name == "tanh":
+            return ff.tanh(args[0])
+        if tgt is F.softmax or name == "softmax":
+            axis = node.kwargs.get("dim", args[1] if len(args) > 1 else -1)
+            return ff.softmax(args[0], axis=-1 if axis is None else axis)
+        if tgt in (torch.flatten,) or name == "flatten":
+            return ff.flat(args[0])
+        if name in ("view", "reshape"):
+            shape = (list(args[1]) if isinstance(args[1], (tuple, list))
+                     else [int(s) for s in args[1:]])
+            shape = [int(s) for s in shape]
+            if -1 in shape:
+                total = int(np.prod(args[0].spec.shape))
+                known = int(np.prod([s for s in shape if s != -1]))
+                shape[shape.index(-1)] = total // known
+            return ff.reshape(args[0], shape)
+        if name == "transpose":
+            d0, d1 = int(args[1]), int(args[2])
+            ndim = args[0].spec.ndim
+            perm = list(range(ndim))
+            perm[d0], perm[d1] = perm[d1], perm[d0]
+            return ff.transpose(args[0], perm)
+        if tgt is torch.cat or name == "cat":
+            axis = node.kwargs.get("dim", args[1] if len(args) > 1 else 0)
+            return ff.concat(args[0], axis=axis)
+        if tgt is torch.matmul or name == "matmul":
+            return ff.batch_matmul(args[0], args[1])
+        if name == "contiguous":
+            return args[0]
+        if name == "size":
+            raise UnsupportedTorchOp("dynamic .size() in traced graph")
+        raise UnsupportedTorchOp(f"function {tgt}")
+
+    # ------------------------------------------------------------- weights
+    def port_parameters(self, ffmodel: Model) -> Dict[str, Dict[str, Any]]:
+        """Copy torch weights into the framework param tree for every layer
+        created by :meth:`apply` (reference: the fx importer relies on
+        FlexFlow-side initializers; we do better and port exactly)."""
+        import torch.nn as nn
+
+        assert ffmodel.params is not None, "compile or init params first"
+        mods = dict(self.graph_module.named_modules())
+        fx_nodes = {n.name: n for n in self.graph_module.graph.nodes}
+        for node_name, layer_name in self.node_to_layer.items():
+            m = mods[fx_nodes[node_name].target]
+            p = ffmodel.params.get(layer_name)
+            if p is None:
+                continue
+            with_no_grad = _np_params(m)
+            if isinstance(m, nn.Linear):
+                p["kernel"] = with_no_grad["weight"].T.copy()
+                if "bias" in with_no_grad:
+                    p["bias"] = with_no_grad["bias"]
+            elif isinstance(m, nn.Conv2d):
+                p["kernel"] = with_no_grad["weight"]  # OIHW both sides
+                if "bias" in with_no_grad:
+                    p["bias"] = with_no_grad["bias"]
+            elif isinstance(m, nn.Embedding):
+                p["embedding"] = with_no_grad["weight"]
+            elif isinstance(m, nn.LayerNorm):
+                if "weight" in with_no_grad:
+                    p["weight"] = with_no_grad["weight"]
+                if "bias" in with_no_grad:
+                    p["bias"] = with_no_grad["bias"]
+        import jax.numpy as jnp
+
+        ffmodel.params = {ln: {pn: jnp.asarray(pv) for pn, pv in lp.items()}
+                          for ln, lp in ffmodel.params.items()}
+        return ffmodel.params
+
+    # -------------------------------------------------- serialized op list
+    def to_op_list(self) -> str:
+        """Serialize the traced graph (reference: the importer's file
+        format written by ``torch_to_flexflow``, torch/model.py)."""
+        ops = []
+        for node in self.graph_module.graph.nodes:
+            ops.append({
+                "name": node.name, "op": node.op,
+                "target": str(node.target),
+                "args": [a.name if hasattr(a, "name") else a
+                         for a in node.args
+                         if not isinstance(a, (dict, slice))],
+            })
+        return json.dumps(ops, default=str, indent=2)

@@ -1,0 +1,103 @@
+"""Keras frontend tests.
+
+Mirrors the reference's keras training integration suite
+(tests/training_tests.sh keras seq/func examples with accuracy-threshold
+gates via the VerifyMetrics callback).
+"""
+
+import numpy as np
+import pytest
+
+import flexflow_tpu.keras as keras
+from flexflow_tpu.keras.callbacks import (EarlyStopping,
+                                          LearningRateScheduler,
+                                          ModelCheckpoint, VerifyMetrics)
+
+
+def _blob_data(n=512, dim=16, classes=4, seed=0):
+    rng = np.random.default_rng(seed)
+    centers = rng.normal(size=(classes, dim)).astype(np.float32) * 3
+    y = rng.integers(0, classes, n).astype(np.int32)
+    x = centers[y] + rng.normal(size=(n, dim)).astype(np.float32)
+    return x, y
+
+
+def test_sequential_mnist_style():
+    x, y = _blob_data()
+    m = keras.Sequential([
+        keras.Dense(32, activation="relu"),
+        keras.Dropout(0.1),
+        keras.Dense(4, activation="softmax"),
+    ], batch_size=32)
+    m.compile(optimizer=keras.SGD(lr=0.05, momentum=0.9),
+              loss="sparse_categorical_crossentropy", metrics=["accuracy"],
+              input_shape=(16,))
+    perf = m.fit(x, y, epochs=8, verbose=False,
+                 callbacks=[VerifyMetrics(90.0)])
+    assert perf.accuracy > 90.0
+    ev = m.evaluate(x, y)
+    assert ev.accuracy > 90.0
+
+
+def test_functional_api_merge():
+    x, y = _blob_data()
+    a = keras.Input((16,))
+    h1 = keras.Dense(32, activation="relu")(a)
+    h2 = keras.Dense(32, activation="tanh")(a)
+    merged = keras.Add()([h1, h2])
+    out = keras.Dense(4, activation="softmax")(merged)
+    m = keras.Model(a, out, batch_size=32)
+    m.compile(optimizer="adam", loss="sparse_categorical_crossentropy")
+    perf = m.fit(x, y, epochs=8, verbose=False)
+    assert perf.accuracy > 85.0
+    preds = m.predict(x[:64])
+    assert preds.shape == (64, 4)
+    np.testing.assert_allclose(preds.sum(axis=1), 1.0, rtol=1e-4)
+
+
+def test_cnn_pipeline():
+    rng = np.random.default_rng(1)
+    x = rng.normal(size=(128, 3, 8, 8)).astype(np.float32)
+    y = (x.mean(axis=(1, 2, 3)) > 0).astype(np.int32)
+    m = keras.Sequential([
+        keras.Conv2D(8, 3, padding="same", activation="relu"),
+        keras.MaxPooling2D(2),
+        keras.Flatten(),
+        keras.Dense(2, activation="softmax"),
+    ], batch_size=16)
+    m.compile(optimizer="sgd", loss="sparse_categorical_crossentropy",
+              input_shape=(3, 8, 8))
+    m.fit(x, y, epochs=2, verbose=False)
+    assert m.predict(x[:16]).shape == (16, 2)
+
+
+def test_callbacks(tmp_path):
+    x, y = _blob_data(256)
+    lrs = []
+    m = keras.Sequential([keras.Dense(16, activation="relu"),
+                          keras.Dense(4, activation="softmax")],
+                         batch_size=32)
+    m.compile(optimizer=keras.SGD(lr=0.1),
+              loss="sparse_categorical_crossentropy", input_shape=(16,))
+
+    class Spy(LearningRateScheduler):
+        def on_epoch_begin(self, epoch):
+            super().on_epoch_begin(epoch)
+            lrs.append(self.model.core.optimizer.lr)
+
+    m.fit(x, y, epochs=3, verbose=False, callbacks=[
+        Spy(lambda e, lr: lr * 0.5),
+        ModelCheckpoint(str(tmp_path / "ck")),
+        EarlyStopping(monitor="accuracy", patience=1),
+    ])
+    assert lrs == [0.05, 0.025, 0.0125]
+    from flexflow_tpu.training.checkpoint import CheckpointManager
+    assert CheckpointManager(str(tmp_path / "ck")).latest_step() is not None
+
+
+def test_summary():
+    a = keras.Input((16,), name="inp")
+    out = keras.Dense(4)(a)
+    m = keras.Model(a, out)
+    s = m.summary()
+    assert "Dense" in s

@@ -95,6 +95,19 @@ class TestPCG:
         # a pure chain: every interior node is a bottleneck
         assert len(pcg.bottleneck_nodes()) >= len(m.layers) - 2
 
+    def test_residual_skip_disqualifies_bottleneck(self):
+        """A node bypassed by a residual edge is NOT a cut point
+        (regression: frontier off-by-one admitted it)."""
+        m = Model(FFConfig(batch_size=8), name="resnet_like")
+        x = m.create_tensor((8, 32), name="x")
+        h = m.dense(x, 32, name="inner")       # bypassed by the skip
+        s = m.add(h, x, name="skip_add")       # x jumps over `inner`
+        m.dense(s, 4, name="head")
+        pcg = PCG(m)
+        cuts = pcg.bottleneck_nodes()
+        assert "inner" not in cuts
+        assert "skip_add" in cuts
+
     def test_strategy_json_roundtrip_and_dot(self):
         m = _mlp(32, 64, 128, 10)
         pcg = PCG(m)

@@ -97,6 +97,28 @@ def test_half_precision_cache_roundtrip(tiny_llama_dir, cache_path):
     assert jnp.asarray(a2).dtype == jnp.bfloat16  # JAX accepts it
 
 
+def test_legacy_void_cache_treated_as_miss(tiny_llama_dir, cache_path):
+    """Pre-tag caches holding raw |V2 bf16 must be rewritten, not returned
+    (regression)."""
+    import ml_dtypes
+
+    model_dir, _ = tiny_llama_dir
+    llm = ff.LLM(model_dir, data_type=DataType.HALF, cache_path=cache_path)
+    llm.download_hf_weights_if_needed()
+    wdir = llm._precision_dir()
+    npz = os.path.join(wdir, "weights.npz")
+    # simulate the old buggy format: untagged keys, raw void bytes
+    with np.load(npz) as z:
+        legacy = {k.replace("__bf16__", ""):
+                  (z[k].view(np.dtype("V2")) if k.startswith("__bf16__")
+                   else z[k]) for k in z.files}
+    np.savez(npz, **legacy)
+    llm2 = ff.LLM(model_dir, data_type=DataType.HALF, cache_path=cache_path)
+    p = llm2.download_hf_weights_if_needed()
+    a = p["embed_tokens"]["embedding"]
+    assert a.dtype == np.dtype(ml_dtypes.bfloat16)  # reconverted, not V2
+
+
 def test_spec_infer_entry_matches_incr(tiny_llama_dir, cache_path, tmp_path):
     """spec_infer CLI must produce the same tokens as incr_decoding
     (reference CI gate python_inference_tests.sh:30-55)."""

@@ -1,0 +1,127 @@
+"""torch.fx frontend tests.
+
+Mirrors the reference's PyTorch alignment strategy (tests/align/: run the
+same op in FlexFlow and torch and compare tensors, tests/align/README.md)
+applied to whole fx-traced modules.
+"""
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+import torch.nn as nn  # noqa: E402
+
+from flexflow_tpu import FFConfig, Model  # noqa: E402
+from flexflow_tpu.fftype import LossType, MetricsType  # noqa: E402
+from flexflow_tpu.torch_frontend import PyTorchModel  # noqa: E402
+from flexflow_tpu.training.optimizer import SGDOptimizer  # noqa: E402
+
+
+def _replay_and_port(tm, in_shape, batch=8):
+    ff = Model(FFConfig(batch_size=batch), name=f"fx_{type(tm).__name__}")
+    x = ff.create_tensor((batch,) + in_shape, name="x")
+    pt = PyTorchModel(tm)
+    pt.apply(ff, [x])
+    ff.params = ff.init_params(__import__("jax").random.PRNGKey(0))
+    pt.port_parameters(ff)
+    return ff, pt
+
+
+class MLP(nn.Module):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = nn.Linear(32, 64)
+        self.fc2 = nn.Linear(64, 10)
+        self.act = nn.ReLU()
+
+    def forward(self, x):
+        h = self.act(self.fc1(x))
+        return self.fc2(h) * 0.5 + 1.0
+
+
+class CNN(nn.Module):
+    def __init__(self):
+        super().__init__()
+        self.conv1 = nn.Conv2d(3, 8, 3, stride=1, padding=1)
+        self.pool = nn.MaxPool2d(2, 2)
+        self.flatten = nn.Flatten()
+        self.fc = nn.Linear(8 * 8 * 8, 10)
+
+    def forward(self, x):
+        h = self.pool(torch.relu(self.conv1(x)))
+        return self.fc(self.flatten(h))
+
+
+class Norms(nn.Module):
+    def __init__(self):
+        super().__init__()
+        self.ln = nn.LayerNorm(16)
+        self.fc = nn.Linear(16, 16)
+        self.sm = nn.Softmax(dim=-1)
+
+    def forward(self, x):
+        return self.sm(self.fc(self.ln(x)) + x)
+
+
+@pytest.mark.parametrize("cls,shape", [(MLP, (32,)), (CNN, (3, 16, 16)),
+                                       (Norms, (16,))])
+def test_forward_alignment(cls, shape):
+    torch.manual_seed(0)
+    tm = cls().eval()
+    ff, pt = _replay_and_port(tm, shape)
+    x = np.random.default_rng(0).normal(size=(8,) + shape).astype(np.float32)
+    with torch.no_grad():
+        want = tm(torch.tensor(x)).numpy()
+    got = np.asarray(ff.apply(ff.params, x))
+    np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)
+
+
+def test_embedding_module():
+    class Emb(nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.emb = nn.Embedding(50, 16)
+            self.fc = nn.Linear(16, 4)
+
+        def forward(self, ids):
+            return self.fc(self.emb(ids))
+
+    torch.manual_seed(1)
+    tm = Emb().eval()
+    ff = Model(FFConfig(batch_size=4), name="fx_emb")
+    from flexflow_tpu.fftype import DataType
+    x = ff.create_tensor((4, 6), DataType.INT32, name="ids")
+    pt = PyTorchModel(tm)
+    pt.apply(ff, [x])
+    import jax
+    ff.params = ff.init_params(jax.random.PRNGKey(0))
+    pt.port_parameters(ff)
+    ids = np.random.default_rng(1).integers(0, 50, (4, 6)).astype(np.int32)
+    with torch.no_grad():
+        want = tm(torch.tensor(ids.astype(np.int64))).numpy()
+    got = np.asarray(ff.apply(ff.params, ids))
+    np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)
+
+
+def test_imported_model_trains():
+    """Imported graphs are real Models: compile + fit converge."""
+    torch.manual_seed(2)
+    tm = MLP()
+    ff, _ = _replay_and_port(tm, (32,), batch=16)
+    ff.softmax(ff.layers[-1].outputs[0])
+    ff.compile(SGDOptimizer(lr=0.05),
+               loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+               metrics=[MetricsType.ACCURACY])
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(256, 32)).astype(np.float32)
+    y = (x[:, 0] > 0).astype(np.int32)
+    perf = ff.fit([x], y, epochs=30, verbose=False)
+    assert perf.accuracy > 80.0
+
+
+def test_op_list_serialization():
+    pt = PyTorchModel(MLP())
+    import json
+    ops = json.loads(pt.to_op_list())
+    assert any(o["op"] == "call_module" for o in ops)
+    assert ops[0]["op"] == "placeholder"
