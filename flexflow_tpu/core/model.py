@@ -80,6 +80,7 @@ class Model:
         self._train_step = None
         self._eval_step = None
         self._rng = None
+        self._epochs_trained = 0
         self.current_transformer_layer_id = -1
 
     # ------------------------------------------------------------- builders
@@ -659,7 +660,7 @@ class Model:
 
         input_names = [t.name for t in self.input_tensors]
 
-        def train_step(trainable, state, opt_state, rng, batch):
+        def train_step(trainable, state, opt_state, rng, batch, lr):
             def loss_fn(tr):
                 p = self._merge_params(tr, state)
                 ctx = OpContext(training=True, rng=rng, state_updates={},
@@ -676,7 +677,8 @@ class Model:
 
             (loss, (vals, updates)), grads = jax.value_and_grad(
                 loss_fn, has_aux=True)(trainable)
-            new_tr, new_opt = self.optimizer.update(trainable, grads, opt_state)
+            new_tr, new_opt = self.optimizer.update(trainable, grads,
+                                                    opt_state, lr=lr)
             new_state = jax.tree.map(lambda x: x, state)
             for lname, up in updates.items():
                 new_state.setdefault(lname, {}).update(up)
@@ -722,14 +724,22 @@ class Model:
             x = [x]
         batch_size = batch_size or self.config.batch_size
         epochs = epochs or self.config.epochs
+        # advance the shuffle seed across fit() calls so per-epoch keras
+        # loops (N calls of epochs=1) see fresh batch orders like one
+        # epochs=N call does
         group = DataLoaderGroup(list(x) + [y], batch_size, mesh=self.mesh,
-                                shuffle=shuffle, seed=self.config.seed)
+                                shuffle=shuffle,
+                                seed=self.config.seed + self._epochs_trained)
         if group.num_batches == 0:
             raise ValueError(
                 f"dataset has {y.shape[0]} samples < batch_size {batch_size}")
         trainable, state = self._split_params(self.params)
         perf = PerfMetrics()
         for epoch in range(epochs):
+            self._epochs_trained += 1
+            # schedules mutate optimizer.lr between epochs; feed it as a
+            # traced scalar so the jitted step sees the new value
+            lr = jnp.asarray(self.optimizer.step_size(), jnp.float32)
             group.reset()
             epoch_perf = PerfMetrics()
             # accumulate on device; fetch ONCE per epoch so async dispatch
@@ -741,7 +751,7 @@ class Model:
                 batch = group.next_batch()
                 self._rng, step_rng = jax.random.split(self._rng)
                 trainable, state, self.opt_state, loss, mvals = self._train_step(
-                    trainable, state, self.opt_state, step_rng, batch)
+                    trainable, state, self.opt_state, step_rng, batch, lr)
                 loss_sum = loss if loss_sum is None else loss_sum + loss
                 for k, v in mvals.items():
                     macc[k] = v if k not in macc else macc[k] + v
@@ -754,9 +764,11 @@ class Model:
                         for k, v in host_m.items()}
             epoch_perf.update(host_avg, n)
             perf.update(host_avg, n)
+            epoch_loss = float(jax.device_get(loss_sum)) / group.num_batches
+            epoch_perf.last_loss = perf.last_loss = epoch_loss
             if verbose:
                 print(f"epoch {epoch}: {epoch_perf.report()} "
-                      f"loss={float(jax.device_get(loss_sum)) / group.num_batches:.4f} "
+                      f"loss={epoch_loss:.4f} "
                       f"throughput={n / dt:.1f} samples/s")
         self.params = self._merge_params(trainable, state)
         return perf

@@ -36,10 +36,11 @@ class KTensor:
 class KerasLayer:
     _count = 0
 
-    def __init__(self, name: Optional[str] = None):
-        type(self).__name__  # noqa: B018
+    def __init__(self, name: Optional[str] = None, **kw):
         KerasLayer._count += 1
         self.name = name or f"{type(self).__name__.lower()}_{KerasLayer._count}"
+        # keras-style Dense(..., input_shape=(16,)) on the first layer
+        self.input_shape = kw.get("input_shape")
         self.inbound: List[KTensor] = []
         self.output: Optional[KTensor] = None
 
@@ -68,8 +69,14 @@ def Input(shape: Sequence[int], dtype: DataType = DataType.FLOAT,
 
 
 def _maybe_activation(model, t, activation):
-    act = _ACTIVATIONS.get(activation, ActiMode.NONE) \
-        if not isinstance(activation, ActiMode) else activation
+    if not isinstance(activation, ActiMode):
+        if activation not in _ACTIVATIONS:
+            raise KeyError(
+                f"unknown activation {activation!r}; supported: "
+                f"{sorted(k for k in _ACTIVATIONS if isinstance(k, str))}")
+        act = _ACTIVATIONS[activation]
+    else:
+        act = activation
     if act == "softmax":
         return model.softmax(t)
     return t if act in (ActiMode.NONE,) else {
@@ -80,7 +87,7 @@ def _maybe_activation(model, t, activation):
 class Dense(KerasLayer):
     def __init__(self, units: int, activation=None, use_bias: bool = True,
                  name: Optional[str] = None, **_):
-        super().__init__(name)
+        super().__init__(name, **_)
         self.units, self.activation, self.use_bias = units, activation, use_bias
 
     def compute_output_shape(self, in_shapes):
@@ -114,7 +121,7 @@ class Flatten(KerasLayer):
 
 class Dropout(KerasLayer):
     def __init__(self, rate: float, name: Optional[str] = None, **_):
-        super().__init__(name)
+        super().__init__(name, **_)
         self.rate = rate
 
     def build_on(self, model, inputs):
@@ -124,7 +131,7 @@ class Dropout(KerasLayer):
 class Embedding(KerasLayer):
     def __init__(self, input_dim: int, output_dim: int,
                  name: Optional[str] = None, **_):
-        super().__init__(name)
+        super().__init__(name, **_)
         self.input_dim, self.output_dim = input_dim, output_dim
 
     def compute_output_shape(self, in_shapes):
@@ -140,7 +147,7 @@ class Conv2D(KerasLayer):
     def __init__(self, filters: int, kernel_size, strides=(1, 1),
                  padding="valid", activation=None, use_bias: bool = True,
                  groups: int = 1, name: Optional[str] = None, **_):
-        super().__init__(name)
+        super().__init__(name, **_)
         self.filters = filters
         self.kernel = (kernel_size, kernel_size) if isinstance(
             kernel_size, int) else tuple(kernel_size)
@@ -176,7 +183,7 @@ class _Pool2D(KerasLayer):
 
     def __init__(self, pool_size=(2, 2), strides=None, padding="valid",
                  name: Optional[str] = None, **_):
-        super().__init__(name)
+        super().__init__(name, **_)
         self.pool = (pool_size, pool_size) if isinstance(pool_size, int) \
             else tuple(pool_size)
         strides = strides or self.pool
@@ -212,7 +219,7 @@ class AveragePooling2D(_Pool2D):
 
 class BatchNormalization(KerasLayer):
     def __init__(self, name: Optional[str] = None, **_):
-        super().__init__(name)
+        super().__init__(name, **_)
 
     def build_on(self, model, inputs):
         return model.batch_norm(inputs[0], relu=False)
@@ -221,7 +228,7 @@ class BatchNormalization(KerasLayer):
 class LayerNormalization(KerasLayer):
     def __init__(self, epsilon: float = 1e-3, name: Optional[str] = None,
                  **_):
-        super().__init__(name)
+        super().__init__(name, **_)
         self.epsilon = epsilon
 
     def build_on(self, model, inputs):

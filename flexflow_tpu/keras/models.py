@@ -120,8 +120,7 @@ class Model:
                 cb.on_epoch_begin(epoch)
             perf = self.core.fit(x, y, epochs=1, batch_size=batch_size,
                                  verbose=verbose)
-            logs = {"accuracy": perf.accuracy,
-                    "loss": perf.sparse_cce_loss / max(perf.train_all, 1)}
+            logs = {"accuracy": perf.accuracy, "loss": perf.last_loss}
             for cb in callbacks:
                 cb.on_epoch_end(epoch, logs)
             if any(getattr(cb, "stop_training", False) for cb in callbacks):
@@ -143,10 +142,15 @@ class Model:
         bs = batch_size or self.core.config.batch_size
         outs = []
         n = x[0].shape[0]
-        for i in range(0, n - n % bs, bs):
+        for i in range(0, n, bs):
             batch = [np.asarray(xi[i:i + bs]) for xi in x]
-            outs.append(np.asarray(self.core.apply(self.core.params, *batch)))
-        return np.concatenate(outs, axis=0) if outs else np.empty((0,))
+            tail = batch[0].shape[0]
+            if tail < bs:   # pad the last partial batch, slice after
+                batch = [np.concatenate(
+                    [b, np.repeat(b[-1:], bs - tail, axis=0)]) for b in batch]
+            out = np.asarray(self.core.apply(self.core.params, *batch))
+            outs.append(out[:tail])
+        return np.concatenate(outs, axis=0)
 
     def summary(self) -> str:
         lines = [f'Model: "{self.name}"']

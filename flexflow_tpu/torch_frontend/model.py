@@ -133,6 +133,8 @@ class PyTorchModel:
         import torch.nn.functional as F
 
         def val(a):
+            if isinstance(a, (list, tuple)):
+                return type(a)(val(x) for x in a)
             return env[a.name] if hasattr(a, "name") else a
 
         args = [val(a) for a in node.args]
@@ -155,7 +157,14 @@ class PyTorchModel:
                 return tensor_fn(a, b)
             if isinstance(a, Tensor):
                 return scalar_fn(a, float(b))
-            return scalar_fn(b, float(a))
+            # scalar on the left: add/mul commute; c - x composes; c / x
+            # has no stable elementwise inverse in the op set
+            if tensor_fn in (ff.add, ff.multiply):  # == on bound methods
+                return scalar_fn(b, float(a))
+            if tensor_fn == ff.subtract:   # c - x == -(x - c)
+                return ff.scalar_multiply(ff.scalar_sub(b, float(a)), -1.0)
+            raise UnsupportedTorchOp(f"scalar-over-tensor {name} "
+                                     f"({a!r} {name} tensor)")
 
         if tgt in (torch.relu, F.relu) or name == "relu":
             return ff.relu(args[0])

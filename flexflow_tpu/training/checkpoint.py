@@ -53,7 +53,9 @@ class CheckpointManager:
     # ----------------------------------------------------------------- save
     def save(self, step: int, model, wait: bool = True) -> None:
         """Save params + opt_state + rng at ``step``."""
-        state: Dict[str, Any] = {"params": model.params}
+        state: Dict[str, Any] = {"params": model.params,
+                                 "epochs_trained":
+                                     np.int64(model._epochs_trained)}
         if model.opt_state is not None:
             state["opt_state"] = model.opt_state
         if model._rng is not None:
@@ -100,6 +102,8 @@ class CheckpointManager:
             model.opt_state = restored["opt_state"]
         if "rng" in restored and model._rng is not None:
             model._rng = jax.numpy.asarray(restored["rng"])
+        if "epochs_trained" in restored:
+            model._epochs_trained = int(restored["epochs_trained"])
         return step
 
     # ------------------------------------------------------------- queries

@@ -24,6 +24,7 @@ class PerfMetrics:
 
     train_all: int = 0
     train_correct: int = 0
+    last_loss: float = 0.0   # most recent epoch's mean training loss
     cce_loss: float = 0.0
     sparse_cce_loss: float = 0.0
     mse_loss: float = 0.0

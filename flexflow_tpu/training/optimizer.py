@@ -23,12 +23,21 @@ import jax.numpy as jnp
 
 class Optimizer:
     """Functional optimizer: init(params) -> state;
-    update(params, grads, state) -> (new_params, new_state)."""
+    update(params, grads, state) -> (new_params, new_state).
+
+    ``lr`` (the step size — ``alpha`` for Adam) may be passed as a traced
+    scalar so learning-rate schedules work inside one jitted train step
+    without retracing; None falls back to the attribute.
+    """
 
     def init(self, params):
         raise NotImplementedError
 
-    def update(self, params, grads, state):
+    def update(self, params, grads, state, lr=None):
+        raise NotImplementedError
+
+    def step_size(self) -> float:
+        """Current host-side step size (fed into the jitted step)."""
         raise NotImplementedError
 
 
@@ -48,8 +57,12 @@ class SGDOptimizer(Optimizer):
             return {}
         return {"v": jax.tree.map(jnp.zeros_like, params)}
 
-    def update(self, params, grads, state):
-        wd, lr, mu = self.weight_decay, self.lr, self.momentum
+    def step_size(self) -> float:
+        return self.lr
+
+    def update(self, params, grads, state, lr=None):
+        wd, mu = self.weight_decay, self.momentum
+        lr = self.lr if lr is None else lr
 
         if mu == 0.0:
             new_params = jax.tree.map(
@@ -94,12 +107,16 @@ class AdamOptimizer(Optimizer):
             "t": jnp.zeros((), jnp.int32),
         }
 
-    def update(self, params, grads, state):
+    def step_size(self) -> float:
+        return self.alpha
+
+    def update(self, params, grads, state, lr=None):
         t = state["t"] + 1
         b1, b2 = self.beta1, self.beta2
+        alpha = self.alpha if lr is None else lr
         # bias-corrected step size, computed once per step like the
         # reference's next_update (optimizer.cc)
-        alpha_t = self.alpha * jnp.sqrt(1 - b2 ** t.astype(jnp.float32)) / (
+        alpha_t = alpha * jnp.sqrt(1 - b2 ** t.astype(jnp.float32)) / (
             1 - b1 ** t.astype(jnp.float32))
 
         def step(p, g, m, v):

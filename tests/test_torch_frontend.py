@@ -119,6 +119,85 @@ def test_imported_model_trains():
     assert perf.accuracy > 80.0
 
 
+def test_scalar_left_and_cat():
+    """Regression: `1.0 - x` must compute c-x (not x-c); torch.cat's list
+    argument must resolve fx nodes to tensors."""
+    class M(nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Linear(8, 8)
+
+        def forward(self, x):
+            a = 1.0 - self.fc(x)
+            b = 2.0 * self.fc(x)
+            return torch.cat([a, b], dim=1)
+
+    torch.manual_seed(3)
+    tm = M().eval()
+    ff, pt = _replay_and_port(tm, (8,), batch=4)
+    x = np.random.default_rng(2).normal(size=(4, 8)).astype(np.float32)
+    with torch.no_grad():
+        want = tm(torch.tensor(x)).numpy()
+    got = np.asarray(ff.apply(ff.params, x))
+    np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)
+
+
+def test_keras_style_regressions():
+    """input_shape kwarg on the first layer; predict() keeps the tail
+    partial batch; logs['loss'] is the real loss; LR schedule really
+    changes the step size."""
+    import flexflow_tpu.keras as keras
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(70, 16)).astype(np.float32)
+    y = (x[:, 0] > 0).astype(np.int32)
+    m = keras.Sequential([keras.Dense(8, activation="relu",
+                                      input_shape=(16,)),
+                          keras.Dense(2, activation="softmax")],
+                         batch_size=32)
+    m.compile(optimizer=keras.SGD(lr=0.1),
+              loss="sparse_categorical_crossentropy")
+    losses = []
+
+    class Rec(keras.callbacks.Callback):
+        def on_epoch_end(self, epoch, logs):
+            losses.append(logs["loss"])
+
+    m.fit(x[:64], y[:64], epochs=3, verbose=False, callbacks=[Rec()])
+    assert all(l > 0 for l in losses) and losses[0] != losses[-1]
+    preds = m.predict(x)
+    assert preds.shape == (70, 2)  # tail batch kept
+    # unknown activation strings raise instead of silently acting linear
+    with pytest.raises(KeyError):
+        keras.layers._maybe_activation(m.core, None, "silu")
+
+
+def test_lr_schedule_changes_updates():
+    """The scheduled lr must flow into the jitted step (regression: it was
+    constant-folded at trace time)."""
+    import flexflow_tpu.keras as keras
+    from flexflow_tpu.keras.callbacks import LearningRateScheduler
+
+    rng = np.random.default_rng(1)
+    x = rng.normal(size=(64, 8)).astype(np.float32)
+    y = (x[:, 0] > 0).astype(np.int32)
+
+    def build():
+        m = keras.Sequential([keras.Dense(4, activation="softmax",
+                                          input_shape=(8,))], batch_size=32)
+        m.compile(optimizer=keras.SGD(lr=0.1),
+                  loss="sparse_categorical_crossentropy")
+        return m
+
+    a, b = build(), build()
+    a.fit(x, y, epochs=2, verbose=False)
+    b.fit(x, y, epochs=2, verbose=False,
+          callbacks=[LearningRateScheduler(lambda e, lr: lr * 0.01)])
+    ka = np.asarray(a.core.params["linear_0"]["kernel"])
+    kb = np.asarray(b.core.params["linear_0"]["kernel"])
+    assert not np.allclose(ka, kb), "schedule had no effect on updates"
+
+
 def test_op_list_serialization():
     pt = PyTorchModel(MLP())
     import json
