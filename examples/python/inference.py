@@ -1,0 +1,49 @@
+"""Serving demo: incremental decoding on a local LLaMA checkpoint.
+
+Twin of the reference's Python serving quickstart (SERVE.md:34-60 /
+inference/python/incr_decoding.py).  With no checkpoint argument it builds
+a tiny randomly-initialized LLaMA locally (the environment has no network
+egress) just to demonstrate the full serve path end-to-end.
+"""
+
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__),
+                                os.pardir, os.pardir))
+
+
+def main():
+    model_dir = sys.argv[1] if len(sys.argv) > 1 else None
+    if model_dir is None:
+        import torch
+        import transformers
+
+        torch.manual_seed(0)
+        cfg = transformers.LlamaConfig(
+            vocab_size=512, hidden_size=256, intermediate_size=512,
+            num_hidden_layers=4, num_attention_heads=8,
+            num_key_value_heads=4, max_position_embeddings=512,
+            tie_word_embeddings=False, bos_token_id=1, eos_token_id=2)
+        model_dir = tempfile.mkdtemp(prefix="tiny_llama_")
+        transformers.LlamaForCausalLM(cfg).eval().save_pretrained(model_dir)
+        print(f"built tiny random LLaMA at {model_dir}")
+
+    import flexflow_tpu.serve as ff
+    from flexflow_tpu.fftype import DataType
+
+    ff.init(num_gpus=1)
+    llm = ff.LLM(model_dir, data_type=DataType.FLOAT)
+    llm.compile(ff.GenerationConfig(do_sample=False),
+                max_requests_per_batch=4, max_seq_length=128,
+                max_tokens_per_batch=64)
+    prompts = [[1, 17, 3, 99], [1, 5, 9]]
+    results = llm.generate(prompts, max_new_tokens=16)
+    for r in results:
+        print(f"[{r.guid}] prompt={r.input_tokens} -> "
+              f"tokens={[int(t) for t in r.output_tokens]}")
+
+
+if __name__ == "__main__":
+    main()

@@ -52,8 +52,15 @@ class Linear(OpDef):
 
     def forward(self, params, inputs, attrs, ctx):
         (x,) = inputs
-        w = params["kernel"]
-        y = jnp.einsum("...i,io->...o", x, w.astype(x.dtype),
+        if "kernel_q" in params:
+            # weight-only quantized path: dequant fuses into the einsum's
+            # operand load, so HBM traffic stays int8/int4
+            from ..quantization import dequantize_kernel
+
+            w = dequantize_kernel(params, x.dtype)
+        else:
+            w = params["kernel"].astype(x.dtype)
+        y = jnp.einsum("...i,io->...o", x, w,
                        preferred_element_type=jnp.float32).astype(x.dtype)
         if attrs.get("use_bias", True):
             y = y + params["bias"].astype(y.dtype)

@@ -46,6 +46,7 @@ import numpy as np
 from ..core.initializers import DEFAULT_WEIGHT_INIT
 from ..core.tensor import TensorSpec
 from ..fftype import DataType, OpType
+from ..quantization import resolve_weight
 from .attention_ops import apply_rotary_embedding
 from .registry import OpDef, ParamSpec, register
 
@@ -133,9 +134,9 @@ class _ServingAttentionBase(OpDef):
 
     # ------------------------------------------------------------ helpers
     def _project_qkv(self, params, x, attrs):
-        q = jnp.einsum("rce,ehd->rchd", x, params["wq"].astype(x.dtype))
-        k = jnp.einsum("rce,ehd->rchd", x, params["wk"].astype(x.dtype))
-        v = jnp.einsum("rce,ehd->rchd", x, params["wv"].astype(x.dtype))
+        q = jnp.einsum("rce,ehd->rchd", x, resolve_weight(params, "wq", x.dtype))
+        k = jnp.einsum("rce,ehd->rchd", x, resolve_weight(params, "wk", x.dtype))
+        v = jnp.einsum("rce,ehd->rchd", x, resolve_weight(params, "wv", x.dtype))
         if attrs.get("qkv_bias", False):
             q = q + params["bq"].astype(q.dtype)
             k = k + params["bk"].astype(k.dtype)
@@ -143,7 +144,8 @@ class _ServingAttentionBase(OpDef):
         return q, k, v
 
     def _output(self, params, out, attrs):
-        y = jnp.einsum("rchd,hde->rce", out, params["wo"].astype(out.dtype))
+        y = jnp.einsum("rchd,hde->rce", out,
+                       resolve_weight(params, "wo", out.dtype))
         if attrs.get("final_bias", False):
             y = y + params["bo"].astype(y.dtype)
         return y

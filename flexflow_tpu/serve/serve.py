@@ -27,6 +27,7 @@ import numpy as np
 from ..config import FFConfig
 from ..core.model import Model
 from ..fftype import DataType, InferenceMode
+from ..quantization import quantize_model_params
 from ..serving import (GenerationConfig, GenerationResult, InferenceManager,
                        RequestManager)
 from ..serving.spec_infer import generate_spec_infer
@@ -90,6 +91,27 @@ class SupportedModels:
 
 def _default_cache_path() -> str:
     return os.path.expanduser("~/.cache/flexflow_tpu")
+
+
+def _maybe_offload_params(params):
+    """Place weights in host memory (reference --offload: weights live in
+    zero-copy CPU memory with a device reserve buffer, config.h offload
+    fields).  TPU-natively: pinned_host memory kind; XLA streams weights
+    into HBM per use.  Falls back with a warning where the backend lacks
+    memory-kind support."""
+    import warnings
+
+    import jax
+
+    try:
+        dev = jax.devices()[0]
+        host = jax.sharding.SingleDeviceSharding(dev,
+                                                 memory_kind="pinned_host")
+        return jax.device_put(params, host)
+    except Exception as e:  # pragma: no cover - backend-dependent
+        warnings.warn(f"host offload unavailable on this backend ({e}); "
+                      f"keeping weights in device memory")
+        return params
 
 
 def _flatten(tree: Dict[str, Any], prefix: str = "") -> Dict[str, np.ndarray]:
@@ -306,6 +328,12 @@ class LLM:
                 generation_config=self.generation_config,
                 dtype=self.data_type)
         self.model.params = self.download_hf_weights_if_needed()
+        # weight-only quantization (reference --4bit/--8bit-quantization,
+        # file_loader.cc:400+) and host offload (reference --offload zero-
+        # copy reserve; here pinned_host memory with XLA-inserted streaming)
+        quantize_model_params(self.model, cfg.quantization)
+        if cfg.offload:
+            self.model.params = _maybe_offload_params(self.model.params)
         self.im = InferenceManager(cfg)
         self.model_id = self.im.compile_model_and_allocate_buffer(
             self.model, mode=mode, max_requests=max_requests_per_batch,

@@ -116,9 +116,23 @@ class InferenceManager:
             model.params = model.init_params(rng)
         pspecs = _param_pspecs(model)
         if mesh is not None:
+            from ..quantization import extend_quantized_pspecs
+
+            pspecs = extend_quantized_pspecs(pspecs, model.params)
+
+            def _put(v, spec):
+                # preserve host offload: a pinned_host-resident weight keeps
+                # its memory kind through the TP resharding
+                kind = getattr(getattr(v, "sharding", None), "memory_kind",
+                               None)
+                if kind and kind != "device":
+                    sh = NamedSharding(mesh, spec, memory_kind=kind)
+                else:
+                    sh = NamedSharding(mesh, spec)
+                return jax.device_put(v, sh)
+
             model.params = {
-                ln: {pn: jax.device_put(v, NamedSharding(mesh, pspecs[ln][pn]))
-                     for pn, v in lp.items()}
+                ln: {pn: _put(v, pspecs[ln][pn]) for pn, v in lp.items()}
                 for ln, lp in model.params.items()}
 
         # KV caches per serving-attention layer (reference: allocated in

@@ -122,6 +122,7 @@ class RequestManager:
         self.next_guid = 1000000
         self.next_available_guid = self.next_guid
         self.ssm_model_ids: List[int] = []
+        self._dumped_guids: set = set()
         self._rng = np.random.default_rng(0)
 
     # -------------------------------------------------------------- setup
@@ -305,6 +306,28 @@ class RequestManager:
             from .spec_infer import generate_spec_infer
             return generate_spec_infer(self, im, model_id, reqs, seed=seed)
         return self.generate_incr_decoding(im, model_id, reqs, seed=seed)
+
+    def dump_profiles(self, path: str):
+        """Per-request latency/steps dump (reference
+        request_manager.cc:404-441 profiling output file)."""
+        import json
+
+        with open(path, "a") as f:
+            for req in self.completed.values():
+                if req.guid in self._dumped_guids:
+                    continue  # periodic calls must not duplicate records
+                self._dumped_guids.add(req.guid)
+                p = req.profile
+                f.write(json.dumps({
+                    "guid": req.guid,
+                    "prompt_len": req.prompt_len,
+                    "output_len": len(req.tokens) - req.prompt_len,
+                    "llm_decoding_steps": p.llm_decoding_steps,
+                    "ssm_decoding_steps": p.ssm_decoding_steps,
+                    "speculated_tokens": p.speculated_tokens,
+                    "accepted_tokens": p.accepted_tokens,
+                    "latency_s": p.finish_time - p.start_time,
+                }) + "\n")
 
     def _result_of(self, req: Request) -> GenerationResult:
         out_tokens = req.tokens[req.prompt_len:]

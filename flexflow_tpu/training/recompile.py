@@ -1,0 +1,53 @@
+"""Dynamic recompilation (reference: src/recompile/recompile_state.cc,
+include/flexflow/recompile.h:26-41).
+
+A user-supplied ``trigger_func(model) -> bool`` is evaluated between
+epochs; when true, ``alter_func(model)`` mutates the layer graph / config
+and the model recompiles (invoked in the reference's train loop,
+model.cc:2791-2795; its MoE example uses this to re-balance experts).
+Under jit, "recompile" means rebuilding the jitted step — weights carry
+over by name, so capacity changes keep learned state where shapes agree.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+
+class RecompileState:
+    def __init__(self, trigger_func: Callable[..., bool],
+                 alter_func: Callable[..., None], model=None):
+        self.trigger_func = trigger_func
+        self.alter_func = alter_func
+        self.model = model
+        self.recompilations = 0
+
+    def trigger(self) -> bool:
+        return bool(self.trigger_func(self.model))
+
+    def alter(self) -> None:
+        """reference: RecompileState::alter (recompile_state.cc)."""
+        self.alter_func(self.model)
+        self.recompilations += 1
+
+
+def maybe_recompile(state: Optional[RecompileState], model) -> bool:
+    """Call between epochs (reference model.cc:2791).  Returns True if a
+    recompilation happened; the caller re-jits before the next epoch."""
+    if state is None:
+        return False
+    state.model = state.model or model
+    if not state.trigger():
+        return False
+    old_params = model.params
+    state.alter()
+    model.compile(model.optimizer, loss_type=model.loss_type,
+                  metrics=model.metrics)
+    # carry learned weights over where layer names + shapes still agree
+    for lname, lp in (old_params or {}).items():
+        if lname in model.params:
+            for pname, pv in lp.items():
+                cur = model.params[lname].get(pname)
+                if cur is not None and cur.shape == pv.shape:
+                    model.params[lname][pname] = pv
+    return True

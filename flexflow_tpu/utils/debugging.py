@@ -1,0 +1,52 @@
+"""Inference debugging: per-op tensor dumps.
+
+TPU-native equivalent of the reference's ``--inference-debugging`` mode
+(``Op::save_inference_tensors_to_file``, src/runtime/operator.cc:29, call
+sites like linear.cc:663-673): every op's inputs, weights and outputs are
+written to files for offline diffing against another implementation.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, List
+
+import jax
+import numpy as np
+
+from .eager import eager_layer_walk
+
+
+def save_inference_tensors(model, params, input_values: Dict[str, Any],
+                           outdir: str, inference: bool = True,
+                           rng=None) -> List[str]:
+    """Run the graph eagerly, dumping ``<layer>.{input_i,param_*,output_i}
+    .npy`` per op (reference file naming: model-id_decoding-step_layer-name
+    _shard-id; here one dir per call).  Returns the written paths."""
+    os.makedirs(outdir, exist_ok=True)
+    written: List[str] = []
+
+    def dump(name: str, arr):
+        a = np.asarray(jax.device_get(arr))
+        if a.dtype.kind == "V" or str(a.dtype) == "bfloat16":
+            # np.save writes bf16 as raw void and the dtype is lost on
+            # load — widen so dumps stay diffable offline
+            a = np.asarray(jax.device_get(jax.numpy.asarray(arr)
+                                          .astype(jax.numpy.float32)))
+        p = os.path.join(outdir, name + ".npy")
+        np.save(p, a)
+        written.append(p)
+
+    def visit(layer, run, lparams, ins):
+        for i, x in enumerate(ins):
+            dump(f"{layer.name}.input_{i}", x)
+        for pname, pv in lparams.items():
+            dump(f"{layer.name}.param_{pname}", pv)
+        outs = run()
+        for i, o in enumerate(outs):
+            dump(f"{layer.name}.output_{i}", o)
+        return outs
+
+    eager_layer_walk(model, params, input_values, visit,
+                     inference=inference, rng=rng)
+    return written

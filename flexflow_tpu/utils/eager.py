@@ -1,0 +1,42 @@
+"""Shared eager layer-graph walk for the debug/profile utilities.
+
+One walk, two consumers (utils/profiling.py, utils/debugging.py) — the
+jitted execution path stays in Model.run_layers; this is the host-visible
+twin used when per-layer host work (timing, file dumps) is needed.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict
+
+from ..ops.registry import OpContext, get_op
+
+
+def eager_layer_walk(model, params, input_values: Dict[str, Any],
+                     visit: Callable, inference: bool = False,
+                     rng=None) -> Dict[Any, Any]:
+    """Walk the layer graph eagerly, delegating each op's execution to
+    ``visit(layer, run, lparams, ins) -> outs`` where ``run()`` executes
+    the op.  ``visit`` may run it several times (profiling) or dump
+    tensors around it (debugging); it must return the op's outputs."""
+    from ..core.model import _tensor_key
+
+    ctx = OpContext(training=False, rng=rng, mesh=model.mesh)
+    vals: Dict[Any, Any] = {}
+    for t in model.input_tensors:
+        if t.name in input_values:
+            vals[("__input__", t.name)] = input_values[t.name]
+    for layer in model.layers:
+        ins = [vals[_tensor_key(t)] for t in layer.inputs]
+        op = get_op(layer.op_type)
+        lparams = params.get(layer.name, {})
+        fn = op.inference if inference and hasattr(op, "inference") \
+            else op.forward
+
+        def run(fn=fn, lparams=lparams, ins=ins, layer=layer):
+            return fn(lparams, ins, layer.attrs, ctx)
+
+        outs = visit(layer, run, lparams, ins)
+        for i, o in enumerate(outs):
+            vals[(layer.name, i)] = o
+    return vals

@@ -1,0 +1,77 @@
+"""Profiling utilities.
+
+TPU-native equivalents of the reference's profiling aids (SURVEY.md §5):
+- per-op kernel timing behind ``--profiling`` (cudaEvent timing in every
+  kernel wrapper, src/ops/kernels/linear_kernels.cu:130-164) →
+  :func:`profile_per_op` runs each layer eagerly with block_until_ready;
+- NVTX ranges (deps/nvtx) → :func:`annotate` wraps
+  ``jax.profiler.TraceAnnotation``;
+- Legion ``-lg:prof`` → :func:`trace` wraps the XLA/TensorBoard profiler
+  (``jax.profiler.trace``), capturing device timelines.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Any, Dict, List
+
+import jax
+
+from .eager import eager_layer_walk
+
+
+def annotate(name: str):
+    """Named range visible in the profiler timeline (reference
+    nvtxRangePushA, request_manager.cc:2030)."""
+    return jax.profiler.TraceAnnotation(name)
+
+
+@contextlib.contextmanager
+def trace(logdir: str):
+    """Capture a device trace viewable in TensorBoard/XProf (the Legion
+    ``-lg:prof`` analogue)."""
+    jax.profiler.start_trace(logdir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def profile_per_op(model, params, input_values: Dict[str, Any],
+                   repeats: int = 5, inference: bool = False,
+                   rng=None) -> List[Dict[str, Any]]:
+    """Time each layer's forward individually (reference --profiling).
+
+    Runs the graph layer by layer eagerly — numbers include dispatch
+    overhead and exclude XLA fusion, so they are for *relative* hot-spot
+    hunting exactly like the reference's per-kernel prints; end-to-end time
+    comes from timing the jitted step.
+    """
+    report: List[Dict[str, Any]] = []
+
+    def visit(layer, run, lparams, ins):
+        outs = run()                     # warm / build
+        jax.block_until_ready(outs)
+        t0 = time.perf_counter()
+        for _ in range(repeats):
+            outs = run()
+            jax.block_until_ready(outs)
+        ms = (time.perf_counter() - t0) / repeats * 1e3
+        report.append({"layer": layer.name, "op": layer.op_type.value,
+                       "ms": ms})
+        return outs
+
+    eager_layer_walk(model, params, input_values, visit,
+                     inference=inference, rng=rng)
+    return report
+
+
+def format_profile(report: List[Dict[str, Any]]) -> str:
+    total = sum(r["ms"] for r in report)
+    lines = [f"{'layer':<40} {'op':<28} {'ms':>9} {'%':>6}"]
+    for r in sorted(report, key=lambda r: -r["ms"]):
+        lines.append(f"{r['layer']:<40} {r['op']:<28} {r['ms']:>9.3f} "
+                     f"{100 * r['ms'] / max(total, 1e-12):>5.1f}%")
+    lines.append(f"{'TOTAL':<40} {'':<28} {total:>9.3f}")
+    return "\n".join(lines)
