@@ -1,0 +1,149 @@
+// Native runtime components.
+//
+// TPU-native equivalents of the reference's C++ host-side hot paths:
+// - BPE tokenizer merge loop (reference: src/runtime/gpt_tokenizer.cc,
+//   324 LoC C++): prompt tokenization is host CPU work on the serving
+//   critical path (TTFT), so it stays native here too.  The Python layer
+//   keeps the regex pre-tokenization and hands each pre-token to
+//   ff_bpe_encode_token; vocab/merges are fed in once via ff_bpe_add_*
+//   (no file parsing in C++ — Python already has the parsed tables).
+// - Batched row gather (reference: src/dataloader/dataloader.cc's
+//   load-entire-dataset + per-iteration batch copy tasks): assembling a
+//   shuffled batch from host RAM before device_put is memcpy-bound;
+//   ff_gather_rows does it without the numpy fancy-indexing allocator
+//   churn, multi-threaded for large batches.
+//
+// Exposed as a flat extern "C" surface (the reference's C API pattern,
+// src/c/flexflow_c.cc) loaded via ctypes — no pybind11 in this image.
+
+#include <cstdint>
+#include <cstring>
+#include <string>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+struct PairHash {
+  size_t operator()(const std::pair<std::string, std::string> &p) const {
+    std::hash<std::string> h;
+    return h(p.first) * 1000003u ^ h(p.second);
+  }
+};
+
+struct BPE {
+  std::unordered_map<std::string, int64_t> vocab;
+  std::unordered_map<std::pair<std::string, std::string>, int64_t, PairHash>
+      ranks;
+};
+
+// split UTF-8 into codepoint-sized symbols (byte-level BPE alphabets are
+// all <= 3-byte sequences)
+std::vector<std::string> utf8_symbols(const char *s) {
+  std::vector<std::string> out;
+  const unsigned char *p = reinterpret_cast<const unsigned char *>(s);
+  while (*p) {
+    int len = 1;
+    if ((*p & 0xF8) == 0xF0)
+      len = 4;
+    else if ((*p & 0xF0) == 0xE0)
+      len = 3;
+    else if ((*p & 0xE0) == 0xC0)
+      len = 2;
+    out.emplace_back(reinterpret_cast<const char *>(p), len);
+    p += len;
+  }
+  return out;
+}
+
+}  // namespace
+
+extern "C" {
+
+void *ff_bpe_new() { return new BPE(); }
+
+void ff_bpe_free(void *h) { delete static_cast<BPE *>(h); }
+
+void ff_bpe_add_token(void *h, const char *token, int64_t id) {
+  static_cast<BPE *>(h)->vocab.emplace(token, id);
+}
+
+void ff_bpe_add_merge(void *h, const char *left, const char *right,
+                      int64_t rank) {
+  static_cast<BPE *>(h)->ranks.emplace(std::make_pair(left, right), rank);
+}
+
+// Apply the merge loop to one pre-token (already byte-encoded UTF-8) and
+// emit vocab ids.  Returns the number of ids, or -1 on overflow/unknown.
+int64_t ff_bpe_encode_token(void *handle, const char *token,
+                            int64_t *out_ids, int64_t max_out) {
+  BPE *bpe = static_cast<BPE *>(handle);
+  std::vector<std::string> word = utf8_symbols(token);
+  const int64_t NO_RANK = INT64_MAX;
+  while (word.size() > 1) {
+    int64_t best_rank = NO_RANK;
+    size_t best_i = 0;
+    for (size_t i = 0; i + 1 < word.size(); ++i) {
+      auto it = bpe->ranks.find({word[i], word[i + 1]});
+      if (it != bpe->ranks.end() && it->second < best_rank) {
+        best_rank = it->second;
+        best_i = i;
+      }
+    }
+    if (best_rank == NO_RANK) break;
+    // merge every occurrence of the best pair (left-to-right), like the
+    // canonical GPT-2 algorithm
+    const std::string first = word[best_i];
+    const std::string second = word[best_i + 1];
+    std::vector<std::string> merged;
+    merged.reserve(word.size());
+    for (size_t i = 0; i < word.size();) {
+      if (i + 1 < word.size() && word[i] == first && word[i + 1] == second) {
+        merged.push_back(first + second);
+        i += 2;
+      } else {
+        merged.push_back(word[i]);
+        i += 1;
+      }
+    }
+    word.swap(merged);
+  }
+  int64_t n = 0;
+  for (const auto &sym : word) {
+    auto it = bpe->vocab.find(sym);
+    if (it == bpe->vocab.end() || n >= max_out) return -1;
+    out_ids[n++] = it->second;
+  }
+  return n;
+}
+
+// Gather rows: dst[i] = src[idx[i]] for row_bytes-sized rows.
+void ff_gather_rows(const char *src, char *dst, const int64_t *idx,
+                    int64_t n, int64_t row_bytes) {
+  const int64_t kParallelThreshold = 4 << 20;  // 4 MiB total
+  if (n * row_bytes < kParallelThreshold) {
+    for (int64_t i = 0; i < n; ++i)
+      std::memcpy(dst + i * row_bytes, src + idx[i] * row_bytes, row_bytes);
+    return;
+  }
+  unsigned hw = std::thread::hardware_concurrency();
+  int64_t nthreads = hw ? (hw < 8 ? hw : 8) : 4;
+  if (nthreads > n) nthreads = n;
+  std::vector<std::thread> threads;
+  int64_t chunk = (n + nthreads - 1) / nthreads;
+  for (int64_t t = 0; t < nthreads; ++t) {
+    int64_t lo = t * chunk, hi = lo + chunk < n ? lo + chunk : n;
+    if (lo >= hi) break;
+    threads.emplace_back([=]() {
+      for (int64_t i = lo; i < hi; ++i)
+        std::memcpy(dst + i * row_bytes, src + idx[i] * row_bytes,
+                    row_bytes);
+    });
+  }
+  for (auto &th : threads) th.join();
+}
+
+int64_t ff_native_abi_version() { return 1; }
+
+}  // extern "C"

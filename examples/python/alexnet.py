@@ -1,0 +1,66 @@
+"""AlexNet training example.
+
+Parity example for the reference's examples/cpp/AlexNet (alexnet.cc) /
+examples/python/native/alexnet.py: the classic 5-conv + 3-dense stack on
+synthetic 3x224x224 data (no dataset egress in this environment).
+
+Run: python examples/python/alexnet.py [--batch-size N] [--epochs N]
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+
+from flexflow_tpu import (FFConfig, LossType, MetricsType, Model,
+                          SGDOptimizer)
+from flexflow_tpu.fftype import ActiMode, PoolType
+
+
+def build_alexnet(model, x):
+    """reference: top_level_task, examples/cpp/AlexNet/alexnet.cc."""
+    t = model.conv2d(x, 64, 11, 11, 4, 4, 2, 2, activation=ActiMode.RELU)
+    t = model.pool2d(t, 3, 3, 2, 2, 0, 0)
+    t = model.conv2d(t, 192, 5, 5, 1, 1, 2, 2, activation=ActiMode.RELU)
+    t = model.pool2d(t, 3, 3, 2, 2, 0, 0)
+    t = model.conv2d(t, 384, 3, 3, 1, 1, 1, 1, activation=ActiMode.RELU)
+    t = model.conv2d(t, 256, 3, 3, 1, 1, 1, 1, activation=ActiMode.RELU)
+    t = model.conv2d(t, 256, 3, 3, 1, 1, 1, 1, activation=ActiMode.RELU)
+    t = model.pool2d(t, 3, 3, 2, 2, 0, 0)
+    t = model.flat(t)
+    t = model.dense(t, 4096, activation=ActiMode.RELU)
+    t = model.dropout(t, 0.5)
+    t = model.dense(t, 4096, activation=ActiMode.RELU)
+    t = model.dropout(t, 0.5)
+    t = model.dense(t, 10)
+    return model.softmax(t)
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--batch-size", type=int, default=64)
+    p.add_argument("--epochs", type=int, default=1)
+    p.add_argument("--samples", type=int, default=256)
+    p.add_argument("--dp", type=int, default=1)
+    args = p.parse_args()
+
+    config = FFConfig(batch_size=args.batch_size, epochs=args.epochs,
+                      data_parallelism_degree=args.dp)
+    model = Model(config, name="alexnet")
+    x = model.create_tensor((args.batch_size, 3, 224, 224))
+    build_alexnet(model, x)
+    model.compile(SGDOptimizer(lr=0.01, momentum=0.9),
+                  loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+                  metrics=[MetricsType.ACCURACY])
+    rng = np.random.default_rng(0)
+    y = rng.integers(0, 10, args.samples).astype(np.int32)
+    xs = (rng.normal(size=(args.samples, 3, 224, 224)).astype(np.float32)
+          + y[:, None, None, None] * 0.1)
+    model.fit([xs], y, epochs=args.epochs)
+
+
+if __name__ == "__main__":
+    main()

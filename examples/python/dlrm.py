@@ -1,0 +1,74 @@
+"""DLRM training example.
+
+Parity example for the reference's examples/cpp/DLRM (dlrm.cc: sparse
+embedding bags + bottom/top MLPs with pairwise feature interaction).
+
+Run: python examples/python/dlrm.py
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+
+from flexflow_tpu import (AdamOptimizer, FFConfig, LossType, MetricsType,
+                          Model)
+from flexflow_tpu.fftype import ActiMode, AggrMode, DataType
+
+
+def mlp(model, t, dims, name):
+    """reference: create_mlp (dlrm.cc)."""
+    for i, d in enumerate(dims):
+        act = ActiMode.RELU if i < len(dims) - 1 else ActiMode.NONE
+        t = model.dense(t, d, activation=act, name=f"{name}_{i}")
+    return t
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--batch-size", type=int, default=64)
+    p.add_argument("--epochs", type=int, default=2)
+    p.add_argument("--embedding-size", type=int, default=16)
+    p.add_argument("--num-sparse", type=int, default=4)
+    p.add_argument("--vocab", type=int, default=1000)
+    args = p.parse_args()
+
+    config = FFConfig(batch_size=args.batch_size, epochs=args.epochs)
+    model = Model(config, name="dlrm")
+    dense_in = model.create_tensor((args.batch_size, 13), name="dense")
+    sparse_ins = [
+        model.create_tensor((args.batch_size, 1), DataType.INT32,
+                            name=f"sparse_{i}")
+        for i in range(args.num_sparse)
+    ]
+    # bottom MLP over dense features (dlrm.cc bottom_mlp)
+    bottom = mlp(model, dense_in, [64, args.embedding_size], "bottom")
+    # embedding bag per sparse feature (SUM aggregation, dlrm.cc)
+    embs = [
+        model.embedding(s, args.vocab, args.embedding_size,
+                        aggr=AggrMode.SUM, name=f"emb_{i}")
+        for i, s in enumerate(sparse_ins)
+    ]
+    # feature interaction: concat embeddings + bottom output (dlrm.cc
+    # interact_features "cat")
+    inter = model.concat(embs + [bottom], axis=1)
+    out = mlp(model, inter, [64, 32, 2], "top")
+    model.softmax(out)
+    model.compile(AdamOptimizer(alpha=1e-3),
+                  loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+                  metrics=[MetricsType.ACCURACY])
+
+    rng = np.random.default_rng(0)
+    n = 512
+    dense = rng.normal(size=(n, 13)).astype(np.float32)
+    sparse = [rng.integers(0, args.vocab, (n, 1)).astype(np.int32)
+              for _ in range(args.num_sparse)]
+    y = ((dense[:, 0] + (sparse[0][:, 0] % 2)) > 0.5).astype(np.int32)
+    model.fit([dense] + sparse, y, epochs=args.epochs)
+
+
+if __name__ == "__main__":
+    main()

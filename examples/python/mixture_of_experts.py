@@ -1,0 +1,55 @@
+"""Mixture-of-Experts training example.
+
+Parity example for the reference's examples/cpp/mixture_of_experts
+(moe.cc: Group_by/Aggregate top-k routed experts with a load-balance
+term), using the framework's `moe` composite (reference FFModel::moe,
+model.h:636).
+
+Run: python examples/python/mixture_of_experts.py
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+
+from flexflow_tpu import (AdamOptimizer, FFConfig, LossType, MetricsType,
+                          Model)
+from flexflow_tpu.fftype import ActiMode
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--batch-size", type=int, default=64)
+    p.add_argument("--epochs", type=int, default=3)
+    p.add_argument("--num-experts", type=int, default=8)
+    p.add_argument("--topk", type=int, default=2)
+    args = p.parse_args()
+
+    config = FFConfig(batch_size=args.batch_size, epochs=args.epochs)
+    model = Model(config, name="moe")
+    x = model.create_tensor((args.batch_size, 64))
+    t = model.dense(x, 64, activation=ActiMode.RELU)
+    # routed expert layer (reference moe.cc: num_exp=128 num_select=2 over
+    # MNIST; scaled down here)
+    t = model.moe(t, num_exp=args.num_experts, num_select=args.topk,
+                  expert_hidden_size=64)
+    t = model.dense(t, 10)
+    model.softmax(t)
+    model.compile(AdamOptimizer(alpha=1e-3),
+                  loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+                  metrics=[MetricsType.ACCURACY])
+
+    rng = np.random.default_rng(0)
+    n = 512
+    centers = rng.normal(size=(10, 64)).astype(np.float32) * 2
+    y = rng.integers(0, 10, n).astype(np.int32)
+    xs = centers[y] + rng.normal(size=(n, 64)).astype(np.float32)
+    model.fit([xs], y, epochs=args.epochs)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,65 @@
+"""Transformer training example.
+
+Parity example for the reference's examples/cpp/Transformer
+(transformer.cc: N encoder layers of multihead attention + 2-dense FFN on
+synthetic data, trained with MSE-style objective).
+
+Run: python examples/python/transformer.py [--layers N] [--batch-size N]
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+
+from flexflow_tpu import (FFConfig, LossType, MetricsType, Model,
+                          AdamOptimizer)
+from flexflow_tpu.fftype import ActiMode
+
+
+def encoder_layer(model, t, hidden, heads, i):
+    """reference: create_attention_encoder (transformer.cc)."""
+    attn = model.multihead_attention(t, t, t, hidden, heads,
+                                     name=f"enc{i}_attn")
+    t = model.add(attn, t, name=f"enc{i}_res1")
+    h = model.dense(t, 4 * hidden, activation=ActiMode.RELU,
+                    name=f"enc{i}_ffn1")
+    h = model.dense(h, hidden, name=f"enc{i}_ffn2")
+    return model.add(h, t, name=f"enc{i}_res2")
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--layers", type=int, default=2)
+    p.add_argument("--batch-size", type=int, default=32)
+    p.add_argument("--seq-len", type=int, default=64)
+    p.add_argument("--hidden", type=int, default=128)
+    p.add_argument("--heads", type=int, default=4)
+    p.add_argument("--epochs", type=int, default=2)
+    args = p.parse_args()
+
+    config = FFConfig(batch_size=args.batch_size, epochs=args.epochs)
+    model = Model(config, name="transformer")
+    x = model.create_tensor((args.batch_size, args.seq_len, args.hidden))
+    t = x
+    for i in range(args.layers):
+        t = encoder_layer(model, t, args.hidden, args.heads, i)
+    t = model.mean(t, dims=[1])       # pool over sequence
+    t = model.dense(t, 8)
+    model.softmax(t)
+    model.compile(AdamOptimizer(alpha=1e-3),
+                  loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+                  metrics=[MetricsType.ACCURACY])
+    rng = np.random.default_rng(0)
+    n = 256
+    y = rng.integers(0, 8, n).astype(np.int32)
+    xs = rng.normal(size=(n, args.seq_len, args.hidden)).astype(np.float32)
+    xs[:, 0, :8] += 3.0 * np.eye(8, args.hidden, dtype=np.float32)[y][:, :8]
+    model.fit([xs], y, epochs=args.epochs)
+
+
+if __name__ == "__main__":
+    main()

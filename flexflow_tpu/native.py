@@ -1,0 +1,150 @@
+"""Loader for the native C++ runtime components (csrc/flexflow_native.cc).
+
+The reference keeps its host runtime in C++ (tokenizer gpt_tokenizer.cc,
+dataloader dataloader.cc, C API flexflow_c.cc); this module builds and
+binds our native equivalents.  Build is on-demand with g++ into a cache
+dir (no pybind11 in the image — plain ctypes over an extern "C" surface),
+and everything degrades gracefully to the pure-Python paths when a
+toolchain is unavailable.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import threading
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+_SRC = os.path.join(os.path.dirname(__file__), os.pardir, "csrc",
+                    "flexflow_native.cc")
+_ABI = 1
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+
+def _build_and_load() -> Optional[ctypes.CDLL]:
+    src = os.path.abspath(_SRC)
+    if not os.path.exists(src):
+        return None
+    with open(src, "rb") as f:
+        digest = hashlib.sha256(f.read()).hexdigest()[:16]
+    cache = os.path.join(os.path.expanduser("~/.cache/flexflow_tpu"),
+                         "native")
+    os.makedirs(cache, exist_ok=True)
+    so = os.path.join(cache, f"libflexflow_native_{digest}.so")
+    if not os.path.exists(so):
+        # per-process tmp name: concurrent cold builds (pytest-xdist,
+        # multi-process launches) must not clobber each other's output
+        tmp = f"{so}.{os.getpid()}.tmp"
+        cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-pthread",
+               src, "-o", tmp]
+        try:
+            subprocess.run(cmd, check=True, capture_output=True,
+                           timeout=120)
+            os.replace(tmp, so)
+        except Exception:
+            return None
+        finally:
+            if os.path.exists(tmp):
+                try:
+                    os.remove(tmp)
+                except OSError:
+                    pass
+    try:
+        lib = ctypes.CDLL(so)
+    except OSError:
+        return None
+    if lib.ff_native_abi_version() != _ABI:
+        return None
+    lib.ff_bpe_new.restype = ctypes.c_void_p
+    lib.ff_bpe_free.argtypes = [ctypes.c_void_p]
+    lib.ff_bpe_add_token.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                     ctypes.c_int64]
+    lib.ff_bpe_add_merge.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                     ctypes.c_char_p, ctypes.c_int64]
+    lib.ff_bpe_encode_token.restype = ctypes.c_int64
+    lib.ff_bpe_encode_token.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p,
+        ctypes.POINTER(ctypes.c_int64), ctypes.c_int64]
+    lib.ff_gather_rows.argtypes = [
+        ctypes.c_char_p, ctypes.c_char_p,
+        ctypes.POINTER(ctypes.c_int64), ctypes.c_int64, ctypes.c_int64]
+    return lib
+
+
+def get_lib() -> Optional[ctypes.CDLL]:
+    """The loaded native library, building it on first use; None if the
+    toolchain/source is unavailable (callers fall back to Python)."""
+    global _lib, _tried
+    with _lock:
+        if not _tried:
+            _tried = True
+            _lib = _build_and_load()
+        return _lib
+
+
+def available() -> bool:
+    return get_lib() is not None
+
+
+# ------------------------------------------------------------------ BPE
+class NativeBPE:
+    """ctypes wrapper over the C++ merge engine (reference
+    gpt_tokenizer.cc).  Python keeps the regex pre-tokenization; each
+    pre-token's merge loop + vocab lookup runs native."""
+
+    def __init__(self, encoder: dict, bpe_ranks: dict):
+        lib = get_lib()
+        assert lib is not None, "native library unavailable"
+        self._lib = lib
+        self._h = ctypes.c_void_p(lib.ff_bpe_new())
+        for tok, tid in encoder.items():
+            lib.ff_bpe_add_token(self._h, tok.encode("utf-8"), int(tid))
+        for (a, b), rank in bpe_ranks.items():
+            lib.ff_bpe_add_merge(self._h, a.encode("utf-8"),
+                                 b.encode("utf-8"), int(rank))
+        self._buf = (ctypes.c_int64 * 4096)()
+
+    def encode_token(self, token: str) -> Optional[List[int]]:
+        """ids for one byte-encoded pre-token; None -> caller falls back."""
+        n = self._lib.ff_bpe_encode_token(self._h, token.encode("utf-8"),
+                                          self._buf, len(self._buf))
+        if n < 0:
+            return None
+        return list(self._buf[:n])
+
+    def __del__(self):
+        lib, h = getattr(self, "_lib", None), getattr(self, "_h", None)
+        if lib is not None and h:
+            lib.ff_bpe_free(h)
+
+
+# --------------------------------------------------------------- gather
+def gather_rows(src: np.ndarray, indices: Sequence[int]) -> np.ndarray:
+    """dst[i] = src[indices[i]] over the leading axis, memcpy'd natively
+    (falls back to numpy fancy indexing without the library)."""
+    lib = get_lib()
+    src = np.asarray(src)
+    idx = np.asarray(indices, np.int64)
+    # numpy fancy indexing handles everything the memcpy path can't:
+    # missing lib, PyObject refcounting, non-contiguous layouts (native
+    # would force a full-dataset copy per call), negative/out-of-range
+    # indices (end-relative semantics / IndexError)
+    if (lib is None or src.dtype.hasobject
+            or not src.flags.c_contiguous or len(idx) == 0
+            or idx.min() < 0 or idx.max() >= src.shape[0]):
+        return src[idx]
+    out = np.empty((len(idx),) + src.shape[1:], src.dtype)
+    row_bytes = src.dtype.itemsize * int(np.prod(src.shape[1:], dtype=np.int64))
+    lib.ff_gather_rows(
+        src.ctypes.data_as(ctypes.c_char_p),
+        out.ctypes.data_as(ctypes.c_char_p),
+        idx.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        len(idx), row_bytes)
+    return out

@@ -104,6 +104,17 @@ class GPT2BPETokenizer(TokenizerBase):
         self.cache = {}
         self.bos_token_id = bos_token_id
         self.eos_token_id = eos_token_id
+        # native merge engine (csrc/flexflow_native.cc — reference
+        # gpt_tokenizer.cc); None -> pure-Python path
+        self._native_cache = {}
+        self._native = None
+        try:
+            from ..native import NativeBPE, available
+
+            if available():
+                self._native = NativeBPE(self.encoder, self.bpe_ranks)
+        except Exception:
+            self._native = None
 
     def _bpe(self, token: str) -> List[str]:
         if token in self.cache:
@@ -132,6 +143,15 @@ class GPT2BPETokenizer(TokenizerBase):
         ids = []
         for tok in self.pat.findall(text):
             mapped = "".join(self.byte_encoder[b] for b in tok.encode("utf-8"))
+            if self._native is not None:
+                native_ids = self._native_cache.get(mapped)
+                if native_ids is None:
+                    native_ids = self._native.encode_token(mapped)
+                    if native_ids is not None:
+                        self._native_cache[mapped] = native_ids
+                if native_ids is not None:
+                    ids.extend(native_ids)
+                    continue
             ids.extend(self.encoder[t] for t in self._bpe(mapped))
         return ids
 

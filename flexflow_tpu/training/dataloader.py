@@ -57,7 +57,12 @@ class SingleDataLoader:
             self.reset()
         sel = self._perm[self._idx: self._idx + self.batch_size]
         self._idx += self.batch_size
-        host = self.data[sel]
+        # native memcpy gather when built (csrc/flexflow_native.cc — the
+        # reference's C++ dataloader batch-copy, dataloader.cc:208-232);
+        # identical result via numpy fancy indexing otherwise
+        from ..native import gather_rows
+
+        host = gather_rows(self.data, sel)
         if self.sharding is not None:
             return jax.device_put(host, self.sharding)
         return jax.device_put(host)
