@@ -43,16 +43,19 @@ struct BPE {
 std::vector<std::string> utf8_symbols(const char *s) {
   std::vector<std::string> out;
   const unsigned char *p = reinterpret_cast<const unsigned char *>(s);
-  while (*p) {
-    int len = 1;
+  size_t remaining = std::strlen(s);
+  while (remaining) {
+    size_t len = 1;
     if ((*p & 0xF8) == 0xF0)
       len = 4;
     else if ((*p & 0xF0) == 0xE0)
       len = 3;
     else if ((*p & 0xE0) == 0xC0)
       len = 2;
+    if (len > remaining) len = remaining;  // truncated/invalid UTF-8 tail
     out.emplace_back(reinterpret_cast<const char *>(p), len);
     p += len;
+    remaining -= len;
   }
   return out;
 }

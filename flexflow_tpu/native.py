@@ -30,13 +30,14 @@ _tried = False
 
 def _build_and_load() -> Optional[ctypes.CDLL]:
     src = os.path.abspath(_SRC)
-    if not os.path.exists(src):
-        return None
-    with open(src, "rb") as f:
-        digest = hashlib.sha256(f.read()).hexdigest()[:16]
-    cache = os.path.join(os.path.expanduser("~/.cache/flexflow_tpu"),
-                         "native")
-    os.makedirs(cache, exist_ok=True)
+    try:
+        with open(src, "rb") as f:
+            digest = hashlib.sha256(f.read()).hexdigest()[:16]
+        cache = os.path.join(os.path.expanduser("~/.cache/flexflow_tpu"),
+                             "native")
+        os.makedirs(cache, exist_ok=True)
+    except OSError:
+        return None  # missing source / unwritable HOME: Python fallback
     so = os.path.join(cache, f"libflexflow_native_{digest}.so")
     if not os.path.exists(so):
         # per-process tmp name: concurrent cold builds (pytest-xdist,
@@ -131,7 +132,9 @@ def gather_rows(src: np.ndarray, indices: Sequence[int]) -> np.ndarray:
     (falls back to numpy fancy indexing without the library)."""
     lib = get_lib()
     src = np.asarray(src)
-    idx = np.asarray(indices, np.int64)
+    # ascontiguousarray, not asarray: the C loop walks a dense int64
+    # buffer, so a strided index view must be compacted first
+    idx = np.ascontiguousarray(indices, np.int64)
     # numpy fancy indexing handles everything the memcpy path can't:
     # missing lib, PyObject refcounting, non-contiguous layouts (native
     # would force a full-dataset copy per call), negative/out-of-range

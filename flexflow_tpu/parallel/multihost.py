@@ -1,0 +1,60 @@
+"""Multi-host initialization.
+
+TPU-native replacement for the reference's multi-node launch stack
+(GASNet/UCX Legion networks + mpirun wrappers, MULTI-NODE.md,
+tests/multinode_helpers/mpi_wrapper*.sh): one `jax.distributed.initialize`
+call per process, after which device meshes span every host — ICI
+collectives within a slice, DCN across slices, no MPI anywhere.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+
+_initialized = False
+
+
+def initialize(coordinator_address: Optional[str] = None,
+               num_processes: Optional[int] = None,
+               process_id: Optional[int] = None) -> None:
+    """Join the multi-host runtime (reference: mpirun + GASNet bootstrap).
+
+    On Cloud TPU the arguments auto-detect from the metadata server; pass
+    them explicitly elsewhere (coordinator "host:port", world size, rank).
+    Environment fallbacks: FF_COORDINATOR, FF_NUM_PROCESSES, FF_PROCESS_ID
+    (mirroring the reference's env-driven config/config.linux scheme).
+    """
+    global _initialized
+    if _initialized:
+        return
+    coordinator_address = (coordinator_address
+                           or os.environ.get("FF_COORDINATOR"))
+    if num_processes is None and "FF_NUM_PROCESSES" in os.environ:
+        num_processes = int(os.environ["FF_NUM_PROCESSES"])
+    if process_id is None and "FF_PROCESS_ID" in os.environ:
+        process_id = int(os.environ["FF_PROCESS_ID"])
+    if num_processes == 1:
+        # single-process "cluster": nothing to coordinate (the reference's
+        # launcher also skips MPI when -np 1); a local loopback address
+        # keeps jax.distributed happy if one was not supplied
+        coordinator_address = coordinator_address or "127.0.0.1:12345"
+        process_id = process_id or 0
+    jax.distributed.initialize(coordinator_address=coordinator_address,
+                               num_processes=num_processes,
+                               process_id=process_id)
+    _initialized = True
+
+
+def is_multi_host() -> bool:
+    return jax.process_count() > 1
+
+
+def local_devices():
+    return jax.local_devices()
+
+
+def global_device_count() -> int:
+    return jax.device_count()

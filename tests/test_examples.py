@@ -1,0 +1,44 @@
+"""Example-script integration tests (the reference's training_tests.sh
+analogue, SURVEY.md §4 point 4: run the example zoo end-to-end and assert
+it completes/converges)."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+ROOT = os.path.abspath(os.path.join(os.path.dirname(__file__), os.pardir))
+
+
+def _run(script, *args, timeout=600):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("XLA_FLAGS", None)
+    return subprocess.run(
+        [sys.executable, os.path.join(ROOT, "examples", "python", script),
+         *args],
+        capture_output=True, text=True, timeout=timeout, env=env)
+
+
+@pytest.mark.parametrize("script,args", [
+    ("transformer.py", ["--layers", "1", "--batch-size", "16",
+                        "--seq-len", "16", "--hidden", "32",
+                        "--heads", "2", "--epochs", "1"]),
+    ("dlrm.py", ["--batch-size", "32", "--epochs", "1",
+                 "--embedding-size", "8", "--vocab", "50"]),
+    ("mixture_of_experts.py", ["--batch-size", "32", "--epochs", "1",
+                               "--num-experts", "4"]),
+])
+def test_example_runs(script, args):
+    r = _run(script, *args)
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "epoch 0" in r.stdout
+
+
+def test_mnist_mlp_converges():
+    r = _run("mnist_mlp.py")
+    assert r.returncode == 0, r.stderr[-2000:]
+    # ModelAccuracy-threshold gate (reference training_tests.sh)
+    last = [l for l in r.stdout.splitlines() if "accuracy" in l][-1]
+    pct = float(last.split("accuracy:")[1].split("%")[0])
+    assert pct > 90.0, r.stdout

@@ -1,0 +1,66 @@
+"""Multi-host runtime tests.
+
+The reference emulates multi-node on one machine with MPI wrappers setting
+per-rank CUDA_VISIBLE_DEVICES (tests/multinode_helpers/mpi_wrapper*.sh);
+here the same emulation is two OS processes joining one
+jax.distributed cluster over loopback — no MPI anywhere.
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+ROOT = os.path.abspath(os.path.join(os.path.dirname(__file__), os.pardir))
+
+_WORKER = """
+import os, sys
+os.environ['JAX_PLATFORMS'] = 'cpu'
+import jax
+jax.config.update('jax_platforms', 'cpu')
+from flexflow_tpu.parallel import multihost
+multihost.initialize('127.0.0.1:%d', 2, int(sys.argv[1]))
+import jax.numpy as jnp
+assert multihost.is_multi_host()
+assert jax.process_count() == 2
+# a real cross-process collective: sum of per-process values
+from jax.experimental import multihost_utils
+total = multihost_utils.process_allgather(
+    jnp.asarray([float(sys.argv[1]) + 1.0]))
+assert float(total.sum()) == 3.0, total
+print('rank', sys.argv[1], 'ok', multihost.global_device_count())
+"""
+
+
+def test_two_process_cluster():
+    port = 23461
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    procs = [subprocess.Popen(
+        [sys.executable, "-c", _WORKER % port, str(i)],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        cwd=ROOT, env=env) for i in range(2)]
+    outs = []
+    for p in procs:
+        out, err = p.communicate(timeout=240)
+        outs.append((p.returncode, out, err))
+    for rc, out, err in outs:
+        assert rc == 0, err[-2000:]
+        assert "ok" in out
+
+
+def test_single_process_initialize():
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    r = subprocess.run([sys.executable, "-c", """
+import os
+os.environ['JAX_PLATFORMS'] = 'cpu'
+import jax
+jax.config.update('jax_platforms', 'cpu')
+from flexflow_tpu.parallel import multihost
+multihost.initialize(num_processes=1, process_id=0)
+assert not multihost.is_multi_host()
+print('ok')
+"""], capture_output=True, text=True, cwd=ROOT, timeout=240, env=env)
+    assert r.returncode == 0, r.stderr[-2000:]

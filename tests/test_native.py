@@ -87,6 +87,14 @@ def test_native_overflow_falls_back_to_python(lib, tiny_bpe_files):
     assert len(tok.encode(text)) == 5000  # no merges apply to 'x'
 
 
+def test_gather_rows_strided_indices(lib):
+    """Regression: a strided index view must be compacted, not walked as
+    a dense buffer."""
+    src = np.arange(30, dtype=np.float32).reshape(10, 3)
+    idx = np.arange(10, dtype=np.int64)[::3]  # non-contiguous view
+    np.testing.assert_array_equal(native.gather_rows(src, idx), src[idx])
+
+
 def test_gather_rows_edge_semantics(lib):
     """Negative / out-of-range indices keep numpy semantics (regression:
     the native memcpy path must not read out of bounds)."""
