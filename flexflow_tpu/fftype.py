@@ -21,8 +21,14 @@ class DataType(enum.Enum):
     BOOL = "bool"
     INT32 = "int32"
     INT64 = "int64"
-    HALF = "float16"
     BFLOAT16 = "bfloat16"
+    # the reference's DT_HALF is CUDA fp16; the TPU-native half precision
+    # is bfloat16 (fp16 is not MXU-native and XLA upcasts it), so HALF
+    # aliases BFLOAT16 (declared after it, so BFLOAT16 stays the canonical
+    # member name).  FLOAT16 exists for ingesting fp16 arrays from
+    # frontends; compute should use BFLOAT16.
+    HALF = "bfloat16"
+    FLOAT16 = "float16"
     FLOAT = "float32"
     DOUBLE = "float64"
     INT4 = "int4"

@@ -202,7 +202,7 @@ class InferenceManager:
     def _build_step(self, record, chunk: int, reorder: bool):
         return jax.jit(self._raw_step(record, reorder), donate_argnums=(1,))
 
-    def _build_decode_block(self, record, k: int):
+    def _build_decode_block(self, record, k: int, include_init: bool = False):
         """K decode steps fused into one device program via lax.scan.
 
         Autoregressive decode needs each sampled token only *on device* for
@@ -216,7 +216,7 @@ class InferenceManager:
         """
         step = self._raw_step(record, reorder=False)
 
-        def block(params, caches, batch, rngs):
+        def block(params, caches, batch, rngs, init_tok):
             active = batch["active"].astype(jnp.int32)
 
             def body(carry, rng_i):
@@ -228,9 +228,14 @@ class InferenceManager:
                 new_tok = outs[0][:, 0].astype(jnp.int32)
                 return (caches, new_tok, depth + active), new_tok
 
-            init = (caches, batch["token_ids"][:, 0], batch["first_depth"])
+            init = (caches, init_tok, batch["first_depth"])
             (caches, _, _), toks = jax.lax.scan(body, init, rngs)
-            return toks, caches  # toks: [k, R] sampled ids
+            if include_init:
+                # prefill→decode handoff: the init token was sampled on
+                # device and never reached the host, so ship it with the
+                # block's tokens in the same (single) sync
+                toks = jnp.concatenate([init_tok[None, :], toks], axis=0)
+            return toks, caches  # toks: [k(+1), R] sampled ids
 
         return jax.jit(block, donate_argnums=(1,))
 
@@ -268,12 +273,18 @@ class InferenceManager:
         return outs
 
     def decode_block(self, model_id: int, bc: BatchConfig, k: int,
-                     rng=None) -> Any:
+                     rng=None, init_tokens=None) -> Any:
         """Run ``k`` fused decode steps (chunk must be 1); returns the
         sampled token ids as a [k, R] device array — ONE host sync for k
         tokens.  The KV scatter stays in bounds because rows are retired by
         the host before exceeding max_seq_length and the cache carries
-        ``prefill_chunk`` slack positions past it."""
+        ``prefill_chunk`` slack positions past it.
+
+        ``init_tokens``: a device [R] int32 array of first tokens (the
+        prefill step's samples) — the prefill→decode handoff.  The host
+        never sees them before the block runs (no tunnel round trip); the
+        returned array is then [k+1, R] with the init tokens first.
+        """
         record = self.models[model_id]
         assert bc.chunk == 1, "decode_block requires a pure-decode batch"
         slack = record["prefill_chunk"]
@@ -284,12 +295,17 @@ class InferenceManager:
         batch = {name: jnp.asarray(v) for name, v in bc.pack().items()}
         if rng is None:
             rng = jax.random.PRNGKey(0)
-        key = ("block", k)
+        include_init = init_tokens is not None
+        if init_tokens is None:
+            init_tokens = batch["token_ids"][:, 0]
+        key = ("block", k, include_init)
         if key not in record["steps"]:
-            record["steps"][key] = self._build_decode_block(record, k)
+            record["steps"][key] = self._build_decode_block(
+                record, k, include_init)
         toks, record["caches"] = record["steps"][key](
             record["model"].params, record["caches"], batch,
-            jax.random.split(rng, k))
+            jax.random.split(rng, k),
+            jnp.asarray(init_tokens, jnp.int32))
         return toks
 
     def reset_request_rows(self, model_id: int, rows: List[int]):

@@ -242,24 +242,46 @@ class RequestManager:
         return bc
 
     # ----------------------------------------------------------- generate
-    def _fold_decode_block(self, bc: BatchConfig, toks: np.ndarray):
+    def _fold_decode_block(self, bc: BatchConfig, toks: np.ndarray,
+                           handoff: bool = False):
         """Fold a [k, R] device-decoded token block into the request state:
         per running row, iteration i consumed one cached token and sampled
         ``toks[i, row]`` — append until EOS/max-len retirement (tokens the
-        device decoded past a row's retirement point are discarded)."""
+        device decoded past a row's retirement point are discarded).
+
+        ``handoff``: toks[0] is the prefill step's sample (the
+        prefill→decode handoff, [k+1, R]); it was cached when the block's
+        first scan step consumed it, so entry 0 appends without a
+        cached_len increment (k increments for k+1 appended tokens keeps
+        the cached_len == len(tokens)-1 decode invariant).
+        """
         k = toks.shape[0]
         for row in list(self.running):
             req = self.running[row]
             if not bc.request_available[row]:
                 continue
             for i in range(k):
-                req.cached_len += 1
-                req.profile.llm_decoding_steps += 1
+                if not (handoff and i == 0):
+                    req.cached_len += 1
+                    req.profile.llm_decoding_steps += 1
                 tok = int(toks[i, row])
                 req.tokens.append(tok)
                 if self._finished(req, tok):
                     self._retire(req)
                     break
+
+    def _decode_only_bc(self) -> BatchConfig:
+        """A chunk-1 BatchConfig over the running rows with device-resident
+        token values (token_ids stay 0 — the block's init_tokens override
+        them)."""
+        bc = BatchConfig(self.max_requests_per_batch, 1)
+        for row, req in self.running.items():
+            bc.request_guid[row] = req.guid
+            bc.first_token_depth[row] = req.cached_len
+            bc.num_tokens_in_batch[row] = 1
+            bc.max_sequence_length[row] = req.max_sequence_length
+            bc.request_available[row] = True
+        return bc
 
     def generate_incr_decoding(self, im: InferenceManager, model_id: int,
                                requests: Sequence[Request],
@@ -284,18 +306,66 @@ class RequestManager:
             rng, step_rng = jax.random.split(rng)
             if bc.chunk == 1 and decode_block > 1:
                 # largest remaining span bounds useful block length
-                remaining = max(
-                    r.remaining_budget(self.max_sequence_length)
-                    for r in self.running.values())
-                k = pick_chunk(max(1, remaining), decode_block)
+                k = pick_chunk(max(1, self._max_remaining_budget()),
+                               decode_block)
                 toks = np.asarray(im.decode_block(model_id, bc, k, step_rng))
                 self._fold_decode_block(bc, toks)
                 bc, result = None, None
                 continue
             outs = im.inference(model_id, bc, rng=step_rng)
+            # prefill→decode handoff: when this step finishes every
+            # running prompt and no request waits for a row, chain the
+            # decode block on device with the (never-materialized) prefill
+            # samples as init tokens — the sync that would download them
+            # costs a full host↔device round trip (fatal over a tunneled
+            # chip, still the dominant non-compute cost on PCIe)
+            if (decode_block > 1 and not self.pending
+                    and self._prefill_completes_all(bc)):
+                rng, block_rng = jax.random.split(rng)
+                self._handoff_decode_block(im, model_id, bc, outs,
+                                           decode_block, block_rng)
+                bc, result = None, None
+                continue
             # final layer is a sampling head emitting [R, C] token ids
             result = InferenceResult(token_ids=np.asarray(outs[0]))
         return [self._result_of(r) for r in requests]
+
+    def _prefill_completes_all(self, bc: BatchConfig) -> bool:
+        """True iff this (prefill) step leaves every running request in
+        pure-decode state — the handoff precondition."""
+        if bc.chunk <= 1:
+            return False
+        for row, req in self.running.items():
+            n = int(bc.num_tokens_in_batch[row])
+            if n == 0 or req.cached_len + n < len(req.tokens):
+                return False
+        return True
+
+    def _max_remaining_budget(self) -> int:
+        return max(r.remaining_budget(self.max_sequence_length)
+                   for r in self.running.values())
+
+    def _handoff_decode_block(self, im: InferenceManager, model_id: int,
+                              bc: BatchConfig, outs, decode_block: int,
+                              block_rng) -> None:
+        """Chain a decode block on the prefill's device-resident samples
+        (never synced to the host) and fold the combined result."""
+        import jax.numpy as jnp
+
+        cols = np.zeros(self.max_requests_per_batch, np.int64)
+        for row, req in self.running.items():
+            n = int(bc.num_tokens_in_batch[row])
+            cols[row] = n - 1
+            req.cached_len += n
+            req.profile.llm_decoding_steps += 1
+        init = outs[0][jnp.arange(outs[0].shape[0]), jnp.asarray(cols)]
+        bc2 = self._decode_only_bc()
+        # init consumes one budget slot, the k scan steps the rest
+        k = pick_chunk(max(1, self._max_remaining_budget() - 1),
+                       decode_block)
+        toks = np.asarray(im.decode_block(model_id, bc2, k, block_rng,
+                                          init_tokens=init))
+        self._fold_decode_block(bc2, toks, handoff=True)
 
     def generate(self, im: InferenceManager, model_id: int,
                  prompts: Sequence[str], max_new_tokens: int = 128,

@@ -33,8 +33,16 @@ print('rank', sys.argv[1], 'ok', multihost.global_device_count())
 """
 
 
+def _free_port() -> int:
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
 def test_two_process_cluster():
-    port = 23461
+    port = _free_port()
     env = dict(os.environ)
     env.pop("XLA_FLAGS", None)
     procs = [subprocess.Popen(
