@@ -75,6 +75,9 @@ def bench_llama_decode():
     return {
         "metric": "llama1p4b_decode_throughput_1chip",
         "value": round(best, 1),
+        # methodology marker: values before this tag used f32 weights and a
+        # single timed run — not comparable with bf16 best-of-3 numbers
+        "methodology": "bf16-weights,best-of-3",
         "unit": "tokens/s",
         # reference publishes no absolute numbers (BASELINE.md §6); 0 = no
         # baseline ratio available

@@ -1,0 +1,41 @@
+"""Keras-frontend CNN example (reference: examples/python/keras/ suite,
+e.g. seq_mnist_cnn.py) on synthetic data."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+
+import flexflow_tpu.keras as keras
+from flexflow_tpu.keras.callbacks import VerifyMetrics
+
+
+def main():
+    rng = np.random.default_rng(0)
+    n = 1024
+    y = rng.integers(0, 10, n).astype(np.int32)
+    x = rng.normal(size=(n, 1, 28, 28)).astype(np.float32)
+    for i in range(n):  # class-dependent 3x3 patch signal
+        r = 2 + 2 * int(y[i])
+        x[i, 0, r:r + 3, r:r + 3] += 3.0
+
+    model = keras.Sequential([
+        keras.Conv2D(16, 3, padding="same", activation="relu"),
+        keras.MaxPooling2D(2),
+        keras.Conv2D(32, 3, padding="same", activation="relu"),
+        keras.MaxPooling2D(2),
+        keras.Flatten(),
+        keras.Dense(64, activation="relu"),
+        keras.Dense(10, activation="softmax"),
+    ], batch_size=64)
+    model.compile(optimizer=keras.SGD(lr=0.05, momentum=0.9),
+                  loss="sparse_categorical_crossentropy",
+                  metrics=["accuracy"], input_shape=(1, 28, 28))
+    model.fit(x, y, epochs=8, callbacks=[VerifyMetrics(80.0)])
+    print("eval:", model.evaluate(x, y).report())
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,51 @@
+"""PyTorch-frontend example (reference: examples/python/pytorch/ suite,
+e.g. mnist_mlp_torch.py): define the net in torch, fx-trace it, replay
+onto the framework, port weights, train on TPU."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+
+
+def main():
+    import torch
+    import torch.nn as nn
+
+    class Net(nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.fc1 = nn.Linear(64, 128)
+            self.fc2 = nn.Linear(128, 10)
+
+        def forward(self, x):
+            return self.fc2(torch.relu(self.fc1(x)))
+
+    from flexflow_tpu import (FFConfig, LossType, MetricsType, Model,
+                              SGDOptimizer)
+    from flexflow_tpu.torch_frontend import PyTorchModel
+
+    torch.manual_seed(0)
+    net = Net()
+    ff = Model(FFConfig(batch_size=64), name="torch_mlp")
+    x = ff.create_tensor((64, 64), name="x")
+    pt = PyTorchModel(net)
+    out = pt.apply(ff, [x])[0]
+    ff.softmax(out)
+    ff.compile(SGDOptimizer(lr=0.05, momentum=0.9),
+               loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+               metrics=[MetricsType.ACCURACY])
+    pt.port_parameters(ff)  # start from the torch module's weights
+
+    rng = np.random.default_rng(0)
+    n = 1024
+    centers = rng.normal(size=(10, 64)).astype(np.float32) * 2
+    y = rng.integers(0, 10, n).astype(np.int32)
+    xs = centers[y] + rng.normal(size=(n, 64)).astype(np.float32)
+    ff.fit([xs], y, epochs=4)
+
+
+if __name__ == "__main__":
+    main()

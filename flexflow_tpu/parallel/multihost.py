@@ -36,16 +36,17 @@ def initialize(coordinator_address: Optional[str] = None,
         num_processes = int(os.environ["FF_NUM_PROCESSES"])
     if process_id is None and "FF_PROCESS_ID" in os.environ:
         process_id = int(os.environ["FF_PROCESS_ID"])
-    if num_processes == 1 and coordinator_address is None:
+    if num_processes == 1:
         # single-process "cluster": nothing to coordinate (the reference's
-        # launcher also skips MPI when -np 1); bind an ephemeral loopback
-        # port so concurrent jobs on one host don't collide
-        import socket
-
-        with socket.socket() as s:
-            s.bind(("127.0.0.1", 0))
-            coordinator_address = f"127.0.0.1:{s.getsockname()[1]}"
+        # launcher also skips MPI when -np 1)
         process_id = process_id or 0
+        if coordinator_address is None:
+            # ephemeral loopback port so concurrent jobs don't collide
+            import socket
+
+            with socket.socket() as s:
+                s.bind(("127.0.0.1", 0))
+                coordinator_address = f"127.0.0.1:{s.getsockname()[1]}"
     jax.distributed.initialize(coordinator_address=coordinator_address,
                                num_processes=num_processes,
                                process_id=process_id)
