@@ -239,6 +239,86 @@ class InferenceManager:
 
         return jax.jit(block, donate_argnums=(1,))
 
+    def _build_beam_block(self, record, d_steps: int, beam_width: int):
+        """``d_steps`` SSM beam-expansion steps fused into one device
+        program (lax.scan) — the device-resident twin of the reference's
+        per-depth beam loop (request_manager.cc:2031-2042).
+
+        Each step: feed the current beam tokens, take the BeamTopK head's
+        per-beam candidate log-probs, re-rank the W*W joint candidates per
+        request on device (the host-side store_beam_metadata re-ranking),
+        and gather each surviving beam's KV cache row from its parent.
+        One host sync then delivers the whole (token, parent, cum_logp)
+        expansion history instead of one sync per depth — the depth loop's
+        host round trips dominate spec_infer wall clock when the chip sits
+        behind a network tunnel.
+        """
+        step = self._raw_step(record, reorder=True)
+        W = beam_width
+
+        def block(params, caches, batch, rngs, init_tok, init_cum):
+            RW = init_tok.shape[0]
+            R = RW // W
+            active = batch["active"].astype(jnp.int32)
+
+            def body(carry, rng_i):
+                caches, tok, cum, depth, parent_rows = carry
+                b = dict(batch)
+                b["token_ids"] = tok[:, None]
+                b["first_depth"] = depth
+                b["parent_rows"] = parent_rows
+                outs, caches = step(params, caches, b, rng_i)
+                # the BeamTopK head emits max_beam_width candidates; use
+                # the first W (they are sorted by probability)
+                ids = outs[0][:, 0, :W].reshape(R, W * W)   # [R, W*W]
+                logp = outs[2][:, 0, :W].reshape(R, W, W)
+                cand = cum[:, :, None] + logp               # [R, Wp, Wc]
+                top_val, top_idx = jax.lax.top_k(
+                    cand.reshape(R, W * W), W)              # [R, W]
+                parent_b = top_idx // W
+                tok_new = jnp.take_along_axis(ids, top_idx, axis=1)
+                tok_new = tok_new.astype(jnp.int32)
+                rows_next = (jnp.arange(R)[:, None] * W
+                             + parent_b).reshape(RW).astype(jnp.int32)
+                carry2 = (caches, tok_new.reshape(RW), top_val,
+                          depth + active, rows_next)
+                return carry2, (tok_new, parent_b, top_val)
+
+            identity = jnp.arange(RW, dtype=jnp.int32)
+            carry = (caches, init_tok, init_cum, batch["first_depth"],
+                     identity)
+            (caches, *_), hist = jax.lax.scan(body, carry, rngs)
+            return hist, caches   # each [d_steps, R, W]
+
+        return jax.jit(block, donate_argnums=(1,))
+
+    def beam_block(self, model_id: int, bc, d_steps: int,
+                   init_tokens, init_cum_logp, rng=None):
+        """Run the fused beam expansion; returns host numpy
+        (tokens, parent_beams, cum_logps), each [d_steps, R, W]."""
+        record = self.models[model_id]
+        W = bc.beam_width
+        assert W == record["beam_width"], (
+            f"beam_width {W} differs from the compiled width "
+            f"{record['beam_width']} — cache rows are laid out per the "
+            f"compiled width")
+        slack = record["prefill_chunk"]
+        d_steps = min(d_steps, slack)  # scatter must stay inside the slack
+        batch = {name: jnp.asarray(v) for name, v in bc.pack().items()}
+        if rng is None:
+            rng = jax.random.PRNGKey(0)
+        key = ("beam_block", d_steps, W)
+        if key not in record["steps"]:
+            record["steps"][key] = self._build_beam_block(record, d_steps,
+                                                          W)
+        hist, record["caches"] = record["steps"][key](
+            record["model"].params, record["caches"], batch,
+            jax.random.split(rng, d_steps),
+            jnp.asarray(init_tokens, jnp.int32),
+            jnp.asarray(init_cum_logp, jnp.float32))
+        toks, parents, cums = hist
+        return (np.asarray(toks), np.asarray(parents), np.asarray(cums))
+
     def _get_step(self, record, chunk: int, reorder: bool):
         key = (chunk, reorder)
         if key not in record["steps"]:

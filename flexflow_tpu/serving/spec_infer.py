@@ -261,73 +261,72 @@ def generate_spec_infer(rm, im, llm_id: int, requests: Sequence[Request],
                 st.beam_logp.append(float(logps[b]))
             req.profile.ssm_decoding_steps += 1
 
-        for depth in range(1, D):
-            if all(len(states[r.guid].tree) + W > tree_chunk
-                   for r in running.values()):
-                break
+        # ---- beam expansion to depth D as ONE fused device program
+        # (InferenceManager.beam_block).  The per-depth host loop the
+        # reference runs (request_manager.cc:2031-2042) would pay one
+        # host↔device round trip per depth; the device re-ranks the W*W
+        # joint candidates itself and the host replays the expansion
+        # history (incl. shared-prefix dedup, merge_dfs_trees) after a
+        # single sync.
+        # fixed depth D-1 so ONE block program compiles per (depth, W) —
+        # a tree-occupancy-dependent depth would recompile the scan every
+        # time occupancy changes; the host replay already stops per-row at
+        # tree capacity, surplus device steps are cheap
+        d_eff = D - 1
+        expandable = any(
+            states[r.guid].beam_nodes
+            and len(states[r.guid].tree) + W <= tree_chunk
+            for r in running.values())
+        if d_eff > 0 and expandable:
             bc = BeamSearchBatchConfig(rm.max_requests_per_batch, 1,
                                        beam_width=W)
-            parent_rows = np.arange(bc.max_requests, dtype=np.int32)
-            any_active = False
+            n_rows = rm.max_requests_per_batch * W
+            init_tok = np.zeros(n_rows, np.int32)
+            init_cum = np.full((rm.max_requests_per_batch, W), -1e30,
+                               np.float32)
             for row, req in running.items():
                 st = states[req.guid]
-                if len(st.tree) + W > tree_chunk:
-                    continue
-                any_active = True
                 for b, node_idx in enumerate(st.beam_nodes):
                     rr = bc.row(row, b)
-                    node = st.tree[node_idx]
                     bc.request_guid[rr] = req.guid
                     bc.request_available[rr] = True
-                    bc.first_token_depth[rr] = st.ssm_cached + depth - 1
+                    bc.first_token_depth[rr] = st.ssm_cached
                     bc.num_tokens_in_batch[rr] = 1
                     bc.max_sequence_length[rr] = req.max_sequence_length
-                    bc.token_ids[rr, 0] = node.token
-            if not any_active:
-                break
+                    init_tok[rr] = st.tree[node_idx].token
+                    init_cum[row, b] = st.beam_logp[b]
             rng, r2 = jax.random.split(rng)
-            outs = im.inference(ssm_id, bc, rng=r2,
-                                parent_rows=parent_rows)
-            ids, _, logps = (np.asarray(outs[0]), np.asarray(outs[1]),
-                             np.asarray(outs[2]))
-            # host-side beam re-ranking (reference store_beam_metadata)
-            reorder = np.arange(bc.max_requests, dtype=np.int32)
-            for row, req in running.items():
-                st = states[req.guid]
-                if not bc.request_available[bc.row(row, 0)]:
-                    continue
-                cands = []  # (cum_logp, beam, token, token_logp)
-                for b, node_idx in enumerate(st.beam_nodes):
-                    rr = bc.row(row, b)
-                    for w in range(W):
-                        cands.append((st.beam_logp[b] + float(logps[rr, 0, w]),
-                                      b, int(ids[rr, 0, w])))
-                cands.sort(key=lambda c: -c[0])
-                new_nodes, new_logp, parents = [], [], []
-                for cum, b, tok in cands[:W]:
-                    parent_node = st.beam_nodes[b]
-                    # dedup shared prefixes (reference merge_dfs_trees)
-                    existing = next(
-                        (i for i, nd in enumerate(st.tree)
-                         if nd.parent == parent_node and nd.token == tok
-                         and nd.depth == st.tree[parent_node].depth + 1),
-                        None)
-                    if existing is None:
-                        st.tree.append(TreeNode(
-                            tok, parent_node,
-                            st.tree[parent_node].depth + 1, cum))
-                        existing = len(st.tree) - 1
-                    new_nodes.append(existing)
-                    new_logp.append(cum)
-                    parents.append(b)
-                # cache rows follow their parent beams
-                for b_new, b_old in enumerate(parents):
-                    reorder[bc.row(row, b_new)] = bc.row(row, b_old)
-                st.beam_nodes, st.beam_logp = new_nodes, new_logp
-                req.profile.ssm_decoding_steps += 1
-            # apply the reorder on the *next* step (gather before scatter);
-            # stash it — next iteration's parent_rows
-            parent_rows = reorder
+            toks_h, parents_h, cums_h = im.beam_block(
+                ssm_id, bc, d_eff, init_tok, init_cum, r2)
+            for i in range(toks_h.shape[0]):
+                for row, req in running.items():
+                    st = states[req.guid]
+                    if len(st.tree) + W > tree_chunk or not st.beam_nodes:
+                        continue
+                    new_nodes, new_logp = [], []
+                    for b in range(W):
+                        pb = int(parents_h[i, row, b])
+                        cum = float(cums_h[i, row, b])
+                        tok = int(toks_h[i, row, b])
+                        if pb >= len(st.beam_nodes) or cum <= -1e29:
+                            continue  # candidate from a padded beam slot
+                        parent_node = st.beam_nodes[pb]
+                        # dedup shared prefixes (reference merge_dfs_trees)
+                        existing = next(
+                            (j for j, nd in enumerate(st.tree)
+                             if nd.parent == parent_node
+                             and nd.token == tok
+                             and nd.depth == st.tree[parent_node].depth + 1),
+                            None)
+                        if existing is None:
+                            st.tree.append(TreeNode(
+                                tok, parent_node,
+                                st.tree[parent_node].depth + 1, cum))
+                            existing = len(st.tree) - 1
+                        new_nodes.append(existing)
+                        new_logp.append(cum)
+                    st.beam_nodes, st.beam_logp = new_nodes, new_logp
+                    req.profile.ssm_decoding_steps += 1
 
         # ---- tree verify step
         bc, _ = _build_tree_batch(rm, im.models[llm_id], states, running,

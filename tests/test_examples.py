@@ -35,6 +35,14 @@ def test_example_runs(script, args):
     assert "epoch 0" in r.stdout
 
 
+def test_frontend_examples_run():
+    """keras + torch.fx frontend example scripts stay green (they gate the
+    frontends' public API surface)."""
+    for script in ("pytorch_mlp.py", "keras_mnist_cnn.py"):
+        r = _run(script, timeout=900)
+        assert r.returncode == 0, (script, r.stderr[-2000:])
+
+
 def test_mnist_mlp_converges():
     r = _run("mnist_mlp.py")
     assert r.returncode == 0, r.stderr[-2000:]
