@@ -1,0 +1,109 @@
+"""Pallas TPU kernel: fused int8-dequant matmul for weight-only-quantized
+serving.
+
+Decode is weight-HBM-bandwidth-bound (BASELINE.md serving configs), so the
+win from int8 quantization is streaming HALF the weight bytes — which only
+materializes if the dequant fuses into the matmul's operand load.  The XLA
+lowering of ``(q.astype(f32) * scale) @ x`` materializes the dequantized
+matrix in HBM (and compiles pathologically inside lax.scan), recreating the
+full-precision traffic; this kernel keeps weights int8 in HBM, dequantizes
+block-by-block in VMEM, and applies the per-output-channel scale once on
+the accumulated tile — the role the reference's hand-written
+``decompress_kernels.cu`` plays for its cuBLAS GEMMs.
+
+Layout contract (matches flexflow_tpu.quantization int8):
+    x [B, K] bf16/f32, q int8 [K, N], scale f32 [N] -> out [B, N] (x.dtype)
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_BK = 1024   # K-block (reduction) — swept on v5e: 1024x512 best
+_BN = 512    # N-block (output channels)
+
+
+def _kernel(x_ref, q_ref, scale_ref, out_ref, acc_ref):
+    from jax.experimental import pallas as pl
+
+    k = pl.program_id(1)
+
+    @pl.when(k == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    # dequantize the weight block in VMEM (int8 -> bf16) and hit the MXU;
+    # the per-channel scale is applied once at the end, not per block
+    w = q_ref[:].astype(jnp.bfloat16)
+    acc_ref[:] += jnp.dot(x_ref[:].astype(jnp.bfloat16), w,
+                          preferred_element_type=jnp.float32)
+
+    @pl.when(k == pl.num_programs(1) - 1)
+    def _finish():
+        out_ref[:] = (acc_ref[:] * scale_ref[:]).astype(out_ref.dtype)
+
+
+def _pad_to(x, axis, mult):
+    size = x.shape[axis]
+    rem = (-size) % mult
+    if rem == 0:
+        return x, size
+    pad = [(0, 0)] * x.ndim
+    pad[axis] = (0, rem)
+    return jnp.pad(x, pad), size
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def int8_matmul(x, q, scale, interpret: bool = False):
+    """x [B, K] @ dequant(q [K, N] int8, scale [N]) -> [B, N] in x.dtype.
+
+    Pads B to the sublane tile and K/N to the block sizes; the padded
+    K rows of q are zero so they contribute nothing.
+    """
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    out_dtype = x.dtype
+    B, K = x.shape
+    N = q.shape[1]
+    x, _ = _pad_to(x, 0, 16)        # bf16 sublane tile
+    x, _ = _pad_to(x, 1, _BK)
+    q, _ = _pad_to(q, 0, _BK)
+    q, _ = _pad_to(q, 1, _BN)
+    # 2-D scale: 1-D f32 operands hit an XLA/Mosaic tiling mismatch
+    scale, _ = _pad_to(scale.reshape(1, -1), 1, _BN)
+    Bp, Kp = x.shape
+    Np = q.shape[1]
+
+    grid = (Np // _BN, Kp // _BK)
+    out = pl.pallas_call(
+        _kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((Bp, _BK), lambda n, k: (0, k)),
+            pl.BlockSpec((_BK, _BN), lambda n, k: (k, n)),
+            pl.BlockSpec((1, _BN), lambda n, k: (0, n)),
+        ],
+        out_specs=pl.BlockSpec((Bp, _BN), lambda n, k: (0, n)),
+        out_shape=jax.ShapeDtypeStruct((Bp, Np), out_dtype),
+        scratch_shapes=[pltpu.VMEM((Bp, _BN), jnp.float32)],
+        interpret=interpret,
+    )(x, q, scale.astype(jnp.float32))
+    return out[:B, :N]
+
+
+def int8_matmul_reference(x, q, scale):
+    """jnp reference (the XLA-dequant path) for parity tests/fallback."""
+    w = q.astype(jnp.float32) * scale[None, :]
+    return jnp.dot(x.astype(jnp.float32), w).astype(x.dtype)
+
+
+def pallas_tpu_available() -> bool:
+    try:
+        return jax.devices()[0].platform in ("tpu", "axon")
+    except Exception:
+        return False

@@ -53,18 +53,49 @@ class Linear(OpDef):
     def forward(self, params, inputs, attrs, ctx):
         (x,) = inputs
         if "kernel_q" in params:
-            # weight-only quantized path: dequant fuses into the einsum's
-            # operand load, so HBM traffic stays int8/int4
-            from ..quantization import dequantize_kernel
-
-            w = dequantize_kernel(params, x.dtype)
+            y = self._quantized_matmul(params, x)
         else:
             w = params["kernel"].astype(x.dtype)
-        y = jnp.einsum("...i,io->...o", x, w,
-                       preferred_element_type=jnp.float32).astype(x.dtype)
+            y = jnp.einsum("...i,io->...o", x, w,
+                           preferred_element_type=jnp.float32).astype(x.dtype)
         if attrs.get("use_bias", True):
             y = y + params["bias"].astype(y.dtype)
         return [apply_activation(y, attrs.get("activation", ActiMode.NONE))]
+
+    @staticmethod
+    def _quantized_matmul(params, x):
+        """Weight-only-quantized forward.  On TPU, int8 goes through the
+        Pallas fused-dequant kernel so weights stream int8 from HBM (the
+        XLA dequant materializes the full-precision matrix — and compiles
+        pathologically inside lax.scan); elsewhere, and for int4, the jnp
+        dequant path is used (XLA fuses it adequately outside scans)."""
+        from ..quantization import dequantize_kernel
+
+        import os
+
+        scale = params["kernel_scale"]
+        # opt-in: per-instance Mosaic compilation through the tunneled
+        # backend is currently minutes per kernel, so the fused path is
+        # enabled explicitly (FF_PALLAS_INT8=1) until compile caching
+        # amortizes it
+        rows = 1
+        for s in x.shape[:-1]:
+            rows *= int(s)
+        # decode-sized batches only: the kernel keeps the whole batch in
+        # one VMEM block, so prefill-sized row counts would blow VMEM
+        if (scale.ndim == 1 and rows <= 64
+                and os.environ.get("FF_PALLAS_INT8") == "1"):
+            from ..kernels.quant_matmul import (int8_matmul,
+                                                pallas_tpu_available)
+
+            if pallas_tpu_available():
+                q = params["kernel_q"]
+                lead = x.shape[:-1]
+                y2 = int8_matmul(x.reshape(-1, x.shape[-1]), q, scale)
+                return y2.reshape(*lead, q.shape[1])
+        w = dequantize_kernel(params, x.dtype)
+        return jnp.einsum("...i,io->...o", x, w,
+                          preferred_element_type=jnp.float32).astype(x.dtype)
 
     def flops(self, attrs, in_specs):
         (x,) = in_specs

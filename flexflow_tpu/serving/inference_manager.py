@@ -257,6 +257,7 @@ class InferenceManager:
         W = beam_width
 
         def block(params, caches, batch, rngs, init_tok, init_cum):
+            assert rngs.shape[0] == d_steps, (rngs.shape, d_steps)
             RW = init_tok.shape[0]
             R = RW // W
             active = batch["active"].astype(jnp.int32)

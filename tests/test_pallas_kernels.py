@@ -1,0 +1,56 @@
+"""Pallas kernel tests (interpret mode on CPU; the real-TPU numbers live
+in the bench notes).  The int8 fused-dequant matmul is the serving-side
+analogue of the reference's decompress_kernels.cu."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from flexflow_tpu.kernels.quant_matmul import (int8_matmul,
+                                               int8_matmul_reference)
+
+
+@pytest.mark.parametrize("B,K,N", [(8, 256, 384), (3, 1024, 512),
+                                   (16, 2048, 1000)])
+def test_int8_matmul_matches_reference(B, K, N):
+    key = jax.random.PRNGKey(0)
+    x = jax.random.normal(key, (B, K), jnp.float32)
+    q = jax.random.randint(key, (K, N), -127, 128, jnp.int8)
+    scale = jnp.abs(jax.random.normal(key, (N,), jnp.float32)) * 0.02 + 1e-3
+    got = np.asarray(int8_matmul(x, q, scale, interpret=True), np.float32)
+    want = np.asarray(int8_matmul_reference(x, q, scale), np.float32)
+    # kernel accumulates bf16 products in f32; tolerance covers the bf16
+    # operand rounding vs the f32 reference
+    denom = np.abs(want).max() + 1e-9
+    assert np.abs(got - want).max() / denom < 2e-2
+
+
+def test_int8_matmul_zero_scale_padding():
+    # padded output channels must not leak into the sliced result
+    key = jax.random.PRNGKey(1)
+    x = jax.random.normal(key, (4, 128), jnp.float32)
+    q = jax.random.randint(key, (128, 130), -5, 6, jnp.int8)  # odd N
+    scale = jnp.ones((130,), jnp.float32)
+    got = np.asarray(int8_matmul(x, q, scale, interpret=True))
+    assert got.shape == (4, 130)
+    want = np.asarray(int8_matmul_reference(x, q, scale))
+    np.testing.assert_allclose(got, want, rtol=2e-2, atol=0.5)
+
+
+def test_linear_op_pallas_gate(monkeypatch):
+    """The in-model fused path is opt-in (FF_PALLAS_INT8) and falls back
+    to the XLA dequant path by default."""
+    from flexflow_tpu import FFConfig, Model
+    from flexflow_tpu.quantization import quantize_model_params
+
+    m = Model(FFConfig(batch_size=4), name="pallas_gate")
+    x = m.create_tensor((4, 64), name="x")
+    m.dense(x, 32)
+    m.params = m.init_params(jax.random.PRNGKey(0))
+    ref = np.asarray(m.apply(m.params, np.ones((4, 64), np.float32)))
+    quantize_model_params(m, "int8")
+    monkeypatch.delenv("FF_PALLAS_INT8", raising=False)
+    got = np.asarray(m.apply(m.params, np.ones((4, 64), np.float32)))
+    np.testing.assert_allclose(got, ref, rtol=0.05, atol=0.05)

@@ -64,6 +64,36 @@ def _spec_generate(llm_hf, ssm_hf, prompts, n_new, beam_width=2,
     return [r.tokens[r.prompt_len:] for r in reqs], reqs
 
 
+def test_single_step_parent_rows_reorder():
+    """The reorder=True single-step path (inference(..., parent_rows=...))
+    stays alive and consistent with the fused beam block's gather
+    semantics even though the macro-loop now uses the block."""
+    hf = _hf_llama(SMALLER, 7)
+    ssm = _build(hf, InferenceMode.BEAM_SEARCH, max_requests=2)
+    im = InferenceManager(ssm.config)
+    sid = im.compile_model_and_allocate_buffer(
+        ssm, mode=InferenceMode.BEAM_SEARCH, max_requests=2,
+        max_seq_length=64, beam_width=2, cache_dtype=np.float32)
+    from flexflow_tpu.serving.batch_config import BeamSearchBatchConfig
+    W, R = 2, 2
+    bc = BeamSearchBatchConfig(R, 1, beam_width=W)
+    for row in range(R):
+        for b in range(W):
+            rr = bc.row(row, b)
+            bc.request_guid[rr] = row
+            bc.request_available[rr] = True
+            bc.first_token_depth[rr] = 0
+            bc.num_tokens_in_batch[rr] = 1
+            bc.max_sequence_length[rr] = 64
+            bc.token_ids[rr, 0] = 3 + row
+    import jax
+    parent_rows = np.array([1, 0, 3, 2], np.int32)  # swap beams per request
+    outs = im.inference(sid, bc, rng=jax.random.PRNGKey(0),
+                        parent_rows=parent_rows)
+    ids = np.asarray(outs[0])
+    assert ids.shape[0] == R * W and ids.shape[-1] >= W
+
+
 def _incr_generate(llm_hf, prompts, n_new, max_requests=4):
     model = _build(llm_hf, InferenceMode.INC_DECODING, max_requests)
     im = InferenceManager(model.config)
