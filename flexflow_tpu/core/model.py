@@ -81,6 +81,7 @@ class Model:
         self._eval_step = None
         self._rng = None
         self._epochs_trained = 0
+        self.strategy = None
         self.current_transformer_layer_id = -1
 
     # ------------------------------------------------------------- builders
@@ -551,6 +552,47 @@ class Model:
         return self._add_layer(OpType.ALLREDUCE, [x], dict(axis=axis), name)[0]
 
     # ------------------------------------------------------------- compile
+    def _train_pspec(self, layer_name: str, pname: str, value) -> PartitionSpec:
+        """Training-time PartitionSpec for a parameter under the compiled
+        strategy: tp>1 shards the weight's output-feature dim over the
+        ``tp`` mesh axis (the reference's partition-parallel weight layout,
+        substitution.cc:70-127); everything else replicates — the batch
+        carries the dp sharding."""
+        a = (self.strategy or {}).get(layer_name)
+        if a is None or a.tp <= 1:
+            return PartitionSpec()
+        layer = next((l for l in self.layers if l.name == layer_name), None)
+        if layer is None:
+            return PartitionSpec()
+        t = layer.op_type
+        spec = PartitionSpec()
+        if t is OpType.LINEAR:
+            if pname == "kernel":
+                spec = PartitionSpec(None, AXIS_MODEL)
+            elif pname == "bias":
+                spec = PartitionSpec(AXIS_MODEL)
+        elif t is OpType.CONV2D:
+            if pname == "kernel":   # OIHW: shard out-channels
+                spec = PartitionSpec(AXIS_MODEL, None, None, None)
+            elif pname == "bias":
+                spec = PartitionSpec(AXIS_MODEL)
+        elif t is OpType.EMBEDDING and pname == "embedding":
+            spec = PartitionSpec(None, AXIS_MODEL)
+        elif t is OpType.MULTIHEAD_ATTENTION:
+            # wq/wk/wv [E, H, D]: shard heads; wo [H, D, E]: shard heads
+            if pname in ("wq", "wk", "wv"):
+                spec = PartitionSpec(None, AXIS_MODEL, None)
+            elif pname == "wo":
+                spec = PartitionSpec(AXIS_MODEL, None, None)
+        # a dim that doesn't divide the tp axis replicates instead of
+        # crashing device_put (e.g. a 10-class head under tp=4)
+        tp_size = self.mesh.shape[AXIS_MODEL] if AXIS_MODEL in \
+            self.mesh.axis_names else 1
+        for dim, ax in enumerate(spec):
+            if ax == AXIS_MODEL and value.shape[dim] % tp_size != 0:
+                return PartitionSpec()
+        return spec
+
     def _non_trainable_keys(self):
         keys = set()
         for layer in self.layers:
@@ -613,39 +655,90 @@ class Model:
     def compile(self, optimizer: Optional[Optimizer] = None,
                 loss_type: LossType = LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
                 metrics: Sequence[MetricsType] = (MetricsType.ACCURACY,),
-                seed: Optional[int] = None):
+                seed: Optional[int] = None, strategy=None):
         """Build the jitted train/eval steps (reference FFModel::compile,
         model.cc:3304 — graph-optimize / fusion / NCCL bootstrap all become
-        this one jit)."""
+        this one jit).
+
+        ``strategy``: a per-layer {name: ShardAssignment} from
+        :func:`flexflow_tpu.search.graph_optimize` — the Unity loop closed:
+        layers assigned tp>1 get their weights sharded over the ``tp`` mesh
+        axis (kernel output dim / conv out-channels / embedding features)
+        and GSPMD inserts the activation collectives the reference
+        materializes as Partition/Combine/AllReduce ops.  Without a
+        strategy, ``tensor_parallelism_degree>1`` in the config synthesizes
+        a uniform one.  (pp/sp/ep training runs through the shard_map
+        trainer, models/llama_train.py.)
+        """
         self.optimizer = optimizer
         self.loss_type = loss_type
         self.metrics = list(metrics)
         self.config.validate()
-        if (self.config.tensor_parallelism_degree > 1
-                or self.config.pipeline_parallelism_degree > 1
+        if (self.config.pipeline_parallelism_degree > 1
                 or self.config.sequence_parallelism_degree > 1
                 or self.config.expert_parallelism_degree > 1):
             raise NotImplementedError(
-                "training compile() currently supports data parallelism only "
-                "(like the reference's onlyDataParallel default, "
-                "model.cc:3995); tp/pp/sp/ep training arrives with the "
-                "parallel IR lowering. Serving supports tp/pp.")
+                "GSPMD training compile() covers dp/tp; pp/sp/ep training "
+                "runs through the shard_map trainer "
+                "(flexflow_tpu/models/llama_train.py)")
+        tp_degree = self.config.tensor_parallelism_degree
+        if strategy is None and tp_degree > 1:
+            from ..search.pcg import ShardAssignment
+
+            strategy = {l.name: ShardAssignment(
+                dp=self.config.data_parallelism_degree, tp=tp_degree)
+                for l in self.layers}
+        self.strategy = strategy
         self._rng = jax.random.PRNGKey(self.config.seed if seed is None else seed)
-        if self.config.data_parallelism_degree > 1:
+        use_tp = strategy is not None and any(
+            a.tp > 1 for a in strategy.values())
+        if use_tp:
+            tps = {a.tp for a in strategy.values() if a.tp > 1}
+            if len(tps) > 1:
+                import warnings
+
+                # GSPMD uses ONE global tp axis: per-layer degrees apply
+                # as the boolean tp>1 over the max degree (per-layer
+                # sub-axis sharding is future work); the search's cost for
+                # heterogeneous strategies describes a finer placement
+                warnings.warn(
+                    f"strategy has heterogeneous tp degrees {sorted(tps)}; "
+                    f"applying max degree {max(tps)} to every tp>1 layer")
+            if tp_degree <= 1:
+                # infer the tp axis size from the strategy
+                tp_degree = max(tps)
+                self.config.tensor_parallelism_degree = tp_degree
+                self.config.data_parallelism_degree = max(
+                    1, self.config.num_devices // tp_degree)
+            self.mesh = self.config.make_mesh([AXIS_DATA, AXIS_MODEL])
+        elif self.config.data_parallelism_degree > 1:
             self.mesh = self.config.make_mesh([AXIS_DATA])
         self._rng, init_rng = jax.random.split(self._rng)
         self.params = self.init_params(init_rng)
         if self.mesh is not None:
-            replicated = NamedSharding(self.mesh, PartitionSpec())
-            self.params = jax.device_put(self.params, replicated)
+            self.params = {
+                ln: {pn: jax.device_put(
+                    v, NamedSharding(self.mesh,
+                                     self._train_pspec(ln, pn, v)))
+                     for pn, v in lp.items()}
+                for ln, lp in self.params.items()}
         if optimizer is not None:
             trainable, _ = self._split_params(self.params)
             self.opt_state = optimizer.init(trainable)
             if self.mesh is not None:
                 # commit opt state to the mesh like params, so checkpoint
                 # restore (which preserves committed shardings) stays
-                # device-consistent with the train step
-                self.opt_state = jax.device_put(self.opt_state, replicated)
+                # device-consistent with the train step; per-parameter
+                # moments inherit the parameter's (possibly tp-sharded)
+                # layout, scalars replicate
+                replicated = NamedSharding(self.mesh, PartitionSpec())
+                param_shard = jax.tree.map(lambda p: p.sharding, trainable)
+                t_struct = jax.tree.structure(trainable)
+                self.opt_state = {
+                    k: jax.device_put(
+                        v, param_shard
+                        if jax.tree.structure(v) == t_struct else replicated)
+                    for k, v in self.opt_state.items()}
 
         final = self.layers[-1]
         out_key = (final.name, 0)

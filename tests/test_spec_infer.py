@@ -87,11 +87,41 @@ def test_single_step_parent_rows_reorder():
             bc.max_sequence_length[rr] = 64
             bc.token_ids[rr, 0] = 3 + row
     import jax
-    parent_rows = np.array([1, 0, 3, 2], np.int32)  # swap beams per request
-    outs = im.inference(sid, bc, rng=jax.random.PRNGKey(0),
-                        parent_rows=parent_rows)
-    ids = np.asarray(outs[0])
-    assert ids.shape[0] == R * W and ids.shape[-1] >= W
+
+    # step 1: cache a DIFFERENT token per beam row so the cache rows are
+    # distinguishable
+    for row in range(R):
+        for b in range(W):
+            bc.token_ids[bc.row(row, b), 0] = 5 + bc.row(row, b)
+    im.inference(sid, bc, rng=jax.random.PRNGKey(0))
+    snapshot = jax.tree.map(lambda c: c.copy(), im.models[sid]["caches"])  # pre-donation copy
+
+    # step 2 at depth 1, same fed token everywhere: the only difference
+    # between identity and swapped parent_rows is WHICH cache row each
+    # beam attends over — outputs must differ if the gather works
+    bc2 = BeamSearchBatchConfig(R, 1, beam_width=W)
+    for row in range(R):
+        for b in range(W):
+            rr = bc2.row(row, b)
+            bc2.request_guid[rr] = row
+            bc2.request_available[rr] = True
+            bc2.first_token_depth[rr] = 1
+            bc2.num_tokens_in_batch[rr] = 1
+            bc2.max_sequence_length[rr] = 64
+            bc2.token_ids[rr, 0] = 9
+    identity = np.arange(R * W, dtype=np.int32)
+    swapped = np.array([1, 0, 3, 2], np.int32)
+    logp_id = np.asarray(im.inference(
+        sid, bc2, rng=jax.random.PRNGKey(1), parent_rows=identity)[2])
+    im.models[sid]["caches"] = snapshot  # rewind the cache mutation
+    logp_sw = np.asarray(im.inference(
+        sid, bc2, rng=jax.random.PRNGKey(1), parent_rows=swapped)[2])
+    assert logp_id.shape[0] == R * W
+    assert not np.allclose(logp_id, logp_sw), \
+        "parent_rows gather had no effect on attention outputs"
+    # swapping beams permutes the rows correspondingly
+    np.testing.assert_allclose(logp_sw[0], logp_id[1], rtol=1e-5)
+    np.testing.assert_allclose(logp_sw[3], logp_id[2], rtol=1e-5)
 
 
 def _incr_generate(llm_hf, prompts, n_new, max_requests=4):

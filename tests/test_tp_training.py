@@ -1,0 +1,94 @@
+"""Tensor-parallel training through the GSPMD compile path — the Unity
+loop closed: strategies found by flexflow_tpu.search apply to training
+(the reference applies discovered MachineViews the same way,
+model.cc:3337-3446)."""
+
+import numpy as np
+import pytest
+
+import jax
+from jax.sharding import PartitionSpec
+
+from flexflow_tpu import FFConfig, Model
+from flexflow_tpu.fftype import ActiMode, LossType, MetricsType, OpType
+from flexflow_tpu.search import ShardAssignment, graph_optimize
+from flexflow_tpu.training.optimizer import AdamOptimizer, SGDOptimizer
+
+
+def _blobs(n=256, dim=32, classes=4, seed=0):
+    rng = np.random.default_rng(seed)
+    centers = rng.normal(size=(classes, dim)).astype(np.float32) * 3
+    y = rng.integers(0, classes, n).astype(np.int32)
+    return centers[y] + rng.normal(size=(n, dim)).astype(np.float32), y
+
+
+def _mlp(cfg, hidden=64):
+    m = Model(cfg, name=f"tp_{cfg.tensor_parallelism_degree}"
+                        f"_{cfg.data_parallelism_degree}_{hidden}")
+    x = m.create_tensor((cfg.batch_size, 32), name="x")
+    t = m.dense(x, hidden, activation=ActiMode.RELU)
+    t = m.dense(t, hidden, activation=ActiMode.RELU)
+    m.softmax(m.dense(t, 4))
+    return m
+
+
+def test_config_tp_training_converges_and_shards():
+    cfg = FFConfig(batch_size=32, data_parallelism_degree=2,
+                   tensor_parallelism_degree=4, seed=1)
+    m = _mlp(cfg)
+    m.compile(SGDOptimizer(lr=0.05, momentum=0.9),
+              loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+              metrics=[MetricsType.ACCURACY])
+    # kernels really live sharded over the tp axis
+    k = m.params["linear_0"]["kernel"]
+    assert "tp" in k.sharding.spec
+    x, y = _blobs()
+    perf = m.fit([x], y, epochs=10, verbose=False)
+    assert perf.accuracy > 90.0
+
+
+def test_tp_matches_dp_numerics():
+    """Same seed: tp-sharded training must track pure-DP training (GSPMD
+    only changes layout, not math, modulo reduction order)."""
+    x, y = _blobs(128)
+
+    def train(tp):
+        cfg = FFConfig(batch_size=32, data_parallelism_degree=8 // tp,
+                       tensor_parallelism_degree=tp, seed=3)
+        m = _mlp(cfg)
+        m.compile(AdamOptimizer(alpha=1e-2),
+                  loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+                  metrics=[MetricsType.ACCURACY])
+        m.fit([x], y, epochs=3, verbose=False)
+        return np.asarray(m.params["linear_2"]["kernel"])
+
+    np.testing.assert_allclose(train(1), train(4), rtol=2e-3, atol=2e-3)
+
+
+def test_search_strategy_applies_to_training():
+    """graph_optimize output feeds compile(strategy=...) directly."""
+    cfg = FFConfig(batch_size=32, seed=2)
+    m = _mlp(cfg, hidden=128)
+    strategy, cost = graph_optimize(m, num_devices=8, budget=100)
+    # force at least one tp assignment so the application path is exercised
+    if not any(a.tp > 1 for a in strategy.values()):
+        lin = next(l.name for l in m.layers if l.op_type is OpType.LINEAR)
+        strategy[lin] = ShardAssignment(dp=2, tp=4)
+    m2 = _mlp(FFConfig(batch_size=32, seed=2), hidden=128)
+    m2.compile(SGDOptimizer(lr=0.05),
+               loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+               metrics=[MetricsType.ACCURACY], strategy=strategy)
+    assert m2.config.tensor_parallelism_degree > 1
+    x, y = _blobs()
+    m2.fit([x], y, epochs=2, verbose=False)  # trains without error
+
+
+def test_opt_state_inherits_param_sharding():
+    cfg = FFConfig(batch_size=32, data_parallelism_degree=2,
+                   tensor_parallelism_degree=4, seed=1)
+    m = _mlp(cfg)
+    m.compile(AdamOptimizer(alpha=1e-3),
+              loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+              metrics=[MetricsType.ACCURACY])
+    mom = m.opt_state["m"]["linear_0"]["kernel"]
+    assert mom.sharding == m.params["linear_0"]["kernel"].sharding
