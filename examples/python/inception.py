@@ -1,0 +1,111 @@
+"""InceptionV3 training example.
+
+Parity example for the reference's examples/cpp/InceptionV3
+(inception.cc: InceptionA/B/C/D/E modules built from conv2d/pool2d/concat).
+Runs a reduced-resolution variant by default so the synthetic-data demo
+fits a quick run; --full uses the 299x299 geometry of the reference.
+
+Run: python examples/python/inception.py [--full]
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+
+from flexflow_tpu import (FFConfig, LossType, MetricsType, Model,
+                          SGDOptimizer)
+from flexflow_tpu.fftype import ActiMode, PoolType
+
+
+def conv_bn(model, t, out_c, kh, kw, sh=1, sw=1, ph=0, pw=0):
+    t = model.conv2d(t, out_c, kh, kw, sh, sw, ph, pw)
+    return model.batch_norm(t, relu=True)
+
+
+def inception_a(model, t, pool_features):
+    """reference: InceptionA (inception.cc)."""
+    b1 = conv_bn(model, t, 64, 1, 1)
+    b2 = conv_bn(model, t, 48, 1, 1)
+    b2 = conv_bn(model, b2, 64, 5, 5, 1, 1, 2, 2)
+    b3 = conv_bn(model, t, 64, 1, 1)
+    b3 = conv_bn(model, b3, 96, 3, 3, 1, 1, 1, 1)
+    b3 = conv_bn(model, b3, 96, 3, 3, 1, 1, 1, 1)
+    b4 = model.pool2d(t, 3, 3, 1, 1, 1, 1, pool_type=PoolType.AVG)
+    b4 = conv_bn(model, b4, pool_features, 1, 1)
+    return model.concat([b1, b2, b3, b4], axis=1)
+
+
+def inception_b(model, t):
+    b1 = conv_bn(model, t, 384, 3, 3, 2, 2)
+    b2 = conv_bn(model, t, 64, 1, 1)
+    b2 = conv_bn(model, b2, 96, 3, 3, 1, 1, 1, 1)
+    b2 = conv_bn(model, b2, 96, 3, 3, 2, 2)
+    b3 = model.pool2d(t, 3, 3, 2, 2, 0, 0)
+    return model.concat([b1, b2, b3], axis=1)
+
+
+def inception_c(model, t, c7):
+    b1 = conv_bn(model, t, 192, 1, 1)
+    b2 = conv_bn(model, t, c7, 1, 1)
+    b2 = conv_bn(model, b2, c7, 1, 7, 1, 1, 0, 3)
+    b2 = conv_bn(model, b2, 192, 7, 1, 1, 1, 3, 0)
+    b3 = conv_bn(model, t, c7, 1, 1)
+    b3 = conv_bn(model, b3, c7, 7, 1, 1, 1, 3, 0)
+    b3 = conv_bn(model, b3, c7, 1, 7, 1, 1, 0, 3)
+    b3 = conv_bn(model, b3, c7, 7, 1, 1, 1, 3, 0)
+    b3 = conv_bn(model, b3, 192, 1, 7, 1, 1, 0, 3)
+    b4 = model.pool2d(t, 3, 3, 1, 1, 1, 1, pool_type=PoolType.AVG)
+    b4 = conv_bn(model, b4, 192, 1, 1)
+    return model.concat([b1, b2, b3, b4], axis=1)
+
+
+def build(model, x, num_classes=10, full=False):
+    t = conv_bn(model, x, 32, 3, 3, 2, 2)
+    t = conv_bn(model, t, 32, 3, 3)
+    t = conv_bn(model, t, 64, 3, 3, 1, 1, 1, 1)
+    t = model.pool2d(t, 3, 3, 2, 2, 0, 0)
+    t = conv_bn(model, t, 80, 1, 1)
+    t = conv_bn(model, t, 192, 3, 3)
+    t = model.pool2d(t, 3, 3, 2, 2, 0, 0)
+    t = inception_a(model, t, 32)
+    t = inception_a(model, t, 64)
+    t = inception_b(model, t)
+    t = inception_c(model, t, 128)
+    # global average pool -> classifier
+    h = t.spec.shape[2]
+    t = model.pool2d(t, h, h, 1, 1, 0, 0, pool_type=PoolType.AVG)
+    t = model.flat(t)
+    t = model.dense(t, num_classes)
+    return model.softmax(t)
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--batch-size", type=int, default=32)
+    p.add_argument("--epochs", type=int, default=1)
+    p.add_argument("--samples", type=int, default=64)
+    p.add_argument("--full", action="store_true",
+                   help="299x299 inputs like the reference")
+    args = p.parse_args()
+
+    res = 299 if args.full else 75
+    config = FFConfig(batch_size=args.batch_size, epochs=args.epochs)
+    model = Model(config, name="inception_v3")
+    x = model.create_tensor((args.batch_size, 3, res, res))
+    build(model, x)
+    model.compile(SGDOptimizer(lr=0.01, momentum=0.9),
+                  loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+                  metrics=[MetricsType.ACCURACY])
+    rng = np.random.default_rng(0)
+    y = rng.integers(0, 10, args.samples).astype(np.int32)
+    xs = (rng.normal(size=(args.samples, 3, res, res)).astype(np.float32)
+          + y[:, None, None, None] * 0.05)
+    model.fit([xs], y, epochs=args.epochs)
+
+
+if __name__ == "__main__":
+    main()

@@ -564,26 +564,18 @@ class Model:
         layer = next((l for l in self.layers if l.name == layer_name), None)
         if layer is None:
             return PartitionSpec()
+        from ..parallel import tp_specs
+
         t = layer.op_type
         spec = PartitionSpec()
         if t is OpType.LINEAR:
-            if pname == "kernel":
-                spec = PartitionSpec(None, AXIS_MODEL)
-            elif pname == "bias":
-                spec = PartitionSpec(AXIS_MODEL)
+            spec = tp_specs.LINEAR_COL.get(pname, spec)
         elif t is OpType.CONV2D:
-            if pname == "kernel":   # OIHW: shard out-channels
-                spec = PartitionSpec(AXIS_MODEL, None, None, None)
-            elif pname == "bias":
-                spec = PartitionSpec(AXIS_MODEL)
-        elif t is OpType.EMBEDDING and pname == "embedding":
-            spec = PartitionSpec(None, AXIS_MODEL)
+            spec = tp_specs.CONV_SPECS.get(pname, spec)
+        elif t is OpType.EMBEDDING:
+            spec = tp_specs.EMBEDDING_SPECS.get(pname, spec)
         elif t is OpType.MULTIHEAD_ATTENTION:
-            # wq/wk/wv [E, H, D]: shard heads; wo [H, D, E]: shard heads
-            if pname in ("wq", "wk", "wv"):
-                spec = PartitionSpec(None, AXIS_MODEL, None)
-            elif pname == "wo":
-                spec = PartitionSpec(AXIS_MODEL, None, None)
+            spec = tp_specs.ATTN_WEIGHT_SPECS.get(pname, spec)
         # a dim that doesn't divide the tp axis replicates instead of
         # crashing device_put (e.g. a 10-class head under tp=4)
         tp_size = self.mesh.shape[AXIS_MODEL] if AXIS_MODEL in \
@@ -693,23 +685,33 @@ class Model:
         use_tp = strategy is not None and any(
             a.tp > 1 for a in strategy.values())
         if use_tp:
-            tps = {a.tp for a in strategy.values() if a.tp > 1}
-            if len(tps) > 1:
-                import warnings
+            import dataclasses as _dc
+            import warnings
 
+            tps = {a.tp for a in strategy.values() if a.tp > 1}
+            if tp_degree <= 1:
+                # infer the tp axis size from the strategy; work on a
+                # config COPY so a shared/user FFConfig is never mutated
+                tp_degree = max(tps)
+                cfg = _dc.replace(self.config,
+                                  tensor_parallelism_degree=tp_degree)
+                if cfg.data_parallelism_degree <= 1:
+                    # user left dp unset: fill the remaining devices
+                    cfg.data_parallelism_degree = max(
+                        1, cfg.num_devices // tp_degree)
+                self.config = cfg
+            elif max(tps) != tp_degree:
+                warnings.warn(
+                    f"config tensor_parallelism_degree={tp_degree} "
+                    f"overrides the strategy's max tp degree {max(tps)}")
+            if len(tps) > 1:
                 # GSPMD uses ONE global tp axis: per-layer degrees apply
-                # as the boolean tp>1 over the max degree (per-layer
-                # sub-axis sharding is future work); the search's cost for
+                # as the boolean tp>1 over that axis (per-layer sub-axis
+                # sharding is future work); the search's cost for
                 # heterogeneous strategies describes a finer placement
                 warnings.warn(
                     f"strategy has heterogeneous tp degrees {sorted(tps)}; "
-                    f"applying max degree {max(tps)} to every tp>1 layer")
-            if tp_degree <= 1:
-                # infer the tp axis size from the strategy
-                tp_degree = max(tps)
-                self.config.tensor_parallelism_degree = tp_degree
-                self.config.data_parallelism_degree = max(
-                    1, self.config.num_devices // tp_degree)
+                    f"applying degree {tp_degree} to every tp>1 layer")
             self.mesh = self.config.make_mesh([AXIS_DATA, AXIS_MODEL])
         elif self.config.data_parallelism_degree > 1:
             self.mesh = self.config.make_mesh([AXIS_DATA])

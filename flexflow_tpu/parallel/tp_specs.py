@@ -1,0 +1,41 @@
+"""Shared tensor-parallel PartitionSpec tables.
+
+Single source for the per-parameter TP layouts used by BOTH the serving
+pspec builder (serving/inference_manager._param_pspecs) and the training
+strategy application (core/model._train_pspec) — the sharding knowledge
+the reference hard-codes in its insertion rules (model.cc:3243-3296) and
+weight loader (file_loader.cc:209-330).
+"""
+
+from jax.sharding import PartitionSpec
+
+from ..config import AXIS_MODEL
+
+# serving attention params: wq/wk/wv [E, H, D], wo [H, D, E] — heads shard
+ATTN_WEIGHT_SPECS = {
+    "wq": PartitionSpec(None, AXIS_MODEL, None),
+    "wk": PartitionSpec(None, AXIS_MODEL, None),
+    "wv": PartitionSpec(None, AXIS_MODEL, None),
+    "wo": PartitionSpec(AXIS_MODEL, None, None),
+}
+ATTN_BIAS_SPECS = {
+    "bq": PartitionSpec(AXIS_MODEL, None),
+    "bk": PartitionSpec(AXIS_MODEL, None),
+    "bv": PartitionSpec(AXIS_MODEL, None),
+    "bo": PartitionSpec(None),
+}
+
+# linear [in, out] kernels
+LINEAR_COL = {"kernel": PartitionSpec(None, AXIS_MODEL),
+              "bias": PartitionSpec(AXIS_MODEL)}
+LINEAR_ROW = {"kernel": PartitionSpec(AXIS_MODEL, None),
+              "bias": PartitionSpec(None)}
+LINEAR_REPLICATED = {"kernel": PartitionSpec(None, None),
+                     "bias": PartitionSpec(None)}
+
+# conv OIHW: shard out-channels
+CONV_SPECS = {"kernel": PartitionSpec(AXIS_MODEL, None, None, None),
+              "bias": PartitionSpec(AXIS_MODEL)}
+
+# embedding [vocab, features]: shard features
+EMBEDDING_SPECS = {"embedding": PartitionSpec(None, AXIS_MODEL)}

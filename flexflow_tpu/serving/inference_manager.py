@@ -53,29 +53,20 @@ def _param_pspecs(model) -> Dict[str, Dict[str, PartitionSpec]]:
     for layer in model.layers:
         if not layer.param_specs:
             continue
+        from ..parallel import tp_specs
+
         lspec = {}
         if layer.op_type in SERVING_ATTENTION_OPS:
             for ps in layer.param_specs:
-                if ps.name in ("wq", "wk", "wv"):
-                    lspec[ps.name] = PartitionSpec(None, AXIS_MODEL, None)
-                elif ps.name == "wo":
-                    lspec[ps.name] = PartitionSpec(AXIS_MODEL, None, None)
-                elif ps.name in ("bq", "bk", "bv"):
-                    lspec[ps.name] = PartitionSpec(AXIS_MODEL, None)
-                else:  # bo
-                    lspec[ps.name] = PartitionSpec(None)
+                lspec[ps.name] = (tp_specs.ATTN_WEIGHT_SPECS.get(ps.name)
+                                  or tp_specs.ATTN_BIAS_SPECS[ps.name])
         elif layer.op_type is OpType.LINEAR:
             shard = layer.attrs.get("shard", "replicate")
+            table = {"col": tp_specs.LINEAR_COL,
+                     "row": tp_specs.LINEAR_ROW,
+                     "replicate": tp_specs.LINEAR_REPLICATED}[shard]
             for ps in layer.param_specs:
-                if ps.name == "kernel":
-                    lspec[ps.name] = {
-                        "col": PartitionSpec(None, AXIS_MODEL),
-                        "row": PartitionSpec(AXIS_MODEL, None),
-                        "replicate": PartitionSpec(None, None),
-                    }[shard]
-                else:  # bias — sharded only under col parallelism
-                    lspec[ps.name] = (PartitionSpec(AXIS_MODEL)
-                                      if shard == "col" else PartitionSpec(None))
+                lspec[ps.name] = table[ps.name]
         else:
             for ps in layer.param_specs:
                 lspec[ps.name] = PartitionSpec(*([None] * len(ps.shape)))

@@ -42,7 +42,7 @@ def maybe_recompile(state: Optional[RecompileState], model) -> bool:
     old_params = model.params
     state.alter()
     model.compile(model.optimizer, loss_type=model.loss_type,
-                  metrics=model.metrics)
+                  metrics=model.metrics, strategy=model.strategy)
     # carry learned weights over where layer names + shapes still agree
     for lname, lp in (old_params or {}).items():
         if lname in model.params:

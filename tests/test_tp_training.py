@@ -83,6 +83,25 @@ def test_search_strategy_applies_to_training():
     m2.fit([x], y, epochs=2, verbose=False)  # trains without error
 
 
+def test_strategy_does_not_mutate_user_config():
+    """Inferring tp from a strategy must not clobber a shared FFConfig or
+    an explicitly-set dp degree (regression)."""
+    cfg = FFConfig(batch_size=32, data_parallelism_degree=2, seed=0)
+    m = _mlp(cfg)
+    strategy = {l.name: ShardAssignment(dp=2, tp=2) for l in m.layers}
+    m.compile(SGDOptimizer(lr=0.05),
+              loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+              metrics=[MetricsType.ACCURACY], strategy=strategy)
+    # the user's config object is untouched...
+    assert cfg.tensor_parallelism_degree == 1
+    assert cfg.data_parallelism_degree == 2
+    # ...and the model kept the explicit dp degree
+    assert m.config.data_parallelism_degree == 2
+    assert m.config.tensor_parallelism_degree == 2
+    x, y = _blobs()
+    m.fit([x], y, epochs=1, verbose=False)
+
+
 def test_opt_state_inherits_param_sharding():
     cfg = FFConfig(batch_size=32, data_parallelism_degree=2,
                    tensor_parallelism_degree=4, seed=1)

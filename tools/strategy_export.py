@@ -1,0 +1,98 @@
+"""Strategy tooling CLI.
+
+Twin of the reference's strategy/substitution tooling
+(tools/substitutions_to_dot, `--export-strategy` dot/json dumps,
+config.h:160-163): run the auto-parallelization search on a model spec and
+dump the strategy as json and/or dot.
+
+Usage:
+  python tools/strategy_export.py --model mlp --num-devices 8 \
+      --dot strategy.dot --json strategy.json [--mcmc] [--memory-limit N]
+
+Model specs: mlp (dims via --dims), llama (sizes via --hidden etc.)
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir))
+
+from flexflow_tpu import FFConfig, Model
+from flexflow_tpu.fftype import ActiMode
+from flexflow_tpu.search import (PCG, SimpleMachineModel,
+                                 export_strategy_dot, graph_optimize,
+                                 strategy_to_json)
+
+
+def build_mlp(dims, batch):
+    m = Model(FFConfig(batch_size=batch), name="tool_mlp")
+    x = m.create_tensor((batch, dims[0]), name="x")
+    t = x
+    for d in dims[1:-1]:
+        t = m.dense(t, d, activation=ActiMode.RELU)
+    m.softmax(m.dense(t, dims[-1]))
+    return m
+
+
+def build_llama(hidden, layers, batch, seq):
+    m = Model(FFConfig(batch_size=batch), name="tool_llama")
+    x = m.create_tensor((batch, seq, hidden), name="x")
+    t = x
+    for i in range(layers):
+        a = m.multihead_attention(t, t, t, hidden, max(1, hidden // 128),
+                                  name=f"attn_{i}")
+        t = m.add(a, t, name=f"res1_{i}")
+        h = m.dense(t, 4 * hidden, activation=ActiMode.GELU,
+                    name=f"ffn1_{i}")
+        h = m.dense(h, hidden, name=f"ffn2_{i}")
+        t = m.add(h, t, name=f"res2_{i}")
+    m.dense(t, 32000, name="lm_head")
+    return m
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--model", choices=["mlp", "llama"], default="mlp")
+    p.add_argument("--dims", type=int, nargs="+",
+                   default=[784, 4096, 4096, 10])
+    p.add_argument("--hidden", type=int, default=2048)
+    p.add_argument("--layers", type=int, default=4)
+    p.add_argument("--batch-size", type=int, default=64)
+    p.add_argument("--seq-len", type=int, default=512)
+    p.add_argument("--num-devices", type=int, default=8)
+    p.add_argument("--budget", type=int, default=500)
+    p.add_argument("--alpha", type=float, default=1.05)
+    p.add_argument("--memory-limit", type=int, default=None)
+    p.add_argument("--mcmc", action="store_true")
+    p.add_argument("--only-data-parallel", action="store_true")
+    p.add_argument("--dot", default="")
+    p.add_argument("--json", default="")
+    args = p.parse_args()
+
+    if args.model == "mlp":
+        m = build_mlp(args.dims, args.batch_size)
+    else:
+        m = build_llama(args.hidden, args.layers, args.batch_size,
+                        args.seq_len)
+    machine = SimpleMachineModel(args.num_devices)
+    strategy, cost = graph_optimize(
+        m, machine=machine, budget=args.budget, alpha=args.alpha,
+        memory_limit=args.memory_limit, use_mcmc=args.mcmc,
+        only_data_parallel=args.only_data_parallel)
+    print(f"modeled step: {cost.total_time*1e3:.3f} ms  "
+          f"memory/device: {cost.memory/2**20:.1f} MiB")
+    for name, a in strategy.items():
+        print(f"  {name:<28} dp={a.dp} tp={a.tp} pp={a.pp_stage}")
+    if args.json:
+        with open(args.json, "w") as f:
+            f.write(strategy_to_json(strategy))
+        print("wrote", args.json)
+    if args.dot:
+        with open(args.dot, "w") as f:
+            f.write(export_strategy_dot(PCG(m), strategy))
+        print("wrote", args.dot)
+
+
+if __name__ == "__main__":
+    main()
