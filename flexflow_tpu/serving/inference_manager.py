@@ -49,12 +49,12 @@ def _param_pspecs(model) -> Dict[str, Dict[str, PartitionSpec]]:
     Linear layers carry a ``shard`` attr ("col" | "row" | "replicate") set
     by the model builders; everything else is replicated.
     """
+    from ..parallel import tp_specs
+
     specs: Dict[str, Dict[str, PartitionSpec]] = {}
     for layer in model.layers:
         if not layer.param_specs:
             continue
-        from ..parallel import tp_specs
-
         lspec = {}
         if layer.op_type in SERVING_ATTENTION_OPS:
             for ps in layer.param_specs:
@@ -345,7 +345,8 @@ class InferenceManager:
         return outs
 
     def decode_block(self, model_id: int, bc: BatchConfig, k: int,
-                     rng=None, init_tokens=None) -> Any:
+                     rng=None, init_tokens=None,
+                     min_remaining: Optional[int] = None) -> Any:
         """Run ``k`` fused decode steps (chunk must be 1); returns the
         sampled token ids as a [k, R] device array — ONE host sync for k
         tokens.  The KV scatter stays in bounds because rows are retired by
@@ -356,14 +357,23 @@ class InferenceManager:
         prefill step's samples) — the prefill→decode handoff.  The host
         never sees them before the block runs (no tunnel round trip); the
         returned array is then [k+1, R] with the init tokens first.
+
+        ``min_remaining``: the smallest per-row remaining token budget in
+        the batch.  A row retired mid-block keeps scattering at advancing
+        depths, so safety requires k <= min_remaining + slack; with the
+        bound supplied, blocks may exceed the cache slack (one host sync
+        per hundreds of tokens on long generations) — without it the
+        conservative slack clamp applies.
         """
         record = self.models[model_id]
         assert bc.chunk == 1, "decode_block requires a pure-decode batch"
         slack = record["prefill_chunk"]
-        if k > slack:
-            # clamp to the largest pow2 within the compiled cache slack —
-            # rows at max_seq_length must not scatter out of bounds
-            k = 1 << (slack.bit_length() - 1)
+        safe = (min_remaining + slack if min_remaining is not None
+                else slack)
+        if k > safe:
+            # largest pow2 within the safe bound — rows must not scatter
+            # past max_seq_length + slack
+            k = 1 << (max(1, safe).bit_length() - 1)
         batch = {name: jnp.asarray(v) for name, v in bc.pack().items()}
         if rng is None:
             rng = jax.random.PRNGKey(0)

@@ -308,7 +308,9 @@ class RequestManager:
                 # largest remaining span bounds useful block length
                 k = pick_chunk(max(1, self._max_remaining_budget()),
                                decode_block)
-                toks = np.asarray(im.decode_block(model_id, bc, k, step_rng))
+                toks = np.asarray(im.decode_block(
+                    model_id, bc, k, step_rng,
+                    min_remaining=self._min_remaining_budget()))
                 self._fold_decode_block(bc, toks)
                 bc, result = None, None
                 continue
@@ -345,6 +347,10 @@ class RequestManager:
         return max(r.remaining_budget(self.max_sequence_length)
                    for r in self.running.values())
 
+    def _min_remaining_budget(self) -> int:
+        return min(r.remaining_budget(self.max_sequence_length)
+                   for r in self.running.values())
+
     def _handoff_decode_block(self, im: InferenceManager, model_id: int,
                               bc: BatchConfig, outs, decode_block: int,
                               block_rng) -> None:
@@ -363,8 +369,9 @@ class RequestManager:
         # init consumes one budget slot, the k scan steps the rest
         k = pick_chunk(max(1, self._max_remaining_budget() - 1),
                        decode_block)
-        toks = np.asarray(im.decode_block(model_id, bc2, k, block_rng,
-                                          init_tokens=init))
+        toks = np.asarray(im.decode_block(
+            model_id, bc2, k, block_rng, init_tokens=init,
+            min_remaining=max(1, self._min_remaining_budget() - 1)))
         self._fold_decode_block(bc2, toks, handoff=True)
 
     def generate(self, im: InferenceManager, model_id: int,

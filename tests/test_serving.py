@@ -159,3 +159,47 @@ class TestTokenizers:
         res = rm.generate(im, mid, ["ab"], max_new_tokens=5)
         assert len(res) == 1 and len(res[0].output_tokens) == 5
         assert res[0].input_tokens[0] == 1  # BOS prepended
+
+
+class TestLongBlocks:
+    """Decode blocks beyond the cache slack: safe when k <= min-remaining
+    + slack (rows retired mid-block keep scattering at advancing depths),
+    cutting host syncs to ~1 per generation wave on long outputs."""
+
+    def _generate(self, hf, prompts, n_new, prefill_chunk, decode_block,
+                  max_new_list=None):
+        model, _ = _build_ff_llama(hf, max_requests=4)
+        im = InferenceManager(model.config)
+        mid = im.compile_model_and_allocate_buffer(
+            model, max_requests=4, max_seq_length=256,
+            prefill_chunk=prefill_chunk, cache_dtype=np.float32)
+        rm = RequestManager(max_requests_per_batch=4,
+                            max_tokens_per_batch=8,
+                            max_sequence_length=256,
+                            decode_block=decode_block)
+        maxes = max_new_list or [n_new] * len(prompts)
+        reqs = [rm.register_new_request(list(p), max_new_tokens=mn)
+                for p, mn in zip(prompts, maxes)]
+        rm.generate_incr_decoding(im, mid, reqs)
+        return [r.tokens[r.prompt_len:] for r in reqs]
+
+    def test_block_beyond_slack_token_match(self):
+        """k=32 with slack=8 must produce exactly the per-step tokens."""
+        hf, _ = _hf_tiny_llama(seed=11)
+        prompts = [[1, 5, 9], [2, 8, 99, 100]]
+        want = [_hf_greedy(hf, p, 40) for p in prompts]
+        got = self._generate(hf, prompts, 40, prefill_chunk=8,
+                             decode_block=64)
+        for w, g in zip(want, got):
+            assert g == w, (g, w)
+
+    def test_mixed_budgets_stay_in_bounds(self):
+        """One nearly-done row must clamp the block (min_remaining bound)
+        without corrupting the long row's output."""
+        hf, _ = _hf_tiny_llama(seed=12)
+        prompts = [[1, 5, 9], [2, 8, 99]]
+        want_long = _hf_greedy(hf, prompts[0], 40)
+        got = self._generate(hf, prompts, 40, prefill_chunk=8,
+                             decode_block=64, max_new_list=[40, 3])
+        assert got[0] == want_long
+        assert len(got[1]) == 3
