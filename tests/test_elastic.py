@@ -1,0 +1,102 @@
+"""Elastic / fault-tolerant training tests (a capability the reference
+lacks entirely, SURVEY.md §5 — the rebuild's contract: injected failures
+lose at most `checkpoint_every` epochs of work and training converges)."""
+
+import numpy as np
+import pytest
+
+from flexflow_tpu import FFConfig, Model
+from flexflow_tpu.fftype import ActiMode, LossType, MetricsType
+from flexflow_tpu.training.elastic import (ElasticTrainer, FaultInjector,
+                                           TrainingFault)
+from flexflow_tpu.training.optimizer import SGDOptimizer
+
+
+def _build():
+    m = Model(FFConfig(batch_size=32, seed=5), name="elastic")
+    x = m.create_tensor((32, 16), name="x")
+    t = m.dense(x, 32, activation=ActiMode.RELU)
+    m.softmax(m.dense(t, 4))
+    return m
+
+
+def _compile_kwargs():
+    return dict(optimizer=SGDOptimizer(lr=0.1),
+                loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+                metrics=[MetricsType.ACCURACY])
+
+
+def _data(n=256):
+    rng = np.random.default_rng(0)
+    centers = rng.normal(size=(4, 16)).astype(np.float32) * 3
+    y = rng.integers(0, 4, n).astype(np.int32)
+    return [centers[y] + rng.normal(size=(n, 16)).astype(np.float32)], y
+
+
+def test_recovers_from_injected_faults(tmp_path):
+    x, y = _data()
+    inj = FaultInjector(fail_at_epochs=(2, 5))
+    trainer = ElasticTrainer(_build, str(tmp_path / "ck"),
+                             compile_kwargs=_compile_kwargs(),
+                             checkpoint_every=1, fault_injector=inj)
+    model = trainer.fit(x, y, epochs=8)
+    kinds = [e["kind"] for e in trainer.events]
+    assert kinds.count("failure") == 2
+    assert kinds.count("recovered") == 2
+    assert trainer.restarts == 2
+    perf = model.eval(x, y)
+    assert perf.accuracy > 90.0
+
+
+def test_gives_up_after_consecutive_failures(tmp_path):
+    x, y = _data(64)
+    inj = FaultInjector(failure_prob=1.0)   # always fails
+    trainer = ElasticTrainer(_build, str(tmp_path / "ck2"),
+                             compile_kwargs=_compile_kwargs(),
+                             max_restarts=2, fault_injector=inj)
+    with pytest.raises(RuntimeError, match="giving up"):
+        trainer.fit(x, y, epochs=4)
+
+
+def test_restart_budget_resets_on_progress(tmp_path):
+    """4 transient faults spread across a run recover fine with
+    max_restarts=2 because checkpoints land between them (regression:
+    lifetime-global budget aborted such runs)."""
+    x, y = _data()
+    inj = FaultInjector(fail_at_epochs=(1, 3, 5, 7))
+    trainer = ElasticTrainer(_build, str(tmp_path / "ck4"),
+                             compile_kwargs=_compile_kwargs(),
+                             max_restarts=2, fault_injector=inj)
+    trainer.fit(x, y, epochs=9)
+    assert trainer.restarts == 4   # all recovered, none fatal
+
+
+def test_plain_bugs_are_not_retried(tmp_path):
+    """A programming error (bare RuntimeError) must surface immediately,
+    not be retried as a device fault."""
+    x, y = _data(64)
+
+    class Exploding(ElasticTrainer):
+        def _fresh_model(self):
+            raise KeyError("user bug")   # not a device fault
+
+    trainer = Exploding(_build, str(tmp_path / "ck5"),
+                        compile_kwargs=_compile_kwargs())
+    with pytest.raises(KeyError):
+        trainer.fit(x, y, epochs=2)
+    assert not any(e["kind"] == "failure" for e in trainer.events)
+
+
+def test_process_restart_resumes_from_checkpoint(tmp_path):
+    """A brand-new trainer in a 'new process' picks up where the old one
+    checkpointed."""
+    x, y = _data()
+    t1 = ElasticTrainer(_build, str(tmp_path / "ck3"),
+                        compile_kwargs=_compile_kwargs())
+    t1.fit(x, y, epochs=3)
+
+    t2 = ElasticTrainer(_build, str(tmp_path / "ck3"),
+                        compile_kwargs=_compile_kwargs())
+    t2.fit(x, y, epochs=5)
+    assert t2.events[0]["kind"] == "resumed"
+    assert t2.events[0]["epoch"] == 3  # continued, not restarted
