@@ -57,6 +57,40 @@ def test_llm_generate_matches_hf(tiny_llama_dir, cache_path):
     assert got == want[: len(got)] and len(got) >= 1
 
 
+def test_sampling_generation(tiny_llama_dir, cache_path):
+    """do_sample=True end-to-end: different seeds diverge, near-zero
+    temperature reproduces greedy (reference GenerationConfig semantics)."""
+    model_dir, hf = tiny_llama_dir
+    llm = ff.LLM(model_dir, data_type=DataType.FLOAT, cache_path=cache_path)
+    llm.compile(ff.GenerationConfig(do_sample=True, temperature=0.9,
+                                    topp=0.9),
+                max_requests_per_batch=2, max_seq_length=64,
+                max_tokens_per_batch=32, cache_dtype=np.float32)
+    prompt = [1, 17, 3, 99]
+    a = [int(t) for t in llm.generate([prompt], max_new_tokens=12,
+                                      seed=0)[0].output_tokens]
+    b = [int(t) for t in llm.generate([prompt], max_new_tokens=12,
+                                      seed=1)[0].output_tokens]
+    assert all(0 <= t < 256 for t in a + b)
+    assert a != b, "different sampling seeds must diverge"
+
+    llm2 = ff.LLM(model_dir, data_type=DataType.FLOAT,
+                  cache_path=cache_path)
+    llm2.compile(ff.GenerationConfig(do_sample=True, temperature=1e-6,
+                                     topp=1e-6),
+                 max_requests_per_batch=2, max_seq_length=64,
+                 max_tokens_per_batch=32, cache_dtype=np.float32)
+    cold = [int(t) for t in llm2.generate([prompt], max_new_tokens=8)[0]
+            .output_tokens]
+    import torch
+    ids = torch.tensor([prompt])
+    with torch.no_grad():
+        want = hf.generate(ids, max_new_tokens=8, do_sample=False,
+                           eos_token_id=None,
+                           pad_token_id=0)[0, len(prompt):].tolist()
+    assert cold == want[: len(cold)]
+
+
 def test_weight_cache_revision(tiny_llama_dir, cache_path):
     model_dir, _ = tiny_llama_dir
     llm = ff.LLM(model_dir, data_type=DataType.FLOAT, cache_path=cache_path)
