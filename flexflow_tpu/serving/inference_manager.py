@@ -39,6 +39,16 @@ SERVING_ATTENTION_OPS = (
 )
 
 
+def _device_put_preserving(v, mesh, spec):
+    """device_put that keeps a pinned_host-resident weight's memory kind
+    through resharding (the --offload contract)."""
+    kind = getattr(getattr(v, "sharding", None), "memory_kind", None)
+    if kind and kind != "device":
+        return jax.device_put(v, NamedSharding(mesh, spec,
+                                               memory_kind=kind))
+    return jax.device_put(v, NamedSharding(mesh, spec))
+
+
 def _param_pspecs(model) -> Dict[str, Dict[str, PartitionSpec]]:
     """Per-parameter PartitionSpecs from layer TP annotations.
 
@@ -92,38 +102,36 @@ class InferenceManager:
         """Returns a model_id handle.  reference: inference_manager.cc:81."""
         cfg = model.config
         tp = cfg.tensor_parallelism_degree
+        pp = cfg.pipeline_parallelism_degree
+        # shared prelude (both execution modes)
+        rows = max_requests * beam_width
+        cache_dtype = cache_dtype or jnp.dtype(cfg.computation_dtype)
+        # slack tail: a mixed decode/prefill batch scatters a full chunk at
+        # each row's depth; rows near max_seq_length would otherwise have
+        # the scatter clamped back over committed entries
+        # (dynamic_update_slice clamps at the edge).  Slack positions are
+        # never attended — the mask stops at each row's current depth.
+        alloc_len = max_seq_length + prefill_chunk + 1
+        if model.params is None:
+            model.params = model.init_params(jax.random.PRNGKey(cfg.seed))
+
+        if pp > 1:
+            return self._compile_pipeline_model(
+                model, mode, max_requests, max_seq_length, prefill_chunk,
+                beam_width, cache_dtype, model_id, rows, alloc_len)
         if self.mesh is None and tp > 1:
             self.mesh = cfg.make_mesh([AXIS_MODEL])
         mesh = self.mesh if tp > 1 else None
         model.mesh = mesh
 
-        rows = max_requests * beam_width
-        # nominal graph-build sanity: model builders created tokens [R, C]
-        cache_dtype = cache_dtype or jnp.dtype(cfg.computation_dtype)
-
-        # parameters: init if absent, then shard
-        if model.params is None:
-            rng = jax.random.PRNGKey(cfg.seed)
-            model.params = model.init_params(rng)
         pspecs = _param_pspecs(model)
         if mesh is not None:
             from ..quantization import extend_quantized_pspecs
 
             pspecs = extend_quantized_pspecs(pspecs, model.params)
-
-            def _put(v, spec):
-                # preserve host offload: a pinned_host-resident weight keeps
-                # its memory kind through the TP resharding
-                kind = getattr(getattr(v, "sharding", None), "memory_kind",
-                               None)
-                if kind and kind != "device":
-                    sh = NamedSharding(mesh, spec, memory_kind=kind)
-                else:
-                    sh = NamedSharding(mesh, spec)
-                return jax.device_put(v, sh)
-
             model.params = {
-                ln: {pn: _put(v, pspecs[ln][pn]) for pn, v in lp.items()}
+                ln: {pn: _device_put_preserving(v, mesh, pspecs[ln][pn])
+                     for pn, v in lp.items()}
                 for ln, lp in model.params.items()}
 
         # KV caches per serving-attention layer (reference: allocated in
@@ -131,12 +139,6 @@ class InferenceManager:
         caches = {}
         cache_sharding = (NamedSharding(mesh, PartitionSpec(None, None, AXIS_MODEL, None))
                           if mesh is not None else None)
-        # slack tail: a mixed decode/prefill batch scatters a full chunk at
-        # each row's depth; rows near max_seq_length would otherwise have
-        # the scatter clamped back over committed entries
-        # (dynamic_update_slice clamps at the edge).  Slack positions are
-        # never attended — the mask stops at each row's current depth.
-        alloc_len = max_seq_length + prefill_chunk + 1
         for layer in model.layers:
             if layer.op_type in SERVING_ATTENTION_OPS:
                 a = layer.attrs
@@ -157,6 +159,30 @@ class InferenceManager:
                       prefill_chunk=prefill_chunk, steps={}, pspecs=pspecs)
         self.models[mid] = record
         return mid
+
+    def _compile_pipeline_model(self, model, mode, max_requests,
+                                max_seq_length, prefill_chunk, beam_width,
+                                cache_dtype, model_id, rows, alloc_len):
+        """Pipeline-parallel serving compile (reference per-stage
+        MachineViews, inference_manager.cc:91-133): weights + caches land
+        on disjoint per-stage device subsets (see pipeline_serving.py)."""
+        from .pipeline_serving import compile_pipeline
+
+        cfg = model.config
+        record = dict(model=model, mode=mode, mesh=None, caches={},
+                      max_requests=max_requests, rows=rows,
+                      max_seq_length=max_seq_length, beam_width=beam_width,
+                      prefill_chunk=prefill_chunk, steps={}, pspecs=None)
+        compile_pipeline(self, record, model, cfg, cache_dtype, rows,
+                         alloc_len)
+        mid = model_id if model_id is not None else len(self.models)
+        self.models[mid] = record
+        return mid
+
+    def supports_decode_block(self, model_id: int) -> bool:
+        """Decode blocks fuse all layers into one program — incompatible
+        with stage-partitioned (pp) execution, which runs per-step."""
+        return "pp_stages" not in self.models[model_id]
 
     # --------------------------------------------------------------- step
     def _raw_step(self, record, reorder: bool):
@@ -339,6 +365,11 @@ class InferenceManager:
             batch["parent_rows"] = jnp.asarray(parent_rows)
         if rng is None:
             rng = jax.random.PRNGKey(0)
+        if "pp_stages" in record:
+            from .pipeline_serving import pipeline_inference
+
+            assert not reorder, "beam reorder under pp serving: unsupported"
+            return pipeline_inference(self, record, model_id, batch, rng)
         step = self._get_step(record, bc.chunk, reorder)
         outs, record["caches"] = step(record["model"].params,
                                       record["caches"], batch, rng)

@@ -304,7 +304,8 @@ class RequestManager:
             if bc is None:
                 break
             rng, step_rng = jax.random.split(rng)
-            if bc.chunk == 1 and decode_block > 1:
+            if (bc.chunk == 1 and decode_block > 1
+                    and im.supports_decode_block(model_id)):
                 # largest remaining span bounds useful block length
                 k = pick_chunk(max(1, self._max_remaining_budget()),
                                decode_block)
@@ -321,7 +322,8 @@ class RequestManager:
             # samples as init tokens — the sync that would download them
             # costs a full host↔device round trip (fatal over a tunneled
             # chip, still the dominant non-compute cost on PCIe)
-            if (decode_block > 1 and not self.pending
+            if (decode_block > 1 and im.supports_decode_block(model_id)
+                    and not self.pending
                     and self._prefill_completes_all(bc)):
                 rng, block_rng = jax.random.split(rng)
                 self._handoff_decode_block(im, model_id, bc, outs,
