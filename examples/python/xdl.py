@@ -1,0 +1,60 @@
+"""XDL training example.
+
+Parity example for the reference's examples/cpp/XDL (xdl.cc: an
+embedding-heavy click-through model — N sparse embedding lookups summed
+with a dense MLP tower, sigmoid CTR head).
+
+Run: python examples/python/xdl.py
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+
+from flexflow_tpu import (AdamOptimizer, FFConfig, LossType, MetricsType,
+                          Model)
+from flexflow_tpu.fftype import ActiMode, AggrMode, DataType
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--batch-size", type=int, default=64)
+    p.add_argument("--epochs", type=int, default=2)
+    p.add_argument("--num-sparse", type=int, default=8)
+    p.add_argument("--vocab", type=int, default=2000)
+    p.add_argument("--embedding-size", type=int, default=16)
+    args = p.parse_args()
+
+    config = FFConfig(batch_size=args.batch_size, epochs=args.epochs)
+    model = Model(config, name="xdl")
+    sparse = [model.create_tensor((args.batch_size, 1), DataType.INT32,
+                                  name=f"sparse_{i}")
+              for i in range(args.num_sparse)]
+    dense_in = model.create_tensor((args.batch_size, 16), name="dense")
+    embs = [model.embedding(s, args.vocab, args.embedding_size,
+                            aggr=AggrMode.SUM, name=f"emb_{i}")
+            for i, s in enumerate(sparse)]
+    t = model.concat(embs + [dense_in], axis=1)
+    t = model.dense(t, 128, activation=ActiMode.RELU)
+    t = model.dense(t, 64, activation=ActiMode.RELU)
+    t = model.dense(t, 2)
+    model.softmax(t)
+    model.compile(AdamOptimizer(alpha=1e-3),
+                  loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+                  metrics=[MetricsType.ACCURACY])
+
+    rng = np.random.default_rng(0)
+    n = 1024
+    xs = [rng.integers(0, args.vocab, (n, 1)).astype(np.int32)
+          for _ in range(args.num_sparse)]
+    xd = rng.normal(size=(n, 16)).astype(np.float32)
+    y = ((xs[0][:, 0] % 3 == 0) ^ (xd[:, 0] > 0)).astype(np.int32)
+    model.fit(xs + [xd], y, epochs=args.epochs)
+
+
+if __name__ == "__main__":
+    main()

@@ -623,14 +623,19 @@ class Model:
         return out
 
     def run_layers(self, params, input_values: Dict[str, Any],
-                   ctx: OpContext, inference: bool = False) -> Dict[Tuple, Any]:
+                   ctx: OpContext, inference: bool = False,
+                   layers=None, seed_vals=None) -> Dict[Tuple, Any]:
         """Walk the layer graph (the jit-traced analogue of the reference's
-        per-op forward task launches, model.cc:2784)."""
-        vals: Dict[Tuple, Any] = {}
+        per-op forward task launches, model.cc:2784).
+
+        ``layers``/``seed_vals`` support partial walks (pipeline-parallel
+        serving stages): only the given layers run, with ``seed_vals``
+        carrying tensors produced by earlier stages."""
+        vals: Dict[Tuple, Any] = dict(seed_vals or {})
         for t in self.input_tensors:
             if t.name in input_values:
                 vals[("__input__", t.name)] = input_values[t.name]
-        for layer in self.layers:
+        for layer in (self.layers if layers is None else layers):
             ins = [vals[_tensor_key(t)] for t in layer.inputs]
             op = get_op(layer.op_type)
             lparams = params.get(layer.name, {})

@@ -75,8 +75,12 @@ def stage_boundaries(model, stages) -> List[List[Tuple]]:
 
 
 def build_stage_meshes(config, pp: int, tp: int) -> List[Mesh]:
+    config.validate()   # informative dp x tp x pp > num_devices error
     devs = list(config.devices)
-    assert len(devs) >= pp * tp, (len(devs), pp, tp)
+    if len(devs) < pp * tp:
+        raise ValueError(
+            f"pipeline serving needs pp({pp}) x tp({tp}) = {pp * tp} "
+            f"devices, have {len(devs)}")
     meshes = []
     for s in range(pp):
         block = np.array(devs[s * tp:(s + 1) * tp])
@@ -112,21 +116,19 @@ def make_stage_step(record, stage_idx: int):
                         kv_cache=caches, kv_cache_out={},
                         mesh=record["pp_meshes"][stage_idx],
                         extra_outputs={})
-        vals: Dict[Tuple, Any] = dict(boundary)
+        feeds = {}
         C = batch["token_ids"].shape[1]
         for name in input_names:
             if name == "tokens":
-                vals[("__input__", name)] = batch["token_ids"]
+                feeds[name] = batch["token_ids"]
             elif name == "positions":
-                vals[("__input__", name)] = (batch["first_depth"][:, None]
-                                             + jnp.arange(C)[None, :])
-        for layer in layers:
-            ins = [vals[_tensor_key(t)] for t in layer.inputs]
-            op = get_op(layer.op_type)
-            outs = op.inference(params.get(layer.name, {}), ins,
-                                layer.attrs, ctx)
-            for i, o in enumerate(outs):
-                vals[(layer.name, i)] = o
+                feeds[name] = (batch["first_depth"][:, None]
+                               + jnp.arange(C)[None, :])
+            else:
+                raise ValueError(f"unknown serving input {name!r}")
+        # the shared layer-graph executor, restricted to this stage
+        vals = model.run_layers(params, feeds, ctx, inference=True,
+                                layers=layers, seed_vals=boundary)
         new_caches = {**caches, **ctx.kv_cache_out}
         if last_stage:
             final = model.layers[-1]

@@ -28,6 +28,10 @@ def _run(script, *args, timeout=600):
                  "--embedding-size", "8", "--vocab", "50"]),
     ("mixture_of_experts.py", ["--batch-size", "32", "--epochs", "1",
                                "--num-experts", "4"]),
+    ("xdl.py", ["--batch-size", "32", "--epochs", "1", "--vocab", "100",
+                "--num-sparse", "3"]),
+    ("candle_uno.py", ["--batch-size", "32", "--epochs", "1"]),
+    ("mlp_unify.py", ["--batch-size", "32", "--epochs", "1"]),
 ])
 def test_example_runs(script, args):
     r = _run(script, *args)

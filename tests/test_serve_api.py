@@ -183,6 +183,30 @@ def test_spec_infer_entry_matches_incr(tiny_llama_dir, cache_path, tmp_path):
             == [int(t) for t in incr[0].output_tokens])
 
 
+def test_serve_api_pipeline_parallel(tiny_llama_dir, cache_path):
+    """ff.init(pipeline_parallelism_degree=2) flows through LLM.compile
+    into stage-partitioned serving."""
+    model_dir, hf = tiny_llama_dir
+    try:
+        ff.init(pipeline_parallelism_degree=2)
+        llm = ff.LLM(model_dir, data_type=DataType.FLOAT,
+                     cache_path=cache_path)
+        llm.compile(max_requests_per_batch=2, max_seq_length=64,
+                    max_tokens_per_batch=16, cache_dtype=np.float32)
+        assert "pp_stages" in llm.im.models[llm.model_id]
+        prompt = [1, 17, 3, 99]
+        got = [int(t) for t in llm.generate([prompt], max_new_tokens=6)[0]
+               .output_tokens]
+        import torch
+        with torch.no_grad():
+            want = hf.generate(torch.tensor([prompt]), max_new_tokens=6,
+                               do_sample=False, eos_token_id=None,
+                               pad_token_id=0)[0, len(prompt):].tolist()
+        assert got == want[: len(got)]
+    finally:
+        ff.init()  # reset the global config for subsequent tests
+
+
 def test_cli_incr_decoding(tiny_llama_dir, cache_path, tmp_path, monkeypatch):
     model_dir, _ = tiny_llama_dir
     import sys
