@@ -144,6 +144,52 @@ class TestPipelineServing:
         rm.generate_incr_decoding(im, mid, [req])
         assert len(req.tokens) == 2 + 3
 
+    def test_spec_infer_with_pp_llm(self):
+        """Tree-verify speculation where the LLM itself is
+        pipeline-parallel: output stays token-identical to single-device
+        incremental decoding (the reference CI's token-match gate applied
+        across the parallelism matrix)."""
+        from flexflow_tpu.serving.spec_infer import generate_spec_infer
+
+        hf = _hf()
+        torch.manual_seed(1)
+        ssm_hf = transformers.LlamaForCausalLM(transformers.LlamaConfig(
+            vocab_size=128, hidden_size=32, intermediate_size=64,
+            num_hidden_layers=1, num_attention_heads=2,
+            num_key_value_heads=2, max_position_embeddings=256,
+            tie_word_embeddings=False)).eval()
+        prompts = [[1, 5, 9, 42], [2, 8, 99]]
+        want, *_ = _generate(hf, 1, 1, prompts, 12)
+
+        llm_cfg = LLAMAConfig.from_hf(hf.config)
+        ssm_cfg = LLAMAConfig.from_hf(ssm_hf.config)
+        ffcfg = FFConfig(pipeline_parallelism_degree=2)
+        llm = Model(ffcfg, name="spec_pp_llm")
+        create_llama_model(llm, llm_cfg, mode=InferenceMode.TREE_VERIFY,
+                           max_requests=2)
+        llm.params = convert_hf_state_dict(hf.state_dict(), llm_cfg)
+        ssm = Model(FFConfig(), name="spec_pp_ssm")
+        create_llama_model(ssm, ssm_cfg, mode=InferenceMode.BEAM_SEARCH,
+                           max_requests=2)
+        ssm.params = convert_hf_state_dict(ssm_hf.state_dict(), ssm_cfg)
+        im = InferenceManager(ffcfg)
+        lid = im.compile_model_and_allocate_buffer(
+            llm, mode=InferenceMode.TREE_VERIFY, max_requests=2,
+            max_seq_length=64, cache_dtype=np.float32)
+        sid = im.compile_model_and_allocate_buffer(
+            ssm, mode=InferenceMode.BEAM_SEARCH, max_requests=2,
+            max_seq_length=64, beam_width=2, cache_dtype=np.float32)
+        rm = RequestManager(max_requests_per_batch=2,
+                            max_tokens_per_batch=32,
+                            max_sequence_length=64,
+                            max_spec_tree_token_num=24)
+        rm.register_ssm_model(sid)
+        reqs = [rm.register_new_request(list(p), max_new_tokens=12)
+                for p in prompts]
+        generate_spec_infer(rm, im, lid, reqs, beam_width=2, beam_depth=3)
+        got = [r.tokens[r.prompt_len:] for r in reqs]
+        assert got == want
+
     def test_pp_disables_decode_blocks(self):
         hf = _hf()
         _, im, mid, _ = _generate(hf, 2, 1, [[1, 5]], 4)
