@@ -209,8 +209,13 @@ def estimate_op_cost(layer, out_shapes, machine: MachineModel,
     """
     flops, act_bytes, w_bytes = op_flops_bytes(layer, out_shapes)
     shard = dp * tp
+    # weights stream from HBM every step and shard only over tp (replicated
+    # across dp) — at small batch (serving decode) this term dominates.
+    # Gather-style ops (embedding: flops == 0) touch only the rows used,
+    # already counted in act_bytes, not the whole table.
+    w_stream = w_bytes / tp if flops else 0.0
     compute = max(flops / shard / machine.peak_flops,
-                  act_bytes / shard / machine.hbm_bandwidth)
+                  (act_bytes / shard + w_stream) / machine.hbm_bandwidth)
     fwd = compute
     bwd = 2 * compute if w_bytes else compute  # dX and dW matmuls
     sync = 0.0

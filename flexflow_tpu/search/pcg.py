@@ -132,6 +132,40 @@ def data_parallel_strategy(pcg: PCG, num_devices: int
     return {l.name: ShardAssignment(dp=num_devices) for l in pcg.nodes}
 
 
+def balanced_partition(costs: List[float], k: int) -> List[int]:
+    """Split a cost sequence into ``k`` contiguous groups minimizing the
+    max group sum (linear-partition DP) — the stage-balancing objective the
+    reference approximates with its uniform layers_per_stage split
+    (inference_manager.cc:131).  Returns the group index per item."""
+    n = len(costs)
+    if n == 0:
+        return []
+    k = min(k, n)
+    prefix = [0.0]
+    for c in costs:
+        prefix.append(prefix[-1] + c)
+    INF = float("inf")
+    # best[j][i]: minimal max-sum splitting the first i items into j groups
+    best = [[INF] * (n + 1) for _ in range(k + 1)]
+    cut = [[0] * (n + 1) for _ in range(k + 1)]
+    best[0][0] = 0.0
+    for j in range(1, k + 1):
+        for i in range(j, n + 1):
+            for m in range(j - 1, i):
+                cand = max(best[j - 1][m], prefix[i] - prefix[m])
+                if cand < best[j][i]:
+                    best[j][i] = cand
+                    cut[j][i] = m
+    out = [0] * n
+    i = n
+    for j in range(k, 0, -1):
+        m = cut[j][i]
+        for t in range(m, i):
+            out[t] = j - 1
+        i = m
+    return out
+
+
 def assign_pipeline_stages(pcg: PCG, num_stages: int,
                            machine: MachineModel,
                            strategy: Optional[Dict[str, ShardAssignment]]
@@ -147,15 +181,10 @@ def assign_pipeline_stages(pcg: PCG, num_stages: int,
         c = estimate_op_cost(l, [o.spec.shape for o in l.outputs], machine,
                              dp=a.dp, tp=a.tp)
         costs.append(c.total_time)
-    total = sum(costs)
-    target = total / num_stages
-    stage, acc = 0, 0.0
-    for l, c in zip(pcg.nodes, costs):
-        if acc > target * (stage + 1) and stage < num_stages - 1:
-            stage += 1
-        acc += c
+    stages = balanced_partition(costs, num_stages)
+    for l, s in zip(pcg.nodes, stages):
         a = strategy[l.name]
-        strategy[l.name] = ShardAssignment(a.dp, a.tp, stage)
+        strategy[l.name] = ShardAssignment(a.dp, a.tp, s)
     return strategy
 
 

@@ -18,7 +18,7 @@ per-stage MachineViews with distinct start_device_id, graph.cc:2016-2024):
 
 from __future__ import annotations
 
-from typing import Any, Dict, List, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -29,26 +29,81 @@ from ..config import AXIS_MODEL
 from ..ops.registry import OpContext, get_op
 
 
-def partition_stages(model, pp: int) -> List[List[Any]]:
-    """Group layers into pp stages by transformer_layer_id
-    (inference_manager.cc:131 layers_per_stage semantics)."""
-    tids = sorted({l.transformer_layer_id for l in model.layers
-                   if l.transformer_layer_id >= 0})
-    n_blocks = max(1, len(tids))
-    per_stage = -(-n_blocks // pp)           # ceil
-    stage_of_tid = {t: min(i // per_stage, pp - 1)
-                    for i, t in enumerate(tids)}
-    stages: List[List[Any]] = [[] for _ in range(pp)]
+def _layer_slots(model):
+    """Classify each layer into its pipeline slot: ``"pre"`` (before any
+    transformer block → pinned to stage 0), a transformer_layer_id, or
+    ``"post"`` (after the blocks → pinned to the last stage).  The single
+    source of truth shared by :func:`partition_stages` (placement) and
+    :func:`cost_balanced_stage_of_tid` (cost attribution)."""
     seen_block = False
     for layer in model.layers:
         tid = layer.transformer_layer_id
         if tid >= 0:
             seen_block = True
-            stages[stage_of_tid[tid]].append(layer)
-        elif not seen_block:
-            stages[0].append(layer)           # embedding etc.
+            yield layer, tid
         else:
+            yield layer, ("post" if seen_block else "pre")
+
+
+def cost_balanced_stage_of_tid(model, pp: int, tp: int,
+                               machine=None) -> Dict[int, int]:
+    """Assign transformer blocks to stages by forward cost, not count
+    (the reference splits uniformly, inference_manager.cc:131; uniform and
+    cost-balanced coincide for homogeneous blocks, but interleaved MoE or
+    mixed-width blocks skew a count split).  ``machine`` defaults to the
+    v5e :class:`SimpleMachineModel`; pass an ``EnhancedMachineModel`` for
+    hardware with a different flops:bandwidth crossover."""
+    from ..search.cost_model import SimpleMachineModel, estimate_op_cost
+    from ..search.pcg import balanced_partition
+
+    tids = sorted({l.transformer_layer_id for l in model.layers
+                   if l.transformer_layer_id >= 0})
+    if not tids:
+        return {}
+    machine = machine or SimpleMachineModel(tp)
+    cost = {t: 0.0 for t in tids}
+    pre = post = 0.0     # embedding → stage 0; final norm / head → last
+    for layer, slot in _layer_slots(model):
+        c = estimate_op_cost(
+            layer, [o.spec.shape for o in layer.outputs], machine,
+            tp=tp).forward_time            # serving runs forward only
+        if slot == "pre":
+            pre += c
+        elif slot == "post":
+            post += c
+        else:
+            cost[slot] += c
+    costs = [cost[t] for t in tids]
+    # pre/post-block layers are pinned to the first/last stage
+    # (partition_stages), so their cost must weigh on those groups — an
+    # lm_head over a 128k vocab streams as much as several blocks
+    costs[0] += pre
+    costs[-1] += post
+    stages = balanced_partition(costs, pp)
+    return dict(zip(tids, stages))
+
+
+def partition_stages(model, pp: int,
+                     stage_of_tid: Optional[Dict[int, int]] = None
+                     ) -> List[List[Any]]:
+    """Group layers into pp stages by transformer_layer_id
+    (inference_manager.cc:131 layers_per_stage semantics); an explicit
+    ``stage_of_tid`` (e.g. from :func:`cost_balanced_stage_of_tid`)
+    overrides the uniform count split."""
+    if stage_of_tid is None:
+        tids = sorted({l.transformer_layer_id for l in model.layers
+                       if l.transformer_layer_id >= 0})
+        per_stage = -(-max(1, len(tids)) // pp)   # ceil
+        stage_of_tid = {t: min(i // per_stage, pp - 1)
+                        for i, t in enumerate(tids)}
+    stages: List[List[Any]] = [[] for _ in range(pp)]
+    for layer, slot in _layer_slots(model):
+        if slot == "pre":
+            stages[0].append(layer)           # embedding etc.
+        elif slot == "post":
             stages[pp - 1].append(layer)      # final norm / head / sampler
+        else:
+            stages[stage_of_tid[slot]].append(layer)
     return stages
 
 
@@ -146,7 +201,8 @@ def compile_pipeline(im, record, model, cfg, cache_dtype, rows, alloc_len):
 
     pp = cfg.pipeline_parallelism_degree
     tp = cfg.tensor_parallelism_degree
-    stages = partition_stages(model, pp)
+    stages = partition_stages(model, pp,
+                              cost_balanced_stage_of_tid(model, pp, tp))
     meshes = build_stage_meshes(cfg, pp, tp)
     record["pp_stages"] = stages
     record["pp_meshes"] = meshes

@@ -66,6 +66,80 @@ class TestPipelineServing:
                  if l.transformer_layer_id >= 0}
         assert tids0 == {0, 1} and tids1 == {2, 3}
 
+    def test_cost_balanced_stage_partition(self):
+        """Mixed-width blocks split by cost, not count: one wide block
+        balances against several thin ones, and serving over the balanced
+        partition stays token-exact vs single-device."""
+        from flexflow_tpu.fftype import DataType
+        from flexflow_tpu.serving.pipeline_serving import (
+            cost_balanced_stage_of_tid)
+
+        def build(ffcfg, name):
+            model = Model(ffcfg, name=name)
+            tokens = model.create_tensor((2, 1), DataType.INT32,
+                                         name="tokens")
+            t = model.embedding(tokens, 64, 32, name="embed_tokens")
+            for i, w in enumerate([512, 32, 32, 32, 32, 32]):
+                model.current_transformer_layer_id = i
+                t = model.dense(t, w, name=f"up_{i}")
+                t = model.dense(t, 32, name=f"down_{i}")
+            model.current_transformer_layer_id = -1
+            t = model.dense(t, 64, name="lm_head")
+            model.arg_max(t, name="argmax")
+            model.params = model.init_params(jax.random.PRNGKey(7))
+            return model
+
+        st = cost_balanced_stage_of_tid(
+            build(FFConfig(), "pp_het_probe"), 2, 1)
+        assert st[0] == 0 and all(st[i] == 1 for i in range(1, 6))
+
+        # a huge lm_head weighs on the last stage: uniform blocks shift
+        # toward stage 0 to compensate
+        model = Model(FFConfig(), name="pp_head_probe")
+        tokens = model.create_tensor((2, 1), DataType.INT32, name="tokens")
+        t = model.embedding(tokens, 64, 32, name="embed_tokens")
+        for i in range(4):
+            model.current_transformer_layer_id = i
+            t = model.dense(t, 32, name=f"blk_{i}")
+        model.current_transformer_layer_id = -1
+        t = model.dense(t, 100000, name="lm_head")
+        model.arg_max(t, name="argmax")
+        st = cost_balanced_stage_of_tid(model, 2, 1)
+        assert st == {0: 0, 1: 0, 2: 0, 3: 1}
+
+        # a huge embedding TABLE is a gather (only touched rows stream) —
+        # unlike a huge lm_head matmul, table size must not move the split
+        def embed_probe(vocab):
+            model = Model(FFConfig(), name=f"pp_embed_probe_{vocab}")
+            tokens = model.create_tensor((2, 1), DataType.INT32,
+                                         name="tokens")
+            t = model.embedding(tokens, vocab, 32, name="embed_tokens")
+            for i in range(4):
+                model.current_transformer_layer_id = i
+                t = model.dense(t, 32, name=f"blk_{i}")
+            model.current_transformer_layer_id = -1
+            t = model.dense(t, 64, name="lm_head")
+            model.arg_max(t, name="argmax")
+            return cost_balanced_stage_of_tid(model, 2, 1)
+
+        assert embed_probe(100000) == embed_probe(64)
+
+        def run(pp):
+            ffcfg = FFConfig(pipeline_parallelism_degree=pp)
+            model = build(ffcfg, f"pp_het_{pp}")
+            im = InferenceManager(ffcfg)
+            mid = im.compile_model_and_allocate_buffer(
+                model, max_requests=2, max_seq_length=16,
+                cache_dtype=np.float32)
+            rm = RequestManager(max_requests_per_batch=2,
+                                max_tokens_per_batch=4,
+                                max_sequence_length=16)
+            reqs = [rm.register_new_request([1, 5], max_new_tokens=4)]
+            rm.generate_incr_decoding(im, mid, reqs)
+            return [r.tokens for r in reqs]
+
+        assert run(2) == run(1)
+
     def test_pp_token_match(self):
         hf = _hf()
         prompts = [[1, 5, 9, 42], [2, 8, 99]]
