@@ -48,31 +48,14 @@ def dequantize_int8(q, scale, dtype):
 
 # ------------------------------------------------------------------- int4
 def quantize_int4(w: np.ndarray, group: int = INT4_GROUP):
-    """w [in, out] -> (packed int8 [in//2, out], scale f32 [in//g, out])."""
-    w = np.asarray(w, np.float32)
-    in_dim, out = w.shape
-    assert in_dim % 2 == 0, "int4 packing needs an even in_dim"
-    g = min(group, in_dim)
-    while in_dim % g:
-        g //= 2
-    wg = w.reshape(in_dim // g, g, out)
-    scale = np.abs(wg).max(axis=1) / 7.0
-    scale = np.where(scale == 0, 1.0, scale).astype(np.float32)
-    q = np.clip(np.rint(wg / scale[:, None, :]), -8, 7).astype(np.int8)
-    q = q.reshape(in_dim, out)
-    lo = q[0::2] & 0x0F
-    hi = (q[1::2] & 0x0F) << 4
-    return (lo | hi).astype(np.int8), scale
+    """w [in, out] -> (packed int8 [in//2, out], scale f32 [in//g, out]).
+    The 2-D linear-kernel layout: packs along the in dim (axis 0)."""
+    return quantize_int4_nd(w, 0, group)
 
 
 def dequantize_int4(packed, scale, dtype, in_dim: int):
-    lo = (packed << 4).astype(jnp.int8) >> 4           # sign-extend low
-    hi = packed.astype(jnp.int8) >> 4                  # arithmetic shift
-    q = jnp.stack([lo, hi], axis=1).reshape(in_dim, packed.shape[-1])
-    g = in_dim // scale.shape[0]
-    deq = (q.reshape(scale.shape[0], g, -1).astype(jnp.float32)
-           * scale[:, None, :])
-    return deq.reshape(in_dim, -1).astype(dtype)
+    assert in_dim == packed.shape[0] * 2, (in_dim, packed.shape)
+    return dequantize_int4_nd(packed, scale, dtype, 0)
 
 
 # --------------------------------------------------------------- param tree
@@ -103,6 +86,46 @@ def dequantize_kernel(params: Dict[str, Any], dtype):
     return dequantize_int4(q, scale, dtype, q.shape[0] * 2)
 
 
+# --------------------------------------------- N-d int4 (attention)
+def quantize_int4_nd(w: np.ndarray, axis: int, group: int = INT4_GROUP):
+    """Group-wise int4 along one reduction ``axis``; all other axes keep
+    independent scales (finer than the int8_nd per-output-channel scale).
+    Returns (packed int8 with axis halved, scale f32 with axis/group).
+    The pack axis must be even-sized and must NOT be a tp-sharded axis
+    (nibble pairs may not straddle shards): wq/wk/wv pack E, wo packs D
+    (heads shard, tp_specs.ATTN_WEIGHT_SPECS)."""
+    w = np.asarray(w, np.float32)
+    n = w.shape[axis]
+    assert n % 2 == 0, "int4 packing needs an even pack-axis size"
+    g = min(group, n)
+    while n % g:
+        g //= 2
+    wm = np.moveaxis(w, axis, 0)
+    rest = wm.shape[1:]
+    wg = wm.reshape(n // g, g, *rest)
+    scale = np.abs(wg).max(axis=1) / 7.0
+    scale = np.where(scale == 0, 1.0, scale).astype(np.float32)
+    q = np.clip(np.rint(wg / scale[:, None]), -8, 7).astype(np.int8)
+    q = q.reshape(n, *rest)
+    packed = ((q[0::2] & 0x0F) | ((q[1::2] & 0x0F) << 4)).astype(np.int8)
+    return (np.moveaxis(packed, 0, axis),
+            np.moveaxis(scale, 0, axis))
+
+
+def dequantize_int4_nd(packed, scale, dtype, axis: int):
+    pm = jnp.moveaxis(packed, axis, 0)
+    sm = jnp.moveaxis(scale, axis, 0)
+    lo = (pm << 4).astype(jnp.int8) >> 4               # sign-extend low
+    hi = pm.astype(jnp.int8) >> 4                      # arithmetic shift
+    n = pm.shape[0] * 2
+    rest = pm.shape[1:]
+    q = jnp.stack([lo, hi], axis=1).reshape(n, *rest)
+    g = n // sm.shape[0]
+    deq = (q.reshape(sm.shape[0], g, *rest).astype(jnp.float32)
+           * sm[:, None])
+    return jnp.moveaxis(deq.reshape(n, *rest), 0, axis).astype(dtype)
+
+
 # ------------------------------------------------- N-d int8 (attention)
 def quantize_int8_nd(w: np.ndarray, reduce_axes):
     """Symmetric int8 with scale over the non-reduced (output) axes; q
@@ -122,10 +145,17 @@ def dequantize_int8_nd(q, scale, dtype):
 
 def resolve_weight(params: Dict[str, Any], name: str, dtype):
     """Fetch a (possibly quantized) weight for an op forward: dequantizes
-    if ``<name>_q`` is present, else returns the plain weight."""
+    if ``<name>_q`` is present, else returns the plain weight.  Layout is
+    recovered from static shapes (traces cleanly under jit): group-wise
+    int4 carries a scale of the same rank as q; int8_nd's scale drops the
+    reduced leading axes."""
     if name + "_q" in params:
-        return dequantize_int8_nd(params[name + "_q"],
-                                  params[name + "_scale"], dtype)
+        q = params[name + "_q"]
+        scale = params[name + "_scale"]
+        if scale.ndim == q.ndim:
+            return dequantize_int4_nd(q, scale, dtype,
+                                      ATTENTION_INT4_PACK_AXIS[name])
+        return dequantize_int8_nd(q, scale, dtype)
     return params[name].astype(dtype)
 
 
@@ -133,6 +163,8 @@ def resolve_weight(params: Dict[str, Any], name: str, dtype):
 # [E, H, D] (in = E), wo is [H, D, E] (in = H, D) — reference scope
 # load_attention_weights_quantized, file_loader.cc:400
 ATTENTION_WEIGHTS = {"wq": (0,), "wk": (0,), "wv": (0,), "wo": (0, 1)}
+# int4 nibble pairs pack along an unsharded reduction axis (heads shard)
+ATTENTION_INT4_PACK_AXIS = {"wq": 0, "wk": 0, "wv": 0, "wo": 1}
 
 SERVING_ATTENTION_TYPES = frozenset({
     OpType.INC_MULTIHEAD_SELF_ATTENTION,
@@ -145,9 +177,9 @@ def quantize_model_params(model, mode: Optional[str],
                           skip_layers=()) -> None:
     """Quantize Linear kernels AND attention projections in ``model.params``
     (reference scope: file_loader.cc:400-651 covers both).  Embeddings,
-    norms and biases stay full precision.  Attention's 3-D projections use
-    per-output-channel int8 even under mode="int4" (nibble packing is
-    defined on the 2-D linear layout); linear kernels honor the mode.
+    norms and biases stay full precision.  Attention's 3-D projections
+    honor the mode like linear kernels: int8 per-output-channel or int4
+    group-wise packed along an unsharded reduction axis.
     """
     if not mode:
         return
@@ -165,7 +197,11 @@ def quantize_model_params(model, mode: Optional[str],
             for wname, axes in ATTENTION_WEIGHTS.items():
                 if wname not in out:
                     continue
-                q, s = quantize_int8_nd(out.pop(wname), axes)
+                if mode == "int4":
+                    q, s = quantize_int4_nd(
+                        out.pop(wname), ATTENTION_INT4_PACK_AXIS[wname])
+                else:
+                    q, s = quantize_int8_nd(out.pop(wname), axes)
                 out[wname + "_q"] = q
                 out[wname + "_scale"] = s
             model.params[layer.name] = out

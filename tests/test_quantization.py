@@ -6,8 +6,9 @@ import pytest
 
 import jax.numpy as jnp
 
-from flexflow_tpu.quantization import (dequantize_int4, dequantize_int8,
-                                       dequantize_kernel, quantize_int4,
+from flexflow_tpu.quantization import (dequantize_int4, dequantize_int4_nd,
+                                       dequantize_int8, dequantize_kernel,
+                                       quantize_int4, quantize_int4_nd,
                                        quantize_int8,
                                        quantize_model_params)
 
@@ -42,6 +43,27 @@ class TestRoundtrip:
         deq = np.asarray(dequantize_int4(jnp.asarray(q), jnp.asarray(s),
                                          jnp.float32, 4))
         np.testing.assert_allclose(deq, w, atol=0.51 * s.max())
+
+    @pytest.mark.parametrize("shape,axis", [((128, 4, 16), 0),
+                                            ((4, 16, 128), 1)])
+    def test_int4_nd_error_bound(self, shape, axis):
+        """3-D attention layouts: wq/wk/wv [E, H, D] pack E; wo [H, D, E]
+        packs D (the head axis stays intact for tp sharding)."""
+        rng = np.random.default_rng(3)
+        w = rng.normal(size=shape).astype(np.float32)
+        q, s = quantize_int4_nd(w, axis)
+        assert q.shape[axis] == shape[axis] // 2
+        assert q.ndim == s.ndim == w.ndim
+        # non-pack axes keep their size (sharding specs apply unchanged)
+        for a in range(w.ndim):
+            if a != axis:
+                assert q.shape[a] == s.shape[a] == shape[a]
+        deq = np.asarray(dequantize_int4_nd(jnp.asarray(q), jnp.asarray(s),
+                                            jnp.float32, axis))
+        g = shape[axis] // s.shape[axis]
+        step = np.repeat(np.moveaxis(s, axis, 0), g, axis=0)
+        err = np.abs(np.moveaxis(deq - w, axis, 0))
+        assert np.all(err <= step * 0.51)
 
     def test_odd_group_fallback(self):
         w = np.random.default_rng(2).normal(size=(24, 8)).astype(np.float32)
@@ -98,9 +120,11 @@ class TestServingIntegration:
         if mode == "int8":
             assert quant == full, (quant, full)
 
-    def test_attention_projections_quantized(self):
+    @pytest.mark.parametrize("mode", ["int8", "int4"])
+    def test_attention_projections_quantized(self, mode):
         """Attention wq/wk/wv/wo must be quantized too (reference
-        load_attention_weights_quantized scope)."""
+        load_attention_weights_quantized scope); int4 packs nibbles along
+        an unsharded reduction axis."""
         transformers = pytest.importorskip("transformers")
         import torch
 
@@ -121,15 +145,22 @@ class TestServingIntegration:
         create_llama_model(model, cfg, mode=InferenceMode.INC_DECODING,
                            max_requests=2)
         model.params = convert_hf_state_dict(hf.state_dict(), cfg)
-        quantize_model_params(model, "int8")
+        quantize_model_params(model, mode)
         attn = model.params["layers_0_attention"]
         for w in ("wq", "wk", "wv", "wo"):
             assert w + "_q" in attn and w not in attn
             assert attn[w + "_q"].dtype == np.int8
+        if mode == "int4":
+            E, H = 32, 2
+            D = E // H
+            assert attn["wq_q"].shape == (E // 2, H, D)   # E packed
+            assert attn["wo_q"].shape == (H, D // 2, E)   # D packed
 
-    def test_quantized_tp_serving(self):
+    @pytest.mark.parametrize("mode", ["int8", "int4"])
+    def test_quantized_tp_serving(self, mode):
         """Quantized weights shard under tensor parallelism (regression:
-        KeyError 'kernel_q' in the pspec device_put)."""
+        KeyError 'kernel_q' in the pspec device_put); int4's packed pairs
+        never straddle the tp-sharded head axis."""
         transformers = pytest.importorskip("transformers")
         import torch
 
@@ -152,7 +183,7 @@ class TestServingIntegration:
         create_llama_model(model, cfg, mode=InferenceMode.INC_DECODING,
                            max_requests=2)
         model.params = convert_hf_state_dict(hf.state_dict(), cfg)
-        quantize_model_params(model, "int8")
+        quantize_model_params(model, mode)
         im = InferenceManager(ffcfg)
         mid = im.compile_model_and_allocate_buffer(
             model, max_requests=2, max_seq_length=32,
