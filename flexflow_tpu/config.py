@@ -97,13 +97,15 @@ class FFConfig:
                 f"({self.num_devices})"
             )
 
-    def make_mesh(self, axes: Optional[Sequence[str]] = None) -> jax.sharding.Mesh:
+    def make_mesh(self, axes: Optional[Sequence[str]] = None,
+                  sizes: Optional[Sequence[int]] = None) -> jax.sharding.Mesh:
         """Build the device mesh.
 
         Replaces the reference's MachineView device assignment
         (machine_view.h:18-39) + FFMapper placement (mapper.cc:376-560):
         device placement on TPU is mesh construction, and op placement is
-        sharding annotation.
+        sharding annotation.  ``sizes`` overrides the per-axis extents for
+        axes the config degrees don't describe (factorized tp sub-axes).
         """
         self.validate()
         degrees = {
@@ -115,7 +117,8 @@ class FFConfig:
         }
         if axes is None:
             axes = [a for a, d in degrees.items() if d > 1] or [AXIS_DATA]
-        shape = [degrees.get(a, 1) for a in axes]
+        shape = (list(sizes) if sizes is not None
+                 else [degrees.get(a, 1) for a in axes])
         n = int(np.prod(shape))
         devs = np.array(self.devices[:n]).reshape(shape)
         return jax.sharding.Mesh(devs, tuple(axes))

@@ -82,6 +82,7 @@ class Model:
         self._rng = None
         self._epochs_trained = 0
         self.strategy = None
+        self._tp_subaxes = None   # [(axis_name, size)] factorized tp axes
         self.current_transformer_layer_id = -1
 
     # ------------------------------------------------------------- builders
@@ -576,14 +577,28 @@ class Model:
             spec = tp_specs.EMBEDDING_SPECS.get(pname, spec)
         elif t is OpType.MULTIHEAD_ATTENTION:
             spec = tp_specs.ATTN_WEIGHT_SPECS.get(pname, spec)
-        # a dim that doesn't divide the tp axis replicates instead of
+        # the layer's tp degree maps to a prefix of the (possibly
+        # factorized) tp mesh axes: a tp=2 layer under a tp=4 mesh built as
+        # ('tp0','tp1') of 2x2 shards over 'tp0' and replicates over 'tp1'
+        names: list = []
+        shard_count = 1
+        for nm, size in (self._tp_subaxes or [(AXIS_MODEL, 1)]):
+            if shard_count >= a.tp:
+                break
+            names.append(nm)
+            shard_count *= size
+        tp_axes = names[0] if len(names) == 1 else tuple(names)
+        # a dim that doesn't divide its shard count replicates instead of
         # crashing device_put (e.g. a 10-class head under tp=4)
-        tp_size = self.mesh.shape[AXIS_MODEL] if AXIS_MODEL in \
-            self.mesh.axis_names else 1
+        out = []
         for dim, ax in enumerate(spec):
-            if ax == AXIS_MODEL and value.shape[dim] % tp_size != 0:
+            if ax != AXIS_MODEL:
+                out.append(ax)
+            elif value.shape[dim] % shard_count != 0:
                 return PartitionSpec()
-        return spec
+            else:
+                out.append(tp_axes)
+        return PartitionSpec(*out)
 
     def _non_trainable_keys(self):
         keys = set()
@@ -705,19 +720,58 @@ class Model:
                     cfg.data_parallelism_degree = max(
                         1, cfg.num_devices // tp_degree)
                 self.config = cfg
-            elif max(tps) != tp_degree:
-                warnings.warn(
-                    f"config tensor_parallelism_degree={tp_degree} "
-                    f"overrides the strategy's max tp degree {max(tps)}")
-            if len(tps) > 1:
-                # GSPMD uses ONE global tp axis: per-layer degrees apply
-                # as the boolean tp>1 over that axis (per-layer sub-axis
-                # sharding is future work); the search's cost for
-                # heterogeneous strategies describes a finer placement
-                warnings.warn(
-                    f"strategy has heterogeneous tp degrees {sorted(tps)}; "
-                    f"applying degree {tp_degree} to every tp>1 layer")
-            self.mesh = self.config.make_mesh([AXIS_DATA, AXIS_MODEL])
+            chain = sorted(tps)
+            nested = all(b % a == 0 for a, b in zip(chain, chain[1:]))
+            if (nested and tp_degree > chain[-1]
+                    and tp_degree % chain[-1] == 0):
+                # config grows the axis past the strategy's max degree:
+                # honor both — mesh extent tp_degree, layers keep their own
+                chain.append(tp_degree)
+            # explicit parallel ops in the graph address the mesh axis by
+            # its name ('tp'): a factorized mesh has no such axis, so those
+            # graphs keep the single-axis layout
+            parallel_types = (OpType.REPARTITION, OpType.COMBINE,
+                              OpType.REPLICATE, OpType.REDUCTION,
+                              OpType.ALLREDUCE, OpType.FUSED_PARALLEL)
+            uses_tp_axis = any(
+                l.attrs.get("axis", AXIS_MODEL) == AXIS_MODEL
+                for l in self.layers if l.op_type in parallel_types)
+            if (nested and chain[-1] == tp_degree and len(chain) > 1
+                    and not uses_tp_axis):
+                # degrees forming a divisibility chain: factorize the tp
+                # axis into sub-axes ('tp0','tp1',...) of sizes
+                # (d1, d2/d1, ...); a tp=d_i layer shards over the first i
+                # sub-axes and replicates over the rest — GSPMD then scopes
+                # its collectives to the prefix sub-mesh
+                sizes = [chain[0]] + [b // a
+                                      for a, b in zip(chain, chain[1:])]
+                self._tp_subaxes = [(f"tp{i}", s)
+                                    for i, s in enumerate(sizes)]
+                names = [nm for nm, _ in self._tp_subaxes]
+                self.mesh = self.config.make_mesh(
+                    [AXIS_DATA] + names,
+                    sizes=[self.config.data_parallelism_degree] + sizes)
+            else:
+                if not nested:
+                    # degrees that don't nest (e.g. {2, 3}) can't share one
+                    # factorized axis: degrade to the boolean tp>1 rule
+                    warnings.warn(
+                        f"strategy tp degrees {sorted(tps)} don't form a "
+                        f"divisibility chain; applying degree {tp_degree} "
+                        f"to every tp>1 layer")
+                elif chain[-1] != tp_degree:
+                    warnings.warn(
+                        f"config tensor_parallelism_degree={tp_degree} "
+                        f"overrides the strategy's max tp degree "
+                        f"{max(tps)}")
+                elif len(chain) > 1 and uses_tp_axis:
+                    warnings.warn(
+                        f"graph uses explicit parallel ops on the "
+                        f"'{AXIS_MODEL}' axis; applying degree {tp_degree} "
+                        f"to every tp>1 layer instead of factorizing "
+                        f"{sorted(tps)}")
+                self._tp_subaxes = [(AXIS_MODEL, tp_degree)]
+                self.mesh = self.config.make_mesh([AXIS_DATA, AXIS_MODEL])
         elif self.config.data_parallelism_degree > 1:
             self.mesh = self.config.make_mesh([AXIS_DATA])
         self._rng, init_rng = jax.random.split(self._rng)

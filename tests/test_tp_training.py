@@ -102,6 +102,104 @@ def test_strategy_does_not_mutate_user_config():
     m.fit([x], y, epochs=1, verbose=False)
 
 
+def _het_strategy(m, degrees):
+    """dp=2 everywhere; the i-th Linear gets tp=degrees[i]."""
+    lins = [l.name for l in m.layers if l.op_type is OpType.LINEAR]
+    s = {l.name: ShardAssignment(dp=2, tp=1) for l in m.layers}
+    for name, tp in zip(lins, degrees):
+        s[name] = ShardAssignment(dp=2, tp=tp)
+    return s
+
+
+def test_heterogeneous_tp_degrees_factorize_axis():
+    """Per-layer tp degrees forming a divisibility chain shard over
+    sub-axes of one factorized tp mesh axis — no degrade warning, and a
+    tp=2 layer really lives on a 2-way sub-axis while tp=4 uses both."""
+    import warnings
+
+    x, y = _blobs(128)
+
+    def train(make_strategy):
+        cfg = FFConfig(batch_size=32, data_parallelism_degree=2, seed=5)
+        m = _mlp(cfg)
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            m.compile(AdamOptimizer(alpha=1e-2),
+                      loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+                      metrics=[MetricsType.ACCURACY],
+                      strategy=make_strategy(m) if make_strategy else None)
+        assert not any("chain" in str(x.message) or
+                       "heterogeneous" in str(x.message) for x in w), w
+        # committed layouts (before fit: the jitted step's output shardings
+        # are GSPMD's choice and may differ)
+        m._compile_specs = {n: m.params[n]["kernel"].sharding.spec
+                            for n in ("linear_0", "linear_1", "linear_2")}
+        m.fit([x], y, epochs=3, verbose=False)
+        return m
+
+    m = train(lambda mm: _het_strategy(mm, [2, 4, 1]))
+    assert m._compile_specs["linear_0"] == PartitionSpec(None, "tp0")
+    assert m._compile_specs["linear_1"] == PartitionSpec(None,
+                                                         ("tp0", "tp1"))
+    assert m._compile_specs["linear_2"] == PartitionSpec()
+    # layout changes only, not math: matches plain-DP training, same seed
+    dp = train(None)
+    np.testing.assert_allclose(np.asarray(m.params["linear_2"]["kernel"]),
+                               np.asarray(dp.params["linear_2"]["kernel"]),
+                               rtol=2e-3, atol=2e-3)
+
+
+def test_config_degree_grows_chain():
+    """config tp degree above the strategy's max (and nesting on top of
+    it) factorizes rather than over-sharding every layer."""
+    cfg = FFConfig(batch_size=32, data_parallelism_degree=2,
+                   tensor_parallelism_degree=4, seed=7)
+    m = _mlp(cfg)
+    m.compile(SGDOptimizer(lr=0.05),
+              loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+              metrics=[MetricsType.ACCURACY],
+              strategy=_het_strategy(m, [2, 2, 1]))
+    assert dict(m.mesh.shape) == {"dp": 2, "tp0": 2, "tp1": 2}
+    assert m.params["linear_0"]["kernel"].sharding.spec == \
+        PartitionSpec(None, "tp0")
+    x, y = _blobs()
+    m.fit([x], y, epochs=1, verbose=False)
+
+
+def test_non_chain_tp_degrees_degrade_with_warning():
+    """Degrees that don't nest ({2, 3}) can't factorize one axis: the
+    boolean tp>1 fallback applies with a warning."""
+    cfg = FFConfig(batch_size=32, data_parallelism_degree=2, seed=6)
+    m = _mlp(cfg, hidden=66)   # divisible by 2, 3, and 6
+    with pytest.warns(UserWarning, match="divisibility chain"):
+        m.compile(SGDOptimizer(lr=0.05),
+                  loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+                  metrics=[MetricsType.ACCURACY],
+                  strategy=_het_strategy(m, [2, 3, 1]))
+    x, y = _blobs()
+    m.fit([x], y, epochs=1, verbose=False)
+
+
+def test_explicit_parallel_ops_keep_single_tp_axis():
+    """A graph with explicit parallel ops addressing the 'tp' axis by name
+    must not get a factorized mesh (which would have no 'tp' axis)."""
+    cfg = FFConfig(batch_size=32, data_parallelism_degree=2, seed=8)
+    m = Model(cfg, name="tp_explicit")
+    x = m.create_tensor((32, 32), name="x")
+    t = m.dense(x, 64, activation=ActiMode.RELU)
+    t = m.allreduce(t)                  # axis defaults to 'tp'
+    t = m.dense(t, 64, activation=ActiMode.RELU)
+    m.softmax(m.dense(t, 4))
+    with pytest.warns(UserWarning, match="explicit parallel ops"):
+        m.compile(SGDOptimizer(lr=0.05),
+                  loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+                  metrics=[MetricsType.ACCURACY],
+                  strategy=_het_strategy(m, [2, 4, 1]))
+    assert "tp" in m.mesh.axis_names
+    x_, y_ = _blobs()
+    m.fit([x_], y_, epochs=1, verbose=False)
+
+
 def test_opt_state_inherits_param_sharding():
     cfg = FFConfig(batch_size=32, data_parallelism_degree=2,
                    tensor_parallelism_degree=4, seed=1)
