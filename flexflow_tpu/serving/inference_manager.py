@@ -26,7 +26,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
-from ..config import AXIS_DATA, AXIS_MODEL, AXIS_PIPE, FFConfig
+from ..config import AXIS_DATA, AXIS_MODEL, AXIS_PIPE, AXIS_SEQ, FFConfig
 from ..fftype import InferenceMode, OpType
 from ..ops.registry import OpContext, get_op
 from .batch_config import (BatchConfig, BeamSearchBatchConfig,
@@ -103,6 +103,7 @@ class InferenceManager:
         cfg = model.config
         tp = cfg.tensor_parallelism_degree
         pp = cfg.pipeline_parallelism_degree
+        sp = cfg.sequence_parallelism_degree
         # shared prelude (both execution modes)
         rows = max_requests * beam_width
         cache_dtype = cache_dtype or jnp.dtype(cfg.computation_dtype)
@@ -112,16 +113,33 @@ class InferenceManager:
         # (dynamic_update_slice clamps at the edge).  Slack positions are
         # never attended — the mask stops at each row's current depth.
         alloc_len = max_seq_length + prefill_chunk + 1
+        if sp > 1:
+            # the cache's length axis shards over sp: round up so every
+            # shard holds the same extent
+            alloc_len = -(-alloc_len // sp) * sp
         if model.params is None:
             model.params = model.init_params(jax.random.PRNGKey(cfg.seed))
 
         if pp > 1:
+            if sp > 1:
+                raise NotImplementedError(
+                    "sequence-parallel KV cache under pipeline-parallel "
+                    "serving: shard the length axis within each stage's "
+                    "submesh is future work; use sp with tp/dp only")
             return self._compile_pipeline_model(
                 model, mode, max_requests, max_seq_length, prefill_chunk,
                 beam_width, cache_dtype, model_id, rows, alloc_len)
-        if self.mesh is None and tp > 1:
-            self.mesh = cfg.make_mesh([AXIS_MODEL])
-        mesh = self.mesh if tp > 1 else None
+        need = {a: d for a, d in ((AXIS_SEQ, sp), (AXIS_MODEL, tp))
+                if d > 1}
+        if need:
+            # the cached mesh serves a model only if it has every needed
+            # axis at the right extent (a second model in the same manager
+            # may use a different parallelism shape); earlier models keep
+            # their own mesh via their committed shardings
+            if self.mesh is None or any(
+                    self.mesh.shape.get(a) != d for a, d in need.items()):
+                self.mesh = cfg.make_mesh(list(need))
+        mesh = self.mesh if need else None
         model.mesh = mesh
 
         pspecs = _param_pspecs(model)
@@ -130,15 +148,26 @@ class InferenceManager:
 
             pspecs = extend_quantized_pspecs(pspecs, model.params)
             model.params = {
-                ln: {pn: _device_put_preserving(v, mesh, pspecs[ln][pn])
+                ln: {pn: _device_put_preserving(
+                    v, mesh,
+                    # sp-only mesh has no 'tp' axis: weights replicate
+                    pspecs[ln][pn] if tp > 1 else PartitionSpec())
                      for pn, v in lp.items()}
                 for ln, lp in model.params.items()}
 
         # KV caches per serving-attention layer (reference: allocated in
-        # attention init, inc_multihead_self_attention.cu:1226+)
+        # attention init, inc_multihead_self_attention.cu:1226+).  The
+        # length axis shards over sp (the reference has no sequence
+        # parallelism at all, SURVEY §5: its dense per-TP-shard cache caps
+        # context at one device's HBM) — GSPMD partitions the attention
+        # einsums over the length shards and combines the softmax across
+        # them, so >100k-token contexts spread over the sp group.
         caches = {}
-        cache_sharding = (NamedSharding(mesh, PartitionSpec(None, None, AXIS_MODEL, None))
-                          if mesh is not None else None)
+        cache_sharding = None
+        if mesh is not None:
+            cache_sharding = NamedSharding(mesh, PartitionSpec(
+                None, AXIS_SEQ if sp > 1 else None,
+                AXIS_MODEL if tp > 1 else None, None))
         for layer in model.layers:
             if layer.op_type in SERVING_ATTENTION_OPS:
                 a = layer.attrs
@@ -156,7 +185,9 @@ class InferenceManager:
         record = dict(model=model, mode=mode, mesh=mesh, caches=caches,
                       max_requests=max_requests, rows=rows,
                       max_seq_length=max_seq_length, beam_width=beam_width,
-                      prefill_chunk=prefill_chunk, steps={}, pspecs=pspecs)
+                      prefill_chunk=prefill_chunk, steps={}, pspecs=pspecs,
+                      cache_pspec=(cache_sharding.spec
+                                   if cache_sharding is not None else None))
         self.models[mid] = record
         return mid
 
@@ -212,6 +243,14 @@ class InferenceManager:
             final = model.layers[-1]
             outs = [vals[(final.name, i)] for i in range(len(final.outputs))]
             new_caches = {**caches, **ctx.kv_cache_out}
+            if record.get("cache_pspec") is not None:
+                # pin the cache layout: without the constraint the
+                # compiler may re-layout scan-carried caches onto one
+                # device, silently dropping the sp/tp sharding
+                cs = NamedSharding(record["mesh"], record["cache_pspec"])
+                new_caches = jax.tree.map(
+                    lambda c: jax.lax.with_sharding_constraint(c, cs),
+                    new_caches)
             return outs, new_caches
 
         return step
