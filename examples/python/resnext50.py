@@ -1,0 +1,100 @@
+"""ResNeXt-50 (32x4d) training example — grouped convolutions.
+
+Parity example for the reference's examples/cpp/resnext50 (resnext.cc:12-86):
+the resnext_block is conv1x1 -> grouped conv3x3 (cardinality 32) -> conv1x1
+with a projected residual, stages [3, 4, 6, 3] at widths 128/256/512/1024.
+Grouped convs lower to XLA's feature_group_count (ops/conv_ops.py) — the
+MXU-friendly form of the reference's cuDNN group handling.  Layout is NCHW
+for reference API parity (XLA re-tiles internally).
+
+Run: python examples/python/resnext50.py [--batch-size N] [--dp N]
+     [--image-size S] [--cardinality C]
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+
+from flexflow_tpu import (FFConfig, LossType, MetricsType, Model,
+                          SGDOptimizer)
+from flexflow_tpu.fftype import ActiMode, PoolType
+
+
+def resnext_block(model, t, stride, out_channels, groups, has_residual=True):
+    """reference: resnext_block (examples/cpp/resnext50/resnext.cc:12-33).
+
+    Faithful to the reference's structure, including its quirk that the
+    residual add+relu happen only on projection blocks (stride > 1 or
+    channel change) — identity blocks return the raw conv chain.  We
+    default ``has_residual=True`` where the reference binary leaves it
+    False (resnext.cc:65-80 never passes it), so projection blocks here
+    actually use their shortcut."""
+    shortcut = t
+    in_channels = t.spec.shape[1]        # NCHW
+    t = model.conv2d(t, out_channels, 1, 1, 1, 1, 0, 0,
+                     activation=ActiMode.RELU)
+    t = model.conv2d(t, out_channels, 3, 3, stride, stride, 1, 1,
+                     activation=ActiMode.RELU, groups=groups)
+    t = model.conv2d(t, 2 * out_channels, 1, 1, 1, 1, 0, 0)
+    if (stride > 1 or in_channels != 2 * out_channels) and has_residual:
+        shortcut = model.conv2d(shortcut, 2 * out_channels, 1, 1, stride,
+                                stride, 0, 0, activation=ActiMode.RELU)
+        t = model.relu(model.add(t, shortcut))
+    return t
+
+
+def build(model, batch_size, image_size, num_classes, cardinality):
+    """reference: top_level_task (resnext.cc:58-88)."""
+    x = model.create_tensor((batch_size, 3, image_size, image_size),
+                            name="image")
+    t = model.conv2d(x, 64, 7, 7, 2, 2, 3, 3, activation=ActiMode.RELU)
+    t = model.pool2d(t, 3, 3, 2, 2, 1, 1, PoolType.MAX)
+    for width, blocks, first_stride in ((128, 3, 1), (256, 4, 2),
+                                        (512, 6, 2), (1024, 3, 2)):
+        stride = first_stride
+        for _ in range(blocks):
+            t = resnext_block(model, t, stride, width, cardinality)
+            stride = 1
+    t = model.relu(t)
+    k = t.spec.shape[2]                  # NCHW spatial
+    t = model.pool2d(t, k, k, 1, 1, 0, 0, PoolType.AVG)
+    t = model.flat(t)
+    t = model.dense(t, num_classes)
+    return model.softmax(t)
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--batch-size", type=int, default=16)
+    p.add_argument("--epochs", type=int, default=2)
+    p.add_argument("--iters", type=int, default=4)
+    p.add_argument("--image-size", type=int, default=64)
+    p.add_argument("--classes", type=int, default=10)
+    p.add_argument("--cardinality", type=int, default=32)
+    p.add_argument("--dp", type=int, default=1)
+    args = p.parse_args()
+
+    config = FFConfig(batch_size=args.batch_size, epochs=args.epochs,
+                      data_parallelism_degree=args.dp)
+    model = Model(config, name="resnext50")
+    build(model, args.batch_size, args.image_size, args.classes,
+          args.cardinality)
+    model.compile(SGDOptimizer(lr=0.001),
+                  loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+                  metrics=[MetricsType.ACCURACY,
+                           MetricsType.SPARSE_CATEGORICAL_CROSSENTROPY])
+
+    rng = np.random.default_rng(0)
+    n = args.batch_size * args.iters
+    xs = rng.standard_normal(
+        (n, 3, args.image_size, args.image_size)).astype(np.float32)
+    ys = rng.integers(0, args.classes, n).astype(np.int32)
+    model.fit([xs], ys, epochs=args.epochs)
+
+
+if __name__ == "__main__":
+    main()

@@ -32,6 +32,8 @@ def _run(script, *args, timeout=600):
                 "--num-sparse", "3"]),
     ("candle_uno.py", ["--batch-size", "32", "--epochs", "1"]),
     ("mlp_unify.py", ["--batch-size", "32", "--epochs", "1"]),
+    ("resnext50.py", ["--batch-size", "8", "--epochs", "1", "--iters", "2",
+                      "--image-size", "32", "--cardinality", "8"]),
 ])
 def test_example_runs(script, args):
     r = _run(script, *args)
