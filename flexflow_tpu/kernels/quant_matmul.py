@@ -96,6 +96,65 @@ def int8_matmul(x, q, scale, interpret: bool = False):
     return out[:B, :N]
 
 
+def _fast_bn(n: int):
+    for bn in (512, 256, 128):
+        if n % bn == 0:
+            return bn
+    return None
+
+
+def fast_path_ok(rows: int, k: int, n: int) -> bool:
+    """Shape gate for :func:`int8_matmul_fast`: whole-K blocks need
+    tile-aligned dims and must fit VMEM."""
+    bn = _fast_bn(n)
+    return (bn is not None and k % 128 == 0 and rows <= 64
+            and k * bn <= 4 * 1024 * 1024        # int8 weight block
+            and k <= 8192)
+
+
+def _fast_kernel(x_ref, q_ref, scale_ref, out_ref):
+    from jax.experimental import pallas as pl  # noqa: F401
+
+    w = q_ref[:].astype(jnp.bfloat16)
+    acc = jnp.dot(x_ref[:].astype(jnp.bfloat16), w,
+                  preferred_element_type=jnp.float32)
+    out_ref[:] = (acc * scale_ref[:]).astype(out_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def int8_matmul_fast(x, q, scale, interpret: bool = False):
+    """Whole-K fused dequant matmul for decode-sized batches.
+
+    Unlike :func:`int8_matmul` it never reshapes or pads the WEIGHT at call
+    time — inside a lax.scan body (the decode block) any pad/reshape of q
+    copies the whole matrix every iteration, which is how the first
+    in-model attempt ran 100x slower than XLA.  Only the tiny activation
+    pads.  Requires :func:`fast_path_ok` shapes.
+    """
+    from jax.experimental import pallas as pl
+
+    B, K = x.shape
+    N = q.shape[1]
+    bn = _fast_bn(N)
+    assert bn is not None and K % 128 == 0, (K, N)
+    Bp = -(-max(B, 16) // 16) * 16
+    if B < Bp:
+        x = jnp.pad(x, ((0, Bp - B), (0, 0)))
+    out = pl.pallas_call(
+        _fast_kernel,
+        grid=(N // bn,),
+        in_specs=[
+            pl.BlockSpec((Bp, K), lambda n: (0, 0)),
+            pl.BlockSpec((K, bn), lambda n: (0, n)),
+            pl.BlockSpec((1, bn), lambda n: (0, n)),
+        ],
+        out_specs=pl.BlockSpec((Bp, bn), lambda n: (0, n)),
+        out_shape=jax.ShapeDtypeStruct((Bp, N), x.dtype),
+        interpret=interpret,
+    )(x, q, scale.reshape(1, N).astype(jnp.float32))
+    return out[:B]
+
+
 def int8_matmul_reference(x, q, scale):
     """jnp reference (the XLA-dequant path) for parity tests/fallback."""
     w = q.astype(jnp.float32) * scale[None, :]

@@ -53,7 +53,7 @@ class Linear(OpDef):
     def forward(self, params, inputs, attrs, ctx):
         (x,) = inputs
         if "kernel_q" in params:
-            y = self._quantized_matmul(params, x)
+            y = self._quantized_matmul(params, x, ctx)
         else:
             w = params["kernel"].astype(x.dtype)
             y = jnp.einsum("...i,io->...o", x, w,
@@ -63,7 +63,7 @@ class Linear(OpDef):
         return [apply_activation(y, attrs.get("activation", ActiMode.NONE))]
 
     @staticmethod
-    def _quantized_matmul(params, x):
+    def _quantized_matmul(params, x, ctx=None):
         """Weight-only-quantized forward.  On TPU, int8 goes through the
         Pallas fused-dequant kernel so weights stream int8 from HBM (the
         XLA dequant materializes the full-precision matrix — and compiles
@@ -74,24 +74,27 @@ class Linear(OpDef):
         import os
 
         scale = params["kernel_scale"]
-        # opt-in: per-instance Mosaic compilation through the tunneled
-        # backend is currently minutes per kernel, so the fused path is
-        # enabled explicitly (FF_PALLAS_INT8=1) until compile caching
-        # amortizes it
         rows = 1
         for s in x.shape[:-1]:
             rows *= int(s)
-        # decode-sized batches only: the kernel keeps the whole batch in
-        # one VMEM block, so prefill-sized row counts would blow VMEM
-        if (scale.ndim == 1 and rows <= 64
-                and os.environ.get("FF_PALLAS_INT8") == "1"):
-            from ..kernels.quant_matmul import (int8_matmul,
+        # decode-sized batches with tile-aligned dims take the whole-K
+        # Pallas kernel by default (FF_PALLAS_INT8=0 opts out); the kernel
+        # keeps the whole batch in one VMEM block, so prefill-sized row
+        # counts and unaligned shapes fall back to the XLA dequant.
+        # Mesh-sharded steps also fall back: pallas_call has no GSPMD
+        # partitioning rule, so under tp it would gather the full weight
+        if (scale.ndim == 1
+                and (ctx is None or getattr(ctx, "mesh", None) is None)
+                and os.environ.get("FF_PALLAS_INT8") != "0"):
+            from ..kernels.quant_matmul import (fast_path_ok,
+                                                int8_matmul_fast,
                                                 pallas_tpu_available)
 
-            if pallas_tpu_available():
-                q = params["kernel_q"]
+            q = params["kernel_q"]
+            if (pallas_tpu_available()
+                    and fast_path_ok(rows, q.shape[0], q.shape[1])):
                 lead = x.shape[:-1]
-                y2 = int8_matmul(x.reshape(-1, x.shape[-1]), q, scale)
+                y2 = int8_matmul_fast(x.reshape(-1, x.shape[-1]), q, scale)
                 return y2.reshape(*lead, q.shape[1])
         w = dequantize_kernel(params, x.dtype)
         return jnp.einsum("...i,io->...o", x, w,

@@ -154,6 +154,18 @@ class InferenceManager:
                     pspecs[ln][pn] if tp > 1 else PartitionSpec())
                      for pn, v in lp.items()}
                 for ln, lp in model.params.items()}
+        else:
+            # single-device: COMMIT host (numpy, e.g. HF-loaded) weights to
+            # the device once — numpy args to a jitted step re-transfer on
+            # every call, which over a network-attached chip costs more
+            # than the step itself; offloaded weights keep their memory
+            # kind
+            model.params = {
+                ln: {pn: (v if getattr(getattr(v, "sharding", None),
+                                       "memory_kind", None)
+                          not in (None, "device") else jax.device_put(v))
+                     for pn, v in lp.items()}
+                for ln, lp in model.params.items()}
 
         # KV caches per serving-attention layer (reference: allocated in
         # attention init, inc_multihead_self_attention.cu:1226+).  The

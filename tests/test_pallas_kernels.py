@@ -8,7 +8,8 @@ import pytest
 import jax
 import jax.numpy as jnp
 
-from flexflow_tpu.kernels.quant_matmul import (int8_matmul,
+from flexflow_tpu.kernels.quant_matmul import (fast_path_ok, int8_matmul,
+                                               int8_matmul_fast,
                                                int8_matmul_reference)
 
 
@@ -27,6 +28,30 @@ def test_int8_matmul_matches_reference(B, K, N):
     assert np.abs(got - want).max() / denom < 2e-2
 
 
+@pytest.mark.parametrize("B,K,N", [(8, 2048, 5504), (8, 256, 384),
+                                   (3, 1024, 512)])
+def test_int8_matmul_fast_matches_reference(B, K, N):
+    """The whole-K decode kernel (no weight pads at call time — safe
+    inside lax.scan) matches the dequant reference."""
+    assert fast_path_ok(B, K, N)
+    key = jax.random.PRNGKey(0)
+    x = jax.random.normal(key, (B, K), jnp.float32)
+    q = jax.random.randint(key, (K, N), -127, 128, jnp.int8)
+    scale = jnp.abs(jax.random.normal(key, (N,), jnp.float32)) * 0.02 + 1e-3
+    got = np.asarray(int8_matmul_fast(x, q, scale, interpret=True),
+                     np.float32)
+    want = np.asarray(int8_matmul_reference(x, q, scale), np.float32)
+    denom = np.abs(want).max() + 1e-9
+    assert np.abs(got - want).max() / denom < 2e-2
+
+
+def test_fast_path_gate():
+    assert not fast_path_ok(8, 2048, 130)      # N not tile-aligned
+    assert not fast_path_ok(8, 100, 512)       # K not 128-aligned
+    assert not fast_path_ok(128, 2048, 512)    # prefill-sized batch
+    assert not fast_path_ok(8, 16384, 512)     # VMEM block too large
+
+
 def test_int8_matmul_zero_scale_padding():
     # padded output channels must not leak into the sliced result
     key = jax.random.PRNGKey(1)
@@ -39,18 +64,23 @@ def test_int8_matmul_zero_scale_padding():
     np.testing.assert_allclose(got, want, rtol=2e-2, atol=0.5)
 
 
-def test_linear_op_pallas_gate(monkeypatch):
-    """The in-model fused path is opt-in (FF_PALLAS_INT8) and falls back
-    to the XLA dequant path by default."""
+@pytest.mark.parametrize("env", [None, "0"])
+def test_linear_op_pallas_gate(monkeypatch, env):
+    """The fused path is default-ON but guarded: FF_PALLAS_INT8=0 opts
+    out, non-TPU platforms and unaligned shapes fall back to XLA dequant —
+    either way the quantized forward stays correct."""
     from flexflow_tpu import FFConfig, Model
     from flexflow_tpu.quantization import quantize_model_params
 
-    m = Model(FFConfig(batch_size=4), name="pallas_gate")
+    m = Model(FFConfig(batch_size=4), name=f"pallas_gate_{env}")
     x = m.create_tensor((4, 64), name="x")
     m.dense(x, 32)
     m.params = m.init_params(jax.random.PRNGKey(0))
     ref = np.asarray(m.apply(m.params, np.ones((4, 64), np.float32)))
     quantize_model_params(m, "int8")
-    monkeypatch.delenv("FF_PALLAS_INT8", raising=False)
+    if env is None:
+        monkeypatch.delenv("FF_PALLAS_INT8", raising=False)
+    else:
+        monkeypatch.setenv("FF_PALLAS_INT8", env)
     got = np.asarray(m.apply(m.params, np.ones((4, 64), np.float32)))
     np.testing.assert_allclose(got, ref, rtol=0.05, atol=0.05)
