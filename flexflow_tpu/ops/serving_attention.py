@@ -134,6 +134,20 @@ class _ServingAttentionBase(OpDef):
 
     # ------------------------------------------------------------ helpers
     def _project_qkv(self, params, x, attrs):
+        if "wqkv" in params:
+            # fused projection (InferenceManager.fuse_qkv): one matmul
+            # instead of three — decode at small batch is per-kernel
+            # floor-bound, so kernel count is throughput.  The reference
+            # stores attention weights fused the same way
+            # (file_loader.cc:209 loads one qkv tensor).
+            h = attrs["num_q_heads"]
+            kv = attrs["num_kv_heads"]
+            qkv = jnp.einsum("rce,ehd->rchd", x,
+                             params["wqkv"].astype(x.dtype))
+            if attrs.get("qkv_bias", False):
+                qkv = qkv + params["bqkv"].astype(qkv.dtype)
+            return (qkv[:, :, :h], qkv[:, :, h:h + kv],
+                    qkv[:, :, h + kv:])
         q = jnp.einsum("rce,ehd->rchd", x, resolve_weight(params, "wq", x.dtype))
         k = jnp.einsum("rce,ehd->rchd", x, resolve_weight(params, "wk", x.dtype))
         v = jnp.einsum("rce,ehd->rchd", x, resolve_weight(params, "wv", x.dtype))

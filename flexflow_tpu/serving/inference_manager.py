@@ -84,6 +84,29 @@ def _param_pspecs(model) -> Dict[str, Dict[str, PartitionSpec]]:
     return specs
 
 
+def fuse_qkv(model) -> None:
+    """Concatenate each serving-attention layer's wq/wk/wv ([E,H,D] +
+    2x[E,KV,D]) into one wqkv [E,H+2KV,D] (and biases into bqkv) so the
+    projection is a single matmul.  Single-device only: under tp the
+    q and kv heads shard at different granularities, and quantized
+    attention keeps its per-weight scales — both skip the fusion."""
+    for layer in model.layers:
+        if layer.op_type not in SERVING_ATTENTION_OPS:
+            continue
+        lp = model.params.get(layer.name)
+        if lp is None or "wq" not in lp or "wq_q" in lp:
+            continue
+        fused = dict(lp)
+        fused["wqkv"] = jnp.concatenate(
+            [jnp.asarray(fused.pop(n)) for n in ("wq", "wk", "wv")],
+            axis=1)
+        if "bq" in fused:
+            fused["bqkv"] = jnp.concatenate(
+                [jnp.asarray(fused.pop(n)) for n in ("bq", "bk", "bv")],
+                axis=0)
+        model.params[layer.name] = fused
+
+
 class InferenceManager:
     """Compiles models for serving and runs per-step inference
     (reference: include/flexflow/request_manager.h:31 InferenceManager)."""
@@ -155,11 +178,15 @@ class InferenceManager:
                      for pn, v in lp.items()}
                 for ln, lp in model.params.items()}
         else:
-            # single-device: COMMIT host (numpy, e.g. HF-loaded) weights to
-            # the device once — numpy args to a jitted step re-transfer on
-            # every call, which over a network-attached chip costs more
-            # than the step itself; offloaded weights keep their memory
-            # kind
+            # single-device: fuse each attention layer's q/k/v projections
+            # into one weight (decode is per-kernel floor-bound; one
+            # matmul replaces three — the layout the reference's loader
+            # uses, file_loader.cc:209), then COMMIT host (numpy, e.g.
+            # HF-loaded) weights to the device once — numpy args to a
+            # jitted step re-transfer on every call, which over a
+            # network-attached chip costs more than the step itself;
+            # offloaded weights keep their memory kind
+            fuse_qkv(model)
             model.params = {
                 ln: {pn: (v if getattr(getattr(v, "sharding", None),
                                        "memory_kind", None)

@@ -100,6 +100,20 @@ class TestLlamaHFAlignment:
         assert req.tokens[req.prompt_len:] == want
 
 
+    def test_qkv_fusion_applied(self):
+        """Single-device compile must actually fuse wq/wk/wv into wqkv
+        (decode is per-kernel floor-bound — a silent guard bail would
+        regress throughput with no output change to catch it)."""
+        hf, _ = _hf_tiny_llama()
+        model, _ = _build_ff_llama(hf)
+        im = InferenceManager(model.config)
+        im.compile_model_and_allocate_buffer(
+            model, max_requests=2, max_seq_length=32,
+            cache_dtype=np.float32)
+        attn = model.params["layers_0_attention"]
+        assert "wqkv" in attn and "wq" not in attn
+
+
 class TestContinuousBatching:
     def test_late_arrivals_join_running_batch(self):
         """Requests registered mid-flight get admitted into free slots and
