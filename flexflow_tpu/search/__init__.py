@@ -19,6 +19,9 @@ from .pcg import (PCG, Edge, ShardAssignment, assign_pipeline_stages,
                   strategy_from_json, strategy_to_json)
 from .substitution import (base_optimize, generic_sequence_optimize,
                            mcmc_optimize, node_choices)
+from .substitution_loader import (Rule, RuleCollection, RuleSchemaError,
+                                  collection_choice_hints, find_matches,
+                                  load_rule_collection)
 
 __all__ = [
     "CostMetrics", "MachineModel", "SimpleMachineModel",
@@ -27,7 +30,9 @@ __all__ = [
     "assign_pipeline_stages", "data_parallel_strategy",
     "export_strategy_dot", "strategy_to_json", "strategy_from_json",
     "base_optimize", "generic_sequence_optimize", "mcmc_optimize",
-    "node_choices", "graph_optimize",
+    "node_choices", "graph_optimize", "Rule", "RuleCollection",
+    "RuleSchemaError", "collection_choice_hints", "find_matches",
+    "load_rule_collection",
 ]
 
 
@@ -36,7 +41,8 @@ def graph_optimize(model, machine: Optional[MachineModel] = None,
                    budget: int = 2000, alpha: float = 1.05,
                    memory_limit: Optional[int] = None,
                    only_data_parallel: bool = False,
-                   use_mcmc: bool = False, seed: int = 0
+                   use_mcmc: bool = False, seed: int = 0,
+                   substitution_json: Optional[str] = None
                    ) -> Tuple[Dict[str, ShardAssignment], CostMetrics]:
     """Find a per-layer sharding strategy (reference graph_optimize_task,
     graph.cc:2108).
@@ -67,6 +73,30 @@ def graph_optimize(model, machine: Optional[MachineModel] = None,
     search = mcmc_optimize if use_mcmc else generic_sequence_optimize
     kwargs = (dict(iterations=budget, seed=seed) if use_mcmc
               else dict(budget=budget, alpha=alpha))
+    if substitution_json:
+        # the reference's --substitution-json appends JSON xfers to an
+        # always-generated base set (substitution.cc:1787-1800).  In the
+        # sharding-collapsed search the base set is already maximal over
+        # (dp, tp) degrees and the rules' algebraic parallel-op
+        # identities are rewrites GSPMD performs mechanically — so the
+        # collection is loaded and validated (schema errors surface
+        # here, like the reference loader's), and licenses referencing
+        # op types with no tp lowering are reported
+        import warnings
+
+        hints = collection_choice_hints(
+            load_rule_collection(substitution_json))
+        from .pcg import TP_CAPABLE
+
+        unlowerable = sorted(
+            t.value for t, hs in hints.items()
+            if t not in TP_CAPABLE
+            and any(k == "partition" and dim > 0 for k, dim, _ in hs))
+        if unlowerable:
+            warnings.warn(
+                f"substitution rules license partitioning for op types "
+                f"without a tensor-parallel lowering (ignored): "
+                f"{unlowerable}")
 
     strategy, _ = search(pcg, machine, num_devices, **kwargs)
     cost = pcg.strategy_cost(strategy, machine)

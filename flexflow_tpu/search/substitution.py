@@ -37,7 +37,16 @@ def _factor_pairs(n: int) -> List[Tuple[int, int]]:
 
 def node_choices(layer, num_devices: int) -> List[ShardAssignment]:
     """Legal assignments for one node (reference create_xfers,
-    substitution.cc:1675: partition/replicate wrappers per degree)."""
+    substitution.cc:1675: partition/replicate wrappers per degree).
+
+    This space is already MAXIMAL over (dp, tp) degree combinations for
+    every op with a tp lowering, which is why a loaded substitution-rule
+    collection (--substitution-json analogue) does not alter it: the
+    reference appends JSON xfers to an always-generated base set
+    (substitution.cc:1787-1800), and in the sharding-collapsed search the
+    base set subsumes any degree a rule could license, while the rules'
+    algebraic parallel-op identities are rewrites GSPMD performs
+    mechanically (see search.graph_optimize / substitution_loader)."""
     choices = [ShardAssignment(dp=d)
                for d in _divisors(num_devices)]
     if layer.op_type in TP_CAPABLE and layer.param_specs:

@@ -1,0 +1,306 @@
+"""Loader for TASO-style substitution rule collections (JSON).
+
+TPU-native equivalent of the reference's substitution loader
+(src/runtime/substitution_loader.cc; schema exemplified by
+substitutions/test_subst.json, shipped collection
+substitutions/graph_subst_3_v2.json with 640 generated rules; unit test
+tests/unit/test_substitution_loader.cc).
+
+Schema (reference substitution_loader.h):
+    RuleCollection { "_t": "RuleCollection", "rule": [Rule] }
+    Rule   { "_t": "Rule", "name", "srcOp": [Operator], "dstOp": [Operator],
+             "mappedOutput": [MapOutput] }
+    Operator { "_t": "Operator", "type": "OP_*", "para": [Parameter],
+               "input": [Tensor] }
+    Tensor { "_t": "Tensor", "opId", "tsId" }   # opId < 0: external input
+    Parameter { "_t": "Parameter", "key": "PM_*", "value": int }
+
+How the rules act here: the reference applies a matched rule by literally
+rewriting the PCG — inserting Repartition/Combine/Replicate/Reduction ops
+(GraphXfer::run, substitution.cc:791) — and a provided --substitution-json
+APPENDS its xfers to an always-generated base set
+(substitution.cc:1787-1800).  Under GSPMD those parallel ops are implied
+by sharding annotations and the sharding-collapsed search space is
+already maximal over (dp, tp) degrees, so a loaded collection cannot add
+choices the base lacks, and its algebraic parallel-op identities are
+rewrites the XLA partitioner performs mechanically.  graph_optimize
+therefore loads + validates the collection (schema errors surface like
+the reference loader's) and WARNS about licenses it cannot lower —
+strategies are unchanged by design, an invariant the tests pin.
+:func:`collection_choice_hints` distills the licenses;
+:func:`find_matches` embeds src patterns into a PCG.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..fftype import OpType
+
+# reference ffconst op-type names -> our OpType (subset that appears in
+# rule files).  Unmapped types are kept as raw strings for inspection but
+# match nothing in find_matches (matching requires a mapped op type)
+_OP_TYPE_MAP = {
+    "OP_LINEAR": OpType.LINEAR,
+    "OP_CONV2D": OpType.CONV2D,
+    "OP_EW_ADD": OpType.EW_ADD,
+    "OP_EW_MUL": OpType.EW_MUL,
+    "OP_RELU": OpType.RELU,
+    "OP_CONCAT": OpType.CONCAT,
+    "OP_SPLIT": OpType.SPLIT,
+    "OP_RESHAPE": OpType.RESHAPE,
+    "OP_TRANSPOSE": OpType.TRANSPOSE,
+    "OP_SOFTMAX": OpType.SOFTMAX,
+    "OP_MULTIHEAD_ATTENTION": OpType.MULTIHEAD_ATTENTION,
+    "OP_EMBEDDING": OpType.EMBEDDING,
+    "OP_MATMUL": OpType.BATCH_MATMUL,
+    "OP_BATCHMATMUL": OpType.BATCH_MATMUL,
+    "OP_PARTITION": OpType.REPARTITION,
+    "OP_REPARTITION": OpType.REPARTITION,
+    "OP_COMBINE": OpType.COMBINE,
+    "OP_REPLICATE": OpType.REPLICATE,
+    "OP_REDUCE": OpType.REDUCTION,
+    "OP_REDUCTION": OpType.REDUCTION,
+    "OP_PIPELINE": None,
+    "OP_NOOP": OpType.NOOP,
+}
+
+PARALLEL_TYPES = {"OP_PARTITION", "OP_REPARTITION", "OP_COMBINE",
+                  "OP_REPLICATE", "OP_REDUCE", "OP_REDUCTION"}
+
+
+@dataclasses.dataclass(frozen=True)
+class TensorRef:
+    """reference substitution_loader.h Tensor: opId < 0 names the
+    (-opId)-th external input; opId >= 0 indexes the pattern's op list."""
+
+    op_id: int
+    ts_id: int
+
+
+@dataclasses.dataclass
+class PatternOp:
+    type_name: str                       # raw "OP_*" string
+    op_type: Optional[OpType]            # mapped, if known
+    inputs: List[TensorRef]
+    params: Dict[str, int]               # "PM_*" -> value
+
+
+@dataclasses.dataclass
+class MapOutput:
+    src_op_id: int
+    src_ts_id: int
+    dst_op_id: int
+    dst_ts_id: int
+
+
+@dataclasses.dataclass
+class Rule:
+    name: str
+    src_ops: List[PatternOp]
+    dst_ops: List[PatternOp]
+    mapped_outputs: List[MapOutput]
+
+
+@dataclasses.dataclass
+class RuleCollection:
+    rules: List[Rule]
+
+
+class RuleSchemaError(ValueError):
+    pass
+
+
+def _parse_op(d: dict) -> PatternOp:
+    if d.get("_t") != "Operator":
+        raise RuleSchemaError(f"expected Operator, got {d.get('_t')!r}")
+    t = d["type"]
+    params = {}
+    for p in d.get("para", []):
+        if p.get("_t") != "Parameter":
+            raise RuleSchemaError(f"expected Parameter, got {p.get('_t')!r}")
+        params[p["key"]] = int(p["value"])
+    inputs = []
+    for i in d.get("input", []):
+        if i.get("_t") != "Tensor":
+            raise RuleSchemaError(f"expected Tensor, got {i.get('_t')!r}")
+        inputs.append(TensorRef(int(i["opId"]), int(i["tsId"])))
+    return PatternOp(t, _OP_TYPE_MAP.get(t), inputs, params)
+
+
+def _validate_pattern(ops: List[PatternOp], where: str) -> None:
+    """Mirror of the reference loader's sanity checks
+    (tests/unit/test_substitution_loader.cc): every non-external input
+    must reference an EARLIER op in the same pattern (patterns are
+    topologically ordered DAGs)."""
+    for idx, op in enumerate(ops):
+        for ref in op.inputs:
+            if ref.op_id >= idx:
+                raise RuleSchemaError(
+                    f"{where}: op {idx} input references op {ref.op_id} "
+                    f"(patterns must be topologically ordered)")
+
+
+def parse_rule(d: dict) -> Rule:
+    if d.get("_t") != "Rule":
+        raise RuleSchemaError(f"expected Rule, got {d.get('_t')!r}")
+    src = [_parse_op(o) for o in d["srcOp"]]
+    dst = [_parse_op(o) for o in d["dstOp"]]
+    _validate_pattern(src, f"rule {d.get('name')!r} srcOp")
+    _validate_pattern(dst, f"rule {d.get('name')!r} dstOp")
+    mapped = []
+    for m in d.get("mappedOutput", []):
+        if m.get("_t") != "MapOutput":
+            raise RuleSchemaError(f"expected MapOutput, got {m.get('_t')!r}")
+        mo = MapOutput(int(m["srcOpId"]), int(m["srcTsId"]),
+                       int(m["dstOpId"]), int(m["dstTsId"]))
+        if not (0 <= mo.src_op_id < len(src)):
+            raise RuleSchemaError(
+                f"rule {d.get('name')!r}: mappedOutput srcOpId "
+                f"{mo.src_op_id} out of range")
+        if not (0 <= mo.dst_op_id < len(dst)):
+            raise RuleSchemaError(
+                f"rule {d.get('name')!r}: mappedOutput dstOpId "
+                f"{mo.dst_op_id} out of range")
+        mapped.append(mo)
+    return Rule(d.get("name", "<unnamed>"), src, dst, mapped)
+
+
+def load_rule_collection(path: str) -> RuleCollection:
+    """Load + validate a rule collection JSON (reference
+    load_rule_collection, substitution_loader.cc; CLI flag
+    --substitution-json).  All schema problems — including missing
+    required keys — surface as :class:`RuleSchemaError`."""
+    with open(path) as f:
+        d = json.load(f)
+    if d.get("_t") != "RuleCollection":
+        raise RuleSchemaError(
+            f"expected RuleCollection, got {d.get('_t')!r}")
+    try:
+        return RuleCollection([parse_rule(r) for r in d.get("rule", [])])
+    except KeyError as e:
+        raise RuleSchemaError(f"missing required key {e}") from e
+
+
+# ------------------------------------------------------------------ match
+def find_matches(rule: Rule, pcg) -> List[Dict[int, str]]:
+    """All embeddings of ``rule.src_ops`` into the PCG: maps pattern op
+    index -> node name.  Structural matching on op type + dataflow edges
+    (the reference's GraphXfer::create_operator_from_pb + match,
+    substitution.cc:791+); parallel-op pattern nodes have no PCG
+    counterpart here (shardings are implicit) so rules containing them in
+    src match nothing — they act through :func:`collection_choice_hints`.
+    """
+    n_pat = len(rule.src_ops)
+    if any(op.type_name in PARALLEL_TYPES for op in rule.src_ops):
+        return []
+    out: List[Dict[int, str]] = []
+    nodes = pcg.nodes
+
+    def _src_key(tensor):
+        """Identity of a tensor: (producer, output index) for internal
+        edges, ("__input__", name) for graph inputs."""
+        if tensor.owner_layer is None:
+            return ("__input__", tensor.name)
+        return (tensor.owner_layer.name, tensor.owner_idx)
+
+    def compatible(p_idx: int, node, assign: Dict[int, str],
+                   ext: Dict[int, tuple]) -> Optional[Dict[int, tuple]]:
+        """None if incompatible; else the external-input bindings this
+        node adds (a pattern reusing opId -1 twice must see the SAME
+        actual tensor both times)."""
+        pat = rule.src_ops[p_idx]
+        if pat.op_type is None or node.op_type is not pat.op_type:
+            return None
+        if len(pat.inputs) > len(node.inputs):
+            return None
+        commutative = pat.op_type in (OpType.EW_ADD, OpType.EW_MUL)
+        orders = ([list(range(len(pat.inputs)))] if not commutative
+                  else [[0, 1], [1, 0]])
+        for order in orders:
+            new_ext: Dict[int, tuple] = {}
+            ok = True
+            for slot, ref in zip(order, pat.inputs):
+                actual = _src_key(node.inputs[slot])  # positional (like
+                if ref.op_id < 0:                     # the reference's
+                    bound = ext.get(ref.op_id,        # Operator inputs),
+                                    new_ext.get(ref.op_id))
+                    if bound is not None and bound != actual:
+                        ok = False                    # plus the swapped
+                        break                         # order for
+                    new_ext[ref.op_id] = actual       # commutative ops
+                else:
+                    want = assign.get(ref.op_id)
+                    if want is None or actual != (want, ref.ts_id):
+                        ok = False
+                        break
+            if ok:
+                return new_ext
+        return None
+
+    def backtrack(p_idx: int, assign: Dict[int, str], used: Set[str],
+                  ext: Dict[int, tuple]):
+        if p_idx == n_pat:
+            out.append(dict(assign))
+            return
+        for node in nodes:
+            if node.name in used:
+                continue
+            new_ext = compatible(p_idx, node, assign, ext)
+            if new_ext is not None:
+                assign[p_idx] = node.name
+                used.add(node.name)
+                backtrack(p_idx + 1, assign, used, {**ext, **new_ext})
+                used.remove(node.name)
+                del assign[p_idx]
+
+    backtrack(0, {}, set(), {})
+    return out
+
+
+# ------------------------------------------------------------ integration
+def collection_choice_hints(collection: RuleCollection
+                            ) -> Dict[OpType, Set[Tuple[str, int, int]]]:
+    """Distill a collection into per-op-type parallelization licenses.
+
+    A rule whose dst pattern wraps an op O with OP_PARTITION (dim k,
+    degree d) / OP_REPLICATE producers asserts "O admits that
+    parallelization" — what the reference's xfers encode (create_xfers,
+    substitution.cc:1368-1382).  Returns {op_type: {(kind, dim, degree)}}
+    with kind in {"partition", "replicate"}; dim 0 is the batch dim (a
+    data-parallel rewrite), dim > 0 licenses weight/feature sharding (tp).
+    The strategy search treats a provided collection the way the
+    reference treats --substitution-json: it REPLACES the generated xfer
+    set, restricting tp choices to licensed degrees
+    (search/substitution.py node_choices).
+    """
+    hints: Dict[OpType, Set[Tuple[str, int, int]]] = {}
+    for rule in collection.rules:
+        # dataflow: a tensor is partitioned once it passes OP_PARTITION
+        # and stays partitioned through compute ops until OP_COMBINE /
+        # OP_REDUCE — so an op deep in the dst pattern (e.g. a LINEAR fed
+        # by another LINEAR fed by the partition) is licensed too, which
+        # is how the reference's multi-op rules express it
+        state: Dict[int, Optional[Tuple[str, int, int]]] = {}
+        for i, op in enumerate(rule.dst_ops):
+            deg = op.params.get("PM_PARALLEL_DEGREE", 0)
+            dim = op.params.get("PM_PARALLEL_DIM", 0)
+            if op.type_name in ("OP_PARTITION", "OP_REPARTITION"):
+                state[i] = ("partition", dim, deg) if deg > 1 else None
+                continue
+            if op.type_name == "OP_REPLICATE":
+                state[i] = ("replicate", 0, deg) if deg > 1 else None
+                continue
+            if op.type_name in PARALLEL_TYPES:   # combine/reduce: undone
+                state[i] = None
+                continue
+            inherited = next(
+                (state.get(r.op_id) for r in op.inputs
+                 if r.op_id >= 0 and state.get(r.op_id) is not None),
+                None)
+            state[i] = inherited
+            if inherited is not None and op.op_type is not None:
+                hints.setdefault(op.op_type, set()).add(inherited)
+    return hints

@@ -1,0 +1,129 @@
+"""Substitution-rule JSON loader tests (reference:
+tests/unit/test_substitution_loader.cc over the substitutions/*.json
+schema; rules widen the strategy search like --substitution-json)."""
+
+import json
+import os
+
+import pytest
+
+from flexflow_tpu import FFConfig, Model
+from flexflow_tpu.fftype import ActiMode, OpType
+from flexflow_tpu.search import (PCG, RuleSchemaError,
+                                 collection_choice_hints, find_matches,
+                                 graph_optimize, load_rule_collection)
+
+FIXTURE = os.path.join(os.path.dirname(__file__), "fixtures",
+                       "substitutions.json")
+
+
+def _load():
+    return load_rule_collection(FIXTURE)
+
+
+class TestLoader:
+    def test_load_and_map_types(self):
+        col = _load()
+        assert len(col.rules) == 2
+        r = col.rules[0]
+        assert r.name == "partition_ew_add_combine"
+        assert r.src_ops[0].op_type is OpType.EW_ADD
+        assert r.dst_ops[0].op_type is OpType.REPARTITION
+        assert r.dst_ops[0].params["PM_PARALLEL_DEGREE"] == 2
+        assert r.mapped_outputs[0].dst_op_id == 3
+
+    @pytest.mark.parametrize("mutate,match", [
+        (lambda d: d.update(_t="Nope"), "RuleCollection"),
+        (lambda d: d["rule"][0].update(_t="Nope"), "Rule"),
+        (lambda d: d["rule"][0]["srcOp"][0].update(_t="Nope"), "Operator"),
+        (lambda d: d["rule"][0]["mappedOutput"][0].update(dstOpId=99),
+         "out of range"),
+    ])
+    def test_schema_violations_raise(self, tmp_path, mutate, match):
+        d = json.load(open(FIXTURE))
+        mutate(d)
+        p = tmp_path / "bad.json"
+        p.write_text(json.dumps(d))
+        with pytest.raises(RuleSchemaError, match=match):
+            load_rule_collection(str(p))
+
+    def test_forward_reference_rejected(self, tmp_path):
+        """Patterns must be topologically ordered (the reference loader's
+        DAG sanity check)."""
+        d = json.load(open(FIXTURE))
+        op = d["rule"][0]["dstOp"][0]
+        op["input"] = [{"_t": "Tensor", "opId": 3, "tsId": 0}]
+        p = tmp_path / "fwd.json"
+        p.write_text(json.dumps(d))
+        with pytest.raises(RuleSchemaError, match="topologically"):
+            load_rule_collection(str(p))
+
+
+def _two_branch_model():
+    m = Model(FFConfig(batch_size=4), name="subst_match")
+    x = m.create_tensor((4, 32), name="x")
+    a = m.dense(x, 32, activation=ActiMode.RELU, name="da")
+    b = m.dense(x, 32, name="db")
+    m.add(a, b, name="sum")
+    m.dense(m.relu(m.dense(b, 32, name="lin1"), name="r1"), 8, name="head")
+    return m
+
+
+class TestMatching:
+    def test_find_matches_single_op(self):
+        col = _load()
+        pcg = PCG(_two_branch_model())
+        matches = find_matches(col.rules[0], pcg)  # EW_ADD pattern
+        assert [mm[0] for mm in matches] == ["sum"]
+
+    def test_find_matches_chain(self):
+        col = _load()
+        pcg = PCG(_two_branch_model())
+        matches = find_matches(col.rules[1], pcg)  # LINEAR -> RELU
+        assert {(mm[0], mm[1]) for mm in matches} == {("lin1", "r1")}
+
+
+class TestSearchIntegration:
+    def test_hints_propagate_through_dst_dataflow(self):
+        """Partitioned-ness flows through compute ops until a combine —
+        the reference's multi-op rules license every op on the
+        partitioned path, not just the partition's direct consumer."""
+        col = _load()
+        hints = collection_choice_hints(col)
+        assert ("partition", 1, 2) in hints[OpType.EW_ADD]
+        # PARTITION -> LINEAR -> RELU -> COMBINE: both compute ops licensed
+        assert ("partition", 1, 4) in hints[OpType.LINEAR]
+        assert ("partition", 1, 4) in hints[OpType.RELU]
+
+    def test_reference_collection_loads(self):
+        """The reference's shipped 640-rule file parses and distills."""
+        path = "/root/reference/substitutions/graph_subst_3_v2.json"
+        if not os.path.exists(path):
+            pytest.skip("reference tree not available")
+        col = load_rule_collection(path)
+        assert len(col.rules) == 640
+        hints = collection_choice_hints(col)
+        assert hints  # algebraic identities still yield some licenses
+
+    def test_missing_key_raises_schema_error(self, tmp_path):
+        d = json.load(open(FIXTURE))
+        del d["rule"][0]["srcOp"][0]["type"]
+        p = tmp_path / "nokey.json"
+        p.write_text(json.dumps(d))
+        with pytest.raises(RuleSchemaError, match="missing required key"):
+            load_rule_collection(str(p))
+
+    def test_graph_optimize_substitution_json_invariant(self):
+        """Documented invariant: the sharding-collapsed search space is
+        already maximal, so a loaded collection must not CHANGE the found
+        strategy (the reference appends JSON xfers to a generated base
+        set; here the base subsumes them) — but licenses for op types
+        with no tp lowering are reported."""
+        want, _ = graph_optimize(_two_branch_model(), num_devices=4,
+                                 budget=50)
+        with pytest.warns(UserWarning, match="without a tensor-parallel"):
+            got, cost = graph_optimize(_two_branch_model(), num_devices=4,
+                                       budget=50,
+                                       substitution_json=FIXTURE)
+        assert got == want
+        assert cost.total_time > 0
