@@ -39,6 +39,23 @@ SERVING_ATTENTION_OPS = (
 )
 
 
+def cache_pspec(sp: int, tp: int) -> PartitionSpec:
+    """The KV cache layout [rows, length, kv_heads, head_dim]: length
+    shards over 'sp', heads over 'tp'.  Single source for the plain and
+    pipeline-stage paths."""
+    return PartitionSpec(None, AXIS_SEQ if sp > 1 else None,
+                         AXIS_MODEL if tp > 1 else None, None)
+
+
+def pin_cache_layout(caches, mesh, spec):
+    """In-graph sharding constraint on updated caches — without it the
+    compiler may re-layout scan-carried or stage outputs, silently
+    dropping the sp/tp sharding."""
+    cs = NamedSharding(mesh, spec)
+    return jax.tree.map(
+        lambda c: jax.lax.with_sharding_constraint(c, cs), caches)
+
+
 def _device_put_preserving(v, mesh, spec):
     """device_put that keeps a pinned_host-resident weight's memory kind
     through resharding (the --offload contract)."""
@@ -144,11 +161,6 @@ class InferenceManager:
             model.params = model.init_params(jax.random.PRNGKey(cfg.seed))
 
         if pp > 1:
-            if sp > 1:
-                raise NotImplementedError(
-                    "sequence-parallel KV cache under pipeline-parallel "
-                    "serving: shard the length axis within each stage's "
-                    "submesh is future work; use sp with tp/dp only")
             return self._compile_pipeline_model(
                 model, mode, max_requests, max_seq_length, prefill_chunk,
                 beam_width, cache_dtype, model_id, rows, alloc_len)
@@ -204,9 +216,7 @@ class InferenceManager:
         caches = {}
         cache_sharding = None
         if mesh is not None:
-            cache_sharding = NamedSharding(mesh, PartitionSpec(
-                None, AXIS_SEQ if sp > 1 else None,
-                AXIS_MODEL if tp > 1 else None, None))
+            cache_sharding = NamedSharding(mesh, cache_pspec(sp, tp))
         for layer in model.layers:
             if layer.op_type in SERVING_ATTENTION_OPS:
                 a = layer.attrs
@@ -283,13 +293,8 @@ class InferenceManager:
             outs = [vals[(final.name, i)] for i in range(len(final.outputs))]
             new_caches = {**caches, **ctx.kv_cache_out}
             if record.get("cache_pspec") is not None:
-                # pin the cache layout: without the constraint the
-                # compiler may re-layout scan-carried caches onto one
-                # device, silently dropping the sp/tp sharding
-                cs = NamedSharding(record["mesh"], record["cache_pspec"])
-                new_caches = jax.tree.map(
-                    lambda c: jax.lax.with_sharding_constraint(c, cs),
-                    new_caches)
+                new_caches = pin_cache_layout(new_caches, record["mesh"],
+                                              record["cache_pspec"])
             return outs, new_caches
 
         return step

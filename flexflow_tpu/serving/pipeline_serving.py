@@ -25,7 +25,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
-from ..config import AXIS_MODEL
+from ..config import AXIS_MODEL, AXIS_SEQ
 from ..ops.registry import OpContext, get_op
 
 
@@ -129,17 +129,25 @@ def stage_boundaries(model, stages) -> List[List[Tuple]]:
     return needed
 
 
-def build_stage_meshes(config, pp: int, tp: int) -> List[Mesh]:
+def build_stage_meshes(config, pp: int, tp: int, sp: int = 1) -> List[Mesh]:
+    """Disjoint per-stage device subsets; each stage's submesh carries the
+    tp axis and, when sp > 1, an sp axis for the length-sharded KV cache
+    (sp x pp composition)."""
     config.validate()   # informative dp x tp x pp > num_devices error
     devs = list(config.devices)
-    if len(devs) < pp * tp:
+    per_stage = sp * tp
+    if len(devs) < pp * per_stage:
         raise ValueError(
-            f"pipeline serving needs pp({pp}) x tp({tp}) = {pp * tp} "
-            f"devices, have {len(devs)}")
+            f"pipeline serving needs pp({pp}) x sp({sp}) x tp({tp}) = "
+            f"{pp * per_stage} devices, have {len(devs)}")
     meshes = []
     for s in range(pp):
-        block = np.array(devs[s * tp:(s + 1) * tp])
-        meshes.append(Mesh(block, (AXIS_MODEL,)))
+        block = np.array(devs[s * per_stage:(s + 1) * per_stage])
+        if sp > 1:
+            meshes.append(Mesh(block.reshape(sp, tp),
+                               (AXIS_SEQ, AXIS_MODEL)))
+        else:
+            meshes.append(Mesh(block, (AXIS_MODEL,)))
     return meshes
 
 
@@ -185,6 +193,11 @@ def make_stage_step(record, stage_idx: int):
         vals = model.run_layers(params, feeds, ctx, inference=True,
                                 layers=layers, seed_vals=boundary)
         new_caches = {**caches, **ctx.kv_cache_out}
+        from .inference_manager import pin_cache_layout
+
+        new_caches = pin_cache_layout(new_caches,
+                                      record["pp_meshes"][stage_idx],
+                                      record["pp_cache_spec"])
         if last_stage:
             final = model.layers[-1]
             outs = [vals[(final.name, i)]
@@ -201,19 +214,22 @@ def compile_pipeline(im, record, model, cfg, cache_dtype, rows, alloc_len):
 
     pp = cfg.pipeline_parallelism_degree
     tp = cfg.tensor_parallelism_degree
+    sp = cfg.sequence_parallelism_degree
     stages = partition_stages(model, pp,
                               cost_balanced_stage_of_tid(model, pp, tp))
-    meshes = build_stage_meshes(cfg, pp, tp)
+    meshes = build_stage_meshes(cfg, pp, tp, sp)
     record["pp_stages"] = stages
     record["pp_meshes"] = meshes
     record["pp_boundaries"] = stage_boundaries(model, stages)
     record["pp_steps"] = {}
-
+    # sp x pp: the cache's length axis shards over each stage's sp axis
     from ..quantization import extend_quantized_pspecs
-    from .inference_manager import _device_put_preserving
+    from .inference_manager import _device_put_preserving, cache_pspec
+
+    cache_spec = cache_pspec(sp, tp)
+    record["pp_cache_spec"] = cache_spec
 
     pspecs = extend_quantized_pspecs(_param_pspecs(model), model.params)
-    rep = [NamedSharding(m, PartitionSpec()) for m in meshes]
     for s, ls in enumerate(stages):
         for layer in ls:
             lp = model.params.get(layer.name)
@@ -229,8 +245,7 @@ def compile_pipeline(im, record, model, cfg, cache_dtype, rows, alloc_len):
                 kv = a["num_kv_heads"]
                 d = a.get("head_dim") or a["embed_dim"] // a["num_q_heads"]
                 shape = (rows, alloc_len, kv, d)
-                csh = (NamedSharding(meshes[s], PartitionSpec(
-                    None, None, AXIS_MODEL, None)) if tp > 1 else rep[s])
+                csh = NamedSharding(meshes[s], cache_spec)
                 record["caches"][layer.name] = {
                     "k": jax.device_put(jnp.zeros(shape, cache_dtype), csh),
                     "v": jax.device_put(jnp.zeros(shape, cache_dtype), csh),

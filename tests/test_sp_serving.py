@@ -86,17 +86,36 @@ class TestSequenceParallelServing:
         cache = im.models[mid]["caches"]["layers_0_attention"]["k"]
         assert "sp" in cache.sharding.spec
 
-    def test_sp_under_pp_raises(self):
+    def test_sp_pp_token_match(self):
+        """sp x pp composed: each pipeline stage length-shards its KV
+        caches over its own sp sub-axis; output stays token-exact."""
         hf = _hf()
+        prompts = [[1, 5, 9, 42]]
+        want, *_ = _generate(hf, 1, 1, prompts, 10)
+
         cfg = LLAMAConfig.from_hf(hf.config)
         ffcfg = FFConfig(sequence_parallelism_degree=2,
-                         pipeline_parallelism_degree=2)
+                         pipeline_parallelism_degree=2,
+                         tensor_parallelism_degree=2)
         model = Model(ffcfg, name="sp_pp")
         create_llama_model(model, cfg, mode=InferenceMode.INC_DECODING,
                            max_requests=2)
         model.params = convert_hf_state_dict(hf.state_dict(), cfg)
         im = InferenceManager(ffcfg)
-        with pytest.raises(NotImplementedError, match="sequence-parallel"):
-            im.compile_model_and_allocate_buffer(
-                model, max_requests=2, max_seq_length=64,
-                cache_dtype=np.float32)
+        mid = im.compile_model_and_allocate_buffer(
+            model, max_requests=2, max_seq_length=64,
+            cache_dtype=np.float32)
+        rm = RequestManager(max_requests_per_batch=2,
+                            max_tokens_per_batch=16,
+                            max_sequence_length=64)
+        reqs = [rm.register_new_request(list(p), max_new_tokens=10)
+                for p in prompts]
+        rm.generate_incr_decoding(im, mid, reqs)
+        assert [r.tokens[r.prompt_len:] for r in reqs] == want
+        # stage 0's cache: length axis on 'sp', heads on 'tp', and the
+        # two stages own disjoint device subsets
+        c0 = im.models[mid]["caches"]["layers_0_attention"]["k"]
+        c1 = im.models[mid]["caches"]["layers_1_attention"]["k"]
+        assert c0.sharding.spec[1] == "sp" and c0.sharding.spec[2] == "tp"
+        assert set(c0.sharding.device_set).isdisjoint(
+            set(c1.sharding.device_set))
