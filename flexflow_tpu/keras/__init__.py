@@ -2,7 +2,7 @@
 ``tensorflow.keras`` replacement, ~4,400 LoC: models, layers, optimizers,
 losses, metrics, callbacks)."""
 
-from . import callbacks, layers
+from . import callbacks, datasets, layers
 from .layers import (Activation, Add, AveragePooling2D, BatchNormalization,
                      Concatenate, Conv2D, Dense, Dropout, Embedding, Flatten,
                      Input, KerasLayer, KTensor, LayerNormalization,
@@ -16,5 +16,5 @@ __all__ = [
     "Activation", "Flatten", "Dropout", "Embedding", "Conv2D",
     "MaxPooling2D", "AveragePooling2D", "BatchNormalization",
     "LayerNormalization", "Add", "Subtract", "Multiply", "Concatenate",
-    "SGD", "Adam", "callbacks", "layers",
+    "SGD", "Adam", "callbacks", "datasets", "layers",
 ]
