@@ -5,16 +5,24 @@ API parity with the reference's keras frontend datasets
 ``load_data() -> (x_train, y_train), (x_test, y_test)``).  The reference
 downloads from public URLs via ``get_file``; here datasets load from a
 local cache (``FF_DATASET_DIR`` or ``~/.keras/datasets``, the reference's
-cache location) and, when the file is absent (e.g. an air-gapped TPU pod),
-fall back to a DETERMINISTIC synthetic stand-in of the right shapes/dtypes
-so examples and CI always run — the fallback is seeded and labeled
+cache location) in the reference's own artifact formats (mnist.npz,
+cifar-10-python.tar.gz pickled batches, ragged reuters.npz), and, when the
+artifact is absent (e.g. an air-gapped TPU pod), fall back to a
+DETERMINISTIC synthetic stand-in of the right shapes/dtypes so examples
+and CI always run — the fallback is seeded and labeled
 linearly-separable, so convergence thresholds remain meaningful.
+
+One deliberate deviation: reuters returns a rectangular int array (padded
+with 0 / truncated to ``maxlen``) instead of the reference's ragged lists
+— the layer API consumes arrays.
 """
 
 from __future__ import annotations
 
 import os
-from typing import Tuple
+import pickle
+import tarfile
+from typing import Optional, Tuple
 
 import numpy as np
 
@@ -27,77 +35,132 @@ def _cache_path(name: str) -> str:
     return os.path.join(root, name)
 
 
+def _load_npz(path: str, keys):
+    p = _cache_path(path)
+    if not os.path.exists(p):
+        return None
+    with np.load(p, allow_pickle=True) as f:
+        return tuple(f[k] for k in keys)
+
+
 def _synthetic_images(shape, classes: int, n_train: int, n_test: int,
                       seed: int) -> Arrays:
     """Class-conditional Gaussian blobs rendered into image tensors —
     linearly separable, so accuracy gates still measure learning."""
     rng = np.random.default_rng(seed)
     centers = rng.normal(size=(classes,) + shape).astype(np.float32) * 64
+
     def make(n, seed2):
         r = np.random.default_rng(seed2)
         y = r.integers(0, classes, n)
         x = centers[y] + r.normal(size=(n,) + shape).astype(np.float32) * 32
         return np.clip(x + 128, 0, 255).astype(np.uint8), y.astype(np.int64)
+
     xtr, ytr = make(n_train, seed + 1)
     xte, yte = make(n_test, seed + 2)
     return (xtr, ytr), (xte, yte)
 
 
 class mnist:
-    """reference: keras/datasets/mnist.py load_data."""
+    """reference: keras/datasets/mnist.py load_data (mnist.npz cache)."""
 
     @staticmethod
     def load_data(path: str = "mnist.npz") -> Arrays:
-        p = _cache_path(path)
-        if os.path.exists(p):
-            with np.load(p, allow_pickle=True) as f:
-                return ((f["x_train"], f["y_train"]),
-                        (f["x_test"], f["y_test"]))
+        got = _load_npz(path, ("x_train", "y_train", "x_test", "y_test"))
+        if got is not None:
+            xtr, ytr, xte, yte = got
+            return (xtr, ytr), (xte, yte)
         return _synthetic_images((28, 28), 10, 6000, 1000, seed=0)
 
 
 class cifar10:
-    """reference: keras/datasets/cifar10.py load_data (NCHW like the
-    reference's conv layout)."""
+    """reference: keras/datasets/cifar10.py load_data — reads the
+    reference's cached ``cifar-10-python.tar.gz`` (five pickled
+    data_batch_N + test_batch, NCHW uint8), cifar10.npz, or synthetic."""
 
     @staticmethod
-    def load_data(path: str = "cifar10.npz") -> Arrays:
+    def _load_tarball(p: str) -> Arrays:
+        def batch(tf_, name):
+            with tf_.extractfile(f"cifar-10-batches-py/{name}") as f:
+                d = pickle.load(f, encoding="bytes")
+            x = d[b"data"].reshape(-1, 3, 32, 32)
+            y = np.asarray(d[b"labels"], np.int64)
+            return x, y
+
+        with tarfile.open(p) as tf_:
+            parts = [batch(tf_, f"data_batch_{i}") for i in range(1, 6)]
+            xtr = np.concatenate([x for x, _ in parts])
+            ytr = np.concatenate([y for _, y in parts])
+            xte, yte = batch(tf_, "test_batch")
+        return (xtr, ytr), (xte, yte)
+
+    @staticmethod
+    def load_data(path: str = "cifar-10-python.tar.gz") -> Arrays:
         p = _cache_path(path)
-        if os.path.exists(p):
-            with np.load(p, allow_pickle=True) as f:
-                return ((f["x_train"], f["y_train"]),
-                        (f["x_test"], f["y_test"]))
+        if os.path.exists(p) and not path.endswith(".npz"):
+            return cifar10._load_tarball(p)
+        npz = path if path.endswith(".npz") else "cifar10.npz"
+        got = _load_npz(npz, ("x_train", "y_train", "x_test", "y_test"))
+        if got is not None:
+            xtr, ytr, xte, yte = got
+            return (xtr, ytr), (xte, yte)
         return _synthetic_images((3, 32, 32), 10, 5000, 1000, seed=1)
 
 
 class reuters:
-    """reference: keras/datasets/reuters.py load_data (token-id
-    sequences + topic labels)."""
+    """reference: keras/datasets/reuters.py load_data (ragged token-id
+    sequences + topic labels; reference signature honored — skip_top,
+    start_char, oov_char, index_from included)."""
 
     @staticmethod
-    def load_data(path: str = "reuters.npz", num_words: int = 10000,
-                  maxlen: int = 80, test_split: float = 0.2) -> Arrays:
-        p = _cache_path(path)
-        if os.path.exists(p):
-            with np.load(p, allow_pickle=True) as f:
-                xs, ys = f["x"], f["y"]
-            # honor the caller's bounds like the synthetic path does
-            # (behavior must not flip on cache presence)
-            xs = np.minimum(xs[:, :maxlen], num_words - 1)
-            n_train = len(xs) - int(len(xs) * test_split)
-            return ((xs[:n_train], ys[:n_train]),
-                    (xs[n_train:], ys[n_train:]))
+    def load_data(path: str = "reuters.npz",
+                  num_words: Optional[int] = None, skip_top: int = 0,
+                  maxlen: Optional[int] = None, test_split: float = 0.2,
+                  seed: int = 113, start_char: int = 1, oov_char: int = 2,
+                  index_from: int = 3) -> Arrays:
+        got = _load_npz(path, ("x", "y"))
+        if got is not None:
+            xs_raw, ys = got
+            # the reference's artifact is a 1-D object array of ragged
+            # lists; rectangularize (truncate to maxlen, pad with 0) and
+            # apply the reference's preprocessing semantics
+            seqs = [list(s) for s in xs_raw]
+            if maxlen is None:
+                # +1: every sequence gains a start_char slot
+                maxlen_eff = max((len(s) for s in seqs), default=0) + 1
+            else:
+                maxlen_eff = maxlen
+            out = np.zeros((len(seqs), maxlen_eff), np.int64)
+            for i, s in enumerate(seqs):
+                s = [start_char] + [w + index_from for w in s]
+                if num_words is not None or skip_top:
+                    top = num_words if num_words is not None else max(
+                        max(s, default=0) + 1, skip_top + 1)
+                    s = [w if skip_top <= w < top else oov_char
+                         for w in s]
+                out[i, :min(len(s), maxlen_eff)] = s[:maxlen_eff]
+            rng = np.random.default_rng(seed)
+            order = rng.permutation(len(out))
+            out, ys = out[order], np.asarray(ys, np.int64)[order]
+            n_train = len(out) - int(len(out) * test_split)
+            return ((out[:n_train], ys[:n_train]),
+                    (out[n_train:], ys[n_train:]))
         # synthetic: class-dependent token distributions, fixed length
+        vocab = num_words or 10000
+        length = maxlen or 80
         rng = np.random.default_rng(2)
         classes = 46
-        base = rng.integers(4, num_words, size=(classes, maxlen))
+        base = rng.integers(max(4, skip_top), vocab,
+                            size=(classes, length))
+
         def make(n, seed2):
             r = np.random.default_rng(seed2)
             y = r.integers(0, classes, n)
-            noise = r.integers(4, num_words, size=(n, maxlen))
-            keep = r.random((n, maxlen)) < 0.7
+            noise = r.integers(max(4, skip_top), vocab, size=(n, length))
+            keep = r.random((n, length)) < 0.7
             x = np.where(keep, base[y], noise)
             return x.astype(np.int64), y.astype(np.int64)
+
         xtr, ytr = make(2000, 3)
         xte, yte = make(400, 4)
         return (xtr, ytr), (xte, yte)

@@ -103,13 +103,16 @@ def test_summary():
     assert "Dense" in s
 
 
-def test_datasets_load_and_train():
+def test_datasets_load_and_train(monkeypatch, tmp_path):
     """Dataset loaders (reference keras/datasets/) return keras-shaped
-    splits; the synthetic fallback is deterministic and learnable."""
+    splits; the synthetic fallback is deterministic and learnable.
+    FF_DATASET_DIR pins the test to an empty cache so a dev machine's
+    real ~/.keras artifacts can't change what it measures."""
     import numpy as np
 
     from flexflow_tpu import keras
 
+    monkeypatch.setenv("FF_DATASET_DIR", str(tmp_path))
     (xtr, ytr), (xte, yte) = keras.datasets.mnist.load_data()
     assert xtr.shape[1:] == (28, 28) and xtr.dtype == np.uint8
     assert len(xtr) == len(ytr) and len(xte) == len(yte)
@@ -133,3 +136,43 @@ def test_datasets_load_and_train():
     x = xtr[:n].reshape(n, 784).astype(np.float32) / 255.0
     perf = model.fit(x, ytr[:n].astype(np.int32), epochs=3, verbose=False)
     assert perf.accuracy > 60.0
+
+
+def test_datasets_cached_reference_formats(monkeypatch, tmp_path):
+    """Cached artifacts in the reference's own formats load: ragged
+    object-array reuters.npz and the pickled cifar-10 tarball."""
+    import pickle
+    import tarfile
+
+    import numpy as np
+
+    from flexflow_tpu.keras.datasets import cifar10, reuters
+
+    monkeypatch.setenv("FF_DATASET_DIR", str(tmp_path))
+    # ragged reuters (the upstream artifact layout)
+    seqs = np.empty(10, object)
+    for i in range(10):
+        seqs[i] = list(range(1, 4 + i))
+    np.savez(tmp_path / "reuters.npz", x=seqs, y=np.arange(10) % 3)
+    (xtr, ytr), (xte, yte) = reuters.load_data(num_words=6, maxlen=8)
+    assert xtr.shape[1] == 8 and len(xtr) + len(xte) == 10
+    assert xtr.max() < 6 + 1          # oov-capped (+start_char slot)
+    (a, _), _ = reuters.load_data(test_split=0.0)
+    assert len(a) == 10               # test_split=0 keeps all in train
+
+    # cifar-10 pickled tarball (reference cifar.py load_batch layout)
+    rng = np.random.default_rng(0)
+    inner = "cifar-10-batches-py"
+    import io
+    with tarfile.open(tmp_path / "cifar-10-python.tar.gz", "w:gz") as tf:
+        for name, n in [(f"data_batch_{i}", 4) for i in range(1, 6)] + [
+                ("test_batch", 4)]:
+            payload = pickle.dumps({
+                b"data": rng.integers(0, 255, (n, 3072), np.uint8),
+                b"labels": list(rng.integers(0, 10, n))})
+            info = tarfile.TarInfo(f"{inner}/{name}")
+            info.size = len(payload)
+            tf.addfile(info, io.BytesIO(payload))
+    (cx, cy), (tx, ty) = cifar10.load_data()
+    assert cx.shape == (20, 3, 32, 32) and tx.shape == (4, 3, 32, 32)
+    assert cy.dtype == np.int64 and len(cy) == 20

@@ -83,6 +83,25 @@ class TestMatching:
         assert {(mm[0], mm[1]) for mm in matches} == {("lin1", "r1")}
 
 
+def test_substitutions_to_dot_tool(tmp_path):
+    """tools/substitutions_to_dot renders a collection (reference
+    tools/substitutions_to_dot twin)."""
+    import subprocess
+    import sys as _sys
+
+    out = tmp_path / "rules.dot"
+    r = subprocess.run(
+        [_sys.executable,
+         os.path.join(os.path.dirname(__file__), os.pardir, "tools",
+                      "substitutions_to_dot.py"),
+         FIXTURE, "-o", str(out)],
+        capture_output=True, text=True)
+    assert r.returncode == 0, r.stderr
+    dot = out.read_text()
+    assert dot.startswith("digraph") and "partition_ew_add_combine" in dot
+    assert "EW_ADD" in dot and "style=dashed" in dot
+
+
 class TestSearchIntegration:
     def test_hints_propagate_through_dst_dataflow(self):
         """Partitioned-ness flows through compute ops until a combine —

@@ -1,0 +1,94 @@
+"""Render a substitution-rule collection to graphviz dot.
+
+Twin of the reference's tools/substitutions_to_dot (rule-file tooling):
+each rule becomes a cluster pair (src pattern -> dst pattern) with
+external inputs as diamonds, parallel ops shaded, and mapped outputs as
+dashed edges.
+
+Usage:
+  python tools/substitutions_to_dot.py RULES.json [-o out.dot]
+  python tools/substitutions_to_dot.py RULES.json --rule NAME
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir))
+
+from flexflow_tpu.search import load_rule_collection
+from flexflow_tpu.search.substitution_loader import PARALLEL_TYPES
+
+
+def _pattern_nodes(lines, rule_idx, side, ops):
+    ext = set()
+    for i, op in enumerate(ops):
+        nid = f"r{rule_idx}_{side}_{i}"
+        label = op.type_name.removeprefix("OP_")
+        if op.params:
+            label += "\\n" + ",".join(
+                f"{k.removeprefix('PM_').lower()}={v}"
+                for k, v in sorted(op.params.items()))
+        fill = ' style=filled fillcolor="#cde8ff"' \
+            if op.type_name in PARALLEL_TYPES else ""
+        lines.append(f'    "{nid}" [label="{label}"{fill}];')
+        for ref in op.inputs:
+            if ref.op_id < 0:
+                ename = f"r{rule_idx}_{side}_in{-ref.op_id}"
+                if ename not in ext:
+                    ext.add(ename)
+                    lines.append(
+                        f'    "{ename}" [label="in{-ref.op_id}" '
+                        f'shape=diamond];')
+                lines.append(f'    "{ename}" -> "{nid}";')
+            else:
+                lines.append(
+                    f'    "r{rule_idx}_{side}_{ref.op_id}" -> "{nid}" '
+                    f'[label="{ref.ts_id}"];')
+
+
+def collection_to_dot(col, only=None) -> str:
+    lines = ["digraph substitutions {", "  rankdir=LR;",
+             '  node [shape=box fontsize=10];']
+    for r_idx, rule in enumerate(col.rules):
+        if only and rule.name != only:
+            continue
+        for side, ops in (("src", rule.src_ops), ("dst", rule.dst_ops)):
+            lines.append(f'  subgraph "cluster_r{r_idx}_{side}" {{')
+            lines.append(f'    label="{rule.name} [{side}]";')
+            _pattern_nodes(lines, r_idx, side, ops)
+            lines.append("  }")
+        for mo in rule.mapped_outputs:
+            lines.append(
+                f'  "r{r_idx}_src_{mo.src_op_id}" -> '
+                f'"r{r_idx}_dst_{mo.dst_op_id}" [style=dashed '
+                f'constraint=false label="out"];')
+    lines.append("}")
+    return "\n".join(lines)
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("rules", help="rule collection JSON")
+    p.add_argument("-o", "--out", help="output .dot path (default stdout)")
+    p.add_argument("--rule", help="render only the named rule")
+    args = p.parse_args()
+    col = load_rule_collection(args.rules)
+    if args.rule and all(r.name != args.rule for r in col.rules):
+        names = ", ".join(r.name for r in col.rules[:20])
+        sys.exit(f"no rule named {args.rule!r}; collection has: {names}"
+                 + (" ..." if len(col.rules) > 20 else ""))
+    dot = collection_to_dot(col, only=args.rule)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(dot)
+        print(f"wrote {args.out} ({len(col.rules)} rules)")
+    else:
+        try:
+            print(dot)
+        except BrokenPipeError:      # piped into head etc.
+            sys.stderr.close()
+
+
+if __name__ == "__main__":
+    main()
