@@ -419,6 +419,21 @@ class Model:
             final_bias, apply_rotary_embedding, scaling_query, scaling_factor,
             qk_prod_scaling, position_bias, rope_theta, name)
 
+    def serving_self_attention(self, mode, input, embed_dim, num_q_heads,
+                               num_kv_heads=None, **kw):
+        """Mode-dispatched serving attention — the per-mode switch every
+        reference model builder repeats (e.g. opt.cc:101-150,
+        falcon.cc:133-145) collapsed into one call: BEAM_SEARCH -> spec,
+        TREE_VERIFY -> tree, else incremental."""
+        from ..fftype import InferenceMode as IM
+
+        method = {
+            IM.BEAM_SEARCH: self.spec_inc_multihead_self_attention,
+            IM.TREE_VERIFY: self.tree_inc_multihead_self_attention,
+        }.get(mode, self.inc_multiquery_self_attention)
+        return method(input, embed_dim, num_q_heads,
+                      num_kv_heads or num_q_heads, **kw)
+
     def spec_inc_multihead_self_attention(self, input, embed_dim, num_heads,
                                           num_kv_heads=None, **kw):
         return self._serving_attention(

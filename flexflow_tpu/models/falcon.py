@@ -130,15 +130,9 @@ def create_falcon_model(model: Model, config: FalconConfig,
         attn_kw = dict(kdim=head_dim, vdim=head_dim, qkv_bias=False,
                        final_bias=False, apply_rotary_embedding=True,
                        rope_theta=c.rope_theta, name=f"{pfx}_attention")
-        if mode is InferenceMode.BEAM_SEARCH:
-            mha = model.spec_inc_multihead_self_attention(
-                att_norm, c.hidden_size, c.n_head, c.n_head_kv, **attn_kw)
-        elif mode is InferenceMode.TREE_VERIFY:
-            mha = model.tree_inc_multihead_self_attention(
-                att_norm, c.hidden_size, c.n_head, c.n_head_kv, **attn_kw)
-        else:
-            mha = model.inc_multiquery_self_attention(
-                att_norm, c.hidden_size, c.n_head, c.n_head_kv, **attn_kw)
+        mha = model.serving_self_attention(
+            mode, att_norm, c.hidden_size, c.n_head, c.n_head_kv,
+            **attn_kw)
 
         h4 = model.dense(mlp_norm, 4 * c.hidden_size, use_bias=False,
                          name=f"{pfx}_mlp_dense_h_to_4h")

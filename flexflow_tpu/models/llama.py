@@ -103,21 +103,10 @@ def create_llama_model(model: Model, config: LLAMAConfig,
             num_kv_heads=c.num_key_value_heads, kdim=head_dim, vdim=head_dim,
             qkv_bias=False, final_bias=False, apply_rotary_embedding=True,
             rope_theta=c.rope_theta, name=f"{pfx}_attention")
-        if mode is InferenceMode.BEAM_SEARCH:
-            mha = model.spec_inc_multihead_self_attention(
-                attn_in, attn_kw.pop("embed_dim"),
-                attn_kw.pop("num_q_heads"), attn_kw.pop("num_kv_heads"),
-                **attn_kw)
-        elif mode is InferenceMode.TREE_VERIFY:
-            mha = model.tree_inc_multihead_self_attention(
-                attn_in, attn_kw.pop("embed_dim"),
-                attn_kw.pop("num_q_heads"), attn_kw.pop("num_kv_heads"),
-                **attn_kw)
-        else:
-            mha = model.inc_multiquery_self_attention(
-                attn_in, attn_kw.pop("embed_dim"),
-                attn_kw.pop("num_q_heads"), attn_kw.pop("num_kv_heads"),
-                kdim=attn_kw.pop("kdim"), vdim=attn_kw.pop("vdim"), **attn_kw)
+        mha = model.serving_self_attention(
+            mode, attn_in, attn_kw.pop("embed_dim"),
+            attn_kw.pop("num_q_heads"), attn_kw.pop("num_kv_heads"),
+            **attn_kw)
 
         ffn_in, residual = model.residual_rms_norm(
             mha, residual, eps=c.rms_norm_eps,

@@ -95,17 +95,8 @@ def create_mpt_model(model: Model, config: MPTConfig,
                        scaling_query=True, scaling_factor=head_dim ** -0.5,
                        qk_prod_scaling=False, position_bias=True,
                        name=f"{pfx}_attention")
-        if mode is InferenceMode.BEAM_SEARCH:
-            attn_outputs = model.spec_inc_multihead_self_attention(
-                layernorm_output, c.hidden_size, c.n_heads, c.n_heads,
-                **attn_kw)
-        elif mode is InferenceMode.TREE_VERIFY:
-            attn_outputs = model.tree_inc_multihead_self_attention(
-                layernorm_output, c.hidden_size, c.n_heads, c.n_heads,
-                **attn_kw)
-        else:
-            attn_outputs = model.inc_multihead_self_attention(
-                layernorm_output, c.hidden_size, c.n_heads, **attn_kw)
+        attn_outputs = model.serving_self_attention(
+            mode, layernorm_output, c.hidden_size, c.n_heads, **attn_kw)
 
         layernorm_output, hidden_states = model.residual_layer_norm(
             attn_outputs, hidden_states, eps=1e-5, use_bias=False,

@@ -95,8 +95,8 @@ def create_opt_model(model: Model, config: OPTConfig,
             added, fc2, elementwise_affine=affine, eps=1e-5,
             name=f"{pfx}_attention_layer_norm")
 
-        mha = model.inc_multihead_self_attention(
-            hidden, c.hidden_size, c.num_attention_heads,
+        mha = model.serving_self_attention(
+            mode, hidden, c.hidden_size, c.num_attention_heads,
             qkv_bias=True, final_bias=False, apply_rotary_embedding=False,
             scaling_query=True, scaling_factor=head_dim ** -0.5,
             qk_prod_scaling=False, name=f"{pfx}_attention")

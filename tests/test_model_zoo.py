@@ -111,3 +111,81 @@ class TestStarCoder:
         cfg = STARCODERConfig.from_hf(hf.config)
         _check_family(hf, create_starcoder_model, convert_hf_state_dict, cfg,
                       [[1, 5, 9, 42], [1, 17, 3, 99, 23, 54], [1, 7]])
+
+
+class TestSpecInferAcrossFamilies:
+    """Every model family serves as BOTH the tree-verify LLM and the
+    beam-search SSM (the reference's inference/models/*.cc all take an
+    InferenceMode; spec_infer pairs any family with itself) — outputs
+    stay token-identical to incremental decoding, the reference CI's
+    token-match gate."""
+
+    def _pair(self, family):
+        torch.manual_seed(7)
+        if family == "opt":
+            from flexflow_tpu.models.opt import (OPTConfig,
+                                                 convert_hf_state_dict,
+                                                 create_opt_model)
+            big = transformers.OPTForCausalLM(transformers.OPTConfig(
+                vocab_size=128, hidden_size=32, ffn_dim=64,
+                num_hidden_layers=2, num_attention_heads=4,
+                max_position_embeddings=64, do_layer_norm_before=True,
+                word_embed_proj_dim=32)).eval()
+            small = transformers.OPTForCausalLM(transformers.OPTConfig(
+                vocab_size=128, hidden_size=16, ffn_dim=32,
+                num_hidden_layers=1, num_attention_heads=2,
+                max_position_embeddings=64, do_layer_norm_before=True,
+                word_embed_proj_dim=16)).eval()
+            return (OPTConfig, create_opt_model, convert_hf_state_dict,
+                    big, small, [2, 5, 9, 42])
+        if family == "mpt":
+            from flexflow_tpu.models.mpt import (MPTConfig,
+                                                 convert_hf_state_dict,
+                                                 create_mpt_model)
+            big = transformers.MptForCausalLM(transformers.MptConfig(
+                vocab_size=128, d_model=32, n_heads=4, n_layers=2,
+                max_seq_len=128, no_bias=True)).eval()
+            small = transformers.MptForCausalLM(transformers.MptConfig(
+                vocab_size=128, d_model=16, n_heads=2, n_layers=1,
+                max_seq_len=128, no_bias=True)).eval()
+            return (MPTConfig, create_mpt_model, convert_hf_state_dict,
+                    big, small, [1, 5, 9, 42])
+        from flexflow_tpu.models.falcon import (FalconConfig,
+                                                convert_hf_state_dict,
+                                                create_falcon_model)
+        big = transformers.FalconForCausalLM(transformers.FalconConfig(
+            vocab_size=128, hidden_size=32, num_hidden_layers=2,
+            num_attention_heads=4, parallel_attn=True, bias=False,
+            alibi=False, multi_query=True,
+            new_decoder_architecture=False)).eval()
+        small = transformers.FalconForCausalLM(transformers.FalconConfig(
+            vocab_size=128, hidden_size=16, num_hidden_layers=1,
+            num_attention_heads=2, parallel_attn=True, bias=False,
+            alibi=False, multi_query=True,
+            new_decoder_architecture=False)).eval()
+        return (FalconConfig, create_falcon_model, convert_hf_state_dict,
+                big, small, [11, 5, 9, 42])
+
+    # StarCoder excluded: the reference wires it INC-only
+    # (starcoder.cc:101-130 asserts on other modes) and so do we
+    @pytest.mark.parametrize("family", ["opt", "mpt", "falcon"])
+    def test_spec_matches_incremental(self, family):
+        from conftest import run_spec_infer
+
+        cfg_cls, build, convert, big, small, prompt = self._pair(family)
+
+        def make(hf, mode, name):
+            cfg = cfg_cls.from_hf(hf.config)
+            m = Model(FFConfig(), name=name)
+            build(m, cfg, mode=mode, max_requests=2)
+            m.params = convert(hf.state_dict(), cfg)
+            return m
+
+        want = _ff_greedy(make(big, InferenceMode.INC_DECODING,
+                               f"{family}_inc"), [prompt], 10)[0]
+        got, _ = run_spec_infer(
+            make(big, InferenceMode.TREE_VERIFY, f"{family}_llm"),
+            make(small, InferenceMode.BEAM_SEARCH, f"{family}_ssm"),
+            [prompt], 10, max_requests=2, max_seq_length=64,
+            beam_depth=3, max_tokens_per_batch=32)
+        assert got[0] == want, f"{family}:\n spec={got[0]}\n incr={want}"

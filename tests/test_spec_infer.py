@@ -44,24 +44,13 @@ def _build(hf, mode, max_requests=4, beam_width=1):
 
 def _spec_generate(llm_hf, ssm_hf, prompts, n_new, beam_width=2,
                    max_requests=4, tree_chunk=24):
+    from conftest import run_spec_infer
+
     llm = _build(llm_hf, InferenceMode.TREE_VERIFY, max_requests)
     ssm = _build(ssm_hf, InferenceMode.BEAM_SEARCH, max_requests)
-    im = InferenceManager(llm.config)
-    llm_id = im.compile_model_and_allocate_buffer(
-        llm, mode=InferenceMode.TREE_VERIFY, max_requests=max_requests,
-        max_seq_length=256, cache_dtype=np.float32)
-    ssm_id = im.compile_model_and_allocate_buffer(
-        ssm, mode=InferenceMode.BEAM_SEARCH, max_requests=max_requests,
-        max_seq_length=256, beam_width=beam_width, cache_dtype=np.float32)
-    rm = RequestManager(max_requests_per_batch=max_requests,
-                        max_tokens_per_batch=64, max_sequence_length=256,
-                        max_spec_tree_token_num=tree_chunk)
-    rm.register_ssm_model(ssm_id)
-    reqs = [rm.register_new_request(list(p), max_new_tokens=n_new)
-            for p in prompts]
-    generate_spec_infer(rm, im, llm_id, reqs, beam_width=beam_width,
-                        beam_depth=4)
-    return [r.tokens[r.prompt_len:] for r in reqs], reqs
+    return run_spec_infer(llm, ssm, prompts, n_new,
+                          beam_width=beam_width, max_requests=max_requests,
+                          tree_chunk=tree_chunk)
 
 
 def test_single_step_parent_rows_reorder():
