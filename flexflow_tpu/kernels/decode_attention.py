@@ -1,0 +1,156 @@
+"""Pallas TPU kernel: fused single-token decode attention.
+
+Decode at small batch is per-kernel floor-bound (PARITY.md known-gaps):
+the unfused path spends ~5 XLA kernels per layer on cache scatter +
+attention einsums + masking.  This kernel fuses, per request row,
+
+    scatter k/v at the row's depth into the KV cache (in place, aliased)
+    -> causal-masked q@K^T over the cache -> softmax -> @V
+
+into ONE program — the TPU analogue of the reference's hand-written
+generation kernel (inc_multihead_self_attention.cu:46
+compute_attention_kernel_generation_kernel + :603 update_kv_cache_kernel).
+
+Layout contract (matches ops/serving_attention.py):
+    q      [R, H, D]    post-RoPE queries, one token per row
+    k_new  [R, KV, D]   post-RoPE key for the new token
+    v_new  [R, KV, D]
+    ck/cv  [R, S, KV, D] caches; S % 16 == 0 (VMEM block tiling)
+    depth  [R] int32    the new token's cache slot (= tokens cached)
+    active [R] int32    0 rows skip the scatter (slot S-1 slack) and
+                        output zeros
+Returns (out [R, H, D], ck', cv') — caches aliased in place.
+GQA folds as H = KV * G.  ALiBi is NOT handled (the jnp path covers
+MPT); tp/sp-sharded meshes use the jnp path too.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+def _kernel(depth_sref, active_sref, q_ref, kn_ref, vn_ref, ck_ref,
+            cv_ref, out_ref, cko_ref, cvo_ref, *, kv_heads: int,
+            groups: int, scale: float):
+    from jax.experimental import pallas as pl
+
+    r = pl.program_id(0)
+    depth = depth_sref[r]
+    active = active_sref[r]
+    S = cko_ref.shape[0]
+    # output blocks are NOT initialized from the aliased input — each
+    # program writes its whole block back, so copy-in first, then scatter
+    # the new token's k/v at the row's depth (inactive rows write into
+    # the never-attended slack tail, like the jnp _scatter_chunk)
+    cko_ref[:] = ck_ref[:]
+    cvo_ref[:] = cv_ref[:]
+    slot = jnp.where(active > 0, depth, S - 1)
+    cko_ref[pl.dslice(slot, 1)] = kn_ref[:].reshape(1, kv_heads, -1)
+    cvo_ref[pl.dslice(slot, 1)] = vn_ref[:].reshape(1, kv_heads, -1)
+
+    span = jax.lax.broadcasted_iota(jnp.int32, (1, S), 1)
+    mask = (span <= depth) & (active > 0)          # [1, S]
+    # read whole blocks as values: strided middle-dim REF reads
+    # (cko_ref[:, kv, :]) mis-lower on Mosaic, value slicing is safe
+    q_all = q_ref[:]
+    k_all = cko_ref[:]
+    v_all = cvo_ref[:]
+    outs = []
+    for kv in range(kv_heads):
+        qg = q_all[kv * groups:(kv + 1) * groups, :]          # [G, D]
+        k = k_all[:, kv, :]                                    # [S, D]
+        logits = jax.lax.dot_general(
+            qg.astype(jnp.float32), k.astype(jnp.float32),
+            (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale        # [G, S]
+        logits = jnp.where(mask, logits, NEG_INF)
+        m = jnp.max(logits, axis=-1, keepdims=True)
+        p = jnp.exp(logits - m)
+        p = p / jnp.sum(p, axis=-1, keepdims=True)
+        v = v_all[:, kv, :]                                    # [S, D]
+        # cast probs to the cache dtype first — bit-exact with the jnp
+        # path's probs.astype(cache.dtype) einsum (_attend)
+        o = jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)                # [G, D]
+        outs.append(o)
+    o = jnp.concatenate(outs, axis=0)
+    o = jnp.where(active > 0, o, 0.0)
+    out_ref[:] = o.astype(out_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("scale", "interpret"))
+def fused_decode_attention(q, k_new, v_new, ck, cv, depth, active,
+                           scale: float, interpret: bool = False):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    R, H, D = q.shape
+    S, KV = ck.shape[1], ck.shape[2]
+    assert S % 16 == 0, f"cache length {S} must be a multiple of 16"
+    G = H // KV
+    kern = functools.partial(_kernel, kv_heads=KV, groups=G, scale=scale)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(R,),
+        in_specs=[
+            pl.BlockSpec((None, H, D), lambda r, d, a: (r, 0, 0)),
+            pl.BlockSpec((None, KV, D), lambda r, d, a: (r, 0, 0)),
+            pl.BlockSpec((None, KV, D), lambda r, d, a: (r, 0, 0)),
+            pl.BlockSpec((None, S, KV, D), lambda r, d, a: (r, 0, 0, 0)),
+            pl.BlockSpec((None, S, KV, D), lambda r, d, a: (r, 0, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((None, H, D), lambda r, d, a: (r, 0, 0)),
+            pl.BlockSpec((None, S, KV, D), lambda r, d, a: (r, 0, 0, 0)),
+            pl.BlockSpec((None, S, KV, D), lambda r, d, a: (r, 0, 0, 0)),
+        ],
+    )
+    out, cko, cvo = pl.pallas_call(
+        kern,
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((R, H, D), q.dtype),
+            jax.ShapeDtypeStruct(ck.shape, ck.dtype),
+            jax.ShapeDtypeStruct(cv.shape, cv.dtype),
+        ],
+        input_output_aliases={5: 1, 6: 2},    # caches update in place
+        interpret=interpret,
+    )(depth.astype(jnp.int32), active.astype(jnp.int32), q,
+      k_new.astype(ck.dtype), v_new.astype(cv.dtype), ck, cv)
+    return out, cko, cvo
+
+
+def decode_attention_reference(q, k_new, v_new, ck, cv, depth, active,
+                               scale: float):
+    """jnp reference mirroring ops/serving_attention.py's C=1 path."""
+    S = ck.shape[1]
+    safe = jnp.where(active > 0, depth, S - 1)
+
+    def upd(cache_row, new_row, s):
+        return jax.lax.dynamic_update_slice(
+            cache_row, new_row[None].astype(cache_row.dtype), (s, 0, 0))
+
+    ck = jax.vmap(upd)(ck, k_new, safe)
+    cv = jax.vmap(upd)(cv, v_new, safe)
+    R, H, D = q.shape
+    KV = ck.shape[2]
+    G = H // KV
+    qg = q.reshape(R, KV, G, D)
+    logits = jnp.einsum("rkgd,rskd->rkgs", qg, ck,
+                        preferred_element_type=jnp.float32) * scale
+    span = jnp.arange(S)[None, None, None, :]
+    mask = (span <= depth[:, None, None, None]) & (
+        active[:, None, None, None] > 0)
+    logits = jnp.where(mask, logits, NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("rkgs,rskd->rkgd", probs.astype(cv.dtype), cv,
+                     preferred_element_type=jnp.float32)
+    out = jnp.where(active[:, None, None] > 0,
+                    out.reshape(R, H, D), 0.0)
+    return out.astype(q.dtype), ck, cv

@@ -223,6 +223,16 @@ class IncMultiHeadSelfAttention(_ServingAttentionBase):
             k = apply_rotary_embedding(k.swapaxes(1, 2), positions[:, None, :],
                                        theta).swapaxes(1, 2)
         ck, cv = self._cache(ctx, layer)
+        fused_mode = self._fused_decode_ok(attrs, ctx, C, ck)
+        if fused_mode:
+            from ..kernels.decode_attention import fused_decode_attention
+
+            out1, ck, cv = fused_decode_attention(
+                q[:, 0], k[:, 0], v[:, 0], ck, cv, bc["first_depth"],
+                bc["active"].astype(jnp.int32), self._scale(attrs),
+                interpret=(fused_mode == "interpret"))
+            self._store(ctx, layer, ck, cv)
+            return [self._output(params, out1[:, None], attrs)]
         ck = _scatter_chunk(ck, k, bc["first_depth"], bc["active"])
         cv = _scatter_chunk(cv, v, bc["first_depth"], bc["active"])
         self._store(ctx, layer, ck, cv)
@@ -236,6 +246,29 @@ class IncMultiHeadSelfAttention(_ServingAttentionBase):
                      positions, key_pos)
         out = _attend(q, ck, cv, mask, self._scale(attrs), alibi)
         return [self._output(params, out, attrs)]
+
+    @staticmethod
+    def _fused_decode_ok(attrs, ctx, C, ck):
+        """Gate for the fused Pallas decode-attention kernel
+        (kernels/decode_attention.py): single-token decode on an
+        unsharded cache, no ALiBi, tile-aligned shapes.  Opt-in via
+        FF_PALLAS_ATTN=1 while perf is validated per-chip;
+        FF_PALLAS_ATTN=interpret runs the kernel interpreted (CI coverage
+        of the in-model wiring on CPU).  Returns the mode or False."""
+        import os
+
+        from ..kernels.quant_matmul import pallas_tpu_available
+
+        mode = os.environ.get("FF_PALLAS_ATTN")
+        if mode not in ("1", "interpret"):
+            return False
+        ok = (C == 1
+              and getattr(ctx, "mesh", None) is None
+              and not attrs.get("position_bias", False)
+              and ck.shape[1] % 16 == 0
+              and ck.shape[3] % 128 == 0
+              and (mode == "interpret" or pallas_tpu_available()))
+        return mode if ok else False
 
     def flops(self, attrs, in_specs):
         (x,) = in_specs

@@ -19,6 +19,7 @@ TPU-native re-design of the reference's InferenceManager
 
 from __future__ import annotations
 
+import math
 from typing import Any, Dict, List, Optional, Tuple
 
 import jax
@@ -153,10 +154,10 @@ class InferenceManager:
         # (dynamic_update_slice clamps at the edge).  Slack positions are
         # never attended — the mask stops at each row's current depth.
         alloc_len = max_seq_length + prefill_chunk + 1
-        if sp > 1:
-            # the cache's length axis shards over sp: round up so every
-            # shard holds the same extent
-            alloc_len = -(-alloc_len // sp) * sp
+        # round the cache length up: %16 keeps VMEM blocks tile-aligned
+        # (fused decode attention), %sp gives every shard equal extent
+        m = math.lcm(16, sp)
+        alloc_len = -(-alloc_len // m) * m
         if model.params is None:
             model.params = model.init_params(jax.random.PRNGKey(cfg.seed))
 

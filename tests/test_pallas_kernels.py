@@ -84,3 +84,75 @@ def test_linear_op_pallas_gate(monkeypatch, env):
         monkeypatch.setenv("FF_PALLAS_INT8", env)
     got = np.asarray(m.apply(m.params, np.ones((4, 64), np.float32)))
     np.testing.assert_allclose(got, ref, rtol=0.05, atol=0.05)
+
+
+@pytest.mark.parametrize("R,H,KV,D,S", [(4, 8, 2, 32, 48),
+                                        (3, 4, 4, 16, 32)])
+def test_fused_decode_attention_matches_production(R, H, KV, D, S):
+    """The fused scatter+attend decode kernel (opt-in FF_PALLAS_ATTN)
+    matches the PRODUCTION jnp ops (_scatter_chunk + _attend) on active
+    rows; inactive rows differ by design (kernel: zeros, production:
+    uniform softmax) and their outputs are discarded either way."""
+    import numpy as np
+
+    from flexflow_tpu.kernels.decode_attention import fused_decode_attention
+    from flexflow_tpu.ops.serving_attention import _attend, _scatter_chunk
+
+    rng = np.random.default_rng(0)
+    mk = lambda s: jnp.asarray(rng.standard_normal(s), jnp.float32)
+    q, kn, vn = mk((R, H, D)), mk((R, KV, D)), mk((R, KV, D))
+    ck, cv = mk((R, S, KV, D)), mk((R, S, KV, D))
+    depth = jnp.asarray(rng.integers(0, S - 2, R), jnp.int32)
+    active = jnp.asarray([1] * (R - 1) + [0], jnp.int32)
+    o1, k1, v1 = fused_decode_attention(q, kn, vn, ck, cv, depth, active,
+                                        0.125, interpret=True)
+    ck2 = _scatter_chunk(ck, kn[:, None], depth, active > 0)
+    cv2 = _scatter_chunk(cv, vn[:, None], depth, active > 0)
+    span = jnp.arange(S)[None, None, :]
+    mask = (span <= depth[:, None, None]) & (active > 0)[:, None, None]
+    o2 = _attend(q[:, None], ck2, cv2, mask, 0.125)[:, 0]
+    act = np.asarray(active) > 0
+    np.testing.assert_allclose(np.asarray(o1)[act], np.asarray(o2)[act],
+                               atol=1e-5)
+    np.testing.assert_array_equal(np.asarray(k1), np.asarray(ck2))
+    np.testing.assert_array_equal(np.asarray(v1), np.asarray(cv2))
+
+
+def test_fused_decode_attention_in_model(monkeypatch):
+    """FF_PALLAS_ATTN=interpret runs the fused kernel through the full
+    serving stack on CPU — covering the op-level wiring (arg order,
+    reshape, cache store) that the TPU-only gate otherwise hides."""
+    import numpy as np
+
+    from flexflow_tpu import FFConfig, Model
+    from flexflow_tpu.fftype import InferenceMode
+    from flexflow_tpu.models.llama import (LLAMAConfig,
+                                           create_llama_model)
+    from flexflow_tpu.serving import InferenceManager, RequestManager
+
+    def gen(env):
+        if env:
+            monkeypatch.setenv("FF_PALLAS_ATTN", env)
+        else:
+            monkeypatch.delenv("FF_PALLAS_ATTN", raising=False)
+        cfg = LLAMAConfig(vocab_size=64, hidden_size=256,
+                          intermediate_size=128, num_hidden_layers=1,
+                          num_attention_heads=2, num_key_value_heads=2,
+                          max_position_embeddings=64)  # head_dim 128
+        model = Model(FFConfig(), name=f"pattn_{env}")
+        create_llama_model(model, cfg, mode=InferenceMode.INC_DECODING,
+                           max_requests=2)
+        model.params = model.init_params(jax.random.PRNGKey(3))
+        im = InferenceManager(model.config)
+        mid = im.compile_model_and_allocate_buffer(
+            model, max_requests=2, max_seq_length=32,
+            cache_dtype=np.float32)
+        rm = RequestManager(max_requests_per_batch=2,
+                            max_tokens_per_batch=8,
+                            max_sequence_length=32)
+        reqs = [rm.register_new_request([1, 5, 9], max_new_tokens=6),
+                rm.register_new_request([2, 8], max_new_tokens=6)]
+        rm.generate_incr_decoding(im, mid, reqs)
+        return [r.tokens for r in reqs]
+
+    assert gen("interpret") == gen(None)
