@@ -34,6 +34,34 @@ import jax.numpy as jnp
 NEG_INF = -1e30
 
 
+def _masked_attention(q_all, k_all, v_all, depth, active, S,
+                      kv_heads, groups, scale):
+    """Shared masked-softmax attention body for both kernel variants:
+    per-kv-head qK^T -> causal mask -> stable softmax -> probs@V (probs
+    cast to the cache dtype, bit-exact with _attend), inactive rows 0."""
+    span = jax.lax.broadcasted_iota(jnp.int32, (1, S), 1)
+    mask = (span <= depth) & (active > 0)
+    outs = []
+    for kv in range(kv_heads):
+        qg = q_all[kv * groups:(kv + 1) * groups, :]
+        k = k_all[:, kv, :]
+        logits = jax.lax.dot_general(
+            qg.astype(jnp.float32), k.astype(jnp.float32),
+            (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        logits = jnp.where(mask, logits, NEG_INF)
+        m = jnp.max(logits, axis=-1, keepdims=True)
+        p = jnp.exp(logits - m)
+        p = p / jnp.sum(p, axis=-1, keepdims=True)
+        v = v_all[:, kv, :]
+        o = jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        outs.append(o)
+    o = jnp.concatenate(outs, axis=0)
+    return jnp.where(active > 0, o, 0.0)
+
+
 def _kernel(depth_sref, active_sref, q_ref, kn_ref, vn_ref, ck_ref,
             cv_ref, out_ref, cko_ref, cvo_ref, *, kv_heads: int,
             groups: int, scale: float):
@@ -53,34 +81,10 @@ def _kernel(depth_sref, active_sref, q_ref, kn_ref, vn_ref, ck_ref,
     cko_ref[pl.dslice(slot, 1)] = kn_ref[:].reshape(1, kv_heads, -1)
     cvo_ref[pl.dslice(slot, 1)] = vn_ref[:].reshape(1, kv_heads, -1)
 
-    span = jax.lax.broadcasted_iota(jnp.int32, (1, S), 1)
-    mask = (span <= depth) & (active > 0)          # [1, S]
     # read whole blocks as values: strided middle-dim REF reads
     # (cko_ref[:, kv, :]) mis-lower on Mosaic, value slicing is safe
-    q_all = q_ref[:]
-    k_all = cko_ref[:]
-    v_all = cvo_ref[:]
-    outs = []
-    for kv in range(kv_heads):
-        qg = q_all[kv * groups:(kv + 1) * groups, :]          # [G, D]
-        k = k_all[:, kv, :]                                    # [S, D]
-        logits = jax.lax.dot_general(
-            qg.astype(jnp.float32), k.astype(jnp.float32),
-            (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale        # [G, S]
-        logits = jnp.where(mask, logits, NEG_INF)
-        m = jnp.max(logits, axis=-1, keepdims=True)
-        p = jnp.exp(logits - m)
-        p = p / jnp.sum(p, axis=-1, keepdims=True)
-        v = v_all[:, kv, :]                                    # [S, D]
-        # cast probs to the cache dtype first — bit-exact with the jnp
-        # path's probs.astype(cache.dtype) einsum (_attend)
-        o = jax.lax.dot_general(
-            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)                # [G, D]
-        outs.append(o)
-    o = jnp.concatenate(outs, axis=0)
-    o = jnp.where(active > 0, o, 0.0)
+    o = _masked_attention(q_ref[:], cko_ref[:], cvo_ref[:], depth, active,
+                          S, kv_heads, groups, scale)
     out_ref[:] = o.astype(out_ref.dtype)
 
 
@@ -120,6 +124,98 @@ def fused_decode_attention(q, k_new, v_new, ck, cv, depth, active,
             jax.ShapeDtypeStruct(cv.shape, cv.dtype),
         ],
         input_output_aliases={5: 1, 6: 2},    # caches update in place
+        interpret=interpret,
+    )(depth.astype(jnp.int32), active.astype(jnp.int32), q,
+      k_new.astype(ck.dtype), v_new.astype(cv.dtype), ck, cv)
+    return out, cko, cvo
+
+
+def _dma_kernel(depth_sref, active_sref, q_ref, kn_ref, vn_ref, ck_hbm,
+                cv_hbm, out_ref, cko_hbm, cvo_hbm, ks, vs, sem_k, sem_v,
+                sem_wk, sem_wv, *, kv_heads: int, groups: int,
+                scale: float):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    r = pl.program_id(0)
+    depth = depth_sref[r]
+    active = active_sref[r]
+    S = ks.shape[0]
+    slot = jnp.where(active > 0, depth, S - 1)
+    # fetch row r HBM -> VMEM (needed for attention regardless)
+    fk = pltpu.make_async_copy(ck_hbm.at[r], ks, sem_k)
+    fv = pltpu.make_async_copy(cv_hbm.at[r], vs, sem_v)
+    fk.start()
+    fv.start()
+    # write ONLY the new slot back to the (aliased) HBM cache — no
+    # whole-row write-back, the win over the blocked variant
+    wk = pltpu.make_async_copy(kn_ref, cko_hbm.at[r, pl.ds(slot, 1)],
+                               sem_wk)
+    wv = pltpu.make_async_copy(vn_ref, cvo_hbm.at[r, pl.ds(slot, 1)],
+                               sem_wv)
+    wk.start()
+    wv.start()
+    fk.wait()
+    fv.wait()
+    # the VMEM copy may predate the slot write: patch it locally
+    ks[pl.dslice(slot, 1)] = kn_ref[:]
+    vs[pl.dslice(slot, 1)] = vn_ref[:]
+
+    o = _masked_attention(q_ref[:], ks[:], vs[:], depth, active, S,
+                          kv_heads, groups, scale)
+    out_ref[:] = o.astype(out_ref.dtype)
+    wk.wait()
+    wv.wait()
+
+
+@functools.partial(jax.jit, static_argnames=("scale", "interpret"))
+def fused_decode_attention_dma(q, k_new, v_new, ck, cv, depth, active,
+                               scale: float, interpret: bool = False):
+    """Manual-DMA variant: caches stay in HBM; only the new token's slot
+    is written back (the blocked variant pays a whole-row write-back per
+    step).  Same contract as :func:`fused_decode_attention`."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    R, H, D = q.shape
+    S, KV = ck.shape[1], ck.shape[2]
+    assert S % 16 == 0, f"cache length {S} must be a multiple of 16"
+    G = H // KV
+    kern = functools.partial(_dma_kernel, kv_heads=KV, groups=G,
+                             scale=scale)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(R,),
+        in_specs=[
+            pl.BlockSpec((None, H, D), lambda r, d, a: (r, 0, 0)),
+            pl.BlockSpec((1, KV, D), lambda r, d, a: (r, 0, 0)),
+            pl.BlockSpec((1, KV, D), lambda r, d, a: (r, 0, 0)),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
+        out_specs=[
+            pl.BlockSpec((None, H, D), lambda r, d, a: (r, 0, 0)),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((S, KV, D), ck.dtype),
+            pltpu.VMEM((S, KV, D), cv.dtype),
+            pltpu.SemaphoreType.DMA,
+            pltpu.SemaphoreType.DMA,
+            pltpu.SemaphoreType.DMA,
+            pltpu.SemaphoreType.DMA,
+        ],
+    )
+    out, cko, cvo = pl.pallas_call(
+        kern,
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((R, H, D), q.dtype),
+            jax.ShapeDtypeStruct(ck.shape, ck.dtype),
+            jax.ShapeDtypeStruct(cv.shape, cv.dtype),
+        ],
+        input_output_aliases={5: 1, 6: 2},
         interpret=interpret,
     )(depth.astype(jnp.int32), active.astype(jnp.int32), q,
       k_new.astype(ck.dtype), v_new.astype(cv.dtype), ck, cv)

@@ -225,9 +225,11 @@ class IncMultiHeadSelfAttention(_ServingAttentionBase):
         ck, cv = self._cache(ctx, layer)
         fused_mode = self._fused_decode_ok(attrs, ctx, C, ck)
         if fused_mode:
-            from ..kernels.decode_attention import fused_decode_attention
+            from ..kernels import decode_attention as _da
 
-            out1, ck, cv = fused_decode_attention(
+            fn = (_da.fused_decode_attention_dma if fused_mode == "dma"
+                  else _da.fused_decode_attention)
+            out1, ck, cv = fn(
                 q[:, 0], k[:, 0], v[:, 0], ck, cv, bc["first_depth"],
                 bc["active"].astype(jnp.int32), self._scale(attrs),
                 interpret=(fused_mode == "interpret"))
@@ -252,15 +254,17 @@ class IncMultiHeadSelfAttention(_ServingAttentionBase):
         """Gate for the fused Pallas decode-attention kernel
         (kernels/decode_attention.py): single-token decode on an
         unsharded cache, no ALiBi, tile-aligned shapes.  Opt-in via
-        FF_PALLAS_ATTN=1 while perf is validated per-chip;
-        FF_PALLAS_ATTN=interpret runs the kernel interpreted (CI coverage
-        of the in-model wiring on CPU).  Returns the mode or False."""
+        FF_PALLAS_ATTN=1 (blocked kernel) or =dma (manual-DMA slot
+        updates) while perf is validated per-chip;
+        FF_PALLAS_ATTN=interpret runs the blocked kernel interpreted
+        (CI coverage of the in-model wiring on CPU).  Returns the mode
+        or False."""
         import os
 
         from ..kernels.quant_matmul import pallas_tpu_available
 
         mode = os.environ.get("FF_PALLAS_ATTN")
-        if mode not in ("1", "interpret"):
+        if mode not in ("1", "dma", "interpret"):
             return False
         ok = (C == 1
               and getattr(ctx, "mesh", None) is None

@@ -86,17 +86,22 @@ def test_linear_op_pallas_gate(monkeypatch, env):
     np.testing.assert_allclose(got, ref, rtol=0.05, atol=0.05)
 
 
+@pytest.mark.parametrize("variant", ["blocked", "dma"])
 @pytest.mark.parametrize("R,H,KV,D,S", [(4, 8, 2, 32, 48),
                                         (3, 4, 4, 16, 32)])
-def test_fused_decode_attention_matches_production(R, H, KV, D, S):
+def test_fused_decode_attention_matches_production(R, H, KV, D, S,
+                                                   variant):
     """The fused scatter+attend decode kernel (opt-in FF_PALLAS_ATTN)
     matches the PRODUCTION jnp ops (_scatter_chunk + _attend) on active
     rows; inactive rows differ by design (kernel: zeros, production:
     uniform softmax) and their outputs are discarded either way."""
     import numpy as np
 
-    from flexflow_tpu.kernels.decode_attention import fused_decode_attention
+    from flexflow_tpu.kernels import decode_attention as da
     from flexflow_tpu.ops.serving_attention import _attend, _scatter_chunk
+
+    fused = (da.fused_decode_attention_dma if variant == "dma"
+             else da.fused_decode_attention)
 
     rng = np.random.default_rng(0)
     mk = lambda s: jnp.asarray(rng.standard_normal(s), jnp.float32)
@@ -104,8 +109,8 @@ def test_fused_decode_attention_matches_production(R, H, KV, D, S):
     ck, cv = mk((R, S, KV, D)), mk((R, S, KV, D))
     depth = jnp.asarray(rng.integers(0, S - 2, R), jnp.int32)
     active = jnp.asarray([1] * (R - 1) + [0], jnp.int32)
-    o1, k1, v1 = fused_decode_attention(q, kn, vn, ck, cv, depth, active,
-                                        0.125, interpret=True)
+    o1, k1, v1 = fused(q, kn, vn, ck, cv, depth, active, 0.125,
+                       interpret=True)
     ck2 = _scatter_chunk(ck, kn[:, None], depth, active > 0)
     cv2 = _scatter_chunk(cv, vn[:, None], depth, active > 0)
     span = jnp.arange(S)[None, None, :]
