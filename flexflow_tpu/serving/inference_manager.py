@@ -235,7 +235,7 @@ class InferenceManager:
         record = dict(model=model, mode=mode, mesh=mesh, caches=caches,
                       max_requests=max_requests, rows=rows,
                       max_seq_length=max_seq_length, beam_width=beam_width,
-                      prefill_chunk=prefill_chunk, steps={}, pspecs=pspecs,
+                      prefill_chunk=prefill_chunk, steps={},
                       cache_pspec=(cache_sharding.spec
                                    if cache_sharding is not None else None))
         self.models[mid] = record
@@ -253,7 +253,7 @@ class InferenceManager:
         record = dict(model=model, mode=mode, mesh=None, caches={},
                       max_requests=max_requests, rows=rows,
                       max_seq_length=max_seq_length, beam_width=beam_width,
-                      prefill_chunk=prefill_chunk, steps={}, pspecs=None)
+                      prefill_chunk=prefill_chunk, steps={})
         compile_pipeline(self, record, model, cfg, cache_dtype, rows,
                          alloc_len)
         mid = model_id if model_id is not None else len(self.models)
