@@ -867,8 +867,35 @@ class Model:
                                     from_logits=from_logits)
             return loss, mvals
 
+        self._train_step_core = train_step
         self._train_step = jax.jit(train_step, donate_argnums=(0, 1, 2))
+        self._train_blocks = {}
         self._eval_step = jax.jit(eval_step)
+
+    def _get_train_block(self, k: int):
+        """K train steps fused into one device program via lax.scan —
+        training's analogue of the serving decode block: one dispatch
+        (and, over a network-attached chip, one round trip) per K steps
+        instead of per step, playing the amortization role of the
+        reference's Legion tracing around fit (flexflow_cffi.py:3570)."""
+        if k in self._train_blocks:
+            return self._train_blocks[k]
+        core = self._train_step_core
+
+        def block(trainable, state, opt_state, rngs, batches, lr):
+            def body(carry, xs):
+                tr, st, opt = carry
+                rng, batch = xs[0], xs[1:]
+                tr, st, opt, loss, mvals = core(tr, st, opt, rng, batch, lr)
+                return (tr, st, opt), (loss, mvals)
+
+            (tr, st, opt), (losses, mvals) = jax.lax.scan(
+                body, (trainable, state, opt_state), (rngs, *batches))
+            return (tr, st, opt, jnp.sum(losses),
+                    jax.tree.map(lambda m: jnp.sum(m, axis=0), mvals))
+
+        self._train_blocks[k] = jax.jit(block, donate_argnums=(0, 1, 2))
+        return self._train_blocks[k]
 
     # ------------------------------------------------------------ forward
     def apply(self, params, *inputs, training: bool = False, rng=None):
@@ -884,8 +911,14 @@ class Model:
     # ---------------------------------------------------------------- fit
     def fit(self, x: Sequence[np.ndarray], y: np.ndarray,
             epochs: Optional[int] = None, batch_size: Optional[int] = None,
-            shuffle: bool = True, verbose: bool = True) -> PerfMetrics:
-        """Training loop (reference: FFModel.fit, flexflow_cffi.py:3534)."""
+            shuffle: bool = True, verbose: bool = True,
+            steps_per_call: int = 1) -> PerfMetrics:
+        """Training loop (reference: FFModel.fit, flexflow_cffi.py:3534).
+
+        ``steps_per_call > 1`` fuses that many steps into one device
+        program (lax.scan) — one dispatch per block instead of per step
+        (see _get_train_block); numerics are identical.  Single-device
+        only for now (stacked batches are not re-sharded over dp)."""
         assert self._train_step is not None, "call compile() first"
         if self.optimizer is None:
             raise ValueError("fit() requires compile(optimizer=...)")
@@ -916,14 +949,31 @@ class Model:
             loss_sum = None
             macc: Dict[str, Any] = {}
             t0 = time.time()
-            for _ in range(group.num_batches):
-                batch = group.next_batch()
-                self._rng, step_rng = jax.random.split(self._rng)
-                trainable, state, self.opt_state, loss, mvals = self._train_step(
-                    trainable, state, self.opt_state, step_rng, batch, lr)
+            spc = steps_per_call if self.mesh is None else 1
+            done = 0
+            while done < group.num_batches:
+                k = min(spc, group.num_batches - done)
+                if k > 1:
+                    batches = [group.next_batch() for _ in range(k)]
+                    stacked = tuple(jnp.stack(parts)
+                                    for parts in zip(*batches))
+                    self._rng, sub = jax.random.split(self._rng)
+                    rngs = jax.random.split(sub, k)
+                    (trainable, state, self.opt_state, loss,
+                     mvals) = self._get_train_block(k)(
+                        trainable, state, self.opt_state, rngs, stacked,
+                        lr)
+                else:
+                    batch = group.next_batch()
+                    self._rng, step_rng = jax.random.split(self._rng)
+                    (trainable, state, self.opt_state, loss,
+                     mvals) = self._train_step(
+                        trainable, state, self.opt_state, step_rng, batch,
+                        lr)
+                done += k
                 loss_sum = loss if loss_sum is None else loss_sum + loss
-                for k, v in mvals.items():
-                    macc[k] = v if k not in macc else macc[k] + v
+                for k2, v in mvals.items():
+                    macc[k2] = v if k2 not in macc else macc[k2] + v
             host_m = jax.device_get(macc)
             dt = time.time() - t0
             n = group.num_batches * batch_size
