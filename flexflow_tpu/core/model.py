@@ -481,10 +481,12 @@ class Model:
         return self._add_layer(OpType.BEAM_TOPK, [x],
                                dict(max_beam_width=max_beam_width), name)
 
-    def sampling(self, x: Tensor, top_p: float = 1.0, name=None) -> Tensor:
+    def sampling(self, x: Tensor, top_p: float = 1.0, top_k: int = 0,
+                 name=None) -> Tensor:
         self._dropout_count += 1  # shared per-layer RNG stream counter
         return self._add_layer(OpType.SAMPLING, [x], dict(
-            top_p=top_p, seed_offset=self._dropout_count), name)[0]
+            top_p=top_p, top_k=top_k,
+            seed_offset=self._dropout_count), name)[0]
 
     # mixture-of-experts family (reference: src/ops/{group_by,aggregate,
     # aggregate_spec,experts,cache,moe}.cc)
@@ -917,8 +919,9 @@ class Model:
 
         ``steps_per_call > 1`` fuses that many steps into one device
         program (lax.scan) — one dispatch per block instead of per step
-        (see _get_train_block); numerics are identical.  Single-device
-        only for now (stacked batches are not re-sharded over dp)."""
+        (see _get_train_block); numerics are identical.  Works under a
+        mesh too: the loader ships stacked batches with the dp sharding
+        on the per-step batch axis."""
         assert self._train_step is not None, "call compile() first"
         if self.optimizer is None:
             raise ValueError("fit() requires compile(optimizer=...)")
@@ -949,14 +952,15 @@ class Model:
             loss_sum = None
             macc: Dict[str, Any] = {}
             t0 = time.time()
-            spc = steps_per_call if self.mesh is None else 1
+            spc = steps_per_call
             done = 0
             while done < group.num_batches:
                 k = min(spc, group.num_batches - done)
                 if k > 1:
-                    batches = [group.next_batch() for _ in range(k)]
-                    stacked = tuple(jnp.stack(parts)
-                                    for parts in zip(*batches))
+                    # loader stacks on host and ships one [k,B,...] per
+                    # tensor with the batch-axis sharding intact (each
+                    # scanned slice keeps its dp shard)
+                    stacked = group.next_batches(k)
                     self._rng, sub = jax.random.split(self._rng)
                     rngs = jax.random.split(sub, k)
                     (trainable, state, self.opt_state, loss,

@@ -13,8 +13,9 @@ and CI always run — the fallback is seeded and labeled
 linearly-separable, so convergence thresholds remain meaningful.
 
 One deliberate deviation: reuters returns a rectangular int array (padded
-with 0 / truncated to ``maxlen``) instead of the reference's ragged lists
-— the layer API consumes arrays.
+with 0) instead of the reference's ragged lists — the layer API consumes
+arrays.  Over-``maxlen`` sequences are DROPPED, matching the reference's
+_remove_long_seq (reuters.py:70-71), never truncated.
 """
 
 from __future__ import annotations
@@ -122,14 +123,22 @@ class reuters:
         if got is not None:
             xs_raw, ys = got
             # the reference's artifact is a 1-D object array of ragged
-            # lists; rectangularize (truncate to maxlen, pad with 0) and
-            # apply the reference's preprocessing semantics
+            # lists; rectangularize (drop over-maxlen rows, pad with 0)
+            # per the reference's preprocessing semantics
             seqs = [list(s) for s in xs_raw]
+            ys = list(np.asarray(ys))
             if maxlen is None:
                 # +1: every sequence gains a start_char slot
                 maxlen_eff = max((len(s) for s in seqs), default=0) + 1
             else:
+                # the reference DROPS over-long sequences rather than
+                # truncating (_remove_long_seq keeps len < maxlen,
+                # reuters.py:70-71) — sample counts and label mix match
                 maxlen_eff = maxlen
+                kept = [(s, y) for s, y in zip(seqs, ys)
+                        if len(s) + 1 < maxlen]  # +1: start_char slot
+                seqs = [s for s, _ in kept]
+                ys = [y for _, y in kept]
             out = np.zeros((len(seqs), maxlen_eff), np.int64)
             for i, s in enumerate(seqs):
                 s = [start_char] + [w + index_from for w in s]
@@ -138,7 +147,7 @@ class reuters:
                         max(s, default=0) + 1, skip_top + 1)
                     s = [w if skip_top <= w < top else oov_char
                          for w in s]
-                out[i, :min(len(s), maxlen_eff)] = s[:maxlen_eff]
+                out[i, :len(s)] = s
             rng = np.random.default_rng(seed)
             order = rng.permutation(len(out))
             out, ys = out[order], np.asarray(ys, np.int64)[order]

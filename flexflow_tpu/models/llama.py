@@ -148,7 +148,8 @@ def _finish_serving_graph(model: Model, final_hidden, vocab_size: int,
     elif gen.do_sample:
         scaled = model.scalar_true_divide(lm_head, max(gen.temperature, 1e-6),
                                           name="temp_scale")
-        model.sampling(scaled, top_p=gen.topp, name="sampling")
+        model.sampling(scaled, top_p=gen.topp, top_k=gen.topk,
+                       name="sampling")
     else:
         model.arg_max(lm_head, name="argmax")
     return model

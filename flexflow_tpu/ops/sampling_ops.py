@@ -117,6 +117,10 @@ class Sampling(OpDef):
     Sort-descending + cumsum + renormalised categorical, all in one jitted
     graph.  Matches the reference semantics: keep the smallest prefix with
     cumulative prob >= top_p (always keeping the first token).
+
+    ``top_k > 0`` additionally restricts candidates to the k highest
+    logits before the top-p cut (the GenerationConfig.topk knob the
+    reference declares, serve.py:44, but never consumes; 0 = disabled).
     """
 
     type = OpType.SAMPLING
@@ -134,6 +138,9 @@ class Sampling(OpDef):
         csum = jnp.cumsum(sorted_p, axis=-1)
         # keep tokens whose *preceding* mass < top_p (first token always kept)
         keep = (csum - sorted_p) < top_p
+        top_k = attrs.get("top_k", 0)
+        if top_k > 0 and top_k < x.shape[-1]:  # <=0 disabled (no NaN mask)
+            keep = keep & (jnp.arange(x.shape[-1]) < top_k)
         masked = jnp.where(keep, sorted_p, 0.0)
         masked = masked / masked.sum(axis=-1, keepdims=True)
         assert ctx.rng is not None, "Sampling op needs ctx.rng"

@@ -18,8 +18,9 @@ Schema (reference substitution_loader.h):
 How the rules act here: the reference applies a matched rule by literally
 rewriting the PCG — inserting Repartition/Combine/Replicate/Reduction ops
 (GraphXfer::run, substitution.cc:791) — and a provided --substitution-json
-APPENDS its xfers to an always-generated base set
-(substitution.cc:1787-1800).  Under GSPMD those parallel ops are implied
+REPLACES the manually coded xfers (the else-branch at
+substitution.cc:1803 skips them when a JSON file is given).  Under GSPMD
+those parallel ops are implied
 by sharding annotations and the sharding-collapsed search space is
 already maximal over (dp, tp) degrees, so a loaded collection cannot add
 choices the base lacks, and its algebraic parallel-op identities are
@@ -271,10 +272,13 @@ def collection_choice_hints(collection: RuleCollection
     substitution.cc:1368-1382).  Returns {op_type: {(kind, dim, degree)}}
     with kind in {"partition", "replicate"}; dim 0 is the batch dim (a
     data-parallel rewrite), dim > 0 licenses weight/feature sharding (tp).
-    The strategy search treats a provided collection the way the
-    reference treats --substitution-json: it REPLACES the generated xfer
-    set, restricting tp choices to licensed degrees
-    (search/substitution.py node_choices).
+    In the reference a supplied --substitution-json REPLACES the manually
+    coded xfers (substitution.cc:1803 else-branch skips them).  Here the
+    sharding-collapsed strategy space already subsumes every rule in the
+    reference's shipped collections, so graph_optimize only loads +
+    validates a provided collection and WARNS about licenses the space
+    cannot express — strategies are unchanged (an invariant the tests
+    pin, tests/test_substitution_loader.py).
     """
     hints: Dict[OpType, Set[Tuple[str, int, int]]] = {}
     for rule in collection.rules:

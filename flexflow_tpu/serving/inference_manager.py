@@ -107,12 +107,19 @@ def fuse_qkv(model) -> None:
     2x[E,KV,D]) into one wqkv [E,H+2KV,D] (and biases into bqkv) so the
     projection is a single matmul.  Single-device only: under tp the
     q and kv heads shard at different granularities, and quantized
-    attention keeps its per-weight scales — both skip the fusion."""
+    attention keeps its per-weight scales — both skip the fusion.
+    Offloaded (pinned_host) projections also skip it: jnp.concatenate
+    would materialize the fused weight in device HBM, silently undoing
+    --offload exactly when HBM is short."""
     for layer in model.layers:
         if layer.op_type not in SERVING_ATTENTION_OPS:
             continue
         lp = model.params.get(layer.name)
         if lp is None or "wq" not in lp or "wq_q" in lp:
+            continue
+        if any(getattr(getattr(lp.get(n), "sharding", None),
+                       "memory_kind", None) not in (None, "device")
+               for n in ("wq", "wk", "wv")):
             continue
         fused = dict(lp)
         fused["wqkv"] = jnp.concatenate(

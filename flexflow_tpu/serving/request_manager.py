@@ -43,7 +43,11 @@ class GenerationConfig:
     do_sample: bool = False
     temperature: float = 0.9
     topp: float = 0.8
-    topk: int = 1
+    # top-k candidate cut applied before top-p (0 = disabled).  The
+    # reference declares topk=1 (serve.py:44) but never consumes it;
+    # honoring that literal default would silently turn every sampling
+    # run greedy, so the wired-up knob defaults to off instead.
+    topk: int = 0
 
 
 @dataclasses.dataclass

@@ -51,8 +51,7 @@ class SingleDataLoader:
         if self.shuffle:
             self._rng.shuffle(self._perm)
 
-    def next_batch(self) -> jax.Array:
-        """reference: SingleDataLoader::next_batch (dataloader.cc:208)."""
+    def _next_batch_host(self) -> np.ndarray:
         if self._idx + self.batch_size > self.num_samples:
             self.reset()
         sel = self._perm[self._idx: self._idx + self.batch_size]
@@ -62,9 +61,25 @@ class SingleDataLoader:
         # identical result via numpy fancy indexing otherwise
         from ..native import gather_rows
 
-        host = gather_rows(self.data, sel)
+        return gather_rows(self.data, sel)
+
+    def next_batch(self) -> jax.Array:
+        """reference: SingleDataLoader::next_batch (dataloader.cc:208)."""
+        host = self._next_batch_host()
         if self.sharding is not None:
             return jax.device_put(host, self.sharding)
+        return jax.device_put(host)
+
+    def next_batches(self, k: int) -> jax.Array:
+        """k batches stacked into one [k, B, ...] transfer for fused
+        multi-step train blocks (lax.scan over the leading dim).  The
+        stack dim is unsharded; each scanned slice keeps the batch-axis
+        sharding, so every chip still receives only its dp shard."""
+        host = np.stack([self._next_batch_host() for _ in range(k)])
+        if self.sharding is not None:
+            spec = self.sharding.spec
+            return jax.device_put(host, NamedSharding(
+                self.sharding.mesh, PartitionSpec(None, *spec)))
         return jax.device_put(host)
 
 
@@ -98,3 +113,6 @@ class DataLoaderGroup:
 
     def next_batch(self) -> Tuple[jax.Array, ...]:
         return tuple(ld.next_batch() for ld in self.loaders)
+
+    def next_batches(self, k: int) -> Tuple[jax.Array, ...]:
+        return tuple(ld.next_batches(k) for ld in self.loaders)

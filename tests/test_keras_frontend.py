@@ -155,7 +155,10 @@ def test_datasets_cached_reference_formats(monkeypatch, tmp_path):
         seqs[i] = list(range(1, 4 + i))
     np.savez(tmp_path / "reuters.npz", x=seqs, y=np.arange(10) % 3)
     (xtr, ytr), (xte, yte) = reuters.load_data(num_words=6, maxlen=8)
-    assert xtr.shape[1] == 8 and len(xtr) + len(xte) == 10
+    # the reference DROPS over-maxlen sequences (_remove_long_seq keeps
+    # len < maxlen after the start_char prepend): lengths 3..12 (+1)
+    # leave only the 4 sequences shorter than 8
+    assert xtr.shape[1] == 8 and len(xtr) + len(xte) == 4
     assert xtr.max() < 6 + 1          # oov-capped (+start_char slot)
     (a, _), _ = reuters.load_data(test_split=0.0)
     assert len(a) == 10               # test_split=0 keeps all in train

@@ -149,6 +149,15 @@ def test_sampling_heads():
     ctx = OpContext(rng=jax.random.PRNGKey(0))
     (s,) = run_op(OpType.SAMPLING, dict(top_p=1e-6), [x], ctx=ctx)
     assert int(s[0]) == 1
+    # top_k=1 forces greedy regardless of top_p; top_k=2 restricts the
+    # candidate set to the two highest logits (GenerationConfig.topk)
+    (s1,) = run_op(OpType.SAMPLING, dict(top_p=1.0, top_k=1), [x], ctx=ctx)
+    assert int(s1[0]) == 1
+    draws = [int(run_op(OpType.SAMPLING, dict(top_p=1.0, top_k=2,
+                                              seed_offset=i), [x],
+                        ctx=OpContext(rng=jax.random.PRNGKey(i)))[0][0])
+             for i in range(20)]
+    assert set(draws) <= {1, 2} and len(set(draws)) == 2
 
 
 def test_beam_topk_logprobs():

@@ -195,6 +195,46 @@ class TestServingIntegration:
         rm.generate_incr_decoding(im, mid, [req])
         assert len(req.tokens) == 3 + 4
 
+    def test_offloaded_attention_skips_qkv_fusion(self):
+        """fuse_qkv must not pull pinned_host (offloaded) q/k/v
+        projections into device HBM: offloaded layers keep their separate
+        weights and memory kind through compile (--offload contract).
+        Non-offloaded attention layers in the same model still fuse."""
+        import jax
+
+        from flexflow_tpu import FFConfig, Model
+        from flexflow_tpu.models.llama import LLAMAConfig, create_llama_model
+        from flexflow_tpu.serving import InferenceManager
+        from flexflow_tpu.serving.inference_manager import \
+            SERVING_ATTENTION_OPS
+
+        cfg = LLAMAConfig(vocab_size=128, hidden_size=32,
+                          intermediate_size=64, num_hidden_layers=2,
+                          num_attention_heads=4, num_key_value_heads=2,
+                          max_position_embeddings=128)
+        model = Model(FFConfig(), name="offl")
+        create_llama_model(model, cfg, max_requests=2)
+        model.params = model.init_params(jax.random.PRNGKey(0))
+        attn = [l.name for l in model.layers
+                if l.op_type in SERVING_ATTENTION_OPS]
+        assert len(attn) == 2
+        # offload the first attention layer's projections (the shape
+        # serve.py's --offload produces for weights that spill to host)
+        host = jax.sharding.SingleDeviceSharding(
+            jax.devices()[0], memory_kind="pinned_host")
+        lp = model.params[attn[0]]
+        for n in ("wq", "wk", "wv"):
+            lp[n] = jax.device_put(lp[n], host)
+        im = InferenceManager(model.config)
+        im.compile_model_and_allocate_buffer(
+            model, max_requests=2, max_seq_length=32,
+            cache_dtype=np.float32)
+        off = model.params[attn[0]]
+        assert "wqkv" not in off and "wq" in off
+        assert off["wq"].sharding.memory_kind == "pinned_host"
+        fused = model.params[attn[1]]
+        assert "wqkv" in fused and "wq" not in fused
+
     def test_quantize_skips_non_linear(self):
         from flexflow_tpu import FFConfig, Model
         from flexflow_tpu.fftype import ActiMode
