@@ -207,6 +207,59 @@ def quantize_model_params(model, mode: Optional[str],
             model.params[layer.name] = out
 
 
+def _quantize_int8_nd_device(w, reduce_axes):
+    """jnp twin of :func:`quantize_int8_nd` — runs where ``w`` lives (no
+    host round trip; essential when init streams a 7B model layer by
+    layer over a network-attached chip)."""
+    scale = jnp.abs(w).max(axis=tuple(reduce_axes)) / 127.0
+    scale = jnp.where(scale == 0, 1.0, scale).astype(jnp.float32)
+    expand = scale[(jnp.newaxis,) * len(reduce_axes)]
+    q = jnp.clip(jnp.rint(w / expand), -127, 127).astype(jnp.int8)
+    return q, scale
+
+
+def init_quantized_params(model, mode: str = "int8", seed: int = 0,
+                          dtype=None) -> None:
+    """Random-init ``model.params`` directly in int8, one layer at a
+    time, entirely ON DEVICE: the full-precision tensor exists only
+    transiently per layer, so models whose f32 weights exceed HBM (e.g.
+    7B on one 16 GB chip) can still be built for benchmarking/serving
+    without a checkpoint.  Non-quantizable params (norms, biases,
+    embeddings) init at ``dtype`` (default: the model's computation
+    dtype)."""
+    import jax
+
+    assert mode == "int8", "on-device init supports int8 (int4 packing " \
+                           "is a host-side checkpoint-load path)"
+    cdt = jnp.dtype(dtype or model.config.computation_dtype)
+    rng = jax.random.PRNGKey(seed)
+    model.params = {}
+    for layer in model.layers:
+        if not layer.param_specs:
+            continue
+        lp = {}
+        for ps in layer.param_specs:
+            rng, sub = jax.random.split(rng)
+            lp[ps.name] = ps.initializer(sub, ps.shape, jnp.float32,
+                                         fans=ps.fans)
+        if layer.op_type is OpType.LINEAR and "kernel" in lp:
+            q, s = _quantize_int8_nd_device(lp.pop("kernel"), (0,))
+            lp["kernel_q"], lp["kernel_scale"] = q, s
+        elif layer.op_type in SERVING_ATTENTION_TYPES:
+            for wname, axes in ATTENTION_WEIGHTS.items():
+                if wname not in lp:
+                    continue
+                q, s = _quantize_int8_nd_device(lp.pop(wname), axes)
+                lp[wname + "_q"], lp[wname + "_scale"] = q, s
+        # cast the leftovers (norm weights, biases, embeddings; scales
+        # stay f32 by the quantizers' convention)
+        lp = {n: (v if n.endswith(("_q", "_scale")) else v.astype(cdt))
+              for n, v in lp.items()}
+        # materialize now so the transient f32 frees before the next layer
+        lp = {n: jax.block_until_ready(v) for n, v in lp.items()}
+        model.params[layer.name] = lp
+
+
 def extend_quantized_pspecs(pspecs, params):
     """Give quantized params the shardings of the weights they replace
     (``x_q`` inherits x's spec; ``x_scale`` takes the trailing axes of x's

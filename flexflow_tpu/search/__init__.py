@@ -42,7 +42,9 @@ def graph_optimize(model, machine: Optional[MachineModel] = None,
                    memory_limit: Optional[int] = None,
                    only_data_parallel: bool = False,
                    use_mcmc: bool = False, seed: int = 0,
-                   substitution_json: Optional[str] = None
+                   substitution_json: Optional[str] = None,
+                   cost_model: Optional[MeasuredCostModel] = None,
+                   max_pipeline: int = 1
                    ) -> Tuple[Dict[str, ShardAssignment], CostMetrics]:
     """Find a per-layer sharding strategy (reference graph_optimize_task,
     graph.cc:2108).
@@ -51,8 +53,21 @@ def graph_optimize(model, machine: Optional[MachineModel] = None,
     is set and the unconstrained optimum exceeds it, re-searches with
     decreasing run-time weight lambda until the strategy fits — a binary
     search exactly like try_one_lambda (graph.cc:2117-2192).
+
+    ``cost_model``: a :class:`MeasuredCostModel` routes every per-node
+    cost query through its on-chip timing cache (the reference's measured
+    search, simulator.cc:519-560) instead of the analytic roofline.
+
+    ``max_pipeline`` > 1 additionally searches pipeline-stage splits: for
+    each stage count pp dividing the device count, the per-node (dp, tp,
+    sp) search runs with num_devices/pp devices per stage, blocks are
+    cost-balanced into stages (balanced_partition), and candidates
+    compare on steady-state pipeline cost (bottleneck stage + boundary
+    p2p, PCG.pipeline_cost) — the analogue of the reference searching
+    MachineViews with per-stage start_device_id (graph.cc:1993-2024).
     """
     pcg = PCG(model)
+    est = cost_model.est if cost_model is not None else None
     # a supplied MachineModel's scale wins over the local device count —
     # searching for a machine you don't have is the normal use
     num_devices = (num_devices
@@ -71,8 +86,8 @@ def graph_optimize(model, machine: Optional[MachineModel] = None,
         return strategy, cost
 
     search = mcmc_optimize if use_mcmc else generic_sequence_optimize
-    kwargs = (dict(iterations=budget, seed=seed) if use_mcmc
-              else dict(budget=budget, alpha=alpha))
+    kwargs = (dict(iterations=budget, seed=seed, est=est) if use_mcmc
+              else dict(budget=budget, alpha=alpha, est=est))
     if substitution_json:
         # the reference's --substitution-json appends JSON xfers to an
         # always-generated base set (substitution.cc:1787-1800).  In the
@@ -98,8 +113,31 @@ def graph_optimize(model, machine: Optional[MachineModel] = None,
                 f"without a tensor-parallel lowering (ignored): "
                 f"{unlowerable}")
 
-    strategy, _ = search(pcg, machine, num_devices, **kwargs)
-    cost = pcg.strategy_cost(strategy, machine)
+    def run_at_pp(pp: int, mem_factor: float = 1.0):
+        """Search with num_devices/pp per stage; pp > 1 balances blocks
+        into stages and costs at the pipeline bottleneck."""
+        nd = num_devices // pp
+        s, _ = search(pcg, machine, nd, **(
+            dict(kwargs, mem_factor=mem_factor) if mem_factor != 1.0
+            else kwargs))
+        if pp > 1:
+            s = assign_pipeline_stages(pcg, pp, machine, s, est=est)
+            return s, pcg.pipeline_cost(s, machine, est=est)
+        return s, pcg.strategy_cost(s, machine, est=est)
+
+    pps = [p for p in range(1, max_pipeline + 1)
+           if num_devices % p == 0] or [1]
+
+    def best_over_pp(mem_factor: float = 1.0):
+        cands = [run_at_pp(p, mem_factor) for p in pps]
+        if memory_limit is not None:
+            fitting = [sc for sc in cands
+                       if sc[1].memory <= memory_limit]
+            if fitting:   # deeper pipelines trade speed for capacity
+                return min(fitting, key=lambda sc: sc[1].total_time)
+        return min(cands, key=lambda sc: sc[1].total_time)
+
+    strategy, cost = best_over_pp()
     if memory_limit is None or cost.memory <= memory_limit:
         return strategy, cost
 
@@ -109,8 +147,7 @@ def graph_optimize(model, machine: Optional[MachineModel] = None,
     c = cost
     for _ in range(8):
         lam = (lo + hi) / 2
-        s, _ = search(pcg, machine, num_devices, mem_factor=lam, **kwargs)
-        c = pcg.strategy_cost(s, machine)
+        s, c = best_over_pp(mem_factor=lam)
         if c.memory <= memory_limit:
             best_fit = (s, c)
             lo = lam          # fits: try weighting runtime more again

@@ -196,9 +196,9 @@ def op_flops_bytes(layer, out_shapes) -> Tuple[int, int, int]:
 
 
 def estimate_op_cost(layer, out_shapes, machine: MachineModel,
-                     dp: int = 1, tp: int = 1,
+                     dp: int = 1, tp: int = 1, sp: int = 1,
                      batch_dim_size: Optional[int] = None) -> CostMetrics:
-    """Roofline cost of one layer under (dp, tp) sharding.
+    """Roofline cost of one layer under (dp, tp, sp) sharding.
 
     - dp shards the batch dim: per-device flops/bytes divide by dp; gradient
       sync adds an allreduce of the weights over dp (the reference's NCCL
@@ -206,11 +206,14 @@ def estimate_op_cost(layer, out_shapes, machine: MachineModel,
     - tp shards weights/heads: flops and weight memory divide by tp; one
       activation allreduce of the output over tp (the reference's inserted
       AllReduce, model.cc:3292).
+    - sp shards the sequence dim (ring attention, ops/ring_attention.py):
+      compute divides like dp (weights replicate) but attention pays
+      (sp-1) ring hops of its K/V shards over ICI.
     """
     flops, act_bytes, w_bytes = op_flops_bytes(layer, out_shapes)
-    shard = dp * tp
+    shard = dp * tp * sp
     # weights stream from HBM every step and shard only over tp (replicated
-    # across dp) — at small batch (serving decode) this term dominates.
+    # across dp/sp) — at small batch (serving decode) this term dominates.
     # Gather-style ops (embedding: flops == 0) touch only the rows used,
     # already counted in act_bytes, not the whole table.
     w_stream = w_bytes / tp if flops else 0.0
@@ -220,25 +223,37 @@ def estimate_op_cost(layer, out_shapes, machine: MachineModel,
     bwd = 2 * compute if w_bytes else compute  # dX and dW matmuls
     sync = 0.0
     if tp > 1 and w_bytes:
-        out_act = sum(_prod(s) for s in out_shapes) * 4 // dp
+        out_act = sum(_prod(s) for s in out_shapes) * 4 // (dp * sp)
         sync += machine.allreduce_time(out_act, tp)          # fwd activations
         sync += machine.allreduce_time(out_act, tp)          # bwd d(input)
     if dp > 1 and w_bytes:
         sync += machine.allreduce_time(w_bytes // tp, dp)    # grad allreduce
+    if sp > 1:
+        # ring attention: each device forwards its K/V shard sp-1 times
+        # (ppermute); K+V together ~ input activation bytes
+        kv_shard = act_bytes // shard
+        sync += (sp - 1) * machine.p2p_time(kv_shard)
+        if w_bytes:   # grads of replicated weights also sum over sp
+            sync += machine.allreduce_time(w_bytes // tp, sp)
     mem = w_bytes // tp + act_bytes // shard
     return CostMetrics(fwd, bwd, sync, mem)
 
 
-def resharding_cost(tensor_bytes: int, src: Tuple[int, int],
-                    dst: Tuple[int, int], machine: MachineModel) -> float:
-    """Cost of moving a tensor between (dp, tp) layouts (reference:
+def resharding_cost(tensor_bytes: int, src: Tuple[int, ...],
+                    dst: Tuple[int, ...], machine: MachineModel) -> float:
+    """Cost of moving a tensor between (dp, tp[, sp]) layouts (reference:
     Simulator::estimate_xfer_cost, simulator.cc:604 + repartition cost
     :562-600).  Identical layouts are free; otherwise approximate as an
     allgather out of the finer layout plus a repartition into the new one.
+    (dp=2,sp=1) vs (dp=1,sp=2) differ — batch- vs sequence-sharded — so
+    layouts compare by the full tuple, not the partition product.
     """
+    src = tuple(src) + (1,) * (3 - len(src))
+    dst = tuple(dst) + (1,) * (3 - len(dst))
     if src == dst:
         return 0.0
-    src_parts, dst_parts = src[0] * src[1], dst[0] * dst[1]
+    src_parts = src[0] * src[1] * src[2]
+    dst_parts = dst[0] * dst[1] * dst[2]
     t = 0.0
     if src_parts > 1:
         t += machine.allgather_time(tensor_bytes, src_parts)
@@ -255,31 +270,120 @@ class MeasuredCostModel:
     — with the same memoization as simulator.cc:523-537.
     """
 
-    def __init__(self, machine: MachineModel, repeats: int = 3):
+    def __init__(self, machine: MachineModel, repeats: int = 3,
+                 auto_measure: bool = False):
         self.machine = machine
         self.repeats = repeats
         self.cache: Dict[Tuple, float] = {}
+        # auto_measure: build + time a jitted per-shard forward for ops
+        # the runner supports (compute ops with plain forward()); serving
+        # attention needs cache/batch plumbing and falls back to the
+        # roofline
+        self.auto_measure = auto_measure
 
-    def _key(self, layer, out_shapes, dp, tp):
+    def _key(self, layer, out_shapes, dp, tp, sp=1):
         return (layer.op_type.value,
                 tuple(tuple(t.spec.shape) for t in layer.inputs),
-                tuple(tuple(s) for s in out_shapes), dp, tp)
+                tuple(tuple(s) for s in out_shapes), dp, tp, sp)
 
     def measure(self, layer, out_shapes, dp: int = 1, tp: int = 1,
+                sp: int = 1,
                 run: Optional[Callable[[], None]] = None) -> CostMetrics:
-        est = estimate_op_cost(layer, out_shapes, self.machine, dp, tp)
-        key = self._key(layer, out_shapes, dp, tp)
+        est = estimate_op_cost(layer, out_shapes, self.machine, dp, tp, sp)
+        key = self._key(layer, out_shapes, dp, tp, sp)
         if key in self.cache:
             fwd = self.cache[key]
-        elif run is None:
-            fwd = est.forward_time
+        elif run is not None:
+            fwd = self.cache[key] = self._time(run)
+        elif self.auto_measure:
+            # the runner shards only the batch dims (one chip cannot run
+            # a tp-sharded op in isolation), so time the (dp, sp, tp=1)
+            # shape and scale by the analytic tp ratio — measuring the
+            # full-tp shapes directly would make tp look like zero gain
+            k1 = self._key(layer, out_shapes, dp, 1, sp)
+            if k1 not in self.cache:
+                run1 = make_op_runner(layer, dp, sp)
+                if run1 is None:
+                    self.cache[k1] = None     # unmeasurable: roofline
+                else:
+                    self.cache[k1] = self._time(run1)
+            base = self.cache[k1]
+            if base is None:
+                fwd = est.forward_time
+            else:
+                est1 = estimate_op_cost(layer, out_shapes, self.machine,
+                                        dp, 1, sp)
+                ratio = (est.forward_time / est1.forward_time
+                         if est1.forward_time > 0 else 1.0)
+                fwd = self.cache[key] = base * ratio
         else:
-            run()  # compile + warm
-            t0 = time.perf_counter()
-            for _ in range(self.repeats):
-                run()
-            fwd = (time.perf_counter() - t0) / self.repeats
-            self.cache[key] = fwd
+            fwd = est.forward_time
         scale = fwd / est.forward_time if est.forward_time > 0 else 1.0
         return CostMetrics(fwd, est.backward_time * scale, est.sync_time,
                            est.memory)
+
+    def _time(self, run: Callable[[], None]) -> float:
+        run()  # compile + warm
+        t0 = time.perf_counter()
+        for _ in range(self.repeats):
+            run()
+        return (time.perf_counter() - t0) / self.repeats
+
+    def est(self, layer, out_shapes, machine, dp: int = 1, tp: int = 1,
+            sp: int = 1) -> CostMetrics:
+        """Drop-in estimator for PCG.strategy_cost(est=...): routes the
+        search's per-node cost queries through the measurement cache —
+        the reference's measured search mode (simulator.cc:519-560)."""
+        return self.measure(layer, out_shapes, dp, tp, sp)
+
+
+def make_op_runner(layer, dp: int = 1,
+                   sp: int = 1) -> Optional[Callable[[], None]]:
+    """Build a timed per-shard forward for one layer (the reference's
+    Op::inner_measure_operator_cost, operator.h:152-155): random inputs at
+    the batch shard size (dp*sp divides the leading dim), zero-init
+    params, one jitted call per invocation.  Returns None for ops whose
+    forward needs serving plumbing (KV caches / batch configs) — the
+    caller falls back to the roofline for those."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..fftype import OpType
+    from ..ops.registry import OpContext, get_op
+
+    if layer.op_type in (OpType.INC_MULTIHEAD_SELF_ATTENTION,
+                         OpType.SPEC_INC_MULTIHEAD_SELF_ATTENTION,
+                         OpType.TREE_INC_MULTIHEAD_SELF_ATTENTION,
+                         OpType.INPUT, OpType.NOOP):
+        return None
+    op = get_op(layer.op_type)
+    div = max(1, dp * sp)
+    if any(t.spec.shape and t.spec.shape[0] % div
+           for t in layer.inputs):
+        return None   # shard doesn't divide the batch: roofline fallback
+    try:
+        key = jax.random.PRNGKey(0)
+        ins = []
+        for t in layer.inputs:
+            shape = list(t.spec.shape)
+            if shape:
+                shape[0] //= div
+            dt = t.spec.dtype.to_jnp()
+            if jnp.issubdtype(dt, jnp.integer):
+                ins.append(jnp.zeros(shape, dt))
+            else:
+                key, sub = jax.random.split(key)
+                ins.append(jax.random.normal(sub, shape, dt))
+        params = {p.name: jnp.zeros(p.shape, p.dtype.to_jnp())
+                  for p in layer.param_specs}
+
+        fn = jax.jit(lambda pr, xs: op.forward(
+            pr, xs, layer.attrs, OpContext(training=False)))
+        fn(params, ins)  # tracing succeeds -> runnable
+
+        def run():
+            jax.block_until_ready(fn(params, ins))
+
+        return run
+    except Exception:
+        return None

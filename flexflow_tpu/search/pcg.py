@@ -27,19 +27,31 @@ TP_CAPABLE = {
     OpType.EMBEDDING,
 }
 
+# ops that admit sequence parallelism (ring attention over ppermute,
+# ops/ring_attention.py — a dimension the reference cannot search at all,
+# SURVEY §5 "sequence parallelism: absent")
+SP_CAPABLE = {
+    OpType.MULTIHEAD_ATTENTION,
+    OpType.INC_MULTIHEAD_SELF_ATTENTION,
+    OpType.SPEC_INC_MULTIHEAD_SELF_ATTENTION,
+    OpType.TREE_INC_MULTIHEAD_SELF_ATTENTION,
+}
+
 
 @dataclasses.dataclass(frozen=True)
 class ShardAssignment:
     """Per-node parallelization choice (reference MachineView,
     machine_view.h:18-39: here degrees over named mesh axes instead of
-    device-id strides)."""
+    device-id strides).  ``sp`` is the sequence-parallel degree (ring
+    attention) — a search dimension the reference lacks."""
 
     dp: int = 1
     tp: int = 1
     pp_stage: int = 0
+    sp: int = 1
 
     def degree(self) -> int:
-        return self.dp * self.tp
+        return self.dp * self.tp * self.sp
 
 
 @dataclasses.dataclass
@@ -99,16 +111,20 @@ class PCG:
 
     # ----------------------------------------------------------------- cost
     def strategy_cost(self, strategy: Dict[str, ShardAssignment],
-                      machine: MachineModel) -> CostMetrics:
+                      machine: MachineModel, est=None) -> CostMetrics:
         """Graph cost under a strategy: per-node roofline + edge resharding
-        (reference SearchHelper DP composition, graph.cc:1206-1281)."""
+        (reference SearchHelper DP composition, graph.cc:1206-1281).
+
+        ``est`` overrides the per-node estimator — pass
+        ``MeasuredCostModel.est`` to run the search on real on-chip
+        timings (the reference's simulator.cc:519 measured mode)."""
+        est = est or estimate_op_cost
         total = CostMetrics()
         per_dev_mem = 0
         for layer in self.nodes:
             a = strategy.get(layer.name, ShardAssignment())
-            c = estimate_op_cost(
-                layer, [o.spec.shape for o in layer.outputs], machine,
-                dp=a.dp, tp=a.tp)
+            c = est(layer, [o.spec.shape for o in layer.outputs], machine,
+                    dp=a.dp, tp=a.tp, sp=a.sp)
             total = total + CostMetrics(c.forward_time, c.backward_time,
                                         c.sync_time, 0)
             per_dev_mem += c.memory
@@ -116,13 +132,45 @@ class PCG:
         for e in self.edges:
             sa = strategy.get(e.src, ShardAssignment())
             da = strategy.get(e.dst, ShardAssignment())
-            xfer += resharding_cost(e.tensor_bytes, (sa.dp, sa.tp),
-                                    (da.dp, da.tp), machine)
+            xfer += resharding_cost(e.tensor_bytes,
+                                    (sa.dp, sa.tp, sa.sp),
+                                    (da.dp, da.tp, da.sp), machine)
             if sa.pp_stage != da.pp_stage:  # stage boundary: p2p activation
                 xfer += machine.p2p_time(e.tensor_bytes // sa.degree())
         total.sync_time += xfer
         total.memory = per_dev_mem
         return total
+
+    def pipeline_cost(self, strategy: Dict[str, ShardAssignment],
+                      machine: MachineModel, est=None) -> CostMetrics:
+        """Steady-state cost of a staged strategy: the bottleneck stage
+        bounds throughput once batches pipeline through the stages
+        (serving/pipeline_serving.py micro-batch overlap; the reference
+        gets the same overlap from its <=4 in-flight batches,
+        request_manager.cc:1946-1977).  Memory is the largest stage's
+        per-device footprint — the pp capacity win."""
+        est = est or estimate_op_cost
+        stage_time: Dict[int, float] = {}
+        stage_mem: Dict[int, int] = {}
+        for layer in self.nodes:
+            a = strategy.get(layer.name, ShardAssignment())
+            c = est(layer, [o.spec.shape for o in layer.outputs], machine,
+                    dp=a.dp, tp=a.tp, sp=a.sp)
+            stage_time[a.pp_stage] = (stage_time.get(a.pp_stage, 0.0)
+                                      + c.total_time)
+            stage_mem[a.pp_stage] = stage_mem.get(a.pp_stage, 0) + c.memory
+        xfer = 0.0
+        for e in self.edges:
+            sa = strategy.get(e.src, ShardAssignment())
+            da = strategy.get(e.dst, ShardAssignment())
+            xfer += resharding_cost(e.tensor_bytes,
+                                    (sa.dp, sa.tp, sa.sp),
+                                    (da.dp, da.tp, da.sp), machine)
+            if sa.pp_stage != da.pp_stage:
+                xfer += machine.p2p_time(e.tensor_bytes // sa.degree())
+        bottleneck = max(stage_time.values()) if stage_time else 0.0
+        return CostMetrics(bottleneck, 0.0, xfer,
+                           max(stage_mem.values()) if stage_mem else 0)
 
 
 # ------------------------------------------------------------- strategies
@@ -169,33 +217,39 @@ def balanced_partition(costs: List[float], k: int) -> List[int]:
 def assign_pipeline_stages(pcg: PCG, num_stages: int,
                            machine: MachineModel,
                            strategy: Optional[Dict[str, ShardAssignment]]
-                           = None) -> Dict[str, ShardAssignment]:
+                           = None, est=None) -> Dict[str, ShardAssignment]:
     """Balance transformer layers across stages by cost, not just count
     (refines the reference's layers_per_stage split,
-    inference_manager.cc:131, graph.cc:2016-2024)."""
+    inference_manager.cc:131, graph.cc:2016-2024).  Balancing uses the
+    SAME estimator (incl. sp degrees and measured timings) that
+    pipeline_cost scores the result with — a split computed from
+    different costs than its score would be systematically skewed."""
+    est = est or estimate_op_cost
     strategy = dict(strategy or
                     {l.name: ShardAssignment() for l in pcg.nodes})
     costs = []
     for l in pcg.nodes:
         a = strategy[l.name]
-        c = estimate_op_cost(l, [o.spec.shape for o in l.outputs], machine,
-                             dp=a.dp, tp=a.tp)
+        c = est(l, [o.spec.shape for o in l.outputs], machine,
+                dp=a.dp, tp=a.tp, sp=a.sp)
         costs.append(c.total_time)
     stages = balanced_partition(costs, num_stages)
     for l, s in zip(pcg.nodes, stages):
         a = strategy[l.name]
-        strategy[l.name] = ShardAssignment(a.dp, a.tp, s)
+        strategy[l.name] = ShardAssignment(a.dp, a.tp, s, a.sp)
     return strategy
 
 
 # ------------------------------------------------------- (de)serialization
 def strategy_to_json(strategy: Dict[str, ShardAssignment]) -> str:
-    return json.dumps({k: {"dp": v.dp, "tp": v.tp, "pp_stage": v.pp_stage}
+    return json.dumps({k: {"dp": v.dp, "tp": v.tp, "pp_stage": v.pp_stage,
+                           "sp": v.sp}
                        for k, v in strategy.items()}, indent=2)
 
 
 def strategy_from_json(s: str) -> Dict[str, ShardAssignment]:
-    return {k: ShardAssignment(v["dp"], v["tp"], v["pp_stage"])
+    return {k: ShardAssignment(v["dp"], v["tp"], v["pp_stage"],
+                               v.get("sp", 1))   # pre-sp exports load fine
             for k, v in json.loads(s).items()}
 
 

@@ -23,7 +23,8 @@ import itertools
 from typing import Dict, List, Optional, Tuple
 
 from .cost_model import MachineModel
-from .pcg import PCG, ShardAssignment, TP_CAPABLE, data_parallel_strategy
+from .pcg import (PCG, SP_CAPABLE, ShardAssignment, TP_CAPABLE,
+                  data_parallel_strategy)
 
 
 def _factor_pairs(n: int) -> List[Tuple[int, int]]:
@@ -33,6 +34,15 @@ def _factor_pairs(n: int) -> List[Tuple[int, int]]:
         if n % dp == 0:
             out.append((dp, n // dp))
     return out
+
+
+def _batch_extent(layer) -> Optional[int]:
+    """Leading-dim extent of the layer's first input (bounds dp: you
+    cannot batch-shard past the batch)."""
+    for t in layer.inputs:
+        if t.spec.shape:
+            return int(t.spec.shape[0])
+    return None
 
 
 def node_choices(layer, num_devices: int) -> List[ShardAssignment]:
@@ -46,19 +56,59 @@ def node_choices(layer, num_devices: int) -> List[ShardAssignment]:
     (substitution.cc:1787-1800), and in the sharding-collapsed search the
     base set subsumes any degree a rule could license, while the rules'
     algebraic parallel-op identities are rewrites GSPMD performs
-    mechanically (see search.graph_optimize / substitution_loader)."""
+    mechanically (see search.graph_optimize / substitution_loader).
+
+    Beyond the reference's space: attention nodes also offer sp (ring
+    sequence parallelism) degrees — dp is capped by the batch extent (a
+    batch of 1 long sequence cannot data-shard; the reference has no
+    dimension to offer there, SURVEY §5)."""
+    batch = _batch_extent(layer)
+
+    def dp_ok(dp: int) -> bool:
+        return batch is None or dp <= batch and batch % dp == 0
+
     choices = [ShardAssignment(dp=d)
-               for d in _divisors(num_devices)]
+               for d in _divisors(num_devices) if dp_ok(d)]
+    if not choices:
+        choices = [ShardAssignment()]
     if layer.op_type in TP_CAPABLE and layer.param_specs:
         for total in _divisors(num_devices):
             for dp, tp in _factor_pairs(total):
-                if tp > 1:
+                if tp > 1 and dp_ok(dp):
                     choices.append(ShardAssignment(dp=dp, tp=tp))
+    if layer.op_type in SP_CAPABLE:
+        for total in _divisors(num_devices):
+            for rest, sp in _factor_pairs(total):
+                if sp <= 1:
+                    continue
+                for dp, tp in _factor_pairs(rest):
+                    if dp_ok(dp) and (tp == 1 or (
+                            layer.op_type in TP_CAPABLE
+                            and layer.param_specs)):
+                        choices.append(
+                            ShardAssignment(dp=dp, tp=tp, sp=sp))
     return choices
 
 
 def _divisors(n: int) -> List[int]:
     return [d for d in range(1, n + 1) if n % d == 0]
+
+
+def feasible_dp_strategy(pcg: PCG, num_devices: int
+                         ) -> Dict[str, ShardAssignment]:
+    """Data-parallel start point clamped to each node's batch extent —
+    dp=num_devices on a batch-1 node is not a real strategy, and an
+    infeasible start would anchor the search on a cost the hardware
+    cannot realize."""
+    out = {}
+    for l in pcg.nodes:
+        batch = _batch_extent(l)
+        dp = num_devices
+        if batch is not None:
+            dp = max(d for d in _divisors(num_devices)
+                     if d <= batch and batch % d == 0)
+        out[l.name] = ShardAssignment(dp=dp)
+    return out
 
 
 def _lambda_cost(metrics, mem_factor: float) -> float:
@@ -72,7 +122,8 @@ def _lambda_cost(metrics, mem_factor: float) -> float:
 def base_optimize(pcg: PCG, machine: MachineModel, num_devices: int,
                   budget: int = 2000, alpha: float = 1.05,
                   mem_factor: float = 1.0,
-                  start: Optional[Dict[str, ShardAssignment]] = None
+                  start: Optional[Dict[str, ShardAssignment]] = None,
+                  est=None
                   ) -> Tuple[Dict[str, ShardAssignment], float]:
     """Best-first search over single-node assignment rewrites
     (reference base_optimize, substitution.cc:2245-2327; memory-aware
@@ -85,13 +136,14 @@ def base_optimize(pcg: PCG, machine: MachineModel, num_devices: int,
     """
     names = [l.name for l in pcg.nodes]
     choices = {l.name: node_choices(l, num_devices) for l in pcg.nodes}
-    start = start or data_parallel_strategy(pcg, num_devices)
+    start = start or feasible_dp_strategy(pcg, num_devices)
 
     def key(strategy):
         return tuple(strategy[n] for n in names)
 
     def cost(strategy):
-        return _lambda_cost(pcg.strategy_cost(strategy, machine), mem_factor)
+        return _lambda_cost(pcg.strategy_cost(strategy, machine, est=est),
+                            mem_factor)
 
     best, best_cost = dict(start), cost(start)
     seen = {key(start)}
@@ -124,7 +176,8 @@ def base_optimize(pcg: PCG, machine: MachineModel, num_devices: int,
 
 def generic_sequence_optimize(pcg: PCG, machine: MachineModel,
                               num_devices: int, budget: int = 2000,
-                              alpha: float = 1.05, mem_factor: float = 1.0
+                              alpha: float = 1.05, mem_factor: float = 1.0,
+                              est=None
                               ) -> Tuple[Dict[str, ShardAssignment], float]:
     """DP over sequence splits at bottleneck nodes (reference
     generic_sequence_optimize, substitution.cc:2588): optimize each
@@ -134,7 +187,7 @@ def generic_sequence_optimize(pcg: PCG, machine: MachineModel,
     cuts = pcg.bottleneck_nodes()
     if not cuts or len(pcg.nodes) <= 8:
         return base_optimize(pcg, machine, num_devices, budget, alpha,
-                             mem_factor)
+                             mem_factor, est=est)
     # split node list into segments at cut points
     order = pcg.topo_order()
     cut_set = set(cuts)
@@ -154,9 +207,9 @@ def generic_sequence_optimize(pcg: PCG, machine: MachineModel,
         # only at the final stitch)
         sub = _SubPCG(pcg, seg, frozen=strategy)
         s, _ = base_optimize(sub, machine, num_devices, per_seg_budget,
-                             alpha, mem_factor)
+                             alpha, mem_factor, est=est)
         strategy.update({n: s[n] for n in seg})
-    full = pcg.strategy_cost(strategy, machine)
+    full = pcg.strategy_cost(strategy, machine, est=est)
     return strategy, _lambda_cost(full, mem_factor)
 
 
@@ -182,13 +235,14 @@ class _SubPCG(PCG):
         self.out_edges = {n: [e for e in parent.out_edges[n]
                               if e.dst in keep] for n in names}
 
-    def strategy_cost(self, strategy, machine):
-        return super().strategy_cost({**self.frozen, **strategy}, machine)
+    def strategy_cost(self, strategy, machine, est=None):
+        return super().strategy_cost({**self.frozen, **strategy}, machine,
+                                     est=est)
 
 
 def mcmc_optimize(pcg: PCG, machine: MachineModel, num_devices: int,
                   iterations: int = 2000, temperature: float = 1e-4,
-                  seed: int = 0, mem_factor: float = 1.0
+                  seed: int = 0, mem_factor: float = 1.0, est=None
                   ) -> Tuple[Dict[str, ShardAssignment], float]:
     """MCMC fallback search (reference FFModel::mcmc_optimize,
     model.cc:3791): propose a random single-node assignment flip, accept
@@ -201,9 +255,10 @@ def mcmc_optimize(pcg: PCG, machine: MachineModel, num_devices: int,
     choices = {l.name: node_choices(l, num_devices) for l in pcg.nodes}
 
     def cost(strategy):
-        return _lambda_cost(pcg.strategy_cost(strategy, machine), mem_factor)
+        return _lambda_cost(pcg.strategy_cost(strategy, machine, est=est),
+                            mem_factor)
 
-    cur = data_parallel_strategy(pcg, num_devices)
+    cur = feasible_dp_strategy(pcg, num_devices)
     cur_cost = cost(cur)
     best, best_cost = dict(cur), cur_cost
     for _ in range(iterations):

@@ -140,6 +140,12 @@ class InferenceManager:
         self.config = config or FFConfig()
         self.mesh: Optional[Mesh] = None
         self.models: Dict[int, Dict[str, Any]] = {}  # model_id -> record
+        # host-sync odometer: bumped by RequestManager each time step
+        # results are materialized to numpy.  On a network-attached chip
+        # every sync costs a full round trip, so syncs-per-token is the
+        # serving path's key overhead metric (tests pin the decode-block
+        # paths to one sync per K tokens).
+        self.host_syncs = 0
 
     # ------------------------------------------------------------ compile
     def compile_model_and_allocate_buffer(
@@ -268,9 +274,12 @@ class InferenceManager:
         return mid
 
     def supports_decode_block(self, model_id: int) -> bool:
-        """Decode blocks fuse all layers into one program — incompatible
-        with stage-partitioned (pp) execution, which runs per-step."""
-        return "pp_stages" not in self.models[model_id]
+        """Decode blocks run for every layout: single/tp/sp models fuse
+        all layers into one lax.scan program; stage-partitioned (pp)
+        models run the micro-batched stage pipeline with device-resident
+        token feedback (pipeline_serving.pipeline_decode_block) — either
+        way, one host sync per K tokens."""
+        return True
 
     # --------------------------------------------------------------- step
     def _raw_step(self, record, reorder: bool):
@@ -364,7 +373,8 @@ class InferenceManager:
         step = self._raw_step(record, reorder=True)
         W = beam_width
 
-        def block(params, caches, batch, rngs, init_tok, init_cum):
+        def block(params, caches, batch, rngs, init_tok, init_cum,
+                  init_parents):
             assert rngs.shape[0] == d_steps, (rngs.shape, d_steps)
             RW = init_tok.shape[0]
             R = RW // W
@@ -393,18 +403,27 @@ class InferenceManager:
                           depth + active, rows_next)
                 return carry2, (tok_new, parent_b, top_val)
 
-            identity = jnp.arange(RW, dtype=jnp.int32)
+            # init_parents seeds the first step's cache-row gather: with
+            # single-row SSM prefill the shared prefix lives only in each
+            # request's beam row 0, so the first gather broadcasts it to
+            # all W rows (replacing the old W-times-duplicated prefill)
             carry = (caches, init_tok, init_cum, batch["first_depth"],
-                     identity)
+                     init_parents)
             (caches, *_), hist = jax.lax.scan(body, carry, rngs)
             return hist, caches   # each [d_steps, R, W]
 
         return jax.jit(block, donate_argnums=(1,))
 
     def beam_block(self, model_id: int, bc, d_steps: int,
-                   init_tokens, init_cum_logp, rng=None):
+                   init_tokens, init_cum_logp, rng=None,
+                   init_parent_rows=None):
         """Run the fused beam expansion; returns host numpy
-        (tokens, parent_beams, cum_logps), each [d_steps, R, W]."""
+        (tokens, parent_beams, cum_logps), each [d_steps, R, W].
+
+        ``init_parent_rows``: per-beam-row cache source for the FIRST
+        step's gather (default: each row itself).  spec_infer passes each
+        request's beam row 0 so the once-prefillled prefix cache
+        broadcasts to the whole beam."""
         record = self.models[model_id]
         W = bc.beam_width
         assert W == record["beam_width"], (
@@ -416,6 +435,8 @@ class InferenceManager:
         batch = {name: jnp.asarray(v) for name, v in bc.pack().items()}
         if rng is None:
             rng = jax.random.PRNGKey(0)
+        if init_parent_rows is None:
+            init_parent_rows = np.arange(record["rows"], dtype=np.int32)
         key = ("beam_block", d_steps, W)
         if key not in record["steps"]:
             record["steps"][key] = self._build_beam_block(record, d_steps,
@@ -424,7 +445,8 @@ class InferenceManager:
             record["model"].params, record["caches"], batch,
             jax.random.split(rng, d_steps),
             jnp.asarray(init_tokens, jnp.int32),
-            jnp.asarray(init_cum_logp, jnp.float32))
+            jnp.asarray(init_cum_logp, jnp.float32),
+            jnp.asarray(init_parent_rows, jnp.int32))
         toks, parents, cums = hist
         return (np.asarray(toks), np.asarray(parents), np.asarray(cums))
 
@@ -496,9 +518,14 @@ class InferenceManager:
             # largest pow2 within the safe bound — rows must not scatter
             # past max_seq_length + slack
             k = 1 << (max(1, safe).bit_length() - 1)
-        batch = {name: jnp.asarray(v) for name, v in bc.pack().items()}
         if rng is None:
             rng = jax.random.PRNGKey(0)
+        if "pp_stages" in record:
+            from .pipeline_serving import pipeline_decode_block
+
+            return pipeline_decode_block(self, record, model_id, bc, k,
+                                         rng, init_tokens)
+        batch = {name: jnp.asarray(v) for name, v in bc.pack().items()}
         include_init = init_tokens is not None
         if init_tokens is None:
             init_tokens = batch["token_ids"][:, 0]

@@ -70,8 +70,23 @@ class ProfileInfo:
     ssm_decoding_steps: int = 0
     speculated_tokens: int = 0
     accepted_tokens: int = 0
+    # SSM-prefill dedup accounting: chunks = prefill batches this request
+    # took part in, rows = beam rows fed across them.  rows == chunks
+    # proves the prefix was prefilled once per chunk and broadcast to the
+    # beam on device (not recomputed W times per chunk).
+    ssm_prefill_chunks: int = 0
+    ssm_prefill_rows: int = 0
     start_time: float = 0.0
+    # host-observed time the first generated token became available (the
+    # p50-TTFT ingredient, BASELINE.md north-star metric); under decode
+    # blocks this is the first block's sync — what a streaming server
+    # could actually emit
+    first_token_time: float = 0.0
     finish_time: float = 0.0
+
+    def note_first_token(self):
+        if self.first_token_time == 0.0:
+            self.first_token_time = time.time()
 
 
 class Request:
@@ -205,6 +220,7 @@ class RequestManager:
                     # the sample at the span's last column is the next token
                     tok = int(prev_result.token_ids[row, n - 1])
                     req.tokens.append(tok)
+                    req.profile.note_first_token()
                     if self._finished(req, tok):
                         self._retire(req)
 
@@ -270,6 +286,7 @@ class RequestManager:
                     req.profile.llm_decoding_steps += 1
                 tok = int(toks[i, row])
                 req.tokens.append(tok)
+                req.profile.note_first_token()
                 if self._finished(req, tok):
                     self._retire(req)
                     break
@@ -316,6 +333,7 @@ class RequestManager:
                 toks = np.asarray(im.decode_block(
                     model_id, bc, k, step_rng,
                     min_remaining=self._min_remaining_budget()))
+                im.host_syncs += 1
                 self._fold_decode_block(bc, toks)
                 bc, result = None, None
                 continue
@@ -336,6 +354,7 @@ class RequestManager:
                 continue
             # final layer is a sampling head emitting [R, C] token ids
             result = InferenceResult(token_ids=np.asarray(outs[0]))
+            im.host_syncs += 1
         return [self._result_of(r) for r in requests]
 
     def _prefill_completes_all(self, bc: BatchConfig) -> bool:
@@ -378,6 +397,7 @@ class RequestManager:
         toks = np.asarray(im.decode_block(
             model_id, bc2, k, block_rng, init_tokens=init,
             min_remaining=max(1, self._min_remaining_budget() - 1)))
+        im.host_syncs += 1
         self._fold_decode_block(bc2, toks, handoff=True)
 
     def generate(self, im: InferenceManager, model_id: int,
@@ -410,6 +430,8 @@ class RequestManager:
                     "speculated_tokens": p.speculated_tokens,
                     "accepted_tokens": p.accepted_tokens,
                     "latency_s": p.finish_time - p.start_time,
+                    "ttft_s": (p.first_token_time - p.start_time
+                               if p.first_token_time else None),
                 }) + "\n")
 
     def _result_of(self, req: Request) -> GenerationResult:

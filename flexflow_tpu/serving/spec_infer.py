@@ -55,12 +55,28 @@ class SpecState:
 
     def __init__(self):
         self.llm_cached = 0
-        self.ssm_cached = 0
+        self.ssm_cached: Dict[int, int] = {}  # per-SSM cache watermark
         self.commit_src: List[int] = []
         self.commit_dst: List[int] = []
         self.tree: List[TreeNode] = []
         self.beam_nodes: List[int] = []  # live beam -> tree node index
         self.beam_logp: List[float] = []
+
+
+def _attach_child(st: SpecState, parent_node: int, tok: int, logp: float,
+                  cap: int) -> Optional[int]:
+    """Add (or find) a tree child; dedups shared prefixes across beams AND
+    across SSMs (reference merge_dfs_trees, request_manager.cc:1260).
+    Returns the node index, or None when the tree is at capacity."""
+    depth = st.tree[parent_node].depth + 1
+    for j, nd in enumerate(st.tree):
+        if (nd.parent == parent_node and nd.token == tok
+                and nd.depth == depth):
+            return j
+    if len(st.tree) >= cap:
+        return None
+    st.tree.append(TreeNode(tok, parent_node, depth, logp))
+    return len(st.tree) - 1
 
 
 def _build_tree_batch(rm, im_record, states: Dict[int, SpecState],
@@ -133,17 +149,22 @@ def _verify_walk(nodes: List[TreeNode], outputs: np.ndarray, start: int = 0
 
 
 def _ssm_prefill(rm, im, ssm_id, states, running, beam_width, seed_rng):
-    """Bring every beam row's SSM cache up to the committed prefix; returns
+    """Bring beam row 0's SSM cache up to the committed prefix; returns
     last-position beam candidates per row (reference
-    prepare_next_batch_init, request_manager.cc:554)."""
-    record = im.models[ssm_id]
+    prepare_next_batch_init, request_manager.cc:554).
+
+    Only row 0 per request is fed — the beam block's first cache gather
+    broadcasts the prefix to the other W-1 rows on device
+    (init_parent_rows), so the prefix compute is paid once instead of W
+    times per request (the reference also prefill-computes once: beam
+    sub-requests fork after init)."""
     results = {}
     while True:
         spans = {}
         for row, req in running.items():
             st = states[req.guid]
-            if st.ssm_cached < len(req.tokens):
-                spans[row] = req.tokens[st.ssm_cached:]
+            if st.ssm_cached.get(ssm_id, 0) < len(req.tokens):
+                spans[row] = req.tokens[st.ssm_cached.get(ssm_id, 0):]
         if not spans:
             break
         max_span = max(len(s) for s in spans.values())
@@ -156,14 +177,15 @@ def _ssm_prefill(rm, im, ssm_id, states, running, beam_width, seed_rng):
             if span is None:
                 continue
             n = min(len(span), chunk)
-            for b in range(beam_width):
-                rr = bc.row(row, b)
-                bc.request_guid[rr] = req.guid
-                bc.request_available[rr] = True
-                bc.first_token_depth[rr] = st.ssm_cached
-                bc.num_tokens_in_batch[rr] = n
-                bc.max_sequence_length[rr] = req.max_sequence_length
-                bc.token_ids[rr, :n] = span[:n]
+            rr = bc.row(row, 0)
+            bc.request_guid[rr] = req.guid
+            bc.request_available[rr] = True
+            bc.first_token_depth[rr] = st.ssm_cached.get(ssm_id, 0)
+            bc.num_tokens_in_batch[rr] = n
+            bc.max_sequence_length[rr] = req.max_sequence_length
+            bc.token_ids[rr, :n] = span[:n]
+            req.profile.ssm_prefill_chunks += 1
+            req.profile.ssm_prefill_rows += 1
         outs = im.inference(ssm_id, bc, rng=seed_rng)
         ids, parents, logps = (np.asarray(outs[0]), np.asarray(outs[1]),
                                np.asarray(outs[2]))
@@ -173,8 +195,8 @@ def _ssm_prefill(rm, im, ssm_id, states, running, beam_width, seed_rng):
             if span is None:
                 continue
             n = min(len(span), chunk)
-            st.ssm_cached += n
-            if st.ssm_cached >= len(req.tokens):
+            st.ssm_cached[ssm_id] = st.ssm_cached.get(ssm_id, 0) + n
+            if st.ssm_cached[ssm_id] >= len(req.tokens):
                 rr = bc.row(row, 0)
                 results[row] = (ids[rr, n - 1], logps[rr, n - 1])
     return results
@@ -187,15 +209,13 @@ def generate_spec_infer(rm, im, llm_id: int, requests: Sequence[Request],
                         ) -> List[GenerationResult]:
     """The SpecInfer macro-loop (reference request_manager.cc:1984-2070).
 
-    ``rm.ssm_model_ids[0]`` is the small speculator (the reference supports
-    several SSMs; we speculate with the first — the reference's own default
-    config does the same in practice).
+    Every registered SSM speculates each macro-iteration (the reference
+    iterates all SSMs, request_manager.cc:2031-2042); their candidate
+    trees merge into one shared per-request tree via prefix dedup
+    (merge_dfs_trees semantics) before a single LLM verify step.
     """
     assert rm.ssm_model_ids, "spec_infer needs a registered SSM"
-    ssm_id = rm.ssm_model_ids[0]
-    ssm_record = im.models[ssm_id]
-    W = beam_width or ssm_record["beam_width"]
-    D = beam_depth or BeamSearchBatchConfig.MAX_BEAM_DEPTH
+    ssm_ids = list(rm.ssm_model_ids)
     tree_chunk = rm.max_spec_tree_token_num
     rng = jax.random.PRNGKey(seed)
     states: Dict[int, SpecState] = {}
@@ -236,97 +256,106 @@ def generate_spec_infer(rm, im, llm_id: int, requests: Sequence[Request],
                 im.inference(llm_id, chain, rng=r3)
                 st.llm_cached += len(span)
 
-        # ---- SSM phase: prefill + beam expansion to depth D
-        rng, r1 = jax.random.split(rng)
-        seeds = _ssm_prefill(rm, im, ssm_id, states, running, W, r1)
+        # ---- committed-chain tree base (built once; every SSM's
+        # candidates merge into this shared per-request tree).  Uncached
+        # positions [llm_cached, L) form the base chain (the reference
+        # carries these as committed tokens inside the verify batch,
+        # request_manager.cc:1211).
         root_of: Dict[int, int] = {}
         for row, req in running.items():
             st = states[req.guid]
-            # committed chain: uncached positions [llm_cached, L) form the
-            # base of the tree (the reference carries these as committed
-            # tokens inside the verify batch, request_manager.cc:1211)
             L = len(req.tokens)
             st.tree = [TreeNode(req.tokens[pos], max(0, i - 1), i)
                        for i, pos in enumerate(range(st.llm_cached, L))]
-            root = len(st.tree) - 1
-            root_of[row] = root
-            ids, logps = seeds[row]
-            st.beam_nodes, st.beam_logp = [], []
-            capacity = tree_chunk - len(st.tree)
-            for b in range(min(W, len(ids), max(0, capacity))):
-                st.tree.append(TreeNode(int(ids[b]), root,
-                                        st.tree[root].depth + 1,
-                                        float(logps[b])))
-                st.beam_nodes.append(len(st.tree) - 1)
-                st.beam_logp.append(float(logps[b]))
-            req.profile.ssm_decoding_steps += 1
+            root_of[row] = len(st.tree) - 1
 
-        # ---- beam expansion to depth D as ONE fused device program
-        # (InferenceManager.beam_block).  The per-depth host loop the
-        # reference runs (request_manager.cc:2031-2042) would pay one
-        # host↔device round trip per depth; the device re-ranks the W*W
-        # joint candidates itself and the host replays the expansion
-        # history (incl. shared-prefix dedup, merge_dfs_trees) after a
-        # single sync.
-        # fixed depth D-1 so ONE block program compiles per (depth, W) —
-        # a tree-occupancy-dependent depth would recompile the scan every
-        # time occupancy changes; the host replay already stops per-row at
-        # tree capacity, surplus device steps are cheap
-        d_eff = D - 1
-        expandable = any(
-            states[r.guid].beam_nodes
-            and len(states[r.guid].tree) + W <= tree_chunk
-            for r in running.values())
-        if d_eff > 0 and expandable:
-            bc = BeamSearchBatchConfig(rm.max_requests_per_batch, 1,
-                                       beam_width=W)
-            n_rows = rm.max_requests_per_batch * W
-            init_tok = np.zeros(n_rows, np.int32)
-            init_cum = np.full((rm.max_requests_per_batch, W), -1e30,
-                               np.float32)
+        # ---- SSM phase, once per registered speculator (reference
+        # iterates all SSMs, request_manager.cc:2031-2042): prefill (row 0
+        # only; the beam block broadcasts the prefix cache) + beam
+        # expansion to depth D, then merge into the shared tree.
+        for ssm_id in ssm_ids:
+            ssm_record = im.models[ssm_id]
+            W = beam_width or ssm_record["beam_width"]
+            D = beam_depth or BeamSearchBatchConfig.MAX_BEAM_DEPTH
+            rng, r1 = jax.random.split(rng)
+            seeds = _ssm_prefill(rm, im, ssm_id, states, running, W, r1)
             for row, req in running.items():
                 st = states[req.guid]
-                for b, node_idx in enumerate(st.beam_nodes):
-                    rr = bc.row(row, b)
-                    bc.request_guid[rr] = req.guid
-                    bc.request_available[rr] = True
-                    bc.first_token_depth[rr] = st.ssm_cached
-                    bc.num_tokens_in_batch[rr] = 1
-                    bc.max_sequence_length[rr] = req.max_sequence_length
-                    init_tok[rr] = st.tree[node_idx].token
-                    init_cum[row, b] = st.beam_logp[b]
-            rng, r2 = jax.random.split(rng)
-            toks_h, parents_h, cums_h = im.beam_block(
-                ssm_id, bc, d_eff, init_tok, init_cum, r2)
-            for i in range(toks_h.shape[0]):
+                root = root_of[row]
+                ids, logps = seeds[row]
+                st.beam_nodes, st.beam_logp = [], []
+                for b in range(min(W, len(ids))):
+                    node = _attach_child(st, root, int(ids[b]),
+                                         float(logps[b]), tree_chunk)
+                    if node is None:
+                        continue  # at capacity (later b may dedup-hit)
+                    st.beam_nodes.append(node)
+                    st.beam_logp.append(float(logps[b]))
+                req.profile.ssm_decoding_steps += 1
+
+            # ---- beam expansion to depth D as ONE fused device program
+            # (InferenceManager.beam_block).  The per-depth host loop the
+            # reference runs would pay one host↔device round trip per
+            # depth; the device re-ranks the W*W joint candidates itself
+            # and the host replays the expansion history (incl.
+            # shared-prefix dedup, merge_dfs_trees) after a single sync.
+            # fixed depth D-1 so ONE block program compiles per
+            # (depth, W) — a tree-occupancy-dependent depth would
+            # recompile the scan every time occupancy changes; the host
+            # replay already stops per-row at tree capacity, surplus
+            # device steps are cheap
+            d_eff = D - 1
+            expandable = any(
+                states[r.guid].beam_nodes
+                and len(states[r.guid].tree) + W <= tree_chunk
+                for r in running.values())
+            if d_eff > 0 and expandable:
+                bc = BeamSearchBatchConfig(rm.max_requests_per_batch, 1,
+                                           beam_width=W)
+                n_rows = rm.max_requests_per_batch * W
+                init_tok = np.zeros(n_rows, np.int32)
+                init_cum = np.full((rm.max_requests_per_batch, W), -1e30,
+                                   np.float32)
+                # prefix caches live in each request's beam row 0 only
+                # (single prefill); the first gather broadcasts them
+                init_parents = np.arange(n_rows, dtype=np.int32)
                 for row, req in running.items():
                     st = states[req.guid]
-                    if len(st.tree) + W > tree_chunk or not st.beam_nodes:
-                        continue
-                    new_nodes, new_logp = [], []
                     for b in range(W):
-                        pb = int(parents_h[i, row, b])
-                        cum = float(cums_h[i, row, b])
-                        tok = int(toks_h[i, row, b])
-                        if pb >= len(st.beam_nodes) or cum <= -1e29:
-                            continue  # candidate from a padded beam slot
-                        parent_node = st.beam_nodes[pb]
-                        # dedup shared prefixes (reference merge_dfs_trees)
-                        existing = next(
-                            (j for j, nd in enumerate(st.tree)
-                             if nd.parent == parent_node
-                             and nd.token == tok
-                             and nd.depth == st.tree[parent_node].depth + 1),
-                            None)
-                        if existing is None:
-                            st.tree.append(TreeNode(
-                                tok, parent_node,
-                                st.tree[parent_node].depth + 1, cum))
-                            existing = len(st.tree) - 1
-                        new_nodes.append(existing)
-                        new_logp.append(cum)
-                    st.beam_nodes, st.beam_logp = new_nodes, new_logp
-                    req.profile.ssm_decoding_steps += 1
+                        init_parents[bc.row(row, b)] = bc.row(row, 0)
+                    for b, node_idx in enumerate(st.beam_nodes):
+                        rr = bc.row(row, b)
+                        bc.request_guid[rr] = req.guid
+                        bc.request_available[rr] = True
+                        bc.first_token_depth[rr] = st.ssm_cached[ssm_id]
+                        bc.num_tokens_in_batch[rr] = 1
+                        bc.max_sequence_length[rr] = req.max_sequence_length
+                        init_tok[rr] = st.tree[node_idx].token
+                        init_cum[row, b] = st.beam_logp[b]
+                rng, r2 = jax.random.split(rng)
+                toks_h, parents_h, cums_h = im.beam_block(
+                    ssm_id, bc, d_eff, init_tok, init_cum, r2,
+                    init_parent_rows=init_parents)
+                for i in range(toks_h.shape[0]):
+                    for row, req in running.items():
+                        st = states[req.guid]
+                        if not st.beam_nodes:
+                            continue
+                        new_nodes, new_logp = [], []
+                        for b in range(W):
+                            pb = int(parents_h[i, row, b])
+                            cum = float(cums_h[i, row, b])
+                            tok = int(toks_h[i, row, b])
+                            if pb >= len(st.beam_nodes) or cum <= -1e29:
+                                continue  # candidate from a padded slot
+                            node = _attach_child(st, st.beam_nodes[pb],
+                                                 tok, cum, tree_chunk)
+                            if node is None:
+                                continue  # tree at capacity
+                            new_nodes.append(node)
+                            new_logp.append(cum)
+                        st.beam_nodes, st.beam_logp = new_nodes, new_logp
+                        req.profile.ssm_decoding_steps += 1
 
         # ---- tree verify step
         bc, _ = _build_tree_batch(rm, im.models[llm_id], states, running,
@@ -355,6 +384,7 @@ def generate_spec_infer(rm, im, llm_id: int, requests: Sequence[Request],
             finished = False
             for tok in new_tokens:
                 req.tokens.append(tok)
+                req.profile.note_first_token()
                 if rm._finished(req, tok):
                     finished = True
                     break

@@ -28,8 +28,8 @@ def run_spec_infer(llm, ssm, prompts, n_new, beam_width=2, max_requests=4,
                    tree_chunk=24, max_seq_length=256, beam_depth=4,
                    max_tokens_per_batch=64):
     """Shared speculative-decoding harness: compile an LLM (tree-verify) +
-    SSM (beam) pair and generate.  Used by test_spec_infer and the
-    cross-family model-zoo tests."""
+    SSM (beam) pair — or a list of SSMs — and generate.  Used by
+    test_spec_infer and the cross-family model-zoo tests."""
     import numpy as np
 
     from flexflow_tpu.fftype import InferenceMode
@@ -40,15 +40,16 @@ def run_spec_infer(llm, ssm, prompts, n_new, beam_width=2, max_requests=4,
     llm_id = im.compile_model_and_allocate_buffer(
         llm, mode=InferenceMode.TREE_VERIFY, max_requests=max_requests,
         max_seq_length=max_seq_length, cache_dtype=np.float32)
-    ssm_id = im.compile_model_and_allocate_buffer(
-        ssm, mode=InferenceMode.BEAM_SEARCH, max_requests=max_requests,
-        max_seq_length=max_seq_length, beam_width=beam_width,
-        cache_dtype=np.float32)
     rm = RequestManager(max_requests_per_batch=max_requests,
                         max_tokens_per_batch=max_tokens_per_batch,
                         max_sequence_length=max_seq_length,
                         max_spec_tree_token_num=tree_chunk)
-    rm.register_ssm_model(ssm_id)
+    for s in (ssm if isinstance(ssm, (list, tuple)) else [ssm]):
+        ssm_id = im.compile_model_and_allocate_buffer(
+            s, mode=InferenceMode.BEAM_SEARCH, max_requests=max_requests,
+            max_seq_length=max_seq_length, beam_width=beam_width,
+            cache_dtype=np.float32)
+        rm.register_ssm_model(ssm_id)
     reqs = [rm.register_new_request(list(p), max_new_tokens=n_new)
             for p in prompts]
     generate_spec_infer(rm, im, llm_id, reqs, beam_width=beam_width,

@@ -235,6 +235,43 @@ class TestServingIntegration:
         fused = model.params[attn[1]]
         assert "wqkv" in fused and "wq" not in fused
 
+    def test_init_quantized_params_decodes(self):
+        """Direct-to-int8 random init (no transient full-precision model
+        — the path that fits 7B random weights on one chip): params come
+        out quantized, and the model serves."""
+        from flexflow_tpu import FFConfig, Model
+        from flexflow_tpu.models.llama import LLAMAConfig, create_llama_model
+        from flexflow_tpu.quantization import init_quantized_params
+        from flexflow_tpu.serving import InferenceManager, RequestManager
+
+        cfg = LLAMAConfig(vocab_size=128, hidden_size=32,
+                          intermediate_size=64, num_hidden_layers=2,
+                          num_attention_heads=4, num_key_value_heads=2,
+                          max_position_embeddings=128)
+        model = Model(FFConfig(), name="qinit")
+        create_llama_model(model, cfg, max_requests=2)
+        init_quantized_params(model, "int8")
+        lin = [l.name for l in model.layers
+               if l.name.endswith(("gate_proj", "up_proj", "down_proj",
+                                   "lm_head"))]
+        assert lin
+        for ln in lin:
+            assert "kernel_q" in model.params[ln], ln
+            assert model.params[ln]["kernel_q"].dtype == jnp.int8
+            assert "kernel" not in model.params[ln]
+        attn = [ln for ln, lp in model.params.items() if "wq_q" in lp]
+        assert attn
+        im = InferenceManager(model.config)
+        mid = im.compile_model_and_allocate_buffer(
+            model, max_requests=2, max_seq_length=32,
+            cache_dtype=np.float32)
+        rm = RequestManager(max_requests_per_batch=2,
+                            max_tokens_per_batch=8,
+                            max_sequence_length=32)
+        req = rm.register_new_request([1, 5, 9], max_new_tokens=4)
+        rm.generate_incr_decoding(im, mid, [req])
+        assert len(req.tokens) == 3 + 4
+
     def test_quantize_skips_non_linear(self):
         from flexflow_tpu import FFConfig, Model
         from flexflow_tpu.fftype import ActiMode

@@ -1,0 +1,162 @@
+"""Search-space extensions beyond round 1: pipeline stages and sequence
+parallelism as first-class searched dimensions (the reference searches
+arbitrary MachineViews incl. per-stage start_device_id, graph.cc:1993-2024;
+it has NO sequence-parallel dimension at all, SURVEY §5), plus the
+measured-cost mode (simulator.cc:519-560: search on real timings).
+"""
+
+import numpy as np
+
+from flexflow_tpu import FFConfig, Model
+from flexflow_tpu.fftype import ActiMode, DataType, OpType
+from flexflow_tpu.search import (PCG, MeasuredCostModel, ShardAssignment,
+                                 SimpleMachineModel, data_parallel_strategy,
+                                 graph_optimize, node_choices,
+                                 strategy_from_json, strategy_to_json)
+
+
+def _transformerish(batch=8, seq=128, embed=1024, n_blocks=4,
+                    name="tform"):
+    """A stack of attention + FFN blocks with transformer_layer_ids (the
+    shape pp search stages over)."""
+    m = Model(FFConfig(batch_size=batch), name=name)
+    x = m.create_tensor((batch, seq, embed), name="x")
+    t = x
+    for i in range(n_blocks):
+        m.current_transformer_layer_id = i
+        a = m.multihead_attention(t, t, t, embed, 8, causal=True,
+                                  name=f"blk{i}_attn")
+        t = m.dense(a, embed, activation=ActiMode.RELU,
+                    name=f"blk{i}_ffn")
+    m.current_transformer_layer_id = -1
+    m.dense(t, 32, name="head")
+    return m
+
+
+class TestSequenceParallelSearch:
+    def test_sp_in_node_choices_for_attention_only(self):
+        m = _transformerish()
+        attn = next(l for l in m.layers
+                    if l.op_type == OpType.MULTIHEAD_ATTENTION)
+        ffn = next(l for l in m.layers if l.op_type == OpType.LINEAR)
+        assert any(c.sp > 1 for c in node_choices(attn, 8))
+        assert all(c.sp == 1 for c in node_choices(ffn, 8))
+
+    def test_dp_capped_by_batch_extent(self):
+        """A batch of 1 cannot data-shard — every choice keeps dp == 1
+        (the regime where only tp/sp can use the devices)."""
+        m = _transformerish(batch=1, name="b1")
+        for layer in m.layers:
+            if layer.inputs:
+                assert all(c.dp == 1 for c in node_choices(layer, 8))
+
+    def test_sp_chosen_for_single_long_sequence(self):
+        """batch=1, very long sequence: dp is infeasible and the
+        attention node's seq^2 term dominates — the search must engage a
+        degree > 1 on attention, which only sp (or tp) can provide; with
+        the ring's cheap (sp-1) p2p hops vs tp's two allreduces of the
+        full activation, sp wins on the attention node."""
+        m = _transformerish(batch=1, seq=32768, embed=512, n_blocks=2,
+                            name="longseq")
+        mm = SimpleMachineModel(8)
+        strategy, cost = graph_optimize(m, machine=mm, num_devices=8,
+                                        budget=400)
+        attn = [l.name for l in m.layers
+                if l.op_type == OpType.MULTIHEAD_ATTENTION]
+        assert any(strategy[n].sp > 1 for n in attn), strategy
+        # and it beats the serial fallback
+        pcg = PCG(m)
+        serial = pcg.strategy_cost(
+            {l.name: ShardAssignment() for l in m.layers}, mm)
+        assert cost.total_time < serial.total_time
+
+    def test_sp_strategy_json_roundtrip(self):
+        s = {"a": ShardAssignment(dp=2, sp=4),
+             "b": ShardAssignment(tp=2, pp_stage=1)}
+        assert strategy_from_json(strategy_to_json(s)) == s
+        # pre-sp round-1 exports (no "sp" key) still load
+        legacy = '{"a": {"dp": 2, "tp": 1, "pp_stage": 0}}'
+        assert strategy_from_json(legacy)["a"] == ShardAssignment(dp=2)
+
+
+class TestPipelineSearch:
+    def test_pp_engaged_under_memory_pressure(self):
+        """Weights too big for one device group's HBM replicated: with
+        max_pipeline the search must return a staged strategy that fits —
+        reproducing the hand-built pp x tp serving shape (stages
+        contiguous, balanced; sharding within stages)."""
+        m = _transformerish(batch=8, seq=64, embed=2048, n_blocks=4,
+                            name="ppmem")
+        mm = SimpleMachineModel(8)
+        pcg = PCG(m)
+        dp_mem = pcg.strategy_cost(data_parallel_strategy(pcg, 8),
+                                   mm).memory
+        limit = int(dp_mem * 0.45)
+        strategy, cost = graph_optimize(m, machine=mm, num_devices=8,
+                                        budget=200, memory_limit=limit,
+                                        max_pipeline=4)
+        assert cost.memory <= limit
+        stages = [strategy[l.name].pp_stage for l in m.layers]
+        assert max(stages) >= 1, "memory pressure should engage pp"
+        # contiguity: stage ids are non-decreasing along the layer order
+        assert stages == sorted(stages), stages
+
+    def test_pp1_still_wins_when_memory_free(self):
+        """Without memory pressure the bottleneck-stage cost of pp (fewer
+        devices per stage) loses to pp=1 with all devices per node — the
+        search must not pipeline for its own sake."""
+        m = _transformerish(batch=64, seq=32, embed=256, n_blocks=4,
+                            name="nofit")
+        strategy, _ = graph_optimize(m, num_devices=8, budget=200,
+                                     max_pipeline=4)
+        assert all(strategy[l.name].pp_stage == 0 for l in m.layers)
+
+
+class TestMeasuredSearch:
+    def test_measurement_flips_a_decision(self):
+        """Seed the measurement cache with on-chip timings contradicting
+        the roofline: the measured search must pick a different strategy
+        (the reference's whole point in running real kernels during
+        search, simulator.cc:519-560)."""
+        m = _transformerish(batch=64, seq=32, embed=2048, n_blocks=1,
+                            name="flip")
+        mm = SimpleMachineModel(2)
+        analytic, _ = graph_optimize(m, machine=mm, num_devices=2,
+                                     budget=300)
+
+        mcm = MeasuredCostModel(mm)
+        from flexflow_tpu.search.cost_model import estimate_op_cost
+
+        # fake measurements: whatever the analytic search chose per node
+        # is "measured" 100x slower than the roofline says; everything
+        # else confirms the roofline
+        for layer in m.layers:
+            outs = [o.spec.shape for o in layer.outputs]
+            for ch in node_choices(layer, 2):
+                est = estimate_op_cost(layer, outs, mm, ch.dp, ch.tp,
+                                       ch.sp)
+                a = analytic[layer.name]
+                slow = 100.0 if (ch.dp, ch.tp, ch.sp) == \
+                    (a.dp, a.tp, a.sp) else 1.0
+                mcm.cache[mcm._key(layer, outs, ch.dp, ch.tp, ch.sp)] = \
+                    est.forward_time * slow
+        measured, _ = graph_optimize(m, machine=mm, num_devices=2,
+                                     budget=300, cost_model=mcm)
+        assert measured != analytic
+
+    def test_auto_measure_runs_real_timings(self):
+        """auto_measure builds + times a real jitted forward for compute
+        ops; the measured forward time is a real positive number and gets
+        cached under the (op-params, sharding) key."""
+        m = Model(FFConfig(batch_size=8), name="meas")
+        x = m.create_tensor((8, 256), name="x")
+        m.dense(x, 256)
+        mm = SimpleMachineModel(1)
+        mcm = MeasuredCostModel(mm, auto_measure=True)
+        lin = next(l for l in m.layers if l.op_type == OpType.LINEAR)
+        outs = [o.spec.shape for o in lin.outputs]
+        c = mcm.est(lin, outs, mm)
+        assert c.forward_time > 0
+        assert mcm.cache, "timing must be cached"
+        # cached: second call returns the same number without re-timing
+        assert mcm.est(lin, outs, mm).forward_time == c.forward_time

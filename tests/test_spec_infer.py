@@ -47,8 +47,10 @@ def _spec_generate(llm_hf, ssm_hf, prompts, n_new, beam_width=2,
     from conftest import run_spec_infer
 
     llm = _build(llm_hf, InferenceMode.TREE_VERIFY, max_requests)
-    ssm = _build(ssm_hf, InferenceMode.BEAM_SEARCH, max_requests)
-    return run_spec_infer(llm, ssm, prompts, n_new,
+    ssms = [_build(s, InferenceMode.BEAM_SEARCH, max_requests)
+            for s in (ssm_hf if isinstance(ssm_hf, (list, tuple))
+                      else [ssm_hf])]
+    return run_spec_infer(llm, ssms, prompts, n_new,
                           beam_width=beam_width, max_requests=max_requests,
                           tree_chunk=tree_chunk)
 
@@ -188,3 +190,29 @@ class TestSpecInfer:
         assert prof.speculated_tokens >= prof.accepted_tokens >= 0
         assert prof.ssm_decoding_steps > 0
         assert len(got[0]) == 12
+        # single prefill per chunk: the prefix is fed to ONE beam row and
+        # broadcast to the others by the beam block's first cache gather
+        # (not recomputed W times)
+        assert prof.ssm_prefill_chunks > 0
+        assert prof.ssm_prefill_rows == prof.ssm_prefill_chunks
+
+    def test_two_ssms_token_exact(self):
+        """Two registered SSMs both speculate each macro-iteration
+        (reference iterates all SSMs, request_manager.cc:2031-2042);
+        their merged tree still verifies to the exact greedy output."""
+        llm_hf = _hf_llama(TINY, seed=0)
+        ssm_a = _hf_llama(SMALLER, seed=7)
+        ssm_b = _hf_llama(SMALLER, seed=9)
+        prompts = [[1, 5, 9, 42, 7], [2, 8, 99, 100]]
+        want = _incr_generate(llm_hf, prompts, 16)
+        got, reqs = _spec_generate(llm_hf, [ssm_a, ssm_b], prompts, 16)
+        for w, g in zip(want, got):
+            assert g == w, f"2-ssm spec != incr:\n spec={g}\n incr={w}"
+        # both SSMs ran: one verify step per macro-iteration but TWO
+        # beam phases, so ssm prefill chunks ≥ 2x the llm steps would
+        # overcount; instead check the per-SSM watermark bookkeeping via
+        # steps: every macro-iteration bumps ssm_decoding_steps at least
+        # twice (once per SSM)
+        prof = reqs[0].profile
+        assert prof.ssm_decoding_steps >= 2 * prof.llm_decoding_steps
+        assert prof.ssm_prefill_rows == prof.ssm_prefill_chunks
