@@ -96,9 +96,13 @@ def int8_matmul(x, q, scale, interpret: bool = False):
     return out[:B, :N]
 
 
-def _fast_bn(n: int):
+def _fast_bn(n: int, k: int = 0):
+    """Largest output-block width that divides n AND keeps the int8
+    weight block (k x bn bytes) inside the VMEM budget — a greedy pick
+    ignoring k rejected 7B's down_proj (k=11008: 512-wide blocks are
+    5.6M > 4M, but 256-wide fit)."""
     for bn in (512, 256, 128):
-        if n % bn == 0:
+        if n % bn == 0 and (not k or k * bn <= 4 * 1024 * 1024):
             return bn
     return None
 
@@ -106,10 +110,8 @@ def _fast_bn(n: int):
 def fast_path_ok(rows: int, k: int, n: int) -> bool:
     """Shape gate for :func:`int8_matmul_fast`: whole-K blocks need
     tile-aligned dims and must fit VMEM."""
-    bn = _fast_bn(n)
-    return (bn is not None and k % 128 == 0 and rows <= 64
-            and k * bn <= 4 * 1024 * 1024        # int8 weight block
-            and k <= 8192)
+    return (_fast_bn(n, k) is not None and k % 128 == 0 and rows <= 64
+            and k <= 16384)
 
 
 def _fast_kernel(x_ref, q_ref, scale_ref, out_ref):
@@ -135,7 +137,7 @@ def int8_matmul_fast(x, q, scale, interpret: bool = False):
 
     B, K = x.shape
     N = q.shape[1]
-    bn = _fast_bn(N)
+    bn = _fast_bn(N, K)
     assert bn is not None and K % 128 == 0, (K, N)
     Bp = -(-max(B, 16) // 16) * 16
     if B < Bp:

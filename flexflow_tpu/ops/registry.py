@@ -51,6 +51,10 @@ class OpContext:
     batch_config: Any = None  # serving: BatchConfig family
     kv_cache: Any = None      # serving: per-layer KV cache pytree (read)
     kv_cache_out: Dict = None  # serving: updated caches collected here
+    # serving: static bound on attended cache length this step (attention
+    # reads cache[:, :attend_len] instead of the full padded allocation —
+    # at 7B/MHA the full-length read costs more than the weights)
+    attend_len: Any = None
     mesh: Any = None
     extra_outputs: Dict = None  # side outputs (e.g. beam parent ids)
     state_updates: Dict = None  # non-trainable state written by ops (BN stats)

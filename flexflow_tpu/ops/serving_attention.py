@@ -98,6 +98,41 @@ def _attend(q, cache_k, cache_v, mask, scale, alibi=None):
     return out.reshape(R, C, H, D).astype(q.dtype)
 
 
+def _int8_fast_proj(params, name, x2, ctx):
+    """Project through the whole-K Pallas int8 kernel when the layout
+    allows (int8_nd weights, single device, tile-aligned shapes) —
+    without it, 7B int8 decode pays a per-step dequant of every
+    attention projection.  Returns [rows, N] or None (caller falls back
+    to the XLA dequant einsum).  The weight reshape 3-D->2-D is a
+    contiguous bitcast, not a copy (unlike the padded reshapes that made
+    the first in-scan attempt 100x slower)."""
+    import os
+
+    q = params.get(name + "_q")
+    if q is None or os.environ.get("FF_PALLAS_INT8") == "0":
+        return None
+    if ctx is not None and getattr(ctx, "mesh", None) is not None:
+        return None   # pallas_call has no GSPMD partitioning rule
+    scale = params[name + "_scale"]
+    if name == "wo":
+        if scale.ndim != 1:       # int4 packed layout: XLA path
+            return None
+        q2 = q.reshape(-1, q.shape[-1])
+        s2 = scale
+    else:                         # wq/wk/wv [E, H, D], scale [H, D]
+        if scale.ndim != 2:
+            return None
+        q2 = q.reshape(q.shape[0], -1)
+        s2 = scale.reshape(-1)
+    from ..kernels.quant_matmul import (fast_path_ok, int8_matmul_fast,
+                                        pallas_tpu_available)
+
+    if not (pallas_tpu_available()
+            and fast_path_ok(x2.shape[0], q2.shape[0], q2.shape[1])):
+        return None
+    return int8_matmul_fast(x2, q2, s2)
+
+
 class _ServingAttentionBase(OpDef):
     """Shared qkv/o projection + cache plumbing for the three modes."""
 
@@ -133,7 +168,7 @@ class _ServingAttentionBase(OpDef):
             "and KV cache (use multihead_attention for training)")
 
     # ------------------------------------------------------------ helpers
-    def _project_qkv(self, params, x, attrs):
+    def _project_qkv(self, params, x, attrs, ctx=None):
         if "wqkv" in params:
             # fused projection (InferenceManager.fuse_qkv): one matmul
             # instead of three — decode at small batch is per-kernel
@@ -148,18 +183,32 @@ class _ServingAttentionBase(OpDef):
                 qkv = qkv + params["bqkv"].astype(qkv.dtype)
             return (qkv[:, :, :h], qkv[:, :, h:h + kv],
                     qkv[:, :, h + kv:])
-        q = jnp.einsum("rce,ehd->rchd", x, resolve_weight(params, "wq", x.dtype))
-        k = jnp.einsum("rce,ehd->rchd", x, resolve_weight(params, "wk", x.dtype))
-        v = jnp.einsum("rce,ehd->rchd", x, resolve_weight(params, "wv", x.dtype))
+        def proj(name):
+            w_q = params.get(name + "_q")
+            if w_q is not None:
+                y2 = _int8_fast_proj(params, name,
+                                     x.reshape(-1, x.shape[-1]), ctx)
+                if y2 is not None:
+                    return y2.reshape(*x.shape[:-1], *w_q.shape[1:])
+            return jnp.einsum("rce,ehd->rchd", x,
+                              resolve_weight(params, name, x.dtype))
+
+        q, k, v = proj("wq"), proj("wk"), proj("wv")
         if attrs.get("qkv_bias", False):
             q = q + params["bq"].astype(q.dtype)
             k = k + params["bk"].astype(k.dtype)
             v = v + params["bv"].astype(v.dtype)
         return q, k, v
 
-    def _output(self, params, out, attrs):
-        y = jnp.einsum("rchd,hde->rce", out,
-                       resolve_weight(params, "wo", out.dtype))
+    def _output(self, params, out, attrs, ctx=None):
+        y2 = _int8_fast_proj(params, "wo",
+                             out.reshape(-1, out.shape[-2] * out.shape[-1])
+                             .astype(out.dtype), ctx)
+        if y2 is not None:
+            y = y2.reshape(*out.shape[:-2], y2.shape[-1])
+        else:
+            y = jnp.einsum("rchd,hde->rce", out,
+                           resolve_weight(params, "wo", out.dtype))
         if attrs.get("final_bias", False):
             y = y + params["bo"].astype(y.dtype)
         return y
@@ -195,6 +244,20 @@ class _ServingAttentionBase(OpDef):
     def _store(self, ctx, layer_name, ck, cv):
         ctx.kv_cache_out[layer_name] = {"k": ck, "v": cv}
 
+    @staticmethod
+    def _attend_slice(ctx, ck, cv):
+        """Bound the attended cache prefix: positions past
+        ctx.attend_len are provably masked (the host buckets it above
+        every active row's depth+chunk), so reading them only burns HBM
+        bandwidth — at 7B/MHA the full padded length costs more per step
+        than the weights.  Sharded caches skip the slice (it would
+        reshard the sp/tp layout mid-step)."""
+        L = ctx.attend_len
+        S = ck.shape[1]
+        if L and L < S and ctx.mesh is None:
+            return ck[:, :L], cv[:, :L], L
+        return ck, cv, S
+
 
 @register
 class IncMultiHeadSelfAttention(_ServingAttentionBase):
@@ -214,7 +277,7 @@ class IncMultiHeadSelfAttention(_ServingAttentionBase):
         bc = ctx.batch_config
         layer = attrs["layer_name"]
         R, C, _ = x.shape
-        q, k, v = self._project_qkv(params, x, attrs)
+        q, k, v = self._project_qkv(params, x, attrs, ctx)
         positions = bc["first_depth"][:, None] + jnp.arange(C)[None, :]
         if attrs.get("rotary", True):
             theta = attrs.get("rope_theta", 10000.0)
@@ -234,11 +297,11 @@ class IncMultiHeadSelfAttention(_ServingAttentionBase):
                 bc["active"].astype(jnp.int32), self._scale(attrs),
                 interpret=(fused_mode == "interpret"))
             self._store(ctx, layer, ck, cv)
-            return [self._output(params, out1[:, None], attrs)]
+            return [self._output(params, out1[:, None], attrs, ctx)]
         ck = _scatter_chunk(ck, k, bc["first_depth"], bc["active"])
         cv = _scatter_chunk(cv, v, bc["first_depth"], bc["active"])
         self._store(ctx, layer, ck, cv)
-        S = ck.shape[1]
+        ak, av, S = self._attend_slice(ctx, ck, cv)
         span = jnp.arange(S)[None, None, :]  # [1,1,S]
         mask = (span <= positions[:, :, None]) & bc["active"][:, None, None]
         alibi = None
@@ -246,8 +309,8 @@ class IncMultiHeadSelfAttention(_ServingAttentionBase):
             key_pos = jnp.broadcast_to(jnp.arange(S)[None, :], (R, S))
             alibi = (jnp.asarray(self._alibi_slopes(attrs["num_q_heads"])),
                      positions, key_pos)
-        out = _attend(q, ck, cv, mask, self._scale(attrs), alibi)
-        return [self._output(params, out, attrs)]
+        out = _attend(q, ak, av, mask, self._scale(attrs), alibi)
+        return [self._output(params, out, attrs, ctx)]
 
     @staticmethod
     def _fused_decode_ok(attrs, ctx, C, ck):
@@ -342,7 +405,7 @@ class TreeIncMultiHeadSelfAttention(_ServingAttentionBase):
         ck = self._commit(ck, bc["commit_count"], bc["commit_src"], bc["commit_dst"])
         cv = self._commit(cv, bc["commit_count"], bc["commit_src"], bc["commit_dst"])
         # 2) project + RoPE at tree depths
-        q, k, v = self._project_qkv(params, x, attrs)
+        q, k, v = self._project_qkv(params, x, attrs, ctx)
         depths = bc["token_depth"]  # [R, C]
         if attrs.get("rotary", True):
             theta = attrs.get("rope_theta", 10000.0)
@@ -355,7 +418,7 @@ class TreeIncMultiHeadSelfAttention(_ServingAttentionBase):
         cv = _scatter_chunk(cv, v, bc["first_depth"], bc["active"])
         self._store(ctx, layer, ck, cv)
         # 4) mask: committed prefix + in-batch ancestors
-        S = ck.shape[1]
+        ak, av, S = self._attend_slice(ctx, ck, cv)
         span = jnp.arange(S)[None, None, :]
         committed = span < bc["first_depth"][:, None, None]  # [R,1->C,S]
         # scatter tree_mask [R,C,C] into the S axis at first_depth offset
@@ -377,5 +440,5 @@ class TreeIncMultiHeadSelfAttention(_ServingAttentionBase):
             key_pos = jax.vmap(place_pos)(base_pos, depths, bc["first_depth"])
             alibi = (jnp.asarray(self._alibi_slopes(attrs["num_q_heads"])),
                      depths, key_pos)
-        out = _attend(q, ck, cv, mask, self._scale(attrs), alibi)
-        return [self._output(params, out, attrs)]
+        out = _attend(q, ak, av, mask, self._scale(attrs), alibi)
+        return [self._output(params, out, attrs, ctx)]

@@ -102,6 +102,20 @@ def _param_pspecs(model) -> Dict[str, Dict[str, PartitionSpec]]:
     return specs
 
 
+def attend_bucket(bc, span: int, alloc_len: int) -> Optional[int]:
+    """Static pow2 bound on the attended cache prefix for this batch:
+    active rows' positions stay below max(first_depth) + span.  None =
+    no saving (bound reaches the allocation) or nothing active."""
+    act = np.asarray(bc.request_available)
+    if not act.any():
+        return None
+    need = int(np.asarray(bc.first_token_depth)[act].max()) + span
+    L = 64
+    while L < need:
+        L *= 2
+    return None if L >= alloc_len else L
+
+
 def fuse_qkv(model) -> None:
     """Concatenate each serving-attention layer's wq/wk/wv ([E,H,D] +
     2x[E,KV,D]) into one wqkv [E,H+2KV,D] (and biases into bqkv) so the
@@ -249,6 +263,7 @@ class InferenceManager:
                       max_requests=max_requests, rows=rows,
                       max_seq_length=max_seq_length, beam_width=beam_width,
                       prefill_chunk=prefill_chunk, steps={},
+                      alloc_len=alloc_len,
                       cache_pspec=(cache_sharding.spec
                                    if cache_sharding is not None else None))
         self.models[mid] = record
@@ -266,7 +281,8 @@ class InferenceManager:
         record = dict(model=model, mode=mode, mesh=None, caches={},
                       max_requests=max_requests, rows=rows,
                       max_seq_length=max_seq_length, beam_width=beam_width,
-                      prefill_chunk=prefill_chunk, steps={})
+                      prefill_chunk=prefill_chunk, steps={},
+                      alloc_len=alloc_len)
         compile_pipeline(self, record, model, cfg, cache_dtype, rows,
                          alloc_len)
         mid = model_id if model_id is not None else len(self.models)
@@ -282,9 +298,16 @@ class InferenceManager:
         return True
 
     # --------------------------------------------------------------- step
-    def _raw_step(self, record, reorder: bool):
+    def _raw_step(self, record, reorder: bool,
+                  attend_len: Optional[int] = None):
         """The un-jitted one-step function shared by the single-step path
-        and the device-resident decode block (lax.scan body)."""
+        and the device-resident decode block (lax.scan body).
+
+        ``attend_len``: static bound on the attended cache prefix (the
+        bucket the host computed over active rows' depth+chunk); the
+        attention ops read cache[:, :attend_len] instead of the whole
+        padded allocation — at 7B/MHA full-length reads cost more than
+        the weights."""
         model = record["model"]
         input_names = [t.name for t in model.input_tensors]
 
@@ -294,6 +317,7 @@ class InferenceManager:
                 caches = jax.tree.map(lambda c: c[parents], caches)
             ctx = OpContext(training=False, rng=rng, batch_config=batch,
                             kv_cache=caches, kv_cache_out={},
+                            attend_len=attend_len,
                             mesh=record["mesh"], extra_outputs={})
             feeds = {}
             C = batch["token_ids"].shape[1]
@@ -316,10 +340,13 @@ class InferenceManager:
 
         return step
 
-    def _build_step(self, record, chunk: int, reorder: bool):
-        return jax.jit(self._raw_step(record, reorder), donate_argnums=(1,))
+    def _build_step(self, record, chunk: int, reorder: bool,
+                    attend_len: Optional[int] = None):
+        return jax.jit(self._raw_step(record, reorder, attend_len),
+                       donate_argnums=(1,))
 
-    def _build_decode_block(self, record, k: int, include_init: bool = False):
+    def _build_decode_block(self, record, k: int, include_init: bool = False,
+                            attend_len: Optional[int] = None):
         """K decode steps fused into one device program via lax.scan.
 
         Autoregressive decode needs each sampled token only *on device* for
@@ -331,7 +358,7 @@ class InferenceManager:
         TPU-native equivalent is a device-resident token feedback loop that
         syncs once per K tokens.
         """
-        step = self._raw_step(record, reorder=False)
+        step = self._raw_step(record, reorder=False, attend_len=attend_len)
 
         def block(params, caches, batch, rngs, init_tok):
             active = batch["active"].astype(jnp.int32)
@@ -450,10 +477,12 @@ class InferenceManager:
         toks, parents, cums = hist
         return (np.asarray(toks), np.asarray(parents), np.asarray(cums))
 
-    def _get_step(self, record, chunk: int, reorder: bool):
-        key = (chunk, reorder)
+    def _get_step(self, record, chunk: int, reorder: bool,
+                  attend_len: Optional[int] = None):
+        key = (chunk, reorder, attend_len)
         if key not in record["steps"]:
-            record["steps"][key] = self._build_step(record, chunk, reorder)
+            record["steps"][key] = self._build_step(record, chunk, reorder,
+                                                    attend_len)
         return record["steps"][key]
 
     def inference(self, model_id: int, bc: BatchConfig,
