@@ -292,7 +292,12 @@ class MeasuredCostModel:
         est = estimate_op_cost(layer, out_shapes, self.machine, dp, tp, sp)
         key = self._key(layer, out_shapes, dp, tp, sp)
         if key in self.cache:
+            # None is the 'unmeasurable' sentinel (stored below when
+            # make_op_runner declines) — fall back to the roofline instead
+            # of treating it as a timing
             fwd = self.cache[key]
+            if fwd is None:
+                fwd = est.forward_time
         elif run is not None:
             fwd = self.cache[key] = self._time(run)
         elif self.auto_measure:

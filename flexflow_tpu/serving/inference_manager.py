@@ -102,6 +102,41 @@ def _param_pspecs(model) -> Dict[str, Dict[str, PartitionSpec]]:
     return specs
 
 
+def beam_rerank(outs, cum, R: int, W: int):
+    """On-device W*W joint beam re-rank for a chunk-1 BeamTopK step (the
+    reference's host-side store_beam_metadata re-ranking).  Shared by the
+    fused beam block and the spec block so the load-bearing assumptions
+    (probability-sorted candidates from the head, row layout r*W+b) live
+    in one place.
+
+    ``outs``: step outputs (ids, parents, logps); ``cum`` [R, W] running
+    log-probs.  Returns (tok_new [R, W] int32, parent_b [R, W] int32,
+    top_val [R, W] f32, rows_next [R*W] int32 cache-gather permutation).
+    """
+    # the BeamTopK head emits max_beam_width candidates sorted by
+    # probability; use the first W
+    ids = outs[0][:, 0, :W].reshape(R, W * W)                   # [R, W*W]
+    logp = outs[2][:, 0, :W].astype(jnp.float32).reshape(R, W, W)
+    cand = cum[:, :, None] + logp                               # [R, Wp, Wc]
+    top_val, top_idx = jax.lax.top_k(cand.reshape(R, W * W), W)
+    parent_b = (top_idx // W).astype(jnp.int32)
+    tok_new = jnp.take_along_axis(ids, top_idx, axis=1).astype(jnp.int32)
+    rows_next = (jnp.arange(R)[:, None] * W
+                 + parent_b).reshape(R * W).astype(jnp.int32)
+    return tok_new, parent_b, top_val, rows_next
+
+
+def pow2_bucket(need: int, alloc_len: int) -> Optional[int]:
+    """Pow2 shape bucket (floor 64) for a static attended-cache bound:
+    the single source of bucketing policy for the single-step, decode-block
+    and spec-block paths (bounded jit-variant count).  None = no saving
+    (the bucket reaches the allocation)."""
+    L = 64
+    while L < need:
+        L *= 2
+    return None if L >= alloc_len else L
+
+
 def attend_bucket(bc, span: int, alloc_len: int) -> Optional[int]:
     """Static pow2 bound on the attended cache prefix for this batch:
     active rows' positions stay below max(first_depth) + span.  None =
@@ -110,10 +145,7 @@ def attend_bucket(bc, span: int, alloc_len: int) -> Optional[int]:
     if not act.any():
         return None
     need = int(np.asarray(bc.first_token_depth)[act].max()) + span
-    L = 64
-    while L < need:
-        L *= 2
-    return None if L >= alloc_len else L
+    return pow2_bucket(need, alloc_len)
 
 
 def fuse_qkv(model) -> None:
@@ -414,18 +446,8 @@ class InferenceManager:
                 b["first_depth"] = depth
                 b["parent_rows"] = parent_rows
                 outs, caches = step(params, caches, b, rng_i)
-                # the BeamTopK head emits max_beam_width candidates; use
-                # the first W (they are sorted by probability)
-                ids = outs[0][:, 0, :W].reshape(R, W * W)   # [R, W*W]
-                logp = outs[2][:, 0, :W].reshape(R, W, W)
-                cand = cum[:, :, None] + logp               # [R, Wp, Wc]
-                top_val, top_idx = jax.lax.top_k(
-                    cand.reshape(R, W * W), W)              # [R, W]
-                parent_b = top_idx // W
-                tok_new = jnp.take_along_axis(ids, top_idx, axis=1)
-                tok_new = tok_new.astype(jnp.int32)
-                rows_next = (jnp.arange(R)[:, None] * W
-                             + parent_b).reshape(RW).astype(jnp.int32)
+                tok_new, parent_b, top_val, rows_next = beam_rerank(
+                    outs, cum, R, W)
                 carry2 = (caches, tok_new.reshape(RW), top_val,
                           depth + active, rows_next)
                 return carry2, (tok_new, parent_b, top_val)
@@ -512,7 +534,11 @@ class InferenceManager:
 
             assert not reorder, "beam reorder under pp serving: unsupported"
             return pipeline_inference(self, record, model_id, batch, rng)
-        step = self._get_step(record, bc.chunk, reorder)
+        # bound the attended cache prefix for this step (sharded caches
+        # skip the slice inside the op, so don't fork jit variants there)
+        attend_len = (attend_bucket(bc, bc.chunk, record["alloc_len"])
+                      if record["mesh"] is None else None)
+        step = self._get_step(record, bc.chunk, reorder, attend_len)
         outs, record["caches"] = step(record["model"].params,
                                       record["caches"], batch, rng)
         return outs
@@ -558,10 +584,14 @@ class InferenceManager:
         include_init = init_tokens is not None
         if init_tokens is None:
             init_tokens = batch["token_ids"][:, 0]
-        key = ("block", k, include_init)
+        # span covers the block's k depth advances (+1 for the scatter at
+        # the final depth); pow2 bucketing keeps the jit-variant count low
+        attend_len = (attend_bucket(bc, k + 1, record["alloc_len"])
+                      if record["mesh"] is None else None)
+        key = ("block", k, include_init, attend_len)
         if key not in record["steps"]:
             record["steps"][key] = self._build_decode_block(
-                record, k, include_init)
+                record, k, include_init, attend_len)
         toks, record["caches"] = record["steps"][key](
             record["model"].params, record["caches"], batch,
             jax.random.split(rng, k),

@@ -369,8 +369,12 @@ def pipeline_decode_block(im, record, model_id: int, bc, k: int, rng,
                             for kk, v in bounds[g].items()}
                 stage_caches = {n: group_caches[g][n]
                                 for n in stage_cache_names[s]}
+                # per-group key: sharing step_rng across groups would give
+                # equal in-group row indices identical Gumbel noise under
+                # do_sample (rows r and r+Rg correlated)
                 out, new_caches = steps[s](stage_params[s], stage_caches,
-                                           boundary, sbatch, step_rng)
+                                           boundary, sbatch,
+                                           jax.random.fold_in(step_rng, g))
                 group_caches[g].update(new_caches)
                 if s == pp - 1:
                     outs_g[g] = out

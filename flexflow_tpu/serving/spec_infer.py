@@ -185,7 +185,15 @@ def _ssm_prefill(rm, im, ssm_id, states, running, beam_width, seed_rng):
             bc.max_sequence_length[rr] = req.max_sequence_length
             bc.token_ids[rr, :n] = span[:n]
             req.profile.ssm_prefill_chunks += 1
-            req.profile.ssm_prefill_rows += 1
+        # count rows from what was ACTUALLY marked available for each
+        # request — a regression back to feeding all W beam rows then
+        # makes rows == W * chunks and the dedup-invariant test fails
+        guids = np.asarray(bc.request_guid)
+        avail = np.asarray(bc.request_available)
+        for row, req in running.items():
+            if spans.get(row) is not None:
+                req.profile.ssm_prefill_rows += int(
+                    (avail & (guids == req.guid)).sum())
         outs = im.inference(ssm_id, bc, rng=seed_rng)
         ids, parents, logps = (np.asarray(outs[0]), np.asarray(outs[1]),
                                np.asarray(outs[2]))
@@ -205,7 +213,8 @@ def _ssm_prefill(rm, im, ssm_id, states, running, beam_width, seed_rng):
 def generate_spec_infer(rm, im, llm_id: int, requests: Sequence[Request],
                         seed: int = 0,
                         beam_width: Optional[int] = None,
-                        beam_depth: Optional[int] = None
+                        beam_depth: Optional[int] = None,
+                        device_loop: Optional[bool] = None
                         ) -> List[GenerationResult]:
     """The SpecInfer macro-loop (reference request_manager.cc:1984-2070).
 
@@ -213,8 +222,24 @@ def generate_spec_infer(rm, im, llm_id: int, requests: Sequence[Request],
     iterates all SSMs, request_manager.cc:2031-2042); their candidate
     trees merge into one shared per-request tree via prefix dedup
     (merge_dfs_trees semantics) before a single LLM verify step.
+
+    ``device_loop``: run the single-SSM device-resident macro-iteration
+    (spec_block.py — one host sync per K macro-iterations instead of ~3
+    per iteration).  Default auto: device when supported (single SSM, no
+    pp, width matching the compiled beam), host otherwise; committed
+    tokens are identical either way (greedy verify over the same
+    candidate set).  FF_SPEC_DEVICE=0 forces the host path.
     """
     assert rm.ssm_model_ids, "spec_infer needs a registered SSM"
+    from .spec_block import device_loop_supported, generate_spec_infer_device
+
+    if device_loop is None:
+        device_loop = device_loop_supported(rm, im, llm_id, beam_width,
+                                            beam_depth)
+    if device_loop:
+        return generate_spec_infer_device(rm, im, llm_id, requests,
+                                          seed=seed, beam_width=beam_width,
+                                          beam_depth=beam_depth)
     ssm_ids = list(rm.ssm_model_ids)
     tree_chunk = rm.max_spec_tree_token_num
     rng = jax.random.PRNGKey(seed)

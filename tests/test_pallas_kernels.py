@@ -49,7 +49,8 @@ def test_fast_path_gate():
     assert not fast_path_ok(8, 2048, 130)      # N not tile-aligned
     assert not fast_path_ok(8, 100, 512)       # K not 128-aligned
     assert not fast_path_ok(128, 2048, 512)    # prefill-sized batch
-    assert not fast_path_ok(8, 16384, 512)     # VMEM block too large
+    assert fast_path_ok(8, 16384, 512)         # 256-wide blocks fit VMEM
+    assert not fast_path_ok(8, 32768, 512)     # K beyond the whole-K gate
 
 
 def test_int8_matmul_zero_scale_padding():

@@ -196,6 +196,50 @@ class TestSpecInfer:
         assert prof.ssm_prefill_chunks > 0
         assert prof.ssm_prefill_rows == prof.ssm_prefill_chunks
 
+    def test_survivor_across_state_rebuild(self):
+        """Regression (device loop): a request still mid-generation when a
+        retirement admits a pending one survives the device-state rebuild
+        — its fold cursor and profile-counter bases must reset with the
+        fresh epoch's zeroed output buffer, or its next tokens are
+        silently dropped.  Staggered budgets force a surviving row (equal
+        budgets retire together and never hit this path)."""
+        from flexflow_tpu.serving import InferenceManager, RequestManager
+        from flexflow_tpu.serving.spec_infer import generate_spec_infer
+
+        llm_hf = _hf_llama(TINY, seed=3)
+        ssm_hf = _hf_llama(SMALLER, seed=4)
+        prompts = [[1, 5, 9], [2, 8, 4, 6], [7, 3]]
+        budgets = [24, 6, 10]   # row 0 survives row 1's retirement
+
+        def run(device_loop):
+            llm = _build(llm_hf, InferenceMode.TREE_VERIFY, max_requests=2)
+            ssm = _build(ssm_hf, InferenceMode.BEAM_SEARCH, max_requests=2)
+            im = InferenceManager(llm.config)
+            lid = im.compile_model_and_allocate_buffer(
+                llm, mode=InferenceMode.TREE_VERIFY, max_requests=2,
+                max_seq_length=256, cache_dtype=np.float32)
+            sid = im.compile_model_and_allocate_buffer(
+                ssm, mode=InferenceMode.BEAM_SEARCH, max_requests=2,
+                max_seq_length=256, beam_width=2, cache_dtype=np.float32)
+            rm = RequestManager(max_requests_per_batch=2,
+                                max_tokens_per_batch=64,
+                                max_sequence_length=256,
+                                max_spec_tree_token_num=24)
+            rm.register_ssm_model(sid)
+            reqs = [rm.register_new_request(list(p), max_new_tokens=n)
+                    for p, n in zip(prompts, budgets)]
+            generate_spec_infer(rm, im, lid, reqs, beam_width=2,
+                                beam_depth=4, device_loop=device_loop)
+            return ([r.tokens[r.prompt_len:] for r in reqs],
+                    [(r.profile.accepted_tokens, r.profile.speculated_tokens)
+                     for r in reqs])
+
+        dev_toks, dev_prof = run(True)
+        host_toks, _ = run(False)
+        assert dev_toks == host_toks, (dev_toks, host_toks)
+        for n, (acc, spec) in zip(budgets, dev_prof):
+            assert 0 <= acc <= spec, (acc, spec)
+
     def test_two_ssms_token_exact(self):
         """Two registered SSMs both speculate each macro-iteration
         (reference iterates all SSMs, request_manager.cc:2031-2042);
