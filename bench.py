@@ -20,10 +20,13 @@ Modes: `python bench.py [all|llama|spec|mnist|kernels]` (default all).
 """
 
 import json
+import os
 import sys
 import time
 
 import numpy as np
+
+REPO = os.path.dirname(os.path.abspath(__file__))
 
 
 def bench_llama_decode():
@@ -150,18 +153,27 @@ def bench_llama7b_decode():
         total = run()
         best = max(best, total / (time.time() - t0))
 
-    # device-side step time: one k=64 decode block, one sync (the
-    # tunnel-safe methodology, docs/INTERNALS.md)
+    # device-side step time via decode-block K-DIFFERENCING: the tunnel
+    # RTT is large (~0.1-0.7 s) AND volatile, so a single timed block's
+    # sync contaminates ms/step by RTT/k (r2's 56.5 ms "step" was mostly
+    # tunnel).  Timing k=16 and k=112 and dividing the difference by 96
+    # cancels the fixed sync/dispatch cost exactly.
     bc = BatchConfig(max_requests, 1)
     bc.request_available[:] = True
     bc.num_tokens_in_batch[:] = 1
     bc.first_token_depth[:] = prompt_len + 2
     bc.token_ids[:, 0] = 7
-    k = 64
-    im.decode_block(mid, bc, k)                      # warm this bucket
-    t0 = time.time()
-    np.asarray(im.decode_block(mid, bc, k))
-    ms_step = (time.time() - t0) / k * 1e3
+
+    def block_s(k):
+        im.decode_block(mid, bc, k, min_remaining=150)   # warm this bucket
+        best = 1e9
+        for _ in range(3):
+            t0 = time.time()
+            np.asarray(im.decode_block(mid, bc, k, min_remaining=150))
+            best = min(best, time.time() - t0)
+        return best
+
+    ms_step = (block_s(112) - block_s(16)) / 96 * 1e3
 
     w_bytes = sum(
         int(np.prod(v.shape)) * v.dtype.itemsize
@@ -174,6 +186,12 @@ def bench_llama7b_decode():
          "vs_baseline": 0},
         {"metric": "llama7b_int8_decode_device_ms_per_step",
          "value": round(ms_step, 2), "unit": "ms",
+         "methodology": ("decode-block k-differencing (112-16)/96, "
+                         "best-of-3 — cancels the volatile tunnel RTT "
+                         "that inflated r2's number; roofline_ms = "
+                         "int8 weight bytes / 819 GB/s (v5e spec — "
+                         "fraction >1 means the chip streams faster "
+                         "than that spec)"),
          "roofline_ms": round(roofline_ms, 2),
          "roofline_fraction": round(roofline_ms / ms_step, 3),
          "vs_baseline": 0},
@@ -313,6 +331,197 @@ def bench_spec_infer():
     ]
 
 
+def bench_opt125m():
+    """OPT-125M single-chip greedy incremental decoding (BASELINE.md
+    measurement config 3).  Random-init weights at the exact HF-default
+    125M architecture — decode cost is weight-independent."""
+    import jax
+
+    from flexflow_tpu import FFConfig, Model
+    from flexflow_tpu.fftype import DataType
+    from flexflow_tpu.models.opt import OPTConfig, create_opt_model
+    from flexflow_tpu.serving import InferenceManager, RequestManager
+
+    cfg = OPTConfig()          # HF facebook/opt-125m defaults
+    max_requests = 16
+    prompt_len = 16
+    new_tokens = 64
+    ff = FFConfig(computation_dtype="bfloat16")
+    model = Model(ff, name="opt125m_bench")
+    create_opt_model(model, cfg, max_requests=max_requests,
+                     dtype=DataType.HALF)
+    model.params = model.init_params(jax.random.PRNGKey(0))
+    im = InferenceManager(ff)
+    mid = im.compile_model_and_allocate_buffer(
+        model, max_requests=max_requests, max_seq_length=256,
+        prefill_chunk=64)
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(4, 50000, prompt_len).tolist()
+               for _ in range(max_requests)]
+
+    def run():
+        rm = RequestManager(max_requests_per_batch=max_requests,
+                            max_tokens_per_batch=32,
+                            max_sequence_length=256, decode_block=64)
+        reqs = [rm.register_new_request(p, max_new_tokens=new_tokens)
+                for p in prompts]
+        results = rm.generate_incr_decoding(im, mid, reqs)
+        return sum(len(r.output_tokens) for r in results)
+
+    run()   # warmup
+    best = 0.0
+    for _ in range(3):
+        t0 = time.time()
+        total = run()
+        best = max(best, total / (time.time() - t0))
+    return [{"metric": "opt125m_decode_throughput_1chip",
+             "value": round(best, 1), "unit": "tokens/s",
+             "methodology": "bf16,random-weights,best-of-3,batch16,"
+                            "greedy (BASELINE config 3)",
+             "vs_baseline": 0}]
+
+
+def bench_resnet50_dp():
+    """ResNet-50 data-parallel training (BASELINE.md measurement
+    config 2): real single-chip throughput, plus a dp-scaling curve on
+    the 8-device virtual CPU mesh run in a SUBPROCESS (the driver's chip
+    is single-device; the scaling shape — GSPMD AllReduce over the dp
+    axis — is what the virtual mesh validates, not absolute speed)."""
+    import subprocess
+    import sys as _sys
+
+    sys.path.insert(0, os.path.join(REPO, "examples", "python"))
+    from resnet import build_resnet
+
+    from flexflow_tpu import (FFConfig, LossType, MetricsType,
+                              SGDOptimizer)
+
+    batch, image, classes, iters = 32, 64, 16, 6
+    config = FFConfig(batch_size=batch)
+    model = build_resnet(config, 50, classes, image)
+    model.compile(optimizer=SGDOptimizer(lr=0.01, momentum=0.9),
+                  loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+                  metrics=[MetricsType.ACCURACY])
+    rng = np.random.default_rng(0)
+    n = batch * iters
+    xs = rng.standard_normal((n, 3, image, image)).astype(np.float32)
+    ys = rng.integers(0, classes, n).astype(np.int32)
+    model.fit(xs, ys, epochs=1)      # warm/compile
+    t0 = time.time()
+    model.fit(xs, ys, epochs=1)
+    tput = n / (time.time() - t0)
+
+    # dp-scaling curve on the virtual CPU mesh (subprocess: this process
+    # owns the TPU backend)
+    code = (
+        "import os; os.environ['JAX_PLATFORMS']='cpu';"
+        "os.environ['XLA_FLAGS']=os.environ.get('XLA_FLAGS','')"
+        "+' --xla_force_host_platform_device_count=8';"
+        "import jax; jax.config.update('jax_platforms','cpu');"
+        "import sys, time, numpy as np;"
+        f"sys.path.insert(0, {REPO!r});"
+        f"sys.path.insert(0, {os.path.join(REPO, 'examples', 'python')!r});"
+        "from resnet import build_resnet;"
+        "from flexflow_tpu import FFConfig, LossType, MetricsType, "
+        "SGDOptimizer;\n"
+        "out=[]\n"
+        "for dp in (1, 2, 4, 8):\n"
+        "    cfg = FFConfig(batch_size=32, data_parallelism_degree=dp,\n"
+        "                   devices=jax.devices()[:dp])\n"
+        "    m = build_resnet(cfg, 50, 16, 32)\n"
+        "    m.compile(optimizer=SGDOptimizer(lr=0.01),\n"
+        "              loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,\n"
+        "              metrics=[MetricsType.ACCURACY])\n"
+        "    rng = np.random.default_rng(0)\n"
+        "    xs = rng.standard_normal((64, 3, 32, 32)).astype(np.float32)\n"
+        "    ys = rng.integers(0, 16, 64).astype(np.int32)\n"
+        "    m.fit(xs, ys, epochs=1)\n"
+        "    t0 = time.time(); m.fit(xs, ys, epochs=1)\n"
+        "    out.append(round(64 / (time.time() - t0), 1))\n"
+        "print('DPSCALE', out)\n")
+    curve = None
+    try:
+        r = subprocess.run([_sys.executable, "-c", code],
+                           capture_output=True, text=True, timeout=1200)
+        for line in r.stdout.splitlines():
+            if line.startswith("DPSCALE"):
+                curve = eval(line.split(" ", 1)[1])
+    except Exception:
+        pass
+    return [{"metric": "resnet50_dp_training_throughput_1chip",
+             "value": round(tput, 1), "unit": "samples/s",
+             "methodology": f"batch{batch},image{image},f32,"
+                            "2nd-epoch wall clock (BASELINE config 2)",
+             "dp_scaling_virtual_cpu_mesh": curve,
+             "vs_baseline": 0}]
+
+
+def bench_longctx():
+    """Long-context serving: single-chip 8k-prompt TTFT (the round-1
+    'demonstrate >=32k context' task's on-chip half) plus the sp-sharded
+    32k KV memory math (multi-chip hardware is not available; the sp
+    serving path itself is token-exact on the virtual mesh,
+    tests/test_sp_serving.py)."""
+    import jax
+
+    from flexflow_tpu import FFConfig, Model
+    from flexflow_tpu.fftype import DataType
+    from flexflow_tpu.models.llama import LLAMAConfig, create_llama_model
+    from flexflow_tpu.serving import InferenceManager, RequestManager
+
+    cfg = LLAMAConfig(
+        vocab_size=32000, hidden_size=2048, intermediate_size=5504,
+        num_hidden_layers=24, num_attention_heads=16,
+        num_key_value_heads=4, max_position_embeddings=16384)
+    S = 8192
+    ff = FFConfig(computation_dtype="bfloat16")
+    model = Model(ff, name="longctx_bench")
+    create_llama_model(model, cfg, max_requests=1, dtype=DataType.HALF)
+    model.params = model.init_params(jax.random.PRNGKey(0))
+    im = InferenceManager(ff)
+    mid = im.compile_model_and_allocate_buffer(
+        model, max_requests=1, max_seq_length=S + 64, prefill_chunk=512)
+    rng = np.random.default_rng(0)
+    prompt = rng.integers(4, 31000, S).tolist()
+
+    def run():
+        rm = RequestManager(max_requests_per_batch=1,
+                            max_tokens_per_batch=512,
+                            max_sequence_length=S + 64, decode_block=16)
+        req = rm.register_new_request(prompt, max_new_tokens=16)
+        rm.generate_incr_decoding(im, mid, [req])
+        return req.profile.first_token_time - req.profile.start_time
+
+    run()   # warmup (compiles the prefill chunk buckets)
+    ttft = min(run() for _ in range(3))
+
+    # sp-sharded 32k memory math: per-shard KV bytes for a batch of 8 at
+    # 32k context, 1.4B arch, bf16 cache — vs one v5e chip's 16 GB
+    R32, S32, sp = 8, 32768, 4
+    kv_heads, d, layers = 4, 128, 24
+    total_kv = R32 * S32 * kv_heads * d * 2 * 2 * layers
+    per_shard = total_kv // sp
+    weights = 2.8e9
+    return [
+        {"metric": "llama1p4b_8k_prompt_ttft_1chip",
+         "value": round(ttft * 1e3, 1), "unit": "ms",
+         "methodology": "8192-token prompt, chunked prefill (512/step), "
+                        "bf16, best-of-3, host-observed first token",
+         "vs_baseline": 0},
+        {"metric": "llama1p4b_32k_sp4_kv_bytes_per_shard",
+         "value": round(per_shard / 1e9, 2), "unit": "GB",
+         "methodology": (
+             f"batch {R32} x {S32} ctx, bf16 KV, {layers}L: total "
+             f"{total_kv / 1e9:.1f} GB KV > 16 GB HBM single-chip even "
+             f"before {weights / 1e9:.1f} GB weights; sp={sp} shards the "
+             f"cache length axis to {per_shard / 1e9:.1f} GB/chip + "
+             "replicated weights = fits; attention combines softmax "
+             "across shards via GSPMD (ops/ring_attention.py + sp cache, "
+             "token-exact on the virtual mesh)"),
+         "vs_baseline": 0},
+    ]
+
+
 def bench_mnist_mlp():
     from flexflow_tpu import FFConfig, LossType, Model, SGDOptimizer
     from flexflow_tpu.fftype import ActiMode
@@ -351,110 +560,101 @@ def bench_mnist_mlp():
 
 
 def bench_kernels():
-    """On-chip Pallas-kernel vs jnp-reference timings (µs/call) so kernel
-    regressions and wins are reproducible, not commit-message lore.
-    Methodology (tunnel-safe, see docs/INTERNALS.md): device-resident
-    fori_loop with all operands as jit args (never closure constants),
-    one np.asarray fetch per measurement."""
+    """On-chip kernel timings (µs/call) so kernel regressions and wins are
+    reproducible, not commit-message lore.
+
+    Methodology: ITERATION-COUNT DIFFERENCING — time a device-resident
+    fori_loop at two iteration counts and divide the difference; the
+    volatile tunnel RTT (~0.1-0.7 s per fetch, which at 100 iters silently
+    added ~1000 µs/call to every round-2 number) cancels exactly.  All
+    operands ride the loop carry (never closure constants).
+
+    The shipped Pallas kernel is the length-tiled flash-decode attention
+    (kernels/flash_decode.py).  Its bench is the regime the host cost
+    model dispatches it for — a RAGGED batch (one long-context row among
+    short rows), where the XLA attend must read every row to the batch
+    max while flash reads each row's own tiles.  The uniform case is also
+    reported: there XLA wins and the dispatcher keeps it (flash_wins
+    returns False), so 'flash loses uniform' is the dispatcher working,
+    not a regression."""
     import jax
     import jax.numpy as jnp
 
-    from flexflow_tpu.kernels import decode_attention as da
-    from flexflow_tpu.kernels import quant_matmul as qm
+    from flexflow_tpu.kernels.flash_decode import flash_decode_attend
+    from flexflow_tpu.ops.serving_attention import _attend
 
     def log(msg):
         print(msg, file=sys.stderr, flush=True)
 
-    def time_loop(body, init, iters=100):
-        jf = jax.jit(lambda c: jax.lax.fori_loop(
-            0, iters, lambda i, c: body(c), c))
-        c = jf(init)
-        np.asarray(jax.tree.leaves(c)[0]).ravel()[0]   # compile+warm
-        t0 = time.time()
-        c = jf(init)
-        np.asarray(jax.tree.leaves(c)[0]).ravel()[0]   # one real sync
-        return (time.time() - t0) / iters * 1e6        # µs/call
+    def time_loop(body, init, lo=50, hi=250):
+        def run(iters):
+            jf = jax.jit(lambda c: jax.lax.fori_loop(
+                0, iters, lambda i, c: body(c), c))
+            c = jf(init)
+            np.asarray(jax.tree.leaves(c)[0]).ravel()[0]   # compile+warm
+            best = 1e9
+            for _ in range(3):
+                t0 = time.time()
+                c = jf(init)
+                np.asarray(jax.tree.leaves(c)[0]).ravel()[0]
+                best = min(best, time.time() - t0)
+            return best
+        return (run(hi) - run(lo)) / (hi - lo) * 1e6       # µs/call
 
     out = []
     rng = np.random.default_rng(0)
 
-    # --- int8 dequant matmul, decode shape (B=16, K=N=4096) ------------
+    # --- int8 convert-dot (the shipped quantized-matmul path) ----------
     B, K, N = 16, 4096, 4096
     x = jnp.asarray(rng.standard_normal((B, K)), jnp.bfloat16)
     q = jnp.asarray(rng.integers(-127, 127, (K, N)), jnp.int8)
     scale = jnp.asarray(rng.random(N) * 0.01, jnp.float32)
 
-    def mm_pallas(c):
+    def mm_int8(c):
         x, q, scale = c
-        return (qm.int8_matmul_fast(x, q, scale), q, scale)
+        y = (jnp.dot(x, q.astype(x.dtype),
+                     preferred_element_type=jnp.float32) * scale)
+        return (y.astype(x.dtype), q, scale)
 
-    def mm_ref(c):
-        x, q, scale = c
-        return (qm.int8_matmul_reference(x, q, scale), q, scale)
+    log("bench_kernels: int8 convert-dot")
+    out.append({"metric": "kernel_int8_convertdot_xla_4096",
+                "value": round(time_loop(mm_int8, (x, q, scale)), 1),
+                "unit": "us/call",
+                "methodology": "iteration-differenced fori_loop; ideal "
+                               "(819 GB/s) = 20 us",
+                "vs_baseline": 0})
 
-    log("bench_kernels: int8 pallas")
-    out.append({"metric": "kernel_int8_matmul_pallas_4096",
-                "value": round(time_loop(mm_pallas, (x, q, scale)), 1),
-                "unit": "us/call", "vs_baseline": 0})
-    log("bench_kernels: int8 xla")
-    out.append({"metric": "kernel_int8_matmul_xla_4096",
-                "value": round(time_loop(mm_ref, (x, q, scale)), 1),
-                "unit": "us/call", "vs_baseline": 0})
+    # --- flash-decode attention vs XLA attend --------------------------
+    R, H, KV, D, S = 16, 16, 4, 128, 8192
+    qv = jnp.asarray(rng.standard_normal((R, H, D)), jnp.bfloat16)
+    ck = jnp.asarray(rng.standard_normal((R, S, KV, D)), jnp.bfloat16)
+    cv = jnp.asarray(rng.standard_normal((R, S, KV, D)), jnp.bfloat16)
+    act = jnp.ones((R,), jnp.int32)
+    sc = 1.0 / np.sqrt(D)
+    ragged = np.full(R, 300)
+    ragged[0] = S - 2      # one 8k-context row among 300-token rows
+    for name, depth_np in (("ragged", ragged),
+                           ("uniform", np.full(R, S - 2))):
+        depth = jnp.asarray(depth_np, jnp.int32)
+        span = jnp.arange(S)[None, None, :]
+        mask = (span <= depth[:, None, None]) & (act > 0)[:, None, None]
 
-    # --- fused decode attention vs jnp scatter+attend -------------------
-    # NOT timed via fori_loop: the aliased-cache Pallas call does not
-    # compile inside a scan/fori body in reasonable time on this chip.
-    # Host-chained async dispatch instead (q feeds back, caches donated),
-    # one fetch at the end — dispatches stream without per-call syncs.
-    def time_chain(fn, init, iters=30):
-        jf = jax.jit(fn, donate_argnums=(3, 4))
+        def att_flash(c, depth=depth):
+            qv, ck, cv = c
+            return (flash_decode_attend(qv, ck, cv, depth, act, sc),
+                    ck, cv)
 
-        def run():
-            qv, kn, vn, ck, cv = init
-            ck, cv = jnp.copy(ck), jnp.copy(cv)   # donation-safe copies
-            for _ in range(iters):
-                qv, ck, cv = jf(qv, kn, vn, ck, cv)
-            np.asarray(qv).ravel()[0]
+        def att_xla(c, mask=mask):
+            qv, ck, cv = c
+            return (_attend(qv[:, None], ck, cv, mask, sc)[:, 0], ck, cv)
 
-        run()                                      # compile + warm
-        t0 = time.time()
-        run()
-        return (time.time() - t0) / iters * 1e6
-
-    # Pallas variants hold whole cache rows in VMEM and OOM on the 16M
-    # scoped-vmem limit beyond S=512 (measured: 18.15M at S=1024 jitted,
-    # 16.04M/22.18M blocked/dma at S=2048) — S capped here; long context
-    # needs a length-tiled flash-decode kernel.
-    R, H, KV, D = 16, 16, 4, 128
-    for S in (512,):
-        qv = jnp.asarray(rng.standard_normal((R, H, D)), jnp.bfloat16)
-        kn = jnp.asarray(rng.standard_normal((R, KV, D)), jnp.bfloat16)
-        vn = jnp.asarray(rng.standard_normal((R, KV, D)), jnp.bfloat16)
-        ck = jnp.asarray(rng.standard_normal((R, S, KV, D)), jnp.bfloat16)
-        cv = jnp.asarray(rng.standard_normal((R, S, KV, D)), jnp.bfloat16)
-        depth = jnp.full((R,), S - 2, jnp.int32)  # near-full cache read
-        active = jnp.ones((R,), jnp.int32)
-        sc = 1.0 / np.sqrt(D)
-
-        def att_pallas(qv, kn, vn, ck, cv, sc=sc, depth=depth,
-                       active=active):
-            o, ck, cv = da.fused_decode_attention(qv, kn, vn, ck, cv,
-                                                  depth, active, sc)
-            return o, ck, cv
-
-        def att_ref(qv, kn, vn, ck, cv, sc=sc, depth=depth, active=active):
-            o, ck, cv = da.decode_attention_reference(qv, kn, vn, ck, cv,
-                                                      depth, active, sc)
-            return o, ck, cv
-
-        init = (qv, kn, vn, ck, cv)
-        log(f"bench_kernels: attn pallas S={S}")
-        out.append({"metric": f"kernel_decode_attn_pallas_S{S}",
-                    "value": round(time_chain(att_pallas, init), 1),
+        log(f"bench_kernels: flash {name} S={S}")
+        out.append({"metric": f"kernel_flash_decode_{name}_S{S}",
+                    "value": round(time_loop(att_flash, (qv, ck, cv)), 1),
                     "unit": "us/call", "vs_baseline": 0})
-        log(f"bench_kernels: attn xla S={S}")
-        out.append({"metric": f"kernel_decode_attn_xla_S{S}",
-                    "value": round(time_chain(att_ref, init), 1),
+        log(f"bench_kernels: xla attend {name} S={S}")
+        out.append({"metric": f"kernel_decode_attn_xla_{name}_S{S}",
+                    "value": round(time_loop(att_xla, (qv, ck, cv)), 1),
                     "unit": "us/call", "vs_baseline": 0})
     return out
 
@@ -476,10 +676,22 @@ def main(which: str):
         head, *extras = bench_kernels()
         head["extras"] = extras
         return head
+    if which == "opt":
+        head, *extras = bench_opt125m()
+        head["extras"] = extras
+        return head
+    if which == "resnet":
+        head, *extras = bench_resnet50_dp()
+        head["extras"] = extras
+        return head
+    if which == "longctx":
+        head, *extras = bench_longctx()
+        head["extras"] = extras
+        return head
     if which != "all":
         raise SystemExit(
             f"unknown bench mode {which!r} (expected all|llama|llama7b|"
-            f"spec|mnist|kernels)")
+            f"spec|mnist|kernels|opt|resnet|longctx)")
     # all: headline decode metric + everything else under extras.  Each
     # section runs in its own process lifetime-wise (HBM frees between
     # them only at process exit), so 7B (10+ GB) runs FIRST while HBM is
@@ -488,7 +700,9 @@ def main(which: str):
     head7b, *ex7b = bench_llama7b_decode()
     extras += [head7b] + ex7b
     head = bench_llama_decode()
-    head["extras"] = extras + bench_spec_infer() + bench_kernels()
+    head["extras"] = (extras + bench_spec_infer() + bench_longctx()
+                      + bench_opt125m() + bench_resnet50_dp()
+                      + bench_kernels())
     return head
 
 

@@ -222,6 +222,15 @@ class Model:
     def gelu(self, x, name=None):
         return self._unary(OpType.GELU, x, name)
 
+    def constant(self, value, name=None) -> Tensor:
+        """Host-known constant tensor node (no inputs; value baked into
+        the graph) — the torch.fx importer's landing spot for traced
+        chains that fold to concrete arrays (e.g. position ids)."""
+        import numpy as _np
+
+        return self._add_layer(OpType.CONSTANT, [],
+                               dict(value=_np.asarray(value)), name)[0]
+
     def identity(self, x, name=None):
         return self._unary(OpType.IDENTITY, x, name)
 
@@ -348,7 +357,9 @@ class Model:
     def multihead_attention(self, query: Tensor, key: Tensor, value: Tensor,
                             embed_dim: int, num_heads: int, kdim: int = 0,
                             vdim: int = 0, dropout: float = 0.0,
-                            causal: bool = False, kernel_initializer=None,
+                            causal: bool = False, qkv_bias: bool = False,
+                            final_bias: bool = False,
+                            kernel_initializer=None,
                             name=None) -> Tensor:
         self._dropout_count += 1
         return self._add_layer(OpType.MULTIHEAD_ATTENTION,
@@ -356,6 +367,7 @@ class Model:
                                    embed_dim=embed_dim, num_heads=num_heads,
                                    kdim=kdim or embed_dim, vdim=vdim or embed_dim,
                                    dropout=dropout, causal=causal,
+                                   qkv_bias=qkv_bias, final_bias=final_bias,
                                    seed_offset=self._dropout_count,
                                    kernel_initializer=kernel_initializer), name)[0]
 
@@ -633,8 +645,12 @@ class Model:
             lp = {}
             for ps in layer.param_specs:
                 rng, sub = jax.random.split(rng)
-                lp[ps.name] = ps.initializer(sub, ps.shape, ps.dtype.to_jnp(),
-                                             fans=ps.fans)
+                if ps.initializer is None:   # bias-style spec: zeros
+                    lp[ps.name] = jnp.zeros(ps.shape, ps.dtype.to_jnp())
+                else:
+                    lp[ps.name] = ps.initializer(sub, ps.shape,
+                                                 ps.dtype.to_jnp(),
+                                                 fans=ps.fans)
             params[layer.name] = lp
         return params
 

@@ -126,6 +126,7 @@ class OpType(enum.Enum):
     INPUT = "input"
     WEIGHT = "weight"
     NOOP = "noop"
+    CONSTANT = "constant"
     LINEAR = "linear"
     CONV2D = "conv2d"
     POOL2D = "pool2d"

@@ -68,7 +68,7 @@ class MultiHeadAttention(OpDef):
         vdim = attrs.get("vdim") or e
         dt = q.dtype
         init = attrs.get("kernel_initializer") or DEFAULT_WEIGHT_INIT
-        return [
+        ps = [
             ParamSpec("wq", (q.shape[-1], h, kdim // h), dt, init,
                       fans=(q.shape[-1], kdim)),
             ParamSpec("wk", (k.shape[-1], h, kdim // h), dt, init,
@@ -77,12 +77,25 @@ class MultiHeadAttention(OpDef):
                       fans=(v.shape[-1], vdim)),
             ParamSpec("wo", (h, vdim // h, e), dt, init, fans=(vdim, e)),
         ]
+        # projection biases (reference attention.cc qkv/final bias flags;
+        # GPT-2-style checkpoints need them for the torch.fx importer)
+        if attrs.get("qkv_bias", False):
+            ps += [ParamSpec("bq", (h, kdim // h), dt),
+                   ParamSpec("bk", (h, kdim // h), dt),
+                   ParamSpec("bv", (h, vdim // h), dt)]
+        if attrs.get("final_bias", False):
+            ps.append(ParamSpec("bo", (e,), dt))
+        return ps
 
     def forward(self, params, inputs, attrs, ctx):
         xq, xk, xv = inputs  # [B, S, E]
         q = jnp.einsum("bse,ehd->bhsd", xq, params["wq"].astype(xq.dtype))
         k = jnp.einsum("bse,ehd->bhsd", xk, params["wk"].astype(xk.dtype))
         v = jnp.einsum("bse,ehd->bhsd", xv, params["wv"].astype(xv.dtype))
+        if attrs.get("qkv_bias", False):
+            q = q + params["bq"].astype(q.dtype)[None, :, None, :]
+            k = k + params["bk"].astype(k.dtype)[None, :, None, :]
+            v = v + params["bv"].astype(v.dtype)[None, :, None, :]
         rate = attrs.get("dropout", 0.0)
         drop_rng = None
         if ctx.training and rate > 0.0:
@@ -92,6 +105,8 @@ class MultiHeadAttention(OpDef):
                             dropout_rate=rate if ctx.training else 0.0,
                             dropout_rng=drop_rng)
         y = jnp.einsum("bhsd,hde->bse", out, params["wo"].astype(out.dtype))
+        if attrs.get("final_bias", False):
+            y = y + params["bo"].astype(y.dtype)
         return [y]
 
     def flops(self, attrs, in_specs):

@@ -55,6 +55,10 @@ class OpContext:
     # reads cache[:, :attend_len] instead of the full padded allocation —
     # at 7B/MHA the full-length read costs more than the weights)
     attend_len: Any = None
+    # serving: host's cost decision that this step's depth profile favors
+    # the length-tiled flash-decode kernel's per-row pruning over the XLA
+    # attend (inference_manager.flash_wins)
+    use_flash: bool = False
     mesh: Any = None
     extra_outputs: Dict = None  # side outputs (e.g. beam parent ids)
     state_updates: Dict = None  # non-trainable state written by ops (BN stats)

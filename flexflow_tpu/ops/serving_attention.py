@@ -98,39 +98,12 @@ def _attend(q, cache_k, cache_v, mask, scale, alibi=None):
     return out.reshape(R, C, H, D).astype(q.dtype)
 
 
-def _int8_fast_proj(params, name, x2, ctx):
-    """Project through the whole-K Pallas int8 kernel when the layout
-    allows (int8_nd weights, single device, tile-aligned shapes) —
-    without it, 7B int8 decode pays a per-step dequant of every
-    attention projection.  Returns [rows, N] or None (caller falls back
-    to the XLA dequant einsum).  The weight reshape 3-D->2-D is a
-    contiguous bitcast, not a copy (unlike the padded reshapes that made
-    the first in-scan attempt 100x slower)."""
-    import os
-
-    q = params.get(name + "_q")
-    if q is None or os.environ.get("FF_PALLAS_INT8") == "0":
-        return None
-    if ctx is not None and getattr(ctx, "mesh", None) is not None:
-        return None   # pallas_call has no GSPMD partitioning rule
-    scale = params[name + "_scale"]
-    if name == "wo":
-        if scale.ndim != 1:       # int4 packed layout: XLA path
-            return None
-        q2 = q.reshape(-1, q.shape[-1])
-        s2 = scale
-    else:                         # wq/wk/wv [E, H, D], scale [H, D]
-        if scale.ndim != 2:
-            return None
-        q2 = q.reshape(q.shape[0], -1)
-        s2 = scale.reshape(-1)
-    from ..kernels.quant_matmul import (fast_path_ok, int8_matmul_fast,
-                                        pallas_tpu_available)
-
-    if not (pallas_tpu_available()
-            and fast_path_ok(x2.shape[0], q2.shape[0], q2.shape[1])):
-        return None
-    return int8_matmul_fast(x2, q2, s2)
+def pallas_tpu_available() -> bool:
+    """True when Pallas kernels can compile for the local backend."""
+    try:
+        return jax.devices()[0].platform in ("tpu", "axon")
+    except Exception:
+        return False
 
 
 class _ServingAttentionBase(OpDef):
@@ -186,10 +159,14 @@ class _ServingAttentionBase(OpDef):
         def proj(name):
             w_q = params.get(name + "_q")
             if w_q is not None:
-                y2 = _int8_fast_proj(params, name,
-                                     x.reshape(-1, x.shape[-1]), ctx)
-                if y2 is not None:
-                    return y2.reshape(*x.shape[:-1], *w_q.shape[1:])
+                scale = params[name + "_scale"]
+                if scale.ndim == 2:   # int8_nd [E,H,D], scale [H,D]:
+                    # convert-dot + post-scale (exact; weights stream
+                    # int8, see Linear._quantized_matmul)
+                    y = jnp.einsum("rce,ehd->rchd", x,
+                                   w_q.astype(x.dtype),
+                                   preferred_element_type=jnp.float32)
+                    return (y * scale).astype(x.dtype)
             return jnp.einsum("rce,ehd->rchd", x,
                               resolve_weight(params, name, x.dtype))
 
@@ -201,11 +178,12 @@ class _ServingAttentionBase(OpDef):
         return q, k, v
 
     def _output(self, params, out, attrs, ctx=None):
-        y2 = _int8_fast_proj(params, "wo",
-                             out.reshape(-1, out.shape[-2] * out.shape[-1])
-                             .astype(out.dtype), ctx)
-        if y2 is not None:
-            y = y2.reshape(*out.shape[:-2], y2.shape[-1])
+        wo_q = params.get("wo_q")
+        if wo_q is not None and params["wo_scale"].ndim == 1:
+            # int8_nd [H,D,E], scale [E]: convert-dot + post-scale
+            y = jnp.einsum("rchd,hde->rce", out, wo_q.astype(out.dtype),
+                           preferred_element_type=jnp.float32)
+            y = (y * params["wo_scale"]).astype(out.dtype)
         else:
             y = jnp.einsum("rchd,hde->rce", out,
                            resolve_weight(params, "wo", out.dtype))
@@ -286,16 +264,14 @@ class IncMultiHeadSelfAttention(_ServingAttentionBase):
             k = apply_rotary_embedding(k.swapaxes(1, 2), positions[:, None, :],
                                        theta).swapaxes(1, 2)
         ck, cv = self._cache(ctx, layer)
-        fused_mode = self._fused_decode_ok(attrs, ctx, C, ck)
-        if fused_mode:
-            from ..kernels import decode_attention as _da
+        flash_mode = self._flash_decode_ok(attrs, ctx, C, ck)
+        if flash_mode:
+            from ..kernels.flash_decode import flash_decode_attention
 
-            fn = (_da.fused_decode_attention_dma if fused_mode == "dma"
-                  else _da.fused_decode_attention)
-            out1, ck, cv = fn(
+            out1, ck, cv = flash_decode_attention(
                 q[:, 0], k[:, 0], v[:, 0], ck, cv, bc["first_depth"],
                 bc["active"].astype(jnp.int32), self._scale(attrs),
-                interpret=(fused_mode == "interpret"))
+                interpret=(flash_mode == "interpret"))
             self._store(ctx, layer, ck, cv)
             return [self._output(params, out1[:, None], attrs, ctx)]
         ck = _scatter_chunk(ck, k, bc["first_depth"], bc["active"])
@@ -313,29 +289,27 @@ class IncMultiHeadSelfAttention(_ServingAttentionBase):
         return [self._output(params, out, attrs, ctx)]
 
     @staticmethod
-    def _fused_decode_ok(attrs, ctx, C, ck):
-        """Gate for the fused Pallas decode-attention kernel
-        (kernels/decode_attention.py): single-token decode on an
-        unsharded cache, no ALiBi, tile-aligned shapes.  Opt-in via
-        FF_PALLAS_ATTN=1 (blocked kernel) or =dma (manual-DMA slot
-        updates) while perf is validated per-chip;
-        FF_PALLAS_ATTN=interpret runs the blocked kernel interpreted
-        (CI coverage of the in-model wiring on CPU).  Returns the mode
-        or False."""
+    def _flash_decode_ok(attrs, ctx, C, ck):
+        """Gate for the length-tiled flash-decode kernel
+        (kernels/flash_decode.py).  The HOST decides per step whether the
+        kernel's per-row tile pruning beats the XLA attend for this
+        batch's depth profile (inference_manager.flash_wins sets
+        ctx.use_flash); this gate checks the shapes the kernel supports
+        (single-token decode, unsharded cache, no ALiBi, lane-aligned
+        head dim).  FF_FLASH_DECODE=interpret runs the kernel interpreted
+        regardless of platform (CI coverage of the in-model wiring on
+        CPU); =0 disables.  Returns 'interpret', True or False."""
         import os
 
-        from ..kernels.quant_matmul import pallas_tpu_available
+        from ..kernels.flash_decode import flash_path_ok
 
-        mode = os.environ.get("FF_PALLAS_ATTN")
-        if mode not in ("1", "dma", "interpret"):
+        mode = os.environ.get("FF_FLASH_DECODE", "auto")
+        if mode == "0" or not getattr(ctx, "use_flash", False):
             return False
-        ok = (C == 1
-              and getattr(ctx, "mesh", None) is None
+        ok = (flash_path_ok(C, ck, getattr(ctx, "mesh", None))
               and not attrs.get("position_bias", False)
-              and ck.shape[1] % 16 == 0
-              and ck.shape[3] % 128 == 0
               and (mode == "interpret" or pallas_tpu_available()))
-        return mode if ok else False
+        return (mode if mode == "interpret" else True) if ok else False
 
     def flops(self, attrs, in_specs):
         (x,) = in_specs

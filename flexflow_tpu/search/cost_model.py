@@ -94,6 +94,14 @@ class MachineModel:
             return 0.0
         return bytes_ / self.ici_bandwidth + self.ici_latency
 
+    def alltoall_time(self, bytes_: int, group: int) -> float:
+        """All-to-all token exchange (MoE dispatch/combine over ep): each
+        device ships (group-1)/group of its bytes across the group."""
+        if group <= 1 or bytes_ == 0:
+            return 0.0
+        return ((group - 1) / group * bytes_ / self._link_bw(group)
+                + (group - 1) * self.ici_latency)
+
 
 class SimpleMachineModel(MachineModel):
     """One-knob model (reference SimpleMachineModel: intra-node + NIC bw).
@@ -196,9 +204,9 @@ def op_flops_bytes(layer, out_shapes) -> Tuple[int, int, int]:
 
 
 def estimate_op_cost(layer, out_shapes, machine: MachineModel,
-                     dp: int = 1, tp: int = 1, sp: int = 1,
+                     dp: int = 1, tp: int = 1, sp: int = 1, ep: int = 1,
                      batch_dim_size: Optional[int] = None) -> CostMetrics:
-    """Roofline cost of one layer under (dp, tp, sp) sharding.
+    """Roofline cost of one layer under (dp, tp, sp, ep) sharding.
 
     - dp shards the batch dim: per-device flops/bytes divide by dp; gradient
       sync adds an allreduce of the weights over dp (the reference's NCCL
@@ -209,25 +217,30 @@ def estimate_op_cost(layer, out_shapes, machine: MachineModel,
     - sp shards the sequence dim (ring attention, ops/ring_attention.py):
       compute divides like dp (weights replicate) but attention pays
       (sp-1) ring hops of its K/V shards over ICI.
+    - ep shards the expert dim (MoE, ops/moe_ops.py): expert weights AND
+      compute divide by ep, and the tokens pay two all-to-alls (dispatch
+      + combine) across the ep group — the searched form of the
+      reference's sample/parameter/attribute-dim flags
+      (config.h:148-150).
     """
     flops, act_bytes, w_bytes = op_flops_bytes(layer, out_shapes)
-    shard = dp * tp * sp
-    # weights stream from HBM every step and shard only over tp (replicated
-    # across dp/sp) — at small batch (serving decode) this term dominates.
-    # Gather-style ops (embedding: flops == 0) touch only the rows used,
-    # already counted in act_bytes, not the whole table.
-    w_stream = w_bytes / tp if flops else 0.0
+    shard = dp * tp * sp * ep
+    # weights stream from HBM every step and shard over tp and (for MoE
+    # experts) ep — replicated across dp/sp; at small batch (serving
+    # decode) this term dominates.  Gather-style ops (embedding:
+    # flops == 0) touch only the rows used, already counted in act_bytes.
+    w_stream = w_bytes / (tp * ep) if flops else 0.0
     compute = max(flops / shard / machine.peak_flops,
                   (act_bytes / shard + w_stream) / machine.hbm_bandwidth)
     fwd = compute
     bwd = 2 * compute if w_bytes else compute  # dX and dW matmuls
     sync = 0.0
     if tp > 1 and w_bytes:
-        out_act = sum(_prod(s) for s in out_shapes) * 4 // (dp * sp)
+        out_act = sum(_prod(s) for s in out_shapes) * 4 // (dp * sp * ep)
         sync += machine.allreduce_time(out_act, tp)          # fwd activations
         sync += machine.allreduce_time(out_act, tp)          # bwd d(input)
     if dp > 1 and w_bytes:
-        sync += machine.allreduce_time(w_bytes // tp, dp)    # grad allreduce
+        sync += machine.allreduce_time(w_bytes // (tp * ep), dp)  # grads
     if sp > 1:
         # ring attention: each device forwards its K/V shard sp-1 times
         # (ppermute); K+V together ~ input activation bytes
@@ -235,25 +248,31 @@ def estimate_op_cost(layer, out_shapes, machine: MachineModel,
         sync += (sp - 1) * machine.p2p_time(kv_shard)
         if w_bytes:   # grads of replicated weights also sum over sp
             sync += machine.allreduce_time(w_bytes // tp, sp)
-    mem = w_bytes // tp + act_bytes // shard
+    if ep > 1:
+        # MoE all-to-all: the routed token activations cross the ep group
+        # twice per direction (dispatch + combine, fwd + bwd)
+        tok_bytes = act_bytes // shard
+        sync += 4 * machine.alltoall_time(tok_bytes, ep)
+    mem = w_bytes // (tp * ep) + act_bytes // shard
     return CostMetrics(fwd, bwd, sync, mem)
 
 
 def resharding_cost(tensor_bytes: int, src: Tuple[int, ...],
                     dst: Tuple[int, ...], machine: MachineModel) -> float:
-    """Cost of moving a tensor between (dp, tp[, sp]) layouts (reference:
-    Simulator::estimate_xfer_cost, simulator.cc:604 + repartition cost
-    :562-600).  Identical layouts are free; otherwise approximate as an
-    allgather out of the finer layout plus a repartition into the new one.
-    (dp=2,sp=1) vs (dp=1,sp=2) differ — batch- vs sequence-sharded — so
-    layouts compare by the full tuple, not the partition product.
+    """Cost of moving a tensor between (dp, tp[, sp[, ep]]) layouts
+    (reference: Simulator::estimate_xfer_cost, simulator.cc:604 +
+    repartition cost :562-600).  Identical layouts are free; otherwise
+    approximate as an allgather out of the finer layout plus a
+    repartition into the new one.  (dp=2,sp=1) vs (dp=1,sp=2) differ —
+    batch- vs sequence-sharded — so layouts compare by the full tuple,
+    not the partition product.
     """
-    src = tuple(src) + (1,) * (3 - len(src))
-    dst = tuple(dst) + (1,) * (3 - len(dst))
+    src = tuple(src) + (1,) * (4 - len(src))
+    dst = tuple(dst) + (1,) * (4 - len(dst))
     if src == dst:
         return 0.0
-    src_parts = src[0] * src[1] * src[2]
-    dst_parts = dst[0] * dst[1] * dst[2]
+    src_parts = src[0] * src[1] * src[2] * src[3]
+    dst_parts = dst[0] * dst[1] * dst[2] * dst[3]
     t = 0.0
     if src_parts > 1:
         t += machine.allgather_time(tensor_bytes, src_parts)
@@ -281,16 +300,17 @@ class MeasuredCostModel:
         # roofline
         self.auto_measure = auto_measure
 
-    def _key(self, layer, out_shapes, dp, tp, sp=1):
+    def _key(self, layer, out_shapes, dp, tp, sp=1, ep=1):
         return (layer.op_type.value,
                 tuple(tuple(t.spec.shape) for t in layer.inputs),
-                tuple(tuple(s) for s in out_shapes), dp, tp, sp)
+                tuple(tuple(s) for s in out_shapes), dp, tp, sp, ep)
 
     def measure(self, layer, out_shapes, dp: int = 1, tp: int = 1,
-                sp: int = 1,
+                sp: int = 1, ep: int = 1,
                 run: Optional[Callable[[], None]] = None) -> CostMetrics:
-        est = estimate_op_cost(layer, out_shapes, self.machine, dp, tp, sp)
-        key = self._key(layer, out_shapes, dp, tp, sp)
+        est = estimate_op_cost(layer, out_shapes, self.machine, dp, tp,
+                               sp, ep)
+        key = self._key(layer, out_shapes, dp, tp, sp, ep)
         if key in self.cache:
             # None is the 'unmeasurable' sentinel (stored below when
             # make_op_runner declines) — fall back to the roofline instead
@@ -302,10 +322,11 @@ class MeasuredCostModel:
             fwd = self.cache[key] = self._time(run)
         elif self.auto_measure:
             # the runner shards only the batch dims (one chip cannot run
-            # a tp-sharded op in isolation), so time the (dp, sp, tp=1)
-            # shape and scale by the analytic tp ratio — measuring the
-            # full-tp shapes directly would make tp look like zero gain
-            k1 = self._key(layer, out_shapes, dp, 1, sp)
+            # a tp/ep-sharded op in isolation), so time the
+            # (dp, sp, tp=1, ep=1) shape and scale by the analytic ratio —
+            # measuring the full shapes directly would make tp/ep look
+            # like zero gain
+            k1 = self._key(layer, out_shapes, dp, 1, sp, 1)
             if k1 not in self.cache:
                 run1 = make_op_runner(layer, dp, sp)
                 if run1 is None:
@@ -317,7 +338,7 @@ class MeasuredCostModel:
                 fwd = est.forward_time
             else:
                 est1 = estimate_op_cost(layer, out_shapes, self.machine,
-                                        dp, 1, sp)
+                                        dp, 1, sp, 1)
                 ratio = (est.forward_time / est1.forward_time
                          if est1.forward_time > 0 else 1.0)
                 fwd = self.cache[key] = base * ratio
@@ -335,11 +356,11 @@ class MeasuredCostModel:
         return (time.perf_counter() - t0) / self.repeats
 
     def est(self, layer, out_shapes, machine, dp: int = 1, tp: int = 1,
-            sp: int = 1) -> CostMetrics:
+            sp: int = 1, ep: int = 1) -> CostMetrics:
         """Drop-in estimator for PCG.strategy_cost(est=...): routes the
         search's per-node cost queries through the measurement cache —
         the reference's measured search mode (simulator.cc:519-560)."""
-        return self.measure(layer, out_shapes, dp, tp, sp)
+        return self.measure(layer, out_shapes, dp, tp, sp, ep)
 
 
 def make_op_runner(layer, dp: int = 1,

@@ -27,6 +27,14 @@ TP_CAPABLE = {
     OpType.EMBEDDING,
 }
 
+# ops that admit expert parallelism: the expert dim shards over an 'ep'
+# mesh axis with all-to-all token dispatch/combine (ops/moe_ops.py; the
+# reference's sample/parameter/attribute-dim parallelizable flags,
+# config.h:148-150, collapse to this one searched degree)
+EP_CAPABLE = {
+    OpType.EXPERTS,
+}
+
 # ops that admit sequence parallelism (ring attention over ppermute,
 # ops/ring_attention.py — a dimension the reference cannot search at all,
 # SURVEY §5 "sequence parallelism: absent")
@@ -49,9 +57,10 @@ class ShardAssignment:
     tp: int = 1
     pp_stage: int = 0
     sp: int = 1
+    ep: int = 1   # expert-parallel degree (MoE expert dim)
 
     def degree(self) -> int:
-        return self.dp * self.tp * self.sp
+        return self.dp * self.tp * self.sp * self.ep
 
 
 @dataclasses.dataclass
@@ -124,7 +133,7 @@ class PCG:
         for layer in self.nodes:
             a = strategy.get(layer.name, ShardAssignment())
             c = est(layer, [o.spec.shape for o in layer.outputs], machine,
-                    dp=a.dp, tp=a.tp, sp=a.sp)
+                    dp=a.dp, tp=a.tp, sp=a.sp, ep=a.ep)
             total = total + CostMetrics(c.forward_time, c.backward_time,
                                         c.sync_time, 0)
             per_dev_mem += c.memory
@@ -133,8 +142,8 @@ class PCG:
             sa = strategy.get(e.src, ShardAssignment())
             da = strategy.get(e.dst, ShardAssignment())
             xfer += resharding_cost(e.tensor_bytes,
-                                    (sa.dp, sa.tp, sa.sp),
-                                    (da.dp, da.tp, da.sp), machine)
+                                    (sa.dp, sa.tp, sa.sp, sa.ep),
+                                    (da.dp, da.tp, da.sp, da.ep), machine)
             if sa.pp_stage != da.pp_stage:  # stage boundary: p2p activation
                 xfer += machine.p2p_time(e.tensor_bytes // sa.degree())
         total.sync_time += xfer
@@ -155,7 +164,7 @@ class PCG:
         for layer in self.nodes:
             a = strategy.get(layer.name, ShardAssignment())
             c = est(layer, [o.spec.shape for o in layer.outputs], machine,
-                    dp=a.dp, tp=a.tp, sp=a.sp)
+                    dp=a.dp, tp=a.tp, sp=a.sp, ep=a.ep)
             stage_time[a.pp_stage] = (stage_time.get(a.pp_stage, 0.0)
                                       + c.total_time)
             stage_mem[a.pp_stage] = stage_mem.get(a.pp_stage, 0) + c.memory
@@ -164,8 +173,8 @@ class PCG:
             sa = strategy.get(e.src, ShardAssignment())
             da = strategy.get(e.dst, ShardAssignment())
             xfer += resharding_cost(e.tensor_bytes,
-                                    (sa.dp, sa.tp, sa.sp),
-                                    (da.dp, da.tp, da.sp), machine)
+                                    (sa.dp, sa.tp, sa.sp, sa.ep),
+                                    (da.dp, da.tp, da.sp, da.ep), machine)
             if sa.pp_stage != da.pp_stage:
                 xfer += machine.p2p_time(e.tensor_bytes // sa.degree())
         bottleneck = max(stage_time.values()) if stage_time else 0.0

@@ -23,8 +23,8 @@ import itertools
 from typing import Dict, List, Optional, Tuple
 
 from .cost_model import MachineModel
-from .pcg import (PCG, SP_CAPABLE, ShardAssignment, TP_CAPABLE,
-                  data_parallel_strategy)
+from .pcg import (EP_CAPABLE, PCG, SP_CAPABLE, ShardAssignment,
+                  TP_CAPABLE, data_parallel_strategy)
 
 
 def _factor_pairs(n: int) -> List[Tuple[int, int]]:
@@ -87,6 +87,16 @@ def node_choices(layer, num_devices: int) -> List[ShardAssignment]:
                             and layer.param_specs)):
                         choices.append(
                             ShardAssignment(dp=dp, tp=tp, sp=sp))
+    if layer.op_type in EP_CAPABLE and layer.param_specs:
+        # expert-parallel degrees for MoE nodes: ep must divide the
+        # expert count (whole experts per shard); composes with dp
+        n_exp = layer.attrs.get("num_experts") or layer.attrs.get("n")
+        for total in _divisors(num_devices):
+            for dp, ep in _factor_pairs(total):
+                if (ep > 1 and dp_ok(dp)
+                        and (n_exp is None or
+                             (ep <= n_exp and n_exp % ep == 0))):
+                    choices.append(ShardAssignment(dp=dp, ep=ep))
     return choices
 
 

@@ -376,8 +376,16 @@ class LLM:
         reqs = [self.rm.register_new_request(p, max_new_tokens)
                 for p in prompts]
         if self.ssms:
+            # single-SSM speculation honors that SSM's configured tree
+            # shape; multi-SSM keeps per-SSM compiled widths (the host
+            # loop reads each record's width)
+            w = d = None
+            if len(self.ssms) == 1:
+                w = getattr(self.ssms[0], "beam_width", None)
+                d = getattr(self.ssms[0], "beam_depth", None)
             results = generate_spec_infer(self.rm, self.im, self.model_id,
-                                          reqs, seed=seed)
+                                          reqs, seed=seed, beam_width=w,
+                                          beam_depth=d)
         else:
             results = self.rm.generate_incr_decoding(
                 self.im, self.model_id, reqs, seed=seed)
@@ -395,7 +403,20 @@ class LLM:
 class SSM(LLM):
     """A small speculative model (reference serve.py class SSM): always
     runs single-device data/tensor/pipeline degrees (spec_infer.cc:341-344
-    forces SSM dp=tp=pp=1)."""
+    forces SSM dp=tp=pp=1).
+
+    ``beam_width``/``beam_depth`` configure the speculation tree this SSM
+    proposes (reference BeamSearchBatchConfig MAX_BEAM_WIDTH/DEPTH as
+    compile-time constants; here per-SSM knobs): width = live hypotheses
+    per request (cache rows are laid out per width at compile),
+    depth = tokens speculated per macro-iteration (None = the runtime
+    maximum)."""
+
+    def __init__(self, model_name: str, beam_width: int = 2,
+                 beam_depth: Optional[int] = None, **kwargs):
+        super().__init__(model_name, **kwargs)
+        self.beam_width = beam_width
+        self.beam_depth = beam_depth
 
     def _compile_as_ssm(self, llm: LLM, max_requests: int,
                         max_seq_length: int, cache_dtype=None):
@@ -411,6 +432,6 @@ class SSM(LLM):
         self.model_id = llm.im.compile_model_and_allocate_buffer(
             self.model, mode=InferenceMode.BEAM_SEARCH,
             max_requests=max_requests, max_seq_length=max_seq_length,
-            beam_width=2, cache_dtype=cache_dtype)
+            beam_width=self.beam_width, cache_dtype=cache_dtype)
         llm.rm.register_ssm_model(self.model_id)
         self.rm = llm.rm

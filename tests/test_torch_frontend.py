@@ -204,3 +204,80 @@ def test_op_list_serialization():
     ops = json.loads(pt.to_op_list())
     assert any(o["op"] == "call_module" for o in ops)
     assert ops[0]["op"] == "placeholder"
+
+
+# ---------------------------------------------------------- HF GPT-2 e2e
+def _gpt2(n_layer=2, n_head=2, n_embd=64, vocab=128, seed=0):
+    from transformers import GPT2Config, GPT2LMHeadModel
+
+    torch.manual_seed(seed)
+    cfg = GPT2Config(n_layer=n_layer, n_head=n_head, n_embd=n_embd,
+                     vocab_size=vocab, n_positions=64,
+                     attn_implementation="eager",
+                     resid_pdrop=0.0, embd_pdrop=0.0, attn_pdrop=0.0)
+    return GPT2LMHeadModel(cfg).eval()
+
+
+def _replay_gpt2(hf, ids):
+    """Trace + replay + port at ids' static length; returns logits."""
+    import jax
+
+    from flexflow_tpu.fftype import DataType
+    from flexflow_tpu.torch_frontend.hf import hf_symbolic_trace
+
+    gm = hf_symbolic_trace(hf)
+    ff = Model(FFConfig(batch_size=ids.shape[0]),
+               name=f"gpt2_fx_{ids.shape[1]}")
+    tokens = ff.create_tensor(ids.shape, dtype=DataType.INT32,
+                              name="tokens")
+    pt = PyTorchModel(hf, trace=gm)
+    pt.apply(ff, [tokens])
+    ff.params = ff.init_params(jax.random.PRNGKey(0))
+    pt.port_parameters(ff)
+    return np.asarray(ff.apply(ff.params, ids), np.float32)
+
+
+def test_gpt2_fx_logits_match():
+    """HF-aware fx trace of GPT2LMHeadModel (leaf attention, stubbed mask
+    builder, folded position ids, inline Conv1D addmm) replays to logits
+    matching transformers — the reference's tests/align/mt5_encoder
+    analogue for a causal LM."""
+    hf = _gpt2()
+    ids = np.array([[1, 5, 9, 2, 8, 4, 17, 3, 99, 7, 23, 50]], np.int32)
+    got = _replay_gpt2(hf, ids)
+    with torch.no_grad():
+        want = hf(input_ids=torch.tensor(ids, dtype=torch.long)
+                  ).logits.numpy()
+    np.testing.assert_allclose(got, want, rtol=2e-3, atol=2e-3)
+
+
+def test_gpt2_fx_greedy_token_match():
+    """Greedy continuation through the replayed graph equals
+    transformers' greedy decode (token-level alignment; the graph is
+    re-replayed per length since the import is static-shape)."""
+    hf = _gpt2(seed=3)
+    prompt = [2, 7, 11, 5]
+    ours = list(prompt)
+    for _ in range(8):
+        ids = np.asarray([ours], np.int32)
+        logits = _replay_gpt2(hf, ids)
+        ours.append(int(logits[0, -1].argmax()))
+    with torch.no_grad():
+        want = hf.generate(
+            torch.tensor([prompt], dtype=torch.long), do_sample=False,
+            max_new_tokens=8, pad_token_id=0).numpy()[0].tolist()
+    assert ours == want, (ours, want)
+
+
+def test_gpt2_fx_real_architecture_dims():
+    """The TRUE gpt2-small architecture (12L/768/12H/50257) traces and
+    replays with matching logits (random weights: the container has no
+    network for checkpoint download; architecture coverage is the
+    point)."""
+    hf = _gpt2(n_layer=12, n_head=12, n_embd=768, vocab=50257, seed=1)
+    ids = np.array([[15, 300, 7000, 123]], np.int32)
+    got = _replay_gpt2(hf, ids)
+    with torch.no_grad():
+        want = hf(input_ids=torch.tensor(ids, dtype=torch.long)
+                  ).logits.numpy()
+    np.testing.assert_allclose(got, want, rtol=5e-3, atol=5e-3)
