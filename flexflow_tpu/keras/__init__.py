@@ -2,11 +2,13 @@
 ``tensorflow.keras`` replacement, ~4,400 LoC: models, layers, optimizers,
 losses, metrics, callbacks)."""
 
-from . import callbacks, datasets, layers
+from . import (callbacks, datasets, initializers, layers, losses, metrics,
+               regularizers)
 from .layers import (Activation, Add, AveragePooling2D, BatchNormalization,
                      Concatenate, Conv2D, Dense, Dropout, Embedding, Flatten,
                      Input, KerasLayer, KTensor, LayerNormalization,
-                     MaxPooling2D, Multiply, Subtract)
+                     Maximum, MaxPooling2D, Minimum, Multiply, Permute,
+                     Reshape, Subtract)
 from .models import Model, Sequential
 from ..training.optimizer import AdamOptimizer as Adam
 from ..training.optimizer import SGDOptimizer as SGD
@@ -15,6 +17,8 @@ __all__ = [
     "Model", "Sequential", "Input", "KerasLayer", "KTensor", "Dense",
     "Activation", "Flatten", "Dropout", "Embedding", "Conv2D",
     "MaxPooling2D", "AveragePooling2D", "BatchNormalization",
-    "LayerNormalization", "Add", "Subtract", "Multiply", "Concatenate",
-    "SGD", "Adam", "callbacks", "datasets", "layers",
+    "LayerNormalization", "Add", "Subtract", "Multiply", "Maximum",
+    "Minimum", "Concatenate", "Reshape", "Permute",
+    "SGD", "Adam", "callbacks", "datasets", "initializers", "layers",
+    "losses", "metrics", "regularizers",
 ]

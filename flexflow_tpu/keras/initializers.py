@@ -1,0 +1,22 @@
+"""Keras initializer objects (reference:
+python/flexflow/keras/initializers.py — thin wrappers over the core
+initializers so keras layer kwargs accept the keras vocabulary)."""
+
+from __future__ import annotations
+
+from ..core.initializers import (ConstantInitializer, GlorotUniform,
+                                 NormInitializer, UniformInitializer,
+                                 ZeroInitializer)
+
+DefaultInitializer = GlorotUniform
+Zeros = ZeroInitializer
+Constant = ConstantInitializer
+
+
+def RandomUniform(minval: float = -0.05, maxval: float = 0.05,
+                  seed: int = 0):
+    return UniformInitializer(seed, minval, maxval)
+
+
+def RandomNormal(mean: float = 0.0, stddev: float = 0.05, seed: int = 0):
+    return NormInitializer(seed, mean, stddev)

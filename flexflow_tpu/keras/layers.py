@@ -86,9 +86,10 @@ def _maybe_activation(model, t, activation):
 
 class Dense(KerasLayer):
     def __init__(self, units: int, activation=None, use_bias: bool = True,
-                 name: Optional[str] = None, **_):
+                 kernel_regularizer=None, name: Optional[str] = None, **_):
         super().__init__(name, **_)
         self.units, self.activation, self.use_bias = units, activation, use_bias
+        self.kernel_regularizer = kernel_regularizer
 
     def compute_output_shape(self, in_shapes):
         return in_shapes[0][:-1] + (self.units,)
@@ -146,8 +147,10 @@ class Conv2D(KerasLayer):
 
     def __init__(self, filters: int, kernel_size, strides=(1, 1),
                  padding="valid", activation=None, use_bias: bool = True,
-                 groups: int = 1, name: Optional[str] = None, **_):
+                 groups: int = 1, kernel_regularizer=None,
+                 name: Optional[str] = None, **_):
         super().__init__(name, **_)
+        self.kernel_regularizer = kernel_regularizer
         self.filters = filters
         self.kernel = (kernel_size, kernel_size) if isinstance(
             kernel_size, int) else tuple(kernel_size)
@@ -253,6 +256,48 @@ class Subtract(_Merge):
 class Multiply(_Merge):
     def build_on(self, model, inputs):
         return model.multiply(inputs[0], inputs[1])
+
+
+class Maximum(_Merge):
+    def build_on(self, model, inputs):
+        return model.max(inputs[0], inputs[1])
+
+
+class Minimum(_Merge):
+    def build_on(self, model, inputs):
+        return model.min(inputs[0], inputs[1])
+
+
+class Reshape(KerasLayer):
+    """reference: keras/layers/core.py Reshape — target_shape excludes the
+    batch dim."""
+
+    def __init__(self, target_shape, name: Optional[str] = None):
+        super().__init__(name)
+        self.target_shape = tuple(int(d) for d in target_shape)
+
+    def compute_output_shape(self, in_shapes):
+        return (in_shapes[0][0],) + self.target_shape
+
+    def build_on(self, model, inputs):
+        batch = inputs[0].spec.shape[0]
+        return model.reshape(inputs[0], (batch,) + self.target_shape)
+
+
+class Permute(KerasLayer):
+    """reference: keras/layers/core.py Permute — dims are 1-indexed over
+    the non-batch axes (the keras convention)."""
+
+    def __init__(self, dims, name: Optional[str] = None):
+        super().__init__(name)
+        self.dims = tuple(int(d) for d in dims)
+
+    def compute_output_shape(self, in_shapes):
+        s = in_shapes[0]
+        return (s[0],) + tuple(s[d] for d in self.dims)
+
+    def build_on(self, model, inputs):
+        return model.transpose(inputs[0], (0,) + self.dims)
 
 
 class Concatenate(KerasLayer):

@@ -1,0 +1,42 @@
+"""Keras loss objects (reference: python/flexflow/keras/losses.py —
+class wrappers resolving to LossType enums; ``Model.compile`` accepts
+either these objects or the equivalent strings)."""
+
+from __future__ import annotations
+
+from ..fftype import LossType
+
+
+class Loss:
+    type: LossType = None
+
+    def __init__(self, name: str = "loss"):
+        self.name = name
+
+
+class CategoricalCrossentropy(Loss):
+    type = LossType.CATEGORICAL_CROSSENTROPY
+
+    def __init__(self, name: str = "categorical_crossentropy"):
+        super().__init__(name)
+
+
+class SparseCategoricalCrossentropy(Loss):
+    type = LossType.SPARSE_CATEGORICAL_CROSSENTROPY
+
+    def __init__(self, name: str = "sparse_categorical_crossentropy"):
+        super().__init__(name)
+
+
+class MeanSquaredError(Loss):
+    type = LossType.MEAN_SQUARED_ERROR_AVG_REDUCE
+
+    def __init__(self, name: str = "mean_squared_error"):
+        super().__init__(name)
+
+
+class Identity(Loss):
+    type = LossType.IDENTITY
+
+    def __init__(self, name: str = "identity"):
+        super().__init__(name)

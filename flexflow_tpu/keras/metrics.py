@@ -1,0 +1,55 @@
+"""Keras metric objects (reference: python/flexflow/keras/metrics.py —
+class wrappers resolving to MetricsType enums)."""
+
+from __future__ import annotations
+
+from ..fftype import MetricsType
+
+
+class Metric:
+    type: MetricsType = None
+
+    def __init__(self, name: str = "metric"):
+        self.name = name
+
+
+class Accuracy(Metric):
+    type = MetricsType.ACCURACY
+
+    def __init__(self, name: str = "accuracy"):
+        super().__init__(name)
+
+
+class CategoricalCrossentropy(Metric):
+    type = MetricsType.CATEGORICAL_CROSSENTROPY
+
+    def __init__(self, name: str = "categorical_crossentropy"):
+        super().__init__(name)
+
+
+class SparseCategoricalCrossentropy(Metric):
+    type = MetricsType.SPARSE_CATEGORICAL_CROSSENTROPY
+
+    def __init__(self, name: str = "sparse_categorical_crossentropy"):
+        super().__init__(name)
+
+
+class MeanSquaredError(Metric):
+    type = MetricsType.MEAN_SQUARED_ERROR
+
+    def __init__(self, name: str = "mean_squared_error"):
+        super().__init__(name)
+
+
+class RootMeanSquaredError(Metric):
+    type = MetricsType.ROOT_MEAN_SQUARED_ERROR
+
+    def __init__(self, name: str = "root_mean_squared_error"):
+        super().__init__(name)
+
+
+class MeanAbsoluteError(Metric):
+    type = MetricsType.MEAN_ABSOLUTE_ERROR
+
+    def __init__(self, name: str = "mean_absolute_error"):
+        super().__init__(name)

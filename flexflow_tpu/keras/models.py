@@ -97,11 +97,28 @@ class Model:
             ins = [sym_to_core[id(t)] for t in layer.inbound]
             out = layer.build_on(core, ins)
             sym_to_core[id(layer.output)] = out
-        loss_t = _LOSSES[loss] if isinstance(loss, str) else loss
-        metric_ts = [_METRICS[m] if isinstance(m, str) else m
+        loss_t = (_LOSSES[loss] if isinstance(loss, str)
+                  else getattr(loss, "type", None) or loss)
+        metric_ts = [(_METRICS[m] if isinstance(m, str)
+                      else getattr(m, "type", None) or m)
                      for m in metrics]
-        core.compile(_to_optimizer(optimizer), loss_type=loss_t,
-                     metrics=metric_ts, seed=seed)
+        opt = _to_optimizer(optimizer)
+        # keras kernel_regularizer=L2(...) lowers to the optimizer's
+        # decoupled weight decay (reference regularizers.py scope; applied
+        # globally — the strongest layer's coefficient wins).  The
+        # user-supplied optimizer instance is COPIED before the override:
+        # mutating it would leak regularization into other models
+        # compiled with the same object
+        from .regularizers import L2 as _L2
+
+        l2s = [l.kernel_regularizer.l2 for l in self._layer_order
+               if isinstance(getattr(l, "kernel_regularizer", None), _L2)]
+        if l2s and getattr(opt, "weight_decay", 0.0) == 0.0:
+            import copy
+
+            opt = copy.copy(opt)
+            opt.weight_decay = max(l2s)
+        core.compile(opt, loss_type=loss_t, metrics=metric_ts, seed=seed)
         self.core = core
         return self
 

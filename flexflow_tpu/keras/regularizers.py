@@ -1,0 +1,25 @@
+"""Keras regularizer objects (reference:
+python/flexflow/keras/regularizers.py).  The reference lowers L1/L2 to
+its weight-decay hook; here L2 maps onto the optimizers' decoupled
+``weight_decay`` (the TPU-idiomatic equivalent) and Model.compile reads
+a Dense/Conv2D layer's ``kernel_regularizer`` to set it.  L1 has no
+optimizer-side analogue and raises, like the reference's unsupported
+paths do."""
+
+from __future__ import annotations
+
+
+class Regularizer:
+    pass
+
+
+class L2(Regularizer):
+    def __init__(self, l2: float = 0.01):
+        self.l2 = float(l2)
+
+
+class L1(Regularizer):
+    def __init__(self, l1: float = 0.01):
+        raise NotImplementedError(
+            "L1 regularization has no decoupled-weight-decay equivalent; "
+            "use L2 (lowered to the optimizer's weight_decay)")

@@ -179,3 +179,43 @@ def test_datasets_cached_reference_formats(monkeypatch, tmp_path):
     (cx, cy), (tx, ty) = cifar10.load_data()
     assert cx.shape == (20, 3, 32, 32) and tx.shape == (4, 3, 32, 32)
     assert cy.dtype == np.int64 and len(cy) == 20
+
+
+def test_keras_aux_modules_and_new_layers():
+    """Reference keras surface parity (losses/metrics/initializers/
+    regularizers objects + Maximum/Minimum/Reshape/Permute layers): a
+    functional model using all of them compiles, trains a step, and the
+    L2 kernel regularizer lowers to the optimizer's weight decay."""
+    import flexflow_tpu.keras as keras
+    import numpy as np
+
+    inp = keras.Input((8,))
+    a = keras.Dense(16, activation="relu",
+                    kernel_regularizer=keras.regularizers.L2(0.01))(inp)
+    b = keras.Dense(16, activation="relu")(inp)
+    t = keras.Maximum()([a, b])
+    t = keras.Minimum()([t, b])
+    t = keras.Reshape((4, 4))(t)
+    t = keras.Permute((2, 1))(t)
+    t = keras.Flatten()(t)
+    out = keras.Dense(3, activation="softmax")(t)
+    m = keras.Model(inp, out, batch_size=16)
+    m.compile(optimizer=keras.SGD(lr=0.05),
+              loss=keras.losses.SparseCategoricalCrossentropy(),
+              metrics=[keras.metrics.Accuracy(),
+                       keras.metrics.SparseCategoricalCrossentropy()])
+    assert m.core.optimizer.weight_decay == 0.01
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(48, 8)).astype(np.float32)
+    y = rng.integers(0, 3, 48).astype(np.int32)
+    hist = m.fit(x, y, epochs=2, verbose=False)
+    assert m.predict(x).shape == (48, 3)
+    # initializer objects construct and produce arrays
+    import jax
+    w = keras.initializers.RandomNormal(stddev=0.1)(
+        jax.random.PRNGKey(0), (4, 4), np.float32)
+    assert np.asarray(w).std() < 1.0
+    # L1 is declared-unsupported, loudly
+    import pytest as _pytest
+    with _pytest.raises(NotImplementedError):
+        keras.regularizers.L1(0.01)
