@@ -1,11 +1,14 @@
 """ONNX frontend (reference: python/flexflow/onnx/model.py, 375 LoC).
 
-The ``onnx`` package is not part of this image, so the importer is gated:
-constructing :class:`ONNXModel` raises a clear ImportError without it.
-The replay logic itself is implemented and mirrors the reference's
-node-type dispatch (onnx/model.py handle_* methods).
+The ``onnx`` package is not part of this image, so proto access goes
+through the vendored minimal wire-format codec (:mod:`.minionnx`) — the
+importer runs (and is CI-tested) without it; with the real package
+installed its protos are used instead.  The replay mirrors the
+reference's node-type dispatch (onnx/model.py handle_* methods) and
+additionally ports initializer weights exactly.
 """
 
+from . import minionnx
 from .model import ONNXModel, UnsupportedOnnxOp
 
-__all__ = ["ONNXModel", "UnsupportedOnnxOp"]
+__all__ = ["ONNXModel", "UnsupportedOnnxOp", "minionnx"]

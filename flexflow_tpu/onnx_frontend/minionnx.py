@@ -1,0 +1,428 @@
+"""Minimal self-contained ONNX protobuf codec.
+
+The environment does not bundle the ``onnx`` package (and the policy is
+to gate, not install), which left the ONNX frontend permanently
+unexecuted.  ONNX models are ordinary protobufs, and the subset the
+importer needs — ModelProto/GraphProto/NodeProto/AttributeProto/
+TensorProto/ValueInfoProto — decodes with a ~hundred-line wire-format
+reader, so this module implements exactly that (plus the tiny encoder
+the tests use to synthesize models).  Field numbers follow the public
+onnx.proto3 schema; unknown fields are skipped, like any proto reader.
+
+API mirrors the pieces of the onnx package the frontend touches:
+``load(path_or_bytes)``, ``numpy_from_tensor(TensorProto)``,
+``get_attribute_value(AttributeProto)``, and ``make_*`` helpers.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import struct
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+# TensorProto.DataType (onnx.proto3)
+DT_FLOAT, DT_UINT8, DT_INT8, DT_UINT16, DT_INT16, DT_INT32, DT_INT64 = \
+    1, 2, 3, 4, 5, 6, 7
+DT_BOOL, DT_FLOAT16, DT_DOUBLE = 9, 10, 11
+_NP_OF = {DT_FLOAT: np.float32, DT_UINT8: np.uint8, DT_INT8: np.int8,
+          DT_UINT16: np.uint16, DT_INT16: np.int16, DT_INT32: np.int32,
+          DT_INT64: np.int64, DT_BOOL: np.bool_, DT_FLOAT16: np.float16,
+          DT_DOUBLE: np.float64}
+
+# AttributeProto.AttributeType
+AT_FLOAT, AT_INT, AT_STRING, AT_TENSOR, AT_GRAPH = 1, 2, 3, 4, 5
+AT_FLOATS, AT_INTS, AT_STRINGS = 6, 7, 8
+
+
+# ------------------------------------------------------------ wire reader
+def _read_varint(buf: bytes, i: int) -> Tuple[int, int]:
+    out = shift = 0
+    while True:
+        b = buf[i]
+        i += 1
+        out |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return out, i
+        shift += 7
+
+
+def _fields(buf: bytes):
+    """Yield (field_number, wire_type, value) over a message buffer.
+    wire 0 -> varint int, 1 -> 8 bytes, 2 -> bytes, 5 -> 4 bytes."""
+    i = 0
+    n = len(buf)
+    while i < n:
+        key, i = _read_varint(buf, i)
+        fn, wt = key >> 3, key & 7
+        if wt == 0:
+            v, i = _read_varint(buf, i)
+        elif wt == 1:
+            v, i = buf[i:i + 8], i + 8
+        elif wt == 2:
+            ln, i = _read_varint(buf, i)
+            v, i = buf[i:i + ln], i + ln
+        elif wt == 5:
+            v, i = buf[i:i + 4], i + 4
+        else:
+            raise ValueError(f"unsupported wire type {wt}")
+        yield fn, wt, v
+
+
+def _packed_varints(buf: bytes) -> List[int]:
+    out, i = [], 0
+    while i < len(buf):
+        v, i = _read_varint(buf, i)
+        out.append(v)
+    return out
+
+
+def _signed64(v: int) -> int:
+    return v - (1 << 64) if v >= (1 << 63) else v
+
+
+# ------------------------------------------------------------- messages
+@dataclasses.dataclass
+class TensorProto:
+    name: str = ""
+    dims: List[int] = dataclasses.field(default_factory=list)
+    data_type: int = DT_FLOAT
+    raw_data: bytes = b""
+    float_data: List[float] = dataclasses.field(default_factory=list)
+    int64_data: List[int] = dataclasses.field(default_factory=list)
+    int32_data: List[int] = dataclasses.field(default_factory=list)
+
+
+@dataclasses.dataclass
+class AttributeProto:
+    name: str = ""
+    type: int = 0
+    f: float = 0.0
+    i: int = 0
+    s: bytes = b""
+    t: Optional[TensorProto] = None
+    floats: List[float] = dataclasses.field(default_factory=list)
+    ints: List[int] = dataclasses.field(default_factory=list)
+    strings: List[bytes] = dataclasses.field(default_factory=list)
+
+
+@dataclasses.dataclass
+class NodeProto:
+    op_type: str = ""
+    name: str = ""
+    input: List[str] = dataclasses.field(default_factory=list)
+    output: List[str] = dataclasses.field(default_factory=list)
+    attribute: List[AttributeProto] = dataclasses.field(default_factory=list)
+
+
+@dataclasses.dataclass
+class ValueInfoProto:
+    name: str = ""
+    elem_type: int = DT_FLOAT
+    shape: List[Optional[int]] = dataclasses.field(default_factory=list)
+
+
+@dataclasses.dataclass
+class GraphProto:
+    name: str = ""
+    node: List[NodeProto] = dataclasses.field(default_factory=list)
+    initializer: List[TensorProto] = dataclasses.field(default_factory=list)
+    input: List[ValueInfoProto] = dataclasses.field(default_factory=list)
+    output: List[ValueInfoProto] = dataclasses.field(default_factory=list)
+
+
+@dataclasses.dataclass
+class ModelProto:
+    ir_version: int = 8
+    graph: GraphProto = dataclasses.field(default_factory=GraphProto)
+
+
+def _parse_tensor(buf: bytes) -> TensorProto:
+    t = TensorProto()
+    for fn, wt, v in _fields(buf):
+        if fn == 1:
+            t.dims.extend(_packed_varints(v) if wt == 2
+                          else [_signed64(v)])
+        elif fn == 2:
+            t.data_type = v
+        elif fn == 4:
+            t.float_data.extend(
+                struct.unpack(f"<{len(v) // 4}f", v) if wt == 2
+                else [struct.unpack("<f", v)[0]])
+        elif fn == 5:
+            # negative int32 values ride the varint as 64-bit two's
+            # complement — recover the sign like the int64 branch
+            t.int32_data.extend(
+                [_signed64(x) for x in _packed_varints(v)] if wt == 2
+                else [_signed64(v)])
+        elif fn == 7:
+            t.int64_data.extend(
+                [_signed64(x) for x in _packed_varints(v)] if wt == 2
+                else [_signed64(v)])
+        elif fn == 8:
+            t.name = v.decode()
+        elif fn == 9:
+            t.raw_data = v
+    return t
+
+
+def _parse_attribute(buf: bytes) -> AttributeProto:
+    a = AttributeProto()
+    for fn, wt, v in _fields(buf):
+        if fn == 1:
+            a.name = v.decode()
+        elif fn == 2:
+            a.f = struct.unpack("<f", v)[0]
+        elif fn == 3:
+            a.i = _signed64(v)
+        elif fn == 4:
+            a.s = v
+        elif fn == 5:
+            a.t = _parse_tensor(v)
+        elif fn == 7:
+            a.floats.extend(struct.unpack(f"<{len(v) // 4}f", v)
+                            if wt == 2 else [struct.unpack("<f", v)[0]])
+        elif fn == 8:
+            a.ints.extend([_signed64(x) for x in _packed_varints(v)]
+                          if wt == 2 else [_signed64(v)])
+        elif fn == 9:
+            a.strings.append(v)
+        elif fn == 20:
+            a.type = v
+    return a
+
+
+def _parse_node(buf: bytes) -> NodeProto:
+    n = NodeProto()
+    for fn, _, v in _fields(buf):
+        if fn == 1:
+            n.input.append(v.decode())
+        elif fn == 2:
+            n.output.append(v.decode())
+        elif fn == 3:
+            n.name = v.decode()
+        elif fn == 4:
+            n.op_type = v.decode()
+        elif fn == 5:
+            n.attribute.append(_parse_attribute(v))
+    return n
+
+
+def _parse_value_info(buf: bytes) -> ValueInfoProto:
+    vi = ValueInfoProto()
+    for fn, _, v in _fields(buf):
+        if fn == 1:
+            vi.name = v.decode()
+        elif fn == 2:  # TypeProto
+            for fn2, _, v2 in _fields(v):
+                if fn2 == 1:  # tensor_type
+                    for fn3, _, v3 in _fields(v2):
+                        if fn3 == 1:
+                            vi.elem_type = v3
+                        elif fn3 == 2:  # shape
+                            for fn4, _, v4 in _fields(v3):
+                                if fn4 == 1:  # dim
+                                    dim = None
+                                    for fn5, _, v5 in _fields(v4):
+                                        if fn5 == 1:
+                                            dim = _signed64(v5)
+                                    vi.shape.append(dim)
+    return vi
+
+
+def _parse_graph(buf: bytes) -> GraphProto:
+    g = GraphProto()
+    for fn, _, v in _fields(buf):
+        if fn == 1:
+            g.node.append(_parse_node(v))
+        elif fn == 2:
+            g.name = v.decode()
+        elif fn == 5:
+            g.initializer.append(_parse_tensor(v))
+        elif fn == 11:
+            g.input.append(_parse_value_info(v))
+        elif fn == 12:
+            g.output.append(_parse_value_info(v))
+    return g
+
+
+def parse_model(buf: bytes) -> ModelProto:
+    m = ModelProto()
+    for fn, _, v in _fields(buf):
+        if fn == 1:
+            m.ir_version = v
+        elif fn == 7:
+            m.graph = _parse_graph(v)
+    return m
+
+
+def load(path_or_bytes) -> ModelProto:
+    if isinstance(path_or_bytes, (bytes, bytearray)):
+        return parse_model(bytes(path_or_bytes))
+    with open(path_or_bytes, "rb") as f:
+        return parse_model(f.read())
+
+
+def numpy_from_tensor(t: TensorProto) -> np.ndarray:
+    dt = _NP_OF[t.data_type]
+    if t.raw_data:
+        arr = np.frombuffer(t.raw_data, dtype=dt)
+    elif t.float_data:
+        arr = np.asarray(t.float_data, dt)
+    elif t.int64_data:
+        arr = np.asarray(t.int64_data, dt)
+    elif t.int32_data:
+        if t.data_type == DT_FLOAT16:
+            # fp16 payloads in int32_data are raw uint16 BIT PATTERNS
+            # (onnx.proto3), not numeric values
+            arr = np.asarray(t.int32_data,
+                             np.uint16).view(np.float16)
+        else:
+            arr = np.asarray(t.int32_data, dt)
+    else:
+        arr = np.zeros(0, dt)
+    return arr.reshape(t.dims) if t.dims else arr
+
+
+def get_attribute_value(a: AttributeProto) -> Any:
+    return {AT_FLOAT: lambda: a.f, AT_INT: lambda: a.i,
+            AT_STRING: lambda: a.s, AT_TENSOR: lambda: a.t,
+            AT_FLOATS: lambda: list(a.floats),
+            AT_INTS: lambda: list(a.ints),
+            AT_STRINGS: lambda: list(a.strings)}[a.type]()
+
+
+# ------------------------------------------------------------ wire writer
+def _varint(v: int) -> bytes:
+    out = b""
+    while True:
+        b = v & 0x7F
+        v >>= 7
+        if v:
+            out += bytes([b | 0x80])
+        else:
+            return out + bytes([b])
+
+
+def _tag(fn: int, wt: int) -> bytes:
+    return _varint((fn << 3) | wt)
+
+
+def _ld(fn: int, payload: bytes) -> bytes:
+    return _tag(fn, 2) + _varint(len(payload)) + payload
+
+
+def _encode_tensor(t: TensorProto) -> bytes:
+    out = b""
+    for d in t.dims:
+        out += _tag(1, 0) + _varint(d)
+    out += _tag(2, 0) + _varint(t.data_type)
+    if t.name:
+        out += _ld(8, t.name.encode())
+    if t.raw_data:
+        out += _ld(9, t.raw_data)
+    return out
+
+
+def _encode_attribute(a: AttributeProto) -> bytes:
+    out = _ld(1, a.name.encode())
+    if a.type == AT_FLOAT:
+        out += _tag(2, 5) + struct.pack("<f", a.f)
+    elif a.type == AT_INT:
+        out += _tag(3, 0) + _varint(a.i & ((1 << 64) - 1))
+    elif a.type == AT_STRING:
+        out += _ld(4, a.s)
+    elif a.type == AT_TENSOR:
+        out += _ld(5, _encode_tensor(a.t))
+    elif a.type == AT_FLOATS:
+        out += _ld(7, b"".join(struct.pack("<f", f) for f in a.floats))
+    elif a.type == AT_INTS:
+        out += _ld(8, b"".join(_varint(i & ((1 << 64) - 1))
+                               for i in a.ints))
+    out += _tag(20, 0) + _varint(a.type)
+    return out
+
+
+def _encode_node(n: NodeProto) -> bytes:
+    out = b""
+    for s in n.input:
+        out += _ld(1, s.encode())
+    for s in n.output:
+        out += _ld(2, s.encode())
+    if n.name:
+        out += _ld(3, n.name.encode())
+    out += _ld(4, n.op_type.encode())
+    for a in n.attribute:
+        out += _ld(5, _encode_attribute(a))
+    return out
+
+
+def _encode_value_info(vi: ValueInfoProto) -> bytes:
+    dims = b""
+    for d in vi.shape:
+        dims += _ld(1, (_tag(1, 0) + _varint(d)) if d is not None else b"")
+    tensor_type = _tag(1, 0) + _varint(vi.elem_type) + _ld(2, dims)
+    return _ld(1, vi.name.encode()) + _ld(2, _ld(1, tensor_type))
+
+
+def _encode_graph(g: GraphProto) -> bytes:
+    out = b""
+    for n in g.node:
+        out += _ld(1, _encode_node(n))
+    if g.name:
+        out += _ld(2, g.name.encode())
+    for t in g.initializer:
+        out += _ld(5, _encode_tensor(t))
+    for vi in g.input:
+        out += _ld(11, _encode_value_info(vi))
+    for vi in g.output:
+        out += _ld(12, _encode_value_info(vi))
+    return out
+
+
+def serialize_model(m: ModelProto) -> bytes:
+    return (_tag(1, 0) + _varint(m.ir_version)
+            + _ld(7, _encode_graph(m.graph)))
+
+
+# ------------------------------------------------------- make_* helpers
+def make_tensor(name: str, arr: np.ndarray) -> TensorProto:
+    arr = np.asarray(arr)
+    dt = next(k for k, v in _NP_OF.items() if v == arr.dtype.type)
+    return TensorProto(name=name, dims=list(arr.shape), data_type=dt,
+                       raw_data=arr.tobytes())
+
+
+def make_node(op_type: str, inputs, outputs, **attrs) -> NodeProto:
+    node = NodeProto(op_type=op_type, input=list(inputs),
+                     output=list(outputs))
+    for k, v in attrs.items():
+        if isinstance(v, float):
+            node.attribute.append(AttributeProto(name=k, type=AT_FLOAT,
+                                                 f=v))
+        elif isinstance(v, int):
+            node.attribute.append(AttributeProto(name=k, type=AT_INT, i=v))
+        elif isinstance(v, (list, tuple)) and all(
+                isinstance(x, int) for x in v):
+            node.attribute.append(AttributeProto(name=k, type=AT_INTS,
+                                                 ints=list(v)))
+        elif isinstance(v, str):
+            node.attribute.append(AttributeProto(name=k, type=AT_STRING,
+                                                 s=v.encode()))
+        else:
+            raise TypeError(f"attribute {k}: {type(v)}")
+    return node
+
+
+def make_value_info(name: str, shape, elem_type: int = DT_FLOAT
+                    ) -> ValueInfoProto:
+    return ValueInfoProto(name=name, elem_type=elem_type,
+                          shape=list(shape))
+
+
+def make_model(nodes, inputs, outputs, initializers=(),
+               name: str = "graph") -> ModelProto:
+    return ModelProto(graph=GraphProto(
+        name=name, node=list(nodes), initializer=list(initializers),
+        input=list(inputs), output=list(outputs)))

@@ -1,48 +1,72 @@
 """ONNX → Model importer (reference python/flexflow/onnx/model.py).
 
 Dispatches on ONNX node op_type the way the reference's ``ONNXModel``
-dispatches via ``handle_<op>`` methods, replaying onto the core Model layer
-API.  Gated on the ``onnx`` package (not in this image — the environment
-policy is to gate, not install).
+dispatches via ``handle_<op>`` methods, replaying onto the core Model
+layer API, then ports the graph's initializer weights into the framework
+param tree (the reference leaves weights to FlexFlow initializers; we
+port exactly, like the torch frontend).
+
+Proto access goes through the vendored minimal codec
+(:mod:`.minionnx`) when the ``onnx`` package is absent (it is not
+bundled in this image), so the frontend is exercised in CI either way;
+with the real package installed its protos are used directly.
 """
 
 from __future__ import annotations
 
 from typing import Any, Dict, List, Optional, Sequence
 
+import numpy as np
+
 from ..core.model import Model
 from ..core.tensor import Tensor
 from ..fftype import ActiMode, PoolType
+from . import minionnx
+
+
+def _onnx_api():
+    """(load, get_attribute_value, numpy_from_tensor) — real onnx package
+    if importable, vendored codec otherwise."""
+    try:
+        import onnx
+        from onnx import numpy_helper
+
+        def _load(src):
+            # onnx.load takes a path; serialized bytes need the
+            # from-string entry point
+            if isinstance(src, (bytes, bytearray)):
+                return onnx.load_model_from_string(bytes(src))
+            return onnx.load(src)
+
+        return _load, onnx.helper.get_attribute_value, \
+            numpy_helper.to_array
+    except ImportError:
+        return (minionnx.load, minionnx.get_attribute_value,
+                minionnx.numpy_from_tensor)
 
 
 class UnsupportedOnnxOp(NotImplementedError):
     pass
 
 
-def _attrs(node) -> Dict[str, Any]:
-    import onnx
-
-    out = {}
-    for a in node.attribute:
-        out[a.name] = onnx.helper.get_attribute_value(a)
-    return out
-
-
 class ONNXModel:
     """reference: class ONNXModel (onnx/model.py) with ``apply``."""
 
     def __init__(self, path_or_proto):
-        try:
-            import onnx  # noqa: F401
-        except ImportError as e:
-            raise ImportError(
-                "the `onnx` package is required for the ONNX frontend; it "
-                "is not bundled in this environment — install it or export "
-                "the model via the torch.fx frontend instead") from e
-        import onnx
+        load, self._attr_value, self._to_array = _onnx_api()
+        self.proto = (load(path_or_proto)
+                      if isinstance(path_or_proto, (str, bytes, bytearray))
+                      else path_or_proto)
+        # fx-importer-style porting map: framework layer name ->
+        # (weight initializer name, bias initializer name, transpose)
+        self.param_layers: Dict[str, tuple] = {}
 
-        self.proto = (onnx.load(path_or_proto)
-                      if isinstance(path_or_proto, str) else path_or_proto)
+    def _attrs(self, node) -> Dict[str, Any]:
+        return {a.name: self._attr_value(a) for a in node.attribute}
+
+    def _init(self, name: str):
+        return next(i for i in self.proto.graph.initializer
+                    if i.name == name)
 
     def apply(self, ffmodel: Model, inputs: Sequence[Tensor]) -> List[Tensor]:
         g = self.proto.graph
@@ -60,15 +84,33 @@ class ONNXModel:
             env[node.output[0]] = handler(ffmodel, node, env)
         return [env[o.name] for o in g.output]
 
+    def port_parameters(self, ffmodel: Model) -> None:
+        """Copy initializer weights into ``ffmodel.params`` for every
+        layer created by :meth:`apply`."""
+        assert ffmodel.params is not None, "init params first"
+        for lname, (w_name, b_name, transpose) in self.param_layers.items():
+            p = ffmodel.params.get(lname)
+            if p is None:
+                continue
+            w = np.asarray(self._to_array(self._init(w_name)))
+            p["kernel"] = (w.T if transpose else w).copy()
+            if b_name is not None:
+                p["bias"] = np.asarray(
+                    self._to_array(self._init(b_name))).copy()
+
     # ------------------------------------------------------------ handlers
     def _handle_gemm(self, ff, node, env):
-        a = _attrs(node)
+        a = self._attrs(node)
         x = env[node.input[0]]
-        # weight initializer gives out_dim
-        w = next(i for i in self.proto.graph.initializer
-                 if i.name == node.input[1])
-        out_dim = w.dims[0] if not a.get("transB", 0) == 0 else w.dims[1]
-        return ff.dense(x, int(out_dim), use_bias=len(node.input) > 2)
+        w = self._init(node.input[1])
+        trans_b = bool(a.get("transB", 0))
+        out_dim = w.dims[0] if trans_b else w.dims[1]
+        use_bias = len(node.input) > 2
+        y = ff.dense(x, int(out_dim), use_bias=use_bias)
+        # framework kernel is [in, out]: transB weights are [out, in]
+        self.param_layers[y.owner_layer.name] = (
+            node.input[1], node.input[2] if use_bias else None, trans_b)
+        return y
 
     def _handle_matmul(self, ff, node, env):
         return ff.batch_matmul(env[node.input[0]], env[node.input[1]])
@@ -84,7 +126,7 @@ class ONNXModel:
 
     def _handle_softmax(self, ff, node, env):
         return ff.softmax(env[node.input[0]],
-                          axis=_attrs(node).get("axis", -1))
+                          axis=self._attrs(node).get("axis", -1))
 
     def _handle_flatten(self, ff, node, env):
         return ff.flat(env[node.input[0]])
@@ -100,18 +142,22 @@ class ONNXModel:
 
     def _handle_concat(self, ff, node, env):
         return ff.concat([env[i] for i in node.input],
-                         axis=_attrs(node).get("axis", 0))
+                         axis=self._attrs(node).get("axis", 0))
 
     def _handle_conv(self, ff, node, env):
-        a = _attrs(node)
-        w = next(i for i in self.proto.graph.initializer
-                 if i.name == node.input[1])
+        a = self._attrs(node)
+        w = self._init(node.input[1])
         kh, kw = a.get("kernel_shape", [w.dims[2], w.dims[3]])
         sh, sw = a.get("strides", [1, 1])
         pads = a.get("pads", [0, 0, 0, 0])
-        return ff.conv2d(env[node.input[0]], int(w.dims[0]), kh, kw, sh, sw,
-                         pads[0], pads[1], groups=a.get("group", 1),
-                         use_bias=len(node.input) > 2)
+        use_bias = len(node.input) > 2
+        y = ff.conv2d(env[node.input[0]], int(w.dims[0]), kh, kw, sh, sw,
+                      pads[0], pads[1], groups=a.get("group", 1),
+                      use_bias=use_bias)
+        # ONNX conv weights are OIHW — the framework layout, no transpose
+        self.param_layers[y.owner_layer.name] = (
+            node.input[1], node.input[2] if use_bias else None, False)
+        return y
 
     def _handle_maxpool(self, ff, node, env):
         return self._pool(ff, node, env, PoolType.MAX)
@@ -120,7 +166,7 @@ class ONNXModel:
         return self._pool(ff, node, env, PoolType.AVG)
 
     def _pool(self, ff, node, env, pt):
-        a = _attrs(node)
+        a = self._attrs(node)
         kh, kw = a["kernel_shape"]
         sh, sw = a.get("strides", [kh, kw])
         pads = a.get("pads", [0, 0, 0, 0])
@@ -128,7 +174,7 @@ class ONNXModel:
                          pads[0], pads[1], pool_type=pt)
 
     def _handle_dropout(self, ff, node, env):
-        a = _attrs(node)
+        a = self._attrs(node)
         return ff.dropout(env[node.input[0]], rate=a.get("ratio", 0.5))
 
     def _handle_identity(self, ff, node, env):

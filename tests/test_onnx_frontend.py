@@ -1,28 +1,136 @@
-"""ONNX frontend tests: gated on the onnx package (not in this image —
-verify the gate produces a clear error; full replay tests activate
-automatically wherever onnx is installed)."""
+"""ONNX frontend tests.
+
+The image has no ``onnx`` package; the vendored minimal protobuf codec
+(onnx_frontend/minionnx.py) makes the importer executable anyway, so
+these tests run in CI instead of skipping (round 2 flagged the frontend
+as never executed).  With a real onnx install the torch-export test
+activates too.
+"""
 
 import numpy as np
 import pytest
 
-from flexflow_tpu.onnx_frontend import ONNXModel
+from flexflow_tpu import FFConfig, Model
+from flexflow_tpu.onnx_frontend import ONNXModel, minionnx as mo
 
 try:
-    import onnx
+    import onnx  # noqa: F401
 
     HAS_ONNX = True
 except ImportError:
     HAS_ONNX = False
 
 
-@pytest.mark.skipif(HAS_ONNX, reason="onnx installed; gate test n/a")
-def test_missing_onnx_raises_clear_error():
-    with pytest.raises(ImportError, match="onnx.*frontend"):
-        ONNXModel("whatever.onnx")
+def _mlp_proto(rng):
+    """Gemm(transB) -> Relu -> Gemm -> Softmax with real weights."""
+    w1 = rng.standard_normal((32, 16)).astype(np.float32) * 0.3  # [out,in]
+    b1 = rng.standard_normal(32).astype(np.float32) * 0.1
+    w2 = rng.standard_normal((32, 4)).astype(np.float32) * 0.3   # [in,out]
+    nodes = [
+        mo.make_node("Gemm", ["x", "w1", "b1"], ["h"], transB=1),
+        mo.make_node("Relu", ["h"], ["a"]),
+        mo.make_node("Gemm", ["a", "w2"], ["z"], transB=0),
+        mo.make_node("Softmax", ["z"], ["out"], axis=-1),
+    ]
+    model = mo.make_model(
+        nodes,
+        inputs=[mo.make_value_info("x", [2, 16])],
+        outputs=[mo.make_value_info("out", [2, 4])],
+        initializers=[mo.make_tensor("w1", w1), mo.make_tensor("b1", b1),
+                      mo.make_tensor("w2", w2)])
+    return model, (w1, b1, w2)
+
+
+def test_minionnx_serialize_load_roundtrip(tmp_path):
+    rng = np.random.default_rng(0)
+    model, (w1, b1, w2) = _mlp_proto(rng)
+    p = tmp_path / "m.onnx"
+    p.write_bytes(mo.serialize_model(model))
+    m2 = mo.load(str(p))
+    assert [n.op_type for n in m2.graph.node] == ["Gemm", "Relu", "Gemm",
+                                                  "Softmax"]
+    np.testing.assert_array_equal(
+        mo.numpy_from_tensor(m2.graph.initializer[0]), w1)
+    attrs = {a.name: mo.get_attribute_value(a)
+             for a in m2.graph.node[0].attribute}
+    assert attrs["transB"] == 1
+
+
+def test_onnx_mlp_replay_and_port():
+    """Full importer path WITHOUT the onnx package: build proto bytes
+    with the vendored codec, replay onto a Model, port the initializer
+    weights, and match a numpy forward of the same weights."""
+    import jax
+
+    rng = np.random.default_rng(1)
+    model_proto, (w1, b1, w2) = _mlp_proto(rng)
+    om = ONNXModel(mo.serialize_model(model_proto))
+    ff = Model(FFConfig(batch_size=2), name="onnx_mlp")
+    x = ff.create_tensor((2, 16), name="x")
+    outs = om.apply(ff, [x])
+    assert outs[0].spec.shape == (2, 4)
+    ff.params = ff.init_params(jax.random.PRNGKey(0))
+    om.port_parameters(ff)
+
+    xin = rng.standard_normal((2, 16)).astype(np.float32)
+    got = np.asarray(ff.apply(ff.params, xin))
+    h = np.maximum(xin @ w1.T + b1, 0.0)
+    z = h @ w2
+    want = np.exp(z - z.max(-1, keepdims=True))
+    want /= want.sum(-1, keepdims=True)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_onnx_conv_pool_replay():
+    rng = np.random.default_rng(2)
+    w = rng.standard_normal((8, 3, 3, 3)).astype(np.float32) * 0.2
+    b = rng.standard_normal(8).astype(np.float32) * 0.1
+    nodes = [
+        mo.make_node("Conv", ["x", "w", "b"], ["c"],
+                     kernel_shape=[3, 3], strides=[1, 1],
+                     pads=[1, 1, 1, 1]),
+        mo.make_node("Relu", ["c"], ["r"]),
+        mo.make_node("MaxPool", ["r"], ["p"], kernel_shape=[2, 2],
+                     strides=[2, 2]),
+        mo.make_node("Flatten", ["p"], ["f"]),
+    ]
+    proto = mo.make_model(
+        nodes, inputs=[mo.make_value_info("x", [2, 3, 8, 8])],
+        outputs=[mo.make_value_info("f", [2, 8 * 4 * 4])],
+        initializers=[mo.make_tensor("w", w), mo.make_tensor("b", b)])
+    import jax
+
+    om = ONNXModel(mo.serialize_model(proto))
+    ff = Model(FFConfig(batch_size=2), name="onnx_conv")
+    x = ff.create_tensor((2, 3, 8, 8), name="x")
+    outs = om.apply(ff, [x])
+    assert outs[0].spec.shape == (2, 8 * 4 * 4)
+    ff.params = ff.init_params(jax.random.PRNGKey(0))
+    om.port_parameters(ff)
+    lname = next(iter(om.param_layers))
+    np.testing.assert_array_equal(np.asarray(ff.params[lname]["kernel"]), w)
+    y = np.asarray(ff.apply(ff.params,
+                            rng.standard_normal((2, 3, 8, 8))
+                            .astype(np.float32)))
+    assert np.isfinite(y).all()
+
+
+def test_unsupported_op_raises():
+    from flexflow_tpu.onnx_frontend import UnsupportedOnnxOp
+
+    proto = mo.make_model(
+        [mo.make_node("Einsum", ["x"], ["y"], equation="ij->ji")],
+        inputs=[mo.make_value_info("x", [2, 2])],
+        outputs=[mo.make_value_info("y", [2, 2])])
+    om = ONNXModel(mo.serialize_model(proto))
+    ff = Model(FFConfig(batch_size=2), name="onnx_bad")
+    x = ff.create_tensor((2, 2), name="x")
+    with pytest.raises(UnsupportedOnnxOp):
+        om.apply(ff, [x])
 
 
 @pytest.mark.skipif(not HAS_ONNX, reason="onnx not installed")
-def test_onnx_mlp_roundtrip(tmp_path):
+def test_onnx_torch_export_roundtrip(tmp_path):
     import torch
     import torch.nn as nn
 
@@ -37,9 +145,26 @@ def test_onnx_mlp_roundtrip(tmp_path):
 
     p = str(tmp_path / "m.onnx")
     torch.onnx.export(MLP(), torch.zeros(2, 16), p)
-    from flexflow_tpu import FFConfig, Model
-
-    ff = Model(FFConfig(batch_size=2), name="onnx_mlp")
+    ff = Model(FFConfig(batch_size=2), name="onnx_torch")
     x = ff.create_tensor((2, 16), name="x")
     outs = ONNXModel(p).apply(ff, [x])
     assert outs[0].spec.shape == (2, 4)
+
+
+def test_minionnx_int32_sign_and_fp16_bits():
+    """Regression: negative int32 values ride varints as 64-bit two's
+    complement (sign must be recovered), and FLOAT16 payloads in
+    int32_data are raw bit patterns, not numeric values."""
+    t = mo.TensorProto(name="i", dims=[3], data_type=mo.DT_INT32)
+    t.raw_data = np.asarray([-1, 2, -300], np.int32).tobytes()
+    np.testing.assert_array_equal(mo.numpy_from_tensor(t),
+                                  [-1, 2, -300])
+    # int32_data path with negatives (simulate a parsed proto)
+    t2 = mo.TensorProto(name="j", dims=[2], data_type=mo.DT_INT32,
+                        int32_data=[-5, 7])
+    np.testing.assert_array_equal(mo.numpy_from_tensor(t2), [-5, 7])
+    # fp16 bit patterns in int32_data: 15360 encodes 1.0
+    t3 = mo.TensorProto(name="h", dims=[2], data_type=mo.DT_FLOAT16,
+                        int32_data=[15360, 0])
+    np.testing.assert_array_equal(
+        np.asarray(mo.numpy_from_tensor(t3), np.float32), [1.0, 0.0])
