@@ -16,7 +16,8 @@ built to agree with the LLM's greedy chain so acceptance ≈ 1 while every
 matmul keeps its true cost; this upper-bounds the mechanism the way real
 distilled SSM weights would approach).
 
-Modes: `python bench.py [all|llama|spec|mnist|kernels]` (default all).
+Modes: `python bench.py [all|llama|llama7b|spec|mnist|kernels|opt|resnet|
+longctx]` (default all).
 """
 
 import json
