@@ -240,6 +240,54 @@ class TestSpecInfer:
         for n, (acc, spec) in zip(budgets, dev_prof):
             assert 0 <= acc <= spec, (acc, spec)
 
+    def test_eos_retirement_matches_host(self):
+        """Device-loop EOS handling: a request whose greedy chain hits the
+        EOS token must truncate at the same position as the host path
+        (the device walk commits up to and including EOS, then retires
+        the row on device)."""
+        from flexflow_tpu.serving import InferenceManager, RequestManager
+        from flexflow_tpu.serving.spec_infer import generate_spec_infer
+
+        llm_hf = _hf_llama(TINY, seed=5)
+        ssm_hf = _hf_llama(SMALLER, seed=6)
+        prompts = [[1, 5, 9], [2, 8, 4]]
+
+        def run(device_loop, eos):
+            llm = _build(llm_hf, InferenceMode.TREE_VERIFY, max_requests=2)
+            ssm = _build(ssm_hf, InferenceMode.BEAM_SEARCH, max_requests=2)
+            im = InferenceManager(llm.config)
+            lid = im.compile_model_and_allocate_buffer(
+                llm, mode=InferenceMode.TREE_VERIFY, max_requests=2,
+                max_seq_length=256, cache_dtype=np.float32)
+            sid = im.compile_model_and_allocate_buffer(
+                ssm, mode=InferenceMode.BEAM_SEARCH, max_requests=2,
+                max_seq_length=256, beam_width=2, cache_dtype=np.float32)
+            rm = RequestManager(max_requests_per_batch=2,
+                                max_tokens_per_batch=64,
+                                max_sequence_length=256,
+                                max_spec_tree_token_num=24)
+            rm.eos_token_id = eos
+            rm.register_ssm_model(sid)
+            reqs = [rm.register_new_request(list(p), max_new_tokens=24)
+                    for p in prompts]
+            generate_spec_infer(rm, im, lid, reqs, beam_width=2,
+                                beam_depth=4, device_loop=device_loop)
+            return [r.tokens[r.prompt_len:] for r in reqs]
+
+        # pick an EOS that actually occurs mid-chain in the no-EOS output
+        free = run(True, eos=None)
+        cand = [t for t in free[0][3:-1]]
+        assert cand, free
+        eos = cand[0]
+        host = run(False, eos=eos)
+        dev = run(True, eos=eos)
+        assert dev == host, (dev, host)
+        # the EOS request truncated (shorter than the free run) and ends
+        # with the EOS token
+        row = 0 if eos in free[0] else 1
+        assert dev[row][-1] == eos
+        assert len(dev[row]) < len(free[row])
+
     def test_two_ssms_token_exact(self):
         """Two registered SSMs both speculate each macro-iteration
         (reference iterates all SSMs, request_manager.cc:2031-2042);
