@@ -335,5 +335,8 @@ class TestPipelineServing:
         assert syncs_tok >= n_new, syncs_tok
         assert syncs_blk <= syncs_tok / 8, (syncs_blk, syncs_tok)
         # regression bound only: the 1-core mesh hides the sync win and
-        # charges the block's extra per-stage dispatches
-        assert t_blk <= 3 * t_tok, (t_blk, t_tok)
+        # charges the block's extra per-stage dispatches.  Loose (5x)
+        # because wall clock on the shared CI host flakes under
+        # co-running load (best-of-3 does not fully cancel a sustained
+        # co-tenant); the deterministic gate above is the sync odometer
+        assert t_blk <= 5 * t_tok, (t_blk, t_tok)
