@@ -173,11 +173,15 @@ def test_spec_infer_entry_matches_incr(tiny_llama_dir, cache_path, tmp_path):
                 max_tokens_per_batch=32, cache_dtype=np.float32)
     incr = llm.generate([[1, 5, 9, 42]], max_new_tokens=8)
 
-    ssm = ff.SSM(ssm_dir, data_type=DataType.FLOAT, cache_path=cache_path)
+    # beam knobs flow from the SSM object through compile into the spec
+    # loop (serve.py SSM(beam_width=, beam_depth=))
+    ssm = ff.SSM(ssm_dir, data_type=DataType.FLOAT, cache_path=cache_path,
+                 beam_width=3, beam_depth=4)
     llm2 = ff.LLM(model_dir, data_type=DataType.FLOAT, cache_path=cache_path)
     llm2.compile(max_requests_per_batch=2, max_seq_length=64,
                  max_tokens_per_batch=32, ssms=[ssm],
                  cache_dtype=np.float32)
+    assert llm2.im.models[ssm.model_id]["beam_width"] == 3
     spec = llm2.generate([[1, 5, 9, 42]], max_new_tokens=8)
     assert ([int(t) for t in spec[0].output_tokens]
             == [int(t) for t in incr[0].output_tokens])
