@@ -599,14 +599,15 @@ def bench_resnet50_dp():
         "    m.fit(xs, ys, epochs=1)\n"
         "    t0 = time.time(); m.fit(xs, ys, epochs=1)\n"
         "    out.append(round(64 / (time.time() - t0), 1))\n"
-        "print('DPSCALE', out)\n")
+        "import json\n"
+        "print('DPSCALE', json.dumps(out))\n")
     curve = None
     try:
         r = subprocess.run([_sys.executable, "-c", code],
                            capture_output=True, text=True, timeout=1200)
         for line in r.stdout.splitlines():
             if line.startswith("DPSCALE"):
-                curve = eval(line.split(" ", 1)[1])
+                curve = json.loads(line.split(" ", 1)[1])
     except Exception:
         pass
     return [{"metric": "resnet50_dp_training_throughput_1chip",

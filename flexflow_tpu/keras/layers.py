@@ -13,6 +13,8 @@ from __future__ import annotations
 import dataclasses
 from typing import Any, List, Optional, Sequence, Tuple
 
+import numpy as np
+
 from ..fftype import ActiMode, DataType, PoolType
 
 _ACTIVATIONS = {
@@ -275,9 +277,28 @@ class Reshape(KerasLayer):
     def __init__(self, target_shape, name: Optional[str] = None):
         super().__init__(name)
         self.target_shape = tuple(int(d) for d in target_shape)
+        if sum(1 for d in self.target_shape if d == -1) > 1:
+            raise ValueError(
+                f"Reshape target_shape {self.target_shape} has more than "
+                "one -1")
+
+    def _resolve(self, in_shape):
+        shape = self.target_shape
+        if -1 not in shape:
+            return shape
+        total = int(np.prod(in_shape[1:]))
+        known = int(np.prod([d for d in shape if d != -1]))
+        if known == 0 or total % known:
+            raise ValueError(
+                f"Reshape target_shape {shape} incompatible with input "
+                f"shape {tuple(in_shape)}")
+        return tuple(total // known if d == -1 else d for d in shape)
 
     def compute_output_shape(self, in_shapes):
-        return (in_shapes[0][0],) + self.target_shape
+        # batch may be symbolic here, so -1 must resolve against the
+        # non-batch dims locally; the core RESHAPE op re-resolves (and
+        # re-validates) at build time
+        return (in_shapes[0][0],) + self._resolve(in_shapes[0])
 
     def build_on(self, model, inputs):
         batch = inputs[0].spec.shape[0]

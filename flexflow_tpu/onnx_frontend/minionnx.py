@@ -286,11 +286,18 @@ def numpy_from_tensor(t: TensorProto) -> np.ndarray:
 
 
 def get_attribute_value(a: AttributeProto) -> Any:
-    return {AT_FLOAT: lambda: a.f, AT_INT: lambda: a.i,
-            AT_STRING: lambda: a.s, AT_TENSOR: lambda: a.t,
-            AT_FLOATS: lambda: list(a.floats),
-            AT_INTS: lambda: list(a.ints),
-            AT_STRINGS: lambda: list(a.strings)}[a.type]()
+    getters = {AT_FLOAT: lambda: a.f, AT_INT: lambda: a.i,
+               AT_STRING: lambda: a.s, AT_TENSOR: lambda: a.t,
+               AT_FLOATS: lambda: list(a.floats),
+               AT_INTS: lambda: list(a.ints),
+               AT_STRINGS: lambda: list(a.strings)}
+    if a.type not in getters:
+        # AT_GRAPH (If/Loop bodies), sparse tensors, or an attribute type
+        # from a newer exporter: surface a diagnosable error instead of a
+        # bare KeyError
+        raise ValueError(
+            f"unsupported ONNX attribute type {a.type} ({a.name!r})")
+    return getters[a.type]()
 
 
 # ------------------------------------------------------------ wire writer
@@ -340,6 +347,12 @@ def _encode_attribute(a: AttributeProto) -> bytes:
     elif a.type == AT_INTS:
         out += _ld(8, b"".join(_varint(i & ((1 << 64) - 1))
                                for i in a.ints))
+    elif a.type == AT_STRINGS:
+        for s in a.strings:
+            out += _ld(9, s if isinstance(s, bytes) else s.encode())
+    else:
+        raise ValueError(
+            f"cannot encode ONNX attribute type {a.type} ({a.name!r})")
     out += _tag(20, 0) + _varint(a.type)
     return out
 

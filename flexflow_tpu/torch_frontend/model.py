@@ -193,14 +193,18 @@ class PyTorchModel:
         tgt = node.target
         name = tgt if isinstance(tgt, str) else getattr(tgt, "__name__", "")
 
-        def has_tensor(v):
-            if isinstance(v, (Tensor, _ParamRef)):
-                return True
+        def leaves(v):
             if isinstance(v, (list, tuple)):
-                return any(has_tensor(x) for x in v)
-            if isinstance(v, dict):
-                return any(has_tensor(x) for x in v.values())
-            return False
+                for x in v:
+                    yield from leaves(x)
+            elif isinstance(v, dict):
+                for x in v.values():
+                    yield from leaves(x)
+            else:
+                yield v
+
+        def has_tensor(v):
+            return any(isinstance(x, (Tensor, _ParamRef)) for x in leaves(v))
 
         # ---- constant folding: traced chains whose inputs are all
         # concrete at the importer's static shapes (size arithmetic,
@@ -249,6 +253,16 @@ class PyTorchModel:
                 bias_ref.target if isinstance(bias_ref, _ParamRef) else None,
                 False)
             return y
+        # past addmm, a parameter/buffer reference has no resolver: fail
+        # loudly at the consuming node instead of leaking the marker into
+        # the generic dispatch (where it would take the scalar branch or
+        # die with an opaque downstream error)
+        leaked = next((x for x in leaves((args, kwargs))
+                       if isinstance(x, _ParamRef)), None)
+        if leaked is not None:
+            raise UnsupportedTorchOp(
+                f"get_attr {leaked.target} consumed by {name}")
+
         if tgt is torch.pow or name == "pow":
             return ff.pow(args[0], float(args[1]))
 

@@ -219,3 +219,28 @@ def test_keras_aux_modules_and_new_layers():
     import pytest as _pytest
     with _pytest.raises(NotImplementedError):
         keras.regularizers.L1(0.01)
+
+
+def test_reshape_minus_one_resolves():
+    """ADVICE r3: Reshape((-1, d)) must resolve -1 against the input
+    element count instead of corrupting downstream static shapes."""
+    import flexflow_tpu.keras as keras
+    import numpy as np
+
+    inp = keras.Input((16,))
+    r = keras.Reshape((-1, 4))
+    t = r(inp)
+    assert r.compute_output_shape([(None, 16)]) == (None, 4, 4)
+    out = keras.Dense(3, activation="softmax")(keras.Flatten()(t))
+    m = keras.Model(inp, out, batch_size=8)
+    m.compile(optimizer=keras.SGD(lr=0.05),
+              loss=keras.losses.SparseCategoricalCrossentropy())
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(8, 16)).astype(np.float32)
+    y = rng.integers(0, 3, 8).astype(np.int32)
+    m.fit(x, y, epochs=1, verbose=False)
+    assert m.predict(x).shape == (8, 3)
+    with pytest.raises(ValueError):
+        keras.Reshape((-1, -1))
+    with pytest.raises(ValueError):
+        keras.Reshape((-1, 5)).compute_output_shape([(None, 16)])
