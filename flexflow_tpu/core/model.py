@@ -222,6 +222,9 @@ class Model:
     def gelu(self, x, name=None):
         return self._unary(OpType.GELU, x, name)
 
+    def silu(self, x, name=None):
+        return self._unary(OpType.SILU, x, name)
+
     def constant(self, value, name=None) -> Tensor:
         """Host-known constant tensor node (no inputs; value baked into
         the graph) — the torch.fx importer's landing spot for traced
@@ -360,7 +363,13 @@ class Model:
                             causal: bool = False, qkv_bias: bool = False,
                             final_bias: bool = False,
                             kernel_initializer=None,
+                            num_kv_heads: int = 0, rotary: bool = False,
+                            rope_theta: float = 10000.0,
+                            sliding_window=None,
                             name=None) -> Tensor:
+        """``num_kv_heads``/``rotary``/``sliding_window`` extend the
+        classic op for LLaMA/Mistral-family full-sequence replay (GQA,
+        RoPE, windowed causal mask) — the torch.fx importer's target."""
         self._dropout_count += 1
         return self._add_layer(OpType.MULTIHEAD_ATTENTION,
                                [query, key, value], dict(
@@ -368,6 +377,9 @@ class Model:
                                    kdim=kdim or embed_dim, vdim=vdim or embed_dim,
                                    dropout=dropout, causal=causal,
                                    qkv_bias=qkv_bias, final_bias=final_bias,
+                                   num_kv_heads=num_kv_heads or num_heads,
+                                   rotary=rotary, rope_theta=rope_theta,
+                                   sliding_window=sliding_window,
                                    seed_offset=self._dropout_count,
                                    kernel_initializer=kernel_initializer), name)[0]
 

@@ -161,6 +161,7 @@ class OpType(enum.Enum):
     TANH = "tanh"
     ELU = "elu"
     GELU = "gelu"
+    SILU = "silu"
     IDENTITY = "identity"
     RSQRT = "rsqrt"
     POW = "pow"

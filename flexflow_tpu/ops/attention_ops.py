@@ -24,29 +24,47 @@ from .registry import OpDef, ParamSpec, register
 
 
 def mha_attention(q, k, v, *, causal=False, mask=None, scale=None,
-                  dropout_rate=0.0, dropout_rng=None):
-    """Core attention: q,k,v [B, H, S, D] -> [B, H, Sq, D].
+                  dropout_rate=0.0, dropout_rng=None,
+                  sliding_window=None):
+    """Core attention: q [B, H, Sq, D], k/v [B, KV, Sk, D] ->
+    [B, H, Sq, D].  H = KV * G (GQA: query heads grouped per KV head, no
+    KV duplication in memory — the layout serving_attention uses).
 
     ``dropout_rate`` applies to the attention probabilities (matching the
-    reference's cuDNN attnDropout, src/ops/attention.cc)."""
+    reference's cuDNN attnDropout, src/ops/attention.cc).
+    ``sliding_window``: with ``causal``, restrict each query to the last
+    ``sliding_window`` positions (HF Mistral convention:
+    0 <= q_pos - k_pos < window)."""
     d = q.shape[-1]
+    B, H, Sq, _ = q.shape
+    KV = k.shape[1]
+    G = H // KV
     scale = scale if scale is not None else 1.0 / np.sqrt(d)
-    logits = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+    qg = q.reshape(B, KV, G, Sq, d)
+    logits = jnp.einsum("bkgqd,bksd->bkgqs", qg, k,
                         preferred_element_type=jnp.float32) * scale
+    sk = logits.shape[-1]
     if causal:
-        sq, sk = logits.shape[-2], logits.shape[-1]
-        cmask = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
-        logits = jnp.where(cmask, logits, -jnp.inf)
+        span = jnp.arange(sk)[None, :]
+        qpos = (jnp.arange(Sq) + (sk - Sq))[:, None]
+        cmask = span <= qpos
+        if sliding_window is not None:
+            cmask &= (qpos - span) < sliding_window
+        logits = jnp.where(cmask[None, None, None], logits, -jnp.inf)
     if mask is not None:
+        if mask.ndim == 4:        # [B, H or 1, Sq, Sk] -> group the heads
+            mask = (mask.reshape(B, KV, G, Sq, sk)
+                    if mask.shape[1] == H else mask[:, :, None])
         logits = jnp.where(mask, logits, -jnp.inf)
     probs = jax.nn.softmax(logits, axis=-1)
     if dropout_rate > 0.0 and dropout_rng is not None:
         keep = jax.random.bernoulli(dropout_rng, 1.0 - dropout_rate,
                                     probs.shape)
         probs = jnp.where(keep, probs / (1.0 - dropout_rate), 0.0)
-    out = jnp.einsum("bhqk,bhkd->bhqd", probs.astype(v.dtype), v,
+    out = jnp.einsum("bkgqs,bksd->bkgqd", probs.astype(v.dtype), v,
                      preferred_element_type=jnp.float32)
-    return out.astype(v.dtype)
+    # -1: v's head dim may differ from q's (vdim != kdim)
+    return out.reshape(B, H, Sq, -1).astype(v.dtype)
 
 
 @register
@@ -64,25 +82,27 @@ class MultiHeadAttention(OpDef):
         q, k, v = in_specs
         e = attrs["embed_dim"]
         h = attrs["num_heads"]
+        kv = attrs.get("num_kv_heads") or h        # GQA: fewer KV heads
         kdim = attrs.get("kdim") or e
         vdim = attrs.get("vdim") or e
+        d = kdim // h
         dt = q.dtype
         init = attrs.get("kernel_initializer") or DEFAULT_WEIGHT_INIT
         ps = [
-            ParamSpec("wq", (q.shape[-1], h, kdim // h), dt, init,
+            ParamSpec("wq", (q.shape[-1], h, d), dt, init,
                       fans=(q.shape[-1], kdim)),
-            ParamSpec("wk", (k.shape[-1], h, kdim // h), dt, init,
-                      fans=(k.shape[-1], kdim)),
-            ParamSpec("wv", (v.shape[-1], h, vdim // h), dt, init,
-                      fans=(v.shape[-1], vdim)),
+            ParamSpec("wk", (k.shape[-1], kv, d), dt, init,
+                      fans=(k.shape[-1], kv * d)),
+            ParamSpec("wv", (v.shape[-1], kv, vdim // h), dt, init,
+                      fans=(v.shape[-1], kv * (vdim // h))),
             ParamSpec("wo", (h, vdim // h, e), dt, init, fans=(vdim, e)),
         ]
         # projection biases (reference attention.cc qkv/final bias flags;
         # GPT-2-style checkpoints need them for the torch.fx importer)
         if attrs.get("qkv_bias", False):
-            ps += [ParamSpec("bq", (h, kdim // h), dt),
-                   ParamSpec("bk", (h, kdim // h), dt),
-                   ParamSpec("bv", (h, vdim // h), dt)]
+            ps += [ParamSpec("bq", (h, d), dt),
+                   ParamSpec("bk", (kv, d), dt),
+                   ParamSpec("bv", (kv, vdim // h), dt)]
         if attrs.get("final_bias", False):
             ps.append(ParamSpec("bo", (e,), dt))
         return ps
@@ -96,6 +116,14 @@ class MultiHeadAttention(OpDef):
             q = q + params["bq"].astype(q.dtype)[None, :, None, :]
             k = k + params["bk"].astype(k.dtype)[None, :, None, :]
             v = v + params["bv"].astype(v.dtype)[None, :, None, :]
+        if attrs.get("rotary", False):
+            # full-sequence RoPE at positions 0..S-1 (the torch.fx
+            # importer's LLaMA/Mistral-family leaf; serving attention
+            # applies the same rotation at cache depths)
+            theta = attrs.get("rope_theta", 10000.0)
+            pos = jnp.arange(q.shape[2])[None, None, :]
+            q = apply_rotary_embedding(q, pos, theta)
+            k = apply_rotary_embedding(k, pos, theta)
         rate = attrs.get("dropout", 0.0)
         drop_rng = None
         if ctx.training and rate > 0.0:
@@ -103,7 +131,8 @@ class MultiHeadAttention(OpDef):
             drop_rng = jax.random.fold_in(ctx.rng, attrs["seed_offset"])
         out = mha_attention(q, k, v, causal=attrs.get("causal", False),
                             dropout_rate=rate if ctx.training else 0.0,
-                            dropout_rng=drop_rng)
+                            dropout_rng=drop_rng,
+                            sliding_window=attrs.get("sliding_window"))
         y = jnp.einsum("bhsd,hde->bse", out, params["wo"].astype(out.dtype))
         if attrs.get("final_bias", False):
             y = y + params["bo"].astype(y.dtype)
@@ -113,7 +142,12 @@ class MultiHeadAttention(OpDef):
         q = in_specs[0]
         b, s, e = q.shape
         h = attrs["num_heads"]
-        return 2 * b * s * e * e * 4 + 4 * b * h * s * s * (e // h)
+        kv = attrs.get("num_kv_heads") or h
+        d = (attrs.get("kdim") or e) // h
+        # q + o projections at h heads, k/v at kv heads (GQA), plus the
+        # two seq^2 attention matmuls
+        proj = 2 * b * s * e * (h * d) * 2 + 2 * b * s * e * (kv * d) * 2
+        return proj + 4 * b * h * s * s * d
 
 
 def apply_rotary_embedding(x, positions, theta: float = 10000.0):

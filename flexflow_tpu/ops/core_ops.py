@@ -202,6 +202,7 @@ _UNARY_FNS = {
     OpType.TANH: jnp.tanh,
     OpType.ELU: jax.nn.elu,
     OpType.GELU: jax.nn.gelu,
+    OpType.SILU: jax.nn.silu,
     OpType.IDENTITY: lambda x: x,
     OpType.RSQRT: jax.lax.rsqrt,
     OpType.EXP: jnp.exp,

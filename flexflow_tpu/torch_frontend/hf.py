@@ -45,8 +45,37 @@ def _patched_masks(module):
             setattr(modeling, name, orig)
 
 
+@contextlib.contextmanager
+def _narrowed_forward(module, input_names: Sequence[str]):
+    """Modern transformers forwards end in ``**kwargs: Unpack[...]``,
+    which torch.fx's bytecode patching cannot rebuild (co_varnames too
+    small).  For the duration of the trace, swap in a forward whose
+    signature is exactly ``input_names`` — the original still runs
+    underneath with those kwargs."""
+    import inspect
+
+    cls = type(module)
+    orig = cls.forward
+    has_var_kw = any(p.kind is inspect.Parameter.VAR_KEYWORD
+                     for p in inspect.signature(orig).parameters.values())
+    if not has_var_kw:
+        yield
+        return
+    args = ", ".join(f"{n}=None" for n in input_names)
+    calls = ", ".join(f"{n}={n}" for n in input_names)
+    ns = {"_orig": orig}
+    exec(f"def forward(self, {args}):\n    return _orig(self, {calls})\n",
+         ns)
+    cls.forward = ns["forward"]
+    try:
+        yield
+    finally:
+        cls.forward = orig
+
+
 def hf_symbolic_trace(module, input_names: Sequence[str] = ("input_ids",),
-                      extra_leaf_suffixes: Sequence[str] = ("Attention",)):
+                      extra_leaf_suffixes: Sequence[str] = (
+                          "Attention", "RotaryEmbedding", "RMSNorm")):
     """Trace an HF transformers model into a GraphModule suitable for
     :class:`flexflow_tpu.torch_frontend.PyTorchModel` replay: attention
     modules stay leaves, mask construction is stubbed."""
@@ -60,6 +89,6 @@ def hf_symbolic_trace(module, input_names: Sequence[str] = ("input_ids",),
                 return True
             return super().is_leaf_module(mod, name)
 
-    with _patched_masks(module):
+    with _patched_masks(module), _narrowed_forward(module, input_names):
         return hffx.symbolic_trace(module, input_names=list(input_names),
                                    tracer_cls=_Tracer)

@@ -45,6 +45,23 @@ def _is_hf_attention(m) -> bool:
         and hasattr(m, "num_heads")
 
 
+def _is_llama_attention(m) -> bool:
+    """Duck-typed LLaMA/Mistral/Qwen2-family attention leaf: separate
+    q/k/v/o Linear projections + a config carrying head counts
+    (transformers.models.mistral.modeling_mistral MistralAttention and
+    friends — the GQA + RoPE + optional sliding-window decoders)."""
+    return all(hasattr(m, a) for a in
+               ("q_proj", "k_proj", "v_proj", "o_proj")) \
+        and hasattr(m, "config")
+
+
+def _is_hf_rmsnorm(m) -> bool:
+    """Duck-typed transformers RMSNorm (MistralRMSNorm etc.): a single
+    ``weight`` and a ``variance_epsilon``."""
+    return type(m).__name__.endswith("RMSNorm") and hasattr(m, "weight") \
+        and hasattr(m, "variance_epsilon")
+
+
 def _pair(v):
     return (v, v) if isinstance(v, int) else tuple(v)
 
@@ -93,7 +110,16 @@ class PyTorchModel:
                 env[node.name] = _ParamRef(node.target)
             elif node.op == "call_module":
                 m = mods[node.target]
-                x = env[node.args[0].name]
+                # modern transformers invokes submodules keyword-only
+                # (self_attn(hidden_states=..., ...)): the primary input
+                # is the first positional arg or the hidden_states kwarg
+                if node.args:
+                    first = node.args[0]
+                else:
+                    first = node.kwargs.get(
+                        "hidden_states",
+                        next(iter(node.kwargs.values()), None))
+                x = env[first.name] if hasattr(first, "name") else first
                 y = self._call_module(ffmodel, node, m, x)
                 env[node.name] = y
                 lead = y[0] if isinstance(y, tuple) else y
@@ -136,9 +162,53 @@ class PyTorchModel:
                 x.detach().cpu().numpy() if torch.is_tensor(x) else x,
                 np.int32))
             return ff.embedding(idx, m.num_embeddings, m.embedding_dim)
+        if _is_llama_attention(m):
+            # LLaMA/Mistral-family leaf -> the framework op with GQA +
+            # in-op RoPE + sliding window; the traced (cos, sin)
+            # position_embeddings arg is ignored (the op re-derives RoPE
+            # at positions 0..S-1, which full-sequence replay means)
+            c = m.config
+            scaling = getattr(c, "rope_scaling", None)
+            if scaling and (scaling.get("rope_type", scaling.get("type"))
+                            not in (None, "default")):
+                # Llama-3-style scaled RoPE would silently diverge
+                raise UnsupportedTorchOp(
+                    f"rope_scaling {scaling!r} (plain RoPE only)")
+            window = getattr(c, "sliding_window", None)
+            if hasattr(c, "use_sliding_window"):
+                # Qwen2-style: the window is gated per layer by
+                # max_window_layers, which a per-leaf handler cannot see
+                if not c.use_sliding_window:
+                    window = None
+                elif getattr(c, "max_window_layers", 0):
+                    raise UnsupportedTorchOp(
+                        "per-layer sliding-window gating "
+                        "(max_window_layers) is not supported")
+            h = int(c.num_attention_heads)
+            kv = int(getattr(c, "num_key_value_heads", h) or h)
+            d = int(getattr(m, "head_dim", None)
+                    or c.hidden_size // h)
+            y = ff.multihead_attention(
+                x, x, x, embed_dim=int(c.hidden_size), num_heads=h,
+                kdim=h * d, vdim=h * d, num_kv_heads=kv, causal=True,
+                rotary=True,
+                rope_theta=float(getattr(c, "rope_theta", 10000.0)),
+                sliding_window=window,
+                qkv_bias=m.q_proj.bias is not None,
+                final_bias=m.o_proj.bias is not None)
+            return (y, None)
+        if type(m).__name__.endswith("RotaryEmbedding"):
+            # traced as a leaf only so its inv_freq buffer stays out of
+            # the graph; its (cos, sin) output feeds attention leaves
+            # that re-derive RoPE natively
+            return None
+        if _is_hf_rmsnorm(m):
+            return ff.rms_norm(x, eps=float(m.variance_epsilon))
         if type(m).__name__ in ("NewGELUActivation", "GELUActivation",
                                 "FastGELUActivation", "QuickGELUActivation"):
             return ff.gelu(x)
+        if type(m).__name__ in ("SiLUActivation",) or isinstance(m, nn.SiLU):
+            return ff.silu(x)
         if isinstance(m, nn.Linear):
             return ff.dense(x, m.out_features, use_bias=m.bias is not None)
         if isinstance(m, nn.Conv2d):
@@ -295,6 +365,8 @@ class PyTorchModel:
             return ff.relu(args[0])
         if tgt is F.gelu or name == "gelu":
             return ff.gelu(args[0])
+        if tgt is F.silu or name == "silu":
+            return ff.silu(args[0])
         if tgt in (torch.sigmoid, F.sigmoid) or name == "sigmoid":
             return ff.sigmoid(args[0])
         if tgt in (torch.tanh, F.tanh) or name == "tanh":
@@ -378,6 +450,30 @@ class PyTorchModel:
                     p["bv"] = b[2 * e:].reshape(h, d).copy()
                 if "c_proj.bias" in with_no_grad:
                     p["bo"] = with_no_grad["c_proj.bias"]
+                continue
+            if _is_llama_attention(m):
+                # separate q/k/v/o Linears ([out, in] torch layout) ->
+                # wq [E, H, D] / wk,wv [E, KV, D] / wo [H, D, E]; same
+                # head-split convention as models/llama.py
+                # convert_hf_state_dict
+                c = m.config
+                h = int(c.num_attention_heads)
+                kv = int(getattr(c, "num_key_value_heads", h) or h)
+                e = int(c.hidden_size)
+                d = int(getattr(m, "head_dim", None) or e // h)
+                p["wq"] = with_no_grad["q_proj.weight"].T.reshape(e, h, d).copy()
+                p["wk"] = with_no_grad["k_proj.weight"].T.reshape(e, kv, d).copy()
+                p["wv"] = with_no_grad["v_proj.weight"].T.reshape(e, kv, d).copy()
+                p["wo"] = with_no_grad["o_proj.weight"].T.reshape(h, d, e).copy()
+                if "q_proj.bias" in with_no_grad:
+                    p["bq"] = with_no_grad["q_proj.bias"].reshape(h, d).copy()
+                    p["bk"] = with_no_grad["k_proj.bias"].reshape(kv, d).copy()
+                    p["bv"] = with_no_grad["v_proj.bias"].reshape(kv, d).copy()
+                if "o_proj.bias" in with_no_grad:
+                    p["bo"] = with_no_grad["o_proj.bias"]
+                continue
+            if _is_hf_rmsnorm(m):
+                p["weight"] = with_no_grad["weight"]
                 continue
             if isinstance(m, nn.Linear):
                 p["kernel"] = with_no_grad["weight"].T.copy()

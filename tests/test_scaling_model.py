@@ -1,0 +1,68 @@
+"""Analytic scaling model (search/scaling.py): the honest multi-chip
+statement one chip permits (r3 verdict missing #7).  The formulas reuse
+the search MachineModel collectives; these tests pin their shape."""
+
+import json
+
+from flexflow_tpu.search.scaling import (DEFAULT_MESHES,
+                                         llama_decode_scaling,
+                                         resnet50_dp_scaling,
+                                         scaling_model,
+                                         spec_infer_scaling)
+
+
+def test_meshes_cover_chip_counts():
+    for n, (tp, pp) in DEFAULT_MESHES.items():
+        assert tp * pp == n
+
+
+def test_resnet_dp_efficiency_shape():
+    r = resnet50_dp_scaling()
+    effs = [row["efficiency"] for row in r["per_chip"]]
+    assert effs[0] == 1.0                       # n=1: no collective
+    assert all(0 < e <= 1 for e in effs)
+    # weak scaling: efficiency declines as the ring grows
+    assert all(a >= b for a, b in zip(effs, effs[1:]))
+    # formula inputs are stated (auditability is the point)
+    assert "grad_bytes" in r["inputs"] and "allreduce" in r["inputs"]
+
+
+def test_llama_decode_strong_scaling():
+    r = llama_decode_scaling()
+    rows = r["per_chip"]
+    assert rows[0]["efficiency"] == 1.0
+    # strong scaling: per-step time falls with chips even after
+    # collectives (weight streaming dominates at 7B)
+    steps = [row["step_ms"] for row in rows]
+    assert all(a > b for a, b in zip(steps, steps[1:]))
+    assert all(0 < row["efficiency"] <= 1 for row in rows)
+    # collectives only appear once the mesh is parallel
+    assert rows[0]["collective_ms"] == 0
+    assert all(row["collective_ms"] > 0 for row in rows[1:])
+
+
+def test_llama_overhead_shifts_but_keeps_shape():
+    base = llama_decode_scaling()
+    slow = llama_decode_scaling(step_overhead_s=0.005)
+    for a, b in zip(base["per_chip"], slow["per_chip"]):
+        assert b["step_ms"] > a["step_ms"]
+
+
+def test_spec_scaling_includes_ssm_serial_term():
+    r = spec_infer_scaling()
+    rows = r["per_chip"]
+    assert rows[0]["efficiency"] == 1.0
+    # the SSM expansion is serial (replicated per stage): efficiency
+    # must decay FASTER than plain decoding at the same chip count
+    dec = llama_decode_scaling()
+    for s_row, d_row in zip(rows[1:], dec["per_chip"][1:]):
+        assert s_row["efficiency"] < d_row["efficiency"]
+
+
+def test_scaling_model_block_is_json():
+    blocks = scaling_model(resnet_step_s=0.08,
+                           llama_step_overhead_s=0.004,
+                           spec_commit_per_iter=7.5)
+    assert len(blocks) == 3
+    s = json.dumps(blocks)          # bench embeds it in the JSON line
+    assert "BASELINE config 4" in s and "north star" in s
