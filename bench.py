@@ -142,9 +142,24 @@ def bench_llama7b_decode():
     in int8 on device (init_quantized_params; no checkpoint in the
     zero-egress container; decode's compute profile is weight-independent).
 
+    r4: the headline runs the EXACT convert-dot path (W8A16 — bit
+    identical to dequantize-then-matmul), which reaches >=0.8 of the
+    weight roofline after the scatter fix (the r3 gap was a serial
+    16-iteration XLA while loop hiding in the vmapped KV-cache scatter,
+    ~3.2 ms/step — found by XProf, fixed with a hinted scatter op).  The
+    W8A8 MXU-native mode (FFConfig.int8_native_matmul, dynamic per-row
+    activation quantization) is measured alongside with its greedy
+    token match rate vs the exact path.  On random-init weights the
+    match rate is a WORST CASE: random logits have near-zero argmax
+    margins, so activation rounding flips ties that a trained model's
+    confident margins would not (the tiny trained-margin model in
+    tests/test_quantization.py matches 100%).
+
     Reports end-to-end serving throughput plus the device-side ms/step
     (one fused decode block timed with a single host sync) against the
     int8 weight-streaming roofline."""
+    import gc
+
     import jax
 
     from flexflow_tpu import FFConfig, Model
@@ -181,38 +196,77 @@ def bench_llama7b_decode():
                             max_sequence_length=256, decode_block=64)
         reqs = [rm.register_new_request(p, max_new_tokens=new_tokens)
                 for p in prompts]
-        results = rm.generate_incr_decoding(im, mid, reqs)
-        return sum(len(r.output_tokens) for r in results)
+        rm.generate_incr_decoding(im, mid, reqs)
+        return reqs
 
     run()   # warmup: compiles prefill + decode buckets
-    best = 0.0
+    best, toks_exact = 0.0, None
     for _ in range(5):
         t0 = time.time()
-        total = run()
-        best = max(best, total / (time.time() - t0))
+        reqs = run()
+        total = sum(len(r.tokens) - r.prompt_len for r in reqs)
+        tput = total / (time.time() - t0)
+        if tput > best:
+            best, toks_exact = tput, [r.tokens for r in reqs]
 
     # device-side step time via decode-block K-DIFFERENCING (see
     # _device_ms_per_step) against the int8 weight-streaming roofline
     ms_step, w_bytes = _device_ms_per_step(im, mid, model, max_requests,
                                            prompt_len)
     roofline_ms = w_bytes / 819e9 * 1e3              # v5e HBM bytes/s
+
+    # W8A8 MXU-native twin: same params, second record (weights shared
+    # by reference; only the caches duplicate)
+    im.models.pop(mid)
+    gc.collect()
+    import dataclasses
+
+    model.config = dataclasses.replace(model.config,
+                                       int8_native_matmul=True)
+    im2 = InferenceManager(model.config)
+    mid2 = im2.compile_model_and_allocate_buffer(
+        model, max_requests=max_requests, max_seq_length=256,
+        prefill_chunk=64)
+
+    def run_native():
+        rm = RequestManager(max_requests_per_batch=max_requests,
+                            max_tokens_per_batch=32,
+                            max_sequence_length=256, decode_block=64)
+        reqs = [rm.register_new_request(p, max_new_tokens=new_tokens)
+                for p in prompts]
+        rm.generate_incr_decoding(im2, mid2, reqs)
+        return reqs
+
+    reqs_n = run_native()    # warmup + tokens for the match rate
+    # GENERATED tokens only — the echoed prompts match by construction
+    flat_n = [t for r in reqs_n for t in r.tokens[r.prompt_len:]]
+    flat_e = [t for r, full in zip(reqs_n, toks_exact)
+              for t in full[r.prompt_len:]]
+    match = sum(a == b for a, b in zip(flat_n, flat_e)) / max(1, len(flat_e))
+    ms_w8a8, _ = _device_ms_per_step(im2, mid2, model, max_requests,
+                                     prompt_len)
     from flexflow_tpu.search.scaling import llama_decode_scaling
 
     return [
         {"metric": "llama7b_int8_decode_throughput_1chip",
          "value": round(best, 1), "unit": "tokens/s",
-         "methodology": "int8-weights,best-of-5,batch16,new128",
+         "methodology": ("int8-weights,exact-convert-dot,best-of-5,"
+                         "batch16,new128"),
          "vs_baseline": 0},
         {"metric": "llama7b_int8_decode_device_ms_per_step",
          "value": round(ms_step, 2), "unit": "ms",
-         "methodology": ("decode-block k-differencing (112-16)/96, "
-                         "best-of-3 — cancels the volatile tunnel RTT "
-                         "that inflated r2's number; roofline_ms = "
-                         "int8 weight bytes / 819 GB/s (v5e spec — "
-                         "fraction >1 means the chip streams faster "
-                         "than that spec)"),
+         "methodology": ("exact W8A16 convert-dot; decode-block "
+                         "k-differencing (112-16)/96, best-of-3 — "
+                         "cancels the volatile tunnel RTT that inflated "
+                         "r2's number; roofline_ms = int8 weight bytes "
+                         "/ 819 GB/s (v5e spec); the step also reads "
+                         "~1.6 GB KV cache the weight-only roofline "
+                         "does not count"),
          "roofline_ms": round(roofline_ms, 2),
          "roofline_fraction": round(roofline_ms / ms_step, 3),
+         "w8a8_native_ms_per_step": round(ms_w8a8, 2),
+         "w8a8_native_roofline_fraction": round(roofline_ms / ms_w8a8, 3),
+         "w8a8_greedy_match_vs_exact": round(match, 3),
          # analytic 1->16-chip statement (BASELINE config 4) seeded with
          # the MEASURED step: overhead = measured - weight-roofline time
          "scaling_model": llama_decode_scaling(
@@ -222,8 +276,9 @@ def bench_llama7b_decode():
     ]
 
 
+
 def build_aligned_llama(cfg, mode, max_requests, dtype=None, share_from=None,
-                        name="aligned"):
+                        name="aligned", disagree_p=0.0, disagree_seed=7):
     """A LLaMA whose greedy output depends ONLY on the current input token:
     zeroing every attention out-projection (wo) and FFN down-projection
     leaves each residual block contributing 0, so logits =
@@ -232,7 +287,14 @@ def build_aligned_llama(cfg, mode, max_requests, dtype=None, share_from=None,
     model's.  Two models sharing embedding+lm_head+final-norm weights
     (``share_from``) then produce IDENTICAL greedy chains regardless of
     their other (random) weights or depth — an aligned LLM/SSM pair with
-    acceptance ≈ 1 for spec_infer benching without real checkpoints."""
+    acceptance ≈ 1 for spec_infer benching without real checkpoints.
+
+    ``disagree_p`` (r4 verdict missing #2): perturb the token->token map
+    on a fraction p of the vocab by swapping those SSM embedding rows
+    among themselves — for a perturbed input token the SSM proposes the
+    LLM's continuation of a DIFFERENT token, so per-proposal acceptance
+    falls to ~(1-p) and the bench measures the acceptance-vs-speedup
+    curve instead of only the acceptance=1 upper bound."""
     import jax
 
     from flexflow_tpu import FFConfig, Model
@@ -252,6 +314,14 @@ def build_aligned_llama(cfg, mode, max_requests, dtype=None, share_from=None,
     if share_from is not None:
         for ln in ("embed_tokens", "lm_head", "norm"):
             model.params[ln] = dict(share_from.params[ln])
+    if disagree_p > 0.0:
+        emb = np.array(np.asarray(model.params["embed_tokens"]["embedding"]))
+        prng = np.random.default_rng(disagree_seed)
+        n = int(round(emb.shape[0] * disagree_p))
+        rows = prng.choice(emb.shape[0], size=n, replace=False)
+        emb[rows] = emb[np.roll(rows, 1)]    # cyclic swap: a derangement
+        model.params["embed_tokens"] = {
+            "embedding": emb.astype(np.asarray(emb).dtype)}
     return model
 
 
@@ -339,6 +409,64 @@ def bench_spec_infer():
              for r in spec_reqs]
     accept = (sum(r.profile.accepted_tokens for r in spec_reqs)
               / max(1, sum(r.profile.speculated_tokens for r in spec_reqs)))
+
+    # ---- acceptance-vs-speedup curve (r4 verdict missing #2): the SSM's
+    # token->token map is perturbed on a vocab fraction p, so acceptance
+    # falls below 1 while every matmul keeps full cost.  Each point
+    # reports MEASURED acceptance (accepted/speculated from the per-
+    # request profiles), not the nominal p.
+    def spec_point(ssm_model, W_pt, D_pt, reps=3):
+        sid = im.compile_model_and_allocate_buffer(
+            ssm_model, mode=InferenceMode.BEAM_SEARCH,
+            max_requests=max_requests, max_seq_length=256,
+            beam_width=W_pt, prefill_chunk=64)
+        best, reqs_best = 0.0, None
+        for _ in range(reps + 1):      # +1 warmup
+            rm = RequestManager(max_requests_per_batch=max_requests,
+                                max_tokens_per_batch=32,
+                                max_sequence_length=256,
+                                max_spec_tree_token_num=tree_chunk)
+            rm.register_ssm_model(sid)
+            reqs = [rm.register_new_request(p, max_new_tokens=new_tokens)
+                    for p in prompts]
+            t0 = time.time()
+            generate_spec_infer(rm, im, llm_id, reqs, beam_width=W_pt,
+                                beam_depth=D_pt)
+            dt = time.time() - t0
+            total = sum(len(r.tokens) - r.prompt_len for r in reqs)
+            if total / dt > best:
+                best, reqs_best = total / dt, reqs
+        im.models.pop(sid)
+        acc = (sum(r.profile.accepted_tokens for r in reqs_best)
+               / max(1, sum(r.profile.speculated_tokens
+                            for r in reqs_best)))
+        return {"acceptance": round(acc, 3),
+                "tokens_s": round(best, 1),
+                "speedup_vs_incr": round(best / best_inc, 3),
+                "W": W_pt, "D": D_pt}
+
+    curve = [{"acceptance": round(accept, 3),
+              "tokens_s": round(best_spec, 1),
+              "speedup_vs_incr": round(best_spec / best_inc, 3),
+              "W": W, "D": D, "nominal_p": 0.0}]
+    # nominal p -> measured acceptance at D=7 is steeper than 1-p (one
+    # wrong proposal wastes the chain's tail): these land near
+    # {0.9, 0.8, 0.6, 0.3}
+    for p_dis in (0.02, 0.05, 0.15, 0.4):
+        ssm_p = build_aligned_llama(
+            ssm_cfg, InferenceMode.BEAM_SEARCH, max_requests,
+            share_from=llm, name=f"spec_ssm_p{int(p_dis*100)}",
+            disagree_p=p_dis)
+        pt = spec_point(ssm_p, W, D)
+        pt["nominal_p"] = p_dis
+        curve.append(pt)
+    # one tree config with real width: W=2, D=4 at p=0.1
+    ssm_w2 = build_aligned_llama(
+        ssm_cfg, InferenceMode.BEAM_SEARCH, max_requests,
+        share_from=llm, name="spec_ssm_w2", disagree_p=0.1)
+    w2_point = spec_point(ssm_w2, 2, 4)
+    w2_point["nominal_p"] = 0.1
+
     return [
         {"metric": "llama1p4b_spec_infer_throughput_1chip",
          "value": round(best_spec, 1), "unit": "tokens/s",
@@ -348,6 +476,15 @@ def bench_spec_infer():
         {"metric": "llama1p4b_spec_vs_incr_speedup",
          "value": round(best_spec / best_inc, 3),
          "unit": "x (same prompts, same harness)",
+         "vs_baseline": 0},
+        {"metric": "llama1p4b_spec_acceptance_curve",
+         "value": round(min(pt["speedup_vs_incr"] for pt in curve), 3),
+         "unit": "x at lowest measured acceptance",
+         "methodology": ("SSM embed rows swapped on vocab fraction p "
+                         "(build_aligned_llama disagree_p); acceptance "
+                         "MEASURED from profiles; best-of-3 each"),
+         "curve": curve,
+         "w2_tree_point": w2_point,
          "vs_baseline": 0},
         {"metric": "llama1p4b_spec_p50_ttft",
          "value": round(float(np.percentile(ttfts, 50)) * 1e3, 1),

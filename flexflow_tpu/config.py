@@ -61,6 +61,12 @@ class FFConfig:
     offload: bool = False
     offload_reserve_space_size: int = 0
     quantization: Optional[str] = None  # "int8" | "int4" | None
+    # int8 serving matmuls run MXU-NATIVE (int8 x int8 -> int32) with
+    # dynamic per-row activation quantization (W8A8) instead of the
+    # exact convert-dot (W8A16).  ~20% faster weight streaming on v5e
+    # (the convert-dot is VPU-convert-bound, not HBM-bound) at a small,
+    # documented numerics change; see docs/INTERNALS.md
+    int8_native_matmul: bool = False
     # device selection
     num_devices: int = 0  # 0: all visible
     devices: Optional[Sequence[jax.Device]] = None

@@ -179,6 +179,120 @@ def flash_decode_attend(q, ck, cv, depth, active, scale: float,
     )(last, depth, active, q, ck, cv)
 
 
+def _kernel_t(last_ref, depth_ref, act_ref,    # scalar prefetch
+              q_ref, k_ref, v_ref,             # blocks ([1,KV,TS,D])
+              o_ref,                           # out
+              m_sc, l_sc, acc_sc,              # scratch
+              *, ts: int, kv: int, g: int, d: int,
+              s_total: int, scale: float):
+    """Transposed-layout kernel body: cache [R, KV, S, D] so K/V tiles
+    arrive [1, KV, TS, D] — the kv batch dim leads BOTH dot operands and
+    the in-VMEM swapaxes relayout of the [R, S, KV, D] kernel (the
+    measured 4.4x uniform-case loss, r3 PARITY §3) disappears.  One row
+    per program (rb = 1)."""
+    from jax.experimental import pallas as pl
+
+    r = pl.program_id(0)
+    t = pl.program_id(1)
+    nt = pl.num_programs(1)
+    kvg = kv * g
+
+    @pl.when(t == 0)
+    def _init():
+        m_sc[:] = jnp.full_like(m_sc, -1e30)
+        l_sc[:] = jnp.zeros_like(l_sc)
+        acc_sc[:] = jnp.zeros_like(acc_sc)
+
+    @pl.when(t <= last_ref[r])
+    def _step():
+        qv = q_ref[:].reshape(kv, g, d)
+        kt = k_ref[:].reshape(kv, ts, d)       # native layout: no swap
+        vt = v_ref[:].reshape(kv, ts, d)
+        # logits[kv, g, ts] = qv . kt (batch kv; contract d)
+        logits = jax.lax.dot_general(
+            qv, kt, (((2,), (2,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32) * scale
+        span = (t * ts
+                + jax.lax.broadcasted_iota(jnp.int32, (1, ts), 1))
+        ok = (span <= depth_ref[r]) & (act_ref[r] > 0)     # [1, TS]
+        logits = jnp.where(ok[None, :, :] > 0, logits, -1e30)
+        l2 = logits.reshape(kvg, ts)
+        tile_max = jnp.max(l2, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_sc[:], tile_max)
+        alpha = jnp.exp(m_sc[:] - m_new)
+        p = jnp.where(m_new > -1e29, jnp.exp(l2 - m_new), 0.0)
+        l_sc[:] = l_sc[:] * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        m_sc[:] = m_new
+        col_ok = (t * ts + jax.lax.broadcasted_iota(
+            jnp.int32, (1, ts, 1), 1)) < s_total
+        vt = jnp.where(col_ok, vt, 0)
+        # pv[kv, g, d] = p . vt (batch kv; contract ts)
+        pv = jax.lax.dot_general(
+            p.reshape(kv, g, ts).astype(vt.dtype), vt,
+            (((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32)
+        acc_sc[:] = acc_sc[:] * alpha + pv.reshape(kvg, d)
+
+    @pl.when(t == nt - 1)
+    def _finish():
+        l = l_sc[:]
+        l = jnp.where(l == 0, 1.0, l)
+        o_ref[:] = (acc_sc[:] / l).reshape(1, kv * g, d).astype(
+            o_ref.dtype)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("scale", "interpret", "ts"))
+def flash_decode_attend_t(q, ck, cv, depth, active, scale: float,
+                          interpret: bool = False, ts=None):
+    """Transposed-cache flash decode: q [R,H,D] against cache
+    [R,KV,S,D] masked to span<=depth[r] -> [R,H,D].  The tile arrives
+    pre-transposed so both dots run with a leading kv batch dim — no
+    in-kernel relayout (the r3 uniform-case fix, PARITY §3)."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    R, H, D = q.shape
+    KV, S = ck.shape[1], ck.shape[2]
+    G = H // KV
+    assert H == KV * G and ck.shape == cv.shape == (R, KV, S, D)
+    if ts is None:
+        ts = _pick_rb_ts(R, S, KV, D)[1]
+    nt = pl.cdiv(S, ts)
+    depth = depth.astype(jnp.int32)
+    active = active.astype(jnp.int32)
+    last = jnp.minimum(depth // ts, nt - 1)
+
+    kernel = functools.partial(_kernel_t, ts=ts, kv=KV, g=G, d=D,
+                               s_total=S, scale=float(scale))
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(R, nt),
+        in_specs=[
+            pl.BlockSpec((1, H, D), lambda r, t, *_: (r, 0, 0)),
+            pl.BlockSpec((1, KV, ts, D),
+                         lambda r, t, last, *_: (r, 0,
+                                                 jnp.minimum(t, last[r]),
+                                                 0)),
+            pl.BlockSpec((1, KV, ts, D),
+                         lambda r, t, last, *_: (r, 0,
+                                                 jnp.minimum(t, last[r]),
+                                                 0)),
+        ],
+        out_specs=pl.BlockSpec((1, H, D), lambda r, t, *_: (r, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((KV * G, 1), jnp.float32),
+            pltpu.VMEM((KV * G, 1), jnp.float32),
+            pltpu.VMEM((KV * G, D), jnp.float32),
+        ],
+    )
+    return pl.pallas_call(
+        kernel, grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((R, H, D), q.dtype),
+        interpret=interpret,
+    )(last, depth, active, q, ck, cv)
+
+
 def flash_decode_attention(q, k_new, v_new, ck, cv, depth, active,
                            scale: float, interpret: bool = False):
     """Scatter-then-attend decode step (drop-in for the op layer): writes

@@ -78,10 +78,16 @@ class Linear(OpDef):
         blocks XLA's cross-op scheduling).  int4 uses the jnp
         group-dequant path (XLA fuses the unpack into the operand load).
         """
-        from ..quantization import dequantize_kernel
+        from ..quantization import dequantize_kernel, native_int8_matmul
 
         scale = params["kernel_scale"]
-        if scale.ndim == 1:  # int8: convert-dot + post-scale (exact)
+        if scale.ndim == 1:  # int8
+            if ctx is not None and getattr(ctx, "w8a8", False):
+                # MXU-native int8 x int8 (W8A8): the activation rows
+                # quantize dynamically, skipping the VPU int8->bf16
+                # convert that bounds the convert-dot (~20% faster
+                # streaming on v5e; FFConfig.int8_native_matmul)
+                return native_int8_matmul(x, params["kernel_q"], scale)
             y = jnp.einsum("...i,io->...o", x,
                            params["kernel_q"].astype(x.dtype),
                            preferred_element_type=jnp.float32)

@@ -60,6 +60,9 @@ class OpContext:
     # attend (inference_manager.flash_wins)
     use_flash: bool = False
     mesh: Any = None
+    # serving: int8 weights multiply MXU-natively against dynamically
+    # int8-quantized activations (FFConfig.int8_native_matmul)
+    w8a8: bool = False
     extra_outputs: Dict = None  # side outputs (e.g. beam parent ids)
     state_updates: Dict = None  # non-trainable state written by ops (BN stats)
     aux_losses: Dict = None     # auxiliary losses (MoE load balance) summed

@@ -56,18 +56,21 @@ NEG_INF = -1e30  # large-negative fill; -inf breaks softmax rows that are all ma
 def _scatter_chunk(cache, chunk, start, active):
     """cache [R,S,KV,D] <- chunk [R,C,KV,D] at per-row offset start [R].
 
-    Inactive rows redirect to the end of the cache (dynamic_update_slice
-    clamps into the never-attended slack tail) — otherwise a batch that
-    populates only some rows would corrupt other requests' committed KV at
-    offset 0 (every step scatters all R rows unconditionally)."""
+    One scatter op with sorted unique (row, pos) indices.  r4: the
+    previous vmapped dynamic_update_slice lowered to a SERIAL 16-
+    iteration XLA while loop costing ~50 us per cache per layer on chip
+    (~3.2 ms of a 12 ms 7B decode step — found by XProf); the hinted
+    scatter measures ~free.  Inactive rows redirect past the cache end
+    and DROP (previously they clamp-wrote into the never-attended slack
+    tail; dropping is the same guarantee with no write)."""
     S = cache.shape[1]
+    R, C = chunk.shape[:2]
     safe_start = jnp.where(active, start, S)
-
-    def upd(cache_row, chunk_row, s):
-        return jax.lax.dynamic_update_slice(
-            cache_row, chunk_row.astype(cache_row.dtype), (s, 0, 0))
-
-    return jax.vmap(upd)(cache, chunk, safe_start)
+    rows = jnp.broadcast_to(jnp.arange(R)[:, None], (R, C))
+    pos = safe_start[:, None] + jnp.arange(C)[None, :]
+    return cache.at[rows, pos].set(chunk.astype(cache.dtype), mode="drop",
+                                   unique_indices=True,
+                                   indices_are_sorted=True)
 
 
 def _attend(q, cache_k, cache_v, mask, scale, alibi=None):
@@ -160,7 +163,11 @@ class _ServingAttentionBase(OpDef):
             w_q = params.get(name + "_q")
             if w_q is not None:
                 scale = params[name + "_scale"]
-                if scale.ndim == 2:   # int8_nd [E,H,D], scale [H,D]:
+                if scale.ndim == 2:   # int8_nd [E,H,D], scale [H,D]
+                    if ctx is not None and getattr(ctx, "w8a8", False):
+                        from ..quantization import native_int8_matmul
+
+                        return native_int8_matmul(x, w_q, scale)
                     # convert-dot + post-scale (exact; weights stream
                     # int8, see Linear._quantized_matmul)
                     y = jnp.einsum("rce,ehd->rchd", x,
@@ -180,10 +187,16 @@ class _ServingAttentionBase(OpDef):
     def _output(self, params, out, attrs, ctx=None):
         wo_q = params.get("wo_q")
         if wo_q is not None and params["wo_scale"].ndim == 1:
-            # int8_nd [H,D,E], scale [E]: convert-dot + post-scale
-            y = jnp.einsum("rchd,hde->rce", out, wo_q.astype(out.dtype),
-                           preferred_element_type=jnp.float32)
-            y = (y * params["wo_scale"]).astype(out.dtype)
+            if ctx is not None and getattr(ctx, "w8a8", False):
+                from ..quantization import native_int8_matmul
+
+                y = native_int8_matmul(out, wo_q, params["wo_scale"],
+                                       contract_rhs_dims=(0, 1))
+            else:
+                # int8_nd [H,D,E], scale [E]: convert-dot + post-scale
+                y = jnp.einsum("rchd,hde->rce", out, wo_q.astype(out.dtype),
+                               preferred_element_type=jnp.float32)
+                y = (y * params["wo_scale"]).astype(out.dtype)
         else:
             y = jnp.einsum("rchd,hde->rce", out,
                            resolve_weight(params, "wo", out.dtype))

@@ -127,14 +127,27 @@ def beam_rerank(outs, cum, R: int, W: int):
 
 
 def pow2_bucket(need: int, alloc_len: int) -> Optional[int]:
-    """Pow2 shape bucket (floor 64) for a static attended-cache bound:
-    the single source of bucketing policy for the single-step, decode-block
+    """Shape bucket (floor 64) for a static attended-cache bound: the
+    single source of bucketing policy for the single-step, decode-block
     and spec-block paths (bounded jit-variant count).  None = no saving
-    (the bucket reaches the allocation)."""
+    (the bucket reaches the allocation).
+
+    r4: the ladder is pow2 AND 1.5x-pow2 (64, 96, 128, 192, 256, 384,
+    ...) — two buckets per octave.  At 7B the decode step is AGGREGATE
+    HBM-bound (weights + cache reads share ~800 GB/s), so a batch whose
+    depths need 131 reading a 256 bucket burns 33% more cache bandwidth
+    than the 192 bucket for zero benefit; the extra jit variants stay
+    bounded (2 per octave)."""
     L = 64
-    while L < need:
+    while True:
+        if need <= L:
+            bucket = L
+            break
+        if need <= L + L // 2:
+            bucket = L + L // 2
+            break
         L *= 2
-    return None if L >= alloc_len else L
+    return None if bucket >= alloc_len else bucket
 
 
 def attend_bucket(bc, span: int, alloc_len: int) -> Optional[int]:
@@ -400,6 +413,7 @@ class InferenceManager:
             ctx = OpContext(training=False, rng=rng, batch_config=batch,
                             kv_cache=caches, kv_cache_out={},
                             attend_len=attend_len, use_flash=use_flash,
+                            w8a8=model.config.int8_native_matmul,
                             mesh=record["mesh"], extra_outputs={})
             feeds = {}
             C = batch["token_ids"].shape[1]

@@ -178,6 +178,7 @@ def make_stage_step(record, stage_idx: int):
         ctx = OpContext(training=False, rng=rng, batch_config=batch,
                         kv_cache=caches, kv_cache_out={},
                         mesh=record["pp_meshes"][stage_idx],
+                        w8a8=model.config.int8_native_matmul,
                         extra_outputs={})
         feeds = {}
         C = batch["token_ids"].shape[1]

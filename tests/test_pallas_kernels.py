@@ -147,3 +147,36 @@ def test_flash_decode_inactive_rows_zero():
                             interpret=True)
     inact = np.asarray(o)[np.asarray(active) == 0]
     assert np.abs(inact).max() == 0.0
+
+
+@pytest.mark.parametrize("R,H,KV,D,S", [(4, 8, 2, 128, 640),
+                                        (2, 8, 8, 256, 384),
+                                        (6, 6, 3, 128, 336)])
+def test_flash_decode_transposed_layout_matches(R, H, KV, D, S):
+    """The [R, KV, S, D] transposed-cache kernel (r4: kills the
+    in-kernel swapaxes relayout behind the uniform-case 4.4x loss,
+    PARITY §3) matches the production jnp attend on active rows."""
+    import numpy as np
+
+    from flexflow_tpu.kernels.flash_decode import flash_decode_attend_t
+    from flexflow_tpu.ops.serving_attention import _attend
+
+    rng = np.random.default_rng(1)
+    mk = lambda s: jnp.asarray(rng.standard_normal(s), jnp.float32)
+    q = mk((R, H, D))
+    ck_t, cv_t = mk((R, KV, S, D)), mk((R, KV, S, D))
+    depth = jnp.asarray(rng.integers(0, S - 2, R), jnp.int32)
+    active = jnp.asarray([1] * (R - 1) + [0], jnp.int32)
+    o1 = flash_decode_attend_t(q, ck_t, cv_t, depth, active, 0.125,
+                               interpret=True)
+    # reference over the standard [R, S, KV, D] layout
+    ck = jnp.swapaxes(ck_t, 1, 2)
+    cv = jnp.swapaxes(cv_t, 1, 2)
+    span = jnp.arange(S)[None, None, :]
+    mask = (span <= depth[:, None, None]) & (active > 0)[:, None, None]
+    o2 = _attend(q[:, None], ck, cv, mask, 0.125)[:, 0]
+    act = np.asarray(active) > 0
+    np.testing.assert_allclose(np.asarray(o1)[act], np.asarray(o2)[act],
+                               atol=1e-4)
+    # inactive rows: zeros by design
+    np.testing.assert_array_equal(np.asarray(o1)[~act], 0)
