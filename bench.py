@@ -780,6 +780,62 @@ def bench_longctx():
 
     run()   # warmup (compiles the prefill chunk buckets)
     ttft = min(run() for _ in range(3))
+    # free the TTFT model before the decode section: its 2.8 GB weights
+    # + 0.4 GB cache would stack on the 8-row model's ~6 GB
+    im.models.pop(mid)
+    del im, model
+    import gc
+
+    gc.collect()
+
+    # ---- 8k-context RAGGED decode throughput (r4 verdict missing #5):
+    # one 8k-deep row among 7 short rows — the regime attend_len and
+    # the flash kernel's per-row tile pruning exist for.  The XLA attend
+    # must read every row to the batch-max bucket (~8k) while flash
+    # reads each row's own tiles; FF_FLASH_DECODE=0 pins the XLA twin.
+    # Decode cost is cache-content-independent, so depths are set
+    # directly instead of paying a real 8k prefill per run.  Batch 8:
+    # the 16-row cache (6.5 GB) plus transient twin caches OOMs 16 GB.
+    from flexflow_tpu.serving.batch_config import BatchConfig
+
+    R8 = 8
+    model8 = Model(ff, name="longctx_decode")
+    create_llama_model(model8, cfg, max_requests=R8, dtype=DataType.HALF)
+    model8.params = model8.init_params(jax.random.PRNGKey(0))
+
+    def decode_tput(flash_mode):
+        os.environ["FF_FLASH_DECODE"] = flash_mode
+        try:
+            im8 = InferenceManager(ff)
+            mid8 = im8.compile_model_and_allocate_buffer(
+                model8, max_requests=R8, max_seq_length=S + 64,
+                prefill_chunk=128)
+            bc = BatchConfig(R8, 1)
+            bc.request_available[:] = True
+            bc.num_tokens_in_batch[:] = 1
+            bc.first_token_depth[0] = S - 200      # the long-context row
+            bc.first_token_depth[1:] = 100
+            bc.token_ids[:, 0] = 7
+
+            def block_s(k):
+                im8.decode_block(mid8, bc, k, min_remaining=150)
+                best = 1e9
+                for _ in range(3):
+                    t0 = time.time()
+                    np.asarray(im8.decode_block(mid8, bc, k,
+                                                min_remaining=150))
+                    best = min(best, time.time() - t0)
+                return best
+
+            ms = (block_s(104) - block_s(8)) / 96 * 1e3
+            im8.models.pop(mid8)
+            gc.collect()
+            return R8 / ms * 1e3       # tokens/s across the batch
+        finally:
+            os.environ.pop("FF_FLASH_DECODE", None)
+
+    tput_flash = decode_tput("auto")
+    tput_xla = decode_tput("0")
 
     # sp-sharded 32k memory math: per-shard KV bytes for a batch of 8 at
     # 32k context, 1.4B arch, bf16 cache — vs one v5e chip's 16 GB
@@ -793,6 +849,20 @@ def bench_longctx():
          "value": round(ttft * 1e3, 1), "unit": "ms",
          "methodology": "8192-token prompt, chunked prefill (512/step), "
                         "bf16, best-of-3, host-observed first token",
+         "vs_baseline": 0},
+        {"metric": "llama1p4b_8k_ragged_decode_throughput_1chip",
+         "value": round(tput_flash, 1), "unit": "tokens/s",
+         "methodology": ("batch8, one row at ~8k depth + 7 at ~100, "
+                         "decode-block k-differencing (104-8)/96; flash "
+                         "kernel dispatched by the host cost model "
+                         "(flash_wins); xla twin = FF_FLASH_DECODE=0. "
+                         "Numerics: the kernel's online softmax differs "
+                         "from XLA's in f32 reduction order — per-step "
+                         "outputs agree to tolerance (parity tests) but "
+                         "greedy ties on random weights can flip, like "
+                         "any flash-attention kernel"),
+         "xla_twin_tokens_s": round(tput_xla, 1),
+         "flash_vs_xla": round(tput_flash / tput_xla, 3),
          "vs_baseline": 0},
         {"metric": "llama1p4b_32k_sp4_kv_bytes_per_shard",
          "value": round(per_shard / 1e9, 2), "unit": "GB",
@@ -918,10 +988,12 @@ def bench_kernels():
                 "vs_baseline": 0})
 
     # --- flash-decode attention vs XLA attend --------------------------
+    # r4: kv-major cache layout [R, KV, S, D] (tiles arrive
+    # pre-transposed); the kernel now wins BOTH regimes on chip
     R, H, KV, D, S = 16, 16, 4, 128, 8192
     qv = jnp.asarray(rng.standard_normal((R, H, D)), jnp.bfloat16)
-    ck = jnp.asarray(rng.standard_normal((R, S, KV, D)), jnp.bfloat16)
-    cv = jnp.asarray(rng.standard_normal((R, S, KV, D)), jnp.bfloat16)
+    ck = jnp.asarray(rng.standard_normal((R, KV, S, D)), jnp.bfloat16)
+    cv = jnp.asarray(rng.standard_normal((R, KV, S, D)), jnp.bfloat16)
     act = jnp.ones((R,), jnp.int32)
     sc = 1.0 / np.sqrt(D)
     ragged = np.full(R, 300)

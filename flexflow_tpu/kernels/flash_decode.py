@@ -1,30 +1,34 @@
 """Length-tiled flash-decode attention (Pallas TPU).
 
 Single-token decode attention whose VMEM footprint is independent of the
-cache length: the grid walks (row-block, S-tile) with a running-softmax
-accumulator carried in scratch across a row-block's tiles — the
-structure of the reference's hand-written generation kernel
+cache length: the grid walks (row, S-tile) with a running-softmax
+accumulator carried in scratch across a row's tiles — the structure of
+the reference's hand-written generation kernel
 (/root/reference/src/ops/inc_multihead_self_attention.cu:46-430, a
 threadblock-per-head loop over cache pages with online softmax), built
 the Pallas way.
 
-Why this kernel exists (round-2 verdict, missing #1): the earlier
-whole-row decode kernels held a row's entire K/V in VMEM and OOM'd past
-S~512-1500, which made long context structurally impossible on one chip.
-Here each grid step stages only an [RB, TS, KV, D] tile; S=8k/32k/128k
-all run in the same few MB.
+r4 layout: the serving KV cache is stored ``[R, KV, S, D]`` so K/V
+tiles arrive ``[1, KV, TS, D]`` — the kv batch dim leads BOTH dot
+operands and no in-kernel relayout is needed.  The r1-r3 kernel held
+the cache ``[R, S, KV, D]`` and paid a VMEM swapaxes per tile, which
+made the uniform full-length case 4.4x SLOWER than the XLA attend
+(r3 PARITY §3); with the native layout the kernel beats the XLA attend
+even there (measured S=8192 uniform: 357 vs 414 us; ragged
+one-8k-row-in-16: 50 vs 368 us), so the r1-r3 kernel was deleted (the
+round-3 precedent: losing kernels do not stay in the tree).
 
-Per-row-block tile pruning — the capability the XLA einsum path cannot
+Per-row tile pruning — the capability the XLA einsum path cannot
 express: rows attend only [0, depth_r], so a scalar-prefetch clamped
-index map re-requests the SAME block for every tile past the row-block's
-max needed tile; Mosaic's pipeline skips the duplicate DMA and @pl.when
-skips the compute.  In a ragged continuous batch (one row at 8k context,
-the rest at a few hundred tokens) the XLA path must read every row's
-full bucketed allocation, while this kernel reads ~sum(depth_r) — the
-host-side attend_len bucket only bounds the BATCH maximum.
+index map re-requests the SAME block for every tile past the row's max
+needed tile; Mosaic's pipeline skips the duplicate DMA and @pl.when
+skips the compute.  In a ragged continuous batch (one row at 8k
+context, the rest at a few hundred tokens) the XLA path must read every
+row's full bucketed allocation, while this kernel reads ~sum(depth_r) —
+the host-side attend_len bucket only bounds the BATCH maximum.
 
-GQA layout: H = KV * G query heads share KV cache heads; both dots batch
-over (row, KV) — no KV duplication in memory or traffic.
+GQA layout: H = KV * G query heads share KV cache heads; both dots
+batch over kv — no KV duplication in memory or traffic.
 """
 
 from __future__ import annotations
@@ -36,160 +40,11 @@ import jax.numpy as jnp
 
 
 def _kernel(last_ref, depth_ref, act_ref,      # scalar prefetch
-            q_ref, k_ref, v_ref,               # blocks
+            q_ref, k_ref, v_ref,               # blocks ([1,KV,TS,D])
             o_ref,                             # out
             m_sc, l_sc, acc_sc,                # scratch
-            *, ts: int, rb: int, kv: int, g: int, d: int,
+            *, ts: int, kv: int, g: int, d: int,
             s_total: int, scale: float):
-    from jax.experimental import pallas as pl
-
-    r = pl.program_id(0)
-    t = pl.program_id(1)
-    nt = pl.num_programs(1)
-    kvg = kv * g
-
-    @pl.when(t == 0)
-    def _init():
-        m_sc[:] = jnp.full_like(m_sc, -1e30)
-        l_sc[:] = jnp.zeros_like(l_sc)
-        acc_sc[:] = jnp.zeros_like(acc_sc)
-
-    @pl.when(t <= last_ref[r])
-    def _step():
-        qv = q_ref[:]                          # [RB, H, D] model dtype
-        # fold (rb, kv) into ONE batch dim — Mosaic's matmul supports a
-        # single batch dimension; the kt/vt transpose is VMEM-local
-        kt = k_ref[:].swapaxes(1, 2).reshape(rb * kv, ts, d)
-        vt = v_ref[:].swapaxes(1, 2).reshape(rb * kv, ts, d)
-        q3 = qv.reshape(rb * kv, g, d)
-        # logits[rb*kv, g, ts] = q3 . kt  (batch rb*kv; contract d)
-        logits = jax.lax.dot_general(
-            q3, kt,
-            (((2,), (2,)), ((0,), (0,))),
-            preferred_element_type=jnp.float32) * scale
-        span = (t * ts
-                + jax.lax.broadcasted_iota(jnp.int32, (rb, ts), 1))
-        # per-row scalars read individually (rb is small and static;
-        # fancy 2-D gathers from SMEM refs are not supported)
-        depth_col = jnp.stack(
-            [depth_ref[r * rb + i] for i in range(rb)]).reshape(rb, 1)
-        act_col = jnp.stack(
-            [act_ref[r * rb + i] for i in range(rb)]).reshape(rb, 1)
-        ok = (span <= depth_col) & (act_col > 0)   # [RB, TS]
-        logits = logits.reshape(rb, kv, g, ts)
-        logits = jnp.where(ok[:, None, None, :], logits, -1e30)
-        l2 = logits.reshape(rb * kvg, ts)
-        tile_max = jnp.max(l2, axis=-1, keepdims=True)    # [RB*KVG, 1]
-        m_new = jnp.maximum(m_sc[:], tile_max)
-        alpha = jnp.exp(m_sc[:] - m_new)
-        # fully-masked lanes (inactive rows / no valid position yet) keep
-        # m_new at the -1e30 fill; exp(l2 - m_new) would be exp(0)=1
-        # there, silently averaging V — force p to 0 so l stays 0 and the
-        # finish-guard zeros the output
-        p = jnp.where(m_new > -1e29, jnp.exp(l2 - m_new), 0.0)
-        l_sc[:] = l_sc[:] * alpha + jnp.sum(p, axis=-1, keepdims=True)
-        m_sc[:] = m_new
-        # pv[rb*kv, g, d] = p . vt (batch rb*kv; contract ts).  vt's
-        # out-of-range pad columns (partial final S tile) may hold NaN;
-        # p is 0 there but 0*NaN = NaN, so zero them explicitly
-        col_ok = (t * ts + jax.lax.broadcasted_iota(
-            jnp.int32, (1, ts, 1), 1)) < s_total
-        vt = jnp.where(col_ok, vt, 0)
-        pv = jax.lax.dot_general(
-            p.reshape(rb * kv, g, ts).astype(vt.dtype), vt,
-            (((2,), (1,)), ((0,), (0,))),
-            preferred_element_type=jnp.float32)
-        acc_sc[:] = acc_sc[:] * alpha + pv.reshape(rb * kvg, d)
-
-    @pl.when(t == nt - 1)
-    def _finish():
-        l = l_sc[:]
-        l = jnp.where(l == 0, 1.0, l)          # inactive rows: zeros out
-        o_ref[:] = (acc_sc[:] / l).reshape(rb, kv * g, d).astype(
-            o_ref.dtype)
-
-
-def _pick_rb_ts(R: int, S: int, KV: int, D: int,
-                budget_bytes: int = 5 * 1024 * 1024):
-    """One row per program (finest pruning granularity — measured best on
-    chip) with the largest S tile the VMEM budget allows.  The budget
-    covers the double-buffered K+V tiles; the in-kernel transposed copies
-    and f32 logits temps take roughly another budget's worth, which
-    together must stay under the ~16 MB scoped-VMEM limit."""
-    per_pos = KV * D * 2 * 2 * 2       # k+v, bf16, double buffer
-    for ts in (1024, 512, 256, 128):
-        if ts * per_pos <= budget_bytes and ts <= max(S, 128):
-            return 1, ts
-    return 1, 128
-
-
-@functools.partial(jax.jit,
-                   static_argnames=("scale", "interpret", "rb", "ts"))
-def flash_decode_attend(q, ck, cv, depth, active, scale: float,
-                        interpret: bool = False, rb=None, ts=None):
-    """q [R,H,D] against cache [R,S,KV,D] masked to span<=depth[r]
-    -> [R,H,D].  VMEM = O(RB*TS*KV*D), any S.  Inactive rows -> zeros.
-
-    The caller scatters the current token's K/V into the cache FIRST
-    (position depth[r]) — mirroring the production jnp path
-    (ops/serving_attention.py _scatter_chunk then _attend).
-    """
-    from jax.experimental import pallas as pl
-    from jax.experimental.pallas import tpu as pltpu
-
-    R, H, D = q.shape
-    S, KV = ck.shape[1], ck.shape[2]
-    G = H // KV
-    assert H == KV * G and ck.shape == cv.shape == (R, S, KV, D)
-    if rb is None or ts is None:
-        rb, ts = _pick_rb_ts(R, S, KV, D)
-    nt = pl.cdiv(S, ts)
-    depth = depth.astype(jnp.int32)
-    active = active.astype(jnp.int32)
-    # last tile any row of each row-block needs; pruned tiles re-request
-    # that block index and Mosaic skips the duplicate DMA
-    blk_depth = jnp.max(depth.reshape(R // rb, rb), axis=1)
-    last = jnp.minimum(blk_depth // ts, nt - 1)
-
-    kernel = functools.partial(_kernel, ts=ts, rb=rb, kv=KV, g=G, d=D,
-                               s_total=S, scale=float(scale))
-    grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=3,
-        grid=(R // rb, nt),
-        in_specs=[
-            pl.BlockSpec((rb, H, D), lambda r, t, *_: (r, 0, 0)),
-            pl.BlockSpec((rb, ts, KV, D),
-                         lambda r, t, last, *_: (r, jnp.minimum(t, last[r]),
-                                                 0, 0)),
-            pl.BlockSpec((rb, ts, KV, D),
-                         lambda r, t, last, *_: (r, jnp.minimum(t, last[r]),
-                                                 0, 0)),
-        ],
-        out_specs=pl.BlockSpec((rb, H, D), lambda r, t, *_: (r, 0, 0)),
-        scratch_shapes=[
-            pltpu.VMEM((rb * KV * G, 1), jnp.float32),   # running max
-            pltpu.VMEM((rb * KV * G, 1), jnp.float32),   # running sum
-            pltpu.VMEM((rb * KV * G, D), jnp.float32),   # out accumulator
-        ],
-    )
-    return pl.pallas_call(
-        kernel, grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((R, H, D), q.dtype),
-        interpret=interpret,
-    )(last, depth, active, q, ck, cv)
-
-
-def _kernel_t(last_ref, depth_ref, act_ref,    # scalar prefetch
-              q_ref, k_ref, v_ref,             # blocks ([1,KV,TS,D])
-              o_ref,                           # out
-              m_sc, l_sc, acc_sc,              # scratch
-              *, ts: int, kv: int, g: int, d: int,
-              s_total: int, scale: float):
-    """Transposed-layout kernel body: cache [R, KV, S, D] so K/V tiles
-    arrive [1, KV, TS, D] — the kv batch dim leads BOTH dot operands and
-    the in-VMEM swapaxes relayout of the [R, S, KV, D] kernel (the
-    measured 4.4x uniform-case loss, r3 PARITY §3) disappears.  One row
-    per program (rb = 1)."""
     from jax.experimental import pallas as pl
 
     r = pl.program_id(0)
@@ -217,16 +72,22 @@ def _kernel_t(last_ref, depth_ref, act_ref,    # scalar prefetch
         ok = (span <= depth_ref[r]) & (act_ref[r] > 0)     # [1, TS]
         logits = jnp.where(ok[None, :, :] > 0, logits, -1e30)
         l2 = logits.reshape(kvg, ts)
-        tile_max = jnp.max(l2, axis=-1, keepdims=True)
+        tile_max = jnp.max(l2, axis=-1, keepdims=True)     # [KVG, 1]
         m_new = jnp.maximum(m_sc[:], tile_max)
         alpha = jnp.exp(m_sc[:] - m_new)
+        # fully-masked lanes (inactive rows / no valid position yet) keep
+        # m_new at the -1e30 fill; exp(l2 - m_new) would be exp(0)=1
+        # there, silently averaging V — force p to 0 so l stays 0 and the
+        # finish-guard zeros the output
         p = jnp.where(m_new > -1e29, jnp.exp(l2 - m_new), 0.0)
         l_sc[:] = l_sc[:] * alpha + jnp.sum(p, axis=-1, keepdims=True)
         m_sc[:] = m_new
+        # pv[kv, g, d] = p . vt (batch kv; contract ts).  vt's
+        # out-of-range pad columns (partial final S tile) may hold NaN;
+        # p is 0 there but 0*NaN = NaN, so zero them explicitly
         col_ok = (t * ts + jax.lax.broadcasted_iota(
             jnp.int32, (1, ts, 1), 1)) < s_total
         vt = jnp.where(col_ok, vt, 0)
-        # pv[kv, g, d] = p . vt (batch kv; contract ts)
         pv = jax.lax.dot_general(
             p.reshape(kv, g, ts).astype(vt.dtype), vt,
             (((2,), (1,)), ((0,), (0,))),
@@ -236,19 +97,36 @@ def _kernel_t(last_ref, depth_ref, act_ref,    # scalar prefetch
     @pl.when(t == nt - 1)
     def _finish():
         l = l_sc[:]
-        l = jnp.where(l == 0, 1.0, l)
+        l = jnp.where(l == 0, 1.0, l)          # inactive rows: zeros out
         o_ref[:] = (acc_sc[:] / l).reshape(1, kv * g, d).astype(
             o_ref.dtype)
 
 
+def _pick_ts(S: int, KV: int, D: int,
+             budget_bytes: int = 5 * 1024 * 1024):
+    """One row per program (finest pruning granularity — measured best
+    on chip) with the largest S tile the VMEM budget allows.  The budget
+    covers the double-buffered K+V tiles; f32 logits temps take roughly
+    another budget's worth, which together must stay under the ~16 MB
+    scoped-VMEM limit."""
+    per_pos = KV * D * 2 * 2 * 2       # k+v, bf16, double buffer
+    for ts in (1024, 512, 256, 128):
+        if ts * per_pos <= budget_bytes and ts <= max(S, 128):
+            return ts
+    return 128
+
+
 @functools.partial(jax.jit,
                    static_argnames=("scale", "interpret", "ts"))
-def flash_decode_attend_t(q, ck, cv, depth, active, scale: float,
-                          interpret: bool = False, ts=None):
-    """Transposed-cache flash decode: q [R,H,D] against cache
-    [R,KV,S,D] masked to span<=depth[r] -> [R,H,D].  The tile arrives
-    pre-transposed so both dots run with a leading kv batch dim — no
-    in-kernel relayout (the r3 uniform-case fix, PARITY §3)."""
+def flash_decode_attend(q, ck, cv, depth, active, scale: float,
+                        interpret: bool = False, ts=None):
+    """q [R,H,D] against cache [R,KV,S,D] masked to span<=depth[r]
+    -> [R,H,D].  VMEM = O(TS*KV*D), any S.  Inactive rows -> zeros.
+
+    The caller scatters the current token's K/V into the cache FIRST
+    (position depth[r]) — mirroring the production jnp path
+    (ops/serving_attention.py _scatter_chunk then _attend).
+    """
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -257,13 +135,15 @@ def flash_decode_attend_t(q, ck, cv, depth, active, scale: float,
     G = H // KV
     assert H == KV * G and ck.shape == cv.shape == (R, KV, S, D)
     if ts is None:
-        ts = _pick_rb_ts(R, S, KV, D)[1]
+        ts = _pick_ts(S, KV, D)
     nt = pl.cdiv(S, ts)
     depth = depth.astype(jnp.int32)
     active = active.astype(jnp.int32)
+    # last tile each row needs; pruned tiles re-request that block index
+    # and Mosaic skips the duplicate DMA
     last = jnp.minimum(depth // ts, nt - 1)
 
-    kernel = functools.partial(_kernel_t, ts=ts, kv=KV, g=G, d=D,
+    kernel = functools.partial(_kernel, ts=ts, kv=KV, g=G, d=D,
                                s_total=S, scale=float(scale))
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=3,
@@ -281,9 +161,9 @@ def flash_decode_attend_t(q, ck, cv, depth, active, scale: float,
         ],
         out_specs=pl.BlockSpec((1, H, D), lambda r, t, *_: (r, 0, 0)),
         scratch_shapes=[
-            pltpu.VMEM((KV * G, 1), jnp.float32),
-            pltpu.VMEM((KV * G, 1), jnp.float32),
-            pltpu.VMEM((KV * G, D), jnp.float32),
+            pltpu.VMEM((KV * G, 1), jnp.float32),   # running max
+            pltpu.VMEM((KV * G, 1), jnp.float32),   # running sum
+            pltpu.VMEM((KV * G, D), jnp.float32),   # out accumulator
         ],
     )
     return pl.pallas_call(
@@ -293,15 +173,105 @@ def flash_decode_attend_t(q, ck, cv, depth, active, scale: float,
     )(last, depth, active, q, ck, cv)
 
 
+
+def _append_kernel(depth_ref, act_ref,           # scalar prefetch
+                   knew_ref, vnew_ref,           # VMEM [R, KV, 1, D]
+                   ck_hbm, cv_hbm,               # ANY (aliased inputs)
+                   ck_out, cv_out,               # aliased with the above
+                   win_k, win_v, sem_k, sem_v):
+    """Per-row in-place cache append: ck[r, :, depth[r], :] = k_new[r].
+
+    Exists so a flash-dispatched decode step contains NO XLA cache op:
+    XLA's layout assignment physically prefers S-major ({3,1,2,0}) for
+    its scatter and would insert a WHOLE-CACHE relayout copy per layer
+    per step at the Pallas boundary (custom calls require the default
+    descending layout) — measured 9.3 ms/step of copies at 1.4B/8k
+    before this kernel; with both the append and the attend as Pallas
+    calls the cache stays in the default layout end to end.
+
+    Mosaic requires S-slices aligned to the sublane tiling, so the
+    write is a read-modify-write of the 16-aligned window around depth
+    (one extra 16-position read per row — bytes are negligible vs the
+    attend; cache allocations are 16-aligned by the
+    InferenceManager)."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    r = pl.program_id(0)
+
+    @pl.when(act_ref[r] > 0)
+    def _():
+        d = depth_ref[r]
+        base = (d // 16) * 16
+        ink = pltpu.make_async_copy(
+            ck_out.at[r, :, pl.ds(base, 16), :], win_k, sem_k)
+        inv = pltpu.make_async_copy(
+            cv_out.at[r, :, pl.ds(base, 16), :], win_v, sem_v)
+        ink.start()
+        inv.start()
+        ink.wait()
+        inv.wait()
+        sel = jax.lax.broadcasted_iota(jnp.int32, (1, 16, 1), 1) == (d - base)
+        win_k[:] = jnp.where(sel, knew_ref[r], win_k[:])
+        win_v[:] = jnp.where(sel, vnew_ref[r], win_v[:])
+        outk = pltpu.make_async_copy(
+            win_k, ck_out.at[r, :, pl.ds(base, 16), :], sem_k)
+        outv = pltpu.make_async_copy(
+            win_v, cv_out.at[r, :, pl.ds(base, 16), :], sem_v)
+        outk.start()
+        outv.start()
+        outk.wait()
+        outv.wait()
+
+
+def cache_append(ck, cv, k_new, v_new, depth, active,
+                 interpret: bool = False):
+    """In-place (donated/aliased) single-token KV append on [R,KV,S,D]
+    caches via async DMA — the Pallas twin of _scatter_chunk for the
+    flash path.  Inactive rows write nothing."""
+    import functools as _ft
+
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    R, KV, S, D = ck.shape
+    assert S % 16 == 0, S     # 16-aligned windows must stay in bounds
+    depth = jnp.minimum(depth.astype(jnp.int32), S - 1)
+    active = active.astype(jnp.int32)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(R,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.VMEM),   # k_new
+            pl.BlockSpec(memory_space=pltpu.VMEM),   # v_new
+            pl.BlockSpec(memory_space=pltpu.ANY),    # ck
+            pl.BlockSpec(memory_space=pltpu.ANY),    # cv
+        ],
+        out_specs=(pl.BlockSpec(memory_space=pltpu.ANY),
+                   pl.BlockSpec(memory_space=pltpu.ANY)),
+        scratch_shapes=[pltpu.VMEM((KV, 16, D), ck.dtype),
+                        pltpu.VMEM((KV, 16, D), cv.dtype),
+                        pltpu.SemaphoreType.DMA(()),
+                        pltpu.SemaphoreType.DMA(())],
+    )
+    return pl.pallas_call(
+        _append_kernel, grid_spec=grid_spec,
+        out_shape=(jax.ShapeDtypeStruct(ck.shape, ck.dtype),
+                   jax.ShapeDtypeStruct(cv.shape, cv.dtype)),
+        input_output_aliases={4: 0, 5: 1},   # +2 scalar-prefetch args
+        interpret=interpret,
+    )(depth, active, k_new[:, :, None].astype(ck.dtype),
+      v_new[:, :, None].astype(cv.dtype), ck, cv)
+
+
 def flash_decode_attention(q, k_new, v_new, ck, cv, depth, active,
                            scale: float, interpret: bool = False):
     """Scatter-then-attend decode step (drop-in for the op layer): writes
-    the new token's K/V at each active row's depth, then runs the
-    length-tiled attention.  Returns (out [R,H,D], ck, cv)."""
-    from ..ops.serving_attention import _scatter_chunk
-
-    ck = _scatter_chunk(ck, k_new[:, None], depth, active)
-    cv = _scatter_chunk(cv, v_new[:, None], depth, active)
+    the new token's K/V at each active row's depth (in place, Pallas
+    DMA), then runs the length-tiled attention.  Caches are
+    [R, KV, S, D].  Returns (out [R,H,D], ck, cv)."""
+    ck, cv = cache_append(ck, cv, k_new, v_new, depth, active,
+                          interpret=interpret)
     out = flash_decode_attend(q, ck, cv, depth, active, scale,
                               interpret=interpret)
     return out, ck, cv
@@ -313,5 +283,5 @@ def flash_path_ok(C: int, ck, mesh) -> bool:
     cache, lane-aligned head dim.  WHETHER flash beats the XLA attend is
     the host's cost decision (inference_manager.flash_wins) — this only
     says the kernel can run."""
-    R, S, KV, D = ck.shape
+    R, KV, S, D = ck.shape
     return C == 1 and mesh is None and D % 128 == 0

@@ -30,7 +30,10 @@ Design notes (why this is NOT a kernel port):
   produces a partial sum that GSPMD all-reduces (the reference inserts an
   explicit AllReduce op after attention, model.cc:3292).
 
-The cache lives in ``ctx.kv_cache[layer_name] = {"k","v"}: [R, S, KV, D]``;
+The cache lives in ``ctx.kv_cache[layer_name] = {"k","v"}: [R, KV, S, D]``
+(r4: kv-heads-major so flash-decode tiles arrive pre-transposed — the
+layout that made the Pallas kernel beat the XLA attend in BOTH its
+regimes; see kernels/flash_decode.py);
 updated caches are written to ``ctx.kv_cache_out`` (functional update — the
 step fn donates the cache buffers so XLA updates them in place).
 """
@@ -54,7 +57,7 @@ NEG_INF = -1e30  # large-negative fill; -inf breaks softmax rows that are all ma
 
 
 def _scatter_chunk(cache, chunk, start, active):
-    """cache [R,S,KV,D] <- chunk [R,C,KV,D] at per-row offset start [R].
+    """cache [R,KV,S,D] <- chunk [R,C,KV,D] at per-row offset start [R].
 
     One scatter op with sorted unique (row, pos) indices.  r4: the
     previous vmapped dynamic_update_slice lowered to a SERIAL 16-
@@ -62,19 +65,23 @@ def _scatter_chunk(cache, chunk, start, active):
     (~3.2 ms of a 12 ms 7B decode step — found by XProf); the hinted
     scatter measures ~free.  Inactive rows redirect past the cache end
     and DROP (previously they clamp-wrote into the never-attended slack
-    tail; dropping is the same guarantee with no write)."""
-    S = cache.shape[1]
+    tail; dropping is the same guarantee with no write).
+
+    Advanced-indexing note: the slice between the two index arrays puts
+    the advanced dims first, so the update shape is chunk's natural
+    [R, C, KV, D]."""
+    S = cache.shape[2]
     R, C = chunk.shape[:2]
     safe_start = jnp.where(active, start, S)
     rows = jnp.broadcast_to(jnp.arange(R)[:, None], (R, C))
     pos = safe_start[:, None] + jnp.arange(C)[None, :]
-    return cache.at[rows, pos].set(chunk.astype(cache.dtype), mode="drop",
-                                   unique_indices=True,
-                                   indices_are_sorted=True)
+    return cache.at[rows, :, pos].set(chunk.astype(cache.dtype),
+                                      mode="drop", unique_indices=True,
+                                      indices_are_sorted=True)
 
 
 def _attend(q, cache_k, cache_v, mask, scale, alibi=None):
-    """q [R,C,H,D] vs cache [R,S,KV,D] with mask [R,C,S] -> [R,C,H,D].
+    """q [R,C,H,D] vs cache [R,KV,S,D] with mask [R,C,S] -> [R,C,H,D].
 
     H = KV * G; queries grouped so each KV head serves G query heads.
     ``alibi``: optional (slopes[H], q_positions[R,C], key_positions[R,S])
@@ -83,10 +90,10 @@ def _attend(q, cache_k, cache_v, mask, scale, alibi=None):
     NOT its token depth (siblings share a depth but occupy distinct slots).
     """
     R, C, H, D = q.shape
-    KV = cache_k.shape[2]
+    KV = cache_k.shape[1]
     G = H // KV
     qg = q.reshape(R, C, KV, G, D)
-    logits = jnp.einsum("rckgd,rskd->rckgs", qg, cache_k,
+    logits = jnp.einsum("rckgd,rksd->rckgs", qg, cache_k,
                         preferred_element_type=jnp.float32) * scale
     if alibi is not None:
         slopes, positions, key_pos = alibi
@@ -96,7 +103,7 @@ def _attend(q, cache_k, cache_v, mask, scale, alibi=None):
         logits = logits + bias
     logits = jnp.where(mask[:, :, None, None, :], logits, NEG_INF)
     probs = jax.nn.softmax(logits, axis=-1)
-    out = jnp.einsum("rckgs,rskd->rckgd", probs.astype(cache_v.dtype), cache_v,
+    out = jnp.einsum("rckgs,rksd->rckgd", probs.astype(cache_v.dtype), cache_v,
                      preferred_element_type=jnp.float32)
     return out.reshape(R, C, H, D).astype(q.dtype)
 
@@ -244,9 +251,9 @@ class _ServingAttentionBase(OpDef):
         than the weights.  Sharded caches skip the slice (it would
         reshard the sp/tp layout mid-step)."""
         L = ctx.attend_len
-        S = ck.shape[1]
+        S = ck.shape[2]
         if L and L < S and ctx.mesh is None:
-            return ck[:, :L], cv[:, :L], L
+            return ck[:, :, :L], cv[:, :, :L], L
         return ck, cv, S
 
 
@@ -367,18 +374,18 @@ class TreeIncMultiHeadSelfAttention(_ServingAttentionBase):
     def _commit(cache, count, src, dst):
         """Move verified speculative KV to committed slots.
 
-        cache [R,S,KV,D]; per row, for i < count: cache[dst[i]] = cache[src[i]].
-        Non-committed entries scatter to a dummy slot (S-1 overwritten later
-        by real tokens, but we drop instead via mode='drop' with dst=-1).
+        cache [R,KV,S,D]; per row, for i < count:
+        cache[:, dst[i]] = cache[:, src[i]].  Non-committed entries
+        scatter out of bounds and drop.
         """
 
-        def row(cache_row, n, s_idx, d_idx):
-            vals = cache_row[s_idx]  # [C, KV, D] gather
+        def row(cache_row, n, s_idx, d_idx):       # cache_row [KV, S, D]
+            vals = cache_row[:, s_idx]             # [KV, C, D] gather
             # discard sentinel must be out-of-bounds *positive* (negative
             # indices wrap in JAX even under mode='drop')
-            S = cache_row.shape[0]
+            S = cache_row.shape[1]
             d_safe = jnp.where(jnp.arange(s_idx.shape[0]) < n, d_idx, S)
-            return cache_row.at[d_safe].set(vals, mode="drop")
+            return cache_row.at[:, d_safe].set(vals, mode="drop")
 
         return jax.vmap(row)(cache, count, src, dst)
 

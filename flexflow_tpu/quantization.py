@@ -148,14 +148,16 @@ def native_int8_matmul(x, w_q, scale, contract_rhs_dims=(0,)):
     per-row activation scale and per-channel weight ``scale`` apply to
     the int32 result.
 
-    ``contract_rhs_dims``: weight dims to contract with x's trailing
-    dims (1 for [K, N] linear kernels; (0,) for [E, H, D] qkv; (0, 1)
-    for [H, D, E] wo).  Exactness: int8 weights ARE exact; the only
+    ``contract_rhs_dims``: the weight's LEADING dims to contract with
+    x's trailing dims — only (0,) ([K, N] linear kernels / [E, H, D]
+    qkv) and (0, 1) ([H, D, E] wo) are supported; the dims must be
+    exactly (0..n-1).  Exactness: int8 weights ARE exact; the only
     approximation is the activation rounding (~0.4% rms), measured as a
     greedy-token match rate in the bench methodology."""
     import jax
     import jax.numpy as jnp
 
+    assert tuple(contract_rhs_dims) in ((0,), (0, 1)), contract_rhs_dims
     n = len(contract_rhs_dims)
     x2 = x
     if n > 1:   # fold x's trailing contraction dims into one

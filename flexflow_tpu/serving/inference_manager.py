@@ -41,11 +41,12 @@ SERVING_ATTENTION_OPS = (
 
 
 def cache_pspec(sp: int, tp: int) -> PartitionSpec:
-    """The KV cache layout [rows, length, kv_heads, head_dim]: length
-    shards over 'sp', heads over 'tp'.  Single source for the plain and
+    """The KV cache layout [rows, kv_heads, length, head_dim] (r4:
+    kv-heads-major — flash-decode tiles arrive pre-transposed): heads
+    shard over 'tp', length over 'sp'.  Single source for the plain and
     pipeline-stage paths."""
-    return PartitionSpec(None, AXIS_SEQ if sp > 1 else None,
-                         AXIS_MODEL if tp > 1 else None, None)
+    return PartitionSpec(None, AXIS_MODEL if tp > 1 else None,
+                         AXIS_SEQ if sp > 1 else None, None)
 
 
 def pin_cache_layout(caches, mesh, spec):
@@ -161,10 +162,16 @@ def attend_bucket(bc, span: int, alloc_len: int) -> Optional[int]:
     return pow2_bucket(need, alloc_len)
 
 
-# flash-decode's measured per-byte cost multiple vs the XLA attend (the
-# tiled kernel trades streaming efficiency for per-row pruning; calibrated
-# on chip: ~172 vs ~734 GB/s effective)
-FLASH_BYTE_PENALTY = 4.5
+# flash-decode's measured per-byte cost multiple vs the XLA attend.
+# r4 recalibration for the kv-major cache layout: the kernel now reads
+# CHEAPER per byte than the XLA einsum (S=8192 chip numbers: flash_t
+# 50.5 us for ~48 MB of row tiles vs XLA 413.9 us for ~268 MB -> ~0.68x
+# per byte), so the penalty is a conservative 1.2 — flash must still
+# promise a real byte saving before the host switches kernels, keeping
+# the short-uniform regime (where XLA's bucket read is already tight
+# and per-call overheads dominate) on the XLA path.  Pinned against the
+# dispatch model by test_flash_dispatch_crossover_tracks_penalty.
+FLASH_BYTE_PENALTY = 1.2
 
 
 def _record_flash_tile(record) -> int:
@@ -172,12 +179,12 @@ def _record_flash_tile(record) -> int:
     (so the dispatch cost model counts what the kernel actually reads)."""
     tile = record.get("_flash_tile")
     if tile is None:
-        from ..kernels.flash_decode import _pick_rb_ts
+        from ..kernels.flash_decode import _pick_ts
 
         tile = 1024
         for kv in record.get("caches", {}).values():
-            R, S, KV, D = kv["k"].shape
-            tile = _pick_rb_ts(R, S, KV, D)[1]
+            R, KV, S, D = kv["k"].shape
+            tile = _pick_ts(S, KV, D)
             break
         record["_flash_tile"] = tile
     return tile
@@ -344,7 +351,7 @@ class InferenceManager:
                 a = layer.attrs
                 kv = a["num_kv_heads"]
                 d = a.get("head_dim") or a["embed_dim"] // a["num_q_heads"]
-                shape = (rows, alloc_len, kv, d)
+                shape = (rows, kv, alloc_len, d)
                 k = jnp.zeros(shape, cache_dtype)
                 v = jnp.zeros(shape, cache_dtype)
                 if cache_sharding is not None:

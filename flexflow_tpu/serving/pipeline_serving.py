@@ -245,7 +245,7 @@ def compile_pipeline(im, record, model, cfg, cache_dtype, rows, alloc_len):
                 a = layer.attrs
                 kv = a["num_kv_heads"]
                 d = a.get("head_dim") or a["embed_dim"] // a["num_q_heads"]
-                shape = (rows, alloc_len, kv, d)
+                shape = (rows, kv, alloc_len, d)
                 csh = NamedSharding(meshes[s], cache_spec)
                 record["caches"][layer.name] = {
                     "k": jax.device_put(jnp.zeros(shape, cache_dtype), csh),

@@ -58,8 +58,9 @@ class TestSequenceParallelServing:
         assert got == want
         # the cache really lives length-sharded over 'sp'
         cache = im.models[mid]["caches"]["layers_0_attention"]["k"]
-        assert cache.sharding.spec[1] == "sp"
-        assert cache.shape[1] % 2 == 0
+        # r4 kv-major layout: length axis is dim 2
+        assert cache.sharding.spec[2] == "sp"
+        assert cache.shape[2] % 2 == 0   # length axis divides over sp
 
     def test_sp_tp_token_match(self):
         """sp x tp combined: length and head axes shard over different
@@ -70,8 +71,9 @@ class TestSequenceParallelServing:
         got, im, mid = _generate(hf, 2, 2, prompts, 10)
         assert got == want
         cache = im.models[mid]["caches"]["layers_0_attention"]["k"]
-        assert cache.sharding.spec[1] == "sp"
-        assert cache.sharding.spec[2] == "tp"
+        # r4 kv-major layout: heads dim 1 over tp, length dim 2 over sp
+        assert cache.sharding.spec[2] == "sp"
+        assert cache.sharding.spec[1] == "tp"
 
     def test_sp_decode_blocks(self):
         """Device-resident decode blocks (lax.scan) run over the sharded
@@ -116,6 +118,6 @@ class TestSequenceParallelServing:
         # two stages own disjoint device subsets
         c0 = im.models[mid]["caches"]["layers_0_attention"]["k"]
         c1 = im.models[mid]["caches"]["layers_1_attention"]["k"]
-        assert c0.sharding.spec[1] == "sp" and c0.sharding.spec[2] == "tp"
+        assert c0.sharding.spec[2] == "sp" and c0.sharding.spec[1] == "tp"
         assert set(c0.sharding.device_set).isdisjoint(
             set(c1.sharding.device_set))
