@@ -969,6 +969,15 @@ def device_loop_supported(rm, im, llm_id: int,
     W = beam_width or ssm_records[0]["beam_width"]
     D = beam_depth or BeamSearchBatchConfig.MAX_BEAM_DEPTH
     if any(W != rec["beam_width"] for rec in ssm_records):
+        # r3 weak #6: this fallback lands in the ~17x-slower host loop —
+        # say so instead of silently degrading
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "spec_infer: requested beam_width %d != compiled width(s) %s"
+            " — falling back to the HOST loop (one sync per phase). "
+            "Compile the SSM with beam_width=%d to use the device loop.",
+            W, [rec["beam_width"] for rec in ssm_records], W)
         return False
     C = 1 + len(ssm_records) * D * W
     return (C <= rm.max_spec_tree_token_num
