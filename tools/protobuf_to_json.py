@@ -1,0 +1,176 @@
+#!/usr/bin/env python3
+"""Convert a GraphSubst RuleCollection protobuf (.pb) into the TASO-style
+JSON rule collection the substitution loader consumes.
+
+Reference analogue: /root/reference/tools/protobuf_to_json/
+protobuf_to_json.cc (+ rules.proto) — a C++ program linking generated
+protobuf classes and nlohmann::json.  The TPU-native rebuild ships a
+dependency-free pure-Python wire decoder instead (the same hand-rolled
+varint/field reader approach as flexflow_tpu/onnx_frontend/minionnx.py:
+no protobuf runtime in the image, and the wire format is simple).
+
+Schema (rules.proto, proto2):
+    RuleCollection { repeated Rule rule = 1 }
+    Rule      { repeated Operator srcOp = 1; repeated Operator dstOp = 2;
+                repeated MapOutput mappedOutput = 3 }
+    Operator  { required int32 type = 1; repeated Tensor input = 2;
+                repeated Parameter para = 3 }
+    Tensor    { required int32 opId = 1; required int32 tsId = 2 }
+    Parameter { required int32 key = 1; required int32 value = 2 }
+    MapOutput { srcOpId = 1; dstOpId = 2; srcTsId = 3; dstTsId = 4 }
+
+Usage: python tools/protobuf_to_json.py rules.pb [out.json]
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+# enum value -> name tables from the reference converter
+# (protobuf_to_json.cc OpType / PMParameter); names are what the JSON
+# schema (and our substitution loader) uses
+OP_TYPE_NAMES = [
+    "OP_INPUT", "OP_WEIGHT", "OP_ANY", "OP_CONV2D", "OP_DROPOUT",
+    "OP_LINEAR", "OP_POOL2D_MAX", "OP_POOL2D_AVG", "OP_RELU",
+    "OP_SIGMOID", "OP_TANH", "OP_BATCHNORM", "OP_CONCAT", "OP_SPLIT",
+    "OP_RESHAPE", "OP_TRANSPOSE", "OP_EW_ADD", "OP_EW_MUL", "OP_MATMUL",
+    "OP_MUL", "OP_ENLARGE", "OP_MERGE_GCONV", "OP_CONSTANT_IMM",
+    "OP_CONSTANT_ICONV", "OP_CONSTANT_ONE", "OP_CONSTANT_POOL",
+    "OP_PARTITION", "OP_COMBINE", "OP_REPLICATE", "OP_REDUCE",
+    "OP_EMBEDDING",
+]
+PM_PARAMETER_NAMES = [
+    "PM_OP_TYPE", "PM_NUM_INPUTS", "PM_NUM_OUTPUTS", "PM_GROUP",
+    "PM_KERNEL_H", "PM_KERNEL_W", "PM_STRIDE_H", "PM_STRIDE_W",
+    "PM_PAD", "PM_ACTI", "PM_NUMDIM", "PM_AXIS", "PM_PERM",
+    "PM_OUTSHUFFLE", "PM_MERGE_GCONV_COUNT", "PM_PARALLEL_DIM",
+    "PM_PARALLEL_DEGREE",
+]
+
+
+def _name(table, idx: int) -> str:
+    return table[idx] if 0 <= idx < len(table) else str(idx)
+
+
+# -------------------------------------------------------- wire reading
+def _varint(buf: bytes, i: int):
+    out = shift = 0
+    while True:
+        b = buf[i]
+        i += 1
+        out |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return out, i
+        shift += 7
+
+
+def _fields(buf: bytes):
+    """Yield (field_number, wire_type, value) over a message payload."""
+    i = 0
+    while i < len(buf):
+        tag, i = _varint(buf, i)
+        fn, wt = tag >> 3, tag & 7
+        if wt == 0:
+            v, i = _varint(buf, i)
+        elif wt == 2:
+            ln, i = _varint(buf, i)
+            v = buf[i:i + ln]
+            i += ln
+        elif wt == 5:
+            v = buf[i:i + 4]
+            i += 4
+        elif wt == 1:
+            v = buf[i:i + 8]
+            i += 8
+        else:
+            raise ValueError(f"unsupported wire type {wt}")
+        yield fn, wt, v
+
+
+def _i32(v: int) -> int:
+    """proto int32 rides varints as 64-bit two's complement."""
+    v &= (1 << 64) - 1
+    return v - (1 << 64) if v >= (1 << 63) else v
+
+
+def _tensor(buf: bytes):
+    t = {"_t": "Tensor", "opId": 0, "tsId": 0}
+    for fn, _, v in _fields(buf):
+        if fn == 1:
+            t["opId"] = _i32(v)
+        elif fn == 2:
+            t["tsId"] = _i32(v)
+    return t
+
+
+def _parameter(buf: bytes):
+    p = {"_t": "Parameter", "key": 0, "value": 0}
+    for fn, _, v in _fields(buf):
+        if fn == 1:
+            p["key"] = _name(PM_PARAMETER_NAMES, _i32(v))
+        elif fn == 2:
+            p["value"] = _i32(v)
+    return p
+
+
+def _operator(buf: bytes):
+    op = {"_t": "Operator", "type": "OP_ANY", "input": [], "para": []}
+    for fn, _, v in _fields(buf):
+        if fn == 1:
+            op["type"] = _name(OP_TYPE_NAMES, _i32(v))
+        elif fn == 2:
+            op["input"].append(_tensor(v))
+        elif fn == 3:
+            op["para"].append(_parameter(v))
+    return op
+
+
+def _map_output(buf: bytes):
+    m = {"_t": "MapOutput", "srcOpId": 0, "dstOpId": 0,
+         "srcTsId": 0, "dstTsId": 0}
+    keys = {1: "srcOpId", 2: "dstOpId", 3: "srcTsId", 4: "dstTsId"}
+    for fn, _, v in _fields(buf):
+        if fn in keys:
+            m[keys[fn]] = _i32(v)
+    return m
+
+
+def _rule(buf: bytes):
+    r = {"_t": "Rule", "srcOp": [], "dstOp": [], "mappedOutput": []}
+    for fn, _, v in _fields(buf):
+        if fn == 1:
+            r["srcOp"].append(_operator(v))
+        elif fn == 2:
+            r["dstOp"].append(_operator(v))
+        elif fn == 3:
+            r["mappedOutput"].append(_map_output(v))
+    return r
+
+
+def convert(pb_bytes: bytes) -> dict:
+    """RuleCollection .pb bytes -> the loader's JSON dict."""
+    rules = []
+    for fn, _, v in _fields(pb_bytes):
+        if fn == 1:
+            rules.append(_rule(v))
+    return {"_t": "RuleCollection", "rule": rules}
+
+
+def main(argv):
+    if len(argv) < 2:
+        print(__doc__)
+        return 2
+    with open(argv[1], "rb") as f:
+        out = convert(f.read())
+    text = json.dumps(out, indent=2)
+    if len(argv) > 2:
+        with open(argv[2], "w") as f:
+            f.write(text)
+    else:
+        print(text)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))
