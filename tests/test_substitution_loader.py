@@ -176,7 +176,6 @@ def test_protobuf_to_json_converter(tmp_path):
     def key(fn):
         return vint(fn << 3)
 
-    tensor = key(1) + vint(0) + key(2) + vint(0)          # opId 0, tsId 0
     tensor_in = key(1) + vint((-1) & ((1 << 64) - 1)) + key(2) + vint(0)
     para = key(1) + vint(15) + key(2) + vint(2)           # PM_PARALLEL_DIM=2
     src_op = key(1) + vint(5) + ld(2, tensor_in)          # OP_LINEAR

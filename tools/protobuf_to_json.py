@@ -25,7 +25,15 @@ Usage: python tools/protobuf_to_json.py rules.pb [out.json]
 from __future__ import annotations
 
 import json
+import os
 import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))
+
+# the shared protobuf wire reader (same decoder the ONNX frontend uses —
+# one copy in the repo, not two drifting ones)
+from flexflow_tpu.onnx_frontend.minionnx import _fields  # noqa: E402
 
 # enum value -> name tables from the reference converter
 # (protobuf_to_json.cc OpType / PMParameter); names are what the JSON
@@ -54,40 +62,6 @@ def _name(table, idx: int) -> str:
 
 
 # -------------------------------------------------------- wire reading
-def _varint(buf: bytes, i: int):
-    out = shift = 0
-    while True:
-        b = buf[i]
-        i += 1
-        out |= (b & 0x7F) << shift
-        if not b & 0x80:
-            return out, i
-        shift += 7
-
-
-def _fields(buf: bytes):
-    """Yield (field_number, wire_type, value) over a message payload."""
-    i = 0
-    while i < len(buf):
-        tag, i = _varint(buf, i)
-        fn, wt = tag >> 3, tag & 7
-        if wt == 0:
-            v, i = _varint(buf, i)
-        elif wt == 2:
-            ln, i = _varint(buf, i)
-            v = buf[i:i + ln]
-            i += ln
-        elif wt == 5:
-            v = buf[i:i + 4]
-            i += 4
-        elif wt == 1:
-            v = buf[i:i + 8]
-            i += 8
-        else:
-            raise ValueError(f"unsupported wire type {wt}")
-        yield fn, wt, v
-
-
 def _i32(v: int) -> int:
     """proto int32 rides varints as 64-bit two's complement."""
     v &= (1 << 64) - 1
