@@ -44,23 +44,21 @@ def _device_ms_per_step(im, mid, model, max_requests, prompt_len):
     bc.first_token_depth[:] = prompt_len + 2
     bc.token_ids[:, 0] = 7
 
-    def block_s(k):
+    def block_s(k, reps=6):
         im.decode_block(mid, bc, k, min_remaining=150)    # warm bucket
         best = 1e9
-        for _ in range(3):
+        for _ in range(reps):
             t0 = time.time()
             np.asarray(im.decode_block(mid, bc, k, min_remaining=150))
             best = min(best, time.time() - t0)
         return best
 
-    # two independent samples PER BLOCK LENGTH, min per length, then
-    # difference: chip wall clock drifts ±10% across minutes
-    # (thermal/co-tenancy); min-per-length removes a slow sample in
-    # EITHER direction, whereas min over whole passes would keep a pass
-    # whose block_s(16) happened to be inflated (optimistic bias)
-    b112 = min(block_s(112) for _ in range(2))
-    b16 = min(block_s(16) for _ in range(2))
-    ms_step = (b112 - b16) / 96 * 1e3
+    # best-of-6 PER BLOCK LENGTH (one warm-up each), then difference:
+    # chip wall clock drifts ±10% across minutes (thermal/co-tenancy);
+    # min-per-length removes a slow sample in EITHER direction before
+    # the subtraction, so neither an inflated long block nor an inflated
+    # short block skews ms/step
+    ms_step = (block_s(112) - block_s(16)) / 96 * 1e3
     w_bytes = sum(int(np.prod(v.shape)) * v.dtype.itemsize
                   for lp in model.params.values() for v in lp.values())
     return ms_step, w_bytes
@@ -285,7 +283,8 @@ def bench_llama7b_decode():
 
 
 def build_aligned_llama(cfg, mode, max_requests, dtype=None, share_from=None,
-                        name="aligned", disagree_p=0.0, disagree_seed=7):
+                        name="aligned", disagree_p=0.0, disagree_seed=7,
+                        computation_dtype="bfloat16"):
     """A LLaMA whose greedy output depends ONLY on the current input token:
     zeroing every attention out-projection (wo) and FFN down-projection
     leaves each residual block contributing 0, so logits =
@@ -308,9 +307,11 @@ def build_aligned_llama(cfg, mode, max_requests, dtype=None, share_from=None,
     from flexflow_tpu.fftype import DataType
     from flexflow_tpu.models.llama import create_llama_model
 
-    model = Model(FFConfig(computation_dtype="bfloat16"), name=name)
+    model = Model(FFConfig(computation_dtype=computation_dtype), name=name)
     create_llama_model(model, cfg, mode=mode, max_requests=max_requests,
-                       dtype=dtype or DataType.HALF)
+                       dtype=dtype or (DataType.HALF
+                                       if computation_dtype == "bfloat16"
+                                       else DataType.FLOAT))
     model.params = model.init_params(jax.random.PRNGKey(0))
     for ln, lp in model.params.items():
         if ln.endswith("_attention") and "wo" in lp:

@@ -174,16 +174,37 @@ class PyTorchModel:
                 # Llama-3-style scaled RoPE would silently diverge
                 raise UnsupportedTorchOp(
                     f"rope_scaling {scaling!r} (plain RoPE only)")
-            window = getattr(c, "sliding_window", None)
-            if hasattr(c, "use_sliding_window"):
-                # Qwen2-style: the window is gated per layer by
-                # max_window_layers, which a per-leaf handler cannot see
-                if not c.use_sliding_window:
-                    window = None
-                elif getattr(c, "max_window_layers", 0):
+            # sliding-window resolution, most-specific first:
+            # 1. Qwen2-style modules carry the PER-LAYER resolved window
+            #    (self.sliding_window set from config.layer_types)
+            # 2. configs with layer_types gate by the leaf's layer_idx
+            # 3. Mistral-style: one config-level window for every layer
+            if hasattr(m, "sliding_window"):
+                window = m.sliding_window
+            elif getattr(c, "layer_types", None) is not None:
+                li = getattr(m, "layer_idx", None)
+                if li is None:
                     raise UnsupportedTorchOp(
-                        "per-layer sliding-window gating "
-                        "(max_window_layers) is not supported")
+                        "per-layer sliding-window gating (layer_types) "
+                        "needs the attention leaf's layer_idx")
+                window = (getattr(c, "sliding_window", None)
+                          if c.layer_types[li] == "sliding_attention"
+                          else None)
+            else:
+                # Mistral-style: one config-level window for every
+                # layer.  Older-transformers Qwen2 lands here too (no
+                # module attr, no layer_types) with the RAW config value
+                # — honor its gating flags instead of silently windowing
+                # every layer
+                window = getattr(c, "sliding_window", None)
+                if window is not None and hasattr(c, "use_sliding_window"):
+                    if not c.use_sliding_window:
+                        window = None
+                    elif getattr(c, "max_window_layers", None):
+                        raise UnsupportedTorchOp(
+                            "per-layer sliding-window gating "
+                            "(max_window_layers) without module-resolved "
+                            "windows — upgrade transformers")
             h = int(c.num_attention_heads)
             kv = int(getattr(c, "num_key_value_heads", h) or h)
             d = int(getattr(m, "head_dim", None)

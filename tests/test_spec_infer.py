@@ -357,3 +357,79 @@ class TestSpecInfer:
         # the union tree really speculated twice the nodes
         assert (reqs2[0].profile.speculated_tokens
                 > 1.5 * reqs1[0].profile.speculated_tokens)
+
+    def test_acceptance_curve_mechanism(self):
+        """The bench's controlled-disagreement SSM (build_aligned_llama
+        disagree_p: embed-row swaps on a vocab fraction p) lowers
+        MEASURED acceptance while the spec output stays token-exact —
+        the machinery behind llama1p4b_spec_acceptance_curve."""
+        import dataclasses
+        import sys as _sys
+
+        import os
+        _sys.path.insert(0, os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        from bench import build_aligned_llama
+
+        from flexflow_tpu.serving import InferenceManager, RequestManager
+        from flexflow_tpu.serving.spec_infer import generate_spec_infer
+        from flexflow_tpu.models.llama import LLAMAConfig
+
+        llm_cfg = LLAMAConfig(
+            vocab_size=512, hidden_size=64, intermediate_size=128,
+            num_hidden_layers=3, num_attention_heads=4,
+            num_key_value_heads=2, max_position_embeddings=128)
+        ssm_cfg = dataclasses.replace(llm_cfg, num_hidden_layers=1)
+        R = 4
+        # f32 on the CPU CI backend (its DotThunk lacks bf16 x bf16)
+        llm = build_aligned_llama(llm_cfg, InferenceMode.TREE_VERIFY, R,
+                                  name="acc_llm",
+                                  computation_dtype="float32")
+        inc = build_aligned_llama(llm_cfg, InferenceMode.INC_DECODING, R,
+                                  name="acc_inc",
+                                  computation_dtype="float32")
+        inc.params = llm.params
+        im = InferenceManager(llm.config)
+        lid = im.compile_model_and_allocate_buffer(
+            llm, mode=InferenceMode.TREE_VERIFY, max_requests=R,
+            max_seq_length=96, prefill_chunk=32)
+        iid = im.compile_model_and_allocate_buffer(
+            inc, mode=InferenceMode.INC_DECODING, max_requests=R,
+            max_seq_length=96, prefill_chunk=32)
+        rng = np.random.default_rng(0)
+        prompts = [rng.integers(4, 500, 8).tolist() for _ in range(R)]
+
+        rm = RequestManager(max_requests_per_batch=R,
+                            max_tokens_per_batch=16,
+                            max_sequence_length=96, decode_block=16)
+        reqs = [rm.register_new_request(p, max_new_tokens=16)
+                for p in prompts]
+        rm.generate_incr_decoding(im, iid, reqs)
+        want = [r.tokens for r in reqs]
+
+        accs = {}
+        for p_dis in (0.0, 0.5):
+            ssm = build_aligned_llama(ssm_cfg, InferenceMode.BEAM_SEARCH,
+                                      R, share_from=llm,
+                                      name=f"acc_ssm{p_dis}",
+                                      disagree_p=p_dis,
+                                      computation_dtype="float32")
+            sid = im.compile_model_and_allocate_buffer(
+                ssm, mode=InferenceMode.BEAM_SEARCH, max_requests=R,
+                max_seq_length=96, beam_width=1, prefill_chunk=32)
+            rm2 = RequestManager(max_requests_per_batch=R,
+                                 max_tokens_per_batch=16,
+                                 max_sequence_length=96,
+                                 max_spec_tree_token_num=8)
+            rm2.register_ssm_model(sid)
+            reqs2 = [rm2.register_new_request(p, max_new_tokens=16)
+                     for p in prompts]
+            generate_spec_infer(rm2, im, lid, reqs2, beam_width=1,
+                                beam_depth=4)
+            assert [r.tokens for r in reqs2] == want, p_dis
+            accs[p_dis] = (
+                sum(r.profile.accepted_tokens for r in reqs2)
+                / max(1, sum(r.profile.speculated_tokens for r in reqs2)))
+            im.models.pop(sid)
+        assert accs[0.0] > 0.99, accs
+        assert accs[0.5] < 0.7, accs

@@ -358,3 +358,36 @@ def test_mistral_fx_greedy_token_match():
             torch.tensor([prompt], dtype=torch.long), do_sample=False,
             max_new_tokens=6, pad_token_id=0).numpy()[0].tolist()
     assert ours == want, (ours, want)
+
+
+def test_qwen2_fx_mixed_window_layers():
+    """Qwen2-family fx import with PER-LAYER sliding-window gating
+    (max_window_layers -> config.layer_types: here 3 full_attention +
+    3 sliding_attention layers) and qkv biases: logits match
+    transformers.  The handler reads the module-resolved
+    self.sliding_window, so each layer gets its own mask."""
+    from transformers import Qwen2Config, Qwen2ForCausalLM
+
+    cfg = Qwen2Config(vocab_size=256, hidden_size=64,
+                      intermediate_size=128, num_hidden_layers=6,
+                      num_attention_heads=4, num_key_value_heads=2,
+                      sliding_window=3, use_sliding_window=True,
+                      max_window_layers=3,
+                      max_position_embeddings=64, use_cache=False)
+    assert cfg.layer_types[:3] == ["full_attention"] * 3
+    assert cfg.layer_types[3:] == ["sliding_attention"] * 3
+    torch.manual_seed(4)
+    hf = Qwen2ForCausalLM(cfg).eval()
+    ids = np.array([[7, 1, 5, 9, 2, 8, 4, 17, 3, 30]], np.int32)
+    got = _replay_mistral(hf, ids)   # same leaf machinery
+    with torch.no_grad():
+        want = hf(input_ids=torch.tensor(ids, dtype=torch.long)
+                  ).logits.numpy()
+    np.testing.assert_allclose(got, want, rtol=5e-3, atol=5e-3)
+    # the mixed gating is real: an all-full-attention twin with the same
+    # weights diverges at positions past the window
+    cfg2 = Qwen2Config(**{**cfg.to_dict(), "use_sliding_window": False})
+    torch.manual_seed(4)
+    hf2 = Qwen2ForCausalLM(cfg2).eval()
+    got2 = _replay_mistral(hf2, ids)
+    assert np.abs(got - got2)[0, -1].max() > 1e-3
