@@ -261,6 +261,9 @@ class InferenceManager:
         # serving path's key overhead metric (tests pin the decode-block
         # paths to one sync per K tokens).
         self.host_syncs = 0
+        # parked compiled records by (model_id -> beam_width) so
+        # rewiden_beam swaps instead of recompiling on alternating widths
+        self._beam_variants: Dict[int, Dict[int, Dict[str, Any]]] = {}
 
     # ------------------------------------------------------------ compile
     def compile_model_and_allocate_buffer(
@@ -389,6 +392,52 @@ class InferenceManager:
         mid = model_id if model_id is not None else len(self.models)
         self.models[mid] = record
         return mid
+
+    def rewiden_beam(self, model_id: int, beam_width: int) -> None:
+        """Recompile a beam-search model's record at a new beam width.
+
+        Beam width fixes the cache row layout (rows = max_requests * W),
+        so a generate() call requesting a different width cannot reuse
+        the compiled record.  The r3 behavior was a silent fall back to
+        the ~17x-slower host spec loop; instead this re-allocates the
+        caches and step cache at the requested width (SSMs are small —
+        the reallocation is cheap, the jit recompiles lazily on first
+        step) so the device-resident loop keeps serving.  Params stay
+        committed.  Pipeline-parallel records cannot be re-widened (stage
+        buffers are not re-laid-out here) — generate_spec_infer raises a
+        ValueError for them before reaching this method."""
+        rec = self.models[model_id]
+        if rec["beam_width"] == beam_width:
+            return
+        assert "pp_stages" not in rec, (
+            "rewiden_beam: pipeline-parallel records are not re-widened; "
+            "compile the SSM at the requested width instead")
+        # park the current record so alternating-width workloads swap
+        # compiled records instead of recompiling every call (cache
+        # contents are per-generate state — the spec loop re-prefills
+        # each SSM's cache from the request tokens, so a parked record's
+        # stale KV entries are never read)
+        variants = self._beam_variants.setdefault(model_id, {})
+        variants.pop(rec["beam_width"], None)   # refresh recency order
+        variants[rec["beam_width"]] = rec
+        parked = variants.pop(beam_width, None)
+        # bound parked HBM: each variant holds full KV caches + compiled
+        # steps — keep the 2 most recently parked, drop older ones (a
+        # width sweep then re-allocates instead of OOMing the chip)
+        while len(variants) > 2:
+            variants.pop(next(iter(variants)))
+        if parked is not None:
+            self.models[model_id] = parked
+            return
+        caches = rec.get("caches") or {}
+        cache_dtype = (next(iter(caches.values()))["k"].dtype
+                       if caches else None)
+        self.compile_model_and_allocate_buffer(
+            rec["model"], mode=rec["mode"],
+            max_requests=rec["max_requests"],
+            max_seq_length=rec["max_seq_length"],
+            prefill_chunk=rec["prefill_chunk"], beam_width=beam_width,
+            cache_dtype=cache_dtype, model_id=model_id)
 
     def supports_decode_block(self, model_id: int) -> bool:
         """Decode blocks run for every layout: single/tp/sp models fuse

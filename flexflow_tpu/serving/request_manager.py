@@ -214,9 +214,10 @@ class RequestManager:
                 n = int(prev_bc.num_tokens_in_batch[row])
                 if n == 0:
                     continue
+                completes = self._row_completes(req, n)
                 req.cached_len += n
                 req.profile.llm_decoding_steps += 1
-                if req.cached_len >= len(req.tokens):
+                if completes:
                     # the sample at the span's last column is the next token
                     tok = int(prev_result.token_ids[row, n - 1])
                     req.tokens.append(tok)
@@ -352,21 +353,45 @@ class RequestManager:
                                            decode_block, block_rng)
                 bc, result = None, None
                 continue
-            # final layer is a sampling head emitting [R, C] token ids
-            result = InferenceResult(token_ids=np.asarray(outs[0]))
-            im.host_syncs += 1
+            # final layer is a sampling head emitting [R, C] token ids.
+            # Mid-prompt prefill chunks: NO row completes its prompt this
+            # step, so the sampled tokens are never read — keep them on
+            # device and let async dispatch pipeline the next chunk
+            # (each materialization costs a full host↔device round trip,
+            # which over a tunneled chip dwarfs the chunk's compute and
+            # used to dominate long-prompt TTFT)
+            if self._any_prompt_completes(bc):
+                result = InferenceResult(token_ids=np.asarray(outs[0]))
+                im.host_syncs += 1
+            else:
+                result = InferenceResult(token_ids=outs[0])
         return [self._result_of(r) for r in requests]
+
+    @staticmethod
+    def _row_completes(req: Request, n: int) -> bool:
+        """True iff a scheduled span of ``n`` tokens reaches the end of
+        the request's known tokens — EXACTLY the condition under which
+        the step's sample at column n-1 is read by the fold in
+        prepare_next_batch (and therefore must be host-materialized).
+        The single source of truth for the sync-elision decision."""
+        return n > 0 and req.cached_len + n >= len(req.tokens)
+
+    def _any_prompt_completes(self, bc: BatchConfig) -> bool:
+        """True iff some running row's scheduled span reaches the end of
+        its prompt this step — only then does prepare_next_batch read the
+        step's sampled tokens."""
+        return any(
+            self._row_completes(req, int(bc.num_tokens_in_batch[row]))
+            for row, req in self.running.items())
 
     def _prefill_completes_all(self, bc: BatchConfig) -> bool:
         """True iff this (prefill) step leaves every running request in
         pure-decode state — the handoff precondition."""
         if bc.chunk <= 1:
             return False
-        for row, req in self.running.items():
-            n = int(bc.num_tokens_in_batch[row])
-            if n == 0 or req.cached_len + n < len(req.tokens):
-                return False
-        return True
+        return all(
+            self._row_completes(req, int(bc.num_tokens_in_batch[row]))
+            for row, req in self.running.items())
 
     def _max_remaining_budget(self) -> int:
         return max(r.remaining_budget(self.max_sequence_length)

@@ -970,14 +970,20 @@ def device_loop_supported(rm, im, llm_id: int,
     D = beam_depth or BeamSearchBatchConfig.MAX_BEAM_DEPTH
     if any(W != rec["beam_width"] for rec in ssm_records):
         # r3 weak #6: this fallback lands in the ~17x-slower host loop —
-        # say so instead of silently degrading
+        # say so instead of silently degrading.  Reachable only when
+        # beam_width is None and the SSMs were compiled at heterogeneous
+        # widths (an explicit beam_width re-widens or raises inside
+        # generate_spec_infer before this gate runs); the host loop DOES
+        # serve per-SSM widths, the device loop needs one uniform width.
         import logging
 
         logging.getLogger(__name__).warning(
-            "spec_infer: requested beam_width %d != compiled width(s) %s"
-            " — falling back to the HOST loop (one sync per phase). "
-            "Compile the SSM with beam_width=%d to use the device loop.",
-            W, [rec["beam_width"] for rec in ssm_records], W)
+            "spec_infer: SSMs compiled at heterogeneous beam widths %s — "
+            "the device loop needs one uniform width, falling back to "
+            "the HOST loop (one sync per phase, each SSM speculating at "
+            "its own width).  Pass beam_width=N to re-widen every SSM "
+            "to N and keep the device loop.",
+            [rec["beam_width"] for rec in ssm_records])
         return False
     C = 1 + len(ssm_records) * D * W
     return (C <= rm.max_spec_tree_token_num

@@ -29,6 +29,8 @@ Cache/bookkeeping invariants per running request (committed = req.tokens):
 from __future__ import annotations
 
 import dataclasses
+import logging
+import os
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -229,10 +231,38 @@ def generate_spec_infer(rm, im, llm_id: int, requests: Sequence[Request],
     pp, width matching the compiled beam), host otherwise; committed
     tokens are identical either way (greedy verify over the same
     candidate set).  FF_SPEC_DEVICE=0 forces the host path.
+
+    A ``beam_width`` different from an SSM's compiled width RECOMPILES
+    that SSM's record at the requested width (cache rows are laid out
+    per-beam, so NO loop can serve a mismatched width); with
+    FF_SPEC_REWIDEN=0, or for a pipeline-parallel SSM, the mismatch
+    raises a clear ValueError instead.
     """
     assert rm.ssm_model_ids, "spec_infer needs a registered SSM"
     from .spec_block import device_loop_supported, generate_spec_infer_device
 
+    if beam_width is not None:
+        rewiden = os.environ.get("FF_SPEC_REWIDEN", "1") != "0"
+        for sid in rm.ssm_model_ids:
+            rec = im.models[sid]
+            if rec["beam_width"] == beam_width:
+                continue
+            if "pp_stages" in rec or not rewiden:
+                # no loop can serve a width the cache rows were not laid
+                # out for (rows = max_requests * compiled_width); without
+                # the recompile this was a crash deep inside an einsum
+                raise ValueError(
+                    f"spec_infer: requested beam_width {beam_width} != "
+                    f"SSM {sid}'s compiled width {rec['beam_width']}, and "
+                    + ("the SSM is pipeline-parallel (stage buffers are "
+                       "not re-laid-out)" if "pp_stages" in rec else
+                       "FF_SPEC_REWIDEN=0 disables the recompile")
+                    + f"; compile the SSM with beam_width={beam_width}")
+            logging.getLogger(__name__).info(
+                "spec_infer: recompiling SSM %d at beam_width %d "
+                "(was %d) to keep the device loop", sid, beam_width,
+                rec["beam_width"])
+            im.rewiden_beam(sid, beam_width)
     if device_loop is None:
         device_loop = device_loop_supported(rm, im, llm_id, beam_width,
                                             beam_depth)

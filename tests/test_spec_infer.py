@@ -358,6 +358,89 @@ class TestSpecInfer:
         assert (reqs2[0].profile.speculated_tokens
                 > 1.5 * reqs1[0].profile.speculated_tokens)
 
+    def test_beam_width_mismatch_rewidens_to_device_loop(self):
+        """r4 (r3 weak #6): requesting a beam width different from the
+        SSM's compiled width must RECOMPILE the record at the new width
+        and stay on the device loop — not silently degrade to the host
+        path — and the committed tokens must equal a run whose SSM was
+        compiled at that width from the start."""
+        from flexflow_tpu.serving import InferenceManager, RequestManager
+        from flexflow_tpu.serving.spec_block import device_loop_supported
+        from flexflow_tpu.serving.spec_infer import generate_spec_infer
+
+        llm_hf = _hf_llama(TINY, seed=0)
+        ssm_hf = _hf_llama(SMALLER, seed=7)
+        prompts = [[1, 5, 9, 42, 7], [2, 8, 99, 100]]
+
+        def run(compiled_w, requested_w):
+            llm = _build(llm_hf, InferenceMode.TREE_VERIFY, 2)
+            ssm = _build(ssm_hf, InferenceMode.BEAM_SEARCH, 2)
+            im = InferenceManager(llm.config)
+            lid = im.compile_model_and_allocate_buffer(
+                llm, mode=InferenceMode.TREE_VERIFY, max_requests=2,
+                max_seq_length=96, cache_dtype=np.float32)
+            sid = im.compile_model_and_allocate_buffer(
+                ssm, mode=InferenceMode.BEAM_SEARCH, max_requests=2,
+                max_seq_length=96, beam_width=compiled_w,
+                cache_dtype=np.float32)
+            rm = RequestManager(max_requests_per_batch=2,
+                                max_tokens_per_batch=64,
+                                max_sequence_length=96,
+                                max_spec_tree_token_num=24)
+            rm.register_ssm_model(sid)
+            reqs = [rm.register_new_request(list(p), max_new_tokens=12)
+                    for p in prompts]
+            generate_spec_infer(rm, im, lid, reqs, beam_width=requested_w,
+                                beam_depth=4)
+            return im, sid, reqs
+
+        im_m, sid_m, reqs_m = run(compiled_w=3, requested_w=2)
+        # the record was re-widened in place and the device gate passes
+        assert im_m.models[sid_m]["beam_width"] == 2
+        assert im_m.models[sid_m]["rows"] == 2 * 2
+        rm_probe = type("RM", (), {"ssm_model_ids": [sid_m],
+                                   "max_spec_tree_token_num": 24})()
+        assert device_loop_supported(rm_probe, im_m, 0, 2, 4)
+        im_c, _, reqs_c = run(compiled_w=2, requested_w=2)
+        assert [r.tokens for r in reqs_m] == [r.tokens for r in reqs_c]
+        # alternating widths must SWAP parked records (keeping their
+        # compiled step caches), not recompile from scratch every call
+        rec_w2 = im_m.models[sid_m]
+        im_m.rewiden_beam(sid_m, 3)
+        assert im_m.models[sid_m]["beam_width"] == 3
+        im_m.rewiden_beam(sid_m, 2)
+        assert im_m.models[sid_m] is rec_w2
+
+    def test_beam_width_mismatch_env_optout_raises(self, monkeypatch):
+        """FF_SPEC_REWIDEN=0 disables the recompile — and since NO loop
+        can serve a width the cache rows were not laid out for (the r3
+        'host fallback' crashed deep inside an einsum), the mismatch now
+        raises a clear, actionable error with the record untouched."""
+        from flexflow_tpu.serving import InferenceManager, RequestManager
+        from flexflow_tpu.serving.spec_infer import generate_spec_infer
+
+        monkeypatch.setenv("FF_SPEC_REWIDEN", "0")
+        llm = _build(_hf_llama(TINY, seed=0), InferenceMode.TREE_VERIFY, 2)
+        ssm = _build(_hf_llama(SMALLER, seed=7),
+                     InferenceMode.BEAM_SEARCH, 2)
+        im = InferenceManager(llm.config)
+        lid = im.compile_model_and_allocate_buffer(
+            llm, mode=InferenceMode.TREE_VERIFY, max_requests=2,
+            max_seq_length=96, cache_dtype=np.float32)
+        sid = im.compile_model_and_allocate_buffer(
+            ssm, mode=InferenceMode.BEAM_SEARCH, max_requests=2,
+            max_seq_length=96, beam_width=3, cache_dtype=np.float32)
+        rm = RequestManager(max_requests_per_batch=2,
+                            max_tokens_per_batch=64,
+                            max_sequence_length=96,
+                            max_spec_tree_token_num=24)
+        rm.register_ssm_model(sid)
+        reqs = [rm.register_new_request([1, 5, 9], max_new_tokens=6)]
+        with pytest.raises(ValueError, match="FF_SPEC_REWIDEN"):
+            generate_spec_infer(rm, im, lid, reqs, beam_width=2,
+                                beam_depth=4)
+        assert im.models[sid]["beam_width"] == 3   # untouched
+
     def test_acceptance_curve_mechanism(self):
         """The bench's controlled-disagreement SSM (build_aligned_llama
         disagree_p: embed-row swaps on a vocab fraction p) lowers
