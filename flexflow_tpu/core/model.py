@@ -365,11 +365,15 @@ class Model:
                             kernel_initializer=None,
                             num_kv_heads: int = 0, rotary: bool = False,
                             rope_theta: float = 10000.0,
-                            sliding_window=None,
+                            sliding_window=None, scale_qk: bool = True,
+                            t5_bias=None,
                             name=None) -> Tensor:
         """``num_kv_heads``/``rotary``/``sliding_window`` extend the
         classic op for LLaMA/Mistral-family full-sequence replay (GQA,
-        RoPE, windowed causal mask) — the torch.fx importer's target."""
+        RoPE, windowed causal mask) — the torch.fx importer's target.
+        ``scale_qk=False`` + ``t5_bias={num_buckets, max_distance[,
+        bidirectional]}`` cover T5/mt5-family attention (unscaled QK,
+        learned relative position bias)."""
         self._dropout_count += 1
         return self._add_layer(OpType.MULTIHEAD_ATTENTION,
                                [query, key, value], dict(
@@ -380,6 +384,7 @@ class Model:
                                    num_kv_heads=num_kv_heads or num_heads,
                                    rotary=rotary, rope_theta=rope_theta,
                                    sliding_window=sliding_window,
+                                   scale_qk=scale_qk, t5_bias=t5_bias,
                                    seed_offset=self._dropout_count,
                                    kernel_initializer=kernel_initializer), name)[0]
 

@@ -244,11 +244,11 @@ def cache_append(ck, cv, k_new, v_new, depth, active,
         in_specs=[
             pl.BlockSpec(memory_space=pltpu.VMEM),   # k_new
             pl.BlockSpec(memory_space=pltpu.VMEM),   # v_new
-            pl.BlockSpec(memory_space=pltpu.ANY),    # ck
-            pl.BlockSpec(memory_space=pltpu.ANY),    # cv
+            pl.BlockSpec(memory_space=pl.ANY),    # ck
+            pl.BlockSpec(memory_space=pl.ANY),    # cv
         ],
-        out_specs=(pl.BlockSpec(memory_space=pltpu.ANY),
-                   pl.BlockSpec(memory_space=pltpu.ANY)),
+        out_specs=(pl.BlockSpec(memory_space=pl.ANY),
+                   pl.BlockSpec(memory_space=pl.ANY)),
         scratch_shapes=[pltpu.VMEM((KV, 16, D), ck.dtype),
                         pltpu.VMEM((KV, 16, D), cv.dtype),
                         pltpu.SemaphoreType.DMA(()),

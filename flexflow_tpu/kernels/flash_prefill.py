@@ -1,0 +1,349 @@
+"""Length-tiled flash-prefill attention (Pallas TPU).
+
+Chunked-prefill attention whose VMEM footprint is independent of the
+cache length: the grid walks (row, C-tile, S-tile) with a running-
+softmax accumulator carried across a (row, C-tile)'s S-tiles — the
+flash_decode kernel (kernels/flash_decode.py) extended from one query
+per row to a tile of TC queries, covering the reference's prompt-phase
+attention (/root/reference/src/ops/inc_multihead_self_attention.cu:902
+compute_attention_kernel_prompt, a batched GEMM over the prompt whose
+scores materialize per request) without materializing [C, S] logits in
+HBM.
+
+Why this exists (r4, chip-measured): at 1.4B/8k the XLA prefill attend
+costs ~3.6 ms per 1024 positions of attend bucket per 512-token chunk —
+the f32 [C, H, S] logits round-trip through HBM twice (write + softmax
+read).  The flash kernel keeps logits in VMEM, reading only the K/V
+tiles (~2 KB/position), which turns the whole 8k prompt's attention
+from ~400 ms into ~10 ms and roughly halves long-prompt TTFT.
+
+Layouts (no in-kernel relayout — the r3 lesson):
+- cache stays the serving-native ``[R, KV, S, D]``: K/V tiles arrive
+  ``[1, KV, TS, D]`` with kv leading both dot operands.
+- q is pre-transposed ONCE on the XLA side to ``[R, KV, G, C, D]`` so a
+  q block reshapes to ``[KV, G*TC, D]`` contiguously (transposing the
+  small q tensor in XLA is ~free; transposing per-tile in VMEM is not).
+
+Per-(row, C-tile) tile pruning: queries in C-tile c attend positions
+<= depth_r + c_end, so a scalar-prefetch clamped index map re-requests
+the same K/V block for every S-tile past the tile's last needed one;
+Mosaic skips the duplicate DMA and @pl.when skips the compute.  Rows
+whose prompt span ends before the C-tile prune to a single tile.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+def _kernel(last_ref, depth_ref, ntok_ref, act_ref,   # scalar prefetch
+            q_ref, k_ref, v_ref,                      # blocks
+            o_ref,                                    # out
+            m_sc, l_sc, acc_sc,                       # scratch
+            *, ts: int, tc: int, kv: int, g: int, d: int,
+            s_total: int, scale: float):
+    from jax.experimental import pallas as pl
+
+    r = pl.program_id(0)
+    c = pl.program_id(1)
+    t = pl.program_id(2)
+    nt = pl.num_programs(2)
+    rows = kv * g * tc
+
+    @pl.when(t == 0)
+    def _init():
+        m_sc[:] = jnp.full_like(m_sc, -1e30)
+        l_sc[:] = jnp.zeros_like(l_sc)
+        acc_sc[:] = jnp.zeros_like(acc_sc)
+
+    @pl.when(t <= last_ref[r, c])
+    def _step():
+        qv = q_ref[:].reshape(kv, g * tc, d)
+        kt = k_ref[:].reshape(kv, ts, d)
+        vt = v_ref[:].reshape(kv, ts, d)
+        # logits[kv, g*tc, ts] = qv . kt (batch kv; contract d)
+        logits = jax.lax.dot_general(
+            qv, kt, (((2,), (2,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32) * scale
+        # causal + query-validity mask.  Query at lane (g_, ci) sits at
+        # absolute position depth + c*tc + ci and is real iff
+        # c*tc + ci < ntok; key j sits at absolute position t*ts + j.
+        ci = jax.lax.broadcasted_iota(
+            jnp.int32, (g, tc, ts), 1).reshape(g * tc, ts)
+        sj = t * ts + jax.lax.broadcasted_iota(
+            jnp.int32, (g, tc, ts), 2).reshape(g * tc, ts)
+        qpos = depth_ref[r] + c * tc + ci
+        ok = ((sj <= qpos) & (c * tc + ci < ntok_ref[r])
+              & (act_ref[r] > 0))
+        logits = jnp.where(ok[None], logits, -1e30)
+        l2 = logits.reshape(rows, ts)
+        tile_max = jnp.max(l2, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_sc[:], tile_max)
+        alpha = jnp.exp(m_sc[:] - m_new)
+        # fully-masked lanes keep m_new at the -1e30 fill; force p to 0
+        # so l stays 0 and the finish-guard zeros the output
+        p = jnp.where(m_new > -1e29, jnp.exp(l2 - m_new), 0.0)
+        l_sc[:] = l_sc[:] * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        m_sc[:] = m_new
+        # vt's out-of-range pad columns (partial final S tile) may hold
+        # NaN; p is 0 there but 0*NaN = NaN, so zero them explicitly
+        col_ok = (t * ts + jax.lax.broadcasted_iota(
+            jnp.int32, (1, ts, 1), 1)) < s_total
+        vt = jnp.where(col_ok, vt, 0)
+        pv = jax.lax.dot_general(
+            p.reshape(kv, g * tc, ts).astype(vt.dtype), vt,
+            (((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32)
+        acc_sc[:] = acc_sc[:] * alpha + pv.reshape(rows, d)
+
+    @pl.when(t == nt - 1)
+    def _finish():
+        l = l_sc[:]
+        l = jnp.where(l == 0, 1.0, l)          # invalid queries: zeros
+        o_ref[:] = (acc_sc[:] / l).reshape(1, kv, g, tc, d).astype(
+            o_ref.dtype)
+
+
+def _pick_tiles(C: int, S: int, KV: int, G: int, D: int):
+    """C tile bounded by the f32 logits temp (KVG*TC*TS) + acc staying
+    comfortably inside scoped VMEM next to the double-buffered K/V
+    tiles; S tile as in flash_decode."""
+    from ..kernels.flash_decode import _pick_ts
+
+    ts = _pick_ts(S, KV, D)
+    budget = 6 * 1024 * 1024                   # logits + p f32 temps
+    tc = C
+    while tc > 16 and KV * G * tc * ts * 2 * 4 > budget:
+        tc //= 2
+    return tc, ts
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("scale", "interpret", "tc", "ts",
+                                    "s_bound"))
+def flash_prefill_attend(q, ck, cv, depth, ntok, active, scale: float,
+                         interpret: bool = False, tc=None, ts=None,
+                         s_bound=None):
+    """q [R,C,H,D] against cache [R,KV,S,D], causal at per-row offset
+    ``depth`` (query c attends cache positions <= depth[r]+c, queries
+    c >= ntok[r] and inactive rows produce zeros) -> [R,C,H,D].
+
+    ``s_bound``: static upper bound on attended positions (the host's
+    attend bucket, >= every depth+ntok).  It bounds the GRID, not just
+    the mask: without it a shallow chunk still cycles cdiv(S, ts) grid
+    steps per (row, C-tile) whose pruned programs cost ~1-2 us each —
+    at 24 layers x 8 C-tiles that fixed overhead erased the kernel's
+    win on the early chunks of a long prompt.
+
+    The caller scatters the chunk's K/V into the cache FIRST
+    (positions [depth, depth+ntok)), mirroring the jnp path
+    (ops/serving_attention.py _scatter_chunk then _attend).
+    """
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    R, C, H, D = q.shape
+    KV, S = ck.shape[1], ck.shape[2]
+    G = H // KV
+    assert H == KV * G and ck.shape == cv.shape == (R, KV, S, D)
+    if tc is None or ts is None:
+        tc0, ts0 = _pick_tiles(C, S, KV, G, D)
+        tc, ts = tc or tc0, ts or ts0
+    assert C % tc == 0, (C, tc)
+    nc = C // tc
+    nt = pl.cdiv(min(s_bound, S) if s_bound else S, ts)
+    depth = depth.astype(jnp.int32)
+    ntok = ntok.astype(jnp.int32)
+    active = active.astype(jnp.int32)
+    # last S-tile each (row, C-tile) needs: its highest real query sits
+    # at depth + min((c+1)*tc, ntok) - 1.  C-tiles past the row's span
+    # (or inactive rows) clamp to tile 0 — one DMA, compute skipped.
+    qmax = jnp.minimum((jnp.arange(nc, dtype=jnp.int32) + 1) * tc,
+                       ntok[:, None])                      # [R, NC]
+    has_q = (jnp.arange(nc, dtype=jnp.int32) * tc < ntok[:, None])
+    last = jnp.where(has_q & (active[:, None] > 0),
+                     jnp.clip((depth[:, None] + qmax - 1) // ts,
+                              0, nt - 1), 0).astype(jnp.int32)
+
+    # pre-transpose q once in XLA: [R,C,H,D] -> [R,KV,G,C,D]
+    qt = q.reshape(R, C, KV, G, D).transpose(0, 2, 3, 1, 4)
+
+    kernel = functools.partial(_kernel, ts=ts, tc=tc, kv=KV, g=G, d=D,
+                               s_total=S, scale=float(scale))
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=4,
+        grid=(R, nc, nt),
+        in_specs=[
+            pl.BlockSpec((1, KV, G, tc, D),
+                         lambda r, c, t, *_: (r, 0, 0, c, 0)),
+            pl.BlockSpec((1, KV, ts, D),
+                         lambda r, c, t, last, *_: (
+                             r, 0, jnp.minimum(t, last[r, c]), 0)),
+            pl.BlockSpec((1, KV, ts, D),
+                         lambda r, c, t, last, *_: (
+                             r, 0, jnp.minimum(t, last[r, c]), 0)),
+        ],
+        out_specs=pl.BlockSpec((1, KV, G, tc, D),
+                               lambda r, c, t, *_: (r, 0, 0, c, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((KV * G * tc, 1), jnp.float32),   # running max
+            pltpu.VMEM((KV * G * tc, 1), jnp.float32),   # running sum
+            pltpu.VMEM((KV * G * tc, D), jnp.float32),   # accumulator
+        ],
+    )
+    out = pl.pallas_call(
+        kernel, grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((R, KV, G, C, D), q.dtype),
+        interpret=interpret,
+    )(last, depth, ntok, active, qt, ck, cv)
+    # [R,KV,G,C,D] -> [R,C,H,D]
+    return out.transpose(0, 3, 1, 2, 4).reshape(R, C, H, D)
+
+
+def _append_kernel(base_ref, off_ref, ntok_ref, act_ref,  # scalar prefetch
+                   kal_ref, val_ref,             # VMEM [R, KV, W, D]
+                   ck_hbm, cv_hbm,               # ANY (aliased inputs)
+                   ck_out, cv_out,               # aliased outputs
+                   win_k, win_v, sem_k, sem_v):
+    """Per-row in-place chunk append: overlay the row's 16-aligned
+    window [base, base+W) with the pre-aligned new K/V on positions
+    [off, off+ntok) (window-relative).  Same rationale as
+    flash_decode._append_kernel: with both the append and the attend as
+    Pallas calls the cache never crosses an XLA layout boundary (XLA
+    prefers S-major for its own scatter and inserts whole-cache relayout
+    copies at custom-call boundaries — measured ~9 ms/step at 1.4B/8k)."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    r = pl.program_id(0)
+    W = win_k.shape[1]
+
+    @pl.when(act_ref[r] > 0)
+    def _():
+        # base16*16 keeps the S-offset PROVABLY divisible by the sublane
+        # tiling (a raw scalar-prefetch offset fails Mosaic's
+        # divisibility check on the memref slice)
+        b = base_ref[r] * 16
+        ink = pltpu.make_async_copy(
+            ck_out.at[r, :, pl.ds(b, W), :], win_k, sem_k)
+        inv = pltpu.make_async_copy(
+            cv_out.at[r, :, pl.ds(b, W), :], win_v, sem_v)
+        ink.start()
+        inv.start()
+        ink.wait()
+        inv.wait()
+        jj = jax.lax.broadcasted_iota(jnp.int32, (1, W, 1), 1)
+        sel = (jj >= off_ref[r]) & (jj < off_ref[r] + ntok_ref[r])
+        # align the zero-padded chunk to the window offset with a
+        # dynamic sublane rotate (entry j of the rolled chunk is
+        # chunk[j - off]; wrapped entries land outside sel's range) —
+        # doing this shift in XLA was a take_along_axis gather measured
+        # at ~1.5 ms/layer, ~60% of a whole flash prefill step.  The
+        # rotate is per-kv-head 2D (tpu.dynamic_rotate rejects 3D
+        # vectors; kv is statically small) on f32 staging (it also
+        # rejects 16-bit data — the chunk is shipped f32 and cast on
+        # the overlay, exact for bf16-derived values).
+        kv = win_k.shape[0]
+        for i in range(kv):
+            win_k[i] = jnp.where(
+                sel[0],
+                pltpu.roll(kal_ref[r, i], off_ref[r], 0).astype(
+                    win_k.dtype),
+                win_k[i])
+            win_v[i] = jnp.where(
+                sel[0],
+                pltpu.roll(val_ref[r, i], off_ref[r], 0).astype(
+                    win_v.dtype),
+                win_v[i])
+        outk = pltpu.make_async_copy(
+            win_k, ck_out.at[r, :, pl.ds(b, W), :], sem_k)
+        outv = pltpu.make_async_copy(
+            win_v, cv_out.at[r, :, pl.ds(b, W), :], sem_v)
+        outk.start()
+        outv.start()
+        outk.wait()
+        outv.wait()
+
+
+def chunk_append(ck, cv, k_new, v_new, depth, ntok, active,
+                 interpret: bool = False):
+    """In-place (aliased) chunk KV append on [R,KV,S,D] caches via async
+    DMA — the Pallas twin of _scatter_chunk for the flash-prefill path.
+
+    k_new/v_new arrive [R, C, KV, D] (projection layout); XLA only
+    transposes and zero-pads them to the window extent (cheap, fused),
+    while the per-row shift to the 16-aligned window offset happens
+    inside the kernel as a dynamic sublane rotate; the kernel does a
+    masked overlay read-modify-write of the [base, base+C+32) window."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    R, KV, S, D = ck.shape
+    C = k_new.shape[1]
+    W = C + 32
+    assert S % 16 == 0 and W <= S, (S, W)
+    depth = depth.astype(jnp.int32)
+    ntok = jnp.minimum(ntok.astype(jnp.int32), C)
+    active = active.astype(jnp.int32)
+    base = jnp.minimum((depth // 16) * 16, S - W)
+    off = depth - base                                   # [R] in [0, 32]
+    pad = [(0, 0), (0, 0), (0, W - C), (0, 0)]
+    # f32 staging: the in-kernel dynamic rotate needs 32-bit data
+    k_al = jnp.pad(k_new.transpose(0, 2, 1, 3),          # [R, KV, W, D]
+                   pad).astype(jnp.float32)
+    v_al = jnp.pad(v_new.transpose(0, 2, 1, 3),
+                   pad).astype(jnp.float32)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=4,
+        grid=(R,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.VMEM),       # k_al
+            pl.BlockSpec(memory_space=pltpu.VMEM),       # v_al
+            pl.BlockSpec(memory_space=pl.ANY),           # ck
+            pl.BlockSpec(memory_space=pl.ANY),           # cv
+        ],
+        out_specs=(pl.BlockSpec(memory_space=pl.ANY),
+                   pl.BlockSpec(memory_space=pl.ANY)),
+        scratch_shapes=[pltpu.VMEM((KV, W, D), ck.dtype),
+                        pltpu.VMEM((KV, W, D), cv.dtype),
+                        pltpu.SemaphoreType.DMA(()),
+                        pltpu.SemaphoreType.DMA(())],
+    )
+    return pl.pallas_call(
+        _append_kernel, grid_spec=grid_spec,
+        out_shape=(jax.ShapeDtypeStruct(ck.shape, ck.dtype),
+                   jax.ShapeDtypeStruct(cv.shape, cv.dtype)),
+        input_output_aliases={6: 0, 7: 1},   # +4 scalar-prefetch args
+        interpret=interpret,
+    )(base // 16, off, ntok, active, k_al, v_al, ck, cv)
+
+
+def flash_prefill_attention(q, k_new, v_new, ck, cv, depth, ntok,
+                            active, scale: float,
+                            interpret: bool = False, s_bound=None):
+    """Scatter-then-attend prefill step (drop-in for the op layer):
+    writes the chunk's K/V at each active row's [depth, depth+ntok)
+    (in place, Pallas DMA), then runs the length-tiled attention.
+    q [R,C,H,D], k_new/v_new [R,C,KV,D], caches [R,KV,S,D];
+    ``s_bound`` = the host's static attend bucket (grid bound).
+    Returns (out [R,C,H,D], ck, cv)."""
+    ck, cv = chunk_append(ck, cv, k_new, v_new, depth, ntok, active,
+                          interpret=interpret)
+    out = flash_prefill_attend(q, ck, cv, depth, ntok, active, scale,
+                               interpret=interpret, s_bound=s_bound)
+    return out, ck, cv
+
+
+def prefill_path_ok(C: int, ck, mesh) -> bool:
+    """Shape gate for the production op: multi-token chunk on an
+    unsharded cache with lane-aligned head dim and a 16-divisible chunk
+    (the append window arithmetic).  WHETHER flash beats the XLA attend
+    is the host's cost decision (inference_manager.flash_prefill_wins)
+    — this only says the kernel can run."""
+    R, KV, S, D = ck.shape
+    return (C >= 16 and C % 16 == 0 and mesh is None
+            and D % 128 == 0 and S % 16 == 0 and C + 32 <= S)

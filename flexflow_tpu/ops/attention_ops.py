@@ -25,7 +25,7 @@ from .registry import OpDef, ParamSpec, register
 
 def mha_attention(q, k, v, *, causal=False, mask=None, scale=None,
                   dropout_rate=0.0, dropout_rng=None,
-                  sliding_window=None):
+                  sliding_window=None, bias=None):
     """Core attention: q [B, H, Sq, D], k/v [B, KV, Sk, D] ->
     [B, H, Sq, D].  H = KV * G (GQA: query heads grouped per KV head, no
     KV duplication in memory — the layout serving_attention uses).
@@ -34,7 +34,9 @@ def mha_attention(q, k, v, *, causal=False, mask=None, scale=None,
     reference's cuDNN attnDropout, src/ops/attention.cc).
     ``sliding_window``: with ``causal``, restrict each query to the last
     ``sliding_window`` positions (HF Mistral convention:
-    0 <= q_pos - k_pos < window)."""
+    0 <= q_pos - k_pos < window).
+    ``bias``: additive logits bias [H, Sq, Sk] (T5-style relative
+    position bias, applied before the mask)."""
     d = q.shape[-1]
     B, H, Sq, _ = q.shape
     KV = k.shape[1]
@@ -43,6 +45,8 @@ def mha_attention(q, k, v, *, causal=False, mask=None, scale=None,
     qg = q.reshape(B, KV, G, Sq, d)
     logits = jnp.einsum("bkgqd,bksd->bkgqs", qg, k,
                         preferred_element_type=jnp.float32) * scale
+    if bias is not None:
+        logits = logits + bias.reshape(KV, G, *bias.shape[-2:])[None]
     sk = logits.shape[-1]
     if causal:
         span = jnp.arange(sk)[None, :]
@@ -65,6 +69,43 @@ def mha_attention(q, k, v, *, causal=False, mask=None, scale=None,
                      preferred_element_type=jnp.float32)
     # -1: v's head dim may differ from q's (vdim != kdim)
     return out.reshape(B, H, Sq, -1).astype(v.dtype)
+
+
+def t5_relative_buckets(rel_pos, num_buckets: int, max_distance: int,
+                        bidirectional: bool = True):
+    """Bucketize relative positions the T5 way (log-spaced beyond
+    num_buckets//4 exact offsets; bidirectional splits the buckets by
+    sign).  ``rel_pos`` = key_pos - query_pos.  Mirrors the scheme of
+    the T5 paper as implemented by HF T5Attention._relative_position_
+    bucket — needed so ported mt5-family checkpoints reproduce exactly
+    (the reference aligns an mt5 encoder end-to-end,
+    tests/align/mt5_encoder/)."""
+    n = num_buckets
+    ret = jnp.zeros_like(rel_pos)
+    if bidirectional:
+        n //= 2
+        ret = ret + (rel_pos > 0).astype(rel_pos.dtype) * n
+        rel_pos = jnp.abs(rel_pos)
+    else:
+        rel_pos = -jnp.minimum(rel_pos, 0)
+    max_exact = n // 2
+    is_small = rel_pos < max_exact
+    scaled = (jnp.log(jnp.maximum(rel_pos, 1).astype(jnp.float32)
+                      / max_exact)
+              / np.log(max_distance / max_exact) * (n - max_exact))
+    large = jnp.minimum(max_exact + scaled.astype(rel_pos.dtype), n - 1)
+    return ret + jnp.where(is_small, rel_pos, large)
+
+
+def t5_position_bias(table, sq: int, sk: int, num_buckets: int,
+                     max_distance: int, bidirectional: bool = True):
+    """Relative position bias [H, Sq, Sk] from a learned bucket table
+    [num_buckets, H]."""
+    rel = (jnp.arange(sk)[None, :] - jnp.arange(sq)[:, None]).astype(
+        jnp.int32)
+    buckets = t5_relative_buckets(rel, num_buckets, max_distance,
+                                  bidirectional)
+    return table[buckets].transpose(2, 0, 1)          # [H, Sq, Sk]
 
 
 @register
@@ -105,6 +146,9 @@ class MultiHeadAttention(OpDef):
                    ParamSpec("bv", (kv, vdim // h), dt)]
         if attrs.get("final_bias", False):
             ps.append(ParamSpec("bo", (e,), dt))
+        t5 = attrs.get("t5_bias")
+        if t5:
+            ps.append(ParamSpec("rel_bias", (t5["num_buckets"], h), dt))
         return ps
 
     def forward(self, params, inputs, attrs, ctx):
@@ -129,7 +173,17 @@ class MultiHeadAttention(OpDef):
         if ctx.training and rate > 0.0:
             assert ctx.rng is not None, "attention dropout needs ctx.rng"
             drop_rng = jax.random.fold_in(ctx.rng, attrs["seed_offset"])
+        bias = None
+        t5 = attrs.get("t5_bias")
+        if t5:
+            bias = t5_position_bias(
+                params["rel_bias"].astype(jnp.float32),
+                q.shape[2], k.shape[2], t5["num_buckets"],
+                t5["max_distance"], t5.get("bidirectional", True))
+        # T5 folds the 1/sqrt(d) into init: scale_qk=False means raw QK
+        scale = None if attrs.get("scale_qk", True) else 1.0
         out = mha_attention(q, k, v, causal=attrs.get("causal", False),
+                            scale=scale, bias=bias,
                             dropout_rate=rate if ctx.training else 0.0,
                             dropout_rng=drop_rng,
                             sliding_window=attrs.get("sliding_window"))

@@ -294,6 +294,17 @@ class IncMultiHeadSelfAttention(_ServingAttentionBase):
                 interpret=(flash_mode == "interpret"))
             self._store(ctx, layer, ck, cv)
             return [self._output(params, out1[:, None], attrs, ctx)]
+        flash_pre = self._flash_prefill_ok(attrs, ctx, C, ck)
+        if flash_pre:
+            from ..kernels.flash_prefill import flash_prefill_attention
+
+            out, ck, cv = flash_prefill_attention(
+                q, k, v, ck, cv, bc["first_depth"], bc["row_tokens"],
+                bc["active"].astype(jnp.int32), self._scale(attrs),
+                interpret=(flash_pre == "interpret"),
+                s_bound=ctx.attend_len)
+            self._store(ctx, layer, ck, cv)
+            return [self._output(params, out, attrs, ctx)]
         ck = _scatter_chunk(ck, k, bc["first_depth"], bc["active"])
         cv = _scatter_chunk(cv, v, bc["first_depth"], bc["active"])
         self._store(ctx, layer, ck, cv)
@@ -327,6 +338,28 @@ class IncMultiHeadSelfAttention(_ServingAttentionBase):
         if mode == "0" or not getattr(ctx, "use_flash", False):
             return False
         ok = (flash_path_ok(C, ck, getattr(ctx, "mesh", None))
+              and not attrs.get("position_bias", False)
+              and (mode == "interpret" or pallas_tpu_available()))
+        return (mode if mode == "interpret" else True) if ok else False
+
+    @staticmethod
+    def _flash_prefill_ok(attrs, ctx, C, ck):
+        """Gate for the length-tiled flash-prefill kernel
+        (kernels/flash_prefill.py).  The HOST decides per step whether
+        the kernel beats the XLA prefill attend for this batch's attend
+        bucket (inference_manager.flash_prefill_wins sets
+        ctx.use_flash); this checks the shapes the kernel supports
+        (16-divisible multi-token chunk, unsharded cache, no ALiBi,
+        lane-aligned head dim).  FF_FLASH_PREFILL=interpret runs the
+        kernel interpreted regardless of platform; =0 disables."""
+        import os
+
+        from ..kernels.flash_prefill import prefill_path_ok
+
+        mode = os.environ.get("FF_FLASH_PREFILL", "auto")
+        if mode == "0" or not getattr(ctx, "use_flash", False):
+            return False
+        ok = (prefill_path_ok(C, ck, getattr(ctx, "mesh", None))
               and not attrs.get("position_bias", False)
               and (mode == "interpret" or pallas_tpu_available()))
         return (mode if mode == "interpret" else True) if ok else False

@@ -217,6 +217,40 @@ def flash_wins(bc, span: int, alloc_len: int, tile: int = 1024) -> bool:
     return flash_bytes * FLASH_BYTE_PENALTY < xla_bytes
 
 
+# Attend-bucket size above which the flash-prefill kernel dispatches.
+# r4 chip measurement (1.4B, 512-token chunks): the XLA prefill attend
+# round-trips f32 [C, H, S] logits through HBM (~3.6 ms per 1024 bucket
+# positions per chunk) while the kernel reads only K/V tiles (~8x fewer
+# bytes), so flash wins from the first kilobucket; below it both paths
+# are sub-ms and the kernel's fixed per-call cost dominates.
+FLASH_PREFILL_MIN_BUCKET = 1024
+
+
+def flash_prefill_wins(bc, chunk: int, alloc_len: int) -> bool:
+    """Host-side cost dispatch between the XLA prefill attend (HBM
+    round trip of the [C, H, bucket] f32 logits) and the length-tiled
+    flash-prefill kernel (kernels/flash_prefill.py, logits stay in
+    VMEM).  True once the batch's attend bucket is big enough that the
+    logits traffic dwarfs the kernel's fixed cost."""
+    import os
+
+    mode = os.environ.get("FF_FLASH_PREFILL", "auto")
+    if mode == "0":
+        return False
+    # kernel shape limits (prefill_path_ok's host-visible half): the
+    # append window needs a 16-divisible chunk and C+32 cache slack
+    if chunk < 16 or chunk % 16 or chunk + 32 > alloc_len:
+        return False
+    act = np.asarray(bc.request_available)
+    if not act.any():
+        return False
+    if mode in ("1", "force", "interpret"):
+        return True   # forced on (tests / manual override)
+    depths = np.asarray(bc.first_token_depth)[act] + chunk
+    bucket = pow2_bucket(int(depths.max()), alloc_len) or alloc_len
+    return bucket >= FLASH_PREFILL_MIN_BUCKET
+
+
 def fuse_qkv(model) -> None:
     """Concatenate each serving-attention layer's wq/wk/wv ([E,H,D] +
     2x[E,KV,D]) into one wqkv [E,H+2KV,D] (and biases into bqkv) so the
@@ -661,12 +695,20 @@ class InferenceManager:
             return pipeline_inference(self, record, model_id, batch, rng)
         # bound the attended cache prefix for this step (sharded caches
         # skip the slice inside the op, so don't fork jit variants there);
-        # ragged decode batches dispatch to the flash kernel instead
+        # ragged decode batches dispatch to the flash kernel, and big-
+        # bucket prefill chunks to the flash-prefill kernel
+        use_flash = (
+            (bc.chunk == 1 and record["mesh"] is None
+             and flash_wins(bc, 1, record["alloc_len"],
+                            _record_flash_tile(record)))
+            or (bc.chunk > 1 and record["mesh"] is None
+                and flash_prefill_wins(bc, bc.chunk,
+                                       record["alloc_len"])))
+        # attend_len serves both paths: the XLA attend slices the cache
+        # to the bucket, the flash-prefill kernel bounds its GRID with it
+        # (pruned-but-cycled grid steps are not free)
         attend_len = (attend_bucket(bc, bc.chunk, record["alloc_len"])
                       if record["mesh"] is None else None)
-        use_flash = (bc.chunk == 1 and record["mesh"] is None
-                     and flash_wins(bc, 1, record["alloc_len"],
-                                    _record_flash_tile(record)))
         step = self._get_step(record, bc.chunk, reorder, attend_len,
                               use_flash)
         outs, record["caches"] = step(record["model"].params,

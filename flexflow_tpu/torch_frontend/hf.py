@@ -46,6 +46,48 @@ def _patched_masks(module):
 
 
 @contextlib.contextmanager
+def _t5_leaf_metas(module):
+    """Register fx meta overrides for T5/mt5-style leaves.
+
+    HFTracer infers each proxy's dtype/shape by running the module on
+    meta tensors; T5Attention's forward throws under meta execution
+    (cache/position plumbing) and T5LayerNorm's throws on its real cpu
+    ``weight`` times a meta input.  The tracer swallows the errors, the
+    proxies carry no metadata, and the first
+    ``hidden_states.dtype == float16`` check downstream dies with a
+    control-flow TraceError.  The overrides declare what each leaf
+    returns: the attention leaf yields hidden states of the input shape
+    plus None slots, the norm leaf is shape/dtype-identity."""
+    from transformers.utils import fx as hffx
+
+    def attn_meta(mod, hidden_states, *a, **k):
+        return (hidden_states, None, None)
+
+    def identity_meta(mod, hidden_states, *a, **k):
+        return hidden_states
+
+    added = []
+    for mm in module.modules():
+        cls = type(mm)
+        if cls in hffx._MANUAL_META_OVERRIDES or cls in (
+                c for c, _ in added):
+            continue
+        if (all(hasattr(mm, a) for a in ("q", "k", "v", "o"))
+                and hasattr(mm, "relative_attention_num_buckets")):
+            added.append((cls, attn_meta))
+        elif (cls.__name__.endswith(("RMSNorm", "LayerNorm"))
+              and hasattr(mm, "variance_epsilon")):
+            added.append((cls, identity_meta))
+    for cls, fn in added:
+        hffx._MANUAL_META_OVERRIDES[cls] = fn
+    try:
+        yield
+    finally:
+        for cls, _ in added:
+            hffx._MANUAL_META_OVERRIDES.pop(cls, None)
+
+
+@contextlib.contextmanager
 def _narrowed_forward(module, input_names: Sequence[str]):
     """Modern transformers forwards end in ``**kwargs: Unpack[...]``,
     which torch.fx's bytecode patching cannot rebuild (co_varnames too
@@ -75,20 +117,32 @@ def _narrowed_forward(module, input_names: Sequence[str]):
 
 def hf_symbolic_trace(module, input_names: Sequence[str] = ("input_ids",),
                       extra_leaf_suffixes: Sequence[str] = (
-                          "Attention", "RotaryEmbedding", "RMSNorm")):
+                          "Attention", "RotaryEmbedding", "RMSNorm",
+                          "LayerNorm")):
     """Trace an HF transformers model into a GraphModule suitable for
     :class:`flexflow_tpu.torch_frontend.PyTorchModel` replay: attention
-    modules stay leaves, mask construction is stubbed."""
+    modules stay leaves, mask construction is stubbed.  T5-style
+    WRAPPER blocks (T5LayerSelfAttention / T5LayerFF — norm + inner op +
+    residual) must trace THROUGH so the residual adds replay op-by-op;
+    only the inner T5Attention / T5LayerNorm are leaves."""
     from transformers.utils import fx as hffx
 
     suffixes = tuple(extra_leaf_suffixes)
+    wrappers = ("LayerSelfAttention", "LayerCrossAttention", "LayerFF")
 
     class _Tracer(hffx.HFTracer):
         def is_leaf_module(self, mod, name):
-            if type(mod).__name__.endswith(suffixes):
+            cls = type(mod).__name__
+            if cls.endswith(wrappers):
+                return False
+            if cls.endswith(suffixes):
                 return True
             return super().is_leaf_module(mod, name)
 
-    with _patched_masks(module), _narrowed_forward(module, input_names):
+    with _patched_masks(module), _narrowed_forward(module, input_names), \
+            _t5_leaf_metas(module):
+        # disable_check: the whitelist omits some traceable classes
+        # (e.g. T5EncoderModel while T5Model is listed); unsupported
+        # graphs still fail loudly at replay via UnsupportedTorchOp
         return hffx.symbolic_trace(module, input_names=list(input_names),
-                                   tracer_cls=_Tracer)
+                                   tracer_cls=_Tracer, disable_check=True)

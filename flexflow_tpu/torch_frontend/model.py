@@ -55,11 +55,23 @@ def _is_llama_attention(m) -> bool:
         and hasattr(m, "config")
 
 
+def _is_t5_attention(m) -> bool:
+    """Duck-typed T5/mt5-family attention leaf: q/k/v/o Linear
+    projections (no _proj suffix), bucketed relative position bias
+    (transformers.models.t5.modeling_t5 T5Attention / MT5Attention —
+    the family the reference aligns end-to-end,
+    tests/align/mt5_encoder/)."""
+    return all(hasattr(m, a) for a in ("q", "k", "v", "o")) \
+        and hasattr(m, "relative_attention_num_buckets")
+
+
 def _is_hf_rmsnorm(m) -> bool:
-    """Duck-typed transformers RMSNorm (MistralRMSNorm etc.): a single
-    ``weight`` and a ``variance_epsilon``."""
-    return type(m).__name__.endswith("RMSNorm") and hasattr(m, "weight") \
-        and hasattr(m, "variance_epsilon")
+    """Duck-typed transformers RMS norm: a single ``weight`` and a
+    ``variance_epsilon`` (MistralRMSNorm etc.; T5LayerNorm is the same
+    computation under a LayerNorm name — nn.LayerNorm carries ``eps``,
+    not ``variance_epsilon``, so the duck-type cannot misfire)."""
+    return (type(m).__name__.endswith(("RMSNorm", "LayerNorm"))
+            and hasattr(m, "weight") and hasattr(m, "variance_epsilon"))
 
 
 def _pair(v):
@@ -162,6 +174,27 @@ class PyTorchModel:
                 x.detach().cpu().numpy() if torch.is_tensor(x) else x,
                 np.int32))
             return ff.embedding(idx, m.num_embeddings, m.embedding_dim)
+        if _is_t5_attention(m):
+            # T5/mt5 encoder self-attention leaf: unscaled QK (the
+            # 1/sqrt(d) is folded into init), bucketed relative position
+            # bias shared from the first block's learned table, no
+            # biases.  The leaf's traced mask input is ignored — with no
+            # padding the extended mask is identically zero.  Returns
+            # enough tuple slots for any position_bias/cache getitem.
+            if getattr(m, "is_decoder", False):
+                raise UnsupportedTorchOp(
+                    "T5 decoder attention (causal + cross-attention "
+                    "threading); the encoder family is supported")
+            h = int(m.n_heads)
+            d = int(m.key_value_proj_dim)
+            y = ff.multihead_attention(
+                x, x, x, embed_dim=int(m.d_model), num_heads=h,
+                kdim=h * d, vdim=h * d, causal=False, scale_qk=False,
+                t5_bias=dict(
+                    num_buckets=int(m.relative_attention_num_buckets),
+                    max_distance=int(m.relative_attention_max_distance),
+                    bidirectional=True))
+            return (y, None, None, None)
         if _is_llama_attention(m):
             # LLaMA/Mistral-family leaf -> the framework op with GQA +
             # in-op RoPE + sliding window; the traced (cos, sin)
@@ -314,6 +347,21 @@ class PyTorchModel:
         if name in ("to", "type_as", "contiguous"):
             return args[0]
         if tgt is getattr:
+            if args[1] == "dtype" and isinstance(args[0], Tensor):
+                # resolve to the real torch dtype so downstream folded
+                # chains (T5Stack's `finfo(embeds.dtype).min` mask
+                # arithmetic) evaluate concretely
+                # DataType.HALF aliases BFLOAT16 (fftype.py: TPU half
+                # precision is bf16) — map it to torch.bfloat16, with
+                # FLOAT16 carrying true fp16
+                return {DataType.FLOAT: torch.float32,
+                        DataType.BFLOAT16: torch.bfloat16,
+                        DataType.FLOAT16: torch.float16,
+                        DataType.DOUBLE: torch.float64,
+                        DataType.INT32: torch.int32,
+                        DataType.INT64: torch.int64,
+                        DataType.BOOL: torch.bool}.get(
+                            args[0].spec.dtype, torch.float32)
             if args[1] in ("device", "dtype"):
                 return None     # placeholder; only feeds folded calls
             raise UnsupportedTorchOp(f"getattr {args[1]}")
@@ -369,6 +417,11 @@ class PyTorchModel:
             key = tgt if tgt in binary else name
             tensor_fn, scalar_fn = binary[key]
             a, b = args[0], args[1]
+            if (isinstance(a, (tuple, list))
+                    and isinstance(b, (tuple, list))):
+                # python sequence concatenation (HF blocks build output
+                # tuples with `(hidden,) + attention_outputs`)
+                return tuple(a) + tuple(b)
             if isinstance(b, Tensor) and isinstance(a, Tensor):
                 return tensor_fn(a, b)
             if isinstance(a, Tensor):
@@ -471,6 +524,32 @@ class PyTorchModel:
                     p["bv"] = b[2 * e:].reshape(h, d).copy()
                 if "c_proj.bias" in with_no_grad:
                     p["bo"] = with_no_grad["c_proj.bias"]
+                continue
+            if _is_t5_attention(m):
+                # q/k/v/o Linears ([out=H*D, in=E] torch layout, no
+                # biases) -> wq/wk/wv [E, H, D] / wo [H, D, E]; the
+                # relative-bias bucket table [num_buckets, H] comes from
+                # this leaf if it owns one, else from the stack's first
+                # block (HF computes it there once and threads the bias
+                # tensor down — replaying it per layer is the same bias)
+                h = int(m.n_heads)
+                e = int(m.d_model)
+                d = int(m.key_value_proj_dim)
+                p["wq"] = with_no_grad["q.weight"].T.reshape(e, h, d).copy()
+                p["wk"] = with_no_grad["k.weight"].T.reshape(e, h, d).copy()
+                p["wv"] = with_no_grad["v.weight"].T.reshape(e, h, d).copy()
+                p["wo"] = with_no_grad["o.weight"].T.reshape(h, d, e).copy()
+                if "relative_attention_bias.weight" in with_no_grad:
+                    p["rel_bias"] = with_no_grad[
+                        "relative_attention_bias.weight"]
+                else:
+                    owners = [mm for mm in self.module.modules()
+                              if getattr(mm, "has_relative_attention_bias",
+                                         False)
+                              and not getattr(mm, "is_decoder", False)]
+                    assert owners, "no relative_attention_bias table found"
+                    p["rel_bias"] = (owners[0].relative_attention_bias
+                                     .weight.detach().cpu().numpy().copy())
                 continue
             if _is_llama_attention(m):
                 # separate q/k/v/o Linears ([out, in] torch layout) ->

@@ -230,3 +230,99 @@ def test_flash_decode_vs_plain_softmax_reference(R, H, KV, D, S):
                                o2[act], atol=1e-4)
     # inactive rows: zeros by design
     np.testing.assert_array_equal(np.asarray(o1)[~act], 0)
+
+
+@pytest.mark.parametrize("R,C,H,KV,D,S", [(3, 64, 8, 2, 128, 640),
+                                          (2, 32, 4, 4, 128, 256),
+                                          (4, 16, 6, 3, 128, 336)])
+def test_flash_prefill_attention_matches_production(R, C, H, KV, D, S):
+    """The length-tiled flash-prefill kernel (C-query tiles, running
+    softmax over S tiles, per-(row, C-tile) pruning) matches the
+    PRODUCTION jnp ops (_scatter_chunk + _attend) on the valid query
+    span of active rows — ragged ntok, unaligned depths, partial final
+    S tiles, GQA groupings.  Queries past a row's ntok and inactive
+    rows are zeros by design (discarded either way)."""
+    import numpy as np
+
+    from flexflow_tpu.kernels.flash_prefill import flash_prefill_attention
+    from flexflow_tpu.ops.serving_attention import _attend, _scatter_chunk
+
+    rng = np.random.default_rng(0)
+    mk = lambda s: jnp.asarray(rng.standard_normal(s), jnp.float32)
+    q, kn, vn = mk((R, C, H, D)), mk((R, C, KV, D)), mk((R, C, KV, D))
+    ck, cv = mk((R, KV, S, D)), mk((R, KV, S, D))
+    depth = jnp.asarray(rng.integers(0, S - C - 33, R), jnp.int32)
+    ntok = jnp.asarray([C] + list(rng.integers(1, C + 1, R - 1)),
+                       jnp.int32)
+    active = jnp.asarray([1] * (R - 1) + [0], jnp.int32)
+    o1, k1, v1 = flash_prefill_attention(q, kn, vn, ck, cv, depth, ntok,
+                                         active, 0.125, interpret=True)
+    # production path: scatter whole chunk, causal mask to depth+c
+    ck2 = _scatter_chunk(ck, kn, depth, active > 0)
+    cv2 = _scatter_chunk(cv, vn, depth, active > 0)
+    span = jnp.arange(S)[None, None, :]
+    positions = depth[:, None] + jnp.arange(C)[None, :]
+    mask = (span <= positions[:, :, None]) & (active > 0)[:, None, None]
+    o2 = _attend(q, ck2, cv2, mask, 0.125)
+    o1n, o2n = np.asarray(o1), np.asarray(o2)
+    for r in range(R):
+        if not int(active[r]):
+            assert np.abs(o1n[r]).max() == 0.0
+            continue
+        n = int(ntok[r])
+        np.testing.assert_allclose(o1n[r, :n], o2n[r, :n], atol=1e-4)
+        # cache writes identical on the row's real span (the jnp scatter
+        # also writes the slack past ntok; the kernel correctly does not)
+        d0 = int(depth[r])
+        np.testing.assert_array_equal(
+            np.asarray(k1)[r, :, d0:d0 + n], np.asarray(ck2)[r, :, d0:d0 + n])
+        np.testing.assert_array_equal(
+            np.asarray(v1)[r, :, d0:d0 + n], np.asarray(cv2)[r, :, d0:d0 + n])
+        # positions outside the write window are untouched
+        np.testing.assert_array_equal(np.asarray(k1)[r, :, :d0],
+                                      np.asarray(ck)[r, :, :d0])
+
+
+def test_flash_prefill_in_model(monkeypatch):
+    """FF_FLASH_PREFILL=interpret forces the host dispatch on and runs
+    the kernel interpreted through the full serving stack on CPU — the
+    prompt spans multiple 16-divisible chunks, then decode proceeds on
+    the caches the kernel wrote.  Tokens must match the pure-XLA run
+    exactly."""
+    import numpy as np
+
+    from flexflow_tpu import FFConfig, Model
+    from flexflow_tpu.fftype import InferenceMode
+    from flexflow_tpu.models.llama import LLAMAConfig, create_llama_model
+    from flexflow_tpu.serving import InferenceManager, RequestManager
+
+    def gen(env):
+        if env:
+            monkeypatch.setenv("FF_FLASH_PREFILL", env)
+        else:
+            monkeypatch.delenv("FF_FLASH_PREFILL", raising=False)
+        cfg = LLAMAConfig(vocab_size=64, hidden_size=256,
+                          intermediate_size=128, num_hidden_layers=1,
+                          num_attention_heads=2, num_key_value_heads=2,
+                          max_position_embeddings=128)  # head_dim 128
+        model = Model(FFConfig(), name=f"fpre_{env}")
+        create_llama_model(model, cfg, mode=InferenceMode.INC_DECODING,
+                           max_requests=2)
+        model.params = model.init_params(jax.random.PRNGKey(3))
+        im = InferenceManager(model.config)
+        mid = im.compile_model_and_allocate_buffer(
+            model, max_requests=2, max_seq_length=96,
+            cache_dtype=np.float32)
+        rm = RequestManager(max_requests_per_batch=2,
+                            max_tokens_per_batch=32,
+                            max_sequence_length=96)
+        # 40-token prompt -> chunked prefill at C=32 then C=16 buckets;
+        # second row short (ragged ntok inside the chunk)
+        long_p = [int(x) for x in
+                  np.random.default_rng(0).integers(2, 60, 40)]
+        reqs = [rm.register_new_request(long_p, max_new_tokens=6),
+                rm.register_new_request([2, 8, 11], max_new_tokens=6)]
+        rm.generate_incr_decoding(im, mid, reqs)
+        return [r.tokens for r in reqs]
+
+    assert gen("interpret") == gen(None)
