@@ -24,6 +24,7 @@ lives in spec_infer.py and reuses this queue/slot machinery.
 from __future__ import annotations
 
 import dataclasses
+import os
 import time
 from typing import Any, Dict, List, Optional, Sequence
 
@@ -419,9 +420,28 @@ class RequestManager:
         # init consumes one budget slot, the k scan steps the rest
         k = pick_chunk(max(1, self._max_remaining_budget() - 1),
                        decode_block)
-        toks = np.asarray(im.decode_block(
+        toks_dev = im.decode_block(
             model_id, bc2, k, block_rng, init_tokens=init,
-            min_remaining=max(1, self._min_remaining_budget() - 1)))
+            min_remaining=max(1, self._min_remaining_budget() - 1))
+        if os.environ.get("FF_STREAM_FIRST_TOKEN", "0") == "1":
+            # surface the FIRST token while the block still runs: init
+            # IS each row's first generated token (the prefill sample,
+            # folded below as the block's entry 0), and its value
+            # depends only on the already-queued prefill — the tiny
+            # fetch completes as soon as prefill does, a decode block
+            # ahead of the block's own sync.  Costs one extra round
+            # trip per generation, so it is opt-in: a clear win on
+            # PCIe-attached chips (RTT << block time), roughly neutral
+            # over a network tunnel (chip A/B: TTFT -40..-120 ms,
+            # total +~RTT at 1.4B/8k with a 16-step block).
+            np.asarray(init)
+            im.host_syncs += 1
+            now = time.time()
+            for row, req in self.running.items():
+                if (bc2.request_available[row]
+                        and req.profile.first_token_time == 0.0):
+                    req.profile.first_token_time = now
+        toks = np.asarray(toks_dev)
         im.host_syncs += 1
         self._fold_decode_block(bc2, toks, handoff=True)
 

@@ -34,15 +34,31 @@ def _patched_masks(module):
     mod_cls = type(module)
     modeling = sys.modules[mod_cls.__module__]
     patched = []
-    for name in ("create_causal_mask", "create_sliding_window_causal_mask"):
+    for name in ("create_causal_mask", "create_sliding_window_causal_mask",
+                 "make_flex_block_causal_mask"):
         if hasattr(modeling, name):
             patched.append((name, getattr(modeling, name)))
             setattr(modeling, name, lambda *a, **k: None)
+    # stack-level mask METHODS (T5Stack._update_causal_mask, copied from
+    # GPTJ): control flow over proxied masks; the output only feeds
+    # attention leaves, which replay their masks natively
+    meth_patched = []
+    seen = set()
+    for mm in module.modules():
+        cls = type(mm)
+        if cls in seen:
+            continue
+        seen.add(cls)
+        if "_update_causal_mask" in cls.__dict__:
+            meth_patched.append((cls, cls._update_causal_mask))
+            cls._update_causal_mask = lambda self, *a, **k: None
     try:
         yield
     finally:
         for name, orig in patched:
             setattr(modeling, name, orig)
+        for cls, orig in meth_patched:
+            cls._update_causal_mask = orig
 
 
 @contextlib.contextmanager
@@ -66,17 +82,17 @@ def _t5_leaf_metas(module):
     def identity_meta(mod, hidden_states, *a, **k):
         return hidden_states
 
+    from .model import _is_hf_rmsnorm, _is_t5_attention
+
     added = []
     for mm in module.modules():
         cls = type(mm)
         if cls in hffx._MANUAL_META_OVERRIDES or cls in (
                 c for c, _ in added):
             continue
-        if (all(hasattr(mm, a) for a in ("q", "k", "v", "o"))
-                and hasattr(mm, "relative_attention_num_buckets")):
+        if _is_t5_attention(mm):
             added.append((cls, attn_meta))
-        elif (cls.__name__.endswith(("RMSNorm", "LayerNorm"))
-              and hasattr(mm, "variance_epsilon")):
+        elif _is_hf_rmsnorm(mm):
             added.append((cls, identity_meta))
     for cls, fn in added:
         hffx._MANUAL_META_OVERRIDES[cls] = fn

@@ -132,7 +132,9 @@ class PyTorchModel:
                         "hidden_states",
                         next(iter(node.kwargs.values()), None))
                 x = env[first.name] if hasattr(first, "name") else first
-                y = self._call_module(ffmodel, node, m, x)
+                kw = {k: (env[v.name] if hasattr(v, "name") else v)
+                      for k, v in node.kwargs.items()}
+                y = self._call_module(ffmodel, node, m, x, kw)
                 env[node.name] = y
                 lead = y[0] if isinstance(y, tuple) else y
                 if isinstance(lead, Tensor) and lead.owner_layer is not None:
@@ -151,9 +153,11 @@ class PyTorchModel:
         return out
 
     # ------------------------------------------------------------- modules
-    def _call_module(self, ff: Model, node, m, x):
+    def _call_module(self, ff: Model, node, m, x, kw=None):
         import torch
         import torch.nn as nn
+
+        kw = kw or {}
 
         if _is_hf_attention(m):
             # attention leaf -> the framework's fused causal MHA op (the
@@ -175,25 +179,30 @@ class PyTorchModel:
                 np.int32))
             return ff.embedding(idx, m.num_embeddings, m.embedding_dim)
         if _is_t5_attention(m):
-            # T5/mt5 encoder self-attention leaf: unscaled QK (the
-            # 1/sqrt(d) is folded into init), bucketed relative position
-            # bias shared from the first block's learned table, no
-            # biases.  The leaf's traced mask input is ignored — with no
-            # padding the extended mask is identically zero.  Returns
-            # enough tuple slots for any position_bias/cache getitem.
-            if getattr(m, "is_decoder", False):
-                raise UnsupportedTorchOp(
-                    "T5 decoder attention (causal + cross-attention "
-                    "threading); the encoder family is supported")
+            # T5/mt5-family attention leaf: unscaled QK (the 1/sqrt(d)
+            # is folded into init), bucketed relative position bias
+            # shared from the stack's first block, no projection biases.
+            # Three modes by leaf role: encoder self-attention
+            # (bidirectional bias), decoder self-attention (causal,
+            # unidirectional bias), cross-attention (key_value_states
+            # from the encoder, no bias — HF computes zeros there).
+            # The traced mask inputs are ignored: causal masking replays
+            # natively and the no-padding extended mask is identically
+            # zero.  Returns enough tuple slots for any getitem.
             h = int(m.n_heads)
             d = int(m.key_value_proj_dim)
+            is_dec = bool(getattr(m, "is_decoder", False))
+            kv_states = kw.get("key_value_states")
+            cross = isinstance(kv_states, Tensor)
+            kv_in = kv_states if cross else x
             y = ff.multihead_attention(
-                x, x, x, embed_dim=int(m.d_model), num_heads=h,
-                kdim=h * d, vdim=h * d, causal=False, scale_qk=False,
-                t5_bias=dict(
+                x, kv_in, kv_in, embed_dim=int(m.d_model), num_heads=h,
+                kdim=h * d, vdim=h * d,
+                causal=is_dec and not cross, scale_qk=False,
+                t5_bias=None if cross else dict(
                     num_buckets=int(m.relative_attention_num_buckets),
                     max_distance=int(m.relative_attention_max_distance),
-                    bidirectional=True))
+                    bidirectional=not is_dec))
             return (y, None, None, None)
         if _is_llama_attention(m):
             # LLaMA/Mistral-family leaf -> the framework op with GQA +
@@ -535,21 +544,32 @@ class PyTorchModel:
                 h = int(m.n_heads)
                 e = int(m.d_model)
                 d = int(m.key_value_proj_dim)
+                # cross-attention k/v project from the ENCODER stream
+                # (kdim may differ when d_model != encoder width; same
+                # here), weights still [H*D, E_kv]
+                ekv = with_no_grad["k.weight"].shape[1]
                 p["wq"] = with_no_grad["q.weight"].T.reshape(e, h, d).copy()
-                p["wk"] = with_no_grad["k.weight"].T.reshape(e, h, d).copy()
-                p["wv"] = with_no_grad["v.weight"].T.reshape(e, h, d).copy()
+                p["wk"] = with_no_grad["k.weight"].T.reshape(ekv, h, d).copy()
+                p["wv"] = with_no_grad["v.weight"].T.reshape(ekv, h, d).copy()
                 p["wo"] = with_no_grad["o.weight"].T.reshape(h, d, e).copy()
-                if "relative_attention_bias.weight" in with_no_grad:
-                    p["rel_bias"] = with_no_grad[
-                        "relative_attention_bias.weight"]
-                else:
-                    owners = [mm for mm in self.module.modules()
-                              if getattr(mm, "has_relative_attention_bias",
-                                         False)
-                              and not getattr(mm, "is_decoder", False)]
-                    assert owners, "no relative_attention_bias table found"
-                    p["rel_bias"] = (owners[0].relative_attention_bias
-                                     .weight.detach().cpu().numpy().copy())
+                if "rel_bias" in p:     # cross-attn layers carry none
+                    if "relative_attention_bias.weight" in with_no_grad:
+                        p["rel_bias"] = with_no_grad[
+                            "relative_attention_bias.weight"]
+                    else:
+                        # the stack's first block owns the table; pick
+                        # the owner on the same side (encoder/decoder)
+                        side = bool(getattr(m, "is_decoder", False))
+                        owners = [
+                            mm for mm in self.module.modules()
+                            if getattr(mm, "has_relative_attention_bias",
+                                       False)
+                            and bool(getattr(mm, "is_decoder",
+                                             False)) == side]
+                        assert owners, "no relative_attention_bias table"
+                        p["rel_bias"] = (
+                            owners[0].relative_attention_bias.weight
+                            .detach().cpu().numpy().copy())
                 continue
             if _is_llama_attention(m):
                 # separate q/k/v/o Linears ([out, in] torch layout) ->

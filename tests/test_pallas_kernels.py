@@ -326,3 +326,37 @@ def test_flash_prefill_in_model(monkeypatch):
         return [r.tokens for r in reqs]
 
     assert gen("interpret") == gen(None)
+
+
+def test_flash_prefill_dispatch_gates():
+    """flash_prefill_wins fires exactly when the kernel is usable and
+    the bucket is big enough to beat the XLA logits round trip: small
+    buckets, non-16-divisible chunks, and chunks without cache slack
+    stay on XLA; deep prefill chunks dispatch."""
+    from flexflow_tpu.serving.batch_config import BatchConfig
+    from flexflow_tpu.serving.inference_manager import (
+        FLASH_PREFILL_MIN_BUCKET, flash_prefill_wins)
+
+    alloc = 8784
+
+    def bc_with(depth, chunk):
+        bc = BatchConfig(1, chunk)
+        bc.request_available[0] = True
+        bc.first_token_depth[0] = depth
+        return bc
+
+    # deep chunk: bucket >= threshold -> flash
+    assert flash_prefill_wins(bc_with(4000, 512), 512, alloc)
+    # first chunk of a short prompt: bucket 512 < threshold -> XLA
+    assert not flash_prefill_wins(bc_with(0, 512), 512, alloc)
+    # the threshold itself is the crossover
+    assert flash_prefill_wins(bc_with(FLASH_PREFILL_MIN_BUCKET - 512,
+                                      512), 512, alloc)
+    # kernel shape limits: chunk < 16 or not 16-divisible -> XLA
+    assert not flash_prefill_wins(bc_with(4000, 8), 8, alloc)
+    assert not flash_prefill_wins(bc_with(4000, 24), 24, alloc)
+    # append window needs C+32 slack in the allocation
+    assert not flash_prefill_wins(bc_with(0, 512), 512, 520)
+    # inactive batch -> XLA
+    bc = BatchConfig(1, 512)
+    assert not flash_prefill_wins(bc, 512, alloc)

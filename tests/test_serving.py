@@ -217,3 +217,17 @@ class TestLongBlocks:
                              decode_block=64, max_new_list=[40, 3])
         assert got[0] == want_long
         assert len(got[1]) == 3
+
+    def test_stream_first_token_optin_token_match(self, monkeypatch):
+        """FF_STREAM_FIRST_TOKEN=1 (surface the prefill sample while the
+        handoff decode block runs — the PCIe streaming mode) changes
+        only WHEN the first token becomes host-visible, never the
+        tokens themselves."""
+        monkeypatch.setenv("FF_STREAM_FIRST_TOKEN", "1")
+        hf, _ = _hf_tiny_llama(seed=13)
+        prompts = [[1, 5, 9], [2, 8, 99, 100]]
+        want = [_hf_greedy(hf, p, 12) for p in prompts]
+        got = self._generate(hf, prompts, 12, prefill_chunk=8,
+                             decode_block=16)
+        for w, g in zip(want, got):
+            assert g == w, (g, w)
