@@ -190,6 +190,19 @@ def _record_flash_tile(record) -> int:
     return tile
 
 
+# Uniform-batch max DEPTH above which the flash-decode kernel
+# dispatches even without raggedness.  r4 in-model A/B (1.4B decode
+# blocks, chip): the XLA attend inside a lax.scan pays a per-step
+# materialization of the attend slice that the standalone kernel bench
+# never showed, so flash wins UNIFORM batches too once the cache read
+# is nontrivial — ratios 1.11x at depth 1800, 1.26x at 3800, 1.29x at
+# 7800, 3.2x for a single 32k row; below ~1k the kernel's per-call cost
+# loses (0.76x at depth 120, ~0.9-1.0x at 400-900).  The threshold sits
+# at the first MEASURED win (comparing actual depth, not the pow2
+# bucket, so the unmeasured 1025-1500 range stays on XLA).
+FLASH_UNIFORM_MIN_DEPTH = 1800
+
+
 def flash_wins(bc, span: int, alloc_len: int, tile: int = 1024) -> bool:
     """Host-side cost dispatch between the XLA attend (every row reads the
     BATCH-max attend bucket) and the length-tiled flash-decode kernel
@@ -197,7 +210,9 @@ def flash_wins(bc, span: int, alloc_len: int, tile: int = 1024) -> bool:
     penalty).  True when the batch's depth profile is ragged enough —
     e.g. one 8k-context request among short ones, the regime where the
     XLA path structurally cannot avoid reading every row to the longest
-    row's depth."""
+    row's depth — OR when the batch-max depth alone is deep enough that
+    the kernel's cheaper per-byte read beats the XLA path's in-scan
+    slice materialization (FLASH_UNIFORM_MIN_DEPTH)."""
     import os
 
     mode = os.environ.get("FF_FLASH_DECODE", "auto")
@@ -209,6 +224,8 @@ def flash_wins(bc, span: int, alloc_len: int, tile: int = 1024) -> bool:
     if mode in ("1", "force", "interpret"):
         return True   # forced on (tests / manual override)
     depths = np.asarray(bc.first_token_depth)[act] + span
+    if int(depths.max()) >= FLASH_UNIFORM_MIN_DEPTH:
+        return True
     bucket = pow2_bucket(int(depths.max()), alloc_len) or alloc_len
     xla_bytes = int(act.sum()) * bucket
     # the kernel reads tiles 0..depth//tile inclusive per row

@@ -194,6 +194,14 @@ class PyTorchModel:
             is_dec = bool(getattr(m, "is_decoder", False))
             kv_states = kw.get("key_value_states")
             cross = isinstance(kv_states, Tensor)
+            if not cross and len(node.args) > 1:
+                # drift guard: if a transformers version passes
+                # key_value_states POSITIONALLY, silently replaying as
+                # self-attention would produce wrong logits — fail loud
+                raise UnsupportedTorchOp(
+                    "T5 attention leaf got positional args beyond "
+                    "hidden_states (key_value_states must arrive as a "
+                    f"keyword): {node.args!r}")
             kv_in = kv_states if cross else x
             y = ff.multihead_attention(
                 x, kv_in, kv_in, embed_dim=int(m.d_model), num_heads=h,

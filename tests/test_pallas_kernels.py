@@ -107,9 +107,9 @@ def test_flash_decode_in_model(monkeypatch):
 
 
 def test_flash_dispatch_cost_model():
-    """flash_wins fires exactly for ragged depth profiles: a lone
-    long-context row among short rows dispatches to the kernel; uniform
-    batches stay on the XLA attend."""
+    """flash_wins fires for ragged depth profiles (a lone long-context
+    row among short rows) AND for deep batches of any shape (the r4
+    uniform term); shallow-uniform batches stay on the XLA attend."""
     from flexflow_tpu.serving.batch_config import BatchConfig
     from flexflow_tpu.serving.inference_manager import flash_wins
 
@@ -124,29 +124,33 @@ def test_flash_dispatch_cost_model():
     # ragged: one 16k row, fifteen 300-token rows — XLA would read every
     # row to the 16k bucket
     assert flash_wins(bc_with([16000] + [300] * 15, ), 1, alloc)
-    # uniform long: everyone needs the full read anyway
-    assert not flash_wins(bc_with([16000] * 16), 1, alloc)
-    # uniform short: XLA bucket is already tight
+    # uniform long (r4): ALSO flash — the XLA attend inside the decode
+    # scan pays a per-step slice materialization (chip A/B: 1.29x at
+    # depth 7800, 3.2x at 32k), so deep buckets dispatch even uniform
+    assert flash_wins(bc_with([16000] * 16), 1, alloc)
+    # uniform short: XLA bucket is already tight, kernel overhead loses
     assert not flash_wins(bc_with([300] * 16), 1, alloc)
 
 
 def test_flash_dispatch_crossover_tracks_penalty():
     """r4 (verdict weak #3): the dispatch crossover is PINNED against
-    FLASH_BYTE_PENALTY so a recalibration (or a kernel layout change
-    shifting the per-byte cost) breaks this test instead of silently
-    mis-dispatching.  The crossover point: flash wins iff
-    flash_bytes * PENALTY < xla_bytes, where flash reads each row's own
-    tiles and XLA reads every active row to the batch-max bucket."""
+    FLASH_BYTE_PENALTY and FLASH_UNIFORM_MIN_DEPTH so a recalibration
+    (or a kernel layout change shifting the per-byte cost) breaks this
+    test instead of silently mis-dispatching.  Deep batches dispatch
+    unconditionally (uniform term); below the uniform threshold flash
+    wins iff flash_bytes * PENALTY < xla_bytes, where flash reads each
+    row's own tiles (tile=128, the 7B-MHA regime where sub-bucket
+    pruning is real) and XLA reads every row to the batch-max bucket."""
     import numpy as np
 
     from flexflow_tpu.serving.batch_config import BatchConfig
-    from flexflow_tpu.serving.inference_manager import (FLASH_BYTE_PENALTY,
-                                                        flash_wins,
-                                                        pow2_bucket)
+    from flexflow_tpu.serving.inference_manager import (
+        FLASH_BYTE_PENALTY, FLASH_UNIFORM_MIN_DEPTH, flash_wins,
+        pow2_bucket)
 
     alloc = 32 * 1024
-    tile = 1024
-    long_depth = 16000
+    tile = 128
+    long_depth = 1000           # below the uniform depth term
 
     def bc_with(depths):
         bc = BatchConfig(len(depths), 1)
@@ -156,6 +160,8 @@ def test_flash_dispatch_crossover_tracks_penalty():
 
     def model_says(depths):
         d = np.asarray(depths) + 1
+        if int(d.max()) >= FLASH_UNIFORM_MIN_DEPTH:
+            return True
         bucket = pow2_bucket(int(d.max()), alloc) or alloc
         xla = len(d) * bucket
         flash = float(np.minimum((d // tile + 1) * tile, alloc).sum())
@@ -164,12 +170,18 @@ def test_flash_dispatch_crossover_tracks_penalty():
     # sweep the short rows' depth up: at some point the ragged advantage
     # dies; flash_wins must flip exactly where the byte model flips
     flips = []
-    for short in (100, 500, 1000, 2000, 4000, 8000, 12000, 15000):
+    for short in (60, 200, 400, 600, 800, 1000):
         depths = [long_depth] + [short] * 15
         got = flash_wins(bc_with(depths), 1, alloc, tile=tile)
         assert got == model_says(depths), (short, got)
         flips.append(got)
     assert flips[0] and not flips[-1], flips  # the crossover exists
+    # deep batches (any shape) dispatch flash via the uniform term
+    for depths in ([16000] + [100] * 15, [16000] * 16, [2100] * 4):
+        assert flash_wins(bc_with(depths), 1, alloc, tile=tile)
+    # the unmeasured 1025-1500 pow2-bucket gray zone stays on XLA (the
+    # threshold compares actual depth, not the rounded-up bucket)
+    assert not flash_wins(bc_with([1200] * 8), 1, alloc, tile=1024)
     # the measured-bench regime (one ~8k row + short rows at 8k alloc)
     # dispatches flash — the profile llama1p4b_8k_ragged_decode uses
     assert flash_wins(bc_with([8000] + [100] * 15), 1, 8400, tile=1024)

@@ -222,12 +222,38 @@ class TestLongBlocks:
         """FF_STREAM_FIRST_TOKEN=1 (surface the prefill sample while the
         handoff decode block runs — the PCIe streaming mode) changes
         only WHEN the first token becomes host-visible, never the
-        tokens themselves."""
-        monkeypatch.setenv("FF_STREAM_FIRST_TOKEN", "1")
+        tokens themselves — and the stream branch must actually FIRE:
+        exactly one extra host sync (the early init fetch) and a
+        first_token_time stamped for every request."""
         hf, _ = _hf_tiny_llama(seed=13)
         prompts = [[1, 5, 9], [2, 8, 99, 100]]
         want = [_hf_greedy(hf, p, 12) for p in prompts]
-        got = self._generate(hf, prompts, 12, prefill_chunk=8,
-                             decode_block=16)
-        for w, g in zip(want, got):
-            assert g == w, (g, w)
+
+        def gen(stream):
+            if stream:
+                monkeypatch.setenv("FF_STREAM_FIRST_TOKEN", "1")
+            else:
+                monkeypatch.delenv("FF_STREAM_FIRST_TOKEN",
+                                   raising=False)
+            model, _ = _build_ff_llama(hf, max_requests=4)
+            im = InferenceManager(model.config)
+            mid = im.compile_model_and_allocate_buffer(
+                model, max_requests=4, max_seq_length=256,
+                prefill_chunk=8, cache_dtype=np.float32)
+            rm = RequestManager(max_requests_per_batch=4,
+                                max_tokens_per_batch=8,
+                                max_sequence_length=256,
+                                decode_block=16)
+            reqs = [rm.register_new_request(list(p), max_new_tokens=12)
+                    for p in prompts]
+            rm.generate_incr_decoding(im, mid, reqs)
+            return ([r.tokens[r.prompt_len:] for r in reqs], im, reqs)
+
+        got_s, im_s, reqs_s = gen(True)
+        got_n, im_n, _ = gen(False)
+        for w, g_s, g_n in zip(want, got_s, got_n):
+            assert g_s == w and g_n == w, (g_s, g_n, w)
+        # one handoff per generation -> exactly one extra sync
+        assert im_s.host_syncs == im_n.host_syncs + 1, (
+            im_s.host_syncs, im_n.host_syncs)
+        assert all(r.profile.first_token_time > 0 for r in reqs_s)
