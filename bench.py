@@ -1044,13 +1044,15 @@ def bench_kernels():
     operands ride the loop carry (never closure constants).
 
     The shipped Pallas kernel is the length-tiled flash-decode attention
-    (kernels/flash_decode.py).  Its bench is the regime the host cost
-    model dispatches it for — a RAGGED batch (one long-context row among
-    short rows), where the XLA attend must read every row to the batch
-    max while flash reads each row's own tiles.  The uniform case is also
-    reported: there XLA wins and the dispatcher keeps it (flash_wins
-    returns False), so 'flash loses uniform' is the dispatcher working,
-    not a regression."""
+    (kernels/flash_decode.py).  Its headline bench is the RAGGED batch
+    (one long-context row among short rows), where the XLA attend must
+    read every row to the batch max while flash reads each row's own
+    tiles.  The uniform case is also reported; note these standalone
+    numbers UNDERSTATE flash's in-model advantage — inside the decode
+    scan the XLA attend additionally pays a per-step attend-slice
+    materialization, which is why flash_wins dispatches flash for ANY
+    deep batch (FLASH_UNIFORM_MIN_DEPTH) even where the standalone
+    uniform numbers look close."""
     import jax
     import jax.numpy as jnp
 

@@ -204,7 +204,7 @@ def flash_prefill_attend(q, ck, cv, depth, ntok, active, scale: float,
 
 
 def _append_kernel(base_ref, off_ref, ntok_ref, act_ref,  # scalar prefetch
-                   kal_ref, val_ref,             # VMEM [R, KV, W, D]
+                   kal_ref, val_ref,     # VMEM [1, KV, W, D] row blocks
                    ck_hbm, cv_hbm,               # ANY (aliased inputs)
                    ck_out, cv_out,               # aliased outputs
                    win_k, win_v, sem_k, sem_v):
@@ -250,12 +250,12 @@ def _append_kernel(base_ref, off_ref, ntok_ref, act_ref,  # scalar prefetch
         for i in range(kv):
             win_k[i] = jnp.where(
                 sel[0],
-                pltpu.roll(kal_ref[r, i], off_ref[r], 0).astype(
+                pltpu.roll(kal_ref[0, i], off_ref[r], 0).astype(
                     win_k.dtype),
                 win_k[i])
             win_v[i] = jnp.where(
                 sel[0],
-                pltpu.roll(val_ref[r, i], off_ref[r], 0).astype(
+                pltpu.roll(val_ref[0, i], off_ref[r], 0).astype(
                     win_v.dtype),
                 win_v[i])
         outk = pltpu.make_async_copy(
@@ -301,8 +301,11 @@ def chunk_append(ck, cv, k_new, v_new, depth, ntok, active,
         num_scalar_prefetch=4,
         grid=(R,),
         in_specs=[
-            pl.BlockSpec(memory_space=pltpu.VMEM),       # k_al
-            pl.BlockSpec(memory_space=pltpu.VMEM),       # v_al
+            # per-row blocks: whole-array VMEM staging would put
+            # R x KV x W x D f32 on chip at once (~18 MB at batch 8,
+            # C=512 — over the VMEM budget); one row at a time is ~1 MB
+            pl.BlockSpec((1, KV, W, D), lambda r, *_: (r, 0, 0, 0)),
+            pl.BlockSpec((1, KV, W, D), lambda r, *_: (r, 0, 0, 0)),
             pl.BlockSpec(memory_space=pl.ANY),           # ck
             pl.BlockSpec(memory_space=pl.ANY),           # cv
         ],

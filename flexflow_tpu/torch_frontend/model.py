@@ -134,6 +134,10 @@ class PyTorchModel:
                 x = env[first.name] if hasattr(first, "name") else first
                 kw = {k: (env[v.name] if hasattr(v, "name") else v)
                       for k, v in node.kwargs.items()}
+                if len(node.args) > 1:
+                    kw["__positional_extras__"] = [
+                        env[a.name] if hasattr(a, "name") else a
+                        for a in node.args[1:]]
                 y = self._call_module(ffmodel, node, m, x, kw)
                 env[node.name] = y
                 lead = y[0] if isinstance(y, tuple) else y
@@ -194,14 +198,22 @@ class PyTorchModel:
             is_dec = bool(getattr(m, "is_decoder", False))
             kv_states = kw.get("key_value_states")
             cross = isinstance(kv_states, Tensor)
-            if not cross and len(node.args) > 1:
+            if not cross:
                 # drift guard: if a transformers version passes
                 # key_value_states POSITIONALLY, silently replaying as
-                # self-attention would produce wrong logits — fail loud
-                raise UnsupportedTorchOp(
-                    "T5 attention leaf got positional args beyond "
-                    "hidden_states (key_value_states must arrive as a "
-                    f"keyword): {node.args!r}")
+                # self-attention would produce wrong logits.  Only a
+                # graph-valued extra can be kv_states; positional masks
+                # (None / concrete torch tensors) are ignored exactly
+                # like keyword masks are.
+                graph_extras = [e for e in
+                                kw.get("__positional_extras__", [])
+                                if isinstance(e, Tensor)]
+                if graph_extras:
+                    raise UnsupportedTorchOp(
+                        "T5 attention leaf got a graph-valued positional "
+                        "arg beyond hidden_states — cannot distinguish a "
+                        "traced mask from key_value_states; pass "
+                        f"key_value_states as a keyword ({node.args!r})")
             kv_in = kv_states if cross else x
             y = ff.multihead_attention(
                 x, kv_in, kv_in, embed_dim=int(m.d_model), num_heads=h,

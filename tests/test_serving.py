@@ -181,7 +181,7 @@ class TestLongBlocks:
     cutting host syncs to ~1 per generation wave on long outputs."""
 
     def _generate(self, hf, prompts, n_new, prefill_chunk, decode_block,
-                  max_new_list=None):
+                  max_new_list=None, return_state=False):
         model, _ = _build_ff_llama(hf, max_requests=4)
         im = InferenceManager(model.config)
         mid = im.compile_model_and_allocate_buffer(
@@ -195,7 +195,8 @@ class TestLongBlocks:
         reqs = [rm.register_new_request(list(p), max_new_tokens=mn)
                 for p, mn in zip(prompts, maxes)]
         rm.generate_incr_decoding(im, mid, reqs)
-        return [r.tokens[r.prompt_len:] for r in reqs]
+        toks = [r.tokens[r.prompt_len:] for r in reqs]
+        return (toks, im, reqs) if return_state else toks
 
     def test_block_beyond_slack_token_match(self):
         """k=32 with slack=8 must produce exactly the per-step tokens."""
@@ -235,19 +236,8 @@ class TestLongBlocks:
             else:
                 monkeypatch.delenv("FF_STREAM_FIRST_TOKEN",
                                    raising=False)
-            model, _ = _build_ff_llama(hf, max_requests=4)
-            im = InferenceManager(model.config)
-            mid = im.compile_model_and_allocate_buffer(
-                model, max_requests=4, max_seq_length=256,
-                prefill_chunk=8, cache_dtype=np.float32)
-            rm = RequestManager(max_requests_per_batch=4,
-                                max_tokens_per_batch=8,
-                                max_sequence_length=256,
-                                decode_block=16)
-            reqs = [rm.register_new_request(list(p), max_new_tokens=12)
-                    for p in prompts]
-            rm.generate_incr_decoding(im, mid, reqs)
-            return ([r.tokens[r.prompt_len:] for r in reqs], im, reqs)
+            return self._generate(hf, prompts, 12, prefill_chunk=8,
+                                  decode_block=16, return_state=True)
 
         got_s, im_s, reqs_s = gen(True)
         got_n, im_n, _ = gen(False)

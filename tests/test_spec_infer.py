@@ -516,3 +516,46 @@ class TestSpecInfer:
             im.models.pop(sid)
         assert accs[0.0] > 0.99, accs
         assert accs[0.5] < 0.7, accs
+
+
+def test_spec_infer_flash_prefill_interpret_token_match(monkeypatch):
+    """FF_FLASH_PREFILL=interpret through the SPEC stack: the SSM's
+    beam-row chunked prefill (SpecIncMHSA inherits the inc prefill
+    dispatch) and the LLM's chain prefill run the flash-prefill kernel
+    interpreted — committed tokens must equal the unforced run."""
+    import numpy as np
+
+    from flexflow_tpu.serving import InferenceManager, RequestManager
+    from flexflow_tpu.serving.spec_infer import generate_spec_infer
+
+    llm_hf = _hf_llama(TINY, seed=0)
+    ssm_hf = _hf_llama(SMALLER, seed=7)
+    # prompt long enough to force multi-chunk (>=16) prefill spans
+    prompt = [int(x) for x in
+              np.random.default_rng(3).integers(2, 120, 40)]
+
+    def run(env):
+        if env:
+            monkeypatch.setenv("FF_FLASH_PREFILL", env)
+        else:
+            monkeypatch.delenv("FF_FLASH_PREFILL", raising=False)
+        llm = _build(llm_hf, InferenceMode.TREE_VERIFY, 2)
+        ssm = _build(ssm_hf, InferenceMode.BEAM_SEARCH, 2)
+        im = InferenceManager(llm.config)
+        lid = im.compile_model_and_allocate_buffer(
+            llm, mode=InferenceMode.TREE_VERIFY, max_requests=2,
+            max_seq_length=96, cache_dtype=np.float32)
+        sid = im.compile_model_and_allocate_buffer(
+            ssm, mode=InferenceMode.BEAM_SEARCH, max_requests=2,
+            max_seq_length=96, beam_width=2, cache_dtype=np.float32)
+        rm = RequestManager(max_requests_per_batch=2,
+                            max_tokens_per_batch=32,
+                            max_sequence_length=96,
+                            max_spec_tree_token_num=24)
+        rm.register_ssm_model(sid)
+        reqs = [rm.register_new_request(list(prompt), max_new_tokens=8)]
+        generate_spec_infer(rm, im, lid, reqs, beam_width=2,
+                            beam_depth=4)
+        return [r.tokens for r in reqs]
+
+    assert run("interpret") == run(None)
