@@ -941,7 +941,7 @@ def bench_longctx():
     return [
         {"metric": "llama1p4b_8k_prompt_ttft_1chip",
          "value": round(ttft * 1e3, 1), "unit": "ms",
-         "methodology": ("8192-token prompt, chunked prefill (512/step), "
+         "methodology": ("8192-token prompt, chunked prefill (512/step — the end-to-end-validated configuration; 1024-chunks measured ~7% faster on the flash path but hit remote-compile-helper instability during validation, so the A/B stays at 512), "
                          "bf16, best-of-3, host-observed first token; "
                          "flash-prefill kernel dispatched by bucket "
                          "(flash_prefill_wins), mid-prompt chunk samples "

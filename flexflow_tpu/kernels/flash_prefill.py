@@ -344,9 +344,18 @@ def flash_prefill_attention(q, k_new, v_new, ck, cv, depth, ntok,
 def prefill_path_ok(C: int, ck, mesh) -> bool:
     """Shape gate for the production op: multi-token chunk on an
     unsharded cache with lane-aligned head dim and a 16-divisible chunk
-    (the append window arithmetic).  WHETHER flash beats the XLA attend
-    is the host's cost decision (inference_manager.flash_prefill_wins)
-    — this only says the kernel can run."""
+    (the append window arithmetic), and an append window that FITS VMEM
+    — the per-row window carries 8 bytes/position/KV-head/lane for the
+    f32-staged chunk (k_al + v_al) plus 2 x cache-dtype for the win
+    scratch, so wide-KV models (7B-class MHA, KV=32) cap at small
+    chunks and a bf16 KV=4/D=128 cache caps at ~C<=1750 (the C=2048
+    case, ~12.8 MB, failed Mosaic compilation on chip; the 11 MB budget
+    keeps a margin below that single calibration point).  WHETHER flash
+    beats the XLA attend is the host's cost decision
+    (inference_manager.flash_prefill_wins) — this only says the kernel
+    can run."""
     R, KV, S, D = ck.shape
+    append_vmem = (C + 32) * KV * D * (8 + 2 * ck.dtype.itemsize)
     return (C >= 16 and C % 16 == 0 and mesh is None
-            and D % 128 == 0 and S % 16 == 0 and C + 32 <= S)
+            and D % 128 == 0 and S % 16 == 0 and C + 32 <= S
+            and append_vmem <= 11 * 1024 * 1024)

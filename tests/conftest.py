@@ -26,10 +26,16 @@ assert len(jax.devices()) == 8, jax.devices()
 
 def run_spec_infer(llm, ssm, prompts, n_new, beam_width=2, max_requests=4,
                    tree_chunk=24, max_seq_length=256, beam_depth=4,
-                   max_tokens_per_batch=64):
+                   max_tokens_per_batch=64, ssm_widths=None,
+                   request_width=...):
     """Shared speculative-decoding harness: compile an LLM (tree-verify) +
     SSM (beam) pair — or a list of SSMs — and generate.  Used by
-    test_spec_infer and the cross-family model-zoo tests."""
+    test_spec_infer and the cross-family model-zoo tests.
+
+    ``ssm_widths``: optional per-SSM compile widths (heterogeneous-width
+    configs); defaults to ``beam_width`` for every SSM.
+    ``request_width``: the width passed to generate_spec_infer; defaults
+    to ``beam_width``, pass None for the driver's compiled-width auto."""
     import numpy as np
 
     from flexflow_tpu.fftype import InferenceMode
@@ -44,14 +50,19 @@ def run_spec_infer(llm, ssm, prompts, n_new, beam_width=2, max_requests=4,
                         max_tokens_per_batch=max_tokens_per_batch,
                         max_sequence_length=max_seq_length,
                         max_spec_tree_token_num=tree_chunk)
-    for s in (ssm if isinstance(ssm, (list, tuple)) else [ssm]):
+    ssms = list(ssm) if isinstance(ssm, (list, tuple)) else [ssm]
+    widths = ssm_widths or [beam_width] * len(ssms)
+    assert len(widths) == len(ssms), (len(widths), len(ssms))
+    for s, w in zip(ssms, widths):
         ssm_id = im.compile_model_and_allocate_buffer(
             s, mode=InferenceMode.BEAM_SEARCH, max_requests=max_requests,
-            max_seq_length=max_seq_length, beam_width=beam_width,
+            max_seq_length=max_seq_length, beam_width=w,
             cache_dtype=np.float32)
         rm.register_ssm_model(ssm_id)
     reqs = [rm.register_new_request(list(p), max_new_tokens=n_new)
             for p in prompts]
-    generate_spec_infer(rm, im, llm_id, reqs, beam_width=beam_width,
-                        beam_depth=beam_depth)
+    generate_spec_infer(
+        rm, im, llm_id, reqs,
+        beam_width=beam_width if request_width is ... else request_width,
+        beam_depth=beam_depth)
     return [r.tokens[r.prompt_len:] for r in reqs], reqs

@@ -372,3 +372,25 @@ def test_flash_prefill_dispatch_gates():
     # inactive batch -> XLA
     bc = BatchConfig(1, 512)
     assert not flash_prefill_wins(bc, 512, alloc)
+
+
+def test_flash_prefill_vmem_gate():
+    """prefill_path_ok bounds the append window's VMEM footprint
+    (f32-staged chunk + cache-dtype win scratch, dtype-aware): a
+    7B-class MHA cache (KV=32, D=128) rejects 512-token chunks (window
+    would need ~26 MB of VMEM — Mosaic compile failure territory),
+    the 1.4B-class bf16 GQA cache (KV=4) caps at ~1750, and an f32
+    cache's bigger scratch caps it earlier."""
+    from flexflow_tpu.kernels.flash_prefill import prefill_path_ok
+
+    gqa = jnp.zeros((1, 4, 8784, 128), jnp.bfloat16)
+    gqa32 = jnp.zeros((1, 4, 8784, 128), jnp.float32)
+    mha = jnp.zeros((1, 32, 8784, 128), jnp.bfloat16)
+    assert prefill_path_ok(512, gqa, None)
+    assert prefill_path_ok(1024, gqa, None)
+    assert not prefill_path_ok(2048, gqa, None)   # failed on chip
+    assert not prefill_path_ok(512, mha, None)
+    assert prefill_path_ok(128, mha, None)
+    # f32 scratch: 16 B/pos vs bf16's 12 — the cap drops accordingly
+    assert prefill_path_ok(1024, gqa32, None)
+    assert not prefill_path_ok(1408, gqa32, None)

@@ -569,36 +569,20 @@ def test_two_ssms_heterogeneous_widths_host_loop(caplog):
     decoding (the union-tree verify guarantee)."""
     import logging
 
-    from flexflow_tpu.serving import InferenceManager, RequestManager
-    from flexflow_tpu.serving.spec_infer import generate_spec_infer
+    from conftest import run_spec_infer
 
     llm_hf = _hf_llama(TINY, seed=0)
-    ssm_a = _hf_llama(SMALLER, seed=7)
-    ssm_b = _hf_llama(SMALLER, seed=9)
     prompts = [[1, 5, 9, 42, 7], [2, 8, 99, 100]]
     want = _incr_generate(llm_hf, prompts, 10, max_requests=2)
 
     llm = _build(llm_hf, InferenceMode.TREE_VERIFY, 2)
-    im = InferenceManager(llm.config)
-    lid = im.compile_model_and_allocate_buffer(
-        llm, mode=InferenceMode.TREE_VERIFY, max_requests=2,
-        max_seq_length=96, cache_dtype=np.float32)
-    rm = RequestManager(max_requests_per_batch=2,
-                       max_tokens_per_batch=64,
-                       max_sequence_length=96,
-                       max_spec_tree_token_num=24)
-    for s, w in ((ssm_a, 2), (ssm_b, 3)):
-        sid = im.compile_model_and_allocate_buffer(
-            _build(s, InferenceMode.BEAM_SEARCH, 2),
-            mode=InferenceMode.BEAM_SEARCH, max_requests=2,
-            max_seq_length=96, beam_width=w, cache_dtype=np.float32)
-        rm.register_ssm_model(sid)
-    reqs = [rm.register_new_request(list(p), max_new_tokens=10)
-            for p in prompts]
+    ssms = [_build(_hf_llama(SMALLER, seed=s), InferenceMode.BEAM_SEARCH,
+                   2) for s in (7, 9)]
     with caplog.at_level(logging.WARNING,
                          logger="flexflow_tpu.serving.spec_block"):
-        generate_spec_infer(rm, im, lid, reqs, beam_depth=4)
+        got, _ = run_spec_infer(llm, ssms, prompts, 10, max_requests=2,
+                                max_seq_length=96, ssm_widths=[2, 3],
+                                request_width=None)
     assert any("heterogeneous beam widths" in r.message
                for r in caplog.records)
-    got = [r.tokens[r.prompt_len:] for r in reqs]
     assert got == want, (got, want)
