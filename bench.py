@@ -1181,17 +1181,53 @@ def main(which: str):
         raise SystemExit(
             f"unknown bench mode {which!r} (expected all|llama|llama7b|"
             f"spec|mnist|kernels|opt|resnet|longctx)")
+
     # all: headline decode metric + everything else under extras.  Each
     # section runs in its own process lifetime-wise (HBM frees between
     # them only at process exit), so 7B (10+ GB) runs FIRST while HBM is
     # clean; the 1.4B sections fit alongside its residue.
-    extras = []
-    head7b, *ex7b = bench_llama7b_decode()
-    extras += [head7b] + ex7b
-    head = bench_llama_decode()
-    head["extras"] = (extras + bench_spec7b() + bench_spec_infer()
-                      + bench_longctx() + bench_opt125m()
-                      + bench_resnet50_dp() + bench_kernels())
+    #
+    # FAULT ISOLATION: the remote compile helper behind the tunnel
+    # occasionally drops a compile mid-flight ("response body closed" —
+    # observed transiently, same compile succeeds on retry), and one
+    # unguarded section must not erase every other section's numbers
+    # from the round record.  Each section gets one retry, then is
+    # skipped with the error on stderr.
+    def _section(fn, label):
+        import gc
+
+        last = ""
+        for attempt in (1, 2):
+            try:
+                r = fn()
+                return list(r) if isinstance(r, (tuple, list)) else [r]
+            except Exception as e:
+                last = f"{type(e).__name__}: {e}"
+                print(f"bench section {label} attempt {attempt} failed: "
+                      f"{last}", file=sys.stderr)
+                # drop the failed attempt's device buffers before the
+                # retry re-allocates the section's models (a 7B section
+                # holds 10+ GB; doubled residue would OOM the retry and
+                # cascade into later sections)
+                gc.collect()
+        # leave a marker in the round record: an absent metric is
+        # indistinguishable from a removed one to trend tooling
+        return [{"metric": f"section_{label}_failed", "value": 0.0,
+                 "unit": "error", "error": last[:500], "vs_baseline": 0}]
+
+    extras = _section(bench_llama7b_decode, "llama7b")
+    heads = _section(bench_llama_decode, "llama")
+    head = heads[0] if heads else {
+        "metric": "llama1p4b_decode_throughput_1chip", "value": 0.0,
+        "unit": "tokens/s", "vs_baseline": 0,
+        "error": "headline section failed twice; see stderr"}
+    head["extras"] = (extras
+                      + _section(bench_spec7b, "spec7b")
+                      + _section(bench_spec_infer, "spec")
+                      + _section(bench_longctx, "longctx")
+                      + _section(bench_opt125m, "opt")
+                      + _section(bench_resnet50_dp, "resnet")
+                      + _section(bench_kernels, "kernels"))
     return head
 
 

@@ -268,6 +268,27 @@ def flash_prefill_wins(bc, chunk: int, alloc_len: int) -> bool:
     return bucket >= FLASH_PREFILL_MIN_BUCKET
 
 
+def _retry_transient(step, *args):
+    """Invoke a jitted step, retrying ONCE on a transient remote-compile
+    failure.  On a network-attached chip the compile service can drop a
+    response mid-flight (observed as INTERNAL '.../remote_compile: read
+    body/HTTP 500' JaxRuntimeErrors whose identical compile succeeds on
+    retry); the failure happens BEFORE execution, so donated buffers are
+    still intact and re-invoking is safe.  Non-transient errors re-raise
+    unchanged."""
+    try:
+        return step(*args)
+    except jax.errors.JaxRuntimeError as e:
+        if "remote_compile" not in str(e):
+            raise
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "transient remote-compile failure; retrying once: %s",
+            str(e).splitlines()[0] if str(e) else e)
+        return step(*args)
+
+
 def fuse_qkv(model) -> None:
     """Concatenate each serving-attention layer's wq/wk/wv ([E,H,D] +
     2x[E,KV,D]) into one wqkv [E,H+2KV,D] (and biases into bqkv) so the
@@ -728,8 +749,8 @@ class InferenceManager:
                       if record["mesh"] is None else None)
         step = self._get_step(record, bc.chunk, reorder, attend_len,
                               use_flash)
-        outs, record["caches"] = step(record["model"].params,
-                                      record["caches"], batch, rng)
+        outs, record["caches"] = _retry_transient(
+            step, record["model"].params, record["caches"], batch, rng)
         return outs
 
     def decode_block(self, model_id: int, bc: BatchConfig, k: int,
@@ -785,9 +806,9 @@ class InferenceManager:
         if key not in record["steps"]:
             record["steps"][key] = self._build_decode_block(
                 record, k, include_init, attend_len, use_flash)
-        toks, record["caches"] = record["steps"][key](
-            record["model"].params, record["caches"], batch,
-            jax.random.split(rng, k),
+        toks, record["caches"] = _retry_transient(
+            record["steps"][key], record["model"].params,
+            record["caches"], batch, jax.random.split(rng, k),
             jnp.asarray(init_tokens, jnp.int32))
         return toks
 

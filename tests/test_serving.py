@@ -247,3 +247,40 @@ class TestLongBlocks:
         assert im_s.host_syncs == im_n.host_syncs + 1, (
             im_s.host_syncs, im_n.host_syncs)
         assert all(r.profile.first_token_time > 0 for r in reqs_s)
+
+
+def test_transient_remote_compile_retry():
+    """_retry_transient retries EXACTLY once on a remote-compile tunnel
+    failure (the compile service drops responses mid-flight under
+    bursts; the identical compile succeeds on retry, and no execution
+    happened so donated buffers are intact) and re-raises everything
+    else unchanged."""
+    import jax
+    import pytest
+
+    from flexflow_tpu.serving.inference_manager import _retry_transient
+
+    calls = {"n": 0}
+
+    def flaky(*args):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise jax.errors.JaxRuntimeError(
+                "INTERNAL: http://127.0.0.1:8093/remote_compile: read "
+                "body: response body closed before all bytes were read")
+        return ("ok", args)
+
+    out, got_args = _retry_transient(flaky, 1, 2)
+    assert out == "ok" and got_args == (1, 2) and calls["n"] == 2
+
+    def dead(*args):
+        raise jax.errors.JaxRuntimeError("some other INTERNAL failure")
+
+    with pytest.raises(jax.errors.JaxRuntimeError, match="other"):
+        _retry_transient(dead)
+
+    def twice_flaky(*args):
+        raise jax.errors.JaxRuntimeError("x remote_compile y")
+
+    with pytest.raises(jax.errors.JaxRuntimeError, match="remote_compile"):
+        _retry_transient(twice_flaky)
