@@ -29,6 +29,21 @@ the host-side attend_len bucket only bounds the BATCH maximum.
 
 GQA layout: H = KV * G query heads share KV cache heads; both dots
 batch over kv — no KV duplication in memory or traffic.
+
+r5 additions:
+- ALiBi (``slopes``): the MPT position bias slope_h * (k_pos - q_pos)
+  is one fused add on the logits tile (reference
+  apply_position_bias_qkprd, inc_multihead_self_attention.cu:304-325),
+  so position-bias models decode on the fast path too.
+- Sharded meshes: ``flash_decode_attention_sharded`` shard_maps the
+  scatter+attend over the serving mesh — tp shards the kv-head axis
+  (heads are independent, no collective; the reference TP-shards its
+  generation kernel by heads the same way,
+  inc_multihead_self_attention.cc:694-697), sp shards the cache length
+  (each shard runs a PARTIAL online softmax via the same kernel and the
+  combine is the standard flash merge: pmax of maxima, psum of
+  rescaled sums/accumulators — the decode twin of
+  ops/ring_attention.py's combine).
 """
 
 from __future__ import annotations
@@ -39,67 +54,107 @@ import jax
 import jax.numpy as jnp
 
 
+def _init_scratch(m_sc, l_sc, acc_sc):
+    m_sc[:] = jnp.full_like(m_sc, -1e30)
+    l_sc[:] = jnp.zeros_like(l_sc)
+    acc_sc[:] = jnp.zeros_like(acc_sc)
+
+
+def _online_softmax_step(r, t, depth_ref, act_ref, q_ref, k_ref, v_ref,
+                         slopes_ref, m_sc, l_sc, acc_sc,
+                         *, ts, kv, g, d, s_total, scale):
+    """One S-tile of the running softmax (shared by the full and partial
+    kernels)."""
+    kvg = kv * g
+    qv = q_ref[:].reshape(kv, g, d)
+    kt = k_ref[:].reshape(kv, ts, d)           # native layout: no swap
+    vt = v_ref[:].reshape(kv, ts, d)
+    # logits[kv, g, ts] = qv . kt (batch kv; contract d)
+    logits = jax.lax.dot_general(
+        qv, kt, (((2,), (2,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32) * scale
+    span = (t * ts
+            + jax.lax.broadcasted_iota(jnp.int32, (1, ts), 1))
+    if slopes_ref is not None:
+        # ALiBi: bias = slope_h * (k_pos - q_pos); q sits at depth_r.
+        rel = (span - depth_ref[r]).astype(jnp.float32)      # [1, TS]
+        logits = logits + (slopes_ref[:].reshape(kv, g, 1)
+                           * rel[None, :, :])
+    # span < s_total guards the padded tail of a partial final tile: a
+    # sharded caller passes local depths that may EXCEED the local
+    # extent (shard wholly below the row's span), so span <= depth no
+    # longer excludes the pad columns by itself
+    ok = ((span <= depth_ref[r]) & (span < s_total)
+          & (act_ref[r] > 0))                                # [1, TS]
+    logits = jnp.where(ok[None, :, :] > 0, logits, -1e30)
+    l2 = logits.reshape(kvg, ts)
+    tile_max = jnp.max(l2, axis=-1, keepdims=True)           # [KVG, 1]
+    m_new = jnp.maximum(m_sc[:], tile_max)
+    alpha = jnp.exp(m_sc[:] - m_new)
+    # fully-masked lanes (inactive rows / no valid position yet) keep
+    # m_new at the -1e30 fill; exp(l2 - m_new) would be exp(0)=1
+    # there, silently averaging V — force p to 0 so l stays 0 and the
+    # finish-guard zeros the output
+    p = jnp.where(m_new > -1e29, jnp.exp(l2 - m_new), 0.0)
+    l_sc[:] = l_sc[:] * alpha + jnp.sum(p, axis=-1, keepdims=True)
+    m_sc[:] = m_new
+    # pv[kv, g, d] = p . vt (batch kv; contract ts).  vt's
+    # out-of-range pad columns (partial final S tile) may hold NaN;
+    # p is 0 there but 0*NaN = NaN, so zero them explicitly
+    col_ok = (t * ts + jax.lax.broadcasted_iota(
+        jnp.int32, (1, ts, 1), 1)) < s_total
+    vt = jnp.where(col_ok, vt, 0)
+    pv = jax.lax.dot_general(
+        p.reshape(kv, g, ts).astype(vt.dtype), vt,
+        (((2,), (1,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32)
+    acc_sc[:] = acc_sc[:] * alpha + pv.reshape(kvg, d)
+
+
 def _kernel(last_ref, depth_ref, act_ref,      # scalar prefetch
             q_ref, k_ref, v_ref,               # blocks ([1,KV,TS,D])
-            o_ref,                             # out
-            m_sc, l_sc, acc_sc,                # scratch
-            *, ts: int, kv: int, g: int, d: int,
-            s_total: int, scale: float):
+            *rest,                             # [slopes], outs, scratch
+            ts: int, kv: int, g: int, d: int,
+            s_total: int, scale: float,
+            alibi: bool, partial: bool):
     from jax.experimental import pallas as pl
+
+    slopes_ref = None
+    if alibi:
+        slopes_ref, *rest = rest
+    if partial:
+        o_ref, m_ref, l_ref, m_sc, l_sc, acc_sc = rest
+    else:
+        (o_ref, m_sc, l_sc, acc_sc), m_ref, l_ref = rest, None, None
 
     r = pl.program_id(0)
     t = pl.program_id(1)
     nt = pl.num_programs(1)
-    kvg = kv * g
 
     @pl.when(t == 0)
     def _init():
-        m_sc[:] = jnp.full_like(m_sc, -1e30)
-        l_sc[:] = jnp.zeros_like(l_sc)
-        acc_sc[:] = jnp.zeros_like(acc_sc)
+        _init_scratch(m_sc, l_sc, acc_sc)
 
     @pl.when(t <= last_ref[r])
     def _step():
-        qv = q_ref[:].reshape(kv, g, d)
-        kt = k_ref[:].reshape(kv, ts, d)       # native layout: no swap
-        vt = v_ref[:].reshape(kv, ts, d)
-        # logits[kv, g, ts] = qv . kt (batch kv; contract d)
-        logits = jax.lax.dot_general(
-            qv, kt, (((2,), (2,)), ((0,), (0,))),
-            preferred_element_type=jnp.float32) * scale
-        span = (t * ts
-                + jax.lax.broadcasted_iota(jnp.int32, (1, ts), 1))
-        ok = (span <= depth_ref[r]) & (act_ref[r] > 0)     # [1, TS]
-        logits = jnp.where(ok[None, :, :] > 0, logits, -1e30)
-        l2 = logits.reshape(kvg, ts)
-        tile_max = jnp.max(l2, axis=-1, keepdims=True)     # [KVG, 1]
-        m_new = jnp.maximum(m_sc[:], tile_max)
-        alpha = jnp.exp(m_sc[:] - m_new)
-        # fully-masked lanes (inactive rows / no valid position yet) keep
-        # m_new at the -1e30 fill; exp(l2 - m_new) would be exp(0)=1
-        # there, silently averaging V — force p to 0 so l stays 0 and the
-        # finish-guard zeros the output
-        p = jnp.where(m_new > -1e29, jnp.exp(l2 - m_new), 0.0)
-        l_sc[:] = l_sc[:] * alpha + jnp.sum(p, axis=-1, keepdims=True)
-        m_sc[:] = m_new
-        # pv[kv, g, d] = p . vt (batch kv; contract ts).  vt's
-        # out-of-range pad columns (partial final S tile) may hold NaN;
-        # p is 0 there but 0*NaN = NaN, so zero them explicitly
-        col_ok = (t * ts + jax.lax.broadcasted_iota(
-            jnp.int32, (1, ts, 1), 1)) < s_total
-        vt = jnp.where(col_ok, vt, 0)
-        pv = jax.lax.dot_general(
-            p.reshape(kv, g, ts).astype(vt.dtype), vt,
-            (((2,), (1,)), ((0,), (0,))),
-            preferred_element_type=jnp.float32)
-        acc_sc[:] = acc_sc[:] * alpha + pv.reshape(kvg, d)
+        _online_softmax_step(r, t, depth_ref, act_ref, q_ref, k_ref,
+                             v_ref, slopes_ref, m_sc, l_sc, acc_sc,
+                             ts=ts, kv=kv, g=g, d=d, s_total=s_total,
+                             scale=scale)
 
     @pl.when(t == nt - 1)
     def _finish():
-        l = l_sc[:]
-        l = jnp.where(l == 0, 1.0, l)          # inactive rows: zeros out
-        o_ref[:] = (acc_sc[:] / l).reshape(1, kv * g, d).astype(
-            o_ref.dtype)
+        if partial:
+            # raw accumulators for the cross-shard flash merge: the sp
+            # combine rescales by exp(m - pmax(m)) and psums
+            o_ref[:] = acc_sc[:].reshape(1, kv * g, d)
+            m_ref[:] = m_sc[:].reshape(1, kv * g)
+            l_ref[:] = l_sc[:].reshape(1, kv * g)
+        else:
+            l = l_sc[:]
+            l = jnp.where(l == 0, 1.0, l)      # inactive rows: zeros out
+            o_ref[:] = (acc_sc[:] / l).reshape(1, kv * g, d).astype(
+                o_ref.dtype)
 
 
 def _pick_ts(S: int, KV: int, D: int,
@@ -116,17 +171,8 @@ def _pick_ts(S: int, KV: int, D: int,
     return 128
 
 
-@functools.partial(jax.jit,
-                   static_argnames=("scale", "interpret", "ts"))
-def flash_decode_attend(q, ck, cv, depth, active, scale: float,
-                        interpret: bool = False, ts=None):
-    """q [R,H,D] against cache [R,KV,S,D] masked to span<=depth[r]
-    -> [R,H,D].  VMEM = O(TS*KV*D), any S.  Inactive rows -> zeros.
-
-    The caller scatters the current token's K/V into the cache FIRST
-    (position depth[r]) — mirroring the production jnp path
-    (ops/serving_attention.py _scatter_chunk then _attend).
-    """
+def _attend_call(q, ck, cv, depth, active, scale, interpret, ts,
+                 slopes, partial: bool):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -140,26 +186,47 @@ def flash_decode_attend(q, ck, cv, depth, active, scale: float,
     depth = depth.astype(jnp.int32)
     active = active.astype(jnp.int32)
     # last tile each row needs; pruned tiles re-request that block index
-    # and Mosaic skips the duplicate DMA
-    last = jnp.minimum(depth // ts, nt - 1)
+    # and Mosaic skips the duplicate DMA.  Clamp below at 0: a sharded
+    # caller may pass negative local depths (shard above the query row's
+    # span — fully masked, gated by `active`), and a negative block
+    # index would walk off the cache
+    last = jnp.clip(depth // ts, 0, nt - 1)
 
+    alibi = slopes is not None
     kernel = functools.partial(_kernel, ts=ts, kv=KV, g=G, d=D,
-                               s_total=S, scale=float(scale))
+                               s_total=S, scale=float(scale),
+                               alibi=alibi, partial=partial)
+    in_specs = [
+        pl.BlockSpec((1, H, D), lambda r, t, *_: (r, 0, 0)),
+        pl.BlockSpec((1, KV, ts, D),
+                     lambda r, t, last, *_: (r, 0,
+                                             jnp.minimum(t, last[r]),
+                                             0)),
+        pl.BlockSpec((1, KV, ts, D),
+                     lambda r, t, last, *_: (r, 0,
+                                             jnp.minimum(t, last[r]),
+                                             0)),
+    ]
+    inputs = [q, ck, cv]
+    if alibi:
+        in_specs.append(pl.BlockSpec((H, 1), lambda r, t, *_: (0, 0)))
+        inputs.append(jnp.asarray(slopes, jnp.float32).reshape(H, 1))
+    out_spec = pl.BlockSpec((1, H, D), lambda r, t, *_: (r, 0, 0))
+    if partial:
+        out_specs = (out_spec,
+                     pl.BlockSpec((1, H), lambda r, t, *_: (r, 0)),
+                     pl.BlockSpec((1, H), lambda r, t, *_: (r, 0)))
+        out_shape = (jax.ShapeDtypeStruct((R, H, D), jnp.float32),
+                     jax.ShapeDtypeStruct((R, H), jnp.float32),
+                     jax.ShapeDtypeStruct((R, H), jnp.float32))
+    else:
+        out_specs = out_spec
+        out_shape = jax.ShapeDtypeStruct((R, H, D), q.dtype)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=3,
         grid=(R, nt),
-        in_specs=[
-            pl.BlockSpec((1, H, D), lambda r, t, *_: (r, 0, 0)),
-            pl.BlockSpec((1, KV, ts, D),
-                         lambda r, t, last, *_: (r, 0,
-                                                 jnp.minimum(t, last[r]),
-                                                 0)),
-            pl.BlockSpec((1, KV, ts, D),
-                         lambda r, t, last, *_: (r, 0,
-                                                 jnp.minimum(t, last[r]),
-                                                 0)),
-        ],
-        out_specs=pl.BlockSpec((1, H, D), lambda r, t, *_: (r, 0, 0)),
+        in_specs=in_specs,
+        out_specs=out_specs,
         scratch_shapes=[
             pltpu.VMEM((KV * G, 1), jnp.float32),   # running max
             pltpu.VMEM((KV * G, 1), jnp.float32),   # running sum
@@ -167,11 +234,39 @@ def flash_decode_attend(q, ck, cv, depth, active, scale: float,
         ],
     )
     return pl.pallas_call(
-        kernel, grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((R, H, D), q.dtype),
+        kernel, grid_spec=grid_spec, out_shape=out_shape,
         interpret=interpret,
-    )(last, depth, active, q, ck, cv)
+    )(last, depth, active, *inputs)
 
+
+@functools.partial(jax.jit,
+                   static_argnames=("scale", "interpret", "ts"))
+def flash_decode_attend(q, ck, cv, depth, active, scale: float,
+                        interpret: bool = False, ts=None, slopes=None):
+    """q [R,H,D] against cache [R,KV,S,D] masked to span<=depth[r]
+    -> [R,H,D].  VMEM = O(TS*KV*D), any S.  Inactive rows -> zeros.
+    ``slopes``: optional [H] ALiBi per-head slopes (adds
+    slope_h * (k_pos - depth_r) to the logits).
+
+    The caller scatters the current token's K/V into the cache FIRST
+    (position depth[r]) — mirroring the production jnp path
+    (ops/serving_attention.py _scatter_chunk then _attend).
+    """
+    return _attend_call(q, ck, cv, depth, active, scale, interpret, ts,
+                        slopes, partial=False)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("scale", "interpret", "ts"))
+def flash_decode_attend_partial(q, ck, cv, depth, active, scale: float,
+                                interpret: bool = False, ts=None,
+                                slopes=None):
+    """Partial (unnormalized) flash attend for cross-shard combines:
+    returns (acc [R,H,D] f32, m [R,H] f32, l [R,H] f32) where
+    out = acc / l after the standard flash merge across shards.  Rows or
+    shards with no valid position report m=-1e30, l=0, acc=0."""
+    return _attend_call(q, ck, cv, depth, active, scale, interpret, ts,
+                        slopes, partial=True)
 
 
 def _append_kernel(depth_ref, act_ref,           # scalar prefetch
@@ -236,7 +331,7 @@ def cache_append(ck, cv, k_new, v_new, depth, active,
 
     R, KV, S, D = ck.shape
     assert S % 16 == 0, S     # 16-aligned windows must stay in bounds
-    depth = jnp.minimum(depth.astype(jnp.int32), S - 1)
+    depth = jnp.clip(depth.astype(jnp.int32), 0, S - 1)
     active = active.astype(jnp.int32)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
@@ -265,7 +360,8 @@ def cache_append(ck, cv, k_new, v_new, depth, active,
 
 
 def flash_decode_attention(q, k_new, v_new, ck, cv, depth, active,
-                           scale: float, interpret: bool = False):
+                           scale: float, interpret: bool = False,
+                           slopes=None):
     """Scatter-then-attend decode step (drop-in for the op layer): writes
     the new token's K/V at each active row's depth (in place, Pallas
     DMA), then runs the length-tiled attention.  Caches are
@@ -273,15 +369,106 @@ def flash_decode_attention(q, k_new, v_new, ck, cv, depth, active,
     ck, cv = cache_append(ck, cv, k_new, v_new, depth, active,
                           interpret=interpret)
     out = flash_decode_attend(q, ck, cv, depth, active, scale,
-                              interpret=interpret)
+                              interpret=interpret, slopes=slopes)
     return out, ck, cv
+
+
+def mesh_axes(mesh):
+    """(tp_axis_or_None, sp_axis_or_None, tp_size, sp_size) of a serving
+    mesh; axes the mesh lacks report size 1."""
+    from ..config import AXIS_MODEL, AXIS_SEQ
+
+    shape = dict(mesh.shape)
+    tp_ax = AXIS_MODEL if AXIS_MODEL in shape else None
+    sp_ax = AXIS_SEQ if AXIS_SEQ in shape else None
+    return (tp_ax, sp_ax,
+            shape.get(AXIS_MODEL, 1), shape.get(AXIS_SEQ, 1))
+
+
+def flash_decode_attention_sharded(q, k_new, v_new, ck, cv, depth,
+                                   active, scale: float, mesh,
+                                   interpret: bool = False, slopes=None):
+    """shard_map'd scatter-then-attend decode step over the serving mesh.
+
+    tp shards the kv-head axis — heads are independent, so each shard
+    runs the plain kernel on its local heads (the reference TP-shards
+    its generation kernel by heads the same way,
+    inc_multihead_self_attention.cc:694-697).  sp shards the cache
+    length: only the shard owning position depth[r] appends the new
+    token; every shard computes a PARTIAL online softmax over its local
+    positions and the combine is the standard flash merge (pmax of
+    maxima, psum of rescaled l/acc) over 'sp'.
+
+    Global layouts (= serving cache_pspec): q/k_new/v_new
+    [R, heads over tp, D]; caches [R, KV over tp, S over sp, D];
+    depth/active replicated.  Returns (out [R,H,D], ck, cv) with out
+    sharded over tp like q.
+    """
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    tp_ax, sp_ax, tp, sp = mesh_axes(mesh)
+    head_spec = P(None, tp_ax, None)
+    cache_spec = P(None, tp_ax, sp_ax, None)
+    slope_spec = P(tp_ax)
+    has_alibi = slopes is not None
+    depth = depth.astype(jnp.int32)
+    active = active.astype(jnp.int32)
+
+    def body(q, kn, vn, ck, cv, depth, active, *sl):
+        sl = sl[0] if has_alibi else None
+        S_l = ck.shape[2]
+        s0 = (jax.lax.axis_index(sp_ax) * S_l) if sp > 1 else 0
+        loc = depth - s0                       # signed local depth
+        app_act = active * ((loc >= 0) & (loc < S_l))
+        ck, cv = cache_append(ck, cv, kn, vn, loc, app_act,
+                              interpret=interpret)
+        if sp <= 1:
+            out = flash_decode_attend(q, ck, cv, depth, active, scale,
+                                      interpret=interpret, slopes=sl)
+            return out, ck, cv
+        # shards wholly below the row's span (loc >= S_l) attend ALL
+        # their positions (span <= loc holds everywhere); shards above
+        # it (loc < 0) are fully masked via `active`
+        att_act = active * (loc >= 0)
+        acc, m, l = flash_decode_attend_partial(
+            q, ck, cv, loc, att_act, scale, interpret=interpret,
+            slopes=sl)
+        m_g = jax.lax.pmax(m, sp_ax)
+        coef = jnp.exp(m - m_g)                # fully-masked shard -> 0
+        l_g = jax.lax.psum(l * coef, sp_ax)
+        acc_g = jax.lax.psum(acc * coef[..., None], sp_ax)
+        out = acc_g / jnp.where(l_g == 0, 1.0, l_g)[..., None]
+        return out.astype(q.dtype), ck, cv
+
+    fn = shard_map(
+        body, mesh=mesh,
+        in_specs=(head_spec, head_spec, head_spec, cache_spec,
+                  cache_spec, P(), P())
+        + ((slope_spec,) if has_alibi else ()),
+        out_specs=(head_spec, cache_spec, cache_spec),
+        check_rep=False)
+    args = (q, k_new, v_new, ck, cv, depth, active)
+    if has_alibi:
+        args += (jnp.asarray(slopes, jnp.float32),)
+    return fn(*args)
 
 
 def flash_path_ok(C: int, ck, mesh) -> bool:
     """Shape gate for the production op (consumed by
-    serving_attention._flash_decode_ok): single-token decode, unsharded
-    cache, lane-aligned head dim.  WHETHER flash beats the XLA attend is
-    the host's cost decision (inference_manager.flash_wins) — this only
-    says the kernel can run."""
+    serving_attention._flash_decode_ok): single-token decode with a
+    lane-aligned head dim, on an unsharded cache OR one sharded over
+    the tp (kv heads) / sp (length) serving axes with shard-aligned
+    extents.  WHETHER flash beats the XLA attend is the host's cost
+    decision (inference_manager.flash_wins) — this only says the kernel
+    can run."""
     R, KV, S, D = ck.shape
-    return C == 1 and mesh is None and D % 128 == 0
+    if C != 1 or D % 128 != 0:
+        return False
+    if mesh is None:
+        return True
+    tp_ax, sp_ax, tp, sp = mesh_axes(mesh)
+    other = [a for a, s in mesh.shape.items()
+             if s > 1 and a not in (tp_ax, sp_ax)]
+    return (not other and KV % tp == 0 and S % sp == 0
+            and (S // sp) % 16 == 0)

@@ -29,6 +29,14 @@ Per-(row, C-tile) tile pruning: queries in C-tile c attend positions
 the same K/V block for every S-tile past the tile's last needed one;
 Mosaic skips the duplicate DMA and @pl.when skips the compute.  Rows
 whose prompt span ends before the C-tile prune to a single tile.
+
+r5 additions (mirroring kernels/flash_decode.py):
+- ALiBi slopes (MPT position bias) as a fused add on the logits tile.
+- Sharded meshes: ``flash_prefill_attention_sharded`` shard_maps over
+  tp (kv heads — independent) and sp (cache length — partial online
+  softmax per shard + the standard flash merge over 'sp'); the chunk
+  append handles chunks STRADDLING sp shard boundaries (each shard
+  overlays its intersection of [depth, depth+ntok)).
 """
 
 from __future__ import annotations
@@ -41,11 +49,19 @@ import jax.numpy as jnp
 
 def _kernel(last_ref, depth_ref, ntok_ref, act_ref,   # scalar prefetch
             q_ref, k_ref, v_ref,                      # blocks
-            o_ref,                                    # out
-            m_sc, l_sc, acc_sc,                       # scratch
-            *, ts: int, tc: int, kv: int, g: int, d: int,
-            s_total: int, scale: float):
+            *rest,                                    # [slopes], outs, scr
+            ts: int, tc: int, kv: int, g: int, d: int,
+            s_total: int, scale: float,
+            alibi: bool, partial: bool):
     from jax.experimental import pallas as pl
+
+    slopes_ref = None
+    if alibi:
+        slopes_ref, *rest = rest
+    if partial:
+        o_ref, m_ref, l_ref, m_sc, l_sc, acc_sc = rest
+    else:
+        (o_ref, m_sc, l_sc, acc_sc), m_ref, l_ref = rest, None, None
 
     r = pl.program_id(0)
     c = pl.program_id(1)
@@ -76,8 +92,18 @@ def _kernel(last_ref, depth_ref, ntok_ref, act_ref,   # scalar prefetch
         sj = t * ts + jax.lax.broadcasted_iota(
             jnp.int32, (g, tc, ts), 2).reshape(g * tc, ts)
         qpos = depth_ref[r] + c * tc + ci
-        ok = ((sj <= qpos) & (c * tc + ci < ntok_ref[r])
-              & (act_ref[r] > 0))
+        if slopes_ref is not None:
+            # ALiBi: slope_h * (k_pos - q_pos); under sp sharding both
+            # positions are shard-local so the difference stays global
+            rel = (sj - qpos).astype(jnp.float32)     # [G*TC, TS]
+            # slopes arrive pre-expanded [KV, G*TC] (lane order (g, ci))
+            bias = slopes_ref[:][:, :, None] * rel[None, :, :]
+            logits = logits + bias
+        # sj < s_total guards the padded tail of a partial final tile
+        # (sharded callers pass local depths that may exceed the local
+        # extent, so sj <= qpos does not exclude the pad by itself)
+        ok = ((sj <= qpos) & (sj < s_total)
+              & (c * tc + ci < ntok_ref[r]) & (act_ref[r] > 0))
         logits = jnp.where(ok[None], logits, -1e30)
         l2 = logits.reshape(rows, ts)
         tile_max = jnp.max(l2, axis=-1, keepdims=True)
@@ -101,10 +127,15 @@ def _kernel(last_ref, depth_ref, ntok_ref, act_ref,   # scalar prefetch
 
     @pl.when(t == nt - 1)
     def _finish():
-        l = l_sc[:]
-        l = jnp.where(l == 0, 1.0, l)          # invalid queries: zeros
-        o_ref[:] = (acc_sc[:] / l).reshape(1, kv, g, tc, d).astype(
-            o_ref.dtype)
+        if partial:
+            o_ref[:] = acc_sc[:].reshape(1, kv, g, tc, d)
+            m_ref[:] = m_sc[:].reshape(1, 1, rows)
+            l_ref[:] = l_sc[:].reshape(1, 1, rows)
+        else:
+            l = l_sc[:]
+            l = jnp.where(l == 0, 1.0, l)      # invalid queries: zeros
+            o_ref[:] = (acc_sc[:] / l).reshape(1, kv, g, tc, d).astype(
+                o_ref.dtype)
 
 
 def _pick_tiles(C: int, S: int, KV: int, G: int, D: int):
@@ -121,27 +152,8 @@ def _pick_tiles(C: int, S: int, KV: int, G: int, D: int):
     return tc, ts
 
 
-@functools.partial(jax.jit,
-                   static_argnames=("scale", "interpret", "tc", "ts",
-                                    "s_bound"))
-def flash_prefill_attend(q, ck, cv, depth, ntok, active, scale: float,
-                         interpret: bool = False, tc=None, ts=None,
-                         s_bound=None):
-    """q [R,C,H,D] against cache [R,KV,S,D], causal at per-row offset
-    ``depth`` (query c attends cache positions <= depth[r]+c, queries
-    c >= ntok[r] and inactive rows produce zeros) -> [R,C,H,D].
-
-    ``s_bound``: static upper bound on attended positions (the host's
-    attend bucket, >= every depth+ntok).  It bounds the GRID, not just
-    the mask: without it a shallow chunk still cycles cdiv(S, ts) grid
-    steps per (row, C-tile) whose pruned programs cost ~1-2 us each —
-    at 24 layers x 8 C-tiles that fixed overhead erased the kernel's
-    win on the early chunks of a long prompt.
-
-    The caller scatters the chunk's K/V into the cache FIRST
-    (positions [depth, depth+ntok)), mirroring the jnp path
-    (ops/serving_attention.py _scatter_chunk then _attend).
-    """
+def _prefill_call(q, ck, cv, depth, ntok, active, scale, interpret,
+                  tc, ts, s_bound, slopes, partial: bool):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -161,6 +173,7 @@ def flash_prefill_attend(q, ck, cv, depth, ntok, active, scale: float,
     # last S-tile each (row, C-tile) needs: its highest real query sits
     # at depth + min((c+1)*tc, ntok) - 1.  C-tiles past the row's span
     # (or inactive rows) clamp to tile 0 — one DMA, compute skipped.
+    # Clamp below at 0: sharded callers pass signed local depths.
     qmax = jnp.minimum((jnp.arange(nc, dtype=jnp.int32) + 1) * tc,
                        ntok[:, None])                      # [R, NC]
     has_q = (jnp.arange(nc, dtype=jnp.int32) * tc < ntok[:, None])
@@ -171,50 +184,139 @@ def flash_prefill_attend(q, ck, cv, depth, ntok, active, scale: float,
     # pre-transpose q once in XLA: [R,C,H,D] -> [R,KV,G,C,D]
     qt = q.reshape(R, C, KV, G, D).transpose(0, 2, 3, 1, 4)
 
+    alibi = slopes is not None
     kernel = functools.partial(_kernel, ts=ts, tc=tc, kv=KV, g=G, d=D,
-                               s_total=S, scale=float(scale))
+                               s_total=S, scale=float(scale),
+                               alibi=alibi, partial=partial)
+    in_specs = [
+        pl.BlockSpec((1, KV, G, tc, D),
+                     lambda r, c, t, *_: (r, 0, 0, c, 0)),
+        pl.BlockSpec((1, KV, ts, D),
+                     lambda r, c, t, last, *_: (
+                         r, 0, jnp.minimum(t, last[r, c]), 0)),
+        pl.BlockSpec((1, KV, ts, D),
+                     lambda r, c, t, last, *_: (
+                         r, 0, jnp.minimum(t, last[r, c]), 0)),
+    ]
+    inputs = [qt, ck, cv]
+    if alibi:
+        # per-KV-head slopes: within a kv group the G query heads have
+        # distinct slopes, so ship the full [H] table reshaped [KV, G]
+        # and index it [kv, g*tc] in-kernel — but g*tc interleaves g and
+        # ci, so expand to [KV, G*TC] host-side instead (tiny)
+        sl = jnp.broadcast_to(
+            jnp.asarray(slopes, jnp.float32).reshape(KV, G, 1),
+            (KV, G, tc)).reshape(KV, G * tc)
+        in_specs.append(
+            pl.BlockSpec((KV, G * tc), lambda r, c, t, *_: (0, 0)))
+        inputs.append(sl)
+    out_spec = pl.BlockSpec((1, KV, G, tc, D),
+                            lambda r, c, t, *_: (r, 0, 0, c, 0))
+    if partial:
+        out_specs = (out_spec,
+                     pl.BlockSpec((1, 1, KV * G * tc),
+                                  lambda r, c, t, *_: (r, c, 0)),
+                     pl.BlockSpec((1, 1, KV * G * tc),
+                                  lambda r, c, t, *_: (r, c, 0)))
+        out_shape = (
+            jax.ShapeDtypeStruct((R, KV, G, C, D), jnp.float32),
+            jax.ShapeDtypeStruct((R, nc, KV * G * tc), jnp.float32),
+            jax.ShapeDtypeStruct((R, nc, KV * G * tc), jnp.float32))
+    else:
+        out_specs = out_spec
+        out_shape = jax.ShapeDtypeStruct((R, KV, G, C, D), q.dtype)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=4,
         grid=(R, nc, nt),
-        in_specs=[
-            pl.BlockSpec((1, KV, G, tc, D),
-                         lambda r, c, t, *_: (r, 0, 0, c, 0)),
-            pl.BlockSpec((1, KV, ts, D),
-                         lambda r, c, t, last, *_: (
-                             r, 0, jnp.minimum(t, last[r, c]), 0)),
-            pl.BlockSpec((1, KV, ts, D),
-                         lambda r, c, t, last, *_: (
-                             r, 0, jnp.minimum(t, last[r, c]), 0)),
-        ],
-        out_specs=pl.BlockSpec((1, KV, G, tc, D),
-                               lambda r, c, t, *_: (r, 0, 0, c, 0)),
+        in_specs=in_specs,
+        out_specs=out_specs,
         scratch_shapes=[
             pltpu.VMEM((KV * G * tc, 1), jnp.float32),   # running max
             pltpu.VMEM((KV * G * tc, 1), jnp.float32),   # running sum
             pltpu.VMEM((KV * G * tc, D), jnp.float32),   # accumulator
         ],
     )
-    out = pl.pallas_call(
-        kernel, grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((R, KV, G, C, D), q.dtype),
+    return pl.pallas_call(
+        kernel, grid_spec=grid_spec, out_shape=out_shape,
         interpret=interpret,
-    )(last, depth, ntok, active, qt, ck, cv)
+    )(last, depth, ntok, active, *inputs)
+
+
+def _ml_to_heads(ml, R, nc, tc, KV, G):
+    """[R, NC, KV*G*TC] kernel layout -> [R, KV, G, NC*TC] (= C)."""
+    return (ml.reshape(R, nc, KV, G, tc)
+              .transpose(0, 2, 3, 1, 4).reshape(R, KV, G, nc * tc))
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("scale", "interpret", "tc", "ts",
+                                    "s_bound"))
+def flash_prefill_attend(q, ck, cv, depth, ntok, active, scale: float,
+                         interpret: bool = False, tc=None, ts=None,
+                         s_bound=None, slopes=None):
+    """q [R,C,H,D] against cache [R,KV,S,D], causal at per-row offset
+    ``depth`` (query c attends cache positions <= depth[r]+c, queries
+    c >= ntok[r] and inactive rows produce zeros) -> [R,C,H,D].
+    ``slopes``: optional [H] ALiBi per-head slopes.
+
+    ``s_bound``: static upper bound on attended positions (the host's
+    attend bucket, >= every depth+ntok).  It bounds the GRID, not just
+    the mask: without it a shallow chunk still cycles cdiv(S, ts) grid
+    steps per (row, C-tile) whose pruned programs cost ~1-2 us each —
+    at 24 layers x 8 C-tiles that fixed overhead erased the kernel's
+    win on the early chunks of a long prompt.
+
+    The caller scatters the chunk's K/V into the cache FIRST
+    (positions [depth, depth+ntok)), mirroring the jnp path
+    (ops/serving_attention.py _scatter_chunk then _attend).
+    """
+    R, C, H, D = q.shape
+    out = _prefill_call(q, ck, cv, depth, ntok, active, scale,
+                        interpret, tc, ts, s_bound, slopes,
+                        partial=False)
     # [R,KV,G,C,D] -> [R,C,H,D]
     return out.transpose(0, 3, 1, 2, 4).reshape(R, C, H, D)
 
 
-def _append_kernel(base_ref, off_ref, ntok_ref, act_ref,  # scalar prefetch
+@functools.partial(jax.jit,
+                   static_argnames=("scale", "interpret", "tc", "ts",
+                                    "s_bound"))
+def flash_prefill_attend_partial(q, ck, cv, depth, ntok, active,
+                                 scale: float, interpret: bool = False,
+                                 tc=None, ts=None, s_bound=None,
+                                 slopes=None):
+    """Partial (unnormalized) flash prefill for cross-shard combines:
+    returns (acc [R,KV,G,C,D] f32, m [R,KV,G,C] f32, l [R,KV,G,C] f32)
+    where out = acc / l after the standard flash merge across shards."""
+    from jax.experimental import pallas as pl
+
+    R, C, H, D = q.shape
+    KV = ck.shape[1]
+    G = H // KV
+    tc0, ts0 = _pick_tiles(C, ck.shape[2], KV, G, D)
+    tc, ts = tc or tc0, ts or ts0
+    acc, m, l = _prefill_call(q, ck, cv, depth, ntok, active, scale,
+                              interpret, tc, ts, s_bound, slopes,
+                              partial=True)
+    nc = C // tc
+    return (acc, _ml_to_heads(m, R, nc, tc, KV, G),
+            _ml_to_heads(l, R, nc, tc, KV, G))
+
+
+def _append_kernel(base_ref, roll_ref, lo_ref, hi_ref, act_ref,  # prefetch
                    kal_ref, val_ref,     # VMEM [1, KV, W, D] row blocks
                    ck_hbm, cv_hbm,               # ANY (aliased inputs)
                    ck_out, cv_out,               # aliased outputs
                    win_k, win_v, sem_k, sem_v):
     """Per-row in-place chunk append: overlay the row's 16-aligned
-    window [base, base+W) with the pre-aligned new K/V on positions
-    [off, off+ntok) (window-relative).  Same rationale as
-    flash_decode._append_kernel: with both the append and the attend as
-    Pallas calls the cache never crosses an XLA layout boundary (XLA
-    prefers S-major for its own scatter and inserts whole-cache relayout
-    copies at custom-call boundaries — measured ~9 ms/step at 1.4B/8k)."""
+    window [base, base+W) with the pre-aligned new K/V on the window-
+    relative span [lo, hi) (chunk entry jj - shift lands at window
+    position jj; the rotate amount arrives pre-reduced mod W in
+    ``roll``).  Same rationale as flash_decode._append_kernel: with
+    both the append and the attend as Pallas calls the cache never
+    crosses an XLA layout boundary (XLA prefers S-major for its own
+    scatter and inserts whole-cache relayout copies at custom-call
+    boundaries — measured ~9 ms/step at 1.4B/8k)."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -236,10 +338,10 @@ def _append_kernel(base_ref, off_ref, ntok_ref, act_ref,  # scalar prefetch
         ink.wait()
         inv.wait()
         jj = jax.lax.broadcasted_iota(jnp.int32, (1, W, 1), 1)
-        sel = (jj >= off_ref[r]) & (jj < off_ref[r] + ntok_ref[r])
+        sel = (jj >= lo_ref[r]) & (jj < hi_ref[r])
         # align the zero-padded chunk to the window offset with a
-        # dynamic sublane rotate (entry j of the rolled chunk is
-        # chunk[j - off]; wrapped entries land outside sel's range) —
+        # dynamic sublane rotate (entry jj of the rolled chunk is
+        # chunk[jj - shift]; wrapped entries land outside sel's range) —
         # doing this shift in XLA was a take_along_axis gather measured
         # at ~1.5 ms/layer, ~60% of a whole flash prefill step.  The
         # rotate is per-kv-head 2D (tpu.dynamic_rotate rejects 3D
@@ -250,12 +352,12 @@ def _append_kernel(base_ref, off_ref, ntok_ref, act_ref,  # scalar prefetch
         for i in range(kv):
             win_k[i] = jnp.where(
                 sel[0],
-                pltpu.roll(kal_ref[0, i], off_ref[r], 0).astype(
+                pltpu.roll(kal_ref[0, i], roll_ref[r], 0).astype(
                     win_k.dtype),
                 win_k[i])
             win_v[i] = jnp.where(
                 sel[0],
-                pltpu.roll(val_ref[0, i], off_ref[r], 0).astype(
+                pltpu.roll(val_ref[0, i], roll_ref[r], 0).astype(
                     win_v.dtype),
                 win_v[i])
         outk = pltpu.make_async_copy(
@@ -269,7 +371,7 @@ def _append_kernel(base_ref, off_ref, ntok_ref, act_ref,  # scalar prefetch
 
 
 def chunk_append(ck, cv, k_new, v_new, depth, ntok, active,
-                 interpret: bool = False):
+                 interpret: bool = False, s_offset=None):
     """In-place (aliased) chunk KV append on [R,KV,S,D] caches via async
     DMA — the Pallas twin of _scatter_chunk for the flash-prefill path.
 
@@ -277,7 +379,13 @@ def chunk_append(ck, cv, k_new, v_new, depth, ntok, active,
     transposes and zero-pads them to the window extent (cheap, fused),
     while the per-row shift to the 16-aligned window offset happens
     inside the kernel as a dynamic sublane rotate; the kernel does a
-    masked overlay read-modify-write of the [base, base+C+32) window."""
+    masked overlay read-modify-write of the [base, base+C+32) window.
+
+    ``s_offset``: global position of this cache's first slot (sharded
+    callers).  The row's local span [depth-s_offset, +ntok) may partly
+    or wholly miss [0, S) — the overlay writes just the intersection,
+    so a chunk straddling sp shard boundaries appends correctly with
+    each shard taking its piece."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -288,8 +396,11 @@ def chunk_append(ck, cv, k_new, v_new, depth, ntok, active,
     depth = depth.astype(jnp.int32)
     ntok = jnp.minimum(ntok.astype(jnp.int32), C)
     active = active.astype(jnp.int32)
-    base = jnp.minimum((depth // 16) * 16, S - W)
-    off = depth - base                                   # [R] in [0, 32]
+    loc = depth - s_offset if s_offset is not None else depth  # signed
+    active = active * ((loc < S) & (loc + ntok > 0))
+    base = jnp.clip((jnp.maximum(loc, 0) // 16) * 16, 0, S - W)
+    shift = loc - base                 # window pos of chunk entry 0
+    roll = shift % W                   # nonneg rotate amount
     pad = [(0, 0), (0, 0), (0, W - C), (0, 0)]
     # f32 staging: the in-kernel dynamic rotate needs 32-bit data
     k_al = jnp.pad(k_new.transpose(0, 2, 1, 3),          # [R, KV, W, D]
@@ -298,7 +409,7 @@ def chunk_append(ck, cv, k_new, v_new, depth, ntok, active,
                    pad).astype(jnp.float32)
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=4,
+        num_scalar_prefetch=5,
         grid=(R,),
         in_specs=[
             # per-row blocks: whole-array VMEM staging would put
@@ -320,14 +431,15 @@ def chunk_append(ck, cv, k_new, v_new, depth, ntok, active,
         _append_kernel, grid_spec=grid_spec,
         out_shape=(jax.ShapeDtypeStruct(ck.shape, ck.dtype),
                    jax.ShapeDtypeStruct(cv.shape, cv.dtype)),
-        input_output_aliases={6: 0, 7: 1},   # +4 scalar-prefetch args
+        input_output_aliases={7: 0, 8: 1},   # +5 scalar-prefetch args
         interpret=interpret,
-    )(base // 16, off, ntok, active, k_al, v_al, ck, cv)
+    )(base // 16, roll, shift, shift + ntok, active, k_al, v_al, ck, cv)
 
 
 def flash_prefill_attention(q, k_new, v_new, ck, cv, depth, ntok,
                             active, scale: float,
-                            interpret: bool = False, s_bound=None):
+                            interpret: bool = False, s_bound=None,
+                            slopes=None):
     """Scatter-then-attend prefill step (drop-in for the op layer):
     writes the chunk's K/V at each active row's [depth, depth+ntok)
     (in place, Pallas DMA), then runs the length-tiled attention.
@@ -337,25 +449,107 @@ def flash_prefill_attention(q, k_new, v_new, ck, cv, depth, ntok,
     ck, cv = chunk_append(ck, cv, k_new, v_new, depth, ntok, active,
                           interpret=interpret)
     out = flash_prefill_attend(q, ck, cv, depth, ntok, active, scale,
-                               interpret=interpret, s_bound=s_bound)
+                               interpret=interpret, s_bound=s_bound,
+                               slopes=slopes)
     return out, ck, cv
 
 
+def flash_prefill_attention_sharded(q, k_new, v_new, ck, cv, depth,
+                                    ntok, active, scale: float, mesh,
+                                    interpret: bool = False,
+                                    slopes=None):
+    """shard_map'd scatter-then-attend prefill over the serving mesh —
+    the chunked-prefill twin of
+    flash_decode.flash_decode_attention_sharded.
+
+    tp shards the kv-head axis (independent heads, no collective); sp
+    shards the cache length: each shard appends its INTERSECTION of the
+    chunk span [depth, depth+ntok) (chunk_append's s_offset handling),
+    runs a partial online softmax over its local positions, and the
+    outputs merge with the standard flash combine over 'sp'.
+    """
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from .flash_decode import mesh_axes
+
+    tp_ax, sp_ax, tp, sp = mesh_axes(mesh)
+    q_spec = P(None, None, tp_ax, None)        # [R, C, H, D]
+    cache_spec = P(None, tp_ax, sp_ax, None)
+    slope_spec = P(tp_ax)
+    has_alibi = slopes is not None
+    depth = depth.astype(jnp.int32)
+    ntok = ntok.astype(jnp.int32)
+    active = active.astype(jnp.int32)
+
+    def body(q, kn, vn, ck, cv, depth, ntok, active, *sl):
+        sl = sl[0] if has_alibi else None
+        S_l = ck.shape[2]
+        s0 = (jax.lax.axis_index(sp_ax) * S_l) if sp > 1 else 0
+        ck, cv = chunk_append(ck, cv, kn, vn, depth, ntok, active,
+                              interpret=interpret, s_offset=s0)
+        if sp <= 1:
+            out = flash_prefill_attend(q, ck, cv, depth, ntok, active,
+                                       scale, interpret=interpret,
+                                       slopes=sl)
+            return out, ck, cv
+        loc = depth - s0
+        # shards wholly above every query of the row (loc + ntok <= 0)
+        # are fully masked; sj <= qpos handles partial overlap since
+        # both are local
+        att_act = active * (loc + ntok > 0)
+        acc, m, l = flash_prefill_attend_partial(
+            q, ck, cv, loc, ntok, att_act, scale, interpret=interpret,
+            slopes=sl)
+        m_g = jax.lax.pmax(m, sp_ax)
+        coef = jnp.exp(m - m_g)                # fully-masked shard -> 0
+        l_g = jax.lax.psum(l * coef, sp_ax)
+        acc_g = jax.lax.psum(acc * coef[..., None], sp_ax)
+        out = acc_g / jnp.where(l_g == 0, 1.0, l_g)[..., None]
+        R, KV, G, C, D = out.shape
+        out = out.transpose(0, 3, 1, 2, 4).reshape(R, C, KV * G, D)
+        return out.astype(q.dtype), ck, cv
+
+    fn = shard_map(
+        body, mesh=mesh,
+        in_specs=(q_spec, q_spec, q_spec, cache_spec, cache_spec,
+                  P(), P(), P())
+        + ((slope_spec,) if has_alibi else ()),
+        out_specs=(q_spec, cache_spec, cache_spec),
+        check_rep=False)
+    args = (q, k_new, v_new, ck, cv, depth, ntok, active)
+    if has_alibi:
+        args += (jnp.asarray(slopes, jnp.float32),)
+    return fn(*args)
+
+
 def prefill_path_ok(C: int, ck, mesh) -> bool:
-    """Shape gate for the production op: multi-token chunk on an
-    unsharded cache with lane-aligned head dim and a 16-divisible chunk
-    (the append window arithmetic), and an append window that FITS VMEM
-    — the per-row window carries 8 bytes/position/KV-head/lane for the
-    f32-staged chunk (k_al + v_al) plus 2 x cache-dtype for the win
-    scratch, so wide-KV models (7B-class MHA, KV=32) cap at small
-    chunks and a bf16 KV=4/D=128 cache caps at ~C<=1750 (the C=2048
-    case, ~12.8 MB, failed Mosaic compilation on chip; the 11 MB budget
-    keeps a margin below that single calibration point).  WHETHER flash
-    beats the XLA attend is the host's cost decision
+    """Shape gate for the production op: multi-token chunk with
+    lane-aligned head dim and a 16-divisible chunk (the append window
+    arithmetic), an append window that FITS VMEM — the per-row window
+    carries 8 bytes/position/KV-head/lane for the f32-staged chunk
+    (k_al + v_al) plus 2 x cache-dtype for the win scratch, so wide-KV
+    models (7B-class MHA, KV=32) cap at small chunks and a bf16
+    KV=4/D=128 cache caps at ~C<=1750 (the C=2048 case, ~12.8 MB,
+    failed Mosaic compilation on chip; the 11 MB budget keeps a margin
+    below that single calibration point) — and an unsharded cache OR
+    one sharded over tp/sp with shard-aligned extents (the per-SHARD
+    window/VMEM limits are what count).  WHETHER flash beats the XLA
+    attend is the host's cost decision
     (inference_manager.flash_prefill_wins) — this only says the kernel
     can run."""
     R, KV, S, D = ck.shape
-    append_vmem = (C + 32) * KV * D * (8 + 2 * ck.dtype.itemsize)
-    return (C >= 16 and C % 16 == 0 and mesh is None
-            and D % 128 == 0 and S % 16 == 0 and C + 32 <= S
+    tp = sp = 1
+    if mesh is not None:
+        from .flash_decode import mesh_axes
+
+        tp_ax, sp_ax, tp, sp = mesh_axes(mesh)
+        other = [a for a, s in mesh.shape.items()
+                 if s > 1 and a not in (tp_ax, sp_ax)]
+        if other or KV % tp or S % sp or (S // sp) % 16:
+            return False
+    kv_l, s_l = KV // tp, S // sp
+    append_vmem = (C + 32) * kv_l * D * (8 + 2 * ck.dtype.itemsize)
+    return (C >= 16 and C % 16 == 0
+            and D % 128 == 0 and s_l % 16 == 0 and C + 32 <= s_l
             and append_vmem <= 11 * 1024 * 1024)

@@ -60,6 +60,12 @@ class ONNXModel:
         # fx-importer-style porting map: framework layer name ->
         # (weight initializer name, bias initializer name, transpose)
         self.param_layers: Dict[str, tuple] = {}
+        # r5 (transformer-block graphs): direct numpy ports — framework
+        # layer name -> {param name: ndarray}; used where the value may
+        # come from a Constant/Identity chain instead of an initializer
+        self.param_arrays: Dict[str, Dict[str, np.ndarray]] = {}
+        # Add nodes folded into a preceding biasless MatMul-dense
+        self._folded_adds: set = set()
 
     def _attrs(self, node) -> Dict[str, Any]:
         return {a.name: self._attr_value(a) for a in node.attribute}
@@ -67,6 +73,22 @@ class ONNXModel:
     def _init(self, name: str):
         return next(i for i in self.proto.graph.initializer
                     if i.name == name)
+
+    def _is_const(self, name: str, env) -> bool:
+        """True when ``name`` resolves to host data (an initializer, or
+        a Constant/Identity product stored as numpy in env)."""
+        if isinstance(env.get(name), np.ndarray):
+            return True
+        return any(i.name == name for i in self.proto.graph.initializer)
+
+    def _const(self, name: str, env) -> np.ndarray:
+        v = env.get(name)
+        if isinstance(v, np.ndarray):
+            return v
+        return np.asarray(self._to_array(self._init(name)))
+
+    def _consumers(self, out_name: str):
+        return [n for n in self.proto.graph.node if out_name in n.input]
 
     def apply(self, ffmodel: Model, inputs: Sequence[Tensor]) -> List[Tensor]:
         g = self.proto.graph
@@ -81,7 +103,10 @@ class ONNXModel:
             handler = getattr(self, f"_handle_{node.op_type.lower()}", None)
             if handler is None:
                 raise UnsupportedOnnxOp(node.op_type)
-            env[node.output[0]] = handler(ffmodel, node, env)
+            out = handler(ffmodel, node, env)
+            outs = out if isinstance(out, (list, tuple)) else [out]
+            for name, val in zip(node.output, outs):
+                env[name] = val
         return [env[o.name] for o in g.output]
 
     def port_parameters(self, ffmodel: Model) -> None:
@@ -97,6 +122,12 @@ class ONNXModel:
             if b_name is not None:
                 p["bias"] = np.asarray(
                     self._to_array(self._init(b_name))).copy()
+        for lname, arrays in self.param_arrays.items():
+            p = ffmodel.params.get(lname)
+            if p is None:
+                continue
+            for pn, arr in arrays.items():
+                p[pn] = np.asarray(arr).copy()
 
     # ------------------------------------------------------------ handlers
     def _handle_gemm(self, ff, node, env):
@@ -113,7 +144,38 @@ class ONNXModel:
         return y
 
     def _handle_matmul(self, ff, node, env):
-        return ff.batch_matmul(env[node.input[0]], env[node.input[1]])
+        """x @ W with a host-side weight becomes a Dense layer (the
+        TorchScript exporter emits Linear as MatMul [+ Add bias], weight
+        pre-transposed to [in, out]); a following Add whose other operand
+        is host data is folded in as the dense bias.  Tensor x tensor
+        MatMul (attention q@k^T, att@v) stays a batched matmul."""
+        a_name, b_name = node.input[0], node.input[1]
+        if not self._is_const(b_name, env):
+            return ff.batch_matmul(env[a_name], env[b_name])
+        w = self._const(b_name, env)                 # [in, out]
+        assert w.ndim == 2, w.shape
+        bias_arr = None
+        consumers = self._consumers(node.output[0])
+        graph_outs = {o.name for o in self.proto.graph.output}
+        if (len(consumers) == 1 and consumers[0].op_type == "Add"
+                # folding rewrites env[matmul_out] to the biased value,
+                # so a matmul output that is ALSO a graph output (or an
+                # Add using it for both operands) must not fold
+                and node.output[0] not in graph_outs):
+            addn = consumers[0]
+            others = [i for i in addn.input if i != node.output[0]]
+            if others and self._is_const(others[0], env):
+                b = self._const(others[0], env)
+                if b.ndim == 1 and b.shape[0] == w.shape[1]:
+                    bias_arr = b
+                    self._folded_adds.add(id(addn))
+        y = ff.dense(env[a_name], int(w.shape[1]),
+                     use_bias=bias_arr is not None)
+        port = {"kernel": w}
+        if bias_arr is not None:
+            port["bias"] = bias_arr
+        self.param_arrays[y.owner_layer.name] = port
+        return y
 
     def _handle_relu(self, ff, node, env):
         return ff.relu(env[node.input[0]])
@@ -132,13 +194,39 @@ class ONNXModel:
         return ff.flat(env[node.input[0]])
 
     def _handle_add(self, ff, node, env):
-        return ff.add(env[node.input[0]], env[node.input[1]])
+        if id(node) in self._folded_adds:        # dense-bias add: folded
+            tensor_in = next(i for i in node.input
+                             if not self._is_const(i, env))
+            return env[tensor_in]
+        x, y = env[node.input[0]], env[node.input[1]]
+        if isinstance(x, np.ndarray):
+            x, y = y, x
+        if isinstance(y, np.ndarray):
+            if y.ndim == 0 or y.size == 1:
+                return ff.scalar_add(x, float(np.reshape(y, ())))
+            raise UnsupportedOnnxOp(
+                "Add with non-scalar constant operand (unfolded bias)")
+        return ff.add(x, y)
 
     def _handle_sub(self, ff, node, env):
-        return ff.subtract(env[node.input[0]], env[node.input[1]])
+        x, y = env[node.input[0]], env[node.input[1]]
+        if isinstance(y, np.ndarray):
+            if y.ndim == 0 or y.size == 1:
+                return ff.scalar_sub(x, float(np.reshape(y, ())))
+            raise UnsupportedOnnxOp("Sub with non-scalar constant operand")
+        if isinstance(x, np.ndarray):
+            raise UnsupportedOnnxOp("Sub with constant minuend")
+        return ff.subtract(x, y)
 
     def _handle_mul(self, ff, node, env):
-        return ff.multiply(env[node.input[0]], env[node.input[1]])
+        x, y = env[node.input[0]], env[node.input[1]]
+        if isinstance(x, np.ndarray):
+            x, y = y, x
+        if isinstance(y, np.ndarray):
+            if y.ndim == 0 or y.size == 1:
+                return ff.scalar_multiply(x, float(np.reshape(y, ())))
+            raise UnsupportedOnnxOp("Mul with non-scalar constant operand")
+        return ff.multiply(x, y)
 
     def _handle_concat(self, ff, node, env):
         return ff.concat([env[i] for i in node.input],
@@ -178,8 +266,70 @@ class ONNXModel:
         return ff.dropout(env[node.input[0]], rate=a.get("ratio", 0.5))
 
     def _handle_identity(self, ff, node, env):
-        return env[node.input[0]]
+        name = node.input[0]
+        if self._is_const(name, env) and name not in env:
+            return self._const(name, env)   # initializer alias (tied LN)
+        return env[name]
+
+    def _handle_constant(self, ff, node, env):
+        a = self._attrs(node)
+        for key in ("value", "value_float", "value_int", "value_floats",
+                    "value_ints"):
+            if key in a:
+                v = a[key]
+                if key == "value":
+                    v = self._to_array(v)
+                return np.asarray(v)
+        raise UnsupportedOnnxOp(f"Constant with attrs {sorted(a)}")
 
     def _handle_reshape(self, ff, node, env):
-        raise UnsupportedOnnxOp(
-            "Reshape with runtime shape tensor; export static shapes")
+        """Static-shape reshape (the TorchScript exporter emits the
+        target shape as a Constant when the traced model used concrete
+        dims).  Runtime shape tensors stay unsupported — export with
+        static shapes."""
+        if not self._is_const(node.input[1], env):
+            raise UnsupportedOnnxOp(
+                "Reshape with runtime shape tensor; export static shapes")
+        shape = [int(d) for d in self._const(node.input[1], env)]
+        x = env[node.input[0]]
+        if any(d in (0, -1) for d in shape):
+            # resolve 0 (copy input dim) and a single -1 against the
+            # known element count
+            in_shape = list(x.spec.shape)
+            shape = [in_shape[i] if d == 0 else d
+                     for i, d in enumerate(shape)]
+            if shape.count(-1) == 1:
+                known = int(np.prod([d for d in shape if d != -1]))
+                shape[shape.index(-1)] = int(np.prod(in_shape)) // known
+        return ff.reshape(x, tuple(shape))
+
+    def _handle_transpose(self, ff, node, env):
+        perm = self._attrs(node).get("perm")
+        x = env[node.input[0]]
+        if perm is None:
+            perm = list(range(len(x.spec.shape)))[::-1]
+        return ff.transpose(x, [int(p) for p in perm])
+
+    def _handle_div(self, ff, node, env):
+        x = env[node.input[0]]
+        if self._is_const(node.input[1], env):
+            d = self._const(node.input[1], env)
+            assert d.ndim == 0 or d.size == 1, d.shape
+            return ff.scalar_true_divide(x, float(d.reshape(())))
+        return ff.divide(x, env[node.input[1]])
+
+    def _handle_layernormalization(self, ff, node, env):
+        """Opset-17 fused LayerNormalization (x, scale, bias) — the
+        torch exporter's nn.LayerNorm; scale/bias may arrive through an
+        Identity alias of another layer's initializers (torch ties
+        them), so resolve through env."""
+        a = self._attrs(node)
+        assert a.get("axis", -1) in (-1, None) or \
+            a["axis"] == len(env[node.input[0]].spec.shape) - 1, a
+        y = ff.layer_norm(env[node.input[0]], eps=a.get("epsilon", 1e-5),
+                          elementwise_affine=True)
+        port = {"weight": self._const(node.input[1], env)}
+        if len(node.input) > 2:
+            port["bias"] = self._const(node.input[2], env)
+        self.param_arrays[y.owner_layer.name] = port
+        return y

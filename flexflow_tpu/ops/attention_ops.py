@@ -46,6 +46,14 @@ def mha_attention(q, k, v, *, causal=False, mask=None, scale=None,
     logits = jnp.einsum("bkgqd,bksd->bkgqs", qg, k,
                         preferred_element_type=jnp.float32) * scale
     if bias is not None:
+        # the [H, Sq, Sk] bias's head axis must use the same KV-major
+        # grouping as q's reshape above; today T5 relative bias is the
+        # only producer and T5 has no GQA — assert rather than silently
+        # misassign per-head biases if the two are ever combined
+        assert KV == H, (
+            "t5_bias with GQA (num_kv_heads < num_heads) needs the bias "
+            "head axis laid out KV-major to match the query grouping — "
+            f"unverified combination (KV={KV}, H={H})")
         logits = logits + bias.reshape(KV, G, *bias.shape[-2:])[None]
     sk = logits.shape[-1]
     if causal:

@@ -284,25 +284,50 @@ class IncMultiHeadSelfAttention(_ServingAttentionBase):
             k = apply_rotary_embedding(k.swapaxes(1, 2), positions[:, None, :],
                                        theta).swapaxes(1, 2)
         ck, cv = self._cache(ctx, layer)
+        slopes = (self._alibi_slopes(attrs["num_q_heads"])
+                  if attrs.get("position_bias", False) else None)
         flash_mode = self._flash_decode_ok(attrs, ctx, C, ck)
         if flash_mode:
-            from ..kernels.flash_decode import flash_decode_attention
+            interp = flash_mode == "interpret"
+            if getattr(ctx, "mesh", None) is not None:
+                from ..kernels.flash_decode import (
+                    flash_decode_attention_sharded)
 
-            out1, ck, cv = flash_decode_attention(
-                q[:, 0], k[:, 0], v[:, 0], ck, cv, bc["first_depth"],
-                bc["active"].astype(jnp.int32), self._scale(attrs),
-                interpret=(flash_mode == "interpret"))
+                out1, ck, cv = flash_decode_attention_sharded(
+                    q[:, 0], k[:, 0], v[:, 0], ck, cv,
+                    bc["first_depth"], bc["active"].astype(jnp.int32),
+                    self._scale(attrs), ctx.mesh, interpret=interp,
+                    slopes=slopes)
+            else:
+                from ..kernels.flash_decode import flash_decode_attention
+
+                out1, ck, cv = flash_decode_attention(
+                    q[:, 0], k[:, 0], v[:, 0], ck, cv,
+                    bc["first_depth"], bc["active"].astype(jnp.int32),
+                    self._scale(attrs), interpret=interp, slopes=slopes)
             self._store(ctx, layer, ck, cv)
             return [self._output(params, out1[:, None], attrs, ctx)]
         flash_pre = self._flash_prefill_ok(attrs, ctx, C, ck)
         if flash_pre:
-            from ..kernels.flash_prefill import flash_prefill_attention
+            interp = flash_pre == "interpret"
+            if getattr(ctx, "mesh", None) is not None:
+                from ..kernels.flash_prefill import (
+                    flash_prefill_attention_sharded)
 
-            out, ck, cv = flash_prefill_attention(
-                q, k, v, ck, cv, bc["first_depth"], bc["row_tokens"],
-                bc["active"].astype(jnp.int32), self._scale(attrs),
-                interpret=(flash_pre == "interpret"),
-                s_bound=ctx.attend_len)
+                out, ck, cv = flash_prefill_attention_sharded(
+                    q, k, v, ck, cv, bc["first_depth"],
+                    bc["row_tokens"], bc["active"].astype(jnp.int32),
+                    self._scale(attrs), ctx.mesh, interpret=interp,
+                    slopes=slopes)
+            else:
+                from ..kernels.flash_prefill import (
+                    flash_prefill_attention)
+
+                out, ck, cv = flash_prefill_attention(
+                    q, k, v, ck, cv, bc["first_depth"],
+                    bc["row_tokens"], bc["active"].astype(jnp.int32),
+                    self._scale(attrs), interpret=interp,
+                    s_bound=ctx.attend_len, slopes=slopes)
             self._store(ctx, layer, ck, cv)
             return [self._output(params, out, attrs, ctx)]
         ck = _scatter_chunk(ck, k, bc["first_depth"], bc["active"])
@@ -326,8 +351,9 @@ class IncMultiHeadSelfAttention(_ServingAttentionBase):
         kernel's per-row tile pruning beats the XLA attend for this
         batch's depth profile (inference_manager.flash_wins sets
         ctx.use_flash); this gate checks the shapes the kernel supports
-        (single-token decode, unsharded cache, no ALiBi, lane-aligned
-        head dim).  FF_FLASH_DECODE=interpret runs the kernel interpreted
+        (single-token decode, lane-aligned head dim, unsharded cache or
+        one sharded over tp/sp — r5; ALiBi is in-kernel).
+        FF_FLASH_DECODE=interpret runs the kernel interpreted
         regardless of platform (CI coverage of the in-model wiring on
         CPU); =0 disables.  Returns 'interpret', True or False."""
         import os
@@ -338,7 +364,6 @@ class IncMultiHeadSelfAttention(_ServingAttentionBase):
         if mode == "0" or not getattr(ctx, "use_flash", False):
             return False
         ok = (flash_path_ok(C, ck, getattr(ctx, "mesh", None))
-              and not attrs.get("position_bias", False)
               and (mode == "interpret" or pallas_tpu_available()))
         return (mode if mode == "interpret" else True) if ok else False
 
@@ -349,9 +374,10 @@ class IncMultiHeadSelfAttention(_ServingAttentionBase):
         the kernel beats the XLA prefill attend for this batch's attend
         bucket (inference_manager.flash_prefill_wins sets
         ctx.use_flash); this checks the shapes the kernel supports
-        (16-divisible multi-token chunk, unsharded cache, no ALiBi,
-        lane-aligned head dim).  FF_FLASH_PREFILL=interpret runs the
-        kernel interpreted regardless of platform; =0 disables."""
+        (16-divisible multi-token chunk, lane-aligned head dim,
+        unsharded cache or one sharded over tp/sp — r5; ALiBi is
+        in-kernel).  FF_FLASH_PREFILL=interpret runs the kernel
+        interpreted regardless of platform; =0 disables."""
         import os
 
         from ..kernels.flash_prefill import prefill_path_ok
@@ -360,7 +386,6 @@ class IncMultiHeadSelfAttention(_ServingAttentionBase):
         if mode == "0" or not getattr(ctx, "use_flash", False):
             return False
         ok = (prefill_path_ok(C, ck, getattr(ctx, "mesh", None))
-              and not attrs.get("position_bias", False)
               and (mode == "interpret" or pallas_tpu_available()))
         return (mode if mode == "interpret" else True) if ok else False
 

@@ -27,7 +27,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
-from ..config import AXIS_DATA, AXIS_MODEL, AXIS_PIPE, AXIS_SEQ, FFConfig
+from ..config import (AXIS_DATA, AXIS_EXPERT, AXIS_MODEL, AXIS_PIPE,
+                      AXIS_SEQ, FFConfig)
 from ..fftype import InferenceMode, OpType
 from ..ops.registry import OpContext, get_op
 from .batch_config import (BatchConfig, BeamSearchBatchConfig,
@@ -96,11 +97,32 @@ def _param_pspecs(model) -> Dict[str, Dict[str, PartitionSpec]]:
                      "replicate": tp_specs.LINEAR_REPLICATED}[shard]
             for ps in layer.param_specs:
                 lspec[ps.name] = table[ps.name]
+        elif layer.op_type is OpType.EXPERTS:
+            # expert-parallel serving (r5): the stacked expert axis
+            # shards over 'ep' — GSPMD partitions the batched expert
+            # einsums and inserts the dispatch/combine all-to-alls (the
+            # reference instead round-robins whole Experts ops across
+            # devices, inference_manager.cc:229 expert_device_index)
+            for ps in layer.param_specs:
+                lspec[ps.name] = PartitionSpec(
+                    AXIS_EXPERT, *([None] * (len(ps.shape) - 1)))
         else:
             for ps in layer.param_specs:
                 lspec[ps.name] = PartitionSpec(*([None] * len(ps.shape)))
         specs[layer.name] = lspec
     return specs
+
+
+def prune_spec(spec: PartitionSpec, mesh) -> PartitionSpec:
+    """Drop axes the mesh lacks from a PartitionSpec (e.g. the 'tp'
+    entries of the attention table on an sp-only or ep-only mesh)."""
+    def prune(e):
+        if isinstance(e, (tuple, list)):
+            kept = tuple(a for a in e if a in mesh.shape)
+            return kept or None
+        return e if (e is None or e in mesh.shape) else None
+
+    return PartitionSpec(*[prune(e) for e in spec])
 
 
 def beam_rerank(outs, cum, R: int, W: int):
@@ -176,18 +198,45 @@ FLASH_BYTE_PENALTY = 1.2
 
 def _record_flash_tile(record) -> int:
     """The S-tile the flash kernel would pick for this model's caches
-    (so the dispatch cost model counts what the kernel actually reads)."""
+    (so the dispatch cost model counts what the kernel actually reads).
+    Sharded records count the PER-SHARD cache extent — that is what the
+    kernel sees inside shard_map."""
     tile = record.get("_flash_tile")
     if tile is None:
-        from ..kernels.flash_decode import _pick_ts
+        from ..kernels.flash_decode import _pick_ts, mesh_axes
 
         tile = 1024
+        tp = sp = 1
+        mesh = record.get("mesh")
+        if mesh is None and record.get("pp_meshes"):
+            mesh = record["pp_meshes"][0]   # pp: per-stage submeshes
+        if mesh is not None:
+            _, _, tp, sp = mesh_axes(mesh)
         for kv in record.get("caches", {}).values():
             R, KV, S, D = kv["k"].shape
-            tile = _pick_ts(S, KV, D)
+            tile = _pick_ts(S // sp, max(KV // tp, 1), D)
             break
         record["_flash_tile"] = tile
     return tile
+
+
+def record_flash_ok(record, C: int) -> bool:
+    """Host half of the kernel shape gates: True when every serving
+    attention cache in the record passes the op-level path gate
+    (flash_path_ok / prefill_path_ok) for chunk C — so ctx.use_flash is
+    only set when the kernel will actually dispatch.  Setting it for a
+    shape the op then rejects compiles a duplicate jit variant identical
+    to the use_flash=False XLA path (compile churn).  r5: sharded
+    records qualify — the kernels shard_map over tp/sp."""
+    caches = record.get("caches") or {}
+    if not caches:
+        return False
+    from ..kernels.flash_decode import flash_path_ok
+    from ..kernels.flash_prefill import prefill_path_ok
+
+    gate = flash_path_ok if C == 1 else prefill_path_ok
+    mesh = record.get("mesh")
+    return all(gate(C, kv["k"], mesh) for kv in caches.values())
 
 
 # Uniform-batch max DEPTH above which the flash-decode kernel
@@ -286,7 +335,14 @@ def _retry_transient(step, *args):
         logging.getLogger(__name__).warning(
             "transient remote-compile failure; retrying once: %s",
             str(e).splitlines()[0] if str(e) else e)
-        return step(*args)
+        try:
+            return step(*args)
+        except Exception as e2:
+            # chain the ORIGINAL failure: if it actually consumed the
+            # donated buffers (compile error surfacing post-execution),
+            # the retry fails confusingly on deleted buffers — the
+            # first exception is the one that explains why
+            raise e2 from e
 
 
 def fuse_qkv(model) -> None:
@@ -358,8 +414,10 @@ class InferenceManager:
         # never attended — the mask stops at each row's current depth.
         alloc_len = max_seq_length + prefill_chunk + 1
         # round the cache length up: %16 keeps VMEM blocks tile-aligned
-        # (fused decode attention), %sp gives every shard equal extent
-        m = math.lcm(16, sp)
+        # (fused decode attention), %(16*sp) gives every sp shard an
+        # equal AND 16-aligned extent (the sharded flash kernels run
+        # per-shard, so the per-shard length is what must align)
+        m = 16 * sp
         alloc_len = -(-alloc_len // m) * m
         if model.params is None:
             model.params = model.init_params(jax.random.PRNGKey(cfg.seed))
@@ -368,7 +426,9 @@ class InferenceManager:
             return self._compile_pipeline_model(
                 model, mode, max_requests, max_seq_length, prefill_chunk,
                 beam_width, cache_dtype, model_id, rows, alloc_len)
-        need = {a: d for a, d in ((AXIS_SEQ, sp), (AXIS_MODEL, tp))
+        ep = cfg.expert_parallelism_degree
+        need = {a: d for a, d in ((AXIS_SEQ, sp), (AXIS_MODEL, tp),
+                                  (AXIS_EXPERT, ep))
                 if d > 1}
         if need:
             # the cached mesh serves a model only if it has every needed
@@ -386,11 +446,12 @@ class InferenceManager:
             from ..quantization import extend_quantized_pspecs
 
             pspecs = extend_quantized_pspecs(pspecs, model.params)
+            # prune each spec to the axes this mesh actually has (an
+            # sp-only mesh has no 'tp' axis -> attention weights
+            # replicate; an ep mesh keeps expert shards regardless)
             model.params = {
                 ln: {pn: _device_put_preserving(
-                    v, mesh,
-                    # sp-only mesh has no 'tp' axis: weights replicate
-                    pspecs[ln][pn] if tp > 1 else PartitionSpec())
+                    v, mesh, prune_spec(pspecs[ln][pn], mesh))
                      for pn, v in lp.items()}
                 for ln, lp in model.params.items()}
         else:
@@ -510,6 +571,15 @@ class InferenceManager:
             max_seq_length=rec["max_seq_length"],
             prefill_chunk=rec["prefill_chunk"], beam_width=beam_width,
             cache_dtype=cache_dtype, model_id=model_id)
+
+    def free_model(self, model_id: int):
+        """Drop a model record AND any beam-width variants parked for it
+        by rewiden_beam — a parked variant holds full KV caches plus
+        compiled step caches, so popping only ``models[model_id]`` keeps
+        its HBM alive (r4 advisor finding).  Returns the dropped record
+        (or None)."""
+        self._beam_variants.pop(model_id, None)
+        return self.models.pop(model_id, None)
 
     def supports_decode_block(self, model_id: int) -> bool:
         """Decode blocks run for every layout: single/tp/sp models fuse
@@ -734,12 +804,14 @@ class InferenceManager:
         # bound the attended cache prefix for this step (sharded caches
         # skip the slice inside the op, so don't fork jit variants there);
         # ragged decode batches dispatch to the flash kernel, and big-
-        # bucket prefill chunks to the flash-prefill kernel
+        # bucket prefill chunks to the flash-prefill kernel.  r5: sharded
+        # (tp/sp) records dispatch too — the kernels shard_map over the
+        # mesh (record_flash_ok checks the per-shard shape gates).
         use_flash = (
-            (bc.chunk == 1 and record["mesh"] is None
+            (bc.chunk == 1 and record_flash_ok(record, 1)
              and flash_wins(bc, 1, record["alloc_len"],
                             _record_flash_tile(record)))
-            or (bc.chunk > 1 and record["mesh"] is None
+            or (bc.chunk > 1 and record_flash_ok(record, bc.chunk)
                 and flash_prefill_wins(bc, bc.chunk,
                                        record["alloc_len"])))
         # attend_len serves both paths: the XLA attend slices the cache
@@ -799,7 +871,7 @@ class InferenceManager:
         # ragged batches dispatch attention to the flash kernel
         attend_len = (attend_bucket(bc, k + 1, record["alloc_len"])
                       if record["mesh"] is None else None)
-        use_flash = (record["mesh"] is None
+        use_flash = (record_flash_ok(record, 1)
                      and flash_wins(bc, k + 1, record["alloc_len"],
                                     _record_flash_tile(record)))
         key = ("block", k, include_init, attend_len, use_flash)

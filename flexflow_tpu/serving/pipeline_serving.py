@@ -151,7 +151,28 @@ def build_stage_meshes(config, pp: int, tp: int, sp: int = 1) -> List[Mesh]:
     return meshes
 
 
-def make_stage_step(record, stage_idx: int):
+def pp_flash_ok(record, C: int) -> bool:
+    """Host half of the flash kernel shape gates for a pipeline record:
+    every stage's caches must pass the op-level path gate against that
+    stage's submesh (the pp twin of inference_manager.record_flash_ok —
+    r5: the Pallas kernels shard_map over each stage's tp/sp axes)."""
+    from ..kernels.flash_decode import flash_path_ok
+    from ..kernels.flash_prefill import prefill_path_ok
+
+    gate = flash_path_ok if C == 1 else prefill_path_ok
+    caches = record.get("caches") or {}
+    if not caches:
+        return False
+    meshes = record["pp_meshes"]
+    for s, ls in enumerate(record["pp_stages"]):
+        for l in ls:
+            if l.name in caches and not gate(C, caches[l.name]["k"],
+                                             meshes[s]):
+                return False
+    return True
+
+
+def make_stage_step(record, stage_idx: int, use_flash: bool = False):
     """Un-jitted step for one stage: (params, caches, boundary_vals,
     batch, rng) -> (boundary_outs_or_final, new_caches)."""
     model = record["model"]
@@ -178,6 +199,7 @@ def make_stage_step(record, stage_idx: int):
         ctx = OpContext(training=False, rng=rng, batch_config=batch,
                         kv_cache=caches, kv_cache_out={},
                         mesh=record["pp_meshes"][stage_idx],
+                        use_flash=use_flash,
                         w8a8=model.config.int8_native_matmul,
                         extra_outputs={})
         feeds = {}
@@ -302,14 +324,23 @@ def pipeline_decode_block(im, record, model_id: int, bc, k: int, rng,
     stage_params = [{l.name: model.params[l.name] for l in ls
                      if l.name in model.params} for ls in stages]
 
+    # ragged/deep decode batches dispatch to the sharded flash kernel
+    # (r5): each stage's attention shard_maps over its submesh
+    from .inference_manager import _record_flash_tile, flash_wins
+
+    use_flash = (pp_flash_ok(record, 1)
+                 and flash_wins(bc, k + 1, record["alloc_len"],
+                                _record_flash_tile(record)))
+
     # jitted per-stage chunk-1 steps (shared with the per-token path
     # except for the group row count)
     steps = []
     for s in range(pp):
-        key = ("pp_step", s, 1, Rg)
+        key = ("pp_step", s, 1, Rg, use_flash)
         if key not in record["pp_steps"]:
             record["pp_steps"][key] = jax.jit(
-                make_stage_step(record, s), donate_argnums=(1,))
+                make_stage_step(record, s, use_flash),
+                donate_argnums=(1,))
         steps.append(record["pp_steps"][key])
 
     # slice each group's cache rows out of the full arrays (one dispatch
@@ -422,11 +453,28 @@ def pipeline_inference(im, record, model_id: int, batch, rng) -> List[Any]:
     boundary: Dict[Tuple, Any] = {}
     outs: List[Any] = []
     chunk = int(batch["token_ids"].shape[1])
+    # flash dispatch (r5): the host cost models run on the packed batch
+    # the caller already built, so reconstruct the two fields they read
+    from .inference_manager import (_record_flash_tile,
+                                    flash_prefill_wins, flash_wins)
+
+    class _BCView:
+        request_available = np.asarray(batch["active"])
+        first_token_depth = np.asarray(batch["first_depth"])
+
+    use_flash = (
+        (chunk == 1 and pp_flash_ok(record, 1)
+         and flash_wins(_BCView, 1, record["alloc_len"],
+                        _record_flash_tile(record)))
+        or (chunk > 1 and pp_flash_ok(record, chunk)
+            and flash_prefill_wins(_BCView, chunk,
+                                   record["alloc_len"])))
     for s in range(len(stages)):
-        key = ("pp_step", s, chunk)
+        key = ("pp_step", s, chunk, use_flash)
         if key not in record["pp_steps"]:
             record["pp_steps"][key] = jax.jit(
-                make_stage_step(record, s), donate_argnums=(1,))
+                make_stage_step(record, s, use_flash),
+                donate_argnums=(1,))
         stage_params = {l.name: model.params[l.name] for l in stages[s]
                         if l.name in model.params}
         stage_caches = {l.name: caches[l.name] for l in stages[s]

@@ -1,0 +1,147 @@
+"""Quantization quality accounting (r5, VERDICT #7).
+
+The reference gates quantized serving on OUTPUT equivalence, not just
+speed (its CI token-matches spec vs incremental runs regardless of the
+weight path, tests/inference/python_inference_tests.sh:30-55; the
+quantized loader feeds the same gates, inference/file_loader.cc:651).
+This module is the rebuild's equivalent: a teacher-forced logits probe
+on the SERVING graph that turns "int8 is fast" into "int8 is fast and
+costs X nats of logprob error / diverges from bf16 greedy at step Y".
+
+Metrics (all vs a full-precision reference model over the same prompts):
+
+- ``top1_agreement``   fraction of next-token argmaxes that agree.
+- ``mean/max_logprob_err``  |log p_q - log p_fp| on the reference
+  model's greedy token at each position (softmax-shift invariant, and
+  weighted toward the tokens that matter — the ones actually decoded).
+- ``ppl_ratio``        exp(mean NLL_q - mean NLL_fp) on the reference
+  greedy continuation: how much likelier the fp model finds its own
+  output than the quantized model does.  1.0 = no quality loss.
+- ``greedy_divergence_step``  first decode step where greedy outputs
+  differ (None = never within the horizon).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+
+def teacher_forced_logprobs(im, model_id: int, tokens: Sequence[int],
+                            layer_name: str = "lm_head"):
+    """Run one prefill chunk over ``tokens`` through the compiled
+    serving record and return the next-token log-softmax
+    [len(tokens), vocab] (float32 numpy): position i holds the
+    distribution over token i+1.
+
+    Uses the record's own step-function machinery (same params/caches/
+    sharding as production serving) but reads the ``layer_name`` dense
+    output instead of the sampling head, via a dedicated jitted probe
+    that does NOT donate the caches (quality probes must not disturb a
+    live serving record).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from ..ops.registry import OpContext
+
+    record = im.models[model_id]
+    model = record["model"]
+    L = len(tokens)
+    assert L <= record["prefill_chunk"], (
+        f"probe prompt {L} exceeds the compiled prefill chunk "
+        f"{record['prefill_chunk']}")
+    key = ("logits_probe", L, layer_name)
+    if key not in record["steps"]:
+        input_names = [t.name for t in model.input_tensors]
+
+        def probe(params, caches, token_ids, row_tokens, active):
+            batch = {"token_ids": token_ids,
+                     "first_depth": jnp.zeros((token_ids.shape[0],),
+                                              jnp.int32),
+                     "row_tokens": row_tokens, "active": active}
+            ctx = OpContext(training=False, rng=jax.random.PRNGKey(0),
+                            batch_config=batch, kv_cache=caches,
+                            kv_cache_out={}, attend_len=None,
+                            w8a8=model.config.int8_native_matmul,
+                            mesh=record["mesh"], extra_outputs={})
+            feeds = {}
+            C = token_ids.shape[1]
+            for name in input_names:
+                if name == "tokens":
+                    feeds[name] = token_ids
+                elif name == "positions":
+                    feeds[name] = jnp.broadcast_to(
+                        jnp.arange(C, dtype=jnp.int32)[None, :],
+                        token_ids.shape)
+                else:
+                    raise ValueError(f"unknown serving input {name!r}")
+            vals = model.run_layers(params, feeds, ctx, inference=True)
+            logits = vals[(layer_name, 0)]          # [R, C, V]
+            return jax.nn.log_softmax(
+                logits[0].astype(jnp.float32), axis=-1)
+
+        record["steps"][key] = jax.jit(probe)
+    R = record["rows"]
+    C = record["prefill_chunk"]
+    token_ids = np.zeros((R, C), np.int32)
+    token_ids[0, :L] = tokens
+    row_tokens = np.zeros((R,), np.int32)
+    row_tokens[0] = L
+    active = np.zeros((R,), bool)
+    active[0] = True
+    lp = record["steps"][key](model.params, record["caches"],
+                              np.asarray(token_ids),
+                              np.asarray(row_tokens), np.asarray(active))
+    return np.asarray(lp[:L])
+
+
+def quality_report(im_ref, mid_ref, im_q, mid_q,
+                   prompts: Sequence[Sequence[int]],
+                   ref_tokens: Optional[List[List[int]]] = None,
+                   q_tokens: Optional[List[List[int]]] = None,
+                   layer_name: str = "lm_head") -> Dict[str, float]:
+    """Compare a quantized serving record against a full-precision one.
+
+    ``prompts``: token sequences to teacher-force (each is prompt +
+    reference-greedy continuation, so the probe weighs the positions a
+    real decode visits).  ``ref_tokens``/``q_tokens``: optional greedy
+    generations from each model for the divergence-step metric.
+    """
+    agree = total = 0
+    errs: List[np.ndarray] = []
+    nll_ref_all: List[np.ndarray] = []
+    nll_q_all: List[np.ndarray] = []
+    for toks in prompts:
+        toks = list(toks)
+        lp_ref = teacher_forced_logprobs(im_ref, mid_ref, toks, layer_name)
+        lp_q = teacher_forced_logprobs(im_q, mid_q, toks, layer_name)
+        nxt = np.asarray(toks[1:])                  # teacher-forced targets
+        pos = np.arange(len(nxt))
+        agree += int((lp_ref[:-1].argmax(-1) == lp_q[:-1].argmax(-1)).sum())
+        total += len(nxt)
+        # logprob error on the path actually taken
+        errs.append(np.abs(lp_q[pos, nxt] - lp_ref[pos, nxt]))
+        nll_ref_all.append(-lp_ref[pos, nxt])
+        nll_q_all.append(-lp_q[pos, nxt])
+    errs_c = np.concatenate(errs)
+    nll_ref = float(np.concatenate(nll_ref_all).mean())
+    nll_q = float(np.concatenate(nll_q_all).mean())
+    report = {
+        "top1_agreement": round(agree / max(1, total), 4),
+        "mean_logprob_err": round(float(errs_c.mean()), 5),
+        "max_logprob_err": round(float(errs_c.max()), 4),
+        "ppl_ref": round(float(np.exp(nll_ref)), 3),
+        "ppl_q": round(float(np.exp(nll_q)), 3),
+        "ppl_ratio": round(float(np.exp(nll_q - nll_ref)), 4),
+    }
+    if ref_tokens is not None and q_tokens is not None:
+        div = None
+        for rt, qt in zip(ref_tokens, q_tokens):
+            for i, (a, b) in enumerate(zip(rt, qt)):
+                if a != b:
+                    div = i if div is None else min(div, i)
+                    break
+        report["greedy_divergence_step"] = div
+    return report

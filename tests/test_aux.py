@@ -107,3 +107,35 @@ def test_recompile_state():
     assert state.recompilations == 1
     assert m.params["h"]["kernel"].shape == (16, 24)
     m.fit([x], y, epochs=1, verbose=False)  # trains after recompilation
+
+
+def test_bench_regression_gate():
+    """bench.py's round-over-round regression gate (r5): >5% drops in a
+    higher-is-better metric (or rises in a lower-is-better one) are
+    flagged against the previous round's committed record; unknown
+    units and small drifts pass (reference analogue: the threshold-
+    gated training runs, tests/training_tests.sh)."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "bench_mod", os.path.join(os.path.dirname(__file__), os.pardir,
+                                  "bench.py"))
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+    prev = [{"metric": "tput", "value": 100.0, "unit": "tokens/s"},
+            {"metric": "lat", "value": 10.0, "unit": "us/call"},
+            {"metric": "mem", "value": 50.0, "unit": "GB"}]
+    now = [{"metric": "tput", "value": 90.0, "unit": "tokens/s"},
+           {"metric": "lat", "value": 10.4, "unit": "us/call"},
+           {"metric": "mem", "value": 10.0, "unit": "GB"}]
+    regs = bench.check_regressions(now, prev)
+    assert [r["metric"] for r in regs] == ["tput"]
+    # lower-is-better: an 8% latency rise trips the gate
+    regs = bench.check_regressions(
+        [{"metric": "lat", "value": 10.8, "unit": "us/call"}], prev)
+    assert [r["metric"] for r in regs] == ["lat"]
+    # flat list round-trips the headline + extras shape
+    flat = bench._flatten_metrics(
+        {"metric": "h", "value": 1.0, "unit": "x",
+         "extras": [{"metric": "e", "value": 2.0, "unit": "x"}]})
+    assert [m["metric"] for m in flat] == ["h", "e"]
