@@ -373,6 +373,21 @@ def flash_decode_attention(q, k_new, v_new, ck, cv, depth, active,
     return out, ck, cv
 
 
+def flash_merge(acc, m, l, axis):
+    """The standard cross-shard flash-softmax merge: rescale partial
+    accumulators by exp(m - pmax(m)) and psum over ``axis``; rows with
+    no valid position anywhere (l == 0 after the merge) yield zeros.
+    Shared by the sharded decode and prefill wrappers — numerically
+    delicate code lives once.  acc [..., D] f32, m/l [...] f32."""
+    import jax
+
+    m_g = jax.lax.pmax(m, axis)
+    coef = jnp.exp(m - m_g)                    # fully-masked shard -> 0
+    l_g = jax.lax.psum(l * coef, axis)
+    acc_g = jax.lax.psum(acc * coef[..., None], axis)
+    return acc_g / jnp.where(l_g == 0, 1.0, l_g)[..., None]
+
+
 def mesh_axes(mesh):
     """(tp_axis_or_None, sp_axis_or_None, tp_size, sp_size) of a serving
     mesh; axes the mesh lacks report size 1."""
@@ -434,11 +449,7 @@ def flash_decode_attention_sharded(q, k_new, v_new, ck, cv, depth,
         acc, m, l = flash_decode_attend_partial(
             q, ck, cv, loc, att_act, scale, interpret=interpret,
             slopes=sl)
-        m_g = jax.lax.pmax(m, sp_ax)
-        coef = jnp.exp(m - m_g)                # fully-masked shard -> 0
-        l_g = jax.lax.psum(l * coef, sp_ax)
-        acc_g = jax.lax.psum(acc * coef[..., None], sp_ax)
-        out = acc_g / jnp.where(l_g == 0, 1.0, l_g)[..., None]
+        out = flash_merge(acc, m, l, sp_ax)
         return out.astype(q.dtype), ck, cv
 
     fn = shard_map(

@@ -139,17 +139,42 @@ def _kernel(last_ref, depth_ref, ntok_ref, act_ref,   # scalar prefetch
 
 
 def _pick_tiles(C: int, S: int, KV: int, G: int, D: int):
-    """C tile bounded by the f32 logits temp (KVG*TC*TS) + acc staying
-    comfortably inside scoped VMEM next to the double-buffered K/V
-    tiles; S tile as in flash_decode."""
-    from ..kernels.flash_decode import _pick_ts
+    """Joint (TC, TS) choice minimizing K/V re-reads under the VMEM
+    logits budget.
 
-    ts = _pick_ts(S, KV, D)
+    Every C-tile re-reads the row's whole attended K/V prefix, so the
+    cache traffic is proportional to NC = C/TC — r5 XProf on a 1.4B/8k
+    prefill chunk showed the attend at 42% of the step with the old
+    ts=1024/tc=32 choice (16 re-reads of the prefix per chunk per
+    layer).  Shrinking TS buys a larger TC inside the same
+    KVG*TC*TS f32 logits budget and cuts NC ~4x; TS stays >= 256 so
+    the K/V tile DMAs keep their efficiency and the grid stays coarse.
+    Tie-break prefers the larger TS (fewer grid steps)."""
+    import os
+
+    if os.environ.get("FF_PF_TS") and os.environ.get("FF_PF_TC"):
+        return (int(os.environ["FF_PF_TC"]),
+                int(os.environ["FF_PF_TS"]))   # calibration override
     budget = 6 * 1024 * 1024                   # logits + p f32 temps
-    tc = C
-    while tc > 16 and KV * G * tc * ts * 2 * 4 > budget:
-        tc //= 2
-    return tc, ts
+    best = None
+    for ts in (1024, 512, 256):
+        if ts > max(S, 256):
+            continue
+        cap = budget // (KV * G * ts * 2 * 4)
+        tc = C
+        while tc > 16 and tc > cap:
+            tc //= 2
+        nc = -(-C // tc)
+        # chip-calibrated cost (r5, 1.4B/8k in-model sweep): each C-tile
+        # re-reads the attended prefix (~nc * S/ts tile reads), and each
+        # grid step pays a fixed pipeline/rescale cost worth ~6 tile
+        # reads — shrinking ts below 512 multiplied the grid and LOST
+        # in-model despite fewer prefix re-reads
+        steps = nc * (S // ts)
+        cost = steps * (1 + 6 * 1024 // ts)
+        if best is None or cost < best[0]:
+            best = (cost, tc, ts)
+    return best[1], best[2]
 
 
 def _prefill_call(q, ck, cv, depth, ntok, active, scale, interpret,
@@ -457,7 +482,7 @@ def flash_prefill_attention(q, k_new, v_new, ck, cv, depth, ntok,
 def flash_prefill_attention_sharded(q, k_new, v_new, ck, cv, depth,
                                     ntok, active, scale: float, mesh,
                                     interpret: bool = False,
-                                    slopes=None):
+                                    slopes=None, s_bound=None):
     """shard_map'd scatter-then-attend prefill over the serving mesh —
     the chunked-prefill twin of
     flash_decode.flash_decode_attention_sharded.
@@ -483,15 +508,21 @@ def flash_prefill_attention_sharded(q, k_new, v_new, ck, cv, depth,
     active = active.astype(jnp.int32)
 
     def body(q, kn, vn, ck, cv, depth, ntok, active, *sl):
+        from .flash_decode import flash_merge
+
         sl = sl[0] if has_alibi else None
         S_l = ck.shape[2]
         s0 = (jax.lax.axis_index(sp_ax) * S_l) if sp > 1 else 0
+        # local grid bound: the host's GLOBAL attend bucket clipped to
+        # the shard extent (short prompts on a long allocation must not
+        # cycle the full pruned grid — flash_prefill_attend docstring)
+        sb = min(s_bound, S_l) if s_bound else None
         ck, cv = chunk_append(ck, cv, kn, vn, depth, ntok, active,
                               interpret=interpret, s_offset=s0)
         if sp <= 1:
             out = flash_prefill_attend(q, ck, cv, depth, ntok, active,
                                        scale, interpret=interpret,
-                                       slopes=sl)
+                                       slopes=sl, s_bound=sb)
             return out, ck, cv
         loc = depth - s0
         # shards wholly above every query of the row (loc + ntok <= 0)
@@ -500,12 +531,8 @@ def flash_prefill_attention_sharded(q, k_new, v_new, ck, cv, depth,
         att_act = active * (loc + ntok > 0)
         acc, m, l = flash_prefill_attend_partial(
             q, ck, cv, loc, ntok, att_act, scale, interpret=interpret,
-            slopes=sl)
-        m_g = jax.lax.pmax(m, sp_ax)
-        coef = jnp.exp(m - m_g)                # fully-masked shard -> 0
-        l_g = jax.lax.psum(l * coef, sp_ax)
-        acc_g = jax.lax.psum(acc * coef[..., None], sp_ax)
-        out = acc_g / jnp.where(l_g == 0, 1.0, l_g)[..., None]
+            slopes=sl, s_bound=sb)
+        out = flash_merge(acc, m, l, sp_ax)
         R, KV, G, C, D = out.shape
         out = out.transpose(0, 3, 1, 2, 4).reshape(R, C, KV * G, D)
         return out.astype(q.dtype), ck, cv

@@ -75,16 +75,25 @@ class ONNXModel:
                     if i.name == name)
 
     def _is_const(self, name: str, env) -> bool:
-        """True when ``name`` resolves to host data (an initializer, or
-        a Constant/Identity product stored as numpy in env)."""
+        """True when ``name`` resolves to host data: an initializer, a
+        Constant/Identity product already in env, or the output of a
+        Constant node anywhere in the graph (lookahead — a bias
+        Constant may legally be ordered AFTER the MatMul that wants to
+        fold it)."""
         if isinstance(env.get(name), np.ndarray):
             return True
-        return any(i.name == name for i in self.proto.graph.initializer)
+        if any(i.name == name for i in self.proto.graph.initializer):
+            return True
+        return any(n.op_type == "Constant" and name in n.output
+                   for n in self.proto.graph.node)
 
     def _const(self, name: str, env) -> np.ndarray:
         v = env.get(name)
         if isinstance(v, np.ndarray):
             return v
+        for n in self.proto.graph.node:
+            if n.op_type == "Constant" and name in n.output:
+                return self._handle_constant(None, n, env)
         return np.asarray(self._to_array(self._init(name)))
 
     def _consumers(self, out_name: str):
@@ -311,11 +320,15 @@ class ONNXModel:
         return ff.transpose(x, [int(p) for p in perm])
 
     def _handle_div(self, ff, node, env):
+        if self._is_const(node.input[0], env):
+            raise UnsupportedOnnxOp("Div with constant numerator")
         x = env[node.input[0]]
         if self._is_const(node.input[1], env):
             d = self._const(node.input[1], env)
-            assert d.ndim == 0 or d.size == 1, d.shape
-            return ff.scalar_true_divide(x, float(d.reshape(())))
+            if d.ndim == 0 or d.size == 1:
+                return ff.scalar_true_divide(x, float(d.reshape(())))
+            raise UnsupportedOnnxOp("Div with non-scalar constant "
+                                    "denominator")
         return ff.divide(x, env[node.input[1]])
 
     def _handle_layernormalization(self, ff, node, env):

