@@ -17,7 +17,7 @@ matmul keeps its true cost; this upper-bounds the mechanism the way real
 distilled SSM weights would approach).
 
 Modes: `python bench.py [all|llama|llama7b|spec|spec7b|mnist|kernels|opt|
-resnet|longctx|quality|distill]` (default all).
+resnet|longctx|quality|distill|crossover]` (default all).
 
 r5: the complete metric record also lands in ``bench_results/<round>.json``
 (committed — the driver's stdout-tail capture truncated 15 of 23 r4
@@ -910,7 +910,8 @@ def bench_flash_crossover():
 
     curve = []
     try:
-        for depth in (600, 1200, 1800, 2400, 3200, 4800, 6400, 7900):
+        for depth in (600, 1000, 1200, 1500, 1800, 2400, 3200,
+                      4800, 6400, 7900):
             fm = block_ms(depth, "1")
             xm = block_ms(depth, "0")
             curve.append({"depth": depth, "flash_ms": round(fm, 3),
@@ -1566,7 +1567,7 @@ def main(which: str):
         raise SystemExit(
             f"unknown bench mode {which!r} (expected all|llama|llama7b|"
             f"spec|spec7b|mnist|kernels|opt|resnet|longctx|quality|"
-            f"distill)")
+            f"distill|crossover)")
 
     # all: headline decode metric + everything else under extras.  Each
     # section runs in its own process lifetime-wise (HBM frees between

@@ -252,4 +252,16 @@ class LLaMATrainer:
 
     def fit_batch(self, params, opt_state, tokens):
         step = self.train_step()
+        tokens = np.asarray(tokens, np.int32)
+        if jax.process_count() > 1:
+            # multi-controller (DCN) path: every process holds the same
+            # full batch; serve each process's addressable shards of the
+            # dp-sharded global array from it (a plain jnp.asarray would
+            # be a process-local array, which jit over a multi-process
+            # mesh rejects) — the reference reaches the same state via
+            # mpirun + GASNet bootstrap (MULTI-NODE.md)
+            sh = NamedSharding(self.mesh, P(AXIS_DATA))
+            arr = jax.make_array_from_callback(
+                tokens.shape, sh, lambda idx: tokens[idx])
+            return step(params, opt_state, arr)
         return step(params, opt_state, jnp.asarray(tokens, jnp.int32))

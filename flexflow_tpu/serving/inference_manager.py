@@ -349,6 +349,33 @@ def _retry_transient(step, *args):
             raise e2 from e
 
 
+def _feed_array(v, dtype=None):
+    """ONE value fed to a jitted step.  Single-controller: commit to
+    device (jnp.asarray).  Multi-controller (jax.process_count()>1, the
+    DCN serving path): plain numpy — jit replicates numpy inputs across
+    the global mesh, while a jnp.asarray would be a PROCESS-LOCAL array
+    that a jit over a multi-process mesh rejects (every rank runs the
+    same deterministic driver loop, so the values are identical by
+    construction).  Device arrays (e.g. the prefill->decode handoff
+    tokens, already global) pass through untouched.  The single place
+    the multi-controller feed contract lives."""
+    if jax.process_count() > 1:
+        if isinstance(v, jax.Array):
+            return v            # already a (global) device array
+        return np.asarray(v, dtype)
+    return jnp.asarray(v, dtype)
+
+
+def _feed_arrays(d: Dict[str, Any]) -> Dict[str, Any]:
+    """_feed_array over a batch dict."""
+    return {k: _feed_array(v) for k, v in d.items()}
+
+
+def _feed_rng(key):
+    """RNG key as a step input (same contract as _feed_array)."""
+    return np.asarray(key) if jax.process_count() > 1 else key
+
+
 def fuse_qkv(model) -> None:
     """Concatenate each serving-attention layer's wq/wk/wv ([E,H,D] +
     2x[E,KV,D]) into one wqkv [E,H+2KV,D] (and biases into bqkv) so the
@@ -751,7 +778,7 @@ class InferenceManager:
             f"compiled width")
         slack = record["prefill_chunk"]
         d_steps = min(d_steps, slack)  # scatter must stay inside the slack
-        batch = {name: jnp.asarray(v) for name, v in bc.pack().items()}
+        batch = _feed_arrays(bc.pack())
         if rng is None:
             rng = jax.random.PRNGKey(0)
         if init_parent_rows is None:
@@ -762,10 +789,10 @@ class InferenceManager:
                                                           W)
         hist, record["caches"] = record["steps"][key](
             record["model"].params, record["caches"], batch,
-            jax.random.split(rng, d_steps),
-            jnp.asarray(init_tokens, jnp.int32),
-            jnp.asarray(init_cum_logp, jnp.float32),
-            jnp.asarray(init_parent_rows, jnp.int32))
+            _feed_rng(jax.random.split(rng, d_steps)),
+            _feed_array(init_tokens, jnp.int32),
+            _feed_array(init_cum_logp, jnp.float32),
+            _feed_array(init_parent_rows, jnp.int32))
         toks, parents, cums = hist
         return (np.asarray(toks), np.asarray(parents), np.asarray(cums))
 
@@ -794,10 +821,10 @@ class InferenceManager:
                 f"compiled with — scatter would clamp over committed KV. "
                 f"Compile with prefill_chunk >= the RequestManager's "
                 f"max_tokens_per_batch.")
-        batch = {k: jnp.asarray(v) for k, v in bc.pack().items()}
+        batch = _feed_arrays(bc.pack())
         reorder = parent_rows is not None
         if reorder:
-            batch["parent_rows"] = jnp.asarray(parent_rows)
+            batch["parent_rows"] = _feed_array(parent_rows)
         if rng is None:
             rng = jax.random.PRNGKey(0)
         if "pp_stages" in record:
@@ -833,7 +860,8 @@ class InferenceManager:
         step = self._get_step(record, bc.chunk, reorder, attend_len,
                               use_flash)
         outs, record["caches"] = _retry_transient(
-            step, record["model"].params, record["caches"], batch, rng)
+            step, record["model"].params, record["caches"], batch,
+            _feed_rng(rng))
         return outs
 
     def decode_block(self, model_id: int, bc: BatchConfig, k: int,
@@ -873,7 +901,7 @@ class InferenceManager:
 
             return pipeline_decode_block(self, record, model_id, bc, k,
                                          rng, init_tokens)
-        batch = {name: jnp.asarray(v) for name, v in bc.pack().items()}
+        batch = _feed_arrays(bc.pack())
         include_init = init_tokens is not None
         if init_tokens is None:
             init_tokens = batch["token_ids"][:, 0]
@@ -891,8 +919,9 @@ class InferenceManager:
                 record, k, include_init, attend_len, use_flash)
         toks, record["caches"] = _retry_transient(
             record["steps"][key], record["model"].params,
-            record["caches"], batch, jax.random.split(rng, k),
-            jnp.asarray(init_tokens, jnp.int32))
+            record["caches"], batch,
+            _feed_rng(jax.random.split(rng, k)),
+            _feed_array(init_tokens, jnp.int32))
         return toks
 
     def reset_request_rows(self, model_id: int, rows: List[int]):

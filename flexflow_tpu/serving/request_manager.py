@@ -415,7 +415,10 @@ class RequestManager:
             cols[row] = n - 1
             req.cached_len += n
             req.profile.llm_decoding_steps += 1
-        init = outs[0][jnp.arange(outs[0].shape[0]), jnp.asarray(cols)]
+        # numpy index operands: under multi-controller serving the step
+        # outputs are GLOBAL arrays and a jnp.asarray index would be a
+        # process-local array the eager op rejects
+        init = outs[0][np.arange(outs[0].shape[0]), cols]
         bc2 = self._decode_only_bc()
         # init consumes one budget slot, the k scan steps the rest
         k = pick_chunk(max(1, self._max_remaining_budget() - 1),

@@ -959,6 +959,13 @@ def device_loop_supported(rm, im, llm_id: int,
 
     if os.environ.get("FF_SPEC_DEVICE", "1") == "0":
         return False
+    import jax
+
+    if jax.process_count() > 1:
+        # multi-controller serving (r5): the device loop's state dict is
+        # built with process-local device_puts — route to the host loop,
+        # whose step feeds go through the _feed_array contract
+        return False
     ssm_records = [im.models[i] for i in rm.ssm_model_ids]
     if not ssm_records:
         return False
