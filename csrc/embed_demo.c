@@ -1,0 +1,52 @@
+/* Demo host: drives flexflow_tpu from plain C through the embedding
+ * API (csrc/flexflow_embed.cc) — the reference's inference/
+ * incr_decoding binary role for a non-Python host.
+ *
+ * Build + run (see tests/test_native.py::test_c_embedding_api):
+ *   g++ -c flexflow_embed.cc $(python3-config --includes)
+ *   gcc embed_demo.c flexflow_embed.o $(python3-config --embed --ldflags) -lstdc++
+ */
+#include <stdio.h>
+
+/* extern "C" guard: the test builds this file with g++ (one compile
+ * line), a pure-C host with gcc — both must see unmangled symbols */
+#ifdef __cplusplus
+extern "C" {
+#endif
+extern int ff_runtime_init(const char *);
+extern long long ff_llm_create(const char *);
+extern int ff_generate(long long, const int *, int, int, int *, int);
+extern int ff_llm_destroy(long long);
+extern const char *ff_last_error(void);
+#ifdef __cplusplus
+}
+#endif
+
+int main(void) {
+  if (ff_runtime_init(NULL) != 0) {
+    fprintf(stderr, "init failed: %s\n", ff_last_error());
+    return 1;
+  }
+  const char *cfg =
+      "{\"family\": \"llama\", \"vocab_size\": 128, \"hidden_size\": 64,"
+      " \"intermediate_size\": 128, \"num_hidden_layers\": 2,"
+      " \"num_attention_heads\": 4, \"num_key_value_heads\": 2,"
+      " \"seed\": 7, \"max_requests\": 2, \"max_seq_length\": 48}";
+  long long h = ff_llm_create(cfg);
+  if (h == 0) {
+    fprintf(stderr, "create failed: %s\n", ff_last_error());
+    return 1;
+  }
+  int prompt[3] = {1, 5, 9};
+  int out[16];
+  int n = ff_generate(h, prompt, 3, 6, out, 16);
+  if (n < 0) {
+    fprintf(stderr, "generate failed: %s\n", ff_last_error());
+    return 1;
+  }
+  printf("generated:");
+  for (int i = 0; i < n; i++) printf(" %d", out[i]);
+  printf("\n");
+  ff_llm_destroy(h);
+  return 0;
+}

@@ -1,0 +1,132 @@
+// C embedding API for flexflow_tpu — serve the framework from a
+// non-Python host.
+//
+// Role of the reference's C API (src/c/flexflow_c.cc, ~380 extern "C"
+// functions over flexflow_c.h): there the control plane is C++ and
+// every frontend crosses into it.  Here the control plane is Python
+// (docs/INTERNALS.md "Why there is no big C API"), so a C/C++/Go/Rust
+// host embeds the CPython interpreter ONCE and drives the
+// flexflow_tpu.embed_bridge module through four calls:
+//
+//   ff_runtime_init(pythonhome_or_null)   -> 0 on success
+//   ff_llm_create(config_json)            -> handle > 0, 0 on error
+//   ff_generate(handle, prompt, n_prompt, max_new, out, cap) -> n or -1
+//   ff_llm_destroy(handle); ff_runtime_destroy();
+//   ff_last_error()                       -> static error string
+//
+// Build (python3-config supplies the embed flags):
+//   g++ -shared -fPIC flexflow_embed.cc -o libflexflow_embed.so \
+//       $(python3-config --includes) $(python3-config --embed --ldflags)
+// A host links libflexflow_embed.so (or compiles this file in) and
+// needs no Python in its own source.  Threading: calls must come from
+// one thread (the embedded interpreter holds the GIL between calls the
+// simple way; a server host would wrap calls in its own mutex).
+
+#include <Python.h>
+
+#include <cstdio>
+#include <cstring>
+#include <string>
+
+static std::string g_err;
+static PyObject *g_bridge = nullptr;
+
+extern "C" {
+
+const char *ff_last_error() { return g_err.c_str(); }
+
+static void capture_py_error(const char *where) {
+  PyObject *t, *v, *tb;
+  PyErr_Fetch(&t, &v, &tb);
+  PyObject *s = v ? PyObject_Str(v) : nullptr;
+  g_err = std::string(where) + ": " +
+          (s ? PyUnicode_AsUTF8(s) : "unknown Python error");
+  Py_XDECREF(s);
+  Py_XDECREF(t);
+  Py_XDECREF(v);
+  Py_XDECREF(tb);
+}
+
+int ff_runtime_init(const char *python_path_or_null) {
+  if (g_bridge) return 0;
+  if (!Py_IsInitialized()) {
+    PyConfig config;
+    PyConfig_InitPythonConfig(&config);
+    if (python_path_or_null && *python_path_or_null) {
+      PyConfig_SetBytesString(&config, &config.home, python_path_or_null);
+    }
+    PyStatus st = Py_InitializeFromConfig(&config);
+    PyConfig_Clear(&config);
+    if (PyStatus_Exception(st)) {
+      g_err = "Py_InitializeFromConfig failed";
+      return -1;
+    }
+  }
+  g_bridge = PyImport_ImportModule("flexflow_tpu.embed_bridge");
+  if (!g_bridge) {
+    capture_py_error("import flexflow_tpu.embed_bridge");
+    return -1;
+  }
+  return 0;
+}
+
+long long ff_llm_create(const char *config_json) {
+  if (!g_bridge) {
+    g_err = "ff_runtime_init not called";
+    return 0;
+  }
+  PyObject *r = PyObject_CallMethod(g_bridge, "create", "s", config_json);
+  if (!r) {
+    capture_py_error("create");
+    return 0;
+  }
+  long long h = PyLong_AsLongLong(r);
+  Py_DECREF(r);
+  return h;
+}
+
+// Greedy-decode: writes up to out_cap generated ids; returns the count
+// or -1 (see ff_last_error).
+int ff_generate(long long handle, const int *prompt, int n_prompt,
+                int max_new, int *out, int out_cap) {
+  if (!g_bridge) {
+    g_err = "ff_runtime_init not called";
+    return -1;
+  }
+  PyObject *plist = PyList_New(n_prompt);
+  for (int i = 0; i < n_prompt; i++)
+    PyList_SET_ITEM(plist, i, PyLong_FromLong(prompt[i]));
+  PyObject *r = PyObject_CallMethod(g_bridge, "generate", "LOi",
+                                    handle, plist, max_new);
+  Py_DECREF(plist);
+  if (!r) {
+    capture_py_error("generate");
+    return -1;
+  }
+  int n = (int)PyList_Size(r);
+  if (n > out_cap) n = out_cap;
+  for (int i = 0; i < n; i++)
+    out[i] = (int)PyLong_AsLong(PyList_GetItem(r, i));
+  Py_DECREF(r);
+  return n;
+}
+
+int ff_llm_destroy(long long handle) {
+  if (!g_bridge) return -1;
+  PyObject *r = PyObject_CallMethod(g_bridge, "destroy", "L", handle);
+  if (!r) {
+    capture_py_error("destroy");
+    return -1;
+  }
+  Py_DECREF(r);
+  return 0;
+}
+
+void ff_runtime_destroy() {
+  Py_XDECREF(g_bridge);
+  g_bridge = nullptr;
+  // leave the interpreter up: jax/XLA teardown at Py_Finalize is not
+  // worth the risk for an embedding host that is about to exit anyway
+}
+
+}  // extern "C"

@@ -1,0 +1,118 @@
+"""Python side of the C embedding API (csrc/flexflow_embed.cc).
+
+The reference exposes ~380 ``extern "C"`` functions
+(src/c/flexflow_c.cc) because its control plane is C++ and every
+frontend must cross that boundary.  Here the control plane is Python,
+so a non-Python host embeds the interpreter and drives THIS bridge
+through a handful of C calls (init / create-from-JSON-config /
+generate / free) — same capability, one boundary, JSON instead of 380
+handle-typed constructors (docs/INTERNALS.md "Why there is no big C
+API").
+
+Config JSON accepted by :func:`create`::
+
+    {"family": "llama",            # llama (default) | opt
+     "vocab_size": 128, "hidden_size": 64, "intermediate_size": 128,
+     "num_hidden_layers": 2, "num_attention_heads": 4,
+     "num_key_value_heads": 2,
+     "seed": 0,                    # random-init weights
+     "weights_npz": "/path.npz",   # optional real weights (npz tree)
+     "tensor_parallelism_degree": 1, "sequence_parallelism_degree": 1,
+     "pipeline_parallelism_degree": 1,
+     "max_requests": 4, "max_seq_length": 256,
+     "max_tokens_per_batch": 32}
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, List
+
+_models: Dict[int, Dict] = {}
+_next_handle = 1
+
+
+def create(config_json: str) -> int:
+    """Build + compile a serving model; returns a handle (>0)."""
+    global _next_handle
+
+    import jax
+    import numpy as np
+
+    from . import FFConfig, Model
+    from .fftype import InferenceMode
+    from .serving import InferenceManager, RequestManager
+
+    cfg = json.loads(config_json)
+    family = cfg.get("family", "llama")
+    ffcfg = FFConfig(
+        tensor_parallelism_degree=cfg.get("tensor_parallelism_degree", 1),
+        sequence_parallelism_degree=cfg.get(
+            "sequence_parallelism_degree", 1),
+        pipeline_parallelism_degree=cfg.get(
+            "pipeline_parallelism_degree", 1))
+    max_requests = cfg.get("max_requests", 4)
+    if family == "llama":
+        from .models.llama import LLAMAConfig, create_llama_model
+
+        mc = LLAMAConfig(**{k: cfg[k] for k in (
+            "vocab_size", "hidden_size", "intermediate_size",
+            "num_hidden_layers", "num_attention_heads",
+            "num_key_value_heads") if k in cfg})
+        model = Model(ffcfg, name=f"embed_{_next_handle}")
+        create_llama_model(model, mc, mode=InferenceMode.INC_DECODING,
+                           max_requests=max_requests)
+    elif family == "opt":
+        from .models.opt import OPTConfig, create_opt_model
+
+        mc = OPTConfig(**{k: cfg[k] for k in (
+            "vocab_size", "hidden_size", "ffn_dim", "num_hidden_layers",
+            "num_attention_heads", "max_position_embeddings")
+            if k in cfg})
+        model = Model(ffcfg, name=f"embed_{_next_handle}")
+        create_opt_model(model, mc, mode=InferenceMode.INC_DECODING,
+                         max_requests=max_requests)
+    else:
+        raise ValueError(f"unknown family {family!r}")
+    if "weights_npz" in cfg:
+        loaded = np.load(cfg["weights_npz"])
+        model.params = {}
+        for key in loaded.files:        # "layer/param" flat names
+            ln, pn = key.split("/", 1)
+            model.params.setdefault(ln, {})[pn] = loaded[key]
+    else:
+        model.params = model.init_params(
+            jax.random.PRNGKey(cfg.get("seed", 0)))
+    im = InferenceManager(ffcfg)
+    mid = im.compile_model_and_allocate_buffer(
+        model, max_requests=max_requests,
+        max_seq_length=cfg.get("max_seq_length", 256))
+    handle = _next_handle
+    _next_handle += 1
+    _models[handle] = dict(
+        im=im, mid=mid,
+        max_requests=max_requests,
+        max_seq_length=cfg.get("max_seq_length", 256),
+        max_tokens_per_batch=cfg.get("max_tokens_per_batch", 32))
+    return handle
+
+
+def generate(handle: int, prompt: List[int], max_new: int) -> List[int]:
+    """Greedy-decode ``max_new`` tokens after ``prompt``; returns the
+    GENERATED ids (prompt excluded)."""
+    from .serving import RequestManager
+
+    rec = _models[handle]
+    rm = RequestManager(
+        max_requests_per_batch=rec["max_requests"],
+        max_tokens_per_batch=rec["max_tokens_per_batch"],
+        max_sequence_length=rec["max_seq_length"])
+    req = rm.register_new_request(list(prompt), max_new_tokens=max_new)
+    rm.generate_incr_decoding(rec["im"], rec["mid"], [req])
+    return list(req.tokens[req.prompt_len:])
+
+
+def destroy(handle: int) -> None:
+    rec = _models.pop(handle, None)
+    if rec is not None:
+        rec["im"].free_model(rec["mid"])

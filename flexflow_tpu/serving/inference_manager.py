@@ -831,6 +831,13 @@ class InferenceManager:
             from .pipeline_serving import pipeline_inference
 
             assert not reorder, "beam reorder under pp serving: unsupported"
+            if jax.process_count() > 1:
+                raise NotImplementedError(
+                    "pipeline-parallel serving under multi-controller "
+                    "(jax.process_count() > 1) is not wired through the "
+                    "_feed_array contract yet — per-stage submeshes and "
+                    "boundary device_puts are process-local; use tp/sp "
+                    "sharding for multi-host serving")
             return pipeline_inference(self, record, model_id, batch, rng)
         # bound the attended cache prefix for this step (sharded caches
         # skip the slice inside the op, so don't fork jit variants there);
@@ -899,6 +906,12 @@ class InferenceManager:
         if "pp_stages" in record:
             from .pipeline_serving import pipeline_decode_block
 
+            if jax.process_count() > 1:
+                raise NotImplementedError(
+                    "pipeline-parallel decode blocks under "
+                    "multi-controller are not wired through the "
+                    "_feed_array contract yet; use tp/sp sharding for "
+                    "multi-host serving")
             return pipeline_decode_block(self, record, model_id, bc, k,
                                          rng, init_tokens)
         batch = _feed_arrays(bc.pack())

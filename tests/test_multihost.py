@@ -105,7 +105,7 @@ for _ in range(2):
     params, opt, loss = tr.fit_batch(params, opt, tokens)
 loss = float(loss)
 assert np.isfinite(loss)
-print('rank', sys.argv[1], 'loss', round(loss, 6))
+print('rank', sys.argv[1], 'loss', loss.hex())   # full precision
 """
 
 

@@ -106,3 +106,58 @@ def test_gather_rows_edge_semantics(lib):
     srcT = np.arange(12, dtype=np.float32).reshape(3, 4).T
     np.testing.assert_array_equal(native.gather_rows(srcT, [2, 0]),
                                   srcT[[2, 0]])
+
+
+def test_c_embedding_api(tmp_path):
+    """The C embedding surface (csrc/flexflow_embed.cc — the reference's
+    flexflow_c.cc role, docs/INTERNALS.md rationale): compile a plain-C
+    host against the extern "C" API, embed CPython, build + serve a
+    model, and match the tokens a direct Python run produces."""
+    import subprocess
+    import sys
+    import sysconfig
+
+    import shutil
+
+    if shutil.which("g++") is None:
+        pytest.skip("no g++ toolchain")
+    if not sysconfig.get_config_var("Py_ENABLE_SHARED"):
+        pytest.skip("no shared libpython to embed")
+    root = os.path.abspath(os.path.join(os.path.dirname(__file__),
+                                        os.pardir))
+    csrc = os.path.join(root, "csrc")
+    inc = sysconfig.get_paths()["include"]
+    libdir = sysconfig.get_config_var("LIBDIR")
+    ver = f"python{sysconfig.get_config_var('py_version_short')}"
+    exe = tmp_path / "embed_demo"
+    cmd = ["g++", os.path.join(csrc, "flexflow_embed.cc"),
+           os.path.join(csrc, "embed_demo.c"),
+           f"-I{inc}", f"-L{libdir}", f"-l{ver}", "-ldl", "-lm",
+           "-o", str(exe)]
+    r = subprocess.run(cmd, capture_output=True, text=True)
+    assert r.returncode == 0, r.stderr[-2000:]
+    env = dict(os.environ)
+    env["LD_LIBRARY_PATH"] = (libdir + ":"
+                              + env.get("LD_LIBRARY_PATH", ""))
+    env["PYTHONPATH"] = root + ":" + env.get("PYTHONPATH", "")
+    # the embedded interpreter must see the venv's packages: hand it the
+    # running interpreter's sys.path (an embedding host would set
+    # PYTHONPATH the same way)
+    env["PYTHONPATH"] = ":".join(sys.path[1:]) + ":" + env["PYTHONPATH"]
+    env["JAX_PLATFORMS"] = "cpu"
+    r = subprocess.run([str(exe)], capture_output=True, text=True,
+                       env=env, cwd=root, timeout=600)
+    assert r.returncode == 0, (r.stdout, r.stderr[-3000:])
+    got = [int(t) for t in r.stdout.split("generated:")[1].split()]
+
+    # Python twin: same config/seed through the bridge directly
+    from flexflow_tpu import embed_bridge
+
+    h = embed_bridge.create(json.dumps(dict(
+        family="llama", vocab_size=128, hidden_size=64,
+        intermediate_size=128, num_hidden_layers=2,
+        num_attention_heads=4, num_key_value_heads=2, seed=7,
+        max_requests=2, max_seq_length=48)))
+    want = embed_bridge.generate(h, [1, 5, 9], 6)
+    embed_bridge.destroy(h)
+    assert got == want, (got, want)
