@@ -1709,8 +1709,25 @@ def _platform_str():
         return f"unknown ({e})"
 
 
+def _slim(result):
+    """Compact stdout form: headline + {metric, value, unit[, key
+    quality fields]} per extra.  The r4 record lost 15 of 23 metrics
+    because the driver keeps only the TAIL of stdout and the full
+    object (methodology strings, curves, scaling models) overflowed the
+    capture — the complete record now lives in bench_results/<round>.json
+    and stdout stays small enough to survive AND parse."""
+    keep = ("metric", "value", "unit", "vs_baseline", "roofline_fraction",
+            "budget_ok", "acceptance", "error")
+    slim = {k: v for k, v in result.items() if k != "extras"}
+    slim.pop("scaling_model", None)
+    slim["record"] = "bench_results/ (full metrics, committed)"
+    slim["extras"] = [{k: m[k] for k in keep if k in m}
+                      for m in result.get("extras", [])]
+    return slim
+
+
 if __name__ == "__main__":
     _mode = sys.argv[1] if len(sys.argv) > 1 else "all"
     _result = main(_mode)
     persist_record(_result, _mode)
-    print(json.dumps(_result))
+    print(json.dumps(_slim(_result)))

@@ -39,8 +39,9 @@ static void capture_py_error(const char *where) {
   PyObject *t, *v, *tb;
   PyErr_Fetch(&t, &v, &tb);
   PyObject *s = v ? PyObject_Str(v) : nullptr;
-  g_err = std::string(where) + ": " +
-          (s ? PyUnicode_AsUTF8(s) : "unknown Python error");
+  const char *msg = s ? PyUnicode_AsUTF8(s) : nullptr;  // NULL if not
+  g_err = std::string(where) + ": " +                   // UTF-8-able
+          (msg ? msg : "unknown Python error");
   Py_XDECREF(s);
   Py_XDECREF(t);
   Py_XDECREF(v);

@@ -41,7 +41,7 @@ def create(config_json: str) -> int:
 
     from . import FFConfig, Model
     from .fftype import InferenceMode
-    from .serving import InferenceManager, RequestManager
+    from .serving import InferenceManager
 
     cfg = json.loads(config_json)
     family = cfg.get("family", "llama")
