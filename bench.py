@@ -355,7 +355,10 @@ def bench_spec_infer():
     ssm_cfg = dataclasses.replace(llm_cfg, num_hidden_layers=2)
     max_requests = 16
     prompt_len = 16
-    new_tokens = 64
+    # r5: 176-token generations — the 64-token runs measured per-sync
+    # tunnel RTT, not the mechanism (see bench_spec7b; same sync
+    # discipline both paths, fits the existing 256-token allocation)
+    new_tokens = 176
     W, D, tree_chunk = 1, 7, 16
 
     llm = build_aligned_llama(llm_cfg, InferenceMode.TREE_VERIFY,
