@@ -9,6 +9,7 @@ from .batch_config import (BatchConfig, BeamInferenceResult,
                            BeamSearchBatchConfig, InferenceResult,
                            TreeVerifyBatchConfig)
 from .inference_manager import InferenceManager
+from .prefix_cache import PrefixCache, PrefixEntry
 from .request_manager import (GenerationConfig, GenerationResult, ProfileInfo,
                               Request, RequestManager, get_request_manager,
                               reset_request_manager)

@@ -125,7 +125,7 @@ def prune_spec(spec: PartitionSpec, mesh) -> PartitionSpec:
     return PartitionSpec(*[prune(e) for e in spec])
 
 
-def beam_rerank(outs, cum, R: int, W: int):
+def beam_rerank(outs, cum, R: int, W: int, active=None):
     """On-device W*W joint beam re-rank for a chunk-1 BeamTopK step (the
     reference's host-side store_beam_metadata re-ranking).  Shared by the
     fused beam block and the spec block so the load-bearing assumptions
@@ -135,6 +135,11 @@ def beam_rerank(outs, cum, R: int, W: int):
     ``outs``: step outputs (ids, parents, logps); ``cum`` [R, W] running
     log-probs.  Returns (tok_new [R, W] int32, parent_b [R, W] int32,
     top_val [R, W] f32, rows_next [R*W] int32 cache-gather permutation).
+
+    ``active`` [R*W] bool: rows_next is forced to the identity for
+    inactive rows — their junk logits would otherwise permute retired
+    rows' caches, which the prefix-KV pool may still own (a pooled
+    beam-row-0 must keep its donated prefix intact).
     """
     # the BeamTopK head emits max_beam_width candidates sorted by
     # probability; use the first W
@@ -146,6 +151,9 @@ def beam_rerank(outs, cum, R: int, W: int):
     tok_new = jnp.take_along_axis(ids, top_idx, axis=1).astype(jnp.int32)
     rows_next = (jnp.arange(R)[:, None] * W
                  + parent_b).reshape(R * W).astype(jnp.int32)
+    if active is not None:
+        rows_next = jnp.where(active, rows_next,
+                              jnp.arange(R * W, dtype=jnp.int32))
     return tok_new, parent_b, top_val, rows_next
 
 
@@ -744,7 +752,7 @@ class InferenceManager:
                 b["parent_rows"] = parent_rows
                 outs, caches = step(params, caches, b, rng_i)
                 tok_new, parent_b, top_val, rows_next = beam_rerank(
-                    outs, cum, R, W)
+                    outs, cum, R, W, active=batch["active"])
                 carry2 = (caches, tok_new.reshape(RW), top_val,
                           depth + active, rows_next)
                 return carry2, (tok_new, parent_b, top_val)
@@ -936,6 +944,57 @@ class InferenceManager:
             _feed_rng(jax.random.split(rng, k)),
             _feed_array(init_tokens, jnp.int32))
         return toks
+
+    # ------------------------------------------------------- prefix cache
+    def _build_copy_prefix(self, record, L: int):
+        """Row->row KV copy of the first ``L`` cache positions, jitted
+        with donated caches (XLA updates in place) and dynamic src/dst
+        rows — one compiled variant per pow2 length bucket, not per row
+        pair.  The device half of the prefix cache: admission copies a
+        pooled prefix into the new request's row instead of re-running
+        prefill over it."""
+
+        def copy(caches, src, dst):
+            def cp(c):
+                seg = jax.lax.dynamic_slice(
+                    c, (src, 0, 0, 0), (1, c.shape[1], L, c.shape[3]))
+                return jax.lax.dynamic_update_slice(c, seg, (dst, 0, 0, 0))
+
+            out = jax.tree.map(cp, caches)
+            if record.get("cache_pspec") is not None:
+                out = pin_cache_layout(out, record["mesh"],
+                                       record["cache_pspec"])
+            return out
+
+        return jax.jit(copy, donate_argnums=(0,))
+
+    def supports_prefix_cache(self, model_id: int) -> bool:
+        """Prefix-cache copy needs the single-record cache layout;
+        stage-partitioned (pp) caches live on per-stage submeshes the
+        row copy is not wired through."""
+        return "pp_stages" not in self.models[model_id]
+
+    def copy_prefix(self, model_id: int, src_row: int, dst_row: int,
+                    length: int) -> None:
+        """Copy cache rows ``src_row[:length]`` -> ``dst_row`` for every
+        serving-attention layer of ``model_id``.  The copied span is the
+        pow2 bucket covering ``length`` (bounded jit variants); positions
+        past ``length`` may carry the source row's unrelated KV, which is
+        safe — they are re-scattered by the destination request's own
+        prefill before anything attends them (see prefix_cache.py)."""
+        record = self.models[model_id]
+        assert "pp_stages" not in record, (
+            "copy_prefix: pipeline-parallel records are not supported — "
+            "gate with supports_prefix_cache")
+        if src_row == dst_row or length <= 0:
+            return
+        L = pow2_bucket(length, record["alloc_len"]) or record["alloc_len"]
+        key = ("copy_prefix", L)
+        if key not in record["steps"]:
+            record["steps"][key] = self._build_copy_prefix(record, L)
+        record["caches"] = _retry_transient(
+            record["steps"][key], record["caches"],
+            _feed_array(np.int32(src_row)), _feed_array(np.int32(dst_row)))
 
     def reset_request_rows(self, model_id: int, rows: List[int]):
         """Zero cache bookkeeping for retired rows.  Cache contents need no

@@ -1,0 +1,349 @@
+"""Radix-tree prefix KV cache: automatic prompt-prefix reuse across requests.
+
+The serving stack recomputes every prompt from scratch: admission starts
+each request at ``cached_len = 0``, so shared system prompts and few-shot
+prefixes — the dominant token mass in production traffic — burn full
+prefill FLOPs and HBM bandwidth on every request.  This module is the
+host half of the fix (SGLang's RadixAttention / vLLM's automatic prefix
+caching, adapted to this stack's row-oriented caches):
+
+- On retirement a request's cache row is NOT freed: it is donated to a
+  pool, and a radix tree over token sequences maps the row's committed
+  prefix to a :class:`PrefixEntry` (per-model cache row + valid KV
+  length, refcount, LRU stamp).
+- On admission the longest matching pooled prefix is copied device-side
+  into the new request's row (``InferenceManager.copy_prefix`` — a
+  jitted, donated, pow2-length-bucketed step), and the request starts
+  with ``first_token_depth = matched_len`` so chunked prefill skips the
+  reused span entirely.
+
+Row accounting: a pooled entry OWNS its batch slot — the RequestManager
+excludes pooled slots from admission until the entry is evicted.  The
+pool is capped at ``max_requests - 1`` slots so one row is always
+admissible without an eviction; beyond that, admission evicts LRU
+unreferenced entries on demand (and insertion evicts to make room).
+
+Alignment rule (the flash-append contract): matches are aligned DOWN to
+a 16-divisible boundary (:data:`PREFIX_ALIGN`).  Prefill chunks are pow2
+buckets, so every chunk >= 16 is a multiple of 16 and each row's chunk
+start depth stays 16-aligned — the invariant the flash-prefill append
+window (``kernels/flash_prefill.prefill_path_ok``) was calibrated
+against.  A non-aligned start depth would be the ONLY way to break it.
+
+Correctness of over-copying: the device copy moves a pow2 BUCKET of
+positions (>= matched_len).  Positions past ``matched_len`` may hold the
+source row's unrelated KV, but every attended position is either
+< matched_len (valid shared-prefix KV — identical bit-for-bit to what
+prefill would recompute, since KV depends only on token values and
+absolute positions) or re-scattered by the request's own prefill/decode
+in the same step that first attends it.  The same argument covers
+claiming an entry's slot IN PLACE (zero-copy) when the match lives in
+the row being admitted.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from ..utils.profiling import PrefixCacheStats
+
+# Matches align down to this boundary — the flash-prefill append window
+# assumes 16-aligned chunk start depths (see module docstring).
+PREFIX_ALIGN = 16
+
+
+def align_down(n: int, align: int = PREFIX_ALIGN) -> int:
+    return (n // align) * align
+
+
+class _Node:
+    """Radix-tree node.  ``edge`` is the token span from the parent;
+    ``n_entries`` counts entries in this node's subtree (including its
+    own), kept incrementally so match() can test "any entry below the
+    longest-common-prefix point" in O(path)."""
+
+    __slots__ = ("edge", "children", "entry", "parent", "n_entries")
+
+    def __init__(self, edge: List[int], parent: Optional["_Node"]):
+        self.edge = edge
+        self.children: Dict[int, "_Node"] = {}
+        self.entry: Optional["PrefixEntry"] = None
+        self.parent = parent
+        self.n_entries = 0
+
+
+class PrefixEntry:
+    """One donated prefix: a retired request's cache row(s) whose first
+    ``length`` positions hold committed KV for ``length`` known tokens.
+
+    ``rows`` maps model_id -> (cache_row, kv_len): the spec path donates
+    the LLM row and each SSM's beam-row-0 under one entry (they share
+    the batch slot), with per-model valid lengths.
+    """
+
+    __slots__ = ("slot", "rows", "length", "refs", "last_use", "node")
+
+    def __init__(self, slot: int, rows: Dict[int, Tuple[int, int]],
+                 length: int):
+        self.slot = slot                  # batch slot this entry owns
+        self.rows = rows                  # model_id -> (cache_row, kv_len)
+        self.length = length              # donated token-prefix length
+        self.refs = 0                     # live requests pinning this entry
+        self.last_use = 0                 # LRU tick
+        self.node: Optional[_Node] = None
+
+
+class PrefixCache:
+    """Host-side radix tree over donated token prefixes with refcounts
+    and LRU eviction.  Pure bookkeeping — the KV bytes live in the
+    InferenceManager's cache rows; this class only decides which rows
+    hold which prefixes and when they are reclaimed."""
+
+    def __init__(self, max_slots: int, align: int = PREFIX_ALIGN,
+                 min_match: int = PREFIX_ALIGN):
+        self.max_slots = max_slots
+        self.align = align
+        self.min_match = min_match
+        self.root = _Node([], None)
+        self.entries: Dict[int, PrefixEntry] = {}   # slot -> entry
+        self.stats = PrefixCacheStats()
+        self._tick = 0
+
+    # ------------------------------------------------------------- helpers
+    def __len__(self) -> int:
+        return len(self.entries)
+
+    def pooled_slots(self) -> Set[int]:
+        return set(self.entries)
+
+    def _bump(self, entry: PrefixEntry):
+        self._tick += 1
+        entry.last_use = self._tick
+
+    @staticmethod
+    def _lcp(a: Sequence[int], b: Sequence[int]) -> int:
+        n = min(len(a), len(b))
+        i = 0
+        while i < n and a[i] == b[i]:
+            i += 1
+        return i
+
+    def _covered(self, tokens: Sequence[int]) -> bool:
+        """True when an existing entry already extends ``tokens`` (every
+        match the donation could serve, that entry serves at least as
+        well).  Read-only — safe to run before capacity eviction."""
+        node, i = self.root, 0
+        while i < len(tokens):
+            child = node.children.get(tokens[i])
+            if child is None:
+                return False
+            j = self._lcp(child.edge, tokens[i:])
+            i += j
+            if j < len(child.edge):
+                return i == len(tokens) and child.n_entries > 0
+            node = child
+        return node is not self.root and node.n_entries > 0
+
+    # -------------------------------------------------------------- insert
+    def insert(self, tokens: Sequence[int], slot: int,
+               rows: Dict[int, Tuple[int, int]]) -> bool:
+        """Donate a retired slot's row(s) holding KV for ``tokens``.
+
+        Returns False (caller keeps the slot free) when the donation is
+        redundant — an existing entry already extends ``tokens`` — or
+        when the pool is full of referenced entries.  Entries that are
+        PROPER prefixes of the new one are superseded: evicted (freeing
+        their slots) once unreferenced, since every match they could
+        serve the new entry serves at least as well.
+        """
+        tokens = [int(t) for t in tokens]
+        if len(tokens) < max(self.min_match, 1) or slot in self.entries:
+            self.stats.donations_rejected += 1
+            return False
+        if self._covered(tokens):
+            self.stats.donations_rejected += 1
+            return False
+        # capacity eviction BEFORE the mutating walk: evict_one prunes
+        # tree nodes, so running it mid-walk could detach the very node
+        # the new leaf is about to hang off
+        while len(self.entries) >= self.max_slots:
+            if self.evict_one() is None:
+                self.stats.donations_rejected += 1
+                return False
+        # walk, collecting path entries (potential supersede victims)
+        node, i = self.root, 0
+        path_entries: List[PrefixEntry] = []
+        while i < len(tokens):
+            child = node.children.get(tokens[i])
+            if child is None:
+                break
+            j = self._lcp(child.edge, tokens[i:])
+            if j < len(child.edge):
+                # diverges (or tokens end) mid-edge
+                node = self._split(child, j)
+                i += j
+                break
+            i += j
+            node = child
+            if node.entry is not None and i < len(tokens):
+                path_entries.append(node.entry)
+        # extend with the unmatched remainder
+        if i < len(tokens):
+            leaf = _Node(tokens[i:], node)
+            node.children[tokens[i]] = leaf
+            node = leaf
+        entry = PrefixEntry(slot, dict(rows), len(tokens))
+        entry.node = node
+        node.entry = entry
+        n = node
+        while n is not None:
+            n.n_entries += 1
+            n = n.parent
+        self.entries[slot] = entry
+        self._bump(entry)
+        self.stats.donations += 1
+        # supersede shallower same-path entries (their coverage is a
+        # strict subset of the new entry's)
+        for old in path_entries:
+            if old.refs == 0:
+                self.remove(old)
+                self.stats.evictions += 1
+        return True
+
+    def _split(self, child: _Node, j: int) -> _Node:
+        """Split ``child``'s edge at offset j; returns the new mid node."""
+        parent = child.parent
+        mid = _Node(child.edge[:j], parent)
+        mid.n_entries = child.n_entries
+        parent.children[mid.edge[0]] = mid
+        child.edge = child.edge[j:]
+        child.parent = mid
+        mid.children[child.edge[0]] = child
+        return mid
+
+    # --------------------------------------------------------------- match
+    def match(self, tokens: Sequence[int]
+              ) -> Tuple[Optional[PrefixEntry], int]:
+        """Longest usable pooled prefix of ``tokens``.
+
+        Returns (entry, d) where the entry's first d tokens equal
+        ``tokens[:d]`` — d is capped at len(tokens) - 1 (at least one
+        token must run through the model to sample a continuation) and
+        aligned down to the 16 boundary.  (None, 0) on no usable match.
+        Per-model usable lengths are a further cap: :meth:`usable`.
+        """
+        tokens = [int(t) for t in tokens]
+        cap = len(tokens) - 1
+        node, i = self.root, 0
+        best: Optional[PrefixEntry] = None
+        best_d = 0
+        while i < len(tokens):
+            child = node.children.get(tokens[i])
+            if child is None:
+                # diverged exactly at a node boundary: every entry below
+                # ``node`` still shares tokens[:i] (subtree entries have
+                # length >= i, so e.length never caps the span here)
+                if node is not self.root and node.n_entries:
+                    e = self._deepest_entry(node)
+                    d = align_down(min(i, cap), self.align)
+                    if e is not None and d > best_d:
+                        best, best_d = e, d
+                break
+            j = self._lcp(child.edge, tokens[i:])
+            i += j
+            if j < len(child.edge):
+                # diverged (or ran out) mid-edge: everything below child
+                # still shares tokens[:i]
+                if child.n_entries:
+                    e = self._deepest_entry(child)
+                    d = align_down(min(i, cap, e.length), self.align)
+                    if d > best_d:
+                        best, best_d = e, d
+                break
+            node = child
+            if node.entry is not None:
+                d = align_down(min(i, cap), self.align)
+                if d >= best_d:      # deeper path entry wins ties
+                    best, best_d = node.entry, d
+            if i == len(tokens) and node.n_entries > (
+                    1 if node.entry is not None else 0):
+                e = self._deepest_entry(node, skip_self=True)
+                if e is not None:
+                    d = align_down(min(i, cap), self.align)
+                    if d > best_d:
+                        best, best_d = e, d
+        if best is None or best_d < self.min_match:
+            return None, 0
+        self._bump(best)
+        return best, best_d
+
+    def _deepest_entry(self, node: _Node, skip_self: bool = False
+                       ) -> Optional[PrefixEntry]:
+        """Any entry in ``node``'s subtree (most-recently-used among the
+        shallowest hits found first — exactness does not matter: every
+        subtree entry shares the caller's common prefix)."""
+        stack = [(node, skip_self)]
+        found: Optional[PrefixEntry] = None
+        while stack:
+            n, skip = stack.pop()
+            if n.entry is not None and not skip:
+                if found is None or n.entry.last_use > found.last_use:
+                    found = n.entry
+                continue  # one entry per branch is enough
+            for c in n.children.values():
+                if c.n_entries:
+                    stack.append((c, False))
+        return found
+
+    def usable(self, entry: PrefixEntry, model_id: int, d: int,
+               n_tokens: int) -> int:
+        """The span of ``entry`` this model may reuse for a prompt of
+        ``n_tokens`` tokens whose first ``d`` agree with the entry."""
+        if model_id not in entry.rows:
+            return 0
+        _, kv_len = entry.rows[model_id]
+        return align_down(min(d, kv_len, n_tokens - 1), self.align)
+
+    # ---------------------------------------------------------- refcounts
+    def acquire(self, entry: PrefixEntry):
+        entry.refs += 1
+        self._bump(entry)
+
+    def release(self, entry: PrefixEntry):
+        assert entry.refs > 0, "release without acquire"
+        entry.refs -= 1
+
+    # ------------------------------------------------------------ evict
+    def evict_one(self, prefer_not: Optional[PrefixEntry] = None
+                  ) -> Optional[Tuple[int, PrefixEntry]]:
+        """Evict the LRU UNREFERENCED entry, preferring not to sacrifice
+        ``prefer_not`` (the entry a pending admission just matched) —
+        unless it is the only candidate, in which case the caller
+        detects ``entry is prefer_not`` and claims its row in place.
+        Returns (freed_slot, evicted_entry) or None."""
+        victims = [e for e in self.entries.values() if e.refs == 0]
+        if prefer_not is not None and len(victims) > 1:
+            victims = [e for e in victims if e is not prefer_not]
+        if not victims:
+            return None
+        victim = min(victims, key=lambda e: e.last_use)
+        self.remove(victim)
+        self.stats.evictions += 1
+        return victim.slot, victim
+
+    def remove(self, entry: PrefixEntry):
+        """Drop an entry and prune its now-empty branch."""
+        node = entry.node
+        node.entry = None
+        entry.node = None
+        n = node
+        while n is not None:
+            n.n_entries -= 1
+            n = n.parent
+        # prune childless, entryless nodes upward
+        while (node is not self.root and node.entry is None
+               and not node.children):
+            parent = node.parent
+            del parent.children[node.edge[0]]
+            node = parent
+        self.entries.pop(entry.slot, None)

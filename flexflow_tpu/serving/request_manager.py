@@ -23,10 +23,11 @@ lives in spec_infer.py and reuses this queue/slot machinery.
 
 from __future__ import annotations
 
+import collections
 import dataclasses
 import os
 import time
-from typing import Any, Dict, List, Optional, Sequence
+from typing import Any, Deque, Dict, List, Optional, Sequence, Tuple
 
 import jax
 import numpy as np
@@ -34,6 +35,7 @@ import numpy as np
 from ..fftype import InferenceMode
 from .batch_config import BatchConfig, InferenceResult, pick_chunk
 from .inference_manager import InferenceManager
+from .prefix_cache import PrefixCache
 
 
 @dataclasses.dataclass
@@ -77,6 +79,8 @@ class ProfileInfo:
     # beam on device (not recomputed W times per chunk).
     ssm_prefill_chunks: int = 0
     ssm_prefill_rows: int = 0
+    # prompt tokens whose KV came from the prefix cache (prefill skipped)
+    prefix_matched_tokens: int = 0
     start_time: float = 0.0
     # host-observed time the first generated token became available (the
     # p50-TTFT ingredient, BASELINE.md north-star metric); under decode
@@ -106,6 +110,7 @@ class Request:
         self.status = Request.PENDING
         self.row: Optional[int] = None      # batch slot while RUNNING
         self.cached_len = 0                 # tokens whose KV is committed
+        self.prefix_entry = None            # pinned PrefixEntry while RUNNING
         self.profile = ProfileInfo(start_time=time.time())
 
     def remaining_budget(self, manager_max_seq_len: int) -> int:
@@ -125,7 +130,9 @@ class RequestManager:
                  max_tokens_per_batch: int = 256,
                  max_sequence_length: int = 1024,
                  max_spec_tree_token_num: int = 64,
-                 decode_block: int = 16):
+                 decode_block: int = 16,
+                 prefix_cache: bool = False,
+                 prefix_pool_slots: Optional[int] = None):
         self.max_requests_per_batch = max_requests_per_batch
         self.max_tokens_per_batch = max_tokens_per_batch
         self.max_sequence_length = max_sequence_length
@@ -136,7 +143,7 @@ class RequestManager:
         self.eos_token_id: Optional[int] = None
         self.bos_token_id: Optional[int] = None
         self.add_bos_token = True
-        self.pending: List[Request] = []
+        self.pending: Deque[Request] = collections.deque()
         self.running: Dict[int, Request] = {}   # row -> Request
         self.completed: Dict[int, Request] = {}
         self.next_guid = 1000000
@@ -144,6 +151,19 @@ class RequestManager:
         self.ssm_model_ids: List[int] = []
         self._dumped_guids: set = set()
         self._rng = np.random.default_rng(0)
+        # prefix KV cache (serving/prefix_cache.py): retired rows are
+        # donated to a radix-tree pool instead of freed; admissions copy
+        # the longest pooled prefix into the new row.  Spare-row
+        # accounting: the pool is capped one below the batch size so one
+        # slot is always admissible without an eviction.
+        self.prefix_cache: Optional[PrefixCache] = None
+        if prefix_cache:
+            slots = (prefix_pool_slots if prefix_pool_slots is not None
+                     else max(0, max_requests_per_batch - 1))
+            self.prefix_cache = PrefixCache(max_slots=slots)
+        # (im, model_id) while a generate loop that supports donation /
+        # prefix copies is driving this manager (generate_incr_decoding)
+        self._prefix_ctx: Optional[Tuple[InferenceManager, int]] = None
 
     # -------------------------------------------------------------- setup
     def register_tokenizer(self, tokenizer, eos_token_id=None,
@@ -187,8 +207,103 @@ class RequestManager:
 
     # ------------------------------------------------------- batch update
     def _free_rows(self) -> List[int]:
+        pooled = (self.prefix_cache.pooled_slots()
+                  if self.prefix_cache is not None else ())
         return [r for r in range(self.max_requests_per_batch)
-                if r not in self.running]
+                if r not in self.running and r not in pooled]
+
+    # ------------------------------------------------------ prefix cache
+    def admit_pending(self, im: Optional[InferenceManager] = None,
+                      model_rows: Optional[Dict[int, int]] = None
+                      ) -> List[Tuple[Request, Dict[int, int]]]:
+        """Admit pending requests into batch slots (the single admission
+        path for the incremental, host-spec and device-spec drivers).
+
+        With the prefix cache on: pooled slots are excluded from
+        admission; when no slot is free, the LRU unreferenced pool entry
+        is evicted to make one (live-referenced entries are never
+        evicted).  Each admitted request's prompt is matched against the
+        pool; on a hit the matched span (16-aligned) is copied
+        device-side into the request's row per model and the request
+        starts with ``cached_len = matched`` so prefill skips it.  When
+        the evicted entry IS the match, its slot is claimed in place —
+        a zero-copy hit.
+
+        ``model_rows``: model_id -> row multiplier (cache_row =
+        slot * multiplier; 1 for the LLM, beam_width for an SSM's
+        beam-row 0).  The first key is the primary model whose match
+        sets ``req.cached_len``.  Returns (request, {model_id:
+        matched_len}) per admission; matched is empty without a hit.
+        """
+        pool = self.prefix_cache
+        admitted: List[Tuple[Request, Dict[int, int]]] = []
+        primary = next(iter(model_rows), None) if model_rows else None
+        # a driver that cannot host the row copy (no im / no row map —
+        # e.g. the pp spec loop) must not walk the tree: a guaranteed
+        # miss would still skew hit_rate / tokens-saved and bump LRU
+        serving = pool is not None and im is not None and bool(model_rows)
+        while self.pending:
+            free = self._free_rows()
+            if not free and (pool is None
+                             or all(e.refs
+                                    for e in pool.entries.values())):
+                # no slot and nothing evictable: bail BEFORE the tree
+                # walk — a saturated batch re-enters here every decode
+                # step, and a discarded match would both waste
+                # O(prompt_len) work and bump the matched entry's LRU
+                # recency without ever consuming it
+                break
+            req = self.pending[0]
+            entry, d = pool.match(req.tokens) if serving else (None, 0)
+            inplace = False
+            if free:
+                row = free[0]
+            else:
+                row, victim = pool.evict_one(prefer_not=entry)
+                inplace = victim is entry
+            self.pending.popleft()
+            req.status = Request.RUNNING
+            req.row = row
+            req.cached_len = 0
+            self.running[row] = req
+            matched: Dict[int, int] = {}
+            if entry is not None and d:
+                for mid, mult in (model_rows or {}).items():
+                    use = pool.usable(entry, mid, d, len(req.tokens))
+                    if use <= 0:
+                        continue
+                    if inplace:
+                        # the entry's KV already lives in this slot's
+                        # rows (cache_row == slot * mult) — zero copy
+                        matched[mid] = use
+                    elif im is not None:
+                        src = entry.rows[mid][0]
+                        im.copy_prefix(mid, src, row * mult, use)
+                        matched[mid] = use
+                if matched and not inplace:
+                    pool.acquire(entry)
+                    req.prefix_entry = entry
+            if serving:
+                best = max(matched.values(), default=0)
+                req.profile.prefix_matched_tokens = best
+                pool.stats.note_lookup(best, req.prompt_len)
+            if primary is not None:
+                req.cached_len = matched.get(primary, 0)
+            admitted.append((req, matched))
+        return admitted
+
+    def prefix_donate(self, req: Request, slot: int, length: int,
+                      rows: Dict[int, Tuple[int, int]]) -> bool:
+        """Donate a retiring request's batch ``slot`` to the prefix pool:
+        ``rows`` maps model_id -> (cache_row, kv_len) — the cache row
+        holding the donated KV and how many positions of it are valid
+        (the LLM row is slot * 1; an SSM's beam-row 0 is slot * W).
+        Returns False when the pool is off or rejects (redundant prefix
+        / full of referenced entries) — the slot then frees normally."""
+        if (self.prefix_cache is None
+                or length < self.prefix_cache.min_match):
+            return False
+        return self.prefix_cache.insert(req.tokens[:length], slot, rows)
 
     def _finished(self, req: Request, new_token: int) -> bool:
         if self.eos_token_id is not None and new_token == self.eos_token_id:
@@ -198,9 +313,21 @@ class RequestManager:
     def _retire(self, req: Request):
         req.status = Request.COMPLETED
         req.profile.finish_time = time.time()
-        del self.running[req.row]
+        row = req.row
+        del self.running[row]
         self.completed[req.guid] = req
         req.row = None
+        if req.prefix_entry is not None:
+            self.prefix_cache.release(req.prefix_entry)
+            req.prefix_entry = None
+        # prefix-cache donation (incremental path; the spec drivers call
+        # prefix_donate explicitly with their per-model watermarks):
+        # instead of freeing the row, hand its committed KV
+        # (tokens[:cached_len]) to the pool
+        if self.prefix_cache is not None and self._prefix_ctx is not None:
+            _, model_id = self._prefix_ctx
+            self.prefix_donate(req, row, req.cached_len,
+                               {model_id: (row, req.cached_len)})
 
     def prepare_next_batch(self, prev_bc: Optional[BatchConfig],
                            prev_result: Optional[InferenceResult]
@@ -226,15 +353,12 @@ class RequestManager:
                     if self._finished(req, tok):
                         self._retire(req)
 
-        # 2) admit pending requests into free rows
-        for row in self._free_rows():
-            if not self.pending:
-                break
-            req = self.pending.pop(0)
-            req.status = Request.RUNNING
-            req.row = row
-            req.cached_len = 0
-            self.running[row] = req
+        # 2) admit pending requests into free slots (prefix-aware: a
+        #    pooled-prefix hit starts the request at cached_len = matched
+        #    so step 3 schedules only the unseen span)
+        ctx = self._prefix_ctx
+        self.admit_pending(im=ctx[0] if ctx else None,
+                           model_rows={ctx[1]: 1} if ctx else None)
 
         if not self.running:
             return None
@@ -321,6 +445,20 @@ class RequestManager:
         if decode_block is None:
             decode_block = self.decode_block
         rng = jax.random.PRNGKey(seed)
+        # arm the prefix cache for this model: admissions match/copy and
+        # retirements donate rows (pp records lack the row-copy step)
+        self._prefix_ctx = (
+            (im, model_id)
+            if (self.prefix_cache is not None
+                and im.supports_prefix_cache(model_id)) else None)
+        try:
+            return self._incr_decoding_loop(im, model_id, requests, rng,
+                                            decode_block)
+        finally:
+            self._prefix_ctx = None
+
+    def _incr_decoding_loop(self, im, model_id, requests, rng,
+                            decode_block):
         bc, result = None, None
         while True:
             bc = self.prepare_next_batch(bc, result)
@@ -477,6 +615,7 @@ class RequestManager:
                     "ssm_decoding_steps": p.ssm_decoding_steps,
                     "speculated_tokens": p.speculated_tokens,
                     "accepted_tokens": p.accepted_tokens,
+                    "prefix_matched_tokens": p.prefix_matched_tokens,
                     "latency_s": p.finish_time - p.start_time,
                     "ttft_s": (p.first_token_time - p.start_time
                                if p.first_token_time else None),

@@ -169,12 +169,15 @@ def _ssm_expand(ssm_step, ssm_step_beam, W: int, D: int, ssm_params,
              "parent_rows": parent_rows}
         outs_b, caches = ssm_step_beam(ssm_params, caches, b, rng_i)
         tok_new, parent_b, top_val, rows_next = beam_rerank(
-            outs_b, cum, R, W)
+            outs_b, cum, R, W, active=act_rw)
         return ((caches, tok_new.reshape(RW), top_val,
                  depth + act_rw_i, rows_next), (tok_new, parent_b))
 
-    carry0 = (ssm_caches, seed_ids.reshape(RW), seed_lp, depth0,
-              jnp.repeat(row0, W))  # first gather broadcasts row 0
+    # first gather broadcasts row 0 across each ACTIVE request's beam;
+    # inactive slots stay identity (a pooled slot's rows must not move)
+    parents0 = jnp.where(act_rw, jnp.repeat(row0, W),
+                         jnp.arange(RW, dtype=jnp.int32))
+    carry0 = (ssm_caches, seed_ids.reshape(RW), seed_lp, depth0, parents0)
     if D > 1:
         (ssm_caches, *_), (lv_tok, lv_par) = jax.lax.scan(
             beam_body, carry0, jax.random.split(r2, D - 1))
@@ -568,18 +571,24 @@ def generate_spec_infer_device(rm, im, llm_id: int,
     T = rm.max_sequence_length + D + 2
     rng = jax.random.PRNGKey(seed)
 
+    from .spec_infer import spec_model_rows, spec_prefix_donate
+
+    model_rows = spec_model_rows(rm, im, llm_id)
     # per-guid persistent marks surviving state rebuilds (admission points)
     states: Dict[int, Dict] = {}
 
     while True:
-        for row in rm._free_rows():
-            if not rm.pending:
-                break
-            req = rm.pending.pop(0)
-            req.status = Request.RUNNING
-            req.row = row
-            rm.running[row] = req
-            states[req.guid] = _new_guid_state(D)
+        # prefix-aware admission: a pooled-prefix hit copies the matched
+        # span into the LLM row and every SSM's beam-row 0, and both
+        # watermarks start at the matched length so the prompt prefills
+        # below only feed the unseen tail.  ssm_cached is SHARED across
+        # SSMs, so it advances only to the shortest per-SSM match.
+        for req, matched in rm.admit_pending(im=im, model_rows=model_rows):
+            st = _new_guid_state(D)
+            st["llm_cached"] = matched.get(llm_id, 0)
+            st["ssm_cached"] = min(
+                (matched.get(sid, 0) for sid in ssm_ids), default=0)
+            states[req.guid] = st
         if not rm.running:
             break
         running = dict(rm.running)
@@ -733,6 +742,16 @@ def generate_spec_infer_device(rm, im, llm_id: int,
             st["speculated"] = int(P[row, 7])
             st["llm_steps"] = int(P[row, 8])
             if not active[row]:
+                if model_rows:
+                    # retired rows had their commit list zeroed on device
+                    # (commit_count = 0 once a row stops), so the exact
+                    # final n_commit is gone — donate the conservative
+                    # llm_cached - (D+1) bound (n_commit <= D+1; the
+                    # 16-alignment of matches absorbs the slack anyway)
+                    spec_prefix_donate(
+                        rm, im, llm_id, req,
+                        max(0, st["llm_cached"] - (D + 1)),
+                        {sid: st["ssm_cached"] for sid in ssm_ids})
                 rm._retire(req)
                 states.pop(req.guid, None)
     return [rm._result_of(r) for r in requests]
@@ -818,13 +837,10 @@ def generate_spec_infer_device_pp(rm, im, llm_id: int,
     states: Dict[int, Dict] = {}
 
     while True:
-        for row in rm._free_rows():
-            if not rm.pending:
-                break
-            req = rm.pending.pop(0)
-            req.status = Request.RUNNING
-            req.row = row
-            rm.running[row] = req
+        # unified admission (no prefix reuse here: the pp LLM's staged
+        # caches are not wired through the row copy — spec_model_rows
+        # returns None for it — but the slot accounting stays shared)
+        for req, _ in rm.admit_pending():
             states[req.guid] = _new_guid_state(D)
         if not rm.running:
             break

@@ -212,6 +212,41 @@ def _ssm_prefill(rm, im, ssm_id, states, running, beam_width, seed_rng):
     return results
 
 
+def spec_model_rows(rm, im, llm_id: int) -> Optional[Dict[int, int]]:
+    """model_id -> cache-row multiplier map for prefix-aware admission
+    (RequestManager.admit_pending), or None when the prefix cache is off
+    or the LLM record cannot host the row copy.  The LLM comes first
+    (the primary model — its match seeds ``req.cached_len``); each SSM's
+    beam-row 0 lives at slot * beam_width."""
+    if rm.prefix_cache is None or not im.supports_prefix_cache(llm_id):
+        return None
+    rows = {llm_id: 1}
+    for sid in rm.ssm_model_ids:
+        if im.supports_prefix_cache(sid):
+            rows[sid] = im.models[sid]["beam_width"]
+    return rows
+
+
+def spec_prefix_donate(rm, im, llm_id: int, req: Request, llm_committed: int,
+                       ssm_cached: Dict[int, int]) -> bool:
+    """Donate a retiring spec request's rows to the prefix pool: the LLM
+    row up to ``llm_committed`` (the watermark EXCLUDING accepted-but-
+    uncommitted KV — pending commit lists still sit at tree slots) and
+    each SSM's beam-row 0 up to its prefill watermark.  Every beam row
+    holds the committed prefix (the per-iteration row-0 broadcast), and
+    inactive rows are pinned by the beam_rerank identity mask, so row 0
+    keeps the donated span intact while the slot sits in the pool."""
+    if (rm.prefix_cache is None or req.row is None
+            or not im.supports_prefix_cache(llm_id)):
+        return False
+    rows = {llm_id: (req.row, llm_committed)}
+    for sid in rm.ssm_model_ids:
+        if im.supports_prefix_cache(sid) and ssm_cached.get(sid, 0) > 0:
+            W = im.models[sid]["beam_width"]
+            rows[sid] = (req.row * W, ssm_cached[sid])
+    return rm.prefix_donate(req, req.row, llm_committed, rows)
+
+
 def generate_spec_infer(rm, im, llm_id: int, requests: Sequence[Request],
                         seed: int = 0,
                         beam_width: Optional[int] = None,
@@ -263,6 +298,13 @@ def generate_spec_infer(rm, im, llm_id: int, requests: Sequence[Request],
                 "(was %d) to keep the device loop", sid, beam_width,
                 rec["beam_width"])
             im.rewiden_beam(sid, beam_width)
+            if rm.prefix_cache is not None:
+                # the re-widened record re-allocates (or swaps) the SSM
+                # caches, so pooled entries' SSM rows no longer hold the
+                # donated KV — drop that component (usable() then returns
+                # 0 for this model; the LLM rows stay valid)
+                for e in rm.prefix_cache.entries.values():
+                    e.rows.pop(sid, None)
     if device_loop is None:
         device_loop = device_loop_supported(rm, im, llm_id, beam_width,
                                             beam_depth)
@@ -274,17 +316,20 @@ def generate_spec_infer(rm, im, llm_id: int, requests: Sequence[Request],
     tree_chunk = rm.max_spec_tree_token_num
     rng = jax.random.PRNGKey(seed)
     states: Dict[int, SpecState] = {}
+    model_rows = spec_model_rows(rm, im, llm_id)
 
     while True:
         # ---- admission / retirement bookkeeping via the shared machinery
-        for row in rm._free_rows():
-            if not rm.pending:
-                break
-            req = rm.pending.pop(0)
-            req.status = Request.RUNNING
-            req.row = row
-            rm.running[row] = req
-            states[req.guid] = SpecState()
+        # (prefix-aware: a pooled-prefix hit copies the matched span into
+        # the LLM row AND each SSM's beam-row 0, and the per-model
+        # watermarks start at the matched length so both prefills skip it)
+        for req, matched in rm.admit_pending(im=im, model_rows=model_rows):
+            st = SpecState()
+            st.llm_cached = matched.get(llm_id, 0)
+            for sid in ssm_ids:
+                if matched.get(sid, 0):
+                    st.ssm_cached[sid] = matched[sid]
+            states[req.guid] = st
         if not rm.running:
             break
         running = dict(rm.running)
@@ -444,6 +489,12 @@ def generate_spec_infer(rm, im, llm_id: int, requests: Sequence[Request],
                     finished = True
                     break
             if finished:
+                # donate BEFORE _retire clears req.row: committed KV =
+                # positions below the pending commit list (accepted
+                # speculative KV still sits at tree slots)
+                spec_prefix_donate(rm, im, llm_id, req,
+                                   st.llm_cached - len(st.commit_src),
+                                   st.ssm_cached)
                 rm._retire(req)
                 states.pop(req.guid, None)
     return [rm._result_of(r) for r in requests]

@@ -13,8 +13,9 @@ TPU-native equivalents of the reference's profiling aids (SURVEY.md §5):
 from __future__ import annotations
 
 import contextlib
+import dataclasses
 import time
-from typing import Any, Dict, List
+from typing import Any, Dict, List, Optional, Sequence
 
 import jax
 
@@ -65,6 +66,59 @@ def profile_per_op(model, params, input_values: Dict[str, Any],
     eager_layer_walk(model, params, input_values, visit,
                      inference=inference, rng=rng)
     return report
+
+
+@dataclasses.dataclass
+class PrefixCacheStats:
+    """Prefix-KV-cache effectiveness counters (serving/prefix_cache.py).
+
+    ``tokens_matched`` is the KV the pool actually supplied (prefill
+    FLOPs + HBM writes skipped); ``tokens_prompt`` is the total prompt
+    token mass admitted while the cache was on — their ratio is the
+    tokens-saved fraction, the cache's headline win alongside warm-TTFT.
+    """
+
+    lookups: int = 0
+    hits: int = 0
+    tokens_matched: int = 0
+    tokens_prompt: int = 0
+    donations: int = 0
+    donations_rejected: int = 0
+    evictions: int = 0
+
+    def note_lookup(self, matched: int, prompt_len: int):
+        self.lookups += 1
+        self.tokens_prompt += prompt_len
+        if matched > 0:
+            self.hits += 1
+            self.tokens_matched += matched
+
+    def hit_rate(self) -> float:
+        return self.hits / self.lookups if self.lookups else 0.0
+
+    def tokens_saved_frac(self) -> float:
+        return (self.tokens_matched / self.tokens_prompt
+                if self.tokens_prompt else 0.0)
+
+    def snapshot(self) -> Dict[str, Any]:
+        d = dataclasses.asdict(self)
+        d["hit_rate"] = round(self.hit_rate(), 4)
+        d["tokens_saved_frac"] = round(self.tokens_saved_frac(), 4)
+        return d
+
+
+def ttft_percentiles(requests: Sequence[Any],
+                     ps: Sequence[int] = (50, 90)) -> Dict[str, float]:
+    """Host-observed time-to-first-token percentiles (seconds) over a
+    batch of finished Requests (serving ProfileInfo stamps).  Requests
+    that never produced a token are skipped."""
+    import numpy as np
+
+    ttfts = [r.profile.first_token_time - r.profile.start_time
+             for r in requests if r.profile.first_token_time > 0.0]
+    if not ttfts:
+        return {f"p{p}": 0.0 for p in ps}
+    return {f"p{p}": float(np.percentile(ttfts, p)) for p in ps}
 
 
 def format_profile(report: List[Dict[str, Any]]) -> str:

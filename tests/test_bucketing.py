@@ -1,0 +1,76 @@
+"""Shape-bucket policy tests.
+
+pow2_bucket / attend_bucket (inference_manager) and pick_chunk
+(batch_config) are the single sources of the jit-variant bucketing
+policy — every serving step's compiled shape flows through them, so the
+floor, the two-buckets-per-octave ladder and the no-saving sentinel are
+pinned here rather than re-derived from downstream behavior.
+"""
+
+import numpy as np
+
+from flexflow_tpu.serving.batch_config import BatchConfig, pick_chunk
+from flexflow_tpu.serving.inference_manager import attend_bucket, pow2_bucket
+
+
+class TestPow2Bucket:
+    def test_floor_64(self):
+        for need in (1, 2, 33, 63, 64):
+            assert pow2_bucket(need, 10_000) == 64
+
+    def test_two_buckets_per_octave(self):
+        # the ladder is 64, 96, 128, 192, 256, 384, 512, ...
+        assert pow2_bucket(65, 10_000) == 96
+        assert pow2_bucket(96, 10_000) == 96
+        assert pow2_bucket(97, 10_000) == 128
+        assert pow2_bucket(128, 10_000) == 128
+        assert pow2_bucket(129, 10_000) == 192
+        assert pow2_bucket(192, 10_000) == 192
+        assert pow2_bucket(193, 10_000) == 256
+        assert pow2_bucket(257, 10_000) == 384
+        assert pow2_bucket(385, 10_000) == 512
+
+    def test_no_saving_when_bucket_reaches_alloc(self):
+        # bucket >= alloc_len -> None (read the whole allocation; a
+        # same-size slice variant would only fork an identical compile)
+        assert pow2_bucket(65, 96) is None     # bucket 96 == alloc 96
+        assert pow2_bucket(100, 128) is None   # bucket 128 == alloc 128
+        assert pow2_bucket(100, 129) == 128    # one below: still a save
+        assert pow2_bucket(1, 64) is None
+        assert pow2_bucket(1, 65) == 64
+
+
+class TestAttendBucket:
+    def _bc(self, depths, active):
+        bc = BatchConfig(len(depths), 1)
+        bc.first_token_depth[:] = depths
+        bc.request_available[:] = active
+        return bc
+
+    def test_bounds_by_max_active_depth_plus_span(self):
+        bc = self._bc([10, 100, 500, 0], [True, True, True, False])
+        # need = 500 + 12 = 512 -> bucket 512
+        assert attend_bucket(bc, 12, 10_000) == 512
+        # the inactive row's depth must not count
+        bc2 = self._bc([10, 100, 500, 9000], [True, True, True, False])
+        assert attend_bucket(bc2, 12, 10_000) == 512
+
+    def test_nothing_active_or_no_saving_is_none(self):
+        bc = self._bc([0, 0], [False, False])
+        assert attend_bucket(bc, 1, 10_000) is None
+        bc3 = self._bc([500, 0], [True, False])
+        assert attend_bucket(bc3, 12, 512) is None  # bucket == alloc
+
+
+class TestPickChunk:
+    def test_pow2_ceiling_with_floor_1(self):
+        assert pick_chunk(0, 256) == 1
+        assert pick_chunk(1, 256) == 1
+        assert pick_chunk(2, 256) == 2
+        assert pick_chunk(3, 256) == 4
+        assert pick_chunk(63, 256) == 64
+        assert pick_chunk(65, 256) == 128
+
+    def test_cap(self):
+        assert pick_chunk(300, 256) == 256
+        assert pick_chunk(1 << 20, 64) == 64

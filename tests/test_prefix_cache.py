@@ -1,0 +1,267 @@
+"""Prefix-KV-cache tests (serving/prefix_cache.py).
+
+The radix-tree pool turns retired requests' cache rows into reusable
+prompt prefixes: warm admissions must start past the matched span
+(first_token_depth > 0) while producing token-identical greedy output to
+a cold run, live-referenced entries must survive eviction pressure, and
+the bench's repeated-system-prompt workload must show warm TTFT below
+cold TTFT.
+"""
+
+import numpy as np
+import pytest
+
+from flexflow_tpu import FFConfig, Model
+from flexflow_tpu.fftype import InferenceMode
+from flexflow_tpu.models.llama import LLAMAConfig, create_llama_model
+from flexflow_tpu.serving import InferenceManager, RequestManager
+from flexflow_tpu.serving.prefix_cache import (PREFIX_ALIGN, PrefixCache,
+                                               align_down)
+
+
+# --------------------------------------------------------------- unit
+def _seq(rng, n):
+    return rng.integers(4, 120, n).tolist()
+
+
+class TestRadixTree:
+    def test_match_aligns_down_and_respects_min_match(self):
+        pc = PrefixCache(max_slots=4)
+        rng = np.random.default_rng(0)
+        toks = _seq(rng, 100)
+        assert pc.insert(toks, slot=0, rows={0: (0, 100)})
+        # 70 shared tokens align down to 64
+        q = toks[:70] + [121] * 30
+        e, d = pc.match(q)
+        assert e is not None and d == 64
+        # a full-prefix query caps at len(q) - 1 then aligns: 100-token
+        # query equal to the entry matches align_down(99) = 96
+        e, d = pc.match(toks)
+        assert e is not None and d == align_down(len(toks) - 1)
+        # below min_match: no usable match
+        e, d = pc.match(toks[:PREFIX_ALIGN - 1] + [121] * 40)
+        assert e is None and d == 0
+
+    def test_divergence_at_node_boundary_still_matches(self):
+        """Two donations sharing a system prefix split the tree at the
+        divergence point; a third query diverging exactly THERE (no
+        matching child) must still match the shared span — the bench's
+        whole repeated-system-prompt workload hits this shape."""
+        pc = PrefixCache(max_slots=4)
+        rng = np.random.default_rng(1)
+        sys_toks = _seq(rng, 64)
+        assert pc.insert(sys_toks + _seq(rng, 10), 0, {0: (0, 74)})
+        assert pc.insert(sys_toks + _seq(rng, 10), 1, {0: (1, 74)})
+        e, d = pc.match(sys_toks + _seq(rng, 10))
+        assert e is not None and d == 64
+
+    def test_redundant_and_superseded_donations(self):
+        pc = PrefixCache(max_slots=4)
+        rng = np.random.default_rng(2)
+        toks = _seq(rng, 96)
+        assert pc.insert(toks[:64], 0, {0: (0, 64)})
+        # an extension supersedes the shorter same-path entry
+        assert pc.insert(toks, 1, {0: (1, 96)})
+        assert sorted(pc.entries) == [1]
+        # a donation an existing entry already covers is rejected
+        assert not pc.insert(toks[:64], 2, {0: (2, 64)})
+        assert pc.stats.donations == 2 and pc.stats.donations_rejected == 1
+
+    def test_refcounted_entries_survive_eviction(self):
+        """Acceptance (b): live-referenced entries are never evicted."""
+        pc = PrefixCache(max_slots=2)
+        rng = np.random.default_rng(3)
+        seqs = [_seq(rng, 64) for _ in range(4)]
+        assert pc.insert(seqs[0], 0, {0: (0, 64)})
+        assert pc.insert(seqs[1], 1, {0: (1, 64)})
+        e0 = pc.entries[0]
+        pc.acquire(e0)
+        # pool full: the next insert must evict the UNREFERENCED entry
+        assert pc.insert(seqs[2], 2, {0: (2, 64)})
+        assert 0 in pc.entries and 1 not in pc.entries
+        # pin everything: a further donation has no victim and is refused
+        pc.acquire(pc.entries[2])
+        assert not pc.insert(seqs[3], 3, {0: (3, 64)})
+        assert pc.evict_one() is None
+        # released entries become evictable again
+        pc.release(e0)
+        freed = pc.evict_one()
+        assert freed is not None and freed[0] == 0
+
+    def test_usable_caps_at_per_model_kv_len(self):
+        pc = PrefixCache(max_slots=2)
+        rng = np.random.default_rng(4)
+        toks = _seq(rng, 128)
+        assert pc.insert(toks, 0, {0: (0, 128), 1: (0, 80)})
+        e, d = pc.match(toks + [121])
+        assert d == 128
+        assert pc.usable(e, 0, d, 129) == 128
+        assert pc.usable(e, 1, d, 129) == 80  # SSM watermark lags
+        assert pc.usable(e, 7, d, 129) == 0   # unknown model
+
+
+# -------------------------------------------------------- integration
+TINY = dict(vocab_size=128, hidden_size=64, intermediate_size=128,
+            num_hidden_layers=2, num_attention_heads=4,
+            num_key_value_heads=2, max_position_embeddings=512)
+
+
+def _build_llama(name="llama_pc", seed=0, mode=InferenceMode.INC_DECODING,
+                 max_requests=4, **over):
+    cfg = LLAMAConfig(**{**TINY, **over})
+    model = Model(FFConfig(seed=seed), name=name)
+    create_llama_model(model, cfg, mode=mode, max_requests=max_requests)
+    return model
+
+
+def _serve(im, mid, rm, prompts, n_new=4):
+    outs = []
+    for p in prompts:
+        req = rm.register_new_request(list(p), max_new_tokens=n_new)
+        rm.generate_incr_decoding(im, mid, [req])
+        outs.append(req)
+    return outs
+
+
+class TestWarmAdmission:
+    def test_warm_request_skips_prefix_and_matches_cold_run(self):
+        """Acceptance (a): a second request sharing a >=64-token prefix
+        with a retired one starts at first_token_depth > 0 and decodes
+        token-identically to a cold run."""
+        model = _build_llama()
+        im = InferenceManager(model.config)
+        mid = im.compile_model_and_allocate_buffer(
+            model, max_requests=4, max_seq_length=512, prefill_chunk=64,
+            cache_dtype=np.float32)
+        rng = np.random.default_rng(0)
+        system = rng.integers(4, 120, 96).tolist()
+        prompts = [system + rng.integers(4, 120, 8).tolist()
+                   for _ in range(3)]
+
+        rm = RequestManager(max_requests_per_batch=4,
+                            max_tokens_per_batch=64,
+                            max_sequence_length=512, prefix_cache=True)
+        r0 = _serve(im, mid, rm, prompts[:1])[0]
+        assert r0.profile.prefix_matched_tokens == 0  # pool was empty
+
+        # admit the second request by hand so the admission-time state is
+        # observable: cached_len seeds first_token_depth past the prefix
+        req1 = rm.register_new_request(prompts[1], max_new_tokens=4)
+        [(admitted, matched)] = rm.admit_pending(im=im, model_rows={mid: 1})
+        assert admitted is req1 and matched[mid] >= 64
+        assert req1.cached_len == matched[mid]
+        bc = rm.prepare_next_batch(None, None)
+        assert bc.first_token_depth[req1.row] == matched[mid] > 0
+        rm.generate_incr_decoding(im, mid, [req1])
+        req2 = _serve(im, mid, rm, prompts[2:])[0]
+        assert req2.profile.prefix_matched_tokens >= 64
+
+        # cold replay: same workload, pool off, token-identical output
+        rm_cold = RequestManager(max_requests_per_batch=4,
+                                 max_tokens_per_batch=64,
+                                 max_sequence_length=512)
+        cold = _serve(im, mid, rm_cold, prompts)
+        for warm_req, cold_req in zip((r0, req1, req2), cold):
+            assert warm_req.tokens == cold_req.tokens
+
+    def test_pool_slots_excluded_then_reclaimed(self):
+        """Pooled slots are invisible to admission until evicted, and the
+        pool never starves admission (cap = max_requests - 1)."""
+        model = _build_llama(name="llama_pc2", seed=1)
+        im = InferenceManager(model.config)
+        mid = im.compile_model_and_allocate_buffer(
+            model, max_requests=2, max_seq_length=512, prefill_chunk=64,
+            cache_dtype=np.float32)
+        rng = np.random.default_rng(1)
+        prompts = [rng.integers(4, 120, 64).tolist() for _ in range(3)]
+        rm = RequestManager(max_requests_per_batch=2,
+                            max_tokens_per_batch=64,
+                            max_sequence_length=512, prefix_cache=True)
+        reqs = _serve(im, mid, rm, prompts)
+        assert all(r.status == r.COMPLETED for r in reqs)
+        # cap is 1 (= max_requests - 1): later donations recycled the slot
+        assert len(rm.prefix_cache.entries) == 1
+        assert rm.prefix_cache.stats.evictions >= 1
+
+
+@pytest.mark.slow
+class TestSpecPrefix:
+    def test_spec_paths_match_cold_run_with_prefix_cache(self):
+        """Spec serving (host AND device loops) with the pool on: warm
+        requests reuse both the LLM row and the SSM's beam-row 0 and
+        commit exactly the tokens a cold run commits."""
+        from flexflow_tpu.serving.spec_infer import generate_spec_infer
+
+        def run(prefix_cache, device, monkey_env):
+            monkey_env.setenv("FF_SPEC_DEVICE", "1" if device else "0")
+            llm = _build_llama(name="pc_llm", seed=0,
+                               mode=InferenceMode.TREE_VERIFY)
+            ssm = _build_llama(name="pc_ssm", seed=1,
+                               mode=InferenceMode.BEAM_SEARCH,
+                               num_hidden_layers=1)
+            im = InferenceManager(llm.config)
+            llm_id = im.compile_model_and_allocate_buffer(
+                llm, mode=InferenceMode.TREE_VERIFY, max_requests=4,
+                max_seq_length=400, cache_dtype=np.float32)
+            rm = RequestManager(max_requests_per_batch=4,
+                                max_tokens_per_batch=64,
+                                max_sequence_length=400,
+                                max_spec_tree_token_num=24,
+                                prefix_cache=prefix_cache)
+            ssm_id = im.compile_model_and_allocate_buffer(
+                ssm, mode=InferenceMode.BEAM_SEARCH, max_requests=4,
+                max_seq_length=400, beam_width=2, cache_dtype=np.float32)
+            rm.register_ssm_model(ssm_id)
+            rng = np.random.default_rng(0)
+            system = rng.integers(4, 90, 96).tolist()
+            outs, matched = [], []
+            for _ in range(3):
+                tail = rng.integers(4, 90, 6).tolist()
+                req = rm.register_new_request(system + tail,
+                                              max_new_tokens=6)
+                generate_spec_infer(rm, im, llm_id, [req], beam_width=2,
+                                    beam_depth=4)
+                outs.append(list(req.tokens))
+                matched.append(req.profile.prefix_matched_tokens)
+            return outs, matched
+
+        monkey = pytest.MonkeyPatch()
+        try:
+            for device in (False, True):
+                warm, m = run(True, device, monkey)
+                cold, _ = run(False, device, monkey)
+                assert warm == cold, f"device={device}"
+                assert m[0] == 0 and all(x >= 64 for x in m[1:]), m
+        finally:
+            monkey.undo()
+
+
+@pytest.mark.slow
+def test_bench_prefix_warm_ttft_beats_cold():
+    """Acceptance (c): bench.py's prefix mode reports warm-prefix TTFT
+    below cold TTFT on the repeated-system-prompt workload (tiny model
+    so the A/B runs on CPU; prefill dominates TTFT at system 448 vs
+    tail 8, so the ratio is far from noise)."""
+    import os
+    import sys
+
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+    import bench
+
+    def tiny_builder():
+        cfg = LLAMAConfig(vocab_size=128, hidden_size=128,
+                          intermediate_size=256, num_hidden_layers=4,
+                          num_attention_heads=4, num_key_value_heads=2,
+                          max_position_embeddings=640)
+        model = Model(FFConfig(), name="llama_prefix_bench_tiny")
+        create_llama_model(model, cfg, max_requests=4)
+        return model, cfg.vocab_size, np.float32
+
+    head, *_ = bench.bench_prefix(
+        model_builder=tiny_builder, system_len=448, tail_len=8,
+        n_requests=5, new_tokens=2, max_seq_length=640,
+        max_tokens_per_batch=64, decode_block=1)
+    assert head["hit_rate"] >= 0.75
+    assert head["tokens_saved_frac"] > 0.5
+    assert head["warm_ttft_s"] < head["cold_ttft_s"], head
+    assert head["value"] > 1.0
