@@ -17,7 +17,13 @@ matmul keeps its true cost; this upper-bounds the mechanism the way real
 distilled SSM weights would approach).
 
 Modes: `python bench.py [all|llama|llama7b|spec|spec7b|mnist|kernels|opt|
-resnet|longctx|quality|distill|crossover|prefix]` (default all).
+resnet|longctx|quality|distill|crossover|prefix|kvdtype]` (default all).
+`kvdtype` A/Bs the int8 KV cache against bf16 on one decode workload
+(tokens/s, cache HBM, greedy parity); `--kv-dtype {bf16,int8}` instead
+forces the cache dtype on the standard serving decode modes.  Every
+record carries `kv_cache_dtype`, `cache_hbm_bytes` and `host_syncs`
+(per-section detail under "kv_cache") so trajectories can attribute
+wins to cache dtype and sync count.
 `--budget SECONDS` caps each mode's wall clock (SIGALRM): a mode that
 blows it is recorded as timed out and, under `all`, the remaining modes
 are skipped so the one-line JSON record still lands (the BENCH_r05
@@ -41,6 +47,29 @@ import time
 import numpy as np
 
 REPO = os.path.dirname(os.path.abspath(__file__))
+
+# --kv-dtype override ("bf16" | "int8" | None) applied to the serving
+# decode benches' cache allocations, so BENCH trajectories can A/B the
+# int8 KV cache on the standard workloads; the dedicated `kvdtype` mode
+# runs both dtypes in one invocation regardless of this flag.
+_KV_DTYPE = None
+
+# per-section KV-cache/bandwidth notes (label -> fields), stamped into
+# every emitted JSON record by persist_record so trajectories can
+# attribute wins to the cache dtype (not just the prefix mode).
+_KV_NOTES = {}
+
+
+def _note_kv(im, mid, label):
+    """Record a serving section's cache dtype, resident cache HBM and
+    host-sync count (call AFTER the section's workload ran so host_syncs
+    reflects it).  Returns the fields for direct inclusion in a head."""
+    s = im.kv_cache_stats(mid)
+    _KV_NOTES[label] = {"kv_cache_dtype": s.kv_cache_dtype,
+                        "cache_hbm_bytes": s.bytes_resident,
+                        "cache_bytes_per_token": s.bytes_per_token,
+                        "host_syncs": im.host_syncs}
+    return _KV_NOTES[label]
 
 
 def _device_ms_per_step(im, mid, model, max_requests, prompt_len):
@@ -105,7 +134,7 @@ def bench_llama_decode():
     im = InferenceManager(ff)
     mid = im.compile_model_and_allocate_buffer(
         model, max_requests=max_requests, max_seq_length=256,
-        prefill_chunk=64)
+        prefill_chunk=64, kv_cache_dtype=_KV_DTYPE)
 
     rng = np.random.default_rng(0)
 
@@ -136,6 +165,7 @@ def bench_llama_decode():
     ms_step, w_bytes = _device_ms_per_step(im, mid, model, max_requests,
                                            prompt_len)
     roofline_ms = w_bytes / 819e9 * 1e3
+    _note_kv(im, mid, "llama")
     return {
         "metric": "llama1p4b_decode_throughput_1chip",
         "value": round(best, 1),
@@ -202,7 +232,7 @@ def bench_llama7b_decode():
     im = InferenceManager(ff)
     mid = im.compile_model_and_allocate_buffer(
         model, max_requests=max_requests, max_seq_length=256,
-        prefill_chunk=64)
+        prefill_chunk=64, kv_cache_dtype=_KV_DTYPE)
 
     rng = np.random.default_rng(0)
     prompts = [rng.integers(4, 31000, prompt_len).tolist()
@@ -244,7 +274,7 @@ def bench_llama7b_decode():
     im2 = InferenceManager(model.config)
     mid2 = im2.compile_model_and_allocate_buffer(
         model, max_requests=max_requests, max_seq_length=256,
-        prefill_chunk=64)
+        prefill_chunk=64, kv_cache_dtype=_KV_DTYPE)
 
     def run_native():
         rm = RequestManager(max_requests_per_batch=max_requests,
@@ -265,6 +295,7 @@ def bench_llama7b_decode():
                                      prompt_len)
     from flexflow_tpu.search.scaling import llama_decode_scaling
 
+    _note_kv(im2, mid2, "llama7b")
     return [
         {"metric": "llama7b_int8_decode_throughput_1chip",
          "value": round(best, 1), "unit": "tokens/s",
@@ -491,6 +522,7 @@ def bench_spec_infer():
     w2_point = spec_point(ssm_w2, 2, 4)
     w2_point["nominal_p"] = 0.1
 
+    _note_kv(im, llm_id, "spec_llm")
     return [
         {"metric": "llama1p4b_spec_infer_throughput_1chip",
          "value": round(best_spec, 1), "unit": "tokens/s",
@@ -707,6 +739,7 @@ def bench_spec7b():
                 for lp in llm.params.values() for v in lp.values())
     ssm_w = sum(int(np.prod(v.shape)) * v.dtype.itemsize
                 for lp in ssm.params.values() for v in lp.values())
+    _note_kv(im, llm_id, "spec7b_llm")
     return [
         {"metric": "llama7b_int8_spec_infer_throughput_1chip",
          "value": round(best_spec, 1), "unit": "tokens/s",
@@ -852,6 +885,7 @@ def bench_distill_spec():
             "speedup_vs_incr": round(best / best_inc, 3),
             "token_match": ([r.tokens for r in best_reqs]
                             == [r.tokens for r in inc_reqs])})
+    _note_kv(im, lid, "distill_llm")
     im.free_model(lid)
     im.free_model(inc_id)
     gc.collect()
@@ -1159,7 +1193,8 @@ def bench_longctx():
     model.params = model.init_params(jax.random.PRNGKey(0))
     im = InferenceManager(ff)
     mid = im.compile_model_and_allocate_buffer(
-        model, max_requests=1, max_seq_length=S + 64, prefill_chunk=512)
+        model, max_requests=1, max_seq_length=S + 64, prefill_chunk=512,
+        kv_cache_dtype=_KV_DTYPE)
     rng = np.random.default_rng(0)
     prompt = rng.integers(4, 31000, S).tolist()
 
@@ -1323,6 +1358,7 @@ def bench_longctx():
     total_kv = R32 * S32 * kv_heads * d * 2 * 2 * layers
     per_shard = total_kv // sp
     weights = 2.8e9
+    _note_kv(im, mid, "longctx")
     return [
         {"metric": "llama1p4b_8k_prompt_ttft_1chip",
          "value": round(ttft * 1e3, 1), "unit": "ms",
@@ -1421,7 +1457,8 @@ def bench_prefix(model_builder=None, max_requests=4, system_len=512,
     im = InferenceManager(model.config)
     mid = im.compile_model_and_allocate_buffer(
         model, max_requests=max_requests, max_seq_length=max_seq_length,
-        prefill_chunk=max_tokens_per_batch, cache_dtype=cache_dtype)
+        prefill_chunk=max_tokens_per_batch, cache_dtype=cache_dtype,
+        kv_cache_dtype=_KV_DTYPE)
 
     rng = np.random.default_rng(0)
     system = rng.integers(4, vocab - 1, system_len).tolist()
@@ -1447,6 +1484,7 @@ def bench_prefix(model_builder=None, max_requests=4, system_len=512,
     run(True)    # warmup: compiles cold-prefill, copy_prefix + tail buckets
     cold_reqs, _ = run(False)
     warm_reqs, rm_on = run(True)
+    _note_kv(im, mid, "prefix")
 
     cold = ttft_percentiles(cold_reqs)["p50"]
     # request 0 is the pool's cold donor; warm numbers start at request 1
@@ -1482,6 +1520,123 @@ def bench_prefix(model_builder=None, max_requests=4, system_len=512,
          "value": round(warm_prefill_tps, 1), "unit": "tokens/s",
          "cold_tokens_per_s": round(cold_prefill_tps, 1),
          "vs_baseline": 0},
+    ]
+    return (head, *extras)
+
+
+def bench_kv_dtype(model_builder=None, max_requests=8, prompt_len=32,
+                   new_tokens=96, max_seq_length=512,
+                   max_tokens_per_batch=64, decode_block=32):
+    """int8-KV-cache A/B (`--kv-dtype` mode): the same greedy decode
+    workload served twice — ``kv_cache_dtype="bf16"`` (= the computation
+    dtype, the pre-existing cache) vs ``"int8"`` (int8 K/V + f32
+    per-row-per-position-per-head scales) — reporting decode tokens/s
+    for both, cache HBM from KVCacheStats (resident bytes and the
+    bytes-per-attended-token stream cost, whose ratio at equal
+    (rows, alloc_len) is the acceptance gate's <= 0.55x), and
+    greedy-token parity (match fraction + first divergence step).
+
+    ``model_builder``: optional ``() -> (model, vocab_size)`` override
+    so the CPU test suite can run the same A/B on a tiny model
+    (default: the 1.4B bench LLaMA in bf16)."""
+    from flexflow_tpu import FFConfig, Model
+    from flexflow_tpu.models.llama import LLAMAConfig, create_llama_model
+    from flexflow_tpu.serving import InferenceManager, RequestManager
+
+    if model_builder is None:
+        def model_builder():
+            from flexflow_tpu.fftype import DataType
+
+            cfg = LLAMAConfig(
+                vocab_size=32000, hidden_size=2048, intermediate_size=5504,
+                num_hidden_layers=24, num_attention_heads=16,
+                num_key_value_heads=4,
+                max_position_embeddings=max_seq_length)
+            model = Model(FFConfig(computation_dtype="bfloat16"),
+                          name="llama_kv_bench")
+            create_llama_model(model, cfg, max_requests=max_requests,
+                               dtype=DataType.HALF)
+            return model, cfg.vocab_size
+
+    rng = np.random.default_rng(0)
+    prompts = None
+
+    def run(kv_dtype):
+        nonlocal prompts
+        model, vocab = model_builder()
+        if prompts is None:
+            prompts = [rng.integers(4, vocab - 1, prompt_len).tolist()
+                       for _ in range(max_requests)]
+        im = InferenceManager(model.config)
+        mid = im.compile_model_and_allocate_buffer(
+            model, max_requests=max_requests,
+            max_seq_length=max_seq_length,
+            prefill_chunk=max_tokens_per_batch, kv_cache_dtype=kv_dtype)
+
+        def serve():
+            rm = RequestManager(max_requests_per_batch=max_requests,
+                                max_tokens_per_batch=max_tokens_per_batch,
+                                max_sequence_length=max_seq_length,
+                                decode_block=decode_block)
+            reqs = [rm.register_new_request(list(p),
+                                            max_new_tokens=new_tokens)
+                    for p in prompts]
+            rm.generate_incr_decoding(im, mid, reqs)
+            return reqs
+
+        serve()                      # warmup: compile the shape buckets
+        best_tps, reqs = 0.0, None
+        for _ in range(3):
+            t0 = time.time()
+            reqs = serve()
+            dt = time.time() - t0
+            tot = sum(len(r.tokens) - r.prompt_len for r in reqs)
+            best_tps = max(best_tps, tot / dt)
+        stats = im.kv_cache_stats(mid)
+        _note_kv(im, mid, f"kvdtype_{kv_dtype}")
+        return best_tps, stats, [list(r.tokens) for r in reqs]
+
+    tps_bf, s_bf, toks_bf = run("bf16")
+    tps_q, s_q, toks_q = run("int8")
+
+    # parity over the GENERATED tokens (prompts echo by construction)
+    gen_bf = [t for p, ts in zip(prompts, toks_bf) for t in ts[len(p):]]
+    gen_q = [t for p, ts in zip(prompts, toks_q) for t in ts[len(p):]]
+    match = (sum(a == b for a, b in zip(gen_bf, gen_q))
+             / max(1, len(gen_bf)))
+    div = None
+    for ts_b, ts_s, p in zip(toks_bf, toks_q, prompts):
+        for i, (a, b) in enumerate(zip(ts_b[len(p):], ts_s[len(p):])):
+            if a != b:
+                div = i if div is None else min(div, i)
+                break
+    # equal (rows, alloc_len) comparison: bytes_resident = rows *
+    # alloc_len * bytes_per_token, so the per-token ratio IS the
+    # resident ratio with the alloc-rounding difference (16- vs
+    # 32-aligned) normalized out
+    hbm_ratio = s_q.bytes_per_token / max(1, s_bf.bytes_per_token)
+    head = {
+        "metric": "kv_cache_int8_decode_speedup",
+        "value": round(tps_q / max(1e-9, tps_bf), 3),
+        "unit": "x (int8-KV decode tokens/s / bf16-KV, same workload)",
+        "methodology": (f"greedy,batch{max_requests},"
+                        f"prompt{prompt_len},new{new_tokens},best-of-3"),
+        "vs_baseline": 0,
+        "bf16_tokens_per_s": round(tps_bf, 1),
+        "int8_tokens_per_s": round(tps_q, 1),
+        "cache_hbm_ratio": round(hbm_ratio, 4),
+        "greedy_match_frac": round(match, 4),
+        "greedy_divergence_step": div,
+    }
+    extras = [
+        {"metric": "kv_cache_bf16_hbm_bytes",
+         "value": s_bf.bytes_resident, "unit": "bytes",
+         "bytes_per_token": s_bf.bytes_per_token,
+         "alloc_len": s_bf.alloc_len, "vs_baseline": 0},
+        {"metric": "kv_cache_int8_hbm_bytes",
+         "value": s_q.bytes_resident, "unit": "bytes",
+         "bytes_per_token": s_q.bytes_per_token,
+         "alloc_len": s_q.alloc_len, "vs_baseline": 0},
     ]
     return (head, *extras)
 
@@ -1713,11 +1868,15 @@ def main(which: str, budget=None):
         head, *extras = bench_prefix()
         head["extras"] = extras
         return head
+    if which == "kvdtype":
+        head, *extras = bench_kv_dtype()
+        head["extras"] = extras
+        return head
     if which != "all":
         raise SystemExit(
             f"unknown bench mode {which!r} (expected all|llama|llama7b|"
             f"spec|spec7b|mnist|kernels|opt|resnet|longctx|quality|"
-            f"distill|crossover|prefix)")
+            f"distill|crossover|prefix|kvdtype)")
 
     # all: headline decode metric + everything else under extras.  Each
     # section runs in its own process lifetime-wise (HBM frees between
@@ -1785,6 +1944,7 @@ def main(which: str, budget=None):
                       + _section(bench_opt125m, "opt")
                       + _section(bench_resnet50_dp, "resnet")
                       + _section(bench_prefix, "prefix")
+                      + _section(bench_kv_dtype, "kvdtype")
                       + _section(bench_kernels, "kernels"))
     if timed_out or skipped:
         head["timed_out"] = {"budget_s": budget, "sections": timed_out,
@@ -1796,6 +1956,23 @@ def main(which: str, budget=None):
 # Which direction is better, by unit (for the regression gate).
 _HIGHER_BETTER = {"tokens/s", "samples/s", "x", "GB/s", "TF/s"}
 _LOWER_BETTER = {"us", "ms", "s", "us/call", "ms/step", "ms/token"}
+
+
+def _kv_summary():
+    """Record-level KV-cache attribution fields, aggregated from the
+    per-section _note_kv calls: the dtype(s) served, the largest
+    resident cache, and the total host-sync count — present in EVERY
+    emitted record (empty-but-present for modes with no serving run) so
+    BENCH_* trajectories can attribute wins without digging."""
+    dtypes = sorted({n["kv_cache_dtype"] for n in _KV_NOTES.values()})
+    return {
+        "kv_cache_dtype": (dtypes[0] if len(dtypes) == 1
+                           else ",".join(dtypes) or "none"),
+        "cache_hbm_bytes": max(
+            (n["cache_hbm_bytes"] for n in _KV_NOTES.values()), default=0),
+        "host_syncs": sum(n["host_syncs"] for n in _KV_NOTES.values()),
+        "kv_cache": dict(_KV_NOTES),
+    }
 
 
 def _flatten_metrics(result):
@@ -1849,6 +2026,7 @@ def persist_record(result, mode: str):
     record = {"round": rnd, "mode": mode,
               "time_unix": round(time.time(), 1),
               "platform": _platform_str(),
+              **_kv_summary(),
               "metrics": metrics}
     prev_rounds = sorted(f for f in os.listdir(outdir)
                          if f.startswith("r") and f.endswith(".json")
@@ -1893,6 +2071,11 @@ def _slim(result):
     slim = {k: v for k, v in result.items() if k != "extras"}
     slim.pop("scaling_model", None)
     slim["record"] = "bench_results/ (full metrics, committed)"
+    # KV-cache attribution rides every stdout record too (per-section
+    # detail stays in the committed bench_results file)
+    kv = _kv_summary()
+    kv.pop("kv_cache", None)
+    slim.update(kv)
     slim["extras"] = [{k: m[k] for k in keep if k in m}
                       for m in result.get("extras", [])]
     return slim
@@ -1910,7 +2093,14 @@ if __name__ == "__main__":
              "skipped — the one-line JSON record still lands, with a "
              "timed_out field, instead of dying rc=124 under an external "
              "timeout with no output")
+    _ap.add_argument(
+        "--kv-dtype", choices=("bf16", "int8"), default=None,
+        help="force the serving decode modes' KV-cache storage dtype "
+             "(int8 = quantized cache + f32 per-head scales; halves "
+             "decode cache HBM reads).  The `kvdtype` mode A/Bs both "
+             "dtypes in one run regardless of this flag.")
     _args = _ap.parse_args()
+    _KV_DTYPE = _args.kv_dtype
     try:
         if _args.mode == "all":
             _result = main(_args.mode, budget=_args.budget)

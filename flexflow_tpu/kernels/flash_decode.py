@@ -62,17 +62,31 @@ def _init_scratch(m_sc, l_sc, acc_sc):
 
 def _online_softmax_step(r, t, depth_ref, act_ref, q_ref, k_ref, v_ref,
                          slopes_ref, m_sc, l_sc, acc_sc,
-                         *, ts, kv, g, d, s_total, scale):
+                         *, ts, kv, g, d, s_total, scale,
+                         ks_ref=None, vs_ref=None):
     """One S-tile of the running softmax (shared by the full and partial
-    kernels)."""
+    kernels).
+
+    ``ks_ref``/``vs_ref``: f32 per-position-per-head scale tiles
+    ``[1, KV, TS]`` for int8 caches.  The HBM->VMEM K/V stream stays
+    int8 (half the bf16 bytes); dequantization happens in-register —
+    K's scale folds into the logits AFTER the dot (exact: the scale is
+    constant along the contracted head_dim), V's scale folds into the
+    probabilities before the PV dot."""
     kvg = kv * g
     qv = q_ref[:].reshape(kv, g, d)
     kt = k_ref[:].reshape(kv, ts, d)           # native layout: no swap
     vt = v_ref[:].reshape(kv, ts, d)
+    if ks_ref is not None:
+        # int8 values are exact in bf16/f32; the dot runs on the raw
+        # codes and the per-position scale multiplies the logits tile
+        kt = kt.astype(qv.dtype)
     # logits[kv, g, ts] = qv . kt (batch kv; contract d)
     logits = jax.lax.dot_general(
         qv, kt, (((2,), (2,)), ((0,), (0,))),
         preferred_element_type=jnp.float32) * scale
+    if ks_ref is not None:
+        logits = logits * ks_ref[:].reshape(kv, 1, ts)
     span = (t * ts
             + jax.lax.broadcasted_iota(jnp.int32, (1, ts), 1))
     if slopes_ref is not None:
@@ -103,9 +117,20 @@ def _online_softmax_step(r, t, depth_ref, act_ref, q_ref, k_ref, v_ref,
     # p is 0 there but 0*NaN = NaN, so zero them explicitly
     col_ok = (t * ts + jax.lax.broadcasted_iota(
         jnp.int32, (1, ts, 1), 1)) < s_total
+    p_kv = p.reshape(kv, g, ts)
+    if vs_ref is not None:
+        # V dequant: fold the per-position scale into p (f32) so the
+        # int8 codes go to the dot after one cast.  The scale tile's
+        # out-of-range pad columns (partial final S tile) may hold NaN
+        # like vt's — p is 0 there but 0*NaN = NaN, so zero the scales
+        # on the same col_ok guard vt gets below
+        vst = jnp.where(col_ok.reshape(1, 1, ts),
+                        vs_ref[:].reshape(kv, 1, ts), 0.0)
+        p_kv = p_kv * vst
+        vt = vt.astype(qv.dtype)
     vt = jnp.where(col_ok, vt, 0)
     pv = jax.lax.dot_general(
-        p.reshape(kv, g, ts).astype(vt.dtype), vt,
+        p_kv.astype(vt.dtype), vt,
         (((2,), (1,)), ((0,), (0,))),
         preferred_element_type=jnp.float32)
     acc_sc[:] = acc_sc[:] * alpha + pv.reshape(kvg, d)
@@ -113,12 +138,15 @@ def _online_softmax_step(r, t, depth_ref, act_ref, q_ref, k_ref, v_ref,
 
 def _kernel(last_ref, depth_ref, act_ref,      # scalar prefetch
             q_ref, k_ref, v_ref,               # blocks ([1,KV,TS,D])
-            *rest,                             # [slopes], outs, scratch
-            ts: int, kv: int, g: int, d: int,
+            *rest,                             # [ks, vs], [slopes], outs,
+            ts: int, kv: int, g: int, d: int,  # scratch
             s_total: int, scale: float,
-            alibi: bool, partial: bool):
+            alibi: bool, partial: bool, quant: bool = False):
     from jax.experimental import pallas as pl
 
+    ks_ref = vs_ref = None
+    if quant:
+        ks_ref, vs_ref, *rest = rest
     slopes_ref = None
     if alibi:
         slopes_ref, *rest = rest
@@ -140,7 +168,7 @@ def _kernel(last_ref, depth_ref, act_ref,      # scalar prefetch
         _online_softmax_step(r, t, depth_ref, act_ref, q_ref, k_ref,
                              v_ref, slopes_ref, m_sc, l_sc, acc_sc,
                              ts=ts, kv=kv, g=g, d=d, s_total=s_total,
-                             scale=scale)
+                             scale=scale, ks_ref=ks_ref, vs_ref=vs_ref)
 
     @pl.when(t == nt - 1)
     def _finish():
@@ -158,13 +186,16 @@ def _kernel(last_ref, depth_ref, act_ref,      # scalar prefetch
 
 
 def _pick_ts(S: int, KV: int, D: int,
-             budget_bytes: int = 5 * 1024 * 1024):
+             budget_bytes: int = 5 * 1024 * 1024, itemsize: int = 2):
     """One row per program (finest pruning granularity — measured best
     on chip) with the largest S tile the VMEM budget allows.  The budget
-    covers the double-buffered K+V tiles; f32 logits temps take roughly
-    another budget's worth, which together must stay under the ~16 MB
-    scoped-VMEM limit."""
-    per_pos = KV * D * 2 * 2 * 2       # k+v, bf16, double buffer
+    covers the double-buffered K+V tiles (``itemsize`` bytes each — 1
+    for int8 caches, whose f32 scale tiles add 8 more bytes/position);
+    f32 logits temps take roughly another budget's worth, which together
+    must stay under the ~16 MB scoped-VMEM limit."""
+    per_pos = KV * D * 2 * itemsize * 2    # k+v, cache dtype, dbl buffer
+    if itemsize == 1:
+        per_pos += KV * 4 * 2 * 2          # k+v f32 scale tiles
     for ts in (1024, 512, 256, 128):
         if ts * per_pos <= budget_bytes and ts <= max(S, 128):
             return ts
@@ -172,7 +203,7 @@ def _pick_ts(S: int, KV: int, D: int,
 
 
 def _attend_call(q, ck, cv, depth, active, scale, interpret, ts,
-                 slopes, partial: bool):
+                 slopes, partial: bool, k_scale=None, v_scale=None):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -180,8 +211,13 @@ def _attend_call(q, ck, cv, depth, active, scale, interpret, ts,
     KV, S = ck.shape[1], ck.shape[2]
     G = H // KV
     assert H == KV * G and ck.shape == cv.shape == (R, KV, S, D)
+    quant = k_scale is not None
+    assert quant == (v_scale is not None)
+    if quant:
+        assert k_scale.shape == v_scale.shape == (R, KV, S), (
+            k_scale.shape, (R, KV, S))
     if ts is None:
-        ts = _pick_ts(S, KV, D)
+        ts = _pick_ts(S, KV, D, itemsize=ck.dtype.itemsize)
     nt = pl.cdiv(S, ts)
     depth = depth.astype(jnp.int32)
     active = active.astype(jnp.int32)
@@ -195,7 +231,7 @@ def _attend_call(q, ck, cv, depth, active, scale, interpret, ts,
     alibi = slopes is not None
     kernel = functools.partial(_kernel, ts=ts, kv=KV, g=G, d=D,
                                s_total=S, scale=float(scale),
-                               alibi=alibi, partial=partial)
+                               alibi=alibi, partial=partial, quant=quant)
     in_specs = [
         pl.BlockSpec((1, H, D), lambda r, t, *_: (r, 0, 0)),
         pl.BlockSpec((1, KV, ts, D),
@@ -208,6 +244,14 @@ def _attend_call(q, ck, cv, depth, active, scale, interpret, ts,
                                              0)),
     ]
     inputs = [q, ck, cv]
+    if quant:
+        # f32 scale tiles ride the same clamped index map as their K/V
+        # tiles, so pruned tiles skip their DMAs too
+        for sc in (k_scale, v_scale):
+            in_specs.append(pl.BlockSpec(
+                (1, KV, ts),
+                lambda r, t, last, *_: (r, 0, jnp.minimum(t, last[r]))))
+            inputs.append(sc)
     if alibi:
         in_specs.append(pl.BlockSpec((H, 1), lambda r, t, *_: (0, 0)))
         inputs.append(jnp.asarray(slopes, jnp.float32).reshape(H, 1))
@@ -242,39 +286,46 @@ def _attend_call(q, ck, cv, depth, active, scale, interpret, ts,
 @functools.partial(jax.jit,
                    static_argnames=("scale", "interpret", "ts"))
 def flash_decode_attend(q, ck, cv, depth, active, scale: float,
-                        interpret: bool = False, ts=None, slopes=None):
+                        interpret: bool = False, ts=None, slopes=None,
+                        k_scale=None, v_scale=None):
     """q [R,H,D] against cache [R,KV,S,D] masked to span<=depth[r]
     -> [R,H,D].  VMEM = O(TS*KV*D), any S.  Inactive rows -> zeros.
     ``slopes``: optional [H] ALiBi per-head slopes (adds
     slope_h * (k_pos - depth_r) to the logits).
+    ``k_scale``/``v_scale``: f32 [R, KV, S] per-position scales for an
+    int8 cache — the HBM stream stays int8, dequant happens in-register.
 
     The caller scatters the current token's K/V into the cache FIRST
     (position depth[r]) — mirroring the production jnp path
     (ops/serving_attention.py _scatter_chunk then _attend).
     """
     return _attend_call(q, ck, cv, depth, active, scale, interpret, ts,
-                        slopes, partial=False)
+                        slopes, partial=False, k_scale=k_scale,
+                        v_scale=v_scale)
 
 
 @functools.partial(jax.jit,
                    static_argnames=("scale", "interpret", "ts"))
 def flash_decode_attend_partial(q, ck, cv, depth, active, scale: float,
                                 interpret: bool = False, ts=None,
-                                slopes=None):
+                                slopes=None, k_scale=None, v_scale=None):
     """Partial (unnormalized) flash attend for cross-shard combines:
     returns (acc [R,H,D] f32, m [R,H] f32, l [R,H] f32) where
     out = acc / l after the standard flash merge across shards.  Rows or
     shards with no valid position report m=-1e30, l=0, acc=0."""
     return _attend_call(q, ck, cv, depth, active, scale, interpret, ts,
-                        slopes, partial=True)
+                        slopes, partial=True, k_scale=k_scale,
+                        v_scale=v_scale)
 
 
 def _append_kernel(depth_ref, act_ref,           # scalar prefetch
-                   knew_ref, vnew_ref,           # VMEM [R, KV, 1, D]
-                   ck_hbm, cv_hbm,               # ANY (aliased inputs)
-                   ck_out, cv_out,               # aliased with the above
-                   win_k, win_v, sem_k, sem_v):
+                   *refs,                        # see below
+                   w: int, quant: bool):
     """Per-row in-place cache append: ck[r, :, depth[r], :] = k_new[r].
+
+    ``refs``: knew, vnew (VMEM [R, KV, 1, D] float), then for quantized
+    caches ksc, vsc (VMEM [R, KV, 1, 1] f32 per-head scales), then the
+    aliased ck/cv in/out pairs and the window/semaphore scratch.
 
     Exists so a flash-dispatched decode step contains NO XLA cache op:
     XLA's layout assignment physically prefers S-major ({3,1,2,0}) for
@@ -285,34 +336,52 @@ def _append_kernel(depth_ref, act_ref,           # scalar prefetch
     calls the cache stays in the default layout end to end.
 
     Mosaic requires S-slices aligned to the sublane tiling, so the
-    write is a read-modify-write of the 16-aligned window around depth
-    (one extra 16-position read per row — bytes are negligible vs the
-    attend; cache allocations are 16-aligned by the
-    InferenceManager)."""
+    write is a read-modify-write of the ``w``-aligned window around
+    depth (w = 16 for bf16/f32 caches, 32 for int8 — the int8 sublane
+    tiling is (32, 128); one extra window read per row — bytes are
+    negligible vs the attend; cache allocations are w-aligned by the
+    InferenceManager).  For quantized caches the NEW TOKEN IS QUANTIZED
+    IN-KERNEL inside the window overlay (rint(x / scale) on the float
+    payload; the scale itself is a tiny XLA-side reduction scattered
+    into the [R, KV, S] scale tensor by the wrapper)."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
+
+    if quant:
+        (knew_ref, vnew_ref, ksc_ref, vsc_ref, ck_hbm, cv_hbm,
+         ck_out, cv_out, win_k, win_v, sem_k, sem_v) = refs
+    else:
+        (knew_ref, vnew_ref, ck_hbm, cv_hbm,
+         ck_out, cv_out, win_k, win_v, sem_k, sem_v) = refs
+        ksc_ref = vsc_ref = None
 
     r = pl.program_id(0)
 
     @pl.when(act_ref[r] > 0)
     def _():
         d = depth_ref[r]
-        base = (d // 16) * 16
+        base = (d // w) * w
         ink = pltpu.make_async_copy(
-            ck_out.at[r, :, pl.ds(base, 16), :], win_k, sem_k)
+            ck_out.at[r, :, pl.ds(base, w), :], win_k, sem_k)
         inv = pltpu.make_async_copy(
-            cv_out.at[r, :, pl.ds(base, 16), :], win_v, sem_v)
+            cv_out.at[r, :, pl.ds(base, w), :], win_v, sem_v)
         ink.start()
         inv.start()
         ink.wait()
         inv.wait()
-        sel = jax.lax.broadcasted_iota(jnp.int32, (1, 16, 1), 1) == (d - base)
-        win_k[:] = jnp.where(sel, knew_ref[r], win_k[:])
-        win_v[:] = jnp.where(sel, vnew_ref[r], win_v[:])
+        sel = jax.lax.broadcasted_iota(jnp.int32, (1, w, 1), 1) == (d - base)
+        kn, vn = knew_ref[r], vnew_ref[r]
+        if quant:
+            kn = jnp.clip(jnp.rint(kn.astype(jnp.float32) / ksc_ref[r]),
+                          -127, 127)
+            vn = jnp.clip(jnp.rint(vn.astype(jnp.float32) / vsc_ref[r]),
+                          -127, 127)
+        win_k[:] = jnp.where(sel, kn.astype(win_k.dtype), win_k[:])
+        win_v[:] = jnp.where(sel, vn.astype(win_v.dtype), win_v[:])
         outk = pltpu.make_async_copy(
-            win_k, ck_out.at[r, :, pl.ds(base, 16), :], sem_k)
+            win_k, ck_out.at[r, :, pl.ds(base, w), :], sem_k)
         outv = pltpu.make_async_copy(
-            win_v, cv_out.at[r, :, pl.ds(base, 16), :], sem_v)
+            win_v, cv_out.at[r, :, pl.ds(base, w), :], sem_v)
         outk.start()
         outv.start()
         outk.wait()
@@ -320,52 +389,96 @@ def _append_kernel(depth_ref, act_ref,           # scalar prefetch
 
 
 def cache_append(ck, cv, k_new, v_new, depth, active,
-                 interpret: bool = False):
+                 interpret: bool = False, k_scale_new=None,
+                 v_scale_new=None):
     """In-place (donated/aliased) single-token KV append on [R,KV,S,D]
     caches via async DMA — the Pallas twin of _scatter_chunk for the
-    flash path.  Inactive rows write nothing."""
+    flash path.  Inactive rows write nothing.
+
+    int8 caches: pass ``k_scale_new``/``v_scale_new`` ([R, KV] f32,
+    the per-head scales of the NEW token — quantization.quantize_kv's
+    scale half); the kernel quantizes the float payload in-kernel.  The
+    caller owns scattering the scales into the [R, KV, S] scale tensor
+    (flash_decode_attention does both)."""
     import functools as _ft
 
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
     R, KV, S, D = ck.shape
-    assert S % 16 == 0, S     # 16-aligned windows must stay in bounds
+    quant = ck.dtype.itemsize == 1
+    w = 32 if quant else 16
+    assert S % w == 0, (S, w)  # aligned windows must stay in bounds
+    assert quant == (k_scale_new is not None) == (v_scale_new is not None)
     depth = jnp.clip(depth.astype(jnp.int32), 0, S - 1)
     active = active.astype(jnp.int32)
+    in_specs = [
+        pl.BlockSpec(memory_space=pltpu.VMEM),   # k_new
+        pl.BlockSpec(memory_space=pltpu.VMEM),   # v_new
+    ]
+    inputs = [k_new[:, :, None] if quant
+              else k_new[:, :, None].astype(ck.dtype),
+              v_new[:, :, None] if quant
+              else v_new[:, :, None].astype(cv.dtype)]
+    if quant:
+        in_specs += [pl.BlockSpec(memory_space=pltpu.VMEM)] * 2
+        inputs += [k_scale_new.astype(jnp.float32)[:, :, None, None],
+                   v_scale_new.astype(jnp.float32)[:, :, None, None]]
+    in_specs += [pl.BlockSpec(memory_space=pl.ANY),    # ck
+                 pl.BlockSpec(memory_space=pl.ANY)]    # cv
+    n_in = 2 + len(inputs)         # + scalar-prefetch args
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(R,),
-        in_specs=[
-            pl.BlockSpec(memory_space=pltpu.VMEM),   # k_new
-            pl.BlockSpec(memory_space=pltpu.VMEM),   # v_new
-            pl.BlockSpec(memory_space=pl.ANY),    # ck
-            pl.BlockSpec(memory_space=pl.ANY),    # cv
-        ],
+        in_specs=in_specs,
         out_specs=(pl.BlockSpec(memory_space=pl.ANY),
                    pl.BlockSpec(memory_space=pl.ANY)),
-        scratch_shapes=[pltpu.VMEM((KV, 16, D), ck.dtype),
-                        pltpu.VMEM((KV, 16, D), cv.dtype),
+        scratch_shapes=[pltpu.VMEM((KV, w, D), ck.dtype),
+                        pltpu.VMEM((KV, w, D), cv.dtype),
                         pltpu.SemaphoreType.DMA(()),
                         pltpu.SemaphoreType.DMA(())],
     )
     return pl.pallas_call(
-        _append_kernel, grid_spec=grid_spec,
+        _ft.partial(_append_kernel, w=w, quant=quant),
+        grid_spec=grid_spec,
         out_shape=(jax.ShapeDtypeStruct(ck.shape, ck.dtype),
                    jax.ShapeDtypeStruct(cv.shape, cv.dtype)),
-        input_output_aliases={4: 0, 5: 1},   # +2 scalar-prefetch args
+        input_output_aliases={n_in: 0, n_in + 1: 1},
         interpret=interpret,
-    )(depth, active, k_new[:, :, None].astype(ck.dtype),
-      v_new[:, :, None].astype(cv.dtype), ck, cv)
+    )(depth, active, *inputs, ck, cv)
 
 
 def flash_decode_attention(q, k_new, v_new, ck, cv, depth, active,
                            scale: float, interpret: bool = False,
-                           slopes=None):
+                           slopes=None, k_scale=None, v_scale=None):
     """Scatter-then-attend decode step (drop-in for the op layer): writes
     the new token's K/V at each active row's depth (in place, Pallas
     DMA), then runs the length-tiled attention.  Caches are
-    [R, KV, S, D].  Returns (out [R,H,D], ck, cv)."""
+    [R, KV, S, D].  Returns (out [R,H,D], ck, cv) — int8 caches (when
+    ``k_scale``/``v_scale`` [R, KV, S] f32 are passed) additionally
+    return the updated scale tensors:
+    (out, ck, cv, k_scale, v_scale)."""
+    if k_scale is not None:
+        from ..quantization import quantize_kv, scatter_kv_scales
+
+        # clamp ONCE, shared by the code write and the scale write:
+        # cache_append clamps internally but scatter_kv_scales drops
+        # out-of-range positions, and a clamped code paired with a
+        # dropped (stale) scale would dequantize garbage at S-1
+        depth = jnp.clip(depth.astype(jnp.int32), 0, ck.shape[2] - 1)
+        # the q half is dead code XLA drops — only the scale is needed
+        # here, the kernel quantizes the payload in-window itself
+        _, k_sc = quantize_kv(k_new)                    # [R, KV]
+        _, v_sc = quantize_kv(v_new)
+        ck, cv = cache_append(ck, cv, k_new, v_new, depth, active,
+                              interpret=interpret, k_scale_new=k_sc,
+                              v_scale_new=v_sc)
+        k_scale = scatter_kv_scales(k_scale, k_sc[:, None], depth, active)
+        v_scale = scatter_kv_scales(v_scale, v_sc[:, None], depth, active)
+        out = flash_decode_attend(q, ck, cv, depth, active, scale,
+                                  interpret=interpret, slopes=slopes,
+                                  k_scale=k_scale, v_scale=v_scale)
+        return out, ck, cv, k_scale, v_scale
     ck, cv = cache_append(ck, cv, k_new, v_new, depth, active,
                           interpret=interpret)
     out = flash_decode_attend(q, ck, cv, depth, active, scale,
@@ -402,7 +515,8 @@ def mesh_axes(mesh):
 
 def flash_decode_attention_sharded(q, k_new, v_new, ck, cv, depth,
                                    active, scale: float, mesh,
-                                   interpret: bool = False, slopes=None):
+                                   interpret: bool = False, slopes=None,
+                                   k_scale=None, v_scale=None):
     """shard_map'd scatter-then-attend decode step over the serving mesh.
 
     tp shards the kv-head axis — heads are independent, so each shard
@@ -416,8 +530,9 @@ def flash_decode_attention_sharded(q, k_new, v_new, ck, cv, depth,
 
     Global layouts (= serving cache_pspec): q/k_new/v_new
     [R, heads over tp, D]; caches [R, KV over tp, S over sp, D];
-    depth/active replicated.  Returns (out [R,H,D], ck, cv) with out
-    sharded over tp like q.
+    scales (int8 caches) [R, KV over tp, S over sp]; depth/active
+    replicated.  Returns (out [R,H,D], ck, cv[, k_scale, v_scale]) with
+    out sharded over tp like q.
     """
     from jax.experimental.shard_map import shard_map
     from jax.sharding import PartitionSpec as P
@@ -425,41 +540,63 @@ def flash_decode_attention_sharded(q, k_new, v_new, ck, cv, depth,
     tp_ax, sp_ax, tp, sp = mesh_axes(mesh)
     head_spec = P(None, tp_ax, None)
     cache_spec = P(None, tp_ax, sp_ax, None)
+    sc_spec = P(None, tp_ax, sp_ax)
     slope_spec = P(tp_ax)
     has_alibi = slopes is not None
+    quant = k_scale is not None
     depth = depth.astype(jnp.int32)
     active = active.astype(jnp.int32)
 
-    def body(q, kn, vn, ck, cv, depth, active, *sl):
-        sl = sl[0] if has_alibi else None
+    def body(q, kn, vn, ck, cv, depth, active, *rest):
+        rest = list(rest)
+        ks, vs = (rest.pop(0), rest.pop(0)) if quant else (None, None)
+        sl = rest.pop(0) if has_alibi else None
         S_l = ck.shape[2]
         s0 = (jax.lax.axis_index(sp_ax) * S_l) if sp > 1 else 0
         loc = depth - s0                       # signed local depth
         app_act = active * ((loc >= 0) & (loc < S_l))
-        ck, cv = cache_append(ck, cv, kn, vn, loc, app_act,
-                              interpret=interpret)
+        if quant:
+            from ..quantization import quantize_kv, scatter_kv_scales
+
+            _, k_sc = quantize_kv(kn)
+            _, v_sc = quantize_kv(vn)
+            ck, cv = cache_append(ck, cv, kn, vn, loc, app_act,
+                                  interpret=interpret, k_scale_new=k_sc,
+                                  v_scale_new=v_sc)
+            ks = scatter_kv_scales(ks, k_sc[:, None], loc, app_act)
+            vs = scatter_kv_scales(vs, v_sc[:, None], loc, app_act)
+        else:
+            ck, cv = cache_append(ck, cv, kn, vn, loc, app_act,
+                                  interpret=interpret)
         if sp <= 1:
             out = flash_decode_attend(q, ck, cv, depth, active, scale,
-                                      interpret=interpret, slopes=sl)
-            return out, ck, cv
+                                      interpret=interpret, slopes=sl,
+                                      k_scale=ks, v_scale=vs)
+            return ((out, ck, cv, ks, vs) if quant
+                    else (out, ck, cv))
         # shards wholly below the row's span (loc >= S_l) attend ALL
         # their positions (span <= loc holds everywhere); shards above
         # it (loc < 0) are fully masked via `active`
         att_act = active * (loc >= 0)
         acc, m, l = flash_decode_attend_partial(
             q, ck, cv, loc, att_act, scale, interpret=interpret,
-            slopes=sl)
+            slopes=sl, k_scale=ks, v_scale=vs)
         out = flash_merge(acc, m, l, sp_ax)
-        return out.astype(q.dtype), ck, cv
+        return ((out.astype(q.dtype), ck, cv, ks, vs) if quant
+                else (out.astype(q.dtype), ck, cv))
 
     fn = shard_map(
         body, mesh=mesh,
         in_specs=(head_spec, head_spec, head_spec, cache_spec,
                   cache_spec, P(), P())
+        + ((sc_spec, sc_spec) if quant else ())
         + ((slope_spec,) if has_alibi else ()),
-        out_specs=(head_spec, cache_spec, cache_spec),
+        out_specs=(head_spec, cache_spec, cache_spec)
+        + ((sc_spec, sc_spec) if quant else ()),
         check_rep=False)
     args = (q, k_new, v_new, ck, cv, depth, active)
+    if quant:
+        args += (k_scale, v_scale)
     if has_alibi:
         args += (jnp.asarray(slopes, jnp.float32),)
     return fn(*args)
@@ -470,11 +607,14 @@ def flash_path_ok(C: int, ck, mesh) -> bool:
     serving_attention._flash_decode_ok): single-token decode with a
     lane-aligned head dim, on an unsharded cache OR one sharded over
     the tp (kv heads) / sp (length) serving axes with shard-aligned
-    extents.  WHETHER flash beats the XLA attend is the host's cost
-    decision (inference_manager.flash_wins) — this only says the kernel
-    can run."""
+    extents.  int8 caches need 32-aligned per-shard extents (the int8
+    sublane tiling widens the append's RMW window to 32).  WHETHER
+    flash beats the XLA attend is the host's cost decision
+    (inference_manager.flash_wins) — this only says the kernel can
+    run."""
     R, KV, S, D = ck.shape
-    if C != 1 or D % 128 != 0:
+    align = 32 if ck.dtype.itemsize == 1 else 16
+    if C != 1 or D % 128 != 0 or S % align != 0:
         return False
     if mesh is None:
         return True
@@ -482,4 +622,4 @@ def flash_path_ok(C: int, ck, mesh) -> bool:
     other = [a for a, s in mesh.shape.items()
              if s > 1 and a not in (tp_ax, sp_ax)]
     return (not other and KV % tp == 0 and S % sp == 0
-            and (S // sp) % 16 == 0)
+            and (S // sp) % align == 0)

@@ -49,12 +49,15 @@ import jax.numpy as jnp
 
 def _kernel(last_ref, depth_ref, ntok_ref, act_ref,   # scalar prefetch
             q_ref, k_ref, v_ref,                      # blocks
-            *rest,                                    # [slopes], outs, scr
+            *rest,                          # [ks, vs], [slopes], outs, scr
             ts: int, tc: int, kv: int, g: int, d: int,
             s_total: int, scale: float,
-            alibi: bool, partial: bool):
+            alibi: bool, partial: bool, quant: bool = False):
     from jax.experimental import pallas as pl
 
+    ks_ref = vs_ref = None
+    if quant:
+        ks_ref, vs_ref, *rest = rest
     slopes_ref = None
     if alibi:
         slopes_ref, *rest = rest
@@ -80,10 +83,17 @@ def _kernel(last_ref, depth_ref, ntok_ref, act_ref,   # scalar prefetch
         qv = q_ref[:].reshape(kv, g * tc, d)
         kt = k_ref[:].reshape(kv, ts, d)
         vt = v_ref[:].reshape(kv, ts, d)
+        if ks_ref is not None:
+            # int8 cache: the HBM->VMEM K/V stream is int8; dequant is
+            # in-register — K's per-position scale folds into the logits
+            # AFTER the dot (exact: constant along the contracted d)
+            kt = kt.astype(qv.dtype)
         # logits[kv, g*tc, ts] = qv . kt (batch kv; contract d)
         logits = jax.lax.dot_general(
             qv, kt, (((2,), (2,)), ((0,), (0,))),
             preferred_element_type=jnp.float32) * scale
+        if ks_ref is not None:
+            logits = logits * ks_ref[:].reshape(kv, 1, ts)
         # causal + query-validity mask.  Query at lane (g_, ci) sits at
         # absolute position depth + c*tc + ci and is real iff
         # c*tc + ci < ntok; key j sits at absolute position t*ts + j.
@@ -118,9 +128,19 @@ def _kernel(last_ref, depth_ref, ntok_ref, act_ref,   # scalar prefetch
         # NaN; p is 0 there but 0*NaN = NaN, so zero them explicitly
         col_ok = (t * ts + jax.lax.broadcasted_iota(
             jnp.int32, (1, ts, 1), 1)) < s_total
+        p_kv = p.reshape(kv, g * tc, ts)
+        if vs_ref is not None:
+            # V dequant: fold the per-position scale into p (f32).  The
+            # scale tile's out-of-range pad columns may hold NaN like
+            # vt's — p is 0 there but 0*NaN = NaN, so zero the scales
+            # on the same col_ok guard vt gets below
+            vst = jnp.where(col_ok.reshape(1, 1, ts),
+                            vs_ref[:].reshape(kv, 1, ts), 0.0)
+            p_kv = p_kv * vst
+            vt = vt.astype(qv.dtype)
         vt = jnp.where(col_ok, vt, 0)
         pv = jax.lax.dot_general(
-            p.reshape(kv, g * tc, ts).astype(vt.dtype), vt,
+            p_kv.astype(vt.dtype), vt,
             (((2,), (1,)), ((0,), (0,))),
             preferred_element_type=jnp.float32)
         acc_sc[:] = acc_sc[:] * alpha + pv.reshape(rows, d)
@@ -178,7 +198,8 @@ def _pick_tiles(C: int, S: int, KV: int, G: int, D: int):
 
 
 def _prefill_call(q, ck, cv, depth, ntok, active, scale, interpret,
-                  tc, ts, s_bound, slopes, partial: bool):
+                  tc, ts, s_bound, slopes, partial: bool,
+                  k_scale=None, v_scale=None):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -186,6 +207,11 @@ def _prefill_call(q, ck, cv, depth, ntok, active, scale, interpret,
     KV, S = ck.shape[1], ck.shape[2]
     G = H // KV
     assert H == KV * G and ck.shape == cv.shape == (R, KV, S, D)
+    quant = k_scale is not None
+    assert quant == (v_scale is not None)
+    if quant:
+        assert k_scale.shape == v_scale.shape == (R, KV, S), (
+            k_scale.shape, (R, KV, S))
     if tc is None or ts is None:
         tc0, ts0 = _pick_tiles(C, S, KV, G, D)
         tc, ts = tc or tc0, ts or ts0
@@ -212,7 +238,7 @@ def _prefill_call(q, ck, cv, depth, ntok, active, scale, interpret,
     alibi = slopes is not None
     kernel = functools.partial(_kernel, ts=ts, tc=tc, kv=KV, g=G, d=D,
                                s_total=S, scale=float(scale),
-                               alibi=alibi, partial=partial)
+                               alibi=alibi, partial=partial, quant=quant)
     in_specs = [
         pl.BlockSpec((1, KV, G, tc, D),
                      lambda r, c, t, *_: (r, 0, 0, c, 0)),
@@ -224,6 +250,14 @@ def _prefill_call(q, ck, cv, depth, ntok, active, scale, interpret,
                          r, 0, jnp.minimum(t, last[r, c]), 0)),
     ]
     inputs = [qt, ck, cv]
+    if quant:
+        # f32 scale tiles ride the K/V tiles' clamped index map
+        for sc in (k_scale, v_scale):
+            in_specs.append(pl.BlockSpec(
+                (1, KV, ts),
+                lambda r, c, t, last, *_: (
+                    r, 0, jnp.minimum(t, last[r, c]))))
+            inputs.append(sc)
     if alibi:
         # per-KV-head slopes: within a kv group the G query heads have
         # distinct slopes, so ship the full [H] table reshaped [KV, G]
@@ -278,7 +312,8 @@ def _ml_to_heads(ml, R, nc, tc, KV, G):
                                     "s_bound"))
 def flash_prefill_attend(q, ck, cv, depth, ntok, active, scale: float,
                          interpret: bool = False, tc=None, ts=None,
-                         s_bound=None, slopes=None):
+                         s_bound=None, slopes=None, k_scale=None,
+                         v_scale=None):
     """q [R,C,H,D] against cache [R,KV,S,D], causal at per-row offset
     ``depth`` (query c attends cache positions <= depth[r]+c, queries
     c >= ntok[r] and inactive rows produce zeros) -> [R,C,H,D].
@@ -298,7 +333,7 @@ def flash_prefill_attend(q, ck, cv, depth, ntok, active, scale: float,
     R, C, H, D = q.shape
     out = _prefill_call(q, ck, cv, depth, ntok, active, scale,
                         interpret, tc, ts, s_bound, slopes,
-                        partial=False)
+                        partial=False, k_scale=k_scale, v_scale=v_scale)
     # [R,KV,G,C,D] -> [R,C,H,D]
     return out.transpose(0, 3, 1, 2, 4).reshape(R, C, H, D)
 
@@ -309,7 +344,8 @@ def flash_prefill_attend(q, ck, cv, depth, ntok, active, scale: float,
 def flash_prefill_attend_partial(q, ck, cv, depth, ntok, active,
                                  scale: float, interpret: bool = False,
                                  tc=None, ts=None, s_bound=None,
-                                 slopes=None):
+                                 slopes=None, k_scale=None,
+                                 v_scale=None):
     """Partial (unnormalized) flash prefill for cross-shard combines:
     returns (acc [R,KV,G,C,D] f32, m [R,KV,G,C] f32, l [R,KV,G,C] f32)
     where out = acc / l after the standard flash merge across shards."""
@@ -322,7 +358,8 @@ def flash_prefill_attend_partial(q, ck, cv, depth, ntok, active,
     tc, ts = tc or tc0, ts or ts0
     acc, m, l = _prefill_call(q, ck, cv, depth, ntok, active, scale,
                               interpret, tc, ts, s_bound, slopes,
-                              partial=True)
+                              partial=True, k_scale=k_scale,
+                              v_scale=v_scale)
     nc = C // tc
     return (acc, _ml_to_heads(m, R, nc, tc, KV, G),
             _ml_to_heads(l, R, nc, tc, KV, G))
@@ -332,16 +369,20 @@ def _append_kernel(base_ref, roll_ref, lo_ref, hi_ref, act_ref,  # prefetch
                    kal_ref, val_ref,     # VMEM [1, KV, W, D] row blocks
                    ck_hbm, cv_hbm,               # ANY (aliased inputs)
                    ck_out, cv_out,               # aliased outputs
-                   win_k, win_v, sem_k, sem_v):
-    """Per-row in-place chunk append: overlay the row's 16-aligned
+                   win_k, win_v, sem_k, sem_v, *, align: int = 16):
+    """Per-row in-place chunk append: overlay the row's ``align``-ed
     window [base, base+W) with the pre-aligned new K/V on the window-
     relative span [lo, hi) (chunk entry jj - shift lands at window
     position jj; the rotate amount arrives pre-reduced mod W in
-    ``roll``).  Same rationale as flash_decode._append_kernel: with
-    both the append and the attend as Pallas calls the cache never
-    crosses an XLA layout boundary (XLA prefers S-major for its own
-    scatter and inserts whole-cache relayout copies at custom-call
-    boundaries — measured ~9 ms/step at 1.4B/8k)."""
+    ``roll``).  ``align`` = 16 for bf16/f32 caches, 32 for int8 (the
+    int8 sublane tiling).  Same rationale as
+    flash_decode._append_kernel: with both the append and the attend as
+    Pallas calls the cache never crosses an XLA layout boundary (XLA
+    prefers S-major for its own scatter and inserts whole-cache
+    relayout copies at custom-call boundaries — measured ~9 ms/step at
+    1.4B/8k).  Quantized chunks arrive as EXACT integer codes staged
+    f32 (the rotate needs 32-bit data); the overlay's astype to the
+    int8 window truncates losslessly."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -350,10 +391,10 @@ def _append_kernel(base_ref, roll_ref, lo_ref, hi_ref, act_ref,  # prefetch
 
     @pl.when(act_ref[r] > 0)
     def _():
-        # base16*16 keeps the S-offset PROVABLY divisible by the sublane
-        # tiling (a raw scalar-prefetch offset fails Mosaic's
+        # base*align keeps the S-offset PROVABLY divisible by the
+        # sublane tiling (a raw scalar-prefetch offset fails Mosaic's
         # divisibility check on the memref slice)
-        b = base_ref[r] * 16
+        b = base_ref[r] * align
         ink = pltpu.make_async_copy(
             ck_out.at[r, :, pl.ds(b, W), :], win_k, sem_k)
         inv = pltpu.make_async_copy(
@@ -410,20 +451,30 @@ def chunk_append(ck, cv, k_new, v_new, depth, ntok, active,
     callers).  The row's local span [depth-s_offset, +ntok) may partly
     or wholly miss [0, S) — the overlay writes just the intersection,
     so a chunk straddling sp shard boundaries appends correctly with
-    each shard taking its piece."""
+    each shard taking its piece.
+
+    int8 caches: pass the chunk PRE-QUANTIZED (int8 codes from
+    quantization.quantize_kv) — the f32 staging carries the exact
+    integer codes and the overlay's cast back to int8 is lossless; the
+    [R, KV, S] scale tensors are the caller's to update
+    (flash_prefill_attention scatters them XLA-side)."""
+    import functools as _ft
+
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
     R, KV, S, D = ck.shape
     C = k_new.shape[1]
+    align = 32 if ck.dtype.itemsize == 1 else 16
     W = C + 32
-    assert S % 16 == 0 and W <= S, (S, W)
+    assert S % align == 0 and W <= S, (S, W, align)
+    assert W % align == 0, (C, align)   # gate: int8 needs C % 32 == 0
     depth = depth.astype(jnp.int32)
     ntok = jnp.minimum(ntok.astype(jnp.int32), C)
     active = active.astype(jnp.int32)
     loc = depth - s_offset if s_offset is not None else depth  # signed
     active = active * ((loc < S) & (loc + ntok > 0))
-    base = jnp.clip((jnp.maximum(loc, 0) // 16) * 16, 0, S - W)
+    base = jnp.clip((jnp.maximum(loc, 0) // align) * align, 0, S - W)
     shift = loc - base                 # window pos of chunk entry 0
     roll = shift % W                   # nonneg rotate amount
     pad = [(0, 0), (0, 0), (0, W - C), (0, 0)]
@@ -453,24 +504,41 @@ def chunk_append(ck, cv, k_new, v_new, depth, ntok, active,
                         pltpu.SemaphoreType.DMA(())],
     )
     return pl.pallas_call(
-        _append_kernel, grid_spec=grid_spec,
+        _ft.partial(_append_kernel, align=align), grid_spec=grid_spec,
         out_shape=(jax.ShapeDtypeStruct(ck.shape, ck.dtype),
                    jax.ShapeDtypeStruct(cv.shape, cv.dtype)),
         input_output_aliases={7: 0, 8: 1},   # +5 scalar-prefetch args
         interpret=interpret,
-    )(base // 16, roll, shift, shift + ntok, active, k_al, v_al, ck, cv)
+    )(base // align, roll, shift, shift + ntok, active, k_al, v_al,
+      ck, cv)
 
 
 def flash_prefill_attention(q, k_new, v_new, ck, cv, depth, ntok,
                             active, scale: float,
                             interpret: bool = False, s_bound=None,
-                            slopes=None):
+                            slopes=None, k_scale=None, v_scale=None):
     """Scatter-then-attend prefill step (drop-in for the op layer):
     writes the chunk's K/V at each active row's [depth, depth+ntok)
     (in place, Pallas DMA), then runs the length-tiled attention.
     q [R,C,H,D], k_new/v_new [R,C,KV,D], caches [R,KV,S,D];
     ``s_bound`` = the host's static attend bucket (grid bound).
-    Returns (out [R,C,H,D], ck, cv)."""
+    Returns (out [R,C,H,D], ck, cv) — int8 caches (``k_scale``/
+    ``v_scale`` [R, KV, S] f32 passed) additionally return the updated
+    scale tensors: (out, ck, cv, k_scale, v_scale)."""
+    if k_scale is not None:
+        from ..quantization import quantize_kv, scatter_kv_scales
+
+        k_q, k_sc = quantize_kv(k_new)       # [R,C,KV,D] -> q, [R,C,KV]
+        v_q, v_sc = quantize_kv(v_new)
+        ck, cv = chunk_append(ck, cv, k_q, v_q, depth, ntok, active,
+                              interpret=interpret)
+        k_scale = scatter_kv_scales(k_scale, k_sc, depth, active)
+        v_scale = scatter_kv_scales(v_scale, v_sc, depth, active)
+        out = flash_prefill_attend(q, ck, cv, depth, ntok, active,
+                                   scale, interpret=interpret,
+                                   s_bound=s_bound, slopes=slopes,
+                                   k_scale=k_scale, v_scale=v_scale)
+        return out, ck, cv, k_scale, v_scale
     ck, cv = chunk_append(ck, cv, k_new, v_new, depth, ntok, active,
                           interpret=interpret)
     out = flash_prefill_attend(q, ck, cv, depth, ntok, active, scale,
@@ -482,7 +550,8 @@ def flash_prefill_attention(q, k_new, v_new, ck, cv, depth, ntok,
 def flash_prefill_attention_sharded(q, k_new, v_new, ck, cv, depth,
                                     ntok, active, scale: float, mesh,
                                     interpret: bool = False,
-                                    slopes=None, s_bound=None):
+                                    slopes=None, s_bound=None,
+                                    k_scale=None, v_scale=None):
     """shard_map'd scatter-then-attend prefill over the serving mesh —
     the chunked-prefill twin of
     flash_decode.flash_decode_attention_sharded.
@@ -491,7 +560,10 @@ def flash_prefill_attention_sharded(q, k_new, v_new, ck, cv, depth,
     shards the cache length: each shard appends its INTERSECTION of the
     chunk span [depth, depth+ntok) (chunk_append's s_offset handling),
     runs a partial online softmax over its local positions, and the
-    outputs merge with the standard flash combine over 'sp'.
+    outputs merge with the standard flash combine over 'sp'.  int8
+    caches carry their [R, KV, S] scale tensors through the same
+    sharding (each shard scatters its intersection of the chunk's
+    scales at shard-local offsets).
     """
     from jax.experimental.shard_map import shard_map
     from jax.sharding import PartitionSpec as P
@@ -501,50 +573,71 @@ def flash_prefill_attention_sharded(q, k_new, v_new, ck, cv, depth,
     tp_ax, sp_ax, tp, sp = mesh_axes(mesh)
     q_spec = P(None, None, tp_ax, None)        # [R, C, H, D]
     cache_spec = P(None, tp_ax, sp_ax, None)
+    sc_spec = P(None, tp_ax, sp_ax)
     slope_spec = P(tp_ax)
     has_alibi = slopes is not None
+    quant = k_scale is not None
     depth = depth.astype(jnp.int32)
     ntok = ntok.astype(jnp.int32)
     active = active.astype(jnp.int32)
 
-    def body(q, kn, vn, ck, cv, depth, ntok, active, *sl):
+    def body(q, kn, vn, ck, cv, depth, ntok, active, *rest):
         from .flash_decode import flash_merge
 
-        sl = sl[0] if has_alibi else None
+        rest = list(rest)
+        ks, vs = (rest.pop(0), rest.pop(0)) if quant else (None, None)
+        sl = rest.pop(0) if has_alibi else None
         S_l = ck.shape[2]
         s0 = (jax.lax.axis_index(sp_ax) * S_l) if sp > 1 else 0
+        loc = depth - s0
         # local grid bound: the host's GLOBAL attend bucket clipped to
         # the shard extent (short prompts on a long allocation must not
         # cycle the full pruned grid — flash_prefill_attend docstring)
         sb = min(s_bound, S_l) if s_bound else None
-        ck, cv = chunk_append(ck, cv, kn, vn, depth, ntok, active,
-                              interpret=interpret, s_offset=s0)
+        if quant:
+            from ..quantization import quantize_kv, scatter_kv_scales
+
+            kn_q, k_sc = quantize_kv(kn)
+            vn_q, v_sc = quantize_kv(vn)
+            ck, cv = chunk_append(ck, cv, kn_q, vn_q, depth, ntok,
+                                  active, interpret=interpret,
+                                  s_offset=s0)
+            ks = scatter_kv_scales(ks, k_sc, loc, active)
+            vs = scatter_kv_scales(vs, v_sc, loc, active)
+        else:
+            ck, cv = chunk_append(ck, cv, kn, vn, depth, ntok, active,
+                                  interpret=interpret, s_offset=s0)
         if sp <= 1:
             out = flash_prefill_attend(q, ck, cv, depth, ntok, active,
                                        scale, interpret=interpret,
-                                       slopes=sl, s_bound=sb)
-            return out, ck, cv
-        loc = depth - s0
+                                       slopes=sl, s_bound=sb,
+                                       k_scale=ks, v_scale=vs)
+            return ((out, ck, cv, ks, vs) if quant else (out, ck, cv))
         # shards wholly above every query of the row (loc + ntok <= 0)
         # are fully masked; sj <= qpos handles partial overlap since
         # both are local
         att_act = active * (loc + ntok > 0)
         acc, m, l = flash_prefill_attend_partial(
             q, ck, cv, loc, ntok, att_act, scale, interpret=interpret,
-            slopes=sl, s_bound=sb)
+            slopes=sl, s_bound=sb, k_scale=ks, v_scale=vs)
         out = flash_merge(acc, m, l, sp_ax)
         R, KV, G, C, D = out.shape
         out = out.transpose(0, 3, 1, 2, 4).reshape(R, C, KV * G, D)
-        return out.astype(q.dtype), ck, cv
+        return ((out.astype(q.dtype), ck, cv, ks, vs) if quant
+                else (out.astype(q.dtype), ck, cv))
 
     fn = shard_map(
         body, mesh=mesh,
         in_specs=(q_spec, q_spec, q_spec, cache_spec, cache_spec,
                   P(), P(), P())
+        + ((sc_spec, sc_spec) if quant else ())
         + ((slope_spec,) if has_alibi else ()),
-        out_specs=(q_spec, cache_spec, cache_spec),
+        out_specs=(q_spec, cache_spec, cache_spec)
+        + ((sc_spec, sc_spec) if quant else ()),
         check_rep=False)
     args = (q, k_new, v_new, ck, cv, depth, ntok, active)
+    if quant:
+        args += (k_scale, v_scale)
     if has_alibi:
         args += (jnp.asarray(slopes, jnp.float32),)
     return fn(*args)
@@ -564,8 +657,11 @@ def prefill_path_ok(C: int, ck, mesh) -> bool:
     window/VMEM limits are what count).  WHETHER flash beats the XLA
     attend is the host's cost decision
     (inference_manager.flash_prefill_wins) — this only says the kernel
-    can run."""
+    can run.  int8 caches additionally need 32-divisible chunks and
+    per-shard extents (the int8 sublane tiling widens the append
+    window's alignment to 32)."""
     R, KV, S, D = ck.shape
+    align = 32 if ck.dtype.itemsize == 1 else 16
     tp = sp = 1
     if mesh is not None:
         from .flash_decode import mesh_axes
@@ -573,10 +669,10 @@ def prefill_path_ok(C: int, ck, mesh) -> bool:
         tp_ax, sp_ax, tp, sp = mesh_axes(mesh)
         other = [a for a, s in mesh.shape.items()
                  if s > 1 and a not in (tp_ax, sp_ax)]
-        if other or KV % tp or S % sp or (S // sp) % 16:
+        if other or KV % tp or S % sp or (S // sp) % align:
             return False
     kv_l, s_l = KV // tp, S // sp
     append_vmem = (C + 32) * kv_l * D * (8 + 2 * ck.dtype.itemsize)
-    return (C >= 16 and C % 16 == 0
-            and D % 128 == 0 and s_l % 16 == 0 and C + 32 <= s_l
+    return (C >= align and C % align == 0
+            and D % 128 == 0 and s_l % align == 0 and C + 32 <= s_l
             and append_vmem <= 11 * 1024 * 1024)

@@ -236,25 +236,62 @@ class _ServingAttentionBase(OpDef):
         return 2.0 ** (-h * 8.0 / num_heads)
 
     def _cache(self, ctx, layer_name):
+        """(k, v, k_scale, v_scale) — the scale tensors are None for
+        full-precision caches, [R, KV, S] f32 for int8 caches (the
+        InferenceManager allocates them beside the K/V rows)."""
         cache = ctx.kv_cache[layer_name]
-        return cache["k"], cache["v"]
+        return (cache["k"], cache["v"],
+                cache.get("k_scale"), cache.get("v_scale"))
 
-    def _store(self, ctx, layer_name, ck, cv):
-        ctx.kv_cache_out[layer_name] = {"k": ck, "v": cv}
+    def _store(self, ctx, layer_name, ck, cv, ks=None, vs=None):
+        out = {"k": ck, "v": cv}
+        if ks is not None:
+            out["k_scale"], out["v_scale"] = ks, vs
+        ctx.kv_cache_out[layer_name] = out
 
     @staticmethod
-    def _attend_slice(ctx, ck, cv):
+    def _attend_slice(ctx, ck, cv, ks=None, vs=None):
         """Bound the attended cache prefix: positions past
         ctx.attend_len are provably masked (the host buckets it above
         every active row's depth+chunk), so reading them only burns HBM
         bandwidth — at 7B/MHA the full padded length costs more per step
         than the weights.  Sharded caches skip the slice (it would
-        reshard the sp/tp layout mid-step)."""
+        reshard the sp/tp layout mid-step).  Scale tensors (int8
+        caches) slice in lockstep with their K/V."""
         L = ctx.attend_len
         S = ck.shape[2]
         if L and L < S and ctx.mesh is None:
-            return ck[:, :, :L], cv[:, :, :L], L
-        return ck, cv, S
+            return (ck[:, :, :L], cv[:, :, :L],
+                    None if ks is None else ks[:, :, :L],
+                    None if vs is None else vs[:, :, :L], L)
+        return ck, cv, ks, vs, S
+
+    @staticmethod
+    def _scatter_quantized(ck, cv, ks, vs, k, v, start, active):
+        """int8-cache chunk commit: quantize the new K/V per position
+        per head (quantization.quantize_kv — the same quantizer the
+        Pallas append wrappers use, so both paths write identical cache
+        contents), scatter the int8 codes into the caches and the f32
+        scales into their [R, KV, S] tensors."""
+        from ..quantization import quantize_kv, scatter_kv_scales
+
+        k_q, k_sc = quantize_kv(k)
+        v_q, v_sc = quantize_kv(v)
+        ck = _scatter_chunk(ck, k_q, start, active)
+        cv = _scatter_chunk(cv, v_q, start, active)
+        ks = scatter_kv_scales(ks, k_sc, start, active)
+        vs = scatter_kv_scales(vs, v_sc, start, active)
+        return ck, cv, ks, vs
+
+    @staticmethod
+    def _dequant_pair(ak, av, aks, avs, dtype):
+        """Dequantize attended cache slices to the compute dtype; jnp
+        so XLA fuses the int8->float convert into the attend's operand
+        load (the HBM stream stays int8 — the ISSUE's bandwidth win on
+        the fallback path too)."""
+        from ..quantization import dequantize_kv
+
+        return dequantize_kv(ak, aks, dtype), dequantize_kv(av, avs, dtype)
 
 
 @register
@@ -283,7 +320,8 @@ class IncMultiHeadSelfAttention(_ServingAttentionBase):
                                        theta).swapaxes(1, 2)
             k = apply_rotary_embedding(k.swapaxes(1, 2), positions[:, None, :],
                                        theta).swapaxes(1, 2)
-        ck, cv = self._cache(ctx, layer)
+        ck, cv, ks, vs = self._cache(ctx, layer)
+        quant = ks is not None
         slopes = (self._alibi_slopes(attrs["num_q_heads"])
                   if attrs.get("position_bias", False) else None)
         flash_mode = self._flash_decode_ok(attrs, ctx, C, ck)
@@ -293,19 +331,23 @@ class IncMultiHeadSelfAttention(_ServingAttentionBase):
                 from ..kernels.flash_decode import (
                     flash_decode_attention_sharded)
 
-                out1, ck, cv = flash_decode_attention_sharded(
+                res = flash_decode_attention_sharded(
                     q[:, 0], k[:, 0], v[:, 0], ck, cv,
                     bc["first_depth"], bc["active"].astype(jnp.int32),
                     self._scale(attrs), ctx.mesh, interpret=interp,
-                    slopes=slopes)
+                    slopes=slopes, k_scale=ks, v_scale=vs)
             else:
                 from ..kernels.flash_decode import flash_decode_attention
 
-                out1, ck, cv = flash_decode_attention(
+                res = flash_decode_attention(
                     q[:, 0], k[:, 0], v[:, 0], ck, cv,
                     bc["first_depth"], bc["active"].astype(jnp.int32),
-                    self._scale(attrs), interpret=interp, slopes=slopes)
-            self._store(ctx, layer, ck, cv)
+                    self._scale(attrs), interpret=interp, slopes=slopes,
+                    k_scale=ks, v_scale=vs)
+            out1, ck, cv = res[:3]
+            if quant:
+                ks, vs = res[3], res[4]
+            self._store(ctx, layer, ck, cv, ks, vs)
             return [self._output(params, out1[:, None], attrs, ctx)]
         flash_pre = self._flash_prefill_ok(attrs, ctx, C, ck)
         if flash_pre:
@@ -314,26 +356,37 @@ class IncMultiHeadSelfAttention(_ServingAttentionBase):
                 from ..kernels.flash_prefill import (
                     flash_prefill_attention_sharded)
 
-                out, ck, cv = flash_prefill_attention_sharded(
+                res = flash_prefill_attention_sharded(
                     q, k, v, ck, cv, bc["first_depth"],
                     bc["row_tokens"], bc["active"].astype(jnp.int32),
                     self._scale(attrs), ctx.mesh, interpret=interp,
-                    slopes=slopes, s_bound=ctx.attend_len)
+                    slopes=slopes, s_bound=ctx.attend_len,
+                    k_scale=ks, v_scale=vs)
             else:
                 from ..kernels.flash_prefill import (
                     flash_prefill_attention)
 
-                out, ck, cv = flash_prefill_attention(
+                res = flash_prefill_attention(
                     q, k, v, ck, cv, bc["first_depth"],
                     bc["row_tokens"], bc["active"].astype(jnp.int32),
                     self._scale(attrs), interpret=interp,
-                    s_bound=ctx.attend_len, slopes=slopes)
-            self._store(ctx, layer, ck, cv)
+                    s_bound=ctx.attend_len, slopes=slopes,
+                    k_scale=ks, v_scale=vs)
+            out, ck, cv = res[:3]
+            if quant:
+                ks, vs = res[3], res[4]
+            self._store(ctx, layer, ck, cv, ks, vs)
             return [self._output(params, out, attrs, ctx)]
-        ck = _scatter_chunk(ck, k, bc["first_depth"], bc["active"])
-        cv = _scatter_chunk(cv, v, bc["first_depth"], bc["active"])
-        self._store(ctx, layer, ck, cv)
-        ak, av, S = self._attend_slice(ctx, ck, cv)
+        if quant:
+            ck, cv, ks, vs = self._scatter_quantized(
+                ck, cv, ks, vs, k, v, bc["first_depth"], bc["active"])
+        else:
+            ck = _scatter_chunk(ck, k, bc["first_depth"], bc["active"])
+            cv = _scatter_chunk(cv, v, bc["first_depth"], bc["active"])
+        self._store(ctx, layer, ck, cv, ks, vs)
+        ak, av, aks, avs, S = self._attend_slice(ctx, ck, cv, ks, vs)
+        if quant:
+            ak, av = self._dequant_pair(ak, av, aks, avs, q.dtype)
         span = jnp.arange(S)[None, None, :]  # [1,1,S]
         mask = (span <= positions[:, :, None]) & bc["active"][:, None, None]
         alibi = None
@@ -452,10 +505,19 @@ class TreeIncMultiHeadSelfAttention(_ServingAttentionBase):
         bc = ctx.batch_config
         layer = attrs["layer_name"]
         R, C, _ = x.shape
-        ck, cv = self._cache(ctx, layer)
-        # 1) commit verified tokens from the previous verify step
+        ck, cv, ks, vs = self._cache(ctx, layer)
+        quant = ks is not None
+        # 1) commit verified tokens from the previous verify step (int8
+        # caches move each committed position's SCALE with its codes —
+        # a code reinterpreted under another position's scale would
+        # silently rescale the whole head slice)
         ck = self._commit(ck, bc["commit_count"], bc["commit_src"], bc["commit_dst"])
         cv = self._commit(cv, bc["commit_count"], bc["commit_src"], bc["commit_dst"])
+        if quant:
+            ks = self._commit(ks, bc["commit_count"], bc["commit_src"],
+                              bc["commit_dst"])
+            vs = self._commit(vs, bc["commit_count"], bc["commit_src"],
+                              bc["commit_dst"])
         # 2) project + RoPE at tree depths
         q, k, v = self._project_qkv(params, x, attrs, ctx)
         depths = bc["token_depth"]  # [R, C]
@@ -466,11 +528,17 @@ class TreeIncMultiHeadSelfAttention(_ServingAttentionBase):
             k = apply_rotary_embedding(k.swapaxes(1, 2), depths[:, None, :],
                                        theta).swapaxes(1, 2)
         # 3) stash tree K/V flat at [first_depth, first_depth+C)
-        ck = _scatter_chunk(ck, k, bc["first_depth"], bc["active"])
-        cv = _scatter_chunk(cv, v, bc["first_depth"], bc["active"])
-        self._store(ctx, layer, ck, cv)
+        if quant:
+            ck, cv, ks, vs = self._scatter_quantized(
+                ck, cv, ks, vs, k, v, bc["first_depth"], bc["active"])
+        else:
+            ck = _scatter_chunk(ck, k, bc["first_depth"], bc["active"])
+            cv = _scatter_chunk(cv, v, bc["first_depth"], bc["active"])
+        self._store(ctx, layer, ck, cv, ks, vs)
         # 4) mask: committed prefix + in-batch ancestors
-        ak, av, S = self._attend_slice(ctx, ck, cv)
+        ak, av, aks, avs, S = self._attend_slice(ctx, ck, cv, ks, vs)
+        if quant:
+            ak, av = self._dequant_pair(ak, av, aks, avs, q.dtype)
         span = jnp.arange(S)[None, None, :]
         committed = span < bc["first_depth"][:, None, None]  # [R,1->C,S]
         # scatter tree_mask [R,C,C] into the S axis at first_depth offset

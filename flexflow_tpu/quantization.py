@@ -178,6 +178,50 @@ def native_int8_matmul(x, w_q, scale, contract_rhs_dims=(0,)):
     return (y.astype(jnp.float32) * xs_b * scale_b).astype(x.dtype)
 
 
+# ------------------------------------------------------- int8 KV cache
+def quantize_kv(x):
+    """Symmetric per-slice int8 quantization of KV-cache entries: float
+    ``[..., D]`` -> (q int8 ``[..., D]``, scale f32 ``[...]``), one scale
+    per head-dim slice (per row, per position, per kv head — the
+    granularity the serving caches store, ``[R, KV, S]`` beside the
+    ``[R, KV, S, D]`` int8 K/V).  The single quantizer for BOTH the jnp
+    scatter path and the Pallas append wrappers, so the two paths write
+    bit-identical cache contents."""
+    m = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1)
+    scale = jnp.where(m == 0, 1.0, m / 127.0).astype(jnp.float32)
+    q = jnp.clip(jnp.rint(x.astype(jnp.float32) / scale[..., None]),
+                 -127, 127).astype(jnp.int8)
+    return q, scale
+
+
+def dequantize_kv(q, scale, dtype):
+    """int8 ``[..., D]`` + scale ``[...]`` -> ``dtype``.  Expressed in
+    jnp so XLA fuses the dequant into the attend's operand load — the
+    HBM stream stays int8 (the same fusion argument as the weight
+    convert-dot above)."""
+    return (q.astype(jnp.float32)
+            * scale[..., None].astype(jnp.float32)).astype(dtype)
+
+
+def scatter_kv_scales(scales, chunk, start, active):
+    """``scales [R, KV, S] <- chunk [R, C, KV]`` at per-row offset
+    ``start`` (the scale twin of serving_attention._scatter_chunk).
+
+    ``start`` may be SIGNED (sharded callers pass shard-local offsets):
+    positions outside ``[0, S)`` and inactive rows redirect past the
+    array end and DROP.  No sorted/unique hints — the clamp can break
+    monotonicity and the array is tiny (4 bytes/position)."""
+    S = scales.shape[2]
+    R, C = chunk.shape[:2]
+    pos = start[:, None].astype(jnp.int32) + jnp.arange(C,
+                                                        dtype=jnp.int32)
+    ok = active[:, None].astype(bool) & (pos >= 0) & (pos < S)
+    pos = jnp.where(ok, pos, S)
+    rows = jnp.broadcast_to(jnp.arange(R)[:, None], (R, C))
+    return scales.at[rows, :, pos].set(chunk.astype(scales.dtype),
+                                       mode="drop")
+
+
 # ------------------------------------------------- N-d int8 (attention)
 def quantize_int8_nd(w: np.ndarray, reduce_axes):
     """Symmetric int8 with scale over the non-reduced (output) axes; q

@@ -306,12 +306,19 @@ class LLM:
                 max_tokens_per_batch: int = 64,
                 ssms: Sequence["SSM"] = (),
                 ff_config: Optional[FFConfig] = None,
-                cache_dtype=None):
+                cache_dtype=None,
+                kv_cache_dtype: Optional[str] = None):
         """Build + compile the serving graph (reference serve.py:303+).
 
         With ``ssms`` the LLM compiles in TREE_VERIFY mode and each SSM in
         BEAM_SEARCH mode on the same InferenceManager (reference
         spec_infer.cc:325-376 semantics).
+
+        ``kv_cache_dtype``: "bf16" (default — the computation dtype) or
+        "int8" (quantized KV cache + f32 per-head scales; halves decode
+        cache HBM reads — docs/INTERNALS.md "KV cache memory layout &
+        dtype").  Also settable via FFConfig.kv_cache_dtype; applies to
+        the LLM and every SSM.
         """
         from . import _resolved_config
 
@@ -337,7 +344,8 @@ class LLM:
         self.im = InferenceManager(cfg)
         self.model_id = self.im.compile_model_and_allocate_buffer(
             self.model, mode=mode, max_requests=max_requests_per_batch,
-            max_seq_length=max_seq_length, cache_dtype=cache_dtype)
+            max_seq_length=max_seq_length, cache_dtype=cache_dtype,
+            kv_cache_dtype=kv_cache_dtype)
         self.rm = RequestManager(
             max_requests_per_batch=max_requests_per_batch,
             max_tokens_per_batch=max_tokens_per_batch,
@@ -359,7 +367,8 @@ class LLM:
             ("llama", "opt", "mpt"))
         for ssm in self.ssms:
             ssm._compile_as_ssm(self, max_requests_per_batch, max_seq_length,
-                                cache_dtype=cache_dtype)
+                                cache_dtype=cache_dtype,
+                                kv_cache_dtype=kv_cache_dtype)
         return self
 
     # ------------------------------------------------------------- generate
@@ -419,7 +428,8 @@ class SSM(LLM):
         self.beam_depth = beam_depth
 
     def _compile_as_ssm(self, llm: LLM, max_requests: int,
-                        max_seq_length: int, cache_dtype=None):
+                        max_seq_length: int, cache_dtype=None,
+                        kv_cache_dtype: Optional[str] = None):
         cfg = FFConfig()  # degree-1 everywhere by default
         config_cls, builder, _ = self.spec.load()
         arch_cfg = config_cls.from_hf(self.hf_config)
@@ -432,6 +442,7 @@ class SSM(LLM):
         self.model_id = llm.im.compile_model_and_allocate_buffer(
             self.model, mode=InferenceMode.BEAM_SEARCH,
             max_requests=max_requests, max_seq_length=max_seq_length,
-            beam_width=self.beam_width, cache_dtype=cache_dtype)
+            beam_width=self.beam_width, cache_dtype=cache_dtype,
+            kv_cache_dtype=kv_cache_dtype)
         llm.rm.register_ssm_model(self.model_id)
         self.rm = llm.rm

@@ -50,13 +50,23 @@ def cache_pspec(sp: int, tp: int) -> PartitionSpec:
                          AXIS_SEQ if sp > 1 else None, None)
 
 
+def scale_pspec(spec: PartitionSpec) -> PartitionSpec:
+    """The [rows, kv_heads, length] KV-scale layout (int8 caches):
+    exactly the cache spec minus the head_dim axis, so scales shard
+    beside the K/V rows they describe."""
+    return PartitionSpec(*tuple(spec)[:3])
+
+
 def pin_cache_layout(caches, mesh, spec):
     """In-graph sharding constraint on updated caches — without it the
     compiler may re-layout scan-carried or stage outputs, silently
-    dropping the sp/tp sharding."""
+    dropping the sp/tp sharding.  Rank-aware: 4-D K/V leaves take the
+    cache spec, 3-D scale leaves (int8 caches) its head_dim-less twin."""
     cs = NamedSharding(mesh, spec)
+    cs3 = NamedSharding(mesh, scale_pspec(spec))
     return jax.tree.map(
-        lambda c: jax.lax.with_sharding_constraint(c, cs), caches)
+        lambda c: jax.lax.with_sharding_constraint(
+            c, cs if c.ndim == 4 else cs3), caches)
 
 
 def _device_put_preserving(v, mesh, spec):
@@ -437,15 +447,35 @@ class InferenceManager:
             self, model, mode: InferenceMode = InferenceMode.INC_DECODING,
             max_requests: int = 16, max_seq_length: int = 1024,
             prefill_chunk: int = 256, beam_width: int = 1,
-            cache_dtype=None, model_id: Optional[int] = None) -> int:
-        """Returns a model_id handle.  reference: inference_manager.cc:81."""
+            cache_dtype=None, kv_cache_dtype: Optional[str] = None,
+            model_id: Optional[int] = None) -> int:
+        """Returns a model_id handle.  reference: inference_manager.cc:81.
+
+        ``kv_cache_dtype``: "bf16" (the computation dtype — bit-identical
+        to the pre-existing default) or "int8" (int8 K/V plus f32
+        per-row-per-position-per-head scale tensors; halves decode cache
+        HBM and doubles resident rows x context).  Defaults to the
+        FFConfig's ``kv_cache_dtype``; ``cache_dtype`` (a raw dtype)
+        still overrides the storage dtype directly — ``jnp.int8`` there
+        selects the quantized layout too (rewiden_beam round-trips it).
+        """
         cfg = model.config
         tp = cfg.tensor_parallelism_degree
         pp = cfg.pipeline_parallelism_degree
         sp = cfg.sequence_parallelism_degree
         # shared prelude (both execution modes)
         rows = max_requests * beam_width
-        cache_dtype = cache_dtype or jnp.dtype(cfg.computation_dtype)
+        kv_cache_dtype = kv_cache_dtype or getattr(cfg, "kv_cache_dtype",
+                                                   None)
+        if kv_cache_dtype not in (None, "bf16", "int8"):
+            raise ValueError(
+                f"kv_cache_dtype={kv_cache_dtype!r}: expected 'bf16' or "
+                f"'int8'")
+        if kv_cache_dtype == "int8" and cache_dtype is None:
+            cache_dtype = jnp.int8
+        cache_dtype = jnp.dtype(cache_dtype
+                                or jnp.dtype(cfg.computation_dtype))
+        kv_quantized = cache_dtype == jnp.dtype(jnp.int8)
         # slack tail: a mixed decode/prefill batch scatters a full chunk at
         # each row's depth; rows near max_seq_length would otherwise have
         # the scatter clamped back over committed entries
@@ -455,8 +485,10 @@ class InferenceManager:
         # round the cache length up: %16 keeps VMEM blocks tile-aligned
         # (fused decode attention), %(16*sp) gives every sp shard an
         # equal AND 16-aligned extent (the sharded flash kernels run
-        # per-shard, so the per-shard length is what must align)
-        m = 16 * sp
+        # per-shard, so the per-shard length is what must align).  int8
+        # caches align to 32 instead — the int8 sublane tiling is (32,
+        # 128), so the flash append's RMW windows are 32 positions wide.
+        m = (32 if kv_quantized else 16) * sp
         alloc_len = -(-alloc_len // m) * m
         if model.params is None:
             model.params = model.init_params(jax.random.PRNGKey(cfg.seed))
@@ -518,9 +550,11 @@ class InferenceManager:
         # einsums over the length shards and combines the softmax across
         # them, so >100k-token contexts spread over the sp group.
         caches = {}
-        cache_sharding = None
+        cache_sharding = scale_sharding = None
         if mesh is not None:
             cache_sharding = NamedSharding(mesh, cache_pspec(sp, tp))
+            scale_sharding = NamedSharding(mesh,
+                                           scale_pspec(cache_sharding.spec))
         for layer in model.layers:
             if layer.op_type in SERVING_ATTENTION_OPS:
                 a = layer.attrs
@@ -533,13 +567,22 @@ class InferenceManager:
                     k = jax.device_put(k, cache_sharding)
                     v = jax.device_put(v, cache_sharding)
                 caches[layer.name] = {"k": k, "v": v}
+                if kv_quantized:
+                    # f32 per-row-per-position-per-head scales beside the
+                    # int8 K/V (zero scale => unwritten positions
+                    # dequantize to 0, matching a zeroed bf16 cache)
+                    for part in ("k_scale", "v_scale"):
+                        s = jnp.zeros((rows, kv, alloc_len), jnp.float32)
+                        if scale_sharding is not None:
+                            s = jax.device_put(s, scale_sharding)
+                        caches[layer.name][part] = s
 
         mid = model_id if model_id is not None else len(self.models)
         record = dict(model=model, mode=mode, mesh=mesh, caches=caches,
                       max_requests=max_requests, rows=rows,
                       max_seq_length=max_seq_length, beam_width=beam_width,
                       prefill_chunk=prefill_chunk, steps={},
-                      alloc_len=alloc_len,
+                      alloc_len=alloc_len, kv_quantized=kv_quantized,
                       cache_pspec=(cache_sharding.spec
                                    if cache_sharding is not None else None))
         self.models[mid] = record
@@ -558,7 +601,9 @@ class InferenceManager:
                       max_requests=max_requests, rows=rows,
                       max_seq_length=max_seq_length, beam_width=beam_width,
                       prefill_chunk=prefill_chunk, steps={},
-                      alloc_len=alloc_len)
+                      alloc_len=alloc_len,
+                      kv_quantized=(jnp.dtype(cache_dtype)
+                                    == jnp.dtype(jnp.int8)))
         compile_pipeline(self, record, model, cfg, cache_dtype, rows,
                          alloc_len)
         mid = model_id if model_id is not None else len(self.models)
@@ -802,6 +847,9 @@ class InferenceManager:
             _feed_array(init_cum_logp, jnp.float32),
             _feed_array(init_parent_rows, jnp.int32))
         toks, parents, cums = hist
+        # one odometer tick for the three fetches: they ride one block's
+        # results, so the tunnel pays a single round trip
+        self.host_syncs += 1
         return (np.asarray(toks), np.asarray(parents), np.asarray(cums))
 
     def _get_step(self, record, chunk: int, reorder: bool,
@@ -956,6 +1004,11 @@ class InferenceManager:
 
         def copy(caches, src, dst):
             def cp(c):
+                if c.ndim == 3:      # [R, KV, S] scale rows (int8 caches)
+                    seg = jax.lax.dynamic_slice(
+                        c, (src, 0, 0), (1, c.shape[1], L))
+                    return jax.lax.dynamic_update_slice(c, seg,
+                                                        (dst, 0, 0))
                 seg = jax.lax.dynamic_slice(
                     c, (src, 0, 0, 0), (1, c.shape[1], L, c.shape[3]))
                 return jax.lax.dynamic_update_slice(c, seg, (dst, 0, 0, 0))
@@ -967,6 +1020,24 @@ class InferenceManager:
             return out
 
         return jax.jit(copy, donate_argnums=(0,))
+
+    def cache_dtype_key(self, model_id: int) -> str:
+        """Short dtype tag of a record's KV-cache storage ("int8",
+        "bfloat16", "float32", ...).  The prefix pool keys donated rows
+        by it so a bf16 pool entry never feeds an int8 record (and vice
+        versa) after a same-model_id recompile at a different dtype —
+        the bytes in the row would be reinterpreted, not converted."""
+        caches = self.models[model_id].get("caches") or {}
+        if not caches:
+            return "none"
+        return str(next(iter(caches.values()))["k"].dtype)
+
+    def kv_cache_stats(self, model_id: int):
+        """KVCacheStats snapshot (bytes resident / per attended token)
+        for a compiled record — see utils/profiling.KVCacheStats."""
+        from ..utils.profiling import KVCacheStats
+
+        return KVCacheStats.of_record(self.models[model_id])
 
     def supports_prefix_cache(self, model_id: int) -> bool:
         """Prefix-cache copy needs the single-record cache layout;

@@ -251,6 +251,9 @@ def compile_pipeline(im, record, model, cfg, cache_dtype, rows, alloc_len):
 
     cache_spec = cache_pspec(sp, tp)
     record["pp_cache_spec"] = cache_spec
+    # set by _compile_pipeline_model from the same cache_dtype — read,
+    # don't recompute, so the flag cannot desynchronize from the layout
+    kv_quantized = record["kv_quantized"]
 
     pspecs = extend_quantized_pspecs(_param_pspecs(model), model.params)
     for s, ls in enumerate(stages):
@@ -273,6 +276,15 @@ def compile_pipeline(im, record, model, cfg, cache_dtype, rows, alloc_len):
                     "k": jax.device_put(jnp.zeros(shape, cache_dtype), csh),
                     "v": jax.device_put(jnp.zeros(shape, cache_dtype), csh),
                 }
+                if kv_quantized:
+                    from .inference_manager import scale_pspec
+
+                    ssh = NamedSharding(meshes[s], scale_pspec(cache_spec))
+                    for part in ("k_scale", "v_scale"):
+                        record["caches"][layer.name][part] = \
+                            jax.device_put(
+                                jnp.zeros((rows, kv, alloc_len),
+                                          jnp.float32), ssh)
 
 
 def _group_count(rows: int, pp: int) -> int:
@@ -355,11 +367,13 @@ def pipeline_decode_block(im, record, model_id: int, bc, k: int, rng,
         for s in range(pp):
             for name in stage_cache_names[s]:
                 kv = record["caches"][name]
+                # generic over parts: int8 caches carry k_scale/v_scale
+                # [R, KV, S] rows that slice and ride exactly like K/V
                 if M == 1:
-                    gc[name] = {"k": kv["k"], "v": kv["v"]}
+                    gc[name] = dict(kv)
                 else:
-                    gc[name] = {"k": kv["k"][g * Rg:(g + 1) * Rg],
-                                "v": kv["v"][g * Rg:(g + 1) * Rg]}
+                    gc[name] = {part: arr[g * Rg:(g + 1) * Rg]
+                                for part, arr in kv.items()}
         group_caches.append(gc)
 
     include_init = init_tokens is not None
@@ -432,7 +446,7 @@ def pipeline_decode_block(im, record, model_id: int, bc, k: int, rng,
     # (donated through the steps) — just adopt the final buffers.
     for name in (n for ns in stage_cache_names for n in ns):
         kv = record["caches"][name]
-        for part in ("k", "v"):
+        for part in tuple(kv):
             if M == 1:
                 kv[part] = group_caches[0][name][part]
                 continue

@@ -30,6 +30,16 @@ start depth stays 16-aligned — the invariant the flash-prefill append
 window (``kernels/flash_prefill.prefill_path_ok``) was calibrated
 against.  A non-aligned start depth would be the ONLY way to break it.
 
+Dtype-key rule (int8 KV caches): entries record the CACHE STORAGE DTYPE
+of each donated row (``PrefixEntry.dtypes``), and :meth:`PrefixCache.
+usable` returns 0 when the admitting model's current dtype differs — a
+row donated by a bf16 record must never feed an int8 record (or vice
+versa) after a same-model_id recompile at another dtype: the copy moves
+raw rows, so the bytes would be REINTERPRETED, not converted, and int8
+rows additionally carry [R, KV, S] scale tensors a bf16 record lacks.
+(``copy_prefix`` itself is dtype-generic — it tree-maps over the cache
+dict, so scale rows copy beside their K/V rows.)
+
 Correctness of over-copying: the device copy moves a pow2 BUCKET of
 positions (>= matched_len).  Positions past ``matched_len`` may hold the
 source row's unrelated KV, but every attended position is either
@@ -78,19 +88,24 @@ class PrefixEntry:
 
     ``rows`` maps model_id -> (cache_row, kv_len): the spec path donates
     the LLM row and each SSM's beam-row-0 under one entry (they share
-    the batch slot), with per-model valid lengths.
+    the batch slot), with per-model valid lengths.  ``dtypes`` maps
+    model_id -> cache storage dtype tag ("int8", "bfloat16", ...; see
+    InferenceManager.cache_dtype_key) — the module-docstring dtype-key
+    rule; models missing from it are legacy wildcard donations.
     """
 
-    __slots__ = ("slot", "rows", "length", "refs", "last_use", "node")
+    __slots__ = ("slot", "rows", "length", "refs", "last_use", "node",
+                 "dtypes")
 
     def __init__(self, slot: int, rows: Dict[int, Tuple[int, int]],
-                 length: int):
+                 length: int, dtypes: Optional[Dict[int, str]] = None):
         self.slot = slot                  # batch slot this entry owns
         self.rows = rows                  # model_id -> (cache_row, kv_len)
         self.length = length              # donated token-prefix length
         self.refs = 0                     # live requests pinning this entry
         self.last_use = 0                 # LRU tick
         self.node: Optional[_Node] = None
+        self.dtypes = dict(dtypes or {})  # model_id -> cache dtype tag
 
 
 class PrefixCache:
@@ -146,8 +161,11 @@ class PrefixCache:
 
     # -------------------------------------------------------------- insert
     def insert(self, tokens: Sequence[int], slot: int,
-               rows: Dict[int, Tuple[int, int]]) -> bool:
+               rows: Dict[int, Tuple[int, int]],
+               dtypes: Optional[Dict[int, str]] = None) -> bool:
         """Donate a retired slot's row(s) holding KV for ``tokens``.
+        ``dtypes``: per-model cache storage dtype tags of the donated
+        rows (the dtype-key rule — see the module docstring).
 
         Returns False (caller keeps the slot free) when the donation is
         redundant — an existing entry already extends ``tokens`` — or
@@ -192,7 +210,7 @@ class PrefixCache:
             leaf = _Node(tokens[i:], node)
             node.children[tokens[i]] = leaf
             node = leaf
-        entry = PrefixEntry(slot, dict(rows), len(tokens))
+        entry = PrefixEntry(slot, dict(rows), len(tokens), dtypes)
         entry.node = node
         node.entry = entry
         n = node
@@ -296,10 +314,18 @@ class PrefixCache:
         return found
 
     def usable(self, entry: PrefixEntry, model_id: int, d: int,
-               n_tokens: int) -> int:
+               n_tokens: int, dtype: Optional[str] = None) -> int:
         """The span of ``entry`` this model may reuse for a prompt of
-        ``n_tokens`` tokens whose first ``d`` agree with the entry."""
+        ``n_tokens`` tokens whose first ``d`` agree with the entry.
+
+        ``dtype``: the admitting record's current cache storage dtype
+        tag (InferenceManager.cache_dtype_key) — a mismatch with the
+        entry's recorded donation dtype returns 0 (the dtype-key rule:
+        row copies move raw bytes, never converting)."""
         if model_id not in entry.rows:
+            return 0
+        recorded = entry.dtypes.get(model_id)
+        if dtype is not None and recorded is not None and recorded != dtype:
             return 0
         _, kv_len = entry.rows[model_id]
         return align_down(min(d, kv_len, n_tokens - 1), self.align)

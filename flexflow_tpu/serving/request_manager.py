@@ -269,7 +269,12 @@ class RequestManager:
             matched: Dict[int, int] = {}
             if entry is not None and d:
                 for mid, mult in (model_rows or {}).items():
-                    use = pool.usable(entry, mid, d, len(req.tokens))
+                    # dtype-key rule: a pooled row donated at another
+                    # cache storage dtype (bf16 pool, int8 record after
+                    # a recompile, or vice versa) is unusable — the row
+                    # copy moves raw bytes, never converting
+                    use = pool.usable(entry, mid, d, len(req.tokens),
+                                      dtype=im.cache_dtype_key(mid))
                     if use <= 0:
                         continue
                     if inplace:
@@ -293,17 +298,22 @@ class RequestManager:
         return admitted
 
     def prefix_donate(self, req: Request, slot: int, length: int,
-                      rows: Dict[int, Tuple[int, int]]) -> bool:
+                      rows: Dict[int, Tuple[int, int]],
+                      dtypes: Optional[Dict[int, str]] = None) -> bool:
         """Donate a retiring request's batch ``slot`` to the prefix pool:
         ``rows`` maps model_id -> (cache_row, kv_len) — the cache row
         holding the donated KV and how many positions of it are valid
         (the LLM row is slot * 1; an SSM's beam-row 0 is slot * W).
-        Returns False when the pool is off or rejects (redundant prefix
-        / full of referenced entries) — the slot then frees normally."""
+        ``dtypes`` maps model_id -> cache storage dtype tag so a pooled
+        bf16 row never feeds an int8 record (prefix_cache dtype-key
+        rule).  Returns False when the pool is off or rejects (redundant
+        prefix / full of referenced entries) — the slot then frees
+        normally."""
         if (self.prefix_cache is None
                 or length < self.prefix_cache.min_match):
             return False
-        return self.prefix_cache.insert(req.tokens[:length], slot, rows)
+        return self.prefix_cache.insert(req.tokens[:length], slot, rows,
+                                        dtypes=dtypes)
 
     def _finished(self, req: Request, new_token: int) -> bool:
         if self.eos_token_id is not None and new_token == self.eos_token_id:
@@ -325,9 +335,11 @@ class RequestManager:
         # instead of freeing the row, hand its committed KV
         # (tokens[:cached_len]) to the pool
         if self.prefix_cache is not None and self._prefix_ctx is not None:
-            _, model_id = self._prefix_ctx
+            im, model_id = self._prefix_ctx
             self.prefix_donate(req, row, req.cached_len,
-                               {model_id: (row, req.cached_len)})
+                               {model_id: (row, req.cached_len)},
+                               dtypes={model_id:
+                                       im.cache_dtype_key(model_id)})
 
     def prepare_next_batch(self, prev_bc: Optional[BatchConfig],
                            prev_result: Optional[InferenceResult]

@@ -199,6 +199,7 @@ def _ssm_prefill(rm, im, ssm_id, states, running, beam_width, seed_rng):
         outs = im.inference(ssm_id, bc, rng=seed_rng)
         ids, parents, logps = (np.asarray(outs[0]), np.asarray(outs[1]),
                                np.asarray(outs[2]))
+        im.host_syncs += 1
         for row, req in running.items():
             st = states[req.guid]
             span = spans.get(row)
@@ -244,7 +245,9 @@ def spec_prefix_donate(rm, im, llm_id: int, req: Request, llm_committed: int,
         if im.supports_prefix_cache(sid) and ssm_cached.get(sid, 0) > 0:
             W = im.models[sid]["beam_width"]
             rows[sid] = (req.row * W, ssm_cached[sid])
-    return rm.prefix_donate(req, req.row, llm_committed, rows)
+    return rm.prefix_donate(req, req.row, llm_committed, rows,
+                            dtypes={mid: im.cache_dtype_key(mid)
+                                    for mid in rows})
 
 
 def generate_spec_infer(rm, im, llm_id: int, requests: Sequence[Request],
@@ -463,6 +466,7 @@ def generate_spec_infer(rm, im, llm_id: int, requests: Sequence[Request],
         rng, r4 = jax.random.split(rng)
         outs = im.inference(llm_id, bc, rng=r4)
         greedy = np.asarray(outs[0])  # [rows, chunk] argmax ids
+        im.host_syncs += 1
 
         # ---- acceptance + bookkeeping
         for row, req in running.items():

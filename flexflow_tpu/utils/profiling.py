@@ -18,6 +18,7 @@ import time
 from typing import Any, Dict, List, Optional, Sequence
 
 import jax
+import numpy as np
 
 from .eager import eager_layer_walk
 
@@ -105,6 +106,67 @@ class PrefixCacheStats:
         d["hit_rate"] = round(self.hit_rate(), 4)
         d["tokens_saved_frac"] = round(self.tokens_saved_frac(), 4)
         return d
+
+
+@dataclasses.dataclass
+class KVCacheStats:
+    """KV-cache memory/bandwidth accounting for one compiled serving
+    record (``InferenceManager.kv_cache_stats``).
+
+    ``bytes_resident`` is everything the record's caches pin in HBM
+    (K + V + scale tensors across layers, at the padded allocation);
+    ``bytes_per_token`` is the per-attended-position stream cost across
+    layers — what one decode step reads per position of context — so
+    ``bytes_streamed_step`` for a batch is sum over active rows of
+    (depth_r + 1) * bytes_per_token.  The int8 win is visible directly:
+    int8 K/V (1 byte) + f32 scales (4 bytes / head / position) lands at
+    ~0.52x the bf16 bytes at head_dim 128, which is why the acceptance
+    gate asks for <= 0.55x."""
+
+    kv_cache_dtype: str
+    layers: int
+    rows: int
+    alloc_len: int
+    bytes_resident: int
+    bytes_per_token: int
+
+    @classmethod
+    def of_record(cls, record) -> "KVCacheStats":
+        caches = record.get("caches") or {}
+        resident = 0
+        per_token = 0
+        dtype = "none"
+        for kv in caches.values():
+            dtype = str(kv["k"].dtype)
+            for part, arr in kv.items():
+                resident += int(arr.size) * arr.dtype.itemsize
+                # per attended position: a 4-D [R, KV, S, D] part
+                # streams KV*D elements per position, a 3-D scale
+                # [R, KV, S] streams KV
+                per_pos = int(np.prod(arr.shape[1:2]
+                                      + arr.shape[3:]))
+                per_token += per_pos * arr.dtype.itemsize
+        return cls(kv_cache_dtype=dtype, layers=len(caches),
+                   rows=record.get("rows", 0),
+                   alloc_len=record.get("alloc_len", 0),
+                   bytes_resident=resident, bytes_per_token=per_token)
+
+    def bytes_streamed_step(self, depths: Sequence[int],
+                            active: Optional[Sequence[bool]] = None
+                            ) -> int:
+        """Decode-step HBM read estimate for a batch at the given
+        per-row depths: each active row streams its attended prefix
+        (depth + 1 positions) across every layer.  The jnp path reads
+        the batch-max bucket instead of each row's own depth, and the
+        flash kernel reads whole tiles — both bounded below by this
+        number, which is the dtype comparison that matters."""
+        d = np.asarray(depths, np.int64)
+        if active is not None:
+            d = d[np.asarray(active, bool)]
+        return int((d + 1).sum()) * self.bytes_per_token
+
+    def snapshot(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self)
 
 
 def ttft_percentiles(requests: Sequence[Any],

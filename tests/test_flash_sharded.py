@@ -146,6 +146,73 @@ class TestShardedFlashPrefill:
                                    np.asarray(ref)[valid], atol=1e-4)
 
 
+# ----------------------------------------------------------- int8 caches
+
+
+class TestShardedInt8Caches:
+    """int8 K/V + [R, KV, S] f32 scales ride the shard_map'd kernels
+    (scales shard by the cache spec minus head_dim).  Gate: the sharded
+    result is bit-compatible with the UNSHARDED int8 kernel — same
+    quantizer, same cache/scale writes — across every mesh shape.  For
+    int8 the per-shard length must be 32-aligned (S=256: sp=4 -> 64)."""
+
+    @pytest.mark.parametrize("axes,shape", MESH_CONFIGS)
+    def test_decode_matches_unsharded_int8(self, axes, shape):
+        R, H, KV, D, S = 4, 8, 4, 128, 256
+        rng = np.random.default_rng(5)
+        q = jnp.asarray(rng.standard_normal((R, H, D)), jnp.float32)
+        kn = jnp.asarray(rng.standard_normal((R, KV, D)), jnp.float32)
+        vn = jnp.asarray(rng.standard_normal((R, KV, D)), jnp.float32)
+        ck = jnp.asarray(rng.integers(-127, 128, (R, KV, S, D)), jnp.int8)
+        cv = jnp.asarray(rng.integers(-127, 128, (R, KV, S, D)), jnp.int8)
+        ks = jnp.asarray(rng.random((R, KV, S)) * 0.02 + 1e-3, jnp.float32)
+        vs = jnp.asarray(rng.random((R, KV, S)) * 0.02 + 1e-3, jnp.float32)
+        depth = jnp.asarray([3, 130, 255, 60], jnp.int32)
+        active = jnp.asarray([1, 1, 1, 0], jnp.int32)
+        o_ref, k_ref, v_ref, ks_ref, vs_ref = flash_decode_attention(
+            q, kn, vn, ck, cv, depth, active, 0.125, interpret=True,
+            k_scale=ks, v_scale=vs)
+        o, k1, v1, ks1, vs1 = flash_decode_attention_sharded(
+            q, kn, vn, ck, cv, depth, active, 0.125,
+            _mesh(axes, shape), interpret=True, k_scale=ks, v_scale=vs)
+        act = np.asarray(active) > 0
+        np.testing.assert_allclose(np.asarray(o)[act],
+                                   np.asarray(o_ref)[act], atol=1e-4)
+        np.testing.assert_array_equal(np.asarray(k1), np.asarray(k_ref))
+        np.testing.assert_array_equal(np.asarray(v1), np.asarray(v_ref))
+        np.testing.assert_array_equal(np.asarray(ks1), np.asarray(ks_ref))
+        np.testing.assert_array_equal(np.asarray(vs1), np.asarray(vs_ref))
+
+    @pytest.mark.parametrize("axes,shape", MESH_CONFIGS)
+    def test_prefill_matches_unsharded_int8(self, axes, shape):
+        # C=32: the int8 append window needs 32-divisible chunks
+        R, C, H, KV, D, S = 3, 32, 8, 4, 128, 256
+        rng = np.random.default_rng(6)
+        q = jnp.asarray(rng.standard_normal((R, C, H, D)), jnp.float32)
+        kn = jnp.asarray(rng.standard_normal((R, C, KV, D)), jnp.float32)
+        vn = jnp.asarray(rng.standard_normal((R, C, KV, D)), jnp.float32)
+        ck = jnp.asarray(rng.integers(-127, 128, (R, KV, S, D)), jnp.int8)
+        cv = jnp.asarray(rng.integers(-127, 128, (R, KV, S, D)), jnp.int8)
+        ks = jnp.asarray(rng.random((R, KV, S)) * 0.02 + 1e-3, jnp.float32)
+        vs = jnp.asarray(rng.random((R, KV, S)) * 0.02 + 1e-3, jnp.float32)
+        depth = jnp.asarray([50, 120, 200], jnp.int32)
+        ntok = jnp.asarray([32, 20, 24], jnp.int32)
+        active = jnp.asarray([1, 1, 1], jnp.int32)
+        o_ref, k_ref, v_ref, ks_ref, vs_ref = flash_prefill_attention(
+            q, kn, vn, ck, cv, depth, ntok, active, 0.125,
+            interpret=True, k_scale=ks, v_scale=vs)
+        o, k1, v1, ks1, vs1 = flash_prefill_attention_sharded(
+            q, kn, vn, ck, cv, depth, ntok, active, 0.125,
+            _mesh(axes, shape), interpret=True, k_scale=ks, v_scale=vs)
+        valid = np.arange(C)[None, :] < np.asarray(ntok)[:, None]
+        np.testing.assert_allclose(np.asarray(o)[valid],
+                                   np.asarray(o_ref)[valid], atol=1e-4)
+        np.testing.assert_array_equal(np.asarray(k1), np.asarray(k_ref))
+        np.testing.assert_array_equal(np.asarray(v1), np.asarray(v_ref))
+        np.testing.assert_array_equal(np.asarray(ks1), np.asarray(ks_ref))
+        np.testing.assert_array_equal(np.asarray(vs1), np.asarray(vs_ref))
+
+
 # --------------------------------------------------------------- in-model
 
 

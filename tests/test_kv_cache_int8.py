@@ -1,0 +1,307 @@
+"""int8 KV-cache serving tests (kv_cache_dtype="int8").
+
+The quantized cache halves the decode HBM stream (int8 K/V + f32
+per-row-per-position-per-head scales instead of full-precision K/V).
+These tests pin the PR's acceptance gates on the CPU jnp path:
+
+- greedy generation with int8 KV token-matches the full-precision cache
+  for >= 64 decode steps on the tiny fixture model (quality gate, wired
+  through utils/quality.quality_report);
+- KVCacheStats reports <= 0.55x bf16 cache HBM at equal
+  (rows, alloc_len) for a production-shaped head_dim;
+- the bf16 default is bit-identical to pre-PR behavior (no scale
+  tensors, 16-aligned allocation, same dtype);
+- the prefix pool's dtype-key rule: a pooled full-precision row never
+  feeds a record recompiled at int8 (and int8 pool rows DO serve int8
+  admissions, scale rows copied beside their K/V);
+- the beam-parent cache gather moves scale rows with their K/V rows.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from flexflow_tpu import FFConfig, Model
+from flexflow_tpu.fftype import InferenceMode
+from flexflow_tpu.models.llama import LLAMAConfig, create_llama_model
+from flexflow_tpu.serving import InferenceManager, RequestManager
+
+TINY = dict(vocab_size=128, hidden_size=64, intermediate_size=128,
+            num_hidden_layers=2, num_attention_heads=4,
+            num_key_value_heads=2, max_position_embeddings=512)
+
+
+def _build_llama(name, seed=1, mode=InferenceMode.INC_DECODING,
+                 max_requests=2, **over):
+    cfg = LLAMAConfig(**{**TINY, **over})
+    model = Model(FFConfig(seed=seed), name=name)
+    create_llama_model(model, cfg, mode=mode, max_requests=max_requests)
+    return model
+
+
+def _compile(model, kv_cache_dtype=None, cache_dtype=None, max_requests=2,
+             max_seq_length=256, prefill_chunk=128):
+    im = InferenceManager(model.config)
+    mid = im.compile_model_and_allocate_buffer(
+        model, max_requests=max_requests, max_seq_length=max_seq_length,
+        prefill_chunk=prefill_chunk, kv_cache_dtype=kv_cache_dtype,
+        cache_dtype=cache_dtype)
+    return im, mid
+
+
+def _greedy(im, mid, prompt, n_new, max_requests=2, max_seq_length=256):
+    rm = RequestManager(max_requests_per_batch=max_requests,
+                        max_tokens_per_batch=128,
+                        max_sequence_length=max_seq_length)
+    req = rm.register_new_request(list(prompt), max_new_tokens=n_new)
+    rm.generate_incr_decoding(im, mid, [req])
+    return list(req.tokens)
+
+
+# ------------------------------------------------------------ quality
+def test_int8_greedy_parity_gate():
+    """Acceptance: >= 64 greedy decode steps with int8 KV token-match
+    the full-precision cache on the tiny fixture model, with the
+    divergence metric wired through utils/quality.quality_report."""
+    from flexflow_tpu.utils.quality import quality_report
+
+    prompt = np.random.default_rng(1).integers(4, 120, 16).tolist()
+    n_new = 64
+    model_ref = _build_llama("kvq_ref")
+    im_ref, mid_ref = _compile(model_ref)
+    toks_ref = _greedy(im_ref, mid_ref, prompt, n_new)
+    model_q = _build_llama("kvq_int8")
+    im_q, mid_q = _compile(model_q, kv_cache_dtype="int8")
+    toks_q = _greedy(im_q, mid_q, prompt, n_new)
+
+    assert toks_q == toks_ref, (
+        f"int8 KV diverged from full precision within {n_new} greedy "
+        f"steps (first mismatch at "
+        f"{next(i for i, (a, b) in enumerate(zip(toks_ref, toks_q)) if a != b)})")
+
+    report = quality_report(im_ref, mid_ref, im_q, mid_q,
+                            prompts=[toks_ref],
+                            ref_tokens=[toks_ref[len(prompt):]],
+                            q_tokens=[toks_q[len(prompt):]])
+    assert report["greedy_divergence_step"] is None, report
+    # teacher-forced probe over the same path: near-total argmax
+    # agreement and bounded logprob drift (the probe catches quality
+    # loss the 64-step horizon alone could miss)
+    assert report["top1_agreement"] >= 0.95, report
+    assert report["ppl_ratio"] < 1.10, report
+
+
+# ----------------------------------------------------- memory accounting
+def test_kv_cache_stats_hbm_gate():
+    """Acceptance: int8 cache HBM <= 0.55x an explicit bf16 cache at
+    equal (rows, alloc_len) — bytes_resident factors as
+    rows * alloc_len * bytes_per_token, so the per-token ratio is the
+    equal-allocation comparison.  Needs a production-shaped head_dim
+    (64 here): the f32 scales cost 4 bytes per head per position, which
+    only amortizes over a wide head."""
+    model_bf = _build_llama("kvs_bf", hidden_size=128,
+                            num_attention_heads=2, num_key_value_heads=2)
+    im_bf, mid_bf = _compile(model_bf, cache_dtype=jnp.bfloat16)
+    model_q = _build_llama("kvs_q", hidden_size=128,
+                           num_attention_heads=2, num_key_value_heads=2)
+    im_q, mid_q = _compile(model_q, kv_cache_dtype="int8")
+    s_bf = im_bf.kv_cache_stats(mid_bf)
+    s_q = im_q.kv_cache_stats(mid_q)
+    assert s_bf.kv_cache_dtype == "bfloat16"
+    assert s_q.kv_cache_dtype == "int8"
+    assert s_bf.rows == s_q.rows
+    ratio = s_q.bytes_per_token / s_bf.bytes_per_token
+    assert ratio <= 0.55, (ratio, s_q.snapshot(), s_bf.snapshot())
+    # resident bytes factor exactly as documented
+    for s in (s_bf, s_q):
+        assert s.bytes_resident == s.rows * s.alloc_len * s.bytes_per_token
+    # streamed-bytes estimate: depths sum over active rows
+    est = s_q.bytes_streamed_step([10, 99], active=[True, False])
+    assert est == 11 * s_q.bytes_per_token
+
+
+def test_bf16_default_layout_unchanged():
+    """The default (kv_cache_dtype unset) must be bit-identical to
+    pre-PR behavior: computation-dtype cache, NO scale tensors, and the
+    16-aligned (not 32) allocation length."""
+    model = _build_llama("kv_default")
+    im, mid = _compile(model, max_seq_length=250, prefill_chunk=128)
+    record = im.models[mid]
+    assert not record["kv_quantized"]
+    for kv in record["caches"].values():
+        assert set(kv) == {"k", "v"}
+        assert kv["k"].dtype == jnp.dtype(
+            model.config.computation_dtype)
+    # pre-PR formula: (max_seq_length + prefill_chunk + 1) rounded to 16
+    expect = -(-(250 + 128 + 1) // 16) * 16
+    assert record["alloc_len"] == expect
+    # int8 records round the same request up to 32 instead
+    model_q = _build_llama("kv_default_q")
+    im_q, mid_q = _compile(model_q, kv_cache_dtype="int8",
+                           max_seq_length=250, prefill_chunk=128)
+    assert im_q.models[mid_q]["alloc_len"] == -(-(250 + 128 + 1) // 32) * 32
+
+
+# ------------------------------------------------------- prefix pool
+def test_prefix_pool_dtype_key_unit():
+    """A pooled entry donated at one cache dtype is unusable by a model
+    whose record now stores another dtype; entries without a recorded
+    dtype (legacy donations) stay wildcard."""
+    from flexflow_tpu.serving.prefix_cache import PrefixCache
+
+    pc = PrefixCache(max_slots=4)
+    toks = list(range(4, 100))
+    assert pc.insert(toks, 0, {0: (0, 96)}, dtypes={0: "float32"})
+    e, d = pc.match(toks + [3])
+    assert e is not None and d >= 64
+    assert pc.usable(e, 0, d, 97, dtype="float32") == d
+    assert pc.usable(e, 0, d, 97, dtype="int8") == 0
+    # legacy entry (no dtype recorded): wildcard
+    toks2 = list(range(5, 101))
+    assert pc.insert(toks2, 1, {0: (1, 96)})
+    e2, d2 = pc.match(toks2 + [3])
+    assert pc.usable(e2, 0, d2, 97, dtype="int8") == d2
+
+
+def test_prefix_pool_dtype_key_blocks_cross_dtype_reuse():
+    """Integration: a row donated by a full-precision record must not
+    seed a request after the same model_id is recompiled at int8 —
+    admission sees a dtype mismatch and treats it as a miss."""
+    model = _build_llama("kv_pool_x", max_requests=4)
+    im, mid = _compile(model, max_requests=4)
+    rng = np.random.default_rng(0)
+    system = rng.integers(4, 120, 96).tolist()
+    rm = RequestManager(max_requests_per_batch=4,
+                        max_tokens_per_batch=128,
+                        max_sequence_length=256, prefix_cache=True)
+    req0 = rm.register_new_request(system + [5, 6], max_new_tokens=4)
+    rm.generate_incr_decoding(im, mid, [req0])
+    assert len(rm.prefix_cache.entries) == 1   # row donated (f32)
+
+    # recompile the SAME model_id at int8 — the pooled row's bytes are
+    # f32 K/V; reinterpreting them as int8 codes would be garbage
+    im.compile_model_and_allocate_buffer(
+        model, max_requests=4, max_seq_length=256, prefill_chunk=128,
+        kv_cache_dtype="int8", model_id=mid)
+    req1 = rm.register_new_request(system + [9, 8], max_new_tokens=4)
+    [(admitted, matched)] = rm.admit_pending(im=im, model_rows={mid: 1})
+    assert admitted is req1 and matched == {}
+    assert req1.cached_len == 0
+
+
+def test_int8_prefix_reuse_matches_cold_run():
+    """int8 pool rows DO serve int8 admissions: copy_prefix moves the
+    [R, KV, S] scale rows beside their K/V rows (the tree-mapped row
+    copy), so a warm admission decodes token-identically to a cold
+    run."""
+    model = _build_llama("kv_pool_q", max_requests=4)
+    im, mid = _compile(model, kv_cache_dtype="int8", max_requests=4)
+    rng = np.random.default_rng(0)
+    system = rng.integers(4, 120, 96).tolist()
+    prompts = [system + rng.integers(4, 120, 8).tolist()
+               for _ in range(3)]
+
+    def serve(prefix_cache):
+        rm = RequestManager(max_requests_per_batch=4,
+                            max_tokens_per_batch=128,
+                            max_sequence_length=256,
+                            prefix_cache=prefix_cache)
+        out = []
+        for p in prompts:
+            req = rm.register_new_request(list(p), max_new_tokens=4)
+            rm.generate_incr_decoding(im, mid, [req])
+            out.append(req)
+        return out
+
+    warm = serve(True)
+    cold = serve(False)
+    assert warm[0].profile.prefix_matched_tokens == 0
+    assert all(r.profile.prefix_matched_tokens >= 64 for r in warm[1:])
+    assert [r.tokens for r in warm] == [r.tokens for r in cold]
+
+
+# ------------------------------------------------------------ beam path
+def test_beam_parent_gather_moves_scales_with_rows():
+    """The beam-parent cache shuffle (reorder step: caches gathered by
+    parent_rows) is rank-generic — int8 scale rows must move with their
+    K/V rows, or a gathered row's codes would be reinterpreted under
+    another row's scales."""
+    model = _build_llama("kv_beam", mode=InferenceMode.BEAM_SEARCH,
+                         max_requests=2)
+    im = InferenceManager(model.config)
+    mid = im.compile_model_and_allocate_buffer(
+        model, mode=InferenceMode.BEAM_SEARCH, max_requests=2,
+        max_seq_length=256, prefill_chunk=128, beam_width=2,
+        kv_cache_dtype="int8")
+    record = im.models[mid]
+    name = next(iter(record["caches"]))
+    R = record["rows"]
+    # distinguishable per-row patterns
+    kv = record["caches"][name]
+    kv["k"] = jnp.broadcast_to(
+        jnp.arange(R, dtype=jnp.int8)[:, None, None, None],
+        kv["k"].shape)
+    kv["k_scale"] = jnp.broadcast_to(
+        jnp.arange(R, dtype=jnp.float32)[:, None, None] + 1.0,
+        kv["k_scale"].shape)
+    before_k = np.asarray(kv["k"][:, 0, 0, 0])
+    before_s = np.asarray(kv["k_scale"][:, 0, 0])
+
+    from flexflow_tpu.serving.batch_config import BeamSearchBatchConfig
+
+    bc = BeamSearchBatchConfig(2, 1, beam_width=2)   # all rows inactive
+    perm = np.array([1, 0, 3, 2], dtype=np.int32)
+    im.inference(mid, bc, parent_rows=perm)
+    kv = record["caches"][name]
+    after_k = np.asarray(kv["k"][:, 0, 0, 0])
+    after_s = np.asarray(kv["k_scale"][:, 0, 0])
+    np.testing.assert_array_equal(after_k, before_k[perm])
+    np.testing.assert_array_equal(after_s, before_s[perm])
+    # the pairing survives: row r's codes still sit beside row r's scale
+    np.testing.assert_array_equal(after_s, after_k.astype(np.float32) + 1)
+
+
+# ------------------------------------------------------------ spec smoke
+def test_spec_infer_runs_on_int8_kv():
+    """Speculative serving end to end on int8 caches (host + device
+    loops): tree commit moves scales with codes, the SSM beam gather
+    keeps row/scale pairing, and both drivers produce a full-length,
+    in-vocab generation.  (No cross-dtype parity assert: chunked vs
+    single-token prefill reassociate float reductions differently, and
+    int8 rounding amplifies that — the parity gate lives on the
+    incremental path above.)"""
+    from flexflow_tpu.serving.spec_infer import generate_spec_infer
+
+    monkey = pytest.MonkeyPatch()
+    try:
+        outs = {}
+        for device in (False, True):
+            monkey.setenv("FF_SPEC_DEVICE", "1" if device else "0")
+            llm = _build_llama("kvspec_llm", seed=0,
+                              mode=InferenceMode.TREE_VERIFY,
+                              max_requests=2)
+            ssm = _build_llama("kvspec_ssm", seed=1,
+                              mode=InferenceMode.BEAM_SEARCH,
+                              num_hidden_layers=1, max_requests=2)
+            im = InferenceManager(llm.config)
+            llm_id = im.compile_model_and_allocate_buffer(
+                llm, mode=InferenceMode.TREE_VERIFY, max_requests=2,
+                max_seq_length=256, kv_cache_dtype="int8")
+            rm = RequestManager(max_requests_per_batch=2,
+                                max_tokens_per_batch=64,
+                                max_sequence_length=256,
+                                max_spec_tree_token_num=24)
+            ssm_id = im.compile_model_and_allocate_buffer(
+                ssm, mode=InferenceMode.BEAM_SEARCH, max_requests=2,
+                max_seq_length=256, beam_width=2, kv_cache_dtype="int8")
+            rm.register_ssm_model(ssm_id)
+            prompt = np.random.default_rng(0).integers(4, 90, 24).tolist()
+            req = rm.register_new_request(prompt, max_new_tokens=8)
+            generate_spec_infer(rm, im, llm_id, [req], beam_width=2,
+                                beam_depth=4)
+            assert len(req.tokens) == len(prompt) + 8
+            assert all(0 <= t < 128 for t in req.tokens)
+            outs[device] = list(req.tokens)
+    finally:
+        monkey.undo()

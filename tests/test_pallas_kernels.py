@@ -65,6 +65,112 @@ def test_flash_decode_attention_matches_production(R, H, KV, D, S):
     np.testing.assert_array_equal(np.asarray(v1), np.asarray(cv2))
 
 
+def test_cache_append_rmw_window_edges():
+    """The append kernel's 16-aligned read-modify-write window, at the
+    edges that matter: depth exactly ON a 16-boundary (d % 16 == 0),
+    depth at the top of a window (d % 16 == 15), depth inside the LAST
+    window (base == S-16, including d == S-1), and inactive rows.  For
+    every case the result must equal the production scatter and every
+    position outside the single written (row, depth) slot must be
+    bit-identical to the original cache — a window restore bug would
+    clobber up to 15 neighbours per append."""
+    from flexflow_tpu.kernels.flash_decode import cache_append
+    from flexflow_tpu.ops.serving_attention import _scatter_chunk
+
+    KV, D, S = 2, 128, 64
+    depths = [0, 15, 16, S - 16, S - 1, 7]   # last row inactive
+    active = [1, 1, 1, 1, 1, 0]
+    R = len(depths)
+    rng = np.random.default_rng(0)
+    mk = lambda s: jnp.asarray(rng.standard_normal(s), jnp.float32)
+    ck, cv = mk((R, KV, S, D)), mk((R, KV, S, D))
+    kn, vn = mk((R, KV, D)), mk((R, KV, D))
+    depth = jnp.asarray(depths, jnp.int32)
+    act = jnp.asarray(active, jnp.int32)
+    k1, v1 = cache_append(ck, cv, kn, vn, depth, act, interpret=True)
+    k2 = _scatter_chunk(ck, kn[:, None], depth, act > 0)
+    v2 = _scatter_chunk(cv, vn[:, None], depth, act > 0)
+    np.testing.assert_array_equal(np.asarray(k1), np.asarray(k2))
+    np.testing.assert_array_equal(np.asarray(v1), np.asarray(v2))
+    # explicit no-collateral-damage check, independent of the scatter
+    k1n, ckn = np.asarray(k1), np.asarray(ck)
+    for r in range(R):
+        if not active[r]:
+            np.testing.assert_array_equal(k1n[r], ckn[r])
+            continue
+        d = depths[r]
+        np.testing.assert_array_equal(k1n[r, :, :d], ckn[r, :, :d])
+        np.testing.assert_array_equal(k1n[r, :, d + 1:], ckn[r, :, d + 1:])
+        np.testing.assert_array_equal(k1n[r, :, d], np.asarray(kn)[r])
+
+
+def test_cache_append_int8_quantizes_in_window():
+    """int8 caches widen the RMW window to 32 (the int8 sublane tiling)
+    and quantize the new token IN-KERNEL: the written codes must equal
+    quantization.quantize_kv's codes for the same scales, windows at
+    32-boundaries (d % 32 == 0 and == 31, base == S-32) must not
+    disturb neighbours, and inactive rows must write nothing."""
+    from flexflow_tpu.kernels.flash_decode import cache_append
+    from flexflow_tpu.quantization import quantize_kv
+
+    KV, D, S = 2, 128, 96
+    depths = [0, 31, 32, S - 32, S - 1, 40]   # last row inactive
+    active = [1, 1, 1, 1, 1, 0]
+    R = len(depths)
+    rng = np.random.default_rng(1)
+    ck = jnp.asarray(rng.integers(-127, 128, (R, KV, S, D)), jnp.int8)
+    cv = jnp.asarray(rng.integers(-127, 128, (R, KV, S, D)), jnp.int8)
+    kn = jnp.asarray(rng.standard_normal((R, KV, D)), jnp.float32)
+    vn = jnp.asarray(rng.standard_normal((R, KV, D)), jnp.float32)
+    k_q, k_sc = quantize_kv(kn)
+    v_q, v_sc = quantize_kv(vn)
+    depth = jnp.asarray(depths, jnp.int32)
+    act = jnp.asarray(active, jnp.int32)
+    k1, v1 = cache_append(ck, cv, kn, vn, depth, act, interpret=True,
+                          k_scale_new=k_sc, v_scale_new=v_sc)
+    k1n, v1n = np.asarray(k1), np.asarray(v1)
+    ckn, cvn = np.asarray(ck), np.asarray(cv)
+    for r in range(R):
+        if not active[r]:
+            np.testing.assert_array_equal(k1n[r], ckn[r])
+            np.testing.assert_array_equal(v1n[r], cvn[r])
+            continue
+        d = depths[r]
+        # in-kernel quantization == the wrapper-level quantizer's codes
+        np.testing.assert_array_equal(k1n[r, :, d], np.asarray(k_q)[r])
+        np.testing.assert_array_equal(v1n[r, :, d], np.asarray(v_q)[r])
+        np.testing.assert_array_equal(k1n[r, :, :d], ckn[r, :, :d])
+        np.testing.assert_array_equal(k1n[r, :, d + 1:], ckn[r, :, d + 1:])
+
+
+def test_flash_decode_int8_attend_matches_dequantized_reference():
+    """The int8 flash-decode attend (in-register dequant: K's scale
+    folded into the logits, V's into the probabilities) matches the
+    production jnp path run on the dequantized cache."""
+    from flexflow_tpu.kernels.flash_decode import flash_decode_attend
+    from flexflow_tpu.ops.serving_attention import _attend
+    from flexflow_tpu.quantization import dequantize_kv
+
+    R, H, KV, D, S = 4, 8, 2, 128, 352
+    rng = np.random.default_rng(2)
+    q = jnp.asarray(rng.standard_normal((R, H, D)), jnp.float32)
+    ck = jnp.asarray(rng.integers(-127, 128, (R, KV, S, D)), jnp.int8)
+    cv = jnp.asarray(rng.integers(-127, 128, (R, KV, S, D)), jnp.int8)
+    ks = jnp.asarray(rng.random((R, KV, S)) * 0.02 + 0.001, jnp.float32)
+    vs = jnp.asarray(rng.random((R, KV, S)) * 0.02 + 0.001, jnp.float32)
+    depth = jnp.asarray(rng.integers(0, S - 2, R), jnp.int32)
+    active = jnp.asarray([1] * (R - 1) + [0], jnp.int32)
+    o1 = flash_decode_attend(q, ck, cv, depth, active, 0.125,
+                             interpret=True, k_scale=ks, v_scale=vs)
+    span = jnp.arange(S)[None, None, :]
+    mask = (span <= depth[:, None, None]) & (active > 0)[:, None, None]
+    o2 = _attend(q[:, None], dequantize_kv(ck, ks, jnp.float32),
+                 dequantize_kv(cv, vs, jnp.float32), mask, 0.125)[:, 0]
+    act = np.asarray(active) > 0
+    np.testing.assert_allclose(np.asarray(o1)[act], np.asarray(o2)[act],
+                               atol=1e-4)
+
+
 def test_flash_decode_in_model(monkeypatch):
     """FF_FLASH_DECODE=interpret forces the host dispatch on and runs the
     kernel interpreted through the full serving stack on CPU — covering
