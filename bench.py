@@ -460,8 +460,7 @@ def bench_spec_infer():
         dt = time.time() - t0
         total = sum(len(r.tokens) - r.prompt_len for r in reqs)
         best_inc = max(best_inc, total / dt)
-    ttfts = [r.profile.first_token_time - r.profile.start_time
-             for r in spec_reqs]
+    ttfts = [r.profile.ttft_s() for r in spec_reqs]
     accept = (sum(r.profile.accepted_tokens for r in spec_reqs)
               / max(1, sum(r.profile.speculated_tokens for r in spec_reqs)))
 
@@ -1204,7 +1203,7 @@ def bench_longctx():
                             max_sequence_length=S + 64, decode_block=16)
         req = rm.register_new_request(prompt, max_new_tokens=16)
         rm.generate_incr_decoding(im, mid, [req])
-        return req.profile.first_token_time - req.profile.start_time
+        return req.profile.ttft_s()
 
     run()   # warmup (compiles the prefill chunk buckets)
     ttft = min(run() for _ in range(3))
@@ -1336,7 +1335,7 @@ def bench_longctx():
                                   decode_block=8)
             req = rm32.register_new_request(prompt32, max_new_tokens=8)
             rm32.generate_incr_decoding(im32, mid32, [req])
-            return req.profile.first_token_time - req.profile.start_time
+            return req.profile.ttft_s()
 
         run32()   # warmup (compiles the 32k-reach chunk buckets)
         ttft32 = min(run32() for _ in range(2))
@@ -1492,12 +1491,10 @@ def bench_prefix(model_builder=None, max_requests=4, system_len=512,
     stats = rm_on.prefix_cache.stats.snapshot()
     prompt_tokens = (system_len + tail_len) * (n_requests - 1)
     warm_prefill_tps = (prompt_tokens
-                        / max(1e-9, sum(r.profile.first_token_time
-                                        - r.profile.start_time
+                        / max(1e-9, sum(r.profile.ttft_s()
                                         for r in warm_reqs[1:])))
     cold_prefill_tps = (prompt_tokens
-                        / max(1e-9, sum(r.profile.first_token_time
-                                        - r.profile.start_time
+                        / max(1e-9, sum(r.profile.ttft_s()
                                         for r in cold_reqs[1:])))
     head = {
         "metric": "prefix_cache_warm_ttft_speedup",
@@ -1975,6 +1972,26 @@ def _kv_summary():
     }
 
 
+def _telemetry_summary():
+    """Serving-telemetry attribution for the round record: the FULL
+    metrics-registry snapshot (queue depth, batch occupancy, kernel-path
+    counters, spec acceptance, prefix-cache counters, latency
+    histograms) plus the headline p50/p90/p99 step-latency percentiles
+    pulled up top-level — present in every emitted record so
+    trajectories can attribute wins per step and per kernel path
+    (docs/OBSERVABILITY.md)."""
+    try:
+        from flexflow_tpu.observability import metrics_snapshot
+    except Exception:               # pragma: no cover - partial installs
+        return {}
+    snap = metrics_snapshot()
+    lat = (snap.get("histograms") or {}).get(
+        "serving_step_latency_seconds") or {}
+    return {"telemetry": snap,
+            "step_latency_percentiles": {
+                p: lat.get(p, 0.0) for p in ("p50", "p90", "p99")}}
+
+
 def _flatten_metrics(result):
     """One flat list of metric dicts (headline first, then extras)."""
     head = {k: v for k, v in result.items() if k != "extras"}
@@ -2023,11 +2040,18 @@ def persist_record(result, mode: str):
     os.makedirs(outdir, exist_ok=True)
     rnd = os.environ.get("FF_BENCH_ROUND", "r05")
     metrics = _flatten_metrics(result)
+    tel = _telemetry_summary()
     record = {"round": rnd, "mode": mode,
               "time_unix": round(time.time(), 1),
               "platform": _platform_str(),
               **_kv_summary(),
+              **tel,
               "metrics": metrics}
+    if "step_latency_percentiles" in tel:
+        # stdout (_slim) reuses THIS snapshot's percentiles so the
+        # committed record and the printed line cannot disagree
+        result["step_latency_percentiles"] = tel[
+            "step_latency_percentiles"]
     prev_rounds = sorted(f for f in os.listdir(outdir)
                          if f.startswith("r") and f.endswith(".json")
                          and f < f"{rnd}.json")
@@ -2076,6 +2100,11 @@ def _slim(result):
     kv = _kv_summary()
     kv.pop("kv_cache", None)
     slim.update(kv)
+    # step-latency percentiles ride stdout (stamped into the result by
+    # persist_record from the SAME snapshot the committed record holds);
+    # the full telemetry snapshot stays in the committed record only
+    # (stdout must survive tail capture)
+    slim.pop("telemetry", None)
     slim["extras"] = [{k: m[k] for k in keep if k in m}
                       for m in result.get("extras", [])]
     return slim

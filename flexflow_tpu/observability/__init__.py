@@ -1,0 +1,64 @@
+"""Serving telemetry: metrics registry + step-event tracing.
+
+One emission surface for the serving stack (request_manager,
+inference_manager, spec_infer, spec_block, prefix_cache,
+pipeline_serving) replacing three generations of ad-hoc counters
+(``host_syncs``, ``PrefixCacheStats``, ``KVCacheStats`` — the legacy
+structs stay as views; their values now also flow through here).
+
+- :class:`MetricsRegistry` (registry.py): counters / gauges /
+  histograms with fixed exponential buckets; thread-safe; near-zero
+  cost when disabled.  The process-wide default registry validates
+  names against :data:`schema.METRICS_SCHEMA`.
+- :class:`StepTracer` (tracer.py): host-side structured step events
+  (admit, prefix-match, prefill-chunk, decode-step, spec-draft,
+  spec-verify, commit, donate, evict) as Chrome-trace JSON, with
+  ``jax.profiler.TraceAnnotation`` spans so host and XLA timelines
+  align.  ``tools/trace_summary.py`` prints a per-phase breakdown.
+
+``FF_TELEMETRY=0`` disables the default registry at import (metrics
+become no-ops; tracing stays explicit-opt-in either way).  See
+docs/OBSERVABILITY.md.
+"""
+
+from __future__ import annotations
+
+import os
+
+from .registry import (Counter, Gauge, Histogram, MetricsRegistry,
+                       exp_buckets)
+from .schema import METRICS_SCHEMA
+from .tracer import EVENT_NAMES, StepTracer
+
+__all__ = [
+    "Counter", "Gauge", "Histogram", "MetricsRegistry", "StepTracer",
+    "METRICS_SCHEMA", "EVENT_NAMES", "exp_buckets", "get_registry",
+    "get_tracer", "metrics_snapshot", "set_telemetry_enabled",
+]
+
+_REGISTRY = MetricsRegistry(
+    schema=METRICS_SCHEMA,
+    enabled=os.environ.get("FF_TELEMETRY", "1") != "0")
+_TRACER = StepTracer()
+
+
+def get_registry() -> MetricsRegistry:
+    """The process-wide serving metrics registry."""
+    return _REGISTRY
+
+
+def get_tracer() -> StepTracer:
+    """The process-wide serving step tracer (inert until started)."""
+    return _TRACER
+
+
+def metrics_snapshot():
+    """Snapshot of the default registry (the ``serve.LLM
+    .metrics_snapshot()`` payload)."""
+    return _REGISTRY.snapshot()
+
+
+def set_telemetry_enabled(enabled: bool):
+    """Runtime switch for the default registry (the FF_TELEMETRY env var
+    decides the import-time default)."""
+    _REGISTRY.enabled = bool(enabled)

@@ -1,0 +1,174 @@
+"""Central metric schema: every metric the serving stack emits.
+
+The registry validates metric names against this table at creation time
+and ``tools/check_metrics_schema.py`` validates the *call sites* in
+``flexflow_tpu/serving/`` statically — a metric incremented anywhere in
+the serving stack but missing here fails CI before it ships an
+undocumented name.  The reference ships its observability vocabulary
+the same way: a fixed ``ProfileInfo`` struct (request_manager.h:244-250)
+and fixed ``--profiling`` timer names, not free-form strings.
+
+Schema entry: name -> {"type": counter|gauge|histogram, "help": str,
+optional "buckets": tuple} — histograms default to the registry's fixed
+exponential ladder when "buckets" is absent.
+"""
+
+from __future__ import annotations
+
+# 0-1 ratio buckets (acceptance rates, occupancy): the exponential
+# latency ladder would put every observation in two buckets.
+RATIO_BUCKETS = tuple(i / 10 for i in range(1, 11))
+
+# token-count buckets: pow2, matching the serving chunk ladder
+TOKEN_BUCKETS = tuple(float(1 << i) for i in range(11))
+
+METRICS_SCHEMA = {
+    # ---------------------------------------------------- host round trips
+    "serving_host_syncs_total": {
+        "type": "counter",
+        "help": "Host<->device round trips (step results materialized to "
+                "numpy).  The serving path's key overhead metric on a "
+                "network-attached chip; mirrors the per-InferenceManager "
+                "host_syncs odometer.",
+    },
+    # ------------------------------------------------------- kernel paths
+    "serving_kernel_path_total": {
+        "type": "counter",
+        "help": "Attention-kernel dispatch decisions, labeled "
+                "phase=decode|prefill, path=flash|xla, "
+                "reason=forced|path_gate|cost_model and cache=int8|fp "
+                "(the record's KV storage dtype, so multi-record "
+                "processes — e.g. the bench kvdtype A/B — attribute "
+                "fallbacks to an arm).  path=xla with reason=path_gate "
+                "is the silent-fallback class the int8 16-chunk bug hid "
+                "in (ROADMAP open item).",
+    },
+    # --------------------------------------------------- request lifecycle
+    "serving_requests_admitted_total": {
+        "type": "counter",
+        "help": "Requests admitted from the pending queue into batch rows.",
+    },
+    "serving_requests_retired_total": {
+        "type": "counter",
+        "help": "Requests retired (EOS or length budget).",
+    },
+    "serving_tokens_generated_total": {
+        "type": "counter",
+        "help": "Generated (non-prompt) tokens committed across requests.",
+    },
+    "serving_queue_depth": {
+        "type": "gauge",
+        "help": "Pending (not yet admitted) requests after the latest "
+                "admission pass.",
+    },
+    "serving_active_requests": {
+        "type": "gauge",
+        "help": "Requests currently occupying batch rows.",
+    },
+    "serving_batch_occupancy": {
+        "type": "gauge",
+        "help": "Active rows / max_requests_per_batch at the latest "
+                "scheduled step (the continuous-batching fill factor).",
+    },
+    # ----------------------------------------------------------- latencies
+    "serving_ttft_seconds": {
+        "type": "histogram",
+        "help": "Host-observed time to first generated token per request "
+                "(monotonic-clock deltas; observed at retirement).",
+    },
+    "serving_tpot_seconds": {
+        "type": "histogram",
+        "help": "Time per output token after the first (decode-phase "
+                "inter-token latency), per retired request.",
+    },
+    "serving_step_latency_seconds": {
+        "type": "histogram",
+        "help": "Wall time of one driver-loop step (dispatch + any host "
+                "sync).  A decode block counts as one step committing K "
+                "tokens; see serving_step_tokens for the per-step yield.",
+    },
+    "serving_step_tokens": {
+        "type": "histogram",
+        "help": "Tokens committed per driver-loop step, summed across "
+                "batch rows (rows completing a prompt for single-step "
+                "syncs, the folded block yield for fused decode blocks, "
+                "all rows' accepted+bonus tokens per spec sync).",
+        "buckets": TOKEN_BUCKETS,
+    },
+    "serving_prefill_chunk_tokens": {
+        "type": "histogram",
+        "help": "Chunk sizes (tokens per row) of scheduled prefill steps.",
+        "buckets": TOKEN_BUCKETS,
+    },
+    # -------------------------------------------------------- speculation
+    "serving_spec_draft_tokens_total": {
+        "type": "counter",
+        "help": "Speculative tokens proposed by SSM drafts (profile "
+                "speculated_tokens, summed at retirement).",
+    },
+    "serving_spec_accepted_tokens_total": {
+        "type": "counter",
+        "help": "Speculated tokens accepted by tree verification "
+                "(profile accepted_tokens, summed at retirement).",
+    },
+    "serving_spec_acceptance_rate": {
+        "type": "histogram",
+        "help": "Per-request accepted/speculated ratio, observed at "
+                "retirement (matches distill.measured_acceptance over "
+                "the same requests).",
+        "buckets": RATIO_BUCKETS,
+    },
+    "serving_spec_verify_tokens": {
+        "type": "histogram",
+        "help": "Verify-batch tree sizes (tokens per row fed to the "
+                "tree-verify step).",
+        "buckets": TOKEN_BUCKETS,
+    },
+    # ------------------------------------------------------- prefix cache
+    "serving_prefix_lookups_total": {
+        "type": "counter",
+        "help": "Prefix-pool lookups at admission (PrefixCacheStats "
+                "re-emission).",
+    },
+    "serving_prefix_hits_total": {
+        "type": "counter",
+        "help": "Prefix-pool lookups that matched a usable pooled prefix.",
+    },
+    "serving_prefix_tokens_matched_total": {
+        "type": "counter",
+        "help": "Prompt tokens served from the prefix pool (prefill "
+                "skipped).",
+    },
+    "serving_prefix_tokens_prompt_total": {
+        "type": "counter",
+        "help": "Total prompt tokens admitted while the prefix pool was "
+                "on (denominator of tokens-saved).",
+    },
+    "serving_prefix_donations_total": {
+        "type": "counter",
+        "help": "Retired rows donated to the prefix pool.",
+    },
+    "serving_prefix_donations_rejected_total": {
+        "type": "counter",
+        "help": "Donations rejected (redundant prefix / pool full of "
+                "referenced entries).",
+    },
+    "serving_prefix_evictions_total": {
+        "type": "counter",
+        "help": "Pool entries evicted (LRU reclaim or supersede).",
+    },
+    # -------------------------------------------------------- KV cache
+    "serving_kv_cache_bytes_resident": {
+        "type": "gauge",
+        "help": "HBM pinned by a compiled record's KV caches (K + V + "
+                "scales at the padded allocation), labeled model=<id>.",
+    },
+    # --------------------------------------------------- pipeline serving
+    "serving_pp_stage_dispatches_total": {
+        "type": "counter",
+        "help": "Per-stage step dispatches of the pipeline-parallel "
+                "decode block (labeled stage=<s>); re-emits the record's "
+                "pp_dispatches odometer so scheduling regressions are "
+                "visible in the snapshot.",
+    },
+}

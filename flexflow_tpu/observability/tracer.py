@@ -1,0 +1,158 @@
+"""StepTracer: host-side structured step events as Chrome trace JSON.
+
+The reference gets its serving timeline from NVTX ranges + Legion
+``-lg:prof`` (SURVEY.md §5); the rebuild's equivalent is this host-side
+event recorder.  Events use the Chrome Trace Event format (the JSON
+Perfetto / chrome://tracing load natively): ``B``/``E`` begin-end pairs
+for phases (prefill-chunk, decode-step, spec-draft, spec-verify) and
+``i`` instants for points (admit, prefix-match, commit, donate, evict).
+
+Host/XLA alignment: every span additionally enters a
+``jax.profiler.TraceAnnotation`` so when a device trace is being
+captured (``utils/profiling.trace`` / ``jax.profiler.trace``) the same
+phase names appear on the XLA timeline — the host JSON and the XProf
+capture line up by name.
+
+Cost model: when no trace is active, ``span()`` returns a shared
+null context manager and ``instant()`` returns immediately — one
+attribute read per call site, nothing allocated (the telemetry-disabled
+bench gate).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+# The serving event taxonomy.  Emitters stick to these names so
+# tools/trace_summary.py's per-phase breakdown stays stable; args carry
+# the variable detail (guid, row, chunk, tokens, ...).
+EVENT_NAMES = (
+    "admit",          # request admitted into a batch row
+    "prefix-match",   # pooled prefix matched at admission
+    "prefill-chunk",  # one chunked-prefill step (span)
+    "decode-step",    # one decode step or fused decode block (span)
+    "spec-draft",     # SSM drafting phase (span)
+    "spec-verify",    # LLM tree-verify phase or fused spec block (span)
+    "commit",         # tokens committed to a request
+    "donate",         # retired row donated to the prefix pool
+    "evict",          # prefix-pool entry evicted
+)
+
+_NULL_CM = contextlib.nullcontext()
+
+
+class _Span:
+    """One B/E pair plus a jax.profiler.TraceAnnotation (host and XLA
+    timelines share the phase name)."""
+
+    __slots__ = ("_tr", "_name", "_args", "_ann")
+
+    def __init__(self, tracer: "StepTracer", name: str, args: Dict):
+        self._tr = tracer
+        self._name = name
+        self._args = args
+        self._ann = None
+
+    def __enter__(self):
+        self._tr._emit("B", self._name, self._args)
+        try:
+            import jax
+
+            self._ann = jax.profiler.TraceAnnotation(self._name)
+            self._ann.__enter__()
+        except Exception:   # jax absent / backend without annotations
+            self._ann = None
+        return self
+
+    def __exit__(self, *exc):
+        if self._ann is not None:
+            self._ann.__exit__(*exc)
+        self._tr._emit("E", self._name, None)
+        return False
+
+
+class StepTracer:
+    """Collects Chrome-trace events while active; inert otherwise."""
+
+    def __init__(self):
+        self._events: List[Dict[str, Any]] = []
+        self._lock = threading.Lock()
+        self._t0 = time.monotonic()
+        self.active = False
+
+    # -------------------------------------------------------------- control
+    def start(self):
+        with self._lock:
+            self._events = []
+            self._t0 = time.monotonic()
+        self.active = True
+
+    def stop(self):
+        self.active = False
+
+    @contextlib.contextmanager
+    def trace(self, path: Optional[str] = None):
+        """Collect events for the duration of the block; write the trace
+        file on exit when ``path`` is given."""
+        self.start()
+        try:
+            yield self
+        finally:
+            self.stop()
+            if path:
+                self.save(path)
+
+    # -------------------------------------------------------------- events
+    def _emit(self, ph: str, name: str, args: Optional[Dict]):
+        ev = {"ph": ph, "name": name, "cat": "serving",
+              "ts": round((time.monotonic() - self._t0) * 1e6, 1),
+              "pid": os.getpid(), "tid": threading.get_ident()}
+        if args:
+            ev["args"] = args
+        if ph == "i":
+            ev["s"] = "t"   # thread-scoped instant
+        with self._lock:
+            self._events.append(ev)
+
+    def span(self, name: str, **args):
+        """Context manager for a phase; no-op (shared null CM, nothing
+        allocated) when no trace is active."""
+        if not self.active:
+            return _NULL_CM
+        return _Span(self, name, args)
+
+    def instant(self, name: str, **args):
+        if not self.active:
+            return
+        self._emit("i", name, args or None)
+
+    def begin(self, name: str, **args):
+        """Explicit B event — for phases spanning loop bodies where a
+        ``with`` block would force re-indentation; pair with :meth:`end`
+        (same thread, LIFO) or the trace will not nest."""
+        if not self.active:
+            return
+        self._emit("B", name, args or None)
+
+    def end(self, name: str):
+        if not self.active:
+            return
+        self._emit("E", name, None)
+
+    # ------------------------------------------------------------- output
+    def events(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return list(self._events)
+
+    def to_chrome_trace(self) -> Dict[str, Any]:
+        return {"traceEvents": self.events(), "displayTimeUnit": "ms"}
+
+    def save(self, path: str):
+        with open(path, "w") as f:
+            json.dump(self.to_chrome_trace(), f)
+            f.write("\n")

@@ -408,6 +408,32 @@ class LLM:
                     }) + "\n")
         return results
 
+    # -------------------------------------------------------- observability
+    def metrics_snapshot(self) -> Dict[str, Any]:
+        """Snapshot of the serving metrics registry (counters, gauges,
+        histograms with percentiles) — queue depth, batch occupancy,
+        TTFT/TPOT/step-latency, kernel-path counters, spec acceptance,
+        prefix-cache effectiveness.  See docs/OBSERVABILITY.md for the
+        metric taxonomy; schema lives in
+        flexflow_tpu/observability/schema.py."""
+        from ..observability import metrics_snapshot
+
+        return metrics_snapshot()
+
+    def trace(self, path: str):
+        """Context manager capturing host step events (admit,
+        prefill-chunk, decode-step, spec-draft/verify, commit, donate,
+        evict) for the block's duration and writing Chrome-trace JSON to
+        ``path`` — open it in Perfetto (ui.perfetto.dev) or
+        chrome://tracing; summarize with tools/trace_summary.py.
+
+        >>> with llm.trace("/tmp/serve_trace.json"):
+        ...     llm.generate("hello")
+        """
+        from ..observability import get_tracer
+
+        return get_tracer().trace(path)
+
 
 class SSM(LLM):
     """A small speculative model (reference serve.py class SSM): always

@@ -30,15 +30,25 @@ import numpy as np
 from ..fftype import InferenceMode
 
 
-def pick_chunk(needed: int, cap: int) -> int:
+def pick_chunk(needed: int, cap: int, min_chunk: int = 1) -> int:
     """Smallest pow2 shape bucket covering ``needed`` tokens per row, capped
     at ``cap``.  Pow2 bucketing bounds jit recompiles to log2(cap) step
     functions — the role Legion tracing plays in the reference.  The single
     source of truth for bucket policy (used by RequestManager and
-    spec_infer)."""
+    spec_infer).
+
+    ``min_chunk``: floor applied to MULTI-token (prefill) chunks only —
+    decode steps (needed <= 1) stay at chunk 1.  int8 KV caches set 32:
+    the int8 flash-prefill append needs 32-divisible chunks
+    (kernels/flash_prefill.prefill_path_ok), so a 16-token chunk on an
+    int8 cache silently fell back to the XLA attend path (the ROADMAP
+    open item the serving_kernel_path_total counter now makes visible).
+    The ``cap`` still wins when smaller — the compiled cache slack is a
+    hard bound — in which case the path-gate fallback is counted, not
+    hidden."""
     if needed <= 1:
         return 1
-    return min(1 << (needed - 1).bit_length(), cap)
+    return min(max(1 << (needed - 1).bit_length(), min_chunk), cap)
 
 
 class BatchConfig:

@@ -30,6 +30,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec
 from ..config import (AXIS_DATA, AXIS_EXPERT, AXIS_MODEL, AXIS_PIPE,
                       AXIS_SEQ, FFConfig)
 from ..fftype import InferenceMode, OpType
+from ..observability import get_registry, get_tracer
 from ..ops.registry import OpContext, get_op
 from .batch_config import (BatchConfig, BeamSearchBatchConfig,
                            InferenceResult, TreeVerifyBatchConfig)
@@ -339,6 +340,23 @@ def flash_prefill_wins(bc, chunk: int, alloc_len: int) -> bool:
     return bucket >= FLASH_PREFILL_MIN_BUCKET
 
 
+def _kernel_path_reason(chunk: int, gate_ok: bool) -> str:
+    """WHY a step's flash-vs-XLA decision came out the way it did (the
+    serving_kernel_path_total reason label): the kernel shape gate
+    rejected ("path_gate" — the silent-fallback class), an env override
+    pinned the mode ("forced"), or the host cost model chose
+    ("cost_model").  One derivation for the single-mesh and
+    pipeline-parallel dispatch sites."""
+    import os
+
+    if not gate_ok:
+        return "path_gate"
+    mode = os.environ.get(
+        "FF_FLASH_DECODE" if chunk == 1 else "FF_FLASH_PREFILL", "auto")
+    return ("forced" if mode in ("0", "1", "force", "interpret")
+            else "cost_model")
+
+
 def _retry_transient(step, *args):
     """Invoke a jitted step, retrying ONCE on a transient remote-compile
     failure.  On a network-attached chip the compile service can drop a
@@ -432,15 +450,31 @@ class InferenceManager:
         self.config = config or FFConfig()
         self.mesh: Optional[Mesh] = None
         self.models: Dict[int, Dict[str, Any]] = {}  # model_id -> record
-        # host-sync odometer: bumped by RequestManager each time step
+        # host-sync odometer: bumped (via note_host_sync) each time step
         # results are materialized to numpy.  On a network-attached chip
         # every sync costs a full round trip, so syncs-per-token is the
         # serving path's key overhead metric (tests pin the decode-block
-        # paths to one sync per K tokens).
+        # paths to one sync per K tokens).  Per-manager int here; the
+        # process-wide registry counter ticks alongside it.
         self.host_syncs = 0
         # parked compiled records by (model_id -> beam_width) so
         # rewiden_beam swaps instead of recompiling on alternating widths
         self._beam_variants: Dict[int, Dict[int, Dict[str, Any]]] = {}
+        # serving telemetry (observability/)
+        m = get_registry()
+        self.tracer = get_tracer()
+        self._c_host_syncs = m.counter("serving_host_syncs_total")
+        self._c_kernel_path = m.counter("serving_kernel_path_total")
+        self._c_pp_dispatch = m.counter("serving_pp_stage_dispatches_total")
+        self._g_cache_bytes = m.gauge("serving_kv_cache_bytes_resident")
+
+    def note_host_sync(self, n: int = 1):
+        """Tick the host-sync odometer — the ONE way serving code records
+        a device->host materialization (tools/check_metrics_schema.py
+        lints direct increments of the raw field out of the serving
+        modules)."""
+        self.host_syncs += n  # lint: allow-direct-sync (the odometer itself)
+        self._c_host_syncs.inc(n)
 
     # ------------------------------------------------------------ compile
     def compile_model_and_allocate_buffer(
@@ -586,6 +620,8 @@ class InferenceManager:
                       cache_pspec=(cache_sharding.spec
                                    if cache_sharding is not None else None))
         self.models[mid] = record
+        self._g_cache_bytes.set(
+            self.kv_cache_stats(mid).bytes_resident, model=mid)
         return mid
 
     def _compile_pipeline_model(self, model, mode, max_requests,
@@ -608,6 +644,8 @@ class InferenceManager:
                          alloc_len)
         mid = model_id if model_id is not None else len(self.models)
         self.models[mid] = record
+        self._g_cache_bytes.set(
+            self.kv_cache_stats(mid).bytes_resident, model=mid)
         return mid
 
     def rewiden_beam(self, model_id: int, beam_width: int) -> None:
@@ -672,6 +710,52 @@ class InferenceManager:
         token feedback (pipeline_serving.pipeline_decode_block) — either
         way, one host sync per K tokens."""
         return True
+
+    def min_prefill_chunk(self, model_id: int) -> int:
+        """Floor for host-picked prefill chunks (batch_config.pick_chunk
+        min_chunk): int8 caches need 32-divisible chunks for the flash-
+        prefill append window (prefill_path_ok's 32-alignment — a 16-token
+        chunk silently falls back to the XLA attend), bf16 records keep
+        the pow2 >= 16 ladder unchanged."""
+        return 32 if self.models[model_id].get("kv_quantized") else 1
+
+    def count_kernel_path(self, record, chunk: int, gate_ok: bool,
+                          use: bool):
+        """Record one flash-vs-XLA dispatch decision in
+        serving_kernel_path_total (phase=decode|prefill, path=flash|xla,
+        reason=path_gate|forced|cost_model, cache=int8|fp) — the SINGLE
+        label derivation, shared with the pipeline-parallel dispatch
+        sites (pipeline_serving) so the two layouts' counters cannot
+        diverge.  The cache label splits the int8 arm from the
+        full-precision arm in cumulative (multi-record) snapshots —
+        bench.py kvdtype runs both in one process."""
+        self._c_kernel_path.inc(
+            phase="decode" if chunk == 1 else "prefill",
+            path="flash" if use else "xla",
+            reason=_kernel_path_reason(chunk, gate_ok),
+            cache="int8" if record.get("kv_quantized") else "fp")
+
+    def note_pp_dispatches(self, stage: int, n: int):
+        """Bulk-record pipeline stage-step dispatches (the registry twin
+        of a pp record's pp_dispatches odometer)."""
+        self._c_pp_dispatch.inc(n, stage=stage)
+
+    def _pick_kernel_path(self, record, bc, chunk: int, span: int) -> bool:
+        """Flash-vs-XLA dispatch for one step, COUNTED: every decision
+        lands in serving_kernel_path_total — path=xla/reason=path_gate
+        is the silent-fallback class the int8 16-token-chunk bug hid in
+        (ROADMAP open item; the int8-aware pick_chunk keeps it at zero,
+        and the counter proves it)."""
+        if chunk == 1:
+            gate_ok = record_flash_ok(record, 1)
+            use = gate_ok and flash_wins(bc, span, record["alloc_len"],
+                                         _record_flash_tile(record))
+        else:
+            gate_ok = record_flash_ok(record, chunk)
+            use = gate_ok and flash_prefill_wins(bc, chunk,
+                                                 record["alloc_len"])
+        self.count_kernel_path(record, chunk, gate_ok, use)
+        return use
 
     # --------------------------------------------------------------- step
     def _raw_step(self, record, reorder: bool,
@@ -849,7 +933,7 @@ class InferenceManager:
         toks, parents, cums = hist
         # one odometer tick for the three fetches: they ride one block's
         # results, so the tunnel pays a single round trip
-        self.host_syncs += 1
+        self.note_host_sync()
         return (np.asarray(toks), np.asarray(parents), np.asarray(cums))
 
     def _get_step(self, record, chunk: int, reorder: bool,
@@ -900,14 +984,10 @@ class InferenceManager:
         # ragged decode batches dispatch to the flash kernel, and big-
         # bucket prefill chunks to the flash-prefill kernel.  r5: sharded
         # (tp/sp) records dispatch too — the kernels shard_map over the
-        # mesh (record_flash_ok checks the per-shard shape gates).
-        use_flash = (
-            (bc.chunk == 1 and record_flash_ok(record, 1)
-             and flash_wins(bc, 1, record["alloc_len"],
-                            _record_flash_tile(record)))
-            or (bc.chunk > 1 and record_flash_ok(record, bc.chunk)
-                and flash_prefill_wins(bc, bc.chunk,
-                                       record["alloc_len"])))
+        # mesh (record_flash_ok checks the per-shard shape gates).  The
+        # decision is counted (serving_kernel_path_total).
+        use_flash = self._pick_kernel_path(record, bc, bc.chunk,
+                                           span=bc.chunk)
         # attend_len serves both paths: the XLA attend slices the cache
         # to the bucket, the flash-prefill kernel bounds its GRID with it
         # (pruned-but-cycled grid steps are not free).  Sharded records
@@ -979,9 +1059,7 @@ class InferenceManager:
         # ragged batches dispatch attention to the flash kernel
         attend_len = (attend_bucket(bc, k + 1, record["alloc_len"])
                       if record["mesh"] is None else None)
-        use_flash = (record_flash_ok(record, 1)
-                     and flash_wins(bc, k + 1, record["alloc_len"],
-                                    _record_flash_tile(record)))
+        use_flash = self._pick_kernel_path(record, bc, 1, span=k + 1)
         key = ("block", k, include_init, attend_len, use_flash)
         if key not in record["steps"]:
             record["steps"][key] = self._build_decode_block(

@@ -340,9 +340,11 @@ def pipeline_decode_block(im, record, model_id: int, bc, k: int, rng,
     # (r5): each stage's attention shard_maps over its submesh
     from .inference_manager import _record_flash_tile, flash_wins
 
-    use_flash = (pp_flash_ok(record, 1)
+    gate_ok = pp_flash_ok(record, 1)
+    use_flash = (gate_ok
                  and flash_wins(bc, k + 1, record["alloc_len"],
                                 _record_flash_tile(record)))
+    im.count_kernel_path(record, 1, gate_ok, use_flash)
 
     # jitted per-stage chunk-1 steps (shared with the per-token path
     # except for the group row count)
@@ -441,6 +443,12 @@ def pipeline_decode_block(im, record, model_id: int, bc, k: int, rng,
             # corrupts batches already dispatched but not yet executed
             depth_g[g] = depth_g[g] + active_g[g]
 
+    # re-emit the per-stage dispatch odometer through the registry (one
+    # bulk inc per stage per block, via the manager's cached handle —
+    # the snapshot twin of pp_dispatches)
+    for s in range(pp):
+        im.note_pp_dispatches(s, k * M)
+
     # write group cache rows back into the full arrays (in-place row
     # update; one dispatch per array).  M == 1 ran on the originals
     # (donated through the steps) — just adopt the final buffers.
@@ -482,13 +490,15 @@ def pipeline_inference(im, record, model_id: int, batch, rng) -> List[Any]:
         request_available = np.asarray(batch["active"])
         first_token_depth = np.asarray(batch["first_depth"])
 
+    gate_ok = pp_flash_ok(record, chunk)
     use_flash = (
-        (chunk == 1 and pp_flash_ok(record, 1)
+        (chunk == 1 and gate_ok
          and flash_wins(_BCView, 1, record["alloc_len"],
                         _record_flash_tile(record)))
-        or (chunk > 1 and pp_flash_ok(record, chunk)
+        or (chunk > 1 and gate_ok
             and flash_prefill_wins(_BCView, chunk,
                                    record["alloc_len"])))
+    im.count_kernel_path(record, chunk, gate_ok, use_flash)
     for s in range(len(stages)):
         key = ("pp_step", s, chunk, use_flash)
         if key not in record["pp_steps"]:

@@ -55,6 +55,7 @@ from __future__ import annotations
 
 from typing import Dict, List, Optional, Sequence, Set, Tuple
 
+from ..observability import get_registry, get_tracer
 from ..utils.profiling import PrefixCacheStats
 
 # Matches align down to this boundary — the flash-prefill append window
@@ -123,6 +124,29 @@ class PrefixCache:
         self.entries: Dict[int, PrefixEntry] = {}   # slot -> entry
         self.stats = PrefixCacheStats()
         self._tick = 0
+        # telemetry: the pool's counters re-emitted through the serving
+        # registry (PrefixCacheStats stays the per-pool view; the
+        # registry aggregates across pools and rides snapshots)
+        m = get_registry()
+        self._tracer = get_tracer()
+        self._c_lookups = m.counter("serving_prefix_lookups_total")
+        self._c_hits = m.counter("serving_prefix_hits_total")
+        self._c_matched = m.counter("serving_prefix_tokens_matched_total")
+        self._c_prompt = m.counter("serving_prefix_tokens_prompt_total")
+        self._c_donations = m.counter("serving_prefix_donations_total")
+        self._c_rejected = m.counter(
+            "serving_prefix_donations_rejected_total")
+        self._c_evictions = m.counter("serving_prefix_evictions_total")
+
+    def note_lookup(self, matched: int, prompt_len: int):
+        """Record one admission lookup (stats + registry re-emission) —
+        the single call site is RequestManager.admit_pending."""
+        self.stats.note_lookup(matched, prompt_len)
+        self._c_lookups.inc()
+        self._c_prompt.inc(prompt_len)
+        if matched > 0:
+            self._c_hits.inc()
+            self._c_matched.inc(matched)
 
     # ------------------------------------------------------------- helpers
     def __len__(self) -> int:
@@ -177,9 +201,11 @@ class PrefixCache:
         tokens = [int(t) for t in tokens]
         if len(tokens) < max(self.min_match, 1) or slot in self.entries:
             self.stats.donations_rejected += 1
+            self._c_rejected.inc()
             return False
         if self._covered(tokens):
             self.stats.donations_rejected += 1
+            self._c_rejected.inc()
             return False
         # capacity eviction BEFORE the mutating walk: evict_one prunes
         # tree nodes, so running it mid-walk could detach the very node
@@ -187,6 +213,7 @@ class PrefixCache:
         while len(self.entries) >= self.max_slots:
             if self.evict_one() is None:
                 self.stats.donations_rejected += 1
+                self._c_rejected.inc()
                 return False
         # walk, collecting path entries (potential supersede victims)
         node, i = self.root, 0
@@ -220,12 +247,16 @@ class PrefixCache:
         self.entries[slot] = entry
         self._bump(entry)
         self.stats.donations += 1
+        self._c_donations.inc()
         # supersede shallower same-path entries (their coverage is a
         # strict subset of the new entry's)
         for old in path_entries:
             if old.refs == 0:
                 self.remove(old)
                 self.stats.evictions += 1
+                self._c_evictions.inc()
+                self._tracer.instant("evict", slot=old.slot,
+                                     reason="superseded")
         return True
 
     def _split(self, child: _Node, j: int) -> _Node:
@@ -355,6 +386,8 @@ class PrefixCache:
         victim = min(victims, key=lambda e: e.last_use)
         self.remove(victim)
         self.stats.evictions += 1
+        self._c_evictions.inc()
+        self._tracer.instant("evict", slot=victim.slot, reason="lru")
         return victim.slot, victim
 
     def remove(self, entry: PrefixEntry):

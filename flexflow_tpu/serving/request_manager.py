@@ -33,6 +33,7 @@ import jax
 import numpy as np
 
 from ..fftype import InferenceMode
+from ..observability import get_registry, get_tracer
 from .batch_config import BatchConfig, InferenceResult, pick_chunk
 from .inference_manager import InferenceManager
 from .prefix_cache import PrefixCache
@@ -81,17 +82,32 @@ class ProfileInfo:
     ssm_prefill_rows: int = 0
     # prompt tokens whose KV came from the prefix cache (prefill skipped)
     prefix_matched_tokens: int = 0
+    # wall-clock admission stamp (time.time()) — LOGGING ONLY.  Every
+    # latency delta below uses the monotonic twin: time.time() jumps
+    # under NTP slew, so a wall-clock TTFT can come out negative (or
+    # minutes long) on a freshly-synced serving host.
     start_time: float = 0.0
-    # host-observed time the first generated token became available (the
+    start_mono: float = 0.0
+    # host-observed monotonic stamp of the first generated token (the
     # p50-TTFT ingredient, BASELINE.md north-star metric); under decode
     # blocks this is the first block's sync — what a streaming server
-    # could actually emit
+    # could actually emit.  0.0 = no token yet.
     first_token_time: float = 0.0
     finish_time: float = 0.0
 
     def note_first_token(self):
         if self.first_token_time == 0.0:
-            self.first_token_time = time.time()
+            self.first_token_time = time.monotonic()
+
+    def ttft_s(self) -> Optional[float]:
+        """Monotonic time-to-first-token; None before the first token."""
+        if self.first_token_time == 0.0:
+            return None
+        return self.first_token_time - self.start_mono
+
+    def latency_s(self) -> float:
+        """Monotonic admission-to-finish latency."""
+        return self.finish_time - self.start_mono
 
 
 class Request:
@@ -111,7 +127,8 @@ class Request:
         self.row: Optional[int] = None      # batch slot while RUNNING
         self.cached_len = 0                 # tokens whose KV is committed
         self.prefix_entry = None            # pinned PrefixEntry while RUNNING
-        self.profile = ProfileInfo(start_time=time.time())
+        self.profile = ProfileInfo(start_time=time.time(),
+                                   start_mono=time.monotonic())
 
     def remaining_budget(self, manager_max_seq_len: int) -> int:
         """Tokens this request may still produce before length retirement
@@ -164,6 +181,29 @@ class RequestManager:
         # (im, model_id) while a generate loop that supports donation /
         # prefix copies is driving this manager (generate_incr_decoding)
         self._prefix_ctx: Optional[Tuple[InferenceManager, int]] = None
+        # prefill chunks must honor this floor (int8 flash-prefill needs
+        # 32-divisible chunks); set per-driver from the serving record
+        self._chunk_floor = 1
+        # serving telemetry (observability/): handles cached here so the
+        # per-step cost is one enabled-check per emission
+        m = get_registry()
+        self.tracer = get_tracer()
+        self._m_queue_depth = m.gauge("serving_queue_depth")
+        self._m_active = m.gauge("serving_active_requests")
+        self._m_occupancy = m.gauge("serving_batch_occupancy")
+        self._m_admitted = m.counter("serving_requests_admitted_total")
+        self._m_retired = m.counter("serving_requests_retired_total")
+        self._m_tokens = m.counter("serving_tokens_generated_total")
+        self._m_ttft = m.histogram("serving_ttft_seconds")
+        self._m_tpot = m.histogram("serving_tpot_seconds")
+        self._m_step_latency = m.histogram("serving_step_latency_seconds")
+        self._m_step_tokens = m.histogram("serving_step_tokens")
+        self._m_prefill_chunk = m.histogram("serving_prefill_chunk_tokens")
+        self._m_spec_draft = m.counter("serving_spec_draft_tokens_total")
+        self._m_spec_accept = m.counter(
+            "serving_spec_accepted_tokens_total")
+        self._m_spec_rate = m.histogram("serving_spec_acceptance_rate")
+        self._m_spec_verify = m.histogram("serving_spec_verify_tokens")
 
     # -------------------------------------------------------------- setup
     def register_tokenizer(self, tokenizer, eos_token_id=None,
@@ -291,10 +331,19 @@ class RequestManager:
             if serving:
                 best = max(matched.values(), default=0)
                 req.profile.prefix_matched_tokens = best
-                pool.stats.note_lookup(best, req.prompt_len)
+                pool.note_lookup(best, req.prompt_len)
+                if best:
+                    self.tracer.instant("prefix-match", guid=req.guid,
+                                        row=row, matched=best,
+                                        prompt_len=req.prompt_len)
             if primary is not None:
                 req.cached_len = matched.get(primary, 0)
+            self._m_admitted.inc()
+            self.tracer.instant("admit", guid=req.guid, row=row,
+                                prompt_len=req.prompt_len)
             admitted.append((req, matched))
+        self._m_queue_depth.set(len(self.pending))
+        self._m_active.set(len(self.running))
         return admitted
 
     def prefix_donate(self, req: Request, slot: int, length: int,
@@ -312,8 +361,12 @@ class RequestManager:
         if (self.prefix_cache is None
                 or length < self.prefix_cache.min_match):
             return False
-        return self.prefix_cache.insert(req.tokens[:length], slot, rows,
-                                        dtypes=dtypes)
+        ok = self.prefix_cache.insert(req.tokens[:length], slot, rows,
+                                      dtypes=dtypes)
+        if ok:
+            self.tracer.instant("donate", guid=req.guid, slot=slot,
+                                length=length)
+        return ok
 
     def _finished(self, req: Request, new_token: int) -> bool:
         if self.eos_token_id is not None and new_token == self.eos_token_id:
@@ -322,11 +375,28 @@ class RequestManager:
 
     def _retire(self, req: Request):
         req.status = Request.COMPLETED
-        req.profile.finish_time = time.time()
+        p = req.profile
+        p.finish_time = time.monotonic()
         row = req.row
         del self.running[row]
         self.completed[req.guid] = req
         req.row = None
+        # telemetry: one site covers every driver (all retire through
+        # here, including the spec drivers' writeback paths)
+        self._m_retired.inc()
+        n_out = len(req.tokens) - req.prompt_len
+        self._m_tokens.inc(n_out)
+        ttft = p.ttft_s()
+        if ttft is not None:
+            self._m_ttft.observe(ttft)
+            if n_out > 1:
+                self._m_tpot.observe((p.finish_time - p.first_token_time)
+                                     / (n_out - 1))
+        if p.speculated_tokens > 0:
+            self._m_spec_draft.inc(p.speculated_tokens)
+            self._m_spec_accept.inc(p.accepted_tokens)
+            self._m_spec_rate.observe(p.accepted_tokens
+                                      / p.speculated_tokens)
         if req.prefix_entry is not None:
             self.prefix_cache.release(req.prefix_entry)
             req.prefix_entry = None
@@ -384,7 +454,12 @@ class RequestManager:
         #    active-request count.
         max_span = max(len(r.tokens) - r.cached_len
                        for r in self.running.values())
-        chunk = pick_chunk(max_span, self.max_tokens_per_batch)
+        chunk = pick_chunk(max_span, self.max_tokens_per_batch,
+                           min_chunk=self._chunk_floor)
+        self._m_occupancy.set(len(self.running)
+                              / self.max_requests_per_batch)
+        if chunk > 1:
+            self._m_prefill_chunk.observe(chunk)
 
         bc = BatchConfig(self.max_requests_per_batch, chunk)
         for row, req in self.running.items():
@@ -401,11 +476,12 @@ class RequestManager:
 
     # ----------------------------------------------------------- generate
     def _fold_decode_block(self, bc: BatchConfig, toks: np.ndarray,
-                           handoff: bool = False):
+                           handoff: bool = False) -> int:
         """Fold a [k, R] device-decoded token block into the request state:
         per running row, iteration i consumed one cached token and sampled
         ``toks[i, row]`` — append until EOS/max-len retirement (tokens the
         device decoded past a row's retirement point are discarded).
+        Returns the tokens actually appended across rows (telemetry).
 
         ``handoff``: toks[0] is the prefill step's sample (the
         prefill→decode handoff, [k+1, R]); it was cached when the block's
@@ -414,6 +490,7 @@ class RequestManager:
         the cached_len == len(tokens)-1 decode invariant).
         """
         k = toks.shape[0]
+        appended = 0
         for row in list(self.running):
             req = self.running[row]
             if not bc.request_available[row]:
@@ -424,10 +501,12 @@ class RequestManager:
                     req.profile.llm_decoding_steps += 1
                 tok = int(toks[i, row])
                 req.tokens.append(tok)
+                appended += 1
                 req.profile.note_first_token()
                 if self._finished(req, tok):
                     self._retire(req)
                     break
+        return appended
 
     def _decode_only_bc(self) -> BatchConfig:
         """A chunk-1 BatchConfig over the running rows with device-resident
@@ -463,16 +542,19 @@ class RequestManager:
             (im, model_id)
             if (self.prefix_cache is not None
                 and im.supports_prefix_cache(model_id)) else None)
+        self._chunk_floor = im.min_prefill_chunk(model_id)
         try:
             return self._incr_decoding_loop(im, model_id, requests, rng,
                                             decode_block)
         finally:
             self._prefix_ctx = None
+            self._chunk_floor = 1
 
     def _incr_decoding_loop(self, im, model_id, requests, rng,
                             decode_block):
         bc, result = None, None
         while True:
+            t_step = time.monotonic()
             bc = self.prepare_next_batch(bc, result)
             if bc is None:
                 break
@@ -482,14 +564,19 @@ class RequestManager:
                 # largest remaining span bounds useful block length
                 k = pick_chunk(max(1, self._max_remaining_budget()),
                                decode_block)
-                toks = np.asarray(im.decode_block(
-                    model_id, bc, k, step_rng,
-                    min_remaining=self._min_remaining_budget()))
-                im.host_syncs += 1
-                self._fold_decode_block(bc, toks)
+                with self.tracer.span("decode-step", block=k,
+                                      rows=bc.num_active_requests()):
+                    toks = np.asarray(im.decode_block(
+                        model_id, bc, k, step_rng,
+                        min_remaining=self._min_remaining_budget()))
+                    im.note_host_sync()
+                self._note_step(t_step, self._fold_decode_block(bc, toks))
                 bc, result = None, None
                 continue
-            outs = im.inference(model_id, bc, rng=step_rng)
+            span_name = "prefill-chunk" if bc.chunk > 1 else "decode-step"
+            with self.tracer.span(span_name, chunk=bc.chunk,
+                                  rows=bc.num_active_requests()):
+                outs = im.inference(model_id, bc, rng=step_rng)
             # prefill→decode handoff: when this step finishes every
             # running prompt and no request waits for a row, chain the
             # decode block on device with the (never-materialized) prefill
@@ -500,8 +587,9 @@ class RequestManager:
                     and not self.pending
                     and self._prefill_completes_all(bc)):
                 rng, block_rng = jax.random.split(rng)
-                self._handoff_decode_block(im, model_id, bc, outs,
-                                           decode_block, block_rng)
+                k_done = self._handoff_decode_block(
+                    im, model_id, bc, outs, decode_block, block_rng)
+                self._note_step(t_step, k_done)
                 bc, result = None, None
                 continue
             # final layer is a sampling head emitting [R, C] token ids.
@@ -513,10 +601,25 @@ class RequestManager:
             # used to dominate long-prompt TTFT)
             if self._any_prompt_completes(bc):
                 result = InferenceResult(token_ids=np.asarray(outs[0]))
-                im.host_syncs += 1
+                im.note_host_sync()
+                # each completing row's sample is one committed token
+                # (appended by the next prepare_next_batch fold)
+                self._note_step(t_step, sum(
+                    self._row_completes(req,
+                                        int(bc.num_tokens_in_batch[row]))
+                    for row, req in self.running.items()))
             else:
                 result = InferenceResult(token_ids=outs[0])
+                self._note_step(t_step, 0)
         return [self._result_of(r) for r in requests]
+
+    def _note_step(self, t_start: float, tokens: int):
+        """Record one driver-loop step's host-observed wall time and
+        token yield — ``tokens`` is ALWAYS the batch-total committed this
+        step (every driver's unit; the schema help documents it)."""
+        self._m_step_latency.observe(time.monotonic() - t_start)
+        if tokens > 0:
+            self._m_step_tokens.observe(tokens)
 
     @staticmethod
     def _row_completes(req: Request, n: int) -> bool:
@@ -554,9 +657,10 @@ class RequestManager:
 
     def _handoff_decode_block(self, im: InferenceManager, model_id: int,
                               bc: BatchConfig, outs, decode_block: int,
-                              block_rng) -> None:
+                              block_rng) -> int:
         """Chain a decode block on the prefill's device-resident samples
-        (never synced to the host) and fold the combined result."""
+        (never synced to the host) and fold the combined result.
+        Returns the folded token count (telemetry)."""
         import jax.numpy as jnp
 
         cols = np.zeros(self.max_requests_per_batch, np.int64)
@@ -573,9 +677,11 @@ class RequestManager:
         # init consumes one budget slot, the k scan steps the rest
         k = pick_chunk(max(1, self._max_remaining_budget() - 1),
                        decode_block)
-        toks_dev = im.decode_block(
-            model_id, bc2, k, block_rng, init_tokens=init,
-            min_remaining=max(1, self._min_remaining_budget() - 1))
+        with self.tracer.span("decode-step", block=k, handoff=True,
+                              rows=bc2.num_active_requests()):
+            toks_dev = im.decode_block(
+                model_id, bc2, k, block_rng, init_tokens=init,
+                min_remaining=max(1, self._min_remaining_budget() - 1))
         if os.environ.get("FF_STREAM_FIRST_TOKEN", "0") == "1":
             # surface the FIRST token while the block still runs: init
             # IS each row's first generated token (the prefill sample,
@@ -588,15 +694,15 @@ class RequestManager:
             # over a network tunnel (chip A/B: TTFT -40..-120 ms,
             # total +~RTT at 1.4B/8k with a 16-step block).
             np.asarray(init)
-            im.host_syncs += 1
-            now = time.time()
+            im.note_host_sync()
+            now = time.monotonic()
             for row, req in self.running.items():
                 if (bc2.request_available[row]
                         and req.profile.first_token_time == 0.0):
                     req.profile.first_token_time = now
         toks = np.asarray(toks_dev)
-        im.host_syncs += 1
-        self._fold_decode_block(bc2, toks, handoff=True)
+        im.note_host_sync()
+        return self._fold_decode_block(bc2, toks, handoff=True)
 
     def generate(self, im: InferenceManager, model_id: int,
                  prompts: Sequence[str], max_new_tokens: int = 128,
@@ -628,9 +734,11 @@ class RequestManager:
                     "speculated_tokens": p.speculated_tokens,
                     "accepted_tokens": p.accepted_tokens,
                     "prefix_matched_tokens": p.prefix_matched_tokens,
-                    "latency_s": p.finish_time - p.start_time,
-                    "ttft_s": (p.first_token_time - p.start_time
-                               if p.first_token_time else None),
+                    # wall-clock admission stamp for log correlation;
+                    # deltas are monotonic-clock (NTP-jump immune)
+                    "start_time_unix": p.start_time,
+                    "latency_s": p.latency_s(),
+                    "ttft_s": p.ttft_s(),
                 }) + "\n")
 
     def _result_of(self, req: Request) -> GenerationResult:

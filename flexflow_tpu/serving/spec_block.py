@@ -47,6 +47,7 @@ spec-beats-incremental CI gate this redesign exists to win).
 
 from __future__ import annotations
 
+import time
 from typing import Dict, List, Optional, Sequence
 
 import jax
@@ -306,17 +307,21 @@ def _new_guid_state(D: int) -> Dict:
             "folded": 0, "accepted": 0, "speculated": 0, "llm_steps": 0}
 
 
-def _fold_packed(P, D: int, running, states):
+def _fold_packed(P, D: int, running, states) -> int:
     """Append newly committed tokens from a packed sync to each request
-    (single source for the _pack_state column offsets)."""
+    (single source for the _pack_state column offsets).  Returns the
+    token count folded this sync (step-telemetry yield)."""
     out_len = P[:, 0]
+    folded = 0
     for row, req in running.items():
         st = states[req.guid]
         for t in P[row, 9 + 2 * D + st["folded"]:
                    9 + 2 * D + out_len[row]]:
             req.tokens.append(int(t))
             req.profile.note_first_token()
+        folded += int(out_len[row]) - st["folded"]
         st["folded"] = int(out_len[row])
+    return folded
 
 
 def _writeback_rows(P, D: int, n_ssms: int, rm, states, running):
@@ -465,7 +470,8 @@ def _llm_prompt_prefill(rm, im, llm_id, running, states, tree_chunk, rng):
         spans = {row: n for row, n in spans.items() if n > 0}
         if not spans:
             return rng
-        chunk = pick_chunk(max(spans.values()), tree_chunk)
+        chunk = pick_chunk(max(spans.values()), tree_chunk,
+                           min_chunk=im.min_prefill_chunk(llm_id))
         bc = TreeVerifyBatchConfig(rm.max_requests_per_batch, chunk)
         for row, req in running.items():
             n = min(spans.get(row, 0), chunk)
@@ -483,7 +489,8 @@ def _llm_prompt_prefill(rm, im, llm_id, running, states, tree_chunk, rng):
             bc.tree_mask[row, :n, :n] = np.tril(np.ones((n, n), bool))
             st["llm_cached"] += n
         rng, r = jax.random.split(rng)
-        im.inference(llm_id, bc, rng=r)  # async dispatch; nothing fetched
+        with rm.tracer.span("prefill-chunk", chunk=chunk, model="verify"):
+            im.inference(llm_id, bc, rng=r)  # async; nothing fetched
 
 
 def _ssm_prompt_prefill(rm, im, ssm_id, running, states, W, rng,
@@ -504,7 +511,8 @@ def _ssm_prompt_prefill(rm, im, ssm_id, running, states, W, rng,
         spans = {row: n for row, n in spans.items() if n > 0}
         if not spans:
             return rng
-        chunk = pick_chunk(max(spans.values()), chunk_cap)
+        chunk = pick_chunk(max(spans.values()), chunk_cap,
+                           min_chunk=im.min_prefill_chunk(ssm_id))
         bc = BeamSearchBatchConfig(rm.max_requests_per_batch, chunk,
                                    beam_width=W)
         for row, req in running.items():
@@ -523,7 +531,8 @@ def _ssm_prompt_prefill(rm, im, ssm_id, running, states, W, rng,
             req.profile.ssm_prefill_chunks += 1
             req.profile.ssm_prefill_rows += 1
         rng, r = jax.random.split(rng)
-        im.inference(ssm_id, bc, rng=r)
+        with rm.tracer.span("prefill-chunk", chunk=chunk, model="draft"):
+            im.inference(ssm_id, bc, rng=r)
 
 
 def generate_spec_infer_device(rm, im, llm_id: int,
@@ -699,10 +708,17 @@ def generate_spec_infer_device(rm, im, llm_id: int,
         P = None
         iters_done = toks_done = 0
         while True:
-            for packed in inflight:
-                P = np.asarray(packed)
-                im.host_syncs += 1
-                _fold_packed(P, D, running, states)
+            t_step = time.monotonic()
+            folded = 0
+            with rm.tracer.span("spec-verify", inflight=len(inflight),
+                                rows=len(running)):
+                for packed in inflight:
+                    P = np.asarray(packed)
+                    im.note_host_sync()
+                    folded += _fold_packed(P, D, running, states)
+            if folded:
+                rm.tracer.instant("commit", tokens=folded)
+            rm._note_step(t_step, folded)
             inflight = []
             active, budget = P[:, 1] > 0, P[:, 2]
             iters_done = int(P[:, 8].max())
@@ -937,24 +953,29 @@ def generate_spec_infer_device_pp(rm, im, llm_id: int,
             return state, ssm_caches, packed
 
         # first sync after ONE iteration (fast TTFT), then rate-scaled
+        t_step = time.monotonic()
         rng, r = jax.random.split(rng)
-        state, ssm_caches, packed = iterate(state, ssm_caches, r)
-        P = np.asarray(packed)
-        im.host_syncs += 1
+        with rm.tracer.span("spec-verify", k=1, rows=len(running)):
+            state, ssm_caches, packed = iterate(state, ssm_caches, r)
+            P = np.asarray(packed)
+            im.note_host_sync()
         iters_done = 1
-        _fold_packed(P, D, running, states)
+        rm._note_step(t_step, _fold_packed(P, D, running, states))
         while (P[:, 1] > 0).any() and not (rm.pending
                                            and not (P[:, 1] > 0).all()):
             rate = max(1.0, int(P[:, 0].max()) / max(1, iters_done))
             remaining = int(P[P[:, 1] > 0, 2].max())
             k = max(1, int(remaining // rate))
-            for _ in range(k):
-                rng, r = jax.random.split(rng)
-                state, ssm_caches, packed = iterate(state, ssm_caches, r)
-            P = np.asarray(packed)
-            im.host_syncs += 1
+            t_step = time.monotonic()
+            with rm.tracer.span("spec-verify", k=k, rows=len(running)):
+                for _ in range(k):
+                    rng, r = jax.random.split(rng)
+                    state, ssm_caches, packed = iterate(state, ssm_caches,
+                                                        r)
+                P = np.asarray(packed)
+                im.note_host_sync()
             iters_done = int(P[:, 8].max())
-            _fold_packed(P, D, running, states)
+            rm._note_step(t_step, _fold_packed(P, D, running, states))
 
         ssm_record["caches"] = ssm_caches
         _writeback_rows(P, D, 1, rm, states, running)

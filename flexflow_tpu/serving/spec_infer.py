@@ -31,6 +31,7 @@ from __future__ import annotations
 import dataclasses
 import logging
 import os
+import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -170,7 +171,8 @@ def _ssm_prefill(rm, im, ssm_id, states, running, beam_width, seed_rng):
         if not spans:
             break
         max_span = max(len(s) for s in spans.values())
-        chunk = pick_chunk(max_span, rm.max_tokens_per_batch)
+        chunk = pick_chunk(max_span, rm.max_tokens_per_batch,
+                           min_chunk=im.min_prefill_chunk(ssm_id))
         bc = BeamSearchBatchConfig(rm.max_requests_per_batch, chunk,
                                    beam_width=beam_width)
         for row, req in running.items():
@@ -199,7 +201,7 @@ def _ssm_prefill(rm, im, ssm_id, states, running, beam_width, seed_rng):
         outs = im.inference(ssm_id, bc, rng=seed_rng)
         ids, parents, logps = (np.asarray(outs[0]), np.asarray(outs[1]),
                                np.asarray(outs[2]))
-        im.host_syncs += 1
+        im.note_host_sync()
         for row, req in running.items():
             st = states[req.guid]
             span = spans.get(row)
@@ -336,6 +338,7 @@ def generate_spec_infer(rm, im, llm_id: int, requests: Sequence[Request],
         if not rm.running:
             break
         running = dict(rm.running)
+        t_step = time.monotonic()
 
         # ---- LLM prompt prefill: long prompts as linear chains first so
         #      the remaining uncached span fits inside one tree chunk
@@ -376,6 +379,8 @@ def generate_spec_infer(rm, im, llm_id: int, requests: Sequence[Request],
         # iterates all SSMs, request_manager.cc:2031-2042): prefill (row 0
         # only; the beam block broadcasts the prefix cache) + beam
         # expansion to depth D, then merge into the shared tree.
+        rm.tracer.begin("spec-draft", ssms=len(ssm_ids),
+                        rows=len(running))
         for ssm_id in ssm_ids:
             ssm_record = im.models[ssm_id]
             W = beam_width or ssm_record["beam_width"]
@@ -460,15 +465,24 @@ def generate_spec_infer(rm, im, llm_id: int, requests: Sequence[Request],
                         st.beam_nodes, st.beam_logp = new_nodes, new_logp
                         req.profile.ssm_decoding_steps += 1
 
+        rm.tracer.end("spec-draft")
+
         # ---- tree verify step
         bc, _ = _build_tree_batch(rm, im.models[llm_id], states, running,
                                   tree_chunk)
+        for row in range(bc.max_requests):
+            if bc.request_available[row]:
+                rm._m_spec_verify.observe(
+                    int(bc.num_tokens_in_batch[row]))
         rng, r4 = jax.random.split(rng)
-        outs = im.inference(llm_id, bc, rng=r4)
-        greedy = np.asarray(outs[0])  # [rows, chunk] argmax ids
-        im.host_syncs += 1
+        with rm.tracer.span("spec-verify", rows=len(running),
+                            chunk=tree_chunk):
+            outs = im.inference(llm_id, bc, rng=r4)
+            greedy = np.asarray(outs[0])  # [rows, chunk] argmax ids
+            im.note_host_sync()
 
         # ---- acceptance + bookkeeping
+        committed_this_iter = 0
         for row, req in running.items():
             st = states[req.guid]
             nodes = st.tree
@@ -479,6 +493,9 @@ def generate_spec_infer(rm, im, llm_id: int, requests: Sequence[Request],
             req.profile.speculated_tokens += len(nodes) - 1 - root
             req.profile.accepted_tokens += len(acc_tokens)
             req.profile.llm_decoding_steps += 1
+            rm.tracer.instant("commit", guid=req.guid, row=row,
+                              tokens=len(new_tokens),
+                              accepted=len(acc_tokens))
             # chain nodes' KV landed at their final slots already; accepted
             # speculative nodes move from tree slot to committed position
             base = st.llm_cached  # batch slot c -> cache slot base + c
@@ -486,12 +503,14 @@ def generate_spec_infer(rm, im, llm_id: int, requests: Sequence[Request],
             st.commit_dst = [base + root + 1 + i for i in range(len(path))]
             st.llm_cached = base + root + 1 + len(path)
             finished = False
+            n_before = len(req.tokens)
             for tok in new_tokens:
                 req.tokens.append(tok)
                 req.profile.note_first_token()
                 if rm._finished(req, tok):
                     finished = True
                     break
+            committed_this_iter += len(req.tokens) - n_before
             if finished:
                 # donate BEFORE _retire clears req.row: committed KV =
                 # positions below the pending commit list (accepted
@@ -501,4 +520,5 @@ def generate_spec_infer(rm, im, llm_id: int, requests: Sequence[Request],
                                    st.ssm_cached)
                 rm._retire(req)
                 states.pop(req.guid, None)
+        rm._note_step(t_step, committed_this_iter)
     return [rm._result_of(r) for r in requests]

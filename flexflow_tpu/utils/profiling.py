@@ -172,12 +172,13 @@ class KVCacheStats:
 def ttft_percentiles(requests: Sequence[Any],
                      ps: Sequence[int] = (50, 90)) -> Dict[str, float]:
     """Host-observed time-to-first-token percentiles (seconds) over a
-    batch of finished Requests (serving ProfileInfo stamps).  Requests
+    batch of finished Requests (serving ProfileInfo stamps — monotonic
+    clock deltas via ProfileInfo.ttft_s, NTP-jump immune).  Requests
     that never produced a token are skipped."""
     import numpy as np
 
-    ttfts = [r.profile.first_token_time - r.profile.start_time
-             for r in requests if r.profile.first_token_time > 0.0]
+    ttfts = [t for t in (r.profile.ttft_s() for r in requests)
+             if t is not None]
     if not ttfts:
         return {f"p{p}": 0.0 for p in ps}
     return {f"p{p}": float(np.percentile(ttfts, p)) for p in ps}

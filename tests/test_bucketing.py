@@ -74,3 +74,25 @@ class TestPickChunk:
     def test_cap(self):
         assert pick_chunk(300, 256) == 256
         assert pick_chunk(1 << 20, 64) == 64
+
+
+class TestPickChunkFloor:
+    """int8-aware min_chunk (observability PR satellite): multi-token
+    chunks honor a floor so int8 flash-prefill's 32-divisibility holds;
+    decode (needed <= 1) and the cap are untouched."""
+
+    def test_floor_applies_to_prefill_only(self):
+        assert pick_chunk(12, 128) == 16            # bf16 ladder unchanged
+        assert pick_chunk(12, 128, min_chunk=32) == 32
+        assert pick_chunk(2, 128, min_chunk=32) == 32
+        assert pick_chunk(1, 128, min_chunk=32) == 1   # decode stays 1
+        assert pick_chunk(0, 128, min_chunk=32) == 1
+
+    def test_floor_below_ladder_is_inert(self):
+        assert pick_chunk(40, 128, min_chunk=32) == 64
+        assert pick_chunk(100, 256, min_chunk=32) == 128
+
+    def test_cap_still_wins(self):
+        # the compiled cache slack is a hard bound; when it is smaller
+        # than the floor the (counted) XLA fallback is correct behavior
+        assert pick_chunk(12, 16, min_chunk=32) == 16

@@ -305,3 +305,46 @@ def test_spec_infer_runs_on_int8_kv():
             outs[device] = list(req.tokens)
     finally:
         monkey.undo()
+
+
+# ------------------------------------------- int8-aware chunk picking
+def test_int8_prefill_chunk_floor_kills_silent_fallback():
+    """ROADMAP open item closed by the observability PR: the host chunk
+    picker bucketed pow2 >= 16, but int8 flash-prefill needs
+    32-divisible chunks (prefill_path_ok's widened append alignment), so
+    a 16-token chunk on an int8 cache silently fell back to the XLA
+    path.  With the int8-aware floor (min_prefill_chunk -> pick_chunk
+    min_chunk=32) the prefill runs at chunk 32 and the NEW kernel-path
+    counter reads ZERO path-gate fallbacks — the counter is the proof
+    the fallback class is gone, not just the bucket math."""
+    from flexflow_tpu.observability import get_registry
+
+    reg = get_registry()
+    reg.reset()
+    # head_dim 128 + 32-aligned int8 allocation: every OTHER
+    # prefill_path_ok condition holds, so chunk alignment alone decides
+    model = _build_llama("int8_chunk_floor", hidden_size=256,
+                         num_attention_heads=2, num_key_value_heads=2,
+                         intermediate_size=256)
+    im, mid = _compile(model, kv_cache_dtype="int8")
+    assert im.min_prefill_chunk(mid) == 32
+    # a 12-token prompt bucketed to 16 pre-fix; 32 now
+    prompt = np.random.default_rng(3).integers(4, 120, 12).tolist()
+    _greedy(im, mid, prompt, n_new=4)
+    kp = reg.snapshot()["counters"]["serving_kernel_path_total"]
+    labels = kp["labels"] if isinstance(kp, dict) else {}
+    assert any("phase=prefill" in k for k in labels), labels
+    gate_fallbacks = {k: v for k, v in labels.items()
+                      if "phase=prefill" in k and "reason=path_gate" in k}
+    assert not gate_fallbacks, (
+        f"int8 prefill still falls back through the shape gate: "
+        f"{gate_fallbacks}")
+
+
+def test_bf16_prefill_chunk_floor_unchanged():
+    """The floor is int8-only: bf16 records keep min_prefill_chunk 1 so
+    the pow2 >= 16 ladder (and its compiled shape buckets) are
+    bit-identical to pre-PR behavior."""
+    model = _build_llama("bf16_chunk_floor")
+    im, mid = _compile(model)
+    assert im.min_prefill_chunk(mid) == 1

@@ -1,0 +1,448 @@
+"""Serving telemetry tests (flexflow_tpu/observability/).
+
+Pins the PR's acceptance surface:
+
+- MetricsRegistry counter/gauge/histogram semantics (labels, fixed
+  exponential buckets, bucket-interpolated percentiles, in-place reset,
+  schema validation) and the disabled-mode no-op contract;
+- StepTracer output is valid Chrome-trace JSON with properly nested
+  begin/end events, across all three decode drivers (incremental,
+  host speculative, device speculative), and tools/trace_summary.py
+  loads it;
+- the spec acceptance-rate counters match distill.measured_acceptance
+  over the same requests;
+- dump_profiles round-trips (JSONL parse, monotonic-delta latencies,
+  idempotent on repeat calls).
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from flexflow_tpu import FFConfig, Model
+from flexflow_tpu.fftype import InferenceMode
+from flexflow_tpu.models.llama import LLAMAConfig, create_llama_model
+from flexflow_tpu.observability import (METRICS_SCHEMA, MetricsRegistry,
+                                        StepTracer, get_registry,
+                                        get_tracer)
+from flexflow_tpu.serving import InferenceManager, RequestManager
+from flexflow_tpu.serving.distill import measured_acceptance
+from flexflow_tpu.serving.spec_infer import generate_spec_infer
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+TINY = dict(vocab_size=128, hidden_size=64, intermediate_size=128,
+            num_hidden_layers=2, num_attention_heads=4,
+            num_key_value_heads=2, max_position_embeddings=512)
+
+
+def _build_llama(name, seed=1, mode=InferenceMode.INC_DECODING,
+                 max_requests=2, **over):
+    cfg = LLAMAConfig(**{**TINY, **over})
+    model = Model(FFConfig(seed=seed), name=name)
+    create_llama_model(model, cfg, mode=mode, max_requests=max_requests)
+    return model
+
+
+# ------------------------------------------------------------- registry
+class TestRegistry:
+    def test_counter_labels_and_totals(self):
+        reg = MetricsRegistry()     # permissive (no schema) for units
+        c = reg.counter("c")
+        c.inc()
+        c.inc(2, path="flash")
+        c.inc(path="xla", reason="path_gate")
+        assert c.value() == 4
+        assert c.value(path="flash") == 2
+        assert c.value(path="xla", reason="path_gate") == 1
+        snap = c.snapshot()
+        assert snap["total"] == 4
+        assert snap["labels"]["path=flash"] == 2
+        assert snap["labels"]["path=xla,reason=path_gate"] == 1
+
+    def test_counter_without_labels_snapshots_scalar(self):
+        reg = MetricsRegistry()
+        c = reg.counter("c")
+        c.inc(3)
+        assert c.snapshot() == 3
+
+    def test_gauge_last_set_wins(self):
+        reg = MetricsRegistry()
+        g = reg.gauge("g")
+        g.set(1.5)
+        g.set(2.5)
+        assert g.value() == 2.5
+        g.set(7, model=0)
+        assert g.value(model=0) == 7
+
+    def test_histogram_buckets_and_percentiles(self):
+        reg = MetricsRegistry()
+        h = reg.histogram("h", buckets=(1.0, 2.0, 4.0, 8.0))
+        for v in (0.5, 1.5, 1.6, 3.0, 9.0):
+            h.observe(v)
+        s = h.snapshot()
+        assert s["count"] == 5 and s["min"] == 0.5 and s["max"] == 9.0
+        assert s["buckets"] == {"le_1": 1, "le_2": 2, "le_4": 1,
+                                "overflow": 1}
+        # percentiles stay within the observed range and are ordered
+        p50, p90, p99 = (h.percentile(p) for p in (50, 90, 99))
+        assert 0.5 <= p50 <= p90 <= p99 <= 9.0
+
+    def test_histogram_exponential_default_ladder(self):
+        from flexflow_tpu.observability import exp_buckets
+
+        b = exp_buckets(start=1e-4, factor=2.0, count=5)
+        assert b == (1e-4, 2e-4, 4e-4, 8e-4, 16e-4)
+        reg = MetricsRegistry()
+        h = reg.histogram("h")
+        assert h.buckets[0] == pytest.approx(1e-4)
+        assert h.buckets[1] / h.buckets[0] == pytest.approx(2.0)
+
+    def test_schema_validation(self):
+        reg = MetricsRegistry(schema=METRICS_SCHEMA)
+        reg.counter("serving_host_syncs_total")        # declared: fine
+        with pytest.raises(ValueError):
+            reg.counter("serving_totally_undeclared_total")
+        with pytest.raises(TypeError):
+            reg.gauge("serving_host_syncs_total")      # declared counter
+        # schema-declared buckets apply (acceptance rate is 0-1 ratio)
+        h = reg.histogram("serving_spec_acceptance_rate")
+        assert h.buckets[-1] == 1.0
+
+    def test_same_name_returns_same_instance(self):
+        reg = MetricsRegistry()
+        assert reg.counter("c") is reg.counter("c")
+        with pytest.raises(TypeError):
+            reg.gauge("c")
+
+    def test_reset_in_place_keeps_handles(self):
+        reg = MetricsRegistry()
+        c, h = reg.counter("c"), reg.histogram("h")
+        c.inc(5)
+        h.observe(1.0)
+        reg.reset()
+        assert c.value() == 0 and h.count == 0
+        c.inc()                      # the pre-reset handle still works
+        assert reg.counter("c").value() == 1
+
+    def test_disabled_mode_is_a_noop(self):
+        reg = MetricsRegistry(enabled=False)
+        c, g, h = reg.counter("c"), reg.gauge("g"), reg.histogram("h")
+        for _ in range(100):
+            c.inc()
+            g.set(1.0)
+            h.observe(0.5)
+        assert c.value() == 0 and g.value() == 0 and h.count == 0
+        reg.enable()
+        c.inc()
+        assert c.value() == 1
+
+
+# --------------------------------------------------------------- tracer
+def _assert_valid_chrome_trace(path):
+    """The acceptance gate: loadable JSON, traceEvents list, B/E pairs
+    properly nested (LIFO per thread) and every span closed."""
+    with open(path) as f:
+        doc = json.load(f)
+    events = doc["traceEvents"]
+    assert isinstance(events, list) and events
+    stacks = {}
+    for ev in events:
+        assert {"ph", "name", "ts", "pid", "tid"} <= set(ev), ev
+        if ev["ph"] == "B":
+            stacks.setdefault(ev["tid"], []).append(ev["name"])
+        elif ev["ph"] == "E":
+            st = stacks.get(ev["tid"])
+            assert st, f"E without B: {ev}"
+            assert st[-1] == ev["name"], (
+                f"unnested E {ev['name']!r}; open stack {st}")
+            st.pop()
+    assert all(not st for st in stacks.values()), stacks
+    return events
+
+
+class TestTracer:
+    def test_span_nesting_and_instants(self, tmp_path):
+        tr = StepTracer()
+        p = str(tmp_path / "t.json")
+        with tr.trace(p):
+            with tr.span("decode-step", block=4):
+                with tr.span("prefill-chunk", chunk=8):
+                    tr.instant("admit", guid=1)
+        events = _assert_valid_chrome_trace(p)
+        names = [(e["ph"], e["name"]) for e in events]
+        assert names == [("B", "decode-step"), ("B", "prefill-chunk"),
+                         ("i", "admit"), ("E", "prefill-chunk"),
+                         ("E", "decode-step")]
+        assert events[0]["args"] == {"block": 4}
+
+    def test_inactive_tracer_allocates_nothing(self):
+        tr = StepTracer()
+        s1 = tr.span("decode-step", block=4)
+        s2 = tr.span("spec-verify")
+        assert s1 is s2                 # the shared null context manager
+        tr.instant("admit")
+        tr.begin("spec-draft")
+        tr.end("spec-draft")
+        assert tr.events() == []
+
+    def test_begin_end_pairs(self, tmp_path):
+        tr = StepTracer()
+        p = str(tmp_path / "t.json")
+        with tr.trace(p):
+            tr.begin("spec-draft", ssms=1)
+            tr.instant("commit", tokens=3)
+            tr.end("spec-draft")
+        _assert_valid_chrome_trace(p)
+
+
+# ----------------------------------------------- drivers emit telemetry
+def _run_incr(trace_path, prefix_cache=False):
+    model = _build_llama("obs_incr", seed=3)
+    im = InferenceManager(model.config)
+    mid = im.compile_model_and_allocate_buffer(
+        model, max_requests=2, max_seq_length=256, prefill_chunk=128)
+    rm = RequestManager(max_requests_per_batch=2, max_tokens_per_batch=128,
+                        max_sequence_length=256, decode_block=8,
+                        prefix_cache=prefix_cache)
+    with get_tracer().trace(trace_path):
+        reqs = [rm.register_new_request(list(range(4, 24)),
+                                        max_new_tokens=8)
+                for _ in range(2)]
+        rm.generate_incr_decoding(im, mid, reqs)
+    return im, rm, reqs
+
+
+def _run_spec(trace_path, device: bool, monkeypatch):
+    monkeypatch.setenv("FF_SPEC_DEVICE", "1" if device else "0")
+    llm = _build_llama("obs_spec_llm", seed=5,
+                       mode=InferenceMode.TREE_VERIFY, max_requests=2)
+    ssm = _build_llama("obs_spec_ssm", seed=6,
+                       mode=InferenceMode.BEAM_SEARCH, max_requests=2)
+    im = InferenceManager(llm.config)
+    llm_id = im.compile_model_and_allocate_buffer(
+        llm, mode=InferenceMode.TREE_VERIFY, max_requests=2,
+        max_seq_length=256, cache_dtype=np.float32)
+    ssm_id = im.compile_model_and_allocate_buffer(
+        ssm, mode=InferenceMode.BEAM_SEARCH, max_requests=2,
+        max_seq_length=256, beam_width=2, cache_dtype=np.float32)
+    rm = RequestManager(max_requests_per_batch=2, max_tokens_per_batch=64,
+                        max_sequence_length=256,
+                        max_spec_tree_token_num=24)
+    rm.register_ssm_model(ssm_id)
+    with get_tracer().trace(trace_path):
+        reqs = [rm.register_new_request([3, 5, 9, 2], max_new_tokens=6)
+                for _ in range(2)]
+        generate_spec_infer(rm, im, llm_id, reqs, beam_width=2,
+                            beam_depth=3)
+    return im, rm, reqs
+
+
+class TestDriversEmit:
+    def test_incr_driver(self, tmp_path):
+        reg = get_registry()
+        reg.reset()
+        p = str(tmp_path / "incr.json")
+        im, rm, reqs = _run_incr(p)
+        events = _assert_valid_chrome_trace(p)
+        names = {e["name"] for e in events}
+        assert "admit" in names and "decode-step" in names
+        assert "prefill-chunk" in names   # 20-token prompt chunks
+        snap = reg.snapshot()
+        # the acceptance-criteria snapshot surface
+        assert snap["gauges"]["serving_queue_depth"] == 0
+        assert snap["gauges"]["serving_batch_occupancy"] == 1.0
+        assert snap["counters"]["serving_requests_admitted_total"] == 2
+        assert snap["counters"]["serving_requests_retired_total"] == 2
+        assert snap["counters"]["serving_tokens_generated_total"] == 16
+        assert snap["counters"]["serving_host_syncs_total"] \
+            == im.host_syncs > 0
+        lat = snap["histograms"]["serving_step_latency_seconds"]
+        assert lat["count"] > 0
+        assert 0 < lat["p50"] <= lat["p90"] <= lat["p99"]
+        assert snap["histograms"]["serving_ttft_seconds"]["count"] == 2
+        kp = snap["counters"]["serving_kernel_path_total"]
+        assert kp["total"] > 0          # every step's decision counted
+
+    @pytest.mark.parametrize("device", [False, True],
+                             ids=["host-spec", "device-spec"])
+    def test_spec_drivers(self, tmp_path, monkeypatch, device):
+        reg = get_registry()
+        reg.reset()
+        p = str(tmp_path / f"spec_{device}.json")
+        im, rm, reqs = _run_spec(p, device, monkeypatch)
+        events = _assert_valid_chrome_trace(p)
+        names = {e["name"] for e in events}
+        assert "admit" in names and "spec-verify" in names
+        if device:
+            assert "prefill-chunk" in names   # prompt prefill spans
+        else:
+            assert "spec-draft" in names and "commit" in names
+        snap = reg.snapshot()
+        # acceptance-rate counters match the profile-derived value the
+        # bench/quality tooling computes (distill.measured_acceptance)
+        drafted = snap["counters"]["serving_spec_draft_tokens_total"]
+        accepted = snap["counters"]["serving_spec_accepted_tokens_total"]
+        assert drafted == sum(r.profile.speculated_tokens for r in reqs)
+        assert accepted == sum(r.profile.accepted_tokens for r in reqs)
+        assert drafted > 0
+        assert accepted / drafted == pytest.approx(
+            measured_acceptance(reqs))
+        rate = snap["histograms"]["serving_spec_acceptance_rate"]
+        assert rate["count"] == len(reqs)
+        assert snap["counters"]["serving_host_syncs_total"] \
+            == im.host_syncs > 0
+        assert snap["histograms"]["serving_step_latency_seconds"][
+            "count"] > 0
+
+    def test_trace_summary_tool_loads_all_drivers(self, tmp_path,
+                                                  monkeypatch):
+        """tools/trace_summary.py parses real traces from the three
+        drivers and prints a per-phase breakdown (rc 0)."""
+        paths = [str(tmp_path / "a.json"), str(tmp_path / "b.json"),
+                 str(tmp_path / "c.json")]
+        _run_incr(paths[0])
+        _run_spec(paths[1], False, monkeypatch)
+        _run_spec(paths[2], True, monkeypatch)
+        out = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools",
+                                          "trace_summary.py")] + paths,
+            capture_output=True, text=True)
+        assert out.returncode == 0, out.stderr
+        assert "decode-step" in out.stdout or "spec-verify" in out.stdout
+        assert "phase" in out.stdout
+
+    def test_prefix_cache_counters_reemitted(self, tmp_path):
+        reg = get_registry()
+        reg.reset()
+        model = _build_llama("obs_prefix", seed=9, max_requests=2)
+        im = InferenceManager(model.config)
+        mid = im.compile_model_and_allocate_buffer(
+            model, max_requests=2, max_seq_length=256, prefill_chunk=128)
+        rm = RequestManager(max_requests_per_batch=2,
+                            max_tokens_per_batch=128,
+                            max_sequence_length=256, decode_block=8,
+                            prefix_cache=True)
+        shared = list(range(4, 40))       # 36 >= min_match after align
+        with get_tracer().trace(str(tmp_path / "p.json")):
+            for tail in ([77, 78], [88, 89]):
+                req = rm.register_new_request(shared + tail,
+                                              max_new_tokens=4)
+                rm.generate_incr_decoding(im, mid, [req])
+        snap = reg.snapshot()["counters"]
+        stats = rm.prefix_cache.stats
+        assert snap["serving_prefix_lookups_total"] == stats.lookups == 2
+        assert snap["serving_prefix_hits_total"] == stats.hits == 1
+        assert (snap["serving_prefix_tokens_matched_total"]
+                == stats.tokens_matched > 0)
+        assert (snap["serving_prefix_donations_total"]
+                == stats.donations >= 1)
+        events = _assert_valid_chrome_trace(str(tmp_path / "p.json"))
+        names = {e["name"] for e in events}
+        assert "donate" in names and "prefix-match" in names
+
+
+# ------------------------------------------------------- dump_profiles
+def test_dump_profiles_roundtrip(tmp_path):
+    model = _build_llama("obs_dump", seed=11)
+    im = InferenceManager(model.config)
+    mid = im.compile_model_and_allocate_buffer(
+        model, max_requests=2, max_seq_length=256, prefill_chunk=128)
+    rm = RequestManager(max_requests_per_batch=2, max_tokens_per_batch=128,
+                        max_sequence_length=256, decode_block=8)
+    reqs = [rm.register_new_request(list(range(4, 12)), max_new_tokens=5)
+            for _ in range(2)]
+    rm.generate_incr_decoding(im, mid, reqs)
+    path = str(tmp_path / "profiles.jsonl")
+    rm.dump_profiles(path)
+    with open(path) as f:
+        rows = [json.loads(line) for line in f]
+    assert len(rows) == 2
+    by_guid = {r["guid"]: r for r in rows}
+    for req in reqs:
+        row = by_guid[req.guid]
+        assert row["output_len"] == len(req.tokens) - req.prompt_len
+        # monotonic deltas: finite, ordered, non-negative
+        assert row["latency_s"] >= row["ttft_s"] >= 0
+        assert row["latency_s"] == pytest.approx(req.profile.latency_s())
+        assert row["start_time_unix"] == req.profile.start_time > 0
+    # idempotent: a second periodic dump appends no duplicates
+    rm.dump_profiles(path)
+    with open(path) as f:
+        assert len(f.readlines()) == 2
+
+
+def test_profile_clocks_are_split():
+    """The NTP-jump fix: start_time stays wall clock (logging), every
+    delta ingredient is monotonic."""
+    import time as _time
+
+    from flexflow_tpu.serving.request_manager import Request
+
+    req = Request(1, "", [1, 2, 3], 4, 64)
+    p = req.profile
+    assert abs(p.start_time - _time.time()) < 5          # wall clock
+    assert abs(p.start_mono - _time.monotonic()) < 5     # monotonic
+    assert p.ttft_s() is None
+    p.note_first_token()
+    first = p.first_token_time
+    p.note_first_token()                                  # sticky
+    assert p.first_token_time == first
+    assert p.ttft_s() >= 0
+
+
+# ------------------------------------------------- disabled-mode bench
+def test_disabled_registry_leaves_serving_untouched(tmp_path):
+    """FF_TELEMETRY=0 semantics: with the registry disabled and no
+    trace active, a full generate leaves zero telemetry state and
+    produces identical tokens (the < 2% bench-overhead gate's
+    functional half)."""
+    reg = get_registry()
+    reg.reset()
+    model = _build_llama("obs_disabled", seed=13)
+    im = InferenceManager(model.config)
+    mid = im.compile_model_and_allocate_buffer(
+        model, max_requests=2, max_seq_length=256, prefill_chunk=128)
+
+    def gen():
+        rm = RequestManager(max_requests_per_batch=2,
+                            max_tokens_per_batch=128,
+                            max_sequence_length=256, decode_block=8)
+        reqs = [rm.register_new_request(list(range(4, 12)),
+                                        max_new_tokens=6)
+                for _ in range(2)]
+        rm.generate_incr_decoding(im, mid, reqs)
+        return [list(r.tokens) for r in reqs]
+
+    baseline = gen()
+    reg.reset()
+    reg.disable()
+    try:
+        toks = gen()
+        snap = reg.snapshot()
+        assert toks == baseline
+        assert all(v == 0 or v == {} or v.get("count") == 0
+                   for group in snap.values() for v in group.values()), snap
+    finally:
+        reg.enable()
+    # host_syncs odometer still ticks when the registry is off (tests
+    # and bench pin against the per-manager int)
+    assert im.host_syncs > 0
+
+
+def test_serve_api_exposes_snapshot_and_trace():
+    """The public serve surface: LLM.metrics_snapshot / LLM.trace exist
+    and delegate to the process-wide registry/tracer (full-stack use is
+    covered by the driver tests above; LLM construction needs HF
+    fixtures these unit tests avoid)."""
+    from flexflow_tpu.serve.serve import LLM
+
+    assert callable(LLM.metrics_snapshot) and callable(LLM.trace)
+    snap = LLM.metrics_snapshot(object.__new__(LLM))
+    assert set(snap) == {"counters", "gauges", "histograms"}
+    cm = LLM.trace(object.__new__(LLM), "/tmp/_unused_trace.json")
+    assert hasattr(cm, "__enter__") and hasattr(cm, "__exit__")

@@ -22,7 +22,9 @@ calls ``np.asarray(ARG)`` and ARG's leading expression is either
 
 Host-side conversions (``np.asarray(bc.…)``, batch dicts, feed helpers)
 do not match and are ignored; ``jnp.asarray`` never syncs.  Every
-device-fetch site must have a ``host_syncs += 1`` within
+device-fetch site must have a ``note_host_sync(`` call (the
+registry-backed odometer tick — serving code must not bump
+``host_syncs`` directly, see tools/check_metrics_schema.py) within
 ±``WINDOW`` (3) lines — several fetches of one dispatch's results may
 share a single tick (one round trip).  A knowingly-unsynced site can be
 annotated ``# no-sync: <why>`` on the same line.
@@ -43,7 +45,7 @@ DEVICE_NAMES = ("out", "outs", "packed", "toks", "toks_dev", "parents",
 FETCH_RE = re.compile(
     r"np\.asarray\(\s*(?:(?:%s)\b|im\.(?:inference|decode_block|"
     r"beam_block)\()" % "|".join(DEVICE_NAMES))
-SYNC_RE = re.compile(r"host_syncs\s*\+=\s*1")
+SYNC_RE = re.compile(r"note_host_sync\(|host_syncs\s*\+=\s*1")
 PRAGMA_RE = re.compile(r"#\s*no-sync\b")
 
 
@@ -72,7 +74,7 @@ def main(argv):
                 bad.extend(check_file(os.path.join(dirpath, name)))
     for path, lineno, text in bad:
         print(f"{path}:{lineno}: np.asarray on a device output without "
-              f"a host_syncs += 1 within {WINDOW} lines:\n    {text}")
+              f"a note_host_sync() within {WINDOW} lines:\n    {text}")
     if bad:
         print(f"check_host_syncs: {len(bad)} unsynced device fetch"
               f"{'es' if len(bad) != 1 else ''} (annotate '# no-sync: "

@@ -4,8 +4,12 @@
 # Exit code is pytest's; DOTS_PASSED=<n> on stdout is the passed-test
 # count parsed from the dot-line output.
 #
-# Static pre-gate: every np.asarray-on-device-output in
-# flexflow_tpu/serving/ must tick the host-sync odometer (the metric
-# the decode-block tests pin) — fails fast before the test run.
+# Static pre-gates (fail fast before the test run):
+# - every np.asarray-on-device-output in flexflow_tpu/serving/ must tick
+#   the host-sync odometer (the metric the decode-block tests pin);
+# - every metric name emitted in the serving stack must be declared in
+#   observability/schema.py, and no serving module may bump host_syncs
+#   directly (must go through im.note_host_sync -> registry counter).
 python "$(dirname "$0")/check_host_syncs.py" || exit 1
+python "$(dirname "$0")/check_metrics_schema.py" || exit 1
 set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c); exit $rc

@@ -1,0 +1,128 @@
+#!/usr/bin/env python
+"""Per-phase time breakdown of a serving step trace.
+
+Reads a Chrome-trace JSON written by ``StepTracer.save`` (or
+``serve.LLM.trace`` / ``tools`` smoke runs), pairs B/E events per
+thread, and prints one line per phase name: count, total/mean/max wall
+time and the share of the traced span.  Instant events ("i") are
+reported by count.  Complete ("X") events with ``dur`` are summed too,
+so traces from other producers load as well.
+
+Usage:  python tools/trace_summary.py TRACE.json [TRACE2.json ...]
+
+Exit 1 on an unreadable or event-less file — the smoke tests use this
+as the "trace is loadable" gate.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from collections import defaultdict
+from typing import Any, Dict, List
+
+
+def load_events(path: str) -> List[Dict[str, Any]]:
+    with open(path) as f:
+        doc = json.load(f)
+    events = doc["traceEvents"] if isinstance(doc, dict) else doc
+    if not isinstance(events, list):
+        raise ValueError(f"{path}: no traceEvents list")
+    return events
+
+
+def summarize(events: List[Dict[str, Any]]) -> Dict[str, Dict[str, Any]]:
+    """Phase name -> {count, total_us, max_us} for spans; instants get
+    {count}.  Unbalanced B events (a crash mid-span) are reported with
+    an ``open`` count instead of being silently dropped."""
+    spans: Dict[str, Dict[str, Any]] = defaultdict(
+        lambda: {"count": 0, "total_us": 0.0, "max_us": 0.0, "open": 0})
+    instants: Dict[str, int] = defaultdict(int)
+    stacks: Dict[Any, List] = defaultdict(list)   # tid -> [(name, ts)]
+    for ev in events:
+        ph, name = ev.get("ph"), ev.get("name", "?")
+        if ph == "B":
+            stacks[ev.get("tid")].append((name, ev["ts"]))
+        elif ph == "E":
+            stack = stacks[ev.get("tid")]
+            # pop to the matching name: tolerates producers that close
+            # out of order rather than corrupting every later pairing
+            while stack:
+                b_name, b_ts = stack.pop()
+                if b_name == name:
+                    dur = ev["ts"] - b_ts
+                    s = spans[name]
+                    s["count"] += 1
+                    s["total_us"] += dur
+                    s["max_us"] = max(s["max_us"], dur)
+                    break
+        elif ph == "X":
+            dur = float(ev.get("dur", 0.0))
+            s = spans[name]
+            s["count"] += 1
+            s["total_us"] += dur
+            s["max_us"] = max(s["max_us"], dur)
+        elif ph == "i":
+            instants[name] += 1
+    for stack in stacks.values():
+        for b_name, _ in stack:
+            spans[b_name]["open"] += 1
+    out = dict(spans)
+    for name, n in instants.items():
+        out.setdefault(name, {"count": 0})["instants"] = n
+    return out
+
+
+def format_summary(summary: Dict[str, Dict[str, Any]],
+                   wall_us: float) -> str:
+    lines = [f"{'phase':<16} {'count':>7} {'total ms':>10} "
+             f"{'mean ms':>9} {'max ms':>9} {'%wall':>6}"]
+    for name, s in sorted(summary.items(),
+                          key=lambda kv: -kv[1].get("total_us", 0.0)):
+        total = s.get("total_us", 0.0)
+        count = s.get("count", 0)
+        cells = [f"{name:<16}", f"{count:>7}"]
+        if count:
+            cells += [f"{total / 1e3:>10.3f}",
+                      f"{total / count / 1e3:>9.3f}",
+                      f"{s.get('max_us', 0.0) / 1e3:>9.3f}",
+                      f"{100 * total / max(wall_us, 1e-9):>5.1f}%"]
+        else:
+            cells += [f"{'-':>10}", f"{'-':>9}", f"{'-':>9}", f"{'-':>6}"]
+        extra = []
+        if s.get("instants"):
+            extra.append(f"instants={s['instants']}")
+        if s.get("open"):
+            extra.append(f"UNCLOSED={s['open']}")
+        lines.append(" ".join(cells) + ("  " + " ".join(extra)
+                                        if extra else ""))
+    return "\n".join(lines)
+
+
+def main(argv) -> int:
+    if len(argv) < 2:
+        print(__doc__.strip(), file=sys.stderr)
+        return 1
+    rc = 0
+    for path in argv[1:]:
+        try:
+            events = load_events(path)
+        except Exception as e:
+            print(f"{path}: unreadable trace ({type(e).__name__}: {e})",
+                  file=sys.stderr)
+            rc = 1
+            continue
+        if not events:
+            print(f"{path}: trace holds no events", file=sys.stderr)
+            rc = 1
+            continue
+        ts = [ev["ts"] for ev in events if "ts" in ev]
+        wall = (max(ts) - min(ts)) if len(ts) > 1 else 0.0
+        print(f"== {path}  ({len(events)} events, "
+              f"{wall / 1e3:.3f} ms traced span)")
+        print(format_summary(summarize(events), wall))
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))
