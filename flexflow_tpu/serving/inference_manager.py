@@ -462,6 +462,7 @@ class InferenceManager:
         self._beam_variants: Dict[int, Dict[int, Dict[str, Any]]] = {}
         # serving telemetry (observability/)
         m = get_registry()
+        self._registry = m
         self.tracer = get_tracer()
         self._c_host_syncs = m.counter("serving_host_syncs_total")
         self._c_kernel_path = m.counter("serving_kernel_path_total")
@@ -729,6 +730,12 @@ class InferenceManager:
         diverge.  The cache label splits the int8 arm from the
         full-precision arm in cumulative (multi-record) snapshots —
         bench.py kvdtype runs both in one process."""
+        if not self._registry.enabled:
+            # disabled-mode contract (FF_TELEMETRY=0, the <2%-overhead
+            # bench gate): bail before deriving the reason label — the
+            # env lookup + label kwargs would otherwise run per STEP in
+            # the hot driver loop only for inc() to drop them
+            return
         self._c_kernel_path.inc(
             phase="decode" if chunk == 1 else "prefill",
             path="flash" if use else "xla",
@@ -1082,6 +1089,9 @@ class InferenceManager:
 
         def copy(caches, src, dst):
             def cp(c):
+                # fflint: disable=retrace-hazard  rank dispatch over the
+                # record's FIXED cache pytree ([R,KV,S] scale leaves vs
+                # [R,KV,S,D] K/V) — one variant per record, not per call
                 if c.ndim == 3:      # [R, KV, S] scale rows (int8 caches)
                     seg = jax.lax.dynamic_slice(
                         c, (src, 0, 0), (1, c.shape[1], L))

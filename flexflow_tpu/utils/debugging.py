@@ -1,20 +1,102 @@
-"""Inference debugging: per-op tensor dumps.
+"""Inference debugging: per-op tensor dumps + the retrace guard.
 
 TPU-native equivalent of the reference's ``--inference-debugging`` mode
 (``Op::save_inference_tensors_to_file``, src/runtime/operator.cc:29, call
 sites like linear.cc:663-673): every op's inputs, weights and outputs are
 written to files for offline diffing against another implementation.
+
+``retrace_guard`` is the DYNAMIC oracle for fflint's static
+``retrace-hazard`` rule (docs/STATIC_ANALYSIS.md): it counts actual XLA
+compilations via ``jax.monitoring`` events, so a test can pin a warmed
+decode loop to ZERO recompiles — the invariant the static rule
+approximates at the AST level.
 """
 
 from __future__ import annotations
 
+import contextlib
 import os
-from typing import Any, Dict, List
+from typing import Any, Dict, List, Optional
 
 import jax
 import numpy as np
 
 from .eager import eager_layer_walk
+
+
+class RetraceCounter:
+    """Mutable compile counter a ``retrace_guard`` block exposes."""
+
+    def __init__(self):
+        self.compiles = 0
+        self.events: List[str] = []
+        self.active = True
+
+
+@contextlib.contextmanager
+def retrace_guard(max_compiles: Optional[int] = 0):
+    """Count XLA compilations inside the block; raise if they exceed
+    ``max_compiles`` (None = count only, never raise).
+
+    Test-only: registers a ``jax.monitoring`` duration listener and
+    counts ``backend_compile`` events — a jit cache HIT emits nothing,
+    a miss (first trace or a RETRACE from an unbucketed shape / weak
+    Python scalar in the cache key) emits one per compiled program.
+    This is compilation-cache-miss counting, not wall clock, so the pin
+    is exact and deterministic.
+
+    Usage::
+
+        with retrace_guard() as g:      # pins 0 compiles
+            run_warmed_decode_loop()
+        assert g.compiles == 0          # already enforced on exit
+
+    Callers must warm the loop first (the first call legitimately
+    compiles).  If the installed JAX emits no monitoring events at all,
+    ``g.compiles`` stays 0 — tests should first prove signal with a
+    fresh compile under ``retrace_guard(max_compiles=None)`` and skip
+    when none is seen.
+    """
+    try:
+        from jax import monitoring
+    except ImportError:                              # very old JAX
+        from jax._src import monitoring  # type: ignore
+    # the public module re-exports register but (on some versions) not
+    # the private unregister — resolve the latter where it lives, or the
+    # guard would leak one dead listener per use into JAX's global list
+    try:
+        from jax._src import monitoring as _monitoring_impl
+    except ImportError:
+        _monitoring_impl = monitoring
+
+    guard = RetraceCounter()
+
+    def _on_event(name: str, duration: float = 0.0, **kw):
+        if guard.active and "backend_compile" in name:
+            guard.compiles += 1
+            guard.events.append(name)
+
+    monitoring.register_event_duration_secs_listener(_on_event)
+    try:
+        yield guard
+    finally:
+        guard.active = False
+        unregister = getattr(
+            _monitoring_impl,
+            "_unregister_event_duration_listener_by_callback", None)
+        if unregister is not None:
+            try:
+                unregister(_on_event)
+            except Exception:
+                pass                     # inert: guard.active gates it
+    if max_compiles is not None and guard.compiles > max_compiles:
+        raise AssertionError(
+            f"retrace_guard: {guard.compiles} XLA compilation(s) inside "
+            f"a block pinned to {max_compiles} — a jit cache key is "
+            f"unstable (unbucketed shape, weak Python scalar, or a "
+            f"Python branch on a traced value; see fflint "
+            f"retrace-hazard in docs/STATIC_ANALYSIS.md). Events: "
+            f"{guard.events}")
 
 
 def save_inference_tensors(model, params, input_values: Dict[str, Any],

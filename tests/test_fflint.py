@@ -1,0 +1,896 @@
+"""fflint framework + rule tests: fixture snippets per rule.
+
+Each rule gets a seeded-positive fixture (asserting the EXACT rule id
+and line), a clean negative, and suppression coverage; the framework
+gets suppression-parsing, baseline round-trip and CLI exit-code tests.
+
+Everything here is pure-AST: the fixtures are written to tmp_path and
+linted with an injected metrics schema, so no fixture ever imports JAX
+(test_fflint_imports_no_jax pins that property for the tool itself —
+the tier-1 pre-gate must stay milliseconds-fast).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from tools.fflint import (LintContext, apply_baseline, lint_file,  # noqa: E402
+                          lint_paths, load_baseline, write_baseline)
+from tools.fflint.rules import ALL_RULES  # noqa: E402
+from tools.fflint.rules.direct_host_sync import DirectHostSyncRule  # noqa: E402
+from tools.fflint.rules.donation import DonationRule  # noqa: E402
+from tools.fflint.rules.host_sync import HostSyncRule  # noqa: E402
+from tools.fflint.rules.metric_schema import MetricSchemaRule  # noqa: E402
+from tools.fflint.rules.pallas_tiling import PallasTilingRule  # noqa: E402
+from tools.fflint.rules.retrace import RetraceRule  # noqa: E402
+
+SCHEMA = {
+    "serving_widgets_total": {"type": "counter", "help": "x"},
+    "serving_queue_depth": {"type": "gauge", "help": "x"},
+}
+
+
+def lint(tmp_path, src, rules, rel="serving/mod.py", schema=SCHEMA):
+    """Write ``src`` under tmp_path/rel and lint it with ``rules``."""
+    path = tmp_path / rel
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(textwrap.dedent(src))
+    ctx = LintContext(repo_root=str(tmp_path), schema=schema)
+    return lint_file(str(path), rules, ctx, rel=rel)
+
+
+def at(findings, rule, line):
+    """The findings with this rule id anchored at this 1-based line."""
+    return [f for f in findings if f.rule == rule and f.line == line]
+
+
+# ------------------------------------------------------------ host sync
+class TestHostSyncRule:
+    R = [HostSyncRule()]
+
+    def test_alias_bound_fetch_without_sync_is_flagged(self, tmp_path):
+        # the class the old ±3-line window could NOT see: the dispatch
+        # and the fetch are far apart, connected only by an alias
+        fs = lint(tmp_path, """\
+            import numpy as np
+
+            def drive(im, mid, bc, rng):
+                outs = im.inference(mid, bc, rng)
+                alias = outs
+                x = alias[0][:, 0]
+                a = 1
+                b = 2
+                c = 3
+                d = 4
+                toks = np.asarray(x)
+                return toks
+            """, self.R)
+        assert at(fs, "host-sync-dataflow", 11), fs
+        assert len(fs) == 1
+
+    def test_direct_dispatch_materialization_flagged(self, tmp_path):
+        fs = lint(tmp_path, """\
+            import numpy as np
+
+            def drive(im, mid, bc, k, rng):
+                toks = np.asarray(im.decode_block(mid, bc, k, rng))
+                return toks
+            """, self.R)
+        assert at(fs, "host-sync-dataflow", 4), fs
+
+    def test_adjacent_sync_statement_is_clean(self, tmp_path):
+        fs = lint(tmp_path, """\
+            import numpy as np
+
+            def drive(im, mid, bc, rng):
+                outs = im.inference(mid, bc, rng)
+                toks = np.asarray(outs[0])
+                im.note_host_sync()
+                ids = np.asarray(outs[1])      # shares the region tick
+                n = int(toks[0])               # host value: never taints
+                return toks, ids, n
+            """, self.R)
+        assert fs == []
+
+    def test_sync_before_fetch_statement_counts(self, tmp_path):
+        fs = lint(tmp_path, """\
+            import numpy as np
+
+            def drive(im, mid, bc, rng):
+                outs = im.inference(mid, bc, rng)
+                im.note_host_sync()
+                return (np.asarray(outs[0]), np.asarray(outs[1]))
+            """, self.R)
+        assert fs == []
+
+    def test_conditional_sync_does_not_cover(self, tmp_path):
+        # a tick buried in an adjacent if-body executes conditionally —
+        # it must NOT satisfy an unconditional fetch (old-window false
+        # pass)
+        fs = lint(tmp_path, """\
+            import numpy as np
+
+            def drive(im, mid, bc, rng, flag):
+                outs = im.inference(mid, bc, rng)
+                if flag:
+                    im.note_host_sync()
+                toks = np.asarray(outs[0])
+                return toks
+            """, self.R)
+        assert at(fs, "host-sync-dataflow", 7), fs
+
+    def test_int_float_item_of_tainted_flagged(self, tmp_path):
+        fs = lint(tmp_path, """\
+            import numpy as np
+
+            def drive(im, mid, bc, rng):
+                outs = im.inference(mid, bc, rng)
+                pad = 0
+                n = int(outs[0].max())
+                pad2 = 0
+                v = float(outs[1][0])
+                pad3 = 0
+                s = outs[2].item()
+                return n, v, s
+            """, self.R)
+        assert at(fs, "host-sync-dataflow", 6), fs
+        assert at(fs, "host-sync-dataflow", 8), fs
+        assert at(fs, "host-sync-dataflow", 10), fs
+
+    def test_beam_block_results_are_host_side(self, tmp_path):
+        # im.beam_block syncs internally and returns numpy — downstream
+        # int()/float() bookkeeping must not require another tick
+        fs = lint(tmp_path, """\
+            import numpy as np
+
+            def drive(im, mid, bc, rng):
+                toks_h, parents_h, cums_h = im.beam_block(mid, bc, 4, rng)
+                pb = int(parents_h[0, 0])
+                cum = float(cums_h[0, 0])
+                return pb, cum
+            """, self.R)
+        assert fs == []
+
+    def test_suppression_inline_and_standalone(self, tmp_path):
+        fs = lint(tmp_path, """\
+            import numpy as np
+
+            def drive(im, mid, bc, rng):
+                outs = im.inference(mid, bc, rng)
+                pad = 0
+                a = np.asarray(outs[0])  # fflint: disable=host-sync-dataflow  probe fetch
+                pad2 = 0
+                # fflint: disable=host-sync-dataflow  counted by caller
+                b = np.asarray(outs[1])
+                pad3 = 0
+                c = np.asarray(outs[2])
+                return a, b, c
+            """, self.R)
+        assert len(fs) == 1 and at(fs, "host-sync-dataflow", 11), fs
+
+    def test_walrus_binding_is_tainted(self, tmp_path):
+        # `(out := im.decode_block(...))` binds at expression level —
+        # the fetch two statements later must still be flagged
+        fs = lint(tmp_path, """\
+            import numpy as np
+
+            def drive(im, mid, bc, rng):
+                if (out := im.decode_block(mid, bc, 4, rng)) is not None:
+                    pad = 0
+                    pad2 = 0
+                    toks = np.asarray(out)
+                    return toks
+                return None
+            """, self.R)
+        assert at(fs, "host-sync-dataflow", 7), fs
+
+    def test_augassign_keeps_taint(self, tmp_path):
+        # `out += 1` READS out: a device value stays a device value
+        fs = lint(tmp_path, """\
+            import numpy as np
+
+            def drive(im, mid, bc, rng):
+                out = im.decode_block(mid, bc, 4, rng)
+                out += 1
+                pad = 0
+                return np.asarray(out)
+            """, self.R)
+        assert at(fs, "host-sync-dataflow", 7), fs
+
+    def test_host_side_batchconfig_conversions_ignored(self, tmp_path):
+        fs = lint(tmp_path, """\
+            import numpy as np
+
+            def flash_wins(bc, span):
+                act = np.asarray(bc.request_available)
+                depths = np.asarray(bc.first_token_depth)[act] + span
+                return float(depths.max())
+            """, self.R)
+        assert fs == []
+
+
+# -------------------------------------------------------------- retrace
+class TestRetraceRule:
+    R = [RetraceRule()]
+
+    def test_traced_branch_flagged_static_branch_clean(self, tmp_path):
+        fs = lint(tmp_path, """\
+            import functools
+            import jax
+
+            @functools.partial(jax.jit, static_argnames=("mode",))
+            def step(x, y, mode):
+                if mode:
+                    x = x + 1
+                if y is not None:
+                    x = x + y
+                if x:
+                    x = x * 2
+                return x
+            """, self.R)
+        assert at(fs, "retrace-hazard", 10), fs
+        assert len(fs) == 1
+
+    def test_concretization_flagged(self, tmp_path):
+        fs = lint(tmp_path, """\
+            import jax
+
+            @jax.jit
+            def step(x):
+                k = int(x.sum())
+                return k
+            """, self.R)
+        assert at(fs, "retrace-hazard", 5), fs
+
+    def test_shape_branch_is_a_warning(self, tmp_path):
+        fs = lint(tmp_path, """\
+            import jax
+
+            @jax.jit
+            def step(x):
+                if x.shape[0] > 8:
+                    return x * 2
+                return x
+            """, self.R)
+        hits = at(fs, "retrace-hazard", 5)
+        assert hits and hits[0].severity == "warn", fs
+
+    def test_jit_call_spelling_and_nested_scan_body(self, tmp_path):
+        fs = lint(tmp_path, """\
+            import jax
+
+            def build(record):
+                def block(params, caches, batch):
+                    def body(carry, rng_i):
+                        caches, tok = carry
+                        if tok:
+                            tok = tok + 1
+                        return (caches, tok), tok
+                    return jax.lax.scan(body, (caches, batch), None)
+                return jax.jit(block, donate_argnums=(1,))
+            """, self.R)
+        assert at(fs, "retrace-hazard", 7), fs
+
+    def test_nonhashable_static_default(self, tmp_path):
+        fs = lint(tmp_path, """\
+            import functools
+            import jax
+
+            @functools.partial(jax.jit, static_argnames=("opts",))
+            def step(x, opts=[]):
+                return x
+            """, self.R)
+        assert at(fs, "retrace-hazard", 5), fs
+
+    def test_static_argnums_out_of_range(self, tmp_path):
+        fs = lint(tmp_path, """\
+            import jax
+
+            def build():
+                def f(x):
+                    return x
+                return jax.jit(f, static_argnums=(3,))
+            """, self.R)
+        assert [f for f in fs if f.rule == "retrace-hazard"], fs
+
+    def test_suppression_honored(self, tmp_path):
+        fs = lint(tmp_path, """\
+            import jax
+
+            @jax.jit
+            def step(x):
+                # fflint: disable=retrace-hazard  one variant per record
+                if x.shape[0] > 8:
+                    return x * 2
+                return x
+            """, self.R)
+        assert fs == []
+
+    def test_branch_rebind_does_not_untaint_fall_through(self, tmp_path):
+        # `y = x; if flag: y = 0` leaves y traced when flag is False —
+        # a clean rebind on a conditional branch must not silence the
+        # later traced branch
+        fs = lint(tmp_path, """\
+            import functools
+            import jax
+
+            @functools.partial(jax.jit, static_argnames=("flag",))
+            def step(x, flag):
+                y = x
+                if flag:
+                    y = 0
+                if y > 1:
+                    return y
+                return x
+            """, self.R)
+        assert at(fs, "retrace-hazard", 9), fs
+
+    def test_augassign_keeps_traced(self, tmp_path):
+        fs = lint(tmp_path, """\
+            import jax
+
+            @jax.jit
+            def step(x):
+                x += 1
+                if x > 0:
+                    return x
+                return -x
+            """, self.R)
+        assert at(fs, "retrace-hazard", 6), fs
+
+    def test_same_named_nested_defs_resolve_nearest(self, tmp_path):
+        # two sibling builders each define `block`; each jax.jit(block)
+        # must analyze ITS OWN block (the inference_manager pattern) —
+        # a module-global last-def-wins map would miss the first one
+        fs = lint(tmp_path, """\
+            import jax
+
+            def build_a():
+                def block(params, x):
+                    if x:
+                        x = x + 1
+                    return x
+                return jax.jit(block)
+
+            def build_b():
+                def block(params, x):
+                    return x
+                return jax.jit(block)
+            """, self.R)
+        assert at(fs, "retrace-hazard", 5), fs
+        assert len(fs) == 1
+
+
+# ------------------------------------------------------- pallas tiling
+class TestPallasTilingRule:
+    R = [PallasTilingRule()]
+
+    def test_int8_sublane_violation_exact_line(self, tmp_path):
+        fs = lint(tmp_path, """\
+            from jax.experimental import pallas as pl
+            from jax.experimental.pallas import tpu as pltpu
+            import jax.numpy as jnp
+
+            W = 16
+
+            def build():
+                # the PR-2 bug class: a 16-wide RMW window on an int8
+                # cache is not addressable by the (32, 128) tiling
+                win = pltpu.VMEM((W, 128), jnp.int8)
+                ok = pltpu.VMEM((2 * W, 128), jnp.int8)
+                return win, ok
+            """, self.R, rel="kernels/k.py")
+        assert at(fs, "pallas-tiling", 10), fs
+        assert len(fs) == 1
+
+    def test_bf16_and_f32_sublane_rules(self, tmp_path):
+        fs = lint(tmp_path, """\
+            from jax.experimental.pallas import tpu as pltpu
+            import jax.numpy as jnp
+
+            def build():
+                bad_bf16 = pltpu.VMEM((8, 128), jnp.bfloat16)
+                ok_f32 = pltpu.VMEM((8, 128), jnp.float32)
+                return bad_bf16, ok_f32
+            """, self.R, rel="kernels/k.py")
+        assert at(fs, "pallas-tiling", 5), fs
+        assert len(fs) == 1
+
+    def test_lane_pad_is_a_warning(self, tmp_path):
+        fs = lint(tmp_path, """\
+            from jax.experimental import pallas as pl
+
+            def build():
+                spec = pl.BlockSpec((8, 64), lambda i: (i, 0))
+                scalarish = pl.BlockSpec((8, 1), lambda i: (i, 0))
+                return spec, scalarish
+            """, self.R, rel="kernels/k.py")
+        hits = at(fs, "pallas-tiling", 4)
+        assert hits and hits[0].severity == "warn", fs
+        assert len(fs) == 1              # (8, 1) scalar column exempt
+
+    def test_out_blockspec_inherits_out_shape_dtype(self, tmp_path):
+        # BlockSpec carries no dtype, but the OUT tile rides out_shape:
+        # a 16-sublane out tile on an int8 out_shape is the PR-2 RMW
+        # bug class and must fire the exact 32-sublane table check
+        fs = lint(tmp_path, """\
+            from jax.experimental import pallas as pl
+            import jax
+            import jax.numpy as jnp
+
+            def build(kernel, x):
+                return pl.pallas_call(
+                    kernel,
+                    grid=(8,),
+                    out_specs=pl.BlockSpec((16, 128), lambda i: (i, 0)),
+                    out_shape=jax.ShapeDtypeStruct((128, 128), jnp.int8),
+                )(x)
+            """, self.R, rel="kernels/k.py")
+        assert at(fs, "pallas-tiling", 9), fs
+
+    def test_grid_must_tile_padded_shape(self, tmp_path):
+        fs = lint(tmp_path, """\
+            from jax.experimental import pallas as pl
+            import jax
+
+            def build(kernel, x):
+                return pl.pallas_call(
+                    kernel,
+                    grid=(3,),
+                    out_specs=pl.BlockSpec((128,), lambda i: (i,)),
+                    out_shape=jax.ShapeDtypeStruct((512,), x.dtype),
+                )(x)
+            """, self.R, rel="kernels/k.py")
+        assert at(fs, "pallas-tiling", 7), fs
+
+    def test_non_pallas_module_is_ignored(self, tmp_path):
+        fs = lint(tmp_path, """\
+            import numpy as np
+
+            def BlockSpec(shape, fn):
+                return shape
+
+            spec = BlockSpec((7, 64), None)   # not pallas: no finding
+            """, self.R, rel="serving/host.py")
+        assert fs == []
+
+    def test_suppression_silences(self, tmp_path):
+        fs = lint(tmp_path, """\
+            from jax.experimental.pallas import tpu as pltpu
+            import jax.numpy as jnp
+
+            def build():
+                # fflint: disable=pallas-tiling  interpret-only debug scratch
+                return pltpu.VMEM((8, 128), jnp.int8)
+            """, self.R, rel="kernels/k.py")
+        assert fs == []
+
+    def test_variable_shapes_are_not_guessed(self, tmp_path):
+        # runtime-derived dims (the real kernels) must never fire
+        fs = lint(tmp_path, """\
+            from jax.experimental import pallas as pl
+
+            def build(KV, ts, D):
+                return pl.BlockSpec((1, KV, ts, D), lambda r, t: (r, 0, t, 0))
+            """, self.R, rel="kernels/k.py")
+        assert fs == []
+
+
+# ------------------------------------------------------- metric schema
+class TestMetricSchemaRule:
+    R = [MetricSchemaRule()]
+
+    def test_undeclared_and_mistyped_and_nonliteral(self, tmp_path):
+        fs = lint(tmp_path, """\
+            def wire(m, name):
+                a = m.counter("serving_widgets_total")
+                b = m.counter("serving_rogue_total")
+                c = m.gauge("serving_widgets_total")
+                d = m.histogram(name)
+                return a, b, c, d
+            """, self.R)
+        assert at(fs, "metric-schema", 3), fs     # undeclared
+        assert at(fs, "metric-schema", 4), fs     # counter-vs-gauge
+        assert at(fs, "metric-schema", 5), fs     # non-literal
+        assert len(fs) == 3
+
+    def test_numpy_histogram_not_a_registry_call(self, tmp_path):
+        fs = lint(tmp_path, """\
+            import numpy as np
+
+            def stats(xs):
+                return np.histogram(xs)
+            """, self.R)
+        assert fs == []
+
+    def test_suppression_silences(self, tmp_path):
+        fs = lint(tmp_path, """\
+            def wire(m):
+                return m.counter("bench_only_total")  # fflint: disable=metric-schema  bench-local registry
+            """, self.R)
+        assert fs == []
+
+    def test_wrapped_literal_still_validated(self, tmp_path):
+        # the old regex needed \\s tricks for wrapped calls; the AST
+        # sees the same Call node regardless of layout
+        fs = lint(tmp_path, """\
+            def wire(m):
+                return m.counter(
+                    "serving_rogue_total")
+            """, self.R)
+        assert len(fs) == 1 and fs[0].rule == "metric-schema"
+
+
+# --------------------------------------------------- direct host sync
+class TestDirectHostSyncRule:
+    R = [DirectHostSyncRule()]
+
+    SRC = """\
+        class IM:
+            def tick(self):
+                self.host_syncs += 1
+        """
+
+    def test_flagged_under_serving(self, tmp_path):
+        fs = lint(tmp_path, self.SRC, self.R, rel="serving/im.py")
+        assert at(fs, "direct-host-sync", 3), fs
+
+    def test_ignored_outside_serving(self, tmp_path):
+        fs = lint(tmp_path, self.SRC, self.R, rel="training/opt.py")
+        assert fs == []
+
+    def test_legacy_and_fflint_pragmas(self, tmp_path):
+        fs = lint(tmp_path, """\
+            class IM:
+                def tick(self, n):
+                    self.host_syncs += n  # lint: allow-direct-sync (odometer)
+
+                def tick2(self, n):
+                    self.host_syncs += n  # fflint: disable=direct-host-sync  odometer
+            """, self.R, rel="serving/im.py")
+        assert fs == []
+
+
+# ------------------------------------------------------------ donation
+class TestDonationRule:
+    R = [DonationRule()]
+
+    def test_factory_indirection_is_out_of_scope(self, tmp_path):
+        # a callable reaching the caller through a factory return is
+        # not resolvable by the module-local name map — documented
+        # limitation (runtime still raises loudly); must NOT guess
+        fs = lint(tmp_path, """\
+            import jax
+
+            def build():
+                def f(params, caches):
+                    return caches
+                return jax.jit(f, donate_argnums=(1,))
+
+            def drive(params, caches):
+                step = build()
+                out = step(params, caches)
+                stale = caches.copy()
+                return out, stale
+            """, self.R)
+        assert fs == []
+
+    def test_same_module_name_binding(self, tmp_path):
+        fs = lint(tmp_path, """\
+            import jax
+
+            def f(params, caches):
+                return caches
+
+            step = jax.jit(f, donate_argnums=(1,))
+
+            def drive(params, caches):
+                out = step(params, caches)
+                stale = caches.copy()
+                return out, stale
+            """, self.R)
+        assert at(fs, "donated-buffer-reuse", 10), fs
+
+    def test_rebind_in_call_statement_is_clean(self, tmp_path):
+        fs = lint(tmp_path, """\
+            import jax
+
+            def f(params, caches):
+                return None, caches
+
+            step = jax.jit(f, donate_argnums=(1,))
+
+            def drive(params, caches):
+                out, caches = step(params, caches)
+                return out, caches.copy()
+            """, self.R)
+        assert fs == []
+
+    def test_loop_without_rebind_flagged(self, tmp_path):
+        fs = lint(tmp_path, """\
+            import jax
+
+            def f(params, caches):
+                return caches
+
+            step = jax.jit(f, donate_argnums=(1,))
+
+            def drive(params, caches):
+                for i in range(4):
+                    out = step(params, caches)
+                return out
+            """, self.R)
+        assert at(fs, "donated-buffer-reuse", 10), fs
+
+    def test_decorated_def_donation(self, tmp_path):
+        fs = lint(tmp_path, """\
+            import functools
+            import jax
+
+            @functools.partial(jax.jit, donate_argnums=(0,))
+            def train_step(state, batch):
+                return state
+
+            def drive(state, batches):
+                out = train_step(state, batches[0])
+                stale = state.copy()
+                return out, stale
+            """, self.R)
+        assert at(fs, "donated-buffer-reuse", 10), fs
+
+    def test_loop_that_only_redefines_the_def_is_not_a_loop_hazard(
+            self, tmp_path):
+        # the loop re-binds cb, it does not re-execute the donation —
+        # the enclosing-loop lookup must stop at the function boundary
+        fs = lint(tmp_path, """\
+            import jax
+
+            def f(params, caches):
+                return caches
+
+            step = jax.jit(f, donate_argnums=(1,))
+
+            def drive(params, caches):
+                cbs = []
+                for i in range(3):
+                    def cb(caches=caches):
+                        out = step(params, caches)
+                        return out
+                    cbs.append(cb)
+                return cbs
+            """, self.R)
+        assert fs == []
+
+    def test_suppression_silences(self, tmp_path):
+        fs = lint(tmp_path, """\
+            import jax
+
+            def f(params, caches):
+                return caches
+
+            step = jax.jit(f, donate_argnums=(1,))
+
+            def drive(params, caches):
+                out = step(params, caches)
+                # fflint: disable=donated-buffer-reuse  repr only, never dereferenced on device
+                stale = caches
+                return out, stale
+            """, self.R)
+        assert fs == []
+
+
+# ----------------------------------------------------------- framework
+class TestFramework:
+    def test_baseline_round_trip(self, tmp_path):
+        src = """\
+            import numpy as np
+
+            def drive(im, mid, bc, rng):
+                outs = im.inference(mid, bc, rng)
+                pad = 0
+                return np.asarray(outs[0])
+            """
+        fs = lint(tmp_path, src, [HostSyncRule()])
+        assert len(fs) == 1
+        bl_path = tmp_path / "baseline.json"
+        write_baseline(fs, str(bl_path), reason="grandfathered: probe")
+        bl = load_baseline(str(bl_path))
+        new, old = apply_baseline(fs, bl)
+        assert new == [] and len(old) == 1
+        # a SECOND identical finding (new site) exceeds the multiset
+        fs2 = fs + fs
+        new2, old2 = apply_baseline(fs2, bl)
+        assert len(new2) == 1 and len(old2) == 1
+        # the entry carries the reason (reviewable baseline)
+        data = json.loads(bl_path.read_text())
+        assert data["findings"][0]["reason"] == "grandfathered: probe"
+
+    def test_baseline_is_line_drift_stable(self, tmp_path):
+        src1 = """\
+            import numpy as np
+
+            def drive(im, mid, bc, rng):
+                outs = im.inference(mid, bc, rng)
+                pad = 0
+                return np.asarray(outs[0])
+            """
+        fs1 = lint(tmp_path, src1, [HostSyncRule()])
+        bl_path = tmp_path / "b.json"
+        write_baseline(fs1, str(bl_path))
+        # unrelated lines added above: line number moves, key does not
+        src2 = "import os\nimport sys\n\n" + textwrap.dedent(src1)
+        (tmp_path / "serving" / "mod.py").write_text(src2)
+        ctx = LintContext(repo_root=str(tmp_path), schema=SCHEMA)
+        fs2 = lint_file(str(tmp_path / "serving" / "mod.py"),
+                        [HostSyncRule()], ctx, rel="serving/mod.py")
+        assert len(fs2) == 1 and fs2[0].line != fs1[0].line
+        new, old = apply_baseline(fs2, load_baseline(str(bl_path)))
+        assert new == [] and len(old) == 1
+
+    def test_malformed_pragma_is_inert_not_suppress_all(self, tmp_path):
+        # a typoed pragma must NOT silently widen to disable-everything
+        fs = lint(tmp_path, """\
+            import numpy as np
+
+            def drive(im, mid, bc, rng):
+                outs = im.inference(mid, bc, rng)
+                pad = 0
+                a = np.asarray(outs[0])  # fflint: disabled=host-sync-dataflow
+                pad2 = 0
+                b = np.asarray(outs[1])  # fflint: disable=
+                pad3 = 0
+                c = np.asarray(outs[2])  # fflint: disable = host-sync-dataflow
+                return a, b, c
+            """, [HostSyncRule()])
+        # the two typos stay live findings; the space-around-= form is
+        # accepted leniently as a valid rule list
+        assert at(fs, "host-sync-dataflow", 6), fs
+        assert at(fs, "host-sync-dataflow", 8), fs
+        assert len(fs) == 2
+
+    def test_comma_space_rule_list(self, tmp_path):
+        # `disable=a, b  reason` — whitespace after the comma must not
+        # silently drop rule b
+        fs = lint(tmp_path, """\
+            import numpy as np
+
+            def drive(im, mid, bc, rng):
+                outs = im.inference(mid, bc, rng)
+                pad = 0
+                a = np.asarray(outs[0])  # fflint: disable=retrace-hazard, host-sync-dataflow  probe
+                return a
+            """, [HostSyncRule()])
+        assert fs == []
+
+    def test_pragma_inside_string_is_inert(self, tmp_path):
+        fs = lint(tmp_path, """\
+            import numpy as np
+
+            def drive(im, mid, bc, rng):
+                outs = im.inference(mid, bc, rng)
+                doc = "# fflint: disable=host-sync-dataflow"
+                return np.asarray(outs[0]), doc
+            """, [HostSyncRule()])
+        assert len(fs) == 1
+
+    def test_parse_error_is_a_finding(self, tmp_path):
+        p = tmp_path / "bad.py"
+        p.write_text("def broken(:\n")
+        ctx = LintContext(repo_root=str(tmp_path), schema={})
+        fs = lint_file(str(p), [HostSyncRule()], ctx, rel="bad.py")
+        assert len(fs) == 1 and fs[0].rule == "parse-error"
+
+    def test_lint_paths_walks_and_sorts(self, tmp_path):
+        (tmp_path / "serving").mkdir()
+        (tmp_path / "serving" / "a.py").write_text(
+            "import numpy as np\n\n"
+            "def d(im, b, r):\n"
+            "    o = im.inference(0, b, r)\n"
+            "    pad = 0\n"
+            "    return np.asarray(o[0])\n")
+        (tmp_path / "serving" / "__pycache__").mkdir()
+        (tmp_path / "serving" / "__pycache__" / "junk.py").write_text(
+            "import numpy as np\n\n"
+            "def d(im, b, r):\n"
+            "    o = im.inference(0, b, r)\n"
+            "    pad = 0\n"
+            "    return np.asarray(o[0])\n")
+        ctx = LintContext(repo_root=str(tmp_path), schema={})
+        fs = lint_paths([str(tmp_path)], rules=[HostSyncRule()], ctx=ctx)
+        assert len(fs) == 1              # __pycache__ skipped
+
+
+class TestCLI:
+    def _run(self, *args, cwd=REPO):
+        return subprocess.run(
+            [sys.executable, "-m", "tools.fflint", *args],
+            capture_output=True, text=True, cwd=cwd, timeout=120)
+
+    def test_clean_tree_exits_zero(self):
+        # the acceptance gate: the repo's own code lints clean
+        r = self._run("flexflow_tpu", "tools")
+        assert r.returncode == 0, r.stdout + r.stderr
+
+    def test_findings_exit_one_and_json(self, tmp_path):
+        bad = tmp_path / "serving" / "m.py"
+        bad.parent.mkdir()
+        bad.write_text(
+            "import numpy as np\n\n"
+            "def d(im, b, r):\n"
+            "    o = im.inference(0, b, r)\n"
+            "    pad = 0\n"
+            "    return np.asarray(o[0])\n")
+        r = self._run(str(bad))
+        assert r.returncode == 1 and "host-sync-dataflow" in r.stdout
+        rj = self._run("--json", str(bad))
+        data = json.loads(rj.stdout)
+        assert data["findings"][0]["rule"] == "host-sync-dataflow"
+        assert data["findings"][0]["line"] == 6
+
+    def test_unknown_rule_exits_two(self):
+        r = self._run("--select", "no-such-rule", "tools")
+        assert r.returncode == 2
+
+    def test_write_baseline_refuses_partial_runs(self, tmp_path):
+        # a subset run must never garbage-collect the full baseline
+        bl = tmp_path / "b.json"
+        for extra in (["--select", "metric-schema"], ["--changed-only"]):
+            r = self._run("--baseline", str(bl), "--write-baseline",
+                          *extra, "tools")
+            assert r.returncode == 2, (extra, r.stderr)
+            assert not bl.exists()
+
+    def test_list_rules_covers_catalog(self):
+        r = self._run("--list-rules")
+        assert r.returncode == 0
+        for cls in ALL_RULES:
+            assert cls.id in r.stdout
+
+
+class TestChangedOnly:
+    def test_changed_files_tracks_git_state(self, tmp_path):
+        import pytest
+
+        from tools.fflint import changed_files
+
+        def git(*args):
+            return subprocess.run(["git", "-C", str(tmp_path), *args],
+                                  capture_output=True, text=True,
+                                  timeout=60)
+        if git("init").returncode != 0:
+            pytest.skip("git unavailable")
+        git("config", "user.email", "t@t")
+        git("config", "user.name", "t")
+        (tmp_path / "clean.py").write_text("x = 1\n")
+        (tmp_path / "dirty.py").write_text("y = 1\n")
+        git("add", "-A")
+        assert git("commit", "-m", "seed").returncode == 0
+        (tmp_path / "dirty.py").write_text("y = 2\n")
+        (tmp_path / "fresh.py").write_text("z = 3\n")
+        changed = changed_files(str(tmp_path))
+        assert changed == {str(tmp_path / "dirty.py"),
+                           str(tmp_path / "fresh.py")}
+        # the lint honors the filter: clean.py is skipped entirely
+        ctx = LintContext(repo_root=str(tmp_path), schema={})
+        fs = lint_paths([str(tmp_path)], rules=[HostSyncRule()],
+                        ctx=ctx, only_files=changed)
+        assert fs == []                  # nothing hazardous, no crash
+
+
+def test_fflint_imports_no_jax():
+    """The suite must stay usable (and fast) without JAX: importing the
+    package and its rules pulls in neither jax nor flexflow_tpu."""
+    code = ("import sys; import tools.fflint; import tools.fflint.rules; "
+            "assert 'jax' not in sys.modules, 'fflint imported jax'; "
+            "assert 'flexflow_tpu' not in sys.modules; "
+            "assert 'numpy' not in sys.modules, 'fflint imported numpy'")
+    r = subprocess.run([sys.executable, "-c", code], cwd=REPO,
+                       capture_output=True, text=True, timeout=60)
+    assert r.returncode == 0, r.stderr

@@ -249,6 +249,68 @@ class TestLongBlocks:
         assert all(r.profile.first_token_time > 0 for r in reqs_s)
 
 
+class TestRetraceGuard:
+    """Dynamic oracle for fflint's static ``retrace-hazard`` rule
+    (docs/STATIC_ANALYSIS.md): a WARMED decode loop must compile
+    nothing.  Any XLA compile inside the pinned block means a jit cache
+    key went unstable — an unbucketed shape, a weak Python scalar, or a
+    Python branch on a traced value — exactly the hazard class the
+    static rule flags at the AST level, verified here against the real
+    serving step cache."""
+
+    def test_warmed_4step_decode_loop_pins_zero_compiles(self):
+        import jax
+
+        from flexflow_tpu.serving.batch_config import BatchConfig
+        from flexflow_tpu.utils.debugging import retrace_guard
+
+        hf, _ = _hf_tiny_llama(seed=21)
+        model, _ = _build_ff_llama(hf, max_requests=2)
+        im = InferenceManager(model.config)
+        mid = im.compile_model_and_allocate_buffer(
+            model, max_requests=2, max_seq_length=128, prefill_chunk=8,
+            cache_dtype=np.float32)
+        bc = BatchConfig(2, 1)
+        bc.request_guid[:] = [1, 2]
+        bc.request_available[:] = True
+        bc.first_token_depth[:] = [3, 4]
+        bc.num_tokens_in_batch[:] = 1
+        bc.max_sequence_length[:] = 128
+        bc.token_ids[:, 0] = [5, 7]
+        rng = jax.random.PRNGKey(0)
+
+        # warm the fused 4-step block; this also proves the monitoring
+        # signal exists on this JAX (a fresh compile must be counted)
+        with retrace_guard(max_compiles=None) as warm:
+            np.asarray(im.decode_block(mid, bc, 4, rng))
+            im.note_host_sync()
+        if warm.compiles == 0:
+            pytest.skip("this JAX emits no compile monitoring events")
+
+        # the identical 4-step decode loop again: same shape bucket,
+        # same step-cache key -> every dispatch must be a cache hit
+        with retrace_guard() as g:          # raises if compiles > 0
+            np.asarray(im.decode_block(mid, bc, 4, rng))
+            im.note_host_sync()
+        assert g.compiles == 0, g.events
+
+    def test_guard_counts_a_fresh_compile(self):
+        import jax
+        import jax.numpy as jnp
+
+        from flexflow_tpu.utils.debugging import retrace_guard
+
+        f = jax.jit(lambda x: x * 3 + 1)
+        with retrace_guard(max_compiles=None) as g:
+            f(jnp.ones(5))
+        if g.compiles == 0:
+            pytest.skip("this JAX emits no compile monitoring events")
+        # and the pin actually raises on a retrace (new shape)
+        with pytest.raises(AssertionError, match="retrace_guard"):
+            with retrace_guard():
+                f(jnp.ones(9))
+
+
 def test_transient_remote_compile_retry():
     """_retry_transient retries EXACTLY once on a remote-compile tunnel
     failure (the compile service drops responses mid-flight under

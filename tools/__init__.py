@@ -1,0 +1,7 @@
+"""Repo tooling package marker (makes ``python -m tools.fflint`` work).
+
+The scripts in this directory remain directly runnable
+(``python tools/check_host_syncs.py``) — they bootstrap sys.path
+themselves — but the fflint static-analysis suite is a proper package
+and is invoked as a module.
+"""

@@ -2,83 +2,47 @@
 """Static check: device fetches in flexflow_tpu/serving/ must tick the
 host-sync odometer.
 
-``InferenceManager.host_syncs`` is the serving path's key overhead
-metric on a network-attached chip (every materialization of a device
-array costs a full tunnel round trip — see the field's docstring), and
-the decode-block tests pin syncs-per-token against it.  The odometer is
-only as honest as its coverage: a new ``np.asarray(<device output>)``
-without the matching ``host_syncs += 1`` silently under-counts, and the
-regression the counter exists to catch walks right past it (this check
-was added after two such sites were found in the host spec loop).
+THIN SHIM over the fflint ``host-sync-dataflow`` rule — the old
+grep-level lint (a name-convention whitelist with a ±3-line window)
+was replaced by the AST dataflow analysis in
+``tools/fflint/rules/host_sync.py``: names bound from
+``im.inference``/``im.decode_block`` dispatches are taint-tracked
+through aliases, and every materialization (``np.asarray``/``int``/
+``float``/``.item()``) must have a ``note_host_sync()`` in the same
+statement region.  See docs/STATIC_ANALYSIS.md for the rule catalog.
 
-This is a GREP-LEVEL lint, deliberately: a real dataflow analysis is
-not worth the moving parts.  A line is a *device-fetch site* when it
-calls ``np.asarray(ARG)`` and ARG's leading expression is either
-
-- a name conventionally bound to step/block outputs: {out, outs,
-  packed, toks, toks_dev, parents, cums, hist, greedy, init, P}, or
-- a direct InferenceManager dispatch: ``im.inference(...)``,
-  ``im.decode_block(...)``, ``im.beam_block(...)``.
-
-Host-side conversions (``np.asarray(bc.…)``, batch dicts, feed helpers)
-do not match and are ignored; ``jnp.asarray`` never syncs.  Every
-device-fetch site must have a ``note_host_sync(`` call (the
-registry-backed odometer tick — serving code must not bump
-``host_syncs`` directly, see tools/check_metrics_schema.py) within
-±``WINDOW`` (3) lines — several fetches of one dispatch's results may
-share a single tick (one round trip).  A knowingly-unsynced site can be
-annotated ``# no-sync: <why>`` on the same line.
-
-Exit 0 = clean; exit 1 prints each violation as path:line: text.
-Wired into tools/run_tier1.sh ahead of pytest.
+The CLI contract is unchanged so existing callers keep working:
+``python tools/check_host_syncs.py [root]`` (default
+``flexflow_tpu/serving``), exit 0 = clean, exit 1 prints each
+violation as ``path:line``.  Suppress intentional sites with
+``# fflint: disable=host-sync-dataflow  <why>`` (the legacy
+``# no-sync: <why>`` pragma is still honored).
 """
 
 from __future__ import annotations
 
 import os
-import re
 import sys
 
-WINDOW = 3
-DEVICE_NAMES = ("out", "outs", "packed", "toks", "toks_dev", "parents",
-                "cums", "hist", "greedy", "init", "P")
-FETCH_RE = re.compile(
-    r"np\.asarray\(\s*(?:(?:%s)\b|im\.(?:inference|decode_block|"
-    r"beam_block)\()" % "|".join(DEVICE_NAMES))
-SYNC_RE = re.compile(r"note_host_sync\(|host_syncs\s*\+=\s*1")
-PRAGMA_RE = re.compile(r"#\s*no-sync\b")
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
 
-
-def check_file(path: str):
-    with open(path) as f:
-        lines = f.readlines()
-    bad = []
-    for i, line in enumerate(lines):
-        if not FETCH_RE.search(line) or PRAGMA_RE.search(line):
-            continue
-        lo = max(0, i - WINDOW)
-        hi = min(len(lines), i + WINDOW + 1)
-        if not any(SYNC_RE.search(lines[j]) for j in range(lo, hi)):
-            bad.append((path, i + 1, line.rstrip()))
-    return bad
+from tools.fflint import LintContext, lint_paths  # noqa: E402
+from tools.fflint.rules.host_sync import HostSyncRule  # noqa: E402
 
 
 def main(argv):
     root = argv[1] if len(argv) > 1 else os.path.join(
-        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-        "flexflow_tpu", "serving")
-    bad = []
-    for dirpath, _, names in sorted(os.walk(root)):
-        for name in sorted(names):
-            if name.endswith(".py"):
-                bad.extend(check_file(os.path.join(dirpath, name)))
-    for path, lineno, text in bad:
-        print(f"{path}:{lineno}: np.asarray on a device output without "
-              f"a note_host_sync() within {WINDOW} lines:\n    {text}")
-    if bad:
-        print(f"check_host_syncs: {len(bad)} unsynced device fetch"
-              f"{'es' if len(bad) != 1 else ''} (annotate '# no-sync: "
-              f"<why>' only if the fetch truly cannot sync)")
+        REPO, "flexflow_tpu", "serving")
+    findings = lint_paths([root], rules=[HostSyncRule()],
+                          ctx=LintContext(repo_root=REPO))
+    for f in findings:
+        print(f.render())
+    if findings:
+        print(f"check_host_syncs: {len(findings)} unsynced device fetch"
+              f"{'es' if len(findings) != 1 else ''} (annotate "
+              f"'# fflint: disable=host-sync-dataflow  <why>' only if "
+              f"the fetch truly cannot sync)")
         return 1
     print("check_host_syncs: OK")
     return 0

@@ -1,130 +1,51 @@
 #!/usr/bin/env python
 """Static check: serving telemetry stays schema-complete.
 
-Two rules over ``flexflow_tpu/serving/`` (and the observability package
-itself), enforced grep-level like tools/check_host_syncs.py:
+THIN SHIM over the fflint ``metric-schema`` and ``direct-host-sync``
+rules — the old regex lint was replaced by the AST analyses in
+``tools/fflint/rules/metric_schema.py`` /
+``tools/fflint/rules/direct_host_sync.py``:
 
-1. **Schema coverage** — every metric name passed to a registry factory
+1. **Schema coverage** — every registry factory name literal
    (``.counter("…")`` / ``.gauge("…")`` / ``.histogram("…")``) must be
-   declared in ``flexflow_tpu/observability/schema.METRICS_SCHEMA`` with
-   a matching type.  The registry also enforces this at runtime, but a
-   code path that only runs on chip would ship the violation; this gate
-   fails in CI first.  Non-literal names can't be checked statically and
-   are rejected outright — the schema exists precisely so the emitted
-   vocabulary is enumerable.
+   declared in ``observability/schema.METRICS_SCHEMA`` with a matching
+   type; non-literal names are rejected outright.
+2. **No direct host_syncs increments** — serving modules tick the
+   odometer through ``InferenceManager.note_host_sync()``; the one
+   legitimate site carries an inline suppression.
 
-2. **No direct host_syncs increments** — serving modules must tick the
-   odometer through ``InferenceManager.note_host_sync()`` (which also
-   feeds the ``serving_host_syncs_total`` registry counter); a raw
-   ``…host_syncs += …`` silently skips the registry and the snapshot
-   under-reports round trips.  The one legitimate site (the odometer
-   inside note_host_sync itself) carries a
-   ``# lint: allow-direct-sync`` pragma.
-
-Exit 0 = clean; exit 1 prints each violation as path:line: text.
-Wired into tools/run_tier1.sh next to check_host_syncs.py.
+See docs/STATIC_ANALYSIS.md.  CLI contract unchanged:
+``python tools/check_metrics_schema.py [roots…]`` (default: serving +
+observability + serve), exit 0 = clean, exit 1 prints violations.
 """
 
 from __future__ import annotations
 
 import os
-import re
 import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
 
-# .counter("name") / .gauge('name') / .histogram("name" — \s spans
-# newlines, so a call whose string literal wraps to the next line is
-# still seen (two such sites exist in the serving wiring)
-FACTORY_RE = re.compile(
-    r"\.(counter|gauge|histogram)\(\s*([\"\'])([^\"\']+)\2")
-# a factory call whose first argument is NOT a string literal (nor a
-# method definition's `self`)
-FACTORY_NONLITERAL_RE = re.compile(
-    r"\.(counter|gauge|histogram)\(\s*[^\"\')\s]")
-SYNC_RE = re.compile(r"\bhost_syncs\s*[+\-]=")
-PRAGMA_RE = re.compile(r"#\s*lint:\s*allow-direct-sync\b")
-
-
-def load_schema():
-    sys.path.insert(0, REPO)
-    from flexflow_tpu.observability.schema import METRICS_SCHEMA
-
-    return METRICS_SCHEMA
-
-
-def iter_py(roots):
-    for root in roots:
-        for dirpath, _, names in sorted(os.walk(root)):
-            for name in sorted(names):
-                if name.endswith(".py"):
-                    yield os.path.join(dirpath, name)
-
-
-def check_file(path, schema):
-    bad = []
-    with open(path) as f:
-        text = f.read()
-    lines = text.splitlines()
-
-    def lineno(pos):
-        return text.count("\n", 0, pos) + 1
-
-    def snippet(pos):
-        return lines[lineno(pos) - 1]
-
-    # factory scans run over the WHOLE text: \s in the patterns spans
-    # newlines, so wrapped calls (.counter(\n "name")) are covered too
-    literal_starts = set()
-    for m in FACTORY_RE.finditer(text):
-        literal_starts.add(m.start())
-        kind, _, name = m.groups()
-        decl = schema.get(name)
-        if decl is None:
-            bad.append((path, lineno(m.start()),
-                        f"metric {name!r} not declared in "
-                        f"observability/schema.py", snippet(m.start())))
-        elif decl["type"] != kind:
-            bad.append((path, lineno(m.start()),
-                        f"metric {name!r} declared as {decl['type']}, "
-                        f"created as {kind}", snippet(m.start())))
-    for m in FACTORY_NONLITERAL_RE.finditer(text):
-        if m.start() in literal_starts:
-            continue
-        line = snippet(m.start())
-        if ("def counter" in line or "def gauge" in line
-                or "def histogram" in line):
-            continue                      # the factory definitions
-        bad.append((path, lineno(m.start()),
-                    "metric factory called with a non-literal name "
-                    "(schema coverage must be statically checkable)",
-                    line))
-
-    if "/serving/" in path.replace(os.sep, "/"):
-        for i, line in enumerate(lines):
-            if SYNC_RE.search(line) and not PRAGMA_RE.search(line):
-                bad.append((path, i + 1,
-                            "direct host_syncs increment — go through "
-                            "im.note_host_sync() so the registry "
-                            "counter ticks too", line))
-    return bad
+from tools.fflint import LintContext, lint_paths  # noqa: E402
+from tools.fflint.rules.direct_host_sync import DirectHostSyncRule  # noqa: E402
+from tools.fflint.rules.metric_schema import MetricSchemaRule  # noqa: E402
 
 
 def main(argv):
-    schema = load_schema()
     roots = argv[1:] or [
         os.path.join(REPO, "flexflow_tpu", "serving"),
         os.path.join(REPO, "flexflow_tpu", "observability"),
         os.path.join(REPO, "flexflow_tpu", "serve"),
     ]
-    bad = []
-    for path in iter_py(roots):
-        bad.extend(check_file(path, schema))
-    for path, lineno, why, text in bad:
-        print(f"{path}:{lineno}: {why}\n    {text.rstrip()}")
-    if bad:
-        print(f"check_metrics_schema: {len(bad)} violation"
-              f"{'s' if len(bad) != 1 else ''}")
+    findings = lint_paths(roots,
+                          rules=[MetricSchemaRule(), DirectHostSyncRule()],
+                          ctx=LintContext(repo_root=REPO))
+    for f in findings:
+        print(f.render())
+    if findings:
+        print(f"check_metrics_schema: {len(findings)} violation"
+              f"{'s' if len(findings) != 1 else ''}")
         return 1
     print("check_metrics_schema: OK")
     return 0

@@ -1,0 +1,35 @@
+"""fflint — AST-based TPU-hazard static analysis for flexflow_tpu.
+
+A machine-checked invariant suite for the hazard classes that silently
+cost performance on a network-attached TPU: host round trips
+(``host-sync-dataflow``), recompilation (``retrace-hazard``), kernel
+fallbacks from bad tile shapes (``pallas-tiling``), telemetry schema
+drift (``metric-schema`` / ``direct-host-sync``) and use-after-donate
+(``donated-buffer-reuse``).
+
+CLI::
+
+    python -m tools.fflint [paths…] [--json] [--select rules]
+        [--baseline tools/fflint_baseline.json] [--write-baseline]
+        [--changed-only] [--list-rules]
+
+Library::
+
+    from tools.fflint import lint_paths, LintContext
+    findings = lint_paths(["flexflow_tpu"], ctx=LintContext())
+
+See docs/STATIC_ANALYSIS.md for the rule catalog and the why behind
+each invariant.
+"""
+
+from .core import (Finding, LintContext, Module, Rule, all_rules,
+                   apply_baseline, changed_files, default_repo_root,
+                   iter_py_files, lint_file, lint_paths, load_baseline,
+                   write_baseline)
+
+__all__ = [
+    "Finding", "LintContext", "Module", "Rule", "all_rules",
+    "apply_baseline", "changed_files", "default_repo_root",
+    "iter_py_files", "lint_file", "lint_paths", "load_baseline",
+    "write_baseline",
+]

@@ -1,0 +1,126 @@
+"""fflint CLI driver: ``python -m tools.fflint [paths…]``.
+
+Exit codes: 0 clean (or everything grandfathered), 1 new findings,
+2 usage error.  Text output is ``path:line:col: [rule] message`` plus
+the snippet; ``--json`` emits a machine-readable findings list (the
+shape ``Finding.as_dict`` documents) for editor/CI integration.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from .core import (LintContext, all_rules, apply_baseline, changed_files,
+                   default_repo_root, lint_paths, load_baseline,
+                   write_baseline)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m tools.fflint",
+        description="AST-based TPU-hazard static analysis "
+                    "(docs/STATIC_ANALYSIS.md has the rule catalog)")
+    p.add_argument("paths", nargs="*",
+                   help="files/directories to lint (default: "
+                        "flexflow_tpu tools, relative to the repo root)")
+    p.add_argument("--json", action="store_true",
+                   help="emit findings as JSON instead of text")
+    p.add_argument("--select", default="",
+                   help="comma-separated rule ids to run (default: all)")
+    p.add_argument("--baseline", default=None,
+                   help="baseline JSON of grandfathered findings")
+    p.add_argument("--write-baseline", action="store_true",
+                   help="write the current findings to --baseline and "
+                        "exit 0 (garbage-collects stale entries)")
+    p.add_argument("--changed-only", action="store_true",
+                   help="lint only files git reports as changed "
+                        "(fast local iteration; full run if git is "
+                        "unavailable)")
+    p.add_argument("--list-rules", action="store_true",
+                   help="print the rule catalog and exit")
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    rules = all_rules()
+
+    if args.list_rules:
+        for r in rules:
+            print(f"{r.id:24s} [{r.severity}] {r.short}")
+        return 0
+
+    if args.select:
+        wanted = {s.strip() for s in args.select.split(",") if s.strip()}
+        unknown = wanted - {r.id for r in rules}
+        if unknown:
+            print(f"fflint: unknown rule(s): {', '.join(sorted(unknown))}",
+                  file=sys.stderr)
+            return 2
+        rules = [r for r in rules if r.id in wanted]
+
+    repo_root = default_repo_root()
+    paths = args.paths or [os.path.join(repo_root, "flexflow_tpu"),
+                           os.path.join(repo_root, "tools")]
+    missing = [p for p in paths if not os.path.exists(p)]
+    if missing:
+        print(f"fflint: no such path(s): {', '.join(missing)}",
+              file=sys.stderr)
+        return 2
+
+    only = None
+    if args.changed_only:
+        only = changed_files(repo_root)
+        if only is None:
+            print("fflint: git unavailable; linting all files",
+                  file=sys.stderr)
+
+    ctx = LintContext(repo_root=repo_root)
+    findings = lint_paths(paths, rules=rules, ctx=ctx, only_files=only)
+
+    if args.write_baseline:
+        if not args.baseline:
+            print("fflint: --write-baseline requires --baseline",
+                  file=sys.stderr)
+            return 2
+        if args.select or args.changed_only:
+            # a partial run sees only a subset of findings; rewriting
+            # the baseline from it would garbage-collect every live
+            # entry outside the subset (and lose its reason text)
+            print("fflint: refusing --write-baseline with --select/"
+                  "--changed-only — the baseline must be regenerated "
+                  "from a full run", file=sys.stderr)
+            return 2
+        write_baseline(findings, args.baseline)
+        print(f"fflint: wrote {len(findings)} finding(s) to "
+              f"{args.baseline}")
+        return 0
+
+    baseline = load_baseline(args.baseline) if args.baseline else {}
+    new, old = apply_baseline(findings, baseline)
+
+    if args.json:
+        print(json.dumps({
+            "findings": [f.as_dict() for f in new],
+            "baselined": len(old),
+        }, indent=2))
+    else:
+        for f in new:
+            print(f.render())
+        tail = f" ({len(old)} baselined)" if old else ""
+        if new:
+            errors = sum(f.severity == "error" for f in new)
+            warns = len(new) - errors
+            print(f"fflint: {errors} error(s), {warns} warning(s)"
+                  f"{tail} — annotate intentional sites with "
+                  f"'# fflint: disable=<rule>  <why>'")
+        else:
+            print(f"fflint: OK{tail}")
+    return 1 if new else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,376 @@
+"""fflint core: the AST walker, rule API, suppressions and baseline.
+
+The framework half of the TPU-hazard static-analysis suite (the rules
+live in ``tools/fflint/rules/``).  Design contract:
+
+- A **rule** subclasses :class:`Rule`, owns a stable kebab-case ``id``
+  (the suppression / baseline / ``--select`` key) and yields
+  :class:`Finding` objects from ``check(module, ctx)``.  Rules are pure
+  AST analyses — none of them imports JAX, numpy or the package under
+  analysis, so the whole suite runs in milliseconds and is safe inside
+  CI before any heavyweight import.
+
+- A **finding** pins ``rule`` / ``severity`` / ``path:line:col`` /
+  message / the source snippet.  Its identity for baselining is
+  ``(path, rule, normalized snippet)`` — line numbers drift on every
+  edit, the flagged source text does not, so a checked-in baseline
+  survives unrelated refactors.
+
+- **Suppressions** are inline comments::
+
+      np.asarray(x)  # fflint: disable=host-sync-dataflow  <why>
+      risky()        # fflint: disable  (all rules; use sparingly)
+
+  parsed with ``tokenize`` so a ``# fflint:`` inside a string literal
+  never suppresses anything.  The legacy serving pragmas
+  (``# no-sync:``, ``# lint: allow-direct-sync``) are honored by their
+  respective rules for backward compatibility.
+
+- The **baseline** (``tools/fflint_baseline.json``) grandfathers
+  pre-existing findings as a multiset of finding keys, each entry
+  carrying a human ``reason``.  New findings never match it; fixing a
+  baselined site leaves a stale entry that ``--write-baseline``
+  garbage-collects.  The goal state is an EMPTY baseline — annotate
+  intentional hazards inline instead.
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import json
+import os
+import subprocess
+import tokenize
+from dataclasses import dataclass
+from typing import Dict, Iterable, Iterator, List, Optional, Sequence, Set
+
+SEVERITY_ERROR = "error"
+SEVERITY_WARN = "warn"
+
+#: sentinel for "every rule suppressed on this line"
+ALL_RULES = "*"
+
+_DISABLE_PREFIX = "fflint:"
+
+
+# --------------------------------------------------------------- findings
+@dataclass(frozen=True)
+class Finding:
+    rule: str
+    severity: str
+    path: str
+    line: int
+    col: int
+    message: str
+    snippet: str
+
+    def key(self) -> tuple:
+        """Baseline identity: stable across line-number drift."""
+        return (self.path, self.rule, " ".join(self.snippet.split()))
+
+    def as_dict(self) -> dict:
+        return {"rule": self.rule, "severity": self.severity,
+                "path": self.path, "line": self.line, "col": self.col,
+                "message": self.message, "snippet": self.snippet}
+
+    def render(self) -> str:
+        return (f"{self.path}:{self.line}:{self.col}: "
+                f"[{self.rule}] {self.message}\n    {self.snippet}")
+
+
+# ------------------------------------------------------------------ rules
+class Rule:
+    """Base class for fflint rules.
+
+    Subclasses set ``id`` (stable kebab-case), ``severity`` and
+    ``short`` (one-line catalog description, shown by ``--list-rules``)
+    and implement ``check``.
+    """
+
+    id: str = ""
+    severity: str = SEVERITY_ERROR
+    short: str = ""
+
+    def check(self, module: "Module",
+              ctx: "LintContext") -> Iterable[Finding]:
+        raise NotImplementedError
+
+    # helper so rules build findings uniformly
+    def finding(self, module: "Module", node, message: str,
+                severity: Optional[str] = None) -> Finding:
+        line = getattr(node, "lineno", node if isinstance(node, int) else 1)
+        col = getattr(node, "col_offset", 0)
+        return Finding(rule=self.id, severity=severity or self.severity,
+                       path=module.rel, line=line, col=col,
+                       message=message, snippet=module.snippet(line))
+
+
+class LintContext:
+    """Run-wide state shared by rules: the repo root (used to locate
+    ``observability/schema.py``) and optional injected overrides so
+    tests can lint fixture trees without the real repo around."""
+
+    def __init__(self, repo_root: Optional[str] = None,
+                 schema: Optional[dict] = None):
+        self.repo_root = repo_root or default_repo_root()
+        self._schema = schema
+        self._schema_loaded = schema is not None
+
+    @property
+    def metrics_schema(self) -> Optional[dict]:
+        """METRICS_SCHEMA loaded WITHOUT importing flexflow_tpu (the
+        package __init__ pulls in JAX; the schema module itself is a
+        pure dict).  None when the schema file does not exist (fixture
+        trees) — the metric rule then skips name validation."""
+        if not self._schema_loaded:
+            self._schema_loaded = True
+            path = os.path.join(self.repo_root, "flexflow_tpu",
+                                "observability", "schema.py")
+            if os.path.exists(path):
+                ns: dict = {}
+                with open(path) as f:
+                    exec(compile(f.read(), path, "exec"), ns)  # noqa: S102
+                self._schema = ns.get("METRICS_SCHEMA")
+        return self._schema
+
+
+def default_repo_root() -> str:
+    """The directory containing ``tools/`` (two levels above this file)."""
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+
+# ----------------------------------------------------------------- module
+class Module:
+    """One parsed source file handed to every rule: path, text, lines,
+    AST and the per-line suppression table."""
+
+    def __init__(self, path: str, rel: Optional[str] = None,
+                 text: Optional[str] = None):
+        self.path = path
+        self.rel = rel if rel is not None else path
+        if text is None:
+            with open(path, encoding="utf-8") as f:
+                text = f.read()
+        self.text = text
+        self.lines = text.splitlines()
+        self.tree = ast.parse(text)          # SyntaxError -> caller
+        self.suppressions = _parse_suppressions(text)
+
+    def snippet(self, line: int) -> str:
+        if 1 <= line <= len(self.lines):
+            return self.lines[line - 1].strip()
+        return ""
+
+    def line_has(self, line: int, needle: str) -> bool:
+        return needle in (self.lines[line - 1]
+                          if 1 <= line <= len(self.lines) else "")
+
+    def suppressed(self, rule_id: str, line: int) -> bool:
+        rules = self.suppressions.get(line)
+        return bool(rules) and (ALL_RULES in rules or rule_id in rules)
+
+
+def _parse_suppressions(text: str) -> Dict[int, Set[str]]:
+    """``# fflint: disable=a,b`` comments, via tokenize so string
+    literals containing the pragma are ignored.  Bare
+    ``# fflint: disable`` suppresses every rule.  A trailing pragma
+    applies to its own line; a STANDALONE pragma comment line applies
+    to the next code line (blank and comment-only lines in between are
+    skipped), so multi-line reasons read naturally above the site."""
+    out: Dict[int, Set[str]] = {}
+    lines = text.splitlines()
+
+    def _next_code_line(after: int) -> int:
+        for i in range(after, len(lines)):
+            stripped = lines[i].strip()
+            if stripped and not stripped.startswith("#"):
+                return i + 1
+        return after                       # pragma at EOF: inert
+    try:
+        tokens = tokenize.generate_tokens(io.StringIO(text).readline)
+        for tok in tokens:
+            if tok.type != tokenize.COMMENT:
+                continue
+            body = tok.string.lstrip("#").strip()
+            if not body.startswith(_DISABLE_PREFIX):
+                continue
+            body = body[len(_DISABLE_PREFIX):].strip()
+            if not body.startswith("disable"):
+                continue
+            rest = body[len("disable"):]
+            if rest and rest[0] not in " \t=":
+                continue                 # 'disabled=', 'disablex': inert
+            rest = rest.strip()
+            if rest.startswith("="):
+                # rule list: comma-separated, whitespace allowed after
+                # commas (`disable=a, b  reason`) — the list continues
+                # while a token ends with ','; the rest is the reason
+                toks = rest[1:].strip().split()
+                parts: List[str] = []
+                for t in toks:
+                    parts.append(t)
+                    if not t.endswith(","):
+                        break
+                rules: Set[str] = {r for r in "".join(parts).split(",")
+                                   if r}
+                if not rules:
+                    continue             # 'disable=' with no rules: inert
+            else:
+                # bare 'disable' (optionally followed by a reason)
+                # suppresses every rule on the line — a malformed rule
+                # list must NEVER silently widen to this
+                rules = {ALL_RULES}
+            line = tok.start[0]
+            standalone = not lines[line - 1][:tok.start[1]].strip()
+            if standalone:
+                line = _next_code_line(line)
+            out.setdefault(line, set()).update(rules)
+    except tokenize.TokenError:
+        pass
+    return out
+
+
+# ----------------------------------------------------------------- runner
+_SKIP_DIRS = {"__pycache__", ".git", "build", "dist", ".venv", "node_modules"}
+
+
+def iter_py_files(paths: Sequence[str]) -> Iterator[str]:
+    for p in paths:
+        if os.path.isfile(p):
+            if p.endswith(".py"):
+                yield p
+            continue
+        # NOTE: do not wrap os.walk in sorted() — that exhausts the
+        # generator before the dirnames[:] pruning can take effect
+        for dirpath, dirnames, names in os.walk(p):
+            dirnames[:] = sorted(d for d in dirnames
+                                 if d not in _SKIP_DIRS
+                                 and not d.startswith("."))
+            for name in sorted(names):
+                if name.endswith(".py"):
+                    yield os.path.join(dirpath, name)
+
+
+def all_rules() -> List[Rule]:
+    from .rules import ALL_RULES as rules
+
+    return [cls() for cls in rules]
+
+
+def lint_file(path: str, rules: Sequence[Rule], ctx: LintContext,
+              rel: Optional[str] = None) -> List[Finding]:
+    try:
+        module = Module(path, rel=rel)
+    except (SyntaxError, UnicodeDecodeError) as e:
+        line = getattr(e, "lineno", 1) or 1
+        return [Finding(rule="parse-error", severity=SEVERITY_ERROR,
+                        path=rel or path, line=line, col=0,
+                        message=f"file does not parse: {e.msg if hasattr(e, 'msg') else e}",
+                        snippet="")]
+    findings: List[Finding] = []
+    for rule in rules:
+        for f in rule.check(module, ctx):
+            if not module.suppressed(f.rule, f.line):
+                findings.append(f)
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return findings
+
+
+def lint_paths(paths: Sequence[str], rules: Optional[Sequence[Rule]] = None,
+               ctx: Optional[LintContext] = None,
+               only_files: Optional[Set[str]] = None) -> List[Finding]:
+    """Lint every .py under ``paths``.  ``only_files``: absolute-path
+    allowlist (the ``--changed-only`` filter)."""
+    rules = list(rules) if rules is not None else all_rules()
+    ctx = ctx or LintContext()
+    findings: List[Finding] = []
+    for path in iter_py_files(paths):
+        if (only_files is not None
+                and os.path.abspath(path) not in only_files):
+            continue
+        # repo-root-relative finding paths: baseline keys must match
+        # across invocations with absolute vs relative roots (and
+        # across checkouts); files outside the root keep their given
+        # path
+        rel = os.path.relpath(os.path.abspath(path), ctx.repo_root)
+        if rel.startswith(".."):
+            rel = path
+        findings.extend(lint_file(path, rules, ctx, rel=rel))
+    return findings
+
+
+def changed_files(repo_root: str) -> Optional[Set[str]]:
+    """Absolute paths of modified/added/untracked .py files per git
+    (``--changed-only``).  None when git is unavailable — the caller
+    falls back to a full run rather than silently linting nothing."""
+    try:
+        # -uall: without it git collapses an untracked directory to one
+        # '?? dir/' entry and every .py inside it would slip the filter
+        out = subprocess.run(
+            ["git", "-C", repo_root, "status", "--porcelain",
+             "--untracked-files=all"],
+            capture_output=True, text=True, timeout=30, check=True).stdout
+    except (OSError, subprocess.SubprocessError):
+        return None
+    files: Set[str] = set()
+    for line in out.splitlines():
+        if len(line) < 4:
+            continue
+        path = line[3:].strip()
+        if " -> " in path:                    # renames: lint the new side
+            path = path.split(" -> ", 1)[1]
+        path = path.strip('"')
+        if path.endswith(".py"):
+            files.add(os.path.abspath(os.path.join(repo_root, path)))
+    return files
+
+
+# --------------------------------------------------------------- baseline
+BASELINE_VERSION = 1
+
+
+def load_baseline(path: str) -> Dict[tuple, int]:
+    """Baseline file -> multiset {finding key: count}.  Missing file =
+    empty baseline (the desired steady state)."""
+    if not os.path.exists(path):
+        return {}
+    with open(path) as f:
+        data = json.load(f)
+    out: Dict[tuple, int] = {}
+    for entry in data.get("findings", []):
+        key = (entry["path"], entry["rule"],
+               " ".join(entry.get("snippet", "").split()))
+        out[key] = out.get(key, 0) + int(entry.get("count", 1))
+    return out
+
+
+def apply_baseline(findings: Sequence[Finding],
+                   baseline: Dict[tuple, int]) -> tuple:
+    """Split findings into (new, grandfathered) against the multiset."""
+    budget = dict(baseline)
+    new: List[Finding] = []
+    old: List[Finding] = []
+    for f in findings:
+        k = f.key()
+        if budget.get(k, 0) > 0:
+            budget[k] -= 1
+            old.append(f)
+        else:
+            new.append(f)
+    return new, old
+
+
+def write_baseline(findings: Sequence[Finding], path: str,
+                   reason: str = "grandfathered by --write-baseline"):
+    counts: Dict[tuple, int] = {}
+    for f in findings:
+        counts[f.key()] = counts.get(f.key(), 0) + 1
+    entries = [{"path": p, "rule": r, "snippet": s, "count": n,
+                "reason": reason}
+               for (p, r, s), n in sorted(counts.items())]
+    with open(path, "w") as f:
+        json.dump({"version": BASELINE_VERSION, "findings": entries},
+                  f, indent=2, sort_keys=True)
+        f.write("\n")

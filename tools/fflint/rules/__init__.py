@@ -1,0 +1,23 @@
+"""The fflint rule catalog — one module per TPU-hazard class.
+
+Adding a rule: subclass :class:`tools.fflint.core.Rule` in a new
+module here, give it a stable kebab-case ``id`` and a ``short``
+catalog line, and append the class to ``ALL_RULES``.  Document the
+invariant (and the why) in docs/STATIC_ANALYSIS.md.
+"""
+
+from .direct_host_sync import DirectHostSyncRule
+from .donation import DonationRule
+from .host_sync import HostSyncRule
+from .metric_schema import MetricSchemaRule
+from .pallas_tiling import PallasTilingRule
+from .retrace import RetraceRule
+
+ALL_RULES = [
+    HostSyncRule,
+    RetraceRule,
+    PallasTilingRule,
+    MetricSchemaRule,
+    DirectHostSyncRule,
+    DonationRule,
+]

@@ -1,0 +1,316 @@
+"""Shared AST helpers for the jit-aware rules (retrace, donation).
+
+Resolves the three jit spellings the tree uses::
+
+    @jax.jit                                   / @jit
+    @functools.partial(jax.jit, static_argnames=(...), ...)
+    name = jax.jit(fn, donate_argnums=(...))   # fn a local def or lambda
+
+into a :class:`JitSite`: the wrapped function's AST, its parameter
+names, and the static / donated argument sets (literal-folded; entries
+that are not literals are ignored rather than guessed).
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set, Tuple
+
+
+def dotted_name(node: ast.AST) -> str:
+    """'jax.jit' for Attribute(Name('jax'), 'jit'); '' when not a plain
+    dotted path."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def _is_jit_ref(node: ast.AST) -> bool:
+    return dotted_name(node) in ("jit", "jax.jit", "pjit", "jax.pjit")
+
+
+def _is_partial_ref(node: ast.AST) -> bool:
+    return dotted_name(node) in ("partial", "functools.partial")
+
+
+def _literal_ints(node: Optional[ast.AST]) -> Tuple[int, ...]:
+    if node is None:
+        return ()
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for e in node.elts:
+            if isinstance(e, ast.Constant) and isinstance(e.value, int):
+                out.append(e.value)
+        return tuple(out)
+    return ()
+
+
+def _literal_strs(node: Optional[ast.AST]) -> Tuple[str, ...]:
+    if node is None:
+        return ()
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return tuple(e.value for e in node.elts
+                     if isinstance(e, ast.Constant)
+                     and isinstance(e.value, str))
+    return ()
+
+
+@dataclass
+class JitSite:
+    """One jit application resolved back to a function AST."""
+
+    func: ast.AST                      # FunctionDef | Lambda
+    jit_node: ast.AST                  # decorator / jax.jit(...) call
+    static_argnums: Tuple[int, ...] = ()
+    static_argnames: Tuple[str, ...] = ()
+    donate_argnums: Tuple[int, ...] = ()
+    #: name the jitted callable is bound to, for call-site tracking:
+    #: ("name", "block") for ``block = jax.jit(...)``, ("self", "_step")
+    #: for ``self._step = jax.jit(...)``; None for decorators (the def's
+    #: own name serves) and anonymous sites.
+    bound_to: Optional[Tuple[str, str]] = None
+
+    def params(self) -> List[str]:
+        a = self.func.args
+        return ([p.arg for p in getattr(a, "posonlyargs", [])]
+                + [p.arg for p in a.args]
+                + [p.arg for p in a.kwonlyargs])
+
+    def param_defaults(self) -> Dict[str, ast.AST]:
+        a = self.func.args
+        pos = [p.arg for p in getattr(a, "posonlyargs", [])] + \
+              [p.arg for p in a.args]
+        out: Dict[str, ast.AST] = {}
+        for name, d in zip(reversed(pos), reversed(a.defaults)):
+            out[name] = d
+        for p, d in zip(a.kwonlyargs, a.kw_defaults):
+            if d is not None:
+                out[p.arg] = d
+        return out
+
+    def static_params(self) -> Set[str]:
+        pos = self.params()
+        out = set(self.static_argnames)
+        for i in self.static_argnums:
+            if 0 <= i < len(pos):
+                out.add(pos[i])
+        return out
+
+    def traced_params(self) -> Set[str]:
+        return set(self.params()) - self.static_params()
+
+
+def _kwargs_of(call: ast.Call) -> Dict[str, ast.AST]:
+    return {k.arg: k.value for k in call.keywords if k.arg}
+
+
+def _site_from_call(call: ast.Call, func_node: ast.AST) -> JitSite:
+    kw = _kwargs_of(call)
+    return JitSite(func=func_node, jit_node=call,
+                   static_argnums=_literal_ints(kw.get("static_argnums")),
+                   static_argnames=_literal_strs(kw.get("static_argnames")),
+                   donate_argnums=_literal_ints(kw.get("donate_argnums")))
+
+
+def collect_jit_sites(tree: ast.AST) -> List[JitSite]:
+    """Every jit application in a module that resolves to a function AST.
+
+    ``jax.jit(fn, ...)`` resolves ``fn`` to the NEAREST same-named def
+    textually preceding the call — the builder pattern the tree uses
+    (``def block(...): ...; return jax.jit(block, ...)``) nests
+    same-named defs in sibling builders (two ``def block`` in
+    inference_manager.py), so a module-global "last def wins" map would
+    analyze the wrong body for all but one of them.
+    """
+    sites: List[JitSite] = []
+    defs: List[ast.AST] = []             # every (async) def, any depth
+
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defs.append(node)
+            for dec in node.decorator_list:
+                site = _site_from_decorator(dec, node)
+                if site is not None:
+                    sites.append(site)
+
+    def resolve(name: str, at_line: int) -> Optional[ast.AST]:
+        best = None
+        for d in defs:
+            if d.name != name:
+                continue
+            if d.lineno <= at_line and (best is None
+                                        or d.lineno > best.lineno):
+                best = d
+        if best is None:                 # call textually before any def
+            for d in defs:
+                if d.name == name:
+                    return d
+        return best
+
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call) or not _is_jit_ref(node.func):
+            continue
+        if not node.args:
+            continue
+        target = node.args[0]
+        func_node: Optional[ast.AST] = None
+        if isinstance(target, ast.Lambda):
+            func_node = target
+        elif isinstance(target, ast.Name):
+            func_node = resolve(target.id, node.lineno)
+        if func_node is None:
+            continue
+        site = _site_from_call(node, func_node)
+        site.bound_to = _binding_of(tree, node)
+        sites.append(site)
+    return sites
+
+
+def _site_from_decorator(dec: ast.AST,
+                         func: ast.AST) -> Optional[JitSite]:
+    if _is_jit_ref(dec):
+        return JitSite(func=func, jit_node=dec)
+    if isinstance(dec, ast.Call):
+        if _is_jit_ref(dec.func):
+            return _site_from_call(dec, func)
+        if (_is_partial_ref(dec.func) and dec.args
+                and _is_jit_ref(dec.args[0])):
+            kw = _kwargs_of(dec)
+            return JitSite(
+                func=func, jit_node=dec,
+                static_argnums=_literal_ints(kw.get("static_argnums")),
+                static_argnames=_literal_strs(kw.get("static_argnames")),
+                donate_argnums=_literal_ints(kw.get("donate_argnums")))
+    return None
+
+
+def _binding_of(tree: ast.AST,
+                call: ast.Call) -> Optional[Tuple[str, str]]:
+    """('name', n) / ('self', attr) when ``call`` is the sole RHS of an
+    assignment; None otherwise (dict stores etc.)."""
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and node.value is call:
+            if len(node.targets) == 1:
+                t = node.targets[0]
+                if isinstance(t, ast.Name):
+                    return ("name", t.id)
+                if (isinstance(t, ast.Attribute)
+                        and isinstance(t.value, ast.Name)
+                        and t.value.id == "self"):
+                    return ("self", t.attr)
+    return None
+
+
+def iter_scopes(tree: ast.AST):
+    """The module node plus every (async) function def."""
+    yield tree
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node
+
+
+#: host-materialization surface shared by the host-sync and retrace
+#: rules — ONE list so a newly-recognized materializer (``__array__``,
+#: ``np.copyto`` …) cannot be added to one rule and silently missed by
+#: the other
+MATERIALIZER_BUILTINS = {"float", "int", "bool"}
+MATERIALIZER_METHODS = {"item", "tolist"}
+NP_NAMES = {"np", "numpy"}
+NP_MATERIALIZER_FUNCS = {"asarray", "array"}
+
+
+def materializer_target(call: ast.Call) -> Optional[ast.AST]:
+    """The expression a materializer call forces to the host — the arg
+    of ``np.asarray/np.array/int/float/bool/jax.device_get`` or the
+    receiver of ``.item()/.tolist()`` — or None when ``call`` is not a
+    materializer.  ``jnp.asarray`` never syncs and never matches."""
+    f = call.func
+    if isinstance(f, ast.Attribute):
+        if f.attr in MATERIALIZER_METHODS:
+            return f.value
+        if f.attr == "device_get" and call.args:
+            return call.args[0]
+        if (f.attr in NP_MATERIALIZER_FUNCS
+                and isinstance(f.value, ast.Name)
+                and f.value.id in NP_NAMES and call.args):
+            return call.args[0]
+    elif (isinstance(f, ast.Name) and f.id in MATERIALIZER_BUILTINS
+          and len(call.args) == 1):
+        return call.args[0]
+    return None
+
+
+def header_exprs(stmt: ast.stmt) -> list:
+    """The expressions a compound statement's header evaluates (its
+    bodies are separate blocks); the statement itself when simple."""
+    if isinstance(stmt, (ast.If, ast.While)):
+        return [stmt.test]
+    if isinstance(stmt, (ast.For, ast.AsyncFor)):
+        return [stmt.iter]
+    if isinstance(stmt, (ast.With, ast.AsyncWith)):
+        return [i.context_expr for i in stmt.items]
+    if isinstance(stmt, ast.Try):
+        return []
+    return [stmt]
+
+
+def child_blocks(stmt: ast.stmt) -> list:
+    """Statement lists nested under a compound statement."""
+    blocks = []
+    for attr in ("body", "orelse", "finalbody"):
+        b = getattr(stmt, attr, None)
+        if b and not isinstance(b, ast.AST):
+            blocks.append(b)
+    for h in getattr(stmt, "handlers", []) or []:
+        if h.body:
+            blocks.append(h.body)
+    return blocks
+
+
+def walrus_bindings(node: ast.AST) -> List[Tuple[str, ast.AST]]:
+    """``(name, value_expr)`` for every walrus (``:=``) binding inside
+    ``node`` — expression-level bindings that statement-level
+    ``assigned_names`` cannot see (``if (out := dispatch()) ...``)."""
+    out: List[Tuple[str, ast.AST]] = []
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.NamedExpr) and isinstance(sub.target,
+                                                         ast.Name):
+            out.append((sub.target.id, sub.value))
+    return out
+
+
+def assigned_names(stmt: ast.stmt) -> Set[str]:
+    """Plain names (re)bound by a statement, tuple targets included."""
+    out: Set[str] = set()
+
+    def add_target(t: ast.AST):
+        if isinstance(t, ast.Name):
+            out.add(t.id)
+        elif isinstance(t, (ast.Tuple, ast.List)):
+            for e in t.elts:
+                add_target(e)
+        elif isinstance(t, ast.Starred):
+            add_target(t.value)
+
+    if isinstance(stmt, ast.Assign):
+        for t in stmt.targets:
+            add_target(t)
+    elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+        add_target(stmt.target)
+    elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+        add_target(stmt.target)
+    elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+        for item in stmt.items:
+            if item.optional_vars is not None:
+                add_target(item.optional_vars)
+    return out

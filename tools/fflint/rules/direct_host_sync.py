@@ -1,0 +1,55 @@
+"""Rule ``direct-host-sync``: serving code never bumps the raw odometer.
+
+Serving modules must tick the host-sync odometer through
+``InferenceManager.note_host_sync()`` — which also feeds the
+``serving_host_syncs_total`` registry counter — never by a raw
+``…host_syncs += …``: a direct bump silently skips the registry and
+the telemetry snapshot under-reports round trips.  The one legitimate
+site (the odometer increment inside ``note_host_sync`` itself) carries
+an inline suppression.
+
+AST check: any augmented assignment (``+=`` / ``-=``) whose target is
+an attribute or name called ``host_syncs``, in files under a
+``serving/`` directory.  The legacy ``# lint: allow-direct-sync``
+pragma from the old grep lint is honored alongside
+``# fflint: disable=direct-host-sync``.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Iterable, List
+
+from ..core import Finding, LintContext, Module, Rule
+
+LEGACY_PRAGMA = "lint: allow-direct-sync"
+
+
+class DirectHostSyncRule(Rule):
+    id = "direct-host-sync"
+    short = ("serving code must tick host_syncs via note_host_sync() "
+             "(registry counter), never by a raw += on the field")
+
+    def check(self, module: Module,
+              ctx: LintContext) -> Iterable[Finding]:
+        parts = module.rel.replace(os.sep, "/").split("/")
+        if "serving" not in parts:
+            return []
+        findings: List[Finding] = []
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.AugAssign):
+                continue
+            t = node.target
+            name = t.attr if isinstance(t, ast.Attribute) else (
+                t.id if isinstance(t, ast.Name) else None)
+            if name != "host_syncs":
+                continue
+            if module.line_has(node.lineno, LEGACY_PRAGMA):
+                continue
+            findings.append(self.finding(
+                module, node,
+                "direct host_syncs increment — go through "
+                "im.note_host_sync() so the serving_host_syncs_total "
+                "registry counter ticks too"))
+        return findings

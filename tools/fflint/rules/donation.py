@@ -1,0 +1,196 @@
+"""Rule ``donated-buffer-reuse``: a donated buffer is dead after the call.
+
+``jax.jit(fn, donate_argnums=…)`` hands the argument's device buffer to
+XLA for in-place reuse (the serving KV caches and training states all
+rely on it — without donation every decode step would hold two full
+cache allocations).  After the call the donated ``jax.Array`` is
+*deleted*: any later read raises ``RuntimeError: Array has been
+deleted`` — but only on the code path that reaches it, which on a
+conditionally-taken branch ships the crash to production.
+
+The rule resolves jitted callables with literal ``donate_argnums``
+that are bound to a plain name or ``self.<attr>``
+(``block = jax.jit(fn, donate_argnums=(1,))`` / decorated defs /
+``self._step = jax.jit(…)``) and checks every call site in the module:
+
+- a donated positional argument passed as a plain name, where the call
+  statement does NOT rebind that name, is **consumed**; any read of the
+  name after the call (before a rebinding statement) is an error;
+- a consuming call inside a ``for``/``while`` body whose donated name
+  is never rebound in that body is an error at the call site — the
+  second iteration re-donates a deleted buffer.
+
+The safe idiom — ``caches = step(params, caches, …)`` (rebinding in
+the consuming statement, as every serving step does via
+``record["caches"] = …``) — never fires.  Aliases, attribute loads and
+cross-module calls are out of scope (runtime still raises loudly
+there); the rule exists for the silent-until-branch-taken class.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from ..core import Finding, LintContext, Module, Rule
+from ._jax_common import assigned_names, collect_jit_sites, iter_scopes
+
+
+def _donating_callables(tree: ast.AST) -> Dict[Tuple[str, str],
+                                               Tuple[int, ...]]:
+    """{("name"|"self", identifier): donated positional indices}."""
+    out: Dict[Tuple[str, str], Tuple[int, ...]] = {}
+    for site in collect_jit_sites(tree):
+        if not site.donate_argnums:
+            continue
+        key = site.bound_to
+        if key is None and isinstance(site.func, (ast.FunctionDef,
+                                                  ast.AsyncFunctionDef)):
+            key = ("name", site.func.name)   # decorated def
+        if key is not None:
+            out[key] = site.donate_argnums
+    return out
+
+
+def _call_key(call: ast.Call) -> Optional[Tuple[str, str]]:
+    f = call.func
+    if isinstance(f, ast.Name):
+        return ("name", f.id)
+    if (isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name)
+            and f.value.id == "self"):
+        return ("self", f.attr)
+    return None
+
+
+def _reads_name(stmt: ast.stmt, name: str) -> Optional[ast.AST]:
+    for node in ast.walk(stmt):
+        if (isinstance(node, ast.Name) and node.id == name
+                and isinstance(node.ctx, ast.Load)):
+            return node
+    return None
+
+
+class DonationRule(Rule):
+    id = "donated-buffer-reuse"
+    short = ("a buffer donated to a jitted call (donate_argnums) is "
+             "deleted by XLA; reading it afterwards crashes at runtime")
+
+    def check(self, module: Module,
+              ctx: LintContext) -> Iterable[Finding]:
+        donors = _donating_callables(module.tree)
+        if not donors:
+            return []
+        findings: List[Finding] = []
+        for scope in iter_scopes(module.tree):
+            self._check_scope(scope, donors, module, findings)
+        return findings
+
+    def _check_scope(self, scope, donors, module: Module,
+                     findings: List[Finding]) -> None:
+        self._walk_block(scope.body, [], donors, module, findings)
+
+    def _walk_block(self, block: List[ast.stmt],
+                    tails: List[List[ast.stmt]], donors,
+                    module: Module, findings: List[Finding]) -> None:
+        """``tails``: statement lists that execute AFTER this block
+        finishes (the enclosing blocks' remainders, innermost first) —
+        the structural "what runs next", so a read in the mutually-
+        exclusive ``else`` arm of the consuming call's ``if`` is never
+        miscounted as running after it."""
+        from ._jax_common import child_blocks
+
+        for i, st in enumerate(block):
+            if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.ClassDef)):
+                continue                 # separate scope
+            after = [block[i + 1:]] + tails
+            for call in self._own_calls(st):
+                key = _call_key(call)
+                if key not in donors:
+                    continue
+                rebound_here = assigned_names(st)
+                for pos in donors[key]:
+                    if pos >= len(call.args):
+                        continue
+                    arg = call.args[pos]
+                    if not isinstance(arg, ast.Name):
+                        continue
+                    if arg.id in rebound_here:
+                        continue         # caches = step(params, caches)
+                    self._check_consumed(arg.id, st, after, module,
+                                         findings, call)
+            for sub in child_blocks(st):
+                self._walk_block(sub, after, donors, module, findings)
+
+    def _check_consumed(self, name: str, call_stmt: ast.stmt,
+                        after: List[List[ast.stmt]], module: Module,
+                        findings: List[Finding], call: ast.Call) -> None:
+        # loop hazard: consuming call inside a loop that never rebinds
+        loop = self._enclosing_loop(call_stmt, module.tree)
+        if loop is not None:
+            rebinds = any(name in assigned_names(s)
+                          for s in ast.walk(loop)
+                          if isinstance(s, ast.stmt))
+            if not rebinds:
+                findings.append(self.finding(
+                    module, call,
+                    f"'{name}' is donated to a jitted call inside a "
+                    f"loop but never rebound in the loop body — the "
+                    f"second iteration re-donates a deleted buffer"))
+                return
+        for stmts in after:
+            for later in stmts:
+                read = _reads_name(later, name)
+                if read is not None:
+                    # a read in the rebinding statement itself still
+                    # reads the deleted buffer (``x = g(x)`` after
+                    # donating x)
+                    findings.append(self.finding(
+                        module, read,
+                        f"'{name}' was donated to the jitted call at "
+                        f"line {call.lineno} (donate_argnums) and read "
+                        f"afterwards — the buffer is deleted by XLA "
+                        f"and this read raises at runtime"))
+                    return
+                if name in assigned_names(later):
+                    return
+
+    @staticmethod
+    def _own_calls(st: ast.stmt):
+        from ._jax_common import header_exprs
+
+        for expr in header_exprs(st):
+            for node in ast.walk(expr):
+                if isinstance(node, ast.Call):
+                    yield node
+
+    @staticmethod
+    def _enclosing_loop(stmt: ast.stmt, tree: ast.AST):
+        """The innermost for/while that RE-EXECUTES ``stmt`` per
+        iteration: it must lie inside the same function scope — a loop
+        that merely (re)defines the enclosing ``def`` does not re-donate
+        anything, so the lookup stops at the innermost function
+        boundary between the loop and the statement."""
+        def contains(node, line):
+            return (node.lineno <= line
+                    <= max(getattr(node, "end_lineno", node.lineno),
+                           node.lineno))
+
+        innermost_def = None
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)) \
+                    and contains(node, stmt.lineno):
+                if (innermost_def is None
+                        or node.lineno > innermost_def.lineno):
+                    innermost_def = node
+        best = None
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.For, ast.AsyncFor, ast.While)) \
+                    and contains(node, stmt.lineno):
+                if (innermost_def is not None
+                        and node.lineno < innermost_def.lineno):
+                    continue             # loop outside the stmt's scope
+                if best is None or node.lineno > best.lineno:
+                    best = node
+        return best

@@ -1,0 +1,220 @@
+"""Rule ``host-sync-dataflow``: device fetches must tick the odometer.
+
+Every materialization of a device array in the serving path costs a
+full host<->device round trip (fatal over a network-tunneled chip);
+``InferenceManager.note_host_sync()`` is the odometer the decode-block
+tests pin syncs-per-token against.  The odometer is only as honest as
+its coverage, so every fetch of a step result must tick it.
+
+This is the ASSIGNMENT-BASED replacement for the old
+``tools/check_host_syncs.py`` grep (a name-convention whitelist with a
+±3-line window): names bound from the device-returning
+``im.inference`` / ``im.decode_block`` dispatches are tracked as
+*device-tainted* through aliases (``x = out``, ``a, b = outs``, ``x = outs[0][:, 0]``, loop
+targets over tainted iterables), and any materialization of a tainted
+value —
+
+    ``np.asarray(x)`` / ``np.array(x)`` / ``float(x)`` / ``int(x)`` /
+    ``bool(x)`` / ``x.item()`` / ``x.tolist()`` / ``jax.device_get(x)``
+
+— must have a ``note_host_sync(`` call in the same **statement region**:
+the fetch's own statement or an immediately-adjacent sibling statement
+in the same block.  (Several fetches of one dispatch's results ride one
+round trip, so neighbors legitimately share a tick; anything farther
+than one statement away is a different region and the old window's
+false-pass class.)  Materializer results are host values — assigning
+from ``np.asarray(...)`` UNtaints the target, so downstream
+``int(P[...])`` bookkeeping never false-positives.
+
+Taint is per-function (module scope included), forward, branch-unioned;
+closures are separate scopes.  ``jnp.asarray`` never syncs and is never
+flagged.  A knowingly-unsynced fetch is annotated
+``# fflint: disable=host-sync-dataflow  <why>`` (the legacy
+``# no-sync: <why>`` pragma is still honored).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Set, Tuple
+
+from ..core import Finding, LintContext, Module, Rule
+from ._jax_common import (assigned_names, child_blocks, header_exprs,
+                          iter_scopes, materializer_target,
+                          walrus_bindings)
+
+#: dispatches whose results are DEVICE arrays (fetching them syncs).
+#: ``im.beam_block`` is deliberately absent: its contract is
+#: sync-inside — it materializes the expansion history itself, ticks
+#: note_host_sync() once for the ride-along fetches and returns host
+#: numpy, so downstream int()/float() bookkeeping reads are free.
+DISPATCH_METHODS = {"inference", "decode_block"}
+LEGACY_PRAGMA = "# no-sync"
+
+
+def _is_dispatch_call(node: ast.AST) -> bool:
+    return (isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr in DISPATCH_METHODS)
+
+
+def _contains_taint(node: ast.AST, tainted: Set[str]) -> bool:
+    """Does this expression read a tainted name or a dispatch result?"""
+    for sub in ast.walk(node):
+        if (isinstance(sub, ast.Name) and isinstance(sub.ctx, ast.Load)
+                and sub.id in tainted):
+            return True
+        if _is_dispatch_call(sub):
+            return True
+    return False
+
+
+def _is_materializer_root(expr: ast.AST) -> bool:
+    """Is this expression a materializer call (its value lives on the
+    host, so assigning from it clears taint)?"""
+    return (isinstance(expr, ast.Call)
+            and materializer_target(expr) is not None)
+
+
+def _contains_sync(stmt: ast.stmt) -> bool:
+    """Does this statement UNCONDITIONALLY execute a note_host_sync()?
+
+    Syncs buried in the bodies of adjacent ``if``/``for``/``while``
+    statements do not count — a conditionally-executed tick cannot
+    cover an unconditional fetch (a false-pass class of the old ±3-line
+    window).  ``with`` bodies execute unconditionally and stay
+    transparent."""
+    for expr in header_exprs(stmt):
+        for sub in ast.walk(expr):
+            if (isinstance(sub, ast.Call)
+                    and isinstance(sub.func, ast.Attribute)
+                    and sub.func.attr == "note_host_sync"):
+                return True
+    if isinstance(stmt, (ast.With, ast.AsyncWith)):
+        return any(_contains_sync(s) for s in stmt.body)
+    if isinstance(stmt, ast.Try):
+        return any(_contains_sync(s)
+                   for s in list(stmt.body) + list(stmt.finalbody))
+    return False
+
+
+class HostSyncRule(Rule):
+    id = "host-sync-dataflow"
+    short = ("materialization of a device-dispatch result without a "
+             "note_host_sync() in the same statement region")
+
+    def check(self, module: Module,
+              ctx: LintContext) -> Iterable[Finding]:
+        findings: List[Finding] = []
+        for scope in iter_scopes(module.tree):
+            tainted: Set[str] = set()
+            self._walk_block(scope.body, tainted, module, findings)
+        return findings
+
+    # ------------------------------------------------------------ walker
+    def _walk_block(self, stmts: List[ast.stmt], tainted: Set[str],
+                    module: Module, findings: List[Finding]) -> None:
+        synced = [_contains_sync(s) for s in stmts]
+        for i, st in enumerate(stmts):
+            region_ok = (synced[i]
+                         or (i > 0 and synced[i - 1])
+                         or (i + 1 < len(stmts) and synced[i + 1]))
+            if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.ClassDef)):
+                continue                      # separate scope
+            for expr in header_exprs(st):
+                self._check_fetches(expr, tainted, region_ok, module,
+                                    findings)
+            # taint transfer AFTER the sink check (P = np.asarray(packed)
+            # checks `packed`'s taint, then binds P as a host value)
+            self._update_taint(st, tainted)
+            # walrus bindings live inside expressions, invisible to the
+            # statement-level update: `if (out := im.decode_block(...))`
+            # must taint out for the statements that follow
+            for wname, wval in walrus_bindings(st):
+                if _contains_taint(wval, tainted):
+                    tainted.add(wname)
+            unconditional = isinstance(st, (ast.With, ast.AsyncWith))
+            for block in child_blocks(st):
+                if unconditional:
+                    # a with-body always executes: taint AND untaint
+                    # flow through to the code after it
+                    self._walk_block(block, tainted, module, findings)
+                else:
+                    # if/for/while/try bodies may not execute: merge
+                    # conservatively — taint added on the branch stays
+                    # visible afterwards, but an UNTAINT on the branch
+                    # must not clear the fall-through path (the fetch
+                    # after `if flag: outs = np.asarray(outs); sync()`
+                    # is still a device fetch when flag is False)
+                    branch = set(tainted)
+                    self._walk_block(block, branch, module, findings)
+                    tainted |= branch
+
+    def _check_fetches(self, root: ast.AST, tainted: Set[str],
+                       region_ok: bool, module: Module,
+                       findings: List[Finding]) -> None:
+        # pruning walk: lambda bodies are DEFERRED code — their fetches
+        # execute (and must sync) at the call site, not here.  ast.walk
+        # cannot prune, so maintain the stack by hand.
+        stack = [root]
+        while stack:
+            node = stack.pop()
+            if isinstance(node, ast.Lambda):
+                continue
+            stack.extend(ast.iter_child_nodes(node))
+            if not isinstance(node, ast.Call):
+                continue
+            fetched = materializer_target(node)
+            if fetched is not None and not _contains_taint(fetched,
+                                                           tainted):
+                fetched = None
+            if fetched is None or region_ok:
+                continue
+            if module.line_has(node.lineno, LEGACY_PRAGMA):
+                continue
+            what = (fetched.id if isinstance(fetched, ast.Name)
+                    else ast.unparse(fetched)[:40])
+            findings.append(self.finding(
+                module, node,
+                f"device fetch of dispatch result '{what}' without a "
+                f"note_host_sync() in the same statement region — the "
+                f"host-sync odometer under-counts a round trip"))
+
+    # ------------------------------------------------------------- taint
+    def _update_taint(self, st: ast.stmt, tainted: Set[str]) -> None:
+        targets = assigned_names(st)
+        if not targets:
+            return
+        value = getattr(st, "value", None)
+        if isinstance(st, (ast.For, ast.AsyncFor)):
+            # loop over a tainted iterable taints the loop variable
+            if _contains_taint(st.iter, tainted):
+                tainted |= targets
+            return
+        if isinstance(st, (ast.With, ast.AsyncWith)):
+            if any(_contains_taint(i.context_expr, tainted)
+                   for i in st.items):
+                tainted |= targets
+            return
+        if value is None:
+            return
+        if isinstance(st, ast.AugAssign):
+            # the target is READ by an augmented assignment, so taint is
+            # preserved (``out += 1`` keeps out a device value); a
+            # tainted RHS taints it too
+            if _contains_taint(value, tainted):
+                tainted |= targets
+            return
+        # materializer at the root of the RHS yields a HOST value; a
+        # tuple display of materializers (the multi-fetch idiom
+        # ``a, b = np.asarray(x), np.asarray(y)``) does too
+        if _is_materializer_root(value) or (
+                isinstance(value, (ast.Tuple, ast.List)) and value.elts
+                and all(_is_materializer_root(e) for e in value.elts)):
+            tainted -= targets
+            return
+        if _contains_taint(value, tainted):
+            tainted |= targets
+        else:
+            tainted -= targets           # clean reassignment kills taint

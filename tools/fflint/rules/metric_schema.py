@@ -1,0 +1,90 @@
+"""Rule ``metric-schema``: the emitted metric vocabulary is enumerable.
+
+Every metric name passed to a registry factory —
+``.counter("…")`` / ``.gauge("…")`` / ``.histogram("…")`` — must be a
+string literal declared in
+``flexflow_tpu/observability/schema.METRICS_SCHEMA`` with a matching
+type.  The registry enforces this at runtime too, but a code path that
+only runs on chip would ship the violation; this gate fails in CI
+first.  Non-literal names are rejected outright: the schema exists
+precisely so the emitted vocabulary is statically enumerable (the
+reference ships a fixed ProfileInfo struct the same way,
+request_manager.h:244-250).
+
+AST-level (subsumes the wrapped-call blindspots of the old
+``tools/check_metrics_schema.py`` regex): a call whose name literal
+sits on the next line, or is spelled as an f-string/variable, parses to
+the same Call node and is validated or rejected accordingly.  Calls on
+obvious non-registry receivers (``np.histogram`` …) are exempt.
+
+The schema is loaded by ``exec`` of the schema file, NOT by importing
+``flexflow_tpu`` (whose ``__init__`` pulls in JAX) — the rule stays
+milliseconds-fast and usable in JAX-free environments.  When no schema
+file exists (fixture trees without one), name validation is skipped
+but the non-literal check still applies.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List
+
+from ..core import Finding, LintContext, Module, Rule
+
+FACTORIES = {"counter", "gauge", "histogram"}
+#: receivers that have same-named methods/functions but are not the
+#: metrics registry (np.histogram, pandas plotting, …)
+SKIP_RECEIVERS = {"np", "numpy", "jnp", "scipy", "torch", "plt", "pd",
+                  "pandas", "ax", "axes"}
+
+
+class MetricSchemaRule(Rule):
+    id = "metric-schema"
+    short = ("registry.counter/gauge/histogram names must be literals "
+             "declared in observability/schema.py with matching type")
+
+    def check(self, module: Module,
+              ctx: LintContext) -> Iterable[Finding]:
+        findings: List[Finding] = []
+        schema = ctx.metrics_schema
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            if not (isinstance(f, ast.Attribute) and f.attr in FACTORIES):
+                continue
+            if (isinstance(f.value, ast.Name)
+                    and f.value.id in SKIP_RECEIVERS):
+                continue
+            name_node = node.args[0] if node.args else None
+            if name_node is None:
+                for kwarg in node.keywords:
+                    if kwarg.arg == "name":
+                        name_node = kwarg.value
+            if name_node is None:
+                continue
+            if isinstance(name_node, ast.Constant) and isinstance(
+                    name_node.value, str):
+                if schema is None:
+                    continue
+                name = name_node.value
+                decl = schema.get(name)
+                if decl is None:
+                    findings.append(self.finding(
+                        module, node,
+                        f"metric {name!r} is not declared in "
+                        f"observability/schema.py — declare it (with "
+                        f"help text) before emitting it"))
+                elif decl.get("type") != f.attr:
+                    findings.append(self.finding(
+                        module, node,
+                        f"metric {name!r} is declared as "
+                        f"{decl.get('type')!r} but created as "
+                        f"{f.attr!r}"))
+            else:
+                findings.append(self.finding(
+                    module, node,
+                    f"metric factory .{f.attr}() called with a "
+                    f"non-literal name — the schema's emitted "
+                    f"vocabulary must be statically enumerable"))
+        return findings

@@ -1,0 +1,337 @@
+"""Rule ``pallas-tiling``: Mosaic tile invariants on literal shapes.
+
+TPU vector memory is tiled ``(sublane, lane)`` with lane fixed at 128
+and the minimum sublane count set by dtype — f32 tiles are (8, 128),
+bf16 (16, 128), int8/fp8 (32, 128) (see /opt guides; the int8 row is
+the invariant behind the PR-2 bug where the flash append's
+read-modify-write window had to widen from 16 to 32 positions when the
+KV cache went int8: a 16-aligned window slice of an int8 cache is not
+addressable by Mosaic's (32, 128) tiling and the kernel silently fell
+back to the XLA path).
+
+The rule constant-folds literal integer assignments per scope (``W =
+32``, ``TS = 2 * W`` …) and then checks every shape it can fully fold:
+
+- ``pl.BlockSpec((…block shape…), index_map)`` and ``pltpu.VMEM((…),
+  dtype)`` / scratch shapes:
+  * **sublane** (second-to-last) literal dim > 1 must be a multiple of
+    the dtype's minimum sublane count — 8 when the dtype is unknown
+    statically (every dtype's minimum is a multiple of 8), the exact
+    table value when the dtype expression is ``jnp.int8`` etc.
+    (error).  BlockSpec carries no dtype itself, but an OUT BlockSpec
+    rides its ``out_shape``'s dtype — when that dtype is literal, the
+    out tile gets the exact table check, so the int8 32-sublane
+    invariant fires on BlockSpec tiles too;
+  * **lane** (last) literal dim > 1 that is not a multiple of 128 is a
+    warn — Mosaic pads it to a full tile, silently wasting VMEM and
+    bandwidth (a deliberate scalar column like ``(KVG, 1)`` running-max
+    scratch is exempt via the > 1 guard).
+- ``grid=`` tuples cross-checked against a foldable ``out_shape`` +
+  out ``BlockSpec``: when grid, block and array dims all fold, the
+  blocks must tile the array exactly (``grid[i] * block[i] ==
+  shape[i]``) — a grid that under-covers drops tail elements, one that
+  over-covers re-runs programs on clamped indices (error).
+
+Real kernels mostly pass runtime-derived shapes (nothing folds —
+nothing to check); the rule exists so the next hand-written constant
+tile (the usual way these bugs arrive) is machine-checked.  Applies
+only to modules that import ``jax.experimental.pallas``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Tuple
+
+from ..core import SEVERITY_WARN, Finding, LintContext, Module, Rule
+from ._jax_common import dotted_name, iter_scopes
+
+LANE = 128
+SUBLANE = {
+    "float32": 8, "f32": 8, "int32": 8, "uint32": 8,
+    "bfloat16": 16, "bf16": 16, "float16": 16, "f16": 16,
+    "int8": 32, "uint8": 32,
+    "float8_e4m3fn": 32, "float8_e5m2": 32, "fp8": 32,
+}
+
+
+def _imports_pallas(tree: ast.AST) -> bool:
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom):
+            if node.module and "pallas" in node.module:
+                return True
+            if any("pallas" in (a.name or "") for a in node.names):
+                return True
+        elif isinstance(node, ast.Import):
+            if any("pallas" in a.name for a in node.names):
+                return True
+    return False
+
+
+class _ConstEnv:
+    """Literal-int constant folding over one scope, document order."""
+
+    def __init__(self):
+        self.env: Dict[str, int] = {}
+
+    def fold(self, node: ast.AST) -> Optional[int]:
+        if isinstance(node, ast.Constant) and isinstance(node.value, int) \
+                and not isinstance(node.value, bool):
+            return node.value
+        if isinstance(node, ast.Name):
+            return self.env.get(node.id)
+        if isinstance(node, ast.UnaryOp) and isinstance(node.op, ast.USub):
+            v = self.fold(node.operand)
+            return -v if v is not None else None
+        if isinstance(node, ast.BinOp):
+            lhs, rhs = self.fold(node.left), self.fold(node.right)
+            if lhs is None or rhs is None:
+                return None
+            try:
+                if isinstance(node.op, ast.Add):
+                    return lhs + rhs
+                if isinstance(node.op, ast.Sub):
+                    return lhs - rhs
+                if isinstance(node.op, ast.Mult):
+                    return lhs * rhs
+                if isinstance(node.op, ast.FloorDiv):
+                    return lhs // rhs
+                if isinstance(node.op, ast.Mod):
+                    return lhs % rhs
+                if isinstance(node.op, ast.Pow):
+                    return lhs ** rhs
+            except (ZeroDivisionError, OverflowError):
+                return None
+        return None
+
+    def fold_shape(self, node: ast.AST) -> Optional[Tuple[int, ...]]:
+        if not isinstance(node, (ast.Tuple, ast.List)):
+            return None
+        dims = [self.fold(e) for e in node.elts]
+        if any(d is None for d in dims):
+            return None
+        return tuple(dims)  # type: ignore[arg-type]
+
+    def bind(self, stmt: ast.stmt) -> None:
+        if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 \
+                and isinstance(stmt.targets[0], ast.Name):
+            v = self.fold(stmt.value)
+            name = stmt.targets[0].id
+            if v is not None:
+                self.env[name] = v
+            else:
+                self.env.pop(name, None)   # unfoldable rebind: unknown
+        else:
+            # any other (re)binding of a known name invalidates it
+            for sub in ast.walk(stmt):
+                if isinstance(sub, ast.Name) and isinstance(
+                        sub.ctx, (ast.Store, ast.Del)):
+                    self.env.pop(sub.id, None)
+
+
+def _dtype_name(node: Optional[ast.AST]) -> Optional[str]:
+    if node is None:
+        return None
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    dn = dotted_name(node)
+    if dn:
+        leaf = dn.split(".")[-1]
+        if leaf in SUBLANE:
+            return leaf
+    return None
+
+
+class PallasTilingRule(Rule):
+    id = "pallas-tiling"
+    short = ("literal Pallas block/scratch shapes must respect the "
+             "dtype sublane table (8/f32, 16/bf16, 32/int8) and grids "
+             "must tile padded shapes exactly")
+
+    def check(self, module: Module,
+              ctx: LintContext) -> Iterable[Finding]:
+        if not _imports_pallas(module.tree):
+            return []
+        findings: List[Finding] = []
+        # module-level literal constants (``W = 16``) seed every
+        # function scope's environment
+        module_env = _ConstEnv()
+        for st in module.tree.body:
+            if not isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                   ast.ClassDef)):
+                module_env.bind(st)
+        for scope in iter_scopes(module.tree):
+            env = _ConstEnv()
+            env.env = dict(module_env.env)
+            if isinstance(scope, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                # parameters shadow module constants (their runtime
+                # values are unknown)
+                a = scope.args
+                for p in (getattr(a, "posonlyargs", []) + a.args
+                          + a.kwonlyargs):
+                    env.env.pop(p.arg, None)
+            body = scope.body if isinstance(scope.body, list) else []
+            self._walk(body, env, module, findings)
+        return findings
+
+    def _walk(self, stmts: List[ast.stmt], env: _ConstEnv,
+              module: Module, findings: List[Finding]) -> None:
+        from ._jax_common import child_blocks, header_exprs
+
+        for st in stmts:
+            if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.ClassDef)):
+                continue                     # separate scope/env
+            # document-order: check this statement's own expressions,
+            # bind, then recurse — a branch-local rebind (``if q:
+            # W = 32; VMEM((W, 128), …)``) must see ITS value, not the
+            # pre-statement one
+            for expr in header_exprs(st):
+                for node in ast.walk(expr):
+                    if isinstance(node, ast.Call):
+                        self._check_call(node, env, module, findings)
+            blocks = child_blocks(st)
+            if not blocks:
+                env.bind(st)
+                continue
+            if isinstance(st, (ast.With, ast.AsyncWith)):
+                for block in blocks:
+                    self._walk(block, env, module, findings)
+            else:
+                # conditional bodies fold with their own env copy;
+                # names they (re)bind are unknown afterwards
+                for block in blocks:
+                    child = _ConstEnv()
+                    child.env = dict(env.env)
+                    self._walk(block, child, module, findings)
+                for sub in ast.walk(st):
+                    if isinstance(sub, ast.Name) and isinstance(
+                            sub.ctx, (ast.Store, ast.Del)):
+                        env.env.pop(sub.id, None)
+
+    # ------------------------------------------------------------ checks
+    def _check_call(self, call: ast.Call, env: _ConstEnv,
+                    module: Module, findings: List[Finding]) -> None:
+        name = dotted_name(call.func)
+        leaf = name.split(".")[-1] if name else ""
+        if leaf == "BlockSpec":
+            shape_node = call.args[0] if call.args else None
+            for kw in call.keywords:
+                if kw.arg == "block_shape":
+                    shape_node = kw.value
+            if shape_node is not None:
+                self._check_shape(shape_node, None, env, module,
+                                  findings, what="BlockSpec block shape")
+        elif leaf == "VMEM":
+            shape_node = call.args[0] if len(call.args) >= 1 else None
+            dtype = _dtype_name(call.args[1]) if len(call.args) >= 2 \
+                else None
+            if shape_node is not None:
+                self._check_shape(shape_node, dtype, env, module,
+                                  findings, what="VMEM scratch shape")
+        if leaf in ("pallas_call", "PrefetchScalarGridSpec", "GridSpec"):
+            self._check_grid(call, env, module, findings)
+
+    def _check_shape(self, shape_node: ast.AST, dtype: Optional[str],
+                     env: _ConstEnv, module: Module,
+                     findings: List[Finding], what: str) -> None:
+        if not isinstance(shape_node, (ast.Tuple, ast.List)):
+            return
+        dims = [env.fold(e) for e in shape_node.elts]
+        if len(dims) < 2:
+            return
+        sub, lane = dims[-2], dims[-1]
+        min_sub = SUBLANE.get(dtype or "", 8)
+        if sub is not None and sub > 1 and sub % min_sub:
+            dt = dtype or "any dtype"
+            findings.append(self.finding(
+                module, shape_node.elts[-2],
+                f"{what}: sublane (second-to-last) dim {sub} is not a "
+                f"multiple of {min_sub} (minimum sublane tile for "
+                f"{dt}) — Mosaic cannot address the block "
+                f"(int8 needs 32, bf16 16, f32 8)"))
+        if lane is not None and lane > 1 and lane % LANE:
+            findings.append(self.finding(
+                module, shape_node.elts[-1],
+                f"{what}: lane (last) dim {lane} is not a multiple of "
+                f"{LANE} — Mosaic pads every block to full 128-lane "
+                f"tiles, silently wasting VMEM/bandwidth",
+                severity=SEVERITY_WARN))
+
+    def _check_grid(self, call: ast.Call, env: _ConstEnv,
+                    module: Module, findings: List[Finding]) -> None:
+        kw = {k.arg: k.value for k in call.keywords if k.arg}
+        # dtype-correlated sublane check: an out BlockSpec's tile rides
+        # the out_shape's dtype — the one place a BlockSpec's dtype IS
+        # statically known, so the int8 32-sublane invariant can fire
+        # on BlockSpec tiles too (the generic dtype-less check can only
+        # enforce the 8 floor).  Guarded to dims passing the 8 floor so
+        # the generic check never double-reports the same dim.
+        out_dtype = self._sds_dtype(kw.get("out_shape"))
+        if out_dtype is not None:
+            for spec in self._blockspecs_of(kw.get("out_specs")):
+                if not spec.args:
+                    continue
+                dims = env.fold_shape(spec.args[0])
+                if dims is None or len(dims) < 2:
+                    continue
+                sub = dims[-2]
+                min_sub = SUBLANE.get(out_dtype, 8)
+                if sub > 1 and sub % 8 == 0 and sub % min_sub:
+                    findings.append(self.finding(
+                        module, spec.args[0],
+                        f"out BlockSpec sublane dim {sub} is not a "
+                        f"multiple of {min_sub}, the minimum sublane "
+                        f"tile for the out_shape dtype {out_dtype} "
+                        f"(int8 needs 32, bf16 16, f32 8)"))
+        grid = env.fold_shape(kw.get("grid")) if "grid" in kw else None
+        if grid is None:
+            return
+        out_shape = self._fold_sds(kw.get("out_shape"), env)
+        block = None
+        out_specs = kw.get("out_specs")
+        if isinstance(out_specs, ast.Call) \
+                and dotted_name(out_specs.func).endswith("BlockSpec") \
+                and out_specs.args:
+            block = env.fold_shape(out_specs.args[0])
+        if out_shape is None or block is None:
+            return
+        if not (len(grid) == len(block) == len(out_shape)):
+            return
+        for i, (g, b, s) in enumerate(zip(grid, block, out_shape)):
+            if g * b != s:
+                findings.append(self.finding(
+                    module, kw["grid"],
+                    f"grid dim {i} ({g}) x block dim ({b}) != padded "
+                    f"shape ({s}) — the grid must tile the padded "
+                    f"array exactly (under-covering drops the tail, "
+                    f"over-covering re-runs clamped programs)"))
+
+    @staticmethod
+    def _fold_sds(node: Optional[ast.AST],
+                  env: _ConstEnv) -> Optional[Tuple[int, ...]]:
+        """Fold ``jax.ShapeDtypeStruct((…), dtype)``'s shape."""
+        if (isinstance(node, ast.Call)
+                and dotted_name(node.func).endswith("ShapeDtypeStruct")
+                and node.args):
+            return env.fold_shape(node.args[0])
+        return None
+
+    @staticmethod
+    def _sds_dtype(node: Optional[ast.AST]) -> Optional[str]:
+        """The literal dtype of a ``jax.ShapeDtypeStruct((…), dtype)``."""
+        if (isinstance(node, ast.Call)
+                and dotted_name(node.func).endswith("ShapeDtypeStruct")
+                and len(node.args) >= 2):
+            return _dtype_name(node.args[1])
+        return None
+
+    @staticmethod
+    def _blockspecs_of(node: Optional[ast.AST]):
+        """BlockSpec call nodes of an out_specs value (single or
+        tuple/list of them)."""
+        cands = (node.elts if isinstance(node, (ast.Tuple, ast.List))
+                 else [node] if node is not None else [])
+        return [c for c in cands
+                if isinstance(c, ast.Call)
+                and dotted_name(c.func).endswith("BlockSpec")]

@@ -1,0 +1,238 @@
+"""Rule ``retrace-hazard``: Python-level control flow on traced values.
+
+Inside a jitted function every Python ``if``/``while`` runs at TRACE
+time.  Branching on a traced argument either crashes
+(TracerBoolConversionError) or — when the value sneaks in as a weakly
+typed Python scalar — silently burns a recompilation per distinct
+value: on a network-attached chip each retrace costs seconds of compile
+service round trips, the exact hazard class the serving bucket tables
+(pow2_bucket, the step-cache keys) exist to bound.
+
+Checks, per jit site resolved by ``_jax_common.collect_jit_sites``
+(decorator, ``partial(jax.jit, ...)`` and ``name = jax.jit(fn, ...)``
+spellings):
+
+- **traced-branch** (error): ``if``/``while`` whose test reads a traced
+  parameter's *value*.  ``x is None`` / ``x is not None`` comparisons
+  are exempt (trace-time structure dispatch, resolved per avals);
+  static parameters (``static_argnums`` / ``static_argnames``) are
+  exempt.  Nested function defs (scan/cond bodies) are traced too and
+  their parameters join the traced set.
+- **shape-branch** (warn): the test reads only ``.shape`` / ``.ndim``
+  / ``.dtype`` / ``len()`` of traced parameters.  Shapes are static so
+  this *works*, but it forks one compile variant per distinct shape —
+  legitimate only when the caller buckets shapes (pow2_bucket); the
+  warn severity makes the author say so with a suppression.
+- **concretization** (error): ``int()`` / ``float()`` / ``bool()`` /
+  ``np.asarray()`` / ``.item()`` / ``.tolist()`` on a traced value
+  inside jit — a forced device sync (or TracerError) per call.
+- **static hygiene** (error): ``static_argnums`` index out of range,
+  and a static parameter whose default is a non-hashable literal
+  (list/dict/set) — jit's cache key would raise at call time.
+
+VALUE-taint propagates through local assignments and tuple unpacks
+(``caches, tok = carry``; branching on ``tok`` is caught), but
+SHAPE-derived locals stay untainted — trace-time config computed from
+shapes/dtypes (``quant = ck.dtype.itemsize == 1``; ``if quant:``)
+never false-positives.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Set, Tuple
+
+from ..core import SEVERITY_WARN, Finding, LintContext, Module, Rule
+from ._jax_common import (assigned_names, child_blocks, collect_jit_sites,
+                          header_exprs, materializer_target,
+                          walrus_bindings)
+
+_SHAPE_ATTRS = {"shape", "ndim", "dtype", "size"}
+
+
+def _is_none_check(node: ast.AST) -> bool:
+    return (isinstance(node, ast.Compare)
+            and all(isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops)
+            and any(isinstance(c, ast.Constant) and c.value is None
+                    for c in [node.left] + node.comparators))
+
+
+def _classify_refs(expr: ast.AST,
+                   traced: Set[str]) -> Tuple[Set[str], Set[str]]:
+    """(value_refs, shape_refs) of traced parameters inside ``expr``."""
+    value: Set[str] = set()
+    shape: Set[str] = set()
+
+    def visit(node: ast.AST, under_shape: bool):
+        if _is_none_check(node):
+            return                       # structure dispatch, static
+        if isinstance(node, ast.Attribute) and node.attr in _SHAPE_ATTRS:
+            visit(node.value, True)
+            return
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Name)
+                and node.func.id == "len" and len(node.args) == 1):
+            visit(node.args[0], True)
+            return
+        if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load):
+            if node.id in traced:
+                (shape if under_shape else value).add(node.id)
+            return
+        for child in ast.iter_child_nodes(node):
+            visit(child, under_shape)
+
+    visit(expr, False)
+    return value, shape
+
+
+class RetraceRule(Rule):
+    id = "retrace-hazard"
+    short = ("Python control flow / concretization on traced values "
+             "inside @jax.jit (recompile or TracerError per call)")
+
+    def check(self, module: Module,
+              ctx: LintContext) -> Iterable[Finding]:
+        findings: List[Finding] = []
+        for site in collect_jit_sites(module.tree):
+            self._check_site(site, module, findings)
+        return findings
+
+    def _check_site(self, site, module: Module,
+                    findings: List[Finding]) -> None:
+        params = site.params()
+        # static hygiene at the jit site itself
+        for i in site.static_argnums:
+            if not (0 <= i < len(params)):
+                findings.append(self.finding(
+                    module, site.jit_node,
+                    f"static_argnums index {i} is out of range for "
+                    f"{len(params)} parameter(s)"))
+        defaults = site.param_defaults()
+        for name in sorted(site.static_params()):
+            d = defaults.get(name)
+            if isinstance(d, (ast.List, ast.Dict, ast.Set)):
+                findings.append(self.finding(
+                    module, d,
+                    f"static parameter '{name}' has a non-hashable "
+                    f"default — jit's cache key raises TypeError at "
+                    f"call time; use a tuple or None"))
+
+        traced = set(site.traced_params())
+        self._walk(site.func, traced, module, findings)
+
+    def _walk(self, func: ast.AST, traced: Set[str], module: Module,
+              findings: List[Finding]) -> None:
+        body = (func.body if isinstance(func.body, list)
+                else [ast.Expr(func.body)])          # Lambda
+        self._walk_block(body, traced, module, findings)
+
+    def _walk_block(self, stmts: List[ast.stmt], traced: Set[str],
+                    module: Module, findings: List[Finding]) -> None:
+        for st in stmts:
+            if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                # nested defs (scan/cond bodies) trace too: their
+                # params join the traced set, and value-taint carried
+                # into the def through closures stays live
+                inner = set(traced)
+                a = st.args
+                for p in (getattr(a, "posonlyargs", []) + a.args
+                          + a.kwonlyargs):
+                    inner.add(p.arg)
+                self._walk(st, inner, module, findings)
+                continue
+            branch_reported = False
+            if isinstance(st, (ast.If, ast.While)):
+                branch_reported = self._check_branch(
+                    st.test, st, traced, module, findings,
+                    kind="while" if isinstance(st, ast.While) else "if")
+            for expr in header_exprs(st):
+                # a test already reported as a traced branch is ONE
+                # defect — don't re-report its concretizations too
+                if branch_reported and expr is st.test:
+                    continue
+                self._check_exprs(expr, traced, module, findings)
+            # VALUE-taint propagation through locals: traced values
+            # flow through scan carries and tuple unpacks
+            # (``caches, tok = carry``), so branching on ``tok`` is
+            # caught; shape-derived locals (``R, C, H, D = q.shape``)
+            # stay untainted and never false-positive
+            targets = assigned_names(st)
+            if targets:
+                src = getattr(st, "value", None)
+                if isinstance(st, (ast.For, ast.AsyncFor)):
+                    src = st.iter
+                if src is not None:
+                    value, _ = _classify_refs(src, traced)
+                    if value:
+                        traced |= targets
+                    elif isinstance(st, ast.AugAssign):
+                        # the target is READ by ``x += 1``: a traced x
+                        # stays traced regardless of the RHS
+                        pass
+                    else:
+                        traced -= targets
+            # walrus bindings are expression-level and invisible above
+            for wname, wval in walrus_bindings(st):
+                wvalue, _ = _classify_refs(wval, traced)
+                if wvalue:
+                    traced.add(wname)
+            unconditional = isinstance(st, (ast.With, ast.AsyncWith))
+            for block in child_blocks(st):
+                if unconditional:
+                    self._walk_block(block, traced, module, findings)
+                else:
+                    # conditional branch: taint added there stays
+                    # visible afterwards, but a clean rebind on the
+                    # branch must not untaint the fall-through path
+                    # (`y = x; if flag: y = 0; if y > 1:` is still a
+                    # traced branch when flag is False)
+                    branch = set(traced)
+                    self._walk_block(block, branch, module, findings)
+                    traced |= branch
+
+    def _check_branch(self, test: ast.AST, node: ast.AST,
+                      traced: Set[str], module: Module,
+                      findings: List[Finding], kind: str) -> bool:
+        """Returns True when a traced-value branch finding was emitted
+        (the caller then skips re-reporting the test's internals)."""
+        value, shape = _classify_refs(test, traced)
+        if value:
+            findings.append(self.finding(
+                module, node,
+                f"Python `{kind}` on traced value(s) "
+                f"{', '.join(sorted(value))} inside @jax.jit — "
+                f"retraces per value or raises TracerBool"
+                f"ConversionError; use lax.cond/lax.select, or mark "
+                f"the argument static if it is host config"))
+            return True
+        if shape:
+            findings.append(self.finding(
+                module, node,
+                f"`{kind}` on .shape of traced "
+                f"{', '.join(sorted(shape))} forks one compile "
+                f"variant per shape — legitimate only behind a shape "
+                f"bucket (suppress with a reason if so)",
+                severity=SEVERITY_WARN))
+        return False
+
+    def _check_exprs(self, root: ast.AST, traced: Set[str],
+                     module: Module, findings: List[Finding]) -> None:
+        for node in ast.walk(root):
+            if isinstance(node, ast.IfExp):
+                self._check_branch(node.test, node, traced, module,
+                                   findings, kind="if")
+            elif isinstance(node, ast.Call):
+                # same materializer surface as host-sync-dataflow (one
+                # shared list in _jax_common — the rules cannot drift)
+                fetched = materializer_target(node)
+                if fetched is None:
+                    continue
+                value, _ = _classify_refs(fetched, traced)
+                if value:
+                    findings.append(self.finding(
+                        module, node,
+                        f"concretization of traced value(s) "
+                        f"{', '.join(sorted(value))} inside @jax.jit — "
+                        f"forces a host sync per call (or TracerError); "
+                        f"keep it on device or mark the argument "
+                        f"static"))

@@ -45,11 +45,16 @@ def summarize(events: List[Dict[str, Any]]) -> Dict[str, Dict[str, Any]]:
             stacks[ev.get("tid")].append((name, ev["ts"]))
         elif ph == "E":
             stack = stacks[ev.get("tid")]
-            # pop to the matching name: tolerates producers that close
-            # out of order rather than corrupting every later pairing
-            while stack:
-                b_name, b_ts = stack.pop()
-                if b_name == name:
+            # pair with the TOPMOST matching B, leaving inner entries
+            # on the stack for their own later E — tolerates producers
+            # that close out of order without dropping the inner spans.
+            # An E with no matching open B (stray end from a third-party
+            # trace, or an end() whose begin predates tracer.start())
+            # is ignored; genuinely never-closed spans surface via the
+            # end-of-trace UNCLOSED sweep below
+            for i in range(len(stack) - 1, -1, -1):
+                if stack[i][0] == name:
+                    _, b_ts = stack.pop(i)
                     dur = ev["ts"] - b_ts
                     s = spans[name]
                     s["count"] += 1
