@@ -37,8 +37,22 @@ r5: the complete metric record also lands in ``bench_results/<round>.json``
 (committed — the driver's stdout-tail capture truncated 15 of 23 r4
 metrics), with a round-over-round regression gate (>5% drops on
 tracked units fail loudly on stderr + a "regressions" field).
+
+r6: post-mortem hardening (the BENCH_r05 rc=124/parsed:null class).
+Every mode runs under the stall watchdog
+(flexflow_tpu/observability/watchdog.py): SIGTERM — what the external
+`timeout` sends — and SIGUSR1 dump a flight-recorder bundle into
+bench_results/ (ring events, metrics snapshot, all-thread stacks, jax
+memory stats; pretty-print with tools/ffstat.py), and a driver loop
+committing nothing for the stall threshold dumps one proactively.  The
+round record is written INCREMENTALLY after every section and stamped
+with `stderr_tail` (own-process tee, --stderr-tail/FF_BENCH_STDERR_TAIL,
+default 4 KiB), `last_heartbeat` (last committed step/phase/age) and
+`stall_bundle`, so a killed run leaves parseable per-mode results
+naming the last completed phase instead of nothing.
 """
 
+import collections
 import json
 import os
 import sys
@@ -47,6 +61,150 @@ import time
 import numpy as np
 
 REPO = os.path.dirname(os.path.abspath(__file__))
+
+
+# ------------------------------------------------- post-mortem plumbing
+# r6 (flight recorder + stall watchdog): BENCH_r05 ended rc=124 with
+# `parsed: null` — the external `timeout` killed the process and the
+# only evidence was a two-line stderr tail.  Three layers now make that
+# impossible to repeat silently: (1) every mode runs under the stall
+# watchdog, whose SIGTERM/SIGUSR1 handlers and stall timer dump a
+# flight-recorder bundle; (2) the round record is written INCREMENTALLY
+# after every section, so completed modes survive any kill; (3) stderr
+# is teed into a bounded in-memory tail stamped into each record.
+
+class _StderrTail:
+    """Tee for sys.stderr keeping the last ``limit`` bytes in memory so
+    every emitted record carries its own stderr tail (the driver's
+    capture keeps only a short tail of the whole run; this rides the
+    committed artifact).  Writes pass through; never raises."""
+
+    def __init__(self, stream, limit: int = 4096):
+        self._stream = stream
+        self.limit = max(256, int(limit))
+        self._chunks: collections.deque = collections.deque()
+        self._size = 0
+
+    def write(self, s):
+        try:
+            n = self._stream.write(s)
+        except Exception:
+            n = len(s)
+        if s:
+            self._chunks.append(s)
+            self._size += len(s)
+            while (len(self._chunks) > 1
+                   and self._size - len(self._chunks[0]) >= self.limit):
+                self._size -= len(self._chunks.popleft())
+        return n
+
+    def flush(self):
+        try:
+            self._stream.flush()
+        except Exception:
+            pass
+
+    def tail(self) -> str:
+        return "".join(self._chunks)[-self.limit:]
+
+    def __getattr__(self, name):
+        return getattr(self._stream, name)
+
+
+_STDERR_TAIL = None          # installed in __main__
+_WATCHDOG = None             # started in __main__
+_PROGRESS = {"mode": None, "in_flight": None, "done": [], "metrics": []}
+
+
+def _results_dir() -> str:
+    """bench_results/ by default; FF_BENCH_RESULTS redirects (tests)."""
+    return os.environ.get("FF_BENCH_RESULTS") or os.path.join(
+        REPO, "bench_results")
+
+
+def _postmortem_fields() -> dict:
+    """The diagnosis fields stamped into every record: stderr tail,
+    last driver heartbeat (committed step/phase/age) and the stall
+    bundle path if the watchdog dumped one."""
+    out = {}
+    if _STDERR_TAIL is not None:
+        out["stderr_tail"] = _STDERR_TAIL.tail()
+    try:
+        from flexflow_tpu.observability import get_heartbeat
+
+        out["last_heartbeat"] = get_heartbeat().state()
+    except Exception:
+        pass
+    if _WATCHDOG is not None and _WATCHDOG.last_bundle:
+        out["stall_bundle"] = _WATCHDOG.last_bundle
+    return out
+
+
+def _write_incremental():
+    """Rewrite the round record with every section completed SO FAR
+    (atomic rename — a kill mid-write can't leave unparseable JSON).
+    The final persist_record overwrites this with the complete record;
+    an rc=124 kill leaves this file: parseable per-mode results plus
+    the in-flight section name, heartbeat and stall-bundle path."""
+    outdir = _results_dir()
+    os.makedirs(outdir, exist_ok=True)
+    rnd = os.environ.get("FF_BENCH_ROUND", "r05")
+    mode = _PROGRESS["mode"] or "all"
+    name = f"{rnd}.json" if mode == "all" else f"partial_{mode}.json"
+    record = {"round": rnd, "mode": mode, "incomplete": True,
+              "time_unix": round(time.time(), 1),
+              "sections_done": list(_PROGRESS["done"]),
+              "section_in_flight": _PROGRESS["in_flight"],
+              **_postmortem_fields(),
+              "metrics": list(_PROGRESS["metrics"])}
+    path = os.path.join(outdir, name)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(record, f, indent=1)
+        f.write("\n")
+    os.replace(tmp, path)
+
+
+def _note_mode_start(label: str):
+    _PROGRESS["in_flight"] = label
+    _write_incremental()
+
+
+def _note_mode_done(label: str, metrics):
+    _PROGRESS["in_flight"] = None
+    _PROGRESS["done"].append(label)
+    _PROGRESS["metrics"].extend(metrics)
+    _write_incremental()
+
+
+def _stamp_bundle(path: str, reason: str):
+    """Watchdog on_bundle hook (stall or signal context): restamp the
+    incremental record so it names the bundle + last heartbeat even if
+    the process dies right after."""
+    _write_incremental()
+
+
+def _start_watchdog(budget):
+    """Run the whole bench under the stall watchdog: SIGTERM (what the
+    external `timeout` sends first) and SIGUSR1 dump a flight-recorder
+    bundle into bench_results/, and a driver loop making no progress
+    for the stall threshold dumps one proactively.  FF_BENCH_STALL_S
+    overrides the threshold (default: 1.5x the per-mode --budget, else
+    300 s)."""
+    global _WATCHDOG
+    try:
+        from flexflow_tpu.observability import Watchdog
+    except Exception as e:       # partial installs must not kill bench
+        print(f"bench: watchdog unavailable ({e})", file=sys.stderr)
+        return None
+    stall = float(os.environ.get("FF_BENCH_STALL_S", "0") or 0)
+    if not stall:
+        stall = max(120.0, budget * 1.5) if budget else 300.0
+    _WATCHDOG = Watchdog(stall_timeout=stall, bundle_dir=_results_dir(),
+                         signals=("SIGTERM", "SIGUSR1"),
+                         on_bundle=_stamp_bundle)
+    _WATCHDOG.start()
+    return _WATCHDOG
 
 # --kv-dtype override ("bf16" | "int8" | None) applied to the serving
 # decode benches' cache allocations, so BENCH trajectories can A/B the
@@ -1900,18 +2058,27 @@ def main(which: str, budget=None):
             return [{"metric": f"section_{label}_skipped", "value": 0.0,
                      "unit": "error", "vs_baseline": 0,
                      "error": f"skipped after {timed_out[0]} timed out"}]
+        # incremental round record: every completed section lands on
+        # disk BEFORE the next one runs, so an external kill mid-run
+        # leaves parseable per-mode results (the r5 parsed:null fix)
+        _note_mode_start(label)
         last = ""
         for attempt in (1, 2):
             try:
                 r = _with_budget(fn, budget)
-                return list(r) if isinstance(r, (tuple, list)) else [r]
+                r = list(r) if isinstance(r, (tuple, list)) else [r]
+                _note_mode_done(label, r)
+                return r
             except _SectionTimeout as e:
                 timed_out.append(label)
                 print(f"bench section {label} {e}; skipping remaining "
                       f"modes", file=sys.stderr)
-                return [{"metric": f"section_{label}_timed_out",
-                         "value": 0.0, "unit": "error", "vs_baseline": 0,
-                         "timed_out": True, "error": str(e)}]
+                marker = [{"metric": f"section_{label}_timed_out",
+                           "value": 0.0, "unit": "error",
+                           "vs_baseline": 0,
+                           "timed_out": True, "error": str(e)}]
+                _note_mode_done(label, marker)
+                return marker
             except Exception as e:
                 last = f"{type(e).__name__}: {e}"
                 print(f"bench section {label} attempt {attempt} failed: "
@@ -1923,8 +2090,10 @@ def main(which: str, budget=None):
                 gc.collect()
         # leave a marker in the round record: an absent metric is
         # indistinguishable from a removed one to trend tooling
-        return [{"metric": f"section_{label}_failed", "value": 0.0,
-                 "unit": "error", "error": last[:500], "vs_baseline": 0}]
+        marker = [{"metric": f"section_{label}_failed", "value": 0.0,
+                   "unit": "error", "error": last[:500], "vs_baseline": 0}]
+        _note_mode_done(label, marker)
+        return marker
 
     extras = _section(bench_llama7b_decode, "llama7b")
     heads = _section(bench_llama_decode, "llama")
@@ -2036,7 +2205,7 @@ def persist_record(result, mode: str):
     Also runs the round-over-round regression gate against the newest
     earlier round file and reports >5% drops loudly (stderr + a
     "regressions" field in the stdout object)."""
-    outdir = os.path.join(REPO, "bench_results")
+    outdir = _results_dir()
     os.makedirs(outdir, exist_ok=True)
     rnd = os.environ.get("FF_BENCH_ROUND", "r05")
     metrics = _flatten_metrics(result)
@@ -2046,6 +2215,7 @@ def persist_record(result, mode: str):
               "platform": _platform_str(),
               **_kv_summary(),
               **tel,
+              **_postmortem_fields(),
               "metrics": metrics}
     if "step_latency_percentiles" in tel:
         # stdout (_slim) reuses THIS snapshot's percentiles so the
@@ -2128,17 +2298,43 @@ if __name__ == "__main__":
              "(int8 = quantized cache + f32 per-head scales; halves "
              "decode cache HBM reads).  The `kvdtype` mode A/Bs both "
              "dtypes in one run regardless of this flag.")
+    _ap.add_argument(
+        "--stderr-tail", type=int,
+        default=int(os.environ.get("FF_BENCH_STDERR_TAIL", "4096")),
+        metavar="BYTES",
+        help="bytes of this process's own stderr kept in memory and "
+             "stamped into every emitted record (post-mortem evidence; "
+             "default 4 KiB, env FF_BENCH_STDERR_TAIL)")
+    _ap.add_argument(
+        "--stall-timeout", type=float,
+        default=None, metavar="SECONDS",
+        help="watchdog stall threshold: a driver loop committing no "
+             "step for this long dumps a flight-recorder bundle "
+             "(default: 1.5x --budget, else 300; env FF_BENCH_STALL_S)")
     _args = _ap.parse_args()
     _KV_DTYPE = _args.kv_dtype
+    # post-mortem plumbing: stderr tee, watchdog (stall + SIGTERM/
+    # SIGUSR1 bundles), incremental round record
+    _STDERR_TAIL = _StderrTail(sys.stderr, limit=_args.stderr_tail)
+    sys.stderr = _STDERR_TAIL
+    if _args.stall_timeout:
+        os.environ["FF_BENCH_STALL_S"] = str(_args.stall_timeout)
+    _PROGRESS["mode"] = _args.mode
+    _start_watchdog(_args.budget)
     try:
         if _args.mode == "all":
             _result = main(_args.mode, budget=_args.budget)
         else:
+            _note_mode_start(_args.mode)
             _result = _with_budget(lambda: main(_args.mode), _args.budget)
+            _note_mode_done(_args.mode, _flatten_metrics(_result))
     except _SectionTimeout as _e:
         _result = {"metric": f"{_args.mode}_timed_out", "value": 0.0,
                    "unit": "error", "vs_baseline": 0, "error": str(_e),
                    "timed_out": {"budget_s": _args.budget,
                                  "sections": [_args.mode], "skipped": []}}
+    finally:
+        if _WATCHDOG is not None:
+            _WATCHDOG.stop()
     persist_record(_result, _args.mode)
     print(json.dumps(_slim(_result)))

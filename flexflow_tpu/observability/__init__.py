@@ -1,4 +1,4 @@
-"""Serving telemetry: metrics registry + step-event tracing.
+"""Serving telemetry: metrics registry + step tracing + flight recorder.
 
 One emission surface for the serving stack (request_manager,
 inference_manager, spec_infer, spec_block, prefix_cache,
@@ -10,30 +10,44 @@ structs stay as views; their values now also flow through here).
   histograms with fixed exponential buckets; thread-safe; near-zero
   cost when disabled.  The process-wide default registry validates
   names against :data:`schema.METRICS_SCHEMA`.
+  :meth:`MetricsRegistry.expose_text` renders Prometheus text
+  exposition for off-box scraping.
 - :class:`StepTracer` (tracer.py): host-side structured step events
   (admit, prefix-match, prefill-chunk, decode-step, spec-draft,
   spec-verify, commit, donate, evict) as Chrome-trace JSON, with
   ``jax.profiler.TraceAnnotation`` spans so host and XLA timelines
   align.  ``tools/trace_summary.py`` prints a per-phase breakdown.
+- :class:`FlightRecorder` (flight_recorder.py): ALWAYS-ON bounded ring
+  of the same events plus host-sync/compile, the post-mortem black box.
+- :class:`Watchdog` (watchdog.py): stall detection off the driver
+  :class:`Heartbeat` + SIGTERM/SIGUSR1 handlers, dumping bundles
+  (flight record + metrics + all-thread stacks + jax memory stats)
+  pretty-printed by ``tools/ffstat.py``.
 
-``FF_TELEMETRY=0`` disables the default registry at import (metrics
-become no-ops; tracing stays explicit-opt-in either way).  See
-docs/OBSERVABILITY.md.
+``FF_TELEMETRY=0`` disables the default registry AND the flight
+recorder at import (both become no-ops; tracing stays explicit-opt-in
+either way).  See docs/OBSERVABILITY.md.
 """
 
 from __future__ import annotations
 
 import os
 
+from .flight_recorder import FlightRecorder, get_flight_recorder
 from .registry import (Counter, Gauge, Histogram, MetricsRegistry,
-                       exp_buckets)
-from .schema import METRICS_SCHEMA
+                       exp_buckets, prometheus_text)
+from .schema import EVENT_SCHEMA, METRICS_SCHEMA
 from .tracer import EVENT_NAMES, StepTracer
+from .watchdog import (Heartbeat, Watchdog, collect_bundle, dump_bundle,
+                       get_heartbeat)
 
 __all__ = [
     "Counter", "Gauge", "Histogram", "MetricsRegistry", "StepTracer",
-    "METRICS_SCHEMA", "EVENT_NAMES", "exp_buckets", "get_registry",
-    "get_tracer", "metrics_snapshot", "set_telemetry_enabled",
+    "FlightRecorder", "Watchdog", "Heartbeat",
+    "METRICS_SCHEMA", "EVENT_SCHEMA", "EVENT_NAMES", "exp_buckets",
+    "get_registry", "get_tracer", "get_flight_recorder", "get_heartbeat",
+    "collect_bundle", "dump_bundle", "metrics_snapshot",
+    "prometheus_text", "set_telemetry_enabled",
 ]
 
 _REGISTRY = MetricsRegistry(
@@ -59,6 +73,7 @@ def metrics_snapshot():
 
 
 def set_telemetry_enabled(enabled: bool):
-    """Runtime switch for the default registry (the FF_TELEMETRY env var
-    decides the import-time default)."""
+    """Runtime switch for the default registry AND the flight recorder
+    (the FF_TELEMETRY env var decides the import-time default)."""
     _REGISTRY.enabled = bool(enabled)
+    get_flight_recorder().enabled = bool(enabled)

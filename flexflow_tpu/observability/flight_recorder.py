@@ -1,0 +1,139 @@
+"""FlightRecorder: a bounded in-memory black box for the serving stack.
+
+The metrics registry and step tracer (PR 3) only help when a run
+*finishes* — a hung collective, a recompile loop, or a dead tunnel
+leaves nothing but whatever stderr survived the kill (the BENCH_r05
+``rc: 124, parsed: null`` failure mode).  The idiom proven by
+distributed-runtime flight recorders (the NCCL / PyTorch-distributed
+flight recorder) is a fixed-size ring of structured events that is
+ALWAYS on and dumped on stall, signal or crash, so the last thing the
+process did is readable post mortem.
+
+Design constraints:
+
+- **Bounded memory always**: a ``collections.deque(maxlen=capacity)``
+  of small dicts; old events fall off the far end (``dropped`` counts
+  them) no matter how long the process serves.
+- **Near-zero cost when disabled** (``FF_TELEMETRY=0``): every
+  ``record_event`` starts with one attribute read and returns.
+  Enabled, the cost is one monotonic() read + one lock + one deque
+  append per event — events are per driver-loop *phase*, not per token.
+- **Schema-validated names**: undeclared event names raise — the
+  vocabulary in ``schema.EVENT_SCHEMA`` is shared with the StepTracer
+  and the fflint ``metric-schema`` rule checks call sites statically.
+- **Thread-safe**: drivers, the watchdog thread and signal handlers all
+  read/write concurrently; every ring touch takes the lock.
+
+Events carry ``seq`` (monotonically increasing, so drops are visible),
+``t`` (``time.monotonic()``), ``name``, and whatever payload the site
+passes (``guid``, ``step``, ``chunk``, ...).  ``snapshot()`` anchors the
+monotonic clock to wall time so dumps correlate with logs.
+"""
+
+from __future__ import annotations
+
+import collections
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from .schema import EVENT_SCHEMA
+
+#: ring capacity default (events, not bytes); override per-recorder or
+#: via FF_FLIGHT_EVENTS for the process-wide one.
+DEFAULT_CAPACITY = 2048
+
+
+class FlightRecorder:
+    """Fixed-size, thread-safe ring buffer of structured serving events."""
+
+    def __init__(self, capacity: int = DEFAULT_CAPACITY,
+                 enabled: bool = True,
+                 schema: Optional[Dict[str, Dict]] = EVENT_SCHEMA):
+        self.capacity = max(1, int(capacity))
+        self.enabled = enabled
+        self._names = frozenset(schema) if schema is not None else None
+        self._ring: collections.deque = collections.deque(
+            maxlen=self.capacity)
+        self._lock = threading.Lock()
+        self._seq = 0
+        # wall/monotonic anchor pair: event["t"] - t0_mono + t0_wall
+        # reconstructs a wall-clock stamp for log correlation
+        self._t0_wall = time.time()
+        self._t0_mono = time.monotonic()
+
+    # --------------------------------------------------------------- emit
+    def record_event(self, name: str, **payload: Any) -> None:
+        """Append one event; no-op when disabled (one attribute read).
+        Unknown names raise ``ValueError`` — declare new events in
+        ``observability/schema.py::EVENT_SCHEMA`` first."""
+        if not self.enabled:
+            return
+        if self._names is not None and name not in self._names:
+            raise ValueError(
+                f"flight-recorder event {name!r} is not declared in "
+                f"observability/schema.py EVENT_SCHEMA — declare it "
+                f"(with help text) before emitting it")
+        ev: Dict[str, Any] = dict(payload)
+        ev["name"] = name
+        with self._lock:
+            # timestamp under the lock: ring order (seq) must agree
+            # with t — ffstat/trace_summary derive per-phase wall time
+            # from consecutive-event deltas in ring order
+            ev["t"] = time.monotonic()
+            ev["seq"] = self._seq
+            self._seq += 1
+            self._ring.append(ev)
+
+    # --------------------------------------------------------------- read
+    @property
+    def recorded(self) -> int:
+        """Total events ever recorded (ring holds the last ``capacity``)."""
+        return self._seq
+
+    @property
+    def dropped(self) -> int:
+        with self._lock:
+            return max(0, self._seq - len(self._ring))
+
+    def events(self, last: Optional[int] = None) -> List[Dict[str, Any]]:
+        """Copy of the ring (oldest first); ``last`` keeps only the tail."""
+        with self._lock:
+            evs = list(self._ring)
+        return evs[-last:] if last else evs
+
+    def clear(self) -> None:
+        with self._lock:
+            self._ring.clear()
+            self._seq = 0
+            self._t0_wall = time.time()
+            self._t0_mono = time.monotonic()
+
+    def snapshot(self) -> Dict[str, Any]:
+        """JSON-serializable dump: the full ring plus clock anchors and
+        drop accounting (the ``flight_record`` section of a watchdog
+        bundle)."""
+        with self._lock:
+            evs = list(self._ring)
+            seq = self._seq
+        return {
+            "capacity": self.capacity,
+            "recorded": seq,
+            "dropped": max(0, seq - len(evs)),
+            "t0_wall": self._t0_wall,
+            "t0_mono": self._t0_mono,
+            "events": evs,
+        }
+
+
+_RECORDER = FlightRecorder(
+    capacity=int(os.environ.get("FF_FLIGHT_EVENTS", str(DEFAULT_CAPACITY))
+                 or DEFAULT_CAPACITY),
+    enabled=os.environ.get("FF_TELEMETRY", "1") != "0")
+
+
+def get_flight_recorder() -> FlightRecorder:
+    """The process-wide flight recorder (always allocated; inert when
+    FF_TELEMETRY=0)."""
+    return _RECORDER

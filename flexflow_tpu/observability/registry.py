@@ -286,3 +286,74 @@ class MetricsRegistry:
             for name, m in sorted(self._metrics.items()):
                 out[m.kind + "s"][name] = m.snapshot()
             return out
+
+    def expose_text(self) -> str:
+        """Prometheus text exposition (format 0.0.4) of the current
+        state — write it behind any HTTP/file endpoint and snapshots are
+        scrapeable off-box.  Rendered from :meth:`snapshot` so a dumped
+        snapshot (stall bundle, bench record) produces the identical
+        text via :func:`prometheus_text`."""
+        return prometheus_text(self.snapshot(), schema=self._schema)
+
+
+# -------------------------------------------------- prometheus rendering
+def _prom_labels(label_str: str) -> str:
+    """``"path=flash,reason=x"`` -> ``{path="flash",reason="x"}``."""
+    if not label_str or label_str == "_":
+        return ""
+    pairs = []
+    for part in label_str.split(","):
+        k, _, v = part.partition("=")
+        v = v.replace("\\", "\\\\").replace('"', '\\"')
+        pairs.append(f'{k}="{v}"')
+    return "{" + ",".join(pairs) + "}"
+
+
+def prometheus_text(snapshot: Dict[str, Dict[str, Any]],
+                    schema: Optional[Dict[str, Dict]] = None) -> str:
+    """Render a :meth:`MetricsRegistry.snapshot` dict as Prometheus text
+    exposition.  Pure function of the snapshot so off-process tooling
+    (tools/ffstat.py ``--prom``) renders dumped bundles identically to a
+    live registry.  Histograms emit cumulative ``_bucket{le=...}``
+    series (+Inf included) plus ``_sum``/``_count``."""
+    lines = []
+
+    def _help(name: str) -> None:
+        decl = (schema or {}).get(name) or {}
+        h = " ".join(str(decl.get("help", "")).split())
+        if h:
+            lines.append(f"# HELP {name} {h}")
+
+    for name, snap in (snapshot.get("counters") or {}).items():
+        _help(name)
+        lines.append(f"# TYPE {name} counter")
+        if isinstance(snap, dict):
+            for label_str, v in (snap.get("labels") or {}).items():
+                lines.append(f"{name}{_prom_labels(label_str)} {v:g}")
+            if not snap.get("labels"):
+                lines.append(f"{name} {snap.get('total', 0):g}")
+        else:
+            lines.append(f"{name} {snap:g}")
+    for name, snap in (snapshot.get("gauges") or {}).items():
+        _help(name)
+        lines.append(f"# TYPE {name} gauge")
+        if isinstance(snap, dict):
+            for label_str, v in snap.items():
+                lines.append(f"{name}{_prom_labels(label_str)} {v:g}")
+        else:
+            lines.append(f"{name} {snap:g}")
+    for name, snap in (snapshot.get("histograms") or {}).items():
+        _help(name)
+        lines.append(f"# TYPE {name} histogram")
+        count = int(snap.get("count", 0))
+        cum = 0
+        for le, c in (snap.get("buckets") or {}).items():
+            if le == "overflow":
+                continue
+            cum += int(c)
+            bound = le[len("le_"):]
+            lines.append(f'{name}_bucket{{le="{bound}"}} {cum}')
+        lines.append(f'{name}_bucket{{le="+Inf"}} {count}')
+        lines.append(f"{name}_sum {snap.get('sum', 0.0):g}")
+        lines.append(f"{name}_count {count}")
+    return "\n".join(lines) + "\n"

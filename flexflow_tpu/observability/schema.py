@@ -1,12 +1,18 @@
-"""Central metric schema: every metric the serving stack emits.
+"""Central metric + event schema: everything the serving stack emits.
 
-The registry validates metric names against this table at creation time
-and ``tools/check_metrics_schema.py`` validates the *call sites* in
-``flexflow_tpu/serving/`` statically — a metric incremented anywhere in
-the serving stack but missing here fails CI before it ships an
-undocumented name.  The reference ships its observability vocabulary
-the same way: a fixed ``ProfileInfo`` struct (request_manager.h:244-250)
-and fixed ``--profiling`` timer names, not free-form strings.
+The registry validates metric names against ``METRICS_SCHEMA`` at
+creation time and the fflint ``metric-schema`` rule validates the *call
+sites* statically — a metric incremented anywhere in the serving stack
+but missing here fails CI before it ships an undocumented name.  The
+reference ships its observability vocabulary the same way: a fixed
+``ProfileInfo`` struct (request_manager.h:244-250) and fixed
+``--profiling`` timer names, not free-form strings.
+
+``EVENT_SCHEMA`` plays the same role for the step-event vocabulary
+shared by the StepTracer (Chrome-trace spans/instants) and the
+FlightRecorder (always-on post-mortem ring): the recorder refuses
+undeclared names at runtime and the fflint rule checks
+``record_event(...)`` call sites.
 
 Schema entry: name -> {"type": counter|gauge|histogram, "help": str,
 optional "buckets": tuple} — histograms default to the registry's fixed
@@ -170,5 +176,55 @@ METRICS_SCHEMA = {
                 "decode block (labeled stage=<s>); re-emits the record's "
                 "pp_dispatches odometer so scheduling regressions are "
                 "visible in the snapshot.",
+    },
+}
+
+# The step-event vocabulary: every name the StepTracer (spans/instants)
+# and the FlightRecorder (post-mortem ring) may emit.  One table so the
+# host trace, the XLA TraceAnnotation names, the flight record and
+# tools/{trace_summary,ffstat}.py all agree; the recorder validates at
+# record time and fflint's metric-schema rule validates the
+# record_event(...) call sites statically.
+EVENT_SCHEMA = {
+    "admit": {
+        "help": "Request admitted into a batch row (guid, row, "
+                "prompt_len).",
+    },
+    "prefix-match": {
+        "help": "Pooled prefix matched at admission (guid, matched, "
+                "prompt_len).",
+    },
+    "prefill-chunk": {
+        "help": "One chunked-prefill step scheduled (chunk, rows).",
+    },
+    "decode-step": {
+        "help": "One decode step or fused K-step decode block dispatched "
+                "(block, rows).",
+    },
+    "spec-draft": {
+        "help": "SSM drafting phase started (ssms, rows).",
+    },
+    "spec-verify": {
+        "help": "LLM tree-verify phase (host loop) or one dispatch+sync "
+                "round of the fused spec block (device loop).",
+    },
+    "commit": {
+        "help": "Tokens committed to a request (guid, tokens, accepted).",
+    },
+    "donate": {
+        "help": "Retired row donated to the prefix pool (guid, slot, "
+                "length).",
+    },
+    "evict": {
+        "help": "Prefix-pool entry evicted (slot, reason=lru|superseded).",
+    },
+    "host-sync": {
+        "help": "Device->host materialization of step results (n); the "
+                "flight-record twin of serving_host_syncs_total.",
+    },
+    "compile": {
+        "help": "A serving record compiled + caches allocated (model, "
+                "mode, rows, alloc_len) — a burst of these mid-serve is "
+                "the recompile-loop stall signature.",
     },
 }

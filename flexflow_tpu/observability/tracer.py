@@ -28,20 +28,16 @@ import threading
 import time
 from typing import Any, Dict, List, Optional
 
-# The serving event taxonomy.  Emitters stick to these names so
+from .schema import EVENT_SCHEMA
+
+# The serving event taxonomy — one vocabulary with the FlightRecorder
+# (schema.EVENT_SCHEMA holds the help text); the tracer's span/instant
+# subset excludes the recorder-only events (host-sync, compile), which
+# would flood an interactive trace.  Emitters stick to these names so
 # tools/trace_summary.py's per-phase breakdown stays stable; args carry
 # the variable detail (guid, row, chunk, tokens, ...).
-EVENT_NAMES = (
-    "admit",          # request admitted into a batch row
-    "prefix-match",   # pooled prefix matched at admission
-    "prefill-chunk",  # one chunked-prefill step (span)
-    "decode-step",    # one decode step or fused decode block (span)
-    "spec-draft",     # SSM drafting phase (span)
-    "spec-verify",    # LLM tree-verify phase or fused spec block (span)
-    "commit",         # tokens committed to a request
-    "donate",         # retired row donated to the prefix pool
-    "evict",          # prefix-pool entry evicted
-)
+EVENT_NAMES = tuple(n for n in EVENT_SCHEMA
+                    if n not in ("host-sync", "compile"))
 
 _NULL_CM = contextlib.nullcontext()
 

@@ -1,0 +1,351 @@
+"""Stall watchdog: post-mortem bundles when serving stops making progress.
+
+A daemon thread watches the process-wide :class:`Heartbeat` (beaten by
+every driver loop's ``_note_step`` — "last committed step").  When a
+driver is inside a generate loop (``Heartbeat.driving`` scope) and no
+step commits for ``stall_timeout`` seconds, the watchdog dumps a
+**bundle**: the flight-recorder ring, a metrics snapshot, all-thread
+stacks (``faulthandler`` into the text twin + ``sys._current_frames``
+into the JSON), and jax device-memory / live-array stats.  It also
+installs ``SIGTERM`` / ``SIGUSR1`` handlers so an external ``timeout``
+kill (the BENCH_r05 rc=124 path) or an operator poke produces the same
+bundle — a readable black box instead of a two-line stderr tail.
+
+Limitations (inherent to CPython): the *signal* handlers run at the next
+bytecode boundary of the main thread, so a main thread blocked inside
+one native call (a dead-tunnel device fetch) cannot dump on SIGTERM —
+but the watchdog THREAD still can (its stall timer keeps running and
+``faulthandler`` dumps native-blocked threads fine), which is why both
+mechanisms exist.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import faulthandler
+import json
+import os
+import signal
+import sys
+import threading
+import time
+import traceback
+from typing import Any, Callable, Dict, Optional
+
+from .flight_recorder import get_flight_recorder
+
+#: default stall threshold (seconds without a committed step while a
+#: driver loop is active)
+DEFAULT_STALL_S = 120.0
+
+
+# ------------------------------------------------------------- heartbeat
+class Heartbeat:
+    """Per-process driver progress stamp: last committed step, phase and
+    monotonic beat time.  Drivers enter a :meth:`driving` scope for the
+    duration of a generate loop (so idle processes never read as
+    stalled) and :meth:`beat` once per committed driver-loop step."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.step = 0        # committed driver-loop steps, all drivers
+        self.tokens = 0      # tokens committed across those steps
+        self.phase = ""      # current/last driver label
+        self.mono = 0.0      # monotonic stamp of the last beat
+        self.active = 0      # drivers currently inside a generate loop
+
+    def beat(self, tokens: int = 0, phase: Optional[str] = None) -> None:
+        """One committed step (cost: a lock + a few attribute writes per
+        driver-loop step — not per token, not per layer)."""
+        with self._lock:
+            self.step += 1
+            self.tokens += int(tokens)
+            self.mono = time.monotonic()
+            if phase:
+                self.phase = phase
+
+    @contextlib.contextmanager
+    def driving(self, phase: str):
+        """Scope a generate loop: the watchdog only declares a stall
+        while at least one driver is inside (idle processes never read
+        as stalled)."""
+        with self._lock:
+            self.active += 1
+            self.phase = phase
+            self.mono = time.monotonic()
+        try:
+            yield self
+        finally:
+            with self._lock:
+                self.active -= 1
+                self.mono = time.monotonic()
+
+    def state(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "step": self.step,
+                "tokens": self.tokens,
+                "phase": self.phase,
+                "active": self.active,
+                "age_s": (round(time.monotonic() - self.mono, 3)
+                          if self.mono else None),
+            }
+
+
+_HEARTBEAT = Heartbeat()
+
+
+def get_heartbeat() -> Heartbeat:
+    """The process-wide driver heartbeat (beaten by every driver loop)."""
+    return _HEARTBEAT
+
+
+# ---------------------------------------------------------------- bundle
+def _thread_stacks() -> Dict[str, Any]:
+    """Python-level stacks for every thread (works from any thread, even
+    while the main thread is blocked in native code — the frames just
+    show the call into it)."""
+    names = {t.ident: t.name for t in threading.enumerate()}
+    out = {}
+    for tid, frame in sys._current_frames().items():
+        label = f"{names.get(tid, 'unknown')}-{tid}"
+        out[label] = [ln.rstrip("\n")
+                      for ln in traceback.format_stack(frame)]
+    return out
+
+
+def _jax_stats() -> Dict[str, Any]:
+    """Device-memory / live-array stats, best-effort: never raises (the
+    dump path must survive a wedged backend)."""
+    out: Dict[str, Any] = {}
+    try:
+        import jax
+
+        live = getattr(jax, "live_arrays", None)
+        if callable(live):
+            arrs = live()
+            out["live_arrays"] = len(arrs)
+            out["live_array_bytes"] = int(
+                sum(getattr(a, "nbytes", 0) for a in arrs))
+        dev = jax.devices()[0]
+        out["platform"] = dev.platform
+        ms = getattr(dev, "memory_stats", None)
+        if callable(ms):
+            out["device_memory_stats"] = ms() or {}
+    except Exception as e:  # pragma: no cover - backend-dependent
+        out["error"] = f"{type(e).__name__}: {e}"
+    return out
+
+
+def collect_bundle(reason: str, heartbeat: Optional[Heartbeat] = None,
+                   recorder=None, registry=None,
+                   extra: Optional[Dict[str, Any]] = None
+                   ) -> Dict[str, Any]:
+    """Assemble the post-mortem dict (pure collection — no I/O), so
+    tests and the serve API can inspect a bundle without touching disk."""
+    hb = heartbeat if heartbeat is not None else get_heartbeat()
+    rec = recorder if recorder is not None else get_flight_recorder()
+    if registry is None:
+        from . import get_registry
+
+        registry = get_registry()
+    bundle = {
+        "bundle_version": 1,
+        "reason": reason,
+        "pid": os.getpid(),
+        "time_unix": round(time.time(), 3),
+        "argv": list(sys.argv),
+        "last_heartbeat": hb.state(),
+        "flight_record": rec.snapshot(),
+        "metrics": registry.snapshot(),
+        "threads": _thread_stacks(),
+        "jax": _jax_stats(),
+    }
+    if extra:
+        bundle.update(extra)
+    return bundle
+
+
+def dump_bundle(bundle_dir: str, reason: str,
+                heartbeat: Optional[Heartbeat] = None, recorder=None,
+                registry=None, extra: Optional[Dict[str, Any]] = None
+                ) -> str:
+    """Write ``<dir>/ffbundle_<pid>_<n>.{json,txt}`` and return the JSON
+    path.  The text twin leads with the stall diagnosis + faulthandler
+    stacks (native-thread-safe) + the last ring events, so a human with
+    only ``cat`` gets the story; ``tools/ffstat.py`` pretty-prints the
+    JSON."""
+    bundle = collect_bundle(reason, heartbeat=heartbeat, recorder=recorder,
+                            registry=registry, extra=extra)
+    os.makedirs(bundle_dir, exist_ok=True)
+    # pid + time-based name: unique per dump, sortable, no collisions
+    # across the SIGTERM-then-stall double-dump case
+    stem = f"ffbundle_{os.getpid()}_{int(time.time() * 1000)}"
+    json_path = os.path.join(bundle_dir, stem + ".json")
+    txt_path = os.path.join(bundle_dir, stem + ".txt")
+    with open(json_path, "w") as f:
+        json.dump(bundle, f, indent=1, default=str)
+        f.write("\n")
+    try:
+        with open(txt_path, "w") as f:
+            hb = bundle["last_heartbeat"]
+            f.write(f"== flight-recorder bundle: {reason}\n"
+                    f"pid {bundle['pid']}  argv {' '.join(bundle['argv'])}\n"
+                    f"last heartbeat: step {hb['step']} phase "
+                    f"{hb['phase']!r} age {hb['age_s']}s "
+                    f"active {hb['active']}\n\n-- all-thread stacks "
+                    f"(faulthandler)\n")
+            f.flush()
+            faulthandler.dump_traceback(file=f, all_threads=True)
+            f.write("\n-- last flight-record events\n")
+            for ev in bundle["flight_record"]["events"][-64:]:
+                payload = {k: v for k, v in ev.items()
+                           if k not in ("name", "t", "seq")}
+                f.write(f"  #{ev['seq']:>6} t={ev['t']:.3f} "
+                        f"{ev['name']:<14} {payload}\n")
+    except Exception:  # pragma: no cover - the JSON half already landed
+        pass
+    return json_path
+
+
+# -------------------------------------------------------------- watchdog
+_SIG_BY_NAME = {"SIGTERM": signal.SIGTERM, "SIGUSR1": signal.SIGUSR1,
+                "SIGINT": signal.SIGINT}
+
+
+class Watchdog:
+    """Daemon thread + signal handlers dumping post-mortem bundles.
+
+    - **Stall**: while a driver loop is active (``Heartbeat.driving``)
+      and no step commits for ``stall_timeout`` seconds, dump once per
+      stall (re-arms when progress resumes).
+    - **SIGTERM**: dump, then restore the previous handler and re-raise
+      so the external killer's exit semantics (rc 143 under ``timeout``)
+      are preserved.
+    - **SIGUSR1**: dump and continue — the live-poke path.
+
+    ``on_bundle(path, reason)`` runs after every dump (bench stamps the
+    round record with it).  Use as a context manager or start()/stop().
+    """
+
+    def __init__(self, stall_timeout: float = DEFAULT_STALL_S,
+                 bundle_dir: Optional[str] = None,
+                 heartbeat: Optional[Heartbeat] = None,
+                 recorder=None, registry=None,
+                 poll_interval: Optional[float] = None,
+                 signals: tuple = ("SIGTERM", "SIGUSR1"),
+                 on_bundle: Optional[Callable[[str, str], None]] = None):
+        self.stall_timeout = float(stall_timeout)
+        self.bundle_dir = bundle_dir or os.path.join(
+            os.getcwd(), "ffbundles")
+        self.heartbeat = (heartbeat if heartbeat is not None
+                          else get_heartbeat())
+        self.recorder = recorder
+        self.registry = registry
+        self.poll_interval = poll_interval or max(
+            0.05, min(5.0, self.stall_timeout / 4))
+        self.signals = tuple(signals or ())
+        self.on_bundle = on_bundle
+        self.last_bundle: Optional[str] = None
+        self.stall_count = 0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._prev_handlers: Dict[int, Any] = {}
+        self._lock = threading.Lock()   # serialize concurrent dumps
+
+    # ---------------------------------------------------------- lifecycle
+    def start(self) -> "Watchdog":
+        if self._thread is not None:
+            return self
+        self._install_signal_handlers()
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._run,
+                                        name="ff-watchdog", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        if self._thread is None:
+            return
+        self._stop.set()
+        self._thread.join(timeout=5)
+        self._thread = None
+        self._restore_signal_handlers()
+
+    def __enter__(self) -> "Watchdog":
+        return self.start()
+
+    def __exit__(self, *exc) -> bool:
+        self.stop()
+        return False
+
+    # -------------------------------------------------------------- dumps
+    def dump(self, reason: str) -> str:
+        """Dump a bundle now (thread-safe; also the signal/stall path)."""
+        with self._lock:
+            path = dump_bundle(self.bundle_dir, reason,
+                               heartbeat=self.heartbeat,
+                               recorder=self.recorder,
+                               registry=self.registry)
+            self.last_bundle = path
+        if self.on_bundle is not None:
+            try:
+                self.on_bundle(path, reason)
+            except Exception:  # pragma: no cover - hook must not kill dump
+                traceback.print_exc()
+        return path
+
+    # ------------------------------------------------------------ signals
+    def _install_signal_handlers(self) -> None:
+        for name in self.signals:
+            sig = _SIG_BY_NAME.get(name)
+            if sig is None:
+                continue
+            try:
+                self._prev_handlers[sig] = signal.signal(
+                    sig, self._on_signal)
+            except ValueError:
+                # not the main thread: the stall timer still works;
+                # signal dumps just aren't available from here
+                break
+
+    def _restore_signal_handlers(self) -> None:
+        for sig, prev in self._prev_handlers.items():
+            try:
+                signal.signal(sig, prev)
+            except (ValueError, TypeError):  # pragma: no cover
+                pass
+        self._prev_handlers.clear()
+
+    def _on_signal(self, signum, frame) -> None:
+        name = signal.Signals(signum).name
+        self.dump(f"signal:{name}")
+        if signum == signal.SIGTERM:
+            # preserve the killer's semantics: restore whatever handler
+            # was there and re-deliver, so `timeout` still reports 124
+            # and the process still dies 143
+            prev = self._prev_handlers.pop(signum, signal.SIG_DFL)
+            try:
+                signal.signal(signum, prev)
+            except (ValueError, TypeError):  # pragma: no cover
+                pass
+            os.kill(os.getpid(), signum)
+
+    # --------------------------------------------------------------- loop
+    def _run(self) -> None:
+        # re-arm on any BEAT (age drops below the threshold), not on the
+        # step count: a stall before the first committed step leaves the
+        # step unchanged, and keying on it would eat every later dump —
+        # driving() stamps the clock on entry, so each new generate loop
+        # re-arms even if the previous one died step-less
+        fired = False
+        while not self._stop.wait(self.poll_interval):
+            st = self.heartbeat.state()
+            if (st["active"] <= 0 or st["age_s"] is None
+                    or st["age_s"] <= self.stall_timeout):
+                fired = False
+                continue
+            if not fired:
+                fired = True                 # once per stall
+                self.stall_count += 1
+                self.dump(f"stall>{self.stall_timeout:g}s")

@@ -434,6 +434,35 @@ class LLM:
 
         return get_tracer().trace(path)
 
+    def flight_record(self, last: Optional[int] = None) -> List[Dict]:
+        """The flight recorder's event ring (oldest first; ``last``
+        keeps only the tail) — the always-on post-mortem black box of
+        admit / prefill-chunk / decode-step / spec-* / commit / donate /
+        evict / host-sync / compile events.  Bounded memory, near-zero
+        cost under FF_TELEMETRY=0.  See docs/OBSERVABILITY.md
+        "Post-mortem debugging"."""
+        from ..observability import get_flight_recorder
+
+        return get_flight_recorder().events(last=last)
+
+    def watchdog(self, stall_timeout: float = 120.0,
+                 bundle_dir: Optional[str] = None,
+                 signals: tuple = ("SIGTERM", "SIGUSR1"), **kwargs):
+        """A stall :class:`~flexflow_tpu.observability.Watchdog` for
+        this process: while a generate loop is running and no step
+        commits for ``stall_timeout`` seconds — or on SIGTERM/SIGUSR1 —
+        it dumps a bundle (flight record, metrics snapshot, all-thread
+        stacks, jax memory stats) to ``bundle_dir`` for
+        ``tools/ffstat.py``.
+
+        >>> with llm.watchdog(stall_timeout=60, bundle_dir="/tmp/wd"):
+        ...     llm.generate(prompts)
+        """
+        from ..observability import Watchdog
+
+        return Watchdog(stall_timeout=stall_timeout,
+                        bundle_dir=bundle_dir, signals=signals, **kwargs)
+
 
 class SSM(LLM):
     """A small speculative model (reference serve.py class SSM): always

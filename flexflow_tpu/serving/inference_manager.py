@@ -30,7 +30,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec
 from ..config import (AXIS_DATA, AXIS_EXPERT, AXIS_MODEL, AXIS_PIPE,
                       AXIS_SEQ, FFConfig)
 from ..fftype import InferenceMode, OpType
-from ..observability import get_registry, get_tracer
+from ..observability import get_flight_recorder, get_registry, get_tracer
 from ..ops.registry import OpContext, get_op
 from .batch_config import (BatchConfig, BeamSearchBatchConfig,
                            InferenceResult, TreeVerifyBatchConfig)
@@ -464,6 +464,7 @@ class InferenceManager:
         m = get_registry()
         self._registry = m
         self.tracer = get_tracer()
+        self.recorder = get_flight_recorder()
         self._c_host_syncs = m.counter("serving_host_syncs_total")
         self._c_kernel_path = m.counter("serving_kernel_path_total")
         self._c_pp_dispatch = m.counter("serving_pp_stage_dispatches_total")
@@ -476,6 +477,10 @@ class InferenceManager:
         modules)."""
         self.host_syncs += n  # lint: allow-direct-sync (the odometer itself)
         self._c_host_syncs.inc(n)
+        # flight-record twin: a stall bundle whose ring ENDS on host-sync
+        # is a blocked device fetch (dead tunnel), vs ending on a
+        # dispatch event (hung compile / collective)
+        self.recorder.record_event("host-sync", n=n)
 
     # ------------------------------------------------------------ compile
     def compile_model_and_allocate_buffer(
@@ -623,6 +628,8 @@ class InferenceManager:
         self.models[mid] = record
         self._g_cache_bytes.set(
             self.kv_cache_stats(mid).bytes_resident, model=mid)
+        self.recorder.record_event("compile", model=mid, mode=str(mode),
+                                   rows=rows, alloc_len=alloc_len)
         return mid
 
     def _compile_pipeline_model(self, model, mode, max_requests,
@@ -647,6 +654,8 @@ class InferenceManager:
         self.models[mid] = record
         self._g_cache_bytes.set(
             self.kv_cache_stats(mid).bytes_resident, model=mid)
+        self.recorder.record_event("compile", model=mid, mode=str(mode),
+                                   rows=rows, alloc_len=alloc_len, pp=True)
         return mid
 
     def rewiden_beam(self, model_id: int, beam_width: int) -> None:

@@ -345,6 +345,7 @@ def pipeline_decode_block(im, record, model_id: int, bc, k: int, rng,
                  and flash_wins(bc, k + 1, record["alloc_len"],
                                 _record_flash_tile(record)))
     im.count_kernel_path(record, 1, gate_ok, use_flash)
+    im.recorder.record_event("decode-step", block=k, pp=pp, groups=M)
 
     # jitted per-stage chunk-1 steps (shared with the per-token path
     # except for the group row count)
@@ -499,6 +500,11 @@ def pipeline_inference(im, record, model_id: int, batch, rng) -> List[Any]:
             and flash_prefill_wins(_BCView, chunk,
                                    record["alloc_len"])))
     im.count_kernel_path(record, chunk, gate_ok, use_flash)
+    if chunk > 1:
+        im.recorder.record_event("prefill-chunk", chunk=chunk,
+                                 pp=len(stages))
+    else:
+        im.recorder.record_event("decode-step", chunk=1, pp=len(stages))
     for s in range(len(stages)):
         key = ("pp_step", s, chunk, use_flash)
         if key not in record["pp_steps"]:

@@ -55,7 +55,7 @@ from __future__ import annotations
 
 from typing import Dict, List, Optional, Sequence, Set, Tuple
 
-from ..observability import get_registry, get_tracer
+from ..observability import get_flight_recorder, get_registry, get_tracer
 from ..utils.profiling import PrefixCacheStats
 
 # Matches align down to this boundary — the flash-prefill append window
@@ -129,6 +129,7 @@ class PrefixCache:
         # registry aggregates across pools and rides snapshots)
         m = get_registry()
         self._tracer = get_tracer()
+        self._recorder = get_flight_recorder()
         self._c_lookups = m.counter("serving_prefix_lookups_total")
         self._c_hits = m.counter("serving_prefix_hits_total")
         self._c_matched = m.counter("serving_prefix_tokens_matched_total")
@@ -257,6 +258,8 @@ class PrefixCache:
                 self._c_evictions.inc()
                 self._tracer.instant("evict", slot=old.slot,
                                      reason="superseded")
+                self._recorder.record_event("evict", slot=old.slot,
+                                            reason="superseded")
         return True
 
     def _split(self, child: _Node, j: int) -> _Node:
@@ -388,6 +391,8 @@ class PrefixCache:
         self.stats.evictions += 1
         self._c_evictions.inc()
         self._tracer.instant("evict", slot=victim.slot, reason="lru")
+        self._recorder.record_event("evict", slot=victim.slot,
+                                    reason="lru")
         return victim.slot, victim
 
     def remove(self, entry: PrefixEntry):

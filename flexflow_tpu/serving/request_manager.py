@@ -33,7 +33,8 @@ import jax
 import numpy as np
 
 from ..fftype import InferenceMode
-from ..observability import get_registry, get_tracer
+from ..observability import (get_flight_recorder, get_heartbeat,
+                             get_registry, get_tracer)
 from .batch_config import BatchConfig, InferenceResult, pick_chunk
 from .inference_manager import InferenceManager
 from .prefix_cache import PrefixCache
@@ -188,6 +189,14 @@ class RequestManager:
         # per-step cost is one enabled-check per emission
         m = get_registry()
         self.tracer = get_tracer()
+        # post-mortem black box + stall-watchdog heartbeat: the recorder
+        # rides the same sites as the tracer but is ALWAYS on (bounded
+        # ring; inert under FF_TELEMETRY=0), the heartbeat beats once
+        # per committed step via _note_step — every driver loop commits
+        # through it, so "last committed step" covers incr, host-spec
+        # and device-spec alike (observability/watchdog.py)
+        self.recorder = get_flight_recorder()
+        self.heartbeat = get_heartbeat()
         self._m_queue_depth = m.gauge("serving_queue_depth")
         self._m_active = m.gauge("serving_active_requests")
         self._m_occupancy = m.gauge("serving_batch_occupancy")
@@ -336,11 +345,16 @@ class RequestManager:
                     self.tracer.instant("prefix-match", guid=req.guid,
                                         row=row, matched=best,
                                         prompt_len=req.prompt_len)
+                    self.recorder.record_event(
+                        "prefix-match", guid=req.guid, row=row,
+                        matched=best)
             if primary is not None:
                 req.cached_len = matched.get(primary, 0)
             self._m_admitted.inc()
             self.tracer.instant("admit", guid=req.guid, row=row,
                                 prompt_len=req.prompt_len)
+            self.recorder.record_event("admit", guid=req.guid, row=row,
+                                       prompt_len=req.prompt_len)
             admitted.append((req, matched))
         self._m_queue_depth.set(len(self.pending))
         self._m_active.set(len(self.running))
@@ -366,6 +380,8 @@ class RequestManager:
         if ok:
             self.tracer.instant("donate", guid=req.guid, slot=slot,
                                 length=length)
+            self.recorder.record_event("donate", guid=req.guid,
+                                       slot=slot, length=length)
         return ok
 
     def _finished(self, req: Request, new_token: int) -> bool:
@@ -544,8 +560,11 @@ class RequestManager:
                 and im.supports_prefix_cache(model_id)) else None)
         self._chunk_floor = im.min_prefill_chunk(model_id)
         try:
-            return self._incr_decoding_loop(im, model_id, requests, rng,
-                                            decode_block)
+            # heartbeat scope: the stall watchdog only declares a stall
+            # while a driver loop is in flight (idle != stalled)
+            with self.heartbeat.driving("incr-decode"):
+                return self._incr_decoding_loop(im, model_id, requests,
+                                                rng, decode_block)
         finally:
             self._prefix_ctx = None
             self._chunk_floor = 1
@@ -564,6 +583,9 @@ class RequestManager:
                 # largest remaining span bounds useful block length
                 k = pick_chunk(max(1, self._max_remaining_budget()),
                                decode_block)
+                self.recorder.record_event(
+                    "decode-step", block=k,
+                    rows=bc.num_active_requests())
                 with self.tracer.span("decode-step", block=k,
                                       rows=bc.num_active_requests()):
                     toks = np.asarray(im.decode_block(
@@ -574,6 +596,16 @@ class RequestManager:
                 bc, result = None, None
                 continue
             span_name = "prefill-chunk" if bc.chunk > 1 else "decode-step"
+            # literal names per branch: the metric-schema lint keeps the
+            # flight-record vocabulary statically enumerable
+            if bc.chunk > 1:
+                self.recorder.record_event(
+                    "prefill-chunk", chunk=bc.chunk,
+                    rows=bc.num_active_requests())
+            else:
+                self.recorder.record_event(
+                    "decode-step", chunk=1,
+                    rows=bc.num_active_requests())
             with self.tracer.span(span_name, chunk=bc.chunk,
                                   rows=bc.num_active_requests()):
                 outs = im.inference(model_id, bc, rng=step_rng)
@@ -616,7 +648,11 @@ class RequestManager:
     def _note_step(self, t_start: float, tokens: int):
         """Record one driver-loop step's host-observed wall time and
         token yield — ``tokens`` is ALWAYS the batch-total committed this
-        step (every driver's unit; the schema help documents it)."""
+        step (every driver's unit; the schema help documents it).  Also
+        the single heartbeat site: every driver loop commits through
+        here, so the stall watchdog's "last committed step" covers incr,
+        host-spec and device-spec alike."""
+        self.heartbeat.beat(tokens=tokens)
         self._m_step_latency.observe(time.monotonic() - t_start)
         if tokens > 0:
             self._m_step_tokens.observe(tokens)
@@ -677,6 +713,8 @@ class RequestManager:
         # init consumes one budget slot, the k scan steps the rest
         k = pick_chunk(max(1, self._max_remaining_budget() - 1),
                        decode_block)
+        self.recorder.record_event("decode-step", block=k, handoff=True,
+                                   rows=bc2.num_active_requests())
         with self.tracer.span("decode-step", block=k, handoff=True,
                               rows=bc2.num_active_requests()):
             toks_dev = im.decode_block(

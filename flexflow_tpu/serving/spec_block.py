@@ -489,6 +489,8 @@ def _llm_prompt_prefill(rm, im, llm_id, running, states, tree_chunk, rng):
             bc.tree_mask[row, :n, :n] = np.tril(np.ones((n, n), bool))
             st["llm_cached"] += n
         rng, r = jax.random.split(rng)
+        rm.recorder.record_event("prefill-chunk", chunk=chunk,
+                                 model="verify")
         with rm.tracer.span("prefill-chunk", chunk=chunk, model="verify"):
             im.inference(llm_id, bc, rng=r)  # async; nothing fetched
 
@@ -531,6 +533,8 @@ def _ssm_prompt_prefill(rm, im, ssm_id, running, states, W, rng,
             req.profile.ssm_prefill_chunks += 1
             req.profile.ssm_prefill_rows += 1
         rng, r = jax.random.split(rng)
+        rm.recorder.record_event("prefill-chunk", chunk=chunk,
+                                 model="draft")
         with rm.tracer.span("prefill-chunk", chunk=chunk, model="draft"):
             im.inference(ssm_id, bc, rng=r)
 
@@ -710,6 +714,9 @@ def generate_spec_infer_device(rm, im, llm_id: int,
         while True:
             t_step = time.monotonic()
             folded = 0
+            rm.recorder.record_event("spec-verify",
+                                     inflight=len(inflight),
+                                     rows=len(running))
             with rm.tracer.span("spec-verify", inflight=len(inflight),
                                 rows=len(running)):
                 for packed in inflight:
@@ -718,6 +725,7 @@ def generate_spec_infer_device(rm, im, llm_id: int,
                     folded += _fold_packed(P, D, running, states)
             if folded:
                 rm.tracer.instant("commit", tokens=folded)
+                rm.recorder.record_event("commit", tokens=folded)
             rm._note_step(t_step, folded)
             inflight = []
             active, budget = P[:, 1] > 0, P[:, 2]
@@ -955,6 +963,8 @@ def generate_spec_infer_device_pp(rm, im, llm_id: int,
         # first sync after ONE iteration (fast TTFT), then rate-scaled
         t_step = time.monotonic()
         rng, r = jax.random.split(rng)
+        rm.recorder.record_event("spec-verify", k=1, rows=len(running),
+                                 pp=True)
         with rm.tracer.span("spec-verify", k=1, rows=len(running)):
             state, ssm_caches, packed = iterate(state, ssm_caches, r)
             P = np.asarray(packed)
@@ -967,6 +977,8 @@ def generate_spec_infer_device_pp(rm, im, llm_id: int,
             remaining = int(P[P[:, 1] > 0, 2].max())
             k = max(1, int(remaining // rate))
             t_step = time.monotonic()
+            rm.recorder.record_event("spec-verify", k=k,
+                                     rows=len(running), pp=True)
             with rm.tracer.span("spec-verify", k=k, rows=len(running)):
                 for _ in range(k):
                     rng, r = jax.random.split(rng)

@@ -314,15 +314,27 @@ def generate_spec_infer(rm, im, llm_id: int, requests: Sequence[Request],
         device_loop = device_loop_supported(rm, im, llm_id, beam_width,
                                             beam_depth)
     if device_loop:
-        return generate_spec_infer_device(rm, im, llm_id, requests,
-                                          seed=seed, beam_width=beam_width,
-                                          beam_depth=beam_depth)
+        # heartbeat scope covers the pp variant too (the device driver
+        # dispatches to it internally)
+        with rm.heartbeat.driving("spec-device"):
+            return generate_spec_infer_device(rm, im, llm_id, requests,
+                                              seed=seed,
+                                              beam_width=beam_width,
+                                              beam_depth=beam_depth)
     ssm_ids = list(rm.ssm_model_ids)
     tree_chunk = rm.max_spec_tree_token_num
     rng = jax.random.PRNGKey(seed)
     states: Dict[int, SpecState] = {}
     model_rows = spec_model_rows(rm, im, llm_id)
 
+    with rm.heartbeat.driving("spec-infer"):
+        return _spec_infer_loop(rm, im, llm_id, requests, ssm_ids,
+                                tree_chunk, rng, states, model_rows,
+                                beam_width, beam_depth)
+
+
+def _spec_infer_loop(rm, im, llm_id, requests, ssm_ids, tree_chunk, rng,
+                     states, model_rows, beam_width, beam_depth):
     while True:
         # ---- admission / retirement bookkeeping via the shared machinery
         # (prefix-aware: a pooled-prefix hit copies the matched span into
@@ -381,6 +393,8 @@ def generate_spec_infer(rm, im, llm_id: int, requests: Sequence[Request],
         # expansion to depth D, then merge into the shared tree.
         rm.tracer.begin("spec-draft", ssms=len(ssm_ids),
                         rows=len(running))
+        rm.recorder.record_event("spec-draft", ssms=len(ssm_ids),
+                                 rows=len(running))
         for ssm_id in ssm_ids:
             ssm_record = im.models[ssm_id]
             W = beam_width or ssm_record["beam_width"]
@@ -475,6 +489,8 @@ def generate_spec_infer(rm, im, llm_id: int, requests: Sequence[Request],
                 rm._m_spec_verify.observe(
                     int(bc.num_tokens_in_batch[row]))
         rng, r4 = jax.random.split(rng)
+        rm.recorder.record_event("spec-verify", rows=len(running),
+                                 chunk=tree_chunk)
         with rm.tracer.span("spec-verify", rows=len(running),
                             chunk=tree_chunk):
             outs = im.inference(llm_id, bc, rng=r4)
@@ -496,6 +512,9 @@ def generate_spec_infer(rm, im, llm_id: int, requests: Sequence[Request],
             rm.tracer.instant("commit", guid=req.guid, row=row,
                               tokens=len(new_tokens),
                               accepted=len(acc_tokens))
+            rm.recorder.record_event("commit", guid=req.guid, row=row,
+                                     tokens=len(new_tokens),
+                                     accepted=len(acc_tokens))
             # chain nodes' KV landed at their final slots already; accepted
             # speculative nodes move from tree slot to committed position
             base = st.llm_cached  # batch slot c -> cache slot base + c

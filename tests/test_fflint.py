@@ -36,13 +36,20 @@ SCHEMA = {
     "serving_queue_depth": {"type": "gauge", "help": "x"},
 }
 
+EVENTS = {
+    "admit": {"help": "x"},
+    "decode-step": {"help": "x"},
+}
 
-def lint(tmp_path, src, rules, rel="serving/mod.py", schema=SCHEMA):
+
+def lint(tmp_path, src, rules, rel="serving/mod.py", schema=SCHEMA,
+         events=EVENTS):
     """Write ``src`` under tmp_path/rel and lint it with ``rules``."""
     path = tmp_path / rel
     path.parent.mkdir(parents=True, exist_ok=True)
     path.write_text(textwrap.dedent(src))
-    ctx = LintContext(repo_root=str(tmp_path), schema=schema)
+    ctx = LintContext(repo_root=str(tmp_path), schema=schema,
+                      events=events)
     return lint_file(str(path), rules, ctx, rel=rel)
 
 
@@ -526,6 +533,40 @@ class TestMetricSchemaRule:
                     "serving_rogue_total")
             """, self.R)
         assert len(fs) == 1 and fs[0].rule == "metric-schema"
+
+    def test_record_event_names_validated(self, tmp_path):
+        # flight-recorder emissions: declared literal ok; undeclared and
+        # non-literal flagged; a bare-function alias is covered too
+        fs = lint(tmp_path, """\
+            def emit(rec, name, record_event):
+                rec.record_event("admit", guid=1)
+                rec.record_event("rogue-event", guid=1)
+                rec.record_event(name)
+                record_event("decode-step", block=4)
+                record_event("also-rogue")
+            """, self.R)
+        assert at(fs, "metric-schema", 3), fs     # undeclared (method)
+        assert at(fs, "metric-schema", 4), fs     # non-literal
+        assert at(fs, "metric-schema", 6), fs     # undeclared (bare)
+        assert len(fs) == 3
+
+    def test_record_event_without_events_schema_skips_names(self,
+                                                            tmp_path):
+        # fixture trees without an EVENT_SCHEMA: name validation skips,
+        # the non-literal check still applies
+        fs = lint(tmp_path, """\
+            def emit(rec, name):
+                rec.record_event("anything-goes")
+                rec.record_event(name)
+            """, self.R, events=None)
+        assert len(fs) == 1 and at(fs, "metric-schema", 3), fs
+
+    def test_record_event_suppression(self, tmp_path):
+        fs = lint(tmp_path, """\
+            def emit(rec):
+                rec.record_event("scratch-event")  # fflint: disable=metric-schema  ad-hoc test ring
+            """, self.R)
+        assert fs == []
 
 
 # --------------------------------------------------- direct host sync

@@ -112,10 +112,26 @@ class LintContext:
     tests can lint fixture trees without the real repo around."""
 
     def __init__(self, repo_root: Optional[str] = None,
-                 schema: Optional[dict] = None):
+                 schema: Optional[dict] = None,
+                 events: Optional[dict] = None):
         self.repo_root = repo_root or default_repo_root()
         self._schema = schema
-        self._schema_loaded = schema is not None
+        self._events = events
+        # injected overrides suppress the file load for BOTH tables (a
+        # fixture tree with only a metrics override must not pick up
+        # the real repo's event table, and vice versa)
+        self._schema_loaded = schema is not None or events is not None
+
+    def _load_schema_file(self) -> None:
+        self._schema_loaded = True
+        path = os.path.join(self.repo_root, "flexflow_tpu",
+                            "observability", "schema.py")
+        if os.path.exists(path):
+            ns: dict = {}
+            with open(path) as f:
+                exec(compile(f.read(), path, "exec"), ns)  # noqa: S102
+            self._schema = ns.get("METRICS_SCHEMA")
+            self._events = ns.get("EVENT_SCHEMA")
 
     @property
     def metrics_schema(self) -> Optional[dict]:
@@ -124,15 +140,16 @@ class LintContext:
         pure dict).  None when the schema file does not exist (fixture
         trees) — the metric rule then skips name validation."""
         if not self._schema_loaded:
-            self._schema_loaded = True
-            path = os.path.join(self.repo_root, "flexflow_tpu",
-                                "observability", "schema.py")
-            if os.path.exists(path):
-                ns: dict = {}
-                with open(path) as f:
-                    exec(compile(f.read(), path, "exec"), ns)  # noqa: S102
-                self._schema = ns.get("METRICS_SCHEMA")
+            self._load_schema_file()
         return self._schema
+
+    @property
+    def events_schema(self) -> Optional[dict]:
+        """EVENT_SCHEMA (flight-recorder/tracer event vocabulary) from
+        the same file, same loading rules."""
+        if not self._schema_loaded:
+            self._load_schema_file()
+        return self._events
 
 
 def default_repo_root() -> str:

@@ -1,14 +1,17 @@
-"""Rule ``metric-schema``: the emitted metric vocabulary is enumerable.
+"""Rule ``metric-schema``: the emitted metric AND event vocabulary is
+enumerable.
 
 Every metric name passed to a registry factory —
 ``.counter("…")`` / ``.gauge("…")`` / ``.histogram("…")`` — must be a
 string literal declared in
 ``flexflow_tpu/observability/schema.METRICS_SCHEMA`` with a matching
-type.  The registry enforces this at runtime too, but a code path that
-only runs on chip would ship the violation; this gate fails in CI
-first.  Non-literal names are rejected outright: the schema exists
-precisely so the emitted vocabulary is statically enumerable (the
-reference ships a fixed ProfileInfo struct the same way,
+type, and every flight-recorder emission — ``record_event("…")`` —
+must name a literal declared in ``schema.EVENT_SCHEMA``.  The registry
+and recorder enforce this at runtime too, but a code path that only
+runs on chip would ship the violation; this gate fails in CI first.
+Non-literal names are rejected outright: the schema exists precisely
+so the emitted vocabulary is statically enumerable (the reference
+ships a fixed ProfileInfo struct the same way,
 request_manager.h:244-250).
 
 AST-level (subsumes the wrapped-call blindspots of the old
@@ -32,6 +35,10 @@ from typing import Iterable, List
 from ..core import Finding, LintContext, Module, Rule
 
 FACTORIES = {"counter", "gauge", "histogram"}
+#: the flight-recorder emission method (FlightRecorder.record_event and
+#: any alias bound as a bare function) — names validate against
+#: EVENT_SCHEMA instead of METRICS_SCHEMA
+RECORD_FUNCS = {"record_event"}
 #: receivers that have same-named methods/functions but are not the
 #: metrics registry (np.histogram, pandas plotting, …)
 SKIP_RECEIVERS = {"np", "numpy", "jnp", "scipy", "torch", "plt", "pd",
@@ -40,8 +47,8 @@ SKIP_RECEIVERS = {"np", "numpy", "jnp", "scipy", "torch", "plt", "pd",
 
 class MetricSchemaRule(Rule):
     id = "metric-schema"
-    short = ("registry.counter/gauge/histogram names must be literals "
-             "declared in observability/schema.py with matching type")
+    short = ("registry.counter/gauge/histogram and record_event names "
+             "must be literals declared in observability/schema.py")
 
     def check(self, module: Module,
               ctx: LintContext) -> Iterable[Finding]:
@@ -51,6 +58,13 @@ class MetricSchemaRule(Rule):
             if not isinstance(node, ast.Call):
                 continue
             f = node.func
+            # flight-recorder emissions: rec.record_event("name", ...)
+            # or a bare record_event("name", ...) alias
+            fname = (f.attr if isinstance(f, ast.Attribute)
+                     else f.id if isinstance(f, ast.Name) else None)
+            if fname in RECORD_FUNCS:
+                findings.extend(self._check_event(module, node, ctx))
+                continue
             if not (isinstance(f, ast.Attribute) and f.attr in FACTORIES):
                 continue
             if (isinstance(f.value, ast.Name)
@@ -88,3 +102,29 @@ class MetricSchemaRule(Rule):
                     f"non-literal name — the schema's emitted "
                     f"vocabulary must be statically enumerable"))
         return findings
+
+    def _check_event(self, module: Module, node: ast.Call,
+                     ctx: LintContext) -> List[Finding]:
+        """Validate one record_event(...) call against EVENT_SCHEMA."""
+        name_node = node.args[0] if node.args else None
+        if name_node is None:
+            for kwarg in node.keywords:
+                if kwarg.arg == "name":
+                    name_node = kwarg.value
+        if name_node is None:
+            return []
+        if not (isinstance(name_node, ast.Constant)
+                and isinstance(name_node.value, str)):
+            return [self.finding(
+                module, node,
+                "record_event() called with a non-literal event name — "
+                "the flight-record vocabulary must be statically "
+                "enumerable")]
+        events = ctx.events_schema
+        if events is None or name_node.value in events:
+            return []
+        return [self.finding(
+            module, node,
+            f"flight-recorder event {name_node.value!r} is not declared "
+            f"in observability/schema.py EVENT_SCHEMA — declare it "
+            f"(with help text) before emitting it")]

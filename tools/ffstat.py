@@ -1,0 +1,287 @@
+#!/usr/bin/env python
+"""Pretty-print flight-recorder bundles and serving-telemetry records.
+
+Reads any of:
+
+- a **watchdog bundle** (``ffbundle_*.json`` from
+  ``flexflow_tpu/observability/watchdog.py`` — stall, SIGTERM or
+  SIGUSR1 dump): prints the stall diagnosis (reason, last heartbeat,
+  the event the ring ends on), a per-phase timing table derived from
+  the ring, the last N events, a thread summary and key metrics;
+- a **raw flight-record dump** (``FlightRecorder.snapshot()`` JSON:
+  a dict with an ``events`` list);
+- a **bench round record** (``bench_results/<round>.json`` with a
+  ``telemetry`` snapshot): prints the metrics summary half only.
+
+Usage:
+    python tools/ffstat.py BUNDLE.json [BUNDLE2.json ...]
+        [--events N] [--guid G] [--prom] [--selftest]
+
+``--events N``  tail length to print (default 32)
+``--guid G``    additionally print the last events touching request G
+``--prom``      emit the bundle's metrics snapshot as Prometheus text
+                exposition (scrape-ready) instead of the human tables
+``--selftest``  build a synthetic bundle end-to-end (recorder ->
+                heartbeat -> dump_bundle) in a temp dir and print it —
+                the CI smoke for the whole dump path (run_tier1.sh)
+
+Exit 1 on an unreadable or empty input — smoke tests use this as the
+"bundle is loadable" gate.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from collections import defaultdict
+from typing import Any, Dict, List, Optional
+
+# direct invocation (`python tools/ffstat.py`) puts tools/ on sys.path,
+# not the repo root — the --prom/--selftest imports need the package
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+
+# --------------------------------------------------------------- loading
+def load(path: str) -> Dict[str, Any]:
+    with open(path) as f:
+        doc = json.load(f)
+    if not isinstance(doc, dict):
+        raise ValueError(f"{path}: expected a JSON object")
+    return doc
+
+
+def flight_events(doc: Dict[str, Any]) -> Optional[List[Dict[str, Any]]]:
+    """The event ring from a bundle or a raw recorder snapshot."""
+    fr = doc.get("flight_record")
+    if isinstance(fr, dict) and isinstance(fr.get("events"), list):
+        return fr["events"]
+    if isinstance(doc.get("events"), list):
+        return doc["events"]
+    return None
+
+
+def metrics_snapshot(doc: Dict[str, Any]) -> Optional[Dict[str, Any]]:
+    for key in ("metrics", "telemetry"):
+        snap = doc.get(key)
+        if isinstance(snap, dict) and ("counters" in snap
+                                       or "histograms" in snap):
+            return snap
+    return None
+
+
+# ------------------------------------------------------------ formatting
+def _fmt_payload(ev: Dict[str, Any]) -> str:
+    skip = ("name", "t", "seq")
+    return " ".join(f"{k}={v}" for k, v in ev.items() if k not in skip)
+
+
+def phase_table(events: List[Dict[str, Any]]) -> str:
+    """Per-phase timing from the ring: the gap from each event to the
+    next one is attributed to that event's phase (phases are recorded
+    at dispatch, so the gap IS the phase's wall time to within one
+    event).  The last event's phase gets an open-ended marker."""
+    agg: Dict[str, Dict[str, float]] = defaultdict(
+        lambda: {"count": 0, "total": 0.0, "max": 0.0})
+    for i, ev in enumerate(events):
+        s = agg[ev.get("name", "?")]
+        s["count"] += 1
+        if i + 1 < len(events):
+            dt = float(events[i + 1].get("t", 0)) - float(ev.get("t", 0))
+            s["total"] += dt
+            s["max"] = max(s["max"], dt)
+    lines = [f"{'phase':<16} {'count':>7} {'total s':>9} {'mean ms':>9} "
+             f"{'max ms':>9}"]
+    for name, s in sorted(agg.items(), key=lambda kv: -kv[1]["total"]):
+        n = int(s["count"])
+        lines.append(
+            f"{name:<16} {n:>7} {s['total']:>9.3f} "
+            f"{s['total'] / n * 1e3:>9.3f} {s['max'] * 1e3:>9.3f}")
+    return "\n".join(lines)
+
+
+def event_tail(events: List[Dict[str, Any]], n: int,
+               guid: Optional[int] = None) -> str:
+    sel = [ev for ev in events
+           if guid is None or ev.get("guid") == guid][-n:]
+    if not sel:
+        return "  (no events)"
+    t_last = float(sel[-1].get("t", 0.0))
+    lines = []
+    for ev in sel:
+        dt = float(ev.get("t", 0.0)) - t_last
+        lines.append(f"  #{ev.get('seq', '?'):>7} {dt:>+9.3f}s "
+                     f"{ev.get('name', '?'):<14} {_fmt_payload(ev)}")
+    return "\n".join(lines)
+
+
+def diagnosis(doc: Dict[str, Any],
+              events: Optional[List[Dict[str, Any]]]) -> str:
+    lines = []
+    reason = doc.get("reason")
+    if reason:
+        lines.append(f"reason: {reason}   pid {doc.get('pid', '?')}   "
+                     f"time_unix {doc.get('time_unix', '?')}")
+    hb = doc.get("last_heartbeat")
+    if isinstance(hb, dict):
+        age = (f"{hb['age_s']}s" if hb.get("age_s") is not None
+               else "n/a (no step committed)")
+        lines.append(
+            f"last heartbeat: step {hb.get('step')} "
+            f"phase {hb.get('phase')!r} age {age} "
+            f"active {hb.get('active')}")
+        if hb.get("active") and hb.get("age_s") is not None:
+            lines.append(
+                f"=> a driver loop was ACTIVE and silent for "
+                f"{hb['age_s']}s when this bundle was dumped")
+    if events:
+        last = events[-1]
+        fr = doc.get("flight_record") or {}
+        lines.append(
+            f"ring: {len(events)} events held "
+            f"({fr.get('recorded', len(events))} recorded, "
+            f"{fr.get('dropped', 0)} dropped); "
+            f"ends on {last.get('name', '?')!r} ({_fmt_payload(last)})")
+        if last.get("name") == "host-sync":
+            lines.append("=> ring ends on host-sync: likely a blocked "
+                         "device->host fetch (dead tunnel / hung "
+                         "dispatch)")
+        elif last.get("name") == "compile":
+            lines.append("=> ring ends on compile: likely a hung or "
+                         "looping compilation")
+    jx = doc.get("jax")
+    if isinstance(jx, dict) and jx:
+        lines.append("jax: " + " ".join(
+            f"{k}={v}" for k, v in jx.items()
+            if k != "device_memory_stats"))
+    threads = doc.get("threads")
+    if isinstance(threads, dict) and threads:
+        lines.append(f"threads captured: {len(threads)} "
+                     f"({', '.join(sorted(threads))})")
+    return "\n".join(lines)
+
+
+def metrics_summary(snap: Dict[str, Any]) -> str:
+    lines = []
+    counters = snap.get("counters") or {}
+    for name in ("serving_tokens_generated_total",
+                 "serving_requests_admitted_total",
+                 "serving_requests_retired_total",
+                 "serving_host_syncs_total"):
+        if name in counters:
+            v = counters[name]
+            total = v.get("total") if isinstance(v, dict) else v
+            lines.append(f"  {name:<40} {total}")
+    lat = (snap.get("histograms") or {}).get(
+        "serving_step_latency_seconds")
+    if isinstance(lat, dict) and lat.get("count"):
+        lines.append(
+            f"  step latency: count {lat['count']} "
+            f"p50 {lat.get('p50')}s p90 {lat.get('p90')}s "
+            f"p99 {lat.get('p99')}s max {lat.get('max')}s")
+    return "\n".join(lines) if lines else "  (no serving metrics)"
+
+
+# ------------------------------------------------------------------ main
+def print_doc(path: str, doc: Dict[str, Any], n_events: int,
+              guid: Optional[int], prom: bool) -> int:
+    events = flight_events(doc)
+    snap = metrics_snapshot(doc)
+    if events is None and snap is None:
+        print(f"{path}: neither a flight record nor a telemetry "
+              f"snapshot", file=sys.stderr)
+        return 1
+    if prom:
+        if snap is None:
+            print(f"{path}: no metrics snapshot to expose",
+                  file=sys.stderr)
+            return 1
+        from flexflow_tpu.observability import prometheus_text
+
+        sys.stdout.write(prometheus_text(snap))
+        return 0
+    print(f"== {path}")
+    diag = diagnosis(doc, events)
+    if diag:
+        print(diag)
+    if events:
+        print("\n-- per-phase timing (ring window)")
+        print(phase_table(events))
+        print(f"\n-- last {min(n_events, len(events))} events")
+        print(event_tail(events, n_events))
+        if guid is not None:
+            print(f"\n-- last events for guid {guid}")
+            print(event_tail(events, n_events, guid=guid))
+    if snap is not None:
+        print("\n-- metrics")
+        print(metrics_summary(snap))
+    return 0
+
+
+def selftest() -> int:
+    """End-to-end smoke of the dump path: record -> heartbeat -> bundle
+    -> pretty-print.  Used by tools/run_tier1.sh so CI exercises the
+    post-mortem machinery on every run."""
+    import tempfile
+
+    from flexflow_tpu.observability import (FlightRecorder, Heartbeat,
+                                            MetricsRegistry, dump_bundle)
+
+    rec = FlightRecorder(capacity=64)
+    hb = Heartbeat()
+    reg = MetricsRegistry()          # permissive ad-hoc registry
+    reg.counter("serving_tokens_generated_total").inc(320)
+    reg.histogram("serving_step_latency_seconds").observe(0.012)
+    with hb.driving("selftest"):
+        rec.record_event("admit", guid=1, row=0, prompt_len=16)
+        for _ in range(40):          # > capacity/2: exercises wrap math
+            rec.record_event("decode-step", block=8, rows=2)
+            hb.beat(tokens=8)
+        rec.record_event("host-sync", n=1)
+    d = tempfile.mkdtemp(prefix="ffstat_selftest_")
+    path = dump_bundle(d, "selftest", heartbeat=hb, recorder=rec,
+                       registry=reg)
+    rc = print_doc(path, load(path), 8, guid=None, prom=False)
+    doc = load(path)
+    evs = flight_events(doc)
+    ok = (rc == 0 and evs and len(evs) >= 32
+          and doc["last_heartbeat"]["step"] == 40
+          and doc["threads"] and metrics_snapshot(doc) is not None)
+    print(f"\nffstat selftest {'OK' if ok else 'FAILED'}: {path}")
+    return 0 if ok else 1
+
+
+def main(argv) -> int:
+    ap = argparse.ArgumentParser(
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("paths", nargs="*", help="bundle/record JSON files")
+    ap.add_argument("--events", type=int, default=32, metavar="N")
+    ap.add_argument("--guid", type=int, default=None, metavar="G")
+    ap.add_argument("--prom", action="store_true")
+    ap.add_argument("--selftest", action="store_true")
+    args = ap.parse_args(argv[1:])
+    if args.selftest:
+        return selftest()
+    if not args.paths:
+        ap.print_usage(sys.stderr)
+        return 1
+    rc = 0
+    for path in args.paths:
+        try:
+            doc = load(path)
+        except Exception as e:
+            print(f"{path}: unreadable ({type(e).__name__}: {e})",
+                  file=sys.stderr)
+            rc = 1
+            continue
+        rc = max(rc, print_doc(path, doc, args.events, args.guid,
+                               args.prom))
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))

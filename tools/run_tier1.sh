@@ -15,4 +15,10 @@
 (cd "$(dirname "$0")/.." \
  && python -m tools.fflint --baseline tools/fflint_baseline.json \
         flexflow_tpu tools) || exit 1
+# Flight-recorder/ffstat smoke: exercises the post-mortem dump path
+# end-to-end (ring -> heartbeat -> bundle on disk -> pretty-print) so a
+# broken dump path fails CI before a stalled chip run needs it.
+(cd "$(dirname "$0")/.." \
+ && env JAX_PLATFORMS=cpu python tools/ffstat.py --selftest >/dev/null) \
+ || { echo "ffstat/flight-recorder selftest FAILED" >&2; exit 1; }
 set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c); exit $rc

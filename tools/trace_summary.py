@@ -8,6 +8,12 @@ time and the share of the traced span.  Instant events ("i") are
 reported by count.  Complete ("X") events with ``dur`` are summed too,
 so traces from other producers load as well.
 
+Flight-record dumps load too (``FlightRecorder.snapshot()`` JSON or a
+whole watchdog bundle containing one): those print a per-event-name
+count/gap breakdown plus the stall-window event tail — the last events
+before the ring stopped, which is where a hung run's story lives.  Full
+bundle analysis (heartbeat, threads, metrics) is ``tools/ffstat.py``.
+
 Usage:  python tools/trace_summary.py TRACE.json [TRACE2.json ...]
 
 Exit 1 on an unreadable or event-less file — the smoke tests use this
@@ -19,12 +25,35 @@ from __future__ import annotations
 import json
 import sys
 from collections import defaultdict
-from typing import Any, Dict, List
+from typing import Any, Dict, List, Optional
+
+#: events shown in the stall-window tail of a flight-record dump
+TAIL_EVENTS = 24
+
+
+def load_doc(path: str):
+    with open(path) as f:
+        return json.load(f)
+
+
+def flight_events(doc) -> Optional[List[Dict[str, Any]]]:
+    """The ring from a flight-record dump or a watchdog bundle; None
+    for Chrome traces."""
+    if not isinstance(doc, dict):
+        return None
+    fr = doc.get("flight_record")
+    if isinstance(fr, dict) and isinstance(fr.get("events"), list):
+        return fr["events"]
+    ev = doc.get("events")
+    if (isinstance(ev, list)
+            and all(isinstance(e, dict) and "name" in e and "ph" not in e
+                    for e in ev[:4])):
+        return ev
+    return None
 
 
 def load_events(path: str) -> List[Dict[str, Any]]:
-    with open(path) as f:
-        doc = json.load(f)
+    doc = load_doc(path)
     events = doc["traceEvents"] if isinstance(doc, dict) else doc
     if not isinstance(events, list):
         raise ValueError(f"{path}: no traceEvents list")
@@ -104,6 +133,40 @@ def format_summary(summary: Dict[str, Dict[str, Any]],
     return "\n".join(lines)
 
 
+def summarize_flight(events: List[Dict[str, Any]]) -> str:
+    """Per-name breakdown of a flight-record ring: count + the wall time
+    from each event to the next (phases are recorded at dispatch, so
+    the gap approximates the phase's wall time), then the stall-window
+    tail — the final events before the ring stopped."""
+    agg: Dict[str, Dict[str, float]] = defaultdict(
+        lambda: {"count": 0, "total_s": 0.0, "max_s": 0.0})
+    for i, ev in enumerate(events):
+        s = agg[ev.get("name", "?")]
+        s["count"] += 1
+        if i + 1 < len(events):
+            dt = float(events[i + 1].get("t", 0)) - float(ev.get("t", 0))
+            s["total_s"] += dt
+            s["max_s"] = max(s["max_s"], dt)
+    lines = [f"{'event':<16} {'count':>7} {'total ms':>10} "
+             f"{'mean ms':>9} {'max ms':>9}"]
+    for name, s in sorted(agg.items(), key=lambda kv: -kv[1]["total_s"]):
+        n = int(s["count"])
+        lines.append(f"{name:<16} {n:>7} {s['total_s'] * 1e3:>10.3f} "
+                     f"{s['total_s'] / n * 1e3:>9.3f} "
+                     f"{s['max_s'] * 1e3:>9.3f}")
+    tail = events[-TAIL_EVENTS:]
+    t_last = float(tail[-1].get("t", 0.0))
+    lines.append(f"-- stall-window tail (last {len(tail)} events; "
+                 f"+s relative to the final event)")
+    for ev in tail:
+        payload = " ".join(f"{k}={v}" for k, v in ev.items()
+                           if k not in ("name", "t", "seq"))
+        lines.append(f"  #{ev.get('seq', '?'):>7} "
+                     f"{float(ev.get('t', 0)) - t_last:>+9.3f}s "
+                     f"{ev.get('name', '?'):<14} {payload}")
+    return "\n".join(lines)
+
+
 def main(argv) -> int:
     if len(argv) < 2:
         print(__doc__.strip(), file=sys.stderr)
@@ -111,11 +174,27 @@ def main(argv) -> int:
     rc = 0
     for path in argv[1:]:
         try:
-            events = load_events(path)
+            doc = load_doc(path)
+            fl = flight_events(doc)
+            events = None if fl is not None else (
+                doc["traceEvents"] if isinstance(doc, dict) else doc)
+            if fl is None and not isinstance(events, list):
+                raise ValueError("no traceEvents list")
         except Exception as e:
             print(f"{path}: unreadable trace ({type(e).__name__}: {e})",
                   file=sys.stderr)
             rc = 1
+            continue
+        if fl is not None:
+            if not fl:
+                print(f"{path}: flight record holds no events",
+                      file=sys.stderr)
+                rc = 1
+                continue
+            span = float(fl[-1].get("t", 0)) - float(fl[0].get("t", 0))
+            print(f"== {path}  (flight record: {len(fl)} events, "
+                  f"{span:.3f} s window)")
+            print(summarize_flight(fl))
             continue
         if not events:
             print(f"{path}: trace holds no events", file=sys.stderr)
