@@ -55,6 +55,7 @@ naming the last completed phase instead of nothing.
 import collections
 import json
 import os
+import subprocess
 import sys
 import time
 
@@ -120,6 +121,40 @@ def _results_dir() -> str:
     """bench_results/ by default; FF_BENCH_RESULTS redirects (tests)."""
     return os.environ.get("FF_BENCH_RESULTS") or os.path.join(
         REPO, "bench_results")
+
+
+_FFLINT_STATE = None
+
+
+def _fflint_state() -> dict:
+    """The static-analysis state this round ran under, stamped into
+    every committed record: a BENCH number from a tree with live fflint
+    findings (a sharding-consistency error, an unsynced fetch) is not
+    the same claim as one from a clean tree, and the record should say
+    which.  Runs `python -m tools.fflint --json` once per process
+    (pure-AST, ~2 s) and caches; never fails the bench."""
+    global _FFLINT_STATE
+    if _FFLINT_STATE is None:
+        try:
+            r = subprocess.run(
+                [sys.executable, "-m", "tools.fflint", "--json",
+                 "--baseline", "tools/fflint_baseline.json",
+                 "flexflow_tpu", "tools"],
+                capture_output=True, text=True, cwd=REPO, timeout=120)
+            data = json.loads(r.stdout)
+            _FFLINT_STATE = {
+                "clean": r.returncode == 0,
+                "new_findings": len(data.get("findings", [])),
+                "baselined": data.get("baselined", 0),
+            }
+            if data.get("findings"):
+                # name the rules so a dirty round is diagnosable from
+                # the record alone
+                _FFLINT_STATE["rules"] = sorted(
+                    {f["rule"] for f in data["findings"]})
+        except Exception as e:      # lint trouble must not kill bench
+            _FFLINT_STATE = {"error": f"{type(e).__name__}: {e}"}
+    return _FFLINT_STATE
 
 
 def _postmortem_fields() -> dict:
@@ -2213,6 +2248,7 @@ def persist_record(result, mode: str):
     record = {"round": rnd, "mode": mode,
               "time_unix": round(time.time(), 1),
               "platform": _platform_str(),
+              "fflint": _fflint_state(),
               **_kv_summary(),
               **tel,
               **_postmortem_fields(),

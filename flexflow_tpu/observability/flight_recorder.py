@@ -56,7 +56,12 @@ class FlightRecorder:
         self._names = frozenset(schema) if schema is not None else None
         self._ring: collections.deque = collections.deque(
             maxlen=self.capacity)
-        self._lock = threading.Lock()
+        # RLock, not Lock: snapshot() runs inside watchdog SIGNAL
+        # handlers, which execute at an arbitrary bytecode boundary of
+        # the main thread — if that thread is mid-record_event, a plain
+        # Lock would self-deadlock the dump (the class fflint's
+        # lock-discipline rule guards against)
+        self._lock = threading.RLock()
         self._seq = 0
         # wall/monotonic anchor pair: event["t"] - t0_mono + t0_wall
         # reconstructs a wall-clock stamp for log correlation
@@ -90,7 +95,8 @@ class FlightRecorder:
     @property
     def recorded(self) -> int:
         """Total events ever recorded (ring holds the last ``capacity``)."""
-        return self._seq
+        with self._lock:
+            return self._seq
 
     @property
     def dropped(self) -> int:
@@ -115,14 +121,18 @@ class FlightRecorder:
         drop accounting (the ``flight_record`` section of a watchdog
         bundle)."""
         with self._lock:
+            # the anchors are rewritten by clear(): reading them in the
+            # same critical section as the ring keeps a concurrent
+            # clear() from pairing old events with new anchors
             evs = list(self._ring)
             seq = self._seq
+            t0_wall, t0_mono = self._t0_wall, self._t0_mono
         return {
             "capacity": self.capacity,
             "recorded": seq,
             "dropped": max(0, seq - len(evs)),
-            "t0_wall": self._t0_wall,
-            "t0_mono": self._t0_mono,
+            "t0_wall": t0_wall,
+            "t0_mono": t0_mono,
             "events": evs,
         }
 

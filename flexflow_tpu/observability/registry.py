@@ -216,7 +216,10 @@ class MetricsRegistry:
     def __init__(self, schema: Optional[Dict[str, Dict]] = None,
                  enabled: bool = True):
         self._metrics: Dict[str, _Metric] = {}
-        self._lock = threading.Lock()
+        # RLock: snapshot() runs inside watchdog signal handlers (the
+        # bundle's "metrics" section) — a plain Lock self-deadlocks if
+        # the signal lands while this thread is mid-inc/observe
+        self._lock = threading.RLock()
         self._schema = schema
         self.enabled = enabled
 
@@ -274,7 +277,8 @@ class MetricsRegistry:
         return self._get(Histogram, name, help, **kw)
 
     def get(self, name: str) -> Optional[_Metric]:
-        return self._metrics.get(name)
+        with self._lock:
+            return self._metrics.get(name)
 
     # ------------------------------------------------------------ snapshot
     def snapshot(self) -> Dict[str, Dict[str, Any]]:

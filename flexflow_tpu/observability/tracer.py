@@ -106,13 +106,18 @@ class StepTracer:
     # -------------------------------------------------------------- events
     def _emit(self, ph: str, name: str, args: Optional[Dict]):
         ev = {"ph": ph, "name": name, "cat": "serving",
-              "ts": round((time.monotonic() - self._t0) * 1e6, 1),
               "pid": os.getpid(), "tid": threading.get_ident()}
         if args:
             ev["args"] = args
         if ph == "i":
             ev["s"] = "t"   # thread-scoped instant
         with self._lock:
+            # _t0 is rewritten by start(): BOTH the clock read and the
+            # subtraction happen inside the lock so an event raced with
+            # a restart lands wholly on one epoch — capturing the clock
+            # before acquiring would pair an old-epoch reading with the
+            # new _t0 (a negative ts in the fresh trace)
+            ev["ts"] = round((time.monotonic() - self._t0) * 1e6, 1)
             self._events.append(ev)
 
     def span(self, name: str, **args):

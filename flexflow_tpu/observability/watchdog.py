@@ -251,7 +251,13 @@ class Watchdog:
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._prev_handlers: Dict[int, Any] = {}
-        self._lock = threading.Lock()   # serialize concurrent dumps
+        # serialize concurrent dumps.  RLock, not Lock: dump() is
+        # reachable from the SIGTERM/SIGUSR1 handlers, which run at an
+        # arbitrary bytecode boundary of the main thread — if that
+        # thread is already inside dump() (serve-API poke) when the
+        # signal lands, a plain Lock deadlocks the process right as it
+        # is trying to explain why it is stuck
+        self._lock = threading.RLock()
 
     # ---------------------------------------------------------- lifecycle
     def start(self) -> "Watchdog":

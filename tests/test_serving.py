@@ -346,3 +346,135 @@ def test_transient_remote_compile_retry():
 
     with pytest.raises(jax.errors.JaxRuntimeError, match="remote_compile"):
         _retry_transient(twice_flaky)
+
+
+class TestShardingSpecHelpers:
+    """Runtime oracle for the sharding helpers fflint's static
+    ``shard-consistency`` rule models symbolically — cache_pspec /
+    scale_pspec / prune_spec / pin_cache_layout on the mesh shapes the
+    analyzer reasons about (sp-only, ep-only, pp per-stage submeshes,
+    tuple-axis entries), so the static and dynamic oracles agree."""
+
+    def test_scale_pspec_is_cache_pspec_minus_head_dim(self):
+        from flexflow_tpu.serving.inference_manager import (cache_pspec,
+                                                            scale_pspec)
+
+        spec = cache_pspec(2, 2)
+        assert tuple(spec) == (None, "tp", "sp", None)
+        assert tuple(scale_pspec(spec)) == (None, "tp", "sp")
+        # degenerate layouts: an axis of extent 1 must NOT appear (the
+        # spec would otherwise demand an axis the mesh never carries)
+        assert tuple(cache_pspec(1, 2)) == (None, "tp", None, None)
+        assert tuple(cache_pspec(2, 1)) == (None, None, "sp", None)
+        assert tuple(scale_pspec(cache_pspec(2, 1))) == (None, None, "sp")
+
+    def test_prune_spec_sp_only_mesh(self):
+        from jax.sharding import PartitionSpec as P
+
+        from flexflow_tpu.serving.inference_manager import prune_spec
+
+        mesh = FFConfig(sequence_parallelism_degree=2).make_mesh()
+        assert tuple(mesh.shape) == ("sp",)
+        # the attention table's tp entries drop, sp survives
+        assert tuple(prune_spec(P("tp", None, "sp"), mesh)) == \
+            (None, None, "sp")
+
+    def test_prune_spec_ep_only_mesh(self):
+        from jax.sharding import PartitionSpec as P
+
+        from flexflow_tpu.serving.inference_manager import prune_spec
+
+        mesh = FFConfig(expert_parallelism_degree=2).make_mesh()
+        assert tuple(prune_spec(P("ep", "tp", None), mesh)) == \
+            ("ep", None, None)
+
+    def test_prune_spec_tuple_axis_entries(self):
+        from jax.sharding import PartitionSpec as P
+
+        from flexflow_tpu.serving.inference_manager import prune_spec
+
+        # both axes present: the tuple entry survives whole
+        mesh_dp_tp = FFConfig(data_parallelism_degree=2,
+                              tensor_parallelism_degree=2).make_mesh()
+        assert tuple(prune_spec(P(("dp", "tp"), None), mesh_dp_tp)) == \
+            (("dp", "tp"), None)
+        # partially present: only the carried axis remains (as a tuple)
+        mesh_tp = FFConfig(tensor_parallelism_degree=2).make_mesh()
+        assert tuple(prune_spec(P(("dp", "tp"), None), mesh_tp)) == \
+            (("tp",), None)
+        # wholly absent: the entry collapses to None, not an empty tuple
+        mesh_sp = FFConfig(sequence_parallelism_degree=2).make_mesh()
+        assert tuple(prune_spec(P(("dp", "tp"), "sp"), mesh_sp)) == \
+            (None, "sp")
+
+    def _caches(self, R=4, KV=2, S=64, D=8, quantized=True):
+        import jax.numpy as jnp
+
+        c = {"k": jnp.zeros((R, KV, S, D), jnp.float32),
+             "v": jnp.zeros((R, KV, S, D), jnp.float32)}
+        if quantized:
+            c["k_scale"] = jnp.zeros((R, KV, S), jnp.float32)
+            c["v_scale"] = jnp.zeros((R, KV, S), jnp.float32)
+        return c
+
+    def test_pin_cache_layout_rank_aware_tp_sp(self):
+        import jax
+
+        from flexflow_tpu.serving.inference_manager import (
+            cache_pspec, pin_cache_layout)
+
+        cfg = FFConfig(tensor_parallelism_degree=2,
+                       sequence_parallelism_degree=2)
+        mesh = cfg.make_mesh()
+        spec = cache_pspec(2, 2)
+        out = jax.jit(lambda c: pin_cache_layout(c, mesh, spec))(
+            self._caches())
+        # 4-D K/V leaves take the cache spec (KV over tp, S over sp) …
+        assert out["k"].addressable_shards[0].data.shape == (4, 1, 32, 8)
+        # … and the 3-D scale leaves its head_dim-less twin — the
+        # rank-dispatch the static rule checks spec-vs-array rank for
+        assert out["k_scale"].addressable_shards[0].data.shape == \
+            (4, 1, 32)
+
+    def test_pin_cache_layout_pp_stage_submeshes(self):
+        import jax
+
+        from flexflow_tpu.serving.inference_manager import (
+            cache_pspec, pin_cache_layout)
+        from flexflow_tpu.serving.pipeline_serving import \
+            build_stage_meshes
+
+        cfg = FFConfig(pipeline_parallelism_degree=2,
+                       tensor_parallelism_degree=2,
+                       sequence_parallelism_degree=2)
+        meshes = build_stage_meshes(cfg, pp=2, tp=2, sp=2)
+        assert len(meshes) == 2
+        devs = {d for m in meshes for d in m.devices.flat}
+        assert len(devs) == 8            # disjoint per-stage subsets
+        spec = cache_pspec(2, 2)
+        for mesh in meshes:
+            out = jax.jit(lambda c, m=mesh: pin_cache_layout(c, m,
+                                                             spec))(
+                self._caches())
+            assert out["v"].addressable_shards[0].data.shape == \
+                (4, 1, 32, 8)
+            assert out["v_scale"].addressable_shards[0].data.shape == \
+                (4, 1, 32)
+
+    def test_pin_cache_layout_sp_only_pruned_spec(self):
+        import jax
+
+        from flexflow_tpu.serving.inference_manager import (
+            cache_pspec, pin_cache_layout, prune_spec)
+
+        # an sp-only mesh with the full tp+sp spec pruned to it: the
+        # tp entry drops, so KV stays whole and only S shards — the
+        # runtime twin of the rule's mesh-membership check
+        mesh = FFConfig(sequence_parallelism_degree=2).make_mesh()
+        spec = prune_spec(cache_pspec(2, 2), mesh)
+        assert tuple(spec) == (None, None, "sp", None)
+        out = jax.jit(lambda c: pin_cache_layout(c, mesh, spec))(
+            self._caches())
+        assert out["k"].addressable_shards[0].data.shape == (4, 2, 32, 8)
+        assert out["k_scale"].addressable_shards[0].data.shape == \
+            (4, 2, 32)

@@ -34,8 +34,11 @@ from tools.fflint.rules.host_sync import HostSyncRule  # noqa: E402
 def main(argv):
     root = argv[1] if len(argv) > 1 else os.path.join(
         REPO, "flexflow_tpu", "serving")
+    # partial rule set over a subtree: stale-pragma judging needs
+    # whole-tree context and stays off (same policy as the CLI)
     findings = lint_paths([root], rules=[HostSyncRule()],
-                          ctx=LintContext(repo_root=REPO))
+                          ctx=LintContext(repo_root=REPO),
+                          judge_suppressions=False)
     for f in findings:
         print(f.render())
     if findings:

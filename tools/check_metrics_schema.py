@@ -38,9 +38,12 @@ def main(argv):
         os.path.join(REPO, "flexflow_tpu", "observability"),
         os.path.join(REPO, "flexflow_tpu", "serve"),
     ]
+    # partial rule set over subtrees: stale-pragma judging needs
+    # whole-tree context and stays off (same policy as the CLI)
     findings = lint_paths(roots,
                           rules=[MetricSchemaRule(), DirectHostSyncRule()],
-                          ctx=LintContext(repo_root=REPO))
+                          ctx=LintContext(repo_root=REPO),
+                          judge_suppressions=False)
     for f in findings:
         print(f.render())
     if findings:

@@ -1,35 +1,45 @@
-"""fflint — AST-based TPU-hazard static analysis for flexflow_tpu.
+"""fflint — whole-program AST-based TPU-hazard static analysis.
 
 A machine-checked invariant suite for the hazard classes that silently
-cost performance on a network-attached TPU: host round trips
-(``host-sync-dataflow``), recompilation (``retrace-hazard``), kernel
-fallbacks from bad tile shapes (``pallas-tiling``), telemetry schema
-drift (``metric-schema`` / ``direct-host-sync``) and use-after-donate
-(``donated-buffer-reuse``).
+cost performance (or multichip correctness) on a network-attached TPU:
+host round trips (``host-sync-dataflow``), recompilation
+(``retrace-hazard``), kernel fallbacks from bad tile shapes
+(``pallas-tiling``), telemetry schema drift (``metric-schema`` /
+``direct-host-sync``), use-after-donate (``donated-buffer-reuse``),
+sharding-plan drift (``shard-consistency``) and thread/signal lock
+misuse (``lock-discipline``).
+
+Two-pass: pass 1 parses every module ONCE and builds the project
+symbol graph (``tools/fflint/graph.py`` — imports, defs, constants),
+pass 2 runs the rules with the graph on ``LintContext.graph`` so they
+resolve cross-file aliases and fold constants interprocedurally.
 
 CLI::
 
     python -m tools.fflint [paths…] [--json] [--select rules]
         [--baseline tools/fflint_baseline.json] [--write-baseline]
-        [--changed-only] [--list-rules]
+        [--changed-only] [--list-rules] [--stats]
 
 Library::
 
     from tools.fflint import lint_paths, LintContext
     findings = lint_paths(["flexflow_tpu"], ctx=LintContext())
 
-See docs/STATIC_ANALYSIS.md for the rule catalog and the why behind
-each invariant.
+See docs/STATIC_ANALYSIS.md for the rule catalog, the symbol-graph
+architecture and the why behind each invariant.
 """
 
-from .core import (Finding, LintContext, Module, Rule, all_rules,
-                   apply_baseline, changed_files, default_repo_root,
-                   iter_py_files, lint_file, lint_paths, load_baseline,
+from .core import (Finding, LintContext, Module, Rule, RunStats,
+                   all_rules, apply_baseline, build_graph, changed_files,
+                   default_repo_root, iter_py_files, lint_file,
+                   lint_modules, lint_paths, load_baseline, load_modules,
                    write_baseline)
+from .graph import ProjectGraph
 
 __all__ = [
-    "Finding", "LintContext", "Module", "Rule", "all_rules",
-    "apply_baseline", "changed_files", "default_repo_root",
-    "iter_py_files", "lint_file", "lint_paths", "load_baseline",
+    "Finding", "LintContext", "Module", "ProjectGraph", "Rule",
+    "RunStats", "all_rules", "apply_baseline", "build_graph",
+    "changed_files", "default_repo_root", "iter_py_files", "lint_file",
+    "lint_modules", "lint_paths", "load_baseline", "load_modules",
     "write_baseline",
 ]

@@ -13,9 +13,9 @@ import json
 import os
 import sys
 
-from .core import (LintContext, all_rules, apply_baseline, changed_files,
-                   default_repo_root, lint_paths, load_baseline,
-                   write_baseline)
+from .core import (LintContext, RunStats, all_rules, apply_baseline,
+                   changed_files, default_repo_root, lint_paths,
+                   load_baseline, write_baseline)
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -41,6 +41,10 @@ def build_parser() -> argparse.ArgumentParser:
                         "unavailable)")
     p.add_argument("--list-rules", action="store_true",
                    help="print the rule catalog and exit")
+    p.add_argument("--stats", action="store_true",
+                   help="print parse/graph/per-rule timing to stderr "
+                        "(included in --json output) — the evidence "
+                        "when the tier-1 pre-gate budget blows")
     return p
 
 
@@ -79,7 +83,21 @@ def main(argv=None) -> int:
                   file=sys.stderr)
 
     ctx = LintContext(repo_root=repo_root)
-    findings = lint_paths(paths, rules=rules, ctx=ctx, only_files=only)
+    stats = RunStats() if args.stats else None
+    # stale-pragma judging needs WHOLE-tree context: a cross-file
+    # pragma's use may come from a caller outside any subtree/file/
+    # changed-set run, so only the canonical full invocation (the bare
+    # default or the tier-1 gate's explicit default roots) judges; a
+    # --select run is off too — a partial catalog shouldn't prune the
+    # audit trail
+    default_roots = {os.path.abspath(os.path.join(repo_root, d))
+                     for d in ("flexflow_tpu", "tools")}
+    whole_tree = {os.path.abspath(p) for p in paths} == default_roots
+    judge = None if (whole_tree and not args.select) else False
+    findings = lint_paths(paths, rules=rules, ctx=ctx, only_files=only,
+                          stats=stats, judge_suppressions=judge)
+    if stats is not None:
+        print(stats.render(), file=sys.stderr)
 
     if args.write_baseline:
         if not args.baseline:
@@ -103,10 +121,13 @@ def main(argv=None) -> int:
     new, old = apply_baseline(findings, baseline)
 
     if args.json:
-        print(json.dumps({
+        payload = {
             "findings": [f.as_dict() for f in new],
             "baselined": len(old),
-        }, indent=2))
+        }
+        if stats is not None:
+            payload["stats"] = stats.as_dict()
+        print(json.dumps(payload, indent=2))
     else:
         for f in new:
             print(f.render())

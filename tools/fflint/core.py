@@ -41,12 +41,18 @@ import io
 import json
 import os
 import subprocess
+import time
 import tokenize
-from dataclasses import dataclass
-from typing import Dict, Iterable, Iterator, List, Optional, Sequence, Set
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, Iterator, List, Optional, Sequence, Set, Tuple
 
 SEVERITY_ERROR = "error"
 SEVERITY_WARN = "warn"
+
+#: framework-emitted pseudo-rule: a `# fflint: disable=<rule>` pragma
+#: that suppressed nothing this run (stale annotations rot the audit
+#: trail).  Reported after all real rules ran, so it sees the truth.
+UNUSED_SUPPRESSION = "unused-suppression"
 
 #: sentinel for "every rule suppressed on this line"
 ALL_RULES = "*"
@@ -115,6 +121,10 @@ class LintContext:
                  schema: Optional[dict] = None,
                  events: Optional[dict] = None):
         self.repo_root = repo_root or default_repo_root()
+        #: pass-1 product (graph.ProjectGraph) — set by the runner
+        #: before any rule runs; rules treat a None graph as "resolve
+        #: nothing" (single-file embedding, very old callers)
+        self.graph = None
         self._schema = schema
         self._events = events
         # injected overrides suppress the file load for BOTH tables (a
@@ -173,7 +183,11 @@ class Module:
         self.text = text
         self.lines = text.splitlines()
         self.tree = ast.parse(text)          # SyntaxError -> caller
+        #: target line -> {rule_id (or ALL_RULES): pragma line}
         self.suppressions = _parse_suppressions(text)
+        #: (target line, rule_id) pairs that actually suppressed a
+        #: finding this run — the unused-suppression check's evidence
+        self.used_suppressions: Set[Tuple[int, str]] = set()
 
     def snippet(self, line: int) -> str:
         if 1 <= line <= len(self.lines):
@@ -186,17 +200,27 @@ class Module:
 
     def suppressed(self, rule_id: str, line: int) -> bool:
         rules = self.suppressions.get(line)
-        return bool(rules) and (ALL_RULES in rules or rule_id in rules)
+        if not rules:
+            return False
+        if rule_id in rules:
+            self.used_suppressions.add((line, rule_id))
+            return True
+        if ALL_RULES in rules:
+            self.used_suppressions.add((line, ALL_RULES))
+            return True
+        return False
 
 
-def _parse_suppressions(text: str) -> Dict[int, Set[str]]:
+def _parse_suppressions(text: str) -> Dict[int, Dict[str, int]]:
     """``# fflint: disable=a,b`` comments, via tokenize so string
     literals containing the pragma are ignored.  Bare
     ``# fflint: disable`` suppresses every rule.  A trailing pragma
     applies to its own line; a STANDALONE pragma comment line applies
     to the next code line (blank and comment-only lines in between are
-    skipped), so multi-line reasons read naturally above the site."""
-    out: Dict[int, Set[str]] = {}
+    skipped), so multi-line reasons read naturally above the site.
+    Each entry remembers the PRAGMA's own line so the
+    unused-suppression check can anchor its finding at the comment."""
+    out: Dict[int, Dict[str, int]] = {}
     lines = text.splitlines()
 
     def _next_code_line(after: int) -> int:
@@ -239,11 +263,14 @@ def _parse_suppressions(text: str) -> Dict[int, Set[str]]:
                 # suppresses every rule on the line — a malformed rule
                 # list must NEVER silently widen to this
                 rules = {ALL_RULES}
-            line = tok.start[0]
+            pragma_line = tok.start[0]
+            line = pragma_line
             standalone = not lines[line - 1][:tok.start[1]].strip()
             if standalone:
                 line = _next_code_line(line)
-            out.setdefault(line, set()).update(rules)
+            entry = out.setdefault(line, {})
+            for r in rules:
+                entry.setdefault(r, pragma_line)
     except tokenize.TokenError:
         pass
     return out
@@ -276,33 +303,56 @@ def all_rules() -> List[Rule]:
     return [cls() for cls in rules]
 
 
-def lint_file(path: str, rules: Sequence[Rule], ctx: LintContext,
-              rel: Optional[str] = None) -> List[Finding]:
-    try:
-        module = Module(path, rel=rel)
-    except (SyntaxError, UnicodeDecodeError) as e:
-        line = getattr(e, "lineno", 1) or 1
-        return [Finding(rule="parse-error", severity=SEVERITY_ERROR,
-                        path=rel or path, line=line, col=0,
-                        message=f"file does not parse: {e.msg if hasattr(e, 'msg') else e}",
-                        snippet="")]
-    findings: List[Finding] = []
-    for rule in rules:
-        for f in rule.check(module, ctx):
-            if not module.suppressed(f.rule, f.line):
-                findings.append(f)
-    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
-    return findings
+@dataclass
+class RunStats:
+    """``--stats`` accounting: where a run's wall clock went.  The
+    tier-1 pre-gate budget is ~3 s for the whole repo; this is the
+    evidence when a new rule blows it."""
+
+    files: int = 0
+    parse_s: float = 0.0
+    graph_s: float = 0.0
+    rules_s: Dict[str, float] = field(default_factory=dict)
+    total_s: float = 0.0
+
+    def as_dict(self) -> dict:
+        return {"files": self.files,
+                "parse_s": round(self.parse_s, 4),
+                "graph_s": round(self.graph_s, 4),
+                "rules_s": {k: round(v, 4)
+                            for k, v in sorted(self.rules_s.items())},
+                "total_s": round(self.total_s, 4)}
+
+    def render(self) -> str:
+        lines = [f"fflint --stats: {self.files} file(s), "
+                 f"parse {self.parse_s:.3f}s, graph {self.graph_s:.3f}s, "
+                 f"total {self.total_s:.3f}s"]
+        for rid, s in sorted(self.rules_s.items(),
+                             key=lambda kv: -kv[1]):
+            lines.append(f"  {rid:<24s} {s:.3f}s")
+        return "\n".join(lines)
 
 
-def lint_paths(paths: Sequence[str], rules: Optional[Sequence[Rule]] = None,
-               ctx: Optional[LintContext] = None,
-               only_files: Optional[Set[str]] = None) -> List[Finding]:
-    """Lint every .py under ``paths``.  ``only_files``: absolute-path
-    allowlist (the ``--changed-only`` filter)."""
-    rules = list(rules) if rules is not None else all_rules()
-    ctx = ctx or LintContext()
-    findings: List[Finding] = []
+def _parse_error_finding(path: str, e) -> Finding:
+    line = getattr(e, "lineno", 1) or 1
+    return Finding(rule="parse-error", severity=SEVERITY_ERROR,
+                   path=path, line=line, col=0,
+                   message=("file does not parse: "
+                            f"{e.msg if hasattr(e, 'msg') else e}"),
+                   snippet="")
+
+
+def load_modules(paths: Sequence[str], ctx: LintContext,
+                 only_files: Optional[Set[str]] = None,
+                 stats: Optional[RunStats] = None
+                 ) -> Tuple[List[Module], List[Finding]]:
+    """PASS 1a: parse every .py under ``paths`` exactly once.  Returns
+    the Module list (shared by the graph build and every rule) plus
+    parse-error findings.  ``only_files``: absolute-path allowlist (the
+    ``--changed-only`` filter)."""
+    t0 = time.perf_counter()
+    modules: List[Module] = []
+    errors: List[Finding] = []
     for path in iter_py_files(paths):
         if (only_files is not None
                 and os.path.abspath(path) not in only_files):
@@ -314,7 +364,159 @@ def lint_paths(paths: Sequence[str], rules: Optional[Sequence[Rule]] = None,
         rel = os.path.relpath(os.path.abspath(path), ctx.repo_root)
         if rel.startswith(".."):
             rel = path
-        findings.extend(lint_file(path, rules, ctx, rel=rel))
+        try:
+            modules.append(Module(path, rel=rel))
+        except (SyntaxError, UnicodeDecodeError) as e:
+            errors.append(_parse_error_finding(rel, e))
+    if stats is not None:
+        stats.files = len(modules)
+        stats.parse_s += time.perf_counter() - t0
+    return modules, errors
+
+
+def build_graph(modules: Sequence[Module],
+                stats: Optional[RunStats] = None):
+    """PASS 1b: the project symbol graph over the parsed modules."""
+    from .graph import ProjectGraph
+
+    t0 = time.perf_counter()
+    graph = ProjectGraph({m.rel: m for m in modules})
+    if stats is not None:
+        stats.graph_s += time.perf_counter() - t0
+    return graph
+
+
+def _unused_suppression_findings(module: Module,
+                                 ran_ids: Set[str],
+                                 full_run: bool) -> List[Finding]:
+    """Pragma entries that suppressed nothing.  Only rules that
+    actually RAN can be judged stale; with the full catalog running, a
+    rule id no rule owns is reported too (a typo suppresses nothing
+    forever).  ``ALL_RULES`` pragmas and ``unused-suppression`` itself
+    are exempt (the latter would be self-referential)."""
+    out: List[Finding] = []
+    for target_line, entry in module.suppressions.items():
+        for rule_id, pragma_line in entry.items():
+            if rule_id in (ALL_RULES, UNUSED_SUPPRESSION):
+                continue
+            if (target_line, rule_id) in module.used_suppressions:
+                continue
+            if rule_id in ran_ids:
+                msg = (f"'# fflint: disable={rule_id}' suppresses "
+                       f"nothing — the hazard it annotated is gone; "
+                       f"remove the stale pragma")
+            elif full_run:
+                msg = (f"'# fflint: disable={rule_id}' names no known "
+                       f"rule — it can never suppress anything "
+                       f"(typo?)")
+            else:
+                continue             # partial run: can't judge
+            out.append(Finding(
+                rule=UNUSED_SUPPRESSION, severity=SEVERITY_WARN,
+                path=module.rel, line=pragma_line, col=0,
+                message=msg, snippet=module.snippet(pragma_line)))
+    return out
+
+
+def lint_modules(modules: Sequence[Module], rules: Sequence[Rule],
+                 ctx: LintContext,
+                 stats: Optional[RunStats] = None,
+                 judge_suppressions: bool = True) -> List[Finding]:
+    """PASS 2: run every rule over every (already-parsed) module with
+    the shared symbol graph on ``ctx.graph``, then the framework's
+    unused-suppression sweep per module.
+
+    ``judge_suppressions=False`` disables the sweep entirely: a run
+    without whole-tree context (single files, ``--changed-only``)
+    cannot tell a stale pragma from one whose finding needs cross-file
+    resolution the partial graph lacks — judging there would tell the
+    user to delete a load-bearing annotation."""
+    if ctx.graph is None:
+        ctx.graph = build_graph(modules, stats=stats)
+    from .rules import ALL_RULES as _catalog
+
+    ran_ids = {r.id for r in rules}
+    full_run = ran_ids >= {cls.id for cls in _catalog}
+    findings: List[Finding] = []
+    for module in modules:
+        for rule in rules:
+            t0 = time.perf_counter()
+            for f in rule.check(module, ctx):
+                if not module.suppressed(f.rule, f.line):
+                    findings.append(f)
+            if stats is not None:
+                stats.rules_s[rule.id] = (
+                    stats.rules_s.get(rule.id, 0.0)
+                    + time.perf_counter() - t0)
+    if judge_suppressions:
+        # a SECOND pass, strictly after every module's rules ran: a
+        # callee-side pragma is marked used by a LATER caller module's
+        # cross-file summary, so judging inside the rule loop would
+        # make staleness depend on file sort order
+        for module in modules:
+            for f in _unused_suppression_findings(module, ran_ids,
+                                                  full_run):
+                if not module.suppressed(f.rule, f.line):
+                    findings.append(f)
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return findings
+
+
+def lint_file(path: str, rules: Sequence[Rule], ctx: LintContext,
+              rel: Optional[str] = None,
+              judge_suppressions: bool = False) -> List[Finding]:
+    """Single-file embedding (tests, editors): parses one module and
+    lints it with a one-module graph — cross-file resolution needs
+    :func:`lint_paths` over the whole tree.  A whole-program graph a
+    caller already installed on ``ctx`` is restored afterwards, never
+    silently replaced.  Stale-pragma judging is OFF by default (same
+    partial-context policy as everywhere else — a one-file graph can't
+    tell a stale pragma from a cross-file-load-bearing one); pass
+    ``judge_suppressions=True`` only for self-contained fixtures."""
+    try:
+        module = Module(path, rel=rel)
+    except (SyntaxError, UnicodeDecodeError) as e:
+        return [_parse_error_finding(rel or path, e)]
+    prev_graph = ctx.graph
+    ctx.graph = build_graph([module])
+    try:
+        return lint_modules([module], rules, ctx,
+                            judge_suppressions=judge_suppressions)
+    finally:
+        ctx.graph = prev_graph
+
+
+def lint_paths(paths: Sequence[str], rules: Optional[Sequence[Rule]] = None,
+               ctx: Optional[LintContext] = None,
+               only_files: Optional[Set[str]] = None,
+               stats: Optional[RunStats] = None,
+               judge_suppressions: Optional[bool] = None) -> List[Finding]:
+    """Two-pass whole-program lint of every .py under ``paths``: parse
+    once + build the symbol graph (pass 1), then run the rules with the
+    graph available (pass 2).
+
+    ``judge_suppressions=None`` (default) auto-decides: judge stale
+    pragmas only when no file filter narrows the tree AND every path
+    is a directory — a single-file run lacks the cross-file context
+    some findings need, so a load-bearing pragma would read as stale
+    (see lint_modules).  Callers linting a deliberate SUBTREE of a
+    larger project (the legacy shims) should pass False explicitly:
+    the auto rule cannot know the tree extends beyond the given
+    directories."""
+    t0 = time.perf_counter()
+    rules = list(rules) if rules is not None else all_rules()
+    ctx = ctx or LintContext()
+    modules, errors = load_modules(paths, ctx, only_files=only_files,
+                                   stats=stats)
+    ctx.graph = build_graph(modules, stats=stats)
+    if judge_suppressions is None:
+        judge_suppressions = (only_files is None
+                              and all(os.path.isdir(p) for p in paths))
+    findings = errors + lint_modules(modules, rules, ctx, stats=stats,
+                                     judge_suppressions=judge_suppressions)
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    if stats is not None:
+        stats.total_s += time.perf_counter() - t0
     return findings
 
 

@@ -1,0 +1,247 @@
+"""Project symbol graph: the whole-program half of fflint.
+
+Pass 1 of the two-pass analyzer.  Every module under the linted roots
+is parsed ONCE (the :class:`~tools.fflint.core.Module` objects are
+shared by every rule) and indexed into a :class:`ProjectGraph`:
+
+- **imports** — per module, local alias -> absolute dotted target,
+  with relative imports (``from ..config import AXIS_MODEL``) resolved
+  against the module's package path.  Function-local imports (the
+  tree's lazy-import idiom) are indexed with module-wide visibility —
+  an over-approximation that is exactly right for linting.
+- **function defs** — top-level functions and ``Class.method``
+  qualnames, resolvable across files through the import table (a
+  dotted name resolves either as ``alias.func`` via imports or as a
+  literal ``Class.method`` qualname in the target module).
+- **constant bindings** — module-level literal str/int/None
+  assignments (``AXIS_MODEL = "tp"``), so rules can fold a name that
+  was imported from two modules away.
+
+Rules receive the graph through ``LintContext.graph`` and use it to
+resolve cross-file aliases and propagate constants interprocedurally:
+the shard-consistency rule symbolically evaluates
+``scale_pspec(cache_pspec(sp, tp))`` across ``serving/`` modules, and
+the host-sync rule summarizes one level of intra-package helpers.
+(The lock rule's signal-handler walk is deliberately module-local —
+see its docstring.)
+
+Resolution is deliberately bounded (depth-limited, first match, no
+star imports, no dynamic dispatch): when the graph cannot resolve a
+name it returns None and the asking rule stays silent — the
+false-positive-shy contract every fflint rule follows.
+
+Pure stdlib (ast/os only): the graph must never pull jax/numpy into
+the lint (tests/test_fflint.py::test_fflint_imports_no_jax).
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass
+from typing import Dict, Optional, Tuple
+
+#: resolution depth bound: an alias chain longer than this (A imports
+#: from B imports from C imports from D) stays unresolved
+_MAX_DEPTH = 3
+
+
+def modname_of(rel: str) -> str:
+    """Dotted module name of a repo-relative path:
+    ``flexflow_tpu/serving/inference_manager.py`` ->
+    ``flexflow_tpu.serving.inference_manager``; ``pkg/__init__.py`` ->
+    ``pkg``."""
+    name = rel[:-3] if rel.endswith(".py") else rel
+    name = name.replace("\\", "/").strip("/").replace("/", ".")
+    if name.endswith(".__init__"):
+        name = name[: -len(".__init__")]
+    return name
+
+
+@dataclass
+class FunctionInfo:
+    """One function def resolved through the graph."""
+
+    modname: str
+    qualname: str                     # "func" or "Class.method"
+    node: ast.AST                     # FunctionDef | AsyncFunctionDef
+    minfo: "ModuleInfo"               # defining module
+
+    def params(self):
+        a = self.node.args
+        return ([p.arg for p in getattr(a, "posonlyargs", [])]
+                + [p.arg for p in a.args])
+
+
+class ModuleInfo:
+    """Per-module symbol tables (built from an already-parsed Module)."""
+
+    def __init__(self, rel: str, module):
+        self.rel = rel
+        self.module = module          # core.Module (shared AST)
+        self.modname = modname_of(rel)
+        self.is_package = rel.replace("\\", "/").endswith("__init__.py")
+        self.imports: Dict[str, str] = {}
+        self.functions: Dict[str, ast.AST] = {}
+        self.constants: Dict[str, object] = {}
+        self._collect()
+
+    # ------------------------------------------------------------ indexing
+    def _package_parts(self):
+        parts = self.modname.split(".") if self.modname else []
+        return parts if self.is_package else parts[:-1]
+
+    def _collect(self) -> None:
+        tree = self.module.tree
+        # imports at ANY depth: the tree lazy-imports inside functions
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    if a.asname:
+                        self.imports[a.asname] = a.name
+                    else:
+                        # `import x.y` binds the top name x -> x
+                        top = a.name.split(".")[0]
+                        self.imports.setdefault(top, top)
+            elif isinstance(node, ast.ImportFrom):
+                base = node.module or ""
+                if node.level:
+                    pkg = self._package_parts()
+                    pkg = pkg[: len(pkg) - (node.level - 1)] \
+                        if node.level > 1 else pkg
+                    base = ".".join(pkg + ([node.module]
+                                           if node.module else []))
+                for a in node.names:
+                    if a.name == "*":
+                        continue      # star imports stay unresolved
+                    alias = a.asname or a.name
+                    self.imports[alias] = (f"{base}.{a.name}"
+                                           if base else a.name)
+        # top-level defs / classes / literal constants only (nested
+        # defs are resolved positionally by the rules that need them)
+        for st in tree.body:
+            if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.functions[st.name] = st
+            elif isinstance(st, ast.ClassDef):
+                for sub in st.body:
+                    if isinstance(sub, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef)):
+                        self.functions[f"{st.name}.{sub.name}"] = sub
+            elif isinstance(st, ast.Assign) and len(st.targets) == 1 \
+                    and isinstance(st.targets[0], ast.Name):
+                v = st.value
+                if isinstance(v, ast.Constant) and isinstance(
+                        v.value, (str, int, type(None))):
+                    self.constants[st.targets[0].id] = v.value
+
+
+class ProjectGraph:
+    """The pass-1 product: every linted module's symbol tables plus
+    cross-module name resolution.  ``cache`` is scratch space rules use
+    to memoize per-run derived state (function summaries etc.) so the
+    graph is computed once and shared."""
+
+    def __init__(self, modules: Dict[str, object]):
+        # rel -> ModuleInfo; modules maps rel -> core.Module
+        self.infos: Dict[str, ModuleInfo] = {
+            rel: ModuleInfo(rel, m) for rel, m in modules.items()}
+        self.by_modname: Dict[str, ModuleInfo] = {}
+        for mi in self.infos.values():
+            self.by_modname.setdefault(mi.modname, mi)
+        self.cache: Dict[object, object] = {}
+        self._axis_vocab: Optional[frozenset] = None
+        self._axis_vocab_done = False
+
+    # ---------------------------------------------------------- accessors
+    def info(self, module) -> Optional[ModuleInfo]:
+        """ModuleInfo for a core.Module (by its rel path)."""
+        rel = getattr(module, "rel", module)
+        return self.infos.get(rel)
+
+    # ---------------------------------------------------------- resolution
+    def _lookup(self, mi: ModuleInfo, name: str, kind: str,
+                depth: int):
+        """Resolve ``name`` (no dots) in ``mi`` to a ('function', info)
+        or ('constant', value) hit, following import aliases up to the
+        depth bound."""
+        if depth > _MAX_DEPTH:
+            return None
+        if kind in ("any", "function") and name in mi.functions:
+            return ("function", FunctionInfo(mi.modname, name,
+                                             mi.functions[name], mi))
+        if kind in ("any", "constant") and name in mi.constants:
+            return ("constant", mi.constants[name])
+        target = mi.imports.get(name)
+        if target is None:
+            return None
+        # `from pkg.mod import sym as name` -> target "pkg.mod.sym";
+        # `import pkg.mod as name` -> target "pkg.mod" (a module ref)
+        if target in self.by_modname:
+            return ("module", self.by_modname[target])
+        if "." in target:
+            mod, _, attr = target.rpartition(".")
+            tmi = self.by_modname.get(mod)
+            if tmi is not None:
+                return self._lookup(tmi, attr, kind, depth + 1)
+        return None
+
+    def _qualname_hit(self, mi: ModuleInfo, dotted: str, kind: str):
+        """Direct ``Class.method`` qualname hit in one module."""
+        if kind in ("any", "function") and "." in dotted \
+                and dotted in mi.functions:
+            return ("function", FunctionInfo(mi.modname, dotted,
+                                             mi.functions[dotted], mi))
+        return None
+
+    def _resolve(self, module, dotted: str, kind: str):
+        mi = self.info(module) if not isinstance(module, ModuleInfo) \
+            else module
+        if mi is None or not dotted:
+            return None
+        hit = self._qualname_hit(mi, dotted, kind)
+        if hit is not None:
+            return hit
+        parts = dotted.split(".")
+        hit = self._lookup(mi, parts[0], "any" if len(parts) > 1
+                           else kind, 0)
+        for i, attr in enumerate(parts[1:], 1):
+            if hit is None or hit[0] != "module":
+                return None
+            # ``alias.Class.method``: the remainder may be a qualname
+            # in the resolved module
+            qhit = self._qualname_hit(hit[1], ".".join(parts[i:]), kind)
+            if qhit is not None:
+                return qhit
+            hit = self._lookup(hit[1], attr, "any", 0)
+        if hit is not None and kind != "any" and hit[0] != kind:
+            return None
+        return hit
+
+    def resolve_function(self, module, dotted: str
+                         ) -> Optional[FunctionInfo]:
+        """``cache_pspec`` / ``im_mod.cache_pspec`` -> the defining
+        FunctionInfo, across files; None when unresolvable."""
+        hit = self._resolve(module, dotted, "function")
+        return hit[1] if hit else None
+
+    def resolve_constant(self, module, dotted: str
+                         ) -> Optional[Tuple[object]]:
+        """Literal module-level constant behind a (possibly imported)
+        name.  Returns a 1-tuple ``(value,)`` so a stored None is
+        distinguishable from "not found"."""
+        hit = self._resolve(module, dotted, "constant")
+        return (hit[1],) if hit else None
+
+    # --------------------------------------------------------- vocabulary
+    def axis_vocabulary(self) -> Optional[frozenset]:
+        """Every mesh axis name the project declares: the string values
+        of module-level ``AXIS_*`` constants (config.py's
+        dp/tp/pp/sp/ep).  None when the linted tree declares none
+        (fixture trees, tools-only runs) — axis-name validation then
+        stays off rather than guessing."""
+        if not self._axis_vocab_done:
+            self._axis_vocab_done = True
+            vocab = {v for mi in self.infos.values()
+                     for k, v in mi.constants.items()
+                     if k.startswith("AXIS_") and isinstance(v, str)}
+            self._axis_vocab = frozenset(vocab) if vocab else None
+        return self._axis_vocab

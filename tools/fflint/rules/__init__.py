@@ -9,9 +9,11 @@ invariant (and the why) in docs/STATIC_ANALYSIS.md.
 from .direct_host_sync import DirectHostSyncRule
 from .donation import DonationRule
 from .host_sync import HostSyncRule
+from .lock_discipline import LockDisciplineRule
 from .metric_schema import MetricSchemaRule
 from .pallas_tiling import PallasTilingRule
 from .retrace import RetraceRule
+from .shard_consistency import ShardConsistencyRule
 
 ALL_RULES = [
     HostSyncRule,
@@ -20,4 +22,6 @@ ALL_RULES = [
     MetricSchemaRule,
     DirectHostSyncRule,
     DonationRule,
+    ShardConsistencyRule,
+    LockDisciplineRule,
 ]

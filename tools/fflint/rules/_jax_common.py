@@ -219,6 +219,100 @@ def iter_scopes(tree: ast.AST):
             yield node
 
 
+#: Mosaic tiling invariants shared by the pallas-tiling and
+#: shard-consistency rules — ONE table so the PR-2 32-aligned-int8 /
+#: 16-aligned-bf16 invariant cannot drift between the kernel-shape
+#: check and the per-shard-extent check (and the int4 row lands in
+#: both at once when sub-byte tiling arrives)
+LANE = 128
+SUBLANE = {
+    "float32": 8, "f32": 8, "int32": 8, "uint32": 8,
+    "bfloat16": 16, "bf16": 16, "float16": 16, "f16": 16,
+    "int8": 32, "uint8": 32,
+    "float8_e4m3fn": 32, "float8_e5m2": 32, "fp8": 32,
+}
+
+
+class ConstEnv:
+    """Literal-int constant folding over one scope, document order.
+    Shared by pallas-tiling (block/grid shapes) and shard-consistency
+    (array dims) so both rules fold ``W = 32``-style constants the
+    same way."""
+
+    def __init__(self):
+        self.env: Dict[str, int] = {}
+
+    def fold(self, node: ast.AST) -> Optional[int]:
+        if isinstance(node, ast.Constant) and isinstance(node.value, int) \
+                and not isinstance(node.value, bool):
+            return node.value
+        if isinstance(node, ast.Name):
+            return self.env.get(node.id)
+        if isinstance(node, ast.UnaryOp) and isinstance(node.op, ast.USub):
+            v = self.fold(node.operand)
+            return -v if v is not None else None
+        if isinstance(node, ast.BinOp):
+            lhs, rhs = self.fold(node.left), self.fold(node.right)
+            if lhs is None or rhs is None:
+                return None
+            try:
+                if isinstance(node.op, ast.Add):
+                    return lhs + rhs
+                if isinstance(node.op, ast.Sub):
+                    return lhs - rhs
+                if isinstance(node.op, ast.Mult):
+                    return lhs * rhs
+                if isinstance(node.op, ast.FloorDiv):
+                    return lhs // rhs
+                if isinstance(node.op, ast.Mod):
+                    return lhs % rhs
+                if isinstance(node.op, ast.Pow):
+                    return lhs ** rhs
+            except (ZeroDivisionError, OverflowError):
+                return None
+        return None
+
+    def fold_shape(self, node: ast.AST) -> Optional[Tuple[int, ...]]:
+        if not isinstance(node, (ast.Tuple, ast.List)):
+            return None
+        dims = [self.fold(e) for e in node.elts]
+        if any(d is None for d in dims):
+            return None
+        return tuple(dims)  # type: ignore[arg-type]
+
+    def bind(self, stmt: ast.stmt) -> None:
+        if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 \
+                and isinstance(stmt.targets[0], ast.Name):
+            v = self.fold(stmt.value)
+            name = stmt.targets[0].id
+            if v is not None:
+                self.env[name] = v
+            else:
+                self.env.pop(name, None)   # unfoldable rebind: unknown
+        else:
+            # any other (re)binding of a known name invalidates it
+            for sub in ast.walk(stmt):
+                if isinstance(sub, ast.Name) and isinstance(
+                        sub.ctx, (ast.Store, ast.Del)):
+                    self.env.pop(sub.id, None)
+
+
+def dtype_leaf(node: Optional[ast.AST]) -> Optional[str]:
+    """The dtype name of a literal dtype expression — ``jnp.int8`` /
+    ``"bfloat16"`` / ``np.float32`` — when it names a SUBLANE-table
+    dtype; None otherwise (runtime dtypes are never guessed)."""
+    if node is None:
+        return None
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    dn = dotted_name(node)
+    if dn:
+        leaf = dn.split(".")[-1]
+        if leaf in SUBLANE:
+            return leaf
+    return None
+
+
 #: host-materialization surface shared by the host-sync and retrace
 #: rules — ONE list so a newly-recognized materializer (``__array__``,
 #: ``np.copyto`` …) cannot be added to one rule and silently missed by

@@ -31,12 +31,26 @@ closures are separate scopes.  ``jnp.asarray`` never syncs and is never
 flagged.  A knowingly-unsynced fetch is annotated
 ``# fflint: disable=host-sync-dataflow  <why>`` (the legacy
 ``# no-sync: <why>`` pragma is still honored).
+
+**Interprocedural (one level, via the symbol graph)**: a call that
+resolves to a function in the linted tree — same module or across
+files through import aliases — is SUMMARIZED: which parameters it
+materializes, whether it ticks ``note_host_sync()``, and whether its
+return value is a host value (every return is materializer-rooted).
+At the call site, passing a tainted value into a parameter the callee
+materializes without syncing is the same under-counted round trip as
+materializing it inline — flagged at the call.  A callee whose
+returns are all host values UNtaints the binding (``toks =
+fetch_and_count(outs)`` — downstream ``int(toks[0])`` bookkeeping
+stays quiet).  One level only: summaries do not chase the callee's own
+callees; unresolvable calls behave exactly as before.
 """
 
 from __future__ import annotations
 
 import ast
-from typing import Iterable, List, Set, Tuple
+from dataclasses import dataclass
+from typing import Iterable, List, Optional, Set, Tuple
 
 from ..core import Finding, LintContext, Module, Rule
 from ._jax_common import (assigned_names, child_blocks, header_exprs,
@@ -98,6 +112,88 @@ def _contains_sync(stmt: ast.stmt) -> bool:
     return False
 
 
+@dataclass
+class _CalleeSummary:
+    """One level of cross-call dataflow: what a resolvable callee does
+    with its parameters (memoized on the run's graph cache)."""
+
+    params: Tuple[str, ...]       # positional parameter names
+    materializes: Set[int]        # positional param indices it fetches
+    syncs: bool                   # body ticks note_host_sync()
+    returns_host: bool            # every return is materializer-rooted
+
+
+def _summarize_callee(fn_info, graph) -> _CalleeSummary:
+    key = ("host-sync-summary", fn_info.modname, fn_info.qualname)
+    cached = graph.cache.get(key)
+    if cached is not None:
+        return cached
+    node = fn_info.node
+    params = fn_info.params()
+    syncs = any(isinstance(n, ast.Call)
+                and isinstance(n.func, ast.Attribute)
+                and n.func.attr == "note_host_sync"
+                for n in ast.walk(node))
+    assigns = [n for n in ast.walk(node) if isinstance(n, ast.Assign)]
+
+    def reads_any(expr: ast.AST, names: Set[str]) -> bool:
+        # STRICTLY name-based: unlike _contains_taint this must NOT
+        # treat the callee's own dispatch calls as tainting — a helper
+        # with an internal (separately-governed) fetch would otherwise
+        # mark every parameter materialized
+        return any(isinstance(sub, ast.Name)
+                   and isinstance(sub.ctx, ast.Load)
+                   and sub.id in names
+                   for sub in ast.walk(expr))
+
+    materializes: Set[int] = set()
+    for i, p in enumerate(params):
+        # per-param alias closure (order-insensitive fixpoint — fine
+        # for a summary: an alias bound anywhere in the body counts)
+        aliases = {p}
+        changed = True
+        while changed:
+            changed = False
+            for a in assigns:
+                if _is_materializer_root(a.value):
+                    continue          # host value: breaks the chain
+                if not reads_any(a.value, aliases):
+                    continue
+                for t in a.targets:
+                    for nm in assigned_names(ast.Assign(targets=[t],
+                                                        value=a.value)):
+                        if nm not in aliases:
+                            aliases.add(nm)
+                            changed = True
+        cal_mod = fn_info.minfo.module
+        for n in ast.walk(node):
+            if isinstance(n, ast.Call):
+                tgt = materializer_target(n)
+                if tgt is None or not reads_any(tgt, aliases):
+                    continue
+                # an inline annotation at the CALLEE's fetch covers the
+                # cross-call finding too — the annotate-the-site/
+                # empty-baseline workflow must not force every call
+                # site to re-annotate (suppressed() also records the
+                # pragma as used, keeping it off the stale list)
+                if cal_mod.suppressed("host-sync-dataflow", n.lineno) \
+                        or cal_mod.line_has(n.lineno, LEGACY_PRAGMA):
+                    continue
+                materializes.add(i)
+                break
+    rets = [n for n in ast.walk(node)
+            if isinstance(n, ast.Return) and n.value is not None]
+    returns_host = bool(rets) and all(
+        _is_materializer_root(r.value)
+        or (isinstance(r.value, (ast.Tuple, ast.List)) and r.value.elts
+            and all(_is_materializer_root(e) for e in r.value.elts))
+        for r in rets)
+    out = _CalleeSummary(tuple(params), materializes, syncs,
+                         returns_host)
+    graph.cache[key] = out
+    return out
+
+
 class HostSyncRule(Rule):
     id = "host-sync-dataflow"
     short = ("materialization of a device-dispatch result without a "
@@ -105,11 +201,38 @@ class HostSyncRule(Rule):
 
     def check(self, module: Module,
               ctx: LintContext) -> Iterable[Finding]:
+        self._graph = getattr(ctx, "graph", None)
+        self._minfo = (self._graph.info(module)
+                       if self._graph is not None else None)
         findings: List[Finding] = []
         for scope in iter_scopes(module.tree):
             tainted: Set[str] = set()
             self._walk_block(scope.body, tainted, module, findings)
         return findings
+
+    def _callee_summary(self, call: ast.Call
+                        ) -> Optional[_CalleeSummary]:
+        """Summary of a call that resolves through the symbol graph to
+        a function in the linted tree; None otherwise.  Receiver-method
+        calls (``im.inference``) never resolve — the receiver is not an
+        import alias — so dispatches keep their special handling."""
+        if self._graph is None or self._minfo is None:
+            return None
+        from ._jax_common import dotted_name
+
+        dn = dotted_name(call.func)
+        if not dn:
+            return None
+        # memoize per (module, name) — including misses, which dominate
+        # (most calls are methods on objects, unresolvable by design)
+        key = ("host-sync-resolve", self._minfo.modname, dn)
+        cached = self._graph.cache.get(key, Ellipsis)
+        if cached is not Ellipsis:
+            return cached
+        fn = self._graph.resolve_function(self._minfo, dn)
+        out = None if fn is None else _summarize_callee(fn, self._graph)
+        self._graph.cache[key] = out
+        return out
 
     # ------------------------------------------------------------ walker
     def _walk_block(self, stmts: List[ast.stmt], tainted: Set[str],
@@ -169,7 +292,44 @@ class HostSyncRule(Rule):
             if fetched is not None and not _contains_taint(fetched,
                                                            tainted):
                 fetched = None
-            if fetched is None or region_ok:
+            if fetched is None:
+                # one level across calls: a resolvable callee that
+                # materializes the tainted argument without ticking is
+                # the same missed round trip, behind a function call
+                summary = self._callee_summary(node)
+                if summary is None or summary.syncs \
+                        or not summary.materializes:
+                    continue
+                for i, arg in enumerate(node.args):
+                    if i in summary.materializes \
+                            and _contains_taint(arg, tainted):
+                        fetched = arg
+                        break
+                if fetched is None:
+                    # keyword spelling of the same hazard:
+                    # fetch_tokens(outs=outs)
+                    for kw in node.keywords:
+                        if kw.arg and kw.arg in summary.params \
+                                and summary.params.index(kw.arg) \
+                                in summary.materializes \
+                                and _contains_taint(kw.value, tainted):
+                            fetched = kw.value
+                            break
+                if fetched is None:
+                    continue
+                if region_ok or module.line_has(node.lineno,
+                                                LEGACY_PRAGMA):
+                    continue
+                what = (fetched.id if isinstance(fetched, ast.Name)
+                        else ast.unparse(fetched)[:40])
+                findings.append(self.finding(
+                    module, node,
+                    f"'{ast.unparse(node.func)}()' materializes its "
+                    f"argument '{what}' (a device-dispatch result) "
+                    f"without a note_host_sync() — the round trip "
+                    f"hides behind the call (cross-file dataflow)"))
+                continue
+            if region_ok:
                 continue
             if module.line_has(node.lineno, LEGACY_PRAGMA):
                 continue
@@ -208,12 +368,20 @@ class HostSyncRule(Rule):
             return
         # materializer at the root of the RHS yields a HOST value; a
         # tuple display of materializers (the multi-fetch idiom
-        # ``a, b = np.asarray(x), np.asarray(y)``) does too
+        # ``a, b = np.asarray(x), np.asarray(y)``) does too, and so
+        # does a resolvable callee whose every return is host-rooted
+        # (the graph-summarized helper — its internal sync already
+        # covered the fetch)
         if _is_materializer_root(value) or (
                 isinstance(value, (ast.Tuple, ast.List)) and value.elts
                 and all(_is_materializer_root(e) for e in value.elts)):
             tainted -= targets
             return
+        if isinstance(value, ast.Call):
+            summary = self._callee_summary(value)
+            if summary is not None and summary.returns_host:
+                tainted -= targets
+                return
         if _contains_taint(value, tainted):
             tainted |= targets
         else:

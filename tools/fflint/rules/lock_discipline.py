@@ -1,0 +1,427 @@
+"""Rule ``lock-discipline``: inferred lock invariants on threaded code.
+
+The serving stack runs real threads — the stall watchdog, the flight
+recorder fed from driver loops AND signal handlers, the metrics
+registry scraped while drivers write.  A field that is *sometimes*
+protected by ``with self._lock:`` and sometimes not is a data race
+that never fails on the single-threaded CPU tier-1 run and corrupts a
+post-mortem bundle exactly when one is needed.  Python has no
+``@GuardedBy`` annotation, so the rule infers one:
+
+- **guarded-field inference**: for every class that creates a
+  ``threading.Lock()`` / ``RLock()`` attribute, the set of ``self.*``
+  fields WRITTEN while the lock is held — inside a ``with
+  self.<lock>:`` block or after a ``self.<lock>.acquire()`` (the
+  try/finally-with-timeout idiom; ``release()`` drops it) — is that
+  lock's guarded set: mutable shared state.  Any
+  read or write of a guarded field OUTSIDE the lock — in any method
+  except ``__init__``/``__new__``, where the object is not yet
+  shared — is an error.  Keying on writes keeps immutable config that
+  happens to be *read* inside a locked region (``self._schema``) out
+  of the guarded set, and a field never locked anywhere (a knob set
+  before the thread starts) never false-positives.
+- **signal-handler lock acquisition**: a handler registered via
+  ``signal.signal(sig, h)`` runs at an arbitrary bytecode boundary of
+  the main thread.  If it acquires a non-reentrant ``Lock`` the main
+  thread already holds, the process deadlocks — the exact
+  SIGTERM-during-dump class the watchdog exists to survive.  The rule
+  follows the handler one call level deep, MODULE-LOCALLY:
+  ``self.method()`` within the class and same-module functions.
+  Cross-module handler helpers are out of scope by design — a finding
+  must anchor (and be suppressible) in the module that owns the code,
+  which a cross-module walk from the registering module cannot do.
+  It errors on any ``with <Lock>:`` / ``<Lock>.acquire()`` it
+  reaches.  ``RLock`` acquisitions are exempt:
+  the handler interrupting its own thread re-enters them safely (they
+  can still *block* on another thread's hold, but cannot self-
+  deadlock — the fix this rule pushes toward).
+
+Nested function bodies inside methods are skipped in both passes: a
+closure may run under a caller's lock or not, and guessing either way
+manufactures false findings.  Locks must be ``self``-attributes or
+module-level names; locks reached through another object
+(``reg._lock``) guard that object's fields and are out of scope.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from ..core import Finding, LintContext, Module, Rule
+from ._jax_common import dotted_name
+
+_LOCK_CTORS = {"Lock": "Lock", "RLock": "RLock"}
+_EXEMPT_METHODS = {"__init__", "__new__"}
+
+
+def _lock_ctor_kind(node: ast.AST, imports=None) -> Optional[str]:
+    """"Lock"/"RLock" when ``node`` constructs a THREADING lock —
+    ``threading.Lock()``, an aliased ``th.RLock()``, or a bare
+    from-imported ``Lock()``.  ``asyncio.Lock()`` / ``multiprocessing``
+    locks must not match: their discipline is a different rule's job,
+    and calling an asyncio lock a thread-race is a false positive."""
+    if not isinstance(node, ast.Call):
+        return None
+    dn = dotted_name(node.func)
+    leaf = dn.split(".")[-1]
+    kind = _LOCK_CTORS.get(leaf)
+    if kind is None:
+        return None
+    if "." in dn:
+        root = dn.rsplit(".", 1)[0]
+        target = imports.get(root, root) if imports else root
+        return kind if target == "threading" else None
+    if imports and leaf in imports:
+        return kind if imports[leaf] == f"threading.{leaf}" else None
+    # bare Lock()/RLock() with no import info: assume threading (the
+    # overwhelmingly common spelling in fixture snippets)
+    return kind
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    if (isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"):
+        return node.attr
+    return None
+
+
+def _with_locks(st: ast.stmt, lock_attrs: Dict[str, str],
+                module_locks: Dict[str, str]) -> Set[str]:
+    """Lock names (``self.X`` -> ``X``, module lock -> name) acquired
+    by a With statement's items."""
+    out: Set[str] = set()
+    if not isinstance(st, (ast.With, ast.AsyncWith)):
+        return out
+    for item in st.items:
+        ce = item.context_expr
+        attr = _self_attr(ce)
+        if attr is not None and attr in lock_attrs:
+            out.add(attr)
+        elif isinstance(ce, ast.Name) and ce.id in module_locks:
+            out.add(ce.id)
+    return out
+
+
+class _ClassLocks:
+    """One class's lock attrs, guarded-field inference and accesses."""
+
+    def __init__(self, cls: ast.ClassDef, imports=None):
+        self.cls = cls
+        self.methods: Dict[str, ast.AST] = {
+            st.name: st for st in cls.body
+            if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef))}
+        self.lock_attrs: Dict[str, str] = {}     # attr -> kind
+        for node in ast.walk(cls):
+            if isinstance(node, ast.Assign):
+                kind = _lock_ctor_kind(node.value, imports)
+                if kind:
+                    for t in node.targets:
+                        attr = _self_attr(t)
+                        if attr:
+                            self.lock_attrs[attr] = kind
+        #: field -> set of guarding lock attrs (inferred)
+        self.guarded: Dict[str, Set[str]] = {}
+        #: (field, node, held_locks, method_name, is_write) for every
+        #: self.field access outside __init__, nested defs excluded
+        self.accesses: List[Tuple[str, ast.AST, frozenset, str,
+                                  bool]] = []
+        if self.lock_attrs:
+            self._scan()
+
+    # ------------------------------------------------------------- scan
+    def _scan(self) -> None:
+        for name, meth in self.methods.items():
+            self._scan_block(meth.body, frozenset(), name)
+        for field, node, held, meth, is_write in self.accesses:
+            if meth in _EXEMPT_METHODS:
+                continue
+            # a field is GUARDED by the locks it is WRITTEN under —
+            # mutable shared state (plain stores, subscript stores and
+            # mutating container methods all count).  Read-only config
+            # merely READ inside a locked region (self._schema) must
+            # not join the guarded set, or every lock-free read of an
+            # immutable field would false-positive.
+            if not is_write:
+                continue
+            for lock in held:
+                self.guarded.setdefault(field, set()).add(lock)
+
+    def _scan_block(self, stmts: List[ast.stmt], held: frozenset,
+                    meth: str) -> None:
+        # `held` evolves through the block: `self._lock.acquire()` (the
+        # try/finally-with-timeout idiom) holds the lock for the
+        # statements that follow, `.release()` drops it.  A non-blocking
+        # acquire that may fail still counts as held — erring toward
+        # false negatives, per the false-positive-shy contract.
+        cur = set(held)
+        for st in stmts:
+            if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.ClassDef)):
+                continue              # closures: lock state unknowable
+            self._collect_accesses(st, frozenset(cur), meth)
+            # only a With's own body runs under its acquired locks;
+            # every other child block (if/for/try bodies, orelse,
+            # handlers) inherits the current held set unchanged
+            body_held = frozenset(cur | _with_locks(st, self.lock_attrs,
+                                                    {}))
+            for attr in ("body", "orelse", "finalbody"):
+                b = getattr(st, attr, None)
+                if b and not isinstance(b, ast.AST):
+                    self._scan_block(b, body_held if attr == "body"
+                                     else frozenset(cur), meth)
+            for h in getattr(st, "handlers", []) or []:
+                self._scan_block(h.body, frozenset(cur), meth)
+            for attr, op in self._acquire_release_ops(st):
+                (cur.add if op == "acquire" else cur.discard)(attr)
+
+    def _acquire_release_ops(self, st: ast.stmt):
+        """(lock attr, "acquire"|"release") calls in this statement."""
+        out = []
+        for node in ast.walk(st):
+            if isinstance(node, ast.Call) and isinstance(
+                    node.func, ast.Attribute) \
+                    and node.func.attr in ("acquire", "release"):
+                attr = _self_attr(node.func.value)
+                if attr is not None and attr in self.lock_attrs:
+                    out.append((attr, node.func.attr))
+        return out
+
+    #: container methods that mutate their receiver — calling one on a
+    #: self-attribute under the lock marks the field guarded, same as a
+    #: plain store (``self._ring.append(ev)``, ``self._metrics[k] = m``)
+    _MUTATORS = {"append", "appendleft", "extend", "insert", "add",
+                 "update", "clear", "pop", "popitem", "popleft",
+                 "remove", "discard", "setdefault", "sort", "reverse"}
+
+    def _collect_accesses(self, st: ast.stmt, held: frozenset,
+                          meth: str) -> None:
+        # only this statement's own expressions — child blocks are
+        # walked by _scan_block with the right held set.  Lambda bodies
+        # are deferred code (lock state at call time unknowable): prune
+        # them with a manual stack, ast.walk cannot.
+        from ._jax_common import header_exprs
+
+        def record(field, node, is_write):
+            if field in self.lock_attrs or field in self.methods:
+                return
+            self.accesses.append((field, node, held, meth, is_write))
+
+        for expr in header_exprs(st):
+            stack = [expr]
+            while stack:
+                node = stack.pop()
+                if isinstance(node, ast.Lambda):
+                    continue
+                # mutation-through-container spellings: record the
+                # receiver field as a WRITE and skip its inner
+                # Attribute so the site is not double-counted as a read
+                if isinstance(node, ast.Subscript) and isinstance(
+                        node.ctx, (ast.Store, ast.Del)):
+                    field = _self_attr(node.value)
+                    if field is not None:
+                        record(field, node.value, True)
+                        stack.append(node.slice)
+                        continue
+                if isinstance(node, ast.Call) and isinstance(
+                        node.func, ast.Attribute) \
+                        and node.func.attr in self._MUTATORS:
+                    field = _self_attr(node.func.value)
+                    if field is not None:
+                        record(field, node.func.value, True)
+                        stack.extend(node.args)
+                        stack.extend(k.value for k in node.keywords)
+                        continue
+                stack.extend(ast.iter_child_nodes(node))
+                field = _self_attr(node)
+                if field is None:
+                    continue
+                record(field, node,
+                       isinstance(node.ctx, (ast.Store, ast.Del)))
+
+
+class LockDisciplineRule(Rule):
+    id = "lock-discipline"
+    short = ("fields touched under `with self._lock:` must always be; "
+             "signal handlers must not acquire non-reentrant locks")
+
+    def check(self, module: Module,
+              ctx: LintContext) -> Iterable[Finding]:
+        if "threading" not in module.text:
+            return []
+        graph = getattr(ctx, "graph", None)
+        minfo = graph.info(module) if graph is not None else None
+        imports = minfo.imports if minfo is not None else None
+        findings: List[Finding] = []
+        module_locks: Dict[str, str] = {}
+        for st in module.tree.body:
+            if isinstance(st, ast.Assign) and len(st.targets) == 1 \
+                    and isinstance(st.targets[0], ast.Name):
+                kind = _lock_ctor_kind(st.value, imports)
+                if kind:
+                    module_locks[st.targets[0].id] = kind
+        class_locks: List[_ClassLocks] = []
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.ClassDef):
+                cl = _ClassLocks(node, imports)
+                if cl.lock_attrs:
+                    class_locks.append(cl)
+        for cl in class_locks:
+            self._check_guarded(cl, module, findings)
+        self._check_signal_handlers(module, ctx, class_locks,
+                                    module_locks, findings)
+        return findings
+
+    # ---------------------------------------------------- guarded fields
+    def _check_guarded(self, cl: _ClassLocks, module: Module,
+                       findings: List[Finding]) -> None:
+        for field, node, held, meth, is_write in cl.accesses:
+            if meth in _EXEMPT_METHODS:
+                continue
+            locks = cl.guarded.get(field)
+            if not locks or locks & held:
+                continue
+            lock = sorted(locks)[0]
+            verb = "written" if is_write else "read"
+            n_sites = sum(1 for f, _, h, m, _w in cl.accesses
+                          if f == field and lock in h)
+            findings.append(self.finding(
+                module, node,
+                f"'self.{field}' is guarded by 'self.{lock}' "
+                f"({n_sites} locked site(s) in "
+                f"{cl.cls.name}) but {verb} here without it — a "
+                f"concurrent thread sees torn state exactly when a "
+                f"post-mortem needs it; take the lock or move the "
+                f"field out of the guarded set everywhere"))
+
+    # --------------------------------------------------- signal handlers
+    def _check_signal_handlers(self, module: Module, ctx: LintContext,
+                               class_locks: List[_ClassLocks],
+                               module_locks: Dict[str, str],
+                               findings: List[Finding]) -> None:
+        if "signal" not in module.text:
+            return
+        graph = getattr(ctx, "graph", None)
+        minfo = graph.info(module) if graph is not None else None
+        registrations = []          # (handler expr, site line)
+        for node in ast.walk(module.tree):
+            if (isinstance(node, ast.Call) and len(node.args) >= 2
+                    and self._is_signal_module_call(
+                        dotted_name(node.func), minfo)):
+                registrations.append((node.args[1], node.lineno))
+        if not registrations:
+            return
+        for handler, reg_line in registrations:
+            for target, cl in self._resolve_handler(handler, module,
+                                                    class_locks, minfo):
+                seen: Set[int] = set()
+                self._walk_handler(target, cl, module, module_locks,
+                                   reg_line, depth=0, seen=seen,
+                                   findings=findings)
+
+    @staticmethod
+    def _is_signal_module_call(dn: str, minfo) -> bool:
+        """True only for the stdlib ``signal.signal()`` registration —
+        an event-bus ``dispatcher.signal(name, cb)`` must not put its
+        callback under signal-handler lock rules.  The receiver must BE
+        the signal module: the literal spelling, or an alias the import
+        table maps to it (``import signal as sig`` /
+        ``from signal import signal``)."""
+        if dn == "signal.signal":
+            return True
+        imports = getattr(minfo, "imports", None) or {}
+        parts = dn.split(".")
+        if len(parts) == 2 and parts[1] == "signal":
+            return imports.get(parts[0]) == "signal"
+        if dn == "signal":
+            return imports.get("signal") == "signal.signal"
+        return False
+
+    def _resolve_handler(self, handler: ast.AST, module: Module,
+                         class_locks: List[_ClassLocks], minfo):
+        """Candidate (function node, owning _ClassLocks|None) pairs."""
+        out = []
+        attr = _self_attr(handler)
+        if attr is not None:
+            for cl in class_locks:
+                if attr in cl.methods:
+                    out.append((cl.methods[attr], cl))
+            return out
+        if isinstance(handler, ast.Name):
+            for st in module.tree.body:
+                if isinstance(st, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef)) \
+                        and st.name == handler.id:
+                    out.append((st, None))
+        return out
+
+    def _walk_handler(self, fn: ast.AST, cl, module: Module,
+                      module_locks, reg_line: int,
+                      depth: int, seen: Set[int],
+                      findings: List[Finding]) -> None:
+        if id(fn) in seen or depth > 1:
+            return
+        seen.add(id(fn))
+        # prune nested closures: a lock taken inside a function merely
+        # DEFINED in the handler (and run later, off-handler — the
+        # deferral this rule's own message recommends) is not acquired
+        # by the handler.  ast.walk cannot prune, so stack by hand.
+        stack = list(ast.iter_child_nodes(fn))
+        while stack:
+            node = stack.pop()
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+                continue
+            stack.extend(ast.iter_child_nodes(node))
+            # with self.<Lock>: / <module lock>: / .acquire()
+            acquired: List[Tuple[str, str, ast.AST]] = []
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                for item in node.items:
+                    acquired.extend(self._lock_of(item.context_expr, cl,
+                                                  module_locks, node))
+            elif isinstance(node, ast.Call) and isinstance(
+                    node.func, ast.Attribute) \
+                    and node.func.attr == "acquire":
+                acquired.extend(self._lock_of(node.func.value, cl,
+                                              module_locks, node))
+            for name, kind, anchor in acquired:
+                if kind == "RLock":
+                    continue       # reentrant: no self-deadlock
+                findings.append(self.finding(
+                    module, anchor,
+                    f"non-reentrant lock '{name}' acquired on a path "
+                    f"reachable from the signal handler registered at "
+                    f"line {reg_line} — a signal arriving while this "
+                    f"thread holds the lock deadlocks the process "
+                    f"(the SIGTERM-during-dump class); use "
+                    f"threading.RLock() or defer the work off the "
+                    f"handler"))
+            # one level of calls: self.method() / module function
+            if depth < 1 and isinstance(node, ast.Call):
+                attr = _self_attr(node.func)
+                if attr is not None and cl is not None \
+                        and attr in cl.methods:
+                    self._walk_handler(cl.methods[attr], cl, module,
+                                       module_locks, reg_line,
+                                       depth + 1, seen, findings)
+                elif isinstance(node.func, ast.Name):
+                    for st in module.tree.body:
+                        if isinstance(st, (ast.FunctionDef,
+                                           ast.AsyncFunctionDef)) \
+                                and st.name == node.func.id:
+                            self._walk_handler(st, None, module,
+                                               module_locks, reg_line,
+                                               depth + 1, seen,
+                                               findings)
+
+    @staticmethod
+    def _lock_of(expr: ast.AST, cl, module_locks: Dict[str, str],
+                 anchor: ast.AST) -> List[Tuple[str, str, ast.AST]]:
+        attr = _self_attr(expr)
+        if attr is not None and cl is not None \
+                and attr in cl.lock_attrs:
+            return [(f"self.{attr}", cl.lock_attrs[attr], anchor)]
+        if isinstance(expr, ast.Name) and expr.id in module_locks:
+            return [(expr.id, module_locks[expr.id], anchor)]
+        return []

@@ -41,18 +41,11 @@ only to modules that import ``jax.experimental.pallas``.
 from __future__ import annotations
 
 import ast
-from typing import Dict, Iterable, List, Optional, Tuple
+from typing import Iterable, List, Optional, Tuple
 
 from ..core import SEVERITY_WARN, Finding, LintContext, Module, Rule
-from ._jax_common import dotted_name, iter_scopes
-
-LANE = 128
-SUBLANE = {
-    "float32": 8, "f32": 8, "int32": 8, "uint32": 8,
-    "bfloat16": 16, "bf16": 16, "float16": 16, "f16": 16,
-    "int8": 32, "uint8": 32,
-    "float8_e4m3fn": 32, "float8_e5m2": 32, "fp8": 32,
-}
+from ._jax_common import (LANE, SUBLANE, ConstEnv, dotted_name,
+                          dtype_leaf, iter_scopes)
 
 
 def _imports_pallas(tree: ast.AST) -> bool:
@@ -68,80 +61,6 @@ def _imports_pallas(tree: ast.AST) -> bool:
     return False
 
 
-class _ConstEnv:
-    """Literal-int constant folding over one scope, document order."""
-
-    def __init__(self):
-        self.env: Dict[str, int] = {}
-
-    def fold(self, node: ast.AST) -> Optional[int]:
-        if isinstance(node, ast.Constant) and isinstance(node.value, int) \
-                and not isinstance(node.value, bool):
-            return node.value
-        if isinstance(node, ast.Name):
-            return self.env.get(node.id)
-        if isinstance(node, ast.UnaryOp) and isinstance(node.op, ast.USub):
-            v = self.fold(node.operand)
-            return -v if v is not None else None
-        if isinstance(node, ast.BinOp):
-            lhs, rhs = self.fold(node.left), self.fold(node.right)
-            if lhs is None or rhs is None:
-                return None
-            try:
-                if isinstance(node.op, ast.Add):
-                    return lhs + rhs
-                if isinstance(node.op, ast.Sub):
-                    return lhs - rhs
-                if isinstance(node.op, ast.Mult):
-                    return lhs * rhs
-                if isinstance(node.op, ast.FloorDiv):
-                    return lhs // rhs
-                if isinstance(node.op, ast.Mod):
-                    return lhs % rhs
-                if isinstance(node.op, ast.Pow):
-                    return lhs ** rhs
-            except (ZeroDivisionError, OverflowError):
-                return None
-        return None
-
-    def fold_shape(self, node: ast.AST) -> Optional[Tuple[int, ...]]:
-        if not isinstance(node, (ast.Tuple, ast.List)):
-            return None
-        dims = [self.fold(e) for e in node.elts]
-        if any(d is None for d in dims):
-            return None
-        return tuple(dims)  # type: ignore[arg-type]
-
-    def bind(self, stmt: ast.stmt) -> None:
-        if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 \
-                and isinstance(stmt.targets[0], ast.Name):
-            v = self.fold(stmt.value)
-            name = stmt.targets[0].id
-            if v is not None:
-                self.env[name] = v
-            else:
-                self.env.pop(name, None)   # unfoldable rebind: unknown
-        else:
-            # any other (re)binding of a known name invalidates it
-            for sub in ast.walk(stmt):
-                if isinstance(sub, ast.Name) and isinstance(
-                        sub.ctx, (ast.Store, ast.Del)):
-                    self.env.pop(sub.id, None)
-
-
-def _dtype_name(node: Optional[ast.AST]) -> Optional[str]:
-    if node is None:
-        return None
-    if isinstance(node, ast.Constant) and isinstance(node.value, str):
-        return node.value
-    dn = dotted_name(node)
-    if dn:
-        leaf = dn.split(".")[-1]
-        if leaf in SUBLANE:
-            return leaf
-    return None
-
-
 class PallasTilingRule(Rule):
     id = "pallas-tiling"
     short = ("literal Pallas block/scratch shapes must respect the "
@@ -155,13 +74,13 @@ class PallasTilingRule(Rule):
         findings: List[Finding] = []
         # module-level literal constants (``W = 16``) seed every
         # function scope's environment
-        module_env = _ConstEnv()
+        module_env = ConstEnv()
         for st in module.tree.body:
             if not isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef,
                                    ast.ClassDef)):
                 module_env.bind(st)
         for scope in iter_scopes(module.tree):
-            env = _ConstEnv()
+            env = ConstEnv()
             env.env = dict(module_env.env)
             if isinstance(scope, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 # parameters shadow module constants (their runtime
@@ -174,7 +93,7 @@ class PallasTilingRule(Rule):
             self._walk(body, env, module, findings)
         return findings
 
-    def _walk(self, stmts: List[ast.stmt], env: _ConstEnv,
+    def _walk(self, stmts: List[ast.stmt], env: ConstEnv,
               module: Module, findings: List[Finding]) -> None:
         from ._jax_common import child_blocks, header_exprs
 
@@ -201,7 +120,7 @@ class PallasTilingRule(Rule):
                 # conditional bodies fold with their own env copy;
                 # names they (re)bind are unknown afterwards
                 for block in blocks:
-                    child = _ConstEnv()
+                    child = ConstEnv()
                     child.env = dict(env.env)
                     self._walk(block, child, module, findings)
                 for sub in ast.walk(st):
@@ -210,7 +129,7 @@ class PallasTilingRule(Rule):
                         env.env.pop(sub.id, None)
 
     # ------------------------------------------------------------ checks
-    def _check_call(self, call: ast.Call, env: _ConstEnv,
+    def _check_call(self, call: ast.Call, env: ConstEnv,
                     module: Module, findings: List[Finding]) -> None:
         name = dotted_name(call.func)
         leaf = name.split(".")[-1] if name else ""
@@ -224,7 +143,7 @@ class PallasTilingRule(Rule):
                                   findings, what="BlockSpec block shape")
         elif leaf == "VMEM":
             shape_node = call.args[0] if len(call.args) >= 1 else None
-            dtype = _dtype_name(call.args[1]) if len(call.args) >= 2 \
+            dtype = dtype_leaf(call.args[1]) if len(call.args) >= 2 \
                 else None
             if shape_node is not None:
                 self._check_shape(shape_node, dtype, env, module,
@@ -233,7 +152,7 @@ class PallasTilingRule(Rule):
             self._check_grid(call, env, module, findings)
 
     def _check_shape(self, shape_node: ast.AST, dtype: Optional[str],
-                     env: _ConstEnv, module: Module,
+                     env: ConstEnv, module: Module,
                      findings: List[Finding], what: str) -> None:
         if not isinstance(shape_node, (ast.Tuple, ast.List)):
             return
@@ -258,7 +177,7 @@ class PallasTilingRule(Rule):
                 f"tiles, silently wasting VMEM/bandwidth",
                 severity=SEVERITY_WARN))
 
-    def _check_grid(self, call: ast.Call, env: _ConstEnv,
+    def _check_grid(self, call: ast.Call, env: ConstEnv,
                     module: Module, findings: List[Finding]) -> None:
         kw = {k.arg: k.value for k in call.keywords if k.arg}
         # dtype-correlated sublane check: an out BlockSpec's tile rides
@@ -309,7 +228,7 @@ class PallasTilingRule(Rule):
 
     @staticmethod
     def _fold_sds(node: Optional[ast.AST],
-                  env: _ConstEnv) -> Optional[Tuple[int, ...]]:
+                  env: ConstEnv) -> Optional[Tuple[int, ...]]:
         """Fold ``jax.ShapeDtypeStruct((…), dtype)``'s shape."""
         if (isinstance(node, ast.Call)
                 and dotted_name(node.func).endswith("ShapeDtypeStruct")
@@ -323,7 +242,7 @@ class PallasTilingRule(Rule):
         if (isinstance(node, ast.Call)
                 and dotted_name(node.func).endswith("ShapeDtypeStruct")
                 and len(node.args) >= 2):
-            return _dtype_name(node.args[1])
+            return dtype_leaf(node.args[1])
         return None
 
     @staticmethod

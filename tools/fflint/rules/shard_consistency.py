@@ -1,0 +1,823 @@
+"""Rule ``shard-consistency``: whole-program sharding-plan checks.
+
+Tier-1 runs on CPU (``JAX_PLATFORMS=cpu``), so a ``PartitionSpec``
+axis the mesh does not carry, a ``shard_map`` in_spec whose rank
+drifted from the cache layout, or a collective over a misspelled axis
+name only surfaces in a MULTICHIP dryrun or an on-chip run — exactly
+the runs we cannot afford per PR.  The reference FlexFlow catches this
+class at plan time via its machine-view/PCG consistency machinery
+(``graph_optimize_task``); our equivalent is this rule, running over
+the pass-1 symbol graph so it can see across files.
+
+The core is a **symbolic PartitionSpec evaluator**: it folds literal
+``PartitionSpec(...)`` constructors (axis entries through constants
+like ``AXIS_MODEL = "tp"`` resolved across modules, ternaries
+``AXIS_SEQ if sp > 1 else None`` as either-arm unions, tuple-axis
+entries, ``*tuple(spec)[:3]`` prefix slices) and evaluates calls to
+project spec constructors (``cache_pspec``, ``scale_pspec``,
+``_param_pspecs``-style helpers) interprocedurally by substituting
+arguments into the callee's return expression.  ``prune_spec``-shaped
+helpers (anything filtering entries by ``… in mesh.shape``) evaluate
+to their argument marked *mesh-pruned*: by construction their output
+axes are a subset of the mesh, so axis-membership checks skip.
+
+Checks (all fold-or-stay-silent — runtime-derived values are never
+guessed):
+
+- **axis vocabulary** (error): every literal axis name written in a
+  ``PartitionSpec`` constructor must be one of the project's declared
+  mesh axes (the string values of ``AXIS_*`` constants — dp/tp/pp/
+  sp/ep from ``config.py``).  A flipped or misspelled axis in
+  ``cache_pspec`` is caught at the constructor's exact line, before
+  any mesh exists.  Skipped when the linted tree declares no ``AXIS_*``
+  constants (fixture trees, tools-only runs).
+- **mesh membership** (error): at ``NamedSharding(mesh, spec)`` and
+  ``shard_map(…, mesh=…, in_specs/out_specs=…)`` sites where the mesh's
+  axis names fold (literal ``Mesh(…, axis_names=(…))``), every folded
+  spec axis must be carried by that mesh.
+- **spec rank vs array rank** (error): at ``jax.device_put(arr, s)`` /
+  ``with_sharding_constraint(arr, s)`` and at ``shard_map``
+  invocations whose argument ranks fold (``jnp.zeros((…), dt)``
+  literal shape tuples — rank folds even when the dims don't), a spec
+  with MORE entries than the array has dims is rejected.  This is the
+  ``scale_pspec(cache_pspec(sp, tp))``-vs-3-rank-scales drift class.
+  Fewer entries is legal (trailing dims replicate) and stays silent.
+- **collective axis scope** (error): ``jax.lax.psum/pmax/pmin/pmean/
+  ppermute/all_gather/all_to_all/axis_index…`` inside a ``shard_map``
+  body may only name axes of that shard_map's mesh (when the mesh
+  folds) or, failing that, axes from the project vocabulary.
+- **in_specs arity** (error): a literal ``in_specs`` tuple whose
+  length cannot match the body's parameter list.
+- **dtype-keyed shard alignment** (error): when a sharded array's
+  sublane (second-to-last) dim and dtype both fold, a dim sharded over
+  any axis must be a multiple of the dtype's minimum sublane tile —
+  32/int8, 16/bf16, 8/f32, the SAME table the ``pallas-tiling`` rule
+  enforces (shared in ``_jax_common``): per-shard extents that violate
+  it cannot be Mosaic-tiled and the kernels silently fall back (the
+  PR-2 32-aligned int8 invariant).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Tuple
+
+from ..core import Finding, LintContext, Module, Rule
+from ._jax_common import (SUBLANE, ConstEnv as _ConstEnv, child_blocks,
+                          dotted_name, dtype_leaf, header_exprs)
+
+#: unknown spec entry sentinel (counts for rank, exempt from axis checks)
+_UNKNOWN = object()
+
+#: jax.lax collectives -> positional index of their axis-name argument
+_COLLECTIVE_AXIS_POS = {
+    "psum": 1, "pmax": 1, "pmin": 1, "pmean": 1, "psum_scatter": 1,
+    "all_gather": 1, "all_to_all": 1, "ppermute": 1, "pshuffle": 1,
+    "axis_index": 0, "axis_size": 0,
+}
+
+_ARRAY_CTORS = {"zeros", "ones", "empty", "full"}
+
+
+class SpecVal:
+    """Symbolic PartitionSpec: per-dim possible axis names.
+
+    ``entries``: tuple of frozensets (possible axis names for that dim;
+    empty = unsharded) or ``_UNKNOWN``; None when the rank itself is
+    unknown.  ``axes``: union of every known axis anywhere in the spec
+    (usable even when the rank is not).  ``mesh_pruned``: the spec went
+    through a prune-to-mesh helper — axis membership holds by
+    construction."""
+
+    __slots__ = ("entries", "axes", "mesh_pruned")
+
+    def __init__(self, entries, axes, mesh_pruned=False):
+        self.entries = entries
+        self.axes = frozenset(axes)
+        self.mesh_pruned = mesh_pruned
+
+    @property
+    def rank(self) -> Optional[int]:
+        return None if self.entries is None else len(self.entries)
+
+
+class _Env:
+    """Per-scope symbolic bindings, document order.  ``poisoned``
+    names were locally (re)bound to something unfoldable — they shadow
+    any same-named module/imported constant, so the graph fallback
+    must NOT re-fold them (fold-or-silent: a shadowed constant's value
+    is unknown, not its module-level one)."""
+
+    def __init__(self):
+        self.specs: Dict[str, SpecVal] = {}
+        self.strs: Dict[str, frozenset] = {}
+        self.arrays: Dict[str, Tuple] = {}      # (rank, dims, dtype)
+        self.meshes: Dict[str, frozenset] = {}
+        self.shardings: Dict[str, Tuple] = {}   # (mesh_axes, SpecVal)
+        self.shardmaps: Dict[str, ast.Call] = {}
+        self.poisoned: set = set()
+
+    def copy(self) -> "_Env":
+        e = _Env()
+        for attr in ("specs", "strs", "arrays", "meshes", "shardings",
+                     "shardmaps"):
+            setattr(e, attr, dict(getattr(self, attr)))
+        e.poisoned = set(self.poisoned)
+        return e
+
+    def kill(self, name: str) -> None:
+        for attr in ("specs", "strs", "arrays", "meshes", "shardings",
+                     "shardmaps"):
+            getattr(self, attr).pop(name, None)
+        self.poisoned.add(name)
+
+
+def _is_pspec_ctor(func: ast.AST, minfo) -> bool:
+    dn = dotted_name(func)
+    if not dn:
+        return False
+    leaf = dn.split(".")[-1]
+    if leaf == "PartitionSpec":
+        return True
+    if "." not in dn and minfo is not None:
+        return minfo.imports.get(dn, "").endswith("PartitionSpec")
+    return False
+
+
+def _prune_like(fn_node: ast.AST) -> bool:
+    """Does this function filter spec entries by mesh membership
+    (``… in mesh.shape``)?  Then its output axes are a subset of the
+    mesh by construction (prune_spec's contract)."""
+    for node in ast.walk(fn_node):
+        if isinstance(node, ast.Compare) and any(
+                isinstance(op, (ast.In, ast.NotIn)) for op in node.ops):
+            for c in node.comparators:
+                if isinstance(c, ast.Attribute) and c.attr == "shape":
+                    return True
+    return False
+
+
+class _Eval:
+    """The symbolic evaluator; bound to the run's graph (shared memo)."""
+
+    def __init__(self, graph):
+        self.graph = graph
+
+    # ----------------------------------------------------------- strings
+    def axis_values(self, node: ast.AST, env: _Env,
+                    minfo) -> Optional[frozenset]:
+        """Possible axis-name strings one spec entry can contribute;
+        frozenset() for (always) None, None for unresolvable."""
+        if isinstance(node, ast.Constant):
+            if node.value is None:
+                return frozenset()
+            if isinstance(node.value, str):
+                return frozenset((node.value,))
+            return None
+        if isinstance(node, ast.IfExp):
+            a = self.axis_values(node.body, env, minfo)
+            b = self.axis_values(node.orelse, env, minfo)
+            if a is None or b is None:
+                return None
+            return a | b
+        if isinstance(node, (ast.Tuple, ast.List)):
+            out = frozenset()
+            for e in node.elts:
+                v = self.axis_values(e, env, minfo)
+                if v is None:
+                    return None
+                out |= v
+            return out
+        if isinstance(node, ast.Name) and node.id in env.strs:
+            return env.strs[node.id]
+        dn = dotted_name(node)
+        if dn and dn.split(".")[0] in env.poisoned:
+            return None              # locally shadowed: value unknown
+        if dn and self.graph is not None and minfo is not None:
+            hit = self.graph.resolve_constant(minfo, dn)
+            if hit is not None:
+                v = hit[0]
+                if v is None:
+                    return frozenset()
+                if isinstance(v, str):
+                    return frozenset((v,))
+        return None
+
+    # ------------------------------------------------------------- specs
+    def eval_spec(self, node: ast.AST, env: _Env, minfo,
+                  depth: int = 0) -> Optional[SpecVal]:
+        if depth > 4:
+            return None
+        if isinstance(node, ast.Name):
+            return env.specs.get(node.id)
+        if (isinstance(node, ast.Attribute) and node.attr == "spec"
+                and isinstance(node.value, ast.Name)
+                and node.value.id in env.shardings):
+            return env.shardings[node.value.id][1]
+        if not isinstance(node, ast.Call):
+            return None
+        if _is_pspec_ctor(node.func, minfo):
+            return self._eval_ctor(node, env, minfo, depth)
+        # interprocedural: a call to a resolvable spec constructor
+        dn = dotted_name(node.func)
+        if not dn or self.graph is None or minfo is None:
+            return None
+        fn = self.graph.resolve_function(minfo, dn)
+        if fn is None:
+            return None
+        if _prune_like(fn.node):
+            if node.args:
+                sub = self.eval_spec(node.args[0], env, minfo, depth + 1)
+                if sub is not None:
+                    return SpecVal(sub.entries, sub.axes,
+                                   mesh_pruned=True)
+            return None
+        # substitute arguments into the callee's single return expr;
+        # every parameter starts poisoned — an unbound (or unfoldable)
+        # param must not fall back to a same-named callee-module
+        # constant it shadows
+        params = fn.params()
+        child = _Env()
+        child.poisoned.update(params)
+        for p, a in zip(params, node.args):
+            sv = self.eval_spec(a, env, minfo, depth + 1)
+            if sv is not None:
+                child.specs[p] = sv
+            av = self.axis_values(a, env, minfo)
+            if av is not None:
+                child.strs[p] = av
+                child.poisoned.discard(p)
+        rets = [n for n in ast.walk(fn.node)
+                if isinstance(n, ast.Return) and n.value is not None]
+        if len(rets) != 1:
+            return None
+        return self.eval_spec(rets[0].value, child, fn.minfo, depth + 1)
+
+    def _eval_ctor(self, call: ast.Call, env: _Env, minfo,
+                   depth: int) -> Optional[SpecVal]:
+        entries: List = []
+        axes = set()
+        rank_known = True
+        for arg in call.args:
+            if isinstance(arg, ast.Starred):
+                sub = self._starred_entries(arg.value, env, minfo, depth)
+                if sub is None:
+                    rank_known = False
+                    continue
+                entries.extend(sub)
+                for e in sub:
+                    if e is not _UNKNOWN:
+                        axes |= e
+                continue
+            av = self.axis_values(arg, env, minfo)
+            if av is None:
+                entries.append(_UNKNOWN)
+            else:
+                entries.append(av)
+                axes |= av
+        return SpecVal(tuple(entries) if rank_known else None, axes)
+
+    def _starred_entries(self, node: ast.AST, env: _Env, minfo,
+                         depth: int) -> Optional[List]:
+        # *tuple(spec)[:k] / *spec[:k]: the leading k entries
+        if isinstance(node, ast.Subscript) and isinstance(
+                node.slice, ast.Slice) and node.slice.lower is None \
+                and isinstance(node.slice.upper, ast.Constant) \
+                and isinstance(node.slice.upper.value, int):
+            k = node.slice.upper.value
+            inner = node.value
+            if isinstance(inner, ast.Call) and isinstance(
+                    inner.func, ast.Name) and inner.func.id == "tuple" \
+                    and inner.args:
+                inner = inner.args[0]
+            sv = self.eval_spec(inner, env, minfo, depth + 1)
+            if sv is not None and sv.entries is not None:
+                return list(sv.entries[:k])
+            return None
+        # *([None] * n) with a literal n: n unsharded entries
+        if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Mult):
+            seq, n = node.left, node.right
+            if not isinstance(seq, (ast.List, ast.Tuple)):
+                seq, n = node.right, node.left
+            if (isinstance(seq, (ast.List, ast.Tuple))
+                    and len(seq.elts) == 1
+                    and isinstance(seq.elts[0], ast.Constant)
+                    and seq.elts[0].value is None
+                    and isinstance(n, ast.Constant)
+                    and isinstance(n.value, int)):
+                return [frozenset()] * n.value
+        return None
+
+    # ------------------------------------------------------------ meshes
+    def mesh_axes_of(self, node: Optional[ast.AST], env: _Env,
+                     minfo) -> Optional[frozenset]:
+        if node is None:
+            return None
+        if isinstance(node, ast.Name):
+            return env.meshes.get(node.id)
+        if isinstance(node, ast.Call):
+            leaf = dotted_name(node.func).split(".")[-1]
+            if leaf == "Mesh":
+                ax = None
+                for kw in node.keywords:
+                    if kw.arg == "axis_names":
+                        ax = kw.value
+                if ax is None and len(node.args) >= 2:
+                    ax = node.args[1]
+                if ax is None:
+                    return None
+                return self.axis_values(ax, env, minfo)
+        return None
+
+    # ------------------------------------------------------------ arrays
+    def array_info(self, node: ast.AST, env: _Env,
+                   ienv: _ConstEnv) -> Optional[Tuple]:
+        """(rank, dims, dtype) — rank folds from a literal shape tuple
+        even when the dims do not; dims are per-dim Optional[int]."""
+        if isinstance(node, ast.Name):
+            return env.arrays.get(node.id)
+        if not isinstance(node, ast.Call):
+            return None
+        leaf = dotted_name(node.func).split(".")[-1]
+        if leaf not in _ARRAY_CTORS or not node.args:
+            return None
+        shape = node.args[0]
+        if not isinstance(shape, (ast.Tuple, ast.List)):
+            return None
+        dims = tuple(ienv.fold(e) for e in shape.elts)
+        dtype = None
+        for kw in node.keywords:
+            if kw.arg == "dtype":
+                dtype = dtype_leaf(kw.value)
+        if dtype is None:
+            for a in node.args[1:]:
+                dtype = dtype_leaf(a)
+                if dtype is not None:
+                    break
+        return (len(dims), dims, dtype)
+
+
+class ShardConsistencyRule(Rule):
+    id = "shard-consistency"
+    short = ("PartitionSpec axes must exist on the mesh, spec ranks "
+             "must fit the arrays they bind, collectives must name "
+             "in-scope axes, sharded dims must stay sublane-aligned")
+
+    _TRIGGERS = ("PartitionSpec", "NamedSharding", "shard_map",
+                 "with_sharding_constraint")
+
+    def check(self, module: Module, ctx: LintContext):
+        if not any(t in module.text for t in self._TRIGGERS):
+            return []
+        graph = getattr(ctx, "graph", None)
+        minfo = graph.info(module) if graph is not None else None
+        if minfo is None:
+            return []
+        ev = _Eval(graph)
+        vocab = graph.axis_vocabulary()
+        findings: List[Finding] = []
+        # module-level int constants seed every scope (pallas idiom)
+        module_ienv = _ConstEnv()
+        for st in module.tree.body:
+            if not isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                   ast.ClassDef)):
+                module_ienv.bind(st)
+        module_env = _Env()
+        self._walk(module.tree.body, module_env, module_ienv, ev, vocab,
+                   module, minfo, findings)
+        for scope, ancestors in self._scopes_with_ancestors(module.tree):
+            ienv = _ConstEnv()
+            ienv.env = dict(module_ienv.env)
+            a = scope.args
+            for p in (getattr(a, "posonlyargs", []) + a.args
+                      + a.kwonlyargs):
+                ienv.env.pop(p.arg, None)
+            env = module_env.copy()
+            for p in (getattr(a, "posonlyargs", []) + a.args
+                      + a.kwonlyargs):
+                env.kill(p.arg)
+            # Python scoping: a name STORED anywhere in this function
+            # is local for its whole body (use-before-assign raises at
+            # runtime), and a store in an ENCLOSING function shadows
+            # the module constant for closures too — kill both sets so
+            # the graph fallback never re-folds a shadowed value; the
+            # in-order walk re-binds whatever actually folds
+            for fn in ancestors + [scope]:
+                for sub in ast.walk(fn):
+                    if isinstance(sub, ast.Name) and isinstance(
+                            sub.ctx, (ast.Store, ast.Del)):
+                        env.kill(sub.id)
+                        ienv.env.pop(sub.id, None)
+            self._walk(scope.body, env, ienv, ev, vocab, module, minfo,
+                       findings)
+        return findings
+
+    @staticmethod
+    def _scopes_with_ancestors(tree: ast.AST):
+        """Every function def paired with its enclosing function chain
+        (outermost first)."""
+        out = []
+
+        def rec(node, ancestors):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                    out.append((child, list(ancestors)))
+                    rec(child, ancestors + [child])
+                else:
+                    rec(child, ancestors)
+
+        rec(tree, [])
+        return out
+
+    # ------------------------------------------------------------ walker
+    def _walk(self, stmts, env: _Env, ienv: _ConstEnv, ev: _Eval,
+              vocab, module: Module, minfo, findings) -> None:
+        for st in stmts:
+            if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue                      # own scope (check())
+            if isinstance(st, ast.ClassDef):
+                # class-level spec tables still get constructor checks;
+                # the class BODY is its own namespace — both envs are
+                # copied so a class constant (`S = 48`) cannot leak
+                # over the module's and poison later folds
+                cienv = _ConstEnv()
+                cienv.env = dict(ienv.env)
+                self._walk(st.body, env.copy(), cienv, ev, vocab,
+                           module, minfo, findings)
+                continue
+            for expr in header_exprs(st):
+                for node in ast.walk(expr):
+                    if isinstance(node, ast.Call):
+                        self._check_call(node, env, ienv, ev, vocab,
+                                         module, minfo, findings)
+            self._bind(st, env, ienv, ev, minfo)
+            blocks = child_blocks(st)
+            if not blocks:
+                continue
+            if isinstance(st, (ast.With, ast.AsyncWith)):
+                for b in blocks:
+                    self._walk(b, env, ienv, ev, vocab, module, minfo,
+                               findings)
+            else:
+                # conditional bodies get their own env copy; names they
+                # (re)bind are unknown afterwards
+                for b in blocks:
+                    cienv = _ConstEnv()
+                    cienv.env = dict(ienv.env)
+                    self._walk(b, env.copy(), cienv, ev, vocab, module,
+                               minfo, findings)
+                for sub in ast.walk(st):
+                    if isinstance(sub, ast.Name) and isinstance(
+                            sub.ctx, (ast.Store, ast.Del)):
+                        env.kill(sub.id)
+                        ienv.env.pop(sub.id, None)
+
+    def _bind(self, st: ast.stmt, env: _Env, ienv: _ConstEnv,
+              ev: _Eval, minfo) -> None:
+        ienv.bind(st)
+        if not (isinstance(st, ast.Assign) and len(st.targets) == 1
+                and isinstance(st.targets[0], ast.Name)):
+            # any other binding of tracked names invalidates them —
+            # including `with … as name` (the with-body then re-binds
+            # whatever IS foldable in document order)
+            if isinstance(st, (ast.Assign, ast.AugAssign, ast.AnnAssign,
+                               ast.For, ast.AsyncFor, ast.With,
+                               ast.AsyncWith)):
+                for sub in ast.walk(st):
+                    if isinstance(sub, ast.Name) and isinstance(
+                            sub.ctx, (ast.Store, ast.Del)):
+                        env.kill(sub.id)
+            return
+        name = st.targets[0].id
+        env.kill(name)
+        v = st.value
+        if isinstance(v, ast.Call):
+            leaf = dotted_name(v.func).split(".")[-1]
+            if leaf == "NamedSharding":
+                mesh_ax = ev.mesh_axes_of(
+                    v.args[0] if v.args else None, env, minfo)
+                sv = ev.eval_spec(v.args[1], env, minfo) \
+                    if len(v.args) >= 2 else None
+                if sv is not None or mesh_ax is not None:
+                    env.shardings[name] = (mesh_ax, sv)
+                return
+            if leaf == "shard_map":
+                env.shardmaps[name] = v
+                return
+        sv = ev.eval_spec(v, env, minfo)
+        if sv is not None:
+            env.specs[name] = sv
+            return
+        ma = ev.mesh_axes_of(v, env, minfo)
+        if ma is not None:
+            env.meshes[name] = ma
+            return
+        ai = ev.array_info(v, env, ienv)
+        if ai is not None:
+            env.arrays[name] = ai
+            return
+        av = ev.axis_values(v, env, minfo)
+        if av is not None:
+            env.strs[name] = av
+
+    # ------------------------------------------------------------ checks
+    def _check_call(self, call: ast.Call, env: _Env, ienv: _ConstEnv,
+                    ev: _Eval, vocab, module: Module, minfo,
+                    findings) -> None:
+        func = call.func
+        # invocation of a shard_map result: shard_map(...)(args) or
+        # fn(args) where fn was bound from shard_map(...)
+        site = None
+        if isinstance(func, ast.Call) and \
+                dotted_name(func.func).split(".")[-1] == "shard_map":
+            site = func
+        elif isinstance(func, ast.Name) and func.id in env.shardmaps:
+            site = env.shardmaps[func.id]
+        if site is not None and site is not call:
+            self._check_invocation(call, site, env, ienv, ev, module,
+                                   minfo, findings)
+        dn = dotted_name(func)
+        leaf = dn.split(".")[-1] if dn else ""
+        if _is_pspec_ctor(func, minfo):
+            self._check_ctor_axes(call, env, ev, vocab, module, minfo,
+                                  findings)
+        elif leaf == "NamedSharding" and len(call.args) >= 2:
+            mesh_ax = ev.mesh_axes_of(call.args[0], env, minfo)
+            sv = ev.eval_spec(call.args[1], env, minfo)
+            self._check_membership(call, sv, mesh_ax, module, findings,
+                                   vocab=vocab)
+        elif leaf in ("device_put", "with_sharding_constraint") \
+                and len(call.args) >= 2:
+            self._check_placement(call, env, ienv, ev, module, minfo,
+                                  findings)
+        elif leaf == "shard_map":
+            self._check_shard_map(call, env, ienv, ev, vocab, module,
+                                  minfo, findings)
+
+    def _check_ctor_axes(self, call: ast.Call, env: _Env, ev: _Eval,
+                         vocab, module: Module, minfo,
+                         findings) -> None:
+        if vocab is None:
+            return
+        for arg in call.args:
+            node = arg.value if isinstance(arg, ast.Starred) else arg
+            av = ev.axis_values(node, env, minfo)
+            if av is None:
+                continue
+            for a in sorted(av - vocab):
+                findings.append(self.finding(
+                    module, node,
+                    f"PartitionSpec axis {a!r} is not a configured "
+                    f"mesh axis name "
+                    f"({', '.join(sorted(vocab))} — the AXIS_* "
+                    f"constants) — a NamedSharding/shard_map over it "
+                    f"fails only on a real multichip mesh"))
+
+    def _check_membership(self, call: ast.Call, sv: Optional[SpecVal],
+                          mesh_ax: Optional[frozenset], module: Module,
+                          findings, vocab=None) -> None:
+        if sv is None or mesh_ax is None or sv.mesh_pruned:
+            return
+        bad = sv.axes - mesh_ax
+        if vocab is not None:
+            # an out-of-vocabulary axis was already reported at its
+            # P() constructor — one typo, one finding (the same dedup
+            # policy _check_collectives applies)
+            bad &= vocab
+        for a in sorted(bad):
+            findings.append(self.finding(
+                module, call,
+                f"spec axis {a!r} is not carried by this mesh (axes: "
+                f"{', '.join(sorted(mesh_ax)) or 'none'}) — "
+                f"sharding over a missing axis fails at mesh-entry "
+                f"time on chip; prune_spec() drops absent axes"))
+
+    def _spec_of_sharding(self, node: ast.AST, env: _Env, ev: _Eval,
+                          minfo):
+        """(mesh_axes, SpecVal) of a sharding expression: an inline
+        NamedSharding(...) call or a name bound to one."""
+        if isinstance(node, ast.Name):
+            return env.shardings.get(node.id, (None, None))
+        if isinstance(node, ast.Call) and \
+                dotted_name(node.func).split(".")[-1] == "NamedSharding":
+            mesh_ax = ev.mesh_axes_of(
+                node.args[0] if node.args else None, env, minfo)
+            sv = ev.eval_spec(node.args[1], env, minfo) \
+                if len(node.args) >= 2 else None
+            return (mesh_ax, sv)
+        # a bare spec where a sharding is accepted
+        # (with_sharding_constraint takes either)
+        sv = ev.eval_spec(node, env, minfo)
+        return (None, sv)
+
+    def _check_placement(self, call: ast.Call, env: _Env,
+                         ienv: _ConstEnv, ev: _Eval, module: Module,
+                         minfo, findings) -> None:
+        arr, sh = call.args[0], call.args[1]
+        _, sv = self._spec_of_sharding(sh, env, ev, minfo)
+        if sv is None:
+            return
+        ai = ev.array_info(arr, env, ienv)
+        if ai is None:
+            return
+        self._check_binding(call, sv, ai, module, findings,
+                            what="sharding")
+
+    def _check_binding(self, anchor, sv: SpecVal, ai: Tuple,
+                       module: Module, findings, what: str) -> None:
+        rank, dims, dtype = ai
+        if sv.entries is None:
+            return
+        if len(sv.entries) > rank:
+            findings.append(self.finding(
+                module, anchor,
+                f"{what} spec has {len(sv.entries)} entries but the "
+                f"array it binds has rank {rank} — the spec rank "
+                f"drifted from the array layout (rank-mismatch "
+                f"crashes only at trace time on a real mesh)"))
+            return
+        # dtype-keyed shard alignment on the sublane dim (the PR-2
+        # invariant, same table as pallas-tiling)
+        if rank < 2 or dtype not in SUBLANE:
+            return
+        i = rank - 2
+        if i >= len(sv.entries):
+            return
+        entry = sv.entries[i]
+        if entry is _UNKNOWN or not entry:
+            return
+        d = dims[i]
+        t = SUBLANE[dtype]
+        if d is not None and d > 1 and d % t:
+            findings.append(self.finding(
+                module, anchor,
+                f"sublane dim {d} (dim {i}) sharded over "
+                f"{'/'.join(sorted(entry))} is not a multiple of {t}, "
+                f"the minimum sublane tile for {dtype} — per-shard "
+                f"extents cannot stay Mosaic-tileable (int8 needs 32, "
+                f"bf16 16, f32 8; kernels silently fall back)"))
+
+    # --------------------------------------------------------- shard_map
+    @staticmethod
+    def _shard_map_parts(call: ast.Call):
+        # shard_map(f, mesh, in_specs, out_specs, …): every operand is
+        # legal positionally too — falling back to the positional slot
+        # keeps the keyword and positional call forms equally checked
+        kw = {k.arg: k.value for k in call.keywords if k.arg}
+
+        def part(name, pos):
+            v = kw.get(name)
+            if v is None and len(call.args) > pos:
+                v = call.args[pos]
+            return v
+
+        return (call.args[0] if call.args else None, part("mesh", 1),
+                part("in_specs", 2), part("out_specs", 3))
+
+    def _specs_list(self, node: Optional[ast.AST], env: _Env,
+                    ev: _Eval, minfo):
+        """Fold an in_specs/out_specs value to a list of Optional
+        SpecVals; None when the container shape itself does not fold
+        (tuple concatenation etc.)."""
+        if node is None:
+            return None
+        if isinstance(node, (ast.Tuple, ast.List)):
+            return [ev.eval_spec(e, env, minfo) for e in node.elts]
+        sv = ev.eval_spec(node, env, minfo)
+        return [sv] if sv is not None else None
+
+    def _resolve_local_def(self, module: Module, name: str,
+                           at_line: int):
+        best = first = None
+        for d in ast.walk(module.tree):
+            if isinstance(d, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and d.name == name:
+                first = first or d
+                if d.lineno <= at_line and (best is None
+                                            or d.lineno > best.lineno):
+                    best = d
+        return best or first
+
+    def _check_shard_map(self, call: ast.Call, env: _Env,
+                         ienv: _ConstEnv, ev: _Eval, vocab,
+                         module: Module, minfo, findings) -> None:
+        body, mesh, in_specs, out_specs = self._shard_map_parts(call)
+        mesh_ax = ev.mesh_axes_of(mesh, env, minfo)
+        spec_axes = set()
+        for node in (in_specs, out_specs):
+            svs = self._specs_list(node, env, ev, minfo)
+            for sv in (svs or []):
+                if sv is None:
+                    continue
+                spec_axes |= sv.axes
+                self._check_membership(call, sv, mesh_ax, module,
+                                       findings, vocab=vocab)
+        body_def = None
+        if isinstance(body, ast.Name):
+            body_def = self._resolve_local_def(module, body.id,
+                                               call.lineno)
+        elif isinstance(body, ast.Lambda):
+            body_def = body
+        if body_def is None:
+            return
+        # arity: a literal in_specs tuple must be satisfiable by the
+        # body's positional parameter list
+        a = body_def.args
+        if isinstance(in_specs, (ast.Tuple, ast.List)) \
+                and a.vararg is None:
+            n_params = len(getattr(a, "posonlyargs", [])) + len(a.args)
+            n_specs = len(in_specs.elts)
+            n_required = n_params - len(a.defaults)
+            if n_specs > n_params or n_specs < n_required:
+                findings.append(self.finding(
+                    module, call,
+                    f"shard_map in_specs has {n_specs} entries but the "
+                    f"body takes {n_params} positional parameter(s) — "
+                    f"the spec list drifted from the body signature"))
+        # collectives inside the body: axis names must be in scope
+        scope_ax = mesh_ax if mesh_ax is not None else None
+        self._check_collectives(body_def, scope_ax, spec_axes, vocab,
+                                ev, env, module, minfo, findings)
+
+    def _check_collectives(self, body_def, mesh_ax, spec_axes, vocab,
+                           ev: _Eval, env: _Env, module: Module, minfo,
+                           findings) -> None:
+        # the body is its own scope: params and locally-stored names
+        # shadow whatever the call-site env (or a module constant)
+        # says, so kill them before folding axis names — same policy
+        # check() applies to every other scope
+        env = env.copy()
+        a = getattr(body_def, "args", None)
+        if a is not None:
+            for p in (getattr(a, "posonlyargs", []) + a.args
+                      + a.kwonlyargs):
+                env.kill(p.arg)
+        for sub in ast.walk(body_def):
+            if isinstance(sub, ast.Name) and isinstance(
+                    sub.ctx, (ast.Store, ast.Del)):
+                env.kill(sub.id)
+        for node in ast.walk(body_def):
+            if not isinstance(node, ast.Call):
+                continue
+            dn = dotted_name(node.func)
+            leaf = dn.split(".")[-1] if dn else ""
+            pos = _COLLECTIVE_AXIS_POS.get(leaf)
+            if pos is None:
+                continue
+            if not ("lax." in dn or dn.startswith("lax")
+                    or "lax" in (minfo.imports.get(dn, "")
+                                 if "." not in dn else "")):
+                continue
+            axis_node = None
+            for kw in node.keywords:
+                if kw.arg == "axis_name":
+                    axis_node = kw.value
+            if axis_node is None and len(node.args) > pos:
+                axis_node = node.args[pos]
+            if axis_node is None:
+                continue
+            av = ev.axis_values(axis_node, env, minfo)
+            if av is None:
+                continue
+            if mesh_ax is not None:
+                bad = sorted(av - mesh_ax)
+                scope = f"this shard_map's mesh axes " \
+                        f"({', '.join(sorted(mesh_ax)) or 'none'})"
+            elif vocab is not None:
+                # spec axes are in scope inside this shard_map by
+                # construction; the union also keeps a non-vocab axis
+                # already reported at its P() constructor from being
+                # double-reported at every collective over it
+                bad = sorted(av - (vocab | spec_axes))
+                scope = (f"the configured mesh axis names "
+                         f"({', '.join(sorted(vocab))}) or this "
+                         f"shard_map's spec axes")
+            else:
+                continue
+            for a in bad:
+                findings.append(self.finding(
+                    module, node,
+                    f"collective {leaf}() over axis {a!r} which is "
+                    f"not among {scope} — an out-of-scope axis name "
+                    f"raises only when the shard_map actually runs "
+                    f"on a mesh"))
+
+    def _check_invocation(self, call: ast.Call, site: ast.Call,
+                          env: _Env, ienv: _ConstEnv, ev: _Eval,
+                          module: Module, minfo, findings) -> None:
+        _, _, in_specs, _ = self._shard_map_parts(site)
+        svs = self._specs_list(in_specs, env, ev, minfo)
+        if svs is None:
+            return
+        for i, arg in enumerate(call.args):
+            if isinstance(arg, ast.Starred) or i >= len(svs):
+                break
+            sv = svs[i]
+            if sv is None:
+                continue
+            ai = ev.array_info(arg, env, ienv)
+            if ai is None:
+                continue
+            self._check_binding(arg, sv, ai, module, findings,
+                                what=f"in_specs[{i}]")

@@ -5,15 +5,22 @@
 # count parsed from the dot-line output.
 #
 # Static pre-gate (fails fast before the test run): the fflint
-# TPU-hazard suite — host-sync dataflow, retrace hazards, Pallas tiling
-# invariants, metric-schema conformance, donation aliasing — over the
-# whole package + tools, against the checked-in baseline (empty: every
-# intentional hazard is inline-annotated instead).  Pure-AST, costs
-# milliseconds.  Rule catalog: docs/STATIC_ANALYSIS.md.  The old
+# TPU-hazard suite — host-sync dataflow (now cross-file via the symbol
+# graph), retrace hazards, Pallas tiling invariants, metric-schema
+# conformance, donation aliasing, whole-program sharding consistency
+# (shard-consistency) and thread/signal lock discipline
+# (lock-discipline) — over the whole package + tools, against the
+# checked-in baseline (empty: every intentional hazard is
+# inline-annotated instead, and stale annotations are themselves
+# findings).  New rules registered in tools/fflint/rules/__init__.py
+# are picked up automatically — this line never changes per rule.
+# Pure-AST two-pass run, a couple of seconds; --stats prints the
+# parse/graph/per-rule budget to stderr so a slow rule is visible in
+# CI logs.  Rule catalog: docs/STATIC_ANALYSIS.md.  The old
 # check_host_syncs.py / check_metrics_schema.py entrypoints remain as
 # shims over the same rules for external callers.
 (cd "$(dirname "$0")/.." \
- && python -m tools.fflint --baseline tools/fflint_baseline.json \
+ && python -m tools.fflint --stats --baseline tools/fflint_baseline.json \
         flexflow_tpu tools) || exit 1
 # Flight-recorder/ffstat smoke: exercises the post-mortem dump path
 # end-to-end (ring -> heartbeat -> bundle on disk -> pretty-print) so a
