@@ -209,6 +209,18 @@ def _note_mode_done(label: str, metrics):
     _PROGRESS["in_flight"] = None
     _PROGRESS["done"].append(label)
     _PROGRESS["metrics"].extend(metrics)
+    # snapshot the section's SLO window NOW: the next section's warmup
+    # clears the ledger, so under mode=all these per-section blocks
+    # are what survives of each section (the final arm's window for
+    # multi-arm sections — see _SLO_SECTIONS)
+    try:
+        from flexflow_tpu.observability import get_ledger
+
+        rep = get_ledger().slo_report()
+        if rep and rep.get("requests"):
+            _SLO_SECTIONS[label] = rep
+    except Exception:               # partial installs must not kill bench
+        pass
     _write_incremental()
 
 
@@ -251,6 +263,15 @@ _KV_DTYPE = None
 # every emitted JSON record by persist_record so trajectories can
 # attribute wins to the cache dtype (not just the prefix mode).
 _KV_NOTES = {}
+
+# per-section SLO reports (label -> slo block), captured at each
+# _note_mode_done BEFORE the next section's warmup clears the ledger
+# window; persist_record stamps them as `slo_sections`.  A section
+# with MULTIPLE serving arms (spec7b's inc-then-spec A/B, longctx's
+# flash/XLA twins) clears at EVERY arm's warmup boundary, so its block
+# covers the final arm's window — each block carries its own request
+# count, so a reader can see what it spans.
+_SLO_SECTIONS = {}
 
 
 def _note_kv(im, mid, label):
@@ -344,6 +365,7 @@ def bench_llama_decode():
         return sum(len(r.output_tokens) for r in results)
 
     run()  # warmup: compiles the prefill + decode shape buckets
+    _clear_ledger_window()
     # best of 5: the chip is reached over a network tunnel whose RTT
     # fluctuates bimodally (~0.1s vs ~0.7s periods); best-of reflects
     # steady-state serving throughput
@@ -441,6 +463,7 @@ def bench_llama7b_decode():
         return reqs
 
     run()   # warmup: compiles prefill + decode buckets
+    _clear_ledger_window()
     best, toks_exact = 0.0, None
     for _ in range(5):
         t0 = time.time()
@@ -639,6 +662,7 @@ def bench_spec_infer():
         return reqs
 
     run_spec(); run_inc()  # warmup: compile all shape buckets
+    _clear_ledger_window()
     best_spec, best_inc, ttfts = 0.0, 0.0, []
     spec_reqs = None
     for _ in range(5):
@@ -816,6 +840,7 @@ def bench_spec7b():
         return reqs
 
     run_inc()   # warmup
+    _clear_ledger_window()
     best_inc, inc_tokens = 0.0, None
     for _ in range(5):
         t0 = time.time()
@@ -866,6 +891,7 @@ def bench_spec7b():
         return reqs
 
     run_spec()  # warmup (compiles the 7B spec block)
+    _clear_ledger_window()
     best_spec, spec_reqs = 0.0, None
     for _ in range(5):
         t0 = time.time()
@@ -1298,6 +1324,7 @@ def bench_opt125m():
         return sum(len(r.output_tokens) for r in results)
 
     run()   # warmup
+    _clear_ledger_window()
     best = 0.0
     for _ in range(5):
         t0 = time.time()
@@ -1399,6 +1426,7 @@ def bench_longctx():
         return req.profile.ttft_s()
 
     run()   # warmup (compiles the prefill chunk buckets)
+    _clear_ledger_window()
     ttft = min(run() for _ in range(3))
     # A/B twin: same prompt with the flash-prefill kernel pinned off
     # (the XLA attend materializes the [C, H, bucket] f32 logits in HBM);
@@ -1407,6 +1435,7 @@ def bench_longctx():
     os.environ["FF_FLASH_PREFILL"] = "0"
     try:
         run()   # warmup the XLA-attend step variants
+        _clear_ledger_window()
         ttft_xla = min(run() for _ in range(2))
     finally:
         if prior is None:
@@ -1531,6 +1560,7 @@ def bench_longctx():
             return req.profile.ttft_s()
 
         run32()   # warmup (compiles the 32k-reach chunk buckets)
+        _clear_ledger_window()
         ttft32 = min(run32() for _ in range(2))
         im32.free_model(mid32)
         gc.collect()
@@ -1674,6 +1704,9 @@ def bench_prefix(model_builder=None, max_requests=4, system_len=512,
         return done, rm
 
     run(True)    # warmup: compiles cold-prefill, copy_prefix + tail buckets
+    _clear_ledger_window()  # warmup's compile-dominated requests must
+    # not contaminate the measured window (SLO attainment/goodput and
+    # ledger TTFT percentiles cover the cold+warm runs below only)
     cold_reqs, _ = run(False)
     warm_reqs, rm_on = run(True)
     _note_kv(im, mid, "prefix")
@@ -1775,6 +1808,7 @@ def bench_kv_dtype(model_builder=None, max_requests=8, prompt_len=32,
             return reqs
 
         serve()                      # warmup: compile the shape buckets
+        _clear_ledger_window()
         best_tps, reqs = 0.0, None
         for _ in range(3):
             t0 = time.time()
@@ -2176,6 +2210,60 @@ def _kv_summary():
     }
 
 
+def _install_slo(ttft_s, tpot_s):
+    """Install the per-request SLO policy on the process ledger
+    (``--slo-ttft``/``--slo-tpot`` or FF_BENCH_SLO_TTFT/_TPOT): every
+    serving section's retired requests are then evaluated against it
+    and persist_record stamps the ``slo`` block."""
+    if ttft_s is None and tpot_s is None:
+        return
+    try:
+        from flexflow_tpu.observability import SLOPolicy, get_ledger
+    except Exception as e:          # partial installs must not kill bench
+        print(f"bench: SLO ledger unavailable ({e})", file=sys.stderr)
+        return
+    get_ledger().set_slo_policy(SLOPolicy(ttft_s=ttft_s, tpot_s=tpot_s))
+
+
+def _clear_ledger_window():
+    """Reset the request ledger's retired window at a measurement
+    boundary (after a section's compile warmup): the `slo` block and
+    ledger-backed TTFT percentiles must cover measured requests only —
+    warmup requests retire with jit-compile-dominated TTFTs that would
+    read as SLO misses and stretch the goodput window."""
+    try:
+        from flexflow_tpu.observability import get_ledger
+    except Exception:               # pragma: no cover - partial installs
+        return
+    get_ledger().clear()
+
+
+def _slo_summary():
+    """The per-request SLO/goodput blocks for the round record: TTFT/
+    TPOT attainment fractions, goodput (tokens from SLO-attaining
+    requests per second of the retired window) and the slowest
+    request's full timeline — so a BENCH round claims latency
+    *attainment under the configured targets*, not just throughput.
+    Empty when no policy is installed (``--slo-ttft``/``--slo-tpot``).
+
+    ``slo`` covers the CURRENT retired window — the whole mode for a
+    single-mode run; under mode=all only the final section (each
+    section's warmup clears the window, _clear_ledger_window), so
+    ``slo_sections`` additionally carries the per-section blocks
+    captured at each section boundary (_note_mode_done)."""
+    try:
+        from flexflow_tpu.observability import get_ledger
+    except Exception:               # pragma: no cover - partial installs
+        return {}
+    out = {}
+    rep = get_ledger().slo_report()
+    if rep is not None:
+        out["slo"] = rep
+    if _SLO_SECTIONS:
+        out["slo_sections"] = dict(_SLO_SECTIONS)
+    return out
+
+
 def _telemetry_summary():
     """Serving-telemetry attribution for the round record: the FULL
     metrics-registry snapshot (queue depth, batch occupancy, kernel-path
@@ -2251,6 +2339,7 @@ def persist_record(result, mode: str):
               "fflint": _fflint_state(),
               **_kv_summary(),
               **tel,
+              **_slo_summary(),
               **_postmortem_fields(),
               "metrics": metrics}
     if "step_latency_percentiles" in tel:
@@ -2258,6 +2347,13 @@ def persist_record(result, mode: str):
         # committed record and the printed line cannot disagree
         result["step_latency_percentiles"] = tel[
             "step_latency_percentiles"]
+    slo = record.get("slo")
+    if slo and slo.get("requests"):
+        # compact attainment/goodput on stdout; the full block (incl.
+        # the slowest request's timeline) stays in the committed record
+        result["slo_attainment"] = slo.get("attainment")
+        result["slo_goodput_tokens_per_s"] = slo.get(
+            "goodput_tokens_per_s")
     prev_rounds = sorted(f for f in os.listdir(outdir)
                          if f.startswith("r") and f.endswith(".json")
                          and f < f"{rnd}.json")
@@ -2335,6 +2431,23 @@ if __name__ == "__main__":
              "decode cache HBM reads).  The `kvdtype` mode A/Bs both "
              "dtypes in one run regardless of this flag.")
     _ap.add_argument(
+        "--slo-ttft", type=float, metavar="SECONDS",
+        default=(float(os.environ["FF_BENCH_SLO_TTFT"])
+                 if os.environ.get("FF_BENCH_SLO_TTFT") else None),
+        help="per-request time-to-first-token SLO target (admit -> "
+             "first committed token).  With either --slo flag set, "
+             "every round record carries an `slo` block: TTFT/TPOT "
+             "attainment %%, goodput (tokens from attaining requests "
+             "per second) and the slowest request's timeline "
+             "(env FF_BENCH_SLO_TTFT)")
+    _ap.add_argument(
+        "--slo-tpot", type=float, metavar="SECONDS",
+        default=(float(os.environ["FF_BENCH_SLO_TPOT"])
+                 if os.environ.get("FF_BENCH_SLO_TPOT") else None),
+        help="per-request time-per-output-token SLO target (mean "
+             "inter-token gap after the first token; env "
+             "FF_BENCH_SLO_TPOT)")
+    _ap.add_argument(
         "--stderr-tail", type=int,
         default=int(os.environ.get("FF_BENCH_STDERR_TAIL", "4096")),
         metavar="BYTES",
@@ -2356,6 +2469,7 @@ if __name__ == "__main__":
     if _args.stall_timeout:
         os.environ["FF_BENCH_STALL_S"] = str(_args.stall_timeout)
     _PROGRESS["mode"] = _args.mode
+    _install_slo(_args.slo_ttft, _args.slo_tpot)
     _start_watchdog(_args.budget)
     try:
         if _args.mode == "all":

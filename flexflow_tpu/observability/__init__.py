@@ -21,8 +21,13 @@ structs stay as views; their values now also flow through here).
   of the same events plus host-sync/compile, the post-mortem black box.
 - :class:`Watchdog` (watchdog.py): stall detection off the driver
   :class:`Heartbeat` + SIGTERM/SIGUSR1 handlers, dumping bundles
-  (flight record + metrics + all-thread stacks + jax memory stats)
-  pretty-printed by ``tools/ffstat.py``.
+  (flight record + metrics + request ledger + all-thread stacks + jax
+  memory stats) pretty-printed by ``tools/ffstat.py``.
+- :class:`RequestLedger` (ledger.py): per-request lifecycle timelines
+  (enqueue/admit/prefill/commit/retire with per-request TTFT/TPOT) plus
+  :class:`SLOPolicy` attainment and goodput accounting, inspected by
+  ``tools/ffreq.py`` and surfaced via ``serve.LLM.request_timelines()``
+  / ``slo_report()``.
 
 ``FF_TELEMETRY=0`` disables the default registry AND the flight
 recorder at import (both become no-ops; tracing stays explicit-opt-in
@@ -34,6 +39,8 @@ from __future__ import annotations
 import os
 
 from .flight_recorder import FlightRecorder, get_flight_recorder
+from .ledger import (RequestLedger, SLOPolicy, get_ledger,
+                     slo_report_from, validate_slo_block)
 from .registry import (Counter, Gauge, Histogram, MetricsRegistry,
                        exp_buckets, prometheus_text)
 from .schema import EVENT_SCHEMA, METRICS_SCHEMA
@@ -44,8 +51,10 @@ from .watchdog import (Heartbeat, Watchdog, collect_bundle, dump_bundle,
 __all__ = [
     "Counter", "Gauge", "Histogram", "MetricsRegistry", "StepTracer",
     "FlightRecorder", "Watchdog", "Heartbeat",
+    "RequestLedger", "SLOPolicy",
     "METRICS_SCHEMA", "EVENT_SCHEMA", "EVENT_NAMES", "exp_buckets",
     "get_registry", "get_tracer", "get_flight_recorder", "get_heartbeat",
+    "get_ledger", "slo_report_from", "validate_slo_block",
     "collect_bundle", "dump_bundle", "metrics_snapshot",
     "prometheus_text", "set_telemetry_enabled",
 ]
@@ -73,7 +82,9 @@ def metrics_snapshot():
 
 
 def set_telemetry_enabled(enabled: bool):
-    """Runtime switch for the default registry AND the flight recorder
-    (the FF_TELEMETRY env var decides the import-time default)."""
+    """Runtime switch for the default registry, the flight recorder AND
+    the request ledger (the FF_TELEMETRY env var decides the
+    import-time default)."""
     _REGISTRY.enabled = bool(enabled)
     get_flight_recorder().enabled = bool(enabled)
+    get_ledger().enabled = bool(enabled)

@@ -1,0 +1,547 @@
+"""RequestLedger: per-request lifecycle timelines + SLO/goodput accounting.
+
+Everything else in this package is aggregate: the registry's TTFT/TPOT
+histograms mix every request together and the flight recorder's ring is
+batch-scoped.  The ROADMAP's async-serving item needs *per-request*
+SLO-goodput reporting (TTFT/TPOT attainment, not just throughput) — the
+reference likewise tracks each BatchConfig slot's request individually
+through admit/decode/commit (ProfileInfo, request_manager.h:244-250) so
+latency is attributable to a request, not a batch.  The ledger is that
+accounting layer: one timeline per request GUID, assembled from the
+same driver sites that feed the recorder/tracer, with an SLO policy
+evaluated per retired request and goodput (tokens from SLO-attaining
+requests per second) derived from the retired window.
+
+Design constraints (shared with the FlightRecorder):
+
+- **Near-zero cost when disabled** (``FF_TELEMETRY=0``): every
+  ``note_event`` starts with one attribute read and returns.
+- **Bounded memory always**: live timelines are bounded by the serving
+  queue itself plus a hard cap (oldest dropped, counted); retired
+  timelines live in a fixed-capacity ring; each timeline's event list
+  is a fixed-size ring of small dicts.
+- **Schema-validated names**: ``note_event`` names must be declared in
+  ``schema.EVENT_SCHEMA`` — the same vocabulary the recorder/tracer
+  use, and the fflint ``metric-schema`` rule checks the call sites
+  statically.
+- **Thread-safe**: drivers feed while bench harnesses snapshot and the
+  watchdog bundles from signal handlers; every touch takes the RLock
+  (re-entrant: ``snapshot()`` runs inside signal handlers that can
+  interrupt a mid-``note_event`` main thread).
+
+Event routing: a ``guid=`` event lands on that request's timeline
+(creating it lazily); a guid-less event (decode-step, prefill-chunk,
+spec-draft/verify, host-sync, compile) broadcasts to every ADMITTED
+in-flight timeline — a request's timeline contains the driver steps it
+lived through.  Lifecycle names get extra bookkeeping:
+
+- ``enqueue``   creates the timeline (queue entry stamp);
+- ``admit``     stamps ``admit_mono`` — the TTFT clock start (see
+  docs/OBSERVABILITY.md: TTFT measures admit -> first token, so a warm
+  prefix hit is credited for the prefill it skipped, not for queue
+  luck; enqueue -> admit is reported separately as ``queue_s``);
+- ``prefix-match`` records the matched prefix length;
+- ``commit``    accumulates committed tokens + stamps first/last
+  commit (inter-token gaps -> per-request TPOT);
+- ``retire``    finalizes: the driver passes the authoritative
+  ProfileInfo latencies (``ttft_s``/``tpot_s``/...) so ledger numbers
+  reconcile EXACTLY with the profile path (pinned by test), evaluates
+  the SLO policy, moves the timeline to the retired ring and updates
+  the ``serving_slo_*`` / ``serving_goodput_tokens_per_s`` gauges.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import os
+import threading
+import time
+from typing import Any, Dict, Iterable, List, Optional
+
+from .schema import EVENT_SCHEMA
+
+#: retired-timeline ring capacity (requests) / per-timeline event ring
+#: capacity (events) / live-timeline hard cap.  Env-overridable for
+#: the process-wide ledger via FF_LEDGER_RETIRED / FF_LEDGER_EVENTS /
+#: FF_LEDGER_LIVE.
+DEFAULT_RETIRED = 256
+DEFAULT_EVENTS = 128
+DEFAULT_LIVE = 4096
+
+
+@dataclasses.dataclass(frozen=True)
+class SLOPolicy:
+    """Per-request latency targets.  ``None`` disables that component.
+
+    - ``ttft_s``: time-to-first-token budget (admit -> first committed
+      token, host-observed monotonic).
+    - ``tpot_s``: time-per-output-token budget (mean inter-token gap
+      after the first token).
+
+    A request ATTAINS the SLO when every configured component holds.
+    A request that never produced a token fails a configured TTFT
+    target; a single-token request has no inter-token gap and passes
+    any TPOT target vacuously.
+    """
+
+    ttft_s: Optional[float] = None
+    tpot_s: Optional[float] = None
+
+    def evaluate(self, ttft_s: Optional[float],
+                 tpot_s: Optional[float]) -> Dict[str, bool]:
+        ttft_ok = (self.ttft_s is None
+                   or (ttft_s is not None and ttft_s <= self.ttft_s))
+        tpot_ok = (self.tpot_s is None
+                   or tpot_s is None or tpot_s <= self.tpot_s)
+        return {"ttft_ok": ttft_ok, "tpot_ok": tpot_ok,
+                "attained": ttft_ok and tpot_ok}
+
+
+def slo_report_from(timelines: Iterable[Dict[str, Any]],
+                    policy: SLOPolicy) -> Dict[str, Any]:
+    """Pure attainment + goodput report over RETIRED timeline dicts —
+    shared by the live ledger, ``tools/ffreq.py`` (dumped snapshots)
+    and the bench ``slo`` block, so all three agree by construction.
+
+    Goodput = tokens from SLO-attaining requests / the retired window's
+    wall span (first admit -> last retire, monotonic).  When the span
+    is unavailable (timelines without admit/retire stamps) the summed
+    latencies stand in, so the number stays finite and honest.
+    """
+    retired = [t for t in timelines if t.get("retired")]
+    n = len(retired)
+    out: Dict[str, Any] = {
+        "policy": {"ttft_s": policy.ttft_s, "tpot_s": policy.tpot_s},
+        "requests": n,
+    }
+    if not n:
+        out.update(attained=0, attainment=None, ttft_attainment=None,
+                   tpot_attainment=None, total_tokens=0,
+                   attained_tokens=0, window_s=0.0,
+                   goodput_tokens_per_s=0.0, slowest=None)
+        return out
+    ttft_ok = tpot_ok = attained = 0
+    tok_total = tok_attained = 0
+    t_lo, t_hi, lat_sum = float("inf"), float("-inf"), 0.0
+    slowest = None
+
+    def _slow_key(t):
+        # ttft_s=None means NO token was ever produced — the worst
+        # case, not the fastest: rank it above any finite TTFT
+        v = t.get("ttft_s")
+        return float("inf") if v is None else float(v)
+
+    for t in retired:
+        v = policy.evaluate(t.get("ttft_s"), t.get("tpot_s"))
+        ttft_ok += v["ttft_ok"]
+        tpot_ok += v["tpot_ok"]
+        attained += v["attained"]
+        toks = int(t.get("tokens") or 0)
+        tok_total += toks
+        if v["attained"]:
+            tok_attained += toks
+        a = t.get("admit_mono")
+        r = t.get("retire_mono")
+        if a is not None:
+            t_lo = min(t_lo, a)
+        if r is not None:
+            t_hi = max(t_hi, r)
+        lat_sum += float(t.get("latency_s") or 0.0)
+        if slowest is None or _slow_key(t) > _slow_key(slowest):
+            slowest = t
+    span = t_hi - t_lo if t_hi > t_lo else 0.0
+    window = max(span if span > 0 else lat_sum, 1e-9)
+    out.update(
+        attained=attained,
+        attainment=round(attained / n, 4),
+        ttft_attainment=round(ttft_ok / n, 4),
+        tpot_attainment=round(tpot_ok / n, 4),
+        total_tokens=tok_total,
+        attained_tokens=tok_attained,
+        window_s=round(window, 6),
+        goodput_tokens_per_s=round(tok_attained / window, 3),
+        slowest=slowest,
+    )
+    return out
+
+
+def validate_slo_block(block: Dict[str, Any]) -> List[str]:
+    """Structural check of an ``slo`` report block (bench records, ffreq
+    ``--slo``) — returns the list of violations (empty = valid).  The
+    runtime twin of the metric schema: a round record claiming goodput
+    must carry every field a trajectory reader parses."""
+    errs: List[str] = []
+    if not isinstance(block, dict):
+        return [f"slo block is {type(block).__name__}, expected dict"]
+    for key in ("policy", "requests", "attained", "attainment",
+                "ttft_attainment", "tpot_attainment", "total_tokens",
+                "attained_tokens", "window_s", "goodput_tokens_per_s",
+                "slowest"):
+        if key not in block:
+            errs.append(f"missing key {key!r}")
+    pol = block.get("policy")
+    if not (isinstance(pol, dict) and {"ttft_s", "tpot_s"} <= set(pol)):
+        errs.append("policy must carry ttft_s and tpot_s")
+    n = block.get("requests")
+    if not isinstance(n, int) or n < 0:
+        errs.append("requests must be a non-negative int")
+    if n:
+        for key in ("attainment", "ttft_attainment", "tpot_attainment"):
+            v = block.get(key)
+            if not (isinstance(v, (int, float)) and 0.0 <= v <= 1.0):
+                errs.append(f"{key} must be a 0..1 fraction, got {v!r}")
+        g = block.get("goodput_tokens_per_s")
+        if not (isinstance(g, (int, float)) and g >= 0):
+            errs.append(f"goodput_tokens_per_s must be >= 0, got {g!r}")
+        if not isinstance(block.get("slowest"), dict):
+            errs.append("slowest must be the slowest request's timeline")
+    return errs
+
+
+class RequestLedger:
+    """Thread-safe per-request lifecycle ledger (see module docstring)."""
+
+    def __init__(self, retired_capacity: int = DEFAULT_RETIRED,
+                 events_per_request: int = DEFAULT_EVENTS,
+                 live_capacity: int = DEFAULT_LIVE,
+                 enabled: bool = True,
+                 schema: Optional[Dict[str, Dict]] = EVENT_SCHEMA):
+        self.retired_capacity = max(1, int(retired_capacity))
+        self.events_per_request = max(8, int(events_per_request))
+        self.live_capacity = max(1, int(live_capacity))
+        self.enabled = enabled
+        self._names = frozenset(schema) if schema is not None else None
+        # RLock, not Lock: snapshot() runs inside watchdog signal
+        # handlers, which execute at an arbitrary bytecode boundary of
+        # the main thread — if that thread is mid-note_event, a plain
+        # Lock would self-deadlock the dump (fflint lock-discipline)
+        self._lock = threading.RLock()
+        self._live: "collections.OrderedDict[int, Dict]" = \
+            collections.OrderedDict()
+        # admitted-but-not-retired subset of _live: guid-less broadcast
+        # events land on these, and they arrive once per driver-loop
+        # phase — indexing the <= batch-size admitted set keeps the
+        # broadcast O(batch) instead of O(pending queue depth)
+        self._admitted: Dict[int, Dict] = {}
+        self._retired: "collections.OrderedDict[int, Dict]" = \
+            collections.OrderedDict()
+        self._retired_dropped = 0
+        self._live_dropped = 0
+        self._policy: Optional[SLOPolicy] = None
+
+    # ---------------------------------------------------------------- feed
+    def note_event(self, name: str, guid: Optional[int] = None,
+                   **payload: Any) -> None:
+        """Feed one lifecycle event; no-op when disabled (one attribute
+        read).  Unknown names raise ``ValueError`` — declare new events
+        in ``observability/schema.py::EVENT_SCHEMA`` first (the fflint
+        ``metric-schema`` rule checks these call sites statically, same
+        as ``record_event``).  ``guid=None`` broadcasts to every
+        admitted in-flight timeline."""
+        if not self.enabled:
+            return
+        if self._names is not None and name not in self._names:
+            raise ValueError(
+                f"ledger event {name!r} is not declared in "
+                f"observability/schema.py EVENT_SCHEMA — declare it "
+                f"(with help text) before emitting it")
+        with self._lock:
+            now = time.monotonic()
+            if guid is None:
+                for t in self._admitted.values():
+                    self._append(t, now, name, payload)
+                return
+            t = self._live.get(guid)
+            if t is None:
+                if name == "retire" or guid in self._retired:
+                    return          # late event for an already-gone guid
+                t = self._new_timeline(guid, now, payload)
+                if name != "enqueue":
+                    # a driver feeding a request the ledger never saw
+                    # enqueued (enabled mid-run): lazily created above
+                    t["enqueue_mono"] = None
+            self._append(t, now, name, payload)
+            retired_with_policy = False
+            if name == "admit":
+                t["admit_mono"] = now
+                t["row"] = payload.get("row")
+                self._admitted[t["guid"]] = t
+                if t["enqueue_mono"] is not None:
+                    t["queue_s"] = now - t["enqueue_mono"]
+            elif name == "prefix-match":
+                t["prefix_matched"] = int(payload.get("matched", 0))
+            elif name == "commit":
+                n = int(payload.get("tokens", 0))
+                t["committed"] += n
+                t["commit_events"] += 1
+                t["accepted"] += int(payload.get("accepted", 0))
+                if n > 0:
+                    if t["first_commit_mono"] is None:
+                        t["first_commit_mono"] = now
+                        t["first_commit_tokens"] = n
+                    t["last_commit_mono"] = now
+            elif name == "retire":
+                self._finalize(t, now, payload)
+                retired_with_policy = self._policy is not None
+        if retired_with_policy:
+            # gauges refresh OUTSIDE the ledger lock (the report itself
+            # briefly re-takes it): registry-lock acquisition must never
+            # happen with the ledger lock held, or a future registry ->
+            # ledger call path would deadlock
+            self._update_slo_gauges()
+
+    def _new_timeline(self, guid: int, now: float,
+                      payload: Dict[str, Any]) -> Dict[str, Any]:
+        # re-entrant re-acquire (already held by note_event): every
+        # guarded-field touch sits lexically under the lock, which is
+        # both what the fflint lock-discipline rule checks and what
+        # keeps this helper safe if ever called from a new site
+        with self._lock:
+            while len(self._live) >= self.live_capacity:
+                evicted_guid, _ = self._live.popitem(last=False)
+                self._admitted.pop(evicted_guid, None)
+                self._live_dropped += 1
+            t = self._blank_timeline(guid, now, payload)
+            self._live[guid] = t
+            return t
+
+    def _blank_timeline(self, guid: int, now: float,
+                        payload: Dict[str, Any]) -> Dict[str, Any]:
+        return {
+            "guid": guid,
+            "prompt_len": payload.get("prompt_len"),
+            "enqueue_wall": time.time(),
+            "enqueue_mono": now,
+            "admit_mono": None, "row": None, "queue_s": None,
+            "prefix_matched": 0,
+            "committed": 0, "commit_events": 0,
+            "first_commit_mono": None, "first_commit_tokens": 0,
+            "last_commit_mono": None,
+            "accepted": 0, "speculated": 0,
+            "retired": False, "retire_mono": None,
+            "tokens": None, "ttft_s": None, "tpot_s": None,
+            "latency_s": None, "slo": None,
+            "events": collections.deque(maxlen=self.events_per_request),
+            "events_dropped": 0,
+        }
+
+    def _append(self, t: Dict, now: float, name: str,
+                payload: Dict[str, Any]) -> None:
+        ev = {k: v for k, v in payload.items() if k != "prompt_len"}
+        ev["name"] = name
+        ev["t"] = now
+        if len(t["events"]) == t["events"].maxlen:
+            t["events_dropped"] += 1
+        t["events"].append(ev)
+
+    def _finalize(self, t: Dict, now: float,
+                  payload: Dict[str, Any]) -> None:
+        # re-entrant re-acquire — see _new_timeline
+        with self._lock:
+            t["retired"] = True
+            t["retire_mono"] = now
+            t["tokens"] = int(payload.get("tokens", t["committed"]))
+            t["accepted"] = int(payload.get("accepted", t["accepted"]))
+            t["speculated"] = int(payload.get("speculated",
+                                              t["speculated"]))
+            if payload.get("prefix_matched") is not None:
+                t["prefix_matched"] = int(payload["prefix_matched"])
+            # the driver passes the authoritative ProfileInfo stamps so
+            # the ledger and profile paths reconcile exactly; own stamps
+            # are the fallback for feeds outside a RequestManager
+            # (tests, ffreq)
+            t["ttft_s"] = payload.get("ttft_s", self._own_ttft(t))
+            t["tpot_s"] = payload.get("tpot_s", self._own_tpot(t))
+            if payload.get("latency_s") is not None:
+                t["latency_s"] = float(payload["latency_s"])
+            elif t["admit_mono"] is not None:
+                t["latency_s"] = now - t["admit_mono"]
+            if payload.get("queue_s") is not None:
+                t["queue_s"] = float(payload["queue_s"])
+            if self._policy is not None:
+                t["slo"] = self._policy.evaluate(t["ttft_s"], t["tpot_s"])
+            self._live.pop(t["guid"], None)
+            self._admitted.pop(t["guid"], None)
+            self._retired[t["guid"]] = t
+            while len(self._retired) > self.retired_capacity:
+                self._retired.popitem(last=False)
+                self._retired_dropped += 1
+
+    @staticmethod
+    def _own_ttft(t: Dict) -> Optional[float]:
+        start = (t["admit_mono"] if t["admit_mono"] is not None
+                 else t["enqueue_mono"])
+        if t["first_commit_mono"] is None or start is None:
+            return None
+        return t["first_commit_mono"] - start
+
+    @staticmethod
+    def _own_tpot(t: Dict) -> Optional[float]:
+        gap_tokens = t["committed"] - t["first_commit_tokens"]
+        if (t["first_commit_mono"] is None or gap_tokens <= 0
+                or t["last_commit_mono"] is None):
+            return None
+        return (t["last_commit_mono"] - t["first_commit_mono"]) / gap_tokens
+
+    def _update_slo_gauges(self) -> None:
+        """Refresh the serving_slo_* / goodput gauges from the retired
+        window — called by note_event AFTER releasing the ledger lock
+        (the report scan below takes it briefly; the registry-lock
+        acquisitions in the gauge writes never overlap a ledger-lock
+        hold).  Cost is one O(retired_capacity) scan per RETIREMENT —
+        bounded at 256 small dicts by default and far rarer than
+        per-step feeds; running O(1) aggregates would need
+        eviction-time window adjustment for the admit/retire bounds —
+        not worth it at this cap."""
+        with self._lock:
+            pol = self._policy
+            if pol is None:
+                return
+            rep = slo_report_from(self._retired.values(), pol)
+        if not rep["requests"]:
+            return
+        try:
+            from . import get_registry
+        except ImportError:         # pragma: no cover - partial install
+            return
+        m = get_registry()
+        m.gauge("serving_slo_attainment").set(rep["attainment"])
+        m.gauge("serving_slo_ttft_attainment").set(rep["ttft_attainment"])
+        m.gauge("serving_slo_tpot_attainment").set(rep["tpot_attainment"])
+        m.gauge("serving_goodput_tokens_per_s").set(
+            rep["goodput_tokens_per_s"])
+
+    # ---------------------------------------------------------------- read
+    def set_slo_policy(self, policy: Optional[SLOPolicy]) -> None:
+        with self._lock:
+            self._policy = policy
+
+    def slo_policy(self) -> Optional[SLOPolicy]:
+        with self._lock:
+            return self._policy
+
+    def in_flight_guids(self) -> List[int]:
+        """GUIDs admitted but not retired (stall suspects — ffstat
+        names these in its bundle diagnosis)."""
+        with self._lock:
+            return list(self._admitted)
+
+    def timeline(self, guid: int) -> Optional[Dict[str, Any]]:
+        """JSON-serializable copy of one request's timeline (live or
+        retired), or None."""
+        with self._lock:
+            t = self._live.get(guid) or self._retired.get(guid)
+            return self._export(t) if t is not None else None
+
+    def timelines(self, include_live: bool = True,
+                  include_retired: bool = True) -> List[Dict[str, Any]]:
+        with self._lock:
+            out = []
+            if include_retired:
+                out.extend(self._export(t)
+                           for t in self._retired.values())
+            if include_live:
+                out.extend(self._export(t) for t in self._live.values())
+            return out
+
+    def ttft_of(self, guid: int) -> Optional[float]:
+        with self._lock:
+            t = self._retired.get(guid) or self._live.get(guid)
+            if t is None:
+                return None
+            return t["ttft_s"] if t["retired"] else self._own_ttft(t)
+
+    def committed_of(self, guid: int) -> Optional[int]:
+        with self._lock:
+            t = self._retired.get(guid) or self._live.get(guid)
+            return None if t is None else t["committed"]
+
+    def committed_total(self, retired_only: bool = False) -> int:
+        """Sum of committed tokens across timelines — the reconciliation
+        quantity: over retired requests it must equal the
+        ``serving_tokens_generated_total`` counter (asserted per driver
+        in tests/test_ledger.py)."""
+        with self._lock:
+            total = sum(t["committed"] for t in self._retired.values())
+            if not retired_only:
+                total += sum(t["committed"] for t in self._live.values())
+            return total
+
+    def slo_report(self, policy: Optional[SLOPolicy] = None
+                   ) -> Optional[Dict[str, Any]]:
+        """Attainment + goodput over the retired window; ``policy``
+        overrides the installed one (ad-hoc what-if reports).  None
+        when no policy is configured anywhere."""
+        with self._lock:
+            pol = policy or self._policy
+            if pol is None:
+                return None
+            return slo_report_from(
+                [self._export(t) for t in self._retired.values()], pol)
+
+    @staticmethod
+    def _export(t: Dict[str, Any]) -> Dict[str, Any]:
+        out = dict(t)
+        out["events"] = list(t["events"])
+        return out
+
+    def snapshot(self) -> Dict[str, Any]:
+        """JSON-serializable dump (the ``ledger`` section of a watchdog
+        bundle; the input ``tools/ffreq.py`` reads)."""
+        with self._lock:
+            return {
+                "retired_capacity": self.retired_capacity,
+                "events_per_request": self.events_per_request,
+                "retired_dropped": self._retired_dropped,
+                "live_dropped": self._live_dropped,
+                "policy": (dataclasses.asdict(self._policy)
+                           if self._policy is not None else None),
+                "live": [self._export(t) for t in self._live.values()],
+                "retired": [self._export(t)
+                            for t in self._retired.values()],
+            }
+
+    def clear(self) -> None:
+        with self._lock:
+            self._live.clear()
+            self._admitted.clear()
+            self._retired.clear()
+            self._retired_dropped = 0
+            self._live_dropped = 0
+            pol = self._policy
+        if pol is None:
+            return
+        # the gauges describe the retired window just emptied (e.g. a
+        # bench measurement-boundary clear dropping warmup requests):
+        # zero them so metrics_snapshot()/expose_text() and slo_report()
+        # cannot disagree about whether a window exists.  Outside the
+        # ledger lock, like _update_slo_gauges.
+        try:
+            from . import get_registry
+        except ImportError:         # pragma: no cover - partial install
+            return
+        m = get_registry()
+        m.gauge("serving_slo_attainment").set(0.0)
+        m.gauge("serving_slo_ttft_attainment").set(0.0)
+        m.gauge("serving_slo_tpot_attainment").set(0.0)
+        m.gauge("serving_goodput_tokens_per_s").set(0.0)
+
+
+_LEDGER = RequestLedger(
+    retired_capacity=int(os.environ.get("FF_LEDGER_RETIRED",
+                                        str(DEFAULT_RETIRED))
+                         or DEFAULT_RETIRED),
+    events_per_request=int(os.environ.get("FF_LEDGER_EVENTS",
+                                          str(DEFAULT_EVENTS))
+                           or DEFAULT_EVENTS),
+    live_capacity=int(os.environ.get("FF_LEDGER_LIVE",
+                                     str(DEFAULT_LIVE))
+                      or DEFAULT_LIVE),
+    enabled=os.environ.get("FF_TELEMETRY", "1") != "0")
+
+
+def get_ledger() -> RequestLedger:
+    """The process-wide request ledger (always allocated; inert when
+    FF_TELEMETRY=0)."""
+    return _LEDGER

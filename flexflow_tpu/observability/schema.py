@@ -169,6 +169,33 @@ METRICS_SCHEMA = {
         "help": "HBM pinned by a compiled record's KV caches (K + V + "
                 "scales at the padded allocation), labeled model=<id>.",
     },
+    # ------------------------------------------------- SLO / goodput
+    # (per-request ledger, observability/ledger.py: evaluated per
+    # retired request against the installed SLOPolicy; all four refresh
+    # together at each retirement over the retired-request window)
+    "serving_slo_attainment": {
+        "type": "gauge",
+        "help": "Fraction of retired requests meeting EVERY configured "
+                "SLO component (TTFT and TPOT targets), over the "
+                "ledger's retired window.",
+    },
+    "serving_slo_ttft_attainment": {
+        "type": "gauge",
+        "help": "Fraction of retired requests whose admit->first-token "
+                "latency met the SLOPolicy ttft_s target.",
+    },
+    "serving_slo_tpot_attainment": {
+        "type": "gauge",
+        "help": "Fraction of retired requests whose mean inter-token "
+                "gap met the SLOPolicy tpot_s target.",
+    },
+    "serving_goodput_tokens_per_s": {
+        "type": "gauge",
+        "help": "Tokens from SLO-attaining retired requests per second "
+                "of the retired window (first admit -> last retire) — "
+                "the ROADMAP async-serving headline: throughput that "
+                "actually met latency targets, not just throughput.",
+    },
     # --------------------------------------------------- pipeline serving
     "serving_pp_stage_dispatches_total": {
         "type": "counter",
@@ -186,9 +213,15 @@ METRICS_SCHEMA = {
 # record time and fflint's metric-schema rule validates the
 # record_event(...) call sites statically.
 EVENT_SCHEMA = {
+    "enqueue": {
+        "help": "Request registered into the pending queue (guid, "
+                "prompt_len) — the ledger's timeline birth; enqueue->"
+                "admit is the queue-wait component of latency.",
+    },
     "admit": {
         "help": "Request admitted into a batch row (guid, row, "
-                "prompt_len).",
+                "prompt_len).  The TTFT clock starts HERE (not at "
+                "enqueue) — see docs/OBSERVABILITY.md.",
     },
     "prefix-match": {
         "help": "Pooled prefix matched at admission (guid, matched, "
@@ -210,6 +243,12 @@ EVENT_SCHEMA = {
     },
     "commit": {
         "help": "Tokens committed to a request (guid, tokens, accepted).",
+    },
+    "retire": {
+        "help": "Request retired — EOS or length budget (guid, tokens; "
+                "the ledger feed additionally carries the authoritative "
+                "ProfileInfo latencies: ttft_s, tpot_s, latency_s, "
+                "queue_s, accepted, speculated, prefix_matched).",
     },
     "donate": {
         "help": "Retired row donated to the prefix pool (guid, slot, "

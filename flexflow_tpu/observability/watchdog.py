@@ -149,6 +149,8 @@ def collect_bundle(reason: str, heartbeat: Optional[Heartbeat] = None,
         from . import get_registry
 
         registry = get_registry()
+    from .ledger import get_ledger
+
     bundle = {
         "bundle_version": 1,
         "reason": reason,
@@ -158,6 +160,10 @@ def collect_bundle(reason: str, heartbeat: Optional[Heartbeat] = None,
         "last_heartbeat": hb.state(),
         "flight_record": rec.snapshot(),
         "metrics": registry.snapshot(),
+        # per-request lifecycle state: in-flight (non-retired) entries
+        # are the stall suspects — ffstat names their GUIDs, ffreq
+        # prints their full timelines
+        "ledger": get_ledger().snapshot(),
         "threads": _thread_stacks(),
         "jax": _jax_stats(),
     }

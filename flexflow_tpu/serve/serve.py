@@ -445,6 +445,39 @@ class LLM:
 
         return get_flight_recorder().events(last=last)
 
+    def request_timelines(self, include_live: bool = True,
+                          include_retired: bool = True) -> List[Dict]:
+        """Per-request lifecycle timelines from the request ledger
+        (observability/ledger.py): one dict per GUID with
+        enqueue/admit/prefix-match/prefill/commit/retire stamps,
+        per-request TTFT/TPOT and the bounded event ring — the
+        per-request twin of :meth:`metrics_snapshot`'s aggregates.
+        Inspect dumps with ``tools/ffreq.py``; see
+        docs/OBSERVABILITY.md "Request lifecycle & SLO accounting"."""
+        from ..observability import get_ledger
+
+        return get_ledger().timelines(include_live=include_live,
+                                      include_retired=include_retired)
+
+    def slo_report(self, ttft_s: Optional[float] = None,
+                   tpot_s: Optional[float] = None) -> Optional[Dict]:
+        """SLO attainment + goodput over the ledger's retired window.
+        With ``ttft_s``/``tpot_s`` given, evaluates that ad-hoc
+        :class:`~flexflow_tpu.observability.SLOPolicy`; otherwise uses
+        the installed policy (``get_ledger().set_slo_policy``), and
+        returns None when neither exists.  Goodput = tokens from
+        SLO-attaining requests per second of the retired window — the
+        ROADMAP's "TTFT/TPOT attainment, not just throughput".
+
+        >>> llm.generate(prompts)
+        >>> llm.slo_report(ttft_s=0.5, tpot_s=0.05)["attainment"]
+        """
+        from ..observability import SLOPolicy, get_ledger
+
+        policy = (SLOPolicy(ttft_s=ttft_s, tpot_s=tpot_s)
+                  if (ttft_s is not None or tpot_s is not None) else None)
+        return get_ledger().slo_report(policy)
+
     def watchdog(self, stall_timeout: float = 120.0,
                  bundle_dir: Optional[str] = None,
                  signals: tuple = ("SIGTERM", "SIGUSR1"), **kwargs):

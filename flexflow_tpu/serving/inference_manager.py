@@ -30,7 +30,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec
 from ..config import (AXIS_DATA, AXIS_EXPERT, AXIS_MODEL, AXIS_PIPE,
                       AXIS_SEQ, FFConfig)
 from ..fftype import InferenceMode, OpType
-from ..observability import get_flight_recorder, get_registry, get_tracer
+from ..observability import (get_flight_recorder, get_ledger,
+                             get_registry, get_tracer)
 from ..ops.registry import OpContext, get_op
 from .batch_config import (BatchConfig, BeamSearchBatchConfig,
                            InferenceResult, TreeVerifyBatchConfig)
@@ -465,6 +466,10 @@ class InferenceManager:
         self._registry = m
         self.tracer = get_tracer()
         self.recorder = get_flight_recorder()
+        # per-request ledger: guid-less feeds here broadcast to every
+        # admitted in-flight timeline (a request's timeline carries the
+        # syncs/compiles it lived through)
+        self.ledger = get_ledger()
         self._c_host_syncs = m.counter("serving_host_syncs_total")
         self._c_kernel_path = m.counter("serving_kernel_path_total")
         self._c_pp_dispatch = m.counter("serving_pp_stage_dispatches_total")
@@ -481,6 +486,7 @@ class InferenceManager:
         # is a blocked device fetch (dead tunnel), vs ending on a
         # dispatch event (hung compile / collective)
         self.recorder.record_event("host-sync", n=n)
+        self.ledger.note_event("host-sync", n=n)
 
     # ------------------------------------------------------------ compile
     def compile_model_and_allocate_buffer(
@@ -630,6 +636,8 @@ class InferenceManager:
             self.kv_cache_stats(mid).bytes_resident, model=mid)
         self.recorder.record_event("compile", model=mid, mode=str(mode),
                                    rows=rows, alloc_len=alloc_len)
+        self.ledger.note_event("compile", model=mid, mode=str(mode),
+                               rows=rows, alloc_len=alloc_len)
         return mid
 
     def _compile_pipeline_model(self, model, mode, max_requests,
@@ -656,6 +664,8 @@ class InferenceManager:
             self.kv_cache_stats(mid).bytes_resident, model=mid)
         self.recorder.record_event("compile", model=mid, mode=str(mode),
                                    rows=rows, alloc_len=alloc_len, pp=True)
+        self.ledger.note_event("compile", model=mid, mode=str(mode),
+                               rows=rows, alloc_len=alloc_len, pp=True)
         return mid
 
     def rewiden_beam(self, model_id: int, beam_width: int) -> None:

@@ -346,6 +346,7 @@ def pipeline_decode_block(im, record, model_id: int, bc, k: int, rng,
                                 _record_flash_tile(record)))
     im.count_kernel_path(record, 1, gate_ok, use_flash)
     im.recorder.record_event("decode-step", block=k, pp=pp, groups=M)
+    im.ledger.note_event("decode-step", block=k, pp=pp, groups=M)
 
     # jitted per-stage chunk-1 steps (shared with the per-token path
     # except for the group row count)
@@ -503,8 +504,11 @@ def pipeline_inference(im, record, model_id: int, batch, rng) -> List[Any]:
     if chunk > 1:
         im.recorder.record_event("prefill-chunk", chunk=chunk,
                                  pp=len(stages))
+        im.ledger.note_event("prefill-chunk", chunk=chunk,
+                             pp=len(stages))
     else:
         im.recorder.record_event("decode-step", chunk=1, pp=len(stages))
+        im.ledger.note_event("decode-step", chunk=1, pp=len(stages))
     for s in range(len(stages)):
         key = ("pp_step", s, chunk, use_flash)
         if key not in record["pp_steps"]:

@@ -25,6 +25,7 @@ from __future__ import annotations
 
 import collections
 import dataclasses
+import itertools
 import os
 import time
 from typing import Any, Deque, Dict, List, Optional, Sequence, Tuple
@@ -34,7 +35,7 @@ import numpy as np
 
 from ..fftype import InferenceMode
 from ..observability import (get_flight_recorder, get_heartbeat,
-                             get_registry, get_tracer)
+                             get_ledger, get_registry, get_tracer)
 from .batch_config import BatchConfig, InferenceResult, pick_chunk
 from .inference_manager import InferenceManager
 from .prefix_cache import PrefixCache
@@ -83,12 +84,22 @@ class ProfileInfo:
     ssm_prefill_rows: int = 0
     # prompt tokens whose KV came from the prefix cache (prefill skipped)
     prefix_matched_tokens: int = 0
-    # wall-clock admission stamp (time.time()) — LOGGING ONLY.  Every
+    # wall-clock registration stamp (time.time()) — LOGGING ONLY.  Every
     # latency delta below uses the monotonic twin: time.time() jumps
     # under NTP slew, so a wall-clock TTFT can come out negative (or
     # minutes long) on a freshly-synced serving host.
     start_time: float = 0.0
     start_mono: float = 0.0
+    # monotonic stamp of batch-slot ADMISSION — the TTFT clock start.
+    # TTFT used to run from registration (start_mono), which silently
+    # folded queue wait into it: a warm prefix-cache hit admitted late
+    # measured WORSE than a cold request admitted instantly, inverting
+    # the prefix A/B under load.  TTFT now measures admit -> first
+    # token (the serving-latency component the driver controls);
+    # enqueue -> admit is reported separately (queue_wait_s, ledger
+    # ``queue_s``).  0.0 = not admitted yet (ttft_s falls back to
+    # start_mono for requests measured outside the admission path).
+    admit_mono: float = 0.0
     # host-observed monotonic stamp of the first generated token (the
     # p50-TTFT ingredient, BASELINE.md north-star metric); under decode
     # blocks this is the first block's sync — what a streaming server
@@ -101,13 +112,22 @@ class ProfileInfo:
             self.first_token_time = time.monotonic()
 
     def ttft_s(self) -> Optional[float]:
-        """Monotonic time-to-first-token; None before the first token."""
+        """Monotonic time-to-first-token measured from ADMISSION (see
+        ``admit_mono``); None before the first token."""
         if self.first_token_time == 0.0:
             return None
-        return self.first_token_time - self.start_mono
+        return self.first_token_time - (self.admit_mono
+                                        or self.start_mono)
+
+    def queue_wait_s(self) -> Optional[float]:
+        """Monotonic enqueue-to-admission wait; None before admission."""
+        if self.admit_mono == 0.0:
+            return None
+        return self.admit_mono - self.start_mono
 
     def latency_s(self) -> float:
-        """Monotonic admission-to-finish latency."""
+        """Monotonic registration-to-finish latency (queue wait
+        included; subtract queue_wait_s for the admitted span)."""
         return self.finish_time - self.start_mono
 
 
@@ -140,6 +160,15 @@ class Request:
                    - len(self.tokens))
 
 
+# PROCESS-WIDE guid allocator (CPython next() on a count is atomic):
+# guids key the request ledger's timelines, so two RequestManager
+# instances in one process (a bench A/B's two arms, test suites) must
+# never mint the same guid — the per-instance counters that used to
+# restart at 1000000 made the second arm's ledger entries silently
+# overwrite the first's, corrupting cross-arm TTFT comparisons.
+_GUID_COUNTER = itertools.count(1000000)
+
+
 class RequestManager:
     """Singleton-style manager (reference request_manager.cc:2075 —
     instantiable here; `get_request_manager()` returns a process-wide one)."""
@@ -164,8 +193,6 @@ class RequestManager:
         self.pending: Deque[Request] = collections.deque()
         self.running: Dict[int, Request] = {}   # row -> Request
         self.completed: Dict[int, Request] = {}
-        self.next_guid = 1000000
-        self.next_available_guid = self.next_guid
         self.ssm_model_ids: List[int] = []
         self._dumped_guids: set = set()
         self._rng = np.random.default_rng(0)
@@ -197,6 +224,11 @@ class RequestManager:
         # and device-spec alike (observability/watchdog.py)
         self.recorder = get_flight_recorder()
         self.heartbeat = get_heartbeat()
+        # per-request lifecycle ledger (observability/ledger.py): fed
+        # beside the recorder/tracer sites with guid-scoped events so
+        # latency is attributable to a request, not a batch; inert
+        # under FF_TELEMETRY=0 like the recorder
+        self.ledger = get_ledger()
         self._m_queue_depth = m.gauge("serving_queue_depth")
         self._m_active = m.gauge("serving_active_requests")
         self._m_occupancy = m.gauge("serving_batch_occupancy")
@@ -248,10 +280,11 @@ class RequestManager:
         max_len = max_sequence_length or self.max_sequence_length
         if len(tokens) >= max_len:
             tokens = tokens[: max_len - 1]
-        req = Request(self.next_available_guid, text, tokens,
+        req = Request(next(_GUID_COUNTER), text, tokens,
                       max_new_tokens, max_len)
-        self.next_available_guid += 1
         self.pending.append(req)
+        self.ledger.note_event("enqueue", guid=req.guid,
+                               prompt_len=req.prompt_len)
         return req
 
     # ------------------------------------------------------- batch update
@@ -314,6 +347,10 @@ class RequestManager:
             req.status = Request.RUNNING
             req.row = row
             req.cached_len = 0
+            # the TTFT clock starts at admission (ProfileInfo.admit_mono
+            # docstring explains the warm-prefix queue-wait ambiguity
+            # this fixes)
+            req.profile.admit_mono = time.monotonic()
             self.running[row] = req
             matched: Dict[int, int] = {}
             if entry is not None and d:
@@ -348,6 +385,8 @@ class RequestManager:
                     self.recorder.record_event(
                         "prefix-match", guid=req.guid, row=row,
                         matched=best)
+                    self.ledger.note_event("prefix-match", guid=req.guid,
+                                           row=row, matched=best)
             if primary is not None:
                 req.cached_len = matched.get(primary, 0)
             self._m_admitted.inc()
@@ -355,6 +394,8 @@ class RequestManager:
                                 prompt_len=req.prompt_len)
             self.recorder.record_event("admit", guid=req.guid, row=row,
                                        prompt_len=req.prompt_len)
+            self.ledger.note_event("admit", guid=req.guid, row=row,
+                                   prompt_len=req.prompt_len)
             admitted.append((req, matched))
         self._m_queue_depth.set(len(self.pending))
         self._m_active.set(len(self.running))
@@ -382,6 +423,8 @@ class RequestManager:
                                 length=length)
             self.recorder.record_event("donate", guid=req.guid,
                                        slot=slot, length=length)
+            self.ledger.note_event("donate", guid=req.guid, slot=slot,
+                                   length=length)
         return ok
 
     def _finished(self, req: Request, new_token: int) -> bool:
@@ -403,11 +446,22 @@ class RequestManager:
         n_out = len(req.tokens) - req.prompt_len
         self._m_tokens.inc(n_out)
         ttft = p.ttft_s()
+        tpot = None
         if ttft is not None:
             self._m_ttft.observe(ttft)
             if n_out > 1:
-                self._m_tpot.observe((p.finish_time - p.first_token_time)
-                                     / (n_out - 1))
+                tpot = (p.finish_time - p.first_token_time) / (n_out - 1)
+                self._m_tpot.observe(tpot)
+        # ledger finalization: the SAME ProfileInfo latencies the
+        # histograms observed, so per-request and aggregate accounting
+        # reconcile exactly (pinned by tests/test_ledger.py)
+        self.recorder.record_event("retire", guid=req.guid, tokens=n_out)
+        self.ledger.note_event(
+            "retire", guid=req.guid, tokens=n_out, ttft_s=ttft,
+            tpot_s=tpot, latency_s=p.latency_s(),
+            queue_s=p.queue_wait_s(), accepted=p.accepted_tokens,
+            speculated=p.speculated_tokens,
+            prefix_matched=p.prefix_matched_tokens)
         if p.speculated_tokens > 0:
             self._m_spec_draft.inc(p.speculated_tokens)
             self._m_spec_accept.inc(p.accepted_tokens)
@@ -448,6 +502,8 @@ class RequestManager:
                     tok = int(prev_result.token_ids[row, n - 1])
                     req.tokens.append(tok)
                     req.profile.note_first_token()
+                    self.ledger.note_event("commit", guid=req.guid,
+                                           tokens=1)
                     if self._finished(req, tok):
                         self._retire(req)
 
@@ -511,17 +567,28 @@ class RequestManager:
             req = self.running[row]
             if not bc.request_available[row]:
                 continue
+            n_row = 0
+            done = False
             for i in range(k):
                 if not (handoff and i == 0):
                     req.cached_len += 1
                     req.profile.llm_decoding_steps += 1
                 tok = int(toks[i, row])
                 req.tokens.append(tok)
-                appended += 1
+                n_row += 1
                 req.profile.note_first_token()
                 if self._finished(req, tok):
-                    self._retire(req)
+                    done = True
                     break
+            # one ledger commit per row per sync (the block's tokens
+            # land together at this host fold), fed BEFORE retirement
+            # so the tokens count toward the request's timeline
+            if n_row:
+                self.ledger.note_event("commit", guid=req.guid,
+                                       tokens=n_row)
+            if done:
+                self._retire(req)
+            appended += n_row
         return appended
 
     def _decode_only_bc(self) -> BatchConfig:
@@ -586,6 +653,8 @@ class RequestManager:
                 self.recorder.record_event(
                     "decode-step", block=k,
                     rows=bc.num_active_requests())
+                self.ledger.note_event("decode-step", block=k,
+                                       rows=bc.num_active_requests())
                 with self.tracer.span("decode-step", block=k,
                                       rows=bc.num_active_requests()):
                     toks = np.asarray(im.decode_block(
@@ -602,8 +671,14 @@ class RequestManager:
                 self.recorder.record_event(
                     "prefill-chunk", chunk=bc.chunk,
                     rows=bc.num_active_requests())
+                self.ledger.note_event(
+                    "prefill-chunk", chunk=bc.chunk,
+                    rows=bc.num_active_requests())
             else:
                 self.recorder.record_event(
+                    "decode-step", chunk=1,
+                    rows=bc.num_active_requests())
+                self.ledger.note_event(
                     "decode-step", chunk=1,
                     rows=bc.num_active_requests())
             with self.tracer.span(span_name, chunk=bc.chunk,
@@ -715,6 +790,8 @@ class RequestManager:
                        decode_block)
         self.recorder.record_event("decode-step", block=k, handoff=True,
                                    rows=bc2.num_active_requests())
+        self.ledger.note_event("decode-step", block=k, handoff=True,
+                               rows=bc2.num_active_requests())
         with self.tracer.span("decode-step", block=k, handoff=True,
                               rows=bc2.num_active_requests()):
             toks_dev = im.decode_block(
@@ -776,7 +853,10 @@ class RequestManager:
                     # deltas are monotonic-clock (NTP-jump immune)
                     "start_time_unix": p.start_time,
                     "latency_s": p.latency_s(),
+                    # admit-based (see ProfileInfo.admit_mono): queue
+                    # wait is the separate queue_wait_s field
                     "ttft_s": p.ttft_s(),
+                    "queue_wait_s": p.queue_wait_s(),
                 }) + "\n")
 
     def _result_of(self, req: Request) -> GenerationResult:

@@ -54,6 +54,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..observability import get_ledger
 from .batch_config import (BeamSearchBatchConfig, TreeVerifyBatchConfig,
                            pick_chunk)
 from .inference_manager import beam_rerank, pow2_bucket
@@ -310,7 +311,10 @@ def _new_guid_state(D: int) -> Dict:
 def _fold_packed(P, D: int, running, states) -> int:
     """Append newly committed tokens from a packed sync to each request
     (single source for the _pack_state column offsets).  Returns the
-    token count folded this sync (step-telemetry yield)."""
+    token count folded this sync (step-telemetry yield); feeds the
+    request ledger one per-guid commit per row per sync (the device
+    loop's token attribution point — nothing finer is host-visible)."""
+    ledger = get_ledger()
     out_len = P[:, 0]
     folded = 0
     for row, req in running.items():
@@ -319,7 +323,11 @@ def _fold_packed(P, D: int, running, states) -> int:
                    9 + 2 * D + out_len[row]]:
             req.tokens.append(int(t))
             req.profile.note_first_token()
-        folded += int(out_len[row]) - st["folded"]
+        n_row = int(out_len[row]) - st["folded"]
+        if n_row:
+            ledger.note_event("commit", guid=req.guid, row=row,
+                              tokens=n_row)
+        folded += n_row
         st["folded"] = int(out_len[row])
     return folded
 
@@ -491,6 +499,8 @@ def _llm_prompt_prefill(rm, im, llm_id, running, states, tree_chunk, rng):
         rng, r = jax.random.split(rng)
         rm.recorder.record_event("prefill-chunk", chunk=chunk,
                                  model="verify")
+        rm.ledger.note_event("prefill-chunk", chunk=chunk,
+                             model="verify")
         with rm.tracer.span("prefill-chunk", chunk=chunk, model="verify"):
             im.inference(llm_id, bc, rng=r)  # async; nothing fetched
 
@@ -535,6 +545,7 @@ def _ssm_prompt_prefill(rm, im, ssm_id, running, states, W, rng,
         rng, r = jax.random.split(rng)
         rm.recorder.record_event("prefill-chunk", chunk=chunk,
                                  model="draft")
+        rm.ledger.note_event("prefill-chunk", chunk=chunk, model="draft")
         with rm.tracer.span("prefill-chunk", chunk=chunk, model="draft"):
             im.inference(ssm_id, bc, rng=r)
 
@@ -717,6 +728,8 @@ def generate_spec_infer_device(rm, im, llm_id: int,
             rm.recorder.record_event("spec-verify",
                                      inflight=len(inflight),
                                      rows=len(running))
+            rm.ledger.note_event("spec-verify", inflight=len(inflight),
+                                 rows=len(running))
             with rm.tracer.span("spec-verify", inflight=len(inflight),
                                 rows=len(running)):
                 for packed in inflight:
@@ -965,6 +978,8 @@ def generate_spec_infer_device_pp(rm, im, llm_id: int,
         rng, r = jax.random.split(rng)
         rm.recorder.record_event("spec-verify", k=1, rows=len(running),
                                  pp=True)
+        rm.ledger.note_event("spec-verify", k=1, rows=len(running),
+                             pp=True)
         with rm.tracer.span("spec-verify", k=1, rows=len(running)):
             state, ssm_caches, packed = iterate(state, ssm_caches, r)
             P = np.asarray(packed)
@@ -979,6 +994,8 @@ def generate_spec_infer_device_pp(rm, im, llm_id: int,
             t_step = time.monotonic()
             rm.recorder.record_event("spec-verify", k=k,
                                      rows=len(running), pp=True)
+            rm.ledger.note_event("spec-verify", k=k, rows=len(running),
+                                 pp=True)
             with rm.tracer.span("spec-verify", k=k, rows=len(running)):
                 for _ in range(k):
                     rng, r = jax.random.split(rng)

@@ -395,6 +395,8 @@ def _spec_infer_loop(rm, im, llm_id, requests, ssm_ids, tree_chunk, rng,
                         rows=len(running))
         rm.recorder.record_event("spec-draft", ssms=len(ssm_ids),
                                  rows=len(running))
+        rm.ledger.note_event("spec-draft", ssms=len(ssm_ids),
+                             rows=len(running))
         for ssm_id in ssm_ids:
             ssm_record = im.models[ssm_id]
             W = beam_width or ssm_record["beam_width"]
@@ -491,6 +493,8 @@ def _spec_infer_loop(rm, im, llm_id, requests, ssm_ids, tree_chunk, rng,
         rng, r4 = jax.random.split(rng)
         rm.recorder.record_event("spec-verify", rows=len(running),
                                  chunk=tree_chunk)
+        rm.ledger.note_event("spec-verify", rows=len(running),
+                             chunk=tree_chunk)
         with rm.tracer.span("spec-verify", rows=len(running),
                             chunk=tree_chunk):
             outs = im.inference(llm_id, bc, rng=r4)
@@ -529,7 +533,16 @@ def _spec_infer_loop(rm, im, llm_id, requests, ssm_ids, tree_chunk, rng,
                 if rm._finished(req, tok):
                     finished = True
                     break
-            committed_this_iter += len(req.tokens) - n_before
+            appended_row = len(req.tokens) - n_before
+            if appended_row:
+                # ledger commit with the ACTUALLY appended count (the
+                # EOS/budget break can truncate new_tokens), fed before
+                # retirement so per-request sums reconcile with
+                # tokens_generated
+                rm.ledger.note_event("commit", guid=req.guid, row=row,
+                                     tokens=appended_row,
+                                     accepted=len(acc_tokens))
+            committed_this_iter += appended_row
             if finished:
                 # donate BEFORE _retire clears req.row: committed KV =
                 # positions below the pending commit list (accepted

@@ -170,15 +170,40 @@ class KVCacheStats:
 
 
 def ttft_percentiles(requests: Sequence[Any],
-                     ps: Sequence[int] = (50, 90)) -> Dict[str, float]:
+                     ps: Sequence[int] = (50, 90),
+                     ledger: Any = None) -> Dict[str, float]:
     """Host-observed time-to-first-token percentiles (seconds) over a
-    batch of finished Requests (serving ProfileInfo stamps — monotonic
-    clock deltas via ProfileInfo.ttft_s, NTP-jump immune).  Requests
-    that never produced a token are skipped."""
+    batch of finished Requests.
+
+    Per-request TTFTs come from the request LEDGER
+    (observability/ledger.py) — the PR-7 reconciliation: the ledger's
+    retire feed carries the authoritative ``ProfileInfo.ttft_s()``
+    stamp, so both paths agree exactly (pinned by
+    tests/test_ledger.py); requests the ledger never saw
+    (``FF_TELEMETRY=0``, ring-evicted) fall back to their profile
+    stamps, monotonic-clock deltas either way (NTP-jump immune).
+
+    TTFT measures ADMISSION -> first token (``ProfileInfo.admit_mono``):
+    a warm prefix-cache hit is credited for the prefill it skipped, not
+    penalized for queue wait — the wait is its own ``queue_wait_s``
+    component.  Requests that never produced a token are skipped.
+    ``ledger``: explicit RequestLedger (defaults to the process-wide
+    one)."""
     import numpy as np
 
-    ttfts = [t for t in (r.profile.ttft_s() for r in requests)
-             if t is not None]
+    if ledger is None:
+        try:
+            from ..observability import get_ledger
+            ledger = get_ledger()
+        except ImportError:         # pragma: no cover - partial install
+            ledger = None
+    ttfts = []
+    for r in requests:
+        t = ledger.ttft_of(r.guid) if ledger is not None else None
+        if t is None:
+            t = r.profile.ttft_s()
+        if t is not None:
+            ttfts.append(t)
     if not ttfts:
         return {f"p{p}": 0.0 for p in ps}
     return {f"p{p}": float(np.percentile(ttfts, p)) for p in ps}

@@ -596,6 +596,35 @@ class TestMetricSchemaRule:
             """, self.R)
         assert fs == []
 
+    def test_note_event_ledger_feeds_validated(self, tmp_path):
+        # request-ledger feeds share the record_event vocabulary: a
+        # declared literal passes, an undeclared name and a non-literal
+        # are flagged at exact lines, guid-kwarg spelling included, and
+        # a bare-function alias is covered like record_event's
+        fs = lint(tmp_path, """\
+            def feed(ledger, name, note_event):
+                ledger.note_event("admit", guid=1, row=0)
+                ledger.note_event("decode-step", block=4)
+                ledger.note_event("rogue-ledger-event", guid=1)
+                ledger.note_event(name, guid=1)
+                note_event("also-rogue", guid=2)
+            """, self.R)
+        assert at(fs, "metric-schema", 4), fs     # undeclared (method)
+        assert at(fs, "metric-schema", 5), fs     # non-literal
+        assert at(fs, "metric-schema", 6), fs     # undeclared (bare)
+        assert len(fs) == 3
+
+    def test_note_event_clean_and_suppressed(self, tmp_path):
+        # negative twin: only declared literals (clean), and an ad-hoc
+        # name behind the standard suppression comment
+        fs = lint(tmp_path, """\
+            def feed(ledger):
+                ledger.note_event("admit", guid=7, prompt_len=3)
+                ledger.note_event("decode-step", rows=2)
+                ledger.note_event("scratch-tl")  # fflint: disable=metric-schema  ad-hoc test ledger
+            """, self.R)
+        assert fs == []
+
 
 # --------------------------------------------------- direct host sync
 class TestDirectHostSyncRule:

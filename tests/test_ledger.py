@@ -1,0 +1,736 @@
+"""Per-request lifecycle ledger + SLO/goodput accounting tests (PR 7).
+
+Pins the acceptance surface:
+
+- RequestLedger lifecycle semantics (enqueue/admit/prefix-match/commit/
+  retire, broadcast step events, lazy timelines), bounded memory
+  (retired ring + per-timeline event ring) and the disabled no-op;
+- multi-threaded churn: parallel feeders + concurrent snapshots leave
+  consistent totals;
+- per-request/aggregate RECONCILIATION across all three decode drivers
+  (incremental, host-spec, device-spec): sum of ledger per-request
+  committed tokens == serving_tokens_generated_total, and ledger TTFTs
+  == the ProfileInfo.ttft_s() path exactly (the ttft_percentiles
+  reconciliation, admit-based TTFT semantics included);
+- SLOPolicy evaluation, attainment/goodput math, the serving_slo_* /
+  goodput gauges and their Prometheus exposition;
+- expose_text() edge cases parsed by a minimal promtool-style parser
+  (empty registry, labeled-series escaping, cumulative +Inf/_sum/_count
+  invariants);
+- bench.py --slo plumbing: a round record carries a schema-valid `slo`
+  block computed from >= 2 requests with distinct lifecycles (one warm
+  prefix hit, one cold);
+- tools/ffreq.py loads ledger snapshots and watchdog bundles name
+  in-flight GUIDs via tools/ffstat.py.
+"""
+
+import io
+import json
+import math
+import os
+import re
+import subprocess
+import sys
+import threading
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from flexflow_tpu import FFConfig, Model
+from flexflow_tpu.fftype import InferenceMode
+from flexflow_tpu.models.llama import LLAMAConfig, create_llama_model
+from flexflow_tpu.observability import (MetricsRegistry, RequestLedger,
+                                        SLOPolicy, get_ledger,
+                                        get_registry, slo_report_from,
+                                        validate_slo_block)
+from flexflow_tpu.serving import InferenceManager, RequestManager
+from flexflow_tpu.serving.spec_infer import generate_spec_infer
+from flexflow_tpu.utils.profiling import ttft_percentiles
+
+TINY = dict(vocab_size=128, hidden_size=64, intermediate_size=128,
+            num_hidden_layers=2, num_attention_heads=4,
+            num_key_value_heads=2, max_position_embeddings=512)
+
+
+def _build_llama(name, seed=1, mode=InferenceMode.INC_DECODING,
+                 max_requests=2, **over):
+    cfg = LLAMAConfig(**{**TINY, **over})
+    model = Model(FFConfig(seed=seed), name=name)
+    create_llama_model(model, cfg, mode=mode, max_requests=max_requests)
+    return model
+
+
+@pytest.fixture(autouse=True)
+def _clean_telemetry():
+    led, reg = get_ledger(), get_registry()
+    led.clear()
+    led.set_slo_policy(None)
+    reg.reset()
+    yield
+    led.clear()
+    led.set_slo_policy(None)
+    reg.reset()
+
+
+def _feed_lifecycle(led, guid, tokens=(1, 4), matched=0, retire=True):
+    led.note_event("enqueue", guid=guid, prompt_len=16)
+    led.note_event("admit", guid=guid, row=0, prompt_len=16)
+    if matched:
+        led.note_event("prefix-match", guid=guid, matched=matched)
+    for n in tokens:
+        led.note_event("commit", guid=guid, tokens=n)
+    if retire:
+        led.note_event("retire", guid=guid, tokens=sum(tokens))
+
+
+# ------------------------------------------------------------ unit tests
+class TestLedgerUnit:
+    def test_lifecycle_fields(self):
+        led = RequestLedger(retired_capacity=8)
+        _feed_lifecycle(led, 1, tokens=(1, 3), matched=32)
+        t = led.timeline(1)
+        assert t["retired"] and t["tokens"] == 4 and t["committed"] == 4
+        assert t["prefix_matched"] == 32
+        assert t["queue_s"] is not None and t["queue_s"] >= 0
+        assert t["ttft_s"] is not None and t["tpot_s"] is not None
+        # commit stamps: TPOT is the mean inter-token gap AFTER the
+        # first commit (3 gap tokens over the first->last commit span)
+        ev = [e for e in t["events"] if e["name"] == "commit"]
+        assert len(ev) == 2
+        own = (ev[-1]["t"] - ev[0]["t"]) / 3
+        assert t["tpot_s"] == pytest.approx(own)
+        assert led.in_flight_guids() == []
+        assert led.committed_total(retired_only=True) == 4
+
+    def test_broadcast_hits_admitted_only(self):
+        led = RequestLedger()
+        led.note_event("enqueue", guid=1, prompt_len=4)   # never admitted
+        led.note_event("enqueue", guid=2, prompt_len=4)
+        led.note_event("admit", guid=2, row=0)
+        led.note_event("decode-step", block=8, rows=1)    # broadcast
+        names1 = [e["name"] for e in led.timeline(1)["events"]]
+        names2 = [e["name"] for e in led.timeline(2)["events"]]
+        assert "decode-step" not in names1
+        assert "decode-step" in names2
+        assert led.in_flight_guids() == [2]
+
+    def test_lazy_timeline_and_late_events(self):
+        led = RequestLedger()
+        # a feed for a guid the ledger never saw enqueue for (enabled
+        # mid-run) creates the timeline lazily
+        led.note_event("admit", guid=9, row=1)
+        assert led.timeline(9)["enqueue_mono"] is None
+        led.note_event("commit", guid=9, tokens=2)
+        led.note_event("retire", guid=9, tokens=2)
+        # late events for a retired guid are dropped, not resurrected
+        led.note_event("commit", guid=9, tokens=50)
+        assert led.timeline(9)["committed"] == 2
+        assert led.in_flight_guids() == []
+
+    def test_bounded_retired_ring_and_event_ring(self):
+        led = RequestLedger(retired_capacity=4, events_per_request=8)
+        for g in range(10):
+            _feed_lifecycle(led, g)
+        snap = led.snapshot()
+        assert len(snap["retired"]) == 4
+        assert snap["retired_dropped"] == 6
+        assert [t["guid"] for t in snap["retired"]] == [6, 7, 8, 9]
+        # per-timeline event ring: > maxlen events drop oldest, counted
+        led.note_event("enqueue", guid=100, prompt_len=1)
+        led.note_event("admit", guid=100, row=0)
+        for _ in range(20):
+            led.note_event("decode-step", block=1, rows=1)
+        t = led.timeline(100)
+        assert len(t["events"]) == 8
+        assert t["events_dropped"] == 14
+        # totals survive ring drops (committed tracked as scalars)
+        assert led.committed_total(retired_only=True) == 4 * 5
+
+    def test_disabled_is_noop_and_runtime_toggle(self):
+        led = RequestLedger(enabled=False)
+        _feed_lifecycle(led, 1)
+        snap = led.snapshot()
+        assert snap["live"] == [] and snap["retired"] == []
+        # the FF_TELEMETRY runtime switch covers the process ledger too
+        from flexflow_tpu.observability import set_telemetry_enabled
+
+        glob = get_ledger()
+        try:
+            set_telemetry_enabled(False)
+            assert glob.enabled is False
+            _feed_lifecycle(glob, 2)
+            assert glob.snapshot()["live"] == []
+            assert glob.snapshot()["retired"] == []
+        finally:
+            set_telemetry_enabled(True)
+        assert glob.enabled is True
+
+    def test_undeclared_event_name_raises(self):
+        led = RequestLedger()
+        with pytest.raises(ValueError, match="EVENT_SCHEMA"):
+            led.note_event("not-a-real-event", guid=1)
+
+    def test_retire_uses_authoritative_payload_stamps(self):
+        led = RequestLedger()
+        led.note_event("enqueue", guid=5, prompt_len=8)
+        led.note_event("admit", guid=5, row=0)
+        led.note_event("commit", guid=5, tokens=3)
+        led.note_event("retire", guid=5, tokens=3, ttft_s=0.125,
+                       tpot_s=0.01, latency_s=0.5, queue_s=0.05)
+        t = led.timeline(5)
+        assert t["ttft_s"] == 0.125 and t["tpot_s"] == 0.01
+        assert t["latency_s"] == 0.5 and t["queue_s"] == 0.05
+
+
+# ---------------------------------------------------------- concurrency
+class TestLedgerConcurrency:
+    def test_parallel_feeders_with_concurrent_snapshots(self):
+        """Satellite: multi-threaded churn — N feeder threads each
+        running full lifecycles while a snapshotter spins; totals must
+        come out exact and no call may raise."""
+        led = RequestLedger(retired_capacity=4096)
+        n_threads, n_reqs, toks = 8, 25, 3
+        errors = []
+        stop = threading.Event()
+
+        def feeder(base):
+            try:
+                for i in range(n_reqs):
+                    g = base * 1000 + i
+                    led.note_event("enqueue", guid=g, prompt_len=4)
+                    led.note_event("admit", guid=g, row=0)
+                    led.note_event("decode-step", block=1, rows=1)
+                    led.note_event("commit", guid=g, tokens=toks)
+                    led.note_event("retire", guid=g, tokens=toks)
+            except Exception as e:  # pragma: no cover - failure path
+                errors.append(e)
+
+        def snapshotter():
+            try:
+                while not stop.is_set():
+                    snap = led.snapshot()
+                    json.dumps(snap)         # serializable mid-churn
+                    led.in_flight_guids()
+                    led.committed_total()
+            except Exception as e:  # pragma: no cover - failure path
+                errors.append(e)
+
+        snap_t = threading.Thread(target=snapshotter)
+        snap_t.start()
+        feeders = [threading.Thread(target=feeder, args=(b,))
+                   for b in range(n_threads)]
+        for t in feeders:
+            t.start()
+        for t in feeders:
+            t.join()
+        stop.set()
+        snap_t.join()
+        assert errors == []
+        assert led.committed_total(retired_only=True) \
+            == n_threads * n_reqs * toks
+        assert len(led.snapshot()["retired"]) == n_threads * n_reqs
+        assert led.in_flight_guids() == []
+
+
+# ------------------------------------------------------------ SLO maths
+class TestSLOPolicy:
+    def test_evaluate_components(self):
+        pol = SLOPolicy(ttft_s=0.5, tpot_s=0.05)
+        assert pol.evaluate(0.4, 0.04)["attained"]
+        assert not pol.evaluate(0.6, 0.04)["ttft_ok"]
+        assert not pol.evaluate(0.4, 0.06)["tpot_ok"]
+        # no first token ever: a configured TTFT target fails
+        assert not pol.evaluate(None, None)["ttft_ok"]
+        # single-token request: TPOT passes vacuously
+        assert pol.evaluate(0.4, None)["attained"]
+        # unconfigured components always hold
+        assert SLOPolicy().evaluate(None, None)["attained"]
+
+    def test_report_attainment_and_goodput(self):
+        fast = {"retired": True, "guid": 1, "tokens": 30, "ttft_s": 0.1,
+                "tpot_s": 0.01, "admit_mono": 100.0,
+                "retire_mono": 101.0, "latency_s": 1.0}
+        slow = {"retired": True, "guid": 2, "tokens": 70, "ttft_s": 2.0,
+                "tpot_s": 0.01, "admit_mono": 100.0,
+                "retire_mono": 102.0, "latency_s": 2.0}
+        live = {"retired": False, "guid": 3, "tokens": None}
+        rep = slo_report_from([fast, slow, live],
+                              SLOPolicy(ttft_s=0.5, tpot_s=0.05))
+        assert rep["requests"] == 2          # live excluded
+        assert rep["attained"] == 1 and rep["attainment"] == 0.5
+        assert rep["ttft_attainment"] == 0.5
+        assert rep["tpot_attainment"] == 1.0
+        assert rep["total_tokens"] == 100
+        assert rep["attained_tokens"] == 30
+        # window = first admit -> last retire = 2 s; only the attaining
+        # request's tokens count toward goodput
+        assert rep["window_s"] == pytest.approx(2.0)
+        assert rep["goodput_tokens_per_s"] == pytest.approx(15.0)
+        assert rep["slowest"]["guid"] == 2
+        assert validate_slo_block(rep) == []
+
+    def test_zero_token_request_ranks_slowest(self):
+        """A retired request that never produced a token (ttft_s None)
+        is the WORST case: it must surface as the report's slowest
+        request, not rank as the fastest."""
+        ok = {"retired": True, "guid": 1, "tokens": 10, "ttft_s": 0.2,
+              "tpot_s": 0.01, "admit_mono": 0.0, "retire_mono": 1.0,
+              "latency_s": 1.0}
+        dead = {"retired": True, "guid": 2, "tokens": 0, "ttft_s": None,
+                "tpot_s": None, "admit_mono": 0.0, "retire_mono": 5.0,
+                "latency_s": 5.0}
+        rep = slo_report_from([ok, dead], SLOPolicy(ttft_s=0.5))
+        assert rep["slowest"]["guid"] == 2
+        assert rep["attainment"] == 0.5      # the dead request misses
+
+    def test_validate_slo_block_rejects_malformed(self):
+        assert validate_slo_block([]) != []
+        assert validate_slo_block({}) != []
+        good = slo_report_from([], SLOPolicy(ttft_s=1.0))
+        assert validate_slo_block(good) == []
+        bad = dict(good)
+        bad["requests"] = 2
+        bad["attainment"] = 7.0              # not a fraction
+        assert validate_slo_block(bad) != []
+
+    def test_gauges_refresh_on_retire(self):
+        led, reg = get_ledger(), get_registry()
+        led.set_slo_policy(SLOPolicy(ttft_s=1e9))
+        _feed_lifecycle(led, 1, tokens=(1, 2))
+        g = reg.snapshot()["gauges"]
+        assert g["serving_slo_attainment"] == 1.0
+        assert g["serving_slo_ttft_attainment"] == 1.0
+        assert g["serving_slo_tpot_attainment"] == 1.0
+        assert g["serving_goodput_tokens_per_s"] > 0
+        # an impossible target flips the attainment gauges to 0
+        led.set_slo_policy(SLOPolicy(ttft_s=-1.0))
+        _feed_lifecycle(led, 2, tokens=(1,))
+        g = reg.snapshot()["gauges"]
+        assert g["serving_slo_attainment"] == 0.0
+        assert g["serving_goodput_tokens_per_s"] == 0.0
+        # clear() zeroes the gauges too: the exposition surfaces and
+        # slo_report() must agree the window is gone (a bench
+        # measurement-boundary clear must not leave stale attainment)
+        led.set_slo_policy(SLOPolicy(ttft_s=1e9))
+        _feed_lifecycle(led, 3, tokens=(1, 2))
+        assert reg.snapshot()["gauges"]["serving_slo_attainment"] == 1.0
+        led.clear()
+        g = reg.snapshot()["gauges"]
+        assert g["serving_slo_attainment"] == 0.0
+        assert g["serving_slo_ttft_attainment"] == 0.0
+        assert g["serving_goodput_tokens_per_s"] == 0.0
+
+
+# ------------------------------------------- drivers: reconciliation
+def _run_incr(prefix_cache=False, n_requests=2, max_requests=2,
+              seed=3):
+    model = _build_llama("led_incr%d" % seed, seed=seed,
+                         max_requests=max_requests)
+    im = InferenceManager(model.config)
+    mid = im.compile_model_and_allocate_buffer(
+        model, max_requests=max_requests, max_seq_length=256,
+        prefill_chunk=128)
+    rm = RequestManager(max_requests_per_batch=max_requests,
+                        max_tokens_per_batch=128,
+                        max_sequence_length=256, decode_block=8,
+                        prefix_cache=prefix_cache)
+    reqs = [rm.register_new_request(list(range(4, 24)), max_new_tokens=8)
+            for _ in range(n_requests)]
+    rm.generate_incr_decoding(im, mid, reqs)
+    return im, rm, reqs
+
+
+def _run_spec(device, monkeypatch, seed=5):
+    monkeypatch.setenv("FF_SPEC_DEVICE", "1" if device else "0")
+    llm = _build_llama("led_spec_llm%d" % device, seed=seed,
+                       mode=InferenceMode.TREE_VERIFY, max_requests=2)
+    ssm = _build_llama("led_spec_ssm%d" % device, seed=seed + 1,
+                       mode=InferenceMode.BEAM_SEARCH, max_requests=2)
+    im = InferenceManager(llm.config)
+    llm_id = im.compile_model_and_allocate_buffer(
+        llm, mode=InferenceMode.TREE_VERIFY, max_requests=2,
+        max_seq_length=256, cache_dtype=np.float32)
+    ssm_id = im.compile_model_and_allocate_buffer(
+        ssm, mode=InferenceMode.BEAM_SEARCH, max_requests=2,
+        max_seq_length=256, beam_width=2, cache_dtype=np.float32)
+    rm = RequestManager(max_requests_per_batch=2, max_tokens_per_batch=64,
+                        max_sequence_length=256,
+                        max_spec_tree_token_num=24)
+    rm.register_ssm_model(ssm_id)
+    reqs = [rm.register_new_request([3, 5, 9, 2], max_new_tokens=6)
+            for _ in range(2)]
+    generate_spec_infer(rm, im, llm_id, reqs, beam_width=2, beam_depth=3)
+    return im, rm, reqs
+
+
+def _assert_reconciles(reqs):
+    """The acceptance invariant: ledger per-request committed sums ==
+    the aggregate tokens_generated counter == profile output lengths,
+    and ledger TTFTs equal the ProfileInfo path EXACTLY."""
+    led = get_ledger()
+    snap = get_registry().snapshot()
+    tg = snap["counters"]["serving_tokens_generated_total"]
+    assert led.committed_total(retired_only=True) == tg > 0
+    for r in reqs:
+        t = led.timeline(r.guid)
+        assert t is not None and t["retired"]
+        assert t["committed"] == t["tokens"] \
+            == len(r.tokens) - r.prompt_len
+        assert t["ttft_s"] == r.profile.ttft_s()
+        names = {e["name"] for e in t["events"]}
+        assert {"enqueue", "admit", "commit", "retire"} <= names
+
+
+class TestDriverReconciliation:
+    def test_incr_driver(self):
+        im, rm, reqs = _run_incr()
+        _assert_reconciles(reqs)
+        # the incr timeline carries the step events it lived through
+        t = get_ledger().timeline(reqs[0].guid)
+        names = {e["name"] for e in t["events"]}
+        assert "prefill-chunk" in names and "decode-step" in names
+        assert "host-sync" in names
+
+    @pytest.mark.parametrize("device", [False, True],
+                             ids=["host-spec", "device-spec"])
+    def test_spec_drivers(self, monkeypatch, device):
+        im, rm, reqs = _run_spec(device, monkeypatch)
+        _assert_reconciles(reqs)
+        t = get_ledger().timeline(reqs[0].guid)
+        names = {e["name"] for e in t["events"]}
+        assert "spec-verify" in names
+        if not device:
+            assert "spec-draft" in names
+
+    def test_ttft_percentiles_pinned_to_profile_path(self):
+        """Satellite: ttft_percentiles now reads the ledger; the values
+        must equal the ProfileInfo.ttft_s() computation exactly, and
+        survive FF_TELEMETRY=0 via the profile fallback."""
+        im, rm, reqs = _run_incr(seed=7)
+        led = get_ledger()
+        from_profiles = {
+            f"p{p}": float(np.percentile(
+                [r.profile.ttft_s() for r in reqs], p))
+            for p in (50, 90)}
+        assert ttft_percentiles(reqs) == from_profiles
+        assert ttft_percentiles(reqs, ledger=led) == from_profiles
+        # ledger knows nothing (cleared): the profile fallback kicks in
+        led.clear()
+        assert ttft_percentiles(reqs) == from_profiles
+
+    def test_guids_unique_across_manager_instances(self):
+        """Guids key the ledger: two RequestManager instances (a bench
+        A/B's cold and warm arms) must never mint the same guid, or the
+        second arm's timelines silently overwrite the first's and the
+        cross-arm TTFT comparison reads the wrong run."""
+        rm_a = RequestManager(max_requests_per_batch=2)
+        rm_b = RequestManager(max_requests_per_batch=2)
+        ra = [rm_a.register_new_request([1, 2, 3], max_new_tokens=2)
+              for _ in range(3)]
+        rb = [rm_b.register_new_request([1, 2, 3], max_new_tokens=2)
+              for _ in range(3)]
+        guids = [r.guid for r in ra + rb]
+        assert len(set(guids)) == 6
+        # and every one has its own live ledger timeline
+        assert len({g for g in guids
+                    if get_ledger().timeline(g) is not None}) == 6
+
+    def test_ttft_measured_from_admit_not_enqueue(self):
+        """The queue-wait ambiguity fix: with 1 batch slot and 2
+        requests, the second request waits a full generation before
+        admission — its TTFT must exclude that wait (admit-based), with
+        the wait reported separately as queue_wait_s / ledger queue_s."""
+        im, rm, reqs = _run_incr(n_requests=2, max_requests=1, seed=11)
+        r2 = reqs[1]
+        p = r2.profile
+        assert p.admit_mono > p.start_mono
+        wait = p.queue_wait_s()
+        assert wait is not None and wait > 0
+        # enqueue-based TTFT would include the wait; admit-based must be
+        # smaller by exactly that amount
+        enqueue_based = p.first_token_time - p.start_mono
+        assert p.ttft_s() == pytest.approx(enqueue_based - wait)
+        t = get_ledger().timeline(r2.guid)
+        assert t["queue_s"] == pytest.approx(wait)
+        assert t["ttft_s"] == p.ttft_s()
+        # the first request was admitted immediately: negligible wait
+        assert reqs[0].profile.queue_wait_s() < wait
+
+
+# ----------------------------------------------- exposition edge cases
+_SAMPLE_RE = re.compile(
+    r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
+    r"(?:\{(?P<labels>.*)\})?\s+(?P<value>\S+)$")
+
+
+def _parse_prom(text):
+    """Minimal promtool-style text-format parser: returns
+    (samples, types) where samples is a list of (name, labels-dict,
+    float value).  Raises on any malformed line."""
+    samples, types = [], {}
+    for line in text.splitlines():
+        if not line.strip():
+            continue
+        if line.startswith("# TYPE "):
+            _, _, name, kind = line.split(None, 3)
+            types[name] = kind
+            continue
+        if line.startswith("#"):
+            assert line.startswith("# HELP "), line
+            continue
+        m = _SAMPLE_RE.match(line)
+        assert m, f"malformed sample line: {line!r}"
+        labels = {}
+        raw = m.group("labels")
+        if raw:
+            for part in re.findall(r'(\w+)="((?:[^"\\]|\\.)*)"', raw):
+                labels[part[0]] = (part[1].replace('\\"', '"')
+                                   .replace("\\\\", "\\"))
+        samples.append((m.group("name"), labels,
+                        float(m.group("value"))))
+    return samples, types
+
+
+class TestExposeTextEdgeCases:
+    def test_empty_registry(self):
+        text = MetricsRegistry().expose_text()
+        samples, types = _parse_prom(text)
+        assert samples == [] and types == {}
+        assert text == "\n"
+
+    def test_labeled_series_escaping_roundtrip(self):
+        reg = MetricsRegistry()
+        g = reg.gauge("g")
+        tricky = 'quo"te\\slash'
+        g.set(2.5, path=tricky)
+        c = reg.counter("c")
+        c.inc(3, reason="plain")
+        samples, types = _parse_prom(reg.expose_text())
+        by = {(n, tuple(sorted(l.items()))): v for n, l, v in samples}
+        assert by[("g", (("path", tricky),))] == 2.5
+        assert by[("c", (("reason", "plain"),))] == 3.0
+        assert types == {"g": "gauge", "c": "counter"}
+
+    def test_histogram_invariants(self):
+        reg = MetricsRegistry()
+        h = reg.histogram("h", buckets=(0.1, 1.0, 10.0))
+        for v in (0.05, 0.5, 0.6, 5.0, 50.0):   # incl. one overflow
+            h.observe(v)
+        samples, types = _parse_prom(reg.expose_text())
+        assert types["h"] == "histogram"
+        buckets = [(l["le"], v) for n, l, v in samples
+                   if n == "h_bucket"]
+        # cumulative, ordered, +Inf last and equal to _count
+        values = [v for _, v in buckets]
+        assert values == sorted(values)
+        assert buckets[-1][0] == "+Inf"
+        count = next(v for n, l, v in samples if n == "h_count")
+        assert buckets[-1][1] == count == 5
+        s = next(v for n, l, v in samples if n == "h_sum")
+        assert s == pytest.approx(0.05 + 0.5 + 0.6 + 5.0 + 50.0)
+        # every non-Inf bound parses as a float
+        assert all(not math.isnan(float(b)) for b, _ in buckets[:-1])
+
+    def test_zero_count_histogram_still_wellformed(self):
+        reg = MetricsRegistry()
+        reg.histogram("h", buckets=(1.0,))
+        samples, _ = _parse_prom(reg.expose_text())
+        by = {n: v for n, l, v in samples}
+        assert by["h_count"] == 0 and by["h_sum"] == 0.0
+        inf = [v for n, l, v in samples
+               if n == "h_bucket" and l.get("le") == "+Inf"]
+        assert inf == [0.0]
+
+    def test_slo_gauges_exposed(self):
+        led, reg = get_ledger(), get_registry()
+        led.set_slo_policy(SLOPolicy(ttft_s=1e9, tpot_s=1e9))
+        _feed_lifecycle(led, 1, tokens=(1, 2))
+        samples, types = _parse_prom(reg.expose_text())
+        by = {n: v for n, l, v in samples}
+        assert by["serving_slo_attainment"] == 1.0
+        assert by["serving_slo_ttft_attainment"] == 1.0
+        assert by["serving_slo_tpot_attainment"] == 1.0
+        assert by["serving_goodput_tokens_per_s"] > 0
+        for n in ("serving_slo_attainment",
+                  "serving_goodput_tokens_per_s"):
+            assert types[n] == "gauge"
+
+
+# ----------------------------------------------------- serve.LLM surface
+def test_serve_api_exposes_timelines_and_slo_report():
+    from flexflow_tpu.serve.serve import LLM
+
+    led = get_ledger()
+    _feed_lifecycle(led, 42, tokens=(1, 2), matched=16)
+    llm = object.__new__(LLM)
+    tls = LLM.request_timelines(llm)
+    assert any(t["guid"] == 42 for t in tls)
+    rep = LLM.slo_report(llm, ttft_s=1e9)
+    assert rep["requests"] == 1 and rep["attainment"] == 1.0
+    assert validate_slo_block(rep) == []
+    # no policy anywhere -> None (not a crash)
+    assert LLM.slo_report(llm) is None
+
+
+# ------------------------------------------------- bench `slo` block
+class TestBenchSLOBlock:
+    @pytest.fixture()
+    def bench_mod(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("FF_BENCH_RESULTS", str(tmp_path))
+        monkeypatch.setenv("FF_BENCH_ROUND", "r98")
+        import bench
+
+        monkeypatch.setattr(bench, "_PROGRESS",
+                            {"mode": "all", "in_flight": None,
+                             "done": [], "metrics": []})
+        tail = bench._StderrTail(io.StringIO(), limit=512)
+        monkeypatch.setattr(bench, "_STDERR_TAIL", tail)
+        monkeypatch.setattr(bench, "_WATCHDOG", None)
+        monkeypatch.setattr(bench, "_KV_NOTES", {})
+        monkeypatch.setattr(bench, "_SLO_SECTIONS", {})
+        monkeypatch.setattr(bench, "_FFLINT_STATE",
+                            {"clean": True, "new_findings": 0,
+                             "baselined": 0})
+        return bench, tmp_path
+
+    def test_record_carries_schema_valid_slo_block(self, bench_mod):
+        """Acceptance: a bench round record carries a schema-valid
+        `slo` block with attainment + goodput computed from >= 2
+        requests with distinct lifecycles — bench_prefix serves the
+        same workload cold (pool off) and warm (pool on), so the
+        ledger's retired window holds both a warm prefix hit and cold
+        requests."""
+        bench, tmp_path = bench_mod
+        bench._install_slo(1e9, 1e9)        # generous: attainment = 1
+
+        def tiny_builder():
+            cfg = LLAMAConfig(**{**TINY,
+                                 "max_position_embeddings": 640})
+            model = Model(FFConfig(), name="llama_slo_bench_tiny")
+            create_llama_model(model, cfg, max_requests=2)
+            return model, cfg.vocab_size, np.float32
+
+        result = bench.bench_prefix(
+            model_builder=tiny_builder, max_requests=2, system_len=64,
+            tail_len=8, n_requests=2, new_tokens=3, max_seq_length=256,
+            max_tokens_per_batch=64, decode_block=4)
+        head = result[0]
+        bench._note_mode_done("prefix", [])
+        bench.persist_record({"extras": list(result[1:]), **head},
+                             "prefix")
+        with open(tmp_path / "partial_prefix.json") as f:
+            rec = json.load(f)
+        slo = rec["slo"]
+        assert validate_slo_block(slo) == [], slo
+        assert slo["requests"] >= 2
+        assert slo["attainment"] == 1.0
+        assert slo["goodput_tokens_per_s"] > 0
+        assert isinstance(slo["slowest"], dict)
+        assert {"guid", "ttft_s", "events"} <= set(slo["slowest"])
+        # the per-section block captured at the section boundary (the
+        # mode=all contamination fix: later sections clear the window,
+        # so slo_sections is the round-complete evidence)
+        assert validate_slo_block(rec["slo_sections"]["prefix"]) == []
+        # distinct lifecycles in the retired window: at least one warm
+        # prefix hit and one cold request — the warmup's requests were
+        # cleared at the measurement boundary
+        tls = get_ledger().timelines(include_live=False)
+        assert any(t["prefix_matched"] > 0 for t in tls)
+        assert any(t["prefix_matched"] == 0 for t in tls)
+        assert len(tls) == 2 * 2            # cold run + warm run only
+        # the slim stdout record carries the compact pair
+        slim = bench._slim({"extras": [], **head,
+                            "slo_attainment": slo["attainment"],
+                            "slo_goodput_tokens_per_s":
+                                slo["goodput_tokens_per_s"]})
+        assert slim["slo_attainment"] == 1.0
+
+    def test_no_policy_no_block(self, bench_mod):
+        bench, tmp_path = bench_mod
+        bench.persist_record({"metric": "m", "value": 1.0, "unit": "s",
+                              "extras": []}, "aux")
+        with open(tmp_path / "partial_aux.json") as f:
+            rec = json.load(f)
+        assert "slo" not in rec
+
+
+# ------------------------------------------------------- tools round trip
+class TestTools:
+    def test_ffreq_reads_snapshot_and_ranks(self, tmp_path):
+        led = get_ledger()
+        led.set_slo_policy(SLOPolicy(ttft_s=1e9))
+        _feed_lifecycle(led, 1, tokens=(1, 4), matched=0)
+        _feed_lifecycle(led, 2, tokens=(1, 2), matched=24)
+        led.note_event("enqueue", guid=3, prompt_len=4)
+        led.note_event("admit", guid=3, row=0)          # stays in flight
+        path = tmp_path / "ledger.json"
+        with open(path, "w") as f:
+            json.dump(led.snapshot(), f)
+        out = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools", "ffreq.py"),
+             str(path), "--guid", "2", "--slo", "1000"],
+            capture_output=True, text=True)
+        assert out.returncode == 0, out.stderr
+        assert "2 retired, 1 in-flight" in out.stdout
+        assert "in-flight guids: 3" in out.stdout
+        assert "prefix-match" in out.stdout      # guid 2's timeline
+        assert "goodput" in out.stdout
+        assert "per-phase breakdown" in out.stdout
+
+    def test_ffreq_selftest(self):
+        out = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools", "ffreq.py"),
+             "--selftest"], capture_output=True, text=True)
+        assert out.returncode == 0, out.stdout + out.stderr
+        assert "selftest OK" in out.stdout
+
+    def test_ffreq_rejects_malformed_slo_spec(self, tmp_path):
+        p = tmp_path / "l.json"
+        p.write_text('{"live": [], "retired": []}')
+        out = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools", "ffreq.py"),
+             str(p), "--slo", "500ms"], capture_output=True, text=True)
+        assert out.returncode == 1
+        assert "bad --slo spec" in out.stderr
+        assert "Traceback" not in out.stderr
+
+    def test_ffreq_rejects_foreign_doc(self, tmp_path):
+        p = tmp_path / "x.json"
+        p.write_text('{"unrelated": 1}')
+        out = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools", "ffreq.py"),
+             str(p)], capture_output=True, text=True)
+        assert out.returncode == 1
+        assert "no per-request ledger data" in out.stderr
+
+    def test_bundle_carries_ledger_and_ffstat_names_inflight(
+            self, tmp_path):
+        """Satellite: watchdog bundles embed the ledger snapshot and
+        ffstat's diagnosis names the in-flight (non-retired) GUIDs."""
+        from flexflow_tpu.observability import dump_bundle
+
+        led = get_ledger()
+        _feed_lifecycle(led, 7, tokens=(1, 2))
+        led.note_event("enqueue", guid=8, prompt_len=4)
+        led.note_event("admit", guid=8, row=0)
+        led.note_event("commit", guid=8, tokens=5)       # hung mid-decode
+        path = dump_bundle(str(tmp_path), "test")
+        with open(path) as f:
+            doc = json.load(f)
+        assert [t["guid"] for t in doc["ledger"]["retired"]] == [7]
+        assert [t["guid"] for t in doc["ledger"]["live"]] == [8]
+        out = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools", "ffstat.py"),
+             path], capture_output=True, text=True)
+        assert out.returncode == 0, out.stderr
+        assert "in-flight (non-retired) requests" in out.stdout
+        assert "guid 8" in out.stdout and "committed 5" in out.stdout
+        # ffreq reads the same bundle for the per-request view
+        out2 = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools", "ffreq.py"),
+             path, "--guid", "8"], capture_output=True, text=True)
+        assert out2.returncode == 0, out2.stderr
+        assert "1 retired, 1 in-flight" in out2.stdout

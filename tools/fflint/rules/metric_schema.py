@@ -5,10 +5,13 @@ Every metric name passed to a registry factory —
 ``.counter("…")`` / ``.gauge("…")`` / ``.histogram("…")`` — must be a
 string literal declared in
 ``flexflow_tpu/observability/schema.METRICS_SCHEMA`` with a matching
-type, and every flight-recorder emission — ``record_event("…")`` —
-must name a literal declared in ``schema.EVENT_SCHEMA``.  The registry
-and recorder enforce this at runtime too, but a code path that only
-runs on chip would ship the violation; this gate fails in CI first.
+type, and every flight-recorder emission — ``record_event("…")`` — and
+request-ledger feed — ``note_event("…")`` — must name a literal
+declared in ``schema.EVENT_SCHEMA`` (one event vocabulary across the
+tracer, the recorder ring and the per-request ledger).  The registry,
+recorder and ledger enforce this at runtime too, but a code path that
+only runs on chip would ship the violation; this gate fails in CI
+first.
 Non-literal names are rejected outright: the schema exists precisely
 so the emitted vocabulary is statically enumerable (the reference
 ships a fixed ProfileInfo struct the same way,
@@ -35,10 +38,10 @@ from typing import Iterable, List
 from ..core import Finding, LintContext, Module, Rule
 
 FACTORIES = {"counter", "gauge", "histogram"}
-#: the flight-recorder emission method (FlightRecorder.record_event and
-#: any alias bound as a bare function) — names validate against
-#: EVENT_SCHEMA instead of METRICS_SCHEMA
-RECORD_FUNCS = {"record_event"}
+#: the event-feed methods (FlightRecorder.record_event,
+#: RequestLedger.note_event, and any alias bound as a bare function) —
+#: names validate against EVENT_SCHEMA instead of METRICS_SCHEMA
+RECORD_FUNCS = {"record_event", "note_event"}
 #: receivers that have same-named methods/functions but are not the
 #: metrics registry (np.histogram, pandas plotting, …)
 SKIP_RECEIVERS = {"np", "numpy", "jnp", "scipy", "torch", "plt", "pd",
@@ -47,8 +50,9 @@ SKIP_RECEIVERS = {"np", "numpy", "jnp", "scipy", "torch", "plt", "pd",
 
 class MetricSchemaRule(Rule):
     id = "metric-schema"
-    short = ("registry.counter/gauge/histogram and record_event names "
-             "must be literals declared in observability/schema.py")
+    short = ("registry.counter/gauge/histogram, record_event and "
+             "note_event names must be literals declared in "
+             "observability/schema.py")
 
     def check(self, module: Module,
               ctx: LintContext) -> Iterable[Finding]:
@@ -105,7 +109,12 @@ class MetricSchemaRule(Rule):
 
     def _check_event(self, module: Module, node: ast.Call,
                      ctx: LintContext) -> List[Finding]:
-        """Validate one record_event(...) call against EVENT_SCHEMA."""
+        """Validate one record_event(...)/note_event(...) call against
+        EVENT_SCHEMA (the recorder's and the ledger's feeds share one
+        vocabulary)."""
+        f = node.func
+        fname = (f.attr if isinstance(f, ast.Attribute)
+                 else f.id if isinstance(f, ast.Name) else "record_event")
         name_node = node.args[0] if node.args else None
         if name_node is None:
             for kwarg in node.keywords:
@@ -117,14 +126,14 @@ class MetricSchemaRule(Rule):
                 and isinstance(name_node.value, str)):
             return [self.finding(
                 module, node,
-                "record_event() called with a non-literal event name — "
-                "the flight-record vocabulary must be statically "
-                "enumerable")]
+                f"{fname}() called with a non-literal event name — "
+                f"the step-event vocabulary must be statically "
+                f"enumerable")]
         events = ctx.events_schema
         if events is None or name_node.value in events:
             return []
         return [self.finding(
             module, node,
-            f"flight-recorder event {name_node.value!r} is not declared "
+            f"event {name_node.value!r} (via {fname}) is not declared "
             f"in observability/schema.py EVENT_SCHEMA — declare it "
             f"(with help text) before emitting it")]

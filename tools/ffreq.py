@@ -1,0 +1,331 @@
+#!/usr/bin/env python
+"""Per-request lifecycle inspector for RequestLedger dumps.
+
+The aggregate tools already exist — ``ffstat.py`` reads flight-recorder
+bundles (batch-scoped ring), ``trace_summary.py`` reads Chrome traces.
+This one reads PER-REQUEST timelines (observability/ledger.py) and
+answers "which request was slow, and where did its time go".
+
+Reads any of:
+
+- a **ledger snapshot** (``RequestLedger.snapshot()`` JSON: a dict with
+  ``live``/``retired`` timeline lists — e.g.
+  ``json.dump(llm.request_timelines(), ...)`` wrapped, or the raw
+  snapshot);
+- a **watchdog bundle** (``ffbundle_*.json`` — its ``ledger`` section);
+- a **bench round record** (``bench_results/<round>.json`` with an
+  ``slo`` block — prints the attainment report; the slowest request's
+  embedded timeline is inspectable with ``--guid``);
+- a bare **timeline list** (``llm.request_timelines()`` dumped as-is).
+
+Usage:
+    python tools/ffreq.py FILE.json [FILE2.json ...]
+        [--slowest N] [--guid G] [--slo TTFT[:TPOT]] [--selftest]
+
+``--slowest N``  rank the N slowest retired requests by TTFT
+                 (default 5)
+``--guid G``     print request G's full timeline (every ledger event
+                 with per-event deltas)
+``--slo SPEC``   re-evaluate attainment + goodput against an ad-hoc
+                 policy, e.g. ``--slo 0.5`` (TTFT 500 ms) or
+                 ``--slo 0.5:0.05`` (plus TPOT 50 ms/token)
+``--selftest``   build a synthetic two-request ledger (one warm prefix
+                 hit, one cold) end-to-end and print it — the CI smoke
+                 for the whole per-request path (tools/run_tier1.sh)
+
+Exit 1 on an unreadable input or one without per-request data.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Any, Dict, List, Optional, Tuple
+
+# direct invocation (`python tools/ffreq.py`) puts tools/ on sys.path,
+# not the repo root — the --slo/--selftest imports need the package
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+
+# --------------------------------------------------------------- loading
+def load(path: str) -> Any:
+    with open(path) as f:
+        return json.load(f)
+
+
+def timelines_of(doc: Any) -> Tuple[List[Dict], Optional[Dict]]:
+    """(timelines, slo_block) from any supported document shape."""
+    if isinstance(doc, list):
+        return [t for t in doc if isinstance(t, dict) and "guid" in t], None
+    if not isinstance(doc, dict):
+        return [], None
+    led = doc.get("ledger") if isinstance(doc.get("ledger"), dict) else doc
+    tls = [t for key in ("retired", "live")
+           for t in (led.get(key) or []) if isinstance(t, dict)]
+    slo = doc.get("slo") if isinstance(doc.get("slo"), dict) else None
+    if not tls and slo and isinstance(slo.get("slowest"), dict):
+        tls = [slo["slowest"]]
+    return tls, slo
+
+
+# ------------------------------------------------------------ formatting
+def _ms(v: Optional[float]) -> str:
+    return "-" if v is None else f"{v * 1e3:8.1f}"
+
+
+def phases_of(t: Dict[str, Any]) -> Dict[str, Optional[float]]:
+    """Per-phase wall-time split of one timeline: queued (enqueue ->
+    admit), ttft (admit -> first commit), decode (first -> last
+    commit).  The ttft phase covers prefill + the first step's sync —
+    the serving latency the driver controls.  The decode span comes
+    from the timeline's first/last-commit SCALARS, which never suffer
+    ring eviction (long generations overflow the bounded per-request
+    event ring and drop their earliest commit events); the ring is
+    only the fallback for hand-built timeline dicts."""
+    first = t.get("first_commit_mono")
+    last = t.get("last_commit_mono")
+    if first is None or last is None:
+        for ev in t.get("events") or []:
+            if ev.get("name") == "commit":
+                if first is None:
+                    first = ev.get("t")
+                last = ev.get("t")
+    return {
+        "queued": t.get("queue_s"),
+        "ttft": t.get("ttft_s"),
+        "decode": (last - first
+                   if first is not None and last is not None else None),
+    }
+
+
+def ranking(timelines: List[Dict], n: int) -> str:
+    """The slowest-N retired requests by TTFT, with the per-phase
+    split, token counts and SLO verdicts where present."""
+    retired = [t for t in timelines if t.get("retired")]
+    live = [t for t in timelines if not t.get("retired")]
+    lines = [f"{len(retired)} retired, {len(live)} in-flight"]
+    if live:
+        lines.append("in-flight guids: "
+                     + " ".join(str(t["guid"]) for t in live))
+    if not retired:
+        return "\n".join(lines)
+    # ttft_s=None (no token ever produced) is the worst case, not the
+    # fastest — rank it first
+    retired.sort(key=lambda t: -(float("inf") if t.get("ttft_s") is None
+                                 else t["ttft_s"]))
+    lines.append(
+        f"\n{'guid':>9} {'ttft ms':>9} {'tpot ms':>9} {'queue ms':>9} "
+        f"{'decode ms':>9} {'tokens':>7} {'prefix':>7} {'slo':>9}")
+    for t in retired[:n]:
+        ph = phases_of(t)
+        slo = t.get("slo")
+        verdict = ("-" if not slo
+                   else "ok" if slo.get("attained") else
+                   ("miss:" + "+".join(
+                       k[:-3] for k in ("ttft_ok", "tpot_ok")
+                       if not slo.get(k))))
+        lines.append(
+            f"{t.get('guid', '?'):>9} {_ms(t.get('ttft_s'))} "
+            f"{_ms(t.get('tpot_s'))} {_ms(ph['queued'])} "
+            f"{_ms(ph['decode'])} {t.get('tokens') or 0:>7} "
+            f"{t.get('prefix_matched') or 0:>7} {verdict:>9}")
+    return "\n".join(lines)
+
+
+def phase_breakdown(timelines: List[Dict]) -> str:
+    """Aggregate per-phase means/maxima over retired requests — where
+    the latency budget goes across the batch."""
+    retired = [t for t in timelines if t.get("retired")]
+    if not retired:
+        return "  (no retired requests)"
+    lines = [f"{'phase':<8} {'mean ms':>9} {'max ms':>9} {'n':>5}"]
+    for phase in ("queued", "ttft", "decode"):
+        vals = [v for v in (phases_of(t)[phase] for t in retired)
+                if v is not None]
+        if not vals:
+            continue
+        lines.append(f"{phase:<8} {sum(vals) / len(vals) * 1e3:>9.1f} "
+                     f"{max(vals) * 1e3:>9.1f} {len(vals):>5}")
+    return "\n".join(lines)
+
+
+def timeline_view(t: Dict[str, Any]) -> str:
+    """One request's full event timeline with inter-event deltas."""
+    head = (f"guid {t.get('guid')}  prompt {t.get('prompt_len')}  "
+            f"tokens {t.get('tokens') if t.get('retired') else '(live)'}  "
+            f"prefix_matched {t.get('prefix_matched') or 0}")
+    lat = (f"queue {_ms(t.get('queue_s')).strip()}ms  "
+           f"ttft {_ms(t.get('ttft_s')).strip()}ms  "
+           f"tpot {_ms(t.get('tpot_s')).strip()}ms/token")
+    lines = [head, lat]
+    if t.get("events_dropped"):
+        lines.append(f"({t['events_dropped']} early events dropped from "
+                     f"the per-request ring)")
+    evs = t.get("events") or []
+    prev = None
+    for ev in evs:
+        dt = "" if prev is None else f"+{(ev.get('t', 0) - prev) * 1e3:.1f}ms"
+        prev = ev.get("t", prev)
+        payload = " ".join(f"{k}={v}" for k, v in ev.items()
+                           if k not in ("name", "t"))
+        lines.append(f"  {dt:>12} {ev.get('name', '?'):<14} {payload}")
+    return "\n".join(lines)
+
+
+def slo_section(timelines: List[Dict], spec: Optional[str],
+                stored: Optional[Dict]) -> Optional[str]:
+    """The attainment report: re-evaluated against ``--slo SPEC`` when
+    given, else the document's stored block."""
+    if spec:
+        from flexflow_tpu.observability import slo_report_from
+
+        rep = slo_report_from(timelines, _parse_slo(spec))
+    elif stored:
+        rep = stored
+    else:
+        return None
+    pol_d = rep.get("policy") or {}
+    lines = [f"policy: ttft {pol_d.get('ttft_s')}s  "
+             f"tpot {pol_d.get('tpot_s')}s/token",
+             f"requests {rep.get('requests')}  "
+             f"attained {rep.get('attained')} "
+             f"({_pct(rep.get('attainment'))}; "
+             f"ttft {_pct(rep.get('ttft_attainment'))}, "
+             f"tpot {_pct(rep.get('tpot_attainment'))})",
+             f"goodput {rep.get('goodput_tokens_per_s')} tokens/s "
+             f"({rep.get('attained_tokens')}/{rep.get('total_tokens')} "
+             f"tokens over {rep.get('window_s')}s window)"]
+    slowest = rep.get("slowest")
+    if isinstance(slowest, dict):
+        lines.append(f"slowest: guid {slowest.get('guid')} "
+                     f"ttft {_ms(slowest.get('ttft_s')).strip()}ms")
+    return "\n".join(lines)
+
+
+def _pct(v) -> str:
+    return "-" if v is None else f"{v * 100:.1f}%"
+
+
+def _parse_slo(spec: str):
+    """``"0.5"`` / ``"0.5:0.05"`` / ``":0.05"`` -> SLOPolicy (seconds)."""
+    from flexflow_tpu.observability import SLOPolicy
+
+    parts = spec.split(":")
+    if len(parts) > 2:
+        raise ValueError(f"--slo {spec!r}: expected TTFT[:TPOT]")
+    return SLOPolicy(
+        ttft_s=float(parts[0]) if parts[0] else None,
+        tpot_s=float(parts[1]) if len(parts) > 1 and parts[1] else None)
+
+
+# ------------------------------------------------------------------ main
+def print_doc(path: str, doc: Any, slowest: int, guid: Optional[int],
+              slo_spec: Optional[str]) -> int:
+    timelines, stored_slo = timelines_of(doc)
+    if not timelines and not stored_slo:
+        print(f"{path}: no per-request ledger data (expected a ledger "
+              f"snapshot, a watchdog bundle with a `ledger` section, a "
+              f"bench record with an `slo` block, or a timeline list)",
+              file=sys.stderr)
+        return 1
+    print(f"== {path}")
+    print(ranking(timelines, slowest))
+    print("\n-- per-phase breakdown (retired requests)")
+    print(phase_breakdown(timelines))
+    slo = slo_section(timelines, slo_spec, stored_slo)
+    if slo:
+        print("\n-- SLO attainment")
+        print(slo)
+    if guid is not None:
+        hit = next((t for t in timelines if t.get("guid") == guid), None)
+        print(f"\n-- timeline for guid {guid}")
+        print(timeline_view(hit) if hit is not None
+              else "  (not in this dump)")
+    return 0
+
+
+def selftest() -> int:
+    """End-to-end smoke: feed a synthetic two-request lifecycle (one
+    warm prefix hit, one cold — distinct timelines) through a real
+    RequestLedger, dump, reload, pretty-print and attainment-check.
+    Used by tools/run_tier1.sh."""
+    import tempfile
+
+    from flexflow_tpu.observability import (RequestLedger, SLOPolicy,
+                                            validate_slo_block)
+
+    led = RequestLedger(retired_capacity=8, events_per_request=16)
+    led.set_slo_policy(SLOPolicy(ttft_s=60.0, tpot_s=60.0))
+    for guid, matched in ((1, 0), (2, 48)):        # cold, then warm
+        led.note_event("enqueue", guid=guid, prompt_len=64)
+        led.note_event("admit", guid=guid, row=guid - 1, prompt_len=64)
+        if matched:
+            led.note_event("prefix-match", guid=guid, matched=matched)
+        led.note_event("prefill-chunk", chunk=64, rows=1)
+        led.note_event("commit", guid=guid, tokens=1)
+        led.note_event("decode-step", block=4, rows=1)
+        led.note_event("commit", guid=guid, tokens=4)
+        led.note_event("retire", guid=guid, tokens=5)
+    led.note_event("enqueue", guid=3, prompt_len=8)
+    led.note_event("admit", guid=3, row=0, prompt_len=8)  # stays in flight
+    snap = led.snapshot()
+    d = tempfile.mkdtemp(prefix="ffreq_selftest_")
+    path = os.path.join(d, "ledger.json")
+    with open(path, "w") as f:
+        json.dump(snap, f)
+    rc = print_doc(path, load(path), slowest=5, guid=2, slo_spec="60:60")
+    rep = led.slo_report()
+    errs = validate_slo_block(rep)
+    ok = (rc == 0 and not errs and rep["requests"] == 2
+          and rep["attainment"] == 1.0
+          and rep["total_tokens"] == 10
+          and led.in_flight_guids() == [3]
+          and led.timeline(2)["prefix_matched"] == 48)
+    print(f"\nffreq selftest {'OK' if ok else 'FAILED: ' + str(errs)}: "
+          f"{path}")
+    return 0 if ok else 1
+
+
+def main(argv) -> int:
+    ap = argparse.ArgumentParser(
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("paths", nargs="*", help="ledger/bundle/record JSON")
+    ap.add_argument("--slowest", type=int, default=5, metavar="N")
+    ap.add_argument("--guid", type=int, default=None, metavar="G")
+    ap.add_argument("--slo", default=None, metavar="TTFT[:TPOT]",
+                    help="re-evaluate attainment against these targets "
+                         "(seconds), e.g. 0.5 or 0.5:0.05")
+    ap.add_argument("--selftest", action="store_true")
+    args = ap.parse_args(argv[1:])
+    if args.selftest:
+        return selftest()
+    if args.slo:
+        try:
+            _parse_slo(args.slo)
+        except ValueError as e:
+            print(f"ffreq: bad --slo spec: {e}", file=sys.stderr)
+            return 1
+    if not args.paths:
+        ap.print_usage(sys.stderr)
+        return 1
+    rc = 0
+    for path in args.paths:
+        try:
+            doc = load(path)
+        except Exception as e:
+            print(f"{path}: unreadable ({type(e).__name__}: {e})",
+                  file=sys.stderr)
+            rc = 1
+            continue
+        rc = max(rc, print_doc(path, doc, args.slowest, args.guid,
+                               args.slo))
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))

@@ -6,8 +6,11 @@ Reads any of:
 - a **watchdog bundle** (``ffbundle_*.json`` from
   ``flexflow_tpu/observability/watchdog.py`` — stall, SIGTERM or
   SIGUSR1 dump): prints the stall diagnosis (reason, last heartbeat,
-  the event the ring ends on), a per-phase timing table derived from
-  the ring, the last N events, a thread summary and key metrics;
+  the event the ring ends on, the GUIDs of in-flight non-retired
+  ledger requests — the stall suspects, inspectable per request with
+  ``tools/ffreq.py BUNDLE --guid G``), a per-phase timing table
+  derived from the ring, the last N events, a thread summary and key
+  metrics;
 - a **raw flight-record dump** (``FlightRecorder.snapshot()`` JSON:
   a dict with an ``events`` list);
 - a **bench round record** (``bench_results/<round>.json`` with a
@@ -152,6 +155,24 @@ def diagnosis(doc: Dict[str, Any],
         elif last.get("name") == "compile":
             lines.append("=> ring ends on compile: likely a hung or "
                          "looping compilation")
+    led = doc.get("ledger")
+    if isinstance(led, dict):
+        live = [t for t in (led.get("live") or [])
+                if isinstance(t, dict)]
+        inflight = [t for t in live if t.get("admit_mono") is not None]
+        if inflight:
+            # the stall suspects: admitted but never retired when the
+            # bundle dumped — inspect each with
+            # `tools/ffreq.py BUNDLE --guid G`
+            lines.append(
+                "in-flight (non-retired) requests: "
+                + " ".join(
+                    f"guid {t.get('guid')} "
+                    f"(committed {t.get('committed', 0)})"
+                    for t in inflight))
+        elif live:
+            lines.append(f"{len(live)} enqueued request(s), none "
+                         f"admitted yet")
     jx = doc.get("jax")
     if isinstance(jx, dict) and jx:
         lines.append("jax: " + " ".join(

@@ -28,4 +28,11 @@
 (cd "$(dirname "$0")/.." \
  && env JAX_PLATFORMS=cpu python tools/ffstat.py --selftest >/dev/null) \
  || { echo "ffstat/flight-recorder selftest FAILED" >&2; exit 1; }
+# Request-ledger/ffreq smoke: the per-request twin (ledger lifecycle ->
+# snapshot on disk -> pretty-print -> SLO attainment/goodput check) so
+# a broken per-request accounting path fails CI before a BENCH round
+# claims goodput numbers from it.
+(cd "$(dirname "$0")/.." \
+ && env JAX_PLATFORMS=cpu python tools/ffreq.py --selftest >/dev/null) \
+ || { echo "ffreq/request-ledger selftest FAILED" >&2; exit 1; }
 set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c); exit $rc
