@@ -271,6 +271,14 @@ class RequestLedger:
                     t["queue_s"] = now - t["enqueue_mono"]
             elif name == "prefix-match":
                 t["prefix_matched"] = int(payload.get("matched", 0))
+            elif name == "preempt":
+                # un-admit: the request left its row for the pending
+                # queue — broadcast driver events must stop landing on
+                # it until the next admit (paged KV preemption)
+                t["preempts"] += 1
+                self._admitted.pop(t["guid"], None)
+            elif name == "restore":
+                t["restored_tokens"] += int(payload.get("tokens", 0))
             elif name == "commit":
                 n = int(payload.get("tokens", 0))
                 t["committed"] += n
@@ -319,6 +327,7 @@ class RequestLedger:
             "first_commit_mono": None, "first_commit_tokens": 0,
             "last_commit_mono": None,
             "accepted": 0, "speculated": 0,
+            "preempts": 0, "restored_tokens": 0,
             "retired": False, "retire_mono": None,
             "tokens": None, "ttft_s": None, "tpot_s": None,
             "latency_s": None, "slo": None,

@@ -169,6 +169,55 @@ METRICS_SCHEMA = {
         "help": "HBM pinned by a compiled record's KV caches (K + V + "
                 "scales at the padded allocation), labeled model=<id>.",
     },
+    # ----------------------------------------------------- paged KV
+    # (serving/kv_pager.py: block-granular page accounting + host-RAM
+    # spill + preemptive scheduling over the dense cache rows)
+    "serving_kv_pages_total": {
+        "type": "gauge",
+        "help": "Page budget of the KV pager (pages of page_len "
+                "committed-KV positions the scheduler may lease "
+                "across rows + resident prefix-pool entries).",
+    },
+    "serving_kv_pages_free": {
+        "type": "gauge",
+        "help": "Unleased pages in the KV pager's budget (clamped at "
+                "0 while forced decode-block growth overcommits; the "
+                "overage is trued up by preemption at the next fold "
+                "boundary and visible in the pager snapshot).",
+    },
+    "serving_kv_spill_bytes_total": {
+        "type": "counter",
+        "help": "KV bytes fetched device->host by preemption spills "
+                "and prefix-pool page spills (bucketed transfers "
+                "outside the jitted steps; int8 caches spill at ~half "
+                "the bf16 byte cost).",
+    },
+    "serving_kv_restore_bytes_total": {
+        "type": "counter",
+        "help": "KV bytes restored host->device at re-admission "
+                "(device_put + the jitted donated row write, "
+                "InferenceManager.restore_row).",
+    },
+    "serving_preemptions_total": {
+        "type": "counter",
+        "help": "Requests preempted by the KV pager, labeled "
+                "reason=pages (lease growth exhausted the budget) | "
+                "admission (pressure-aware scheduler freed a row/pages "
+                "for a TTFT-threatened queue head) | pool (a pooled "
+                "prefix's pages were reclaimed).  The preempted "
+                "request re-enters the pending queue with resume "
+                "priority and restores or recomputes at re-admission.",
+    },
+    "serving_admission_blocked_total": {
+        "type": "counter",
+        "help": "Admission passes that left the queue head waiting, "
+                "labeled reason=no_rows|no_pages — counted once per "
+                "(request, reason) transition, not per retry, so the "
+                "total reads as 'requests that experienced this "
+                "block', and queue_wait_s spikes in tools/ffreq.py "
+                "are attributable (each transition also lands a "
+                "ledger note on the request's timeline).",
+    },
     # ------------------------------------------------- SLO / goodput
     # (per-request ledger, observability/ledger.py: evaluated per
     # retired request against the installed SLOPolicy; all four refresh
@@ -254,8 +303,38 @@ EVENT_SCHEMA = {
         "help": "Retired row donated to the prefix pool (guid, slot, "
                 "length).",
     },
+    "preempt": {
+        "help": "Running request preempted by the KV pager (guid, row, "
+                "reason=pages|admission, mode=spill|recompute, tokens "
+                "= committed KV positions released).  The request "
+                "re-enters the pending queue with resume priority; "
+                "look for the following restore/admit pair — the "
+                "preempt->restore/recompute span — in its ffreq "
+                "timeline.",
+    },
+    "spill": {
+        "help": "Committed KV fetched device->host (guid for request "
+                "spills, slot for prefix-pool page spills; tokens, "
+                "bytes).  A bucketed transfer outside the jitted "
+                "steps — never inside the decode loop.",
+    },
+    "restore": {
+        "help": "Spilled KV restored host->device at re-admission "
+                "(guid, row, tokens, bytes) — the device_put + jitted "
+                "donated row write; the alternative outcome is plain "
+                "re-prefill (recompute), visible as the request's "
+                "prefill-chunk events instead.",
+    },
+    "admission-blocked": {
+        "help": "The queue head could not be admitted (guid, "
+                "reason=no_rows|no_pages); noted once per (request, "
+                "reason) transition so a timeline shows WHY its "
+                "queue_wait_s grew.",
+    },
     "evict": {
-        "help": "Prefix-pool entry evicted (slot, reason=lru|superseded).",
+        "help": "Prefix-pool entry evicted (slot, reason=lru|superseded"
+                "|host-lru; slot=None for spilled entries dropped from "
+                "the host-RAM ring).",
     },
     "host-sync": {
         "help": "Device->host materialization of step results (n); the "

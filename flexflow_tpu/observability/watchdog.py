@@ -167,6 +167,18 @@ def collect_bundle(reason: str, heartbeat: Optional[Heartbeat] = None,
         "threads": _thread_stacks(),
         "jax": _jax_stats(),
     }
+    # paged-KV state: pages free/leased + spilled GUIDs per live pager
+    # (lazy import — serving imports observability at module load, so
+    # the reverse edge must only exist at bundle time; best-effort:
+    # the dump path must survive a partial install)
+    try:
+        from ..serving.kv_pager import pager_snapshots
+
+        pagers = pager_snapshots()
+        if pagers:
+            bundle["kv_pager"] = pagers
+    except Exception:  # pragma: no cover - partial install
+        pass
     if extra:
         bundle.update(extra)
     return bundle

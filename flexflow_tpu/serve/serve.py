@@ -307,7 +307,10 @@ class LLM:
                 ssms: Sequence["SSM"] = (),
                 ff_config: Optional[FFConfig] = None,
                 cache_dtype=None,
-                kv_cache_dtype: Optional[str] = None):
+                kv_cache_dtype: Optional[str] = None,
+                kv_page_budget_bytes: Optional[int] = None,
+                kv_page_len: int = 64,
+                kv_spill_policy: str = "auto"):
         """Build + compile the serving graph (reference serve.py:303+).
 
         With ``ssms`` the LLM compiles in TREE_VERIFY mode and each SSM in
@@ -319,6 +322,16 @@ class LLM:
         cache HBM reads — docs/INTERNALS.md "KV cache memory layout &
         dtype").  Also settable via FFConfig.kv_cache_dtype; applies to
         the LLM and every SSM.
+
+        ``kv_page_budget_bytes``: enable the paged KV allocator
+        (serving/kv_pager.py) with this committed-KV byte budget: cache
+        rows lease ``kv_page_len``-token pages against it, and under
+        load the scheduler preempts rows (spilling their KV to host
+        RAM or dropping it for recompute, priced per
+        ``kv_spill_policy``: "auto" | "restore" | "recompute") so
+        oversubscribed traffic keeps a larger resident batch than
+        worst-case row sizing allows.  None (default) keeps the
+        row-capped behavior — docs/INTERNALS.md "Paged KV cache".
         """
         from . import _resolved_config
 
@@ -346,10 +359,22 @@ class LLM:
             self.model, mode=mode, max_requests=max_requests_per_batch,
             max_seq_length=max_seq_length, cache_dtype=cache_dtype,
             kv_cache_dtype=kv_cache_dtype)
+        pager = None
+        if kv_page_budget_bytes is not None:
+            from ..serving.kv_pager import (RecoveryPolicy,
+                                            pager_for_budget)
+
+            pager = pager_for_budget(
+                kv_page_budget_bytes,
+                self.im.kv_cache_stats(self.model_id).bytes_per_token,
+                page_len=kv_page_len,
+                policy=RecoveryPolicy.for_record(
+                    self.im, self.model_id, mode=kv_spill_policy))
         self.rm = RequestManager(
             max_requests_per_batch=max_requests_per_batch,
             max_tokens_per_batch=max_tokens_per_batch,
-            max_sequence_length=max_seq_length)
+            max_sequence_length=max_seq_length,
+            kv_pager=pager)
         tok_dir = self.download_hf_tokenizer_if_needed()
         bos = self.hf_config.get("bos_token_id")
         eos = self.hf_config.get("eos_token_id")
@@ -477,6 +502,15 @@ class LLM:
         policy = (SLOPolicy(ttft_s=ttft_s, tpot_s=tpot_s)
                   if (ttft_s is not None or tpot_s is not None) else None)
         return get_ledger().slo_report(policy)
+
+    def kv_pager_state(self) -> Optional[Dict[str, Any]]:
+        """Snapshot of the paged-KV allocator (pages total/free,
+        per-slot leases, spilled GUIDs, spill/restore/preemption
+        odometers) — None when paging is off.  The same state rides
+        watchdog bundles (``tools/ffstat.py`` prints it)."""
+        if self.rm is None or self.rm.kv_pager is None:
+            return None
+        return self.rm.kv_pager.snapshot()
 
     def watchdog(self, stall_timeout: float = 120.0,
                  bundle_dir: Optional[str] = None,

@@ -9,6 +9,8 @@ from .batch_config import (BatchConfig, BeamInferenceResult,
                            BeamSearchBatchConfig, InferenceResult,
                            TreeVerifyBatchConfig)
 from .inference_manager import InferenceManager
+from .kv_pager import (KVPager, PressureScheduler, RecoveryPolicy,
+                       pager_for_budget, pager_snapshots)
 from .prefix_cache import PrefixCache, PrefixEntry
 from .request_manager import (GenerationConfig, GenerationResult, ProfileInfo,
                               Request, RequestManager, get_request_manager,

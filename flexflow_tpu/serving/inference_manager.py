@@ -1174,6 +1174,122 @@ class InferenceManager:
             record["steps"][key], record["caches"],
             _feed_array(np.int32(src_row)), _feed_array(np.int32(dst_row)))
 
+    # ------------------------------------------------------ paged KV spill
+    def supports_kv_spill(self, model_id: int) -> bool:
+        """Row spill/restore needs the single-record cache layout (same
+        constraint as the prefix-row copy); stage-partitioned (pp)
+        caches live on per-stage submeshes the row transfers are not
+        wired through — pp-served rows preempt to recompute instead."""
+        return "pp_stages" not in self.models[model_id]
+
+    def model_param_bytes(self, model_id: int) -> Dict[str, int]:
+        """{"elements", "bytes"} across the record's committed params —
+        the RecoveryPolicy's decode-roofline inputs (2 flops/element
+        per token; weight bytes stream once per prefill chunk).
+        Cached on the record (the tree walk is O(params))."""
+        record = self.models[model_id]
+        cached = record.get("_param_bytes")
+        if cached is None:
+            elements = nbytes = 0
+            for lp in (record["model"].params or {}).values():
+                for v in lp.values():
+                    elements += int(v.size)
+                    nbytes += int(v.size) * jnp.dtype(v.dtype).itemsize
+            cached = record["_param_bytes"] = {"elements": elements,
+                                               "bytes": nbytes}
+        return cached
+
+    def _build_fetch_row(self, record, L: int):
+        """Jitted (NOT donated — the caches stay resident) slice of one
+        cache row's first ``L`` positions across every layer/part; one
+        compiled variant per pow2 length bucket, dynamic row index."""
+
+        def fetch(caches, row):
+            def cut(c):
+                # fflint: disable=retrace-hazard  rank dispatch over the
+                # record's FIXED cache pytree ([R,KV,S] scale leaves vs
+                # [R,KV,S,D] K/V) — one variant per record, not per call
+                if c.ndim == 3:      # [R, KV, S] scale rows (int8)
+                    return jax.lax.dynamic_slice(
+                        c, (row, 0, 0), (1, c.shape[1], L))
+                return jax.lax.dynamic_slice(
+                    c, (row, 0, 0, 0), (1, c.shape[1], L, c.shape[3]))
+
+            return jax.tree.map(cut, caches)
+
+        return jax.jit(fetch)
+
+    def _build_restore_row(self, record, L: int):
+        """Jitted, donated row write: scatter a fetched ``L``-position
+        segment tree back into the caches at a dynamic destination row
+        (the host->device half of spill/restore; the device_put of the
+        host segment happens at the call's argument feed)."""
+
+        def restore(caches, seg, row):
+            def put(c, s):
+                # fflint: disable=retrace-hazard  rank dispatch over the
+                # record's FIXED cache pytree — one variant per record
+                if c.ndim == 3:
+                    return jax.lax.dynamic_update_slice(c, s, (row, 0, 0))
+                return jax.lax.dynamic_update_slice(c, s, (row, 0, 0, 0))
+
+            out = jax.tree.map(put, caches, seg)
+            if record.get("cache_pspec") is not None:
+                out = pin_cache_layout(out, record["mesh"],
+                                       record["cache_pspec"])
+            return out
+
+        return jax.jit(restore, donate_argnums=(0,))
+
+    def fetch_row(self, model_id: int, row: int, length: int
+                  ) -> Optional[Dict[str, Any]]:
+        """Materialize cache row ``row``'s first ``length`` positions to
+        host numpy for every serving-attention layer (the spill half of
+        the KV pager).  The fetched span is the pow2 BUCKET covering
+        ``length`` (bounded jit variants, same policy as copy_prefix);
+        positions past ``length`` may carry unrelated KV, which is safe
+        under the prefix-cache over-copy argument — a later restore
+        writes them back below the attended depth.  Returns
+        ``{"layers": {layer: {part: np.ndarray}}, "len": bucket,
+        "valid": length, "bytes": n}`` or None for empty spans /
+        unsupported (pp) records.  One transfer batch — the whole tree
+        rides a single device_get."""
+        record = self.models[model_id]
+        if ("pp_stages" in record or length <= 0
+                or not record.get("caches")):
+            return None
+        L = pow2_bucket(length, record["alloc_len"]) or record["alloc_len"]
+        key = ("fetch_row", L)
+        if key not in record["steps"]:
+            record["steps"][key] = self._build_fetch_row(record, L)
+        seg = _retry_transient(record["steps"][key], record["caches"],
+                               _feed_array(np.int32(row)))
+        host = jax.tree.map(np.asarray, jax.device_get(seg))
+        self.note_host_sync()
+        nbytes = sum(int(a.nbytes) for lp in host.values()
+                     for a in lp.values())
+        return {"layers": host, "len": L, "valid": int(length),
+                "bytes": nbytes}
+
+    def restore_row(self, model_id: int, row: int,
+                    payload: Dict[str, Any]) -> int:
+        """Write a ``fetch_row`` payload back into cache row ``row``
+        (the restore half of the KV pager; any row — restores need not
+        land where the spill came from).  Returns the bytes moved."""
+        record = self.models[model_id]
+        assert "pp_stages" not in record, (
+            "restore_row: pipeline-parallel records are not supported — "
+            "gate with supports_kv_spill")
+        L = payload["len"]
+        key = ("restore_row", L)
+        if key not in record["steps"]:
+            record["steps"][key] = self._build_restore_row(record, L)
+        seg = jax.tree.map(_feed_array, payload["layers"])
+        record["caches"] = _retry_transient(
+            record["steps"][key], record["caches"], seg,
+            _feed_array(np.int32(row)))
+        return int(payload["bytes"])
+
     def reset_request_rows(self, model_id: int, rows: List[int]):
         """Zero cache bookkeeping for retired rows.  Cache contents need no
         clearing — the attention mask never reads past a row's depth."""

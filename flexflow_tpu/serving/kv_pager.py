@@ -1,0 +1,548 @@
+"""Paged KV cache: block-granular allocator, host-RAM spill, preemption.
+
+The serving stack sizes every cache row to the full allocation up front
+(``compile_model_and_allocate_buffer``: ``rows = max_requests *
+beam_width`` dense ``[R, KV, alloc_len, D]`` slabs — mirroring the
+reference's statically-sized per-request KV, src/runtime/
+request_manager.cc / inference_manager.cc), so the resident batch is
+hard-capped by worst-case row HBM even though short requests never
+touch most of their slab.  This module is the allocator half of the
+fix (vLLM's PagedAttention block tables / the reference's planned
+paged-KV direction, adapted to this stack's row-oriented caches):
+
+- Cache rows LEASE refcounted, fixed-length **pages** of the KV length
+  axis instead of owning a full-length slab: a row's page count tracks
+  its committed KV (``ceil(len / page_len)``), and the pager enforces a
+  process-level page budget — the HBM accounting a scheduler needs to
+  admit more rows than worst-case sizing would allow.
+- Under pressure, victim rows **spill** their committed KV to host RAM
+  (``InferenceManager.fetch_row`` — a bucketed device->host fetch
+  outside any jitted step) or are dropped for **recompute**, releasing
+  their pages; a preempted request re-enters the pending queue with
+  resume priority and, at re-admission, either **restores** its KV
+  (``InferenceManager.restore_row`` — ``device_put`` + a jitted,
+  donated row write) or re-prefills it chunk by chunk.  Both paths are
+  bit-exact: KV depends only on token values and absolute positions
+  (the prefix-cache correctness argument, prefix_cache.py).
+- The restore-vs-recompute decision is **priced** by the search cost
+  model (:class:`RecoveryPolicy`): restore = bytes / host-link
+  bandwidth, recompute = a roofline over ``cached_len`` tokens of
+  chunked prefill (``search/cost_model.MachineModel`` — the
+  BENCH_r04-validated scaling model's machine description).
+- Admission is **pressure-aware** (:class:`PressureScheduler`): when
+  the pending queue's head has waited long enough to threaten the
+  installed :class:`~flexflow_tpu.observability.SLOPolicy` TTFT
+  target, the scheduler preempts the lowest-priority (most recently
+  admitted) row to free pages/rows — trading one row's TPOT for the
+  queue's TTFT, which is the balance FCFS admission cannot express.
+
+Alignment invariants (shared with the prefix cache and the Pallas
+kernels): ``page_len`` must be a multiple of ``PREFIX_ALIGN`` (16, the
+flash-prefill append-window contract) AND of 32 (the int8 sublane RMW
+window, docs/STATIC_ANALYSIS.md pallas-tiling table), so page
+boundaries are always legal chunk-start depths for every cache dtype.
+Restore lengths align DOWN to 16 like prefix matches — the resumed
+prefill recomputes the unaligned tail.
+
+Shape stability (the zero-recompile contract): paging lives entirely
+in the allocator and the admission path.  The jitted decode/prefill
+steps never see a page table — rows stay dense device slabs, and
+spill/restore are separate bucketed transfers outside the decode
+loop, so ``TestRetraceGuard`` pins a warmed decode loop to ZERO
+compiles with the pager enabled.  The page budget is therefore an
+*accounting* bound over committed-KV bytes (what admission control
+and preemption need); physically freeing dense frames awaits a paged
+Mosaic attend kernel (docs/INTERNALS.md "Paged KV cache" notes the
+boundary honestly).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import weakref
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from ..observability import get_flight_recorder, get_registry
+from .prefix_cache import PREFIX_ALIGN, align_down
+
+#: smallest legal page length: lcm(16, 32) — 16-aligned chunk starts
+#: for bf16 flash prefill AND 32-wide int8 RMW append windows, so page
+#: boundaries are valid start depths for every cache dtype.
+PAGE_ALIGN = 32
+
+#: default page length (tokens of KV per page).  64 = two int8 RMW
+#: windows; small enough that short requests strand < one chunk of HBM.
+DEFAULT_PAGE_LEN = 64
+
+
+def pages_for(length: int, page_len: int) -> int:
+    """Pages needed to hold ``length`` committed KV positions."""
+    if length <= 0:
+        return 0
+    return -(-int(length) // int(page_len))
+
+
+class PageLease:
+    """One slot's page holding: a running request's row or a resident
+    prefix-pool entry (a slot is owned by exactly one of those at a
+    time, so leases key by slot).  ``refs`` counts borrowers beyond the
+    owner — a pooled entry pinned by in-flight admissions keeps its
+    pages until released (the prefix pool's refcount rule, extended to
+    pages)."""
+
+    __slots__ = ("slot", "pages", "length", "owner", "guid", "refs",
+                 "last_use")
+
+    def __init__(self, slot: int, pages: int, length: int, owner: str,
+                 guid: Optional[int]):
+        self.slot = slot
+        self.pages = pages
+        self.length = length
+        self.owner = owner          # "req" | "pool"
+        self.guid = guid
+        self.refs = 0
+        self.last_use = 0.0
+
+
+class RecoveryPolicy:
+    """Prices restore-from-host against recompute-by-prefill for a
+    preempted request with ``cached_len`` committed KV positions.
+
+    - restore cost  = spilled bytes / ``host_bandwidth`` (the
+      host<->device link; defaults to the machine model's DCN figure —
+      the conservative off-chip link in the BENCH_r04-validated
+      scaling model).
+    - recompute cost = ``cached_len`` tokens of chunked prefill under
+      the same machine's roofline: ``max(flops/peak_flops,
+      weight_bytes/hbm_bandwidth)`` per token — prefill streams the
+      weights once per chunk, so the per-token weight stream divides
+      by ``chunk``.
+
+    ``mode``: "auto" prices per decision; "restore"/"recompute" pin it
+    (tests and the bench A/B arms use the pins).
+    """
+
+    def __init__(self, machine=None, flops_per_token: float = 0.0,
+                 weight_bytes: float = 0.0,
+                 kv_bytes_per_token: float = 0.0,
+                 prefill_chunk: int = 256,
+                 host_bandwidth: Optional[float] = None,
+                 mode: str = "auto"):
+        if machine is None:
+            from ..search.cost_model import SimpleMachineModel
+
+            machine = SimpleMachineModel(1)
+        assert mode in ("auto", "restore", "recompute"), mode
+        self.machine = machine
+        self.flops_per_token = float(flops_per_token)
+        self.weight_bytes = float(weight_bytes)
+        self.kv_bytes_per_token = float(kv_bytes_per_token)
+        self.prefill_chunk = max(1, int(prefill_chunk))
+        self.host_bandwidth = float(host_bandwidth
+                                    or machine.dcn_bandwidth)
+        self.mode = mode
+
+    def restore_s(self, nbytes: int) -> float:
+        return float(nbytes) / self.host_bandwidth
+
+    def recompute_s(self, cached_len: int) -> float:
+        per_tok = max(
+            self.flops_per_token / self.machine.peak_flops,
+            (self.weight_bytes / self.prefill_chunk
+             + self.kv_bytes_per_token) / self.machine.hbm_bandwidth)
+        return float(cached_len) * per_tok
+
+    def choose(self, cached_len: int, nbytes: int) -> str:
+        """"restore" | "recompute" for a spilled span of ``cached_len``
+        tokens occupying ``nbytes`` of host RAM."""
+        if self.mode != "auto":
+            return self.mode
+        if nbytes <= 0 or cached_len <= 0:
+            return "recompute"
+        return ("restore" if self.restore_s(nbytes)
+                <= self.recompute_s(cached_len) else "recompute")
+
+    @classmethod
+    def for_record(cls, im, model_id: int, machine=None,
+                   mode: str = "auto",
+                   host_bandwidth: Optional[float] = None
+                   ) -> "RecoveryPolicy":
+        """Policy parameterized from a compiled record: decode flops ~
+        2 * params per token, weight stream = param bytes, KV stream
+        from KVCacheStats."""
+        record = im.models[model_id]
+        n_params = im.model_param_bytes(model_id)
+        stats = im.kv_cache_stats(model_id)
+        return cls(machine=machine,
+                   flops_per_token=2.0 * n_params["elements"],
+                   weight_bytes=n_params["bytes"],
+                   kv_bytes_per_token=stats.bytes_per_token,
+                   prefill_chunk=record.get("prefill_chunk", 256),
+                   host_bandwidth=host_bandwidth, mode=mode)
+
+
+class PressureScheduler:
+    """Preemption policy: WHEN to preempt for admission and WHOM.
+
+    - ``should_admit_preempt``: True when the pending queue's head has
+      waited longer than the pressure threshold — ``queue_pressure_s``
+      (the operator's knob), TIGHTENED to half the installed SLO TTFT
+      target when that is smaller (preemption must fire before queue
+      wait alone consumes the TTFT budget, leaving the other half for
+      the prefill itself; a loose SLO never slackens the knob, which
+      keeps preemption timing deterministic for tests and benches).
+    - ``pick_victim``: the lowest-priority running request — most
+      recently admitted first (LIFO preemption preserves FCFS
+      fairness: the newest arrival re-queues, the oldest keeps its
+      TPOT), tie-broken toward the most pages held.  Forward progress
+      is the CALLER's contract: every call passes ``protect_guids``
+      (the earliest-admitted request, RequestManager._protected_guids)
+      so at least one row always runs to completion.
+    """
+
+    def __init__(self, queue_pressure_s: float = 0.25,
+                 preempt_for_admission: bool = True):
+        self.queue_pressure_s = float(queue_pressure_s)
+        self.preempt_for_admission = bool(preempt_for_admission)
+
+    def _threshold_s(self) -> float:
+        from ..observability import get_ledger
+
+        pol = get_ledger().slo_policy()
+        if pol is not None and pol.ttft_s is not None:
+            return min(self.queue_pressure_s, 0.5 * pol.ttft_s)
+        return self.queue_pressure_s
+
+    def should_admit_preempt(self, queue_wait_s: float) -> bool:
+        # strict >: a zero threshold must not let a request whose wait
+        # clock was JUST reset (preemption thrash guard) re-trigger
+        return (self.preempt_for_admission
+                and queue_wait_s > self._threshold_s())
+
+    @staticmethod
+    def pick_victim(running: Dict[int, Any],
+                    protect_guids: Tuple[int, ...] = ()) -> Optional[Any]:
+        cands = [r for r in running.values()
+                 if r.guid not in protect_guids]
+        if not cands:
+            return None
+        cands.sort(key=lambda r: (-r.profile.admit_mono,
+                                  -(len(r.tokens))))
+        return cands[0]
+
+
+#: live pagers (weak — bench A/B arms and tests create several per
+#: process); the watchdog embeds every live pager's snapshot in stall
+#: bundles so ffstat can print pages free/leased + spilled GUIDs.
+_LIVE_PAGERS: "weakref.WeakSet[KVPager]" = weakref.WeakSet()
+
+
+def pager_snapshots() -> List[Dict[str, Any]]:
+    """Snapshots of every live pager (the watchdog-bundle feed)."""
+    return [p.snapshot() for p in list(_LIVE_PAGERS)]
+
+
+class KVPager:
+    """Block/page-granular KV accounting + host-RAM spill buffers.
+
+    Pure host bookkeeping — the KV bytes live in the
+    InferenceManager's dense cache rows; this class decides how many
+    committed-KV pages each slot may hold against ``total_pages``, and
+    keeps the host-side spill store for preempted rows and spilled
+    prefix-pool entries.  Thread-safe (snapshots run from the
+    watchdog's signal path).
+    """
+
+    def __init__(self, total_pages: int, page_len: int = DEFAULT_PAGE_LEN,
+                 policy: Optional[RecoveryPolicy] = None,
+                 scheduler: Optional[PressureScheduler] = None,
+                 bytes_per_token: int = 0,
+                 host_budget_bytes: Optional[int] = None):
+        if page_len % PAGE_ALIGN:
+            raise ValueError(
+                f"page_len={page_len} must be a multiple of {PAGE_ALIGN} "
+                f"(lcm of the {PREFIX_ALIGN}-aligned flash-prefill chunk "
+                f"starts and the 32-wide int8 RMW append window)")
+        self.total_pages = max(1, int(total_pages))
+        self.page_len = int(page_len)
+        self.policy = policy or RecoveryPolicy()
+        self.scheduler = scheduler or PressureScheduler()
+        #: bytes of committed KV per position (for budget<->bytes
+        #: conversions in snapshots/bench; 0 = unknown)
+        self.bytes_per_token = int(bytes_per_token)
+        self.host_budget_bytes = host_budget_bytes
+        self.leases: Dict[int, PageLease] = {}       # slot -> lease
+        self.leased_pages = 0
+        #: guid -> {"models": {mid: {"layers": {...}, "len": L}},
+        #:          "bytes": n, "tokens": committed tokens at spill}
+        self.spilled: Dict[int, Dict[str, Any]] = {}
+        self.spilled_bytes = 0
+        # lifetime odometers (the registry counters' local twins, so
+        # tests and bench read them without a registry diff)
+        self.spill_bytes_total = 0
+        self.restore_bytes_total = 0
+        self.preemptions = {"pages": 0, "admission": 0, "pool": 0}
+        self.spill_drops = 0
+        # RLock, not Lock: snapshot() is reachable from the watchdog's
+        # SIGTERM/SIGUSR1 bundle path, which runs at an arbitrary
+        # bytecode boundary of the main thread — if that thread is
+        # mid-lease() when the signal lands, a plain Lock would
+        # self-deadlock the dump (the PR-6 lock-discipline class)
+        self._lock = threading.RLock()
+        m = get_registry()
+        self._recorder = get_flight_recorder()
+        self._g_pages_total = m.gauge("serving_kv_pages_total")
+        self._g_pages_free = m.gauge("serving_kv_pages_free")
+        self._c_spill = m.counter("serving_kv_spill_bytes_total")
+        self._c_restore = m.counter("serving_kv_restore_bytes_total")
+        self._c_preempt = m.counter("serving_preemptions_total")
+        self._g_pages_total.set(self.total_pages)
+        self._g_pages_free.set(self.total_pages)
+        _LIVE_PAGERS.add(self)
+
+    # ------------------------------------------------------------ leases
+    @property
+    def free_pages(self) -> int:
+        with self._lock:
+            return max(0, self.total_pages - self.leased_pages)
+
+    @property
+    def overcommitted_pages(self) -> int:
+        with self._lock:
+            return max(0, self.leased_pages - self.total_pages)
+
+    def pages_for(self, length: int) -> int:
+        return pages_for(length, self.page_len)
+
+    def lease_of(self, slot: int) -> Optional[PageLease]:
+        with self._lock:
+            return self.leases.get(slot)
+
+    def shortfall(self, slot: Optional[int], length: int) -> int:
+        """Extra pages a lease-to-``length`` on ``slot`` would need
+        beyond the free pool (0 = satisfiable now)."""
+        with self._lock:
+            have = self.leases[slot].pages if slot in self.leases else 0
+            need = pages_for(length, self.page_len) - have
+            free = self.total_pages - self.leased_pages
+            return max(0, need - max(0, free))
+
+    def lease(self, slot: int, length: int, owner: str = "req",
+              guid: Optional[int] = None, force: bool = False) -> bool:
+        """Adjust ``slot``'s page count to cover ``length`` positions.
+        Returns False (state unchanged) when growth exceeds the free
+        pool and ``force`` is not set; ``force=True`` books the overage
+        anyway (forward-progress guarantee mid-decode-block — the dense
+        allocation physically has the space; the overcommit is counted
+        and trued up by preemption at the next fold boundary)."""
+        with self._lock:
+            lease = self.leases.get(slot)
+            have = lease.pages if lease is not None else 0
+            want = pages_for(length, self.page_len)
+            grow = want - have
+            if grow > 0 and not force and (
+                    self.leased_pages + grow > self.total_pages):
+                return False
+            if lease is None:
+                lease = self.leases[slot] = PageLease(
+                    slot, 0, 0, owner, guid)
+            lease.pages = want
+            lease.length = int(length)
+            lease.owner = owner
+            lease.guid = guid
+            lease.last_use = time.monotonic()
+            self.leased_pages += grow
+            self._g_pages_free.set(
+                max(0, self.total_pages - self.leased_pages))
+            return True
+
+    def release(self, slot: int) -> int:
+        """Free a slot's pages; returns the page count released."""
+        with self._lock:
+            lease = self.leases.pop(slot, None)
+            if lease is None:
+                return 0
+            self.leased_pages -= lease.pages
+            self._g_pages_free.set(
+                max(0, self.total_pages - self.leased_pages))
+            return lease.pages
+
+    def acquire(self, slot: int):
+        with self._lock:
+            if slot in self.leases:
+                self.leases[slot].refs += 1
+
+    def release_ref(self, slot: int):
+        with self._lock:
+            if slot in self.leases and self.leases[slot].refs > 0:
+                self.leases[slot].refs -= 1
+
+    # ------------------------------------------------------------- spill
+    def store_spill(self, guid: int, models: Dict[int, Dict[str, Any]],
+                    tokens: int, nbytes: int) -> None:
+        """Keep a preempted request's fetched KV in host RAM.  Over the
+        host budget, the LRU spill is dropped (its request silently
+        degrades to recompute — counted in ``spill_drops``)."""
+        with self._lock:
+            self.spilled[guid] = {"models": models, "tokens": int(tokens),
+                                  "bytes": int(nbytes)}
+            self.spilled_bytes += int(nbytes)
+            self.spill_bytes_total += int(nbytes)
+            while (self.host_budget_bytes is not None
+                   and self.spilled_bytes > self.host_budget_bytes
+                   and len(self.spilled) > 1):
+                old_guid = next(iter(self.spilled))
+                if old_guid == guid:
+                    break
+                dropped = self.spilled.pop(old_guid)
+                self.spilled_bytes -= dropped["bytes"]
+                self.spill_drops += 1
+        self._c_spill.inc(nbytes)
+
+    def peek_spill(self, guid: int) -> Optional[Dict[str, Any]]:
+        with self._lock:
+            return self.spilled.get(guid)
+
+    def take_spill(self, guid: int) -> Optional[Dict[str, Any]]:
+        with self._lock:
+            sp = self.spilled.pop(guid, None)
+            if sp is not None:
+                self.spilled_bytes -= sp["bytes"]
+            return sp
+
+    def drop_spill(self, guid: int) -> None:
+        self.take_spill(guid)
+
+    def count_spill(self, nbytes: int) -> None:
+        """Count spill bytes that bypass the per-guid store (prefix-
+        pool page spills keep their payload on the PrefixEntry)."""
+        with self._lock:
+            self.spill_bytes_total += int(nbytes)
+        self._c_spill.inc(nbytes)
+
+    def count_restore(self, nbytes: int) -> None:
+        with self._lock:
+            self.restore_bytes_total += int(nbytes)
+        self._c_restore.inc(nbytes)
+
+    def count_preemption(self, reason: str) -> None:
+        with self._lock:
+            self.preemptions[reason] = self.preemptions.get(reason, 0) + 1
+        self._c_preempt.inc(reason=reason)
+
+    # ---------------------------------------------------------- snapshot
+    def snapshot(self) -> Dict[str, Any]:
+        """JSON-serializable state (the watchdog-bundle / ffstat feed):
+        budget, per-slot leases, spilled GUIDs and the odometers."""
+        with self._lock:
+            return {
+                "page_len": self.page_len,
+                "total_pages": self.total_pages,
+                "leased_pages": self.leased_pages,
+                "free_pages": max(0,
+                                  self.total_pages - self.leased_pages),
+                "overcommitted_pages": max(
+                    0, self.leased_pages - self.total_pages),
+                "bytes_per_token": self.bytes_per_token,
+                "budget_bytes": (self.total_pages * self.page_len
+                                 * self.bytes_per_token),
+                "leases": [
+                    {"slot": l.slot, "pages": l.pages,
+                     "length": l.length, "owner": l.owner,
+                     "guid": l.guid, "refs": l.refs}
+                    for l in self.leases.values()],
+                "spilled_guids": {g: {"tokens": s["tokens"],
+                                      "bytes": s["bytes"]}
+                                  for g, s in self.spilled.items()},
+                "spilled_bytes": self.spilled_bytes,
+                "spill_bytes_total": self.spill_bytes_total,
+                "restore_bytes_total": self.restore_bytes_total,
+                "spill_drops": self.spill_drops,
+                "preemptions": dict(self.preemptions),
+            }
+
+    def config(self) -> Dict[str, Any]:
+        """The bench-record ``kv_pager`` stamp (page size, budget,
+        spill policy) — stable fields only."""
+        return {
+            "enabled": True,
+            "page_len": self.page_len,
+            "total_pages": self.total_pages,
+            "budget_bytes": (self.total_pages * self.page_len
+                             * self.bytes_per_token),
+            "spill_policy": self.policy.mode,
+            "host_budget_bytes": self.host_budget_bytes,
+        }
+
+
+def pager_for_budget(budget_bytes: int, bytes_per_token: int,
+                     page_len: int = DEFAULT_PAGE_LEN,
+                     **kwargs) -> KVPager:
+    """A pager whose page budget covers ``budget_bytes`` of committed
+    KV at ``bytes_per_token`` (KVCacheStats.bytes_per_token of the
+    served record) — the bench A/B's fixed-HBM-budget constructor."""
+    page_bytes = max(1, page_len * int(bytes_per_token))
+    return KVPager(max(1, int(budget_bytes) // page_bytes),
+                   page_len=page_len, bytes_per_token=bytes_per_token,
+                   **kwargs)
+
+
+def _selftest() -> int:
+    """Pure-host allocator smoke (the run_tier1.sh pager gate): lease /
+    release / refcount accounting, alignment validation, spill-store
+    budgeting and policy pricing — no model, no device."""
+    import numpy as np
+
+    ok = True
+
+    def check(cond, msg):
+        nonlocal ok
+        if not cond:
+            ok = False
+            print(f"kv_pager selftest FAILED: {msg}")
+
+    try:
+        KVPager(4, page_len=48)
+        check(False, "page_len=48 accepted")
+    except ValueError:
+        pass
+    p = KVPager(8, page_len=64, bytes_per_token=128)
+    check(p.pages_for(1) == 1 and p.pages_for(64) == 1
+          and p.pages_for(65) == 2, "pages_for math")
+    check(p.lease(0, 100) and p.free_pages == 6, "lease grow")
+    check(p.lease(0, 30) and p.free_pages == 7, "lease shrink")
+    check(not p.lease(1, 8 * 64) and p.free_pages == 7,
+          "over-budget lease must fail atomically")
+    check(p.lease(1, 8 * 64, force=True) and p.free_pages == 0
+          and p.overcommitted_pages == 1, "forced overcommit books")
+    check(p.release(1) == 8 and p.free_pages == 7, "release")
+    check(p.shortfall(None, 64 * 7) == 0
+          and p.shortfall(None, 64 * 8) == 1, "shortfall")
+    payload = {0: {"layers": {"l0": {"k": np.zeros((1, 2, 64, 4))}},
+                   "len": 64}}
+    p.store_spill(7, payload, tokens=90, nbytes=4096)
+    check(p.peek_spill(7) is not None and p.spilled_bytes == 4096,
+          "spill store")
+    check(p.take_spill(7)["tokens"] == 90 and p.spilled_bytes == 0,
+          "spill take")
+    pol = RecoveryPolicy(flops_per_token=2e9, weight_bytes=1e9,
+                         kv_bytes_per_token=1e5, prefill_chunk=256)
+    check(pol.choose(4096, 64) == "restore",
+          "tiny spill vs long recompute must restore")
+    check(pol.choose(16, 10 ** 12) == "recompute",
+          "huge spill vs short recompute must recompute")
+    check(RecoveryPolicy(mode="recompute").choose(4096, 64)
+          == "recompute", "pinned mode wins")
+    snap = p.snapshot()
+    check(snap["total_pages"] == 8 and snap["leases"][0]["slot"] == 0,
+          "snapshot shape")
+    if ok:
+        print("kv_pager selftest OK")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":  # pragma: no cover - CLI smoke entry
+    import sys
+
+    sys.exit(_selftest())

@@ -14,6 +14,18 @@ per-stage MachineViews with distinct start_device_id, graph.cc:2016-2024):
   analogue).  Batches flow through stages sequentially per step; the
   4-deep in-flight overlap the reference gets from Legion futures maps to
   async dispatch across the disjoint per-stage device queues.
+
+Paged KV (serving/kv_pager.py): pp-served rows take the shared
+admission path — page leasing, admission blocking and pressure
+preemption all apply — but their caches live on per-stage submeshes
+the row fetch/restore transfers are not wired through
+(``InferenceManager.supports_kv_spill`` is False for pp records), so a
+preempted pp row always recovers by RECOMPUTE: the request re-enters
+the pending queue with ``cached_len = 0`` and re-prefills chunk by
+chunk, which is bit-exact (KV depends only on token values and
+positions).  Lease accounting refreshes at every host sync via
+``RequestManager._note_step`` — the pp decode block commits many
+tokens per sync without touching ``prepare_next_batch``.
 """
 
 from __future__ import annotations

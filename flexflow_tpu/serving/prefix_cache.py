@@ -93,10 +93,18 @@ class PrefixEntry:
     model_id -> cache storage dtype tag ("int8", "bfloat16", ...; see
     InferenceManager.cache_dtype_key) — the module-docstring dtype-key
     rule; models missing from it are legacy wildcard donations.
+
+    ``host`` (paged KV, serving/kv_pager.py): a SPILLED entry's KV
+    payloads — model_id -> an ``InferenceManager.fetch_row`` dict.
+    A spilled entry owns NO batch slot (``slot is None``) and no
+    pages; it stays matchable in the radix tree, and admission
+    restores host->row directly (``restore_row``) instead of the
+    device row-to-row copy.  The dtype-key rule applies unchanged —
+    the host bytes are the raw storage dtype.
     """
 
     __slots__ = ("slot", "rows", "length", "refs", "last_use", "node",
-                 "dtypes")
+                 "dtypes", "host")
 
     def __init__(self, slot: int, rows: Dict[int, Tuple[int, int]],
                  length: int, dtypes: Optional[Dict[int, str]] = None):
@@ -107,6 +115,7 @@ class PrefixEntry:
         self.last_use = 0                 # LRU tick
         self.node: Optional[_Node] = None
         self.dtypes = dict(dtypes or {})  # model_id -> cache dtype tag
+        self.host = None                  # spilled payloads (kv_pager)
 
 
 class PrefixCache:
@@ -116,12 +125,24 @@ class PrefixCache:
     hold which prefixes and when they are reclaimed."""
 
     def __init__(self, max_slots: int, align: int = PREFIX_ALIGN,
-                 min_match: int = PREFIX_ALIGN):
+                 min_match: int = PREFIX_ALIGN,
+                 max_host_entries: Optional[int] = None):
         self.max_slots = max_slots
         self.align = align
         self.min_match = min_match
         self.root = _Node([], None)
         self.entries: Dict[int, PrefixEntry] = {}   # slot -> entry
+        # SPILLED entries (paged KV): matchable, slot-less, KV in host
+        # RAM — bounded by max_host_entries (LRU; default 2x the slot
+        # cap so a spilled pool cannot grow host RAM without bound)
+        self.host_entries: List[PrefixEntry] = []
+        self.max_host_entries = (max_host_entries
+                                 if max_host_entries is not None
+                                 else max(8, 2 * max_slots))
+        # eviction hook (set by the RequestManager when a KV pager is
+        # attached): remove() fires it so internally-triggered
+        # evictions release the entry's page lease
+        self.on_evict = None
         self.stats = PrefixCacheStats()
         self._tick = 0
         # telemetry: the pool's counters re-emitted through the serving
@@ -395,8 +416,36 @@ class PrefixCache:
                                     reason="lru")
         return victim.slot, victim
 
+    def detach_slot(self, entry: PrefixEntry, host) -> None:
+        """Spill a resident entry's KV to ``host`` payloads (paged KV):
+        the entry stays matchable in the tree but releases its batch
+        slot — admission restores host->row instead of row-to-row
+        copying.  Caller (the RequestManager) moves the actual bytes
+        and releases the page lease; referenced entries never spill."""
+        assert entry.refs == 0 and entry.slot is not None, (
+            "detach_slot: entry must be resident and unreferenced")
+        self.entries.pop(entry.slot, None)
+        entry.slot = None
+        entry.host = host
+        self.host_entries.append(entry)
+        # bound host RAM: LRU spilled entries are dropped outright
+        while len(self.host_entries) > self.max_host_entries:
+            victim = min(self.host_entries, key=lambda e: e.last_use)
+            if victim is entry:
+                break
+            self.remove(victim)
+            self.stats.evictions += 1
+            self._c_evictions.inc()
+            self._tracer.instant("evict", slot=None, reason="host-lru")
+            self._recorder.record_event("evict", slot=None,
+                                        reason="host-lru")
+
     def remove(self, entry: PrefixEntry):
-        """Drop an entry and prune its now-empty branch."""
+        """Drop an entry (resident or spilled) and prune its now-empty
+        branch; fires ``on_evict`` so an attached KV pager releases the
+        entry's page lease."""
+        if self.on_evict is not None:
+            self.on_evict(entry)
         node = entry.node
         node.entry = None
         entry.node = None
@@ -410,4 +459,9 @@ class PrefixCache:
             parent = node.parent
             del parent.children[node.edge[0]]
             node = parent
-        self.entries.pop(entry.slot, None)
+        if entry.slot is not None:
+            self.entries.pop(entry.slot, None)
+        else:
+            self.host_entries = [e for e in self.host_entries
+                                 if e is not entry]
+            entry.host = None

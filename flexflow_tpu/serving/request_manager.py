@@ -38,7 +38,8 @@ from ..observability import (get_flight_recorder, get_heartbeat,
                              get_ledger, get_registry, get_tracer)
 from .batch_config import BatchConfig, InferenceResult, pick_chunk
 from .inference_manager import InferenceManager
-from .prefix_cache import PrefixCache
+from .kv_pager import KVPager
+from .prefix_cache import PREFIX_ALIGN, PrefixCache, align_down
 
 
 @dataclasses.dataclass
@@ -84,6 +85,16 @@ class ProfileInfo:
     ssm_prefill_rows: int = 0
     # prompt tokens whose KV came from the prefix cache (prefill skipped)
     prefix_matched_tokens: int = 0
+    # KV-pager lifecycle (serving/kv_pager.py): times this request was
+    # preempted, and the KV positions restored from host spill vs
+    # recomputed by re-prefill across those preemptions
+    preemptions: int = 0
+    restored_tokens: int = 0
+    recomputed_tokens: int = 0
+    # monotonic stamp of the LAST preemption: the pressure scheduler's
+    # queue-wait clock restarts here, so a freshly preempted request
+    # cannot immediately counter-preempt its replacement (thrash guard)
+    preempt_mono: float = 0.0
     # wall-clock registration stamp (time.time()) — LOGGING ONLY.  Every
     # latency delta below uses the monotonic twin: time.time() jumps
     # under NTP slew, so a wall-clock TTFT can come out negative (or
@@ -148,6 +159,9 @@ class Request:
         self.row: Optional[int] = None      # batch slot while RUNNING
         self.cached_len = 0                 # tokens whose KV is committed
         self.prefix_entry = None            # pinned PrefixEntry while RUNNING
+        # last admission-block reason noted for this request (the
+        # once-per-transition dedup for serving_admission_blocked_total)
+        self.blocked_reason: Optional[str] = None
         self.profile = ProfileInfo(start_time=time.time(),
                                    start_mono=time.monotonic())
 
@@ -179,7 +193,8 @@ class RequestManager:
                  max_spec_tree_token_num: int = 64,
                  decode_block: int = 16,
                  prefix_cache: bool = False,
-                 prefix_pool_slots: Optional[int] = None):
+                 prefix_pool_slots: Optional[int] = None,
+                 kv_pager: Optional[KVPager] = None):
         self.max_requests_per_batch = max_requests_per_batch
         self.max_tokens_per_batch = max_tokens_per_batch
         self.max_sequence_length = max_sequence_length
@@ -206,9 +221,26 @@ class RequestManager:
             slots = (prefix_pool_slots if prefix_pool_slots is not None
                      else max(0, max_requests_per_batch - 1))
             self.prefix_cache = PrefixCache(max_slots=slots)
+        # paged KV allocator (serving/kv_pager.py): when set, admission
+        # and growth lease pages against its budget, and the pressure
+        # scheduler may preempt rows (spill-to-host or recompute) to
+        # free pages/rows under load.  None = the pre-existing
+        # row-capped behavior, bit-identical.
+        self.kv_pager = kv_pager
+        if self.prefix_cache is not None and kv_pager is not None:
+            # pool evictions must release the entry's page lease (the
+            # pool evicts internally on insert/supersede, where the
+            # manager is not on the call path)
+            self.prefix_cache.on_evict = self._on_pool_evict
         # (im, model_id) while a generate loop that supports donation /
         # prefix copies is driving this manager (generate_incr_decoding)
         self._prefix_ctx: Optional[Tuple[InferenceManager, int]] = None
+        # (im, {model_id: row multiplier}) while a driver whose cache
+        # layout supports row spill/restore is in flight — only the
+        # incremental driver's linear rows qualify (spec rows carry
+        # pending tree-slot commit lists; preempting them recomputes)
+        self._spill_ctx: Optional[Tuple[InferenceManager,
+                                        Dict[int, int]]] = None
         # prefill chunks must honor this floor (int8 flash-prefill needs
         # 32-divisible chunks); set per-driver from the serving record
         self._chunk_floor = 1
@@ -245,6 +277,7 @@ class RequestManager:
             "serving_spec_accepted_tokens_total")
         self._m_spec_rate = m.histogram("serving_spec_acceptance_rate")
         self._m_spec_verify = m.histogram("serving_spec_verify_tokens")
+        self._m_adm_blocked = m.counter("serving_admission_blocked_total")
 
     # -------------------------------------------------------------- setup
     def register_tokenizer(self, tokenizer, eos_token_id=None,
@@ -318,25 +351,90 @@ class RequestManager:
         matched_len}) per admission; matched is empty without a hit.
         """
         pool = self.prefix_cache
+        pager = self.kv_pager
         admitted: List[Tuple[Request, Dict[int, int]]] = []
         primary = next(iter(model_rows), None) if model_rows else None
         # a driver that cannot host the row copy (no im / no row map —
         # e.g. the pp spec loop) must not walk the tree: a guaranteed
         # miss would still skew hit_rate / tokens-saved and bump LRU
         serving = pool is not None and im is not None and bool(model_rows)
+        if pager is not None:
+            # true up page leases for growth since the last pass (the
+            # spec drivers reach here once per macro-iteration; the
+            # incr driver trues up WITH preemption in
+            # prepare_next_batch before calling)
+            self.pager_sync_leases()
+        admission_preempted = False
         while self.pending:
+            req = self.pending[0]
             free = self._free_rows()
-            if not free and (pool is None
-                             or all(e.refs
-                                    for e in pool.entries.values())):
+            have_row = bool(free) or (
+                pool is not None
+                and any(e.refs == 0 for e in pool.entries.values()))
+            short = (pager.shortfall(None, len(req.tokens))
+                     if pager is not None else 0)
+            if (not have_row or short) and pager is not None:
+                # reclaim order: pooled pages first (spilling a pool
+                # entry to host frees its slot AND pages while keeping
+                # the prefix matchable), then pressure-gated preemption
+                # of the lowest-priority running row.  At most ONE
+                # admission preemption per pass — bounds both the
+                # victim-TPOT damage per step and this loop (a
+                # preempted victim re-enters at the queue FRONT, so an
+                # unbounded pass could ping-pong head and victim)
+                if im is not None:
+                    self._reclaim_pool_pages(im, len(req.tokens))
+                else:
+                    while (pager.shortfall(None, len(req.tokens))
+                           and pool is not None
+                           and pool.evict_one() is not None):
+                        pass
+                wait = time.monotonic() - max(req.profile.start_mono,
+                                              req.profile.preempt_mono)
+                if (not admission_preempted and self.running
+                        and pager.scheduler.should_admit_preempt(wait)):
+                    victim = pager.scheduler.pick_victim(
+                        self.running,
+                        protect_guids=self._protected_guids())
+                    if victim is not None and (
+                            not have_row
+                            or pager.shortfall(None, len(req.tokens))):
+                        self.preempt_request(victim, reason="admission")
+                        admission_preempted = True
+                        # the victim re-queued at the FRONT — restart
+                        # the pass from the (possibly new) head
+                        continue
+                free = self._free_rows()
+                have_row = bool(free) or (
+                    pool is not None
+                    and any(e.refs == 0 for e in pool.entries.values()))
+                short = pager.shortfall(None, len(req.tokens))
+                if short and not self.running and not (
+                        pool is not None and pool.entries):
+                    # nothing left to reclaim: a request bigger than
+                    # the whole page budget must still run (forward
+                    # progress) — force-book the overage below
+                    short = 0
+            if not have_row:
                 # no slot and nothing evictable: bail BEFORE the tree
                 # walk — a saturated batch re-enters here every decode
                 # step, and a discarded match would both waste
                 # O(prompt_len) work and bump the matched entry's LRU
-                # recency without ever consuming it
+                # recency without ever consuming it.  The block is
+                # COUNTED (satellite fix: this used to fail silently)
+                self._note_admission_blocked(req, "no_rows")
                 break
-            req = self.pending[0]
-            entry, d = pool.match(req.tokens) if serving else (None, 0)
+            if short:
+                self._note_admission_blocked(req, "no_pages")
+                break
+            # a preempted request's own spill beats any pooled prefix
+            # (it is the request's full committed KV) — skip the tree
+            # walk when one is waiting
+            spill = (pager.peek_spill(req.guid)
+                     if (pager is not None and im is not None
+                         and model_rows) else None)
+            entry, d = (pool.match(req.tokens)
+                        if serving and spill is None else (None, 0))
             inplace = False
             if free:
                 row = free[0]
@@ -347,13 +445,22 @@ class RequestManager:
             req.status = Request.RUNNING
             req.row = row
             req.cached_len = 0
-            # the TTFT clock starts at admission (ProfileInfo.admit_mono
-            # docstring explains the warm-prefix queue-wait ambiguity
-            # this fixes)
-            req.profile.admit_mono = time.monotonic()
+            req.blocked_reason = None
+            # the TTFT clock starts at FIRST admission (ProfileInfo
+            # .admit_mono docstring explains the warm-prefix queue-wait
+            # ambiguity this fixes); a preempted request keeps its
+            # original stamp — its first token may already be out, and
+            # re-stamping would make ttft_s negative
+            if req.profile.admit_mono == 0.0:
+                req.profile.admit_mono = time.monotonic()
             self.running[row] = req
+            if pager is not None:
+                pager.lease(row, len(req.tokens), owner="req",
+                            guid=req.guid, force=True)
             matched: Dict[int, int] = {}
-            if entry is not None and d:
+            if spill is not None:
+                matched = self._restore_spilled(im, model_rows, req, row)
+            elif entry is not None and d:
                 for mid, mult in (model_rows or {}).items():
                     # dtype-key rule: a pooled row donated at another
                     # cache storage dtype (bf16 pool, int8 record after
@@ -363,7 +470,25 @@ class RequestManager:
                                       dtype=im.cache_dtype_key(mid))
                     if use <= 0:
                         continue
-                    if inplace:
+                    if entry.host is not None:
+                        # spilled pool entry: restore host->row directly
+                        # (no device row-to-row copy; the over-copied
+                        # bucket tail is re-scattered by the request's
+                        # own prefill before anything attends it)
+                        payload = entry.host.get(mid)
+                        if payload is None:
+                            continue
+                        nb = im.restore_row(mid, row * mult, payload)
+                        if pager is not None:
+                            pager.count_restore(nb)
+                        self.recorder.record_event(
+                            "restore", guid=req.guid, row=row,
+                            tokens=use, bytes=nb)
+                        self.ledger.note_event(
+                            "restore", guid=req.guid, row=row,
+                            tokens=use, bytes=nb)
+                        matched[mid] = use
+                    elif inplace:
                         # the entry's KV already lives in this slot's
                         # rows (cache_row == slot * mult) — zero copy
                         matched[mid] = use
@@ -371,10 +496,14 @@ class RequestManager:
                         src = entry.rows[mid][0]
                         im.copy_prefix(mid, src, row * mult, use)
                         matched[mid] = use
-                if matched and not inplace:
+                if matched and not inplace and entry.host is None:
                     pool.acquire(entry)
                     req.prefix_entry = entry
-            if serving:
+                    if pager is not None:
+                        # donation records page refs: the pinned
+                        # entry's pages stay leased while borrowed
+                        pager.acquire(entry.slot)
+            if serving and spill is None:
                 best = max(matched.values(), default=0)
                 req.profile.prefix_matched_tokens = best
                 pool.note_lookup(best, req.prompt_len)
@@ -400,6 +529,240 @@ class RequestManager:
         self._m_queue_depth.set(len(self.pending))
         self._m_active.set(len(self.running))
         return admitted
+
+    # ------------------------------------------------------- paged KV
+    def _protected_guids(self) -> Tuple[int, ...]:
+        """The earliest-admitted running request is never preempted —
+        at least one row always runs to completion (no livelock)."""
+        if not self.running:
+            return ()
+        oldest = min(self.running.values(),
+                     key=lambda r: r.profile.admit_mono or 0.0)
+        return (oldest.guid,)
+
+    def _note_admission_blocked(self, req: Request, reason: str):
+        """Count + ledger-note a blocked queue head ONCE per (request,
+        reason) transition — a saturated batch re-enters admission
+        every decode step, and per-retry ticks would read as load, not
+        as 'this request experienced this block' (the satellite fix
+        for the silent no-rows/no-pages bail)."""
+        if req.blocked_reason == reason:
+            return
+        req.blocked_reason = reason
+        self._m_adm_blocked.inc(reason=reason)
+        self.recorder.record_event("admission-blocked", guid=req.guid,
+                                   reason=reason)
+        self.ledger.note_event("admission-blocked", guid=req.guid,
+                               reason=reason)
+
+    def _restore_spilled(self, im: InferenceManager,
+                         model_rows: Dict[int, int], req: Request,
+                         row: int) -> Dict[int, int]:
+        """Restore a preempted request's spilled KV into its new row(s)
+        (host->device device_put + jitted donated row write).  Returns
+        the per-model restored lengths — exactly the ``matched`` shape
+        a prefix-pool hit produces, so every driver resumes from it
+        without new plumbing.  The restore length aligns down to the
+        16 boundary (the flash-prefill chunk-start invariant); the
+        unaligned tail re-prefills."""
+        pager = self.kv_pager
+        sp = pager.take_spill(req.guid)
+        if sp is None:
+            return {}
+        matched: Dict[int, int] = {}
+        total = 0
+        for mid, payload in sp["models"].items():
+            mult = model_rows.get(mid)
+            if mult is None or not im.supports_kv_spill(mid):
+                continue
+            use = align_down(min(payload["valid"], len(req.tokens) - 1))
+            if use <= 0:
+                continue
+            total += im.restore_row(mid, row * mult, payload)
+            matched[mid] = use
+        if matched:
+            best = max(matched.values())
+            req.profile.restored_tokens += best
+            pager.count_restore(total)
+            self.tracer.instant("restore", guid=req.guid, row=row,
+                                tokens=best, bytes=total)
+            self.recorder.record_event("restore", guid=req.guid,
+                                       row=row, tokens=best, bytes=total)
+            self.ledger.note_event("restore", guid=req.guid, row=row,
+                                   tokens=best, bytes=total)
+        return matched
+
+    def _on_pool_evict(self, entry):
+        """PrefixCache eviction hook (insert-supersede, LRU reclaim,
+        host-LRU): a resident entry's page lease dies with it."""
+        if self.kv_pager is not None and entry.slot is not None:
+            self.kv_pager.release(entry.slot)
+
+    def _spill_pool_entry(self, im: InferenceManager, entry) -> bool:
+        """Move a resident, unreferenced pool entry's KV to host RAM:
+        the entry stays matchable (admission restores host->row) but
+        releases its batch slot AND its pages — the cheapest reclaim
+        under page pressure, since no in-flight request loses work."""
+        pool, pager = self.prefix_cache, self.kv_pager
+        if any(not im.supports_kv_spill(mid) for mid in entry.rows):
+            return False
+        host: Dict[int, Dict[str, Any]] = {}
+        total = 0
+        for mid, (cache_row, kv_len) in entry.rows.items():
+            span = align_down(min(kv_len, entry.length))
+            payload = im.fetch_row(mid, cache_row, span)
+            if payload is None:
+                continue
+            host[mid] = payload
+            total += payload["bytes"]
+        if not host:
+            return False
+        slot = entry.slot
+        pool.detach_slot(entry, host)
+        pager.release(slot)
+        pager.count_spill(total)
+        pager.count_preemption("pool")
+        self.tracer.instant("spill", slot=slot, tokens=entry.length,
+                            bytes=total)
+        self.recorder.record_event("spill", slot=slot,
+                                   tokens=entry.length, bytes=total)
+        # no ledger feed: pool spills are slot-keyed (no request), and
+        # a guid-less note_event BROADCASTS to every admitted in-flight
+        # timeline — running requests would record a spill they never
+        # experienced
+        return True
+
+    def _reclaim_pool_pages(self, im: InferenceManager, need_len: int):
+        """Free pages by spilling (preferred — keeps the prefix
+        matchable) or evicting LRU unreferenced pool entries until the
+        pending head's lease fits or the pool runs dry."""
+        pool, pager = self.prefix_cache, self.kv_pager
+        if pool is None:
+            return
+        while pager.shortfall(None, need_len) > 0:
+            victims = [e for e in pool.entries.values() if e.refs == 0]
+            if not victims:
+                break
+            victim = min(victims, key=lambda e: e.last_use)
+            if self._spill_pool_entry(im, victim):
+                continue
+            if pool.evict_one() is None:
+                break
+
+    def pager_sync_leases(self, preempt: bool = False, extra: int = 0):
+        """Lease every running row's pages to cover its committed
+        tokens (+``extra`` for an upcoming decode block).  With
+        ``preempt`` (the incr driver's fold boundary — the only point
+        where every row's host state is consistent mid-loop), shortage
+        preempts the lowest-priority other row; otherwise the overage
+        is force-booked (counted, trued up at the next boundary) —
+        never block the driver mid-dispatch."""
+        pager = self.kv_pager
+        if pager is None or not self.running:
+            return
+        for row in list(self.running):
+            req = self.running.get(row)
+            if req is None:
+                continue          # preempted by an earlier iteration
+            target = len(req.tokens) + extra
+            if pager.lease(row, target, owner="req", guid=req.guid):
+                continue
+            if preempt:
+                protect = self._protected_guids()
+                while pager.shortfall(row, target) > 0:
+                    others = {r: q for r, q in self.running.items()
+                              if q is not req}
+                    victim = pager.scheduler.pick_victim(
+                        others, protect_guids=protect)
+                    if victim is None:
+                        break
+                    self.preempt_request(victim, reason="pages")
+            pager.lease(row, target, owner="req", guid=req.guid,
+                        force=True)
+        if preempt:
+            # true up force-booked overage (decode-block growth books
+            # pages mid-dispatch without preempting — a lease that
+            # merely KEEPS its overcommitted count succeeds, so the
+            # per-row loop above never repays it)
+            protect = self._protected_guids()
+            while pager.overcommitted_pages > 0:
+                victim = pager.scheduler.pick_victim(
+                    self.running, protect_guids=protect)
+                if victim is None:
+                    break         # only protected rows left: overage
+                self.preempt_request(victim, reason="pages")
+
+    def preempt_request(self, req: Request, reason: str,
+                        mode: Optional[str] = None):
+        """Evict a RUNNING request from its row: spill its committed KV
+        to host RAM (restore at re-admission) or drop it for recompute,
+        release its pages, and re-queue it at the FRONT of pending
+        (resume priority).  ``mode`` pins "spill"/"recompute"; default
+        prices spill-then-restore against recompute via the pager's
+        :class:`~flexflow_tpu.serving.kv_pager.RecoveryPolicy`.  Spill
+        needs the incr driver's linear cache layout (``_spill_ctx``);
+        spec/pp-served rows always recompute — their rows carry
+        pending tree-slot commit state no linear fetch can capture."""
+        pager = self.kv_pager
+        row = req.row
+        assert (row is not None and self.running.get(row) is req), (
+            "preempt_request: request is not running", req.guid, row)
+        ctx = self._spill_ctx
+        spill_len = align_down(min(req.cached_len, len(req.tokens) - 1))
+        if mode is None:
+            mode = "recompute"
+            if ctx is not None and spill_len >= PREFIX_ALIGN:
+                nbytes_est = spill_len * max(1, pager.bytes_per_token)
+                if pager.policy.choose(spill_len, nbytes_est) == "restore":
+                    mode = "spill"
+        if mode == "spill" and ctx is not None and spill_len > 0:
+            im, model_rows = ctx
+            models: Dict[int, Dict[str, Any]] = {}
+            total = 0
+            for mid, mult in model_rows.items():
+                payload = im.fetch_row(mid, row * mult, spill_len)
+                if payload is None:
+                    continue
+                models[mid] = payload
+                total += payload["bytes"]
+            if models:
+                pager.store_spill(req.guid, models, spill_len, total)
+                self.tracer.instant("spill", guid=req.guid, row=row,
+                                    tokens=spill_len, bytes=total)
+                self.recorder.record_event("spill", guid=req.guid,
+                                           row=row, tokens=spill_len,
+                                           bytes=total)
+                self.ledger.note_event("spill", guid=req.guid, row=row,
+                                       tokens=spill_len, bytes=total)
+            else:
+                mode = "recompute"
+        if mode == "recompute":
+            req.profile.recomputed_tokens += max(0, spill_len)
+        if req.prefix_entry is not None:
+            self.prefix_cache.release(req.prefix_entry)
+            if pager is not None and req.prefix_entry.slot is not None:
+                pager.release_ref(req.prefix_entry.slot)
+            req.prefix_entry = None
+        del self.running[row]
+        pager.release(row)
+        req.row = None
+        req.status = Request.PENDING
+        req.cached_len = 0
+        req.blocked_reason = None
+        req.profile.preemptions += 1
+        req.profile.preempt_mono = time.monotonic()
+        self.pending.appendleft(req)        # resume priority
+        pager.count_preemption(reason)
+        self.tracer.instant("preempt", guid=req.guid, row=row,
+                            reason=reason, mode=mode, tokens=spill_len)
+        self.recorder.record_event("preempt", guid=req.guid, row=row,
+                                   reason=reason, mode=mode,
+                                   tokens=spill_len)
+        self.ledger.note_event("preempt", guid=req.guid, row=row,
+                               reason=reason, mode=mode,
+                               tokens=spill_len)
+        self._m_queue_depth.set(len(self.pending))
+        self._m_active.set(len(self.running))
 
     def prefix_donate(self, req: Request, slot: int, length: int,
                       rows: Dict[int, Tuple[int, int]],
@@ -469,6 +832,9 @@ class RequestManager:
                                       / p.speculated_tokens)
         if req.prefix_entry is not None:
             self.prefix_cache.release(req.prefix_entry)
+            if (self.kv_pager is not None
+                    and req.prefix_entry.slot is not None):
+                self.kv_pager.release_ref(req.prefix_entry.slot)
             req.prefix_entry = None
         # prefix-cache donation (incremental path; the spec drivers call
         # prefix_donate explicitly with their per-model watermarks):
@@ -480,6 +846,18 @@ class RequestManager:
                                {model_id: (row, req.cached_len)},
                                dtypes={model_id:
                                        im.cache_dtype_key(model_id)})
+        # paged KV: the slot's pages follow the slot — to the pool
+        # entry when the row was donated (the lease retags, shrunk to
+        # the donated length), back to the free pool otherwise
+        if self.kv_pager is not None:
+            entry = (self.prefix_cache.entries.get(row)
+                     if self.prefix_cache is not None else None)
+            if entry is not None:
+                self.kv_pager.lease(row, entry.length, owner="pool",
+                                    guid=None, force=True)
+            else:
+                self.kv_pager.release(row)
+            self.kv_pager.drop_spill(req.guid)
 
     def prepare_next_batch(self, prev_bc: Optional[BatchConfig],
                            prev_result: Optional[InferenceResult]
@@ -507,12 +885,27 @@ class RequestManager:
                     if self._finished(req, tok):
                         self._retire(req)
 
+        # 1.5) paged KV: true up page leases for the growth the fold
+        #      just committed, preempting lowest-priority rows at this
+        #      host-consistent boundary when the budget is out
+        #      (prepare_next_batch is the incr driver's exclusive
+        #      path, so preemption here never races device state)
+        if self.kv_pager is not None:
+            self.pager_sync_leases(preempt=True)
+
         # 2) admit pending requests into free slots (prefix-aware: a
         #    pooled-prefix hit starts the request at cached_len = matched
-        #    so step 3 schedules only the unseen span)
+        #    so step 3 schedules only the unseen span).  Without a
+        #    prefix pool the spill ctx still supplies (im, rows) so a
+        #    preempted request's host KV can restore at re-admission.
         ctx = self._prefix_ctx
-        self.admit_pending(im=ctx[0] if ctx else None,
-                           model_rows={ctx[1]: 1} if ctx else None)
+        if ctx is not None:
+            self.admit_pending(im=ctx[0], model_rows={ctx[1]: 1})
+        elif self._spill_ctx is not None:
+            self.admit_pending(im=self._spill_ctx[0],
+                               model_rows=dict(self._spill_ctx[1]))
+        else:
+            self.admit_pending()
 
         if not self.running:
             return None
@@ -625,6 +1018,13 @@ class RequestManager:
             (im, model_id)
             if (self.prefix_cache is not None
                 and im.supports_prefix_cache(model_id)) else None)
+        # arm the KV pager's spill path: the incr driver's rows are
+        # linear committed KV, the layout fetch_row/restore_row move
+        # (spec rows carry tree-slot commit state and recompute instead)
+        self._spill_ctx = (
+            (im, {model_id: 1})
+            if (self.kv_pager is not None
+                and im.supports_kv_spill(model_id)) else None)
         self._chunk_floor = im.min_prefill_chunk(model_id)
         try:
             # heartbeat scope: the stall watchdog only declares a stall
@@ -634,6 +1034,7 @@ class RequestManager:
                                                 rng, decode_block)
         finally:
             self._prefix_ctx = None
+            self._spill_ctx = None
             self._chunk_floor = 1
 
     def _incr_decoding_loop(self, im, model_id, requests, rng,
@@ -650,6 +1051,10 @@ class RequestManager:
                 # largest remaining span bounds useful block length
                 k = pick_chunk(max(1, self._max_remaining_budget()),
                                decode_block)
+                # paged KV: book the block's growth up front (no
+                # preemption here — the BatchConfig is already built;
+                # overage is trued up at the next fold boundary)
+                self.pager_sync_leases(extra=k)
                 self.recorder.record_event(
                     "decode-step", block=k,
                     rows=bc.num_active_requests())
@@ -726,7 +1131,13 @@ class RequestManager:
         step (every driver's unit; the schema help documents it).  Also
         the single heartbeat site: every driver loop commits through
         here, so the stall watchdog's "last committed step" covers incr,
-        host-spec and device-spec alike."""
+        host-spec and device-spec alike.  Also the paged-KV lease
+        true-up shared by every driver: the device-resident spec loop
+        and the pp decode block commit many tokens per sync without
+        touching prepare_next_batch, so their page accounting refreshes
+        here (force-booked; preemption stays at the admission/fold
+        boundaries where host state is consistent)."""
+        self.pager_sync_leases()
         self.heartbeat.beat(tokens=tokens)
         self._m_step_latency.observe(time.monotonic() - t_start)
         if tokens > 0:
@@ -788,6 +1199,9 @@ class RequestManager:
         # init consumes one budget slot, the k scan steps the rest
         k = pick_chunk(max(1, self._max_remaining_budget() - 1),
                        decode_block)
+        # paged KV: book the handoff block's growth (no preemption —
+        # see the decode-block site; trued up at the next fold)
+        self.pager_sync_leases(extra=k + 1)
         self.recorder.record_event("decode-step", block=k, handoff=True,
                                    rows=bc2.num_active_requests())
         self.ledger.note_event("decode-step", block=k, handoff=True,

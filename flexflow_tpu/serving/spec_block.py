@@ -37,6 +37,13 @@ per host sync (early-exiting when every request retires), so one sync
 ships K * (accepted+1) tokens per row.  The host folds the output buffer,
 retires finished requests, admits pending ones, and re-enters.
 
+Paged KV: the device loop runs many macro-iterations per host sync, so
+preemption can only happen at the admission/rebuild boundaries the
+driver already has (the inner dispatch loop breaks back to admission
+when ``rm.pending`` sees a free row) — page leases true up at each
+sync via ``rm._note_step`` and preempted rows recover by recompute
+(see spec_infer.py's paged-KV note).
+
 Gates (see device_loop_supported): beam width equal to each SSM's
 compiled width, union tree within the tree-token cap; r4 additions
 cover pipeline-parallel LLMs (stage-dispatched driver) and multi-SSM

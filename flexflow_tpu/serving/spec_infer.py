@@ -15,6 +15,16 @@ Division of labour (vs the reference's Legion CPU tasks + CUDA kernels):
   walk, commit-list construction.  These are O(requests x tree) scalar
   loops — exactly what the reference also runs on CPU.
 
+Paged KV (serving/kv_pager.py): both spec drivers admit through the
+shared ``RequestManager.admit_pending`` path, so page leasing,
+admission blocking and pressure preemption apply unchanged — but a
+spec row's cache interleaves committed KV with pending tree-slot
+commit lists, a layout the linear row spill cannot capture, so
+preempted spec requests always recover by RECOMPUTE (fresh per-guid
+state at re-admission; committed tokens are replayed through prefill,
+bit-exact).  Lease growth is trued up at every host sync
+(``RequestManager._note_step``).
+
 Cache/bookkeeping invariants per running request (committed = req.tokens):
 
 - ``llm_cached``: LLM cache holds correct KV for positions [0, llm_cached);

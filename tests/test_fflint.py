@@ -625,6 +625,48 @@ class TestMetricSchemaRule:
             """, self.R)
         assert fs == []
 
+    def test_pager_names_covered_by_real_schema(self, tmp_path):
+        # the paged-KV vocabulary validates against the CHECKED-IN
+        # schema (not the fixture-injected one): every pager metric and
+        # event the serving stack emits is declared, and a rogue
+        # sibling is still flagged — the rule covers the new names
+        src = """\
+            def wire(m, rec, ledger):
+                a = m.gauge("serving_kv_pages_total")
+                b = m.gauge("serving_kv_pages_free")
+                c = m.counter("serving_kv_spill_bytes_total")
+                d = m.counter("serving_kv_restore_bytes_total")
+                e = m.counter("serving_preemptions_total")
+                f = m.counter("serving_admission_blocked_total")
+                rec.record_event("preempt", guid=1, reason="pages")
+                rec.record_event("spill", guid=1, bytes=64)
+                ledger.note_event("restore", guid=1, tokens=16)
+                ledger.note_event("admission-blocked", guid=1,
+                                  reason="no_pages")
+                return a, b, c, d, e, f
+            """
+        path = tmp_path / "serving" / "pager_fixture.py"
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_text(textwrap.dedent(src))
+        ctx = LintContext(repo_root=REPO)   # exec-loads the real schema
+        fs = lint_file(str(path), self.R, ctx,
+                       rel="serving/pager_fixture.py",
+                       judge_suppressions=True)
+        assert fs == []
+        rogue = tmp_path / "serving" / "rogue_fixture.py"
+        rogue.write_text(textwrap.dedent("""\
+            def wire(m, rec):
+                m.counter("serving_kv_pages_total")
+                rec.record_event("unspill", guid=1)
+            """))
+        fs = lint_file(str(rogue), self.R, ctx,
+                       rel="serving/rogue_fixture.py",
+                       judge_suppressions=True)
+        # gauge declared, counter spelling flagged; undeclared event
+        assert at(fs, "metric-schema", 2), fs
+        assert at(fs, "metric-schema", 3), fs
+        assert len(fs) == 2
+
 
 # --------------------------------------------------- direct host sync
 class TestDirectHostSyncRule:

@@ -1,0 +1,507 @@
+"""Paged KV cache tests (serving/kv_pager.py).
+
+The load-bearing promise is the spec suite's: scheduling may only
+change WHEN a request computes, never WHAT it computes — greedy tokens
+must be bit-exact across preempt->restore and preempt->recompute on
+every driver.  KV depends only on token values and absolute positions
+(the prefix-cache correctness argument), so both recovery paths are
+exact by construction; these tests pin it end-to-end.
+"""
+
+import numpy as np
+import pytest
+
+from flexflow_tpu import FFConfig, Model
+from flexflow_tpu.fftype import InferenceMode
+from flexflow_tpu.models.llama import (LLAMAConfig, convert_hf_state_dict,
+                                       create_llama_model)
+from flexflow_tpu.serving import InferenceManager, RequestManager
+from flexflow_tpu.serving.kv_pager import (KVPager, PressureScheduler,
+                                           RecoveryPolicy, pager_for_budget,
+                                           pages_for)
+
+TINY = dict(vocab_size=128, hidden_size=64, intermediate_size=128,
+            num_hidden_layers=2, num_attention_heads=4,
+            num_key_value_heads=2, max_position_embeddings=256)
+
+
+def _tiny_model(seed=0, max_requests=4, mode=InferenceMode.INC_DECODING,
+                params=TINY):
+    import jax
+
+    cfg = LLAMAConfig(**params)
+    model = Model(FFConfig(), name=f"pager_{mode.value}_{seed}")
+    create_llama_model(model, cfg, mode=mode, max_requests=max_requests)
+    model.params = model.init_params(jax.random.PRNGKey(seed))
+    return model, cfg
+
+
+def _prompts(n, length, vocab=127, seed=0):
+    rng = np.random.default_rng(seed)
+    return [rng.integers(1, vocab, length).tolist() for _ in range(n)]
+
+
+# ------------------------------------------------------------ allocator
+class TestPagerAccounting:
+    def test_page_alignment_enforced(self):
+        with pytest.raises(ValueError, match="multiple of 32"):
+            KVPager(4, page_len=48)
+        KVPager(4, page_len=32)     # lcm(16, 32) boundary is legal
+
+    def test_lease_release_shortfall(self):
+        p = KVPager(4, page_len=64)
+        assert pages_for(0, 64) == 0 and pages_for(65, 64) == 2
+        assert p.lease(0, 100) and p.free_pages == 2
+        assert p.lease(0, 10) and p.free_pages == 3    # shrink refunds
+        assert not p.lease(1, 64 * 4)                  # atomic fail
+        assert p.free_pages == 3
+        assert p.lease(1, 64 * 4, force=True)
+        assert p.free_pages == 0 and p.overcommitted_pages == 1
+        assert p.release(1) == 4 and p.free_pages == 3
+        assert p.shortfall(None, 64 * 3) == 0
+        assert p.shortfall(None, 64 * 4) == 1
+        assert p.shortfall(0, 64) == 0                 # own page counts
+
+    def test_spill_store_and_host_budget(self):
+        p = KVPager(4, host_budget_bytes=1000)
+        p.store_spill(1, {}, tokens=32, nbytes=600)
+        p.store_spill(2, {}, tokens=32, nbytes=600)
+        # over budget: LRU spill (guid 1) dropped -> recompute
+        assert p.peek_spill(1) is None
+        assert p.peek_spill(2) is not None
+        assert p.spill_drops == 1
+        assert p.take_spill(2)["bytes"] == 600
+        assert p.spilled_bytes == 0
+        assert p.spill_bytes_total == 1200             # lifetime odometer
+
+    def test_policy_pricing_and_pins(self):
+        pol = RecoveryPolicy(flops_per_token=4e9, weight_bytes=2e9,
+                             kv_bytes_per_token=1e5)
+        # long cached span, small spill -> restore; inverse -> recompute
+        assert pol.choose(8192, 1 << 20) == "restore"
+        assert pol.choose(16, 1 << 40) == "recompute"
+        assert RecoveryPolicy(mode="recompute").choose(8192, 1) \
+            == "recompute"
+        assert pol.restore_s(0) == 0.0 and pol.recompute_s(0) == 0.0
+
+    def test_scheduler_victim_is_lowest_priority_and_protects(self):
+        class R:
+            def __init__(self, guid, admit, n):
+                self.guid = guid
+                self.tokens = [0] * n
+
+                class P:
+                    pass
+                self.profile = P()
+                self.profile.admit_mono = admit
+
+        running = {0: R(1, 10.0, 8), 1: R(2, 20.0, 8), 2: R(3, 15.0, 8)}
+        s = PressureScheduler()
+        v = s.pick_victim(running, protect_guids=(1,))
+        assert v.guid == 2              # most recently admitted
+        assert s.pick_victim({0: running[0]}, protect_guids=(1,)) is None
+
+    def test_pager_for_budget_and_snapshot(self):
+        p = pager_for_budget(64 * 10 * 128, bytes_per_token=128,
+                             page_len=64)
+        assert p.total_pages == 10
+        p.lease(3, 70, owner="pool")
+        snap = p.snapshot()
+        assert snap["leases"][0]["owner"] == "pool"
+        assert snap["budget_bytes"] == 64 * 10 * 128
+        assert p.config()["enabled"] and p.config()["page_len"] == 64
+
+
+# ------------------------------------------------- incr driver parity
+class TestIncrPreemptionParity:
+    def _serve(self, im, mid, prompts, pager, new_tokens=48,
+               decode_block=4):
+        rm = RequestManager(max_requests_per_batch=4,
+                            max_tokens_per_batch=64,
+                            max_sequence_length=256,
+                            decode_block=decode_block, kv_pager=pager)
+        reqs = [rm.register_new_request(list(p), max_new_tokens=new_tokens)
+                for p in prompts]
+        rm.generate_incr_decoding(im, mid, reqs)
+        return [r.tokens[r.prompt_len:] for r in reqs], reqs, rm
+
+    @pytest.fixture(scope="class")
+    def compiled(self):
+        model, _ = _tiny_model(seed=3)
+        im = InferenceManager(model.config)
+        mid = im.compile_model_and_allocate_buffer(
+            model, max_requests=4, max_seq_length=256,
+            cache_dtype=np.float32)
+        prompts = _prompts(4, 24, seed=1)
+        base, _, _ = self._serve(im, mid, prompts, None)
+        return im, mid, prompts, base
+
+    def _pager(self, im, mid, mode):
+        return KVPager(
+            2, page_len=64,
+            policy=RecoveryPolicy.for_record(im, mid, mode=mode),
+            scheduler=PressureScheduler(queue_pressure_s=0.0),
+            bytes_per_token=im.kv_cache_stats(mid).bytes_per_token)
+
+    def test_preempt_restore_parity(self, compiled):
+        im, mid, prompts, base = compiled
+        pager = self._pager(im, mid, "restore")
+        got, reqs, _ = self._serve(im, mid, prompts, pager)
+        assert got == base              # bit-exact under spill/restore
+        assert sum(pager.preemptions.values()) > 0
+        assert pager.spill_bytes_total > 0
+        assert pager.restore_bytes_total > 0
+        assert sum(r.profile.restored_tokens for r in reqs) > 0
+        # everything released at the end: no leaked leases or spills
+        assert pager.free_pages == pager.total_pages
+        assert not pager.snapshot()["spilled_guids"]
+
+    def test_preempt_recompute_parity(self, compiled):
+        im, mid, prompts, base = compiled
+        pager = self._pager(im, mid, "recompute")
+        got, reqs, _ = self._serve(im, mid, prompts, pager)
+        assert got == base              # bit-exact under recompute
+        assert sum(pager.preemptions.values()) > 0
+        assert pager.restore_bytes_total == 0
+        assert sum(r.profile.recomputed_tokens for r in reqs) > 0
+
+    def test_preempted_ttft_clock_not_restamped(self, compiled):
+        im, mid, prompts, base = compiled
+        pager = self._pager(im, mid, "restore")
+        _, reqs, _ = self._serve(im, mid, prompts, pager)
+        for r in reqs:
+            ttft = r.profile.ttft_s()
+            assert ttft is not None and ttft >= 0.0
+
+    def test_ledger_timeline_carries_preempt_spans(self, compiled):
+        from flexflow_tpu.observability import get_ledger
+
+        im, mid, prompts, base = compiled
+        if not get_ledger().enabled:
+            pytest.skip("telemetry disabled")
+        pager = self._pager(im, mid, "restore")
+        _, reqs, rm = self._serve(im, mid, prompts, pager)
+        preempted = [r for r in reqs if r.profile.preemptions]
+        assert preempted
+        tl = rm.ledger.timeline(preempted[0].guid)
+        assert tl["preempts"] == preempted[0].profile.preemptions
+        names = [e["name"] for e in tl["events"]]
+        assert "preempt" in names
+        # ffreq renders the preempt->resume span from these events
+        from tools.ffreq import preempt_spans, timeline_view
+
+        assert preempt_spans(tl)
+        assert "preempted" in timeline_view(tl)
+
+
+# --------------------------------------------- admission-blocked fix
+class TestAdmissionBlocked:
+    def test_no_rows_counted_once_per_transition(self):
+        from flexflow_tpu.observability import get_ledger, get_registry
+
+        model, _ = _tiny_model(seed=5, max_requests=1)
+        im = InferenceManager(model.config)
+        mid = im.compile_model_and_allocate_buffer(
+            model, max_requests=1, max_seq_length=128,
+            cache_dtype=np.float32)
+        rm = RequestManager(max_requests_per_batch=1,
+                            max_tokens_per_batch=32,
+                            max_sequence_length=128, decode_block=4)
+        c = get_registry().counter("serving_admission_blocked_total")
+        before = c.value(reason="no_rows")
+        reqs = [rm.register_new_request(list(p), max_new_tokens=16)
+                for p in _prompts(3, 12, seed=2)]
+        rm.generate_incr_decoding(im, mid, reqs)
+        # requests 2 and 3 each hit the block exactly once (dedup per
+        # transition, NOT once per saturated decode step)
+        assert c.value(reason="no_rows") == before + 2
+        if get_ledger().enabled:
+            tl = rm.ledger.timeline(reqs[1].guid)
+            blocked = [e for e in tl["events"]
+                       if e["name"] == "admission-blocked"]
+            assert len(blocked) == 1
+            assert blocked[0]["reason"] == "no_rows"
+
+    def test_no_pages_counted(self):
+        from flexflow_tpu.observability import get_registry
+
+        model, _ = _tiny_model(seed=6, max_requests=4)
+        im = InferenceManager(model.config)
+        mid = im.compile_model_and_allocate_buffer(
+            model, max_requests=4, max_seq_length=128,
+            cache_dtype=np.float32)
+        pager = KVPager(
+            1, page_len=64,
+            policy=RecoveryPolicy.for_record(im, mid, mode="recompute"),
+            scheduler=PressureScheduler(preempt_for_admission=False))
+        rm = RequestManager(max_requests_per_batch=4,
+                            max_tokens_per_batch=64,
+                            max_sequence_length=128, decode_block=4,
+                            kv_pager=pager)
+        c = get_registry().counter("serving_admission_blocked_total")
+        before = c.value(reason="no_pages")
+        reqs = [rm.register_new_request(list(p), max_new_tokens=8)
+                for p in _prompts(2, 24, seed=3)]
+        rm.generate_incr_decoding(im, mid, reqs)
+        assert c.value(reason="no_pages") > before
+        assert [r.tokens[r.prompt_len:] for r in reqs] \
+            == [r.tokens[r.prompt_len:] for r in reqs]  # completed
+        assert all(len(r.tokens) - r.prompt_len == 8 for r in reqs)
+
+
+# -------------------------------------------------- spec driver parity
+class TestSpecPreemptionParity:
+    def _spec_serve(self, pager_fn, device_loop, n=3):
+        from flexflow_tpu.serving.spec_infer import generate_spec_infer
+
+        llm, _ = _tiny_model(seed=11, max_requests=2,
+                             mode=InferenceMode.TREE_VERIFY)
+        ssm, _ = _tiny_model(seed=12, max_requests=2,
+                             mode=InferenceMode.BEAM_SEARCH)
+        im = InferenceManager(llm.config)
+        lid = im.compile_model_and_allocate_buffer(
+            llm, mode=InferenceMode.TREE_VERIFY, max_requests=2,
+            max_seq_length=256, cache_dtype=np.float32)
+        sid = im.compile_model_and_allocate_buffer(
+            ssm, mode=InferenceMode.BEAM_SEARCH, max_requests=2,
+            max_seq_length=256, beam_width=2, cache_dtype=np.float32)
+        pager = pager_fn(im, lid) if pager_fn else None
+        rm = RequestManager(max_requests_per_batch=2,
+                            max_tokens_per_batch=64,
+                            max_sequence_length=256, kv_pager=pager)
+        rm.register_ssm_model(sid)
+        reqs = [rm.register_new_request(list(p), max_new_tokens=20)
+                for p in _prompts(n, 20, seed=4)]
+        generate_spec_infer(rm, im, lid, reqs, beam_width=2,
+                            beam_depth=4, device_loop=device_loop)
+        return [r.tokens[r.prompt_len:] for r in reqs], pager
+
+    @staticmethod
+    def _tight_pager(im, lid):
+        # two pages: both rows admit (one page each), the third request
+        # then exercises admission-pressure preemption of the newest
+        # row (always recompute — spec rows never spill); one page
+        # would leave only the protected oldest running, and the
+        # scheduler never preempts the last runnable row
+        return KVPager(
+            2, page_len=64,
+            policy=RecoveryPolicy.for_record(im, lid, mode="recompute"),
+            scheduler=PressureScheduler(queue_pressure_s=0.0),
+            bytes_per_token=im.kv_cache_stats(lid).bytes_per_token)
+
+    @pytest.mark.parametrize("device_loop", [False, True])
+    def test_spec_paged_parity(self, device_loop):
+        base, _ = self._spec_serve(None, device_loop)
+        got, pager = self._spec_serve(self._tight_pager, device_loop)
+        assert got == base
+        assert sum(pager.preemptions.values()) > 0
+        # spec preemption must never spill (tree-slot commit state)
+        assert pager.spill_bytes_total == 0
+        assert pager.free_pages == pager.total_pages
+
+
+# ------------------------------------------------------ int8 spill cost
+class TestInt8SpillBytes:
+    WIDE = dict(vocab_size=128, hidden_size=128, intermediate_size=128,
+                num_hidden_layers=1, num_attention_heads=2,
+                num_key_value_heads=2, max_position_embeddings=256)
+
+    def _fetch_bytes(self, kv_cache_dtype):
+        import jax.numpy as jnp
+
+        model, _ = _tiny_model(seed=7, max_requests=2, params=self.WIDE)
+        im = InferenceManager(model.config)
+        mid = im.compile_model_and_allocate_buffer(
+            model, max_requests=2, max_seq_length=128,
+            cache_dtype=(None if kv_cache_dtype == "int8"
+                         else jnp.bfloat16),
+            kv_cache_dtype=kv_cache_dtype)
+        payload = im.fetch_row(mid, 0, 64)
+        assert payload is not None and payload["len"] == 64
+        return payload["bytes"], im, mid
+
+    def test_int8_pages_spill_at_half_bf16_bytes(self):
+        bf16, _, _ = self._fetch_bytes(None)
+        q, im, mid = self._fetch_bytes("int8")
+        # head_dim 64: int8 K/V (1B) + f32 scales = (2*64+8)/(2*64*2)
+        # = 0.53x — the "~0.5x spill/restore cost" multiplicative
+        # composition with the int8 cache work
+        ratio = q / bf16
+        assert 0.45 < ratio < 0.60, (q, bf16, ratio)
+        # round-trip: restore re-lands the fetched bucket bit-exactly
+        rec = im.models[mid]
+        layer = next(iter(rec["caches"]))
+        before = np.asarray(rec["caches"][layer]["k"][0, :, :64])
+        payload = im.fetch_row(mid, 0, 64)
+        nb = im.restore_row(mid, 1, payload)
+        assert nb == payload["bytes"]
+        after = np.asarray(rec["caches"][layer]["k"][1, :, :64])
+        np.testing.assert_array_equal(before, after)
+
+
+# -------------------------------------------- prefix pool page spill
+class TestPrefixPoolSpill:
+    def test_donation_match_roundtrip_through_spilled_page(self):
+        model, _ = _tiny_model(seed=9, max_requests=2)
+        im = InferenceManager(model.config)
+        mid = im.compile_model_and_allocate_buffer(
+            model, max_requests=2, max_seq_length=256,
+            cache_dtype=np.float32)
+        system = _prompts(1, 48, seed=5)[0]
+        tails = _prompts(3, 8, seed=6)
+
+        def serve(rm, tail):
+            req = rm.register_new_request(system + tail,
+                                          max_new_tokens=12)
+            rm.generate_incr_decoding(im, mid, [req])
+            return req
+
+        pager = KVPager(
+            4, page_len=64,
+            policy=RecoveryPolicy.for_record(im, mid, mode="restore"),
+            scheduler=PressureScheduler(preempt_for_admission=False),
+            bytes_per_token=im.kv_cache_stats(mid).bytes_per_token)
+        rm = RequestManager(max_requests_per_batch=2,
+                            max_tokens_per_batch=64,
+                            max_sequence_length=256, decode_block=4,
+                            prefix_cache=True, kv_pager=pager)
+        cold = serve(rm, tails[0])      # donates system+tail[0] prefix
+        pool = rm.prefix_cache
+        assert pool.entries               # donation landed (resident)
+        entry = next(iter(pool.entries.values()))
+        # force the pool page spill (the admission path reaches this
+        # via _reclaim_pool_pages under page pressure)
+        assert rm._spill_pool_entry(im, entry)
+        assert entry.slot is None and entry.host
+        assert entry in pool.host_entries
+        assert not pool.entries           # slot freed with the pages
+        restore_before = pager.restore_bytes_total
+        warm = serve(rm, tails[1])
+        # the spilled prefix still matched — restored host->row
+        assert warm.profile.prefix_matched_tokens >= 16
+        assert pager.restore_bytes_total > restore_before
+        # parity: a pool-free serve of the same prompt decodes the same
+        rm2 = RequestManager(max_requests_per_batch=2,
+                             max_tokens_per_batch=64,
+                             max_sequence_length=256, decode_block=4)
+        ref = serve(rm2, tails[1])
+        assert warm.tokens == ref.tokens
+        # dtype-key rule unchanged for spilled entries
+        assert pool.usable(entry, mid, 48, 56, dtype="int8") == 0
+
+    def test_pool_eviction_releases_pages(self):
+        model, _ = _tiny_model(seed=10, max_requests=2)
+        im = InferenceManager(model.config)
+        mid = im.compile_model_and_allocate_buffer(
+            model, max_requests=2, max_seq_length=256,
+            cache_dtype=np.float32)
+        pager = KVPager(
+            8, page_len=64,
+            policy=RecoveryPolicy.for_record(im, mid, mode="recompute"),
+            bytes_per_token=im.kv_cache_stats(mid).bytes_per_token)
+        rm = RequestManager(max_requests_per_batch=2,
+                            max_tokens_per_batch=64,
+                            max_sequence_length=256, decode_block=4,
+                            prefix_cache=True, kv_pager=pager)
+        req = rm.register_new_request(_prompts(1, 48, seed=7)[0],
+                                      max_new_tokens=8)
+        rm.generate_incr_decoding(im, mid, [req])
+        assert rm.prefix_cache.entries
+        leased = pager.total_pages - pager.free_pages
+        assert leased > 0                 # the pool entry holds pages
+        rm.prefix_cache.evict_one()
+        assert pager.free_pages == pager.total_pages  # on_evict hook
+
+
+# -------------------------------------------------- zero-recompile pin
+class TestPagedRetraceGuard:
+    def test_warmed_paged_serve_pins_zero_compiles(self):
+        from flexflow_tpu.utils.debugging import retrace_guard
+
+        model, _ = _tiny_model(seed=13, max_requests=4)
+        im = InferenceManager(model.config)
+        mid = im.compile_model_and_allocate_buffer(
+            model, max_requests=4, max_seq_length=256,
+            cache_dtype=np.float32)
+        prompts = _prompts(4, 24, seed=8)
+
+        def serve():
+            # page-growth preemption only (admission pressure is
+            # wall-clock and would make the schedule run-dependent)
+            pager = KVPager(
+                2, page_len=64,
+                policy=RecoveryPolicy.for_record(im, mid,
+                                                 mode="restore"),
+                scheduler=PressureScheduler(
+                    preempt_for_admission=False),
+                bytes_per_token=im.kv_cache_stats(mid).bytes_per_token)
+            rm = RequestManager(max_requests_per_batch=4,
+                                max_tokens_per_batch=64,
+                                max_sequence_length=256,
+                                decode_block=4, kv_pager=pager)
+            # 24 prompt + 48 new crosses the 64-token page boundary, so
+            # lease growth deterministically preempts mid-generation
+            reqs = [rm.register_new_request(list(p), max_new_tokens=48)
+                    for p in prompts]
+            rm.generate_incr_decoding(im, mid, reqs)
+            assert sum(pager.preemptions.values()) > 0  # paging LIVE
+            return [r.tokens[r.prompt_len:] for r in reqs]
+
+        with retrace_guard(max_compiles=None) as warm:
+            base = serve()
+        if warm.compiles == 0:
+            pytest.skip("this JAX emits no compile monitoring events")
+        # identical paged workload again: every admission / prefill /
+        # decode-block / spill-fetch / restore bucket must be a cache
+        # hit — paging lives OUTSIDE the jitted steps by construction
+        with retrace_guard() as g:
+            again = serve()
+        assert g.compiles == 0, g.events
+        assert again == base
+
+
+# ------------------------------------------------------- bench A/B
+class TestBenchPagedSmoke:
+    def test_paged_arm_beats_row_capped_under_fixed_budget(self):
+        import bench
+
+        def tiny():
+            model, cfg = _tiny_model(seed=14, max_requests=6)
+            return model, cfg.vocab_size
+
+        head, spill, preempts, goodput = bench.bench_paged(
+            model_builder=tiny, max_requests=6, prompt_len=40,
+            new_tokens=32, max_seq_length=192, max_tokens_per_batch=64,
+            decode_block=8, n_requests=10, budget_rows=1)
+        assert head["greedy_parity"] is True
+        # strictly higher resident batch at the same byte budget
+        assert head["paged_resident_batch"] \
+            > head["capped_resident_batch"]
+        assert head["value"] > 1.2
+        # the counters prove spill and preemption actually fired
+        assert spill["value"] > 0 and spill["restore_bytes"] > 0
+        assert preempts["value"] > 0
+        assert head["paged_goodput_tokens_per_s"] > 0
+        # the record stamp rides every round beside kv_cache_dtype
+        assert bench._PAGER_CONF["enabled"] is True
+        assert bench._PAGER_CONF["page_len"] == 64
+        assert bench._PAGER_CONF["spill_policy"] == "restore"
+
+
+# ----------------------------------------------- bundle/ffstat surface
+class TestPagerObservability:
+    def test_bundle_embeds_pager_state_and_ffstat_prints_it(self, capsys):
+        from flexflow_tpu.observability import collect_bundle
+        from tools.ffstat import diagnosis, flight_events
+
+        p = KVPager(4, page_len=64, bytes_per_token=100)
+        p.lease(0, 70, guid=42)
+        p.store_spill(7, {}, tokens=64, nbytes=1234)
+        bundle = collect_bundle("test")
+        pagers = bundle.get("kv_pager")
+        assert pagers and any(s["total_pages"] == 4 for s in pagers)
+        text = diagnosis(bundle, flight_events(bundle))
+        assert "kv pager" in text
+        assert "7(64tok)" in text
+        p.release(0)
+        p.take_spill(7)

@@ -119,7 +119,8 @@ def ranking(timelines: List[Dict], n: int) -> str:
                                  else t["ttft_s"]))
     lines.append(
         f"\n{'guid':>9} {'ttft ms':>9} {'tpot ms':>9} {'queue ms':>9} "
-        f"{'decode ms':>9} {'tokens':>7} {'prefix':>7} {'slo':>9}")
+        f"{'decode ms':>9} {'tokens':>7} {'prefix':>7} {'pre':>4} "
+        f"{'slo':>9}")
     for t in retired[:n]:
         ph = phases_of(t)
         slo = t.get("slo")
@@ -132,8 +133,36 @@ def ranking(timelines: List[Dict], n: int) -> str:
             f"{t.get('guid', '?'):>9} {_ms(t.get('ttft_s'))} "
             f"{_ms(t.get('tpot_s'))} {_ms(ph['queued'])} "
             f"{_ms(ph['decode'])} {t.get('tokens') or 0:>7} "
-            f"{t.get('prefix_matched') or 0:>7} {verdict:>9}")
+            f"{t.get('prefix_matched') or 0:>7} "
+            f"{t.get('preempts') or 0:>4} {verdict:>9}")
     return "\n".join(lines)
+
+
+def preempt_spans(t: Dict[str, Any]) -> List[str]:
+    """Per-request preempt -> restore/recompute spans (paged KV): for
+    each ``preempt`` event, the wall time until the request was next
+    re-admitted and whether its KV came back via ``restore`` (host
+    spill) or plain re-prefill (recompute) — where a preempted
+    request's latency went."""
+    evs = t.get("events") or []
+    out: List[str] = []
+    for i, ev in enumerate(evs):
+        if ev.get("name") != "preempt":
+            continue
+        resume = mode = None
+        for nxt in evs[i + 1:]:
+            if nxt.get("name") == "restore":
+                mode = f"restore({nxt.get('tokens')}tok)"
+            elif nxt.get("name") == "admit":
+                resume = nxt.get("t")
+                break
+        gap = ("" if resume is None
+               else f" resumed +{(resume - ev.get('t', 0)) * 1e3:.1f}ms")
+        out.append(f"  preempt reason={ev.get('reason')} "
+                   f"mode={ev.get('mode')} -> "
+                   f"{mode or 'recompute (re-prefill)'}"
+                   f"{gap or ' (never resumed in this window)'}")
+    return out
 
 
 def phase_breakdown(timelines: List[Dict]) -> str:
@@ -162,6 +191,11 @@ def timeline_view(t: Dict[str, Any]) -> str:
            f"ttft {_ms(t.get('ttft_s')).strip()}ms  "
            f"tpot {_ms(t.get('tpot_s')).strip()}ms/token")
     lines = [head, lat]
+    if t.get("preempts"):
+        lines.append(f"preempted {t['preempts']}x "
+                     f"(restored {t.get('restored_tokens') or 0} KV "
+                     f"positions from host spill):")
+        lines.extend(preempt_spans(t))
     if t.get("events_dropped"):
         lines.append(f"({t['events_dropped']} early events dropped from "
                      f"the per-request ring)")

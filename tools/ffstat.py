@@ -173,6 +173,28 @@ def diagnosis(doc: Dict[str, Any],
         elif live:
             lines.append(f"{len(live)} enqueued request(s), none "
                          f"admitted yet")
+    pagers = doc.get("kv_pager")
+    if isinstance(pagers, list):
+        for p in pagers:
+            if not isinstance(p, dict):
+                continue
+            spilled = p.get("spilled_guids") or {}
+            lines.append(
+                f"kv pager: pages {p.get('free_pages')}/"
+                f"{p.get('total_pages')} free "
+                f"(page_len {p.get('page_len')}, "
+                f"{len(p.get('leases') or [])} leased slots, "
+                f"overcommit {p.get('overcommitted_pages', 0)}); "
+                f"spilled guids: "
+                + (" ".join(f"{g}({s.get('tokens')}tok)"
+                            for g, s in spilled.items())
+                   if spilled else "none")
+                + f"; preemptions {p.get('preemptions')}")
+            if spilled:
+                lines.append(
+                    "=> spilled requests are waiting on pages — "
+                    "inspect each with `tools/ffreq.py BUNDLE "
+                    "--guid G` (preempt->restore/recompute spans)")
     jx = doc.get("jax")
     if isinstance(jx, dict) and jx:
         lines.append("jax: " + " ".join(

@@ -35,4 +35,13 @@
 (cd "$(dirname "$0")/.." \
  && env JAX_PLATFORMS=cpu python tools/ffreq.py --selftest >/dev/null) \
  || { echo "ffreq/request-ledger selftest FAILED" >&2; exit 1; }
+# KV-pager smoke: pure-host allocator accounting (lease/release/refs,
+# page-alignment validation, spill-store budgeting, restore-vs-
+# recompute pricing) so a broken pager fails CI in milliseconds before
+# a paged BENCH round depends on it.
+(cd "$(dirname "$0")/.." \
+ && env JAX_PLATFORMS=cpu python -c \
+    "import sys; from flexflow_tpu.serving.kv_pager import _selftest; \
+sys.exit(_selftest())" >/dev/null) \
+ || { echo "kv_pager selftest FAILED" >&2; exit 1; }
 set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c); exit $rc
