@@ -114,7 +114,14 @@ class _StderrTail:
 
 _STDERR_TAIL = None          # installed in __main__
 _WATCHDOG = None             # started in __main__
-_PROGRESS = {"mode": None, "in_flight": None, "done": [], "metrics": []}
+_PROGRESS = {"mode": None, "in_flight": None, "done": [], "metrics": [],
+             # label -> {"status": started|done|aborted|failed,
+             #           "t_start_unix", "elapsed_s"[, "error"]}:
+             # stamped "started" IMMEDIATELY at mode entry, so a mode
+             # that never completes its first section still leaves a
+             # diagnosable marker (the BENCH_r05 0-progress class —
+             # ffstat.py prints these)
+             "sections": {}}
 
 
 def _results_dir() -> str:
@@ -190,6 +197,7 @@ def _write_incremental():
               "time_unix": round(time.time(), 1),
               "sections_done": list(_PROGRESS["done"]),
               "section_in_flight": _PROGRESS["in_flight"],
+              "sections": dict(_PROGRESS.get("sections") or {}),
               **_postmortem_fields(),
               "metrics": list(_PROGRESS["metrics"])}
     path = os.path.join(outdir, name)
@@ -201,14 +209,28 @@ def _write_incremental():
 
 
 def _note_mode_start(label: str):
+    # the started marker lands ON DISK before the section runs: a mode
+    # killed with zero progress (BENCH_r05) leaves {status: started,
+    # t_start_unix} instead of nothing, and ffstat.py can say "mode X
+    # ran Ns and completed no section" from the record alone
     _PROGRESS["in_flight"] = label
+    # setdefault: tests monkeypatch _PROGRESS with minimal dicts
+    _PROGRESS.setdefault("sections", {})[label] = {
+        "status": "started", "t_start_unix": round(time.time(), 1)}
     _write_incremental()
 
 
-def _note_mode_done(label: str, metrics):
+def _note_mode_done(label: str, metrics, status: str = "done",
+                    error: str = None):
     _PROGRESS["in_flight"] = None
     _PROGRESS["done"].append(label)
     _PROGRESS["metrics"].extend(metrics)
+    sec = _PROGRESS.setdefault("sections", {}).setdefault(label, {})
+    sec["status"] = status
+    if error:
+        sec["error"] = error[:500]
+    if sec.get("t_start_unix"):
+        sec["elapsed_s"] = round(time.time() - sec["t_start_unix"], 1)
     # snapshot the section's SLO window NOW: the next section's warmup
     # clears the ledger, so under mode=all these per-section blocks
     # are what survives of each section (the final arm's window for
@@ -2047,6 +2069,137 @@ def bench_paged(model_builder=None, max_requests=8, prompt_len=48,
     return (head, *extras)
 
 
+def bench_live(model_builder=None, max_requests=8, max_seq_length=512,
+               n_requests=32, decode_block=8, max_tokens_per_batch=64,
+               utilization=0.8, tenants=4, fault_names=("none",
+                                                        "disconnects",
+                                                        "deadline_storm")):
+    """Live-traffic serving bench: the async front-end
+    (serve/frontend.py) driven by the ffload harness (tools/ffload.py)
+    under Poisson arrivals, reported PER FAULT PROFILE — the first
+    serving numbers in the trajectory that are under-load, under-fault
+    claims instead of offline batch ones.
+
+    Methodology: a closed-loop warmup pass compiles every shape bucket
+    AND measures offline throughput; the live arrival rate is then set
+    to ``utilization`` of that capacity (Poisson gaps), so the bench
+    exercises a loaded-but-feasible regime rather than a trivially
+    idle or hopelessly saturated one.  ``tenants`` groups share prompt
+    prefixes, exercising the radix prefix pool under live admission.
+    Headline = SLO goodput under the fault-free profile; extras carry
+    goodput + TTFT/TPOT attainment + outcome counts per fault profile
+    (client disconnects mid-stream; deadline storms).  The injected-
+    stall profile is NOT run here — it would trip the bench's own
+    watchdog by design; tests/test_frontend.py and the ffload CLI
+    cover it.
+
+    ``model_builder``: optional ``() -> (model, vocab_size)`` override
+    for the CPU test suite (default: the 1.4B bench LLaMA in bf16)."""
+    import asyncio
+
+    from flexflow_tpu import FFConfig, Model
+    from flexflow_tpu.models.llama import LLAMAConfig, create_llama_model
+    from flexflow_tpu.observability import SLOPolicy, get_ledger
+    from flexflow_tpu.serving import InferenceManager, RequestManager
+    from tools.ffload import (FAULT_PROFILES, TrafficProfile,
+                              _run_profiles)
+
+    if model_builder is None:
+        def model_builder():
+            from flexflow_tpu.fftype import DataType
+
+            cfg = LLAMAConfig(
+                vocab_size=32000, hidden_size=2048, intermediate_size=5504,
+                num_hidden_layers=24, num_attention_heads=16,
+                num_key_value_heads=4,
+                max_position_embeddings=max_seq_length)
+            model = Model(FFConfig(computation_dtype="bfloat16"),
+                          name="llama_live_bench")
+            create_llama_model(model, cfg, max_requests=max_requests,
+                               dtype=DataType.HALF)
+            return model, cfg.vocab_size
+
+    model, vocab = model_builder()
+    im = InferenceManager(model.config)
+    mid = im.compile_model_and_allocate_buffer(
+        model, max_requests=max_requests, max_seq_length=max_seq_length,
+        prefill_chunk=max_tokens_per_batch, kv_cache_dtype=_KV_DTYPE)
+    rm = RequestManager(max_requests_per_batch=max_requests,
+                        max_tokens_per_batch=max_tokens_per_batch,
+                        max_sequence_length=max_seq_length,
+                        decode_block=decode_block, prefix_cache=True)
+    if get_ledger().slo_policy() is None:
+        # generous portable defaults (override via --slo-ttft/--slo-
+        # tpot): live attainment under faults is the claim, not an
+        # absolute latency bar that a CPU test run could never meet
+        get_ledger().set_slo_policy(SLOPolicy(ttft_s=60.0, tpot_s=1.0))
+    shape = dict(prompt_lens=(16, 32, 48), output_lens=(16, 24, 32),
+                 vocab=max(16, vocab - 2), tenants=tenants,
+                 tenant_prefix_len=16)
+
+    # closed-loop warmup: compiles the buckets and measures capacity
+    warm = TrafficProfile(n_requests=max_requests, arrival="closed",
+                          seed=11, **shape)
+    rep_w = asyncio.run(_run_profiles(
+        im, mid, rm, warm, [FAULT_PROFILES["none"]]))[0]
+    warm_tokens = rep_w["counters"]["serving_tokens_generated_total"]
+    mean_out = sum(shape["output_lens"]) / len(shape["output_lens"])
+    cap_rps = max(1e-3, warm_tokens / max(1e-9, rep_w["wall_s"])
+                  / mean_out)
+    rate = utilization * cap_rps
+    _clear_ledger_window()
+
+    reports = []
+    for name in fault_names:
+        traffic = TrafficProfile(n_requests=n_requests,
+                                 arrival="poisson", rate_rps=rate,
+                                 seed=23, **shape)
+        reports.append(asyncio.run(_run_profiles(
+            im, mid, rm, traffic, [FAULT_PROFILES[name]]))[0])
+    _note_kv(im, mid, "live")
+
+    # the headline is the FAULT-FREE profile wherever it sits in
+    # fault_names (callers may reorder/subset); without one, the first
+    # profile heads the record with its name in the unit
+    by_name = {r["fault_profile"]: r for r in reports}
+    base = by_name.get("none", reports[0])
+    head = {
+        "metric": "live_serving_goodput",
+        "value": base.get("goodput_tokens_per_s", 0.0),
+        "unit": ("tokens/s (SLO-attaining, fault-free live profile)"
+                 if base["fault_profile"] == "none" else
+                 f"tokens/s (SLO-attaining, "
+                 f"{base['fault_profile']} profile)"),
+        "methodology": (f"poisson@{rate:.2f}rps({utilization:.0%}of"
+                        f"{cap_rps:.2f}cap),rows{max_requests},"
+                        f"n{n_requests},tenants{tenants},"
+                        f"frontend+ffload"),
+        "vs_baseline": 0,
+        "ttft_attainment": base.get("ttft_attainment"),
+        "tpot_attainment": base.get("tpot_attainment"),
+        "arrival_rate_rps": round(rate, 3),
+        "offline_capacity_rps": round(cap_rps, 3),
+        "outcomes": base["outcomes"],
+    }
+    extras = []
+    for rep in reports:
+        if rep is base:
+            continue
+        extras.append({
+            "metric": f"live_goodput_{rep['fault_profile']}",
+            "value": rep.get("goodput_tokens_per_s", 0.0),
+            "unit": "tokens/s (SLO-attaining, under fault)",
+            "vs_baseline": 0,
+            "ttft_attainment": rep.get("ttft_attainment"),
+            "tpot_attainment": rep.get("tpot_attainment"),
+            "cancelled_in_window": (rep.get("slo") or {}).get(
+                "cancelled", 0),
+            "outcomes": rep["outcomes"],
+            "counters": {k: v for k, v in rep["counters"].items() if v},
+        })
+    return (head, *extras)
+
+
 def bench_mnist_mlp():
     from flexflow_tpu import FFConfig, LossType, Model, SGDOptimizer
     from flexflow_tpu.fftype import ActiMode
@@ -2282,11 +2435,15 @@ def main(which: str, budget=None):
         head, *extras = bench_paged()
         head["extras"] = extras
         return head
+    if which == "live":
+        head, *extras = bench_live()
+        head["extras"] = extras
+        return head
     if which != "all":
         raise SystemExit(
             f"unknown bench mode {which!r} (expected all|llama|llama7b|"
             f"spec|spec7b|mnist|kernels|opt|resnet|longctx|quality|"
-            f"distill|crossover|prefix|kvdtype|paged)")
+            f"distill|crossover|prefix|kvdtype|paged|live)")
 
     # all: headline decode metric + everything else under extras.  Each
     # section runs in its own process lifetime-wise (HBM frees between
@@ -2310,6 +2467,9 @@ def main(which: str, budget=None):
             # bad state — skip the rest so the record still lands well
             # inside the external process timeout (the rc=124 killer)
             skipped.append(label)
+            _PROGRESS.setdefault("sections", {})[label] = {
+                "status": "skipped",
+                "error": f"skipped after {timed_out[0]} timed out"}
             return [{"metric": f"section_{label}_skipped", "value": 0.0,
                      "unit": "error", "vs_baseline": 0,
                      "error": f"skipped after {timed_out[0]} timed out"}]
@@ -2332,7 +2492,8 @@ def main(which: str, budget=None):
                            "value": 0.0, "unit": "error",
                            "vs_baseline": 0,
                            "timed_out": True, "error": str(e)}]
-                _note_mode_done(label, marker)
+                _note_mode_done(label, marker, status="aborted",
+                                error=str(e))
                 return marker
             except Exception as e:
                 last = f"{type(e).__name__}: {e}"
@@ -2347,7 +2508,7 @@ def main(which: str, budget=None):
         # indistinguishable from a removed one to trend tooling
         marker = [{"metric": f"section_{label}_failed", "value": 0.0,
                    "unit": "error", "error": last[:500], "vs_baseline": 0}]
-        _note_mode_done(label, marker)
+        _note_mode_done(label, marker, status="failed", error=last)
         return marker
 
     extras = _section(bench_llama7b_decode, "llama7b")
@@ -2367,6 +2528,7 @@ def main(which: str, budget=None):
                       + _section(bench_prefix, "prefix")
                       + _section(bench_kv_dtype, "kvdtype")
                       + _section(bench_paged, "paged")
+                      + _section(bench_live, "live")
                       + _section(bench_kernels, "kernels"))
     if timed_out or skipped:
         head["timed_out"] = {"budget_s": budget, "sections": timed_out,
@@ -2532,6 +2694,9 @@ def persist_record(result, mode: str):
               **tel,
               **_slo_summary(),
               **_postmortem_fields(),
+              # per-section started/done/aborted markers (the 0-progress
+              # diagnosis surface — ffstat prints them)
+              "sections": dict(_PROGRESS.get("sections") or {}),
               "metrics": metrics}
     if "step_latency_percentiles" in tel:
         # stdout (_slim) reuses THIS snapshot's percentiles so the
@@ -2671,6 +2836,11 @@ if __name__ == "__main__":
             _result = _with_budget(lambda: main(_args.mode), _args.budget)
             _note_mode_done(_args.mode, _flatten_metrics(_result))
     except _SectionTimeout as _e:
+        # the aborted marker lands in the incremental record too, so a
+        # single-mode --budget kill leaves {status: aborted, elapsed_s}
+        # for ffstat instead of only the stdout error object
+        _note_mode_done(_args.mode, [], status="aborted",
+                        error=str(_e))
         _result = {"metric": f"{_args.mode}_timed_out", "value": 0.0,
                    "unit": "error", "vs_baseline": 0, "error": str(_e),
                    "timed_out": {"budget_s": _args.budget,

@@ -114,6 +114,11 @@ def slo_report_from(timelines: Iterable[Dict[str, Any]],
     out: Dict[str, Any] = {
         "policy": {"ttft_s": policy.ttft_s, "tpot_s": policy.tpot_s},
         "requests": n,
+        # cancelled requests (deadline/shed/disconnect) stay in the
+        # window: a shed request that produced nothing fails a TTFT
+        # target and honestly drags attainment — goodput only ever
+        # counts attaining requests' tokens
+        "cancelled": sum(1 for t in retired if t.get("cancelled")),
     }
     if not n:
         out.update(attained=0, attainment=None, ttft_attainment=None,
@@ -254,7 +259,7 @@ class RequestLedger:
                 return
             t = self._live.get(guid)
             if t is None:
-                if name == "retire" or guid in self._retired:
+                if name in ("retire", "cancel") or guid in self._retired:
                     return          # late event for an already-gone guid
                 t = self._new_timeline(guid, now, payload)
                 if name != "enqueue":
@@ -290,6 +295,16 @@ class RequestLedger:
                         t["first_commit_tokens"] = n
                     t["last_commit_mono"] = now
             elif name == "retire":
+                self._finalize(t, now, payload)
+                retired_with_policy = self._policy is not None
+            elif name == "cancel":
+                # the cancel twin of retire: finalizes the timeline
+                # into the retired ring with cancelled=True so the
+                # committed-token reconciliation and the SLO window
+                # keep covering it (a shed/deadline cancel IS an SLO
+                # outcome, not a vanished request)
+                t["cancelled"] = True
+                t["cancel_reason"] = payload.get("reason")
                 self._finalize(t, now, payload)
                 retired_with_policy = self._policy is not None
         if retired_with_policy:
@@ -328,6 +343,7 @@ class RequestLedger:
             "last_commit_mono": None,
             "accepted": 0, "speculated": 0,
             "preempts": 0, "restored_tokens": 0,
+            "cancelled": False, "cancel_reason": None,
             "retired": False, "retire_mono": None,
             "tokens": None, "ttft_s": None, "tpot_s": None,
             "latency_s": None, "slo": None,

@@ -218,6 +218,47 @@ METRICS_SCHEMA = {
                 "are attributable (each transition also lands a "
                 "ledger note on the request's timeline).",
     },
+    # ------------------------------------------- async front-end
+    # (serve/frontend.py: continuous-admission asyncio front-end with
+    # per-token streaming, deadlines, backpressure and load shedding
+    # over the blocking driver loops — docs/SERVING.md)
+    "serving_cancellations_total": {
+        "type": "counter",
+        "help": "Requests cancelled before natural retirement "
+                "(RequestManager.cancel_request), labeled reason="
+                "deadline (SLO-derived per-request deadline expired "
+                "mid-stream) | disconnect (client stream closed) | "
+                "slow_client (bounded stream queue overflowed) | "
+                "client (explicit API cancel) | shed:* (load-shed "
+                "victims — the shed reason rides the label) | stall/"
+                "closed/driver_failed (server-side teardown of work "
+                "whose streams were failed — never misread as client "
+                "disconnects).  A "
+                "cancelled request's pager pages, pool donations and "
+                "ledger timeline are released exactly like a "
+                "retirement; its committed tokens stay counted in "
+                "serving_tokens_generated_total (reconciliation).",
+    },
+    "serving_shed_total": {
+        "type": "counter",
+        "help": "Requests dropped by the front-end's load-shed policy "
+                "under overload, labeled reason=hopeless (remaining "
+                "deadline budget < estimated remaining service time — "
+                "the request cannot attain its SLO, so shedding it "
+                "costs nothing) | overload (pending queue over the "
+                "shed watermark; newest arrivals first) | "
+                "pager_pressure (KV page budget exhausted with a deep "
+                "queue).  Every shed also ticks "
+                "serving_cancellations_total{reason=shed:<reason>}.",
+    },
+    "serving_rejected_total": {
+        "type": "counter",
+        "help": "Intake submissions rejected before enqueue, labeled "
+                "reason=backpressure (pending deque at the intake "
+                "watermark — the client got Overloaded with a "
+                "retry_after_s hint instead of unbounded queue "
+                "growth) | closed (front-end shut down or failed).",
+    },
     # ------------------------------------------------- SLO / goodput
     # (per-request ledger, observability/ledger.py: evaluated per
     # retired request against the installed SLOPolicy; all four refresh
@@ -302,6 +343,29 @@ EVENT_SCHEMA = {
     "donate": {
         "help": "Retired row donated to the prefix pool (guid, slot, "
                 "length).",
+    },
+    "cancel": {
+        "help": "Request cancelled before natural retirement (guid, "
+                "reason=deadline|disconnect|slow_client|client|shed:*, "
+                "tokens committed so far; the ledger feed additionally "
+                "carries ttft_s/latency_s/queue_s).  Finalizes the "
+                "request's timeline with cancelled=True — the cancel "
+                "twin of `retire`.",
+    },
+    "shed": {
+        "help": "The front-end's load-shed policy dropped a request "
+                "(guid, reason=hopeless|overload|pager_pressure), "
+                "recorded when the enacting cancel lands (beside its "
+                "cancel event, whose reason is shed:<reason>) — "
+                "selection alone is never counted, so shed totals "
+                "can't outnumber actual cancellations.",
+    },
+    "disconnect": {
+        "help": "A streaming client went away mid-request (guid, "
+                "streamed = tokens delivered before the disconnect); "
+                "the front-end cancels the request so its row, pages "
+                "and pool refs free immediately instead of decoding "
+                "for a dead socket.",
     },
     "preempt": {
         "help": "Running request preempted by the KV pager (guid, row, "

@@ -77,4 +77,7 @@ def _resolved_config() -> FFConfig:
     return _global_config
 
 
+from .frontend import (AsyncServeFrontend, FrontendClosed,  # noqa: E402
+                       Overloaded, RequestAborted, ShedPolicy,
+                       TokenStream)
 from .serve import LLM, SSM, GenerationConfig, SupportedModels  # noqa: E402

@@ -1,0 +1,617 @@
+"""Async serving front-end: continuous admission, streaming, deadlines,
+backpressure and graceful shedding over the blocking driver loops.
+
+Everything below this module is a *batch* engine: the driver loops
+(``RequestManager.generate_incr_decoding``, ``generate_spec_infer``)
+block the calling thread until every queued request retires — the shape
+the reference exposes through its ``inference/incr_decoding`` /
+``inference/spec_infer`` entry points and the prototype ``triton/``
+backend wraps for live traffic.  This module is our live-traffic
+equivalent, built the way the reference splits Legion runtime threads
+from the request queue:
+
+- **One dedicated driver thread** owns the blocking step loop.  It
+  re-enters the generate loop whenever the pending deque is non-empty,
+  so admission is CONTINUOUS (Orca-style: new arrivals join the running
+  batch at the next ``prepare_next_batch`` boundary, they never wait
+  for a batch to finish).  JAX dispatch stays on one thread — the event
+  loop never touches the device.
+- **The asyncio event loop** owns intake, per-token streaming,
+  deadlines, backpressure and shedding.  The thread boundary is
+  explicit and narrow: driver→loop via ``call_soon_threadsafe`` (the
+  ``on_commit``/``on_finish`` hooks), loop→driver via
+  ``RequestManager.request_cancel`` (a locked mailbox the driver drains
+  at the ``admit_pending`` boundary, where no driver-local row state is
+  in flight).
+- **Streaming** is a bounded per-request ``asyncio.Queue``: tokens are
+  delivered as the driver commits them (per fold — a K-step decode
+  block arrives as one K-token burst, which is what the device actually
+  produced between host syncs).  A consumer that stops draining fills
+  its queue and is cancelled as a slow client rather than growing
+  unbounded host memory; the final-status sentinel always has a
+  reserved slot, so no await ever hangs.
+- **Deadlines** derive from the installed
+  :class:`~flexflow_tpu.observability.SLOPolicy` when the caller gives
+  none: a request that would blow ``deadline_factor * (ttft_s +
+  max_new_tokens * tpot_s)`` is cancelled mid-stream — its pager
+  pages, pool donations and ledger timeline released exactly like a
+  retirement (``RequestManager.cancel_request``).
+- **Backpressure**: intake REJECTS (``Overloaded`` with a
+  ``retry_after_s`` hint, ``serving_rejected_total{reason=
+  backpressure}``) when the pending deque reaches the watermark —
+  bounded queues instead of unbounded growth, the vLLM admission-
+  control stance.
+- **Shedding**: under overload the :class:`ShedPolicy` reads the
+  request ledger's in-flight timelines and the KV pager's page
+  pressure and drops the pending requests LEAST likely to attain
+  their SLO (hopeless deadlines first, then newest arrivals), counted
+  under ``serving_shed_total{reason}``.
+
+See docs/SERVING.md for the architecture walkthrough and
+``tools/ffload.py`` for the fault-injecting load harness that
+exercises every path above.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import threading
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from ..observability import get_flight_recorder, get_ledger, get_registry
+from ..serving.request_manager import Request, RequestManager
+
+__all__ = ["AsyncServeFrontend", "TokenStream", "ShedPolicy",
+           "Overloaded", "RequestAborted", "FrontendClosed"]
+
+
+class Overloaded(Exception):
+    """Intake rejected: the pending deque is at the backpressure
+    watermark.  ``retry_after_s`` is the estimated drain time of one
+    queue slot — the HTTP-429-Retry-After hint."""
+
+    def __init__(self, retry_after_s: float, pending: int, limit: int):
+        super().__init__(
+            f"serving queue full ({pending}/{limit} pending); "
+            f"retry after {retry_after_s:.3f}s")
+        self.retry_after_s = retry_after_s
+        self.pending = pending
+        self.limit = limit
+
+
+class RequestAborted(Exception):
+    """The stream ended before natural retirement (deadline, shed,
+    disconnect, slow client, driver stall).  ``tokens`` carries what
+    was streamed before the abort."""
+
+    def __init__(self, guid: int, reason: str,
+                 tokens: Optional[List[int]] = None):
+        super().__init__(f"request {guid} aborted: {reason}")
+        self.guid = guid
+        self.reason = reason
+        self.tokens = list(tokens or [])
+
+
+class FrontendClosed(Exception):
+    """Submission refused: the front-end is shut down or its driver
+    failed/stalled (the bundle path, when a watchdog dumped one)."""
+
+
+#: queue sentinel carrying the final status (its slot is reserved so a
+#: full token queue can never block stream termination)
+_FINAL = object()
+
+
+class TokenStream:
+    """One client's handle on an in-flight request.
+
+    Async-iterate for per-token streaming, or :meth:`result` to drain
+    to completion.  All state lives on the event-loop thread; the
+    driver reaches it only through ``call_soon_threadsafe``.
+    """
+
+    def __init__(self, frontend: "AsyncServeFrontend", req: Request,
+                 queue_tokens: int, deadline_mono: Optional[float]):
+        self._fe = frontend
+        self.request = req
+        self.guid = req.guid
+        self.deadline_mono = deadline_mono
+        # +1: the _FINAL sentinel's reserved slot (delivery never
+        # exceeds maxsize-1 tokens — see _deliver)
+        self._q: "asyncio.Queue" = asyncio.Queue(maxsize=queue_tokens + 1)
+        #: (status, reason, exc) once the request left the engine
+        self._final: Optional[Tuple[str, Optional[str],
+                                    Optional[BaseException]]] = None
+        self.tokens: List[int] = []     # streamed so far (consumer side)
+
+    # ------------------------------------------------------------- client
+    def __aiter__(self) -> "TokenStream":
+        return self
+
+    async def __anext__(self) -> int:
+        item = await self._q.get()
+        if item is _FINAL:
+            # re-arm: repeated iteration keeps terminating
+            self._q.put_nowait(_FINAL)
+            status, reason, exc = self._final
+            if exc is not None:
+                raise exc
+            if status != "retired":
+                raise RequestAborted(self.guid, reason or status,
+                                     self.tokens)
+            raise StopAsyncIteration
+        self.tokens.append(item)
+        return item
+
+    async def result(self) -> List[int]:
+        """Drain the stream; returns all generated token ids.  Raises
+        :class:`RequestAborted` (carrying the partial tokens) when the
+        request was cancelled."""
+        async for _ in self:
+            pass
+        return self.tokens
+
+    @property
+    def finished(self) -> bool:
+        return self._final is not None
+
+    @property
+    def status(self) -> Optional[str]:
+        """None while streaming; "retired" | "cancelled" | "failed"."""
+        return self._final[0] if self._final is not None else None
+
+    def disconnect(self) -> None:
+        """The client goes away mid-stream: the front-end cancels the
+        request so its row/pages free immediately instead of decoding
+        for a dead socket (``serving_cancellations_total{reason=
+        disconnect}``)."""
+        if self._final is None:
+            self._fe._note_disconnect(self)
+
+
+class ShedPolicy:
+    """WHEN the front-end sheds pending requests and WHOM.
+
+    - ``overloaded()``: the trigger — the pending deque over the shed
+      watermark, or the KV pager's page budget exhausted under a
+      non-empty queue (``pager_pressure``).
+    - ``victims()``: the selection — requests LEAST likely to attain
+      their SLO.  Hopeless deadlines first: with a service-time
+      estimate from the ledger's recent retired window and the
+      request's queue position against the in-flight batch, a pending
+      request whose deadline lands before its estimated completion is
+      shed for free (it was going to miss anyway).  Then, while still
+      over the watermark, newest arrivals (LIFO — preserving the FCFS
+      order of earlier arrivals, the same fairness stance as the
+      pager's admission preemption).
+    """
+
+    def __init__(self, max_pending: int = 64,
+                 shed_watermark: Optional[int] = None,
+                 estimate_ttl_s: float = 0.25):
+        self.max_pending = max(1, int(max_pending))
+        self.shed_watermark = (int(shed_watermark)
+                               if shed_watermark is not None
+                               else max(1, self.max_pending // 2))
+        # service-estimate cache: the median scan copies the ledger's
+        # whole retired window under its lock, and victims() runs
+        # every reap tick (50x/s default) — cap the scan rate instead
+        self.estimate_ttl_s = float(estimate_ttl_s)
+        self._est: Optional[float] = None
+        self._est_mono: float = 0.0
+
+    # ------------------------------------------------------------ intake
+    def reject_now(self, rm: RequestManager) -> bool:
+        return len(rm.pending) >= self.max_pending
+
+    def retry_after_s(self, rm: RequestManager, ledger) -> float:
+        """One queue slot's estimated drain time (the Overloaded
+        hint): recent per-request service time over the batch width,
+        floored at 10 ms so clients never busy-spin."""
+        est = self._service_estimate(ledger)
+        if est is None:
+            return 0.05
+        return max(0.01, est / max(1, rm.max_requests_per_batch))
+
+    # ---------------------------------------------------------- shedding
+    def overloaded(self, rm: RequestManager, pager) -> Optional[str]:
+        if len(rm.pending) > self.shed_watermark:
+            return "overload"
+        if (pager is not None and rm.pending
+                and pager.free_pages == 0):
+            return "pager_pressure"
+        return None
+
+    def victims(self, rm: RequestManager, ledger, pager, now: float,
+                deadlines: Dict[int, Optional[float]]
+                ) -> List[Tuple[int, str]]:
+        """(guid, reason) per shed victim this tick.  ``deadlines``
+        maps guid -> absolute monotonic deadline (None = none)."""
+        out: List[Tuple[int, str]] = []
+        trigger = self.overloaded(rm, pager)
+        if not rm.pending or (trigger is None and not any(
+                d is not None for d in deadlines.values())):
+            # idle/healthy fast path: nothing to shed and no deadline
+            # to price — skip the ledger-window scan entirely (this
+            # runs every reap tick on the event loop)
+            return out
+        try:
+            pending = list(rm.pending)
+        except RuntimeError:
+            # the driver thread mutated the deque mid-iteration; this
+            # tick's view is gone — shed on the next one
+            return out
+        est = self._service_estimate(ledger)
+        if est is not None:
+            # per-slot start estimate: position in the queue over the
+            # batch width rounds of the estimated service time
+            width = max(1, rm.max_requests_per_batch)
+            survivors = []
+            for i, req in enumerate(pending):
+                dl = deadlines.get(req.guid)
+                if dl is not None and now + (i // width + 1) * est > dl:
+                    out.append((req.guid, "hopeless"))
+                else:
+                    survivors.append(req)
+            pending = survivors
+        if trigger is not None:
+            keep = self.shed_watermark
+            for req in pending[keep:][::-1]:        # newest first
+                out.append((req.guid, trigger))
+        return out
+
+    def _service_estimate(self, ledger) -> Optional[float]:
+        """Median admitted-span of the recent retired window (the
+        ledger feed the shed decision reads) — None before any
+        retirement, which disables hopeless-shedding (never guess).
+        Cached for ``estimate_ttl_s`` so reap ticks don't rescan the
+        window 50x/s."""
+        now = time.monotonic()
+        if (self._est_mono
+                and now - self._est_mono < self.estimate_ttl_s):
+            return self._est
+        # admitted span only: latency_s includes queue wait (its
+        # docstring says so), and pricing a queue-positioned start
+        # estimate with queue-inflated service times would double-count
+        # the wait and shed attainable requests as hopeless
+        lats = sorted(
+            t["latency_s"] - (t.get("queue_s") or 0.0)
+            for t in ledger.timelines(include_live=False)
+            if t.get("latency_s") is not None and not t.get("cancelled"))
+        self._est = lats[len(lats) // 2] if lats else None
+        self._est_mono = now
+        return self._est
+
+
+class AsyncServeFrontend:
+    """The asyncio front-end (module docstring).  Use as an async
+    context manager::
+
+        async with AsyncServeFrontend(im, model_id, rm) as fe:
+            stream = await fe.submit([1, 2, 3], max_new_tokens=32)
+            async for tok in stream:
+                ...
+
+    or build one from a compiled :class:`~flexflow_tpu.serve.LLM` via
+    ``llm.frontend()``.
+    """
+
+    def __init__(self, im, model_id: int, rm: RequestManager,
+                 shed_policy: Optional[ShedPolicy] = None,
+                 stream_queue_tokens: int = 256,
+                 deadline_factor: float = 2.0,
+                 reap_interval_s: float = 0.02):
+        self.im = im
+        self.model_id = model_id
+        self.rm = rm
+        self.shed_policy = shed_policy or ShedPolicy()
+        self.stream_queue_tokens = max(1, int(stream_queue_tokens))
+        self.deadline_factor = float(deadline_factor)
+        self.reap_interval_s = float(reap_interval_s)
+        self.ledger = get_ledger()
+        self.recorder = get_flight_recorder()
+        m = get_registry()
+        self._m_shed = m.counter("serving_shed_total")
+        self._m_rejected = m.counter("serving_rejected_total")
+        # event-loop-owned state (every touch happens on the loop
+        # thread; the driver reaches it only via call_soon_threadsafe)
+        self._handles: Dict[int, TokenStream] = {}
+        # guids with an abort already requested but not yet enacted
+        # (the cancel mailbox drains at driver boundaries, so a shed
+        # victim stays visible in rm.pending for up to a decode block
+        # — without this guard the reaper would re-count it each tick)
+        self._abort_requested: set = set()
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+        self._reaper_task: Optional[asyncio.Task] = None
+        # driver-thread plumbing
+        self._thread: Optional[threading.Thread] = None
+        self._wake = threading.Event()
+        self._stop = threading.Event()
+        self._failed: Optional[BaseException] = None
+        self.last_bundle: Optional[str] = None
+
+    # ----------------------------------------------------------- lifecycle
+    async def start(self) -> "AsyncServeFrontend":
+        if self._thread is not None:
+            return self
+        self._loop = asyncio.get_running_loop()
+        self.rm.on_commit = self._driver_on_commit
+        self.rm.on_finish = self._driver_on_finish
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._driver_main,
+                                        name="ff-serve-driver",
+                                        daemon=True)
+        self._thread.start()
+        self._reaper_task = self._loop.create_task(self._reaper())
+        return self
+
+    async def close(self, timeout: float = 10.0) -> None:
+        """Stop intake, let in-flight work finish (bounded by
+        ``timeout``), join the driver thread and fail any leftover
+        streams with :class:`FrontendClosed`."""
+        if self._failed is None:
+            self._failed = FrontendClosed("front-end closed")
+        if self._reaper_task is not None:
+            self._reaper_task.cancel()
+            self._reaper_task = None
+        self._stop.set()
+        self._wake.set()
+        if self._thread is not None:
+            await asyncio.get_running_loop().run_in_executor(
+                None, self._thread.join, timeout)
+            if not self._thread.is_alive():
+                self._thread = None
+        self.rm.on_commit = None
+        self.rm.on_finish = None
+        self._fail_live(FrontendClosed("front-end closed"),
+                        reason="closed")
+
+    async def __aenter__(self) -> "AsyncServeFrontend":
+        return await self.start()
+
+    async def __aexit__(self, *exc) -> bool:
+        await self.close()
+        return False
+
+    # -------------------------------------------------------------- intake
+    async def submit(self, prompt, max_new_tokens: int = 128,
+                     deadline_s: Optional[float] = None,
+                     stream_queue_tokens: Optional[int] = None
+                     ) -> TokenStream:
+        """Enqueue one request; returns its :class:`TokenStream`.
+
+        Raises :class:`Overloaded` (with ``retry_after_s``) at the
+        backpressure watermark and :class:`FrontendClosed` after
+        shutdown/failure.  ``deadline_s`` is a wall budget from NOW
+        (submission); None derives one from the installed SLOPolicy
+        (``deadline_factor * (ttft_s + max_new_tokens * tpot_s)``) and
+        stays None when no policy is installed."""
+        if self._failed is not None:
+            self._m_rejected.inc(reason="closed")
+            raise FrontendClosed(str(self._failed))
+        if self.shed_policy.reject_now(self.rm):
+            self._m_rejected.inc(reason="backpressure")
+            raise Overloaded(
+                self.shed_policy.retry_after_s(self.rm, self.ledger),
+                len(self.rm.pending), self.shed_policy.max_pending)
+        if deadline_s is None:
+            deadline_s = self._policy_deadline_s(max_new_tokens)
+        req = self.rm.register_new_request(prompt, max_new_tokens)
+        stream = TokenStream(
+            self, req,
+            stream_queue_tokens or self.stream_queue_tokens,
+            time.monotonic() + deadline_s
+            if deadline_s is not None else None)
+        self._handles[req.guid] = stream
+        self._wake.set()
+        return stream
+
+    def _policy_deadline_s(self, max_new_tokens: int) -> Optional[float]:
+        pol = self.ledger.slo_policy()
+        if pol is None:
+            return None
+        base = (pol.ttft_s or 0.0) + max_new_tokens * (pol.tpot_s or 0.0)
+        return self.deadline_factor * base if base > 0 else None
+
+    # ------------------------------------------------------- cancellation
+    def cancel(self, guid: int, reason: str = "client") -> None:
+        """Cancel a submitted request from the event loop (boxed to the
+        driver; the stream terminates when the cancel lands).  A no-op
+        for already-finished streams (the natural race: a client
+        cancel scheduled behind a completion)."""
+        h = self._handles.get(guid)
+        if h is not None and h._final is not None:
+            return
+        # the abort is now spoken for: the shed policy must not pick
+        # this guid while its cancel waits in the mailbox (a shed tick
+        # then would inflate serving_shed_total with no matching
+        # shed:* cancellation — the reasons are first-wins)
+        self._abort_requested.add(guid)
+        self.rm.request_cancel(guid, reason)
+        self._wake.set()
+
+    def _note_disconnect(self, stream: TokenStream) -> None:
+        self.recorder.record_event("disconnect", guid=stream.guid,
+                                   streamed=len(stream.tokens))
+        self.ledger.note_event("disconnect", guid=stream.guid,
+                               streamed=len(stream.tokens))
+        self.cancel(stream.guid, "disconnect")
+
+    # ------------------------------------------------------ reaper/shedder
+    async def _reaper(self) -> None:
+        """Deadline enforcement + shed policy, on the event loop."""
+        while True:
+            await asyncio.sleep(self.reap_interval_s)
+            try:
+                self._reap_tick(time.monotonic())
+            except asyncio.CancelledError:
+                raise
+            except Exception:       # the reaper must outlive one bad tick
+                import traceback
+
+                traceback.print_exc()
+
+    def _reap_tick(self, now: float) -> None:
+        for h in list(self._handles.values()):
+            if (h._final is None and h.deadline_mono is not None
+                    and now > h.deadline_mono
+                    and h.guid not in self._abort_requested):
+                self._abort_requested.add(h.guid)
+                self.cancel(h.guid, "deadline")
+        deadlines = {h.guid: h.deadline_mono
+                     for h in self._handles.values()
+                     if h._final is None}
+        for guid, why in self.shed_policy.victims(
+                self.rm, self.ledger, self.rm.kv_pager, now, deadlines):
+            if guid in self._abort_requested:
+                continue
+            # the shed COUNTER/EVENT is emitted at enactment
+            # (_driver_on_finish), not here: a victim that retires
+            # naturally before the mailbox drains must not read as a
+            # shed with no matching cancellation
+            self.cancel(guid, f"shed:{why}")
+        # prune abort marks whose request is gone without a handle
+        # finish (cancel-of-finished races): neither side will ever
+        # discard them, and a long-lived server must not leak guids
+        if self._abort_requested:
+            try:
+                alive = {h.guid for h in self._handles.values()}
+                alive |= {r.guid for r in list(self.rm.pending)}
+                alive |= {r.guid
+                          for r in list(self.rm.running.values())}
+            except RuntimeError:
+                return               # driver mutated mid-scan; next tick
+            self._abort_requested &= alive
+
+    # ------------------------------------------------------ driver thread
+    def _driver_main(self) -> None:
+        rm = self.rm
+        while not self._stop.is_set():
+            if rm.pending or rm.running:
+                try:
+                    self._generate_once()
+                except BaseException as e:  # noqa: BLE001 - fail streams
+                    self._failed = e
+                    self._call_loop(self._fail_live, e)
+                    return
+            else:
+                rm.drain_cancels()       # idle-time cancels (stale-safe)
+                self._wake.wait(0.05)
+                self._wake.clear()
+
+    def _generate_once(self) -> None:
+        """One blocking generate pass over everything queued (the
+        driver loop admits continuously, so arrivals during the pass
+        join it; the pass returns when the engine drains)."""
+        if self.rm.ssm_model_ids:
+            from ..serving.spec_infer import generate_spec_infer
+
+            generate_spec_infer(self.rm, self.im, self.model_id, ())
+        else:
+            self.rm.generate_incr_decoding(self.im, self.model_id, ())
+
+    # --------------------------------------------- driver->loop delivery
+    def _call_loop(self, fn, *args) -> None:
+        loop = self._loop
+        if loop is None or loop.is_closed():
+            return
+        try:
+            loop.call_soon_threadsafe(fn, *args)
+        except RuntimeError:            # loop shut down mid-call
+            pass
+
+    def _driver_on_commit(self, req: Request, toks: Sequence[int]) -> None:
+        self._call_loop(self._deliver, req.guid,
+                        [int(t) for t in toks])
+
+    def _driver_on_finish(self, req: Request, status: str,
+                          reason: Optional[str]) -> None:
+        if (status == "cancelled" and reason
+                and reason.startswith("shed:")):
+            # shed accounting lands when the cancel is ENACTED — the
+            # counter/event can never outnumber actual cancellations
+            # (registry + recorder are thread-safe; the timeline
+            # already carries cancel_reason="shed:<why>")
+            why = reason[5:]
+            self._m_shed.inc(reason=why)
+            self.recorder.record_event("shed", guid=req.guid,
+                                       reason=why)
+        self._call_loop(self._finish, req.guid, status, reason, None)
+
+    def _deliver(self, guid: int, toks: List[int]) -> None:
+        h = self._handles.get(guid)
+        if h is None or h._final is not None:
+            return
+        for t in toks:
+            if h._q.qsize() >= h._q.maxsize - 1:
+                # bounded stream: a consumer this far behind is treated
+                # as gone — cancel rather than buffer unboundedly (the
+                # sentinel slot stays reserved, so termination is still
+                # deliverable)
+                self.cancel(guid, "slow_client")
+                return
+            h._q.put_nowait(t)
+
+    def _finish(self, guid: int, status: str, reason: Optional[str],
+                exc: Optional[BaseException]) -> None:
+        self._abort_requested.discard(guid)
+        h = self._handles.pop(guid, None)
+        if h is None or h._final is not None:
+            return
+        h._final = (status, reason, exc)
+        h._q.put_nowait(_FINAL)         # reserved slot — never raises
+
+    def _fail_live(self, exc: BaseException,
+                   reason: str = "driver_failed") -> None:
+        """Terminate every live stream with ``exc`` (driver death,
+        watchdog stall, shutdown) — no hung awaits, ever.  The
+        engine-side requests are cancelled too (boxed; enacted when the
+        driver unwedges or next idles): their clients are gone, so
+        decoding on for them would burn batch rows on dead sockets.
+        ``reason`` labels those cancellations (stall | closed |
+        driver_failed) so a post-mortem never misreads server-side
+        failure as a burst of client disconnects."""
+        for guid in list(self._handles):
+            self._finish(guid, "failed", None,
+                         exc if isinstance(exc, Exception)
+                         else RuntimeError(repr(exc)))
+            self.rm.request_cancel(guid, reason)
+        self._wake.set()
+
+    # ------------------------------------------------------ observability
+    def live_guids(self) -> List[int]:
+        return [g for g, h in self._handles.items() if h._final is None]
+
+    def watchdog(self, stall_timeout: float = 120.0,
+                 bundle_dir: Optional[str] = None, **kwargs):
+        """A stall :class:`~flexflow_tpu.observability.Watchdog` wired
+        to this front-end: when the driver loop stops committing steps
+        for ``stall_timeout`` seconds, the bundle dumps (ledger names
+        the in-flight GUIDs) AND every connected client stream
+        terminates with :class:`RequestAborted` — a stalled chip must
+        never strand clients on hung awaits."""
+        from ..observability import Watchdog
+
+        def on_bundle(path: str, reason: str) -> None:
+            self.last_bundle = path
+            if reason.startswith("stall"):
+                self._failed = FrontendClosed(
+                    f"driver stalled ({reason}); bundle: {path}")
+                self._call_loop(
+                    self._fail_live,
+                    RequestAborted(-1, f"driver-stall:{path}"),
+                    "stall")
+
+        return Watchdog(stall_timeout=stall_timeout,
+                        bundle_dir=bundle_dir, on_bundle=on_bundle,
+                        **kwargs)
+
+    def stats(self) -> Dict[str, Any]:
+        return {
+            "live_streams": len(self._handles),
+            "pending": len(self.rm.pending),
+            "running": len(self.rm.running),
+            "failed": repr(self._failed) if self._failed else None,
+            "last_bundle": self.last_bundle,
+        }

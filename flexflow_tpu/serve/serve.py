@@ -433,6 +433,24 @@ class LLM:
                     }) + "\n")
         return results
 
+    # ------------------------------------------------------------ frontend
+    def frontend(self, **kwargs):
+        """An :class:`~flexflow_tpu.serve.AsyncServeFrontend` over this
+        compiled model: continuous-admission async serving with
+        per-token streaming, SLO-derived deadlines, bounded-intake
+        backpressure and graceful shedding (docs/SERVING.md).
+
+        >>> llm.compile(...)
+        >>> async with llm.frontend() as fe:
+        ...     stream = await fe.submit("hello", max_new_tokens=32)
+        ...     async for tok in stream: ...
+        """
+        assert self.rm is not None, "call compile() first"
+        from .frontend import AsyncServeFrontend
+
+        return AsyncServeFrontend(self.im, self.model_id, self.rm,
+                                  **kwargs)
+
     # -------------------------------------------------------- observability
     def metrics_snapshot(self) -> Dict[str, Any]:
         """Snapshot of the serving metrics registry (counters, gauges,

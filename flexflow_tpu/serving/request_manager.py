@@ -27,8 +27,10 @@ import collections
 import dataclasses
 import itertools
 import os
+import threading
 import time
-from typing import Any, Deque, Dict, List, Optional, Sequence, Tuple
+from typing import (Any, Callable, Deque, Dict, List, Optional, Sequence,
+                    Tuple)
 
 import jax
 import numpy as np
@@ -145,7 +147,7 @@ class ProfileInfo:
 class Request:
     """One in-flight generation request (reference request_manager.h:52)."""
 
-    PENDING, RUNNING, COMPLETED = range(3)
+    PENDING, RUNNING, COMPLETED, CANCELLED = range(4)
 
     def __init__(self, guid: int, prompt: str, tokens: List[int],
                  max_new_tokens: int, max_sequence_length: int):
@@ -207,7 +209,16 @@ class RequestManager:
         self.add_bos_token = True
         self.pending: Deque[Request] = collections.deque()
         self.running: Dict[int, Request] = {}   # row -> Request
+        # finished (retired + cancelled) requests, kept for
+        # dump_profiles and result lookups — BOUNDED: the async
+        # front-end turns this manager into a long-lived server, and
+        # an unbounded dict of full Request objects (prompt + output
+        # token lists) is a slow OOM under live traffic.  FIFO-evicted
+        # past the cap (env FF_COMPLETED_CAP), evicted guids leave
+        # _dumped_guids too so neither side leaks.
         self.completed: Dict[int, Request] = {}
+        self.completed_capacity = int(
+            os.environ.get("FF_COMPLETED_CAP", "4096") or 4096)
         self.ssm_model_ids: List[int] = []
         self._dumped_guids: set = set()
         self._rng = np.random.default_rng(0)
@@ -278,6 +289,23 @@ class RequestManager:
         self._m_spec_rate = m.histogram("serving_spec_acceptance_rate")
         self._m_spec_verify = m.histogram("serving_spec_verify_tokens")
         self._m_adm_blocked = m.counter("serving_admission_blocked_total")
+        self._m_cancelled = m.counter("serving_cancellations_total")
+        # deferred-cancellation mailbox (async front-end → driver
+        # thread): request_cancel() boxes a guid from any thread;
+        # drain_cancels() enacts them on the driver thread at the
+        # admit_pending boundary, where no driver-local row state is
+        # in flight (docs/SERVING.md "Cancellation").
+        self._cancel_lock = threading.Lock()
+        self._cancel_box: Dict[int, str] = {}
+        # async front-end hooks (serve/frontend.py), called on the
+        # DRIVER thread: on_commit(req, tokens) with each newly
+        # appended token-id batch, on_finish(req, status, reason) once
+        # per request at retirement ("retired") or cancellation
+        # ("cancelled", reason).  None = no front-end attached.
+        self.on_commit: Optional[Callable[[Request, Sequence[int]],
+                                          None]] = None
+        self.on_finish: Optional[Callable[[Request, str, Optional[str]],
+                                          None]] = None
 
     # -------------------------------------------------------------- setup
     def register_tokenizer(self, tokenizer, eos_token_id=None,
@@ -350,6 +378,13 @@ class RequestManager:
         sets ``req.cached_len``.  Returns (request, {model_id:
         matched_len}) per admission; matched is empty without a hit.
         """
+        # deferred cancellations first: every driver passes through
+        # here between device epochs (the incr driver via
+        # prepare_next_batch, the spec/pp drivers at their macro-
+        # iteration top BEFORE capturing local running copies), so this
+        # is the one boundary where removing a running row races no
+        # driver-local state
+        self.drain_cancels()
         pool = self.prefix_cache
         pager = self.kv_pager
         admitted: List[Tuple[Request, Dict[int, int]]] = []
@@ -790,6 +825,15 @@ class RequestManager:
                                    length=length)
         return ok
 
+    def _note_completed(self, req: Request):
+        """Record a finished request, FIFO-evicting past the cap (the
+        long-lived front-end bound — see completed_capacity)."""
+        self.completed[req.guid] = req
+        while len(self.completed) > self.completed_capacity:
+            old_guid = next(iter(self.completed))
+            del self.completed[old_guid]
+            self._dumped_guids.discard(old_guid)
+
     def _finished(self, req: Request, new_token: int) -> bool:
         if self.eos_token_id is not None and new_token == self.eos_token_id:
             return True
@@ -801,7 +845,7 @@ class RequestManager:
         p.finish_time = time.monotonic()
         row = req.row
         del self.running[row]
-        self.completed[req.guid] = req
+        self._note_completed(req)
         req.row = None
         # telemetry: one site covers every driver (all retire through
         # here, including the spec drivers' writeback paths)
@@ -830,6 +874,20 @@ class RequestManager:
             self._m_spec_accept.inc(p.accepted_tokens)
             self._m_spec_rate.observe(p.accepted_tokens
                                       / p.speculated_tokens)
+        self._release_row(req, row)
+        cb = self.on_finish
+        if cb is not None:
+            cb(req, "retired", None)
+
+    def _release_row(self, req: Request, row: int):
+        """Free a LEAVING (retired or cancelled) request's row — the
+        single exit path shared by :meth:`_retire` and
+        :meth:`cancel_request` (the preempt path's partial twin keeps
+        the spill buffer and skips donation): release the pinned prefix
+        entry, donate the committed KV to the prefix pool when a driver
+        context is armed, and settle the pager — pages follow the slot
+        (retagged to the pool entry on donation, freed otherwise) and
+        any host spill buffer dies with the request."""
         if req.prefix_entry is not None:
             self.prefix_cache.release(req.prefix_entry)
             if (self.kv_pager is not None
@@ -859,6 +917,87 @@ class RequestManager:
                 self.kv_pager.release(row)
             self.kv_pager.drop_spill(req.guid)
 
+    # ------------------------------------------------------- cancellation
+    def request_cancel(self, guid: int, reason: str = "client") -> None:
+        """Thread-safe DEFERRED cancellation (the async front-end's
+        entry point): the guid is boxed here and enacted by
+        :meth:`cancel_request` at the next ``admit_pending`` boundary —
+        every driver passes through it between device epochs, where no
+        driver-local row state is in flight.  First reason wins (a
+        deadline cancel racing a disconnect keeps whichever the client
+        experienced first)."""
+        with self._cancel_lock:
+            self._cancel_box.setdefault(guid, reason)
+
+    def drain_cancels(self) -> int:
+        """Enact boxed cancellations; returns how many took effect.
+        Must run on the driver thread (or with no driver in flight —
+        the idle front-end loop calls it directly)."""
+        with self._cancel_lock:
+            if not self._cancel_box:
+                return 0
+            box = self._cancel_box
+            self._cancel_box = {}
+        n = 0
+        for guid, reason in box.items():
+            n += bool(self.cancel_request(guid, reason=reason))
+        return n
+
+    def cancel_request(self, guid: int, reason: str = "client") -> bool:
+        """Cancel a PENDING or RUNNING request NOW.  Its row, pager
+        page leases, pool donations/refs and spill buffers release
+        EXACTLY like a retirement (:meth:`_release_row` — the shared
+        helper), its committed tokens stay counted in
+        ``serving_tokens_generated_total`` (they were generated; the
+        ledger reconciliation holds with cancellations in the mix) and
+        its ledger timeline finalizes with ``cancelled=True``.  The
+        caller must be at a driver-safe boundary — external threads go
+        through :meth:`request_cancel`.  Returns False for unknown or
+        already-finished guids (the natural race: a request retiring
+        right as its deadline expires)."""
+        req = next((r for r in self.running.values() if r.guid == guid),
+                   None)
+        row = None
+        if req is not None:
+            row = req.row
+        else:
+            req = next((r for r in self.pending if r.guid == guid), None)
+            if req is None:
+                return False
+            self.pending.remove(req)
+        p = req.profile
+        p.finish_time = time.monotonic()
+        req.status = Request.CANCELLED
+        # committed (generated) tokens stay counted — a mid-stream
+        # deadline cancel already delivered them
+        n_out = len(req.tokens) - req.prompt_len
+        if n_out:
+            self._m_tokens.inc(n_out)
+        if row is not None:
+            del self.running[row]
+            req.row = None
+            self._release_row(req, row)
+        elif self.kv_pager is not None:
+            # a preempted request cancelled while waiting in the queue
+            # still holds a host spill buffer
+            self.kv_pager.drop_spill(req.guid)
+        self._note_completed(req)
+        self._m_cancelled.inc(reason=reason)
+        self.tracer.instant("cancel", guid=req.guid, reason=reason,
+                            tokens=n_out)
+        self.recorder.record_event("cancel", guid=req.guid,
+                                   reason=reason, tokens=n_out)
+        self.ledger.note_event(
+            "cancel", guid=req.guid, reason=reason, tokens=n_out,
+            ttft_s=p.ttft_s(), latency_s=p.latency_s(),
+            queue_s=p.queue_wait_s())
+        self._m_queue_depth.set(len(self.pending))
+        self._m_active.set(len(self.running))
+        cb = self.on_finish
+        if cb is not None:
+            cb(req, "cancelled", reason)
+        return True
+
     def prepare_next_batch(self, prev_bc: Optional[BatchConfig],
                            prev_result: Optional[InferenceResult]
                            ) -> Optional[BatchConfig]:
@@ -882,6 +1021,9 @@ class RequestManager:
                     req.profile.note_first_token()
                     self.ledger.note_event("commit", guid=req.guid,
                                            tokens=1)
+                    cb = self.on_commit
+                    if cb is not None:
+                        cb(req, (tok,))
                     if self._finished(req, tok):
                         self._retire(req)
 
@@ -979,6 +1121,9 @@ class RequestManager:
             if n_row:
                 self.ledger.note_event("commit", guid=req.guid,
                                        tokens=n_row)
+                cb = self.on_commit
+                if cb is not None:
+                    cb(req, req.tokens[-n_row:])
             if done:
                 self._retire(req)
             appended += n_row

@@ -315,12 +315,13 @@ def _new_guid_state(D: int) -> Dict:
             "folded": 0, "accepted": 0, "speculated": 0, "llm_steps": 0}
 
 
-def _fold_packed(P, D: int, running, states) -> int:
+def _fold_packed(P, D: int, running, states, rm=None) -> int:
     """Append newly committed tokens from a packed sync to each request
     (single source for the _pack_state column offsets).  Returns the
     token count folded this sync (step-telemetry yield); feeds the
     request ledger one per-guid commit per row per sync (the device
-    loop's token attribution point — nothing finer is host-visible)."""
+    loop's token attribution point — nothing finer is host-visible)
+    and the front-end's on_commit streaming hook when one is armed."""
     ledger = get_ledger()
     out_len = P[:, 0]
     folded = 0
@@ -334,6 +335,9 @@ def _fold_packed(P, D: int, running, states) -> int:
         if n_row:
             ledger.note_event("commit", guid=req.guid, row=row,
                               tokens=n_row)
+            cb = rm.on_commit if rm is not None else None
+            if cb is not None:
+                cb(req, req.tokens[-n_row:])
         folded += n_row
         st["folded"] = int(out_len[row])
     return folded
@@ -742,7 +746,7 @@ def generate_spec_infer_device(rm, im, llm_id: int,
                 for packed in inflight:
                     P = np.asarray(packed)
                     im.note_host_sync()
-                    folded += _fold_packed(P, D, running, states)
+                    folded += _fold_packed(P, D, running, states, rm=rm)
             if folded:
                 rm.tracer.instant("commit", tokens=folded)
                 rm.recorder.record_event("commit", tokens=folded)
@@ -992,7 +996,8 @@ def generate_spec_infer_device_pp(rm, im, llm_id: int,
             P = np.asarray(packed)
             im.note_host_sync()
         iters_done = 1
-        rm._note_step(t_step, _fold_packed(P, D, running, states))
+        rm._note_step(t_step, _fold_packed(P, D, running, states,
+                                           rm=rm))
         while (P[:, 1] > 0).any() and not (rm.pending
                                            and not (P[:, 1] > 0).all()):
             rate = max(1.0, int(P[:, 0].max()) / max(1, iters_done))
@@ -1011,7 +1016,8 @@ def generate_spec_infer_device_pp(rm, im, llm_id: int,
                 P = np.asarray(packed)
                 im.note_host_sync()
             iters_done = int(P[:, 8].max())
-            rm._note_step(t_step, _fold_packed(P, D, running, states))
+            rm._note_step(t_step, _fold_packed(P, D, running, states,
+                                           rm=rm))
 
         ssm_record["caches"] = ssm_caches
         _writeback_rows(P, D, 1, rm, states, running)

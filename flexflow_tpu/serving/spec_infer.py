@@ -552,6 +552,9 @@ def _spec_infer_loop(rm, im, llm_id, requests, ssm_ids, tree_chunk, rng,
                 rm.ledger.note_event("commit", guid=req.guid, row=row,
                                      tokens=appended_row,
                                      accepted=len(acc_tokens))
+                cb = rm.on_commit
+                if cb is not None:
+                    cb(req, req.tokens[-appended_row:])
             committed_this_iter += appended_row
             if finished:
                 # donate BEFORE _retire clears req.row: committed KV =

@@ -25,6 +25,7 @@ from tools.fflint import (LintContext, RunStats, apply_baseline,  # noqa: E402
                           lint_file, lint_paths, load_baseline,
                           write_baseline)
 from tools.fflint.rules import ALL_RULES  # noqa: E402
+from tools.fflint.rules.asyncio_blocking import AsyncioBlockingRule  # noqa: E402
 from tools.fflint.rules.direct_host_sync import DirectHostSyncRule  # noqa: E402
 from tools.fflint.rules.donation import DonationRule  # noqa: E402
 from tools.fflint.rules.host_sync import HostSyncRule  # noqa: E402
@@ -1473,6 +1474,106 @@ class TestSymbolGraph:
 
 
 # ------------------------------------------------------- lock discipline
+class TestAsyncioBlockingRule:
+    R = [AsyncioBlockingRule()]
+
+    def test_time_sleep_in_async_def(self, tmp_path):
+        fs = lint(tmp_path, """\
+            import time
+
+
+            async def reaper(self):
+                time.sleep(0.1)
+                return 1
+            """, self.R)
+        assert at(fs, "asyncio-blocking-call", 5), fs
+        assert len(fs) == 1
+        assert "asyncio.sleep" in fs[0].message
+
+    def test_dispatch_and_driver_loop_in_async_def(self, tmp_path):
+        fs = lint(tmp_path, """\
+            async def handler(im, rm, mid, bc, rng):
+                outs = im.inference(mid, bc, rng)
+                rm.generate_incr_decoding(im, mid, ())
+                return outs
+            """, self.R)
+        assert at(fs, "asyncio-blocking-call", 2), fs
+        assert at(fs, "asyncio-blocking-call", 3), fs
+        assert len(fs) == 2
+
+    def test_materialization_of_tainted_value_in_async_def(self,
+                                                           tmp_path):
+        # the taint rides an alias, same as host-sync-dataflow; the
+        # dispatch itself is on line 2 (flagged), the fetch of the
+        # aliased result on line 4 is the SECOND blocking round trip
+        fs = lint(tmp_path, """\
+            import numpy as np
+
+
+            async def handler(im, mid, bc, rng):
+                outs = im.decode_block(mid, bc, 8, rng)
+                alias = outs
+                host = np.asarray(alias)
+                return host
+            """, self.R)
+        assert at(fs, "asyncio-blocking-call", 5), fs
+        assert at(fs, "asyncio-blocking-call", 7), fs
+
+    def test_sync_def_and_asyncio_sleep_clean(self, tmp_path):
+        fs = lint(tmp_path, """\
+            import asyncio
+            import time
+
+
+            def driver_thread(im, mid, bc, rng):
+                time.sleep(0.1)
+                outs = im.inference(mid, bc, rng)
+                return outs
+
+
+            async def reaper(self):
+                await asyncio.sleep(0.1)
+                return 1
+            """, self.R)
+        assert fs == []
+
+    def test_nested_sync_def_is_deferred_code(self, tmp_path):
+        # a def nested in an async body is shipped to an executor /
+        # the driver thread — its blocking calls run off-loop
+        fs = lint(tmp_path, """\
+            import time
+
+
+            async def submit(self, loop):
+                def blocking_probe():
+                    time.sleep(0.5)
+                    return 1
+                return await loop.run_in_executor(None, blocking_probe)
+            """, self.R)
+        assert fs == []
+
+    def test_materializer_of_host_value_clean(self, tmp_path):
+        # int() on plain host bookkeeping must not flag: only
+        # device-dispatch taint counts
+        fs = lint(tmp_path, """\
+            async def count(self, items):
+                n = int(len(items))
+                return n
+            """, self.R)
+        assert fs == []
+
+    def test_suppression(self, tmp_path):
+        fs = lint(tmp_path, """\
+            import time
+
+
+            async def probe(self):
+                time.sleep(0.01)  # fflint: disable=asyncio-blocking-call  test probe
+                return 1
+            """, self.R)
+        assert fs == []
+
+
 class TestLockDisciplineRule:
     R = [LockDisciplineRule()]
 

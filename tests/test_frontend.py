@@ -1,0 +1,566 @@
+"""Async serving front-end + ffload acceptance tests (PR 9).
+
+Pins the acceptance surface:
+
+- ``RequestManager.cancel_request``: pending AND running cancellation
+  releases pager page leases and donates reusable prefix rows exactly
+  like ``_retire`` (the shared ``_release_row`` helper), ticks
+  ``serving_cancellations_total{reason}`` and finalizes the ledger
+  timeline with ``cancelled=True`` — with the committed-token
+  reconciliation (sum of per-request committed ==
+  ``serving_tokens_generated_total``) intact;
+- the front-end lifecycle: streaming, backpressure (``Overloaded`` +
+  retry_after), SLO-derived deadlines enforced mid-stream, slow-client
+  cancellation on stream-queue overflow, graceful shedding under an
+  overload burst;
+- watchdog interaction: an injected driver stall while streaming
+  clients are connected dumps a bundle whose ledger names the
+  in-flight GUIDs, and every client stream terminates with an error —
+  no hung awaits;
+- the tier-1 acceptance run: the front-end under ffload with fault
+  injection (disconnect + cancel + deadline storm + injected stall),
+  asserting no hung streams, pager free-page count back at baseline,
+  goodput/attainment reported, and ledger reconciliation with
+  cancellations in the mix;
+- the zero-recompile pin: a warmed decode loop stays at ZERO compiles
+  with cancellations firing mid-serve (cancellation lives entirely in
+  host bookkeeping, never in the jitted steps);
+- bench.py satellite: the per-mode started/aborted section markers and
+  ffstat's 0-progress diagnosis.
+"""
+
+import asyncio
+import json
+import os
+import sys
+import time
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from flexflow_tpu.observability import (SLOPolicy, get_ledger,  # noqa: E402
+                                        get_registry)
+from flexflow_tpu.serve.frontend import (AsyncServeFrontend,  # noqa: E402
+                                         FrontendClosed, Overloaded,
+                                         RequestAborted, ShedPolicy)
+from flexflow_tpu.serving import RequestManager  # noqa: E402
+from flexflow_tpu.serving.kv_pager import KVPager  # noqa: E402
+from tools.ffload import (FAULT_PROFILES, FaultProfile,  # noqa: E402
+                          StallInjector, TrafficProfile,
+                          build_tiny_engine, run_load)
+
+TELEMETRY_ON = get_ledger().enabled
+
+pytestmark = pytest.mark.skipif(
+    not TELEMETRY_ON, reason="front-end accounting tests need telemetry")
+
+
+def _prompts(n, length, vocab=120, seed=0):
+    rng = np.random.default_rng(seed)
+    return [rng.integers(4, vocab, length).tolist() for _ in range(n)]
+
+
+def _counter(name):
+    v = (get_registry().snapshot().get("counters") or {}).get(name, 0)
+    return float(v.get("total", 0) if isinstance(v, dict) else v)
+
+
+def _labels(name):
+    v = (get_registry().snapshot().get("counters") or {}).get(name, {})
+    return dict(v.get("labels", {})) if isinstance(v, dict) else {}
+
+
+# ------------------------------------------------------- cancel_request
+class TestCancelRequest:
+    def test_pending_cancel_removes_and_counts(self):
+        get_ledger().clear()
+        rm = RequestManager(max_requests_per_batch=2)
+        req = rm.register_new_request([3, 5, 9], max_new_tokens=8)
+        before = _counter("serving_cancellations_total")
+        assert rm.cancel_request(req.guid, reason="client")
+        assert not rm.pending and req.status == req.CANCELLED
+        assert _counter("serving_cancellations_total") == before + 1
+        tl = get_ledger().timeline(req.guid)
+        assert tl["cancelled"] and tl["retired"]
+        assert tl["cancel_reason"] == "client" and tl["tokens"] == 0
+        # second cancel of a finished guid is a no-op
+        assert not rm.cancel_request(req.guid)
+        assert not rm.cancel_request(424242)
+
+    def test_running_cancel_releases_pages_and_donates_like_retire(self):
+        """The satellite audit: a RUNNING cancel must settle the pager
+        and the prefix pool EXACTLY like _retire — pages retag to the
+        donated pool entry, nothing leaks, and the donated prefix is
+        matchable by a later request."""
+        get_ledger().clear()
+        im, mid, _ = build_tiny_engine(max_requests=4, seed=5)
+        pager = KVPager(64, page_len=64,
+                        bytes_per_token=im.kv_cache_stats(
+                            mid).bytes_per_token)
+        rm = RequestManager(max_requests_per_batch=4,
+                            max_tokens_per_batch=64,
+                            max_sequence_length=256, decode_block=4,
+                            prefix_cache=True, kv_pager=pager)
+        prompts = _prompts(2, 24, seed=2)
+        reqs = [rm.register_new_request(list(p), max_new_tokens=32)
+                for p in prompts]
+        victim = reqs[0]
+        tokens_before = _counter("serving_tokens_generated_total")
+
+        # deterministic mid-stream cancel: boxed after the victim
+        # commits >= 8 tokens, enacted at the next driver boundary
+        def on_commit(req, toks):
+            if req.guid == victim.guid \
+                    and len(req.tokens) - req.prompt_len >= 8:
+                rm.request_cancel(req.guid, "deadline")
+
+        rm.on_commit = on_commit
+        rm.generate_incr_decoding(im, mid, reqs)
+        rm.on_commit = None
+
+        assert victim.status == victim.CANCELLED
+        n_out = len(victim.tokens) - victim.prompt_len
+        assert n_out >= 8
+        assert _labels("serving_cancellations_total").get(
+            "reason=deadline")
+        # pager accounting: every page is either free or retagged to a
+        # donated pool entry — no leaked request leases, no spills
+        snap = pager.snapshot()
+        assert all(lease["owner"] == "pool" for lease in snap["leases"])
+        pool_pages = sum(lease["pages"] for lease in snap["leases"])
+        assert snap["leased_pages"] == pool_pages
+        assert not snap["spilled_guids"]
+        # the cancelled request's committed KV was DONATED (exactly like
+        # _retire): a same-prefix request must match it
+        probe = rm.register_new_request(list(prompts[0]),
+                                        max_new_tokens=4)
+        rm.generate_incr_decoding(im, mid, [probe])
+        assert probe.profile.prefix_matched_tokens >= 16
+        # reconciliation with the cancellation in the mix
+        delta = _counter("serving_tokens_generated_total") \
+            - tokens_before
+        assert get_ledger().committed_total(retired_only=True) == delta
+        tl = get_ledger().timeline(victim.guid)
+        assert tl["cancelled"] and tl["tokens"] == n_out
+        assert tl["ttft_s"] is not None          # it DID stream tokens
+
+    def test_slo_report_counts_cancelled(self):
+        led = get_ledger()
+        led.clear()
+        led.note_event("enqueue", guid=90001, prompt_len=4)
+        led.note_event("admit", guid=90001, row=0)
+        led.note_event("commit", guid=90001, tokens=3)
+        led.note_event("cancel", guid=90001, reason="deadline", tokens=3)
+        rep = led.slo_report(SLOPolicy(ttft_s=10.0))
+        assert rep["requests"] == 1 and rep["cancelled"] == 1
+        led.clear()
+
+
+# ------------------------------------------------------ front-end basics
+class TestFrontendBasics:
+    @pytest.fixture(scope="class")
+    def engine(self):
+        return build_tiny_engine(max_requests=2, seed=3)
+
+    def test_stream_and_result(self, engine):
+        im, mid, rm = engine
+
+        async def go():
+            async with AsyncServeFrontend(im, mid, rm) as fe:
+                s = await fe.submit([5, 9, 11], max_new_tokens=6)
+                toks = [t async for t in s]
+                assert s.status == "retired"
+                return toks
+
+        toks = asyncio.run(go())
+        assert len(toks) == 6
+
+    def test_backpressure_rejects_with_retry_after(self, engine):
+        im, mid, rm = engine
+        before = _counter("serving_rejected_total")
+
+        async def go():
+            fe = AsyncServeFrontend(
+                im, mid, rm, shed_policy=ShedPolicy(max_pending=1,
+                                                    shed_watermark=5))
+            async with fe:
+                s1 = await fe.submit([4, 5, 6], max_new_tokens=32)
+                # fill the 1-slot pending deque, then overflow it
+                # (submits race admission, so allow a couple of tries)
+                err, extra = None, []
+                for _ in range(6):
+                    try:
+                        extra.append(await fe.submit([7, 8, 9],
+                                                     max_new_tokens=32))
+                    except Overloaded as e:
+                        err = e
+                        break
+                for s in [s1] + extra:
+                    try:
+                        await s.result()
+                    except RequestAborted:
+                        pass
+                return err
+
+        err = asyncio.run(go())
+        assert err is not None and err.retry_after_s > 0
+        assert _counter("serving_rejected_total") > before
+        assert _labels("serving_rejected_total").get(
+            "reason=backpressure")
+
+    def test_deadline_cancels_mid_stream(self, engine):
+        im, mid, rm = engine
+
+        async def go():
+            fe = AsyncServeFrontend(im, mid, rm, reap_interval_s=0.005)
+            async with fe:
+                s = await fe.submit([3, 4, 5], max_new_tokens=200,
+                                    deadline_s=0.01)
+                with pytest.raises(RequestAborted) as ei:
+                    await s.result()
+                return ei.value
+
+        err = asyncio.run(go())
+        assert err.reason == "deadline"
+
+    def test_slo_policy_derives_deadline(self, engine):
+        im, mid, rm = engine
+        get_ledger().set_slo_policy(SLOPolicy(ttft_s=0.002,
+                                              tpot_s=0.0))
+        try:
+            async def go():
+                fe = AsyncServeFrontend(im, mid, rm,
+                                        reap_interval_s=0.005,
+                                        deadline_factor=1.0)
+                async with fe:
+                    s = await fe.submit([6, 7, 8], max_new_tokens=300)
+                    assert s.deadline_mono is not None
+                    try:
+                        await s.result()
+                        return "completed"
+                    except RequestAborted as e:
+                        return e.reason
+
+            assert asyncio.run(go()) == "deadline"
+        finally:
+            get_ledger().set_slo_policy(None)
+
+    def test_slow_client_cancelled_on_queue_overflow(self, engine):
+        im, mid, rm = engine
+
+        async def go():
+            fe = AsyncServeFrontend(im, mid, rm, stream_queue_tokens=2)
+            async with fe:
+                s = await fe.submit([9, 10, 11], max_new_tokens=64)
+                # never consume: the 2-token queue overflows and the
+                # front-end cancels rather than buffering unboundedly
+                for _ in range(2000):
+                    if s.finished:
+                        break
+                    await asyncio.sleep(0.005)
+                with pytest.raises(RequestAborted) as ei:
+                    await s.result()
+                return ei.value.reason
+
+        assert asyncio.run(go()) == "slow_client"
+
+    def test_submit_after_close_raises(self, engine):
+        im, mid, rm = engine
+
+        async def go():
+            fe = AsyncServeFrontend(im, mid, rm)
+            async with fe:
+                pass
+            with pytest.raises(FrontendClosed):
+                await fe.submit([1, 2, 3])
+
+        asyncio.run(go())
+
+
+# ----------------------------------------- watchdog + front-end (stall)
+class TestWatchdogFrontendStall:
+    def test_injected_stall_bundles_inflight_guids_and_fails_streams(
+            self, tmp_path):
+        """Satellite: an injected driver stall while streaming clients
+        are connected must (a) dump a bundle whose ledger names the
+        in-flight GUIDs and (b) terminate every client stream with an
+        error — no hung awaits."""
+        im, mid, rm = build_tiny_engine(max_requests=4, seed=9)
+        # warm the shape buckets FIRST: jit compiles beat no heartbeat,
+        # so an unwarmed engine under a 0.4s watchdog would stall on
+        # the first compile — the injected stall must be the only one
+        warm = [rm.register_new_request([4 + i, 8, 15],
+                                        max_new_tokens=16)
+                for i in range(3)]
+        rm.generate_incr_decoding(im, mid, warm)
+        injector = StallInjector(im, after_calls=2, stall_s=1.6)
+
+        async def go():
+            fe = AsyncServeFrontend(im, mid, rm)
+            wd = fe.watchdog(stall_timeout=0.4,
+                             bundle_dir=str(tmp_path))
+            injector.install()
+            try:
+                async with fe:
+                    wd.start()
+                    streams = [await fe.submit([4 + i, 8, 15],
+                                               max_new_tokens=200)
+                               for i in range(3)]
+                    guids = [s.guid for s in streams]
+                    outcomes = []
+                    for s in streams:
+                        try:
+                            await asyncio.wait_for(s.result(),
+                                                   timeout=30)
+                            outcomes.append("completed")
+                        except RequestAborted as e:
+                            outcomes.append(e.reason)
+                        except FrontendClosed:
+                            outcomes.append("closed")
+                    return guids, outcomes, fe.last_bundle
+            finally:
+                wd.stop()
+                injector.remove()
+
+        guids, outcomes, bundle_path = asyncio.run(go())
+        assert injector.fired
+        # (b) every stream terminated, none completed, none hung
+        assert len(outcomes) == 3
+        assert all(o.startswith("driver-stall") for o in outcomes), \
+            outcomes
+        # (a) the bundle's ledger names the in-flight guids
+        assert bundle_path and os.path.exists(bundle_path)
+        with open(bundle_path) as f:
+            bundle = json.load(f)
+        live = bundle["ledger"]["live"]
+        inflight = {t["guid"] for t in live
+                    if t.get("admit_mono") is not None}
+        assert inflight & set(guids), (inflight, guids)
+        # ffstat's diagnosis names them too
+        from tools.ffstat import diagnosis, flight_events
+
+        text = diagnosis(bundle, flight_events(bundle))
+        assert "in-flight (non-retired) requests" in text
+
+
+# ------------------------------------------------- tier-1 acceptance run
+class TestFrontendAcceptance:
+    def test_ffload_faults_pager_release_and_reconciliation(self,
+                                                            tmp_path):
+        """The acceptance run: front-end under ffload with disconnects
+        + random cancels + a deadline storm, then an injected stall —
+        no hung streams, pager pages back at baseline, goodput/
+        attainment reported, reconciliation with cancellations."""
+        im, mid, _ = build_tiny_engine(max_requests=4, seed=11)
+        pager = KVPager(128, page_len=64,
+                        bytes_per_token=im.kv_cache_stats(
+                            mid).bytes_per_token)
+        rm = RequestManager(max_requests_per_batch=4,
+                            max_tokens_per_batch=64,
+                            max_sequence_length=256, decode_block=4,
+                            kv_pager=pager)
+        get_ledger().clear()
+        get_ledger().set_slo_policy(SLOPolicy(ttft_s=30.0, tpot_s=5.0))
+        baseline_free = pager.free_pages
+        tokens_before = _counter("serving_tokens_generated_total")
+        cancels_before = _counter("serving_cancellations_total")
+
+        traffic = TrafficProfile(
+            n_requests=14, arrival="burst", burst_size=7,
+            burst_gap_s=0.05, prompt_lens=(8, 16, 24),
+            output_lens=(8, 16, 24), tenants=2, seed=4)
+        fault = FaultProfile("mixed-nostall", disconnect_p=0.4,
+                             cancel_p=0.3, storm_fraction=0.3)
+
+        async def phase_faults():
+            fe = AsyncServeFrontend(im, mid, rm, reap_interval_s=0.005)
+            async with fe:
+                return await run_load(fe, traffic, fault)
+
+        try:
+            rep = asyncio.run(phase_faults())
+        finally:
+            get_ledger().set_slo_policy(None)
+
+        # every client finished one way or another (run_load gathering
+        # IS the no-hung-awaits assertion); the fault mix actually hit
+        assert sum(rep["outcomes"].values()) >= traffic.n_requests \
+            - rep["outcomes"].get("rejected", 0)
+        assert _counter("serving_cancellations_total") > cancels_before
+        # goodput/attainment reported from the ledger window
+        assert rep["slo"]["requests"] > 0
+        assert rep["goodput_tokens_per_s"] >= 0
+        assert rep["ttft_attainment"] is not None
+        # drained: cancelled requests' pages FULLY released — free-page
+        # count returns to its pre-load baseline (no prefix pool here,
+        # so nothing may stay leased)
+        assert not rm.pending and not rm.running
+        assert pager.free_pages == baseline_free == pager.total_pages
+        assert not pager.snapshot()["spilled_guids"]
+        # reconciliation with cancellations in the mix
+        delta = _counter("serving_tokens_generated_total") \
+            - tokens_before
+        assert get_ledger().committed_total(retired_only=True) == delta
+
+        # ---- injected-stall phase on the SAME (warmed) engine: the
+        # injector fires on the 2nd dispatch, milliseconds in — well
+        # before any unwarmed tail bucket could compile-stall instead
+        injector = StallInjector(im, after_calls=2, stall_s=1.2)
+
+        async def phase_stall():
+            fe = AsyncServeFrontend(im, mid, rm)
+            wd = fe.watchdog(stall_timeout=0.3,
+                             bundle_dir=str(tmp_path))
+            injector.install()
+            try:
+                async with fe:
+                    wd.start()
+                    return await run_load(
+                        fe, TrafficProfile(n_requests=4,
+                                           arrival="closed",
+                                           prompt_lens=(8, 16, 24),
+                                           output_lens=(8, 16, 24),
+                                           seed=6),
+                        FAULT_PROFILES["none"], injector)
+            finally:
+                wd.stop()
+                injector.remove()
+
+        rep2 = asyncio.run(phase_stall())
+        assert injector.fired
+        aborted = sum(v for k, v in rep2["outcomes"].items()
+                      if k.startswith("aborted"))
+        assert aborted == 4                       # no hung streams
+        assert rep2["stall"]["bundle"]
+        # the stalled engine recovers: boxed cancels drain once the
+        # stall clears, pages return to baseline again
+        deadline = time.monotonic() + 10
+        while (rm.pending or rm.running) and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert pager.free_pages == pager.total_pages
+
+    def test_zero_recompile_pin_with_cancellations(self):
+        """Cancellation lives entirely in host bookkeeping: a warmed
+        decode loop replays the SAME load (with a deterministic
+        mid-stream cancel in the mix) at ZERO compiles."""
+        from flexflow_tpu.utils.debugging import retrace_guard
+
+        im, mid, _ = build_tiny_engine(max_requests=4, seed=13)
+        prompts = _prompts(4, 16, seed=8)
+
+        def serve():
+            rm = RequestManager(max_requests_per_batch=4,
+                                max_tokens_per_batch=64,
+                                max_sequence_length=256,
+                                decode_block=4)
+            reqs = [rm.register_new_request(list(p), max_new_tokens=24)
+                    for p in prompts]
+            victim = reqs[1]
+
+            def on_commit(req, toks):
+                # cancel keyed on COMMITTED TOKEN COUNT — deterministic
+                # across runs, unlike any wall-clock trigger
+                if req.guid == victim.guid \
+                        and len(req.tokens) - req.prompt_len >= 8:
+                    rm.request_cancel(req.guid, "client")
+
+            rm.on_commit = on_commit
+            rm.generate_incr_decoding(im, mid, reqs)
+            assert victim.status == victim.CANCELLED
+            return [r.tokens[r.prompt_len:] for r in reqs]
+
+        with retrace_guard(max_compiles=None) as warm:
+            base = serve()
+        if warm.compiles == 0:
+            pytest.skip("this JAX emits no compile monitoring events")
+        with retrace_guard() as g:
+            again = serve()
+        assert g.compiles == 0, g.events
+        assert again == base
+
+
+# ----------------------------------------------- bench satellite + live
+class TestBenchSectionMarkers:
+    def test_started_marker_lands_before_section_runs(self, tmp_path,
+                                                      monkeypatch):
+        import bench
+
+        monkeypatch.setenv("FF_BENCH_RESULTS", str(tmp_path))
+        monkeypatch.setenv("FF_BENCH_ROUND", "r98")
+        monkeypatch.setitem(bench._PROGRESS, "mode", "probe")
+        monkeypatch.setitem(bench._PROGRESS, "in_flight", None)
+        monkeypatch.setitem(bench._PROGRESS, "done", [])
+        monkeypatch.setitem(bench._PROGRESS, "metrics", [])
+        monkeypatch.setitem(bench._PROGRESS, "sections", {})
+        bench._note_mode_start("probe")
+        # the 0-progress record is ON DISK already (the BENCH_r05 fix)
+        with open(tmp_path / "partial_probe.json") as f:
+            rec = json.load(f)
+        assert rec["sections"]["probe"]["status"] == "started"
+        assert rec["section_in_flight"] == "probe"
+        from tools.ffstat import bench_sections
+
+        text = bench_sections(rec)
+        assert "ZERO recorded progress" in text
+        # aborted stamp carries elapsed + error
+        bench._PROGRESS["sections"]["probe"]["error"] = "boom"
+        bench._note_mode_done("probe", [], status="aborted")
+        with open(tmp_path / "partial_probe.json") as f:
+            rec = json.load(f)
+        sec = rec["sections"]["probe"]
+        assert sec["status"] == "aborted" and "elapsed_s" in sec
+        text = bench_sections(rec)
+        assert "aborted" in text and "ZERO" not in text
+
+    def test_ffstat_accepts_section_only_record(self, tmp_path, capsys):
+        from tools.ffstat import print_doc
+
+        rec = {"round": "r97", "mode": "llama", "incomplete": True,
+               "time_unix": 2000.0, "sections_done": [],
+               "section_in_flight": "llama",
+               "sections": {"llama": {"status": "started",
+                                      "t_start_unix": 1000.0}}}
+        p = tmp_path / "partial_llama.json"
+        p.write_text(json.dumps(rec))
+        assert print_doc(str(p), rec, 8, guid=None, prom=False) == 0
+        out = capsys.readouterr().out
+        assert "ZERO recorded progress" in out
+
+
+class TestBenchLiveSmoke:
+    def test_live_mode_reports_goodput_per_fault_profile(self):
+        import bench
+
+        def tiny():
+            import jax
+
+            from flexflow_tpu import FFConfig, Model
+            from flexflow_tpu.models.llama import (LLAMAConfig,
+                                                   create_llama_model)
+
+            cfg = LLAMAConfig(vocab_size=128, hidden_size=64,
+                              intermediate_size=128,
+                              num_hidden_layers=2,
+                              num_attention_heads=4,
+                              num_key_value_heads=2,
+                              max_position_embeddings=256)
+            model = Model(FFConfig(), name="live_test")
+            create_llama_model(model, cfg, max_requests=4)
+            model.params = model.init_params(jax.random.PRNGKey(1))
+            return model, cfg.vocab_size
+
+        head, *extras = bench.bench_live(
+            model_builder=tiny, max_requests=4, max_seq_length=256,
+            n_requests=8, tenants=2,
+            fault_names=("none", "deadline_storm"))
+        assert head["metric"] == "live_serving_goodput"
+        assert head["value"] > 0
+        assert head["ttft_attainment"] is not None
+        assert head["arrival_rate_rps"] > 0
+        storm = extras[0]
+        assert storm["metric"] == "live_goodput_deadline_storm"
+        assert storm["outcomes"].get("aborted:deadline", 0) \
+            + storm["outcomes"].get("completed", 0) > 0

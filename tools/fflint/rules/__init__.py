@@ -6,6 +6,7 @@ catalog line, and append the class to ``ALL_RULES``.  Document the
 invariant (and the why) in docs/STATIC_ANALYSIS.md.
 """
 
+from .asyncio_blocking import AsyncioBlockingRule
 from .direct_host_sync import DirectHostSyncRule
 from .donation import DonationRule
 from .host_sync import HostSyncRule
@@ -24,4 +25,5 @@ ALL_RULES = [
     DonationRule,
     ShardConsistencyRule,
     LockDisciplineRule,
+    AsyncioBlockingRule,
 ]

@@ -1,0 +1,153 @@
+"""Rule ``asyncio-blocking-call``: the event loop never blocks.
+
+The async serving front-end (flexflow_tpu/serve/frontend.py) splits the
+world in two: the dedicated driver THREAD owns every blocking step —
+device dispatches, host syncs, the generate loops — and the asyncio
+event loop owns intake/streaming/deadlines.  One blocking call inside
+an ``async def`` body stalls EVERY connected client at once (the event
+loop is cooperative), which is strictly worse than the single-request
+latency it would cost on a thread.  This rule pins the boundary
+statically:
+
+- ``time.sleep(...)`` inside an ``async def`` body (use
+  ``asyncio.sleep``);
+- calls to the blocking serving entry points — the device dispatches
+  the host-sync-dataflow rule tracks (``.inference`` /
+  ``.decode_block``), the sync-inside ``.beam_block``, the driver
+  loops (``.generate_incr_decoding`` / ``generate_spec_infer`` /
+  ``.generate``-on-an-engine is not matched: too generic) and
+  ``.block_until_ready()`` — device work belongs on the driver thread;
+- host materialization of a device-dispatch result (``np.asarray`` /
+  ``int()`` / ``.item()`` / … — the shared materializer surface from
+  ``_jax_common``), with the same assignment-based taint the
+  host-sync-dataflow rule uses: a binding from a dispatch call taints,
+  aliases propagate, materializer-rooted assignments untaint.
+
+Nested ``def``/``lambda`` bodies are DEFERRED code (typically shipped
+to an executor or the driver thread) and are skipped; nested ``async
+def`` bodies are visited in their own right.  Suppress a deliberate
+site with ``# fflint: disable=asyncio-blocking-call  <why>``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Set
+
+from ..core import Finding, LintContext, Module, Rule
+from ._jax_common import (assigned_names, dotted_name, header_exprs,
+                          materializer_target, walrus_bindings)
+from .host_sync import DISPATCH_METHODS, _contains_taint
+
+#: attribute calls that block the calling thread on device/driver work
+BLOCKING_METHODS = (set(DISPATCH_METHODS)
+                    | {"beam_block", "generate_incr_decoding",
+                       "block_until_ready"})
+#: plain-name calls that block (resolved by dotted name)
+BLOCKING_FUNCS = {"time.sleep", "generate_spec_infer",
+                  "generate_spec_infer_device"}
+
+
+class AsyncioBlockingRule(Rule):
+    id = "asyncio-blocking-call"
+    short = ("time.sleep / device dispatch / host materialization "
+             "inside an async def body — the event loop must never "
+             "block on device work")
+
+    def check(self, module: Module,
+              ctx: LintContext) -> Iterable[Finding]:
+        findings: List[Finding] = []
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.AsyncFunctionDef):
+                self._check_async_body(node, module, findings)
+        return findings
+
+    # ---------------------------------------------------------- walker
+    def _check_async_body(self, fn: ast.AsyncFunctionDef,
+                          module: Module,
+                          findings: List[Finding]) -> None:
+        tainted: Set[str] = set()
+        self._walk_block(fn.body, tainted, module, findings)
+
+    def _walk_block(self, stmts, tainted: Set[str], module: Module,
+                    findings: List[Finding]) -> None:
+        for st in stmts:
+            if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.ClassDef)):
+                continue            # deferred / separately-visited code
+            for expr in header_exprs(st):
+                self._check_expr(expr, tainted, module, findings)
+            self._update_taint(st, tainted)
+            for wname, wval in walrus_bindings(st):
+                if _contains_taint(wval, tainted):
+                    tainted.add(wname)
+            for attr in ("body", "orelse", "finalbody"):
+                block = getattr(st, attr, None)
+                if block and not isinstance(block, ast.AST):
+                    self._walk_block(block, tainted, module, findings)
+            for h in getattr(st, "handlers", []) or []:
+                self._walk_block(h.body, tainted, module, findings)
+
+    # ----------------------------------------------------------- checks
+    def _check_expr(self, root: ast.AST, tainted: Set[str],
+                    module: Module, findings: List[Finding]) -> None:
+        stack = [root]
+        while stack:
+            node = stack.pop()
+            if isinstance(node, (ast.Lambda, ast.FunctionDef,
+                                 ast.AsyncFunctionDef)):
+                continue            # deferred code
+            stack.extend(ast.iter_child_nodes(node))
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            dn = dotted_name(f)
+            if dn in BLOCKING_FUNCS:
+                what = ("time.sleep blocks the event loop — use "
+                        "asyncio.sleep" if dn == "time.sleep" else
+                        f"'{dn}()' is a blocking driver loop")
+                findings.append(self.finding(
+                    module, node,
+                    f"{what}; inside an async def this stalls every "
+                    f"connected client (run it on the driver thread)"))
+                continue
+            if (isinstance(f, ast.Attribute)
+                    and f.attr in BLOCKING_METHODS):
+                findings.append(self.finding(
+                    module, node,
+                    f"'.{f.attr}()' blocks on device/driver work "
+                    f"inside an async def — the event loop owns "
+                    f"intake/streaming only; dispatch belongs on the "
+                    f"dedicated driver thread"))
+                continue
+            fetched = materializer_target(node)
+            if fetched is not None and _contains_taint(fetched, tainted):
+                what = (fetched.id if isinstance(fetched, ast.Name)
+                        else ast.unparse(fetched)[:40])
+                findings.append(self.finding(
+                    module, node,
+                    f"host materialization of device-dispatch result "
+                    f"'{what}' inside an async def — the fetch blocks "
+                    f"the event loop for a full host<->device round "
+                    f"trip"))
+
+    # ------------------------------------------------------------ taint
+    def _update_taint(self, st: ast.stmt, tainted: Set[str]) -> None:
+        targets = assigned_names(st)
+        if not targets:
+            return
+        value = getattr(st, "value", None)
+        if isinstance(st, (ast.For, ast.AsyncFor)):
+            if _contains_taint(st.iter, tainted):
+                tainted |= targets
+            return
+        if value is None:
+            return
+        if (isinstance(value, ast.Call)
+                and materializer_target(value) is not None):
+            tainted -= targets      # host value
+            return
+        if _contains_taint(value, tainted):
+            tainted |= targets
+        else:
+            tainted -= targets

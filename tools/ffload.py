@@ -1,0 +1,541 @@
+"""ffload: fault-injecting live-traffic load harness for the front-end.
+
+Drives an :class:`~flexflow_tpu.serve.AsyncServeFrontend` with
+synthetic client traffic and reports SLO goodput + TTFT/TPOT attainment
+per fault profile — every number a BENCH round claims for serving is
+therefore an under-load, under-fault number, not an offline batch one.
+
+Usage::
+
+  python tools/ffload.py [--requests N] [--arrival poisson|burst|closed]
+                         [--rate RPS] [--fault none|disconnects|cancels|
+                          deadline_storm|stall|mixed]
+                         [--slo-ttft S] [--slo-tpot S] [--seed K]
+                         [--json] [--selftest]
+
+Traffic (``TrafficProfile``):
+
+- **poisson** arrivals at ``--rate`` requests/s (exponential gaps),
+  **burst** arrivals (groups of ``burst_size`` back-to-back separated
+  by ``burst_gap_s`` — the worst case for admission), or **closed**
+  (everything submitted up front — the offline-bench shape, kept for
+  A/B continuity);
+- mixed prompt/output-length distributions (sampled per request);
+- optional **shared-prefix tenant traffic**: ``tenants`` groups whose
+  prompts share a ``tenant_prefix_len`` system prefix, exercising the
+  radix prefix pool under live arrivals.
+
+Fault profiles (``FaultProfile``; the catalog docs/SERVING.md ships):
+
+- ``disconnects``  — clients vanish mid-stream with probability
+  ``disconnect_p`` after a random number of streamed tokens;
+- ``cancels``      — clients issue explicit cancels at random times;
+- ``deadline_storm`` — a fraction of requests carries near-zero
+  deadlines, forcing mid-stream deadline cancellation bursts;
+- ``stall``        — a :class:`StallInjector` wraps the
+  InferenceManager's dispatch entry points and blocks one step for
+  ``stall_s`` seconds, exercising the PR-5 watchdog end-to-end (bundle
+  dumped, client streams failed — never hung);
+- ``mixed``        — all of the above at once.
+
+The report's headline is the ledger's ``goodput_tokens_per_s`` plus
+TTFT/TPOT attainment under the installed SLO policy, alongside client
+outcome counts (completed / rejected / aborted-by-reason) and the
+shed/cancel/reject counter deltas.
+
+``--selftest`` runs a tiny in-process load (CPU llama, one forced
+disconnect, one forced deadline miss, an overload burst that sheds)
+and asserts the shed and cancel counters tick — the run_tier1.sh CI
+smoke beside ffstat/ffreq.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import dataclasses
+import json
+import os
+import sys
+import time
+from typing import Any, Dict, List, Optional
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+# ------------------------------------------------------------- profiles
+@dataclasses.dataclass
+class TrafficProfile:
+    """Arrival process + request-shape distributions."""
+
+    n_requests: int = 32
+    arrival: str = "poisson"            # poisson | burst | closed
+    rate_rps: float = 50.0              # poisson mean arrival rate
+    burst_size: int = 8
+    burst_gap_s: float = 0.25
+    prompt_lens: tuple = (8, 16, 32)    # sampled uniformly per request
+    output_lens: tuple = (8, 16, 32)
+    vocab: int = 100
+    tenants: int = 0                    # >0: shared-prefix groups
+    tenant_prefix_len: int = 16
+    seed: int = 0
+
+
+@dataclasses.dataclass
+class FaultProfile:
+    """What goes wrong, and how often."""
+
+    name: str = "none"
+    disconnect_p: float = 0.0           # P(client vanishes mid-stream)
+    cancel_p: float = 0.0               # P(random explicit cancel)
+    storm_fraction: float = 0.0         # requests with ~zero deadlines
+    storm_deadline_s: float = 0.001
+    stall_after_steps: int = 0          # 0 = no injected stall
+    stall_s: float = 0.0
+
+
+FAULT_PROFILES: Dict[str, FaultProfile] = {
+    "none": FaultProfile("none"),
+    "disconnects": FaultProfile("disconnects", disconnect_p=0.3),
+    "cancels": FaultProfile("cancels", cancel_p=0.3),
+    "deadline_storm": FaultProfile("deadline_storm", storm_fraction=0.4),
+    "stall": FaultProfile("stall", stall_after_steps=4, stall_s=2.0),
+    "mixed": FaultProfile("mixed", disconnect_p=0.15, cancel_p=0.15,
+                          storm_fraction=0.2, stall_after_steps=8,
+                          stall_s=1.0),
+}
+
+
+class StallInjector:
+    """Injected driver stall: wraps an InferenceManager's dispatch
+    entry points (``inference`` / ``decode_block``) so the Nth call
+    blocks for ``stall_s`` seconds before proceeding — from the
+    watchdog's point of view, indistinguishable from a wedged device.
+    One stall per install; ``remove()`` restores the originals."""
+
+    def __init__(self, im, after_calls: int, stall_s: float):
+        self.im = im
+        self.after_calls = int(after_calls)
+        self.stall_s = float(stall_s)
+        self.calls = 0
+        self.fired = False
+        self._orig: Dict[str, Any] = {}
+
+    def _wrap(self, fn):
+        def wrapped(*args, **kwargs):
+            self.calls += 1
+            if not self.fired and self.calls >= self.after_calls:
+                self.fired = True
+                time.sleep(self.stall_s)    # the injected stall
+            return fn(*args, **kwargs)
+
+        return wrapped
+
+    def install(self) -> "StallInjector":
+        for name in ("inference", "decode_block"):
+            self._orig[name] = getattr(self.im, name)
+            setattr(self.im, name, self._wrap(self._orig[name]))
+        return self
+
+    def remove(self) -> None:
+        for name, fn in self._orig.items():
+            setattr(self.im, name, fn)
+        self._orig.clear()
+
+
+# ------------------------------------------------------------- clients
+def make_prompts(traffic: TrafficProfile, rng) -> List[List[int]]:
+    """Token-id prompts per the traffic profile: mixed lengths, and
+    shared tenant prefixes when ``tenants`` > 0 (tenant k's requests
+    open with the same system prefix, so retired rows seed the radix
+    pool and later same-tenant admissions hit it)."""
+    tenant_prefix = {
+        k: rng.integers(4, traffic.vocab,
+                        traffic.tenant_prefix_len).tolist()
+        for k in range(traffic.tenants)}
+    prompts = []
+    for i in range(traffic.n_requests):
+        plen = int(rng.choice(traffic.prompt_lens))
+        body = rng.integers(4, traffic.vocab, plen).tolist()
+        if traffic.tenants:
+            body = tenant_prefix[i % traffic.tenants] + body
+        prompts.append(body)
+    return prompts
+
+
+async def _arrival_gaps(traffic: TrafficProfile, rng):
+    """Yields (index, pre-submit sleep) per request."""
+    for i in range(traffic.n_requests):
+        if traffic.arrival == "poisson":
+            gap = float(rng.exponential(1.0 / max(1e-6,
+                                                  traffic.rate_rps)))
+        elif traffic.arrival == "burst":
+            gap = (traffic.burst_gap_s
+                   if i and i % traffic.burst_size == 0 else 0.0)
+        else:                           # closed: all up front
+            gap = 0.0
+        yield i, gap
+
+
+async def _client(frontend, i: int, prompt: List[int], out_len: int,
+                  fault: FaultProfile, rng, outcomes: Dict[str, int],
+                  retry_once: bool = True) -> None:
+    """One synthetic client: submit, stream, maybe misbehave."""
+    from flexflow_tpu.serve.frontend import (FrontendClosed, Overloaded,
+                                             RequestAborted)
+
+    deadline_s = None
+    if fault.storm_fraction and rng.random() < fault.storm_fraction:
+        deadline_s = fault.storm_deadline_s
+    try:
+        stream = await frontend.submit(prompt, max_new_tokens=out_len,
+                                       deadline_s=deadline_s)
+    except Overloaded as e:
+        if retry_once:
+            # honor the server's hint exactly once — the well-behaved
+            # client protocol the backpressure design assumes
+            await asyncio.sleep(e.retry_after_s)
+            return await _client(frontend, i, prompt, out_len, fault,
+                                 rng, outcomes, retry_once=False)
+        outcomes["rejected"] = outcomes.get("rejected", 0) + 1
+        return
+    except FrontendClosed:
+        outcomes["rejected_closed"] = outcomes.get("rejected_closed",
+                                                   0) + 1
+        return
+    disconnect_after = (1 + int(rng.integers(0, max(1, out_len // 2)))
+                        if rng.random() < fault.disconnect_p else None)
+    cancel_after_s = (float(rng.uniform(0.0, 0.05))
+                      if rng.random() < fault.cancel_p else None)
+    if cancel_after_s is not None:
+        asyncio.get_running_loop().call_later(
+            cancel_after_s, frontend.cancel, stream.guid, "client")
+    try:
+        async for _tok in stream:
+            if (disconnect_after is not None
+                    and len(stream.tokens) >= disconnect_after):
+                stream.disconnect()
+                outcomes["disconnected"] = outcomes.get(
+                    "disconnected", 0) + 1
+                return
+        outcomes["completed"] = outcomes.get("completed", 0) + 1
+    except RequestAborted as e:
+        key = f"aborted:{e.reason.split(':')[0]}"
+        outcomes[key] = outcomes.get(key, 0) + 1
+
+
+# --------------------------------------------------------------- runner
+def _counter_total(snap: Dict[str, Any], name: str) -> float:
+    v = (snap.get("counters") or {}).get(name, 0)
+    return float(v.get("total", 0) if isinstance(v, dict) else v)
+
+
+async def run_load(frontend, traffic: TrafficProfile,
+                   fault: FaultProfile,
+                   stall_injector: Optional[StallInjector] = None
+                   ) -> Dict[str, Any]:
+    """Run one load+fault profile against a started front-end and
+    return its report (headline: goodput + attainment from the ledger
+    window; plus client outcomes and counter deltas)."""
+    import numpy as np
+
+    from flexflow_tpu.observability import get_ledger, get_registry
+
+    rng = np.random.default_rng(traffic.seed)
+    prompts = make_prompts(traffic, rng)
+    before = get_registry().snapshot()
+    outcomes: Dict[str, int] = {}
+    t0 = time.monotonic()
+    tasks = []
+    async for i, gap in _arrival_gaps(traffic, rng):
+        if gap:
+            await asyncio.sleep(gap)
+        out_len = int(rng.choice(traffic.output_lens))
+        tasks.append(asyncio.ensure_future(
+            _client(frontend, i, prompts[i], out_len, fault, rng,
+                    outcomes)))
+    await asyncio.gather(*tasks)
+    wall = time.monotonic() - t0
+    after = get_registry().snapshot()
+    rep: Dict[str, Any] = {
+        "fault_profile": fault.name,
+        "traffic": dataclasses.asdict(traffic),
+        "wall_s": round(wall, 3),
+        "outcomes": dict(sorted(outcomes.items())),
+        "counters": {
+            name: _counter_total(after, name) - _counter_total(before,
+                                                               name)
+            for name in ("serving_cancellations_total",
+                         "serving_shed_total",
+                         "serving_rejected_total",
+                         "serving_tokens_generated_total",
+                         "serving_preemptions_total")},
+        "stall": {
+            "injected": bool(stall_injector and stall_injector.fired),
+            "bundle": frontend.last_bundle,
+        },
+    }
+    slo = get_ledger().slo_report()
+    if slo is not None:
+        rep["slo"] = slo
+        rep["goodput_tokens_per_s"] = slo["goodput_tokens_per_s"]
+        rep["ttft_attainment"] = slo["ttft_attainment"]
+        rep["tpot_attainment"] = slo["tpot_attainment"]
+    return rep
+
+
+def format_report(rep: Dict[str, Any]) -> str:
+    lines = [f"== ffload [{rep['fault_profile']}] "
+             f"{rep['traffic']['n_requests']} requests "
+             f"({rep['traffic']['arrival']}) in {rep['wall_s']}s"]
+    if "goodput_tokens_per_s" in rep:
+        lines.append(
+            f"goodput {rep['goodput_tokens_per_s']} tok/s | "
+            f"attainment ttft {rep['ttft_attainment']} "
+            f"tpot {rep['tpot_attainment']} "
+            f"(cancelled {rep['slo'].get('cancelled', 0)}"
+            f"/{rep['slo'].get('requests', 0)} in window)")
+    lines.append("outcomes: " + ", ".join(
+        f"{k}={v}" for k, v in rep["outcomes"].items()))
+    lines.append("counters: " + ", ".join(
+        f"{k.replace('serving_', '')}={v:g}"
+        for k, v in rep["counters"].items() if v))
+    if rep["stall"]["injected"]:
+        lines.append(f"injected stall fired; bundle: "
+                     f"{rep['stall']['bundle']}")
+    return "\n".join(lines)
+
+
+# ---------------------------------------------------- in-process engine
+def build_tiny_engine(max_requests: int = 4, max_seq_length: int = 256,
+                      decode_block: int = 4, seed: int = 0,
+                      prefix_cache: bool = False, kv_pager=None):
+    """A CPU-sized llama + RequestManager for in-process load runs
+    (the selftest / CI path; bench.py's ``live`` mode builds the real
+    model the same way).  Returns (im, model_id, rm)."""
+    import jax
+    import numpy as np
+
+    from flexflow_tpu import FFConfig, Model
+    from flexflow_tpu.models.llama import LLAMAConfig, create_llama_model
+    from flexflow_tpu.serving import InferenceManager, RequestManager
+
+    cfg = LLAMAConfig(vocab_size=128, hidden_size=64,
+                      intermediate_size=128, num_hidden_layers=2,
+                      num_attention_heads=4, num_key_value_heads=2,
+                      max_position_embeddings=max_seq_length)
+    model = Model(FFConfig(), name=f"ffload_tiny_{seed}")
+    create_llama_model(model, cfg, max_requests=max_requests)
+    model.params = model.init_params(jax.random.PRNGKey(seed))
+    im = InferenceManager(model.config)
+    mid = im.compile_model_and_allocate_buffer(
+        model, max_requests=max_requests, max_seq_length=max_seq_length,
+        cache_dtype=np.float32)
+    rm = RequestManager(max_requests_per_batch=max_requests,
+                        max_tokens_per_batch=64,
+                        max_sequence_length=max_seq_length,
+                        decode_block=decode_block,
+                        prefix_cache=prefix_cache, kv_pager=kv_pager)
+    return im, mid, rm
+
+
+async def _run_profiles(im, mid, rm, traffic: TrafficProfile,
+                        faults: List[FaultProfile],
+                        shed_policy=None,
+                        stall_timeout: float = 0.0,
+                        bundle_dir: Optional[str] = None
+                        ) -> List[Dict[str, Any]]:
+    """Drive one engine through a sequence of fault profiles (one
+    front-end per profile — streams and counters stay attributable;
+    the ledger window is cleared between profiles)."""
+    from flexflow_tpu.observability import get_ledger
+    from flexflow_tpu.serve.frontend import AsyncServeFrontend
+
+    reports = []
+    for fault in faults:
+        get_ledger().clear()
+        fe = AsyncServeFrontend(im, mid, rm, shed_policy=shed_policy,
+                                reap_interval_s=0.005)
+        injector = None
+        if fault.stall_after_steps:
+            injector = StallInjector(im, fault.stall_after_steps,
+                                     fault.stall_s).install()
+        wd = (fe.watchdog(stall_timeout=stall_timeout,
+                          bundle_dir=bundle_dir)
+              if stall_timeout else None)
+        try:
+            async with fe:
+                if wd is not None:
+                    wd.start()
+                reports.append(await run_load(fe, traffic, fault,
+                                              injector))
+        finally:
+            if wd is not None:
+                wd.stop()
+            if injector is not None:
+                injector.remove()
+    return reports
+
+
+# -------------------------------------------------------------- selftest
+def selftest() -> int:
+    """Tiny in-process load with one forced disconnect, one forced
+    deadline miss and an overload burst that sheds — asserts the
+    shed/cancel counters tick and no client await hangs.  The
+    run_tier1.sh CI smoke beside the ffstat/ffreq ones.  Every fault
+    is INJECTED deterministically (no probability sampling) so the CI
+    gate never flakes."""
+    import numpy as np
+
+    from flexflow_tpu.observability import (SLOPolicy, get_ledger,
+                                            get_registry)
+    from flexflow_tpu.serve.frontend import (AsyncServeFrontend,
+                                             RequestAborted, ShedPolicy)
+
+    # one-at-a-time serving makes the overload deterministic: a burst
+    # leaves everything else pending (> watermark 1) while one runs
+    im, mid, rm = build_tiny_engine(max_requests=1, decode_block=4)
+    get_ledger().clear()
+    get_ledger().set_slo_policy(SLOPolicy(ttft_s=30.0, tpot_s=5.0))
+    rng = np.random.default_rng(7)
+
+    def prompt(n):
+        return rng.integers(4, 120, n).tolist()
+
+    before = get_registry().snapshot()
+    results: Dict[str, Any] = {}
+
+    async def collect(stream):
+        try:
+            await stream.result()
+            return "completed"
+        except RequestAborted as e:
+            return f"aborted:{e.reason.split(':')[0]}"
+
+    async def scenario():
+        fe = AsyncServeFrontend(
+            im, mid, rm, reap_interval_s=0.005,
+            shed_policy=ShedPolicy(max_pending=16, shed_watermark=1))
+        async with fe:
+            # 1) forced disconnect after the first streamed token
+            s1 = await fe.submit(prompt(12), max_new_tokens=16)
+            async for _tok in s1:
+                s1.disconnect()
+                break
+            # 2) forced deadline miss: a budget no 200-token request
+            #    can meet (the reaper cancels it mid-stream)
+            s2 = await fe.submit(prompt(12), max_new_tokens=200,
+                                 deadline_s=0.002)
+            results["deadline"] = await collect(s2)
+            # 3) overload burst: 5 at once through a 1-row engine with
+            #    shed watermark 1 — the newest arrivals are shed
+            burst = [await fe.submit(prompt(8), max_new_tokens=8)
+                     for _ in range(5)]
+            results["burst"] = await asyncio.gather(
+                *(collect(s) for s in burst))
+        results["stats"] = fe.stats()
+
+    asyncio.run(scenario())
+    after = get_registry().snapshot()
+
+    def delta(name):
+        return _counter_total(after, name) - _counter_total(before, name)
+
+    ok = True
+
+    def check(cond, msg):
+        nonlocal ok
+        if not cond:
+            ok = False
+            print(f"ffload selftest FAILED: {msg}")
+
+    check(results.get("deadline") == "aborted:deadline",
+          f"deadline miss not enforced: {results.get('deadline')}")
+    check(delta("serving_cancellations_total") >= 2,
+          f"expected >=2 cancellations (deadline miss + disconnect), "
+          f"got {delta('serving_cancellations_total')}")
+    check(delta("serving_shed_total") >= 1,
+          f"expected >=1 shed under the overload burst, got "
+          f"{delta('serving_shed_total')}")
+    reasons = (after.get("counters", {})
+               .get("serving_cancellations_total", {}))
+    labels = (reasons.get("labels", {})
+              if isinstance(reasons, dict) else {})
+    check(any("deadline" in k for k in labels),
+          f"no deadline cancellation in {sorted(labels)}")
+    check(any("disconnect" in k for k in labels),
+          f"no disconnect cancellation in {sorted(labels)}")
+    check(any(o == "aborted:shed" for o in results.get("burst", ())),
+          f"no shed abort surfaced to a client: {results.get('burst')}")
+    check(not rm.pending and not rm.running, "engine did not drain")
+    rep = get_ledger().slo_report()
+    check(rep is not None and rep["requests"] > 0
+          and rep["cancelled"] > 0,
+          "no SLO window with cancellations reported")
+    # reconciliation with cancellations in the mix: every finalized
+    # timeline's committed tokens are in the aggregate counter
+    led_committed = get_ledger().committed_total(retired_only=True)
+    tg = delta("serving_tokens_generated_total")
+    check(led_committed == tg,
+          f"ledger committed {led_committed} != tokens counter {tg}")
+    if ok:
+        print(f"ffload selftest OK "
+              f"(cancels {delta('serving_cancellations_total'):g}, "
+              f"sheds {delta('serving_shed_total'):g}, "
+              f"goodput {rep['goodput_tokens_per_s'] if rep else 0} "
+              f"tok/s)")
+    return 0 if ok else 1
+
+
+# ------------------------------------------------------------------ CLI
+def main(argv) -> int:
+    ap = argparse.ArgumentParser(
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("--requests", type=int, default=32)
+    ap.add_argument("--arrival", choices=("poisson", "burst", "closed"),
+                    default="poisson")
+    ap.add_argument("--rate", type=float, default=50.0,
+                    help="poisson arrival rate (requests/s)")
+    ap.add_argument("--fault", choices=sorted(FAULT_PROFILES),
+                    default="none")
+    ap.add_argument("--tenants", type=int, default=0,
+                    help="shared-prefix tenant groups (exercises the "
+                         "radix prefix pool; 0 = independent prompts)")
+    ap.add_argument("--slo-ttft", type=float, default=1.0)
+    ap.add_argument("--slo-tpot", type=float, default=0.5)
+    ap.add_argument("--stall-timeout", type=float, default=1.0,
+                    help="watchdog threshold for the stall profiles")
+    ap.add_argument("--bundle-dir", default=None)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--json", action="store_true")
+    ap.add_argument("--selftest", action="store_true")
+    args = ap.parse_args(argv)
+    if args.selftest:
+        return selftest()
+
+    from flexflow_tpu.observability import SLOPolicy, get_ledger
+
+    im, mid, rm = build_tiny_engine(
+        max_requests=4, prefix_cache=bool(args.tenants))
+    get_ledger().set_slo_policy(SLOPolicy(ttft_s=args.slo_ttft,
+                                          tpot_s=args.slo_tpot))
+    traffic = TrafficProfile(n_requests=args.requests,
+                             arrival=args.arrival, rate_rps=args.rate,
+                             tenants=args.tenants, seed=args.seed)
+    fault = FAULT_PROFILES[args.fault]
+    reports = asyncio.run(_run_profiles(
+        im, mid, rm, traffic, [fault],
+        stall_timeout=(args.stall_timeout
+                       if fault.stall_after_steps else 0.0),
+        bundle_dir=args.bundle_dir))
+    if args.json:
+        print(json.dumps(reports[0], indent=1, default=str))
+    else:
+        print(format_report(reports[0]))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

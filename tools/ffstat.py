@@ -13,8 +13,12 @@ Reads any of:
   metrics;
 - a **raw flight-record dump** (``FlightRecorder.snapshot()`` JSON:
   a dict with an ``events`` list);
-- a **bench round record** (``bench_results/<round>.json`` with a
-  ``telemetry`` snapshot): prints the metrics summary half only.
+- a **bench round record** (``bench_results/<round>.json``, complete
+  or the incrementally-written partial): prints the per-section
+  started/done/aborted status table — a section stamped ``started``
+  with nothing completed is called out explicitly as a ZERO-progress
+  mode (the BENCH_r05 diagnosis class) — plus the metrics summary
+  when a ``telemetry`` snapshot is present.
 
 Usage:
     python tools/ffstat.py BUNDLE.json [BUNDLE2.json ...]
@@ -119,6 +123,49 @@ def event_tail(events: List[Dict[str, Any]], n: int,
         lines.append(f"  #{ev.get('seq', '?'):>7} {dt:>+9.3f}s "
                      f"{ev.get('name', '?'):<14} {_fmt_payload(ev)}")
     return "\n".join(lines)
+
+
+def bench_sections(doc: Dict[str, Any]) -> Optional[str]:
+    """Per-section status table from a bench round record (complete or
+    incremental).  The load-bearing case is a 0-PROGRESS mode: a
+    section stamped ``started`` at mode entry with nothing completed
+    (the BENCH_r05 class — killed with no evidence) now reads as an
+    explicit diagnosis line instead of an absent record."""
+    secs = doc.get("sections")
+    if not isinstance(secs, dict) or not secs:
+        if "sections_done" not in doc and "section_in_flight" not in doc:
+            return None
+        secs = {}
+    lines = []
+    done = doc.get("sections_done") or []
+    in_flight = doc.get("section_in_flight")
+    order = list(secs) + [s for s in done if s not in secs]
+    if in_flight and in_flight not in order:
+        order.append(in_flight)
+    for label in order:
+        s = secs.get(label, {})
+        status = s.get("status") or ("done" if label in done else
+                                     "in-flight" if label == in_flight
+                                     else "?")
+        extra = ""
+        if s.get("elapsed_s") is not None:
+            extra += f" {s['elapsed_s']}s"
+        if s.get("error"):
+            extra += f"  [{str(s['error'])[:60]}]"
+        lines.append(f"  {label:<12} {status:<10}{extra}")
+    zero = [label for label in order
+            if (secs.get(label, {}).get("status") == "started"
+                or label == in_flight) and label not in done]
+    for label in zero:
+        t0 = secs.get(label, {}).get("t_start_unix")
+        ago = (f" (started at unix {t0}"
+               + (f", record written {round(doc['time_unix'] - t0, 1)}s"
+                  f" later" if doc.get("time_unix") and t0 else "")
+               + ")") if t0 else ""
+        lines.append(f"=> section {label!r} made ZERO recorded progress"
+                     f"{ago} — the process died or was killed inside "
+                     f"it; check stderr_tail/stall_bundle above")
+    return "\n".join(lines) if lines else None
 
 
 def diagnosis(doc: Dict[str, Any],
@@ -233,9 +280,10 @@ def print_doc(path: str, doc: Dict[str, Any], n_events: int,
               guid: Optional[int], prom: bool) -> int:
     events = flight_events(doc)
     snap = metrics_snapshot(doc)
-    if events is None and snap is None:
-        print(f"{path}: neither a flight record nor a telemetry "
-              f"snapshot", file=sys.stderr)
+    secs = bench_sections(doc)
+    if events is None and snap is None and secs is None:
+        print(f"{path}: neither a flight record, a telemetry snapshot "
+              f"nor a bench round record", file=sys.stderr)
         return 1
     if prom:
         if snap is None:
@@ -250,6 +298,9 @@ def print_doc(path: str, doc: Dict[str, Any], n_events: int,
     diag = diagnosis(doc, events)
     if diag:
         print(diag)
+    if secs:
+        print("\n-- bench sections")
+        print(secs)
     if events:
         print("\n-- per-phase timing (ring window)")
         print(phase_table(events))

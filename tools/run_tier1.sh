@@ -35,6 +35,15 @@
 (cd "$(dirname "$0")/.." \
  && env JAX_PLATFORMS=cpu python tools/ffreq.py --selftest >/dev/null) \
  || { echo "ffreq/request-ledger selftest FAILED" >&2; exit 1; }
+# ffload/front-end smoke: a tiny in-process live-traffic run through
+# the async front-end with one forced disconnect, one forced deadline
+# miss and an overload burst — asserts the shed/cancel counters tick,
+# streams never hang, and the committed-token reconciliation holds
+# with cancellations in the mix, so a broken serving front-end fails
+# CI before a BENCH `live` round depends on it.
+(cd "$(dirname "$0")/.." \
+ && env JAX_PLATFORMS=cpu python tools/ffload.py --selftest >/dev/null) \
+ || { echo "ffload/front-end selftest FAILED" >&2; exit 1; }
 # KV-pager smoke: pure-host allocator accounting (lease/release/refs,
 # page-alignment validation, spill-store budgeting, restore-vs-
 # recompute pricing) so a broken pager fails CI in milliseconds before
