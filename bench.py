@@ -1906,13 +1906,21 @@ def bench_paged(model_builder=None, max_requests=8, prompt_len=48,
       compile_model_and_allocate_buffer's static allocation admits;
     - **paged** arm: ``max_requests`` rows leasing ``page_len``-token
       pages against the same byte budget, with host-RAM spill and
-      preemptive scheduling reclaiming pages under pressure.
+      preemptive scheduling reclaiming pages under pressure (dense
+      slabs — the lease is ACCOUNTING);
+    - **physical** arm (PR 10): the same budget buys an actual
+      ``[num_frames, KV, page_len, D]`` frame pool read through page
+      tables — ``cache_hbm_bytes`` is the POOL allocation (measured,
+      not the dense-slab formula), and the
+      ``serving_kv_frames_{total,free}`` gauges prove residency
+      tracks leased frames.
 
     Headline = mean resident batch (admitted rows integrated over the
-    serving window) paged / row-capped; extras carry decode tokens/s,
-    SLO goodput per arm, the spill/restore/preemption counters (the
-    proof pressure actually fired), and bit-exact greedy parity across
-    arms (scheduling must never change tokens).
+    serving window) paged / row-capped, with the physical arm's gain
+    and HBM beside it; extras carry decode tokens/s, SLO goodput per
+    arm, the spill/restore/preemption counters (the proof pressure
+    actually fired), frame-pool gauges, and bit-exact greedy parity
+    across all arms (scheduling must never change tokens).
 
     ``model_builder``: optional ``() -> (model, vocab_size)`` override
     so the CPU test suite runs the same A/B on a tiny model (default:
@@ -1941,6 +1949,9 @@ def bench_paged(model_builder=None, max_requests=8, prompt_len=48,
                                dtype=DataType.HALF)
             return model, cfg.vocab_size
 
+    from flexflow_tpu.observability import get_registry
+    from flexflow_tpu.serving.kv_pager import pager_for_record
+
     model, vocab = model_builder()
     im = InferenceManager(model.config)
     mid_paged = im.compile_model_and_allocate_buffer(
@@ -1953,6 +1964,14 @@ def bench_paged(model_builder=None, max_requests=8, prompt_len=48,
     # the FIXED budget: exactly what the row-capped arm's static
     # allocation pins (rows * padded length * per-token bytes)
     budget_bytes = budget_rows * stats.alloc_len * stats.bytes_per_token
+    # the PHYSICAL arm: the same byte budget buys a frame pool (the
+    # whole point of PR 10 — the budget is allocated HBM, not lease
+    # accounting over dense slabs)
+    mid_phys = im.compile_model_and_allocate_buffer(
+        model, max_requests=max_requests, max_seq_length=max_seq_length,
+        prefill_chunk=max_tokens_per_batch, kv_cache_dtype=_KV_DTYPE,
+        kv_layout="paged", kv_page_len=page_len,
+        kv_frame_budget_bytes=budget_bytes)
 
     rng = np.random.default_rng(0)
     prompts = [rng.integers(4, vocab - 1, prompt_len).tolist()
@@ -2009,10 +2028,18 @@ def bench_paged(model_builder=None, max_requests=8, prompt_len=48,
                                              mode="restore"),
             scheduler=PressureScheduler(queue_pressure_s=1.0))
 
-    # warmup: compile both arms' shape buckets (incl. the paged arm's
-    # fetch/restore buckets via a throwaway pager) before measuring
+    def make_phys_pager():
+        # the physical twin: same byte budget, but the pager owns the
+        # frame pool's concrete ids — leases ARE resident HBM
+        return pager_for_record(
+            im, mid_phys, mode="restore",
+            scheduler=PressureScheduler(queue_pressure_s=1.0))
+
+    # warmup: compile the arms' shape buckets (incl. the paged arms'
+    # fetch/restore buckets via throwaway pagers) before measuring
     serve(mid_paged, max_requests, make_pager())
     serve(mid_capped, budget_rows, None)
+    serve(mid_phys, max_requests, make_phys_pager())
     _clear_ledger_window()
 
     reqs_c, wall_c, _ = serve(mid_capped, budget_rows, None)
@@ -2022,15 +2049,26 @@ def bench_paged(model_builder=None, max_requests=8, prompt_len=48,
     reqs_p, wall_p, _ = serve(mid_paged, max_requests, pager)
     res_p, tps_p, rep_p = arm_report(reqs_p, wall_p)
     _note_kv(im, mid_paged, "paged")
+    _clear_ledger_window()
+    phys_pager = make_phys_pager()
+    reqs_f, wall_f, _ = serve(mid_phys, max_requests, phys_pager)
+    res_f, tps_f, rep_f = arm_report(reqs_f, wall_f)
+    _note_kv(im, mid_phys, "paged_physical")
     _PAGER_CONF.clear()
-    _PAGER_CONF.update(pager.config())
+    _PAGER_CONF.update(phys_pager.config())
+    _PAGER_CONF["physical"] = True
 
     # greedy parity across arms: scheduling (preemption, spill,
-    # restore, recompute) must never change a request's tokens
+    # restore, recompute — and the frame-pool layout itself) must
+    # never change a request's tokens
     gen_c = [r.tokens[r.prompt_len:] for r in reqs_c]
     gen_p = [r.tokens[r.prompt_len:] for r in reqs_p]
-    parity = gen_c == gen_p
+    gen_f = [r.tokens[r.prompt_len:] for r in reqs_f]
+    parity = gen_c == gen_p == gen_f
     psnap = pager.snapshot()
+    fsnap = phys_pager.snapshot()
+    m = get_registry()
+    phys_stats = im.kv_cache_stats(mid_phys)
     head = {
         "metric": "paged_kv_resident_batch_gain",
         "value": round(res_p / max(1e-9, res_c), 3),
@@ -2043,28 +2081,56 @@ def bench_paged(model_builder=None, max_requests=8, prompt_len=48,
         "vs_baseline": 0,
         "paged_resident_batch": round(res_p, 2),
         "capped_resident_batch": round(res_c, 2),
+        "physical_resident_batch": round(res_f, 2),
+        "physical_resident_gain": round(res_f / max(1e-9, res_c), 3),
         "paged_tokens_per_s": round(tps_p, 1),
         "capped_tokens_per_s": round(tps_c, 1),
+        "physical_tokens_per_s": round(tps_f, 1),
         "paged_goodput_tokens_per_s": rep_p["goodput_tokens_per_s"],
         "capped_goodput_tokens_per_s": rep_c["goodput_tokens_per_s"],
+        "physical_goodput_tokens_per_s": rep_f["goodput_tokens_per_s"],
         "greedy_parity": parity,
         "budget_bytes": int(budget_bytes),
+        # MEASURED frame-pool HBM: the allocation itself shrank to the
+        # budget (vs the accounting arm's dense rows x alloc_len slabs)
+        "physical_cache_hbm_bytes": int(phys_stats.pool_bytes),
+        "paged_cache_hbm_bytes": _KV_NOTES["paged"]["cache_hbm_bytes"],
     }
     extras = [
         {"metric": "paged_kv_spill_bytes", "unit": "bytes",
          "value": psnap["spill_bytes_total"],
          "restore_bytes": psnap["restore_bytes_total"],
-         "spilled_live": psnap["spilled_bytes"], "vs_baseline": 0},
+         "spilled_live": psnap["spilled_bytes"],
+         "physical_spill_bytes": fsnap["spill_bytes_total"],
+         "physical_restore_bytes": fsnap["restore_bytes_total"],
+         "vs_baseline": 0},
         {"metric": "paged_kv_preemptions", "unit": "count",
          "value": sum(psnap["preemptions"].values()),
          "by_reason": psnap["preemptions"],
+         "physical_by_reason": fsnap["preemptions"],
          "pages_total": psnap["total_pages"],
          "page_len": psnap["page_len"], "vs_baseline": 0},
         {"metric": "paged_kv_goodput_gain",
          "value": round(rep_p["goodput_tokens_per_s"]
                         / max(1e-9, rep_c["goodput_tokens_per_s"]), 3),
          "unit": "x (SLO goodput, paged / row-capped)",
+         "physical_goodput_gain": round(
+             rep_f["goodput_tokens_per_s"]
+             / max(1e-9, rep_c["goodput_tokens_per_s"]), 3),
          "slo_policy": rep_p["policy"], "vs_baseline": 0},
+        {"metric": "paged_kv_physical_frames", "unit": "frames",
+         "value": fsnap["total_pages"],
+         # the gauges the ops dashboards read — total is the pool, free
+         # must be back at total once the stream drains (no leaks)
+         "frames_total_gauge": m.gauge(
+             "serving_kv_frames_total").value(),
+         "frames_free_gauge": m.gauge("serving_kv_frames_free").value(),
+         "frames_shared_total": m.counter(
+             "serving_prefix_frames_shared_total").value(),
+         "frame_bytes": int(phys_stats.frame_bytes),
+         "pool_hbm_bytes": int(phys_stats.pool_bytes),
+         "dense_slab_hbm_bytes": int(stats.bytes_resident),
+         "vs_baseline": 0},
     ]
     return (head, *extras)
 

@@ -44,6 +44,12 @@ r5 additions:
   combine is the standard flash merge: pmax of maxima, psum of
   rescaled sums/accumulators — the decode twin of
   ops/ring_attention.py's combine).
+
+PR 10: the PAGED twins (``paged_decode_attention`` + friends, bottom
+of this file) run the SAME kernel bodies against a global
+``[num_frames, KV, page_len, D]`` frame pool indexed through
+scalar-prefetched per-row page tables — the vLLM PagedAttention block
+table, built the Pallas way (docs/INTERNALS.md "Paged KV cache").
 """
 
 from __future__ import annotations
@@ -600,6 +606,349 @@ def flash_decode_attention_sharded(q, k_new, v_new, ck, cv, depth,
     if has_alibi:
         args += (jnp.asarray(slopes, jnp.float32),)
     return fn(*args)
+
+
+# --------------------------------------------------------------- paged
+# Physical paged KV (PR 10): K/V live in a GLOBAL frame pool
+# [num_frames, KV, page_len, D] and each row's logical pages map to
+# frames through an int32 [R, max_pages] page table (the vLLM
+# PagedAttention block-table idiom, built the Pallas way).  The grid
+# walks (row, logical page) and the K/V BlockSpec index maps read the
+# scalar-prefetched table — so the DMA stream touches exactly the
+# row's LEASED frames, in whatever fragmented order the allocator
+# handed them out, and HBM residency equals leased frames instead of
+# rows x max_seq.  The kernel BODY is the dense `_kernel` unchanged:
+# grid index t IS the logical page, so every span/depth/ALiBi
+# computation stays in global position space; only the address of the
+# tile moved.  Tables are DATA (fixed [R, max_pages] shape) — contents
+# change per step with zero retracing.
+
+
+def _paged_kernel(table_ref, *rest, **kw):
+    """The dense kernel behind a table indirection: the table ref is
+    consumed by the BlockSpec index maps alone."""
+    return _kernel(*rest, **kw)
+
+
+def paged_head_axes(mesh):
+    """(merged head-shard axes tuple, group size) of a serving mesh for
+    paged pools: frames have no global length axis, so BOTH tp and sp
+    shard the KV-head axis (heads are independent — no collective, no
+    flash merge)."""
+    from ..config import AXIS_MODEL, AXIS_SEQ
+
+    shape = dict(mesh.shape)
+    axes = tuple(a for a in (AXIS_MODEL, AXIS_SEQ)
+                 if shape.get(a, 1) > 1)
+    size = 1
+    for a in axes:
+        size *= shape[a]
+    return axes, size
+
+
+def _paged_attend_call(q, pk, pv, table, depth, active, scale,
+                       interpret, slopes, s_bound,
+                       k_scale=None, v_scale=None):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    R, H, D = q.shape
+    F, KV, L, _ = pk.shape
+    G = H // KV
+    P = table.shape[1]
+    assert H == KV * G and pk.shape == pv.shape == (F, KV, L, D)
+    assert table.shape == (R, P), (table.shape, (R, P))
+    quant = k_scale is not None
+    assert quant == (v_scale is not None)
+    if quant:
+        assert k_scale.shape == v_scale.shape == (F, KV, L), (
+            k_scale.shape, (F, KV, L))
+    nt = min(P, pl.cdiv(s_bound, L)) if s_bound else P
+    depth = depth.astype(jnp.int32)
+    active = active.astype(jnp.int32)
+    # table entries of unleased pages may be stale — clip so the
+    # clamped re-request of a pruned tile never walks off the pool
+    # (reads there are fully masked by span <= depth)
+    table = jnp.clip(table.astype(jnp.int32), 0, F - 1)
+    last = jnp.clip(depth // L, 0, nt - 1)
+
+    alibi = slopes is not None
+    kernel = functools.partial(_paged_kernel, ts=L, kv=KV, g=G, d=D,
+                               s_total=nt * L, scale=float(scale),
+                               alibi=alibi, partial=False, quant=quant)
+    kv_map = lambda r, t, tab, last, *_: (  # noqa: E731 — shared by K/V
+        tab[r, jnp.minimum(t, last[r])], 0, 0, 0)
+    in_specs = [
+        pl.BlockSpec((1, H, D), lambda r, t, *_: (r, 0, 0)),
+        pl.BlockSpec((1, KV, L, D), kv_map),
+        pl.BlockSpec((1, KV, L, D), kv_map),
+    ]
+    inputs = [q, pk, pv]
+    if quant:
+        # f32 scale frames ride the same table indirection
+        for sc in (k_scale, v_scale):
+            in_specs.append(pl.BlockSpec(
+                (1, KV, L),
+                lambda r, t, tab, last, *_: (
+                    tab[r, jnp.minimum(t, last[r])], 0, 0)))
+            inputs.append(sc)
+    if alibi:
+        in_specs.append(pl.BlockSpec((H, 1), lambda r, t, *_: (0, 0)))
+        inputs.append(jnp.asarray(slopes, jnp.float32).reshape(H, 1))
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=4,
+        grid=(R, nt),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, H, D), lambda r, t, *_: (r, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((KV * G, 1), jnp.float32),   # running max
+            pltpu.VMEM((KV * G, 1), jnp.float32),   # running sum
+            pltpu.VMEM((KV * G, D), jnp.float32),   # out accumulator
+        ],
+    )
+    return pl.pallas_call(
+        kernel, grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((R, H, D), q.dtype),
+        interpret=interpret,
+    )(table, last, depth, active, q, *inputs[1:])
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("scale", "interpret", "s_bound"))
+def paged_decode_attend(q, pk, pv, table, depth, active, scale: float,
+                        interpret: bool = False, slopes=None,
+                        s_bound=None, k_scale=None, v_scale=None):
+    """q [R,H,D] against the paged pool pk/pv [F,KV,page_len,D] read
+    through ``table`` int32 [R,max_pages], masked to span<=depth[r]
+    -> [R,H,D].  Grid walks the row's LEASED frames (pruned past
+    depth//page_len like the dense kernel's S tiles); ``s_bound``
+    statically bounds the walked pages (the host's attend bucket)."""
+    return _paged_attend_call(q, pk, pv, table, depth, active, scale,
+                              interpret, slopes, s_bound,
+                              k_scale=k_scale, v_scale=v_scale)
+
+
+def _paged_append_kernel(frame_ref, off_ref, act_ref,   # scalar prefetch
+                         *refs, w: int, quant: bool):
+    """Per-row in-place single-token append into the FRAME holding the
+    row's current depth: pk[frame[r], :, off[r], :] = k_new[r].  The
+    same ``w``-aligned RMW window as the dense kernel (16 bf16 / 32
+    int8 — page_len % 32 == 0 keeps every window inside one frame),
+    with the window base computed inside the frame instead of the
+    row slab."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    if quant:
+        (knew_ref, vnew_ref, ksc_ref, vsc_ref, ck_hbm, cv_hbm,
+         ck_out, cv_out, win_k, win_v, sem_k, sem_v) = refs
+    else:
+        (knew_ref, vnew_ref, ck_hbm, cv_hbm,
+         ck_out, cv_out, win_k, win_v, sem_k, sem_v) = refs
+        ksc_ref = vsc_ref = None
+
+    r = pl.program_id(0)
+
+    @pl.when(act_ref[r] > 0)
+    def _():
+        f = frame_ref[r]
+        off = off_ref[r]
+        base = (off // w) * w
+        ink = pltpu.make_async_copy(
+            ck_out.at[f, :, pl.ds(base, w), :], win_k, sem_k)
+        inv = pltpu.make_async_copy(
+            cv_out.at[f, :, pl.ds(base, w), :], win_v, sem_v)
+        ink.start()
+        inv.start()
+        ink.wait()
+        inv.wait()
+        sel = jax.lax.broadcasted_iota(jnp.int32, (1, w, 1), 1) \
+            == (off - base)
+        kn, vn = knew_ref[r], vnew_ref[r]
+        if quant:
+            kn = jnp.clip(jnp.rint(kn.astype(jnp.float32) / ksc_ref[r]),
+                          -127, 127)
+            vn = jnp.clip(jnp.rint(vn.astype(jnp.float32) / vsc_ref[r]),
+                          -127, 127)
+        win_k[:] = jnp.where(sel, kn.astype(win_k.dtype), win_k[:])
+        win_v[:] = jnp.where(sel, vn.astype(win_v.dtype), win_v[:])
+        outk = pltpu.make_async_copy(
+            win_k, ck_out.at[f, :, pl.ds(base, w), :], sem_k)
+        outv = pltpu.make_async_copy(
+            win_v, cv_out.at[f, :, pl.ds(base, w), :], sem_v)
+        outk.start()
+        outv.start()
+        outk.wait()
+        outv.wait()
+
+
+def paged_cache_append(pk, pv, k_new, v_new, table, depth, active,
+                       interpret: bool = False, k_scale_new=None,
+                       v_scale_new=None):
+    """In-place (aliased) single-token KV append on paged
+    [F,KV,page_len,D] pools — the table-indirected twin of
+    :func:`cache_append`.  The host side resolves depth to (frame,
+    in-frame offset) through the table; the kernel's RMW window never
+    crosses a frame boundary (page_len % 32 == 0)."""
+    import functools as _ft
+
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    F, KV, L, D = pk.shape
+    R = k_new.shape[0]
+    P = table.shape[1]
+    quant = pk.dtype.itemsize == 1
+    w = 32 if quant else 16
+    assert L % w == 0, (L, w)
+    assert quant == (k_scale_new is not None) == (v_scale_new is not None)
+    depth = jnp.clip(depth.astype(jnp.int32), 0, P * L - 1)
+    frame = jnp.take_along_axis(jnp.asarray(table, jnp.int32),
+                                (depth // L)[:, None], axis=1)[:, 0]
+    # unleased pages carry the out-of-range sentinel: mask the write
+    # instead of clipping onto somebody else's frame
+    active = active.astype(jnp.int32) * (frame >= 0) * (frame < F)
+    frame = jnp.clip(frame, 0, F - 1)
+    off = depth % L
+    in_specs = [
+        pl.BlockSpec(memory_space=pltpu.VMEM),   # k_new
+        pl.BlockSpec(memory_space=pltpu.VMEM),   # v_new
+    ]
+    inputs = [k_new[:, :, None] if quant
+              else k_new[:, :, None].astype(pk.dtype),
+              v_new[:, :, None] if quant
+              else v_new[:, :, None].astype(pv.dtype)]
+    if quant:
+        in_specs += [pl.BlockSpec(memory_space=pltpu.VMEM)] * 2
+        inputs += [k_scale_new.astype(jnp.float32)[:, :, None, None],
+                   v_scale_new.astype(jnp.float32)[:, :, None, None]]
+    in_specs += [pl.BlockSpec(memory_space=pl.ANY),    # pk
+                 pl.BlockSpec(memory_space=pl.ANY)]    # pv
+    n_in = 3 + len(inputs)         # + scalar-prefetch args
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(R,),
+        in_specs=in_specs,
+        out_specs=(pl.BlockSpec(memory_space=pl.ANY),
+                   pl.BlockSpec(memory_space=pl.ANY)),
+        scratch_shapes=[pltpu.VMEM((KV, w, D), pk.dtype),
+                        pltpu.VMEM((KV, w, D), pv.dtype),
+                        pltpu.SemaphoreType.DMA(()),
+                        pltpu.SemaphoreType.DMA(())],
+    )
+    return pl.pallas_call(
+        _ft.partial(_paged_append_kernel, w=w, quant=quant),
+        grid_spec=grid_spec,
+        out_shape=(jax.ShapeDtypeStruct(pk.shape, pk.dtype),
+                   jax.ShapeDtypeStruct(pv.shape, pv.dtype)),
+        input_output_aliases={n_in: 0, n_in + 1: 1},
+        interpret=interpret,
+    )(frame, off, active, *inputs, pk, pv)
+
+
+def paged_decode_attention(q, k_new, v_new, pk, pv, table, depth,
+                           active, scale: float,
+                           interpret: bool = False, slopes=None,
+                           s_bound=None, k_scale=None, v_scale=None):
+    """Scatter-then-attend decode step on a paged pool (drop-in for
+    the op layer): append the new token into the frame holding each
+    active row's depth, then run the page-table attend.  Returns
+    (out, pk, pv[, k_scale, v_scale]) like the dense twin."""
+    if k_scale is not None:
+        from ..quantization import quantize_kv, scatter_kv_scales_paged
+
+        depth = jnp.clip(depth.astype(jnp.int32), 0,
+                         table.shape[1] * pk.shape[2] - 1)
+        _, k_sc = quantize_kv(k_new)                    # [R, KV]
+        _, v_sc = quantize_kv(v_new)
+        pk, pv = paged_cache_append(pk, pv, k_new, v_new, table, depth,
+                                    active, interpret=interpret,
+                                    k_scale_new=k_sc, v_scale_new=v_sc)
+        k_scale = scatter_kv_scales_paged(k_scale, k_sc[:, None], depth,
+                                          active, table)
+        v_scale = scatter_kv_scales_paged(v_scale, v_sc[:, None], depth,
+                                          active, table)
+        out = paged_decode_attend(q, pk, pv, table, depth, active,
+                                  scale, interpret=interpret,
+                                  slopes=slopes, s_bound=s_bound,
+                                  k_scale=k_scale, v_scale=v_scale)
+        return out, pk, pv, k_scale, v_scale
+    pk, pv = paged_cache_append(pk, pv, k_new, v_new, table, depth,
+                                active, interpret=interpret)
+    out = paged_decode_attend(q, pk, pv, table, depth, active, scale,
+                              interpret=interpret, slopes=slopes,
+                              s_bound=s_bound)
+    return out, pk, pv
+
+
+def paged_decode_attention_sharded(q, k_new, v_new, pk, pv, table,
+                                   depth, active, scale: float, mesh,
+                                   interpret: bool = False, slopes=None,
+                                   s_bound=None, k_scale=None,
+                                   v_scale=None):
+    """shard_map'd paged decode step: frames shard on the KV-HEAD axis
+    over the merged tp/sp group (paged pools have no length axis for
+    sp — heads are the only independent dimension), tables/depths
+    replicate, and each shard runs the plain paged kernels on its
+    local heads.  No collective, no flash merge."""
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    axes, size = paged_head_axes(mesh)
+    head = axes[0] if len(axes) == 1 else (axes or None)
+    head_spec = P(None, head, None)
+    pool_spec = P(None, head, None, None)
+    sc_spec = P(None, head, None)
+    slope_spec = P(head)
+    has_alibi = slopes is not None
+    quant = k_scale is not None
+    depth = depth.astype(jnp.int32)
+    active = active.astype(jnp.int32)
+    table = jnp.asarray(table, jnp.int32)
+
+    def body(q, kn, vn, pk, pv, table, depth, active, *rest):
+        rest = list(rest)
+        ks, vs = (rest.pop(0), rest.pop(0)) if quant else (None, None)
+        sl = rest.pop(0) if has_alibi else None
+        res = paged_decode_attention(q, kn, vn, pk, pv, table, depth,
+                                     active, scale, interpret=interpret,
+                                     slopes=sl, s_bound=s_bound,
+                                     k_scale=ks, v_scale=vs)
+        return res
+
+    fn = shard_map(
+        body, mesh=mesh,
+        in_specs=(head_spec, head_spec, head_spec, pool_spec, pool_spec,
+                  P(), P(), P())
+        + ((sc_spec, sc_spec) if quant else ())
+        + ((slope_spec,) if has_alibi else ()),
+        out_specs=(head_spec, pool_spec, pool_spec)
+        + ((sc_spec, sc_spec) if quant else ()),
+        check_rep=False)
+    args = (q, k_new, v_new, pk, pv, table, depth, active)
+    if quant:
+        args += (k_scale, v_scale)
+    if has_alibi:
+        args += (jnp.asarray(slopes, jnp.float32),)
+    return fn(*args)
+
+
+def paged_path_ok(C: int, pk, mesh) -> bool:
+    """Shape gate for the paged decode kernels: single-token decode,
+    lane-aligned head dim, frame length a legal RMW window multiple
+    (32 for int8 pools, 16 otherwise — page_len % 32 == 0 satisfies
+    both by construction), and an unsharded pool OR one whose KV-head
+    axis divides the merged tp/sp head group."""
+    F, KV, L, D = pk.shape
+    align = 32 if pk.dtype.itemsize == 1 else 16
+    if C != 1 or D % 128 != 0 or L % align != 0:
+        return False
+    if mesh is None:
+        return True
+    axes, size = paged_head_axes(mesh)
+    other = [a for a, s in mesh.shape.items()
+             if s > 1 and a not in axes]
+    return not other and KV % size == 0
 
 
 def flash_path_ok(C: int, ck, mesh) -> bool:

@@ -643,6 +643,358 @@ def flash_prefill_attention_sharded(q, k_new, v_new, ck, cv, depth,
     return fn(*args)
 
 
+# --------------------------------------------------------------- paged
+# Physical paged KV (PR 10) — the chunked-prefill / tree-verify twin
+# of flash_decode's paged kernels: the (row, C-tile, S-tile) grid's
+# S axis walks LOGICAL PAGES and the K/V BlockSpec index maps resolve
+# each page to its frame through the scalar-prefetched page table.
+# The kernel body is the dense `_kernel` unchanged (grid index t is
+# the logical page; all causal/ALiBi math stays in global positions).
+
+
+def _paged_kernel(table_ref, *rest, **kw):
+    """The dense prefill kernel behind a table indirection (the table
+    ref feeds the BlockSpec index maps alone)."""
+    return _kernel(*rest, **kw)
+
+
+def _pick_tc_paged(C: int, L: int, KV: int, G: int) -> int:
+    """Largest C-tile whose f32 logits+p temps ([KVG*TC, L] twice) fit
+    the VMEM budget — the paged S-tile is pinned to the frame length,
+    so only TC is free."""
+    budget = 6 * 1024 * 1024
+    cap = max(1, budget // (KV * G * L * 2 * 4))
+    tc = C
+    while tc > 16 and tc > cap:
+        tc //= 2
+    return tc
+
+
+def _paged_prefill_call(q, pk, pv, table, depth, ntok, active, scale,
+                        interpret, tc, s_bound, slopes,
+                        k_scale=None, v_scale=None):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    R, C, H, D = q.shape
+    F, KV, L, _ = pk.shape
+    G = H // KV
+    P = table.shape[1]
+    assert H == KV * G and pk.shape == pv.shape == (F, KV, L, D)
+    quant = k_scale is not None
+    assert quant == (v_scale is not None)
+    if quant:
+        assert k_scale.shape == v_scale.shape == (F, KV, L), (
+            k_scale.shape, (F, KV, L))
+    if tc is None:
+        tc = _pick_tc_paged(C, L, KV, G)
+    assert C % tc == 0, (C, tc)
+    nc = C // tc
+    nt = min(P, pl.cdiv(s_bound, L)) if s_bound else P
+    depth = depth.astype(jnp.int32)
+    ntok = ntok.astype(jnp.int32)
+    active = active.astype(jnp.int32)
+    table = jnp.clip(jnp.asarray(table, jnp.int32), 0, F - 1)
+    # last logical page each (row, C-tile) needs (the dense kernel's
+    # pruning clamp, with ts = the frame length)
+    qmax = jnp.minimum((jnp.arange(nc, dtype=jnp.int32) + 1) * tc,
+                       ntok[:, None])                      # [R, NC]
+    has_q = (jnp.arange(nc, dtype=jnp.int32) * tc < ntok[:, None])
+    last = jnp.where(has_q & (active[:, None] > 0),
+                     jnp.clip((depth[:, None] + qmax - 1) // L,
+                              0, nt - 1), 0).astype(jnp.int32)
+
+    qt = q.reshape(R, C, KV, G, D).transpose(0, 2, 3, 1, 4)
+
+    alibi = slopes is not None
+    kernel = functools.partial(_paged_kernel, ts=L, tc=tc, kv=KV, g=G,
+                               d=D, s_total=nt * L, scale=float(scale),
+                               alibi=alibi, partial=False, quant=quant)
+    kv_map = lambda r, c, t, tab, last, *_: (  # noqa: E731
+        tab[r, jnp.minimum(t, last[r, c])], 0, 0, 0)
+    in_specs = [
+        pl.BlockSpec((1, KV, G, tc, D),
+                     lambda r, c, t, *_: (r, 0, 0, c, 0)),
+        pl.BlockSpec((1, KV, L, D), kv_map),
+        pl.BlockSpec((1, KV, L, D), kv_map),
+    ]
+    inputs = [qt, pk, pv]
+    if quant:
+        for sc in (k_scale, v_scale):
+            in_specs.append(pl.BlockSpec(
+                (1, KV, L),
+                lambda r, c, t, tab, last, *_: (
+                    tab[r, jnp.minimum(t, last[r, c])], 0, 0)))
+            inputs.append(sc)
+    if alibi:
+        sl = jnp.broadcast_to(
+            jnp.asarray(slopes, jnp.float32).reshape(KV, G, 1),
+            (KV, G, tc)).reshape(KV, G * tc)
+        in_specs.append(
+            pl.BlockSpec((KV, G * tc), lambda r, c, t, *_: (0, 0)))
+        inputs.append(sl)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=5,
+        grid=(R, nc, nt),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, KV, G, tc, D),
+                               lambda r, c, t, *_: (r, 0, 0, c, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((KV * G * tc, 1), jnp.float32),   # running max
+            pltpu.VMEM((KV * G * tc, 1), jnp.float32),   # running sum
+            pltpu.VMEM((KV * G * tc, D), jnp.float32),   # accumulator
+        ],
+    )
+    return pl.pallas_call(
+        kernel, grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((R, KV, G, C, D), q.dtype),
+        interpret=interpret,
+    )(table, last, depth, ntok, active, *inputs)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("scale", "interpret", "tc",
+                                    "s_bound"))
+def paged_prefill_attend(q, pk, pv, table, depth, ntok, active,
+                         scale: float, interpret: bool = False,
+                         tc=None, s_bound=None, slopes=None,
+                         k_scale=None, v_scale=None):
+    """q [R,C,H,D] against the paged pool through ``table``, causal at
+    per-row offset ``depth`` — the page-table twin of
+    :func:`flash_prefill_attend` (chunked prefill AND the spec
+    drivers' tree-verify prompt phase ride this shape).  ``s_bound``
+    bounds the walked pages like the dense kernel bounds its grid."""
+    R, C, H, D = q.shape
+    out = _paged_prefill_call(q, pk, pv, table, depth, ntok, active,
+                              scale, interpret, tc, s_bound, slopes,
+                              k_scale=k_scale, v_scale=v_scale)
+    return out.transpose(0, 3, 1, 2, 4).reshape(R, C, H, D)
+
+
+def _paged_chunk_kernel(frame_ref, roll_ref, lo_ref, hi_ref, act_ref,
+                        kal_ref, val_ref,     # VMEM [1, KV, Wc, D]
+                        pk_hbm, pv_hbm,       # ANY (aliased inputs)
+                        pk_out, pv_out,       # aliased outputs
+                        win_k, win_v, sem_k, sem_v, *, L: int):
+    """Per-(row, straddled-frame) chunk overlay: frame p of the chunk's
+    span RMWs as a WHOLE frame window [0, L) — frames are page_len
+    wide, page_len % 32 == 0, so every window is sublane-legal for
+    every cache dtype.  The chunk arrives zero-padded f32 and rotates
+    to the window offset in-kernel (the dense chunk_append's dynamic
+    sublane rotate, with per-(r, p) rotate amounts)."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    r = pl.program_id(0)
+    p = pl.program_id(1)
+
+    @pl.when(act_ref[r, p] > 0)
+    def _():
+        f = frame_ref[r, p]
+        ink = pltpu.make_async_copy(pk_out.at[f], win_k, sem_k)
+        inv = pltpu.make_async_copy(pv_out.at[f], win_v, sem_v)
+        ink.start()
+        inv.start()
+        ink.wait()
+        inv.wait()
+        jj = jax.lax.broadcasted_iota(jnp.int32, (1, L, 1), 1)
+        sel = (jj >= lo_ref[r, p]) & (jj < hi_ref[r, p])
+        kv = win_k.shape[0]
+        for i in range(kv):
+            rk = pltpu.roll(kal_ref[0, i], roll_ref[r, p], 0)
+            rv = pltpu.roll(val_ref[0, i], roll_ref[r, p], 0)
+            win_k[i] = jnp.where(sel[0], rk[:L].astype(win_k.dtype),
+                                 win_k[i])
+            win_v[i] = jnp.where(sel[0], rv[:L].astype(win_v.dtype),
+                                 win_v[i])
+        outk = pltpu.make_async_copy(win_k, pk_out.at[f], sem_k)
+        outv = pltpu.make_async_copy(win_v, pv_out.at[f], sem_v)
+        outk.start()
+        outv.start()
+        outk.wait()
+        outv.wait()
+
+
+def paged_chunk_append(pk, pv, k_new, v_new, table, depth, ntok,
+                       active, interpret: bool = False):
+    """In-place (aliased) chunk KV append on paged pools: the chunk
+    [depth, depth+ntok) straddles up to cdiv(C, page_len)+1 frames and
+    each (row, frame) program overlays its intersection — the same
+    piecewise-overlay contract as the dense kernel's sp straddle
+    handling, with the pieces resolved through the page table.  int8
+    pools take the chunk PRE-QUANTIZED (exact codes staged f32, cast
+    lossless); scale frames are the caller's (scatter_kv_scales_paged)."""
+    import functools as _ft
+
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    F, KV, L, D = pk.shape
+    R, C = k_new.shape[:2]
+    P = table.shape[1]
+    align = 32 if pk.dtype.itemsize == 1 else 16
+    assert L % align == 0, (L, align)
+    assert C % 16 == 0, C   # host chunk gate (pick_chunk pow2 >= 16)
+    npc = -(-C // L) + 1    # frames a chunk can straddle
+    depth = jnp.clip(depth.astype(jnp.int32), 0, P * L - 1)
+    ntok = jnp.minimum(ntok.astype(jnp.int32), C)
+    active = active.astype(jnp.int32)
+    pidx = (depth // L)[:, None] + jnp.arange(npc,
+                                              dtype=jnp.int32)  # [R,NPC]
+    shift = depth[:, None] - pidx * L     # window pos of chunk entry 0
+    lo = jnp.clip(shift, 0, L)
+    hi = jnp.clip(shift + ntok[:, None], 0, L)
+    frame = jnp.take_along_axis(jnp.asarray(table, jnp.int32),
+                                jnp.clip(pidx, 0, P - 1), axis=1)
+    # unleased pages carry the out-of-range sentinel: mask the overlay
+    # instead of clipping onto somebody else's frame
+    act = (active[:, None] * (hi > lo) * (pidx < P)
+           * (frame >= 0) * (frame < F))
+    frame = jnp.clip(frame, 0, F - 1)
+    wc = max(C, L)          # rolled width must cover the window
+    roll = shift % wc
+    pad = [(0, 0), (0, 0), (0, wc - C), (0, 0)]
+    k_al = jnp.pad(k_new.transpose(0, 2, 1, 3),          # [R, KV, Wc, D]
+                   pad).astype(jnp.float32)
+    v_al = jnp.pad(v_new.transpose(0, 2, 1, 3),
+                   pad).astype(jnp.float32)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=5,
+        grid=(R, npc),
+        in_specs=[
+            pl.BlockSpec((1, KV, wc, D), lambda r, p, *_: (r, 0, 0, 0)),
+            pl.BlockSpec((1, KV, wc, D), lambda r, p, *_: (r, 0, 0, 0)),
+            pl.BlockSpec(memory_space=pl.ANY),           # pk
+            pl.BlockSpec(memory_space=pl.ANY),           # pv
+        ],
+        out_specs=(pl.BlockSpec(memory_space=pl.ANY),
+                   pl.BlockSpec(memory_space=pl.ANY)),
+        scratch_shapes=[pltpu.VMEM((KV, L, D), pk.dtype),
+                        pltpu.VMEM((KV, L, D), pv.dtype),
+                        pltpu.SemaphoreType.DMA(()),
+                        pltpu.SemaphoreType.DMA(())],
+    )
+    return pl.pallas_call(
+        _ft.partial(_paged_chunk_kernel, L=L), grid_spec=grid_spec,
+        out_shape=(jax.ShapeDtypeStruct(pk.shape, pk.dtype),
+                   jax.ShapeDtypeStruct(pv.shape, pv.dtype)),
+        input_output_aliases={7: 0, 8: 1},   # +5 scalar-prefetch args
+        interpret=interpret,
+    )(frame, roll, lo, hi, act, k_al, v_al, pk, pv)
+
+
+def paged_prefill_attention(q, k_new, v_new, pk, pv, table, depth,
+                            ntok, active, scale: float,
+                            interpret: bool = False, s_bound=None,
+                            slopes=None, k_scale=None, v_scale=None):
+    """Scatter-then-attend prefill step on a paged pool (drop-in for
+    the op layer): overlay the chunk across its straddled frames, then
+    run the page-table attend.  Returns (out, pk, pv[, k_scale,
+    v_scale]) like the dense twin."""
+    if k_scale is not None:
+        from ..quantization import quantize_kv, scatter_kv_scales_paged
+
+        k_q, k_sc = quantize_kv(k_new)       # [R,C,KV] scales
+        v_q, v_sc = quantize_kv(v_new)
+        pk, pv = paged_chunk_append(pk, pv, k_q, v_q, table, depth,
+                                    ntok, active, interpret=interpret)
+        k_scale = scatter_kv_scales_paged(k_scale, k_sc, depth, active,
+                                          table)
+        v_scale = scatter_kv_scales_paged(v_scale, v_sc, depth, active,
+                                          table)
+        out = paged_prefill_attend(q, pk, pv, table, depth, ntok,
+                                   active, scale, interpret=interpret,
+                                   s_bound=s_bound, slopes=slopes,
+                                   k_scale=k_scale, v_scale=v_scale)
+        return out, pk, pv, k_scale, v_scale
+    pk, pv = paged_chunk_append(pk, pv, k_new, v_new, table, depth,
+                                ntok, active, interpret=interpret)
+    out = paged_prefill_attend(q, pk, pv, table, depth, ntok, active,
+                               scale, interpret=interpret,
+                               s_bound=s_bound, slopes=slopes)
+    return out, pk, pv
+
+
+def paged_prefill_attention_sharded(q, k_new, v_new, pk, pv, table,
+                                    depth, ntok, active, scale: float,
+                                    mesh, interpret: bool = False,
+                                    slopes=None, s_bound=None,
+                                    k_scale=None, v_scale=None):
+    """shard_map'd paged prefill: frames shard on the KV-head axis
+    over the merged tp/sp group (see
+    flash_decode.paged_decode_attention_sharded), tables replicate,
+    each shard appends and attends its local heads — no collective."""
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from .flash_decode import paged_head_axes
+
+    axes, size = paged_head_axes(mesh)
+    head = axes[0] if len(axes) == 1 else (axes or None)
+    q_spec = P(None, None, head, None)         # [R, C, H, D]
+    pool_spec = P(None, head, None, None)
+    sc_spec = P(None, head, None)
+    slope_spec = P(head)
+    has_alibi = slopes is not None
+    quant = k_scale is not None
+    depth = depth.astype(jnp.int32)
+    ntok = ntok.astype(jnp.int32)
+    active = active.astype(jnp.int32)
+    table = jnp.asarray(table, jnp.int32)
+
+    def body(q, kn, vn, pk, pv, table, depth, ntok, active, *rest):
+        rest = list(rest)
+        ks, vs = (rest.pop(0), rest.pop(0)) if quant else (None, None)
+        sl = rest.pop(0) if has_alibi else None
+        return paged_prefill_attention(
+            q, kn, vn, pk, pv, table, depth, ntok, active, scale,
+            interpret=interpret, s_bound=s_bound, slopes=sl,
+            k_scale=ks, v_scale=vs)
+
+    fn = shard_map(
+        body, mesh=mesh,
+        in_specs=(q_spec, q_spec, q_spec, pool_spec, pool_spec,
+                  P(), P(), P(), P())
+        + ((sc_spec, sc_spec) if quant else ())
+        + ((slope_spec,) if has_alibi else ()),
+        out_specs=(q_spec, pool_spec, pool_spec)
+        + ((sc_spec, sc_spec) if quant else ()),
+        check_rep=False)
+    args = (q, k_new, v_new, pk, pv, table, depth, ntok, active)
+    if quant:
+        args += (k_scale, v_scale)
+    if has_alibi:
+        args += (jnp.asarray(slopes, jnp.float32),)
+    return fn(*args)
+
+
+def paged_prefill_path_ok(C: int, pk, mesh) -> bool:
+    """Shape gate for the paged prefill kernels: an align-divisible
+    multi-token chunk (16 bf16 / 32 int8 — the overlay's cast and the
+    window RMW), lane-aligned head dim, a per-program VMEM footprint
+    (f32-staged chunk + whole-frame windows) inside the budget, and
+    an unsharded pool OR KV heads divisible by the merged tp/sp
+    group."""
+    F, KV, L, D = pk.shape
+    align = 32 if pk.dtype.itemsize == 1 else 16
+    size = 1
+    if mesh is not None:
+        from .flash_decode import paged_head_axes
+
+        axes, size = paged_head_axes(mesh)
+        other = [a for a, s in mesh.shape.items()
+                 if s > 1 and a not in axes]
+        if other or KV % size:
+            return False
+    kv_l = KV // max(1, size)
+    wc = max(C, L)
+    append_vmem = kv_l * D * (wc * 8 + 2 * L * pk.dtype.itemsize)
+    return (C >= align and C % align == 0 and D % 128 == 0
+            and L % align == 0
+            and append_vmem <= 11 * 1024 * 1024)
+
+
 def prefill_path_ok(C: int, ck, mesh) -> bool:
     """Shape gate for the production op: multi-token chunk with
     lane-aligned head dim and a 16-divisible chunk (the append window

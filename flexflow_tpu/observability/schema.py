@@ -198,6 +198,28 @@ METRICS_SCHEMA = {
                 "(device_put + the jitted donated row write, "
                 "InferenceManager.restore_row).",
     },
+    "serving_kv_frames_total": {
+        "type": "gauge",
+        "help": "Physical frames in a paged record's global KV frame "
+                "pool ([num_frames, KV, page_len, D] per layer; the "
+                "page tables index this axis).  Set by a KVPager "
+                "constructed with num_frames — HBM residency is "
+                "leased frames x frame bytes, not rows x max_seq.",
+    },
+    "serving_kv_frames_free": {
+        "type": "gauge",
+        "help": "Frames on the physical pager's free list (distinct "
+                "from serving_kv_pages_free: the page BUDGET may sit "
+                "below the physical pool — the surplus is the forced-"
+                "overcommit headroom that replaces dense-slab slack).",
+    },
+    "serving_prefix_frames_shared_total": {
+        "type": "counter",
+        "help": "Whole KV frames leased by refcount from a prefix-pool "
+                "donor at admission instead of device-copied (paged "
+                "records; saved bytes = count x frame bytes of the "
+                "served record).",
+    },
     "serving_preemptions_total": {
         "type": "counter",
         "help": "Requests preempted by the KV pager, labeled "

@@ -36,6 +36,14 @@ layout that made the Pallas kernel beat the XLA attend in BOTH its
 regimes; see kernels/flash_decode.py);
 updated caches are written to ``ctx.kv_cache_out`` (functional update — the
 step fn donates the cache buffers so XLA updates them in place).
+
+PR 10 (physical paged KV): when the batch carries a ``page_table``
+(int32 ``[R, max_pages]`` — presence of the key IS the layout switch),
+the same dicts hold GLOBAL frame pools ``[num_frames, KV, page_len,
+D]`` instead of row slabs; scatters/commits resolve positions to
+(frame, in-frame offset) through the table, the flash paths dispatch
+the page-table kernels, and the jnp fallback attends a gathered dense
+view bucketed in whole pages (docs/INTERNALS.md "Paged KV cache").
 """
 
 from __future__ import annotations
@@ -78,6 +86,42 @@ def _scatter_chunk(cache, chunk, start, active):
     return cache.at[rows, :, pos].set(chunk.astype(cache.dtype),
                                       mode="drop", unique_indices=True,
                                       indices_are_sorted=True)
+
+
+def _scatter_chunk_paged(pool, chunk, start, active, table):
+    """pool [F,KV,L,D] <- chunk [R,C,KV,D] through the page table at
+    per-row offset ``start`` — the paged twin of :func:`_scatter_chunk`.
+    Row r's token c lands in frame ``table[r, pos // L]`` at in-frame
+    offset ``pos % L``; inactive rows and positions past the table
+    redirect to the sentinel frame F and DROP."""
+    F, KV, L, D = pool.shape
+    R, C = chunk.shape[:2]
+    P = table.shape[1]
+    pos = start[:, None].astype(jnp.int32) + jnp.arange(C,
+                                                       dtype=jnp.int32)
+    page = pos // L
+    ok = active[:, None].astype(bool) & (pos >= 0) & (page < P)
+    fr = jnp.take_along_axis(table, jnp.clip(page, 0, P - 1), axis=1)
+    fr = jnp.where(ok, fr, F)
+    return pool.at[fr, :, pos % L].set(chunk.astype(pool.dtype),
+                                       mode="drop")
+
+
+def _paged_view(pool, table, pages):
+    """Gather a dense logical view of the first ``pages`` table columns:
+    pool [F,KV,L,D] + table [R,P] -> [R, KV, pages*L, D] (scale pools
+    [F,KV,L] -> [R, KV, pages*L]).  The jnp-fallback read path: XLA
+    fuses the gather into the attend's operand stream, and the gather
+    width is the host's attend bucket in pages — the paged analogue of
+    ``_attend_slice``.  Stale table entries clip to a real frame; the
+    attend mask (span <= depth) guards every unleased position."""
+    t = jnp.clip(table[:, :pages], 0, pool.shape[0] - 1)
+    g = pool[t]                        # [R, pages, KV, L(, D)]
+    if g.ndim == 5:
+        R, Pg, KV, L, D = g.shape
+        return g.transpose(0, 2, 1, 3, 4).reshape(R, KV, Pg * L, D)
+    R, Pg, KV, L = g.shape
+    return g.transpose(0, 2, 1, 3).reshape(R, KV, Pg * L)
 
 
 def _attend(q, cache_k, cache_v, mask, scale, alibi=None):
@@ -250,6 +294,71 @@ class _ServingAttentionBase(OpDef):
         ctx.kv_cache_out[layer_name] = out
 
     @staticmethod
+    def _page_table(ctx):
+        """The step's page table (int32 [R, max_pages]) when the record
+        is paged — the InferenceManager rides it on the batch dict as
+        DATA — else None.  Presence of the key IS the layout switch:
+        paged pools and dense slabs are both 4-D and otherwise
+        indistinguishable inside the trace."""
+        bc = ctx.batch_config
+        return bc["page_table"] if "page_table" in bc else None
+
+    @staticmethod
+    def _paged_attend_pages(ctx, pool, table):
+        """Table columns this step's attend reads: the host's attend
+        bucket rounded up to whole pages (the paged analogue of
+        ``_attend_slice`` — fewer gathered frames instead of a shorter
+        slice), or the full table without a bucket."""
+        L = pool.shape[2]
+        P = table.shape[1]
+        if ctx.attend_len and ctx.attend_len < P * L:
+            return min(P, -(-int(ctx.attend_len) // L))
+        return P
+
+    def _paged_gather(self, ctx, ck, cv, ks, vs, table):
+        """(ak, av, aks, avs, S): the dense logical view the jnp attend
+        reads, gathered frame-by-frame through the table."""
+        pages = self._paged_attend_pages(ctx, ck, table)
+        ak = _paged_view(ck, table, pages)
+        av = _paged_view(cv, table, pages)
+        aks = _paged_view(ks, table, pages) if ks is not None else None
+        avs = _paged_view(vs, table, pages) if vs is not None else None
+        return ak, av, aks, avs, pages * ck.shape[2]
+
+    def _scatter_any(self, ck, cv, ks, vs, k, v, start, active,
+                     table=None):
+        """Chunk commit on either layout: dense slabs scatter rows,
+        paged pools scatter through the table; int8 caches quantize
+        once (the shared quantizer) and move codes + scales in
+        lockstep."""
+        if ks is not None:
+            from ..quantization import (quantize_kv, scatter_kv_scales,
+                                        scatter_kv_scales_paged)
+
+            k_q, k_sc = quantize_kv(k)
+            v_q, v_sc = quantize_kv(v)
+            if table is not None:
+                ck = _scatter_chunk_paged(ck, k_q, start, active, table)
+                cv = _scatter_chunk_paged(cv, v_q, start, active, table)
+                ks = scatter_kv_scales_paged(ks, k_sc, start, active,
+                                             table)
+                vs = scatter_kv_scales_paged(vs, v_sc, start, active,
+                                             table)
+            else:
+                ck = _scatter_chunk(ck, k_q, start, active)
+                cv = _scatter_chunk(cv, v_q, start, active)
+                ks = scatter_kv_scales(ks, k_sc, start, active)
+                vs = scatter_kv_scales(vs, v_sc, start, active)
+            return ck, cv, ks, vs
+        if table is not None:
+            ck = _scatter_chunk_paged(ck, k, start, active, table)
+            cv = _scatter_chunk_paged(cv, v, start, active, table)
+        else:
+            ck = _scatter_chunk(ck, k, start, active)
+            cv = _scatter_chunk(cv, v, start, active)
+        return ck, cv, ks, vs
+
+    @staticmethod
     def _attend_slice(ctx, ck, cv, ks=None, vs=None):
         """Bound the attended cache prefix: positions past
         ctx.attend_len are provably masked (the host buckets it above
@@ -265,23 +374,6 @@ class _ServingAttentionBase(OpDef):
                     None if ks is None else ks[:, :, :L],
                     None if vs is None else vs[:, :, :L], L)
         return ck, cv, ks, vs, S
-
-    @staticmethod
-    def _scatter_quantized(ck, cv, ks, vs, k, v, start, active):
-        """int8-cache chunk commit: quantize the new K/V per position
-        per head (quantization.quantize_kv — the same quantizer the
-        Pallas append wrappers use, so both paths write identical cache
-        contents), scatter the int8 codes into the caches and the f32
-        scales into their [R, KV, S] tensors."""
-        from ..quantization import quantize_kv, scatter_kv_scales
-
-        k_q, k_sc = quantize_kv(k)
-        v_q, v_sc = quantize_kv(v)
-        ck = _scatter_chunk(ck, k_q, start, active)
-        cv = _scatter_chunk(cv, v_q, start, active)
-        ks = scatter_kv_scales(ks, k_sc, start, active)
-        vs = scatter_kv_scales(vs, v_sc, start, active)
-        return ck, cv, ks, vs
 
     @staticmethod
     def _dequant_pair(ak, av, aks, avs, dtype):
@@ -322,12 +414,29 @@ class IncMultiHeadSelfAttention(_ServingAttentionBase):
                                        theta).swapaxes(1, 2)
         ck, cv, ks, vs = self._cache(ctx, layer)
         quant = ks is not None
+        table = self._page_table(ctx)
         slopes = (self._alibi_slopes(attrs["num_q_heads"])
                   if attrs.get("position_bias", False) else None)
-        flash_mode = self._flash_decode_ok(attrs, ctx, C, ck)
+        flash_mode = self._flash_decode_ok(attrs, ctx, C, ck,
+                                           paged=table is not None)
         if flash_mode:
             interp = flash_mode == "interpret"
-            if getattr(ctx, "mesh", None) is not None:
+            if table is not None:
+                from ..kernels.flash_decode import (
+                    paged_decode_attention, paged_decode_attention_sharded)
+
+                fn = (paged_decode_attention_sharded
+                      if getattr(ctx, "mesh", None) is not None
+                      else paged_decode_attention)
+                kw = ({"mesh": ctx.mesh}
+                      if getattr(ctx, "mesh", None) is not None else {})
+                res = fn(q[:, 0], k[:, 0], v[:, 0], ck, cv, table,
+                         bc["first_depth"],
+                         bc["active"].astype(jnp.int32),
+                         self._scale(attrs), interpret=interp,
+                         slopes=slopes, s_bound=ctx.attend_len,
+                         k_scale=ks, v_scale=vs, **kw)
+            elif getattr(ctx, "mesh", None) is not None:
                 from ..kernels.flash_decode import (
                     flash_decode_attention_sharded)
 
@@ -349,10 +458,27 @@ class IncMultiHeadSelfAttention(_ServingAttentionBase):
                 ks, vs = res[3], res[4]
             self._store(ctx, layer, ck, cv, ks, vs)
             return [self._output(params, out1[:, None], attrs, ctx)]
-        flash_pre = self._flash_prefill_ok(attrs, ctx, C, ck)
+        flash_pre = self._flash_prefill_ok(attrs, ctx, C, ck,
+                                           paged=table is not None)
         if flash_pre:
             interp = flash_pre == "interpret"
-            if getattr(ctx, "mesh", None) is not None:
+            if table is not None:
+                from ..kernels.flash_prefill import (
+                    paged_prefill_attention,
+                    paged_prefill_attention_sharded)
+
+                fn = (paged_prefill_attention_sharded
+                      if getattr(ctx, "mesh", None) is not None
+                      else paged_prefill_attention)
+                kw = ({"mesh": ctx.mesh}
+                      if getattr(ctx, "mesh", None) is not None else {})
+                res = fn(q, k, v, ck, cv, table, bc["first_depth"],
+                         bc["row_tokens"],
+                         bc["active"].astype(jnp.int32),
+                         self._scale(attrs), interpret=interp,
+                         s_bound=ctx.attend_len, slopes=slopes,
+                         k_scale=ks, v_scale=vs, **kw)
+            elif getattr(ctx, "mesh", None) is not None:
                 from ..kernels.flash_prefill import (
                     flash_prefill_attention_sharded)
 
@@ -377,14 +503,16 @@ class IncMultiHeadSelfAttention(_ServingAttentionBase):
                 ks, vs = res[3], res[4]
             self._store(ctx, layer, ck, cv, ks, vs)
             return [self._output(params, out, attrs, ctx)]
-        if quant:
-            ck, cv, ks, vs = self._scatter_quantized(
-                ck, cv, ks, vs, k, v, bc["first_depth"], bc["active"])
-        else:
-            ck = _scatter_chunk(ck, k, bc["first_depth"], bc["active"])
-            cv = _scatter_chunk(cv, v, bc["first_depth"], bc["active"])
+        ck, cv, ks, vs = self._scatter_any(
+            ck, cv, ks, vs, k, v, bc["first_depth"], bc["active"],
+            table=table)
         self._store(ctx, layer, ck, cv, ks, vs)
-        ak, av, aks, avs, S = self._attend_slice(ctx, ck, cv, ks, vs)
+        if table is not None:
+            ak, av, aks, avs, S = self._paged_gather(ctx, ck, cv, ks,
+                                                     vs, table)
+        else:
+            ak, av, aks, avs, S = self._attend_slice(ctx, ck, cv, ks,
+                                                     vs)
         if quant:
             ak, av = self._dequant_pair(ak, av, aks, avs, q.dtype)
         span = jnp.arange(S)[None, None, :]  # [1,1,S]
@@ -398,30 +526,33 @@ class IncMultiHeadSelfAttention(_ServingAttentionBase):
         return [self._output(params, out, attrs, ctx)]
 
     @staticmethod
-    def _flash_decode_ok(attrs, ctx, C, ck):
+    def _flash_decode_ok(attrs, ctx, C, ck, paged=False):
         """Gate for the length-tiled flash-decode kernel
         (kernels/flash_decode.py).  The HOST decides per step whether the
         kernel's per-row tile pruning beats the XLA attend for this
         batch's depth profile (inference_manager.flash_wins sets
         ctx.use_flash); this gate checks the shapes the kernel supports
         (single-token decode, lane-aligned head dim, unsharded cache or
-        one sharded over tp/sp — r5; ALiBi is in-kernel).
-        FF_FLASH_DECODE=interpret runs the kernel interpreted
-        regardless of platform (CI coverage of the in-model wiring on
-        CPU); =0 disables.  Returns 'interpret', True or False."""
+        one sharded over tp/sp — r5; ALiBi is in-kernel).  ``paged``
+        records gate on the page-table kernel's shapes instead
+        (paged_path_ok — PR 10).  FF_FLASH_DECODE=interpret runs the
+        kernel interpreted regardless of platform (CI coverage of the
+        in-model wiring on CPU); =0 disables.  Returns 'interpret',
+        True or False."""
         import os
 
-        from ..kernels.flash_decode import flash_path_ok
+        from ..kernels.flash_decode import flash_path_ok, paged_path_ok
 
         mode = os.environ.get("FF_FLASH_DECODE", "auto")
         if mode == "0" or not getattr(ctx, "use_flash", False):
             return False
-        ok = (flash_path_ok(C, ck, getattr(ctx, "mesh", None))
+        gate = paged_path_ok if paged else flash_path_ok
+        ok = (gate(C, ck, getattr(ctx, "mesh", None))
               and (mode == "interpret" or pallas_tpu_available()))
         return (mode if mode == "interpret" else True) if ok else False
 
     @staticmethod
-    def _flash_prefill_ok(attrs, ctx, C, ck):
+    def _flash_prefill_ok(attrs, ctx, C, ck, paged=False):
         """Gate for the length-tiled flash-prefill kernel
         (kernels/flash_prefill.py).  The HOST decides per step whether
         the kernel beats the XLA prefill attend for this batch's attend
@@ -429,16 +560,20 @@ class IncMultiHeadSelfAttention(_ServingAttentionBase):
         ctx.use_flash); this checks the shapes the kernel supports
         (16-divisible multi-token chunk, lane-aligned head dim,
         unsharded cache or one sharded over tp/sp — r5; ALiBi is
-        in-kernel).  FF_FLASH_PREFILL=interpret runs the kernel
-        interpreted regardless of platform; =0 disables."""
+        in-kernel).  ``paged`` records gate on the page-table kernel's
+        shapes instead (paged_prefill_path_ok — PR 10).
+        FF_FLASH_PREFILL=interpret runs the kernel interpreted
+        regardless of platform; =0 disables."""
         import os
 
-        from ..kernels.flash_prefill import prefill_path_ok
+        from ..kernels.flash_prefill import (paged_prefill_path_ok,
+                                             prefill_path_ok)
 
         mode = os.environ.get("FF_FLASH_PREFILL", "auto")
         if mode == "0" or not getattr(ctx, "use_flash", False):
             return False
-        ok = (prefill_path_ok(C, ck, getattr(ctx, "mesh", None))
+        gate = paged_prefill_path_ok if paged else prefill_path_ok
+        ok = (gate(C, ck, getattr(ctx, "mesh", None))
               and (mode == "interpret" or pallas_tpu_available()))
         return (mode if mode == "interpret" else True) if ok else False
 
@@ -500,6 +635,29 @@ class TreeIncMultiHeadSelfAttention(_ServingAttentionBase):
 
         return jax.vmap(row)(cache, count, src, dst)
 
+    @staticmethod
+    def _commit_paged(pool, table, count, src, dst):
+        """The page-table commit: per row, for i < count, the KV at
+        logical position src[i] moves to logical position dst[i] —
+        both resolved to (frame, in-frame offset) through the row's
+        table.  Rank-agnostic (4-D K/V pools and 3-D scale pools);
+        non-committed entries target the sentinel frame and drop."""
+        F = pool.shape[0]
+        L = pool.shape[2]
+        P = table.shape[1]
+        n_slots = src.shape[1]
+        src = jnp.clip(src.astype(jnp.int32), 0, P * L - 1)
+        fs = jnp.clip(jnp.take_along_axis(table, src // L, axis=1),
+                      0, F - 1)
+        vals = pool[fs, :, src % L]                # [R, C, KV(, D)]
+        live = jnp.arange(n_slots)[None, :] < count[:, None]
+        dpage = dst.astype(jnp.int32) // L
+        okd = live & (dst >= 0) & (dpage < P)
+        fd = jnp.take_along_axis(table, jnp.clip(dpage, 0, P - 1),
+                                 axis=1)
+        fd = jnp.where(okd, fd, F)
+        return pool.at[fd, :, dst % L].set(vals, mode="drop")
+
     def inference(self, params, inputs, attrs, ctx):
         (x,) = inputs  # [R, C, E] — C = flattened tree slots
         bc = ctx.batch_config
@@ -507,17 +665,24 @@ class TreeIncMultiHeadSelfAttention(_ServingAttentionBase):
         R, C, _ = x.shape
         ck, cv, ks, vs = self._cache(ctx, layer)
         quant = ks is not None
+        table = self._page_table(ctx)
         # 1) commit verified tokens from the previous verify step (int8
         # caches move each committed position's SCALE with its codes —
         # a code reinterpreted under another position's scale would
         # silently rescale the whole head slice)
-        ck = self._commit(ck, bc["commit_count"], bc["commit_src"], bc["commit_dst"])
-        cv = self._commit(cv, bc["commit_count"], bc["commit_src"], bc["commit_dst"])
+        if table is not None:
+            commit = (lambda c: self._commit_paged(
+                c, table, bc["commit_count"], bc["commit_src"],
+                bc["commit_dst"]))
+        else:
+            commit = (lambda c: self._commit(
+                c, bc["commit_count"], bc["commit_src"],
+                bc["commit_dst"]))
+        ck = commit(ck)
+        cv = commit(cv)
         if quant:
-            ks = self._commit(ks, bc["commit_count"], bc["commit_src"],
-                              bc["commit_dst"])
-            vs = self._commit(vs, bc["commit_count"], bc["commit_src"],
-                              bc["commit_dst"])
+            ks = commit(ks)
+            vs = commit(vs)
         # 2) project + RoPE at tree depths
         q, k, v = self._project_qkv(params, x, attrs, ctx)
         depths = bc["token_depth"]  # [R, C]
@@ -528,15 +693,17 @@ class TreeIncMultiHeadSelfAttention(_ServingAttentionBase):
             k = apply_rotary_embedding(k.swapaxes(1, 2), depths[:, None, :],
                                        theta).swapaxes(1, 2)
         # 3) stash tree K/V flat at [first_depth, first_depth+C)
-        if quant:
-            ck, cv, ks, vs = self._scatter_quantized(
-                ck, cv, ks, vs, k, v, bc["first_depth"], bc["active"])
-        else:
-            ck = _scatter_chunk(ck, k, bc["first_depth"], bc["active"])
-            cv = _scatter_chunk(cv, v, bc["first_depth"], bc["active"])
+        ck, cv, ks, vs = self._scatter_any(
+            ck, cv, ks, vs, k, v, bc["first_depth"], bc["active"],
+            table=table)
         self._store(ctx, layer, ck, cv, ks, vs)
         # 4) mask: committed prefix + in-batch ancestors
-        ak, av, aks, avs, S = self._attend_slice(ctx, ck, cv, ks, vs)
+        if table is not None:
+            ak, av, aks, avs, S = self._paged_gather(ctx, ck, cv, ks,
+                                                     vs, table)
+        else:
+            ak, av, aks, avs, S = self._attend_slice(ctx, ck, cv, ks,
+                                                     vs)
         if quant:
             ak, av = self._dequant_pair(ak, av, aks, avs, q.dtype)
         span = jnp.arange(S)[None, None, :]

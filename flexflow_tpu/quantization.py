@@ -222,6 +222,27 @@ def scatter_kv_scales(scales, chunk, start, active):
                                        mode="drop")
 
 
+def scatter_kv_scales_paged(scales, chunk, start, active, table):
+    """``scales [F, KV, page_len] <- chunk [R, C, KV]`` through the
+    per-row page table (the paged twin of :func:`scatter_kv_scales`):
+    position ``start[r] + c`` lands in frame ``table[r, pos //
+    page_len]`` at in-frame offset ``pos % page_len``.  Positions past
+    the table and inactive rows redirect to the out-of-range frame
+    sentinel and DROP."""
+    F, KV, L = scales.shape
+    R, C = chunk.shape[:2]
+    P = table.shape[1]
+    pos = start[:, None].astype(jnp.int32) + jnp.arange(C,
+                                                       dtype=jnp.int32)
+    page = pos // L
+    ok = active[:, None].astype(bool) & (pos >= 0) & (page < P)
+    fr = jnp.take_along_axis(jnp.asarray(table, jnp.int32),
+                             jnp.clip(page, 0, P - 1), axis=1)
+    fr = jnp.where(ok, fr, F)
+    return scales.at[fr, :, pos % L].set(chunk.astype(scales.dtype),
+                                         mode="drop")
+
+
 # ------------------------------------------------- N-d int8 (attention)
 def quantize_int8_nd(w: np.ndarray, reduce_axes):
     """Symmetric int8 with scale over the non-reduced (output) axes; q

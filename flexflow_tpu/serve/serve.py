@@ -310,7 +310,8 @@ class LLM:
                 kv_cache_dtype: Optional[str] = None,
                 kv_page_budget_bytes: Optional[int] = None,
                 kv_page_len: int = 64,
-                kv_spill_policy: str = "auto"):
+                kv_spill_policy: str = "auto",
+                kv_layout: Optional[str] = None):
         """Build + compile the serving graph (reference serve.py:303+).
 
         With ``ssms`` the LLM compiles in TREE_VERIFY mode and each SSM in
@@ -332,6 +333,15 @@ class LLM:
         oversubscribed traffic keeps a larger resident batch than
         worst-case row sizing allows.  None (default) keeps the
         row-capped behavior — docs/INTERNALS.md "Paged KV cache".
+
+        ``kv_layout``: "paged" makes the pages PHYSICAL (PR 10): the
+        LLM's K/V live in a global ``[num_frames, KV, page_len, D]``
+        frame pool sized by ``kv_page_budget_bytes`` and every step
+        reads per-row page tables, so cache HBM residency equals the
+        pager's leased frames instead of rows x max_seq.  Requires
+        ``kv_page_budget_bytes`` (the pool is the budget); SSMs stay
+        dense (beam rows gather caches by parent).  Default ("dense")
+        keeps dense slabs with accounting-only paging.
         """
         from . import _resolved_config
 
@@ -354,22 +364,37 @@ class LLM:
         quantize_model_params(self.model, cfg.quantization)
         if cfg.offload:
             self.model.params = _maybe_offload_params(self.model.params)
+        if kv_layout == "paged" and kv_page_budget_bytes is None:
+            raise ValueError(
+                "kv_layout='paged' needs kv_page_budget_bytes: the "
+                "frame pool IS the budget (physical HBM, not "
+                "accounting)")
         self.im = InferenceManager(cfg)
         self.model_id = self.im.compile_model_and_allocate_buffer(
             self.model, mode=mode, max_requests=max_requests_per_batch,
             max_seq_length=max_seq_length, cache_dtype=cache_dtype,
-            kv_cache_dtype=kv_cache_dtype)
+            kv_cache_dtype=kv_cache_dtype, kv_layout=kv_layout,
+            kv_page_len=kv_page_len,
+            kv_frame_budget_bytes=(kv_page_budget_bytes
+                                   if kv_layout == "paged" else None))
         pager = None
         if kv_page_budget_bytes is not None:
             from ..serving.kv_pager import (RecoveryPolicy,
-                                            pager_for_budget)
+                                            pager_for_budget,
+                                            pager_for_record)
 
-            pager = pager_for_budget(
-                kv_page_budget_bytes,
-                self.im.kv_cache_stats(self.model_id).bytes_per_token,
-                page_len=kv_page_len,
-                policy=RecoveryPolicy.for_record(
-                    self.im, self.model_id, mode=kv_spill_policy))
+            if kv_layout == "paged":
+                # physical pool: the pager owns the record's concrete
+                # frames (budget == the allocated pool)
+                pager = pager_for_record(self.im, self.model_id,
+                                         mode=kv_spill_policy)
+            else:
+                pager = pager_for_budget(
+                    kv_page_budget_bytes,
+                    self.im.kv_cache_stats(self.model_id).bytes_per_token,
+                    page_len=kv_page_len,
+                    policy=RecoveryPolicy.for_record(
+                        self.im, self.model_id, mode=kv_spill_policy))
         self.rm = RequestManager(
             max_requests_per_batch=max_requests_per_batch,
             max_tokens_per_batch=max_tokens_per_batch,

@@ -52,6 +52,19 @@ def cache_pspec(sp: int, tp: int) -> PartitionSpec:
                          AXIS_SEQ if sp > 1 else None, None)
 
 
+def paged_cache_pspec(sp: int, tp: int) -> PartitionSpec:
+    """The PAGED frame-pool layout [num_frames, kv_heads, page_len,
+    head_dim]: frames replace the global length axis, so 'sp' has no
+    length to shard — both tp and sp shard the KV-HEAD axis (heads are
+    independent; the page tables replicate).  The frame and in-page
+    axes stay unsharded: frame ids are data, and a page is the kernels'
+    RMW/tile granule."""
+    axes = tuple(a for a, d in ((AXIS_MODEL, tp), (AXIS_SEQ, sp))
+                 if d > 1)
+    head = axes[0] if len(axes) == 1 else (axes or None)
+    return PartitionSpec(None, head, None, None)
+
+
 def scale_pspec(spec: PartitionSpec) -> PartitionSpec:
     """The [rows, kv_heads, length] KV-scale layout (int8 caches):
     exactly the cache spec minus the head_dim axis, so scales shard
@@ -123,6 +136,41 @@ def _param_pspecs(model) -> Dict[str, Dict[str, PartitionSpec]]:
                 lspec[ps.name] = PartitionSpec(*([None] * len(ps.shape)))
         specs[layer.name] = lspec
     return specs
+
+
+def resolve_cache_dtype(cfg, cache_dtype=None,
+                        kv_cache_dtype: Optional[str] = None):
+    """The KV storage dtype compile resolves from its three knobs
+    (raw ``cache_dtype`` > ``kv_cache_dtype`` tag > FFConfig default)
+    — shared with pre-compile sizing (paged pool budgets)."""
+    kv_cache_dtype = kv_cache_dtype or getattr(cfg, "kv_cache_dtype",
+                                               None)
+    if kv_cache_dtype not in (None, "bf16", "int8"):
+        raise ValueError(
+            f"kv_cache_dtype={kv_cache_dtype!r}: expected 'bf16' or "
+            f"'int8'")
+    if kv_cache_dtype == "int8" and cache_dtype is None:
+        cache_dtype = jnp.int8
+    return jnp.dtype(cache_dtype or jnp.dtype(cfg.computation_dtype))
+
+
+def estimate_kv_bytes_per_token(model, cache_dtype) -> int:
+    """Per-attended-position KV stream bytes across the model's
+    serving-attention layers at ``cache_dtype`` storage (K + V, plus
+    the f32 scales of int8 caches) — KVCacheStats.bytes_per_token
+    WITHOUT allocating, so paged frame pools can be sized from a byte
+    budget before compile."""
+    dt = jnp.dtype(cache_dtype)
+    per = 0
+    for layer in model.layers:
+        if layer.op_type in SERVING_ATTENTION_OPS:
+            a = layer.attrs
+            kvh = a["num_kv_heads"]
+            d = a.get("head_dim") or a["embed_dim"] // a["num_q_heads"]
+            per += kvh * d * 2 * dt.itemsize
+            if dt.itemsize == 1:
+                per += kvh * 2 * 4      # f32 k/v scale frames
+    return per
 
 
 def prune_spec(spec: PartitionSpec, mesh) -> PartitionSpec:
@@ -222,6 +270,9 @@ def _record_flash_tile(record) -> int:
     Sharded records count the PER-SHARD cache extent — that is what the
     kernel sees inside shard_map."""
     tile = record.get("_flash_tile")
+    if tile is None and record.get("paged"):
+        # paged kernels tile the cache by whole frames
+        tile = record["_flash_tile"] = record["page_len"]
     if tile is None:
         from ..kernels.flash_decode import _pick_ts, mesh_axes
 
@@ -251,11 +302,17 @@ def record_flash_ok(record, C: int) -> bool:
     caches = record.get("caches") or {}
     if not caches:
         return False
+    mesh = record.get("mesh")
+    if record.get("paged"):
+        from ..kernels.flash_decode import paged_path_ok
+        from ..kernels.flash_prefill import paged_prefill_path_ok
+
+        gate = paged_path_ok if C == 1 else paged_prefill_path_ok
+        return all(gate(C, kv["k"], mesh) for kv in caches.values())
     from ..kernels.flash_decode import flash_path_ok
     from ..kernels.flash_prefill import prefill_path_ok
 
     gate = flash_path_ok if C == 1 else prefill_path_ok
-    mesh = record.get("mesh")
     return all(gate(C, kv["k"], mesh) for kv in caches.values())
 
 
@@ -494,7 +551,10 @@ class InferenceManager:
             max_requests: int = 16, max_seq_length: int = 1024,
             prefill_chunk: int = 256, beam_width: int = 1,
             cache_dtype=None, kv_cache_dtype: Optional[str] = None,
-            model_id: Optional[int] = None) -> int:
+            model_id: Optional[int] = None,
+            kv_layout: Optional[str] = None, kv_page_len: int = 64,
+            kv_num_frames: Optional[int] = None,
+            kv_frame_budget_bytes: Optional[int] = None) -> int:
         """Returns a model_id handle.  reference: inference_manager.cc:81.
 
         ``kv_cache_dtype``: "bf16" (the computation dtype — bit-identical
@@ -504,6 +564,21 @@ class InferenceManager:
         FFConfig's ``kv_cache_dtype``; ``cache_dtype`` (a raw dtype)
         still overrides the storage dtype directly — ``jnp.int8`` there
         selects the quantized layout too (rewiden_beam round-trips it).
+
+        ``kv_layout``: "dense" (default — per-row ``[R, KV, S, D]``
+        slabs) or "paged" (PR 10): K/V live in a GLOBAL frame pool
+        ``[num_frames, KV, page_len, D]`` per layer (+ ``[F, KV,
+        page_len]`` f32 scale frames for int8) and every step reads a
+        per-row ``page_table`` int32 ``[rows, max_pages]`` mapping
+        logical pages to frames — HBM residency is leased frames, not
+        ``rows x max_seq``.  ``kv_num_frames`` sizes the pool (default
+        ``rows * max_pages``, the dense-equivalent identity layout that
+        needs no pager; a KVPager with ``num_frames`` drives smaller
+        pools).  Paged records require beam_width == 1 (beam-parent
+        cache gathers would alias frames mid-step) and pp == 1 (stage
+        row-group slicing assumes row-major slabs); ``kv_page_len``
+        must be a multiple of 32 (lcm of the 16-aligned flash-prefill
+        chunk-start invariant and the 32-wide int8 RMW window).
         """
         cfg = model.config
         tp = cfg.tensor_parallelism_degree
@@ -511,16 +586,8 @@ class InferenceManager:
         sp = cfg.sequence_parallelism_degree
         # shared prelude (both execution modes)
         rows = max_requests * beam_width
-        kv_cache_dtype = kv_cache_dtype or getattr(cfg, "kv_cache_dtype",
-                                                   None)
-        if kv_cache_dtype not in (None, "bf16", "int8"):
-            raise ValueError(
-                f"kv_cache_dtype={kv_cache_dtype!r}: expected 'bf16' or "
-                f"'int8'")
-        if kv_cache_dtype == "int8" and cache_dtype is None:
-            cache_dtype = jnp.int8
-        cache_dtype = jnp.dtype(cache_dtype
-                                or jnp.dtype(cfg.computation_dtype))
+        cache_dtype = resolve_cache_dtype(cfg, cache_dtype,
+                                          kv_cache_dtype)
         kv_quantized = cache_dtype == jnp.dtype(jnp.int8)
         # slack tail: a mixed decode/prefill batch scatters a full chunk at
         # each row's depth; rows near max_seq_length would otherwise have
@@ -536,6 +603,32 @@ class InferenceManager:
         # 128), so the flash append's RMW windows are 32 positions wide.
         m = (32 if kv_quantized else 16) * sp
         alloc_len = -(-alloc_len // m) * m
+        paged = kv_layout == "paged"
+        if kv_layout not in (None, "dense", "paged"):
+            raise ValueError(
+                f"kv_layout={kv_layout!r}: expected 'dense' or 'paged'")
+        if paged:
+            from .kv_pager import PAGE_ALIGN
+
+            if kv_page_len % PAGE_ALIGN:
+                raise ValueError(
+                    f"kv_page_len={kv_page_len} must be a multiple of "
+                    f"{PAGE_ALIGN} (16-aligned chunk starts AND the "
+                    f"32-wide int8 RMW window)")
+            if beam_width != 1:
+                raise ValueError(
+                    "kv_layout='paged' requires beam_width == 1: the "
+                    "beam-parent cache gather would alias frames "
+                    "between sibling rows mid-step (draft SSMs stay "
+                    "dense)")
+            if pp > 1:
+                raise ValueError(
+                    "kv_layout='paged' is not wired through pipeline "
+                    "stage row-group slicing yet — pp records keep "
+                    "dense slabs (with pager accounting + spill)")
+            # a page is the kernels' RMW/tile granule, so the logical
+            # row length rounds to whole pages
+            alloc_len = -(-alloc_len // kv_page_len) * kv_page_len
         if model.params is None:
             model.params = model.init_params(jax.random.PRNGKey(cfg.seed))
 
@@ -597,8 +690,27 @@ class InferenceManager:
         # them, so >100k-token contexts spread over the sp group.
         caches = {}
         cache_sharding = scale_sharding = None
+        max_pages = num_frames = None
+        if paged:
+            max_pages = alloc_len // kv_page_len
+            if kv_num_frames is None and kv_frame_budget_bytes is not None:
+                # size the pool from a byte budget (serve.LLM.compile's
+                # kv_page_budget_bytes / the bench's fixed-HBM arm):
+                # never below one full row — forward progress
+                frame_bytes = kv_page_len * max(
+                    1, estimate_kv_bytes_per_token(model, cache_dtype))
+                kv_num_frames = max(
+                    max_pages, int(kv_frame_budget_bytes) // frame_bytes)
+            num_frames = int(kv_num_frames or rows * max_pages)
+            if num_frames < max_pages:
+                raise ValueError(
+                    f"kv_num_frames={num_frames} < max_pages="
+                    f"{max_pages}: one full-length row must always fit "
+                    f"the pool (forward progress)")
         if mesh is not None:
-            cache_sharding = NamedSharding(mesh, cache_pspec(sp, tp))
+            spec = (paged_cache_pspec(sp, tp) if paged
+                    else cache_pspec(sp, tp))
+            cache_sharding = NamedSharding(mesh, spec)
             scale_sharding = NamedSharding(mesh,
                                            scale_pspec(cache_sharding.spec))
         for layer in model.layers:
@@ -606,7 +718,15 @@ class InferenceManager:
                 a = layer.attrs
                 kv = a["num_kv_heads"]
                 d = a.get("head_dim") or a["embed_dim"] // a["num_q_heads"]
-                shape = (rows, kv, alloc_len, d)
+                if paged and kv % max(1, tp * sp):
+                    raise ValueError(
+                        f"kv_layout='paged': layer {layer.name} has "
+                        f"{kv} kv heads, not divisible by the tp*sp "
+                        f"head-shard group {tp * sp} (paged pools "
+                        f"shard frames on the KV-head axis; sp has no "
+                        f"length axis to shard)")
+                shape = ((num_frames, kv, kv_page_len, d) if paged
+                         else (rows, kv, alloc_len, d))
                 k = jnp.zeros(shape, cache_dtype)
                 v = jnp.zeros(shape, cache_dtype)
                 if cache_sharding is not None:
@@ -618,7 +738,7 @@ class InferenceManager:
                     # int8 K/V (zero scale => unwritten positions
                     # dequantize to 0, matching a zeroed bf16 cache)
                     for part in ("k_scale", "v_scale"):
-                        s = jnp.zeros((rows, kv, alloc_len), jnp.float32)
+                        s = jnp.zeros(shape[:3], jnp.float32)
                         if scale_sharding is not None:
                             s = jax.device_put(s, scale_sharding)
                         caches[layer.name][part] = s
@@ -631,6 +751,25 @@ class InferenceManager:
                       alloc_len=alloc_len, kv_quantized=kv_quantized,
                       cache_pspec=(cache_sharding.spec
                                    if cache_sharding is not None else None))
+        if paged:
+            # the identity table is the pager-less default: frame
+            # r*max_pages + p backs row r's page p, so a full pool
+            # behaves exactly like the dense layout (tests and direct
+            # im users need no pager).  A RequestManager with a
+            # physical KVPager overwrites it via set_page_table.
+            if num_frames == rows * max_pages:
+                table = np.arange(rows * max_pages,
+                                  dtype=np.int32).reshape(rows, max_pages)
+                leased = num_frames
+            else:
+                # pager-driven pools start with every page UNLEASED:
+                # the out-of-range sentinel makes stray writes drop
+                # instead of landing in frame 0
+                table = np.full((rows, max_pages), num_frames, np.int32)
+                leased = 0
+            record.update(paged=True, page_len=int(kv_page_len),
+                          max_pages=max_pages, num_frames=num_frames,
+                          page_table=table, leased_frames=leased)
         self.models[mid] = record
         self._g_cache_bytes.set(
             self.kv_cache_stats(mid).bytes_resident, model=mid)
@@ -797,6 +936,10 @@ class InferenceManager:
         the weights."""
         model = record["model"]
         input_names = [t.name for t in model.input_tensors]
+
+        assert not (reorder and record.get("paged")), (
+            "beam-parent reorder on a paged record: the row gather "
+            "would alias frames — compile draft SSMs dense")
 
         def step(params, caches, batch, rng):
             if reorder:  # beam-parent cache shuffle (spec decoding)
@@ -988,6 +1131,12 @@ class InferenceManager:
                 f"Compile with prefill_chunk >= the RequestManager's "
                 f"max_tokens_per_batch.")
         batch = _feed_arrays(bc.pack())
+        if record.get("paged"):
+            # the per-row page table rides the batch as DATA (int32
+            # [rows, max_pages], fixed shape) — table contents change
+            # per step without retracing
+            batch["page_table"] = _feed_array(record["page_table"],
+                                              jnp.int32)
         reorder = parent_rows is not None
         if reorder:
             batch["parent_rows"] = _feed_array(parent_rows)
@@ -1019,8 +1168,10 @@ class InferenceManager:
         # (pruned-but-cycled grid steps are not free).  Sharded records
         # take it ONLY on flash prefill steps — the XLA slice is skipped
         # under a mesh (it would reshard), so other sharded variants
-        # would fork identical compiles
-        if record["mesh"] is None:
+        # would fork identical compiles.  PAGED records take it always:
+        # the bound becomes how many table columns the dense-view
+        # gather reads (the frame axis is unsharded, so no resharding)
+        if record["mesh"] is None or record.get("paged"):
             attend_len = attend_bucket(bc, bc.chunk, record["alloc_len"])
         else:
             attend_len = (attend_bucket(bc, bc.chunk,
@@ -1077,6 +1228,9 @@ class InferenceManager:
             return pipeline_decode_block(self, record, model_id, bc, k,
                                          rng, init_tokens)
         batch = _feed_arrays(bc.pack())
+        if record.get("paged"):
+            batch["page_table"] = _feed_array(record["page_table"],
+                                              jnp.int32)
         include_init = init_tokens is not None
         if init_tokens is None:
             init_tokens = batch["token_ids"][:, 0]
@@ -1084,7 +1238,8 @@ class InferenceManager:
         # the final depth); pow2 bucketing keeps the jit-variant count low;
         # ragged batches dispatch attention to the flash kernel
         attend_len = (attend_bucket(bc, k + 1, record["alloc_len"])
-                      if record["mesh"] is None else None)
+                      if record["mesh"] is None or record.get("paged")
+                      else None)
         use_flash = self._pick_kernel_path(record, bc, 1, span=k + 1)
         key = ("block", k, include_init, attend_len, use_flash)
         if key not in record["steps"]:
@@ -1174,13 +1329,188 @@ class InferenceManager:
             record["steps"][key], record["caches"],
             _feed_array(np.int32(src_row)), _feed_array(np.int32(dst_row)))
 
+    # ----------------------------------------------------- physical pages
+    def is_paged(self, model_id: int) -> bool:
+        """True when the record stores K/V in a global frame pool read
+        through per-row page tables (``kv_layout='paged'``)."""
+        return bool(self.models[model_id].get("paged"))
+
+    def set_page_table(self, model_id: int, table) -> None:
+        """Install the record's page table (int32 ``[rows, max_pages]``
+        — the RequestManager pushes it from the pager's leases after
+        every lease mutation) and refresh the resident-bytes gauge.
+        ``leased_frames`` is derived from the attached pager when one
+        pushed the table; identity tables count the whole pool."""
+        record = self.models[model_id]
+        assert record.get("paged"), "set_page_table: record is dense"
+        table = np.asarray(table, np.int32)
+        assert table.shape == (record["rows"], record["max_pages"]), (
+            table.shape, (record["rows"], record["max_pages"]))
+        record["page_table"] = table
+
+    def note_leased_frames(self, model_id: int, leased: int) -> None:
+        """Record how many pool frames are currently referenced (the
+        pager's ``leased_pages`` in physical mode) — what
+        ``kv_cache_stats`` reports as resident bytes."""
+        record = self.models[model_id]
+        record["leased_frames"] = int(leased)
+        self._g_cache_bytes.set(
+            self.kv_cache_stats(model_id).bytes_resident, model=model_id)
+
+    @staticmethod
+    def _pow2_pages(n: int, max_pages: int) -> int:
+        """Frame-count bucket for spill/restore transfers (whole-frame
+        pow2 ladder, floor 1 — pages are coarse already)."""
+        p = 1
+        while p < n:
+            p *= 2
+        return min(p, max_pages)
+
+    def _build_fetch_frames(self, record, P: int):
+        """Jitted (NOT donated) gather of ``P`` whole frames from every
+        layer's pool — rank-agnostic: 4-D K/V pools and 3-D scale pools
+        both gather on the leading frame axis."""
+
+        def fetch(caches, frames):
+            return jax.tree.map(lambda c: c[frames], caches)
+
+        return jax.jit(fetch)
+
+    def _build_restore_frames(self, record, P: int):
+        """Jitted, donated scatter of ``P`` fetched frames into the
+        pools at a dynamic frame-id vector (pad entries carry the
+        out-of-range sentinel ``num_frames`` and drop)."""
+
+        def restore(caches, seg, frames):
+            out = jax.tree.map(
+                lambda c, s: c.at[frames].set(s.astype(c.dtype),
+                                              mode="drop"),
+                caches, seg)
+            if record.get("cache_pspec") is not None:
+                out = pin_cache_layout(out, record["mesh"],
+                                       record["cache_pspec"])
+            return out
+
+        return jax.jit(restore, donate_argnums=(0,))
+
+    def _fetch_row_paged(self, record, row: int, length: int):
+        """Whole-frame spill fetch: the row's leased frames (from the
+        page table) materialize to host in one bucketed transfer."""
+        page_len = record["page_len"]
+        pages = -(-int(length) // page_len)
+        P = self._pow2_pages(pages, record["max_pages"])
+        frames = np.zeros(P, np.int32)
+        frames[:pages] = record["page_table"][row, :pages]
+        key = ("fetch_frames", P)
+        if key not in record["steps"]:
+            record["steps"][key] = self._build_fetch_frames(record, P)
+        seg = _retry_transient(record["steps"][key], record["caches"],
+                               _feed_array(frames, jnp.int32))
+        host = jax.tree.map(np.asarray, jax.device_get(seg))
+        self.note_host_sync()
+        nbytes = sum(int(a.nbytes) for lp in host.values()
+                     for a in lp.values())
+        return {"layers": host, "len": P * page_len,
+                "valid": int(length), "bytes": nbytes, "paged": True,
+                "pages": pages}
+
+    def _restore_row_paged(self, record, row: int,
+                           payload: Dict[str, Any]) -> int:
+        """Whole-frame restore into the DESTINATION row's current
+        frames (any frames — admission leased them before calling)."""
+        page_len = record["page_len"]
+        P = payload["len"] // page_len
+        pages = min(payload.get("pages",
+                                -(-payload["valid"] // page_len)), P)
+        dst = np.full(P, record["num_frames"], np.int32)   # pad -> drop
+        dst[:pages] = record["page_table"][row, :pages]
+        key = ("restore_frames", P)
+        if key not in record["steps"]:
+            record["steps"][key] = self._build_restore_frames(record, P)
+        seg = jax.tree.map(_feed_array, payload["layers"])
+        record["caches"] = _retry_transient(
+            record["steps"][key], record["caches"], seg,
+            _feed_array(dst, jnp.int32))
+        return int(payload["bytes"])
+
+    # -------------------------------------------------------- pp KV spill
+    def _pp_stage_cache_names(self, record) -> List[List[str]]:
+        """Per-stage lists of cache layer names (each stage's caches
+        live on its own submesh, so row transfers run stage by
+        stage — one jitted fetch/restore per (stage, bucket))."""
+        return [[l.name for l in ls if l.name in record["caches"]]
+                for ls in record["pp_stages"]]
+
+    def _fetch_row_pp(self, record, row: int, length: int):
+        """ROADMAP paged phase-2c: the pp half of the spill path.  The
+        row's first ``length`` positions materialize per stage (each
+        stage's caches are a separate device assignment — one jitted
+        slice per stage, one combined host payload), so pp-served rows
+        can spill-and-restore instead of always recomputing."""
+        L = pow2_bucket(length, record["alloc_len"]) or record["alloc_len"]
+        host: Dict[str, Any] = {}
+        for s, names in enumerate(self._pp_stage_cache_names(record)):
+            if not names:
+                continue
+            key = ("fetch_row_pp", s, L)
+            if key not in record["steps"]:
+                record["steps"][key] = self._build_fetch_row(record, L)
+            sub = {n: record["caches"][n] for n in names}
+            seg = _retry_transient(record["steps"][key], sub,
+                                   _feed_array(np.int32(row)))
+            host.update(jax.tree.map(np.asarray, jax.device_get(seg)))
+        if not host:
+            return None
+        self.note_host_sync()
+        nbytes = sum(int(a.nbytes) for lp in host.values()
+                     for a in lp.values())
+        return {"layers": host, "len": L, "valid": int(length),
+                "bytes": nbytes}
+
+    def _build_restore_row_pp(self, record, mesh, L: int):
+        """Per-stage donated row write (the pp twin of
+        _build_restore_row; the stage submesh pins the layout)."""
+
+        def restore(caches, seg, row):
+            def put(c, s):
+                # fflint: disable=retrace-hazard  rank dispatch over the
+                # record's FIXED cache pytree — one variant per record
+                if c.ndim == 3:
+                    return jax.lax.dynamic_update_slice(c, s, (row, 0, 0))
+                return jax.lax.dynamic_update_slice(c, s, (row, 0, 0, 0))
+
+            out = jax.tree.map(put, caches, seg)
+            return pin_cache_layout(out, mesh, record["pp_cache_spec"])
+
+        return jax.jit(restore, donate_argnums=(0,))
+
+    def _restore_row_pp(self, record, row: int,
+                        payload: Dict[str, Any]) -> int:
+        L = payload["len"]
+        for s, names in enumerate(self._pp_stage_cache_names(record)):
+            names = [n for n in names if n in payload["layers"]]
+            if not names:
+                continue
+            key = ("restore_row_pp", s, L)
+            if key not in record["steps"]:
+                record["steps"][key] = self._build_restore_row_pp(
+                    record, record["pp_meshes"][s], L)
+            sub = {n: record["caches"][n] for n in names}
+            seg = jax.tree.map(_feed_array,
+                               {n: payload["layers"][n] for n in names})
+            out = _retry_transient(record["steps"][key], sub, seg,
+                                   _feed_array(np.int32(row)))
+            record["caches"].update(out)
+        return int(payload["bytes"])
+
     # ------------------------------------------------------ paged KV spill
     def supports_kv_spill(self, model_id: int) -> bool:
-        """Row spill/restore needs the single-record cache layout (same
-        constraint as the prefix-row copy); stage-partitioned (pp)
-        caches live on per-stage submeshes the row transfers are not
-        wired through — pp-served rows preempt to recompute instead."""
-        return "pp_stages" not in self.models[model_id]
+        """Row spill/restore runs on every layout now: single-mesh
+        records move pow2-bucketed row slices, paged records move whole
+        frames, and stage-partitioned (pp) records move per-stage row
+        slices (ROADMAP paged phase-2c — pp rows spill instead of
+        always recomputing)."""
+        return bool(self.models[model_id].get("caches"))
 
     def model_param_bytes(self, model_id: int) -> Dict[str, int]:
         """{"elements", "bytes"} across the record's committed params —
@@ -1251,13 +1581,18 @@ class InferenceManager:
         under the prefix-cache over-copy argument — a later restore
         writes them back below the attended depth.  Returns
         ``{"layers": {layer: {part: np.ndarray}}, "len": bucket,
-        "valid": length, "bytes": n}`` or None for empty spans /
-        unsupported (pp) records.  One transfer batch — the whole tree
-        rides a single device_get."""
+        "valid": length, "bytes": n}`` or None for empty spans.
+        Paged records move WHOLE FRAMES through the row's page table
+        (pow2-bucketed frame counts, payload tagged ``paged``);
+        stage-partitioned (pp) records move per-stage row slices.
+        One transfer batch per device assignment."""
         record = self.models[model_id]
-        if ("pp_stages" in record or length <= 0
-                or not record.get("caches")):
+        if length <= 0 or not record.get("caches"):
             return None
+        if "pp_stages" in record:
+            return self._fetch_row_pp(record, row, length)
+        if record.get("paged"):
+            return self._fetch_row_paged(record, row, length)
         L = pow2_bucket(length, record["alloc_len"]) or record["alloc_len"]
         key = ("fetch_row", L)
         if key not in record["steps"]:
@@ -1277,9 +1612,12 @@ class InferenceManager:
         (the restore half of the KV pager; any row — restores need not
         land where the spill came from).  Returns the bytes moved."""
         record = self.models[model_id]
-        assert "pp_stages" not in record, (
-            "restore_row: pipeline-parallel records are not supported — "
-            "gate with supports_kv_spill")
+        if "pp_stages" in record:
+            return self._restore_row_pp(record, row, payload)
+        if record.get("paged"):
+            assert payload.get("paged"), (
+                "restore_row: dense payload into a paged record")
+            return self._restore_row_paged(record, row, payload)
         L = payload["len"]
         key = ("restore_row", L)
         if key not in record["steps"]:

@@ -45,15 +45,19 @@ Restore lengths align DOWN to 16 like prefix matches — the resumed
 prefill recomputes the unaligned tail.
 
 Shape stability (the zero-recompile contract): paging lives entirely
-in the allocator and the admission path.  The jitted decode/prefill
-steps never see a page table — rows stay dense device slabs, and
-spill/restore are separate bucketed transfers outside the decode
-loop, so ``TestRetraceGuard`` pins a warmed decode loop to ZERO
-compiles with the pager enabled.  The page budget is therefore an
-*accounting* bound over committed-KV bytes (what admission control
-and preemption need); physically freeing dense frames awaits a paged
-Mosaic attend kernel (docs/INTERNALS.md "Paged KV cache" notes the
-boundary honestly).
+in the allocator and the admission path.  Against a DENSE record the
+jitted decode/prefill steps never see a page table and the page budget
+is an *accounting* bound over committed-KV bytes; against a PAGED
+record (PR 10, ``kv_layout="paged"``) the pager additionally owns
+CONCRETE frame ids of the record's global frame pool
+(``num_frames``), and the per-row page table the jitted steps consume
+is pure int32 DATA of a fixed ``[rows, max_pages]`` shape — either
+way ``TestRetraceGuard``/``TestPagedRetraceGuard`` pin a warmed
+decode loop to ZERO compiles with the pager enabled.  Physical mode
+makes the budget real: HBM residency is ``leased_frames x
+frame_bytes``, spill/restore move whole frames, and a prefix-pool hit
+LEASES the donor's frames by refcount instead of copying rows
+(docs/INTERNALS.md "Paged KV cache — the page lifecycle").
 """
 
 from __future__ import annotations
@@ -89,10 +93,13 @@ class PageLease:
     time, so leases key by slot).  ``refs`` counts borrowers beyond the
     owner — a pooled entry pinned by in-flight admissions keeps its
     pages until released (the prefix pool's refcount rule, extended to
-    pages)."""
+    pages).  ``frames`` (physical pagers only) is the ordered list of
+    CONCRETE frame ids backing logical pages 0..pages-1 — frame ids
+    need not be contiguous or monotone (the free list fragments under
+    churn; the page-table kernels only ever see data)."""
 
     __slots__ = ("slot", "pages", "length", "owner", "guid", "refs",
-                 "last_use")
+                 "last_use", "frames")
 
     def __init__(self, slot: int, pages: int, length: int, owner: str,
                  guid: Optional[int]):
@@ -103,6 +110,7 @@ class PageLease:
         self.guid = guid
         self.refs = 0
         self.last_use = 0.0
+        self.frames: List[int] = []
 
 
 class RecoveryPolicy:
@@ -258,7 +266,9 @@ class KVPager:
                  policy: Optional[RecoveryPolicy] = None,
                  scheduler: Optional[PressureScheduler] = None,
                  bytes_per_token: int = 0,
-                 host_budget_bytes: Optional[int] = None):
+                 host_budget_bytes: Optional[int] = None,
+                 num_frames: Optional[int] = None,
+                 frame_order: Optional[List[int]] = None):
         if page_len % PAGE_ALIGN:
             raise ValueError(
                 f"page_len={page_len} must be a multiple of {PAGE_ALIGN} "
@@ -266,6 +276,30 @@ class KVPager:
                 f"starts and the 32-wide int8 RMW append window)")
         self.total_pages = max(1, int(total_pages))
         self.page_len = int(page_len)
+        #: PHYSICAL mode (PR 10): when set, leases own concrete frame
+        #: ids of an InferenceManager frame pool instead of a pure page
+        #: count — ``total_pages`` stays the admission BUDGET while
+        #: ``num_frames`` is the pool's physical capacity (>= budget;
+        #: the surplus is the forced-overcommit headroom that replaces
+        #: the dense slabs' implicit slack).  ``frame_order`` seeds the
+        #: free list (tests use it to force fragmented, out-of-order
+        #: frame ids; default ascending).
+        self.num_frames = int(num_frames) if num_frames else None
+        self._free_frames: List[int] = []
+        self._frame_refs: Dict[int, int] = {}
+        if self.num_frames is not None:
+            if self.num_frames < self.total_pages:
+                raise ValueError(
+                    f"num_frames={self.num_frames} < total_pages="
+                    f"{self.total_pages}: the physical pool must cover "
+                    f"the page budget")
+            order = (list(frame_order) if frame_order is not None
+                     else list(range(self.num_frames)))
+            assert sorted(order) == list(range(self.num_frames)), (
+                "frame_order must be a permutation of range(num_frames)")
+            # popped from the END: reversed so default allocation starts
+            # at frame 0 (pure convention — ids are opaque to kernels)
+            self._free_frames = list(reversed(order))
         self.policy = policy or RecoveryPolicy()
         self.scheduler = scheduler or PressureScheduler()
         #: bytes of committed KV per position (for budget<->bytes
@@ -294,11 +328,17 @@ class KVPager:
         self._recorder = get_flight_recorder()
         self._g_pages_total = m.gauge("serving_kv_pages_total")
         self._g_pages_free = m.gauge("serving_kv_pages_free")
+        self._g_frames_total = m.gauge("serving_kv_frames_total")
+        self._g_frames_free = m.gauge("serving_kv_frames_free")
         self._c_spill = m.counter("serving_kv_spill_bytes_total")
         self._c_restore = m.counter("serving_kv_restore_bytes_total")
         self._c_preempt = m.counter("serving_preemptions_total")
+        self._c_shared = m.counter("serving_prefix_frames_shared_total")
         self._g_pages_total.set(self.total_pages)
         self._g_pages_free.set(self.total_pages)
+        if self.num_frames is not None:
+            self._g_frames_total.set(self.num_frames)
+            self._g_frames_free.set(len(self._free_frames))
         _LIVE_PAGERS.add(self)
 
     # ------------------------------------------------------------ leases
@@ -326,6 +366,10 @@ class KVPager:
             have = self.leases[slot].pages if slot in self.leases else 0
             need = pages_for(length, self.page_len) - have
             free = self.total_pages - self.leased_pages
+            if self.num_frames is not None:
+                # physical mode: the free LIST is the hard bound (the
+                # budget may be overcommitted by forced bookings)
+                free = min(free, len(self._free_frames))
             return max(0, need - max(0, free))
 
     def lease(self, slot: int, length: int, owner: str = "req",
@@ -333,9 +377,13 @@ class KVPager:
         """Adjust ``slot``'s page count to cover ``length`` positions.
         Returns False (state unchanged) when growth exceeds the free
         pool and ``force`` is not set; ``force=True`` books the overage
-        anyway (forward-progress guarantee mid-decode-block — the dense
-        allocation physically has the space; the overcommit is counted
-        and trued up by preemption at the next fold boundary)."""
+        anyway (forward-progress guarantee mid-decode-block: accounting
+        pagers have the dense slabs' physical space behind them, and
+        physical pagers carry ``num_frames - total_pages`` headroom
+        frames for exactly this).  A PHYSICAL pager additionally fails
+        even under ``force`` when the frame free list itself runs dry —
+        there is no byte of HBM left to book; the caller must preempt
+        (``RequestManager.pager_sync_leases`` does)."""
         with self._lock:
             lease = self.leases.get(slot)
             have = lease.pages if lease is not None else 0
@@ -344,18 +392,50 @@ class KVPager:
             if grow > 0 and not force and (
                     self.leased_pages + grow > self.total_pages):
                 return False
+            if self.num_frames is not None and grow > len(
+                    self._free_frames):
+                return False           # physically out of frames
             if lease is None:
                 lease = self.leases[slot] = PageLease(
                     slot, 0, 0, owner, guid)
+            if self.num_frames is not None:
+                if grow > 0:
+                    for _ in range(grow):
+                        f = self._free_frames.pop()
+                        self._frame_refs[f] = 1
+                        lease.frames.append(f)
+                elif grow < 0:
+                    for _ in range(-grow):
+                        self._unref_frame(lease.frames.pop())
+                self.leased_pages = len(self._frame_refs)
+            else:
+                self.leased_pages += grow
             lease.pages = want
             lease.length = int(length)
             lease.owner = owner
             lease.guid = guid
             lease.last_use = time.monotonic()
-            self.leased_pages += grow
+            self._set_free_gauges()
+            return True
+
+    def _unref_frame(self, f: int) -> None:
+        """Drop one reference on frame ``f``; a frame nobody references
+        returns to the free list.  Callers already hold ``_lock`` —
+        re-acquiring the RLock here keeps the helper safe standalone."""
+        with self._lock:
+            rc = self._frame_refs.get(f, 0) - 1
+            if rc <= 0:
+                self._frame_refs.pop(f, None)
+                self._free_frames.append(f)
+            else:
+                self._frame_refs[f] = rc
+
+    def _set_free_gauges(self) -> None:
+        with self._lock:
             self._g_pages_free.set(
                 max(0, self.total_pages - self.leased_pages))
-            return True
+            if self.num_frames is not None:
+                self._g_frames_free.set(len(self._free_frames))
 
     def release(self, slot: int) -> int:
         """Free a slot's pages; returns the page count released."""
@@ -363,10 +443,78 @@ class KVPager:
             lease = self.leases.pop(slot, None)
             if lease is None:
                 return 0
-            self.leased_pages -= lease.pages
-            self._g_pages_free.set(
-                max(0, self.total_pages - self.leased_pages))
+            if self.num_frames is not None:
+                for f in lease.frames:
+                    self._unref_frame(f)
+                self.leased_pages = len(self._frame_refs)
+            else:
+                self.leased_pages -= lease.pages
+            self._set_free_gauges()
             return lease.pages
+
+    # ------------------------------------------------------------- frames
+    def frames_of(self, slot: int) -> List[int]:
+        """The ordered concrete frame ids backing ``slot``'s logical
+        pages (physical pagers; empty otherwise)."""
+        with self._lock:
+            lease = self.leases.get(slot)
+            return list(lease.frames) if lease is not None else []
+
+    def adopt_prefix(self, dst_slot: int, src_slot: int,
+                     n_pages: int) -> int:
+        """Frame-sharing prefix hit (the physical twin of the device
+        ``copy_prefix``): ``dst_slot``'s logical pages [0, n) become
+        refcounted borrows of ``src_slot``'s frames — no device copy,
+        no new frames, the donor's bytes serve both rows.  Only WHOLE
+        donor pages share (a partially-matched tail page would be
+        written by the borrower's resumed prefill, corrupting the
+        donor); the caller aligns the match down to a page boundary.
+        Returns the pages shared (0 when the source cannot serve).
+        ``dst_slot`` must not hold a lease yet (admission calls this
+        before the row's own lease)."""
+        with self._lock:
+            if self.num_frames is None:
+                return 0
+            src = self.leases.get(src_slot)
+            if src is None or n_pages <= 0:
+                return 0
+            n = min(int(n_pages), len(src.frames))
+            if n <= 0:
+                return 0
+            assert dst_slot not in self.leases, (
+                "adopt_prefix: destination slot already holds a lease",
+                dst_slot)
+            dst = self.leases[dst_slot] = PageLease(
+                dst_slot, n, n * self.page_len, "req", None)
+            for f in src.frames[:n]:
+                self._frame_refs[f] = self._frame_refs.get(f, 0) + 1
+                dst.frames.append(f)
+            dst.last_use = time.monotonic()
+            self.leased_pages = len(self._frame_refs)
+            self._set_free_gauges()
+        self._c_shared.inc(n)
+        return n
+
+    def frame_table(self, rows: int, max_pages: int,
+                    fill: Optional[int] = None) -> "Any":
+        """Pack every slot's lease into an int32 ``[rows, max_pages]``
+        page table (the device feed — tables are DATA, not shapes).
+        Slots without a lease, and pages past a lease's count, hold
+        ``fill`` — default ``num_frames``, the OUT-OF-RANGE sentinel:
+        reads there clip to a real frame but are masked by the
+        attend's depth guard, while writes are dropped by the scatter
+        guards (a row that outruns its lease corrupts nobody)."""
+        import numpy as np
+
+        if fill is None:
+            fill = self.num_frames or 0
+        with self._lock:
+            table = np.full((rows, max_pages), int(fill), np.int32)
+            for slot, lease in self.leases.items():
+                if 0 <= slot < rows and lease.frames:
+                    n = min(len(lease.frames), max_pages)
+                    table[slot, :n] = lease.frames[:n]
+            return table
 
     def acquire(self, slot: int):
         with self._lock:
@@ -447,10 +595,14 @@ class KVPager:
                 "bytes_per_token": self.bytes_per_token,
                 "budget_bytes": (self.total_pages * self.page_len
                                  * self.bytes_per_token),
+                "num_frames": self.num_frames,
+                "free_frames": (len(self._free_frames)
+                                if self.num_frames is not None else None),
                 "leases": [
                     {"slot": l.slot, "pages": l.pages,
                      "length": l.length, "owner": l.owner,
-                     "guid": l.guid, "refs": l.refs}
+                     "guid": l.guid, "refs": l.refs,
+                     "frames": list(l.frames)}
                     for l in self.leases.values()],
                 "spilled_guids": {g: {"tokens": s["tokens"],
                                       "bytes": s["bytes"]}
@@ -469,6 +621,7 @@ class KVPager:
             "enabled": True,
             "page_len": self.page_len,
             "total_pages": self.total_pages,
+            "num_frames": self.num_frames,
             "budget_bytes": (self.total_pages * self.page_len
                              * self.bytes_per_token),
             "spill_policy": self.policy.mode,
@@ -488,6 +641,28 @@ def pager_for_budget(budget_bytes: int, bytes_per_token: int,
                    **kwargs)
 
 
+def pager_for_record(im, model_id: int, mode: str = "auto",
+                     scheduler: Optional[PressureScheduler] = None,
+                     host_budget_bytes: Optional[int] = None,
+                     total_pages: Optional[int] = None) -> KVPager:
+    """The PHYSICAL pager matching a paged record: owns the record's
+    ``num_frames`` concrete frame ids (budget == the allocated pool
+    unless ``total_pages`` caps it lower), with the byte accounting
+    and recovery policy parameterized from the compiled record — the
+    ONE record->pager wiring, shared by serve.LLM.compile and the
+    bench's physical arm so their knobs cannot diverge."""
+    record = im.models[model_id]
+    assert record.get("paged"), (
+        "pager_for_record: record is dense — use pager_for_budget")
+    return KVPager(
+        total_pages or record["num_frames"],
+        page_len=record["page_len"],
+        num_frames=record["num_frames"],
+        bytes_per_token=im.kv_cache_stats(model_id).bytes_per_token,
+        policy=RecoveryPolicy.for_record(im, model_id, mode=mode),
+        scheduler=scheduler, host_budget_bytes=host_budget_bytes)
+
+
 def _selftest() -> int:
     """Pure-host allocator smoke (the run_tier1.sh pager gate): lease /
     release / refcount accounting, alignment validation, spill-store
@@ -503,6 +678,7 @@ def _selftest() -> int:
             print(f"kv_pager selftest FAILED: {msg}")
 
     try:
+        # fflint: disable=pallas-tiling  the misalignment IS the test
         KVPager(4, page_len=48)
         check(False, "page_len=48 accepted")
     except ValueError:
@@ -537,6 +713,33 @@ def _selftest() -> int:
     snap = p.snapshot()
     check(snap["total_pages"] == 8 and snap["leases"][0]["slot"] == 0,
           "snapshot shape")
+    # physical frame mode: concrete ids, refcounted sharing, hard cap
+    f = KVPager(4, page_len=64, num_frames=6,
+                frame_order=[5, 3, 1, 0, 2, 4])
+    check(f.lease(0, 130) and f.frames_of(0) == [5, 3, 1],
+          "frame alloc follows the seeded order")
+    check(f.leased_pages == 3 and f.free_pages == 1, "frame accounting")
+    check(f.adopt_prefix(2, 0, 2) == 2
+          and f.frames_of(2) == [5, 3]
+          and f.leased_pages == 3, "adopt shares without new frames")
+    check(f.lease(2, 3 * 64) and f.frames_of(2)[:2] == [5, 3]
+          and len(f.frames_of(2)) == 3, "borrower grows with own frames")
+    check(f.release(0) == 3 and f.leased_pages == 3,
+          "shared frames survive the donor release")
+    check(f.release(2) == 3 and f.leased_pages == 0
+          and f.free_pages == 4, "last ref frees")
+    check(f.lease(1, 6 * 64, force=True) and not f.lease(3, 64,
+                                                         force=True),
+          "force stops at the physical frame pool")
+    tab = f.frame_table(4, 8)
+    check(tab.shape == (4, 8) and list(tab[1][:6]) == f.frames_of(1)
+          and tab[0, 0] == f.num_frames, "frame_table packs leases "
+          "(unleased slots hold the out-of-range sentinel)")
+    try:
+        KVPager(8, page_len=64, num_frames=4)
+        check(False, "num_frames < total_pages accepted")
+    except ValueError:
+        pass
     if ok:
         print("kv_pager selftest OK")
     return 0 if ok else 1

@@ -252,6 +252,12 @@ class RequestManager:
         # pending tree-slot commit lists; preempting them recomputes)
         self._spill_ctx: Optional[Tuple[InferenceManager,
                                         Dict[int, int]]] = None
+        # (im, {model_id: row multiplier}) of the LAST admission pass —
+        # armed by admit_pending for every driver, so the physical
+        # page-table push (_push_tables) reaches the paged records of
+        # spec drivers too, whose rows never arm _spill_ctx
+        self._paged_ctx: Optional[Tuple[InferenceManager,
+                                        Dict[int, int]]] = None
         # prefill chunks must honor this floor (int8 flash-prefill needs
         # 32-divisible chunks); set per-driver from the serving record
         self._chunk_floor = 1
@@ -393,6 +399,11 @@ class RequestManager:
         # e.g. the pp spec loop) must not walk the tree: a guaranteed
         # miss would still skew hit_rate / tokens-saved and bump LRU
         serving = pool is not None and im is not None and bool(model_rows)
+        if im is not None and model_rows:
+            # remembered for the physical page-table push: every driver
+            # (incr AND the spec loops) passes through admission
+            self._check_paged_serving(im, model_rows)
+            self._paged_ctx = (im, dict(model_rows))
         if pager is not None:
             # true up page leases for growth since the last pass (the
             # spec drivers reach here once per macro-iteration; the
@@ -406,7 +417,12 @@ class RequestManager:
             have_row = bool(free) or (
                 pool is not None
                 and any(e.refs == 0 for e in pool.entries.values()))
-            short = (pager.shortfall(None, len(req.tokens))
+            # physical pagers admit against prompt + one dispatch of
+            # growth headroom — the admission lease books exactly this,
+            # and a gating/lease mismatch would admit rows the frame
+            # pool cannot actually back
+            need_len = len(req.tokens) + self._headroom_tokens()
+            short = (pager.shortfall(None, need_len)
                      if pager is not None else 0)
             if (not have_row or short) and pager is not None:
                 # reclaim order: pooled pages first (spilling a pool
@@ -418,9 +434,9 @@ class RequestManager:
                 # preempted victim re-enters at the queue FRONT, so an
                 # unbounded pass could ping-pong head and victim)
                 if im is not None:
-                    self._reclaim_pool_pages(im, len(req.tokens))
+                    self._reclaim_pool_pages(im, need_len)
                 else:
-                    while (pager.shortfall(None, len(req.tokens))
+                    while (pager.shortfall(None, need_len)
                            and pool is not None
                            and pool.evict_one() is not None):
                         pass
@@ -433,7 +449,7 @@ class RequestManager:
                         protect_guids=self._protected_guids())
                     if victim is not None and (
                             not have_row
-                            or pager.shortfall(None, len(req.tokens))):
+                            or pager.shortfall(None, need_len)):
                         self.preempt_request(victim, reason="admission")
                         admission_preempted = True
                         # the victim re-queued at the FRONT — restart
@@ -443,7 +459,7 @@ class RequestManager:
                 have_row = bool(free) or (
                     pool is not None
                     and any(e.refs == 0 for e in pool.entries.values()))
-                short = pager.shortfall(None, len(req.tokens))
+                short = pager.shortfall(None, need_len)
                 if short and not self.running and not (
                         pool is not None and pool.entries):
                     # nothing left to reclaim: a request bigger than
@@ -489,14 +505,54 @@ class RequestManager:
             if req.profile.admit_mono == 0.0:
                 req.profile.admit_mono = time.monotonic()
             self.running[row] = req
-            if pager is not None:
-                pager.lease(row, len(req.tokens), owner="req",
-                            guid=req.guid, force=True)
             matched: Dict[int, int] = {}
+            if (pager is not None and pager.num_frames is not None
+                    and spill is None and entry is not None and d
+                    and not inplace and entry.host is None
+                    and entry.slot is not None):
+                # physical paged records: a pooled-prefix hit LEASES
+                # the donor's whole pages by refcount instead of
+                # device-copying rows (the copy_prefix satellite) —
+                # zero bytes move, the shared frames serve both; only
+                # whole pages share (the borrower's resumed prefill
+                # writes the partial tail page).  Must run BEFORE the
+                # row's own lease: the shared frames become logical
+                # pages [0, n) and the lease below grows the tail.
+                for mid in (model_rows or {}):
+                    if not im.is_paged(mid):
+                        continue
+                    use = pool.usable(entry, mid, d, len(req.tokens),
+                                      dtype=im.cache_dtype_key(mid))
+                    pages = use // pager.page_len
+                    if pages <= 0:
+                        continue
+                    shared = pager.adopt_prefix(row, entry.slot, pages)
+                    if shared:
+                        matched[mid] = shared * pager.page_len
+            if pager is not None:
+                # physical pagers book one dispatch of growth headroom
+                # at admission too — a freshly (re)admitted row may go
+                # straight into a decode block, and its frames must be
+                # in the table BEFORE that dispatch (0 for accounting
+                # pagers: dense slabs absorb late bookings).  Headroom
+                # is optional (the next fold boundary re-books it);
+                # the committed length is NOT — retry without headroom
+                # if the free list cannot cover both
+                if not pager.lease(row,
+                                   len(req.tokens)
+                                   + self._headroom_tokens(),
+                                   owner="req", guid=req.guid,
+                                   force=True):
+                    pager.lease(row, len(req.tokens), owner="req",
+                                guid=req.guid, force=True)
+                # restores below read the DESTINATION row's table
+                self._push_tables()
             if spill is not None:
                 matched = self._restore_spilled(im, model_rows, req, row)
             elif entry is not None and d:
                 for mid, mult in (model_rows or {}).items():
+                    if mid in matched:
+                        continue          # frame-shared above
                     # dtype-key rule: a pooled row donated at another
                     # cache storage dtype (bf16 pool, int8 record after
                     # a recompile, or vice versa) is unusable — the row
@@ -527,7 +583,11 @@ class RequestManager:
                         # the entry's KV already lives in this slot's
                         # rows (cache_row == slot * mult) — zero copy
                         matched[mid] = use
-                    elif im is not None:
+                    elif im is not None and not im.is_paged(mid):
+                        # dense rows device-copy; paged records never
+                        # reach here — whole pages frame-share above,
+                        # and a sub-page match is a miss (copying rows
+                        # of a frame pool has no meaning)
                         src = entry.rows[mid][0]
                         im.copy_prefix(mid, src, row * mult, use)
                         matched[mid] = use
@@ -566,6 +626,69 @@ class RequestManager:
         return admitted
 
     # ------------------------------------------------------- paged KV
+    def _check_paged_serving(self, im: InferenceManager,
+                             model_rows) -> None:
+        """A small-pool paged record's table is pager-FED; serving it
+        without the matching physical pager would silently drop every
+        write on the sentinel entries — fail loudly instead."""
+        for mid in model_rows:
+            if not im.is_paged(mid):
+                continue
+            rec = im.models[mid]
+            if (rec["num_frames"] < rec["rows"] * rec["max_pages"]
+                    and (self.kv_pager is None
+                         or self.kv_pager.num_frames
+                         != rec["num_frames"])):
+                raise ValueError(
+                    f"model {mid} has a {rec['num_frames']}-frame "
+                    f"paged pool smaller than its worst case "
+                    f"({rec['rows']}x{rec['max_pages']}): serving it "
+                    f"requires a KVPager(num_frames="
+                    f"{rec['num_frames']}) to lease frames and push "
+                    f"page tables")
+
+    def _push_tables(self) -> None:
+        """Publish the physical pager's leases to every paged record's
+        device-visible page table (plus the leased-frame count the
+        residency stats report).  A pure numpy repack — the table is
+        DATA to the jitted steps, so pushing costs no compiles."""
+        pager = self.kv_pager
+        if (pager is None or pager.num_frames is None
+                or self._paged_ctx is None):
+            return
+        im, model_rows = self._paged_ctx
+        for mid in model_rows:
+            if not im.is_paged(mid):
+                continue
+            rec = im.models[mid]
+            im.set_page_table(
+                mid, pager.frame_table(rec["rows"], rec["max_pages"]))
+            im.note_leased_frames(mid, pager.leased_pages)
+
+    def _headroom_tokens(self) -> int:
+        """Physical pagers must hold a row's frames BEFORE the step
+        that writes them (there is no dense slab behind the table to
+        absorb a late booking), so every lease true-up books this many
+        tokens of growth PAST the committed length: a decode block's
+        appends (the handoff block included), or a spec macro-
+        iteration's tree scatter at [cached, cached + C).  Prefill
+        needs none — it only writes below ``len(tokens)``, which the
+        base lease already covers.  Kept tight on purpose: headroom is
+        pages BOOKED but not yet filled, so a loose bound (e.g. the
+        prefill chunk) would overdemand a page per row and thrash the
+        preemption loop."""
+        pager = self.kv_pager
+        if (pager is None or pager.num_frames is None
+                or self._paged_ctx is None):
+            return 0
+        im, model_rows = self._paged_ctx
+        if not any(im.is_paged(mid) for mid in model_rows):
+            return 0
+        if self.ssm_model_ids:
+            return 2 + max(self.decode_block,
+                           self.max_spec_tree_token_num)
+        return 2 + self.decode_block
+
     def _protected_guids(self) -> Tuple[int, ...]:
         """The earliest-admitted running request is never preempted —
         at least one row always runs to completion (no livelock)."""
@@ -632,6 +755,7 @@ class RequestManager:
         host-LRU): a resident entry's page lease dies with it."""
         if self.kv_pager is not None and entry.slot is not None:
             self.kv_pager.release(entry.slot)
+            self._push_tables()
 
     def _spill_pool_entry(self, im: InferenceManager, entry) -> bool:
         """Move a resident, unreferenced pool entry's KV to host RAM:
@@ -655,6 +779,7 @@ class RequestManager:
         slot = entry.slot
         pool.detach_slot(entry, host)
         pager.release(slot)
+        self._push_tables()
         pager.count_spill(total)
         pager.count_preemption("pool")
         self.tracer.instant("spill", slot=slot, tokens=entry.length,
@@ -684,22 +809,28 @@ class RequestManager:
             if pool.evict_one() is None:
                 break
 
-    def pager_sync_leases(self, preempt: bool = False, extra: int = 0):
+    def pager_sync_leases(self, preempt: bool = False, extra=0):
         """Lease every running row's pages to cover its committed
-        tokens (+``extra`` for an upcoming decode block).  With
-        ``preempt`` (the incr driver's fold boundary — the only point
-        where every row's host state is consistent mid-loop), shortage
-        preempts the lowest-priority other row; otherwise the overage
-        is force-booked (counted, trued up at the next boundary) —
-        never block the driver mid-dispatch."""
+        tokens (+``extra`` for an upcoming decode block; an int, or a
+        {row: extra} dict for per-row bounds — the device-spec epoch
+        lease books each row's OWN remaining budget, not the fleet
+        max).  With ``preempt`` (the incr driver's fold boundary — the
+        only point where every row's host state is consistent
+        mid-loop), shortage preempts the lowest-priority other row;
+        otherwise the overage is force-booked (counted, trued up at
+        the next boundary) — never block the driver mid-dispatch."""
         pager = self.kv_pager
         if pager is None or not self.running:
             return
+        # physical pagers book one dispatch's worth of growth AHEAD:
+        # the table must hold a frame before any step writes into it
+        headroom = self._headroom_tokens()
         for row in list(self.running):
             req = self.running.get(row)
             if req is None:
                 continue          # preempted by an earlier iteration
-            target = len(req.tokens) + extra
+            e = extra.get(row, 0) if isinstance(extra, dict) else extra
+            target = len(req.tokens) + max(e, headroom)
             if pager.lease(row, target, owner="req", guid=req.guid):
                 continue
             if preempt:
@@ -712,8 +843,38 @@ class RequestManager:
                     if victim is None:
                         break
                     self.preempt_request(victim, reason="pages")
-            pager.lease(row, target, owner="req", guid=req.guid,
-                        force=True)
+            if (not pager.lease(row, target, owner="req", guid=req.guid,
+                                force=True)
+                    and pager.num_frames is not None and preempt):
+                # a physical pager can run its FRAME pool dry (force
+                # books budget overage, never nonexistent HBM): at a
+                # fold boundary (``preempt`` — no batch in flight),
+                # free frames by preempting other rows, newest first;
+                # if nothing else holds frames the row itself
+                # re-queues (num_frames >= max_pages guarantees it
+                # runs alone).  At mid-dispatch sites the lease just
+                # fails: the already-built batch still references the
+                # victim's table rows, so preempting HERE would
+                # redirect its writes — the out-of-range table
+                # sentinel makes the (headroom-prevented) residual
+                # case drop writes instead of corrupting frames, and
+                # the next boundary trues up.
+                while not pager.lease(row, target, owner="req",
+                                      guid=req.guid, force=True):
+                    others = {r: q for r, q in self.running.items()
+                              if q is not req}
+                    victim = pager.scheduler.pick_victim(
+                        others, protect_guids=self._protected_guids())
+                    if victim is None:
+                        # only the protected row (or nobody) left to
+                        # take from: this row yields instead — the
+                        # forward-progress guarantee must hold in the
+                        # frame-dry path too, or two oversized rows
+                        # ping-pong spill/restore forever
+                        if self.running.get(row) is req:
+                            self.preempt_request(req, reason="pages")
+                        break
+                    self.preempt_request(victim, reason="pages")
         if preempt:
             # true up force-booked overage (decode-block growth books
             # pages mid-dispatch without preempting — a lease that
@@ -726,6 +887,7 @@ class RequestManager:
                 if victim is None:
                     break         # only protected rows left: overage
                 self.preempt_request(victim, reason="pages")
+        self._push_tables()
 
     def preempt_request(self, req: Request, reason: str,
                         mode: Optional[str] = None):
@@ -735,9 +897,12 @@ class RequestManager:
         (resume priority).  ``mode`` pins "spill"/"recompute"; default
         prices spill-then-restore against recompute via the pager's
         :class:`~flexflow_tpu.serving.kv_pager.RecoveryPolicy`.  Spill
-        needs the incr driver's linear cache layout (``_spill_ctx``);
-        spec/pp-served rows always recompute — their rows carry
-        pending tree-slot commit state no linear fetch can capture."""
+        needs a linear committed-KV row (``_spill_ctx`` — the incr
+        driver on single-mesh, PAGED and pp records alike: paged rows
+        move whole frames, pp rows per-stage slices — ROADMAP paged
+        phase-2c dropped the incr-single-mesh-only caveat); spec rows
+        still recompute — they carry pending tree-slot commit state no
+        linear fetch can capture."""
         pager = self.kv_pager
         row = req.row
         assert (row is not None and self.running.get(row) is req), (
@@ -787,6 +952,7 @@ class RequestManager:
         req.profile.preemptions += 1
         req.profile.preempt_mono = time.monotonic()
         self.pending.appendleft(req)        # resume priority
+        self._push_tables()
         pager.count_preemption(reason)
         self.tracer.instant("preempt", guid=req.guid, row=row,
                             reason=reason, mode=mode, tokens=spill_len)
@@ -916,6 +1082,7 @@ class RequestManager:
             else:
                 self.kv_pager.release(row)
             self.kv_pager.drop_spill(req.guid)
+            self._push_tables()
 
     # ------------------------------------------------------- cancellation
     def request_cancel(self, guid: int, reason: str = "client") -> None:
@@ -1171,6 +1338,12 @@ class RequestManager:
             if (self.kv_pager is not None
                 and im.supports_kv_spill(model_id)) else None)
         self._chunk_floor = im.min_prefill_chunk(model_id)
+        self._check_paged_serving(im, {model_id: 1})
+        if im.is_paged(model_id):
+            # the physical page-table push needs the (im, rows) context
+            # even when the spill path is off (pp keeps it armed via
+            # _spill_ctx anyway)
+            self._paged_ctx = (im, {model_id: 1})
         try:
             # heartbeat scope: the stall watchdog only declares a stall
             # while a driver loop is in flight (idle != stalled)

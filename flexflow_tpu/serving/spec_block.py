@@ -435,6 +435,12 @@ def build_spec_block(im, llm_id: int, ssm_ids, W: int, D: int,
             "commit_src": state["commit_src"],
             "commit_dst": state["commit_dst"],
         }
+        if "page_table" in state:
+            # paged LLM record: the table rides the device state as
+            # DATA for the whole fused epoch (leases were extended to
+            # the epoch's worst case before dispatch — the device loop
+            # cannot fault a frame in)
+            batch_v["page_table"] = state["page_table"]
         outs_v, llm_caches = llm_step(llm_params, state["llm_caches"],
                                       batch_v, rs[-1])
         greedy = outs_v[0].astype(jnp.int32)               # [R, C]
@@ -445,6 +451,8 @@ def build_spec_block(im, llm_id: int, ssm_ids, W: int, D: int,
         new["llm_caches"] = llm_caches
         new["ssm_caches"] = (new_ssm_caches[0] if N == 1
                              else tuple(new_ssm_caches))
+        if "page_table" in state:
+            new["page_table"] = state["page_table"]
         return new
 
     def block(llm_params, ssm_params_list, state, rng, k_limit):
@@ -626,6 +634,22 @@ def generate_spec_infer_device(rm, im, llm_id: int,
             states[req.guid] = st
         if not rm.running:
             break
+        if rm.kv_pager is not None and llm_record.get("paged"):
+            # physical frames for the WHOLE fused epoch: the device
+            # while_loop appends up to a row's remaining budget plus
+            # the tree span without returning to the host, so every
+            # frame it will write must be leased (and in the table)
+            # before dispatch — each row its OWN bound (a fleet-max
+            # would over-reserve frames near-finished rows can never
+            # write).  Preempting here is safe — the running set is
+            # captured below, after the true-up.
+            epoch = {
+                row: C + D + 2 + max(
+                    0, req.remaining_budget(rm.max_sequence_length))
+                for row, req in rm.running.items()}
+            rm.pager_sync_leases(preempt=True, extra=epoch)
+        if not rm.running:
+            break
         running = dict(rm.running)
 
         rng = _llm_prompt_prefill(rm, im, llm_id, running, states,
@@ -664,6 +688,9 @@ def generate_spec_infer_device(rm, im, llm_id: int,
             "speculated": np.zeros(R, np.int32),
             "llm_steps": np.zeros(R, np.int32),
         }
+        if llm_record.get("paged"):
+            st0["page_table"] = np.asarray(llm_record["page_table"],
+                                           np.int32)
         for row, req in running.items():
             st = states[req.guid]
             st0["llm_cached"][row] = st["llm_cached"]

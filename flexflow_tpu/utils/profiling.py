@@ -129,12 +129,24 @@ class KVCacheStats:
     alloc_len: int
     bytes_resident: int
     bytes_per_token: int
+    #: physical paging (kv_layout="paged"): K/V live in a global
+    #: [num_frames, KV, page_len, D] pool per layer, so residency is
+    #: ``frames_leased * frame_bytes`` (what the leases pin) rather
+    #: than the dense rows x alloc_len formula; ``pool_bytes`` is the
+    #: pool's full allocation (the hard HBM ceiling the operator sized)
+    paged: bool = False
+    page_len: int = 0
+    frames_total: int = 0
+    frames_leased: int = 0
+    frame_bytes: int = 0
+    pool_bytes: int = 0
 
     @classmethod
     def of_record(cls, record) -> "KVCacheStats":
         caches = record.get("caches") or {}
         resident = 0
         per_token = 0
+        frame_bytes = 0
         dtype = "none"
         for kv in caches.values():
             dtype = str(kv["k"].dtype)
@@ -146,6 +158,21 @@ class KVCacheStats:
                 per_pos = int(np.prod(arr.shape[1:2]
                                       + arr.shape[3:]))
                 per_token += per_pos * arr.dtype.itemsize
+                # paged pools: one frame of this part = everything
+                # past the leading frame axis
+                frame_bytes += (int(np.prod(arr.shape[1:]))
+                                * arr.dtype.itemsize)
+        if record.get("paged"):
+            leased = int(record.get("leased_frames", 0))
+            return cls(kv_cache_dtype=dtype, layers=len(caches),
+                       rows=record.get("rows", 0),
+                       alloc_len=record.get("alloc_len", 0),
+                       bytes_resident=leased * frame_bytes,
+                       bytes_per_token=per_token, paged=True,
+                       page_len=record.get("page_len", 0),
+                       frames_total=record.get("num_frames", 0),
+                       frames_leased=leased, frame_bytes=frame_bytes,
+                       pool_bytes=resident)
         return cls(kv_cache_dtype=dtype, layers=len(caches),
                    rows=record.get("rows", 0),
                    alloc_len=record.get("alloc_len", 0),

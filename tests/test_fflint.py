@@ -497,6 +497,52 @@ class TestPallasTilingRule:
             """, self.R, rel="serving/host.py")
         assert fs == []
 
+    # ------------------------------------------------ page_len (PR 10)
+    def test_page_len_literal_checked_everywhere(self, tmp_path):
+        # NOT a pallas module: the paged-KV frame-length invariant is
+        # consumed far from the kernels (pager ctors, compile kwargs)
+        fs = lint(tmp_path, """\
+            DEFAULT_PAGE_LEN = 48
+
+            def build(pager_cls):
+                page_len = 64                 # ok
+                pager_cls(page_len=page_len)
+                pager_cls(kv_page_len=40)     # bad literal kwarg
+            """, self.R, rel="serving/pager.py")
+        assert at(fs, "pallas-tiling", 1), fs   # bad module constant
+        assert at(fs, "pallas-tiling", 6), fs   # bad kwarg
+        assert len(fs) == 2
+
+    def test_page_len_cross_module_constant_folds(self, tmp_path):
+        # the ffshard ProjectGraph resolves the imported constant to
+        # its literal, so the CALL SITE is checked cross-module
+        fs = lint_tree(tmp_path, {
+            "consts.py": "OK_PAGE_LEN = 96\nBAD_PAGE_LEN = 80\n",
+            "use.py": """\
+                from consts import BAD_PAGE_LEN, OK_PAGE_LEN
+
+                def f(mk):
+                    mk(page_len=OK_PAGE_LEN)
+                    mk(page_len=BAD_PAGE_LEN)
+                """,
+        }, self.R)
+        pl_fs = [f for f in fs if f.rule == "pallas-tiling"]
+        # BAD_PAGE_LEN fires at its definition AND at the call site
+        assert any(f.path.endswith("consts.py") and f.line == 2
+                   for f in pl_fs), fs
+        assert any(f.path.endswith("use.py") and f.line == 5
+                   for f in pl_fs), fs
+        assert not any(f.line == 4 and f.path.endswith("use.py")
+                       for f in pl_fs), fs
+
+    def test_page_len_suppression(self, tmp_path):
+        fs = lint(tmp_path, """\
+            def f(mk):
+                # fflint: disable=pallas-tiling  misalignment is the test
+                mk(page_len=48)
+            """, self.R, rel="tests_fixture.py")
+        assert fs == []
+
     def test_suppression_silences(self, tmp_path):
         fs = lint(tmp_path, """\
             from jax.experimental.pallas import tpu as pltpu

@@ -1,0 +1,662 @@
+"""Physical paged KV tests (PR 10): page-table kernels + frame pools.
+
+The load-bearing promise extends PR 8's: paging may only change WHERE
+bytes live, never WHAT a request computes — greedy tokens must be
+bit-exact between dense slabs and physically-paged frame pools on every
+driver, for every table layout the allocator can produce (identity,
+scrambled, fragmented, shared).  And the tentpole's accounting claim
+becomes measurable: ``kv_cache_stats()`` residency equals
+``leased_frames x frame_bytes``, not the dense ``rows x alloc_len``
+formula.
+"""
+
+import numpy as np
+import pytest
+
+from flexflow_tpu import FFConfig, Model
+from flexflow_tpu.fftype import InferenceMode
+from flexflow_tpu.models.llama import LLAMAConfig, create_llama_model
+from flexflow_tpu.serving import InferenceManager, RequestManager
+from flexflow_tpu.serving.kv_pager import (KVPager, PressureScheduler,
+                                           RecoveryPolicy)
+
+TINY = dict(vocab_size=128, hidden_size=64, intermediate_size=128,
+            num_hidden_layers=2, num_attention_heads=4,
+            num_key_value_heads=2, max_position_embeddings=256)
+
+
+def _tiny_model(seed=0, max_requests=4, mode=InferenceMode.INC_DECODING,
+                ffcfg=None):
+    import jax
+
+    cfg = LLAMAConfig(**TINY)
+    model = Model(ffcfg or FFConfig(), name=f"pgphys_{mode.value}_{seed}")
+    create_llama_model(model, cfg, mode=mode, max_requests=max_requests)
+    model.params = model.init_params(jax.random.PRNGKey(seed))
+    return model, cfg
+
+
+def _prompts(n, length, vocab=127, seed=0):
+    rng = np.random.default_rng(seed)
+    return [rng.integers(1, vocab, length).tolist() for _ in range(n)]
+
+
+def _serve(im, mid, prompts, pager=None, rows=4, new_tokens=48,
+           decode_block=4, max_seq=256, prefix_cache=False):
+    rm = RequestManager(max_requests_per_batch=rows,
+                        max_tokens_per_batch=64,
+                        max_sequence_length=max_seq,
+                        decode_block=decode_block, kv_pager=pager,
+                        prefix_cache=prefix_cache)
+    reqs = [rm.register_new_request(list(p), max_new_tokens=new_tokens)
+            for p in prompts]
+    rm.generate_incr_decoding(im, mid, reqs)
+    return [r.tokens[r.prompt_len:] for r in reqs], reqs, rm
+
+
+# ------------------------------------------------------ frame allocator
+class TestFramePoolAllocator:
+    def test_frames_follow_seeded_order_and_refcounts(self):
+        p = KVPager(4, page_len=64, num_frames=6,
+                    frame_order=[5, 3, 1, 0, 2, 4])
+        assert p.lease(0, 130) and p.frames_of(0) == [5, 3, 1]
+        assert p.leased_pages == 3
+        # adopt: borrow the donor's first 2 whole pages by refcount
+        assert p.adopt_prefix(2, 0, 2) == 2
+        assert p.frames_of(2) == [5, 3] and p.leased_pages == 3
+        # borrower growth appends its OWN frames after the shared ones
+        assert p.lease(2, 3 * 64)
+        assert p.frames_of(2)[:2] == [5, 3]
+        assert len(p.frames_of(2)) == 3
+        # shared frames survive the donor's release; last ref frees
+        assert p.release(0) == 3 and p.leased_pages == 3
+        assert p.release(2) == 3 and p.leased_pages == 0
+
+    def test_force_stops_at_physical_pool(self):
+        p = KVPager(4, page_len=64, num_frames=6)
+        assert p.lease(0, 6 * 64, force=True)       # budget overcommit ok
+        assert not p.lease(1, 64, force=True)       # frames are HARD
+        assert p.shortfall(1, 64) == 1              # physical clamp
+        p.release(0)
+        assert p.lease(1, 64, force=True)
+
+    def test_frame_table_sentinel_and_validation(self):
+        p = KVPager(4, page_len=64, num_frames=4)
+        p.lease(1, 100)
+        tab = p.frame_table(3, 4)
+        assert tab.shape == (3, 4)
+        assert list(tab[1][:2]) == p.frames_of(1)
+        assert tab[0, 0] == 4 and tab[1, 2] == 4    # OOB sentinel
+        with pytest.raises(ValueError, match="physical pool"):
+            KVPager(8, page_len=64, num_frames=4)
+
+    def test_shrink_returns_tail_frames(self):
+        p = KVPager(4, page_len=64, num_frames=4)
+        p.lease(0, 200)                             # 4 pages
+        first = p.frames_of(0)[0]
+        assert p.lease(0, 30)                       # shrink to 1
+        assert p.frames_of(0) == [first]
+        assert p.leased_pages == 1
+
+
+# ------------------------------------------------------ compile guards
+class TestPagedCompileGuards:
+    def test_rejections(self):
+        model, _ = _tiny_model(seed=1)
+        im = InferenceManager(model.config)
+        with pytest.raises(ValueError, match="multiple of 32"):
+            im.compile_model_and_allocate_buffer(
+                model, max_requests=2, max_seq_length=128,
+                # fflint: disable=pallas-tiling  the misalignment IS the test
+                kv_layout="paged", kv_page_len=48)
+        with pytest.raises(ValueError, match="beam_width"):
+            im.compile_model_and_allocate_buffer(
+                model, max_requests=2, max_seq_length=128, beam_width=2,
+                kv_layout="paged")
+        with pytest.raises(ValueError, match="one full-length row"):
+            im.compile_model_and_allocate_buffer(
+                model, max_requests=2, max_seq_length=128,
+                kv_layout="paged", kv_num_frames=1)
+
+    def test_pp_paged_rejected(self):
+        ffcfg = FFConfig(pipeline_parallelism_degree=2)
+        model, _ = _tiny_model(seed=2, max_requests=2, ffcfg=ffcfg)
+        im = InferenceManager(ffcfg)
+        with pytest.raises(ValueError, match="pipeline"):
+            im.compile_model_and_allocate_buffer(
+                model, max_requests=2, max_seq_length=128,
+                kv_layout="paged")
+
+    def test_small_pool_without_physical_pager_rejected(self):
+        model, _ = _tiny_model(seed=3)
+        im = InferenceManager(model.config)
+        mid = im.compile_model_and_allocate_buffer(
+            model, max_requests=4, max_seq_length=256,
+            cache_dtype=np.float32, kv_layout="paged", kv_num_frames=10)
+        with pytest.raises(ValueError, match="requires a KVPager"):
+            _serve(im, mid, _prompts(1, 24))
+        # the matching physical pager is accepted
+        pager = KVPager(10, page_len=64, num_frames=10)
+        _serve(im, mid, _prompts(1, 24), pager=pager)
+
+
+# ---------------------------------------------------- driver parity
+class TestPagedParityIncr:
+    @pytest.fixture(scope="class")
+    def compiled(self):
+        model, _ = _tiny_model(seed=3)
+        im = InferenceManager(model.config)
+        mid_d = im.compile_model_and_allocate_buffer(
+            model, max_requests=4, max_seq_length=256,
+            cache_dtype=np.float32)
+        mid_p = im.compile_model_and_allocate_buffer(
+            model, max_requests=4, max_seq_length=256,
+            cache_dtype=np.float32, kv_layout="paged", kv_page_len=64)
+        mid_s = im.compile_model_and_allocate_buffer(
+            model, max_requests=4, max_seq_length=256,
+            cache_dtype=np.float32, kv_layout="paged", kv_page_len=64,
+            kv_num_frames=10)
+        prompts = _prompts(4, 24, seed=1)
+        base, _, _ = _serve(im, mid_d, prompts)
+        return im, mid_d, mid_p, mid_s, prompts, base
+
+    def test_identity_table_parity(self, compiled):
+        im, _, mid_p, _, prompts, base = compiled
+        got, _, _ = _serve(im, mid_p, prompts)
+        assert got == base
+
+    def test_fragmented_out_of_order_frames_parity(self, compiled):
+        # deliberately non-contiguous, out-of-order frame ids per row:
+        # a scrambled permutation table must decode bit-identically —
+        # frame ids are opaque data to the kernels
+        im, _, mid_p, _, prompts, base = compiled
+        rec = im.models[mid_p]
+        rng = np.random.default_rng(7)
+        perm = rng.permutation(rec["num_frames"])
+        im.set_page_table(
+            mid_p, perm[: rec["rows"] * rec["max_pages"]].reshape(
+                rec["rows"], rec["max_pages"]).astype(np.int32))
+        got, _, _ = _serve(im, mid_p, prompts)
+        assert got == base
+        # restore the identity for later tests
+        im.set_page_table(
+            mid_p, np.arange(rec["rows"] * rec["max_pages"],
+                             dtype=np.int32).reshape(
+                rec["rows"], rec["max_pages"]))
+
+    @pytest.mark.parametrize("mode", ["restore", "recompute"])
+    def test_physical_pager_preemption_parity(self, compiled, mode):
+        im, _, _, mid_s, prompts, base = compiled
+        rec = im.models[mid_s]
+        pager = KVPager(
+            6, page_len=64, num_frames=rec["num_frames"],
+            policy=RecoveryPolicy.for_record(im, mid_s, mode=mode),
+            scheduler=PressureScheduler(preempt_for_admission=False),
+            bytes_per_token=im.kv_cache_stats(mid_s).bytes_per_token)
+        got, reqs, _ = _serve(im, mid_s, prompts, pager=pager)
+        assert got == base
+        assert sum(pager.preemptions.values()) > 0, "paging never fired"
+        if mode == "restore":
+            assert pager.restore_bytes_total > 0
+            assert sum(r.profile.restored_tokens for r in reqs) > 0
+        else:
+            assert pager.restore_bytes_total == 0
+            assert sum(r.profile.recomputed_tokens for r in reqs) > 0
+        # no leaked frames: the pool drains back to fully free
+        assert pager.leased_pages == 0
+        assert len(pager._free_frames) == rec["num_frames"]
+
+    def test_fragmented_frame_order_with_pager_parity(self, compiled):
+        im, _, _, mid_s, prompts, base = compiled
+        rec = im.models[mid_s]
+        order = list(np.random.default_rng(11).permutation(
+            rec["num_frames"]))
+        pager = KVPager(
+            rec["num_frames"], page_len=64,
+            num_frames=rec["num_frames"],
+            frame_order=[int(f) for f in order],
+            policy=RecoveryPolicy.for_record(im, mid_s, mode="restore"),
+            scheduler=PressureScheduler(preempt_for_admission=False),
+            bytes_per_token=im.kv_cache_stats(mid_s).bytes_per_token)
+        got, _, _ = _serve(im, mid_s, prompts, pager=pager)
+        assert got == base
+
+    def test_residency_equals_leased_frames(self, compiled):
+        im, _, _, mid_s, prompts, _ = compiled
+        rec = im.models[mid_s]
+        s0 = im.kv_cache_stats(mid_s)
+        assert s0.paged and s0.frames_total == rec["num_frames"]
+        # the POOL allocation is measured too, and is smaller than the
+        # dense-slab formula would claim
+        assert s0.pool_bytes == rec["num_frames"] * s0.frame_bytes
+        assert s0.pool_bytes < (rec["rows"] * rec["alloc_len"]
+                                * s0.bytes_per_token)
+        probe = {}
+        pager = KVPager(
+            rec["num_frames"], page_len=64,
+            num_frames=rec["num_frames"],
+            policy=RecoveryPolicy.for_record(im, mid_s, mode="restore"),
+            scheduler=PressureScheduler(preempt_for_admission=False),
+            bytes_per_token=im.kv_cache_stats(mid_s).bytes_per_token)
+        orig = RequestManager._push_tables
+
+        def probing(self):
+            orig(self)
+            s = im.kv_cache_stats(mid_s)
+            probe[s.frames_leased] = s.bytes_resident
+        RequestManager._push_tables = probing
+        try:
+            _serve(im, mid_s, prompts, pager=pager)
+        finally:
+            RequestManager._push_tables = orig
+        # mid-serve, residency tracked leased frames exactly
+        assert any(n > 0 for n in probe)
+        fb = im.kv_cache_stats(mid_s).frame_bytes
+        for leased, resident in probe.items():
+            assert resident == leased * fb
+        # drained: zero leased, zero resident
+        s1 = im.kv_cache_stats(mid_s)
+        assert s1.frames_leased == 0 and s1.bytes_resident == 0
+
+    def test_bf16_paged_parity(self):
+        import jax.numpy as jnp
+
+        model, _ = _tiny_model(seed=5)
+        im = InferenceManager(model.config)
+        mid_d = im.compile_model_and_allocate_buffer(
+            model, max_requests=4, max_seq_length=256,
+            cache_dtype=jnp.bfloat16)
+        mid_p = im.compile_model_and_allocate_buffer(
+            model, max_requests=4, max_seq_length=256,
+            cache_dtype=jnp.bfloat16, kv_layout="paged", kv_page_len=64)
+        prompts = _prompts(4, 24, seed=3)
+        base, _, _ = _serve(im, mid_d, prompts)
+        got, _, _ = _serve(im, mid_p, prompts)
+        assert got == base
+
+    def test_int8_paged_parity_and_frame_bytes(self):
+        model, _ = _tiny_model(seed=4)
+        im = InferenceManager(model.config)
+        mid_d = im.compile_model_and_allocate_buffer(
+            model, max_requests=4, max_seq_length=256,
+            kv_cache_dtype="int8")
+        mid_p = im.compile_model_and_allocate_buffer(
+            model, max_requests=4, max_seq_length=256,
+            kv_cache_dtype="int8", kv_layout="paged", kv_page_len=64)
+        mid_b = im.compile_model_and_allocate_buffer(
+            model, max_requests=4, max_seq_length=256,
+            kv_cache_dtype="bf16", kv_layout="paged", kv_page_len=64)
+        prompts = _prompts(4, 24, seed=2)
+        base, _, _ = _serve(im, mid_d, prompts)
+        got, _, _ = _serve(im, mid_p, prompts)
+        assert got == base
+        # int8 frames (+ f32 scale frames) shrink against the
+        # full-precision pool (f32 here — the test config's
+        # computation dtype): (D + 4) / (4 * D) at head_dim 16 — the
+        # dtype halving composes with paging
+        fb_q = im.kv_cache_stats(mid_p).frame_bytes
+        fb_f = im.kv_cache_stats(mid_b).frame_bytes
+        assert 0.25 < fb_q / fb_f < 0.55, (fb_q, fb_f)
+
+
+class TestSpecPagedParity:
+    def _spec_serve(self, paged, device_loop, pager_fn=None, n=3):
+        from flexflow_tpu.serving.spec_infer import generate_spec_infer
+
+        llm, _ = _tiny_model(seed=11, max_requests=2,
+                             mode=InferenceMode.TREE_VERIFY)
+        ssm, _ = _tiny_model(seed=12, max_requests=2,
+                             mode=InferenceMode.BEAM_SEARCH)
+        im = InferenceManager(llm.config)
+        kw = dict(kv_layout="paged", kv_page_len=64) if paged else {}
+        lid = im.compile_model_and_allocate_buffer(
+            llm, mode=InferenceMode.TREE_VERIFY, max_requests=2,
+            max_seq_length=256, cache_dtype=np.float32, **kw)
+        sid = im.compile_model_and_allocate_buffer(
+            ssm, mode=InferenceMode.BEAM_SEARCH, max_requests=2,
+            max_seq_length=256, beam_width=2, cache_dtype=np.float32)
+        pager = pager_fn(im, lid) if pager_fn else None
+        rm = RequestManager(max_requests_per_batch=2,
+                            max_tokens_per_batch=64,
+                            max_sequence_length=256, kv_pager=pager)
+        rm.register_ssm_model(sid)
+        reqs = [rm.register_new_request(list(p), max_new_tokens=20)
+                for p in _prompts(n, 20, seed=4)]
+        generate_spec_infer(rm, im, lid, reqs, beam_width=2,
+                            beam_depth=4, device_loop=device_loop)
+        return [r.tokens[r.prompt_len:] for r in reqs], pager
+
+    @staticmethod
+    def _tight_pager(im, lid):
+        rec = im.models[lid]
+        return KVPager(
+            3, page_len=64, num_frames=rec["num_frames"],
+            policy=RecoveryPolicy.for_record(im, lid, mode="recompute"),
+            scheduler=PressureScheduler(queue_pressure_s=0.0),
+            bytes_per_token=im.kv_cache_stats(lid).bytes_per_token)
+
+    @pytest.mark.parametrize("device_loop", [False, True])
+    def test_spec_paged_target_parity(self, device_loop):
+        # the tree-verify target serves from a frame pool (the SSM
+        # stays dense — beam rows gather caches by parent); the fused
+        # device loop carries the table as state
+        base, _ = self._spec_serve(False, device_loop)
+        got, _ = self._spec_serve(True, device_loop)
+        assert got == base
+
+    @pytest.mark.parametrize("device_loop", [False, True])
+    def test_spec_paged_with_physical_pager_parity(self, device_loop):
+        base, _ = self._spec_serve(False, device_loop)
+        got, pager = self._spec_serve(True, device_loop,
+                                      self._tight_pager)
+        assert got == base
+        assert sum(pager.preemptions.values()) > 0
+        # spec rows never spill (tree-slot commit state)
+        assert pager.spill_bytes_total == 0
+        assert pager.leased_pages == 0
+
+
+# ------------------------------------------------ prefix frame sharing
+class TestPrefixFrameSharing:
+    def test_pooled_match_leases_donor_frames(self):
+        from flexflow_tpu.observability import get_registry
+
+        model, _ = _tiny_model(seed=9)
+        im = InferenceManager(model.config)
+        mid_d = im.compile_model_and_allocate_buffer(
+            model, max_requests=4, max_seq_length=256,
+            cache_dtype=np.float32)
+        mid_p = im.compile_model_and_allocate_buffer(
+            model, max_requests=4, max_seq_length=256,
+            cache_dtype=np.float32, kv_layout="paged", kv_page_len=64)
+        rec = im.models[mid_p]
+        system = _prompts(1, 80, seed=5)[0]
+        tails = _prompts(3, 8, seed=6)
+        c_shared = get_registry().counter(
+            "serving_prefix_frames_shared_total")
+        before = c_shared.value()
+
+        pager = KVPager(
+            rec["num_frames"], page_len=64,
+            num_frames=rec["num_frames"],
+            policy=RecoveryPolicy.for_record(im, mid_p, mode="restore"),
+            scheduler=PressureScheduler(preempt_for_admission=False),
+            bytes_per_token=im.kv_cache_stats(mid_p).bytes_per_token)
+        rm = RequestManager(max_requests_per_batch=4,
+                            max_tokens_per_batch=64,
+                            max_sequence_length=256, decode_block=4,
+                            prefix_cache=True, kv_pager=pager)
+
+        def one(rm2, mid, tail):
+            req = rm2.register_new_request(system + tail,
+                                           max_new_tokens=12)
+            rm2.generate_incr_decoding(im, mid, [req])
+            return req
+
+        one(rm, mid_p, tails[0])            # cold: donates the prefix
+        warm = one(rm, mid_p, tails[1])
+        # WHOLE donor pages leased by refcount — zero bytes copied
+        assert warm.profile.prefix_matched_tokens >= 64
+        assert warm.profile.prefix_matched_tokens % 64 == 0
+        assert c_shared.value() - before >= 1
+        # parity against a pool-free dense serve of the same prompt
+        rm2 = RequestManager(max_requests_per_batch=4,
+                             max_tokens_per_batch=64,
+                             max_sequence_length=256, decode_block=4)
+        ref = one(rm2, mid_d, tails[1])
+        assert warm.tokens == ref.tokens
+
+    def test_donor_eviction_keeps_borrowed_frames(self):
+        p = KVPager(8, page_len=64, num_frames=8)
+        p.lease(0, 128, owner="pool")       # a donated entry: 2 frames
+        donor = p.frames_of(0)
+        assert p.adopt_prefix(2, 0, 2) == 2
+        p.release(0)                        # pool eviction
+        # the borrower still holds both frames; nothing returned free
+        assert p.frames_of(2) == donor
+        assert p.leased_pages == 2
+        p.release(2)
+        assert p.leased_pages == 0
+
+
+# ----------------------------------------------------- spill payloads
+class TestPagedSpill:
+    def test_whole_frame_payload_roundtrip(self):
+        import jax
+        import jax.numpy as jnp
+
+        model, _ = _tiny_model(seed=7, max_requests=4)
+        im = InferenceManager(model.config)
+        mid = im.compile_model_and_allocate_buffer(
+            model, max_requests=4, max_seq_length=256,
+            cache_dtype=np.float32, kv_layout="paged", kv_page_len=64)
+        rec = im.models[mid]
+        rng = np.random.default_rng(1)
+        for name, kv in rec["caches"].items():
+            for part in list(kv):
+                arr = np.array(kv[part])
+                arr[rec["page_table"][0]] = rng.standard_normal(
+                    arr[rec["page_table"][0]].shape).astype(arr.dtype)
+                kv[part] = jnp.asarray(arr)
+        before = {n: np.array(kv["k"])
+                  for n, kv in rec["caches"].items()}
+        pay = im.fetch_row(mid, 0, 100)
+        # whole-frame pow2 bucket: 100 positions -> 2 pages of 64
+        assert pay["paged"] and pay["pages"] == 2
+        assert pay["len"] == 2 * 64 and pay["valid"] == 100
+        nb = im.restore_row(mid, 3, pay)
+        assert nb == pay["bytes"]
+        name = next(iter(rec["caches"]))
+        now = np.array(rec["caches"][name]["k"])
+        np.testing.assert_array_equal(
+            before[name][rec["page_table"][0, :2]],
+            now[rec["page_table"][3, :2]])
+        # the source row is untouched (fetch does not donate)
+        np.testing.assert_array_equal(
+            before[name][rec["page_table"][0]],
+            now[rec["page_table"][0]])
+        del jax  # imported for symmetry with other tests
+
+
+# ------------------------------------------------------- pp spill
+class TestPpSpill:
+    def _pp_model(self, seed=21):
+        ffcfg = FFConfig(pipeline_parallelism_degree=2)
+        model, _ = _tiny_model(seed=seed, max_requests=2, ffcfg=ffcfg)
+        im = InferenceManager(ffcfg)
+        mid = im.compile_model_and_allocate_buffer(
+            model, max_requests=2, max_seq_length=128,
+            cache_dtype=np.float32)
+        return im, mid
+
+    def test_pp_fetch_restore_roundtrip(self):
+        import jax
+        import jax.numpy as jnp
+
+        im, mid = self._pp_model()
+        rec = im.models[mid]
+        assert im.supports_kv_spill(mid)    # phase-2c: pp spills now
+        rng = np.random.default_rng(2)
+        for name, kv in rec["caches"].items():
+            for part in list(kv):
+                arr = np.array(kv[part])
+                arr[0] = rng.standard_normal(arr[0].shape).astype(
+                    arr.dtype)
+                kv[part] = jax.device_put(jnp.asarray(arr),
+                                          kv[part].sharding)
+        before = {n: np.array(kv["k"])
+                  for n, kv in rec["caches"].items()}
+        pay = im.fetch_row(mid, 0, 48)
+        assert pay is not None and pay["valid"] == 48
+        # every stage's layers rode the payload
+        assert set(pay["layers"]) == set(rec["caches"])
+        im.restore_row(mid, 1, pay)
+        for name in rec["caches"]:
+            now = np.array(rec["caches"][name]["k"])
+            np.testing.assert_array_equal(before[name][0, :, :pay["len"]],
+                                          now[1, :, :pay["len"]])
+
+    def test_pp_preempt_spill_restore_parity(self):
+        im, mid = self._pp_model(seed=22)
+        prompts = _prompts(3, 20, seed=9)
+        base, _, _ = _serve(im, mid, prompts, rows=2, new_tokens=24,
+                            max_seq=128)
+        pager = KVPager(
+            2, page_len=32,
+            policy=RecoveryPolicy.for_record(im, mid, mode="restore"),
+            scheduler=PressureScheduler(queue_pressure_s=0.0),
+            bytes_per_token=im.kv_cache_stats(mid).bytes_per_token)
+        got, reqs, _ = _serve(im, mid, prompts, pager=pager, rows=2,
+                              new_tokens=24, max_seq=128)
+        assert got == base
+        assert sum(pager.preemptions.values()) > 0
+        # the ROADMAP phase-2c claim: pp rows SPILL now, not recompute
+        assert pager.spill_bytes_total > 0
+        assert pager.restore_bytes_total > 0
+        assert sum(r.profile.restored_tokens for r in reqs) > 0
+
+
+# --------------------------------------------- tp-sharded paged serving
+class TestShardedPagedServing:
+    def test_tp_paged_token_match(self):
+        # the frame pool shards on the KV-head axis over tp; the whole
+        # incr driver must decode token-identically to the dense tp
+        # record (jnp fallback path — GSPMD partitions the gathered
+        # view's einsums)
+        ffcfg = FFConfig(tensor_parallelism_degree=2)
+        model, _ = _tiny_model(seed=17, max_requests=2, ffcfg=ffcfg)
+        im = InferenceManager(ffcfg)
+        mid_d = im.compile_model_and_allocate_buffer(
+            model, max_requests=2, max_seq_length=128,
+            cache_dtype=np.float32)
+        mid_p = im.compile_model_and_allocate_buffer(
+            model, max_requests=2, max_seq_length=128,
+            cache_dtype=np.float32, kv_layout="paged", kv_page_len=64)
+        rec = im.models[mid_p]
+        assert rec["caches"]                  # paged pools allocated
+        prompts = _prompts(2, 20, seed=11)
+        base, _, _ = _serve(im, mid_d, prompts, rows=2, new_tokens=24,
+                            max_seq=128)
+        got, _, _ = _serve(im, mid_p, prompts, rows=2, new_tokens=24,
+                           max_seq=128)
+        assert got == base
+
+
+# ------------------------------------------------- sharded paged kernels
+class TestShardedPagedKernels:
+    """Head-axis-sharded paged kernels vs their unsharded selves on the
+    8-device virtual CPU mesh (interpret mode): frames shard on the
+    KV-HEAD axis over the merged tp/sp group — there is no length axis
+    for sp and no flash merge, so sharded output must be bit-close to
+    unsharded, table indirection and all."""
+
+    MESHES = [(("tp",), (4,)), (("sp",), (4,)), (("sp", "tp"), (2, 2))]
+
+    @staticmethod
+    def _mesh(axes, shape):
+        import jax
+        from jax.sharding import Mesh
+
+        n = int(np.prod(shape))
+        return Mesh(np.array(jax.devices()[:n]).reshape(shape), axes)
+
+    @staticmethod
+    def _fixture(seed=0):
+        import jax.numpy as jnp
+
+        R, KV, G, D, L, P = 3, 4, 2, 128, 64, 4
+        F = R * P + 2
+        rng = np.random.default_rng(seed)
+        mk = lambda s: jnp.asarray(rng.standard_normal(s), jnp.float32)
+        table = jnp.asarray(
+            rng.permutation(F)[: R * P].reshape(R, P), jnp.int32)
+        pk, pv = mk((F, KV, L, D)), mk((F, KV, L, D))
+        q, kn, vn = mk((R, KV * G, D)), mk((R, KV, D)), mk((R, KV, D))
+        depth = jnp.asarray([5, 130, 255], jnp.int32)
+        active = jnp.asarray([1, 1, 1], jnp.int32)
+        return q, kn, vn, pk, pv, table, depth, active
+
+    @pytest.mark.parametrize("axes,shape", MESHES)
+    def test_paged_decode_sharded_matches_unsharded(self, axes, shape):
+        from flexflow_tpu.kernels.flash_decode import (
+            paged_decode_attention, paged_decode_attention_sharded)
+
+        q, kn, vn, pk, pv, table, depth, active = self._fixture()
+        ref, rk, rv = paged_decode_attention(
+            q, kn, vn, pk, pv, table, depth, active, 0.088,
+            interpret=True)
+        got, gk, gv = paged_decode_attention_sharded(
+            q, kn, vn, pk, pv, table, depth, active, 0.088,
+            self._mesh(axes, shape), interpret=True)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   atol=1e-4)
+        np.testing.assert_array_equal(np.asarray(gk), np.asarray(rk))
+
+    @pytest.mark.parametrize("axes,shape", MESHES)
+    def test_paged_prefill_sharded_matches_unsharded(self, axes, shape):
+        import jax.numpy as jnp
+
+        from flexflow_tpu.kernels.flash_prefill import (
+            paged_prefill_attention, paged_prefill_attention_sharded)
+
+        q0, kn, vn, pk, pv, table, depth, active = self._fixture(1)
+        R, KV, G, D, C = 3, 4, 2, 128, 32
+        rng = np.random.default_rng(2)
+        mk = lambda s: jnp.asarray(rng.standard_normal(s), jnp.float32)
+        q = mk((R, C, KV * G, D))
+        knc, vnc = mk((R, C, KV, D)), mk((R, C, KV, D))
+        depth = jnp.asarray([0, 50, 140], jnp.int32)
+        ntok = jnp.asarray([32, 20, 32], jnp.int32)
+        ref, rk, rv = paged_prefill_attention(
+            q, knc, vnc, pk, pv, table, depth, ntok, active, 0.088,
+            interpret=True, s_bound=256)
+        got, gk, gv = paged_prefill_attention_sharded(
+            q, knc, vnc, pk, pv, table, depth, ntok, active, 0.088,
+            self._mesh(axes, shape), interpret=True, s_bound=256)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   atol=1e-4)
+        np.testing.assert_array_equal(np.asarray(gk), np.asarray(rk))
+        np.testing.assert_array_equal(np.asarray(gv), np.asarray(rv))
+
+
+# -------------------------------------------------- zero-recompile pin
+class TestPagedPhysicalRetraceGuard:
+    def test_tables_are_data_not_shapes(self):
+        from flexflow_tpu.utils.debugging import retrace_guard
+
+        model, _ = _tiny_model(seed=13)
+        im = InferenceManager(model.config)
+        mid = im.compile_model_and_allocate_buffer(
+            model, max_requests=4, max_seq_length=256,
+            cache_dtype=np.float32, kv_layout="paged", kv_page_len=64,
+            kv_num_frames=12)
+        rec = im.models[mid]
+        prompts = _prompts(4, 24, seed=8)
+
+        def serve(order_seed):
+            # a DIFFERENT fragmented frame order each serve: table
+            # contents change, shapes do not
+            order = [int(f) for f in np.random.default_rng(
+                order_seed).permutation(rec["num_frames"])]
+            pager = KVPager(
+                6, page_len=64, num_frames=rec["num_frames"],
+                frame_order=order,
+                policy=RecoveryPolicy.for_record(im, mid,
+                                                 mode="restore"),
+                scheduler=PressureScheduler(
+                    preempt_for_admission=False),
+                bytes_per_token=im.kv_cache_stats(mid).bytes_per_token)
+            got, _, _ = _serve(im, mid, prompts, pager=pager)
+            assert sum(pager.preemptions.values()) > 0  # paging LIVE
+            return got
+
+        with retrace_guard(max_compiles=None) as warm:
+            base = serve(1)
+        if warm.compiles == 0:
+            pytest.skip("this JAX emits no compile monitoring events")
+        # different table contents, different frame order, same
+        # shapes: every step/fetch/restore bucket must be a cache hit
+        with retrace_guard() as g:
+            again = serve(2)
+        assert g.compiles == 0, g.events
+        assert again == base

@@ -469,7 +469,7 @@ class TestBenchPagedSmoke:
             model, cfg = _tiny_model(seed=14, max_requests=6)
             return model, cfg.vocab_size
 
-        head, spill, preempts, goodput = bench.bench_paged(
+        head, spill, preempts, goodput, frames = bench.bench_paged(
             model_builder=tiny, max_requests=6, prompt_len=40,
             new_tokens=32, max_seq_length=192, max_tokens_per_batch=64,
             decode_block=8, n_requests=10, budget_rows=1)
@@ -478,13 +478,26 @@ class TestBenchPagedSmoke:
         assert head["paged_resident_batch"] \
             > head["capped_resident_batch"]
         assert head["value"] > 1.2
+        # the PHYSICAL arm holds the gain with the pool ACTUALLY small:
+        # its HBM allocation is the budget, not rows x alloc_len slabs
+        assert head["physical_resident_batch"] \
+            > head["capped_resident_batch"]
+        assert head["physical_cache_hbm_bytes"] \
+            < head["paged_cache_hbm_bytes"]
+        assert head["physical_cache_hbm_bytes"] \
+            <= head["budget_bytes"] * 1.25   # +- one row of rounding
         # the counters prove spill and preemption actually fired
         assert spill["value"] > 0 and spill["restore_bytes"] > 0
         assert preempts["value"] > 0
         assert head["paged_goodput_tokens_per_s"] > 0
+        # frame gauges: pool fully free once the stream drains
+        assert frames["frames_total_gauge"] == frames["value"]
+        assert frames["frames_free_gauge"] == frames["frames_total_gauge"]
+        assert frames["pool_hbm_bytes"] < frames["dense_slab_hbm_bytes"]
         # the record stamp rides every round beside kv_cache_dtype
         assert bench._PAGER_CONF["enabled"] is True
         assert bench._PAGER_CONF["page_len"] == 64
+        assert bench._PAGER_CONF["physical"] is True
         assert bench._PAGER_CONF["spill_policy"] == "restore"
 
 

@@ -31,6 +31,18 @@ The rule constant-folds literal integer assignments per scope (``W =
   blocks must tile the array exactly (``grid[i] * block[i] ==
   shape[i]``) — a grid that under-covers drops tail elements, one that
   over-covers re-runs programs on clamped indices (error).
+- **page_len constants** (PR 10, physical paged KV): every foldable
+  ``page_len`` / ``kv_page_len`` binding or call keyword must be a
+  multiple of 32 — the lcm of the 16-aligned flash-prefill chunk-start
+  invariant and the 32-wide int8 RMW window, so frame boundaries are
+  legal chunk starts AND whole frames are legal RMW windows for every
+  cache dtype.  Checked in EVERY module (the constant is consumed far
+  from the kernels: pager ctors, compile kwargs, serve API); names
+  that only fold through an import resolve CROSS-MODULE via the
+  ffshard ProjectGraph's constant bindings.  The page-table
+  scalar-prefetch BlockSpecs and frame-shape literals of the paged
+  kernels themselves ride the generic BlockSpec/VMEM sublane checks
+  above — a frame's (sublane) extent IS page_len.
 
 Real kernels mostly pass runtime-derived shapes (nothing folds —
 nothing to check); the rule exists so the next hand-written constant
@@ -67,11 +79,75 @@ class PallasTilingRule(Rule):
              "dtype sublane table (8/f32, 16/bf16, 32/int8) and grids "
              "must tile padded shapes exactly")
 
+    #: page_len spellings the %32 invariant applies to (exact names,
+    #: any case — DEFAULT_PAGE_LEN / PAGE_ALIGN-adjacent constants and
+    #: the compile/serve kwargs)
+    _PAGE_LEN_NAMES = ("page_len", "kv_page_len")
+
+    @classmethod
+    def _is_page_len_name(cls, name: str) -> bool:
+        return name.lower().lstrip("_") in cls._PAGE_LEN_NAMES \
+            or name.lower().endswith("_page_len")
+
+    def _fold_page_value(self, node: ast.AST, env: ConstEnv,
+                         module: Module, ctx: LintContext):
+        """Fold a page_len expression: local/module literals first,
+        then an imported name through the ProjectGraph's cross-module
+        constant bindings."""
+        v = env.fold(node)
+        if isinstance(v, int):
+            return v
+        if ctx.graph is not None:
+            dn = dotted_name(node)
+            if dn:
+                hit = ctx.graph.resolve_constant(module, dn)
+                if hit is not None and isinstance(hit[0], int):
+                    return hit[0]
+        return None
+
+    def _check_page_len(self, module: Module, ctx: LintContext,
+                        findings: List[Finding]) -> None:
+        env = ConstEnv()
+        for st in module.tree.body:
+            if not isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                   ast.ClassDef)):
+                env.bind(st)
+
+        def bad(node, what, v):
+            findings.append(self.finding(
+                module, node,
+                f"{what} = {v} is not a multiple of 32 — page_len is "
+                f"the paged-KV frame length, the lcm of the 16-aligned "
+                f"flash-prefill chunk-start invariant and the 32-wide "
+                f"int8 RMW append window; a misaligned frame is not "
+                f"addressable by Mosaic's int8 (32, 128) tiling and "
+                f"breaks page-boundary chunk starts"))
+
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    if isinstance(t, ast.Name) \
+                            and self._is_page_len_name(t.id):
+                        v = self._fold_page_value(node.value, env,
+                                                  module, ctx)
+                        if isinstance(v, int) and v % 32:
+                            bad(node.value, f"{t.id}", v)
+            elif isinstance(node, ast.Call):
+                for kw in node.keywords:
+                    if kw.arg and self._is_page_len_name(kw.arg):
+                        v = self._fold_page_value(kw.value, env,
+                                                  module, ctx)
+                        if isinstance(v, int) and v % 32:
+                            bad(kw.value, f"{kw.arg}=", v)
+
     def check(self, module: Module,
               ctx: LintContext) -> Iterable[Finding]:
-        if not _imports_pallas(module.tree):
-            return []
         findings: List[Finding] = []
+        # the page_len invariant is consumed far from the kernels —
+        # check EVERY module, not just pallas importers
+        self._check_page_len(module, ctx, findings)
+        if not _imports_pallas(module.tree):
+            return findings
         # module-level literal constants (``W = 16``) seed every
         # function scope's environment
         module_env = ConstEnv()
