@@ -2266,6 +2266,194 @@ def bench_live(model_builder=None, max_requests=8, max_seq_length=512,
     return (head, *extras)
 
 
+def bench_net(n_requests=24, max_requests=4, out_len=24,
+              decode_block=8, kill_test=True):
+    """Network serving bench: the serve/net wire surface
+    (docs/SERVING.md "Wire protocol & router") measured two ways.
+
+    **A. Wire vs in-process overhead** — one engine in this process
+    streams the same request set twice: directly through
+    ``AsyncServeFrontend`` and over a real loopback socket through
+    ``ServeNetServer`` (HTTP/1.1 + per-token SSE).  Reported as the
+    wall-clock overhead percentage plus per-token wire cost; the
+    streamed tokens must match in-process decoding exactly (parity is
+    recorded, not assumed).
+
+    **B. 1-vs-2-replica goodput scaling** — a closed (maximally
+    oversubscribed) stream of tenant traffic through the
+    ``ReplicaRouter``, first over one spawned CPU replica process,
+    then over two (IDENTICAL seeds — replicas of one model).  Replica
+    processes are forced onto CPU so a chip-holding bench process
+    never shares its device; the scaling claim is about the router
+    and process isolation, not the model.  Extras carry the
+    prefix-affinity hit rate and, when ``kill_test``, a replica-kill
+    round: one replica is SIGKILLed mid-stream and every accepted
+    request must still complete via failover + deterministic
+    skip-token resume (``recovered`` records it).
+
+    Headline = the 2-replica / 1-replica goodput ratio (the ROADMAP
+    multi-replica scale-out claim; acceptance floor 1.6x)."""
+    import asyncio
+
+    from flexflow_tpu.observability import (SLOPolicy, get_ledger,
+                                            get_registry)
+    from flexflow_tpu.serve.frontend import AsyncServeFrontend
+    from flexflow_tpu.serve.net.client import NetClient
+    from flexflow_tpu.serve.net.router import ReplicaRouter, spawn_replica
+    from flexflow_tpu.serve.net.server import ServeNetServer
+    from tools.ffload import build_tiny_engine
+
+    rng = np.random.default_rng(5)
+    prompt_lens = (12, 16, 24)
+    prompts = [rng.integers(4, 120,
+                            int(rng.choice(prompt_lens))).tolist()
+               for _ in range(n_requests)]
+    if get_ledger().slo_policy() is None:
+        get_ledger().set_slo_policy(SLOPolicy(ttft_s=60.0, tpot_s=5.0))
+
+    # ---------------- A: wire vs in-process on one engine ------------
+    im, mid, rm = build_tiny_engine(max_requests=max_requests,
+                                    decode_block=decode_block, seed=0)
+
+    async def _run_inproc():
+        fe = AsyncServeFrontend(im, mid, rm, reap_interval_s=0.005)
+        async with fe:
+            async def one(p):
+                s = await fe.submit(p, max_new_tokens=out_len)
+                return await s.result()
+
+            t0 = time.monotonic()
+            toks = await asyncio.gather(*(one(p) for p in prompts))
+            return toks, time.monotonic() - t0
+
+    async def _run_wire():
+        fe = AsyncServeFrontend(im, mid, rm, reap_interval_s=0.005)
+        async with fe:
+            async with ServeNetServer(fe) as srv:
+                cl = NetClient(srv.url)
+
+                async def one(p):
+                    ws = await cl.generate(p, max_new_tokens=out_len)
+                    return await ws.result()
+
+                t0 = time.monotonic()
+                toks = await asyncio.gather(*(one(p) for p in prompts))
+                return toks, time.monotonic() - t0
+
+    # warmup compiles every shape bucket so neither arm pays it
+    asyncio.run(_run_inproc())
+    toks_in, wall_in = asyncio.run(_run_inproc())
+    toks_wire, wall_wire = asyncio.run(_run_wire())
+    n_tokens = sum(len(t) for t in toks_in)
+    overhead_pct = 100.0 * (wall_wire / max(1e-9, wall_in) - 1.0)
+    per_token_us = (1e6 * (wall_wire - wall_in) / max(1, n_tokens))
+
+    # ---------------- B: 1-vs-2-replica goodput scaling --------------
+    def _affinity_counts():
+        snap = get_registry().snapshot()
+        v = (snap.get("counters") or {}).get("router_affinity_total", {})
+        return dict(v.get("labels", {})) if isinstance(v, dict) else {}
+
+    async def _router_phase(urls, kill_proc=None, kill_after_tokens=4):
+        router = ReplicaRouter(urls, scrape_interval_s=0.2,
+                               circuit_cooldown_s=1.0)
+        async with router:
+            killed = {"done": False}
+
+            async def one(i, p):
+                rs = await router.generate(p, max_new_tokens=out_len,
+                                           tenant=f"tenant{i % 4}")
+                got = 0
+                try:
+                    async for _ in rs:
+                        got += 1
+                        if (kill_proc is not None and not killed["done"]
+                                and got >= kill_after_tokens
+                                and rs._replica is not None
+                                and rs._replica.url == kill_proc.url):
+                            killed["done"] = True
+                            kill_proc.kill()
+                except Exception:
+                    return got, False
+                return got, True
+
+            t0 = time.monotonic()
+            results = await asyncio.gather(
+                *(one(i, p) for i, p in enumerate(prompts)))
+            wall = time.monotonic() - t0
+        tokens = sum(g for g, _ in results)
+        completed = sum(1 for _, ok in results if ok)
+        return {"tokens": tokens, "wall_s": wall,
+                "tokens_per_s": tokens / max(1e-9, wall),
+                "completed": completed, "of": len(results)}
+
+    async def _warm_replica(url):
+        cl = NetClient(url)
+        for plen in prompt_lens:
+            ws = await cl.generate(list(range(4, 4 + plen)),
+                                   max_new_tokens=decode_block)
+            await ws.result()
+
+    reps = [spawn_replica(rows=max_requests, decode_block=decode_block,
+                          seed=0) for _ in range(2)]
+    try:
+        for r in reps:
+            asyncio.run(_warm_replica(r.url))
+        single = asyncio.run(_router_phase([reps[0].url]))
+        aff_before = _affinity_counts()
+        dual = asyncio.run(_router_phase([r.url for r in reps]))
+        aff = _affinity_counts()
+        hits = (aff.get("outcome=hit", 0)
+                - aff_before.get("outcome=hit", 0))
+        total_routed = sum(aff.values()) - sum(aff_before.values())
+        kill_rep = None
+        if kill_test:
+            kill_rep = asyncio.run(_router_phase(
+                [r.url for r in reps], kill_proc=reps[0]))
+    finally:
+        for r in reps:
+            r.close()
+
+    scaling = dual["tokens_per_s"] / max(1e-9, single["tokens_per_s"])
+    head = {
+        "metric": "net_2replica_goodput_scaling",
+        "value": round(scaling, 3),
+        "unit": "x",
+        "vs_baseline": 0,
+        "methodology": (f"closed stream n{n_requests} out{out_len} "
+                        f"rows{max_requests} tenants4, router over "
+                        f"spawned CPU replica procs (identical seeds), "
+                        f"client-observed tokens/s dual/single"),
+        "single_replica_tokens_per_s": round(single["tokens_per_s"], 1),
+        "dual_replica_tokens_per_s": round(dual["tokens_per_s"], 1),
+        "prefix_affinity_hit_rate": round(
+            hits / max(1, total_routed), 3),
+    }
+    extras = [{
+        "metric": "net_wire_overhead",
+        "value": round(overhead_pct, 1),
+        "unit": "%",
+        "vs_baseline": 0,
+        "per_token_overhead_us": round(per_token_us, 1),
+        "inproc_wall_s": round(wall_in, 3),
+        "wire_wall_s": round(wall_wire, 3),
+        "tokens": n_tokens,
+        "wire_parity": toks_wire == toks_in,
+    }]
+    if kill_rep is not None:
+        extras.append({
+            "metric": "net_replica_kill_recovery",
+            "value": float(kill_rep["completed"]),
+            "unit": "requests completed (of accepted, one replica "
+                    "SIGKILLed mid-stream)",
+            "vs_baseline": 0,
+            "accepted": kill_rep["of"],
+            "recovered": kill_rep["completed"] == kill_rep["of"],
+            "tokens_per_s": round(kill_rep["tokens_per_s"], 1),
+        })
+    return (head, *extras)
+
+
 def bench_mnist_mlp():
     from flexflow_tpu import FFConfig, LossType, Model, SGDOptimizer
     from flexflow_tpu.fftype import ActiMode
@@ -2505,11 +2693,15 @@ def main(which: str, budget=None):
         head, *extras = bench_live()
         head["extras"] = extras
         return head
+    if which == "net":
+        head, *extras = bench_net()
+        head["extras"] = extras
+        return head
     if which != "all":
         raise SystemExit(
             f"unknown bench mode {which!r} (expected all|llama|llama7b|"
             f"spec|spec7b|mnist|kernels|opt|resnet|longctx|quality|"
-            f"distill|crossover|prefix|kvdtype|paged|live)")
+            f"distill|crossover|prefix|kvdtype|paged|live|net)")
 
     # all: headline decode metric + everything else under extras.  Each
     # section runs in its own process lifetime-wise (HBM frees between
@@ -2595,6 +2787,7 @@ def main(which: str, budget=None):
                       + _section(bench_kv_dtype, "kvdtype")
                       + _section(bench_paged, "paged")
                       + _section(bench_live, "live")
+                      + _section(bench_net, "net")
                       + _section(bench_kernels, "kernels"))
     if timed_out or skipped:
         head["timed_out"] = {"budget_s": budget, "sections": timed_out,

@@ -308,6 +308,86 @@ METRICS_SCHEMA = {
                 "the ROADMAP async-serving headline: throughput that "
                 "actually met latency targets, not just throughput.",
     },
+    # ------------------------------------------------ network serving
+    # (serve/net/: the HTTP/1.1 + SSE wire surface over the async
+    # front-end — docs/SERVING.md "Wire protocol & router")
+    "serving_net_requests_total": {
+        "type": "counter",
+        "help": "HTTP requests served by the wire front-end, labeled "
+                "endpoint=generate|cancel|health|stats|metrics|other "
+                "and code=<http status>.  endpoint=generate with "
+                "code=429 is the Overloaded/backpressure class (the "
+                "body carries retry_after_s and the response a "
+                "Retry-After header); code=503 is draining/closed.",
+    },
+    "serving_net_active_streams": {
+        "type": "gauge",
+        "help": "SSE token streams currently open on the wire server "
+                "(connected generate clients mid-stream).",
+    },
+    "serving_net_stream_tokens_total": {
+        "type": "counter",
+        "help": "Tokens framed as SSE `token` events onto client "
+                "sockets (after any skip_tokens router-resume "
+                "suppression; compare serving_tokens_generated_total "
+                "for what the engine produced).",
+    },
+    "serving_net_disconnects_total": {
+        "type": "counter",
+        "help": "Client sockets that closed mid-stream (read-EOF or "
+                "write failure while tokens were flowing).  Each one "
+                "also ticks serving_cancellations_total{reason="
+                "disconnect} when the engine-side cancel lands — the "
+                "wire twin of the front-end's disconnect path.",
+    },
+    "serving_net_request_seconds": {
+        "type": "histogram",
+        "help": "Wall time of one wire request from head-parse to "
+                "response flush (generate requests span the whole SSE "
+                "stream — the wire-side latency envelope the bench "
+                "`net` mode A/Bs against in-process streaming).",
+    },
+    # ------------------------------------------------ replica router
+    # (serve/net/router.py: multi-replica prefix-affinity router over
+    # N wire servers, scored from scraped /metrics)
+    "router_requests_total": {
+        "type": "counter",
+        "help": "Requests the router accepted for routing, labeled "
+                "outcome=completed (done event relayed) | failed "
+                "(retries exhausted or non-retriable transport error) "
+                "| rejected (every candidate replica circuit-open or "
+                "upstream 429/503 passed through).",
+    },
+    "router_failovers_total": {
+        "type": "counter",
+        "help": "Mid-request replica failovers: the upstream socket "
+                "died before a `done` event, and the router resubmitted "
+                "to another replica with skip_tokens set to the count "
+                "already relayed (greedy decode is deterministic, so "
+                "the client stream stays byte-identical).",
+    },
+    "router_affinity_total": {
+        "type": "counter",
+        "help": "Prefix-affinity routing decisions, labeled outcome="
+                "hit (request followed its prefix-hash map entry to "
+                "the replica already holding the tenant's frames) | "
+                "spill (mapped replica over the pressure threshold — "
+                "routed to the best-scored replica and remapped) | "
+                "new (first sighting of the prefix key).",
+    },
+    "router_replica_score": {
+        "type": "gauge",
+        "help": "Latest load-balance score per replica (labeled "
+                "replica=<url>): normalized serving_goodput_tokens_"
+                "per_s + frames-free headroom - queue depth, from the "
+                "most recent /metrics scrape.  Higher = preferred.",
+    },
+    "router_circuit_open_total": {
+        "type": "counter",
+        "help": "Circuit-breaker trips, labeled replica=<url>: a "
+                "transport failure marked the replica dead and "
+                "routing excludes it until the cooldown expires.",
+    },
     # --------------------------------------------------- pipeline serving
     "serving_pp_stage_dispatches_total": {
         "type": "counter",
@@ -425,6 +505,42 @@ EVENT_SCHEMA = {
     "host-sync": {
         "help": "Device->host materialization of step results (n); the "
                 "flight-record twin of serving_host_syncs_total.",
+    },
+    "net-request": {
+        "help": "One wire request accepted by the HTTP/SSE server "
+                "(endpoint, guid for generate submissions, peer) — the "
+                "network-side birth of a request the frontend's "
+                "enqueue event then tracks.",
+    },
+    "net-disconnect": {
+        "help": "A client socket closed mid-SSE-stream (guid, streamed "
+                "= tokens framed before the close).  The server "
+                "cancels the engine-side request (reason=disconnect) "
+                "so rows/frames free instead of decoding for a dead "
+                "socket — the wire twin of the `disconnect` event.",
+    },
+    "net-drain": {
+        "help": "The wire server began graceful drain (SIGTERM or "
+                "programmatic close): intake answers 503, in-flight "
+                "SSE streams flush, then the front-end closes behind "
+                "a drain barrier (live = streams open at drain start).",
+    },
+    "router-route": {
+        "help": "The router bound a request to a replica (replica, "
+                "affinity=hit|spill|new, key) — the prefix-affinity "
+                "decision trail for one routed submission.",
+    },
+    "router-failover": {
+        "help": "Mid-request failover: the upstream replica died "
+                "before `done` (replica, relayed = tokens already "
+                "forwarded); the router resubmits elsewhere with "
+                "skip_tokens=relayed so the client stream stays "
+                "byte-identical.",
+    },
+    "router-circuit-open": {
+        "help": "Circuit breaker opened on a replica after a "
+                "transport failure (replica, cooldown_s); routing "
+                "excludes it until the cooldown expires.",
     },
     "compile": {
         "help": "A serving record compiled + caches allocated (model, "

@@ -347,14 +347,35 @@ class AsyncServeFrontend:
         return self
 
     async def close(self, timeout: float = 10.0) -> None:
-        """Stop intake, let in-flight work finish (bounded by
-        ``timeout``), join the driver thread and fail any leftover
-        streams with :class:`FrontendClosed`."""
+        """Shut down behind a DRAIN BARRIER: stop intake, flush (fail)
+        every live stream and box cancels for their engine-side
+        requests, then join the driver thread.
+
+        The ordering is the fix for the teardown re-entry bug: when
+        streams were failed only *after* the join, their boxed cancels
+        were never drained, so requests that arrived during teardown
+        left ``rm.pending`` non-empty and a driver mid-pass would
+        re-enter the generate loop for clients that no longer existed —
+        the join then timed out and leaked the thread.  With the
+        barrier, the driver's next ``admit_pending`` boundary drains
+        the cancels, the engine empties, and the pass returns promptly;
+        whatever the dead driver never drained is enacted here after
+        the join (``drain_cancels`` is driver-safe once the thread is
+        gone).  The wire server's SIGTERM path
+        (:meth:`~flexflow_tpu.serve.net.server.ServeNetServer.begin_drain`)
+        depends on this barrier for its bounded shutdown."""
         if self._failed is None:
             self._failed = FrontendClosed("front-end closed")
         if self._reaper_task is not None:
             self._reaper_task.cancel()
             self._reaper_task = None
+        # barrier step 1+2: intake is refused (_failed above), live
+        # streams flush with FrontendClosed and their engine-side
+        # requests are cancel-boxed so the driver exits its pass at the
+        # next admission boundary instead of decoding for dead clients
+        self._fail_live(FrontendClosed("front-end closed"),
+                        reason="closed")
+        # barrier step 3: join the driver
         self._stop.set()
         self._wake.set()
         if self._thread is not None:
@@ -362,10 +383,19 @@ class AsyncServeFrontend:
                 None, self._thread.join, timeout)
             if not self._thread.is_alive():
                 self._thread = None
-        self.rm.on_commit = None
-        self.rm.on_finish = None
+        # catch streams submitted in the closing race (after the flush
+        # above but before intake saw _failed), then enact every cancel
+        # the dead driver never reached so the engine queue is empty
+        # for whoever owns this rm next.  ONLY when the join actually
+        # succeeded: drain_cancels is driver-safe solely with no driver
+        # in flight — a wedged thread that outlived the join timeout
+        # still owns the boundary and will drain the box itself
         self._fail_live(FrontendClosed("front-end closed"),
                         reason="closed")
+        if self._thread is None:
+            self.rm.drain_cancels()
+        self.rm.on_commit = None
+        self.rm.on_finish = None
 
     async def __aenter__(self) -> "AsyncServeFrontend":
         return await self.start()

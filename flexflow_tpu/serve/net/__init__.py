@@ -1,0 +1,25 @@
+"""``flexflow_tpu.serve.net`` — the network serving surface.
+
+The wire layer above the PR-9 in-process front-end: a versioned
+HTTP/1.1 + SSE protocol (protocol.py), a stdlib-asyncio server over
+one :class:`~flexflow_tpu.serve.AsyncServeFrontend` (server.py), a
+protocol client + ffload facade (client.py), and a multi-replica
+prefix-affinity router that speaks the same protocol downstream and
+upstream (router.py).  ``python -m flexflow_tpu.serve.net`` runs a
+replica server or the CI selftest.  docs/SERVING.md "Wire protocol &
+router" is the architecture walkthrough.
+"""
+
+from __future__ import annotations
+
+from . import protocol
+from .client import (HttpFrontend, NetClient, NetError,
+                     ReplicaUnavailable, StreamBroken, WireStream)
+from .router import (ReplicaProc, ReplicaRouter, RouterServer,
+                     RoutedStream, spawn_replica)
+from .server import ServeNetServer
+
+__all__ = ["protocol", "ServeNetServer", "NetClient", "WireStream",
+           "HttpFrontend", "NetError", "ReplicaUnavailable",
+           "StreamBroken", "ReplicaRouter", "RouterServer",
+           "RoutedStream", "ReplicaProc", "spawn_replica"]

@@ -1,0 +1,358 @@
+"""Asyncio wire client for the serve/net protocol.
+
+Speaks serve/net/protocol.py against a :class:`ServeNetServer` (or the
+router, which serves the identical surface): submit + per-token SSE
+streaming, cancel, health/stats/metrics scrapes.  Pure asyncio streams
+— no http.client, no requests — so it is safe to drive from inside an
+event loop (the fflint ``asyncio-blocking-call`` rule enforces exactly
+this for serve/net/).
+
+Exception mapping keeps the in-process front-end's surface: a 429
+raises :class:`~flexflow_tpu.serve.frontend.Overloaded` (with the
+server's ``retry_after_s``), a 503 raises
+:class:`~flexflow_tpu.serve.frontend.FrontendClosed`, a mid-stream
+``error`` event raises
+:class:`~flexflow_tpu.serve.frontend.RequestAborted` carrying the
+partial tokens — so ffload's synthetic clients (tools/ffload.py) drive
+a wire server with the *same* code that drives an in-process front-end
+(:class:`HttpFrontend` is that drop-in facade).  Transport-level
+failures (connect refused, socket reset before ``done``) raise
+:class:`ReplicaUnavailable` / :class:`StreamBroken` instead — the
+router's failover triggers, never conflated with engine-side outcomes.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from collections import deque
+from typing import Any, Dict, List, Optional, Tuple, Union
+
+from ..frontend import FrontendClosed, Overloaded, RequestAborted
+from . import protocol as wire
+
+__all__ = ["NetClient", "WireStream", "HttpFrontend", "NetError",
+           "ReplicaUnavailable", "StreamBroken", "parse_base_url"]
+
+
+class NetError(Exception):
+    """Transport-level wire failure (distinct from engine-side
+    outcomes, which reuse the front-end's exception types)."""
+
+
+class ReplicaUnavailable(NetError):
+    """Could not reach the server at all (refused / reset during the
+    request head) — the router circuit-breaks on this."""
+
+
+class StreamBroken(NetError):
+    """The SSE stream died before a ``done``/``error`` event (server
+    killed mid-stream).  ``tokens`` carries what was relayed — the
+    router resubmits elsewhere with ``skip_tokens=len(tokens)``."""
+
+    def __init__(self, guid: Optional[int],
+                 tokens: Optional[List[int]] = None):
+        self.guid = guid
+        self.tokens = list(tokens or [])
+        super().__init__(
+            f"stream broken after {len(self.tokens)} tokens "
+            f"(guid {guid})")
+
+
+def parse_base_url(url: str) -> Tuple[str, int]:
+    """``http://host:port`` -> (host, port).  Only http is spoken."""
+    if url.startswith("http://"):
+        url = url[len("http://"):]
+    url = url.rstrip("/")
+    host, _, port = url.partition(":")
+    if not host or not port or not port.isdigit():
+        raise ValueError(f"expected http://host:port, got {url!r}")
+    return host, int(port)
+
+
+def _request_bytes(method: str, path: str, host: str, body: bytes = b"",
+                   headers: Optional[Dict[str, str]] = None) -> bytes:
+    head = [f"{method} {path} HTTP/1.1", f"Host: {host}",
+            f"Content-Length: {len(body)}", "Connection: close"]
+    for k, v in (headers or {}).items():
+        head.append(f"{k}: {v}")
+    return ("\r\n".join(head) + "\r\n\r\n").encode() + body
+
+
+class WireStream:
+    """Client half of one SSE token stream — the wire twin of
+    :class:`~flexflow_tpu.serve.frontend.TokenStream` (same iteration
+    surface, same ``disconnect()`` affordance — except here disconnect
+    aborts a real socket, which is what the server's cancellation-on-
+    disconnect path exists to catch)."""
+
+    def __init__(self, reader: asyncio.StreamReader,
+                 writer: asyncio.StreamWriter, guid: int,
+                 request_id: Optional[str]):
+        self._reader = reader
+        self._writer = writer
+        self.guid = guid
+        self.request_id = request_id
+        self.tokens: List[int] = []
+        self._parser = wire.SSEParser()
+        self._pending: "deque" = deque()
+        #: (status, reason) once terminal
+        self._final: Optional[Tuple[str, Optional[str]]] = None
+
+    # ------------------------------------------------------------ client
+    def __aiter__(self) -> "WireStream":
+        return self
+
+    async def __anext__(self) -> int:
+        if self._final is not None:
+            self._raise_final()
+        while True:
+            while not self._pending:
+                try:
+                    chunk = await self._reader.read(65536)
+                except ConnectionError:
+                    chunk = b""
+                # CancelledError propagates untouched: cancelling the
+                # consuming task must never masquerade as a replica
+                # failure (the router would spuriously fail over and
+                # keep decoding a request nobody wants)
+                if not chunk:
+                    self._final = ("broken", None)
+                    self._close()
+                    raise StreamBroken(self.guid, self.tokens)
+                self._pending.extend(self._parser.feed(chunk))
+            event, data = self._pending.popleft()
+            if event == "token":
+                tok = int(data["t"])
+                self.tokens.append(tok)
+                return tok
+            if event == "done":
+                self._final = ("retired", None)
+                self._close()
+                self._raise_final()
+            if event == "error":
+                self._final = (data.get("status") or "cancelled",
+                               data.get("reason"))
+                self._close()
+                self._raise_final()
+            # meta / unknown events: skip
+
+    def _raise_final(self):
+        status, reason = self._final
+        if status == "retired":
+            raise StopAsyncIteration
+        if status == "broken":
+            raise StreamBroken(self.guid, self.tokens)
+        raise RequestAborted(self.guid, reason or status, self.tokens)
+
+    async def result(self) -> List[int]:
+        async for _ in self:
+            pass
+        return self.tokens
+
+    @property
+    def finished(self) -> bool:
+        return self._final is not None
+
+    @property
+    def status(self) -> Optional[str]:
+        return self._final[0] if self._final is not None else None
+
+    def disconnect(self) -> None:
+        """Abort the socket — a REAL client vanishing, not a polite
+        cancel.  The server's EOF watcher turns this into
+        ``RequestManager.cancel_request(reason=disconnect)``."""
+        if self._final is None:
+            self._final = ("disconnected", "client gone")
+        tr = self._writer.transport
+        if tr is not None:
+            tr.abort()
+
+    def _close(self) -> None:
+        try:
+            self._writer.close()
+        except Exception:
+            pass
+
+
+class NetClient:
+    """One serve/net endpoint (server or router).  Connections are
+    one-shot (``Connection: close``): scrapes are cheap on loopback and
+    streams own their socket anyway."""
+
+    def __init__(self, base_url: str, connect_timeout_s: float = 5.0):
+        self.host, self.port = parse_base_url(base_url)
+        self.base_url = f"http://{self.host}:{self.port}"
+        self.connect_timeout_s = float(connect_timeout_s)
+
+    # ----------------------------------------------------------- plumbing
+    async def _connect(self) -> Tuple[asyncio.StreamReader,
+                                      asyncio.StreamWriter]:
+        try:
+            return await asyncio.wait_for(
+                asyncio.open_connection(self.host, self.port),
+                self.connect_timeout_s)
+        except (OSError, asyncio.TimeoutError) as e:
+            raise ReplicaUnavailable(
+                f"{self.base_url}: {e!r}") from e
+
+    async def request(self, method: str, path: str, body: bytes = b"",
+                      headers: Optional[Dict[str, str]] = None
+                      ) -> Tuple[int, Dict[str, str], bytes]:
+        """One non-streaming round trip -> (status, headers, body)."""
+        reader, writer = await self._connect()
+        try:
+            writer.write(_request_bytes(method, path, self.host, body,
+                                        headers))
+            await writer.drain()
+            start, hdrs = await wire.read_http_head(reader)
+            status = int(start.split()[1])
+            if "content-length" in hdrs:
+                payload = await wire.read_http_body(reader, hdrs)
+            else:                   # Connection: close framing
+                payload = await reader.read(-1)
+            return status, hdrs, payload
+        except (ConnectionError, asyncio.IncompleteReadError) as e:
+            raise ReplicaUnavailable(f"{self.base_url}: {e!r}") from e
+        finally:
+            try:
+                writer.close()
+            except Exception:
+                pass
+
+    async def request_json(self, method: str, path: str,
+                           obj: Optional[Dict[str, Any]] = None
+                           ) -> Tuple[int, Dict[str, Any]]:
+        import json as _json
+
+        body = _json.dumps(obj).encode() if obj is not None else b""
+        status, _, payload = await self.request(method, path, body)
+        try:
+            return status, _json.loads(payload.decode() or "{}")
+        except ValueError:
+            return status, {"raw": payload.decode("utf-8", "replace")}
+
+    # ---------------------------------------------------------- endpoints
+    async def health(self) -> Dict[str, Any]:
+        return (await self.request_json("GET", wire.P_HEALTH))[1]
+
+    async def stats(self) -> Dict[str, Any]:
+        return (await self.request_json("GET", wire.P_STATS))[1]
+
+    async def metrics_text(self) -> str:
+        _, _, payload = await self.request("GET", wire.P_METRICS)
+        return payload.decode("utf-8", "replace")
+
+    async def metrics_values(self) -> Dict[str, float]:
+        return wire.parse_prometheus_gauges(await self.metrics_text())
+
+    async def cancel(self, guid: int, reason: str = "client") -> bool:
+        try:
+            status, obj = await self.request_json(
+                "POST", wire.P_CANCEL, {"guid": int(guid),
+                                        "reason": reason})
+        except NetError:
+            return False
+        return status == 200 and bool(obj.get("ok"))
+
+    async def generate(self, prompt: Union[List[int], str],
+                       max_new_tokens: int = 128,
+                       deadline_s: Optional[float] = None,
+                       tenant: Optional[str] = None,
+                       skip_tokens: int = 0,
+                       request_id: Optional[str] = None) -> WireStream:
+        """Submit over the wire; returns a live :class:`WireStream`
+        once the server's ``meta`` event lands.  Raises ``Overloaded``
+        on 429, ``FrontendClosed`` on 503, :class:`ProtocolError` on
+        4xx, :class:`ReplicaUnavailable` on transport failure."""
+        sub = wire.SubmitRequest(prompt=prompt,
+                                 max_new_tokens=max_new_tokens,
+                                 tenant=tenant, skip_tokens=skip_tokens,
+                                 request_id=request_id)
+        headers = ({wire.H_DEADLINE: f"{deadline_s:.6f}"}
+                   if deadline_s is not None else None)
+        reader, writer = await self._connect()
+        try:
+            writer.write(_request_bytes("POST", wire.P_GENERATE,
+                                        self.host, sub.encode(),
+                                        headers))
+            await writer.drain()
+            start, hdrs = await wire.read_http_head(reader)
+            status = int(start.split()[1])
+        except (ConnectionError, asyncio.IncompleteReadError) as e:
+            writer.close()
+            raise ReplicaUnavailable(f"{self.base_url}: {e!r}") from e
+        if status != 200:
+            payload = b""
+            try:
+                if "content-length" in hdrs:
+                    payload = await wire.read_http_body(reader, hdrs)
+            except (ConnectionError, asyncio.IncompleteReadError,
+                    wire.ProtocolError):
+                pass
+            writer.close()
+            self._raise_for_status(status, payload)
+        # SSE: the first event is always meta (guid assignment)
+        parser = wire.SSEParser()
+        pending: "deque" = deque()
+        while not pending:
+            chunk = await reader.read(65536)
+            if not chunk:
+                writer.close()
+                raise ReplicaUnavailable(
+                    f"{self.base_url}: stream closed before meta")
+            pending.extend(parser.feed(chunk))
+        event, data = pending.popleft()
+        if event != "meta":
+            pending.appendleft((event, data))
+            data = {}
+        ws = WireStream(reader, writer, int(data.get("guid", -1)),
+                        data.get("request_id"))
+        ws._parser = parser
+        ws._pending = pending
+        return ws
+
+    def _raise_for_status(self, status: int, payload: bytes) -> None:
+        import json as _json
+
+        try:
+            obj = _json.loads(payload.decode() or "{}")
+        except ValueError:
+            obj = {}
+        if status == 429:
+            raise Overloaded(float(obj.get("retry_after_s", 0.05)),
+                             int(obj.get("pending", 0)),
+                             int(obj.get("limit", 0)))
+        if status == 503:
+            raise FrontendClosed(
+                f"{self.base_url}: {obj.get('detail') or 'unavailable'}")
+        raise wire.ProtocolError(status, obj.get("error", "error"),
+                                 obj.get("detail", ""))
+
+
+class HttpFrontend:
+    """Drop-in facade matching the slice of ``AsyncServeFrontend`` the
+    ffload harness drives (``submit`` / ``cancel`` / ``stats`` /
+    ``last_bundle``), backed by a wire server — so ``tools/ffload.py
+    --transport http://…`` reuses its synthetic clients verbatim and a
+    disconnect fault becomes a real socket abort."""
+
+    def __init__(self, base_url: str):
+        self.client = NetClient(base_url)
+        self.last_bundle: Optional[str] = None
+
+    async def submit(self, prompt, max_new_tokens: int = 128,
+                     deadline_s: Optional[float] = None) -> WireStream:
+        try:
+            return await self.client.generate(
+                prompt, max_new_tokens=max_new_tokens,
+                deadline_s=deadline_s)
+        except ReplicaUnavailable as e:
+            raise FrontendClosed(str(e)) from e
+
+    def cancel(self, guid: int, reason: str = "client") -> None:
+        """Sync fire-and-forget (the shape ffload's ``call_later``
+        callbacks need) — the POST rides its own task."""
+        asyncio.ensure_future(self.client.cancel(guid, reason))
+
+    async def stats(self) -> Dict[str, Any]:
+        return await self.client.stats()

@@ -1,0 +1,359 @@
+"""Versioned JSON wire schema for the network serving surface.
+
+One module owns every byte that crosses a socket: the endpoint table,
+the submit/cancel request schemas, the SSE event framing, the deadline
+propagation header and the HTTP status mapping for the front-end's
+exceptions.  The server (serve/net/server.py), the client
+(serve/net/client.py) and the router (serve/net/router.py) all encode
+and decode through these helpers, so "protocol change" is a one-file
+diff and the wire stays self-describing (every submit and every SSE
+``meta`` event carries ``protocol``).
+
+Endpoints (HTTP/1.1; stdlib-asyncio server, no frameworks):
+
+==========================  =====  =====================================
+path                        verb   semantics
+==========================  =====  =====================================
+``/v1/generate``            POST   submit one request; response is a
+                                   ``text/event-stream`` of per-token
+                                   SSE events (below)
+``/v1/cancel``              POST   ``{"guid": g[, "reason": r]}`` —
+                                   cancel a streamed request by guid
+``/v1/health``              GET    liveness + drain state + frontend
+                                   stats (JSON)
+``/v1/stats``               GET    metrics snapshot + SLO report +
+                                   frontend stats (JSON; the ffload
+                                   wire transport's counter source)
+``/metrics``                GET    Prometheus text exposition
+                                   (``MetricsRegistry.expose_text``)
+==========================  =====  =====================================
+
+Submit body (JSON)::
+
+    {"protocol": 1,
+     "prompt": [ids...] | "text",       # text requires a tokenizer
+     "max_new_tokens": int,
+     "deadline_s": float | null,        # budget from NOW; see header
+     "tenant": str | null,              # prefix-affinity routing key
+     "skip_tokens": int,                # router failover resume: the
+                                        # first k tokens are generated
+                                        # but not framed
+     "request_id": str | null}          # client-side correlation id
+
+Deadline propagation: the ``X-FFServe-Deadline-S`` header (remaining
+budget in seconds, a float) overrides the body's ``deadline_s`` — a
+router forwards the *remaining* budget downstream, so queue time spent
+at one hop shrinks the deadline at the next.
+
+SSE framing (``Content-Type: text/event-stream``; one event per
+generated token — the per-token latency envelope is the wire's, not a
+batching layer's)::
+
+    event: meta\\n  data: {"protocol":1,"guid":g,"request_id":...}\\n\\n
+    event: token\\n data: {"t": <id>, "i": <index>}\\n\\n
+    event: done\\n  data: {"status":"retired","tokens":n}\\n\\n
+    event: error\\n data: {"status":"cancelled|failed","reason":r,
+                           "tokens":n}\\n\\n
+
+Status mapping (the front-end's exception surface on the wire):
+
+- ``Overloaded``      -> **429** with ``{"error":"overloaded",
+  "retry_after_s":x}`` and a ``Retry-After`` header (the backpressure
+  hint, seconds rounded up);
+- ``FrontendClosed`` / draining -> **503** ``{"error":"unavailable"}``
+  (+ ``Retry-After`` when draining — a restarting replica comes back);
+- malformed body / protocol mismatch -> **400** with
+  ``{"error":"bad_request"|"protocol_version", ...}``;
+- unknown path **404**, wrong verb **405**.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Any, Dict, List, Optional, Tuple, Union
+
+PROTOCOL_VERSION = 1
+
+# ------------------------------------------------------------ endpoints
+P_GENERATE = "/v1/generate"
+P_CANCEL = "/v1/cancel"
+P_HEALTH = "/v1/health"
+P_STATS = "/v1/stats"
+P_METRICS = "/metrics"
+
+#: deadline propagation header: REMAINING budget (seconds, float).
+#: Overrides the body's deadline_s; a router forwards the remaining
+#: budget so multi-hop queueing never silently extends an SLO.
+H_DEADLINE = "x-ffserve-deadline-s"
+
+_MAX_BODY = 8 << 20          # 8 MiB: longest token-id prompt we accept
+_MAX_HEAD = 64 << 10         # request/response head size cap
+
+
+class ProtocolError(Exception):
+    """A malformed or unacceptable wire request.  ``status`` is the
+    HTTP code the server answers with; ``error`` the machine-readable
+    body tag."""
+
+    def __init__(self, status: int, error: str, detail: str = ""):
+        super().__init__(detail or error)
+        self.status = status
+        self.error = error
+        self.detail = detail
+
+    def body(self) -> Dict[str, Any]:
+        out = {"error": self.error}
+        if self.detail:
+            out["detail"] = self.detail
+        return out
+
+
+# ------------------------------------------------------- submit schema
+@dataclasses.dataclass
+class SubmitRequest:
+    """One decoded ``POST /v1/generate`` body."""
+
+    prompt: Union[List[int], str]
+    max_new_tokens: int = 128
+    deadline_s: Optional[float] = None
+    tenant: Optional[str] = None
+    skip_tokens: int = 0
+    request_id: Optional[str] = None
+
+    def encode(self) -> bytes:
+        out: Dict[str, Any] = {"protocol": PROTOCOL_VERSION,
+                               "prompt": self.prompt,
+                               "max_new_tokens": self.max_new_tokens}
+        if self.deadline_s is not None:
+            out["deadline_s"] = self.deadline_s
+        if self.tenant is not None:
+            out["tenant"] = self.tenant
+        if self.skip_tokens:
+            out["skip_tokens"] = self.skip_tokens
+        if self.request_id is not None:
+            out["request_id"] = self.request_id
+        return json.dumps(out).encode()
+
+
+def parse_submit(body: bytes,
+                 headers: Optional[Dict[str, str]] = None
+                 ) -> SubmitRequest:
+    """Decode + validate a submit body (and the deadline header, which
+    wins over the body's ``deadline_s``).  Raises :class:`ProtocolError`
+    with the HTTP status the server should answer."""
+    try:
+        obj = json.loads(body.decode("utf-8"))
+    except (ValueError, UnicodeDecodeError) as e:
+        raise ProtocolError(400, "bad_request", f"body is not JSON: {e}")
+    if not isinstance(obj, dict):
+        raise ProtocolError(400, "bad_request", "body must be an object")
+    ver = obj.get("protocol", PROTOCOL_VERSION)
+    if ver != PROTOCOL_VERSION:
+        raise ProtocolError(
+            400, "protocol_version",
+            f"peer speaks protocol {ver!r}, this server speaks "
+            f"{PROTOCOL_VERSION}")
+    prompt = obj.get("prompt")
+    if isinstance(prompt, list):
+        if not prompt or not all(isinstance(t, int) and t >= 0
+                                 for t in prompt):
+            raise ProtocolError(400, "bad_request",
+                                "prompt must be a non-empty list of "
+                                "token ids >= 0")
+    elif not isinstance(prompt, str) or not prompt:
+        raise ProtocolError(400, "bad_request",
+                            "prompt must be a token-id list or a "
+                            "non-empty string")
+    try:
+        max_new = int(obj.get("max_new_tokens", 128))
+        skip = int(obj.get("skip_tokens", 0))
+    except (TypeError, ValueError):
+        raise ProtocolError(400, "bad_request",
+                            "max_new_tokens/skip_tokens must be ints")
+    if max_new < 1 or skip < 0 or skip >= max_new + 1:
+        raise ProtocolError(400, "bad_request",
+                            f"bad budgets: max_new_tokens={max_new}, "
+                            f"skip_tokens={skip}")
+    deadline = obj.get("deadline_s")
+    hdr = (headers or {}).get(H_DEADLINE)
+    if hdr is not None:
+        try:
+            deadline = float(hdr)
+        except ValueError:
+            raise ProtocolError(400, "bad_request",
+                                f"{H_DEADLINE} must be a float, got "
+                                f"{hdr!r}")
+    if deadline is not None:
+        try:
+            deadline = float(deadline)
+        except (TypeError, ValueError):
+            raise ProtocolError(400, "bad_request",
+                                "deadline_s must be a number")
+        if deadline <= 0:
+            raise ProtocolError(400, "bad_request",
+                                "deadline_s must be > 0 (remaining "
+                                "budget from now)")
+    tenant = obj.get("tenant")
+    if tenant is not None and not isinstance(tenant, str):
+        raise ProtocolError(400, "bad_request", "tenant must be a string")
+    rid = obj.get("request_id")
+    if rid is not None and not isinstance(rid, str):
+        raise ProtocolError(400, "bad_request",
+                            "request_id must be a string")
+    return SubmitRequest(prompt=prompt, max_new_tokens=max_new,
+                         deadline_s=deadline, tenant=tenant,
+                         skip_tokens=skip, request_id=rid)
+
+
+# --------------------------------------------------------- SSE framing
+def sse_event(name: str, data: Dict[str, Any]) -> bytes:
+    """One server-sent event frame."""
+    return (f"event: {name}\ndata: "
+            f"{json.dumps(data, separators=(',', ':'))}\n\n").encode()
+
+
+class SSEParser:
+    """Incremental SSE decoder: feed arbitrary byte chunks, get back
+    complete ``(event, data-dict)`` pairs.  Tolerates frames split
+    across TCP segments (the normal case)."""
+
+    def __init__(self) -> None:
+        self._buf = b""
+
+    def feed(self, chunk: bytes) -> List[Tuple[str, Dict[str, Any]]]:
+        self._buf += chunk
+        out: List[Tuple[str, Dict[str, Any]]] = []
+        while b"\n\n" in self._buf:
+            frame, self._buf = self._buf.split(b"\n\n", 1)
+            event, data = "message", {}
+            for line in frame.decode("utf-8", "replace").splitlines():
+                if line.startswith("event:"):
+                    event = line[len("event:"):].strip()
+                elif line.startswith("data:"):
+                    try:
+                        data = json.loads(line[len("data:"):].strip())
+                    except ValueError:
+                        data = {"raw": line[len("data:"):].strip()}
+            out.append((event, data))
+        return out
+
+
+# ------------------------------------------------------- HTTP plumbing
+def http_response(status: int, body: bytes,
+                  content_type: str = "application/json",
+                  extra_headers: Optional[Dict[str, str]] = None,
+                  close: bool = False) -> bytes:
+    """A complete Content-Length-framed HTTP/1.1 response."""
+    reason = {200: "OK", 400: "Bad Request", 404: "Not Found",
+              405: "Method Not Allowed", 408: "Request Timeout",
+              429: "Too Many Requests", 500: "Internal Server Error",
+              503: "Service Unavailable"}.get(status, "Status")
+    head = [f"HTTP/1.1 {status} {reason}",
+            f"Content-Type: {content_type}",
+            f"Content-Length: {len(body)}",
+            f"Connection: {'close' if close else 'keep-alive'}"]
+    for k, v in (extra_headers or {}).items():
+        head.append(f"{k}: {v}")
+    return ("\r\n".join(head) + "\r\n\r\n").encode() + body
+
+
+def json_response(status: int, obj: Dict[str, Any],
+                  extra_headers: Optional[Dict[str, str]] = None,
+                  close: bool = False) -> bytes:
+    return http_response(status, json.dumps(obj).encode(),
+                         extra_headers=extra_headers, close=close)
+
+
+def sse_response_head() -> bytes:
+    """The head of a streaming SSE response.  ``Connection: close``
+    frames the stream end without chunked encoding — the socket close
+    IS the terminator, and every stream also ends with an explicit
+    ``done``/``error`` event before it."""
+    return (b"HTTP/1.1 200 OK\r\n"
+            b"Content-Type: text/event-stream\r\n"
+            b"Cache-Control: no-store\r\n"
+            b"Connection: close\r\n\r\n")
+
+
+def overloaded_response(retry_after_s: float, pending: int = 0,
+                        limit: int = 0) -> bytes:
+    """429 for the front-end's ``Overloaded``: JSON carries the exact
+    hint, the Retry-After header its ceil (the header is int-seconds)."""
+    return json_response(
+        429, {"error": "overloaded",
+              "retry_after_s": round(float(retry_after_s), 4),
+              "pending": pending, "limit": limit},
+        extra_headers={"Retry-After": str(max(1, int(retry_after_s + 1)))
+                       })
+
+
+def unavailable_response(detail: str = "",
+                         retry_after_s: Optional[float] = None) -> bytes:
+    hdrs = ({"Retry-After": str(max(1, int(retry_after_s + 1)))}
+            if retry_after_s is not None else None)
+    body = {"error": "unavailable"}
+    if detail:
+        body["detail"] = detail
+    return json_response(503, body, extra_headers=hdrs, close=True)
+
+
+async def read_http_head(reader) -> Tuple[str, Dict[str, str]]:
+    """Read one HTTP request/response head off an asyncio StreamReader:
+    returns ``(start_line, lowercase-keyed headers)``.  Raises
+    :class:`ProtocolError` (400) on garbage, ``ConnectionError`` on a
+    peer that closed before a full head."""
+    head = await reader.readuntil(b"\r\n\r\n")
+    if len(head) > _MAX_HEAD:
+        raise ProtocolError(400, "bad_request", "oversized head")
+    lines = head.decode("latin-1").split("\r\n")
+    start = lines[0].strip()
+    headers: Dict[str, str] = {}
+    for line in lines[1:]:
+        if not line.strip():
+            continue
+        k, sep, v = line.partition(":")
+        if sep:
+            headers[k.strip().lower()] = v.strip()
+    if not start:
+        raise ProtocolError(400, "bad_request", "empty request line")
+    return start, headers
+
+
+async def read_http_body(reader, headers: Dict[str, str]) -> bytes:
+    """Read a Content-Length body (the only framing we accept on
+    requests — no chunked uploads)."""
+    try:
+        n = int(headers.get("content-length", "0"))
+    except ValueError:
+        raise ProtocolError(400, "bad_request", "bad Content-Length")
+    if n < 0 or n > _MAX_BODY:
+        raise ProtocolError(400, "bad_request",
+                            f"Content-Length {n} out of range")
+    if n == 0:
+        return b""
+    return await reader.readexactly(n)
+
+
+# ------------------------------------------------- prometheus scraping
+def parse_prometheus_gauges(text: str) -> Dict[str, float]:
+    """Label-aggregated metric values from a Prometheus text page:
+    ``{name: sum-over-label-sets}``.  The router's scrape decoder — it
+    only needs whole-replica gauges/counters (goodput, frames free,
+    queue depth), so label splits collapse by summation."""
+    out: Dict[str, float] = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        head, _, val = line.rpartition(" ")
+        if not head:
+            continue
+        name = head.split("{", 1)[0].strip()
+        # histogram series stay distinct (_bucket/_sum/_count suffixes
+        # are part of the series name, so they never pollute the gauge)
+        try:
+            out[name] = out.get(name, 0.0) + float(val)
+        except ValueError:
+            continue
+    return out

@@ -1,0 +1,635 @@
+"""Multi-replica prefix-affinity router over N wire servers.
+
+One chip mesh is a replica, not the system: this module fronts N
+:class:`~flexflow_tpu.serve.net.server.ServeNetServer` replicas (dp
+replica groups on disjoint mesh slices in production; N CPU processes
+in tests — ``spawn_replica``) and routes live traffic across them.
+Three policies compose, in the spirit of Orca's iteration-level
+frontier and AlpaServe's multi-replica placement results (PAPERS.md):
+
+- **Load scoring from scraped /metrics.**  Every
+  ``scrape_interval_s`` the router pulls each replica's Prometheus
+  page and scores it::
+
+      score = w_goodput * goodput/max(goodput)
+            + w_frames  * frames_free/max(frames_free)
+            - w_load    * (queue_depth + active)/max(load)
+
+  where goodput is ``serving_goodput_tokens_per_s`` (throughput that
+  met SLOs — a replica serving junk latency scores low even when
+  busy), frames_free is ``serving_kv_frames_free`` (paged-KV headroom;
+  replicas without a physical pager contribute 0 and the term
+  neutralizes), and load is ``serving_queue_depth +
+  serving_active_requests``.  Normalization is across the current
+  candidate set, so the score is a *ranking*, not an absolute.
+
+- **Prefix-affinity with pressure spillover.**  A request's affinity
+  key is its ``tenant`` (ffload's tenant traffic model) or, absent
+  one, a content hash of its first ``affinity_prefix_len`` prompt
+  tokens.  Keys map to replicas: repeat keys ROUTE BACK to the replica
+  whose prefix pool already holds their frames
+  (``router_affinity_total{outcome=hit}``) — unless that replica is
+  under pressure (zero frame headroom while a peer has some, or queue
+  depth beyond ``spill_queue_factor`` x the lightest candidate plus
+  ``spill_queue_slack``), in which case the request spills to the
+  best-scored replica and the key is remapped (``outcome=spill``).
+  Affinity beats instantaneous balance on purpose: a prefix hit skips
+  whole-frame prefill work, which buys more than a marginally shorter
+  queue.
+
+- **Failover with deterministic resume.**  A replica that dies
+  mid-stream (socket reset before ``done``) is circuit-broken for
+  ``circuit_cooldown_s`` and the request resubmits to another replica
+  with ``skip_tokens`` = tokens already relayed: greedy decode is
+  deterministic, so the re-generated prefix is suppressed server-side
+  and the client stream stays byte-identical
+  (``router_failovers_total``).  Engine-side aborts (deadline, shed,
+  client cancel) are NOT failovers — they propagate as-is.
+
+:class:`RouterServer` exposes the router through the *same* wire
+protocol as a single replica (it subclasses the server and overrides
+only submission), so ffload's ``--transport`` and any protocol client
+point at a router without knowing it is one.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import hashlib
+import itertools
+import os
+import subprocess
+import sys
+import time
+import types
+from typing import Dict, List, Optional, Sequence, Set, Tuple, Union
+
+from ...observability import get_flight_recorder, get_registry
+from ..frontend import FrontendClosed, Overloaded, RequestAborted
+from . import protocol as wire
+from .client import (NetClient, NetError, ReplicaUnavailable,
+                     StreamBroken, WireStream)
+from .server import ServeNetServer
+
+__all__ = ["ReplicaRouter", "RoutedStream", "RouterServer",
+           "ReplicaHandle", "spawn_replica", "ReplicaProc"]
+
+
+@dataclasses.dataclass
+class ReplicaHandle:
+    """Router-side state for one replica endpoint."""
+
+    url: str
+    client: NetClient
+    scrape: Dict[str, float] = dataclasses.field(default_factory=dict)
+    scrape_ok: bool = False
+    score: float = 0.0
+    circuit_open_until: float = 0.0
+
+    @property
+    def load(self) -> float:
+        return (self.scrape.get("serving_queue_depth", 0.0)
+                + self.scrape.get("serving_active_requests", 0.0))
+
+    @property
+    def frames_free(self) -> float:
+        return self.scrape.get("serving_kv_frames_free", 0.0)
+
+    @property
+    def goodput(self) -> float:
+        return self.scrape.get("serving_goodput_tokens_per_s", 0.0)
+
+    def available(self, now: float) -> bool:
+        return now >= self.circuit_open_until
+
+
+class ReplicaRouter:
+    """Routing core (no sockets of its own — :class:`RouterServer`
+    adds the wire surface).  Use::
+
+        router = ReplicaRouter(["http://127.0.0.1:8101", ...])
+        await router.start()
+        stream = await router.generate(prompt, max_new_tokens=64,
+                                       tenant="acme")
+        async for tok in stream: ...
+        await router.close()
+    """
+
+    def __init__(self, replica_urls: Sequence[str],
+                 scrape_interval_s: float = 0.25,
+                 affinity_prefix_len: int = 16,
+                 affinity_capacity: int = 4096,
+                 spill_queue_factor: float = 2.0,
+                 spill_queue_slack: float = 2.0,
+                 circuit_cooldown_s: float = 2.0,
+                 max_failovers: int = 3,
+                 w_goodput: float = 1.0, w_frames: float = 0.5,
+                 w_load: float = 1.0):
+        if not replica_urls:
+            raise ValueError("router needs at least one replica url")
+        self.replicas: List[ReplicaHandle] = [
+            ReplicaHandle(url=u.rstrip("/"), client=NetClient(u))
+            for u in replica_urls]
+        self.scrape_interval_s = float(scrape_interval_s)
+        self.affinity_prefix_len = int(affinity_prefix_len)
+        self.affinity_capacity = int(affinity_capacity)
+        self.spill_queue_factor = float(spill_queue_factor)
+        self.spill_queue_slack = float(spill_queue_slack)
+        self.circuit_cooldown_s = float(circuit_cooldown_s)
+        self.max_failovers = int(max_failovers)
+        self.w_goodput, self.w_frames, self.w_load = (
+            float(w_goodput), float(w_frames), float(w_load))
+        #: affinity key -> replica url (insertion-ordered for LRU cap)
+        self._affinity: Dict[str, str] = {}
+        self._live: Set["RoutedStream"] = set()
+        self.recorder = get_flight_recorder()
+        m = get_registry()
+        self._m_req = m.counter("router_requests_total")
+        self._m_failover = m.counter("router_failovers_total")
+        self._m_affinity = m.counter("router_affinity_total")
+        self._m_score = m.gauge("router_replica_score")
+        self._m_circuit = m.counter("router_circuit_open_total")
+        self._scrape_task: Optional[asyncio.Task] = None
+
+    # ----------------------------------------------------------- lifecycle
+    async def start(self) -> "ReplicaRouter":
+        await self.scrape_once()
+        if self._scrape_task is None:
+            self._scrape_task = asyncio.get_running_loop().create_task(
+                self._scrape_loop())
+        return self
+
+    async def close(self) -> None:
+        if self._scrape_task is not None:
+            self._scrape_task.cancel()
+            self._scrape_task = None
+        for rs in list(self._live):
+            rs.disconnect()
+
+    async def __aenter__(self) -> "ReplicaRouter":
+        return await self.start()
+
+    async def __aexit__(self, *exc) -> bool:
+        await self.close()
+        return False
+
+    # ------------------------------------------------------------- scraping
+    async def _scrape_loop(self) -> None:
+        while True:
+            await asyncio.sleep(self.scrape_interval_s)
+            try:
+                await self.scrape_once()
+            except asyncio.CancelledError:
+                raise
+            except Exception:       # scrape must outlive one bad tick
+                pass
+
+    async def scrape_once(self) -> None:
+        """One concurrent metrics pull across all replicas, then
+        rescore.  An unreachable replica circuit-breaks here too — a
+        dead endpoint never waits for a request to find it."""
+        async def pull(r: ReplicaHandle) -> None:
+            try:
+                r.scrape = await r.client.metrics_values()
+                r.scrape_ok = True
+            except (NetError, wire.ProtocolError):
+                r.scrape_ok = False
+                self._open_circuit(r, why="scrape")
+
+        await asyncio.gather(*(pull(r) for r in self.replicas))
+        self._rescore()
+
+    def _rescore(self) -> None:
+        cands = [r for r in self.replicas if r.scrape_ok]
+        if not cands:
+            return
+        max_g = max((r.goodput for r in cands), default=0.0) or 1.0
+        max_f = max((r.frames_free for r in cands), default=0.0) or 1.0
+        max_l = max((r.load for r in cands), default=0.0) or 1.0
+        for r in cands:
+            r.score = (self.w_goodput * r.goodput / max_g
+                       + self.w_frames * r.frames_free / max_f
+                       - self.w_load * r.load / max_l)
+            self._m_score.set(round(r.score, 4), replica=r.url)
+
+    def _open_circuit(self, r: ReplicaHandle, why: str = "fail") -> None:
+        now = time.monotonic()
+        if r.circuit_open_until > now:
+            r.circuit_open_until = now + self.circuit_cooldown_s
+            return                  # already open: extend quietly
+        r.circuit_open_until = now + self.circuit_cooldown_s
+        self._m_circuit.inc(replica=r.url)
+        self.recorder.record_event("router-circuit-open", replica=r.url,
+                                   cooldown_s=self.circuit_cooldown_s,
+                                   why=why)
+
+    # ------------------------------------------------------------- routing
+    def affinity_key(self, prompt: Union[List[int], str],
+                     tenant: Optional[str]) -> str:
+        """Tenant name when given (the explicit shared-prefix group);
+        else a content hash of the prompt head — same prefix, same
+        key, across processes (sha1, not hash())."""
+        if tenant:
+            return f"t:{tenant}"
+        if isinstance(prompt, str):
+            head = prompt[: 4 * self.affinity_prefix_len].encode()
+        else:
+            head = b",".join(
+                str(int(t)).encode()
+                for t in prompt[: self.affinity_prefix_len])
+        return "p:" + hashlib.sha1(head).hexdigest()[:16]
+
+    def pick(self, key: str, exclude: Optional[Set[str]] = None
+             ) -> Tuple[ReplicaHandle, str]:
+        """(replica, affinity outcome hit|spill|new) for one routing
+        decision, committed immediately (map updated, counter ticked).
+        Raises FrontendClosed when every replica is excluded or
+        circuit-open (the router's 503).  Request binding goes through
+        :meth:`_select` + :meth:`_commit_route` instead, so a replica
+        that rejects the submit neither claims the key nor counts a
+        decision."""
+        replica, outcome = self._select(key, exclude)
+        self._commit_route(key, replica, outcome)
+        return replica, outcome
+
+    def _select(self, key: str, exclude: Optional[Set[str]] = None
+                ) -> Tuple[ReplicaHandle, str]:
+        """Pure selection: no side effects until the replica ACCEPTS
+        (``_commit_route``)."""
+        now = time.monotonic()
+        exclude = exclude or set()
+        cands = [r for r in self.replicas
+                 if r.url not in exclude and r.available(now)]
+        if not cands:
+            raise FrontendClosed(
+                "no replica available (all circuit-open or excluded)")
+        by_url = {r.url: r for r in cands}
+        best = max(cands, key=lambda r: (r.score, -r.load))
+        mapped = self._affinity.get(key)
+        if mapped is not None and mapped in by_url:
+            target = by_url[mapped]
+            if self._under_pressure(target, cands):
+                outcome = "spill"
+                target = best
+            else:
+                outcome = "hit"
+        else:
+            outcome = "new" if mapped is None else "spill"
+            target = best
+        return target, outcome
+
+    def _commit_route(self, key: str, replica: ReplicaHandle,
+                      outcome: str) -> None:
+        self._remember(key, replica.url)
+        self._m_affinity.inc(outcome=outcome)
+
+    def _under_pressure(self, target: ReplicaHandle,
+                        cands: List[ReplicaHandle]) -> bool:
+        min_load = min((r.load for r in cands), default=0.0)
+        if target.load > (self.spill_queue_factor * min_load
+                          + self.spill_queue_slack):
+            return True
+        if (target.scrape.get("serving_kv_frames_free") == 0.0
+                and any(r.frames_free > 0 for r in cands
+                        if r is not target)):
+            return True
+        return False
+
+    def _remember(self, key: str, url: str) -> None:
+        self._affinity.pop(key, None)
+        self._affinity[key] = url
+        while len(self._affinity) > self.affinity_capacity:
+            self._affinity.pop(next(iter(self._affinity)))
+
+    # ------------------------------------------------------------ requests
+    async def generate(self, prompt: Union[List[int], str],
+                       max_new_tokens: int = 128,
+                       deadline_s: Optional[float] = None,
+                       tenant: Optional[str] = None,
+                       skip_tokens: int = 0,
+                       request_id: Optional[str] = None
+                       ) -> "RoutedStream":
+        """Route one request; returns a :class:`RoutedStream` whose
+        iteration survives replica death (failover + deterministic
+        resume).  Raises like ``NetClient.generate`` when no replica
+        accepts."""
+        rs = RoutedStream(self, prompt, max_new_tokens,
+                          (time.monotonic() + deadline_s
+                           if deadline_s is not None else None),
+                          tenant, skip_tokens, request_id)
+        await rs._bind_first()
+        self._live.add(rs)
+        return rs
+
+    def cancel(self, guid: int, reason: str = "client") -> None:
+        """Cancel a live routed stream by its ROUTER-LOCAL guid (the
+        id the RouterServer's ``meta`` event hands clients).  Upstream
+        guids are per-replica-process — identically-seeded replicas
+        assign colliding sequences, and a failover rebinds to a new
+        one — so the router never keys on them; the cancel is
+        forwarded to the currently-bound replica under ITS guid."""
+        for rs in list(self._live):
+            if (rs.guid == guid and rs._ws is not None
+                    and rs._replica is not None):
+                asyncio.ensure_future(
+                    rs._replica.client.cancel(rs.upstream_guid, reason))
+                return
+
+    # ------------------------------------------------------ server facade
+    def frontend_facade(self) -> "types.SimpleNamespace":
+        """The AsyncServeFrontend-shaped facade RouterServer mounts:
+        submit routes, cancel targets the bound replica, stats
+        aggregates, close stops scraping."""
+        async def close(timeout: float = 10.0) -> None:
+            await self.close()
+
+        return types.SimpleNamespace(
+            rm=types.SimpleNamespace(tokenizer=None),
+            submit=None,            # RouterServer overrides _submit
+            cancel=self.cancel,
+            stats=self.stats,
+            close=close)
+
+    def stats(self) -> Dict[str, object]:
+        now = time.monotonic()
+        return {
+            "router": True,
+            "live_streams": len(self._live),
+            "affinity_keys": len(self._affinity),
+            "replicas": [{
+                "url": r.url,
+                "score": round(r.score, 4),
+                "load": r.load,
+                "goodput": r.goodput,
+                "frames_free": r.frames_free,
+                "scrape_ok": r.scrape_ok,
+                "circuit_open": not r.available(now),
+            } for r in self.replicas],
+            "failed": None,
+            "last_bundle": None,
+        }
+
+
+#: router-local stream ids (``RoutedStream.guid``): upstream guids
+#: collide across replica processes and change on failover, so the
+#: router's public id is its own
+_ROUTED_GUID = itertools.count(1)
+
+
+class RoutedStream:
+    """One routed request: iterates like a TokenStream/WireStream and
+    transparently fails over (resubmit + ``skip_tokens`` resume) when
+    the bound replica dies mid-stream.  ``guid`` is ROUTER-LOCAL and
+    stable across failovers — it is what the RouterServer's ``meta``
+    event carries and what ``ReplicaRouter.cancel`` keys on; the
+    bound replica's own id is ``upstream_guid``."""
+
+    def __init__(self, router: ReplicaRouter,
+                 prompt: Union[List[int], str], max_new_tokens: int,
+                 deadline_mono: Optional[float], tenant: Optional[str],
+                 skip_initial: int, request_id: Optional[str]):
+        self._router = router
+        self._prompt = prompt
+        self._max_new = max_new_tokens
+        self._deadline_mono = deadline_mono
+        self._tenant = tenant
+        self._skip_initial = int(skip_initial)
+        self.request_id = request_id
+        self.tokens: List[int] = []     # relayed to the consumer
+        self.failovers = 0
+        self._key = router.affinity_key(prompt, tenant)
+        self._exclude: Set[str] = set()
+        self._replica: Optional[ReplicaHandle] = None
+        self._ws: Optional[WireStream] = None
+        self._final: Optional[str] = None
+        self._rid = next(_ROUTED_GUID)
+
+    # ------------------------------------------------------------- binding
+    async def _bind_first(self) -> None:
+        await self._bind(first=True)
+
+    async def _bind(self, first: bool) -> None:
+        """Pick a replica and open an upstream stream, walking the
+        candidate set on per-replica rejection.  Transport failures
+        circuit-break; 429/503 exclude the replica for THIS request
+        only (it is alive, just full — the next request may land
+        there)."""
+        router = self._router
+        last: Optional[BaseException] = None
+        for _ in range(len(router.replicas)):
+            try:
+                replica, outcome = router._select(self._key,
+                                                  self._exclude)
+            except FrontendClosed:
+                break
+            deadline = self._remaining_deadline()
+            if deadline is not None and deadline <= 0:
+                self._finish("failed")
+                raise RequestAborted(self.guid, "deadline", self.tokens)
+            try:
+                ws = await replica.client.generate(
+                    self._prompt, max_new_tokens=self._max_new,
+                    deadline_s=deadline, tenant=self._tenant,
+                    skip_tokens=self._skip_initial + len(self.tokens),
+                    request_id=self.request_id)
+            except (ReplicaUnavailable, StreamBroken) as e:
+                last = e
+                self._exclude.add(replica.url)
+                router._open_circuit(replica, why="submit")
+                continue
+            except (Overloaded, FrontendClosed) as e:
+                last = e
+                self._exclude.add(replica.url)
+                continue
+            self._replica = replica
+            self._ws = ws
+            # the replica ACCEPTED: only now does the key map to it
+            # and the affinity decision count (a rejecting replica in
+            # the retry walk must not claim the key or inflate the
+            # hit-rate denominator)
+            router._commit_route(self._key, replica, outcome)
+            router.recorder.record_event(
+                "router-route", replica=replica.url, affinity=outcome,
+                key=self._key)
+            return
+        self._finish("rejected")
+        if isinstance(last, (Overloaded, FrontendClosed)):
+            raise last
+        raise FrontendClosed(
+            f"no replica accepted the request ({last!r})")
+
+    def _remaining_deadline(self) -> Optional[float]:
+        if self._deadline_mono is None:
+            return None
+        return self._deadline_mono - time.monotonic()
+
+    # ------------------------------------------------------------- client
+    @property
+    def guid(self) -> int:
+        return self._rid
+
+    @property
+    def upstream_guid(self) -> int:
+        return self._ws.guid if self._ws is not None else -1
+
+    @property
+    def finished(self) -> bool:
+        return self._final is not None
+
+    @property
+    def status(self) -> Optional[str]:
+        return self._final
+
+    def __aiter__(self) -> "RoutedStream":
+        return self
+
+    async def __anext__(self) -> int:
+        while True:
+            if self._final is not None and self._ws is None:
+                raise StopAsyncIteration
+            try:
+                tok = await self._ws.__anext__()
+                self.tokens.append(tok)
+                return tok
+            except StopAsyncIteration:
+                self._finish("completed")
+                raise
+            except RequestAborted as e:
+                # engine-side outcome (deadline/shed/cancel): propagate,
+                # never failover — the abort would just replay elsewhere
+                self._finish("failed" if e.reason == "replica_failed"
+                             else "aborted")
+                raise RequestAborted(self.guid, e.reason, self.tokens)
+            except (StreamBroken, ReplicaUnavailable):
+                await self._failover()
+
+    async def result(self) -> List[int]:
+        async for _ in self:
+            pass
+        return self.tokens
+
+    def disconnect(self) -> None:
+        if self._ws is not None:
+            self._ws.disconnect()
+        self._finish("disconnected", count=False)
+
+    # ------------------------------------------------------------ failover
+    async def _failover(self) -> None:
+        router = self._router
+        failed = self._replica
+        if failed is not None:
+            self._exclude.add(failed.url)
+            router._open_circuit(failed, why="stream")
+        self.failovers += 1
+        if self.failovers > router.max_failovers:
+            self._finish("failed")
+            raise RequestAborted(self.guid, "replica_failed",
+                                 self.tokens)
+        router.recorder.record_event(
+            "router-failover",
+            replica=failed.url if failed else None,
+            relayed=len(self.tokens))
+        router._m_failover.inc()
+        self._ws = None
+        await self._bind(first=False)   # raises when nobody accepts
+
+    def _finish(self, outcome: str, count: bool = True) -> None:
+        if self._final is not None:
+            return
+        self._final = outcome
+        self._router._live.discard(self)
+        if count:
+            self._router._m_req.inc(outcome=outcome)
+
+
+class RouterServer(ServeNetServer):
+    """The router behind the SAME wire protocol as a replica: clients
+    (ffload ``--transport``, NetClient, curl) cannot tell a router
+    from a server.  Only submission differs — everything else
+    (SSE framing, disconnect watching, drain, metrics endpoint) is the
+    inherited server, so the wire semantics stay identical by
+    construction."""
+
+    def __init__(self, router: ReplicaRouter, host: str = "127.0.0.1",
+                 port: int = 0, drain_timeout_s: float = 10.0):
+        super().__init__(router.frontend_facade(), host=host, port=port,
+                         drain_timeout_s=drain_timeout_s)
+        self.router = router
+
+    async def _submit(self, sub: wire.SubmitRequest):
+        rs = await self.router.generate(
+            sub.prompt, max_new_tokens=sub.max_new_tokens,
+            deadline_s=sub.deadline_s, tenant=sub.tenant,
+            skip_tokens=sub.skip_tokens, request_id=sub.request_id)
+        # the resume prefix is suppressed UPSTREAM (the replica server
+        # applies skip_tokens); zero the local SSE skip so the
+        # inherited _stream_sse does not drop another skip_tokens from
+        # the already-suppressed relay
+        sub.skip_tokens = 0
+        return rs
+
+
+# --------------------------------------------------- replica processes
+@dataclasses.dataclass
+class ReplicaProc:
+    """One spawned replica server process (the N-CPU-procs test shape;
+    production replicas are long-lived deployments on their own mesh
+    slices)."""
+
+    proc: "subprocess.Popen"
+    url: str
+
+    def kill(self) -> None:
+        """Hard kill — the failover test's replica death."""
+        self.proc.kill()
+
+    def terminate(self) -> None:
+        """SIGTERM — exercises the server's graceful drain."""
+        self.proc.terminate()
+
+    def close(self, timeout_s: float = 10.0) -> None:
+        if self.proc.poll() is None:
+            self.proc.terminate()
+            try:
+                self.proc.wait(timeout_s)
+            except subprocess.TimeoutExpired:
+                self.proc.kill()
+                self.proc.wait(5.0)
+
+
+def spawn_replica(host: str = "127.0.0.1", port: int = 0, rows: int = 2,
+                  decode_block: int = 4, seed: int = 0,
+                  max_pending: int = 64,
+                  ready_timeout_s: float = 180.0) -> ReplicaProc:
+    """Spawn ``python -m flexflow_tpu.serve.net --replica`` as a child
+    process (tiny CPU llama engine; JAX_PLATFORMS forced to cpu so a
+    chip-holding parent never shares its device) and block until its
+    ``FFSERVE_READY host port`` line.  SYNC on purpose — call it from
+    setup code, never from inside the event loop."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    repo = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))))
+    env["PYTHONPATH"] = (repo + os.pathsep + env.get("PYTHONPATH", "")
+                         ).rstrip(os.pathsep)
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "flexflow_tpu.serve.net", "--replica",
+         "--host", host, "--port", str(port), "--rows", str(rows),
+         "--decode-block", str(decode_block), "--seed", str(seed),
+         "--max-pending", str(max_pending)],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+        env=env, cwd=repo, text=True, bufsize=1)
+    deadline = time.monotonic() + ready_timeout_s
+    line = ""
+    while time.monotonic() < deadline:
+        line = proc.stdout.readline()
+        if not line:
+            break
+        if line.startswith("FFSERVE_READY"):
+            _, rhost, rport = line.split()
+            return ReplicaProc(proc=proc, url=f"http://{rhost}:{rport}")
+    proc.kill()
+    raise RuntimeError(
+        f"replica did not come up within {ready_timeout_s}s "
+        f"(last line: {line!r})")

@@ -1,0 +1,411 @@
+"""The wire server: stdlib-asyncio HTTP/1.1 + SSE over one
+:class:`~flexflow_tpu.serve.AsyncServeFrontend`.
+
+This is the reference's ``triton/`` backend analogue (PAPER.md entry
+products) built on the PR-9 front-end hooks instead of a framework —
+``asyncio.start_server``, hand-rolled head parsing, Content-Length
+bodies, and per-token SSE frames.  Everything the event loop does here
+is non-blocking by construction (the fflint ``asyncio-blocking-call``
+rule covers sockets/http.client too); device work stays on the
+front-end's dedicated driver thread.
+
+What the wire adds over the in-process front-end:
+
+- **Cancellation-on-disconnect for real sockets**: while a stream is
+  live the handler races the next token against a read-EOF watcher on
+  the client socket; either a failed write or the watcher firing means
+  the client is gone, and the request is cancelled through
+  ``TokenStream.disconnect`` -> ``RequestManager.cancel_request`` so
+  its row/frames free immediately (``serving_net_disconnects_total``
+  plus the engine's ``serving_cancellations_total{reason=disconnect}``).
+- **Graceful drain on SIGTERM**: intake flips to 503 (with Retry-After
+  — a restarting replica comes back), in-flight SSE streams flush to
+  their ``done`` events (bounded by ``drain_timeout_s``), then the
+  front-end closes behind its drain barrier, which fails any stragglers
+  with explicit ``error`` events rather than hung sockets.
+- **Scrapeability**: ``/metrics`` serves
+  ``MetricsRegistry.expose_text()`` — the router's load-balance scores
+  (goodput, frame headroom, queue depth) ride the same exposition every
+  Prometheus scraper reads.
+
+See docs/SERVING.md "Wire protocol & router" and serve/net/protocol.py
+for the wire schema.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import signal
+import time
+from typing import Dict, Optional, Tuple
+
+from ...observability import (get_flight_recorder, get_ledger,
+                              get_registry)
+from ..frontend import (AsyncServeFrontend, FrontendClosed, Overloaded,
+                        RequestAborted)
+from . import protocol as wire
+
+__all__ = ["ServeNetServer"]
+
+#: idle keep-alive window before a quiet connection is closed
+_KEEPALIVE_IDLE_S = 75.0
+
+
+class ServeNetServer:
+    """One wire server over one front-end.  Lifecycle::
+
+        srv = ServeNetServer(frontend)
+        await srv.start()                 # binds; srv.port is real
+        srv.install_signal_handlers()     # SIGTERM -> graceful drain
+        await srv.wait_closed()           # until drained/closed
+
+    or ``async with ServeNetServer(frontend) as srv: ...`` for tests.
+    """
+
+    def __init__(self, frontend: AsyncServeFrontend,
+                 host: str = "127.0.0.1", port: int = 0,
+                 drain_timeout_s: float = 10.0):
+        self.frontend = frontend
+        self.host = host
+        self.port = port
+        self.drain_timeout_s = float(drain_timeout_s)
+        self.recorder = get_flight_recorder()
+        m = get_registry()
+        self._m_req = m.counter("serving_net_requests_total")
+        self._m_streams = m.gauge("serving_net_active_streams")
+        self._m_tok = m.counter("serving_net_stream_tokens_total")
+        self._m_disc = m.counter("serving_net_disconnects_total")
+        self._m_lat = m.histogram("serving_net_request_seconds")
+        self._server: Optional[asyncio.AbstractServer] = None
+        self._draining = False
+        self._closed = asyncio.Event()
+        self._active_streams = 0
+        self._drain_task: Optional[asyncio.Task] = None
+
+    # ----------------------------------------------------------- lifecycle
+    async def start(self) -> "ServeNetServer":
+        if self._server is not None:
+            return self
+        self._server = await asyncio.start_server(
+            self._handle_conn, self.host, self.port)
+        self.port = self._server.sockets[0].getsockname()[1]
+        return self
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def install_signal_handlers(self) -> None:
+        """SIGTERM/SIGINT -> graceful drain (the k8s preStop shape)."""
+        loop = asyncio.get_running_loop()
+        for sig in (signal.SIGTERM, signal.SIGINT):
+            try:
+                loop.add_signal_handler(sig, self.begin_drain)
+            except (NotImplementedError, RuntimeError):
+                pass            # non-main thread / platform without it
+
+    def begin_drain(self) -> None:
+        """Flip to draining: new submits answer 503, live streams get
+        ``drain_timeout_s`` to flush, then the front-end closes behind
+        its drain barrier and the listener shuts."""
+        if self._draining:
+            return
+        self._draining = True
+        self.recorder.record_event("net-drain",
+                                   live=self._active_streams)
+        self._drain_task = asyncio.get_running_loop().create_task(
+            self._drain())
+
+    async def _drain(self) -> None:
+        deadline = time.monotonic() + self.drain_timeout_s
+        while self._active_streams and time.monotonic() < deadline:
+            await asyncio.sleep(0.02)
+        # the barrier in AsyncServeFrontend.close fails any stragglers
+        # (their handlers write an `error` event and hang up cleanly)
+        await self.frontend.close()
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+            self._server = None
+        self._closed.set()
+
+    async def wait_closed(self) -> None:
+        await self._closed.wait()
+
+    async def aclose(self) -> None:
+        """Programmatic graceful shutdown (the SIGTERM path without the
+        signal)."""
+        if self._server is None and self._closed.is_set():
+            return
+        self.begin_drain()
+        await self.wait_closed()
+
+    async def __aenter__(self) -> "ServeNetServer":
+        return await self.start()
+
+    async def __aexit__(self, *exc) -> bool:
+        await self.aclose()
+        return False
+
+    # ---------------------------------------------------------- connection
+    async def _handle_conn(self, reader: asyncio.StreamReader,
+                           writer: asyncio.StreamWriter) -> None:
+        try:
+            while True:
+                try:
+                    start, headers = await asyncio.wait_for(
+                        wire.read_http_head(reader), _KEEPALIVE_IDLE_S)
+                except (asyncio.TimeoutError, asyncio.IncompleteReadError,
+                        ConnectionError, asyncio.LimitOverrunError):
+                    return
+                except wire.ProtocolError as e:
+                    writer.write(wire.json_response(e.status, e.body(),
+                                                    close=True))
+                    await writer.drain()
+                    return
+                parts = start.split()
+                if len(parts) < 2:
+                    writer.write(wire.json_response(
+                        400, {"error": "bad_request"}, close=True))
+                    await writer.drain()
+                    return
+                method, path = parts[0].upper(), parts[1]
+                try:
+                    body = await wire.read_http_body(reader, headers)
+                except wire.ProtocolError as e:
+                    writer.write(wire.json_response(e.status, e.body(),
+                                                    close=True))
+                    await writer.drain()
+                    return
+                except (asyncio.IncompleteReadError, ConnectionError):
+                    return
+                keep = await self._route(method, path, headers, body,
+                                         reader, writer)
+                if not keep or headers.get("connection", "") == "close":
+                    return
+        finally:
+            try:
+                writer.close()
+            except Exception:
+                pass
+
+    async def _route(self, method: str, path: str,
+                     headers: Dict[str, str], body: bytes,
+                     reader: asyncio.StreamReader,
+                     writer: asyncio.StreamWriter) -> bool:
+        """Dispatch one request; returns True to keep the connection."""
+        t0 = time.monotonic()
+        endpoint, code, keep = "other", 404, True
+        try:
+            if path == wire.P_GENERATE:
+                endpoint = "generate"
+                if method != "POST":
+                    code = 405
+                    writer.write(wire.json_response(
+                        405, {"error": "method_not_allowed"}))
+                    await writer.drain()
+                    return True
+                code = await self._h_generate(headers, body, reader,
+                                              writer)
+                keep = False        # SSE responses own the socket
+            elif path == wire.P_CANCEL and method == "POST":
+                endpoint, code = "cancel", await self._h_cancel(
+                    body, writer)
+            elif path == wire.P_HEALTH and method == "GET":
+                endpoint, code = "health", await self._h_health(writer)
+            elif path == wire.P_STATS and method == "GET":
+                endpoint, code = "stats", await self._h_stats(writer)
+            elif path == wire.P_METRICS and method == "GET":
+                endpoint, code = "metrics", await self._h_metrics(writer)
+            else:
+                writer.write(wire.json_response(
+                    404, {"error": "not_found", "path": path}))
+                await writer.drain()
+            return keep
+        except (ConnectionError, asyncio.IncompleteReadError):
+            return False
+        finally:
+            self._m_req.inc(endpoint=endpoint, code=code)
+            self._m_lat.observe(time.monotonic() - t0)
+
+    # ------------------------------------------------------------- handlers
+    async def _h_health(self, writer) -> int:
+        stats = self.frontend.stats()
+        state = ("draining" if self._draining else
+                 "failed" if stats.get("failed") else "serving")
+        writer.write(wire.json_response(
+            200, {"ok": state == "serving",
+                  "protocol": wire.PROTOCOL_VERSION, "state": state,
+                  **stats}))
+        await writer.drain()
+        return 200
+
+    async def _h_stats(self, writer) -> int:
+        writer.write(wire.json_response(
+            200, {"protocol": wire.PROTOCOL_VERSION,
+                  "metrics": get_registry().snapshot(),
+                  "slo": get_ledger().slo_report(),
+                  "frontend": self.frontend.stats()}))
+        await writer.drain()
+        return 200
+
+    async def _h_metrics(self, writer) -> int:
+        text = get_registry().expose_text().encode()
+        writer.write(wire.http_response(
+            200, text, content_type="text/plain; version=0.0.4"))
+        await writer.drain()
+        return 200
+
+    async def _h_cancel(self, body: bytes, writer) -> int:
+        try:
+            obj = json.loads(body.decode("utf-8"))
+            guid = int(obj["guid"])
+        except (ValueError, KeyError, TypeError, UnicodeDecodeError):
+            writer.write(wire.json_response(
+                400, {"error": "bad_request",
+                      "detail": "body must be {\"guid\": int}"}))
+            await writer.drain()
+            return 400
+        reason = obj.get("reason") or "client"
+        self.frontend.cancel(guid, str(reason))
+        writer.write(wire.json_response(200, {"ok": True, "guid": guid}))
+        await writer.drain()
+        return 200
+
+    async def _h_generate(self, headers: Dict[str, str], body: bytes,
+                          reader: asyncio.StreamReader,
+                          writer: asyncio.StreamWriter) -> int:
+        if self._draining:
+            writer.write(wire.unavailable_response(
+                "draining", retry_after_s=self.drain_timeout_s))
+            await writer.drain()
+            return 503
+        try:
+            sub = wire.parse_submit(body, headers)
+        except wire.ProtocolError as e:
+            writer.write(wire.json_response(e.status, e.body()))
+            await writer.drain()
+            return e.status
+        if (isinstance(sub.prompt, str)
+                and self.frontend.rm.tokenizer is None):
+            writer.write(wire.json_response(
+                400, {"error": "bad_request",
+                      "detail": "string prompts need a server-side "
+                                "tokenizer; send token ids"}))
+            await writer.drain()
+            return 400
+        try:
+            stream = await self._submit(sub)
+        except Overloaded as e:
+            writer.write(wire.overloaded_response(
+                e.retry_after_s, e.pending, e.limit))
+            await writer.drain()
+            return 429
+        except FrontendClosed as e:
+            writer.write(wire.unavailable_response(str(e)))
+            await writer.drain()
+            return 503
+        self.recorder.record_event("net-request", endpoint="generate",
+                                   guid=stream.guid)
+        await self._stream_sse(stream, sub, reader, writer)
+        return 200
+
+    async def _submit(self, sub: wire.SubmitRequest):
+        """Bind one parsed submit to the engine.  The base server wraps
+        one front-end (tenant affinity is a router concern — a single
+        replica's prefix pool hits on content alone); RouterServer
+        overrides this to route across replicas."""
+        return await self.frontend.submit(
+            sub.prompt, max_new_tokens=sub.max_new_tokens,
+            deadline_s=sub.deadline_s)
+
+    # --------------------------------------------------------- SSE stream
+    async def _stream_sse(self, stream, sub: wire.SubmitRequest,
+                          reader: asyncio.StreamReader,
+                          writer: asyncio.StreamWriter) -> None:
+        """Frame one TokenStream as SSE, racing every next-token await
+        against a read-EOF watcher so a vanished client cancels the
+        engine-side request immediately (not at the next write)."""
+        self._active_streams += 1
+        self._m_streams.set(self._active_streams)
+        watcher = asyncio.ensure_future(self._watch_eof(reader))
+        next_fut: Optional[asyncio.Future] = None
+        idx = framed = 0
+        try:
+            writer.write(wire.sse_response_head())
+            writer.write(wire.sse_event("meta", {
+                "protocol": wire.PROTOCOL_VERSION, "guid": stream.guid,
+                "request_id": sub.request_id,
+                "skip_tokens": sub.skip_tokens}))
+            await writer.drain()
+            it = stream.__aiter__()
+            while True:
+                next_fut = asyncio.ensure_future(it.__anext__())
+                done, _ = await asyncio.wait(
+                    {next_fut, watcher},
+                    return_when=asyncio.FIRST_COMPLETED)
+                if next_fut not in done:
+                    # the client socket hit EOF while we waited for the
+                    # next token: a real disconnect, mid-stream
+                    next_fut.cancel()
+                    self._note_disconnect(stream, framed)
+                    return
+                try:
+                    tok = next_fut.result()
+                except StopAsyncIteration:
+                    writer.write(wire.sse_event("done", {
+                        "status": "retired", "tokens": idx,
+                        "framed": framed}))
+                    await writer.drain()
+                    return
+                except RequestAborted as e:
+                    writer.write(wire.sse_event("error", {
+                        "status": "cancelled", "reason": e.reason,
+                        "tokens": idx, "framed": framed}))
+                    await writer.drain()
+                    return
+                except Exception as e:      # driver death / stall
+                    writer.write(wire.sse_event("error", {
+                        "status": "failed", "reason": repr(e),
+                        "tokens": idx, "framed": framed}))
+                    await writer.drain()
+                    return
+                idx += 1
+                if idx > sub.skip_tokens:
+                    writer.write(wire.sse_event(
+                        "token", {"t": int(tok), "i": idx - 1}))
+                    await writer.drain()
+                    framed += 1
+                    self._m_tok.inc()
+        except (ConnectionError, asyncio.IncompleteReadError):
+            if next_fut is not None and not next_fut.done():
+                next_fut.cancel()
+            self._note_disconnect(stream, framed)
+        finally:
+            if not watcher.done():
+                watcher.cancel()
+            self._active_streams -= 1
+            self._m_streams.set(self._active_streams)
+
+    async def _watch_eof(self, reader: asyncio.StreamReader) -> None:
+        """Resolves when the client half-closes or drops the socket.
+        SSE clients send nothing after the request, so any read result
+        short of data is a disconnect; stray bytes are drained and
+        ignored (a permissive peer pipelining a cancel would use the
+        cancel endpoint on its own connection)."""
+        while True:
+            try:
+                chunk = await reader.read(4096)
+            except (ConnectionError, asyncio.CancelledError):
+                return
+            if not chunk:
+                return
+
+    def _note_disconnect(self, stream, framed: int) -> None:
+        if stream.finished:
+            return                  # raced a natural completion
+        self._m_disc.inc()
+        self.recorder.record_event("net-disconnect", guid=stream.guid,
+                                   streamed=framed)
+        stream.disconnect()

@@ -1619,6 +1619,79 @@ class TestAsyncioBlockingRule:
             """, self.R)
         assert fs == []
 
+    # ------------------------------------ blocking network calls (PR 11)
+    def test_blocking_http_and_socket_funcs_in_async_def(self, tmp_path):
+        # the serve/net contract: the event loop never does a
+        # synchronous network RTT — http.client, urllib, requests and
+        # socket.create_connection all flag inside an async def
+        fs = lint(tmp_path, """\
+            import http.client
+            import socket
+            import urllib.request
+
+            import requests
+
+
+            async def scrape(self, host, url):
+                conn = http.client.HTTPConnection(host)
+                page = urllib.request.urlopen(url)
+                sock = socket.create_connection((host, 80))
+                body = requests.get(url)
+                return conn, page, sock, body
+            """, self.R)
+        assert at(fs, "asyncio-blocking-call", 9), fs
+        assert at(fs, "asyncio-blocking-call", 10), fs
+        assert at(fs, "asyncio-blocking-call", 11), fs
+        assert at(fs, "asyncio-blocking-call", 12), fs
+        assert len(fs) == 4
+        assert "network round trip" in fs[0].message
+
+    def test_blocking_socket_methods_in_async_def(self, tmp_path):
+        fs = lint(tmp_path, """\
+            async def relay(self, sock, conn, payload):
+                chunk = sock.recv(4096)
+                sock.sendall(payload)
+                resp = conn.getresponse()
+                return chunk, resp
+            """, self.R)
+        assert at(fs, "asyncio-blocking-call", 2), fs
+        assert at(fs, "asyncio-blocking-call", 3), fs
+        assert at(fs, "asyncio-blocking-call", 4), fs
+        assert len(fs) == 3
+        assert "socket/HTTP I/O" in fs[0].message
+
+    def test_sync_def_network_and_asyncio_streams_clean(self, tmp_path):
+        # blocking network code on a plain thread is fine, and the
+        # asyncio-native replacements never flag (reader.read is not
+        # a socket .recv; open_connection is not create_connection)
+        fs = lint(tmp_path, """\
+            import asyncio
+            import socket
+
+
+            def health_probe(host):
+                sock = socket.create_connection((host, 80))
+                return sock.recv(1)
+
+
+            async def wire(self, host):
+                reader, writer = await asyncio.open_connection(host, 80)
+                writer.write(b"x")
+                await writer.drain()
+                return await reader.read(4096)
+            """, self.R)
+        assert fs == []
+
+    def test_net_call_suppression(self, tmp_path):
+        fs = lint(tmp_path, """\
+            import socket
+
+
+            async def probe(self, host):
+                return socket.getaddrinfo(host, 80)  # fflint: disable=asyncio-blocking-call  startup-only resolve
+            """, self.R)
+        assert fs == []
+
 
 class TestLockDisciplineRule:
     R = [LockDisciplineRule()]

@@ -280,6 +280,63 @@ class TestFrontendBasics:
         asyncio.run(go())
 
 
+# --------------------------------------------- close() drain barrier
+class TestCloseDrainBarrier:
+    """PR-11 satellite regression: close() used to fail streams only
+    AFTER joining the driver, so requests arriving during teardown
+    left their boxed cancels undrained — the driver re-entered the
+    generate loop for dead clients, the join timed out, and
+    ``rm.pending`` stayed populated for the next owner.  The barrier
+    (stop intake -> flush streams + box cancels -> join -> post-join
+    drain) is what the wire server's SIGTERM path relies on."""
+
+    def test_close_mid_stream_joins_fast_and_empties_engine(self):
+        im, mid, rm = build_tiny_engine(max_requests=1, decode_block=4,
+                                        seed=13)
+        # warm the shape buckets so close() never races a first-compile
+        warm = rm.register_new_request(_prompts(1, 8, seed=1)[0],
+                                       max_new_tokens=8)
+        rm.generate_incr_decoding(im, mid, [warm])
+
+        async def go():
+            fe = AsyncServeFrontend(im, mid, rm, reap_interval_s=0.005)
+            await fe.start()
+            # a 1-row engine with a deep backlog: teardown arrives while
+            # most of these are still pending (the re-entry trigger)
+            streams = [await fe.submit(_prompts(1, 8, seed=i)[0],
+                                       max_new_tokens=64)
+                       for i in range(6)]
+            await asyncio.sleep(0.05)       # the driver is mid-pass
+            t0 = time.monotonic()
+            await fe.close(timeout=10.0)
+            return fe, streams, time.monotonic() - t0
+
+        fe, streams, close_wall = asyncio.run(go())
+        # the barrier drains at the next admission boundary — closing
+        # must not wait out a 6 x 64-token backlog (nor hit the join
+        # timeout and leak the thread)
+        assert close_wall < 8.0
+        assert fe._thread is None, "driver thread leaked past close()"
+        # the engine is EMPTY for whoever owns this rm next
+        assert not rm.pending and not rm.running
+        assert not rm._cancel_box
+        # every stream terminated (failed/cancelled — never hung)
+        assert all(s.finished for s in streams)
+
+    def test_double_close_is_idempotent(self):
+        im, mid, rm = build_tiny_engine(max_requests=1, seed=14)
+
+        async def go():
+            fe = AsyncServeFrontend(im, mid, rm, reap_interval_s=0.005)
+            async with fe:
+                await fe.submit(_prompts(1, 8, seed=3)[0],
+                                max_new_tokens=4)
+            await fe.close()            # second close: no-op, no raise
+
+        asyncio.run(go())
+        assert not rm.pending and not rm.running
+
+
 # ----------------------------------------- watchdog + front-end (stall)
 class TestWatchdogFrontendStall:
     def test_injected_stall_bundles_inflight_guids_and_fails_streams(

@@ -1,0 +1,178 @@
+"""Wire-protocol unit tests (serve/net/protocol.py, PR 11).
+
+Pure-host, no engine: submit schema validation (versioning, deadline
+header precedence, budget sanity), SSE framing + the incremental
+parser under arbitrary TCP segmentation, HTTP response framing, the
+429/503 mapping bodies, and the router's Prometheus scrape decoder.
+"""
+
+import asyncio
+import json
+import os
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from flexflow_tpu.serve.net import protocol as wire  # noqa: E402
+
+
+class TestSubmitSchema:
+    def test_roundtrip(self):
+        sub = wire.SubmitRequest(prompt=[1, 2, 3], max_new_tokens=7,
+                                 deadline_s=1.5, tenant="acme",
+                                 skip_tokens=2, request_id="r1")
+        got = wire.parse_submit(sub.encode())
+        assert got == sub
+
+    def test_defaults(self):
+        got = wire.parse_submit(json.dumps(
+            {"prompt": [4, 5]}).encode())
+        assert got.max_new_tokens == 128
+        assert got.deadline_s is None and got.tenant is None
+        assert got.skip_tokens == 0
+
+    def test_protocol_version_mismatch_is_400(self):
+        with pytest.raises(wire.ProtocolError) as ei:
+            wire.parse_submit(json.dumps(
+                {"protocol": 99, "prompt": [1]}).encode())
+        assert ei.value.status == 400
+        assert ei.value.error == "protocol_version"
+
+    @pytest.mark.parametrize("body", [
+        b"not json",
+        b"[1,2]",
+        json.dumps({"prompt": []}).encode(),          # empty string/list
+        json.dumps({"prompt": [1, -2]}).encode(),     # negative id
+        json.dumps({"prompt": [1], "max_new_tokens": 0}).encode(),
+        json.dumps({"prompt": [1], "skip_tokens": -1}).encode(),
+        json.dumps({"prompt": [1], "deadline_s": 0}).encode(),
+        json.dumps({"prompt": [1], "tenant": 7}).encode(),
+    ])
+    def test_bad_bodies_are_400(self, body):
+        with pytest.raises(wire.ProtocolError) as ei:
+            wire.parse_submit(body)
+        assert ei.value.status == 400
+
+    def test_deadline_header_wins_over_body(self):
+        body = json.dumps({"prompt": [1], "deadline_s": 9.0}).encode()
+        got = wire.parse_submit(body, {wire.H_DEADLINE: "0.25"})
+        assert got.deadline_s == 0.25
+
+    def test_bad_deadline_header_is_400(self):
+        with pytest.raises(wire.ProtocolError):
+            wire.parse_submit(json.dumps({"prompt": [1]}).encode(),
+                              {wire.H_DEADLINE: "soon"})
+
+
+class TestSSE:
+    def test_event_framing(self):
+        frame = wire.sse_event("token", {"t": 5, "i": 0})
+        assert frame == b'event: token\ndata: {"t":5,"i":0}\n\n'
+
+    def test_parser_reassembles_split_frames(self):
+        frames = (wire.sse_event("meta", {"guid": 3})
+                  + wire.sse_event("token", {"t": 9, "i": 0})
+                  + wire.sse_event("done", {"status": "retired",
+                                            "tokens": 1}))
+        # feed in pathological 3-byte chunks: every frame must still
+        # come out whole and in order
+        parser = wire.SSEParser()
+        events = []
+        for i in range(0, len(frames), 3):
+            events.extend(parser.feed(frames[i:i + 3]))
+        assert [e for e, _ in events] == ["meta", "token", "done"]
+        assert events[1][1] == {"t": 9, "i": 0}
+
+    def test_parser_tolerates_unparseable_data(self):
+        parser = wire.SSEParser()
+        out = parser.feed(b"event: x\ndata: {not json}\n\n")
+        assert out == [("x", {"raw": "{not json}"})]
+
+
+class TestHttpFraming:
+    def _reader(self, payload: bytes) -> asyncio.StreamReader:
+        r = asyncio.StreamReader()
+        r.feed_data(payload)
+        r.feed_eof()
+        return r
+
+    def test_response_roundtrips_through_head_reader(self):
+        resp = wire.json_response(200, {"ok": True})
+
+        async def go():
+            reader = self._reader(resp)
+            start, headers = await wire.read_http_head(reader)
+            body = await wire.read_http_body(reader, headers)
+            return start, headers, body
+
+        start, headers, body = asyncio.run(go())
+        assert start.startswith("HTTP/1.1 200")
+        assert headers["content-type"] == "application/json"
+        assert json.loads(body) == {"ok": True}
+
+    def test_overloaded_response_carries_retry_after(self):
+        resp = wire.overloaded_response(0.37, pending=8, limit=8)
+
+        async def go():
+            reader = self._reader(resp)
+            start, headers = await wire.read_http_head(reader)
+            return start, headers, await wire.read_http_body(reader,
+                                                             headers)
+
+        start, headers, body = asyncio.run(go())
+        assert "429" in start
+        assert headers["retry-after"] == "1"
+        obj = json.loads(body)
+        assert obj["error"] == "overloaded"
+        assert obj["retry_after_s"] == 0.37
+
+    def test_unavailable_response_is_503(self):
+        resp = wire.unavailable_response("draining", retry_after_s=5.0)
+        assert resp.startswith(b"HTTP/1.1 503")
+        assert b"Retry-After: 6" in resp
+
+    def test_oversized_content_length_rejected(self):
+        async def go():
+            reader = self._reader(b"")
+            with pytest.raises(wire.ProtocolError):
+                await wire.read_http_body(
+                    reader, {"content-length": str(10 << 30)})
+
+        asyncio.run(go())
+
+
+class TestPrometheusScrape:
+    TEXT = "\n".join([
+        "# HELP serving_goodput_tokens_per_s help text",
+        "# TYPE serving_goodput_tokens_per_s gauge",
+        "serving_goodput_tokens_per_s 123.5",
+        "serving_queue_depth 4",
+        'serving_cancellations_total{reason="deadline"} 2',
+        'serving_cancellations_total{reason="disconnect"} 3',
+        'serving_ttft_seconds_bucket{le="0.1"} 7',
+        "serving_ttft_seconds_sum 0.9",
+        "serving_ttft_seconds_count 7",
+    ]) + "\n"
+
+    def test_values_and_label_sums(self):
+        vals = wire.parse_prometheus_gauges(self.TEXT)
+        assert vals["serving_goodput_tokens_per_s"] == 123.5
+        assert vals["serving_queue_depth"] == 4
+        # label splits collapse by summation
+        assert vals["serving_cancellations_total"] == 5
+        # histogram series keep their suffixed names — the base gauge
+        # namespace never sees bucket counts
+        assert vals["serving_ttft_seconds_bucket"] == 7
+        assert "serving_ttft_seconds" not in vals
+
+    def test_live_registry_page_parses(self):
+        from flexflow_tpu.observability import get_registry
+
+        m = get_registry()
+        m.counter("serving_net_requests_total").inc(endpoint="health",
+                                                    code=200)
+        vals = wire.parse_prometheus_gauges(m.expose_text())
+        assert vals.get("serving_net_requests_total", 0) >= 1

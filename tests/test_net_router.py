@@ -1,0 +1,223 @@
+"""Replica-router tests (serve/net/router.py, PR 11).
+
+Routing-core units run with injected scrapes (no sockets): the score
+formula, prefix-affinity hit/spill/new transitions, pressure
+spillover, and the circuit breaker.  The end-to-end half spawns two
+REAL replica processes (identical seeds) and pins the acceptance
+surface: affinity routing under tenant traffic, failover with
+deterministic skip-token resume after a mid-stream SIGKILL (the
+relayed stream must equal the surviving replica's own answer token
+for token), and the RouterServer speaking the identical wire protocol
+so a client cannot tell a router from a replica.
+"""
+
+import asyncio
+import os
+import sys
+import time
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from flexflow_tpu.observability import get_ledger, get_registry  # noqa: E402
+from flexflow_tpu.serve.frontend import FrontendClosed  # noqa: E402
+from flexflow_tpu.serve.net.client import NetClient  # noqa: E402
+from flexflow_tpu.serve.net.router import (ReplicaRouter,  # noqa: E402
+                                           RouterServer, spawn_replica)
+
+TELEMETRY_ON = get_ledger().enabled
+
+pytestmark = pytest.mark.skipif(
+    not TELEMETRY_ON, reason="router accounting tests need telemetry")
+
+
+def _prompts(n, length, vocab=120, seed=0):
+    rng = np.random.default_rng(seed)
+    return [rng.integers(4, vocab, length).tolist() for _ in range(n)]
+
+
+def _labels(name):
+    v = (get_registry().snapshot().get("counters") or {}).get(name, {})
+    return dict(v.get("labels", {})) if isinstance(v, dict) else {}
+
+
+def _mk_router(**kw):
+    kw.setdefault("scrape_interval_s", 9999.0)   # no background scrape
+    return ReplicaRouter(["http://127.0.0.1:1", "http://127.0.0.1:2"],
+                         **kw)
+
+
+def _inject(router, scrapes):
+    """Install fake scrape results and rescore (the unit-test stand-in
+    for a /metrics pull)."""
+    for r, scrape in zip(router.replicas, scrapes):
+        r.scrape = dict(scrape)
+        r.scrape_ok = True
+    router._rescore()
+
+
+class TestRoutingCore:
+    def test_affinity_key_tenant_and_content_hash(self):
+        router = _mk_router()
+        assert router.affinity_key([1, 2], "acme") == "t:acme"
+        k1 = router.affinity_key(list(range(40)), None)
+        k2 = router.affinity_key(list(range(40)) + [999], None)
+        assert k1 == k2          # only the head participates
+        assert k1 != router.affinity_key([7] + list(range(39)), None)
+
+    def test_score_prefers_goodput_and_headroom_over_load(self):
+        router = _mk_router()
+        _inject(router, [
+            {"serving_goodput_tokens_per_s": 100.0,
+             "serving_kv_frames_free": 10.0, "serving_queue_depth": 0.0},
+            {"serving_goodput_tokens_per_s": 10.0,
+             "serving_kv_frames_free": 0.0, "serving_queue_depth": 8.0,
+             "serving_active_requests": 4.0},
+        ])
+        r1, r2 = router.replicas
+        assert r1.score > r2.score
+        target, outcome = router.pick("t:new-tenant")
+        assert target is r1 and outcome == "new"
+
+    def test_affinity_hit_then_pressure_spill_and_remap(self):
+        router = _mk_router(spill_queue_factor=2.0, spill_queue_slack=2.0)
+        _inject(router, [{"serving_queue_depth": 0.0},
+                         {"serving_queue_depth": 0.0}])
+        first, outcome = router.pick("t:acme")
+        assert outcome == "new"
+        again, outcome = router.pick("t:acme")
+        assert again is first and outcome == "hit"
+        # pile load onto the mapped replica: next pick spills to the
+        # other one and REMAPS the key there
+        loaded = {"serving_queue_depth": 50.0}
+        idle = {"serving_queue_depth": 0.0}
+        _inject(router, [loaded, idle] if first is router.replicas[0]
+                else [idle, loaded])
+        spilled, outcome = router.pick("t:acme")
+        assert spilled is not first and outcome == "spill"
+        # pressure gone: the REMAPPED replica is now the hit target
+        _inject(router, [idle, idle])
+        target, outcome = router.pick("t:acme")
+        assert target is spilled and outcome == "hit"
+
+    def test_zero_frame_headroom_spills_when_peer_has_frames(self):
+        router = _mk_router()
+        _inject(router, [
+            {"serving_kv_frames_free": 0.0, "serving_queue_depth": 0.0},
+            {"serving_kv_frames_free": 6.0, "serving_queue_depth": 0.0},
+        ])
+        router._remember("t:acme", router.replicas[0].url)
+        target, outcome = router.pick("t:acme")
+        assert target is router.replicas[1] and outcome == "spill"
+
+    def test_circuit_open_excludes_until_cooldown(self):
+        router = _mk_router(circuit_cooldown_s=0.05)
+        _inject(router, [{}, {}])
+        r1, r2 = router.replicas
+        router._remember("t:acme", r1.url)
+        before = _labels("router_circuit_open_total")
+        router._open_circuit(r1)
+        after = _labels("router_circuit_open_total")
+        assert sum(after.values()) == sum(before.values()) + 1
+        target, outcome = router.pick("t:acme")
+        assert target is r2 and outcome == "spill"
+        time.sleep(0.06)                # cooldown expires
+        assert r1.available(time.monotonic())
+
+    def test_all_replicas_down_raises_frontend_closed(self):
+        router = _mk_router(circuit_cooldown_s=60.0)
+        for r in router.replicas:
+            router._open_circuit(r)
+        with pytest.raises(FrontendClosed):
+            router.pick("t:acme")
+
+    def test_affinity_map_is_capacity_bounded(self):
+        router = _mk_router(affinity_capacity=4)
+        _inject(router, [{}, {}])
+        for i in range(10):
+            router.pick(f"t:tenant{i}")
+        assert len(router._affinity) == 4
+        assert "t:tenant9" in router._affinity   # newest survive
+
+
+class TestRouterEndToEnd:
+    """Two real replica processes (identical seeds — replicas of one
+    model) behind the router."""
+
+    @pytest.fixture(scope="class")
+    def replicas(self):
+        reps = [spawn_replica(rows=2, decode_block=4, seed=0)
+                for _ in range(2)]
+        yield reps
+        for r in reps:
+            r.close()
+
+    def test_affinity_failover_and_wire_surface(self, replicas):
+        prompts = _prompts(3, 12, seed=11)
+
+        async def go():
+            router = ReplicaRouter([r.url for r in replicas],
+                                   scrape_interval_s=0.1,
+                                   circuit_cooldown_s=0.5)
+            out = {}
+            async with router:
+                # tenant traffic, two rounds: round 2 must hit the map
+                before_hits = _labels("router_affinity_total").get(
+                    "outcome=hit", 0)
+                for _ in range(2):
+                    for tenant in ("acme", "globex"):
+                        rs = await router.generate(prompts[0],
+                                                   max_new_tokens=8,
+                                                   tenant=tenant)
+                        assert len(await rs.result()) == 8
+                out["hits"] = (_labels("router_affinity_total").get(
+                    "outcome=hit", 0) - before_hits)
+
+                # RouterServer: the same wire protocol in front of the
+                # router — a NetClient cannot tell it from a replica
+                srv = RouterServer(router)
+                await srv.start()
+                cl = NetClient(srv.url)
+                ws = await cl.generate(prompts[2], max_new_tokens=8,
+                                       tenant="acme")
+                via_router = await ws.result()
+                direct = await (await NetClient(
+                    replicas[0].url).generate(
+                        prompts[2], max_new_tokens=8)).result()
+                out["router_wire_parity"] = via_router == direct
+                # skip_tokens through the router applies exactly ONCE
+                # (upstream): the relay must be the direct answer
+                # minus its first k tokens, not minus 2k
+                ws = await cl.generate(prompts[2], max_new_tokens=8,
+                                       tenant="acme", skip_tokens=3)
+                out["skip_once"] = (await ws.result()) == direct[3:]
+                srv._server.close()
+
+                # kill the bound replica mid-stream: failover must
+                # resume deterministically
+                rs = await router.generate(prompts[1],
+                                           max_new_tokens=24)
+                async for _ in rs:
+                    if len(rs.tokens) >= 4:
+                        break
+                bound = rs._replica.url
+                victim = next(r for r in replicas if r.url == bound)
+                survivor = next(r for r in replicas if r.url != bound)
+                victim.kill()
+                out["tokens"] = await rs.result()
+                out["failovers"] = rs.failovers
+                out["ref"] = await (await NetClient(
+                    survivor.url).generate(
+                        prompts[1], max_new_tokens=24)).result()
+            return out
+
+        out = asyncio.run(go())
+        assert out["hits"] >= 2
+        assert out["router_wire_parity"]
+        assert out["skip_once"]
+        assert out["failovers"] >= 1
+        assert len(out["tokens"]) == 24
+        assert out["tokens"] == out["ref"]   # byte-identical resume

@@ -1,0 +1,295 @@
+"""Wire-server acceptance tests (serve/net/server.py, PR 11).
+
+Everything here runs over REAL loopback sockets against the stdlib-
+asyncio server — the acceptance surface of the network serving
+tentpole:
+
+- streamed greedy tokens byte-identical to in-process streams of the
+  same engine (the wire must be a transparent transport);
+- backpressure on the wire: 429 + retry_after_s from the front-end's
+  ``Overloaded``;
+- deadline propagation: the ``X-FFServe-Deadline-S`` header enforces a
+  mid-stream cancel server-side;
+- cancellation-on-disconnect END TO END: a client socket abort
+  mid-stream frees the engine row AND the KV pager's pages back to
+  baseline, finalizes the ledger timeline ``cancelled=True`` and ticks
+  ``serving_cancellations_total{reason=disconnect}``;
+- the cancel endpoint, health/stats/metrics scrapes, 404/405/400
+  mapping, and graceful drain (503 for new work, then closed).
+"""
+
+import asyncio
+import json
+import os
+import sys
+import time
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from flexflow_tpu.observability import (SLOPolicy, get_ledger,  # noqa: E402
+                                        get_registry)
+from flexflow_tpu.serve.frontend import (AsyncServeFrontend,  # noqa: E402
+                                         FrontendClosed, Overloaded,
+                                         RequestAborted, ShedPolicy)
+from flexflow_tpu.serve.net import protocol as wire  # noqa: E402
+from flexflow_tpu.serve.net.client import NetClient  # noqa: E402
+from flexflow_tpu.serve.net.server import ServeNetServer  # noqa: E402
+from flexflow_tpu.serving.kv_pager import KVPager  # noqa: E402
+from tools.ffload import build_tiny_engine  # noqa: E402
+
+TELEMETRY_ON = get_ledger().enabled
+
+pytestmark = pytest.mark.skipif(
+    not TELEMETRY_ON, reason="wire accounting tests need telemetry")
+
+
+def _prompts(n, length, vocab=120, seed=0):
+    rng = np.random.default_rng(seed)
+    return [rng.integers(4, vocab, length).tolist() for _ in range(n)]
+
+
+def _counter(name):
+    v = (get_registry().snapshot().get("counters") or {}).get(name, 0)
+    return float(v.get("total", 0) if isinstance(v, dict) else v)
+
+
+def _labels(name):
+    v = (get_registry().snapshot().get("counters") or {}).get(name, {})
+    return dict(v.get("labels", {})) if isinstance(v, dict) else {}
+
+
+class TestWireServer:
+    @pytest.fixture(scope="class")
+    def engine(self):
+        return build_tiny_engine(max_requests=2, seed=7)
+
+    def test_wire_parity_byte_identical(self, engine):
+        """The tentpole acceptance: greedy tokens streamed over a real
+        socket equal the same engine's in-process streams exactly."""
+        im, mid, rm = engine
+        prompts = _prompts(3, 10, seed=1)
+
+        async def go():
+            fe = AsyncServeFrontend(im, mid, rm, reap_interval_s=0.005)
+            async with fe:
+                ref = []
+                for p in prompts:
+                    s = await fe.submit(list(p), max_new_tokens=12)
+                    ref.append(await s.result())
+                async with ServeNetServer(fe) as srv:
+                    cl = NetClient(srv.url)
+                    got = []
+                    for p in prompts:
+                        ws = await cl.generate(list(p),
+                                               max_new_tokens=12)
+                        got.append(await ws.result())
+                    return ref, got
+
+        ref, got = asyncio.run(go())
+        assert got == ref
+        assert all(len(t) == 12 for t in got)
+
+    def test_overload_maps_to_429_with_retry_hint(self, engine):
+        im, mid, rm = engine
+
+        async def go():
+            fe = AsyncServeFrontend(
+                im, mid, rm, reap_interval_s=0.005,
+                shed_policy=ShedPolicy(max_pending=1, shed_watermark=5))
+            async with fe:
+                async with ServeNetServer(fe) as srv:
+                    cl = NetClient(srv.url)
+                    first = await cl.generate(_prompts(1, 8, seed=2)[0],
+                                              max_new_tokens=32)
+                    err, extra = None, []
+                    for _ in range(6):
+                        try:
+                            extra.append(await cl.generate(
+                                _prompts(1, 8, seed=3)[0],
+                                max_new_tokens=32))
+                        except Overloaded as e:
+                            err = e
+                            break
+                    for ws in [first] + extra:
+                        try:
+                            await ws.result()
+                        except RequestAborted:
+                            pass
+                    return err
+
+        err = asyncio.run(go())
+        assert err is not None and err.retry_after_s > 0
+
+    def test_deadline_header_cancels_mid_stream(self, engine):
+        im, mid, rm = engine
+        before = _labels("serving_cancellations_total").get(
+            "reason=deadline", 0)
+
+        async def go():
+            fe = AsyncServeFrontend(im, mid, rm, reap_interval_s=0.005)
+            async with fe:
+                async with ServeNetServer(fe) as srv:
+                    cl = NetClient(srv.url)
+                    ws = await cl.generate(_prompts(1, 8, seed=4)[0],
+                                           max_new_tokens=200,
+                                           deadline_s=0.01)
+                    with pytest.raises(RequestAborted) as ei:
+                        await ws.result()
+                    return ei.value
+
+        err = asyncio.run(go())
+        assert err.reason == "deadline"
+        assert _labels("serving_cancellations_total").get(
+            "reason=deadline", 0) > before
+
+    def test_cancel_endpoint_aborts_stream(self, engine):
+        im, mid, rm = engine
+
+        async def go():
+            fe = AsyncServeFrontend(im, mid, rm, reap_interval_s=0.005)
+            async with fe:
+                async with ServeNetServer(fe) as srv:
+                    cl = NetClient(srv.url)
+                    ws = await cl.generate(_prompts(1, 8, seed=5)[0],
+                                           max_new_tokens=200)
+                    async for _ in ws:
+                        break               # stream is live
+                    assert await cl.cancel(ws.guid, "client")
+                    with pytest.raises(RequestAborted) as ei:
+                        await ws.result()
+                    return ei.value
+
+        err = asyncio.run(go())
+        assert err.reason == "client"
+
+    def test_health_stats_metrics_and_errors(self, engine):
+        im, mid, rm = engine
+
+        async def go():
+            fe = AsyncServeFrontend(im, mid, rm, reap_interval_s=0.005)
+            async with fe:
+                async with ServeNetServer(fe) as srv:
+                    cl = NetClient(srv.url)
+                    hel = await cl.health()
+                    stats = await cl.stats()
+                    text = await cl.metrics_text()
+                    s404, _ = await cl.request_json("GET", "/nope")
+                    s405, _ = await cl.request_json("GET",
+                                                    wire.P_GENERATE)
+                    s400, _ = await cl.request_json(
+                        "POST", wire.P_GENERATE, {"prompt": []})
+                    return hel, stats, text, s404, s405, s400
+
+        hel, stats, text, s404, s405, s400 = asyncio.run(go())
+        assert hel["ok"] and hel["state"] == "serving"
+        assert hel["protocol"] == wire.PROTOCOL_VERSION
+        assert "counters" in stats["metrics"]
+        assert stats["frontend"]["failed"] is None
+        assert "serving_net_requests_total" in text
+        assert (s404, s405, s400) == (404, 405, 400)
+
+    def test_string_prompt_without_tokenizer_is_400(self, engine):
+        im, mid, rm = engine
+        assert rm.tokenizer is None
+
+        async def go():
+            fe = AsyncServeFrontend(im, mid, rm, reap_interval_s=0.005)
+            async with fe:
+                async with ServeNetServer(fe) as srv:
+                    status, obj = await NetClient(srv.url).request_json(
+                        "POST", wire.P_GENERATE, {"prompt": "hello"})
+                    return status, obj
+
+        status, obj = asyncio.run(go())
+        assert status == 400 and obj["error"] == "bad_request"
+
+    def test_graceful_drain_503s_new_work_and_closes(self, engine):
+        im, mid, rm = engine
+
+        async def go():
+            fe = AsyncServeFrontend(im, mid, rm, reap_interval_s=0.005)
+            async with fe:
+                srv = ServeNetServer(fe, drain_timeout_s=5.0)
+                await srv.start()
+                cl = NetClient(srv.url)
+                ws = await cl.generate(_prompts(1, 8, seed=6)[0],
+                                       max_new_tokens=6)
+                srv.begin_drain()           # the SIGTERM path
+                hel = await cl.health()
+                with pytest.raises(FrontendClosed):
+                    await cl.generate(_prompts(1, 8, seed=6)[0],
+                                      max_new_tokens=6)
+                # the in-flight stream still flushes to completion
+                toks = await ws.result()
+                await asyncio.wait_for(srv.wait_closed(), 10.0)
+                return hel, toks
+
+        hel, toks = asyncio.run(go())
+        assert hel["state"] == "draining"
+        assert len(toks) == 6
+        assert not rm.pending and not rm.running
+
+
+class TestDisconnectEndToEnd:
+    """Satellite: a real socket client dropping mid-stream must leave
+    the engine exactly as a retirement would — pager frames back at
+    baseline, ledger finalized cancelled=True, and the disconnect
+    cancellation counted."""
+
+    def test_socket_abort_frees_pager_and_finalizes_ledger(self):
+        get_ledger().clear()
+        im, mid, _ = build_tiny_engine(max_requests=2, seed=9)
+        pager = KVPager(64, page_len=64,
+                        bytes_per_token=im.kv_cache_stats(
+                            mid).bytes_per_token)
+        from flexflow_tpu.serving import RequestManager
+
+        rm = RequestManager(max_requests_per_batch=2,
+                            max_tokens_per_batch=64,
+                            max_sequence_length=256, decode_block=4,
+                            kv_pager=pager)
+        free_baseline = pager.free_pages
+        before_cancel = _labels("serving_cancellations_total").get(
+            "reason=disconnect", 0)
+        before_disc = _counter("serving_net_disconnects_total")
+
+        async def go():
+            fe = AsyncServeFrontend(im, mid, rm, reap_interval_s=0.005)
+            async with fe:
+                async with ServeNetServer(fe) as srv:
+                    cl = NetClient(srv.url)
+                    ws = await cl.generate(_prompts(1, 16, seed=8)[0],
+                                           max_new_tokens=128)
+                    async for _ in ws:
+                        if len(ws.tokens) >= 3:
+                            break
+                    guid = ws.guid
+                    ws.disconnect()        # hard socket abort
+                    deadline = time.monotonic() + 10.0
+                    while time.monotonic() < deadline:
+                        if (not rm.running and not rm.pending
+                                and pager.free_pages == free_baseline):
+                            break
+                        await asyncio.sleep(0.02)
+                    return guid
+
+        guid = asyncio.run(go())
+        # pager frames back at baseline — nothing leaked for the dead
+        # client, no spills pending
+        assert pager.free_pages == free_baseline
+        snap = pager.snapshot()
+        assert not snap["leases"] and not snap["spilled_guids"]
+        # ledger timeline finalized as a cancellation with the tokens
+        # it really streamed
+        tl = get_ledger().timeline(guid)
+        assert tl is not None and tl["cancelled"]
+        assert tl["cancel_reason"] == "disconnect"
+        assert tl["tokens"] >= 3
+        # and both sides of the wire counted it
+        assert _labels("serving_cancellations_total").get(
+            "reason=disconnect", 0) > before_cancel
+        assert _counter("serving_net_disconnects_total") > before_disc

@@ -21,7 +21,15 @@ statically:
   ``int()`` / ``.item()`` / … — the shared materializer surface from
   ``_jax_common``), with the same assignment-based taint the
   host-sync-dataflow rule uses: a binding from a dispatch call taints,
-  aliases propagate, materializer-rooted assignments untaint.
+  aliases propagate, materializer-rooted assignments untaint;
+- blocking NETWORK calls — ``socket.create_connection`` /
+  ``socket.getaddrinfo``, ``http.client.HTTP(S)Connection`` /
+  ``.getresponse()``, ``urllib.request.urlopen``, ``requests.*`` and
+  raw socket ``.recv``/``.recv_into``/``.sendall``/``.makefile`` —
+  inside an ``async def``.  The wire serving surface (serve/net/) is
+  pure-asyncio by contract: one synchronous RTT on the event loop
+  stalls every connected SSE stream at once.  Use
+  ``asyncio.open_connection`` / stream read-write instead.
 
 Nested ``def``/``lambda`` bodies are DEFERRED code (typically shipped
 to an executor or the driver thread) and are skipped; nested ``async
@@ -46,6 +54,22 @@ BLOCKING_METHODS = (set(DISPATCH_METHODS)
 #: plain-name calls that block (resolved by dotted name)
 BLOCKING_FUNCS = {"time.sleep", "generate_spec_infer",
                   "generate_spec_infer_device"}
+#: dotted names whose call is a synchronous network round trip (DNS,
+#: connect, full HTTP exchange) — the serve/net event loop must go
+#: through asyncio.open_connection / StreamReader-Writer instead
+BLOCKING_NET_FUNCS = {
+    "socket.create_connection", "socket.getaddrinfo",
+    "http.client.HTTPConnection", "http.client.HTTPSConnection",
+    "urllib.request.urlopen",
+    "requests.get", "requests.post", "requests.put", "requests.head",
+    "requests.delete", "requests.request",
+}
+#: attribute calls that block on a raw socket / http.client response
+#: (names chosen to be socket-specific: .recv/.recv_into/.sendall/
+#: .makefile/.getresponse do not collide with repo-local APIs; the
+#: generic .connect/.accept/.send are deliberately NOT matched)
+BLOCKING_NET_METHODS = {"recv", "recv_into", "sendall", "makefile",
+                        "getresponse"}
 
 
 class AsyncioBlockingRule(Rule):
@@ -110,6 +134,23 @@ class AsyncioBlockingRule(Rule):
                     module, node,
                     f"{what}; inside an async def this stalls every "
                     f"connected client (run it on the driver thread)"))
+                continue
+            if dn in BLOCKING_NET_FUNCS:
+                findings.append(self.finding(
+                    module, node,
+                    f"'{dn}()' is a synchronous network round trip "
+                    f"inside an async def — one blocked RTT stalls "
+                    f"every connected stream; use asyncio.open_"
+                    f"connection / non-blocking stream I/O instead"))
+                continue
+            if (isinstance(f, ast.Attribute)
+                    and f.attr in BLOCKING_NET_METHODS):
+                findings.append(self.finding(
+                    module, node,
+                    f"'.{f.attr}()' blocks on socket/HTTP I/O inside "
+                    f"an async def — the event loop must stay non-"
+                    f"blocking; use asyncio StreamReader/StreamWriter "
+                    f"(or run the exchange in an executor)"))
                 continue
             if (isinstance(f, ast.Attribute)
                     and f.attr in BLOCKING_METHODS):

@@ -10,8 +10,17 @@ Usage::
   python tools/ffload.py [--requests N] [--arrival poisson|burst|closed]
                          [--rate RPS] [--fault none|disconnects|cancels|
                           deadline_storm|stall|mixed]
+                         [--transport http://host:port]
                          [--slo-ttft S] [--slo-tpot S] [--seed K]
                          [--json] [--selftest]
+
+``--transport http://host:port`` points the SAME client swarm at a
+serve/net wire server or router instead of an in-process engine: the
+disconnect fault becomes a real socket abort (exercising the server's
+cancellation-on-disconnect watcher end-to-end) and the report builds
+from the server's ``/v1/stats`` deltas.  The ``stall`` profiles need
+in-process injection and are refused over a transport.  ``--selftest``
+stays deterministic and in-process.
 
 Traffic (``TrafficProfile``):
 
@@ -58,7 +67,7 @@ import json
 import os
 import sys
 import time
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
@@ -231,6 +240,29 @@ def _counter_total(snap: Dict[str, Any], name: str) -> float:
     return float(v.get("total", 0) if isinstance(v, dict) else v)
 
 
+async def _drive_clients(frontend, traffic: TrafficProfile,
+                         fault: FaultProfile, rng
+                         ) -> Tuple[Dict[str, int], float]:
+    """The shared client swarm: submit per the arrival process, stream,
+    inject client-side faults.  ``frontend`` is anything with the
+    submit/cancel surface — the in-process AsyncServeFrontend or the
+    wire HttpFrontend (serve/net/client.py), which is how ``--transport``
+    reuses every fault profile over real sockets."""
+    prompts = make_prompts(traffic, rng)
+    outcomes: Dict[str, int] = {}
+    t0 = time.monotonic()
+    tasks = []
+    async for i, gap in _arrival_gaps(traffic, rng):
+        if gap:
+            await asyncio.sleep(gap)
+        out_len = int(rng.choice(traffic.output_lens))
+        tasks.append(asyncio.ensure_future(
+            _client(frontend, i, prompts[i], out_len, fault, rng,
+                    outcomes)))
+    await asyncio.gather(*tasks)
+    return outcomes, time.monotonic() - t0
+
+
 async def run_load(frontend, traffic: TrafficProfile,
                    fault: FaultProfile,
                    stall_injector: Optional[StallInjector] = None
@@ -243,20 +275,8 @@ async def run_load(frontend, traffic: TrafficProfile,
     from flexflow_tpu.observability import get_ledger, get_registry
 
     rng = np.random.default_rng(traffic.seed)
-    prompts = make_prompts(traffic, rng)
     before = get_registry().snapshot()
-    outcomes: Dict[str, int] = {}
-    t0 = time.monotonic()
-    tasks = []
-    async for i, gap in _arrival_gaps(traffic, rng):
-        if gap:
-            await asyncio.sleep(gap)
-        out_len = int(rng.choice(traffic.output_lens))
-        tasks.append(asyncio.ensure_future(
-            _client(frontend, i, prompts[i], out_len, fault, rng,
-                    outcomes)))
-    await asyncio.gather(*tasks)
-    wall = time.monotonic() - t0
+    outcomes, wall = await _drive_clients(frontend, traffic, fault, rng)
     after = get_registry().snapshot()
     rep: Dict[str, Any] = {
         "fault_profile": fault.name,
@@ -279,6 +299,54 @@ async def run_load(frontend, traffic: TrafficProfile,
     slo = get_ledger().slo_report()
     if slo is not None:
         rep["slo"] = slo
+        rep["goodput_tokens_per_s"] = slo["goodput_tokens_per_s"]
+        rep["ttft_attainment"] = slo["ttft_attainment"]
+        rep["tpot_attainment"] = slo["tpot_attainment"]
+    return rep
+
+
+async def run_load_net(frontend, traffic: TrafficProfile,
+                       fault: FaultProfile) -> Dict[str, Any]:
+    """Wire-transport twin of :func:`run_load`: the same synthetic
+    client swarm, but driven over REAL sockets against a serve.net
+    server or router (``frontend`` is an
+    :class:`~flexflow_tpu.serve.net.client.HttpFrontend`) — a
+    disconnect fault is a genuine socket abort the server's EOF
+    watcher must catch, not an in-process method call.  Counters and
+    the SLO window live in the SERVER process, so the report builds
+    from ``/v1/stats`` deltas; the SLO block is the server's
+    cumulative window (``slo_window`` marks that), since a remote
+    ledger cannot be cleared per profile."""
+    import numpy as np
+
+    rng = np.random.default_rng(traffic.seed)
+    before = await frontend.stats()
+    outcomes, wall = await _drive_clients(frontend, traffic, fault, rng)
+    after = await frontend.stats()
+    b = before.get("metrics") or {}
+    a = after.get("metrics") or {}
+    rep: Dict[str, Any] = {
+        "fault_profile": fault.name,
+        "transport": frontend.client.base_url,
+        "traffic": dataclasses.asdict(traffic),
+        "wall_s": round(wall, 3),
+        "outcomes": dict(sorted(outcomes.items())),
+        "counters": {
+            name: _counter_total(a, name) - _counter_total(b, name)
+            for name in ("serving_cancellations_total",
+                         "serving_shed_total",
+                         "serving_rejected_total",
+                         "serving_tokens_generated_total",
+                         "serving_net_requests_total",
+                         "serving_net_stream_tokens_total",
+                         "serving_net_disconnects_total",
+                         "router_failovers_total")},
+        "stall": {"injected": False, "bundle": None},
+    }
+    slo = after.get("slo")
+    if slo:
+        rep["slo"] = slo
+        rep["slo_window"] = "server-cumulative"
         rep["goodput_tokens_per_s"] = slo["goodput_tokens_per_s"]
         rep["ttft_attainment"] = slo["ttft_attainment"]
         rep["tpot_attainment"] = slo["tpot_attainment"]
@@ -500,6 +568,11 @@ def main(argv) -> int:
                     help="poisson arrival rate (requests/s)")
     ap.add_argument("--fault", choices=sorted(FAULT_PROFILES),
                     default="none")
+    ap.add_argument("--transport", default=None, metavar="URL",
+                    help="http://host:port of a serve.net server or "
+                         "router: drive it over real sockets instead "
+                         "of building an in-process engine "
+                         "(disconnect faults become socket aborts)")
     ap.add_argument("--tenants", type=int, default=0,
                     help="shared-prefix tenant groups (exercises the "
                          "radix prefix pool; 0 = independent prompts)")
@@ -514,6 +587,24 @@ def main(argv) -> int:
     args = ap.parse_args(argv)
     if args.selftest:
         return selftest()
+    fault = FAULT_PROFILES[args.fault]
+    if args.transport:
+        if fault.stall_after_steps:
+            ap.error(f"--fault {args.fault} injects an in-process "
+                     f"driver stall and cannot run over --transport")
+        from flexflow_tpu.serve.net.client import HttpFrontend
+
+        traffic = TrafficProfile(n_requests=args.requests,
+                                 arrival=args.arrival,
+                                 rate_rps=args.rate,
+                                 tenants=args.tenants, seed=args.seed)
+        rep = asyncio.run(run_load_net(HttpFrontend(args.transport),
+                                       traffic, fault))
+        if args.json:
+            print(json.dumps(rep, indent=1, default=str))
+        else:
+            print(format_report(rep))
+        return 0
 
     from flexflow_tpu.observability import SLOPolicy, get_ledger
 
@@ -524,7 +615,6 @@ def main(argv) -> int:
     traffic = TrafficProfile(n_requests=args.requests,
                              arrival=args.arrival, rate_rps=args.rate,
                              tenants=args.tenants, seed=args.seed)
-    fault = FAULT_PROFILES[args.fault]
     reports = asyncio.run(_run_profiles(
         im, mid, rm, traffic, [fault],
         stall_timeout=(args.stall_timeout

@@ -44,6 +44,18 @@
 (cd "$(dirname "$0")/.." \
  && env JAX_PLATFORMS=cpu python tools/ffload.py --selftest >/dev/null) \
  || { echo "ffload/front-end selftest FAILED" >&2; exit 1; }
+# serve.net smoke: the network serving surface end-to-end — a loopback
+# HTTP/SSE server over a tiny engine (streamed greedy tokens must be
+# byte-identical to in-process streams; a socket abort mid-stream must
+# cancel server-side) plus a 2-replica router smoke (spawned CPU
+# replica processes, tenant affinity hits, and a mid-stream replica
+# SIGKILL recovering via deterministic skip-token resume) — so a
+# broken wire layer fails CI before ffload --transport or a BENCH
+# `net` round depends on it.
+(cd "$(dirname "$0")/.." \
+ && env JAX_PLATFORMS=cpu python -m flexflow_tpu.serve.net --selftest \
+    >/dev/null) \
+ || { echo "serve.net wire/router selftest FAILED" >&2; exit 1; }
 # KV-pager smoke: pure-host allocator accounting (lease/release/refs,
 # page-alignment validation, spill-store budgeting, restore-vs-
 # recompute pricing) so a broken pager fails CI in milliseconds before
