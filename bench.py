@@ -1893,6 +1893,147 @@ def bench_kv_dtype(model_builder=None, max_requests=8, prompt_len=32,
     return (head, *extras)
 
 
+def bench_mixed(model_builder=None, max_requests=4, bystander_prompt=24,
+                bystander_new=192, victim_prompt=576, victim_new=8,
+                max_seq_length=1024, max_tokens_per_batch=256,
+                decode_block=8, admit_after=16):
+    """Stall-free mixed-batch A/B (`mixed` mode): the long-prompt
+    INTERFERENCE scenario — ``max_requests - 1`` short-prompt bystanders
+    decoding a steady stream, one long-prompt victim admitted
+    mid-stream (deterministically, after ``admit_after`` committed
+    bystander tokens) — served twice:
+
+    - **separate-dispatch** arm (``hybrid_steps=False``): the legacy
+      path, where the victim's chunked prefill runs every row at the
+      prefill chunk width — each chunk step is one bystander token at
+      chunk-step latency (the BENCH_r03 8k-prompt TTFT that was
+      simultaneously everyone else's TPOT spike);
+    - **hybrid-step** arm (``hybrid_steps=True``): the victim's prefill
+      rides the decode dispatches as roofline-budgeted rider chunks
+      (serving/batch_config.HybridBatchConfig).
+
+    Headline: bystander TPOT p99 ratio (separate / hybrid — the stall
+    relief); victim TTFT per arm rides the record (the acceptance gate
+    is <= 10% regression), plus greedy parity across arms (scheduling
+    may change WHEN rows compute, never WHAT).  Per-token gaps come
+    from the driver-thread on_commit hook (block commits normalize by
+    their token count), so the p99 is the stall signature itself, not a
+    retirement-time mean.
+
+    ``model_builder``: optional ``() -> (model, vocab_size,
+    cache_dtype)`` override for the CPU test suite (default: the 1.4B
+    bench LLaMA in bf16)."""
+    from flexflow_tpu import FFConfig, Model
+    from flexflow_tpu.models.llama import LLAMAConfig, create_llama_model
+    from flexflow_tpu.serving import InferenceManager, RequestManager
+
+    if model_builder is None:
+        def model_builder():
+            from flexflow_tpu.fftype import DataType
+
+            cfg = LLAMAConfig(
+                vocab_size=32000, hidden_size=2048, intermediate_size=5504,
+                num_hidden_layers=24, num_attention_heads=16,
+                num_key_value_heads=4,
+                max_position_embeddings=max_seq_length)
+            model = Model(FFConfig(computation_dtype="bfloat16"),
+                          name="llama_mixed_bench")
+            create_llama_model(model, cfg, max_requests=max_requests,
+                               dtype=DataType.HALF)
+            return model, cfg.vocab_size, None
+
+    model, vocab, cache_dtype = model_builder()
+    im = InferenceManager(model.config)
+    mid = im.compile_model_and_allocate_buffer(
+        model, max_requests=max_requests, max_seq_length=max_seq_length,
+        prefill_chunk=max_tokens_per_batch, cache_dtype=cache_dtype,
+        kv_cache_dtype=_KV_DTYPE)
+
+    rng = np.random.default_rng(0)
+    bystanders = [rng.integers(4, vocab - 1, bystander_prompt).tolist()
+                  for _ in range(max_requests - 1)]
+    victim_tokens = rng.integers(4, vocab - 1, victim_prompt).tolist()
+
+    def run(hybrid):
+        rm = RequestManager(max_requests_per_batch=max_requests,
+                            max_tokens_per_batch=max_tokens_per_batch,
+                            max_sequence_length=max_seq_length,
+                            decode_block=decode_block,
+                            hybrid_steps=hybrid)
+        stamps = {}
+        state = {"committed": 0, "victim": None}
+
+        def on_commit(req, toks):
+            stamps.setdefault(req.guid, []).append(
+                (time.monotonic(), len(toks)))
+            state["committed"] += len(toks)
+            if (state["victim"] is None
+                    and state["committed"] >= admit_after):
+                # driver-thread registration: deterministic across arms
+                # (same committed-token count -> same logical admit
+                # point), unlike a wall-clock timer
+                state["victim"] = rm.register_new_request(
+                    list(victim_tokens), max_new_tokens=victim_new)
+
+        rm.on_commit = on_commit
+        reqs = [rm.register_new_request(list(p),
+                                        max_new_tokens=bystander_new)
+                for p in bystanders]
+        rm.generate_incr_decoding(im, mid, reqs)
+        victim = state["victim"]
+        assert victim is not None and victim.status == victim.COMPLETED, \
+            "victim was never admitted mid-stream (scenario broken)"
+        gaps = []
+        for r in reqs:
+            ss = stamps.get(r.guid) or []
+            for (t0, _n0), (t1, n1) in zip(ss, ss[1:]):
+                gaps.extend([(t1 - t0) / max(1, n1)] * n1)
+        return {
+            "tpot_p50_s": float(np.percentile(gaps, 50)) if gaps else 0.0,
+            "tpot_p99_s": float(np.percentile(gaps, 99)) if gaps else 0.0,
+            "victim_ttft_s": victim.profile.ttft_s() or 0.0,
+            "tokens": ([list(r.tokens) for r in reqs]
+                       + [list(victim.tokens)]),
+        }
+
+    run(True)        # warmup: compile both arms' shape buckets
+    run(False)
+    _clear_ledger_window()
+    hyb = run(True)
+    sep = run(False)
+    _note_kv(im, mid, "mixed")
+    parity = hyb["tokens"] == sep["tokens"]
+    ttft_ratio = hyb["victim_ttft_s"] / max(1e-9, sep["victim_ttft_s"])
+    head = {
+        "metric": "mixed_hybrid_bystander_tpot_p99_speedup",
+        "value": round(sep["tpot_p99_s"] / max(1e-9, hyb["tpot_p99_s"]),
+                       3),
+        "unit": "x (separate-dispatch bystander TPOT p99 / hybrid-step)",
+        "methodology": (f"interference,{max_requests - 1}bystanders+"
+                        f"1x{victim_prompt}prompt@{admit_after}tok,"
+                        f"greedy,best-of-1"),
+        "vs_baseline": 0,
+        "separate_tpot_p99_ms": round(sep["tpot_p99_s"] * 1e3, 2),
+        "hybrid_tpot_p99_ms": round(hyb["tpot_p99_s"] * 1e3, 2),
+        "separate_victim_ttft_s": round(sep["victim_ttft_s"], 4),
+        "hybrid_victim_ttft_s": round(hyb["victim_ttft_s"], 4),
+        "victim_ttft_ratio": round(ttft_ratio, 3),
+        "victim_ttft_budget_ok": ttft_ratio <= 1.10,
+        "greedy_match": parity,
+    }
+    extras = [
+        {"metric": "mixed_bystander_tpot_p50",
+         "value": round(hyb["tpot_p50_s"] * 1e3, 2), "unit": "ms",
+         "separate_ms": round(sep["tpot_p50_s"] * 1e3, 2),
+         "vs_baseline": 0},
+        {"metric": "mixed_victim_ttft",
+         "value": round(hyb["victim_ttft_s"], 4), "unit": "s",
+         "separate_s": round(sep["victim_ttft_s"], 4),
+         "vs_baseline": 0},
+    ]
+    return (head, *extras)
+
+
 def bench_paged(model_builder=None, max_requests=8, prompt_len=48,
                 new_tokens=48, max_seq_length=512,
                 max_tokens_per_batch=64, decode_block=8, n_requests=24,
@@ -2685,6 +2826,10 @@ def main(which: str, budget=None):
         head, *extras = bench_kv_dtype()
         head["extras"] = extras
         return head
+    if which == "mixed":
+        head, *extras = bench_mixed()
+        head["extras"] = extras
+        return head
     if which == "paged":
         head, *extras = bench_paged()
         head["extras"] = extras
@@ -2701,7 +2846,7 @@ def main(which: str, budget=None):
         raise SystemExit(
             f"unknown bench mode {which!r} (expected all|llama|llama7b|"
             f"spec|spec7b|mnist|kernels|opt|resnet|longctx|quality|"
-            f"distill|crossover|prefix|kvdtype|paged|live|net)")
+            f"distill|crossover|prefix|kvdtype|mixed|paged|live|net)")
 
     # all: headline decode metric + everything else under extras.  Each
     # section runs in its own process lifetime-wise (HBM frees between
@@ -2785,6 +2930,7 @@ def main(which: str, budget=None):
                       + _section(bench_resnet50_dp, "resnet")
                       + _section(bench_prefix, "prefix")
                       + _section(bench_kv_dtype, "kvdtype")
+                      + _section(bench_mixed, "mixed")
                       + _section(bench_paged, "paged")
                       + _section(bench_live, "live")
                       + _section(bench_net, "net")

@@ -231,8 +231,11 @@ def _attend_call(q, ck, cv, depth, active, scale, interpret, ts,
     # and Mosaic skips the duplicate DMA.  Clamp below at 0: a sharded
     # caller may pass negative local depths (shard above the query row's
     # span — fully masked, gated by `active`), and a negative block
-    # index would walk off the cache
-    last = jnp.clip(depth // ts, 0, nt - 1)
+    # index would walk off the cache.  INACTIVE rows prune to tile 0
+    # outright: the hybrid step's decode sub-pass carries the rider
+    # rows inactive at their (deep, mid-prefill) depths, and without
+    # the clamp their whole cache would stream for fully-masked compute
+    last = jnp.where(active > 0, jnp.clip(depth // ts, 0, nt - 1), 0)
 
     alibi = slopes is not None
     kernel = functools.partial(_kernel, ts=ts, kv=KV, g=G, d=D,
@@ -670,7 +673,9 @@ def _paged_attend_call(q, pk, pv, table, depth, active, scale,
     # clamped re-request of a pruned tile never walks off the pool
     # (reads there are fully masked by span <= depth)
     table = jnp.clip(table.astype(jnp.int32), 0, F - 1)
-    last = jnp.clip(depth // L, 0, nt - 1)
+    # inactive rows prune to page 0 like the dense kernel's tile 0 (the
+    # hybrid decode sub-pass carries rider rows inactive at deep depths)
+    last = jnp.where(active > 0, jnp.clip(depth // L, 0, nt - 1), 0)
 
     alibi = slopes is not None
     kernel = functools.partial(_paged_kernel, ts=L, kv=KV, g=G, d=D,

@@ -37,6 +37,18 @@ r5 additions (mirroring kernels/flash_decode.py):
   softmax per shard + the standard flash merge over 'sp'); the chunk
   append handles chunks STRADDLING sp shard boundaries (each shard
   overlays its intersection of [depth, depth+ntok)).
+
+Hybrid steps (stall-free mixed batches): the fused step's RIDER
+sub-pass (inference_manager.hybrid_step) is an ordinary prefill batch
+through these kernels — rider rows active at their budgeted chunk, the
+decode rows inactive.  The inactive-row pruning above is what makes
+that composition cheap: bystander rows clamp to a single K/V tile
+(``has_q & active`` in the ``last`` map), so a mostly-decode batch's
+rider dispatch streams only the riders' caches.  The 16-aligned
+chunk-start and 32-wide int8 RMW-window invariants bound the
+scheduler's rider chunks exactly as they bound separate prefill
+chunks (batch_config.budgeted_chunk keeps budgeted chunks on the same
+pow2 ladder).
 """
 
 from __future__ import annotations

@@ -106,6 +106,27 @@ METRICS_SCHEMA = {
         "help": "Chunk sizes (tokens per row) of scheduled prefill steps.",
         "buckets": TOKEN_BUCKETS,
     },
+    # ------------------------------------------------------- hybrid steps
+    # (stall-free mixed batches: chunked prefill fused into decode
+    # dispatches — request_manager._hybrid_batch / _dispatch_hybrid)
+    "serving_hybrid_steps_total": {
+        "type": "counter",
+        "help": "Mixed-batch (decode rows + prefilling rows) steps by "
+                "dispatch mode: mode=hybrid (ONE fused dispatch — the "
+                "full decode batch at the 1-token path plus a roofline-"
+                "budgeted rider chunk of the prefilling rows) | "
+                "separate (the legacy chunk-wide dispatch every row "
+                "pays for — the BENCH_r03 TPOT-spike class).  An A/B's "
+                "two arms are attributable from one snapshot.",
+    },
+    "serving_hybrid_rider_tokens": {
+        "type": "histogram",
+        "help": "Prefill tokens riding each hybrid step (summed across "
+                "rider rows; the roofline budget caps them so the "
+                "decode rows' TPOT holds — "
+                "search/cost_model.hybrid_rider_budget).",
+        "buckets": TOKEN_BUCKETS,
+    },
     # -------------------------------------------------------- speculation
     "serving_spec_draft_tokens_total": {
         "type": "counter",
@@ -425,6 +446,15 @@ EVENT_SCHEMA = {
     "decode-step": {
         "help": "One decode step or fused K-step decode block dispatched "
                 "(block, rows).",
+    },
+    "hybrid-step": {
+        "help": "One stall-free mixed dispatch: the decode batch plus a "
+                "budgeted rider slice of prefilling rows in ONE device "
+                "program (chunk, rows, decode_rows, rider_rows, "
+                "rider_tokens).  Rider rows additionally land "
+                "guid-scoped prefill-chunk notes with rider=True on "
+                "their ledger timelines (tools/ffreq.py renders the "
+                "spans).",
     },
     "spec-draft": {
         "help": "SSM drafting phase started (ssms, rows).",

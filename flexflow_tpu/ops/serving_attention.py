@@ -44,6 +44,17 @@ D]`` instead of row slabs; scatters/commits resolve positions to
 (frame, in-frame offset) through the table, the flash paths dispatch
 the page-table kernels, and the jnp fallback attends a gathered dense
 view bucketed in whole pages (docs/INTERNALS.md "Paged KV cache").
+
+Hybrid steps (stall-free mixed batches): this op is deliberately
+ROLE-AGNOSTIC.  The fused decode+rider dispatch
+(inference_manager.hybrid_step) runs it twice over the same caches —
+once at chunk 1 with ``active`` = the decode rows, once at the rider
+chunk with ``active`` = the rider rows — so the mixed-row attend is
+mask dataflow, not a new code path: inactive rows' scatters redirect
+and DROP, their attend lanes mask to zeros (and the flash kernels
+prune their tiles), and the two roles share the page-table
+indirection untouched.  Everything hybrid-specific lives in the
+batch/scheduler layers (docs/INTERNALS.md "Hybrid steps").
 """
 
 from __future__ import annotations

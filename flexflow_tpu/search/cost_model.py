@@ -257,6 +257,47 @@ def estimate_op_cost(layer, out_shapes, machine: MachineModel,
     return CostMetrics(fwd, bwd, sync, mem)
 
 
+def hybrid_rider_budget(machine: MachineModel, weight_bytes: int,
+                        weight_elements: int, decode_rows: int,
+                        kv_stream_bytes: int = 0,
+                        slack: float = 1.0) -> int:
+    """Rider-token knee for the stall-free hybrid step (ROADMAP "fuse
+    chunked prefill into decode steps"; the serving twin of
+    :func:`estimate_op_cost`'s compute/bandwidth max): the largest
+    prefill chunk whose sub-pass stays BANDWIDTH-bound.
+
+    A decode step at serving batch sizes is bandwidth-bound: its floor
+    is streaming the weights (plus the KV it attends) from HBM, during
+    which the MXU idles.  The fused hybrid step runs the rider chunk
+    as its own full-model sub-pass, so a mixed step pays roughly one
+    EXTRA weight stream (~+t_mem) over the pure-decode floor — rider
+    tokens are not free, they are flat-priced: any chunk whose FLOPs
+    fit inside that stream's MXU idle time costs the same +t_mem, so
+    the budget is the knee where the sub-pass would flip
+    compute-bound and start scaling with chunk size:
+
+        t_mem   = (weight_bytes + kv_stream_bytes) / hbm_bw
+        free    = t_mem * peak_flops - 2 * weight_elements * decode_rows
+        budget  = slack * free / (2 * weight_elements)
+
+    (2 flops per weight element per token — the same accounting the
+    KV pager's RecoveryPolicy uses.)  Versus the separate-dispatch
+    arm's chunk-wide COMPUTE-bound stall this bounds bystander TPOT at
+    ~2x the decode floor during mixed phases instead of ~chunk/x;
+    compacting rider rows into the decode pass (ROADMAP follow-up)
+    is what would make riders genuinely free.  ``slack`` derates the
+    headroom (<1 trades rider throughput for bystander TPOT margin;
+    >1 accepts measured TPOT degradation for faster victim TTFT).
+    Returns whole tokens, >= 0; the caller still clamps to chunk
+    floors/alignment and the compiled cache slack
+    (batch_config.budgeted_chunk)."""
+    per_tok_flops = 2.0 * max(1, weight_elements)
+    t_mem = (max(0, weight_bytes) + max(0, kv_stream_bytes)) \
+        / machine.hbm_bandwidth
+    free = t_mem * machine.peak_flops - per_tok_flops * max(0, decode_rows)
+    return max(0, int(slack * free / per_tok_flops))
+
+
 def resharding_cost(tensor_bytes: int, src: Tuple[int, ...],
                     dst: Tuple[int, ...], machine: MachineModel) -> float:
     """Cost of moving a tensor between (dp, tp[, sp[, ep]]) layouts

@@ -51,6 +51,32 @@ def pick_chunk(needed: int, cap: int, min_chunk: int = 1) -> int:
     return min(max(1 << (needed - 1).bit_length(), min_chunk), cap)
 
 
+def budgeted_chunk(needed: int, cap: int, min_chunk: int = 1,
+                   budget: Optional[int] = None) -> int:
+    """:func:`pick_chunk` under an optional token BUDGET — the single
+    spelling for every chunk/block-size call site (request_manager,
+    spec_infer, spec_block used to each write their own ``max(1, ...)``
+    + floor-clamp variant).
+
+    ``budget``: a soft token bound from a cost model (the hybrid step's
+    roofline rider budget, ROADMAP stall-free item): the chunk may not
+    EXCEED the largest power of two <= budget, so a budgeted rider
+    chunk stays within the priced FLOP headroom while keeping the pow2
+    shape-bucket ladder (bounded jit variants).  Floors still win over
+    the budget — ``min_chunk`` (the int8 32-divisible flash-prefill
+    append window) and the 16-aligned chunk-start invariant are
+    correctness/efficiency gates, not preferences — and ``cap`` (the
+    compiled cache slack) is a hard bound over everything.  With
+    ``budget=None`` this is exactly ``pick_chunk(max(1, needed), cap,
+    min_chunk)`` — bit-identical to the historical call sites."""
+    needed = max(1, needed)
+    if budget is not None and needed > 1:
+        b = max(int(budget), 1)
+        pow2 = 1 << (b.bit_length() - 1)      # largest pow2 <= budget
+        cap = min(cap, max(pow2, min_chunk))
+    return pick_chunk(needed, cap, min_chunk=min_chunk)
+
+
 class BatchConfig:
     """One serving step's worth of work (reference batch_config.h:39).
 
@@ -102,6 +128,77 @@ class BatchConfig:
     def __repr__(self):
         return (f"<{type(self).__name__} reqs={self.num_active_requests()} "
                 f"tokens={self.num_active_tokens()} chunk={self.chunk}>")
+
+
+@dataclasses.dataclass
+class RoleView:
+    """Host-side view of ONE role's rows inside a hybrid batch — just
+    the two arrays the kernel-dispatch cost models read
+    (inference_manager.flash_wins / flash_prefill_wins / attend_bucket),
+    so per-role flash/bucket decisions reuse the single-role code
+    unchanged."""
+
+    request_available: np.ndarray   # [R] bool, this role's rows only
+    first_token_depth: np.ndarray   # [R] int32 (shared across roles)
+
+
+class HybridBatchConfig(BatchConfig):
+    """One STALL-FREE mixed step (ROADMAP "fuse chunked prefill into
+    decode steps"; the Sarathi-Serve piggybacked-chunked-prefill idea on
+    the row-oriented TPU batch): the full decode batch plus a token-
+    budgeted slice of admitted requests' remaining prefill, dispatched
+    as ONE device program.
+
+    Per-row roles ride as DATA (``row_role``), so role mixes and rider
+    spans change per step with zero retracing — exactly like the paged
+    page table.  ``chunk`` is the RIDER chunk (roofline-budgeted,
+    search/cost_model.hybrid_rider_budget); decode rows occupy only
+    column 0 of ``token_ids`` and take the 1-token kernel path inside
+    the fused step, riders take the chunk path — the separate-dispatch
+    layout instead ran EVERY row at the prefill chunk width, which is
+    why one 8k prompt used to spike every decoding request's TPOT
+    (BENCH_r03).
+    """
+
+    ROLE_NONE, ROLE_DECODE, ROLE_RIDER = 0, 1, 2
+
+    def __init__(self, max_requests: Optional[int] = None,
+                 chunk: int = 16):
+        super().__init__(max_requests, chunk)
+        self.row_role = np.zeros(self.max_requests, np.int8)
+
+    # ------------------------------------------------------------ queries
+    def decode_rows(self) -> int:
+        return int((self.row_role == self.ROLE_DECODE).sum())
+
+    def rider_rows(self) -> int:
+        return int((self.row_role == self.ROLE_RIDER).sum())
+
+    def rider_tokens(self) -> int:
+        """Prefill tokens riding this dispatch (telemetry headline)."""
+        return int(self.num_tokens_in_batch[
+            self.row_role == self.ROLE_RIDER].sum())
+
+    def role_view(self, role: int) -> RoleView:
+        return RoleView(self.request_available & (self.row_role == role),
+                        self.first_token_depth)
+
+    # ------------------------------------------------------------- device
+    def pack(self) -> Dict[str, np.ndarray]:
+        d = super().pack()
+        # role masks as data: the fused step's two sub-passes each see
+        # only their role's rows active (disjoint rows, disjoint cache
+        # rows — order between the passes is irrelevant)
+        d["decode_active"] = (self.request_available
+                              & (self.row_role == self.ROLE_DECODE))
+        d["rider_active"] = (self.request_available
+                             & (self.row_role == self.ROLE_RIDER))
+        return d
+
+    def __repr__(self):
+        return (f"<HybridBatchConfig decode={self.decode_rows()} "
+                f"riders={self.rider_rows()} chunk={self.chunk} "
+                f"rider_tokens={self.rider_tokens()}>")
 
 
 class TreeVerifyBatchConfig(BatchConfig):

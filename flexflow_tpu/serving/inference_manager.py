@@ -1252,6 +1252,132 @@ class InferenceManager:
             _feed_array(init_tokens, jnp.int32))
         return toks
 
+    # -------------------------------------------------------- hybrid step
+    def supports_hybrid_step(self, model_id: int) -> bool:
+        """The fused decode+rider step runs on single-mesh and tp/sp
+        records, dense or paged; stage-partitioned (pp) records keep
+        separate dispatches — their decode path is the micro-batched
+        stage pipeline, which has no single step function to fuse
+        into."""
+        return "pp_stages" not in self.models[model_id]
+
+    def hybrid_rider_budget(self, model_id: int, decode_rows: int) -> int:
+        """Roofline rider-token budget for one hybrid step (the
+        search cost model's free-FLOP headroom pricing,
+        search/cost_model.hybrid_rider_budget) from this record's
+        committed weights and the default machine model (override via
+        ``self.machine``; env ``FF_HYBRID_BUDGET`` pins an explicit
+        token count for benches/tests).  KV stream bytes are omitted —
+        a conservative under-estimate of t_mem, so the budget errs
+        toward protecting bystander TPOT."""
+        import os
+
+        env = os.environ.get("FF_HYBRID_BUDGET")
+        if env:
+            return max(0, int(env))
+        from ..search.cost_model import (SimpleMachineModel,
+                                         hybrid_rider_budget)
+
+        machine = getattr(self, "machine", None)
+        if machine is None:
+            machine = self.machine = SimpleMachineModel(1)
+        pb = self.model_param_bytes(model_id)
+        return hybrid_rider_budget(machine, pb["bytes"], pb["elements"],
+                                   decode_rows)
+
+    def _build_hybrid_step(self, record, d_attend, r_attend, d_flash,
+                           r_flash):
+        """The fused stall-free step: ONE jitted program running the
+        rider (chunked-prefill) sub-pass then the decode sub-pass over
+        the same donated caches.  Roles are disjoint rows, so pass
+        order is correctness-neutral; riders go first only so a
+        completing rider's sample and the decode samples ship in the
+        same sync.  Each sub-pass is the ordinary _raw_step with its
+        OWN attend bucket and flash decision — decode rows take the
+        1-token kernel path, riders the chunk path, both reading the
+        page table as data on paged records."""
+        rstep = self._raw_step(record, reorder=False, attend_len=r_attend,
+                               use_flash=r_flash)
+        dstep = self._raw_step(record, reorder=False, attend_len=d_attend,
+                               use_flash=d_flash)
+
+        def hybrid(params, caches, batch, rng):
+            rng_r, rng_d = jax.random.split(rng)
+            C = batch["token_ids"].shape[1]
+            rb = dict(batch)
+            rb["active"] = batch["rider_active"]
+            outs_r, caches = rstep(params, caches, rb, rng_r)
+            db = dict(batch)
+            db["active"] = batch["decode_active"]
+            db["token_ids"] = batch["token_ids"][:, :1]
+            db["row_tokens"] = jnp.minimum(batch["row_tokens"], 1)
+            outs_d, caches = dstep(params, caches, db, rng_d)
+            # each rider's sample sits at its span's last column; the
+            # gather is data-indexed so spans change without retracing
+            last = jnp.clip(batch["row_tokens"].astype(jnp.int32) - 1,
+                            0, C - 1)
+            rider_tok = jnp.take_along_axis(
+                outs_r[0].astype(jnp.int32), last[:, None], axis=1)[:, 0]
+            toks = jnp.stack([outs_d[0][:, 0].astype(jnp.int32),
+                              rider_tok])
+            return toks, caches   # toks [2, R]: decode row 0, rider row 1
+
+        return jax.jit(hybrid, donate_argnums=(1,))
+
+    def hybrid_step(self, model_id: int, bc, rng=None):
+        """Run one fused decode+rider dispatch (bc: a
+        HybridBatchConfig).  Returns a [2, R] int32 device array —
+        row 0 the decode rows' sampled tokens, row 1 each rider row's
+        sample at its span's last column (meaningful only when the
+        span completes the prompt) — so ONE host sync serves both
+        roles.  Cache updates stay internal, exactly like
+        :meth:`inference`."""
+        from .batch_config import HybridBatchConfig
+
+        record = self.models[model_id]
+        assert "pp_stages" not in record, (
+            "hybrid_step: pp records keep separate dispatches — gate "
+            "with supports_hybrid_step")
+        if bc.chunk > record["prefill_chunk"]:
+            raise ValueError(
+                f"hybrid rider chunk {bc.chunk} exceeds the cache slack "
+                f"(prefill_chunk={record['prefill_chunk']}) — scatter "
+                f"would clamp over committed KV")
+        batch = _feed_arrays(bc.pack())
+        if record.get("paged"):
+            batch["page_table"] = _feed_array(record["page_table"],
+                                              jnp.int32)
+        if rng is None:
+            rng = jax.random.PRNGKey(0)
+        # per-ROLE kernel dispatch + attend buckets, each counted in
+        # serving_kernel_path_total like a separate-dispatch step would
+        # be (phase=decode for the decode sub-pass, prefill for the
+        # rider sub-pass)
+        dview = bc.role_view(HybridBatchConfig.ROLE_DECODE)
+        rview = bc.role_view(HybridBatchConfig.ROLE_RIDER)
+        d_flash = self._pick_kernel_path(record, dview, 1, span=1)
+        r_flash = self._pick_kernel_path(record, rview, bc.chunk,
+                                         span=bc.chunk)
+        if record["mesh"] is None or record.get("paged"):
+            d_attend = attend_bucket(dview, 1, record["alloc_len"])
+            r_attend = attend_bucket(rview, bc.chunk, record["alloc_len"])
+        else:
+            # sharded dense records: same policy as inference() — the
+            # XLA slice would reshard, so only flash prefill takes the
+            # bucket (it bounds the kernel grid)
+            d_attend = None
+            r_attend = (attend_bucket(rview, bc.chunk,
+                                      record["alloc_len"])
+                        if r_flash else None)
+        key = ("hybrid", bc.chunk, d_attend, r_attend, d_flash, r_flash)
+        if key not in record["steps"]:
+            record["steps"][key] = self._build_hybrid_step(
+                record, d_attend, r_attend, d_flash, r_flash)
+        toks, record["caches"] = _retry_transient(
+            record["steps"][key], record["model"].params,
+            record["caches"], batch, _feed_rng(rng))
+        return toks
+
     # ------------------------------------------------------- prefix cache
     def _build_copy_prefix(self, record, L: int):
         """Row->row KV copy of the first ``L`` cache positions, jitted

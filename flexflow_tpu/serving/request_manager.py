@@ -38,7 +38,8 @@ import numpy as np
 from ..fftype import InferenceMode
 from ..observability import (get_flight_recorder, get_heartbeat,
                              get_ledger, get_registry, get_tracer)
-from .batch_config import BatchConfig, InferenceResult, pick_chunk
+from .batch_config import (BatchConfig, HybridBatchConfig,
+                           InferenceResult, budgeted_chunk)
 from .inference_manager import InferenceManager
 from .kv_pager import KVPager
 from .prefix_cache import PREFIX_ALIGN, PrefixCache, align_down
@@ -196,7 +197,8 @@ class RequestManager:
                  decode_block: int = 16,
                  prefix_cache: bool = False,
                  prefix_pool_slots: Optional[int] = None,
-                 kv_pager: Optional[KVPager] = None):
+                 kv_pager: Optional[KVPager] = None,
+                 hybrid_steps: Optional[bool] = None):
         self.max_requests_per_batch = max_requests_per_batch
         self.max_tokens_per_batch = max_tokens_per_batch
         self.max_sequence_length = max_sequence_length
@@ -261,6 +263,20 @@ class RequestManager:
         # prefill chunks must honor this floor (int8 flash-prefill needs
         # 32-divisible chunks); set per-driver from the serving record
         self._chunk_floor = 1
+        # stall-free hybrid steps (ROADMAP "fuse chunked prefill into
+        # decode steps"): a MIXED batch (decode rows + prefilling rows)
+        # dispatches as ONE fused step — the full decode batch at the
+        # 1-token path plus a roofline-budgeted rider chunk of the
+        # prefilling rows — instead of running every row at the prefill
+        # chunk width.  Default ON (env FF_HYBRID=0 or hybrid_steps=
+        # False for the separate-dispatch A/B arm); greedy outputs are
+        # bit-identical either way (tests/test_hybrid.py pins it).
+        if hybrid_steps is None:
+            hybrid_steps = os.environ.get("FF_HYBRID", "1") != "0"
+        self.hybrid_steps = bool(hybrid_steps)
+        # (im, model_id) while a driver that can host the fused step is
+        # in flight (armed by generate_incr_decoding beside _prefix_ctx)
+        self._hybrid_ctx: Optional[Tuple[InferenceManager, int]] = None
         # serving telemetry (observability/): handles cached here so the
         # per-step cost is one enabled-check per emission
         m = get_registry()
@@ -296,6 +312,13 @@ class RequestManager:
         self._m_spec_verify = m.histogram("serving_spec_verify_tokens")
         self._m_adm_blocked = m.counter("serving_admission_blocked_total")
         self._m_cancelled = m.counter("serving_cancellations_total")
+        # hybrid-step telemetry: steps counted by dispatch mode (every
+        # MIXED batch ticks exactly one — mode=hybrid for fused
+        # dispatches, mode=separate for the legacy chunk-wide path, so
+        # an A/B's arms are attributable from one snapshot), rider
+        # tokens observed at the fold site
+        self._m_hybrid_steps = m.counter("serving_hybrid_steps_total")
+        self._m_rider_tokens = m.histogram("serving_hybrid_rider_tokens")
         # deferred-cancellation mailbox (async front-end → driver
         # thread): request_cancel() boxes a guid from any thread;
         # drain_cancels() enacts them on the driver thread at the
@@ -1226,12 +1249,23 @@ class RequestManager:
         #    TPU the device cost of a step is rows x chunk regardless of how
         #    many rows are active, so the bucket must NOT depend on the
         #    active-request count.
-        max_span = max(len(r.tokens) - r.cached_len
-                       for r in self.running.values())
-        chunk = pick_chunk(max_span, self.max_tokens_per_batch,
-                           min_chunk=self._chunk_floor)
+        spans = {row: len(req.tokens) - req.cached_len
+                 for row, req in self.running.items()}
         self._m_occupancy.set(len(self.running)
                               / self.max_requests_per_batch)
+        mixed = (any(s <= 1 for s in spans.values())
+                 and any(s > 1 for s in spans.values()))
+        if mixed and self._hybrid_ctx is not None:
+            return self._hybrid_batch(spans)
+        if mixed:
+            # the separate-dispatch arm of the A/B: a mixed batch about
+            # to run EVERY row at the prefill chunk width (the TPOT-
+            # spike class the hybrid step removes) — counted so both
+            # arms are attributable from one snapshot
+            self._m_hybrid_steps.inc(mode="separate")
+        chunk = budgeted_chunk(max(spans.values()),
+                               self.max_tokens_per_batch,
+                               min_chunk=self._chunk_floor)
         if chunk > 1:
             self._m_prefill_chunk.observe(chunk)
 
@@ -1247,6 +1281,110 @@ class RequestManager:
             bc.request_available[row] = True
             bc.token_ids[row, :n] = span
         return bc
+
+    # -------------------------------------------------------- hybrid step
+    def _hybrid_batch(self, spans: Dict[int, int]) -> HybridBatchConfig:
+        """Fold scheduling for one stall-free mixed step: every
+        span-1 row decodes (1 token, column 0), every longer-span row
+        rides a slice of its remaining prefill.  The rider chunk is the
+        roofline budget (cost model free-FLOP headroom, split across
+        riders) clamped to the compiled cap and the chunk floors —
+        floors win over the budget (the int8 32-divisible window and
+        16-aligned chunk starts are invariants, not preferences)."""
+        im, model_id = self._hybrid_ctx
+        riders = [row for row, s in spans.items() if s > 1]
+        budget = im.hybrid_rider_budget(model_id,
+                                        len(spans) - len(riders))
+        # the rider sub-pass is a FULL-WIDTH [R, chunk] model pass
+        # (inactive rows are masked, not skipped — XLA computes them),
+        # so the roofline headroom prices R * chunk token slots, not
+        # riders * chunk: divide by the batch width the pass pays for
+        chunk = budgeted_chunk(max(spans[r] for r in riders),
+                               self.max_tokens_per_batch,
+                               min_chunk=self._chunk_floor,
+                               budget=max(1, budget
+                                          // self.max_requests_per_batch))
+        if chunk > 1:   # same guard as every other chunk site: the
+            self._m_prefill_chunk.observe(chunk)   # histogram is
+        # multi-token prefill chunks only (a budget-starved chunk of 1
+        # must not pollute the hybrid-vs-separate chunk comparison)
+        bc = HybridBatchConfig(self.max_requests_per_batch, chunk)
+        for row, req in self.running.items():
+            rider = spans[row] > 1
+            n = min(spans[row], chunk) if rider else 1
+            bc.request_guid[row] = req.guid
+            bc.first_token_depth[row] = req.cached_len
+            bc.num_tokens_in_batch[row] = n
+            bc.max_sequence_length[row] = req.max_sequence_length
+            bc.request_available[row] = True
+            bc.row_role[row] = (bc.ROLE_RIDER if rider
+                                else bc.ROLE_DECODE)
+            bc.token_ids[row, :n] = req.tokens[req.cached_len:
+                                               req.cached_len + n]
+        return bc
+
+    def _fold_hybrid(self, bc: HybridBatchConfig, toks: np.ndarray) -> int:
+        """Fold one hybrid step's [2, R] samples (row 0 decode, row 1
+        rider) into the request state: decode rows commit their sampled
+        token exactly like a chunk-1 step's fold; rider rows advance
+        their prefill watermark and commit their sample only when the
+        chunk completes the prompt (the prefill->decode boundary — the
+        row decodes from the next step on).  Ledger/telemetry
+        attribution is per ROLE: rider rows land guid-scoped
+        ``prefill-chunk`` notes with ``rider=True`` so ffreq renders
+        the chunk spans inside the victim's timeline.  Returns tokens
+        committed (telemetry)."""
+        appended = 0
+        for row in list(self.running):
+            req = self.running[row]
+            n = int(bc.num_tokens_in_batch[row])
+            if not bc.request_available[row] or n == 0:
+                continue
+            req.profile.llm_decoding_steps += 1
+            if bc.row_role[row] == bc.ROLE_RIDER:
+                completes = self._row_completes(req, n)
+                req.cached_len += n
+                self.ledger.note_event("prefill-chunk", guid=req.guid,
+                                       chunk=n, rider=True)
+                if not completes:
+                    continue
+                tok = int(toks[1, row])
+            else:
+                req.cached_len += 1
+                tok = int(toks[0, row])
+            req.tokens.append(tok)
+            appended += 1
+            req.profile.note_first_token()
+            self.ledger.note_event("commit", guid=req.guid, tokens=1)
+            cb = self.on_commit
+            if cb is not None:
+                cb(req, (tok,))
+            if self._finished(req, tok):
+                self._retire(req)
+        return appended
+
+    def _dispatch_hybrid(self, im: InferenceManager, model_id: int,
+                         bc: HybridBatchConfig, rng,
+                         t_step: float) -> None:
+        """Dispatch + sync + fold one hybrid step (the driver-loop
+        branch body).  Always one host sync: every hybrid step carries
+        at least one decode row, whose sample the next fold needs."""
+        rider_tokens = bc.rider_tokens()
+        self._m_hybrid_steps.inc(mode="hybrid")
+        self._m_rider_tokens.observe(rider_tokens)
+        self.recorder.record_event(
+            "hybrid-step", chunk=bc.chunk, rows=bc.num_active_requests(),
+            decode_rows=bc.decode_rows(), rider_rows=bc.rider_rows(),
+            rider_tokens=rider_tokens)
+        self.ledger.note_event(
+            "hybrid-step", chunk=bc.chunk, rows=bc.num_active_requests(),
+            decode_rows=bc.decode_rows(), rider_tokens=rider_tokens)
+        with self.tracer.span("hybrid-step", chunk=bc.chunk,
+                              rows=bc.num_active_requests(),
+                              rider_tokens=rider_tokens):
+            toks = np.asarray(im.hybrid_step(model_id, bc, rng=rng))
+            im.note_host_sync()
+        self._note_step(t_step, self._fold_hybrid(bc, toks))
 
     # ----------------------------------------------------------- generate
     def _fold_decode_block(self, bc: BatchConfig, toks: np.ndarray,
@@ -1338,6 +1476,13 @@ class RequestManager:
             if (self.kv_pager is not None
                 and im.supports_kv_spill(model_id)) else None)
         self._chunk_floor = im.min_prefill_chunk(model_id)
+        # arm the stall-free hybrid step: mixed batches fuse the decode
+        # rows with a budgeted rider slice of the prefilling rows into
+        # one dispatch (pp records keep separate dispatches)
+        self._hybrid_ctx = (
+            (im, model_id)
+            if (self.hybrid_steps and im.supports_hybrid_step(model_id))
+            else None)
         self._check_paged_serving(im, {model_id: 1})
         if im.is_paged(model_id):
             # the physical page-table push needs the (im, rows) context
@@ -1353,6 +1498,7 @@ class RequestManager:
         finally:
             self._prefix_ctx = None
             self._spill_ctx = None
+            self._hybrid_ctx = None
             self._chunk_floor = 1
 
     def _incr_decoding_loop(self, im, model_id, requests, rng,
@@ -1364,11 +1510,18 @@ class RequestManager:
             if bc is None:
                 break
             rng, step_rng = jax.random.split(rng)
+            if isinstance(bc, HybridBatchConfig):
+                # stall-free mixed step: decode rows + a budgeted rider
+                # chunk in ONE dispatch (the fold happens here — the
+                # hybrid result shape differs from InferenceResult)
+                self._dispatch_hybrid(im, model_id, bc, step_rng, t_step)
+                bc, result = None, None
+                continue
             if (bc.chunk == 1 and decode_block > 1
                     and im.supports_decode_block(model_id)):
                 # largest remaining span bounds useful block length
-                k = pick_chunk(max(1, self._max_remaining_budget()),
-                               decode_block)
+                k = budgeted_chunk(self._max_remaining_budget(),
+                                   decode_block)
                 # paged KV: book the block's growth up front (no
                 # preemption here — the BatchConfig is already built;
                 # overage is trued up at the next fold boundary)
@@ -1515,8 +1668,8 @@ class RequestManager:
         init = outs[0][np.arange(outs[0].shape[0]), cols]
         bc2 = self._decode_only_bc()
         # init consumes one budget slot, the k scan steps the rest
-        k = pick_chunk(max(1, self._max_remaining_budget() - 1),
-                       decode_block)
+        k = budgeted_chunk(self._max_remaining_budget() - 1,
+                           decode_block)
         # paged KV: book the handoff block's growth (no preemption —
         # see the decode-block site; trued up at the next fold)
         self.pager_sync_leases(extra=k + 1)

@@ -63,7 +63,7 @@ import numpy as np
 
 from ..observability import get_ledger
 from .batch_config import (BeamSearchBatchConfig, TreeVerifyBatchConfig,
-                           pick_chunk)
+                           budgeted_chunk)
 from .inference_manager import beam_rerank, pow2_bucket
 from .request_manager import GenerationResult, Request
 
@@ -497,8 +497,8 @@ def _llm_prompt_prefill(rm, im, llm_id, running, states, tree_chunk, rng):
         spans = {row: n for row, n in spans.items() if n > 0}
         if not spans:
             return rng
-        chunk = pick_chunk(max(spans.values()), tree_chunk,
-                           min_chunk=im.min_prefill_chunk(llm_id))
+        chunk = budgeted_chunk(max(spans.values()), tree_chunk,
+                               min_chunk=im.min_prefill_chunk(llm_id))
         bc = TreeVerifyBatchConfig(rm.max_requests_per_batch, chunk)
         for row, req in running.items():
             n = min(spans.get(row, 0), chunk)
@@ -542,8 +542,8 @@ def _ssm_prompt_prefill(rm, im, ssm_id, running, states, W, rng,
         spans = {row: n for row, n in spans.items() if n > 0}
         if not spans:
             return rng
-        chunk = pick_chunk(max(spans.values()), chunk_cap,
-                           min_chunk=im.min_prefill_chunk(ssm_id))
+        chunk = budgeted_chunk(max(spans.values()), chunk_cap,
+                               min_chunk=im.min_prefill_chunk(ssm_id))
         bc = BeamSearchBatchConfig(rm.max_requests_per_batch, chunk,
                                    beam_width=W)
         for row, req in running.items():

@@ -48,7 +48,7 @@ import jax
 import numpy as np
 
 from .batch_config import (BatchConfig, BeamSearchBatchConfig,
-                           TreeVerifyBatchConfig, pick_chunk)
+                           TreeVerifyBatchConfig, budgeted_chunk)
 from .request_manager import GenerationResult, Request
 
 
@@ -181,8 +181,8 @@ def _ssm_prefill(rm, im, ssm_id, states, running, beam_width, seed_rng):
         if not spans:
             break
         max_span = max(len(s) for s in spans.values())
-        chunk = pick_chunk(max_span, rm.max_tokens_per_batch,
-                           min_chunk=im.min_prefill_chunk(ssm_id))
+        chunk = budgeted_chunk(max_span, rm.max_tokens_per_batch,
+                               min_chunk=im.min_prefill_chunk(ssm_id))
         bc = BeamSearchBatchConfig(rm.max_requests_per_batch, chunk,
                                    beam_width=beam_width)
         for row, req in running.items():

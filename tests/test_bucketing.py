@@ -9,7 +9,8 @@ pinned here rather than re-derived from downstream behavior.
 
 import numpy as np
 
-from flexflow_tpu.serving.batch_config import BatchConfig, pick_chunk
+from flexflow_tpu.serving.batch_config import (BatchConfig, budgeted_chunk,
+                                               pick_chunk)
 from flexflow_tpu.serving.inference_manager import attend_bucket, pow2_bucket
 
 
@@ -96,3 +97,47 @@ class TestPickChunkFloor:
         # the compiled cache slack is a hard bound; when it is smaller
         # than the floor the (counted) XLA fallback is correct behavior
         assert pick_chunk(12, 16, min_chunk=32) == 16
+
+
+class TestBudgetedChunk:
+    """budgeted_chunk — the ONE spelling for every chunk/block pick
+    (request_manager, spec_infer, spec_block used three variants of
+    ``pick_chunk(max(1, ...), ...)`` + floor clamps) — plus the hybrid
+    rider budget semantics: budget caps at the largest pow2 <= budget,
+    floors beat the budget, the cap beats everything."""
+
+    def test_budget_none_is_pick_chunk_exactly(self):
+        for needed in (0, 1, 2, 40, 300, -3):
+            for cap, floor in ((256, 1), (64, 32), (16, 32)):
+                assert budgeted_chunk(needed, cap, min_chunk=floor) \
+                    == pick_chunk(max(1, needed), cap, min_chunk=floor)
+
+    def test_budget_caps_at_largest_pow2_leq(self):
+        assert budgeted_chunk(1000, 256, budget=100) == 64
+        assert budgeted_chunk(1000, 256, budget=128) == 128
+        assert budgeted_chunk(1000, 256, budget=127) == 64
+        # a chunk never exceeds the need's own pow2 bucket either
+        assert budgeted_chunk(40, 256, budget=1000) == 64
+
+    def test_floor_beats_budget(self):
+        # int8's 32-divisible append window is an invariant: a budget
+        # below the floor must NOT ship a sub-floor multi-token chunk
+        assert budgeted_chunk(100, 256, min_chunk=32, budget=8) == 32
+        assert budgeted_chunk(100, 256, min_chunk=32, budget=1) == 32
+
+    def test_cap_beats_budget_and_floor(self):
+        assert budgeted_chunk(1000, 64, budget=4096) == 64
+        assert budgeted_chunk(12, 16, min_chunk=32, budget=8) == 16
+
+    def test_decode_unaffected_by_budget(self):
+        # needed <= 1 is a decode step: always chunk 1, budget inert
+        assert budgeted_chunk(1, 256, budget=4) == 1
+        assert budgeted_chunk(0, 256, min_chunk=32, budget=1) == 1
+
+    def test_sixteen_alignment_preserved(self):
+        # budgeted chunks stay on the pow2 ladder, so every multi-token
+        # chunk >= 16 keeps the flash-prefill 16-aligned chunk-start
+        # invariant (sub-16 chunks take the counted XLA path, as today)
+        for budget in (16, 33, 64, 100, 500):
+            c = budgeted_chunk(1000, 256, min_chunk=16, budget=budget)
+            assert c % 16 == 0 and c & (c - 1) == 0

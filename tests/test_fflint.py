@@ -714,6 +714,45 @@ class TestMetricSchemaRule:
         assert at(fs, "metric-schema", 3), fs
         assert len(fs) == 2
 
+    def test_hybrid_names_covered_by_real_schema(self, tmp_path):
+        # the stall-free hybrid-step vocabulary validates against the
+        # CHECKED-IN schema (baseline stays EMPTY): the step counter,
+        # the rider-token histogram and the hybrid-step event are all
+        # declared; a rogue sibling is still flagged
+        src = """\
+            def wire(m, rec, ledger):
+                a = m.counter("serving_hybrid_steps_total")
+                b = m.histogram("serving_hybrid_rider_tokens")
+                rec.record_event("hybrid-step", chunk=32, rows=4,
+                                 decode_rows=3, rider_rows=1,
+                                 rider_tokens=32)
+                ledger.note_event("hybrid-step", chunk=32, rows=4)
+                ledger.note_event("prefill-chunk", guid=1, chunk=32,
+                                  rider=True)
+                return a, b
+            """
+        path = tmp_path / "serving" / "hybrid_fixture.py"
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_text(textwrap.dedent(src))
+        ctx = LintContext(repo_root=REPO)   # exec-loads the real schema
+        fs = lint_file(str(path), self.R, ctx,
+                       rel="serving/hybrid_fixture.py",
+                       judge_suppressions=True)
+        assert fs == []
+        rogue = tmp_path / "serving" / "hybrid_rogue.py"
+        rogue.write_text(textwrap.dedent("""\
+            def wire(m, rec):
+                m.counter("serving_hybrid_rider_tokens")
+                rec.record_event("hybrid-rider")
+            """))
+        fs = lint_file(str(rogue), self.R, ctx,
+                       rel="serving/hybrid_rogue.py",
+                       judge_suppressions=True)
+        # histogram declared as counter spelling flagged; rogue event
+        assert at(fs, "metric-schema", 2), fs
+        assert at(fs, "metric-schema", 3), fs
+        assert len(fs) == 2
+
 
 # --------------------------------------------------- direct host sync
 class TestDirectHostSyncRule:

@@ -1,0 +1,327 @@
+"""Stall-free hybrid steps (chunked prefill fused into decode
+dispatches — serving/batch_config.HybridBatchConfig,
+request_manager._hybrid_batch, inference_manager.hybrid_step).
+
+The load-bearing promise is the pager suite's, extended to dispatch
+fusion: the hybrid step may only change WHEN rows compute (one fused
+dispatch instead of a chunk-wide mixed step), never WHAT they compute —
+greedy tokens must be bit-exact between the hybrid and separate-
+dispatch arms on every driver, for bf16 and int8 caches, dense and
+paged layouts.  Plus the zero-retrace pin: role mixes and rider spans
+are DATA, so warmed hybrid serving must never recompile.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from flexflow_tpu import FFConfig, Model
+from flexflow_tpu.fftype import InferenceMode
+from flexflow_tpu.models.llama import LLAMAConfig, create_llama_model
+from flexflow_tpu.observability import get_registry
+from flexflow_tpu.serving import InferenceManager, RequestManager
+from flexflow_tpu.serving.batch_config import (HybridBatchConfig,
+                                               budgeted_chunk)
+
+TINY = dict(vocab_size=128, hidden_size=64, intermediate_size=128,
+            num_hidden_layers=2, num_attention_heads=4,
+            num_key_value_heads=2, max_position_embeddings=512)
+
+SMALLER = dict(vocab_size=128, hidden_size=32, intermediate_size=64,
+               num_hidden_layers=1, num_attention_heads=2,
+               num_key_value_heads=2, max_position_embeddings=512)
+
+
+def _tiny_model(seed=0, max_requests=4, mode=InferenceMode.INC_DECODING,
+                params=TINY):
+    import jax
+
+    cfg = LLAMAConfig(**params)
+    model = Model(FFConfig(), name=f"hybrid_{mode.value}_{seed}")
+    create_llama_model(model, cfg, mode=mode, max_requests=max_requests)
+    model.params = model.init_params(jax.random.PRNGKey(seed))
+    return model, cfg
+
+
+def _prompts(lengths, vocab=127, seed=0):
+    rng = np.random.default_rng(seed)
+    return [rng.integers(1, vocab, n).tolist() for n in lengths]
+
+
+def _hybrid_steps_count():
+    snap = get_registry().snapshot()
+    c = snap.get("counters", {}).get("serving_hybrid_steps_total") or {}
+    return (c.get("labels") or {}).get("mode=hybrid", 0)
+
+
+def _serve_interference(im, mid, hybrid, lengths=(6, 9, 120, 7),
+                        victim_len=None, new_tokens=24, admit_after=6,
+                        max_requests=4, max_tokens_per_batch=64,
+                        decode_block=4, seed=0):
+    """Serve short prompts decoding + (optionally) one long victim
+    admitted mid-stream — the mixed-batch scenario the hybrid step
+    fuses.  Returns every request's full token list."""
+    rm = RequestManager(max_requests_per_batch=max_requests,
+                        max_tokens_per_batch=max_tokens_per_batch,
+                        max_sequence_length=256,
+                        decode_block=decode_block, hybrid_steps=hybrid)
+    state = {"committed": 0, "victim": None}
+    if victim_len is not None:
+        victim_prompt = _prompts([victim_len], seed=seed + 7)[0]
+
+        def on_commit(req, toks):
+            state["committed"] += len(toks)
+            if (state["victim"] is None
+                    and state["committed"] >= admit_after):
+                state["victim"] = rm.register_new_request(
+                    list(victim_prompt), max_new_tokens=new_tokens)
+
+        rm.on_commit = on_commit
+    reqs = [rm.register_new_request(p, max_new_tokens=new_tokens)
+            for p in _prompts(lengths, seed=seed)]
+    rm.generate_incr_decoding(im, mid, reqs)
+    out = [list(r.tokens) for r in reqs]
+    if victim_len is not None:
+        assert state["victim"] is not None, "victim never admitted"
+        assert state["victim"].status == state["victim"].COMPLETED
+        out.append(list(state["victim"].tokens))
+    return out
+
+
+# --------------------------------------------------------------- parity
+class TestHybridParity:
+    """Bit-exact greedy parity of hybrid vs separate dispatch — and the
+    hybrid path must actually have dispatched (a parity pin over a
+    never-taken path proves nothing)."""
+
+    def _compile(self, kv_cache_dtype=None, kv_layout=None,
+                 max_requests=4):
+        model, _ = _tiny_model(max_requests=max_requests)
+        im = InferenceManager(model.config)
+        kw = {}
+        if kv_layout:
+            kw.update(kv_layout=kv_layout, kv_page_len=32)
+        mid = im.compile_model_and_allocate_buffer(
+            model, max_requests=max_requests, max_seq_length=256,
+            prefill_chunk=64,
+            cache_dtype=(np.float32 if kv_cache_dtype is None else None),
+            kv_cache_dtype=kv_cache_dtype, **kw)
+        return im, mid
+
+    @pytest.mark.parametrize("kv_cache_dtype,kv_layout", [
+        (None, None),            # bf16-class (f32 on CPU), dense
+        ("int8", None),          # int8 + scales, dense
+        (None, "paged"),         # paged frame pool, identity table
+        ("int8", "paged"),       # int8 paged
+    ])
+    def test_incr_parity(self, kv_cache_dtype, kv_layout):
+        im, mid = self._compile(kv_cache_dtype, kv_layout)
+        before = _hybrid_steps_count()
+        hyb = _serve_interference(im, mid, hybrid=True, victim_len=90)
+        assert _hybrid_steps_count() > before, \
+            "hybrid path never dispatched — parity would be vacuous"
+        sep = _serve_interference(im, mid, hybrid=False, victim_len=90)
+        assert hyb == sep
+
+    def test_mixed_from_admission_parity(self):
+        """Prompts of very different lengths admitted together: the
+        short rows finish prefill and decode while the long row still
+        prefills — the organic (no-late-arrival) mixed phase."""
+        im, mid = self._compile()
+        before = _hybrid_steps_count()
+        hyb = _serve_interference(im, mid, hybrid=True)
+        assert _hybrid_steps_count() > before
+        sep = _serve_interference(im, mid, hybrid=False)
+        assert hyb == sep
+
+    def test_budget_floor_respected_with_int8(self):
+        """An int8 record's 32-token chunk floor must survive a rider
+        budget smaller than the floor (floors are invariants, not
+        preferences): the hybrid arm still matches and never ships a
+        sub-floor multi-token chunk (the silent XLA-fallback class the
+        kernel-path counter guards)."""
+        im, mid = self._compile("int8")
+        os.environ["FF_HYBRID_BUDGET"] = "8"       # floor-breakingly low
+        try:
+            hyb = _serve_interference(im, mid, hybrid=True,
+                                      victim_len=90)
+        finally:
+            del os.environ["FF_HYBRID_BUDGET"]
+        sep = _serve_interference(im, mid, hybrid=False, victim_len=90)
+        assert hyb == sep
+
+
+# ----------------------------------------------------- spec drivers pin
+class TestSpecDriversUnchanged:
+    """The hybrid flag must be inert for the spec drivers (their
+    prefill/verify scheduling is its own fused loop): host-spec and
+    device-spec outputs are bit-identical with hybrid_steps on/off."""
+
+    @pytest.mark.parametrize("device_loop", [False, True])
+    def test_spec_parity(self, device_loop):
+        import jax
+
+        from flexflow_tpu.serving.spec_infer import generate_spec_infer
+
+        def run(hybrid):
+            llm, _ = _tiny_model(seed=1, mode=InferenceMode.TREE_VERIFY)
+            ssm, _ = _tiny_model(seed=2, mode=InferenceMode.BEAM_SEARCH,
+                                 params=SMALLER)
+            im = InferenceManager(llm.config)
+            lid = im.compile_model_and_allocate_buffer(
+                llm, mode=InferenceMode.TREE_VERIFY, max_requests=2,
+                max_seq_length=256, cache_dtype=np.float32)
+            rm = RequestManager(max_requests_per_batch=2,
+                                max_tokens_per_batch=64,
+                                max_sequence_length=256,
+                                max_spec_tree_token_num=24,
+                                hybrid_steps=hybrid)
+            sid = im.compile_model_and_allocate_buffer(
+                ssm, mode=InferenceMode.BEAM_SEARCH, max_requests=2,
+                max_seq_length=256, beam_width=2,
+                cache_dtype=np.float32)
+            rm.register_ssm_model(sid)
+            reqs = [rm.register_new_request(p, max_new_tokens=10)
+                    for p in _prompts([5, 12], seed=3)]
+            generate_spec_infer(rm, im, lid, reqs, beam_width=2,
+                                beam_depth=3, device_loop=device_loop)
+            return [list(r.tokens) for r in reqs]
+
+        assert run(True) == run(False)
+
+
+# ------------------------------------------------------- retrace guard
+class TestHybridRetraceGuard:
+    def test_zero_recompiles_across_role_mixes(self):
+        """Warmed hybrid serving compiles NOTHING as rider spans and
+        role mixes change: roles/spans ride the batch as data (like
+        the page table), so a permuted workload — different rows
+        decode vs ride each step — reuses every compiled variant."""
+        from flexflow_tpu.utils.debugging import retrace_guard
+
+        im, mid = TestHybridParity()._compile()
+        lengths = (6, 9, 120, 7)
+        # warm every shape bucket this workload touches (prefill
+        # chunks, hybrid chunks, decode blocks, attend buckets)
+        _serve_interference(im, mid, hybrid=True, lengths=lengths)
+        # prove the oracle has signal on this JAX build: a fresh chunk
+        # bucket must register at least one compile
+        with retrace_guard(max_compiles=None) as probe:
+            _serve_interference(im, mid, hybrid=True,
+                                lengths=(6, 9, 200, 7),
+                                max_tokens_per_batch=32)
+        if probe.compiles == 0:
+            pytest.skip("jax.monitoring emits no compile events here")
+        _serve_interference(im, mid, hybrid=True,
+                            lengths=(6, 9, 200, 7),
+                            max_tokens_per_batch=32)
+        with retrace_guard() as g:           # raises if compiles > 0
+            # same bucket multiset, permuted rows: role mixes and
+            # rider spans differ per step, shapes do not
+            for perm in ((120, 6, 9, 7), (7, 120, 6, 9)):
+                _serve_interference(im, mid, hybrid=True, lengths=perm)
+        assert g.compiles == 0
+
+
+# ----------------------------------------------------------- telemetry
+class TestHybridTelemetry:
+    def test_counters_and_rider_timeline(self):
+        """The fold site observes rider tokens, both dispatch modes
+        tick the step counter, and the victim's ledger timeline carries
+        guid-scoped rider prefill-chunk notes (what ffreq renders)."""
+        from flexflow_tpu.observability import get_ledger
+
+        im, mid = TestHybridParity()._compile()
+        m = get_registry()
+        if not m.enabled:
+            pytest.skip("telemetry disabled (FF_TELEMETRY=0)")
+        before = _hybrid_steps_count()
+        _serve_interference(im, mid, hybrid=True)
+        assert _hybrid_steps_count() > before
+        snap = m.snapshot()
+        hist = snap.get("histograms", {}).get(
+            "serving_hybrid_rider_tokens") or {}
+        assert (hist.get("count") or 0) > 0
+        # the long prompt's timeline shows its rider chunks
+        led = get_ledger()
+        riders = [ev for t in led.snapshot().get("retired", [])
+                  for ev in (t.get("events") or [])
+                  if ev.get("name") == "prefill-chunk"
+                  and ev.get("rider")]
+        assert riders, "no rider prefill-chunk notes on any timeline"
+        import tools.ffreq as ffreq
+
+        spanned = [t for t in led.snapshot().get("retired", [])
+                   if ffreq.rider_spans(t)]
+        assert spanned, "ffreq renders no rider spans"
+
+    def test_separate_mode_counted(self):
+        im, mid = TestHybridParity()._compile()
+        m = get_registry()
+        if not m.enabled:
+            pytest.skip("telemetry disabled (FF_TELEMETRY=0)")
+
+        def count():
+            c = m.snapshot().get("counters", {}).get(
+                "serving_hybrid_steps_total") or {}
+            return (c.get("labels") or {}).get("mode=separate", 0)
+
+        before = count()
+        _serve_interference(im, mid, hybrid=False)
+        assert count() > before
+
+
+# -------------------------------------------------------- bench smoke
+class TestBenchMixedSmoke:
+    def test_bench_mixed_tiny(self, tmp_path, monkeypatch):
+        import jax
+
+        import bench
+
+        monkeypatch.setenv("FF_BENCH_RESULTS", str(tmp_path))
+
+        def tiny():
+            cfg = LLAMAConfig(**dict(TINY,
+                                     max_position_embeddings=1024))
+            model = Model(FFConfig(), name="mixed_bench_tiny")
+            create_llama_model(model, cfg, max_requests=4)
+            model.params = model.init_params(jax.random.PRNGKey(0))
+            return model, cfg.vocab_size, np.float32
+
+        head, *extras = bench.bench_mixed(
+            model_builder=tiny, max_requests=4, bystander_prompt=10,
+            bystander_new=48, victim_prompt=200, victim_new=6,
+            max_seq_length=512, max_tokens_per_batch=128,
+            decode_block=4, admit_after=8)
+        # structural gates only — CPU wall-clock ratios are CI noise;
+        # the PARITY and scenario assertions are the hard ones
+        assert head["greedy_match"] is True
+        assert head["separate_victim_ttft_s"] > 0
+        assert head["hybrid_victim_ttft_s"] > 0
+        assert head["value"] > 0
+        assert any(x["metric"] == "mixed_victim_ttft" for x in extras)
+
+
+# --------------------------------------------------- budgeted_chunk API
+class TestHybridBatchConfig:
+    def test_pack_role_masks_disjoint(self):
+        bc = HybridBatchConfig(4, chunk=16)
+        bc.request_available[:3] = True
+        bc.row_role[0] = bc.ROLE_DECODE
+        bc.row_role[1] = bc.ROLE_RIDER
+        bc.row_role[2] = bc.ROLE_DECODE
+        bc.num_tokens_in_batch[:3] = (1, 12, 1)
+        d = bc.pack()
+        assert d["decode_active"].tolist() == [True, False, True, False]
+        assert d["rider_active"].tolist() == [False, True, False, False]
+        assert not (d["decode_active"] & d["rider_active"]).any()
+        assert bc.decode_rows() == 2 and bc.rider_rows() == 1
+        assert bc.rider_tokens() == 12
+
+    def test_role_view_filters(self):
+        bc = HybridBatchConfig(3, chunk=8)
+        bc.request_available[:] = True
+        bc.row_role[:] = (bc.ROLE_DECODE, bc.ROLE_RIDER, bc.ROLE_NONE)
+        v = bc.role_view(bc.ROLE_RIDER)
+        assert v.request_available.tolist() == [False, True, False]

@@ -165,6 +165,30 @@ def preempt_spans(t: Dict[str, Any]) -> List[str]:
     return out
 
 
+def _rider_events(t: Dict[str, Any]) -> List[Dict[str, Any]]:
+    """This request's ``prefill-chunk`` events that rode hybrid decode
+    dispatches — the one filter both the span rendering and the token
+    total read, so they cannot drift apart."""
+    return [ev for ev in t.get("events") or []
+            if ev.get("name") == "prefill-chunk" and ev.get("rider")]
+
+
+def rider_spans(t: Dict[str, Any]) -> List[str]:
+    """Rider-chunk spans (stall-free hybrid steps): ``prefill-chunk``
+    events with ``rider=True`` are this request's prefill slices that
+    rode decode dispatches instead of stalling them — rendered with
+    the inter-chunk gap so a victim's TTFT decomposes into its rider
+    chunks."""
+    out: List[str] = []
+    prev = None
+    for ev in _rider_events(t):
+        gap = ("" if prev is None
+               else f" (+{(ev.get('t', 0) - prev) * 1e3:.1f}ms)")
+        prev = ev.get("t", prev)
+        out.append(f"  rider chunk {ev.get('chunk')}tok{gap}")
+    return out
+
+
 def phase_breakdown(timelines: List[Dict]) -> str:
     """Aggregate per-phase means/maxima over retired requests — where
     the latency budget goes across the batch."""
@@ -196,6 +220,12 @@ def timeline_view(t: Dict[str, Any]) -> str:
                      f"(restored {t.get('restored_tokens') or 0} KV "
                      f"positions from host spill):")
         lines.extend(preempt_spans(t))
+    riders = rider_spans(t)
+    if riders:
+        tok = sum(ev.get("chunk") or 0 for ev in _rider_events(t))
+        lines.append(f"prefill rode {len(riders)} hybrid decode "
+                     f"dispatches ({tok} tokens as rider chunks):")
+        lines.extend(riders)
     if t.get("events_dropped"):
         lines.append(f"({t['events_dropped']} early events dropped from "
                      f"the per-request ring)")
@@ -300,6 +330,13 @@ def selftest() -> int:
         if matched:
             led.note_event("prefix-match", guid=guid, matched=matched)
         led.note_event("prefill-chunk", chunk=64, rows=1)
+        if guid == 2:
+            # a prefill slice that rode a hybrid decode dispatch — the
+            # rider-span rendering path (stall-free mixed batches)
+            led.note_event("hybrid-step", chunk=16, rows=2,
+                           decode_rows=1, rider_tokens=16)
+            led.note_event("prefill-chunk", guid=guid, chunk=16,
+                           rider=True)
         led.note_event("commit", guid=guid, tokens=1)
         led.note_event("decode-step", block=4, rows=1)
         led.note_event("commit", guid=guid, tokens=4)
@@ -318,7 +355,9 @@ def selftest() -> int:
           and rep["attainment"] == 1.0
           and rep["total_tokens"] == 10
           and led.in_flight_guids() == [3]
-          and led.timeline(2)["prefix_matched"] == 48)
+          and led.timeline(2)["prefix_matched"] == 48
+          and rider_spans(led.timeline(2))
+          and not rider_spans(led.timeline(1)))
     print(f"\nffreq selftest {'OK' if ok else 'FAILED: ' + str(errs)}: "
           f"{path}")
     return 0 if ok else 1

@@ -56,6 +56,17 @@
  && env JAX_PLATFORMS=cpu python -m flexflow_tpu.serve.net --selftest \
     >/dev/null) \
  || { echo "serve.net wire/router selftest FAILED" >&2; exit 1; }
+# Hybrid-step parity smoke (fast tier): the stall-free mixed-batch
+# dispatch (chunked prefill fused into decode dispatches,
+# serving/request_manager._hybrid_batch) must stay BIT-EXACT vs the
+# separate-dispatch path on a tiny mixed workload — the one invariant
+# every hybrid perf claim rests on — so a parity break fails CI in
+# seconds before the full suite (or a BENCH `mixed` round) runs.
+(cd "$(dirname "$0")/.." \
+ && env JAX_PLATFORMS=cpu python -m pytest -q -p no:cacheprovider \
+    "tests/test_hybrid.py::TestHybridParity::test_mixed_from_admission_parity" \
+    >/dev/null) \
+ || { echo "hybrid-step parity smoke FAILED" >&2; exit 1; }
 # KV-pager smoke: pure-host allocator accounting (lease/release/refs,
 # page-alignment validation, spill-store budgeting, restore-vs-
 # recompute pricing) so a broken pager fails CI in milliseconds before
