@@ -179,6 +179,20 @@ def _postmortem_fields() -> dict:
         pass
     if _WATCHDOG is not None and _WATCHDOG.last_bundle:
         out["stall_bundle"] = _WATCHDOG.last_bundle
+    try:
+        from flexflow_tpu.observability import get_metrics_history
+
+        hist = get_metrics_history().snapshot(tail=240)
+        if hist["samples"] and not (
+                isinstance(out.get("stall_bundle"), dict)
+                and out["stall_bundle"].get("metrics_history")):
+            # the round's goodput/frames/queue-depth TIME-SERIES (the
+            # ffstat `metrics history` section); bounded tail so the
+            # record stays readable — and stamped ONCE: a stall bundle
+            # already embeds the same tail
+            out["metrics_history"] = hist
+    except Exception:
+        pass
     return out
 
 
@@ -273,6 +287,18 @@ def _start_watchdog(budget):
                          signals=("SIGTERM", "SIGUSR1"),
                          on_bundle=_stamp_bundle)
     _WATCHDOG.start()
+    # metrics time-series beside the watchdog: every round record (and
+    # every incremental rewrite — the stall-survivor) carries the
+    # goodput/frames/queue-depth history leading up to it, so a stalled
+    # mode leaves a TIME-SERIES on disk, not one terminal snapshot
+    try:
+        from flexflow_tpu.observability import get_metrics_history
+
+        get_metrics_history().start(interval_s=float(
+            os.environ.get("FF_BENCH_HISTORY_S", "1.0") or 1.0))
+    except Exception as e:       # partial installs must not kill bench
+        print(f"bench: metrics history unavailable ({e})",
+              file=sys.stderr)
     return _WATCHDOG
 
 # --kv-dtype override ("bf16" | "int8" | None) applied to the serving

@@ -44,6 +44,8 @@ from .ledger import (RequestLedger, SLOPolicy, get_ledger,
 from .registry import (Counter, Gauge, Histogram, MetricsRegistry,
                        exp_buckets, prometheus_text)
 from .schema import EVENT_SCHEMA, METRICS_SCHEMA
+from .traceplane import (MetricsHistory, TraceAssembler, TraceContext,
+                         get_metrics_history, scalar_values)
 from .tracer import EVENT_NAMES, StepTracer
 from .watchdog import (Heartbeat, Watchdog, collect_bundle, dump_bundle,
                        get_heartbeat)
@@ -52,6 +54,8 @@ __all__ = [
     "Counter", "Gauge", "Histogram", "MetricsRegistry", "StepTracer",
     "FlightRecorder", "Watchdog", "Heartbeat",
     "RequestLedger", "SLOPolicy",
+    "TraceContext", "TraceAssembler", "MetricsHistory",
+    "get_metrics_history", "scalar_values",
     "METRICS_SCHEMA", "EVENT_SCHEMA", "EVENT_NAMES", "exp_buckets",
     "get_registry", "get_tracer", "get_flight_recorder", "get_heartbeat",
     "get_ledger", "slo_report_from", "validate_slo_block",

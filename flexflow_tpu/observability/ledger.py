@@ -267,6 +267,14 @@ class RequestLedger:
                     # enqueued (enabled mid-run): lazily created above
                     t["enqueue_mono"] = None
             self._append(t, now, name, payload)
+            if payload.get("trace_id") is not None:
+                # distributed trace context (observability/traceplane):
+                # any event may carry it (enqueue from a traced submit,
+                # or a later trace-adopt), and the SCALARS are what the
+                # TraceAssembler joins on — event rings can evict
+                t["trace_id"] = str(payload["trace_id"])
+                if payload.get("hop") is not None:
+                    t["hop"] = int(payload["hop"])
             retired_with_policy = False
             if name == "admit":
                 t["admit_mono"] = now
@@ -333,6 +341,7 @@ class RequestLedger:
                         payload: Dict[str, Any]) -> Dict[str, Any]:
         return {
             "guid": guid,
+            "trace_id": None, "hop": None,
             "prompt_len": payload.get("prompt_len"),
             "enqueue_wall": time.time(),
             "enqueue_mono": now,
@@ -469,6 +478,16 @@ class RequestLedger:
             if include_live:
                 out.extend(self._export(t) for t in self._live.values())
             return out
+
+    def timelines_for_trace(self, trace_id: str) -> List[Dict[str, Any]]:
+        """Every timeline (live + retired) stamped with ``trace_id`` —
+        this process's contribution to one distributed trace (the
+        ``/v1/timelines?trace=`` payload the TraceAssembler merges)."""
+        with self._lock:
+            return [self._export(t)
+                    for store in (self._retired, self._live)
+                    for t in store.values()
+                    if t.get("trace_id") == trace_id]
 
     def ttft_of(self, guid: int) -> Optional[float]:
         with self._lock:

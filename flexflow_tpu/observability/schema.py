@@ -335,7 +335,8 @@ METRICS_SCHEMA = {
     "serving_net_requests_total": {
         "type": "counter",
         "help": "HTTP requests served by the wire front-end, labeled "
-                "endpoint=generate|cancel|health|stats|metrics|other "
+                "endpoint=generate|cancel|health|stats|timelines|"
+                "history|metrics|other "
                 "and code=<http status>.  endpoint=generate with "
                 "code=429 is the Overloaded/backpressure class (the "
                 "body carries retry_after_s and the response a "
@@ -368,9 +369,31 @@ METRICS_SCHEMA = {
                 "stream — the wire-side latency envelope the bench "
                 "`net` mode A/Bs against in-process streaming).",
     },
+    # ------------------------------------------------ fleet trace plane
+    # (observability/traceplane.py + serve/net/: wire-propagated trace
+    # context — X-FFServe-Trace — and cross-replica timeline assembly)
+    "serving_trace_hops_total": {
+        "type": "counter",
+        "help": "Trace contexts adopted by this process, labeled "
+                "source=wire (an X-FFServe-Trace header arrived with "
+                "the submit — this hop joins an existing distributed "
+                "trace) | minted (no header: this hop minted a fresh "
+                "trace_id — it is hop 0 of the chain).  One tick per "
+                "request, so wire/minted splits say how much traffic "
+                "arrives already-traced vs starts here.",
+    },
     # ------------------------------------------------ replica router
     # (serve/net/router.py: multi-replica prefix-affinity router over
     # N wire servers, scored from scraped /metrics)
+    "router_route_seconds": {
+        "type": "histogram",
+        "help": "Wall time of one routing decision: submit arrival at "
+                "the router to a replica ACCEPTING the upstream "
+                "submit, including the candidate retry walk past "
+                "rejecting/dead replicas (a failover's re-route "
+                "observes here too).  The router-side latency the "
+                "assembled trace's router-route span renders.",
+    },
     "router_requests_total": {
         "type": "counter",
         "help": "Requests the router accepted for routing, labeled "
@@ -571,6 +594,21 @@ EVENT_SCHEMA = {
         "help": "Circuit breaker opened on a replica after a "
                 "transport failure (replica, cooldown_s); routing "
                 "excludes it until the cooldown expires.",
+    },
+    "trace-adopt": {
+        "help": "A request adopted a distributed trace context (guid, "
+                "trace_id, hop, source=wire|minted): the X-FFServe-"
+                "Trace header's id/hop when one arrived with the "
+                "submit, else a freshly-minted hop-0 context.  The "
+                "ledger stamps trace_id/hop onto the request's "
+                "timeline here — the join key tools/fftrace.py merges "
+                "cross-process timelines on.",
+    },
+    "trace-assemble": {
+        "help": "A TraceAssembler merged one trace_id's timelines "
+                "across sources into a single Chrome trace (trace_id, "
+                "sources, timelines, events) — the router's "
+                "assemble_trace and tools/fftrace.py both record it.",
     },
     "compile": {
         "help": "A serving record compiled + caches allocated (model, "

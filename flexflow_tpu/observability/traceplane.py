@@ -1,0 +1,405 @@
+"""Fleet trace plane: wire-propagated trace context, cross-process
+timeline assembly and metrics time-series history.
+
+Every observability surface below this module stops at the process
+boundary: the ledger's timelines, the flight-recorder ring and the
+registry's gauges all describe ONE process.  The serving stack is now N
+replica processes behind a router (serve/net/), so three cross-process
+primitives live here:
+
+- :class:`TraceContext` — the Dapper-style propagation unit.  A
+  ``trace_id`` (random 128-bit hex, unique across processes by
+  construction) plus a ``hop`` index (0 = the process that minted it;
+  each forwarding hop sends ``child()`` downstream).  On the wire it is
+  the ``X-FFServe-Trace: <trace_id>/<hop>`` header
+  (serve/net/protocol.py); in-process it is stamped onto the request's
+  ledger timeline (``trace_id``/``hop`` fields), so a request that
+  crossed the router and failed over across two replicas leaves
+  timelines in three processes sharing one join key.
+
+- :class:`TraceAssembler` — merges ledger timelines from any number of
+  sources (a router's own ledger, per-replica ``/v1/timelines``
+  payloads, watchdog bundles, bench records) into ONE Chrome-trace /
+  Perfetto file per trace_id.  Cross-process clock alignment uses each
+  timeline's ``enqueue_wall``/``enqueue_mono`` anchor pair (the same
+  trick the flight recorder uses for log correlation): every monotonic
+  stamp converts to wall time through its own timeline's anchors, so
+  sources never need synchronized monotonic clocks — just sane wall
+  clocks, which same-fleet hosts have.  Span/instant names reuse the
+  ledger/StepTracer event vocabulary (schema.EVENT_SCHEMA).
+
+- :class:`MetricsHistory` — a bounded ring of registry snapshots
+  sampled on an interval, answering "goodput over the last minute"
+  instead of only "goodput now".  Near-zero cost when telemetry is
+  disabled (one enabled check, nothing sampled), bounded memory always
+  (deque ring + compact scalar samples), thread-safe behind an RLock
+  (``snapshot()`` runs inside watchdog signal handlers — the bundle's
+  ``metrics_history`` section).  The router keeps one per replica, fed
+  from its /metrics scrapes, so load-score decisions are explainable
+  from the retained series, not just the instantaneous scrape.
+
+See docs/OBSERVABILITY.md "Distributed tracing & metrics history".
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import os
+import re
+import threading
+import time
+import uuid
+from typing import Any, Dict, Iterable, List, Optional, Tuple
+
+__all__ = ["TraceContext", "MetricsHistory", "TraceAssembler",
+           "scalar_values", "get_metrics_history"]
+
+
+# -------------------------------------------------------- trace context
+#: wire shape of one context: <trace_id>/<hop> (lowercase hex / int)
+_TRACE_RE = re.compile(r"^([0-9a-f]{8,32})/(\d{1,4})$")
+
+
+@dataclasses.dataclass(frozen=True)
+class TraceContext:
+    """One hop's view of a distributed trace.
+
+    ``trace_id`` is shared by every hop of one request's journey;
+    ``hop`` is this process's position in the forwarding chain (0 = the
+    minter).  Immutable — forwarding downstream creates :meth:`child`.
+    """
+
+    trace_id: str
+    hop: int = 0
+
+    @classmethod
+    def mint(cls) -> "TraceContext":
+        """A fresh hop-0 context.  uuid4 (os.urandom) — unique across
+        processes without coordination, which is the whole point: two
+        replicas minting concurrently must never collide (pinned by
+        tests/test_traceplane.py across real processes)."""
+        return cls(trace_id=uuid.uuid4().hex, hop=0)
+
+    @classmethod
+    def parse(cls, value: str) -> "TraceContext":
+        """Decode a wire header value; raises ``ValueError`` on
+        anything but ``<hex>/<int>``."""
+        m = _TRACE_RE.match(value.strip().lower())
+        if not m:
+            raise ValueError(
+                f"bad trace context {value!r} (expected <hex-id>/<hop>)")
+        return cls(trace_id=m.group(1), hop=int(m.group(2)))
+
+    def child(self) -> "TraceContext":
+        """The context to forward DOWNSTREAM: same trace, next hop."""
+        return TraceContext(trace_id=self.trace_id, hop=self.hop + 1)
+
+    def header_value(self) -> str:
+        return f"{self.trace_id}/{self.hop}"
+
+
+# ------------------------------------------------------ metrics history
+def scalar_values(snapshot: Dict[str, Any]) -> Dict[str, float]:
+    """Flatten a ``MetricsRegistry.snapshot()`` dict to one compact
+    ``{name: float}`` sample: counters/gauges collapse label splits by
+    summation (the same stance as the router's Prometheus scrape
+    decoder), histograms contribute ``_count``/``_sum`` series.  This
+    is the per-sample payload the history ring stores — a few hundred
+    floats, not the full nested snapshot."""
+    out: Dict[str, float] = {}
+    for name, snap in (snapshot.get("counters") or {}).items():
+        if isinstance(snap, dict):
+            out[name] = float(snap.get("total", 0.0))
+        else:
+            out[name] = float(snap)
+    for name, snap in (snapshot.get("gauges") or {}).items():
+        if isinstance(snap, dict):
+            out[name] = float(sum(snap.values()))
+        else:
+            out[name] = float(snap)
+    for name, snap in (snapshot.get("histograms") or {}).items():
+        if isinstance(snap, dict):
+            out[name + "_count"] = float(snap.get("count", 0))
+            out[name + "_sum"] = float(snap.get("sum", 0.0))
+    return out
+
+
+class MetricsHistory:
+    """Bounded time-series ring of metric samples.
+
+    Two feed paths share the ring:
+
+    - :meth:`sample` — pull one sample from a live registry (the
+      process-local sampler thread started by :meth:`start`);
+    - :meth:`append` — push an externally-obtained value map (the
+      router's per-replica retention, fed from /metrics scrapes).
+
+    Each sample is ``{"wall": time.time(), "mono": time.monotonic(),
+    "values": {name: float}}``.  Memory is bounded by the ring capacity
+    no matter how long the process serves; ``dropped`` counts what fell
+    off.  Disabled telemetry (``registry.enabled`` False) makes
+    :meth:`sample` a no-op, so the sampler thread costs one attribute
+    read per interval under ``FF_TELEMETRY=0``.
+    """
+
+    def __init__(self, capacity: int = 512,
+                 interval_s: float = 1.0):
+        self.capacity = max(2, int(capacity))
+        self.interval_s = max(0.01, float(interval_s))
+        # RLock, not Lock: snapshot() runs inside watchdog signal
+        # handlers (the bundle's metrics_history tail) which can
+        # interrupt a mid-append main thread — a plain Lock would
+        # self-deadlock the dump (fflint lock-discipline)
+        self._lock = threading.RLock()
+        self._ring: collections.deque = collections.deque(
+            maxlen=self.capacity)
+        self._seq = 0
+        self._thread: Optional[threading.Thread] = None
+        self._stop_evt = threading.Event()
+
+    # ---------------------------------------------------------------- feed
+    def append(self, values: Dict[str, float],
+               wall: Optional[float] = None) -> None:
+        """Push one externally-sampled value map (already scalar)."""
+        sample = {"wall": float(wall if wall is not None
+                                else time.time()),
+                  "mono": time.monotonic(),
+                  "values": dict(values)}
+        with self._lock:
+            self._ring.append(sample)
+            self._seq += 1
+
+    def sample(self, registry=None) -> bool:
+        """Pull one sample from ``registry`` (default: the process-wide
+        one).  Returns False without touching the ring when telemetry
+        is disabled — the near-zero-cost gate."""
+        if registry is None:
+            from . import get_registry
+
+            registry = get_registry()
+        if not registry.enabled:
+            return False
+        self.append(scalar_values(registry.snapshot()))
+        return True
+
+    # ------------------------------------------------------------- sampler
+    def start(self, interval_s: Optional[float] = None) -> "MetricsHistory":
+        """Start (idempotently) the background sampler thread against
+        the process-wide registry."""
+        if interval_s is not None:
+            self.interval_s = max(0.01, float(interval_s))
+        if self._thread is not None and self._thread.is_alive():
+            return self
+        self._stop_evt.clear()
+        self._thread = threading.Thread(target=self._run,
+                                        name="ff-metrics-history",
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop_evt.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=2.0)
+        self._thread = None
+
+    def _run(self) -> None:
+        while not self._stop_evt.wait(self.interval_s):
+            try:
+                self.sample()
+            except Exception:       # one bad sample must not kill the ring
+                pass
+
+    # ---------------------------------------------------------------- read
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._ring)
+
+    @property
+    def dropped(self) -> int:
+        with self._lock:
+            return max(0, self._seq - len(self._ring))
+
+    def series(self, name: str) -> List[Tuple[float, float]]:
+        """``[(wall, value), ...]`` for one metric across the ring —
+        the plot-ready view ('goodput over the last minute')."""
+        with self._lock:
+            samples = list(self._ring)
+        return [(s["wall"], s["values"][name]) for s in samples
+                if name in s["values"]]
+
+    def snapshot(self, tail: Optional[int] = None) -> Dict[str, Any]:
+        """JSON-serializable dump (the ``/v1/metrics/history`` payload
+        and the watchdog bundle's ``metrics_history`` section).
+        ``tail`` keeps only the most recent N samples."""
+        with self._lock:
+            samples = list(self._ring)
+            seq = self._seq
+        # dropped = what the RING evicted, not what `tail` trimmed —
+        # a tail-truncated dump of a never-full ring lost nothing
+        dropped = max(0, seq - len(samples))
+        if tail is not None:
+            samples = samples[-max(0, int(tail)):]
+        return {
+            "capacity": self.capacity,
+            "interval_s": self.interval_s,
+            "recorded": seq,
+            "dropped": dropped,
+            "samples": samples,
+        }
+
+    def clear(self) -> None:
+        with self._lock:
+            self._ring.clear()
+            self._seq = 0
+
+
+_HISTORY = MetricsHistory(
+    capacity=int(os.environ.get("FF_HISTORY_SAMPLES", "512") or 512),
+    interval_s=float(os.environ.get("FF_HISTORY_INTERVAL_S", "1.0")
+                     or 1.0))
+
+
+def get_metrics_history() -> MetricsHistory:
+    """The process-wide metrics history ring (allocated always; the
+    sampler thread only runs once something calls ``start()`` — the
+    wire server and bench.py do)."""
+    return _HISTORY
+
+
+# ----------------------------------------------------- timeline assembly
+def _wall_of(t: Dict[str, Any], mono: Optional[float]) -> Optional[float]:
+    """Convert one monotonic stamp to wall time through the timeline's
+    own ``enqueue_wall``/``enqueue_mono`` anchor pair; None when the
+    stamp or the anchors are missing (hand-built timelines)."""
+    if mono is None:
+        return None
+    w0, m0 = t.get("enqueue_wall"), t.get("enqueue_mono")
+    if w0 is None or m0 is None:
+        return None
+    return float(w0) + (float(mono) - float(m0))
+
+
+class TraceAssembler:
+    """Merge ledger timelines from N sources into one Chrome trace.
+
+    Each source is a labeled list of timeline dicts (the shape
+    ``RequestLedger.snapshot()['live'|'retired']`` / ``/v1/timelines``
+    carry).  ``build(trace_id)`` selects every timeline stamped with
+    that trace_id, converts each to wall-clock-anchored Chrome-trace
+    events (one ``pid`` per source, ``tid`` = the timeline's guid) and
+    returns the Perfetto-loadable dict: lifecycle phases as ``X``
+    complete spans (queue, ttft, stream), every ledger event as a
+    thread-scoped instant under its schema name.
+    """
+
+    def __init__(self) -> None:
+        self._sources: List[Tuple[str, List[Dict[str, Any]]]] = []
+
+    def add_source(self, label: str,
+                   timelines: Iterable[Dict[str, Any]]) -> int:
+        """Register one source; returns how many of its timelines carry
+        a trace_id (the mergeable subset)."""
+        tls = [t for t in timelines if isinstance(t, dict)]
+        self._sources.append((str(label), tls))
+        return sum(1 for t in tls if t.get("trace_id"))
+
+    def trace_ids(self) -> Dict[str, int]:
+        """``{trace_id: timeline count}`` across every source — the
+        menu ``fftrace`` prints when no --trace is given."""
+        out: Dict[str, int] = {}
+        for _, tls in self._sources:
+            for t in tls:
+                tid = t.get("trace_id")
+                if tid:
+                    out[tid] = out.get(tid, 0) + 1
+        return out
+
+    # ------------------------------------------------------------- build
+    def build(self, trace_id: str) -> Dict[str, Any]:
+        """One Chrome trace for ``trace_id``.  Raises ``ValueError``
+        when no source holds a timeline with it."""
+        picked: List[Tuple[int, str, Dict[str, Any]]] = []
+        for pid, (label, tls) in enumerate(self._sources):
+            for t in tls:
+                if t.get("trace_id") == trace_id:
+                    picked.append((pid, label, t))
+        if not picked:
+            raise ValueError(
+                f"trace {trace_id!r} not found in any source "
+                f"({[s[0] for s in self._sources]})")
+        # global wall origin: earliest stamp across every picked
+        # timeline, so ts is a small positive µs offset
+        origins = [w for _, _, t in picked
+                   for w in (_wall_of(t, t.get("enqueue_mono")),)
+                   if w is not None]
+        t0 = min(origins) if origins else 0.0
+        events: List[Dict[str, Any]] = []
+        seen_pids: Dict[int, str] = {}
+        for pid, label, t in picked:
+            hop = t.get("hop")
+            if pid not in seen_pids:
+                name = (f"{label} (hop {hop})" if hop is not None
+                        else label)
+                seen_pids[pid] = name
+                events.append({"ph": "M", "name": "process_name",
+                               "pid": pid, "tid": 0,
+                               "args": {"name": name}})
+            events.extend(self._timeline_events(pid, t, t0))
+        events.sort(key=lambda e: e.get("ts", 0))
+        return {
+            "traceEvents": events,
+            "displayTimeUnit": "ms",
+            "otherData": {
+                "trace_id": trace_id,
+                "sources": [seen_pids[p] for p in sorted(seen_pids)],
+                "timelines": len(picked),
+            },
+        }
+
+    @staticmethod
+    def _timeline_events(pid: int, t: Dict[str, Any],
+                         t0: float) -> List[Dict[str, Any]]:
+        tid = int(t.get("guid") or 0)
+        base = {"pid": pid, "tid": tid, "cat": "serving"}
+
+        def ts_us(mono: Optional[float]) -> Optional[float]:
+            w = _wall_of(t, mono)
+            return None if w is None else round((w - t0) * 1e6, 1)
+
+        out: List[Dict[str, Any]] = []
+        # lifecycle phases as complete spans, from the timeline's
+        # scalar stamps (never subject to per-request event-ring
+        # eviction — same stance as ffreq.phases_of)
+        enq, adm = t.get("enqueue_mono"), t.get("admit_mono")
+        first, last = t.get("first_commit_mono"), t.get("last_commit_mono")
+        spans = []
+        if enq is not None and adm is not None:
+            spans.append(("queue", enq, adm))
+        if adm is not None and t.get("ttft_s") is not None:
+            spans.append(("ttft", adm, adm + t["ttft_s"]))
+        elif adm is not None and first is not None:
+            spans.append(("ttft", adm, first))
+        if first is not None and last is not None and last > first:
+            spans.append(("stream", first, last))
+        for name, lo, hi in spans:
+            ts = ts_us(lo)
+            if ts is None:
+                continue
+            out.append({**base, "ph": "X", "name": name, "ts": ts,
+                        "dur": max(0.0, round((hi - lo) * 1e6, 1)),
+                        "args": {"guid": t.get("guid"),
+                                 "hop": t.get("hop")}})
+        # every ledger event as a thread-scoped instant under its
+        # schema name (the StepTracer vocabulary)
+        for ev in t.get("events") or []:
+            ts = ts_us(ev.get("t"))
+            if ts is None:
+                continue
+            args = {k: v for k, v in ev.items() if k not in ("name", "t")}
+            out.append({**base, "ph": "i", "s": "t",
+                        "name": str(ev.get("name", "?")), "ts": ts,
+                        "args": args})
+        return out

@@ -167,6 +167,17 @@ def collect_bundle(reason: str, heartbeat: Optional[Heartbeat] = None,
         "threads": _thread_stacks(),
         "jax": _jax_stats(),
     }
+    # metrics time-series leading into the dump: a stall bundle shows
+    # the minutes BEFORE the stall (goodput/queue-depth/frames decay),
+    # not just the terminal snapshot — ffstat prints the tail
+    try:
+        from .traceplane import get_metrics_history
+
+        hist = get_metrics_history().snapshot(tail=240)
+        if hist["samples"]:
+            bundle["metrics_history"] = hist
+    except Exception:  # pragma: no cover - partial install
+        pass
     # paged-KV state: pages free/leased + spilled GUIDs per live pager
     # (lazy import — serving imports observability at module load, so
     # the reverse edge must only exist at bundle time; best-effort:

@@ -407,8 +407,9 @@ class AsyncServeFrontend:
     # -------------------------------------------------------------- intake
     async def submit(self, prompt, max_new_tokens: int = 128,
                      deadline_s: Optional[float] = None,
-                     stream_queue_tokens: Optional[int] = None
-                     ) -> TokenStream:
+                     stream_queue_tokens: Optional[int] = None,
+                     trace=None,
+                     trace_source: Optional[str] = None) -> TokenStream:
         """Enqueue one request; returns its :class:`TokenStream`.
 
         Raises :class:`Overloaded` (with ``retry_after_s``) at the
@@ -416,7 +417,14 @@ class AsyncServeFrontend:
         shutdown/failure.  ``deadline_s`` is a wall budget from NOW
         (submission); None derives one from the installed SLOPolicy
         (``deadline_factor * (ttft_s + max_new_tokens * tpot_s)``) and
-        stays None when no policy is installed."""
+        stays None when no policy is installed.  ``trace`` is an
+        adopted :class:`~flexflow_tpu.observability.TraceContext` (the
+        wire server passes the X-FFServe-Trace header's): it is
+        stamped onto the request's ledger timeline so cross-process
+        trace assembly can join this hop.  ``trace_source`` labels
+        ``serving_trace_hops_total`` — "wire" when the context arrived
+        in an inbound header, "minted" when this process created it;
+        None infers from the hop (hop>0 must have been forwarded)."""
         if self._failed is not None:
             self._m_rejected.inc(reason="closed")
             raise FrontendClosed(str(self._failed))
@@ -427,7 +435,9 @@ class AsyncServeFrontend:
                 len(self.rm.pending), self.shed_policy.max_pending)
         if deadline_s is None:
             deadline_s = self._policy_deadline_s(max_new_tokens)
-        req = self.rm.register_new_request(prompt, max_new_tokens)
+        req = self.rm.register_new_request(prompt, max_new_tokens,
+                                           trace=trace,
+                                           trace_source=trace_source)
         stream = TokenStream(
             self, req,
             stream_queue_tokens or self.stream_queue_tokens,

@@ -27,6 +27,7 @@ import asyncio
 from collections import deque
 from typing import Any, Dict, List, Optional, Tuple, Union
 
+from ...observability.traceplane import TraceContext
 from ..frontend import FrontendClosed, Overloaded, RequestAborted
 from . import protocol as wire
 
@@ -87,11 +88,15 @@ class WireStream:
 
     def __init__(self, reader: asyncio.StreamReader,
                  writer: asyncio.StreamWriter, guid: int,
-                 request_id: Optional[str]):
+                 request_id: Optional[str],
+                 trace: Optional[TraceContext] = None):
         self._reader = reader
         self._writer = writer
         self.guid = guid
         self.request_id = request_id
+        #: the trace context this stream was submitted under (the
+        #: server-side timelines join on its trace_id)
+        self.trace = trace
         self.tokens: List[int] = []
         self._parser = wire.SSEParser()
         self._pending: "deque" = deque()
@@ -245,6 +250,24 @@ class NetClient:
     async def metrics_values(self) -> Dict[str, float]:
         return wire.parse_prometheus_gauges(await self.metrics_text())
 
+    async def timelines(self, guid: Optional[int] = None,
+                        trace: Optional[str] = None) -> Dict[str, Any]:
+        """Fetch the peer's request-ledger timelines: full recent
+        snapshot by default, one timeline with ``guid``, one
+        distributed trace's timelines with ``trace`` (the
+        TraceAssembler / fftrace feed)."""
+        path = wire.P_TIMELINES
+        if guid is not None:
+            path += f"?guid={int(guid)}"
+        elif trace is not None:
+            path += f"?trace={trace}"
+        return (await self.request_json("GET", path))[1]
+
+    async def metrics_history(self) -> Dict[str, Any]:
+        """Fetch the peer's MetricsHistory ring (time-series of
+        registry samples; routers add per-replica rings)."""
+        return (await self.request_json("GET", wire.P_HISTORY))[1]
+
     async def cancel(self, guid: int, reason: str = "client") -> bool:
         try:
             status, obj = await self.request_json(
@@ -259,17 +282,27 @@ class NetClient:
                        deadline_s: Optional[float] = None,
                        tenant: Optional[str] = None,
                        skip_tokens: int = 0,
-                       request_id: Optional[str] = None) -> WireStream:
+                       request_id: Optional[str] = None,
+                       trace: Optional[TraceContext] = None
+                       ) -> WireStream:
         """Submit over the wire; returns a live :class:`WireStream`
         once the server's ``meta`` event lands.  Raises ``Overloaded``
         on 429, ``FrontendClosed`` on 503, :class:`ProtocolError` on
-        4xx, :class:`ReplicaUnavailable` on transport failure."""
+        4xx, :class:`ReplicaUnavailable` on transport failure.
+
+        ``trace``: the distributed-trace context to propagate (a
+        forwarding hop passes ``ctx.child()``).  None MINTS a fresh
+        hop-0 context — every wire submission is traceable end to end
+        without callers opting in."""
+        if trace is None:
+            trace = TraceContext.mint()
         sub = wire.SubmitRequest(prompt=prompt,
                                  max_new_tokens=max_new_tokens,
                                  tenant=tenant, skip_tokens=skip_tokens,
-                                 request_id=request_id)
-        headers = ({wire.H_DEADLINE: f"{deadline_s:.6f}"}
-                   if deadline_s is not None else None)
+                                 request_id=request_id, trace=trace)
+        headers = {wire.H_TRACE: trace.header_value()}
+        if deadline_s is not None:
+            headers[wire.H_DEADLINE] = f"{deadline_s:.6f}"
         reader, writer = await self._connect()
         try:
             writer.write(_request_bytes("POST", wire.P_GENERATE,
@@ -306,7 +339,7 @@ class NetClient:
             pending.appendleft((event, data))
             data = {}
         ws = WireStream(reader, writer, int(data.get("guid", -1)),
-                        data.get("request_id"))
+                        data.get("request_id"), trace=trace)
         ws._parser = parser
         ws._pending = pending
         return ws

@@ -24,6 +24,14 @@ path                        verb   semantics
 ``/v1/stats``               GET    metrics snapshot + SLO report +
                                    frontend stats (JSON; the ffload
                                    wire transport's counter source)
+``/v1/timelines``           GET    request-ledger timelines (JSON):
+                                   recent retired + live; ``?guid=G``
+                                   one timeline, ``?trace=TID`` the
+                                   timelines of one distributed trace
+                                   (the TraceAssembler/fftrace feed)
+``/v1/metrics/history``     GET    the MetricsHistory ring (JSON
+                                   time-series of registry samples;
+                                   routers add per-replica rings)
 ``/metrics``                GET    Prometheus text exposition
                                    (``MetricsRegistry.expose_text``)
 ==========================  =====  =====================================
@@ -45,11 +53,21 @@ budget in seconds, a float) overrides the body's ``deadline_s`` — a
 router forwards the *remaining* budget downstream, so queue time spent
 at one hop shrinks the deadline at the next.
 
+Trace propagation: the ``X-FFServe-Trace: <trace_id>/<hop>`` header
+(observability/traceplane.TraceContext) carries the distributed trace
+context.  The RECEIVER adopts the header as its own hop; a forwarding
+hop sends ``child()`` (same trace_id, hop+1) downstream.  NetClient
+mints a fresh hop-0 context when the caller gives none, so every wire
+submission is traceable end to end; the server stamps trace_id/hop
+onto the request's ledger timeline (the ``/v1/timelines`` join key)
+and echoes the trace_id in the SSE ``meta`` event.
+
 SSE framing (``Content-Type: text/event-stream``; one event per
 generated token — the per-token latency envelope is the wire's, not a
 batching layer's)::
 
-    event: meta\\n  data: {"protocol":1,"guid":g,"request_id":...}\\n\\n
+    event: meta\\n  data: {"protocol":1,"guid":g,"request_id":...,
+                           "trace_id":...}\\n\\n
     event: token\\n data: {"t": <id>, "i": <index>}\\n\\n
     event: done\\n  data: {"status":"retired","tokens":n}\\n\\n
     event: error\\n data: {"status":"cancelled|failed","reason":r,
@@ -73,6 +91,8 @@ import dataclasses
 import json
 from typing import Any, Dict, List, Optional, Tuple, Union
 
+from ...observability.traceplane import TraceContext
+
 PROTOCOL_VERSION = 1
 
 # ------------------------------------------------------------ endpoints
@@ -80,12 +100,19 @@ P_GENERATE = "/v1/generate"
 P_CANCEL = "/v1/cancel"
 P_HEALTH = "/v1/health"
 P_STATS = "/v1/stats"
+P_TIMELINES = "/v1/timelines"
+P_HISTORY = "/v1/metrics/history"
 P_METRICS = "/metrics"
 
 #: deadline propagation header: REMAINING budget (seconds, float).
 #: Overrides the body's deadline_s; a router forwards the remaining
 #: budget so multi-hop queueing never silently extends an SLO.
 H_DEADLINE = "x-ffserve-deadline-s"
+
+#: distributed-trace propagation header: ``<trace_id>/<hop>``
+#: (TraceContext.header_value()).  The receiver ADOPTS this context;
+#: forwarding hops send child() downstream.
+H_TRACE = "x-ffserve-trace"
 
 _MAX_BODY = 8 << 20          # 8 MiB: longest token-id prompt we accept
 _MAX_HEAD = 64 << 10         # request/response head size cap
@@ -120,6 +147,15 @@ class SubmitRequest:
     tenant: Optional[str] = None
     skip_tokens: int = 0
     request_id: Optional[str] = None
+    #: adopted distributed-trace context (the X-FFServe-Trace header;
+    #: rides headers, never the body — like the deadline)
+    trace: Optional[TraceContext] = None
+    #: how ``trace`` was obtained — "wire" when parse_submit decoded
+    #: it from an inbound header (this hop JOINS a distributed trace,
+    #: whatever its hop number), "minted" when the server created one
+    #: for a header-less foreign client.  Never encoded: it is the
+    #: serving_trace_hops_total{source} label, not wire state.
+    trace_source: Optional[str] = None
 
     def encode(self) -> bytes:
         out: Dict[str, Any] = {"protocol": PROTOCOL_VERSION,
@@ -201,9 +237,18 @@ def parse_submit(body: bytes,
     if rid is not None and not isinstance(rid, str):
         raise ProtocolError(400, "bad_request",
                             "request_id must be a string")
+    trace = None
+    tr_hdr = (headers or {}).get(H_TRACE)
+    if tr_hdr is not None:
+        try:
+            trace = TraceContext.parse(tr_hdr)
+        except ValueError as e:
+            raise ProtocolError(400, "bad_request", str(e))
     return SubmitRequest(prompt=prompt, max_new_tokens=max_new,
                          deadline_s=deadline, tenant=tenant,
-                         skip_tokens=skip, request_id=rid)
+                         skip_tokens=skip, request_id=rid, trace=trace,
+                         trace_source="wire" if trace is not None
+                         else None)
 
 
 # --------------------------------------------------------- SSE framing

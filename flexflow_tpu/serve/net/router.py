@@ -65,7 +65,9 @@ import time
 import types
 from typing import Dict, List, Optional, Sequence, Set, Tuple, Union
 
-from ...observability import get_flight_recorder, get_registry
+from ...observability import (MetricsHistory, TraceAssembler,
+                              TraceContext, get_flight_recorder,
+                              get_ledger, get_registry)
 from ..frontend import FrontendClosed, Overloaded, RequestAborted
 from . import protocol as wire
 from .client import (NetClient, NetError, ReplicaUnavailable,
@@ -86,6 +88,12 @@ class ReplicaHandle:
     scrape_ok: bool = False
     score: float = 0.0
     circuit_open_until: float = 0.0
+    #: retained time-series of this replica's scrapes: every load-score
+    #: decision is explainable/replayable from the history the router
+    #: kept, not just the instantaneous scrape (RouterServer serves it
+    #: at /v1/metrics/history)
+    history: MetricsHistory = dataclasses.field(
+        default_factory=lambda: MetricsHistory(capacity=256))
 
     @property
     def load(self) -> float:
@@ -144,12 +152,21 @@ class ReplicaRouter:
         self._affinity: Dict[str, str] = {}
         self._live: Set["RoutedStream"] = set()
         self.recorder = get_flight_recorder()
+        # router-hop request timelines land in this process's ledger
+        # (routed guids live in their own range below the engine's
+        # 1000000 floor, so an in-process engine never collides):
+        # enqueue/admit/route/failover/commit/retire under the request's
+        # trace_id — the router's contribution to an assembled trace,
+        # served at the RouterServer's /v1/timelines
+        self.ledger = get_ledger()
         m = get_registry()
         self._m_req = m.counter("router_requests_total")
         self._m_failover = m.counter("router_failovers_total")
         self._m_affinity = m.counter("router_affinity_total")
         self._m_score = m.gauge("router_replica_score")
         self._m_circuit = m.counter("router_circuit_open_total")
+        self._m_route_lat = m.histogram("router_route_seconds")
+        self._m_trace_hops = m.counter("serving_trace_hops_total")
         self._scrape_task: Optional[asyncio.Task] = None
 
     # ----------------------------------------------------------- lifecycle
@@ -193,6 +210,9 @@ class ReplicaRouter:
             try:
                 r.scrape = await r.client.metrics_values()
                 r.scrape_ok = True
+                # retain the sample: the score this scrape produces is
+                # replayable from the ring, not just the latest values
+                r.history.append(r.scrape)
             except (NetError, wire.ProtocolError):
                 r.scrape_ok = False
                 self._open_circuit(r, why="scrape")
@@ -308,16 +328,33 @@ class ReplicaRouter:
                        deadline_s: Optional[float] = None,
                        tenant: Optional[str] = None,
                        skip_tokens: int = 0,
-                       request_id: Optional[str] = None
+                       request_id: Optional[str] = None,
+                       trace: Optional[TraceContext] = None
                        ) -> "RoutedStream":
         """Route one request; returns a :class:`RoutedStream` whose
         iteration survives replica death (failover + deterministic
         resume).  Raises like ``NetClient.generate`` when no replica
-        accepts."""
+        accepts.  ``trace`` is the adopted distributed-trace context
+        (RouterServer passes the X-FFServe-Trace header's); None mints
+        a fresh hop-0 one — either way the router records its own hop
+        under the trace_id and forwards ``child()`` to the replica."""
+        if trace is None:
+            trace = TraceContext.mint()
+            source = "minted"
+        else:
+            source = "wire"
         rs = RoutedStream(self, prompt, max_new_tokens,
                           (time.monotonic() + deadline_s
                            if deadline_s is not None else None),
-                          tenant, skip_tokens, request_id)
+                          tenant, skip_tokens, request_id, trace)
+        self._m_trace_hops.inc(source=source)
+        self.recorder.record_event("trace-adopt", guid=rs.guid,
+                                   trace_id=trace.trace_id,
+                                   hop=trace.hop, source=source)
+        plen = len(prompt) if not isinstance(prompt, str) else None
+        self.ledger.note_event("enqueue", guid=rs.guid,
+                               prompt_len=plen,
+                               trace_id=trace.trace_id, hop=trace.hop)
         await rs._bind_first()
         self._live.add(rs)
         return rs
@@ -335,6 +372,42 @@ class ReplicaRouter:
                 asyncio.ensure_future(
                     rs._replica.client.cancel(rs.upstream_guid, reason))
                 return
+
+    # ----------------------------------------------------- trace assembly
+    async def assemble_trace(self, trace_id: str) -> Dict[str, object]:
+        """One Chrome trace for ``trace_id`` across the whole fleet:
+        the router's own hop timelines (this process's ledger) merged
+        with every reachable replica's ``/v1/timelines?trace=``
+        payload.  Unreachable replicas (killed mid-stream — the
+        failover case) are skipped, not fatal: their half of the story
+        can be grafted offline from a saved bundle/snapshot via
+        ``tools/fftrace.py``.  Raises ``ValueError`` when no source
+        holds the trace."""
+        asm = TraceAssembler()
+        asm.add_source("router", self.ledger.timelines_for_trace(
+            trace_id))
+
+        async def pull(r: ReplicaHandle):
+            try:
+                doc = await r.client.timelines(trace=trace_id)
+            except (NetError, wire.ProtocolError):
+                return r.url, None
+            led = doc.get("ledger") or {}
+            return r.url, ((led.get("retired") or [])
+                           + (led.get("live") or []))
+
+        for url, tls in await asyncio.gather(
+                *(pull(r) for r in self.replicas)):
+            if tls:
+                asm.add_source(url, tls)
+        trace = asm.build(trace_id)
+        meta = trace.get("otherData") or {}
+        self.recorder.record_event(
+            "trace-assemble", trace_id=trace_id,
+            sources=len(meta.get("sources") or ()),
+            timelines=meta.get("timelines"),
+            events=len(trace.get("traceEvents") or ()))
+        return trace
 
     # ------------------------------------------------------ server facade
     def frontend_facade(self) -> "types.SimpleNamespace":
@@ -373,7 +446,9 @@ class ReplicaRouter:
 
 #: router-local stream ids (``RoutedStream.guid``): upstream guids
 #: collide across replica processes and change on failover, so the
-#: router's public id is its own
+#: router's public id is its own.  Counts from 1 — disjoint from the
+#: engine's process-wide guid floor (1000000), so router-hop ledger
+#: timelines never collide with an in-process engine's.
 _ROUTED_GUID = itertools.count(1)
 
 
@@ -388,7 +463,8 @@ class RoutedStream:
     def __init__(self, router: ReplicaRouter,
                  prompt: Union[List[int], str], max_new_tokens: int,
                  deadline_mono: Optional[float], tenant: Optional[str],
-                 skip_initial: int, request_id: Optional[str]):
+                 skip_initial: int, request_id: Optional[str],
+                 trace: Optional[TraceContext] = None):
         self._router = router
         self._prompt = prompt
         self._max_new = max_new_tokens
@@ -396,6 +472,8 @@ class RoutedStream:
         self._tenant = tenant
         self._skip_initial = int(skip_initial)
         self.request_id = request_id
+        #: the router hop's trace context; replicas get trace.child()
+        self.trace = trace
         self.tokens: List[int] = []     # relayed to the consumer
         self.failovers = 0
         self._key = router.affinity_key(prompt, tenant)
@@ -403,6 +481,7 @@ class RoutedStream:
         self._replica: Optional[ReplicaHandle] = None
         self._ws: Optional[WireStream] = None
         self._final: Optional[str] = None
+        self._failover_mono: Optional[float] = None
         self._rid = next(_ROUTED_GUID)
 
     # ------------------------------------------------------------- binding
@@ -417,6 +496,8 @@ class RoutedStream:
         there)."""
         router = self._router
         last: Optional[BaseException] = None
+        t_route0 = time.monotonic()
+        skip = self._skip_initial + len(self.tokens)
         for _ in range(len(router.replicas)):
             try:
                 replica, outcome = router._select(self._key,
@@ -431,8 +512,10 @@ class RoutedStream:
                 ws = await replica.client.generate(
                     self._prompt, max_new_tokens=self._max_new,
                     deadline_s=deadline, tenant=self._tenant,
-                    skip_tokens=self._skip_initial + len(self.tokens),
-                    request_id=self.request_id)
+                    skip_tokens=skip,
+                    request_id=self.request_id,
+                    trace=(self.trace.child() if self.trace is not None
+                           else None))
             except (ReplicaUnavailable, StreamBroken) as e:
                 last = e
                 self._exclude.add(replica.url)
@@ -449,9 +532,36 @@ class RoutedStream:
             # the retry walk must not claim the key or inflate the
             # hit-rate denominator)
             router._commit_route(self._key, replica, outcome)
+            route_s = time.monotonic() - t_route0
+            router._m_route_lat.observe(route_s)
             router.recorder.record_event(
                 "router-route", replica=replica.url, affinity=outcome,
                 key=self._key)
+            # the router-hop span trail: admit closes the router-queue
+            # span (the TTFT clock of THIS hop — replica queue_wait +
+            # ttft + first relay ride inside it), and router-route
+            # carries the decision's score components so an assembled
+            # trace explains WHY this replica, not just which.  A
+            # resume route additionally carries the failover gap and
+            # the replayed-prefix length (the replica regenerates and
+            # suppresses `skip` tokens — deterministic resume).
+            led = router.ledger
+            if first:
+                # FIRST bind only: a failover re-bind must not restamp
+                # admit_mono — that would swallow replica A's streaming
+                # time into queue_s and drive this hop's ttft negative
+                led.note_event("admit", guid=self.guid)
+            led.note_event(
+                "router-route", guid=self.guid, replica=replica.url,
+                affinity=outcome, route_s=round(route_s, 6),
+                score=round(replica.score, 4),
+                goodput=replica.goodput, load=replica.load,
+                frames_free=replica.frames_free,
+                **({"resume": True, "replayed": skip,
+                    "gap_s": round(time.monotonic()
+                                   - self._failover_mono, 6)}
+                   if self._failover_mono is not None else {}))
+            self._failover_mono = None
             return
         self._finish("rejected")
         if isinstance(last, (Overloaded, FrontendClosed)):
@@ -491,6 +601,11 @@ class RoutedStream:
             try:
                 tok = await self._ws.__anext__()
                 self.tokens.append(tok)
+                if len(self.tokens) == 1:
+                    # the router hop's first-token stamp: closes this
+                    # hop's ttft span (replica queue+prefill+relay)
+                    self._router.ledger.note_event(
+                        "commit", guid=self.guid, tokens=1)
                 return tok
             except StopAsyncIteration:
                 self._finish("completed")
@@ -526,8 +641,15 @@ class RoutedStream:
             self._finish("failed")
             raise RequestAborted(self.guid, "replica_failed",
                                  self.tokens)
+        self._failover_mono = time.monotonic()
         router.recorder.record_event(
             "router-failover",
+            replica=failed.url if failed else None,
+            relayed=len(self.tokens))
+        # the failover-gap span opens HERE on the router-hop timeline
+        # and closes at the resume router-route note (gap_s)
+        router.ledger.note_event(
+            "router-failover", guid=self.guid,
             replica=failed.url if failed else None,
             relayed=len(self.tokens))
         router._m_failover.inc()
@@ -539,6 +661,15 @@ class RoutedStream:
             return
         self._final = outcome
         self._router._live.discard(self)
+        # finalize the router-hop timeline so it retires into the
+        # ledger ring (assemblable after the stream is gone)
+        if outcome == "completed":
+            self._router.ledger.note_event(
+                "retire", guid=self.guid, tokens=len(self.tokens))
+        else:
+            self._router.ledger.note_event(
+                "cancel", guid=self.guid, reason=outcome,
+                tokens=len(self.tokens))
         if count:
             self._router._m_req.inc(outcome=outcome)
 
@@ -561,13 +692,30 @@ class RouterServer(ServeNetServer):
         rs = await self.router.generate(
             sub.prompt, max_new_tokens=sub.max_new_tokens,
             deadline_s=sub.deadline_s, tenant=sub.tenant,
-            skip_tokens=sub.skip_tokens, request_id=sub.request_id)
+            skip_tokens=sub.skip_tokens, request_id=sub.request_id,
+            trace=sub.trace)
         # the resume prefix is suppressed UPSTREAM (the replica server
         # applies skip_tokens); zero the local SSE skip so the
         # inherited _stream_sse does not drop another skip_tokens from
         # the already-suppressed relay
         sub.skip_tokens = 0
+        # a header-less client still gets a traceable stream: the
+        # router minted inside generate() — echo it through the meta
+        sub.trace = rs.trace
         return rs
+
+    async def _h_history(self, writer) -> int:
+        """The router's own history PLUS the per-replica rings it
+        retained from scrapes — the load-score decisions' evidence."""
+        from ...observability import get_metrics_history
+
+        writer.write(wire.json_response(
+            200, {"protocol": wire.PROTOCOL_VERSION,
+                  "history": get_metrics_history().snapshot(),
+                  "replicas": {r.url: r.history.snapshot()
+                               for r in self.router.replicas}}))
+        await writer.drain()
+        return 200
 
 
 # --------------------------------------------------- replica processes

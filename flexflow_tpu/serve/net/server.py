@@ -38,10 +38,11 @@ import asyncio
 import json
 import signal
 import time
+import urllib.parse
 from typing import Dict, Optional, Tuple
 
 from ...observability import (get_flight_recorder, get_ledger,
-                              get_registry)
+                              get_metrics_history, get_registry)
 from ..frontend import (AsyncServeFrontend, FrontendClosed, Overloaded,
                         RequestAborted)
 from . import protocol as wire
@@ -50,6 +51,11 @@ __all__ = ["ServeNetServer"]
 
 #: idle keep-alive window before a quiet connection is closed
 _KEEPALIVE_IDLE_S = 75.0
+
+
+def _query_params(query: str) -> Dict[str, str]:
+    """``a=b&c=d`` decoder (last wins; bare keys map to "")."""
+    return dict(urllib.parse.parse_qsl(query, keep_blank_values=True))
 
 
 class ServeNetServer:
@@ -90,6 +96,10 @@ class ServeNetServer:
         self._server = await asyncio.start_server(
             self._handle_conn, self.host, self.port)
         self.port = self._server.sockets[0].getsockname()[1]
+        # metrics time-series: a serving process keeps history so
+        # /v1/metrics/history answers "goodput over the last minute",
+        # not just "goodput now" (no-op ticks under FF_TELEMETRY=0)
+        get_metrics_history().start()
         return self
 
     @property
@@ -196,6 +206,7 @@ class ServeNetServer:
                      writer: asyncio.StreamWriter) -> bool:
         """Dispatch one request; returns True to keep the connection."""
         t0 = time.monotonic()
+        path, _, query = path.partition("?")
         endpoint, code, keep = "other", 404, True
         try:
             if path == wire.P_GENERATE:
@@ -216,6 +227,11 @@ class ServeNetServer:
                 endpoint, code = "health", await self._h_health(writer)
             elif path == wire.P_STATS and method == "GET":
                 endpoint, code = "stats", await self._h_stats(writer)
+            elif path == wire.P_TIMELINES and method == "GET":
+                endpoint, code = "timelines", await self._h_timelines(
+                    query, writer)
+            elif path == wire.P_HISTORY and method == "GET":
+                endpoint, code = "history", await self._h_history(writer)
             elif path == wire.P_METRICS and method == "GET":
                 endpoint, code = "metrics", await self._h_metrics(writer)
             else:
@@ -247,6 +263,43 @@ class ServeNetServer:
                   "metrics": get_registry().snapshot(),
                   "slo": get_ledger().slo_report(),
                   "frontend": self.frontend.stats()}))
+        await writer.drain()
+        return 200
+
+    async def _h_timelines(self, query: str, writer) -> int:
+        """Ledger timelines over the wire — the cross-process half of
+        the trace plane: a router's TraceAssembler and tools/fftrace.py
+        pull per-replica timelines from here and join them on
+        trace_id.  ``?guid=G`` narrows to one request, ``?trace=TID``
+        to one distributed trace."""
+        params = _query_params(query)
+        led = get_ledger()
+        body: Dict[str, object] = {"protocol": wire.PROTOCOL_VERSION}
+        if "guid" in params:
+            try:
+                guid = int(params["guid"])
+            except ValueError:
+                writer.write(wire.json_response(
+                    400, {"error": "bad_request",
+                          "detail": "guid must be an int"}))
+                await writer.drain()
+                return 400
+            body["timeline"] = led.timeline(guid)
+        elif "trace" in params:
+            tls = led.timelines_for_trace(params["trace"])
+            body["ledger"] = {
+                "live": [t for t in tls if not t.get("retired")],
+                "retired": [t for t in tls if t.get("retired")]}
+        else:
+            body["ledger"] = led.snapshot()
+        writer.write(wire.json_response(200, body))
+        await writer.drain()
+        return 200
+
+    async def _h_history(self, writer) -> int:
+        writer.write(wire.json_response(
+            200, {"protocol": wire.PROTOCOL_VERSION,
+                  "history": get_metrics_history().snapshot()}))
         await writer.drain()
         return 200
 
@@ -306,8 +359,9 @@ class ServeNetServer:
             writer.write(wire.unavailable_response(str(e)))
             await writer.drain()
             return 503
-        self.recorder.record_event("net-request", endpoint="generate",
-                                   guid=stream.guid)
+        self.recorder.record_event(
+            "net-request", endpoint="generate", guid=stream.guid,
+            trace_id=sub.trace.trace_id if sub.trace else None)
         await self._stream_sse(stream, sub, reader, writer)
         return 200
 
@@ -316,9 +370,17 @@ class ServeNetServer:
         one front-end (tenant affinity is a router concern — a single
         replica's prefix pool hits on content alone); RouterServer
         overrides this to route across replicas."""
+        if sub.trace is None:
+            # header-less foreign client (curl): mint here so EVERY
+            # wire submission is traceable — the SSE meta echoes the
+            # trace_id back (sub is mutated so meta/recorder see it)
+            from ...observability.traceplane import TraceContext
+
+            sub.trace, sub.trace_source = TraceContext.mint(), "minted"
         return await self.frontend.submit(
             sub.prompt, max_new_tokens=sub.max_new_tokens,
-            deadline_s=sub.deadline_s)
+            deadline_s=sub.deadline_s, trace=sub.trace,
+            trace_source=sub.trace_source)
 
     # --------------------------------------------------------- SSE stream
     async def _stream_sse(self, stream, sub: wire.SubmitRequest,
@@ -337,7 +399,9 @@ class ServeNetServer:
             writer.write(wire.sse_event("meta", {
                 "protocol": wire.PROTOCOL_VERSION, "guid": stream.guid,
                 "request_id": sub.request_id,
-                "skip_tokens": sub.skip_tokens}))
+                "skip_tokens": sub.skip_tokens,
+                "trace_id": (sub.trace.trace_id if sub.trace
+                             else None)}))
             await writer.drain()
             it = stream.__aiter__()
             while True:

@@ -165,6 +165,8 @@ class Request:
         # last admission-block reason noted for this request (the
         # once-per-transition dedup for serving_admission_blocked_total)
         self.blocked_reason: Optional[str] = None
+        # adopted distributed-trace context (TraceContext) or None
+        self.trace = None
         self.profile = ProfileInfo(start_time=time.time(),
                                    start_mono=time.monotonic())
 
@@ -311,6 +313,7 @@ class RequestManager:
         self._m_spec_rate = m.histogram("serving_spec_acceptance_rate")
         self._m_spec_verify = m.histogram("serving_spec_verify_tokens")
         self._m_adm_blocked = m.counter("serving_admission_blocked_total")
+        self._m_trace_hops = m.counter("serving_trace_hops_total")
         self._m_cancelled = m.counter("serving_cancellations_total")
         # hybrid-step telemetry: steps counted by dispatch mode (every
         # MIXED batch ticks exactly one — mode=hybrid for fused
@@ -354,9 +357,21 @@ class RequestManager:
 
     # ------------------------------------------------------------ requests
     def register_new_request(self, prompt, max_new_tokens: int = 128,
-                             max_sequence_length: Optional[int] = None
+                             max_sequence_length: Optional[int] = None,
+                             trace=None,
+                             trace_source: Optional[str] = None
                              ) -> Request:
-        """Tokenize + queue (reference: request_manager.cc:178-234)."""
+        """Tokenize + queue (reference: request_manager.cc:178-234).
+
+        ``trace``: an adopted
+        :class:`~flexflow_tpu.observability.TraceContext` — stamped
+        into the enqueue ledger note (so the timeline carries
+        trace_id/hop, the cross-process assembly join key) and counted
+        under ``serving_trace_hops_total{source}``.  ``trace_source``
+        is that label ("wire": the context arrived in an inbound
+        header — the wire layer, which alone knows, passes it;
+        "minted": created in this process); None falls back to the
+        hop — hop>0 can only have been forwarded from upstream."""
         if isinstance(prompt, str):
             assert self.tokenizer is not None, "no tokenizer registered"
             tokens = list(self.tokenizer.encode(prompt))
@@ -372,9 +387,25 @@ class RequestManager:
             tokens = tokens[: max_len - 1]
         req = Request(next(_GUID_COUNTER), text, tokens,
                       max_new_tokens, max_len)
+        req.trace = trace
         self.pending.append(req)
-        self.ledger.note_event("enqueue", guid=req.guid,
-                               prompt_len=req.prompt_len)
+        if trace is not None:
+            # the distributed-trace join key rides the enqueue note so
+            # the timeline is born stamped; hop>0 means the context
+            # arrived over the wire, hop 0 that this process minted it
+            self.ledger.note_event("enqueue", guid=req.guid,
+                                   prompt_len=req.prompt_len,
+                                   trace_id=trace.trace_id,
+                                   hop=trace.hop)
+            source = trace_source or ("wire" if trace.hop > 0
+                                      else "minted")
+            self._m_trace_hops.inc(source=source)
+            self.recorder.record_event("trace-adopt", guid=req.guid,
+                                       trace_id=trace.trace_id,
+                                       hop=trace.hop, source=source)
+        else:
+            self.ledger.note_event("enqueue", guid=req.guid,
+                                   prompt_len=req.prompt_len)
         return req
 
     # ------------------------------------------------------- batch update

@@ -753,6 +753,49 @@ class TestMetricSchemaRule:
         assert at(fs, "metric-schema", 3), fs
         assert len(fs) == 2
 
+    def test_traceplane_names_covered_by_real_schema(self, tmp_path):
+        # the fleet-trace-plane vocabulary validates against the
+        # CHECKED-IN schema (baseline stays EMPTY): the hop counter,
+        # the route-latency histogram and the trace-adopt/assemble
+        # events are all declared; rogue siblings are still flagged
+        src = """\
+            def wire(m, rec, ledger):
+                a = m.counter("serving_trace_hops_total")
+                b = m.histogram("router_route_seconds")
+                rec.record_event("trace-adopt", guid=1,
+                                 trace_id="deadbeef", hop=0,
+                                 source="wire")
+                rec.record_event("trace-assemble", trace_id="deadbeef",
+                                 sources=3, timelines=3, events=32)
+                ledger.note_event("router-route", guid=1,
+                                  replica="http://a", affinity="hit",
+                                  route_s=0.001, score=1.0)
+                ledger.note_event("router-failover", guid=1,
+                                  replica="http://a", relayed=4)
+                return a, b
+            """
+        path = tmp_path / "serving" / "traceplane_fixture.py"
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_text(textwrap.dedent(src))
+        ctx = LintContext(repo_root=REPO)   # exec-loads the real schema
+        fs = lint_file(str(path), self.R, ctx,
+                       rel="serving/traceplane_fixture.py",
+                       judge_suppressions=True)
+        assert fs == []
+        rogue = tmp_path / "serving" / "traceplane_rogue.py"
+        rogue.write_text(textwrap.dedent("""\
+            def wire(m, rec):
+                m.counter("router_route_seconds")
+                rec.record_event("trace-assembled")
+            """))
+        fs = lint_file(str(rogue), self.R, ctx,
+                       rel="serving/traceplane_rogue.py",
+                       judge_suppressions=True)
+        # histogram declared as counter spelling flagged; rogue event
+        assert at(fs, "metric-schema", 2), fs
+        assert at(fs, "metric-schema", 3), fs
+        assert len(fs) == 2
+
 
 # --------------------------------------------------- direct host sync
 class TestDirectHostSyncRule:

@@ -1,0 +1,510 @@
+"""Fleet trace-plane tests (observability/traceplane.py, PR 13).
+
+The distributed half of the observability stack: wire-propagated trace
+context (``X-FFServe-Trace``), the ``/v1/timelines`` +
+``/v1/metrics/history`` endpoints, and cross-process Chrome-trace
+assembly.  Units run without sockets (TraceContext algebra, the
+MetricsHistory ring's bounding/disabled gates, TraceAssembler clock
+alignment); the acceptance half runs over real loopback sockets:
+
+- timeline-endpoint round-trip: a wire submit's minted trace context
+  lands on the server-side ledger timeline and comes back out through
+  ``/v1/timelines`` (full snapshot, ``?guid=``, ``?trace=``) and the
+  history ring through ``/v1/metrics/history``;
+- trace_id uniqueness ACROSS PROCESSES: concurrently-minting real
+  processes never collide (the no-coordination property assembly
+  relies on);
+- the 2-replica kill-failover e2e: one routed request whose bound
+  replica is SIGKILLed mid-stream must leave ONE assembled trace with
+  spans from the router hop and BOTH replica hops under a consistent
+  trace_id — the victim's half grafted from its pre-kill snapshot on
+  disk (the post-mortem path), the survivor's pulled live (the
+  fftrace ``--url`` path).
+"""
+
+import asyncio
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from flexflow_tpu.observability import (MetricsHistory,  # noqa: E402
+                                        MetricsRegistry, RequestLedger,
+                                        TraceAssembler, TraceContext,
+                                        get_ledger, get_metrics_history,
+                                        get_registry, scalar_values)
+
+TELEMETRY_ON = get_ledger().enabled
+
+
+def _prompts(n, length, vocab=120, seed=0):
+    rng = np.random.default_rng(seed)
+    return [rng.integers(4, vocab, length).tolist() for _ in range(n)]
+
+
+def _labels(name):
+    v = (get_registry().snapshot().get("counters") or {}).get(name, {})
+    return dict(v.get("labels", {})) if isinstance(v, dict) else {}
+
+
+# ------------------------------------------------------- trace context
+class TestTraceContext:
+    def test_mint_parse_header_round_trip(self):
+        ctx = TraceContext.mint()
+        assert ctx.hop == 0 and len(ctx.trace_id) == 32
+        back = TraceContext.parse(ctx.header_value())
+        assert back == ctx
+
+    def test_child_keeps_id_bumps_hop(self):
+        ctx = TraceContext.mint()
+        c = ctx.child()
+        assert c.trace_id == ctx.trace_id and c.hop == ctx.hop + 1
+        assert c.child().hop == 2
+        assert ctx.hop == 0          # immutable parent
+
+    def test_parse_rejects_garbage(self):
+        for bad in ("", "nohop", "xyz/1", "abc123", "deadbeef/",
+                    "deadbeef/-1", "deadbeef/1/2", "g" * 16 + "/0"):
+            with pytest.raises(ValueError):
+                TraceContext.parse(bad)
+        # case/whitespace tolerant on the way IN (proxies rewrite
+        # header casing), canonical on the way out
+        ctx = TraceContext.parse("  DEADBEEFDEADBEEF/3 ")
+        assert ctx == TraceContext("deadbeefdeadbeef", 3)
+
+    def test_in_process_uniqueness(self):
+        ids = {TraceContext.mint().trace_id for _ in range(2000)}
+        assert len(ids) == 2000
+
+    def test_uniqueness_across_processes(self, tmp_path):
+        """The no-coordination guarantee assembly joins rely on: real
+        concurrent processes minting contexts never collide.  The
+        subprocess loads traceplane.py STANDALONE (importlib, no
+        package/JAX import) so 3 processes cost milliseconds."""
+        script = tmp_path / "mint.py"
+        script.write_text(
+            "import importlib.util, sys\n"
+            f"spec = importlib.util.spec_from_file_location('tp', "
+            f"{os.path.join(REPO, 'flexflow_tpu', 'observability', 'traceplane.py')!r})\n"
+            "tp = importlib.util.module_from_spec(spec)\n"
+            "sys.modules['tp'] = tp\n"
+            "spec.loader.exec_module(tp)\n"
+            "for _ in range(200):\n"
+            "    print(tp.TraceContext.mint().trace_id)\n")
+        procs = [subprocess.Popen([sys.executable, str(script)],
+                                  stdout=subprocess.PIPE, text=True)
+                 for _ in range(3)]
+        ids = []
+        for p in procs:
+            out, _ = p.communicate(timeout=60)
+            assert p.returncode == 0
+            ids.extend(out.split())
+        assert len(ids) == 600 and len(set(ids)) == 600
+
+
+# ----------------------------------------------------- metrics history
+class TestMetricsHistory:
+    def test_ring_bounds_under_churn(self):
+        h = MetricsHistory(capacity=16)
+        for i in range(500):
+            h.append({"serving_queue_depth": float(i)})
+        assert len(h) == 16
+        assert h.dropped == 484
+        snap = h.snapshot()
+        assert snap["recorded"] == 500 and len(snap["samples"]) == 16
+        # the ring keeps the NEWEST samples
+        assert snap["samples"][-1]["values"]["serving_queue_depth"] == 499.0
+        json.dumps(snap)                 # wire/bundle-serializable
+
+    def test_ring_bounds_under_threaded_churn(self):
+        h = MetricsHistory(capacity=32)
+        stop = threading.Event()
+        snaps = []
+
+        def reader():
+            while not stop.is_set():
+                snaps.append(len(h.snapshot()["samples"]))
+
+        t = threading.Thread(target=reader)
+        t.start()
+        try:
+            threads = [threading.Thread(
+                target=lambda: [h.append({"x": 1.0}) for _ in range(400)])
+                for _ in range(4)]
+            for th in threads:
+                th.start()
+            for th in threads:
+                th.join()
+        finally:
+            stop.set()
+            t.join()
+        assert len(h) == 32 and h.dropped == 4 * 400 - 32
+        assert all(n <= 32 for n in snaps)
+
+    def test_disabled_registry_is_noop(self):
+        h = MetricsHistory(capacity=8)
+        reg = MetricsRegistry(enabled=False)
+        reg.counter("c").inc()
+        assert h.sample(reg) is False
+        assert len(h) == 0 and h.snapshot()["samples"] == []
+
+    def test_sample_flattens_registry(self):
+        reg = MetricsRegistry()
+        reg.counter("reqs").inc(3)
+        reg.counter("labeled").inc(2, reason="a")
+        reg.counter("labeled").inc(5, reason="b")
+        reg.gauge("depth").set(7)
+        reg.histogram("lat").observe(0.5)
+        reg.histogram("lat").observe(1.5)
+        h = MetricsHistory(capacity=8)
+        assert h.sample(reg) is True
+        vals = h.snapshot()["samples"][-1]["values"]
+        assert vals["reqs"] == 3.0
+        assert vals["labeled"] == 7.0          # label splits summed
+        assert vals["lat_count"] == 2.0 and vals["lat_sum"] == 2.0
+        assert vals["depth"] == 7.0
+        # scalar_values is the same flattening, callable standalone
+        assert scalar_values(reg.snapshot()) == vals
+
+    def test_series_and_tail(self):
+        h = MetricsHistory(capacity=64)
+        for i in range(10):
+            h.append({"goodput": float(i)}, wall=1000.0 + i)
+        s = h.series("goodput")
+        assert [v for _, v in s] == [float(i) for i in range(10)]
+        assert [w for w, _ in s] == [1000.0 + i for i in range(10)]
+        assert h.series("missing") == []
+        tail = h.snapshot(tail=3)["samples"]
+        assert [x["values"]["goodput"] for x in tail] == [7.0, 8.0, 9.0]
+
+    def test_sampler_thread_fills_and_stops(self):
+        reg = MetricsRegistry()
+        reg.gauge("g").set(1)
+        h = MetricsHistory(capacity=64, interval_s=0.01)
+        # the sampler targets the process registry; drive the pull
+        # path directly instead so the test owns its registry
+        h.start(interval_s=0.01)
+        try:
+            deadline = time.monotonic() + 5.0
+            while time.monotonic() < deadline:
+                h.sample(reg)
+                if len(h) >= 3:
+                    break
+                time.sleep(0.01)
+            assert len(h) >= 3
+        finally:
+            h.stop()
+        assert h._thread is None
+        h.clear()
+        assert len(h) == 0 and h.dropped == 0
+
+    def test_process_singleton(self):
+        assert get_metrics_history() is get_metrics_history()
+
+
+# ---------------------------------------------------- trace assembler
+def _mk_timeline(guid, trace_id, hop, wall0, mono0, tokens=4):
+    """A hand-built ledger-shaped timeline: its OWN mono base (each
+    process's monotonic clock is arbitrary), wall-anchored at wall0."""
+    return {
+        "guid": guid, "trace_id": trace_id, "hop": hop,
+        "prompt_len": 8, "enqueue_wall": wall0, "enqueue_mono": mono0,
+        "admit_mono": mono0 + 0.010, "first_commit_mono": mono0 + 0.030,
+        "last_commit_mono": mono0 + 0.090, "ttft_s": 0.020,
+        "tokens": tokens, "retired": True,
+        "events": [{"name": "admit", "t": mono0 + 0.010},
+                   {"name": "commit", "t": mono0 + 0.030, "tokens": 1}],
+    }
+
+
+class TestTraceAssembler:
+    def test_merges_sources_on_wall_anchors(self):
+        """Two sources with WILDLY different monotonic bases align on
+        their wall anchors: source b starts 50 ms after a in wall
+        time, and the merged event stream is globally sorted."""
+        tid = TraceContext.mint().trace_id
+        asm = TraceAssembler()
+        asm.add_source("router", [_mk_timeline(1, tid, 0, 100.0, 5.0)])
+        asm.add_source("replica", [_mk_timeline(2, tid, 1, 100.05,
+                                                99999.0)])
+        trace = asm.build(tid)
+        evs = [e for e in trace["traceEvents"] if e.get("ph") != "M"]
+        assert evs and [e["ts"] for e in evs] == sorted(
+            e["ts"] for e in evs)
+        pids = {e["pid"] for e in evs}
+        assert pids == {0, 1}
+        # hop 1's queue span starts ~50ms after hop 0's (wall offset
+        # survived the mono-base gulf)
+        q0 = next(e for e in evs if e["pid"] == 0 and e["name"] == "queue")
+        q1 = next(e for e in evs if e["pid"] == 1 and e["name"] == "queue")
+        assert q1["ts"] - q0["ts"] == pytest.approx(50_000, abs=500)
+        # process metadata names the hop
+        meta = [e for e in trace["traceEvents"] if e.get("ph") == "M"]
+        assert {m["args"]["name"] for m in meta} == {
+            "router (hop 0)", "replica (hop 1)"}
+        assert trace["otherData"]["timelines"] == 2
+
+    def test_lifecycle_spans_and_event_instants(self):
+        tid = TraceContext.mint().trace_id
+        asm = TraceAssembler()
+        asm.add_source("p", [_mk_timeline(1, tid, 0, 10.0, 0.0)])
+        trace = asm.build(tid)
+        names = {e["name"] for e in trace["traceEvents"]}
+        assert {"queue", "ttft", "stream", "admit", "commit"} <= names
+        spans = {e["name"]: e for e in trace["traceEvents"]
+                 if e.get("ph") == "X"}
+        assert spans["queue"]["dur"] == pytest.approx(10_000, abs=1)
+        assert spans["ttft"]["dur"] == pytest.approx(20_000, abs=1)
+
+    def test_unknown_trace_raises_and_ids_listed(self):
+        tid = TraceContext.mint().trace_id
+        asm = TraceAssembler()
+        n = asm.add_source("p", [_mk_timeline(1, tid, 0, 1.0, 0.0),
+                                 {"guid": 2, "events": []}])
+        assert n == 1                       # only the stamped one counts
+        assert asm.trace_ids() == {tid: 1}
+        with pytest.raises(ValueError):
+            asm.build("feedfacefeedface")
+
+    def test_ledger_stamping_and_timelines_for_trace(self):
+        """The real feed: note_event with trace_id/hop stamps the
+        timeline SCALARS (assembly joins on them even after event-ring
+        eviction), and timelines_for_trace spans live + retired."""
+        led = RequestLedger(retired_capacity=8, events_per_request=4)
+        if not led.enabled:
+            pytest.skip("needs telemetry")
+        ctx = TraceContext.parse(TraceContext.mint().child()
+                                 .header_value())
+        led.note_event("enqueue", guid=1, prompt_len=8,
+                       trace_id=ctx.trace_id, hop=ctx.hop)
+        led.note_event("enqueue", guid=2, prompt_len=8)   # untraced
+        led.note_event("admit", guid=1)
+        for _ in range(8):       # overflow the 4-event ring: scalars
+            led.note_event("commit", guid=1, tokens=1)     # must survive
+        tls = led.timelines_for_trace(ctx.trace_id)
+        assert [t["guid"] for t in tls] == [1]
+        assert tls[0]["trace_id"] == ctx.trace_id
+        assert tls[0]["hop"] == 1
+        led.note_event("retire", guid=1, tokens=8)
+        tls = led.timelines_for_trace(ctx.trace_id)
+        assert [t["guid"] for t in tls] == [1] and tls[0]["retired"]
+        # assembler accepts the ledger's export directly
+        trace = TraceAssembler()
+        trace.add_source("x", tls)
+        assert trace.build(ctx.trace_id)["otherData"]["timelines"] == 1
+
+
+# ------------------------------------------------- wire: endpoints e2e
+@pytest.mark.skipif(not TELEMETRY_ON,
+                    reason="trace accounting needs telemetry")
+class TestTimelineEndpointRoundTrip:
+    def test_wire_submit_stamps_and_roundtrips(self):
+        from flexflow_tpu.serve.frontend import AsyncServeFrontend
+        from flexflow_tpu.serve.net.client import NetClient
+        from flexflow_tpu.serve.net.server import ServeNetServer
+        from tools.ffload import build_tiny_engine
+
+        im, mid, rm = build_tiny_engine(max_requests=2, seed=3)
+        prompt = _prompts(1, 10, seed=5)[0]
+
+        async def go():
+            out = {}
+            fe = AsyncServeFrontend(im, mid, rm, reap_interval_s=0.005)
+            async with fe:
+                async with ServeNetServer(fe) as srv:
+                    cl = NetClient(srv.url)
+                    before = _labels("serving_trace_hops_total")
+                    ws = await cl.generate(prompt, max_new_tokens=6)
+                    out["tokens"] = await ws.result()
+                    out["trace"] = ws.trace
+                    out["guid"] = ws.guid
+                    out["hops"] = {
+                        k: _labels("serving_trace_hops_total").get(k, 0)
+                        - before.get(k, 0)
+                        for k in ("source=wire", "source=minted")}
+                    # ---- /v1/timelines round-trips, three shapes
+                    out["full"] = await cl.timelines()
+                    out["by_guid"] = await cl.timelines(guid=ws.guid)
+                    out["by_trace"] = await cl.timelines(
+                        trace=ws.trace.trace_id)
+                    out["bad_guid"] = await cl.request_json(
+                        "GET", "/v1/timelines?guid=abc")
+                    # ---- /v1/metrics/history (seed the ring so the
+                    # payload is non-empty regardless of sampler phase)
+                    get_metrics_history().append(
+                        {"serving_goodput_tokens_per_s": 42.0})
+                    out["hist"] = await cl.metrics_history()
+            return out
+
+        out = asyncio.run(go())
+        assert len(out["tokens"]) == 6
+        # NetClient minted hop 0; the server ADOPTED it (wire source —
+        # the header arrived with the submit)
+        ctx = out["trace"]
+        assert ctx is not None and ctx.hop == 0
+        assert out["hops"]["source=wire"] == 1
+
+        tl = out["by_guid"]["timeline"]
+        assert tl["guid"] == out["guid"]
+        assert tl["trace_id"] == ctx.trace_id and tl["hop"] == 0
+        assert tl["retired"] and tl["tokens"] == 6
+
+        led = out["by_trace"]["ledger"]
+        tls = led["retired"] + led["live"]
+        assert [t["guid"] for t in tls] == [out["guid"]]
+        assert all(t["trace_id"] == ctx.trace_id for t in tls)
+
+        full = out["full"]["ledger"]
+        assert any(t.get("guid") == out["guid"]
+                   for t in full.get("retired", []))
+
+        assert out["bad_guid"][0] == 400
+
+        hist = out["hist"]["history"]
+        assert hist["samples"] and any(
+            "serving_goodput_tokens_per_s" in s["values"]
+            for s in hist["samples"])
+
+
+# --------------------------------------- 2-replica kill-failover trace
+@pytest.mark.skipif(not TELEMETRY_ON,
+                    reason="trace accounting needs telemetry")
+class TestRouterFailoverTrace:
+    """THE acceptance e2e: a routed request whose bound replica dies
+    mid-stream leaves ONE assembled Chrome trace with spans from the
+    router and BOTH replicas under a consistent trace_id."""
+
+    @pytest.fixture(scope="class")
+    def replicas(self):
+        from flexflow_tpu.serve.net.router import spawn_replica
+
+        reps = [spawn_replica(rows=2, decode_block=4, seed=0)
+                for _ in range(2)]
+        yield reps
+        for r in reps:
+            r.close()
+
+    def test_failover_assembles_across_all_hops(self, replicas,
+                                                tmp_path):
+        from flexflow_tpu.serve.net.client import NetClient
+        from flexflow_tpu.serve.net.router import (ReplicaRouter,
+                                                   RouterServer)
+        from tools import fftrace
+
+        prompt = _prompts(1, 12, seed=21)[0]
+        victim_file = str(tmp_path / "victim_timelines.json")
+
+        async def go():
+            out = {}
+            router = ReplicaRouter([r.url for r in replicas],
+                                   scrape_interval_s=0.1,
+                                   circuit_cooldown_s=0.5)
+            async with router:
+                srv = RouterServer(router)
+                await srv.start()
+                rs = await router.generate(prompt, max_new_tokens=16)
+                tid = rs.trace.trace_id
+                out["tid"] = tid
+                async for _ in rs:
+                    if len(rs.tokens) >= 4:
+                        break
+                # the victim's half of the story, saved BEFORE the
+                # kill (post-mortem: a dead process's ledger arrives
+                # from a bundle/snapshot on disk)
+                bound = rs._replica.url
+                victim = next(r for r in replicas if r.url == bound)
+                doc = await NetClient(bound).timelines(trace=tid)
+                with open(victim_file, "w") as f:
+                    json.dump(doc, f)
+                victim.kill()
+                out["tokens"] = await rs.result()
+                out["failovers"] = rs.failovers
+                out["survivor"] = rs._replica.url
+
+                # (a) the router's own fleet assembly: router hop +
+                # survivor (victim unreachable — skipped, not fatal)
+                out["live_trace"] = await router.assemble_trace(tid)
+
+                # (b) the fftrace path: saved victim snapshot grafted
+                # beside LIVE endpoints discovered through the router
+                # (RouterServer /v1/timelines + /v1/stats replicas).
+                # Fetched with the 8-char PREFIX an operator pastes:
+                # live fetch must fall back to full snapshots (the
+                # server's ?trace= filter is exact-match) and narrow
+                # client-side in assemble()
+                sources = fftrace.load_file_sources([victim_file])
+                sources += await fftrace._fetch_live(srv.url, tid[:8])
+                out_path = str(tmp_path / "assembled.json")
+                out["fftrace_rc"] = fftrace.assemble(sources, tid[:8],
+                                                     out_path)
+                with open(out_path) as f:
+                    out["fftrace_trace"] = json.load(f)
+
+                # the router-served history: its own ring plus the
+                # per-replica rings it retained from scrapes — the
+                # victim's series survives its death
+                out["hist"] = await NetClient(srv.url).metrics_history()
+                # the router's OWN hop timeline, post-failover
+                out["router_tl"] = (await NetClient(srv.url).timelines(
+                    guid=rs.guid))["timeline"]
+                srv._server.close()
+            return out
+
+        out = asyncio.run(go())
+        assert out["failovers"] >= 1 and len(out["tokens"]) == 16
+        tid = out["tid"]
+
+        # (a) live fleet assembly: router + survivor, consistent id,
+        # with the routing decision and the failover gap visible
+        lt = out["live_trace"]
+        assert lt["otherData"]["trace_id"] == tid
+        names = {e["name"] for e in lt["traceEvents"]}
+        assert {"router-route", "router-failover"} <= names
+
+        # (b) the full post-mortem: ONE trace, spans from the router
+        # AND both replicas (victim from disk, survivor live)
+        assert out["fftrace_rc"] == 0
+        ft = out["fftrace_trace"]
+        assert ft["otherData"]["trace_id"] == tid
+        evs = [e for e in ft["traceEvents"] if e.get("ph") != "M"]
+        assert len({e["pid"] for e in evs}) >= 3   # router + 2 replicas
+        assert ft["otherData"]["timelines"] >= 3
+        names = {e["name"] for e in evs}
+        assert {"queue", "ttft", "router-route", "router-failover"} \
+            <= names
+        # every merged timeline joined on the SAME trace_id: both
+        # replica hops are hop 1 (the router forwarded child()), the
+        # router hop is 0
+        meta = [e["args"]["name"] for e in ft["traceEvents"]
+                if e.get("ph") == "M"]
+        assert sum("hop 1" in m for m in meta) == 2
+        assert sum("hop 0" in m for m in meta) == 1
+        assert [e["ts"] for e in evs] == sorted(e["ts"] for e in evs)
+
+        # the router ALSO retained the victim's scrape history: the
+        # per-replica rings answer "what was it doing before it died"
+        rings = out["hist"]["replicas"]
+        assert set(rings) == {r.url for r in replicas}
+        assert any(rings[u]["samples"] for u in rings)
+
+        # counter evidence: the router minted this trace (no inbound
+        # header on a direct router.generate call)
+        assert _labels("serving_trace_hops_total").get(
+            "source=minted", 0) >= 1
+
+        # the failover re-bind must NOT restamp the router hop's admit
+        # (that would swallow replica A's streaming time into queue_s
+        # and drive this hop's ttft negative): after a mid-stream
+        # failover the router timeline's clocks stay sane
+        rtl = out["router_tl"]
+        assert rtl["trace_id"] == tid and rtl["retired"]
+        assert rtl["ttft_s"] is not None and rtl["ttft_s"] >= 0
+        assert rtl["admit_mono"] <= rtl["first_commit_mono"]

@@ -20,12 +20,18 @@ Reads any of:
 
 Usage:
     python tools/ffreq.py FILE.json [FILE2.json ...]
-        [--slowest N] [--guid G] [--slo TTFT[:TPOT]] [--selftest]
+        [--slowest N] [--guid G] [--trace TID] [--slo TTFT[:TPOT]]
+        [--selftest]
 
 ``--slowest N``  rank the N slowest retired requests by TTFT
                  (default 5)
 ``--guid G``     print request G's full timeline (every ledger event
                  with per-event deltas)
+``--trace TID``  render one distributed trace's CROSS-HOP breakdown
+                 (router queue -> route -> replica queue_wait -> ttft
+                 -> stream) across every input file at once — pass the
+                 router's dump beside the replicas' and the hops line
+                 up on wall-clock offsets (unambiguous id prefixes ok)
 ``--slo SPEC``   re-evaluate attainment + goodput against an ad-hoc
                  policy, e.g. ``--slo 0.5`` (TTFT 500 ms) or
                  ``--slo 0.5:0.05`` (plus TPOT 50 ms/token)
@@ -189,6 +195,78 @@ def rider_spans(t: Dict[str, Any]) -> List[str]:
     return out
 
 
+def _wall_start(t: Dict[str, Any]) -> Optional[float]:
+    return t.get("enqueue_wall")
+
+
+def trace_breakdown(sources: List[Tuple[str, List[Dict]]],
+                    trace_spec: str) -> Tuple[str, int]:
+    """(report, exit code) — the cross-hop view of one distributed
+    trace: every timeline stamped with the trace_id, across every
+    input document, ordered by hop then wall-clock start.  Per hop:
+    where the time went (queue/ttft/stream) plus the router-specific
+    spans (route decision with its score components, failover gaps,
+    resume replays) pulled from the hop's events."""
+    hops: List[Tuple[str, Dict]] = []
+    ids = set()
+    for label, tls in sources:
+        for t in tls:
+            tid = t.get("trace_id")
+            if tid:
+                ids.add(tid)
+                if tid.startswith(trace_spec):
+                    hops.append((label, t))
+    matched = {t.get("trace_id") for _, t in hops}
+    if not hops:
+        return (f"trace {trace_spec!r} not found "
+                f"(available: {', '.join(sorted(ids)) or 'none'})", 1)
+    if len(matched) > 1:
+        return (f"--trace {trace_spec!r} is ambiguous: "
+                f"{', '.join(sorted(matched))}", 1)
+    hops.sort(key=lambda lt: (lt[1].get("hop") if lt[1].get("hop")
+                              is not None else 99,
+                              _wall_start(lt[1]) or 0.0))
+    t0 = min((w for _, t in hops
+              for w in (_wall_start(t),) if w is not None),
+             default=None)
+    lines = [f"trace {next(iter(matched))}: {len(hops)} hop "
+             f"timeline(s)",
+             f"\n{'hop':>4} {'start ms':>9} {'guid':>9} {'queue ms':>9} "
+             f"{'ttft ms':>9} {'stream ms':>10} {'tok':>5} "
+             f"{'status':<10} source"]
+    for label, t in hops:
+        ph = phases_of(t)
+        start = _wall_start(t)
+        rel = ("-" if start is None or t0 is None
+               else f"{(start - t0) * 1e3:9.1f}")
+        status = ("cancelled:" + str(t.get("cancel_reason"))
+                  if t.get("cancelled")
+                  else "retired" if t.get("retired") else "live")
+        lines.append(
+            f"{t.get('hop', '-')!s:>4} {rel:>9} {t.get('guid'):>9} "
+            f"{_ms(ph['queued'])} {_ms(t.get('ttft_s'))} "
+            f"{_ms(ph['decode']):>10} {t.get('tokens') or 0:>5} "
+            f"{status:<10} {label}")
+        for ev in t.get("events") or []:
+            name = ev.get("name")
+            if name == "router-route":
+                resume = (f" RESUME(+{(ev.get('gap_s') or 0) * 1e3:.1f}"
+                          f"ms gap, {ev.get('replayed')} replayed)"
+                          if ev.get("resume") else "")
+                lines.append(
+                    f"{'':>24} route -> {ev.get('replica')} "
+                    f"[{ev.get('affinity')}] "
+                    f"{(ev.get('route_s') or 0) * 1e3:.1f}ms "
+                    f"score={ev.get('score')} load={ev.get('load')} "
+                    f"frames={ev.get('frames_free')}"
+                    f"{resume}")
+            elif name == "router-failover":
+                lines.append(
+                    f"{'':>24} failover: {ev.get('replica')} died "
+                    f"after {ev.get('relayed')} relayed tokens")
+    return "\n".join(lines), 0
+
+
 def phase_breakdown(timelines: List[Dict]) -> str:
     """Aggregate per-phase means/maxima over retired requests — where
     the latency budget goes across the batch."""
@@ -211,6 +289,9 @@ def timeline_view(t: Dict[str, Any]) -> str:
     head = (f"guid {t.get('guid')}  prompt {t.get('prompt_len')}  "
             f"tokens {t.get('tokens') if t.get('retired') else '(live)'}  "
             f"prefix_matched {t.get('prefix_matched') or 0}")
+    if t.get("trace_id"):
+        head += (f"  trace {t['trace_id']}/{t.get('hop')} "
+                 f"(cross-hop view: --trace {t['trace_id'][:8]})")
     lat = (f"queue {_ms(t.get('queue_s')).strip()}ms  "
            f"ttft {_ms(t.get('ttft_s')).strip()}ms  "
            f"tpot {_ms(t.get('tpot_s')).strip()}ms/token")
@@ -320,12 +401,17 @@ def selftest() -> int:
     import tempfile
 
     from flexflow_tpu.observability import (RequestLedger, SLOPolicy,
+                                            TraceContext,
                                             validate_slo_block)
 
+    trace = TraceContext.mint()
     led = RequestLedger(retired_capacity=8, events_per_request=16)
     led.set_slo_policy(SLOPolicy(ttft_s=60.0, tpot_s=60.0))
     for guid, matched in ((1, 0), (2, 48)):        # cold, then warm
-        led.note_event("enqueue", guid=guid, prompt_len=64)
+        ctx = trace.child() if guid == 2 else None  # guid 2 is traced
+        led.note_event("enqueue", guid=guid, prompt_len=64,
+                       **({"trace_id": ctx.trace_id, "hop": ctx.hop}
+                          if ctx else {}))
         led.note_event("admit", guid=guid, row=guid - 1, prompt_len=64)
         if matched:
             led.note_event("prefix-match", guid=guid, matched=matched)
@@ -349,6 +435,22 @@ def selftest() -> int:
     with open(path, "w") as f:
         json.dump(snap, f)
     rc = print_doc(path, load(path), slowest=5, guid=2, slo_spec="60:60")
+    # the cross-hop view: a synthetic router hop (hop 0) in a second
+    # "document" joins guid 2's replica hop on the shared trace_id
+    router_led = RequestLedger(retired_capacity=8)
+    router_led.note_event("enqueue", guid=2001, prompt_len=64,
+                          trace_id=trace.trace_id, hop=trace.hop)
+    router_led.note_event("admit", guid=2001)
+    router_led.note_event("router-route", guid=2001,
+                          replica="http://r1", affinity="hit",
+                          route_s=0.001, score=1.2)
+    router_led.note_event("commit", guid=2001, tokens=1)
+    router_led.note_event("retire", guid=2001, tokens=5)
+    report, trc = trace_breakdown(
+        [("router", router_led.timelines_for_trace(trace.trace_id)),
+         ("replica", timelines_of(load(path))[0])],
+        trace.trace_id[:8])
+    print("\n" + report)
     rep = led.slo_report()
     errs = validate_slo_block(rep)
     ok = (rc == 0 and not errs and rep["requests"] == 2
@@ -356,6 +458,10 @@ def selftest() -> int:
           and rep["total_tokens"] == 10
           and led.in_flight_guids() == [3]
           and led.timeline(2)["prefix_matched"] == 48
+          and led.timeline(2)["trace_id"] == trace.trace_id
+          and led.timeline(2)["hop"] == 1
+          and trc == 0 and "route -> http://r1" in report
+          and report.count("\n") >= 4        # header + 2 hops + route
           and rider_spans(led.timeline(2))
           and not rider_spans(led.timeline(1)))
     print(f"\nffreq selftest {'OK' if ok else 'FAILED: ' + str(errs)}: "
@@ -370,6 +476,10 @@ def main(argv) -> int:
     ap.add_argument("paths", nargs="*", help="ledger/bundle/record JSON")
     ap.add_argument("--slowest", type=int, default=5, metavar="N")
     ap.add_argument("--guid", type=int, default=None, metavar="G")
+    ap.add_argument("--trace", default=None, metavar="TID",
+                    help="render one distributed trace's cross-hop "
+                         "breakdown across ALL input files (id prefix "
+                         "ok)")
     ap.add_argument("--slo", default=None, metavar="TTFT[:TPOT]",
                     help="re-evaluate attainment against these targets "
                          "(seconds), e.g. 0.5 or 0.5:0.05")
@@ -387,14 +497,22 @@ def main(argv) -> int:
         ap.print_usage(sys.stderr)
         return 1
     rc = 0
+    docs: List[Tuple[str, Any]] = []
     for path in args.paths:
         try:
-            doc = load(path)
+            docs.append((path, load(path)))
         except Exception as e:
             print(f"{path}: unreadable ({type(e).__name__}: {e})",
                   file=sys.stderr)
             rc = 1
-            continue
+    if args.trace is not None:
+        # cross-hop view spans EVERY input at once (router dump beside
+        # replica dumps), so it renders once, not per file
+        sources = [(path, timelines_of(doc)[0]) for path, doc in docs]
+        report, trc = trace_breakdown(sources, args.trace)
+        print(report)
+        return max(rc, trc)
+    for path, doc in docs:
         rc = max(rc, print_doc(path, doc, args.slowest, args.guid,
                                args.slo))
     return rc

@@ -210,12 +210,17 @@ def diagnosis(doc: Dict[str, Any],
         if inflight:
             # the stall suspects: admitted but never retired when the
             # bundle dumped — inspect each with
-            # `tools/ffreq.py BUNDLE --guid G`
+            # `tools/ffreq.py BUNDLE --guid G`; trace ids name the
+            # DISTRIBUTED request a hop belongs to (cross-hop view:
+            # `tools/fftrace.py ... --trace <id>`)
             lines.append(
                 "in-flight (non-retired) requests: "
                 + " ".join(
                     f"guid {t.get('guid')} "
-                    f"(committed {t.get('committed', 0)})"
+                    f"(committed {t.get('committed', 0)}"
+                    + (f", trace {t['trace_id'][:8]}/"
+                       f"{t.get('hop')}" if t.get("trace_id") else "")
+                    + ")"
                     for t in inflight))
         elif live:
             lines.append(f"{len(live)} enqueued request(s), none "
@@ -251,6 +256,54 @@ def diagnosis(doc: Dict[str, Any],
     if isinstance(threads, dict) and threads:
         lines.append(f"threads captured: {len(threads)} "
                      f"({', '.join(sorted(threads))})")
+    return "\n".join(lines)
+
+
+#: history series a stall reads by: what was the box DOING in the
+#: minutes leading in (goodput decaying? queue growing? frames gone?)
+_HISTORY_KEYS = (
+    ("serving_goodput_tokens_per_s", "goodput"),
+    ("serving_queue_depth", "queue"),
+    ("serving_active_requests", "active"),
+    ("serving_kv_frames_free", "frames_free"),
+    ("serving_tokens_generated_total", "tokens"),
+)
+
+
+def history_section(doc: Dict[str, Any], rows: int = 12) -> Optional[str]:
+    """The metrics time-series leading into the dump (the bundle's
+    ``metrics_history`` section / a bench record's stamp): the last N
+    samples of the stall-relevant series, so 'goodput over the minutes
+    BEFORE the stall' reads straight off the record."""
+    hist = doc.get("metrics_history")
+    if not isinstance(hist, dict):
+        # a stalled bench record carries the series ONCE, inside its
+        # embedded stall bundle — read it through
+        sb = doc.get("stall_bundle")
+        hist = sb.get("metrics_history") if isinstance(sb, dict) \
+            else None
+    if not isinstance(hist, dict):
+        return None
+    samples = [s for s in (hist.get("samples") or [])
+               if isinstance(s, dict)]
+    if not samples:
+        return None
+    keys = [(k, label) for k, label in _HISTORY_KEYS
+            if any(k in (s.get("values") or {}) for s in samples)]
+    if not keys:
+        return None
+    t_last = float(samples[-1].get("wall", 0.0))
+    lines = [f"{len(samples)} sample(s) held "
+             f"(interval {hist.get('interval_s')}s, "
+             f"{hist.get('dropped', 0)} dropped)",
+             "  " + f"{'t':>8} " + " ".join(f"{label:>11}"
+                                            for _, label in keys)]
+    for s in samples[-rows:]:
+        vals = s.get("values") or {}
+        cells = " ".join(
+            f"{vals[k]:>11.6g}" if k in vals else f"{'-':>11}"
+            for k, _ in keys)
+        lines.append(f"  {s.get('wall', 0.0) - t_last:>+8.1f} {cells}")
     return "\n".join(lines)
 
 
@@ -309,6 +362,10 @@ def print_doc(path: str, doc: Dict[str, Any], n_events: int,
         if guid is not None:
             print(f"\n-- last events for guid {guid}")
             print(event_tail(events, n_events, guid=guid))
+    hist = history_section(doc)
+    if hist:
+        print("\n-- metrics history (tail leading into the dump)")
+        print(hist)
     if snap is not None:
         print("\n-- metrics")
         print(metrics_summary(snap))
@@ -322,13 +379,28 @@ def selftest() -> int:
     import tempfile
 
     from flexflow_tpu.observability import (FlightRecorder, Heartbeat,
-                                            MetricsRegistry, dump_bundle)
+                                            MetricsRegistry,
+                                            TraceContext, dump_bundle,
+                                            get_ledger,
+                                            get_metrics_history)
 
     rec = FlightRecorder(capacity=64)
     hb = Heartbeat()
     reg = MetricsRegistry()          # permissive ad-hoc registry
     reg.counter("serving_tokens_generated_total").inc(320)
     reg.histogram("serving_step_latency_seconds").observe(0.012)
+    # an in-flight TRACED request (global ledger — the bundle embeds
+    # it) so the stall diagnosis names its trace_id beside the guid,
+    # plus a few history samples so the time-series tail renders
+    ctx = TraceContext.mint()
+    led = get_ledger()
+    led.note_event("enqueue", guid=990001, prompt_len=16,
+                   trace_id=ctx.trace_id, hop=1)
+    led.note_event("admit", guid=990001, row=0)
+    hist = get_metrics_history()
+    for i in range(3):
+        hist.append({"serving_goodput_tokens_per_s": 100.0 - i,
+                     "serving_queue_depth": float(i)})
     with hb.driving("selftest"):
         rec.record_event("admit", guid=1, row=0, prompt_len=16)
         for _ in range(40):          # > capacity/2: exercises wrap math
@@ -338,12 +410,17 @@ def selftest() -> int:
     d = tempfile.mkdtemp(prefix="ffstat_selftest_")
     path = dump_bundle(d, "selftest", heartbeat=hb, recorder=rec,
                        registry=reg)
+    led.note_event("cancel", guid=990001, reason="selftest")  # tidy up
     rc = print_doc(path, load(path), 8, guid=None, prom=False)
     doc = load(path)
     evs = flight_events(doc)
+    diag = diagnosis(doc, evs)
     ok = (rc == 0 and evs and len(evs) >= 32
           and doc["last_heartbeat"]["step"] == 40
-          and doc["threads"] and metrics_snapshot(doc) is not None)
+          and doc["threads"] and metrics_snapshot(doc) is not None
+          and (not led.enabled            # FF_TELEMETRY=0: no trace/
+               or (ctx.trace_id[:8] in diag     # history sections
+                   and history_section(doc) is not None)))
     print(f"\nffstat selftest {'OK' if ok else 'FAILED'}: {path}")
     return 0 if ok else 1
 

@@ -1,0 +1,282 @@
+#!/usr/bin/env python
+"""Cross-process trace assembly: one Chrome trace per routed request.
+
+``ffreq.py`` inspects one process's per-request timelines; this tool
+merges the timelines of ONE distributed trace across every process
+that touched it — router hop + each replica hop — into a single
+Chrome-trace/Perfetto file, so "where did this request's 900 ms go,
+across which replica(s)" is a one-command question.  The join key is
+the ``trace_id`` the ``X-FFServe-Trace`` header propagated
+(observability/traceplane.py); clock alignment rides each timeline's
+own wall/monotonic anchor pair, so sources only need sane wall clocks.
+
+Sources, freely mixed:
+
+- **saved documents** (positional args): ledger snapshots
+  (``RequestLedger.snapshot()`` JSON), watchdog bundles
+  (``ffbundle_*.json`` — their ``ledger`` section), bench round
+  records, or bare timeline lists — anything ``ffreq`` reads;
+- **live endpoints** (``--url http://host:port``): the peer's
+  ``/v1/timelines`` endpoint.  A router additionally names its
+  replicas in ``/v1/stats``, and every reachable one is pulled too —
+  pointing at the router covers the fleet.  A replica killed
+  mid-stream (the failover case) is skipped live; pass its saved
+  bundle/snapshot as a positional arg to graft its half back in.
+
+Usage:
+    python tools/fftrace.py [FILES...] [--url URL]
+        [--trace TRACE_ID] [-o OUT.json] [--selftest]
+
+``--trace TID``  assemble this trace (omit to list the trace_ids the
+                 sources hold and exit)
+``-o OUT``       output path (default ``fftrace_<id8>.json``)
+``--selftest``   build a synthetic router+replica failover trace
+                 end-to-end (two ledgers, one saved to disk) and
+                 assemble it — the CI smoke (tools/run_tier1.sh)
+
+Exit 1 on unreadable input or a trace_id no source holds.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import re
+import sys
+from typing import Any, Dict, List, Optional, Tuple
+
+# direct invocation (`python tools/fftrace.py`) puts tools/ on
+# sys.path, not the repo root — the package imports need it
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+
+# --------------------------------------------------------------- sources
+def doc_timelines(doc: Any) -> List[Dict[str, Any]]:
+    """Every timeline dict a saved document holds (ffreq's loader —
+    one parser for every document shape both tools read)."""
+    from tools.ffreq import timelines_of
+
+    tls, _ = timelines_of(doc)
+    return tls
+
+
+def load_file_sources(paths: List[str]) -> List[Tuple[str, List[Dict]]]:
+    out = []
+    for path in paths:
+        with open(path) as f:
+            doc = json.load(f)
+        out.append((os.path.basename(path), doc_timelines(doc)))
+    return out
+
+
+#: a FULL trace_id (uuid4 hex) — anything shorter is an operator's
+#: pasted prefix, which the server's exact-match ``?trace=`` filter
+#: would miss; those pull the whole snapshot and narrow client-side
+#: (assemble()'s unambiguous-prefix resolution)
+_FULL_TRACE_ID = re.compile(r"^[0-9a-f]{32}$")
+
+
+async def _fetch_live(url: str, trace_id: Optional[str]
+                      ) -> List[Tuple[str, List[Dict]]]:
+    """(label, timelines) per reachable endpoint behind ``url``: the
+    peer itself plus, when it is a router, every replica its stats
+    name.  Dead endpoints are skipped with a note — assembly from the
+    survivors plus saved files is the post-mortem path."""
+    from flexflow_tpu.serve.net.client import NetClient
+
+    exact = trace_id is not None and bool(
+        _FULL_TRACE_ID.match(trace_id.strip().lower()))
+
+    async def pull(u: str) -> Tuple[str, Optional[List[Dict]]]:
+        cl = NetClient(u)
+        try:
+            doc = (await cl.timelines(trace=trace_id) if exact
+                   else await cl.timelines())
+        except Exception as e:  # noqa: BLE001 - skip dead endpoints
+            print(f"fftrace: {u} unreachable ({e}); skipping",
+                  file=sys.stderr)
+            return u, None
+        led = doc.get("ledger") or {}
+        return u, ((led.get("retired") or []) + (led.get("live") or []))
+
+    label, tls = await pull(url)
+    out = [(label, tls)] if tls is not None else []
+    try:
+        stats = await NetClient(url).stats()
+    except Exception:
+        stats = {}
+    # a router's /v1/stats names its replicas under the frontend block
+    # (RouterServer mounts the router facade there)
+    urls = [r.get("url") for r in (stats.get("frontend") or {}).get(
+        "replicas", []) if isinstance(r, dict)]
+    for u, tls in await asyncio.gather(*(pull(u) for u in urls
+                                         if u and u != url)):
+        if tls is not None:
+            out.append((u, tls))
+    return out
+
+
+# ------------------------------------------------------------- assembly
+def assemble(sources: List[Tuple[str, List[Dict]]],
+             trace_id: Optional[str], out_path: Optional[str]) -> int:
+    from flexflow_tpu.observability import TraceAssembler
+
+    asm = TraceAssembler()
+    for label, tls in sources:
+        asm.add_source(label, tls)
+    ids = asm.trace_ids()
+    if trace_id is None:
+        if not ids:
+            print("no trace-stamped timelines in any source",
+                  file=sys.stderr)
+            return 1
+        print(f"{len(ids)} trace(s) across "
+              f"{len(sources)} source(s):")
+        for tid, n in sorted(ids.items(), key=lambda kv: -kv[1]):
+            print(f"  {tid}  ({n} timeline(s))")
+        print("re-run with --trace <id> to assemble one")
+        return 0
+    # accept unambiguous id prefixes (operators paste 8-char heads)
+    matches = [t for t in ids if t.startswith(trace_id)]
+    if len(matches) > 1:
+        print(f"fftrace: --trace {trace_id!r} is ambiguous: "
+              f"{', '.join(sorted(matches))}", file=sys.stderr)
+        return 1
+    if len(matches) == 1:
+        trace_id = matches[0]
+    try:
+        trace = asm.build(trace_id)
+    except ValueError as e:
+        print(f"fftrace: {e}", file=sys.stderr)
+        return 1
+    path = out_path or f"fftrace_{trace_id[:8]}.json"
+    with open(path, "w") as f:
+        json.dump(trace, f)
+        f.write("\n")
+    meta = trace["otherData"]
+    print(f"assembled trace {trace_id}: "
+          f"{meta['timelines']} timeline(s) across "
+          f"{len(meta['sources'])} source(s) "
+          f"({', '.join(meta['sources'])}), "
+          f"{len(trace['traceEvents'])} events -> {path}")
+    return 0
+
+
+# ------------------------------------------------------------- selftest
+def selftest() -> int:
+    """End-to-end smoke of the assembly path with the failover shape:
+    a router-hop ledger plus TWO replica-hop ledgers (the second
+    resuming after a failover) share one trace_id; one replica's
+    snapshot goes through disk (the saved-document path), and the
+    assembled Chrome trace must hold spans from all three processes
+    under one consistent trace_id.  Used by tools/run_tier1.sh."""
+    import tempfile
+    import time
+
+    from flexflow_tpu.observability import RequestLedger, TraceContext
+
+    ctx = TraceContext.mint()
+    router_led = RequestLedger(retired_capacity=8)
+    router_led.note_event("enqueue", guid=1, prompt_len=16,
+                          trace_id=ctx.trace_id, hop=ctx.hop)
+    router_led.note_event("admit", guid=1)
+    router_led.note_event("router-route", guid=1, replica="http://a",
+                          affinity="new", route_s=0.001, score=1.0)
+    router_led.note_event("commit", guid=1, tokens=1)
+    router_led.note_event("router-failover", guid=1,
+                          replica="http://a", relayed=3)
+    router_led.note_event("router-route", guid=1, replica="http://b",
+                          affinity="spill", resume=True, replayed=3,
+                          gap_s=0.002)
+    router_led.note_event("commit", guid=1, tokens=1)
+    router_led.note_event("retire", guid=1, tokens=8)
+
+    child = ctx.child()
+
+    def replica_ledger(guid: int, tokens: int) -> RequestLedger:
+        led = RequestLedger(retired_capacity=8)
+        led.note_event("enqueue", guid=guid, prompt_len=16,
+                       trace_id=child.trace_id, hop=child.hop)
+        led.note_event("admit", guid=guid, row=0)
+        led.note_event("prefill-chunk", guid=guid, chunk=16)
+        led.note_event("commit", guid=guid, tokens=1)
+        time.sleep(0.002)
+        led.note_event("commit", guid=guid, tokens=tokens - 1)
+        led.note_event("retire", guid=guid, tokens=tokens)
+        return led
+
+    led_a = replica_ledger(guid=1000001, tokens=3)   # dies mid-stream
+    led_b = replica_ledger(guid=1000002, tokens=8)   # resumes
+
+    d = tempfile.mkdtemp(prefix="fftrace_selftest_")
+    # replica A's half arrives from DISK (its process is "dead")
+    a_path = os.path.join(d, "replica_a_ledger.json")
+    with open(a_path, "w") as f:
+        json.dump(led_a.snapshot(), f)
+    out_path = os.path.join(d, "trace.json")
+    sources = (load_file_sources([a_path])
+               + [("router", router_led.timelines_for_trace(
+                   ctx.trace_id)),
+                  ("http://b", led_b.timelines_for_trace(
+                      child.trace_id))])
+    rc = assemble(sources, ctx.trace_id[:8], out_path)
+    with open(out_path) as f:
+        trace = json.load(f)
+    evs = trace["traceEvents"]
+    pids = {e["pid"] for e in evs if e.get("ph") != "M"}
+    names = {e["name"] for e in evs}
+    # listing mode must also see exactly one trace across the sources
+    rc_list = assemble(sources, None, None)
+    ok = (rc == 0 and rc_list == 0
+          and trace["otherData"]["trace_id"] == ctx.trace_id
+          and len(pids) == 3                      # router + 2 replicas
+          and trace["otherData"]["timelines"] == 3
+          and {"queue", "ttft", "stream"} <= names   # lifecycle spans
+          and "router-failover" in names             # failover visible
+          and "router-route" in names
+          and all(e.get("ts", 0) >= 0 for e in evs))
+    # cross-ledger ordering sanity: events are wall-aligned and sorted
+    ts = [e["ts"] for e in evs if e.get("ph") != "M"]
+    ok = ok and ts == sorted(ts)
+    print(f"fftrace selftest {'OK' if ok else 'FAILED'}: {out_path}")
+    return 0 if ok else 1
+
+
+# ------------------------------------------------------------------ main
+def main(argv) -> int:
+    ap = argparse.ArgumentParser(
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("paths", nargs="*",
+                    help="saved ledger/bundle/record JSON files")
+    ap.add_argument("--url", default=None,
+                    help="live endpoint (router or replica); a "
+                         "router's replicas are pulled too")
+    ap.add_argument("--trace", default=None, metavar="TRACE_ID",
+                    help="trace to assemble (unambiguous prefix ok); "
+                         "omit to list what the sources hold")
+    ap.add_argument("-o", "--out", default=None, metavar="OUT.json")
+    ap.add_argument("--selftest", action="store_true")
+    args = ap.parse_args(argv[1:])
+    if args.selftest:
+        return selftest()
+    if not args.paths and not args.url:
+        ap.print_usage(sys.stderr)
+        return 1
+    try:
+        sources = load_file_sources(args.paths)
+    except Exception as e:
+        print(f"fftrace: unreadable input ({type(e).__name__}: {e})",
+              file=sys.stderr)
+        return 1
+    if args.url:
+        sources.extend(asyncio.run(_fetch_live(args.url, args.trace)))
+    return assemble(sources, args.trace, args.out)
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))

@@ -35,6 +35,15 @@
 (cd "$(dirname "$0")/.." \
  && env JAX_PLATFORMS=cpu python tools/ffreq.py --selftest >/dev/null) \
  || { echo "ffreq/request-ledger selftest FAILED" >&2; exit 1; }
+# fftrace/trace-plane smoke: cross-process trace assembly end-to-end —
+# a synthetic router hop plus two replica hops (one arriving from a
+# saved ledger snapshot on disk, the failover shape) must merge into
+# ONE Chrome trace with lifecycle spans from all three processes under
+# a consistent trace_id — so a broken assembly path fails CI before a
+# fleet post-mortem needs it.
+(cd "$(dirname "$0")/.." \
+ && env JAX_PLATFORMS=cpu python tools/fftrace.py --selftest >/dev/null) \
+ || { echo "fftrace/trace-plane selftest FAILED" >&2; exit 1; }
 # ffload/front-end smoke: a tiny in-process live-traffic run through
 # the async front-end with one forced disconnect, one forced deadline
 # miss and an overload burst — asserts the shed/cancel counters tick,
