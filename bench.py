@@ -1919,6 +1919,81 @@ def bench_kv_dtype(model_builder=None, max_requests=8, prompt_len=32,
     return (head, *extras)
 
 
+def _autosize_victim(victim_prompt, victim_new, bystander_new, chunk,
+                     max_seq_length):
+    """The interference-A/B p99-boundary guard (the ROADMAP `mixed`
+    caveat): the separate-dispatch arm's stall signature is ~one long
+    gap per victim prefill CHUNK in every bystander's commit series, so
+    the victim's chunk count must clear 1% of a bystander's commits or
+    the pooled p99 never samples the stalls and the comparison silently
+    inverts on dispatch-overhead-dominated tiny models.  Auto-grows the
+    victim prompt (whole chunks) to clear the percentile; returns
+    ``(victim_prompt, undersized)`` — undersized=True (warn + the
+    record stamps ``p99_undersized``) when the context window cannot
+    fit a big-enough victim."""
+    need = int(0.01 * bystander_new) + 1
+    if -(-victim_prompt // chunk) >= need:
+        return victim_prompt, False
+    cap = ((max_seq_length - victim_new - 16) // chunk) * chunk
+    victim_prompt = max(victim_prompt, min(need * chunk, cap))
+    undersized = -(-victim_prompt // chunk) < need
+    if undersized:
+        print(f"bench: victim prompt {victim_prompt} yields only "
+              f"{-(-victim_prompt // chunk)} prefill chunks "
+              f"(< {need} needed to clear the bystander p99 at "
+              f"{bystander_new} commits) — the interference p99 may "
+              f"invert; record stamped p99_undersized",
+              file=sys.stderr)
+    return victim_prompt, undersized
+
+
+def _interference_scenario(rm_factory, drive, bystanders, victim_tokens,
+                           bystander_new, victim_new, admit_after):
+    """One interference serve (the harness `mixed` and `disagg` share):
+    bystanders stream decode while one long-prompt victim is registered
+    from the driver-thread on_commit hook after ``admit_after``
+    committed tokens — deterministic across arms (same committed-token
+    count -> same logical admit point), unlike a wall-clock timer.
+    Per-token gaps come from the commit stamps (block commits normalize
+    by their token count), so the p99 is the stall signature itself.
+    Returns bystander TPOT p50/p99, victim TTFT/guid, and every arm's
+    token sequences for the cross-arm parity gate."""
+    rm = rm_factory()
+    stamps = {}
+    state = {"committed": 0, "victim": None}
+
+    def on_commit(req, toks):
+        stamps.setdefault(req.guid, []).append(
+            (time.monotonic(), len(toks)))
+        state["committed"] += len(toks)
+        if (state["victim"] is None
+                and state["committed"] >= admit_after):
+            state["victim"] = rm.register_new_request(
+                list(victim_tokens), max_new_tokens=victim_new)
+
+    rm.on_commit = on_commit
+    reqs = [rm.register_new_request(list(p),
+                                    max_new_tokens=bystander_new)
+            for p in bystanders]
+    drive(rm, reqs)
+    victim = state["victim"]
+    assert victim is not None and victim.status == victim.COMPLETED, \
+        "victim was never admitted mid-stream (scenario broken)"
+    gaps = []
+    for r in reqs:
+        ss = stamps.get(r.guid) or []
+        for (t0, _n0), (t1, n1) in zip(ss, ss[1:]):
+            gaps.extend([(t1 - t0) / max(1, n1)] * n1)
+    return {
+        "tpot_p50_s": float(np.percentile(gaps, 50)) if gaps else 0.0,
+        "tpot_p99_s": float(np.percentile(gaps, 99)) if gaps else 0.0,
+        "victim_ttft_s": victim.profile.ttft_s() or 0.0,
+        "victim_guid": victim.guid,
+        "tokens": ([list(r.tokens) for r in reqs]
+                   + [list(victim.tokens)]),
+    }
+
+
 def bench_mixed(model_builder=None, max_requests=4, bystander_prompt=24,
                 bystander_new=192, victim_prompt=576, victim_new=8,
                 max_seq_length=1024, max_tokens_per_batch=256,
@@ -1975,52 +2050,28 @@ def bench_mixed(model_builder=None, max_requests=4, bystander_prompt=24,
         prefill_chunk=max_tokens_per_batch, cache_dtype=cache_dtype,
         kv_cache_dtype=_KV_DTYPE)
 
+    # p99-boundary guard (ROADMAP caveat): grow the victim so its
+    # chunk count clears the bystander percentile, else stamp the
+    # record so a silent inversion is attributable
+    victim_prompt, p99_undersized = _autosize_victim(
+        victim_prompt, victim_new, bystander_new, max_tokens_per_batch,
+        max_seq_length)
+
     rng = np.random.default_rng(0)
     bystanders = [rng.integers(4, vocab - 1, bystander_prompt).tolist()
                   for _ in range(max_requests - 1)]
     victim_tokens = rng.integers(4, vocab - 1, victim_prompt).tolist()
 
     def run(hybrid):
-        rm = RequestManager(max_requests_per_batch=max_requests,
-                            max_tokens_per_batch=max_tokens_per_batch,
-                            max_sequence_length=max_seq_length,
-                            decode_block=decode_block,
-                            hybrid_steps=hybrid)
-        stamps = {}
-        state = {"committed": 0, "victim": None}
-
-        def on_commit(req, toks):
-            stamps.setdefault(req.guid, []).append(
-                (time.monotonic(), len(toks)))
-            state["committed"] += len(toks)
-            if (state["victim"] is None
-                    and state["committed"] >= admit_after):
-                # driver-thread registration: deterministic across arms
-                # (same committed-token count -> same logical admit
-                # point), unlike a wall-clock timer
-                state["victim"] = rm.register_new_request(
-                    list(victim_tokens), max_new_tokens=victim_new)
-
-        rm.on_commit = on_commit
-        reqs = [rm.register_new_request(list(p),
-                                        max_new_tokens=bystander_new)
-                for p in bystanders]
-        rm.generate_incr_decoding(im, mid, reqs)
-        victim = state["victim"]
-        assert victim is not None and victim.status == victim.COMPLETED, \
-            "victim was never admitted mid-stream (scenario broken)"
-        gaps = []
-        for r in reqs:
-            ss = stamps.get(r.guid) or []
-            for (t0, _n0), (t1, n1) in zip(ss, ss[1:]):
-                gaps.extend([(t1 - t0) / max(1, n1)] * n1)
-        return {
-            "tpot_p50_s": float(np.percentile(gaps, 50)) if gaps else 0.0,
-            "tpot_p99_s": float(np.percentile(gaps, 99)) if gaps else 0.0,
-            "victim_ttft_s": victim.profile.ttft_s() or 0.0,
-            "tokens": ([list(r.tokens) for r in reqs]
-                       + [list(victim.tokens)]),
-        }
+        return _interference_scenario(
+            lambda: RequestManager(
+                max_requests_per_batch=max_requests,
+                max_tokens_per_batch=max_tokens_per_batch,
+                max_sequence_length=max_seq_length,
+                decode_block=decode_block, hybrid_steps=hybrid),
+            lambda rm, reqs: rm.generate_incr_decoding(im, mid, reqs),
+            bystanders, victim_tokens, bystander_new, victim_new,
+            admit_after)
 
     run(True)        # warmup: compile both arms' shape buckets
     run(False)
@@ -2046,6 +2097,8 @@ def bench_mixed(model_builder=None, max_requests=4, bystander_prompt=24,
         "victim_ttft_ratio": round(ttft_ratio, 3),
         "victim_ttft_budget_ok": ttft_ratio <= 1.10,
         "greedy_match": parity,
+        "victim_prompt": victim_prompt,
+        "p99_undersized": p99_undersized,
     }
     extras = [
         {"metric": "mixed_bystander_tpot_p50",
@@ -2056,6 +2109,188 @@ def bench_mixed(model_builder=None, max_requests=4, bystander_prompt=24,
          "value": round(hyb["victim_ttft_s"], 4), "unit": "s",
          "separate_s": round(sep["victim_ttft_s"], 4),
          "vs_baseline": 0},
+    ]
+    return (head, *extras)
+
+
+def bench_disagg(model_builder=None, max_requests=4, bystander_prompt=24,
+                 bystander_new=192, victim_prompt=576, victim_new=8,
+                 max_seq_length=1024, max_tokens_per_batch=64,
+                 decode_block=8, admit_after=16, prefill_rows=2):
+    """Disaggregated prefill/decode TTFT-isolation A/B (`disagg` mode):
+    the `mixed` interference scenario (``max_requests - 1`` short-
+    prompt bystanders decoding, one long-prompt victim admitted after
+    ``admit_after`` committed tokens) served THREE ways:
+
+    - **mixed-continuous** (single mesh, ``hybrid_steps=False``): the
+      victim's chunked prefill runs every row at chunk width;
+    - **hybrid** (single mesh, PR-12 fused steps): the prefill rides
+      decode dispatches as roofline-budgeted rider chunks;
+    - **disagg** (serving/disagg.py): the prefill runs on its OWN mesh
+      slice and the finished KV migrates whole-frame to the decode
+      slice — the structural fix, bystanders never see a chunk.
+
+    Headline: bystander TPOT p99 isolation (mixed-continuous /
+    disagg).  Greedy parity is asserted bit-exact across ALL THREE
+    arms (scheduling may change WHEN rows compute, never WHAT), and
+    the migration counters + the victim's migrate ledger span land in
+    the record.  With fewer than 2 visible devices both slices share
+    one device (stamped ``single_device`` — the structural overlap
+    claim then needs real hardware).
+
+    ``model_builder``: optional ``(devices=None) -> (model,
+    vocab_size, cache_dtype)`` override for the CPU test suite
+    (default: the 1.4B bench LLaMA in bf16)."""
+    import jax
+
+    from flexflow_tpu import FFConfig, Model
+    from flexflow_tpu.models.llama import LLAMAConfig, create_llama_model
+    from flexflow_tpu.observability import get_ledger
+    from flexflow_tpu.serving import InferenceManager, RequestManager
+    from flexflow_tpu.serving.disagg import FrameMigrator, SlicePool
+
+    if model_builder is None:
+        def model_builder(devices=None):
+            from flexflow_tpu.fftype import DataType
+
+            cfg = LLAMAConfig(
+                vocab_size=32000, hidden_size=2048, intermediate_size=5504,
+                num_hidden_layers=24, num_attention_heads=16,
+                num_key_value_heads=4,
+                max_position_embeddings=max_seq_length)
+            model = Model(FFConfig(computation_dtype="bfloat16",
+                                   devices=devices),
+                          name="llama_disagg_bench")
+            create_llama_model(model, cfg, max_requests=max_requests,
+                               dtype=DataType.HALF)
+            return model, cfg.vocab_size, None
+
+    victim_prompt, p99_undersized = _autosize_victim(
+        victim_prompt, victim_new, bystander_new, max_tokens_per_batch,
+        max_seq_length)
+    devs = jax.devices()
+    single_device = len(devs) < 2
+    if single_device:
+        print("bench disagg: < 2 devices — both slices share one "
+              "device (async-dispatch overlap claim needs hardware)",
+              file=sys.stderr)
+    pre_devs = (devs[0],)
+    dec_devs = (devs[0],) if single_device else (devs[1],)
+
+    def compile_arm(devices, rows):
+        model, vocab, cache_dtype = model_builder(devices=devices)
+        im = InferenceManager(model.config)
+        mid = im.compile_model_and_allocate_buffer(
+            model, max_requests=rows, max_seq_length=max_seq_length,
+            prefill_chunk=max_tokens_per_batch,
+            cache_dtype=cache_dtype, kv_cache_dtype=_KV_DTYPE)
+        return im, mid, vocab
+
+    im_s, mid_s, vocab = compile_arm(None, max_requests)
+    im_pre, pmid, _ = compile_arm(pre_devs, prefill_rows)
+    im_dec, dmid, _ = compile_arm(dec_devs, max_requests)
+
+    rng = np.random.default_rng(0)
+    bystanders = [rng.integers(4, vocab - 1, bystander_prompt).tolist()
+                  for _ in range(max_requests - 1)]
+    victim_tokens = rng.integers(4, vocab - 1, victim_prompt).tolist()
+
+    def scenario(run_generate):
+        return _interference_scenario(
+            lambda: RequestManager(
+                max_requests_per_batch=max_requests,
+                max_tokens_per_batch=max_tokens_per_batch,
+                max_sequence_length=max_seq_length,
+                decode_block=decode_block),
+            run_generate, bystanders, victim_tokens, bystander_new,
+            victim_new, admit_after)
+
+    def run_single(hybrid):
+        def go(rm, reqs):
+            rm.hybrid_steps = hybrid
+            rm.generate_incr_decoding(im_s, mid_s, reqs)
+        return scenario(go)
+
+    migrators = []
+
+    def run_disagg():
+        from flexflow_tpu.serving.kv_pager import RecoveryPolicy
+
+        # the A/B measures the TRANSFER arm, so the handoff decision is
+        # pinned to migrate (auto pricing — which legitimately picks
+        # recompute on tiny CPU models whose re-prefill undercuts the
+        # link latency — is covered by tests/test_disagg.py; on the
+        # 1.4B default the auto price picks migrate by ~20x)
+        mig = FrameMigrator(
+            SlicePool(im_pre, pmid, label="prefill"),
+            SlicePool(im_dec, dmid, label="decode"),
+            policy=RecoveryPolicy.for_record(im_dec, dmid,
+                                             migrate_mode="migrate"))
+        migrators.append(mig)
+
+        def go(rm, reqs):
+            rm.generate_disagg(im_pre, pmid, im_dec, dmid, reqs,
+                               migrator=mig)
+        return scenario(go)
+
+    # warmup: compile every arm's shape buckets off the clock
+    run_single(True)
+    run_single(False)
+    run_disagg()
+    _clear_ledger_window()
+    hyb = run_single(True)
+    sep = run_single(False)
+    dis = run_disagg()
+    _note_kv(im_dec, dmid, "disagg")
+    mig = migrators[-1]
+    parity = (dis["tokens"] == sep["tokens"]
+              and hyb["tokens"] == sep["tokens"])
+    # the victim's migrate span, straight off its ledger timeline (the
+    # record-level proof the handoff happened and what it cost)
+    try:
+        tl = get_ledger().timeline(dis["victim_guid"]) or {}
+    except Exception:
+        tl = {}
+    migrate_events = [ev for ev in (tl.get("events") or [])
+                      if ev.get("name") == "migrate"]
+    head = {
+        "metric": "disagg_bystander_tpot_p99_isolation",
+        "value": round(sep["tpot_p99_s"] / max(1e-9, dis["tpot_p99_s"]),
+                       3),
+        "unit": "x (mixed-continuous bystander TPOT p99 / "
+                "disaggregated)",
+        "methodology": (f"interference,{max_requests - 1}bystanders+"
+                        f"1x{victim_prompt}prompt@{admit_after}tok,"
+                        f"3-arm,greedy,best-of-1"),
+        "vs_baseline": 0,
+        "separate_tpot_p99_ms": round(sep["tpot_p99_s"] * 1e3, 2),
+        "hybrid_tpot_p99_ms": round(hyb["tpot_p99_s"] * 1e3, 2),
+        "disagg_tpot_p99_ms": round(dis["tpot_p99_s"] * 1e3, 2),
+        "disagg_vs_hybrid_p99": round(
+            hyb["tpot_p99_s"] / max(1e-9, dis["tpot_p99_s"]), 3),
+        "greedy_match": parity,
+        "victim_prompt": victim_prompt,
+        "p99_undersized": p99_undersized,
+        "single_device": single_device,
+        "prefill_rows": prefill_rows,
+        "migrations": dict(mig.migrations),
+        "migration_bytes": mig.bytes_total,
+    }
+    extras = [
+        {"metric": "disagg_bystander_tpot_p50",
+         "value": round(dis["tpot_p50_s"] * 1e3, 2), "unit": "ms",
+         "separate_ms": round(sep["tpot_p50_s"] * 1e3, 2),
+         "hybrid_ms": round(hyb["tpot_p50_s"] * 1e3, 2),
+         "vs_baseline": 0},
+        {"metric": "disagg_victim_ttft",
+         "value": round(dis["victim_ttft_s"], 4), "unit": "s",
+         "separate_s": round(sep["victim_ttft_s"], 4),
+         "hybrid_s": round(hyb["victim_ttft_s"], 4),
+         "vs_baseline": 0},
+        {"metric": "disagg_migration_span",
+         "value": float(len(migrate_events)), "unit": "x",
+         "vs_baseline": 0,
+         "events": migrate_events},
     ]
     return (head, *extras)
 
@@ -2856,6 +3091,10 @@ def main(which: str, budget=None):
         head, *extras = bench_mixed()
         head["extras"] = extras
         return head
+    if which == "disagg":
+        head, *extras = bench_disagg()
+        head["extras"] = extras
+        return head
     if which == "paged":
         head, *extras = bench_paged()
         head["extras"] = extras
@@ -2872,7 +3111,8 @@ def main(which: str, budget=None):
         raise SystemExit(
             f"unknown bench mode {which!r} (expected all|llama|llama7b|"
             f"spec|spec7b|mnist|kernels|opt|resnet|longctx|quality|"
-            f"distill|crossover|prefix|kvdtype|mixed|paged|live|net)")
+            f"distill|crossover|prefix|kvdtype|mixed|disagg|paged|live|"
+            f"net)")
 
     # all: headline decode metric + everything else under extras.  Each
     # section runs in its own process lifetime-wise (HBM frees between
@@ -2957,6 +3197,7 @@ def main(which: str, budget=None):
                       + _section(bench_prefix, "prefix")
                       + _section(bench_kv_dtype, "kvdtype")
                       + _section(bench_mixed, "mixed")
+                      + _section(bench_disagg, "disagg")
                       + _section(bench_paged, "paged")
                       + _section(bench_live, "live")
                       + _section(bench_net, "net")

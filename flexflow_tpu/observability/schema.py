@@ -241,6 +241,31 @@ METRICS_SCHEMA = {
                 "records; saved bytes = count x frame bytes of the "
                 "served record).",
     },
+    # ------------------------------------------- disaggregated serving
+    "serving_migrations_total": {
+        "type": "counter",
+        "help": "Prefill->decode slice handoffs under disaggregated "
+                "serving (serving/disagg.py), labeled decision=migrate "
+                "(whole-frame KV transfer over the device link) | "
+                "recompute (the decode slice re-prefills — chosen when "
+                "RecoveryPolicy.choose_migrate prices the transfer "
+                "above the re-prefill, or when the destination cannot "
+                "lease frames).",
+    },
+    "serving_migration_bytes_total": {
+        "type": "counter",
+        "help": "KV cache bytes moved between mesh slices by frame "
+                "migration (decision=migrate handoffs; int8 payloads "
+                "include their f32 scale frames).",
+    },
+    "serving_migration_seconds": {
+        "type": "histogram",
+        "help": "Wall time of one whole-request KV migration (source "
+                "fetch + destination lease/table push + restore) — the "
+                "victim-TTFT component disaggregation adds, and what "
+                "the device-link bandwidth term in SimpleMachineModel "
+                "prices.",
+    },
     "serving_preemptions_total": {
         "type": "counter",
         "help": "Requests preempted by the KV pager, labeled "
@@ -549,6 +574,16 @@ EVENT_SCHEMA = {
                 "reason=no_rows|no_pages); noted once per (request, "
                 "reason) transition so a timeline shows WHY its "
                 "queue_wait_s grew.",
+    },
+    "migrate": {
+        "help": "Disaggregated prefill->decode handoff at a fold "
+                "boundary (guid, src_row, dst_row, tokens, bytes, "
+                "seconds, decision=migrate|recompute): the request's "
+                "prefilled KV left the prefill slice — as a whole-"
+                "frame device-to-device transfer (migrate) or by "
+                "re-prefilling on the decode slice (recompute).  "
+                "tools/ffreq.py renders the prefill-slice -> transfer "
+                "-> decode-slice span from it.",
     },
     "evict": {
         "help": "Prefix-pool entry evicted (slot, reason=lru|superseded"

@@ -54,7 +54,8 @@ class MachineModel:
     def __init__(self, num_devices: int, peak_flops: float,
                  hbm_bandwidth: float, ici_bandwidth: float,
                  ici_latency: float, dcn_bandwidth: float,
-                 devices_per_host: int = 0, hbm_per_device: int = 0):
+                 devices_per_host: int = 0, hbm_per_device: int = 0,
+                 device_link_bandwidth: Optional[float] = None):
         self.num_devices = num_devices
         self.peak_flops = peak_flops
         self.hbm_bandwidth = hbm_bandwidth
@@ -63,6 +64,13 @@ class MachineModel:
         self.dcn_bandwidth = dcn_bandwidth
         self.devices_per_host = devices_per_host or num_devices
         self.hbm_per_device = hbm_per_device
+        # direct device-to-device payload link (whole-frame KV
+        # migration between mesh slices, serving/disagg.py): a single
+        # p2p hop, so it defaults to the per-direction ICI figure —
+        # distinct from dcn_bandwidth, which prices the HOST link the
+        # spill/restore path crosses.
+        self.device_link_bandwidth = float(device_link_bandwidth
+                                           or ici_bandwidth)
 
     # -------------------------------------------------------- collectives
     def _link_bw(self, group: int) -> float:
@@ -102,6 +110,15 @@ class MachineModel:
         return ((group - 1) / group * bytes_ / self._link_bw(group)
                 + (group - 1) * self.ici_latency)
 
+    def migrate_time(self, bytes_: int) -> float:
+        """One whole-payload device-to-device KV handoff (the
+        disaggregated prefill->decode frame migration): a single p2p
+        transfer over the device link — what RecoveryPolicy's
+        ``migrate`` arm prices against recompute-on-the-decode-slice."""
+        if bytes_ <= 0:
+            return 0.0
+        return bytes_ / self.device_link_bandwidth + self.ici_latency
+
 
 class SimpleMachineModel(MachineModel):
     """One-knob model (reference SimpleMachineModel: intra-node + NIC bw).
@@ -114,10 +131,12 @@ class SimpleMachineModel(MachineModel):
                  hbm_bandwidth: float = 819e9, ici_bandwidth: float = 45e9,
                  ici_latency: float = 1e-6, dcn_bandwidth: float = 25e9,
                  devices_per_host: int = 0,
-                 hbm_per_device: int = 16 * 1024**3):
+                 hbm_per_device: int = 16 * 1024**3,
+                 device_link_bandwidth: Optional[float] = None):
         super().__init__(num_devices, peak_flops, hbm_bandwidth,
                          ici_bandwidth, ici_latency, dcn_bandwidth,
-                         devices_per_host, hbm_per_device)
+                         devices_per_host, hbm_per_device,
+                         device_link_bandwidth=device_link_bandwidth)
 
 
 class EnhancedMachineModel(MachineModel):
@@ -145,6 +164,8 @@ class EnhancedMachineModel(MachineModel):
             dcn_bandwidth=kv.get("dcn_gbps", 25.0) * 1e9,
             devices_per_host=int(kv.get("devices_per_host", 0)),
             hbm_per_device=int(kv.get("hbm_gb", 16) * 1024**3),
+            device_link_bandwidth=(kv["device_link_gbps"] * 1e9
+                                   if "device_link_gbps" in kv else None),
         )
 
 

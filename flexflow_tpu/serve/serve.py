@@ -199,6 +199,10 @@ class LLM:
         self.rm: Optional[RequestManager] = None
         self.generation_config = GenerationConfig()
         self.ssms: List["SSM"] = []
+        # disaggregated prefill/decode (compile(disagg=...)): the
+        # prefill slice's {im, model_id, pager, rows}; None = single
+        # mesh.  self.im/self.model_id stay the DECODE record.
+        self._disagg: Optional[Dict[str, Any]] = None
 
     # ------------------------------------------------------------- HF cache
     def _is_local(self) -> bool:
@@ -311,7 +315,9 @@ class LLM:
                 kv_page_budget_bytes: Optional[int] = None,
                 kv_page_len: int = 64,
                 kv_spill_policy: str = "auto",
-                kv_layout: Optional[str] = None):
+                kv_layout: Optional[str] = None,
+                disagg: Optional[Sequence[int]] = None,
+                disagg_prefill_rows: Optional[int] = None):
         """Build + compile the serving graph (reference serve.py:303+).
 
         With ``ssms`` the LLM compiles in TREE_VERIFY mode and each SSM in
@@ -342,16 +348,52 @@ class LLM:
         ``kv_page_budget_bytes`` (the pool is the budget); SSMs stay
         dense (beam rows gather caches by parent).  Default ("dense")
         keeps dense slabs with accounting-only paging.
+
+        ``disagg=(p_devices, d_devices)``: DISAGGREGATED prefill/decode
+        (docs/INTERNALS.md "Disaggregated prefill/decode — frame
+        migration between slices"): the first ``p_devices`` visible
+        devices become the prefill slice and the next ``d_devices``
+        the decode slice — two compiled records, same weights loaded
+        per slice, finished prefills migrating their KV frames across
+        at fold boundaries so long prompts stop degrading bystander
+        TPOT structurally.  ``disagg_prefill_rows`` sizes the prefill
+        slice's row pool (default 2 — a couple of concurrent
+        prefills); the decode pool is ``max_requests_per_batch``.
+        Each slice gets its own pager under ``kv_page_budget_bytes``.
+        Incompatible with ``ssms``.  Env ``FF_DISAGG=0`` is the A/B
+        kill switch: compile keeps both slices but ``generate`` falls
+        back to the single-mesh driver on the decode record.
         """
         from . import _resolved_config
 
         self.generation_config = generation_config or GenerationConfig()
         cfg = ff_config or _resolved_config()
         self.ssms = list(ssms)
+        if disagg is not None and self.ssms:
+            raise ValueError(
+                "disagg=... is incompatible with ssms: the speculative "
+                "drivers are single-mesh loops (migrate their prefill "
+                "via serving.disagg.migrate_into_pending instead)")
         mode = (InferenceMode.TREE_VERIFY if self.ssms
                 else InferenceMode.INC_DECODING)
         config_cls, builder, _ = self.spec.load()
         arch_cfg = config_cls.from_hf(self.hf_config)
+        cfg_pre = None
+        if disagg is not None:
+            import dataclasses as _dc
+
+            p_n, d_n = int(disagg[0]), int(disagg[1])
+            devs = tuple(cfg.devices)
+            if p_n < 1 or d_n < 1 or p_n + d_n > len(devs):
+                raise ValueError(
+                    f"disagg=({p_n}, {d_n}) needs {p_n + d_n} devices, "
+                    f"have {len(devs)}")
+            # device partition: prefill slice first, decode slice next;
+            # the config's parallelism degrees apply WITHIN each slice
+            cfg_pre = _dc.replace(cfg, devices=devs[:p_n],
+                                  num_devices=p_n)
+            cfg = _dc.replace(cfg, devices=devs[p_n: p_n + d_n],
+                              num_devices=d_n)
         self.model = Model(cfg, name=self.model_name.replace("/", "--"))
         builder(self.model, arch_cfg, mode=mode,
                 max_requests=max_requests_per_batch,
@@ -383,18 +425,26 @@ class LLM:
                                             pager_for_budget,
                                             pager_for_record)
 
+            label = "decode" if disagg is not None else None
             if kv_layout == "paged":
                 # physical pool: the pager owns the record's concrete
                 # frames (budget == the allocated pool)
                 pager = pager_for_record(self.im, self.model_id,
-                                         mode=kv_spill_policy)
+                                         mode=kv_spill_policy,
+                                         slice_label=label)
             else:
                 pager = pager_for_budget(
                     kv_page_budget_bytes,
                     self.im.kv_cache_stats(self.model_id).bytes_per_token,
-                    page_len=kv_page_len,
+                    page_len=kv_page_len, slice_label=label,
                     policy=RecoveryPolicy.for_record(
                         self.im, self.model_id, mode=kv_spill_policy))
+        if disagg is not None:
+            self._compile_prefill_slice(
+                cfg_pre, builder, arch_cfg, mode,
+                disagg_prefill_rows or 2, max_seq_length, cache_dtype,
+                kv_cache_dtype, kv_layout, kv_page_len,
+                kv_page_budget_bytes, kv_spill_policy)
         self.rm = RequestManager(
             max_requests_per_batch=max_requests_per_batch,
             max_tokens_per_batch=max_tokens_per_batch,
@@ -421,6 +471,62 @@ class LLM:
                                 kv_cache_dtype=kv_cache_dtype)
         return self
 
+    def _compile_prefill_slice(self, cfg_pre, builder, arch_cfg, mode,
+                               prefill_rows, max_seq_length,
+                               cache_dtype, kv_cache_dtype, kv_layout,
+                               kv_page_len, kv_page_budget_bytes,
+                               kv_spill_policy):
+        """The prefill half of compile(disagg=...): the SAME weights
+        loaded onto the prefill slice's devices as a second compiled
+        record in its own InferenceManager, with its own pager under
+        the paged layout — serving/disagg.py hands finished prefills
+        from here to the decode record."""
+        pre_model = Model(cfg_pre,
+                          name=self.model_name.replace("/", "--")
+                          + "--prefill")
+        builder(pre_model, arch_cfg, mode=mode,
+                max_requests=prefill_rows,
+                generation_config=self.generation_config,
+                dtype=self.data_type)
+        # a second host read of the cached weight archive: the decode
+        # compile committed ITS copy device-side; this one commits to
+        # the prefill slice
+        pre_model.params = self.download_hf_weights_if_needed()
+        quantize_model_params(pre_model, cfg_pre.quantization)
+        if cfg_pre.offload:
+            # same offload treatment as the decode record — a model
+            # that fits only because weights stream from pinned host
+            # must not keep a full resident copy on the prefill slice
+            pre_model.params = _maybe_offload_params(pre_model.params)
+        im_pre = InferenceManager(cfg_pre)
+        pmid = im_pre.compile_model_and_allocate_buffer(
+            pre_model, mode=mode, max_requests=prefill_rows,
+            max_seq_length=max_seq_length, cache_dtype=cache_dtype,
+            kv_cache_dtype=kv_cache_dtype, kv_layout=kv_layout,
+            kv_page_len=kv_page_len,
+            kv_frame_budget_bytes=(kv_page_budget_bytes
+                                   if kv_layout == "paged" else None))
+        pre_pager = None
+        if kv_page_budget_bytes is not None:
+            from ..serving.kv_pager import (RecoveryPolicy,
+                                            pager_for_budget,
+                                            pager_for_record)
+
+            if kv_layout == "paged":
+                pre_pager = pager_for_record(im_pre, pmid,
+                                             mode=kv_spill_policy,
+                                             slice_label="prefill")
+            else:
+                pre_pager = pager_for_budget(
+                    kv_page_budget_bytes,
+                    im_pre.kv_cache_stats(pmid).bytes_per_token,
+                    page_len=kv_page_len, slice_label="prefill",
+                    policy=RecoveryPolicy.for_record(
+                        im_pre, pmid, mode=kv_spill_policy))
+        self._disagg = {"im": im_pre, "model_id": pmid,
+                        "pager": pre_pager, "rows": prefill_rows,
+                        "model": pre_model}
+
     # ------------------------------------------------------------- generate
     def generate(self, prompts: Union[str, Sequence[Any]],
                  max_new_tokens: int = 128,
@@ -445,6 +551,13 @@ class LLM:
             results = generate_spec_infer(self.rm, self.im, self.model_id,
                                           reqs, seed=seed, beam_width=w,
                                           beam_depth=d)
+        elif self._disagg is not None:
+            # disaggregated two-pool loop (FF_DISAGG=0 falls back to
+            # the single-mesh driver inside generate_disagg)
+            results = self.rm.generate_disagg(
+                self._disagg["im"], self._disagg["model_id"],
+                self.im, self.model_id, reqs, seed=seed,
+                prefill_pager=self._disagg["pager"])
         else:
             results = self.rm.generate_incr_decoding(
                 self.im, self.model_id, reqs, seed=seed)

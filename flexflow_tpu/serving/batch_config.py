@@ -103,6 +103,29 @@ class BatchConfig:
         # row-oriented token ids [R, chunk] (reference PerTokenInfo flattened)
         self.token_ids = np.zeros((R, chunk), np.int32)
 
+    # ------------------------------------------------------------ setup
+    def add_row(self, row: int, guid: int, depth: int,
+                span: List[int], max_sequence_length: int,
+                n: Optional[int] = None) -> int:
+        """Schedule one request on ``row``: ``span`` is the token
+        window starting at cache ``depth`` (sliced to the chunk; pass
+        ``n`` to schedule more or fewer slots than values — a shorter
+        span leaves the tail ids zeroed, the decode-block handoff
+        contract where init_tokens overrides them device-side).  The
+        one spelling of the per-row fill shared by RequestManager's
+        batch builders and the disaggregated two-pool scheduler
+        (serving/disagg.py).  Returns the scheduled count."""
+        n = min(len(span) if n is None else n, self.chunk)
+        self.request_guid[row] = guid
+        self.first_token_depth[row] = depth
+        self.num_tokens_in_batch[row] = n
+        self.max_sequence_length[row] = max_sequence_length
+        self.request_available[row] = True
+        k = min(n, len(span))
+        if k:
+            self.token_ids[row, :k] = span[:k]
+        return n
+
     # ------------------------------------------------------------ queries
     def get_mode(self) -> InferenceMode:
         return InferenceMode.INC_DECODING

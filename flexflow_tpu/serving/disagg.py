@@ -1,0 +1,834 @@
+"""Disaggregated prefill/decode serving: frame migration between slices.
+
+Single-host disaggregation over the frame pool (ROADMAP "Disaggregated
+prefill/decode over the frame pool"; the DistServe/Splitwise line of
+serving systems): prefill and decode run on **disjoint mesh slices** —
+two compiled records over device subsets, same weights loaded per
+slice — so a burst of long prefills can no longer stall bystander
+decode steps structurally, instead of merely being budgeted (the PR-12
+hybrid rider) or time-shared (mixed continuous batching).
+
+The parts were already here; this module only retargets them:
+
+- **Transfers**: a finished prefill's KV leaves the prefill slice as
+  the existing pow2-bucketed spill transfers
+  (``InferenceManager.fetch_row``/``restore_row`` — dense bucketed row
+  slices, paged whole frames through the page table, int8 scale frames
+  included), re-aimed device-to-device: the destination's jitted
+  donated row/frame write consumes the source fetch directly, and on
+  physical pagers the destination row's page table is rewritten to the
+  frames its own pager leased before the write lands
+  (:class:`FrameMigrator`).
+- **Pricing**: ``RecoveryPolicy.choose_migrate`` — transfer bytes over
+  the device link (``SimpleMachineModel.device_link_bandwidth``) vs
+  ``cached_len`` tokens of re-prefill on the decode slice.
+- **Scheduling**: the two-pool loop (:func:`run_disagg_loop`).
+  Admission gates against BOTH pools (a prefill row now and a decode
+  row at handoff), prefill chunks dispatch on the prefill slice while
+  the decode slice runs pure 1-token steps (fused into decode blocks),
+  and completed prefills hand off at FOLD BOUNDARIES only — the PR-10
+  invariant: never mid-dispatch, an in-flight batch's writes must
+  never be redirected.  Decode-side page pressure reuses the
+  ``PressureScheduler``/``preempt_request`` machinery; a preempted
+  request's host spill re-admits straight to the decode pool.
+
+Kill switch: ``FF_DISAGG=0`` makes :meth:`RequestManager.
+generate_disagg` fall back to the single-mesh incremental driver (the
+mixed-continuous A/B arm) without recompiling anything.
+
+Bit-exactness: KV depends only on token values and absolute positions
+(the prefix-cache argument), migration moves raw cache bytes, and the
+two slices hold identical weights — so greedy outputs match the
+single-mesh arms bit for bit (tests/test_disagg.py pins it, and
+``bench.py disagg`` asserts it per round).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional
+
+import jax
+import numpy as np
+
+from ..observability import (get_flight_recorder, get_ledger,
+                             get_registry, get_tracer)
+from .batch_config import BatchConfig, budgeted_chunk
+from .kv_pager import KVPager, RecoveryPolicy
+
+
+class SlicePool:
+    """One mesh slice of the disaggregated server: a compiled record
+    (``im``, ``model_id``) plus the slice's optional :class:`KVPager`
+    and the row-pool bookkeeping the two-pool scheduler needs.  The
+    pager, when physical, owns THIS slice's frame pool — per-slice
+    gauges key on its ``slice_label``."""
+
+    def __init__(self, im, model_id: int, pager: Optional[KVPager] = None,
+                 label: str = "slice"):
+        self.im = im
+        self.model_id = model_id
+        self.pager = pager
+        self.label = label
+        rec = im.models[model_id]
+        self.rows = int(rec["max_requests"])
+        if rec.get("paged"):
+            # the _check_paged_serving contract, per slice: a
+            # budget-sized pool's table is pager-FED — serving it
+            # without the matching physical pager would silently drop
+            # every write on the sentinel entries
+            if (rec["num_frames"] < rec["rows"] * rec["max_pages"]
+                    and (pager is None
+                         or pager.num_frames != rec["num_frames"])):
+                raise ValueError(
+                    f"{label} slice: model {model_id} has a "
+                    f"{rec['num_frames']}-frame pool smaller than its "
+                    f"worst case; serving it needs a KVPager("
+                    f"num_frames={rec['num_frames']})")
+
+    # ------------------------------------------------------------ leases
+    def push_tables(self) -> None:
+        """Publish this slice's physical leases to its record's page
+        table (the per-slice twin of RequestManager._push_tables)."""
+        pager = self.pager
+        if (pager is None or pager.num_frames is None
+                or not self.im.is_paged(self.model_id)):
+            return
+        rec = self.im.models[self.model_id]
+        self.im.set_page_table(
+            self.model_id,
+            pager.frame_table(rec["rows"], rec["max_pages"]))
+        self.im.note_leased_frames(self.model_id, pager.leased_pages)
+
+    def lease(self, row: int, length: int, guid: Optional[int]) -> bool:
+        if self.pager is None:
+            return True
+        ok = self.pager.lease(row, length, owner="req", guid=guid,
+                              force=True)
+        self.push_tables()
+        return ok
+
+    def release(self, row: int) -> None:
+        if self.pager is None:
+            return
+        self.pager.release(row)
+        self.push_tables()
+
+    def shortfall(self, length: int) -> int:
+        if self.pager is None:
+            return 0
+        return self.pager.shortfall(None, length)
+
+
+def _single_device(im, model_id: int):
+    """The one device a record's caches live on, or None when the
+    record is stage-partitioned / sharded over a submesh (the
+    device-to-device fast path needs a single concrete target)."""
+    rec = im.models[model_id]
+    if "pp_stages" in rec or not rec.get("caches"):
+        return None
+    arr = next(iter(next(iter(rec["caches"].values())).values()))
+    devs = getattr(arr.sharding, "device_set", None)
+    if devs is None or len(devs) != 1:
+        return None
+    return next(iter(devs))
+
+
+class FrameMigrator:
+    """Whole-request KV handoff between two slices' records.
+
+    Retargets the spill-transfer pair device-to-device: the source
+    slice's bucketed fetch (dense rows: pow2 length buckets; paged
+    records: pow2 whole-frame counts through the page table, f32
+    scale frames riding beside int8 K/V) feeds the destination
+    slice's donated row/frame write.  The destination row's pages are
+    leased — and its page table pushed — by the caller BEFORE
+    :meth:`migrate` runs, so the restore lands in the destination
+    pager's own frames.  Every handoff is counted
+    (``serving_migrations_total{decision}``,
+    ``serving_migration_bytes_total``, ``serving_migration_seconds``)
+    and landed on the request's ledger timeline as a ``migrate``
+    event.
+    """
+
+    def __init__(self, src: SlicePool, dst: SlicePool,
+                 policy: Optional[RecoveryPolicy] = None):
+        self.src = src
+        self.dst = dst
+        if policy is None:
+            policy = RecoveryPolicy.for_record(dst.im, dst.model_id)
+        self.policy = policy
+        self._validate()
+        # direct device-to-device transport: single-device slices
+        # (today's supported disagg shape) skip host staging entirely —
+        # the fetch keeps committed device arrays and jax.device_put
+        # lands them on the decode slice (ICI on TPU), which is what
+        # RecoveryPolicy.migrate_s's device-link term prices.
+        # Multi-device submesh slices fall back to the host-staged
+        # spill payload (two host-link crossings — the auto price is
+        # optimistic there until a sharded d2d transport lands).
+        self._dst_device = _single_device(dst.im, dst.model_id)
+        self._direct = (jax.process_count() == 1
+                        and self._dst_device is not None
+                        and _single_device(src.im, src.model_id)
+                        is not None)
+        self.bytes_per_token = max(
+            1, src.im.kv_cache_stats(src.model_id).bytes_per_token)
+        m = get_registry()
+        self._recorder = get_flight_recorder()
+        self._ledger = get_ledger()
+        self._tracer = get_tracer()
+        self._c_migrations = m.counter("serving_migrations_total")
+        self._c_bytes = m.counter("serving_migration_bytes_total")
+        self._h_seconds = m.histogram("serving_migration_seconds")
+        # lifetime odometers (the registry counters' local twins, so
+        # tests and bench read one migrator without a registry diff)
+        self.migrations = {"migrate": 0, "recompute": 0}
+        self.bytes_total = 0
+
+    def _validate(self) -> None:
+        """The transfer is a raw byte move — the two records must agree
+        on everything that gives those bytes meaning: layer set, cache
+        dtype, per-position shape, paged-ness and page length."""
+        a = self.src.im.models[self.src.model_id]
+        b = self.dst.im.models[self.dst.model_id]
+        ca, cb = a.get("caches") or {}, b.get("caches") or {}
+        if sorted(ca) != sorted(cb):
+            raise ValueError(
+                f"migration slices serve different models: "
+                f"{sorted(ca)} vs {sorted(cb)}")
+        if bool(a.get("paged")) != bool(b.get("paged")):
+            raise ValueError(
+                "migration between dense and paged layouts is not "
+                "supported — compile both slices with the same "
+                "kv_layout")
+        if a.get("paged") and a["page_len"] != b["page_len"]:
+            raise ValueError(
+                f"page_len mismatch across slices: {a['page_len']} vs "
+                f"{b['page_len']}")
+        for name, kv in ca.items():
+            for part, arr in kv.items():
+                other = cb[name][part]
+                if (arr.dtype != other.dtype
+                        or arr.shape[1:] != other.shape[1:]):
+                    raise ValueError(
+                        f"cache layout mismatch at {name}/{part}: "
+                        f"{arr.dtype}{arr.shape} vs "
+                        f"{other.dtype}{other.shape}")
+
+    # ------------------------------------------------------------ pricing
+    def estimate_bytes(self, length: int) -> int:
+        return int(length) * self.bytes_per_token
+
+    def decide(self, cached_len: int) -> str:
+        """"migrate" | "recompute" for a prefilled span about to leave
+        the prefill slice (RecoveryPolicy.choose_migrate over the
+        record's own byte estimate)."""
+        return self.policy.choose_migrate(
+            cached_len, self.estimate_bytes(cached_len))
+
+    # ----------------------------------------------------------- transfer
+    def migrate(self, guid: int, src_row: int, dst_row: int,
+                length: int) -> Dict[str, Any]:
+        """Move ``length`` committed KV positions from the source
+        slice's ``src_row`` into the destination slice's ``dst_row``.
+        The full span stays valid (no 16-align-down: nothing needs
+        re-prefill — the fetch bucket covers ``length`` and positions
+        past it are never attended before the decode scatter rewrites
+        them).  Returns ``{"bytes", "seconds"}``."""
+        t0 = time.monotonic()
+        payload = self.src.im.fetch_row(self.src.model_id, src_row,
+                                        length,
+                                        to_host=not self._direct)
+        assert payload is not None, (
+            "migrate: empty span", guid, src_row, length)
+        if self._direct:
+            # committed source arrays device_put straight onto the
+            # decode slice — no host materialization, no host sync
+            dev = self._dst_device
+            payload["layers"] = {
+                name: {part: jax.device_put(a, dev)
+                       for part, a in parts.items()}
+                for name, parts in payload["layers"].items()}
+        nbytes = self.dst.im.restore_row(self.dst.model_id, dst_row,
+                                         payload)
+        dt = time.monotonic() - t0
+        self.migrations["migrate"] += 1
+        self.bytes_total += nbytes
+        self._c_migrations.inc(decision="migrate")
+        self._c_bytes.inc(nbytes)
+        self._h_seconds.observe(dt)
+        self._note_handoff(guid, src_row, dst_row, length, "migrate",
+                        nbytes=nbytes, seconds=dt)
+        return {"bytes": nbytes, "seconds": dt}
+
+    def note_recompute(self, guid: int, src_row: int, dst_row: int,
+                       length: int) -> None:
+        """Count a handoff that chose re-prefill over transfer (the
+        other ``serving_migrations_total`` arm)."""
+        self.migrations["recompute"] += 1
+        self._c_migrations.inc(decision="recompute")
+        self._note_handoff(guid, src_row, dst_row, length, "recompute",
+                        nbytes=0, seconds=0.0)
+
+    def _note_handoff(self, guid: int, src_row: int, dst_row: int,
+                   length: int, decision: str, nbytes: int,
+                   seconds: float) -> None:
+        self._tracer.instant("migrate", guid=guid, src_row=src_row,
+                             dst_row=dst_row, tokens=length,
+                             decision=decision)
+        self._recorder.record_event("migrate", guid=guid,
+                                    src_row=src_row, dst_row=dst_row,
+                                    tokens=length, bytes=nbytes,
+                                    decision=decision)
+        self._ledger.note_event("migrate", guid=guid, src_row=src_row,
+                                dst_row=dst_row, tokens=length,
+                                bytes=nbytes, seconds=seconds,
+                                decision=decision)
+
+
+def migrate_into_pending(rm, src: SlicePool, src_row: int, req,
+                         dst_model_id: int, length: int) -> int:
+    """Cross-slice migration through the shared ADMISSION restore path:
+    fetch ``src_row``'s committed KV from the prefill slice and park it
+    in the decode manager's spill store keyed by the request's guid —
+    the next admission pass restores it into whatever row the request
+    lands in (16-aligned span; the unaligned tail re-prefills, exactly
+    like a preemption restore).  Because admission is the ONE path
+    every driver shares (``admit_pending``: incremental, host-spec AND
+    device-spec), this is how a prefill-slice handoff reaches the spec
+    drivers without a dedicated loop; the two-pool loop below uses the
+    direct row-to-row :meth:`FrameMigrator.migrate` instead (full-span
+    validity, no align-down tail).  Both records must share the cache
+    layout — :class:`FrameMigrator`'s validation applies.  Returns the
+    bytes parked."""
+    assert rm.kv_pager is not None, (
+        "migrate_into_pending needs the decode manager's KVPager — the "
+        "spill store is the handoff buffer")
+    payload = src.im.fetch_row(src.model_id, src_row, length)
+    if payload is None:
+        return 0
+    nbytes = int(payload["bytes"])
+    rm.kv_pager.store_spill(req.guid, {dst_model_id: payload},
+                            tokens=length, nbytes=nbytes)
+    m = get_registry()
+    m.counter("serving_migrations_total").inc(decision="migrate")
+    m.counter("serving_migration_bytes_total").inc(nbytes)
+    get_flight_recorder().record_event(
+        "migrate", guid=req.guid, src_row=src_row, tokens=length,
+        bytes=nbytes, decision="migrate")
+    get_ledger().note_event(
+        "migrate", guid=req.guid, src_row=src_row, tokens=length,
+        bytes=nbytes, decision="migrate")
+    return nbytes
+
+
+class _DisaggState:
+    """Loop-local state of one disaggregated serve."""
+
+    def __init__(self):
+        self.prefill_pool: Dict[int, Any] = {}   # prefill row -> Request
+        self.inflight: Optional[tuple] = None    # (bc, outs) to fold
+
+
+def _free_decode_rows(rm, dec: SlicePool) -> List[int]:
+    return [r for r in range(dec.rows) if r not in rm.running]
+
+
+def _drain_cancels(rm, pre: SlicePool, st: _DisaggState) -> int:
+    """The two-pool twin of RequestManager.drain_cancels: pending and
+    decode-pool cancels take the shared path; a request mid-prefill on
+    the prefill slice releases its prefill row here (it is in neither
+    ``running`` nor ``pending``, so the shared path cannot see it)."""
+    with rm._cancel_lock:
+        if not rm._cancel_box:
+            return 0
+        box = rm._cancel_box
+        rm._cancel_box = {}
+    n = 0
+    for guid, reason in box.items():
+        hit = next(((row, req) for row, req in st.prefill_pool.items()
+                    if req.guid == guid), None)
+        if hit is not None:
+            row, req = hit
+            del st.prefill_pool[row]
+            pre.release(row)
+            req.row = None
+            # hand the bookkeeping (status, counters, ledger, hooks)
+            # to the shared cancel path via a transient pending stint
+            rm.pending.appendleft(req)
+        n += bool(rm.cancel_request(guid, reason=reason))
+    return n
+
+
+def _admit(rm, pre: SlicePool, dec: SlicePool, st: _DisaggState) -> None:
+    """Two-pool admission: fresh requests take a prefill row now AND
+    reserve a decode row for their handoff (the both-pools gate);
+    preempted returnees with a parked spill go straight back to the
+    decode pool.  Blocks are counted once per (request, reason)
+    transition exactly like the single-pool path."""
+    pager = dec.pager
+    admission_preempted = False
+    while rm.pending:
+        req = rm.pending[0]
+        free_dec = _free_decode_rows(rm, dec)
+        # a preempted request's own spill beats everything: its
+        # prefill is done, it only needs a decode row + restore
+        spill = (pager.peek_spill(req.guid)
+                 if pager is not None else None)
+        forward = (not rm.running and not st.prefill_pool)
+        if spill is not None:
+            need = len(req.tokens) + rm._headroom_tokens()
+            if not free_dec or len(free_dec) <= len(st.prefill_pool):
+                rm._note_admission_blocked(req, "no_rows")
+                break
+            if pager.shortfall(None, need) and not forward:
+                rm._note_admission_blocked(req, "no_pages")
+                break
+            row = free_dec[0]
+            rm.pending.popleft()
+            _stamp_admit(rm, req, row)
+            rm.running[row] = req
+            if not pager.lease(row, need, owner="req", guid=req.guid,
+                               force=True):
+                pager.lease(row, len(req.tokens), owner="req",
+                            guid=req.guid, force=True)
+            rm._push_tables()
+            matched = rm._restore_spilled(dec.im, {dec.model_id: 1},
+                                          req, row)
+            req.cached_len = matched.get(dec.model_id, 0)
+            continue
+        # fresh request -> prefill pool, gated on BOTH pools
+        free_pre = [r for r in range(pre.rows)
+                    if r not in st.prefill_pool]
+        if not free_pre or len(free_dec) <= len(st.prefill_pool):
+            # decode-side pressure preemption: a TTFT-threatened head
+            # may evict the newest decode row (once per pass; the
+            # victim's spill re-admits through the branch above) —
+            # but ONLY when decode rows are the binding constraint
+            # (``free_pre`` non-empty): preempting cannot mint a
+            # prefill row, it would just spill+restore a bystander
+            # for nothing
+            wait = time.monotonic() - max(req.profile.start_mono,
+                                          req.profile.preempt_mono)
+            if (pager is not None and not admission_preempted
+                    and rm.running and free_pre
+                    and pager.scheduler.should_admit_preempt(wait)):
+                victim = pager.scheduler.pick_victim(
+                    rm.running, protect_guids=rm._protected_guids())
+                if victim is not None:
+                    rm.preempt_request(victim, reason="admission")
+                    admission_preempted = True
+                    continue
+            rm._note_admission_blocked(req, "no_rows")
+            break
+        if pre.shortfall(len(req.tokens)) and not forward:
+            rm._note_admission_blocked(req, "no_pages")
+            break
+        if (pager is not None and not forward
+                and pager.shortfall(None, len(req.tokens)
+                                    + rm._headroom_tokens())):
+            # the decode pool could not lease this request's handoff
+            # today — admitting it to prefill would strand a finished
+            # prefill with nowhere to go (admission gates BOTH pools)
+            rm._note_admission_blocked(req, "no_pages")
+            break
+        row = free_pre[0]
+        rm.pending.popleft()
+        _stamp_admit(rm, req, row)
+        st.prefill_pool[row] = req
+        pre.lease(row, len(req.tokens), guid=req.guid)
+    rm._m_queue_depth.set(len(rm.pending))
+    rm._m_active.set(len(rm.running) + len(st.prefill_pool))
+
+
+def _stamp_admit(rm, req, row: int) -> None:
+    req.status = req.RUNNING
+    req.row = row
+    req.cached_len = 0
+    req.blocked_reason = None
+    if req.profile.admit_mono == 0.0:
+        req.profile.admit_mono = time.monotonic()
+    rm._m_admitted.inc()
+    rm.tracer.instant("admit", guid=req.guid, row=row,
+                      prompt_len=req.prompt_len)
+    rm.recorder.record_event("admit", guid=req.guid, row=row,
+                             prompt_len=req.prompt_len)
+    rm.ledger.note_event("admit", guid=req.guid, row=row,
+                         prompt_len=req.prompt_len)
+
+
+def _prefill_bc(rm, pre: SlicePool, st: _DisaggState) -> BatchConfig:
+    spans = {row: len(req.tokens) - req.cached_len
+             for row, req in st.prefill_pool.items()}
+    chunk = budgeted_chunk(max(spans.values()), rm.max_tokens_per_batch,
+                           min_chunk=pre.im.min_prefill_chunk(
+                               pre.model_id))
+    bc = BatchConfig(pre.rows, chunk)
+    for row, req in st.prefill_pool.items():
+        bc.add_row(row, req.guid, req.cached_len,
+                   req.tokens[req.cached_len: req.cached_len + chunk],
+                   req.max_sequence_length)
+    if chunk > 1:
+        rm._m_prefill_chunk.observe(chunk)
+    return bc
+
+
+def _hand_off(rm, pre: SlicePool, dec: SlicePool, st: _DisaggState,
+              prow: int, req, migrator: FrameMigrator) -> None:
+    """Move a finished prefill to the decode pool at this fold
+    boundary: migrate its KV frames or drop them for re-prefill on the
+    decode slice, per the priced decision."""
+    drow = _free_decode_rows(rm, dec)[0]   # reserved by admission
+    decision = migrator.decide(req.cached_len)
+    pager = dec.pager
+    if decision == "migrate" and pager is not None:
+        # the destination row's frames must be in ITS pager's table
+        # before the restore lands; a frame-dry physical pool preempts
+        # at this boundary (no batch in flight), newest rows first
+        need = len(req.tokens) + rm._headroom_tokens()
+        while not pager.lease(drow, need, owner="req", guid=req.guid,
+                              force=True):
+            others = {r: q for r, q in rm.running.items()}
+            victim = pager.scheduler.pick_victim(
+                others, protect_guids=rm._protected_guids())
+            if victim is None:
+                decision = "recompute"
+                break
+            rm.preempt_request(victim, reason="pages")
+        rm._push_tables()
+    if decision == "migrate":
+        migrator.migrate(req.guid, prow, drow, req.cached_len)
+        req.profile.migrated_tokens += req.cached_len
+    else:
+        migrator.note_recompute(req.guid, prow, drow, req.cached_len)
+        req.profile.recomputed_tokens += req.cached_len
+        req.cached_len = 0
+        if pager is not None:
+            pager.lease(drow, len(req.tokens), owner="req",
+                        guid=req.guid, force=True)
+            rm._push_tables()
+    del st.prefill_pool[prow]
+    pre.release(prow)
+    req.row = drow
+    rm.running[drow] = req
+
+
+def _fold_prefill(rm, pre: SlicePool, dec: SlicePool, st: _DisaggState,
+                  bc: BatchConfig, outs, migrator: FrameMigrator,
+                  t_step: float) -> None:
+    """Fold one prefill-slice chunk: advance watermarks; rows that
+    completed their prompt sync their sampled first token and hand off
+    to the decode pool (the fold-boundary invariant — the dispatch
+    this folds is DONE, nothing in flight references the rows)."""
+    toks = None
+    if any(bc.request_available[row]
+           and rm._row_completes(req, int(bc.num_tokens_in_batch[row]))
+           for row, req in st.prefill_pool.items()):
+        toks = np.asarray(outs[0])
+        pre.im.note_host_sync()
+    committed = 0
+    for row in list(st.prefill_pool):
+        req = st.prefill_pool[row]
+        n = int(bc.num_tokens_in_batch[row])
+        if not bc.request_available[row] or n == 0:
+            continue
+        completes = rm._row_completes(req, n)
+        req.cached_len += n
+        req.profile.llm_decoding_steps += 1
+        rm.ledger.note_event("prefill-chunk", guid=req.guid, chunk=n,
+                             slice="prefill")
+        if not completes:
+            continue
+        tok = int(toks[row, n - 1])
+        req.tokens.append(tok)
+        committed += 1
+        req.profile.note_first_token()
+        rm.ledger.note_event("commit", guid=req.guid, tokens=1)
+        cb = rm.on_commit
+        if cb is not None:
+            cb(req, (tok,))
+        if rm._finished(req, tok):
+            # finished AT prefill (EOS first token / 1-token budget):
+            # retire through the shared path via the reserved decode
+            # row — no KV moves for a request that will never decode
+            drow = _free_decode_rows(rm, dec)[0]
+            del st.prefill_pool[row]
+            pre.release(row)
+            req.row = drow
+            rm.running[drow] = req
+            rm._retire(req)
+        else:
+            _hand_off(rm, pre, dec, st, row, req, migrator)
+    rm._note_step(t_step, committed)
+
+
+def _decode_pass(rm, dec: SlicePool, rng, decode_block: int) -> None:
+    """One decode-slice dispatch + fold: pure 1-token steps fused into
+    a decode block when every row is decoding; recompute rows (the
+    priced re-prefill arm, and preemption returnees' unaligned tails)
+    take a chunk-wide step."""
+    t_step = time.monotonic()
+    spans = {row: len(req.tokens) - req.cached_len
+             for row, req in rm.running.items()}
+    rm._m_occupancy.set(len(rm.running) / rm.max_requests_per_batch)
+    if all(s <= 1 for s in spans.values()):
+        k = budgeted_chunk(rm._max_remaining_budget(), decode_block)
+        # chunk-1 batch WITH token values: the block's first scan step
+        # consumes each row's pending token (init_tokens defaults to
+        # token_ids[:, 0] — _decode_only_bc's zeroed ids are only for
+        # the handoff path, which overrides them)
+        bc = BatchConfig(dec.rows, 1)
+        for row, req in rm.running.items():
+            bc.add_row(row, req.guid, req.cached_len,
+                       req.tokens[req.cached_len: req.cached_len + 1],
+                       req.max_sequence_length)
+        rm.pager_sync_leases(extra=k)
+        rm.recorder.record_event("decode-step", block=k,
+                                 rows=bc.num_active_requests())
+        rm.ledger.note_event("decode-step", block=k,
+                             rows=bc.num_active_requests())
+        with rm.tracer.span("decode-step", block=k,
+                            rows=bc.num_active_requests()):
+            toks = np.asarray(dec.im.decode_block(
+                dec.model_id, bc, k, rng,
+                min_remaining=rm._min_remaining_budget()))
+            dec.im.note_host_sync()
+        rm._note_step(t_step, rm._fold_decode_block(bc, toks))
+        return
+    # recompute arm: some decode-pool row is mid-(re)prefill
+    chunk = budgeted_chunk(max(spans.values()), rm.max_tokens_per_batch,
+                           min_chunk=dec.im.min_prefill_chunk(
+                               dec.model_id))
+    bc = BatchConfig(dec.rows, chunk)
+    for row, req in rm.running.items():
+        n = 1 if spans[row] <= 1 else min(spans[row], chunk)
+        bc.add_row(row, req.guid, req.cached_len,
+                   req.tokens[req.cached_len: req.cached_len + n],
+                   req.max_sequence_length, n=n)
+    if chunk > 1:
+        rm._m_prefill_chunk.observe(chunk)
+    rm.recorder.record_event("prefill-chunk", chunk=chunk,
+                             rows=bc.num_active_requests())
+    rm.ledger.note_event("prefill-chunk", chunk=chunk,
+                         rows=bc.num_active_requests())
+    with rm.tracer.span("prefill-chunk", chunk=chunk,
+                        rows=bc.num_active_requests()):
+        outs = dec.im.inference(dec.model_id, bc, rng=rng)
+    toks = None
+    if rm._any_prompt_completes(bc):
+        toks = np.asarray(outs[0])
+        dec.im.note_host_sync()
+    committed = 0
+    for row in list(rm.running):
+        req = rm.running[row]
+        n = int(bc.num_tokens_in_batch[row])
+        if n == 0:
+            continue
+        completes = rm._row_completes(req, n)
+        req.cached_len += n
+        req.profile.llm_decoding_steps += 1
+        if not completes:
+            continue
+        tok = int(toks[row, n - 1])
+        req.tokens.append(tok)
+        committed += 1
+        req.profile.note_first_token()
+        rm.ledger.note_event("commit", guid=req.guid, tokens=1)
+        cb = rm.on_commit
+        if cb is not None:
+            cb(req, (tok,))
+        if rm._finished(req, tok):
+            rm._retire(req)
+    rm._note_step(t_step, committed)
+
+
+def run_disagg_loop(rm, pre: SlicePool, dec: SlicePool, requests,
+                    seed: int = 0,
+                    migrator: Optional[FrameMigrator] = None,
+                    decode_block: Optional[int] = None):
+    """The two-pool scheduling loop.  Per iteration: admit (both-pool
+    gated), DISPATCH one prefill chunk on the prefill slice (async —
+    the host does not wait for it), run one decode block on the decode
+    slice, then fold the prefill chunk and hand completed prefills
+    across at that fold boundary.  JAX async dispatch overlaps the two
+    slices' compute; the host blocks only on the small sampled-token
+    arrays."""
+    assert rm.max_requests_per_batch == dec.rows, (
+        "the manager's batch size is the DECODE pool",
+        rm.max_requests_per_batch, dec.rows)
+    if dec.pager is not None:
+        assert rm.kv_pager is None or rm.kv_pager is dec.pager, (
+            "the manager's pager must be the decode slice's")
+        rm.kv_pager = dec.pager
+    if migrator is None:
+        migrator = FrameMigrator(pre, dec)
+    if decode_block is None:
+        decode_block = rm.decode_block
+    rng = jax.random.PRNGKey(seed)
+    st = _DisaggState()
+    # arm the shared helpers for the DECODE record: _headroom_tokens /
+    # _push_tables / pager_sync_leases / preempt spill all key off
+    # these (the prefill slice is SlicePool-managed)
+    rm._check_paged_serving(dec.im, {dec.model_id: 1})
+    rm._paged_ctx = (dec.im, {dec.model_id: 1})
+    rm._spill_ctx = (
+        (dec.im, {dec.model_id: 1})
+        if (dec.pager is not None
+            and dec.im.supports_kv_spill(dec.model_id)) else None)
+    rm._chunk_floor = dec.im.min_prefill_chunk(dec.model_id)
+    try:
+        with rm.heartbeat.driving("disagg-serve"):
+            while True:
+                _drain_cancels(rm, pre, st)
+                _admit(rm, pre, dec, st)
+                if not (rm.pending or st.prefill_pool or rm.running
+                        or st.inflight):
+                    break
+                if st.prefill_pool and st.inflight is None:
+                    bc_p = _prefill_bc(rm, pre, st)
+                    rng, r_pre = jax.random.split(rng)
+                    rm.recorder.record_event(
+                        "prefill-chunk", chunk=bc_p.chunk,
+                        rows=bc_p.num_active_requests())
+                    with rm.tracer.span("prefill-chunk",
+                                        chunk=bc_p.chunk,
+                                        rows=bc_p.num_active_requests()):
+                        outs = pre.im.inference(pre.model_id, bc_p,
+                                                rng=r_pre)
+                    st.inflight = (bc_p, outs)
+                if rm.running:
+                    rng, r_dec = jax.random.split(rng)
+                    _decode_pass(rm, dec, r_dec, decode_block)
+                if st.inflight is not None:
+                    bc_p, outs = st.inflight
+                    st.inflight = None
+                    # step clock stamps at FOLD entry, not dispatch:
+                    # the decode pass in between recorded its own
+                    # span, so the prefill fold observes only its
+                    # residual wall time (the wait for the overlapped
+                    # prefill to finish + the fold itself) — stamping
+                    # at dispatch would double-count the decode pass
+                    # in serving_step_seconds
+                    _fold_prefill(rm, pre, dec, st, bc_p, outs,
+                                  migrator, time.monotonic())
+                if rm.kv_pager is not None and rm.running:
+                    # fold-boundary true-up: decode-block growth was
+                    # force-booked mid-dispatch; repay it (preempting
+                    # newest rows) while no batch is in flight
+                    rm.pager_sync_leases(preempt=True)
+    finally:
+        rm._spill_ctx = None
+        rm._chunk_floor = 1
+    return [rm._result_of(r) for r in requests]
+
+
+# --------------------------------------------------------------- selftest
+def _selftest() -> int:
+    """Deterministic two-submesh CPU dryrun smoke (the run_tier1.sh
+    gate, MULTICHIP-harness style): a tiny LLaMA served disaggregated
+    across two virtual CPU devices must produce BIT-IDENTICAL greedy
+    tokens to the single-mesh incremental driver, with the migration
+    counters ticking and the two records genuinely living on different
+    devices.  Run via::
+
+        env JAX_PLATFORMS=cpu \\
+            XLA_FLAGS=--xla_force_host_platform_device_count=2 \\
+            python -m flexflow_tpu.serving.disagg --selftest
+    """
+    import jax as _jax
+
+    from .. import FFConfig, Model
+    from ..fftype import DataType
+    from ..models.llama import LLAMAConfig, create_llama_model
+    from .inference_manager import InferenceManager
+    from .request_manager import RequestManager
+
+    ok = True
+
+    def check(cond, msg):
+        nonlocal ok
+        if not cond:
+            ok = False
+            print(f"disagg selftest FAILED: {msg}")
+
+    devs = _jax.devices()
+    if len(devs) < 2:
+        print("disagg selftest SKIPPED: needs >= 2 devices "
+              "(XLA_FLAGS=--xla_force_host_platform_device_count=2)")
+        return 0
+
+    tiny = dict(vocab_size=128, hidden_size=64, intermediate_size=128,
+                num_hidden_layers=2, num_attention_heads=4,
+                num_key_value_heads=2, max_position_embeddings=512)
+
+    def build(devices):
+        cfg = LLAMAConfig(**tiny)
+        model = Model(FFConfig(devices=devices), name="disagg_selftest")
+        create_llama_model(model, cfg, max_requests=4,
+                           dtype=DataType.FLOAT)
+        model.params = model.init_params(_jax.random.PRNGKey(0))
+        return model
+
+    def compile_on(devices, max_requests=4):
+        model = build(devices)
+        im = InferenceManager(model.config)
+        mid = im.compile_model_and_allocate_buffer(
+            model, max_requests=max_requests, max_seq_length=256,
+            prefill_chunk=64, cache_dtype=np.float32)
+        return im, mid
+
+    im_pre, pmid = compile_on((devs[0],), max_requests=2)
+    im_dec, dmid = compile_on((devs[1],))
+
+    def cache_devices(im, mid):
+        arr = next(iter(next(iter(
+            im.models[mid]["caches"].values())).values()))
+        return set(arr.sharding.device_set)
+
+    p_dev = cache_devices(im_pre, pmid)
+    d_dev = cache_devices(im_dec, dmid)
+    check(p_dev and d_dev and not (p_dev & d_dev),
+          f"slices share a device: {p_dev} vs {d_dev}")
+
+    rng = np.random.default_rng(3)
+    prompts = [rng.integers(1, 127, n).tolist() for n in (24, 40, 9)]
+
+    rm = RequestManager(max_requests_per_batch=4,
+                        max_tokens_per_batch=64,
+                        max_sequence_length=256, decode_block=4)
+    reqs = [rm.register_new_request(list(p), max_new_tokens=12)
+            for p in prompts]
+    pre = SlicePool(im_pre, pmid, label="prefill")
+    dec = SlicePool(im_dec, dmid, label="decode")
+    mig = FrameMigrator(pre, dec, policy=RecoveryPolicy(
+        migrate_mode="migrate"))
+    outs = run_disagg_loop(rm, pre, dec, reqs, seed=0, migrator=mig)
+    check(len(outs) == 3 and all(r.output_tokens for r in outs),
+          "disagg serve produced no tokens")
+    check(mig.migrations["migrate"] == 3 and mig.bytes_total > 0,
+          f"expected 3 migrations, got {mig.migrations}")
+
+    # single-mesh reference on a THIRD record (decode device) — the
+    # parity oracle
+    im_ref, rmid = compile_on((devs[1],))
+    rm2 = RequestManager(max_requests_per_batch=4,
+                         max_tokens_per_batch=64,
+                         max_sequence_length=256, decode_block=4)
+    reqs2 = [rm2.register_new_request(list(p), max_new_tokens=12)
+             for p in prompts]
+    ref = rm2.generate_incr_decoding(im_ref, rmid, reqs2, seed=0)
+    check([r.output_tokens for r in outs]
+          == [r.output_tokens for r in ref],
+          "disagg tokens differ from the single-mesh driver")
+    if ok:
+        print("disagg selftest OK "
+              f"(3 requests migrated, {mig.bytes_total} bytes, "
+              f"parity exact)")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":  # pragma: no cover - CLI smoke entry
+    import sys
+
+    sys.exit(_selftest())

@@ -672,12 +672,24 @@ class InferenceManager:
             # HF-loaded) weights to the device once — numpy args to a
             # jitted step re-transfer on every call, which over a
             # network-attached chip costs more than the step itself;
-            # offloaded weights keep their memory kind
+            # offloaded weights keep their memory kind.  The committed
+            # device is the config's FIRST device: a config pinned to a
+            # device subset (disaggregated mesh slices, serving/
+            # disagg.py) must land its weights — and therefore every
+            # jitted step — on ITS slice, not wherever the process
+            # default points; for the default all-devices config this
+            # is the same device the uncommitted placement used.
+            # Multi-controller keeps the uncommitted feed contract
+            # (jax.devices() is global there; committing to a possibly
+            # remote device is illegal).
             fuse_qkv(model)
+            dev = (cfg.devices[0]
+                   if cfg.devices and jax.process_count() == 1 else None)
             model.params = {
                 ln: {pn: (v if getattr(getattr(v, "sharding", None),
                                        "memory_kind", None)
-                          not in (None, "device") else jax.device_put(v))
+                          not in (None, "device")
+                          else jax.device_put(v, dev))
                      for pn, v in lp.items()}
                 for ln, lp in model.params.items()}
 
@@ -713,6 +725,10 @@ class InferenceManager:
             cache_sharding = NamedSharding(mesh, spec)
             scale_sharding = NamedSharding(mesh,
                                            scale_pspec(cache_sharding.spec))
+        # single-device records commit the caches beside the weights
+        # (same slice-pinning rationale as the param commit above)
+        slice_dev = (cfg.devices[0] if mesh is None and cfg.devices
+                     and jax.process_count() == 1 else None)
         for layer in model.layers:
             if layer.op_type in SERVING_ATTENTION_OPS:
                 a = layer.attrs
@@ -732,6 +748,9 @@ class InferenceManager:
                 if cache_sharding is not None:
                     k = jax.device_put(k, cache_sharding)
                     v = jax.device_put(v, cache_sharding)
+                elif slice_dev is not None:
+                    k = jax.device_put(k, slice_dev)
+                    v = jax.device_put(v, slice_dev)
                 caches[layer.name] = {"k": k, "v": v}
                 if kv_quantized:
                     # f32 per-row-per-position-per-head scales beside the
@@ -741,6 +760,8 @@ class InferenceManager:
                         s = jnp.zeros(shape[:3], jnp.float32)
                         if scale_sharding is not None:
                             s = jax.device_put(s, scale_sharding)
+                        elif slice_dev is not None:
+                            s = jax.device_put(s, slice_dev)
                         caches[layer.name][part] = s
 
         mid = model_id if model_id is not None else len(self.models)
@@ -1519,9 +1540,13 @@ class InferenceManager:
 
         return jax.jit(restore, donate_argnums=(0,))
 
-    def _fetch_row_paged(self, record, row: int, length: int):
+    def _fetch_row_paged(self, record, row: int, length: int,
+                         to_host: bool = True):
         """Whole-frame spill fetch: the row's leased frames (from the
-        page table) materialize to host in one bucketed transfer."""
+        page table) materialize in one bucketed transfer — to host
+        numpy for spills, or as committed device arrays
+        (``to_host=False``, no host sync) for the disaggregated
+        device-to-device handoff."""
         page_len = record["page_len"]
         pages = -(-int(length) // page_len)
         P = self._pow2_pages(pages, record["max_pages"])
@@ -1532,11 +1557,12 @@ class InferenceManager:
             record["steps"][key] = self._build_fetch_frames(record, P)
         seg = _retry_transient(record["steps"][key], record["caches"],
                                _feed_array(frames, jnp.int32))
-        host = jax.tree.map(np.asarray, jax.device_get(seg))
-        self.note_host_sync()
-        nbytes = sum(int(a.nbytes) for lp in host.values()
+        if to_host:
+            seg = jax.tree.map(np.asarray, jax.device_get(seg))
+            self.note_host_sync()
+        nbytes = sum(int(a.nbytes) for lp in seg.values()
                      for a in lp.values())
-        return {"layers": host, "len": P * page_len,
+        return {"layers": seg, "len": P * page_len,
                 "valid": int(length), "bytes": nbytes, "paged": True,
                 "pages": pages}
 
@@ -1697,8 +1723,8 @@ class InferenceManager:
 
         return jax.jit(restore, donate_argnums=(0,))
 
-    def fetch_row(self, model_id: int, row: int, length: int
-                  ) -> Optional[Dict[str, Any]]:
+    def fetch_row(self, model_id: int, row: int, length: int,
+                  to_host: bool = True) -> Optional[Dict[str, Any]]:
         """Materialize cache row ``row``'s first ``length`` positions to
         host numpy for every serving-attention layer (the spill half of
         the KV pager).  The fetched span is the pow2 BUCKET covering
@@ -1711,25 +1737,33 @@ class InferenceManager:
         Paged records move WHOLE FRAMES through the row's page table
         (pow2-bucketed frame counts, payload tagged ``paged``);
         stage-partitioned (pp) records move per-stage row slices.
-        One transfer batch per device assignment."""
+        One transfer batch per device assignment.
+
+        ``to_host=False`` (dense + paged records; the disaggregated
+        FrameMigrator's device-to-device fast path) skips the host
+        materialization AND the host sync: the payload carries the
+        bucketed slice as committed DEVICE arrays for the caller to
+        ``jax.device_put`` onto the destination slice — no host
+        staging, nothing blocks."""
         record = self.models[model_id]
         if length <= 0 or not record.get("caches"):
             return None
         if "pp_stages" in record:
             return self._fetch_row_pp(record, row, length)
         if record.get("paged"):
-            return self._fetch_row_paged(record, row, length)
+            return self._fetch_row_paged(record, row, length, to_host)
         L = pow2_bucket(length, record["alloc_len"]) or record["alloc_len"]
         key = ("fetch_row", L)
         if key not in record["steps"]:
             record["steps"][key] = self._build_fetch_row(record, L)
         seg = _retry_transient(record["steps"][key], record["caches"],
                                _feed_array(np.int32(row)))
-        host = jax.tree.map(np.asarray, jax.device_get(seg))
-        self.note_host_sync()
-        nbytes = sum(int(a.nbytes) for lp in host.values()
+        if to_host:
+            seg = jax.tree.map(np.asarray, jax.device_get(seg))
+            self.note_host_sync()
+        nbytes = sum(int(a.nbytes) for lp in seg.values()
                      for a in lp.values())
-        return {"layers": host, "len": L, "valid": int(length),
+        return {"layers": seg, "len": L, "valid": int(length),
                 "bytes": nbytes}
 
     def restore_row(self, model_id: int, row: int,

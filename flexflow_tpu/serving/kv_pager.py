@@ -126,9 +126,19 @@ class RecoveryPolicy:
       weight_bytes/hbm_bandwidth)`` per token — prefill streams the
       weights once per chunk, so the per-token weight stream divides
       by ``chunk``.
+    - migrate cost = spilled bytes / ``device_bandwidth`` (the direct
+      device-to-device link, ``MachineModel.device_link_bandwidth``):
+      single-device slices transfer committed device arrays via
+      jax.device_put without host staging (FrameMigrator's direct
+      path), which is what this term prices — distinct from restore's
+      host link.  Sharded submesh slices fall back to the host-staged
+      spill payload, where this price is optimistic (two host-link
+      crossings) until a sharded d2d transport lands.
 
     ``mode``: "auto" prices per decision; "restore"/"recompute" pin it
-    (tests and the bench A/B arms use the pins).
+    (tests and the bench A/B arms use the pins).  ``migrate_mode``
+    plays the same role for the disaggregated migrate-vs-recompute
+    decision ("auto" | "migrate" | "recompute").
     """
 
     def __init__(self, machine=None, flops_per_token: float = 0.0,
@@ -136,12 +146,16 @@ class RecoveryPolicy:
                  kv_bytes_per_token: float = 0.0,
                  prefill_chunk: int = 256,
                  host_bandwidth: Optional[float] = None,
-                 mode: str = "auto"):
+                 mode: str = "auto",
+                 device_bandwidth: Optional[float] = None,
+                 migrate_mode: str = "auto"):
         if machine is None:
             from ..search.cost_model import SimpleMachineModel
 
             machine = SimpleMachineModel(1)
         assert mode in ("auto", "restore", "recompute"), mode
+        assert migrate_mode in ("auto", "migrate", "recompute"), \
+            migrate_mode
         self.machine = machine
         self.flops_per_token = float(flops_per_token)
         self.weight_bytes = float(weight_bytes)
@@ -149,10 +163,21 @@ class RecoveryPolicy:
         self.prefill_chunk = max(1, int(prefill_chunk))
         self.host_bandwidth = float(host_bandwidth
                                     or machine.dcn_bandwidth)
+        self.device_bandwidth = float(
+            device_bandwidth
+            or getattr(machine, "device_link_bandwidth", None)
+            or machine.ici_bandwidth)
         self.mode = mode
+        self.migrate_mode = migrate_mode
 
     def restore_s(self, nbytes: int) -> float:
         return float(nbytes) / self.host_bandwidth
+
+    def migrate_s(self, nbytes: int) -> float:
+        """Whole-payload device-to-device transfer time over the
+        migration link (+ one link latency)."""
+        return (float(nbytes) / self.device_bandwidth
+                + self.machine.ici_latency)
 
     def recompute_s(self, cached_len: int) -> float:
         per_tok = max(
@@ -171,10 +196,24 @@ class RecoveryPolicy:
         return ("restore" if self.restore_s(nbytes)
                 <= self.recompute_s(cached_len) else "recompute")
 
+    def choose_migrate(self, cached_len: int, nbytes: int) -> str:
+        """"migrate" | "recompute" for a prefilled span of
+        ``cached_len`` KV positions (``nbytes`` of cache bytes) whose
+        request is leaving the prefill slice: ship the frames over the
+        device link, or re-prefill on the decode slice (the
+        DistServe-style transfer-vs-recompute decision)."""
+        if self.migrate_mode != "auto":
+            return self.migrate_mode
+        if nbytes <= 0 or cached_len <= 0:
+            return "recompute"
+        return ("migrate" if self.migrate_s(nbytes)
+                <= self.recompute_s(cached_len) else "recompute")
+
     @classmethod
     def for_record(cls, im, model_id: int, machine=None,
                    mode: str = "auto",
-                   host_bandwidth: Optional[float] = None
+                   host_bandwidth: Optional[float] = None,
+                   migrate_mode: str = "auto"
                    ) -> "RecoveryPolicy":
         """Policy parameterized from a compiled record: decode flops ~
         2 * params per token, weight stream = param bytes, KV stream
@@ -187,7 +226,8 @@ class RecoveryPolicy:
                    weight_bytes=n_params["bytes"],
                    kv_bytes_per_token=stats.bytes_per_token,
                    prefill_chunk=record.get("prefill_chunk", 256),
-                   host_bandwidth=host_bandwidth, mode=mode)
+                   host_bandwidth=host_bandwidth, mode=mode,
+                   migrate_mode=migrate_mode)
 
 
 class PressureScheduler:
@@ -268,7 +308,8 @@ class KVPager:
                  bytes_per_token: int = 0,
                  host_budget_bytes: Optional[int] = None,
                  num_frames: Optional[int] = None,
-                 frame_order: Optional[List[int]] = None):
+                 frame_order: Optional[List[int]] = None,
+                 slice_label: Optional[str] = None):
         if page_len % PAGE_ALIGN:
             raise ValueError(
                 f"page_len={page_len} must be a multiple of {PAGE_ALIGN} "
@@ -324,6 +365,14 @@ class KVPager:
         # mid-lease() when the signal lands, a plain Lock would
         # self-deadlock the dump (the PR-6 lock-discipline class)
         self._lock = threading.RLock()
+        #: disaggregated serving (serving/disagg.py) runs one pager per
+        #: mesh slice — the label keys this pager's gauge series (e.g.
+        #: {slice="prefill"} vs {slice="decode"}) and rides snapshots
+        #: so ffstat's stall diagnosis prints per-slice frame gauges.
+        #: None keeps the unlabeled single-pool series (bit-identical
+        #: to the pre-disagg exposition).
+        self.slice_label = slice_label
+        self._slice_kw = ({"slice": slice_label} if slice_label else {})
         m = get_registry()
         self._recorder = get_flight_recorder()
         self._g_pages_total = m.gauge("serving_kv_pages_total")
@@ -334,11 +383,12 @@ class KVPager:
         self._c_restore = m.counter("serving_kv_restore_bytes_total")
         self._c_preempt = m.counter("serving_preemptions_total")
         self._c_shared = m.counter("serving_prefix_frames_shared_total")
-        self._g_pages_total.set(self.total_pages)
-        self._g_pages_free.set(self.total_pages)
+        self._g_pages_total.set(self.total_pages, **self._slice_kw)
+        self._g_pages_free.set(self.total_pages, **self._slice_kw)
         if self.num_frames is not None:
-            self._g_frames_total.set(self.num_frames)
-            self._g_frames_free.set(len(self._free_frames))
+            self._g_frames_total.set(self.num_frames, **self._slice_kw)
+            self._g_frames_free.set(len(self._free_frames),
+                                    **self._slice_kw)
         _LIVE_PAGERS.add(self)
 
     # ------------------------------------------------------------ leases
@@ -433,9 +483,11 @@ class KVPager:
     def _set_free_gauges(self) -> None:
         with self._lock:
             self._g_pages_free.set(
-                max(0, self.total_pages - self.leased_pages))
+                max(0, self.total_pages - self.leased_pages),
+                **self._slice_kw)
             if self.num_frames is not None:
-                self._g_frames_free.set(len(self._free_frames))
+                self._g_frames_free.set(len(self._free_frames),
+                                        **self._slice_kw)
 
     def release(self, slot: int) -> int:
         """Free a slot's pages; returns the page count released."""
@@ -585,6 +637,7 @@ class KVPager:
         budget, per-slot leases, spilled GUIDs and the odometers."""
         with self._lock:
             return {
+                "slice": self.slice_label,
                 "page_len": self.page_len,
                 "total_pages": self.total_pages,
                 "leased_pages": self.leased_pages,
@@ -644,7 +697,9 @@ def pager_for_budget(budget_bytes: int, bytes_per_token: int,
 def pager_for_record(im, model_id: int, mode: str = "auto",
                      scheduler: Optional[PressureScheduler] = None,
                      host_budget_bytes: Optional[int] = None,
-                     total_pages: Optional[int] = None) -> KVPager:
+                     total_pages: Optional[int] = None,
+                     slice_label: Optional[str] = None,
+                     migrate_mode: str = "auto") -> KVPager:
     """The PHYSICAL pager matching a paged record: owns the record's
     ``num_frames`` concrete frame ids (budget == the allocated pool
     unless ``total_pages`` caps it lower), with the byte accounting
@@ -659,8 +714,10 @@ def pager_for_record(im, model_id: int, mode: str = "auto",
         page_len=record["page_len"],
         num_frames=record["num_frames"],
         bytes_per_token=im.kv_cache_stats(model_id).bytes_per_token,
-        policy=RecoveryPolicy.for_record(im, model_id, mode=mode),
-        scheduler=scheduler, host_budget_bytes=host_budget_bytes)
+        policy=RecoveryPolicy.for_record(im, model_id, mode=mode,
+                                         migrate_mode=migrate_mode),
+        scheduler=scheduler, host_budget_bytes=host_budget_bytes,
+        slice_label=slice_label)
 
 
 def _selftest() -> int:
@@ -710,6 +767,18 @@ def _selftest() -> int:
           "huge spill vs short recompute must recompute")
     check(RecoveryPolicy(mode="recompute").choose(4096, 64)
           == "recompute", "pinned mode wins")
+    # the migrate arm (disaggregated prefill->decode): the device link
+    # is faster than the host link, so a payload that would lose as a
+    # host restore can still win as a device-to-device migration
+    check(pol.choose_migrate(4096, 64) == "migrate",
+          "tiny payload vs long recompute must migrate")
+    check(pol.choose_migrate(16, 10 ** 13) == "recompute",
+          "huge payload vs short recompute must recompute")
+    check(pol.migrate_s(10 ** 6) < pol.restore_s(10 ** 6),
+          "device link must price below the host link by default")
+    check(RecoveryPolicy(migrate_mode="recompute")
+          .choose_migrate(4096, 64) == "recompute",
+          "pinned migrate_mode wins")
     snap = p.snapshot()
     check(snap["total_pages"] == 8 and snap["leases"][0]["slot"] == 0,
           "snapshot shape")

@@ -94,6 +94,10 @@ class ProfileInfo:
     preemptions: int = 0
     restored_tokens: int = 0
     recomputed_tokens: int = 0
+    # disaggregated serving (serving/disagg.py): KV positions carried
+    # from the prefill slice to the decode slice by frame migration
+    # (a recompute handoff counts under recomputed_tokens instead)
+    migrated_tokens: int = 0
     # monotonic stamp of the LAST preemption: the pressure scheduler's
     # queue-wait clock restarts here, so a freshly preempted request
     # cannot immediately counter-preempt its replacement (thrash guard)
@@ -1302,15 +1306,10 @@ class RequestManager:
 
         bc = BatchConfig(self.max_requests_per_batch, chunk)
         for row, req in self.running.items():
-            remaining = len(req.tokens) - req.cached_len
-            n = min(remaining, chunk)
-            span = req.tokens[req.cached_len: req.cached_len + n]
-            bc.request_guid[row] = req.guid
-            bc.first_token_depth[row] = req.cached_len
-            bc.num_tokens_in_batch[row] = n
-            bc.max_sequence_length[row] = req.max_sequence_length
-            bc.request_available[row] = True
-            bc.token_ids[row, :n] = span
+            n = min(len(req.tokens) - req.cached_len, chunk)
+            bc.add_row(row, req.guid, req.cached_len,
+                       req.tokens[req.cached_len: req.cached_len + n],
+                       req.max_sequence_length, n=n)
         return bc
 
     # -------------------------------------------------------- hybrid step
@@ -1343,15 +1342,11 @@ class RequestManager:
         for row, req in self.running.items():
             rider = spans[row] > 1
             n = min(spans[row], chunk) if rider else 1
-            bc.request_guid[row] = req.guid
-            bc.first_token_depth[row] = req.cached_len
-            bc.num_tokens_in_batch[row] = n
-            bc.max_sequence_length[row] = req.max_sequence_length
-            bc.request_available[row] = True
+            bc.add_row(row, req.guid, req.cached_len,
+                       req.tokens[req.cached_len: req.cached_len + n],
+                       req.max_sequence_length, n=n)
             bc.row_role[row] = (bc.ROLE_RIDER if rider
                                 else bc.ROLE_DECODE)
-            bc.token_ids[row, :n] = req.tokens[req.cached_len:
-                                               req.cached_len + n]
         return bc
 
     def _fold_hybrid(self, bc: HybridBatchConfig, toks: np.ndarray) -> int:
@@ -1471,11 +1466,8 @@ class RequestManager:
         them)."""
         bc = BatchConfig(self.max_requests_per_batch, 1)
         for row, req in self.running.items():
-            bc.request_guid[row] = req.guid
-            bc.first_token_depth[row] = req.cached_len
-            bc.num_tokens_in_batch[row] = 1
-            bc.max_sequence_length[row] = req.max_sequence_length
-            bc.request_available[row] = True
+            bc.add_row(row, req.guid, req.cached_len, [],
+                       req.max_sequence_length, n=1)
         return bc
 
     def generate_incr_decoding(self, im: InferenceManager, model_id: int,
@@ -1735,6 +1727,41 @@ class RequestManager:
         im.note_host_sync()
         return self._fold_decode_block(bc2, toks, handoff=True)
 
+    # ------------------------------------------------- disaggregated serve
+    def generate_disagg(self, prefill_im: InferenceManager,
+                        prefill_model_id: int, im: InferenceManager,
+                        model_id: int, requests: Sequence[Request],
+                        seed: int = 0, migrator=None,
+                        prefill_pager: Optional[KVPager] = None,
+                        decode_block: Optional[int] = None
+                        ) -> List[GenerationResult]:
+        """Disaggregated prefill/decode driver (serving/disagg.py —
+        ROADMAP "Disaggregated prefill/decode over the frame pool"):
+        prefill chunks dispatch on the PREFILL slice's record, the
+        decode slice runs pure 1-token steps, and finished prefills
+        hand their KV across at fold boundaries — migrated whole-frame
+        over the device link or re-prefilled on the decode slice, per
+        ``RecoveryPolicy.choose_migrate``.  This manager's row pool is
+        the DECODE pool (``max_requests_per_batch`` must equal the
+        decode record's rows); its ``kv_pager`` is the decode slice's.
+
+        ``FF_DISAGG=0`` (the A/B kill switch) falls back to the
+        single-mesh incremental driver on the decode record — the
+        mixed-continuous arm, no recompile."""
+        if os.environ.get("FF_DISAGG", "1") == "0":
+            return self.generate_incr_decoding(
+                im, model_id, requests, seed=seed,
+                decode_block=decode_block)
+        from .disagg import SlicePool, run_disagg_loop
+
+        pre = SlicePool(prefill_im, prefill_model_id,
+                        pager=prefill_pager, label="prefill")
+        dec = SlicePool(im, model_id, pager=self.kv_pager,
+                        label="decode")
+        return run_disagg_loop(self, pre, dec, requests, seed=seed,
+                               migrator=migrator,
+                               decode_block=decode_block)
+
     def generate(self, im: InferenceManager, model_id: int,
                  prompts: Sequence[str], max_new_tokens: int = 128,
                  seed: int = 0) -> List[GenerationResult]:
@@ -1765,6 +1792,7 @@ class RequestManager:
                     "speculated_tokens": p.speculated_tokens,
                     "accepted_tokens": p.accepted_tokens,
                     "prefix_matched_tokens": p.prefix_matched_tokens,
+                    "migrated_tokens": p.migrated_tokens,
                     # wall-clock admission stamp for log correlation;
                     # deltas are monotonic-clock (NTP-jump immune)
                     "start_time_unix": p.start_time,

@@ -227,11 +227,20 @@ def _ssm_prefill(rm, im, ssm_id, states, running, beam_width, seed_rng):
 
 def spec_model_rows(rm, im, llm_id: int) -> Optional[Dict[int, int]]:
     """model_id -> cache-row multiplier map for prefix-aware admission
-    (RequestManager.admit_pending), or None when the prefix cache is off
-    or the LLM record cannot host the row copy.  The LLM comes first
-    (the primary model — its match seeds ``req.cached_len``); each SSM's
-    beam-row 0 lives at slot * beam_width."""
-    if rm.prefix_cache is None or not im.supports_prefix_cache(llm_id):
+    (RequestManager.admit_pending), or None when admission has nothing
+    to copy in: no prefix cache AND no parked spill payloads (the
+    admission restore door — how a cross-slice migration's fetched KV
+    reaches a spec serve, serving/disagg.migrate_into_pending — maps
+    payload model ids to cache rows through this same map; preempted
+    SPEC rows never park one, they recompute, so the pager's spill
+    store can only be non-empty here when a migration seeded it before
+    the serve) or the LLM record cannot host the row copy.  The LLM
+    comes first (the primary model — its match seeds
+    ``req.cached_len``); each SSM's beam-row 0 lives at
+    slot * beam_width."""
+    has_spill = rm.kv_pager is not None and bool(rm.kv_pager.spilled)
+    if ((rm.prefix_cache is None and not has_spill)
+            or not im.supports_prefix_cache(llm_id)):
         return None
     rows = {llm_id: 1}
     for sid in rm.ssm_model_ids:

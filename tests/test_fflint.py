@@ -796,6 +796,46 @@ class TestMetricSchemaRule:
         assert at(fs, "metric-schema", 3), fs
         assert len(fs) == 2
 
+    def test_disagg_names_covered_by_real_schema(self, tmp_path):
+        # the disaggregated-serving vocabulary validates against the
+        # CHECKED-IN schema (baseline stays EMPTY): the migration
+        # counters, the transfer-latency histogram and the migrate
+        # event are all declared; rogue siblings are still flagged
+        src = """\
+            def wire(m, rec, ledger):
+                a = m.counter("serving_migrations_total")
+                b = m.counter("serving_migration_bytes_total")
+                c = m.histogram("serving_migration_seconds")
+                rec.record_event("migrate", guid=1, src_row=0,
+                                 dst_row=2, tokens=64, bytes=32768,
+                                 decision="migrate")
+                ledger.note_event("migrate", guid=1, src_row=0,
+                                  dst_row=2, tokens=64, bytes=32768,
+                                  seconds=0.002, decision="migrate")
+                return a, b, c
+            """
+        path = tmp_path / "serving" / "disagg_fixture.py"
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_text(textwrap.dedent(src))
+        ctx = LintContext(repo_root=REPO)   # exec-loads the real schema
+        fs = lint_file(str(path), self.R, ctx,
+                       rel="serving/disagg_fixture.py",
+                       judge_suppressions=True)
+        assert fs == []
+        rogue = tmp_path / "serving" / "disagg_rogue.py"
+        rogue.write_text(textwrap.dedent("""\
+            def wire(m, rec):
+                m.counter("serving_migration_seconds")
+                rec.record_event("migrated")
+            """))
+        fs = lint_file(str(rogue), self.R, ctx,
+                       rel="serving/disagg_rogue.py",
+                       judge_suppressions=True)
+        # histogram declared as counter spelling flagged; rogue event
+        assert at(fs, "metric-schema", 2), fs
+        assert at(fs, "metric-schema", 3), fs
+        assert len(fs) == 2
+
 
 # --------------------------------------------------- direct host sync
 class TestDirectHostSyncRule:

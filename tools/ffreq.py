@@ -179,6 +179,41 @@ def _rider_events(t: Dict[str, Any]) -> List[Dict[str, Any]]:
             if ev.get("name") == "prefill-chunk" and ev.get("rider")]
 
 
+def _prefill_slice_events(t: Dict[str, Any]) -> List[Dict[str, Any]]:
+    """This request's ``prefill-chunk`` events that ran on the PREFILL
+    slice of a disaggregated serve (ledger notes tagged
+    ``slice="prefill"`` by serving/disagg.py)."""
+    return [ev for ev in t.get("events") or []
+            if ev.get("name") == "prefill-chunk"
+            and ev.get("slice") == "prefill"]
+
+
+def migrate_spans(t: Dict[str, Any]) -> List[str]:
+    """Disaggregated-serving handoff spans: the request's prefill ran
+    on the prefill slice, then its KV crossed to the decode slice —
+    rendered as one prefill-slice -> transfer -> decode-slice line per
+    ``migrate`` event, with the transfer's size/cost (or the recompute
+    decision) spelled out so a victim's TTFT decomposes into its
+    slices."""
+    out: List[str] = []
+    chunks = _prefill_slice_events(t)
+    for ev in (t.get("events") or []):
+        if ev.get("name") != "migrate":
+            continue
+        decision = ev.get("decision")
+        if decision == "migrate":
+            cost = (f"{ev.get('bytes', 0)}B in "
+                    f"{(ev.get('seconds') or 0.0) * 1e3:.1f}ms")
+        else:
+            cost = "recompute (decode slice re-prefills)"
+        out.append(
+            f"  prefill-slice ({len(chunks)} chunk(s), "
+            f"{ev.get('tokens')}tok, row {ev.get('src_row')}) -> "
+            f"transfer [{cost}] -> decode-slice row "
+            f"{ev.get('dst_row', '?')}")
+    return out
+
+
 def rider_spans(t: Dict[str, Any]) -> List[str]:
     """Rider-chunk spans (stall-free hybrid steps): ``prefill-chunk``
     events with ``rider=True`` are this request's prefill slices that
@@ -307,6 +342,11 @@ def timeline_view(t: Dict[str, Any]) -> str:
         lines.append(f"prefill rode {len(riders)} hybrid decode "
                      f"dispatches ({tok} tokens as rider chunks):")
         lines.extend(riders)
+    migs = migrate_spans(t)
+    if migs:
+        lines.append("disaggregated serve (prefill and decode on "
+                     "separate mesh slices):")
+        lines.extend(migs)
     if t.get("events_dropped"):
         lines.append(f"({t['events_dropped']} early events dropped from "
                      f"the per-request ring)")
@@ -423,6 +463,14 @@ def selftest() -> int:
                            decode_rows=1, rider_tokens=16)
             led.note_event("prefill-chunk", guid=guid, chunk=16,
                            rider=True)
+        if guid == 1:
+            # a disaggregated handoff — the migrate-span rendering
+            # path (prefill-slice -> transfer -> decode-slice)
+            led.note_event("prefill-chunk", guid=guid, chunk=64,
+                           slice="prefill")
+            led.note_event("migrate", guid=guid, src_row=0, dst_row=2,
+                           tokens=64, bytes=32768, seconds=0.002,
+                           decision="migrate")
         led.note_event("commit", guid=guid, tokens=1)
         led.note_event("decode-step", block=4, rows=1)
         led.note_event("commit", guid=guid, tokens=4)
@@ -463,7 +511,10 @@ def selftest() -> int:
           and trc == 0 and "route -> http://r1" in report
           and report.count("\n") >= 4        # header + 2 hops + route
           and rider_spans(led.timeline(2))
-          and not rider_spans(led.timeline(1)))
+          and not rider_spans(led.timeline(1))
+          and migrate_spans(led.timeline(1))
+          and "transfer [32768B" in migrate_spans(led.timeline(1))[0]
+          and not migrate_spans(led.timeline(2)))
     print(f"\nffreq selftest {'OK' if ok else 'FAILED: ' + str(errs)}: "
           f"{path}")
     return 0 if ok else 1

@@ -231,9 +231,17 @@ def diagnosis(doc: Dict[str, Any],
             if not isinstance(p, dict):
                 continue
             spilled = p.get("spilled_guids") or {}
+            # disaggregated serves run one pager per mesh slice — name
+            # the slice and its frame gauges so a stalled two-slice
+            # serve shows WHICH pool ran dry
+            tag = (f"[{p['slice']}]" if p.get("slice") else "")
+            frames = ""
+            if p.get("num_frames") is not None:
+                frames = (f", frames {p.get('free_frames')}/"
+                          f"{p.get('num_frames')} free")
             lines.append(
-                f"kv pager: pages {p.get('free_pages')}/"
-                f"{p.get('total_pages')} free "
+                f"kv pager{tag}: pages {p.get('free_pages')}/"
+                f"{p.get('total_pages')} free{frames} "
                 f"(page_len {p.get('page_len')}, "
                 f"{len(p.get('leases') or [])} leased slots, "
                 f"overcommit {p.get('overcommitted_pages', 0)}); "

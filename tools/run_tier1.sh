@@ -76,6 +76,18 @@
     "tests/test_hybrid.py::TestHybridParity::test_mixed_from_admission_parity" \
     >/dev/null) \
  || { echo "hybrid-step parity smoke FAILED" >&2; exit 1; }
+# Disaggregated-serving smoke: a deterministic two-submesh CPU dryrun
+# (MULTICHIP-harness style — two virtual CPU devices, one per slice):
+# a tiny model served with prefill and decode on SEPARATE devices must
+# produce bit-identical greedy tokens to the single-mesh driver, with
+# the KV frames genuinely migrating between the slices' records — so a
+# broken migration/two-pool-scheduling path fails CI before a BENCH
+# `disagg` round (or real two-slice serving) depends on it.
+(cd "$(dirname "$0")/.." \
+ && env JAX_PLATFORMS=cpu \
+    XLA_FLAGS="--xla_force_host_platform_device_count=2" \
+    python -m flexflow_tpu.serving.disagg --selftest >/dev/null) \
+ || { echo "disagg two-submesh selftest FAILED" >&2; exit 1; }
 # KV-pager smoke: pure-host allocator accounting (lease/release/refs,
 # page-alignment validation, spill-store budgeting, restore-vs-
 # recompute pricing) so a broken pager fails CI in milliseconds before
