@@ -3285,6 +3285,33 @@ def _slo_summary():
     return out
 
 
+def _devprof_summary():
+    """Device-profiling stamp for the round record: the CompileReports
+    of every record compiled this round (XLA FLOPs / HBM bytes per
+    compiled step variant) plus the measured-vs-predicted drift table
+    from any sampled dispatch timings (FF_DEVPROF_SAMPLE=N arms the
+    sampler) — BENCH chip rounds carry measured-vs-predicted evidence
+    automatically; tools/ffprof.py renders the tables and --calibrate
+    fits a machine profile from them."""
+    try:
+        from flexflow_tpu.observability.devprof import (drift_table,
+                                                        get_devprof)
+
+        snap = get_devprof().snapshot()
+        if not (snap.get("reports") or snap.get("samples")):
+            return {}
+        # the raw sample ring rides the record too (bounded by
+        # FF_DEVPROF_RING): ffprof renders drift from it and
+        # --calibrate fits the machine profile from it — the drift
+        # table alone would strand the calibration workflow
+        return {"devprof": {"sample_every": snap.get("sample_every"),
+                            "reports": snap.get("reports") or {},
+                            "samples": snap.get("samples") or [],
+                            "drift": drift_table(snap)}}
+    except Exception:               # pragma: no cover - partial installs
+        return {}
+
+
 def _telemetry_summary():
     """Serving-telemetry attribution for the round record: the FULL
     metrics-registry snapshot (queue depth, batch occupancy, kernel-path
@@ -3365,6 +3392,9 @@ def persist_record(result, mode: str):
               "kv_pager": dict(_PAGER_CONF),
               **tel,
               **_slo_summary(),
+              # compile reports + drift table (devprof): chip rounds
+              # carry measured-vs-predicted evidence beside the claims
+              **_devprof_summary(),
               **_postmortem_fields(),
               # per-section started/done/aborted markers (the 0-progress
               # diagnosis surface — ffstat prints them)

@@ -38,6 +38,9 @@ from __future__ import annotations
 
 import os
 
+from .devprof import (CompileReport, DispatchProfiler,
+                      calibrate_machine_profile, drift_table, get_devprof,
+                      harvest_compile_report)
 from .flight_recorder import FlightRecorder, get_flight_recorder
 from .ledger import (RequestLedger, SLOPolicy, get_ledger,
                      slo_report_from, validate_slo_block)
@@ -54,6 +57,8 @@ __all__ = [
     "Counter", "Gauge", "Histogram", "MetricsRegistry", "StepTracer",
     "FlightRecorder", "Watchdog", "Heartbeat",
     "RequestLedger", "SLOPolicy",
+    "CompileReport", "DispatchProfiler", "get_devprof",
+    "harvest_compile_report", "drift_table", "calibrate_machine_profile",
     "TraceContext", "TraceAssembler", "MetricsHistory",
     "get_metrics_history", "scalar_values",
     "METRICS_SCHEMA", "EVENT_SCHEMA", "EVENT_NAMES", "exp_buckets",

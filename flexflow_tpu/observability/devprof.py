@@ -1,0 +1,496 @@
+"""Device profiling plane: compiled-record cost reports, sampled
+per-dispatch device timing, and cost-model drift/calibration.
+
+Everything the serving stack measured before this module was HOST time
+(step latencies, TTFT/TPOT, queue waits).  Every pricing decision the
+stack makes — paged restore-vs-recompute, disaggregated
+migrate-vs-recompute, the hybrid rider budget, the Unity-style search —
+trusts ``SimpleMachineModel``'s hand-set ``hbm_bandwidth`` /
+``peak_flops`` / link constants unvalidated.  The reference closes the
+same loop with ``Simulator::measure_operator_cost`` (measured per-op
+costs feed the search); this module is the serving-side equivalent:
+
+- :class:`CompileReport` — at every step-compile site in
+  ``inference_manager.py`` the jitted program is built ahead-of-time
+  (``jit(...).lower(args).compile()`` — the SAME single XLA compile the
+  lazy jit path would pay on first call) and the executable's
+  ``cost_analysis()`` + ``memory_analysis()`` are harvested: XLA's own
+  FLOP count, HBM bytes accessed and argument/output/temp footprints
+  per compiled record, registered beside the record and exposed as
+  ``serving_compiled_*`` gauges.
+- :class:`DispatchProfiler` — sampled per-dispatch DEVICE timing:
+  every ``FF_DEVPROF_SAMPLE``-th dispatch per (phase, path) does a
+  timed ``jax.block_until_ready`` on the dispatch result (ticked
+  through the existing ``note_host_sync`` discipline at sites where
+  the block adds a sync the driver would not otherwise pay).  Off by
+  default (``FF_DEVPROF_SAMPLE=0``): the hot path costs two attribute
+  reads; a no-op under ``FF_TELEMETRY=0`` either way.
+- **Drift + calibration** — each sample lands a
+  ``serving_costmodel_drift_ratio{phase,path}`` gauge
+  (cost-model-predicted / measured, from the record's CompileReport
+  roofline under the active machine model) plus per-bound roofline
+  attainment, and :func:`calibrate_machine_profile` fits ``hbm_bw``,
+  flop rate, host-link and device-link bandwidths from the sample ring
+  into a machine-profile JSON (``tools/ffprof.py --calibrate``) that
+  ``MachineModel.from_json`` / ``search.cost_model.default_machine``
+  (env ``FF_MACHINE_PROFILE``) feed back into ``RecoveryPolicy`` and
+  the search cost model.
+
+See docs/OBSERVABILITY.md "Device profiling & cost-model calibration".
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+#: bounded sample ring (FF_DEVPROF_RING overrides)
+DEFAULT_RING = 512
+
+#: phase vocabulary the dispatch sites emit — used by the calibration
+#: fit to decide which roofline bound a phase's samples pin down.
+BANDWIDTH_PHASES = ("decode", "hybrid")          # weight-stream bound
+FLOP_PHASES = ("prefill", "spec_verify", "spec_draft")
+HOST_LINK_PHASES = ("spill", "restore")          # host<->device payloads
+DEVICE_LINK_PHASES = ("migrate",)                # slice-to-slice payloads
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+class CompileReport:
+    """XLA's own cost/memory analysis of ONE compiled serving step
+    (``jax.stages.Compiled.cost_analysis()`` / ``memory_analysis()``):
+    FLOPs, HBM bytes accessed, and the argument/output/temp byte
+    footprints.  The roofline these numbers induce under a
+    :class:`~flexflow_tpu.search.cost_model.MachineModel` is what the
+    drift gauges compare measured device time against."""
+
+    __slots__ = ("key", "model", "flops", "bytes_accessed",
+                 "argument_bytes", "output_bytes", "temp_bytes",
+                 "generated_code_bytes")
+
+    def __init__(self, key: str, model: Any = None, flops: float = 0.0,
+                 bytes_accessed: float = 0.0, argument_bytes: int = 0,
+                 output_bytes: int = 0, temp_bytes: int = 0,
+                 generated_code_bytes: int = 0):
+        self.key = str(key)
+        self.model = model
+        self.flops = float(flops)
+        self.bytes_accessed = float(bytes_accessed)
+        self.argument_bytes = int(argument_bytes)
+        self.output_bytes = int(output_bytes)
+        self.temp_bytes = int(temp_bytes)
+        self.generated_code_bytes = int(generated_code_bytes)
+
+    @property
+    def peak_bytes(self) -> int:
+        """Peak HBM the executable needs live at once (arguments +
+        outputs + XLA temp allocations; donated caches alias, so this
+        over-counts by the aliased bytes — a conservative bound)."""
+        return self.argument_bytes + self.output_bytes + self.temp_bytes
+
+    # ------------------------------------------------------------ roofline
+    def t_flops(self, machine) -> float:
+        """Compute-bound floor under ``machine`` (seconds)."""
+        return self.flops / machine.peak_flops if self.flops > 0 else 0.0
+
+    def t_mem(self, machine) -> float:
+        """Bandwidth-bound floor under ``machine`` (seconds)."""
+        return (self.bytes_accessed / machine.hbm_bandwidth
+                if self.bytes_accessed > 0 else 0.0)
+
+    def predicted_s(self, machine) -> float:
+        """The cost model's step-time prediction: the roofline max of
+        the two bounds (the same shape as
+        ``search.cost_model.estimate_op_cost``)."""
+        return max(self.t_flops(machine), self.t_mem(machine))
+
+    # --------------------------------------------------------- serialization
+    def as_dict(self) -> Dict[str, Any]:
+        return {"key": self.key, "model": self.model,
+                "flops": self.flops,
+                "bytes_accessed": self.bytes_accessed,
+                "argument_bytes": self.argument_bytes,
+                "output_bytes": self.output_bytes,
+                "temp_bytes": self.temp_bytes,
+                "peak_bytes": self.peak_bytes,
+                "generated_code_bytes": self.generated_code_bytes}
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "CompileReport":
+        return cls(key=d.get("key", "?"), model=d.get("model"),
+                   flops=d.get("flops", 0.0),
+                   bytes_accessed=d.get("bytes_accessed", 0.0),
+                   argument_bytes=d.get("argument_bytes", 0),
+                   output_bytes=d.get("output_bytes", 0),
+                   temp_bytes=d.get("temp_bytes", 0),
+                   generated_code_bytes=d.get("generated_code_bytes", 0))
+
+
+def step_key_str(key) -> str:
+    """Canonical compact spelling of a record's step-cache key tuple
+    (the ``step`` label of the ``serving_compiled_*`` gauges)."""
+    if isinstance(key, (tuple, list)):
+        return ":".join("_" if k is None else str(k) for k in key)
+    return str(key)
+
+
+def harvest_compile_report(compiled, key, model: Any = None
+                           ) -> Optional[CompileReport]:
+    """Extract a :class:`CompileReport` from a ``jax.stages.Compiled``.
+    Best-effort and backend-tolerant: ``cost_analysis`` returns a list
+    of per-computation dicts on some backends and a dict on others, and
+    either analysis may be unimplemented — returns None rather than
+    raising (the compile site falls back to report-less serving)."""
+    flops = bytes_accessed = 0.0
+    have = False
+    try:
+        ca = compiled.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else None
+        if isinstance(ca, dict):
+            flops = float(ca.get("flops", 0.0) or 0.0)
+            bytes_accessed = float(ca.get("bytes accessed", 0.0) or 0.0)
+            have = True
+    except Exception:
+        pass
+    arg = out = temp = code = 0
+    try:
+        ma = compiled.memory_analysis()
+        if ma is not None:
+            arg = int(getattr(ma, "argument_size_in_bytes", 0) or 0)
+            out = int(getattr(ma, "output_size_in_bytes", 0) or 0)
+            temp = int(getattr(ma, "temp_size_in_bytes", 0) or 0)
+            code = int(getattr(ma, "generated_code_size_in_bytes", 0)
+                       or 0)
+            have = True
+    except Exception:
+        pass
+    if not have:
+        return None
+    return CompileReport(step_key_str(key), model=model, flops=flops,
+                         bytes_accessed=bytes_accessed,
+                         argument_bytes=arg, output_bytes=out,
+                         temp_bytes=temp, generated_code_bytes=code)
+
+
+class _Sample:
+    """An in-flight sampled dispatch (begin() token)."""
+
+    __slots__ = ("phase", "path", "t0")
+
+    def __init__(self, phase: str, path: str, t0: float):
+        self.phase = phase
+        self.path = path
+        self.t0 = t0
+
+
+class DispatchProfiler:
+    """Sampled per-dispatch device timing + compile-report registry.
+
+    ``begin(phase, path)`` returns None on unsampled dispatches (the
+    hot-path cost: two attribute reads when sampling is off, one lock'd
+    counter bump when on); every ``sample_every``-th dispatch per
+    (phase, path) returns a token whose ``end()`` does the timed
+    ``jax.block_until_ready`` and lands the histogram/drift gauges.
+    Thread-safe (RLock — snapshots ride watchdog signal-path bundles).
+    """
+
+    def __init__(self, registry=None, sample_every: Optional[int] = None,
+                 ring: Optional[int] = None, machine=None):
+        if registry is None:
+            from . import get_registry
+
+            registry = get_registry()
+        self._registry = registry
+        if sample_every is None:
+            sample_every = (0 if os.environ.get("FF_DEVPROF", "1") == "0"
+                            else _env_int("FF_DEVPROF_SAMPLE", 0))
+        # plain (unlocked) attribute: read on EVERY dispatch — keeping
+        # it out of the guarded set means the hot path never takes the
+        # lock while sampling is off (writes are single attr stores)
+        self._sample_every = max(0, int(sample_every))
+        self._machine = machine
+        self._lock = threading.RLock()
+        self._counts: Dict[tuple, int] = {}
+        self._samples: deque = deque(
+            maxlen=max(16, ring or _env_int("FF_DEVPROF_RING",
+                                            DEFAULT_RING)))
+        self._reports: Dict[str, CompileReport] = {}
+        m = registry
+        self._h_seconds = m.histogram("serving_devprof_device_seconds")
+        self._c_samples = m.counter("serving_devprof_samples_total")
+        self._g_attain = m.gauge("serving_devprof_roofline_attainment")
+        self._g_drift = m.gauge("serving_costmodel_drift_ratio")
+        self._g_flops = m.gauge("serving_compiled_flops")
+        self._g_bytes = m.gauge("serving_compiled_bytes_accessed")
+        self._g_peak = m.gauge("serving_compiled_peak_bytes")
+
+    # -------------------------------------------------------------- control
+    @property
+    def sample_every(self) -> int:
+        return self._sample_every
+
+    def set_sample_every(self, n: int) -> None:
+        """Runtime sampling-cadence override (0 disables; benches and
+        tests use this instead of re-importing with the env set)."""
+        self._sample_every = max(0, int(n))
+
+    def set_machine(self, machine) -> None:
+        """Pin the machine model drift compares against (tests; the
+        default is ``search.cost_model.default_machine()``, which honors
+        a calibrated FF_MACHINE_PROFILE)."""
+        self._machine = machine
+
+    def machine(self):
+        if self._machine is None:
+            from ..search.cost_model import default_machine
+
+            self._machine = default_machine()
+        return self._machine
+
+    def reset(self) -> None:
+        with self._lock:
+            self._counts.clear()
+            self._samples.clear()
+            self._reports.clear()
+
+    # ------------------------------------------------------ compile reports
+    def register_report(self, report: CompileReport) -> None:
+        """Register one record's CompileReport (the compile sites in
+        inference_manager call this once per step variant) and expose
+        the ``serving_compiled_*`` gauges."""
+        rkey = f"{report.model}/{report.key}"
+        with self._lock:
+            self._reports[rkey] = report
+        labels = {"model": report.model, "step": report.key}
+        self._g_flops.set(report.flops, **labels)
+        self._g_bytes.set(report.bytes_accessed, **labels)
+        self._g_peak.set(report.peak_bytes, **labels)
+        if self._registry.enabled:
+            from .flight_recorder import get_flight_recorder
+
+            get_flight_recorder().record_event(
+                "compile-report", model=report.model, key=report.key,
+                flops=report.flops, bytes=report.bytes_accessed)
+
+    def reports(self) -> Dict[str, CompileReport]:
+        with self._lock:
+            return dict(self._reports)
+
+    # ------------------------------------------------------------- sampling
+    def begin(self, phase: str, path: str = "dense"
+              ) -> Optional[_Sample]:
+        """Nth-dispatch sampling gate.  None (the overwhelmingly common
+        case) means: dispatch normally, no timing."""
+        if self._sample_every <= 0 or not self._registry.enabled:
+            return None
+        with self._lock:
+            n = self._counts.get((phase, path), 0) + 1
+            self._counts[(phase, path)] = n
+        if n % self._sample_every:
+            return None
+        return _Sample(phase, path, time.perf_counter())
+
+    def end(self, sample: _Sample, result=None, im=None, report=None,
+            payload_bytes: int = 0, tokens: int = 0,
+            machine=None) -> float:
+        """Finish a sampled dispatch: block until ``result`` is ready
+        on device, stamp the elapsed device-inclusive wall time, and
+        land the histogram + drift gauges.  The block is one genuine
+        extra synchronization point per sample; sites that block pass
+        ``im`` (an InferenceManager) so it ticks ``note_host_sync`` —
+        uniformly, since a caller's subsequent materialization (where
+        one follows) is a *second* real round trip with its own tick.
+        Transfer sites whose payload already materialized (spill
+        fetches) pass neither ``result`` nor ``im``."""
+        if result is not None:
+            import jax
+
+            jax.block_until_ready(result)
+        dt = time.perf_counter() - sample.t0
+        if im is not None:
+            im.note_host_sync()
+        self.observe(sample.phase, sample.path, dt, report=report,
+                     payload_bytes=payload_bytes, tokens=tokens,
+                     machine=machine)
+        return dt
+
+    def observe(self, phase: str, path: str, seconds: float,
+                report: Optional[CompileReport] = None,
+                payload_bytes: int = 0, tokens: int = 0,
+                machine=None) -> None:
+        """Land one device-time observation (the ``end()`` tail; the
+        disaggregated migrator feeds its already-timed transfers here
+        directly).  Gated on the sampling knob like ``begin()`` —
+        ``FF_DEVPROF_SAMPLE=0`` means the whole plane is off, external
+        feeds included."""
+        if self._sample_every <= 0 or not self._registry.enabled:
+            return
+        seconds = float(seconds)
+        self._h_seconds.observe(seconds, phase=phase, path=path)
+        self._c_samples.inc(phase=phase, path=path)
+        entry: Dict[str, Any] = {"phase": phase, "path": path,
+                                 "seconds": round(seconds, 9)}
+        if payload_bytes:
+            entry["payload_bytes"] = int(payload_bytes)
+        if tokens:
+            entry["tokens"] = int(tokens)
+        if report is not None and seconds > 0:
+            m = machine or self.machine()
+            t_mem, t_fl = report.t_mem(m), report.t_flops(m)
+            entry.update(key=report.key, model=report.model,
+                         flops=report.flops,
+                         bytes_accessed=report.bytes_accessed,
+                         predicted_s=round(max(t_mem, t_fl), 9))
+            self._g_attain.set(t_mem / seconds, phase=phase, path=path,
+                               bound="mem")
+            self._g_attain.set(t_fl / seconds, phase=phase, path=path,
+                               bound="flops")
+            drift = max(t_mem, t_fl) / seconds
+            entry["drift"] = round(drift, 6)
+            self._g_drift.set(drift, phase=phase, path=path)
+        from .flight_recorder import get_flight_recorder
+
+        get_flight_recorder().record_event(
+            "devprof-sample", phase=phase, path=path,
+            seconds=round(seconds, 9))
+        with self._lock:
+            self._samples.append(entry)
+
+    # ------------------------------------------------------------- snapshot
+    def snapshot(self) -> Dict[str, Any]:
+        """JSON-serializable state: the sample ring, the compile-report
+        registry and the per-(phase, path) dispatch counts — embedded
+        in watchdog bundles and bench round records, rendered by
+        tools/ffprof.py."""
+        with self._lock:
+            return {
+                "sample_every": self._sample_every,
+                "counts": {f"{p}/{pa}": n
+                           for (p, pa), n in sorted(self._counts.items())},
+                "samples": list(self._samples),
+                "reports": {k: r.as_dict()
+                            for k, r in sorted(self._reports.items())},
+            }
+
+
+# ------------------------------------------------------------ drift table
+def drift_table(snapshot: Dict[str, Any]) -> List[Dict[str, Any]]:
+    """Per-(phase, path) measured-vs-predicted summary from a devprof
+    snapshot's sample ring: sample count, median measured seconds,
+    median predicted seconds (when the samples carried a CompileReport
+    roofline) and the drift ratio predicted/measured.  The table bench
+    rounds stamp beside their metrics and ``ffprof`` renders."""
+    groups: Dict[tuple, List[Dict[str, Any]]] = {}
+    for s in snapshot.get("samples") or []:
+        groups.setdefault((s.get("phase", "?"), s.get("path", "?")),
+                          []).append(s)
+    rows = []
+    for (phase, path), ss in sorted(groups.items()):
+        meas = sorted(s["seconds"] for s in ss)
+        row: Dict[str, Any] = {"phase": phase, "path": path,
+                               "samples": len(ss),
+                               "measured_s_p50": _median(meas)}
+        preds = sorted(s["predicted_s"] for s in ss
+                       if s.get("predicted_s"))
+        if preds and row["measured_s_p50"] > 0:
+            row["predicted_s_p50"] = _median(preds)
+            row["drift_ratio"] = round(
+                row["predicted_s_p50"] / row["measured_s_p50"], 6)
+        rows.append(row)
+    return rows
+
+
+def _median(xs: List[float]) -> float:
+    if not xs:
+        return 0.0
+    xs = sorted(xs)
+    n = len(xs)
+    mid = n // 2
+    return round(xs[mid] if n % 2 else (xs[mid - 1] + xs[mid]) / 2.0, 9)
+
+
+# -------------------------------------------------------------- calibration
+def calibrate_machine_profile(snapshot: Dict[str, Any],
+                              num_devices: int = 1) -> Dict[str, Any]:
+    """Fit a machine-profile dict from a devprof snapshot's sample ring.
+
+    Each phase class pins the bound its dispatches are limited by:
+
+    - BANDWIDTH_PHASES (decode, hybrid): the step streams the weights
+      (+ attended KV) from HBM — implied ``hbm_bw = bytes_accessed /
+      seconds`` per sample (XLA's own byte count over measured time).
+    - FLOP_PHASES (prefill, spec verify/draft): chunk-wide passes are
+      compute-bound — implied ``flop rate = flops / seconds``.
+    - HOST_LINK_PHASES (spill, restore): ``payload_bytes / seconds``
+      prices the host link (the RecoveryPolicy restore arm).
+    - DEVICE_LINK_PHASES (migrate): ``payload_bytes / seconds`` prices
+      the slice-to-slice device link (the disagg migrate arm).
+
+    Medians, not means — a cold first sample (compile, page fault) must
+    not drag the fit.  Keys follow EnhancedMachineModel's config
+    vocabulary so :meth:`MachineModel.from_json` loads the result
+    directly; phases with no samples leave their key absent (the loader
+    keeps its defaults).  The fit is an *effective* rate — it folds
+    dispatch overhead into the bandwidth term, which is exactly what a
+    pricing model for THIS serving stack should use."""
+    samples = snapshot.get("samples") or []
+
+    def rates(phases: tuple, num: str, den_floor: float = 0.0):
+        out = []
+        for s in samples:
+            if s.get("phase") not in phases:
+                continue
+            n, d = float(s.get(num, 0) or 0), float(s.get("seconds", 0))
+            if n > den_floor and d > 0:
+                out.append(n / d)
+        return out
+
+    prof: Dict[str, Any] = {"profile_version": 1,
+                            "source": "devprof-calibrate",
+                            "num_devices": int(num_devices)}
+    counts: Dict[str, int] = {}
+    hbm = rates(BANDWIDTH_PHASES, "bytes_accessed")
+    if hbm:
+        prof["hbm_gbps"] = round(_median(hbm) / 1e9, 6)
+        counts["hbm"] = len(hbm)
+    flop = rates(FLOP_PHASES, "flops")
+    if flop:
+        prof["peak_tflops"] = round(_median(flop) / 1e12, 9)
+        counts["flops"] = len(flop)
+    host = rates(HOST_LINK_PHASES, "payload_bytes")
+    if host:
+        prof["dcn_gbps"] = round(_median(host) / 1e9, 6)
+        counts["host_link"] = len(host)
+    link = rates(DEVICE_LINK_PHASES, "payload_bytes")
+    if link:
+        prof["device_link_gbps"] = round(_median(link) / 1e9, 6)
+        counts["device_link"] = len(link)
+    prof["sample_counts"] = counts
+    return prof
+
+
+# ---------------------------------------------------------------- singleton
+_DEVPROF: Optional[DispatchProfiler] = None
+_DEVPROF_LOCK = threading.Lock()
+
+
+def get_devprof() -> DispatchProfiler:
+    """The process-wide dispatch profiler (built lazily so the package
+    registry exists first; env knobs FF_DEVPROF / FF_DEVPROF_SAMPLE /
+    FF_DEVPROF_RING are read at first use)."""
+    global _DEVPROF
+    if _DEVPROF is None:
+        with _DEVPROF_LOCK:
+            if _DEVPROF is None:
+                _DEVPROF = DispatchProfiler()
+    return _DEVPROF

@@ -121,9 +121,37 @@ class Gauge(_Metric):
                 for k, v in sorted(self._values.items())}
 
 
+class _HistState:
+    """One histogram series' mutable state (the aggregate, plus one per
+    label combination when a histogram observes with labels)."""
+
+    __slots__ = ("counts", "count", "sum", "min", "max")
+
+    def __init__(self, n_buckets: int):
+        self.counts = [0] * (n_buckets + 1)  # +overflow
+        self.count = 0
+        self.sum = 0.0
+        self.min = float("inf")
+        self.max = float("-inf")
+
+    def add(self, buckets: Tuple[float, ...], v: float) -> None:
+        self.counts[bisect.bisect_left(buckets, v)] += 1
+        self.count += 1
+        self.sum += v
+        if v < self.min:
+            self.min = v
+        if v > self.max:
+            self.max = v
+
+
 class Histogram(_Metric):
     """Fixed-bucket histogram with count/sum/min/max and
-    bucket-interpolated percentiles."""
+    bucket-interpolated percentiles.  ``observe(v, **labels)`` with
+    labels additionally tracks a per-label-combination series (the
+    devprof per-(phase, path) device-seconds split); the top-level
+    count/sum/percentiles stay the aggregate over every observation,
+    so unlabeled callers and existing snapshot consumers see the exact
+    pre-labels shape."""
 
     kind = "histogram"
 
@@ -132,76 +160,80 @@ class Histogram(_Metric):
         self.buckets: Tuple[float, ...] = tuple(buckets or DEFAULT_BUCKETS)
         assert list(self.buckets) == sorted(self.buckets), (
             f"{name}: bucket bounds must be sorted")
-        self._counts = [0] * (len(self.buckets) + 1)  # +overflow
-        self._count = 0
-        self._sum = 0.0
-        self._min = float("inf")
-        self._max = float("-inf")
+        self._agg = _HistState(len(self.buckets))
+        self._series: Dict[Tuple, _HistState] = {}
 
-    def observe(self, v: float):
+    def observe(self, v: float, **labels):
         reg = self._reg
         if not reg.enabled:
             return
         v = float(v)
         with reg._lock:
-            self._counts[bisect.bisect_left(self.buckets, v)] += 1
-            self._count += 1
-            self._sum += v
-            if v < self._min:
-                self._min = v
-            if v > self._max:
-                self._max = v
+            self._agg.add(self.buckets, v)
+            if labels:
+                key = _label_key(labels)
+                st = self._series.get(key)
+                if st is None:
+                    st = self._series[key] = _HistState(len(self.buckets))
+                st.add(self.buckets, v)
 
     @property
     def count(self) -> int:
-        return self._count
+        return self._agg.count
 
     @property
     def sum(self) -> float:
-        return self._sum
+        return self._agg.sum
+
+    def _percentile_of(self, st: _HistState, p: float) -> float:
+        if st.count == 0:
+            return 0.0
+        target = (p / 100.0) * st.count
+        cum = 0
+        for i, c in enumerate(st.counts):
+            if c == 0:
+                continue
+            if cum + c >= target:
+                lo = self.buckets[i - 1] if i > 0 else min(
+                    st.min, self.buckets[0])
+                hi = (self.buckets[i] if i < len(self.buckets)
+                      else st.max)
+                frac = (target - cum) / c
+                est = lo + (hi - lo) * max(0.0, min(1.0, frac))
+                return max(st.min, min(st.max, est))
+            cum += c
+        return st.max
 
     def percentile(self, p: float) -> float:
         """Estimate the p-th percentile from the bucket counts by linear
         interpolation inside the target bucket (clamped to the observed
         min/max so the estimate never leaves the data's range)."""
-        if self._count == 0:
-            return 0.0
-        target = (p / 100.0) * self._count
-        cum = 0
-        for i, c in enumerate(self._counts):
-            if c == 0:
-                continue
-            if cum + c >= target:
-                lo = self.buckets[i - 1] if i > 0 else min(
-                    self._min, self.buckets[0])
-                hi = (self.buckets[i] if i < len(self.buckets)
-                      else self._max)
-                frac = (target - cum) / c
-                est = lo + (hi - lo) * max(0.0, min(1.0, frac))
-                return max(self._min, min(self._max, est))
-            cum += c
-        return self._max
+        return self._percentile_of(self._agg, p)
 
     def _reset(self):
-        self._counts = [0] * (len(self.buckets) + 1)
-        self._count = 0
-        self._sum = 0.0
-        self._min = float("inf")
-        self._max = float("-inf")
+        self._agg = _HistState(len(self.buckets))
+        self._series.clear()
+
+    def _snap_state(self, st: _HistState):
+        out = {"count": st.count, "sum": round(st.sum, 6)}
+        if st.count:
+            out.update(
+                min=round(st.min, 6), max=round(st.max, 6),
+                mean=round(st.sum / st.count, 6),
+                p50=round(self._percentile_of(st, 50), 6),
+                p90=round(self._percentile_of(st, 90), 6),
+                p99=round(self._percentile_of(st, 99), 6),
+                buckets={f"le_{b:g}": c
+                         for b, c in zip(self.buckets, st.counts)
+                         if c} | ({"overflow": st.counts[-1]}
+                                  if st.counts[-1] else {}))
+        return out
 
     def snapshot(self):
-        out = {"count": self._count, "sum": round(self._sum, 6)}
-        if self._count:
-            out.update(
-                min=round(self._min, 6), max=round(self._max, 6),
-                mean=round(self._sum / self._count, 6),
-                p50=round(self.percentile(50), 6),
-                p90=round(self.percentile(90), 6),
-                p99=round(self.percentile(99), 6),
-                buckets={f"le_{b:g}": c
-                         for b, c in zip(self.buckets, self._counts)
-                         if c} | ({"overflow": self._counts[-1]}
-                                  if self._counts[-1] else {}))
+        out = self._snap_state(self._agg)
+        if self._series:
+            out["series"] = {_fmt_labels(k): self._snap_state(st)
+                             for k, st in sorted(self._series.items())}
         return out
 
 
@@ -346,9 +378,11 @@ def prometheus_text(snapshot: Dict[str, Dict[str, Any]],
                 lines.append(f"{name}{_prom_labels(label_str)} {v:g}")
         else:
             lines.append(f"{name} {snap:g}")
-    for name, snap in (snapshot.get("histograms") or {}).items():
-        _help(name)
-        lines.append(f"# TYPE {name} histogram")
+    def _hist_series(name: str, snap: Dict[str, Any],
+                     label_str: str = "") -> None:
+        prefix = _prom_labels(label_str)
+        # merge the series labels with le= (prometheus histogram form)
+        pre = prefix[:-1] + "," if prefix else "{"
         count = int(snap.get("count", 0))
         cum = 0
         for le, c in (snap.get("buckets") or {}).items():
@@ -356,8 +390,21 @@ def prometheus_text(snapshot: Dict[str, Dict[str, Any]],
                 continue
             cum += int(c)
             bound = le[len("le_"):]
-            lines.append(f'{name}_bucket{{le="{bound}"}} {cum}')
-        lines.append(f'{name}_bucket{{le="+Inf"}} {count}')
-        lines.append(f"{name}_sum {snap.get('sum', 0.0):g}")
-        lines.append(f"{name}_count {count}")
+            lines.append(f'{name}_bucket{pre}le="{bound}"}} {cum}')
+        lines.append(f'{name}_bucket{pre}le="+Inf"}} {count}')
+        lines.append(f"{name}_sum{prefix} {snap.get('sum', 0.0):g}")
+        lines.append(f"{name}_count{prefix} {count}")
+
+    for name, snap in (snapshot.get("histograms") or {}).items():
+        _help(name)
+        lines.append(f"# TYPE {name} histogram")
+        series = snap.get("series")
+        if series:
+            # labeled histogram (per-series buckets): each label combo
+            # is its own prometheus series — the aggregate would alias
+            # the empty label set, so only the labeled series render
+            for label_str, sub in series.items():
+                _hist_series(name, sub, label_str)
+        else:
+            _hist_series(name, snap)
     return "\n".join(lines) + "\n"

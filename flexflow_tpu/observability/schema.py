@@ -457,6 +457,75 @@ METRICS_SCHEMA = {
                 "transport failure marked the replica dead and "
                 "routing excludes it until the cooldown expires.",
     },
+    # ---------------------------------------------- device profiling
+    # (observability/devprof.py: compiled-record cost reports + sampled
+    # per-dispatch device timing + cost-model drift — the measurement
+    # substrate for BENCH chip rounds and cost-model calibration)
+    "serving_compiled_flops": {
+        "type": "gauge",
+        "help": "XLA cost_analysis FLOPs of one compiled serving step "
+                "(labeled model=<id>, step=<step-cache key>) — "
+                "harvested at the AOT compile site in "
+                "inference_manager, the numerator of the compute-bound "
+                "roofline term the drift gauge compares against.",
+    },
+    "serving_compiled_bytes_accessed": {
+        "type": "gauge",
+        "help": "XLA cost_analysis HBM bytes accessed per invocation "
+                "of one compiled serving step (labeled model=<id>, "
+                "step=<key>) — the bandwidth-bound roofline numerator; "
+                "decode steps are expected to sit near weight bytes + "
+                "attended KV.",
+    },
+    "serving_compiled_peak_bytes": {
+        "type": "gauge",
+        "help": "memory_analysis argument+output+temp bytes of one "
+                "compiled serving step (labeled model=<id>, "
+                "step=<key>): the executable's live-HBM bound "
+                "(donated caches alias, so this over-counts by the "
+                "aliased bytes — a conservative ceiling).",
+    },
+    "serving_devprof_device_seconds": {
+        "type": "histogram",
+        "help": "Sampled per-dispatch device time (a timed "
+                "block_until_ready on the dispatch result), labeled "
+                "phase=decode|prefill|hybrid|spec_draft|spec_verify|"
+                "spill|restore|migrate and path=dense|paged|pp (the "
+                "record's cache layout).  Only "
+                "every FF_DEVPROF_SAMPLE-th dispatch per (phase, path) "
+                "observes here — the histogram is a sample, not a "
+                "census (serving_devprof_samples_total counts them).",
+    },
+    "serving_devprof_samples_total": {
+        "type": "counter",
+        "help": "Sampled dispatch timings taken per (phase, path) — "
+                "the denominator discipline for the device-seconds "
+                "histogram and the drift gauges (each sample costs one "
+                "block_until_ready; FF_DEVPROF_SAMPLE sets the "
+                "cadence, 0 = off).",
+    },
+    "serving_devprof_roofline_attainment": {
+        "type": "gauge",
+        "help": "Per-bound roofline attainment of the latest sampled "
+                "dispatch: labeled phase, path and bound=mem|flops — "
+                "t_bound / measured, where t_mem = compiled bytes "
+                "accessed / machine hbm_bw and t_flops = compiled "
+                "FLOPs / machine peak.  ~1.0 means the dispatch runs "
+                "at that bound; <<1 on both bounds means overhead-"
+                "dominated (or a mis-set machine model — see the drift "
+                "gauge).",
+    },
+    "serving_costmodel_drift_ratio": {
+        "type": "gauge",
+        "help": "Cost-model drift per (phase, path): predicted / "
+                "measured for the latest sampled dispatch, where "
+                "predicted = max(t_mem, t_flops) from the record's "
+                "CompileReport under the active machine model "
+                "(default_machine — honors FF_MACHINE_PROFILE).  1.0 "
+                "= the model prices this hardware correctly; the "
+                "ffprof --calibrate workflow exists to drive this "
+                "toward 1.",
+    },
     # --------------------------------------------------- pipeline serving
     "serving_pp_stage_dispatches_total": {
         "type": "counter",
@@ -649,5 +718,21 @@ EVENT_SCHEMA = {
         "help": "A serving record compiled + caches allocated (model, "
                 "mode, rows, alloc_len) — a burst of these mid-serve is "
                 "the recompile-loop stall signature.",
+    },
+    "compile-report": {
+        "help": "One compiled step's XLA cost/memory analysis was "
+                "harvested into a CompileReport (model, key, flops, "
+                "bytes) — the devprof twin of `compile`; rendered by "
+                "tools/ffprof.py and stamped into bench rounds.",
+    },
+    "devprof-sample": {
+        "help": "One sampled dispatch timing landed (phase, path, "
+                "seconds) — the flight-record twin of the device-"
+                "seconds histogram.  In a stall bundle the per-phase "
+                "devprof tail splits two bug classes: healthy recent "
+                "device seconds point at a hung NEXT dispatch, while "
+                "zero sampled device time in the window points "
+                "host-side (scheduler/queue) — tools/ffstat.py prints "
+                "the split.",
     },
 }

@@ -178,6 +178,18 @@ def collect_bundle(reason: str, heartbeat: Optional[Heartbeat] = None,
             bundle["metrics_history"] = hist
     except Exception:  # pragma: no cover - partial install
         pass
+    # device-profiling state: compile reports + the sampled per-phase
+    # device-seconds tail — a stall whose window holds healthy recent
+    # device time points at a hung NEXT dispatch; one with zero sampled
+    # device time points host-side (ffstat prints the split)
+    try:
+        from .devprof import get_devprof
+
+        dp = get_devprof().snapshot()
+        if dp["samples"] or dp["reports"]:
+            bundle["devprof"] = dp
+    except Exception:  # pragma: no cover - partial install
+        pass
     # paged-KV state: pages free/leased + spilled GUIDs per live pager
     # (lazy import — serving imports observability at module load, so
     # the reverse edge must only exist at bundle time; best-effort:

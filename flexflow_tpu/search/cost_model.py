@@ -119,6 +119,40 @@ class MachineModel:
             return 0.0
         return bytes_ / self.device_link_bandwidth + self.ici_latency
 
+    # ------------------------------------------------- calibrated profiles
+    @classmethod
+    def from_json(cls, source,
+                  num_devices: Optional[int] = None) -> "MachineModel":
+        """Build a machine model from a machine-profile JSON (a path or
+        an already-parsed dict) — the artifact ``tools/ffprof.py
+        --calibrate`` fits from devprof's sampled dispatch timings.
+        Keys follow :meth:`EnhancedMachineModel.from_file`'s vocabulary
+        (``hbm_gbps``, ``peak_tflops``, ``dcn_gbps``,
+        ``device_link_gbps``, ...); absent keys keep the
+        SimpleMachineModel v5e defaults, so a partial calibration (say,
+        only hbm_gbps measured) still loads.  ``num_devices`` passed
+        explicitly overrides the profile's own value (None defers to
+        the profile)."""
+        import json
+
+        if isinstance(source, dict):
+            kv = source
+        else:
+            with open(source) as f:
+                kv = json.load(f)
+        return cls(
+            num_devices=int(num_devices or kv.get("num_devices", 1)),
+            peak_flops=float(kv.get("peak_tflops", 197.0)) * 1e12,
+            hbm_bandwidth=float(kv.get("hbm_gbps", 819.0)) * 1e9,
+            ici_bandwidth=float(kv.get("ici_gbps", 45.0)) * 1e9,
+            ici_latency=float(kv.get("ici_latency_us", 1.0)) * 1e-6,
+            dcn_bandwidth=float(kv.get("dcn_gbps", 25.0)) * 1e9,
+            devices_per_host=int(kv.get("devices_per_host", 0)),
+            hbm_per_device=int(float(kv.get("hbm_gb", 16)) * 1024**3),
+            device_link_bandwidth=(float(kv["device_link_gbps"]) * 1e9
+                                   if "device_link_gbps" in kv else None),
+        )
+
 
 class SimpleMachineModel(MachineModel):
     """One-knob model (reference SimpleMachineModel: intra-node + NIC bw).
@@ -167,6 +201,32 @@ class EnhancedMachineModel(MachineModel):
             device_link_bandwidth=(kv["device_link_gbps"] * 1e9
                                    if "device_link_gbps" in kv else None),
         )
+
+
+def default_machine(num_devices: Optional[int] = None) -> MachineModel:
+    """The machine description serving pricing uses when none is
+    passed explicitly: a calibrated machine-profile JSON from
+    ``FF_MACHINE_PROFILE`` (written by ``tools/ffprof.py --calibrate``
+    from devprof's sampled dispatch timings) when the env var is set
+    and loadable, else the hand-set :class:`SimpleMachineModel` v5e
+    defaults.  This is the feedback edge that makes the KV pager's
+    RecoveryPolicy, the disaggregated migrate-vs-recompute decision,
+    the hybrid rider budget and devprof's own drift gauges price the
+    MEASURED machine instead of the datasheet.  ``num_devices`` left
+    None defers to the profile's own (calibrated-box) value; pass it
+    only to model a different topology."""
+    import logging
+    import os
+
+    path = os.environ.get("FF_MACHINE_PROFILE")
+    if path:
+        try:
+            return MachineModel.from_json(path, num_devices=num_devices)
+        except Exception as e:
+            logging.getLogger(__name__).warning(
+                "FF_MACHINE_PROFILE=%s failed to load (%s); falling "
+                "back to SimpleMachineModel defaults", path, e)
+    return SimpleMachineModel(num_devices or 1)
 
 
 # --------------------------------------------------------------- op math

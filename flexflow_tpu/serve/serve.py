@@ -601,6 +601,27 @@ class LLM:
 
         return metrics_snapshot()
 
+    def compile_reports(self) -> Dict[str, Any]:
+        """The compiled record's CompileReports (XLA's own FLOPs / HBM
+        bytes accessed / peak footprint per compiled step variant,
+        harvested at the AOT compile sites) keyed by step-cache key —
+        {} before compile() or when harvest was unavailable.  See
+        docs/OBSERVABILITY.md "Device profiling & cost-model
+        calibration"."""
+        if self.im is None or self.model_id is None:
+            return {}
+        return self.im.compile_reports(self.model_id)
+
+    def devprof_snapshot(self) -> Dict[str, Any]:
+        """The device-profiling plane's state: sampled per-dispatch
+        device seconds (FF_DEVPROF_SAMPLE=N arms the sampler), the
+        compile-report registry and dispatch counts — render with
+        ``tools/ffprof.py``; ``--calibrate`` fits a machine-profile
+        JSON from the samples."""
+        from ..observability import get_devprof
+
+        return get_devprof().snapshot()
+
     def trace(self, path: str):
         """Context manager capturing host step events (admit,
         prefill-chunk, decode-step, spec-draft/verify, commit, donate,

@@ -258,6 +258,15 @@ class FrameMigrator:
         self._c_migrations.inc(decision="migrate")
         self._c_bytes.inc(nbytes)
         self._h_seconds.observe(dt)
+        # device-link sample for the profiling plane: every migration
+        # is already timed here, so feed devprof directly (no extra
+        # sync) — payload_bytes/seconds is what ffprof --calibrate
+        # fits device_link_gbps from
+        from ..observability import get_devprof
+
+        get_devprof().observe(
+            "migrate", "paged" if payload.get("paged") else "dense",
+            dt, payload_bytes=nbytes)
         self._note_handoff(guid, src_row, dst_row, length, "migrate",
                         nbytes=nbytes, seconds=dt)
         return {"bytes": nbytes, "seconds": dt}

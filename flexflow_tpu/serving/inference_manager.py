@@ -30,8 +30,9 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec
 from ..config import (AXIS_DATA, AXIS_EXPERT, AXIS_MODEL, AXIS_PIPE,
                       AXIS_SEQ, FFConfig)
 from ..fftype import InferenceMode, OpType
-from ..observability import (get_flight_recorder, get_ledger,
-                             get_registry, get_tracer)
+from ..observability import (get_devprof, get_flight_recorder,
+                             get_ledger, get_registry, get_tracer)
+from ..observability.devprof import harvest_compile_report, step_key_str
 from ..ops.registry import OpContext, get_op
 from .batch_config import (BatchConfig, BeamSearchBatchConfig,
                            InferenceResult, TreeVerifyBatchConfig)
@@ -527,6 +528,10 @@ class InferenceManager:
         # admitted in-flight timeline (a request's timeline carries the
         # syncs/compiles it lived through)
         self.ledger = get_ledger()
+        # device profiling plane: compile-report harvest at the AOT
+        # compile sites + sampled per-dispatch device timing
+        # (observability/devprof.py; FF_DEVPROF_SAMPLE)
+        self.devprof = get_devprof()
         self._c_host_syncs = m.counter("serving_host_syncs_total")
         self._c_kernel_path = m.counter("serving_kernel_path_total")
         self._c_pp_dispatch = m.counter("serving_pp_stage_dispatches_total")
@@ -1111,15 +1116,22 @@ class InferenceManager:
         if init_parent_rows is None:
             init_parent_rows = np.arange(record["rows"], dtype=np.int32)
         key = ("beam_block", d_steps, W)
-        if key not in record["steps"]:
-            record["steps"][key] = self._build_beam_block(record, d_steps,
-                                                          W)
-        hist, record["caches"] = record["steps"][key](
-            record["model"].params, record["caches"], batch,
-            _feed_rng(jax.random.split(rng, d_steps)),
-            _feed_array(init_tokens, jnp.int32),
-            _feed_array(init_cum_logp, jnp.float32),
-            _feed_array(init_parent_rows, jnp.int32))
+        args = (record["model"].params, record["caches"], batch,
+                _feed_rng(jax.random.split(rng, d_steps)),
+                _feed_array(init_tokens, jnp.int32),
+                _feed_array(init_cum_logp, jnp.float32),
+                _feed_array(init_parent_rows, jnp.int32))
+        step = self._compiled_step(
+            record, model_id, key,
+            lambda: self._build_beam_block(record, d_steps, W), *args)
+        prof = self.devprof.begin("spec_draft",
+                                  self._devprof_path(record))
+        hist, record["caches"] = step(*args)
+        if prof is not None:
+            # sampled: one extra synchronization point, ticked (the
+            # np.asarray fetch below keeps its own tick)
+            self.devprof.end(prof, result=hist, im=self,
+                             report=self._step_report(record, key))
         toks, parents, cums = hist
         # one odometer tick for the three fetches: they ride one block's
         # results, so the tunnel pays a single round trip
@@ -1134,6 +1146,73 @@ class InferenceManager:
             record["steps"][key] = self._build_step(record, chunk, reorder,
                                                     attend_len, use_flash)
         return record["steps"][key]
+
+    # ------------------------------------------------------ device profiling
+    @staticmethod
+    def _devprof_path(record) -> str:
+        """The ``path`` label of devprof samples for this record (the
+        cache layout the dispatch ran against)."""
+        return ("pp" if "pp_stages" in record
+                else "paged" if record.get("paged") else "dense")
+
+    @staticmethod
+    def _step_report(record, key):
+        """The harvested CompileReport of one step variant (None when
+        AOT harvest was unavailable for it)."""
+        reports = record.get("compile_reports")
+        return reports.get(step_key_str(key)) if reports else None
+
+    def compile_reports(self, model_id: int):
+        """Harvested CompileReports of a record's compiled step
+        variants as plain dicts, keyed by step-cache key string —
+        FLOPs, HBM bytes accessed and peak/argument/output bytes per
+        compiled program (observability/devprof.py; {} when the AOT
+        harvest was unavailable).  Bench rounds stamp this beside
+        their metrics."""
+        return {k: r.as_dict() for k, r in sorted(
+            (self.models[model_id].get("compile_reports")
+             or {}).items())}
+
+    def _compiled_step(self, record, model_id, key, build, *args):
+        """Get-or-compile the step cached under ``key``, to be invoked
+        with exactly ``*args``.
+
+        The first build compiles AHEAD OF TIME
+        (``jit(...).lower(*args).compile()``) — the same single XLA
+        compile the lazy jit path would pay on its first call, but with
+        the executable in hand, so its ``cost_analysis()`` /
+        ``memory_analysis()`` harvest into a :class:`CompileReport`
+        registered beside the record and exposed as
+        ``serving_compiled_*`` gauges.  Subsequent calls hit the cached
+        executable directly — the retrace-guard zero-compile pins hold
+        exactly as before.  Falls back to the plain lazy-jit callable
+        under multi-controller (the numpy feed contract replicates at
+        jit dispatch, which AOT arg commitment bypasses), under the
+        ``FF_DEVPROF_COMPILE=0`` kill switch, and on any AOT failure —
+        serving never depends on the report existing."""
+        import os
+
+        fn = record["steps"].get(key)
+        if fn is not None:
+            return fn
+        jitted = build()
+        fn = jitted
+        if (jax.process_count() == 1
+                and os.environ.get("FF_DEVPROF_COMPILE", "1") != "0"):
+            try:
+                compiled = jitted.lower(*args).compile()
+            except Exception:
+                pass    # lazy jit compiles on first call instead
+            else:
+                fn = compiled
+                report = harvest_compile_report(compiled, key,
+                                                model=model_id)
+                if report is not None:
+                    record.setdefault("compile_reports", {})[
+                        report.key] = report
+                    self.devprof.register_report(report)
+        record["steps"][key] = fn
+        return fn
 
     def inference(self, model_id: int, bc: BatchConfig,
                   rng=None, parent_rows: Optional[np.ndarray] = None
@@ -1198,11 +1277,29 @@ class InferenceManager:
             attend_len = (attend_bucket(bc, bc.chunk,
                                         record["alloc_len"])
                           if use_flash and bc.chunk > 1 else None)
-        step = self._get_step(record, bc.chunk, reorder, attend_len,
-                              use_flash)
-        outs, record["caches"] = _retry_transient(
-            step, record["model"].params, record["caches"], batch,
-            _feed_rng(rng))
+        key = (bc.chunk, reorder, attend_len, use_flash)
+        args = (record["model"].params, record["caches"], batch,
+                _feed_rng(rng))
+        step = self._compiled_step(
+            record, model_id, key,
+            lambda: self._build_step(record, bc.chunk, reorder,
+                                     attend_len, use_flash), *args)
+        # sampled device timing (devprof): phase by batch flavor — a
+        # tree-verify batch is the spec drivers' widest cache reader,
+        # a chunk-1 batch a plain decode step, else a prefill chunk
+        phase = ("spec_verify" if isinstance(bc, TreeVerifyBatchConfig)
+                 else "spec_draft" if isinstance(bc, BeamSearchBatchConfig)
+                 else "decode" if bc.chunk == 1 else "prefill")
+        prof = self.devprof.begin(phase, self._devprof_path(record))
+        outs, record["caches"] = _retry_transient(step, *args)
+        if prof is not None:
+            # sampled: the timed block is one genuine extra
+            # synchronization point, ticked uniformly (for the async
+            # mid-prompt prefill path it is the ONLY sync; at sites
+            # whose caller materializes right after, that fetch is a
+            # second real round trip with its own tick)
+            self.devprof.end(prof, result=outs, im=self,
+                             report=self._step_report(record, key))
         return outs
 
     def decode_block(self, model_id: int, bc: BatchConfig, k: int,
@@ -1263,14 +1360,22 @@ class InferenceManager:
                       else None)
         use_flash = self._pick_kernel_path(record, bc, 1, span=k + 1)
         key = ("block", k, include_init, attend_len, use_flash)
-        if key not in record["steps"]:
-            record["steps"][key] = self._build_decode_block(
-                record, k, include_init, attend_len, use_flash)
-        toks, record["caches"] = _retry_transient(
-            record["steps"][key], record["model"].params,
-            record["caches"], batch,
-            _feed_rng(jax.random.split(rng, k)),
-            _feed_array(init_tokens, jnp.int32))
+        args = (record["model"].params, record["caches"], batch,
+                _feed_rng(jax.random.split(rng, k)),
+                _feed_array(init_tokens, jnp.int32))
+        step = self._compiled_step(
+            record, model_id, key,
+            lambda: self._build_decode_block(record, k, include_init,
+                                             attend_len, use_flash),
+            *args)
+        prof = self.devprof.begin("decode", self._devprof_path(record))
+        toks, record["caches"] = _retry_transient(step, *args)
+        if prof is not None:
+            # sampled: the timed block is one genuine extra
+            # synchronization point (the caller's materialization that
+            # follows is a second, separately-ticked round trip)
+            self.devprof.end(prof, result=toks, im=self,
+                             report=self._step_report(record, key))
         return toks
 
     # -------------------------------------------------------- hybrid step
@@ -1296,12 +1401,12 @@ class InferenceManager:
         env = os.environ.get("FF_HYBRID_BUDGET")
         if env:
             return max(0, int(env))
-        from ..search.cost_model import (SimpleMachineModel,
-                                         hybrid_rider_budget)
+        from ..search.cost_model import default_machine, hybrid_rider_budget
 
         machine = getattr(self, "machine", None)
         if machine is None:
-            machine = self.machine = SimpleMachineModel(1)
+            # default_machine honors a calibrated FF_MACHINE_PROFILE
+            machine = self.machine = default_machine()
         pb = self.model_param_bytes(model_id)
         return hybrid_rider_budget(machine, pb["bytes"], pb["elements"],
                                    decode_rows)
@@ -1391,12 +1496,19 @@ class InferenceManager:
                                       record["alloc_len"])
                         if r_flash else None)
         key = ("hybrid", bc.chunk, d_attend, r_attend, d_flash, r_flash)
-        if key not in record["steps"]:
-            record["steps"][key] = self._build_hybrid_step(
-                record, d_attend, r_attend, d_flash, r_flash)
-        toks, record["caches"] = _retry_transient(
-            record["steps"][key], record["model"].params,
-            record["caches"], batch, _feed_rng(rng))
+        args = (record["model"].params, record["caches"], batch,
+                _feed_rng(rng))
+        step = self._compiled_step(
+            record, model_id, key,
+            lambda: self._build_hybrid_step(record, d_attend, r_attend,
+                                            d_flash, r_flash), *args)
+        prof = self.devprof.begin("hybrid", self._devprof_path(record))
+        toks, record["caches"] = _retry_transient(step, *args)
+        if prof is not None:
+            # sampled: one extra synchronization point, ticked (the
+            # fold's own materialization keeps its separate tick)
+            self.devprof.end(prof, result=toks, im=self,
+                             report=self._step_report(record, key))
         return toks
 
     # ------------------------------------------------------- prefix cache
@@ -1748,23 +1860,35 @@ class InferenceManager:
         record = self.models[model_id]
         if length <= 0 or not record.get("caches"):
             return None
+        # sampled host-link timing (devprof phase=spill): the host
+        # materialization below syncs anyway, so a sample adds no
+        # round trip — the payload_bytes/seconds rate is what
+        # ffprof --calibrate fits the host-link bandwidth from
+        prof = (self.devprof.begin("spill", self._devprof_path(record))
+                if to_host else None)
         if "pp_stages" in record:
-            return self._fetch_row_pp(record, row, length)
-        if record.get("paged"):
-            return self._fetch_row_paged(record, row, length, to_host)
-        L = pow2_bucket(length, record["alloc_len"]) or record["alloc_len"]
-        key = ("fetch_row", L)
-        if key not in record["steps"]:
-            record["steps"][key] = self._build_fetch_row(record, L)
-        seg = _retry_transient(record["steps"][key], record["caches"],
-                               _feed_array(np.int32(row)))
-        if to_host:
-            seg = jax.tree.map(np.asarray, jax.device_get(seg))
-            self.note_host_sync()
-        nbytes = sum(int(a.nbytes) for lp in seg.values()
-                     for a in lp.values())
-        return {"layers": seg, "len": L, "valid": int(length),
-                "bytes": nbytes}
+            out = self._fetch_row_pp(record, row, length)
+        elif record.get("paged"):
+            out = self._fetch_row_paged(record, row, length, to_host)
+        else:
+            L = (pow2_bucket(length, record["alloc_len"])
+                 or record["alloc_len"])
+            key = ("fetch_row", L)
+            if key not in record["steps"]:
+                record["steps"][key] = self._build_fetch_row(record, L)
+            seg = _retry_transient(record["steps"][key],
+                                   record["caches"],
+                                   _feed_array(np.int32(row)))
+            if to_host:
+                seg = jax.tree.map(np.asarray, jax.device_get(seg))
+                self.note_host_sync()
+            nbytes = sum(int(a.nbytes) for lp in seg.values()
+                         for a in lp.values())
+            out = {"layers": seg, "len": L, "valid": int(length),
+                   "bytes": nbytes}
+        if prof is not None and out is not None:
+            self.devprof.end(prof, payload_bytes=out["bytes"])
+        return out
 
     def restore_row(self, model_id: int, row: int,
                     payload: Dict[str, Any]) -> int:
@@ -1772,21 +1896,39 @@ class InferenceManager:
         (the restore half of the KV pager; any row — restores need not
         land where the spill came from).  Returns the bytes moved."""
         record = self.models[model_id]
+        # sample only HOST-staged restores (numpy payloads): the
+        # disagg direct path feeds committed device arrays, and its
+        # device-link rate would pollute the host-link calibration
+        # fit (phase 'restore' is a HOST_LINK_PHASES member)
+        on_host = any(isinstance(a, np.ndarray)
+                      for lp in payload["layers"].values()
+                      for a in lp.values())
+        prof = (self.devprof.begin("restore",
+                                   self._devprof_path(record))
+                if on_host else None)
         if "pp_stages" in record:
-            return self._restore_row_pp(record, row, payload)
-        if record.get("paged"):
+            nbytes = self._restore_row_pp(record, row, payload)
+        elif record.get("paged"):
             assert payload.get("paged"), (
                 "restore_row: dense payload into a paged record")
-            return self._restore_row_paged(record, row, payload)
-        L = payload["len"]
-        key = ("restore_row", L)
-        if key not in record["steps"]:
-            record["steps"][key] = self._build_restore_row(record, L)
-        seg = jax.tree.map(_feed_array, payload["layers"])
-        record["caches"] = _retry_transient(
-            record["steps"][key], record["caches"], seg,
-            _feed_array(np.int32(row)))
-        return int(payload["bytes"])
+            nbytes = self._restore_row_paged(record, row, payload)
+        else:
+            L = payload["len"]
+            key = ("restore_row", L)
+            if key not in record["steps"]:
+                record["steps"][key] = self._build_restore_row(record, L)
+            seg = jax.tree.map(_feed_array, payload["layers"])
+            record["caches"] = _retry_transient(
+                record["steps"][key], record["caches"], seg,
+                _feed_array(np.int32(row)))
+            nbytes = int(payload["bytes"])
+        if prof is not None:
+            # the donated row write is async — block to time it; this
+            # adds a sync the restore path would not otherwise pay, so
+            # tick the odometer (im=self)
+            self.devprof.end(prof, result=record["caches"], im=self,
+                             payload_bytes=nbytes)
+        return nbytes
 
     def reset_request_rows(self, model_id: int, rows: List[int]):
         """Zero cache bookkeeping for retired rows.  Cache contents need no

@@ -150,9 +150,12 @@ class RecoveryPolicy:
                  device_bandwidth: Optional[float] = None,
                  migrate_mode: str = "auto"):
         if machine is None:
-            from ..search.cost_model import SimpleMachineModel
+            # default_machine honors a calibrated FF_MACHINE_PROFILE
+            # (tools/ffprof.py --calibrate) — measured hbm/link rates
+            # price restore/recompute/migrate instead of the datasheet
+            from ..search.cost_model import default_machine
 
-            machine = SimpleMachineModel(1)
+            machine = default_machine()
         assert mode in ("auto", "restore", "recompute"), mode
         assert migrate_mode in ("auto", "migrate", "recompute"), \
             migrate_mode

@@ -1,0 +1,428 @@
+"""Device profiling plane (observability/devprof.py): CompileReport
+harvest at the AOT compile sites, sampled per-dispatch device timing,
+cost-model drift gauges, and the calibrate -> machine-profile ->
+RecoveryPolicy feedback loop."""
+
+import json
+import os
+import sys
+import threading
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from flexflow_tpu.observability import (METRICS_SCHEMA,  # noqa: E402
+                                        MetricsRegistry, get_devprof,
+                                        get_registry,
+                                        set_telemetry_enabled)
+from flexflow_tpu.observability.devprof import (  # noqa: E402
+    CompileReport, DispatchProfiler, calibrate_machine_profile,
+    drift_table, harvest_compile_report, step_key_str)
+from flexflow_tpu.search.cost_model import (MachineModel,  # noqa: E402
+                                            SimpleMachineModel,
+                                            default_machine)
+from flexflow_tpu.serving.batch_config import BatchConfig  # noqa: E402
+from flexflow_tpu.serving.kv_pager import RecoveryPolicy  # noqa: E402
+from tools.ffload import build_tiny_engine  # noqa: E402
+
+
+def _decode_bc(rows=2, seq=128):
+    bc = BatchConfig(rows, 1)
+    bc.request_guid[:] = np.arange(1, rows + 1)
+    bc.request_available[:] = True
+    bc.first_token_depth[:] = np.arange(3, 3 + rows)
+    bc.num_tokens_in_batch[:] = 1
+    bc.max_sequence_length[:] = seq
+    bc.token_ids[:, 0] = np.arange(5, 5 + rows)
+    return bc
+
+
+def _private_profiler(sample_every=1, machine=None):
+    reg = MetricsRegistry(schema=METRICS_SCHEMA, enabled=True)
+    return DispatchProfiler(registry=reg, sample_every=sample_every,
+                            machine=machine), reg
+
+
+# ------------------------------------------------------ compile reports
+class TestCompileReportHarvest:
+    def test_cpu_record_harvests_reports_and_gauges(self):
+        im, mid, _ = build_tiny_engine(max_requests=2, seed=31)
+        bc = _decode_bc()
+        np.asarray(im.decode_block(mid, bc, 4, jax.random.PRNGKey(0)))
+        im.note_host_sync()
+        reports = im.compile_reports(mid)
+        assert reports, "AOT compile site harvested nothing"
+        key, rd = next(iter(reports.items()))
+        assert key.startswith("block:4"), key
+        # XLA's own analysis: a 2-layer transformer block must count
+        # real flops and real HBM traffic
+        assert rd["flops"] > 0
+        assert rd["bytes_accessed"] > 0
+        assert rd["peak_bytes"] >= rd["argument_bytes"] > 0
+        # the gauges are exposed under (model, step) labels
+        g = get_registry().get("serving_compiled_flops")
+        assert g is not None
+        assert g.value(model=mid, step=key) == rd["flops"]
+
+    def test_harvest_on_raw_compiled(self):
+        f = jax.jit(lambda a, b: (a @ b).sum())
+        x = jnp.ones((32, 32), jnp.float32)
+        rep = harvest_compile_report(f.lower(x, x).compile(),
+                                     ("k", 32, None), model=7)
+        assert rep is not None
+        assert rep.key == step_key_str(("k", 32, None)) == "k:32:_"
+        assert rep.flops > 0
+        d2 = CompileReport.from_dict(rep.as_dict())
+        assert d2.as_dict() == rep.as_dict()
+
+    def test_prefill_and_decode_variants_both_reported(self):
+        im, mid, rm = build_tiny_engine(max_requests=2, seed=32)
+        reqs = [rm.register_new_request(list(range(2, 10)),
+                                        max_new_tokens=6)
+                for _ in range(2)]
+        rm.generate_incr_decoding(im, mid, reqs)
+        keys = list(im.compile_reports(mid))
+        assert any(k.startswith("block:") for k in keys), keys
+        # at least one non-block (prefill chunk) variant compiled too
+        assert any(not k.startswith("block:") for k in keys), keys
+
+
+# ------------------------------------------------------------- sampling
+class TestSamplingCadence:
+    def test_every_nth_per_phase_path(self):
+        prof, _ = _private_profiler(sample_every=3)
+        hits = [prof.begin("decode", "dense") is not None
+                for _ in range(9)]
+        assert hits == [False, False, True] * 3
+        # independent counters per (phase, path)
+        assert prof.begin("prefill", "dense") is None
+        assert prof.begin("decode", "paged") is None
+
+    def test_zero_means_off(self):
+        prof, _ = _private_profiler(sample_every=0)
+        assert all(prof.begin("decode", "dense") is None
+                   for _ in range(8))
+        prof.set_sample_every(1)
+        assert prof.begin("decode", "dense") is not None
+
+    def test_observe_respects_sampling_off(self):
+        # external feeds (the disagg migrator) route through observe()
+        # directly — FF_DEVPROF_SAMPLE=0 must silence those too, or
+        # "0 = off" would be a lie for migrate-heavy serves
+        prof, reg = _private_profiler(sample_every=0)
+        prof.observe("migrate", "dense", 0.01, payload_bytes=1024)
+        assert prof.snapshot()["samples"] == []
+        assert reg.get("serving_devprof_samples_total").value() == 0
+        prof.set_sample_every(4)
+        prof.observe("migrate", "dense", 0.01, payload_bytes=1024)
+        assert len(prof.snapshot()["samples"]) == 1
+
+    def test_disabled_registry_is_noop(self):
+        prof, reg = _private_profiler(sample_every=1)
+        reg.disable()
+        assert prof.begin("decode", "dense") is None
+        prof.observe("decode", "dense", 0.01)
+        assert prof.snapshot()["samples"] == []
+        reg.enable()
+        assert prof.begin("decode", "dense") is not None
+
+    def test_global_profiler_noop_under_telemetry_off(self):
+        dp = get_devprof()
+        prev = dp.sample_every
+        dp.set_sample_every(1)
+        try:
+            set_telemetry_enabled(False)
+            assert dp.begin("decode", "dense") is None
+        finally:
+            set_telemetry_enabled(
+                os.environ.get("FF_TELEMETRY", "1") != "0")
+            dp.set_sample_every(prev)
+
+    def test_end_ticks_note_host_sync_only_when_im_passed(self):
+        prof, _ = _private_profiler(sample_every=1)
+
+        class _IM:
+            syncs = 0
+
+            def note_host_sync(self):
+                self.syncs += 1
+
+        im = _IM()
+        s = prof.begin("restore", "dense")
+        prof.end(s, result=jnp.ones(4), im=im)
+        assert im.syncs == 1
+        s = prof.begin("decode", "dense")
+        prof.end(s, result=jnp.ones(4))
+        assert im.syncs == 1
+
+
+# ------------------------------------------------------------ drift math
+class TestDriftMath:
+    def test_drift_against_pinned_machine(self):
+        machine = SimpleMachineModel(1, peak_flops=1e12,
+                                     hbm_bandwidth=1e11)
+        prof, reg = _private_profiler(sample_every=1, machine=machine)
+        rep = CompileReport("block:8", model=0, flops=2.0e9,
+                            bytes_accessed=1.0e9)
+        # t_flops = 2e9/1e12 = 2ms; t_mem = 1e9/1e11 = 10ms
+        assert rep.t_flops(machine) == pytest.approx(2e-3)
+        assert rep.t_mem(machine) == pytest.approx(10e-3)
+        assert rep.predicted_s(machine) == pytest.approx(10e-3)
+        prof.observe("decode", "dense", 5e-3, report=rep)
+        g = reg.get("serving_costmodel_drift_ratio")
+        assert g.value(phase="decode", path="dense") == pytest.approx(
+            2.0)
+        a = reg.get("serving_devprof_roofline_attainment")
+        assert a.value(phase="decode", path="dense",
+                       bound="mem") == pytest.approx(2.0)
+        assert a.value(phase="decode", path="dense",
+                       bound="flops") == pytest.approx(0.4)
+        # the per-(phase, path) device-seconds series landed too
+        h = reg.get("serving_devprof_device_seconds").snapshot()
+        assert h["series"]["path=dense,phase=decode"]["count"] == 1
+
+    def test_drift_table_medians(self):
+        prof, _ = _private_profiler(sample_every=1)
+        rep = CompileReport("k", model=0, flops=1e9,
+                            bytes_accessed=1e9)
+        m = SimpleMachineModel(1, hbm_bandwidth=1e11, peak_flops=1e13)
+        for dt in (0.01, 0.02, 0.03):
+            prof.observe("decode", "dense", dt, report=rep, machine=m)
+        rows = drift_table(prof.snapshot())
+        assert len(rows) == 1
+        r = rows[0]
+        assert r["samples"] == 3
+        assert r["measured_s_p50"] == pytest.approx(0.02)
+        assert r["predicted_s_p50"] == pytest.approx(0.01)
+        assert r["drift_ratio"] == pytest.approx(0.5)
+
+
+# ----------------------------------------------------------- calibration
+class TestCalibration:
+    def _snap_with_rates(self):
+        prof, _ = _private_profiler(sample_every=1)
+        rep = CompileReport("b", model=0, flops=4e9,
+                            bytes_accessed=2e9)
+        prof.observe("decode", "dense", 0.020, report=rep)   # 100 GB/s
+        prof.observe("prefill", "dense", 0.008, report=rep)  # 0.5 TF/s
+        prof.observe("spill", "dense", 1.0, payload_bytes=10**9)
+        prof.observe("migrate", "dense", 0.1, payload_bytes=10**9)
+        return prof.snapshot()
+
+    def test_fit_and_from_json_roundtrip(self, tmp_path):
+        prof = calibrate_machine_profile(self._snap_with_rates())
+        assert prof["hbm_gbps"] == pytest.approx(100.0)
+        assert prof["peak_tflops"] == pytest.approx(0.5)
+        assert prof["dcn_gbps"] == pytest.approx(1.0)
+        assert prof["device_link_gbps"] == pytest.approx(10.0)
+        p = tmp_path / "machine_profile.json"
+        p.write_text(json.dumps(prof))
+        m = MachineModel.from_json(str(p))
+        assert m.hbm_bandwidth == pytest.approx(100e9)
+        assert m.peak_flops == pytest.approx(0.5e12)
+        assert m.dcn_bandwidth == pytest.approx(1e9)
+        assert m.device_link_bandwidth == pytest.approx(10e9)
+        # partial profiles keep the v5e defaults for absent keys
+        m2 = MachineModel.from_json({"hbm_gbps": 50.0})
+        assert m2.hbm_bandwidth == pytest.approx(50e9)
+        assert m2.peak_flops == pytest.approx(197e12)
+
+    def test_calibrated_profile_prices_recovery_policy(self, tmp_path):
+        prof = calibrate_machine_profile(self._snap_with_rates())
+        p = tmp_path / "machine_profile.json"
+        p.write_text(json.dumps(prof))
+        m = MachineModel.from_json(str(p))
+        pol = RecoveryPolicy(machine=m, flops_per_token=2e6,
+                             weight_bytes=1e6, prefill_chunk=256)
+        # restore prices against the CALIBRATED host link (1 GB/s)
+        assert pol.restore_s(10**9) == pytest.approx(1.0)
+        # migrate against the calibrated device link (10 GB/s)
+        assert pol.migrate_s(10**9) == pytest.approx(
+            0.1 + m.ici_latency)
+        # recompute's weight stream term uses the calibrated hbm_bw
+        base = RecoveryPolicy(machine=SimpleMachineModel(1),
+                              flops_per_token=2e6, weight_bytes=1e6,
+                              prefill_chunk=256)
+        assert pol.recompute_s(1024) > base.recompute_s(1024)
+
+    def test_from_json_num_devices_deference(self, tmp_path,
+                                             monkeypatch):
+        # the profile's own (calibrated-box) device count loads unless
+        # the caller explicitly models a different topology
+        m = MachineModel.from_json({"num_devices": 4,
+                                    "hbm_gbps": 50.0})
+        assert m.num_devices == 4
+        assert MachineModel.from_json({"num_devices": 4},
+                                      num_devices=2).num_devices == 2
+        p = tmp_path / "mp.json"
+        p.write_text(json.dumps({"num_devices": 4}))
+        monkeypatch.setenv("FF_MACHINE_PROFILE", str(p))
+        assert default_machine().num_devices == 4
+        assert default_machine(2).num_devices == 2
+
+    def test_direct_restore_payload_not_sampled_as_host_link(self):
+        # the disagg direct path restores committed DEVICE arrays —
+        # its device-link rate must not pollute the host-link
+        # ('restore' phase) calibration fit
+        dp = get_devprof()
+        prev = dp.sample_every
+        dp.set_sample_every(1)
+        try:
+            im, mid, _ = build_tiny_engine(max_requests=2, seed=36)
+            bc = _decode_bc()
+            np.asarray(im.decode_block(mid, bc, 4,
+                                       jax.random.PRNGKey(0)))
+            im.note_host_sync()
+
+            def restores():
+                return [s for s in dp.snapshot()["samples"]
+                        if s["phase"] == "restore"]
+
+            dev = im.fetch_row(mid, 0, 8, to_host=False)
+            im.restore_row(mid, 1, dev)
+            assert restores() == [], "device payload sampled as host"
+            host = im.fetch_row(mid, 0, 8)
+            im.restore_row(mid, 1, host)
+            assert len(restores()) == 1
+        finally:
+            dp.set_sample_every(prev)
+
+    def test_default_machine_honors_env_profile(self, tmp_path,
+                                                monkeypatch):
+        p = tmp_path / "machine_profile.json"
+        p.write_text(json.dumps({"hbm_gbps": 123.0,
+                                 "device_link_gbps": 7.0}))
+        monkeypatch.setenv("FF_MACHINE_PROFILE", str(p))
+        m = default_machine(1)
+        assert m.hbm_bandwidth == pytest.approx(123e9)
+        assert m.device_link_bandwidth == pytest.approx(7e9)
+        # RecoveryPolicy's default machine picks it up (the feedback
+        # edge the calibration workflow exists for)
+        pol = RecoveryPolicy(weight_bytes=1e6, flops_per_token=2e6)
+        assert pol.machine.hbm_bandwidth == pytest.approx(123e9)
+        # unreadable profile falls back to the datasheet defaults
+        monkeypatch.setenv("FF_MACHINE_PROFILE",
+                           str(tmp_path / "missing.json"))
+        assert default_machine(1).hbm_bandwidth == pytest.approx(819e9)
+
+
+# -------------------------------------------------- live-serve coverage
+class TestLiveServeSampling:
+    def test_drift_gauges_populated_on_cpu_serve(self):
+        """The acceptance-criterion serve: sampling on, a mixed
+        workload on a CPU record -> the drift gauge carries decode,
+        prefill AND hybrid phases (the hybrid step fuses the mixed
+        fold; pure-prefill chunks run before any row decodes)."""
+        dp = get_devprof()
+        prev = dp.sample_every
+        dp.set_sample_every(1)
+        try:
+            from flexflow_tpu.serving import RequestManager
+
+            im, mid, _ = build_tiny_engine(max_requests=4, seed=33)
+            # a small chunk budget staggers the fold: short rows
+            # finish their prompt after chunk 1 and decode while the
+            # long row still prefills -> hybrid steps dispatch
+            rm = RequestManager(max_requests_per_batch=4,
+                                max_tokens_per_batch=16,
+                                max_sequence_length=256,
+                                decode_block=4)
+            prompts = [list(range(2, 5)), list(range(2, 5)),
+                       list(range(2, 42))]
+            reqs = [rm.register_new_request(p, max_new_tokens=8)
+                    for p in prompts]
+            rm.generate_incr_decoding(im, mid, reqs)
+            g = get_registry().get("serving_costmodel_drift_ratio")
+            for phase in ("decode", "prefill", "hybrid"):
+                assert g.value(phase=phase, path="dense") > 0, (
+                    phase, g.snapshot())
+            snap = dp.snapshot()
+            phases = {s["phase"] for s in snap["samples"]}
+            assert {"decode", "prefill", "hybrid"} <= phases, phases
+        finally:
+            dp.set_sample_every(prev)
+
+    def test_zero_recompiles_with_profiler_live(self):
+        from flexflow_tpu.utils.debugging import retrace_guard
+
+        dp = get_devprof()
+        prev = dp.sample_every
+        dp.set_sample_every(1)
+        try:
+            im, mid, _ = build_tiny_engine(max_requests=2, seed=34)
+            bc = _decode_bc()
+            rng = jax.random.PRNGKey(0)
+            with retrace_guard(max_compiles=None) as warm:
+                np.asarray(im.decode_block(mid, bc, 4, rng))
+                im.note_host_sync()
+            if warm.compiles == 0:
+                pytest.skip("this JAX emits no compile monitoring "
+                            "events")
+            with retrace_guard() as g:
+                for _ in range(3):
+                    np.asarray(im.decode_block(mid, bc, 4, rng))
+                    im.note_host_sync()
+            assert g.compiles == 0, g.events
+        finally:
+            dp.set_sample_every(prev)
+
+    def test_devprof_off_adds_no_syncs_on_async_prefill(self):
+        """FF_DEVPROF off (sample_every=0): a mid-prompt prefill chunk
+        must stay ASYNC — the zero-added-host-syncs acceptance gate."""
+        im, mid, _ = build_tiny_engine(max_requests=2, seed=35)
+        bc = BatchConfig(2, 8)
+        bc.request_guid[:] = [1, 2]
+        bc.request_available[:] = True
+        bc.first_token_depth[:] = 0
+        bc.num_tokens_in_batch[:] = 8
+        bc.max_sequence_length[:] = 128
+        bc.token_ids[:] = np.arange(16).reshape(2, 8)
+        before = im.host_syncs
+        im.inference(mid, bc, rng=jax.random.PRNGKey(0))
+        assert im.host_syncs == before, (
+            "a prefill dispatch synced with devprof off")
+
+
+# ----------------------------------------------------- concurrent churn
+class TestSnapshotChurn:
+    def test_8_thread_observe_and_snapshot(self):
+        prof, _ = _private_profiler(sample_every=1)
+        rep = CompileReport("k", model=0, flops=1e9,
+                            bytes_accessed=1e9)
+        errors = []
+
+        def churn(i):
+            try:
+                for j in range(200):
+                    s = prof.begin("decode", f"p{i % 2}")
+                    if s is not None:
+                        prof.end(s, report=rep)
+                    if j % 16 == 0:
+                        snap = prof.snapshot()
+                        assert isinstance(snap["samples"], list)
+                        drift_table(snap)
+                    prof.register_report(rep)
+            except Exception as e:  # pragma: no cover - failure path
+                errors.append(e)
+
+        threads = [threading.Thread(target=churn, args=(i,))
+                   for i in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errors, errors
+        snap = prof.snapshot()
+        # ring stays bounded under churn
+        assert len(snap["samples"]) <= 512
+        assert sum(snap["counts"].values()) == 8 * 200
+
+
+if __name__ == "__main__":
+    sys.exit(pytest.main([__file__, "-q"]))

@@ -836,6 +836,50 @@ class TestMetricSchemaRule:
         assert at(fs, "metric-schema", 3), fs
         assert len(fs) == 2
 
+    def test_devprof_names_covered_by_real_schema(self, tmp_path):
+        # the device-profiling vocabulary validates against the
+        # CHECKED-IN schema (baseline stays EMPTY): the compiled-record
+        # gauges, the sampled device-seconds histogram/counter, the
+        # roofline/drift gauges and the compile-report/devprof-sample
+        # events are all declared; rogue siblings are still flagged
+        src = """\
+            def wire(m, rec):
+                a = m.gauge("serving_compiled_flops")
+                b = m.gauge("serving_compiled_bytes_accessed")
+                c = m.gauge("serving_compiled_peak_bytes")
+                d = m.histogram("serving_devprof_device_seconds")
+                e = m.counter("serving_devprof_samples_total")
+                f = m.gauge("serving_devprof_roofline_attainment")
+                g = m.gauge("serving_costmodel_drift_ratio")
+                rec.record_event("compile-report", model=0,
+                                 key="block:8", flops=4.0e9,
+                                 bytes=2.0e9)
+                rec.record_event("devprof-sample", phase="decode",
+                                 path="dense", seconds=0.002)
+                return a, b, c, d, e, f, g
+            """
+        path = tmp_path / "serving" / "devprof_fixture.py"
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_text(textwrap.dedent(src))
+        ctx = LintContext(repo_root=REPO)   # exec-loads the real schema
+        fs = lint_file(str(path), self.R, ctx,
+                       rel="serving/devprof_fixture.py",
+                       judge_suppressions=True)
+        assert fs == []
+        rogue = tmp_path / "serving" / "devprof_rogue.py"
+        rogue.write_text(textwrap.dedent("""\
+            def wire(m, rec):
+                m.counter("serving_devprof_device_seconds")
+                rec.record_event("devprof-sampled")
+            """))
+        fs = lint_file(str(rogue), self.R, ctx,
+                       rel="serving/devprof_rogue.py",
+                       judge_suppressions=True)
+        # histogram declared as counter spelling flagged; rogue event
+        assert at(fs, "metric-schema", 2), fs
+        assert at(fs, "metric-schema", 3), fs
+        assert len(fs) == 2
+
 
 # --------------------------------------------------- direct host sync
 class TestDirectHostSyncRule:

@@ -1,0 +1,376 @@
+#!/usr/bin/env python
+"""Device-profiling inspector: compile reports, drift tables, cost-model
+calibration, and the paged-kernel compile probe.
+
+Reads any of:
+
+- a **watchdog bundle** (``ffbundle_*.json`` — its ``devprof`` section
+  carries the compile-report registry + the sampled per-dispatch
+  device-seconds ring leading into the dump);
+- a **bench round record** (``bench_results/<round>.json`` — rounds
+  stamp the active records' CompileReports and the drift table);
+- a **raw devprof snapshot** (``DispatchProfiler.snapshot()`` JSON —
+  a dict with ``samples``/``reports``).
+
+Renders per-record compile reports (XLA's own FLOPs / HBM bytes
+accessed / peak-footprint per compiled step variant) and the
+measured-vs-predicted drift table (cost-model roofline over measured
+device seconds, per (phase, path)).
+
+Modes:
+
+``--calibrate [--out PATH]``
+    Fit a machine-profile JSON from the snapshot's sample ring
+    (observability/devprof.calibrate_machine_profile): decode/hybrid
+    samples pin the effective HBM bandwidth, prefill/verify samples the
+    flop rate, spill/restore the host link, migrations the device
+    link.  Load the result back with ``FF_MACHINE_PROFILE=PATH`` —
+    ``search.cost_model.default_machine`` feeds it into the KV pager's
+    RecoveryPolicy, the disagg migrate pricing, the hybrid rider
+    budget and devprof's own drift gauges.
+
+``--compile-probe``
+    Attempt REAL (non-interpret) Mosaic compiles of the paged decode /
+    prefill kernels and compare against the host-side shape gates
+    (``paged_path_ok`` / ``paged_prefill_path_ok``; ``_pick_tc_paged``
+    picks are printed) — the ROADMAP BENCH_r06(b) calibration item.
+    The paged kernels are interpret-validated on CPU; only a TPU
+    backend exercises the Mosaic lowering, so this SKIPS (exit 0) off
+    chip unless ``--force`` is given.
+
+``--selftest``
+    Synthetic end-to-end smoke (run_tier1.sh): harvest a real compiled
+    report, feed a profiler samples across every phase class, render
+    both tables, calibrate, round-trip the profile through
+    ``MachineModel.from_json`` and require the loaded ``hbm_bw`` to
+    reproduce the measured step time within 2x.
+
+Exit 1 on unreadable input or (for --compile-probe) a gate mismatch.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Any, Dict, List, Optional
+
+# direct invocation (`python tools/ffprof.py`) puts tools/ on sys.path,
+# not the repo root — the package imports need it
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+
+# --------------------------------------------------------------- loading
+def load(path: str) -> Dict[str, Any]:
+    with open(path) as f:
+        doc = json.load(f)
+    if not isinstance(doc, dict):
+        raise ValueError(f"{path}: expected a JSON object")
+    return doc
+
+
+def devprof_snapshot(doc: Dict[str, Any]) -> Optional[Dict[str, Any]]:
+    """The devprof snapshot inside any supported document shape."""
+    dp = doc.get("devprof")
+    if isinstance(dp, dict):
+        return dp
+    if "samples" in doc or "reports" in doc:
+        return doc
+    sb = doc.get("stall_bundle")
+    if isinstance(sb, dict) and isinstance(sb.get("devprof"), dict):
+        return sb["devprof"]
+    return None
+
+
+# ------------------------------------------------------------- rendering
+def _mb(n: float) -> str:
+    return f"{n / 1e6:.2f}"
+
+
+def render_reports(snap: Dict[str, Any]) -> str:
+    """Compile-report table: one row per compiled step variant."""
+    reports = snap.get("reports") or {}
+    if not reports:
+        return "(no compile reports harvested)"
+    lines = [f"{'model/step':<44} {'MFLOP':>10} {'MB-acc':>9} "
+             f"{'argMB':>8} {'outMB':>8} {'tmpMB':>8} {'peakMB':>8}"]
+    for key, r in sorted(reports.items()):
+        lines.append(
+            f"{key:<44} {r.get('flops', 0) / 1e6:>10.3f} "
+            f"{_mb(r.get('bytes_accessed', 0)):>9} "
+            f"{_mb(r.get('argument_bytes', 0)):>8} "
+            f"{_mb(r.get('output_bytes', 0)):>8} "
+            f"{_mb(r.get('temp_bytes', 0)):>8} "
+            f"{_mb(r.get('peak_bytes', 0)):>8}")
+    return "\n".join(lines)
+
+
+def render_drift(snap: Dict[str, Any]) -> str:
+    """Measured-vs-predicted table per (phase, path): the drift ratio
+    is predicted/measured — 1.0 means the machine model prices this
+    hardware right; >>1 means the constants are optimistic (the
+    --calibrate workflow exists to close it)."""
+    from flexflow_tpu.observability.devprof import drift_table
+
+    rows = drift_table(snap)
+    if not rows:
+        return "(no device-time samples)"
+    lines = [f"{'phase':<12} {'path':<7} {'n':>5} {'measured_p50':>13} "
+             f"{'predicted_p50':>14} {'drift':>8}"]
+    for r in rows:
+        pred = (f"{r['predicted_s_p50'] * 1e3:.3f}ms"
+                if "predicted_s_p50" in r else "-")
+        drift = (f"{r['drift_ratio']:.4f}" if "drift_ratio" in r
+                 else "-")
+        lines.append(
+            f"{r['phase']:<12} {r['path']:<7} {r['samples']:>5} "
+            f"{r['measured_s_p50'] * 1e3:>11.3f}ms {pred:>14} "
+            f"{drift:>8}")
+    return "\n".join(lines)
+
+
+def print_doc(path: str, doc: Dict[str, Any]) -> int:
+    snap = devprof_snapshot(doc)
+    if snap is None:
+        print(f"{path}: no devprof section (enable sampling with "
+              f"FF_DEVPROF_SAMPLE=N and re-capture)", file=sys.stderr)
+        return 1
+    print(f"== {path}")
+    se = snap.get("sample_every")
+    if se is not None:
+        print(f"sampling: every {se or 'OFF'} dispatch(es) per "
+              f"(phase, path); counts "
+              f"{snap.get('counts') or {}}")
+    print("\n-- compile reports (XLA cost/memory analysis per "
+          "compiled step)")
+    print(render_reports(snap))
+    print("\n-- cost-model drift (predicted/measured per phase)")
+    print(render_drift(snap))
+    return 0
+
+
+# ------------------------------------------------------------ calibration
+def cmd_calibrate(paths: List[str], out: Optional[str]) -> int:
+    from flexflow_tpu.observability.devprof import (
+        calibrate_machine_profile)
+
+    samples: List[Dict[str, Any]] = []
+    for path in paths:
+        snap = devprof_snapshot(load(path))
+        if snap:
+            samples.extend(snap.get("samples") or [])
+    if not samples:
+        print("ffprof --calibrate: no device-time samples in the "
+              "input(s); serve with FF_DEVPROF_SAMPLE=N first",
+              file=sys.stderr)
+        return 1
+    prof = calibrate_machine_profile({"samples": samples})
+    text = json.dumps(prof, indent=1)
+    if out:
+        with open(out, "w") as f:
+            f.write(text + "\n")
+        print(f"machine profile -> {out}  (load with "
+              f"FF_MACHINE_PROFILE={out})")
+    print(text)
+    return 0
+
+
+# ---------------------------------------------------------- compile probe
+def _probe_case(label: str, dtype, quant: bool) -> Dict[str, Any]:
+    """One real-compile attempt of the paged decode AND prefill
+    kernels vs their host gates.  Returns the per-case report dict."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from flexflow_tpu.kernels.flash_decode import (paged_decode_attention,
+                                                   paged_path_ok)
+    from flexflow_tpu.kernels.flash_prefill import (_pick_tc_paged,
+                                                    paged_prefill_attend,
+                                                    paged_prefill_path_ok)
+
+    R, KV, H, D, L, F, MP = 2, 1, 2, 128, 32, 8, 4
+    C = 32                              # legal for bf16 AND int8 gates
+    pk = jnp.zeros((F, KV, L, D), dtype)
+    pv = jnp.zeros((F, KV, L, D), dtype)
+    table = jnp.asarray(np.arange(R * MP, dtype=np.int32).reshape(R, MP))
+    depth = jnp.asarray([5, 9], jnp.int32)
+    active = jnp.ones((R,), bool)
+    q1 = jnp.zeros((R, 1, H, D), jnp.float32)
+    qC = jnp.zeros((R, C, H, D), jnp.float32)
+    kn = jnp.zeros((R, KV, D), jnp.float32)
+    scales = ((jnp.zeros((F, KV, L), jnp.float32),) * 2 if quant
+              else (None, None))
+
+    def attempt(fn, *args) -> Any:
+        try:
+            jax.jit(fn).lower(*args).compile()
+            return True
+        except Exception as e:
+            return f"{type(e).__name__}: {str(e).splitlines()[0][:120]}"
+
+    dec_gate = paged_path_ok(1, pk, None)
+    dec_ok = attempt(
+        lambda q, k, v, a, b, t, d, ac: paged_decode_attention(
+            q, k, v, a, b, t, d, ac, 1.0, interpret=False,
+            k_scale=scales[0], v_scale=scales[1]),
+        q1, kn, kn, pk, pv, table, depth, active)
+    pre_gate = paged_prefill_path_ok(C, pk, None)
+    ntok = jnp.full((R,), C, jnp.int32)
+    pre_ok = attempt(
+        lambda q, a, b, t, d, n, ac: paged_prefill_attend(
+            q, a, b, t, d, n, ac, 1.0, interpret=False,
+            k_scale=scales[0], v_scale=scales[1]),
+        qC, pk, pv, table, depth, ntok, active)
+    return {"case": label,
+            "decode": {"gate": dec_gate, "compile": dec_ok,
+                       "mismatch": dec_gate != (dec_ok is True)},
+            "prefill": {"gate": pre_gate, "compile": pre_ok,
+                        "tc_pick": _pick_tc_paged(C, L, KV, 1),
+                        "mismatch": pre_gate != (pre_ok is True)}}
+
+
+def cmd_compile_probe(force: bool = False) -> int:
+    """Real (non-interpret) Mosaic compiles of the paged kernels vs
+    the host shape gates — the gates were calibrated against
+    interpret-mode only until run on chip (BENCH_r06(b))."""
+    import jax
+    import jax.numpy as jnp
+
+    plat = jax.devices()[0].platform
+    if plat != "tpu" and not force:
+        print(f"ffprof --compile-probe: SKIPPED (platform={plat}; "
+              f"real Mosaic compiles need a TPU backend — run on chip "
+              f"for the BENCH_r06(b) gate calibration, or pass "
+              f"--force to attempt anyway)")
+        return 0
+    rc = 0
+    for label, dtype, quant in (("bf16", jnp.bfloat16, False),
+                                ("int8", jnp.int8, True)):
+        rep = _probe_case(label, dtype, quant)
+        for phase in ("decode", "prefill"):
+            r = rep[phase]
+            status = ("ok" if r["compile"] is True
+                      else f"FAILED ({r['compile']})")
+            mm = "  << GATE MISMATCH" if r["mismatch"] else ""
+            extra = (f" tc_pick={r['tc_pick']}"
+                     if "tc_pick" in r else "")
+            print(f"paged {phase:<8} {label}: gate="
+                  f"{'ok' if r['gate'] else 'reject'} "
+                  f"compile={status}{extra}{mm}")
+            if r["mismatch"]:
+                rc = 1
+    if rc:
+        print("=> gate mismatch: paged_path_ok/_pick_tc_paged admit "
+              "shapes Mosaic rejects (or vice versa) — recalibrate "
+              "the gates (kernels/flash_{decode,prefill}.py)",
+              file=sys.stderr)
+    return rc
+
+
+# ---------------------------------------------------------------- selftest
+def selftest() -> int:
+    """End-to-end smoke (run_tier1.sh): real compile-report harvest,
+    synthetic samples across every calibration phase class, both
+    renderers, and the calibrate -> from_json -> RecoveryPolicy loop
+    with the 2x reproduction gate."""
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+
+    from flexflow_tpu.observability import METRICS_SCHEMA, MetricsRegistry
+    from flexflow_tpu.observability.devprof import (
+        CompileReport, DispatchProfiler, calibrate_machine_profile,
+        harvest_compile_report)
+    from flexflow_tpu.search.cost_model import MachineModel
+
+    # 1) REAL harvest: a tiny jitted program's cost analysis
+    f = jax.jit(lambda a, b: (a @ b).sum())
+    x = jnp.ones((64, 64), jnp.float32)
+    compiled = f.lower(x, x).compile()
+    report = harvest_compile_report(compiled, ("probe", 64), model=0)
+    ok = report is not None and report.flops > 0
+    # 2) a private profiler fed one sample per phase class
+    reg = MetricsRegistry(schema=METRICS_SCHEMA, enabled=True)
+    prof = DispatchProfiler(registry=reg, sample_every=1)
+    step = CompileReport("block:8", model=0, flops=4.0e9,
+                         bytes_accessed=2.0e9)
+    # decode: 2 GB in 20 ms -> effective hbm 100 GB/s
+    prof.observe("decode", "dense", 0.020, report=step)
+    prof.observe("decode", "dense", 0.020, report=step)
+    # prefill: 4 GFLOP in 8 ms -> 0.5 TFLOP/s
+    prof.observe("prefill", "dense", 0.008, report=step)
+    # host link: 1 GB in 1 s; device link: 1 GB in 0.1 s
+    prof.observe("spill", "dense", 1.0, payload_bytes=10**9)
+    prof.observe("migrate", "dense", 0.1, payload_bytes=10**9)
+    prof.register_report(report)
+    snap = prof.snapshot()
+    ok = ok and len(snap["samples"]) == 5 and snap["reports"]
+    ok = ok and "(no" not in render_reports(snap)
+    ok = ok and "(no" not in render_drift(snap)
+    # 3) calibrate -> JSON -> from_json -> reproduction within 2x
+    pr = calibrate_machine_profile(snap)
+    d = tempfile.mkdtemp(prefix="ffprof_selftest_")
+    out = os.path.join(d, "machine_profile.json")
+    with open(out, "w") as fh:
+        json.dump(pr, fh)
+    m = MachineModel.from_json(out)
+    measured = 0.020
+    predicted = step.bytes_accessed / m.hbm_bandwidth
+    ok = ok and measured / 2 <= predicted <= measured * 2
+    ok = ok and abs(m.peak_flops - 0.5e12) / 0.5e12 < 0.01
+    ok = ok and abs(m.dcn_bandwidth - 1e9) / 1e9 < 0.01
+    ok = ok and abs(m.device_link_bandwidth - 1e10) / 1e10 < 0.01
+    # 4) the document pipeline end-to-end (bundle-shaped doc)
+    doc_path = os.path.join(d, "doc.json")
+    with open(doc_path, "w") as fh:
+        json.dump({"devprof": snap}, fh)
+    ok = ok and print_doc(doc_path, load(doc_path)) == 0
+    ok = ok and cmd_calibrate([doc_path],
+                              os.path.join(d, "p2.json")) == 0
+    print(f"\nffprof selftest {'OK' if ok else 'FAILED'}: {out}")
+    return 0 if ok else 1
+
+
+def main(argv) -> int:
+    ap = argparse.ArgumentParser(
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("paths", nargs="*",
+                    help="bundle / bench-record / devprof-snapshot JSON")
+    ap.add_argument("--calibrate", action="store_true")
+    ap.add_argument("--out", default=None, metavar="PATH",
+                    help="--calibrate output file (default: stdout)")
+    ap.add_argument("--compile-probe", action="store_true")
+    ap.add_argument("--force", action="store_true",
+                    help="attempt the compile probe off-TPU too")
+    ap.add_argument("--selftest", action="store_true")
+    args = ap.parse_args(argv[1:])
+    if args.selftest:
+        return selftest()
+    if args.compile_probe:
+        return cmd_compile_probe(force=args.force)
+    if not args.paths:
+        ap.print_usage(sys.stderr)
+        return 1
+    if args.calibrate:
+        return cmd_calibrate(args.paths, args.out)
+    rc = 0
+    for path in args.paths:
+        try:
+            doc = load(path)
+        except Exception as e:
+            print(f"{path}: unreadable ({type(e).__name__}: {e})",
+                  file=sys.stderr)
+            rc = 1
+            continue
+        rc = max(rc, print_doc(path, doc))
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))

@@ -255,6 +255,37 @@ def diagnosis(doc: Dict[str, Any],
                     "=> spilled requests are waiting on pages — "
                     "inspect each with `tools/ffreq.py BUNDLE "
                     "--guid G` (preempt->restore/recompute spans)")
+    dp = doc.get("devprof")
+    if isinstance(dp, dict) and (dp.get("samples")
+                                 or dp.get("sample_every")):
+        # per-phase device-seconds tail: a stall whose window holds
+        # healthy recent device time points at a hung NEXT dispatch
+        # (compile/collective/dead tunnel); one with ZERO sampled
+        # device time is host-side (scheduler/queue/lock) — different
+        # bug classes (full tables: tools/ffprof.py BUNDLE)
+        by_phase: Dict[str, List[float]] = defaultdict(list)
+        for s in dp.get("samples") or []:
+            if isinstance(s, dict) and "seconds" in s:
+                by_phase[f"{s.get('phase', '?')}/"
+                         f"{s.get('path', '?')}"].append(s["seconds"])
+        if by_phase:
+            lines.append(
+                "device time (devprof, sampled 1/"
+                f"{dp['sample_every']}): " + "  ".join(
+                    f"{ph} n={len(v)} last={v[-1] * 1e3:.2f}ms "
+                    f"max={max(v) * 1e3:.2f}ms"
+                    for ph, v in sorted(by_phase.items())))
+        else:
+            lines.append(
+                "device time (devprof): sampling armed "
+                f"(1/{dp['sample_every']}) but ZERO dispatches "
+                "sampled in the window")
+            if reason and str(reason).startswith("stall"):
+                lines.append(
+                    "=> no device time sampled while stalled: the "
+                    "driver never reached a dispatch — look "
+                    "host-side (admission/scheduler/lock), not at "
+                    "the chip")
     jx = doc.get("jax")
     if isinstance(jx, dict) and jx:
         lines.append("jax: " + " ".join(

@@ -28,6 +28,15 @@
 (cd "$(dirname "$0")/.." \
  && env JAX_PLATFORMS=cpu python tools/ffstat.py --selftest >/dev/null) \
  || { echo "ffstat/flight-recorder selftest FAILED" >&2; exit 1; }
+# Device-profiling/ffprof smoke: compile-report harvest (real XLA
+# cost analysis of a tiny jitted program), sampled-timing rendering,
+# and the calibrate -> machine-profile JSON -> MachineModel.from_json
+# -> RecoveryPolicy pricing loop with its 2x reproduction gate — so a
+# broken measurement/calibration path fails CI before a BENCH chip
+# round claims measured-vs-predicted evidence from it.
+(cd "$(dirname "$0")/.." \
+ && env JAX_PLATFORMS=cpu python tools/ffprof.py --selftest >/dev/null) \
+ || { echo "ffprof/devprof selftest FAILED" >&2; exit 1; }
 # Request-ledger/ffreq smoke: the per-request twin (ledger lifecycle ->
 # snapshot on disk -> pretty-print -> SLO attainment/goodput check) so
 # a broken per-request accounting path fails CI before a BENCH round
