@@ -18,9 +18,10 @@ distilled SSM weights would approach).
 
 Modes: `python bench.py [all|llama|llama7b|spec|spec7b|mnist|kernels|opt|
 resnet|longctx|quality|distill|crossover|prefix|kvdtype]` (default all).
-`kvdtype` A/Bs the int8 KV cache against bf16 on one decode workload
-(tokens/s, cache HBM, greedy parity); `--kv-dtype {bf16,int8}` instead
-forces the cache dtype on the standard serving decode modes.  Every
+`kvdtype` A/Bs a quantized KV cache against bf16 on one decode workload
+(tokens/s, cache HBM, greedy parity, path-gate fallbacks) — int8 by
+default, int4 under `--kv-dtype int4`; on other modes `--kv-dtype
+{bf16,int8,int4}` forces the cache dtype on the serving decode path.  Every
 record carries `kv_cache_dtype`, `cache_hbm_bytes` and `host_syncs`
 (per-section detail under "kv_cache") so trajectories can attribute
 wins to cache dtype and sync count.
@@ -301,10 +302,11 @@ def _start_watchdog(budget):
               file=sys.stderr)
     return _WATCHDOG
 
-# --kv-dtype override ("bf16" | "int8" | None) applied to the serving
-# decode benches' cache allocations, so BENCH trajectories can A/B the
-# int8 KV cache on the standard workloads; the dedicated `kvdtype` mode
-# runs both dtypes in one invocation regardless of this flag.
+# --kv-dtype override ("bf16" | "int8" | "int4" | None) applied to the
+# serving decode benches' cache allocations, so BENCH trajectories can
+# A/B the quantized KV cache on the standard workloads; the dedicated
+# `kvdtype` mode runs bf16 + the quantized arm in one invocation (int4
+# when this flag says int4, int8 otherwise).
 _KV_DTYPE = None
 
 # per-section KV-cache/bandwidth notes (label -> fields), stamped into
@@ -1803,15 +1805,22 @@ def bench_prefix(model_builder=None, max_requests=4, system_len=512,
 
 def bench_kv_dtype(model_builder=None, max_requests=8, prompt_len=32,
                    new_tokens=96, max_seq_length=512,
-                   max_tokens_per_batch=64, decode_block=32):
-    """int8-KV-cache A/B (`--kv-dtype` mode): the same greedy decode
-    workload served twice — ``kv_cache_dtype="bf16"`` (= the computation
-    dtype, the pre-existing cache) vs ``"int8"`` (int8 K/V + f32
-    per-row-per-position-per-head scales) — reporting decode tokens/s
+                   max_tokens_per_batch=64, decode_block=32,
+                   quant_dtype="int8"):
+    """Quantized-KV-cache A/B (`--kv-dtype` mode): the same greedy
+    decode workload served twice — ``kv_cache_dtype="bf16"`` (= the
+    computation dtype, the pre-existing cache) vs ``quant_dtype``
+    ("int8": int8 K/V + f32 per-row-per-position-per-head scales;
+    "int4": 2 codes packed per int8 carrier byte, same scale frames —
+    ``--kv-dtype int4`` selects this arm) — reporting decode tokens/s
     for both, cache HBM from KVCacheStats (resident bytes and the
     bytes-per-attended-token stream cost, whose ratio at equal
-    (rows, alloc_len) is the acceptance gate's <= 0.55x), and
-    greedy-token parity (match fraction + first divergence step).
+    (rows, alloc_len) is the acceptance gate's <= 0.55x int8 / <=
+    0.35x int4), greedy-token parity (match fraction + first
+    divergence step; int4's coarser codes CAN flip near-tied argmaxes
+    — the flag is the evidence either way), and each arm's
+    ``serving_kernel_path_total{reason=path_gate}`` fallback delta
+    (silent kernel fallbacks attribute to their arm).
 
     ``model_builder``: optional ``() -> (model, vocab_size)`` override
     so the CPU test suite can run the same A/B on a tiny model
@@ -1838,6 +1847,15 @@ def bench_kv_dtype(model_builder=None, max_requests=8, prompt_len=32,
     rng = np.random.default_rng(0)
     prompts = None
 
+    def path_gate_counts():
+        from flexflow_tpu.observability import get_registry
+
+        snap = get_registry().snapshot()["counters"].get(
+            "serving_kernel_path_total") or {}
+        labels = snap.get("labels") or {}
+        return {k: v for k, v in labels.items()
+                if "reason=path_gate" in k}
+
     def run(kv_dtype):
         nonlocal prompts
         model, vocab = model_builder()
@@ -1863,6 +1881,7 @@ def bench_kv_dtype(model_builder=None, max_requests=8, prompt_len=32,
 
         serve()                      # warmup: compile the shape buckets
         _clear_ledger_window()
+        gates0 = path_gate_counts()
         best_tps, reqs = 0.0, None
         for _ in range(3):
             t0 = time.time()
@@ -1871,11 +1890,16 @@ def bench_kv_dtype(model_builder=None, max_requests=8, prompt_len=32,
             tot = sum(len(r.tokens) - r.prompt_len for r in reqs)
             best_tps = max(best_tps, tot / dt)
         stats = im.kv_cache_stats(mid)
+        # this arm's silent-fallback delta (labels carry cache=..., so
+        # multi-arm runs attribute each fallback to its dtype)
+        gates = {k: v - gates0.get(k, 0)
+                 for k, v in path_gate_counts().items()
+                 if v - gates0.get(k, 0)}
         _note_kv(im, mid, f"kvdtype_{kv_dtype}")
-        return best_tps, stats, [list(r.tokens) for r in reqs]
+        return best_tps, stats, [list(r.tokens) for r in reqs], gates
 
-    tps_bf, s_bf, toks_bf = run("bf16")
-    tps_q, s_q, toks_q = run("int8")
+    tps_bf, s_bf, toks_bf, gates_bf = run("bf16")
+    tps_q, s_q, toks_q, gates_q = run(quant_dtype)
 
     # parity over the GENERATED tokens (prompts echo by construction)
     gen_bf = [t for p, ts in zip(prompts, toks_bf) for t in ts[len(p):]]
@@ -1894,24 +1918,30 @@ def bench_kv_dtype(model_builder=None, max_requests=8, prompt_len=32,
     # 32-aligned) normalized out
     hbm_ratio = s_q.bytes_per_token / max(1, s_bf.bytes_per_token)
     head = {
-        "metric": "kv_cache_int8_decode_speedup",
+        "metric": f"kv_cache_{quant_dtype}_decode_speedup",
         "value": round(tps_q / max(1e-9, tps_bf), 3),
-        "unit": "x (int8-KV decode tokens/s / bf16-KV, same workload)",
+        "unit": (f"x ({quant_dtype}-KV decode tokens/s / bf16-KV, "
+                 f"same workload)"),
         "methodology": (f"greedy,batch{max_requests},"
                         f"prompt{prompt_len},new{new_tokens},best-of-3"),
         "vs_baseline": 0,
         "bf16_tokens_per_s": round(tps_bf, 1),
-        "int8_tokens_per_s": round(tps_q, 1),
+        f"{quant_dtype}_tokens_per_s": round(tps_q, 1),
         "cache_hbm_ratio": round(hbm_ratio, 4),
         "greedy_match_frac": round(match, 4),
         "greedy_divergence_step": div,
+        # per-arm silent-fallback deltas: non-empty means some dispatch
+        # fell back through a shape gate during the timed rounds (the
+        # int8 16-chunk bug class — zero is the healthy reading)
+        "path_gate_fallbacks_bf16": gates_bf,
+        f"path_gate_fallbacks_{quant_dtype}": gates_q,
     }
     extras = [
         {"metric": "kv_cache_bf16_hbm_bytes",
          "value": s_bf.bytes_resident, "unit": "bytes",
          "bytes_per_token": s_bf.bytes_per_token,
          "alloc_len": s_bf.alloc_len, "vs_baseline": 0},
-        {"metric": "kv_cache_int8_hbm_bytes",
+        {"metric": f"kv_cache_{quant_dtype}_hbm_bytes",
          "value": s_q.bytes_resident, "unit": "bytes",
          "bytes_per_token": s_q.bytes_per_token,
          "alloc_len": s_q.alloc_len, "vs_baseline": 0},
@@ -2275,6 +2305,11 @@ def bench_disagg(model_builder=None, max_requests=4, bystander_prompt=24,
         "prefill_rows": prefill_rows,
         "migrations": dict(mig.migrations),
         "migration_bytes": mig.bytes_total,
+        # A/B stamp for the SJF prefill-slice batcher
+        # (FF_PREFILL_SJF=1 admits shortest-prefill-first instead of
+        # FCFS) — run the mode once per order and diff victim_ttft /
+        # tpot_p99 between the stamped rows
+        "prefill_sjf": os.environ.get("FF_PREFILL_SJF", "0") == "1",
     }
     extras = [
         {"metric": "disagg_bystander_tpot_p50",
@@ -3084,7 +3119,8 @@ def main(which: str, budget=None):
         head["extras"] = extras
         return head
     if which == "kvdtype":
-        head, *extras = bench_kv_dtype()
+        head, *extras = bench_kv_dtype(
+            quant_dtype=("int4" if _KV_DTYPE == "int4" else "int8"))
         head["extras"] = extras
         return head
     if which == "mixed":
@@ -3484,11 +3520,13 @@ if __name__ == "__main__":
              "timed_out field, instead of dying rc=124 under an external "
              "timeout with no output")
     _ap.add_argument(
-        "--kv-dtype", choices=("bf16", "int8"), default=None,
+        "--kv-dtype", choices=("bf16", "int8", "int4"), default=None,
         help="force the serving decode modes' KV-cache storage dtype "
-             "(int8 = quantized cache + f32 per-head scales; halves "
-             "decode cache HBM reads).  The `kvdtype` mode A/Bs both "
-             "dtypes in one run regardless of this flag.")
+             "(int8 = quantized cache + f32 per-head scales, halves "
+             "decode cache HBM reads; int4 = 2 codes packed per "
+             "carrier byte, quarters them).  The `kvdtype` mode A/Bs "
+             "bf16 against the quantized arm in one run — int8 by "
+             "default, int4 when this flag says int4.")
     _ap.add_argument(
         "--slo-ttft", type=float, metavar="SECONDS",
         default=(float(os.environ["FF_BENCH_SLO_TTFT"])

@@ -62,11 +62,13 @@ class FFConfig:
     offload_reserve_space_size: int = 0
     quantization: Optional[str] = None  # "int8" | "int4" | None
     # KV-cache storage dtype for serving: "bf16" (= the computation
-    # dtype — the pre-existing behavior, bit-identical default) or
+    # dtype — the pre-existing behavior, bit-identical default),
     # "int8" (per-row-per-position-per-head scales beside int8 K/V —
-    # halves decode cache HBM reads and doubles resident rows x context;
+    # halves decode cache HBM reads and doubles resident rows x context)
+    # or "int4" (two codes per int8 carrier byte along the sequence
+    # axis — quarter-bandwidth decode attend, ~4x resident context;
     # see docs/INTERNALS.md "KV cache memory layout & dtype")
-    kv_cache_dtype: Optional[str] = None  # "bf16" | "int8" | None
+    kv_cache_dtype: Optional[str] = None  # "bf16" | "int8" | "int4" | None
     # int8 serving matmuls run MXU-NATIVE (int8 x int8 -> int32) with
     # dynamic per-row activation quantization (W8A8) instead of the
     # exact convert-dot (W8A16).  ~20% faster weight streaming on v5e

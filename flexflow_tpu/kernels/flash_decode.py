@@ -66,10 +66,23 @@ def _init_scratch(m_sc, l_sc, acc_sc):
     acc_sc[:] = jnp.zeros_like(acc_sc)
 
 
+def _unpack_int4_tile(t, kv, ts, d):
+    """In-register unpack of a packed-int4 carrier tile ``[kv, ts//2,
+    d]`` int8 -> sign-extended codes ``[kv, ts, d]`` int32 (low nibble
+    = even logical position).  int32 arithmetic: Mosaic's shift/mask
+    support is widest there, and the codes feed a convert-to-float
+    next anyway.  The interleave is a minor-dim stack + sublane-merge
+    reshape — the lane dim (d) is untouched."""
+    t32 = t.astype(jnp.int32)
+    lo = (t32 << 28) >> 28                     # sign-extend low nibble
+    hi = t32 >> 4                              # arithmetic: high nibble
+    return jnp.stack([lo, hi], axis=2).reshape(kv, ts, d)
+
+
 def _online_softmax_step(r, t, depth_ref, act_ref, q_ref, k_ref, v_ref,
                          slopes_ref, m_sc, l_sc, acc_sc,
                          *, ts, kv, g, d, s_total, scale,
-                         ks_ref=None, vs_ref=None):
+                         ks_ref=None, vs_ref=None, pack: int = 1):
     """One S-tile of the running softmax (shared by the full and partial
     kernels).
 
@@ -78,11 +91,19 @@ def _online_softmax_step(r, t, depth_ref, act_ref, q_ref, k_ref, v_ref,
     int8 (half the bf16 bytes); dequantization happens in-register —
     K's scale folds into the logits AFTER the dot (exact: the scale is
     constant along the contracted head_dim), V's scale folds into the
-    probabilities before the PV dot."""
+    probabilities before the PV dot.
+
+    ``pack`` = 2 (int4 carriers): the K/V tiles arrive PACKED at half
+    the logical tile width ``[1, KV, TS//2, D]`` — a quarter of bf16's
+    HBM bytes — and unpack in-register before the dots; the scale
+    tiles and every mask stay at the logical width."""
     kvg = kv * g
     qv = q_ref[:].reshape(kv, g, d)
-    kt = k_ref[:].reshape(kv, ts, d)           # native layout: no swap
-    vt = v_ref[:].reshape(kv, ts, d)
+    kt = k_ref[:].reshape(kv, ts // pack, d)   # native layout: no swap
+    vt = v_ref[:].reshape(kv, ts // pack, d)
+    if pack == 2:
+        kt = _unpack_int4_tile(kt, kv, ts, d)
+        vt = _unpack_int4_tile(vt, kv, ts, d)
     if ks_ref is not None:
         # int8 values are exact in bf16/f32; the dot runs on the raw
         # codes and the per-position scale multiplies the logits tile
@@ -147,7 +168,8 @@ def _kernel(last_ref, depth_ref, act_ref,      # scalar prefetch
             *rest,                             # [ks, vs], [slopes], outs,
             ts: int, kv: int, g: int, d: int,  # scratch
             s_total: int, scale: float,
-            alibi: bool, partial: bool, quant: bool = False):
+            alibi: bool, partial: bool, quant: bool = False,
+            pack: int = 1):
     from jax.experimental import pallas as pl
 
     ks_ref = vs_ref = None
@@ -174,7 +196,8 @@ def _kernel(last_ref, depth_ref, act_ref,      # scalar prefetch
         _online_softmax_step(r, t, depth_ref, act_ref, q_ref, k_ref,
                              v_ref, slopes_ref, m_sc, l_sc, acc_sc,
                              ts=ts, kv=kv, g=g, d=d, s_total=s_total,
-                             scale=scale, ks_ref=ks_ref, vs_ref=vs_ref)
+                             scale=scale, ks_ref=ks_ref, vs_ref=vs_ref,
+                             pack=pack)
 
     @pl.when(t == nt - 1)
     def _finish():
@@ -192,14 +215,16 @@ def _kernel(last_ref, depth_ref, act_ref,      # scalar prefetch
 
 
 def _pick_ts(S: int, KV: int, D: int,
-             budget_bytes: int = 5 * 1024 * 1024, itemsize: int = 2):
+             budget_bytes: int = 5 * 1024 * 1024, itemsize: int = 2,
+             pack: int = 1):
     """One row per program (finest pruning granularity — measured best
     on chip) with the largest S tile the VMEM budget allows.  The budget
     covers the double-buffered K+V tiles (``itemsize`` bytes each — 1
-    for int8 caches, whose f32 scale tiles add 8 more bytes/position);
-    f32 logits temps take roughly another budget's worth, which together
-    must stay under the ~16 MB scoped-VMEM limit."""
-    per_pos = KV * D * 2 * itemsize * 2    # k+v, cache dtype, dbl buffer
+    for int8 caches, whose f32 scale tiles add 8 more bytes/position;
+    int4 carriers pack ``pack`` positions per byte so the code bytes
+    halve again); f32 logits temps take roughly another budget's worth,
+    which together must stay under the ~16 MB scoped-VMEM limit."""
+    per_pos = KV * D * 2 * itemsize * 2 // pack   # k+v codes, dbl buffer
     if itemsize == 1:
         per_pos += KV * 4 * 2 * 2          # k+v f32 scale tiles
     for ts in (1024, 512, 256, 128):
@@ -214,16 +239,20 @@ def _attend_call(q, ck, cv, depth, active, scale, interpret, ts,
     from jax.experimental.pallas import tpu as pltpu
 
     R, H, D = q.shape
-    KV, S = ck.shape[1], ck.shape[2]
+    KV = ck.shape[1]
     G = H // KV
-    assert H == KV * G and ck.shape == cv.shape == (R, KV, S, D)
     quant = k_scale is not None
     assert quant == (v_scale is not None)
+    # pack factor from static shapes: int4 carriers hold 2 codes/byte
+    # along axis 2 while the scale frames keep the LOGICAL length
+    pack = (k_scale.shape[2] // ck.shape[2]) if quant else 1
+    S = ck.shape[2] * pack
+    assert H == KV * G and ck.shape == cv.shape == (R, KV, S // pack, D)
     if quant:
         assert k_scale.shape == v_scale.shape == (R, KV, S), (
             k_scale.shape, (R, KV, S))
     if ts is None:
-        ts = _pick_ts(S, KV, D, itemsize=ck.dtype.itemsize)
+        ts = _pick_ts(S, KV, D, itemsize=ck.dtype.itemsize, pack=pack)
     nt = pl.cdiv(S, ts)
     depth = depth.astype(jnp.int32)
     active = active.astype(jnp.int32)
@@ -240,14 +269,19 @@ def _attend_call(q, ck, cv, depth, active, scale, interpret, ts,
     alibi = slopes is not None
     kernel = functools.partial(_kernel, ts=ts, kv=KV, g=G, d=D,
                                s_total=S, scale=float(scale),
-                               alibi=alibi, partial=partial, quant=quant)
+                               alibi=alibi, partial=partial, quant=quant,
+                               pack=pack)
+    # packed carriers tile at ts//pack bytes per logical ts-tile; the
+    # block-INDEX space is unchanged (carrier block t covers logical
+    # positions [t*ts, (t+1)*ts)), so the clamped pruning maps are
+    # shared verbatim with the full-width layouts
     in_specs = [
         pl.BlockSpec((1, H, D), lambda r, t, *_: (r, 0, 0)),
-        pl.BlockSpec((1, KV, ts, D),
+        pl.BlockSpec((1, KV, ts // pack, D),
                      lambda r, t, last, *_: (r, 0,
                                              jnp.minimum(t, last[r]),
                                              0)),
-        pl.BlockSpec((1, KV, ts, D),
+        pl.BlockSpec((1, KV, ts // pack, D),
                      lambda r, t, last, *_: (r, 0,
                                              jnp.minimum(t, last[r]),
                                              0)),
@@ -327,9 +361,23 @@ def flash_decode_attend_partial(q, ck, cv, depth, active, scale: float,
                         v_scale=v_scale)
 
 
+def _nibble_merge(win, new, sel, nib):
+    """Merge int4 ``new`` codes ``[KV, 1, D]`` into the carrier bytes
+    of an RMW window ``[KV, w, D]`` at the ``sel``-marked row: ``nib``
+    (the logical depth's parity) picks the low or high nibble; the
+    neighbouring nibble keeps its old value.  int32 arithmetic, then a
+    wrap-around cast back to the int8 carrier."""
+    old = win.astype(jnp.int32)
+    c4 = new.astype(jnp.int32) & 0x0F
+    merged = jnp.where(nib > 0,
+                       (old & 0x0F) | (c4 << 4),
+                       (old & ~0x0F) | c4)
+    return jnp.where(sel, merged, old).astype(win.dtype)
+
+
 def _append_kernel(depth_ref, act_ref,           # scalar prefetch
                    *refs,                        # see below
-                   w: int, quant: bool):
+                   w: int, quant: bool, pack: int = 1):
     """Per-row in-place cache append: ck[r, :, depth[r], :] = k_new[r].
 
     ``refs``: knew, vnew (VMEM [R, KV, 1, D] float), then for quantized
@@ -352,7 +400,14 @@ def _append_kernel(depth_ref, act_ref,           # scalar prefetch
     InferenceManager).  For quantized caches the NEW TOKEN IS QUANTIZED
     IN-KERNEL inside the window overlay (rint(x / scale) on the float
     payload; the scale itself is a tiny XLA-side reduction scattered
-    into the [R, KV, S] scale tensor by the wrapper)."""
+    into the [R, KV, S] scale tensor by the wrapper).
+
+    ``pack`` = 2 (int4 carriers): ``depth`` stays LOGICAL; the target
+    byte is carrier row depth//2 and depth's parity picks the nibble,
+    merged against the byte's other nibble (_nibble_merge).  The w=32
+    carrier-row window then spans 64 LOGICAL positions — the PR-2
+    32-alignment invariant widens to 64, enforced by the wrapper's
+    carrier-extent assert and the path gates."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -365,11 +420,13 @@ def _append_kernel(depth_ref, act_ref,           # scalar prefetch
         ksc_ref = vsc_ref = None
 
     r = pl.program_id(0)
+    qmax = 7 if pack == 2 else 127
 
     @pl.when(act_ref[r] > 0)
     def _():
         d = depth_ref[r]
-        base = (d // w) * w
+        row = d // pack                        # carrier row of depth
+        base = (row // w) * w
         ink = pltpu.make_async_copy(
             ck_out.at[r, :, pl.ds(base, w), :], win_k, sem_k)
         inv = pltpu.make_async_copy(
@@ -378,15 +435,21 @@ def _append_kernel(depth_ref, act_ref,           # scalar prefetch
         inv.start()
         ink.wait()
         inv.wait()
-        sel = jax.lax.broadcasted_iota(jnp.int32, (1, w, 1), 1) == (d - base)
+        sel = jax.lax.broadcasted_iota(jnp.int32, (1, w, 1), 1) \
+            == (row - base)
         kn, vn = knew_ref[r], vnew_ref[r]
         if quant:
             kn = jnp.clip(jnp.rint(kn.astype(jnp.float32) / ksc_ref[r]),
-                          -127, 127)
+                          -qmax, qmax)
             vn = jnp.clip(jnp.rint(vn.astype(jnp.float32) / vsc_ref[r]),
-                          -127, 127)
-        win_k[:] = jnp.where(sel, kn.astype(win_k.dtype), win_k[:])
-        win_v[:] = jnp.where(sel, vn.astype(win_v.dtype), win_v[:])
+                          -qmax, qmax)
+        if pack == 2:
+            nib = d - row * 2                  # logical parity
+            win_k[:] = _nibble_merge(win_k[:], kn, sel, nib)
+            win_v[:] = _nibble_merge(win_v[:], vn, sel, nib)
+        else:
+            win_k[:] = jnp.where(sel, kn.astype(win_k.dtype), win_k[:])
+            win_v[:] = jnp.where(sel, vn.astype(win_v.dtype), win_v[:])
         outk = pltpu.make_async_copy(
             win_k, ck_out.at[r, :, pl.ds(base, w), :], sem_k)
         outv = pltpu.make_async_copy(
@@ -399,7 +462,7 @@ def _append_kernel(depth_ref, act_ref,           # scalar prefetch
 
 def cache_append(ck, cv, k_new, v_new, depth, active,
                  interpret: bool = False, k_scale_new=None,
-                 v_scale_new=None):
+                 v_scale_new=None, pack: int = 1):
     """In-place (donated/aliased) single-token KV append on [R,KV,S,D]
     caches via async DMA — the Pallas twin of _scatter_chunk for the
     flash path.  Inactive rows write nothing.
@@ -408,17 +471,23 @@ def cache_append(ck, cv, k_new, v_new, depth, active,
     the per-head scales of the NEW token — quantization.quantize_kv's
     scale half); the kernel quantizes the float payload in-kernel.  The
     caller owns scattering the scales into the [R, KV, S] scale tensor
-    (flash_decode_attention does both)."""
+    (flash_decode_attention does both).
+
+    ``pack`` = 2 (int4 carriers, ck axis 2 at HALF the logical length):
+    ``depth`` stays logical and the kernel merges the +-7 code into the
+    target byte's nibble; the scales come from quantize_kv_int4."""
     import functools as _ft
 
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
-    R, KV, S, D = ck.shape
+    R, KV, S_c, D = ck.shape
+    S = S_c * pack                 # logical positions
     quant = ck.dtype.itemsize == 1
-    w = 32 if quant else 16
-    assert S % w == 0, (S, w)  # aligned windows must stay in bounds
+    w = 32 if quant else 16        # CARRIER-row window (64 logical int4)
+    assert S_c % w == 0, (S_c, w)  # aligned windows must stay in bounds
     assert quant == (k_scale_new is not None) == (v_scale_new is not None)
+    assert pack == 1 or quant, pack
     depth = jnp.clip(depth.astype(jnp.int32), 0, S - 1)
     active = active.astype(jnp.int32)
     in_specs = [
@@ -448,7 +517,7 @@ def cache_append(ck, cv, k_new, v_new, depth, active,
                         pltpu.SemaphoreType.DMA(())],
     )
     return pl.pallas_call(
-        _ft.partial(_append_kernel, w=w, quant=quant),
+        _ft.partial(_append_kernel, w=w, quant=quant, pack=pack),
         grid_spec=grid_spec,
         out_shape=(jax.ShapeDtypeStruct(ck.shape, ck.dtype),
                    jax.ShapeDtypeStruct(cv.shape, cv.dtype)),
@@ -463,25 +532,30 @@ def flash_decode_attention(q, k_new, v_new, ck, cv, depth, active,
     """Scatter-then-attend decode step (drop-in for the op layer): writes
     the new token's K/V at each active row's depth (in place, Pallas
     DMA), then runs the length-tiled attention.  Caches are
-    [R, KV, S, D].  Returns (out [R,H,D], ck, cv) — int8 caches (when
-    ``k_scale``/``v_scale`` [R, KV, S] f32 are passed) additionally
-    return the updated scale tensors:
+    [R, KV, S, D].  Returns (out [R,H,D], ck, cv) — quantized caches
+    (when ``k_scale``/``v_scale`` [R, KV, S] f32 are passed; int4
+    carriers are detected from the carrier/scale length ratio)
+    additionally return the updated scale tensors:
     (out, ck, cv, k_scale, v_scale)."""
     if k_scale is not None:
-        from ..quantization import quantize_kv, scatter_kv_scales
+        from ..quantization import (quantize_kv, quantize_kv_int4,
+                                    scatter_kv_scales)
 
+        pack = k_scale.shape[2] // ck.shape[2]
         # clamp ONCE, shared by the code write and the scale write:
         # cache_append clamps internally but scatter_kv_scales drops
         # out-of-range positions, and a clamped code paired with a
         # dropped (stale) scale would dequantize garbage at S-1
-        depth = jnp.clip(depth.astype(jnp.int32), 0, ck.shape[2] - 1)
+        depth = jnp.clip(depth.astype(jnp.int32), 0,
+                         k_scale.shape[2] - 1)
         # the q half is dead code XLA drops — only the scale is needed
         # here, the kernel quantizes the payload in-window itself
-        _, k_sc = quantize_kv(k_new)                    # [R, KV]
-        _, v_sc = quantize_kv(v_new)
+        qfn = quantize_kv_int4 if pack == 2 else quantize_kv
+        _, k_sc = qfn(k_new)                            # [R, KV]
+        _, v_sc = qfn(v_new)
         ck, cv = cache_append(ck, cv, k_new, v_new, depth, active,
                               interpret=interpret, k_scale_new=k_sc,
-                              v_scale_new=v_sc)
+                              v_scale_new=v_sc, pack=pack)
         k_scale = scatter_kv_scales(k_scale, k_sc[:, None], depth, active)
         v_scale = scatter_kv_scales(v_scale, v_sc[:, None], depth, active)
         out = flash_decode_attend(q, ck, cv, depth, active, scale,
@@ -553,6 +627,9 @@ def flash_decode_attention_sharded(q, k_new, v_new, ck, cv, depth,
     slope_spec = P(tp_ax)
     has_alibi = slopes is not None
     quant = k_scale is not None
+    # int4 pack factor from the GLOBAL shapes (sp shards carrier and
+    # scale lengths in lockstep, so the per-shard ratio matches)
+    pack = (k_scale.shape[2] // ck.shape[2]) if quant else 1
     depth = depth.astype(jnp.int32)
     active = active.astype(jnp.int32)
 
@@ -560,18 +637,20 @@ def flash_decode_attention_sharded(q, k_new, v_new, ck, cv, depth,
         rest = list(rest)
         ks, vs = (rest.pop(0), rest.pop(0)) if quant else (None, None)
         sl = rest.pop(0) if has_alibi else None
-        S_l = ck.shape[2]
+        S_l = ck.shape[2] * pack               # LOGICAL shard extent
         s0 = (jax.lax.axis_index(sp_ax) * S_l) if sp > 1 else 0
         loc = depth - s0                       # signed local depth
         app_act = active * ((loc >= 0) & (loc < S_l))
         if quant:
-            from ..quantization import quantize_kv, scatter_kv_scales
+            from ..quantization import (quantize_kv, quantize_kv_int4,
+                                        scatter_kv_scales)
 
-            _, k_sc = quantize_kv(kn)
-            _, v_sc = quantize_kv(vn)
+            qfn = quantize_kv_int4 if pack == 2 else quantize_kv
+            _, k_sc = qfn(kn)
+            _, v_sc = qfn(vn)
             ck, cv = cache_append(ck, cv, kn, vn, loc, app_act,
                                   interpret=interpret, k_scale_new=k_sc,
-                                  v_scale_new=v_sc)
+                                  v_scale_new=v_sc, pack=pack)
             ks = scatter_kv_scales(ks, k_sc[:, None], loc, app_act)
             vs = scatter_kv_scales(vs, v_sc[:, None], loc, app_act)
         else:
@@ -656,13 +735,16 @@ def _paged_attend_call(q, pk, pv, table, depth, active, scale,
     from jax.experimental.pallas import tpu as pltpu
 
     R, H, D = q.shape
-    F, KV, L, _ = pk.shape
+    F, KV = pk.shape[:2]
     G = H // KV
     P = table.shape[1]
-    assert H == KV * G and pk.shape == pv.shape == (F, KV, L, D)
-    assert table.shape == (R, P), (table.shape, (R, P))
     quant = k_scale is not None
     assert quant == (v_scale is not None)
+    # int4 pack factor from the carrier/scale-frame length ratio
+    pack = (k_scale.shape[2] // pk.shape[2]) if quant else 1
+    L = pk.shape[2] * pack         # LOGICAL page length
+    assert H == KV * G and pk.shape == pv.shape == (F, KV, L // pack, D)
+    assert table.shape == (R, P), (table.shape, (R, P))
     if quant:
         assert k_scale.shape == v_scale.shape == (F, KV, L), (
             k_scale.shape, (F, KV, L))
@@ -680,13 +762,14 @@ def _paged_attend_call(q, pk, pv, table, depth, active, scale,
     alibi = slopes is not None
     kernel = functools.partial(_paged_kernel, ts=L, kv=KV, g=G, d=D,
                                s_total=nt * L, scale=float(scale),
-                               alibi=alibi, partial=False, quant=quant)
+                               alibi=alibi, partial=False, quant=quant,
+                               pack=pack)
     kv_map = lambda r, t, tab, last, *_: (  # noqa: E731 — shared by K/V
         tab[r, jnp.minimum(t, last[r])], 0, 0, 0)
     in_specs = [
         pl.BlockSpec((1, H, D), lambda r, t, *_: (r, 0, 0)),
-        pl.BlockSpec((1, KV, L, D), kv_map),
-        pl.BlockSpec((1, KV, L, D), kv_map),
+        pl.BlockSpec((1, KV, L // pack, D), kv_map),
+        pl.BlockSpec((1, KV, L // pack, D), kv_map),
     ]
     inputs = [q, pk, pv]
     if quant:
@@ -734,13 +817,16 @@ def paged_decode_attend(q, pk, pv, table, depth, active, scale: float,
 
 
 def _paged_append_kernel(frame_ref, off_ref, act_ref,   # scalar prefetch
-                         *refs, w: int, quant: bool):
+                         *refs, w: int, quant: bool, pack: int = 1):
     """Per-row in-place single-token append into the FRAME holding the
     row's current depth: pk[frame[r], :, off[r], :] = k_new[r].  The
     same ``w``-aligned RMW window as the dense kernel (16 bf16 / 32
     int8 — page_len % 32 == 0 keeps every window inside one frame),
     with the window base computed inside the frame instead of the
-    row slab."""
+    row slab.  ``pack`` = 2: ``off`` is the LOGICAL in-frame offset;
+    the code nibble-merges into carrier row off//2 like the dense
+    twin (page_len % 64 == 0 keeps the 32-carrier-row window inside
+    one frame)."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -753,12 +839,14 @@ def _paged_append_kernel(frame_ref, off_ref, act_ref,   # scalar prefetch
         ksc_ref = vsc_ref = None
 
     r = pl.program_id(0)
+    qmax = 7 if pack == 2 else 127
 
     @pl.when(act_ref[r] > 0)
     def _():
         f = frame_ref[r]
         off = off_ref[r]
-        base = (off // w) * w
+        row = off // pack                      # carrier row in frame
+        base = (row // w) * w
         ink = pltpu.make_async_copy(
             ck_out.at[f, :, pl.ds(base, w), :], win_k, sem_k)
         inv = pltpu.make_async_copy(
@@ -768,15 +856,20 @@ def _paged_append_kernel(frame_ref, off_ref, act_ref,   # scalar prefetch
         ink.wait()
         inv.wait()
         sel = jax.lax.broadcasted_iota(jnp.int32, (1, w, 1), 1) \
-            == (off - base)
+            == (row - base)
         kn, vn = knew_ref[r], vnew_ref[r]
         if quant:
             kn = jnp.clip(jnp.rint(kn.astype(jnp.float32) / ksc_ref[r]),
-                          -127, 127)
+                          -qmax, qmax)
             vn = jnp.clip(jnp.rint(vn.astype(jnp.float32) / vsc_ref[r]),
-                          -127, 127)
-        win_k[:] = jnp.where(sel, kn.astype(win_k.dtype), win_k[:])
-        win_v[:] = jnp.where(sel, vn.astype(win_v.dtype), win_v[:])
+                          -qmax, qmax)
+        if pack == 2:
+            nib = off - row * 2
+            win_k[:] = _nibble_merge(win_k[:], kn, sel, nib)
+            win_v[:] = _nibble_merge(win_v[:], vn, sel, nib)
+        else:
+            win_k[:] = jnp.where(sel, kn.astype(win_k.dtype), win_k[:])
+            win_v[:] = jnp.where(sel, vn.astype(win_v.dtype), win_v[:])
         outk = pltpu.make_async_copy(
             win_k, ck_out.at[f, :, pl.ds(base, w), :], sem_k)
         outv = pltpu.make_async_copy(
@@ -789,24 +882,27 @@ def _paged_append_kernel(frame_ref, off_ref, act_ref,   # scalar prefetch
 
 def paged_cache_append(pk, pv, k_new, v_new, table, depth, active,
                        interpret: bool = False, k_scale_new=None,
-                       v_scale_new=None):
+                       v_scale_new=None, pack: int = 1):
     """In-place (aliased) single-token KV append on paged
     [F,KV,page_len,D] pools — the table-indirected twin of
     :func:`cache_append`.  The host side resolves depth to (frame,
     in-frame offset) through the table; the kernel's RMW window never
-    crosses a frame boundary (page_len % 32 == 0)."""
+    crosses a frame boundary (page_len % 32 == 0; int4 carriers at
+    ``pack`` = 2 need logical page_len % 64 == 0)."""
     import functools as _ft
 
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
-    F, KV, L, D = pk.shape
+    F, KV, L_c, D = pk.shape
+    L = L_c * pack                 # logical page length
     R = k_new.shape[0]
     P = table.shape[1]
     quant = pk.dtype.itemsize == 1
-    w = 32 if quant else 16
-    assert L % w == 0, (L, w)
+    w = 32 if quant else 16        # carrier-row window
+    assert L_c % w == 0, (L_c, w)
     assert quant == (k_scale_new is not None) == (v_scale_new is not None)
+    assert pack == 1 or quant, pack
     depth = jnp.clip(depth.astype(jnp.int32), 0, P * L - 1)
     frame = jnp.take_along_axis(jnp.asarray(table, jnp.int32),
                                 (depth // L)[:, None], axis=1)[:, 0]
@@ -842,7 +938,7 @@ def paged_cache_append(pk, pv, k_new, v_new, table, depth, active,
                         pltpu.SemaphoreType.DMA(())],
     )
     return pl.pallas_call(
-        _ft.partial(_paged_append_kernel, w=w, quant=quant),
+        _ft.partial(_paged_append_kernel, w=w, quant=quant, pack=pack),
         grid_spec=grid_spec,
         out_shape=(jax.ShapeDtypeStruct(pk.shape, pk.dtype),
                    jax.ShapeDtypeStruct(pv.shape, pv.dtype)),
@@ -860,15 +956,19 @@ def paged_decode_attention(q, k_new, v_new, pk, pv, table, depth,
     active row's depth, then run the page-table attend.  Returns
     (out, pk, pv[, k_scale, v_scale]) like the dense twin."""
     if k_scale is not None:
-        from ..quantization import quantize_kv, scatter_kv_scales_paged
+        from ..quantization import (quantize_kv, quantize_kv_int4,
+                                    scatter_kv_scales_paged)
 
+        pack = k_scale.shape[2] // pk.shape[2]
         depth = jnp.clip(depth.astype(jnp.int32), 0,
-                         table.shape[1] * pk.shape[2] - 1)
-        _, k_sc = quantize_kv(k_new)                    # [R, KV]
-        _, v_sc = quantize_kv(v_new)
+                         table.shape[1] * k_scale.shape[2] - 1)
+        qfn = quantize_kv_int4 if pack == 2 else quantize_kv
+        _, k_sc = qfn(k_new)                            # [R, KV]
+        _, v_sc = qfn(v_new)
         pk, pv = paged_cache_append(pk, pv, k_new, v_new, table, depth,
                                     active, interpret=interpret,
-                                    k_scale_new=k_sc, v_scale_new=v_sc)
+                                    k_scale_new=k_sc, v_scale_new=v_sc,
+                                    pack=pack)
         k_scale = scatter_kv_scales_paged(k_scale, k_sc[:, None], depth,
                                           active, table)
         v_scale = scatter_kv_scales_paged(v_scale, v_sc[:, None], depth,
@@ -938,14 +1038,18 @@ def paged_decode_attention_sharded(q, k_new, v_new, pk, pv, table,
     return fn(*args)
 
 
-def paged_path_ok(C: int, pk, mesh) -> bool:
+def paged_path_ok(C: int, pk, mesh, pack: int = 1) -> bool:
     """Shape gate for the paged decode kernels: single-token decode,
     lane-aligned head dim, frame length a legal RMW window multiple
     (32 for int8 pools, 16 otherwise — page_len % 32 == 0 satisfies
-    both by construction), and an unsharded pool OR one whose KV-head
-    axis divides the merged tp/sp head group."""
-    F, KV, L, D = pk.shape
-    align = 32 if pk.dtype.itemsize == 1 else 16
+    both by construction; int4 carriers at ``pack`` = 2 widen the
+    requirement to LOGICAL page_len % 64 == 0, i.e. 32 carrier
+    sublanes), and an unsharded pool OR one whose KV-head axis divides
+    the merged tp/sp head group.  Misaligned int4 shapes fall back to
+    the jnp path (serving_attention) rather than fail to tile."""
+    F, KV, L_c, D = pk.shape
+    L = L_c * pack                 # logical page length
+    align = 32 * pack if pk.dtype.itemsize == 1 else 16
     if C != 1 or D % 128 != 0 or L % align != 0:
         return False
     if mesh is None:
@@ -956,18 +1060,21 @@ def paged_path_ok(C: int, pk, mesh) -> bool:
     return not other and KV % size == 0
 
 
-def flash_path_ok(C: int, ck, mesh) -> bool:
+def flash_path_ok(C: int, ck, mesh, pack: int = 1) -> bool:
     """Shape gate for the production op (consumed by
     serving_attention._flash_decode_ok): single-token decode with a
     lane-aligned head dim, on an unsharded cache OR one sharded over
     the tp (kv heads) / sp (length) serving axes with shard-aligned
     extents.  int8 caches need 32-aligned per-shard extents (the int8
-    sublane tiling widens the append's RMW window to 32).  WHETHER
-    flash beats the XLA attend is the host's cost decision
-    (inference_manager.flash_wins) — this only says the kernel can
-    run."""
-    R, KV, S, D = ck.shape
-    align = 32 if ck.dtype.itemsize == 1 else 16
+    sublane tiling widens the append's RMW window to 32); int4
+    carriers (``pack`` = 2) widen it again to 64 LOGICAL positions —
+    32 carrier sublanes — with the jnp path as the fallback where the
+    alignment fails.  WHETHER flash beats the XLA attend is the host's
+    cost decision (inference_manager.flash_wins) — this only says the
+    kernel can run."""
+    R, KV, S_c, D = ck.shape
+    S = S_c * pack                 # logical length
+    align = 32 * pack if ck.dtype.itemsize == 1 else 16
     if C != 1 or D % 128 != 0 or S % align != 0:
         return False
     if mesh is None:

@@ -64,7 +64,8 @@ def _kernel(last_ref, depth_ref, ntok_ref, act_ref,   # scalar prefetch
             *rest,                          # [ks, vs], [slopes], outs, scr
             ts: int, tc: int, kv: int, g: int, d: int,
             s_total: int, scale: float,
-            alibi: bool, partial: bool, quant: bool = False):
+            alibi: bool, partial: bool, quant: bool = False,
+            pack: int = 1):
     from jax.experimental import pallas as pl
 
     ks_ref = vs_ref = None
@@ -93,8 +94,17 @@ def _kernel(last_ref, depth_ref, ntok_ref, act_ref,   # scalar prefetch
     @pl.when(t <= last_ref[r, c])
     def _step():
         qv = q_ref[:].reshape(kv, g * tc, d)
-        kt = k_ref[:].reshape(kv, ts, d)
-        vt = v_ref[:].reshape(kv, ts, d)
+        kt = k_ref[:].reshape(kv, ts // pack, d)
+        vt = v_ref[:].reshape(kv, ts // pack, d)
+        if pack == 2:
+            # int4 carrier tile: in-register nibble unpack to ``ts``
+            # logical positions (2 codes/byte along the sequence axis)
+            # BEFORE the dequant cast — the HBM->VMEM stream stays at
+            # quarter the bf16 bandwidth (flash_decode._unpack_int4_tile)
+            from .flash_decode import _unpack_int4_tile
+
+            kt = _unpack_int4_tile(kt, kv, ts, d)
+            vt = _unpack_int4_tile(vt, kv, ts, d)
         if ks_ref is not None:
             # int8 cache: the HBM->VMEM K/V stream is int8; dequant is
             # in-register — K's per-position scale folds into the logits
@@ -216,11 +226,18 @@ def _prefill_call(q, ck, cv, depth, ntok, active, scale, interpret,
     from jax.experimental.pallas import tpu as pltpu
 
     R, C, H, D = q.shape
-    KV, S = ck.shape[1], ck.shape[2]
+    KV = ck.shape[1]
     G = H // KV
-    assert H == KV * G and ck.shape == cv.shape == (R, KV, S, D)
     quant = k_scale is not None
     assert quant == (v_scale is not None)
+    # int4 carriers pack 2 codes/byte along S: the carrier is half the
+    # LOGICAL length and the f32 scale frames (always logical-length)
+    # reveal the ratio — pack derives from static shapes, no new
+    # static_argnames (flash_decode._attend_call's convention)
+    pack = (k_scale.shape[2] // ck.shape[2]) if quant else 1
+    assert pack in (1, 2), (k_scale.shape, ck.shape)
+    S = ck.shape[2] * pack                       # logical positions
+    assert H == KV * G and ck.shape == cv.shape == (R, KV, S // pack, D)
     if quant:
         assert k_scale.shape == v_scale.shape == (R, KV, S), (
             k_scale.shape, (R, KV, S))
@@ -228,6 +245,7 @@ def _prefill_call(q, ck, cv, depth, ntok, active, scale, interpret,
         tc0, ts0 = _pick_tiles(C, S, KV, G, D)
         tc, ts = tc or tc0, ts or ts0
     assert C % tc == 0, (C, tc)
+    assert ts % pack == 0, (ts, pack)
     nc = C // tc
     nt = pl.cdiv(min(s_bound, S) if s_bound else S, ts)
     depth = depth.astype(jnp.int32)
@@ -250,14 +268,18 @@ def _prefill_call(q, ck, cv, depth, ntok, active, scale, interpret,
     alibi = slopes is not None
     kernel = functools.partial(_kernel, ts=ts, tc=tc, kv=KV, g=G, d=D,
                                s_total=S, scale=float(scale),
-                               alibi=alibi, partial=partial, quant=quant)
+                               alibi=alibi, partial=partial, quant=quant,
+                               pack=pack)
+    # carrier K/V blocks are ts//pack wide on the SAME clamped index
+    # maps (block-index space is unchanged — block t holds logical
+    # positions [t*ts, (t+1)*ts) at half width when packed)
     in_specs = [
         pl.BlockSpec((1, KV, G, tc, D),
                      lambda r, c, t, *_: (r, 0, 0, c, 0)),
-        pl.BlockSpec((1, KV, ts, D),
+        pl.BlockSpec((1, KV, ts // pack, D),
                      lambda r, c, t, last, *_: (
                          r, 0, jnp.minimum(t, last[r, c]), 0)),
-        pl.BlockSpec((1, KV, ts, D),
+        pl.BlockSpec((1, KV, ts // pack, D),
                      lambda r, c, t, last, *_: (
                          r, 0, jnp.minimum(t, last[r, c]), 0)),
     ]
@@ -366,7 +388,10 @@ def flash_prefill_attend_partial(q, ck, cv, depth, ntok, active,
     R, C, H, D = q.shape
     KV = ck.shape[1]
     G = H // KV
-    tc0, ts0 = _pick_tiles(C, ck.shape[2], KV, G, D)
+    # scale frames are always logical-length: int4 carriers are half
+    # the logical extent, so size the tiles off the scales when present
+    s_log = k_scale.shape[2] if k_scale is not None else ck.shape[2]
+    tc0, ts0 = _pick_tiles(C, s_log, KV, G, D)
     tc, ts = tc or tc0, ts or ts0
     acc, m, l = _prefill_call(q, ck, cv, depth, ntok, active, scale,
                               interpret, tc, ts, s_bound, slopes,
@@ -381,25 +406,31 @@ def _append_kernel(base_ref, roll_ref, lo_ref, hi_ref, act_ref,  # prefetch
                    kal_ref, val_ref,     # VMEM [1, KV, W, D] row blocks
                    ck_hbm, cv_hbm,               # ANY (aliased inputs)
                    ck_out, cv_out,               # aliased outputs
-                   win_k, win_v, sem_k, sem_v, *, align: int = 16):
+                   win_k, win_v, sem_k, sem_v, *, align: int = 16,
+                   pack: int = 1):
     """Per-row in-place chunk append: overlay the row's ``align``-ed
     window [base, base+W) with the pre-aligned new K/V on the window-
     relative span [lo, hi) (chunk entry jj - shift lands at window
     position jj; the rotate amount arrives pre-reduced mod W in
-    ``roll``).  ``align`` = 16 for bf16/f32 caches, 32 for int8 (the
-    int8 sublane tiling).  Same rationale as
+    ``roll``).  ``align`` is the CARRIER-row multiplier for the
+    prefetched base: 16 for bf16/f32 caches, 32 for int8 AND for int4
+    carriers (64 logical positions = 32 carrier sublanes — the int8
+    sublane tiling at half width).  Same rationale as
     flash_decode._append_kernel: with both the append and the attend as
     Pallas calls the cache never crosses an XLA layout boundary (XLA
     prefers S-major for its own scatter and inserts whole-cache
     relayout copies at custom-call boundaries — measured ~9 ms/step at
     1.4B/8k).  Quantized chunks arrive as EXACT integer codes staged
-    f32 (the rotate needs 32-bit data); the overlay's astype to the
-    int8 window truncates losslessly."""
+    f32 AT LOGICAL LENGTH (the rotate needs 32-bit data); the overlay's
+    astype to the int8 window truncates losslessly, and for ``pack`` ==
+    2 the kernel packs pairs of rotated logical codes into carrier
+    bytes in-register, masking each nibble by its own logical-position
+    bound (a chunk may start/end mid-byte)."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
     r = pl.program_id(0)
-    W = win_k.shape[1]
+    W = win_k.shape[1]                 # carrier rows (= logical / pack)
 
     @pl.when(act_ref[r] > 0)
     def _():
@@ -415,8 +446,6 @@ def _append_kernel(base_ref, roll_ref, lo_ref, hi_ref, act_ref,  # prefetch
         inv.start()
         ink.wait()
         inv.wait()
-        jj = jax.lax.broadcasted_iota(jnp.int32, (1, W, 1), 1)
-        sel = (jj >= lo_ref[r]) & (jj < hi_ref[r])
         # align the zero-padded chunk to the window offset with a
         # dynamic sublane rotate (entry jj of the rolled chunk is
         # chunk[jj - shift]; wrapped entries land outside sel's range) —
@@ -427,17 +456,45 @@ def _append_kernel(base_ref, roll_ref, lo_ref, hi_ref, act_ref,  # prefetch
         # rejects 16-bit data — the chunk is shipped f32 and cast on
         # the overlay, exact for bf16-derived values).
         kv = win_k.shape[0]
-        for i in range(kv):
-            win_k[i] = jnp.where(
-                sel[0],
-                pltpu.roll(kal_ref[0, i], roll_ref[r], 0).astype(
-                    win_k.dtype),
-                win_k[i])
-            win_v[i] = jnp.where(
-                sel[0],
-                pltpu.roll(val_ref[0, i], roll_ref[r], 0).astype(
-                    win_v.dtype),
-                win_v[i])
+        if pack == 1:
+            jj = jax.lax.broadcasted_iota(jnp.int32, (1, W, 1), 1)
+            sel = (jj >= lo_ref[r]) & (jj < hi_ref[r])
+            for i in range(kv):
+                win_k[i] = jnp.where(
+                    sel[0],
+                    pltpu.roll(kal_ref[0, i], roll_ref[r], 0).astype(
+                        win_k.dtype),
+                    win_k[i])
+                win_v[i] = jnp.where(
+                    sel[0],
+                    pltpu.roll(val_ref[0, i], roll_ref[r], 0).astype(
+                        win_v.dtype),
+                    win_v[i])
+        else:
+            # int4 pack: carrier byte at window row jc covers LOGICAL
+            # window positions 2*jc (low nibble) and 2*jc+1 (high) —
+            # each nibble overlays independently so lo/hi (logical)
+            # may land mid-byte and the neighbour nibble survives
+            d = win_k.shape[2]
+            jc = jax.lax.broadcasted_iota(jnp.int32, (W, 1), 0)
+            in_lo = (2 * jc >= lo_ref[r]) & (2 * jc < hi_ref[r])
+            in_hi = (2 * jc + 1 >= lo_ref[r]) & (2 * jc + 1 < hi_ref[r])
+            for i in range(kv):
+                rk = pltpu.roll(kal_ref[0, i], roll_ref[r], 0)
+                rv = pltpu.roll(val_ref[0, i], roll_ref[r], 0)
+                # [2W logical, D] -> even/odd logical rows per byte
+                rk = rk[:2 * W].astype(jnp.int32).reshape(W, 2, d)
+                rv = rv[:2 * W].astype(jnp.int32).reshape(W, 2, d)
+                ok32 = win_k[i].astype(jnp.int32)
+                ov32 = win_v[i].astype(jnp.int32)
+                k_lo = jnp.where(in_lo, rk[:, 0] & 0x0F, ok32 & 0x0F)
+                k_hi = jnp.where(in_hi, rk[:, 1] & 0x0F,
+                                 (ok32 >> 4) & 0x0F)
+                v_lo = jnp.where(in_lo, rv[:, 0] & 0x0F, ov32 & 0x0F)
+                v_hi = jnp.where(in_hi, rv[:, 1] & 0x0F,
+                                 (ov32 >> 4) & 0x0F)
+                win_k[i] = (k_lo | (k_hi << 4)).astype(win_k.dtype)
+                win_v[i] = (v_lo | (v_hi << 4)).astype(win_v.dtype)
         outk = pltpu.make_async_copy(
             win_k, ck_out.at[r, :, pl.ds(b, W), :], sem_k)
         outv = pltpu.make_async_copy(
@@ -449,7 +506,8 @@ def _append_kernel(base_ref, roll_ref, lo_ref, hi_ref, act_ref,  # prefetch
 
 
 def chunk_append(ck, cv, k_new, v_new, depth, ntok, active,
-                 interpret: bool = False, s_offset=None):
+                 interpret: bool = False, s_offset=None,
+                 pack: int = 1):
     """In-place (aliased) chunk KV append on [R,KV,S,D] caches via async
     DMA — the Pallas twin of _scatter_chunk for the flash-prefill path.
 
@@ -469,18 +527,27 @@ def chunk_append(ck, cv, k_new, v_new, depth, ntok, active,
     quantization.quantize_kv) — the f32 staging carries the exact
     integer codes and the overlay's cast back to int8 is lossless; the
     [R, KV, S] scale tensors are the caller's to update
-    (flash_prefill_attention scatters them XLA-side)."""
+    (flash_prefill_attention scatters them XLA-side).
+
+    ``pack`` == 2 (int4 carriers): ``ck``/``cv`` are int8 carriers at
+    HALF the logical extent; the chunk arrives as int4 codes in [-7, 7]
+    (quantization.quantize_kv_int4) staged f32 at LOGICAL length, and
+    the kernel packs them into carrier nibbles in-register.  All window
+    arithmetic here stays in LOGICAL positions — the alignment widens
+    to 64 (= 32 carrier sublanes, the PR-2 invariant doubled)."""
     import functools as _ft
 
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
-    R, KV, S, D = ck.shape
+    R, KV, S_c, D = ck.shape
+    S = S_c * pack                    # logical positions
     C = k_new.shape[1]
-    align = 32 if ck.dtype.itemsize == 1 else 16
-    W = C + 32
+    assert pack in (1, 2) and (pack == 1 or ck.dtype.itemsize == 1)
+    align = (32 * pack) if ck.dtype.itemsize == 1 else 16
+    W = C + max(align, 32)            # logical window extent
     assert S % align == 0 and W <= S, (S, W, align)
-    assert W % align == 0, (C, align)   # gate: int8 needs C % 32 == 0
+    assert W % align == 0, (C, align)   # gate: int8 C%32, int4 C%64
     depth = depth.astype(jnp.int32)
     ntok = jnp.minimum(ntok.astype(jnp.int32), C)
     active = active.astype(jnp.int32)
@@ -495,6 +562,7 @@ def chunk_append(ck, cv, k_new, v_new, depth, ntok, active,
                    pad).astype(jnp.float32)
     v_al = jnp.pad(v_new.transpose(0, 2, 1, 3),
                    pad).astype(jnp.float32)
+    Wc = W // pack                     # carrier window rows
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=5,
@@ -510,13 +578,14 @@ def chunk_append(ck, cv, k_new, v_new, depth, ntok, active,
         ],
         out_specs=(pl.BlockSpec(memory_space=pl.ANY),
                    pl.BlockSpec(memory_space=pl.ANY)),
-        scratch_shapes=[pltpu.VMEM((KV, W, D), ck.dtype),
-                        pltpu.VMEM((KV, W, D), cv.dtype),
+        scratch_shapes=[pltpu.VMEM((KV, Wc, D), ck.dtype),
+                        pltpu.VMEM((KV, Wc, D), cv.dtype),
                         pltpu.SemaphoreType.DMA(()),
                         pltpu.SemaphoreType.DMA(())],
     )
     return pl.pallas_call(
-        _ft.partial(_append_kernel, align=align), grid_spec=grid_spec,
+        _ft.partial(_append_kernel, align=align // pack, pack=pack),
+        grid_spec=grid_spec,
         out_shape=(jax.ShapeDtypeStruct(ck.shape, ck.dtype),
                    jax.ShapeDtypeStruct(cv.shape, cv.dtype)),
         input_output_aliases={7: 0, 8: 1},   # +5 scalar-prefetch args
@@ -538,12 +607,15 @@ def flash_prefill_attention(q, k_new, v_new, ck, cv, depth, ntok,
     ``v_scale`` [R, KV, S] f32 passed) additionally return the updated
     scale tensors: (out, ck, cv, k_scale, v_scale)."""
     if k_scale is not None:
-        from ..quantization import quantize_kv, scatter_kv_scales
+        from ..quantization import (quantize_kv, quantize_kv_int4,
+                                    scatter_kv_scales)
 
-        k_q, k_sc = quantize_kv(k_new)       # [R,C,KV,D] -> q, [R,C,KV]
-        v_q, v_sc = quantize_kv(v_new)
+        pack = k_scale.shape[2] // ck.shape[2]   # 2 = int4 carrier
+        qfn = quantize_kv_int4 if pack == 2 else quantize_kv
+        k_q, k_sc = qfn(k_new)               # [R,C,KV,D] -> q, [R,C,KV]
+        v_q, v_sc = qfn(v_new)
         ck, cv = chunk_append(ck, cv, k_q, v_q, depth, ntok, active,
-                              interpret=interpret)
+                              interpret=interpret, pack=pack)
         k_scale = scatter_kv_scales(k_scale, k_sc, depth, active)
         v_scale = scatter_kv_scales(v_scale, v_sc, depth, active)
         out = flash_prefill_attend(q, ck, cv, depth, ntok, active,
@@ -589,6 +661,9 @@ def flash_prefill_attention_sharded(q, k_new, v_new, ck, cv, depth,
     slope_spec = P(tp_ax)
     has_alibi = slopes is not None
     quant = k_scale is not None
+    # pack from GLOBAL shapes: sp shards carrier and scales in
+    # lockstep, so the logical/carrier ratio is shard-invariant
+    pack = (k_scale.shape[2] // ck.shape[2]) if quant else 1
     depth = depth.astype(jnp.int32)
     ntok = ntok.astype(jnp.int32)
     active = active.astype(jnp.int32)
@@ -599,7 +674,7 @@ def flash_prefill_attention_sharded(q, k_new, v_new, ck, cv, depth,
         rest = list(rest)
         ks, vs = (rest.pop(0), rest.pop(0)) if quant else (None, None)
         sl = rest.pop(0) if has_alibi else None
-        S_l = ck.shape[2]
+        S_l = ck.shape[2] * pack            # logical shard extent
         s0 = (jax.lax.axis_index(sp_ax) * S_l) if sp > 1 else 0
         loc = depth - s0
         # local grid bound: the host's GLOBAL attend bucket clipped to
@@ -607,13 +682,15 @@ def flash_prefill_attention_sharded(q, k_new, v_new, ck, cv, depth,
         # cycle the full pruned grid — flash_prefill_attend docstring)
         sb = min(s_bound, S_l) if s_bound else None
         if quant:
-            from ..quantization import quantize_kv, scatter_kv_scales
+            from ..quantization import (quantize_kv, quantize_kv_int4,
+                                        scatter_kv_scales)
 
-            kn_q, k_sc = quantize_kv(kn)
-            vn_q, v_sc = quantize_kv(vn)
+            qfn = quantize_kv_int4 if pack == 2 else quantize_kv
+            kn_q, k_sc = qfn(kn)
+            vn_q, v_sc = qfn(vn)
             ck, cv = chunk_append(ck, cv, kn_q, vn_q, depth, ntok,
                                   active, interpret=interpret,
-                                  s_offset=s0)
+                                  s_offset=s0, pack=pack)
             ks = scatter_kv_scales(ks, k_sc, loc, active)
             vs = scatter_kv_scales(vs, v_sc, loc, active)
         else:
@@ -689,12 +766,17 @@ def _paged_prefill_call(q, pk, pv, table, depth, ntok, active, scale,
     from jax.experimental.pallas import tpu as pltpu
 
     R, C, H, D = q.shape
-    F, KV, L, _ = pk.shape
+    F, KV = pk.shape[:2]
     G = H // KV
     P = table.shape[1]
-    assert H == KV * G and pk.shape == pv.shape == (F, KV, L, D)
     quant = k_scale is not None
     assert quant == (v_scale is not None)
+    # int4 carrier frames are half the logical frame length; the f32
+    # scale frames stay logical-length and reveal the pack ratio
+    pack = (k_scale.shape[2] // pk.shape[2]) if quant else 1
+    assert pack in (1, 2), (k_scale.shape, pk.shape)
+    L = pk.shape[2] * pack                        # logical frame length
+    assert H == KV * G and pk.shape == pv.shape == (F, KV, L // pack, D)
     if quant:
         assert k_scale.shape == v_scale.shape == (F, KV, L), (
             k_scale.shape, (F, KV, L))
@@ -721,14 +803,15 @@ def _paged_prefill_call(q, pk, pv, table, depth, ntok, active, scale,
     alibi = slopes is not None
     kernel = functools.partial(_paged_kernel, ts=L, tc=tc, kv=KV, g=G,
                                d=D, s_total=nt * L, scale=float(scale),
-                               alibi=alibi, partial=False, quant=quant)
+                               alibi=alibi, partial=False, quant=quant,
+                               pack=pack)
     kv_map = lambda r, c, t, tab, last, *_: (  # noqa: E731
         tab[r, jnp.minimum(t, last[r, c])], 0, 0, 0)
     in_specs = [
         pl.BlockSpec((1, KV, G, tc, D),
                      lambda r, c, t, *_: (r, 0, 0, c, 0)),
-        pl.BlockSpec((1, KV, L, D), kv_map),
-        pl.BlockSpec((1, KV, L, D), kv_map),
+        pl.BlockSpec((1, KV, L // pack, D), kv_map),
+        pl.BlockSpec((1, KV, L // pack, D), kv_map),
     ]
     inputs = [qt, pk, pv]
     if quant:
@@ -787,13 +870,17 @@ def _paged_chunk_kernel(frame_ref, roll_ref, lo_ref, hi_ref, act_ref,
                         kal_ref, val_ref,     # VMEM [1, KV, Wc, D]
                         pk_hbm, pv_hbm,       # ANY (aliased inputs)
                         pk_out, pv_out,       # aliased outputs
-                        win_k, win_v, sem_k, sem_v, *, L: int):
+                        win_k, win_v, sem_k, sem_v, *, L: int,
+                        pack: int = 1):
     """Per-(row, straddled-frame) chunk overlay: frame p of the chunk's
     span RMWs as a WHOLE frame window [0, L) — frames are page_len
     wide, page_len % 32 == 0, so every window is sublane-legal for
     every cache dtype.  The chunk arrives zero-padded f32 and rotates
     to the window offset in-kernel (the dense chunk_append's dynamic
-    sublane rotate, with per-(r, p) rotate amounts)."""
+    sublane rotate, with per-(r, p) rotate amounts).  ``L`` and the
+    lo/hi bounds are LOGICAL positions; ``pack`` == 2 packs the rotated
+    int4 codes into the frame's L/2 carrier bytes with per-nibble
+    overlay masks (the dense _append_kernel's int4 path)."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -809,16 +896,40 @@ def _paged_chunk_kernel(frame_ref, roll_ref, lo_ref, hi_ref, act_ref,
         inv.start()
         ink.wait()
         inv.wait()
-        jj = jax.lax.broadcasted_iota(jnp.int32, (1, L, 1), 1)
-        sel = (jj >= lo_ref[r, p]) & (jj < hi_ref[r, p])
         kv = win_k.shape[0]
-        for i in range(kv):
-            rk = pltpu.roll(kal_ref[0, i], roll_ref[r, p], 0)
-            rv = pltpu.roll(val_ref[0, i], roll_ref[r, p], 0)
-            win_k[i] = jnp.where(sel[0], rk[:L].astype(win_k.dtype),
-                                 win_k[i])
-            win_v[i] = jnp.where(sel[0], rv[:L].astype(win_v.dtype),
-                                 win_v[i])
+        if pack == 1:
+            jj = jax.lax.broadcasted_iota(jnp.int32, (1, L, 1), 1)
+            sel = (jj >= lo_ref[r, p]) & (jj < hi_ref[r, p])
+            for i in range(kv):
+                rk = pltpu.roll(kal_ref[0, i], roll_ref[r, p], 0)
+                rv = pltpu.roll(val_ref[0, i], roll_ref[r, p], 0)
+                win_k[i] = jnp.where(sel[0], rk[:L].astype(win_k.dtype),
+                                     win_k[i])
+                win_v[i] = jnp.where(sel[0], rv[:L].astype(win_v.dtype),
+                                     win_v[i])
+        else:
+            Lc = L // 2
+            d = win_k.shape[2]
+            jc = jax.lax.broadcasted_iota(jnp.int32, (Lc, 1), 0)
+            in_lo = ((2 * jc >= lo_ref[r, p])
+                     & (2 * jc < hi_ref[r, p]))
+            in_hi = ((2 * jc + 1 >= lo_ref[r, p])
+                     & (2 * jc + 1 < hi_ref[r, p]))
+            for i in range(kv):
+                rk = pltpu.roll(kal_ref[0, i], roll_ref[r, p], 0)
+                rv = pltpu.roll(val_ref[0, i], roll_ref[r, p], 0)
+                rk = rk[:L].astype(jnp.int32).reshape(Lc, 2, d)
+                rv = rv[:L].astype(jnp.int32).reshape(Lc, 2, d)
+                ok32 = win_k[i].astype(jnp.int32)
+                ov32 = win_v[i].astype(jnp.int32)
+                k_lo = jnp.where(in_lo, rk[:, 0] & 0x0F, ok32 & 0x0F)
+                k_hi = jnp.where(in_hi, rk[:, 1] & 0x0F,
+                                 (ok32 >> 4) & 0x0F)
+                v_lo = jnp.where(in_lo, rv[:, 0] & 0x0F, ov32 & 0x0F)
+                v_hi = jnp.where(in_hi, rv[:, 1] & 0x0F,
+                                 (ov32 >> 4) & 0x0F)
+                win_k[i] = (k_lo | (k_hi << 4)).astype(win_k.dtype)
+                win_v[i] = (v_lo | (v_hi << 4)).astype(win_v.dtype)
         outk = pltpu.make_async_copy(win_k, pk_out.at[f], sem_k)
         outv = pltpu.make_async_copy(win_v, pv_out.at[f], sem_v)
         outk.start()
@@ -828,23 +939,28 @@ def _paged_chunk_kernel(frame_ref, roll_ref, lo_ref, hi_ref, act_ref,
 
 
 def paged_chunk_append(pk, pv, k_new, v_new, table, depth, ntok,
-                       active, interpret: bool = False):
+                       active, interpret: bool = False,
+                       pack: int = 1):
     """In-place (aliased) chunk KV append on paged pools: the chunk
     [depth, depth+ntok) straddles up to cdiv(C, page_len)+1 frames and
     each (row, frame) program overlays its intersection — the same
     piecewise-overlay contract as the dense kernel's sp straddle
     handling, with the pieces resolved through the page table.  int8
     pools take the chunk PRE-QUANTIZED (exact codes staged f32, cast
-    lossless); scale frames are the caller's (scatter_kv_scales_paged)."""
+    lossless); scale frames are the caller's (scatter_kv_scales_paged).
+    ``pack`` == 2: int4 carrier frames at half the logical page_len —
+    all span math here stays LOGICAL, the kernel packs nibbles."""
     import functools as _ft
 
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
-    F, KV, L, D = pk.shape
+    F, KV, L_c, D = pk.shape
+    L = L_c * pack                    # logical page length
     R, C = k_new.shape[:2]
     P = table.shape[1]
-    align = 32 if pk.dtype.itemsize == 1 else 16
+    assert pack in (1, 2) and (pack == 1 or pk.dtype.itemsize == 1)
+    align = (32 * pack) if pk.dtype.itemsize == 1 else 16
     assert L % align == 0, (L, align)
     assert C % 16 == 0, C   # host chunk gate (pick_chunk pow2 >= 16)
     npc = -(-C // L) + 1    # frames a chunk can straddle
@@ -882,13 +998,14 @@ def paged_chunk_append(pk, pv, k_new, v_new, table, depth, ntok,
         ],
         out_specs=(pl.BlockSpec(memory_space=pl.ANY),
                    pl.BlockSpec(memory_space=pl.ANY)),
-        scratch_shapes=[pltpu.VMEM((KV, L, D), pk.dtype),
-                        pltpu.VMEM((KV, L, D), pv.dtype),
+        scratch_shapes=[pltpu.VMEM((KV, L_c, D), pk.dtype),
+                        pltpu.VMEM((KV, L_c, D), pv.dtype),
                         pltpu.SemaphoreType.DMA(()),
                         pltpu.SemaphoreType.DMA(())],
     )
     return pl.pallas_call(
-        _ft.partial(_paged_chunk_kernel, L=L), grid_spec=grid_spec,
+        _ft.partial(_paged_chunk_kernel, L=L, pack=pack),
+        grid_spec=grid_spec,
         out_shape=(jax.ShapeDtypeStruct(pk.shape, pk.dtype),
                    jax.ShapeDtypeStruct(pv.shape, pv.dtype)),
         input_output_aliases={7: 0, 8: 1},   # +5 scalar-prefetch args
@@ -905,12 +1022,16 @@ def paged_prefill_attention(q, k_new, v_new, pk, pv, table, depth,
     run the page-table attend.  Returns (out, pk, pv[, k_scale,
     v_scale]) like the dense twin."""
     if k_scale is not None:
-        from ..quantization import quantize_kv, scatter_kv_scales_paged
+        from ..quantization import (quantize_kv, quantize_kv_int4,
+                                    scatter_kv_scales_paged)
 
-        k_q, k_sc = quantize_kv(k_new)       # [R,C,KV] scales
-        v_q, v_sc = quantize_kv(v_new)
+        pack = k_scale.shape[2] // pk.shape[2]   # 2 = int4 carrier
+        qfn = quantize_kv_int4 if pack == 2 else quantize_kv
+        k_q, k_sc = qfn(k_new)               # [R,C,KV] scales
+        v_q, v_sc = qfn(v_new)
         pk, pv = paged_chunk_append(pk, pv, k_q, v_q, table, depth,
-                                    ntok, active, interpret=interpret)
+                                    ntok, active, interpret=interpret,
+                                    pack=pack)
         k_scale = scatter_kv_scales_paged(k_scale, k_sc, depth, active,
                                           table)
         v_scale = scatter_kv_scales_paged(v_scale, v_sc, depth, active,
@@ -981,15 +1102,19 @@ def paged_prefill_attention_sharded(q, k_new, v_new, pk, pv, table,
     return fn(*args)
 
 
-def paged_prefill_path_ok(C: int, pk, mesh) -> bool:
+def paged_prefill_path_ok(C: int, pk, mesh, pack: int = 1) -> bool:
     """Shape gate for the paged prefill kernels: an align-divisible
-    multi-token chunk (16 bf16 / 32 int8 — the overlay's cast and the
-    window RMW), lane-aligned head dim, a per-program VMEM footprint
-    (f32-staged chunk + whole-frame windows) inside the budget, and
-    an unsharded pool OR KV heads divisible by the merged tp/sp
-    group."""
-    F, KV, L, D = pk.shape
-    align = 32 if pk.dtype.itemsize == 1 else 16
+    multi-token chunk (16 bf16 / 32 int8 / 64 int4 — the overlay's
+    cast and the window RMW; packed carriers double the logical
+    alignment to keep 32 carrier sublanes), lane-aligned head dim, a
+    per-program VMEM footprint (f32-staged LOGICAL chunk + carrier
+    whole-frame windows) inside the budget, and an unsharded pool OR
+    KV heads divisible by the merged tp/sp group.  ``L``/``C`` math is
+    in LOGICAL positions (``pk`` is the carrier — half-width for
+    int4)."""
+    F, KV, L_c, D = pk.shape
+    L = L_c * pack
+    align = (32 * pack) if pk.dtype.itemsize == 1 else 16
     size = 1
     if mesh is not None:
         from .flash_decode import paged_head_axes
@@ -1001,13 +1126,13 @@ def paged_prefill_path_ok(C: int, pk, mesh) -> bool:
             return False
     kv_l = KV // max(1, size)
     wc = max(C, L)
-    append_vmem = kv_l * D * (wc * 8 + 2 * L * pk.dtype.itemsize)
+    append_vmem = kv_l * D * (wc * 8 + 2 * L_c * pk.dtype.itemsize)
     return (C >= align and C % align == 0 and D % 128 == 0
             and L % align == 0
             and append_vmem <= 11 * 1024 * 1024)
 
 
-def prefill_path_ok(C: int, ck, mesh) -> bool:
+def prefill_path_ok(C: int, ck, mesh, pack: int = 1) -> bool:
     """Shape gate for the production op: multi-token chunk with
     lane-aligned head dim and a 16-divisible chunk (the append window
     arithmetic), an append window that FITS VMEM — the per-row window
@@ -1023,9 +1148,14 @@ def prefill_path_ok(C: int, ck, mesh) -> bool:
     (inference_manager.flash_prefill_wins) — this only says the kernel
     can run.  int8 caches additionally need 32-divisible chunks and
     per-shard extents (the int8 sublane tiling widens the append
-    window's alignment to 32)."""
-    R, KV, S, D = ck.shape
-    align = 32 if ck.dtype.itemsize == 1 else 16
+    window's alignment to 32); int4 carriers (``pack`` == 2) double
+    that to 64 LOGICAL positions — still 32 carrier sublanes — and
+    the S math below is in logical positions (``ck`` is the
+    half-width carrier)."""
+    R, KV, S_c, D = ck.shape
+    S = S_c * pack
+    align = (32 * pack) if ck.dtype.itemsize == 1 else 16
+    W = C + max(align, 32)            # logical append window
     tp = sp = 1
     if mesh is not None:
         from .flash_decode import mesh_axes
@@ -1036,7 +1166,9 @@ def prefill_path_ok(C: int, ck, mesh) -> bool:
         if other or KV % tp or S % sp or (S // sp) % align:
             return False
     kv_l, s_l = KV // tp, S // sp
-    append_vmem = (C + 32) * kv_l * D * (8 + 2 * ck.dtype.itemsize)
+    # f32 LOGICAL staging (8 bytes/pos for k_al+v_al) + two carrier
+    # windows at itemsize/pack bytes per logical position
+    append_vmem = W * kv_l * D * (8 + 2 * ck.dtype.itemsize // pack)
     return (C >= align and C % align == 0
-            and D % 128 == 0 and s_l % align == 0 and C + 32 <= s_l
+            and D % 128 == 0 and s_l % align == 0 and W <= s_l
             and append_vmem <= 11 * 1024 * 1024)

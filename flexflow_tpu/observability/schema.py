@@ -42,7 +42,7 @@ METRICS_SCHEMA = {
         "type": "counter",
         "help": "Attention-kernel dispatch decisions, labeled "
                 "phase=decode|prefill, path=flash|xla, "
-                "reason=forced|path_gate|cost_model and cache=int8|fp "
+                "reason=forced|path_gate|cost_model and cache=int4|int8|fp "
                 "(the record's KV storage dtype, so multi-record "
                 "processes — e.g. the bench kvdtype A/B — attribute "
                 "fallbacks to an arm).  path=xla with reason=path_gate "

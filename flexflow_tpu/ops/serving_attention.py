@@ -68,7 +68,7 @@ import numpy as np
 from ..core.initializers import DEFAULT_WEIGHT_INIT
 from ..core.tensor import TensorSpec
 from ..fftype import DataType, OpType
-from ..quantization import resolve_weight
+from ..quantization import kv_pack_factor, resolve_weight
 from .attention_ops import apply_rotary_embedding
 from .registry import OpDef, ParamSpec, register
 
@@ -315,12 +315,14 @@ class _ServingAttentionBase(OpDef):
         return bc["page_table"] if "page_table" in bc else None
 
     @staticmethod
-    def _paged_attend_pages(ctx, pool, table):
+    def _paged_attend_pages(ctx, pool, table, pack=1):
         """Table columns this step's attend reads: the host's attend
         bucket rounded up to whole pages (the paged analogue of
         ``_attend_slice`` — fewer gathered frames instead of a shorter
-        slice), or the full table without a bucket."""
-        L = pool.shape[2]
+        slice), or the full table without a bucket.  ``pack``: codes
+        per carrier byte (int4 pools hold 2 logical positions per
+        axis-2 row), so the bucket compares in LOGICAL tokens."""
+        L = pool.shape[2] * pack
         P = table.shape[1]
         if ctx.attend_len and ctx.attend_len < P * L:
             return min(P, -(-int(ctx.attend_len) // L))
@@ -328,24 +330,51 @@ class _ServingAttentionBase(OpDef):
 
     def _paged_gather(self, ctx, ck, cv, ks, vs, table):
         """(ak, av, aks, avs, S): the dense logical view the jnp attend
-        reads, gathered frame-by-frame through the table."""
-        pages = self._paged_attend_pages(ctx, ck, table)
+        reads, gathered frame-by-frame through the table.  ``S`` is the
+        LOGICAL length (int4 carriers stay packed in the view; the
+        dequant unpacks them)."""
+        pack = kv_pack_factor(ck, ks)
+        pages = self._paged_attend_pages(ctx, ck, table, pack)
         ak = _paged_view(ck, table, pages)
         av = _paged_view(cv, table, pages)
         aks = _paged_view(ks, table, pages) if ks is not None else None
         avs = _paged_view(vs, table, pages) if vs is not None else None
-        return ak, av, aks, avs, pages * ck.shape[2]
+        return ak, av, aks, avs, pages * ck.shape[2] * pack
 
     def _scatter_any(self, ck, cv, ks, vs, k, v, start, active,
                      table=None):
         """Chunk commit on either layout: dense slabs scatter rows,
         paged pools scatter through the table; int8 caches quantize
         once (the shared quantizer) and move codes + scales in
-        lockstep."""
+        lockstep.  Int4 caches (pack factor 2, recovered from the
+        carrier/scale shape ratio) quantize to +-7 codes and merge them
+        nibble-wise into the packed carrier — the parity-sequenced RMW
+        scatter, so chunk boundaries splitting a byte stay exact."""
         if ks is not None:
-            from ..quantization import (quantize_kv, scatter_kv_scales,
+            from ..quantization import (quantize_kv, quantize_kv_int4,
+                                        scatter_kv_packed,
+                                        scatter_kv_packed_paged,
+                                        scatter_kv_scales,
                                         scatter_kv_scales_paged)
 
+            if kv_pack_factor(ck, ks) == 2:
+                k_q, k_sc = quantize_kv_int4(k)
+                v_q, v_sc = quantize_kv_int4(v)
+                if table is not None:
+                    ck = scatter_kv_packed_paged(ck, k_q, start, active,
+                                                 table)
+                    cv = scatter_kv_packed_paged(cv, v_q, start, active,
+                                                 table)
+                    ks = scatter_kv_scales_paged(ks, k_sc, start,
+                                                 active, table)
+                    vs = scatter_kv_scales_paged(vs, v_sc, start,
+                                                 active, table)
+                else:
+                    ck = scatter_kv_packed(ck, k_q, start, active)
+                    cv = scatter_kv_packed(cv, v_q, start, active)
+                    ks = scatter_kv_scales(ks, k_sc, start, active)
+                    vs = scatter_kv_scales(vs, v_sc, start, active)
+                return ck, cv, ks, vs
             k_q, k_sc = quantize_kv(k)
             v_q, v_sc = quantize_kv(v)
             if table is not None:
@@ -376,12 +405,18 @@ class _ServingAttentionBase(OpDef):
         every active row's depth+chunk), so reading them only burns HBM
         bandwidth — at 7B/MHA the full padded length costs more per step
         than the weights.  Sharded caches skip the slice (it would
-        reshard the sp/tp layout mid-step).  Scale tensors (int8
-        caches) slice in lockstep with their K/V."""
+        reshard the sp/tp layout mid-step).  Scale tensors (int8/int4
+        caches) slice in lockstep with their K/V; int4 carriers slice
+        at HALF the logical bucket (2 codes/byte), with the bucket
+        rounded down to even so carrier and scale stay aligned.
+        Returns the LOGICAL attended length."""
         L = ctx.attend_len
-        S = ck.shape[2]
+        pack = kv_pack_factor(ck, ks)
+        S = ck.shape[2] * pack
+        if L:
+            L -= L % pack
         if L and L < S and ctx.mesh is None:
-            return (ck[:, :, :L], cv[:, :, :L],
+            return (ck[:, :, :L // pack], cv[:, :, :L // pack],
                     None if ks is None else ks[:, :, :L],
                     None if vs is None else vs[:, :, :L], L)
         return ck, cv, ks, vs, S
@@ -391,9 +426,14 @@ class _ServingAttentionBase(OpDef):
         """Dequantize attended cache slices to the compute dtype; jnp
         so XLA fuses the int8->float convert into the attend's operand
         load (the HBM stream stays int8 — the ISSUE's bandwidth win on
-        the fallback path too)."""
-        from ..quantization import dequantize_kv
+        the fallback path too).  Int4 carriers additionally unpack via
+        shifts/masks in the same fusion, so the stream is 0.5 byte per
+        cached value."""
+        from ..quantization import dequantize_kv, dequantize_kv_packed
 
+        if kv_pack_factor(ak, aks) == 2:
+            return (dequantize_kv_packed(ak, aks, dtype),
+                    dequantize_kv_packed(av, avs, dtype))
         return dequantize_kv(ak, aks, dtype), dequantize_kv(av, avs, dtype)
 
 
@@ -428,8 +468,10 @@ class IncMultiHeadSelfAttention(_ServingAttentionBase):
         table = self._page_table(ctx)
         slopes = (self._alibi_slopes(attrs["num_q_heads"])
                   if attrs.get("position_bias", False) else None)
+        pack = kv_pack_factor(ck, ks)
         flash_mode = self._flash_decode_ok(attrs, ctx, C, ck,
-                                           paged=table is not None)
+                                           paged=table is not None,
+                                           pack=pack)
         if flash_mode:
             interp = flash_mode == "interpret"
             if table is not None:
@@ -470,7 +512,8 @@ class IncMultiHeadSelfAttention(_ServingAttentionBase):
             self._store(ctx, layer, ck, cv, ks, vs)
             return [self._output(params, out1[:, None], attrs, ctx)]
         flash_pre = self._flash_prefill_ok(attrs, ctx, C, ck,
-                                           paged=table is not None)
+                                           paged=table is not None,
+                                           pack=pack)
         if flash_pre:
             interp = flash_pre == "interpret"
             if table is not None:
@@ -537,7 +580,7 @@ class IncMultiHeadSelfAttention(_ServingAttentionBase):
         return [self._output(params, out, attrs, ctx)]
 
     @staticmethod
-    def _flash_decode_ok(attrs, ctx, C, ck, paged=False):
+    def _flash_decode_ok(attrs, ctx, C, ck, paged=False, pack=1):
         """Gate for the length-tiled flash-decode kernel
         (kernels/flash_decode.py).  The HOST decides per step whether the
         kernel's per-row tile pruning beats the XLA attend for this
@@ -546,7 +589,9 @@ class IncMultiHeadSelfAttention(_ServingAttentionBase):
         (single-token decode, lane-aligned head dim, unsharded cache or
         one sharded over tp/sp — r5; ALiBi is in-kernel).  ``paged``
         records gate on the page-table kernel's shapes instead
-        (paged_path_ok — PR 10).  FF_FLASH_DECODE=interpret runs the
+        (paged_path_ok — PR 10).  ``pack``: codes per carrier byte —
+        int4 caches need the wider 64-logical-position alignment (32
+        int8 sublanes of carrier).  FF_FLASH_DECODE=interpret runs the
         kernel interpreted regardless of platform (CI coverage of the
         in-model wiring on CPU); =0 disables.  Returns 'interpret',
         True or False."""
@@ -558,12 +603,12 @@ class IncMultiHeadSelfAttention(_ServingAttentionBase):
         if mode == "0" or not getattr(ctx, "use_flash", False):
             return False
         gate = paged_path_ok if paged else flash_path_ok
-        ok = (gate(C, ck, getattr(ctx, "mesh", None))
+        ok = (gate(C, ck, getattr(ctx, "mesh", None), pack=pack)
               and (mode == "interpret" or pallas_tpu_available()))
         return (mode if mode == "interpret" else True) if ok else False
 
     @staticmethod
-    def _flash_prefill_ok(attrs, ctx, C, ck, paged=False):
+    def _flash_prefill_ok(attrs, ctx, C, ck, paged=False, pack=1):
         """Gate for the length-tiled flash-prefill kernel
         (kernels/flash_prefill.py).  The HOST decides per step whether
         the kernel beats the XLA prefill attend for this batch's attend
@@ -584,7 +629,7 @@ class IncMultiHeadSelfAttention(_ServingAttentionBase):
         if mode == "0" or not getattr(ctx, "use_flash", False):
             return False
         gate = paged_prefill_path_ok if paged else prefill_path_ok
-        ok = (gate(C, ck, getattr(ctx, "mesh", None))
+        ok = (gate(C, ck, getattr(ctx, "mesh", None), pack=pack)
               and (mode == "interpret" or pallas_tpu_available()))
         return (mode if mode == "interpret" else True) if ok else False
 
@@ -669,6 +714,47 @@ class TreeIncMultiHeadSelfAttention(_ServingAttentionBase):
         fd = jnp.where(okd, fd, F)
         return pool.at[fd, :, dst % L].set(vals, mode="drop")
 
+    @staticmethod
+    def _commit_packed_paged(pool, table, count, src, dst):
+        """The page-table commit for int4 CARRIER pools ``[F, KV,
+        page_len//2, D]``: logical position ``src[i]`` resolves through
+        the table to (frame, carrier byte, nibble); the gather
+        sign-extends the selected nibble and the rewrite runs the
+        two-pass parity merge at the destination (even logical
+        positions first, odd on the pass-A result) so committed
+        neighbours sharing a destination byte compose.  Scale pools
+        stay logical-length and take :meth:`_commit_paged`."""
+        F, KV, L2, D = pool.shape
+        L = L2 * 2
+        P = table.shape[1]
+        n_slots = src.shape[1]
+        src = jnp.clip(src.astype(jnp.int32), 0, P * L - 1)
+        fs = jnp.clip(jnp.take_along_axis(table, src // L, axis=1),
+                      0, F - 1)
+        v = pool[fs, :, (src % L) // 2].astype(jnp.int32)  # [R,C,KV,D]
+        code = jnp.where((src % 2).astype(bool)[:, :, None, None],
+                         v >> 4, (v << 28) >> 28)          # sign-extended
+        live = jnp.arange(n_slots)[None, :] < count[:, None]
+        dst = dst.astype(jnp.int32)
+        dpage = dst // L
+        fd = jnp.take_along_axis(table, jnp.clip(dpage, 0, P - 1),
+                                 axis=1)
+        okd = (live & (dst >= 0) & (dpage < P)
+               & (fd >= 0) & (fd < F))
+        fd = jnp.where(okd, fd, 0)      # safe gather index; DROP via tgt
+        db = (dst % L) // 2
+        odd = (dst % 2).astype(bool)
+        for parity in (False, True):
+            m = okd & (odd == parity)
+            old = pool[fd, :, db].astype(jnp.int32)
+            c4 = code & 0x0F
+            new = jnp.where(odd[:, :, None, None],
+                            (old & 0x0F) | (c4 << 4),
+                            (old & ~0x0F) | c4).astype(pool.dtype)
+            pool = pool.at[jnp.where(m, fd, F), :, db].set(new,
+                                                           mode="drop")
+        return pool
+
     def inference(self, params, inputs, attrs, ctx):
         (x,) = inputs  # [R, C, E] — C = flattened tree slots
         bc = ctx.batch_config
@@ -676,21 +762,34 @@ class TreeIncMultiHeadSelfAttention(_ServingAttentionBase):
         R, C, _ = x.shape
         ck, cv, ks, vs = self._cache(ctx, layer)
         quant = ks is not None
+        pack = kv_pack_factor(ck, ks)
         table = self._page_table(ctx)
-        # 1) commit verified tokens from the previous verify step (int8
-        # caches move each committed position's SCALE with its codes —
-        # a code reinterpreted under another position's scale would
-        # silently rescale the whole head slice)
+        # 1) commit verified tokens from the previous verify step
+        # (int8/int4 caches move each committed position's SCALE with
+        # its codes — a code reinterpreted under another position's
+        # scale would silently rescale the whole head slice; int4
+        # carriers commit nibble-wise via the packed commit twins)
         if table is not None:
             commit = (lambda c: self._commit_paged(
                 c, table, bc["commit_count"], bc["commit_src"],
                 bc["commit_dst"]))
+            commit_kv = commit if pack == 1 else (
+                lambda c: self._commit_packed_paged(
+                    c, table, bc["commit_count"], bc["commit_src"],
+                    bc["commit_dst"]))
         else:
             commit = (lambda c: self._commit(
                 c, bc["commit_count"], bc["commit_src"],
                 bc["commit_dst"]))
-        ck = commit(ck)
-        cv = commit(cv)
+            if pack == 1:
+                commit_kv = commit
+            else:
+                from ..quantization import commit_kv_packed
+                commit_kv = (lambda c: commit_kv_packed(
+                    c, bc["commit_count"], bc["commit_src"],
+                    bc["commit_dst"]))
+        ck = commit_kv(ck)
+        cv = commit_kv(cv)
         if quant:
             ks = commit(ks)
             vs = commit(vs)

@@ -243,6 +243,169 @@ def scatter_kv_scales_paged(scales, chunk, start, active, table):
                                          mode="drop")
 
 
+# ------------------------------------------------- int4 packed KV cache
+# Carrier layout (the serving caches' "int4" dtype): the K/V arrays stay
+# int8-TYPED but hold 2 codes/byte along the SEQUENCE axis at half width
+# — dense ``[R, KV, S//2, D]`` / paged ``[F, KV, L//2, D]`` — so every
+# dtype-generic layer (sharding pspecs, pager frame pool, whole-frame
+# migration, prefix-pool keys) sees an ordinary int8 array and needs no
+# new cases.  Byte at carrier row ``s2`` holds logical position ``2*s2``
+# in the LOW nibble and ``2*s2 + 1`` in the HIGH nibble (the
+# file-loader's weight-pack convention, quantize_int4_nd above).  Scale
+# frames keep the FULL logical length (f32 ``[R, KV, S]``), which also
+# makes the pack factor recoverable from static shapes alone
+# (:func:`kv_pack_factor`).
+
+def quantize_kv_int4(x):
+    """Symmetric per-slice int4 quantization: float ``[..., D]`` ->
+    (codes int8 ``[..., D]`` in [-7, 7], scale f32 ``[...]``).  Codes
+    come back UNPACKED (one per byte) — the jnp scatter packs them via
+    :func:`scatter_kv_packed` and the Pallas chunk append packs them
+    in-kernel, both from the same exact integers, so the two paths
+    write bit-identical carrier bytes.  Symmetric around 0 at +-7 (not
+    -8) so negation symmetry holds like the int8 KV quantizer's."""
+    m = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1)
+    scale = jnp.where(m == 0, 1.0, m / 7.0).astype(jnp.float32)
+    q = jnp.clip(jnp.rint(x.astype(jnp.float32) / scale[..., None]),
+                 -7, 7).astype(jnp.int8)
+    return q, scale
+
+
+def pack_kv_int4(q, axis: int = 2):
+    """Codes int8 (values in [-8, 7]) -> packed carrier int8 with
+    ``axis`` halved.  Even positions land in low nibbles."""
+    qm = jnp.moveaxis(q, axis, 0)
+    packed = ((qm[0::2] & 0x0F) | ((qm[1::2] & 0x0F) << 4))
+    return jnp.moveaxis(packed.astype(jnp.int8), 0, axis)
+
+
+def unpack_kv_int4(p, axis: int = 2):
+    """Packed carrier int8 -> sign-extended codes int8 with ``axis``
+    doubled (low nibble first, interleaved back to logical order)."""
+    pm = jnp.moveaxis(p, axis, 0)
+    lo = (pm << 4).astype(jnp.int8) >> 4               # sign-extend low
+    hi = pm.astype(jnp.int8) >> 4                      # arithmetic shift
+    n = pm.shape[0] * 2
+    q = jnp.stack([lo, hi], axis=1).reshape((n,) + pm.shape[1:])
+    return jnp.moveaxis(q, 0, axis)
+
+
+def dequantize_kv_packed(packed, scale, dtype, axis: int = 2):
+    """Packed carrier + full-length scale -> ``dtype``; the unpack is
+    pure shifts/masks so XLA fuses it (with the dequant multiply) into
+    the attend's operand load — the HBM stream stays at 0.5 byte per
+    cached value."""
+    return dequantize_kv(unpack_kv_int4(packed, axis), scale, dtype)
+
+
+def kv_pack_factor(cache, scales) -> int:
+    """Codes per carrier byte, recovered from static shapes: the scale
+    frame keeps full logical length on axis 2 while the int4 carrier
+    halves it.  1 for bf16 (no scales) and int8, 2 for int4; works for
+    dense ``[R, KV, S(,D)]`` and paged ``[F, KV, L(,D)]`` layouts."""
+    if scales is None:
+        return 1
+    return scales.shape[2] // cache.shape[2]
+
+
+def _merge_nibbles(carrier, rows, byte, ok, codes, odd):
+    """One parity pass of the packed scatter: gather the target bytes,
+    merge ``codes`` into the ``odd`` (high) or even (low) nibble, and
+    scatter back with out-of-range/inactive entries redirected past the
+    end (DROP).  Within one parity class consecutive logical positions
+    hit DISTINCT bytes, so the scatter is collision-free."""
+    S2 = carrier.shape[2]
+    old = carrier[rows, :, jnp.clip(byte, 0, S2 - 1)].astype(jnp.int32)
+    c4 = codes.astype(jnp.int32) & 0x0F
+    new = jnp.where(odd[..., None, None],
+                    (old & 0x0F) | (c4 << 4),
+                    (old & ~0x0F) | c4).astype(carrier.dtype)
+    tgt = jnp.where(ok, byte, S2)
+    return carrier.at[rows, :, tgt].set(new, mode="drop")
+
+
+def scatter_kv_packed(carrier, codes, start, active):
+    """``carrier [R, KV, S//2, D] <- codes [R, C, KV, D]`` (int4 values,
+    unpacked) at per-row LOGICAL offset ``start`` — the packed twin of
+    serving_attention._scatter_chunk.  Read-modify-write in two
+    parity-sequenced passes (even logical positions merge low nibbles,
+    then odd positions merge highs on the pass-A result) so a chunk
+    boundary splitting a byte never loses the neighbouring nibble.
+    ``start`` may be signed (sharded callers pass shard-local offsets);
+    out-of-range positions and inactive rows DROP."""
+    S2 = carrier.shape[2]
+    R, C = codes.shape[:2]
+    pos = start[:, None].astype(jnp.int32) + jnp.arange(C,
+                                                        dtype=jnp.int32)
+    ok = active[:, None].astype(bool) & (pos >= 0) & (pos < S2 * 2)
+    byte, odd = pos // 2, (pos % 2).astype(bool)
+    rows = jnp.broadcast_to(jnp.arange(R)[:, None], (R, C))
+    carrier = _merge_nibbles(carrier, rows, byte, ok & ~odd, codes, odd)
+    return _merge_nibbles(carrier, rows, byte, ok & odd, codes, odd)
+
+
+def scatter_kv_packed_paged(pool, codes, start, active, table):
+    """``pool [F, KV, page_len//2, D] <- codes [R, C, KV, D]`` through
+    the per-row page table (the packed twin of _scatter_chunk_paged):
+    logical position ``start[r] + c`` lands in frame ``table[r, pos //
+    L]`` at carrier byte ``(pos % L) // 2``.  Same two-pass parity
+    merge; positions past the table, unleased (negative) frames and
+    inactive rows redirect to the frame sentinel and DROP."""
+    F, KV, L2, D = pool.shape
+    L = L2 * 2
+    R, C = codes.shape[:2]
+    P = table.shape[1]
+    pos = start[:, None].astype(jnp.int32) + jnp.arange(C,
+                                                        dtype=jnp.int32)
+    page = pos // L
+    fr = jnp.take_along_axis(jnp.asarray(table, jnp.int32),
+                             jnp.clip(page, 0, P - 1), axis=1)
+    ok = (active[:, None].astype(bool) & (pos >= 0) & (page < P)
+          & (fr >= 0) & (fr < F))
+    fr = jnp.where(ok, fr, 0)           # safe gather index; DROP via tgt
+    byte, odd = (pos % L) // 2, (pos % 2).astype(bool)
+    for parity in (False, True):
+        m = ok & (odd == parity)
+        old = pool[fr, :, jnp.clip(byte, 0, L2 - 1)].astype(jnp.int32)
+        c4 = codes.astype(jnp.int32) & 0x0F
+        new = jnp.where(odd[..., None, None],
+                        (old & 0x0F) | (c4 << 4),
+                        (old & ~0x0F) | c4).astype(pool.dtype)
+        f_tgt = jnp.where(m, fr, F)
+        pool = pool.at[f_tgt, :, byte].set(new, mode="drop")
+    return pool
+
+
+def commit_kv_packed(carrier, count, src, dst):
+    """Tree-verify commit on a packed carrier ``[R, KV, S//2, D]``: per
+    row, gather the int4 codes at LOGICAL positions ``src[r, i]`` and
+    rewrite them at ``dst[r, i]`` for ``i < count[r]`` (the packed twin
+    of TreeIncMultiHeadSelfAttention's slot-compaction gather).  The
+    gather sign-extends whichever nibble ``src`` selects; the rewrite
+    runs the two-pass parity merge so committed neighbours sharing a
+    destination byte compose instead of clobbering."""
+    def row_fn(car, n, s_idx, d_idx):
+        S2 = car.shape[1]
+        N = s_idx.shape[0]
+        valid = jnp.arange(N, dtype=jnp.int32) < n
+        v = car[:, jnp.clip(s_idx // 2, 0, S2 - 1)].astype(jnp.int32)
+        code = jnp.where((s_idx % 2).astype(bool)[None, :, None],
+                         v >> 4, (v << 28) >> 28)      # sign-extended
+        db, odd = d_idx // 2, (d_idx % 2).astype(bool)
+        for parity in (False, True):
+            m = valid & (odd == parity)
+            old = car[:, jnp.clip(db, 0, S2 - 1)].astype(jnp.int32)
+            c4 = code & 0x0F
+            new = jnp.where(odd[None, :, None],
+                            (old & 0x0F) | (c4 << 4),
+                            (old & ~0x0F) | c4).astype(car.dtype)
+            car = car.at[:, jnp.where(m, db, S2)].set(new, mode="drop")
+        return car
+
+    import jax
+    return jax.vmap(row_fn)(carrier, count, src, dst)
+
+
 # ------------------------------------------------- N-d int8 (attention)
 def quantize_int8_nd(w: np.ndarray, reduce_axes):
     """Symmetric int8 with scale over the non-reduced (output) axes; q

@@ -324,11 +324,13 @@ class LLM:
         BEAM_SEARCH mode on the same InferenceManager (reference
         spec_infer.cc:325-376 semantics).
 
-        ``kv_cache_dtype``: "bf16" (default — the computation dtype) or
+        ``kv_cache_dtype``: "bf16" (default — the computation dtype),
         "int8" (quantized KV cache + f32 per-head scales; halves decode
-        cache HBM reads — docs/INTERNALS.md "KV cache memory layout &
-        dtype").  Also settable via FFConfig.kv_cache_dtype; applies to
-        the LLM and every SSM.
+        cache HBM reads), or "int4" (two codes packed per int8 carrier
+        byte along the sequence axis; quarter-bandwidth decode attend
+        and ~4x resident context at the same HBM — docs/INTERNALS.md
+        "KV cache memory layout & dtype").  Also settable via
+        FFConfig.kv_cache_dtype; applies to the LLM and every SSM.
 
         ``kv_page_budget_bytes``: enable the paged KV allocator
         (serving/kv_pager.py) with this committed-KV byte budget: cache

@@ -35,6 +35,9 @@ The parts were already here; this module only retargets them:
 Kill switch: ``FF_DISAGG=0`` makes :meth:`RequestManager.
 generate_disagg` fall back to the single-mesh incremental driver (the
 mixed-continuous A/B arm) without recompiling anything.
+``FF_PREFILL_SJF=1`` swaps the prefill slice's FCFS admission for
+shortest-job-first over calibrated prefill cost (:func:`_sjf_reorder`;
+``bench.py disagg`` stamps which order each run used).
 
 Bit-exactness: KV depends only on token values and absolute positions
 (the prefix-cache argument), migration moves raw cache bytes, and the
@@ -45,6 +48,7 @@ single-mesh arms bit for bit (tests/test_disagg.py pins it, and
 
 from __future__ import annotations
 
+import os
 import time
 from typing import Any, Dict, List, Optional
 
@@ -370,12 +374,55 @@ def _drain_cancels(rm, pre: SlicePool, st: _DisaggState) -> int:
     return n
 
 
+def _sjf_reorder(rm, pre: SlicePool, dec: SlicePool) -> None:
+    """Shortest-job-first admission order for the prefill slice
+    (``FF_PREFILL_SJF=1``; ROADMAP "scheduling frontier"): stably
+    reorder the pending queue by estimated prefill cost — the
+    request's remaining prompt tokens priced through the prefill
+    slice's :class:`RecoveryPolicy` (``recompute_s`` is exactly the
+    calibrated cost of a chunked prefill of n tokens under the machine
+    roofline, so a recalibrated machine model reorders the queue
+    too).  Preempted returnees with a parked spill keep absolute
+    priority: their prefill is already done, SJF only orders the jobs
+    that will OCCUPY the prefill slice.  The sort is stable, so
+    equal-cost prompts keep FCFS order; long prompts CAN age under
+    sustained short arrivals — the latency/fairness trade the flag
+    opts into (``bench.py disagg`` stamps both arms)."""
+    if len(rm.pending) < 2 \
+            or os.environ.get("FF_PREFILL_SJF", "0") != "1":
+        return
+    policy = getattr(pre, "_sjf_policy", None)
+    if policy is None:
+        policy = pre._sjf_policy = RecoveryPolicy.for_record(
+            pre.im, pre.model_id)
+    pager = dec.pager
+
+    def key(item):
+        i, req = item
+        if pager is not None and pager.peek_spill(req.guid) is not None:
+            return (0, 0.0, i)
+        return (1, policy.recompute_s(len(req.tokens)), i)
+
+    order = sorted(enumerate(rm.pending), key=key)
+    if [i for i, _ in order] == list(range(len(order))):
+        return
+    reqs = [req for _, req in order]
+    rm.pending.clear()
+    rm.pending.extend(reqs)
+    rm.tracer.instant("sjf-reorder", depth=len(reqs),
+                      head_guid=reqs[0].guid,
+                      head_prompt=len(reqs[0].tokens))
+
+
 def _admit(rm, pre: SlicePool, dec: SlicePool, st: _DisaggState) -> None:
     """Two-pool admission: fresh requests take a prefill row now AND
     reserve a decode row for their handoff (the both-pools gate);
     preempted returnees with a parked spill go straight back to the
     decode pool.  Blocks are counted once per (request, reason)
-    transition exactly like the single-pool path."""
+    transition exactly like the single-pool path.  Under
+    ``FF_PREFILL_SJF=1`` the queue is shortest-prefill-first (stable;
+    :func:`_sjf_reorder`) instead of FCFS."""
+    _sjf_reorder(rm, pre, dec)
     pager = dec.pager
     admission_preempted = False
     while rm.pending:

@@ -146,19 +146,30 @@ def resolve_cache_dtype(cfg, cache_dtype=None,
     — shared with pre-compile sizing (paged pool budgets)."""
     kv_cache_dtype = kv_cache_dtype or getattr(cfg, "kv_cache_dtype",
                                                None)
-    if kv_cache_dtype not in (None, "bf16", "int8"):
+    if kv_cache_dtype not in (None, "bf16", "int8", "int4"):
         raise ValueError(
-            f"kv_cache_dtype={kv_cache_dtype!r}: expected 'bf16' or "
-            f"'int8'")
-    if kv_cache_dtype == "int8" and cache_dtype is None:
-        cache_dtype = jnp.int8
+            f"kv_cache_dtype={kv_cache_dtype!r}: expected 'bf16', "
+            f"'int8' or 'int4'")
+    if kv_cache_dtype in ("int8", "int4") and cache_dtype is None:
+        cache_dtype = jnp.int8          # int4 rides an int8 carrier
     return jnp.dtype(cache_dtype or jnp.dtype(cfg.computation_dtype))
 
 
-def estimate_kv_bytes_per_token(model, cache_dtype) -> int:
+def resolve_kv_pack(cfg, kv_cache_dtype: Optional[str] = None) -> int:
+    """Codes per carrier byte: 2 for the packed int4 cache (int8-typed
+    carrier at HALF the logical sequence extent), 1 otherwise.  The
+    twin of :func:`resolve_cache_dtype` — together they fully describe
+    the storage layout (carrier dtype + logical/carrier ratio)."""
+    kv_cache_dtype = kv_cache_dtype or getattr(cfg, "kv_cache_dtype",
+                                               None)
+    return 2 if kv_cache_dtype == "int4" else 1
+
+
+def estimate_kv_bytes_per_token(model, cache_dtype, pack: int = 1) -> int:
     """Per-attended-position KV stream bytes across the model's
     serving-attention layers at ``cache_dtype`` storage (K + V, plus
-    the f32 scales of int8 caches) — KVCacheStats.bytes_per_token
+    the f32 scales of int8/int4 caches; ``pack`` = 2 halves the code
+    bytes for packed int4 carriers) — KVCacheStats.bytes_per_token
     WITHOUT allocating, so paged frame pools can be sized from a byte
     budget before compile."""
     dt = jnp.dtype(cache_dtype)
@@ -168,7 +179,7 @@ def estimate_kv_bytes_per_token(model, cache_dtype) -> int:
             a = layer.attrs
             kvh = a["num_kv_heads"]
             d = a.get("head_dim") or a["embed_dim"] // a["num_q_heads"]
-            per += kvh * d * 2 * dt.itemsize
+            per += kvh * d * 2 * dt.itemsize // pack
             if dt.itemsize == 1:
                 per += kvh * 2 * 4      # f32 k/v scale frames
     return per
@@ -304,17 +315,20 @@ def record_flash_ok(record, C: int) -> bool:
     if not caches:
         return False
     mesh = record.get("mesh")
+    pack = record.get("kv_pack", 1)
     if record.get("paged"):
         from ..kernels.flash_decode import paged_path_ok
         from ..kernels.flash_prefill import paged_prefill_path_ok
 
         gate = paged_path_ok if C == 1 else paged_prefill_path_ok
-        return all(gate(C, kv["k"], mesh) for kv in caches.values())
+        return all(gate(C, kv["k"], mesh, pack=pack)
+                   for kv in caches.values())
     from ..kernels.flash_decode import flash_path_ok
     from ..kernels.flash_prefill import prefill_path_ok
 
     gate = flash_path_ok if C == 1 else prefill_path_ok
-    return all(gate(C, kv["k"], mesh) for kv in caches.values())
+    return all(gate(C, kv["k"], mesh, pack=pack)
+               for kv in caches.values())
 
 
 # Uniform-batch max DEPTH above which the flash-decode kernel
@@ -563,12 +577,17 @@ class InferenceManager:
         """Returns a model_id handle.  reference: inference_manager.cc:81.
 
         ``kv_cache_dtype``: "bf16" (the computation dtype — bit-identical
-        to the pre-existing default) or "int8" (int8 K/V plus f32
+        to the pre-existing default), "int8" (int8 K/V plus f32
         per-row-per-position-per-head scale tensors; halves decode cache
-        HBM and doubles resident rows x context).  Defaults to the
+        HBM and doubles resident rows x context), or "int4" (PACKED 2
+        codes/byte in an int8-typed carrier at HALF the logical
+        sequence extent, same f32 scale frames — quarters the cache
+        HBM vs bf16 and quadruples resident context).  Defaults to the
         FFConfig's ``kv_cache_dtype``; ``cache_dtype`` (a raw dtype)
         still overrides the storage dtype directly — ``jnp.int8`` there
-        selects the quantized layout too (rewiden_beam round-trips it).
+        selects the int8 quantized layout (rewiden_beam round-trips
+        int4 via the ``kv_cache_dtype`` tag instead, since the carrier
+        dtype alone cannot distinguish int8 from packed int4).
 
         ``kv_layout``: "dense" (default — per-row ``[R, KV, S, D]``
         slabs) or "paged" (PR 10): K/V live in a GLOBAL frame pool
@@ -594,6 +613,11 @@ class InferenceManager:
         cache_dtype = resolve_cache_dtype(cfg, cache_dtype,
                                           kv_cache_dtype)
         kv_quantized = cache_dtype == jnp.dtype(jnp.int8)
+        # int4: same int8 carrier dtype, 2 codes/byte along the LOGICAL
+        # sequence axis — the carrier allocates at HALF the logical
+        # extent, every downstream consumer derives the ratio from the
+        # record's kv_pack (or the carrier/scale shape ratio)
+        kv_pack = resolve_kv_pack(cfg, kv_cache_dtype)
         # slack tail: a mixed decode/prefill batch scatters a full chunk at
         # each row's depth; rows near max_seq_length would otherwise have
         # the scatter clamped back over committed entries
@@ -606,7 +630,9 @@ class InferenceManager:
         # per-shard, so the per-shard length is what must align).  int8
         # caches align to 32 instead — the int8 sublane tiling is (32,
         # 128), so the flash append's RMW windows are 32 positions wide.
-        m = (32 if kv_quantized else 16) * sp
+        # (int4 doubles that to 64 LOGICAL positions = 32 carrier
+        # sublanes at 2 codes/byte)
+        m = (32 * kv_pack if kv_quantized else 16) * sp
         alloc_len = -(-alloc_len // m) * m
         paged = kv_layout == "paged"
         if kv_layout not in (None, "dense", "paged"):
@@ -620,6 +646,13 @@ class InferenceManager:
                     f"kv_page_len={kv_page_len} must be a multiple of "
                     f"{PAGE_ALIGN} (16-aligned chunk starts AND the "
                     f"32-wide int8 RMW window)")
+            if kv_page_len % (PAGE_ALIGN * kv_pack):
+                raise ValueError(
+                    f"kv_page_len={kv_page_len} with "
+                    f"kv_cache_dtype='int4' must be a multiple of "
+                    f"{PAGE_ALIGN * kv_pack}: packed carriers store 2 "
+                    f"codes/byte, so a frame needs {PAGE_ALIGN * kv_pack}"
+                    f" logical positions to keep 32 carrier sublanes")
             if beam_width != 1:
                 raise ValueError(
                     "kv_layout='paged' requires beam_width == 1: the "
@@ -638,6 +671,11 @@ class InferenceManager:
             model.params = model.init_params(jax.random.PRNGKey(cfg.seed))
 
         if pp > 1:
+            if kv_pack != 1:
+                raise ValueError(
+                    "kv_cache_dtype='int4' is not wired through "
+                    "pipeline stage row-group slicing yet — pp records "
+                    "keep bf16/int8 caches")
             return self._compile_pipeline_model(
                 model, mode, max_requests, max_seq_length, prefill_chunk,
                 beam_width, cache_dtype, model_id, rows, alloc_len)
@@ -715,7 +753,8 @@ class InferenceManager:
                 # kv_page_budget_bytes / the bench's fixed-HBM arm):
                 # never below one full row — forward progress
                 frame_bytes = kv_page_len * max(
-                    1, estimate_kv_bytes_per_token(model, cache_dtype))
+                    1, estimate_kv_bytes_per_token(model, cache_dtype,
+                                                   kv_pack))
                 kv_num_frames = max(
                     max_pages, int(kv_frame_budget_bytes) // frame_bytes)
             num_frames = int(kv_num_frames or rows * max_pages)
@@ -748,8 +787,11 @@ class InferenceManager:
                         f"length axis to shard)")
                 shape = ((num_frames, kv, kv_page_len, d) if paged
                          else (rows, kv, alloc_len, d))
-                k = jnp.zeros(shape, cache_dtype)
-                v = jnp.zeros(shape, cache_dtype)
+                # int4: the CARRIER allocates at half the logical
+                # length; the f32 scale frames below stay logical
+                car = (shape[0], shape[1], shape[2] // kv_pack, shape[3])
+                k = jnp.zeros(car, cache_dtype)
+                v = jnp.zeros(car, cache_dtype)
                 if cache_sharding is not None:
                     k = jax.device_put(k, cache_sharding)
                     v = jax.device_put(v, cache_sharding)
@@ -760,7 +802,10 @@ class InferenceManager:
                 if kv_quantized:
                     # f32 per-row-per-position-per-head scales beside the
                     # int8 K/V (zero scale => unwritten positions
-                    # dequantize to 0, matching a zeroed bf16 cache)
+                    # dequantize to 0, matching a zeroed bf16 cache);
+                    # scales keep the LOGICAL length — the carrier/scale
+                    # shape ratio IS the pack-factor signal every
+                    # kernel and fallback derives from
                     for part in ("k_scale", "v_scale"):
                         s = jnp.zeros(shape[:3], jnp.float32)
                         if scale_sharding is not None:
@@ -775,6 +820,7 @@ class InferenceManager:
                       max_seq_length=max_seq_length, beam_width=beam_width,
                       prefill_chunk=prefill_chunk, steps={},
                       alloc_len=alloc_len, kv_quantized=kv_quantized,
+                      kv_pack=kv_pack,
                       cache_pspec=(cache_sharding.spec
                                    if cache_sharding is not None else None))
         if paged:
@@ -818,7 +864,7 @@ class InferenceManager:
                       max_requests=max_requests, rows=rows,
                       max_seq_length=max_seq_length, beam_width=beam_width,
                       prefill_chunk=prefill_chunk, steps={},
-                      alloc_len=alloc_len,
+                      alloc_len=alloc_len, kv_pack=1,
                       kv_quantized=(jnp.dtype(cache_dtype)
                                     == jnp.dtype(jnp.int8)))
         compile_pipeline(self, record, model, cfg, cache_dtype, rows,
@@ -872,12 +918,18 @@ class InferenceManager:
         caches = rec.get("caches") or {}
         cache_dtype = (next(iter(caches.values()))["k"].dtype
                        if caches else None)
+        # the carrier dtype alone cannot distinguish int8 from packed
+        # int4 — round-trip the dtype TAG so the recompile re-allocates
+        # half-width carriers (and min_prefill_chunk keeps its floor)
         self.compile_model_and_allocate_buffer(
             rec["model"], mode=rec["mode"],
             max_requests=rec["max_requests"],
             max_seq_length=rec["max_seq_length"],
             prefill_chunk=rec["prefill_chunk"], beam_width=beam_width,
-            cache_dtype=cache_dtype, model_id=model_id)
+            cache_dtype=cache_dtype,
+            kv_cache_dtype=("int4" if rec.get("kv_pack", 1) == 2
+                            else None),
+            model_id=model_id)
 
     def free_model(self, model_id: int):
         """Drop a model record AND any beam-width variants parked for it
@@ -900,31 +952,40 @@ class InferenceManager:
         """Floor for host-picked prefill chunks (batch_config.pick_chunk
         min_chunk): int8 caches need 32-divisible chunks for the flash-
         prefill append window (prefill_path_ok's 32-alignment — a 16-token
-        chunk silently falls back to the XLA attend), bf16 records keep
-        the pow2 >= 16 ladder unchanged."""
-        return 32 if self.models[model_id].get("kv_quantized") else 1
+        chunk silently falls back to the XLA attend), int4 carriers
+        double that to 64 (2 codes/byte keeps the RMW window at 32
+        carrier sublanes), bf16 records keep the pow2 >= 16 ladder
+        unchanged."""
+        rec = self.models[model_id]
+        if not rec.get("kv_quantized"):
+            return 1
+        return 32 * rec.get("kv_pack", 1)
 
     def count_kernel_path(self, record, chunk: int, gate_ok: bool,
                           use: bool):
         """Record one flash-vs-XLA dispatch decision in
         serving_kernel_path_total (phase=decode|prefill, path=flash|xla,
-        reason=path_gate|forced|cost_model, cache=int8|fp) — the SINGLE
-        label derivation, shared with the pipeline-parallel dispatch
-        sites (pipeline_serving) so the two layouts' counters cannot
-        diverge.  The cache label splits the int8 arm from the
-        full-precision arm in cumulative (multi-record) snapshots —
-        bench.py kvdtype runs both in one process."""
+        reason=path_gate|forced|cost_model, cache=int4|int8|fp) — the
+        SINGLE label derivation, shared with the pipeline-parallel
+        dispatch sites (pipeline_serving) so the two layouts' counters
+        cannot diverge.  The cache label splits the quantized arms from
+        the full-precision arm in cumulative (multi-record) snapshots —
+        bench.py kvdtype runs all three in one process."""
         if not self._registry.enabled:
             # disabled-mode contract (FF_TELEMETRY=0, the <2%-overhead
             # bench gate): bail before deriving the reason label — the
             # env lookup + label kwargs would otherwise run per STEP in
             # the hot driver loop only for inc() to drop them
             return
+        if not record.get("kv_quantized"):
+            cache = "fp"
+        else:
+            cache = "int4" if record.get("kv_pack", 1) == 2 else "int8"
         self._c_kernel_path.inc(
             phase="decode" if chunk == 1 else "prefill",
             path="flash" if use else "xla",
             reason=_kernel_path_reason(chunk, gate_ok),
-            cache="int8" if record.get("kv_quantized") else "fp")
+            cache=cache)
 
     def note_pp_dispatches(self, stage: int, n: int):
         """Bulk-record pipeline stage-step dispatches (the registry twin
@@ -1519,6 +1580,7 @@ class InferenceManager:
         pair.  The device half of the prefix cache: admission copies a
         pooled prefix into the new request's row instead of re-running
         prefill over it."""
+        pack = record.get("kv_pack", 1)
 
         def copy(caches, src, dst):
             def cp(c):
@@ -1530,8 +1592,11 @@ class InferenceManager:
                         c, (src, 0, 0), (1, c.shape[1], L))
                     return jax.lax.dynamic_update_slice(c, seg,
                                                         (dst, 0, 0))
+                # int4 carriers: L logical positions = L//pack bytes
+                # (L is a pow2 bucket >= 2, so the division is exact)
                 seg = jax.lax.dynamic_slice(
-                    c, (src, 0, 0, 0), (1, c.shape[1], L, c.shape[3]))
+                    c, (src, 0, 0, 0),
+                    (1, c.shape[1], L // pack, c.shape[3]))
                 return jax.lax.dynamic_update_slice(c, seg, (dst, 0, 0, 0))
 
             out = jax.tree.map(cp, caches)
@@ -1543,14 +1608,21 @@ class InferenceManager:
         return jax.jit(copy, donate_argnums=(0,))
 
     def cache_dtype_key(self, model_id: int) -> str:
-        """Short dtype tag of a record's KV-cache storage ("int8",
-        "bfloat16", "float32", ...).  The prefix pool keys donated rows
-        by it so a bf16 pool entry never feeds an int8 record (and vice
-        versa) after a same-model_id recompile at a different dtype —
-        the bytes in the row would be reinterpreted, not converted."""
-        caches = self.models[model_id].get("caches") or {}
+        """Short dtype tag of a record's KV-cache storage ("int4",
+        "int8", "bfloat16", "float32", ...).  The prefix pool keys
+        donated rows by it so a bf16 pool entry never feeds an int8
+        record (and vice versa) after a same-model_id recompile at a
+        different dtype — the bytes in the row would be reinterpreted,
+        not converted.  Packed int4 carriers are int8-typed, so the key
+        comes from the record's pack factor, NOT the carrier dtype: an
+        int4 row fed to an int8 record would halve-misread every
+        position."""
+        rec = self.models[model_id]
+        caches = rec.get("caches") or {}
         if not caches:
             return "none"
+        if rec.get("kv_pack", 1) == 2:
+            return "int4"
         return str(next(iter(caches.values()))["k"].dtype)
 
     def kv_cache_stats(self, model_id: int):
@@ -1798,16 +1870,21 @@ class InferenceManager:
         cache row's first ``L`` positions across every layer/part; one
         compiled variant per pow2 length bucket, dynamic row index."""
 
+        pack = record.get("kv_pack", 1)
+
         def fetch(caches, row):
             def cut(c):
                 # fflint: disable=retrace-hazard  rank dispatch over the
                 # record's FIXED cache pytree ([R,KV,S] scale leaves vs
                 # [R,KV,S,D] K/V) — one variant per record, not per call
-                if c.ndim == 3:      # [R, KV, S] scale rows (int8)
+                if c.ndim == 3:      # [R, KV, S] scale rows (int8/int4)
                     return jax.lax.dynamic_slice(
                         c, (row, 0, 0), (1, c.shape[1], L))
+                # int4 carriers pack 2 logical positions per byte along
+                # the sequence axis: L logical positions = L//pack bytes
                 return jax.lax.dynamic_slice(
-                    c, (row, 0, 0, 0), (1, c.shape[1], L, c.shape[3]))
+                    c, (row, 0, 0, 0), (1, c.shape[1], L // pack,
+                                        c.shape[3]))
 
             return jax.tree.map(cut, caches)
 

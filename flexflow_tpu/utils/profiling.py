@@ -121,7 +121,9 @@ class KVCacheStats:
     (depth_r + 1) * bytes_per_token.  The int8 win is visible directly:
     int8 K/V (1 byte) + f32 scales (4 bytes / head / position) lands at
     ~0.52x the bf16 bytes at head_dim 128, which is why the acceptance
-    gate asks for <= 0.55x."""
+    gate asks for <= 0.55x.  Int4 packs two positions per carrier byte
+    (0.5 bytes / element + the same f32 scales) and lands at ~0.28x,
+    gated at <= 0.35x."""
 
     kv_cache_dtype: str
     layers: int
@@ -144,20 +146,26 @@ class KVCacheStats:
     @classmethod
     def of_record(cls, record) -> "KVCacheStats":
         caches = record.get("caches") or {}
+        pack = record.get("kv_pack", 1)
         resident = 0
         per_token = 0
         frame_bytes = 0
         dtype = "none"
         for kv in caches.values():
-            dtype = str(kv["k"].dtype)
+            dtype = "int4" if pack == 2 else str(kv["k"].dtype)
             for part, arr in kv.items():
                 resident += int(arr.size) * arr.dtype.itemsize
                 # per attended position: a 4-D [R, KV, S, D] part
                 # streams KV*D elements per position, a 3-D scale
-                # [R, KV, S] streams KV
+                # [R, KV, S] streams KV.  Int4 carriers hold ``pack``
+                # logical positions per stored byte, so a position
+                # streams KV*D//pack carrier bytes
                 per_pos = int(np.prod(arr.shape[1:2]
                                       + arr.shape[3:]))
-                per_token += per_pos * arr.dtype.itemsize
+                nb = per_pos * arr.dtype.itemsize
+                if arr.ndim == 4:
+                    nb //= pack
+                per_token += nb
                 # paged pools: one frame of this part = everything
                 # past the leading frame axis
                 frame_bytes += (int(np.prod(arr.shape[1:]))

@@ -60,7 +60,9 @@ def _compile(devices=None, max_requests=4, kv_cache_dtype=None,
     im = InferenceManager(model.config)
     kw = {}
     if kv_layout:
-        kw.update(kv_layout=kv_layout, kv_page_len=32)
+        # int4 frames need 64 logical positions (32 carrier sublanes)
+        kw.update(kv_layout=kv_layout,
+                  kv_page_len=(64 if kv_cache_dtype == "int4" else 32))
     mid = im.compile_model_and_allocate_buffer(
         model, mode=mode, max_requests=max_requests,
         max_seq_length=max_seq, prefill_chunk=prefill_chunk,
@@ -96,8 +98,10 @@ class TestMigrationRoundtrip:
     @pytest.mark.parametrize("kv_cache_dtype,kv_layout", [
         (None, None),            # bf16-class (f32 on CPU), dense rows
         ("int8", None),          # int8 + f32 scales, dense rows
+        ("int4", None),          # packed carriers + f32 scales, dense
         (None, "paged"),         # whole frames, identity table
         ("int8", "paged"),       # int8 whole frames + scale frames
+        ("int4", "paged"),       # packed 64-long frames + scale frames
     ])
     def test_roundtrip_bit_exact(self, kv_cache_dtype, kv_layout):
         devs = jax.devices()
@@ -120,7 +124,7 @@ class TestMigrationRoundtrip:
         assert stats["bytes"] > 0
         dst = im_b.fetch_row(mid_b, 2, L)
         assert sorted(src["layers"]) == sorted(dst["layers"])
-        if kv_cache_dtype == "int8":
+        if kv_cache_dtype in ("int8", "int4"):
             parts = next(iter(src["layers"].values()))
             assert "k_scale" in parts and "v_scale" in parts
         for name, parts in src["layers"].items():
@@ -331,6 +335,78 @@ class TestTwoPoolAccounting:
         # every lease settled at retirement: the pool drains back
         assert pager.leases == {} and pager.free_pages == 5
         assert pager.spilled == {}
+
+
+# ----------------------------------------------------------- SJF order
+class TestSJFPrefillOrder:
+    """FF_PREFILL_SJF=1 admits shortest-prefill-first on the prefill
+    slice (stable over calibrated cost; spill returnees keep absolute
+    priority) and — like every scheduling knob — changes WHEN rows
+    compute, never WHAT."""
+
+    def test_reorder_semantics(self, monkeypatch):
+        from flexflow_tpu.serving.disagg import _sjf_reorder
+
+        devs = jax.devices()
+        im_pre, pmid = _compile(devices=(devs[0],), max_requests=1)
+        im_dec, dmid = _compile(devices=(devs[1],), max_requests=2)
+        pre = SlicePool(im_pre, pmid, label="prefill")
+        dec = SlicePool(im_dec, dmid, label="decode")
+        rm = _rm(rows=2)
+        reqs = [rm.register_new_request(p, max_new_tokens=2)
+                for p in _prompts([40, 8, 24, 8], seed=3)]
+        # flag off: FCFS untouched
+        monkeypatch.delenv("FF_PREFILL_SJF", raising=False)
+        _sjf_reorder(rm, pre, dec)
+        assert list(rm.pending) == reqs
+        # flag on: shortest first, equal lengths keep arrival order
+        monkeypatch.setenv("FF_PREFILL_SJF", "1")
+        _sjf_reorder(rm, pre, dec)
+        assert list(rm.pending) == [reqs[1], reqs[3], reqs[2], reqs[0]]
+        # a parked spill beats everything: its prefill is already done
+        pager = KVPager(total_pages=8, page_len=32,
+                        bytes_per_token=512, slice_label="decode")
+        monkeypatch.setattr(
+            pager, "peek_spill",
+            lambda guid: {"len": 1} if guid == reqs[0].guid else None)
+        dec_p = SlicePool(im_dec, dmid, label="decode", pager=pager)
+        _sjf_reorder(rm, pre, dec_p)
+        assert list(rm.pending) == [reqs[0], reqs[1], reqs[3], reqs[2]]
+
+    def test_sjf_admits_short_first_same_tokens(self, monkeypatch):
+        devs = jax.devices()
+        prompts = _prompts([40, 8], seed=5)
+
+        def serve(sjf):
+            if sjf:
+                monkeypatch.setenv("FF_PREFILL_SJF", "1")
+            else:
+                monkeypatch.delenv("FF_PREFILL_SJF", raising=False)
+            im_pre, pmid = _compile(devices=(devs[0],), max_requests=1)
+            im_dec, dmid = _compile(devices=(devs[1],), max_requests=2)
+            rm = _rm(rows=2)
+            reqs = [rm.register_new_request(list(p), max_new_tokens=4)
+                    for p in prompts]
+            mig = FrameMigrator(
+                SlicePool(im_pre, pmid, label="prefill"),
+                SlicePool(im_dec, dmid, label="decode"),
+                policy=RecoveryPolicy(migrate_mode="migrate"))
+            run_disagg_loop(rm, SlicePool(im_pre, pmid, label="prefill"),
+                            SlicePool(im_dec, dmid, label="decode"),
+                            reqs, migrator=mig)
+            return reqs
+
+        fcfs = serve(False)
+        sjf = serve(True)
+        # the 1-row prefill pool serializes admission: FCFS admits the
+        # long prompt first, SJF the short one
+        assert (fcfs[0].profile.admit_mono
+                < fcfs[1].profile.admit_mono)
+        assert (sjf[1].profile.admit_mono
+                < sjf[0].profile.admit_mono)
+        # scheduling neutrality: per-request outputs are identical
+        assert ([list(r.tokens) for r in sjf]
+                == [list(r.tokens) for r in fcfs])
 
 
 # -------------------------------------------------------- kill switch

@@ -439,6 +439,34 @@ class TestPallasTilingRule:
         assert at(fs, "pallas-tiling", 5), fs
         assert len(fs) == 1
 
+    def test_int4_subbyte_sublane_row(self, tmp_path):
+        # the sub-byte row: a packed int4 carrier stores 2 codes/byte,
+        # so one 32-sublane carrier tile spans 64 LOGICAL positions —
+        # a tile declared at jnp.int4 must be 64-aligned (32 is the
+        # int8 row, not int4's)
+        fs = lint(tmp_path, """\
+            from jax.experimental.pallas import tpu as pltpu
+            import jax.numpy as jnp
+
+            def build():
+                bad = pltpu.VMEM((32, 128), jnp.int4)
+                ok = pltpu.VMEM((64, 128), jnp.int4)
+                return bad, ok
+            """, self.R, rel="kernels/k.py")
+        assert at(fs, "pallas-tiling", 5), fs
+        assert len(fs) == 1
+
+    def test_int4_suppression(self, tmp_path):
+        fs = lint(tmp_path, """\
+            from jax.experimental.pallas import tpu as pltpu
+            import jax.numpy as jnp
+
+            def build():
+                # fflint: disable=pallas-tiling  interpret-only int4 tile
+                return pltpu.VMEM((32, 128), jnp.int4)
+            """, self.R, rel="kernels/k.py")
+        assert fs == []
+
     def test_lane_pad_is_a_warning(self, tmp_path):
         fs = lint(tmp_path, """\
             from jax.experimental import pallas as pl

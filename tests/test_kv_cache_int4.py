@@ -1,0 +1,381 @@
+"""int4 packed KV-cache serving tests (kv_cache_dtype="int4").
+
+The packed cache quarters the decode HBM stream: two int4 codes per
+int8 carrier byte along the SEQUENCE axis (carriers ``[R, KV, S//2,
+D]``), with the int8 path's f32 ``[R, KV, S]`` scale frames reused at
+full logical length.  These tests pin the PR's acceptance gates on the
+CPU paths:
+
+- pack/unpack are exact inverses over the full code range, and the
+  fused packed dequant matches unpack-then-dequant bit for bit;
+- the BIT-EXACT greedy A/B: the two int4 serving paths — the jnp
+  fallback and the Pallas kernels in interpret mode — produce
+  token-identical 64-step generations (both quantize through
+  quantize_kv_int4, so any packed-RMW or in-kernel-unpack bug shows as
+  divergence).  Cross-dtype (int4 vs bf16) is a QUALITY gate, not an
+  exactness gate: 4-bit codes legitimately flip near-tied argmaxes on
+  the tiny fixture, so that arm asserts quality_report thresholds;
+- KVCacheStats reports <= 0.35x bf16 cache HBM at equal
+  (rows, alloc_len) for a production-shaped head_dim;
+- the record layout: kv_pack=2, 64-aligned allocation (64 logical
+  positions = 32 carrier sublanes, the packed RMW window), carriers
+  half-width on axis 2 beside full-length scales;
+- the prefix pool's dtype key separates int4 from int8 (reinterpreting
+  packed nibbles as int8 codes would be garbage);
+- whole-frame migration carries int4 rows bit-exactly at roughly a
+  quarter of the bf16 payload bytes;
+- a warmed int4 decode loop compiles nothing (retrace pin), and the
+  unwired corners (pipeline stages, 32-long pages) refuse loudly.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from flexflow_tpu import FFConfig, Model
+from flexflow_tpu.fftype import InferenceMode
+from flexflow_tpu.models.llama import LLAMAConfig, create_llama_model
+from flexflow_tpu.serving import InferenceManager, RequestManager
+
+TINY = dict(vocab_size=128, hidden_size=64, intermediate_size=128,
+            num_hidden_layers=2, num_attention_heads=4,
+            num_key_value_heads=2, max_position_embeddings=512)
+# head_dim 128: every flash shape gate holds, so the interpret-mode
+# kernels actually engage in the A/B below (one layer: the packed
+# append/attend mechanics are identical per layer, and interpret-mode
+# kernel cost scales with layer count)
+WIDE = dict(vocab_size=128, hidden_size=256, intermediate_size=256,
+            num_hidden_layers=1, num_attention_heads=2,
+            num_key_value_heads=2, max_position_embeddings=512)
+
+
+def _build_llama(name, seed=1, mode=InferenceMode.INC_DECODING,
+                 max_requests=2, **over):
+    cfg = LLAMAConfig(**{**TINY, **over})
+    model = Model(FFConfig(seed=seed), name=name)
+    create_llama_model(model, cfg, mode=mode, max_requests=max_requests)
+    return model
+
+
+def _compile(model, kv_cache_dtype=None, cache_dtype=None, max_requests=2,
+             max_seq_length=256, prefill_chunk=128, **kw):
+    im = InferenceManager(model.config)
+    mid = im.compile_model_and_allocate_buffer(
+        model, max_requests=max_requests, max_seq_length=max_seq_length,
+        prefill_chunk=prefill_chunk, kv_cache_dtype=kv_cache_dtype,
+        cache_dtype=cache_dtype, **kw)
+    return im, mid
+
+
+def _greedy(im, mid, prompt, n_new, max_requests=2, max_seq_length=256):
+    rm = RequestManager(max_requests_per_batch=max_requests,
+                        max_tokens_per_batch=128,
+                        max_sequence_length=max_seq_length)
+    req = rm.register_new_request(list(prompt), max_new_tokens=n_new)
+    rm.generate_incr_decoding(im, mid, [req])
+    return list(req.tokens)
+
+
+# ------------------------------------------------------------ packing
+def test_int4_pack_unpack_round_trip():
+    """pack -> unpack is the identity over the whole signed-nibble
+    range, on the sequence axis of a cache-shaped array, and the fused
+    packed dequant equals unpack-then-dequant bit for bit."""
+    from flexflow_tpu.quantization import (dequantize_kv,
+                                           dequantize_kv_packed,
+                                           kv_pack_factor, pack_kv_int4,
+                                           quantize_kv_int4,
+                                           unpack_kv_int4)
+
+    rng = np.random.default_rng(0)
+    codes = jnp.asarray(rng.integers(-8, 8, (3, 2, 64, 16)), jnp.int8)
+    packed = pack_kv_int4(codes)
+    assert packed.shape == (3, 2, 32, 16) and packed.dtype == jnp.int8
+    np.testing.assert_array_equal(np.asarray(unpack_kv_int4(packed)),
+                                  np.asarray(codes))
+
+    # quantizer feeds both paths the same exact integers
+    x = jnp.asarray(rng.standard_normal((3, 2, 64, 16)), jnp.float32)
+    q, scale = quantize_kv_int4(x)
+    assert int(jnp.max(jnp.abs(q))) <= 7
+    ref = dequantize_kv(q, scale, jnp.float32)
+    fused = dequantize_kv_packed(pack_kv_int4(q), scale, jnp.float32)
+    np.testing.assert_array_equal(np.asarray(fused), np.asarray(ref))
+
+    # the pack factor is recoverable from static shapes alone
+    assert kv_pack_factor(packed, scale) == 2
+    assert kv_pack_factor(codes, scale) == 1
+    assert kv_pack_factor(codes, None) == 1
+
+
+def test_int4_record_layout_invariants():
+    """The compiled record's packed layout: kv_pack=2, allocation
+    rounded to 64 logical positions (= 32 carrier sublanes, the packed
+    RMW window), int8 carriers half-width on the sequence axis beside
+    full-length f32 scales, and the 64-token prefill-chunk floor."""
+    model = _build_llama("int4_layout")
+    im, mid = _compile(model, kv_cache_dtype="int4", max_seq_length=250,
+                       prefill_chunk=128)
+    record = im.models[mid]
+    assert record["kv_quantized"] and record["kv_pack"] == 2
+    assert record["alloc_len"] == -(-(250 + 128 + 1) // 64) * 64
+    for kv in record["caches"].values():
+        for part in ("k", "v"):
+            c, s = kv[part], kv[f"{part}_scale"]
+            assert c.dtype == jnp.int8 and c.ndim == 4
+            assert s.dtype == jnp.float32
+            assert c.shape[2] * 2 == s.shape[2] == record["alloc_len"]
+    assert im.min_prefill_chunk(mid) == 64
+    assert im.cache_dtype_key(mid) == "int4"
+
+
+# ------------------------------------------------------------ quality
+def test_int4_flash_jnp_greedy_ab_bit_exact():
+    """Acceptance: the bit-exact greedy A/B on the CPU.  The same int4
+    serve runs twice — the jnp fallback path vs the Pallas kernels in
+    interpret mode (FF_FLASH_DECODE/FF_FLASH_PREFILL=interpret) — and
+    64 decode steps must token-match EXACTLY: both paths quantize
+    through quantize_kv_int4 and write the same carrier bytes, so any
+    packed-RMW, nibble-order or in-kernel-unpack bug diverges here.
+    The kernel-path counter proves the flash arm really took the
+    kernels (no silent fallback making the A/B vacuous)."""
+    from flexflow_tpu.observability import get_registry
+    from flexflow_tpu.utils.quality import quality_report
+
+    prompt = np.random.default_rng(1).integers(4, 120, 16).tolist()
+    n_new = 64
+    reg = get_registry()
+    monkey = pytest.MonkeyPatch()
+    try:
+        monkey.delenv("FF_FLASH_DECODE", raising=False)
+        monkey.delenv("FF_FLASH_PREFILL", raising=False)
+        model_j = _build_llama("int4_ab_jnp", **WIDE)
+        im_j, mid_j = _compile(model_j, kv_cache_dtype="int4")
+        toks_j = _greedy(im_j, mid_j, prompt, n_new)
+
+        monkey.setenv("FF_FLASH_DECODE", "interpret")
+        monkey.setenv("FF_FLASH_PREFILL", "interpret")
+        reg.reset()
+        model_f = _build_llama("int4_ab_flash", **WIDE)
+        im_f, mid_f = _compile(model_f, kv_cache_dtype="int4")
+        toks_f = _greedy(im_f, mid_f, prompt, n_new)
+    finally:
+        monkey.undo()
+
+    assert toks_f == toks_j, (
+        f"int4 flash kernels diverged from the jnp fallback within "
+        f"{n_new} greedy steps (first mismatch at "
+        f"{next(i for i, (a, b) in enumerate(zip(toks_j, toks_f)) if a != b)})")
+    report = quality_report(im_j, mid_j, im_f, mid_f,
+                            prompts=[toks_j],
+                            ref_tokens=[toks_j[len(prompt):]],
+                            q_tokens=[toks_f[len(prompt):]])
+    assert report["greedy_divergence_step"] is None, report
+
+    # the flash arm engaged the kernels: int4-labelled flash dispatches
+    # on both phases, and the record carries the kernel tile note
+    kp = reg.snapshot()["counters"]["serving_kernel_path_total"]
+    labels = kp["labels"] if isinstance(kp, dict) else {}
+    flash = {k: v for k, v in labels.items()
+             if "cache=int4" in k and "path=flash" in k}
+    assert any("phase=decode" in k for k in flash), labels
+    assert any("phase=prefill" in k for k in flash), labels
+    assert im_f.models[mid_f].get("_flash_tile") == 128
+
+
+def test_int4_quality_gate_vs_bf16():
+    """Cross-dtype arm: int4 vs the full-precision cache is a QUALITY
+    gate, not an exactness gate.  4-bit codes (+-7) carry ~1.04x the
+    reference perplexity on the tiny random-weight fixture and CAN flip
+    near-tied argmaxes, so greedy chains legitimately fork; the
+    teacher-forced probe bounds the drift instead (the bench stamps the
+    greedy match fraction as a FLAG for the same reason)."""
+    from flexflow_tpu.utils.quality import quality_report
+
+    prompt = np.random.default_rng(1).integers(4, 120, 16).tolist()
+    n_new = 64
+    model_ref = _build_llama("int4q_ref")
+    im_ref, mid_ref = _compile(model_ref)
+    toks_ref = _greedy(im_ref, mid_ref, prompt, n_new)
+    model_q = _build_llama("int4q_q")
+    im_q, mid_q = _compile(model_q, kv_cache_dtype="int4")
+    toks_q = _greedy(im_q, mid_q, prompt, n_new)
+
+    report = quality_report(im_ref, mid_ref, im_q, mid_q,
+                            prompts=[toks_ref],
+                            ref_tokens=[toks_ref[len(prompt):]],
+                            q_tokens=[toks_q[len(prompt):]])
+    assert report["top1_agreement"] >= 0.75, report
+    assert report["ppl_ratio"] < 1.10, report
+
+
+def test_paged_int4_matches_dense_int4():
+    """The paged pool is a layout change, not a numerics change: paged
+    int4 greedy output is bit-identical to dense int4 (same quantizer,
+    same codes, frames vs slabs)."""
+    prompt = np.random.default_rng(3).integers(4, 120, 20).tolist()
+    model_d = _build_llama("int4_dense", num_hidden_layers=1)
+    im_d, mid_d = _compile(model_d, kv_cache_dtype="int4")
+    model_p = _build_llama("int4_paged", num_hidden_layers=1)
+    im_p, mid_p = _compile(model_p, kv_cache_dtype="int4",
+                           kv_layout="paged", kv_page_len=64)
+    assert _greedy(im_p, mid_p, prompt, 16) == \
+        _greedy(im_d, mid_d, prompt, 16)
+
+
+# ----------------------------------------------------- memory accounting
+def test_kv_cache_stats_hbm_gate_int4():
+    """Acceptance: int4 cache HBM <= 0.35x an explicit bf16 cache at
+    equal (rows, alloc_len) — and strictly below the int8 arm.  Needs a
+    production-shaped head_dim (64 here): the f32 scales cost 4 bytes
+    per head per position regardless of the code width, which only
+    amortizes over a wide head."""
+    shape = dict(hidden_size=128, num_attention_heads=2,
+                 num_key_value_heads=2)
+    model_bf = _build_llama("kvs4_bf", **shape)
+    im_bf, mid_bf = _compile(model_bf, cache_dtype=jnp.bfloat16)
+    model_q8 = _build_llama("kvs4_q8", **shape)
+    im_q8, mid_q8 = _compile(model_q8, kv_cache_dtype="int8")
+    model_q4 = _build_llama("kvs4_q4", **shape)
+    im_q4, mid_q4 = _compile(model_q4, kv_cache_dtype="int4")
+    s_bf = im_bf.kv_cache_stats(mid_bf)
+    s_q8 = im_q8.kv_cache_stats(mid_q8)
+    s_q4 = im_q4.kv_cache_stats(mid_q4)
+    assert s_q4.kv_cache_dtype == "int4"
+    assert s_bf.rows == s_q4.rows
+    ratio = s_q4.bytes_per_token / s_bf.bytes_per_token
+    assert ratio <= 0.35, (ratio, s_q4.snapshot(), s_bf.snapshot())
+    assert s_q4.bytes_per_token < s_q8.bytes_per_token
+    # resident bytes factor exactly as documented
+    assert s_q4.bytes_resident == \
+        s_q4.rows * s_q4.alloc_len * s_q4.bytes_per_token
+    # streamed-bytes estimate: depths sum over active rows
+    est = s_q4.bytes_streamed_step([10, 99], active=[True, False])
+    assert est == 11 * s_q4.bytes_per_token
+
+
+# ------------------------------------------------------- prefix pool
+def test_prefix_pool_dtype_key_int4_vs_int8():
+    """int4 and int8 pool rows are mutually unusable: an int8 code
+    byte reinterpreted as two packed nibbles (or vice versa) is
+    garbage, so the dtype key must miss across the quantized pair, not
+    just quantized-vs-float."""
+    from flexflow_tpu.serving.prefix_cache import PrefixCache
+
+    pc = PrefixCache(max_slots=4)
+    toks = list(range(4, 100))
+    assert pc.insert(toks, 0, {0: (0, 96)}, dtypes={0: "int8"})
+    e, d = pc.match(toks + [3])
+    assert e is not None and d >= 64
+    assert pc.usable(e, 0, d, 97, dtype="int8") == d
+    assert pc.usable(e, 0, d, 97, dtype="int4") == 0
+    toks2 = list(range(5, 101))
+    assert pc.insert(toks2, 1, {0: (1, 96)}, dtypes={0: "int4"})
+    e2, d2 = pc.match(toks2 + [3])
+    assert pc.usable(e2, 0, d2, 97, dtype="int4") == d2
+    assert pc.usable(e2, 0, d2, 97, dtype="int8") == 0
+    assert pc.usable(e2, 0, d2, 97, dtype="bfloat16") == 0
+
+
+# -------------------------------------------------------- migration
+def test_int4_migration_roundtrip_quarter_payload():
+    """Whole-frame migration carries int4 rows bit-exactly (carriers
+    AND scale frames) at ~0.28x the bf16 payload bytes for the same
+    migrated length — the disagg transfer is repriced by the same
+    per-token accounting the HBM gate pins."""
+    from flexflow_tpu.serving.disagg import FrameMigrator, SlicePool
+
+    devs = jax.devices()
+    if len(devs) < 2:
+        pytest.skip("needs two devices")
+    shape = dict(hidden_size=128, num_attention_heads=2,
+                 num_key_value_heads=2, num_hidden_layers=1)
+
+    def serve_and_migrate(kv_cache_dtype, cache_dtype):
+        ims = []
+        for i, dev in enumerate(devs[:2]):
+            cfg = LLAMAConfig(**{**TINY, **shape})
+            m = Model(FFConfig(seed=0, devices=(dev,)),
+                      name=f"mig4_{kv_cache_dtype or 'bf16'}_{i}")
+            create_llama_model(m, cfg, max_requests=4)
+            m.params = m.init_params(jax.random.PRNGKey(0))
+            im = InferenceManager(m.config)
+            mid = im.compile_model_and_allocate_buffer(
+                m, max_requests=4, max_seq_length=256, prefill_chunk=64,
+                kv_cache_dtype=kv_cache_dtype, cache_dtype=cache_dtype)
+            ims.append((im, mid))
+        (im_a, mid_a), (im_b, mid_b) = ims
+        prompt = np.random.default_rng(0).integers(1, 127, 45).tolist()
+        rm = RequestManager(max_requests_per_batch=4,
+                            max_tokens_per_batch=64,
+                            max_sequence_length=256, decode_block=4)
+        rm.generate_incr_decoding(
+            im_a, mid_a,
+            [rm.register_new_request(list(prompt), max_new_tokens=1)])
+        mig = FrameMigrator(SlicePool(im_a, mid_a, label="prefill"),
+                            SlicePool(im_b, mid_b, label="decode"))
+        stats = mig.migrate(guid=7, src_row=0, dst_row=2, length=45)
+        src = im_a.fetch_row(mid_a, 0, 45)
+        dst = im_b.fetch_row(mid_b, 2, 45)
+        for name, parts in src["layers"].items():
+            for part, arr in parts.items():
+                np.testing.assert_array_equal(
+                    np.asarray(arr),
+                    np.asarray(dst["layers"][name][part]),
+                    err_msg=f"{name}/{part}")
+        return stats["bytes"]
+
+    b_q = serve_and_migrate("int4", None)
+    # int4 scale frames ride along: k_scale/v_scale in the transfer
+    b_bf = serve_and_migrate(None, jnp.bfloat16)
+    assert 0 < b_q <= 0.35 * b_bf, (b_q, b_bf)
+
+
+# ------------------------------------------------------- retrace guard
+def test_int4_warmed_decode_loop_pins_zero_compiles():
+    """A warmed int4 decode loop compiles nothing: the packed-scatter
+    RMW, scale updates and fused dequant all live inside the step
+    cache's shape buckets, so quantization adds no retrace hazard."""
+    from flexflow_tpu.serving.batch_config import BatchConfig
+    from flexflow_tpu.utils.debugging import retrace_guard
+
+    model = _build_llama("int4_retrace")
+    im, mid = _compile(model, kv_cache_dtype="int4", max_seq_length=128,
+                       prefill_chunk=64)
+    bc = BatchConfig(2, 1)
+    bc.request_guid[:] = [1, 2]
+    bc.request_available[:] = True
+    bc.first_token_depth[:] = [3, 4]
+    bc.num_tokens_in_batch[:] = 1
+    bc.max_sequence_length[:] = 128
+    bc.token_ids[:, 0] = [5, 7]
+    rng = jax.random.PRNGKey(0)
+
+    with retrace_guard(max_compiles=None) as warm:
+        np.asarray(im.decode_block(mid, bc, 4, rng))
+        im.note_host_sync()
+    if warm.compiles == 0:
+        pytest.skip("this JAX emits no compile monitoring events")
+
+    with retrace_guard() as g:          # raises if compiles > 0
+        np.asarray(im.decode_block(mid, bc, 4, rng))
+        im.note_host_sync()
+    assert g.compiles == 0, g.events
+
+
+# --------------------------------------------------------- refusals
+def test_int4_unwired_corners_refuse():
+    """The corners int4 is NOT wired through refuse at compile time
+    instead of producing garbage: pipeline-stage row-group slicing, and
+    page lengths that would split a carrier's 32-sublane tile."""
+    model = _build_llama("int4_pp")
+    model.config.pipeline_parallelism_degree = 2
+    with pytest.raises(ValueError, match="pipeline stage"):
+        _compile(model, kv_cache_dtype="int4")
+
+    model2 = _build_llama("int4_page32")
+    with pytest.raises(ValueError, match="multiple of 64"):
+        _compile(model2, kv_cache_dtype="int4", kv_layout="paged",
+                 kv_page_len=32)

@@ -230,6 +230,10 @@ SUBLANE = {
     "bfloat16": 16, "bf16": 16, "float16": 16, "f16": 16,
     "int8": 32, "uint8": 32,
     "float8_e4m3fn": 32, "float8_e5m2": 32, "fp8": 32,
+    # sub-byte: int4 KV carriers pack 2 codes/byte along the sequence
+    # axis, so a PACKED tile needs 64 logical positions per 32 carrier
+    # sublanes — blocks declared at jnp.int4 tile (64, 128)
+    "int4": 64, "uint4": 64,
 }
 
 

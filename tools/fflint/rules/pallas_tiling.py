@@ -2,12 +2,15 @@
 
 TPU vector memory is tiled ``(sublane, lane)`` with lane fixed at 128
 and the minimum sublane count set by dtype — f32 tiles are (8, 128),
-bf16 (16, 128), int8/fp8 (32, 128) (see /opt guides; the int8 row is
-the invariant behind the PR-2 bug where the flash append's
-read-modify-write window had to widen from 16 to 32 positions when the
-KV cache went int8: a 16-aligned window slice of an int8 cache is not
-addressable by Mosaic's (32, 128) tiling and the kernel silently fell
-back to the XLA path).
+bf16 (16, 128), int8/fp8 (32, 128), and sub-byte int4 (64, 128) (see
+/opt guides; the int8 row is the invariant behind the PR-2 bug where
+the flash append's read-modify-write window had to widen from 16 to 32
+positions when the KV cache went int8: a 16-aligned window slice of an
+int8 cache is not addressable by Mosaic's (32, 128) tiling and the
+kernel silently fell back to the XLA path.  The int4 row is the same
+invariant doubled: a packed carrier stores 2 codes/byte, so 64 LOGICAL
+positions back one 32-sublane carrier tile — the int4 KV append's RMW
+window, docs/INTERNALS.md "KV cache memory layout & dtype").
 
 The rule constant-folds literal integer assignments per scope (``W =
 32``, ``TS = 2 * W`` …) and then checks every shape it can fully fold:
@@ -76,8 +79,8 @@ def _imports_pallas(tree: ast.AST) -> bool:
 class PallasTilingRule(Rule):
     id = "pallas-tiling"
     short = ("literal Pallas block/scratch shapes must respect the "
-             "dtype sublane table (8/f32, 16/bf16, 32/int8) and grids "
-             "must tile padded shapes exactly")
+             "dtype sublane table (8/f32, 16/bf16, 32/int8, 64/int4) "
+             "and grids must tile padded shapes exactly")
 
     #: page_len spellings the %32 invariant applies to (exact names,
     #: any case — DEFAULT_PAGE_LEN / PAGE_ALIGN-adjacent constants and
@@ -244,7 +247,7 @@ class PallasTilingRule(Rule):
                 f"{what}: sublane (second-to-last) dim {sub} is not a "
                 f"multiple of {min_sub} (minimum sublane tile for "
                 f"{dt}) — Mosaic cannot address the block "
-                f"(int8 needs 32, bf16 16, f32 8)"))
+                f"(int4 needs 64, int8 32, bf16 16, f32 8)"))
         if lane is not None and lane > 1 and lane % LANE:
             findings.append(self.finding(
                 module, shape_node.elts[-1],
@@ -278,7 +281,7 @@ class PallasTilingRule(Rule):
                         f"out BlockSpec sublane dim {sub} is not a "
                         f"multiple of {min_sub}, the minimum sublane "
                         f"tile for the out_shape dtype {out_dtype} "
-                        f"(int8 needs 32, bf16 16, f32 8)"))
+                        f"(int4 needs 64, int8 32, bf16 16, f32 8)"))
         grid = env.fold_shape(kw.get("grid")) if "grid" in kw else None
         if grid is None:
             return

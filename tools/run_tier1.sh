@@ -85,6 +85,18 @@
     "tests/test_hybrid.py::TestHybridParity::test_mixed_from_admission_parity" \
     >/dev/null) \
  || { echo "hybrid-step parity smoke FAILED" >&2; exit 1; }
+# Int4 packed-KV parity smoke (fast tier): the bit-exact greedy A/B
+# between the two int4 serving paths — the jnp fallback and the Pallas
+# kernels in interpret mode — on a flash-shaped tiny model.  Both
+# paths quantize through the same quantize_kv_int4, so ANY packed-RMW,
+# nibble-order or in-kernel-unpack regression shows as token
+# divergence here, in seconds, before the full suite (or a BENCH
+# `kvdtype --kv-dtype int4` round) runs.
+(cd "$(dirname "$0")/.." \
+ && env JAX_PLATFORMS=cpu python -m pytest -q -p no:cacheprovider \
+    "tests/test_kv_cache_int4.py::test_int4_flash_jnp_greedy_ab_bit_exact" \
+    >/dev/null) \
+ || { echo "int4 packed-KV parity smoke FAILED" >&2; exit 1; }
 # Disaggregated-serving smoke: a deterministic two-submesh CPU dryrun
 # (MULTICHIP-harness style — two virtual CPU devices, one per slice):
 # a tiny model served with prefill and decode on SEPARATE devices must
