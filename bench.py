@@ -2177,7 +2177,8 @@ def bench_disagg(model_builder=None, max_requests=4, bystander_prompt=24,
     from flexflow_tpu.models.llama import LLAMAConfig, create_llama_model
     from flexflow_tpu.observability import get_ledger
     from flexflow_tpu.serving import InferenceManager, RequestManager
-    from flexflow_tpu.serving.disagg import FrameMigrator, SlicePool
+    from flexflow_tpu.serving.disagg import (FrameMigrator, SlicePool,
+                                             prefill_sjf_enabled)
 
     if model_builder is None:
         def model_builder(devices=None):
@@ -2305,26 +2306,29 @@ def bench_disagg(model_builder=None, max_requests=4, bystander_prompt=24,
         "prefill_rows": prefill_rows,
         "migrations": dict(mig.migrations),
         "migration_bytes": mig.bytes_total,
-        # A/B stamp for the SJF prefill-slice batcher
-        # (FF_PREFILL_SJF=1 admits shortest-prefill-first instead of
+        # A/B stamp for the SJF prefill-slice batcher (default ON
+        # since PR 17; FF_PREFILL_SJF=0 is the kill switch back to
         # FCFS) — run the mode once per order and diff victim_ttft /
         # tpot_p99 between the stamped rows
-        "prefill_sjf": os.environ.get("FF_PREFILL_SJF", "0") == "1",
+        "prefill_sjf": prefill_sjf_enabled(),
     }
     extras = [
         {"metric": "disagg_bystander_tpot_p50",
          "value": round(dis["tpot_p50_s"] * 1e3, 2), "unit": "ms",
          "separate_ms": round(sep["tpot_p50_s"] * 1e3, 2),
          "hybrid_ms": round(hyb["tpot_p50_s"] * 1e3, 2),
+         "prefill_sjf": prefill_sjf_enabled(),
          "vs_baseline": 0},
         {"metric": "disagg_victim_ttft",
          "value": round(dis["victim_ttft_s"], 4), "unit": "s",
          "separate_s": round(sep["victim_ttft_s"], 4),
          "hybrid_s": round(hyb["victim_ttft_s"], 4),
+         "prefill_sjf": prefill_sjf_enabled(),
          "vs_baseline": 0},
         {"metric": "disagg_migration_span",
          "value": float(len(migrate_events)), "unit": "x",
          "vs_baseline": 0,
+         "prefill_sjf": prefill_sjf_enabled(),
          "events": migrate_events},
     ]
     return (head, *extras)
@@ -2891,6 +2895,230 @@ def bench_net(n_requests=24, max_requests=4, out_len=24,
     return (head, *extras)
 
 
+def bench_fleetkv(n_tenants=3, reqs_per_tenant=3, prefix_len=208,
+                  tail_len=16, out_len=16, max_requests=4,
+                  decode_block=8, kill_test=True):
+    """Fleet KV economy bench (docs/SERVING.md "Fleet KV economy"):
+    router-directed cross-replica prefix-frame migration measured
+    against the recompute alternative.
+
+    Three paged+prefix-cache CPU replica processes with identical
+    seeds: donor **A** serves each tenant's first request cold (the
+    retire donates the prefix frames into A's pool and A starts
+    advertising the digest in ``/v1/stats``); migrate arm **B**
+    receives each tenant prefix over the wire
+    (``router.migrate_prefix`` with the pricing pinned to "migrate" —
+    the toy CPU model recomputes faster than any wire, so "auto"
+    would correctly refuse; the pin isolates the transfer mechanics)
+    before serving the tenant's traffic; recompute arm **C** serves
+    the identical traffic fully cold.  Every request's greedy tokens
+    must match across arms (parity is recorded, not assumed), and the
+    first request of each tenant — the one migration warms — carries
+    the TTFT differential: on B it prefills only the unmatched tail
+    past the imported frames, on C the whole prompt.
+
+    Headline = mean cold first-request TTFT / mean warm
+    first-request TTFT (>1 means migration beats recompute).  Extras
+    carry per-arm goodput, migration decision counters, wire bytes,
+    and (``kill_test``) a donor-death round: a fourth replica D warms
+    a fresh tenant, is SIGKILLed, and the migration attempt must
+    return "failed" with B's free-frame count untouched while the
+    request still completes on B via recompute with byte parity
+    against D's pre-kill answer."""
+    import asyncio
+
+    from flexflow_tpu.observability import get_registry
+    from flexflow_tpu.serve.net.client import NetClient
+    from flexflow_tpu.serve.net.router import ReplicaRouter, spawn_replica
+
+    rng = np.random.default_rng(11)
+    tenants = []
+    for _ in range(n_tenants):
+        prefix = rng.integers(4, 120, prefix_len).tolist()
+        tails = [rng.integers(4, 120, tail_len).tolist()
+                 for _ in range(reqs_per_tenant)]
+        tenants.append([prefix + t for t in tails])
+    # disjoint token range so the warm-up donation can never match a
+    # tenant prefix — it exists purely to pay JIT compile up front
+    warm_prompt = rng.integers(120, 127, prefix_len + tail_len).tolist()
+
+    async def _timed_serve(cl, prompt):
+        t0 = time.monotonic()
+        ws = await cl.generate(prompt, max_new_tokens=out_len)
+        toks, ttft = [], None
+        async for tok in ws:
+            if ttft is None:
+                ttft = time.monotonic() - t0
+            toks.append(tok)
+        return toks, ttft
+
+    async def _serve_arm(url, warm=True):
+        """All tenant traffic, sequentially, on one replica."""
+        cl = NetClient(url)
+        if warm:
+            await (await cl.generate(
+                warm_prompt, max_new_tokens=out_len)).result()
+        toks, ttfts, first_ttfts = [], [], []
+        t0 = time.monotonic()
+        for reqs in tenants:
+            for i, p in enumerate(reqs):
+                t, ttft = await _timed_serve(cl, p)
+                toks.append(t)
+                ttfts.append(ttft)
+                if i == 0:
+                    first_ttfts.append(ttft)
+        wall = time.monotonic() - t0
+        n_tok = sum(len(t) for t in toks)
+        return {"tokens": toks, "ttfts": ttfts,
+                "first_ttfts": first_ttfts, "wall_s": wall,
+                "tokens_per_s": n_tok / max(1e-9, wall)}
+
+    def _migration_counts():
+        snap = get_registry().snapshot()
+        v = (snap.get("counters") or {}).get(
+            "router_prefix_migrations_total", {})
+        return dict(v.get("labels", {})) if isinstance(v, dict) else {}
+
+    async def _warm_donor(url):
+        """Serve each tenant's first request cold on the donor (this
+        donates the prefix into its pool) and return the answers —
+        the parity reference for the migrate arm's first requests."""
+        cl = NetClient(url)
+        refs = []
+        for reqs in tenants:
+            refs.append(await (await cl.generate(
+                reqs[0], max_new_tokens=out_len)).result())
+        deadline = time.monotonic() + 15.0
+        while time.monotonic() < deadline:
+            kv = (await cl.stats()).get("kv") or {}
+            if len(kv.get("digests") or ()) >= n_tenants:
+                break
+            await asyncio.sleep(0.05)
+        return refs
+
+    async def _migrate_all(a_url, b_url):
+        """Push every tenant prefix A -> B through the router policy
+        path; returns the per-tenant decisions and wire bytes."""
+        router = ReplicaRouter([a_url, b_url], scrape_interval_s=30.0,
+                               kv_migration=True, migrate_mode="migrate")
+        async with router:
+            await router.scrape_once()
+            target = router.replicas[1]
+            decisions = []
+            for reqs in tenants:
+                decisions.append(await router.migrate_prefix(
+                    reqs[0], target))
+        return decisions
+
+    async def _kill_round(b_url):
+        """Donor dies before the transfer: migration must fail closed
+        (no leaked frames on B) and the request recomputes on B."""
+        d = spawn_replica(rows=max_requests, decode_block=decode_block,
+                          seed=0, prefix_cache=True, paged=True)
+        try:
+            kill_prompt = rng.integers(4, 120,
+                                       prefix_len + tail_len).tolist()
+            cl_d = NetClient(d.url)
+            ref = await (await cl_d.generate(
+                kill_prompt, max_new_tokens=out_len)).result()
+            deadline = time.monotonic() + 15.0
+            while time.monotonic() < deadline:
+                kv = (await cl_d.stats()).get("kv") or {}
+                if kv.get("digests"):
+                    break
+                await asyncio.sleep(0.05)
+            router = ReplicaRouter([d.url, b_url],
+                                   scrape_interval_s=30.0,
+                                   kv_migration=True,
+                                   migrate_mode="migrate")
+            async with router:
+                await router.scrape_once()
+                cl_b = NetClient(b_url)
+                frames_before = (await cl_b.metrics_values()).get(
+                    "serving_kv_frames_free")
+                d.kill()
+                decision = await router.migrate_prefix(
+                    kill_prompt, router.replicas[1])
+                frames_after = (await cl_b.metrics_values()).get(
+                    "serving_kv_frames_free")
+                got = await (await cl_b.generate(
+                    kill_prompt, max_new_tokens=out_len)).result()
+            return {"decision": decision, "parity": got == ref,
+                    "frames_before": frames_before,
+                    "frames_after": frames_after,
+                    "frames_at_baseline": frames_before == frames_after}
+        finally:
+            d.close()
+
+    reps = [spawn_replica(rows=max_requests, decode_block=decode_block,
+                          seed=0, prefix_cache=True, paged=True)
+            for _ in range(3)]
+    a, b, c = reps
+    try:
+        refs = asyncio.run(_warm_donor(a.url))
+        mig_before = _migration_counts()
+        decisions = asyncio.run(_migrate_all(a.url, b.url))
+        mig_counts = {k: v - mig_before.get(k, 0)
+                      for k, v in _migration_counts().items()}
+        wire_bytes = asyncio.run(
+            NetClient(b.url).metrics_values()).get(
+                "serving_kv_wire_import_bytes_total", 0.0)
+        warm_arm = asyncio.run(_serve_arm(b.url))
+        cold_arm = asyncio.run(_serve_arm(c.url))
+        kill_rec = asyncio.run(_kill_round(b.url)) if kill_test else None
+    finally:
+        for r in reps:
+            r.close()
+
+    parity = (warm_arm["tokens"] == cold_arm["tokens"]
+              and all(warm_arm["tokens"][i * reqs_per_tenant] == refs[i]
+                      for i in range(n_tenants)))
+    warm_first = float(np.mean(warm_arm["first_ttfts"]))
+    cold_first = float(np.mean(cold_arm["first_ttfts"]))
+    speedup = cold_first / max(1e-9, warm_first)
+    head = {
+        "metric": "fleetkv_warm_ttft_speedup",
+        "value": round(speedup, 2),
+        "unit": "x",
+        "vs_baseline": 0,
+        "methodology": (
+            f"{n_tenants} tenants x {reqs_per_tenant} reqs, "
+            f"prefix{prefix_len}+tail{tail_len} out{out_len}, paged "
+            f"CPU replica procs (identical seeds): mean first-request "
+            f"TTFT cold-on-C / migrated-warm-on-B; migration pinned "
+            f"(toy model recomputes faster than any wire, so auto "
+            f"correctly refuses on CPU)"),
+        "warm_first_ttft_ms": round(1e3 * warm_first, 1),
+        "cold_first_ttft_ms": round(1e3 * cold_first, 1),
+        "greedy_parity": parity,
+        "migrate_decisions": decisions,
+    }
+    extras = [{
+        "metric": "fleetkv_arm_goodput",
+        "value": round(warm_arm["tokens_per_s"], 1),
+        "unit": "tokens/s (migrate arm)",
+        "vs_baseline": 0,
+        "recompute_arm_tokens_per_s": round(
+            cold_arm["tokens_per_s"], 1),
+        "wire_import_bytes": int(wire_bytes),
+        "migration_counters": mig_counts,
+    }]
+    if kill_rec is not None:
+        extras.append({
+            "metric": "fleetkv_donor_kill_fallback",
+            "value": 1.0 if (kill_rec["decision"] == "failed"
+                             and kill_rec["parity"]
+                             and kill_rec["frames_at_baseline"])
+            else 0.0,
+            "unit": "bool (donor SIGKILLed pre-transfer: migration "
+                    "failed closed, request recomputed with parity, "
+                    "importer frames at baseline)",
+            "vs_baseline": 0,
+            **kill_rec,
+        })
+    return (head, *extras)
+
+
 def bench_mnist_mlp():
     from flexflow_tpu import FFConfig, LossType, Model, SGDOptimizer
     from flexflow_tpu.fftype import ActiMode
@@ -3143,12 +3371,16 @@ def main(which: str, budget=None):
         head, *extras = bench_net()
         head["extras"] = extras
         return head
+    if which == "fleetkv":
+        head, *extras = bench_fleetkv()
+        head["extras"] = extras
+        return head
     if which != "all":
         raise SystemExit(
             f"unknown bench mode {which!r} (expected all|llama|llama7b|"
             f"spec|spec7b|mnist|kernels|opt|resnet|longctx|quality|"
             f"distill|crossover|prefix|kvdtype|mixed|disagg|paged|live|"
-            f"net)")
+            f"net|fleetkv)")
 
     # all: headline decode metric + everything else under extras.  Each
     # section runs in its own process lifetime-wise (HBM frees between
@@ -3237,6 +3469,7 @@ def main(which: str, budget=None):
                       + _section(bench_paged, "paged")
                       + _section(bench_live, "live")
                       + _section(bench_net, "net")
+                      + _section(bench_fleetkv, "fleetkv")
                       + _section(bench_kernels, "kernels"))
     if timed_out or skipped:
         head["timed_out"] = {"budget_s": budget, "sections": timed_out,

@@ -534,6 +534,33 @@ METRICS_SCHEMA = {
                 "pp_dispatches odometer so scheduling regressions are "
                 "visible in the snapshot.",
     },
+    # ---------------------------------------------------- fleet KV economy
+    "serving_kv_wire_export_bytes_total": {
+        "type": "counter",
+        "help": "KV bundle bytes serialized out of this replica's "
+                "prefix pool through /v1/kv/export (magic + header + "
+                "frames + scale frames) — the donor half of the "
+                "router-directed cross-replica prefix migration.",
+    },
+    "serving_kv_wire_import_bytes_total": {
+        "type": "counter",
+        "help": "KV bundle bytes accepted into this replica's prefix "
+                "pool through /v1/kv/import (counted only when the "
+                "adoption commits — a rejected or failed import counts "
+                "zero, matching the lease-release double-spend "
+                "contract).",
+    },
+    "router_prefix_migrations_total": {
+        "type": "counter",
+        "help": "Router-directed cross-replica prefix migrations, "
+                "labeled decision=migrate|recompute|failed: migrate = "
+                "the bundle was priced cheaper than re-prefill "
+                "(RecoveryPolicy.choose_wire over the calibrated wire "
+                "bandwidth) and the export->import relay committed; "
+                "recompute = pricing chose local re-prefill; failed = "
+                "the relay died mid-transfer and routing fell back to "
+                "recompute.",
+    },
 }
 
 # The step-event vocabulary: every name the StepTracer (spans/instants)
@@ -698,6 +725,33 @@ EVENT_SCHEMA = {
         "help": "Circuit breaker opened on a replica after a "
                 "transport failure (replica, cooldown_s); routing "
                 "excludes it until the cooldown expires.",
+    },
+    "router-migrate": {
+        "help": "The router priced and (maybe) relayed a cross-replica "
+                "prefix migration before routing (guid, donor, target, "
+                "digest, decision=migrate|recompute|failed, bytes, "
+                "seconds): the fleet-KV-economy decision trail — "
+                "export from the donor, wire relay, import into the "
+                "target, then the normal route.  tools/ffreq.py "
+                "renders the export -> wire -> import -> admit span "
+                "from it.",
+    },
+    "kv-export": {
+        "help": "This replica serialized a pooled prefix into a wire "
+                "bundle for a peer (tokens = exported span, bytes, "
+                "seconds, digest).  Donor-side, read-only: nothing is "
+                "released; lands on a synthetic donor timeline stamped "
+                "with the migration's trace_id so fftrace grafts the "
+                "donor hop into the traced request.",
+    },
+    "kv-import": {
+        "help": "This replica adopted a peer's exported prefix bundle "
+                "(tokens = imported span, bytes, seconds, digest, "
+                "resident = landed in a leased batch slot vs a "
+                "slot-less host entry).  The import either fully "
+                "commits (lease + restore + pool insert) or fully "
+                "releases — frame counts return to baseline on any "
+                "failure.",
     },
     "trace-adopt": {
         "help": "A request adopted a distributed trace context (guid, "

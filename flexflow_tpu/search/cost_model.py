@@ -55,7 +55,8 @@ class MachineModel:
                  hbm_bandwidth: float, ici_bandwidth: float,
                  ici_latency: float, dcn_bandwidth: float,
                  devices_per_host: int = 0, hbm_per_device: int = 0,
-                 device_link_bandwidth: Optional[float] = None):
+                 device_link_bandwidth: Optional[float] = None,
+                 wire_bandwidth: Optional[float] = None):
         self.num_devices = num_devices
         self.peak_flops = peak_flops
         self.hbm_bandwidth = hbm_bandwidth
@@ -71,6 +72,12 @@ class MachineModel:
         # spill/restore path crosses.
         self.device_link_bandwidth = float(device_link_bandwidth
                                            or ici_bandwidth)
+        # cross-replica wire link (router-directed prefix-frame
+        # migration over /v1/kv/export+import): a KV bundle crosses
+        # process boundaries over the datacenter network, so it
+        # defaults to the DCN figure — distinct from the device link,
+        # which never leaves the host.
+        self.wire_bandwidth = float(wire_bandwidth or dcn_bandwidth)
 
     # -------------------------------------------------------- collectives
     def _link_bw(self, group: int) -> float:
@@ -119,6 +126,16 @@ class MachineModel:
             return 0.0
         return bytes_ / self.device_link_bandwidth + self.ici_latency
 
+    def wire_migrate_time(self, bytes_: int) -> float:
+        """One cross-replica KV bundle over the datacenter wire (the
+        router-directed ``/v1/kv/export`` -> ``/v1/kv/import`` path):
+        the bytes cross the network once plus a device hop on each
+        end, so one DCN crossing + two link latencies is the model —
+        what the router's migrate-vs-recompute pricing uses."""
+        if bytes_ <= 0:
+            return 0.0
+        return bytes_ / self.wire_bandwidth + 2.0 * self.ici_latency
+
     # ------------------------------------------------- calibrated profiles
     @classmethod
     def from_json(cls, source,
@@ -151,6 +168,8 @@ class MachineModel:
             hbm_per_device=int(float(kv.get("hbm_gb", 16)) * 1024**3),
             device_link_bandwidth=(float(kv["device_link_gbps"]) * 1e9
                                    if "device_link_gbps" in kv else None),
+            wire_bandwidth=(float(kv["wire_gbps"]) * 1e9
+                            if "wire_gbps" in kv else None),
         )
 
 

@@ -35,11 +35,13 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(
     os.path.dirname(os.path.abspath(__file__))))))
 
 
-def _build_engine(rows: int, decode_block: int, seed: int):
+def _build_engine(rows: int, decode_block: int, seed: int,
+                  prefix_cache: bool = False, paged: bool = False):
     from tools.ffload import build_tiny_engine
 
     return build_tiny_engine(max_requests=rows,
-                             decode_block=decode_block, seed=seed)
+                             decode_block=decode_block, seed=seed,
+                             prefix_cache=prefix_cache, paged=paged)
 
 
 # --------------------------------------------------------------- replica
@@ -48,7 +50,9 @@ def replica_main(args) -> int:
     from flexflow_tpu.serve.frontend import AsyncServeFrontend, ShedPolicy
     from flexflow_tpu.serve.net.server import ServeNetServer
 
-    im, mid, rm = _build_engine(args.rows, args.decode_block, args.seed)
+    im, mid, rm = _build_engine(args.rows, args.decode_block, args.seed,
+                                prefix_cache=args.prefix_cache,
+                                paged=args.paged)
     if get_ledger().slo_policy() is None:
         # a policy must be installed for the goodput gauge the router
         # scores on; generous CPU-feasible targets
@@ -204,6 +208,86 @@ def selftest() -> int:
     return 0 if ok else 1
 
 
+# ---------------------------------------------------- fleet-KV smoke
+def selftest_fleetkv() -> int:
+    """run_tier1.sh fleet-KV loopback smoke (deterministic, 2 spawned
+    CPU replicas): serve a prompt cold on replica A (the retire
+    donates its prefix into A's pool), wait for A to advertise the
+    prefix digest in ``/v1/stats``, export the frames over
+    ``/v1/kv/export``, import the bundle into replica B over
+    ``/v1/kv/import``, then serve the SAME prompt on B — B must score
+    a prefix-pool match (``serving_prefix_hits_total`` > 0, zero
+    before) and stream byte-identical greedy tokens to A's cold
+    answer."""
+    import numpy as np
+
+    from flexflow_tpu.serve.net.client import NetClient
+    from flexflow_tpu.serve.net.router import spawn_replica
+
+    ok = True
+
+    def check(cond, msg):
+        nonlocal ok
+        if not cond:
+            ok = False
+            print(f"serve.net fleetkv selftest FAILED: {msg}")
+
+    rng = np.random.default_rng(7)
+    prompt = rng.integers(4, 120, 48).tolist()
+    reps = [spawn_replica(rows=2, decode_block=4, seed=0,
+                          prefix_cache=True) for _ in range(2)]
+    try:
+        async def run() -> None:
+            a = NetClient(reps[0].url)
+            b = NetClient(reps[1].url)
+            # cold reference on A — the same serve warms A's pool
+            ref = await (await a.generate(prompt,
+                                          max_new_tokens=12)).result()
+            check(len(ref) == 12, f"cold serve short: {len(ref)}")
+            deadline = time.monotonic() + 10.0
+            digests: List[str] = []
+            while time.monotonic() < deadline and not digests:
+                kv = (await a.stats()).get("kv") or {}
+                digests = list(kv.get("digests") or ())
+                if not digests:
+                    await asyncio.sleep(0.05)
+            check(digests, "donor never advertised a prefix digest")
+            before = await b.metrics_values()
+            check(before.get("serving_prefix_hits_total", 0.0) == 0.0,
+                  "importer pool warm before import (bad baseline)")
+            bundle = await a.kv_export(prompt)
+            check(bundle is not None, "kv_export found no usable match")
+            res = await b.kv_import(bundle)
+            check(res.get("imported"),
+                  f"kv_import did not adopt the bundle: {res}")
+            got = await (await b.generate(prompt,
+                                          max_new_tokens=12)).result()
+            check(got == ref,
+                  f"imported-prefix serve not byte-identical: "
+                  f"{got} vs {ref}")
+            vals = await b.metrics_values()
+            check(vals.get("serving_prefix_hits_total", 0.0) > 0,
+                  "importer served without a prefix-pool match")
+            check(vals.get("serving_kv_wire_import_bytes_total", 0.0)
+                  >= len(bundle),
+                  "import byte counter did not account the bundle")
+            avals = await a.metrics_values()
+            check(avals.get("serving_kv_wire_export_bytes_total", 0.0)
+                  >= len(bundle),
+                  "export byte counter did not account the bundle")
+
+        asyncio.run(run())
+    finally:
+        for r in reps:
+            r.close()
+
+    if ok:
+        print("serve.net fleetkv selftest OK (cross-replica export/"
+              "import, prefix match on importer, byte-identical "
+              "greedy tokens)")
+    return 0 if ok else 1
+
+
 # ------------------------------------------------------------------ CLI
 def main(argv) -> int:
     ap = argparse.ArgumentParser(
@@ -213,15 +297,26 @@ def main(argv) -> int:
                     help="run one replica wire server over a tiny CPU "
                          "engine until SIGTERM")
     ap.add_argument("--selftest", action="store_true")
+    ap.add_argument("--selftest-fleetkv", action="store_true",
+                    help="2-process cross-replica KV export/import "
+                         "smoke (run_tier1.sh)")
     ap.add_argument("--host", default="127.0.0.1")
     ap.add_argument("--port", type=int, default=0)
     ap.add_argument("--rows", type=int, default=2)
     ap.add_argument("--decode-block", type=int, default=4)
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--max-pending", type=int, default=64)
+    ap.add_argument("--prefix-cache", action="store_true",
+                    help="replica: enable the prefix pool (fleet-KV "
+                         "donors/importers need it)")
+    ap.add_argument("--paged", action="store_true",
+                    help="replica: physical paged KV + frame-backed "
+                         "pager instead of dense rows")
     args = ap.parse_args(argv)
     if args.selftest:
         return selftest()
+    if args.selftest_fleetkv:
+        return selftest_fleetkv()
     if args.replica:
         return replica_main(args)
     ap.print_help()

@@ -201,9 +201,12 @@ class NetClient:
                 f"{self.base_url}: {e!r}") from e
 
     async def request(self, method: str, path: str, body: bytes = b"",
-                      headers: Optional[Dict[str, str]] = None
+                      headers: Optional[Dict[str, str]] = None,
+                      limit: Optional[int] = None
                       ) -> Tuple[int, Dict[str, str], bytes]:
-        """One non-streaming round trip -> (status, headers, body)."""
+        """One non-streaming round trip -> (status, headers, body).
+        ``limit`` overrides the response-body cap (KV bundles carry
+        whole cache frames, far past the prompt-sized default)."""
         reader, writer = await self._connect()
         try:
             writer.write(_request_bytes(method, path, self.host, body,
@@ -212,7 +215,8 @@ class NetClient:
             start, hdrs = await wire.read_http_head(reader)
             status = int(start.split()[1])
             if "content-length" in hdrs:
-                payload = await wire.read_http_body(reader, hdrs)
+                payload = await wire.read_http_body(
+                    reader, hdrs, limit=limit or wire._MAX_BODY)
             else:                   # Connection: close framing
                 payload = await reader.read(-1)
             return status, hdrs, payload
@@ -276,6 +280,51 @@ class NetClient:
         except NetError:
             return False
         return status == 200 and bool(obj.get("ok"))
+
+    # ------------------------------------------------- fleet KV economy
+    async def kv_export(self, tokens: List[int],
+                        trace: Optional[TraceContext] = None
+                        ) -> Optional[bytes]:
+        """Ask the peer to serialize its longest pooled prefix of
+        ``tokens`` into a wire bundle.  Returns the raw bundle bytes
+        (relay them to :meth:`kv_import` opaquely — no numpy decode on
+        the relaying hop), or None when the peer holds no usable match
+        (404).  Transport failures raise :class:`ReplicaUnavailable`;
+        engine-side errors raise :class:`ProtocolError`."""
+        import json as _json
+
+        headers = ({wire.H_TRACE: trace.header_value()}
+                   if trace is not None else None)
+        status, _, payload = await self.request(
+            "POST", wire.P_KV_EXPORT,
+            _json.dumps({"tokens": [int(t) for t in tokens]}).encode(),
+            headers=headers, limit=wire._MAX_KV_BODY)
+        if status == 200:
+            return payload
+        if status == 404:
+            return None
+        self._raise_for_status(status, payload)
+
+    async def kv_import(self, bundle: bytes,
+                        trace: Optional[TraceContext] = None
+                        ) -> Dict[str, Any]:
+        """Push an exported bundle into the peer's prefix pool.
+        Returns the peer's adoption report (``imported``/``resident``/
+        ``span``/``reason``) — ``imported: False`` means the caller
+        falls back to recompute, it is not a transport error."""
+        import json as _json
+
+        headers = {"Content-Type": "application/octet-stream"}
+        if trace is not None:
+            headers[wire.H_TRACE] = trace.header_value()
+        status, _, payload = await self.request(
+            "POST", wire.P_KV_IMPORT, bundle, headers=headers)
+        if status != 200:
+            self._raise_for_status(status, payload)
+        try:
+            return _json.loads(payload.decode() or "{}")
+        except ValueError:
+            return {"imported": False, "reason": "bad-reply"}
 
     async def generate(self, prompt: Union[List[int], str],
                        max_new_tokens: int = 128,

@@ -103,6 +103,8 @@ P_STATS = "/v1/stats"
 P_TIMELINES = "/v1/timelines"
 P_HISTORY = "/v1/metrics/history"
 P_METRICS = "/metrics"
+P_KV_EXPORT = "/v1/kv/export"
+P_KV_IMPORT = "/v1/kv/import"
 
 #: deadline propagation header: REMAINING budget (seconds, float).
 #: Overrides the body's deadline_s; a router forwards the remaining
@@ -116,6 +118,9 @@ H_TRACE = "x-ffserve-trace"
 
 _MAX_BODY = 8 << 20          # 8 MiB: longest token-id prompt we accept
 _MAX_HEAD = 64 << 10         # request/response head size cap
+#: KV bundles carry whole cache frames, so the /v1/kv/import body cap
+#: is its own (much larger) knob — the generate path keeps _MAX_BODY.
+_MAX_KV_BODY = 256 << 20
 
 
 class ProtocolError(Exception):
@@ -365,19 +370,137 @@ async def read_http_head(reader) -> Tuple[str, Dict[str, str]]:
     return start, headers
 
 
-async def read_http_body(reader, headers: Dict[str, str]) -> bytes:
+async def read_http_body(reader, headers: Dict[str, str],
+                         limit: int = _MAX_BODY) -> bytes:
     """Read a Content-Length body (the only framing we accept on
-    requests — no chunked uploads)."""
+    requests — no chunked uploads).  ``limit`` defaults to the JSON
+    body cap; the KV-import path passes :data:`_MAX_KV_BODY`."""
     try:
         n = int(headers.get("content-length", "0"))
     except ValueError:
         raise ProtocolError(400, "bad_request", "bad Content-Length")
-    if n < 0 or n > _MAX_BODY:
+    if n < 0 or n > limit:
         raise ProtocolError(400, "bad_request",
                             f"Content-Length {n} out of range")
     if n == 0:
         return b""
     return await reader.readexactly(n)
+
+
+# ------------------------------------------------ fleet KV wire bundle
+#: version stamp inside every KV bundle — bumped whenever the header
+#: schema or the array framing changes; import rejects a mismatch so a
+#: mixed-version fleet degrades to recompute instead of corrupting a
+#: pager.
+KV_WIRE_VERSION = 1
+_KV_MAGIC = b"FFKV"
+
+#: fixed token-prefix length the fleet's KV digests hash over — shared
+#: by the replica-side prefix-pool advertisement (/v1/stats "kv" block)
+#: and the router's migration lookup, independent of the router's own
+#: (configurable) affinity_prefix_len, so the two always agree.  The
+#: canonical implementation lives beside the pool it indexes
+#: (serving/prefix_cache.py); this module re-exports it as wire
+#: vocabulary.
+from ...serving.prefix_cache import (PREFIX_DIGEST_HEAD,  # noqa: E402
+                                     prefix_digest)
+
+
+def encode_kv_bundle(tokens: List[int], span: int,
+                     models: Dict[str, Dict[str, Any]]) -> bytes:
+    """Serialize one prefix-pool entry into a self-describing binary
+    bundle: ``FFKV`` magic + version + JSON header + concatenated raw
+    array bytes.
+
+    ``models`` maps model-key (stringified model id) to
+    ``{"layout": <kv_layout_descriptor dict>, "payload": <fetch_row
+    payload>}`` where the payload's ``layers`` hold numpy arrays; the
+    arrays are manifest-indexed (dtype/shape/offset) so decode needs
+    no pickling — the wire stays arbitrary-code-free."""
+    import numpy as np
+
+    blobs: List[bytes] = []
+    offset = 0
+    header_models: Dict[str, Any] = {}
+    for key, spec in models.items():
+        payload = spec["payload"]
+        manifest: List[Dict[str, Any]] = []
+        for lname, parts in payload["layers"].items():
+            for part, arr in parts.items():
+                arr = np.ascontiguousarray(arr)
+                raw = arr.tobytes()
+                manifest.append({"layer": lname, "part": part,
+                                 "dtype": arr.dtype.str,
+                                 "shape": list(arr.shape),
+                                 "offset": offset,
+                                 "nbytes": len(raw)})
+                blobs.append(raw)
+                offset += len(raw)
+        meta = {k: v for k, v in payload.items() if k != "layers"}
+        header_models[str(key)] = {"layout": spec["layout"],
+                                   "meta": meta, "arrays": manifest}
+    header = json.dumps({"version": KV_WIRE_VERSION,
+                         "tokens": [int(t) for t in tokens],
+                         "span": int(span),
+                         "models": header_models}).encode()
+    head = (_KV_MAGIC + KV_WIRE_VERSION.to_bytes(4, "big")
+            + len(header).to_bytes(4, "big"))
+    return head + header + b"".join(blobs)
+
+
+def decode_kv_bundle(data: bytes) -> Dict[str, Any]:
+    """Inverse of :func:`encode_kv_bundle`.  Returns ``{"tokens",
+    "span", "models": {key: {"layout", "payload"}}}`` with numpy
+    arrays reconstructed (contiguous copies — the buffer is released).
+    Raises :class:`ProtocolError` (400) on a malformed bundle or a
+    version mismatch."""
+    import numpy as np
+
+    if len(data) < 12 or data[:4] != _KV_MAGIC:
+        raise ProtocolError(400, "bad_request", "not a KV bundle")
+    ver = int.from_bytes(data[4:8], "big")
+    if ver != KV_WIRE_VERSION:
+        raise ProtocolError(
+            400, "kv_wire_version",
+            f"peer sent KV bundle v{ver}, this server speaks "
+            f"v{KV_WIRE_VERSION}")
+    hlen = int.from_bytes(data[8:12], "big")
+    if hlen < 2 or 12 + hlen > len(data):
+        raise ProtocolError(400, "bad_request", "truncated KV header")
+    try:
+        header = json.loads(data[12:12 + hlen].decode("utf-8"))
+    except (ValueError, UnicodeDecodeError) as e:
+        raise ProtocolError(400, "bad_request",
+                            f"KV header is not JSON: {e}")
+    if header.get("version") != KV_WIRE_VERSION:
+        raise ProtocolError(400, "kv_wire_version",
+                            "header/frame version mismatch")
+    body = memoryview(data)[12 + hlen:]
+    models: Dict[str, Any] = {}
+    for key, spec in (header.get("models") or {}).items():
+        layers: Dict[str, Dict[str, Any]] = {}
+        for ent in spec.get("arrays", []):
+            off, nb = int(ent["offset"]), int(ent["nbytes"])
+            if off < 0 or off + nb > len(body):
+                raise ProtocolError(400, "bad_request",
+                                    "array extent outside bundle")
+            arr = np.frombuffer(body[off:off + nb],
+                                dtype=np.dtype(ent["dtype"]))
+            arr = arr.reshape([int(s) for s in ent["shape"]]).copy()
+            layers.setdefault(ent["layer"], {})[ent["part"]] = arr
+        payload = dict(spec.get("meta") or {})
+        payload["layers"] = layers
+        models[str(key)] = {"layout": spec.get("layout") or {},
+                            "payload": payload}
+    try:
+        tokens = [int(t) for t in header["tokens"]]
+        span = int(header["span"])
+    except (KeyError, TypeError, ValueError):
+        raise ProtocolError(400, "bad_request", "bad KV header fields")
+    if span < 1 or span > len(tokens):
+        raise ProtocolError(400, "bad_request",
+                            f"span {span} outside tokens")
+    return {"tokens": tokens, "span": span, "models": models}
 
 
 # ------------------------------------------------- prometheus scraping

@@ -88,6 +88,13 @@ class ReplicaHandle:
     scrape_ok: bool = False
     score: float = 0.0
     circuit_open_until: float = 0.0
+    #: fleet-KV advertisement from /v1/stats "kv": the bounded prefix
+    #: digest list this replica's pool holds, plus the pricing terms
+    #: (bytes_per_token, recompute roofline inputs) the router's
+    #: migrate-vs-recompute decision needs
+    digests: Set[str] = dataclasses.field(default_factory=set)
+    kv_pricing: Dict[str, float] = dataclasses.field(
+        default_factory=dict)
     #: retained time-series of this replica's scrapes: every load-score
     #: decision is explainable/replayable from the history the router
     #: kept, not just the instantaneous scrape (RouterServer serves it
@@ -133,7 +140,10 @@ class ReplicaRouter:
                  circuit_cooldown_s: float = 2.0,
                  max_failovers: int = 3,
                  w_goodput: float = 1.0, w_frames: float = 0.5,
-                 w_load: float = 1.0):
+                 w_load: float = 1.0,
+                 kv_migration: bool = True,
+                 migrate_timeout_s: float = 10.0,
+                 migrate_mode: str = "auto"):
         if not replica_urls:
             raise ValueError("router needs at least one replica url")
         self.replicas: List[ReplicaHandle] = [
@@ -148,6 +158,15 @@ class ReplicaRouter:
         self.max_failovers = int(max_failovers)
         self.w_goodput, self.w_frames, self.w_load = (
             float(w_goodput), float(w_frames), float(w_load))
+        #: fleet KV economy: migrate a peer-held prefix into the routed
+        #: replica before submitting, when the wire price beats the
+        #: recompute roofline.  ``migrate_mode`` pins the decision for
+        #: bench A/B arms ("auto" | "migrate" | "recompute").
+        self.kv_migration = bool(kv_migration)
+        self.migrate_timeout_s = float(migrate_timeout_s)
+        assert migrate_mode in ("auto", "migrate", "recompute"), \
+            migrate_mode
+        self.migrate_mode = migrate_mode
         #: affinity key -> replica url (insertion-ordered for LRU cap)
         self._affinity: Dict[str, str] = {}
         self._live: Set["RoutedStream"] = set()
@@ -167,6 +186,7 @@ class ReplicaRouter:
         self._m_circuit = m.counter("router_circuit_open_total")
         self._m_route_lat = m.histogram("router_route_seconds")
         self._m_trace_hops = m.counter("serving_trace_hops_total")
+        self._m_migrations = m.counter("router_prefix_migrations_total")
         self._scrape_task: Optional[asyncio.Task] = None
 
     # ----------------------------------------------------------- lifecycle
@@ -216,6 +236,17 @@ class ReplicaRouter:
             except (NetError, wire.ProtocolError):
                 r.scrape_ok = False
                 self._open_circuit(r, why="scrape")
+                return
+            if not self.kv_migration:
+                return
+            try:
+                kv = (await r.client.stats()).get("kv") or {}
+                r.digests = set(kv.get("digests") or ())
+                r.kv_pricing = dict(kv.get("pricing") or {})
+            except (NetError, wire.ProtocolError, AttributeError):
+                # a replica without the kv block (router-of-routers,
+                # older build) just never donates
+                r.digests = set()
 
         await asyncio.gather(*(pull(r) for r in self.replicas))
         self._rescore()
@@ -254,11 +285,13 @@ class ReplicaRouter:
             return f"t:{tenant}"
         if isinstance(prompt, str):
             head = prompt[: 4 * self.affinity_prefix_len].encode()
-        else:
-            head = b",".join(
-                str(int(t)).encode()
-                for t in prompt[: self.affinity_prefix_len])
-        return "p:" + hashlib.sha1(head).hexdigest()[:16]
+            return "p:" + hashlib.sha1(head).hexdigest()[:16]
+        # token prompts share the pool's canonical digest function, so
+        # with the default affinity_prefix_len the "p:" suffix equals
+        # the digest replicas advertise in /v1/stats — the migration
+        # donor lookup and the affinity map speak the same key space
+        return "p:" + wire.prefix_digest(prompt,
+                                         head=self.affinity_prefix_len)
 
     def pick(self, key: str, exclude: Optional[Set[str]] = None
              ) -> Tuple[ReplicaHandle, str]:
@@ -321,6 +354,109 @@ class ReplicaRouter:
         self._affinity[key] = url
         while len(self._affinity) > self.affinity_capacity:
             self._affinity.pop(next(iter(self._affinity)))
+
+    # ----------------------------------------------- fleet KV economy
+    def _wire_policy(self, pricing: Dict[str, float]):
+        """A RecoveryPolicy priced from a donor's advertised roofline
+        terms — its ``choose_wire`` is the migrate-vs-recompute call.
+        The machine profile (wire_gbps when calibrated) supplies the
+        wire-bandwidth denominator; the donor supplies the numerators."""
+        from ...serving.kv_pager import RecoveryPolicy
+
+        return RecoveryPolicy(
+            flops_per_token=float(pricing.get("flops_per_token", 0.0)),
+            weight_bytes=float(pricing.get("weight_bytes", 0.0)),
+            kv_bytes_per_token=float(
+                pricing.get("bytes_per_token", 0.0)),
+            prefill_chunk=int(pricing.get("prefill_chunk", 256)),
+            migrate_mode=self.migrate_mode)
+
+    async def migrate_prefix(self, prompt: Union[List[int], str],
+                             target: ReplicaHandle,
+                             exclude: Optional[Set[str]] = None,
+                             guid: Optional[int] = None,
+                             trace: Optional[TraceContext] = None
+                             ) -> str:
+        """Fleet KV economy, donor side of a routing decision: when a
+        PEER replica advertises the request's prefix digest and the
+        routed ``target`` does not, price shipping the peer's frames
+        over the wire (``/v1/kv/export`` -> ``/v1/kv/import`` relay)
+        against re-prefilling locally, and run the transfer when it
+        wins.  Never raises — any failure (donor dies mid-export,
+        target rejects the bundle, timeout) degrades to "failed" and
+        the caller simply recomputes; transport deaths circuit-break
+        the side that died.  Returns
+        "skip" | "migrate" | "recompute" | "failed"."""
+        if not self.kv_migration or isinstance(prompt, str):
+            return "skip"
+        tokens = [int(t) for t in prompt]
+        if len(tokens) < wire.PREFIX_DIGEST_HEAD:
+            return "skip"
+        digest = wire.prefix_digest(tokens)
+        if digest in target.digests:
+            return "skip"           # already resident where we route
+        now = time.monotonic()
+        exclude = exclude or set()
+        donors = [r for r in self.replicas
+                  if r is not target and r.url not in exclude
+                  and r.available(now) and digest in r.digests]
+        if not donors:
+            return "skip"
+        donor = max(donors, key=lambda r: r.score)
+        est_len = len(tokens)
+        bpt = float(donor.kv_pricing.get("bytes_per_token", 0.0))
+        nbytes_est = int(bpt * est_len)
+        decision = self._wire_policy(donor.kv_pricing).choose_wire(
+            est_len, nbytes_est)
+        t0 = time.monotonic()
+        moved = 0
+        if decision == "migrate":
+            # the relay never decodes the bundle — opaque bytes donor
+            # -> router -> target, one timeout budget across both legs
+            deadline = t0 + self.migrate_timeout_s
+            try:
+                bundle = await asyncio.wait_for(
+                    donor.client.kv_export(tokens, trace=trace),
+                    self.migrate_timeout_s)
+                if bundle is None:  # advertisement raced an eviction
+                    decision = "failed"
+            except (ReplicaUnavailable, StreamBroken):
+                self._open_circuit(donor, why="kv-export")
+                decision = "failed"
+            except (NetError, wire.ProtocolError,
+                    asyncio.TimeoutError):
+                decision = "failed"
+            if decision == "migrate":
+                try:
+                    res = await asyncio.wait_for(
+                        target.client.kv_import(bundle, trace=trace),
+                        max(0.001, deadline - time.monotonic()))
+                    if res.get("imported"):
+                        moved = len(bundle)
+                        # advertise immediately — the very next request
+                        # with this key must not re-migrate while the
+                        # scrape tick catches up
+                        target.digests.add(digest)
+                    else:
+                        decision = "failed"
+                except (ReplicaUnavailable, StreamBroken):
+                    self._open_circuit(target, why="kv-import")
+                    decision = "failed"
+                except (NetError, wire.ProtocolError,
+                        asyncio.TimeoutError):
+                    decision = "failed"
+        seconds = round(time.monotonic() - t0, 6)
+        self._m_migrations.inc(decision=decision)
+        self.recorder.record_event(
+            "router-migrate", guid=guid, donor=donor.url,
+            target=target.url, digest=digest, decision=decision,
+            bytes=moved, seconds=seconds)
+        if guid is not None:
+            self.ledger.note_event(
+                "router-migrate", guid=guid, donor=donor.url,
+                target=target.url, digest=digest, decision=decision,
+                bytes=moved, seconds=seconds)
+        return decision
 
     # ------------------------------------------------------------ requests
     async def generate(self, prompt: Union[List[int], str],
@@ -483,6 +619,10 @@ class RoutedStream:
         self._final: Optional[str] = None
         self._failover_mono: Optional[float] = None
         self._rid = next(_ROUTED_GUID)
+        #: one migration attempt per request: a submit-rejection walk
+        #: or a failover must not re-ship the same frames to every
+        #: candidate it visits
+        self._migrated = False
 
     # ------------------------------------------------------------- binding
     async def _bind_first(self) -> None:
@@ -508,6 +648,21 @@ class RoutedStream:
             if deadline is not None and deadline <= 0:
                 self._finish("failed")
                 raise RequestAborted(self.guid, "deadline", self.tokens)
+            # fleet KV economy: on a spill or a fresh key, a peer that
+            # already holds this prefix can donate its frames to the
+            # routed replica before the submit — the prefill then
+            # starts from the imported span instead of token zero.
+            # Affinity hits skip it (the frames are already local),
+            # resumes skip it (the replayed prefix is being
+            # regenerated anyway), and it runs at most once.
+            if (outcome in ("spill", "new") and not self._migrated
+                    and not self.tokens):
+                self._migrated = True
+                await router.migrate_prefix(
+                    self._prompt, replica, exclude=self._exclude,
+                    guid=self.guid,
+                    trace=(self.trace.child()
+                           if self.trace is not None else None))
             try:
                 ws = await replica.client.generate(
                     self._prompt, max_new_tokens=self._max_new,
@@ -749,7 +904,9 @@ class ReplicaProc:
 def spawn_replica(host: str = "127.0.0.1", port: int = 0, rows: int = 2,
                   decode_block: int = 4, seed: int = 0,
                   max_pending: int = 64,
-                  ready_timeout_s: float = 180.0) -> ReplicaProc:
+                  ready_timeout_s: float = 180.0,
+                  prefix_cache: bool = False,
+                  paged: bool = False) -> ReplicaProc:
     """Spawn ``python -m flexflow_tpu.serve.net --replica`` as a child
     process (tiny CPU llama engine; JAX_PLATFORMS forced to cpu so a
     chip-holding parent never shares its device) and block until its
@@ -761,12 +918,16 @@ def spawn_replica(host: str = "127.0.0.1", port: int = 0, rows: int = 2,
         os.path.dirname(os.path.abspath(__file__)))))
     env["PYTHONPATH"] = (repo + os.pathsep + env.get("PYTHONPATH", "")
                          ).rstrip(os.pathsep)
+    argv = [sys.executable, "-m", "flexflow_tpu.serve.net",
+            "--replica", "--host", host, "--port", str(port),
+            "--rows", str(rows), "--decode-block", str(decode_block),
+            "--seed", str(seed), "--max-pending", str(max_pending)]
+    if prefix_cache:
+        argv.append("--prefix-cache")
+    if paged:
+        argv.append("--paged")
     proc = subprocess.Popen(
-        [sys.executable, "-m", "flexflow_tpu.serve.net", "--replica",
-         "--host", host, "--port", str(port), "--rows", str(rows),
-         "--decode-block", str(decode_block), "--seed", str(seed),
-         "--max-pending", str(max_pending)],
-        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+        argv, stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
         env=env, cwd=repo, text=True, bufsize=1)
     deadline = time.monotonic() + ready_timeout_s
     line = ""

@@ -35,6 +35,7 @@ for the wire schema.
 from __future__ import annotations
 
 import asyncio
+import itertools
 import json
 import signal
 import time
@@ -51,6 +52,15 @@ __all__ = ["ServeNetServer"]
 
 #: idle keep-alive window before a quiet connection is closed
 _KEEPALIVE_IDLE_S = 75.0
+
+#: how long a KV export/import handler waits for the driver thread to
+#: reach its next driver-safe boundary and run the boxed engine op
+_KV_OP_TIMEOUT_S = 30.0
+
+#: synthetic ledger guids for donor/importer KV-wire timelines — the
+#: negative range never collides with engine guids, and a process-wide
+#: counter keeps multi-server tests collision-free on the shared ledger
+_KV_GUID = itertools.count(1)
 
 
 def _query_params(query: str) -> Dict[str, str]:
@@ -83,6 +93,10 @@ class ServeNetServer:
         self._m_tok = m.counter("serving_net_stream_tokens_total")
         self._m_disc = m.counter("serving_net_disconnects_total")
         self._m_lat = m.histogram("serving_net_request_seconds")
+        self._m_kv_export = m.counter(
+            "serving_kv_wire_export_bytes_total")
+        self._m_kv_import = m.counter(
+            "serving_kv_wire_import_bytes_total")
         self._server: Optional[asyncio.AbstractServer] = None
         self._draining = False
         self._closed = asyncio.Event()
@@ -182,7 +196,13 @@ class ServeNetServer:
                     return
                 method, path = parts[0].upper(), parts[1]
                 try:
-                    body = await wire.read_http_body(reader, headers)
+                    # KV bundles carry whole cache frames — the import
+                    # endpoint gets its own (much larger) body cap
+                    limit = (wire._MAX_KV_BODY
+                             if path.partition("?")[0] == wire.P_KV_IMPORT
+                             else wire._MAX_BODY)
+                    body = await wire.read_http_body(reader, headers,
+                                                     limit=limit)
                 except wire.ProtocolError as e:
                     writer.write(wire.json_response(e.status, e.body(),
                                                     close=True))
@@ -234,6 +254,12 @@ class ServeNetServer:
                 endpoint, code = "history", await self._h_history(writer)
             elif path == wire.P_METRICS and method == "GET":
                 endpoint, code = "metrics", await self._h_metrics(writer)
+            elif path == wire.P_KV_EXPORT and method == "POST":
+                endpoint, code = "kv_export", await self._h_kv_export(
+                    headers, body, writer)
+            elif path == wire.P_KV_IMPORT and method == "POST":
+                endpoint, code = "kv_import", await self._h_kv_import(
+                    headers, body, writer)
             else:
                 writer.write(wire.json_response(
                     404, {"error": "not_found", "path": path}))
@@ -262,9 +288,44 @@ class ServeNetServer:
             200, {"protocol": wire.PROTOCOL_VERSION,
                   "metrics": get_registry().snapshot(),
                   "slo": get_ledger().slo_report(),
+                  "kv": self._kv_stats(),
                   "frontend": self.frontend.stats()}))
         await writer.drain()
         return 200
+
+    def _kv_stats(self) -> Dict[str, object]:
+        """The fleet-KV advertisement: a bounded prefix-key digest list
+        plus the layout + pricing inputs a router needs to price
+        migrate-vs-recompute against this replica (RecoveryPolicy's
+        recompute roofline terms).  Read-only snapshot reads — safe
+        off the driver thread."""
+        fe = self.frontend
+        rm = getattr(fe, "rm", None)
+        im = getattr(fe, "im", None)
+        mid = getattr(fe, "model_id", None)
+        pool = getattr(rm, "prefix_cache", None)
+        out: Dict[str, object] = {
+            "pool": pool is not None, "digests": [],
+            "digest_head": wire.PREFIX_DIGEST_HEAD}
+        if pool is not None:
+            out["digests"] = pool.advertised_digests()
+        if im is None or mid is None:
+            return out
+        try:
+            from ...serving.disagg import kv_layout_descriptor
+
+            out["layout"] = kv_layout_descriptor(im, mid)
+            stats = im.kv_cache_stats(mid)
+            params = im.model_param_bytes(mid)
+            out["pricing"] = {
+                "bytes_per_token": stats.bytes_per_token,
+                "flops_per_token": 2.0 * params["elements"],
+                "weight_bytes": params["bytes"],
+                "prefill_chunk": im.models[mid].get("prefill_chunk",
+                                                    256)}
+        except Exception:
+            pass        # a half-compiled record advertises digests only
+        return out
 
     async def _h_timelines(self, query: str, writer) -> int:
         """Ledger timelines over the wire — the cross-process half of
@@ -323,6 +384,195 @@ class ServeNetServer:
         reason = obj.get("reason") or "client"
         self.frontend.cancel(guid, str(reason))
         writer.write(wire.json_response(200, {"ok": True, "guid": guid}))
+        await writer.drain()
+        return 200
+
+    # ------------------------------------------------- fleet KV economy
+    async def _run_driver_op(self, fn):
+        """Box ``fn`` onto the engine's driver thread and await the
+        result without blocking the event loop."""
+        fut = self.frontend.rm.call_on_driver(fn)
+        try:
+            return await asyncio.wait_for(asyncio.wrap_future(fut),
+                                          _KV_OP_TIMEOUT_S)
+        except asyncio.TimeoutError:
+            fut.cancel()
+            raise
+
+    def _kv_note(self, name: str, headers: Dict[str, str],
+                 **payload) -> None:
+        """Land one kv-export/kv-import event on a synthetic ledger
+        timeline stamped with the migration's trace context (the
+        X-FFServe-Trace header the router relays), so fftrace grafts
+        this replica's hop into the traced request — the same join
+        failover halves ride.  The timeline is never retired (it is
+        not a request; retiring it would pollute the SLO window) —
+        the live ring's capacity bounds it."""
+        # the event vocabulary stays statically enumerable for the
+        # metric-schema lint: exactly the two wire-migration events
+        if name == "kv-export":
+            self.recorder.record_event("kv-export", **payload)
+        else:
+            assert name == "kv-import", name
+            self.recorder.record_event("kv-import", **payload)
+        guid = -next(_KV_GUID)
+        led = get_ledger()
+        tr_hdr = headers.get(wire.H_TRACE)
+        trace_id = hop = None
+        if tr_hdr:
+            try:
+                from ...observability.traceplane import TraceContext
+
+                ctx = TraceContext.parse(tr_hdr)
+                trace_id, hop = ctx.trace_id, ctx.hop
+            except ValueError:
+                pass
+        led.note_event("enqueue", guid=guid, trace_id=trace_id,
+                       hop=hop, prompt_len=payload.get("tokens"))
+        if name == "kv-export":
+            led.note_event("kv-export", guid=guid, trace_id=trace_id,
+                           hop=hop, **payload)
+        else:
+            led.note_event("kv-import", guid=guid, trace_id=trace_id,
+                           hop=hop, **payload)
+
+    async def _h_kv_export(self, headers: Dict[str, str], body: bytes,
+                           writer) -> int:
+        """Serialize the longest pooled prefix of the posted tokens
+        into a binary KV bundle (donor side of the cross-replica
+        migration).  Read-only: nothing is leased or released here, so
+        a peer dying mid-download costs this replica nothing."""
+        if self._draining:
+            writer.write(wire.unavailable_response("draining"))
+            await writer.drain()
+            return 503
+        try:
+            obj = json.loads(body.decode("utf-8"))
+            tokens = obj["tokens"]
+            assert (isinstance(tokens, list) and tokens
+                    and all(isinstance(t, int) and t >= 0
+                            for t in tokens))
+        except (ValueError, KeyError, TypeError, AssertionError,
+                UnicodeDecodeError):
+            writer.write(wire.json_response(
+                400, {"error": "bad_request",
+                      "detail": "body must be {\"tokens\": [ids...]}"}))
+            await writer.drain()
+            return 400
+        fe = self.frontend
+        rm, im = fe.rm, getattr(fe, "im", None)
+        if im is None or getattr(rm, "prefix_cache", None) is None:
+            writer.write(wire.json_response(
+                404, {"error": "no_match", "detail": "no prefix pool"}))
+            await writer.drain()
+            return 404
+        t0 = time.monotonic()
+        try:
+            res = await self._run_driver_op(
+                lambda: rm.kv_export_prefix(im, tokens))
+        except asyncio.TimeoutError:
+            writer.write(wire.unavailable_response("driver busy"))
+            await writer.drain()
+            return 503
+        except Exception as e:
+            writer.write(wire.json_response(
+                500, {"error": "internal", "detail": repr(e)}))
+            await writer.drain()
+            return 500
+        if res is None:
+            writer.write(wire.json_response(404, {"error": "no_match"}))
+            await writer.drain()
+            return 404
+        from ...serving.disagg import kv_layout_descriptor
+
+        models = {str(m): {"layout": kv_layout_descriptor(im, m),
+                           "payload": spec["payload"]}
+                  for m, spec in res["models"].items()}
+        bundle = wire.encode_kv_bundle(res["tokens"], res["span"],
+                                       models)
+        dt = time.monotonic() - t0
+        self._m_kv_export.inc(len(bundle))
+        self._kv_note("kv-export", headers, tokens=res["span"],
+                      bytes=len(bundle), seconds=round(dt, 6),
+                      digest=wire.prefix_digest(tokens))
+        writer.write(wire.http_response(
+            200, bundle, content_type="application/octet-stream",
+            extra_headers={"X-FFServe-KV-Span": str(res["span"])}))
+        await writer.drain()
+        return 200
+
+    async def _h_kv_import(self, headers: Dict[str, str], body: bytes,
+                           writer) -> int:
+        """Adopt a peer's KV bundle into the local prefix pool
+        (importer side).  Layout validation runs BEFORE the driver op
+        (read-only record compare); the driver op then leases, restores
+        and inserts atomically — any failure releases the lease, so the
+        pager's frame count returns to baseline."""
+        if self._draining:
+            writer.write(wire.unavailable_response("draining"))
+            await writer.drain()
+            return 503
+        try:
+            bundle = wire.decode_kv_bundle(body)
+        except wire.ProtocolError as e:
+            writer.write(wire.json_response(e.status, e.body()))
+            await writer.drain()
+            return e.status
+        fe = self.frontend
+        rm, im = fe.rm, getattr(fe, "im", None)
+        if im is None or getattr(rm, "prefix_cache", None) is None:
+            writer.write(wire.json_response(
+                404, {"error": "no_pool",
+                      "detail": "this replica has no prefix pool"}))
+            await writer.drain()
+            return 404
+        from ...serving.disagg import (kv_layout_descriptor,
+                                       validate_kv_layouts)
+
+        payloads, dtypes = {}, {}
+        for key, spec in bundle["models"].items():
+            try:
+                m = int(key)
+                if m not in im.models:
+                    raise ValueError(f"unknown model id {key}")
+                validate_kv_layouts(spec["layout"],
+                                    kv_layout_descriptor(im, m),
+                                    what="wire import")
+            except ValueError as e:
+                writer.write(wire.json_response(
+                    409, {"error": "layout_mismatch",
+                          "detail": str(e)}))
+                await writer.drain()
+                return 409
+            payloads[m] = spec["payload"]
+            dtypes[m] = (spec["layout"] or {}).get("dtype_key")
+        t0 = time.monotonic()
+        try:
+            res = await self._run_driver_op(
+                lambda: rm.kv_import_prefix(im, bundle["tokens"],
+                                            bundle["span"], payloads,
+                                            dtypes))
+        except asyncio.TimeoutError:
+            writer.write(wire.unavailable_response("driver busy"))
+            await writer.drain()
+            return 503
+        except Exception as e:
+            writer.write(wire.json_response(
+                500, {"error": "internal", "detail": repr(e)}))
+            await writer.drain()
+            return 500
+        dt = time.monotonic() - t0
+        if res.get("imported"):
+            # bytes count only on commit — the double-spend contract's
+            # observable half
+            self._m_kv_import.inc(len(body))
+            self._kv_note("kv-import", headers, tokens=res["span"],
+                          bytes=len(body), seconds=round(dt, 6),
+                          digest=wire.prefix_digest(bundle["tokens"]),
+                          resident=bool(res.get("resident")))
+        writer.write(wire.json_response(
+            200, {"protocol": wire.PROTOCOL_VERSION, **res,
+                  "bytes": len(body), "seconds": round(dt, 6)}))
         await writer.drain()
         return 200
 

@@ -35,9 +35,10 @@ The parts were already here; this module only retargets them:
 Kill switch: ``FF_DISAGG=0`` makes :meth:`RequestManager.
 generate_disagg` fall back to the single-mesh incremental driver (the
 mixed-continuous A/B arm) without recompiling anything.
-``FF_PREFILL_SJF=1`` swaps the prefill slice's FCFS admission for
-shortest-job-first over calibrated prefill cost (:func:`_sjf_reorder`;
-``bench.py disagg`` stamps which order each run used).
+Prefill admission order is shortest-job-first over calibrated prefill
+cost by default (:func:`_sjf_reorder`; ``bench.py disagg`` stamps
+which order each run used); ``FF_PREFILL_SJF=0`` is the kill switch
+back to plain FCFS.
 
 Bit-exactness: KV depends only on token values and absolute positions
 (the prefix-cache argument), migration moves raw cache bytes, and the
@@ -124,6 +125,61 @@ class SlicePool:
         return self.pager.shortfall(None, length)
 
 
+def kv_layout_descriptor(im, model_id: int) -> Dict[str, Any]:
+    """JSON-serializable description of everything that gives a
+    record's cache bytes meaning: layer set, per-part dtype +
+    per-position shape tail, paged-ness, page length and the spill
+    dtype key.  Two records whose descriptors validate clean can
+    exchange raw KV payloads — the contract FrameMigrator enforces
+    intra-host and the ``/v1/kv/export``/``import`` wire pair enforces
+    across processes (the descriptor rides inside every KV bundle)."""
+    rec = im.models[model_id]
+    caches = rec.get("caches") or {}
+    layers: Dict[str, Dict[str, Any]] = {}
+    for name, kv in caches.items():
+        layers[name] = {
+            part: {"dtype": str(arr.dtype),
+                   "tail": [int(s) for s in arr.shape[1:]]}
+            for part, arr in kv.items()}
+    return {"layers": layers,
+            "paged": bool(rec.get("paged")),
+            "page_len": int(rec["page_len"]) if rec.get("paged")
+            else None,
+            "dtype_key": im.cache_dtype_key(model_id)}
+
+
+def validate_kv_layouts(a: Dict[str, Any], b: Dict[str, Any],
+                        what: str = "migration") -> None:
+    """Raise ``ValueError`` unless two :func:`kv_layout_descriptor`
+    dicts describe byte-compatible cache layouts (a raw KV transfer
+    between them is meaning-preserving)."""
+    la, lb = a.get("layers") or {}, b.get("layers") or {}
+    if sorted(la) != sorted(lb):
+        raise ValueError(
+            f"{what} endpoints serve different models: "
+            f"{sorted(la)} vs {sorted(lb)}")
+    if bool(a.get("paged")) != bool(b.get("paged")):
+        raise ValueError(
+            f"{what} between dense and paged layouts is not "
+            f"supported — compile both sides with the same kv_layout")
+    if a.get("paged") and a.get("page_len") != b.get("page_len"):
+        raise ValueError(
+            f"page_len mismatch across {what} endpoints: "
+            f"{a.get('page_len')} vs {b.get('page_len')}")
+    if a.get("dtype_key") != b.get("dtype_key"):
+        raise ValueError(
+            f"cache layout mismatch across {what} endpoints: dtype "
+            f"key {a.get('dtype_key')!r} vs {b.get('dtype_key')!r}")
+    for name, parts in la.items():
+        for part, spec in parts.items():
+            other = lb[name].get(part)
+            if (other is None or spec["dtype"] != other["dtype"]
+                    or list(spec["tail"]) != list(other["tail"])):
+                raise ValueError(
+                    f"cache layout mismatch at {name}/{part}: "
+                    f"{spec} vs {other}")
+
+
 def _single_device(im, model_id: int):
     """The one device a record's caches live on, or None when the
     record is stage-partitioned / sharded over a submesh (the
@@ -193,32 +249,14 @@ class FrameMigrator:
     def _validate(self) -> None:
         """The transfer is a raw byte move — the two records must agree
         on everything that gives those bytes meaning: layer set, cache
-        dtype, per-position shape, paged-ness and page length."""
-        a = self.src.im.models[self.src.model_id]
-        b = self.dst.im.models[self.dst.model_id]
-        ca, cb = a.get("caches") or {}, b.get("caches") or {}
-        if sorted(ca) != sorted(cb):
-            raise ValueError(
-                f"migration slices serve different models: "
-                f"{sorted(ca)} vs {sorted(cb)}")
-        if bool(a.get("paged")) != bool(b.get("paged")):
-            raise ValueError(
-                "migration between dense and paged layouts is not "
-                "supported — compile both slices with the same "
-                "kv_layout")
-        if a.get("paged") and a["page_len"] != b["page_len"]:
-            raise ValueError(
-                f"page_len mismatch across slices: {a['page_len']} vs "
-                f"{b['page_len']}")
-        for name, kv in ca.items():
-            for part, arr in kv.items():
-                other = cb[name][part]
-                if (arr.dtype != other.dtype
-                        or arr.shape[1:] != other.shape[1:]):
-                    raise ValueError(
-                        f"cache layout mismatch at {name}/{part}: "
-                        f"{arr.dtype}{arr.shape} vs "
-                        f"{other.dtype}{other.shape}")
+        dtype, per-position shape, paged-ness and page length.  The
+        check is the shared :func:`validate_kv_layouts` over the two
+        records' :func:`kv_layout_descriptor`s — the same contract the
+        cross-replica wire pair enforces per bundle."""
+        validate_kv_layouts(
+            kv_layout_descriptor(self.src.im, self.src.model_id),
+            kv_layout_descriptor(self.dst.im, self.dst.model_id),
+            what="migration")
 
     # ------------------------------------------------------------ pricing
     def estimate_bytes(self, length: int) -> int:
@@ -374,10 +412,20 @@ def _drain_cancels(rm, pre: SlicePool, st: _DisaggState) -> int:
     return n
 
 
+def prefill_sjf_enabled() -> bool:
+    """Whether the prefill slice admits shortest-job-first (the
+    default since the order-only reorder proved scheduling-neutral) —
+    ``FF_PREFILL_SJF=0`` is the kill switch back to FCFS.  One probe
+    point so the bench stamp, the regression test and the reorder gate
+    can never disagree."""
+    return os.environ.get("FF_PREFILL_SJF", "1") != "0"
+
+
 def _sjf_reorder(rm, pre: SlicePool, dec: SlicePool) -> None:
     """Shortest-job-first admission order for the prefill slice
-    (``FF_PREFILL_SJF=1``; ROADMAP "scheduling frontier"): stably
-    reorder the pending queue by estimated prefill cost — the
+    (default ON; ``FF_PREFILL_SJF=0`` kills it; ROADMAP "scheduling
+    frontier"): stably reorder the pending queue by estimated prefill
+    cost — the
     request's remaining prompt tokens priced through the prefill
     slice's :class:`RecoveryPolicy` (``recompute_s`` is exactly the
     calibrated cost of a chunked prefill of n tokens under the machine
@@ -388,8 +436,7 @@ def _sjf_reorder(rm, pre: SlicePool, dec: SlicePool) -> None:
     equal-cost prompts keep FCFS order; long prompts CAN age under
     sustained short arrivals — the latency/fairness trade the flag
     opts into (``bench.py disagg`` stamps both arms)."""
-    if len(rm.pending) < 2 \
-            or os.environ.get("FF_PREFILL_SJF", "0") != "1":
+    if len(rm.pending) < 2 or not prefill_sjf_enabled():
         return
     policy = getattr(pre, "_sjf_policy", None)
     if policy is None:
@@ -419,9 +466,9 @@ def _admit(rm, pre: SlicePool, dec: SlicePool, st: _DisaggState) -> None:
     reserve a decode row for their handoff (the both-pools gate);
     preempted returnees with a parked spill go straight back to the
     decode pool.  Blocks are counted once per (request, reason)
-    transition exactly like the single-pool path.  Under
-    ``FF_PREFILL_SJF=1`` the queue is shortest-prefill-first (stable;
-    :func:`_sjf_reorder`) instead of FCFS."""
+    transition exactly like the single-pool path.  The queue is
+    shortest-prefill-first by default (stable; :func:`_sjf_reorder`);
+    ``FF_PREFILL_SJF=0`` restores FCFS."""
     _sjf_reorder(rm, pre, dec)
     pager = dec.pager
     admission_preempted = False

@@ -148,7 +148,8 @@ class RecoveryPolicy:
                  host_bandwidth: Optional[float] = None,
                  mode: str = "auto",
                  device_bandwidth: Optional[float] = None,
-                 migrate_mode: str = "auto"):
+                 migrate_mode: str = "auto",
+                 wire_bandwidth: Optional[float] = None):
         if machine is None:
             # default_machine honors a calibrated FF_MACHINE_PROFILE
             # (tools/ffprof.py --calibrate) — measured hbm/link rates
@@ -170,6 +171,10 @@ class RecoveryPolicy:
             device_bandwidth
             or getattr(machine, "device_link_bandwidth", None)
             or machine.ici_bandwidth)
+        self.wire_bandwidth = float(
+            wire_bandwidth
+            or getattr(machine, "wire_bandwidth", None)
+            or machine.dcn_bandwidth)
         self.mode = mode
         self.migrate_mode = migrate_mode
 
@@ -181,6 +186,13 @@ class RecoveryPolicy:
         migration link (+ one link latency)."""
         return (float(nbytes) / self.device_bandwidth
                 + self.machine.ici_latency)
+
+    def wire_migrate_s(self, nbytes: int) -> float:
+        """Cross-replica KV bundle over the datacenter wire (the
+        router's ``/v1/kv/export`` -> ``/v1/kv/import`` pair): one
+        network crossing + a device hop on each end."""
+        return (float(nbytes) / self.wire_bandwidth
+                + 2.0 * self.machine.ici_latency)
 
     def recompute_s(self, cached_len: int) -> float:
         per_tok = max(
@@ -210,6 +222,21 @@ class RecoveryPolicy:
         if nbytes <= 0 or cached_len <= 0:
             return "recompute"
         return ("migrate" if self.migrate_s(nbytes)
+                <= self.recompute_s(cached_len) else "recompute")
+
+    def choose_wire(self, cached_len: int, nbytes: int) -> str:
+        """"migrate" | "recompute" for a prefix of ``cached_len``
+        committed KV positions a PEER replica holds (``nbytes`` of
+        cache bytes on the wire): ship the bundle across the network
+        into the local pager, or re-prefill the prefix locally — the
+        fleet-KV-economy pricing the router runs before routing a
+        request whose prefix lives elsewhere.  Honors ``migrate_mode``
+        pins the same way :meth:`choose_migrate` does."""
+        if self.migrate_mode != "auto":
+            return self.migrate_mode
+        if nbytes <= 0 or cached_len <= 0:
+            return "recompute"
+        return ("migrate" if self.wire_migrate_s(nbytes)
                 <= self.recompute_s(cached_len) else "recompute")
 
     @classmethod

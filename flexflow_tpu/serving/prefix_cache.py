@@ -53,6 +53,7 @@ the row being admitted.
 
 from __future__ import annotations
 
+import hashlib
 from typing import Dict, List, Optional, Sequence, Set, Tuple
 
 from ..observability import get_flight_recorder, get_registry, get_tracer
@@ -61,6 +62,24 @@ from ..utils.profiling import PrefixCacheStats
 # Matches align down to this boundary — the flash-prefill append window
 # assumes 16-aligned chunk start depths (see module docstring).
 PREFIX_ALIGN = 16
+
+#: fixed token-prefix length the fleet's KV digests hash over.  The
+#: replica-side pool advertisement (/v1/stats "kv" block) and the
+#: router's cross-replica migration lookup both hash exactly this many
+#: leading tokens, so the two planes always agree regardless of the
+#: router's own (configurable) affinity prefix length.
+PREFIX_DIGEST_HEAD = 16
+
+
+def prefix_digest(tokens: Sequence[int],
+                  head: int = PREFIX_DIGEST_HEAD) -> str:
+    """16-hex-char digest of the first ``head`` token ids — the fleet
+    KV economy's prefix-identity key.  Byte-compatible with the
+    router's ``p:`` affinity hashing (same join: comma-separated
+    decimal ids), so one implementation serves both planes."""
+    return hashlib.sha1(
+        b",".join(str(int(t)).encode()
+                  for t in list(tokens)[:head])).hexdigest()[:16]
 
 
 def align_down(n: int, align: int = PREFIX_ALIGN) -> int:
@@ -104,9 +123,10 @@ class PrefixEntry:
     """
 
     __slots__ = ("slot", "rows", "length", "refs", "last_use", "node",
-                 "dtypes", "host")
+                 "dtypes", "host", "digest")
 
-    def __init__(self, slot: int, rows: Dict[int, Tuple[int, int]],
+    def __init__(self, slot: Optional[int],
+                 rows: Dict[int, Tuple[int, int]],
                  length: int, dtypes: Optional[Dict[int, str]] = None):
         self.slot = slot                  # batch slot this entry owns
         self.rows = rows                  # model_id -> (cache_row, kv_len)
@@ -116,6 +136,10 @@ class PrefixEntry:
         self.node: Optional[_Node] = None
         self.dtypes = dict(dtypes or {})  # model_id -> cache dtype tag
         self.host = None                  # spilled payloads (kv_pager)
+        #: fleet-KV identity: prefix_digest of the donated tokens
+        #: (None when the entry is shorter than PREFIX_DIGEST_HEAD —
+        #: too short to advertise)
+        self.digest: Optional[str] = None
 
 
 class PrefixCache:
@@ -262,6 +286,8 @@ class PrefixCache:
         entry = PrefixEntry(slot, dict(rows), len(tokens), dtypes)
         entry.node = node
         node.entry = entry
+        if len(tokens) >= PREFIX_DIGEST_HEAD:
+            entry.digest = prefix_digest(tokens)
         n = node
         while n is not None:
             n.n_entries += 1
@@ -282,6 +308,95 @@ class PrefixCache:
                 self._recorder.record_event("evict", slot=old.slot,
                                             reason="superseded")
         return True
+
+    def insert_host(self, tokens: Sequence[int],
+                    rows: Dict[int, Tuple[int, int]],
+                    dtypes: Optional[Dict[int, str]],
+                    host) -> Optional["PrefixEntry"]:
+        """Adopt a slot-less HOST entry holding ``host`` payloads
+        (model_id -> fetch_row dict) for ``tokens`` — the wire-import
+        landing pad when the importing replica has no free batch slot
+        to make the entry resident.  The entry is matchable in the
+        radix tree exactly like a spilled one (:meth:`detach_slot`):
+        admission restores host->row.  Returns the new entry, or None
+        when the donation is redundant (an existing entry already
+        covers ``tokens``)."""
+        tokens = [int(t) for t in tokens]
+        if len(tokens) < max(self.min_match, 1) or self._covered(tokens):
+            self.stats.donations_rejected += 1
+            self._c_rejected.inc()
+            return None
+        node, i = self.root, 0
+        while i < len(tokens):
+            child = node.children.get(tokens[i])
+            if child is None:
+                break
+            j = self._lcp(child.edge, tokens[i:])
+            if j < len(child.edge):
+                node = self._split(child, j)
+                i += j
+                break
+            i += j
+            node = child
+        if i < len(tokens):
+            leaf = _Node(tokens[i:], node)
+            node.children[tokens[i]] = leaf
+            node = leaf
+        if node.entry is not None:
+            # exact duplicate — _covered should have caught it
+            self.stats.donations_rejected += 1
+            self._c_rejected.inc()
+            return None
+        entry = PrefixEntry(None, dict(rows), len(tokens), dtypes)
+        entry.node = node
+        node.entry = entry
+        entry.host = host
+        if len(tokens) >= PREFIX_DIGEST_HEAD:
+            entry.digest = prefix_digest(tokens)
+        n = node
+        while n is not None:
+            n.n_entries += 1
+            n = n.parent
+        self.host_entries.append(entry)
+        self._bump(entry)
+        self.stats.donations += 1
+        self._c_donations.inc()
+        # bound host RAM exactly like detach_slot's spill path
+        while len(self.host_entries) > self.max_host_entries:
+            victims = [e for e in self.host_entries if e is not entry]
+            if not victims:
+                break
+            victim = min(victims, key=lambda e: e.last_use)
+            self.remove(victim)
+            self.stats.evictions += 1
+            self._c_evictions.inc()
+            self._tracer.instant("evict", slot=None, reason="host-lru")
+            self._recorder.record_event("evict", slot=None,
+                                        reason="host-lru")
+        return entry
+
+    def advertised_digests(self, cap: int = 256) -> List[str]:
+        """Bounded prefix-key advertisement for the fleet: the
+        digests of the pool's entries (resident + host), most recently
+        used first, deduplicated, at most ``cap`` — what a replica
+        publishes in its ``/v1/stats`` "kv" block for the router's
+        migration lookup.  Snapshot-safe: reads copies, so the asyncio
+        stats handler may call it while the driver thread mutates the
+        pool."""
+        ents = [e for e in (list(self.entries.values())
+                            + list(self.host_entries))
+                if e.digest is not None]
+        ents.sort(key=lambda e: -e.last_use)
+        out: List[str] = []
+        seen: Set[str] = set()
+        for e in ents:
+            if e.digest in seen:
+                continue
+            seen.add(e.digest)
+            out.append(e.digest)
+            if len(out) >= cap:
+                break
+        return out
 
     def _split(self, child: _Node, j: int) -> _Node:
         """Split ``child``'s edge at offset j; returns the new mid node."""

@@ -333,6 +333,12 @@ class RequestManager:
         # in flight (docs/SERVING.md "Cancellation").
         self._cancel_lock = threading.Lock()
         self._cancel_box: Dict[int, str] = {}
+        # deferred ENGINE-OP mailbox (wire KV export/import → driver
+        # thread): call_on_driver() boxes a callable from any thread;
+        # drain_cancels() runs them at the same driver-safe boundary
+        # as cancellations, so device work never races the step loop.
+        self._driver_ops_lock = threading.Lock()
+        self._driver_ops: List[Tuple[Callable[[], Any], Any]] = []
         # async front-end hooks (serve/frontend.py), called on the
         # DRIVER thread: on_commit(req, tokens) with each newly
         # appended token-id batch, on_finish(req, status, reason) once
@@ -850,6 +856,144 @@ class RequestManager:
         # experienced
         return True
 
+    # -------------------------------------------------- fleet KV economy
+    def kv_export_prefix(self, im: InferenceManager, tokens
+                         ) -> Optional[Dict[str, Any]]:
+        """DRIVER-thread op (the ``/v1/kv/export`` handler's boxed
+        call): serialize the longest pooled prefix of ``tokens`` into
+        host payloads a peer replica can adopt.  The donor side is
+        READ-ONLY — resident entries are fetched (host-staged
+        ``fetch_row``, the same payloads the spill path moves), host
+        entries pass their payloads through; nothing is released, so
+        a mid-transfer peer death costs the donor nothing.  Returns
+        ``{"tokens": tokens[:span], "span", "models": {mid:
+        {"payload", "dtype", "use"}}}`` or None when no usable match
+        exists."""
+        pool = self.prefix_cache
+        if pool is None or im is None:
+            return None
+        tokens = [int(t) for t in tokens]
+        entry, d = pool.match(tokens)
+        if entry is None or d <= 0:
+            return None
+        uses: Dict[int, int] = {}
+        for mid in entry.rows:
+            use = pool.usable(entry, mid, d, len(tokens),
+                              dtype=im.cache_dtype_key(mid))
+            if entry.host is not None:
+                payload = entry.host.get(mid)
+                if payload is None:
+                    use = 0
+                else:
+                    use = min(use, align_down(int(payload["valid"])))
+            if use > 0:
+                uses[mid] = use
+        if not uses:
+            return None
+        span = min(uses.values())
+        if span < pool.min_match:
+            return None
+        models: Dict[int, Dict[str, Any]] = {}
+        for mid, use in uses.items():
+            if entry.host is not None:
+                payload = entry.host[mid]
+            else:
+                cache_row = entry.rows[mid][0]
+                payload = im.fetch_row(mid, cache_row, span)
+                if payload is None:
+                    return None
+            models[mid] = {"payload": payload,
+                           "dtype": im.cache_dtype_key(mid),
+                           "use": min(use, span)}
+        return {"tokens": tokens[:span], "span": span, "models": models}
+
+    def kv_import_prefix(self, im: InferenceManager, tokens, span: int,
+                         payloads: Dict[int, Dict[str, Any]],
+                         dtypes: Optional[Dict[int, str]] = None,
+                         model_rows: Optional[Dict[int, int]] = None
+                         ) -> Dict[str, Any]:
+        """DRIVER-thread op (the ``/v1/kv/import`` handler's boxed
+        call): adopt a peer's exported prefix payloads into the local
+        pool.  Resident adoption first — a free batch slot takes a
+        ``owner="pool"`` page lease (``adopt_prefix``-style: the
+        entry's whole frames become shareable by admission) and the
+        payloads restore into its rows; if no slot or no pages, the
+        entry lands slot-less as a HOST entry (restored row-ward at
+        admission).  Double-spend accounting: the lease is taken
+        before the restore and released on ANY failure path, so an
+        aborted import leaves the pager's frame count at baseline.
+        Returns ``{"imported", "resident", "span", "reason"}``."""
+        pool = self.prefix_cache
+        out = {"imported": False, "resident": False, "span": 0,
+               "reason": ""}
+        if pool is None or im is None:
+            out["reason"] = "no-pool"
+            return out
+        tokens = [int(t) for t in tokens]
+        span = align_down(min(len(tokens), int(span)))
+        out["span"] = span
+        if span < pool.min_match:
+            out["reason"] = "too-short"
+            return out
+        tokens = tokens[:span]
+        dtypes = dict(dtypes or {})
+        for mid in payloads:
+            want = im.cache_dtype_key(mid)
+            got = dtypes.get(mid)
+            if got is not None and got != want:
+                out["reason"] = "dtype-key"
+                return out
+            dtypes[mid] = want
+        if model_rows is None:
+            model_rows = (dict(self._paged_ctx[1])
+                          if self._paged_ctx is not None
+                          else {mid: 1 for mid in payloads})
+        pager = self.kv_pager
+        free = self._free_rows()
+        slot = (free[0] if free and len(pool.entries) < pool.max_slots
+                else None)
+        if slot is not None:
+            leased = True
+            if pager is not None:
+                leased = pager.lease(slot, span, owner="pool",
+                                     guid=None)
+                if leased:
+                    self._push_tables()
+            if leased:
+                rows: Dict[int, Tuple[int, int]] = {}
+                try:
+                    for mid, payload in payloads.items():
+                        mult = model_rows.get(mid, 1)
+                        im.restore_row(mid, slot * mult, payload)
+                        rows[mid] = (slot * mult, span)
+                    ok = pool.insert(tokens, slot, rows, dtypes)
+                except Exception:
+                    # restore/insert died mid-way: release the lease so
+                    # the frames return to baseline (the importer-side
+                    # half of the double-spend contract)
+                    if pager is not None:
+                        pager.release(slot)
+                        self._push_tables()
+                    raise
+                if ok:
+                    out.update(imported=True, resident=True,
+                               reason="resident")
+                    return out
+                if pager is not None:
+                    pager.release(slot)
+                    self._push_tables()
+                out["reason"] = "rejected"
+                return out
+        # no slot / no pages: slot-less HOST landing pad — matchable,
+        # zero device residency, restored at admission
+        rows = {mid: (0, span) for mid in payloads}
+        entry = pool.insert_host(tokens, rows, dtypes, dict(payloads))
+        if entry is None:
+            out["reason"] = "rejected"
+            return out
+        out.update(imported=True, resident=False, reason="host")
+        return out
+
     def _reclaim_pool_pages(self, im: InferenceManager, need_len: int):
         """Free pages by spilling (preferred — keeps the prefix
         matchable) or evicting LRU unreferenced pool entries until the
@@ -1154,18 +1298,49 @@ class RequestManager:
         with self._cancel_lock:
             self._cancel_box.setdefault(guid, reason)
 
+    def call_on_driver(self, fn: Callable[[], Any]):
+        """Thread-safe deferred ENGINE OP: box ``fn`` to run on the
+        driver thread at the next :meth:`drain_cancels` boundary (the
+        admission boundary every driver passes through between device
+        epochs, and the idle front-end loop's ≤50 ms tick).  Returns a
+        ``concurrent.futures.Future`` resolving to ``fn()``'s result —
+        the wire KV export/import handlers await it with a timeout.
+        Never call from the driver thread itself (it would deadlock on
+        its own mailbox); driver-side code just calls ``fn``."""
+        import concurrent.futures
+
+        fut: "concurrent.futures.Future" = concurrent.futures.Future()
+        with self._driver_ops_lock:
+            self._driver_ops.append((fn, fut))
+        return fut
+
+    def _drain_driver_ops(self) -> None:
+        with self._driver_ops_lock:
+            if not self._driver_ops:
+                return
+            ops, self._driver_ops = self._driver_ops, []
+        for fn, fut in ops:
+            if not fut.set_running_or_notify_cancel():
+                continue
+            try:
+                fut.set_result(fn())
+            except BaseException as e:  # delivered to the waiter
+                fut.set_exception(e)
+
     def drain_cancels(self) -> int:
-        """Enact boxed cancellations; returns how many took effect.
-        Must run on the driver thread (or with no driver in flight —
-        the idle front-end loop calls it directly)."""
+        """Enact boxed cancellations (then boxed engine ops); returns
+        how many cancellations took effect.  Must run on the driver
+        thread (or with no driver in flight — the idle front-end loop
+        calls it directly)."""
         with self._cancel_lock:
-            if not self._cancel_box:
-                return 0
             box = self._cancel_box
-            self._cancel_box = {}
+            self._cancel_box = {} if box else box
         n = 0
         for guid, reason in box.items():
             n += bool(self.cancel_request(guid, reason=reason))
+        # engine ops run AFTER cancellations: a cancel may free the
+        # slot or pages an import op is about to lease
+        self._drain_driver_ops()
         return n
 
     def cancel_request(self, guid: int, reason: str = "client") -> bool:

@@ -339,8 +339,9 @@ class TestTwoPoolAccounting:
 
 # ----------------------------------------------------------- SJF order
 class TestSJFPrefillOrder:
-    """FF_PREFILL_SJF=1 admits shortest-prefill-first on the prefill
-    slice (stable over calibrated cost; spill returnees keep absolute
+    """FF_PREFILL_SJF (default ON since PR 17; =0 is the kill switch
+    back to FCFS) admits shortest-prefill-first on the prefill slice
+    (stable over calibrated cost; spill returnees keep absolute
     priority) and — like every scheduling knob — changes WHEN rows
     compute, never WHAT."""
 
@@ -355,12 +356,13 @@ class TestSJFPrefillOrder:
         rm = _rm(rows=2)
         reqs = [rm.register_new_request(p, max_new_tokens=2)
                 for p in _prompts([40, 8, 24, 8], seed=3)]
-        # flag off: FCFS untouched
-        monkeypatch.delenv("FF_PREFILL_SJF", raising=False)
+        # kill switch: FCFS untouched
+        monkeypatch.setenv("FF_PREFILL_SJF", "0")
         _sjf_reorder(rm, pre, dec)
         assert list(rm.pending) == reqs
-        # flag on: shortest first, equal lengths keep arrival order
-        monkeypatch.setenv("FF_PREFILL_SJF", "1")
+        # default (env unset) is ON: shortest first, equal lengths
+        # keep arrival order
+        monkeypatch.delenv("FF_PREFILL_SJF", raising=False)
         _sjf_reorder(rm, pre, dec)
         assert list(rm.pending) == [reqs[1], reqs[3], reqs[2], reqs[0]]
         # a parked spill beats everything: its prefill is already done
@@ -379,9 +381,10 @@ class TestSJFPrefillOrder:
 
         def serve(sjf):
             if sjf:
-                monkeypatch.setenv("FF_PREFILL_SJF", "1")
-            else:
+                # env unset: the default-on regression half
                 monkeypatch.delenv("FF_PREFILL_SJF", raising=False)
+            else:
+                monkeypatch.setenv("FF_PREFILL_SJF", "0")
             im_pre, pmid = _compile(devices=(devs[0],), max_requests=1)
             im_dec, dmid = _compile(devices=(devs[1],), max_requests=2)
             rm = _rm(rows=2)

@@ -1,0 +1,376 @@
+"""Fleet KV economy tests (PR 17): router-directed cross-replica
+prefix-frame migration over the wire.
+
+Host-only coverage first — the ``FFKV`` bundle codec (round-trip,
+version fencing, truncation fences), the canonical prefix digest and
+the pool's bounded advertisement, the ``choose_wire``
+migrate-vs-recompute pricing, and the ``FF_PREFILL_SJF`` default-ON
+regression — then engine-level export/import bookkeeping on tiny CPU
+engines: donor export is read-only, importer adoption is
+lease-before-restore with the lease released on any failure (the
+double-spend contract), dtype-key and span fences reject before any
+state mutates.  The 2-process wire path itself is exercised by
+``python -m flexflow_tpu.serve.net --selftest-fleetkv`` (run_tier1.sh)
+and ``bench.py fleetkv``.
+"""
+
+import asyncio
+import hashlib
+import os
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from flexflow_tpu.serve.net import protocol as wire  # noqa: E402
+from flexflow_tpu.serving.disagg import prefill_sjf_enabled  # noqa: E402
+from flexflow_tpu.serving.kv_pager import RecoveryPolicy  # noqa: E402
+from flexflow_tpu.serving.prefix_cache import (PREFIX_DIGEST_HEAD,  # noqa: E402
+                                               PrefixCache,
+                                               prefix_digest)
+
+
+def _payload(span=32, heads=2, dim=4, dtype=np.float32, seed=0):
+    """A fake ``fetch_row`` payload: two layers x {k, v} arrays."""
+    rng = np.random.default_rng(seed)
+    layers = {}
+    for li in range(2):
+        layers[f"layer{li}"] = {
+            part: rng.standard_normal(
+                (span, heads, dim)).astype(dtype)
+            for part in ("k", "v")}
+    nbytes = sum(a.nbytes for parts in layers.values()
+                 for a in parts.values())
+    return {"layers": layers, "valid": span, "bytes": nbytes}
+
+
+class TestKVWireCodec:
+    def test_roundtrip(self):
+        tokens = list(range(4, 36))
+        p = _payload(span=32)
+        models = {"0": {"layout": {"kv_layout": "dense",
+                                   "page_len": 0},
+                        "payload": p}}
+        bundle = wire.encode_kv_bundle(tokens, 32, models)
+        assert bundle[:4] == b"FFKV"
+        got = wire.decode_kv_bundle(bundle)
+        assert got["tokens"] == tokens and got["span"] == 32
+        spec = got["models"]["0"]
+        assert spec["layout"] == {"kv_layout": "dense", "page_len": 0}
+        assert spec["payload"]["valid"] == 32
+        assert spec["payload"]["bytes"] == p["bytes"]
+        for lname, parts in p["layers"].items():
+            for part, arr in parts.items():
+                back = spec["payload"]["layers"][lname][part]
+                assert back.dtype == arr.dtype
+                np.testing.assert_array_equal(back, arr)
+
+    def test_dtype_and_multi_model_preserved(self):
+        models = {
+            "0": {"layout": {}, "payload": _payload(dtype=np.float32)},
+            "1": {"layout": {}, "payload": _payload(dtype=np.float16,
+                                                    seed=3)},
+        }
+        got = wire.decode_kv_bundle(
+            wire.encode_kv_bundle([1] * 32, 32, models))
+        assert set(got["models"]) == {"0", "1"}
+        assert (got["models"]["1"]["payload"]["layers"]["layer0"]["k"]
+                .dtype == np.float16)
+
+    def test_version_mismatch_is_kv_wire_version(self):
+        bundle = bytearray(wire.encode_kv_bundle(
+            [1] * 16, 16, {"0": {"layout": {}, "payload": _payload()}}))
+        bundle[7] = wire.KV_WIRE_VERSION + 1  # frame version field
+        with pytest.raises(wire.ProtocolError) as ei:
+            wire.decode_kv_bundle(bytes(bundle))
+        assert ei.value.status == 400
+        assert ei.value.error == "kv_wire_version"
+
+    def test_bad_magic_and_runt(self):
+        for bad in (b"NOPE" + b"\0" * 20, b"FFKV\0"):
+            with pytest.raises(wire.ProtocolError) as ei:
+                wire.decode_kv_bundle(bad)
+            assert ei.value.status == 400
+
+    def test_truncated_body_is_fenced(self):
+        bundle = wire.encode_kv_bundle(
+            [1] * 16, 16, {"0": {"layout": {}, "payload": _payload()}})
+        with pytest.raises(wire.ProtocolError) as ei:
+            wire.decode_kv_bundle(bundle[:-8])  # array bytes cut short
+        assert ei.value.status == 400
+
+
+class TestDigestAdvertisement:
+    def test_digest_is_canonical_sha1_head(self):
+        tokens = list(range(100, 140))
+        want = hashlib.sha1(
+            b",".join(str(t).encode()
+                      for t in tokens[:PREFIX_DIGEST_HEAD])
+        ).hexdigest()[:16]
+        assert prefix_digest(tokens) == want
+        # only the head participates — a differing tail shares the key
+        assert prefix_digest(tokens[:PREFIX_DIGEST_HEAD]
+                             + [7, 8, 9]) == want
+
+    def test_pool_advertises_resident_and_host_entries(self):
+        pool = PrefixCache(max_slots=4)
+        resident = list(range(4, 36))
+        pool.insert(resident, 0, {0: (0, 32)}, {0: "f32"})
+        host_toks = list(range(40, 72))
+        assert pool.insert_host(host_toks, {0: (0, 32)}, {0: "f32"},
+                                {0: _payload()}) is not None
+        ads = pool.advertised_digests()
+        assert prefix_digest(resident) in ads
+        assert prefix_digest(host_toks) in ads
+        # MRU first: the host entry landed last
+        assert ads[0] == prefix_digest(host_toks)
+        assert pool.advertised_digests(cap=1) == [ads[0]]
+
+    def test_host_insert_rejects_covered_and_short(self):
+        pool = PrefixCache(max_slots=4)
+        toks = list(range(4, 36))
+        assert pool.insert_host(toks, {0: (0, 32)}, {0: "f32"},
+                                {0: _payload()}) is not None
+        assert pool.insert_host(toks, {0: (0, 32)}, {0: "f32"},
+                                {0: _payload()}) is None
+        assert pool.insert_host([1, 2, 3], {0: (0, 3)}, {0: "f32"},
+                                {0: _payload(span=3)}) is None
+
+
+class TestWirePricing:
+    def test_auto_migrate_wins_when_recompute_is_expensive(self):
+        pol = RecoveryPolicy(flops_per_token=1e12,
+                             wire_bandwidth=1e12)
+        assert pol.choose_wire(256, 1 << 20) == "migrate"
+
+    def test_auto_recompute_wins_when_wire_is_slow(self):
+        pol = RecoveryPolicy(flops_per_token=1.0,
+                             wire_bandwidth=1e3)
+        assert pol.choose_wire(256, 1 << 20) == "recompute"
+
+    def test_pins_override_pricing(self):
+        assert RecoveryPolicy(migrate_mode="migrate").choose_wire(
+            1, 1) == "migrate"
+        assert RecoveryPolicy(
+            flops_per_token=1e12, wire_bandwidth=1e12,
+            migrate_mode="recompute").choose_wire(
+                256, 1 << 20) == "recompute"
+
+    def test_auto_degenerate_spans_recompute(self):
+        pol = RecoveryPolicy(flops_per_token=1e12,
+                             wire_bandwidth=1e12)
+        assert pol.choose_wire(0, 1 << 20) == "recompute"
+        assert pol.choose_wire(256, 0) == "recompute"
+
+    def test_wire_time_scales_with_bandwidth(self):
+        fast = RecoveryPolicy(wire_bandwidth=1e10)
+        slow = RecoveryPolicy(wire_bandwidth=1e7)
+        assert (slow.wire_migrate_s(1 << 20)
+                > fast.wire_migrate_s(1 << 20))
+
+
+class TestPrefillSJFDefault:
+    def test_default_on(self, monkeypatch):
+        monkeypatch.delenv("FF_PREFILL_SJF", raising=False)
+        assert prefill_sjf_enabled() is True
+
+    def test_kill_switch(self, monkeypatch):
+        monkeypatch.setenv("FF_PREFILL_SJF", "0")
+        assert prefill_sjf_enabled() is False
+        monkeypatch.setenv("FF_PREFILL_SJF", "1")
+        assert prefill_sjf_enabled() is True
+
+
+class TestFleetKVMetricSchema:
+    """Satellite: every wire-migration metric and event name the
+    fleet-KV plane emits validates against the CHECKED-IN schema, and
+    a rogue sibling is still flagged (the fflint baseline stays
+    empty)."""
+
+    def test_names_covered_by_real_schema(self, tmp_path):
+        from tools.fflint import LintContext, lint_file
+        from tools.fflint.rules.metric_schema import MetricSchemaRule
+
+        rules = [MetricSchemaRule()]
+        src = """\
+            def fleetkv(m, rec, ledger):
+                a = m.counter("serving_kv_wire_export_bytes_total")
+                b = m.counter("serving_kv_wire_import_bytes_total")
+                c = m.counter("router_prefix_migrations_total")
+                rec.record_event("router-migrate", guid=1,
+                                 decision="migrate", bytes=64)
+                rec.record_event("kv-export", guid=1, tokens=32)
+                ledger.note_event("kv-import", guid=1, resident=True)
+                return a, b, c
+            """
+        path = tmp_path / "serving" / "fleetkv_fixture.py"
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_text(textwrap.dedent(src))
+        ctx = LintContext(repo_root=REPO)  # exec-loads the real schema
+        fs = lint_file(str(path), rules, ctx,
+                       rel="serving/fleetkv_fixture.py",
+                       judge_suppressions=True)
+        assert fs == [], fs
+        rogue = tmp_path / "serving" / "rogue_fixture.py"
+        rogue.write_text(textwrap.dedent("""\
+            def fleetkv(m, rec):
+                m.counter("serving_kv_wire_exports_total")
+                rec.record_event("kv-teleport", guid=1)
+            """))
+        fs = lint_file(str(rogue), rules, ctx,
+                       rel="serving/rogue_fixture.py",
+                       judge_suppressions=True)
+        assert [f.line for f in fs if f.rule == "metric-schema"] \
+            == [2, 3], fs
+
+
+# --------------------------------------------------------------------
+# engine-level export/import bookkeeping (tiny CPU engines)
+# --------------------------------------------------------------------
+
+def _serve_once(im, mid, rm, prompt, n=8):
+    from flexflow_tpu.serve.frontend import AsyncServeFrontend
+
+    async def go():
+        fe = AsyncServeFrontend(im, mid, rm, reap_interval_s=0.005)
+        async with fe:
+            s = await fe.submit(prompt, max_new_tokens=n)
+            return await s.result()
+
+    return asyncio.run(go())
+
+
+def _export_payloads(res):
+    payloads = {mid: m["payload"] for mid, m in res["models"].items()}
+    dtypes = {mid: m["dtype"] for mid, m in res["models"].items()}
+    return payloads, dtypes
+
+
+class TestEngineExportImport:
+    PROMPT = np.random.default_rng(7).integers(4, 120, 48).tolist()
+
+    @pytest.fixture(scope="class")
+    def donor(self):
+        from tools.ffload import build_tiny_engine
+
+        im, mid, rm = build_tiny_engine(max_requests=2, decode_block=4,
+                                        seed=0, prefix_cache=True)
+        _serve_once(im, mid, rm, self.PROMPT)  # retire donates prefix
+        assert rm.prefix_cache.entries, "serve did not warm the pool"
+        return im, mid, rm
+
+    @pytest.fixture(scope="class")
+    def importer(self):
+        from tools.ffload import build_tiny_engine
+
+        return build_tiny_engine(max_requests=2, decode_block=4,
+                                 seed=0, prefix_cache=True)
+
+    def test_export_is_aligned_and_read_only(self, donor):
+        im, _, rm = donor
+        n_entries = len(rm.prefix_cache.entries)
+        res = rm.kv_export_prefix(im, self.PROMPT)
+        assert res is not None
+        assert res["span"] > 0 and res["span"] % 16 == 0
+        assert res["tokens"] == self.PROMPT[:res["span"]]
+        for spec in res["models"].values():
+            assert spec["payload"]["layers"]
+            assert spec["dtype"] == im.cache_dtype_key(
+                next(iter(res["models"])))
+        # donor side untouched: same entries, nothing released
+        assert len(rm.prefix_cache.entries) == n_entries
+
+    def test_export_no_match_returns_none(self, donor):
+        im, _, rm = donor
+        stranger = np.random.default_rng(99).integers(
+            4, 120, 48).tolist()
+        assert rm.kv_export_prefix(im, stranger) is None
+        assert rm.kv_export_prefix(im, self.PROMPT[:4]) is None
+
+    def test_import_fences_before_mutating(self, donor, importer):
+        im_a, _, rm_a = donor
+        im_b, _, rm_b = importer
+        res = rm_a.kv_export_prefix(im_a, self.PROMPT)
+        payloads, dtypes = _export_payloads(res)
+        out = rm_b.kv_import_prefix(
+            im_b, res["tokens"], res["span"], payloads,
+            {mid: "bogus-key" for mid in dtypes})
+        assert out == {"imported": False, "resident": False,
+                       "span": res["span"], "reason": "dtype-key"}
+        out = rm_b.kv_import_prefix(im_b, res["tokens"][:8], 8,
+                                    payloads, dtypes)
+        assert not out["imported"] and out["reason"] == "too-short"
+        pool, rm_b.prefix_cache = rm_b.prefix_cache, None
+        try:
+            out = rm_b.kv_import_prefix(im_b, res["tokens"],
+                                        res["span"], payloads, dtypes)
+            assert not out["imported"] and out["reason"] == "no-pool"
+        finally:
+            rm_b.prefix_cache = pool
+        assert not rm_b.prefix_cache.entries  # nothing leaked through
+
+    def test_poisoned_import_leaves_pool_clean(self, donor, importer):
+        im_a, _, rm_a = donor
+        im_b, _, rm_b = importer
+        res = rm_a.kv_export_prefix(im_a, self.PROMPT)
+        payloads, dtypes = _export_payloads(res)
+        bad = {mid: {k: v for k, v in p.items() if k != "layers"}
+               for mid, p in payloads.items()}
+        with pytest.raises(Exception):
+            rm_b.kv_import_prefix(im_b, res["tokens"], res["span"],
+                                  bad, dtypes)
+        assert not rm_b.prefix_cache.entries
+        # the slot the failed import touched is reusable: the good
+        # bundle still adopts resident afterwards
+        out = rm_b.kv_import_prefix(im_b, res["tokens"], res["span"],
+                                    payloads, dtypes)
+        assert out["imported"] and out["resident"]
+        entry, d = rm_b.prefix_cache.match(self.PROMPT)
+        assert entry is not None and d > 0
+        assert entry.digest == prefix_digest(self.PROMPT)
+        # re-import of a covered prefix is redundant, not an error
+        out = rm_b.kv_import_prefix(im_b, res["tokens"], res["span"],
+                                    payloads, dtypes)
+        assert not out["resident"]
+
+
+class TestPagedImportLease:
+    """The pager half of the double-spend contract on the physical
+    paged layout: import leases pages before the restore and releases
+    them on any failure, so a poisoned bundle leaves the frame count
+    at baseline."""
+
+    def test_lease_released_on_poisoned_import(self):
+        from tools.ffload import build_tiny_engine
+
+        prompt = np.random.default_rng(7).integers(4, 120, 80).tolist()
+        im, mid, rm = build_tiny_engine(max_requests=2, decode_block=4,
+                                        seed=0, prefix_cache=True,
+                                        paged=True)
+        _serve_once(im, mid, rm, prompt)
+        res = rm.kv_export_prefix(im, prompt)
+        assert res is not None and res["span"] >= 64
+        payloads, dtypes = _export_payloads(res)
+        other = np.random.default_rng(8).integers(4, 120, 80).tolist()
+        # evict the donated entry so the import takes the RESIDENT
+        # path (free slot + pool capacity) — otherwise it lands as a
+        # host entry and never touches the pager
+        while rm.prefix_cache.evict_one() is not None:
+            pass
+        free0 = rm.kv_pager.free_pages
+        entries0 = len(rm.prefix_cache.entries)
+        bad = {m: {k: v for k, v in p.items() if k != "layers"}
+               for m, p in payloads.items()}
+        with pytest.raises(Exception):
+            rm.kv_import_prefix(im, other[:res["span"]], res["span"],
+                                bad, dtypes)
+        assert rm.kv_pager.free_pages == free0
+        assert len(rm.prefix_cache.entries) == entries0
+        out = rm.kv_import_prefix(im, other[:res["span"]],
+                                  res["span"], payloads, dtypes)
+        assert out["imported"] and out["resident"]
+        assert rm.kv_pager.free_pages < free0  # lease held by the pool

@@ -378,10 +378,16 @@ def format_report(rep: Dict[str, Any]) -> str:
 # ---------------------------------------------------- in-process engine
 def build_tiny_engine(max_requests: int = 4, max_seq_length: int = 256,
                       decode_block: int = 4, seed: int = 0,
-                      prefix_cache: bool = False, kv_pager=None):
+                      prefix_cache: bool = False, kv_pager=None,
+                      paged: bool = False):
     """A CPU-sized llama + RequestManager for in-process load runs
     (the selftest / CI path; bench.py's ``live`` mode builds the real
-    model the same way).  Returns (im, model_id, rm)."""
+    model the same way).  Returns (im, model_id, rm).
+
+    ``paged=True`` compiles the physical paged KV layout and wires a
+    frame-backed :class:`KVPager` (the replica shape the fleet-KV
+    loopback smoke and ``spawn_replica(paged=True)`` run) instead of
+    dense rows."""
     import jax
     import numpy as np
 
@@ -397,9 +403,20 @@ def build_tiny_engine(max_requests: int = 4, max_seq_length: int = 256,
     create_llama_model(model, cfg, max_requests=max_requests)
     model.params = model.init_params(jax.random.PRNGKey(seed))
     im = InferenceManager(model.config)
+    compile_kw = {}
+    if paged:
+        compile_kw = {"kv_layout": "paged", "kv_page_len": 64}
     mid = im.compile_model_and_allocate_buffer(
         model, max_requests=max_requests, max_seq_length=max_seq_length,
-        cache_dtype=np.float32)
+        cache_dtype=np.float32, **compile_kw)
+    if paged and kv_pager is None:
+        from flexflow_tpu.serving import KVPager
+
+        rec = im.models[mid]
+        kv_pager = KVPager(
+            rec["num_frames"], page_len=64,
+            num_frames=rec["num_frames"],
+            bytes_per_token=im.kv_cache_stats(mid).bytes_per_token)
     rm = RequestManager(max_requests_per_batch=max_requests,
                         max_tokens_per_batch=64,
                         max_sequence_length=max_seq_length,

@@ -214,6 +214,40 @@ def migrate_spans(t: Dict[str, Any]) -> List[str]:
     return out
 
 
+def wire_migrate_spans(t: Dict[str, Any]) -> List[str]:
+    """Fleet-KV cross-replica migration spans: a router hop's
+    ``router-migrate`` decision (export -> wire bytes/ms -> import),
+    and the ``kv-export`` / ``kv-import`` halves the donor and
+    importer replicas land on their own trace-stamped timelines — so
+    an assembled trace shows whose frames moved where before the
+    route."""
+    out: List[str] = []
+    for ev in (t.get("events") or []):
+        name = ev.get("name")
+        if name == "router-migrate":
+            if ev.get("decision") == "migrate":
+                cost = (f"{ev.get('bytes', 0)}B over the wire in "
+                        f"{(ev.get('seconds') or 0.0) * 1e3:.1f}ms")
+            else:
+                cost = f"{ev.get('decision')} (no transfer)"
+            out.append(f"  export {ev.get('donor')} -> [{cost}] -> "
+                       f"import {ev.get('target')} "
+                       f"digest={ev.get('digest')}")
+        elif name == "kv-export":
+            out.append(f"  kv-export {ev.get('tokens')}tok -> "
+                       f"{ev.get('bytes', 0)}B bundle in "
+                       f"{(ev.get('seconds') or 0.0) * 1e3:.1f}ms "
+                       f"(donor, read-only)")
+        elif name == "kv-import":
+            landing = ("resident slot" if ev.get("resident")
+                       else "host entry")
+            out.append(f"  kv-import {ev.get('tokens')}tok <- "
+                       f"{ev.get('bytes', 0)}B bundle in "
+                       f"{(ev.get('seconds') or 0.0) * 1e3:.1f}ms "
+                       f"({landing})")
+    return out
+
+
 def rider_spans(t: Dict[str, Any]) -> List[str]:
     """Rider-chunk spans (stall-free hybrid steps): ``prefill-chunk``
     events with ``rider=True`` are this request's prefill slices that
@@ -299,6 +333,10 @@ def trace_breakdown(sources: List[Tuple[str, List[Dict]]],
                 lines.append(
                     f"{'':>24} failover: {ev.get('replica')} died "
                     f"after {ev.get('relayed')} relayed tokens")
+            elif name in ("router-migrate", "kv-export", "kv-import"):
+                for span in wire_migrate_spans(
+                        {"events": [ev]}):
+                    lines.append(f"{'':>24}{span}")
     return "\n".join(lines), 0
 
 
@@ -347,6 +385,11 @@ def timeline_view(t: Dict[str, Any]) -> str:
         lines.append("disaggregated serve (prefill and decode on "
                      "separate mesh slices):")
         lines.extend(migs)
+    wmigs = wire_migrate_spans(t)
+    if wmigs:
+        lines.append("fleet KV economy (cross-replica prefix "
+                     "migration over the wire):")
+        lines.extend(wmigs)
     if t.get("events_dropped"):
         lines.append(f"({t['events_dropped']} early events dropped from "
                      f"the per-request ring)")

@@ -184,6 +184,14 @@ def selftest() -> int:
     router_led.note_event("enqueue", guid=1, prompt_len=16,
                           trace_id=ctx.trace_id, hop=ctx.hop)
     router_led.note_event("admit", guid=1)
+    # fleet-KV migration decided before the route: the router's hop
+    # carries the decision, the donor replica's ledger carries the
+    # kv-export half on a synthetic (never-retired) timeline — both
+    # must graft into the assembled trace like the failover halves do
+    router_led.note_event("router-migrate", guid=1, donor="http://d",
+                          target="http://a", digest="deadbeef00112233",
+                          decision="migrate", bytes=33833,
+                          seconds=0.004)
     router_led.note_event("router-route", guid=1, replica="http://a",
                           affinity="new", route_s=0.001, score=1.0)
     router_led.note_event("commit", guid=1, tokens=1)
@@ -212,6 +220,14 @@ def selftest() -> int:
     led_a = replica_ledger(guid=1000001, tokens=3)   # dies mid-stream
     led_b = replica_ledger(guid=1000002, tokens=8)   # resumes
 
+    # donor replica: synthetic kv-export timeline (negative guid,
+    # stamped with the request's trace context, never retired)
+    led_d = RequestLedger(retired_capacity=8)
+    led_d.note_event("enqueue", guid=-1, prompt_len=32,
+                     trace_id=child.trace_id, hop=child.hop)
+    led_d.note_event("kv-export", guid=-1, tokens=32, bytes=33833,
+                     seconds=0.004, digest="deadbeef00112233")
+
     d = tempfile.mkdtemp(prefix="fftrace_selftest_")
     # replica A's half arrives from DISK (its process is "dead")
     a_path = os.path.join(d, "replica_a_ledger.json")
@@ -222,6 +238,8 @@ def selftest() -> int:
                + [("router", router_led.timelines_for_trace(
                    ctx.trace_id)),
                   ("http://b", led_b.timelines_for_trace(
+                      child.trace_id)),
+                  ("http://d", led_d.timelines_for_trace(
                       child.trace_id))])
     rc = assemble(sources, ctx.trace_id[:8], out_path)
     with open(out_path) as f:
@@ -233,11 +251,13 @@ def selftest() -> int:
     rc_list = assemble(sources, None, None)
     ok = (rc == 0 and rc_list == 0
           and trace["otherData"]["trace_id"] == ctx.trace_id
-          and len(pids) == 3                      # router + 2 replicas
-          and trace["otherData"]["timelines"] == 3
+          and len(pids) == 4              # router + 2 replicas + donor
+          and trace["otherData"]["timelines"] == 4
           and {"queue", "ttft", "stream"} <= names   # lifecycle spans
           and "router-failover" in names             # failover visible
           and "router-route" in names
+          and "router-migrate" in names    # fleet-KV decision visible
+          and "kv-export" in names         # donor hop grafted
           and all(e.get("ts", 0) >= 0 for e in evs))
     # cross-ledger ordering sanity: events are wall-aligned and sorted
     ts = [e["ts"] for e in evs if e.get("ph") != "M"]

@@ -74,6 +74,20 @@
  && env JAX_PLATFORMS=cpu python -m flexflow_tpu.serve.net --selftest \
     >/dev/null) \
  || { echo "serve.net wire/router selftest FAILED" >&2; exit 1; }
+# Fleet-KV loopback smoke: deterministic 2-process prefix-frame
+# migration over the wire — serve a prompt cold on spawned CPU replica
+# A (the retire donates the prefix into A's pool and A advertises the
+# digest in /v1/stats), export the frames over /v1/kv/export, import
+# the bundle into replica B over /v1/kv/import, then serve the SAME
+# prompt on B: B must score a prefix-pool match (hits counter > 0,
+# zero before) and stream byte-identical greedy tokens to A's cold
+# answer — so a broken export/import/adoption path fails CI before
+# the router's migration policy or a BENCH `fleetkv` round depends
+# on it.
+(cd "$(dirname "$0")/.." \
+ && env JAX_PLATFORMS=cpu python -m flexflow_tpu.serve.net \
+    --selftest-fleetkv >/dev/null) \
+ || { echo "serve.net fleet-KV loopback selftest FAILED" >&2; exit 1; }
 # Hybrid-step parity smoke (fast tier): the stall-free mixed-batch
 # dispatch (chunked prefill fused into decode dispatches,
 # serving/request_manager._hybrid_batch) must stay BIT-EXACT vs the
