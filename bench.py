@@ -329,6 +329,39 @@ _PAGER_CONF = {"enabled": False}
 # count, so a reader can see what it spans.
 _SLO_SECTIONS = {}
 
+# fleet-health stamp ({"section": label, **/v1/fleet/health payload}):
+# the `live` mode notes a fleet-of-one over its own history ring, the
+# `fleetkv` mode notes the migration router's view — persist_record
+# stamps it so tools/ffdash.py renders saved rounds, alerts included.
+_FLEET_HEALTH = None
+
+
+def _note_fleet_health(label, payload):
+    global _FLEET_HEALTH
+    if isinstance(payload, dict):
+        _FLEET_HEALTH = {"section": label, **payload}
+
+
+def _fleet_health_local(tail=60):
+    """Fleet-of-one health payload: the real FleetAggregator + default
+    burn-rate rules over THIS process's metrics-history ring (a local
+    bench is its own single replica), so live rounds carry the same
+    payload shape a router serves at /v1/fleet/health — fired alerts
+    and all."""
+    try:
+        from flexflow_tpu.observability import (AlertEngine,
+                                                FleetAggregator,
+                                                get_metrics_history)
+
+        rings = {"local": get_metrics_history()}
+        agg = FleetAggregator(stale_after_s=60.0)
+        engine = AlertEngine()
+        agg.merge(rings)
+        engine.evaluate(agg.history, rings)
+        return agg.health_snapshot(alerts=engine, tail=tail)
+    except Exception as e:    # partial installs must not kill bench
+        return {"error": str(e)}
+
 
 def _note_kv(im, mid, label):
     """Record a serving section's cache dtype, resident cache HBM and
@@ -2664,6 +2697,7 @@ def bench_live(model_builder=None, max_requests=8, max_seq_length=512,
         reports.append(asyncio.run(_run_profiles(
             im, mid, rm, traffic, [FAULT_PROFILES[name]]))[0])
     _note_kv(im, mid, "live")
+    _note_fleet_health("live", _fleet_health_local())
 
     # the headline is the FAULT-FREE profile wherever it sits in
     # fault_names (callers may reorder/subset); without one, the first
@@ -3008,6 +3042,10 @@ def bench_fleetkv(n_tenants=3, reqs_per_tenant=3, prefix_len=208,
             for reqs in tenants:
                 decisions.append(await router.migrate_prefix(
                     reqs[0], target))
+            # post-migration scrape refreshes the fleet plane, then
+            # the round record keeps the router's health view
+            await router.scrape_once()
+            _note_fleet_health("fleetkv", router.fleet_health(tail=60))
         return decisions
 
     async def _kill_round(b_url):
@@ -3668,6 +3706,11 @@ def persist_record(result, mode: str):
               # per-section started/done/aborted markers (the 0-progress
               # diagnosis surface — ffstat prints them)
               "sections": dict(_PROGRESS.get("sections") or {}),
+              # fleet-health stamp (live/fleetkv modes): the
+              # /v1/fleet/health payload incl. fired alerts, rendered
+              # from the saved round by tools/ffdash.py
+              **({"fleet_health": _FLEET_HEALTH} if _FLEET_HEALTH
+                 else {}),
               "metrics": metrics}
     if "step_latency_percentiles" in tel:
         # stdout (_slim) reuses THIS snapshot's percentiles so the

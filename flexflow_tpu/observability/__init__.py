@@ -28,6 +28,13 @@ structs stay as views; their values now also flow through here).
   :class:`SLOPolicy` attainment and goodput accounting, inspected by
   ``tools/ffreq.py`` and surfaced via ``serve.LLM.request_timelines()``
   / ``slo_report()``.
+- :class:`FleetAggregator` / :class:`AlertEngine` (fleet.py): the
+  fleet health plane — cross-replica federation of the router's
+  per-replica history rings per the schema's ``"agg"`` kinds, derived
+  fleet series + per-replica outlier scores, and declarative
+  multi-window SLO burn-rate alerting with alert-triggered diagnostic
+  bundle capture.  Served as ``/v1/fleet/health`` by the router and
+  rendered by ``tools/ffdash.py``.
 
 ``FF_TELEMETRY=0`` disables the default registry AND the flight
 recorder at import (both become no-ops; tracing stays explicit-opt-in
@@ -41,6 +48,8 @@ import os
 from .devprof import (CompileReport, DispatchProfiler,
                       calibrate_machine_profile, drift_table, get_devprof,
                       harvest_compile_report)
+from .fleet import (ALERT_RULE_SCHEMA, DEFAULT_ALERT_RULES, AlertEngine,
+                    FleetAggregator, validate_rule)
 from .flight_recorder import FlightRecorder, get_flight_recorder
 from .ledger import (RequestLedger, SLOPolicy, get_ledger,
                      slo_report_from, validate_slo_block)
@@ -61,6 +70,8 @@ __all__ = [
     "harvest_compile_report", "drift_table", "calibrate_machine_profile",
     "TraceContext", "TraceAssembler", "MetricsHistory",
     "get_metrics_history", "scalar_values",
+    "FleetAggregator", "AlertEngine", "validate_rule",
+    "DEFAULT_ALERT_RULES", "ALERT_RULE_SCHEMA",
     "METRICS_SCHEMA", "EVENT_SCHEMA", "EVENT_NAMES", "exp_buckets",
     "get_registry", "get_tracer", "get_flight_recorder", "get_heartbeat",
     "get_ledger", "slo_report_from", "validate_slo_block",

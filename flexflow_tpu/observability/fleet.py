@@ -1,0 +1,558 @@
+"""Fleet health plane: cross-replica metrics federation + SLO
+burn-rate alerting over the router's retained per-replica history
+rings.
+
+Every observability surface below this one is per-replica (registry,
+ledger, trace plane, devprof).  The router already scrapes ``/metrics``
+and retains a :class:`~..observability.traceplane.MetricsHistory` ring
+per replica; this module is the read-and-alarm half of the
+self-driving loop built on top of that retention:
+
+- :class:`FleetAggregator` merges the per-replica rings into fleet
+  time-series using the aggregation kind every metric declares in
+  ``schema.py`` (``"agg"``: counters sum, histograms bucket-merge —
+  their flattened series are all per-replica cumulative counts, so the
+  merge is a sum over equal keys — and each gauge declares
+  sum/max/last), derives the fleet headline series (goodput, SLO
+  attainment, KV frame headroom, cost-model drift) and scores every
+  replica's deviation from the fleet median (the outlier table a
+  placement policy or autoscaler reads before it acts).  Replicas whose
+  latest scrape is older than ``stale_after_s`` are EXCLUDED from the
+  merge and flagged ``stale`` instead of silently dragging sums down.
+
+- :class:`AlertEngine` evaluates declarative, schema-validated rules
+  with SRE-style multi-window burn-rate semantics: the FAST window
+  (~1m) and the SLOW window (~10m) must BOTH breach before a rule
+  fires — a fast-only breach is a blip, a slow-only breach is an old
+  incident already recovering — and a fired rule re-arms only after
+  the fast window recovers past the threshold by the rule's hysteresis
+  margin.  Transitions (never evaluations) tick
+  ``router_fleet_alerts_total{rule,state}`` and land ``fleet-alert``
+  recorder/ledger events; an ``on_fire`` hook lets the router pull the
+  offending replica's ``/v1/debug/bundle`` the moment a replica-scoped
+  rule opens.
+
+Both classes are near-zero-cost under ``FF_TELEMETRY=0``: every entry
+point starts with one ``registry.enabled`` attribute read and returns.
+State is guarded by an RLock (health snapshots ride watchdog bundles,
+which dump from signal handlers — fflint lock-discipline).
+
+Consumed by ``serve/net/router.py`` (scrape-loop evaluation +
+``/v1/fleet/health``), ``tools/ffdash.py`` (terminal rendering) and
+``bench.py`` (fleet-health stamps in ``live``/``fleetkv`` records).
+Documented in docs/OBSERVABILITY.md "Fleet health & alerting".
+"""
+
+from __future__ import annotations
+
+import statistics
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from .schema import METRICS_SCHEMA
+from .traceplane import MetricsHistory
+
+#: fleet aggregation vocabulary (must match the fflint metric-schema
+#: rule's AGG_KINDS — a metric cannot register without one of these)
+AGG_KINDS = ("sum", "max", "last", "histogram")
+
+#: outlier indicator metrics and their GOOD direction: +1 = higher is
+#: better (a replica BELOW the fleet median accrues deviation), -1 =
+#: lower is better.  Only bad-direction deviation scores — with two
+#: replicas both sit equally far from the median, and the healthy one
+#: must not be penalized for being better.
+OUTLIER_DIRECTIONS: Dict[str, int] = {
+    "serving_goodput_tokens_per_s": +1,
+    "serving_slo_attainment": +1,
+    "serving_slo_ttft_attainment": +1,
+    "serving_kv_frames_free": +1,
+    "serving_queue_depth": -1,
+}
+
+#: per-metric deviation scale floor (deviation = bad-direction delta /
+#: max(|median|, floor)): ratios deviate meaningfully at small absolute
+#: deltas, so their floor sits below the default.
+_OUTLIER_FLOOR: Dict[str, float] = {
+    "serving_slo_attainment": 0.25,
+    "serving_slo_ttft_attainment": 0.25,
+}
+
+#: headline series the /v1/fleet/health payload tails (beside every
+#: derived fleet_* series) — the full flattened key set (label splits,
+#: histogram buckets) stays queryable from the aggregator's ring but
+#: would bloat a health poll.
+HEALTH_SERIES = (
+    "serving_goodput_tokens_per_s",
+    "serving_slo_attainment",
+    "serving_queue_depth",
+    "serving_active_requests",
+    "serving_kv_frames_free",
+    "serving_net_active_streams",
+)
+
+
+def _registry_enabled() -> bool:
+    from . import get_registry
+
+    return get_registry().enabled
+
+
+def base_metric(series_key: str,
+                schema: Dict[str, Dict] = METRICS_SCHEMA) -> str:
+    """Flattened-series key -> owning schema metric: strip the
+    ``{labels}`` tag, then a histogram's ``_bucket/_sum/_count``
+    suffix when the stem is a declared histogram."""
+    name = series_key.split("{", 1)[0]
+    for suf in ("_bucket", "_sum", "_count"):
+        if name.endswith(suf):
+            stem = name[:-len(suf)]
+            if (schema.get(stem) or {}).get("type") == "histogram":
+                return stem
+    return name
+
+
+def agg_kind(series_key: str,
+             schema: Dict[str, Dict] = METRICS_SCHEMA) -> Optional[str]:
+    """The cross-replica merge rule for one flattened series key, or
+    None for keys outside the schema (derived/foreign series are never
+    merged blind).  Histogram series flatten to cumulative counts and
+    sums, so the declared ``histogram`` kind resolves to ``sum``."""
+    decl = schema.get(base_metric(series_key, schema))
+    if decl is None:
+        return None
+    kind = decl.get("agg")
+    return "sum" if kind == "histogram" else kind
+
+
+class FleetAggregator:
+    """Merges per-replica :class:`MetricsHistory` rings into fleet
+    time-series + a per-replica outlier table (see module docstring).
+
+    ``merge()`` is driven from the router's scrape loop; readers
+    (``/v1/fleet/health``, ffdash, bench stamps) call
+    :meth:`health_snapshot` / :meth:`series_tail`.
+    """
+
+    def __init__(self, schema: Optional[Dict[str, Dict]] = None,
+                 capacity: int = 512,
+                 stale_after_s: float = 10.0,
+                 outlier_threshold: float = 1.0):
+        self.schema = METRICS_SCHEMA if schema is None else schema
+        self.stale_after_s = max(0.1, float(stale_after_s))
+        self.outlier_threshold = float(outlier_threshold)
+        #: the fleet time-series ring (fed by merge(), never sampled)
+        self.history = MetricsHistory(capacity=capacity)
+        # RLock: health snapshots can ride watchdog bundles (signal
+        # handlers) while the scrape loop is mid-merge
+        self._lock = threading.RLock()
+        self._replicas: Dict[str, Dict[str, Any]] = {}
+        self._merges = 0
+
+    # ------------------------------------------------------------ merging
+    def merge(self, rings: Dict[str, MetricsHistory],
+              now: Optional[float] = None) -> Optional[Dict[str, float]]:
+        """Fold every replica's LATEST sample into one fleet sample,
+        append it to the fleet ring and refresh the outlier table.
+        Returns the merged value map (None when telemetry is disabled
+        — the near-zero-cost gate — or when no replica is fresh)."""
+        if not _registry_enabled():
+            return None
+        now = time.time() if now is None else float(now)
+        latest: Dict[str, Dict[str, float]] = {}
+        meta: Dict[str, Dict[str, Any]] = {}
+        for url, ring in rings.items():
+            snap = ring.snapshot(tail=1)
+            samples = snap.get("samples") or []
+            if not samples:
+                meta[url] = {"stale": True, "age_s": None,
+                             "last_scrape_wall": None}
+                continue
+            wall = float(samples[-1].get("wall", 0.0))
+            age = now - wall
+            stale = age > self.stale_after_s
+            meta[url] = {"stale": stale, "age_s": round(age, 3),
+                         "last_scrape_wall": wall}
+            if not stale:
+                latest[url] = samples[-1].get("values") or {}
+        merged = self._merge_values(latest)
+        if latest:
+            merged.update(self._derived(latest))
+        merged["fleet_replicas"] = float(len(latest))
+        merged["fleet_replicas_stale"] = float(
+            sum(1 for m in meta.values() if m["stale"]))
+        scores = self._outlier_scores(latest)
+        for url, m in meta.items():
+            sc = scores.get(url, {"score": 0.0, "deviations": {}})
+            m["outlier_score"] = round(sc["score"], 4)
+            m["outlier"] = sc["score"] >= self.outlier_threshold
+            m["deviations"] = sc["deviations"]
+        if latest:
+            self.history.append(merged, wall=now)
+        with self._lock:
+            self._replicas = meta
+            self._merges += 1
+        return merged if latest else None
+
+    def _merge_values(self, latest: Dict[str, Dict[str, float]]
+                      ) -> Dict[str, float]:
+        out: Dict[str, float] = {}
+        kinds: Dict[str, Optional[str]] = {}
+        counts: Dict[str, int] = {}
+        for values in latest.values():
+            for key, v in values.items():
+                kind = kinds.get(key)
+                if kind is None and key not in kinds:
+                    kind = kinds[key] = agg_kind(key, self.schema)
+                if kind is None:
+                    continue
+                if key not in out:
+                    out[key] = float(v)
+                    counts[key] = 1
+                elif kind == "max":
+                    out[key] = max(out[key], float(v))
+                else:           # sum now; "last" divides by count below
+                    out[key] += float(v)
+                    counts[key] += 1
+        for key, kind in kinds.items():
+            # "last" gauges (ratios/levels where neither sum nor max
+            # means anything fleet-wide) keep the cross-replica mean
+            if kind == "last" and key in out and counts[key] > 1:
+                out[key] /= counts[key]
+        return out
+
+    def _derived(self, latest: Dict[str, Dict[str, float]]
+                 ) -> Dict[str, float]:
+        def col(name: str) -> List[float]:
+            return [v[name] for v in latest.values() if name in v]
+
+        out: Dict[str, float] = {}
+        goodput = col("serving_goodput_tokens_per_s")
+        if goodput:
+            out["fleet_goodput_tokens_per_s"] = sum(goodput)
+        att = col("serving_slo_attainment")
+        if att:
+            out["fleet_slo_attainment"] = sum(att) / len(att)
+        free, total = (col("serving_kv_frames_free"),
+                       col("serving_kv_frames_total"))
+        if total and sum(total) > 0:
+            out["fleet_kv_frame_headroom"] = sum(free) / sum(total)
+        drift = col("serving_costmodel_drift_ratio")
+        if drift:
+            out["fleet_costmodel_drift"] = sum(drift) / len(drift)
+        return out
+
+    def _outlier_scores(self, latest: Dict[str, Dict[str, float]]
+                        ) -> Dict[str, Dict[str, Any]]:
+        scores = {url: {"score": 0.0, "deviations": {}}
+                  for url in latest}
+        if len(latest) < 2:
+            return scores
+        for metric, direction in OUTLIER_DIRECTIONS.items():
+            vals = {url: values[metric]
+                    for url, values in latest.items() if metric in values}
+            if len(vals) < 2:
+                continue
+            med = statistics.median(vals.values())
+            scale = max(abs(med), _OUTLIER_FLOOR.get(metric, 1.0))
+            for url, v in vals.items():
+                dev = (med - v) if direction > 0 else (v - med)
+                if dev > 0:
+                    d = dev / scale
+                    scores[url]["deviations"][metric] = round(d, 4)
+                    scores[url]["score"] += d
+        return scores
+
+    # ------------------------------------------------------------- reading
+    def replica_table(self) -> Dict[str, Dict[str, Any]]:
+        """The latest per-replica staleness + outlier table."""
+        with self._lock:
+            return {url: dict(m) for url, m in self._replicas.items()}
+
+    def series_tail(self, names: Optional[List[str]] = None,
+                    tail: int = 120) -> Dict[str, List[List[float]]]:
+        """``{name: [[wall, value], ...]}`` tails of the fleet ring —
+        default: every derived ``fleet_*`` series plus the
+        ``HEALTH_SERIES`` headliners that have samples."""
+        snap = self.history.snapshot(tail=tail)
+        samples = snap.get("samples") or []
+        if names is None:
+            seen: Dict[str, None] = {}
+            for s in samples:
+                for k in s.get("values", {}):
+                    if k.startswith("fleet_") or k in HEALTH_SERIES:
+                        seen[k] = None
+            names = list(seen)
+        out: Dict[str, List[List[float]]] = {}
+        for name in names:
+            pts = [[s["wall"], s["values"][name]] for s in samples
+                   if name in s.get("values", {})]
+            if pts:
+                out[name] = pts
+        return out
+
+    def health_snapshot(self, alerts: Optional["AlertEngine"] = None,
+                        tail: int = 120) -> Dict[str, Any]:
+        """The ``/v1/fleet/health`` payload (also stamped into bench
+        records and rendered by tools/ffdash.py): fleet series tails,
+        the per-replica outlier/staleness table and — when an engine
+        is attached — active alerts + recent transitions."""
+        with self._lock:
+            merges = self._merges
+        payload: Dict[str, Any] = {
+            "time_unix": time.time(),
+            "stale_after_s": self.stale_after_s,
+            "merges": merges,
+            "replicas": self.replica_table(),
+            "fleet": {"series": self.series_tail(tail=tail)},
+        }
+        if alerts is not None:
+            payload["alerts"] = {"active": alerts.active(),
+                                 "recent": alerts.recent()}
+        return payload
+
+
+# ---------------------------------------------------------------- alerting
+#: the declarative rule schema: field -> (required, validator).  A rule
+#: is a plain dict; validate_rule() normalizes it (defaults applied)
+#: or raises ValueError — the engine refuses un-validatable rules at
+#: construction, never at evaluation time.
+ALERT_RULE_SCHEMA: Dict[str, Tuple[bool, Callable[[Any], bool]]] = {
+    "name": (True, lambda v: isinstance(v, str) and v != ""),
+    "metric": (True, lambda v: isinstance(v, str) and v != ""),
+    "scope": (True, lambda v: v in ("fleet", "replica")),
+    "kind": (True, lambda v: v in ("below", "above")),
+    "threshold": (True, lambda v: isinstance(v, (int, float))),
+    "fast_window_s": (True, lambda v: isinstance(v, (int, float))
+                      and v > 0),
+    "slow_window_s": (True, lambda v: isinstance(v, (int, float))
+                      and v > 0),
+    "rearm_margin": (False, lambda v: isinstance(v, (int, float))
+                     and v >= 0),
+    "capture": (False, lambda v: isinstance(v, bool)),
+    "help": (False, lambda v: isinstance(v, str)),
+}
+
+
+def validate_rule(rule: Dict[str, Any]) -> Dict[str, Any]:
+    """Validate one alert rule against :data:`ALERT_RULE_SCHEMA` and
+    return the normalized copy (defaults filled).  Raises ValueError
+    naming the offending field — a mistyped rule fails loudly at
+    engine construction, not silently at 3am."""
+    if not isinstance(rule, dict):
+        raise ValueError(f"alert rule must be a dict, got {type(rule)}")
+    unknown = set(rule) - set(ALERT_RULE_SCHEMA)
+    if unknown:
+        raise ValueError(f"alert rule {rule.get('name')!r}: unknown "
+                         f"fields {sorted(unknown)}")
+    out = dict(rule)
+    for field, (required, ok) in ALERT_RULE_SCHEMA.items():
+        if field not in out:
+            if required:
+                raise ValueError(f"alert rule {rule.get('name')!r}: "
+                                 f"missing required field {field!r}")
+            continue
+        if not ok(out[field]):
+            raise ValueError(f"alert rule {rule.get('name')!r}: "
+                             f"invalid {field!r}: {out[field]!r}")
+    if out["slow_window_s"] < out["fast_window_s"]:
+        raise ValueError(f"alert rule {out['name']!r}: slow window "
+                         f"shorter than fast window")
+    out.setdefault("rearm_margin", 0.0)
+    # replica-scoped rules default to capturing the offender's bundle
+    out.setdefault("capture", out["scope"] == "replica")
+    return out
+
+
+#: the stock rule set: SLO burn at replica and fleet scope, plus fleet
+#: frame-headroom exhaustion.  Thresholds are workload-independent
+#: ratios; absolute-valued rules (goodput floors, queue ceilings) are
+#: deployment-specific and belong to the caller.
+DEFAULT_ALERT_RULES: Tuple[Dict[str, Any], ...] = (
+    {"name": "replica-slo-burn", "metric": "serving_slo_attainment",
+     "scope": "replica", "kind": "below", "threshold": 0.9,
+     "fast_window_s": 60.0, "slow_window_s": 600.0,
+     "rearm_margin": 0.02,
+     "help": "one replica is burning its SLO error budget in both "
+             "windows — capture its bundle and look for the stall"},
+    {"name": "fleet-slo-burn", "metric": "fleet_slo_attainment",
+     "scope": "fleet", "kind": "below", "threshold": 0.9,
+     "fast_window_s": 60.0, "slow_window_s": 600.0,
+     "rearm_margin": 0.02,
+     "help": "the FLEET is missing SLO — capacity, not one replica"},
+    {"name": "fleet-frame-headroom",
+     "metric": "fleet_kv_frame_headroom",
+     "scope": "fleet", "kind": "below", "threshold": 0.05,
+     "fast_window_s": 60.0, "slow_window_s": 600.0,
+     "rearm_margin": 0.02,
+     "help": "fleet-wide KV frame pool nearly exhausted — admission "
+             "is about to block everywhere at once"},
+)
+
+
+class AlertEngine:
+    """Multi-window burn-rate alerting over fleet + per-replica series
+    (see module docstring for the fire/re-arm semantics).
+
+    ``on_fire(rule, scope_key, info)`` runs after a firing transition
+    commits, outside the engine lock — the router's bundle-capture
+    hook.  Exceptions in the hook are swallowed: a broken capture path
+    must not wedge alert evaluation.
+    """
+
+    def __init__(self, rules: Optional[List[Dict[str, Any]]] = None,
+                 on_fire: Optional[Callable[
+                     [Dict[str, Any], str, Dict[str, Any]], None]] = None,
+                 recent_capacity: int = 64):
+        source = DEFAULT_ALERT_RULES if rules is None else rules
+        self.rules = [validate_rule(r) for r in source]
+        names = [r["name"] for r in self.rules]
+        if len(set(names)) != len(names):
+            raise ValueError(f"duplicate alert rule names: {names}")
+        self.on_fire = on_fire
+        self._lock = threading.RLock()
+        self._states: Dict[Tuple[str, str], Dict[str, Any]] = {}
+        self._recent: List[Dict[str, Any]] = []
+        self._recent_cap = max(1, int(recent_capacity))
+
+    # ---------------------------------------------------------- evaluation
+    @staticmethod
+    def _window_mean(ring: MetricsHistory, metric: str,
+                     window_s: float, now: float) -> Optional[float]:
+        pts = [v for wall, v in ring.series(metric)
+               if wall >= now - window_s]
+        if not pts:
+            return None
+        return sum(pts) / len(pts)
+
+    def evaluate(self, fleet_history: MetricsHistory,
+                 replica_histories: Dict[str, MetricsHistory],
+                 now: Optional[float] = None) -> List[Dict[str, Any]]:
+        """One evaluation pass over every rule x scope.  Returns the
+        transitions that happened (also retained in :meth:`recent`).
+        No-op under disabled telemetry."""
+        if not _registry_enabled():
+            return []
+        now = time.time() if now is None else float(now)
+        transitions: List[Dict[str, Any]] = []
+        fired: List[Tuple[Dict[str, Any], str, Dict[str, Any]]] = []
+        for rule in self.rules:
+            if rule["scope"] == "fleet":
+                scopes: List[Tuple[str, MetricsHistory]] = [
+                    ("fleet", fleet_history)]
+            else:
+                scopes = sorted(replica_histories.items())
+            for scope_key, ring in scopes:
+                t = self._evaluate_one(rule, scope_key, ring, now)
+                if t is not None:
+                    transitions.append(t)
+                    if t["state"] == "firing":
+                        fired.append((rule, scope_key, t))
+        for rule, scope_key, info in fired:
+            self._emit(rule, scope_key, info)
+            if self.on_fire is not None and rule.get("capture"):
+                try:
+                    self.on_fire(rule, scope_key, info)
+                except Exception:
+                    pass
+        for t in transitions:
+            if t["state"] == "resolved":
+                self._emit_resolved(t)
+        return transitions
+
+    def _evaluate_one(self, rule: Dict[str, Any], scope_key: str,
+                      ring: MetricsHistory,
+                      now: float) -> Optional[Dict[str, Any]]:
+        fast = self._window_mean(ring, rule["metric"],
+                                 rule["fast_window_s"], now)
+        slow = self._window_mean(ring, rule["metric"],
+                                 rule["slow_window_s"], now)
+        below = rule["kind"] == "below"
+        thr = float(rule["threshold"])
+
+        def breach(v: Optional[float]) -> bool:
+            return v is not None and (v < thr if below else v > thr)
+
+        key = (rule["name"], scope_key)
+        with self._lock:
+            st = self._states.setdefault(
+                key, {"state": "ok", "since": None,
+                      "fast": None, "slow": None})
+            st["fast"], st["slow"] = fast, slow
+            transition: Optional[str] = None
+            if st["state"] == "ok":
+                # BOTH windows must burn before the rule opens
+                if breach(fast) and breach(slow):
+                    st["state"], st["since"] = "firing", now
+                    transition = "firing"
+            else:
+                # hysteresis: only a fast-window recovery past the
+                # re-arm margin closes the alert (the slow window keeps
+                # burning long after the incident ends by construction)
+                margin = float(rule["rearm_margin"])
+                recovered = (fast is not None
+                             and (fast >= thr + margin if below
+                                  else fast <= thr - margin))
+                if recovered:
+                    st["state"], st["since"] = "ok", None
+                    transition = "resolved"
+            if transition is None:
+                return None
+            info = {"rule": rule["name"], "scope": scope_key,
+                    "metric": rule["metric"], "state": transition,
+                    "kind": rule["kind"], "threshold": thr,
+                    "fast": fast, "slow": slow, "wall": now,
+                    "capture": bool(rule.get("capture"))}
+            self._recent.append(info)
+            del self._recent[:-self._recent_cap]
+        return info
+
+    def _emit(self, rule: Dict[str, Any], scope_key: str,
+              info: Dict[str, Any]) -> None:
+        from . import get_registry
+        from .flight_recorder import get_flight_recorder
+        from .ledger import get_ledger
+
+        get_registry().counter("router_fleet_alerts_total").inc(
+            rule=rule["name"], state="firing")
+        get_flight_recorder().record_event(
+            "fleet-alert", rule=rule["name"], scope=scope_key,
+            state="firing", fast=info["fast"], slow=info["slow"],
+            threshold=info["threshold"])
+        get_ledger().note_event(
+            "fleet-alert", rule=rule["name"], scope=scope_key,
+            state="firing", threshold=info["threshold"])
+
+    def _emit_resolved(self, info: Dict[str, Any]) -> None:
+        from . import get_registry
+        from .flight_recorder import get_flight_recorder
+
+        get_registry().counter("router_fleet_alerts_total").inc(
+            rule=info["rule"], state="resolved")
+        get_flight_recorder().record_event(
+            "fleet-alert", rule=info["rule"], scope=info["scope"],
+            state="resolved", fast=info["fast"], slow=info["slow"],
+            threshold=info["threshold"])
+
+    # ------------------------------------------------------------- reading
+    def active(self) -> List[Dict[str, Any]]:
+        """Currently-firing alerts (rule, scope, since, latest window
+        values)."""
+        out: List[Dict[str, Any]] = []
+        with self._lock:
+            for (name, scope), st in sorted(self._states.items()):
+                if st["state"] != "firing":
+                    continue
+                rule = next(r for r in self.rules if r["name"] == name)
+                out.append({"rule": name, "scope": scope,
+                            "metric": rule["metric"],
+                            "kind": rule["kind"],
+                            "threshold": rule["threshold"],
+                            "since": st["since"],
+                            "fast": st["fast"], "slow": st["slow"]})
+        return out
+
+    def recent(self) -> List[Dict[str, Any]]:
+        """Recent transitions, oldest first (bounded ring)."""
+        with self._lock:
+            return [dict(t) for t in self._recent]

@@ -14,9 +14,19 @@ FlightRecorder (always-on post-mortem ring): the recorder refuses
 undeclared names at runtime and the fflint rule checks
 ``record_event(...)`` call sites.
 
-Schema entry: name -> {"type": counter|gauge|histogram, "help": str,
-optional "buckets": tuple} — histograms default to the registry's fixed
-exponential ladder when "buckets" is absent.
+Schema entry: name -> {"type": counter|gauge|histogram, "agg":
+sum|max|last|histogram, "help": str, optional "buckets": tuple} —
+histograms default to the registry's fixed exponential ladder when
+"buckets" is absent.  "agg" declares how the fleet aggregator
+(observability/fleet.py) merges the metric across replicas: counters
+sum, histograms bucket-merge, and each gauge declares sum (additive
+level — queue depths, free frames, goodput), max (identical-per-replica
+value where max dedups — compiled-step cost reports) or last
+(a ratio/level where neither sum nor max means anything fleet-wide —
+attainment, drift; the fleet series keeps the cross-replica mean and
+the per-replica values feed the outlier score instead).  The fflint
+metric-schema rule errors on a registered metric whose declaration
+lacks a valid "agg", so a new metric cannot ship unmergeable.
 """
 
 from __future__ import annotations
@@ -32,6 +42,7 @@ METRICS_SCHEMA = {
     # ---------------------------------------------------- host round trips
     "serving_host_syncs_total": {
         "type": "counter",
+        "agg": "sum",
         "help": "Host<->device round trips (step results materialized to "
                 "numpy).  The serving path's key overhead metric on a "
                 "network-attached chip; mirrors the per-InferenceManager "
@@ -40,6 +51,7 @@ METRICS_SCHEMA = {
     # ------------------------------------------------------- kernel paths
     "serving_kernel_path_total": {
         "type": "counter",
+        "agg": "sum",
         "help": "Attention-kernel dispatch decisions, labeled "
                 "phase=decode|prefill, path=flash|xla, "
                 "reason=forced|path_gate|cost_model and cache=int4|int8|fp "
@@ -52,49 +64,59 @@ METRICS_SCHEMA = {
     # --------------------------------------------------- request lifecycle
     "serving_requests_admitted_total": {
         "type": "counter",
+        "agg": "sum",
         "help": "Requests admitted from the pending queue into batch rows.",
     },
     "serving_requests_retired_total": {
         "type": "counter",
+        "agg": "sum",
         "help": "Requests retired (EOS or length budget).",
     },
     "serving_tokens_generated_total": {
         "type": "counter",
+        "agg": "sum",
         "help": "Generated (non-prompt) tokens committed across requests.",
     },
     "serving_queue_depth": {
         "type": "gauge",
+        "agg": "sum",
         "help": "Pending (not yet admitted) requests after the latest "
                 "admission pass.",
     },
     "serving_active_requests": {
         "type": "gauge",
+        "agg": "sum",
         "help": "Requests currently occupying batch rows.",
     },
     "serving_batch_occupancy": {
         "type": "gauge",
+        "agg": "last",
         "help": "Active rows / max_requests_per_batch at the latest "
                 "scheduled step (the continuous-batching fill factor).",
     },
     # ----------------------------------------------------------- latencies
     "serving_ttft_seconds": {
         "type": "histogram",
+        "agg": "histogram",
         "help": "Host-observed time to first generated token per request "
                 "(monotonic-clock deltas; observed at retirement).",
     },
     "serving_tpot_seconds": {
         "type": "histogram",
+        "agg": "histogram",
         "help": "Time per output token after the first (decode-phase "
                 "inter-token latency), per retired request.",
     },
     "serving_step_latency_seconds": {
         "type": "histogram",
+        "agg": "histogram",
         "help": "Wall time of one driver-loop step (dispatch + any host "
                 "sync).  A decode block counts as one step committing K "
                 "tokens; see serving_step_tokens for the per-step yield.",
     },
     "serving_step_tokens": {
         "type": "histogram",
+        "agg": "histogram",
         "help": "Tokens committed per driver-loop step, summed across "
                 "batch rows (rows completing a prompt for single-step "
                 "syncs, the folded block yield for fused decode blocks, "
@@ -103,6 +125,7 @@ METRICS_SCHEMA = {
     },
     "serving_prefill_chunk_tokens": {
         "type": "histogram",
+        "agg": "histogram",
         "help": "Chunk sizes (tokens per row) of scheduled prefill steps.",
         "buckets": TOKEN_BUCKETS,
     },
@@ -111,6 +134,7 @@ METRICS_SCHEMA = {
     # dispatches — request_manager._hybrid_batch / _dispatch_hybrid)
     "serving_hybrid_steps_total": {
         "type": "counter",
+        "agg": "sum",
         "help": "Mixed-batch (decode rows + prefilling rows) steps by "
                 "dispatch mode: mode=hybrid (ONE fused dispatch — the "
                 "full decode batch at the 1-token path plus a roofline-"
@@ -121,6 +145,7 @@ METRICS_SCHEMA = {
     },
     "serving_hybrid_rider_tokens": {
         "type": "histogram",
+        "agg": "histogram",
         "help": "Prefill tokens riding each hybrid step (summed across "
                 "rider rows; the roofline budget caps them so the "
                 "decode rows' TPOT holds — "
@@ -130,16 +155,19 @@ METRICS_SCHEMA = {
     # -------------------------------------------------------- speculation
     "serving_spec_draft_tokens_total": {
         "type": "counter",
+        "agg": "sum",
         "help": "Speculative tokens proposed by SSM drafts (profile "
                 "speculated_tokens, summed at retirement).",
     },
     "serving_spec_accepted_tokens_total": {
         "type": "counter",
+        "agg": "sum",
         "help": "Speculated tokens accepted by tree verification "
                 "(profile accepted_tokens, summed at retirement).",
     },
     "serving_spec_acceptance_rate": {
         "type": "histogram",
+        "agg": "histogram",
         "help": "Per-request accepted/speculated ratio, observed at "
                 "retirement (matches distill.measured_acceptance over "
                 "the same requests).",
@@ -147,6 +175,7 @@ METRICS_SCHEMA = {
     },
     "serving_spec_verify_tokens": {
         "type": "histogram",
+        "agg": "histogram",
         "help": "Verify-batch tree sizes (tokens per row fed to the "
                 "tree-verify step).",
         "buckets": TOKEN_BUCKETS,
@@ -154,39 +183,47 @@ METRICS_SCHEMA = {
     # ------------------------------------------------------- prefix cache
     "serving_prefix_lookups_total": {
         "type": "counter",
+        "agg": "sum",
         "help": "Prefix-pool lookups at admission (PrefixCacheStats "
                 "re-emission).",
     },
     "serving_prefix_hits_total": {
         "type": "counter",
+        "agg": "sum",
         "help": "Prefix-pool lookups that matched a usable pooled prefix.",
     },
     "serving_prefix_tokens_matched_total": {
         "type": "counter",
+        "agg": "sum",
         "help": "Prompt tokens served from the prefix pool (prefill "
                 "skipped).",
     },
     "serving_prefix_tokens_prompt_total": {
         "type": "counter",
+        "agg": "sum",
         "help": "Total prompt tokens admitted while the prefix pool was "
                 "on (denominator of tokens-saved).",
     },
     "serving_prefix_donations_total": {
         "type": "counter",
+        "agg": "sum",
         "help": "Retired rows donated to the prefix pool.",
     },
     "serving_prefix_donations_rejected_total": {
         "type": "counter",
+        "agg": "sum",
         "help": "Donations rejected (redundant prefix / pool full of "
                 "referenced entries).",
     },
     "serving_prefix_evictions_total": {
         "type": "counter",
+        "agg": "sum",
         "help": "Pool entries evicted (LRU reclaim or supersede).",
     },
     # -------------------------------------------------------- KV cache
     "serving_kv_cache_bytes_resident": {
         "type": "gauge",
+        "agg": "sum",
         "help": "HBM pinned by a compiled record's KV caches (K + V + "
                 "scales at the padded allocation), labeled model=<id>.",
     },
@@ -195,12 +232,14 @@ METRICS_SCHEMA = {
     # spill + preemptive scheduling over the dense cache rows)
     "serving_kv_pages_total": {
         "type": "gauge",
+        "agg": "sum",
         "help": "Page budget of the KV pager (pages of page_len "
                 "committed-KV positions the scheduler may lease "
                 "across rows + resident prefix-pool entries).",
     },
     "serving_kv_pages_free": {
         "type": "gauge",
+        "agg": "sum",
         "help": "Unleased pages in the KV pager's budget (clamped at "
                 "0 while forced decode-block growth overcommits; the "
                 "overage is trued up by preemption at the next fold "
@@ -208,6 +247,7 @@ METRICS_SCHEMA = {
     },
     "serving_kv_spill_bytes_total": {
         "type": "counter",
+        "agg": "sum",
         "help": "KV bytes fetched device->host by preemption spills "
                 "and prefix-pool page spills (bucketed transfers "
                 "outside the jitted steps; int8 caches spill at ~half "
@@ -215,12 +255,14 @@ METRICS_SCHEMA = {
     },
     "serving_kv_restore_bytes_total": {
         "type": "counter",
+        "agg": "sum",
         "help": "KV bytes restored host->device at re-admission "
                 "(device_put + the jitted donated row write, "
                 "InferenceManager.restore_row).",
     },
     "serving_kv_frames_total": {
         "type": "gauge",
+        "agg": "sum",
         "help": "Physical frames in a paged record's global KV frame "
                 "pool ([num_frames, KV, page_len, D] per layer; the "
                 "page tables index this axis).  Set by a KVPager "
@@ -229,6 +271,7 @@ METRICS_SCHEMA = {
     },
     "serving_kv_frames_free": {
         "type": "gauge",
+        "agg": "sum",
         "help": "Frames on the physical pager's free list (distinct "
                 "from serving_kv_pages_free: the page BUDGET may sit "
                 "below the physical pool — the surplus is the forced-"
@@ -236,6 +279,7 @@ METRICS_SCHEMA = {
     },
     "serving_prefix_frames_shared_total": {
         "type": "counter",
+        "agg": "sum",
         "help": "Whole KV frames leased by refcount from a prefix-pool "
                 "donor at admission instead of device-copied (paged "
                 "records; saved bytes = count x frame bytes of the "
@@ -244,6 +288,7 @@ METRICS_SCHEMA = {
     # ------------------------------------------- disaggregated serving
     "serving_migrations_total": {
         "type": "counter",
+        "agg": "sum",
         "help": "Prefill->decode slice handoffs under disaggregated "
                 "serving (serving/disagg.py), labeled decision=migrate "
                 "(whole-frame KV transfer over the device link) | "
@@ -254,12 +299,14 @@ METRICS_SCHEMA = {
     },
     "serving_migration_bytes_total": {
         "type": "counter",
+        "agg": "sum",
         "help": "KV cache bytes moved between mesh slices by frame "
                 "migration (decision=migrate handoffs; int8 payloads "
                 "include their f32 scale frames).",
     },
     "serving_migration_seconds": {
         "type": "histogram",
+        "agg": "histogram",
         "help": "Wall time of one whole-request KV migration (source "
                 "fetch + destination lease/table push + restore) — the "
                 "victim-TTFT component disaggregation adds, and what "
@@ -268,6 +315,7 @@ METRICS_SCHEMA = {
     },
     "serving_preemptions_total": {
         "type": "counter",
+        "agg": "sum",
         "help": "Requests preempted by the KV pager, labeled "
                 "reason=pages (lease growth exhausted the budget) | "
                 "admission (pressure-aware scheduler freed a row/pages "
@@ -278,6 +326,7 @@ METRICS_SCHEMA = {
     },
     "serving_admission_blocked_total": {
         "type": "counter",
+        "agg": "sum",
         "help": "Admission passes that left the queue head waiting, "
                 "labeled reason=no_rows|no_pages — counted once per "
                 "(request, reason) transition, not per retry, so the "
@@ -292,6 +341,7 @@ METRICS_SCHEMA = {
     # over the blocking driver loops — docs/SERVING.md)
     "serving_cancellations_total": {
         "type": "counter",
+        "agg": "sum",
         "help": "Requests cancelled before natural retirement "
                 "(RequestManager.cancel_request), labeled reason="
                 "deadline (SLO-derived per-request deadline expired "
@@ -309,6 +359,7 @@ METRICS_SCHEMA = {
     },
     "serving_shed_total": {
         "type": "counter",
+        "agg": "sum",
         "help": "Requests dropped by the front-end's load-shed policy "
                 "under overload, labeled reason=hopeless (remaining "
                 "deadline budget < estimated remaining service time — "
@@ -321,6 +372,7 @@ METRICS_SCHEMA = {
     },
     "serving_rejected_total": {
         "type": "counter",
+        "agg": "sum",
         "help": "Intake submissions rejected before enqueue, labeled "
                 "reason=backpressure (pending deque at the intake "
                 "watermark — the client got Overloaded with a "
@@ -333,22 +385,26 @@ METRICS_SCHEMA = {
     # together at each retirement over the retired-request window)
     "serving_slo_attainment": {
         "type": "gauge",
+        "agg": "last",
         "help": "Fraction of retired requests meeting EVERY configured "
                 "SLO component (TTFT and TPOT targets), over the "
                 "ledger's retired window.",
     },
     "serving_slo_ttft_attainment": {
         "type": "gauge",
+        "agg": "last",
         "help": "Fraction of retired requests whose admit->first-token "
                 "latency met the SLOPolicy ttft_s target.",
     },
     "serving_slo_tpot_attainment": {
         "type": "gauge",
+        "agg": "last",
         "help": "Fraction of retired requests whose mean inter-token "
                 "gap met the SLOPolicy tpot_s target.",
     },
     "serving_goodput_tokens_per_s": {
         "type": "gauge",
+        "agg": "sum",
         "help": "Tokens from SLO-attaining retired requests per second "
                 "of the retired window (first admit -> last retire) — "
                 "the ROADMAP async-serving headline: throughput that "
@@ -359,9 +415,11 @@ METRICS_SCHEMA = {
     # front-end — docs/SERVING.md "Wire protocol & router")
     "serving_net_requests_total": {
         "type": "counter",
+        "agg": "sum",
         "help": "HTTP requests served by the wire front-end, labeled "
                 "endpoint=generate|cancel|health|stats|timelines|"
-                "history|metrics|other "
+                "history|metrics|kv_export|kv_import|debug_bundle|"
+                "fleet_health|other "
                 "and code=<http status>.  endpoint=generate with "
                 "code=429 is the Overloaded/backpressure class (the "
                 "body carries retry_after_s and the response a "
@@ -369,11 +427,13 @@ METRICS_SCHEMA = {
     },
     "serving_net_active_streams": {
         "type": "gauge",
+        "agg": "sum",
         "help": "SSE token streams currently open on the wire server "
                 "(connected generate clients mid-stream).",
     },
     "serving_net_stream_tokens_total": {
         "type": "counter",
+        "agg": "sum",
         "help": "Tokens framed as SSE `token` events onto client "
                 "sockets (after any skip_tokens router-resume "
                 "suppression; compare serving_tokens_generated_total "
@@ -381,6 +441,7 @@ METRICS_SCHEMA = {
     },
     "serving_net_disconnects_total": {
         "type": "counter",
+        "agg": "sum",
         "help": "Client sockets that closed mid-stream (read-EOF or "
                 "write failure while tokens were flowing).  Each one "
                 "also ticks serving_cancellations_total{reason="
@@ -389,6 +450,7 @@ METRICS_SCHEMA = {
     },
     "serving_net_request_seconds": {
         "type": "histogram",
+        "agg": "histogram",
         "help": "Wall time of one wire request from head-parse to "
                 "response flush (generate requests span the whole SSE "
                 "stream — the wire-side latency envelope the bench "
@@ -399,6 +461,7 @@ METRICS_SCHEMA = {
     # context — X-FFServe-Trace — and cross-replica timeline assembly)
     "serving_trace_hops_total": {
         "type": "counter",
+        "agg": "sum",
         "help": "Trace contexts adopted by this process, labeled "
                 "source=wire (an X-FFServe-Trace header arrived with "
                 "the submit — this hop joins an existing distributed "
@@ -412,6 +475,7 @@ METRICS_SCHEMA = {
     # N wire servers, scored from scraped /metrics)
     "router_route_seconds": {
         "type": "histogram",
+        "agg": "histogram",
         "help": "Wall time of one routing decision: submit arrival at "
                 "the router to a replica ACCEPTING the upstream "
                 "submit, including the candidate retry walk past "
@@ -421,6 +485,7 @@ METRICS_SCHEMA = {
     },
     "router_requests_total": {
         "type": "counter",
+        "agg": "sum",
         "help": "Requests the router accepted for routing, labeled "
                 "outcome=completed (done event relayed) | failed "
                 "(retries exhausted or non-retriable transport error) "
@@ -429,6 +494,7 @@ METRICS_SCHEMA = {
     },
     "router_failovers_total": {
         "type": "counter",
+        "agg": "sum",
         "help": "Mid-request replica failovers: the upstream socket "
                 "died before a `done` event, and the router resubmitted "
                 "to another replica with skip_tokens set to the count "
@@ -437,6 +503,7 @@ METRICS_SCHEMA = {
     },
     "router_affinity_total": {
         "type": "counter",
+        "agg": "sum",
         "help": "Prefix-affinity routing decisions, labeled outcome="
                 "hit (request followed its prefix-hash map entry to "
                 "the replica already holding the tenant's frames) | "
@@ -446,6 +513,7 @@ METRICS_SCHEMA = {
     },
     "router_replica_score": {
         "type": "gauge",
+        "agg": "last",
         "help": "Latest load-balance score per replica (labeled "
                 "replica=<url>): normalized serving_goodput_tokens_"
                 "per_s + frames-free headroom - queue depth, from the "
@@ -453,6 +521,7 @@ METRICS_SCHEMA = {
     },
     "router_circuit_open_total": {
         "type": "counter",
+        "agg": "sum",
         "help": "Circuit-breaker trips, labeled replica=<url>: a "
                 "transport failure marked the replica dead and "
                 "routing excludes it until the cooldown expires.",
@@ -463,6 +532,7 @@ METRICS_SCHEMA = {
     # substrate for BENCH chip rounds and cost-model calibration)
     "serving_compiled_flops": {
         "type": "gauge",
+        "agg": "max",
         "help": "XLA cost_analysis FLOPs of one compiled serving step "
                 "(labeled model=<id>, step=<step-cache key>) — "
                 "harvested at the AOT compile site in "
@@ -471,6 +541,7 @@ METRICS_SCHEMA = {
     },
     "serving_compiled_bytes_accessed": {
         "type": "gauge",
+        "agg": "max",
         "help": "XLA cost_analysis HBM bytes accessed per invocation "
                 "of one compiled serving step (labeled model=<id>, "
                 "step=<key>) — the bandwidth-bound roofline numerator; "
@@ -479,6 +550,7 @@ METRICS_SCHEMA = {
     },
     "serving_compiled_peak_bytes": {
         "type": "gauge",
+        "agg": "max",
         "help": "memory_analysis argument+output+temp bytes of one "
                 "compiled serving step (labeled model=<id>, "
                 "step=<key>): the executable's live-HBM bound "
@@ -487,6 +559,7 @@ METRICS_SCHEMA = {
     },
     "serving_devprof_device_seconds": {
         "type": "histogram",
+        "agg": "histogram",
         "help": "Sampled per-dispatch device time (a timed "
                 "block_until_ready on the dispatch result), labeled "
                 "phase=decode|prefill|hybrid|spec_draft|spec_verify|"
@@ -498,6 +571,7 @@ METRICS_SCHEMA = {
     },
     "serving_devprof_samples_total": {
         "type": "counter",
+        "agg": "sum",
         "help": "Sampled dispatch timings taken per (phase, path) — "
                 "the denominator discipline for the device-seconds "
                 "histogram and the drift gauges (each sample costs one "
@@ -506,6 +580,7 @@ METRICS_SCHEMA = {
     },
     "serving_devprof_roofline_attainment": {
         "type": "gauge",
+        "agg": "last",
         "help": "Per-bound roofline attainment of the latest sampled "
                 "dispatch: labeled phase, path and bound=mem|flops — "
                 "t_bound / measured, where t_mem = compiled bytes "
@@ -517,6 +592,7 @@ METRICS_SCHEMA = {
     },
     "serving_costmodel_drift_ratio": {
         "type": "gauge",
+        "agg": "last",
         "help": "Cost-model drift per (phase, path): predicted / "
                 "measured for the latest sampled dispatch, where "
                 "predicted = max(t_mem, t_flops) from the record's "
@@ -529,6 +605,7 @@ METRICS_SCHEMA = {
     # --------------------------------------------------- pipeline serving
     "serving_pp_stage_dispatches_total": {
         "type": "counter",
+        "agg": "sum",
         "help": "Per-stage step dispatches of the pipeline-parallel "
                 "decode block (labeled stage=<s>); re-emits the record's "
                 "pp_dispatches odometer so scheduling regressions are "
@@ -537,6 +614,7 @@ METRICS_SCHEMA = {
     # ---------------------------------------------------- fleet KV economy
     "serving_kv_wire_export_bytes_total": {
         "type": "counter",
+        "agg": "sum",
         "help": "KV bundle bytes serialized out of this replica's "
                 "prefix pool through /v1/kv/export (magic + header + "
                 "frames + scale frames) — the donor half of the "
@@ -544,6 +622,7 @@ METRICS_SCHEMA = {
     },
     "serving_kv_wire_import_bytes_total": {
         "type": "counter",
+        "agg": "sum",
         "help": "KV bundle bytes accepted into this replica's prefix "
                 "pool through /v1/kv/import (counted only when the "
                 "adoption commits — a rejected or failed import counts "
@@ -552,6 +631,7 @@ METRICS_SCHEMA = {
     },
     "router_prefix_migrations_total": {
         "type": "counter",
+        "agg": "sum",
         "help": "Router-directed cross-replica prefix migrations, "
                 "labeled decision=migrate|recompute|failed: migrate = "
                 "the bundle was priced cheaper than re-prefill "
@@ -560,6 +640,23 @@ METRICS_SCHEMA = {
                 "recompute = pricing chose local re-prefill; failed = "
                 "the relay died mid-transfer and routing fell back to "
                 "recompute.",
+    },
+    # ---------------------------------------------------- fleet health plane
+    # (observability/fleet.py: cross-replica metrics federation + SLO
+    # burn-rate alerting over the router's retained per-replica history
+    # rings — docs/OBSERVABILITY.md "Fleet health & alerting")
+    "router_fleet_alerts_total": {
+        "type": "counter",
+        "agg": "sum",
+        "help": "Fleet alert state transitions at the router, labeled "
+                "rule=<alert rule name> and state=firing (both burn-"
+                "rate windows crossed the threshold — the alert "
+                "opened and, when the rule is replica-scoped, the "
+                "replica's diagnostic bundle was auto-captured) | "
+                "resolved (the fast window recovered past the re-arm "
+                "margin and the alert closed).  One tick per "
+                "transition, never per evaluation, so the total reads "
+                "as 'times this rule opened/closed'.",
     },
 }
 
@@ -767,6 +864,23 @@ EVENT_SCHEMA = {
                 "across sources into a single Chrome trace (trace_id, "
                 "sources, timelines, events) — the router's "
                 "assemble_trace and tools/fftrace.py both record it.",
+    },
+    "fleet-alert": {
+        "help": "A fleet alert rule changed state at the router (rule, "
+                "scope=fleet|<replica url>, state=firing|resolved, "
+                "fast, slow, threshold: the two window burn values "
+                "that crossed — or the fast value that recovered).  "
+                "The declared input contract for the fleet placement "
+                "policy / autoscaler: act on transitions, not on raw "
+                "series.",
+    },
+    "fleet-capture": {
+        "help": "The router auto-captured a replica's diagnostic "
+                "bundle because a replica-scoped alert fired (rule, "
+                "replica, path = the ffbundle_*.json written to disk, "
+                "ok; on a failed pull, ok=False and path=None).  The "
+                "bundle is the watchdog shape — tools/ffstat.py reads "
+                "it and names the replica's in-flight GUIDs.",
     },
     "compile": {
         "help": "A serving record compiled + caches allocated (model, "

@@ -55,8 +55,13 @@ def replica_main(args) -> int:
                                 paged=args.paged)
     if get_ledger().slo_policy() is None:
         # a policy must be installed for the goodput gauge the router
-        # scores on; generous CPU-feasible targets
-        get_ledger().set_slo_policy(SLOPolicy(ttft_s=30.0, tpot_s=5.0))
+        # scores on; generous CPU-feasible targets by default.  The
+        # flags exist so a test can spawn one replica with an
+        # unattainably tight budget — deterministic SLO degradation
+        # (attainment pins to 0, goodput to 0) without touching the
+        # token stream, the fleet-alert smoke's fault profile.
+        get_ledger().set_slo_policy(SLOPolicy(ttft_s=args.slo_ttft,
+                                              tpot_s=args.slo_tpot))
 
     async def amain() -> None:
         # watermark == max_pending: replicas queue under oversubscription
@@ -288,6 +293,135 @@ def selftest_fleetkv() -> int:
     return 0 if ok else 1
 
 
+# ------------------------------------------------- fleet-health smoke
+def selftest_fleet() -> int:
+    """run_tier1.sh fleet-health federation smoke (deterministic, 2
+    spawned CPU replicas behind a router): one replica spawns with an
+    unattainably tight SLO budget, so its attainment gauge pins to 0
+    while its token stream stays byte-identical to the healthy
+    replica's.  The router's burn-rate engine must fire
+    ``replica-slo-burn`` against that replica ONLY, auto-capture its
+    ``/v1/debug/bundle`` to disk, and ``/v1/fleet/health`` over the
+    wire must mark it the outlier — then, once killed, ``stale``."""
+    import json as _json
+    import shutil
+    import tempfile
+
+    from flexflow_tpu.serve.net.client import NetClient
+    from flexflow_tpu.serve.net.router import (ReplicaRouter,
+                                               RouterServer,
+                                               spawn_replica)
+
+    ok = True
+
+    def check(cond, msg):
+        nonlocal ok
+        if not cond:
+            ok = False
+            print(f"serve.net fleet selftest FAILED: {msg}")
+
+    prompt = [(7 * i) % 120 + 4 for i in range(32)]
+    cap_dir = tempfile.mkdtemp(prefix="ff_fleet_caps_")
+    healthy = spawn_replica(rows=2, decode_block=4, seed=0)
+    degraded = spawn_replica(rows=2, decode_block=4, seed=0,
+                             slo_ttft_s=1e-4)
+    try:
+        async def run() -> None:
+            # sub-second windows keep the smoke fast; the semantics
+            # (both windows must burn) are identical at any scale
+            rules = [{"name": "replica-slo-burn",
+                      "metric": "serving_slo_attainment",
+                      "scope": "replica", "kind": "below",
+                      "threshold": 0.9, "fast_window_s": 0.5,
+                      "slow_window_s": 1.0, "rearm_margin": 0.02,
+                      "capture": True}]
+            router = ReplicaRouter([healthy.url, degraded.url],
+                                   scrape_interval_s=0.1,
+                                   alert_rules=rules,
+                                   capture_dir=cap_dir)
+            async with router:
+                srv = RouterServer(router)
+                await srv.start()
+                rc = NetClient(srv.url)
+                # the degraded replica SERVES identically — only its
+                # SLO accounting is broken
+                ref = await (await NetClient(healthy.url).generate(
+                    prompt, max_new_tokens=10)).result()
+                got = await (await NetClient(degraded.url).generate(
+                    prompt, max_new_tokens=10)).result()
+                check(got == ref,
+                      f"degraded replica stream diverged: {got} "
+                      f"vs {ref}")
+                # scrapes pick the pinned gauge up; both burn windows
+                # breach; the alert fires and the capture lands
+                deadline = time.monotonic() + 20.0
+                while time.monotonic() < deadline:
+                    if any(c["ok"] for c in router.captures):
+                        break
+                    await asyncio.sleep(0.1)
+                active = router.alerts.active()
+                check(any(a["rule"] == "replica-slo-burn"
+                          and a["scope"] == degraded.url
+                          for a in active),
+                      f"no replica-slo-burn against the degraded "
+                      f"replica: {active}")
+                check(not any(a["scope"] == healthy.url
+                              for a in active),
+                      f"healthy replica alarmed: {active}")
+                caps = [c for c in router.captures if c["ok"]]
+                check(caps, "alert fired but no bundle captured")
+                if caps:
+                    check(caps[0]["replica"] == degraded.url,
+                          f"captured the wrong replica: {caps[0]}")
+                    with open(caps[0]["path"]) as f:
+                        bundle = _json.load(f)
+                    check(bundle.get("reason") == "on-demand"
+                          and "flight_record" in bundle
+                          and "ledger" in bundle,
+                          f"capture is not a watchdog-shaped bundle: "
+                          f"{sorted(bundle)}")
+                # the wire view: outlier table + alerts + fleet series
+                fh = await rc.fleet_health()
+                reps = fh.get("replicas") or {}
+                check((reps.get(degraded.url) or {}).get("outlier")
+                      is True,
+                      f"degraded replica not the outlier: {reps}")
+                check((reps.get(healthy.url) or {}).get("outlier")
+                      is False,
+                      f"healthy replica flagged outlier: {reps}")
+                check((fh.get("alerts") or {}).get("active"),
+                      "wire payload lost the active alerts")
+                series = (fh.get("fleet") or {}).get("series") or {}
+                check("fleet_slo_attainment" in series
+                      and "fleet_goodput_tokens_per_s" in series,
+                      f"fleet series missing: {sorted(series)}")
+                # staleness: kill the degraded replica; its ring stops
+                # refreshing and the table must flip to stale
+                degraded.kill()
+                deadline = time.monotonic() + 10.0
+                stale = False
+                while time.monotonic() < deadline and not stale:
+                    fh = await rc.fleet_health()
+                    stale = ((fh["replicas"].get(degraded.url) or {})
+                             .get("stale") is True)
+                    if not stale:
+                        await asyncio.sleep(0.2)
+                check(stale, "killed replica never flagged stale")
+                srv._server.close()
+
+        asyncio.run(run())
+    finally:
+        for r in (healthy, degraded):
+            r.close()
+        shutil.rmtree(cap_dir, ignore_errors=True)
+
+    if ok:
+        print("serve.net fleet selftest OK (burn-rate alert on the "
+              "degraded replica only, auto bundle capture, wire "
+              "outlier + staleness, byte-identical streams)")
+    return 0 if ok else 1
+
+
 # ------------------------------------------------------------------ CLI
 def main(argv) -> int:
     ap = argparse.ArgumentParser(
@@ -300,6 +434,11 @@ def main(argv) -> int:
     ap.add_argument("--selftest-fleetkv", action="store_true",
                     help="2-process cross-replica KV export/import "
                          "smoke (run_tier1.sh)")
+    ap.add_argument("--selftest-fleet", action="store_true",
+                    help="2-replica fleet-health federation smoke: "
+                         "SLO burn-rate alert on the degraded replica, "
+                         "auto bundle capture, /v1/fleet/health outlier "
+                         "+ staleness (run_tier1.sh)")
     ap.add_argument("--host", default="127.0.0.1")
     ap.add_argument("--port", type=int, default=0)
     ap.add_argument("--rows", type=int, default=2)
@@ -312,11 +451,19 @@ def main(argv) -> int:
     ap.add_argument("--paged", action="store_true",
                     help="replica: physical paged KV + frame-backed "
                          "pager instead of dense rows")
+    ap.add_argument("--slo-ttft", type=float, default=30.0,
+                    help="replica: SLO TTFT budget in seconds (set "
+                         "unattainably tight to degrade one replica's "
+                         "attainment deterministically)")
+    ap.add_argument("--slo-tpot", type=float, default=5.0,
+                    help="replica: SLO per-token budget in seconds")
     args = ap.parse_args(argv)
     if args.selftest:
         return selftest()
     if args.selftest_fleetkv:
         return selftest_fleetkv()
+    if args.selftest_fleet:
+        return selftest_fleet()
     if args.replica:
         return replica_main(args)
     ap.print_help()

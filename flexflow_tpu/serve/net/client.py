@@ -254,6 +254,28 @@ class NetClient:
     async def metrics_values(self) -> Dict[str, float]:
         return wire.parse_prometheus_gauges(await self.metrics_text())
 
+    async def metrics_series(self) -> Dict[str, float]:
+        """Full per-series scrape: labeled splits and histogram
+        bucket/sum/count series stay distinct (the fleet aggregator's
+        feed), while the bare-name keys match
+        :meth:`metrics_values`."""
+        return wire.flatten_prometheus(
+            wire.parse_prometheus_text(await self.metrics_text()))
+
+    async def debug_bundle(self) -> Dict[str, Any]:
+        """Pull the peer's on-demand diagnostic bundle (the watchdog
+        bundle shape: flight record + ledger + devprof + pager
+        snapshots) — the router's alert-triggered capture; the dict
+        writes to disk as a ``ffbundle_*.json`` tools/ffstat.py
+        reads."""
+        return (await self.request_json("GET", wire.P_DEBUG_BUNDLE))[1]
+
+    async def fleet_health(self) -> Dict[str, Any]:
+        """Fetch a router's fleet-health view (fleet series tails,
+        active alerts, per-replica outlier/staleness table).  404s on
+        a plain replica — only routers aggregate."""
+        return (await self.request_json("GET", wire.P_FLEET_HEALTH))[1]
+
     async def timelines(self, guid: Optional[int] = None,
                         trace: Optional[str] = None) -> Dict[str, Any]:
         """Fetch the peer's request-ledger timelines: full recent

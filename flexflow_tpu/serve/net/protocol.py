@@ -32,6 +32,18 @@ path                        verb   semantics
 ``/v1/metrics/history``     GET    the MetricsHistory ring (JSON
                                    time-series of registry samples;
                                    routers add per-replica rings)
+``/v1/debug/bundle``        GET    the watchdog diagnostic bundle shape
+                                   served on demand (flight record +
+                                   ledger + devprof + pager snapshots;
+                                   ``observability.watchdog.
+                                   collect_bundle`` as JSON — the
+                                   router's alert-triggered capture
+                                   pull, readable by tools/ffstat.py)
+``/v1/fleet/health``        GET    router only: fleet time-series tail,
+                                   active alerts, per-replica outlier
+                                   table and scrape staleness (the
+                                   FleetAggregator/AlertEngine view;
+                                   tools/ffdash.py renders it)
 ``/metrics``                GET    Prometheus text exposition
                                    (``MetricsRegistry.expose_text``)
 ==========================  =====  =====================================
@@ -105,6 +117,8 @@ P_HISTORY = "/v1/metrics/history"
 P_METRICS = "/metrics"
 P_KV_EXPORT = "/v1/kv/export"
 P_KV_IMPORT = "/v1/kv/import"
+P_DEBUG_BUNDLE = "/v1/debug/bundle"
+P_FLEET_HEALTH = "/v1/fleet/health"
 
 #: deadline propagation header: REMAINING budget (seconds, float).
 #: Overrides the body's deadline_s; a router forwards the remaining
@@ -524,4 +538,158 @@ def parse_prometheus_gauges(text: str) -> Dict[str, float]:
             out[name] = out.get(name, 0.0) + float(val)
         except ValueError:
             continue
+    return out
+
+
+def _split_prom_line(line: str) -> Optional[Tuple[str, Dict[str, str],
+                                                  float]]:
+    """One exposition data line -> (name, labels, value), quote-aware:
+    a label VALUE may contain spaces, commas and braces, so the closing
+    ``}`` is found by scanning, not splitting."""
+    brace = line.find("{")
+    if brace < 0:
+        head, _, val = line.rpartition(" ")
+        if not head:
+            return None
+        try:
+            return head.strip(), {}, float(val)
+        except ValueError:
+            return None
+    name = line[:brace].strip()
+    labels: Dict[str, str] = {}
+    i = brace + 1
+    n = len(line)
+    while i < n and line[i] != "}":
+        if line[i] == ",":
+            i += 1
+            continue
+        eq = line.find("=", i)
+        if eq < 0:
+            return None
+        key = line[i:eq].strip()
+        i = eq + 1
+        if i >= n or line[i] != '"':
+            return None
+        i += 1
+        buf = []
+        while i < n:
+            c = line[i]
+            if c == "\\" and i + 1 < n:
+                # the renderer escapes only \\ and \" — \x -> x inverts
+                # both (plus the promtool \n convention)
+                nxt = line[i + 1]
+                buf.append("\n" if nxt == "n" else nxt)
+                i += 2
+                continue
+            if c == '"':
+                break
+            buf.append(c)
+            i += 1
+        labels[key] = "".join(buf)
+        i += 1
+    try:
+        return name, labels, float(line[i + 1:].strip())
+    except ValueError:
+        return None
+
+
+def _fmt_label_set(labels: Dict[str, str]) -> str:
+    """registry._fmt_labels spelling (sorted ``k=v`` joins) so parsed
+    series key-compare against :meth:`MetricsRegistry.snapshot`."""
+    return ",".join(f"{k}={v}" for k, v in sorted(labels.items()))
+
+
+def parse_prometheus_text(text: str) -> Dict[str, Dict[str, Any]]:
+    """Structured decode of a Prometheus text page — the full inverse
+    of ``observability.registry.prometheus_text``, recovering what
+    :func:`parse_prometheus_gauges` collapses: labeled series stay
+    split and histogram ``_bucket``/``_sum``/``_count`` lines fold back
+    into their family (the fleet aggregator bucket-merges them).
+
+    Returns ``{family: {"type": counter|gauge|histogram|untyped,
+    "series": {...}}}`` where scalar families map label-set strings
+    (``""`` for the bare line; the registry's sorted ``k=v,k2=v2``
+    spelling otherwise) to values, and histogram families map label-set
+    strings (``le`` excluded) to ``{"count", "sum", "buckets":
+    {le_str: cumulative_count}}`` with ``le_str`` the rendered bound
+    (``"+Inf"`` included)."""
+    families: Dict[str, Dict[str, Any]] = {}
+    types: Dict[str, str] = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        if line.startswith("#"):
+            parts = line.split()
+            if len(parts) >= 4 and parts[1] == "TYPE":
+                types[parts[2]] = parts[3]
+            continue
+        split = _split_prom_line(line)
+        if split is None:
+            continue
+        name, labels, val = split
+        base = part = None
+        for suf in ("_bucket", "_sum", "_count"):
+            stem = name[:-len(suf)] if name.endswith(suf) else None
+            if stem and types.get(stem) == "histogram":
+                base, part = stem, suf[1:]
+                break
+        if part is not None:
+            fam = families.setdefault(
+                base, {"type": "histogram", "series": {}})
+            le = labels.pop("le", None)
+            sub = fam["series"].setdefault(
+                _fmt_label_set(labels),
+                {"count": 0.0, "sum": 0.0, "buckets": {}})
+            if part == "bucket":
+                if le is not None:
+                    sub["buckets"][le] = val
+            elif part == "sum":
+                sub["sum"] = val
+            else:
+                sub["count"] = val
+        else:
+            fam = families.setdefault(
+                name, {"type": types.get(name, "untyped"), "series": {}})
+            fam["series"][_fmt_label_set(labels)] = val
+    return families
+
+
+def flatten_prometheus(families: Dict[str, Dict[str, Any]]
+                       ) -> Dict[str, float]:
+    """Per-series scalar map from :func:`parse_prometheus_text` output
+    — the feed shape for a ``MetricsHistory`` ring.  Bare series keep
+    their family name and labeled series add ``name{k=v,...}`` keys, so
+    the keys shared with a replica's self-sampled ring (bare names,
+    histogram ``_count``/``_sum`` aggregates — the
+    ``traceplane.scalar_values`` spelling) stay identical while the
+    label/bucket splits the aggregator needs ride alongside.  Every
+    emitted value is a per-replica level or cumulative count, so
+    cross-replica histogram merges reduce to summing equal keys."""
+    out: Dict[str, float] = {}
+    for name, fam in families.items():
+        series = fam.get("series") or {}
+        if fam.get("type") == "histogram":
+            total_c = total_s = 0.0
+            for ls, sub in series.items():
+                total_c += sub.get("count", 0.0)
+                total_s += sub.get("sum", 0.0)
+                tag = f"{{{ls}}}" if ls else ""
+                if ls:
+                    out[f"{name}_count{tag}"] = sub.get("count", 0.0)
+                    out[f"{name}_sum{tag}"] = sub.get("sum", 0.0)
+                base = dict(p.split("=", 1) for p in ls.split(",")
+                            if "=" in p) if ls else {}
+                for le, cum in (sub.get("buckets") or {}).items():
+                    bl = _fmt_label_set({**base, "le": le})
+                    out[f"{name}_bucket{{{bl}}}"] = cum
+            out[f"{name}_count"] = total_c
+            out[f"{name}_sum"] = total_s
+        else:
+            total = 0.0
+            for ls, v in series.items():
+                total += v
+                if ls:
+                    out[f"{name}{{{ls}}}"] = v
+            out[name] = total
     return out

@@ -58,14 +58,16 @@ import asyncio
 import dataclasses
 import hashlib
 import itertools
+import json
 import os
 import subprocess
 import sys
 import time
 import types
-from typing import Dict, List, Optional, Sequence, Set, Tuple, Union
+from typing import Any, Dict, List, Optional, Sequence, Set, Tuple, Union
 
-from ...observability import (MetricsHistory, TraceAssembler,
+from ...observability import (AlertEngine, FleetAggregator,
+                              MetricsHistory, TraceAssembler,
                               TraceContext, get_flight_recorder,
                               get_ledger, get_registry)
 from ..frontend import FrontendClosed, Overloaded, RequestAborted
@@ -143,7 +145,11 @@ class ReplicaRouter:
                  w_load: float = 1.0,
                  kv_migration: bool = True,
                  migrate_timeout_s: float = 10.0,
-                 migrate_mode: str = "auto"):
+                 migrate_mode: str = "auto",
+                 alert_rules: Optional[List[Dict[str, Any]]] = None,
+                 capture_dir: str = "bench_results",
+                 fleet_stale_scrapes: float = 8.0,
+                 outlier_threshold: float = 1.0):
         if not replica_urls:
             raise ValueError("router needs at least one replica url")
         self.replicas: List[ReplicaHandle] = [
@@ -188,6 +194,26 @@ class ReplicaRouter:
         self._m_trace_hops = m.counter("serving_trace_hops_total")
         self._m_migrations = m.counter("router_prefix_migrations_total")
         self._scrape_task: Optional[asyncio.Task] = None
+        # fleet health plane: federation of the per-replica rings above
+        # + burn-rate alerting, evaluated from the scrape loop.  A
+        # replica whose last scrape is older than fleet_stale_scrapes
+        # intervals is excluded from merges and flagged stale.
+        self.fleet = FleetAggregator(
+            stale_after_s=max(1.0, float(fleet_stale_scrapes)
+                              * self.scrape_interval_s),
+            outlier_threshold=outlier_threshold)
+        # on_fire only QUEUES: the hook runs synchronously inside
+        # evaluate(), but bundle capture awaits the replica's wire —
+        # the scrape loop drains the queue right after evaluation
+        self._pending_captures: List[Dict[str, Any]] = []
+        self.alerts = AlertEngine(
+            rules=alert_rules,
+            on_fire=lambda rule, scope, info:
+                self._pending_captures.append(info))
+        self.capture_dir = capture_dir
+        #: completed alert-triggered bundle pulls, newest last
+        #: ({rule, replica, path, wall, ok}) — surfaced in fleet_health
+        self.captures: List[Dict[str, Any]] = []
 
     # ----------------------------------------------------------- lifecycle
     async def start(self) -> "ReplicaRouter":
@@ -228,11 +254,17 @@ class ReplicaRouter:
         dead endpoint never waits for a request to find it."""
         async def pull(r: ReplicaHandle) -> None:
             try:
-                r.scrape = await r.client.metrics_values()
+                # ONE wire fetch feeds both views of the page: the
+                # label-collapsed gauge map scoring reads, and the full
+                # per-series flatten (labeled splits, histogram
+                # bucket/sum/count) the fleet aggregator merges
+                text = await r.client.metrics_text()
+                r.scrape = wire.parse_prometheus_gauges(text)
                 r.scrape_ok = True
                 # retain the sample: the score this scrape produces is
                 # replayable from the ring, not just the latest values
-                r.history.append(r.scrape)
+                r.history.append(wire.flatten_prometheus(
+                    wire.parse_prometheus_text(text)))
             except (NetError, wire.ProtocolError):
                 r.scrape_ok = False
                 self._open_circuit(r, why="scrape")
@@ -250,6 +282,14 @@ class ReplicaRouter:
 
         await asyncio.gather(*(pull(r) for r in self.replicas))
         self._rescore()
+        # fleet federation + burn-rate evaluation ride the same tick:
+        # alert windows see exactly the samples the merge saw
+        rings = {r.url: r.history for r in self.replicas}
+        self.fleet.merge(rings)
+        self.alerts.evaluate(self.fleet.history, rings)
+        pending, self._pending_captures = self._pending_captures, []
+        for info in pending:
+            await self._capture_bundle(info)
 
     def _rescore(self) -> None:
         cands = [r for r in self.replicas if r.scrape_ok]
@@ -274,6 +314,48 @@ class ReplicaRouter:
         self.recorder.record_event("router-circuit-open", replica=r.url,
                                    cooldown_s=self.circuit_cooldown_s,
                                    why=why)
+
+    # --------------------------------------------------------- fleet health
+    async def _capture_bundle(self, info: Dict[str, Any]) -> None:
+        """Alert-triggered diagnostic capture: a replica-scoped rule
+        fired, so pull that replica's ``/v1/debug/bundle`` NOW — while
+        the incident is live, not after someone reads the pager — and
+        write it as an ``ffbundle_*.json`` tools/ffstat.py reads.  Any
+        failure is recorded, never raised: a dead replica must not take
+        the scrape loop down with it."""
+        url = info.get("scope", "")
+        handle = next((r for r in self.replicas if r.url == url), None)
+        if handle is None:
+            return
+        cap: Dict[str, Any] = {"rule": info["rule"], "replica": url,
+                               "path": None, "wall": time.time(),
+                               "ok": False}
+        try:
+            bundle = await handle.client.debug_bundle()
+            os.makedirs(self.capture_dir, exist_ok=True)
+            stem = (f"ffbundle_{os.getpid()}_"
+                    f"{int(cap['wall'] * 1000)}")
+            path = os.path.join(self.capture_dir, stem + ".json")
+            with open(path, "w") as f:
+                json.dump(bundle, f, indent=1, default=str)
+            cap["path"], cap["ok"] = path, True
+        except (NetError, wire.ProtocolError, OSError):
+            pass
+        self.captures.append(cap)
+        del self.captures[:-64]
+        self.recorder.record_event(
+            "fleet-capture", rule=cap["rule"], replica=url,
+            path=cap["path"] or "", ok=cap["ok"])
+
+    def fleet_health(self, tail: int = 120) -> Dict[str, Any]:
+        """The ``/v1/fleet/health`` payload: fleet series tails, active
+        alerts + recent transitions, the per-replica outlier/staleness
+        table, and the alert-triggered captures taken so far."""
+        payload = self.fleet.health_snapshot(alerts=self.alerts,
+                                             tail=tail)
+        payload["scrape_interval_s"] = self.scrape_interval_s
+        payload["captures"] = [dict(c) for c in self.captures]
+        return payload
 
     # ------------------------------------------------------------- routing
     def affinity_key(self, prompt: Union[List[int], str],
@@ -872,6 +954,22 @@ class RouterServer(ServeNetServer):
         await writer.drain()
         return 200
 
+    async def _h_fleet_health(self, query: str, writer) -> int:
+        """The router IS the fleet vantage point — override the
+        replica's 404 with the aggregator's health payload
+        (``?tail=N`` bounds the series tails)."""
+        from .server import _query_params
+
+        try:
+            tail = max(1, int(_query_params(query).get("tail", "120")))
+        except ValueError:
+            tail = 120
+        writer.write(wire.json_response(
+            200, {"protocol": wire.PROTOCOL_VERSION,
+                  **self.router.fleet_health(tail=tail)}))
+        await writer.drain()
+        return 200
+
 
 # --------------------------------------------------- replica processes
 @dataclasses.dataclass
@@ -906,7 +1004,8 @@ def spawn_replica(host: str = "127.0.0.1", port: int = 0, rows: int = 2,
                   max_pending: int = 64,
                   ready_timeout_s: float = 180.0,
                   prefix_cache: bool = False,
-                  paged: bool = False) -> ReplicaProc:
+                  paged: bool = False,
+                  slo_ttft_s: Optional[float] = None) -> ReplicaProc:
     """Spawn ``python -m flexflow_tpu.serve.net --replica`` as a child
     process (tiny CPU llama engine; JAX_PLATFORMS forced to cpu so a
     chip-holding parent never shares its device) and block until its
@@ -926,6 +1025,10 @@ def spawn_replica(host: str = "127.0.0.1", port: int = 0, rows: int = 2,
         argv.append("--prefix-cache")
     if paged:
         argv.append("--paged")
+    if slo_ttft_s is not None:
+        # an unattainably tight budget degrades this replica's SLO
+        # attainment deterministically — the fleet-alert tests' fault
+        argv.extend(["--slo-ttft", str(float(slo_ttft_s))])
     proc = subprocess.Popen(
         argv, stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
         env=env, cwd=repo, text=True, bufsize=1)

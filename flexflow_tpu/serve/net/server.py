@@ -252,6 +252,13 @@ class ServeNetServer:
                     query, writer)
             elif path == wire.P_HISTORY and method == "GET":
                 endpoint, code = "history", await self._h_history(writer)
+            elif path == wire.P_DEBUG_BUNDLE and method == "GET":
+                endpoint, code = ("debug_bundle",
+                                  await self._h_debug_bundle(writer))
+            elif path == wire.P_FLEET_HEALTH and method == "GET":
+                endpoint, code = ("fleet_health",
+                                  await self._h_fleet_health(query,
+                                                             writer))
             elif path == wire.P_METRICS and method == "GET":
                 endpoint, code = "metrics", await self._h_metrics(writer)
             elif path == wire.P_KV_EXPORT and method == "POST":
@@ -363,6 +370,37 @@ class ServeNetServer:
                   "history": get_metrics_history().snapshot()}))
         await writer.drain()
         return 200
+
+    async def _h_debug_bundle(self, writer) -> int:
+        """The PR-5 watchdog bundle shape served ON DEMAND (flight
+        record + ledger timelines + devprof snapshot + pager snapshots
+        + metrics history tail): ``observability.watchdog.
+        collect_bundle`` as JSON, so a router firing a burn-rate alert
+        against this replica pulls the same evidence a stall dump
+        writes — and ``tools/ffstat.py`` reads either identically.
+        Pure snapshot reads under RLocks (signal-dump-safe locks), so
+        no driver-op boxing is needed and a wedged driver thread
+        cannot wedge the capture that is trying to diagnose it."""
+        from ...observability.watchdog import collect_bundle
+
+        bundle = collect_bundle("on-demand")
+        # default=str mirrors dump_bundle's serialization: snapshot
+        # payloads may carry non-JSON scalars (numpy floats, paths)
+        body = json.dumps(bundle, default=str).encode()
+        writer.write(wire.http_response(200, body,
+                                        content_type="application/json"))
+        await writer.drain()
+        return 200
+
+    async def _h_fleet_health(self, query: str, writer) -> int:
+        """Replica default: fleet health lives at the ROUTER (it owns
+        the per-replica scrape retention) — RouterServer overrides
+        this with the real FleetAggregator/AlertEngine payload."""
+        writer.write(wire.json_response(
+            404, {"error": "not_found",
+                  "detail": "fleet health is served by the router"}))
+        await writer.drain()
+        return 404
 
     async def _h_metrics(self, writer) -> int:
         text = get_registry().expose_text().encode()

@@ -36,8 +36,12 @@ from tools.fflint.rules.retrace import RetraceRule  # noqa: E402
 from tools.fflint.rules.shard_consistency import ShardConsistencyRule  # noqa: E402
 
 SCHEMA = {
-    "serving_widgets_total": {"type": "counter", "help": "x"},
-    "serving_queue_depth": {"type": "gauge", "help": "x"},
+    "serving_widgets_total": {"type": "counter", "agg": "sum",
+                              "help": "x"},
+    "serving_queue_depth": {"type": "gauge", "agg": "sum", "help": "x"},
+    # declared WITHOUT a fleet aggregation kind — the missing-agg test
+    "serving_aggless_total": {"type": "counter", "help": "x"},
+    "serving_misagg_depth": {"type": "gauge", "agg": "avg", "help": "x"},
 }
 
 EVENTS = {
@@ -610,6 +614,35 @@ class TestMetricSchemaRule:
         assert at(fs, "metric-schema", 4), fs     # counter-vs-gauge
         assert at(fs, "metric-schema", 5), fs     # non-literal
         assert len(fs) == 3
+
+    def test_missing_or_invalid_agg_kind_flagged(self, tmp_path):
+        # observability/fleet.py merges per-replica series by the
+        # schema's declared "agg" kind — a metric registered without
+        # one (or with a kind outside sum|max|last|histogram) cannot
+        # be federated and is a lint error at its registration site
+        fs = lint(tmp_path, """\
+            def wire(m):
+                a = m.counter("serving_aggless_total")
+                b = m.gauge("serving_misagg_depth")
+                c = m.counter("serving_widgets_total")
+                return a, b, c
+            """, self.R)
+        assert at(fs, "metric-schema", 2), fs     # missing agg
+        assert at(fs, "metric-schema", 3), fs     # invalid agg kind
+        assert len(fs) == 2
+        assert "aggregation kind" in at(fs, "metric-schema",
+                                        2)[0].message
+
+    def test_every_real_metric_declares_an_agg_kind(self):
+        # the live schema itself: 100% coverage, valid vocabulary
+        from flexflow_tpu.observability.fleet import AGG_KINDS
+        from flexflow_tpu.observability.schema import METRICS_SCHEMA
+
+        for name, decl in METRICS_SCHEMA.items():
+            assert decl.get("agg") in AGG_KINDS, (
+                f"{name}: agg={decl.get('agg')!r}")
+            if decl["type"] == "histogram":
+                assert decl["agg"] == "histogram", name
 
     def test_numpy_histogram_not_a_registry_call(self, tmp_path):
         fs = lint(tmp_path, """\

@@ -1,0 +1,456 @@
+"""Fleet health plane tests (observability/fleet.py, PR 18).
+
+Unit half (no sockets): the rich Prometheus parser round-trips
+``MetricsRegistry.expose_text()`` — labeled splits and per-label
+histogram series included — with the flattened bare keys matching the
+``scalar_values`` spelling the router's rings use;
+:class:`FleetAggregator` merges per the schema's declared ``agg``
+kinds, derives the fleet headline series, scores outliers
+directionally and excludes stale scrapes; :class:`AlertEngine`
+enforces multi-window burn-rate semantics (BOTH windows must breach),
+hysteresis re-arm, transition-only counter ticks and capture-gated
+``on_fire``.
+
+E2E half (two spawned CPU replica processes): one replica carries an
+unattainably tight SLO budget (``spawn_replica(slo_ttft_s=...)``) —
+its attainment pins to 0 while its greedy streams stay byte-identical
+to the healthy replica's; the router's burn-rate rule must fire
+against THAT replica only, auto-capture its ``/v1/debug/bundle`` to
+disk readable by ``tools/ffstat.py`` (in-flight GUIDs named), and
+``/v1/fleet/health`` must mark it the outlier.
+"""
+
+import asyncio
+import json
+import os
+import sys
+import time
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from flexflow_tpu.observability import (AlertEngine,  # noqa: E402
+                                        FleetAggregator, MetricsHistory,
+                                        MetricsRegistry, METRICS_SCHEMA,
+                                        get_ledger, get_registry,
+                                        scalar_values, validate_rule)
+from flexflow_tpu.observability.fleet import (agg_kind,  # noqa: E402
+                                              base_metric)
+from flexflow_tpu.serve.net import protocol as wire  # noqa: E402
+
+TELEMETRY_ON = get_ledger().enabled
+
+pytestmark = pytest.mark.skipif(
+    not TELEMETRY_ON, reason="fleet plane tests need telemetry")
+
+
+# ------------------------------------------------ prometheus round-trip
+def _traffic_registry() -> MetricsRegistry:
+    m = MetricsRegistry(schema=METRICS_SCHEMA)
+    m.counter("serving_requests_admitted_total").inc(5)
+    m.counter("serving_cancellations_total").inc(2, reason="deadline")
+    m.counter("serving_cancellations_total").inc(1, reason="shed")
+    m.gauge("serving_queue_depth").set(3.0)
+    m.gauge("serving_slo_attainment").set(0.93)
+    h = m.histogram("serving_step_latency_seconds")
+    for v in (0.001, 0.004, 0.02):
+        h.observe(v)
+    # the PR-15 labeled histogram: per-series buckets on the wire
+    d = m.histogram("serving_devprof_device_seconds")
+    d.observe(0.002, phase="decode", path="dense")
+    d.observe(0.004, phase="decode", path="dense")
+    d.observe(0.030, phase="prefill", path="paged")
+    return m
+
+
+class TestPrometheusRoundTrip:
+    def test_bare_keys_match_scalar_values(self):
+        m = _traffic_registry()
+        flat = wire.flatten_prometheus(
+            wire.parse_prometheus_text(m.expose_text()))
+        expect = scalar_values(m.snapshot())
+        for key, val in expect.items():
+            assert key in flat, key
+            assert flat[key] == pytest.approx(val), key
+
+    def test_labeled_splits_survive(self):
+        flat = wire.flatten_prometheus(
+            wire.parse_prometheus_text(_traffic_registry().expose_text()))
+        assert flat["serving_cancellations_total{reason=deadline}"] == 2
+        assert flat["serving_cancellations_total{reason=shed}"] == 1
+        assert flat["serving_cancellations_total"] == 3
+
+    def test_histogram_series_and_buckets(self):
+        flat = wire.flatten_prometheus(
+            wire.parse_prometheus_text(_traffic_registry().expose_text()))
+        assert flat[
+            "serving_devprof_device_seconds_count{path=dense,phase=decode}"
+        ] == 2
+        assert flat[
+            "serving_devprof_device_seconds_count{path=paged,phase=prefill}"
+        ] == 1
+        # aggregates keep the scalar_values spelling
+        assert flat["serving_devprof_device_seconds_count"] == 3
+        assert flat["serving_devprof_device_seconds_sum"] == \
+            pytest.approx(0.036)
+        # cumulative buckets present, +Inf equals the series count
+        inf = [k for k in flat
+               if k.startswith("serving_devprof_device_seconds_bucket{")
+               and "le=+Inf" in k and "phase=decode" in k]
+        assert inf and flat[inf[0]] == 2
+
+    def test_legacy_gauge_parser_agrees_on_plain_series(self):
+        text = _traffic_registry().expose_text()
+        legacy = wire.parse_prometheus_gauges(text)
+        flat = wire.flatten_prometheus(wire.parse_prometheus_text(text))
+        for key in ("serving_requests_admitted_total",
+                    "serving_queue_depth", "serving_slo_attainment"):
+            assert legacy[key] == pytest.approx(flat[key]), key
+
+
+# ------------------------------------------------------ schema helpers
+class TestAggKinds:
+    def test_base_metric_strips_labels_and_histogram_suffixes(self):
+        assert base_metric("serving_requests_admitted_total") == \
+            "serving_requests_admitted_total"
+        assert base_metric("serving_cancellations_total{reason=shed}"
+                           ) == "serving_cancellations_total"
+        assert base_metric("serving_step_latency_seconds_count") == \
+            "serving_step_latency_seconds"
+        assert base_metric(
+            "serving_devprof_device_seconds_bucket{le=+Inf,phase=x}"
+        ) == "serving_devprof_device_seconds"
+
+    def test_agg_kind_resolution(self):
+        assert agg_kind("serving_requests_admitted_total") == "sum"
+        assert agg_kind("serving_slo_attainment") == "last"
+        assert agg_kind("serving_compiled_flops{model=m}") == "max"
+        # histogram-flattened series merge as sums
+        assert agg_kind("serving_step_latency_seconds_count") == "sum"
+        # foreign keys are never merged blind
+        assert agg_kind("totally_unknown_series") is None
+
+
+# ----------------------------------------------------- fleet aggregator
+def _ring(values_by_wall):
+    ring = MetricsHistory(capacity=64)
+    for wall, values in values_by_wall:
+        ring.append(values, wall=wall)
+    return ring
+
+
+T0 = 1_700_000_000.0
+
+
+class TestFleetAggregator:
+    def test_merge_kinds(self):
+        a = _ring([(T0, {"serving_requests_admitted_total": 10.0,
+                         "serving_queue_depth": 2.0,
+                         "serving_slo_attainment": 0.9,
+                         "serving_compiled_flops{model=m}": 100.0,
+                         "serving_step_latency_seconds_count": 5.0})])
+        b = _ring([(T0, {"serving_requests_admitted_total": 4.0,
+                         "serving_queue_depth": 1.0,
+                         "serving_slo_attainment": 0.7,
+                         "serving_compiled_flops{model=m}": 100.0,
+                         "serving_step_latency_seconds_count": 3.0})])
+        agg = FleetAggregator(stale_after_s=10.0)
+        merged = agg.merge({"http://a": a, "http://b": b}, now=T0 + 1)
+        assert merged["serving_requests_admitted_total"] == 14.0  # sum
+        assert merged["serving_queue_depth"] == 3.0           # sum
+        assert merged["serving_slo_attainment"] == \
+            pytest.approx(0.8)                                # mean
+        assert merged["serving_compiled_flops{model=m}"] == 100.0  # max
+        assert merged["serving_step_latency_seconds_count"] == 8.0
+        assert merged["fleet_replicas"] == 2.0
+
+    def test_derived_series(self):
+        a = _ring([(T0, {"serving_goodput_tokens_per_s": 40.0,
+                         "serving_slo_attainment": 1.0,
+                         "serving_kv_frames_total": 64.0,
+                         "serving_kv_frames_free": 50.0,
+                         "serving_costmodel_drift_ratio": 1.2})])
+        b = _ring([(T0, {"serving_goodput_tokens_per_s": 20.0,
+                         "serving_slo_attainment": 0.5,
+                         "serving_kv_frames_total": 64.0,
+                         "serving_kv_frames_free": 5.0,
+                         "serving_costmodel_drift_ratio": 0.8})])
+        merged = FleetAggregator().merge({"a": a, "b": b}, now=T0 + 1)
+        assert merged["fleet_goodput_tokens_per_s"] == 60.0
+        assert merged["fleet_slo_attainment"] == pytest.approx(0.75)
+        assert merged["fleet_kv_frame_headroom"] == \
+            pytest.approx(55.0 / 128.0)
+        assert merged["fleet_costmodel_drift"] == pytest.approx(1.0)
+
+    def test_outlier_scoring_is_directional(self):
+        # the sick replica (low goodput/attainment, deep queue) accrues
+        # deviation; the healthy one must NOT be penalized for being
+        # better than the median in a 2-replica fleet
+        a = _ring([(T0, {"serving_goodput_tokens_per_s": 50.0,
+                         "serving_slo_attainment": 0.98,
+                         "serving_queue_depth": 1.0})])
+        b = _ring([(T0, {"serving_goodput_tokens_per_s": 5.0,
+                         "serving_slo_attainment": 0.2,
+                         "serving_queue_depth": 9.0})])
+        agg = FleetAggregator(outlier_threshold=1.0)
+        agg.merge({"http://a": a, "http://b": b}, now=T0 + 1)
+        table = agg.replica_table()
+        assert table["http://b"]["outlier"] is True
+        assert table["http://b"]["outlier_score"] > 1.0
+        assert table["http://a"]["outlier"] is False
+        assert table["http://a"]["outlier_score"] == 0.0
+        assert "serving_slo_attainment" in table["http://b"][
+            "deviations"]
+
+    def test_stale_replica_excluded_and_flagged(self):
+        fresh = _ring([(T0 + 100, {"serving_queue_depth": 2.0})])
+        stale = _ring([(T0, {"serving_queue_depth": 50.0})])
+        agg = FleetAggregator(stale_after_s=5.0)
+        merged = agg.merge({"http://fresh": fresh,
+                            "http://stale": stale}, now=T0 + 100.5)
+        # the stale replica's values must NOT drag the merge
+        assert merged["serving_queue_depth"] == 2.0
+        assert merged["fleet_replicas"] == 1.0
+        assert merged["fleet_replicas_stale"] == 1.0
+        table = agg.replica_table()
+        assert table["http://stale"]["stale"] is True
+        assert table["http://fresh"]["stale"] is False
+        payload = agg.health_snapshot()
+        assert payload["replicas"]["http://stale"]["stale"] is True
+
+    def test_all_stale_merges_nothing(self):
+        old = _ring([(T0, {"serving_queue_depth": 1.0})])
+        agg = FleetAggregator(stale_after_s=1.0)
+        assert agg.merge({"http://a": old}, now=T0 + 100) is None
+        assert agg.history.snapshot()["recorded"] == 0
+
+    def test_disabled_telemetry_is_noop(self):
+        ring = _ring([(T0, {"serving_queue_depth": 1.0})])
+        agg = FleetAggregator()
+        engine = AlertEngine()
+        reg = get_registry()
+        reg.enabled = False
+        try:
+            assert agg.merge({"a": ring}, now=T0 + 1) is None
+            assert engine.evaluate(agg.history, {"a": ring},
+                                   now=T0 + 1) == []
+        finally:
+            reg.enabled = True
+        assert agg.history.snapshot()["recorded"] == 0
+
+
+# --------------------------------------------------------- alert engine
+def _rule(**over):
+    base = {"name": "slo-burn", "metric": "serving_slo_attainment",
+            "scope": "replica", "kind": "below", "threshold": 0.5,
+            "fast_window_s": 2.0, "slow_window_s": 10.0,
+            "rearm_margin": 0.1}
+    base.update(over)
+    return base
+
+
+def _alert_counter_labels():
+    snap = (get_registry().snapshot().get("counters") or {}).get(
+        "router_fleet_alerts_total", {})
+    return dict(snap.get("labels", {})) if isinstance(snap, dict) \
+        else {}
+
+
+class TestAlertEngine:
+    def test_validate_rule(self):
+        ok = validate_rule(_rule())
+        assert ok["rearm_margin"] == 0.1
+        assert ok["capture"] is True          # replica scope default
+        assert validate_rule(_rule(scope="fleet",
+                                   rearm_margin=0.0))["capture"] is False
+        with pytest.raises(ValueError):
+            validate_rule(_rule(kind="sideways"))
+        with pytest.raises(ValueError):
+            validate_rule(_rule(slow_window_s=1.0))   # slow < fast
+        with pytest.raises(ValueError):
+            validate_rule({k: v for k, v in _rule().items()
+                           if k != "metric"})
+        with pytest.raises(ValueError):
+            validate_rule(_rule(frobnicate=1))
+        with pytest.raises(ValueError):
+            AlertEngine(rules=[_rule(), _rule()])     # dup names
+
+    def test_both_windows_must_burn(self):
+        # 20 healthy ticks then the incident: the FAST window breaches
+        # first — no fire until the SLOW window burns too
+        engine = AlertEngine(rules=[_rule()])
+        ring = _ring([(T0 + i, {"serving_slo_attainment": 1.0})
+                      for i in range(20)])
+        fired = []
+        for i in range(20, 30):
+            ring.append({"serving_slo_attainment": 0.0}, wall=T0 + i)
+            trans = engine.evaluate(MetricsHistory(), {"r": ring},
+                                    now=T0 + i)
+            fired.extend(trans)
+            if not trans and not fired:
+                # fast-only breach must NOT fire
+                fast = AlertEngine._window_mean(ring,
+                                                "serving_slo_attainment",
+                                                2.0, T0 + i)
+                slow = AlertEngine._window_mean(ring,
+                                                "serving_slo_attainment",
+                                                10.0, T0 + i)
+                if fast is not None and fast < 0.5:
+                    assert slow >= 0.5, (i, fast, slow)
+        assert len(fired) == 1 and fired[0]["state"] == "firing"
+        # both windows were genuinely burning at the transition
+        assert fired[0]["fast"] < 0.5 and fired[0]["slow"] < 0.5
+
+    def test_hysteresis_rearm(self):
+        engine = AlertEngine(rules=[_rule(fast_window_s=1.0,
+                                          slow_window_s=2.0,
+                                          rearm_margin=0.1)])
+        ring = _ring([(T0 + i, {"serving_slo_attainment": 0.0})
+                      for i in range(4)])
+        before = dict(_alert_counter_labels())
+        t = engine.evaluate(MetricsHistory(), {"r": ring}, now=T0 + 3)
+        assert [x["state"] for x in t] == ["firing"]
+        assert engine.active()
+        # recovery INSIDE the margin: still firing (no flap)
+        ring.append({"serving_slo_attainment": 0.55}, wall=T0 + 4)
+        assert engine.evaluate(MetricsHistory(), {"r": ring},
+                               now=T0 + 4.9) == []
+        assert engine.active()
+        # recovery past threshold + margin: resolved
+        ring.append({"serving_slo_attainment": 0.95}, wall=T0 + 5)
+        t = engine.evaluate(MetricsHistory(), {"r": ring}, now=T0 + 5.9)
+        assert [x["state"] for x in t] == ["resolved"]
+        assert not engine.active()
+        after = _alert_counter_labels()
+        assert after.get("rule=slo-burn,state=firing", 0) == \
+            before.get("rule=slo-burn,state=firing", 0) + 1
+        assert after.get("rule=slo-burn,state=resolved", 0) == \
+            before.get("rule=slo-burn,state=resolved", 0) + 1
+        # transitions retained oldest-first
+        states = [x["state"] for x in engine.recent()]
+        assert states[-2:] == ["firing", "resolved"]
+
+    def test_on_fire_is_capture_gated(self):
+        calls = []
+        hook = lambda rule, scope, info: calls.append(scope)  # noqa: E731
+        ring = _ring([(T0 + i, {"serving_slo_attainment": 0.0})
+                      for i in range(4)])
+        engine = AlertEngine(rules=[_rule(capture=False)], on_fire=hook)
+        engine.evaluate(MetricsHistory(), {"r": ring}, now=T0 + 3)
+        assert engine.active() and calls == []
+        engine2 = AlertEngine(rules=[_rule()], on_fire=hook)
+        engine2.evaluate(MetricsHistory(), {"r": ring}, now=T0 + 3)
+        assert calls == ["r"]
+
+    def test_fleet_scope_reads_fleet_ring(self):
+        fleet = _ring([(T0 + i, {"fleet_slo_attainment": 0.1})
+                       for i in range(4)])
+        engine = AlertEngine(rules=[_rule(name="fleet-burn",
+                                          metric="fleet_slo_attainment",
+                                          scope="fleet")])
+        t = engine.evaluate(fleet, {}, now=T0 + 3)
+        assert [x["scope"] for x in t] == ["fleet"]
+
+
+# ------------------------------------------------------------ e2e fleet
+@pytest.mark.skipif(os.environ.get("FF_SKIP_NET_TESTS") == "1",
+                    reason="spawning replica processes disabled")
+class TestFleetE2E:
+    def test_degraded_replica_alerts_captures_and_outliers(self, tmp_path):
+        from flexflow_tpu.serve.net.client import NetClient
+        from flexflow_tpu.serve.net.router import (ReplicaRouter,
+                                                   RouterServer,
+                                                   spawn_replica)
+
+        prompt = [(5 * i) % 110 + 4 for i in range(40)]
+        healthy = spawn_replica(rows=2, decode_block=4, seed=0)
+        degraded = spawn_replica(rows=2, decode_block=4, seed=0,
+                                 slo_ttft_s=1e-4)
+        out = {}
+        try:
+            async def go():
+                rules = [{"name": "replica-slo-burn",
+                          "metric": "serving_slo_attainment",
+                          "scope": "replica", "kind": "below",
+                          "threshold": 0.9, "fast_window_s": 0.5,
+                          "slow_window_s": 1.0, "rearm_margin": 0.02,
+                          "capture": True}]
+                router = ReplicaRouter(
+                    [healthy.url, degraded.url], scrape_interval_s=0.1,
+                    alert_rules=rules, capture_dir=str(tmp_path))
+                async with router:
+                    srv = RouterServer(router)
+                    await srv.start()
+                    rc = NetClient(srv.url)
+                    hc = NetClient(healthy.url)
+                    dc = NetClient(degraded.url)
+                    # identical greedy streams despite the degradation
+                    out["ref"] = await (await hc.generate(
+                        prompt, max_new_tokens=10)).result()
+                    out["got"] = await (await dc.generate(
+                        prompt, max_new_tokens=10)).result()
+                    # an on-demand bundle taken MID-FLIGHT names the
+                    # live request (the ffstat stall-suspect surface)
+                    ws = await dc.generate(prompt[:8],
+                                           max_new_tokens=24)
+                    seen = 0
+                    async for _ in ws:
+                        seen += 1
+                        if seen >= 2:
+                            break
+                    out["live_bundle"] = await dc.debug_bundle()
+                    await ws.result()
+                    deadline = time.monotonic() + 20.0
+                    while time.monotonic() < deadline:
+                        if any(c["ok"] for c in router.captures):
+                            break
+                        await asyncio.sleep(0.1)
+                    out["active"] = router.alerts.active()
+                    out["captures"] = [dict(c) for c in router.captures]
+                    out["health"] = await rc.fleet_health()
+                    srv._server.close()
+            asyncio.run(go())
+        finally:
+            healthy.close()
+            degraded.close()
+
+        assert out["got"] == out["ref"]
+        active = out["active"]
+        assert any(a["rule"] == "replica-slo-burn"
+                   and a["scope"] == degraded.url for a in active), active
+        assert not any(a["scope"] == healthy.url for a in active)
+        caps = [c for c in out["captures"] if c["ok"]]
+        assert caps and caps[0]["replica"] == degraded.url
+        with open(caps[0]["path"]) as f:
+            bundle = json.load(f)
+        assert bundle["reason"] == "on-demand"
+        assert "flight_record" in bundle and "ledger" in bundle
+
+        # the auto-captured bundle is ffstat-readable
+        import tools.ffstat as ffstat
+        assert ffstat.main(["ffstat", caps[0]["path"]]) == 0
+
+        # a bundle pulled mid-request names the in-flight GUID
+        live_path = os.path.join(str(tmp_path), "ffbundle_live.json")
+        with open(live_path, "w") as f:
+            json.dump(out["live_bundle"], f, default=str)
+        live = [t for t in (out["live_bundle"]["ledger"].get("live")
+                            or []) if t.get("admit_mono") is not None]
+        assert live, "no in-flight request in the mid-stream bundle"
+        import contextlib
+        import io
+        buf = io.StringIO()
+        with contextlib.redirect_stdout(buf):
+            assert ffstat.main(["ffstat", live_path]) == 0
+        assert f"guid {live[0]['guid']}" in buf.getvalue()
+
+        # the wire health view: outlier + alerts + fleet series
+        health = out["health"]
+        reps = health["replicas"]
+        assert reps[degraded.url]["outlier"] is True
+        assert reps[healthy.url]["outlier"] is False
+        assert health["alerts"]["active"]
+        assert "fleet_slo_attainment" in health["fleet"]["series"]
+        assert health["captures"]

@@ -1,0 +1,327 @@
+#!/usr/bin/env python
+"""Terminal fleet-health dashboard over the router's health plane.
+
+Renders one screenful of fleet state — derived fleet series as
+sparklines, active burn-rate alerts, recent alert transitions, the
+per-replica outlier/staleness table and alert-triggered bundle
+captures — from any of:
+
+- a **live router**: ``python tools/ffdash.py http://HOST:PORT`` polls
+  ``/v1/fleet/health`` (the :class:`~flexflow_tpu.observability.fleet.
+  FleetAggregator` payload RouterServer serves) once, or continuously
+  with ``--watch SECONDS``;
+- a **saved record**: a bench round record (``bench_results/<r>.json``)
+  carrying a ``fleet_health`` stamp (bench ``live``/``fleetkv`` modes
+  write one), or a raw fleet-health payload saved from the endpoint
+  (``curl .../v1/fleet/health > fh.json``).
+
+Usage:
+    python tools/ffdash.py TARGET [--tail N] [--watch SECONDS]
+    python tools/ffdash.py --selftest
+
+``TARGET``     router base URL (http…) or a JSON file path
+``--tail N``   series tail length to request/render (default 120)
+``--watch S``  live mode: clear + re-render every S seconds until ^C
+``--selftest`` deterministic no-socket smoke (run_tier1.sh): build a
+               synthetic 2-replica fleet with one degraded replica
+               entirely from in-memory rings, run the real
+               FleetAggregator + AlertEngine over it, render, and
+               assert the alert/outlier/series sections all surface.
+
+Exit 1 on an unreadable target or a failed selftest.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from typing import Any, Dict, List, Optional
+
+# direct invocation (`python tools/ffdash.py`) puts tools/ on sys.path,
+# not the repo root — the --selftest imports need the package
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+_BLOCKS = "▁▂▃▄▅▆▇█"
+
+
+# -------------------------------------------------------------- rendering
+def spark(values: List[float], width: int = 32) -> str:
+    """Unicode sparkline of the series tail, min-max normalized — the
+    SHAPE is the signal (a cliff, a ramp, a flatline), not the scale;
+    the latest value prints beside it."""
+    vals = [float(v) for v in values[-width:]]
+    if not vals:
+        return ""
+    lo, hi = min(vals), max(vals)
+    if hi - lo < 1e-12:
+        return _BLOCKS[0] * len(vals)
+    return "".join(_BLOCKS[int((v - lo) / (hi - lo)
+                               * (len(_BLOCKS) - 1))] for v in vals)
+
+
+def _fmt(v: Any) -> str:
+    if isinstance(v, float):
+        return f"{v:.4g}"
+    return str(v)
+
+
+def _age(since: Optional[float], now: float) -> str:
+    if since is None:
+        return "-"
+    s = max(0.0, now - float(since))
+    if s < 90:
+        return f"{s:.0f}s"
+    if s < 5400:
+        return f"{s / 60:.1f}m"
+    return f"{s / 3600:.1f}h"
+
+
+def render_health(payload: Dict[str, Any], width: int = 78) -> str:
+    """One screenful of fleet state from a ``/v1/fleet/health``
+    payload (pure text in, text out — shared by live mode, saved
+    records and the selftest)."""
+    now = float(payload.get("time_unix") or time.time())
+    out: List[str] = []
+    rule = "=" * width
+    reps: Dict[str, Dict[str, Any]] = payload.get("replicas") or {}
+    fresh = sum(1 for m in reps.values() if not m.get("stale"))
+    out.append(rule)
+    out.append(f"FLEET HEALTH  @ {time.strftime('%H:%M:%S', time.localtime(now))}"
+               f"   replicas {fresh}/{len(reps)} fresh"
+               f"   merges {payload.get('merges', '-')}"
+               f"   stale_after {_fmt(payload.get('stale_after_s', '-'))}s")
+    out.append(rule)
+
+    series: Dict[str, List[List[float]]] = (
+        (payload.get("fleet") or {}).get("series") or {})
+    if series:
+        out.append("-- fleet series " + "-" * (width - 16))
+        namew = max(len(n) for n in series)
+        for name in sorted(series):
+            pts = series[name]
+            vals = [p[1] for p in pts]
+            out.append(f"  {name:<{namew}}  {spark(vals):<32} "
+                       f" {_fmt(vals[-1])}")
+    else:
+        out.append("  (no fleet series yet)")
+
+    alerts = payload.get("alerts") or {}
+    active = alerts.get("active") or []
+    out.append("-- alerts " + "-" * (width - 10))
+    if active:
+        for a in active:
+            out.append(
+                f"  FIRING  {a.get('rule')}  [{a.get('scope')}]  "
+                f"{a.get('metric')} {a.get('kind')} "
+                f"{_fmt(a.get('threshold'))}  "
+                f"fast={_fmt(a.get('fast'))} slow={_fmt(a.get('slow'))}"
+                f"  for {_age(a.get('since'), now)}")
+    else:
+        out.append("  no active alerts")
+    recent = alerts.get("recent") or []
+    for t in recent[-6:]:
+        out.append(f"    {t.get('state', '?'):>8}  {t.get('rule')}  "
+                   f"[{t.get('scope')}]  "
+                   f"{_age(t.get('wall'), now)} ago")
+
+    out.append("-- replicas " + "-" * (width - 12))
+    if reps:
+        urlw = max(len(u) for u in reps)
+        for url in sorted(reps):
+            m = reps[url]
+            flags = []
+            if m.get("stale"):
+                flags.append("STALE")
+            if m.get("outlier"):
+                flags.append("OUTLIER")
+            dev = m.get("deviations") or {}
+            worst = ""
+            if dev:
+                k = max(dev, key=lambda n: dev[n])
+                worst = f"  worst {k}={_fmt(dev[k])}"
+            out.append(
+                f"  {url:<{urlw}}  age {_fmt(m.get('age_s', '-')):>6}s"
+                f"  score {_fmt(m.get('outlier_score', 0.0)):>6}"
+                f"  {' '.join(flags) or 'ok'}{worst}")
+    else:
+        out.append("  (no replicas)")
+
+    caps = payload.get("captures") or []
+    if caps:
+        out.append("-- captures " + "-" * (width - 12))
+        for c in caps[-4:]:
+            out.append(f"  {c.get('rule')}  [{c.get('replica')}]  "
+                       f"{'ok' if c.get('ok') else 'FAILED'}  "
+                       f"{c.get('path') or '-'}")
+    out.append(rule)
+    return "\n".join(out)
+
+
+# ---------------------------------------------------------------- loading
+def fetch_live(url: str, tail: int, timeout_s: float = 5.0
+               ) -> Dict[str, Any]:
+    import urllib.request
+
+    target = url.rstrip("/") + f"/v1/fleet/health?tail={int(tail)}"
+    with urllib.request.urlopen(target, timeout=timeout_s) as resp:
+        return json.loads(resp.read().decode())
+
+
+def load_saved(path: str) -> Dict[str, Any]:
+    """A fleet-health payload from a saved JSON: the payload itself,
+    or a bench round record's ``fleet_health`` stamp."""
+    with open(path) as f:
+        doc = json.load(f)
+    if not isinstance(doc, dict):
+        raise ValueError(f"{path}: expected a JSON object")
+    if isinstance(doc.get("fleet_health"), dict):
+        return doc["fleet_health"]
+    if "replicas" in doc and "fleet" in doc:
+        return doc
+    raise ValueError(
+        f"{path}: no fleet-health payload (expected a /v1/fleet/health "
+        f"dump or a bench record with a 'fleet_health' stamp)")
+
+
+# --------------------------------------------------------------- selftest
+def selftest() -> int:
+    """Deterministic no-socket smoke: synthetic rings -> the real
+    aggregator + engine -> render -> assert every section surfaced."""
+    from flexflow_tpu.observability import (AlertEngine, FleetAggregator,
+                                            MetricsHistory)
+
+    ok = True
+
+    def check(cond, msg):
+        nonlocal ok
+        if not cond:
+            ok = False
+            print(f"ffdash selftest FAILED: {msg}")
+
+    t0 = 1_700_000_000.0
+    a, b = MetricsHistory(capacity=64), MetricsHistory(capacity=64)
+    rings = {"http://replica-a:1": a, "http://replica-b:2": b}
+    agg = FleetAggregator(stale_after_s=5.0)
+    fired: List[Dict[str, Any]] = []
+    engine = AlertEngine(
+        rules=[{"name": "replica-slo-burn",
+                "metric": "serving_slo_attainment",
+                "scope": "replica", "kind": "below", "threshold": 0.9,
+                "fast_window_s": 3.0, "slow_window_s": 6.0,
+                "rearm_margin": 0.02, "capture": True}],
+        on_fire=lambda rule, scope, info: fired.append(info))
+    # 10 ticks: replica-b's attainment collapses from tick 3 on while
+    # its goodput dries up — replica-a stays healthy throughout
+    for i in range(10):
+        now = t0 + float(i)
+        a.append({"serving_slo_attainment": 0.98,
+                  "serving_goodput_tokens_per_s": 50.0,
+                  "serving_queue_depth": 1.0,
+                  "serving_kv_frames_total": 64.0,
+                  "serving_kv_frames_free": 40.0}, wall=now)
+        sick = i >= 3
+        b.append({"serving_slo_attainment": 0.2 if sick else 0.97,
+                  "serving_goodput_tokens_per_s": 2.0 if sick else 48.0,
+                  "serving_queue_depth": 9.0 if sick else 1.0,
+                  "serving_kv_frames_total": 64.0,
+                  "serving_kv_frames_free": 5.0 if sick else 41.0},
+                 wall=now)
+        agg.merge(rings, now=now)
+        engine.evaluate(agg.history, rings, now=now)
+
+    check(fired and fired[0]["scope"] == "http://replica-b:2",
+          f"burn-rate alert did not fire on the sick replica: {fired}")
+    active = engine.active()
+    check(any(x["scope"] == "http://replica-b:2" for x in active),
+          f"alert not active: {active}")
+    table = agg.replica_table()
+    check(table["http://replica-b:2"]["outlier"] is True,
+          f"sick replica not the outlier: {table}")
+    check(table["http://replica-a:1"]["outlier"] is False,
+          f"healthy replica flagged: {table}")
+
+    payload = agg.health_snapshot(alerts=engine)
+    payload["time_unix"] = t0 + 10.0
+    payload["captures"] = [{"rule": "replica-slo-burn",
+                            "replica": "http://replica-b:2",
+                            "path": "/tmp/ffbundle_demo.json",
+                            "ok": True}]
+    text = render_health(payload)
+    print(text)
+    for needle in ("FLEET HEALTH", "fleet_slo_attainment",
+                   "fleet_goodput_tokens_per_s", "FIRING",
+                   "replica-slo-burn", "http://replica-b:2", "OUTLIER",
+                   "-- captures", "ffbundle_demo.json"):
+        check(needle in text, f"render lost section: {needle!r}")
+    check(_BLOCKS[0] in text or _BLOCKS[-1] in text,
+          "no sparkline rendered")
+
+    # recovery: the fast window clears past the re-arm margin and the
+    # transition shows up in the rendered recent-alerts tail
+    for i in range(10, 16):
+        now = t0 + float(i)
+        for ring, att in ((a, 0.98), (b, 0.97)):
+            ring.append({"serving_slo_attainment": att,
+                         "serving_goodput_tokens_per_s": 49.0,
+                         "serving_queue_depth": 1.0,
+                         "serving_kv_frames_total": 64.0,
+                         "serving_kv_frames_free": 40.0}, wall=now)
+        agg.merge(rings, now=now)
+        engine.evaluate(agg.history, rings, now=now)
+    check(not engine.active(), f"alert never re-armed: "
+          f"{engine.active()}")
+    payload = agg.health_snapshot(alerts=engine)
+    payload["time_unix"] = t0 + 16.0
+    check("resolved" in render_health(payload),
+          "resolved transition not rendered")
+
+    if ok:
+        print("ffdash selftest OK (synthetic fleet: burn-rate fire + "
+              "re-arm, outlier table, full render)")
+    return 0 if ok else 1
+
+
+# ------------------------------------------------------------------- CLI
+def main(argv: List[str]) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python tools/ffdash.py", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("target", nargs="?",
+                    help="router base URL (http…) or saved JSON path")
+    ap.add_argument("--tail", type=int, default=120)
+    ap.add_argument("--watch", type=float, default=0.0,
+                    help="live mode: re-render every S seconds")
+    ap.add_argument("--selftest", action="store_true")
+    args = ap.parse_args(argv)
+
+    if args.selftest:
+        return selftest()
+    if not args.target:
+        ap.print_help()
+        return 2
+    live = args.target.startswith("http://") \
+        or args.target.startswith("https://")
+    try:
+        while True:
+            payload = (fetch_live(args.target, args.tail) if live
+                       else load_saved(args.target))
+            if args.watch > 0 and live:
+                sys.stdout.write("\x1b[2J\x1b[H")
+            print(render_health(payload))
+            if args.watch <= 0 or not live:
+                return 0
+            time.sleep(args.watch)
+    except KeyboardInterrupt:
+        return 0
+    except (OSError, ValueError) as e:
+        print(f"ffdash: {e}", file=sys.stderr)
+        return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

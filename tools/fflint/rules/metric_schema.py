@@ -5,7 +5,11 @@ Every metric name passed to a registry factory —
 ``.counter("…")`` / ``.gauge("…")`` / ``.histogram("…")`` — must be a
 string literal declared in
 ``flexflow_tpu/observability/schema.METRICS_SCHEMA`` with a matching
-type, and every flight-recorder emission — ``record_event("…")`` — and
+type AND a fleet aggregation kind (``"agg": sum|max|last|histogram`` —
+the merge rule ``observability/fleet.py`` federates the metric across
+replicas with; a metric without one would silently drop out of the
+fleet view), and every flight-recorder emission — ``record_event("…")``
+— and
 request-ledger feed — ``note_event("…")`` — must name a literal
 declared in ``schema.EVENT_SCHEMA`` (one event vocabulary across the
 tracer, the recorder ring and the per-request ledger).  The registry,
@@ -46,6 +50,11 @@ RECORD_FUNCS = {"record_event", "note_event"}
 #: metrics registry (np.histogram, pandas plotting, …)
 SKIP_RECEIVERS = {"np", "numpy", "jnp", "scipy", "torch", "plt", "pd",
                   "pandas", "ax", "axes"}
+#: the fleet-aggregation vocabulary (schema docstring): how
+#: observability/fleet.py merges the metric across replicas.  A metric
+#: registered without one cannot be federated, so a missing/invalid
+#: "agg" on a REGISTERED metric is a lint error at the call site.
+AGG_KINDS = {"sum", "max", "last", "histogram"}
 
 
 class MetricSchemaRule(Rule):
@@ -99,6 +108,14 @@ class MetricSchemaRule(Rule):
                         f"metric {name!r} is declared as "
                         f"{decl.get('type')!r} but created as "
                         f"{f.attr!r}"))
+                elif decl.get("agg") not in AGG_KINDS:
+                    findings.append(self.finding(
+                        module, node,
+                        f"metric {name!r} is declared without a fleet "
+                        f"aggregation kind — add \"agg\": "
+                        f"sum|max|last|histogram to its schema entry "
+                        f"so observability/fleet.py can merge it "
+                        f"across replicas"))
             else:
                 findings.append(self.finding(
                     module, node,

@@ -88,6 +88,26 @@
  && env JAX_PLATFORMS=cpu python -m flexflow_tpu.serve.net \
     --selftest-fleetkv >/dev/null) \
  || { echo "serve.net fleet-KV loopback selftest FAILED" >&2; exit 1; }
+# ffdash/fleet-plane smoke: deterministic no-socket federation +
+# alerting — synthetic 2-replica rings through the REAL FleetAggregator
+# and AlertEngine (burn-rate fire on the degraded replica, hysteresis
+# re-arm, outlier table) rendered end-to-end — so a broken health
+# plane or dashboard fails CI before anyone reads it mid-incident.
+(cd "$(dirname "$0")/.." \
+ && env JAX_PLATFORMS=cpu python tools/ffdash.py --selftest >/dev/null) \
+ || { echo "ffdash/fleet-plane selftest FAILED" >&2; exit 1; }
+# Fleet-health federation smoke: the 2-replica e2e gate — one spawned
+# CPU replica carries an unattainably tight SLO budget (--slo-ttft),
+# the router's burn-rate engine must fire replica-slo-burn against
+# THAT replica only, auto-capture its /v1/debug/bundle to disk, mark
+# it the outlier over /v1/fleet/health, flip it to stale once killed —
+# while its token streams stay byte-identical to the healthy
+# replica's — so a broken federation/alert/capture path fails CI
+# before an incident needs it.
+(cd "$(dirname "$0")/.." \
+ && env JAX_PLATFORMS=cpu python -m flexflow_tpu.serve.net \
+    --selftest-fleet >/dev/null) \
+ || { echo "serve.net fleet-health selftest FAILED" >&2; exit 1; }
 # Hybrid-step parity smoke (fast tier): the stall-free mixed-batch
 # dispatch (chunked prefill fused into decode dispatches,
 # serving/request_manager._hybrid_batch) must stay BIT-EXACT vs the
