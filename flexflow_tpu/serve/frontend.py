@@ -393,6 +393,9 @@ class AsyncServeFrontend:
         self._fail_live(FrontendClosed("front-end closed"),
                         reason="closed")
         if self._thread is None:
+            # fflint: disable=ffrace-thread-affinity  guarded by the
+            # join above: _thread is None only after the driver thread
+            # exited, so the loop IS the sole thread touching the rm
             self.rm.drain_cancels()
         self.rm.on_commit = None
         self.rm.on_finish = None
@@ -525,6 +528,9 @@ class AsyncServeFrontend:
             self._abort_requested &= alive
 
     # ------------------------------------------------------ driver thread
+    # ffrace: root=driver  (the blocking driver loop: Thread(target=
+    # _driver_main) in start() carries the engine's affinity, so the
+    # rm mutations below are its own, not a foreign thread's)
     def _driver_main(self) -> None:
         rm = self.rm
         while not self._stop.is_set():

@@ -270,6 +270,9 @@ class FrameMigrator:
             cached_len, self.estimate_bytes(cached_len))
 
     # ----------------------------------------------------------- transfer
+    # ffrace: fold-boundary  (rewrites the destination slice's cache
+    # rows in place — legal only while neither slice has a dispatch
+    # in flight over them)
     def migrate(self, guid: int, src_row: int, dst_row: int,
                 length: int) -> Dict[str, Any]:
         """Move ``length`` committed KV positions from the source
@@ -497,6 +500,8 @@ def _admit(rm, pre: SlicePool, dec: SlicePool, st: _DisaggState) -> None:
                 pager.lease(row, len(req.tokens), owner="req",
                             guid=req.guid, force=True)
             rm._push_tables()
+            # ffrace: fold-boundary  disagg admission: the decode row
+            # was just leased free, no dispatch references it
             matched = rm._restore_spilled(dec.im, {dec.model_id: 1},
                                           req, row)
             req.cached_len = matched.get(dec.model_id, 0)
@@ -520,6 +525,8 @@ def _admit(rm, pre: SlicePool, dec: SlicePool, st: _DisaggState) -> None:
                 victim = pager.scheduler.pick_victim(
                     rm.running, protect_guids=rm._protected_guids())
                 if victim is not None:
+                    # ffrace: fold-boundary  _admit runs between
+                    # device epochs, same contract as admit_pending
                     rm.preempt_request(victim, reason="admission")
                     admission_preempted = True
                     continue
@@ -577,6 +584,8 @@ def _prefill_bc(rm, pre: SlicePool, st: _DisaggState) -> BatchConfig:
     return bc
 
 
+# ffrace: fold-boundary  (called only from _fold_prefill: the
+# dispatch being folded is done, nothing in flight references the rows)
 def _hand_off(rm, pre: SlicePool, dec: SlicePool, st: _DisaggState,
               prow: int, req, migrator: FrameMigrator) -> None:
     """Move a finished prefill to the decode pool at this fold
@@ -617,6 +626,8 @@ def _hand_off(rm, pre: SlicePool, dec: SlicePool, st: _DisaggState,
     rm.running[drow] = req
 
 
+# ffrace: fold-boundary  (IS the fold: runs after the prefill
+# dispatch's outputs are synced, before the next dispatch is built)
 def _fold_prefill(rm, pre: SlicePool, dec: SlicePool, st: _DisaggState,
                   bc: BatchConfig, outs, migrator: FrameMigrator,
                   t_step: float) -> None:
@@ -813,6 +824,8 @@ def run_disagg_loop(rm, pre: SlicePool, dec: SlicePool, requests,
                     # prefill to finish + the fold itself) — stamping
                     # at dispatch would double-count the decode pass
                     # in serving_step_seconds
+                    # ffrace: fold-boundary  the overlapped prefill
+                    # was waited on above; its outputs are host-side
                     _fold_prefill(rm, pre, dec, st, bc_p, outs,
                                   migrator, time.monotonic())
                 if rm.kv_pager is not None and rm.running:

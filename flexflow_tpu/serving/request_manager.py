@@ -514,6 +514,10 @@ class RequestManager:
                     if victim is not None and (
                             not have_row
                             or pager.shortfall(None, need_len)):
+                        # ffrace: fold-boundary  admission runs only
+                        # between device epochs (drain_cancels above
+                        # is the same contract): nothing in flight
+                        # references the victim's row
                         self.preempt_request(victim, reason="admission")
                         admission_preempted = True
                         # the victim re-queued at the FRONT — restart
@@ -612,6 +616,9 @@ class RequestManager:
                 # restores below read the DESTINATION row's table
                 self._push_tables()
             if spill is not None:
+                # ffrace: fold-boundary  same admission boundary as
+                # the preempt above: the destination row is free and
+                # no dispatch references it yet
                 matched = self._restore_spilled(im, model_rows, req, row)
             elif entry is not None and d:
                 for mid, mult in (model_rows or {}).items():
@@ -777,6 +784,8 @@ class RequestManager:
         self.ledger.note_event("admission-blocked", guid=req.guid,
                                reason=reason)
 
+    # ffrace: fold-boundary  (re-points a row at spilled host KV —
+    # legal only while no dispatch references the destination row)
     def _restore_spilled(self, im: InferenceManager,
                          model_rows: Dict[int, int], req: Request,
                          row: int) -> Dict[int, int]:
@@ -1044,6 +1053,9 @@ class RequestManager:
                         others, protect_guids=protect)
                     if victim is None:
                         break
+                    # ffrace: fold-boundary  reached only with
+                    # preempt=True, which callers pass solely at the
+                    # between-dispatch true-up
                     self.preempt_request(victim, reason="pages")
             if (not pager.lease(row, target, owner="req", guid=req.guid,
                                 force=True)
@@ -1074,8 +1086,10 @@ class RequestManager:
                         # frame-dry path too, or two oversized rows
                         # ping-pong spill/restore forever
                         if self.running.get(row) is req:
+                            # ffrace: fold-boundary  preempt=True path
                             self.preempt_request(req, reason="pages")
                         break
+                    # ffrace: fold-boundary  preempt=True path
                     self.preempt_request(victim, reason="pages")
         if preempt:
             # true up force-booked overage (decode-block growth books
@@ -1088,9 +1102,13 @@ class RequestManager:
                     self.running, protect_guids=protect)
                 if victim is None:
                     break         # only protected rows left: overage
+                # ffrace: fold-boundary  preempt=True-gated true-up
                 self.preempt_request(victim, reason="pages")
         self._push_tables()
 
+    # ffrace: fold-boundary  (the PR-10 invariant this annotation
+    # encodes: evicting a running row re-points leases a dispatch may
+    # read — callers must sit between dispatches)
     def preempt_request(self, req: Request, reason: str,
                         mode: Optional[str] = None):
         """Evict a RUNNING request from its row: spill its committed KV

@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import json
 import os
+import re
 import subprocess
 import sys
 import textwrap
@@ -28,9 +29,13 @@ from tools.fflint.rules import ALL_RULES  # noqa: E402
 from tools.fflint.rules.asyncio_blocking import AsyncioBlockingRule  # noqa: E402
 from tools.fflint.rules.direct_host_sync import DirectHostSyncRule  # noqa: E402
 from tools.fflint.rules.donation import DonationRule  # noqa: E402
+from tools.fflint.rules.fold_boundary import FoldBoundaryRule  # noqa: E402
 from tools.fflint.rules.host_sync import HostSyncRule  # noqa: E402
 from tools.fflint.rules.lock_discipline import LockDisciplineRule  # noqa: E402
-from tools.fflint.rules.metric_schema import MetricSchemaRule  # noqa: E402
+from tools.fflint.rules.lock_order import LockOrderRule  # noqa: E402
+from tools.fflint.rules.metric_schema import (  # noqa: E402
+    DERIVED_FLEET_SERIES, MetricSchemaRule)
+from tools.fflint.rules.thread_affinity import ThreadAffinityRule  # noqa: E402
 from tools.fflint.rules.pallas_tiling import PallasTilingRule  # noqa: E402
 from tools.fflint.rules.retrace import RetraceRule  # noqa: E402
 from tools.fflint.rules.shard_consistency import ShardConsistencyRule  # noqa: E402
@@ -2524,6 +2529,48 @@ class TestMutationOracle:
         fs = self._lint(root, rules)
         assert at(fs, "lock-discipline", mono_line), fs
 
+    def test_dropped_call_on_driver_caught_at_exact_line(self, tmp_path):
+        # the ffrace tentpole hazard: an asyncio handler reaching
+        # driver-affine engine state directly because someone deleted
+        # the call_on_driver wrapper around the KV-export op
+        rels = ["flexflow_tpu/serve/net/server.py",
+                "flexflow_tpu/serve/frontend.py"]
+        root = self._copy_tree(tmp_path, rels)
+        rules = [ThreadAffinityRule()]
+        assert self._lint(root, rules) == []      # control: clean copies
+        sv = root / "flexflow_tpu/serve/net/server.py"
+        text = sv.read_text()
+        needle = ("res = await self._run_driver_op(\n"
+                  "                lambda: rm.kv_export_prefix(im, "
+                  "tokens))")
+        assert text.count(needle) == 1, "kv-export handler changed shape?"
+        repl = "res = rm.kv_export_prefix(im, tokens)"
+        sv.write_text(text.replace(needle, repl))
+        line = 1 + text[:text.index(needle)].count("\n")
+        fs = self._lint(root, rules)
+        assert at(fs, "ffrace-thread-affinity", line), fs
+        assert all(f.rule == "ffrace-thread-affinity" for f in fs), fs
+
+    def test_preempt_from_non_fold_site_caught_at_exact_line(
+            self, tmp_path):
+        # the fold-boundary hazard: a preemption injected into the
+        # cancel path, which runs mid-dispatch (rows still referenced
+        # by the in-flight step)
+        rels = ["flexflow_tpu/serving/request_manager.py"]
+        root = self._copy_tree(tmp_path, rels)
+        rules = [FoldBoundaryRule()]
+        assert self._lint(root, rules) == []      # control: clean copy
+        rmf = root / "flexflow_tpu/serving/request_manager.py"
+        text = rmf.read_text()
+        needle = "        req.status = Request.CANCELLED\n"
+        assert text.count(needle) == 1, "cancel path changed shape?"
+        inject = ('        self.preempt_request(req, '
+                  'reason="deadline")\n')
+        rmf.write_text(text.replace(needle, needle + inject))
+        line = 2 + text[:text.index(needle)].count("\n")
+        fs = self._lint(root, rules)
+        assert at(fs, "ffrace-fold-boundary", line), fs
+
 
 # ---------------------------------------------------------------- stats
 class TestStats:
@@ -2550,3 +2597,540 @@ class TestStats:
         data = json.loads(r.stdout)
         assert data["stats"]["files"] == 1
         assert "fflint --stats" in r.stderr
+
+    def test_whole_repo_run_is_clean_and_under_budget(self):
+        # the tier-1 pre-gate contract, pinned: the real tree with ALL
+        # rules (ffrace family included) has ZERO findings at default
+        # severity and the full two-pass run fits the 8s budget
+        r = subprocess.run(
+            [sys.executable, "-m", "tools.fflint", "--json", "--stats",
+             "flexflow_tpu", "tools"],
+            capture_output=True, text=True, cwd=REPO, timeout=300)
+        assert r.returncode == 0, r.stdout + r.stderr
+        data = json.loads(r.stdout)
+        assert data["findings"] == [], data["findings"]
+        assert data["stats"]["total_s"] < 8.0, data["stats"]
+
+
+# ------------------------------------------------------ github format
+class TestGithubFormat:
+    def test_annotations_anchor_file_and_line(self, tmp_path):
+        bad = tmp_path / "m.py"
+        bad.write_text("def f(reg, name):\n    reg.counter(name)\n")
+        r = subprocess.run(
+            [sys.executable, "-m", "tools.fflint", "--format", "github",
+             str(bad)],
+            capture_output=True, text=True, cwd=REPO, timeout=120)
+        assert r.returncode == 1, r.stdout + r.stderr
+        ann = [ln for ln in r.stdout.splitlines()
+               if ln.startswith("::error ")]
+        assert len(ann) == 1, r.stdout
+        assert "m.py" in ann[0] and "line=2" in ann[0], ann
+        assert "title=fflint metric-schema" in ann[0], ann
+        assert "::[metric-schema]" in ann[0], ann
+        # the human summary stays on stderr, off the annotation stream
+        assert "1 finding(s)" in r.stderr, r.stderr
+
+    def test_gh_escape_covers_the_runner_table(self):
+        from tools.fflint.__main__ import _gh_escape
+        assert _gh_escape("a%b\r\nc") == "a%25b%0D%0Ac"
+
+    def test_clean_run_emits_no_annotations(self, tmp_path):
+        ok = tmp_path / "m.py"
+        ok.write_text("x = 1\n")
+        r = subprocess.run(
+            [sys.executable, "-m", "tools.fflint", "--format", "github",
+             str(ok)],
+            capture_output=True, text=True, cwd=REPO, timeout=120)
+        assert r.returncode == 0, r.stdout + r.stderr
+        assert "::error" not in r.stdout, r.stdout
+
+
+# -------------------------------------------------- ffrace: affinity
+class TestThreadAffinityRule:
+    R = [ThreadAffinityRule()]
+
+    def test_thread_root_reaching_affine_state_is_flagged(self, tmp_path):
+        fs = lint(tmp_path, """\
+            import threading
+
+
+            class Sampler:
+                def start(self, rm):
+                    self.rm = rm
+                    self._thread = threading.Thread(
+                        target=self._run, daemon=True)
+                    self._thread.start()
+
+                def _run(self):
+                    self.rm.drain_cancels()
+            """, self.R)
+        line = line_of(tmp_path, "serving/mod.py", "drain_cancels",
+                       subdir=".")
+        assert at(fs, "ffrace-thread-affinity", line), fs
+        assert "thread root" in fs[0].message, fs[0].message
+
+    def test_asyncio_root_reaching_affine_state_is_flagged(self,
+                                                           tmp_path):
+        # every async def is a potential task on the loop — no
+        # create_task call required to seed the root
+        fs = lint(tmp_path, """\
+            async def handler(rm, req):
+                rm.preempt_request(req, reason="deadline")
+            """, self.R)
+        line = line_of(tmp_path, "serving/mod.py", "preempt_request",
+                       subdir=".")
+        assert at(fs, "ffrace-thread-affinity", line), fs
+        assert "asyncio root" in fs[0].message, fs[0].message
+
+    def test_mailbox_calls_are_sanctioned(self, tmp_path):
+        # the locked mailboxes ARE the sanctioned path — including the
+        # deferred body handed to call_on_driver (the driver runs it)
+        fs = lint(tmp_path, """\
+            async def handler(rm, req, tokens):
+                rm.register_new_request(req)
+                rm.request_cancel(7, "client-gone")
+                fut = rm.call_on_driver(
+                    lambda: rm.kv_export_prefix(req, tokens))
+                return fut
+            """, self.R)
+        assert fs == []
+
+    def test_root_driver_mark_flips_the_check_to_blocking(self,
+                                                          tmp_path):
+        # a thread target marked root=driver OWNS the affine state;
+        # what it must not do is wait indefinitely
+        fs = lint(tmp_path, """\
+            import threading
+
+
+            class Frontend:
+                def start(self):
+                    threading.Thread(target=self._driver_main).start()
+
+                # ffrace: root=driver  the engine's own loop
+                def _driver_main(self):
+                    self.rm.drain_cancels()
+                    self.ready.result()
+                    self.ready.result(timeout=1.0)
+            """, self.R)
+        line = line_of(tmp_path, "serving/mod.py", "self.ready.result()",
+                       subdir=".")
+        assert at(fs, "ffrace-thread-affinity", line), fs
+        assert len(fs) == 1, fs
+        assert "driver thread" in fs[0].message, fs[0].message
+
+    def test_signal_handler_is_a_root(self, tmp_path):
+        fs = lint(tmp_path, """\
+            import signal
+
+
+            def _on_term(signum, frame):
+                ENGINE.cancel_request(0, reason="sigterm")
+
+
+            def install():
+                signal.signal(signal.SIGTERM, _on_term)
+            """, self.R)
+        line = line_of(tmp_path, "serving/mod.py", "cancel_request",
+                       subdir=".")
+        assert at(fs, "ffrace-thread-affinity", line), fs
+        assert "signal root" in fs[0].message, fs[0].message
+
+    def test_propagation_crosses_files_through_the_graph(self, tmp_path):
+        fs = lint_tree(tmp_path, {
+            "pkg/a.py": """\
+                from .b import drain_now
+
+
+                async def handler(rm):
+                    drain_now(rm)
+                """,
+            "pkg/b.py": """\
+                def drain_now(rm):
+                    rm._push_tables()
+                """,
+        }, self.R)
+        line = line_of(tmp_path, "pkg/b.py", "_push_tables")
+        assert at(fs, "ffrace-thread-affinity", line), fs
+        assert "asyncio root pkg/a.py:handler" in fs[0].message, fs
+
+    def test_suppression_with_justification(self, tmp_path):
+        fs = lint(tmp_path, """\
+            import threading
+
+
+            class Sampler:
+                def start(self, rm):
+                    self.rm = rm
+                    threading.Thread(target=self._run).start()
+
+                def _run(self):
+                    self.rm.drain_cancels()  # fflint: disable=ffrace-thread-affinity  fixture: sampler owns a stopped engine
+            """, self.R)
+        assert fs == []
+
+
+# ------------------------------------------------- ffrace: lock order
+class TestLockOrderRule:
+    R = [LockOrderRule()]
+
+    CYCLE_M1 = """\
+        import threading
+
+        A = threading.Lock()
+        B = threading.Lock()
+
+
+        def fwd():
+            with A:
+                with B:
+                    pass
+        """
+
+    def test_opposite_order_across_modules_is_a_cycle(self, tmp_path):
+        fs = lint_tree(tmp_path, {
+            "pkg/m1.py": self.CYCLE_M1,
+            "pkg/m2.py": """\
+                from pkg.m1 import A, B
+
+
+                def rev():
+                    with B:
+                        with A:
+                            pass
+                """,
+        }, self.R)
+        l1 = line_of(tmp_path, "pkg/m1.py", "with B:")
+        l2 = line_of(tmp_path, "pkg/m2.py", "with A:")
+        assert at(fs, "ffrace-lock-order", l1), fs
+        assert at(fs, "ffrace-lock-order", l2), fs
+        assert "cycle" in fs[0].message, fs[0].message
+        assert "pkg.m1:A" in fs[0].message, fs[0].message
+
+    def test_consistent_global_order_is_clean(self, tmp_path):
+        fs = lint_tree(tmp_path, {
+            "pkg/m1.py": self.CYCLE_M1,
+            "pkg/m2.py": """\
+                from pkg.m1 import A, B
+
+
+                def also_fwd():
+                    with A:
+                        with B:
+                            pass
+                """,
+        }, self.R)
+        assert fs == []
+
+    def test_self_deadlock_through_a_helper_call(self, tmp_path):
+        # one-level call propagation: outer holds the lock, inner
+        # re-acquires it — a guaranteed deadlock on a plain Lock
+        fs = lint(tmp_path, """\
+            import threading
+
+
+            class Box:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def outer(self):
+                    with self._lock:
+                        self.inner()
+
+                def inner(self):
+                    with self._lock:
+                        pass
+            """, self.R)
+        line = line_of(tmp_path, "serving/mod.py", "self.inner()",
+                       subdir=".")
+        assert at(fs, "ffrace-lock-order", line), fs
+        assert "self-deadlock" in fs[0].message, fs[0].message
+
+    def test_rlock_reentry_is_exempt(self, tmp_path):
+        fs = lint(tmp_path, """\
+            import threading
+
+
+            class Box:
+                def __init__(self):
+                    self._lock = threading.RLock()
+
+                def outer(self):
+                    with self._lock:
+                        self.inner()
+
+                def inner(self):
+                    with self._lock:
+                        pass
+            """, self.R)
+        assert fs == []
+
+    def test_acquire_release_spans_feed_the_order_graph(self, tmp_path):
+        fs = lint(tmp_path, """\
+            import threading
+
+            GATE = threading.Lock()
+            AUX = threading.Lock()
+
+
+            def fwd():
+                GATE.acquire()
+                with AUX:
+                    pass
+                GATE.release()
+
+
+            def rev():
+                with AUX:
+                    GATE.acquire()
+                    GATE.release()
+            """, self.R)
+        # both edges of the cycle anchor: the with in fwd, the
+        # explicit acquire in rev (8-space needle picks rev's)
+        l_fwd = line_of(tmp_path, "serving/mod.py", "with AUX:",
+                        subdir=".")
+        l_rev = line_of(tmp_path, "serving/mod.py",
+                        "        GATE.acquire()", subdir=".")
+        assert at(fs, "ffrace-lock-order", l_fwd), fs
+        assert at(fs, "ffrace-lock-order", l_rev), fs
+
+    def test_blocking_wait_while_holding_a_lock(self, tmp_path):
+        fs = lint(tmp_path, """\
+            import threading
+
+
+            class W:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def bad(self, fut):
+                    with self._lock:
+                        return fut.result()
+
+                def ok(self, fut):
+                    with self._lock:
+                        v = fut.result(timeout=0.5)
+                    return fut.result() if v else None
+
+                async def aok(self, q):
+                    with self._lock:
+                        return await q.get()
+            """, self.R)
+        line = line_of(tmp_path, "serving/mod.py",
+                       "return fut.result()", subdir=".")
+        assert at(fs, "ffrace-lock-order", line), fs
+        assert len(fs) == 1, fs
+        assert "W._lock" in fs[0].message, fs[0].message
+
+    def test_suppression_with_justification(self, tmp_path):
+        fs = lint(tmp_path, """\
+            import threading
+
+
+            class Box:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def outer(self):
+                    with self._lock:
+                        with self._lock:  # fflint: disable=ffrace-lock-order  fixture: proving the pragma works
+                            pass
+            """, self.R)
+        assert fs == []
+
+
+# ---------------------------------------------- ffrace: fold boundary
+class TestFoldBoundaryRule:
+    R = [FoldBoundaryRule()]
+
+    def test_required_def_missing_annotation_is_flagged(self, tmp_path):
+        fs = lint(tmp_path, """\
+            class RM:
+                def preempt_request(self, req, reason):
+                    self.pending.append(req)
+            """, self.R)
+        line = line_of(tmp_path, "serving/mod.py",
+                       "def preempt_request", subdir=".")
+        assert at(fs, "ffrace-fold-boundary", line), fs
+        assert "must carry" in fs[0].message, fs[0].message
+
+    def test_framemigrator_migrate_requires_annotation(self, tmp_path):
+        fs = lint(tmp_path, """\
+            class FrameMigrator:
+                def migrate(self, rows):
+                    return rows
+            """, self.R)
+        line = line_of(tmp_path, "serving/mod.py", "def migrate",
+                       subdir=".")
+        assert at(fs, "ffrace-fold-boundary", line), fs
+
+    def test_unrelated_migrate_is_not_checked(self, tmp_path):
+        # `migrate` outside FrameMigrator is someone else's verb
+        fs = lint(tmp_path, """\
+            class DataMover:
+                def migrate(self, rows):
+                    return rows
+
+
+            def run(m):
+                m.migrate([])
+            """, self.R)
+        assert fs == []
+
+    def test_call_from_non_fold_context_is_flagged(self, tmp_path):
+        fs = lint(tmp_path, """\
+            class RM:
+                # ffrace: fold-boundary  re-points rows between dispatches
+                def preempt_request(self, req, reason):
+                    pass
+
+                # ffrace: fold-boundary  runs inside the fold
+                def pager_sync(self):
+                    self.preempt_request(1, "pages")
+
+                def mid_dispatch(self):
+                    self.preempt_request(1, "deadline")
+
+                def blessed(self):
+                    # ffrace: fold-boundary  admission: nothing in flight
+                    self.preempt_request(1, "admission")
+            """, self.R)
+        line = line_of(tmp_path, "serving/mod.py",
+                       'self.preempt_request(1, "deadline")', subdir=".")
+        assert at(fs, "ffrace-fold-boundary", line), fs
+        assert len(fs) == 1, fs
+        assert "outside a fold boundary" in fs[0].message, fs[0].message
+
+    def test_suppression_with_justification(self, tmp_path):
+        fs = lint(tmp_path, """\
+            class RM:
+                # ffrace: fold-boundary  re-points rows between dispatches
+                def preempt_request(self, req, reason):
+                    pass
+
+                def mid_dispatch(self):
+                    self.preempt_request(1, "deadline")  # fflint: disable=ffrace-fold-boundary  fixture: proving the pragma works
+            """, self.R)
+        assert fs == []
+
+
+# ------------------------------------------------ alert-rule metrics
+class TestAlertRuleValidation:
+    R = [MetricSchemaRule()]
+
+    def test_unknown_metric_is_flagged(self, tmp_path):
+        fs = lint(tmp_path, """\
+            RULES = [
+                {
+                    "name": "phantom",
+                    "metric": "serving_phantom_depth",
+                    "kind": "below",
+                    "scope": "fleet",
+                    "threshold": 1.0,
+                },
+            ]
+            """, self.R)
+        line = line_of(tmp_path, "serving/mod.py",
+                       "serving_phantom_depth", subdir=".")
+        assert at(fs, "metric-schema", line), fs
+        assert "neither declared" in fs[0].message, fs[0].message
+
+    def test_cumulative_counter_metric_is_flagged(self, tmp_path):
+        fs = lint(tmp_path, """\
+            RULE = {
+                "name": "ramp",
+                "metric": "serving_widgets_total",
+                "kind": "above",
+                "scope": "replica",
+                "threshold": 100.0,
+            }
+            """, self.R)
+        line = line_of(tmp_path, "serving/mod.py",
+                       "serving_widgets_total", subdir=".")
+        assert at(fs, "metric-schema", line), fs
+        assert "cannot be window-thresholded" in fs[0].message, fs
+
+    def test_gauge_and_derived_series_are_clean(self, tmp_path):
+        fs = lint(tmp_path, """\
+            RULES = [
+                {
+                    "name": "depth",
+                    "metric": "serving_queue_depth{tenant=a}",
+                    "kind": "above",
+                    "scope": "replica",
+                    "threshold": 64.0,
+                },
+                {
+                    "name": "slo",
+                    "metric": "fleet_slo_attainment",
+                    "kind": "below",
+                    "scope": "fleet",
+                    "threshold": 0.99,
+                },
+            ]
+            """, self.R)
+        assert fs == []
+
+    def test_histogram_flattened_series_is_flagged(self, tmp_path):
+        hist_schema = dict(SCHEMA, serving_ttft_ms={
+            "type": "histogram", "agg": "histogram", "help": "x"})
+        fs = lint(tmp_path, """\
+            RULE = {
+                "name": "ttft",
+                "metric": "serving_ttft_ms_count",
+                "kind": "above",
+                "scope": "replica",
+                "threshold": 5.0,
+            }
+            """, self.R, schema=hist_schema)
+        line = line_of(tmp_path, "serving/mod.py",
+                       "serving_ttft_ms_count", subdir=".")
+        assert at(fs, "metric-schema", line), fs
+        assert "_count" in fs[0].message, fs[0].message
+
+    def test_non_literal_metric_flagged_even_without_schema(self,
+                                                            tmp_path):
+        fs = lint(tmp_path, """\
+            def mk(name):
+                return {"metric": name, "kind": "above", "scope": "x"}
+            """, self.R, schema=None)
+        line = line_of(tmp_path, "serving/mod.py", '"metric": name',
+                       subdir=".")
+        assert at(fs, "metric-schema", line), fs
+        assert "must be a literal" in fs[0].message, fs[0].message
+
+    def test_echo_dicts_do_not_match(self, tmp_path):
+        # dicts that merely carry rule fields onward (alert events,
+        # validator spec tables) have a non-literal kind — not ours
+        fs = lint(tmp_path, """\
+            def echo(rule):
+                return {
+                    "metric": rule["metric"],
+                    "kind": rule["kind"],
+                    "scope": "fleet",
+                }
+            """, self.R)
+        assert fs == []
+
+    def test_suppression_with_justification(self, tmp_path):
+        fs = lint(tmp_path, """\
+            RULE = {
+                "name": "staged",
+                "metric": "serving_phantom_depth",  # fflint: disable=metric-schema  fixture: schema lands next PR
+                "kind": "below",
+                "scope": "fleet",
+            }
+            """, self.R)
+        assert fs == []
+
+    def test_derived_fleet_series_pinned_to_fleet_source(self):
+        # the DERIVED_FLEET_SERIES table must track fleet.py exactly:
+        # a series added to the aggregator without updating the rule
+        # would be flagged as unknown, and a removed one would keep an
+        # alertable name that no longer exists
+        src = open(os.path.join(
+            REPO, "flexflow_tpu/observability/fleet.py"),
+            encoding="utf-8").read()
+        assert set(re.findall(r'"(fleet_[a-z0-9_]+)"', src)) \
+            == DERIVED_FLEET_SERIES

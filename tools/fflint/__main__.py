@@ -2,8 +2,13 @@
 
 Exit codes: 0 clean (or everything grandfathered), 1 new findings,
 2 usage error.  Text output is ``path:line:col: [rule] message`` plus
-the snippet; ``--json`` emits a machine-readable findings list (the
-shape ``Finding.as_dict`` documents) for editor/CI integration.
+the snippet; ``--format json`` (or the ``--json`` alias) emits a
+machine-readable findings list (the shape ``Finding.as_dict``
+documents) for editor/CI integration; ``--format github`` emits
+GitHub Actions workflow commands (``::error file=…,line=…``) so a CI
+run annotates the diff inline — run_tier1.sh switches to it when
+``GITHUB_ACTIONS``/``FF_LINT_GITHUB`` is set.  The exit code and the
+finding set are format-independent.
 """
 
 from __future__ import annotations
@@ -26,8 +31,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("paths", nargs="*",
                    help="files/directories to lint (default: "
                         "flexflow_tpu tools, relative to the repo root)")
+    p.add_argument("--format", choices=("text", "json", "github"),
+                   default=None,
+                   help="output format: text (default), json, or "
+                        "github (Actions ::error annotations)")
     p.add_argument("--json", action="store_true",
-                   help="emit findings as JSON instead of text")
+                   help="alias for --format json")
     p.add_argument("--select", default="",
                    help="comma-separated rule ids to run (default: all)")
     p.add_argument("--baseline", default=None,
@@ -46,6 +55,12 @@ def build_parser() -> argparse.ArgumentParser:
                         "(included in --json output) — the evidence "
                         "when the tier-1 pre-gate budget blows")
     return p
+
+
+def _gh_escape(s: str) -> str:
+    """Workflow-command data escaping (the Actions runner's own
+    table): %, CR and LF are the only characters with meaning."""
+    return s.replace("%", "%25").replace("\r", "%0D").replace("\n", "%0A")
 
 
 def main(argv=None) -> int:
@@ -120,7 +135,8 @@ def main(argv=None) -> int:
     baseline = load_baseline(args.baseline) if args.baseline else {}
     new, old = apply_baseline(findings, baseline)
 
-    if args.json:
+    fmt = args.format or ("json" if args.json else "text")
+    if fmt == "json":
         payload = {
             "findings": [f.as_dict() for f in new],
             "baselined": len(old),
@@ -128,6 +144,15 @@ def main(argv=None) -> int:
         if stats is not None:
             payload["stats"] = stats.as_dict()
         print(json.dumps(payload, indent=2))
+    elif fmt == "github":
+        for f in new:
+            kind = "error" if f.severity == "error" else "warning"
+            print(f"::{kind} file={f.path},line={f.line},"
+                  f"col={f.col + 1},title=fflint {f.rule}::"
+                  f"{_gh_escape(f'[{f.rule}] {f.message}')}")
+        print(f"fflint: {len(new)} finding(s)"
+              + (f" ({len(old)} baselined)" if old else ""),
+              file=sys.stderr)
     else:
         for f in new:
             print(f.render())

@@ -9,12 +9,15 @@ invariant (and the why) in docs/STATIC_ANALYSIS.md.
 from .asyncio_blocking import AsyncioBlockingRule
 from .direct_host_sync import DirectHostSyncRule
 from .donation import DonationRule
+from .fold_boundary import FoldBoundaryRule
 from .host_sync import HostSyncRule
 from .lock_discipline import LockDisciplineRule
+from .lock_order import LockOrderRule
 from .metric_schema import MetricSchemaRule
 from .pallas_tiling import PallasTilingRule
 from .retrace import RetraceRule
 from .shard_consistency import ShardConsistencyRule
+from .thread_affinity import ThreadAffinityRule
 
 ALL_RULES = [
     HostSyncRule,
@@ -26,4 +29,7 @@ ALL_RULES = [
     ShardConsistencyRule,
     LockDisciplineRule,
     AsyncioBlockingRule,
+    ThreadAffinityRule,
+    LockOrderRule,
+    FoldBoundaryRule,
 ]

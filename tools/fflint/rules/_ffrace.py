@@ -1,0 +1,379 @@
+"""Shared plumbing for the ffrace rule family.
+
+The serving stack is a fixed set of execution roots — the blocking
+driver thread, the asyncio event loop, daemon samplers (watchdog,
+metrics-history), and signal handlers — with exactly one sanctioned
+way to touch engine state from a foreign root: the mailbox trio
+``register_new_request`` / ``request_cancel`` / ``call_on_driver``
+(drained by the driver at fold boundaries) plus
+``call_soon_threadsafe`` for driver->loop handoff.  The three ffrace
+rules (thread-affinity, lock-order, fold-boundary) share this module:
+the ``# ffrace:`` pragma table, execution-root discovery, the
+driver-affine method table, and memoized per-function call summaries
+(all cached on ``ProjectGraph.cache`` so pass 2 stays O(functions)
+regardless of how many roots walk the graph).
+
+Pragma grammar (tokenize-parsed exactly like ``# fflint:`` pragmas —
+a trailing comment applies to its own line, a standalone comment line
+to the next code line; anything after the mark is a free-form reason):
+
+- ``# ffrace: fold-boundary`` on a ``def`` declares the whole function
+  a fold-boundary context; on a call line it blesses that one call.
+- ``# ffrace: root=driver`` on a ``def`` declares it the driver-loop
+  entry: a ``threading.Thread(target=...)`` pointing at it seeds the
+  DRIVER affinity instead of a foreign-thread root.  ``root=thread`` /
+  ``root=asyncio`` / ``root=signal`` force-seed a root the discovery
+  pass cannot see (callbacks registered through an unresolvable
+  indirection) — the add-a-root escape hatch in
+  docs/STATIC_ANALYSIS.md.
+
+Pure stdlib (ast/io/tokenize): must never import jax/numpy
+(tests/test_fflint.py::test_fflint_imports_no_jax).
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import tokenize
+from typing import Dict, List, Optional, Set, Tuple
+
+from ._jax_common import dotted_name
+
+#: RequestManager/InferenceManager/pager/ledger mutation surface: a
+#: call to one of these names is driver-affine — legal only on the
+#: driver thread (or with no driver in flight).  Leaf-name matched, so
+#: the table must stay collision-free against innocent stdlib names.
+DRIVER_AFFINE = frozenset({
+    "admit_pending", "prepare_next_batch", "drain_cancels",
+    "cancel_request", "preempt_request", "pager_sync_leases",
+    "_push_tables", "_restore_spilled", "_retire", "_release_row",
+    "kv_export_prefix", "kv_import_prefix", "prefix_donate",
+    "generate_incr_decoding", "generate_spec_infer", "generate_disagg",
+    "run_disagg_loop",
+})
+
+#: The sanctioned foreign-thread API: locked mailboxes the driver
+#: drains at its own boundaries.  A call through one of these is a
+#: barrier — the walk records nothing and does not descend.
+SANCTIONED = frozenset({
+    "register_new_request", "request_cancel", "call_on_driver",
+    "call_soon_threadsafe",
+})
+
+#: Indefinite blocking waits: flagged with ZERO args/kwargs only (a
+#: timeout argument makes them bounded) and never under ``await``
+#: (awaiting a wrapped future yields the loop).
+BLOCKING_ZERO_ARG = frozenset({"result", "get", "wait", "join"})
+#: Socket reads block regardless of arguments.
+BLOCKING_ANY_ARG = frozenset({"recv", "recv_into", "accept"})
+
+_PRAGMA_PREFIX = "ffrace:"
+
+#: BFS depth bound for affinity propagation — deep enough for the
+#: serving stack's real chains (root -> helper -> helper -> rm call),
+#: bounded so a pathological graph cannot blow up the lint.
+_MAX_AFFINITY_DEPTH = 8
+
+
+# ---------------------------------------------------------------- pragmas
+def ffrace_marks(module) -> Dict[int, Dict[str, int]]:
+    """``# ffrace: <mark> [reason]`` table for one module:
+    target code line -> {mark: pragma line}.  Same attachment rules as
+    core's suppression pragmas: trailing applies to its own line,
+    standalone to the next code line."""
+    cached = module.__dict__.get("_ffrace_marks")
+    if cached is not None:
+        return cached
+    out: Dict[int, Dict[str, int]] = {}
+    if _PRAGMA_PREFIX not in module.text:   # fast path: most files
+        module._ffrace_marks = out
+        return out
+    lines = module.lines
+
+    def _next_code_line(after: int) -> int:
+        for i in range(after, len(lines)):
+            stripped = lines[i].strip()
+            if stripped and not stripped.startswith("#"):
+                return i + 1
+        return after                       # pragma at EOF: inert
+
+    try:
+        for tok in tokenize.generate_tokens(
+                io.StringIO(module.text).readline):
+            if tok.type != tokenize.COMMENT:
+                continue
+            body = tok.string.lstrip("#").strip()
+            if not body.startswith(_PRAGMA_PREFIX):
+                continue
+            rest = body[len(_PRAGMA_PREFIX):].strip()
+            if not rest:
+                continue
+            mark = rest.split()[0]
+            pragma_line = tok.start[0]
+            line = pragma_line
+            if not lines[line - 1][:tok.start[1]].strip():
+                line = _next_code_line(line)
+            out.setdefault(line, {}).setdefault(mark, pragma_line)
+    except tokenize.TokenError:
+        pass
+    module._ffrace_marks = out
+    return out
+
+
+def def_marks(module, fnode: ast.AST) -> Dict[str, int]:
+    """Marks attached to a function's ``def`` line."""
+    return ffrace_marks(module).get(fnode.lineno, {})
+
+
+# ------------------------------------------------------------- references
+class FuncRef:
+    """A function pinned to its defining module — the BFS node."""
+
+    __slots__ = ("rel", "qualname", "node", "minfo")
+
+    def __init__(self, rel: str, qualname: str, node: ast.AST, minfo):
+        self.rel = rel
+        self.qualname = qualname
+        self.node = node
+        self.minfo = minfo
+
+    @property
+    def key(self) -> Tuple[str, str]:
+        return (self.rel, self.qualname)
+
+    @property
+    def cls(self) -> Optional[str]:
+        return self.qualname.split(".")[0] if "." in self.qualname \
+            else None
+
+
+def call_leaf(func: ast.AST) -> str:
+    """``rm.drain_cancels`` -> 'drain_cancels'; ``foo`` -> 'foo'."""
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    if isinstance(func, ast.Name):
+        return func.id
+    return ""
+
+
+def resolve_callable(graph, mi, cls: Optional[str],
+                     expr: ast.AST) -> Optional[FuncRef]:
+    """Resolve a callable reference (``self._m`` against the enclosing
+    class, a bare name, or a dotted path through the import graph) to
+    its defining function; None when unresolvable — the asking rule
+    stays silent on it."""
+    if isinstance(expr, ast.Attribute) \
+            and isinstance(expr.value, ast.Name) \
+            and expr.value.id == "self" and cls:
+        node = mi.functions.get(f"{cls}.{expr.attr}")
+        if node is not None:
+            return FuncRef(mi.rel, f"{cls}.{expr.attr}", node, mi)
+        return None
+    dotted = dotted_name(expr)
+    if not dotted:
+        return None
+    node = mi.functions.get(dotted)
+    if node is not None:
+        return FuncRef(mi.rel, dotted, node, mi)
+    fi = graph.resolve_function(mi, dotted)
+    if fi is not None:
+        return FuncRef(fi.minfo.rel, fi.qualname, fi.node, fi.minfo)
+    return None
+
+
+def body_nodes(fnode: ast.AST) -> List[ast.AST]:
+    """Every node in a function body, pruning nested defs and lambdas:
+    deferred code runs on whoever calls it, not on this function's
+    root (which is exactly why ``call_on_driver(lambda: ...)`` bodies
+    are exempt here — the driver runs them)."""
+    out: List[ast.AST] = []
+    stack = list(ast.iter_child_nodes(fnode))
+    while stack:
+        n = stack.pop()
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.Lambda)):
+            continue
+        out.append(n)
+        stack.extend(ast.iter_child_nodes(n))
+    return out
+
+
+def awaited_ids(nodes: List[ast.AST]) -> Set[int]:
+    """ids of Call nodes directly under ``await`` — yields to the
+    loop, never an indefinite block."""
+    return {id(n.value) for n in nodes
+            if isinstance(n, ast.Await) and isinstance(n.value, ast.Call)}
+
+
+def is_blocking_call(call: ast.Call, awaited: Set[int]) -> Optional[str]:
+    """'result' / 'recv' / ... when the call is an indefinite blocking
+    wait; None otherwise."""
+    if id(call) in awaited or not isinstance(call.func, ast.Attribute):
+        return None
+    leaf = call.func.attr
+    if leaf in BLOCKING_ANY_ARG:
+        return leaf
+    if leaf in BLOCKING_ZERO_ARG and not call.args and not call.keywords:
+        return leaf
+    return None
+
+
+# ---------------------------------------------------------------- summary
+class FuncSummary:
+    """One function's ffrace-relevant surface, memoized per run."""
+
+    __slots__ = ("affine", "driver_entries", "blocking", "calls")
+
+    def __init__(self):
+        #: (call node, leaf name) — driver-affine mutation sites
+        self.affine: List[Tuple[ast.AST, str]] = []
+        #: (call node, callee qualname) — calls into root=driver defs
+        self.driver_entries: List[Tuple[ast.AST, str]] = []
+        #: (call node, leaf name) — indefinite blocking waits
+        self.blocking: List[Tuple[ast.AST, str]] = []
+        #: resolvable callees the walk descends into
+        self.calls: List[FuncRef] = []
+
+
+def func_summary(graph, ref: FuncRef) -> FuncSummary:
+    memo = graph.cache.setdefault("ffrace:summaries", {})
+    s = memo.get(ref.key)
+    if s is not None:
+        return s
+    s = FuncSummary()
+    memo[ref.key] = s
+    nodes = body_nodes(ref.node)
+    awaited = awaited_ids(nodes)
+    for n in nodes:
+        if not isinstance(n, ast.Call):
+            continue
+        leaf = call_leaf(n.func)
+        if leaf in SANCTIONED:
+            continue                       # mailbox barrier
+        if leaf in DRIVER_AFFINE:
+            s.affine.append((n, leaf))
+            continue                       # don't descend past the sink
+        b = is_blocking_call(n, awaited)
+        if b is not None:
+            s.blocking.append((n, b))
+        callee = resolve_callable(graph, ref.minfo, ref.cls, n.func)
+        if callee is None:
+            continue
+        if "root=driver" in def_marks(callee.minfo.module, callee.node):
+            # a driver-entry function invoked as a plain call: the
+            # caller inherits the whole driver-affine surface
+            s.driver_entries.append((n, callee.qualname))
+            continue
+        s.calls.append(callee)
+    return s
+
+
+# ------------------------------------------------------------------ roots
+class Root:
+    """One execution root: where a foreign (or driver) flow starts."""
+
+    __slots__ = ("kind", "ref")
+
+    def __init__(self, kind: str, ref: FuncRef):
+        self.kind = kind                   # thread|asyncio|signal|driver
+        self.ref = ref
+
+    @property
+    def desc(self) -> str:
+        return f"{self.kind} root {self.ref.rel}:{self.ref.qualname}"
+
+
+def _is_thread_ctor(call: ast.Call, mi) -> bool:
+    d = dotted_name(call.func)
+    if d == "threading.Thread":
+        return mi.imports.get("threading") == "threading"
+    if d == "Thread":
+        return mi.imports.get("Thread", "").endswith("threading.Thread")
+    return False
+
+
+def _signal_handler_arg(call: ast.Call, mi) -> Optional[ast.AST]:
+    """handler expr of a ``signal.signal(sig, handler)`` registration."""
+    d = dotted_name(call.func)
+    parts = d.split(".")
+    registers = (
+        (len(parts) == 2 and parts[1] == "signal"
+         and mi.imports.get(parts[0]) == "signal")
+        or (d == "signal" and mi.imports.get("signal") == "signal.signal"))
+    if registers and len(call.args) >= 2:
+        return call.args[1]
+    return None
+
+
+def _thread_target_arg(call: ast.Call, mi) -> Optional[ast.AST]:
+    """target expr of a thread-spawning call: ``Thread(target=...)``,
+    ``loop.run_in_executor(pool, fn, ...)``, ``asyncio.to_thread(fn)``."""
+    if _is_thread_ctor(call, mi):
+        for kw in call.keywords:
+            if kw.arg == "target":
+                return kw.value
+        return None
+    leaf = call_leaf(call.func)
+    if leaf == "run_in_executor" and len(call.args) >= 2:
+        return call.args[1]
+    if leaf == "to_thread" and call.args:
+        return call.args[0]
+    return None
+
+
+def _calls_with_class(tree: ast.AST) -> List[Tuple[ast.Call, Optional[str]]]:
+    out: List[Tuple[ast.Call, Optional[str]]] = []
+    stack: List[Tuple[ast.AST, Optional[str]]] = [(tree, None)]
+    while stack:
+        node, cls = stack.pop()
+        for child in ast.iter_child_nodes(node):
+            ccls = child.name if isinstance(child, ast.ClassDef) else cls
+            if isinstance(child, ast.Call):
+                out.append((child, cls))
+            stack.append((child, ccls))
+    return out
+
+
+def collect_roots(graph) -> List[Root]:
+    """Every execution root in the linted tree, memoized per run:
+    thread targets (Thread/run_in_executor/to_thread), signal
+    handlers, every ``async def`` (any of them may become a task — the
+    loop IS the root), and explicit ``# ffrace: root=...`` marks.  A
+    thread target whose def carries ``root=driver`` seeds the driver
+    root instead of a foreign one."""
+    cached = graph.cache.get("ffrace:roots")
+    if cached is not None:
+        return cached
+    roots: Dict[Tuple[str, str, str], Root] = {}
+
+    def add(kind: str, ref: Optional[FuncRef]) -> None:
+        if ref is None:
+            return
+        marks = def_marks(ref.minfo.module, ref.node)
+        for m in marks:
+            if m.startswith("root="):
+                kind = m.split("=", 1)[1] or kind
+                break
+        roots.setdefault((kind,) + ref.key, Root(kind, ref))
+
+    for mi in graph.infos.values():
+        for qualname, fnode in mi.functions.items():
+            if isinstance(fnode, ast.AsyncFunctionDef):
+                add("asyncio", FuncRef(mi.rel, qualname, fnode, mi))
+            for m in def_marks(mi.module, fnode):
+                if m.startswith("root="):
+                    add(m.split("=", 1)[1],
+                        FuncRef(mi.rel, qualname, fnode, mi))
+        for call, cls in _calls_with_class(mi.module.tree):
+            target = _thread_target_arg(call, mi)
+            if target is not None:
+                add("thread", resolve_callable(graph, mi, cls, target))
+            handler = _signal_handler_arg(call, mi)
+            if handler is not None:
+                add("signal", resolve_callable(graph, mi, cls, handler))
+
+    out = sorted(roots.values(),
+                 key=lambda r: (r.kind, r.ref.rel, r.ref.qualname))
+    graph.cache["ffrace:roots"] = out
+    return out

@@ -1,0 +1,139 @@
+"""ffrace-fold-boundary: preemption/migration only between dispatches.
+
+The PR-10/14 invariant: preempting a request, restoring spilled KV
+and migrating frames re-point rows and leases that an in-flight
+dispatch may still read — so they are legal only at FOLD BOUNDARIES,
+the points where the previous dispatch's outputs are fully folded
+into host state and nothing on-device references the rows.  Until
+now that lived in docstrings; this rule makes it a checked
+annotation:
+
+- ``# ffrace: fold-boundary`` on a ``def`` declares the entire
+  function a fold-boundary context (``_hand_off``: the dispatch it
+  folds is done by contract).
+- ``# ffrace: fold-boundary`` on a CALL line (trailing, or standalone
+  above with the reason) blesses that single call site — used where
+  the boundary is conditional (pager true-up preempts gated on
+  ``preempt=True``, which only fold-boundary callers pass).
+
+Checked entry points are the defs annotated anywhere in the linted
+tree, matched at call sites by leaf name.  Three names are REQUIRED
+to carry the annotation wherever they are defined —
+``preempt_request``, ``FrameMigrator.migrate`` and
+``_restore_spilled`` — so deleting the annotation to silence the
+rule is itself a finding (the annotation cannot silently rot).  A
+call to a checked entry from a non-annotated context without a
+call-site pragma is an error: either the site IS a fold boundary
+(annotate it, stating why) or the call is the mid-dispatch mutation
+this rule exists to catch.
+
+A call inside a nested def counts as blessed when ANY enclosing def
+is annotated (the closure runs within the boundary's extent); fixture
+trees with no annotated defs check nothing except the REQUIRED list —
+the false-positive-shy contract.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..core import Rule
+from . import _ffrace
+
+#: defs that MUST be annotated ``# ffrace: fold-boundary`` wherever
+#: they are defined: (name, required enclosing class or None=any)
+REQUIRED = (
+    ("preempt_request", None),
+    ("migrate", "FrameMigrator"),
+    ("_restore_spilled", None),
+)
+
+
+def _defs_with_class(tree: ast.AST):
+    """(def node, enclosing class name) for every def in a module."""
+    stack: List[Tuple[ast.AST, Optional[str]]] = [(tree, None)]
+    while stack:
+        node, cls = stack.pop()
+        for child in ast.iter_child_nodes(node):
+            ccls = child.name if isinstance(child, ast.ClassDef) else cls
+            if isinstance(child, (ast.FunctionDef,
+                                  ast.AsyncFunctionDef)):
+                yield child, cls
+            stack.append((child, ccls))
+
+
+def _analyze(graph) -> Dict[str, List[Tuple[object, str]]]:
+    cached = graph.cache.get("ffrace:fold")
+    if cached is not None:
+        return cached
+    findings: Dict[str, List[Tuple[object, str]]] = {}
+    annotated_defs: Set[int] = set()       # id(def node)
+    checked_leaves: Set[str] = set()
+
+    required_names = tuple(name for name, _c in REQUIRED)
+    for mi in graph.infos.values():
+        text = mi.module.text
+        if "ffrace:" not in text \
+                and not any(n in text for n in required_names):
+            continue
+        for fnode, cls in _defs_with_class(mi.module.tree):
+            marks = _ffrace.def_marks(mi.module, fnode)
+            if "fold-boundary" in marks:
+                annotated_defs.add(id(fnode))
+                checked_leaves.add(fnode.name)
+                continue
+            for name, req_cls in REQUIRED:
+                if fnode.name == name and (req_cls is None
+                                           or cls == req_cls):
+                    findings.setdefault(mi.rel, []).append((
+                        fnode,
+                        f"'{fnode.name}' mutates rows/leases that an "
+                        f"in-flight dispatch may reference; its def "
+                        f"must carry '# ffrace: fold-boundary'"))
+                    checked_leaves.add(fnode.name)
+
+    for mi in graph.infos.values():
+        if not any(leaf in mi.module.text for leaf in checked_leaves):
+            continue
+        marks = _ffrace.ffrace_marks(mi.module)
+
+        def scan(node: ast.AST, def_stack: List[ast.AST]) -> None:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                    scan(child, def_stack + [child])
+                    continue
+                if isinstance(child, ast.Call):
+                    leaf = _ffrace.call_leaf(child.func)
+                    if leaf in checked_leaves \
+                            and not any(id(d) in annotated_defs
+                                        for d in def_stack) \
+                            and "fold-boundary" not in marks.get(
+                                child.lineno, {}):
+                        findings.setdefault(mi.rel, []).append((
+                            child,
+                            f"'{leaf}()' called outside a fold "
+                            f"boundary: a dispatch may still "
+                            f"reference the rows it re-points; "
+                            f"annotate the enclosing def or this "
+                            f"call line with '# ffrace: "
+                            f"fold-boundary <why no dispatch is in "
+                            f"flight>'"))
+                scan(child, def_stack)
+
+        scan(mi.module.tree, [])
+    graph.cache["ffrace:fold"] = findings
+    return findings
+
+
+class FoldBoundaryRule(Rule):
+    id = "ffrace-fold-boundary"
+    short = ("preempt/migrate/restore entry points must be annotated "
+             "fold-boundary and only called from fold-boundary sites")
+
+    def check(self, module, ctx):
+        if ctx.graph is None:
+            return
+        for node, msg in _analyze(ctx.graph).get(module.rel, []):
+            yield self.finding(module, node, msg)

@@ -1,0 +1,339 @@
+"""ffrace-lock-order: global lock-ordering deadlock detection.
+
+PR 6's lock-discipline rule proves per-class field/lock pairing; this
+rule proves the CROSS-lock property it cannot see: the global
+acquired-while-holding graph must be acyclic.  Two threads taking the
+same two locks in opposite orders deadlock only under contention —
+never on the single-threaded tier-1 run, always at fleet scale.
+
+Model (docs/STATIC_ANALYSIS.md has the full semantics):
+
+- **Lock identity** is the defining site: a ``threading.Lock()`` /
+  ``RLock()`` bound to ``self.<attr>`` is ``module:Class.attr``; a
+  module-level lock is ``module:name``, resolvable through the import
+  graph so two modules acquiring the same imported lock share a node
+  (asyncio/multiprocessing locks are out of scope, as in
+  lock-discipline).
+- **Edges**: while lock A is held (``with`` block or ``.acquire()``
+  ... ``.release()`` span, tracked per block), acquiring lock B adds
+  edge A->B anchored at the acquisition.  Calls made while holding
+  propagate ONE level deep through resolvable callees: the callee's
+  own direct acquisitions become edges from every held lock.
+- **Findings**: every edge that sits on a cycle is an error at its
+  acquisition site (each involved module gets its own anchored,
+  individually suppressible finding).  Re-acquiring a held
+  non-reentrant ``Lock`` is an immediate self-deadlock error;
+  ``RLock`` re-entry is exempt (but RLocks still participate in
+  multi-lock cycles).
+- **Blocking while holding**: an indefinite wait (zero-arg
+  ``.result()`` / ``.get()`` / ``.wait()`` / ``.join()``, socket
+  reads; ``await`` and timeout forms exempt) while holding any lock
+  is an error — it extends the hold across an unbounded dependency,
+  the convoy/deadlock feeder.
+
+Nested defs/lambdas are pruned (deferred code runs under its caller's
+locks, unknowable here); unresolvable receivers stay silent — the
+false-positive-shy contract.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..core import Rule
+from ._jax_common import dotted_name
+from . import _ffrace
+from .lock_discipline import _lock_ctor_kind, _self_attr
+
+
+class _LockTables:
+    """Project-wide lock-definition tables."""
+
+    def __init__(self):
+        self.kinds: Dict[str, str] = {}              # lock id -> kind
+        self.class_attrs: Dict[Tuple[str, str], Dict[str, str]] = {}
+        self.module_names: Dict[str, Dict[str, str]] = {}
+
+
+def _lock_tables(graph) -> _LockTables:
+    cached = graph.cache.get("ffrace:locks")
+    if cached is not None:
+        return cached
+    t = _LockTables()
+    for mi in graph.infos.values():
+        if "threading" not in mi.module.text:
+            continue
+        for st in mi.module.tree.body:
+            if isinstance(st, ast.ClassDef):
+                attrs: Dict[str, str] = {}
+                for node in ast.walk(st):
+                    if not isinstance(node, ast.Assign):
+                        continue
+                    kind = _lock_ctor_kind(node.value, mi.imports)
+                    if not kind:
+                        continue
+                    for tgt in node.targets:
+                        attr = _self_attr(tgt)
+                        if attr:
+                            lid = f"{mi.modname}:{st.name}.{attr}"
+                            attrs[attr] = lid
+                            t.kinds[lid] = kind
+                if attrs:
+                    t.class_attrs[(mi.rel, st.name)] = attrs
+            elif isinstance(st, ast.Assign) and len(st.targets) == 1 \
+                    and isinstance(st.targets[0], ast.Name):
+                kind = _lock_ctor_kind(st.value, mi.imports)
+                if kind:
+                    lid = f"{mi.modname}:{st.targets[0].id}"
+                    t.module_names.setdefault(mi.rel, {})[
+                        st.targets[0].id] = lid
+                    t.kinds[lid] = kind
+    graph.cache["ffrace:locks"] = t
+    return t
+
+
+def _lock_of(graph, t: _LockTables, mi, cls: Optional[str],
+             expr: ast.AST) -> Optional[str]:
+    """Lock id of an acquisition expression; None when it is not a
+    known threading lock (other receivers stay silent)."""
+    attr = _self_attr(expr)
+    if attr is not None:
+        return t.class_attrs.get((mi.rel, cls or ""), {}).get(attr)
+    if isinstance(expr, ast.Name):
+        lid = t.module_names.get(mi.rel, {}).get(expr.id)
+        if lid:
+            return lid
+        target = mi.imports.get(expr.id)
+    else:
+        dotted = dotted_name(expr)
+        if not dotted or "." not in dotted:
+            return None
+        alias, _, leaf = dotted.rpartition(".")
+        mod = mi.imports.get(alias)
+        target = f"{mod}.{leaf}" if mod else None
+    if not target or "." not in target:
+        return None
+    mod, _, name = target.rpartition(".")
+    tmi = graph.by_modname.get(mod)
+    if tmi is None:
+        return None
+    return t.module_names.get(tmi.rel, {}).get(name)
+
+
+def _calls_in(expr: ast.AST) -> List[ast.Call]:
+    out: List[ast.Call] = []
+    stack = [expr]
+    while stack:
+        n = stack.pop()
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.Lambda)):
+            continue
+        if isinstance(n, ast.Call):
+            out.append(n)
+        stack.extend(ast.iter_child_nodes(n))
+    return out
+
+
+def _direct_acquires(graph, t: _LockTables,
+                     ref: _ffrace.FuncRef) -> Set[str]:
+    """Lock ids a function acquires anywhere in its own body (the
+    one-level call-propagation summary)."""
+    memo = graph.cache.setdefault("ffrace:lockacq", {})
+    got = memo.get(ref.key)
+    if got is not None:
+        return got
+    acq: Set[str] = set()
+    memo[ref.key] = acq
+    for n in _ffrace.body_nodes(ref.node):
+        if isinstance(n, (ast.With, ast.AsyncWith)):
+            for item in n.items:
+                lid = _lock_of(graph, t, ref.minfo, ref.cls,
+                               item.context_expr)
+                if lid:
+                    acq.add(lid)
+        elif isinstance(n, ast.Call) \
+                and _ffrace.call_leaf(n.func) == "acquire" \
+                and isinstance(n.func, ast.Attribute):
+            lid = _lock_of(graph, t, ref.minfo, ref.cls, n.func.value)
+            if lid:
+                acq.add(lid)
+    return acq
+
+
+def _analyze(graph) -> Dict[str, List[Tuple[object, str]]]:
+    cached = graph.cache.get("ffrace:lockorder")
+    if cached is not None:
+        return cached
+    t = _lock_tables(graph)
+    findings: Dict[str, List[Tuple[object, str]]] = {}
+    # (held, acquired) -> first anchoring (rel, node)
+    edges: Dict[Tuple[str, str], Tuple[str, object]] = {}
+
+    def scan_function(ref: _ffrace.FuncRef) -> None:
+        mi = ref.minfo
+        awaited = _ffrace.awaited_ids(_ffrace.body_nodes(ref.node))
+
+        def on_acquire(lid: str, node, held: List[str]) -> None:
+            for h in held:
+                if h == lid:
+                    if t.kinds.get(lid) != "RLock":
+                        findings.setdefault(ref.rel, []).append((
+                            node,
+                            f"non-reentrant lock '{lid}' re-acquired "
+                            f"while already held: self-deadlock"))
+                else:
+                    edges.setdefault((h, lid), (ref.rel, node))
+
+        def scan_block(stmts, held: List[str]) -> None:
+            for st in stmts:
+                if isinstance(st, (ast.With, ast.AsyncWith)):
+                    inner = list(held)
+                    for item in st.items:
+                        lid = _lock_of(graph, t, mi, ref.cls,
+                                       item.context_expr)
+                        if lid:
+                            on_acquire(lid, item.context_expr, inner)
+                            inner.append(lid)
+                    scan_block(st.body, inner)
+                    continue
+                for expr in _header_exprs(st):
+                    for call in _calls_in(expr):
+                        leaf = _ffrace.call_leaf(call.func)
+                        recv = call.func.value \
+                            if isinstance(call.func, ast.Attribute) \
+                            else None
+                        if leaf == "acquire" and recv is not None:
+                            lid = _lock_of(graph, t, mi, ref.cls, recv)
+                            if lid:
+                                on_acquire(lid, call, held)
+                                held.append(lid)
+                            continue
+                        if leaf == "release" and recv is not None:
+                            lid = _lock_of(graph, t, mi, ref.cls, recv)
+                            if lid and lid in held:
+                                held.remove(lid)
+                            continue
+                        if not held:
+                            continue
+                        b = _ffrace.is_blocking_call(call, awaited)
+                        if b is not None:
+                            findings.setdefault(ref.rel, []).append((
+                                call,
+                                f"blocking wait '{b}()' while holding "
+                                f"lock '{held[-1]}': the hold spans an "
+                                f"unbounded dependency; use a timeout "
+                                f"or move the wait outside the lock"))
+                            continue
+                        callee = _ffrace.resolve_callable(
+                            graph, mi, ref.cls, call.func)
+                        if callee is not None \
+                                and callee.key != ref.key:
+                            for lid in sorted(_direct_acquires(
+                                    graph, t, callee)):
+                                on_acquire(lid, call, held)
+                for block in _child_blocks(st):
+                    scan_block(block, list(held))
+
+        scan_block(ref.node.body, [])
+
+    for mi in graph.infos.values():
+        if not _module_touches_locks(graph, t, mi):
+            continue
+        for qualname, fnode in mi.functions.items():
+            scan_function(_ffrace.FuncRef(mi.rel, qualname, fnode, mi))
+
+    # cycle detection: an edge is a finding iff its source is
+    # reachable from its target (the edge closes a cycle)
+    adj: Dict[str, Set[str]] = {}
+    for (a, b) in edges:
+        adj.setdefault(a, set()).add(b)
+    reach_memo: Dict[str, Set[str]] = {}
+
+    def reachable(src: str) -> Set[str]:
+        got = reach_memo.get(src)
+        if got is not None:
+            return got
+        seen: Set[str] = set()
+        stack = [src]
+        while stack:
+            n = stack.pop()
+            for m in adj.get(n, ()):
+                if m not in seen:
+                    seen.add(m)
+                    stack.append(m)
+        reach_memo[src] = seen
+        return seen
+
+    for (a, b), (rel, node) in sorted(edges.items(),
+                                      key=lambda kv: str(kv[0])):
+        if a in reachable(b):
+            cyc = sorted({a, b} | (reachable(a) & reachable(b)))
+            findings.setdefault(rel, []).append((
+                node,
+                f"lock-order cycle: '{b}' acquired while holding "
+                f"'{a}', but an opposite-order path exists "
+                f"(cycle locks: {', '.join(cyc)}); pick one global "
+                f"order"))
+    graph.cache["ffrace:lockorder"] = findings
+    return findings
+
+
+def _module_touches_locks(graph, t: _LockTables, mi) -> bool:
+    """Cheap bail: a module can only contribute holds if it defines a
+    lock or imports a name that resolves to one."""
+    if mi.rel in t.module_names:
+        return True
+    if any(rel == mi.rel for (rel, _c) in t.class_attrs):
+        return True
+    for target in mi.imports.values():
+        tmi = graph.by_modname.get(target)
+        if tmi is not None and t.module_names.get(tmi.rel):
+            return True                    # module alias over lock defs
+        if "." in target:
+            mod, _, name = target.rpartition(".")
+            tmi = graph.by_modname.get(mod)
+            if tmi is not None \
+                    and name in t.module_names.get(tmi.rel, {}):
+                return True
+    return False
+
+
+def _header_exprs(st: ast.stmt) -> list:
+    if isinstance(st, (ast.If, ast.While)):
+        return [st.test]
+    if isinstance(st, (ast.For, ast.AsyncFor)):
+        return [st.iter]
+    if isinstance(st, ast.Try):
+        return []
+    if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef,
+                       ast.ClassDef)):
+        return []
+    return [st]
+
+
+def _child_blocks(st: ast.stmt) -> list:
+    if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef,
+                       ast.ClassDef)):
+        return []
+    blocks = []
+    for attr in ("body", "orelse", "finalbody"):
+        b = getattr(st, attr, None)
+        if b and not isinstance(b, ast.AST):
+            blocks.append(b)
+    if isinstance(st, ast.Try):
+        for h in st.handlers:
+            blocks.append(h.body)
+    return blocks
+
+
+class LockOrderRule(Rule):
+    id = "ffrace-lock-order"
+    short = ("global acquired-while-holding graph must be acyclic; no "
+             "indefinite blocking waits while holding a lock")
+
+    def check(self, module, ctx):
+        if ctx.graph is None:
+            return
+        for node, msg in _analyze(ctx.graph).get(module.rel, []):
+            yield self.finding(module, node, msg)

@@ -32,6 +32,18 @@ The schema is loaded by ``exec`` of the schema file, NOT by importing
 milliseconds-fast and usable in JAX-free environments.  When no schema
 file exists (fixture trees without one), name validation is skipped
 but the non-literal check still applies.
+
+**Alert rules** (``observability/fleet.AlertEngine``): a dict literal
+whose string keys include the ``validate_rule`` trio ``metric`` /
+``kind`` / ``scope`` is an alert rule.  Its ``metric`` must be a
+literal naming either a ``METRICS_SCHEMA`` gauge or one of the
+fleet-derived series the aggregator synthesizes
+(``DERIVED_FLEET_SERIES`` below — tests pin the set against
+fleet.py's source).  Counters and histogram-flattened ``_count`` /
+``_sum`` series are CUMULATIVE: window-averaging them for a burn-rate
+threshold compares a monotone ramp against a level and the alert
+never (or always) fires — an incompatible ``agg`` kind is an error
+at authoring time, not a silent dead rule in an incident.
 """
 
 from __future__ import annotations
@@ -55,6 +67,23 @@ SKIP_RECEIVERS = {"np", "numpy", "jnp", "scipy", "torch", "plt", "pd",
 #: registered without one cannot be federated, so a missing/invalid
 #: "agg" on a REGISTERED metric is a lint error at the call site.
 AGG_KINDS = {"sum", "max", "last", "histogram"}
+#: the dict keys that identify a literal as an AlertEngine rule —
+#: fleet.validate_rule's required trio
+ALERT_RULE_KEYS = {"metric", "kind", "scope"}
+#: fleet-level series SYNTHESIZED by observability/fleet.py's
+#: aggregator (never registry-emitted, so absent from METRICS_SCHEMA)
+#: — instantaneous by construction, hence valid alert targets.
+#: tests/test_fflint.py pins this set against fleet.py's source.
+DERIVED_FLEET_SERIES = {
+    "fleet_goodput_tokens_per_s",
+    "fleet_slo_attainment",
+    "fleet_kv_frame_headroom",
+    "fleet_costmodel_drift",
+    "fleet_replicas",
+    "fleet_replicas_stale",
+}
+#: histogram scalar-flattening suffixes (fleet.base_metric's table)
+_HIST_SUFFIXES = ("_bucket", "_sum", "_count")
 
 
 class MetricSchemaRule(Rule):
@@ -68,6 +97,10 @@ class MetricSchemaRule(Rule):
         findings: List[Finding] = []
         schema = ctx.metrics_schema
         for node in ast.walk(module.tree):
+            if isinstance(node, ast.Dict):
+                findings.extend(self._check_alert_rule(
+                    module, node, schema))
+                continue
             if not isinstance(node, ast.Call):
                 continue
             f = node.func
@@ -123,6 +156,65 @@ class MetricSchemaRule(Rule):
                     f"non-literal name — the schema's emitted "
                     f"vocabulary must be statically enumerable"))
         return findings
+
+    def _check_alert_rule(self, module: Module, node: ast.Dict,
+                          schema) -> List[Finding]:
+        """Validate one AlertEngine rule dict literal: a Dict whose
+        literal string keys include the validate_rule trio.  Other
+        dicts (records, kwargs, configs) never match."""
+        keys = {}
+        for k, v in zip(node.keys, node.values):
+            if not (isinstance(k, ast.Constant)
+                    and isinstance(k.value, str)):
+                return []                  # ** / computed keys: not a rule
+            keys[k.value] = v
+        if not ALERT_RULE_KEYS <= keys.keys():
+            return []
+        # an AUTHORED rule spells its comparison literally; dicts that
+        # merely echo rule fields (alert events, the validator spec
+        # table in fleet.py) carry a non-literal kind and are not ours
+        kind_node = keys["kind"]
+        if not (isinstance(kind_node, ast.Constant)
+                and kind_node.value in ("below", "above")):
+            return []
+        name_node = keys["metric"]
+        if not (isinstance(name_node, ast.Constant)
+                and isinstance(name_node.value, str)):
+            return [self.finding(
+                module, name_node,
+                "alert rule 'metric' must be a literal metric name — "
+                "the alertable vocabulary must be statically "
+                "enumerable")]
+        if schema is None:
+            return []                      # fixture tree: names unchecked
+        name = name_node.value
+        stem = name.split("{", 1)[0]
+        for suf in _HIST_SUFFIXES:
+            base = stem[: -len(suf)] if stem.endswith(suf) else None
+            if base and schema.get(base, {}).get("type") == "histogram":
+                return [self.finding(
+                    module, name_node,
+                    f"alert rule metric {name!r} is a histogram's "
+                    f"cumulative {suf} series — window-thresholding a "
+                    f"monotone ramp never re-arms; alert on a gauge "
+                    f"or a derived fleet_* series")]
+        if stem in DERIVED_FLEET_SERIES:
+            return []
+        decl = schema.get(stem)
+        if decl is None:
+            return [self.finding(
+                module, name_node,
+                f"alert rule metric {name!r} is neither declared in "
+                f"observability/schema.py nor a fleet-derived series "
+                f"— the rule would silently never fire")]
+        if decl.get("type") != "gauge":
+            return [self.finding(
+                module, name_node,
+                f"alert rule metric {name!r} is a "
+                f"{decl.get('type')} with agg {decl.get('agg')!r} — "
+                f"cumulative series cannot be window-thresholded; "
+                f"alert on a gauge or a derived fleet_* series")]
+        return []
 
     def _check_event(self, module: Module, node: ast.Call,
                      ctx: LintContext) -> List[Finding]:

@@ -1,0 +1,105 @@
+"""ffrace-thread-affinity: whole-program thread-affinity inference.
+
+The engine objects (RequestManager / InferenceManager / KVPager /
+ledger) are driver-affine: exactly one blocking driver thread mutates
+them, and every other execution root — asyncio handlers, daemon
+samplers, signal handlers, worker threads — must go through the
+locked mailboxes (``register_new_request`` / ``request_cancel`` /
+``call_on_driver``) that the driver drains at its own fold
+boundaries.  PR 17 built the mailboxes; this rule proves statically
+that nothing bypasses them.
+
+Model (details + add-a-root guide: docs/STATIC_ANALYSIS.md):
+
+1. **Roots** are discovered project-wide: ``threading.Thread(target=
+   ...)`` / ``run_in_executor`` / ``to_thread`` targets, ``signal.
+   signal`` handlers, every ``async def`` (any coroutine may become a
+   task on the loop), plus explicit ``# ffrace: root=<kind>`` marks.
+   A thread target marked ``# ffrace: root=driver`` seeds the DRIVER
+   affinity (the frontend's ``_driver_main``).
+2. **Propagation**: from each root, a depth-bounded BFS follows
+   resolvable calls (``self.method``, module functions, imported
+   names through the project graph), pruning lambdas/nested defs
+   (deferred code runs on its caller's root — which exempts
+   ``call_on_driver(lambda: ...)`` bodies by construction) and
+   stopping at the sanctioned mailbox calls.
+3. **Findings**: a driver-affine call (the mutation table in
+   ``_ffrace.DRIVER_AFFINE``) or a call into a ``root=driver`` entry
+   reached from a foreign root is an error, anchored at the call
+   site.  On the DRIVER root the check flips: indefinite blocking
+   waits (zero-arg ``.result()`` / ``.get()`` / ``.wait()`` /
+   ``.join()``, socket reads) are errors — a blocked driver stalls
+   every request on the replica.  (Event-loop blocking is
+   asyncio-blocking's job; this rule only walks threads.)
+
+Unresolvable indirection stays silent (the fflint false-positive-shy
+contract); intentional exceptions carry
+``# fflint: disable=ffrace-thread-affinity`` with a justification.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Tuple
+
+from ..core import Rule
+from . import _ffrace
+
+
+def _analyze(graph) -> Dict[str, List[Tuple[object, str]]]:
+    """rel -> [(node, message)] for the whole linted tree, memoized on
+    the graph so the per-module check() is a dict lookup."""
+    cached = graph.cache.get("ffrace:affinity")
+    if cached is not None:
+        return cached
+    findings: Dict[str, List[Tuple[object, str]]] = {}
+    seen_sites = set()
+
+    def emit(rel: str, node, msg: str) -> None:
+        site = (rel, node.lineno, node.col_offset)
+        if site not in seen_sites:
+            seen_sites.add(site)
+            findings.setdefault(rel, []).append((node, msg))
+
+    for root in _ffrace.collect_roots(graph):
+        foreign = root.kind != "driver"
+        visited = set()
+        stack = [(root.ref, 0)]
+        while stack:
+            ref, depth = stack.pop()
+            if ref.key in visited or depth > _ffrace._MAX_AFFINITY_DEPTH:
+                continue
+            visited.add(ref.key)
+            s = _ffrace.func_summary(graph, ref)
+            if foreign:
+                for node, leaf in s.affine:
+                    emit(ref.rel, node,
+                         f"driver-affine '{leaf}()' reached from "
+                         f"{root.desc}: route it through call_on_driver"
+                         f"/request_cancel or justify inline")
+                for node, qualname in s.driver_entries:
+                    emit(ref.rel, node,
+                         f"driver entry '{qualname}' called from "
+                         f"{root.desc}: only the driver thread may run "
+                         f"it")
+            else:
+                for node, leaf in s.blocking:
+                    emit(ref.rel, node,
+                         f"indefinite blocking wait '{leaf}()' on the "
+                         f"driver thread ({root.desc}): a stalled "
+                         f"driver stalls the replica; pass a timeout")
+            for callee in s.calls:
+                stack.append((callee, depth + 1))
+    graph.cache["ffrace:affinity"] = findings
+    return findings
+
+
+class ThreadAffinityRule(Rule):
+    id = "ffrace-thread-affinity"
+    short = ("driver-affine engine state reached from a foreign "
+             "execution root without the sanctioned mailboxes")
+
+    def check(self, module, ctx):
+        if ctx.graph is None:
+            return
+        for node, msg in _analyze(ctx.graph).get(module.rel, []):
+            yield self.finding(module, node, msg)

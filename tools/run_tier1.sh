@@ -19,8 +19,16 @@
 # CI logs.  Rule catalog: docs/STATIC_ANALYSIS.md.  The old
 # check_host_syncs.py / check_metrics_schema.py entrypoints remain as
 # shims over the same rules for external callers.
+# Under GitHub Actions (or with FF_LINT_GITHUB=1) findings emit as
+# ::error workflow commands so they annotate the diff inline; the
+# finding set and exit code are identical in every format.
+fflint_format=""
+if [ -n "${GITHUB_ACTIONS:-}" ] || [ -n "${FF_LINT_GITHUB:-}" ]; then
+  fflint_format="--format github"
+fi
 (cd "$(dirname "$0")/.." \
- && python -m tools.fflint --stats --baseline tools/fflint_baseline.json \
+ && python -m tools.fflint --stats $fflint_format \
+        --baseline tools/fflint_baseline.json \
         flexflow_tpu tools) || exit 1
 # Flight-recorder/ffstat smoke: exercises the post-mortem dump path
 # end-to-end (ring -> heartbeat -> bundle on disk -> pretty-print) so a
